@@ -1,0 +1,59 @@
+"""mxnet_tpu: a TPU-native deep-learning framework.
+
+Brand-new framework with the capabilities of Apache MXNet (the reference,
+see SURVEY.md), re-designed for TPU: jax/XLA is the compute substrate
+(no dependency engine, no manual memory planner — SURVEY.md §1 "TPU
+translation at a glance"), Pallas for hot kernels, pjit/shard_map over
+device meshes for parallelism, collectives over ICI/DCN for distribution.
+
+Public surface mirrors the reference Python frontend (mx.nd, mx.autograd,
+mx.gluon, mx.sym, mx.mod, mx.optimizer, mx.metric, mx.io, mx.kv, ...).
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus  # noqa: F401
+
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from .ndarray.ndarray import NDArray  # noqa: F401
+
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from . import engine  # noqa: F401
+
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import metric  # noqa: F401
+from . import callback  # noqa: F401
+
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from .symbol.symbol import Symbol  # noqa: F401
+from .executor import Executor  # noqa: F401
+
+from . import io  # noqa: F401
+from . import recordio  # noqa: F401
+from . import gluon  # noqa: F401
+from . import module  # noqa: F401
+from . import module as mod  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import model  # noqa: F401
+from .model import save_checkpoint, load_checkpoint  # noqa: F401
+from . import monitor  # noqa: F401
+from .monitor import Monitor  # noqa: F401
+from . import profiler  # noqa: F401
+from . import runtime  # noqa: F401
+from . import test_utils  # noqa: F401
+from . import util  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
+
+from .util import is_np_array, set_np, use_np  # noqa: F401
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)

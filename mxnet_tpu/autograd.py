@@ -1,0 +1,346 @@
+"""Autograd: eager tape + jax.vjp backward.
+
+TPU-native re-design of the reference imperative autograd runtime
+(ref: src/imperative/imperative.cc — RecordOp :193, Backward :280,
+MarkVariables :123; python/mxnet/autograd.py scopes :122-181).
+
+Design: instead of attaching AGInfo to NNVM nodes and running an MXGradient
+graph pass (ref: src/nnvm/gradient.cc:275), every recorded op stores its pure
+jax function and the concrete input/output jax.Arrays. Backward walks the tape
+in reverse and calls `jax.vjp` per node — the FGradient registry, backward
+shape inference, and the dependency engine all collapse into jax's tracing.
+Gradient buffers live on marked NDArrays (`attach_grad`), mirroring
+`grad_req` semantics ('write'/'add'/'null').
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "Function",
+    "get_symbol",
+]
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape: Optional["Tape"] = None
+
+
+_STATE = _AGState()
+
+
+def is_recording() -> bool:
+    """ref: MXAutogradIsRecording / imperative.cc:26-32 thread-local flags."""
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(is_rec: bool) -> bool:
+    prev = _STATE.recording
+    _STATE.recording = is_rec
+    if is_rec and _STATE.tape is None:
+        _STATE.tape = Tape()
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    prev = _STATE.training
+    _STATE.training = train
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+        self._prev_rec = self._prev_train = None
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._prev_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._prev_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True) -> _Scope:  # noqa: F811 (name parity)
+    """ref: python/mxnet/autograd.py:122 `record`."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    """ref: python/mxnet/autograd.py:148 `pause`."""
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    __slots__ = ("fn", "inputs", "outputs", "input_owners", "differentiable",
+                 "custom_backward")
+
+    def __init__(self, fn, inputs, outputs, input_owners, differentiable=True,
+                 custom_backward=None):
+        self.fn = fn                      # pure: (*jax arrays) -> array or tuple
+        self.inputs = inputs              # list[jax.Array]
+        self.outputs = outputs            # list[jax.Array]
+        self.input_owners = input_owners  # list[Optional[NDArray]]
+        self.differentiable = differentiable
+        self.custom_backward = custom_backward  # (out_grads)->in_grads, overrides vjp
+
+
+class Tape:
+    """Eager tape (ref: the AGInfo chain built by Imperative::RecordOp)."""
+
+    def __init__(self):
+        self.nodes: List[TapeNode] = []
+        self.producer: Dict[int, TapeNode] = {}  # id(out array) -> node
+        self.marked: Dict[int, Any] = {}          # id(NDArray) -> NDArray
+
+    def record(self, fn, in_arrays, out_arrays, in_owners, differentiable=True,
+               custom_backward=None):
+        node = TapeNode(fn, list(in_arrays), list(out_arrays), list(in_owners),
+                        differentiable, custom_backward)
+        self.nodes.append(node)
+        for o in out_arrays:
+            self.producer[id(o)] = node
+        return node
+
+
+def current_tape() -> Optional[Tape]:
+    return _STATE.tape
+
+
+def _reset_tape():
+    _STATE.tape = Tape() if _STATE.recording else None
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """ref: Imperative::MarkVariables (imperative.cc:123)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g if req != "null" else None
+        var._grad_req = req
+
+
+def _is_float(arr) -> bool:
+    return jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(
+        arr.dtype, jnp.complexfloating
+    )
+
+
+def _zero_cotangent(arr):
+    if _is_float(arr):
+        return jnp.zeros(arr.shape, arr.dtype)
+    return onp.zeros(arr.shape, dtype=jax.dtypes.float0)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run reverse accumulation from `heads`.
+
+    ref: MXAutogradBackwardEx → Imperative::Backward (imperative.cc:280-523).
+    Walks the eager tape in reverse creation order (already topological),
+    vjp-ing each op; gradients land on marked NDArrays respecting grad_req.
+    """
+    from .ndarray.ndarray import NDArray  # cycle-free at call time
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    tape = _STATE.tape
+    if tape is None or not tape.nodes:
+        raise MXNetError("no computation recorded; call inside autograd.record()")
+
+    if head_grads is None:
+        head_grads = [jnp.ones(h.shape, h.dtype) for h in heads]
+    else:
+        head_grads = [
+            jnp.ones(h.shape, h.dtype) if g is None else g._data
+            for h, g in zip(heads, head_grads)
+        ]
+
+    # grad accumulator keyed by id of the recorded jax array
+    grads: Dict[int, Any] = {}
+    for h, hg in zip(heads, head_grads):
+        grads[id(h._data)] = hg
+
+    for node in reversed(tape.nodes):
+        out_grads = [grads.get(id(o)) for o in node.outputs]
+        if all(g is None for g in out_grads):
+            continue
+        if not node.differentiable:
+            continue
+        cotangents = [
+            g if g is not None else _zero_cotangent(o)
+            for g, o in zip(out_grads, node.outputs)
+        ]
+        if node.custom_backward is not None:
+            in_grads = node.custom_backward(cotangents)
+        else:
+            def _fn_tuple(*args, _f=node.fn):
+                out = _f(*args)
+                return out if isinstance(out, (tuple, list)) else (out,)
+
+            _, vjp_fn = jax.vjp(_fn_tuple, *node.inputs)
+            in_grads = vjp_fn(tuple(cotangents))
+        for inp, owner, ig in zip(node.inputs, node.input_owners, in_grads):
+            if ig is None or (hasattr(ig, "dtype") and ig.dtype == jax.dtypes.float0):
+                continue
+            key = id(inp)
+            if key in grads:
+                grads[key] = grads[key] + ig
+            else:
+                grads[key] = ig
+            if owner is not None and getattr(owner, "_grad", None) is not None:
+                owner._pending_grad = grads[key]
+
+    # deposit into marked variables per grad_req
+    seen = set()
+    for node in tape.nodes:
+        for owner in node.input_owners:
+            if owner is None or id(owner) in seen:
+                continue
+            seen.add(id(owner))
+            pend = getattr(owner, "_pending_grad", None)
+            if pend is None:
+                continue
+            if owner._grad_req == "add":
+                owner._grad._data = owner._grad._data + pend
+            else:  # write
+                owner._grad._data = pend.astype(owner._grad._data.dtype) \
+                    if pend.dtype != owner._grad._data.dtype else pend
+            owner._pending_grad = None
+
+    if not retain_graph:
+        _reset_tape()
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """ref: python/mxnet/autograd.py:273 `grad` — returns grads instead of
+    storing into .grad buffers. create_graph (higher-order) is supported by
+    re-recording the vjp computation through the op layer is NOT yet done;
+    use jax.grad via hybridize for higher-order needs."""
+    from .ndarray.ndarray import NDArray, array as _nd_array
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    # temporarily attach scratch grads
+    saved = [(v, getattr(v, "_grad", None), getattr(v, "_grad_req", "null"))
+             for v in variables]
+    for v in variables:
+        v._grad = _nd_array(onp.zeros(v.shape, dtype=onp.dtype(v.dtype)), ctx=v.ctx)
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph) or create_graph,
+                 train_mode=train_mode)
+        out = [v.grad for v in variables]
+    finally:
+        for v, g, req in saved:
+            v._grad, v._grad_req = g, req
+    return out
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        "get_symbol: use hybridize/jit tracing instead (tape is value-level)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Custom differentiable functions (ref: python/mxnet/autograd.py:368 Function,
+# backed C-side by src/c_api/c_api_function.cc callbacks)
+# ---------------------------------------------------------------------------
+
+class Function:
+    """User-defined op with custom backward.
+
+    Subclass and implement `forward(self, *inputs)` and
+    `backward(self, *output_grads)` operating on NDArrays with autograd
+    paused (mirrors the reference contract).
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+
+        if is_recording():
+            tape = current_tape()
+
+            def custom_backward(cotangents, _self=self, _inputs=inputs):
+                with pause():
+                    in_grads = _self.backward(*[_wrap(c) for c in cotangents])
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                return tuple(g._data if isinstance(g, NDArray) else g
+                             for g in in_grads)
+
+            tape.record(
+                fn=None,
+                in_arrays=[i._data for i in inputs],
+                out_arrays=[o._data for o in outs],
+                in_owners=list(inputs),
+                custom_backward=custom_backward,
+            )
+        return outs[0] if single else outs

@@ -1,0 +1,75 @@
+"""Execution-engine facade.
+
+The reference's dependency engine (ref: src/engine/ — ThreadedEnginePerDevice
+with per-var read/write queues, include/mxnet/engine.h:117) exists to overlap
+async op execution with Python; on TPU, PJRT's async dispatch + XLA's data-flow
+ordering provide the same guarantees by construction (SURVEY.md §5.2: "XLA
+removes intra-graph races by construction"). This module keeps the *control*
+surface: engine-type selection (Naive = synchronous debugging mode, ref:
+MXNET_ENGINE_TYPE in src/engine/engine.cc:32-56), bulking knobs, and the
+WaitForAll / exception-surfacing entry points.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+from .base import get_env
+
+__all__ = ["set_bulk_size", "bulk", "is_sync", "wait_for_all", "set_engine_type"]
+
+_state = threading.local()
+
+
+def _engine_type() -> str:
+    return getattr(_state, "engine_type",
+                   get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice"))
+
+
+def set_engine_type(name: str):
+    """'NaiveEngine' forces synchronous dispatch for debugging
+    (ref: docs/faq/env_var.md:110-114)."""
+    _state.engine_type = name
+
+
+def is_sync() -> bool:
+    return _engine_type() == "NaiveEngine"
+
+
+def maybe_sync(arr):
+    """Called by the nd layer after each op when in NaiveEngine mode: blocks
+    so exceptions surface at the op that raised them (ref: engine exception
+    chains, src/engine/threaded_engine.h:64-65,387)."""
+    if is_sync():
+        jax.block_until_ready(arr)
+    return arr
+
+
+_BULK_SIZE = get_env("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15)
+
+
+def set_bulk_size(size: int) -> int:
+    """ref: Engine::set_bulk_size (include/mxnet/engine.h:311-317). Bulking
+    ≙ XLA fusion; the knob is kept for API parity and is advisory."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def wait_for_all():
+    """ref: Engine::WaitForAll (include/mxnet/engine.h:234)."""
+    try:
+        jax.effects_barrier()
+    except AttributeError:
+        pass

@@ -1,0 +1,223 @@
+"""Executor: a bound, compiled symbol.
+
+TPU-native re-design of the reference GraphExecutor
+(ref: src/executor/graph_executor.cc — Init :388, InitDataEntryMemory :1016,
+InitCachedOps :1174, RunOps :1384, Forward/Backward :78/:91). Bind-time
+"compilation" is jax.jit of the whole-graph eval function; XLA performs
+memory planning, inplace/sharing, fusion (the reference's MXPlanMemory and
+op-bulking), and async dispatch. Backward is jax.vjp of the same function —
+the MXGradient pass (src/nnvm/gradient.cc:275) is never materialized.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context
+from .ndarray.ndarray import NDArray, _wrap
+from . import random as _random
+from .symbol.symbol import Symbol, eval_graph
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol: Symbol, ctx: Context, args: Dict[str, NDArray],
+                 args_grad: Dict[str, NDArray], grad_reqs: Dict[str, str],
+                 aux_states: Dict[str, NDArray]):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = args
+        self.grad_dict = args_grad
+        self.grad_req = grad_reqs
+        self.aux_dict = aux_states
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self.outputs: List[NDArray] = []
+        self._monitor_callback = None
+        self._monitor_all = False
+        self._last_is_train = False
+        self._compiled = {}
+        self._compiled_grad = {}
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """ref: graph_executor.cc:185 SetMonitorCallback"""
+        self._monitor_callback = callback
+        self._monitor_all = monitor_all
+
+    def collect_monitor_stats(self, helper):
+        for name, out in zip(self._symbol.list_outputs(), self.outputs):
+            helper(name, out)
+
+    # ------------------------------------------------------------------
+    # compilation
+    # ------------------------------------------------------------------
+    def _get_compiled(self, is_train: bool):
+        key = is_train
+        if key not in self._compiled:
+            sym = self._symbol
+
+            def fn(arg_vals, aux_vals, rng_raw):
+                vm = dict(arg_vals)
+                vm.update(aux_vals)
+                outs, aux_updates = eval_graph(sym, vm, is_train, rng_raw)
+                return outs, aux_updates
+
+            self._compiled[key] = jax.jit(fn)
+        return self._compiled[key]
+
+    def _get_compiled_grad(self, need_outputs=True):
+        """Fused forward+backward (one XLA program ≙ the train-mode cached
+        graph with backward segment, cached_op.cc StaticBackward)."""
+        if not self._compiled_grad:
+            sym = self._symbol
+            grad_names = [n for n in self._arg_names
+                          if self.grad_req.get(n, "null") != "null"]
+
+            def fb(arg_vals, aux_vals, rng_raw, ograds):
+                def fwd(gvals):
+                    vm = dict(arg_vals)
+                    vm.update(gvals)
+                    vm.update(aux_vals)
+                    outs, aux_updates = eval_graph(sym, vm, True, rng_raw)
+                    return tuple(outs), aux_updates
+
+                gvals = {n: arg_vals[n] for n in grad_names}
+                outs, vjp_fn, aux_updates = jax.vjp(
+                    lambda gv: fwd(gv), gvals, has_aux=True)
+                cots = tuple(
+                    og if og is not None else jnp.ones_like(o)
+                    for o, og in zip(outs, ograds))
+                grads = vjp_fn(cots)[0]
+                return outs, aux_updates, grads
+
+            self._compiled_grad["fb"] = jax.jit(fb)
+        return self._compiled_grad["fb"]
+
+    # ------------------------------------------------------------------
+    # execution (ref: GraphExecutor::Forward :78 / Backward :91)
+    # ------------------------------------------------------------------
+    def _arg_values(self):
+        return {n: self.arg_dict[n]._data for n in self._arg_names}
+
+    def _aux_values(self):
+        return {n: self.aux_dict[n]._data for n in self._aux_names}
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        self._last_is_train = is_train
+        fn = self._get_compiled(is_train)
+        rng = jax.random.key_data(_random.next_key())
+        outs, aux_updates = fn(self._arg_values(), self._aux_values(), rng)
+        for name, val in aux_updates.items():
+            self.aux_dict[name]._rebind(val)
+        self.outputs = [_wrap(o) for o in outs]
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if out_grads is None:
+            ograds = [None] * len(self._symbol._outputs)
+        elif isinstance(out_grads, NDArray):
+            ograds = [out_grads._data]
+        else:
+            ograds = [g._data if isinstance(g, NDArray) else g
+                      for g in out_grads]
+        fb = self._get_compiled_grad()
+        rng = jax.random.key_data(_random.next_key())
+        outs, aux_updates, grads = fb(self._arg_values(), self._aux_values(),
+                                      rng, tuple(ograds))
+        self.outputs = [_wrap(o) for o in outs]
+        for name, val in aux_updates.items():
+            self.aux_dict[name]._rebind(val)
+        for name, g in grads.items():
+            tgt = self.grad_dict.get(name)
+            if tgt is None:
+                continue
+            if self.grad_req.get(name) == "add":
+                tgt._rebind(tgt._data + g)
+            else:
+                tgt._rebind(g)
+
+    def forward_backward(self, out_grads=None, is_train=True, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        self.backward(out_grads)
+        return self.outputs
+
+    # ------------------------------------------------------------------
+    # misc API parity
+    # ------------------------------------------------------------------
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """ref: executor.py copy_params_from"""
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._rebind(
+                    arr._data.astype(self.arg_dict[name]._data.dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"Found name '{name}' not in arguments")
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._rebind(arr._data)
+                elif not allow_extra_params:
+                    raise MXNetError(f"Found name '{name}' not in aux states")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """ref: graph_executor.cc:876 Reshape — rebind with new shapes.
+        jit recompiles per shape automatically; we rebuild buffers."""
+        from .ndarray.ndarray import zeros as nd_zeros
+        shapes = {n: tuple(kwargs.get(n, self.arg_dict[n].shape))
+                  for n in self._arg_names}
+        all_shapes = Symbol._infer_shape_impl  # noqa: F841  (parity no-op)
+        new_args = {}
+        from .symbol.symbol import _infer_all_shapes
+        inferred = _infer_all_shapes(self._symbol, dict(
+            (k, tuple(v)) for k, v in kwargs.items()))
+        for n in self._arg_names:
+            s = inferred.get(n) or shapes[n]
+            old = self.arg_dict[n]
+            if tuple(old.shape) == tuple(s):
+                new_args[n] = old
+            else:
+                new_args[n] = nd_zeros(s, self._ctx, dtype=str(old.dtype))
+        new_auxs = {}
+        for n in self._aux_names:
+            s = inferred.get(n) or self.aux_dict[n].shape
+            new_auxs[n] = self.aux_dict[n] if tuple(
+                self.aux_dict[n].shape) == tuple(s) else nd_zeros(s, self._ctx)
+        grads = {n: nd_zeros(new_args[n].shape, self._ctx)
+                 for n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        dict(self.grad_req), new_auxs)
+
+    def debug_str(self):
+        return self._symbol.tojson()

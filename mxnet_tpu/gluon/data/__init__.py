@@ -1,0 +1,4 @@
+from .dataset import Dataset, ArrayDataset, SimpleDataset, RecordFileDataset  # noqa: F401
+from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler  # noqa: F401
+from .dataloader import DataLoader  # noqa: F401
+from . import vision  # noqa: F401

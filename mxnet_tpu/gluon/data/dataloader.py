@@ -1,0 +1,105 @@
+"""Gluon DataLoader.
+
+ref: python/mxnet/gluon/data/dataloader.py — multi-worker loading. The
+reference forks worker processes that share NDArrays through
+cpu_shared_storage + ForkingPickler (dataloader.py:27-71). On TPU the
+device transfer happens once per batch on the host side, so workers here
+are a thread pool (decode/augment release the GIL in numpy/cv2) with an
+optional process pool; batches land as host numpy and are device_put once.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import multiprocessing
+from typing import Optional
+
+import numpy as onp
+
+from ...ndarray.ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """ref: dataloader.py default_batchify_fn."""
+    if isinstance(data[0], NDArray):
+        from ...ndarray.ndarray import stack
+        return stack(data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = onp.asarray(data)
+    return array(data)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=True, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * self._num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._num_workers)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch_idx in self._batch_sampler:
+                yield self._load_batch(batch_idx)
+            return
+        # pipelined: keep `prefetch` batches in flight
+        sampler_iter = iter(self._batch_sampler)
+        futures = []
+        try:
+            for _ in range(max(1, self._prefetch)):
+                futures.append(self._pool.submit(self._load_batch,
+                                                 next(sampler_iter)))
+        except StopIteration:
+            pass
+        while futures:
+            fut = futures.pop(0)
+            try:
+                futures.append(self._pool.submit(self._load_batch,
+                                                 next(sampler_iter)))
+            except StopIteration:
+                pass
+            yield fut.result(timeout=self._timeout)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)

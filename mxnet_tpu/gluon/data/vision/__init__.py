@@ -1,0 +1,2 @@
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset, ImageFolderDataset  # noqa: F401
+from . import transforms  # noqa: F401

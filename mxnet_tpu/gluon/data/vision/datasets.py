@@ -1,0 +1,202 @@
+"""Vision datasets (ref: python/mxnet/gluon/data/vision/datasets.py).
+
+No network egress in this environment: datasets read from local files under
+`root`; synthetic fallback available for tests via `synthetic=True`.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as onp
+
+from ....base import MXNetError, data_dir
+from ....ndarray.ndarray import array
+from ..dataset import ArrayDataset, Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(array(self._data[idx]), self._label[idx])
+        return array(self._data[idx]), self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """ref: datasets.py MNIST — idx-ubyte files in `root`."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root=os.path.join(data_dir(), "datasets", "mnist"),
+                 train=True, transform=None, synthetic=False,
+                 synthetic_size=1024):
+        self._train = train
+        self._synthetic = synthetic
+        self._synthetic_size = synthetic_size
+        super().__init__(root, transform)
+
+    def _read_idx(self, path):
+        for cand in (path, path + ".gz"):
+            if os.path.exists(cand):
+                opener = gzip.open if cand.endswith(".gz") else open
+                with opener(cand, "rb") as f:
+                    magic = struct.unpack(">I", f.read(4))[0]
+                    ndim = magic & 0xFF
+                    dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+                    return onp.frombuffer(f.read(), dtype=onp.uint8) \
+                        .reshape(dims)
+        return None
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        imgs = self._read_idx(os.path.join(self._root, files[0]))
+        labels = self._read_idx(os.path.join(self._root, files[1]))
+        if imgs is None or labels is None:
+            if not self._synthetic:
+                raise MXNetError(
+                    f"MNIST files not found under {self._root} (no network "
+                    f"egress; place idx-ubyte files there, or pass "
+                    f"synthetic=True for a deterministic synthetic set)")
+            rng = onp.random.RandomState(42 if self._train else 43)
+            n = self._synthetic_size
+            labels = rng.randint(0, 10, size=n).astype(onp.int32)
+            imgs = onp.zeros((n, 28, 28), onp.uint8)
+            for i, lab in enumerate(labels):
+                imgs[i, 2 + lab * 2:6 + lab * 2, 4:24] = 200
+                imgs[i] += rng.randint(0, 30, size=(28, 28)).astype(onp.uint8)
+        self._data = imgs.reshape(-1, 28, 28, 1)
+        self._label = labels.astype(onp.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join(data_dir(), "datasets",
+                                         "fashion-mnist"), train=True,
+                 transform=None, **kwargs):
+        super().__init__(root, train, transform, **kwargs)
+
+
+class CIFAR10(_DownloadedDataset):
+    """ref: datasets.py CIFAR10 — python-pickle batches in `root`."""
+
+    def __init__(self, root=os.path.join(data_dir(), "datasets", "cifar10"),
+                 train=True, transform=None, synthetic=False,
+                 synthetic_size=1024):
+        self._train = train
+        self._synthetic = synthetic
+        self._synthetic_size = synthetic_size
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        batch_files = [f"data_batch_{i}" for i in range(1, 6)] \
+            if self._train else ["test_batch"]
+        data, labels = [], []
+        found = True
+        for fname in batch_files:
+            path = os.path.join(self._root, "cifar-10-batches-py", fname)
+            if not os.path.exists(path):
+                found = False
+                break
+            with open(path, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data.append(d[b"data"].reshape(-1, 3, 32, 32))
+            labels.extend(d[b"labels"])
+        if not found:
+            if not self._synthetic:
+                raise MXNetError(
+                    f"CIFAR10 files not found under {self._root}; pass "
+                    f"synthetic=True for tests")
+            rng = onp.random.RandomState(7 if self._train else 8)
+            n = self._synthetic_size
+            labels = rng.randint(0, 10, size=n).tolist()
+            raw = rng.randint(0, 255, size=(n, 3, 32, 32)).astype(onp.uint8)
+            data = [raw]
+        imgs = onp.concatenate(data).transpose(0, 2, 3, 1)
+        self._data = imgs
+        self._label = onp.asarray(labels, onp.int32)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root=os.path.join(data_dir(), "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None, **kwargs):
+        self._fine = fine_label
+        super().__init__(root, train, transform, **kwargs)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """ref: datasets.py ImageRecordDataset."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack
+        record = super().__getitem__(idx)
+        header, img_bytes = unpack(record)
+        from ....image import imdecode
+        img = imdecode(img_bytes, flag=self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """ref: datasets.py ImageFolderDataset — root/<class>/<img>."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        img = imread(self.items[idx][0], flag=self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,198 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py;
+kernels in src/operator/image/image_random.cc)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ....ndarray.ndarray import NDArray, array, invoke
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+import jax.numpy as jnp
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting"]
+
+
+class Compose(Sequential):
+    """ref: transforms.py Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (ref: _image_to_tensor)."""
+
+    def hybrid_forward(self, F, x):
+        out = x.astype("float32") / 255.0
+        if out.ndim == 3:
+            return out.transpose((2, 0, 1))
+        return out.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, onp.float32).reshape(-1, 1, 1)
+        self._std = onp.asarray(std, onp.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        mean = array(self._mean)
+        std = array(self._std)
+        return (x - mean) / std
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+        h, w = self._size[1], self._size[0]
+        if x.ndim == 3:
+            return invoke(lambda a: jax.image.resize(
+                a, (h, w, a.shape[2]), method="linear"), [x])
+        return invoke(lambda a: jax.image.resize(
+            a, (a.shape[0], h, w, a.shape[3]), method="linear"), [x])
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0, x0 = max((H - h) // 2, 0), max((W - w) // 2, 0)
+        return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import math
+        H, W = x.shape[-3], x.shape[-2]
+        area = H * W
+        for _ in range(10):
+            target_area = onp.random.uniform(*self._scale) * area
+            aspect = math.exp(onp.random.uniform(
+                math.log(self._ratio[0]), math.log(self._ratio[1])))
+            w = int(round(math.sqrt(target_area * aspect)))
+            h = int(round(math.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = onp.random.randint(0, W - w + 1)
+                y0 = onp.random.randint(0, H - h + 1)
+                crop = x[..., y0:y0 + h, x0:x0 + w, :]
+                return Resize(self._size)(crop)
+        return Compose([Resize(self._size)])(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return x[..., :, ::-1, :]
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return x[..., ::-1, :, :]
+        return x
+
+
+class _RandomJitter(Block):
+    def __init__(self, magnitude):
+        super().__init__()
+        self._m = magnitude
+
+    def _factor(self):
+        return 1.0 + onp.random.uniform(-self._m, self._m)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        return x * self._factor()
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        f = self._factor()
+        mean = x.astype("float32").mean()
+        return x.astype("float32") * f + mean * (1 - f)
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        f = self._factor()
+        coef = array(onp.asarray([0.299, 0.587, 0.114], onp.float32))
+        gray = (x.astype("float32") * coef).sum(axis=-1, keepdims=True)
+        return x.astype("float32") * f + gray * (1 - f)
+
+
+class RandomHue(_RandomJitter):
+    def forward(self, x):
+        # simplified: rotate color channels toward mean by factor
+        f = self._factor()
+        mean = x.astype("float32").mean(axis=-1, keepdims=True)
+        return x.astype("float32") * f + mean * (1 - f)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = onp.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (ref: transforms.py RandomLighting)."""
+
+    _eigval = onp.asarray([55.46, 4.794, 1.148], onp.float32)
+    _eigvec = onp.asarray([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]], onp.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        alpha = onp.random.normal(0, self._alpha, size=(3,))
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return x.astype("float32") + array(rgb.astype(onp.float32))

@@ -1,0 +1,335 @@
+"""Gluon Parameter / ParameterDict.
+
+ref: python/mxnet/gluon/parameter.py (1,029 LoC) — Parameter with deferred
+initialization, grad_req, per-context copies; ParameterDict with prefix
+scoping. TPU-native: one jax buffer per parameter (replication/sharding is
+the mesh's job under pjit, not a per-GPU copy list — SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from .. import initializer as init_mod
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros, array as nd_array
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """ref: parameter.py DeferredInitializationError."""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init = ()
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._trainer = None
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape) if new_shape else None
+            return
+        # allow filling in unknown (0) dims
+        assert len(self._shape) == len(new_shape) and all(
+            s == 0 or s == n for s, n in zip(self._shape, new_shape)), \
+            f"Expected shape {self._shape} is incompatible with given " \
+            f"shape {new_shape} for Parameter {self.name}"
+        self._shape = tuple(new_shape)
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """ref: parameter.py initialize — supports deferred init when the
+        shape is not yet known (filled by the first forward)."""
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"Cannot initialize Parameter {self.name} because it has "
+                f"invalid shape: {self._shape}.")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        self._deferred_init = ()
+        data = nd_zeros(self._shape, ctx[0] if ctx else None,
+                        dtype=onp.dtype(self.dtype).name
+                        if not isinstance(self.dtype, str) else self.dtype)
+        initializer = init or self.init or default_init
+        init_mod.create(initializer) if isinstance(initializer, str) else None
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+        initializer(init_mod.InitDesc(self.name), data)
+        self._data = data
+        if self.grad_req != "null":
+            self._grad = nd_zeros(self._shape, ctx[0] if ctx else None,
+                                  dtype=str(data.dtype))
+            from .. import autograd as ag
+            ag.mark_variables([self._data], [self._grad], [self.grad_req])
+            # the data NDArray itself carries the grad buffer
+            self._data._grad = self._grad
+            self._data._grad_req = self.grad_req
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        if self._shape is None or any(s == 0 for s in self._shape):
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has unknown shape")
+        self._finish_init(init, ctx, default_init)
+
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has not been initialized yet because "
+                f"initialization was deferred. Actual initialization happens "
+                f"during the first forward pass.")
+        raise MXNetError(
+            f"Parameter {self.name} has not been initialized. You should "
+            f"initialize parameters with Block.initialize().")
+
+    # ------------------------------------------------------------------
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(
+                f"Cannot get gradient array for Parameter {self.name} "
+                f"because grad_req='null'")
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.ctx]
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            if not self._deferred_init:
+                raise MXNetError(
+                    f"Parameter {self.name} has not been initialized")
+            self._finish_deferred_init()
+        dt = self._data._data.dtype
+        self._data._rebind(
+            data._data.astype(dt) if isinstance(data, NDArray)
+            else nd_array(data)._data.astype(dt))
+
+    def zero_grad(self):
+        if self._grad is not None:
+            import jax.numpy as jnp
+            self._grad._rebind(jnp.zeros_like(self._grad._data))
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._rebind(self._data._data.astype(
+                onp.dtype(dtype) if isinstance(dtype, str) else dtype))
+            if self._grad is not None:
+                self._grad._rebind(self._grad._data.astype(
+                    onp.dtype(dtype) if isinstance(dtype, str) else dtype))
+
+    def var(self):
+        """Symbol placeholder for SymbolBlock interop."""
+        if self._var is None:
+            from ..symbol.symbol import Variable
+            self._var = Variable(self.name, shape=self.shape,
+                                 dtype=self.dtype)
+        return self._var
+
+    @property
+    def stype(self):
+        return self._stype
+
+
+class Constant(Parameter):
+    """ref: parameter.py Constant — non-trainable parameter with fixed
+    value."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd_array(value)
+        self.value = value
+
+        class _InitConst(init_mod.Initializer):
+            def _init_weight(self, _, arr):
+                arr._rebind(value._data.astype(arr._data.dtype))
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype), init=_InitConst())
+
+
+class ParameterDict:
+    """ref: parameter.py ParameterDict."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params: Dict[str, Parameter] = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __len__(self):
+        return len(self._params)
+
+    def __repr__(self):
+        s = "\n".join(repr(p) for p in self._params.values())
+        return f"ParameterDict ({self._prefix})\n{s}"
+
+    def get(self, name, **kwargs) -> Parameter:
+        """ref: parameter.py ParameterDict.get — create-or-retrieve."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        param.shape = v
+                        continue
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"Cannot update self with other because they"
+                                 f" have different Parameters with the same "
+                                 f"name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for _, v in self.items():
+            v.initialize(None, ctx, init or init_mod.Uniform(),
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import ndarray as nd_mod
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(f"Prefix '{strip_prefix}' is to be stripped "
+                                 f"but Parameter's name '{param.name}' does "
+                                 f"not start with it")
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd_mod.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import ndarray as nd_mod
+        loaded = nd_mod.load(filename)
+        arg_dict = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    f"Parameter {name} is missing in file {filename}"
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    f"Parameter {name} loaded from file {filename} is not " \
+                    f"present in ParameterDict"
+                continue
+            self[name].set_data(arg_dict[name])

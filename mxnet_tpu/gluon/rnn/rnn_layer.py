@@ -1,0 +1,195 @@
+"""Gluon fused RNN layers (RNN/LSTM/GRU).
+
+ref: python/mxnet/gluon/rnn/rnn_layer.py (634 LoC) — _RNNLayer over the
+fused RNN op (here ops/rnn.py's lax.scan implementation). Parameters are
+registered per-layer/direction/gate to match the reference's naming
+(l0_i2h_weight, ...) and packed into the flat vector at call time.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be one of ['TNC' or 'NTC']"
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(f"{j}{i}_i2h_weight",
+                                     (ng * nh, ni), i2h_weight_initializer)
+                self._register_param(f"{j}{i}_h2h_weight",
+                                     (ng * nh, nh), h2h_weight_initializer)
+                self._register_param(f"{j}{i}_i2h_bias",
+                                     (ng * nh,), i2h_bias_initializer)
+                self._register_param(f"{j}{i}_h2h_bias",
+                                     (ng * nh,), h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def _infer_param_shapes(self, x, *args):
+        ni = x.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, ni)
+            ni = nh * self._dir
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ...ndarray.ndarray import zeros as nd_zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if func is None:
+                states.append(nd_zeros(info["shape"], **kwargs))
+            else:
+                info.update(kwargs)
+                states.append(func(name=f"{self.prefix}h0_{i}",
+                                   **{k: v for k, v in info.items()
+                                      if k != "__layout__"}))
+        return states
+
+    def _pack_params(self):
+        """Flatten per-gate params into the fused layout (all weights then
+        all biases — matches ops/rnn.py unpack_rnn_params)."""
+        from ...ndarray.ndarray import concat
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(getattr(self, f"{j}{i}_i2h_weight").data()
+                          .reshape((-1,)))
+                ws.append(getattr(self, f"{j}{i}_h2h_weight").data()
+                          .reshape((-1,)))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(getattr(self, f"{j}{i}_i2h_bias").data())
+                bs.append(getattr(self, f"{j}{i}_h2h_bias").data())
+        return concat(*(ws + bs), dim=0)
+
+    def __call__(self, inputs, states=None):
+        self._resolve_deferred(inputs)
+        skip_states = states is None
+        if skip_states:
+            batch_size = inputs.shape[self._layout.find("N")]
+            states = self.begin_state(batch_size)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        out, out_states = self._forward_kernel(inputs, states)
+        return out if skip_states else (out, out_states)
+
+    def _resolve_deferred(self, x):
+        try:
+            for p in self._reg_params.values():
+                p.data()
+        except Exception:
+            xx = x if self._layout == "TNC" else x
+            self._infer_param_shapes(xx)
+            for p in self.collect_params().values():
+                if p._deferred_init:
+                    p._finish_deferred_init()
+                elif p._data is None:
+                    p.initialize()
+
+    def _forward_kernel(self, inputs, states):
+        from ... import ndarray as F
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        params = self._pack_params()
+        rnn_args = [inputs, params] + list(states)
+        outputs = F.RNN(*rnn_args, state_size=self._hidden_size,
+                        num_layers=self._num_layers, mode=self._mode,
+                        bidirectional=self._dir == 2, p=self._dropout,
+                        state_outputs=True)
+        out, h_out, c_out = outputs
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        if self._mode == "lstm":
+            return out, [h_out, c_out]
+        return out, [h_out]
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._input_size} -> " \
+               f"{self._hidden_size}, {self._layout}, " \
+               f"num_layers={self._num_layers})"
+
+
+class RNN(_RNNLayer):
+    """ref: rnn_layer.py RNN."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """ref: rnn_layer.py LSTM."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", projection_size,
+                         **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """ref: rnn_layer.py GRU."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

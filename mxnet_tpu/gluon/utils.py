@@ -1,0 +1,99 @@
+"""Gluon utilities.
+
+ref: python/mxnet/gluon/utils.py — split_data/split_and_load (the
+data-parallel batch scatter), clip_global_norm, check_sha1, download.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import math
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """ref: utils.py split_data."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}.")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        start = i * step
+        end = size if i == num_slice - 1 else (i + 1) * step
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(start, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """ref: utils.py split_and_load — scatter a batch across devices.
+    On a one-mesh TPU program the scatter is a sharding annotation; this
+    per-device list form is kept for reference-style training loops."""
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """ref: utils.py clip_global_norm."""
+    assert len(arrays) > 0
+    total_norm = math.sqrt(sum(
+        float((a * a).sum().asscalar()) for a in arrays))
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """ref: utils.py download (no egress in this environment — local
+    files/file:// only)."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+        shutil.copyfile(url[7:], fname)
+        return fname
+    raise MXNetError("network download is unavailable in this environment; "
+                     "place the file locally and pass a file:// url")

@@ -1,0 +1,356 @@
+"""Image API: decode/augment + ImageIter.
+
+ref: python/mxnet/image/image.py (2,504 LoC) — imdecode/imread/imresize,
+Augmenters, ImageIter; C++ pipeline in src/io/iter_image_recordio_2.cc +
+image_aug_default.cc. Decode uses cv2 when present, else PIL, else raw
+numpy for pre-decoded arrays.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+from typing import List, Optional
+
+import numpy as onp
+
+from .base import MXNetError
+from .io.io import DataBatch, DataDesc, DataIter
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "center_crop",
+           "random_crop", "color_normalize", "CreateAugmenter", "Augmenter",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "HorizontalFlipAug", "ColorNormalizeAug", "CastAug", "ImageIter",
+           "ImageRecordIterPy"]
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError:
+        return None
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """ref: image.py imdecode."""
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imdecode(onp.frombuffer(buf, onp.uint8),
+                           cv2.IMREAD_COLOR if flag else
+                           cv2.IMREAD_GRAYSCALE)
+        if img is None:
+            raise MXNetError("imdecode failed")
+        if to_rgb and flag:
+            img = img[:, :, ::-1]
+        return array(img)
+    try:
+        from PIL import Image
+        import io as _io
+        img = onp.asarray(Image.open(_io.BytesIO(buf)))
+        return array(img)
+    except ImportError:
+        raise MXNetError("no image decoder available (cv2/PIL missing)")
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    import jax
+    data = src._data if isinstance(src, NDArray) else onp.asarray(src)
+    from .ndarray.ndarray import _wrap
+    import jax.numpy as jnp
+    out = jax.image.resize(jnp.asarray(data, jnp.float32),
+                           (h, w, data.shape[2]), method="linear")
+    return _wrap(out.astype(jnp.asarray(data).dtype)
+                 if onp.issubdtype(onp.asarray(data).dtype, onp.integer)
+                 else out)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    out = src[y0:y0 + new_h, x0:x0 + new_w]
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = pyrandom.randint(0, max(w - new_w, 0))
+    y0 = pyrandom.randint(0, max(h - new_h, 0))
+    out = src[y0:y0 + new_h, x0:x0 + new_w]
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter:
+    """ref: image.py Augmenter."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return src[:, ::-1]
+        return src
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = array(onp.asarray(mean, onp.float32)) \
+            if mean is not None else None
+        self.std = array(onp.asarray(std, onp.float32)) \
+            if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src.astype("float32"), self.mean, self.std)
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """ref: image.py CreateAugmenter."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = onp.asarray([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.asarray([58.395, 57.12, 57.375])
+    if mean is not None and (std is not None or True):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """ref: image.py ImageIter — .lst/.rec image iterator with augmenters."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.shuffle = shuffle
+        self.seq = []
+        self.imgrec = None
+        self.imglist = {}
+        if path_imgrec:
+            from .recordio import MXIndexedRecordIO, MXRecordIO
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = onp.asarray(parts[1:-1], dtype=onp.float32)
+                    key = int(parts[0])
+                    self.imglist[key] = (label, parts[-1])
+                    self.seq.append(key)
+            self.path_root = path_root
+        elif imglist:
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (onp.asarray(label, onp.float32)
+                                   if not onp.isscalar(label)
+                                   else onp.asarray([label], onp.float32),
+                                   fname)
+                self.seq.append(i)
+            self.path_root = path_root
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        from .recordio import unpack
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                img = f.read()
+            return label, img
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_data = onp.zeros((self.batch_size,) + self.data_shape,
+                               onp.float32)
+        batch_label = onp.zeros((self.batch_size, self.label_width),
+                                onp.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s) if isinstance(s, bytes) else array(s)
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = img.asnumpy() if isinstance(img, NDArray) else img
+                if arr.ndim == 3 and arr.shape[2] == self.data_shape[0]:
+                    arr = arr.transpose(2, 0, 1)
+                batch_data[i] = arr
+                batch_label[i] = onp.asarray(label).reshape(-1)[
+                    :self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        label_out = batch_label[:, 0] if self.label_width == 1 \
+            else batch_label
+        return DataBatch(data=[array(batch_data)],
+                         label=[array(label_out)], pad=pad)
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+def ImageRecordIterPy(path_imgrec=None, data_shape=(3, 224, 224),
+                      batch_size=1, label_width=1, shuffle=False,
+                      mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1,
+                      std_b=1, rand_crop=False, rand_mirror=False,
+                      resize=0, **kwargs):
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = onp.asarray([mean_r, mean_g, mean_b])
+    std = None
+    if (std_r, std_g, std_b) != (1, 1, 1):
+        std = onp.asarray([std_r, std_g, std_b])
+    augs = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
+                           rand_mirror=rand_mirror, mean=mean, std=std)
+    return ImageIter(batch_size, data_shape, label_width,
+                     path_imgrec=path_imgrec, shuffle=shuffle,
+                     aug_list=augs, **kwargs)

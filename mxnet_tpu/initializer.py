@@ -1,0 +1,249 @@
+"""Weight initializers.
+
+ref: python/mxnet/initializer.py — registry of Initializer subclasses
+(Xavier/MSRAPrelu/Orthogonal/Bilinear/...), dispatched by parameter name
+patterns (weight/bias/gamma/beta/...) in the default `__call__` path.
+Randomness uses the framework threefry state (random.py).
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import Registry
+from . import random as _random
+from .ndarray.ndarray import NDArray, _wrap
+
+_REG = Registry("initializer")
+register = _REG.register
+
+
+class InitDesc(str):
+    """Parameter-name descriptor with attrs (ref: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr: NDArray):
+        if not isinstance(desc, str):
+            desc = str(desc)
+        init_attr = getattr(desc, "attrs", {}).get("__init__", "") \
+            if isinstance(desc, InitDesc) else ""
+        if init_attr:
+            create(init_attr)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # fill helpers rebind the target buffer in place
+    @staticmethod
+    def _set(arr: NDArray, value):
+        arr._rebind(jnp.asarray(value, arr._data.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, name, arr):
+        self._set(arr, jnp.zeros(arr.shape))
+
+    def _init_gamma(self, name, arr):
+        self._set(arr, jnp.ones(arr.shape))
+
+    def _init_beta(self, name, arr):
+        self._set(arr, jnp.zeros(arr.shape))
+
+    def _init_zero(self, name, arr):
+        self._set(arr, jnp.zeros(arr.shape))
+
+    def _init_one(self, name, arr):
+        self._set(arr, jnp.ones(arr.shape))
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+@register("zeros")
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._set(arr, jnp.zeros(arr.shape))
+
+
+@register("ones")
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._set(arr, jnp.ones(arr.shape))
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        self._set(arr, jnp.full(arr.shape, self.value))
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        self._set(arr, jax.random.uniform(_random.next_key(), arr.shape,
+                                          minval=-self.scale, maxval=self.scale))
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        self._set(arr, self.sigma * jax.random.normal(_random.next_key(),
+                                                      arr.shape))
+
+
+@register("xavier")
+class Xavier(Initializer):
+    """ref: initializer.py Xavier — gaussian/uniform over avg/in/out fan."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            fan_in = fan_out = shape[0] if shape else 1
+        else:
+            if len(shape) > 2:
+                hw_scale = float(onp.prod(shape[2:]))
+            fan_in = shape[1] * hw_scale
+            fan_out = shape[0] * hw_scale
+        factor = {
+            "avg": (fan_in + fan_out) / 2.0,
+            "in": fan_in,
+            "out": fan_out,
+        }[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            w = jax.random.uniform(_random.next_key(), shape, minval=-scale,
+                                   maxval=scale)
+        else:
+            w = scale * jax.random.normal(_random.next_key(), shape)
+        self._set(arr, w)
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(_random.next_key(), (nout, nin),
+                                     minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(_random.next_key(), (nout, nin))
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._set(arr, self.scale * q.reshape(arr.shape))
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(onp.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = onp.zeros(arr.shape, dtype="float32")
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+
+@register("mixed")
+class Mixed:
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(f"parameter {name} did not match any pattern")
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if name.lower() in _REG.keys():
+        return _REG.get(name.lower())(**kwargs)
+    raise ValueError(f"unknown initializer {name}")

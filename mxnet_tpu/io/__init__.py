@@ -1,0 +1,4 @@
+from .io import (  # noqa: F401
+    DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
+    MXDataIter, ImageRecordIter, MNISTIter, CSVIter, LibSVMIter,
+)

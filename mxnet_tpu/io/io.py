@@ -1,0 +1,387 @@
+"""Data iterators.
+
+ref: python/mxnet/io/io.py (DataIter :180, NDArrayIter :491, ResizeIter,
+PrefetchingIter :617) and the C++ iterator registry
+(src/io/iter_image_recordio_2.cc:880 MXNET_REGISTER_IO_ITER). The C++
+threaded decode pipeline's role is filled by the native reader in
+mxnet_tpu/native plus background-thread prefetch here.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import namedtuple
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MXDataIter", "ImageRecordIter", "MNISTIter",
+           "CSVIter", "LibSVMIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """ref: io.py DataDesc."""
+
+    def __new__(cls, name, shape, dtype=onp.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """ref: io.py DataBatch."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """ref: io.py:180 DataIter."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """ref: io/utils.py _init_data."""
+    if data is None:
+        data = []
+    if isinstance(data, (onp.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, list or dict")
+    out = {}
+    for k, v in data.items():
+        out[k] = v if isinstance(v, NDArray) else array(v)
+    return list(sorted(out.items()))
+
+
+class NDArrayIter(DataIter):
+    """ref: io.py:491 NDArrayIter — batching/shuffle/pad over in-memory
+    arrays."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 shuffle_seed=0,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.idx = onp.arange(self.num_data)
+        if shuffle:
+            rng = onp.random.RandomState(shuffle_seed or None)
+            rng.shuffle(self.idx)
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._cached = {k: v.asnumpy() for k, v in self.data + self.label}
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self.idx)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrs):
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            sel = self.idx[self.cursor:end]
+        else:
+            pad = end - self.num_data
+            sel = onp.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+        return [array(self._cached[k][sel]) for k, _ in arrs]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """ref: io.py ResizeIter — clip/loop an iterator to `size` batches."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """ref: io.py:617 PrefetchingIter — background-thread double buffering
+    (the role of src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, list):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                    self._queue.put(batches)
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        if len(batches) == 1:
+            return batches[0]
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([b.label for b in batches], []),
+            pad=batches[0].pad, index=batches[0].index)
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+class MXDataIter(DataIter):
+    """Placeholder for C-registered iterators (ref: io.py MXDataIter)."""
+
+    def __init__(self, *a, **kw):
+        raise MXNetError("MXDataIter: use the named iterator classes")
+
+
+def MNISTIter(image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+              batch_size=128, shuffle=True, flat=False, seed=0,
+              silent=False, data_shape=(1, 28, 28), **kwargs):
+    """ref: src/io/iter_mnist.cc — reads idx-ubyte MNIST files."""
+    import gzip
+    import os
+    import struct
+
+    def read_idx(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(dims)
+
+    imgs = read_idx(image).astype(onp.float32) / 255.0
+    labels = read_idx(label).astype(onp.float32)
+    if flat:
+        imgs = imgs.reshape(imgs.shape[0], -1)
+    else:
+        imgs = imgs.reshape((-1,) + tuple(data_shape))
+    return NDArrayIter(imgs, labels, batch_size=batch_size, shuffle=shuffle,
+                       last_batch_handle="discard")
+
+
+def CSVIter(data_csv, data_shape, label_csv=None, label_shape=(1,),
+            batch_size=128, round_batch=True, **kwargs):
+    """ref: src/io/iter_csv.cc"""
+    data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32)
+    data = data.reshape((-1,) + tuple(data_shape))
+    label = None
+    if label_csv:
+        label = onp.loadtxt(label_csv, delimiter=",", dtype=onp.float32)
+        label = label.reshape((-1,) + tuple(label_shape))
+    return NDArrayIter(data, label, batch_size=batch_size)
+
+
+def LibSVMIter(data_libsvm, data_shape, batch_size=128, **kwargs):
+    """ref: src/io/iter_libsvm.cc — parses libsvm text into dense batches."""
+    feats = []
+    labels = []
+    dim = int(onp.prod(data_shape))
+    with open(data_libsvm) as f:
+        for line in f:
+            parts = line.strip().split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            row = onp.zeros(dim, dtype=onp.float32)
+            for tok in parts[1:]:
+                i, v = tok.split(":")
+                row[int(i)] = float(v)
+            feats.append(row)
+    data = onp.stack(feats).reshape((-1,) + tuple(data_shape))
+    return NDArrayIter(data, onp.asarray(labels, onp.float32),
+                       batch_size=batch_size)
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
+                    label_width=1, shuffle=False, **kwargs):
+    """RecordIO image pipeline (ref: src/io/iter_image_recordio_2.cc
+    ImageRecordIter2). Decode+augment via the image module; the native C++
+    reader (mxnet_tpu/native) supplies the fast path when built."""
+    from ..image import ImageRecordIterPy
+    return ImageRecordIterPy(path_imgrec=path_imgrec, data_shape=data_shape,
+                             batch_size=batch_size, label_width=label_width,
+                             shuffle=shuffle, **kwargs)

@@ -1,0 +1,239 @@
+"""KVStore: the distributed key-value parameter store API.
+
+TPU-native re-design of the reference KVStore stack (ref:
+src/kvstore/kvstore.cc:40-77 factory; kvstore_local.h / comm.h device
+reduce; kvstore_dist.h ps-lite worker; python/mxnet/kvstore.py client).
+On TPU the device-comm and NCCL backends collapse into XLA collectives
+compiled into the step function (SURVEY.md §3.5 "TPU mapping"), and the
+multi-host path rides jax.distributed + a global mesh instead of a ZMQ
+parameter server (Appendix B "ps-lite: none of this survives"). This module
+keeps the API *shape* (create/init/push/pull/row_sparse_pull/set_optimizer/
+rank/num_workers) so reference workflows port unchanged:
+
+- 'local'/'device': single-process store; push aggregates gradients from
+  all device shards (the CommDevice::Reduce role, comm.h:503) — on a TPU
+  mesh the actual reduction is a lax.psum inside the jitted step, and this
+  object only tracks optimizer state / weight mirrors.
+- 'dist_sync'/'dist_device_sync'/'dist_async': multi-process via
+  jax.distributed; push performs a global psum over the 'data' axis.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, get_env
+from .ndarray.ndarray import NDArray, _wrap, zeros as nd_zeros
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "create"]
+
+
+def _key_str(key):
+    return str(key)
+
+
+class KVStoreBase:
+    def __init__(self):
+        self._updater = None
+        self._optimizer = None
+        self._store: Dict[str, NDArray] = {}
+        self._compression = {"type": "none", "threshold": 0.5}
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    # -- data plane -------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def _normalize(self, key, value):
+        if isinstance(key, (list, tuple)):
+            return [_key_str(k) for k in key], list(value)
+        return [_key_str(key)], [value]
+
+    def _reduce(self, vals: List[NDArray]) -> NDArray:
+        """Aggregate device shards (ref: CommDevice::Reduce comm.h:503)."""
+        if len(vals) == 1:
+            return _wrap(vals[0]._data)
+        total = vals[0]._data
+        for v in vals[1:]:
+            total = total + v._data
+        return _wrap(total)
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        # group per key: value may be list-of-lists for multi-key push
+        if len(keys) == 1 and isinstance(value, (list, tuple)) and \
+                value and isinstance(value[0], NDArray):
+            grouped = {keys[0]: list(value)}
+        elif len(keys) > 1 and isinstance(value[0], (list, tuple)):
+            grouped = {k: list(v) for k, v in zip(keys, value)}
+        else:
+            grouped = {k: [v] for k, v in zip(keys, values)}
+        for k, vals in grouped.items():
+            agg = self._reduce(vals)
+            agg = self._global_reduce(k, agg)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} was not init'd")
+                self._updater(_updater_key(k), agg, self._store[k])
+            else:
+                if k in self._store:
+                    self._store[k] += agg
+                else:
+                    self._store[k] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        if len(keys) == 1 and isinstance(out, (list, tuple)) and \
+                out and isinstance(out[0], NDArray):
+            targets = {keys[0]: list(out)}
+        elif len(keys) > 1 and isinstance(out[0], (list, tuple)):
+            targets = {k: list(o) for k, o in zip(keys, out)}
+        else:
+            targets = {k: [o] for k, o in zip(keys, outs)}
+        for k, tgts in targets.items():
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not init'd")
+            src = self._store[k]
+            for t in tgts:
+                t._rebind(src._data.astype(t._data.dtype))
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only requested rows (ref: kvstore.py:248 row_sparse_pull —
+        dense rows are gathered; on TPU a gather is the natural layout)."""
+        keys, outs = self._normalize(key, out)
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        if not isinstance(row_ids, (list, tuple)):
+            row_ids = [row_ids] * len(outs)
+        for k, t, rid in zip(keys, outs, row_ids):
+            src = self._store[k]
+            idx = rid._data.astype(jnp.int32)
+            rows = jnp.take(src._data, idx, axis=0)
+            new = jnp.zeros_like(t._data).at[idx].set(rows)
+            t._rebind(new)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    broadcast = pull
+
+    # -- hooks ------------------------------------------------------------
+    def _global_reduce(self, key, val: NDArray) -> NDArray:
+        return val
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """ref: kvstore.py:450 — in the reference this pickles the optimizer
+        to server processes; here the 'server' is this process."""
+        from .optimizer import get_updater
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """ref: kvstore.py:394 / src/kvstore/gradient_compression.h — kept
+        as a stored policy; the 2-bit codec applies on the DCN path."""
+        self._compression.update(compression_params)
+
+    # -- persistence (ref: kvstore.py:538 save/load_optimizer_states) -----
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _updater_key(k: str):
+    try:
+        return int(k)
+    except ValueError:
+        return k
+
+
+class KVStoreLocal(KVStoreBase):
+    """'local'/'device' store (ref: src/kvstore/kvstore_local.h:184).
+    On TPU both are the same: aggregation happens on-device; the actual
+    multi-chip allreduce lives inside the pjit'd step (parallel/)."""
+
+    def __init__(self, type_name="local"):
+        super().__init__()
+        self._type = type_name
+
+
+class KVStoreDist(KVStoreBase):
+    """Multi-process store over jax.distributed collectives
+    (ref: src/kvstore/kvstore_dist.h:44 — ZPush/ZPull replaced by psum over
+    the global device mesh; sync semantics ≙ kSyncMode)."""
+
+    def __init__(self, type_name="dist_sync"):
+        super().__init__()
+        self._type = type_name
+        self._initialized = jax.process_count() > 1
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+    def _global_reduce(self, key, val: NDArray) -> NDArray:
+        if jax.process_count() <= 1:
+            return val
+        # allreduce across processes via a tiny pmap-psum program per key
+        # (DCN path; batched in parallel/allreduce for the hot loop)
+        from .parallel import allreduce_across_processes
+        return _wrap(allreduce_across_processes(val._data))
+
+    def barrier(self):
+        """ref: ps::Postoffice::Barrier (kvstore_dist.h:53)."""
+        if jax.process_count() > 1:
+            from .parallel import process_barrier
+            process_barrier()
+
+
+def create(name="local") -> KVStoreBase:
+    """ref: src/kvstore/kvstore.cc:40-77 factory."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl"):
+        return KVStoreLocal(name)
+    if name.startswith("dist"):
+        return KVStoreDist(name)
+    raise MXNetError(f"unknown KVStore type {name}")
+
+
+KVStore = KVStoreBase

@@ -1,0 +1,437 @@
+"""Evaluation metrics.
+
+ref: python/mxnet/metric.py (1,783 LoC) — EvalMetric registry: Accuracy,
+TopKAccuracy, F1, MCC, MAE/MSE/RMSE, CrossEntropy, Perplexity,
+PearsonCorrelation, Composite, CustomMetric, updated per batch by
+Module/estimators (ref: module/base_module.py:525-533).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as onp
+
+from .base import Registry, MXNetError
+
+_REG = Registry("metric")
+register = _REG.register
+
+
+def _as_numpy(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(f"Shape of labels {label_shape} does not match "
+                         f"shape of predictions {pred_shape}")
+    if wrap:
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+    return labels, preds
+
+
+class EvalMetric:
+    """ref: metric.py:68 EvalMetric."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.global_sum_metric / self.global_num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def _inc(self, metric, inst):
+        self.sum_metric += metric
+        self.num_inst += inst
+        self.global_sum_metric += metric
+        self.global_num_inst += inst
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+def create(metric, *args, **kwargs):
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    return _REG.get(metric.lower())(*args, **kwargs)
+
+
+@register("acc")
+@register("accuracy")
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).astype("int32")
+            pred = _as_numpy(pred)
+            if pred.ndim > label.ndim:
+                pred = onp.argmax(pred, axis=self.axis).astype("int32")
+            else:
+                pred = pred.astype("int32")
+            label, pred = label.flat, pred.flat
+            n_correct = int((onp.asarray(label) == onp.asarray(pred)).sum())
+            self._inc(n_correct, len(onp.asarray(label)))
+
+
+@register("top_k_accuracy")
+@register("topkaccuracy")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        self.name += f"_{top_k}"
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).astype("int32")
+            pred = _as_numpy(pred)
+            top = onp.argsort(pred, axis=-1)[:, ::-1][:, :self.top_k]
+            correct = (top == label.reshape(-1, 1)).any(axis=1).sum()
+            self._inc(int(correct), label.shape[0])
+
+
+class _BinaryStats:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.tp = self.fp = self.tn = self.fn = 0
+
+    def update(self, label, pred):
+        pred_label = onp.argmax(pred, axis=1)
+        label = label.astype("int32")
+        self.tp += int(((pred_label == 1) & (label == 1)).sum())
+        self.fp += int(((pred_label == 1) & (label == 0)).sum())
+        self.tn += int(((pred_label == 0) & (label == 0)).sum())
+        self.fn += int(((pred_label == 0) & (label == 1)).sum())
+
+    @property
+    def precision(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+    @property
+    def recall(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+    @property
+    def f1(self):
+        d = self.precision + self.recall
+        return 2 * self.precision * self.recall / d if d else 0.0
+
+    @property
+    def total(self):
+        return self.tp + self.fp + self.tn + self.fn
+
+    @property
+    def mcc(self):
+        d = math.sqrt((self.tp + self.fp) * (self.tp + self.fn)
+                      * (self.tn + self.fp) * (self.tn + self.fn))
+        return ((self.tp * self.tn - self.fp * self.fn) / d) if d else 0.0
+
+
+@register("f1")
+class F1(EvalMetric):
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names)
+        self.average = average
+        self.metrics = _BinaryStats()
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.metrics.update(_as_numpy(label), _as_numpy(pred))
+        self.sum_metric = self.metrics.f1 * self.metrics.total
+        self.global_sum_metric = self.sum_metric
+        self.num_inst = self.metrics.total
+        self.global_num_inst = self.num_inst
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "metrics"):
+            self.metrics.reset()
+
+
+@register("mcc")
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names)
+        self.metrics = _BinaryStats()
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.metrics.update(_as_numpy(label), _as_numpy(pred))
+        self.sum_metric = self.metrics.mcc * self.metrics.total
+        self.global_sum_metric = self.sum_metric
+        self.num_inst = self.metrics.total
+        self.global_num_inst = self.num_inst
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "metrics"):
+            self.metrics.reset()
+
+
+@register("mae")
+class MAE(EvalMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_numpy(label), _as_numpy(pred)
+            if label.shape != pred.shape:
+                label = label.reshape(pred.shape)
+            self._inc(float(onp.abs(label - pred).mean()), 1)
+
+
+@register("mse")
+class MSE(EvalMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_numpy(label), _as_numpy(pred)
+            if label.shape != pred.shape:
+                label = label.reshape(pred.shape)
+            self._inc(float(((label - pred) ** 2).mean()), 1)
+
+
+@register("rmse")
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_numpy(label), _as_numpy(pred)
+            if label.shape != pred.shape:
+                label = label.reshape(pred.shape)
+            self._inc(float(onp.sqrt(((label - pred) ** 2).mean())), 1)
+
+
+@register("ce")
+@register("crossentropy")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel().astype("int64")
+            pred = _as_numpy(pred)
+            prob = pred[onp.arange(label.shape[0]), label]
+            ce = (-onp.log(prob + self.eps)).sum()
+            self._inc(float(ce), label.shape[0])
+
+
+@register("nll_loss")
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(eps, name, output_names, label_names)
+
+
+@register("perplexity")
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel().astype("int64")
+            pred = _as_numpy(pred).reshape(-1, _as_numpy(pred).shape[-1])
+            probs = pred[onp.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                probs = onp.where(ignore, 1.0, probs)
+                num -= int(ignore.sum())
+            loss += -onp.log(onp.maximum(1e-10, probs)).sum()
+            num += label.shape[0]
+        self._inc(float(loss), num)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register("pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_numpy(label).ravel(), _as_numpy(pred).ravel()
+            self._inc(float(onp.corrcoef(label, pred)[0, 1]), 1)
+
+
+@register("loss")
+class Loss(EvalMetric):
+    """Dummy metric for directly printing loss values."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        if not isinstance(preds, (list, tuple)):
+            preds = [preds]
+        for pred in preds:
+            loss = float(_as_numpy(pred).sum())
+            self._inc(loss, int(onp.prod(_as_numpy(pred).shape)))
+
+
+class CompositeEvalMetric(EvalMetric):
+    """ref: metric.py:278."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
+        super().reset()
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+
+_REG.register("composite")(CompositeEvalMetric)
+
+
+class CustomMetric(EvalMetric):
+    """ref: metric.py CustomMetric — wrap a feval(label, pred) function."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = getattr(feval, "__name__", "custom")
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, output_names, label_names)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            reval = self._feval(_as_numpy(label), _as_numpy(pred))
+            if isinstance(reval, tuple):
+                m, n = reval
+                self._inc(m, n)
+            else:
+                self._inc(reval, 1)
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = getattr(numpy_feval, "__name__", "custom")
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -1,0 +1,194 @@
+"""DataParallelExecutorGroup: per-device executors + batch slicing.
+
+ref: python/mxnet/module/executor_group.py:144 (decide_slices :282,
+forward/backward fan-out, grad aggregation). On a TPU mesh the preferred
+path is one pjit-compiled executor over all chips (parallel/), but this
+class keeps the reference's explicit multi-context semantics for API
+parity and for CPU multi-device tests.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context
+from ..io.io import DataDesc
+from ..ndarray.ndarray import NDArray, concatenate, zeros as nd_zeros
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """ref: executor_manager.py _split_input_slice."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        end = batch_size if i == len(work_load_list) - 1 else \
+            start + int(round(batch_size * w / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts: List[Context], workload,
+                 data_shapes, label_shapes, param_names, for_training,
+                 inputs_need_grad, shared_group=None, logger=None,
+                 fixed_param_names=None, grad_req="write", state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = state_names or []
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.data_shapes = [DataDesc(*d) if not isinstance(d, DataDesc) else d
+                            for d in data_shapes]
+        self.label_shapes = [DataDesc(*l) if not isinstance(l, DataDesc)
+                             else l for l in (label_shapes or [])]
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [l.name for l in self.label_shapes]
+
+        self.batch_size = self.data_shapes[0].shape[0]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+
+        self.execs = []
+        self._default_execs = None
+        self._bind_exec(shared_group)
+
+    def _grad_req_for(self, name):
+        if not self.for_training:
+            return "null"
+        if name in self.fixed_param_names:
+            return "null"
+        if name in self.data_names:
+            return "write" if self.inputs_need_grad else "null"
+        if name in self.label_names or name in self.state_names:
+            return "null"
+        return "write"
+
+    def _bind_exec(self, shared_group):
+        self.execs = []
+        for i, ctx in enumerate(self.contexts):
+            sl = self.slices[i]
+            nbatch = sl.stop - sl.start
+            shapes = {}
+            for d in self.data_shapes:
+                shapes[d.name] = (nbatch,) + tuple(d.shape[1:])
+            for l in self.label_shapes:
+                shapes[l.name] = (nbatch,) + tuple(l.shape[1:])
+            grad_req = {n: self._grad_req_for(n) for n in self.arg_names}
+            self.execs.append(self.symbol.simple_bind(
+                ctx, grad_req=grad_req, **shapes))
+
+    # ------------------------------------------------------------------
+    @property
+    def param_arrays(self):
+        return [[e.arg_dict[n] for e in self.execs]
+                for n in self.param_names]
+
+    @property
+    def grad_arrays(self):
+        return [[e.grad_dict.get(n) for e in self.execs]
+                for n in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[e.aux_dict[n] for e in self.execs] for n in self.aux_names]
+
+    @property
+    def data_arrays(self):
+        return [[(sl, e.arg_dict[name]) for sl, e in
+                 zip(self.slices, self.execs)] for name in self.data_names]
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params,
+                               allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        for name in self.param_names:
+            arrs = [e.arg_dict[name] for e in self.execs]
+            avg = arrs[0]
+            if len(arrs) > 1:
+                total = arrs[0]._data
+                for a in arrs[1:]:
+                    total = total + a._data.astype(total.dtype)
+                from ..ndarray.ndarray import _wrap
+                avg = _wrap(total / len(arrs))
+            arg_params[name]._rebind(avg._data.astype(
+                arg_params[name]._data.dtype))
+        for name in self.aux_names:
+            arrs = [e.aux_dict[name] for e in self.execs]
+            from ..ndarray.ndarray import _wrap
+            total = arrs[0]._data
+            for a in arrs[1:]:
+                total = total + a._data
+            aux_params[name]._rebind(total / len(arrs))
+
+    # ------------------------------------------------------------------
+    def _load_slice(self, batch_data, names):
+        for name, full in zip(names, batch_data):
+            for sl, e in zip(self.slices, self.execs):
+                if name in e.arg_dict:
+                    e.arg_dict[name]._rebind(full[sl.start:sl.stop]._data
+                                             .astype(e.arg_dict[name]._data.dtype))
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        self._load_slice(data_batch.data, self.data_names)
+        if self.label_names and data_batch.label:
+            self._load_slice(data_batch.label, self.label_names)
+        for e in self.execs:
+            e.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True for backward")
+        for i, e in enumerate(self.execs):
+            if out_grads is None:
+                e.backward()
+            else:
+                sl = self.slices[i]
+                e.backward([g[sl.start:sl.stop] for g in out_grads])
+
+    def get_outputs(self, merge_multi_context=True):
+        outs = [[e.outputs[i] for e in self.execs]
+                for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return [o[0] if len(o) == 1 else concatenate(o, axis=0)
+                    for o in outs]
+        return outs
+
+    def get_input_grads(self, merge_multi_context=True):
+        grads = [[e.grad_dict.get(n) for e in self.execs]
+                 for n in self.data_names]
+        if merge_multi_context:
+            return [g[0] if len(g) == 1 else concatenate(g, axis=0)
+                    for g in grads]
+        return grads
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for i, e in enumerate(self.execs):
+            sl = self.slices[i]
+            labels_slice = [l[sl.start:sl.stop] for l in labels] \
+                if not pre_sliced else labels[i]
+            # only visible outputs feed metrics
+            eval_metric.update_dict(
+                dict(zip(self.label_names, labels_slice)),
+                dict(zip(self.symbol.list_outputs(), e.outputs)))
+
+    def install_monitor(self, mon):
+        for e in self.execs:
+            mon.install(e)
+
+    def bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        raise NotImplementedError

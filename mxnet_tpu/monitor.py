@@ -1,0 +1,67 @@
+"""Monitor: per-tensor stats during training.
+
+ref: python/mxnet/monitor.py + the executor monitor callback
+(src/executor/graph_executor.cc:185,1343-1372). The TPU executor calls
+`tic/toc_print` around forward/backward; stats are computed eagerly on
+outputs the executor exposes.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return x.abs().mean().asscalar()
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def stat_helper(self, name, value):
+        if not self.activated or not self.re_prog.match(str(name)):
+            return
+        self.queue.append((self.step, str(name), self.stat_func(value)))
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                exe._monitor_all = True
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            exe.collect_monitor_stats(self.stat_helper)
+            exe._monitor_all = False
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

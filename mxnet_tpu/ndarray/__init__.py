@@ -1,0 +1,35 @@
+"""nd namespace: NDArray + the generated op surface.
+
+Mirrors python/mxnet/ndarray/__init__.py: ops are "generated at import"
+from the registry (ref: python/mxnet/ndarray/register.py:116) — here the
+codegen is make_nd_function over the op registry.
+"""
+import sys as _sys
+
+from .ndarray import (  # noqa: F401
+    NDArray, array, zeros, ones, full, empty, arange, eye, linspace,
+    concat, concatenate, stack, split, dot, save, load, waitall,
+    from_numpy, moveaxis, invoke, _wrap,
+)
+from .. import ops as _ops
+from ..ops.registry import list_ops as _list_ops, make_nd_function as _make
+
+_mod = _sys.modules[__name__]
+for _name in _list_ops():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make(_name))
+
+# sparse + random sub-namespaces
+from . import sparse  # noqa: E402,F401
+from .. import random as _random_mod
+
+random = _random_mod
+
+
+def zeros_like(data, **kw):
+    return invoke(lambda x: __import__("jax.numpy", fromlist=["zeros_like"]).zeros_like(x), [data])
+
+
+def ones_like(data, **kw):
+    import jax.numpy as jnp
+    return invoke(lambda x: jnp.ones_like(x), [data])

@@ -1,0 +1,751 @@
+"""NDArray: the framework's array type, backed by jax.Array.
+
+TPU-native re-design of the reference NDArray (ref: include/mxnet/ndarray.h:82,
+src/ndarray/ndarray.cc). The reference pairs a ref-counted Chunk with an engine
+variable for async dependency tracking; here the backing store is an immutable
+jax.Array and the async-lazy semantics (`WaitToRead/WaitToWrite`,
+ndarray.h:368-376) come for free from PJRT's async dispatch —
+`wait_to_read()` maps to `block_until_ready()`. "Mutation" rebinds the
+underlying buffer (functional update via `.at[]`), which is exactly the
+engine-var versioning story without threads.
+
+Cross-device copies (ref: CopyFromTo, src/ndarray/ndarray.cc:1205-1277)
+map to jax.device_put.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+
+__all__ = [
+    "NDArray", "array", "zeros", "ones", "full", "empty", "arange", "eye",
+    "linspace", "concat", "concatenate", "stack", "split", "dot", "save",
+    "load", "waitall", "from_numpy", "moveaxis",
+]
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32, "float64": jnp.float64, "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16, "uint8": jnp.uint8, "int8": jnp.int8,
+    "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
+    "uint32": jnp.uint32, "uint64": jnp.uint64, "int16": jnp.int16,
+}
+
+
+def _canon_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _DTYPE_ALIASES[dtype]
+    return dtype
+
+
+def _ctx_of(arr: jax.Array) -> Context:
+    try:
+        dev = list(arr.devices())[0]
+    except Exception:
+        return cpu()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("gpu", dev.id)
+
+
+def _wrap(data, ctx: Optional[Context] = None) -> "NDArray":
+    out = NDArray.__new__(NDArray)
+    out._data = data
+    out._grad = None
+    out._grad_req = "null"
+    out._pending_grad = None
+    out._writeback = None
+    return out
+
+
+def _place(data, ctx: Optional[Context]):
+    if ctx is None:
+        return data
+    dev = ctx.jax_device()
+    if dev is None:
+        return data
+    return jax.device_put(data, dev)
+
+
+def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
+           differentiable: bool = True, **params):
+    """Execute a pure jax op over NDArrays with autograd recording.
+
+    This is the whole imperative dispatch path of the reference
+    (ref: Imperative::Invoke → InvokeOp → PushFCompute,
+    src/imperative/imperative.cc:89,40 and imperative_utils.h:394):
+    shape/dtype inference, engine push, and async dispatch are all PJRT's
+    job; recording mirrors Imperative::RecordOp (imperative.cc:193).
+    """
+    if params:
+        import functools
+        call = functools.partial(fn, **params)
+    else:
+        call = fn
+    in_arrays = [i._data for i in inputs]
+    out = call(*in_arrays)
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    if autograd.is_recording():
+        # identity-like ops may return the input buffer itself; give such
+        # outputs a fresh identity so tape grad-keying (by id) stays sound
+        in_ids = {id(a) for a in in_arrays}
+        outs = [jnp.copy(o) if id(o) in in_ids else o for o in outs]
+        tape = autograd.current_tape()
+        tape.record(call, in_arrays, outs, list(inputs),
+                    differentiable=differentiable)
+    wrapped = [_wrap(o) for o in outs]
+    if isinstance(out, (tuple, list)):
+        return wrapped
+    return wrapped[0] if n_out == 1 else wrapped
+
+
+def _coerce_operand(other, ref: "NDArray"):
+    if isinstance(other, NDArray):
+        return other
+    arr = jnp.asarray(other, dtype=ref.dtype if not isinstance(other, bool) else None)
+    return _wrap(arr)
+
+
+class NDArray:
+    """Multi-dimensional array (ref: python/mxnet/ndarray/ndarray.py NDArray)."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_pending_grad", "_writeback")
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        arr = jnp.asarray(data, dtype=_canon_dtype(dtype))
+        self._data = _place(arr, ctx)
+        self._grad = None
+        self._grad_req = "null"
+        self._pending_grad = None
+        self._writeback = None  # (base NDArray, index) for sliced views
+
+    # ------------------------------------------------------------------
+    # properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def ctx(self) -> Context:
+        return _ctx_of(self._data)
+
+    context = ctx
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    @property
+    def handle(self):
+        return self._data  # ABI parity shim: the "handle" is the jax buffer
+
+    # ------------------------------------------------------------------
+    # sync / conversion (ref: ndarray.h:368-376 WaitToRead/WaitToWrite)
+    # ------------------------------------------------------------------
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> onp.ndarray:
+        return onp.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kwargs):
+        return self._data.__dlpack__(**kwargs)
+
+    def astype(self, dtype, copy=True) -> "NDArray":
+        dt = _canon_dtype(dtype)
+        if not copy and onp.dtype(dt) == self.dtype:
+            return self
+        return invoke(lambda x: x.astype(dt), [self])
+
+    def copy(self) -> "NDArray":
+        return invoke(lambda x: x + 0 if False else jnp.copy(x), [self])
+
+    def copyto(self, other) -> "NDArray":
+        """ref: CopyFromTo (src/ndarray/ndarray.cc:1205)."""
+        if isinstance(other, Context):
+            return _wrap(_place(self._data, other))
+        if isinstance(other, NDArray):
+            other._rebind(_place(self._data.astype(other._data.dtype),
+                                 other.ctx))
+            return other
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.ctx:
+            return self
+        return _wrap(_place(self._data, ctx))
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype: str):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp.cast_storage(self, stype)
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """ref: python/mxnet/ndarray/ndarray.py attach_grad → MarkVariables."""
+        self._grad = _wrap(jnp.zeros(self.shape, self._data.dtype))
+        self._grad_req = grad_req
+
+    def detach(self) -> "NDArray":
+        # fresh identity so the tape does not route grads through this value
+        return _wrap(jnp.copy(self._data)) if autograd.is_recording() \
+            else _wrap(self._data)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # mutation plumbing
+    # ------------------------------------------------------------------
+    def _rebind(self, new_data):
+        """Swap the backing buffer; write through to the base if this array
+        came from basic slicing (view semantics parity with the reference)."""
+        self._data = new_data
+        if self._writeback is not None:
+            base, idx = self._writeback
+            base._rebind(base._data.at[idx].set(new_data))
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _clean_index(key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    @staticmethod
+    def _is_basic(key) -> bool:
+        def basic(k):
+            return isinstance(k, (int, slice, type(None), type(Ellipsis)))
+        if isinstance(key, tuple):
+            return all(basic(k) for k in key)
+        return basic(key)
+
+    def __getitem__(self, key):
+        ckey = self._clean_index(key)
+        out = invoke(lambda x: x[ckey], [self])
+        if self._is_basic(key):
+            out._writeback = (self, ckey)
+        return out
+
+    def __setitem__(self, key, value):
+        ckey = self._clean_index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        new = self._data.at[ckey].set(value)
+        self._rebind(new)
+
+    # ------------------------------------------------------------------
+    # arithmetic — funnels through invoke() so autograd sees everything
+    # ------------------------------------------------------------------
+    def _binary(self, other, fn):
+        other = _coerce_operand(other, self)
+        return invoke(fn, [self, other])
+
+    def _rbinary(self, other, fn):
+        other = _coerce_operand(other, self)
+        return invoke(fn, [other, self])
+
+    def __add__(self, o): return self._binary(o, jnp.add)
+    def __radd__(self, o): return self._rbinary(o, jnp.add)
+    def __sub__(self, o): return self._binary(o, jnp.subtract)
+    def __rsub__(self, o): return self._rbinary(o, jnp.subtract)
+    def __mul__(self, o): return self._binary(o, jnp.multiply)
+    def __rmul__(self, o): return self._rbinary(o, jnp.multiply)
+    def __truediv__(self, o): return self._binary(o, jnp.divide)
+    def __rtruediv__(self, o): return self._rbinary(o, jnp.divide)
+    def __floordiv__(self, o): return self._binary(o, jnp.floor_divide)
+    def __rfloordiv__(self, o): return self._rbinary(o, jnp.floor_divide)
+    def __mod__(self, o): return self._binary(o, jnp.mod)
+    def __rmod__(self, o): return self._rbinary(o, jnp.mod)
+    def __pow__(self, o): return self._binary(o, jnp.power)
+    def __rpow__(self, o): return self._rbinary(o, jnp.power)
+    def __matmul__(self, o): return self._binary(o, jnp.matmul)
+    def __neg__(self): return invoke(jnp.negative, [self])
+    def __abs__(self): return invoke(jnp.abs, [self])
+
+    def __iadd__(self, o):
+        o = _coerce_operand(o, self)
+        out = invoke(jnp.add, [self, o])
+        self._rebind(out._data)
+        return self
+
+    def __isub__(self, o):
+        o = _coerce_operand(o, self)
+        out = invoke(jnp.subtract, [self, o])
+        self._rebind(out._data)
+        return self
+
+    def __imul__(self, o):
+        o = _coerce_operand(o, self)
+        out = invoke(jnp.multiply, [self, o])
+        self._rebind(out._data)
+        return self
+
+    def __itruediv__(self, o):
+        o = _coerce_operand(o, self)
+        out = invoke(jnp.divide, [self, o])
+        self._rebind(out._data)
+        return self
+
+    # comparisons return NDArray of same float dtype (reference semantics)
+    def _cmp(self, other, fn):
+        other = _coerce_operand(other, self)
+        ref_dtype = self._data.dtype
+        return invoke(lambda a, b: fn(a, b).astype(ref_dtype), [self, other],
+                      differentiable=False)
+
+    def __eq__(self, o): return self._cmp(o, jnp.equal)
+    def __ne__(self, o): return self._cmp(o, jnp.not_equal)
+    def __lt__(self, o): return self._cmp(o, jnp.less)
+    def __le__(self, o): return self._cmp(o, jnp.less_equal)
+    def __gt__(self, o): return self._cmp(o, jnp.greater)
+    def __ge__(self, o): return self._cmp(o, jnp.greater_equal)
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements "
+                         "is ambiguous.")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self.shape))} @{self.ctx}>"
+
+    # ------------------------------------------------------------------
+    # shape ops (each maps to an op-registry function; methods mirror
+    # python/mxnet/ndarray/ndarray.py's method surface)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        # MXNet special codes: -1 infer, 0 copy-from-input, -2/-3/-4 advanced
+        shape = _expand_reshape_spec(self.shape, shape)
+        return invoke(lambda x: jnp.reshape(x, shape), [self])
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        ax = axes if axes else None
+        return invoke(lambda x: jnp.transpose(x, ax), [self])
+
+    def swapaxes(self, a1, a2):
+        return invoke(lambda x: jnp.swapaxes(x, a1, a2), [self])
+
+    def flatten(self):
+        n = self.shape[0] if self.ndim > 0 else 1
+        return invoke(lambda x: jnp.reshape(x, (n, -1)), [self])
+
+    def expand_dims(self, axis):
+        return invoke(lambda x: jnp.expand_dims(x, axis), [self])
+
+    def squeeze(self, axis=None):
+        return invoke(lambda x: jnp.squeeze(x, axis), [self])
+
+    def broadcast_to(self, shape):
+        shape = tuple(shape)
+        cur = self.shape
+        # MXNet allows 0 meaning keep current dim
+        shape = tuple(c if s == 0 else s for s, c in zip(shape, cur)) \
+            if len(shape) == len(cur) else shape
+        return invoke(lambda x: jnp.broadcast_to(x, shape), [self])
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def tile(self, reps):
+        return invoke(lambda x: jnp.tile(x, reps), [self])
+
+    def repeat(self, repeats, axis=None):
+        return invoke(lambda x: jnp.repeat(x, repeats, axis=axis), [self])
+
+    def pad(self, mode="constant", pad_width=None, constant_value=0):
+        from ..ops import nn as _nn
+        return invoke(_nn.pad_op, [self], mode=mode, pad_width=tuple(pad_width),
+                      constant_value=constant_value)
+
+    def slice(self, begin, end, step=None):
+        idx = tuple(slice(b, e, s) for b, e, s in
+                    zip(begin, end, step or [None] * len(begin)))
+        return self[idx]
+
+    def slice_axis(self, axis, begin, end):
+        idx = [slice(None)] * self.ndim
+        idx[axis] = slice(begin, end)
+        return self[tuple(idx)]
+
+    def take(self, indices, axis=0, mode="clip"):
+        ind = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
+        return invoke(lambda x: jnp.take(x, ind.astype(jnp.int32), axis=axis,
+                                         mode=mode), [self])
+
+    def pick(self, index, axis=-1, keepdims=False):
+        from ..ops import tensor as _t
+        return invoke(_t.pick, [self, index], axis=axis, keepdims=keepdims)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        dt = _canon_dtype(dtype)
+        return invoke(lambda x: jax.nn.one_hot(x.astype(jnp.int32), depth,
+                                               dtype=dt) * (on_value - off_value)
+                      + off_value, [self], differentiable=False)
+
+    # reductions
+    def _reduce(self, fn, axis=None, keepdims=False, **kw):
+        ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+        return invoke(lambda x: fn(x, axis=ax, keepdims=keepdims, **kw), [self])
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.sum, axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.mean, axis, keepdims)
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.max, axis, keepdims)
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.min, axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.prod, axis, keepdims)
+
+    def std(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.std, axis, keepdims)
+
+    def var(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.var, axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.linalg.norm(
+            x if axis is not None else x.ravel(), ord=ord, axis=axis,
+            keepdims=keepdims), [self])
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims)
+                      .astype(jnp.float32), [self], differentiable=False)
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims)
+                      .astype(jnp.float32), [self], differentiable=False)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        def f(x):
+            r = jnp.argsort(x, axis=axis)
+            if not is_ascend:
+                r = jnp.flip(r, axis=axis)
+            return r.astype(jnp.float32)
+        return invoke(f, [self], differentiable=False)
+
+    def sort(self, axis=-1, is_ascend=True):
+        def f(x):
+            r = jnp.sort(x, axis=axis)
+            if not is_ascend:
+                r = jnp.flip(r, axis=axis)
+            return r
+        return invoke(f, [self])
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+        from ..ops import tensor as _t
+        return invoke(_t.topk, [self], axis=axis, k=k, ret_typ=ret_typ,
+                      is_ascend=is_ascend, dtype=dtype,
+                      differentiable=(ret_typ == "value"))
+
+    def clip(self, a_min, a_max):
+        return invoke(lambda x: jnp.clip(x, a_min, a_max), [self])
+
+    # elementwise math
+    def abs(self): return invoke(jnp.abs, [self])
+    def sign(self): return invoke(jnp.sign, [self])
+    def sqrt(self): return invoke(jnp.sqrt, [self])
+    def square(self): return invoke(jnp.square, [self])
+    def exp(self): return invoke(jnp.exp, [self])
+    def log(self): return invoke(jnp.log, [self])
+    def relu(self): return invoke(jax.nn.relu, [self])
+    def sigmoid(self): return invoke(jax.nn.sigmoid, [self])
+    def tanh(self): return invoke(jnp.tanh, [self])
+    def softmax(self, axis=-1):
+        return invoke(lambda x: jax.nn.softmax(x, axis=axis), [self])
+    def log_softmax(self, axis=-1):
+        return invoke(lambda x: jax.nn.log_softmax(x, axis=axis), [self])
+    def round(self): return invoke(jnp.round, [self], differentiable=False)
+    def floor(self): return invoke(jnp.floor, [self], differentiable=False)
+    def ceil(self): return invoke(jnp.ceil, [self], differentiable=False)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        from ..ops import tensor as _t
+        return invoke(_t.dot, [self, other], transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+
+    def batch_dot(self, other, transpose_a=False, transpose_b=False):
+        from ..ops import tensor as _t
+        return invoke(_t.batch_dot, [self, other], transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+
+    def zeros_like(self):
+        return invoke(jnp.zeros_like, [self], differentiable=False)
+
+    def ones_like(self):
+        return invoke(jnp.ones_like, [self], differentiable=False)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        from ..ops import tensor as _t
+        return invoke(_t.slice_channel, [self], num_outputs=num_outputs,
+                      axis=axis, squeeze_axis=squeeze_axis, n_out=num_outputs)
+
+    def tojson(self):
+        raise MXNetError("NDArray has no tojson; use Symbol")
+
+
+def _expand_reshape_spec(cur: Tuple[int, ...], spec: Tuple[int, ...]):
+    """MXNet reshape special codes (ref: matrix_op-inl.h ReshapeParam docs):
+    0 = copy input dim, -1 = infer, -2 = copy all remaining, -3 = merge two,
+    -4 = split (followed by two dims)."""
+    if not any(s in (0, -2, -3, -4) for s in spec):
+        return spec
+    out: List[int] = []
+    i = 0  # position in cur
+    j = 0
+    spec = list(spec)
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(cur[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(cur[i:]); i = len(cur)
+        elif s == -3:
+            out.append(cur[i] * cur[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1:
+                d1 = cur[i] // d2
+            if d2 == -1:
+                d2 = cur[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# creation functions (ref: python/mxnet/ndarray/utils.py + init ops in
+# src/operator/tensor/init_op.cc)
+# ---------------------------------------------------------------------------
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source, NDArray):
+        source = source._data
+    if dtype is None and not hasattr(source, "dtype"):
+        # python lists default to float32 (reference behavior:
+        # python/mxnet/ndarray/utils.py array)
+        dtype = "float32"
+        source = onp.asarray(source, dtype=onp.float32)
+    return NDArray(source, ctx=ctx, dtype=dtype)
+
+
+def from_numpy(a, zero_copy=False) -> NDArray:
+    return NDArray(a)
+
+
+def empty(shape, ctx=None, dtype="float32") -> NDArray:
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _wrap(_place(jnp.zeros(shape, _canon_dtype(dtype)), ctx))
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs) -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _wrap(_place(jnp.ones(shape, _canon_dtype(dtype)), ctx))
+
+
+def full(shape, val, ctx=None, dtype="float32") -> NDArray:
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _wrap(_place(jnp.full(shape, val, _canon_dtype(dtype)), ctx))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32") -> NDArray:
+    arr = jnp.arange(start, stop, step, _canon_dtype(dtype))
+    if repeat > 1:
+        arr = jnp.repeat(arr, repeat)
+    return _wrap(_place(arr, ctx))
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32") -> NDArray:
+    return _wrap(_place(jnp.eye(N, M if M > 0 else None, k, _canon_dtype(dtype)), ctx))
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32") -> NDArray:
+    return _wrap(_place(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                     dtype=_canon_dtype(dtype)), ctx))
+
+
+def concat(*arrays, dim=1, **kwargs):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    dim = kwargs.get("dim", dim)
+    return invoke(lambda *xs: jnp.concatenate(xs, axis=dim), list(arrays))
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke(lambda *xs: jnp.concatenate(xs, axis=axis), list(arrays))
+
+
+def stack(*arrays, axis=0):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return invoke(lambda *xs: jnp.stack(xs, axis=axis), list(arrays))
+
+
+def split(ary, indices_or_sections, axis=0):
+    n = indices_or_sections
+    outs = invoke(lambda x: tuple(jnp.split(x, n, axis=axis)), [ary])
+    return outs
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    from ..ops import tensor as _t
+    return invoke(_t.dot, [lhs, rhs], transpose_a=transpose_a,
+                  transpose_b=transpose_b)
+
+
+def moveaxis(tensor, source, destination):
+    return invoke(lambda x: jnp.moveaxis(x, source, destination), [tensor])
+
+
+def waitall():
+    """ref: MXNDArrayWaitAll / Engine::WaitForAll (include/mxnet/engine.h:234)."""
+    (jax.effects_barrier if hasattr(jax, "effects_barrier") else lambda: None)()
+
+
+# ---------------------------------------------------------------------------
+# serialization — reference binary format kept for checkpoint compatibility
+# (ref: src/ndarray/ndarray.cc Save/Load, magic 0x112; python/mxnet/ndarray/
+# utils.py save/load). We write a simplified but self-describing container:
+# magic, count, per-array (name, dtype, shape, raw bytes little-endian).
+# ---------------------------------------------------------------------------
+
+_NDAR_MAGIC = 0x112
+
+
+def save(fname: str, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = [""] * len(data)
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _NDAR_MAGIC, len(arrays)))
+        for name, arr in zip(names, arrays):
+            nb = name.encode()
+            a = arr.asnumpy()
+            dt = str(a.dtype).encode()
+            f.write(struct.pack("<I", len(nb))); f.write(nb)
+            f.write(struct.pack("<I", len(dt))); f.write(dt)
+            f.write(struct.pack("<I", a.ndim))
+            f.write(struct.pack(f"<{a.ndim}q", *a.shape))
+            raw = onp.ascontiguousarray(a).tobytes()
+            f.write(struct.pack("<Q", len(raw))); f.write(raw)
+
+
+def load(fname: str):
+    with open(fname, "rb") as f:
+        magic, n = struct.unpack("<QQ", f.read(16))
+        if magic != _NDAR_MAGIC:
+            raise MXNetError(f"bad ndarray file magic {magic:#x}")
+        names, arrays = [], []
+        for _ in range(n):
+            (ln,) = struct.unpack("<I", f.read(4)); name = f.read(ln).decode()
+            (ld,) = struct.unpack("<I", f.read(4)); dt = f.read(ld).decode()
+            (nd,) = struct.unpack("<I", f.read(4))
+            shape = struct.unpack(f"<{nd}q", f.read(8 * nd)) if nd else ()
+            (nb,) = struct.unpack("<Q", f.read(8))
+            a = onp.frombuffer(f.read(nb), dtype=dt).reshape(shape)
+            names.append(name); arrays.append(array(a, dtype=dt))
+    if any(names):
+        return dict(zip(names, arrays))
+    return arrays

@@ -1,0 +1,155 @@
+"""Sparse NDArray types: row_sparse and CSR.
+
+TPU-native take on the reference sparse storage types
+(ref: include/mxnet/ndarray.h:61-66 kRowSparseStorage/kCSRStorage;
+src/operator/tensor/cast_storage-inl.h). XLA has no ragged buffers, so
+these are *capability-compatible* containers: they hold (data, indices)
+with static-bounded sizes, support the reference API surface
+(`.data/.indices/.indptr`, `tostype`, `retain`), and convert to dense at
+op boundaries — the dense-segment strategy SURVEY.md §7 "hard parts (c)"
+calls for. Row-sparse gradients for embeddings are produced as dense
+segment-sums on TPU (the MXU-friendly layout) while keeping this API.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import NDArray, _wrap, array as _dense_array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("_aux",)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """ref: python/mxnet/ndarray/sparse.py RowSparseNDArray."""
+
+    __slots__ = ()
+
+    def __init__(self, data, indices, shape):
+        dense = jnp.zeros(shape, jnp.asarray(data).dtype)
+        idx = jnp.asarray(indices, jnp.int32)
+        dense = dense.at[idx].set(jnp.asarray(data))
+        super().__init__(dense)
+        self._aux = {"indices": idx, "values": jnp.asarray(data)}
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return _wrap(self._aux["indices"])
+
+    @property
+    def data(self) -> NDArray:
+        return _wrap(self._aux["values"])
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return _wrap(self._data)
+        raise MXNetError(f"cast_storage row_sparse->{stype} unsupported")
+
+    def retain(self, indices):
+        idx = indices._data.astype(jnp.int32) if isinstance(indices, NDArray) \
+            else jnp.asarray(indices, jnp.int32)
+        vals = jnp.take(self._data, idx, axis=0)
+        return RowSparseNDArray(vals, idx, self.shape)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """ref: python/mxnet/ndarray/sparse.py CSRNDArray."""
+
+    __slots__ = ()
+
+    def __init__(self, data, indices, indptr, shape):
+        data = jnp.asarray(data)
+        indices = jnp.asarray(indices, jnp.int32)
+        indptr = jnp.asarray(indptr, jnp.int32)
+        dense = onp.zeros(shape, dtype=onp.dtype(data.dtype))
+        d, ind, iptr = (onp.asarray(data), onp.asarray(indices),
+                        onp.asarray(indptr))
+        for r in range(shape[0]):
+            for j in range(iptr[r], iptr[r + 1]):
+                dense[r, ind[j]] = d[j]
+        super().__init__(dense)
+        self._aux = {"data": data, "indices": indices, "indptr": indptr}
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self) -> NDArray:
+        return _wrap(self._aux["data"])
+
+    @property
+    def indices(self) -> NDArray:
+        return _wrap(self._aux["indices"])
+
+    @property
+    def indptr(self) -> NDArray:
+        return _wrap(self._aux["indptr"])
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return _wrap(self._data)
+        raise MXNetError(f"cast_storage csr->{stype} unsupported")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(data, indices, shape)
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indices, indptr, shape)
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def cast_storage(arr: NDArray, stype: str):
+    """ref: src/operator/tensor/cast_storage.cc"""
+    if stype == "default":
+        return _wrap(arr._data)
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = onp.where(onp.any(a != 0, axis=tuple(range(1, a.ndim))))[0]
+        return RowSparseNDArray(a[nz_rows], nz_rows, a.shape)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        indptr = [0]
+        indices, data = [], []
+        for r in range(a.shape[0]):
+            cols = onp.where(a[r] != 0)[0]
+            indices.extend(cols.tolist())
+            data.extend(a[r, cols].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(onp.asarray(data, a.dtype), indices, indptr, a.shape)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        return RowSparseNDArray(onp.zeros((0,) + tuple(shape[1:]), dtype=dtype),
+                                onp.zeros((0,), dtype="int32"), shape)
+    if stype == "csr":
+        return CSRNDArray(onp.zeros((0,), dtype=dtype), [], [0] * (shape[0] + 1),
+                          shape)
+    from .ndarray import zeros as dzeros
+    return dzeros(shape, ctx, dtype)

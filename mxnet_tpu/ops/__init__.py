@@ -1,0 +1,3 @@
+"""Op corpus: importing this package populates the registry."""
+from . import tensor, nn, optimizer_ops, linalg  # noqa: F401
+from .registry import get_op, list_ops, make_nd_function, register_op  # noqa: F401

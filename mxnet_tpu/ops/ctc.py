@@ -1,0 +1,102 @@
+"""CTC loss.
+
+TPU-native replacement for the vendored warp-ctc
+(ref: 3rdparty/ctc_include + src/operator/nn/ctc_loss.cc). Implemented as a
+log-space alpha recursion over `lax.scan` — static shapes, MXU/VPU friendly,
+differentiable by jax.grad (no hand-written backward as in warp-ctc).
+Blank label is index 0 (the reference's convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+NEG_INF = -1e30
+
+
+def _interleave_blanks(labels):
+    """(B, L) -> (B, 2L+1) with blanks (0) interleaved."""
+    b, l = labels.shape
+    ext = jnp.zeros((b, 2 * l + 1), labels.dtype)
+    return ext.at[:, 1::2].set(labels)
+
+
+@register_op("CTCLoss", aliases=["ctc_loss", "_contrib_CTCLoss",
+                                 "_contrib_ctc_loss"])
+def ctc_loss(data, label, *lengths, use_data_lengths=False,
+             use_label_lengths=False, blank_label="first"):
+    """data: (T, B, C) activations (pre-softmax); label: (B, L) int labels
+    (0 = blank per reference convention when blank_label='first';
+    padding with -1 or 0 treated as absent when label lengths unused)."""
+    data_lengths = None
+    label_lengths = None
+    li = 0
+    if use_data_lengths and len(lengths) > li:
+        data_lengths = lengths[li].astype(jnp.int32)
+        li += 1
+    if use_label_lengths and len(lengths) > li:
+        label_lengths = lengths[li].astype(jnp.int32)
+
+    T, B, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+
+    labels = label.astype(jnp.int32)
+    if blank_label == "last":
+        blank = C - 1
+    else:
+        blank = 0
+    if label_lengths is None:
+        # reference: labels padded with 0 (or -1); count positive entries
+        label_lengths = jnp.sum((labels > 0).astype(jnp.int32), axis=1)
+    if data_lengths is None:
+        data_lengths = jnp.full((B,), T, jnp.int32)
+
+    L = labels.shape[1]
+    S = 2 * L + 1
+    if blank == 0:
+        ext = _interleave_blanks(labels)
+    else:
+        b_, l_ = labels.shape
+        ext = jnp.full((b_, S), blank, labels.dtype).at[:, 1::2].set(labels)
+
+    ext_valid = jnp.arange(S)[None, :] < (2 * label_lengths + 1)[:, None]
+
+    # can-skip mask: alpha[s] can come from s-2 if ext[s] != blank and
+    # ext[s] != ext[s-2]
+    ext_sm2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_sm2)
+
+    init = jnp.full((B, S), NEG_INF)
+    init = init.at[:, 0].set(logp[0, :, blank] if blank == 0 else
+                             logp[0][jnp.arange(B), blank])
+    first_lab = jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0]
+    init = init.at[:, 1].set(jnp.where(label_lengths > 0, first_lab, NEG_INF))
+
+    def step(alpha, t):
+        lp = logp[t]  # (B, C)
+        emit = jnp.take_along_axis(lp, ext, axis=1)  # (B, S)
+        a_prev = alpha
+        a_sm1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=NEG_INF)[:, :S]
+        a_sm2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=NEG_INF)[:, :S]
+        a_sm2 = jnp.where(can_skip, a_sm2, NEG_INF)
+        new = jnp.logaddexp(jnp.logaddexp(a_prev, a_sm1), a_sm2) + emit
+        new = jnp.where(ext_valid, new, NEG_INF)
+        # frozen past data_lengths: keep alpha unchanged
+        active = (t < data_lengths)[:, None]
+        new = jnp.where(active, new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, init, jnp.arange(1, T))
+
+    # final: logaddexp of positions 2*len-1 and 2*len
+    last1 = jnp.take_along_axis(alpha, (2 * label_lengths - 1)[:, None],
+                                axis=1)[:, 0]
+    last2 = jnp.take_along_axis(alpha, (2 * label_lengths)[:, None],
+                                axis=1)[:, 0]
+    ll = jnp.logaddexp(last1, last2)
+    empty = label_lengths == 0
+    # all-blank path for empty labels
+    ll = jnp.where(empty, alpha[:, 0], ll)
+    return -ll

@@ -1,0 +1,139 @@
+"""Linear-algebra ops (`_linalg_*`).
+
+TPU-native coverage of the reference linalg family
+(ref: src/operator/tensor/la_op.cc — gemm, potrf, trsm, syrk, syevd, ...;
+LAPACK bridged via src/c_api/../c_lapack_api.cc). On TPU these map to
+jax.numpy.linalg / jax.scipy.linalg, which XLA lowers to MXU-friendly
+blocked algorithms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .registry import register_op
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register_op("_linalg_gemm", aliases=["linalg_gemm"])
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+         axis=-2):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
+
+
+@register_op("_linalg_gemm2", aliases=["linalg_gemm2"])
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+@register_op("_linalg_potrf", aliases=["linalg_potrf"])
+def potrf(A, lower=True):
+    L = jnp.linalg.cholesky(A)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register_op("_linalg_potri", aliases=["linalg_potri"])
+def potri(A, lower=True):
+    # A is the cholesky factor; potri returns inverse of the original matrix
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    Linv = jsl.solve_triangular(A, eye, lower=lower)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv) if lower else \
+        jnp.matmul(Linv, jnp.swapaxes(Linv, -1, -2))
+
+
+@register_op("_linalg_trmm", aliases=["linalg_trmm"])
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    At = _t(A, transpose)
+    return alpha * (jnp.matmul(B, At) if rightside else jnp.matmul(At, B))
+
+
+@register_op("_linalg_trsm", aliases=["linalg_trsm"])
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if rightside:
+        # solve X A^T' = alpha B  →  A' X^T = alpha B^T
+        Xt = jsl.solve_triangular(_t(A, not transpose) if False else A,
+                                  jnp.swapaxes(B, -1, -2),
+                                  trans=0 if transpose else 1,
+                                  lower=lower)
+        return alpha * jnp.swapaxes(Xt, -1, -2)
+    return alpha * jsl.solve_triangular(A, B, trans=1 if transpose else 0,
+                                        lower=lower)
+
+
+@register_op("_linalg_syrk", aliases=["linalg_syrk"])
+def syrk(A, transpose=False, alpha=1.0):
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+@register_op("_linalg_syevd", aliases=["linalg_syevd"], n_out=2)
+def syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w  # MXNet returns (U rows=eigvecs, L)
+
+
+@register_op("_linalg_gelqf", aliases=["linalg_gelqf"], n_out=2)
+def gelqf(A):
+    # LQ of A: A = L Q  (Q rows orthonormal).  qr of A^T: A^T = Qt R
+    Qt, R = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(R, -1, -2), jnp.swapaxes(Qt, -1, -2)
+
+
+@register_op("_linalg_det", aliases=["linalg_det"])
+def det(A):
+    return jnp.linalg.det(A)
+
+
+@register_op("_linalg_slogdet", aliases=["linalg_slogdet"], n_out=2)
+def slogdet(A):
+    sign, ld = jnp.linalg.slogdet(A)
+    return sign, ld
+
+
+@register_op("_linalg_inverse", aliases=["linalg_inverse"])
+def inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register_op("_linalg_extractdiag", aliases=["linalg_extractdiag"])
+def extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register_op("_linalg_makediag", aliases=["linalg_makediag"])
+def makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    if offset >= 0:
+        return out.at[..., idx, idx + offset].set(A)
+    return out.at[..., idx - offset, idx].set(A)
+
+
+@register_op("_linalg_extracttrian", aliases=["linalg_extracttrian"])
+def extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register_op("_linalg_maketrian", aliases=["linalg_maketrian"])
+def maketrian(A, offset=0, lower=True):
+    m = A.shape[-1]
+    # solve n(n+1)/2 - like count for n given m and offset≈0
+    import math
+    n = int((math.isqrt(8 * m + 1) - 1) // 2) + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    return out.at[..., rows, cols].set(A)
+
+
+@register_op("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)

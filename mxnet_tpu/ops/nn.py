@@ -1,0 +1,795 @@
+"""Neural-net layer ops.
+
+TPU-native coverage of the reference `src/operator/nn/` + root nn ops
+(51.5k LoC — SURVEY.md §2.3): Convolution/Deconvolution
+(ref: src/operator/nn/convolution.cc — here lax.conv_general_dilated, which
+XLA tiles onto the MXU), Pooling (pooling.cc → lax.reduce_window),
+FullyConnected (fully_connected.cc:245-333), BatchNorm (batch_norm.cc, with
+aux moving stats returned functionally), LayerNorm/GroupNorm/InstanceNorm,
+softmax family (softmax.cc), SoftmaxOutput (softmax_output.cc — custom-vjp
+loss-layer semantics), Dropout (dropout-inl.h → threefry bernoulli),
+Embedding (indexing_op.cc), sequence ops, UpSampling, LRN, pad.
+
+All functions are pure; BatchNorm-style running-stat mutation is expressed
+as extra outputs written back by the caller (gluon layer / symbol executor).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register_op
+
+
+def _key(raw):
+    return jax.random.wrap_key_data(raw)
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: src/operator/nn/fully_connected.cc:245-333)
+# ---------------------------------------------------------------------------
+
+@register_op("FullyConnected", input_names=("data", "weight", "bias"))
+def fully_connected(data, weight, *bias, num_hidden=0, no_bias=False, flatten=True):
+    if flatten and data.ndim > 2:
+        data = jnp.reshape(data, (data.shape[0], -1))
+    out = jnp.matmul(data, weight.T)
+    if not no_bias and bias:
+        out = out + bias[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution (ref: src/operator/nn/convolution.cc; MXU path)
+# ---------------------------------------------------------------------------
+
+def _conv_dims(ndim):
+    if ndim == 3:
+        return ("NCW", "OIW", "NCW")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register_op("Convolution", aliases=["Convolution_v1"], input_names=("data", "weight", "bias"))
+def convolution(data, weight, *bias, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=0, num_group=1, workspace=1024,
+                no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
+    nd = data.ndim
+    k = len(kernel) if kernel else nd - 2
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dims(nd),
+        feature_group_count=num_group,
+    )
+    if not no_bias and bias:
+        out = out + bias[0].reshape((1, -1) + (1,) * k)
+    return out
+
+
+@register_op("Deconvolution", input_names=("data", "weight", "bias"))
+def deconvolution(data, weight, *bias, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, target_shape=None, num_filter=0,
+                  num_group=1, workspace=512, no_bias=True, cudnn_tune=None,
+                  cudnn_off=False, layout=None):
+    """ref: src/operator/nn/deconvolution.cc — conv transpose"""
+    nd = data.ndim
+    k = len(kernel) if kernel else nd - 2
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    adj = tuple(adj) if adj else (0,) * k
+    # conv_transpose of the forward conv: use lhs dilation
+    pads = [(d * (kk - 1) - p, d * (kk - 1) - p + a)
+            for kk, p, d, a in zip(kernel, pad, dilate, adj)]
+    # weight layout for deconv in MXNet: (in_channels, out_channels/g, *kernel)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + k)))
+    w = jnp.swapaxes(w, 0, 1) if num_group == 1 else _group_swap(w, num_group)
+    out = jax.lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * k,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dims(nd),
+        feature_group_count=num_group,
+    )
+    if not no_bias and bias:
+        out = out + bias[0].reshape((1, -1) + (1,) * k)
+    return out
+
+
+def _group_swap(w, g):
+    cin_g = w.shape[0] // g
+    cout_g = w.shape[1]
+    parts = jnp.reshape(w, (g, cin_g, cout_g) + w.shape[2:])
+    parts = jnp.swapaxes(parts, 1, 2)
+    return jnp.reshape(parts, (g * cout_g, cin_g) + w.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: src/operator/nn/pooling.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("Pooling", aliases=["Pooling_v1"])
+def pooling(data, kernel=(2, 2), pool_type="max", global_pool=False,
+            cudnn_off=False, pooling_convention="valid", stride=None,
+            pad=None, p_value=2, count_include_pad=True, layout=None):
+    nd = data.ndim
+    k = nd - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * k
+        pad = (0,) * k
+    else:
+        kernel = tuple(kernel)
+        stride = tuple(stride) if stride else (1,) * k
+        pad = tuple(pad) if pad else (0,) * k
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    if pooling_convention == "full":
+        # ceil-mode: pad right edge enough to cover
+        pads = [(0, 0), (0, 0)]
+        for i in range(k):
+            size = data.shape[2 + i] + 2 * pad[i]
+            out = -(-max(size - kernel[i], 0) // stride[i]) + 1
+            need = (out - 1) * stride[i] + kernel[i] - size
+            pads.append((pad[i], pad[i] + max(need, 0)))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides,
+                                     pads)
+    if pool_type in ("avg", "sum"):
+        s = jax.lax.reduce_window(data, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
+                                  jax.lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = float(onp.prod(kernel))
+            return s / jnp.asarray(denom, s.dtype)
+        ones = jnp.ones_like(data)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        pw = jax.lax.reduce_window(jnp.abs(data) ** p_value, 0.0, jax.lax.add,
+                                   window, strides, pads)
+        return pw ** (1.0 / p_value)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register_op("_contrib_AdaptiveAvgPooling2D")
+def adaptive_avg_pool2d(data, output_size=None):
+    """ref: src/operator/contrib/adaptive_avg_pooling.cc"""
+    if not output_size:
+        oh = ow = 1
+    else:
+        oh, ow = _pair(output_size)
+    n, c, h, w = data.shape
+    x = jnp.reshape(data, (n, c, oh, h // oh, ow, w // ow)) \
+        if h % oh == 0 and w % ow == 0 else None
+    if x is not None:
+        return jnp.mean(x, axis=(3, 5))
+    return jax.image.resize(data, (n, c, oh, ow), method="linear")
+
+
+@register_op("UpSampling")
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    """ref: src/operator/nn/upsampling.cc"""
+    data = args[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    else:
+        out = jax.image.resize(data, (n, c, h * scale, w * scale),
+                               method="bilinear")
+    return out
+
+
+@register_op("_contrib_BilinearResize2D")
+def bilinear_resize2d(data, height=1, width=1, scale_height=None,
+                      scale_width=None, mode="size"):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height = int(round(h * scale_height))
+        width = int(round(w * scale_width))
+    return jax.image.resize(data, (n, c, height, width), method="linear")
+
+
+# ---------------------------------------------------------------------------
+# Activations (ref: src/operator/nn/activation.cc, leaky_relu.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("Activation")
+def activation(data, act_type="relu"):
+    return {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+    }[act_type](data)
+
+
+@register_op("LeakyReLU", needs_rng=True)
+def leaky_relu(data, *extra, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334, _training=False):
+    """ref: src/operator/leaky_relu.cc — leaky/prelu/elu/selu/gelu/rrelu"""
+    raw_key = extra[-1] if extra else None
+    gamma = extra[0] if len(extra) > 1 or (extra and act_type == "prelu") else None
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * (jnp.exp(data) - 1))
+    if act_type == "selu":
+        alpha, lam = 1.6732632423543772, 1.0507009873554805
+        return lam * jnp.where(data > 0, data, alpha * (jnp.exp(data) - 1))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        if _training and raw_key is not None:
+            u = jax.random.uniform(_key(raw_key), data.shape, data.dtype,
+                                   lower_bound, upper_bound)
+            return jnp.where(data > 0, data, u * data)
+        s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# softmax family (ref: src/operator/nn/softmax.cc, log_softmax.cc, softmin.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("softmax")
+def softmax(data, *length, axis=-1, temperature=None, dtype=None,
+            use_length=False):
+    x = data / temperature if temperature else data
+    if use_length and length:
+        ln = length[0].astype(jnp.int32)
+        pos = jnp.arange(x.shape[axis])
+        shp = [1] * x.ndim
+        shp[axis] = -1
+        mask = pos.reshape(shp) < ln.reshape(ln.shape + (1,) * (x.ndim - ln.ndim))
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmin")
+def softmin(data, axis=-1, temperature=None, dtype=None):
+    x = -data / (temperature or 1.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization, smooth_alpha):
+    axis = 1 if multi_output else -1
+    if multi_output:
+        prob = jax.nn.softmax(data, axis=1)
+    else:
+        prob = jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1) \
+            .reshape(data.shape)
+    return prob
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                    multi_output, normalization, smooth_alpha):
+    return _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                               use_ignore, multi_output, normalization,
+                               smooth_alpha)
+
+
+def _so_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output,
+            normalization, smooth_alpha):
+    prob = _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                               use_ignore, multi_output, normalization,
+                               smooth_alpha)
+    return prob, (prob, label)
+
+
+def _so_bwd(grad_scale, ignore_label, use_ignore, multi_output, normalization,
+            smooth_alpha, res, g):
+    """Loss-layer gradient: prob - one_hot(label), scaled
+    (ref: src/operator/softmax_output-inl.h backward)."""
+    prob, label = res
+    if multi_output:
+        nclass = prob.shape[1]
+        lab = label.astype(jnp.int32)
+        oh = jnp.moveaxis(jax.nn.one_hot(lab, nclass, dtype=prob.dtype), -1, 1)
+        grad = prob - oh
+        if smooth_alpha:
+            grad = grad + smooth_alpha * (1.0 / nclass - oh)
+        if use_ignore:
+            mask = (lab != int(ignore_label)).astype(prob.dtype)
+            grad = grad * mask[:, None]
+        denom = 1.0
+        if normalization == "batch":
+            denom = prob.shape[0]
+        elif normalization == "valid" and use_ignore:
+            denom = jnp.maximum(jnp.sum(lab != int(ignore_label)), 1).astype(prob.dtype)
+        grad = grad * (grad_scale / denom)
+    else:
+        flat = prob.reshape(prob.shape[0], -1)
+        nclass = flat.shape[-1]
+        lab = label.reshape(-1).astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, nclass, dtype=prob.dtype)
+        grad = flat - oh
+        if smooth_alpha:
+            grad = grad + smooth_alpha * (1.0 / nclass - oh)
+        if use_ignore:
+            mask = (lab != int(ignore_label)).astype(prob.dtype)
+            grad = grad * mask[:, None]
+        denom = 1.0
+        if normalization == "batch":
+            denom = prob.shape[0]
+        elif normalization == "valid" and use_ignore:
+            denom = jnp.maximum(jnp.sum(lab != int(ignore_label)), 1).astype(prob.dtype)
+        grad = (grad * (grad_scale / denom)).reshape(prob.shape)
+    return grad, jnp.zeros_like(label)
+
+
+_softmax_output.defvjp(_so_fwd, _so_bwd)
+
+
+@register_op("SoftmaxOutput", aliases=["Softmax"], input_names=("data", "label"))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """ref: src/operator/softmax_output.cc — forward is softmax, backward is
+    cross-entropy gradient wrt logits (the classic fused loss layer)."""
+    return _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                           multi_output, normalization, smooth_alpha)
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    return -jnp.sum(jnp.take_along_axis(logp, lab[:, None], axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# regression outputs (ref: src/operator/regression_output.cc)
+# ---------------------------------------------------------------------------
+
+def _make_regression(link, grad_fn, name):
+    @partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def op(data, label, grad_scale):
+        return link(data)
+
+    def fwd(data, label, grad_scale):
+        return link(data), (link(data), label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        n = out.shape[0]
+        return (grad_fn(out, label) * (grad_scale / max(out.size // n, 1) * 1.0),
+                jnp.zeros_like(label))
+
+    op.defvjp(fwd, bwd)
+
+    @register_op(name)
+    def reg(data, label, grad_scale=1.0):
+        return op(data, label.reshape(data.shape), grad_scale)
+    return reg
+
+
+_make_regression(lambda x: x, lambda o, l: o - l, "LinearRegressionOutput")
+_make_regression(jax.nn.sigmoid, lambda o, l: o - l, "LogisticRegressionOutput")
+_make_regression(lambda x: x, lambda o, l: jnp.sign(o - l), "MAERegressionOutput")
+
+
+@register_op("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """ref: src/operator/svm_output.cc — forward is identity"""
+    return data
+
+
+# ---------------------------------------------------------------------------
+# normalization (ref: src/operator/nn/batch_norm.cc, layer_norm.cc,
+# group_norm.cc, instance_norm.cc, l2_normalization.cc, lrn.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("BatchNorm", aliases=["BatchNorm_v1", "_contrib_SyncBatchNorm"],
+             n_out=3, needs_train=True, visible_outputs=1,
+             input_names=("data", "gamma", "beta", "moving_mean", "moving_var"),
+             aux_updates={1: 3, 2: 4})
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               min_calib_range=None, max_calib_range=None, ndev=1, key=None,
+               _training=False):
+    """Returns (out, new_moving_mean, new_moving_var); caller writes the aux
+    stats back (ref: batch_norm.cc aux states). SyncBatchNorm alias: under
+    pjit the batch axis is global, so plain BN *is* sync-BN on TPU."""
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    shape = [1] * data.ndim
+    shape[axis] = -1
+    if _training and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        new_mean = moving_mean * momentum + mean * (1 - momentum)
+        new_var = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    out = (data - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+    out = out * g.reshape(shape) + beta.reshape(shape)
+    return out, jax.lax.stop_gradient(new_mean), jax.lax.stop_gradient(new_var)
+
+
+@register_op("LayerNorm", input_names=("data", "gamma", "beta"))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = -1
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("GroupNorm", input_names=("data", "gamma", "beta"))
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, output_mean_var=False):
+    n, c = data.shape[:2]
+    x = data.reshape((n, num_groups, c // num_groups) + data.shape[2:])
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("InstanceNorm", input_names=("data", "gamma", "beta"))
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / n
+
+
+@register_op("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    parts = [padded[:, i:i + data.shape[1]] for i in range(nsize)]
+    ssum = sum(parts)
+    return data / jnp.power(knorm + alpha * ssum / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (ref: src/operator/nn/dropout-inl.h)
+# ---------------------------------------------------------------------------
+
+@register_op("Dropout", needs_rng=True, needs_train=True)
+def dropout(data, raw_key, p=0.5, mode="training", axes=None,
+            cudnn_off=False, _training=False):
+    if (not _training and mode != "always") or p <= 0:
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_key(raw_key), keep, shape).astype(data.dtype)
+    return data * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# Embedding (ref: src/operator/tensor/indexing_op.cc Embedding)
+# ---------------------------------------------------------------------------
+
+@register_op("Embedding", aliases=["_contrib_SparseEmbedding"],
+             input_names=("data", "weight"))
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (ref: src/operator/sequence_{mask,last,reverse}.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("SequenceMask")
+def sequence_mask(data, *length, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or not length:
+        return data
+    ln = length[0].astype(jnp.int32)
+    steps = jnp.arange(data.shape[axis])
+    shp = [1] * data.ndim
+    shp[axis] = -1
+    batch_axis = 1 - axis if axis in (0, 1) else 0
+    lshp = [1] * data.ndim
+    lshp[batch_axis] = -1
+    mask = steps.reshape(shp) < ln.reshape(lshp)
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register_op("SequenceLast")
+def sequence_last(data, *length, use_sequence_length=False, axis=0):
+    if not use_sequence_length or not length:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    ln = length[0].astype(jnp.int32) - 1
+    return jnp.take_along_axis(
+        data, ln.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=axis
+    ).squeeze(axis)
+
+
+@register_op("SequenceReverse")
+def sequence_reverse(data, *length, use_sequence_length=False, axis=0):
+    if not use_sequence_length or not length:
+        return jnp.flip(data, axis=axis)
+    ln = length[0].astype(jnp.int32)
+    T = data.shape[axis]
+    pos = jnp.arange(T)[:, None]
+    rev = jnp.where(pos < ln[None, :], ln[None, :] - 1 - pos, pos)  # (T, B)
+    shp = (T,) + (rev.shape[1],) + (1,) * (data.ndim - 2)
+    return jnp.take_along_axis(data, rev.reshape(shp), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# pad / crop (ref: src/operator/pad.cc, crop.cc)
+# ---------------------------------------------------------------------------
+
+def pad_op(data, mode="constant", pad_width=None, constant_value=0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(data.ndim)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register_op("Crop")
+def crop(*args, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=1):
+    data = args[0]
+    if len(args) > 1:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = h_w
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (h - th) // 2, (w - tw) // 2
+    else:
+        y0, x0 = offset
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+# ---------------------------------------------------------------------------
+# spatial transforms (ref: src/operator/grid_generator.cc,
+# bilinear_sampler.cc, spatial_transformer.cc)
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(data, grid):
+    """grid: (N,2,H,W) in [-1,1] xy coords (MXNet convention)."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = gx - x0; wy1 = gy - y0
+    wx0 = 1 - wx1; wy0 = 1 - wy1
+
+    def gather(yy, xx):
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        flat = data.reshape(n, c, h * w)
+        idx = (yc * w + xc).reshape(n, 1, -1)
+        out = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])),
+                                  axis=2)
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+        return out.reshape(n, c, *gx.shape[1:]) * valid[:, None].astype(data.dtype)
+
+    out = (gather(y0, x0) * (wy0 * wx0)[:, None]
+           + gather(y0, x1) * (wy0 * wx1)[:, None]
+           + gather(y1, x0) * (wy1 * wx0)[:, None]
+           + gather(y1, x1) * (wy1 * wx1)[:, None])
+    return out
+
+
+@register_op("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=False):
+    return _bilinear_sample(data, grid)
+
+
+@register_op("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    h, w = target_shape
+    if transform_type == "affine":
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones]).reshape(3, -1)
+        out = jnp.einsum("nij,jk->nik", theta, coords)
+        return out.reshape(n, 2, h, w)
+    # warp: data is flow (n,2,h,w)
+    n = data.shape[0]
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy])[None]
+    norm = jnp.asarray([(w - 1) / 2.0, (h - 1) / 2.0]).reshape(1, 2, 1, 1)
+    return base + data / norm
+
+
+@register_op("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    grid = grid_generator(loc, "affine", target_shape)
+    return _bilinear_sample(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops (ref: src/operator/roi_pooling.cc, contrib/roi_align.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    ph, pw = _pair(pooled_size)
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y0 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x1 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y1 - y0 + 1, 1)
+        rw = jnp.maximum(x1 - x0 + 1, 1)
+        img = data[b]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+
+        def cell(iy, ix):
+            cy0 = y0 + (iy * rh) // ph
+            cy1 = y0 + jnp.maximum(((iy + 1) * rh + ph - 1) // ph, 1) + 0
+            cx0 = x0 + (ix * rw) // pw
+            cx1 = x0 + jnp.maximum(((ix + 1) * rw + pw - 1) // pw, 1)
+            my = (ys >= cy0) & (ys < jnp.maximum(cy1, cy0 + 1))
+            mx = (xs >= cx0) & (xs < jnp.maximum(cx1, cx0 + 1))
+            mask = my[:, None] & mx[None, :]
+            return jnp.max(jnp.where(mask[None], img, -jnp.inf), axis=(1, 2))
+
+        cells = [[cell(iy, ix) for ix in range(pw)] for iy in range(ph)]
+        return jnp.stack([jnp.stack(r, axis=-1) for r in cells], axis=-2)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register_op("_contrib_ROIAlign")
+def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ref: src/operator/contrib/roi_align.cc — bilinear-sampled average."""
+    ph, pw = _pair(pooled_size)
+    n, c, h, w = data.shape
+    offset = 0.5 if aligned else 0.0
+    ns = 2 if sample_ratio <= 0 else sample_ratio
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0 = roi[1] * spatial_scale - offset
+        y0 = roi[2] * spatial_scale - offset
+        x1 = roi[3] * spatial_scale - offset
+        y1 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x1 - x0, 1.0)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        bh, bw = rh / ph, rw / pw
+        iy = jnp.arange(ph * ns) + 0.5
+        ix = jnp.arange(pw * ns) + 0.5
+        sy = y0 + iy * (bh / ns)
+        sx = x0 + ix * (bw / ns)
+        gy = 2 * sy / jnp.maximum(h - 1, 1) - 1
+        gx = 2 * sx / jnp.maximum(w - 1, 1) - 1
+        ggx, ggy = jnp.meshgrid(gx, gy)
+        grid = jnp.stack([ggx, ggy])[None]
+        samp = _bilinear_sample(data[b][None], grid)[0]
+        samp = samp.reshape(c, ph, ns, pw, ns)
+        return jnp.mean(samp, axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# im2col / col2im (ref: src/operator/nn/im2col.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("im2col")
+def im2col(data, kernel=None, stride=None, dilate=None, pad=None):
+    k = len(kernel)
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    patches = jax.lax.conv_general_dilated_patches(
+        data, kernel, stride, [(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=_conv_dims(data.ndim))
+    n, ck, oh, ow = patches.shape
+    return patches.reshape(n, ck, oh * ow)
+
+
+# ---------------------------------------------------------------------------
+# correlation (ref: src/operator/correlation.cc) — simplified dense version
+# ---------------------------------------------------------------------------
+
+@register_op("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    d = max_displacement
+    n, c, h, w = data1.shape
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (d, d), (d, d)))
+    outs = []
+    for dy in range(0, 2 * d + 1, stride2):
+        for dx in range(0, 2 * d + 1, stride2):
+            shifted = p2[:, :, dy:dy + h, dx:dx + w]
+            if is_multiply:
+                outs.append(jnp.mean(data1 * shifted, axis=1))
+            else:
+                outs.append(jnp.mean(jnp.abs(data1 - shifted), axis=1))
+    return jnp.stack(outs, axis=1)

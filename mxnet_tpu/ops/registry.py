@@ -1,0 +1,148 @@
+"""Op registry: one registration mechanism for the whole op corpus.
+
+TPU-native replacement for the reference's NNVM op registry
+(ref: NNVM_REGISTER_OP, 354 uses in src/operator/**/*.cc, plus the legacy
+MXNET_REGISTER_OP_PROPERTY path — SURVEY.md Appendix A). In the reference an
+op carries FCompute/FInferShape/FGradient/... attributes; here an op is a
+pure jax function (shape inference = jax.eval_shape, gradient = jax.vjp,
+kernel = XLA fusion), so the registry only keeps name → (fn, metadata) for
+the user-facing API codegen, aliases, and docs.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Callable, Dict, List, Optional
+
+from ..base import MXNetError
+
+__all__ = ["register_op", "get_op", "list_ops", "OpInfo", "make_nd_function"]
+
+
+class OpInfo:
+    __slots__ = ("name", "fn", "n_out", "differentiable", "arg_names",
+                 "defaults", "needs_rng", "needs_train", "input_names",
+                 "aux_updates", "visible_outputs")
+
+    def __init__(self, name, fn, n_out, differentiable, needs_rng=False,
+                 needs_train=False, input_names=None, aux_updates=None,
+                 visible_outputs=None):
+        self.name = name
+        self.fn = fn
+        self.n_out = n_out
+        self.differentiable = differentiable
+        self.needs_rng = needs_rng
+        self.needs_train = needs_train
+        # symbol-layer metadata (ref: nnvm FListInputNames /
+        # FListAuxiliaryStates / FNumVisibleOutputs attrs):
+        self.input_names = input_names    # declared tensor-input names
+        self.aux_updates = aux_updates or {}  # out_idx -> input_idx (aux var)
+        self.visible_outputs = visible_outputs  # user-visible output count
+        sig = inspect.signature(fn)
+        self.arg_names = []
+        self.defaults = {}
+        for pname, p in sig.parameters.items():
+            if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                self.arg_names.append("*")
+                continue
+            self.arg_names.append(pname)
+            if p.default is not p.empty:
+                self.defaults[pname] = p.default
+
+
+_OPS: Dict[str, OpInfo] = {}
+
+
+def register_op(name: str, n_out: int = 1, differentiable: bool = True,
+                aliases: Optional[List[str]] = None, needs_rng: bool = False,
+                needs_train: bool = False, input_names=None, aux_updates=None,
+                visible_outputs=None):
+    """Register a pure-jax op function under an MXNet-style name.
+
+    The function's leading parameters without defaults are tensor inputs
+    (jax arrays); keyword parameters with defaults are op params (the
+    dmlc::Parameter analog). `needs_rng`: a threefry key is appended as a
+    trailing tensor input by the nd wrapper. `needs_train`: the wrapper
+    injects `_training=autograd.is_training()` (ref: the thread-local
+    is_train_ flag, src/imperative/imperative.cc:26)."""
+
+    def deco(fn):
+        info = OpInfo(name, fn, n_out, differentiable, needs_rng, needs_train,
+                      input_names, aux_updates, visible_outputs)
+        _OPS[name] = info
+        for a in aliases or []:
+            _OPS[a] = info
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpInfo:
+    if name not in _OPS:
+        raise MXNetError(f"operator '{name}' is not registered")
+    return _OPS[name]
+
+
+def has_op(name: str) -> bool:
+    return name in _OPS
+
+
+def list_ops() -> List[str]:
+    return sorted(_OPS)
+
+
+def make_nd_function(name: str) -> Callable:
+    """Build the user-facing nd.<name> function: NDArray in/out, autograd
+    recording (this is the codegen the reference does at import time —
+    ref: python/mxnet/ndarray/register.py:116)."""
+    info = _OPS[name]
+
+    def nd_fn(*args, **kwargs):
+        from ..ndarray.ndarray import NDArray, invoke, array as _arr
+
+        out_kw = kwargs.pop("out", None)
+        kwargs.pop("name", None)  # symbol-layer arg, ignored in eager
+        inputs = []
+        rest_params = {}
+        param_names = [n for n in info.arg_names if n in info.defaults]
+        pi = 0
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], NDArray):
+                inputs.extend(a)
+            else:
+                # positional op-param after the tensor inputs
+                while pi < len(param_names) and param_names[pi] in kwargs:
+                    pi += 1
+                if pi < len(param_names):
+                    rest_params[param_names[pi]] = a
+                    pi += 1
+        # split kwargs into tensor inputs vs params by value type
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                inputs.append(v)
+            else:
+                rest_params[k] = v
+        n_out = rest_params.get("num_outputs", info.n_out) \
+            if info.n_out == -1 else info.n_out
+        if info.needs_train and "_training" not in rest_params:
+            from .. import autograd as _ag
+            rest_params["_training"] = _ag.is_training()
+        if info.needs_rng:
+            import jax as _jax
+            from ..random import next_key
+            from ..ndarray.ndarray import _wrap as _w
+            # raw uint32 key data: vjp-safe (int cotangents are float0)
+            inputs.append(_w(_jax.random.key_data(next_key())))
+        out = invoke(info.fn, inputs, n_out=n_out,
+                     differentiable=info.differentiable, **rest_params)
+        if out_kw is not None:
+            out_kw._rebind(out._data if isinstance(out, NDArray) else out[0]._data)
+            return out_kw
+        return out
+
+    nd_fn.__name__ = name
+    nd_fn.__qualname__ = name
+    nd_fn.__doc__ = info.fn.__doc__
+    return nd_fn

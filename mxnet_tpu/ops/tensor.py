@@ -1,0 +1,587 @@
+"""Tensor op corpus: elementwise, broadcast, reduce, matrix, indexing.
+
+TPU-native coverage of the reference's `src/operator/tensor/` family
+(33.5k LoC of C++/CUDA — SURVEY.md §2.3): elemwise_* / broadcast_* /
+*_scalar ops (ref: elemwise_binary_broadcast_op_basic.cc), reductions
+(broadcast_reduce_op.h), dot incl. transpose flags (dot-inl.h), indexing
+(indexing_op.cc), matrix manipulation (matrix_op-inl.h), ordering
+(ordering_op.cc). Each op is a pure jax.numpy composition — XLA supplies
+kernels, fusion, and gradients, so 33k LoC collapses to compositions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# elementwise binary + broadcast families
+# (ref: src/operator/tensor/elemwise_binary_op_basic.cc,
+#       elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+}
+
+for _name, _fn in _BINARY.items():
+    register_op(f"elemwise_{_name}", aliases=[f"_{_name}", f"_Plus" if _name == "add" else f"_x{_name}"])(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
+    register_op(f"broadcast_{_name}",
+                aliases=[f"_broadcast_{_name}"])((lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
+
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal, "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal, "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+for _name, _fn in _CMP.items():
+    register_op(f"broadcast_{_name}", differentiable=False)(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs).astype(lhs.dtype))(_fn))
+
+_SCALAR = {
+    "plus": jnp.add, "minus": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less, "lesser_equal": jnp.less_equal,
+}
+for _name, _fn in _SCALAR.items():
+    diff = _name in ("plus", "minus", "mul", "div", "mod", "power",
+                     "maximum", "minimum")
+    register_op(f"_{_name}_scalar", differentiable=diff)(
+        (lambda f: lambda data, scalar=1.0: f(data, jnp.asarray(scalar, data.dtype)).astype(data.dtype))(_fn))
+
+register_op("_rminus_scalar")(lambda data, scalar=1.0: scalar - data)
+register_op("_rdiv_scalar")(lambda data, scalar=1.0: scalar / data)
+register_op("_rpower_scalar")(lambda data, scalar=1.0: jnp.power(scalar, data))
+register_op("_rmod_scalar")(lambda data, scalar=1.0: jnp.mod(scalar, data))
+
+
+@register_op("add_n", aliases=["ElementWiseSum", "_sum"])
+def add_n(*args):
+    """ref: src/operator/tensor/elemwise_sum.cc"""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+register_op("_grad_add")(lambda lhs, rhs: lhs + rhs)
+
+# ---------------------------------------------------------------------------
+# unary math (ref: elemwise_unary_op_basic.cc / _trig.cc / _logexp.cc / _pow.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "cbrt": jnp.cbrt, "exp": jnp.exp, "expm1": jnp.expm1,
+    "log": jnp.log, "log10": jnp.log10, "log1p": jnp.log1p, "log2": jnp.log2,
+    "negative": jnp.negative, "reciprocal": jnp.reciprocal, "sqrt": jnp.sqrt,
+    "square": jnp.square, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "identity": lambda x: x,
+}
+for _name, _fn in _UNARY.items():
+    register_op(_name)((lambda f: lambda data: f(data))(_fn))
+
+register_op("_copy")(lambda data: jnp.copy(data))
+
+_UNARY_NONDIFF = {
+    "ceil": jnp.ceil, "floor": jnp.floor, "rint": jnp.rint,
+    "round": jnp.round, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "sign": jnp.sign, "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+}
+for _name, _fn in _UNARY_NONDIFF.items():
+    register_op(_name, differentiable=False)((lambda f: lambda data: f(data))(_fn))
+
+
+@register_op("clip")
+def clip(data, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register_op("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """ref: src/operator/tensor/elemwise_unary_op_basic.cc smooth_l1:
+    |x|<1/s^2 ? 0.5 (sx)^2 : |x| - 0.5/s^2"""
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * data * data,
+                     jnp.abs(data) - 0.5 / s2)
+
+
+@register_op("BlockGrad", aliases=["stop_gradient"], differentiable=False)
+def block_grad(data):
+    """ref: src/operator/tensor/elemwise_unary_op_basic.cc BlockGrad"""
+    return jax.lax.stop_gradient(data)
+
+
+@register_op("make_loss")
+def make_loss(data):
+    return data
+
+
+# ---------------------------------------------------------------------------
+# reductions (ref: src/operator/tensor/broadcast_reduce_op.h)
+# ---------------------------------------------------------------------------
+
+def _axis_arg(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+def _make_reduce(jfn, nan_fn=None):
+    def red(data, axis=None, keepdims=False, exclude=False):
+        ax = _axis_arg(axis)
+        if exclude and ax is not None:
+            all_ax = set(range(data.ndim))
+            keep = {a % data.ndim for a in (ax if isinstance(ax, tuple) else (ax,))}
+            ax = tuple(sorted(all_ax - keep))
+        return jfn(data, axis=ax, keepdims=keepdims)
+    return red
+
+
+register_op("sum", aliases=["sum_axis"])(_make_reduce(jnp.sum))
+register_op("nansum")(_make_reduce(jnp.nansum))
+register_op("mean")(_make_reduce(jnp.mean))
+register_op("prod")(_make_reduce(jnp.prod))
+register_op("nanprod")(_make_reduce(jnp.nanprod))
+register_op("max", aliases=["max_axis"])(_make_reduce(jnp.max))
+register_op("min", aliases=["min_axis"])(_make_reduce(jnp.min))
+
+
+@register_op("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    ax = _axis_arg(axis)
+    if ax is None:
+        data = data.ravel()
+    return jnp.linalg.norm(data, ord=ord, axis=ax, keepdims=keepdims)
+
+
+@register_op("moments", n_out=2)
+def moments(data, axes=None, keepdims=False):
+    """ref: src/operator/nn/moments.cc"""
+    ax = _axis_arg(axes)
+    mean = jnp.mean(data, axis=ax, keepdims=keepdims)
+    var = jnp.var(data, axis=ax, keepdims=keepdims)
+    return mean, var
+
+
+@register_op("argmax", differentiable=False)
+def argmax(data, axis=None, keepdims=False):
+    return jnp.argmax(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register_op("argmin", differentiable=False)
+def argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register_op("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register_op("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """ref: src/operator/tensor/broadcast_reduce_op_index.cc pick"""
+    idx = index.astype(jnp.int32)
+    if idx.ndim == data.ndim:
+        idx = jnp.squeeze(idx, axis=axis)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        picked = jnp.squeeze(picked, axis=axis)
+    return picked
+
+
+# ---------------------------------------------------------------------------
+# ordering (ref: src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("topk", differentiable=False)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    mv = jnp.moveaxis(data, axis, -1)
+    vals, idx = jax.lax.top_k(-mv if is_ascend else mv, k)
+    if is_ascend:
+        vals = -vals
+    if ret_typ == "mask":
+        oh = jax.nn.one_hot(idx, mv.shape[-1], dtype=data.dtype).sum(axis=-2)
+        return jnp.moveaxis(oh, -1, axis)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(jnp.dtype(dtype))
+    return idx.astype(jnp.dtype(dtype))
+
+
+@register_op("sort")
+def sort(data, axis=-1, is_ascend=True):
+    r = jnp.sort(data, axis=axis)
+    return r if is_ascend else jnp.flip(r, axis=axis)
+
+
+@register_op("argsort", differentiable=False)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    r = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis)
+    return r.astype(jnp.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot (ref: src/operator/tensor/dot-inl.h) — straight to the MXU
+# ---------------------------------------------------------------------------
+
+@register_op("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    a = lhs.T if transpose_a and lhs.ndim == 2 else (
+        jnp.transpose(lhs) if transpose_a else lhs)
+    b = rhs.T if transpose_b and rhs.ndim == 2 else (
+        jnp.transpose(rhs) if transpose_b else rhs)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register_op("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# matrix manipulation (ref: src/operator/tensor/matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("reshape", aliases=["Reshape"])
+def reshape(data, shape=None, reverse=False):
+    from ..ndarray.ndarray import _expand_reshape_spec
+    return jnp.reshape(data, _expand_reshape_spec(data.shape, tuple(shape)))
+
+
+@register_op("reshape_like")
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register_op("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register_op("size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+@register_op("cast", aliases=["Cast", "amp_cast"])
+def cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype) if isinstance(dtype, str) else dtype)
+
+
+@register_op("transpose")
+def transpose(data, axes=None):
+    return jnp.transpose(data, tuple(axes) if axes else None)
+
+
+@register_op("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register_op("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register_op("Flatten", aliases=["flatten"])
+def flatten(data):
+    """ref: src/operator/tensor/matrix_op.cc Flatten — collapse all but dim0"""
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register_op("slice")
+def slice_op(data, begin=None, end=None, step=None):
+    idx = tuple(slice(b, e, s) for b, e, s in
+                zip(begin, end, step or [None] * len(begin)))
+    return data[idx]
+
+
+@register_op("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register_op("slice_like")
+def slice_like(data, shape_like, axes=None):
+    tgt = shape_like.shape
+    idx = [slice(None)] * data.ndim
+    axes = axes if axes else range(min(data.ndim, len(tgt)))
+    for ax in axes:
+        idx[ax] = slice(0, tgt[ax])
+    return data[tuple(idx)]
+
+
+@register_op("SliceChannel", aliases=["slice_channel", "split"], n_out=-1)
+def slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """ref: src/operator/slice_channel.cc"""
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register_op("_split_v2", n_out=-1)
+def split_v2(data, indices_or_sections=1, axis=0, squeeze_axis=False, sections=0):
+    n = sections if sections else indices_or_sections
+    if isinstance(n, (list, tuple)):
+        n = list(n)
+    parts = jnp.split(data, n, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register_op("Concat", aliases=["concat"])
+def concat(*args, dim=1, num_args=0):
+    """ref: src/operator/nn/concat.cc"""
+    return jnp.concatenate(args, axis=dim)
+
+
+@register_op("stack")
+def stack(*args, axis=0, num_args=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register_op("tile")
+def tile(data, reps=None):
+    return jnp.tile(data, tuple(reps))
+
+
+@register_op("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register_op("reverse", aliases=["flip"])
+def reverse(data, axis=None):
+    ax = axis if isinstance(axis, (tuple, list)) else (axis,)
+    return jnp.flip(data, axis=ax)
+
+
+@register_op("SwapAxis", aliases=["swapaxes"])
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register_op("depth_to_space")
+def depth_to_space(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+
+
+@register_op("space_to_depth")
+def space_to_depth(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+@register_op("diag")
+def diag(data, k=0, axis1=0, axis2=1):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register_op("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register_op("broadcast_to")
+def broadcast_to(data, shape=None):
+    shape = tuple(c if s == 0 else s for s, c in zip(shape, data.shape)) \
+        if len(shape) == data.ndim else tuple(shape)
+    return jnp.broadcast_to(data, shape)
+
+
+@register_op("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register_op("broadcast_axis", aliases=["broadcast_axes"])
+def broadcast_axis(data, axis=None, size=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    sizes = size if isinstance(size, (list, tuple)) else [size]
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register_op("Pad", aliases=["pad"])
+def pad_alias(data, mode="constant", pad_width=None, constant_value=0):
+    from .nn import pad_op
+    return pad_op(data, mode=mode, pad_width=tuple(pad_width),
+                  constant_value=constant_value)
+
+
+@register_op("zeros_like", differentiable=False)
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register_op("ones_like", differentiable=False)
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register_op("_identity_with_attr_like_rhs")
+def identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+# ---------------------------------------------------------------------------
+# indexing (ref: src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("take")
+def take(a, indices, axis=0, mode="clip"):
+    m = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=m)
+
+
+@register_op("batch_take")
+def batch_take(a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1).squeeze(1)
+
+
+@register_op("one_hot", differentiable=False)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register_op("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register_op("scatter_nd")
+def scatter_nd(data, indices, shape=None):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(shape), data.dtype)
+    return out.at[idx].add(data)
+
+
+@register_op("_ravel_multi_index", differentiable=False)
+def ravel_multi_index(data, shape=None):
+    dims = jnp.asarray(shape)
+    mult = jnp.cumprod(jnp.concatenate([jnp.ones(1, dims.dtype),
+                                        dims[::-1][:-1]]))[::-1]
+    return jnp.sum(data * mult[:, None], axis=0).astype(data.dtype)
+
+
+@register_op("_unravel_index", differentiable=False)
+def unravel_index(data, shape=None):
+    idx = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    return jnp.stack(idx).astype(data.dtype)
+
+
+@register_op("boolean_mask")
+def boolean_mask(data, index, axis=0):
+    # XLA needs static shapes: materialize via nonzero with size bound
+    mask = index.astype(bool)
+    idx = jnp.nonzero(mask, size=mask.shape[0])[0]
+    return jnp.take(data, idx, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# init-like ops needing no input (exposed via creation API); histogram
+# ---------------------------------------------------------------------------
+
+@register_op("histogram", differentiable=False)
+def histogram(data, bin_cnt=10, range=None):
+    h, edges = jnp.histogram(data, bins=bin_cnt, range=range)
+    return h.astype(jnp.float32), edges
+
+
+@register_op("khatri_rao")
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("ir,jr->ijr", out, m).reshape(-1, out.shape[1])
+    return out
+
+
+@register_op("_square_sum")
+def square_sum(data, axis=None, keepdims=False):
+    return jnp.sum(jnp.square(data), axis=_axis_arg(axis), keepdims=keepdims)
+
+
+@register_op("cast_storage")
+def cast_storage(data, stype="default"):
+    return data  # dense-on-TPU: storage casts are identity (see sparse.py)
+
+
+@register_op("_contrib_arange_like", differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        shape = data.shape
+    else:
+        n = data.shape[axis]
+        shape = (n,)
+    return (start + step * jnp.arange(n, dtype=data.dtype)).reshape(shape)
+
+
+@register_op("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    """ref: src/operator/contrib/transformer.cc:33"""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register_op("_sym_zeros", differentiable=False)
+def _sym_zeros(shape=(), dtype="float32"):
+    return jnp.zeros(tuple(shape), jnp.dtype(dtype))
+
+
+@register_op("_sym_ones", differentiable=False)
+def _sym_ones(shape=(), dtype="float32"):
+    return jnp.ones(tuple(shape), jnp.dtype(dtype))

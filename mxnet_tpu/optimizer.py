@@ -1,0 +1,569 @@
+"""Optimizers.
+
+ref: python/mxnet/optimizer/optimizer.py (1,901 LoC) — registry of
+Optimizer subclasses with create_state/update, lr/wd multipliers, and the
+`Updater` wrapper used server-side by KVStore. The numeric updates delegate
+to the fused update ops (ops/optimizer_ops.py ≙ src/operator/optimizer_op.cc)
+so the whole step stays inside XLA.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Dict, Optional
+
+import numpy as onp
+
+from .base import Registry, MXNetError
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray, invoke, zeros as nd_zeros
+from .ops import optimizer_ops as oops
+
+__all__ = ["Optimizer", "SGD", "Adam", "AdaGrad", "RMSProp", "AdaDelta",
+           "Ftrl", "FTML", "NAG", "Signum", "SignSGD", "Adamax", "Nadam",
+           "AdamW", "SGLD", "DCASGD", "LBSGD", "Test", "create", "register",
+           "Updater", "get_updater"]
+
+_REG = Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass.__name__.lower())(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.get(name.lower())(**kwargs)
+
+
+class Optimizer:
+    """ref: optimizer.py:48 Optimizer base — bookkeeping of per-index update
+    counts, lr/wd multipliers, schedulers, rescale_grad/clip."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.aggregate_num = 0
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == onp.float16:
+            w32 = weight.astype("float32")
+            return (w32, self.create_state(index, w32))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == onp.float16:
+            w32, base_state = state
+            g32 = grad.astype("float32")
+            self.update(index, w32, g32, base_state)
+            weight._rebind(w32._data.astype(weight._data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- hyperparams ------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been "
+                             "defined")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common(self, index):
+        self._update_count(index)
+        return self._get_lr(index), self._get_wd(index), \
+            (-1.0 if self.clip_gradient is None else self.clip_gradient)
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        return d
+
+
+def _assign(weight: NDArray, new: NDArray):
+    weight._rebind(new._data)
+
+
+@register
+class SGD(Optimizer):
+    """ref: optimizer.py SGD → sgd_update/sgd_mom_update ops."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, weight.ctx, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        if state is None:
+            new_w = invoke(oops.sgd_update, [weight, grad], lr=lr, wd=wd,
+                           rescale_grad=self.rescale_grad, clip_gradient=clip)
+            _assign(weight, new_w)
+        else:
+            new_w, new_mom = invoke(oops.sgd_mom_update, [weight, grad, state],
+                                    n_out=2, lr=lr, momentum=self.momentum,
+                                    wd=wd, rescale_grad=self.rescale_grad,
+                                    clip_gradient=clip)
+            _assign(weight, new_w)
+            _assign(state, new_mom)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, weight.ctx, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        if state is None:
+            new_w = invoke(oops.sgd_update, [weight, grad], lr=lr, wd=wd,
+                           rescale_grad=self.rescale_grad, clip_gradient=clip)
+            _assign(weight, new_w)
+        else:
+            new_w, new_mom = invoke(oops.nag_mom_update, [weight, grad, state],
+                                    n_out=2, lr=lr, momentum=self.momentum,
+                                    wd=wd, rescale_grad=self.rescale_grad,
+                                    clip_gradient=clip)
+            _assign(weight, new_w)
+            _assign(state, new_mom)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, weight.ctx, dtype=dt),
+                nd_zeros(weight.shape, weight.ctx, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        new_w, new_mean, new_var = invoke(
+            oops.adam_update, [weight, grad, mean, var], n_out=3, lr=lr,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=clip)
+        _assign(weight, new_w)
+        _assign(mean, new_mean)
+        _assign(var, new_var)
+
+
+@register
+class AdamW(Optimizer):
+    """ref: contrib adamw (_adamw_update, src/operator/contrib/adamw.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon, self.eta = beta1, beta2, epsilon, eta
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, weight.ctx, dtype=dt),
+                nd_zeros(weight.shape, weight.ctx, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        mean, var = state
+        new_w, new_mean, new_var = invoke(
+            oops.adamw_update, [weight, grad, mean, var], n_out=3, lr=lr,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            eta=self.eta, rescale_grad=self.rescale_grad, clip_gradient=clip)
+        _assign(weight, new_w)
+        _assign(mean, new_mean)
+        _assign(var, new_var)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, weight.ctx, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        new_w, new_h = invoke(oops.adagrad_update, [weight, grad, state],
+                              n_out=2, lr=lr, epsilon=self.float_stable_eps,
+                              wd=wd, rescale_grad=self.rescale_grad,
+                              clip_gradient=clip)
+        _assign(weight, new_w)
+        _assign(state, new_h)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        if self.centered:
+            return (nd_zeros(weight.shape, weight.ctx, dtype=dt),
+                    nd_zeros(weight.shape, weight.ctx, dtype=dt),
+                    nd_zeros(weight.shape, weight.ctx, dtype=dt))
+        return nd_zeros(weight.shape, weight.ctx, dtype=dt)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        cw = -1.0 if self.clip_weights is None else self.clip_weights
+        if self.centered:
+            n, g_avg, delta = state
+            new_w, new_n, new_g, new_d = invoke(
+                oops.rmspropalex_update, [weight, grad, n, g_avg, delta],
+                n_out=4, lr=lr, gamma1=self.gamma1, gamma2=self.gamma2,
+                epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=clip, clip_weights=cw)
+            _assign(weight, new_w); _assign(n, new_n)
+            _assign(g_avg, new_g); _assign(delta, new_d)
+        else:
+            new_w, new_n = invoke(
+                oops.rmsprop_update, [weight, grad, state], n_out=2, lr=lr,
+                gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad, clip_gradient=clip,
+                clip_weights=cw)
+            _assign(weight, new_w); _assign(state, new_n)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, weight.ctx, dtype=dt),
+                nd_zeros(weight.shape, weight.ctx, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        acc_g, acc_d = state
+        new_w, new_g, new_d = invoke(
+            oops.adadelta_update, [weight, grad, acc_g, acc_d], n_out=3,
+            rho=self.rho, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=clip)
+        _assign(weight, new_w); _assign(acc_g, new_g); _assign(acc_d, new_d)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, weight.ctx, dtype=dt),
+                nd_zeros(weight.shape, weight.ctx, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        z, n = state
+        new_w, new_z, new_n = invoke(
+            oops.ftrl_update, [weight, grad, z, n], n_out=3, lr=lr,
+            lamda1=self.lamda1, beta=self.beta, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=clip)
+        _assign(weight, new_w); _assign(z, new_z); _assign(n, new_n)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return tuple(nd_zeros(weight.shape, weight.ctx, dtype=dt)
+                     for _ in range(3))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        new_w, new_d, new_v, new_z = invoke(
+            oops.ftml_update, [weight, grad, d, v, z], n_out=4, lr=lr,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_grad=clip, t=t)
+        _assign(weight, new_w); _assign(d, new_d)
+        _assign(v, new_v); _assign(z, new_z)
+
+
+@register
+class SignSGD(Optimizer):
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        new_w = invoke(oops.signsgd_update, [weight, grad], lr=lr, wd=wd,
+                       rescale_grad=self.rescale_grad, clip_gradient=clip)
+        _assign(weight, new_w)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, weight.ctx, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        new_w, new_mom = invoke(oops.signum_update, [weight, grad, state],
+                                n_out=2, lr=lr, momentum=self.momentum, wd=wd,
+                                rescale_grad=self.rescale_grad,
+                                clip_gradient=clip, wd_lh=self.wd_lh)
+        _assign(weight, new_w); _assign(state, new_mom)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, weight.ctx, dtype=dt),
+                nd_zeros(weight.shape, weight.ctx, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        m, u = state
+        g = grad * self.rescale_grad + wd * weight
+        if clip >= 0:
+            g = g.clip(-clip, clip)
+        m_new = self.beta1 * m + (1.0 - self.beta1) * g
+        u_new = _nd.invoke(
+            lambda a, b: __import__("jax.numpy", fromlist=["maximum"]).maximum(a, b),
+            [self.beta2 * u, g.abs()])
+        _assign(m, m_new); _assign(u, u_new)
+        _assign(weight, weight - lr * m_new / (u_new + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        dt = str(weight.dtype)
+        return (nd_zeros(weight.shape, weight.ctx, dtype=dt),
+                nd_zeros(weight.shape, weight.ctx, dtype=dt))
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        t = self._index_update_count[index]
+        g = grad * self.rescale_grad + wd * weight
+        if clip >= 0:
+            g = g.clip(-clip, clip)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        g_prime = g / (1.0 - self.m_schedule)
+        m_new = self.beta1 * m + (1.0 - self.beta1) * g
+        v_new = self.beta2 * v + (1.0 - self.beta2) * g * g
+        m_prime = m_new / (1.0 - m_schedule_next)
+        v_prime = v_new / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        _assign(m, m_new); _assign(v, v_new)
+        _assign(weight, weight - lr * m_bar / (v_prime.sqrt() + self.epsilon))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (ref: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        g = grad * self.rescale_grad + wd * weight
+        if clip >= 0:
+            g = g.clip(-clip, clip)
+        from . import random as _random
+        noise = _random.normal(0, math.sqrt(lr), shape=weight.shape,
+                               dtype=str(weight.dtype))
+        _assign(weight, weight - lr / 2 * g + noise)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None if self.momentum == 0.0 else \
+            nd_zeros(weight.shape, weight.ctx, dtype=str(weight.dtype))
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr, wd, clip = self._common(index)
+        g = grad * self.rescale_grad
+        if clip >= 0:
+            g = g.clip(-clip, clip)
+        mom, prev = state
+        comp = self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            new_mom = self.momentum * mom - lr * (g + wd * weight + comp)
+            _assign(mom, new_mom)
+            step = new_mom
+        else:
+            step = -lr * (g + wd * weight + comp)
+        _assign(prev, weight)
+        _assign(weight, weight + step)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layerwise scaling
+    (ref: optimizer.py LBSGD)."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        self.warmup_strategy = warmup_strategy
+
+
+@register
+class Test(Optimizer):
+    """Mock optimizer for tests (ref: optimizer.py:1633)."""
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, weight.ctx, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        _assign(weight, weight + grad * self.rescale_grad)
+        _assign(state, grad)
+
+
+class Updater:
+    """ref: optimizer.py:1672 Updater — the callable KVStore servers run."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[int, object] = {}
+        self.states_synced: Dict[int, bool] = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states) if isinstance(states, bytes) \
+            else states
+        self.states_synced = {k: False for k in self.states}
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)
+
+
+# opt registry by short alias (mirror reference names)
+_REG.alias("sgd", "stochasticgradientdescent")
+_REG.alias("adam", "adamoptimizer") if "adamoptimizer" not in _REG else None

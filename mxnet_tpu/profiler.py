@@ -1,0 +1,163 @@
+"""Profiler: jax.profiler + chrome-trace export.
+
+ref: src/profiler/profiler.h:251 + python/mxnet/profiler.py — the reference
+emits chrome://tracing JSON per engine event. On TPU the deep trace comes
+from jax.profiler (XProf/TensorBoard); this module keeps the reference's
+control surface (set_config/set_state/dump, scoped ranges) and emits a
+chrome-trace JSON of the Python-level scopes for parity.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+import jax
+
+__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
+           "Scope", "scope", "Task", "Frame", "Event", "Marker"]
+
+_state = threading.local()
+_config = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": True, "profile_imperative": True,
+           "profile_memory": True, "profile_api": True,
+           "aggregate_stats": False}
+_events: List[dict] = []
+_running = False
+_jax_dir: Optional[str] = None
+
+
+def set_config(**kwargs):
+    """ref: python/mxnet/profiler.py set_config / MXSetProcessProfilerConfig"""
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    global _running, _jax_dir
+    if state == "run" and not _running:
+        _running = True
+        _jax_dir = os.path.splitext(_config["filename"])[0] + "_xprof"
+        try:
+            jax.profiler.start_trace(_jax_dir)
+        except Exception:
+            _jax_dir = None
+    elif state == "stop" and _running:
+        _running = False
+        if _jax_dir:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def pause(profile_process="worker"):
+    pass
+
+
+def resume(profile_process="worker"):
+    pass
+
+
+def is_running() -> bool:
+    return _running
+
+
+def dumps(reset=False) -> str:
+    out = json.dumps({"traceEvents": list(_events)}, indent=1)
+    if reset:
+        _events.clear()
+    return out
+
+
+def dump(finished=True, profile_process="worker"):
+    with open(_config["filename"], "w") as f:
+        f.write(dumps())
+
+
+class Scope:
+    """Named profiling scope (ref: profiler.scope; also jax named scopes)."""
+
+    _current = threading.local()
+
+    def __init__(self, name="<unk>:"):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        self._jctx = jax.profiler.TraceAnnotation(self.name)
+        self._jctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._jctx.__exit__(*exc)
+        t1 = time.perf_counter_ns()
+        if _running:
+            _events.append({
+                "name": self.name, "ph": "X", "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "ts": self._t0 / 1000.0, "dur": (t1 - self._t0) / 1000.0,
+            })
+
+
+scope = Scope
+
+
+class _Named:
+    def __init__(self, name, domain=None):
+        self.name = getattr(name, "name", name)
+
+    def start(self):
+        self._scope = Scope(self.name)
+        self._scope.__enter__()
+
+    def stop(self):
+        self._scope.__exit__(None, None, None)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(name, self)
+
+    def new_frame(self, name):
+        return Frame(name, self)
+
+    def new_event(self, name):
+        return Event(name, self)
+
+    def new_marker(self, name):
+        return Marker(name, self)
+
+
+class Task(_Named):
+    pass
+
+
+class Frame(_Named):
+    pass
+
+
+class Event(_Named):
+    pass
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope_name="process"):
+        if _running:
+            _events.append({"name": self.name, "ph": "i", "pid": os.getpid(),
+                            "ts": time.perf_counter_ns() / 1000.0,
+                            "s": scope_name[0]})

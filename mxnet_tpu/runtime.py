@@ -1,0 +1,64 @@
+"""Runtime feature detection.
+
+ref: src/libinfo.cc → python/mxnet/runtime.py — build-feature introspection
+(`feature_list()`, `Features`). TPU-native features are detected from the
+live jax install instead of compile-time flags.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    feats = {
+        "TPU": any(d.platform != "cpu" for d in jax.devices()),
+        "CPU": True,
+        "BF16": True,
+        "F16C": True,
+        "JIT": True,
+        "PALLAS": True,
+        "DIST_KVSTORE": True,
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": True,
+        "PROFILER": True,
+        "OPENCV": _has("cv2"),
+        "BLAS_OPEN": True,
+        "LAPACK": True,
+        "MKLDNN": False,
+        "CUDA": False,
+        "CUDNN": False,
+        "NCCL": False,
+        "TENSORRT": False,
+        "OPENMP": True,
+        "SSE": False,
+        "TVM_OP": False,
+        "CAFFE": False,
+        "DEBUG": False,
+    }
+    return feats
+
+
+def _has(mod):
+    try:
+        __import__(mod)
+        return True
+    except ImportError:
+        return False
+
+
+class Features(dict):
+    """ref: python/mxnet/runtime.py Features."""
+
+    def __init__(self):
+        super().__init__([(k, Feature(k, v)) for k, v in _detect().items()])
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,28 @@
+"""sym namespace: Symbol + generated op surface.
+
+Mirrors python/mxnet/symbol/__init__.py (generated sym ops, ref:
+python/mxnet/symbol/register.py).
+"""
+import sys as _sys
+
+from .symbol import (  # noqa: F401
+    Symbol, Variable, var, Group, load, load_json, zeros, ones,
+    make_symbol_function as _make,
+)
+from ..ops.registry import list_ops as _list_ops
+
+_mod = _sys.modules[__name__]
+for _name in _list_ops():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make(_name))
+
+
+class _Contrib:
+    def __getattr__(self, name):
+        for cand in (f"_contrib_{name}", name):
+            if hasattr(_mod, cand):
+                return getattr(_mod, cand)
+        raise AttributeError(name)
+
+
+contrib = _Contrib()

@@ -1,0 +1,189 @@
+"""Test utilities.
+
+ref: python/mxnet/test_utils.py (2,222 LoC) — assert_almost_equal,
+check_numeric_gradient (finite differences), check_consistency (the
+cpu-vs-gpu oracle; here cpu-jax vs tpu-jax), default_context, random data
+generators. This is the backbone of the test pyramid (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Callable, Dict, List, Optional
+
+import numpy as onp
+
+from . import autograd
+from .context import Context, cpu, current_context, num_gpus
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["assert_almost_equal", "almost_equal", "same", "default_context",
+           "rand_ndarray", "rand_shape_2d", "rand_shape_3d", "rand_shape_nd",
+           "check_numeric_gradient", "check_consistency", "numeric_grad",
+           "simple_forward", "list_gpus"]
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def list_gpus():
+    return list(range(num_gpus()))
+
+
+def _as_np(a):
+    return a.asnumpy() if isinstance(a, NDArray) else onp.asarray(a)
+
+
+def same(a, b):
+    return onp.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20, equal_nan=False):
+    return onp.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol,
+                        equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b"),
+                        equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    if not onp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        err = onp.max(onp.abs(a - b) / (onp.abs(b) + atol + 1e-30))
+        raise AssertionError(
+            f"Arrays {names[0]} and {names[1]} differ: max relative error "
+            f"{err}\n{names[0]}: {a}\n{names[1]}: {b}")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(onp.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(onp.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32",
+                 ctx=None, **kwargs):
+    a = onp.random.uniform(-1, 1, size=shape).astype(dtype)
+    nd = array(a, ctx=ctx)
+    if stype != "default":
+        from .ndarray import sparse
+        return sparse.cast_storage(nd, stype)
+    return nd
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    executor = sym.simple_bind(ctx or default_context(),
+                               **{k: v.shape for k, v in inputs.items()})
+    for k, v in inputs.items():
+        executor.arg_dict[k][:] = v
+    outputs = executor.forward(is_train=is_train)
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True, dtype=onp.float32):
+    """Finite-difference gradient of executor's scalar output sum w.r.t. args
+    (ref: test_utils.py numeric_grad)."""
+    grads = {}
+    for name, arr in location.items():
+        base = arr.asnumpy().astype(onp.float64)
+        g = onp.zeros_like(base)
+        flat = base.ravel()
+        gflat = g.ravel()
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps
+            executor.arg_dict[name][:] = base.reshape(arr.shape).astype(dtype)
+            fp = sum(o.asnumpy().astype(onp.float64).sum()
+                     for o in executor.forward(is_train=use_forward_train))
+            flat[i] = old - eps
+            executor.arg_dict[name][:] = base.reshape(arr.shape).astype(dtype)
+            fm = sum(o.asnumpy().astype(onp.float64).sum()
+                     for o in executor.forward(is_train=use_forward_train))
+            flat[i] = old
+            executor.arg_dict[name][:] = base.reshape(arr.shape).astype(dtype)
+            gflat[i] = (fp - fm) / (2 * eps)
+        grads[name] = g
+    return grads
+
+
+def check_numeric_gradient(fn: Callable, inputs: List[NDArray],
+                           rtol=1e-2, atol=1e-4, eps=1e-3):
+    """Compare autograd gradients of `fn(*inputs).sum()` against central
+    finite differences (ref: test_utils.py check_numeric_gradient — adapted
+    to the eager tape)."""
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        y = fn(*inputs)
+        s = y.sum() if not isinstance(y, (list, tuple)) else sum(
+            o.sum() for o in y)
+    s.backward()
+    analytic = [x.grad.asnumpy().astype(onp.float64) for x in inputs]
+
+    for xi, x in enumerate(inputs):
+        base = x.asnumpy().astype(onp.float64)
+        num = onp.zeros_like(base)
+        flat_idx = list(onp.ndindex(*base.shape)) if base.shape else [()]
+        for idx in flat_idx:
+            pert = base.copy()
+            pert[idx] = base[idx] + eps
+            args = [array(pert.astype("float32")) if j == xi else inputs[j]
+                    for j in range(len(inputs))]
+            yp = fn(*args)
+            fp = (yp.sum() if not isinstance(yp, (list, tuple)) else
+                  sum(o.sum() for o in yp)).asscalar()
+            pert[idx] = base[idx] - eps
+            args = [array(pert.astype("float32")) if j == xi else inputs[j]
+                    for j in range(len(inputs))]
+            ym = fn(*args)
+            fm = (ym.sum() if not isinstance(ym, (list, tuple)) else
+                  sum(o.sum() for o in ym)).asscalar()
+            num[idx] = (fp - fm) / (2 * eps)
+        if not onp.allclose(analytic[xi], num, rtol=rtol, atol=atol):
+            err = onp.max(onp.abs(analytic[xi] - num))
+            raise AssertionError(
+                f"numeric gradient check failed for input {xi}: max abs err "
+                f"{err}\nanalytic: {analytic[xi]}\nnumeric: {num}")
+
+
+def check_consistency(fn: Callable, inputs: List[onp.ndarray],
+                      ctx_list: Optional[List[Context]] = None,
+                      rtol=1e-4, atol=1e-5):
+    """Run the same computation on every available backend and compare —
+    the reference's cpu-vs-gpu oracle (ref: test_utils.py check_consistency,
+    used heavily by tests/python/gpu/test_operator_gpu.py). Here: cpu-jax
+    vs accelerator-jax."""
+    from .context import gpu
+    if ctx_list is None:
+        ctx_list = [cpu()]
+        if num_gpus() > 0:
+            ctx_list.append(gpu())
+    results = []
+    for ctx in ctx_list:
+        args = [array(a, ctx=ctx) for a in inputs]
+        out = fn(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results.append([o.asnumpy() for o in outs])
+    ref = results[0]
+    for got, ctx in zip(results[1:], ctx_list[1:]):
+        for r, g in zip(ref, got):
+            assert_almost_equal(r, g, rtol=rtol, atol=atol,
+                                names=(str(ctx_list[0]), str(ctx)))
+    return results
+
+
+class DummyIter:
+    """Infinite iterator repeating one batch (benchmark fixture — ref:
+    SyntheticDataIter in example/image-classification/common/data.py:99)."""
+
+    def __init__(self, batch):
+        self.batch = batch
+
+    def __iter__(self):
+        while True:
+            yield self.batch

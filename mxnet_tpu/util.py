@@ -1,0 +1,83 @@
+"""Utility flags: numpy-semantics switches.
+
+ref: python/mxnet/util.py:53-132 set_np_shape/is_np_array — the reference
+gates NumPy-compatible shape/array semantics behind global flags so the
+legacy 1-based API coexists with mx.np.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+_state = threading.local()
+
+
+def _get(name, default=False):
+    return getattr(_state, name, default)
+
+
+def is_np_shape() -> bool:
+    return _get("np_shape")
+
+
+def set_np_shape(active: bool) -> bool:
+    prev = is_np_shape()
+    _state.np_shape = active
+    return prev
+
+
+def is_np_array() -> bool:
+    return _get("np_array")
+
+
+def set_np_array(active: bool) -> bool:
+    prev = is_np_array()
+    _state.np_array = active
+    return prev
+
+
+def set_np(shape=True, array=True):
+    set_np_shape(shape)
+    set_np_array(array)
+
+
+def reset_np():
+    set_np(False, False)
+
+
+class _NumpyScope:
+    def __init__(self, shape, array):
+        self._shape, self._array = shape, array
+
+    def __enter__(self):
+        self._prev = (is_np_shape(), is_np_array())
+        set_np(self._shape, self._array)
+
+    def __exit__(self, *exc):
+        set_np(*self._prev)
+
+
+def np_shape(active=True):
+    return _NumpyScope(active, is_np_array())
+
+
+def np_array(active=True):
+    return _NumpyScope(is_np_shape(), active)
+
+
+def use_np(func):
+    """Decorator form (ref: python/mxnet/util.py use_np)."""
+    if isinstance(func, type):
+        return func
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with _NumpyScope(True, True):
+            return func(*args, **kwargs)
+
+    return wrapper
+
+
+def get_gpu_count():
+    from .context import num_gpus
+    return num_gpus()

@@ -1,0 +1,66 @@
+"""Network visualization: print_summary / plot_network.
+
+ref: python/mxnet/visualization.py:427 — graphviz plot + layer summary table
+over a Symbol graph.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Text summary of a symbol graph (ref: visualization.py print_summary)."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    nodes = symbol._topo_nodes()
+    shapes = {}
+    if shape is not None:
+        try:
+            for node, s in symbol._infer_node_shapes(shape).items():
+                shapes[node] = s
+        except Exception:
+            pass
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+    for node in nodes:
+        op = node.op or "Variable"
+        out_shape = shapes.get(node, "")
+        prev = ",".join(i.name for i in node.inputs[:2])
+        print_row([f"{node.name} ({op})", str(out_shape), "", prev], positions)
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("graphviz is not installed; use print_summary")
+    dot = Digraph(name=title)
+    for node in symbol._topo_nodes():
+        if hide_weights and node.op is None and (
+                node.name.endswith("weight") or node.name.endswith("bias")):
+            continue
+        dot.node(node.name, label=f"{node.name}\n{node.op or 'var'}")
+        for inp in node.inputs:
+            if hide_weights and inp.op is None and (
+                    inp.name.endswith("weight") or inp.name.endswith("bias")):
+                continue
+            dot.edge(inp.name, node.name)
+    return dot

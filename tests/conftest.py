@@ -1,0 +1,31 @@
+"""Test config: force CPU jax with a virtual 8-device mesh.
+
+Mirrors the reference test strategy (SURVEY.md §4): CPU-runnable unit
+tests; multi-device sharding validated on a virtual 8-device CPU mesh
+(the analog of tools/launch.py local-mode multi-process tests).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon TPU plugin prepends itself to jax_platforms at import; force cpu
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """Seeded determinism (ref: tests/python/unittest/common.py:117
+    @with_seed)."""
+    onp.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

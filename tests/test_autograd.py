@@ -1,0 +1,147 @@
+"""Autograd tests (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * 2).sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * onp.exp(x.asnumpy()),
+                        rtol=1e-5)
+
+
+def test_multi_variable():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert a.grad.asscalar() == pytest.approx(4.0)  # b + 1
+    assert b.grad.asscalar() == pytest.approx(2.0)  # a
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 1.0]))
+    assert x.grad.asnumpy().tolist() == [20.0, 2.0]
+
+
+def test_grad_add_req():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert x.grad.asscalar() == pytest.approx(6.0)
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert x.grad.asscalar() == pytest.approx(9.0)  # only d(z)/dx via last x
+
+    x2 = nd.array([3.0])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = nd.BlockGrad(x2 * x2) * x2
+    y2.backward()
+    assert x2.grad.asscalar() == pytest.approx(9.0)
+
+
+def test_training_scopes():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0])
+    with autograd.record():
+        y = (x * x).sum()
+    (g,) = autograd.grad(y, [x])
+    assert_almost_equal(g.asnumpy(), 2 * x.asnumpy())
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_numeric_gradient_conv_like():
+    check_numeric_gradient(lambda x: nd.tanh(x), [nd.array([[0.3, -0.4]])])
+    check_numeric_gradient(lambda a, b: a * b + nd.sigmoid(a),
+                           [nd.array([0.5]), nd.array([-0.25])])
+
+
+def test_softmax_output_loss_grad():
+    # SoftmaxOutput backward = (p - onehot(label)) * grad_scale
+    x = nd.array(onp.random.randn(4, 5).astype("float32"))
+    label = nd.array([0, 1, 2, 3])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = onp.exp(x.asnumpy())
+    p = p / p.sum(axis=1, keepdims=True)
+    expect = p.copy()
+    expect[onp.arange(4), [0, 1, 2, 3]] -= 1
+    assert_almost_equal(x.grad.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_mark_variables():
+    x = nd.array([1.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 5
+    y.backward()
+    assert x.grad.asscalar() == pytest.approx(5.0)

