@@ -356,8 +356,9 @@ class HybridLambda(HybridBlock):
             self._func_name = getattr(function, "__name__", "lambda")
 
     def hybrid_forward(self, F, x, *args):
-        fn = self._func or getattr(F, self._func_name)
-        return fn(x, *args)
+        if self._func is not None:
+            return self._func(F, x, *args)
+        return getattr(F, self._func_name)(x, *args)
 
 
 class LeakyReLU(HybridBlock):

@@ -709,6 +709,14 @@ def _solve_param_shapes(node: _Node, in_shapes, shapes):
         c = data_shape[1]
         setvar(1, (c,))
         setvar(2, (c,))
+    elif op in ("SoftmaxOutput", "Softmax"):
+        if p.get("multi_output"):
+            setvar(1, (data_shape[0],) + tuple(data_shape[2:]))
+        else:
+            setvar(1, data_shape[:-1])
+    elif op in ("LinearRegressionOutput", "LogisticRegressionOutput",
+                "MAERegressionOutput", "SVMOutput"):
+        setvar(1, data_shape)
     elif op == "Embedding":
         setvar(1, (int(p.get("input_dim")), int(p.get("output_dim"))))
     elif op == "LeakyReLU" and p.get("act_type") == "prelu":

@@ -1,0 +1,229 @@
+"""Gluon tests (ref: tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    x = nd.ones((2, 3))
+    y = net(x)
+    assert y.shape == (2, 4)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    assert_almost_equal(y.asnumpy(), x.asnumpy() @ w.T + b, rtol=1e-5)
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(4)
+    net.initialize()
+    y = net(nd.ones((2, 7)))
+    assert y.shape == (2, 4)
+    assert net.weight.shape == (4, 7)
+
+
+def test_sequential_mlp_training():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array(onp.random.randn(8, 4).astype("float32"))
+    y = nd.array(onp.array([0, 1] * 4, dtype="float32"))
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y).mean()
+        loss.backward()
+        trainer.step(8)
+        losses.append(loss.asscalar())
+    assert losses[-1] < losses[0]
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(3))
+    net.initialize()
+    x = nd.array(onp.random.randn(4, 5).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    jitted = net(x).asnumpy()
+    assert_almost_equal(eager, jitted, rtol=1e-5, atol=1e-6)
+    # again (cached path)
+    jitted2 = net(x).asnumpy()
+    assert_almost_equal(eager, jitted2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_training_grads():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((4, 3))
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    g = net.weight.grad()
+    assert g.shape == (2, 3)
+    assert float(onp.abs(g.asnumpy()).sum()) > 0
+
+
+def test_conv_pool_shapes():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D(2, 2))
+        net.add(nn.Conv2D(16, kernel_size=3, padding=1))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    net.initialize()
+    y = net(nd.ones((2, 3, 16, 16)))
+    assert y.shape == (2, 10)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.array(onp.random.randn(8, 4, 3, 3).astype("float32") * 3 + 1)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert float(onp.abs(rm).sum()) > 0  # stats moved
+    # inference uses running stats (no batch dependence)
+    out1 = bn(x[0:2]).asnumpy()
+    out2 = bn(x[0:2]).asnumpy()
+    assert_almost_equal(out1, out2)
+
+
+def test_dropout_train_vs_test():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = nd.ones((100, 100))
+    out_test = do(x).asnumpy()
+    assert_almost_equal(out_test, x.asnumpy())
+    with autograd.record():
+        out_train = do(x).asnumpy()
+    frac_zero = (out_train == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 6)
+    emb.initialize()
+    idx = nd.array([1, 2, 3])
+    out = emb(idx)
+    assert out.shape == (3, 6)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    f = str(tmp_path / "p.params")
+    net.save_parameters(f)
+    net2 = nn.Dense(3, in_units=2)
+    net2.load_parameters(f)
+    assert_almost_equal(net.weight.data().asnumpy(),
+                        net2.weight.data().asnumpy())
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4))
+        net.add(nn.Dense(4, in_units=4))
+    params = net.collect_params()
+    assert len(params) == 4
+    wparams = net.collect_params(".*weight")
+    assert len(wparams) == 2
+
+
+def test_constant_param():
+    class Net(nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.c = self.params.get_constant("const", nd.array([1.0, 2.0]))
+
+        def hybrid_forward(self, F, x, c):
+            return x + c
+
+    net = Net()
+    net.initialize()
+    out = net(nd.zeros((2,)))
+    assert out.asnumpy().tolist() == [1.0, 2.0]
+
+
+def test_lambda_blocks():
+    net = nn.HybridSequential()
+    net.add(nn.Lambda("tanh"))
+    net.add(nn.HybridLambda(lambda F, x: F.relu(x)))
+    out = net(nd.array([[-2.0, 2.0]]))
+    assert out.asnumpy()[0][0] == 0
+    assert out.asnumpy()[0][1] == pytest.approx(onp.tanh(2.0), rel=1e-5)
+
+
+def test_prelu_gelu_etc():
+    for blk in [nn.LeakyReLU(0.1), nn.ELU(), nn.SELU(), nn.GELU(),
+                nn.Swish()]:
+        blk.initialize()
+        out = blk(nd.array([[-1.0, 1.0]]))
+        assert out.shape == (1, 2)
+
+
+def test_losses():
+    pred = nd.array(onp.random.randn(4, 5).astype("float32"))
+    label = nd.array([0, 1, 2, 3])
+    for loss_fn in [gluon.loss.SoftmaxCrossEntropyLoss(),
+                    gluon.loss.L2Loss(), gluon.loss.L1Loss(),
+                    gluon.loss.HuberLoss(), gluon.loss.HingeLoss()]:
+        if isinstance(loss_fn, gluon.loss.SoftmaxCrossEntropyLoss):
+            out = loss_fn(pred, label)
+        else:
+            out = loss_fn(pred, nd.ones((4, 5)))
+        assert out.shape == (4,)
+        assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_sigmoid_bce_matches_manual():
+    loss_fn = gluon.loss.SigmoidBCELoss()
+    pred = nd.array([[0.5, -0.5]])
+    label = nd.array([[1.0, 0.0]])
+    out = loss_fn(pred, label).asnumpy()
+    p = 1 / (1 + onp.exp(-pred.asnumpy()))
+    expect = -(label.asnumpy() * onp.log(p)
+               + (1 - label.asnumpy()) * onp.log(1 - p)).mean(axis=1)
+    assert_almost_equal(out, expect, rtol=1e-5)
+
+
+def test_split_and_load():
+    data = nd.arange(8).reshape((8, 1))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert len(parts) == 1
+    total = gluon.utils.clip_global_norm([nd.ones((2, 2)), nd.ones((2,))],
+                                         1.0)
+    assert total == pytest.approx(onp.sqrt(6.0), rel=1e-5)
+
+
+def test_trainer_adam():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = nd.ones((4, 2))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(4)
+    assert not onp.allclose(w_before, net.weight.data().asnumpy())

@@ -1,0 +1,187 @@
+"""Symbol + Executor + Module tests (ref: tests/python/unittest/
+test_symbol.py, test_executor.py, test_module.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io.io import DataBatch, NDArrayIter
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp_symbol(num_hidden=16, num_classes=3):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    act1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act1, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_symbol_compose_and_arguments():
+    s = _mlp_symbol()
+    args = s.list_arguments()
+    assert "data" in args
+    assert "fc1_weight" in args and "fc1_bias" in args
+    assert "fc2_weight" in args
+    assert "softmax_label" in args
+
+
+def test_infer_shape():
+    s = _mlp_symbol()
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(data=(8, 10))
+    args = s.list_arguments()
+    shapes = dict(zip(args, arg_shapes))
+    assert shapes["fc1_weight"] == (16, 10)
+    assert shapes["fc2_weight"] == (3, 16)
+    assert out_shapes[0] == (8, 3)
+
+
+def test_simple_bind_forward_backward():
+    s = _mlp_symbol()
+    ex = s.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    for name in ("fc1_weight", "fc2_weight"):
+        ex.arg_dict[name][:] = onp.random.randn(
+            *ex.arg_dict[name].shape).astype("float32") * 0.1
+    ex.arg_dict["data"][:] = onp.random.randn(4, 10).astype("float32")
+    ex.arg_dict["softmax_label"][:] = onp.array([0, 1, 2, 0],
+                                                dtype="float32")
+    outs = ex.forward(is_train=True)
+    assert outs[0].shape == (4, 3)
+    assert_almost_equal(outs[0].asnumpy().sum(axis=1), onp.ones(4),
+                        rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["fc1_weight"].asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_symbol_arith_and_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2 * a + b / a - 3
+    ex = c.bind(mx.cpu(), {"a": nd.array([2.0]), "b": nd.array([4.0])},
+                grad_req="null")
+    out = ex.forward()[0]
+    assert out.asscalar() == pytest.approx(2 * 2 + 4 / 2 - 3)
+
+
+def test_symbol_json_roundtrip():
+    s = _mlp_symbol()
+    js = s.tojson()
+    s2 = sym.load_json(js)
+    assert s2.list_arguments() == s.list_arguments()
+    ex = s2.simple_bind(mx.cpu(), data=(2, 5), softmax_label=(2,))
+    assert ex.forward()[0].shape == (2, 3)
+
+
+def test_symbol_batchnorm_aux():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn")
+    out = sym.relu(bn)
+    assert set(out.list_auxiliary_states()) == {"bn_moving_mean",
+                                                "bn_moving_var"}
+    ex = out.simple_bind(mx.cpu(), data=(4, 3))
+    ex.arg_dict["data"][:] = onp.random.randn(4, 3).astype("float32") * 2
+    ex.forward(is_train=True)
+    # moving stats updated
+    assert onp.abs(ex.aux_dict["bn_moving_mean"].asnumpy()).sum() > 0
+
+
+def test_module_fit_mnist_like():
+    """Mini end-to-end: linearly separable data must reach >0.9 accuracy
+    (the MNIST MLP gate pattern, ref: tests/python/train/test_mlp.py:82)."""
+    onp.random.seed(0)
+    n, d = 400, 10
+    w_true = onp.random.randn(d, 3).astype("float32")
+    x = onp.random.randn(n, d).astype("float32")
+    y = onp.argmax(x @ w_true, axis=1).astype("float32")
+
+    train_iter = NDArrayIter(x, y, batch_size=40, shuffle=True)
+    s = _mlp_symbol(num_hidden=32, num_classes=3)
+    mod = mx.mod.Module(s, context=mx.cpu())
+    mod.fit(train_iter, num_epoch=12,
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(train_iter, "acc")
+    assert score[0][1] > 0.9, f"accuracy {score[0][1]} too low"
+
+
+def test_module_predict():
+    s = _mlp_symbol()
+    x = onp.random.randn(10, 8).astype("float32")
+    data_iter = NDArrayIter(x, onp.zeros(10, "float32"), batch_size=5)
+    mod = mx.mod.Module(s, context=mx.cpu())
+    mod.bind(data_shapes=data_iter.provide_data,
+             label_shapes=data_iter.provide_label)
+    mod.init_params()
+    out = mod.predict(data_iter)
+    assert out.shape == (10, 3)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    prefix = str(tmp_path / "model")
+    s = _mlp_symbol()
+    data_iter = NDArrayIter(onp.random.randn(8, 6).astype("float32"),
+                            onp.zeros(8, "float32"), batch_size=4)
+    mod = mx.mod.Module(s, context=mx.cpu())
+    mod.bind(data_shapes=data_iter.provide_data,
+             label_shapes=data_iter.provide_label)
+    mod.init_params()
+    mod.save_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(data_shapes=data_iter.provide_data,
+              label_shapes=data_iter.provide_label)
+    p1, _ = mod.get_params()
+    p2, _ = mod2.get_params()
+    for k in p1:
+        assert_almost_equal(p1[k].asnumpy(), p2[k].asnumpy())
+
+
+def test_executor_reshape():
+    s = _mlp_symbol()
+    ex = s.simple_bind(mx.cpu(), data=(4, 10), softmax_label=(4,))
+    ex2 = ex.reshape(data=(8, 10), softmax_label=(8,))
+    assert ex2.arg_dict["data"].shape == (8, 10)
+    assert ex2.arg_dict["fc1_weight"].shape == (16, 10)
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    out1 = sym.relu(a, name="r1")
+    out2 = sym.tanh(a, name="t1")
+    grp = sym.Group([out1, out2])
+    assert grp.num_outputs == 2
+    ex = grp.bind(mx.cpu(), {"a": nd.array([-1.0, 1.0])}, grad_req="null")
+    o1, o2 = ex.forward()
+    assert o1.asnumpy().tolist() == [0.0, 1.0]
+    assert_almost_equal(o2.asnumpy(), onp.tanh([-1.0, 1.0]), rtol=1e-5)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        pooled = sym.sum(data, axis=1, keepdims=True)  # len-invariant params
+        fc = sym.FullyConnected(pooled, num_hidden=4, name="fc")
+        out = sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    from mxnet_tpu.module import BucketingModule
+    mod = BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    batch = DataBatch(
+        data=[nd.ones((2, 10))], label=[nd.zeros((2,))], bucket_key=10,
+        provide_data=[("data", (2, 10))],
+        provide_label=[("softmax_label", (2,))])
+    mod.bind(data_shapes=[("data", (2, 10))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    mod.init_optimizer()
+    mod.forward(batch)
+    out = mod.get_outputs()[0]
+    assert out.shape == (2, 4)
+    mod.backward()
+    mod.update()
+    # switch bucket
+    batch5 = DataBatch(
+        data=[nd.ones((2, 5))], label=[nd.zeros((2,))], bucket_key=5,
+        provide_data=[("data", (2, 5))],
+        provide_label=[("softmax_label", (2,))])
+    mod.forward(batch5)
+    assert mod.get_outputs()[0].shape == (2, 4)
