@@ -118,6 +118,60 @@ def record_aux_update(param: Parameter, new_value: NDArray):
         param.data()._rebind(new_value._data)
 
 
+def functional_call(block, pvals: Dict[str, Any], args, training=False,
+                    rng_raw=None):
+    """Run `block.forward(*args)` as a pure function of parameter values.
+
+    The bridge between the stateful Gluon API and jax transforms: parameter
+    buffers are temporarily rebound to the provided (possibly traced)
+    values; mutable aux-state writes (BatchNorm stats) are captured and
+    returned instead of applied. Used by hybridize (jit), the parallel
+    train-step builders (pjit/shard_map), and checkpointing.
+
+    Returns (outputs: tuple of jax values, aux_updates: {param_name: value}).
+    """
+    from ..ndarray.ndarray import NDArray as _ND, _wrap as _w
+    plist = sorted(block._collect_params_with_prefix().items())
+    saved = [(p, p._data._data if p._data is not None else None)
+             for _, p in plist]
+    call_args = [_w(a) if (hasattr(a, "shape") and hasattr(a, "dtype")
+                           and not isinstance(a, _ND)) else a
+                 for a in args]
+    try:
+        for (n, p) in plist:
+            if p._data is not None and n in pvals:
+                p._data._data = pvals[n]
+        ctxs = []
+        tc_scope = nn_trace_ctx()
+        tc = tc_scope.__enter__()
+        try:
+            if rng_raw is not None:
+                rng_scope = _random.trace_rng(
+                    jax.random.wrap_key_data(rng_raw))
+                rng_scope.__enter__()
+            else:
+                rng_scope = None
+            try:
+                with autograd._Scope(False, training):
+                    out = block.forward(*call_args)
+            finally:
+                if rng_scope is not None:
+                    rng_scope.__exit__(None, None, None)
+            aux = {p.name: v for p, v in tc.aux_updates}
+            # map back to prefixed names used in pvals
+            name_of = {p.name: n for n, p in plist}
+            aux = {name_of.get(k, k): v for k, v in aux.items()}
+        finally:
+            tc_scope.__exit__(None, None, None)
+    finally:
+        for p, d in saved:
+            if d is not None:
+                p._data._data = d
+    single = not isinstance(out, (list, tuple))
+    outs = [out] if single else list(out)
+    return tuple(o._data for o in outs), aux
+
+
 class Block:
     """ref: block.py:131."""
 

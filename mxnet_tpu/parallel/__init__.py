@@ -1,0 +1,35 @@
+"""Parallelism: meshes, collectives, SPMD training, context/pipeline
+parallel (SURVEY.md §2.4 / §5.7 / §5.8 TPU-native plans)."""
+from .mesh import (  # noqa: F401
+    Mesh, NamedSharding, PartitionSpec, P, make_mesh, data_parallel_mesh,
+    local_mesh_devices,
+)
+from .collectives import (  # noqa: F401
+    allreduce, allgather, reduce_scatter, broadcast,
+    allreduce_across_processes, process_barrier,
+    grad_compression_2bit, grad_decompression_2bit,
+)
+from .train import (  # noqa: F401
+    ParallelTrainer, make_functional_optimizer, sgd_init, sgd_apply,
+    adam_init, adam_apply,
+)
+from .ring_attention import (  # noqa: F401
+    ring_attention, ulysses_attention, context_parallel_attention,
+    local_attention,
+)
+from .pipeline import pipeline_apply  # noqa: F401
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None, **kwargs):
+    """Multi-host init (ref role: ps-lite scheduler wiring via DMLC_* env,
+    python/mxnet/kvstore_server.py:76; here jax.distributed over DCN)."""
+    import os
+    import jax
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MX_COORDINATOR")
+    if coordinator_address is None:
+        return  # single-process
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)

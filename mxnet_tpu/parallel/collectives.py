@@ -1,0 +1,117 @@
+"""Collective-communication primitives.
+
+TPU-native replacement for the reference's three comm backends behind
+KVStore (ref: SURVEY.md §5.8 — in-process device comm `comm.h`, NCCL
+`kvstore_nccl.h`, ps-lite `kvstore_dist*.h`). All of them become XLA
+collectives compiled into the step function: psum/all_gather/
+reduce_scatter/ppermute over ICI; jax.distributed + a global mesh over DCN.
+This module exposes them with KVStore-era names for the compat layer and
+utility entry points for the dist kvstore.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast",
+           "allreduce_across_processes", "process_barrier",
+           "grad_compression_2bit", "grad_decompression_2bit"]
+
+
+def allreduce(x, axis_name: str):
+    """lax.psum — the whole KVStore push/pull collapses into this
+    (SURVEY.md §3.5 'TPU mapping')."""
+    return jax.lax.psum(x, axis_name)
+
+
+def allgather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+def broadcast(x, axis_name: str, root: int = 0):
+    """ncclBcast analog (ref: kvstore_nccl.h:402)."""
+    idx = jax.lax.axis_index(axis_name)
+    src = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(src, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# cross-process helpers used by KVStoreDist (DCN path)
+# ---------------------------------------------------------------------------
+
+def _global_mesh():
+    devs = onp.asarray(jax.devices())
+    return Mesh(devs, ("all",))
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_fn(shape, dtype):
+    mesh = _global_mesh()
+
+    @functools.partial(
+        jax.jit,
+        out_shardings=NamedSharding(mesh, P()))
+    def fn(x):
+        return x  # replicated out_sharding forces the cross-device reduce
+
+    return fn, mesh
+
+
+def allreduce_across_processes(x):
+    """Sum `x` (same shape on every process) across all processes.
+
+    ref role: ps-lite ZPush+server-accumulate+ZPull
+    (src/kvstore/kvstore_dist.h:411, kvstore_dist_server.h:346). Here a
+    tiny jitted psum program over the global device mesh."""
+    if jax.process_count() <= 1:
+        return x
+    mesh = _global_mesh()
+    n = len(jax.devices())
+
+    def local_sum(v):
+        return jax.lax.psum(v, "all")
+
+    f = jax.jit(
+        jax.shard_map(local_sum, mesh=mesh, in_specs=P(),
+                      out_specs=P(), check_vma=False))
+    return f(x) / 1  # already summed; every process holds the result
+
+
+def process_barrier():
+    """ref: ps::Postoffice::Barrier (kvstore_dist.h:53)."""
+    if jax.process_count() <= 1:
+        return
+    # a tiny allreduce acts as a barrier
+    allreduce_across_processes(jnp.zeros((1,), jnp.float32)).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# 2-bit gradient compression (ref: src/kvstore/gradient_compression.h:38-132
+# — stochastic-threshold 2-bit quantization with error feedback, used on the
+# DCN path). Kept as an optional codec; pure jax so it fuses into the step.
+# ---------------------------------------------------------------------------
+
+def grad_compression_2bit(grad, residual, threshold: float = 0.5):
+    """Quantize grad+residual to {-threshold, 0, +threshold}; returns
+    (quantized_values, new_residual). Matches compute_expected_2bit_
+    quantization in tests/nightly/dist_sync_kvstore.py."""
+    acc = grad + residual
+    q = jnp.where(acc >= threshold, threshold,
+                  jnp.where(acc <= -threshold, -threshold, 0.0))
+    new_residual = acc - q
+    return q.astype(grad.dtype), new_residual.astype(grad.dtype)
+
+
+def grad_decompression_2bit(q):
+    return q

@@ -1,0 +1,63 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's device topology machinery
+(ref: src/kvstore/gpu_topology.h:1101 ComputeTrees — PCIe/NVLink spanning
+trees for reduction). On TPU the topology is the ICI torus and the
+abstraction is jax.sharding.Mesh: named axes ('data', 'model', 'seq',
+'pipe', 'expert') over which pjit/shard_map place collectives
+(SURVEY.md §2.4 "TPU-native plan" column).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "data_parallel_mesh", "Mesh", "NamedSharding",
+           "PartitionSpec", "P", "local_mesh_devices"]
+
+P = PartitionSpec
+
+
+def local_mesh_devices(n: Optional[int] = None):
+    devs = jax.devices()
+    if n is not None:
+        if len(devs) < n:
+            raise ValueError(
+                f"requested {n} devices but only {len(devs)} present; for "
+                f"CPU testing set XLA_FLAGS=--xla_force_host_platform_"
+                f"device_count={n}")
+        devs = devs[:n]
+    return devs
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh with named axes, e.g. {'data': 4, 'model': 2}.
+
+    Axis sizes of -1 are inferred from the device count (at most one).
+    Axis order follows dict order: put the fastest-varying (most
+    bandwidth-hungry, e.g. 'model'/'seq') axes last so they map to
+    nearest-neighbour ICI links.
+    """
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = int(onp.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(onp.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {n}")
+    mesh_devs = onp.asarray(devices[:total]).reshape(sizes)
+    return Mesh(mesh_devs, tuple(names))
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    devs = local_mesh_devices(n)
+    return make_mesh({"data": len(devs)}, devs)

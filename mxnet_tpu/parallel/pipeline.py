@@ -1,0 +1,80 @@
+"""Pipeline parallelism (GPipe-style microbatching over a 'pipe' mesh axis).
+
+The reference has NO pipeline parallelism (SURVEY.md §2.4 — closest is
+staged PartialForward, graph_executor.cc:82). TPU-native design: each
+device on the 'pipe' axis owns one stage's parameters; microbatches stream
+through via lax.ppermute inside shard_map, with a lax.scan over
+(num_microbatches + num_stages - 1) ticks — the standard GPipe schedule
+expressed as a compiler-visible loop.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
+                   pipe_axis: str = "pipe", num_microbatches: int = 1):
+    """Run a homogeneous-stage pipeline.
+
+    stage_fn(params_i, h) -> h : one stage's computation (same signature on
+    every stage; heterogeneous pipelines wrap with lax.switch inside).
+    stage_params: pytree whose leaves have a leading stage dimension equal
+    to the 'pipe' axis size (sharded over that axis).
+    x: (num_microbatches * mb, ...) global input, replicated.
+    Returns the final stage's outputs re-assembled in order.
+    """
+    n_stage = mesh.shape[pipe_axis]
+    assert x.shape[0] % num_microbatches == 0
+    mb = x.shape[0] // num_microbatches
+
+    def local_fn(params, xloc):
+        # params: this stage's slice (leading dim 1) ; xloc: full input copy
+        params = jax.tree.map(lambda v: v[0], params)
+        idx = jax.lax.axis_index(pipe_axis)
+        micro = xloc.reshape((num_microbatches, mb) + xloc.shape[1:])
+        n_tick = num_microbatches + n_stage - 1
+        buf = jnp.zeros((mb,) + xloc.shape[1:], xloc.dtype)
+        outs = jnp.zeros((num_microbatches, mb) + xloc.shape[1:], xloc.dtype)
+        perm = [(j, (j + 1) % n_stage) for j in range(n_stage)]
+
+        def tick(t, carry):
+            buf, outs = carry
+            # stage 0 ingests microbatch t (if in range)
+            feed = micro[jnp.clip(t, 0, num_microbatches - 1)]
+            h_in = jnp.where(idx == 0,
+                             jnp.where(t < num_microbatches, feed, buf),
+                             buf)
+            h_out = stage_fn(params, h_in)
+            # last stage emits microbatch t-(n_stage-1)
+            out_t = t - (n_stage - 1)
+            emit = jnp.logical_and(idx == n_stage - 1,
+                                   jnp.logical_and(out_t >= 0,
+                                                   out_t < num_microbatches))
+            outs = jax.lax.cond(
+                emit,
+                lambda o: o.at[jnp.clip(out_t, 0, num_microbatches - 1)]
+                .set(h_out),
+                lambda o: o, outs)
+            # shift activations to the next stage
+            buf = jax.lax.ppermute(h_out, pipe_axis, perm)
+            return (buf, outs)
+
+        buf, outs = jax.lax.fori_loop(0, n_tick, tick, (buf, outs))
+        # only the last stage holds real outputs; broadcast them
+        outs = jax.lax.psum(
+            jnp.where(idx == n_stage - 1, outs, jnp.zeros_like(outs)),
+            pipe_axis)
+        return outs.reshape((num_microbatches * mb,) + xloc.shape[1:])
+
+    mapped = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(pipe_axis), stage_params), P()),
+        out_specs=P(), check_vma=False)
+    return mapped(stage_params, x)

@@ -1,0 +1,143 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO long-context parallelism (SURVEY.md §5.7 — 2019-era:
+bucketing and fused RNNs only); this module is the mandated
+beyond-reference capability. Two interchangeable strategies behind one
+`context_parallel_attention` entry point:
+
+- Ring attention: K/V blocks rotate around the ICI ring via lax.ppermute
+  while each device holds its Q shard; softmax is merged online
+  (log-sum-exp accumulation), so attention over sequence length P*T_local
+  needs only O(T_local^2) memory per device and fully overlappable
+  nearest-neighbour transfers.
+- Ulysses: lax.all_to_all swaps the sharded axis from sequence to heads,
+  runs dense local attention, and swaps back — cheaper at moderate
+  sequence lengths when heads >= devices.
+
+Both are pure jax and run inside shard_map over a 'seq' mesh axis.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention",
+           "context_parallel_attention", "local_attention"]
+
+
+def local_attention(q, k, v, scale=None, causal=False, q_offset=0,
+                    kv_offset=0):
+    """Plain attention on local blocks. q: (B,H,Tq,D), k/v: (B,H,Tk,D)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[2])
+        kpos = kv_offset + jnp.arange(k.shape[2])
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Executed per-device under shard_map. q/k/v: (B,H,T_loc,D)."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    scale_v = scale if scale is not None else 1.0 / jnp.sqrt(D)
+
+    # online-softmax accumulators
+    o = jnp.zeros((B, H, T, D), jnp.float32)
+    l = jnp.zeros((B, H, T), jnp.float32)          # sum of exp
+    m = jnp.full((B, H, T), -jnp.inf, jnp.float32)  # running max
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        src_idx = (my_idx - i) % axis_size  # whose K/V block we hold now
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale_v
+        logits = logits.astype(jnp.float32)
+        if causal:
+            qpos = my_idx * T + jnp.arange(T)
+            kpos = src_idx * T + jnp.arange(T)
+            mask = qpos[:, None] >= kpos[None, :]
+            logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        blk_max = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked blocks (max = -inf)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(logits - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+        corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+        new_l = l * corr + jnp.sum(p, axis=-1)
+        new_o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        # rotate K/V to the next device (nearest-neighbour ICI hop)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (new_o, new_l, new_m, k_next, v_next)
+
+    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, l, m, k, v))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                   causal: bool = False, scale: Optional[float] = None):
+    """q/k/v: (B, H, T_global, D) logically; sharded over `seq_axis` on the
+    T dimension. Returns attention output with the same sharding."""
+    fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                           causal=causal, scale=scale)
+    spec = P(None, None, seq_axis, None)
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    return mapped(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool,
+                   scale: Optional[float]):
+    """all_to_all: seq-sharded (B,H,T_loc,D) -> head-sharded full-T, dense
+    attention, back."""
+    # (B, H, T_loc, D) -> split H across devices, gather T
+    def seq2head(x):
+        # concat_axis gathers T (axis 2); split_axis scatters H (axis 1)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def head2seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    out = local_attention(qh, kh, vh, scale=scale, causal=causal)
+    return head2seq(out)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                      causal: bool = False, scale: Optional[float] = None):
+    fn = functools.partial(_ulysses_local, axis_name=seq_axis, causal=causal,
+                           scale=scale)
+    spec = P(None, None, seq_axis, None)
+    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    return mapped(q, k, v)
+
+
+def context_parallel_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                               causal: bool = False,
+                               scale: Optional[float] = None,
+                               strategy: str = "ring"):
+    """One entry point behind a `context_parallel` mesh axis
+    (SURVEY.md §5.7 plan)."""
+    if strategy == "ring":
+        return ring_attention(q, k, v, mesh, seq_axis, causal, scale)
+    if strategy in ("ulysses", "all_to_all"):
+        return ulysses_attention(q, k, v, mesh, seq_axis, causal, scale)
+    raise ValueError(f"unknown context-parallel strategy {strategy}")

@@ -1,0 +1,250 @@
+"""SPMD train-step builders: the TPU-native data-parallel path.
+
+This replaces the reference's entire data-parallel machinery —
+DataParallelExecutorGroup batch slicing (ref: python/mxnet/module/
+executor_group.py:282), KVStore comm trees (src/kvstore/comm.h:503,
+comm_tree.h), NCCL reduce (kvstore_nccl.h:285), and server-side optimizer
+(kvstore_dist_server.h:346) — with ONE pjit-compiled function over a named
+mesh: batch sharded on the 'data' axis, parameters replicated (or
+ZeRO-sharded), gradients reduced by XLA-inserted collectives riding ICI
+(SURVEY.md §3.5 'TPU mapping'). The optimizer runs inside the same XLA
+program (fused like src/operator/optimizer_op.cc kernels).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..gluon.block import functional_call
+from ..ndarray.ndarray import NDArray, _wrap
+from .. import random as _random
+
+__all__ = ["sgd_init", "sgd_apply", "adam_init", "adam_apply",
+           "make_functional_optimizer", "ParallelTrainer"]
+
+
+# ---------------------------------------------------------------------------
+# functional optimizers over pytrees (pure — live inside the jitted step)
+# ---------------------------------------------------------------------------
+
+def sgd_init(params, momentum=0.0, **kw):
+    if momentum == 0.0:
+        return {}
+    return {"mom": jax.tree.map(jnp.zeros_like, params)}
+
+
+def sgd_apply(params, grads, state, lr=0.01, momentum=0.0, wd=0.0,
+              clip_gradient=None, **kw):
+    def upd(w, g, m):
+        if clip_gradient is not None and clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        if m is None:
+            return w - lr * g, None
+        new_m = momentum * m - lr * g
+        return w + new_m, new_m
+
+    if not state:
+        new = jax.tree.map(lambda w, g: upd(w, g, None)[0], params, grads)
+        return new, state
+    out = jax.tree.map(lambda w, g, m: upd(w, g, m), params, grads,
+                       state["mom"])
+    new_params = jax.tree.map(lambda _, o: o[0], params, out)
+    new_mom = jax.tree.map(lambda _, o: o[1], params, out)
+    return new_params, {"mom": new_mom}
+
+
+def adam_init(params, **kw):
+    zeros = functools.partial(jax.tree.map, jnp.zeros_like)
+    return {"mean": zeros(params), "var": zeros(params),
+            "t": jnp.zeros((), jnp.int32)}
+
+
+def adam_apply(params, grads, state, lr=0.001, beta1=0.9, beta2=0.999,
+               epsilon=1e-8, wd=0.0, clip_gradient=None, **kw):
+    t = state["t"] + 1
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+
+    def upd(w, g, m, v):
+        if clip_gradient is not None and clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        new_m = beta1 * m + (1 - beta1) * g
+        new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+        new_w = w - lr_t * new_m / (jnp.sqrt(new_v) + epsilon)
+        return new_w, new_m, new_v
+
+    out = jax.tree.map(upd, params, grads, state["mean"], state["var"])
+    pick = lambda i: jax.tree.map(lambda _, o: o[i], params, out)  # noqa: E731
+    return pick(0), {"mean": pick(1), "var": pick(2), "t": t}
+
+
+_FUNCTIONAL_OPTS = {
+    "sgd": (sgd_init, sgd_apply),
+    "adam": (adam_init, adam_apply),
+}
+
+
+def make_functional_optimizer(name: str):
+    if name not in _FUNCTIONAL_OPTS:
+        raise MXNetError(f"functional optimizer '{name}' not available "
+                         f"(have {sorted(_FUNCTIONAL_OPTS)})")
+    return _FUNCTIONAL_OPTS[name]
+
+
+# ---------------------------------------------------------------------------
+# ParallelTrainer
+# ---------------------------------------------------------------------------
+
+def _zero_spec(params: Dict[str, Any], mesh: Mesh, axis: str):
+    """ZeRO-1-style optimizer-state sharding spec: shard dim0 when it
+    divides the data-axis size (the 'optimizer state sharding supersedes
+    server-side update' plan, SURVEY.md §2.4)."""
+    n = mesh.shape[axis]
+
+    def spec(v):
+        if hasattr(v, "shape") and v.ndim >= 1 and v.shape[0] % n == 0 \
+                and v.shape[0] > 0:
+            return NamedSharding(mesh, P(axis))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, params)
+
+
+class ParallelTrainer:
+    """Data-parallel (optionally ZeRO) trainer for a Gluon block.
+
+    Usage:
+        net.initialize(); trainer = ParallelTrainer(net, loss_fn, mesh=mesh)
+        loss = trainer.step(x, y)   # x NDArray with global batch
+
+    The whole step (forward, backward, allreduce, optimizer) is one XLA
+    executable; parameters live device-resident between steps.
+    """
+
+    def __init__(self, block, loss_fn, optimizer: str = "sgd",
+                 optimizer_params: Optional[dict] = None,
+                 mesh: Optional[Mesh] = None, batch_axis: str = "data",
+                 zero: bool = False, donate: bool = True,
+                 param_shardings: Optional[Dict[str, P]] = None):
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self.opt_params = dict(optimizer_params or {})
+        self.lr = self.opt_params.pop("learning_rate",
+                                      self.opt_params.pop("lr", 0.01))
+        self._init_fn, self._apply_fn = make_functional_optimizer(optimizer)
+
+        self._param_shardings = param_shardings
+        self._zero = zero
+        self.params = None
+        self.opt_state = None
+        self._compiled = None
+        try:
+            self._extract_params()
+        except Exception:
+            pass  # deferred shapes: resolved on first step()
+
+    def _extract_params(self):
+        block, mesh = self.block, self.mesh
+        zero, param_shardings = self._zero, self._param_shardings
+        batch_axis = self.batch_axis
+        plist = sorted(block._collect_params_with_prefix().items())
+        self.param_names = [n for n, _ in plist]
+        self._param_objs = dict(plist)
+        self.trainable = {n for n, p in plist if p.grad_req != "null"}
+        params = {n: p.data()._data for n, p in plist}
+        self.params = params
+        self.opt_state = self._init_fn(
+            {n: v for n, v in params.items() if n in self.trainable},
+            **self.opt_params)
+
+        if mesh is not None:
+            if param_shardings:
+                self._pspec = {
+                    n: NamedSharding(mesh, param_shardings.get(n, P()))
+                    for n in params}
+            else:
+                self._pspec = {n: NamedSharding(mesh, P()) for n in params}
+            self._dspec = NamedSharding(mesh, P(batch_axis))
+            if zero:
+                self._ospec = jax.tree.map(
+                    lambda _: None, self.opt_state)
+                self._ospec = _zero_spec(self.opt_state, mesh, batch_axis)
+            else:
+                self._ospec = jax.tree.map(
+                    lambda v: NamedSharding(mesh, P()), self.opt_state)
+            # place params on mesh
+            self.params = {n: jax.device_put(v, self._pspec[n])
+                           for n, v in params.items()}
+            self.opt_state = jax.tree.map(jax.device_put, self.opt_state,
+                                          self._ospec)
+
+    # ------------------------------------------------------------------
+    def _build(self, sample_x, sample_y):
+        block, loss_fn = self.block, self.loss_fn
+        trainable = sorted(self.trainable)
+        apply_fn = self._apply_fn
+        opt_params = self.opt_params
+
+        def pure_step(params, opt_state, x, y, rng, lr):
+            def loss_of(tparams):
+                allp = dict(params)
+                allp.update(tparams)
+                (out,), aux = functional_call(block, allp, [x],
+                                              training=True, rng_raw=rng)
+                loss_out, _ = functional_call(
+                    loss_fn, {}, [out, y], training=True)
+                return jnp.mean(loss_out[0]), aux
+
+            tparams = {n: params[n] for n in trainable}
+            (loss, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(tparams)
+            new_t, new_opt = apply_fn(tparams, grads, opt_state, lr=lr,
+                                      **opt_params)
+            new_params = dict(params)
+            new_params.update(new_t)
+            new_params.update(aux)  # running stats
+            return new_params, new_opt, loss
+
+        kwargs = {}
+        if self.mesh is not None:
+            kwargs["in_shardings"] = (self._pspec, self._ospec, self._dspec,
+                                      self._dspec, None, None)
+            kwargs["out_shardings"] = (self._pspec, self._ospec, None)
+        return jax.jit(pure_step, donate_argnums=(0, 1), **kwargs)
+
+    def step(self, x, y) -> float:
+        xv = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yv = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self.params is None:
+            # resolve deferred parameter shapes with one eager forward
+            from .. import autograd as _ag
+            with _ag.pause():
+                self.block(_wrap(xv[:1]))
+            self._extract_params()
+        if self._compiled is None:
+            self._compiled = self._build(xv, yv)
+        rng = jax.random.key_data(_random.next_key())
+        self.params, self.opt_state, loss = self._compiled(
+            self.params, self.opt_state, xv, yv, rng,
+            jnp.asarray(self.lr, jnp.float32))
+        return _wrap(loss)
+
+    def sync_to_block(self):
+        """Write trained values back into the Gluon parameters."""
+        for n, v in self.params.items():
+            self._param_objs[n].data()._rebind(v)
+
+    @property
+    def loss_and_params(self):
+        return self.params

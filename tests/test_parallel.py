@@ -1,0 +1,165 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (the analog of the
+reference's local multi-process distributed tests, SURVEY.md §4)."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import (
+    P, ParallelTrainer, context_parallel_attention, local_attention,
+    make_mesh, pipeline_apply, ring_attention, ulysses_attention,
+    grad_compression_2bit,
+)
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_make_mesh():
+    mesh = make_mesh({"data": 4, "model": 2})
+    assert mesh.shape == {"data": 4, "model": 2}
+    mesh2 = make_mesh({"data": -1})
+    assert mesh2.shape["data"] == len(jax.devices())
+
+
+def test_parallel_trainer_dp():
+    mesh = make_mesh({"data": 8})
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = ParallelTrainer(net, loss_fn, optimizer="sgd",
+                              optimizer_params={"learning_rate": 0.5},
+                              mesh=mesh)
+    onp.random.seed(0)
+    x = onp.random.randn(32, 4).astype("float32")
+    w = onp.random.randn(4, 2).astype("float32")
+    y = onp.argmax(x @ w, axis=1).astype("float32")
+    losses = [float(trainer.step(nd.array(x), nd.array(y)).asscalar())
+              for _ in range(40)]
+    assert losses[-1] < losses[0]
+    trainer.sync_to_block()
+    out = net(nd.array(x)).asnumpy()
+    acc = (out.argmax(axis=1) == y).mean()
+    assert acc > 0.8
+
+
+def test_parallel_trainer_matches_single_device():
+    """DP on 8 virtual devices must match the math of 1-device training."""
+    def make_net(seed):
+        onp.random.seed(seed)
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        net.weight.data()._rebind(
+            jnp.asarray(onp.random.randn(2, 3).astype("float32")))
+        net.bias.data()._rebind(jnp.zeros(2, jnp.float32))
+        return net
+
+    x = onp.random.RandomState(1).randn(16, 3).astype("float32")
+    y = onp.array([0, 1] * 8, "float32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net1 = make_net(42)
+    mesh = make_mesh({"data": 8})
+    t1 = ParallelTrainer(net1, loss_fn, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1}, mesh=mesh)
+    l_mesh = float(t1.step(nd.array(x), nd.array(y)).asscalar())
+
+    net2 = make_net(42)
+    t2 = ParallelTrainer(net2, loss_fn, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1}, mesh=None)
+    l_single = float(t2.step(nd.array(x), nd.array(y)).asscalar())
+
+    assert l_mesh == pytest.approx(l_single, rel=1e-5)
+    w1 = t1.params[sorted(t1.params)[0]]
+    w2 = t2.params[sorted(t2.params)[0]]
+    assert_almost_equal(onp.asarray(w1), onp.asarray(w2), rtol=1e-5,
+                        atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_local(causal):
+    mesh = make_mesh({"seq": 8})
+    B, H, T, D = 2, 4, 32, 16
+    rng = onp.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    ref = local_attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, seq_axis="seq", causal=causal)
+    assert_almost_equal(onp.asarray(out), onp.asarray(ref), rtol=2e-4,
+                        atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_local(causal):
+    mesh = make_mesh({"seq": 8})
+    B, H, T, D = 2, 8, 32, 16  # H divisible by mesh size
+    rng = onp.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    ref = local_attention(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, mesh, seq_axis="seq", causal=causal)
+    assert_almost_equal(onp.asarray(out), onp.asarray(ref), rtol=2e-4,
+                        atol=2e-5)
+
+
+def test_context_parallel_dispatch():
+    mesh = make_mesh({"seq": 8})
+    q = jnp.ones((1, 8, 16, 8), jnp.float32)
+    for strat in ("ring", "ulysses"):
+        out = context_parallel_attention(q, q, q, mesh, strategy=strat)
+        assert out.shape == q.shape
+
+
+def test_pipeline_apply():
+    mesh = make_mesh({"pipe": 4})
+    n_stage = 4
+    rng = onp.random.RandomState(0)
+    # each stage: h -> h @ W_i  (W stacked with leading stage dim)
+    Ws = jnp.asarray(rng.randn(n_stage, 8, 8).astype("float32") * 0.5)
+
+    def stage_fn(W, h):
+        return jnp.tanh(h @ W)
+
+    x = jnp.asarray(rng.randn(16, 8).astype("float32"))
+    out = pipeline_apply(stage_fn, Ws, x, mesh, pipe_axis="pipe",
+                         num_microbatches=4)
+    ref = x
+    for i in range(n_stage):
+        ref = jnp.tanh(ref @ Ws[i])
+    assert_almost_equal(onp.asarray(out), onp.asarray(ref), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_grad_compression_2bit():
+    """Matches compute_expected_2bit_quantization semantics
+    (ref: tests/nightly/dist_sync_kvstore.py)."""
+    grad = jnp.asarray([0.7, -0.6, 0.2, -0.1], jnp.float32)
+    residual = jnp.zeros(4, jnp.float32)
+    q, r = grad_compression_2bit(grad, residual, threshold=0.5)
+    assert onp.asarray(q).tolist() == [0.5, -0.5, 0.0, 0.0]
+    assert_almost_equal(onp.asarray(r), [0.2, -0.1, 0.2, -0.1], rtol=1e-6)
+    # error feedback accumulates
+    q2, r2 = grad_compression_2bit(grad, r, threshold=0.5)
+    assert onp.asarray(q2).tolist() == [0.5, -0.5, 0.0, 0.0]
+
+
+def test_zero_sharding():
+    mesh = make_mesh({"data": 8})
+    net = nn.Dense(8, in_units=16)
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    trainer = ParallelTrainer(net, loss_fn, optimizer="adam",
+                              optimizer_params={"learning_rate": 0.01},
+                              mesh=mesh, zero=True)
+    x = nd.array(onp.random.randn(16, 16).astype("float32"))
+    y = nd.array(onp.random.randn(16, 8).astype("float32"))
+    l1 = trainer.step(x, y).asscalar()
+    l2 = trainer.step(x, y).asscalar()
+    assert l2 < l1
