@@ -1,0 +1,80 @@
+"""Benchmark: ResNet-50 training throughput (synthetic ImageNet batch).
+
+Mirrors the reference headline benchmark (`train_imagenet.py --benchmark`
+with SyntheticDataIter — example/image-classification/common/data.py:99).
+Baseline: 109 images/sec on K80, batch 32 (BASELINE.md single-device
+table, example/image-classification/README.md:149-156).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as onp
+
+BASELINE_IMG_PER_SEC = 109.0  # resnet-50, K80, batch 32
+BATCH = 32
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    on_accel = bool(accel)
+    cpu_dev = jax.local_devices(backend="cpu")[0] if on_accel else \
+        jax.devices()[0]
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import ParallelTrainer
+
+    # All eager work (init, deferred-shape resolution) on host — avoid
+    # per-op roundtrips to the accelerator; transfer params once.
+    with jax.default_device(cpu_dev):
+        net = resnet50_v1(classes=1000)
+        net.initialize()
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = ParallelTrainer(net, loss_fn, optimizer="sgd",
+                                  optimizer_params={"learning_rate": 0.05,
+                                                    "momentum": 0.9})
+        rng = onp.random.RandomState(0)
+        xv = jnp.asarray(rng.uniform(-1, 1, size=(BATCH, 3, 224, 224))
+                         .astype("float32"))
+        yv = jnp.asarray(rng.randint(0, 1000, size=(BATCH,))
+                         .astype("float32"))
+        net(nd.array(xv[:1]))  # resolve deferred shapes on host
+        trainer._extract_params()
+
+    if on_accel:
+        dev = accel[0]
+        trainer.params = jax.device_put(trainer.params, dev)
+        trainer.opt_state = jax.device_put(trainer.opt_state, dev)
+        xv = jax.device_put(xv, dev)
+        yv = jax.device_put(yv, dev)
+    x, y = nd.array(xv), nd.array(yv)
+
+    # warmup (compile)
+    for _ in range(2):
+        trainer.step(x, y).wait_to_read()
+
+    n_steps = 20 if on_accel else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_per_sec = n_steps * BATCH / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

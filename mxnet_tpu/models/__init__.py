@@ -1,0 +1,5 @@
+from . import transformer  # noqa: F401
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerLM, BERTModel,
+    tensor_parallel_shardings,
+)

@@ -37,7 +37,14 @@ def next_key():
     if _STATE.trace_key is not None:
         _STATE.trace_counter += 1
         return jax.random.fold_in(_STATE.trace_key, _STATE.trace_counter)
-    _STATE.key, sub = jax.random.split(_STATE.key)
+    new_key, sub = jax.random.split(_STATE.key)
+    if isinstance(new_key, jax.core.Tracer):
+        # inside a jit trace with no explicit key argument (e.g. a plain
+        # jax.jit around an inference forward): never store a tracer in
+        # the global state — derive a constant per-trace key instead
+        _STATE.trace_counter += 1
+        return jax.random.fold_in(jax.random.key(0), _STATE.trace_counter)
+    _STATE.key = new_key
     return sub
 
 
