@@ -16,6 +16,8 @@ from .context import Context, cpu, gpu, tpu, current_context, num_gpus  # noqa: 
 
 from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
+from . import numpy as np  # noqa: F401
+from . import numpy_extension as npx  # noqa: F401
 from .ndarray.ndarray import NDArray  # noqa: F401
 
 from . import autograd  # noqa: F401

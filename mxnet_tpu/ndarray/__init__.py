@@ -33,3 +33,20 @@ def zeros_like(data, **kw):
 def ones_like(data, **kw):
     import jax.numpy as jnp
     return invoke(lambda x: jnp.ones_like(x), [data])
+
+
+class _Contrib:
+    """nd.contrib namespace: `_contrib_*` ops + control flow helpers
+    (ref: python/mxnet/ndarray/contrib.py)."""
+
+    def __getattr__(self, name):
+        if name in ("foreach", "while_loop", "cond"):
+            from ..ops import control_flow as _cf
+            return getattr(_cf, name)
+        for cand in (f"_contrib_{name}", name):
+            if hasattr(_mod, cand):
+                return getattr(_mod, cand)
+        raise AttributeError(name)
+
+
+contrib = _Contrib()

@@ -92,7 +92,11 @@ def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
     else:
         call = fn
     in_arrays = [i._data for i in inputs]
-    out = call(*in_arrays)
+    was_recording = autograd.set_recording(False)  # no nested recording:
+    try:   # ops whose impls re-enter the nd layer (control flow bodies)
+        out = call(*in_arrays)  # must not write tracer nodes to the tape
+    finally:
+        autograd.set_recording(was_recording)
     outs = list(out) if isinstance(out, (tuple, list)) else [out]
     if autograd.is_recording():
         # identity-like ops may return the input buffer itself; give such

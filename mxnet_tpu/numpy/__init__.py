@@ -1,0 +1,363 @@
+"""mx.np: NumPy-compatible array namespace.
+
+ref: python/mxnet/numpy/ + src/operator/numpy/ (SURVEY.md §2.2/§2.3 —
+`_np_*`/`_npi_*` ops, mx.np.ndarray with true scalars/zero-dim arrays).
+TPU-native: jax.numpy *is* a NumPy-compatible trace-friendly namespace, so
+this module wraps it behind the `mx.np` array type (an NDArray subclass
+with numpy-style semantics — comparisons return bool arrays, reductions
+return scalars-as-0d, python-operator broadcasting unrestricted).
+"""
+from __future__ import annotations
+
+import sys as _sys
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import (NDArray, _canon_dtype, _place, _wrap,
+                               invoke as _invoke)
+
+pi = onp.pi
+e = onp.e
+inf = onp.inf
+nan = onp.nan
+newaxis = None
+
+float32 = onp.float32
+float64 = onp.float64
+float16 = onp.float16
+int8 = onp.int8
+int32 = onp.int32
+int64 = onp.int64
+uint8 = onp.uint8
+bool_ = onp.bool_
+
+
+class ndarray(NDArray):
+    """mx.np array: numpy semantics (ref: python/mxnet/numpy/multiarray.py).
+    Comparisons return bool arrays (unlike mx.nd's same-dtype floats)."""
+
+    __slots__ = ()
+
+    def _cmp(self, other, fn):
+        from ..ndarray.ndarray import _coerce_operand
+        other = _coerce_operand(other, self)
+        return _invoke(lambda a, b: fn(a, b), [self, other],
+                       differentiable=False)
+
+    def __eq__(self, o):
+        return self._cmp(o, jnp.equal)
+
+    def __ne__(self, o):
+        return self._cmp(o, jnp.not_equal)
+
+    def __lt__(self, o):
+        return self._cmp(o, jnp.less)
+
+    def __le__(self, o):
+        return self._cmp(o, jnp.less_equal)
+
+    def __gt__(self, o):
+        return self._cmp(o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._cmp(o, jnp.greater_equal)
+
+    def __hash__(self):
+        return id(self)
+
+    def as_nd_ndarray(self):
+        out = NDArray.__new__(NDArray)
+        out._data = self._data
+        out._grad = self._grad
+        out._grad_req = self._grad_req
+        out._pending_grad = None
+        out._writeback = None
+        return out
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+
+def _np_wrap(data) -> ndarray:
+    out = ndarray.__new__(ndarray)
+    out._data = data
+    out._grad = None
+    out._grad_req = "null"
+    out._pending_grad = None
+    out._writeback = None
+    return out
+
+
+def array(obj, dtype=None, ctx=None):
+    if isinstance(obj, NDArray):
+        obj = obj._data
+    return _np_wrap(_place(jnp.asarray(obj, _canon_dtype(dtype)), ctx))
+
+
+def zeros(shape, dtype=None, order="C", ctx=None):
+    return _np_wrap(_place(jnp.zeros(shape, _canon_dtype(dtype)
+                                     or jnp.float32), ctx))
+
+
+def ones(shape, dtype=None, order="C", ctx=None):
+    return _np_wrap(_place(jnp.ones(shape, _canon_dtype(dtype)
+                                    or jnp.float32), ctx))
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None):
+    return _np_wrap(_place(jnp.full(shape, fill_value,
+                                    _canon_dtype(dtype)), ctx))
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return _np_wrap(_place(jnp.arange(start, stop, step,
+                                      _canon_dtype(dtype)), ctx))
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):
+    return _np_wrap(_place(jnp.eye(N, M, k, _canon_dtype(dtype)
+                                   or jnp.float32), ctx))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=_canon_dtype(dtype), axis=axis)
+    if retstep:
+        return _np_wrap(_place(out[0], ctx)), out[1]
+    return _np_wrap(_place(out, ctx))
+
+
+def _unary(jfn):
+    def f(x, out=None, **kwargs):
+        if not isinstance(x, NDArray):
+            x = array(x)
+        res = _invoke(lambda a: jfn(a, **kwargs), [x])
+        return _np_wrap(res._data)
+    return f
+
+
+def _binary(jfn):
+    def f(x1, x2, out=None, **kwargs):
+        if not isinstance(x1, NDArray):
+            x1 = array(x1)
+        if not isinstance(x2, NDArray):
+            x2 = array(x2, dtype=str(x1.dtype))
+        res = _invoke(lambda a, b: jfn(a, b, **kwargs), [x1, x2])
+        return _np_wrap(res._data)
+    return f
+
+
+# elementwise + reductions generated from jax.numpy (SURVEY.md Appendix A
+# "NumPy namespace" op list)
+_UNARY_NAMES = [
+    "abs", "absolute", "sign", "sqrt", "cbrt", "square", "exp", "expm1",
+    "log", "log2", "log10", "log1p", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh", "arccosh",
+    "arctanh", "degrees", "radians", "floor", "ceil", "rint", "trunc",
+    "negative", "reciprocal", "logical_not", "isnan", "isinf", "isfinite",
+]
+_BINARY_NAMES = [
+    "add", "subtract", "multiply", "divide", "true_divide", "mod",
+    "remainder", "power", "maximum", "minimum", "hypot", "arctan2",
+    "logical_and", "logical_or", "logical_xor", "equal", "not_equal",
+    "greater", "greater_equal", "less", "less_equal", "floor_divide",
+    "lcm", "gcd", "bitwise_and", "bitwise_or", "bitwise_xor", "copysign",
+    "ldexp",
+]
+
+_mod = _sys.modules[__name__]
+for _name in _UNARY_NAMES:
+    setattr(_mod, _name, _unary(getattr(jnp, _name)))
+for _name in _BINARY_NAMES:
+    setattr(_mod, _name, _binary(getattr(jnp, _name)))
+
+
+def sum(a, axis=None, dtype=None, keepdims=False, **kw):  # noqa: A001
+    return _np_wrap(_invoke(lambda x: jnp.sum(x, axis=axis, dtype=dtype,
+                                              keepdims=keepdims), [a])._data)
+
+
+def mean(a, axis=None, dtype=None, keepdims=False, **kw):
+    return _np_wrap(_invoke(lambda x: jnp.mean(x, axis=axis, dtype=dtype,
+                                               keepdims=keepdims),
+                            [a])._data)
+
+
+def max(a, axis=None, keepdims=False, **kw):  # noqa: A001
+    return _np_wrap(_invoke(lambda x: jnp.max(x, axis=axis,
+                                              keepdims=keepdims), [a])._data)
+
+
+def min(a, axis=None, keepdims=False, **kw):  # noqa: A001
+    return _np_wrap(_invoke(lambda x: jnp.min(x, axis=axis,
+                                              keepdims=keepdims), [a])._data)
+
+
+def prod(a, axis=None, keepdims=False, **kw):
+    return _np_wrap(_invoke(lambda x: jnp.prod(x, axis=axis,
+                                               keepdims=keepdims),
+                            [a])._data)
+
+
+def std(a, axis=None, ddof=0, keepdims=False, **kw):
+    return _np_wrap(_invoke(lambda x: jnp.std(x, axis=axis, ddof=ddof,
+                                              keepdims=keepdims), [a])._data)
+
+
+def var(a, axis=None, ddof=0, keepdims=False, **kw):
+    return _np_wrap(_invoke(lambda x: jnp.var(x, axis=axis, ddof=ddof,
+                                              keepdims=keepdims), [a])._data)
+
+
+def argmax(a, axis=None, **kw):
+    return _np_wrap(_invoke(lambda x: jnp.argmax(x, axis=axis), [a],
+                            differentiable=False)._data)
+
+
+def argmin(a, axis=None, **kw):
+    return _np_wrap(_invoke(lambda x: jnp.argmin(x, axis=axis), [a],
+                            differentiable=False)._data)
+
+
+def dot(a, b, out=None):
+    return _np_wrap(_invoke(jnp.dot, [a, b])._data)
+
+
+def matmul(a, b, out=None):
+    return _np_wrap(_invoke(jnp.matmul, [a, b])._data)
+
+
+def tensordot(a, b, axes=2):
+    return _np_wrap(_invoke(lambda x, y: jnp.tensordot(x, y, axes=axes),
+                            [a, b])._data)
+
+
+def einsum(subscripts, *operands, **kwargs):
+    return _np_wrap(_invoke(lambda *ops: jnp.einsum(subscripts, *ops),
+                            list(operands))._data)
+
+
+def concatenate(seq, axis=0, out=None):
+    return _np_wrap(_invoke(lambda *xs: jnp.concatenate(xs, axis=axis),
+                            list(seq))._data)
+
+
+def stack(arrays, axis=0, out=None):
+    return _np_wrap(_invoke(lambda *xs: jnp.stack(xs, axis=axis),
+                            list(arrays))._data)
+
+
+def split(ary, indices_or_sections, axis=0):
+    outs = _invoke(lambda x: tuple(jnp.split(x, indices_or_sections,
+                                             axis=axis)), [ary])
+    return [_np_wrap(o._data) for o in outs]
+
+
+def reshape(a, newshape, order="C"):
+    return _np_wrap(_invoke(lambda x: jnp.reshape(x, newshape), [a])._data)
+
+
+def transpose(a, axes=None):
+    return _np_wrap(_invoke(lambda x: jnp.transpose(x, axes), [a])._data)
+
+
+def swapaxes(a, axis1, axis2):
+    return _np_wrap(_invoke(lambda x: jnp.swapaxes(x, axis1, axis2),
+                            [a])._data)
+
+
+def expand_dims(a, axis):
+    return _np_wrap(_invoke(lambda x: jnp.expand_dims(x, axis), [a])._data)
+
+
+def squeeze(a, axis=None):
+    return _np_wrap(_invoke(lambda x: jnp.squeeze(x, axis), [a])._data)
+
+
+def broadcast_to(a, shape):
+    return _np_wrap(_invoke(lambda x: jnp.broadcast_to(x, shape),
+                            [a])._data)
+
+
+def where(condition, x=None, y=None):
+    if x is None:
+        return _np_wrap(_invoke(
+            lambda c: jnp.stack(jnp.nonzero(c)), [condition],
+            differentiable=False)._data)
+    if not isinstance(x, NDArray):
+        x = array(x)
+    if not isinstance(y, NDArray):
+        y = array(y)
+    return _np_wrap(_invoke(lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                            [condition, x, y])._data)
+
+
+def clip(a, a_min, a_max, out=None):
+    return _np_wrap(_invoke(lambda x: jnp.clip(x, a_min, a_max), [a])._data)
+
+
+def cumsum(a, axis=None, dtype=None, out=None):
+    return _np_wrap(_invoke(lambda x: jnp.cumsum(x, axis=axis, dtype=dtype),
+                            [a])._data)
+
+
+def copy(a):
+    return _np_wrap(_invoke(jnp.copy, [a])._data)
+
+
+def zeros_like(a, dtype=None):
+    return _np_wrap(jnp.zeros_like(a._data, _canon_dtype(dtype)))
+
+
+def ones_like(a, dtype=None):
+    return _np_wrap(jnp.ones_like(a._data, _canon_dtype(dtype)))
+
+
+def tile(a, reps):
+    return _np_wrap(_invoke(lambda x: jnp.tile(x, reps), [a])._data)
+
+
+def repeat(a, repeats, axis=None):
+    return _np_wrap(_invoke(lambda x: jnp.repeat(x, repeats, axis=axis),
+                            [a])._data)
+
+
+def unique(ar, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    res = onp.unique(ar.asnumpy(), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(array(r) for r in res)
+    return array(res)
+
+
+def may_share_memory(a, b):
+    return False
+
+
+# random sub-namespace (ref: python/mxnet/numpy/random.py)
+class _NPRandom:
+    def __getattr__(self, name):
+        from .. import random as _r
+
+        def call(*args, size=None, **kwargs):
+            if size is not None:
+                kwargs["shape"] = size
+            out = getattr(_r, name)(*args, **kwargs)
+            if isinstance(out, NDArray):
+                return _np_wrap(out._data)
+            return out
+        return call
+
+
+random = _NPRandom()

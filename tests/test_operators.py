@@ -1,0 +1,293 @@
+"""Operator tests (ref: tests/python/unittest/test_operator.py — the
+reference's biggest test file; numpy-reference comparisons + gradient
+checks over the op corpus)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_fully_connected():
+    x = nd.array(onp.random.randn(4, 5).astype("float32"))
+    w = nd.array(onp.random.randn(3, 5).astype("float32"))
+    b = nd.array(onp.random.randn(3).astype("float32"))
+    out = nd.FullyConnected(x, w, b, num_hidden=3)
+    assert_almost_equal(out.asnumpy(),
+                        x.asnumpy() @ w.asnumpy().T + b.asnumpy(),
+                        rtol=1e-5, atol=1e-6)
+    out2 = nd.FullyConnected(x, w, num_hidden=3, no_bias=True)
+    assert_almost_equal(out2.asnumpy(), x.asnumpy() @ w.asnumpy().T,
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_convolution_shapes_and_value():
+    x = nd.ones((1, 1, 4, 4))
+    w = nd.ones((2, 1, 3, 3))
+    out = nd.Convolution(x, w, kernel=(3, 3), num_filter=2, no_bias=True)
+    assert out.shape == (1, 2, 2, 2)
+    assert_almost_equal(out.asnumpy(), onp.full((1, 2, 2, 2), 9.0))
+    out_pad = nd.Convolution(x, w, kernel=(3, 3), num_filter=2,
+                             pad=(1, 1), stride=(2, 2), no_bias=True)
+    assert out_pad.shape == (1, 2, 2, 2)
+
+
+def test_convolution_grad():
+    x = nd.array(onp.random.randn(2, 2, 5, 5).astype("float32"))
+    w = nd.array(onp.random.randn(3, 2, 3, 3).astype("float32") * 0.4)
+    check_numeric_gradient(
+        lambda a, b: nd.Convolution(a, b, kernel=(3, 3), num_filter=3,
+                                    no_bias=True), [x, w],
+        rtol=2e-2, atol=2e-3)
+
+
+def test_deconvolution_inverts_shape():
+    x = nd.array(onp.random.randn(1, 4, 5, 5).astype("float32"))
+    w = nd.array(onp.random.randn(4, 3, 3, 3).astype("float32"))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=3, stride=(2, 2),
+                           no_bias=True)
+    assert out.shape == (1, 3, 11, 11)
+    # conv of the output shape gives back input spatial dims
+    w2 = nd.ones((4, 3, 3, 3))
+    back = nd.Convolution(out, w2, kernel=(3, 3), num_filter=4,
+                          stride=(2, 2), no_bias=True)
+    assert back.shape[2:] == (5, 5)
+
+
+def test_pooling():
+    x = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert mp.asnumpy().reshape(-1).tolist() == [5, 7, 13, 15]
+    ap = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert ap.asnumpy().reshape(-1).tolist() == [2.5, 4.5, 10.5, 12.5]
+    gp = nd.Pooling(x, global_pool=True, pool_type="max")
+    assert gp.asnumpy().reshape(-1).tolist() == [15]
+    # ceil mode (full convention)
+    x2 = nd.ones((1, 1, 5, 5))
+    full = nd.Pooling(x2, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                      pooling_convention="full")
+    assert full.shape == (1, 1, 3, 3)
+
+
+def test_batchnorm_modes():
+    x = nd.array(onp.random.randn(8, 3, 4, 4).astype("float32") * 2 + 3)
+    gamma, beta = nd.ones(3), nd.zeros(3)
+    mean, var = nd.zeros(3), nd.ones(3)
+    out, new_mean, new_var = nd.BatchNorm(
+        x, gamma, beta, mean, var, fix_gamma=False, _training=True)
+    got = out.asnumpy()
+    assert abs(got.mean()) < 1e-2
+    assert abs(got.std() - 1) < 1e-2
+
+
+def test_layernorm_groupnorm():
+    x = nd.array(onp.random.randn(4, 6).astype("float32"))
+    out = nd.LayerNorm(x, nd.ones(6), nd.zeros(6))
+    m = out.asnumpy().mean(axis=-1)
+    assert_almost_equal(m, onp.zeros(4), atol=1e-5)
+    x4 = nd.array(onp.random.randn(2, 4, 3, 3).astype("float32"))
+    gn = nd.GroupNorm(x4, nd.ones(4), nd.zeros(4), num_groups=2)
+    assert gn.shape == x4.shape
+
+
+def test_softmax_family():
+    x = nd.array([[1.0, 2.0, 3.0]])
+    sm = nd.softmax(x)
+    assert_almost_equal(sm.asnumpy().sum(), 1.0, rtol=1e-6)
+    lsm = nd.log_softmax(x)
+    assert_almost_equal(onp.exp(lsm.asnumpy()), sm.asnumpy(), rtol=1e-5)
+    smin = nd.softmin(x)
+    assert smin.asnumpy()[0, 0] == pytest.approx(
+        sm.asnumpy()[0, 2], rel=1e-5)
+    # masked softmax with length
+    x2 = nd.array(onp.random.randn(2, 5).astype("float32"))
+    out = nd.softmax(x2, nd.array([3, 5]), use_length=True, axis=-1)
+    assert out.asnumpy()[0, 3:].sum() == 0
+
+
+def test_embedding_and_grad():
+    w = nd.array(onp.random.randn(10, 4).astype("float32"))
+    idx = nd.array([1, 3, 1])
+    out = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+    assert_almost_equal(out.asnumpy()[0], w.asnumpy()[1])
+    w.attach_grad()
+    with mx.autograd.record():
+        y = nd.Embedding(idx, w, input_dim=10, output_dim=4).sum()
+    y.backward()
+    g = w.grad.asnumpy()
+    assert g[1].sum() == pytest.approx(8.0)  # row 1 used twice
+    assert g[3].sum() == pytest.approx(4.0)
+    assert g[0].sum() == 0
+
+
+def test_sequence_ops():
+    x = nd.array(onp.arange(12, dtype="float32").reshape(3, 2, 2))
+    ln = nd.array([2, 3])
+    masked = nd.SequenceMask(x, ln, use_sequence_length=True, value=-1)
+    assert (masked.asnumpy()[2, 0] == -1).all()
+    assert (masked.asnumpy()[2, 1] != -1).all()
+    last = nd.SequenceLast(x, ln, use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x.asnumpy()[1, 0])
+    assert_almost_equal(last.asnumpy()[1], x.asnumpy()[2, 1])
+    rev = nd.SequenceReverse(x, ln, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])
+
+
+def test_dropout_always_mode():
+    x = nd.ones((50, 50))
+    out = nd.Dropout(x, p=0.5, mode="always")
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_rnn_op_lstm():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, B, I, H = 5, 3, 4, 6
+    x = nd.array(onp.random.randn(T, B, I).astype("float32"))
+    psize = rnn_param_size("lstm", 1, I, H, False)
+    params = nd.array(onp.random.randn(psize).astype("float32") * 0.1)
+    h0 = nd.zeros((1, B, H))
+    c0 = nd.zeros((1, B, H))
+    out, h_out, c_out = nd.RNN(x, params, h0, c0, state_size=H,
+                               num_layers=1, mode="lstm")
+    assert out.shape == (T, B, H)
+    assert h_out.shape == (1, B, H)
+    # bidirectional, 2 layers
+    psize2 = rnn_param_size("lstm", 2, I, H, True)
+    params2 = nd.array(onp.random.randn(psize2).astype("float32") * 0.1)
+    h02 = nd.zeros((4, B, H))
+    c02 = nd.zeros((4, B, H))
+    out2, _, _ = nd.RNN(x, params2, h02, c02, state_size=H, num_layers=2,
+                        mode="lstm", bidirectional=True)
+    assert out2.shape == (T, B, 2 * H)
+
+
+def test_rnn_op_gru_vanilla():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, B, I, H = 4, 2, 3, 5
+    x = nd.array(onp.random.randn(T, B, I).astype("float32"))
+    for mode in ("gru", "rnn_tanh", "rnn_relu"):
+        psize = rnn_param_size(mode, 1, I, H, False)
+        params = nd.array(onp.random.randn(psize).astype("float32") * 0.1)
+        h0 = nd.zeros((1, B, H))
+        out, h_out, _ = nd.RNN(x, params, h0, state_size=H, num_layers=1,
+                               mode=mode)
+        assert out.shape == (T, B, H)
+
+
+def test_ctc_loss():
+    T, B, C = 10, 2, 5
+    onp.random.seed(0)
+    x = nd.array(onp.random.randn(T, B, C).astype("float32"))
+    labels = nd.array([[1, 2, 0, 0], [2, 3, 4, 0]])
+    loss = nd.CTCLoss(x, labels)
+    assert loss.shape == (B,)
+    assert (loss.asnumpy() > 0).all()
+    # uniform logits over C classes: loss of empty-vs-label sanity
+    x.attach_grad()
+    with mx.autograd.record():
+        l = nd.CTCLoss(x, labels).sum()
+    l.backward()
+    assert onp.isfinite(x.grad.asnumpy()).all()
+
+
+def test_linalg_ops():
+    a = onp.random.randn(4, 4).astype("float32")
+    spd = a @ a.T + 4 * onp.eye(4, dtype="float32")
+    A = nd.array(spd)
+    L = nd.linalg_potrf(A)
+    assert_almost_equal((L.asnumpy() @ L.asnumpy().T), spd, rtol=1e-4,
+                        atol=1e-4)
+    g = nd.linalg_gemm2(nd.array(a), nd.array(a), transpose_b=True)
+    assert_almost_equal(g.asnumpy(), a @ a.T, rtol=1e-4, atol=1e-4)
+    d = nd.linalg_det(A)
+    assert d.asscalar() == pytest.approx(onp.linalg.det(spd), rel=1e-3)
+    inv = nd.linalg_inverse(A)
+    assert_almost_equal(inv.asnumpy() @ spd, onp.eye(4), atol=1e-4)
+    sld = nd.linalg_sumlogdiag(A)
+    assert sld.asscalar() == pytest.approx(onp.log(onp.diag(spd)).sum(),
+                                           rel=1e-5)
+
+
+def test_optimizer_ops():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.2])
+    new_w = nd.sgd_update(w, g, lr=1.0, wd=0.0)
+    assert_almost_equal(new_w.asnumpy(), [0.9, 1.8], rtol=1e-6)
+    mom = nd.zeros(2)
+    new_w, new_mom = nd.sgd_mom_update(w, g, mom, lr=1.0, momentum=0.9)
+    assert_almost_equal(new_w.asnumpy(), [0.9, 1.8], rtol=1e-6)
+    mean, var = nd.zeros(2), nd.zeros(2)
+    new_w, m2, v2 = nd.adam_update(w, g, mean, var, lr=0.1)
+    assert onp.all(new_w.asnumpy() < w.asnumpy())
+    flag = nd.all_finite(nd.array([1.0, 2.0]))
+    assert flag.asscalar() == 1.0
+    flag = nd.all_finite(nd.array([1.0, onp.inf]))
+    assert flag.asscalar() == 0.0
+
+
+def test_gather_scatter_nd():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    idx = nd.array([[0, 2], [1, 3]])
+    out = nd.gather_nd(data, idx)
+    # coords are column-wise: (0,1) and (2,3)
+    assert out.asnumpy().tolist() == [1.0, 11.0]
+    scat = nd.scatter_nd(out, idx, shape=(3, 4))
+    assert scat.asnumpy()[0, 1] == 1.0
+    assert scat.asnumpy()[2, 3] == 11.0
+
+
+def test_random_samplers():
+    mx.random.seed(42)
+    u = mx.random.uniform(0, 1, shape=(1000,))
+    assert 0.4 < u.asnumpy().mean() < 0.6
+    n = mx.random.normal(0, 1, shape=(1000,))
+    assert abs(n.asnumpy().mean()) < 0.15
+    g = mx.random.gamma(2.0, 2.0, shape=(500,))
+    assert g.asnumpy().min() >= 0
+    p = mx.random.poisson(3.0, shape=(500,))
+    assert 2 < p.asnumpy().mean() < 4
+    r = mx.random.randint(0, 10, shape=(100,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    m = mx.random.multinomial(nd.array([0.0, 0.0, 1.0]), shape=5)
+    assert (m.asnumpy() == 2).all()
+    # determinism
+    mx.random.seed(7)
+    a = mx.random.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.random.uniform(shape=(4,)).asnumpy()
+    assert_almost_equal(a, b)
+
+
+def test_upsampling_and_resize():
+    x = nd.array(onp.arange(4, dtype="float32").reshape(1, 1, 2, 2))
+    up = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert up.shape == (1, 1, 4, 4)
+    assert up.asnumpy()[0, 0, 0, 1] == 0.0
+    assert up.asnumpy()[0, 0, 0, 2] == 1.0
+    rs = nd._contrib_BilinearResize2D(x, height=4, width=4)
+    assert rs.shape == (1, 1, 4, 4)
+
+
+def test_roi_and_spatial():
+    data = nd.array(onp.random.randn(2, 3, 8, 8).astype("float32"))
+    rois = nd.array([[0, 0, 0, 4, 4], [1, 2, 2, 7, 7]])
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (2, 3, 2, 2)
+    ra = nd._contrib_ROIAlign(data, rois, pooled_size=(2, 2),
+                              spatial_scale=1.0)
+    assert ra.shape == (2, 3, 2, 2)
+
+
+def test_leaky_relu_variants():
+    x = nd.array([[-2.0, 2.0]])
+    leaky = nd.LeakyReLU(x, act_type="leaky", slope=0.1)
+    assert_almost_equal(leaky.asnumpy(), [[-0.2, 2.0]], rtol=1e-5)
+    elu = nd.LeakyReLU(x, act_type="elu", slope=1.0)
+    assert elu.asnumpy()[0, 0] == pytest.approx(onp.exp(-2) - 1, rel=1e-4)
+    gelu = nd.LeakyReLU(x, act_type="gelu")
+    assert gelu.asnumpy()[0, 1] == pytest.approx(1.954, rel=1e-2)
+    g = nd.array([0.3])
+    prelu = nd.LeakyReLU(x, g, act_type="prelu")
+    assert prelu.asnumpy()[0, 0] == pytest.approx(-0.6, rel=1e-5)
