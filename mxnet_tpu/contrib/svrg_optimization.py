@@ -1,0 +1,101 @@
+"""SVRG: stochastic variance-reduced gradient.
+
+ref: python/mxnet/contrib/svrg_optimization/ — SVRGModule/SVRGOptimizer:
+every `update_freq` epochs take a full-batch gradient snapshot; per-step
+update uses g(w) - g(w_snap) + g_full (variance-reduced). Implemented over
+the Module API.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..module.module import Module
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names, label_names, **kwargs)
+        self.update_freq = update_freq
+        self._snapshot_params = None
+        self._full_grads = None
+        self._snapshot_mod = None
+
+    def bind(self, *args, **kwargs):
+        super().bind(*args, **kwargs)
+        self._snapshot_mod = Module(self._symbol, self._data_names,
+                                    self._label_names,
+                                    context=self._context)
+        self._snapshot_mod.bind(*args, **kwargs)
+
+    def update_full_grads(self, train_data):
+        """Full-pass gradient at the snapshot weights (ref:
+        svrg_module.py update_full_grads)."""
+        arg_params, aux_params = self.get_params()
+        self._snapshot_params = {k: v.copy()
+                                 for k, v in arg_params.items()}
+        self._snapshot_mod.init_params(arg_params=arg_params,
+                                       aux_params=aux_params,
+                                       force_init=True, allow_missing=True)
+        accum = {name: nd_zeros(arg_params[name].shape)
+                 for name in self._param_names}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self._snapshot_mod.forward(batch, is_train=True)
+            self._snapshot_mod.backward()
+            for name, grads in zip(self._snapshot_mod._param_names,
+                                   self._snapshot_mod._exec_group
+                                   .grad_arrays):
+                if grads[0] is not None:
+                    accum[name] += grads[0]
+            nbatch += 1
+        self._full_grads = {k: v / max(nbatch, 1)
+                            for k, v in accum.items()}
+        train_data.reset()
+
+    def update_svrg_gradients(self):
+        """grad ← grad - grad_snap + full_grad (ref:
+        svrg_module.py _update_svrg_gradients)."""
+        if self._full_grads is None:
+            return
+        # gradient at snapshot weights for the current batch
+        arg_params, aux_params = self.get_params()
+        self._snapshot_mod.init_params(
+            arg_params=self._snapshot_params, aux_params=aux_params,
+            force_init=True, allow_missing=True)
+        for name, cur_grads, snap_grads in zip(
+                self._param_names, self._exec_group.grad_arrays,
+                self._snapshot_mod._exec_group.grad_arrays):
+            if cur_grads[0] is None or snap_grads[0] is None:
+                continue
+            adjusted = cur_grads[0] - snap_grads[0] + self._full_grads[name]
+            cur_grads[0]._rebind(adjusted._data)
+
+    def forward_backward(self, data_batch):
+        super().forward_backward(data_batch)
+        if self._full_grads is not None:
+            self._snapshot_mod.forward(data_batch, is_train=True)
+            self._snapshot_mod.backward()
+            self.update_svrg_gradients()
+
+    def fit(self, train_data, **kwargs):
+        """fit with periodic full-gradient snapshots."""
+        num_epoch = kwargs.get("num_epoch")
+        assert num_epoch is not None
+
+        epoch_counter = {"n": 0}
+        orig_cb = kwargs.get("epoch_end_callback")
+
+        def epoch_cb(epoch, sym=None, arg=None, aux=None):
+            epoch_counter["n"] = epoch + 1
+            if (epoch + 1) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            if orig_cb is not None:
+                orig_cb(epoch, sym, arg, aux)
+
+        kwargs["epoch_end_callback"] = epoch_cb
+        super().fit(train_data, **kwargs)

@@ -1,0 +1,37 @@
+"""TensorBoard hook (ref: python/mxnet/contrib/tensorboard.py —
+LogMetricsCallback writing eval metrics to an event writer)."""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch-end callback pushing metrics to a SummaryWriter-like object.
+
+    Accepts any writer with an `add_scalar(tag, value, step)` method
+    (mxboard/tensorboardX/torch.utils.tensorboard all qualify)."""
+
+    def __init__(self, logging_dir=None, prefix=None, summary_writer=None):
+        self.prefix = prefix
+        self.step = 0
+        if summary_writer is not None:
+            self.summary_writer = summary_writer
+        else:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.summary_writer = SummaryWriter(logging_dir)
+            except Exception as e:
+                raise MXNetError(
+                    "no tensorboard writer available; pass summary_writer="
+                    "<object with add_scalar>") from e
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -1,0 +1,123 @@
+"""Text utilities: vocabulary + embeddings.
+
+ref: python/mxnet/contrib/text/ — vocab.Vocabulary, embedding.TokenEmbedding
+(pretrained GloVe/fastText loaders become local-file loaders: no egress).
+"""
+from __future__ import annotations
+
+import collections
+import os
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ["Vocabulary", "count_tokens_from_str", "CustomEmbedding"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """ref: contrib/text/utils.py count_tokens_from_str."""
+    source_str = source_str.lower() if to_lower else source_str
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    for seq in source_str.split(seq_delim):
+        counter.update(t for t in seq.split(token_delim) if t)
+    return counter
+
+
+class Vocabulary:
+    """ref: contrib/text/vocab.py Vocabulary."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens or [])
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_token = [unknown_token]
+        for tok in self._reserved_tokens:
+            self._token_to_idx[tok] = len(self._idx_to_token)
+            self._idx_to_token.append(tok)
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq < min_freq or tok in self._token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self) -> Dict[str, int]:
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        if isinstance(tokens, str):
+            return self._token_to_idx.get(tokens, 0)
+        return [self._token_to_idx.get(t, 0) for t in tokens]
+
+    def to_tokens(self, indices):
+        if isinstance(indices, int):
+            return self._idx_to_token[indices]
+        return [self._idx_to_token[i] for i in indices]
+
+
+class CustomEmbedding:
+    """ref: contrib/text/embedding.py CustomEmbedding — load token vectors
+    from a local text file 'token v1 v2 ...'."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None):
+        self._token_to_vec = {}
+        dim = None
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                vec = onp.asarray([float(x) for x in parts[1:]],
+                                  onp.float32)
+                dim = len(vec)
+                self._token_to_vec[parts[0]] = vec
+        if dim is None:
+            raise MXNetError("empty embedding file")
+        self.vec_len = dim
+        self._vocab = vocabulary
+        if vocabulary is not None:
+            mat = onp.zeros((len(vocabulary), dim), onp.float32)
+            for tok, idx in vocabulary.token_to_idx.items():
+                if tok in self._token_to_vec:
+                    mat[idx] = self._token_to_vec[tok]
+            self.idx_to_vec = nd_array(mat)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        vecs = []
+        for t in toks:
+            v = self._token_to_vec.get(t)
+            if v is None and lower_case_backup:
+                v = self._token_to_vec.get(t.lower())
+            vecs.append(v if v is not None
+                        else onp.zeros(self.vec_len, onp.float32))
+        out = nd_array(onp.stack(vecs))
+        return out[0] if single else out

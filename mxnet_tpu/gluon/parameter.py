@@ -169,13 +169,13 @@ class Parameter:
         pass
 
     def cast(self, dtype):
+        from ..ndarray.ndarray import _canon_dtype
         self.dtype = dtype
+        dt = _canon_dtype(dtype) if isinstance(dtype, str) else dtype
         if self._data is not None:
-            self._data._rebind(self._data._data.astype(
-                onp.dtype(dtype) if isinstance(dtype, str) else dtype))
+            self._data._rebind(self._data._data.astype(dt))
             if self._grad is not None:
-                self._grad._rebind(self._grad._data.astype(
-                    onp.dtype(dtype) if isinstance(dtype, str) else dtype))
+                self._grad._rebind(self._grad._data.astype(dt))
 
     def var(self):
         """Symbol placeholder for SymbolBlock interop."""

@@ -50,3 +50,10 @@ class _Contrib:
 
 
 contrib = _Contrib()
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """ref: mx.nd.Custom — run a registered python CustomOp
+    (python/mxnet/operator.py)."""
+    from ..operator import invoke_custom
+    return invoke_custom(op_type, *inputs, **kwargs)

@@ -1,0 +1,141 @@
+"""Custom operators: user-defined ops in Python.
+
+ref: python/mxnet/operator.py (1,160 LoC) — CustomOp/CustomOpProp callable
+from graphs; C side runs callbacks on a dedicated thread
+(src/operator/custom/custom-inl.h:52,76). TPU-native: a custom op is a
+host callback; in eager mode it runs inline with tape recording (custom
+backward honored); inside jit it lowers through jax.pure_callback. The
+registration surface (`@mx.operator.register`, CustomOpProp with
+list_arguments/infer_shape/create_operator) matches the reference so
+user custom ops port unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as onp
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray, _wrap, array as nd_array
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_op_prop"]
+
+_REG = Registry("custom_op")
+
+
+class CustomOp:
+    """ref: operator.py CustomOp — forward/backward with assign helper."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst: NDArray, req: str, src):
+        if req == "null":
+            return
+        src_data = src._data if isinstance(src, NDArray) else \
+            nd_array(src)._data
+        if req in ("write", "inplace"):
+            dst._rebind(src_data.astype(dst._data.dtype))
+        elif req == "add":
+            dst._rebind(dst._data + src_data.astype(dst._data.dtype))
+        else:
+            raise MXNetError(f"unknown req {req}")
+
+
+class CustomOpProp:
+    """ref: operator.py CustomOpProp."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name: str):
+    """ref: operator.py register — decorator on a CustomOpProp subclass."""
+
+    def deco(prop_cls):
+        _REG.register(reg_name)(prop_cls)
+        return prop_cls
+
+    return deco
+
+
+def get_op_prop(name: str) -> type:
+    return _REG.get(name)
+
+
+def invoke_custom(op_type: str, *inputs: NDArray, **kwargs):
+    """Execute a registered custom op eagerly with autograd support
+    (the role of CustomOperator::Push, custom-inl.h:76 — minus the
+    dedicated callback thread: the host *is* the callback thread here)."""
+    from . import autograd
+
+    prop = _REG.get(op_type)(**kwargs) if _accepts_kwargs(_REG.get(op_type)) \
+        else _REG.get(op_type)()
+    in_shapes = [list(i.shape) for i in inputs]
+    in_shapes_out = prop.infer_shape(in_shapes)
+    _, out_shapes, aux_shapes = in_shapes_out
+    from .ndarray.ndarray import zeros as nd_zeros
+    out_data = [nd_zeros(tuple(s)) for s in out_shapes]
+    aux = [nd_zeros(tuple(s)) for s in aux_shapes]
+    op = prop.create_operator(None, in_shapes,
+                              [i.dtype for i in inputs])
+
+    with autograd.pause():
+        op.forward(is_train=autograd.is_training(),
+                   req=["write"] * len(out_data), in_data=list(inputs),
+                   out_data=out_data, aux=aux)
+
+    if autograd.is_recording():
+        tape = autograd.current_tape()
+
+        def custom_backward(cotangents, _op=op, _inputs=inputs,
+                            _outputs=out_data, _aux=aux):
+            in_grads = [nd_zeros(i.shape) for i in _inputs]
+            with autograd.pause():
+                _op.backward(req=["write"] * len(in_grads),
+                             out_grad=[_wrap(c) for c in cotangents],
+                             in_data=list(_inputs), out_data=_outputs,
+                             in_grad=in_grads, aux=_aux)
+            return tuple(g._data for g in in_grads)
+
+        tape.record(fn=None, in_arrays=[i._data for i in inputs],
+                    out_arrays=[o._data for o in out_data],
+                    in_owners=list(inputs), custom_backward=custom_backward)
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+def _accepts_kwargs(cls):
+    import inspect
+    sig = inspect.signature(cls.__init__)
+    return len(sig.parameters) > 1

@@ -124,6 +124,12 @@ def make_nd_function(name: str) -> Callable:
                 inputs.append(v)
             else:
                 rest_params[k] = v
+        from .. import amp as _amp
+        if _amp.is_active():
+            from ..ndarray.ndarray import _wrap as _aw
+            cast = _amp.cast_for_op(name, [i._data for i in inputs])
+            inputs = [i if c is i._data else _aw(c)
+                      for i, c in zip(inputs, cast)]
         n_out = rest_params.get("num_outputs", info.n_out) \
             if info.n_out == -1 else info.n_out
         if info.needs_train and "_training" not in rest_params:
