@@ -1,0 +1,212 @@
+"""End-to-end convergence tests (ref: tests/python/train/ — test_mlp.py
+accuracy gate >0.95, test_conv.py, test_autograd.py training loops)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, sym
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.io.io import NDArrayIter
+
+
+def _synthetic_mnist(n=1500, seed=0):
+    """Deterministic separable digit-like data (no egress → no real MNIST;
+    same role as the reference's fixture data)."""
+    rng = onp.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n)
+    imgs = onp.zeros((n, 28, 28), "float32")
+    for i, lab in enumerate(labels):
+        imgs[i, 2 + lab * 2:6 + lab * 2, 4:24] = 0.8
+        imgs[i] += rng.uniform(0, 0.2, size=(28, 28))
+    return imgs.reshape(n, 784), labels.astype("float32")
+
+
+def test_mlp_mnist_gate():
+    """The reference CI gate: MLP reaches >0.95 train accuracy
+    (ref: tests/python/train/test_mlp.py:82)."""
+    x, y = _synthetic_mnist()
+    train_iter = NDArrayIter(x, y, batch_size=100, shuffle=True)
+
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    softmax = sym.SoftmaxOutput(fc3, name="softmax")
+
+    mod = mx.mod.Module(softmax, context=mx.cpu())
+    mod.fit(train_iter, num_epoch=8,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    acc = mod.score(train_iter, "acc")[0][1]
+    assert acc > 0.95, f"Low training accuracy: {acc}"
+
+
+def test_gluon_conv_training():
+    """LeNet-style conv net learns synthetic digits (ref:
+    tests/python/train/test_conv.py)."""
+    x, y = _synthetic_mnist(600)
+    x = x.reshape(-1, 1, 28, 28)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 5, activation="relu"))
+        net.add(nn.MaxPool2D(2, 2))
+        net.add(nn.Conv2D(16, 3, activation="relu"))
+        net.add(nn.MaxPool2D(2, 2))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(64, activation="relu"))
+        net.add(nn.Dense(10))
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.002})
+    bs = 50
+    for epoch in range(4):
+        perm = onp.random.permutation(len(x))
+        for i in range(0, len(x), bs):
+            idx = perm[i:i + bs]
+            data = nd.array(x[idx])
+            label = nd.array(y[idx])
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label).mean()
+            loss.backward()
+            trainer.step(1)
+    preds = net(nd.array(x[:300])).asnumpy().argmax(axis=1)
+    acc = (preds == y[:300]).mean()
+    assert acc > 0.9, f"conv accuracy {acc}"
+
+
+def test_lstm_lm_overfit():
+    """Tiny LSTM language model overfits a repeated sequence — the word-LM
+    capability slice (ref: example/rnn/word_lm)."""
+    vocab, T, B = 12, 8, 4
+    rng = onp.random.RandomState(0)
+    seq = rng.randint(0, vocab, size=(B, T + 1))
+
+    class LM(nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = nn.Embedding(vocab, 16)
+                self.lstm = gluon.rnn.LSTM(32, layout="NTC")
+                self.out = nn.Dense(vocab, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            h = self.embed(x)
+            h = self.lstm(h)
+            return self.out(h)
+
+    net = LM()
+    net.initialize(mx.initializer.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    data = nd.array(seq[:, :-1], dtype="int32")
+    target = nd.array(seq[:, 1:], dtype="float32")
+    first = last = None
+    for step in range(60):
+        with autograd.record():
+            logits = net(data)
+            loss = loss_fn(logits.reshape((-1, vocab)),
+                           target.reshape((-1,))).mean()
+        loss.backward()
+        trainer.step(1)
+        if step == 0:
+            first = loss.asscalar()
+        last = loss.asscalar()
+    assert last < first * 0.5, f"LM did not learn: {first} -> {last}"
+
+
+def test_ssd_multibox_pipeline():
+    """Minimal SSD slice: feature extractor → priors → target matching →
+    losses train jointly (ref: example/ssd/train/train_net.py config 4)."""
+    rng = onp.random.RandomState(0)
+    B = 4
+    images = nd.array(rng.uniform(0, 1, (B, 3, 32, 32)).astype("float32"))
+    # one gt box per image, class 0, around a grid cell
+    labels = nd.array(onp.tile(
+        onp.asarray([[0, 0.1, 0.1, 0.45, 0.45]], "float32"), (B, 1, 1)))
+
+    class TinySSD(nn.HybridBlock):
+        def __init__(self, num_classes=2, num_anchors=3, **kw):
+            super().__init__(**kw)
+            self.na = num_anchors
+            self.nc = num_classes
+            with self.name_scope():
+                self.backbone = nn.HybridSequential()
+                self.backbone.add(nn.Conv2D(16, 3, 2, 1,
+                                            activation="relu"))
+                self.backbone.add(nn.Conv2D(16, 3, 2, 1,
+                                            activation="relu"))
+                self.cls_head = nn.Conv2D(num_anchors * (num_classes + 1),
+                                          3, padding=1)
+                self.loc_head = nn.Conv2D(num_anchors * 4, 3, padding=1)
+
+        def hybrid_forward(self, F, x):
+            feat = self.backbone(x)
+            anchors = F.contrib.MultiBoxPrior(
+                feat, sizes=(0.3, 0.5), ratios=(1, 2))
+            cls = self.cls_head(feat)
+            B_, _, h, w = cls.shape
+            cls = cls.transpose((0, 2, 3, 1)).reshape(
+                (B_, h * w * self.na, self.nc + 1)).transpose((0, 2, 1))
+            loc = self.loc_head(feat).transpose((0, 2, 3, 1)).reshape(
+                (B_, -1))
+            return anchors, cls, loc
+
+    net = TinySSD()
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    first = last = None
+    for step in range(12):
+        with autograd.record():
+            anchors, cls_preds, loc_preds = net(images)
+            box_t, box_m, cls_t = nd.contrib.MultiBoxTarget(
+                anchors, labels, cls_preds)
+            cls_loss = ce(cls_preds.transpose((0, 2, 1)), cls_t).mean()
+            loc_loss = (nd.smooth_l1((loc_preds - box_t) * box_m,
+                                     scalar=1.0)).mean()
+            loss = cls_loss + loc_loss
+        loss.backward()
+        trainer.step(B)
+        if step == 0:
+            first = loss.asscalar()
+        last = loss.asscalar()
+    assert last < first, f"SSD loss did not decrease: {first} -> {last}"
+    # inference path: detection decode runs
+    anchors, cls_preds, loc_preds = net(images)
+    probs = nd.softmax(cls_preds.transpose((0, 2, 1)),
+                       axis=-1).transpose((0, 2, 1))
+    det = nd.contrib.MultiBoxDetection(probs, loc_preds, anchors)
+    assert det.shape[2] == 6
+
+
+def test_optimizer_convergence_matrix():
+    """Every registered optimizer reduces a quadratic loss (ref:
+    tests/python/unittest/test_optimizer.py pattern)."""
+    for opt_name in ["sgd", "adam", "adagrad", "rmsprop", "adadelta",
+                     "nag", "signum", "ftrl", "ftml", "adamax", "nadam",
+                     "adamw"]:
+        net = nn.Dense(1, in_units=4, use_bias=False)
+        net.initialize(mx.initializer.Normal(0.5))
+        lr = {"sgd": 0.1, "adadelta": 1.0}.get(opt_name, 0.05)
+        trainer = gluon.Trainer(net.collect_params(), opt_name,
+                                {"learning_rate": lr}
+                                if opt_name != "adadelta" else {})
+        x = nd.array(onp.random.RandomState(0)
+                     .randn(16, 4).astype("float32"))
+        first = last = None
+        for i in range(25):
+            with autograd.record():
+                loss = (net(x) ** 2).mean()
+            loss.backward()
+            trainer.step(16)
+            if i == 0:
+                first = loss.asscalar()
+            last = loss.asscalar()
+        assert last < first, f"{opt_name}: {first} -> {last}"
