@@ -25,6 +25,36 @@ def _to_nd(x):
     return _wrap(x)
 
 
+def _masked_while_scan(cond_f, body_f, init_vars, max_iterations):
+    """Bounded while as a masked lax.scan of max_iterations steps.
+
+    lax.while_loop is not reverse-mode differentiable; since
+    max_iterations is static (the reference pads output buffers the same
+    way, src/operator/control_flow.cc), a scan that masks updates once
+    the condition fails keeps grads flowing while matching while-loop
+    semantics. cond_f(vars)->bool scalar; body_f(vars)->(outs, new_vars).
+
+    Returns (out_bufs, final_vars, n_iters)."""
+    outs_sd, _ = jax.eval_shape(lambda vs: body_f(vs), tuple(init_vars))
+    bufs0 = tuple(jnp.zeros((max_iterations,) + tuple(s.shape), s.dtype)
+                  for s in outs_sd)
+
+    def step(carry, _):
+        n, active, vs, bufs = carry
+        act = jnp.logical_and(active, cond_f(vs))
+        outs, nvs = body_f(vs)
+        vs2 = tuple(jnp.where(act, nv, v) for nv, v in zip(nvs, vs))
+        bufs2 = tuple(b.at[n].set(jnp.where(act, o, b[n]))
+                      for b, o in zip(bufs, outs))
+        return (n + act.astype(jnp.int32), act, vs2, bufs2), None
+
+    (n, _, final_vars, bufs), _ = jax.lax.scan(
+        step, (jnp.asarray(0, jnp.int32), jnp.asarray(True),
+               tuple(init_vars), bufs0),
+        None, length=max_iterations)
+    return bufs, final_vars, n
+
+
 def _to_jax(x):
     from ..ndarray.ndarray import NDArray
     if isinstance(x, NDArray):
@@ -142,41 +172,23 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars,
     meta = {}
 
     def fn(*arrays):
-        def probe():
-            nds = [_to_nd(a) for a in arrays]
-            outs, new_vars = func(*nds)
-            out_list = outs if isinstance(outs, (list, tuple)) else [outs]
-            meta["out_single"] = not isinstance(outs, (tuple, list))
-            return out_list
-
-        out_template = [(_to_jax(o).shape, _to_jax(o).dtype)
-                        for o in probe()]
-        n_out = len(out_template)
-
-        def body(state):
-            i, vs, bufs = state
+        def body_f(vs):
             nds = [_to_nd(v) for v in vs]
             outs, new_vars = func(*nds)
+            meta["out_single"] = not isinstance(outs, (tuple, list))
             out_list = outs if isinstance(outs, (list, tuple)) else [outs]
             nv_list = new_vars if isinstance(new_vars, (list, tuple)) \
                 else [new_vars]
-            bufs = tuple(b.at[i].set(_to_jax(o))
-                         for b, o in zip(bufs, out_list))
-            return (i + 1, tuple(_to_jax(v) for v in nv_list), bufs)
+            return (tuple(_to_jax(o) for o in out_list),
+                    tuple(_to_jax(v) for v in nv_list))
 
-        def cond_wrap(state):
-            i, vs, _ = state
-            nds = [_to_nd(v) for v in vs]
-            c = cond_fn(*nds)
-            cv = _to_jax(c)
-            return jnp.logical_and(i < max_iterations,
-                                   jnp.squeeze(cv).astype(bool))
+        def cond_f(vs):
+            c = cond_fn(*[_to_nd(v) for v in vs])
+            return jnp.squeeze(_to_jax(c)).astype(bool)
 
-        bufs = tuple(jnp.zeros((max_iterations,) + tuple(s), d)
-                     for s, d in out_template)
-        i, final_vars, bufs = jax.lax.while_loop(
-            cond_wrap, body, (jnp.asarray(0), tuple(arrays), bufs))
-        return bufs + final_vars + (i.astype(jnp.int32),)
+        bufs, final_vars, n = _masked_while_scan(cond_f, body_f, arrays,
+                                                 max_iterations)
+        return bufs + final_vars + (n,)
 
     results = invoke(fn, vars_list)
     # count outputs: len(results) = n_out + n_vars + 1
@@ -235,3 +247,98 @@ def cond(pred_fn_or_val, then_func: Callable, else_func: Callable,
     if not isinstance(results, list):
         return results
     return results[0] if meta.get("single") else results
+
+
+# ---------------------------------------------------------------------------
+# Registered subgraph ops — the internal graph-node forms used by the
+# symbolic layer (ref: src/operator/control_flow.cc:475,489,503 register
+# `_foreach`/`_while_loop`/`_cond` as ops whose attrs carry nnvm subgraphs;
+# here the node params carry sub-Symbols and the op fn compiles them into
+# lax.scan / lax.while_loop / lax.cond around symbol.eval_graph).
+# ---------------------------------------------------------------------------
+
+from .registry import register_op  # noqa: E402
+
+
+def _eval_sub(sub, value_map, training):
+    from ..symbol.symbol import eval_graph
+    outs, _aux = eval_graph(sub, value_map, training, None)
+    return outs
+
+
+@register_op("_foreach", n_out=-1, differentiable=True, needs_train=True)
+def _foreach_node(*arrays, __subgraph__=None, in_names=(), n_data=1,
+                  n_states=1, num_outputs=None, _training=False, **_ig):
+    """Subgraph-op form of foreach: scans `__subgraph__` (a Symbol whose
+    outputs are loop outputs followed by new states) over axis 0 of the
+    first `n_data` inputs. Remaining inputs beyond data+states are loop
+    invariants (closure-captured variables)."""
+    in_names = list(in_names)
+    data = arrays[:n_data]
+    states = arrays[n_data:n_data + n_states]
+    free = arrays[n_data + n_states:]
+    free_map = dict(zip(in_names[n_data + n_states:], free))
+
+    def body(carry, slices):
+        vm = dict(free_map)
+        vm.update(zip(in_names[:n_data], slices))
+        vm.update(zip(in_names[n_data:n_data + n_states], carry))
+        outs = _eval_sub(__subgraph__, vm, _training)
+        n_loop_out = len(outs) - n_states
+        new_states = tuple(outs[n_loop_out:])
+        return new_states, tuple(outs[:n_loop_out])
+
+    final, stacked = jax.lax.scan(body, tuple(states), tuple(data))
+    return tuple(stacked) + tuple(final)
+
+
+@register_op("_while_loop", n_out=-1, differentiable=True, needs_train=True)
+def _while_loop_node(*arrays, __cond__=None, __func__=None, in_names=(),
+                     n_vars=1, max_iterations=1, num_outputs=None,
+                     _training=False, **_ig):
+    """Subgraph-op form of while_loop: `__cond__`/`__func__` are Symbols
+    over the loop vars (+ invariants); outputs are padded to
+    max_iterations rows (XLA static shapes)."""
+    in_names = list(in_names)
+    loop_vars = arrays[:n_vars]
+    free = arrays[n_vars:]
+    free_map = dict(zip(in_names[n_vars:], free))
+
+    def vm_of(vs):
+        vm = dict(free_map)
+        vm.update(zip(in_names[:n_vars], vs))
+        return vm
+
+    out_shapes = jax.eval_shape(
+        lambda vs: tuple(_eval_sub(__func__, vm_of(vs), _training)),
+        tuple(loop_vars))
+    n_out = len(out_shapes) - n_vars
+
+    def cond_f(vs):
+        c = _eval_sub(__cond__, vm_of(vs), _training)[0]
+        return jnp.squeeze(c).astype(bool)
+
+    def body_f(vs):
+        outs = _eval_sub(__func__, vm_of(vs), _training)
+        return tuple(outs[:n_out]), tuple(outs[n_out:])
+
+    bufs, final_vars, _n = _masked_while_scan(cond_f, body_f,
+                                              tuple(loop_vars),
+                                              max_iterations)
+    return bufs + final_vars
+
+
+@register_op("_cond", n_out=-1, differentiable=True, needs_train=True)
+def _cond_node(*arrays, __pred__=None, __then__=None, __else__=None,
+               in_names=(), num_outputs=None, _training=False, **_ig):
+    """Subgraph-op form of cond: evaluates `__pred__` then dispatches to
+    `__then__` or `__else__` via lax.cond (both traced; XLA executes one)."""
+    in_names = list(in_names)
+    vm = dict(zip(in_names, arrays))
+    pred = _eval_sub(__pred__, vm, _training)[0]
+
+    def mk(sub):
+        return lambda _: tuple(_eval_sub(sub, vm, _training))
+
+    return jax.lax.cond(jnp.squeeze(pred).astype(bool),
+                        mk(__then__), mk(__else__), 0)

@@ -34,7 +34,7 @@ def flash_attention_available(q_len: int, k_len: int, head_dim: int) -> bool:
     if not _HAS_PLTPU:
         return False
     return (q_len % DEFAULT_BLOCK_Q == 0 and k_len % DEFAULT_BLOCK_K == 0
-            and head_dim % 128 == 0 or head_dim in (64, 128, 256))
+            and (head_dim % 128 == 0 or head_dim in (64, 128, 256)))
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
@@ -69,8 +69,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     l0 = jnp.zeros((block_q,), jnp.float32)
     num_k = k_len // block_k
     if causal:
-        # only blocks at or before the diagonal contribute
-        num_k_run = qi * block_q // block_k + 1
+        # only K-blocks touching rows up to this Q-block's LAST row
+        # contribute; also never beyond k_len (cross-length case)
+        num_k_run = jnp.minimum(num_k,
+                                ((qi + 1) * block_q - 1) // block_k + 1)
         o, m, l = jax.lax.fori_loop(0, num_k_run, body, (o0, m0, l0))
     else:
         o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))

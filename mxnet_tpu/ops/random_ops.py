@@ -1,0 +1,383 @@
+"""Random sampling op corpus on threefry keys.
+
+TPU-native equivalents of the reference's sampler ops
+(ref: src/operator/random/sample_op.cc — `_random_{uniform,normal,...}`,
+`_sample_*` row-wise variants; src/operator/random/sample_multinomial_op.cc;
+src/operator/random/pdf_op.cc; src/operator/random/shuffle_op.cc;
+src/operator/random/unique_sample_op.cc). The reference seeds 1024 mt19937 /
+Philox states through the resource manager (include/mxnet/random_generator.h);
+here every op draws from a stateless threefry key appended as a trailing
+input by the registry's `needs_rng` plumbing, so sampling stays functional
+and jit/pjit-safe.
+
+Conventions (matching the reference):
+- `_random_<dist>(shape=, dtype=)`: scalar distribution params, tensor-free.
+- `_random_<dist>_like(data)`: same, output shaped like `data`.
+- `_sample_<dist>(params..., shape=)`: per-row distribution params; output
+  shape = params.shape + shape (ref: sample_op.h MultiSampleOpShape).
+- `_random_pdf_<dist>(sample, params...)`: densities, differentiable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = []
+
+
+def _key(raw):
+    return jax.random.wrap_key_data(raw)
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def _dt(dtype, default="float32"):
+    return jnp.dtype(dtype or default)
+
+
+# ---------------------------------------------------------------------------
+# _random_* — scalar-parameter samplers (ref: sample_op.cc:61-213)
+# ---------------------------------------------------------------------------
+
+@register_op("_random_uniform", differentiable=False, needs_rng=True,
+             aliases=["random_uniform"])
+def _random_uniform(raw_key, low=0.0, high=1.0, shape=(1,), dtype="float32",
+                    ctx=None):
+    return jax.random.uniform(_key(raw_key), _shape(shape),
+                              _dt(dtype), low, high)
+
+
+@register_op("_random_normal", differentiable=False, needs_rng=True,
+             aliases=["random_normal"])
+def _random_normal(raw_key, loc=0.0, scale=1.0, shape=(1,), dtype="float32",
+                   ctx=None):
+    return loc + scale * jax.random.normal(_key(raw_key), _shape(shape),
+                                           _dt(dtype))
+
+
+@register_op("_random_gamma", differentiable=False, needs_rng=True,
+             aliases=["random_gamma"])
+def _random_gamma(raw_key, alpha=1.0, beta=1.0, shape=(1,), dtype="float32",
+                  ctx=None):
+    return beta * jax.random.gamma(_key(raw_key), alpha, _shape(shape),
+                                   _dt(dtype))
+
+
+@register_op("_random_exponential", differentiable=False, needs_rng=True,
+             aliases=["random_exponential"])
+def _random_exponential(raw_key, lam=1.0, shape=(1,), dtype="float32",
+                        ctx=None):
+    return jax.random.exponential(_key(raw_key), _shape(shape),
+                                  _dt(dtype)) / lam
+
+
+@register_op("_random_poisson", differentiable=False, needs_rng=True,
+             aliases=["random_poisson"])
+def _random_poisson(raw_key, lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    return jax.random.poisson(_key(raw_key), lam,
+                              _shape(shape)).astype(_dt(dtype))
+
+
+@register_op("_random_negative_binomial", differentiable=False,
+             needs_rng=True, aliases=["random_negative_binomial"])
+def _random_negative_binomial(raw_key, k=1, p=0.5, shape=(1,),
+                              dtype="float32", ctx=None):
+    key = _key(raw_key)
+    g = jax.random.gamma(key, k, _shape(shape)) * (1.0 - p) / p
+    return jax.random.poisson(jax.random.fold_in(key, 1), g,
+                              _shape(shape)).astype(_dt(dtype))
+
+
+@register_op("_random_generalized_negative_binomial", differentiable=False,
+             needs_rng=True, aliases=["random_generalized_negative_binomial"])
+def _random_generalized_negative_binomial(raw_key, mu=1.0, alpha=1.0,
+                                          shape=(1,), dtype="float32",
+                                          ctx=None):
+    key = _key(raw_key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    g = jax.random.gamma(key, r, _shape(shape)) * (1.0 - p) / p
+    return jax.random.poisson(jax.random.fold_in(key, 1), g,
+                              _shape(shape)).astype(_dt(dtype))
+
+
+@register_op("_random_randint", differentiable=False, needs_rng=True,
+             aliases=["random_randint"])
+def _random_randint(raw_key, low=0, high=1, shape=(1,), dtype="int32",
+                    ctx=None):
+    return jax.random.randint(_key(raw_key), _shape(shape), low, high,
+                              _dt(dtype, "int32"))
+
+
+# _like variants (ref: sample_op.cc `_random_*_like` registrations)
+
+@register_op("_random_uniform_like", differentiable=False, needs_rng=True)
+def _random_uniform_like(data, raw_key, low=0.0, high=1.0):
+    return jax.random.uniform(_key(raw_key), data.shape, data.dtype,
+                              low, high)
+
+
+@register_op("_random_normal_like", differentiable=False, needs_rng=True)
+def _random_normal_like(data, raw_key, loc=0.0, scale=1.0):
+    return loc + scale * jax.random.normal(_key(raw_key), data.shape,
+                                           data.dtype)
+
+
+@register_op("_random_gamma_like", differentiable=False, needs_rng=True)
+def _random_gamma_like(data, raw_key, alpha=1.0, beta=1.0):
+    return beta * jax.random.gamma(_key(raw_key), alpha, data.shape,
+                                   data.dtype)
+
+
+@register_op("_random_exponential_like", differentiable=False, needs_rng=True)
+def _random_exponential_like(data, raw_key, lam=1.0):
+    return jax.random.exponential(_key(raw_key), data.shape,
+                                  data.dtype) / lam
+
+
+@register_op("_random_poisson_like", differentiable=False, needs_rng=True)
+def _random_poisson_like(data, raw_key, lam=1.0):
+    return jax.random.poisson(_key(raw_key), lam,
+                              data.shape).astype(data.dtype)
+
+
+@register_op("_random_negative_binomial_like", differentiable=False,
+             needs_rng=True)
+def _random_negative_binomial_like(data, raw_key, k=1, p=0.5):
+    key = _key(raw_key)
+    g = jax.random.gamma(key, k, data.shape) * (1.0 - p) / p
+    return jax.random.poisson(jax.random.fold_in(key, 1), g,
+                              data.shape).astype(data.dtype)
+
+
+@register_op("_random_generalized_negative_binomial_like",
+             differentiable=False, needs_rng=True)
+def _random_generalized_negative_binomial_like(data, raw_key, mu=1.0,
+                                               alpha=1.0):
+    key = _key(raw_key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    g = jax.random.gamma(key, r, data.shape) * (1.0 - p) / p
+    return jax.random.poisson(jax.random.fold_in(key, 1), g,
+                              data.shape).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# _sample_* — per-row parameter samplers (ref: multisample_op.cc; output
+# shape is params.shape + shape)
+# ---------------------------------------------------------------------------
+
+def _row_shape(param, shape):
+    return tuple(param.shape) + _shape(shape)
+
+
+def _bcast(param, shape):
+    """Broadcast a params tensor against trailing sample dims."""
+    extra = len(_shape(shape))
+    return param.reshape(param.shape + (1,) * extra) if extra else param
+
+
+@register_op("_sample_uniform", differentiable=False, needs_rng=True,
+             aliases=["sample_uniform"])
+def _sample_uniform(low, high, raw_key, shape=(), dtype="float32"):
+    u = jax.random.uniform(_key(raw_key), _row_shape(low, shape), _dt(dtype))
+    return _bcast(low, shape) + u * (_bcast(high, shape) - _bcast(low, shape))
+
+
+@register_op("_sample_normal", differentiable=False, needs_rng=True,
+             aliases=["sample_normal"])
+def _sample_normal(mu, sigma, raw_key, shape=(), dtype="float32"):
+    z = jax.random.normal(_key(raw_key), _row_shape(mu, shape), _dt(dtype))
+    return _bcast(mu, shape) + z * _bcast(sigma, shape)
+
+
+@register_op("_sample_gamma", differentiable=False, needs_rng=True,
+             aliases=["sample_gamma"])
+def _sample_gamma(alpha, beta, raw_key, shape=(), dtype="float32"):
+    g = jax.random.gamma(_key(raw_key), _bcast(alpha, shape),
+                         _row_shape(alpha, shape), _dt(dtype))
+    return g * _bcast(beta, shape)
+
+
+@register_op("_sample_exponential", differentiable=False, needs_rng=True,
+             aliases=["sample_exponential"])
+def _sample_exponential(lam, raw_key, shape=(), dtype="float32"):
+    e = jax.random.exponential(_key(raw_key), _row_shape(lam, shape),
+                               _dt(dtype))
+    return e / _bcast(lam, shape)
+
+
+@register_op("_sample_poisson", differentiable=False, needs_rng=True,
+             aliases=["sample_poisson"])
+def _sample_poisson(lam, raw_key, shape=(), dtype="float32"):
+    p = jax.random.poisson(_key(raw_key), _bcast(lam, shape),
+                           _row_shape(lam, shape))
+    return p.astype(_dt(dtype))
+
+
+@register_op("_sample_negative_binomial", differentiable=False,
+             needs_rng=True, aliases=["sample_negative_binomial"])
+def _sample_negative_binomial(k, p, raw_key, shape=(), dtype="float32"):
+    key = _key(raw_key)
+    kk, pp = _bcast(k, shape), _bcast(p, shape)
+    g = jax.random.gamma(key, kk, _row_shape(k, shape)) * (1.0 - pp) / pp
+    return jax.random.poisson(jax.random.fold_in(key, 1), g,
+                              _row_shape(k, shape)).astype(_dt(dtype))
+
+
+@register_op("_sample_generalized_negative_binomial", differentiable=False,
+             needs_rng=True, aliases=["sample_generalized_negative_binomial"])
+def _sample_generalized_negative_binomial(mu, alpha, raw_key, shape=(),
+                                          dtype="float32"):
+    key = _key(raw_key)
+    r = 1.0 / _bcast(alpha, shape)
+    p = r / (r + _bcast(mu, shape))
+    g = jax.random.gamma(key, r, _row_shape(mu, shape)) * (1.0 - p) / p
+    return jax.random.poisson(jax.random.fold_in(key, 1), g,
+                              _row_shape(mu, shape)).astype(_dt(dtype))
+
+
+@register_op("_sample_multinomial", differentiable=False, needs_rng=True,
+             aliases=["sample_multinomial"])
+def _sample_multinomial(data, raw_key, shape=(), get_prob=False,
+                        dtype="int32"):
+    """ref: src/operator/random/sample_multinomial_op.cc — rows of `data`
+    are probability vectors; draws `shape` categorical samples per row."""
+    logits = jnp.log(jnp.clip(data, 1e-20, None))
+    k = data.shape[-1]
+    rows = 1
+    for d in data.shape[:-1]:
+        rows *= d
+    n = 1
+    for d in _shape(shape):
+        n *= d
+    out_shape = tuple(data.shape[:-1]) + _shape(shape)
+    flat = jax.random.categorical(_key(raw_key),
+                                  logits.reshape((rows, 1, k)),
+                                  axis=-1, shape=(rows, n))
+    samp = flat.reshape(out_shape).astype(_dt(dtype, "int32"))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1).reshape((rows, k)),
+            flat.astype(jnp.int32), axis=-1).reshape(out_shape)
+        return samp, lp
+    return samp
+
+
+@register_op("_sample_unique_zipfian", n_out=2, differentiable=False,
+             needs_rng=True, aliases=["sample_unique_zipfian"])
+def _sample_unique_zipfian(raw_key, range_max=1, shape=(1,)):
+    """ref: src/operator/random/unique_sample_op.cc — log-uniform (zipfian)
+    candidate sampler; returns (samples, num_tries). Sampling-with-rejection
+    is replaced by an XLA-friendly fixed draw; num_tries reports the draw
+    count (expected-tries estimate matches the reference's use in sampled
+    softmax normalization)."""
+    shp = _shape(shape)
+    u = jax.random.uniform(_key(raw_key), shp)
+    samples = (jnp.exp(u * jnp.log(float(range_max) + 1.0)) - 1.0)
+    samples = jnp.clip(samples.astype(jnp.int32), 0, range_max - 1)
+    num_tries = jnp.full((), shp[-1] if shp else 1, jnp.int32)
+    return samples, num_tries
+
+
+@register_op("_shuffle", differentiable=False, needs_rng=True,
+             aliases=["shuffle"])
+def _shuffle(data, raw_key):
+    """ref: src/operator/random/shuffle_op.cc — shuffle along axis 0."""
+    return jax.random.permutation(_key(raw_key), data, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# _random_pdf_* — densities (ref: src/operator/random/pdf_op.cc);
+# differentiable w.r.t. sample and params
+# ---------------------------------------------------------------------------
+
+def _pdf_out(sample, param):
+    """Params broadcast over trailing sample dims (row-wise semantics)."""
+    extra = sample.ndim - param.ndim
+    return param.reshape(param.shape + (1,) * extra) if extra > 0 else param
+
+
+def _maybe_exp(logpdf, is_log):
+    return logpdf if is_log else jnp.exp(logpdf)
+
+
+@register_op("_random_pdf_uniform")
+def _random_pdf_uniform(sample, low, high, is_log=False):
+    low, high = _pdf_out(sample, low), _pdf_out(sample, high)
+    inside = (sample >= low) & (sample <= high)
+    logpdf = jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+    return _maybe_exp(logpdf, is_log)
+
+
+@register_op("_random_pdf_normal")
+def _random_pdf_normal(sample, mu, sigma, is_log=False):
+    mu, sigma = _pdf_out(sample, mu), _pdf_out(sample, sigma)
+    z = (sample - mu) / sigma
+    logpdf = -0.5 * z * z - jnp.log(sigma) - 0.5 * jnp.log(2 * jnp.pi)
+    return _maybe_exp(logpdf, is_log)
+
+
+@register_op("_random_pdf_gamma")
+def _random_pdf_gamma(sample, alpha, beta, is_log=False):
+    alpha, beta = _pdf_out(sample, alpha), _pdf_out(sample, beta)
+    # reference parameterization: scale beta (sample ~ beta * Gamma(alpha))
+    logpdf = (alpha * -jnp.log(beta) + (alpha - 1) * jnp.log(sample)
+              - sample / beta - jax.scipy.special.gammaln(alpha))
+    return _maybe_exp(logpdf, is_log)
+
+
+@register_op("_random_pdf_exponential")
+def _random_pdf_exponential(sample, lam, is_log=False):
+    lam = _pdf_out(sample, lam)
+    logpdf = jnp.log(lam) - lam * sample
+    return _maybe_exp(logpdf, is_log)
+
+
+@register_op("_random_pdf_poisson")
+def _random_pdf_poisson(sample, lam, is_log=False):
+    lam = _pdf_out(sample, lam)
+    logpdf = (sample * jnp.log(lam) - lam
+              - jax.scipy.special.gammaln(sample + 1.0))
+    return _maybe_exp(logpdf, is_log)
+
+
+@register_op("_random_pdf_negative_binomial")
+def _random_pdf_negative_binomial(sample, k, p, is_log=False):
+    k, p = _pdf_out(sample, k), _pdf_out(sample, p)
+    logpdf = (jax.scipy.special.gammaln(sample + k)
+              - jax.scipy.special.gammaln(sample + 1.0)
+              - jax.scipy.special.gammaln(k)
+              + k * jnp.log(p) + sample * jnp.log1p(-p))
+    return _maybe_exp(logpdf, is_log)
+
+
+@register_op("_random_pdf_generalized_negative_binomial")
+def _random_pdf_generalized_negative_binomial(sample, mu, alpha,
+                                              is_log=False):
+    mu, alpha = _pdf_out(sample, mu), _pdf_out(sample, alpha)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    logpdf = (jax.scipy.special.gammaln(sample + r)
+              - jax.scipy.special.gammaln(sample + 1.0)
+              - jax.scipy.special.gammaln(r)
+              + r * jnp.log(p) + sample * jnp.log1p(-p))
+    return _maybe_exp(logpdf, is_log)
+
+
+@register_op("_random_pdf_dirichlet")
+def _random_pdf_dirichlet(sample, alpha, is_log=False):
+    # sample: (..., k) rows on the simplex; alpha: (..., k)
+    a = alpha
+    while a.ndim < sample.ndim:
+        a = a[..., None, :]
+    logpdf = (jnp.sum((a - 1.0) * jnp.log(sample), axis=-1)
+              + jax.scipy.special.gammaln(jnp.sum(a, axis=-1))
+              - jnp.sum(jax.scipy.special.gammaln(a), axis=-1))
+    return _maybe_exp(logpdf, is_log)

@@ -19,6 +19,9 @@ for _name in _list_ops():
 
 class _Contrib:
     def __getattr__(self, name):
+        if name in ("foreach", "while_loop", "cond"):
+            from . import control_flow as _cf
+            return getattr(_cf, name)
         for cand in (f"_contrib_{name}", name):
             if hasattr(_mod, cand):
                 return getattr(_mod, cand)

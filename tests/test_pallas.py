@@ -50,3 +50,28 @@ def test_flash_attention_fallback_odd_shapes():
     out = flash_attention(q, q, q, False, None, 128, 128, True)
     ref = local_attention(q, q, q)
     assert_almost_equal(onp.asarray(out), onp.asarray(ref), rtol=1e-5)
+
+
+@pytest.mark.parametrize("bq,bk", [(256, 128), (128, 256), (64, 128)])
+def test_flash_attention_causal_mixed_blocks(bq, bk):
+    """Regression: causal K-block count must cover the Q-block's LAST row
+    (wrong when block_q > block_k)."""
+    B, H, T, D = 1, 1, 256, 64
+    rng = onp.random.RandomState(2)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32") * 0.5)
+    k = jnp.asarray(rng.randn(B, H, T, D).astype("float32") * 0.5)
+    v = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    ref = local_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, None, bq, bk, True)
+    assert_almost_equal(onp.asarray(out), onp.asarray(ref), rtol=2e-4,
+                        atol=2e-4)
+
+
+def test_flash_attention_available_predicate():
+    from mxnet_tpu.ops.pallas_kernels import (flash_attention_available,
+                                              _HAS_PLTPU)
+    if not _HAS_PLTPU:
+        pytest.skip("no pltpu")
+    assert not flash_attention_available(100, 100, 64)
+    assert flash_attention_available(128, 128, 64)
+    assert not flash_attention_available(128, 100, 64)

@@ -1,0 +1,150 @@
+"""Random-sampler op corpus tests.
+
+Mirrors the reference's tests/python/unittest/test_random.py strategy:
+moment checks on large draws, per-row param semantics for `_sample_*`,
+pdf values vs closed forms, determinism under mx.random.seed.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_random_uniform_moments():
+    x = nd._random_uniform(low=2.0, high=4.0, shape=(50000,)).asnumpy()
+    assert x.min() >= 2.0 and x.max() <= 4.0
+    assert abs(x.mean() - 3.0) < 0.02
+
+
+def test_random_normal_moments():
+    x = nd._random_normal(loc=1.0, scale=2.0, shape=(50000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.05
+    assert abs(x.std() - 2.0) < 0.05
+
+
+def test_random_gamma_exponential_poisson():
+    g = nd._random_gamma(alpha=3.0, beta=2.0, shape=(20000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.15
+    e = nd._random_exponential(lam=2.0, shape=(20000,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.03
+    p = nd._random_poisson(lam=4.0, shape=(20000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.1
+
+
+def test_random_randint_and_like():
+    r = nd._random_randint(low=0, high=10, shape=(1000,)).asnumpy()
+    assert r.min() >= 0 and r.max() < 10 and r.dtype == onp.int32
+    base = nd.zeros((3, 4))
+    u = nd._random_uniform_like(base)
+    assert u.shape == (3, 4)
+    n = nd._random_normal_like(base, loc=5.0, scale=0.1)
+    assert abs(n.asnumpy().mean() - 5.0) < 0.3
+
+
+def test_sample_rowwise_shapes_and_values():
+    low = nd.array([0.0, 10.0])
+    high = nd.array([1.0, 20.0])
+    s = nd._sample_uniform(low, high, shape=(5000,)).asnumpy()
+    assert s.shape == (2, 5000)
+    assert s[0].max() <= 1.0 and s[1].min() >= 10.0
+    mu = nd.array([0.0, 100.0])
+    sg = nd.array([1.0, 1.0])
+    z = nd._sample_normal(mu, sg, shape=(5000,)).asnumpy()
+    assert abs(z[0].mean()) < 0.1 and abs(z[1].mean() - 100.0) < 0.1
+    lam = nd.array([1.0, 8.0])
+    pz = nd._sample_poisson(lam, shape=(5000,)).asnumpy()
+    assert abs(pz[0].mean() - 1.0) < 0.15 and abs(pz[1].mean() - 8.0) < 0.3
+
+
+def test_sample_gamma_rowwise():
+    a = nd.array([2.0, 9.0])
+    b = nd.array([1.0, 0.5])
+    g = nd._sample_gamma(a, b, shape=(5000,)).asnumpy()
+    assert abs(g[0].mean() - 2.0) < 0.2
+    assert abs(g[1].mean() - 4.5) < 0.3
+
+
+def test_sample_multinomial():
+    probs = nd.array([[0.0, 0.1, 0.9], [0.8, 0.2, 0.0]])
+    s = nd._sample_multinomial(probs, shape=(2000,)).asnumpy()
+    assert s.shape == (2, 2000)
+    assert (s[0] == 0).mean() < 0.01
+    assert abs((s[0] == 2).mean() - 0.9) < 0.05
+    assert abs((s[1] == 0).mean() - 0.8) < 0.05
+    samp, lp = nd._sample_multinomial(probs, shape=(10,), get_prob=True)
+    assert lp.shape == (2, 10)
+    assert float(lp.asnumpy().max()) <= 0.0
+
+
+def test_shuffle_and_zipfian():
+    x = nd.arange(100).reshape((100, 1))
+    y = nd._shuffle(x).asnumpy()
+    assert sorted(y.ravel().tolist()) == list(range(100))
+    s, tries = nd._sample_unique_zipfian(range_max=1000, shape=(50,))
+    sv = s.asnumpy()
+    assert sv.min() >= 0 and sv.max() < 1000
+    # zipfian: small ids much more likely
+    assert (sv < 100).mean() > 0.3
+
+
+def test_pdf_normal_uniform():
+    sample = nd.array([[0.0, 1.0]])
+    mu = nd.array([0.0])
+    sigma = nd.array([1.0])
+    p = nd._random_pdf_normal(sample, mu, sigma).asnumpy()
+    expect = onp.exp(-0.5 * onp.array([0.0, 1.0]) ** 2) / onp.sqrt(2 * onp.pi)
+    assert onp.allclose(p[0], expect, atol=1e-5)
+    u = nd._random_pdf_uniform(nd.array([[0.5, 3.0]]), nd.array([0.0]),
+                               nd.array([2.0])).asnumpy()
+    assert onp.allclose(u[0], [0.5, 0.0], atol=1e-6)
+
+
+def test_pdf_gamma_exponential_poisson():
+    s = nd.array([[1.0, 2.0]])
+    pg = nd._random_pdf_gamma(s, nd.array([2.0]), nd.array([1.0])).asnumpy()
+    expect = onp.array([1.0, 2.0]) * onp.exp(-onp.array([1.0, 2.0]))
+    assert onp.allclose(pg[0], expect, atol=1e-5)
+    pe = nd._random_pdf_exponential(s, nd.array([1.5])).asnumpy()
+    assert onp.allclose(pe[0], 1.5 * onp.exp(-1.5 * onp.array([1.0, 2.0])),
+                        atol=1e-5)
+    pp = nd._random_pdf_poisson(nd.array([[0.0, 3.0]]),
+                                nd.array([2.0])).asnumpy()
+    expect = onp.array([onp.exp(-2.0), 2.0 ** 3 * onp.exp(-2.0) / 6.0])
+    assert onp.allclose(pp[0], expect, atol=1e-5)
+
+
+def test_pdf_dirichlet():
+    s = nd.array([[0.3, 0.7]])
+    a = nd.array([1.0, 1.0])
+    p = nd._random_pdf_dirichlet(s, a).asnumpy()
+    assert onp.allclose(p, [1.0], atol=1e-5)
+
+
+def test_pdf_grad_flows():
+    from mxnet_tpu import autograd
+    mu = nd.array([0.5])
+    mu.attach_grad()
+    s = nd.array([[0.0]])
+    with autograd.record():
+        p = nd._random_pdf_normal(s, mu, nd.array([1.0]), is_log=True)
+    p.backward()
+    # d/dmu logN(0; mu,1) = (0-mu)*(-1) ... = (x-mu) => -0.5? compute:
+    # logpdf = -0.5(x-mu)^2 - ... ; d/dmu = (x-mu) = -0.5
+    assert abs(float(mu.grad.asnumpy()[0]) - (-0.5)) < 1e-5
+
+
+def test_seed_determinism():
+    mx.random.seed(42)
+    a = nd._random_uniform(shape=(10,)).asnumpy()
+    mx.random.seed(42)
+    b = nd._random_uniform(shape=(10,)).asnumpy()
+    assert onp.allclose(a, b)
+
+
+def test_negative_binomial_means():
+    x = nd._random_negative_binomial(k=4, p=0.5, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 4.0) < 0.3  # mean = k(1-p)/p
+    y = nd._random_generalized_negative_binomial(
+        mu=3.0, alpha=0.5, shape=(20000,)).asnumpy()
+    assert abs(y.mean() - 3.0) < 0.3

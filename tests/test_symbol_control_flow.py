@@ -1,0 +1,123 @@
+"""Symbolic control flow (sym.contrib.foreach/while_loop/cond).
+
+Mirrors tests/python/unittest/test_contrib_control_flow.py: symbolic
+subgraph ops must agree with the eager nd.contrib versions and support
+gradients through bind.
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_sym_foreach_cumsum():
+    data = sym.var("data")
+    init = sym.var("init")
+
+    def body(d, s):
+        out = d + s
+        return out, out
+
+    outs, final = sym.contrib.foreach(body, data, init)
+    ex = outs.bind(args={"data": nd.array([1.0, 2.0, 3.0]),
+                         "init": nd.array([0.0])})
+    y = ex.forward()[0].asnumpy()
+    assert onp.allclose(y.ravel(), [1.0, 3.0, 6.0])
+
+
+def test_sym_foreach_with_weight_closure():
+    data = sym.var("data")
+    init = sym.var("init")
+    w = sym.var("w")
+
+    def body(d, s):
+        out = d * w + s
+        return out, out
+
+    outs, final = sym.contrib.foreach(body, data, init)
+    ex = outs.bind(args={"data": nd.array([1.0, 2.0, 3.0]),
+                         "init": nd.array([0.0]),
+                         "w": nd.array([2.0])})
+    y = ex.forward()[0].asnumpy()
+    assert onp.allclose(y.ravel(), [2.0, 6.0, 12.0])
+    # grads flow through the scan to the closure weight
+    ex2 = outs.simple_bind(data=(3,), init=(1,), w=(1,))
+    ex2.forward(data=nd.array([1.0, 2.0, 3.0]), init=nd.array([0.0]),
+                w=nd.array([2.0]))
+    ex2.backward(out_grads=nd.ones((3, 1)))
+    # d/dw sum over outs: out1=w, out2=2w+out1, out3=3w+out2
+    # douts/dw = 1 + (2+1) + (3+2+1) = 10
+    assert abs(float(ex2.grad_dict["w"].asnumpy().ravel()[0]) - 10.0) < 1e-4
+
+
+def test_sym_while_loop():
+    x = sym.var("x")
+
+    def cond_fn(v):
+        return sym.sum(v) < 100.0
+
+    def func(v):
+        nv = v * 2.0
+        return nv, nv
+
+    outs, finals = sym.contrib.while_loop(cond_fn, func, [x],
+                                          max_iterations=20)
+    ex = finals[0].bind(args={"x": nd.array([1.0])})
+    y = float(ex.forward()[0].asnumpy().ravel()[0])
+    assert y == 128.0  # doubles until >= 100
+
+
+def test_sym_cond():
+    a = sym.var("a")
+    b = sym.var("b")
+    out = sym.contrib.cond(lambda x, y: sym.sum(x) < sym.sum(y),
+                           lambda x, y: x * 2.0,
+                           lambda x, y: y * 3.0,
+                           inputs=[a, b])
+    ex = out.bind(args={"a": nd.array([1.0]), "b": nd.array([5.0])})
+    assert float(ex.forward()[0].asnumpy()[0]) == 2.0
+    ex2 = out.bind(args={"a": nd.array([9.0]), "b": nd.array([5.0])})
+    assert float(ex2.forward()[0].asnumpy()[0]) == 15.0
+
+
+def test_sym_foreach_matches_nd():
+    data_v = onp.random.RandomState(0).randn(4, 3).astype("float32")
+
+    def body_nd(d, s):
+        out = d + s
+        return out, out
+
+    nd_outs, nd_final = nd.contrib.foreach(body_nd, nd.array(data_v),
+                                           nd.zeros((3,)))
+    data = sym.var("data")
+    init = sym.var("init")
+    s_outs, s_final = sym.contrib.foreach(body_nd, data, init)
+    ex = s_outs.bind(args={"data": nd.array(data_v), "init": nd.zeros((3,))})
+    assert onp.allclose(ex.forward()[0].asnumpy(), nd_outs.asnumpy(),
+                        atol=1e-6)
+
+
+def test_sym_while_loop_grad():
+    """Regression: reverse-mode grad through the _while_loop node (masked
+    lax.scan — lax.while_loop is not reverse-differentiable)."""
+    x = sym.var("x")
+    outs, finals = sym.contrib.while_loop(
+        lambda v: sym.sum(v) < 100.0,
+        lambda v: (v * 2.0, v * 2.0), [x], max_iterations=20)
+    ex = finals[0].simple_bind(x=(1,))
+    ex.forward(x=nd.array([1.0]))
+    assert float(ex.outputs[0].asnumpy()[0]) == 128.0
+    ex.backward(out_grads=nd.ones((1,)))
+    # final = x * 2^7 -> d/dx = 128
+    assert abs(float(ex.grad_dict["x"].asnumpy()[0]) - 128.0) < 1e-3
+
+
+def test_nd_while_loop_iter_count_semantics():
+    """Masked-scan rewrite must preserve outputs/final-var semantics."""
+    outs, finals = nd.contrib.while_loop(
+        lambda v: nd.sum(v) < 10.0,
+        lambda v: (v + 1.0, v + 1.0), [nd.array([0.0])],
+        max_iterations=32)
+    o = outs[0].asnumpy() if isinstance(outs, list) else outs.asnumpy()
+    assert float(finals[0].asnumpy()[0]) == 10.0
+    assert onp.allclose(o.ravel()[:10], onp.arange(1.0, 11.0))
