@@ -1,0 +1,137 @@
+"""Legacy / CamelCase / `_npx_*` alias registrations.
+
+The reference accumulates three generations of op naming: v0.x CamelCase
+internal names (`_Plus`, `_MulScalar`, ... — registered via add_alias in
+src/operator/tensor/elemwise_binary_op_basic.cc etc.), legacy-property ops
+(`crop`, `choose_element_0index`), and the numpy-extension `_npx_*`
+convention (src/operator/numpy_extension/, python/mxnet/_numpy_op_doc.py).
+All are the *same kernels* under other names, so here they are pure
+registry aliases onto the canonical ops (SURVEY.md Appendix A demands one
+registration mechanism covering both sets).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import _OPS, register_op
+
+__all__ = []
+
+# the handful of canonical names the corpus genuinely lacked
+register_op("_hypot_scalar")(
+    lambda data, scalar=0.0: jnp.hypot(data, jnp.asarray(scalar, data.dtype)))
+for _lname, _lfn in [("and", jnp.logical_and), ("or", jnp.logical_or),
+                     ("xor", jnp.logical_xor)]:
+    register_op(f"_logical_{_lname}_scalar", differentiable=False)(
+        (lambda f: lambda data, scalar=0.0:
+         f(data, scalar).astype(data.dtype))(_lfn))
+
+
+@register_op("_image_adjust_lighting", differentiable=False)
+def _image_adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """ref: src/operator/image/image_random.cc AdjustLighting — AlexNet-style
+    PCA lighting shift with fixed alpha coefficients."""
+    eigval = jnp.asarray([55.46, 4.794, 1.148], data.dtype)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], data.dtype)
+    alpha = jnp.asarray(alpha, data.dtype)
+    shift = (eigvec * alpha * eigval).sum(axis=1)
+    return data + shift.reshape((3,) + (1,) * (data.ndim - 3) + (1, 1)) \
+        if data.shape[-3] == 3 else data + shift
+
+
+# new-name -> canonical already-registered name
+_ALIASES = {
+    # v0.x CamelCase elemwise/scalar families
+    "_plus": "elemwise_add", "_minus": "elemwise_sub",
+    "_Plus": "elemwise_add", "_Minus": "elemwise_sub",
+    "_Mul": "_mul", "_Div": "_div",
+    "_Mod": "_mod", "_Power": "_power", "_Hypot": "_hypot",
+    "_Maximum": "_maximum", "_Minimum": "_minimum",
+    "_Equal": "broadcast_equal", "_Not_Equal": "broadcast_not_equal",
+    "_Greater": "broadcast_greater",
+    "_Greater_Equal": "broadcast_greater_equal",
+    "_Lesser": "broadcast_lesser", "_Lesser_Equal": "broadcast_lesser_equal",
+    "_Logical_And": "broadcast_logical_and",
+    "_Logical_Or": "broadcast_logical_or",
+    "_Logical_Xor": "broadcast_logical_xor",
+    "_PlusScalar": "_plus_scalar", "_MinusScalar": "_minus_scalar",
+    "_RMinusScalar": "_rminus_scalar", "_MulScalar": "_mul_scalar",
+    "_DivScalar": "_div_scalar", "_RDivScalar": "_rdiv_scalar",
+    "_ModScalar": "_mod_scalar", "_RModScalar": "_rmod_scalar",
+    "_PowerScalar": "_power_scalar", "_RPowerScalar": "_rpower_scalar",
+    "_HypotScalar": "_hypot_scalar",
+    "_MaximumScalar": "_maximum_scalar", "_MinimumScalar": "_minimum_scalar",
+    "_EqualScalar": "_equal_scalar", "_NotEqualScalar": "_not_equal_scalar",
+    "_GreaterScalar": "_greater_scalar",
+    "_GreaterEqualScalar": "_greater_equal_scalar",
+    "_LesserScalar": "_lesser_scalar",
+    "_LesserEqualScalar": "_lesser_equal_scalar",
+    "_LogicalAndScalar": "_logical_and_scalar",
+    "_LogicalOrScalar": "_logical_or_scalar",
+    "_LogicalXorScalar": "_logical_xor_scalar",
+    # broadcast spellings (ref: elemwise_binary_broadcast_op_basic.cc)
+    "broadcast_plus": "broadcast_add", "broadcast_minus": "broadcast_sub",
+    # legacy-property op spellings
+    "crop": "Crop",
+    "choose_element_0index": "pick",
+    "MakeLoss": "make_loss",
+    "CuDNNBatchNorm": "BatchNorm",
+    "_CrossDeviceCopy": "_copy",
+    # sampling convenience names (ref: sample_op.cc add_alias)
+    "uniform": "_random_uniform", "normal": "_random_normal",
+    "ravel_multi_index": "_ravel_multi_index",
+    "unravel_index": "_unravel_index",
+    # MKLDNN fused subgraph ops — on TPU the fusion is XLA's job, the
+    # unfused op is the same computation (ref: src/operator/subgraph/mkldnn/)
+    "_sg_mkldnn_conv": "Convolution",
+    "_sg_mkldnn_fully_connected": "FullyConnected",
+    # numpy-extension nn ops (ref: src/operator/numpy_extension/ and the
+    # `_npx_*` surface in python/mxnet/ndarray/numpy_extension/)
+    "_npx_activation": "Activation",
+    "_npx_batch_dot": "batch_dot",
+    "_npx_batch_flatten": "Flatten",
+    "_npx_batch_norm": "BatchNorm",
+    "_npx_cast": "Cast",
+    "_npx_convolution": "Convolution",
+    "_npx_deconvolution": "Deconvolution",
+    "_npx_dropout": "Dropout",
+    "_npx_embedding": "Embedding",
+    "_npx_fully_connected": "FullyConnected",
+    "_npx_gamma": "gamma",
+    "_npx_layer_norm": "LayerNorm",
+    "_npx_leaky_relu": "LeakyReLU",
+    "_npx_log_softmax": "log_softmax",
+    "_npx_multibox_detection": "_contrib_MultiBoxDetection",
+    "_npx_multibox_prior": "_contrib_MultiBoxPrior",
+    "_npx_multibox_target": "_contrib_MultiBoxTarget",
+    "_npx_one_hot": "one_hot",
+    "_npx_pick": "pick",
+    "_npx_pooling": "Pooling",
+    "_npx_reshape_like": "reshape_like",
+    "_npx_rnn": "RNN",
+    "_npx_roi_pooling": "ROIPooling",
+    "_npx_sequence_mask": "SequenceMask",
+    "_npx_slice": "slice",
+    "_npx_smooth_l1": "smooth_l1",
+    "_npx_softmax": "softmax",
+    "_npx_topk": "topk",
+}
+
+# _npx__image_* -> _image_* (ref: src/operator/image/ registered under both)
+for _img in ("adjust_lighting", "crop", "flip_left_right", "flip_top_bottom",
+             "normalize", "random_brightness", "random_color_jitter",
+             "random_contrast", "random_flip_left_right",
+             "random_flip_top_bottom", "random_hue", "random_lighting",
+             "random_saturation", "resize", "to_tensor"):
+    _ALIASES[f"_npx__image_{_img}"] = f"_image_{_img}"
+
+_missing = []
+for _new, _old in _ALIASES.items():
+    if _old in _OPS:
+        _OPS.setdefault(_new, _OPS[_old])
+    else:
+        _missing.append((_new, _old))
+if _missing:
+    raise RuntimeError(f"legacy alias targets not registered: {_missing}")

@@ -1,0 +1,230 @@
+"""NumPy-semantics op registrations (`_npi_*` / `_np_*` / `_npx_*`).
+
+The reference exposes a NumPy-compatible namespace `mx.np` whose ops are
+registered C++ kernels with numpy semantics (ref: src/operator/numpy/ —
+np_broadcast_reduce_op_value.cc, np_elemwise_broadcast_op.cc,
+np_init_op.cc, np_matrix_op.cc, np_tensordot_op.cc, np_true_divide.cc,
+np_cumsum.cc, random/np_uniform_op.cc ...; surfaced through
+python/mxnet/numpy/). Here each is a direct jax.numpy wrapper — jnp *is*
+the numpy-semantics tensor language on TPU — registered under the
+reference's internal op names so the generated `mx.np`/symbol surfaces and
+any code reaching for `_npi_*` ops port unchanged.
+
+`_npx_*` names (nn ops with numpy-array calling convention, ref:
+python/mxnet/_numpy_op_doc.py and src/operator/numpy_extension/) are
+registered as aliases of the canonical layer ops in legacy_aliases.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = []
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    return tuple(axis) if isinstance(axis, (tuple, list)) else int(axis)
+
+
+# ---------------------------------------------------------------------------
+# reductions (ref: src/operator/numpy/np_broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+for _name, _fn in [("sum", jnp.sum), ("max", jnp.max), ("min", jnp.min),
+                   ("prod", jnp.prod), ("mean", jnp.mean)]:
+    register_op(f"_np_{_name}", aliases=[f"_npi_{_name}"])(
+        (lambda f: lambda a, axis=None, dtype=None, keepdims=False,
+         initial=None: f(a, axis=_ax(axis), keepdims=keepdims)
+         .astype(dtype) if dtype else
+         f(a, axis=_ax(axis), keepdims=keepdims))(_fn))
+
+register_op("_npi_std")(
+    lambda a, axis=None, dtype=None, ddof=0, keepdims=False:
+    jnp.std(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims))
+register_op("_npi_var")(
+    lambda a, axis=None, dtype=None, ddof=0, keepdims=False:
+    jnp.var(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims))
+register_op("_npi_argmax", differentiable=False)(
+    lambda data, axis=None, keepdims=False:
+    jnp.argmax(data, axis=None if axis is None else int(axis),
+               keepdims=keepdims).astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# elementwise / comparison (ref: np_elemwise_broadcast_op.cc)
+# ---------------------------------------------------------------------------
+
+register_op("_npi_true_divide")(lambda lhs, rhs: jnp.true_divide(lhs, rhs))
+register_op("_npi_true_divide_scalar")(
+    lambda data, scalar=1.0: jnp.true_divide(data, scalar))
+register_op("_npi_rtrue_divide_scalar")(
+    lambda data, scalar=1.0: jnp.true_divide(scalar, data))
+
+for _name, _fn in [("maximum", jnp.maximum), ("minimum", jnp.minimum)]:
+    register_op(f"_npi_{_name}")((lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
+    register_op(f"_npi_{_name}_scalar")(
+        (lambda f: lambda data, scalar=0.0: f(data, scalar))(_fn))
+
+for _name, _fn in [("equal", jnp.equal), ("not_equal", jnp.not_equal),
+                   ("greater", jnp.greater), ("less", jnp.less),
+                   ("greater_equal", jnp.greater_equal),
+                   ("less_equal", jnp.less_equal)]:
+    register_op(f"_npi_{_name}", differentiable=False)(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
+    register_op(f"_npi_{_name}_scalar", differentiable=False)(
+        (lambda f: lambda data, scalar=0.0: f(data, scalar))(_fn))
+
+register_op("_npi_abs")(lambda data: jnp.abs(data))
+register_op("_npi_log")(lambda data: jnp.log(data))
+register_op("_npi_clip")(
+    lambda data, a_min=None, a_max=None: jnp.clip(data, a_min, a_max))
+
+
+# ---------------------------------------------------------------------------
+# init (ref: np_init_op.cc)
+# ---------------------------------------------------------------------------
+
+def _shape_t(shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape or ())
+
+
+register_op("_npi_zeros", differentiable=False)(
+    lambda shape=(), ctx=None, dtype="float32":
+    jnp.zeros(_shape_t(shape), dtype))
+register_op("_npi_ones", differentiable=False)(
+    lambda shape=(), ctx=None, dtype="float32":
+    jnp.ones(_shape_t(shape), dtype))
+register_op("_npi_full", differentiable=False)(
+    lambda shape=(), fill_value=0.0, ctx=None, dtype="float32":
+    jnp.full(_shape_t(shape), fill_value, dtype))
+register_op("_npi_arange", differentiable=False)(
+    lambda start=0.0, stop=None, step=1.0, ctx=None, dtype="float32":
+    jnp.arange(start, stop, step, dtype=dtype))
+register_op("_npi_linspace", differentiable=False)(
+    lambda start=0.0, stop=1.0, num=50, endpoint=True, ctx=None,
+    dtype="float32": jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                                  dtype=dtype))
+register_op("_np_zeros_like", differentiable=False)(
+    lambda a: jnp.zeros_like(a))
+register_op("_np_ones_like", differentiable=False)(
+    lambda a: jnp.ones_like(a))
+
+
+# ---------------------------------------------------------------------------
+# matrix / shape manipulation (ref: np_matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+register_op("_np_reshape", aliases=["_npi_reshape"])(
+    lambda a, newshape=(), order="C": jnp.reshape(a, newshape))
+register_op("_np_transpose")(
+    lambda a, axes=None: jnp.transpose(a, axes))
+register_op("_np_squeeze")(
+    lambda a, axis=None: jnp.squeeze(a, _ax(axis)))
+register_op("_np_broadcast_to")(
+    lambda array, shape=(): jnp.broadcast_to(array, _shape_t(shape)))
+register_op("_np_copy")(lambda a: jnp.copy(a))
+register_op("_np_repeat")(
+    lambda a, repeats=1, axis=None: jnp.repeat(a, repeats, axis=axis))
+register_op("_npi_expand_dims")(
+    lambda a, axis=0: jnp.expand_dims(a, int(axis)))
+register_op("_npi_concatenate", aliases=["_npi_concat"])(
+    lambda *args, dim=0, axis=None: jnp.concatenate(
+        args, axis=int(axis if axis is not None else dim)))
+register_op("_npi_stack")(
+    lambda *args, axis=0: jnp.stack(args, axis=int(axis)))
+register_op("_npi_swapaxes")(
+    lambda data, dim1=0, dim2=0: jnp.swapaxes(data, int(dim1), int(dim2)))
+register_op("_npi_tile")(
+    lambda A, reps=(): jnp.tile(A, tuple(reps) if not isinstance(reps, int)
+                                else reps))
+register_op("_npi_split", n_out=-1)(
+    lambda ary, indices_or_sections=1, axis=0:
+    tuple(jnp.split(ary, indices_or_sections, axis=int(axis))))
+register_op("_npi_slice")(
+    lambda data, begin=(), end=(), step=(): data[tuple(
+        slice(b, e, s if s not in (0, None) else None)
+        for b, e, s in zip(begin, end,
+                           step or (None,) * len(begin)))])
+register_op("_npi_gather_nd", differentiable=False)(
+    lambda data, indices: data[tuple(indices.astype(jnp.int32))])
+register_op("_npi_rnn_param_concat", aliases=["_rnn_param_concat"])(
+    lambda *args, dim=0: jnp.concatenate([a.reshape(-1) for a in args],
+                                         axis=0))
+
+
+# _npi_slice_assign / _npi_slice_assign_scalar / _npi_scatter_set_nd are
+# registered as aliases of the canonical ops in extra_ops.py.
+
+
+# ---------------------------------------------------------------------------
+# dot / tensordot (ref: np_dot.cc, np_tensordot_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("_np_dot")
+def _np_dot(a, b):
+    return jnp.dot(a, b)
+
+
+@register_op("_npi_tensordot")
+def _npi_tensordot(a, b, a_axes_summed=(), b_axes_summed=()):
+    return jnp.tensordot(a, b, axes=(tuple(a_axes_summed),
+                                     tuple(b_axes_summed)))
+
+
+@register_op("_npi_tensordot_int_axes")
+def _npi_tensordot_int_axes(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=int(axes))
+
+
+# ---------------------------------------------------------------------------
+# random (ref: src/operator/numpy/random/) — threefry keys via needs_rng
+# ---------------------------------------------------------------------------
+
+def _key(raw):
+    return jax.random.wrap_key_data(raw)
+
+
+@register_op("_npi_random_uniform", aliases=["_npi_uniform"],
+             differentiable=False, needs_rng=True)
+def _npi_uniform(raw_key, low=0.0, high=1.0, size=None, ctx=None,
+                 dtype="float32"):
+    return jax.random.uniform(_key(raw_key), _shape_t(size),
+                              jnp.dtype(dtype or "float32"), low, high)
+
+
+@register_op("_npi_random_normal", aliases=["_npi_normal"],
+             differentiable=False, needs_rng=True)
+def _npi_normal(raw_key, loc=0.0, scale=1.0, size=None, ctx=None,
+                dtype="float32"):
+    return loc + scale * jax.random.normal(_key(raw_key), _shape_t(size),
+                                           jnp.dtype(dtype or "float32"))
+
+
+@register_op("_npi_random_randint", aliases=["_npi_randint"],
+             differentiable=False, needs_rng=True)
+def _npi_randint(raw_key, low=0, high=None, size=None, ctx=None,
+                 dtype="int32"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(raw_key), _shape_t(size), int(low),
+                              int(high), jnp.dtype(dtype or "int32"))
+
+
+@register_op("_npi_multinomial", differentiable=False, needs_rng=True)
+def _npi_multinomial(*arrays, n=1, pvals=None, size=None):
+    # arrays is (pvals, key) when pvals arrives as a tensor, else (key,)
+    raw_key = arrays[-1]
+    p = arrays[0] if len(arrays) > 1 else jnp.asarray(pvals)
+    counts = jax.random.multinomial(
+        _key(raw_key), float(n), p,
+        shape=(_shape_t(size) + p.shape) if size is not None else None)
+    return counts.astype(jnp.int64)
+
+
+@register_op("_np__random_shuffle", differentiable=False, needs_rng=True)
+def _np_random_shuffle(data, raw_key):
+    return jax.random.permutation(_key(raw_key), data, axis=0)

@@ -1,0 +1,317 @@
+"""Tests for the op-corpus completion: init/assign ops, multi-tensor
+optimizer updates, RPN/deformable vision ops, DGL sampling, npi namespace.
+
+Mirrors the reference's unit-test strategy (SURVEY.md §4): seeded numpy
+reference comparisons (tests/python/unittest/test_operator.py style).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _np(x):
+    return onp.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+
+
+def test_init_ops_registered():
+    out = nd._zeros(shape=(2, 3))
+    assert out.shape == (2, 3) and _np(out).sum() == 0
+    assert _np(nd._ones(shape=(4,))).sum() == 4
+    assert _np(nd._full(shape=(2, 2), value=3.5)).sum() == 14.0
+    eye = _np(nd._eye(N=3))
+    assert onp.allclose(eye, onp.eye(3))
+    ar = _np(nd._arange(start=0, stop=6, step=1, repeat=2))
+    assert onp.allclose(ar, onp.repeat(onp.arange(6), 2))
+    ls = _np(nd._linspace(start=0, stop=1, num=5))
+    assert onp.allclose(ls, onp.linspace(0, 1, 5))
+
+
+def test_slice_assign():
+    x = nd.zeros((4, 5))
+    y = nd.ones((2, 3))
+    out = nd._slice_assign(x, y, begin=(1, 1), end=(3, 4))
+    expect = onp.zeros((4, 5))
+    expect[1:3, 1:4] = 1
+    assert onp.allclose(_np(out), expect)
+    out2 = nd._slice_assign_scalar(x, begin=(0, 0), end=(2, 2), scalar=7.0)
+    assert _np(out2)[:2, :2].sum() == 28.0
+
+
+def test_scatter_set_nd():
+    x = nd.zeros((3, 3))
+    idx = nd.array(onp.array([[0, 2], [1, 0]], dtype="int32"))
+    vals = nd.array(onp.array([5.0, 9.0], dtype="float32"))
+    out = nd._scatter_set_nd(x, vals, idx, shape=(3, 3))
+    e = onp.zeros((3, 3))
+    e[0, 1], e[2, 0] = 5.0, 9.0
+    assert onp.allclose(_np(out), e)
+
+
+def test_histogram_cumsum():
+    x = nd.array(onp.array([0.1, 0.9, 0.4, 0.6, 0.4], dtype="float32"))
+    cnt, edges = nd._histogram(x, bin_cnt=2, range=(0.0, 1.0))
+    assert _np(cnt).tolist() == [3, 2]
+    c = nd.cumsum(nd.array(onp.arange(4, dtype="float32")), axis=0)
+    assert onp.allclose(_np(c), [0, 1, 3, 6])
+
+
+def test_sparse_retain_op():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    keep = nd.array(onp.array([0, 2], dtype="int32"))
+    out = _np(nd._sparse_retain(data, keep))
+    assert out[1].sum() == 0 and out[3].sum() == 0
+    assert onp.allclose(out[0], [0, 1, 2]) and onp.allclose(out[2], [6, 7, 8])
+
+
+def test_amp_multicast():
+    a = nd.array(onp.ones((2,), dtype="float16"))
+    b = nd.array(onp.ones((2,), dtype="float32"))
+    outs = nd.amp_multicast(a, b, num_outputs=2)
+    assert all(str(o.dtype) == "float32" for o in outs)
+    # narrow cast picks the narrowest FLOAT dtype, never an int input
+    c = nd.array(onp.ones((2,), dtype="int32"))
+    outs = nd.amp_multicast(a, b, c, num_outputs=3, cast_narrow=True)
+    assert all(str(o.dtype) == "float16" for o in outs)
+
+
+def test_multi_sgd_family():
+    w = [onp.random.RandomState(i).randn(3, 2).astype("float32")
+         for i in range(2)]
+    g = [onp.full((3, 2), 0.5, "float32") for _ in range(2)]
+    arrays = [nd.array(a) for pair in zip(w, g) for a in pair]
+    outs = nd.multi_sgd_update(*arrays, lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                               num_weights=2)
+    assert onp.allclose(_np(outs[0]), w[0] - 0.1 * 0.5, atol=1e-6)
+    assert onp.allclose(_np(outs[1]), w[1] - 0.2 * 0.5, atol=1e-6)
+
+    mom = [onp.full((3, 2), 0.2, "float32") for _ in range(2)]
+    arrays = [nd.array(a) for trip in zip(w, g, mom) for a in trip]
+    outs = nd.multi_sgd_mom_update(*arrays, lrs=(0.1, 0.1), wds=(0.0, 0.0),
+                                   momentum=0.9, num_weights=2)
+    # (w, mom) pairs out; momentum state actually advances
+    assert len(outs) == 4
+    new_m = 0.9 * 0.2 - 0.1 * 0.5
+    assert onp.allclose(_np(outs[1]), new_m, atol=1e-6)
+    assert onp.allclose(_np(outs[0]), w[0] + new_m, atol=1e-6)
+
+    w32 = [a.astype("float32") for a in w]
+    wh = [a.astype("float16") for a in w]
+    arrays = [nd.array(a) for trip in zip(wh, g, w32) for a in trip]
+    outs = nd.multi_mp_sgd_update(*arrays, lrs=(0.1, 0.1), wds=(0.0, 0.0),
+                                  num_weights=2)
+    # (w, w32) pairs out; master copy advances in fp32
+    assert len(outs) == 4
+    assert str(outs[0].dtype) == "float16"
+    assert str(outs[1].dtype) == "float32"
+    assert onp.allclose(_np(outs[1]), w32[0] - 0.1 * 0.5, atol=1e-6)
+
+    arrays = [nd.array(a) for quad in zip(wh, g, mom, w32) for a in quad]
+    outs = nd.multi_mp_sgd_mom_update(*arrays, lrs=(0.1, 0.1),
+                                      wds=(0.0, 0.0), momentum=0.9,
+                                      num_weights=2)
+    assert len(outs) == 6
+    assert onp.allclose(_np(outs[1]), new_m, atol=1e-6)
+    assert onp.allclose(_np(outs[2]), w32[0] + new_m, atol=1e-6)
+
+
+def test_mp_nag_and_group_adagrad():
+    w = onp.ones((4, 2), "float32")
+    g = onp.full((4, 2), 0.1, "float32")
+    outs = nd.mp_nag_mom_update(nd.array(w.astype("float16")), nd.array(g),
+                                nd.array(onp.zeros_like(w)), nd.array(w),
+                                lr=0.1, momentum=0.9)
+    assert len(outs) == 3
+    assert str(outs[0].dtype) == "float16"
+    assert str(outs[2].dtype) == "float32"  # updated master weights
+    assert not onp.allclose(_np(outs[2]), w)
+    w2, h2 = nd._contrib_group_adagrad_update(
+        nd.array(w), nd.array(g), nd.array(onp.zeros((4, 1), "float32")),
+        lr=0.5)
+    assert _np(h2).shape == (4, 1)
+    assert (_np(w2) < w).all()
+
+
+def test_boolean_mask():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    mask = nd.array(onp.array([1, 0, 1, 0], dtype="float32"))
+    out = _np(nd.contrib.boolean_mask(data, mask))
+    assert out.shape == (2, 3)
+    assert onp.allclose(out[1], [6, 7, 8])
+
+
+def test_proposal_shapes_and_validity():
+    rs = onp.random.RandomState(0)
+    N, A, H, W = 1, 9, 8, 8
+    cls_prob = nd.array(rs.uniform(0, 1, (N, 2 * A, H, W)).astype("float32"))
+    bbox_pred = nd.array(rs.uniform(-0.2, 0.2,
+                                    (N, 4 * A, H, W)).astype("float32"))
+    im_info = nd.array(onp.array([[128, 128, 1.0]], dtype="float32"))
+    rois, scores = nd._contrib_Proposal(
+        cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=200,
+        rpn_post_nms_top_n=40, threshold=0.7, rpn_min_size=4,
+        scales=(8, 16, 32), ratios=(0.5, 1, 2), output_score=True)
+    r = _np(rois)
+    assert r.shape == (40, 5)
+    assert (r[:, 0] == 0).all()
+    # boxes inside the image
+    assert (r[:, 1] >= 0).all() and (r[:, 3] <= 127).all()
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+    # MultiProposal agrees on batch handling
+    rois2, _ = nd._contrib_MultiProposal(
+        cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=200,
+        rpn_post_nms_top_n=40, threshold=0.7, rpn_min_size=4,
+        scales=(8, 16, 32), ratios=(0.5, 1, 2))
+    assert _np(rois2).shape == (40, 5)
+
+
+def test_psroi_pooling():
+    C_out, G = 2, 3
+    data = nd.array(onp.random.RandomState(1).uniform(
+        0, 1, (1, C_out * G * G, 16, 16)).astype("float32"))
+    rois = nd.array(onp.array([[0, 0, 0, 63, 63]], dtype="float32"))
+    out = nd._contrib_PSROIPooling(data, rois, spatial_scale=0.25,
+                                   output_dim=C_out, pooled_size=G,
+                                   group_size=G)
+    assert _np(out).shape == (1, C_out, G, G)
+    assert onp.isfinite(_np(out)).all()
+
+
+def test_deformable_convolution_matches_plain_conv_at_zero_offset():
+    rs = onp.random.RandomState(2)
+    x = rs.randn(1, 2, 6, 6).astype("float32")
+    wgt = rs.randn(3, 2, 3, 3).astype("float32")
+    off = onp.zeros((1, 2 * 9, 4, 4), "float32")
+    out = nd._contrib_DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(wgt), kernel=(3, 3),
+        num_filter=3, no_bias=True)
+    ref = nd.Convolution(nd.array(x), nd.array(wgt), kernel=(3, 3),
+                         num_filter=3, no_bias=True)
+    assert onp.allclose(_np(out), _np(ref), atol=1e-3)
+
+
+def test_deformable_psroi_and_rroi():
+    rs = onp.random.RandomState(3)
+    data = nd.array(rs.uniform(0, 1, (1, 8, 12, 12)).astype("float32"))
+    rois = nd.array(onp.array([[0, 4, 4, 40, 40]], dtype="float32"))
+    out, _ = nd._contrib_DeformablePSROIPooling(
+        data, rois, spatial_scale=0.25, output_dim=2, group_size=2,
+        pooled_size=2, no_trans=True)
+    assert _np(out).shape == (1, 2, 2, 2)
+    rrois = nd.array(onp.array([[0, 24, 24, 16, 8, 30.0]], dtype="float32"))
+    out2 = nd._contrib_RROIAlign(data, rrois, pooled_size=(2, 2),
+                                 spatial_scale=0.25)
+    assert _np(out2).shape == (1, 8, 2, 2)
+    assert onp.isfinite(_np(out2)).all()
+
+
+def _toy_graph():
+    # 5-vertex ring with self-referential edge ids
+    indptr = onp.array([0, 2, 4, 6, 8, 10], "int64")
+    indices = onp.array([1, 4, 0, 2, 1, 3, 2, 4, 3, 0], "int64")
+    data = onp.arange(10, dtype="float32")
+    return indptr, indices, data
+
+
+def test_dgl_sampling_and_subgraph():
+    indptr, indices, data = _toy_graph()
+    seeds = nd.array(onp.array([0], "int64"))
+    outs = nd._contrib_dgl_csr_neighbor_uniform_sample(
+        nd.array(indptr), nd.array(indices), nd.array(data), seeds,
+        num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    verts = _np(outs[0])
+    assert verts[0] == 0 and (verts >= -1).all()
+    sub_indptr = _np(outs[1])
+    assert sub_indptr[-1] >= 0
+    # vertex-induced subgraph on {0,1,2}
+    outs2 = nd._contrib_dgl_subgraph(
+        nd.array(indptr), nd.array(indices), nd.array(data),
+        nd.array(onp.array([0, 1, 2], "int64")), num_args=2,
+        return_mapping=True)
+    sp, cols = _np(outs2[0]), _np(outs2[1])
+    assert sp[-1] == len(cols)
+    assert set(cols.tolist()) <= {0, 1, 2}
+    # adjacency: same pattern, unit data
+    a_indptr, a_indices, a_data = nd._contrib_dgl_adjacency(
+        nd.array(indptr), nd.array(indices), nd.array(data))
+    assert onp.allclose(_np(a_data), 1.0)
+
+
+def test_npi_namespace_ops():
+    a = nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    assert onp.allclose(_np(nd._np_sum(a, axis=1)), [3, 12])
+    assert onp.allclose(_np(nd._npi_mean(a)), 2.5)
+    assert onp.allclose(_np(nd._npi_std(a)), onp.arange(6).std())
+    assert _np(nd._npi_tensordot_int_axes(a, nd.array(
+        onp.ones((3, 2), "float32")), axes=1)).shape == (2, 2)
+    assert onp.allclose(_np(nd._npi_true_divide_scalar(a, scalar=2.0)),
+                        onp.arange(6).reshape(2, 3) / 2.0)
+    s = nd._npi_split(a, indices_or_sections=3, axis=1)
+    assert len(s) == 3 and _np(s[0]).shape == (2, 1)
+    st = nd._npi_stack(a, a, axis=0)
+    assert _np(st).shape == (2, 2, 3)
+    out = nd._npi_slice_assign_scalar(a, begin=(0, 0), end=(1, 2),
+                                      scalar=9.0)
+    assert _np(out)[0, :2].tolist() == [9.0, 9.0]
+    assert _np(nd._npi_random_uniform(low=0, high=1, size=(3, 3))).shape \
+        == (3, 3)
+    sh = _np(nd._np__random_shuffle(nd.array(onp.arange(10,
+                                                        dtype="float32"))))
+    assert sorted(sh.tolist()) == list(range(10))
+
+
+def test_legacy_aliases_resolve():
+    a = nd.array(onp.array([1.0, 2.0], dtype="float32"))
+    b = nd.array(onp.array([3.0, 4.0], dtype="float32"))
+    assert onp.allclose(_np(nd._Plus(a, b)), [4, 6])
+    assert onp.allclose(_np(nd._MulScalar(a, scalar=3.0)), [3, 6])
+    assert onp.allclose(_np(nd._Maximum(a, b)), [3, 4])
+    assert onp.allclose(_np(nd.broadcast_plus(a, b)), [4, 6])
+    assert onp.allclose(_np(nd._hypot_scalar(a, scalar=0.0)), [1, 2])
+    # npx nn aliases hit the canonical kernels
+    x = nd.array(onp.random.RandomState(0).randn(2, 4).astype("float32"))
+    w = nd.array(onp.random.RandomState(1).randn(3, 4).astype("float32"))
+    bb = nd.array(onp.zeros(3, "float32"))
+    y = nd._npx_fully_connected(x, w, bb, num_hidden=3)
+    assert _np(y).shape == (2, 3)
+
+
+def test_unsupported_ops_raise():
+    with pytest.raises(mx.base.MXNetError):
+        nd._TensorRT()
+    with pytest.raises(mx.base.MXNetError):
+        nd._Native()
+
+
+def test_custom_op_via_registry():
+    from mxnet_tpu import operator
+
+    @operator.register("scale2x_extra")
+    class Scale2Prop(operator.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 2)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 2)
+            return Op()
+
+    x = nd.array(onp.array([1.0, 2.0], dtype="float32"))
+    y = nd.Custom(x, op_type="scale2x_extra")
+    y = y[0] if isinstance(y, (list, tuple)) else y
+    assert onp.allclose(_np(y), [2, 4])
+
+
+def test_identity_attach_kl_sparse_reg():
+    from mxnet_tpu import autograd
+    x = nd.array(onp.array([0.5, -0.5], dtype="float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                         penalty=0.001)
+        z = y.sum()
+    z.backward()
+    assert onp.isfinite(_np(x.grad)).all()
