@@ -11,6 +11,14 @@ mx.gluon, mx.sym, mx.mod, mx.optimizer, mx.metric, mx.io, mx.kv, ...).
 """
 __version__ = "0.1.0"
 
+
+# Wire this process into a multi-worker job before anything touches the
+# XLA backend, when launched by tools/launch.py (ref role: the DMLC_ROLE
+# bootstrap that runs on `import mxnet`, python/mxnet/kvstore_server.py:76).
+from .base import initialize_distributed as _init_dist
+
+_init_dist()
+
 from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus  # noqa: F401
 

@@ -169,3 +169,32 @@ def get_logger(name: str = "mxnet_tpu", level=logging.INFO) -> logging.Logger:
         logger.propagate = False
         _LOGGER = logger
     return logger
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None, **kwargs):
+    """Wire this process into a multi-worker jax.distributed job.
+
+    Single implementation behind both the import-time bootstrap
+    (mxnet_tpu/__init__.py) and parallel.initialize_distributed (ref role:
+    the DMLC_ROLE/DMLC_PS_ROOT_URI wiring of the ps-lite tracker,
+    python/mxnet/kvstore_server.py:76 and tools/launch.py:29). Explicit
+    arguments win; otherwise the MX_COORDINATOR / MX_NUM_WORKERS /
+    MX_WORKER_ID env set by tools/launch.py is used; unset values stay
+    None so jax can auto-detect cluster shape (TPU pod runtimes).
+    Idempotent; no-op when no coordinator is known."""
+    import os
+    import jax
+    if jax.distributed.is_initialized():
+        return
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MX_COORDINATOR")
+    if coordinator_address is None:
+        return
+    if num_processes is None and "MX_NUM_WORKERS" in os.environ:
+        num_processes = int(os.environ["MX_NUM_WORKERS"])
+    if process_id is None and "MX_WORKER_ID" in os.environ:
+        process_id = int(os.environ["MX_WORKER_ID"])
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)

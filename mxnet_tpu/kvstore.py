@@ -197,9 +197,12 @@ class KVStoreDist(KVStoreBase):
     the global device mesh; sync semantics ≙ kSyncMode)."""
 
     def __init__(self, type_name="dist_sync"):
+        from .parallel import initialize_distributed
+        initialize_distributed()  # wire ranks from tools/launch.py env
         super().__init__()
         self._type = type_name
         self._initialized = jax.process_count() > 1
+        self._residuals = {}  # per-key error feedback for 2-bit compression
 
     @property
     def rank(self) -> int:
@@ -212,10 +215,23 @@ class KVStoreDist(KVStoreBase):
     def _global_reduce(self, key, val: NDArray) -> NDArray:
         if jax.process_count() <= 1:
             return val
-        # allreduce across processes via a tiny pmap-psum program per key
-        # (DCN path; batched in parallel/allreduce for the hot loop)
+        data = val._data
+        if self._compression.get("type") == "2bit":
+            # quantize locally with error feedback, decompress, then sum —
+            # the same math as each worker pushing quantized grads and the
+            # server accumulating dequantized values
+            # (ref: kvstore_dist.h:356-376 + kvstore_dist_server.h:602)
+            from .parallel import (grad_compression_2bit,
+                                   grad_decompression_2bit)
+            residual = self._residuals.get(key)
+            if residual is None or residual.shape != data.shape:
+                residual = jnp.zeros_like(data)
+            q, new_residual = grad_compression_2bit(
+                data, residual, float(self._compression["threshold"]))
+            self._residuals[key] = new_residual
+            data = grad_decompression_2bit(q).astype(data.dtype)
         from .parallel import allreduce_across_processes
-        return _wrap(allreduce_across_processes(val._data))
+        return _wrap(allreduce_across_processes(data))
 
     def barrier(self):
         """ref: ps::Postoffice::Barrier (kvstore_dist.h:53)."""

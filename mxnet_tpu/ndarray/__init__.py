@@ -57,3 +57,39 @@ def Custom(*inputs, op_type=None, **kwargs):
     (python/mxnet/operator.py)."""
     from ..operator import invoke_custom
     return invoke_custom(op_type, *inputs, **kwargs)
+
+
+def _contrib_boolean_mask(data, index, axis=0):
+    """ref: src/operator/contrib/boolean_mask.cc — dynamic-shape gather of
+    the rows selected by a 0/1 mask, differentiable.
+
+    Defined at the NDArray layer (shadowing the generated registry
+    wrapper) because the dynamic output shape cannot be re-traced by
+    jax.vjp; the backward is a tape custom_backward scatter, the same
+    mechanism nd.Custom uses."""
+    import jax.numpy as jnp
+    import numpy as onp
+    from .. import autograd
+
+    mask = onp.asarray(index.asnumpy()).astype(bool)
+    idx = jnp.asarray(onp.nonzero(mask)[0], jnp.int32)
+    out = jnp.take(data._data, idx, axis=axis)
+    out_nd = _wrap(out)
+    if autograd.is_recording():
+        tape = autograd.current_tape()
+
+        def custom_backward(cotangents, _idx=idx, _axis=axis,
+                            _shape=data._data.shape,
+                            _dtype=data._data.dtype,
+                            _imask=index._data):
+            g = jnp.zeros(_shape, _dtype)
+            moved = jnp.moveaxis(g, _axis, 0)
+            cot = jnp.moveaxis(cotangents[0].astype(_dtype), _axis, 0)
+            moved = moved.at[_idx].set(cot)
+            return (jnp.moveaxis(moved, 0, _axis),
+                    jnp.zeros_like(_imask))
+
+        tape.record(fn=None, in_arrays=[data._data, index._data],
+                    out_arrays=[out], in_owners=[data, index],
+                    custom_backward=custom_backward)
+    return out_nd

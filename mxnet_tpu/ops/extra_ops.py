@@ -128,7 +128,8 @@ def cumsum(a, axis=None, dtype=None):
              aliases=["histogram"])
 def _histogram(data, *bins, bin_cnt=None, range=None):
     """ref: src/operator/tensor/histogram.cc — either an explicit bin-edge
-    tensor or (bin_cnt, range) scalars."""
+    tensor or (bin_cnt, range) scalars. The single canonical histogram op
+    (also exposed as `histogram`)."""
     if bins:
         cnt, edges = jnp.histogram(data.ravel(), bins=bins[0])
     else:
@@ -160,10 +161,12 @@ def amp_multicast(*data, num_outputs=1, cast_narrow=False):
 
 
 @register_op("_contrib_boolean_mask", differentiable=False)
-def boolean_mask(data, index, axis=0):
+def boolean_mask_raw(data, index, axis=0):
     """ref: src/operator/contrib/boolean_mask.cc — dynamic-shape output,
     eager/host only (the reference likewise forbids it in symbols without
-    a known nnz)."""
+    a known nnz). The differentiable NDArray-level wrapper (tape
+    custom-backward, since a dynamic gather cannot be re-traced by vjp)
+    lives in ndarray/__init__.py."""
     keep = onp.asarray(index).astype(bool)
     return jnp.compress(keep, data, axis=axis)
 
@@ -237,35 +240,40 @@ def multi_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
                          rescale_grad=1.0, clip_gradient=-1.0,
                          num_weights=1):
     """ref: optimizer_op.cc multi_sgd_mom_update — (w, g, mom) input
-    triples. The reference mutates mom in place; functionally that is
-    (new_w, new_mom) pairs out, matching sgd_mom_update above."""
+    triples. The reference returns the num_weights updated weights and
+    mutates mom in place; functionally outputs[:num_weights] are the
+    weights (reference indexing preserved) and outputs[num_weights:] are
+    the advanced momentum buffers."""
     n = int(num_weights)
     lrs, wds = _listify(lrs, n), _listify(wds, n)
-    out = []
+    ws, moms = [], []
     for i in range(n):
         w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
         g = _clip_rescale(g, rescale_grad, clip_gradient) + wds[i] * w
         new_m = momentum * m - lrs[i] * g
-        out.extend((w + new_m, new_m))
-    return tuple(out)
+        ws.append(w + new_m)
+        moms.append(new_m)
+    return tuple(ws + moms)
 
 
 @register_op("multi_mp_sgd_update", n_out=-1)
 def multi_mp_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
                         clip_gradient=-1.0, num_weights=1):
     """ref: optimizer_op.cc multi_mp_sgd_update — (w, g, w32) input
-    triples; fp32 master copy drives the update. Outputs (new_w, new_w32)
-    pairs, matching mp_sgd_update above."""
+    triples; fp32 master copy drives the update. outputs[:num_weights] are
+    the low-precision weights (reference indexing preserved);
+    outputs[num_weights:] are the advanced fp32 master copies."""
     n = int(num_weights)
     lrs, wds = _listify(lrs, n), _listify(wds, n)
-    out = []
+    ws, w32s = [], []
     for i in range(n):
         w, g, w32 = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
         g32 = _clip_rescale(g.astype(jnp.float32), rescale_grad,
                             clip_gradient) + wds[i] * w32
         new_w32 = w32 - lrs[i] * g32
-        out.extend((new_w32.astype(w.dtype), new_w32))
-    return tuple(out)
+        ws.append(new_w32.astype(w.dtype))
+        w32s.append(new_w32)
+    return tuple(ws + w32s)
 
 
 @register_op("multi_mp_sgd_mom_update", n_out=-1)
@@ -273,19 +281,22 @@ def multi_mp_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
                             rescale_grad=1.0, clip_gradient=-1.0,
                             num_weights=1):
     """ref: optimizer_op.cc multi_mp_sgd_mom_update — (w, g, mom, w32)
-    input quads; outputs (new_w, new_mom, new_w32) triples, matching
-    mp_sgd_mom_update above."""
+    input quads. outputs[:num_weights] are the low-precision weights
+    (reference indexing preserved); then num_weights momenta, then
+    num_weights fp32 master copies."""
     n = int(num_weights)
     lrs, wds = _listify(lrs, n), _listify(wds, n)
-    out = []
+    ws, moms, w32s = [], [], []
     for i in range(n):
         w, g, m, w32 = arrays[4 * i:4 * i + 4]
         g32 = _clip_rescale(g.astype(jnp.float32), rescale_grad,
                             clip_gradient) + wds[i] * w32
         new_m = momentum * m - lrs[i] * g32
         new_w32 = w32 + new_m
-        out.extend((new_w32.astype(w.dtype), new_m, new_w32))
-    return tuple(out)
+        ws.append(new_w32.astype(w.dtype))
+        moms.append(new_m)
+        w32s.append(new_w32)
+    return tuple(ws + moms + w32s)
 
 
 @register_op("mp_nag_mom_update", n_out=3)
@@ -809,7 +820,18 @@ def dgl_graph_compact(indptr, indices, data, *vids_arrays, num_args=2,
 @register_op("Custom", n_out=-1)
 def custom(*inputs, op_type=None, **kwargs):
     """ref: src/operator/custom/custom-inl.h — dispatch to a Python
-    CustomOp registered via mxnet_tpu.operator.register."""
+    CustomOp registered via mxnet_tpu.operator.register.
+
+    Gradient-correct custom backward only flows through nd.Custom (which
+    records the user's backward on the tape); this raw registry path would
+    silently substitute jax.vjp of the forward, so it refuses to record."""
+    from .. import autograd
+    from ..base import MXNetError
+    if autograd.is_recording():
+        raise MXNetError(
+            "the registry-level Custom op cannot record gradients (it "
+            "would ignore the user-defined backward); call nd.Custom "
+            "inside autograd.record() instead")
     from ..operator import invoke_custom
     from ..ndarray.ndarray import _wrap
     outs = invoke_custom(op_type, *[_wrap(i) for i in inputs], **kwargs)

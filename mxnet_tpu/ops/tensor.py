@@ -536,12 +536,6 @@ def boolean_mask(data, index, axis=0):
 # init-like ops needing no input (exposed via creation API); histogram
 # ---------------------------------------------------------------------------
 
-@register_op("histogram", differentiable=False)
-def histogram(data, bin_cnt=10, range=None):
-    h, edges = jnp.histogram(data, bins=bin_cnt, range=range)
-    return h.astype(jnp.float32), edges
-
-
 @register_op("khatri_rao")
 def khatri_rao(*args):
     out = args[0]

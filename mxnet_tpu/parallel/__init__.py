@@ -20,16 +20,6 @@ from .ring_attention import (  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401
 
 
-def initialize_distributed(coordinator_address=None, num_processes=None,
-                           process_id=None, **kwargs):
-    """Multi-host init (ref role: ps-lite scheduler wiring via DMLC_* env,
-    python/mxnet/kvstore_server.py:76; here jax.distributed over DCN)."""
-    import os
-    import jax
-    if coordinator_address is None:
-        coordinator_address = os.environ.get("MX_COORDINATOR")
-    if coordinator_address is None:
-        return  # single-process
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id, **kwargs)
+# Multi-host init (ref role: ps-lite scheduler wiring via DMLC_* env,
+# python/mxnet/kvstore_server.py:76; here jax.distributed over DCN).
+from ..base import initialize_distributed  # noqa: F401

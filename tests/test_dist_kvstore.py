@@ -1,0 +1,29 @@
+"""Launch the multi-process dist_sync kvstore test through tools/launch.py.
+
+Mirrors the reference's distributed test tier (SURVEY.md §4: multiple
+processes on one machine via `tools/launch.py -n <workers> --launcher
+local`), with jax.distributed+Gloo standing in for the ps-lite tracker.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.timeout(300)
+def test_dist_sync_kvstore_two_workers():
+    env = dict(os.environ)
+    # the worker forces the CPU backend in-process; drop any virtual-device
+    # flag so each rank owns exactly one CPU device
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(ROOT, "tests", "nightly", "dist_sync_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=280)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"dist test failed:\n{out[-3000:]}"
+    assert out.count("DIST_KVSTORE_OK") == 2, out[-3000:]

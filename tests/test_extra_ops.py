@@ -90,30 +90,33 @@ def test_multi_sgd_family():
     arrays = [nd.array(a) for trip in zip(w, g, mom) for a in trip]
     outs = nd.multi_sgd_mom_update(*arrays, lrs=(0.1, 0.1), wds=(0.0, 0.0),
                                    momentum=0.9, num_weights=2)
-    # (w, mom) pairs out; momentum state actually advances
+    # outs[:n] = weights (reference indexing), outs[n:] = advanced momenta
     assert len(outs) == 4
     new_m = 0.9 * 0.2 - 0.1 * 0.5
-    assert onp.allclose(_np(outs[1]), new_m, atol=1e-6)
     assert onp.allclose(_np(outs[0]), w[0] + new_m, atol=1e-6)
+    assert onp.allclose(_np(outs[1]), w[1] + new_m, atol=1e-6)
+    assert onp.allclose(_np(outs[2]), new_m, atol=1e-6)
 
     w32 = [a.astype("float32") for a in w]
     wh = [a.astype("float16") for a in w]
     arrays = [nd.array(a) for trip in zip(wh, g, w32) for a in trip]
     outs = nd.multi_mp_sgd_update(*arrays, lrs=(0.1, 0.1), wds=(0.0, 0.0),
                                   num_weights=2)
-    # (w, w32) pairs out; master copy advances in fp32
+    # outs[:n] = fp16 weights (reference indexing), outs[n:] = fp32 masters
     assert len(outs) == 4
     assert str(outs[0].dtype) == "float16"
-    assert str(outs[1].dtype) == "float32"
-    assert onp.allclose(_np(outs[1]), w32[0] - 0.1 * 0.5, atol=1e-6)
+    assert str(outs[1].dtype) == "float16"
+    assert str(outs[2].dtype) == "float32"
+    assert onp.allclose(_np(outs[2]), w32[0] - 0.1 * 0.5, atol=1e-6)
 
     arrays = [nd.array(a) for quad in zip(wh, g, mom, w32) for a in quad]
     outs = nd.multi_mp_sgd_mom_update(*arrays, lrs=(0.1, 0.1),
                                       wds=(0.0, 0.0), momentum=0.9,
                                       num_weights=2)
+    # outs = n weights, then n momenta, then n fp32 masters
     assert len(outs) == 6
-    assert onp.allclose(_np(outs[1]), new_m, atol=1e-6)
-    assert onp.allclose(_np(outs[2]), w32[0] + new_m, atol=1e-6)
+    assert onp.allclose(_np(outs[2]), new_m, atol=1e-6)
+    assert onp.allclose(_np(outs[4]), w32[0] + new_m, atol=1e-6)
 
 
 def test_mp_nag_and_group_adagrad():
@@ -139,6 +142,22 @@ def test_boolean_mask():
     out = _np(nd.contrib.boolean_mask(data, mask))
     assert out.shape == (2, 3)
     assert onp.allclose(out[1], [6, 7, 8])
+
+
+def test_boolean_mask_gradient():
+    from mxnet_tpu import autograd
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    mask = nd.array(onp.array([1, 0, 1, 0], dtype="float32"))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.contrib.boolean_mask(data, mask)
+        loss = (out * out).sum()
+    loss.backward()
+    g = _np(data.grad)
+    # selected rows get 2*x, masked-out rows get exactly zero
+    assert onp.allclose(g[0], 2 * onp.array([0, 1, 2]))
+    assert onp.allclose(g[2], 2 * onp.array([6, 7, 8]))
+    assert onp.allclose(g[1], 0) and onp.allclose(g[3], 0)
 
 
 def test_proposal_shapes_and_validity():

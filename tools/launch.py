@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Local multi-process job launcher.
+
+TPU-native analog of the reference's distributed launcher
+(ref: tools/launch.py:29 — dmlc-core tracker spawning scheduler/server/
+worker processes wired by DMLC_ROLE/DMLC_PS_ROOT_URI env). There are no
+parameter servers here: every rank is a worker; ranks are wired into one
+jax.distributed job (Gloo on CPU hosts, ICI/DCN on TPU slices) via the
+MX_COORDINATOR / MX_NUM_WORKERS / MX_WORKER_ID env the framework's
+`initialize_distributed` reads.
+
+Usage (mirrors `tools/launch.py -n 2 --launcher local python train.py`):
+
+    python tools/launch.py -n 2 python dist_sync_kvstore.py
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="launch a local multi-process mxnet_tpu job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("--launcher", default="local", choices=["local"],
+                        help="only 'local' (single host) is supported; "
+                        "multi-host slices are wired by the TPU runtime")
+    parser.add_argument("--env", action="append", default=[],
+                        help="extra KEY=VALUE env for every worker")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on every worker")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+
+    coordinator = f"localhost:{_free_port()}"
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env["MX_COORDINATOR"] = coordinator
+        env["MX_NUM_WORKERS"] = str(args.num_workers)
+        env["MX_WORKER_ID"] = str(rank)
+        for kv in args.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
