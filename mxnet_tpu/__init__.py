@@ -19,6 +19,24 @@ from .base import initialize_distributed as _init_dist
 
 _init_dist()
 
+
+def _maybe_install_signal_handler():
+    """Crash backtraces for hard faults (ref: src/initialize.cc:62,226 —
+    the SIGSEGV/SIGABRT backtrace handler behind MXNET_USE_SIGNAL_HANDLER).
+    faulthandler is the CPython-native equivalent; on by default like the
+    reference's release builds, disabled with MXNET_USE_SIGNAL_HANDLER=0."""
+    import os
+    if os.environ.get("MXNET_USE_SIGNAL_HANDLER", "1") not in \
+            ("0", "false", "False"):
+        import faulthandler
+        try:
+            faulthandler.enable()
+        except Exception:  # non-main thread / closed stderr
+            pass
+
+
+_maybe_install_signal_handler()
+
 from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus  # noqa: F401
 
@@ -59,6 +77,11 @@ from .model import save_checkpoint, load_checkpoint  # noqa: F401
 from . import monitor  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from . import profiler  # noqa: F401
+from . import rtc  # noqa: F401
+from . import subgraph  # noqa: F401
+from . import executor_manager  # noqa: F401
+from . import operator_tune  # noqa: F401
+from .model import FeedForward  # noqa: F401
 from . import runtime  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import util  # noqa: F401

@@ -115,3 +115,198 @@ def load_checkpoint(prefix, epoch):
     symbol = sym_mod.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy model API (ref: python/mxnet/model.py FeedForward — the
+    pre-Module trainer). Thin façade over Module: same constructor
+    surface, `fit/predict/score/save/load`, so v0.x-era scripts port
+    unchanged. New code should use Module or Gluon."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .initializer import Uniform
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else \
+            [ctx] if ctx is not None else None
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.optimizer_params = kwargs
+        self._module = None
+
+    def _build_module(self, data, label_names=None, work_load_list=None,
+                      logger=None):
+        from .module import Module
+        import logging
+        if label_names is None:
+            label_names = ["softmax_label"]
+        label_names = [n for n in label_names
+                       if n in self.symbol.list_arguments()]
+        self._module = Module(self.symbol, data_names=("data",),
+                              label_names=tuple(label_names),
+                              context=self.ctx, logger=logger or logging,
+                              work_load_list=work_load_list)
+        return self._module
+
+    def _checkpoint_params(self):
+        """Apply the allow_extra_params policy to loaded checkpoint params
+        (ref: FeedForward._init_params allow_extra_params handling)."""
+        if self.arg_params is None:
+            return None, self.aux_params
+        known = set(self.symbol.list_arguments())
+        extras = set(self.arg_params) - known
+        if extras and not self.allow_extra_params:
+            raise MXNetError(
+                f"params {sorted(extras)} are not arguments of the symbol; "
+                "pass allow_extra_params=True to ignore them")
+        return ({k: v for k, v in self.arg_params.items() if k in known},
+                self.aux_params)
+
+    def _ensure_predictor(self, X):
+        """Bind an inference module on demand (loaded checkpoints can call
+        predict/score without fit)."""
+        if self._module is not None:
+            return self._module
+        # an unlabeled iterator still needs the symbol's label variables
+        # declared as labels (not parameters); fall back to the default name
+        label_names = [d[0] for d in X.provide_label] or None
+        mod = self._build_module(X, label_names=label_names)
+        mod.bind(data_shapes=X.provide_data,
+                 label_shapes=X.provide_label or None, for_training=False)
+        arg_params, aux_params = self._checkpoint_params()
+        mod.set_params(arg_params or {}, aux_params or {},
+                       allow_missing=False)
+        return mod
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """ref: model.py FeedForward.fit."""
+        from .io import NDArrayIter, ResizeIter
+        from .io.io import DataIter
+        if not isinstance(X, DataIter):
+            X = NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                            shuffle=True)
+        if self.epoch_size is not None:
+            X = ResizeIter(X, self.epoch_size)
+        mod = self._build_module(X, label_names=[d[0]
+                                                 for d in X.provide_label],
+                                 work_load_list=work_load_list,
+                                 logger=logger)
+        arg_params, aux_params = self._checkpoint_params()
+        fit_kwargs = {}
+        if eval_end_callback is not None:
+            fit_kwargs["eval_end_callback"] = eval_end_callback
+        if eval_batch_end_callback is not None:
+            fit_kwargs["eval_batch_end_callback"] = eval_batch_end_callback
+        mod.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=(tuple(self.optimizer_params.items())
+                                  or (("learning_rate", 0.01),)),
+                initializer=self.initializer,
+                arg_params=arg_params, aux_params=aux_params,
+                allow_missing=arg_params is not None,
+                begin_epoch=self.begin_epoch,
+                num_epoch=(self.num_epoch if self.num_epoch is not None
+                           else 1),
+                monitor=monitor, **fit_kwargs)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """ref: model.py FeedForward.predict — returns numpy outputs (list
+        for multi-output symbols); with return_data, also the consumed
+        data/label batches."""
+        from .io import NDArrayIter
+        from .io.io import DataIter
+        import numpy as _onp
+        if not isinstance(X, DataIter):
+            X = NDArrayIter(X, None, batch_size=self.numpy_batch_size)
+        mod = self._ensure_predictor(X)
+        if reset:
+            X.reset()
+        datas, labels = [], []
+        if return_data:
+            # consume once to capture data/label, then predict on the copy
+            for nbatch, batch in enumerate(X):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                pad = batch.pad or 0
+                datas.append(_onp.asarray(
+                    batch.data[0].asnumpy())[:batch.data[0].shape[0] - pad])
+                if batch.label:
+                    labels.append(_onp.asarray(batch.label[0].asnumpy())
+                                  [:batch.label[0].shape[0] - pad])
+            X.reset()
+        outs = mod.predict(X, num_batch=num_batch, reset=False,
+                           always_output_list=True)
+        if len(outs) == 0:
+            raise MXNetError("predict got no batches from the iterator "
+                             "(exhausted iterator with reset=False?)")
+        np_outs = [o.asnumpy() for o in outs]
+        result = np_outs[0] if len(np_outs) == 1 else np_outs
+        if return_data:
+            data_cat = _onp.concatenate(datas) if datas else None
+            label_cat = _onp.concatenate(labels) if labels else None
+            return result, data_cat, label_cat
+        return result
+
+    def score(self, X, eval_metric="acc", num_batch=None, **kwargs):
+        """ref: model.py FeedForward.score — works on fitted or
+        checkpoint-loaded models."""
+        from . import metric as metric_mod
+        from .io import NDArrayIter
+        from .io.io import DataIter
+        if not isinstance(X, DataIter):
+            raise MXNetError("score expects a DataIter with labels")
+        mod = self._ensure_predictor(X)
+        if isinstance(eval_metric, str):
+            eval_metric = metric_mod.create(eval_metric)
+        mod.score(X, eval_metric, num_batch=num_batch)
+        return eval_metric.get()[1]
+
+    def save(self, prefix, epoch=None, remove_amp_cast=True):
+        """ref: model.py FeedForward.save → save_checkpoint."""
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {},
+                        remove_amp_cast)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """ref: model.py FeedForward.load."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """ref: model.py FeedForward.create — construct and fit."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

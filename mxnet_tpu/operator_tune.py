@@ -1,0 +1,55 @@
+"""Operator autotuning facade.
+
+ref: src/operator/operator_tune.{h,cc} — the reference measures each
+op's serial cost at startup to decide per-op OMP parallelization
+(`UseOMP`, operator_tune.h:197; modes kAuto/kAlwaysOMP/kNeverOMP/...,
+:165, selected by MXNET_USE_OPERATOR_TUNING). On TPU that whole job —
+cost modeling, kernel selection, tiling — is XLA's autotuner, which runs
+per-compilation rather than per-process-start. This module keeps the
+user-facing control surface (mode query/set + a measured-cost table via
+one-off timing) so tooling written against the reference keeps working.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict
+
+__all__ = ["set_tuning_mode", "tuning_mode", "measure_op_cost",
+           "cost_table"]
+
+_MODES = ("auto", "always", "never", "instrumented")
+_mode = "auto"
+_costs: Dict[str, float] = {}
+
+
+def set_tuning_mode(mode: str):
+    """ref: OperatorTuneBase tuning modes (operator_tune.h:165). Advisory
+    on TPU: XLA always autotunes compiled programs."""
+    m = mode.lower()
+    if m not in _MODES:
+        raise ValueError(f"unknown tuning mode {mode!r}; one of {_MODES}")
+    global _mode
+    _mode = m
+
+
+def tuning_mode() -> str:
+    return _mode
+
+
+def measure_op_cost(name: str, fn: Callable, *args, iters: int = 10,
+                    **kwargs) -> float:
+    """Measure an op's steady-state wall time (the analog of the startup
+    micro-benchmarks in operator_tune.cc) and record it in the table."""
+    import jax
+    fn(*args, **kwargs)  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(getattr(out, "_data", out))
+    cost = (time.perf_counter() - t0) / iters
+    _costs[name] = cost
+    return cost
+
+
+def cost_table() -> Dict[str, float]:
+    return dict(_costs)

@@ -1,0 +1,99 @@
+"""Runtime kernel compilation.
+
+TPU-native take on the reference's NVRTC path (ref: python/mxnet/rtc.py
+CudaModule/CudaKernel over MXRtcCudaModuleCreate, src/common/rtc.cc):
+users hand the framework kernel *source* at runtime and launch it on
+device arrays. On TPU the kernel language is Pallas/jax, and the
+"runtime compiler" is jit: `PallasModule` executes a source string that
+defines kernel functions (with `jax`, `jax.numpy as jnp`,
+`jax.experimental.pallas as pl` in scope), and `get_kernel` returns a
+launchable wrapper compiled on first call.
+
+    mod = rtc.PallasModule('''
+    def axpy(x, y, alpha=1.0):
+        return alpha * x + y
+    ''')
+    k = mod.get_kernel("axpy")
+    out = k.launch([x_nd, y_nd], alpha=2.0)
+
+CUDA C sources cannot run on TPU; `CudaModule` raises with that
+explanation so reference code fails loudly instead of silently.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .base import MXNetError
+
+__all__ = ["PallasModule", "PallasKernel", "CudaModule"]
+
+
+class PallasKernel:
+    """ref: rtc.py CudaKernel — a launchable compiled kernel."""
+
+    def __init__(self, fn, name: str):
+        import jax
+        self._name = name
+        self._fn = fn
+        self._jitted = {}
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0, **params):
+        """Launch on device arrays. grid/block/shared_mem are accepted for
+        API parity but scheduling is the compiler's job on TPU (pallas
+        grids are declared inside the kernel via pl.pallas_call)."""
+        import jax
+        from .ndarray.ndarray import NDArray, _wrap
+
+        in_arrays = [a._data if isinstance(a, NDArray) else a for a in args]
+        key = tuple(sorted(params.items()))
+        if key not in self._jitted:
+            import functools
+            self._jitted[key] = jax.jit(
+                functools.partial(self._fn, **params))
+        out = self._jitted[key](*in_arrays)
+        if isinstance(out, (tuple, list)):
+            return [_wrap(o) for o in out]
+        return _wrap(out)
+
+    __call__ = launch
+
+
+class PallasModule:
+    """ref: rtc.py CudaModule — compile source once, export kernels."""
+
+    def __init__(self, source: str, options=(),
+                 exports: Optional[List[str]] = None):
+        import jax
+        import jax.numpy as jnp
+        try:
+            from jax.experimental import pallas as pl
+        except Exception:  # pallas optional on CPU-only builds
+            pl = None
+        namespace = {"jax": jax, "jnp": jnp, "pl": pl, "np": None}
+        import numpy as onp
+        namespace["np"] = onp
+        exec(compile(source, "<mxnet_tpu.rtc>", "exec"), namespace)
+        self._namespace = namespace
+        self._exports = list(exports) if exports else [
+            k for k, v in namespace.items()
+            if callable(v) and not k.startswith("_")
+            and getattr(v, "__module__", None) is None]
+
+    def get_kernel(self, name: str, signature: Optional[str] = None
+                   ) -> PallasKernel:
+        """`signature` (the CUDA C prototype in the reference) is accepted
+        and ignored — jax infers shapes/dtypes at trace time."""
+        fn = self._namespace.get(name)
+        if fn is None or not callable(fn):
+            raise MXNetError(f"kernel '{name}' not defined in module source")
+        return PallasKernel(fn, name)
+
+
+class CudaModule:
+    """ref: python/mxnet/rtc.py CudaModule — CUDA C via NVRTC."""
+
+    def __init__(self, *a, **k):
+        raise MXNetError(
+            "CudaModule compiles CUDA C, which cannot run on TPU; write "
+            "the kernel as jax/Pallas source and use rtc.PallasModule")

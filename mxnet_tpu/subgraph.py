@@ -1,0 +1,285 @@
+"""Graph-partition (subgraph) framework.
+
+TPU-native re-expression of the reference's subgraph API
+(ref: src/operator/subgraph/subgraph_property.h:78 SubgraphSelector /
+:207 SubgraphProperty; build_subgraph.cc BuildSubgraph pass;
+MXNET_REGISTER_SUBGRAPH_PROPERTY :497; backends src/operator/subgraph/
+mkldnn/ conv+bn+relu fusion and tensorrt/). In the reference a property
+carves regions out of the NNVM graph and hands them to an external
+compiler (MKL-DNN, TensorRT). SURVEY.md §2.3 notes the TPU build's
+whole-graph→XLA lowering *generalizes* this: every jitted executor is one
+big "subgraph". This module keeps the partition API itself so users can
+still scope fusion/lowering decisions to regions: a selected region is
+contracted into one `_subgraph_xla` node whose kernel evaluates the inner
+symbol as a single jit unit (eager calls get one fused XLA program per
+region — the CachedOp-for-a-region the MKLDNN backend hand-builds).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Registry
+
+__all__ = ["SubgraphSelector", "SubgraphProperty", "build_subgraph",
+           "register_subgraph_property", "get_subgraph_property",
+           "OpNameSelector", "XLAFusionProperty"]
+
+
+class SubgraphSelector:
+    """Decides which nodes join a region
+    (ref: subgraph_property.h:78 SubgraphSelector::Select/SelectInput/
+    SelectOutput)."""
+
+    def select(self, node) -> bool:
+        """Can `node` seed a new region?"""
+        return False
+
+    def select_input(self, node, input_node) -> bool:
+        """May the region growing from `node` absorb `input_node`?"""
+        return self.select(input_node)
+
+    def select_output(self, node, output_node) -> bool:
+        """May the region growing from `node` absorb `output_node`?"""
+        return self.select(output_node)
+
+
+class OpNameSelector(SubgraphSelector):
+    """Select by op-name set (the common case in the reference backends,
+    e.g. mkldnn conv property matching Convolution/BatchNorm/Activation)."""
+
+    def __init__(self, op_names):
+        self.op_names = set(op_names)
+
+    def select(self, node) -> bool:
+        return (not node.is_variable) and node.op in self.op_names
+
+
+class SubgraphProperty:
+    """ref: subgraph_property.h:207 — owns the selector and how a carved
+    region becomes a node."""
+
+    def create_subgraph_selector(self) -> SubgraphSelector:
+        raise NotImplementedError
+
+    def create_subgraph_node(self, subgraph_symbol, in_names, region_idx):
+        """Return (op_name, params) for the contracted node. Default: the
+        `_subgraph_xla` op that jit-evaluates the region as one unit."""
+        return "_subgraph_xla", {"__subgraph__": subgraph_symbol,
+                                 "in_names": tuple(in_names)}
+
+
+class XLAFusionProperty(SubgraphProperty):
+    """Default property: carve dense compute chains (the ops the MKLDNN
+    backend fuses — conv/FC/norm/activation/elementwise) into one XLA
+    program each (ref: subgraph/mkldnn/mkldnn_conv_property.h)."""
+
+    FUSED_OPS = ("Convolution", "FullyConnected", "BatchNorm", "Activation",
+                 "relu", "sigmoid", "tanh", "softsign", "elemwise_add",
+                 "elemwise_mul", "broadcast_add", "broadcast_mul", "Flatten",
+                 "LayerNorm")
+
+    def __init__(self, op_names=None):
+        self.op_names = tuple(op_names) if op_names else self.FUSED_OPS
+
+    def create_subgraph_selector(self):
+        return OpNameSelector(self.op_names)
+
+
+SUBGRAPH_PROPERTIES = Registry("subgraph_property")
+
+
+def register_subgraph_property(name: str):
+    """ref: MXNET_REGISTER_SUBGRAPH_PROPERTY (subgraph_property.h:497)."""
+    return SUBGRAPH_PROPERTIES.register(name)
+
+
+def get_subgraph_property(name: str) -> SubgraphProperty:
+    return SUBGRAPH_PROPERTIES.get(name)()
+
+
+register_subgraph_property("XLA")(XLAFusionProperty)
+register_subgraph_property("default")(XLAFusionProperty)
+
+
+# ---------------------------------------------------------------------------
+# the partition pass (ref: build_subgraph.cc BuildSubgraph)
+# ---------------------------------------------------------------------------
+
+def _assign_regions(nodes, selector) -> Dict[int, int]:
+    """Greedy convex region assignment in topological order.
+
+    A node may join the region of a direct input unless that region is
+    'poisoned' for it — reachable through an intervening non-region node —
+    which would create a cycle after contraction (the reference's
+    incomprehensible-cycle check in build_subgraph.cc lives here)."""
+    region_of: Dict[int, int] = {}
+    poisoned: Dict[int, Set[int]] = {}
+    next_region = 0
+    for node in nodes:
+        pois: Set[int] = set()
+        in_regions: Set[int] = set()
+        for inp, _ in node.inputs:
+            pois |= poisoned.get(id(inp), set())
+            r = region_of.get(id(inp))
+            if r is not None:
+                in_regions.add(r)
+        if not node.is_variable and selector.select(node):
+            candidates = sorted(in_regions - pois)
+            picked = None
+            for r in candidates:
+                # the region may also veto absorbing this node
+                picked = r
+                break
+            if picked is None:
+                picked = next_region
+                next_region += 1
+            region_of[id(node)] = picked
+            # regions NOT picked remain poisonous downstream (their values
+            # leave the region and re-enter through this node's output)
+            pois |= (in_regions - {picked})
+        else:
+            # all input regions become poisonous for downstream nodes
+            pois |= in_regions
+        poisoned[id(node)] = pois
+    return region_of
+
+
+def build_subgraph(symbol, prop: Optional[SubgraphProperty] = None,
+                   property_name: Optional[str] = None):
+    """Partition `symbol` with `prop` and contract each region (of ≥2
+    nodes) into one `_subgraph_xla` node. Returns a new Symbol computing
+    identical outputs (ref: BuildSubgraph pass, build_subgraph.cc)."""
+    from .symbol.symbol import Symbol, Variable, _Node
+
+    if prop is None:
+        prop = get_subgraph_property(property_name or "XLA")
+    selector = prop.create_subgraph_selector()
+    nodes = symbol._topo_nodes()
+    region_of = _assign_regions(nodes, selector)
+
+    # drop singleton regions — contracting one node buys nothing
+    from collections import Counter
+    sizes = Counter(region_of.values())
+    region_of = {nid: r for nid, r in region_of.items() if sizes[r] >= 2}
+    if not region_of:
+        return symbol
+
+    # region -> member nodes in topo order
+    members: Dict[int, List] = {}
+    for node in nodes:
+        r = region_of.get(id(node))
+        if r is not None:
+            members.setdefault(r, []).append(node)
+
+    # entry mapping: (id(old_node), out_idx) -> (new_node, out_idx)
+    entry_map: Dict[Tuple[int, int], Tuple[object, int]] = {}
+    region_node: Dict[int, object] = {}
+    # which (node, out_idx) entries of a region are consumed outside it (or
+    # are graph outputs) — those become the contracted node's outputs
+    consumed_outside: Dict[int, List[Tuple[int, int]]] = {}
+
+    def _note_outside(entry, consumer_region):
+        node, oi = entry
+        r = region_of.get(id(node))
+        if r is not None and r != consumer_region:
+            lst = consumed_outside.setdefault(r, [])
+            if (id(node), oi) not in lst:
+                lst.append((id(node), oi))
+
+    for node in nodes:
+        my_r = region_of.get(id(node))
+        for entry in node.inputs:
+            _note_outside(entry, my_r)
+    for entry in symbol._outputs:
+        _note_outside(entry, None)
+
+    def _region_inputs(r) -> List[Tuple[object, int]]:
+        seen, ins = set(), []
+        for node in members[r]:
+            for entry in node.inputs:
+                inp, oi = entry
+                if region_of.get(id(inp)) != r:
+                    key = (id(inp), oi)
+                    if key not in seen:
+                        seen.add(key)
+                        ins.append(entry)
+        return ins
+
+    def _build_region_node(r):
+        if r in region_node:
+            return region_node[r]
+        ext_inputs = _region_inputs(r)
+        in_names = [f"__sg{r}_in{i}" for i in range(len(ext_inputs))]
+        # clone member nodes into a sub-symbol over placeholder variables
+        placeholder = {}
+        for (inp, oi), nm in zip(ext_inputs, in_names):
+            placeholder[(id(inp), oi)] = (Variable(nm)._outputs[0][0], 0)
+        clone: Dict[int, object] = {}
+        for node in members[r]:
+            new_ins = []
+            for entry in node.inputs:
+                inp, oi = entry
+                if region_of.get(id(inp)) == r:
+                    new_ins.append((clone[id(inp)], oi))
+                else:
+                    new_ins.append(placeholder[(id(inp), oi)])
+            clone[id(node)] = _Node(node.op, node.name, new_ins,
+                                    dict(node.params), dict(node.attrs))
+        out_entries = consumed_outside.get(r) or \
+            [(id(members[r][-1]), 0)]
+        sub = Symbol([(clone[nid], oi) for nid, oi in out_entries])
+        op_name, params = prop.create_subgraph_node(sub, in_names, r)
+        params = dict(params)
+        params["num_outputs"] = len(out_entries)
+        # external inputs are outside the region and cannot (convexity)
+        # depend on it, so this recursion terminates
+        outer_ins = [_map_entry(entry) for entry in ext_inputs]
+        big = _Node(op_name, f"subgraph{r}", outer_ins, params)
+        region_node[r] = big
+        for slot, (nid_, oi) in enumerate(out_entries):
+            entry_map[(nid_, oi)] = (big, slot)
+        return big
+
+    def _map_entry(entry):
+        """Demand-driven rebuild (never mutates the input symbol)."""
+        node, oi = entry
+        key = (id(node), oi)
+        if key in entry_map:
+            return entry_map[key]
+        r = region_of.get(id(node))
+        if r is not None:
+            _build_region_node(r)
+            return entry_map[key]
+        if node.is_variable:
+            entry_map[key] = (node, 0)
+            return entry_map[key]
+        new_ins = [_map_entry(e) for e in node.inputs]
+        nn = _Node(node.op, node.name, new_ins, dict(node.params),
+                   dict(node.attrs))
+        for i in range(node._n_out):
+            entry_map[(id(node), i)] = (nn, i)
+        return entry_map[key]
+
+    new_outputs = [_map_entry(e) for e in symbol._outputs]
+    return Symbol(new_outputs)
+
+
+# ---------------------------------------------------------------------------
+# the contracted-region op
+# ---------------------------------------------------------------------------
+
+def _subgraph_xla(*ins, __subgraph__=None, in_names=(), num_outputs=1,
+                  _training=False):
+    """Evaluate a carved region as one jit unit (ref role: the fused op a
+    subgraph backend emits, e.g. _sg_mkldnn_conv). Aux-state updates of
+    region members (BatchNorm moving stats) stay inside the region — the
+    same limitation the reference's fused inference ops have."""
+    from .symbol.symbol import eval_graph
+    vm = dict(zip(in_names, ins))
+    outs, _ = eval_graph(__subgraph__, vm, _training, None)
+    return tuple(outs) if len(outs) != 1 else outs[0]
+
+
+from .ops.registry import register_op  # noqa: E402
+
+register_op("_subgraph_xla", n_out=-1, needs_train=True)(_subgraph_xla)

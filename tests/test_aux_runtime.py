@@ -1,0 +1,274 @@
+"""Tests for the runtime/aux parity bundle: subgraph partitioning, rtc,
+executor_manager, FeedForward, operator_tune, im2rec, signal handler.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# subgraph framework
+# ---------------------------------------------------------------------------
+
+def _dense_chain():
+    x = sym.var("data")
+    w1 = sym.var("w1")
+    w2 = sym.var("w2")
+    h = sym.FullyConnected(x, w1, num_hidden=8, no_bias=True, name="fc1")
+    a = sym.Activation(h, act_type="relu", name="act1")
+    return sym.FullyConnected(a, w2, num_hidden=4, no_bias=True, name="fc2")
+
+
+def _eval(s, vals):
+    from mxnet_tpu.symbol.symbol import eval_graph
+    outs, _ = eval_graph(s, {k: v for k, v in vals.items()}, False, None)
+    return [onp.asarray(o) for o in outs]
+
+
+def test_subgraph_contraction_preserves_outputs():
+    from mxnet_tpu.subgraph import build_subgraph, XLAFusionProperty
+    net = _dense_chain()
+    rs = onp.random.RandomState(0)
+    vals = {"data": rs.randn(2, 16).astype("float32"),
+            "w1": rs.randn(8, 16).astype("float32"),
+            "w2": rs.randn(4, 8).astype("float32")}
+    ref = _eval(net, vals)
+    part = build_subgraph(net, XLAFusionProperty())
+    ops = [n.op for n in part._topo_nodes() if not n.is_variable]
+    assert "_subgraph_xla" in ops
+    # the whole chain collapses into one region
+    assert ops.count("_subgraph_xla") == 1 and len(ops) == 1
+    out = _eval(part, vals)
+    assert onp.allclose(out[0], ref[0], atol=1e-5)
+    # arguments survive contraction
+    assert set(part.list_arguments()) == set(net.list_arguments())
+
+
+def test_subgraph_partial_selection_and_outside_consumer():
+    """An unselected node consuming a region-internal value must keep the
+    graph acyclic and correct (the poisoning path)."""
+    from mxnet_tpu.subgraph import build_subgraph, XLAFusionProperty
+    x = sym.var("data")
+    w = sym.var("w")
+    h = sym.FullyConnected(x, w, num_hidden=8, no_bias=True, name="fc")
+    a = sym.Activation(h, act_type="relu", name="act")
+    # softmax is NOT in the fused-op set; consumes the region output
+    s = sym.softmax(a, name="sm")
+    # elemwise_add IS selected and consumes both region + outside values
+    out = s + a
+    rs = onp.random.RandomState(1)
+    vals = {"data": rs.randn(3, 5).astype("float32"),
+            "w": rs.randn(8, 5).astype("float32")}
+    ref = _eval(out, vals)
+    part = build_subgraph(out, XLAFusionProperty())
+    got = _eval(part, vals)
+    assert onp.allclose(got[0], ref[0], atol=1e-5)
+
+
+def test_subgraph_through_executor():
+    from mxnet_tpu.subgraph import build_subgraph
+    net = _dense_chain()
+    part = build_subgraph(net, property_name="XLA")
+    rs = onp.random.RandomState(2)
+    args = {"data": nd.array(rs.randn(2, 16).astype("float32")),
+            "w1": nd.array(rs.randn(8, 16).astype("float32")),
+            "w2": nd.array(rs.randn(4, 8).astype("float32"))}
+    e_ref = net.bind(mx.cpu(), dict(args))
+    e_new = part.bind(mx.cpu(), dict(args))
+    r = e_ref.forward()[0].asnumpy()
+    n = e_new.forward()[0].asnumpy()
+    assert onp.allclose(r, n, atol=1e-5)
+
+
+def test_subgraph_property_registry():
+    from mxnet_tpu.subgraph import (get_subgraph_property,
+                                    register_subgraph_property,
+                                    SubgraphProperty, OpNameSelector)
+
+    @register_subgraph_property("test_only_fc")
+    class FCOnly(SubgraphProperty):
+        def create_subgraph_selector(self):
+            return OpNameSelector(["FullyConnected"])
+
+    prop = get_subgraph_property("test_only_fc")
+    assert isinstance(prop, FCOnly)
+
+
+# ---------------------------------------------------------------------------
+# rtc
+# ---------------------------------------------------------------------------
+
+def test_rtc_pallas_module():
+    from mxnet_tpu import rtc
+    mod = rtc.PallasModule("""
+def axpy(x, y, alpha=1.0):
+    return alpha * x + y
+""")
+    k = mod.get_kernel("axpy", "void axpy(float*, float*, float)")
+    x = nd.array(onp.array([1.0, 2.0], "float32"))
+    y = nd.array(onp.array([10.0, 20.0], "float32"))
+    out = k.launch([x, y], alpha=3.0)
+    assert onp.allclose(out.asnumpy(), [13.0, 26.0])
+    with pytest.raises(mx.base.MXNetError):
+        mod.get_kernel("missing")
+    with pytest.raises(mx.base.MXNetError):
+        rtc.CudaModule("__global__ void k() {}")
+
+
+# ---------------------------------------------------------------------------
+# operator_tune
+# ---------------------------------------------------------------------------
+
+def test_operator_tune():
+    from mxnet_tpu import operator_tune
+    operator_tune.set_tuning_mode("never")
+    assert operator_tune.tuning_mode() == "never"
+    with pytest.raises(ValueError):
+        operator_tune.set_tuning_mode("bogus")
+    a = nd.ones((64, 64))
+    cost = operator_tune.measure_op_cost("elemwise_add", lambda: a + a,
+                                         iters=3)
+    assert cost > 0 and operator_tune.cost_table()["elemwise_add"] == cost
+    operator_tune.set_tuning_mode("auto")
+
+
+# ---------------------------------------------------------------------------
+# FeedForward + executor_manager
+# ---------------------------------------------------------------------------
+
+def _mlp_symbol():
+    x = sym.var("data")
+    h = sym.FullyConnected(x, num_hidden=16, name="fc1")
+    a = sym.Activation(h, act_type="relu")
+    o = sym.FullyConnected(a, num_hidden=2, name="fc2")
+    return sym.SoftmaxOutput(o, name="softmax")
+
+
+def _toy_xy(n=64):
+    rs = onp.random.RandomState(3)
+    x = rs.randn(n, 8).astype("float32")
+    y = (x[:, 0] > 0).astype("float32")
+    x[y == 1, :] += 2.0
+    return x, y
+
+
+def test_feedforward_fit_predict_score(tmp_path):
+    x, y = _toy_xy()
+    model = mx.FeedForward(_mlp_symbol(), num_epoch=4, numpy_batch_size=16,
+                           learning_rate=0.5)
+    model.fit(x, y, kvstore="local")
+    preds = model.predict(x)
+    assert preds.shape == (64, 2)
+    acc = (preds.argmax(axis=1) == y).mean()
+    assert acc > 0.8, f"FeedForward failed to learn: acc={acc}"
+    # checkpoint roundtrip: predict AND score must work without fit
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 4)
+    loaded = mx.FeedForward.load(prefix, 4)
+    from mxnet_tpu.io import NDArrayIter
+    p2 = loaded.predict(NDArrayIter(x, None, batch_size=16))
+    assert onp.allclose(preds[:p2.shape[0]], p2, atol=1e-4)
+    loaded2 = mx.FeedForward.load(prefix, 4)
+    s = loaded2.score(NDArrayIter(x, y, batch_size=16,
+                                  label_name="softmax_label"))
+    assert s > 0.8
+    # return_data mode gives (outputs, data, label)
+    out3, d3, l3 = model.predict(
+        NDArrayIter(x, y, batch_size=16, label_name="softmax_label"),
+        return_data=True)
+    assert d3.shape[0] == out3.shape[0] and l3.shape[0] == out3.shape[0]
+
+
+def test_feedforward_allow_extra_params():
+    x, y = _toy_xy(32)
+    symb = _mlp_symbol()
+    bogus = {"not_a_param": nd.ones((1,))}
+    model = mx.FeedForward(symb, num_epoch=1, numpy_batch_size=16,
+                           arg_params=bogus)
+    with pytest.raises(mx.base.MXNetError):
+        model.fit(x, y)
+    # with allow_extra_params=True the stray key is dropped silently
+    model2 = mx.FeedForward(symb, num_epoch=1, numpy_batch_size=16,
+                            arg_params=bogus, allow_extra_params=True)
+    model2.fit(x, y)
+
+
+def test_executor_manager_slices():
+    from mxnet_tpu.executor_manager import _split_input_slice
+    slices = _split_input_slice(10, [1.0, 1.0])
+    assert [((s.start, s.stop)) for s in slices] == [(0, 5), (5, 10)]
+    slices = _split_input_slice(9, [2.0, 1.0])
+    assert slices[0].stop == 6 and slices[1].stop == 9
+
+
+def test_data_parallel_executor_manager():
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu import metric as metric_mod
+    x, y = _toy_xy(32)
+    it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mgr = DataParallelExecutorManager(_mlp_symbol(), [mx.cpu()], it)
+    from mxnet_tpu.initializer import Uniform
+    init = Uniform(0.1)
+    arg_params = {}
+    aux_params = {}
+    # initialize params through the group's buffers
+    for name, arrs in zip(mgr.param_names, mgr.param_arrays):
+        init(name, arrs[0])
+        for a in arrs[1:]:
+            a[:] = arrs[0]
+    it.reset()
+    batch = next(it)
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    m = metric_mod.create("acc")
+    mgr.update_metric(m, batch.label)
+    assert m.get()[1] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# im2rec + signal handler
+# ---------------------------------------------------------------------------
+
+def test_im2rec_roundtrip(tmp_path):
+    from PIL import Image
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            Image.new("RGB", (32 + i, 40), color=(i * 20, 100, 50)).save(
+                root / cls / f"{i}.jpg")
+    prefix = str(tmp_path / "data")
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import im2rec
+    finally:
+        sys.path.pop(0)
+    im2rec.main([prefix, str(root), "--list"])
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    im2rec.main([prefix, str(root), "--resize", "16", "--center-crop"])
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    labels = set()
+    for k in rec.keys:
+        header, img_bytes = recordio.unpack(rec.read_idx(k))
+        labels.add(float(header.label))
+        from io import BytesIO
+        img = Image.open(BytesIO(img_bytes))
+        assert img.size == (16, 16)
+    assert labels == {0.0, 1.0}
+
+
+def test_signal_handler_enabled():
+    import faulthandler
+    assert faulthandler.is_enabled()

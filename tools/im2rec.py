@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Pack an image dataset into RecordIO.
+
+ref: tools/im2rec.py — the reference's dataset-packing CLI. Two modes,
+same flags:
+
+  list mode:    python tools/im2rec.py --list prefix image_root
+                (writes prefix.lst: "index \t label \t relpath")
+  record mode:  python tools/im2rec.py prefix image_root
+                (reads prefix.lst, writes prefix.rec + prefix.idx)
+
+The .rec framing is bit-compatible with the reference (recordio.py
+pack_img → IRHeader + JPEG bytes), produced through the same
+MXIndexedRecordIO writer the native C++ prefetch server reads
+(mxnet_tpu/native/recordio.cc).
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(args):
+    """ref: im2rec.py make_list — enumerate images, one class per
+    subdirectory, shuffled, with train/test split support."""
+    entries = []
+    label_map = {}
+    root = args.root
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        rel_dir = os.path.relpath(dirpath, root)
+        for fname in sorted(filenames):
+            if not fname.lower().endswith(EXTS):
+                continue
+            cls = 0.0 if rel_dir == "." else \
+                label_map.setdefault(rel_dir, float(len(label_map)))
+            entries.append((cls, os.path.normpath(
+                os.path.join(rel_dir, fname))))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(entries)
+    n_test = int(len(entries) * args.test_ratio)
+    chunks = [("", entries[n_test:]), ("_test", entries[:n_test])] \
+        if n_test else [("", entries)]
+    for suffix, chunk in chunks:
+        path = f"{args.prefix}{suffix}.lst"
+        with open(path, "w") as f:
+            for i, (label, rel) in enumerate(chunk):
+                f.write(f"{i}\t{label:.6f}\t{rel}\n")
+        print(f"wrote {len(chunk)} entries to {path}")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            label = [float(x) for x in parts[1:-1]]
+            yield idx, label[0] if len(label) == 1 else label, parts[-1]
+
+
+def make_record(args):
+    """ref: im2rec.py image_encode/write loop — resize/re-encode each image
+    and append to an indexed .rec."""
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    lst = args.prefix + ".lst"
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    n = 0
+    for idx, label, rel in read_list(lst):
+        path = os.path.join(args.root, rel)
+        try:
+            img = Image.open(path).convert("RGB")
+        except Exception as e:  # noqa: BLE001 — skip unreadable, like ref
+            print(f"skipping {path}: {e}", file=sys.stderr)
+            continue
+        if args.resize:
+            w, h = img.size
+            scale = args.resize / min(w, h)
+            img = img.resize((max(1, round(w * scale)),
+                              max(1, round(h * scale))))
+        if args.center_crop:
+            w, h = img.size
+            s = min(w, h)
+            left, top = (w - s) // 2, (h - s) // 2
+            img = img.crop((left, top, left + s, top + s))
+        import io as _io
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG", quality=args.quality)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf.getvalue()))
+        n += 1
+        if n % 1000 == 0:
+            print(f"packed {n} images")
+    rec.close()
+    print(f"wrote {n} records to {args.prefix}.rec")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="make an image list / pack images into RecordIO")
+    p.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="make a .lst file instead of packing records")
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge to this many pixels")
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    args = p.parse_args(argv)
+    if args.list:
+        make_list(args)
+    else:
+        make_record(args)
+
+
+if __name__ == "__main__":
+    main()
