@@ -1,0 +1,190 @@
+"""Python backend for the native C API shim.
+
+The reference's C API (ref: src/c_api/, include/mxnet/c_api.h — 234 MX*
+entry points) is the ABI every language binding sits on; its inference
+subset is the standalone predict API (ref: src/c_api/c_predict_api.cc,
+include/mxnet/c_predict_api.h). Here the ABI boundary runs the other way
+round: libmxtpu_capi.so (native/c_predict_api.cc) embeds CPython and calls
+the functions in this module, so C/C++/Java/Go programs get the same
+MXPred* contract while the compute still flows through jax/XLA.
+
+Everything crosses the boundary as plain str/bytes/int tuples — no numpy
+C API on the native side.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as onp
+
+from .base import MXNetError
+
+_handles: Dict[int, "_Predictor"] = {}
+_next_handle = [1]
+_lock = threading.Lock()
+
+
+class _Predictor:
+    def __init__(self, symbol_json: str, param_bytes: bytes, dev_type: int,
+                 dev_id: int, input_shapes: List[Tuple[str, Tuple[int, ...]]],
+                 output_names: List[str]):
+        from . import context as ctx_mod
+        from .executor import Executor  # noqa: F401  (bind returns one)
+        from .ndarray.ndarray import load_frombuffer, zeros as nd_zeros
+        from .symbol.symbol import load_json
+
+        sym = load_json(symbol_json)
+        if output_names:
+            outs = sym.list_outputs()
+            picked = []
+            for name in output_names:
+                # accept exact output names or the un-suffixed node name
+                # ("fc2" for "fc2_output"), like the reference predict API
+                if name in outs:
+                    picked.append(outs.index(name))
+                elif f"{name}_output" in outs:
+                    picked.append(outs.index(f"{name}_output"))
+                else:
+                    raise MXNetError(f"output {name} not found in symbol "
+                                     f"outputs {outs}")
+            from .symbol.symbol import Symbol
+            sym = Symbol([sym._outputs[i] for i in picked])
+        params = load_frombuffer(param_bytes) if param_bytes else {}
+        arg_params = {}
+        aux_params = {}
+        for k, v in (params or {}).items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        ctx = ctx_mod.cpu(dev_id) if dev_type == 1 else ctx_mod.tpu(dev_id)
+        self.input_shapes = dict(input_shapes)
+        args = {}
+        for name in sym.list_arguments():
+            if name in self.input_shapes:
+                args[name] = nd_zeros(tuple(self.input_shapes[name]))
+            elif name in arg_params:
+                args[name] = arg_params[name]
+            else:
+                raise MXNetError(f"argument {name} has neither a declared "
+                                 "input shape nor a loaded parameter")
+        aux = {name: aux_params[name]
+               for name in sym.list_auxiliary_states() if name in aux_params}
+        self.executor = sym.bind(ctx, args, args_grad=None,
+                                 aux_states=aux or None)
+        self.outputs: List[onp.ndarray] = []
+
+    def set_input(self, key: str, data: bytes, shape: Tuple[int, ...],
+                  dtype: str):
+        from .ndarray.ndarray import array
+        if key not in self.executor.arg_dict:
+            raise MXNetError(f"unknown input {key}")
+        arr = onp.frombuffer(data, dtype=dtype).reshape(shape)
+        self.executor.arg_dict[key]._rebind(
+            array(arr.astype("float32")
+                  if dtype == "float32" else arr)._data)
+
+    def forward(self):
+        self.outputs = [o.asnumpy()
+                        for o in self.executor.forward(is_train=False)]
+
+    def get_output_shape(self, index: int) -> Tuple[int, ...]:
+        self._check_index(index)
+        return tuple(self.outputs[index].shape)
+
+    def get_output(self, index: int) -> bytes:
+        self._check_index(index)
+        return onp.ascontiguousarray(
+            self.outputs[index].astype(onp.float32)).tobytes()
+
+    def _check_index(self, index):
+        if not self.outputs:
+            raise MXNetError("call MXPredForward before reading outputs")
+        if not 0 <= index < len(self.outputs):
+            raise MXNetError(f"output index {index} out of range "
+                             f"({len(self.outputs)} outputs)")
+
+
+# ---------------------------------------------------------------------------
+# flat entry points called from the native shim
+# ---------------------------------------------------------------------------
+
+def create(symbol_json: str, param_bytes: bytes, dev_type: int, dev_id: int,
+           input_names: List[str], input_shapes: List[List[int]],
+           output_names: List[str] = ()) -> int:
+    pred = _Predictor(symbol_json, param_bytes, dev_type, dev_id,
+                      list(zip(input_names,
+                               [tuple(s) for s in input_shapes])),
+                      list(output_names))
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _handles[h] = pred
+    return h
+
+
+def _get(handle: int) -> _Predictor:
+    pred = _handles.get(handle)
+    if pred is None:
+        raise MXNetError(f"invalid predictor handle {handle}")
+    return pred
+
+
+def set_input(handle: int, key: str, data: bytes, shape: List[int],
+              dtype: str = "float32"):
+    _get(handle).set_input(key, data, tuple(shape), dtype)
+
+
+def set_input_flat(handle: int, key: str, data: bytes, flat_shape: List[int],
+                   dtype: str = "float32"):
+    """C-ABI entry: a flat buffer reshaped to the declared input shape
+    (ref: MXPredSetInput takes (data, size) with the shape fixed at
+    MXPredCreate time)."""
+    pred = _get(handle)
+    shape = pred.input_shapes.get(key)
+    if shape is None:
+        raise MXNetError(f"{key} was not declared as an input at create "
+                         "time")
+    n_expect = 1
+    for d in shape:
+        n_expect *= d
+    n_got = int(flat_shape[0]) if flat_shape else 0
+    if n_got != n_expect:
+        raise MXNetError(f"MXPredSetInput({key}): got {n_got} elements, "
+                         f"declared shape {tuple(shape)} needs {n_expect}")
+    pred.set_input(key, data, tuple(shape), dtype)
+
+
+def forward(handle: int):
+    _get(handle).forward()
+
+
+def get_output_shape(handle: int, index: int) -> Tuple[int, ...]:
+    return _get(handle).get_output_shape(index)
+
+
+def get_output(handle: int, index: int) -> bytes:
+    return _get(handle).get_output(index)
+
+
+def free(handle: int):
+    with _lock:
+        _handles.pop(handle, None)
+
+
+def num_outputs(handle: int) -> int:
+    return len(_get(handle).executor._symbol.list_outputs())
+
+
+def list_op_names() -> List[str]:
+    from .ops.registry import list_ops
+    return list_ops()
+
+
+def version() -> int:
+    from . import __version__
+    major, minor, patch = (__version__.split(".") + ["0", "0"])[:3]
+    return int(major) * 10000 + int(minor) * 100 + int(patch)

@@ -199,3 +199,34 @@ class NativeBatchServer:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# C predict API library (ref: src/c_api/c_predict_api.cc — the standalone
+# inference ABI). Separate .so because it links libpython (the RecordIO
+# library stays interpreter-free).
+# ---------------------------------------------------------------------------
+
+_CAPI_SRC = os.path.join(_HERE, "c_predict_api.cc")
+_CAPI_SO = os.path.join(_HERE, "libmxtpu_capi.so")
+
+
+_CAPI_HDR = os.path.join(_HERE, "mxtpu_predict.h")
+
+
+def build_capi(force: bool = False) -> str:
+    """Compile libmxtpu_capi.so (cached by source+header mtime)."""
+    src_mtime = max(os.path.getmtime(_CAPI_SRC),
+                    os.path.getmtime(_CAPI_HDR))
+    if not force and os.path.exists(_CAPI_SO) and \
+            os.path.getmtime(_CAPI_SO) >= src_mtime:
+        return _CAPI_SO
+    import sysconfig
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ldver = sysconfig.get_config_var("LDVERSION")
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _CAPI_SRC,
+           f"-I{inc}", f"-L{libdir}", f"-lpython{ldver}",
+           f"-Wl,-rpath,{libdir}", "-o", _CAPI_SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _CAPI_SO

@@ -1,0 +1,336 @@
+/*
+ * Native C predict API: embeds CPython and drives mxnet_tpu.c_api_backend.
+ *
+ * TPU-native inversion of the reference ABI stack: there, Python sits on a
+ * C++ core (src/c_api/c_predict_api.cc wraps the GraphExecutor); here the
+ * compute core is jax/XLA behind Python, so the C ABI embeds the
+ * interpreter once per process and marshals tensors as raw byte buffers.
+ * The exported contract (mxtpu_predict.h) matches the reference's
+ * c_predict_api.h subset, with API_BEGIN/API_END-style error capture into
+ * a per-process last-error string (ref: src/c_api/c_api_error.cc).
+ *
+ * Build: g++ -O2 -std=c++17 -shared -fPIC c_predict_api.cc
+ *            $(python3-config --includes) -L$LIBDIR -lpython3.12
+ *            -o libmxtpu_capi.so
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+#include "mxtpu_predict.h"
+}
+
+namespace {
+
+std::mutex g_mutex;
+// per-thread last error, like the reference's thread-local error ring
+// (src/c_api/c_api_error.cc) — readable without locks
+thread_local std::string g_last_error;
+PyObject *g_backend = nullptr;  // mxnet_tpu.c_api_backend module
+
+// op-name list storage for MXListAllOpNames
+std::vector<std::string> g_op_names;
+std::vector<const char *> g_op_name_ptrs;
+
+struct Predictor {
+  long handle;                          // backend-side id
+  std::vector<std::vector<uint32_t>> out_shapes;  // per-output cache
+};
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+std::string fetch_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = "unknown python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  return msg;
+}
+
+// Initialize the interpreter + import the backend module once.
+bool ensure_backend() {
+  if (g_backend) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // no signal handlers: stay a polite guest library
+    // Py_InitializeEx leaves this thread holding the GIL; hand it back so
+    // every entry point can use the PyGILState API uniformly
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.c_api_backend");
+  if (!mod) {
+    set_error("failed to import mxnet_tpu.c_api_backend (is PYTHONPATH "
+              "set?): " + fetch_py_error());
+    PyGILState_Release(gil);
+    return false;
+  }
+  g_backend = mod;  // keep the reference for process lifetime
+  PyGILState_Release(gil);
+  return true;
+}
+
+// Call backend.<fn>(*args); returns new reference or nullptr (error set).
+PyObject *call_backend(const char *fn, PyObject *args) {
+  PyObject *f = PyObject_GetAttrString(g_backend, fn);
+  if (!f) {
+    set_error(std::string("backend missing function ") + fn);
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *ret = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!ret) set_error(fetch_py_error());
+  return ret;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  if (!ensure_backend()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *ret = call_backend("version", PyTuple_New(0));
+  int rc = -1;
+  if (ret) {
+    *out = static_cast<int>(PyLong_AsLong(ret));
+    Py_DECREF(ret);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXListAllOpNames(uint32_t *out_size, const char ***out_array) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  if (!ensure_backend()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *ret = call_backend("list_op_names", PyTuple_New(0));
+  int rc = -1;
+  if (ret) {
+    g_op_names.clear();
+    g_op_name_ptrs.clear();
+    Py_ssize_t n = PyList_Size(ret);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      g_op_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(ret, i)));
+    }
+    for (const auto &s : g_op_names) g_op_name_ptrs.push_back(s.c_str());
+    *out_size = static_cast<uint32_t>(g_op_names.size());
+    *out_array = g_op_name_ptrs.data();
+    Py_DECREF(ret);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+static int pred_create_impl(const char *symbol_json_str,
+                            const void *param_bytes, int param_size,
+                            int dev_type, int dev_id,
+                            uint32_t num_input_nodes,
+                            const char **input_keys,
+                            const uint32_t *input_shape_indptr,
+                            const uint32_t *input_shape_data,
+                            uint32_t num_output_nodes,
+                            const char **output_keys, PredictorHandle *out) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  if (!ensure_backend()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+
+  PyObject *names = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_keys[i]));
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shp = PyList_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyList_SetItem(shp, j - lo, PyLong_FromUnsignedLong(
+                                      input_shape_data[j]));
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *outputs = PyList_New(num_output_nodes);
+  for (uint32_t i = 0; i < num_output_nodes; ++i)
+    PyList_SetItem(outputs, i, PyUnicode_FromString(output_keys[i]));
+
+  PyObject *args = Py_BuildValue(
+      "(sy#iiOOO)", symbol_json_str,
+      static_cast<const char *>(param_bytes),
+      static_cast<Py_ssize_t>(param_size), dev_type, dev_id, names, shapes,
+      outputs);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  Py_DECREF(outputs);
+  PyObject *ret = call_backend("create", args);
+  int rc = -1;
+  if (ret) {
+    auto *p = new Predictor{PyLong_AsLong(ret), {}};
+    Py_DECREF(ret);
+    *out = p;
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data, PredictorHandle *out) {
+  return pred_create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                          dev_id, num_input_nodes, input_keys,
+                          input_shape_indptr, input_shape_data, 0, nullptr,
+                          out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           uint32_t num_input_nodes, const char **input_keys,
+                           const uint32_t *input_shape_indptr,
+                           const uint32_t *input_shape_data,
+                           uint32_t num_output_nodes,
+                           const char **output_keys, PredictorHandle *out) {
+  return pred_create_impl(symbol_json_str, param_bytes, param_size, dev_type,
+                          dev_id, num_input_nodes, input_keys,
+                          input_shape_indptr, input_shape_data,
+                          num_output_nodes, output_keys, out);
+}
+
+int MXPredGetOutputCount(PredictorHandle handle, uint32_t *out) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  auto *p = static_cast<Predictor *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *ret = call_backend("num_outputs", Py_BuildValue("(l)", p->handle));
+  int rc = -1;
+  if (ret) {
+    *out = static_cast<uint32_t>(PyLong_AsLong(ret));
+    Py_DECREF(ret);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, uint32_t size) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  auto *p = static_cast<Predictor *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  // shape [] → backend reshapes to the declared input shape; we pass the
+  // flat length and let numpy reshape on the python side
+  PyObject *args = Py_BuildValue(
+      "(lsy#[I]s)", p->handle, key, reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)), size, "float32");
+  PyObject *ret = call_backend("set_input_flat", args);
+  int rc = -1;
+  if (ret) {
+    Py_DECREF(ret);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  auto *p = static_cast<Predictor *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *ret = call_backend("forward", Py_BuildValue("(l)", p->handle));
+  int rc = -1;
+  if (ret) {
+    Py_DECREF(ret);
+    p->out_shapes.clear();
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t **shape_data, uint32_t *shape_ndim) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  auto *p = static_cast<Predictor *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *ret = call_backend("get_output_shape",
+                               Py_BuildValue("(lI)", p->handle, index));
+  int rc = -1;
+  if (ret) {
+    if (p->out_shapes.size() <= index) p->out_shapes.resize(index + 1);
+    auto &shp = p->out_shapes[index];
+    shp.clear();
+    Py_ssize_t nd = PyTuple_Size(ret);
+    for (Py_ssize_t i = 0; i < nd; ++i)
+      shp.push_back(static_cast<uint32_t>(
+          PyLong_AsLong(PyTuple_GetItem(ret, i))));
+    Py_DECREF(ret);
+    *shape_data = shp.data();
+    *shape_ndim = static_cast<uint32_t>(shp.size());
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float *data,
+                    uint32_t size) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  auto *p = static_cast<Predictor *>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *ret = call_backend("get_output",
+                               Py_BuildValue("(lI)", p->handle, index));
+  int rc = -1;
+  if (ret) {
+    char *buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(ret, &buf, &n) == 0) {
+      if (static_cast<uint32_t>(n) != size * sizeof(float)) {
+        set_error("MXPredGetOutput: caller buffer holds " +
+                  std::to_string(size) + " floats but output has " +
+                  std::to_string(n / sizeof(float)));
+      } else {
+        std::memcpy(data, buf, n);
+        rc = 0;
+      }
+    } else {
+      set_error(fetch_py_error());
+    }
+    Py_DECREF(ret);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  auto *p = static_cast<Predictor *>(handle);
+  if (!p) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *ret = call_backend("free", Py_BuildValue("(l)", p->handle));
+  Py_XDECREF(ret);
+  PyGILState_Release(gil);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+/*
+ * C predict API for mxnet_tpu.
+ *
+ * Drop-in subset of the reference's standalone inference ABI
+ * (ref: include/mxnet/c_predict_api.h — MXPredCreate/MXPredSetInput/
+ * MXPredForward/MXPredGetOutputShape/MXPredGetOutput/MXPredFree, and
+ * include/mxnet/c_api.h MXGetVersion/MXGetLastError/MXListAllOpNames).
+ * The implementation (c_predict_api.cc) embeds CPython and executes the
+ * jax/XLA graph through mxnet_tpu.c_api_backend; callers link only
+ * against this C ABI, exactly like a reference deployment.
+ *
+ * All functions return 0 on success, -1 on failure (then consult
+ * MXGetLastError).
+ */
+#ifndef MXTPU_PREDICT_H_
+#define MXTPU_PREDICT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *PredictorHandle;
+
+/* Library-wide */
+int MXGetVersion(int *out);
+const char *MXGetLastError(void);
+int MXListAllOpNames(uint32_t *out_size, const char ***out_array);
+
+/* Predictor lifecycle (ref: c_predict_api.h MXPredCreate):
+ *   symbol_json_str  – symbol graph as JSON (Symbol.tojson / file)
+ *   param_bytes/size – serialized parameters (nd.save format, the
+ *                      "<prefix>-0000.params" checkpoint file contents)
+ *   dev_type         – 1 = cpu, 2 = accelerator (tpu)
+ *   num_input_nodes / input_keys / input_shape_indptr / input_shape_data
+ *                    – CSR-packed input shapes, as in the reference
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data, PredictorHandle *out);
+
+/* As MXPredCreate but keeping only the listed outputs
+ * (ref: c_predict_api.h MXPredCreatePartialOut). */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           uint32_t num_input_nodes, const char **input_keys,
+                           const uint32_t *input_shape_indptr,
+                           const uint32_t *input_shape_data,
+                           uint32_t num_output_nodes,
+                           const char **output_keys, PredictorHandle *out);
+
+int MXPredGetOutputCount(PredictorHandle handle, uint32_t *out);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, uint32_t size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t **shape_data, uint32_t *shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float *data,
+                    uint32_t size);
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MXTPU_PREDICT_H_ */

@@ -738,18 +738,27 @@ def save(fname: str, data):
 
 def load(fname: str):
     with open(fname, "rb") as f:
-        magic, n = struct.unpack("<QQ", f.read(16))
-        if magic != _NDAR_MAGIC:
-            raise MXNetError(f"bad ndarray file magic {magic:#x}")
-        names, arrays = [], []
-        for _ in range(n):
-            (ln,) = struct.unpack("<I", f.read(4)); name = f.read(ln).decode()
-            (ld,) = struct.unpack("<I", f.read(4)); dt = f.read(ld).decode()
-            (nd,) = struct.unpack("<I", f.read(4))
-            shape = struct.unpack(f"<{nd}q", f.read(8 * nd)) if nd else ()
-            (nb,) = struct.unpack("<Q", f.read(8))
-            a = onp.frombuffer(f.read(nb), dtype=dt).reshape(shape)
-            names.append(name); arrays.append(array(a, dtype=dt))
+        return load_frombuffer(f.read())
+
+
+def load_frombuffer(buf: bytes):
+    """Deserialize from an in-memory buffer (ref: MXNDArrayLoadFromBuffer,
+    include/mxnet/c_api.h — used by the C predict API, which receives
+    param bytes rather than a path)."""
+    import io as _io
+    f = _io.BytesIO(buf)
+    magic, n = struct.unpack("<QQ", f.read(16))
+    if magic != _NDAR_MAGIC:
+        raise MXNetError(f"bad ndarray buffer magic {magic:#x}")
+    names, arrays = [], []
+    for _ in range(n):
+        (ln,) = struct.unpack("<I", f.read(4)); name = f.read(ln).decode()
+        (ld,) = struct.unpack("<I", f.read(4)); dt = f.read(ld).decode()
+        (nd,) = struct.unpack("<I", f.read(4))
+        shape = struct.unpack(f"<{nd}q", f.read(8 * nd)) if nd else ()
+        (nb,) = struct.unpack("<Q", f.read(8))
+        a = onp.frombuffer(f.read(nb), dtype=dt).reshape(shape)
+        names.append(name); arrays.append(array(a, dtype=dt))
     if any(names):
         return dict(zip(names, arrays))
     return arrays

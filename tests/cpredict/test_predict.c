@@ -1,0 +1,166 @@
+/*
+ * End-to-end C consumer of the predict ABI (ref: the reference's
+ * amalgamation / cpp-package deployments that link only c_predict_api).
+ *
+ * Usage: test_predict <symbol.json> <params file> <n_in> <expected_n_out>
+ * Feeds an iota input and prints the first output row; exits nonzero on
+ * any ABI failure.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu_predict.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s symbol.json params n_in n_out\n", argv[0]);
+    return 2;
+  }
+  long sym_size = 0, param_size = 0;
+  char *sym_json = read_file(argv[1], &sym_size);
+  char *params = read_file(argv[2], &param_size);
+  int n_in = atoi(argv[3]);
+  unsigned expect_out = (unsigned)atoi(argv[4]);
+  if (!sym_json || !params) {
+    fprintf(stderr, "cannot read inputs\n");
+    return 2;
+  }
+
+  int version = 0;
+  if (MXGetVersion(&version) != 0) {
+    fprintf(stderr, "MXGetVersion: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("version=%d\n", version);
+
+  uint32_t n_ops = 0;
+  const char **op_names = NULL;
+  if (MXListAllOpNames(&n_ops, &op_names) != 0) {
+    fprintf(stderr, "MXListAllOpNames: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("n_ops=%u\n", n_ops);
+
+  const char *input_keys[] = {"data"};
+  uint32_t indptr[] = {0, 2};
+  uint32_t shape_data[] = {1, (uint32_t)n_in};
+  PredictorHandle pred = NULL;
+  if (MXPredCreate(sym_json, params, (int)param_size, 1, 0, 1, input_keys,
+                   indptr, shape_data, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  float *input = (float *)malloc(sizeof(float) * n_in);
+  for (int i = 0; i < n_in; ++i) input[i] = (float)i / n_in;
+  if (MXPredSetInput(pred, "data", input, (uint32_t)n_in) != 0) {
+    fprintf(stderr, "MXPredSetInput: %s\n", MXGetLastError());
+    return 1;
+  }
+  /* wrong-size input must fail cleanly */
+  if (MXPredSetInput(pred, "data", input, (uint32_t)n_in + 1) == 0) {
+    fprintf(stderr, "oversized MXPredSetInput unexpectedly succeeded\n");
+    return 1;
+  }
+  if (MXPredSetInput(pred, "data", input, (uint32_t)n_in) != 0) {
+    fprintf(stderr, "MXPredSetInput(retry): %s\n", MXGetLastError());
+    return 1;
+  }
+
+  if (MXPredForward(pred) != 0) {
+    fprintf(stderr, "MXPredForward: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  uint32_t n_outputs = 0;
+  if (MXPredGetOutputCount(pred, &n_outputs) != 0) {
+    fprintf(stderr, "MXPredGetOutputCount: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("n_outputs=%u\n", n_outputs);
+
+  uint32_t *oshape = NULL, ondim = 0;
+  if (MXPredGetOutputShape(pred, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "MXPredGetOutputShape: %s\n", MXGetLastError());
+    return 1;
+  }
+  uint32_t total = 1;
+  printf("out_shape=");
+  for (uint32_t i = 0; i < ondim; ++i) {
+    printf("%u%s", oshape[i], i + 1 < ondim ? "x" : "\n");
+    total *= oshape[i];
+  }
+  if (ondim < 1 || oshape[ondim - 1] != expect_out) {
+    fprintf(stderr, "unexpected output shape\n");
+    return 1;
+  }
+
+  float *out = (float *)malloc(sizeof(float) * total);
+  if (MXPredGetOutput(pred, 0, out, total) != 0) {
+    fprintf(stderr, "MXPredGetOutput: %s\n", MXGetLastError());
+    return 1;
+  }
+  float sum = 0;
+  printf("out=");
+  for (uint32_t i = 0; i < total && i < 8; ++i) printf("%.6f ", out[i]);
+  printf("\n");
+  for (uint32_t i = 0; i < total; ++i) sum += out[i];
+  printf("out_sum=%.6f\n", sum);
+
+  if (MXPredFree(pred) != 0) {
+    fprintf(stderr, "MXPredFree: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  /* partial-out creation: select the final output by bare node name */
+  const char *out_keys[] = {"out"};
+  PredictorHandle pred2 = NULL;
+  if (MXPredCreatePartialOut(sym_json, params, (int)param_size, 1, 0, 1,
+                             input_keys, indptr, shape_data, 1, out_keys,
+                             &pred2) != 0) {
+    fprintf(stderr, "MXPredCreatePartialOut: %s\n", MXGetLastError());
+    return 1;
+  }
+  if (MXPredSetInput(pred2, "data", input, (uint32_t)n_in) != 0 ||
+      MXPredForward(pred2) != 0) {
+    fprintf(stderr, "partial-out forward: %s\n", MXGetLastError());
+    return 1;
+  }
+  float *out2 = (float *)malloc(sizeof(float) * total);
+  if (MXPredGetOutput(pred2, 0, out2, total) != 0) {
+    fprintf(stderr, "partial-out MXPredGetOutput: %s\n", MXGetLastError());
+    return 1;
+  }
+  for (uint32_t i = 0; i < total; ++i) {
+    if (out2[i] != out[i]) {
+      fprintf(stderr, "partial-out value mismatch at %u\n", i);
+      return 1;
+    }
+  }
+  MXPredFree(pred2);
+  free(out2);
+  printf("C_PREDICT_OK\n");
+  free(input);
+  free(out);
+  free(sym_json);
+  free(params);
+  return 0;
+}

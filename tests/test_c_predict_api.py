@@ -1,0 +1,82 @@
+"""End-to-end test of the native C predict ABI.
+
+Builds libmxtpu_capi.so (embedding CPython), compiles a pure-C consumer
+against mxtpu_predict.h, exports an MLP checkpoint from Python, and runs
+the C program — asserting its output matches the Python-side executor
+bit-for-bit (the reference's deployment story: a C/C++ app linking only
+c_predict_api, SURVEY.md §2.1 "Predict-only API").
+"""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "mxnet_tpu", "native")
+
+
+def _mlp():
+    x = sym.var("data")
+    h = sym.FullyConnected(x, num_hidden=8, name="fc1")
+    a = sym.Activation(h, act_type="relu")
+    o = sym.FullyConnected(a, num_hidden=3, name="fc2")
+    return sym.softmax(o, name="out")
+
+
+def test_c_predict_end_to_end(tmp_path):
+    from mxnet_tpu.native import build_capi
+    so = build_capi()
+
+    # export a tiny checkpoint from python
+    net = _mlp()
+    rs = onp.random.RandomState(0)
+    args = {"data": nd.array(rs.randn(1, 6).astype("float32")),
+            "fc1_weight": nd.array(rs.randn(8, 6).astype("float32")),
+            "fc1_bias": nd.zeros((8,)),
+            "fc2_weight": nd.array(rs.randn(3, 8).astype("float32")),
+            "fc2_bias": nd.zeros((3,))}
+    exe = net.bind(mx.cpu(), dict(args))
+    x = (onp.arange(6, dtype="float32") / 6.0).reshape(1, 6)
+    exe.arg_dict["data"]._rebind(nd.array(x)._data)
+    py_out = exe.forward()[0].asnumpy()
+
+    sym_path = str(tmp_path / "net-symbol.json")
+    net.save(sym_path)
+    params = {f"arg:{k}": v for k, v in args.items() if k != "data"}
+    param_path = str(tmp_path / "net-0000.params")
+    nd.save(param_path, params)
+
+    # compile the C consumer
+    c_src = os.path.join(ROOT, "tests", "cpredict", "test_predict.c")
+    c_bin = str(tmp_path / "test_predict")
+    subprocess.run(["gcc", "-O2", c_src, f"-I{NATIVE}", f"-L{NATIVE}",
+                    "-lmxtpu_capi", f"-Wl,-rpath,{NATIVE}", "-o", c_bin],
+                   check=True, capture_output=True)
+
+    # The embedded interpreter initializes with the default prefix, not
+    # this venv — point it at the repo + the venv's site-packages, and do
+    # NOT include any sitecustomize dir so JAX_PLATFORMS=cpu is honored.
+    import site
+    site_pkgs = site.getsitepackages()[0]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + site_pkgs
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([c_bin, sym_path, param_path, "6", "3"],
+                          env=env, capture_output=True, text=True,
+                          timeout=380)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"C predictor failed:\n{out[-3000:]}"
+    assert "C_PREDICT_OK" in out
+    # output values match python bit-for-bit (same fp32 math on CPU)
+    line = [l for l in proc.stdout.splitlines() if l.startswith("out=")][0]
+    c_vals = [float(v) for v in line[4:].split()]
+    assert onp.allclose(c_vals, py_out.ravel()[:len(c_vals)], atol=1e-6)
+    # op registry visible through the ABI
+    n_ops = int([l for l in proc.stdout.splitlines()
+                 if l.startswith("n_ops=")][0][6:])
+    assert n_ops > 500

@@ -13,7 +13,6 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.timeout(300)
 def test_dist_sync_kvstore_two_workers():
     env = dict(os.environ)
     # the worker forces the CPU backend in-process; drop any virtual-device
