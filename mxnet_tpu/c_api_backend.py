@@ -76,6 +76,18 @@ class _Predictor:
         self.executor = sym.bind(ctx, args, args_grad=None,
                                  aux_states=aux or None)
         self.outputs: List[onp.ndarray] = []
+        # Infer output shapes at create time so callers can allocate
+        # buffers before forward — the standard consumer pattern
+        # Create -> GetOutputShape -> malloc -> SetInput -> Forward
+        # (ref: c_predict_api.cc:245,290 infers out_shapes in
+        # MXPredCreate).  Refreshed with actual shapes after forward.
+        try:
+            _, out_shapes, _ = sym.infer_shape(
+                **{name: tuple(a.shape) for name, a in args.items()})
+            self._out_shapes = [tuple(s) if s is not None else None
+                                for s in (out_shapes or [])]
+        except Exception:
+            self._out_shapes = []
 
     def set_input(self, key: str, data: bytes, shape: Tuple[int, ...],
                   dtype: str):
@@ -90,10 +102,23 @@ class _Predictor:
     def forward(self):
         self.outputs = [o.asnumpy()
                         for o in self.executor.forward(is_train=False)]
+        self._out_shapes = [tuple(o.shape) for o in self.outputs]
 
     def get_output_shape(self, index: int) -> Tuple[int, ...]:
-        self._check_index(index)
-        return tuple(self.outputs[index].shape)
+        if self.outputs:
+            self._check_index(index)
+            return tuple(self.outputs[index].shape)
+        if not self._out_shapes:  # create-time inference failed entirely
+            raise MXNetError("output shapes could not be inferred at "
+                             "create time; call MXPredForward first")
+        if not 0 <= index < len(self._out_shapes):
+            raise MXNetError(f"output index {index} out of range "
+                             f"({len(self._out_shapes)} outputs)")
+        shape = self._out_shapes[index]
+        if shape is None:
+            raise MXNetError(f"output {index} shape could not be inferred "
+                             "at create time; call MXPredForward first")
+        return shape
 
     def get_output(self, index: int) -> bytes:
         self._check_index(index)

@@ -125,7 +125,11 @@ class _RNNLayer(HybridBlock):
                         num_layers=self._num_layers, mode=self._mode,
                         bidirectional=self._dir == 2, p=self._dropout,
                         state_outputs=True)
-        out, h_out, c_out = outputs
+        if self._mode == "lstm":
+            out, h_out, c_out = outputs
+        else:
+            out, h_out = outputs
+            c_out = None
         if self._layout == "NTC":
             out = out.swapaxes(0, 1)
         if self._mode == "lstm":

@@ -53,7 +53,12 @@ std::string fetch_py_error() {
   if (value) {
     PyObject *s = PyObject_Str(value);
     if (s) {
-      msg = PyUnicode_AsUTF8(s);
+      const char *utf8 = PyUnicode_AsUTF8(s);
+      if (utf8) {
+        msg = utf8;
+      } else {
+        PyErr_Clear();  // non-representable message; keep the placeholder
+      }
       Py_DECREF(s);
     }
   }
@@ -132,7 +137,12 @@ int MXListAllOpNames(uint32_t *out_size, const char ***out_array) {
     g_op_name_ptrs.clear();
     Py_ssize_t n = PyList_Size(ret);
     for (Py_ssize_t i = 0; i < n; ++i) {
-      g_op_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(ret, i)));
+      const char *utf8 = PyUnicode_AsUTF8(PyList_GetItem(ret, i));
+      if (!utf8) {  // skip non-UTF-8-representable names
+        PyErr_Clear();
+        continue;
+      }
+      g_op_names.emplace_back(utf8);
     }
     for (const auto &s : g_op_names) g_op_name_ptrs.push_back(s.c_str());
     *out_size = static_cast<uint32_t>(g_op_names.size());

@@ -427,8 +427,16 @@ def _proposal_single(score, bbox_deltas, im_info, anchors, feature_stride,
     return rois, scr
 
 
+def _proposal_visible(params):
+    """(rois,) normally; (rois, scores) when output_score is set
+    (ref: proposal.cc exposes the score output under output_score=True;
+    ADVICE r1: the flag was accepted and silently dropped)."""
+    from .registry import parse_bool_param
+    return 2 if parse_bool_param(params.get("output_score", False)) else 1
+
+
 @register_op("_contrib_Proposal", n_out=2, differentiable=False,
-             aliases=["Proposal"], visible_outputs=1)
+             aliases=["Proposal"], visible_outputs=_proposal_visible)
 def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
@@ -448,7 +456,7 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
 
 
 @register_op("_contrib_MultiProposal", n_out=2, differentiable=False,
-             aliases=["MultiProposal"], visible_outputs=1)
+             aliases=["MultiProposal"], visible_outputs=_proposal_visible)
 def multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
                    rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
                    scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
@@ -685,10 +693,13 @@ def _dgl_sample_host(indptr, indices, data, seeds, num_hops, num_neighbor,
     rng = rng or onp.random
     seeds = onp.asarray(seeds).astype(onp.int64)
     seeds = seeds[seeds >= 0]
-    visited = dict.fromkeys(seeds.tolist())
+    # vertex -> hop distance at first visit (0 for seeds) — emitted as the
+    # per-slot layer output (ref: CSRNeighborUniformSample writes actual
+    # hop distances, -1 for unused slots; ADVICE r1: all-zeros was wrong)
+    visited = dict.fromkeys(seeds.tolist(), 0)
     frontier = list(seeds.tolist())
     sub_rows = {}
-    for _ in range(int(num_hops)):
+    for hop in range(int(num_hops)):
         nxt = []
         for v in frontier:
             lo, hi = int(indptr[v]), int(indptr[v + 1])
@@ -707,7 +718,7 @@ def _dgl_sample_host(indptr, indices, data, seeds, num_hops, num_neighbor,
             sub_rows[v] = (nbr, eid)
             for u in nbr.tolist():
                 if u not in visited:
-                    visited[u] = None
+                    visited[u] = hop + 1
                     nxt.append(u)
         frontier = nxt
     verts = list(visited)[:int(max_num_vertices)]
@@ -715,7 +726,9 @@ def _dgl_sample_host(indptr, indices, data, seeds, num_hops, num_neighbor,
     n = int(max_num_vertices)
     out_v = onp.full((n,), -1, onp.int64)
     out_v[:len(verts)] = verts
-    # layer annotation: hop distance (0 for seeds)
+    # layer annotation: hop distance (0 for seeds), -1 for unused slots
+    layer = onp.full((n,), -1, onp.int64)
+    layer[:len(verts)] = [visited[v] for v in verts]
     sub_indptr = onp.zeros((n + 1,), onp.int64)
     cols, eids = [], []
     for i, v in enumerate(verts):
@@ -731,7 +744,7 @@ def _dgl_sample_host(indptr, indices, data, seeds, num_hops, num_neighbor,
     return (jnp.asarray(out_v), jnp.asarray(sub_indptr),
             jnp.asarray(onp.asarray(cols, onp.int64)),
             jnp.asarray(onp.asarray(eids, onp.float32)),
-            jnp.asarray(onp.full((n,), 0, onp.int64)))
+            jnp.asarray(layer))
 
 
 @register_op("_contrib_dgl_csr_neighbor_uniform_sample", n_out=-1,

@@ -1,16 +1,25 @@
 """Pallas TPU kernels for the hot ops.
 
 Flash attention: the kernel the reference era hand-wrote in CUDA for
-attention-adjacent workloads is here a Pallas kernel tiled for the MXU
-(128-aligned q/k blocks, fp32 online-softmax accumulators in VMEM) with a
-recompute backward via jax.custom_vjp. Falls back to the XLA composition
-(parallel/ring_attention.local_attention) on CPU or when shapes don't
-tile — same numerics, so tests validate the kernel in interpret mode.
+attention-adjacent workloads is here a Pallas kernel tiled for the MXU.
+Memory is O(T) in sequence length on both passes:
+
+- forward: K/V blocks stream through VMEM via the innermost grid
+  dimension (double-buffered by Mosaic), online softmax in fp32
+  accumulators held in VMEM scratch across the K sweep; the row
+  logsumexp is emitted as a second output for the backward.
+- backward: two tiled kernels with per-block recompute of the
+  probabilities from (q, k, lse) — dq sweeps K blocks, dk/dv sweeps Q
+  blocks — never materializing a T x T matrix (the flash-attention
+  backward; round-1 used a dense jax.vjp here, which was O(T^2)).
+
+Falls back to the XLA composition (parallel/ring_attention
+.local_attention) on CPU or when shapes don't tile — same numerics, so
+tests validate the kernels in interpret mode.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,145 +46,297 @@ def flash_attention_available(q_len: int, k_len: int, head_dim: int) -> bool:
             and (head_dim % 128 == 0 or head_dim in (64, 128, 256)))
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-               scale: float, k_len: int):
-    """One (batch*head, q_block) program: stream K/V blocks, online
-    softmax in fp32 accumulators."""
-    q = q_ref[...].astype(jnp.float32) * scale  # (block_q, d)
-    block_q, d = q.shape
+def _dot32(a, b, trans_a=False, trans_b=False):
+    """MXU matmul with fp32 accumulation regardless of input dtype."""
+    dn = (((0,) if trans_a else (1,), (1,) if trans_b else (0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dn,
+                               preferred_element_type=jnp.float32)
+
+
+def _causal_mask(s, qi, bq, kj, bk):
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward: grid (BH, nq, nk) — K/V stream through the innermost dimension
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, causal, scale, bq, bk, nk):
     qi = pl.program_id(1)
+    kj = pl.program_id(2)
 
-    def body(start_k, carry):
-        o, m, l = carry
-        k = k_ref[pl.ds(start_k * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(start_k * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk)
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: K blocks strictly above the diagonal contribute nothing
+    needed = (qi + 1) * bq - 1 >= kj * bk if causal else True
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale        # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = _dot32(q, k, trans_b=True)                  # (bq, bk)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_blk = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1)
-        o_new = o * corr[:, None] + jax.lax.dot(p, v)
-        return o_new, m_new, l_new
+            s = _causal_mask(s, qi, bq, kj, bk)
+        m_prev = m_ref[:, 0:1]                          # (bq, 1)
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                          # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)                  # (bq, 1)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + _dot32(p, v)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    o0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    num_k = k_len // block_k
-    if causal:
-        # only K-blocks touching rows up to this Q-block's LAST row
-        # contribute; also never beyond k_len (cross-length case)
-        num_k_run = jnp.minimum(num_k,
-                                ((qi + 1) * block_q - 1) // block_k + 1)
-        o, m, l = jax.lax.fori_loop(0, num_k_run, body, (o0, m0, l0))
-    else:
-        o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
-    o_ref[...] = (o / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _flush():
+        l = l_ref[:, 0:1]
+        m = m_ref[:, 0:1]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+        lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-20))   # (bq, 1)
 
 
-def _fa_kernel_3d(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-                  k_len):
-    # refs carry a leading singleton (the batch*head block); strip it
-    _fa_kernel(_Squeezed(q_ref), _Squeezed(k_ref), _Squeezed(v_ref),
-               _Squeezed(o_ref), block_k=block_k, causal=causal,
-               scale=scale, k_len=k_len)
+def _flash_fwd(q, k, v, causal, s, bq, bk, interpret):
+    """q/k/v: (BH, T, D) -> (out (BH, Tq, D), lse (BH, Tq) fp32)."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    nq, nk = Tq // bq, Tk // bk
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=s,
+                               bq=bq, bk=bk, nk=nk)
+    compiler_params = None
+    if _HAS_PLTPU and not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            # (BH, Tq, 1): the last-two-dims of every block must be
+            # (8, 128)-aligned or span the array — a (1, bq) row block
+            # is rejected by the Mosaic lowering
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(q, k, v)
 
 
-class _Squeezed:
-    """View of a (1, m, n) ref as (m, n)."""
+# ---------------------------------------------------------------------------
+# backward: dq sweeps K blocks; dk/dv sweeps Q blocks (per-block recompute)
+# ---------------------------------------------------------------------------
 
-    def __init__(self, ref):
-        self._ref = ref
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, causal, scale, bq, bk, nk):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
 
-    @property
-    def dtype(self):
-        return self._ref.dtype
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @property
-    def shape(self):
-        return self._ref.shape[1:]
+    needed = (qi + 1) * bq - 1 >= kj * bk if causal else True
 
-    def __getitem__(self, idx):
-        if idx is Ellipsis:
-            return self._ref[0]
-        return self._ref[(0,) + (idx if isinstance(idx, tuple) else (idx,))]
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                 # (bq, 1)
+        delta = delta_ref[0]
+        s = _dot32(q, k, trans_b=True)
+        if causal:
+            s = _causal_mask(s, qi, bq, kj, bk)
+        p = jnp.exp(s - lse)                             # (bq, bk)
+        dp = _dot32(do, v, trans_b=True)                 # (bq, bk)
+        ds = p * (dp - delta)
+        acc_ref[...] += scale * _dot32(ds, k)            # (bq, d)
 
-    def __setitem__(self, idx, val):
-        if idx is Ellipsis:
-            self._ref[0] = val
-        else:
-            self._ref[(0,) + (idx if isinstance(idx, tuple)
-                              else (idx,))] = val
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    causal, scale, bq, bk, nq):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    needed = (qi + 1) * bq - 1 >= kj * bk if causal else True
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                 # (bq, 1)
+        delta = delta_ref[0]
+        s = _dot32(q, k, trans_b=True)                   # (bq, bk)
+        if causal:
+            s = _causal_mask(s, qi, bq, kj, bk)
+        p = jnp.exp(s - lse)
+        dv_acc[...] += _dot32(p, do, trans_a=True)       # (bk, d)
+        dp = _dot32(do, v, trans_b=True)
+        ds = p * (dp - delta)                            # (bq, bk)
+        # scale * ds^T @ (q*scale)/scale = scale * ds^T @ q_raw
+        dk_acc[...] += _dot32(ds, q, trans_a=True)
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, s, bq, bk, interpret):
+    """(BH, T, D) operands -> (dq, dk, dv), O(T) memory."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    nq, nk = Tq // bq, Tk // bk
+    # delta_i = sum_d dO_id * O_id — rowwise, XLA fuses this
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)               # (BH, Tq, 1)
+    row_spec_q = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    compiler_params = None
+    if _HAS_PLTPU and not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=s,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            row_spec_q,
+            row_spec_q,
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    row_spec_kq = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=s,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            row_spec_kq,
+            row_spec_kq,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (B, H, T, D) with custom vjp
+# ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     interpret=False):
     """q/k/v: (B, H, T, D). Tiled online-softmax attention on the MXU."""
-    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    return _flash_fwd_dispatch(q, k, v, causal, s, block_q, block_k,
-                               interpret)
+    out, _ = _fa_vjp_fwd(q, k, v, causal, scale, block_q, block_k,
+                         interpret)
+    return out
 
 
-def _flash_fwd_dispatch(q, k, v, causal, s, block_q, block_k, interpret):
+def _resolve_blocks(q, k, block_q, block_k):
     Tq, Tk = q.shape[2], k.shape[2]
-    bq = min(block_q, Tq)
-    bk = min(block_k, Tk)
-    if Tq % bq or Tk % bk:
-        from ..parallel.ring_attention import local_attention
-        return local_attention(q, k, v, scale=s, causal=causal)
-    return _flash_fwd_wrapped(q, k, v, causal, s, bq, bk, interpret)
-
-
-def _flash_fwd_wrapped(q, k, v, causal, s, bq, bk, interpret):
-    kernel = functools.partial(_fa_kernel_3d, block_k=bk, causal=causal,
-                               scale=s, k_len=k.shape[2])
-    B, H, Tq, D = q.shape
-    Tk = k.shape[2]
-    qr = q.reshape(B * H, Tq, D)
-    kr = k.reshape(B * H, Tk, D)
-    vr = v.reshape(B * H, Tk, D)
-    out = pl.pallas_call(
-        kernel,
-        grid=(B * H, Tq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-        interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(B, H, Tq, D)
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    # no pltpu (kernels need its VMEM scratch even in interpret mode)
+    # -> dense XLA fallback
+    tiles = _HAS_PLTPU and Tq % bq == 0 and Tk % bk == 0
+    return bq, bk, tiles
 
 
 def _fa_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    out = _flash_fwd_dispatch(q, k, v, causal, s, block_q, block_k,
-                              interpret)
-    return out, (q, k, v)
+    bq, bk, tiles = _resolve_blocks(q, k, block_q, block_k)
+    if not tiles:
+        from ..parallel.ring_attention import local_attention
+        out = local_attention(q, k, v, scale=s, causal=causal)
+        return out, (q, k, v, None, None)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    out, lse = _flash_fwd(q.reshape(B * H, Tq, D), k.reshape(B * H, Tk, D),
+                          v.reshape(B * H, Tk, D), causal, s, bq, bk,
+                          interpret)
+    return out.reshape(B, H, Tq, D), (q, k, v, out, lse)
 
 
 def _fa_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    """Recompute backward (flash-attention pattern: saves O(T^2) memory by
-    re-deriving the probabilities from q,k)."""
-    q, k, v = res
+    q, k, v, out, lse = res
     s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-
-    def ref_attn(q_, k_, v_):
+    if lse is None:  # non-tiling fallback path: dense recompute vjp
         from ..parallel.ring_attention import local_attention
-        return local_attention(q_, k_, v_, scale=s, causal=causal)
 
-    _, vjp = jax.vjp(ref_attn, q, k, v)
-    return vjp(g)
+        def ref_attn(q_, k_, v_):
+            return local_attention(q_, k_, v_, scale=s, causal=causal)
+
+        _, vjp = jax.vjp(ref_attn, q, k, v)
+        return vjp(g)
+    bq, bk, _ = _resolve_blocks(q, k, block_q, block_k)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    dq, dk, dv = _flash_bwd(
+        q.reshape(B * H, Tq, D), k.reshape(B * H, Tk, D),
+        v.reshape(B * H, Tk, D), out,
+        lse, g.reshape(B * H, Tq, D), causal, s, bq, bk, interpret)
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
 
 
 flash_attention.defvjp(_fa_vjp_fwd, _fa_vjp_bwd)

@@ -16,7 +16,16 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..base import MXNetError
 
-__all__ = ["register_op", "get_op", "list_ops", "OpInfo", "make_nd_function"]
+__all__ = ["register_op", "get_op", "list_ops", "OpInfo",
+           "make_nd_function", "parse_bool_param"]
+
+
+def parse_bool_param(v) -> bool:
+    """Coerce an op param that may arrive as a string (symbol json /
+    C-API attrs) to bool — the dmlc::Parameter bool-parsing role."""
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes", "on")
+    return bool(v)
 
 
 class OpInfo:
@@ -143,6 +152,17 @@ def make_nd_function(name: str) -> Callable:
             inputs.append(_w(_jax.random.key_data(next_key())))
         out = invoke(info.fn, inputs, n_out=n_out,
                      differentiable=info.differentiable, **rest_params)
+        # Hide non-visible outputs in eager mode too (ref:
+        # FNumVisibleOutputs applies to imperative invoke). Ops with
+        # aux_updates are exempt: their hidden outputs are the new aux
+        # values, which the eager caller (e.g. gluon BatchNorm) writes
+        # back itself.
+        vis = info.visible_outputs
+        if callable(vis):  # param-dependent (e.g. Proposal output_score)
+            vis = vis(rest_params)
+        if vis is not None and not info.aux_updates \
+                and isinstance(out, (tuple, list)) and vis < len(out):
+            out = out[0] if vis == 1 else out[:vis]
         if out_kw is not None:
             out_kw._rebind(out._data if isinstance(out, NDArray) else out[0]._data)
             return out_kw

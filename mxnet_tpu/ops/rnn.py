@@ -147,9 +147,18 @@ def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
     return ys, hT, None
 
 
+def _rnn_visible(params):
+    """1 output normally; with state_outputs also h_out (and c_out for
+    LSTM) — ref: rnn-inl.h NumVisibleOutputs."""
+    from .registry import parse_bool_param
+    if not parse_bool_param(params.get("state_outputs", False)):
+        return 1
+    return 3 if params.get("mode", "lstm") == "lstm" else 2
+
+
 @register_op("RNN", n_out=3, needs_rng=True, needs_train=True,
              input_names=("data", "parameters", "state", "state_cell"),
-             visible_outputs=1)
+             visible_outputs=_rnn_visible)
 def rnn(data, parameters, state, *rest, state_size=0, num_layers=1,
         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
         projection_size=None, lstm_state_clip_min=None,

@@ -108,32 +108,62 @@ register_subgraph_property("default")(XLAFusionProperty)
 def _assign_regions(nodes, selector) -> Dict[int, int]:
     """Greedy convex region assignment in topological order.
 
-    A node may join the region of a direct input unless that region is
-    'poisoned' for it — reachable through an intervening non-region node —
-    which would create a cycle after contraction (the reference's
-    incomprehensible-cycle check in build_subgraph.cc lives here)."""
+    Two cycle guards (the reference's cycle check in build_subgraph.cc
+    plays both roles):
+
+    1. *Same-region re-entry* ('poisoned'): a node may not join region R
+       if R's value reaches it through an intervening non-member node —
+       contraction would create R -> node -> R.
+    2. *Inter-region cycles* ('region_reach'): joining region R is
+       forbidden when some other region R' is an ancestor of this node
+       while R already reaches R' — contraction would close the loop
+       R -> R' -> node(R).  Region-level reachability is maintained
+       transitively as regions grow (graphs are small; the O(R^2)
+       closure update is fine).
+    """
     region_of: Dict[int, int] = {}
     poisoned: Dict[int, Set[int]] = {}
+    # ancestor regions per node (any region with a member upstream of it)
+    anc: Dict[int, Set[int]] = {}
+    # region -> set of regions reachable FROM it in the contracted graph
+    region_reach: Dict[int, Set[int]] = {}
+
+    def _add_reach_edges(srcs: Set[int], dst: int):
+        """Record edges src -> dst and keep region_reach transitive."""
+        new_dst = {dst} | region_reach.get(dst, set())
+        for src in srcs:
+            for s in list(region_reach):
+                if src == s or src in region_reach[s]:
+                    region_reach[s] |= new_dst
+            region_reach.setdefault(src, set()).update(new_dst)
+
     next_region = 0
     for node in nodes:
         pois: Set[int] = set()
+        anc_n: Set[int] = set()
         in_regions: Set[int] = set()
         for inp, _ in node.inputs:
             pois |= poisoned.get(id(inp), set())
+            anc_n |= anc.get(id(inp), set())
             r = region_of.get(id(inp))
             if r is not None:
                 in_regions.add(r)
+                anc_n.add(r)
         if not node.is_variable and selector.select(node):
-            candidates = sorted(in_regions - pois)
             picked = None
-            for r in candidates:
-                # the region may also veto absorbing this node
+            for r in sorted(in_regions - pois):
+                # joining r adds edges R' -> r for every other ancestor
+                # region R'; reject if r already reaches any such R'
+                if any(rp in region_reach.get(r, ())
+                       for rp in anc_n if rp != r):
+                    continue
                 picked = r
                 break
             if picked is None:
                 picked = next_region
                 next_region += 1
             region_of[id(node)] = picked
+            _add_reach_edges(anc_n - {picked}, picked)
             # regions NOT picked remain poisonous downstream (their values
             # leave the region and re-enter through this node's output)
             pois |= (in_regions - {picked})
@@ -141,6 +171,7 @@ def _assign_regions(nodes, selector) -> Dict[int, int]:
             # all input regions become poisonous for downstream nodes
             pois |= in_regions
         poisoned[id(node)] = pois
+        anc[id(node)] = anc_n
     return region_of
 
 
@@ -205,9 +236,17 @@ def build_subgraph(symbol, prop: Optional[SubgraphProperty] = None,
                         ins.append(entry)
         return ins
 
+    building: Set[int] = set()
+
     def _build_region_node(r):
         if r in region_node:
             return region_node[r]
+        if r in building:
+            raise RuntimeError(
+                f"cycle between contracted subgraph regions involving "
+                f"region {r} — partition produced a non-DAG (bug in "
+                f"_assign_regions cycle guard)")
+        building.add(r)
         ext_inputs = _region_inputs(r)
         in_names = [f"__sg{r}_in{i}" for i in range(len(ext_inputs))]
         # clone member nodes into a sub-symbol over placeholder variables
@@ -236,6 +275,7 @@ def build_subgraph(symbol, prop: Optional[SubgraphProperty] = None,
         outer_ins = [_map_entry(entry) for entry in ext_inputs]
         big = _Node(op_name, f"subgraph{r}", outer_ins, params)
         region_node[r] = big
+        building.discard(r)
         for slot, (nid_, oi) in enumerate(out_entries):
             entry_map[(nid_, oi)] = (big, slot)
         return big

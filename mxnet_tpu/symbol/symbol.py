@@ -514,6 +514,8 @@ def _make_node(op_name: str, inputs: List[Tuple[_Node, int]], params: dict,
     node = _Node(op_name, name, list(inputs), params, attrs)
     n_out = node._n_out
     info_vis = info.visible_outputs
+    if callable(info_vis):  # param-dependent (e.g. Proposal output_score)
+        info_vis = info_vis(params)
     vis = info_vis if info_vis is not None else n_out
     return Symbol([(node, i) for i in range(vis)])
 

@@ -69,6 +69,19 @@ int main(int argc, char **argv) {
     return 1;
   }
 
+  /* standard consumer pattern: output shape must be available right
+   * after Create, BEFORE SetInput/Forward (allocate buffers up front) */
+  uint32_t *pre_shape = NULL, pre_ndim = 0;
+  if (MXPredGetOutputShape(pred, 0, &pre_shape, &pre_ndim) != 0) {
+    fprintf(stderr, "MXPredGetOutputShape(pre-forward): %s\n",
+            MXGetLastError());
+    return 1;
+  }
+  if (pre_ndim < 1 || pre_shape[pre_ndim - 1] != expect_out) {
+    fprintf(stderr, "unexpected pre-forward output shape\n");
+    return 1;
+  }
+
   float *input = (float *)malloc(sizeof(float) * n_in);
   for (int i = 0; i < n_in; ++i) input[i] = (float)i / n_in;
   if (MXPredSetInput(pred, "data", input, (uint32_t)n_in) != 0) {

@@ -73,6 +73,32 @@ def test_subgraph_partial_selection_and_outside_consumer():
     assert onp.allclose(got[0], ref[0], atol=1e-5)
 
 
+def test_subgraph_inter_region_cycle_guard():
+    """Two regions connected both directly and through an unselected
+    bridge node must not contract into a cyclic graph (ADVICE r1: the
+    poison check alone only guards same-region re-entry; r0 -> g -> h(r1)
+    plus c2(r1) -> e(r0) closed a loop and recursed forever)."""
+    from mxnet_tpu.subgraph import build_subgraph, XLAFusionProperty
+    x = sym.var("x")
+    y = sym.var("y")
+    a = sym.relu(x, name="a")
+    a2 = sym.relu(a, name="a2")          # r0 = {a, a2, ...}
+    c = sym.relu(y, name="c")
+    c2 = sym.relu(c, name="c2")          # r1 = {c, c2, ...}
+    e = sym.elemwise_add(a2, c2, name="e")   # joins r0; edge r1 -> r0
+    g = sym.negative(a2, name="g")           # unselected bridge out of r0
+    h = sym.elemwise_add(g, c2, name="h")    # joining r1 would close loop
+    out = sym.Group([e, h])
+    rs = onp.random.RandomState(3)
+    vals = {"x": rs.randn(2, 4).astype("float32"),
+            "y": rs.randn(2, 4).astype("float32")}
+    ref = _eval(out, vals)
+    part = build_subgraph(out, XLAFusionProperty())  # must not recurse
+    got = _eval(part, vals)
+    for r, g_ in zip(ref, got):
+        assert onp.allclose(g_, r, atol=1e-5)
+
+
 def test_subgraph_through_executor():
     from mxnet_tpu.subgraph import build_subgraph
     net = _dense_chain()

@@ -28,6 +28,33 @@ def _mlp():
     return sym.softmax(o, name="out")
 
 
+def test_backend_output_shape_before_forward(tmp_path):
+    """The ABI contract: Create -> GetOutputShape -> malloc -> SetInput ->
+    Forward (ref: c_predict_api.cc:245,290 infers out_shapes at create;
+    ADVICE r1: requiring forward first broke the standard consumer)."""
+    from mxnet_tpu import c_api_backend as cab
+
+    net = _mlp()
+    rs = onp.random.RandomState(0)
+    params = {"arg:fc1_weight": nd.array(rs.randn(8, 6).astype("float32")),
+              "arg:fc1_bias": nd.zeros((8,)),
+              "arg:fc2_weight": nd.array(rs.randn(3, 8).astype("float32")),
+              "arg:fc2_bias": nd.zeros((3,))}
+    ppath = str(tmp_path / "p.params")
+    nd.save(ppath, params)
+    with open(ppath, "rb") as f:
+        pbytes = f.read()
+    h = cab.create(net.tojson(), pbytes, 1, 0, ["data"], [[2, 6]])
+    try:
+        assert cab.get_output_shape(h, 0) == (2, 3)  # before any forward
+        cab.set_input(h, "data", onp.zeros((2, 6), "float32").tobytes(),
+                      [2, 6])
+        cab.forward(h)
+        assert cab.get_output_shape(h, 0) == (2, 3)
+    finally:
+        cab.free(h)
+
+
 def test_c_predict_end_to_end(tmp_path):
     from mxnet_tpu.native import build_capi
     so = build_capi()

@@ -177,11 +177,16 @@ def test_proposal_shapes_and_validity():
     # boxes inside the image
     assert (r[:, 1] >= 0).all() and (r[:, 3] <= 127).all()
     assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
-    # MultiProposal agrees on batch handling
-    rois2, _ = nd._contrib_MultiProposal(
+    # scores output actually carries the picked fg scores
+    s = _np(scores)
+    assert s.shape == (40, 1) and onp.isfinite(s).all()
+    # MultiProposal agrees on batch handling; without output_score the
+    # score output is hidden (ref: NumVisibleOutputs of proposal.cc)
+    rois2 = nd._contrib_MultiProposal(
         cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=200,
         rpn_post_nms_top_n=40, threshold=0.7, rpn_min_size=4,
         scales=(8, 16, 32), ratios=(0.5, 1, 2))
+    assert not isinstance(rois2, (tuple, list))
     assert _np(rois2).shape == (40, 5)
 
 
@@ -214,7 +219,8 @@ def test_deformable_psroi_and_rroi():
     rs = onp.random.RandomState(3)
     data = nd.array(rs.uniform(0, 1, (1, 8, 12, 12)).astype("float32"))
     rois = nd.array(onp.array([[0, 4, 4, 40, 40]], dtype="float32"))
-    out, _ = nd._contrib_DeformablePSROIPooling(
+    # single visible output (top_count hidden, ref NumVisibleOutputs=1)
+    out = nd._contrib_DeformablePSROIPooling(
         data, rois, spatial_scale=0.25, output_dim=2, group_size=2,
         pooled_size=2, no_trans=True)
     assert _np(out).shape == (1, 2, 2, 2)
@@ -243,6 +249,13 @@ def test_dgl_sampling_and_subgraph():
     assert verts[0] == 0 and (verts >= -1).all()
     sub_indptr = _np(outs[1])
     assert sub_indptr[-1] >= 0
+    # layer output: hop distance per slot (0 = seed, 1 = neighbor),
+    # -1 padding for unused slots (ref: CSRNeighborUniformSample)
+    layer = _np(outs[4])
+    assert layer[0] == 0  # the seed
+    used = verts >= 0
+    assert (layer[used][1:] == 1).all()  # 1-hop sample: neighbors at hop 1
+    assert (layer[~used] == -1).all()
     # vertex-induced subgraph on {0,1,2}
     outs2 = nd._contrib_dgl_subgraph(
         nd.array(indptr), nd.array(indices), nd.array(data),

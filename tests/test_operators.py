@@ -150,7 +150,8 @@ def test_rnn_op_lstm():
     h0 = nd.zeros((1, B, H))
     c0 = nd.zeros((1, B, H))
     out, h_out, c_out = nd.RNN(x, params, h0, c0, state_size=H,
-                               num_layers=1, mode="lstm")
+                               num_layers=1, mode="lstm",
+                               state_outputs=True)
     assert out.shape == (T, B, H)
     assert h_out.shape == (1, B, H)
     # bidirectional, 2 layers
@@ -159,8 +160,15 @@ def test_rnn_op_lstm():
     h02 = nd.zeros((4, B, H))
     c02 = nd.zeros((4, B, H))
     out2, _, _ = nd.RNN(x, params2, h02, c02, state_size=H, num_layers=2,
-                        mode="lstm", bidirectional=True)
+                        mode="lstm", bidirectional=True,
+                        state_outputs=True)
     assert out2.shape == (T, B, 2 * H)
+    # without state_outputs only the sequence output is visible
+    # (ref: rnn-inl.h NumVisibleOutputs)
+    only = nd.RNN(x, params, h0, c0, state_size=H, num_layers=1,
+                  mode="lstm")
+    assert not isinstance(only, (tuple, list))
+    assert only.shape == (T, B, H)
 
 
 def test_rnn_op_gru_vanilla():
@@ -171,9 +179,10 @@ def test_rnn_op_gru_vanilla():
         psize = rnn_param_size(mode, 1, I, H, False)
         params = nd.array(onp.random.randn(psize).astype("float32") * 0.1)
         h0 = nd.zeros((1, B, H))
-        out, h_out, _ = nd.RNN(x, params, h0, state_size=H, num_layers=1,
-                               mode=mode)
+        out, h_out = nd.RNN(x, params, h0, state_size=H, num_layers=1,
+                            mode=mode, state_outputs=True)
         assert out.shape == (T, B, H)
+        assert h_out.shape == (1, B, H)
 
 
 def test_ctc_loss():
