@@ -25,9 +25,8 @@ def _maybe_install_signal_handler():
     the SIGSEGV/SIGABRT backtrace handler behind MXNET_USE_SIGNAL_HANDLER).
     faulthandler is the CPython-native equivalent; on by default like the
     reference's release builds, disabled with MXNET_USE_SIGNAL_HANDLER=0."""
-    import os
-    if os.environ.get("MXNET_USE_SIGNAL_HANDLER", "1") not in \
-            ("0", "false", "False"):
+    from . import config as _config
+    if _config.get("MXNET_USE_SIGNAL_HANDLER"):
         import faulthandler
         try:
             faulthandler.enable()
@@ -36,6 +35,7 @@ def _maybe_install_signal_handler():
 
 
 _maybe_install_signal_handler()
+from . import config  # noqa: F401,E402  (typed MXNET_* flag registry)
 
 from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus  # noqa: F401

@@ -209,8 +209,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             continue
         if not node.differentiable:
             continue
+        from .ndarray.sparse_ops import SparseCotangent
         cotangents = [
-            g if g is not None else _zero_cotangent(o)
+            (g.densify() if isinstance(g, SparseCotangent) else g)
+            if g is not None else _zero_cotangent(o)
             for g, o in zip(out_grads, node.outputs)
         ]
         if node.custom_backward is not None:
@@ -227,13 +229,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 continue
             key = id(inp)
             if key in grads:
-                grads[key] = grads[key] + ig
+                grads[key] = grads[key] + ig  # SparseCotangent sums too
             else:
                 grads[key] = ig
             if owner is not None and getattr(owner, "_grad", None) is not None:
                 owner._pending_grad = grads[key]
 
     # deposit into marked variables per grad_req
+    from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+    from .ndarray.sparse_ops import SparseCotangent
     seen = set()
     for node in tape.nodes:
         for owner in node.input_owners:
@@ -243,7 +247,49 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             pend = getattr(owner, "_pending_grad", None)
             if pend is None:
                 continue
-            if owner._grad_req == "add":
+            if isinstance(pend, SparseCotangent):
+                # row-sparse gradient: deposit without materializing the
+                # dense buffer when the grad slot is sparse (ref:
+                # Embedding sparse_grad / dot(csr.T, _) grads)
+                if isinstance(owner._grad, BaseSparseNDArray) \
+                        and owner._grad_req != "add":
+                    owner._grad = pend.to_rowsparse()
+                elif isinstance(owner._grad, BaseSparseNDArray):
+                    prev = owner._grad
+                    merged = SparseCotangent(
+                        jnp.concatenate([prev._aux["values"], pend.values]),
+                        jnp.concatenate([prev._aux["indices"],
+                                         pend.indices]), pend.shape) \
+                        if prev._aux["values"].size else pend
+                    owner._grad = merged.to_rowsparse()
+                elif owner._grad_req == "add":
+                    owner._grad._data = owner._grad._data + pend.densify()
+                else:
+                    owner._grad._data = pend.densify()
+            elif isinstance(owner, BaseSparseNDArray):
+                # leaf stored sparse: cotangent is values-shaped; pair it
+                # with the leaf's indices as a row_sparse grad
+                new_g = RowSparseNDArray(
+                    pend, owner._aux["indices"], owner.shape)
+                if owner._grad_req == "add" and \
+                        isinstance(owner._grad, RowSparseNDArray) and \
+                        owner._grad._aux["values"].size:
+                    prev = owner._grad
+                    merged = SparseCotangent(
+                        jnp.concatenate([prev._aux["values"], pend]),
+                        jnp.concatenate([prev._aux["indices"],
+                                         new_g._aux["indices"]]),
+                        owner.shape)
+                    new_g = merged.to_rowsparse()
+                owner._grad = new_g
+            elif isinstance(owner._grad, BaseSparseNDArray):
+                # dense cotangent reached a sparse grad slot (mixed
+                # sparse+dense paths): grad degrades to dense honestly
+                from .ndarray.ndarray import _wrap as _dense_wrap
+                owner._grad = _dense_wrap(
+                    owner._grad._data + pend if owner._grad_req == "add"
+                    else pend)
+            elif owner._grad_req == "add":
                 owner._grad._data = owner._grad._data + pend
             else:  # write
                 owner._grad._data = pend.astype(owner._grad._data.dtype) \

@@ -35,14 +35,14 @@ class MXNetError(RuntimeError):
 
 def get_env(name: str, default, dtype: Optional[type] = None):
     """Typed env lookup (ref: dmlc::GetEnv use sites, e.g.
-    src/engine/threaded_engine_perdevice.cc:84; docs/faq/env_var.md)."""
-    val = os.environ.get(name)
-    if val is None:
-        return default
-    ty = dtype or type(default)
-    if ty is bool:
-        return val not in ("0", "false", "False", "")
-    return ty(val)
+    src/engine/threaded_engine_perdevice.cc:84; docs/faq/env_var.md).
+
+    Delegates to the typed flag registry (mxnet_tpu.config) so runtime
+    overrides via config.set_flag are honored everywhere. For names
+    registered in the flag registry the registry's type and default are
+    canonical; `default`/`dtype` only apply to unregistered names."""
+    from . import config as _config
+    return _config.get(name, default, dtype=dtype)
 
 
 def data_dir() -> str:

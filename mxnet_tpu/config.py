@@ -1,0 +1,303 @@
+"""Typed runtime configuration / env-flag system.
+
+The reference exposes ~83 ``MXNET_*`` environment variables read ad hoc
+via ``dmlc::GetEnv`` at use sites (ref: docs/faq/env_var.md;
+src/engine/threaded_engine_perdevice.cc:84 etc.). Here the flag system
+is one typed registry: every flag has a declared type, default, doc
+string, and a TPU status — ``active`` flags change behavior in this
+framework and are read (through :func:`get`) at a real use site;
+``accepted`` flags are recognized for workflow compatibility but are
+no-ops on TPU (their job belongs to XLA/PJRT), and reading them warns
+once when they are set to a non-default value so users know the knob
+has no effect.
+
+Resolution order: :func:`set_flag` runtime override > environment >
+declared default.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["Flag", "register_flag", "get", "set_flag", "unset_flag",
+           "describe", "flags"]
+
+
+def _parse_bool(v: str) -> bool:
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Flag:
+    name: str
+    type: type
+    default: Any
+    doc: str
+    active: bool = True           # False: accepted-but-inert on TPU
+    tpu_note: str = ""            # why inert / how reinterpreted
+    choices: Optional[tuple] = None
+    _warned: bool = field(default=False, repr=False)
+
+    def parse(self, raw: str) -> Any:
+        if self.type is bool:
+            return _parse_bool(raw)
+        return self.type(raw)
+
+
+_FLAGS: Dict[str, Flag] = {}
+_OVERRIDES: Dict[str, Any] = {}
+_LOCK = threading.Lock()
+_GEN = 0  # bumped on every runtime override; hot paths cache against it
+
+
+def generation() -> int:
+    """Monotone counter for flag-cache invalidation (engine.is_sync)."""
+    return _GEN
+
+
+def register_flag(name: str, type: type, default: Any, doc: str,
+                  active: bool = True, tpu_note: str = "",
+                  choices: Optional[tuple] = None) -> Flag:
+    f = Flag(name, type, default, doc, active, tpu_note, choices)
+    _FLAGS[name] = f
+    return f
+
+
+def get(name: str, default: Any = None, dtype: Optional[type] = None) -> Any:
+    """Resolve a flag: runtime override > env > declared default.
+
+    Unregistered names fall back to a raw env read with ``default``,
+    coerced to ``dtype`` (or the default's type) — the dmlc::GetEnv
+    escape hatch. For registered names the registry's type/default are
+    canonical and ``default``/``dtype`` are ignored."""
+    # lock-free read path: dict reads are atomic in CPython, and this is
+    # called from the per-op eager dispatch (engine.is_sync)
+    f = _FLAGS.get(name)
+    if name in _OVERRIDES:
+        val = _OVERRIDES.get(name, default)
+        if f is not None and not f.active and val != f.default \
+                and not f._warned:
+            f._warned = True
+            warnings.warn(
+                f"{name}={val} has no effect on the TPU backend"
+                + (f" ({f.tpu_note})" if f.tpu_note else ""),
+                stacklevel=2)
+        return val
+    if f is None:
+        raw = os.environ.get(name)
+        if raw is None:
+            return default
+        ty = dtype or (type(default) if default is not None else None)
+        if ty is bool or isinstance(default, bool):
+            return _parse_bool(raw)
+        if ty is not None:
+            try:
+                return ty(raw)
+            except (TypeError, ValueError):
+                return raw
+        return raw
+    raw = os.environ.get(name)
+    val = f.default if raw is None else f.parse(raw)
+    if not f.active and val != f.default and not f._warned:
+        f._warned = True
+        warnings.warn(
+            f"{name}={val} has no effect on the TPU backend"
+            + (f" ({f.tpu_note})" if f.tpu_note else ""), stacklevel=2)
+    if f.choices and val not in f.choices:
+        raise ValueError(f"{name}={val!r} not in {f.choices}")
+    return val
+
+
+def set_flag(name: str, value: Any) -> None:
+    """Runtime override (highest precedence)."""
+    global _GEN
+    f = _FLAGS.get(name)
+    if f is not None:
+        if f.type is bool and isinstance(value, str):
+            value = _parse_bool(value)
+        elif not isinstance(value, f.type):
+            value = f.type(value)
+        if f.choices and value not in f.choices:
+            raise ValueError(f"{name}={value!r} not in {f.choices}")
+    with _LOCK:
+        _OVERRIDES[name] = value
+        _GEN += 1
+
+
+def unset_flag(name: str) -> None:
+    global _GEN
+    with _LOCK:
+        _OVERRIDES.pop(name, None)
+        _GEN += 1
+
+
+def flags() -> Dict[str, Flag]:
+    return dict(_FLAGS)
+
+
+def describe() -> str:
+    """Human-readable flag table (the env_var.md analog)."""
+    lines = []
+    for name in sorted(_FLAGS):
+        f = _FLAGS[name]
+        cur = get(name)
+        status = "active" if f.active else "accepted (no-op on TPU)"
+        lines.append(f"{name} = {cur!r}  [{f.type.__name__}, "
+                     f"default {f.default!r}, {status}]")
+        lines.append(f"    {f.doc}")
+        if f.tpu_note:
+            lines.append(f"    TPU: {f.tpu_note}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Active flags — each is read via config.get() at the cited use site.
+# ---------------------------------------------------------------------------
+
+register_flag(
+    "MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+    "Execution engine. NaiveEngine = fully synchronous dispatch for "
+    "debugging (ref: src/engine/engine.cc:32-56).",
+    choices=("ThreadedEnginePerDevice", "ThreadedEnginePooled",
+             "NaiveEngine"))
+register_flag(
+    "MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+    "Bulk (segment) execution of the training graph "
+    "(ref: env_var.md:120). TPU: whole-graph jit when on; per-op "
+    "dispatch hints when off.")
+register_flag(
+    "MXNET_EXEC_BULK_EXEC_INFERENCE", bool, True,
+    "Bulk execution of inference graphs (ref: env_var.md:123).")
+register_flag(
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", int, 15,
+    "Max nodes per bulked segment (ref: env_var.md:129). TPU: advisory "
+    "segment size for the engine facade's bulk scope.")
+register_flag(
+    "MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+    "Arrays above this element count are sharded across kvstore "
+    "servers / collective chunks (ref: kvstore_dist.h EncodeDefaultKey).")
+register_flag(
+    "MXNET_UPDATE_ON_KVSTORE", bool, True,
+    "Run the optimizer inside the kvstore (server-side update) when the "
+    "kvstore supports it (ref: python/mxnet/model.py _create_kvstore).")
+register_flag(
+    "MXNET_HOME", str, os.path.join(os.path.expanduser("~"), ".mxnet_tpu"),
+    "Data/model cache root (ref: env_var.md MXNET_HOME).")
+register_flag(
+    "MXNET_GLUON_REPO", str,
+    "https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/",
+    "Base URL for gluon model-zoo downloads (ref: env_var.md).",
+    active=False,
+    tpu_note="no network egress in this build; weights load from local "
+             "files")
+register_flag(
+    "MXNET_USE_SIGNAL_HANDLER", bool, True,
+    "Install the SIGSEGV/SIGABRT backtrace handler at import "
+    "(ref: src/initialize.cc:62).")
+register_flag(
+    "MXNET_SAFE_ACCUMULATION", bool, False,
+    "Accumulate reductions/softmax in fp32 even for fp16/bf16 inputs "
+    "(ref: env_var.md MXNET_SAFE_ACCUMULATION).")
+register_flag(
+    "MXNET_ENFORCE_DETERMINISM", bool, False,
+    "Refuse/avoid non-deterministic kernels. TPU: forces synchronous "
+    "NaiveEngine-style dispatch ordering in the engine facade.")
+register_flag(
+    "MXNET_BACKWARD_DO_MIRROR", bool, False,
+    "Trade compute for memory in backward (ref: env_var.md:187, "
+    "src/nnvm/gradient.cc mirror). TPU: wraps the forward in "
+    "jax.checkpoint (rematerialization) when building grad programs.")
+register_flag(
+    "MXNET_SUBGRAPH_BACKEND", str, "",
+    "Partition graphs with the named subgraph property before "
+    "compilation (ref: env_var.md:319 MXNET_SUBGRAPH_BACKEND). "
+    "TPU: applies mxnet_tpu.subgraph.build_subgraph in Symbol.bind.")
+register_flag(
+    "MXNET_STORAGE_FALLBACK_LOG_VERBOSE", bool, True,
+    "Warn when a sparse op falls back to the dense implementation "
+    "(ref: env_var.md:30).")
+register_flag(
+    "MXNET_OPTIMIZER_AGGREGATION_SIZE", int, 4,
+    "Max tensors fused per multi-tensor optimizer update "
+    "(ref: env_var.md MXNET_OPTIMIZER_AGGREGATION_SIZE).")
+register_flag(
+    "MXNET_MP_WORKER_NTHREADS", int, 4,
+    "Default worker count for multiprocess data loading "
+    "(ref: env_var.md:60).", active=False,
+    tpu_note="takes effect when DataLoader multiprocess workers land")
+register_flag(
+    "MXNET_CPU_WORKER_NTHREADS", int, 1,
+    "Host-side worker threads for the native IO pipeline "
+    "(ref: env_var.md:25). TPU: thread count of the native RecordIO "
+    "batch server.")
+register_flag(
+    "MXNET_PROFILER_AUTOSTART", bool, False,
+    "Start the profiler at import (ref: env_var.md MXNET_PROFILER_"
+    "AUTOSTART).")
+register_flag(
+    "MXNET_PROFILER_MODE", int, 0,
+    "Default profiler mode bitmask (ref: env_var.md).")
+register_flag(
+    "MXNET_TEST_SEED", int, -1,
+    "Fixed seed for the test harness; -1 = random per test "
+    "(ref: tests/python/unittest/common.py).")
+register_flag(
+    "MXNET_MODULE_SEED", int, -1,
+    "Fixed module-level test seed; -1 = random "
+    "(ref: tests/python/unittest/common.py:189).")
+
+# ---------------------------------------------------------------------------
+# Accepted-but-inert flags (XLA/PJRT owns the job). Setting them warns.
+# ---------------------------------------------------------------------------
+
+for _name, _type, _default, _doc, _note in [
+    ("MXNET_GPU_MEM_POOL_TYPE", str, "Naive",
+     "GPU memory pool selector (ref: storage.cc:103).",
+     "PJRT owns device memory pooling"),
+    ("MXNET_GPU_MEM_POOL_RESERVE", int, 5,
+     "Percent of GPU memory held back from the pool.",
+     "PJRT owns device memory pooling"),
+    ("MXNET_EXEC_ENABLE_INPLACE", bool, True,
+     "Allow in-place buffer sharing in the memory planner.",
+     "XLA's buffer assignment handles aliasing/donation"),
+    ("MXNET_EXEC_NUM_TEMP", int, 1,
+     "Number of temp-space resources per device.",
+     "XLA allocates scratch internally"),
+    ("MXNET_CPU_PRIORITY_NTHREADS", int, 4,
+     "Priority-queue worker threads of the CPU engine.",
+     "PJRT schedules host work"),
+    ("MXNET_GPU_WORKER_NTHREADS", int, 2,
+     "Per-GPU engine worker threads.",
+     "PJRT streams replace engine worker pools"),
+    ("MXNET_OMP_MAX_THREADS", int, 0,
+     "OpenMP thread cap for CPU kernels.",
+     "XLA:CPU threadpool is sized by jax"),
+    ("MXNET_CUDNN_AUTOTUNE_DEFAULT", int, 1,
+     "cuDNN conv algo autotuning.",
+     "XLA autotunes convolutions during compilation"),
+    ("MXNET_CUDA_ALLOW_TENSOR_CORE", bool, True,
+     "Allow TensorCore math.",
+     "use jax.default_matmul_precision / bf16 policies"),
+    ("MXNET_USE_OPERATOR_TUNING", int, 1,
+     "OpenMP operator tuning (ref: operator_tune.h).",
+     "XLA fusion decides parallelization"),
+    ("MXNET_ENABLE_OPERATOR_TUNING", int, 1,
+     "Enable/disable operator tuning.",
+     "XLA fusion decides parallelization"),
+    ("MXNET_KVSTORE_USETREE", bool, False,
+     "Topology-aware tree reduction (ref: comm_tree.h).",
+     "ICI collectives are already topology-optimal"),
+    ("MXNET_KVSTORE_REDUCTION_NTHREADS", int, 4,
+     "CPU threads for kvstore reduction.",
+     "psum runs on-device over ICI"),
+    ("MXNET_ENABLE_GPU_P2P", bool, True,
+     "Peer-to-peer GPU copies in device comm.",
+     "ICI replaces P2P copies"),
+    ("MXNET_MKLDNN_ENABLED", bool, True,
+     "MKL-DNN CPU kernels.", "XLA:CPU generates its own kernels"),
+]:
+    register_flag(_name, _type, _default, _doc, active=False,
+                  tpu_note=_note)

@@ -34,8 +34,28 @@ def set_engine_type(name: str):
     _state.engine_type = name
 
 
+_SYNC_CACHE = [-1, False]  # [config generation, value]
+
+
 def is_sync() -> bool:
-    return _engine_type() == "NaiveEngine"
+    """Called on every eager op dispatch — cached against the config
+    generation so the common (off) case is two attribute reads, not an
+    env lookup. MXNET_ENFORCE_DETERMINISM forces the deterministic
+    synchronous dispatch order (the TPU reinterpretation of refusing
+    non-deterministic kernels, docs/faq/env_var.md)."""
+    et = getattr(_state, "engine_type", None)
+    if et is not None:
+        return et == "NaiveEngine" \
+            or get_env("MXNET_ENFORCE_DETERMINISM", False)
+    from . import config as _config
+    gen = _config.generation()
+    if _SYNC_CACHE[0] != gen:
+        _SYNC_CACHE[1] = (
+            get_env("MXNET_ENGINE_TYPE",
+                    "ThreadedEnginePerDevice") == "NaiveEngine"
+            or get_env("MXNET_ENFORCE_DETERMINISM", False))
+        _SYNC_CACHE[0] = gen
+    return _SYNC_CACHE[1]
 
 
 def maybe_sync(arr):

@@ -79,7 +79,14 @@ class Executor:
                 outs, aux_updates = eval_graph(sym, vm, is_train, rng_raw)
                 return outs, aux_updates
 
-            self._compiled[key] = jax.jit(fn)
+            # MXNET_EXEC_BULK_EXEC_{TRAIN,INFERENCE} (env_var.md:120-126):
+            # bulk on = one fused XLA program (the default); off = per-op
+            # eager dispatch, the reference's debugging mode where each op
+            # surfaces errors individually
+            from .base import get_env
+            bulk = get_env("MXNET_EXEC_BULK_EXEC_TRAIN" if is_train
+                           else "MXNET_EXEC_BULK_EXEC_INFERENCE", True)
+            self._compiled[key] = jax.jit(fn) if bulk else fn
         return self._compiled[key]
 
     def _get_compiled_grad(self, need_outputs=True):
@@ -90,6 +97,13 @@ class Executor:
             grad_names = [n for n in self._arg_names
                           if self.grad_req.get(n, "null") != "null"]
 
+            # MXNET_BACKWARD_DO_MIRROR (ref: env_var.md:187, the mirror/
+            # recompute option of src/nnvm/gradient.cc): on TPU this is
+            # rematerialization — wrap the forward in jax.checkpoint so
+            # the backward recomputes activations instead of storing them
+            from .base import get_env
+            mirror = get_env("MXNET_BACKWARD_DO_MIRROR", False)
+
             def fb(arg_vals, aux_vals, rng_raw, ograds):
                 def fwd(gvals):
                     vm = dict(arg_vals)
@@ -99,8 +113,9 @@ class Executor:
                     return tuple(outs), aux_updates
 
                 gvals = {n: arg_vals[n] for n in grad_names}
+                fwd_fn = jax.checkpoint(fwd) if mirror else fwd
                 outs, vjp_fn, aux_updates = jax.vjp(
-                    lambda gv: fwd(gv), gvals, has_aux=True)
+                    lambda gv: fwd_fn(gv), gvals, has_aux=True)
                 cots = tuple(
                     og if og is not None else jnp.ones_like(o)
                     for o, og in zip(outs, ograds))

@@ -14,8 +14,12 @@ rank/num_workers) so reference workflows port unchanged:
   all device shards (the CommDevice::Reduce role, comm.h:503) — on a TPU
   mesh the actual reduction is a lax.psum inside the jitted step, and this
   object only tracks optimizer state / weight mirrors.
-- 'dist_sync'/'dist_device_sync'/'dist_async': multi-process via
-  jax.distributed; push performs a global psum over the 'data' axis.
+- 'dist_sync'/'dist_device_sync': multi-process via jax.distributed;
+  push performs a global psum over the 'data' axis.
+- 'dist_async': true asynchronous SGD — pushes are applied per-arrival
+  by a parameter-server role (kvstore_server.KVServer on rank 0) with
+  NO worker barrier, matching the reference's sync_mode_==false path
+  (ref: src/kvstore/kvstore_dist_server.h:346-358).
 """
 from __future__ import annotations
 
@@ -28,7 +32,8 @@ import jax.numpy as jnp
 from .base import MXNetError, get_env
 from .ndarray.ndarray import NDArray, _wrap, zeros as nd_zeros
 
-__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "create"]
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
+           "create"]
 
 
 def _key_str(key):
@@ -66,6 +71,18 @@ class KVStoreBase:
             return [_key_str(k) for k in key], list(value)
         return [_key_str(key)], [value]
 
+    def _group(self, key, value):
+        """key(s)/value(s) -> {key: [NDArray, ...]} supporting the
+        reference's single-key-many-devices and multi-key list-of-lists
+        push/pull forms (ref: kvstore.py:160 push grouping)."""
+        keys, values = self._normalize(key, value)
+        if len(keys) == 1 and isinstance(value, (list, tuple)) and \
+                value and isinstance(value[0], NDArray):
+            return {keys[0]: list(value)}
+        if len(keys) > 1 and isinstance(value[0], (list, tuple)):
+            return {k: list(v) for k, v in zip(keys, value)}
+        return {k: [v] for k, v in zip(keys, values)}
+
     def _reduce(self, vals: List[NDArray]) -> NDArray:
         """Aggregate device shards (ref: CommDevice::Reduce comm.h:503)."""
         if len(vals) == 1:
@@ -76,16 +93,7 @@ class KVStoreBase:
         return _wrap(total)
 
     def push(self, key, value, priority=0):
-        keys, values = self._normalize(key, value)
-        # group per key: value may be list-of-lists for multi-key push
-        if len(keys) == 1 and isinstance(value, (list, tuple)) and \
-                value and isinstance(value[0], NDArray):
-            grouped = {keys[0]: list(value)}
-        elif len(keys) > 1 and isinstance(value[0], (list, tuple)):
-            grouped = {k: list(v) for k, v in zip(keys, value)}
-        else:
-            grouped = {k: [v] for k, v in zip(keys, values)}
-        for k, vals in grouped.items():
+        for k, vals in self._group(key, value).items():
             agg = self._reduce(vals)
             agg = self._global_reduce(k, agg)
             if self._updater is not None:
@@ -99,15 +107,7 @@ class KVStoreBase:
                     self._store[k] = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        keys, outs = self._normalize(key, out)
-        if len(keys) == 1 and isinstance(out, (list, tuple)) and \
-                out and isinstance(out[0], NDArray):
-            targets = {keys[0]: list(out)}
-        elif len(keys) > 1 and isinstance(out[0], (list, tuple)):
-            targets = {k: list(o) for k, o in zip(keys, out)}
-        else:
-            targets = {k: [o] for k, o in zip(keys, outs)}
-        for k, tgts in targets.items():
+        for k, tgts in self._group(key, out).items():
             if k not in self._store:
                 raise MXNetError(f"key {k} was not init'd")
             src = self._store[k]
@@ -231,6 +231,16 @@ class KVStoreDist(KVStoreBase):
             self._residuals[key] = new_residual
             data = grad_decompression_2bit(q).astype(data.dtype)
         from .parallel import allreduce_across_processes
+        # MXNET_KVSTORE_BIGARRAY_BOUND (ref: kvstore_dist.h:58,546 —
+        # arrays above the bound are sharded across servers): here big
+        # arrays go through the DCN collective in bounded chunks, capping
+        # the per-collective buffer exactly as server sharding did
+        bound = int(get_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
+        if data.size >= bound > 0:
+            flat = data.reshape(-1)
+            pieces = [allreduce_across_processes(flat[i:i + bound])
+                      for i in range(0, flat.shape[0], bound)]
+            return _wrap(jnp.concatenate(pieces).reshape(data.shape))
         return _wrap(allreduce_across_processes(data))
 
     def barrier(self):
@@ -240,6 +250,85 @@ class KVStoreDist(KVStoreBase):
             process_barrier()
 
 
+class KVStoreDistAsync(KVStoreBase):
+    """Asynchronous multi-process store over the parameter-server role.
+
+    Each push is shipped to the server and applied the moment it arrives
+    (server-side optimizer if set, else accumulate) — no coordination
+    with other workers; pulls read whatever state the server holds right
+    now. This is the reference's `dist_async` contract
+    (ref: kvstore_dist_server.h:348-358; docs/faq/distributed_training.md).
+    barrier() IS still a real barrier (ps::Postoffice::Barrier exists in
+    async mode too) — training steps just never call it.
+    """
+
+    def __init__(self, type_name="dist_async"):
+        super().__init__()
+        self._type = type_name
+        import os
+        from . import kvstore_server as srv
+        self._rank = int(os.environ.get("MX_WORKER_ID", "0"))
+        self._num_workers = int(os.environ.get("MX_NUM_WORKERS", "1"))
+        if self._num_workers == 1 and jax.distributed.is_initialized():
+            # launched by something other than tools/launch.py — take the
+            # job shape from jax.distributed so every rank agrees
+            self._rank = jax.process_index()
+            self._num_workers = jax.process_count()
+        addr = srv.ensure_server(self._num_workers, rank=self._rank)
+        self._client = srv.KVClient(addr)
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            self._client.request("init", k, v.asnumpy())
+
+    def push(self, key, value, priority=0):
+        for k, vals in self._group(key, value).items():
+            agg = self._reduce(vals)  # local device shards only
+            self._client.request("push", k, agg.asnumpy())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        for k, tgts in self._group(key, out).items():
+            cur = self._client.request("pull", k)
+            for t in tgts:
+                t._rebind(jnp.asarray(cur).astype(t._data.dtype))
+
+    def set_optimizer(self, optimizer):
+        """Pickle the optimizer to the server — rank 0 only, exactly as
+        the reference (kvstore.py:450 gates on rank==0; a later worker's
+        copy would replace the server Updater and wipe its state). All
+        ranks then synchronize so no push races the installation."""
+        self._optimizer = optimizer
+        if self._rank == 0:
+            self._client.request("set_optimizer", None,
+                                 pickle.dumps(optimizer))
+        self.barrier()
+
+    def set_updater(self, updater):
+        raise MXNetError(
+            "dist_async applies updates on the server; use set_optimizer")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        states = self._client.request("get_states", None, dump_optimizer)
+        with open(fname, "wb") as f:
+            f.write(states)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._client.request("set_states", None, f.read())
+
+    def barrier(self):
+        self._client.request("barrier")
+
+
 def create(name="local") -> KVStoreBase:
     """ref: src/kvstore/kvstore.cc:40-77 factory."""
     if not isinstance(name, str):
@@ -247,6 +336,8 @@ def create(name="local") -> KVStoreBase:
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "local_allreduce_device", "device", "nccl"):
         return KVStoreLocal(name)
+    if name == "dist_async":
+        return KVStoreDistAsync(name)
     if name.startswith("dist"):
         return KVStoreDist(name)
     raise MXNetError(f"unknown KVStore type {name}")

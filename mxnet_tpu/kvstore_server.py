@@ -1,0 +1,263 @@
+"""Parameter-server backend for the async kvstore types.
+
+The reference's ``dist_async`` runs real server processes (ps-lite) that
+apply each worker's push to the global weights the moment it arrives —
+no worker barrier (ref: src/kvstore/kvstore_dist_server.h:346-358, the
+``sync_mode_ == false`` path of ApplyUpdates; server bootstrap
+python/mxnet/kvstore_server.py:76). The synchronous types map naturally
+onto ICI/DCN collectives, but *async* semantics cannot be expressed as a
+collective — they need a shared state holder. This module provides it:
+
+- :class:`KVServer` — a threaded TCP server owning the store and the
+  server-side optimizer (``update_on_kvstore``). Runs inside rank 0's
+  process (the server *role* of the reference's scheduler/server ranks).
+- :class:`KVClient` — per-worker connection used by
+  ``mx.kv.create('dist_async')``.
+
+Wire protocol: uint32 length | pickled (cmd, key, payload) request,
+same framing for the reply. Push requests are applied immediately under
+the store lock; the per-worker ack only confirms receipt (ordering /
+backpressure) and never waits for other workers.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as onp
+
+from .base import MXNetError, get_logger
+
+__all__ = ["KVServer", "KVClient", "server_address", "ensure_server"]
+
+_log = get_logger("mxnet_tpu.kvstore_server")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(n)
+        if not b:
+            raise ConnectionError("kvstore server connection closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket):
+    (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, ln))
+
+
+def server_address() -> Optional[str]:
+    """host:port of the parameter server for this job.
+
+    ``MX_KV_SERVER`` is exported by tools/launch.py; standalone single
+    process jobs get a loopback default."""
+    return os.environ.get("MX_KV_SERVER")
+
+
+class KVServer:
+    """The server role: owns weights, applies pushes per-arrival.
+
+    ref: kvstore_dist_server.h DataHandleEx(:325)/ApplyUpdates(:346) —
+    in async mode each push updates the store immediately (updater if
+    set, else +=); pulls return the current state.
+    """
+
+    def __init__(self, address: str, num_workers: int):
+        host, _, port = address.partition(":")
+        self._store: Dict[str, onp.ndarray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._lock = threading.Lock()
+        self._num_workers = num_workers
+        self._barrier_count = 0
+        self._barrier_generation = 0
+        self._barrier_cv = threading.Condition()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host or "127.0.0.1", int(port)))
+        self._listener.listen(num_workers + 4)
+        self._stopping = False
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # -- request handling -------------------------------------------------
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                cmd, key, payload = _recv_msg(conn)
+                if cmd == "stop":
+                    _send_msg(conn, ("ok", None))
+                    break
+                try:
+                    reply = self._handle(cmd, key, payload)
+                    _send_msg(conn, ("ok", reply))
+                except Exception as e:  # surface errors to the worker
+                    _send_msg(conn, ("err", f"{type(e).__name__}: {e}"))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, cmd: str, key, payload):
+        if cmd == "init":
+            with self._lock:
+                self._store.setdefault(key, onp.array(payload, copy=True))
+            return None
+        if cmd == "push":
+            with self._lock:
+                if key not in self._store:
+                    raise MXNetError(f"key {key} was not init'd")
+                grad = onp.asarray(payload)
+                if self._updater is not None:
+                    # server-side optimizer: the update_on_kvstore path
+                    from .ndarray.ndarray import array as _nd_array
+                    w = _nd_array(self._store[key])
+                    self._updater(_int_key(key), _nd_array(grad), w)
+                    self._store[key] = w.asnumpy()
+                else:
+                    self._store[key] = self._store[key] + grad
+            return None
+        if cmd == "pull":
+            with self._lock:
+                if key not in self._store:
+                    raise MXNetError(f"key {key} was not init'd")
+                return onp.array(self._store[key], copy=True)
+        if cmd == "set_optimizer":
+            # ref: kvstore.py:450 — the optimizer arrives pickled
+            from .optimizer import get_updater
+            with self._lock:
+                self._optimizer = pickle.loads(payload)
+                self._updater = get_updater(self._optimizer)
+            return None
+        if cmd == "get_states":
+            with self._lock:
+                if self._updater is None:
+                    raise MXNetError("optimizer is not set")
+                return self._updater.get_states(bool(payload))
+        if cmd == "set_states":
+            with self._lock:
+                if self._updater is None:
+                    raise MXNetError("optimizer is not set")
+                self._updater.set_states(payload)
+            return None
+        if cmd == "barrier":
+            with self._barrier_cv:
+                gen = self._barrier_generation
+                self._barrier_count += 1
+                if self._barrier_count == self._num_workers:
+                    self._barrier_count = 0
+                    self._barrier_generation += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    while self._barrier_generation == gen:
+                        self._barrier_cv.wait(timeout=60.0)
+            return None
+        raise MXNetError(f"unknown kvstore server command {cmd}")
+
+    def stop(self):
+        self._stopping = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+class KVClient:
+    """Worker-side connection to the server (ref: ps::KVWorker)."""
+
+    def __init__(self, address: str, retries: int = 50):
+        host, _, port = address.partition(":")
+        self._lock = threading.Lock()
+        last = None
+        for _ in range(retries):
+            try:
+                self._sock = socket.create_connection(
+                    (host or "127.0.0.1", int(port)), timeout=60)
+                break
+            except OSError as e:  # server may not be up yet
+                last = e
+                time.sleep(0.1)
+        else:
+            raise MXNetError(f"cannot reach kvstore server {address}: {last}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def request(self, cmd: str, key=None, payload=None):
+        with self._lock:
+            _send_msg(self._sock, (cmd, key, payload))
+            status, reply = _recv_msg(self._sock)
+        if status != "ok":
+            raise MXNetError(f"kvstore server: {reply}")
+        return reply
+
+    def close(self):
+        try:
+            self.request("stop")
+        except Exception:
+            pass
+        self._sock.close()
+
+
+_local_server: Optional[KVServer] = None
+
+
+def ensure_server(num_workers: int, rank: Optional[int] = None) -> str:
+    """Start the server (rank 0 only) and return its address.
+
+    The launcher exports MX_KV_SERVER to every rank; rank 0 binds it.
+    Without a launcher (single process) a loopback server is started on
+    a free port."""
+    global _local_server
+    addr = server_address()
+    if rank is None:
+        rank = int(os.environ.get("MX_WORKER_ID", "0"))
+    if addr is None:
+        if num_workers > 1:
+            # without a shared endpoint every rank would silently start
+            # its own private server and training would never synchronize
+            raise MXNetError(
+                "dist_async with multiple workers requires a shared "
+                "parameter-server endpoint: launch via tools/launch.py "
+                "(exports MX_KV_SERVER) or set MX_KV_SERVER=host:port "
+                "for every rank")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            addr = f"127.0.0.1:{s.getsockname()[1]}"
+        os.environ["MX_KV_SERVER"] = addr
+    if rank == 0 and _local_server is None:
+        _local_server = KVServer(addr, num_workers)
+        _log.info("kvstore server listening on %s (%d workers)", addr,
+                  num_workers)
+    return addr

@@ -22,8 +22,10 @@ __all__ = ["save_checkpoint", "load_checkpoint", "load_params",
 
 
 def _create_kvstore(kvstore, num_device, arg_params):
-    """ref: model.py _create_kvstore — decide update_on_kvstore."""
-    update_on_kvstore = True
+    """ref: model.py _create_kvstore — decide update_on_kvstore
+    (MXNET_UPDATE_ON_KVSTORE overrides the default, env_var.md)."""
+    from .base import get_env
+    update_on_kvstore = get_env("MXNET_UPDATE_ON_KVSTORE", True)
     if kvstore is None:
         kv = None
     elif isinstance(kvstore, kvs.KVStoreBase):
@@ -43,6 +45,11 @@ def _create_kvstore(kvstore, num_device, arg_params):
         raise TypeError("kvstore must be KVStore, str or None")
     if kv is None:
         update_on_kvstore = False
+    elif "async" in kv.type:
+        # async stores apply updates server-side per push; running the
+        # optimizer locally on pulled weights would corrupt training
+        # (ref: model.py _create_kvstore forces this for async too)
+        update_on_kvstore = True
     return kv, update_on_kvstore
 
 
@@ -80,9 +87,22 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updates[k].append((index * num_device + k, g, w))
+    agg = getattr(updater, "aggregate_updates", False) and \
+        getattr(getattr(updater, "optimizer", None), "aggregate_num", 0) > 1
     for dev_updates in updates:
-        for i, g, w in dev_updates:
-            updater(i, g, w)
+        if agg:
+            # fused multi-tensor updates in chunks of aggregate_num
+            # (MXNET_OPTIMIZER_AGGREGATION_SIZE; optimizer_op.cc
+            # multi_sgd_* ops)
+            width = updater.optimizer.aggregate_num
+            for s in range(0, len(dev_updates), width):
+                chunk = dev_updates[s:s + width]
+                updater([i for i, _, _ in chunk],
+                        [g for _, g, _ in chunk],
+                        [w for _, _, w in chunk])
+        else:
+            for i, g, w in dev_updates:
+                updater(i, g, w)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,

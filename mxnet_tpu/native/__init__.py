@@ -161,7 +161,13 @@ class NativeBatchServer:
     parser-thread role of the reference's C++ IO pipeline)."""
 
     def __init__(self, path: str, batch_size: int, shuffle: bool = False,
-                 seed: int = 0, num_workers: int = 2):
+                 seed: int = 0, num_workers: int = 0):
+        if num_workers <= 0:
+            # MXNET_CPU_WORKER_NTHREADS sizes the native IO thread pool
+            # (ref: env_var.md:25 — the CPU engine worker count)
+            from ..base import get_env
+            num_workers = max(2, int(get_env("MXNET_CPU_WORKER_NTHREADS",
+                                             1)))
         self._reader = NativeRecordIO(path)
         self._L = self._reader._L
         self._h = self._L.rio_batch_server_create(

@@ -8,8 +8,8 @@ import sys as _sys
 
 from .ndarray import (  # noqa: F401
     NDArray, array, zeros, ones, full, empty, arange, eye, linspace,
-    concat, concatenate, stack, split, dot, save, load, waitall,
-    from_numpy, moveaxis, invoke, _wrap,
+    concat, concatenate, stack, split, dot, save, load, load_frombuffer,
+    waitall, from_numpy, moveaxis, invoke, _wrap,
 )
 from .. import ops as _ops
 from ..ops.registry import list_ops as _list_ops, make_nd_function as _make

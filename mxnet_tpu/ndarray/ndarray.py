@@ -14,7 +14,6 @@ map to jax.device_put.
 """
 from __future__ import annotations
 
-import struct
 from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -92,6 +91,10 @@ def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
     else:
         call = fn
     in_arrays = [i._data for i in inputs]
+    if any(getattr(i, "stype", "default") != "default" for i in inputs):
+        # sparse inputs execute through the dense implementation
+        from .sparse import log_storage_fallback
+        log_storage_fallback(getattr(fn, "__name__", str(fn)))
     was_recording = autograd.set_recording(False)  # no nested recording:
     try:   # ops whose impls re-enter the nd layer (control flow bodies)
         out = call(*in_arrays)  # must not write tracer nodes to the tape
@@ -107,6 +110,12 @@ def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
         tape.record(call, in_arrays, outs, list(inputs),
                     differentiable=differentiable)
     wrapped = [_wrap(o) for o in outs]
+    from .. import engine as _engine
+    if _engine.is_sync():
+        # NaiveEngine / MXNET_ENFORCE_DETERMINISM: block after every op
+        # so exceptions surface at the op that raised them (ref:
+        # threaded_engine.h:64-65 exception chains; env_var.md:110-114)
+        jax.block_until_ready(outs)
     if isinstance(out, (tuple, list)):
         return wrapped
     return wrapped[0] if n_out == 1 else wrapped
@@ -241,8 +250,14 @@ class NDArray:
     # autograd
     # ------------------------------------------------------------------
     def attach_grad(self, grad_req: str = "write", stype=None):
-        """ref: python/mxnet/ndarray/ndarray.py attach_grad → MarkVariables."""
-        self._grad = _wrap(jnp.zeros(self.shape, self._data.dtype))
+        """ref: python/mxnet/ndarray/ndarray.py attach_grad → MarkVariables.
+        stype='row_sparse' keeps the grad buffer sparse (O(nnz) deposit,
+        ref: Embedding sparse_grad workflow)."""
+        if stype in ("row_sparse", "csr"):
+            from .sparse import zeros as sp_zeros
+            self._grad = sp_zeros(stype, self.shape, dtype=str(self.dtype))
+        else:
+            self._grad = _wrap(jnp.zeros(self.shape, self._data.dtype))
         self._grad_req = grad_req
 
     def detach(self) -> "NDArray":
@@ -690,6 +705,12 @@ def split(ary, indices_or_sections, axis=0):
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     from ..ops import tensor as _t
+    from .sparse_ops import maybe_sparse_dispatch
+    res = maybe_sparse_dispatch(
+        "dot", [lhs, rhs], {"transpose_a": transpose_a,
+                            "transpose_b": transpose_b})
+    if res is not NotImplemented:
+        return res
     return invoke(_t.dot, [lhs, rhs], transpose_a=transpose_a,
                   transpose_b=transpose_b)
 
@@ -704,16 +725,15 @@ def waitall():
 
 
 # ---------------------------------------------------------------------------
-# serialization — reference binary format kept for checkpoint compatibility
-# (ref: src/ndarray/ndarray.cc Save/Load, magic 0x112; python/mxnet/ndarray/
-# utils.py save/load). We write a simplified but self-describing container:
-# magic, count, per-array (name, dtype, shape, raw bytes little-endian).
+# serialization — the reference binary format, byte-for-byte
+# (ref: src/ndarray/ndarray.cc:1594-1860 NDArray::Save/Load; layout doc
+# in ndarray/serialization.py). A reference-produced .params file loads
+# here and vice versa, including sparse (row_sparse/csr) arrays.
 # ---------------------------------------------------------------------------
 
-_NDAR_MAGIC = 0x112
-
-
 def save(fname: str, data):
+    """ref: mx.nd.save / MXNDArraySave."""
+    from .serialization import save_bytes
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -723,17 +743,7 @@ def save(fname: str, data):
         names = [""] * len(data)
         arrays = list(data)
     with open(fname, "wb") as f:
-        f.write(struct.pack("<QQ", _NDAR_MAGIC, len(arrays)))
-        for name, arr in zip(names, arrays):
-            nb = name.encode()
-            a = arr.asnumpy()
-            dt = str(a.dtype).encode()
-            f.write(struct.pack("<I", len(nb))); f.write(nb)
-            f.write(struct.pack("<I", len(dt))); f.write(dt)
-            f.write(struct.pack("<I", a.ndim))
-            f.write(struct.pack(f"<{a.ndim}q", *a.shape))
-            raw = onp.ascontiguousarray(a).tobytes()
-            f.write(struct.pack("<Q", len(raw))); f.write(raw)
+        f.write(save_bytes(arrays, names))
 
 
 def load(fname: str):
@@ -745,20 +755,20 @@ def load_frombuffer(buf: bytes):
     """Deserialize from an in-memory buffer (ref: MXNDArrayLoadFromBuffer,
     include/mxnet/c_api.h — used by the C predict API, which receives
     param bytes rather than a path)."""
-    import io as _io
-    f = _io.BytesIO(buf)
-    magic, n = struct.unpack("<QQ", f.read(16))
-    if magic != _NDAR_MAGIC:
-        raise MXNetError(f"bad ndarray buffer magic {magic:#x}")
-    names, arrays = [], []
-    for _ in range(n):
-        (ln,) = struct.unpack("<I", f.read(4)); name = f.read(ln).decode()
-        (ld,) = struct.unpack("<I", f.read(4)); dt = f.read(ld).decode()
-        (nd,) = struct.unpack("<I", f.read(4))
-        shape = struct.unpack(f"<{nd}q", f.read(8 * nd)) if nd else ()
-        (nb,) = struct.unpack("<Q", f.read(8))
-        a = onp.frombuffer(f.read(nb), dtype=dt).reshape(shape)
-        names.append(name); arrays.append(array(a, dtype=dt))
-    if any(names):
+    from .serialization import load_buffer
+    entries, names = load_buffer(buf)
+    arrays = []
+    for stype, shape, dt, data, aux in entries:
+        if data is None:  # is_none() placeholder array
+            arrays.append(None)
+        elif stype == "row_sparse":
+            from .sparse import RowSparseNDArray
+            arrays.append(RowSparseNDArray(data, aux[0], shape))
+        elif stype == "csr":
+            from .sparse import CSRNDArray
+            arrays.append(CSRNDArray(data, aux[1], aux[0], shape))
+        else:
+            arrays.append(array(data, dtype=str(dt)))
+    if names:
         return dict(zip(names, arrays))
     return arrays

@@ -1,0 +1,233 @@
+"""Reference-binary-format NDArray serialization.
+
+Implements the exact byte layout of the reference's ``NDArray::Save`` /
+``NDArray::Load`` (ref: src/ndarray/ndarray.cc:1594-1860) so ``.params``
+files interoperate both ways:
+
+file      := uint64 list_magic (0x112) | uint64 reserved (0)
+           | uint64 n_arrays | n_arrays * ndarray
+           | uint64 n_names  | n_names * (uint64 len | bytes)
+ndarray   := uint32 magic (V2 0xF993fac9 / V3 0xF993faca)
+           | int32 stype (0 dense, 1 row_sparse, 2 csr)
+           | [storage_shape: shape]         (sparse only)
+           | shape
+           | int32 dev_type | int32 dev_id  (Context::Save, base.h:157)
+           | int32 type_flag                (mshadow dtype enum)
+           | nad * (int32 aux_type | shape) (sparse only)
+           | raw data bytes (storage_shape elems * dtype size, LE)
+           | nad * raw aux bytes
+shape     := int32 ndim | ndim * int64      (Tuple<dim_t>::Save,
+                                             include/mxnet/tuple.h:704)
+
+Legacy loads: V1 magic 0xF993fac8 (shape/ctx/type/data, no stype) and
+the ancient header where the leading uint32 is ndim with uint32 dims
+(ndarray.cc LegacyTShapeLoad).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+
+LIST_MAGIC = 0x112
+V1_MAGIC = 0xF993FAC8
+V2_MAGIC = 0xF993FAC9
+V3_MAGIC = 0xF993FACA
+
+# mshadow type flags (3rdparty/mshadow/mshadow/base.h kFloat32...)
+_TYPE_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+              "int32": 4, "int8": 5, "int64": 6, "bfloat16": 7}
+_FLAG_TYPE = {v: k for k, v in _TYPE_FLAG.items()}
+
+_STYPE_ID = {"default": 0, "row_sparse": 1, "csr": 2}
+_ID_STYPE = {v: k for k, v in _STYPE_ID.items()}
+# aux tensors per storage type (include/mxnet/ndarray.h num_aux_data):
+# row_sparse: [indices]; csr: [indptr, indices]
+_NUM_AUX = {0: 0, 1: 1, 2: 2}
+
+_DEV_TYPE = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5,
+             "tpu": 2}  # tpu arrays round-trip through the device slot
+
+
+def _write_shape(out: List[bytes], shape: Sequence[int]):
+    out.append(struct.pack("<i", len(shape)))
+    if shape:
+        out.append(struct.pack(f"<{len(shape)}q", *shape))
+
+
+def _save_one(out: List[bytes], arr) -> None:
+    stype = getattr(arr, "stype", "default")
+    sid = _STYPE_ID[stype]
+    # 0-dim arrays only exist under np-shape semantics: V2's ndim==0
+    # means "none" (ndarray.cc:1770), so scalars get the V3 magic
+    out.append(struct.pack("<I", V3_MAGIC if arr.ndim == 0 and sid == 0
+                           else V2_MAGIC))
+    out.append(struct.pack("<i", sid))
+    if stype == "row_sparse":
+        values = onp.asarray(arr.data.asnumpy())
+        indices = onp.asarray(arr.indices.asnumpy()).astype("int64")
+        aux = [indices]
+        storage_shape = values.shape
+        data = values
+    elif stype == "csr":
+        data = onp.asarray(arr.data.asnumpy())
+        indptr = onp.asarray(arr.indptr.asnumpy()).astype("int64")
+        indices = onp.asarray(arr.indices.asnumpy()).astype("int64")
+        aux = [indptr, indices]
+        storage_shape = data.shape
+    else:
+        data = arr.asnumpy()
+        aux = []
+        storage_shape = None
+    if storage_shape is not None:
+        _write_shape(out, storage_shape)
+    _write_shape(out, arr.shape)
+    dev = getattr(getattr(arr, "ctx", None), "device_type", "cpu")
+    out.append(struct.pack("<ii", _DEV_TYPE.get(dev, 1), 0))
+    dt = str(data.dtype)
+    if dt not in _TYPE_FLAG:
+        raise MXNetError(f"dtype {dt} has no reference type flag")
+    out.append(struct.pack("<i", _TYPE_FLAG[dt]))
+    for a in aux:
+        out.append(struct.pack("<i", _TYPE_FLAG[str(a.dtype)]))
+        _write_shape(out, a.shape)
+    out.append(onp.ascontiguousarray(data).tobytes())
+    for a in aux:
+        out.append(onp.ascontiguousarray(a).tobytes())
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise MXNetError("Invalid NDArray file format (truncated)")
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.read(4))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.read(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.read(8))[0]
+
+    def shape_ndim(self) -> Tuple[Tuple[int, ...], int]:
+        ndim = self.i32()
+        if ndim <= 0:
+            return (), ndim
+        return struct.unpack(f"<{ndim}q", self.read(8 * ndim)), ndim
+
+    def shape(self) -> Tuple[int, ...]:
+        return self.shape_ndim()[0]
+
+    def legacy_shape_u32(self, ndim: int) -> Tuple[int, ...]:
+        return struct.unpack(f"<{ndim}I", self.read(4 * ndim))
+
+
+def _np_of_flag(flag: int) -> onp.dtype:
+    if flag not in _FLAG_TYPE:
+        raise MXNetError(f"unknown mshadow type flag {flag}")
+    return onp.dtype(_FLAG_TYPE[flag])
+
+
+def _load_one(r: _Reader):
+    """Returns (stype, shape, dtype, data ndarray, aux list)."""
+    magic = r.u32()
+    if magic in (V2_MAGIC, V3_MAGIC):
+        sid = r.i32()
+        nad = _NUM_AUX.get(sid)
+        if nad is None:
+            raise MXNetError(f"unknown storage type id {sid}")
+        storage_shape = r.shape() if nad > 0 else None
+        shape, ndim = r.shape_ndim()
+        # V2: ndim==0 is the is_none() placeholder (ndarray.cc:1770);
+        # V3 (np semantics): ndim==0 is a real scalar, ndim==-1 is none
+        if (magic == V2_MAGIC and ndim == 0) \
+                or (magic == V3_MAGIC and ndim < 0):
+            return "default", (), onp.dtype("float32"), None, []
+        r.i32(); r.i32()  # context (dev_type, dev_id) — data is host-side
+        type_flag = r.i32()
+        aux_meta = [(r.i32(), r.shape()) for _ in range(nad)]
+        dt = _np_of_flag(type_flag)
+        n_elem = int(onp.prod(storage_shape)) if storage_shape is not None \
+            else int(onp.prod(shape)) if shape else 1
+        data = onp.frombuffer(r.read(n_elem * dt.itemsize), dtype=dt)
+        data = data.reshape(storage_shape if storage_shape is not None
+                            else shape)
+        aux = []
+        for aflag, ashape in aux_meta:
+            adt = _np_of_flag(aflag)
+            cnt = int(onp.prod(ashape)) if ashape else 1
+            aux.append(onp.frombuffer(r.read(cnt * adt.itemsize),
+                                      dtype=adt).reshape(ashape))
+        return _ID_STYPE[sid], shape, dt, data, aux
+    # legacy paths (ndarray.cc LegacyLoad)
+    if magic == V1_MAGIC:
+        shape = r.shape()
+    else:  # ancient: magic itself is ndim, dims are uint32
+        shape = r.legacy_shape_u32(magic)
+    if not shape:
+        return "default", (), onp.dtype("float32"), None, []
+    r.i32(); r.i32()  # context
+    type_flag = r.i32()
+    dt = _np_of_flag(type_flag)
+    n_elem = int(onp.prod(shape))
+    data = onp.frombuffer(r.read(n_elem * dt.itemsize),
+                          dtype=dt).reshape(shape)
+    return "default", shape, dt, data, []
+
+
+def save_bytes(arrays, names: Sequence[str]) -> bytes:
+    out: List[bytes] = [struct.pack("<QQ", LIST_MAGIC, 0),
+                        struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _save_one(out, a)
+    names = [n for n in names if n] if any(names) else []
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        nb = n.encode()
+        out.append(struct.pack("<Q", len(nb)))
+        out.append(nb)
+    return b"".join(out)
+
+
+def load_buffer(buf: bytes):
+    """Returns (list of (stype, shape, dtype, data, aux), names)."""
+    r = _Reader(buf)
+    header = r.u64()
+    if header != LIST_MAGIC:
+        raise MXNetError(f"Invalid NDArray file format (magic {header:#x})")
+    second = r.u64()
+    if second != 0:
+        # round-1 interim layout: magic | count | (name,dtype,shape,bytes)*
+        return _load_legacy_interim(r, second)
+    n = r.u64()
+    arrays = [_load_one(r) for _ in range(n)]
+    n_names = r.u64()
+    names = [r.read(r.u64()).decode() for _ in range(n_names)]
+    if names and len(names) != len(arrays):
+        raise MXNetError("Invalid NDArray file format (name count)")
+    return arrays, names
+
+
+def _load_legacy_interim(r: _Reader, n: int):
+    names, arrays = [], []
+    for _ in range(n):
+        name = r.read(r.u32()).decode()
+        dt = onp.dtype(r.read(r.u32()).decode())
+        ndim = r.u32()
+        shape = struct.unpack(f"<{ndim}q", r.read(8 * ndim)) if ndim else ()
+        nb = r.u64()
+        data = onp.frombuffer(r.read(nb), dtype=dt).reshape(shape)
+        names.append(name)
+        arrays.append(("default", shape, dt, data, []))
+    return arrays, names if any(names) else []

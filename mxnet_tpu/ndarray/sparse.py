@@ -2,13 +2,16 @@
 
 TPU-native take on the reference sparse storage types
 (ref: include/mxnet/ndarray.h:61-66 kRowSparseStorage/kCSRStorage;
-src/operator/tensor/cast_storage-inl.h). XLA has no ragged buffers, so
-these are *capability-compatible* containers: they hold (data, indices)
-with static-bounded sizes, support the reference API surface
-(`.data/.indices/.indptr`, `tostype`, `retain`), and convert to dense at
-op boundaries — the dense-segment strategy SURVEY.md §7 "hard parts (c)"
-calls for. Row-sparse gradients for embeddings are produced as dense
-segment-sums on TPU (the MXU-friendly layout) while keeping this API.
+src/operator/tensor/cast_storage-inl.h; python/mxnet/ndarray/sparse.py).
+
+XLA has no ragged buffers, so the design is *dense-segment* sparse
+(SURVEY.md §7 hard part (c)): a sparse array holds its compact
+``(values, indices)`` payload as static-shaped jax arrays, and the sparse
+code paths — sparse×dense dot, row-wise optimizer updates, sparse
+gradients, ``row_sparse_pull`` — operate on the payload only, touching
+O(nnz) data. The *dense view* is materialized lazily, only when a dense
+op consumes the array (that is the reference's storage-fallback path,
+and it warns via MXNET_STORAGE_FALLBACK_LOG_VERBOSE).
 """
 from __future__ import annotations
 
@@ -18,25 +21,114 @@ import numpy as onp
 from ..base import MXNetError
 from .ndarray import NDArray, _wrap, array as _dense_array
 
-__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage", "zeros"]
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "zeros",
+           "log_storage_fallback"]
+
+_fallback_warned = set()
+
+
+def log_storage_fallback(op_name: str):
+    """Warn (once per op) when a sparse input executes through the dense
+    implementation — MXNET_STORAGE_FALLBACK_LOG_VERBOSE
+    (ref: env_var.md:30; src/common/utils.h LogStorageFallback)."""
+    from ..base import get_env
+    if not get_env("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", True):
+        return
+    if op_name in _fallback_warned:
+        return
+    _fallback_warned.add(op_name)
+    import warnings
+    warnings.warn(
+        f"op {op_name}: sparse input falls back to the dense "
+        "implementation (set MXNET_STORAGE_FALLBACK_LOG_VERBOSE=0 to "
+        "silence)", stacklevel=3)
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ("_aux",)
+    """Common lazy-dense machinery.
+
+    ``_data`` (the dense buffer every generic op reads) is a property
+    that materializes on first access; sparse-aware code never touches
+    it. The payload lives in ``_aux``. A dense write-back (``_rebind``
+    from a dense op / kvstore pull) marks the payload stale; the next
+    payload read re-extracts it from the dense buffer so sparse readers
+    never see pre-update values.
+    """
+
+    __slots__ = ("_aux_store", "_dense_cache", "_shape", "_payload_stale")
+
+    def _init_base(self, shape):
+        # NDArray.__init__ is bypassed (it would require a dense buffer)
+        self._shape = tuple(int(s) for s in shape)
+        self._dense_cache = None
+        self._payload_stale = False
+        self._grad = None
+        self._grad_req = "null"
+        self._pending_grad = None
+        self._writeback = None
+
+    # _data shadows the NDArray slot with a lazy property
+    @property
+    def _data(self):
+        d = self._dense_cache
+        if d is None:
+            d = self._densify()
+            self._dense_cache = d
+        return d
+
+    @_data.setter
+    def _data(self, v):
+        self._dense_cache = v
+        self._payload_stale = True
+
+    @property
+    def _aux(self):
+        if self._payload_stale:
+            self._refresh_payload(self._dense_cache)
+            self._payload_stale = False
+        return self._aux_store
+
+    @_aux.setter
+    def _aux(self, v):
+        self._aux_store = v
+        self._payload_stale = False
+
+    def _refresh_payload(self, dense):
+        raise NotImplementedError
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._aux["values"].dtype)
+
+    def _densify(self):
+        raise NotImplementedError
+
+    def densified(self) -> bool:
+        """Whether the dense view has been materialized (test hook)."""
+        return self._dense_cache is not None
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """ref: python/mxnet/ndarray/sparse.py RowSparseNDArray."""
+    """ref: python/mxnet/ndarray/sparse.py RowSparseNDArray —
+    ``values: (nnz_rows,) + shape[1:]``, ``indices: (nnz_rows,)``.
+    Duplicate indices are allowed and sum in the dense view (gradient
+    accumulation semantics)."""
 
     __slots__ = ()
 
     def __init__(self, data, indices, shape):
-        dense = jnp.zeros(shape, jnp.asarray(data).dtype)
-        idx = jnp.asarray(indices, jnp.int32)
-        dense = dense.at[idx].set(jnp.asarray(data))
-        super().__init__(dense)
-        self._aux = {"indices": idx, "values": jnp.asarray(data)}
+        if shape is None:
+            raise MXNetError("row_sparse_array requires an explicit shape")
+        self._init_base(shape)
+        values = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        idx = indices._data if isinstance(indices, NDArray) else indices
+        self._aux = {"values": jnp.asarray(values),
+                     "indices": jnp.asarray(idx, jnp.int32)}
 
     @property
     def stype(self):
@@ -50,6 +142,18 @@ class RowSparseNDArray(BaseSparseNDArray):
     def data(self) -> NDArray:
         return _wrap(self._aux["values"])
 
+    def _densify(self):
+        vals = self._aux["values"]
+        idx = self._aux["indices"].astype(jnp.int32)
+        dense = jnp.zeros(self._shape, vals.dtype)
+        return dense.at[idx].add(vals)
+
+    def _refresh_payload(self, dense):
+        a = onp.asarray(dense)
+        nz = onp.where(onp.any(a != 0, axis=tuple(range(1, a.ndim))))[0]
+        self._aux_store = {"values": jnp.asarray(a[nz]),
+                           "indices": jnp.asarray(nz, jnp.int32)}
+
     def tostype(self, stype):
         if stype == "row_sparse":
             return self
@@ -58,29 +162,47 @@ class RowSparseNDArray(BaseSparseNDArray):
         raise MXNetError(f"cast_storage row_sparse->{stype} unsupported")
 
     def retain(self, indices):
+        """ref: _sparse_retain — keep only the requested rows."""
         idx = indices._data.astype(jnp.int32) if isinstance(indices, NDArray) \
             else jnp.asarray(indices, jnp.int32)
-        vals = jnp.take(self._data, idx, axis=0)
+        # gather from the compact payload: for each wanted row find its
+        # slot (first match; missing rows yield zeros)
+        own = self._aux["indices"]
+        eq = own[None, :] == idx[:, None]                  # (want, nnz)
+        has = eq.any(axis=1)
+        slot = jnp.argmax(eq, axis=1)
+        vals = jnp.where(
+            has.reshape((-1,) + (1,) * (self._aux["values"].ndim - 1)),
+            self._aux["values"][slot], 0)
         return RowSparseNDArray(vals, idx, self.shape)
+
+    def copy(self):
+        return RowSparseNDArray(self._aux["values"], self._aux["indices"],
+                                self.shape)
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """ref: python/mxnet/ndarray/sparse.py CSRNDArray."""
+    """ref: python/mxnet/ndarray/sparse.py CSRNDArray — 2-D
+    ``data: (nnz,)``, ``indices: (nnz,)`` col ids, ``indptr: (m+1,)``."""
 
     __slots__ = ()
 
     def __init__(self, data, indices, indptr, shape):
-        data = jnp.asarray(data)
-        indices = jnp.asarray(indices, jnp.int32)
-        indptr = jnp.asarray(indptr, jnp.int32)
-        dense = onp.zeros(shape, dtype=onp.dtype(data.dtype))
-        d, ind, iptr = (onp.asarray(data), onp.asarray(indices),
-                        onp.asarray(indptr))
-        for r in range(shape[0]):
-            for j in range(iptr[r], iptr[r + 1]):
-                dense[r, ind[j]] = d[j]
-        super().__init__(dense)
-        self._aux = {"data": data, "indices": indices, "indptr": indptr}
+        if shape is None:
+            raise MXNetError("csr_matrix requires an explicit shape")
+        if len(shape) != 2:
+            raise MXNetError("csr requires 2D")
+        self._init_base(shape)
+        values = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        self._aux = {
+            "values": jnp.asarray(values),
+            "indices": jnp.asarray(
+                indices._data if isinstance(indices, NDArray) else indices,
+                jnp.int32),
+            "indptr": jnp.asarray(
+                indptr._data if isinstance(indptr, NDArray) else indptr,
+                jnp.int32),
+        }
 
     @property
     def stype(self):
@@ -88,7 +210,7 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def data(self) -> NDArray:
-        return _wrap(self._aux["data"])
+        return _wrap(self._aux["values"])
 
     @property
     def indices(self) -> NDArray:
@@ -98,12 +220,54 @@ class CSRNDArray(BaseSparseNDArray):
     def indptr(self) -> NDArray:
         return _wrap(self._aux["indptr"])
 
+    def _row_ids(self):
+        """Per-nnz row id, expanded from indptr (host-side, memoized)."""
+        cached = self._aux.get("_row_ids")
+        if cached is None:
+            iptr = onp.asarray(self._aux["indptr"])
+            counts = onp.diff(iptr)
+            cached = jnp.asarray(onp.repeat(onp.arange(len(counts)), counts),
+                                 jnp.int32)
+            self._aux["_row_ids"] = cached
+        return cached
+
+    def _densify(self):
+        vals = self._aux["values"]
+        cols = self._aux["indices"].astype(jnp.int32)
+        rows = self._row_ids()
+        dense = jnp.zeros(self._shape, vals.dtype)
+        return dense.at[rows, cols].add(vals)
+
+    def _refresh_payload(self, dense):
+        a = onp.asarray(dense)
+        rows, cols = onp.nonzero(a)
+        indptr = onp.zeros(a.shape[0] + 1, onp.int64)
+        onp.add.at(indptr, rows + 1, 1)
+        self._aux_store = {
+            "values": jnp.asarray(a[rows, cols]),
+            "indices": jnp.asarray(cols, jnp.int32),
+            "indptr": jnp.asarray(onp.cumsum(indptr), jnp.int32),
+        }
+
     def tostype(self, stype):
         if stype == "csr":
             return self
         if stype == "default":
             return _wrap(self._data)
         raise MXNetError(f"cast_storage csr->{stype} unsupported")
+
+    def slice(self, start, stop):
+        """Row slice (ref: csr slice op) on the compact payload."""
+        iptr = onp.asarray(self._aux["indptr"])
+        lo, hi = int(iptr[start]), int(iptr[stop])
+        new_iptr = iptr[start:stop + 1] - lo
+        return CSRNDArray(self._aux["values"][lo:hi],
+                          self._aux["indices"][lo:hi], new_iptr,
+                          (stop - start, self.shape[1]))
+
+    def copy(self):
+        return CSRNDArray(self._aux["values"], self._aux["indices"],
+                          self._aux["indptr"], self.shape)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
@@ -126,6 +290,8 @@ def cast_storage(arr: NDArray, stype: str):
     """ref: src/operator/tensor/cast_storage.cc"""
     if stype == "default":
         return _wrap(arr._data)
+    if getattr(arr, "stype", "default") == stype:
+        return arr
     a = arr.asnumpy()
     if stype == "row_sparse":
         nz_rows = onp.where(onp.any(a != 0, axis=tuple(range(1, a.ndim))))[0]
@@ -133,23 +299,21 @@ def cast_storage(arr: NDArray, stype: str):
     if stype == "csr":
         if a.ndim != 2:
             raise MXNetError("csr requires 2D")
-        indptr = [0]
-        indices, data = [], []
-        for r in range(a.shape[0]):
-            cols = onp.where(a[r] != 0)[0]
-            indices.extend(cols.tolist())
-            data.extend(a[r, cols].tolist())
-            indptr.append(len(indices))
-        return CSRNDArray(onp.asarray(data, a.dtype), indices, indptr, a.shape)
+        rows, cols = onp.nonzero(a)
+        indptr = onp.zeros(a.shape[0] + 1, onp.int64)
+        onp.add.at(indptr, rows + 1, 1)
+        indptr = onp.cumsum(indptr)
+        return CSRNDArray(a[rows, cols], cols, indptr, a.shape)
     raise MXNetError(f"unknown stype {stype}")
 
 
 def zeros(stype, shape, ctx=None, dtype="float32"):
     if stype == "row_sparse":
         return RowSparseNDArray(onp.zeros((0,) + tuple(shape[1:]), dtype=dtype),
-                                onp.zeros((0,), dtype="int32"), shape)
+                                onp.zeros((0,), dtype="int64"), shape)
     if stype == "csr":
-        return CSRNDArray(onp.zeros((0,), dtype=dtype), [], [0] * (shape[0] + 1),
-                          shape)
+        return CSRNDArray(onp.zeros((0,), dtype=dtype),
+                          onp.zeros((0,), dtype="int64"),
+                          [0] * (shape[0] + 1), shape)
     from .ndarray import zeros as dzeros
     return dzeros(shape, ctx, dtype)

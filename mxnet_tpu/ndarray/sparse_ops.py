@@ -1,0 +1,254 @@
+"""Sparse-aware op implementations (the FComputeEx dispatch tier).
+
+The reference dispatches an op to a sparse kernel when input storage
+types allow (ref: src/imperative/imperative_utils.h:99 SetShapeType
+choosing kFComputeEx; sparse dot kernels src/operator/tensor/dot-inl.h;
+_square_sum src/operator/tensor/square_sum-inl.h). Here
+:func:`maybe_sparse_dispatch` is that choice point: ``nd.<op>`` calls it
+before the dense path; a registered sparse impl computes on the compact
+``(values, indices)`` payload and records a custom backward on the
+autograd tape. Gradients w.r.t. weights flow as :class:`SparseCotangent`
+— (values, indices) pairs that deposit into ``row_sparse`` grad buffers
+without ever materializing the dense gradient (the point of sparse
+training: O(nnz) optimizer/communication cost).
+
+Like the reference's sparse kernels these run host-driven-eager (CPU
+sparse in the reference is also outside the fused path); the MXU-dense
+parts (segment sums, gathers) are jax ops.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import NDArray, _wrap
+from .sparse import BaseSparseNDArray, CSRNDArray, RowSparseNDArray
+
+__all__ = ["SparseCotangent", "register_sparse_op", "maybe_sparse_dispatch"]
+
+
+class SparseCotangent:
+    """Row-sparse gradient flowing through the tape to a leaf.
+
+    values: (nnz,) + row_shape; indices: (nnz,) — duplicates allowed,
+    they sum (gradient accumulation semantics)."""
+
+    __slots__ = ("values", "indices", "shape")
+
+    def __init__(self, values, indices, shape):
+        self.values = jnp.asarray(values)
+        self.indices = jnp.asarray(indices, jnp.int32).reshape(-1)
+        self.shape = tuple(shape)
+
+    def __add__(self, other):
+        if isinstance(other, SparseCotangent):
+            return SparseCotangent(
+                jnp.concatenate([self.values, other.values]),
+                jnp.concatenate([self.indices, other.indices]), self.shape)
+        # dense on the other side: give up sparsity
+        return self.densify() + other
+
+    __radd__ = __add__
+
+    def densify(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.indices.astype(jnp.int32)].add(self.values)
+
+    def to_rowsparse(self) -> RowSparseNDArray:
+        """Deduplicated row_sparse gradient (sorted unique rows, summed
+        values — the reference's row_sparse grad invariant)."""
+        idx = onp.asarray(self.indices)
+        uniq, inv = onp.unique(idx, return_inverse=True)
+        vals = jax.ops.segment_sum(self.values, jnp.asarray(inv),
+                                   num_segments=len(uniq))
+        return RowSparseNDArray(vals, uniq, self.shape)
+
+
+_SPARSE_OPS: Dict[str, Callable] = {}
+
+
+def register_sparse_op(name: str, *aliases: str):
+    def deco(fn):
+        _SPARSE_OPS[name] = fn
+        for a in aliases:
+            _SPARSE_OPS[a] = fn
+        return fn
+    return deco
+
+
+def maybe_sparse_dispatch(name: str, inputs, params):
+    """Return the sparse-impl result, or NotImplemented to use the dense
+    path (which densifies with a storage-fallback warning)."""
+    fn = _SPARSE_OPS.get(name)
+    if fn is None:
+        return NotImplemented
+    if not any(isinstance(i, BaseSparseNDArray) for i in inputs) \
+            and not params.get("sparse_grad"):
+        return NotImplemented
+    return fn(*inputs, **params)
+
+
+def _record(fn_name, in_edges, in_owners, out_edges, custom_backward):
+    from .. import autograd
+    if autograd.is_recording():
+        autograd.current_tape().record(
+            fn=None, in_arrays=in_edges, out_arrays=out_edges,
+            in_owners=in_owners, custom_backward=custom_backward)
+
+
+# ---------------------------------------------------------------------------
+# dot — ref: src/operator/tensor/dot-inl.h (csr x dense -> dense,
+# csr^T x dense -> row_sparse)
+# ---------------------------------------------------------------------------
+
+def _csr_rows(csr: CSRNDArray):
+    return csr._row_ids()
+
+
+@register_sparse_op("dot")
+def sparse_dot(lhs, rhs, transpose_a=False, transpose_b=False,
+               forward_stype=None):
+    if not isinstance(lhs, CSRNDArray):
+        return NotImplemented
+    if transpose_b:
+        raise MXNetError("sparse dot: transpose_b is not supported")
+    vals = lhs._aux["values"]
+    cols = lhs._aux["indices"].astype(jnp.int32)
+    rows = _csr_rows(lhs)
+    m, k_dim = lhs.shape
+    # rhs may be dense or row_sparse; compute against the dense view —
+    # the MXU-friendly layout (deliberate, not a fallback). The tape
+    # edge for a sparse rhs is its VALUES payload so chains of sparse
+    # ops connect (and leaf deposits stay row-sparse).
+    rhs_sparse = isinstance(rhs, RowSparseNDArray)
+    rhs_dense = rhs._data
+    rhs_edge = rhs._aux["values"] if rhs_sparse else rhs_dense
+
+    def _rhs_cot(pernnz, _cols):
+        """Cotangent w.r.t. the rhs edge from per-nnz contributions."""
+        if rhs_sparse:
+            dense_d = jnp.zeros(rhs_dense.shape, pernnz.dtype) \
+                .at[_cols].add(pernnz)
+            return dense_d[rhs._aux["indices"].astype(jnp.int32)]
+        return SparseCotangent(pernnz, _cols, rhs_dense.shape)
+
+    if not transpose_a:
+        # (m, k) csr x (k, n) -> (m, n) dense
+        prod = vals[:, None] * rhs_dense[cols]           # (nnz, n)
+        out_arr = jax.ops.segment_sum(prod, rows, num_segments=m)
+
+        def bwd(cotangents, _vals=vals, _cols=cols, _rows=rows):
+            (g,) = cotangents                            # (m, n) dense
+            pernnz = _vals[:, None] * g[_rows]           # (nnz, n)
+            return (None, _rhs_cot(pernnz, _cols))
+
+        out = _wrap(out_arr)
+        _record("dot", [vals, rhs_edge], [None, rhs], [out._data], bwd)
+        return out
+
+    # transpose_a: lhs is (m, k); out = lhs^T rhs: (k, n) row_sparse
+    # with rows = columns present in lhs (ref: dot-inl.h csr^T case)
+    uniq, inv = onp.unique(onp.asarray(cols), return_inverse=True)
+    prod = vals[:, None] * rhs_dense[rows]               # (nnz, n)
+    out_vals = jax.ops.segment_sum(prod, jnp.asarray(inv),
+                                   num_segments=len(uniq))
+    out = RowSparseNDArray(out_vals, uniq, (k_dim, rhs_dense.shape[1]))
+
+    def bwd_t(cotangents, _vals=vals, _rows=rows, _inv=inv):
+        (g_vals,) = cotangents                           # (u, n) values cot
+        pernnz = _vals[:, None] * g_vals[jnp.asarray(_inv)]
+        return (None, _rhs_cot(pernnz, _rows))
+
+    _record("dot", [vals, rhs_edge], [None, rhs],
+            [out._aux["values"]], bwd_t)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# elementwise on the csr payload
+# ---------------------------------------------------------------------------
+
+@register_sparse_op("square")
+def sparse_square(data):
+    if isinstance(data, CSRNDArray):
+        out = CSRNDArray(jnp.square(data._aux["values"]),
+                         data._aux["indices"], data._aux["indptr"],
+                         data.shape)
+        _record("square", [data._aux["values"]], [None],
+                [out._aux["values"]],
+                lambda c, _v=data._aux["values"]: (2.0 * _v * c[0],))
+        return out
+    if isinstance(data, RowSparseNDArray):
+        out = RowSparseNDArray(jnp.square(data._aux["values"]),
+                               data._aux["indices"], data.shape)
+        _record("square", [data._aux["values"]], [None],
+                [out._aux["values"]],
+                lambda c, _v=data._aux["values"]: (2.0 * _v * c[0],))
+        return out
+    return NotImplemented
+
+
+@register_sparse_op("_square_sum")
+def sparse_square_sum(data, axis=None, keepdims=False):
+    """ref: src/operator/tensor/square_sum-inl.h — row_sparse in,
+    row_sparse out for axis=1 (the FM v_s term)."""
+    if not isinstance(data, RowSparseNDArray):
+        return NotImplemented
+    vals = data._aux["values"]
+    out_vals = jnp.sum(jnp.square(vals), axis=1,
+                       keepdims=bool(keepdims))
+    shape = (data.shape[0], 1) if keepdims else (data.shape[0],)
+    out = RowSparseNDArray(out_vals, data._aux["indices"], shape)
+
+    def bwd(cotangents, _v=vals):
+        (g,) = cotangents                # values cotangent, (nnz,1)|(nnz,)
+        g = g if g.ndim == _v.ndim else g[:, None]
+        return (2.0 * _v * g,)
+
+    _record("_square_sum", [vals], [data], [out._aux["values"]], bwd)
+    return out
+
+
+@register_sparse_op("_sparse_retain")
+def sparse_retain(data, indices):
+    if not isinstance(data, RowSparseNDArray):
+        return NotImplemented
+    return data.retain(indices)
+
+
+@register_sparse_op("cast_storage")
+def sparse_cast_storage(data, stype="default"):
+    from .sparse import cast_storage as _cast
+    return _cast(data, stype)
+
+
+# ---------------------------------------------------------------------------
+# Embedding with sparse_grad (ref: src/operator/tensor/indexing_op.cc
+# Embedding FInferStorageType: grad stype row_sparse when sparse_grad)
+# ---------------------------------------------------------------------------
+
+@register_sparse_op("Embedding")
+def sparse_embedding(data, weight, input_dim=0, output_dim=0,
+                     dtype="float32", sparse_grad=False, **_ignored):
+    if not sparse_grad:
+        return NotImplemented
+    ids = data._data.astype(jnp.int32)
+    w = weight._data
+    out = _wrap(jnp.take(w, ids, axis=0))
+
+    def bwd(cotangents, _ids=ids, _wshape=w.shape):
+        (g,) = cotangents                        # (..., dim) dense
+        flat = g.reshape(-1, _wshape[1])
+        return (None, SparseCotangent(flat, _ids.reshape(-1), _wshape))
+
+    _record("Embedding", [data._data, w], [None, weight], [out._data], bwd)
+    return out
+
+
+_SPARSE_OPS["_contrib_SparseEmbedding"] = \
+    lambda data, weight, **kw: sparse_embedding(
+        data, weight, **{**kw, "sparse_grad": True})

@@ -272,6 +272,8 @@ def hard_sigmoid(data, alpha=0.2, beta=0.5):
 @register_op("softmax")
 def softmax(data, *length, axis=-1, temperature=None, dtype=None,
             use_length=False):
+    from .tensor import _safe_acc
+    data, restore = _safe_acc(data)  # MXNET_SAFE_ACCUMULATION: fp32 math
     x = data / temperature if temperature else data
     if use_length and length:
         ln = length[0].astype(jnp.int32)
@@ -280,15 +282,19 @@ def softmax(data, *length, axis=-1, temperature=None, dtype=None,
         shp[axis] = -1
         mask = pos.reshape(shp) < ln.reshape(ln.shape + (1,) * (x.ndim - ln.ndim))
         x = jnp.where(mask, x, -jnp.inf)
+        out = jnp.where(mask, jax.nn.softmax(x, axis=axis), 0.0)
+    else:
         out = jax.nn.softmax(x, axis=axis)
-        return jnp.where(mask, out, 0.0)
-    return jax.nn.softmax(x, axis=axis)
+    return out.astype(restore) if restore is not None else out
 
 
 @register_op("log_softmax")
 def log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
+    from .tensor import _safe_acc
+    data, restore = _safe_acc(data)  # MXNET_SAFE_ACCUMULATION: fp32 math
     x = data / temperature if temperature else data
-    return jax.nn.log_softmax(x, axis=axis)
+    out = jax.nn.log_softmax(x, axis=axis)
+    return out.astype(restore) if restore is not None else out
 
 
 @register_op("softmin")

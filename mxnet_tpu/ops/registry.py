@@ -133,6 +133,16 @@ def make_nd_function(name: str) -> Callable:
                 inputs.append(v)
             else:
                 rest_params[k] = v
+        # FComputeEx dispatch: sparse storage types route to sparse
+        # kernels when one exists (ref: imperative_utils.h:99 dispatch-
+        # mode choice); otherwise fall through to the dense path
+        from ..ndarray.sparse_ops import maybe_sparse_dispatch
+        sparse_res = maybe_sparse_dispatch(name, inputs, rest_params)
+        if sparse_res is not NotImplemented:
+            if out_kw is not None:
+                out_kw._rebind(sparse_res._data)
+                return out_kw
+            return sparse_res
         from .. import amp as _amp
         if _amp.is_active():
             from ..ndarray.ndarray import _wrap as _aw

@@ -151,6 +151,17 @@ def _axis_arg(axis):
     return int(axis)
 
 
+def _safe_acc(data):
+    """MXNET_SAFE_ACCUMULATION (env_var.md): accumulate low-precision
+    floats in fp32. Returns (possibly upcast data, restore dtype|None)."""
+    from ..base import get_env
+    if get_env("MXNET_SAFE_ACCUMULATION", False) \
+            and jnp.issubdtype(data.dtype, jnp.floating) \
+            and jnp.dtype(data.dtype).itemsize < 4:
+        return data.astype(jnp.float32), data.dtype
+    return data, None
+
+
 def _make_reduce(jfn, nan_fn=None):
     def red(data, axis=None, keepdims=False, exclude=False):
         ax = _axis_arg(axis)
@@ -158,7 +169,9 @@ def _make_reduce(jfn, nan_fn=None):
             all_ax = set(range(data.ndim))
             keep = {a % data.ndim for a in (ax if isinstance(ax, tuple) else (ax,))}
             ax = tuple(sorted(all_ax - keep))
-        return jfn(data, axis=ax, keepdims=keepdims)
+        data, restore = _safe_acc(data)
+        out = jfn(data, axis=ax, keepdims=keepdims)
+        return out.astype(restore) if restore is not None else out
     return red
 
 

@@ -138,6 +138,56 @@ def _assign(weight: NDArray, new: NDArray):
     weight._rebind(new._data)
 
 
+def _rowsparse_parts(grad):
+    """(row_indices int32, values, is_sparse) of a gradient. Sparse
+    optimizer updates touch ONLY these rows (ref: the lazy/sparse update
+    paths of src/operator/optimizer_op.cc, e.g. _sparse_adagrad_update
+    and SGDUpdateRspImpl)."""
+    from .ndarray.sparse import RowSparseNDArray
+    if isinstance(grad, RowSparseNDArray):
+        return (grad._aux["indices"].astype(_nd.jnp.int32),
+                grad._aux["values"], True)
+    return None, None, False
+
+
+def _clip_scale(g, rescale, clip):
+    g = g * rescale
+    if clip is not None and clip >= 0:
+        g = _nd.jnp.clip(g, -clip, clip)
+    return g
+
+
+def _rows_get(arr, idx):
+    """(buffer, slots) for row reads on a dense or row_sparse array —
+    row_sparse weights are updated on their compact payload, never via
+    the dense view. Payload indices may be unsorted; a gradient row with
+    no payload slot is an error (silently updating a wrong row would
+    corrupt training)."""
+    from .ndarray.sparse import RowSparseNDArray
+    if isinstance(arr, RowSparseNDArray):
+        own = arr._aux["indices"]
+        order = _nd.jnp.argsort(own)
+        sorted_idx = own[order]
+        pos = _nd.jnp.clip(
+            _nd.jnp.searchsorted(sorted_idx, idx.astype(own.dtype)),
+            0, own.shape[0] - 1)
+        if not bool((sorted_idx[pos] == idx.astype(own.dtype)).all()):
+            raise MXNetError(
+                "sparse update: gradient rows missing from the "
+                "row_sparse weight/state payload")
+        return arr._aux["values"], order[pos]
+    return arr._data, idx
+
+
+def _rows_set(arr, buf, slots, new_rows):
+    from .ndarray.sparse import RowSparseNDArray
+    if isinstance(arr, RowSparseNDArray):
+        arr._aux["values"] = buf.at[slots].set(new_rows)
+        arr._dense_cache = None
+    else:
+        arr._rebind(buf.at[slots].set(new_rows))
+
+
 @register
 class SGD(Optimizer):
     """ref: optimizer.py SGD → sgd_update/sgd_mom_update ops."""
@@ -146,6 +196,11 @@ class SGD(Optimizer):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
+        # width of the fused multi-tensor update (ref: the reference SGD
+        # reads MXNET_OPTIMIZER_AGGREGATION_SIZE for multi_sgd_update)
+        from .base import get_env
+        self.aggregate_num = max(
+            1, min(45, int(get_env("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4))))
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -153,6 +208,23 @@ class SGD(Optimizer):
         return nd_zeros(weight.shape, weight.ctx, dtype=str(weight.dtype))
 
     def update(self, index, weight, grad, state):
+        idx, gv, sparse = _rowsparse_parts(grad)
+        if sparse and self.lazy_update:
+            # lazy row-wise update: only rows present in the gradient are
+            # touched — weights AND momentum (ref: SGDUpdateRspImpl /
+            # sgd_mom lazy path, src/operator/optimizer_op.cc)
+            lr, wd, clip = self._common(index)
+            w, wslots = _rows_get(weight, idx)
+            rows = w[wslots]
+            g = _clip_scale(gv, self.rescale_grad, clip) + wd * rows
+            if state is None:
+                _rows_set(weight, w, wslots, rows - lr * g)
+            else:
+                m, mslots = _rows_get(state, idx)
+                new_m = self.momentum * m[mslots] - lr * g
+                _rows_set(weight, w, wslots, rows + new_m)
+                _rows_set(state, m, mslots, new_m)
+            return
         lr, wd, clip = self._common(index)
         if state is None:
             new_w = invoke(oops.sgd_update, [weight, grad], lr=lr, wd=wd,
@@ -165,6 +237,36 @@ class SGD(Optimizer):
                                     clip_gradient=clip)
             _assign(weight, new_w)
             _assign(state, new_mom)
+
+    def update_multi(self, indices, weights, grads, states):
+        """Fused multi-tensor update — one op call for up to
+        aggregate_num parameters (ref: optimizer_op.cc multi_sgd_update /
+        multi_sgd_mom_update; width set by
+        MXNET_OPTIMIZER_AGGREGATION_SIZE)."""
+        from .ops.extra_ops import multi_sgd_mom_update, multi_sgd_update
+        n = len(indices)
+        lws = [self._common(i) for i in indices]
+        lrs = [t[0] for t in lws]
+        wds = [t[1] for t in lws]
+        clip = lws[0][2] if lws else -1.0
+        if self.momentum == 0.0:
+            arrays = [a for w, g in zip(weights, grads) for a in (w, g)]
+            outs = invoke(multi_sgd_update, arrays, n_out=n,
+                          lrs=lrs, wds=wds, rescale_grad=self.rescale_grad,
+                          clip_gradient=clip, num_weights=n)
+            for w, nw in zip(weights, outs):
+                _assign(w, nw)
+        else:
+            arrays = [a for w, g, m in zip(weights, grads, states)
+                      for a in (w, g, m)]
+            outs = invoke(multi_sgd_mom_update, arrays, n_out=2 * n,
+                          lrs=lrs, wds=wds, momentum=self.momentum,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=clip, num_weights=n)
+            for w, nw in zip(weights, outs[:n]):
+                _assign(w, nw)
+            for m, nm in zip(states, outs[n:]):
+                _assign(m, nm)
 
 
 @register
@@ -199,6 +301,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         dt = str(weight.dtype)
@@ -212,6 +315,25 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        idx, gv, sparse = _rowsparse_parts(grad)
+        if sparse and self.lazy_update:
+            # lazy Adam: mean/var/weight rows not present in the gradient
+            # are untouched (ref: adam_update lazy_update path,
+            # src/operator/optimizer_op.cc AdamUpdateRspImpl)
+            w, wslots = _rows_get(weight, idx)
+            rows = w[wslots]
+            g = _clip_scale(gv, self.rescale_grad, clip) + wd * rows
+            mb, mslots = _rows_get(mean, idx)
+            vb, vslots = _rows_get(var, idx)
+            m_rows = self.beta1 * mb[mslots] + (1 - self.beta1) * g
+            v_rows = self.beta2 * vb[vslots] + \
+                (1 - self.beta2) * _nd.jnp.square(g)
+            new_rows = rows - lr * m_rows / (_nd.jnp.sqrt(v_rows) +
+                                             self.epsilon)
+            _rows_set(weight, w, wslots, new_rows)
+            _rows_set(mean, mb, mslots, m_rows)
+            _rows_set(var, vb, vslots, v_rows)
+            return
         new_w, new_mean, new_var = invoke(
             oops.adam_update, [weight, grad, mean, var], n_out=3, lr=lr,
             beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
@@ -258,6 +380,21 @@ class AdaGrad(Optimizer):
 
     def update(self, index, weight, grad, state):
         lr, wd, clip = self._common(index)
+        idx, gv, sparse = _rowsparse_parts(grad)
+        if sparse:
+            # _sparse_adagrad_update: history and weight rows not present
+            # in the gradient are untouched (ref: optimizer_op.cc
+            # _sparse_adagrad_update kernel)
+            w, wslots = _rows_get(weight, idx)
+            rows = w[wslots]
+            g = _clip_scale(gv, self.rescale_grad, clip) + wd * rows
+            h, hslots = _rows_get(state, idx)
+            h_rows = h[hslots] + _nd.jnp.square(g)
+            new_rows = rows - lr * g / (_nd.jnp.sqrt(h_rows) +
+                                        self.float_stable_eps)
+            _rows_set(weight, w, wslots, new_rows)
+            _rows_set(state, h, hslots, h_rows)
+            return
         new_w, new_h = invoke(oops.adagrad_update, [weight, grad, state],
                               n_out=2, lr=lr, epsilon=self.float_stable_eps,
                               wd=wd, rescale_grad=self.rescale_grad,
@@ -543,6 +680,32 @@ class Updater:
         self.aggregate_updates = optimizer.aggregate_num > 0
 
     def __call__(self, index, grad, weight):
+        if isinstance(index, (list, tuple)):
+            # aggregated call: one fused multi-tensor op per chunk
+            # (ref: the list-form Updater path driving multi_sgd_update)
+            for i, w in zip(index, weight):
+                if i not in self.states:
+                    self.states[i] = \
+                        self.optimizer.create_state_multi_precision(i, w)
+                    self.states_synced[i] = True
+            # the fused path handles plain dense fp32 tensors only;
+            # multi-precision states (w32, base) tuples and row_sparse
+            # grads keep their scalar update semantics
+            from .ndarray.sparse import RowSparseNDArray
+            fusable = (self.aggregate_updates
+                       and hasattr(self.optimizer, "update_multi")
+                       and not self.optimizer.multi_precision
+                       and not any(isinstance(g, RowSparseNDArray)
+                                   for g in grad))
+            if fusable:
+                self.optimizer.update_multi(
+                    list(index), list(weight), list(grad),
+                    [self.states[i] for i in index])
+            else:
+                for i, g, w in zip(index, grad, weight):
+                    self.optimizer.update_multi_precision(
+                        i, w, g, self.states[i])
+            return
         if index not in self.states:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)

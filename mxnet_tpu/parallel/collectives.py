@@ -55,19 +55,6 @@ def _global_mesh():
     return Mesh(devs, ("all",))
 
 
-@functools.lru_cache(maxsize=None)
-def _allreduce_fn(shape, dtype):
-    mesh = _global_mesh()
-
-    @functools.partial(
-        jax.jit,
-        out_shardings=NamedSharding(mesh, P()))
-    def fn(x):
-        return x  # replicated out_sharding forces the cross-device reduce
-
-    return fn, mesh
-
-
 def allreduce_across_processes(x):
     """Sum `x` (same shape on every process) across all processes.
 
@@ -76,16 +63,17 @@ def allreduce_across_processes(x):
     tiny jitted psum program over the global device mesh."""
     if jax.process_count() <= 1:
         return x
+    return _allreduce_jit()(x)
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_jit():
+    """One jitted psum program reused across calls — rebuilding the
+    shard_map closure per call would retrace/recompile every push."""
     mesh = _global_mesh()
-    n = len(jax.devices())
-
-    def local_sum(v):
-        return jax.lax.psum(v, "all")
-
-    f = jax.jit(
-        jax.shard_map(local_sum, mesh=mesh, in_specs=P(),
-                      out_specs=P(), check_vma=False))
-    return f(x) / 1  # already summed; every process holds the result
+    return jax.jit(
+        jax.shard_map(lambda v: jax.lax.psum(v, "all"), mesh=mesh,
+                      in_specs=P(), out_specs=P(), check_vma=False))
 
 
 def process_barrier():

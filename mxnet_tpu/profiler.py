@@ -161,3 +161,17 @@ class Marker:
             _events.append({"name": self.name, "ph": "i", "pid": os.getpid(),
                             "ts": time.perf_counter_ns() / 1000.0,
                             "s": scope_name[0]})
+
+
+# MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE (ref: env_var.md): start
+# profiling at import with the configured mode bitmask.
+def _maybe_autostart():
+    from .base import get_env
+    if get_env("MXNET_PROFILER_AUTOSTART", False):
+        mode = int(get_env("MXNET_PROFILER_MODE", 0))
+        if mode:
+            set_config(profile_all=True)
+        set_state("run")
+
+
+_maybe_autostart()

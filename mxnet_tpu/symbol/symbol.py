@@ -385,8 +385,16 @@ class Symbol:
             shapes = _infer_all_shapes(
                 self, {n: a.shape for n, a in args.items()})
             aux_states = {n: nd_zeros(shapes[n], ctx) for n in aux_names}
-        return Executor(self, ctx, dict(args), dict(args_grad), grad_reqs,
-                        dict(aux_states))
+        # MXNET_SUBGRAPH_BACKEND: partition with the named property
+        # before compilation (ref: env_var.md:319; build_subgraph.cc)
+        from ..base import get_env
+        backend = get_env("MXNET_SUBGRAPH_BACKEND", "")
+        bind_sym = self
+        if backend:
+            from ..subgraph import build_subgraph
+            bind_sym = build_subgraph(self, property_name=backend)
+        return Executor(bind_sym, ctx, dict(args), dict(args_grad),
+                        grad_reqs, dict(aux_states))
 
     # evaluation helper used by tests: symbol.eval(ctx, **bindings)
     def eval(self, ctx=None, **kwargs):

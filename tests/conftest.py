@@ -24,8 +24,14 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def _seed():
     """Seeded determinism (ref: tests/python/unittest/common.py:117
-    @with_seed)."""
-    onp.random.seed(0)
+    @with_seed; MXNET_TEST_SEED/MXNET_MODULE_SEED env control)."""
+    from mxnet_tpu import config
+    seed = int(config.get("MXNET_TEST_SEED"))
+    if seed < 0:
+        seed = int(config.get("MXNET_MODULE_SEED"))
+    if seed < 0:
+        seed = 0
+    onp.random.seed(seed)
     import mxnet_tpu as mx
-    mx.random.seed(0)
+    mx.random.seed(seed)
     yield

@@ -1,0 +1,91 @@
+"""Multi-process dist_async KVStore worker (4 ranks).
+
+TPU-native analog of the reference async test
+(ref: tests/nightly/dist_async_kvstore.py): pushes apply on the server
+the moment they arrive — NO worker barrier — and the server runs the
+optimizer when one is set (update_on_kvstore). Asserts:
+
+1. apply-per-push: a worker sees its own push reflected in an immediate
+   pull without waiting for any other worker (in sync mode the update
+   would be held until all ranks pushed);
+2. eventual sum: after an explicit barrier, the store holds every
+   rank's contribution;
+3. server-side optimizer: with SGD set on the server, each push moves
+   the weight by -lr * grad at arrival; optimizer state save/load
+   round-trips from rank 0.
+
+Run:  python tools/launch.py -n 4 python tests/nightly/dist_async_kvstore.py
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    assert kv.type == "dist_async"
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == int(os.environ["MX_NUM_WORKERS"])
+
+    shape = (2, 3)
+    if rank == 0:
+        kv.init("w", nd.zeros(shape))
+    kv.barrier()  # ensure init happened (setup only, not a train barrier)
+
+    # --- 1. apply-per-push, no waiting on other workers ------------------
+    my = float(rank + 1)
+    kv.push("w", nd.array(onp.full(shape, my, "float32")))
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    got = float(out.asnumpy()[0, 0])
+    # own contribution is visible immediately; other ranks may or may not
+    # have landed yet — the value is SOME partial sum including ours
+    total = sum(range(1, nw + 1))
+    assert got >= my - 1e-6, f"rank {rank}: own push not applied ({got})"
+    assert got <= total + 1e-6, f"rank {rank}: impossible sum {got}"
+
+    # --- 2. eventual consistency after barrier ---------------------------
+    kv.barrier()
+    kv.pull("w", out=out)
+    assert onp.allclose(out.asnumpy(), total), \
+        f"rank {rank}: final {out.asnumpy()[0, 0]} != {total}"
+
+    # --- 3. server-side optimizer (update_on_kvstore) --------------------
+    # collective, like the reference: every rank calls set_optimizer and
+    # only rank 0's copy reaches the server (kvstore.py:450)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    if rank == 0:
+        kv.init("x", nd.ones(shape))
+    kv.barrier()
+    kv.push("x", nd.array(onp.full(shape, 2.0, "float32")))
+    kv.barrier()
+    kv.pull("x", out=out)
+    # each of nw pushes applied per-arrival: x -= 0.5 * 2.0, nw times
+    expect = 1.0 - 0.5 * 2.0 * nw
+    assert onp.allclose(out.asnumpy(), expect, atol=1e-5), \
+        f"rank {rank}: optimizer path {out.asnumpy()[0, 0]} != {expect}"
+
+    # --- optimizer state save/load from rank 0 ---------------------------
+    if rank == 0:
+        fname = os.path.join(os.path.dirname(__file__), "..", "..",
+                             f".async_states_{os.getpid()}.bin")
+        kv.save_optimizer_states(fname)
+        kv.load_optimizer_states(fname)
+        os.unlink(fname)
+    kv.barrier()
+
+    print(f"rank {rank}/{nw}: DIST_ASYNC_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

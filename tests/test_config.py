@@ -1,0 +1,178 @@
+"""Typed config / MXNET_* env flag system (ref: docs/faq/env_var.md,
+dmlc::GetEnv use sites)."""
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, nd
+
+
+def test_flag_resolution_order(monkeypatch):
+    # default
+    assert config.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 1000000
+    # env wins over default, with type coercion
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "4096")
+    assert config.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 4096
+    # runtime override wins over env
+    config.set_flag("MXNET_KVSTORE_BIGARRAY_BOUND", 17)
+    try:
+        assert config.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 17
+    finally:
+        config.unset_flag("MXNET_KVSTORE_BIGARRAY_BOUND")
+    assert config.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 4096
+
+
+def test_bool_parsing(monkeypatch):
+    monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "1")
+    assert config.get("MXNET_SAFE_ACCUMULATION") is True
+    monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "0")
+    assert config.get("MXNET_SAFE_ACCUMULATION") is False
+
+
+def test_choices_enforced():
+    with pytest.raises(ValueError):
+        config.set_flag("MXNET_ENGINE_TYPE", "NoSuchEngine")
+
+
+def test_inert_flag_warns_once():
+    f = config.flags()["MXNET_GPU_MEM_POOL_TYPE"]
+    f._warned = False
+    config.set_flag("MXNET_GPU_MEM_POOL_TYPE", "Round")
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            config.get("MXNET_GPU_MEM_POOL_TYPE")
+        assert any("no effect" in str(x.message) for x in w)
+    finally:
+        config.unset_flag("MXNET_GPU_MEM_POOL_TYPE")
+        f._warned = False
+
+
+def test_get_env_delegates_to_config():
+    from mxnet_tpu.base import get_env
+    config.set_flag("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 7)
+    try:
+        assert get_env("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15) == 7
+    finally:
+        config.unset_flag("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN")
+
+
+def test_describe_lists_flags():
+    text = config.describe()
+    assert "MXNET_ENGINE_TYPE" in text
+    assert "MXNET_SAFE_ACCUMULATION" in text
+
+
+def test_safe_accumulation_softmax_and_sum():
+    """MXNET_SAFE_ACCUMULATION: bf16 inputs accumulate in fp32; output
+    dtype is preserved (ref: env_var.md MXNET_SAFE_ACCUMULATION)."""
+    x16 = nd.array(onp.full((64,), 1.0 / 64, "float32")).astype("float16")
+    config.set_flag("MXNET_SAFE_ACCUMULATION", True)
+    try:
+        s = nd.sum(x16)
+        assert str(s.dtype) == "float16"
+        sm = nd.softmax(nd.array(onp.zeros((4, 8), "float32"))
+                        .astype("float16"))
+        assert str(sm.dtype) == "float16"
+        assert onp.allclose(sm.asnumpy().sum(axis=-1), 1.0, atol=1e-3)
+    finally:
+        config.unset_flag("MXNET_SAFE_ACCUMULATION")
+
+
+def test_enforce_determinism_forces_sync():
+    from mxnet_tpu import engine
+    assert not engine.is_sync()
+    config.set_flag("MXNET_ENFORCE_DETERMINISM", True)
+    try:
+        assert engine.is_sync()
+    finally:
+        config.unset_flag("MXNET_ENFORCE_DETERMINISM")
+
+
+def test_backward_do_mirror_executor():
+    """Remat path produces identical gradients."""
+    from mxnet_tpu import sym
+    x = sym.var("data")
+    w = sym.var("w")
+    net = sym.sum(sym.relu(sym.FullyConnected(x, w, num_hidden=4,
+                                              no_bias=True)))
+    rs = onp.random.RandomState(0)
+    args = {"data": nd.array(rs.randn(2, 3).astype("float32")),
+            "w": nd.array(rs.randn(4, 3).astype("float32"))}
+
+    def run_grad():
+        grads = {k: nd.zeros(v.shape) for k, v in args.items()}
+        e = net.bind(mx.cpu(), dict(args), args_grad=grads)
+        e.forward(is_train=True)
+        e.backward()
+        return {k: v.asnumpy() for k, v in e.grad_dict.items()}
+
+    g_plain = run_grad()
+    config.set_flag("MXNET_BACKWARD_DO_MIRROR", True)
+    try:
+        g_mirror = run_grad()
+    finally:
+        config.unset_flag("MXNET_BACKWARD_DO_MIRROR")
+    for k in g_plain:
+        assert onp.allclose(g_plain[k], g_mirror[k], atol=1e-5)
+
+
+def test_subgraph_backend_env_bind():
+    """MXNET_SUBGRAPH_BACKEND partitions at bind time without changing
+    results."""
+    from mxnet_tpu import sym
+    x = sym.var("data")
+    w = sym.var("w")
+    net = sym.Activation(sym.FullyConnected(x, w, num_hidden=4,
+                                            no_bias=True),
+                         act_type="relu")
+    rs = onp.random.RandomState(1)
+    args = {"data": nd.array(rs.randn(2, 3).astype("float32")),
+            "w": nd.array(rs.randn(4, 3).astype("float32"))}
+    ref = net.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    config.set_flag("MXNET_SUBGRAPH_BACKEND", "XLA")
+    try:
+        e = net.bind(mx.cpu(), dict(args))
+        ops = [n.op for n in e._symbol._topo_nodes() if not n.is_variable]
+        assert "_subgraph_xla" in ops
+        got = e.forward()[0].asnumpy()
+    finally:
+        config.unset_flag("MXNET_SUBGRAPH_BACKEND")
+    assert onp.allclose(ref, got, atol=1e-5)
+
+
+def test_sgd_reads_aggregation_size():
+    config.set_flag("MXNET_OPTIMIZER_AGGREGATION_SIZE", 9)
+    try:
+        opt = mx.optimizer.SGD(learning_rate=0.1)
+        assert opt.aggregate_num == 9
+    finally:
+        config.unset_flag("MXNET_OPTIMIZER_AGGREGATION_SIZE")
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_multi_tensor_sgd_matches_single(momentum):
+    """The fused aggregated update must equal per-parameter updates
+    (ref: optimizer_op.cc multi_sgd_* vs sgd_*)."""
+    from mxnet_tpu.optimizer import SGD, get_updater
+    rs = onp.random.RandomState(5)
+    ws = [rs.randn(4, 3).astype("float32") for _ in range(5)]
+    gs = [rs.randn(4, 3).astype("float32") for _ in range(5)]
+
+    def run(aggregated):
+        opt = SGD(learning_rate=0.1, momentum=momentum, wd=0.01)
+        upd = get_updater(opt)
+        weights = [nd.array(w) for w in ws]
+        grads = [nd.array(g) for g in gs]
+        for step in range(3):
+            if aggregated:
+                upd(list(range(5)), grads, weights)
+            else:
+                for i in range(5):
+                    upd(i, grads[i], weights[i])
+        return [w.asnumpy() for w in weights]
+
+    for a, b in zip(run(True), run(False)):
+        assert onp.allclose(a, b, atol=1e-6)

@@ -1,0 +1,166 @@
+"""Reference binary .params format (ref: src/ndarray/ndarray.cc:1594-1860).
+
+The golden fixture below is handcrafted byte-by-byte from the reference
+layout (NOT via the code under test), so these tests pin the on-disk
+format: a reference-produced file must load, and save() must emit
+byte-identical output for the same content.
+"""
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+V2 = 0xF993FAC9
+
+
+def _shape_bytes(shape):
+    return struct.pack("<i", len(shape)) + (
+        struct.pack(f"<{len(shape)}q", *shape) if shape else b"")
+
+
+def _golden_dense():
+    """list(magic,reserved) | 1 ndarray | 1 name — fp32 (2,3) on cpu."""
+    a = onp.arange(6, dtype="float32").reshape(2, 3)
+    blob = b""
+    blob += struct.pack("<QQ", 0x112, 0)          # list magic + reserved
+    blob += struct.pack("<Q", 1)                  # ndarray count
+    blob += struct.pack("<I", V2)                 # per-array magic
+    blob += struct.pack("<i", 0)                  # stype dense
+    blob += _shape_bytes((2, 3))                  # shape int32 ndim + int64s
+    blob += struct.pack("<ii", 1, 0)              # Context (kCPU, 0)
+    blob += struct.pack("<i", 0)                  # type flag kFloat32
+    blob += a.tobytes()                           # raw data LE
+    blob += struct.pack("<Q", 1)                  # name count
+    name = b"arg:weight"
+    blob += struct.pack("<Q", len(name)) + name
+    return blob, a
+
+
+def test_golden_dense_load():
+    blob, a = _golden_dense()
+    out = nd.load_frombuffer(blob)
+    assert list(out.keys()) == ["arg:weight"]
+    assert onp.array_equal(out["arg:weight"].asnumpy(), a)
+
+
+def test_save_reproduces_golden_bytes(tmp_path):
+    blob, a = _golden_dense()
+    p = str(tmp_path / "g.params")
+    nd.save(p, {"arg:weight": nd.array(a)})
+    with open(p, "rb") as f:
+        written = f.read()
+    assert written == blob
+
+
+def test_round_trip_dtypes(tmp_path):
+    p = str(tmp_path / "t.params")
+    data = {
+        "f32": nd.array(onp.random.RandomState(0).randn(3, 4)
+                        .astype("float32")),
+        "f64": nd.array(onp.arange(4, dtype="float64")),
+        "f16": nd.array(onp.arange(4, dtype="float32")).astype("float16"),
+        "i32": nd.array(onp.arange(5, dtype="int32")),
+        "i64": nd.array(onp.arange(5, dtype="int64")),
+        "u8": nd.array(onp.arange(7, dtype="uint8")),
+        "i8": nd.array(onp.arange(7, dtype="int8")),
+    }
+    nd.save(p, data)
+    out = nd.load(p)
+    for k, v in data.items():
+        assert str(out[k].dtype) == str(v.dtype), k
+        assert onp.array_equal(out[k].asnumpy(), v.asnumpy()), k
+
+
+def test_round_trip_list_and_scalar(tmp_path):
+    p = str(tmp_path / "l.params")
+    nd.save(p, [nd.array(onp.ones((2, 2), "float32")),
+                nd.array(onp.asarray(3.5, "float32"))])
+    out = nd.load(p)
+    assert isinstance(out, list) and len(out) == 2
+    assert out[1].shape == ()
+    assert float(out[1].asscalar()) == 3.5
+
+
+def test_row_sparse_round_trip(tmp_path):
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    p = str(tmp_path / "rs.params")
+    vals = onp.asarray([[1, 2, 3], [4, 5, 6]], "float32")
+    idx = onp.asarray([1, 3], "int64")
+    rs = RowSparseNDArray(vals, idx, (5, 3))
+    nd.save(p, {"w": rs})
+    out = nd.load(p)["w"]
+    assert out.stype == "row_sparse"
+    assert onp.array_equal(out.indices.asnumpy().astype("int64"), idx)
+    assert onp.array_equal(out.data.asnumpy(), vals)
+    assert out.shape == (5, 3)
+
+
+def test_csr_round_trip(tmp_path):
+    from mxnet_tpu.ndarray.sparse import CSRNDArray
+    p = str(tmp_path / "csr.params")
+    data = onp.asarray([7.0, 8.0, 9.0], "float32")
+    indices = onp.asarray([0, 2, 1], "int64")
+    indptr = onp.asarray([0, 2, 2, 3], "int64")
+    m = CSRNDArray(data, indices, indptr, (3, 3))
+    nd.save(p, {"m": m})
+    out = nd.load(p)["m"]
+    assert out.stype == "csr"
+    assert onp.array_equal(out.data.asnumpy(), data)
+    assert onp.array_equal(out.indices.asnumpy().astype("int64"), indices)
+    assert onp.array_equal(out.indptr.asnumpy().astype("int64"), indptr)
+
+
+def test_legacy_v1_load():
+    """V1 magic: shape | ctx | type | data (ndarray.cc LegacyLoad)."""
+    a = onp.asarray([1.0, 2.0], "float32")
+    blob = struct.pack("<QQ", 0x112, 0)
+    blob += struct.pack("<Q", 1)
+    blob += struct.pack("<I", 0xF993FAC8)
+    blob += _shape_bytes((2,))
+    blob += struct.pack("<ii", 1, 0)
+    blob += struct.pack("<i", 0)
+    blob += a.tobytes()
+    blob += struct.pack("<Q", 0)                  # no names
+    out = nd.load_frombuffer(blob)
+    assert isinstance(out, list)
+    assert onp.array_equal(out[0].asnumpy(), a)
+
+
+def test_ancient_magic_is_ndim_load():
+    """Pre-V1: leading uint32 is ndim, dims are uint32."""
+    a = onp.asarray([[1, 2], [3, 4]], "float32")
+    blob = struct.pack("<QQ", 0x112, 0)
+    blob += struct.pack("<Q", 1)
+    blob += struct.pack("<I", 2)                  # ndim (acts as magic)
+    blob += struct.pack("<II", 2, 2)              # uint32 dims
+    blob += struct.pack("<ii", 1, 0)
+    blob += struct.pack("<i", 0)
+    blob += a.tobytes()
+    blob += struct.pack("<Q", 0)
+    out = nd.load_frombuffer(blob)
+    assert onp.array_equal(out[0].asnumpy(), a)
+
+
+def test_bad_magic_rejected():
+    with pytest.raises(mx.MXNetError):
+        nd.load_frombuffer(struct.pack("<QQ", 0xdead, 0))
+
+
+def test_module_checkpoint_uses_reference_format(tmp_path):
+    """save_checkpoint output starts with the reference list magic."""
+    x = mx.sym.var("data")
+    net = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    arg = {"fc_weight": nd.array(onp.ones((2, 3), "float32")),
+           "fc_bias": nd.zeros((2,))}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 1, net, arg, {})
+    with open(prefix + "-0001.params", "rb") as f:
+        head = f.read(16)
+    magic, reserved = struct.unpack("<QQ", head)
+    assert magic == 0x112 and reserved == 0
+    _, loaded_arg, _ = mx.model.load_checkpoint(prefix, 1)
+    assert onp.array_equal(loaded_arg["fc_weight"].asnumpy(),
+                           arg["fc_weight"].asnumpy())

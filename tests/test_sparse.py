@@ -1,0 +1,260 @@
+"""Sparse end-to-end: lazy containers, sparse kernels, row-sparse
+gradients, sparse optimizer updates, sparse-FM training convergence
+(ref: src/operator/tensor/dot-inl.h, optimizer_op.cc sparse paths,
+tests/python/train/test_sparse_fm.py)."""
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.ndarray.sparse import (CSRNDArray, RowSparseNDArray,
+                                      cast_storage, csr_matrix,
+                                      row_sparse_array)
+
+
+def _rand_csr(rs, m, n, density=0.1):
+    a = (rs.uniform(0, 1, (m, n)) < density) * \
+        rs.randn(m, n).astype("float32")
+    return a.astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# containers
+# ---------------------------------------------------------------------------
+
+def test_lazy_containers_do_not_densify():
+    rs = RowSparseNDArray(onp.ones((2, 3), "float32"),
+                          onp.array([1, 4], "int64"), (6, 3))
+    assert not rs.densified()
+    assert rs.shape == (6, 3) and str(rs.dtype) == "float32"
+    assert rs.indices.asnumpy().tolist() == [1, 4]  # payload access only
+    assert not rs.densified()
+    dense = rs.asnumpy()                            # dense view on demand
+    assert rs.densified()
+    assert onp.allclose(dense[1], 1) and onp.allclose(dense[0], 0)
+
+
+def test_csr_round_trip_and_slice():
+    rs = onp.random.RandomState(0)
+    a = _rand_csr(rs, 6, 8)
+    m = cast_storage(nd.array(a), "csr")
+    assert isinstance(m, CSRNDArray)
+    assert onp.allclose(m.asnumpy(), a)
+    s = m.slice(2, 5)
+    assert onp.allclose(s.asnumpy(), a[2:5])
+    back = cast_storage(m, "default")
+    assert onp.allclose(back.asnumpy(), a)
+
+
+def test_row_sparse_retain():
+    rs = row_sparse_array((onp.asarray([[1., 2.], [3., 4.]], "float32"),
+                           onp.asarray([0, 3], "int64")), shape=(5, 2))
+    kept = rs.retain(nd.array(onp.asarray([3, 4], "int64")))
+    assert kept.indices.asnumpy().tolist() == [3, 4]
+    got = kept.asnumpy()
+    assert onp.allclose(got[3], [3, 4]) and onp.allclose(got[4], 0)
+
+
+def test_storage_fallback_warns():
+    from mxnet_tpu.ndarray import sparse as sp
+    sp._fallback_warned.clear()
+    rs = row_sparse_array((onp.ones((1, 2), "float32"),
+                           onp.asarray([0], "int64")), shape=(3, 2))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nd.relu(rs)  # no sparse impl -> dense fallback
+    assert any("dense implementation" in str(x.message) for x in w)
+
+
+# ---------------------------------------------------------------------------
+# sparse kernels + gradients
+# ---------------------------------------------------------------------------
+
+def test_csr_dot_dense_forward_and_sparse_grad():
+    rs = onp.random.RandomState(1)
+    a = _rand_csr(rs, 8, 10, 0.3)
+    w = rs.randn(10, 4).astype("float32")
+    x = cast_storage(nd.array(a), "csr")
+    wv = nd.array(w)
+    wv.attach_grad(stype="row_sparse")
+    with autograd.record():
+        out = nd.dot(x, wv)
+        loss = (out * out).sum()
+    assert onp.allclose(out.asnumpy(), a @ w, atol=1e-5)
+    loss.backward()
+    g = wv.grad
+    assert g.stype == "row_sparse"
+    dense_ref = a.T @ (2 * (a @ w))
+    assert onp.allclose(g.asnumpy(), dense_ref, atol=1e-4)
+    # rows for absent columns must not appear in the payload
+    nz_cols = set(onp.nonzero(a)[1].tolist())
+    assert set(g.indices.asnumpy().tolist()) <= nz_cols
+
+
+def test_csr_dot_transpose_a():
+    rs = onp.random.RandomState(2)
+    a = _rand_csr(rs, 6, 9, 0.4)
+    r = rs.randn(6, 3).astype("float32")
+    x = cast_storage(nd.array(a), "csr")
+    out = nd.dot(x, nd.array(r), transpose_a=True)
+    assert out.stype == "row_sparse"
+    assert onp.allclose(out.asnumpy(), a.T @ r, atol=1e-5)
+
+
+def test_square_sum_row_sparse():
+    v = row_sparse_array((onp.asarray([[1., 2.], [3., 4.]], "float32"),
+                          onp.asarray([1, 3], "int64")), shape=(5, 2))
+    out = nd._square_sum(v, axis=1, keepdims=True)
+    assert out.stype == "row_sparse"
+    assert out.shape == (5, 1)
+    assert onp.allclose(out.data.asnumpy().ravel(), [5., 25.])
+
+
+def test_embedding_sparse_grad():
+    rs = onp.random.RandomState(3)
+    w = rs.randn(20, 4).astype("float32")
+    weight = nd.array(w)
+    weight.attach_grad(stype="row_sparse")
+    ids = nd.array(onp.asarray([[1, 3], [3, 7]], "float32"))
+    with autograd.record():
+        emb = nd.Embedding(ids, weight, input_dim=20, output_dim=4,
+                           sparse_grad=True)
+        loss = emb.sum()
+    loss.backward()
+    g = weight.grad
+    assert g.stype == "row_sparse"
+    assert sorted(g.indices.asnumpy().tolist()) == [1, 3, 7]
+    dense = g.asnumpy()
+    assert onp.allclose(dense[3], 2.0)  # id 3 appears twice, grads sum
+    assert onp.allclose(dense[1], 1.0) and onp.allclose(dense[0], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# sparse optimizer updates: only live rows touched
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt_name,kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.0}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.1}),
+])
+def test_sparse_update_touches_only_live_rows(opt_name, kwargs):
+    from mxnet_tpu.optimizer import create, get_updater
+    rs = onp.random.RandomState(4)
+    w0 = rs.randn(10, 3).astype("float32")
+    weight = nd.array(w0)
+    grad = RowSparseNDArray(onp.ones((2, 3), "float32"),
+                            onp.asarray([2, 5], "int64"), (10, 3))
+    upd = get_updater(create(opt_name, **kwargs))
+    for _ in range(2):
+        upd(0, grad, weight)
+    w1 = weight.asnumpy()
+    untouched = [r for r in range(10) if r not in (2, 5)]
+    assert onp.allclose(w1[untouched], w0[untouched]), \
+        "rows without gradient must not move"
+    assert not onp.allclose(w1[2], w0[2])
+    # row math matches the dense optimizer on the same rows
+    from mxnet_tpu.optimizer import create as create2, get_updater as gu2
+    wd = nd.array(w0)
+    upd_d = gu2(create2(opt_name, **kwargs))
+    for _ in range(2):
+        upd_d(0, nd.array(grad.asnumpy()), wd)
+    assert onp.allclose(w1[[2, 5]], wd.asnumpy()[[2, 5]], atol=1e-5)
+
+
+def test_sparse_update_on_row_sparse_weight():
+    """row_sparse WEIGHT storage: the update runs on the compact payload
+    (values), never on the dense view."""
+    from mxnet_tpu.optimizer import SGD, get_updater
+    w0 = onp.random.RandomState(5).randn(8, 2).astype("float32")
+    weight = RowSparseNDArray(w0, onp.arange(8, dtype="int64"), (8, 2))
+    grad = RowSparseNDArray(onp.ones((2, 2), "float32"),
+                            onp.asarray([1, 6], "int64"), (8, 2))
+    upd = get_updater(SGD(learning_rate=0.5))
+    upd(0, grad, weight)
+    assert not weight.densified()
+    got = weight.data.asnumpy()
+    assert onp.allclose(got[1], w0[1] - 0.5)
+    assert onp.allclose(got[0], w0[0])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.array(onp.arange(12, dtype="float32").reshape(6, 2)))
+    out = nd.zeros((6, 2))
+    kv.row_sparse_pull("emb", out=out,
+                       row_ids=nd.array(onp.asarray([1, 4], "int64")))
+    got = out.asnumpy()
+    assert onp.allclose(got[1], [2, 3]) and onp.allclose(got[4], [8, 9])
+    assert onp.allclose(got[0], 0)
+
+
+# ---------------------------------------------------------------------------
+# the convergence gate: sparse FM (ref: tests/python/train/test_sparse_fm.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt_name,kwargs,gate", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "clip_gradient": 5.0},
+     0.4),
+    ("adam", {"learning_rate": 0.02, "clip_gradient": 5.0}, 0.25),
+    ("adagrad", {"learning_rate": 0.1, "clip_gradient": 5.0}, 0.25),
+])
+def test_factorization_machine_training(opt_name, kwargs, gate):
+    """FM with csr inputs + row-sparse weight grads trains to low loss;
+    never-activated feature rows stay exactly at init."""
+    from mxnet_tpu.optimizer import create, get_updater
+    rs = onp.random.RandomState(0)
+    feature_dim, factor_size, batch, n_batches = 200, 4, 32, 8
+    X = _rand_csr(rs, batch * n_batches, feature_dim, 0.05)
+    true_w = rs.randn(feature_dim, 1).astype("float32")
+    y = X @ true_w  # linear ground truth: FM can fit it
+
+    w1 = nd.array(rs.randn(feature_dim, 1).astype("float32") * 0.01)
+    v = nd.array(rs.randn(feature_dim, factor_size).astype("float32") * 0.01)
+    bias = nd.array(onp.zeros((1,), "float32"))
+    w1_0, v_0 = w1.asnumpy().copy(), v.asnumpy().copy()
+    for p in (w1, v):
+        p.attach_grad(stype="row_sparse")
+    bias.attach_grad()
+
+    opt = create(opt_name, rescale_grad=1.0 / batch, **kwargs)
+    upd = get_updater(opt)
+
+    def fm_forward(xb):
+        t1 = nd.dot(xb, w1) + bias
+        xv = nd.dot(xb, v)                       # (b, k)
+        t2 = 0.5 * nd.sum(xv * xv, axis=1, keepdims=True)
+        x2 = nd.square(xb)                       # csr
+        v2 = nd.sum(v * v, axis=1, keepdims=True)
+        t3 = 0.5 * nd.dot(x2, v2)
+        return t1 + t2 - t3
+
+    losses = []
+    for epoch in range(15):
+        total = 0.0
+        for b in range(n_batches):
+            xb = cast_storage(
+                nd.array(X[b * batch:(b + 1) * batch]), "csr")
+            yb = nd.array(y[b * batch:(b + 1) * batch])
+            with autograd.record():
+                pred = fm_forward(xb)
+                loss = nd.sum(nd.square(pred - yb)) / batch
+            loss.backward()
+            assert w1.grad.stype == "row_sparse"
+            upd(0, w1.grad, w1)
+            upd(2, bias.grad, bias)
+            total += float(loss.asscalar())
+        losses.append(total / n_batches)
+    assert losses[-1] < gate * losses[0], \
+        f"FM({opt_name}) did not converge: {losses[0]:.4f} -> " \
+        f"{losses[-1]:.4f}"
+
+    # features never active in the data: their w1 rows never moved
+    active = set(onp.nonzero(X)[1].tolist())
+    dead = [r for r in range(feature_dim) if r not in active]
+    if dead:
+        assert onp.allclose(w1.asnumpy()[dead], w1_0[dead]), \
+            "inactive feature rows must stay at init (sparse update)"

@@ -43,10 +43,14 @@ def main(argv=None):
         parser.error("no command given")
 
     coordinator = f"localhost:{_free_port()}"
+    # parameter-server endpoint for async kvstore types (rank 0 binds it,
+    # ref role: DMLC_PS_ROOT_URI of the ps-lite tracker)
+    kv_server = f"127.0.0.1:{_free_port()}"
     procs = []
     for rank in range(args.num_workers):
         env = dict(os.environ)
         env["MX_COORDINATOR"] = coordinator
+        env["MX_KV_SERVER"] = kv_server
         env["MX_NUM_WORKERS"] = str(args.num_workers)
         env["MX_WORKER_ID"] = str(rank)
         for kv in args.env:
