@@ -225,9 +225,8 @@ register_flag(
     "(ref: env_var.md MXNET_OPTIMIZER_AGGREGATION_SIZE).")
 register_flag(
     "MXNET_MP_WORKER_NTHREADS", int, 4,
-    "Default worker count for multiprocess data loading "
-    "(ref: env_var.md:60).", active=False,
-    tpu_note="takes effect when DataLoader multiprocess workers land")
+    "Per-worker decode thread cap in multiprocess DataLoader workers "
+    "(ref: env_var.md:60).")
 register_flag(
     "MXNET_CPU_WORKER_NTHREADS", int, 1,
     "Host-side worker threads for the native IO pipeline "

@@ -1,102 +1,366 @@
-"""ONNX interop.
+"""ONNX interop: functional export and import, no onnx package needed.
 
-ref: python/mxnet/contrib/onnx/ — import_model/export_model over the
-symbol graph. The onnx package is not part of this image; the graph walk
-is implemented and gated on `import onnx` so environments that have it get
-working export of the core op set, and others get a clear error.
+ref: python/mxnet/contrib/onnx/ — `export_model` (mx2onnx/
+_export_onnx.py + _op_translations.py) and `import_model` (onnx2mx/).
+The serialization layer is the self-contained wire-format codec in
+onnx_proto.py; this module does the graph translation for the core op
+set (the same families the reference's translation table covers).
 """
 from __future__ import annotations
 
+from typing import Dict, List, Optional, Tuple
+
+import numpy as onp
+
 from ..base import MXNetError
+from . import onnx_proto as proto
 
 __all__ = ["export_model", "import_model", "get_model_metadata"]
 
-# Symbol-op → ONNX-op for the core set (ref: contrib/onnx/mx2onnx/
-# _op_translations.py — the reference's table covers the same families)
-_MX2ONNX = {
-    "FullyConnected": "Gemm", "Convolution": "Conv", "Activation": None,
-    "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
-    "softmax": "Softmax", "Pooling": None, "Flatten": "Flatten",
-    "BatchNorm": "BatchNormalization", "Concat": "Concat",
-    "Dropout": "Dropout", "elemwise_add": "Add", "broadcast_add": "Add",
-    "broadcast_mul": "Mul", "reshape": "Reshape", "transpose": "Transpose",
-    "LayerNorm": "LayerNormalization",
-}
+
+def _attr_tuple(v, n=None):
+    if isinstance(v, str):
+        v = eval(v, {"__builtins__": {}})  # noqa: S307 (symbol json attrs)
+    if isinstance(v, (int, float)):
+        v = (int(v),) * (n or 1)
+    return tuple(int(x) for x in v)
 
 
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-        return onnx
-    except ImportError as e:
-        raise MXNetError(
-            "onnx is not installed in this environment; ONNX import/export "
-            "is gated (install onnx to enable)") from e
-
+# ---------------------------------------------------------------------------
+# export: Symbol graph -> ONNX
+# ---------------------------------------------------------------------------
 
 def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """ref: contrib/onnx/mx2onnx/export_model.py."""
-    onnx = _require_onnx()
-    from onnx import helper, TensorProto
-
+                 onnx_file_path="model.onnx", verbose=False,
+                 opset_version=17):
+    """Export (symbol, params) to an .onnx file
+    (ref: contrib/onnx/mx2onnx/export_model.py)."""
+    from ..ndarray.ndarray import NDArray
     if isinstance(sym, str):
-        from ..symbol import symbol as sym_mod
-        sym = sym_mod.load(sym)
-    nodes = []
-    initializers = []
-    inputs = []
+        from ..symbol import symbol as sym_mod2
+        sym = sym_mod2.load(sym)
+    if isinstance(input_shape, tuple):
+        input_shape = [input_shape]
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+    np_params = {k: (v.asnumpy() if isinstance(v, NDArray)
+                     else onp.asarray(v)) for k, v in params.items()}
+
+    nodes_b: List[bytes] = []
+    initializers: List[bytes] = []
     arg_names = sym.list_arguments()
-    for node in sym._topo_nodes():
-        if node.is_variable:
-            shape = None
-            if isinstance(params, dict) and node.name in params:
-                arr = params[node.name].asnumpy()
-                initializers.append(helper.make_tensor(
-                    node.name, TensorProto.FLOAT, arr.shape,
-                    arr.astype("float32").ravel()))
-            else:
-                inputs.append(helper.make_tensor_value_info(
-                    node.name, TensorProto.FLOAT,
-                    list(input_shape[0]) if input_shape else None))
+    aux_names = sym.list_auxiliary_states()
+    data_names = [n for n in arg_names if n not in np_params]
+    if len(data_names) != len(input_shape):
+        raise MXNetError(f"got {len(input_shape)} input shapes for "
+                         f"{len(data_names)} data inputs {data_names}")
+
+    for k, v in np_params.items():
+        if k in arg_names or k in aux_names:
+            initializers.append(proto.tensor(k, v.astype(
+                "float32" if v.dtype not in (onp.int64, onp.int32)
+                else v.dtype)))
+
+    name_of: Dict[Tuple[int, int], str] = {}
+
+    def entry_name(entry):
+        node_, oi = entry
+        if node_.is_variable:
+            return node_.name
+        return name_of[(id(node_), oi)]
+
+    topo = sym._topo_nodes()
+    for nd_ in topo:
+        if nd_.is_variable:
             continue
-        onnx_op = _MX2ONNX.get(node.op)
-        if onnx_op is None and node.op == "Activation":
-            onnx_op = {"relu": "Relu", "sigmoid": "Sigmoid",
-                       "tanh": "Tanh"}[node.params.get("act_type", "relu")]
-        elif onnx_op is None and node.op == "Pooling":
-            onnx_op = "MaxPool" if node.params.get(
-                "pool_type", "max") == "max" else "AveragePool"
-        if onnx_op is None:
-            raise MXNetError(f"op {node.op} has no ONNX translation yet")
-        nodes.append(helper.make_node(
-            onnx_op, [i.name for i, _ in node.inputs], [node.name],
-            name=node.name))
-    outputs = [helper.make_tensor_value_info(n, TensorProto.FLOAT, None)
-               for n, _ in [(e[0].name, 0) for e in sym._outputs]]
-    graph = helper.make_graph(nodes, "mxnet_tpu_model", inputs, outputs,
-                              initializer=initializers)
-    model = helper.make_model(graph)
-    onnx.save(model, onnx_file_path)
+        op = nd_.op
+        p = {k: v for k, v in nd_.params.items()
+             if not k.startswith("_")}
+        ins = [entry_name(e) for e in nd_.inputs]
+        outs = [f"{nd_.name}_out{i}" if nd_._n_out > 1 else nd_.name
+                for i in range(nd_._n_out)]
+        for i in range(nd_._n_out):
+            name_of[(id(nd_), i)] = outs[i]
+        nodes_b.extend(_export_node(op, nd_.name, ins, outs, p,
+                                    np_params, initializers))
+
+    out_names = [entry_name(e) for e in sym._outputs]
+    # infer output shapes for the graph signature
+    try:
+        _, out_shapes, _ = sym.infer_shape(
+            **{n: s for n, s in zip(data_names, input_shape)},
+            **{k: v.shape for k, v in np_params.items()
+               if k in arg_names})
+        out_shapes = out_shapes or [()] * len(out_names)
+    except Exception:
+        out_shapes = [()] * len(out_names)
+    inputs_b = [proto.value_info(n, tuple(s))
+                for n, s in zip(data_names, input_shape)]
+    outputs_b = [proto.value_info(n, tuple(s) if s else ())
+                 for n, s in zip(out_names, out_shapes)]
+    g = proto.graph(nodes_b, "mxnet_tpu_model", initializers, inputs_b,
+                    outputs_b)
+    blob = proto.model(g, opset=opset_version)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
     return onnx_file_path
 
 
+def _export_node(op, name, ins, outs, p, np_params, initializers):
+    """Translate one symbol node; may emit several ONNX nodes
+    (ref: mx2onnx/_op_translations.py)."""
+    N = proto.node
+
+    def truthy(v):
+        return str(v) in ("True", "1", "true")
+
+    if op == "FullyConnected":
+        attrs = {"alpha": 1.0, "beta": 1.0, "transB": 1}
+        if truthy(p.get("no_bias", False)):
+            zname = f"{name}_zero_bias"
+            nh = int(p["num_hidden"])
+            initializers.append(proto.tensor(
+                zname, onp.zeros((nh,), "float32")))
+            return [N("Gemm", ins[:2] + [zname], outs, name, attrs)]
+        return [N("Gemm", ins[:3], outs, name, attrs)]
+    if op == "Convolution":
+        kernel = _attr_tuple(p["kernel"])
+        attrs = {"kernel_shape": kernel,
+                 "strides": _attr_tuple(p.get("stride", 1), len(kernel)),
+                 "pads": _attr_tuple(p.get("pad", 0), len(kernel)) * 2,
+                 "dilations": _attr_tuple(p.get("dilate", 1), len(kernel)),
+                 "group": int(p.get("num_group", 1))}
+        keep = 2 if truthy(p.get("no_bias", False)) else 3
+        return [N("Conv", ins[:keep], outs, name, attrs)]
+    if op == "Activation":
+        act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus", "softsign": "Softsign"}[
+                   p.get("act_type", "relu")]
+        return [N(act, ins[:1], outs, name)]
+    simple = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+              "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+              "negative": "Neg", "floor": "Floor", "ceil": "Ceil",
+              "erf": "Erf"}
+    if op in simple:
+        return [N(simple[op], ins[:1], outs, name)]
+    if op in ("softmax", "log_softmax"):
+        attrs = {"axis": int(p.get("axis", -1))}
+        return [N("Softmax" if op == "softmax" else "LogSoftmax",
+                  ins[:1], outs, name, attrs)]
+    if op == "Pooling":
+        kernel = _attr_tuple(p.get("kernel", 1))
+        ptype = p.get("pool_type", "max")
+        if truthy(p.get("global_pool", False)):
+            return [N("GlobalMaxPool" if ptype == "max"
+                      else "GlobalAveragePool", ins[:1], outs, name)]
+        attrs = {"kernel_shape": kernel,
+                 "strides": _attr_tuple(p.get("stride", 1), len(kernel)),
+                 "pads": _attr_tuple(p.get("pad", 0), len(kernel)) * 2}
+        return [N("MaxPool" if ptype == "max" else "AveragePool",
+                  ins[:1], outs, name, attrs)]
+    if op == "BatchNorm":
+        attrs = {"epsilon": float(p.get("eps", 1e-3)),
+                 "momentum": float(p.get("momentum", 0.9))}
+        # onnx operand order: X, scale, B, mean, var — matches the
+        # symbol's (data, gamma, beta, moving_mean, moving_var)
+        return [N("BatchNormalization", ins[:5], outs[:1], name, attrs)]
+    if op == "LayerNorm":
+        attrs = {"axis": int(p.get("axis", -1)),
+                 "epsilon": float(p.get("eps", 1e-5))}
+        return [N("LayerNormalization", ins[:3], outs[:1], name, attrs)]
+    if op == "Flatten":
+        return [N("Flatten", ins[:1], outs, name, {"axis": 1})]
+    if op == "Concat":
+        return [N("Concat", ins, outs, name,
+                  {"axis": int(p.get("dim", 1))})]
+    if op == "Dropout":
+        return [N("Identity", ins[:1], outs[:1], name)]  # inference
+    if op in ("elemwise_add", "broadcast_add", "_plus", "_Plus"):
+        return [N("Add", ins[:2], outs, name)]
+    if op in ("elemwise_sub", "broadcast_sub"):
+        return [N("Sub", ins[:2], outs, name)]
+    if op in ("elemwise_mul", "broadcast_mul"):
+        return [N("Mul", ins[:2], outs, name)]
+    if op in ("elemwise_div", "broadcast_div"):
+        return [N("Div", ins[:2], outs, name)]
+    if op in ("reshape", "Reshape"):
+        shp = _attr_tuple(p.get("shape", ()))
+        sname = f"{name}_shape"
+        initializers.append(proto.tensor(
+            sname, onp.asarray(shp, "int64")))
+        return [N("Reshape", ins[:1] + [sname], outs, name)]
+    if op == "transpose":
+        axes = p.get("axes")
+        attrs = {"perm": _attr_tuple(axes)} if axes else {}
+        return [N("Transpose", ins[:1], outs, name, attrs)]
+    if op == "SoftmaxOutput":
+        return [N("Softmax", ins[:1], outs[:1], name, {"axis": -1})]
+    if op in ("mean", "sum", "max", "min"):
+        attrs = {"keepdims": int(truthy(p.get("keepdims", False)))}
+        axis = p.get("axis")
+        if op == "sum":
+            # opset >= 13: ReduceSum takes axes as an INPUT tensor, not
+            # an attribute (the other Reduce* move at opset 18)
+            op_ins = ins[:1]
+            if axis is not None:
+                aname = f"{name}_axes"
+                initializers.append(proto.tensor(
+                    aname, onp.asarray(_attr_tuple(axis), "int64")))
+                op_ins = ins[:1] + [aname]
+            return [N("ReduceSum", op_ins, outs, name, attrs)]
+        if axis is not None:
+            attrs["axes"] = _attr_tuple(axis)
+        return [N({"mean": "ReduceMean", "max": "ReduceMax",
+                   "min": "ReduceMin"}[op], ins[:1], outs, name, attrs)]
+    if op == "Embedding":
+        return [N("Gather", [ins[1], ins[0]], outs, name)]
+    raise MXNetError(f"ONNX export: unsupported op '{op}' "
+                     "(ref table: contrib/onnx/mx2onnx/_op_translations)")
+
+
+# ---------------------------------------------------------------------------
+# import: ONNX -> Symbol graph + params
+# ---------------------------------------------------------------------------
+
 def import_model(model_file):
-    """ref: contrib/onnx/onnx2mx/import_model.py."""
-    _require_onnx()
-    raise MXNetError("ONNX import: supported when onnx is installed; "
-                     "translation table pending (export is available)")
+    """Returns (sym, arg_params, aux_params)
+    (ref: contrib/onnx/onnx2mx/import_model.py)."""
+    from .. import symbol as sym_mod
+    from ..ndarray.ndarray import array as nd_array
+
+    with open(model_file, "rb") as f:
+        g = proto.decode_model(f.read())
+
+    values: Dict[str, object] = {}
+    aux_params: Dict[str, object] = {}
+    for k in g["initializers"]:
+        values[k] = sym_mod.var(k)
+    for name, shape, dtype in g["inputs"]:
+        if name not in values:
+            values[name] = sym_mod.var(name)
+
+    for n in g["nodes"]:
+        outs = _import_node(n, values, g["initializers"], sym_mod)
+        for out_name, s in zip(n["outputs"], outs):
+            values[out_name] = s
+
+    # materialize AFTER the walk: node translation may re-layout
+    # initializers (Gemm transB=0)
+    arg_params = {k: nd_array(arr) for k, arr in g["initializers"].items()}
+    out_syms = [values[name] for name, _, _ in g["outputs"]]
+    s = out_syms[0] if len(out_syms) == 1 else sym_mod.Group(out_syms)
+    return s, arg_params, aux_params
+
+
+def _import_node(n, values, inits, sym_mod):
+    op = n["op_type"]
+    a = n["attrs"]
+    ins = [values[i] for i in n["inputs"] if i]
+
+    simple = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+              "Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Abs": "abs",
+              "Neg": "negative", "Floor": "floor", "Ceil": "ceil",
+              "Erf": "erf"}
+    if op in simple:
+        return [getattr(sym_mod, simple[op])(ins[0])]
+    if op == "Softplus":
+        return [sym_mod.Activation(ins[0], act_type="softrelu")]
+    if op == "Identity":
+        return [ins[0] + 0.0]
+    if op in ("Add", "Sub", "Mul", "Div"):
+        fn = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+              "Mul": "broadcast_mul", "Div": "broadcast_div"}[op]
+        return [getattr(sym_mod, fn)(ins[0], ins[1])]
+    if op in ("Softmax", "LogSoftmax"):
+        fn = "softmax" if op == "Softmax" else "log_softmax"
+        return [getattr(sym_mod, fn)(ins[0],
+                                     axis=int(a.get("axis", -1)))]
+    if op == "Gemm":
+        # FullyConnected implies transB=1 (weight stored (out, in));
+        # other Gemm layouts are handled where possible, refused loudly
+        # where not (silent wrong numbers are worse)
+        if int(a.get("transA", 0)):
+            raise MXNetError("ONNX import: Gemm transA=1 unsupported")
+        if float(a.get("alpha", 1.0)) != 1.0 or \
+                float(a.get("beta", 1.0)) != 1.0:
+            raise MXNetError("ONNX import: Gemm alpha/beta != 1 "
+                             "unsupported")
+        w_name = n["inputs"][1]
+        if not int(a.get("transB", 0)):
+            if w_name not in inits:
+                raise MXNetError("ONNX import: Gemm transB=0 with "
+                                 "non-initializer weight unsupported")
+            # re-layout to FullyConnected's (out, in); arg_params are
+            # materialized from inits after the node walk
+            inits[w_name] = onp.ascontiguousarray(inits[w_name].T)
+        num_hidden = int(inits[w_name].shape[0]) if w_name in inits \
+            else 0
+        return [sym_mod.FullyConnected(
+            *ins[:3], num_hidden=num_hidden, no_bias=len(ins) < 3)]
+    if op == "Conv":
+        kernel = tuple(a["kernel_shape"])
+        w_name = n["inputs"][1]
+        num_filter = int(inits[w_name].shape[0]) if w_name in inits else 0
+        pads = tuple(a.get("pads", (0,) * (2 * len(kernel))))
+        return [sym_mod.Convolution(
+            *ins, kernel=kernel, num_filter=num_filter,
+            stride=tuple(a.get("strides", (1,) * len(kernel))),
+            pad=pads[:len(kernel)],
+            dilate=tuple(a.get("dilations", (1,) * len(kernel))),
+            num_group=int(a.get("group", 1)),
+            no_bias=len(ins) < 3)]
+    if op in ("MaxPool", "AveragePool"):
+        kernel = tuple(a["kernel_shape"])
+        pads = tuple(a.get("pads", (0,) * (2 * len(kernel))))
+        return [sym_mod.Pooling(
+            ins[0], kernel=kernel,
+            pool_type="max" if op == "MaxPool" else "avg",
+            stride=tuple(a.get("strides", (1,) * len(kernel))),
+            pad=pads[:len(kernel)])]
+    if op in ("GlobalMaxPool", "GlobalAveragePool"):
+        return [sym_mod.Pooling(
+            ins[0], kernel=(1, 1), global_pool=True,
+            pool_type="max" if op == "GlobalMaxPool" else "avg")]
+    if op == "BatchNormalization":
+        return [sym_mod.BatchNorm(
+            *ins[:5], eps=float(a.get("epsilon", 1e-5)),
+            momentum=float(a.get("momentum", 0.9)), fix_gamma=False)]
+    if op == "LayerNormalization":
+        return [sym_mod.LayerNorm(*ins[:3],
+                                  axis=int(a.get("axis", -1)),
+                                  eps=float(a.get("epsilon", 1e-5)))]
+    if op == "Flatten":
+        return [sym_mod.Flatten(ins[0])]
+    if op == "Concat":
+        return [sym_mod.concat(*ins, dim=int(a.get("axis", 1)))]
+    if op == "Reshape":
+        shape_name = n["inputs"][1]
+        shp = tuple(int(x) for x in inits[shape_name].ravel())
+        return [sym_mod.reshape(ins[0], shape=shp)]
+    if op == "Transpose":
+        perm = a.get("perm")
+        return [sym_mod.transpose(ins[0],
+                                  axes=tuple(perm) if perm else None)]
+    if op in ("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin"):
+        fn = {"ReduceMean": "mean", "ReduceSum": "sum",
+              "ReduceMax": "max", "ReduceMin": "min"}[op]
+        axes = a.get("axes")
+        if axes is None and len(n["inputs"]) > 1:
+            # opset>=13 ReduceSum carries axes as a tensor input
+            ax_name = n["inputs"][1]
+            if ax_name in inits:
+                axes = [int(x) for x in inits[ax_name].ravel()]
+        return [getattr(sym_mod, fn)(
+            ins[0], axis=tuple(axes) if axes else None,
+            keepdims=bool(a.get("keepdims", 1)))]  # ONNX default is 1
+    if op == "Gather":
+        if int(a.get("axis", 0)) != 0:
+            raise MXNetError("ONNX import: Gather axis != 0 unsupported")
+        return [sym_mod.take(ins[0], ins[1])]
+    raise MXNetError(f"ONNX import: unsupported op '{op}'")
 
 
 def get_model_metadata(model_file):
-    onnx = _require_onnx()
-    model = onnx.load(model_file)
-    graph = model.graph
-    return {
-        "input_tensor_data": [(i.name, tuple(
-            d.dim_value for d in i.type.tensor_type.shape.dim))
-            for i in graph.input],
-        "output_tensor_data": [(o.name, tuple(
-            d.dim_value for d in o.type.tensor_type.shape.dim))
-            for o in graph.output],
-    }
+    with open(model_file, "rb") as f:
+        g = proto.decode_model(f.read())
+    return {"input_tensor_data": [(n, s) for n, s, _ in g["inputs"]],
+            "output_tensor_data": [(n, s) for n, s, _ in g["outputs"]]}

@@ -1,0 +1,367 @@
+"""Minimal ONNX protobuf wire-format codec (no onnx/protoc dependency).
+
+The reference ships a functional ONNX import/export
+(ref: python/mxnet/contrib/onnx/ — mx2onnx/_export_onnx.py and
+onnx2mx/import_model.py) built on the `onnx` package. That package is
+not in this image, so this module encodes/decodes the ONNX message
+subset the exporter needs directly in protobuf wire format (the field
+numbers below are the stable public onnx.proto3 schema, IR version 7 /
+opset 13 era): ModelProto, GraphProto, NodeProto, AttributeProto,
+TensorProto, ValueInfoProto, TypeProto, TensorShapeProto.
+
+Files produced here are standard .onnx protobufs readable by onnxruntime
+/ netron; files produced by standard exporters load back through
+`decode_model`.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as onp
+
+# TensorProto.DataType
+DT_FLOAT, DT_UINT8, DT_INT8, DT_INT32, DT_INT64 = 1, 2, 3, 6, 7
+DT_FLOAT16, DT_DOUBLE, DT_BOOL, DT_BFLOAT16 = 10, 11, 9, 16
+_NP2DT = {"float32": DT_FLOAT, "float64": DT_DOUBLE, "float16": DT_FLOAT16,
+          "uint8": DT_UINT8, "int8": DT_INT8, "int32": DT_INT32,
+          "int64": DT_INT64, "bool": DT_BOOL, "bfloat16": DT_BFLOAT16}
+_DT2NP = {v: k for k, v in _NP2DT.items()}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR = 1, 2, 3, 4
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+
+# -- low-level wire encoding -------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _f_varint(field: int, v: int) -> bytes:
+    return _varint((field << 3) | 0) + _varint(int(v))
+
+
+def _f_bytes(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _f_str(field: int, s: str) -> bytes:
+    return _f_bytes(field, s.encode())
+
+
+def _f_float(field: int, v: float) -> bytes:
+    return _varint((field << 3) | 5) + struct.pack("<f", float(v))
+
+
+def _packed_floats(field: int, vals) -> bytes:
+    return _f_bytes(field, struct.pack(f"<{len(vals)}f", *vals))
+
+
+def _packed_varints(field: int, vals) -> bytes:
+    return _f_bytes(field, b"".join(_varint(int(v)) for v in vals))
+
+
+# -- message builders --------------------------------------------------------
+
+def tensor(name: str, arr: onp.ndarray) -> bytes:
+    arr = onp.ascontiguousarray(arr)
+    dt = _NP2DT[str(arr.dtype)]
+    out = b""
+    out += _packed_varints(1, arr.shape)          # dims
+    out += _f_varint(2, dt)                       # data_type
+    out += _f_str(8, name)                        # name
+    out += _f_bytes(9, arr.tobytes())             # raw_data
+    return out
+
+
+def attribute(name: str, value) -> bytes:
+    out = _f_str(1, name)
+    if isinstance(value, bool):
+        out += _f_varint(3, int(value)) + _f_varint(20, AT_INT)
+    elif isinstance(value, int):
+        out += _f_varint(3, value) + _f_varint(20, AT_INT)
+    elif isinstance(value, float):
+        out += _f_float(2, value) + _f_varint(20, AT_FLOAT)
+    elif isinstance(value, str):
+        out += _f_bytes(4, value.encode()) + _f_varint(20, AT_STRING)
+    elif isinstance(value, onp.ndarray):
+        out += _f_bytes(5, tensor("", value)) + _f_varint(20, AT_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                out += _f_float(7, v)
+            out += _f_varint(20, AT_FLOATS)
+        elif value and isinstance(value[0], str):
+            for v in value:
+                out += _f_bytes(9, v.encode())
+            out += _f_varint(20, AT_STRINGS)
+        else:
+            for v in value:
+                out += _f_varint(8, int(v))
+            out += _f_varint(20, AT_INTS)
+    else:
+        raise TypeError(f"unsupported attribute value {value!r}")
+    return out
+
+
+def node(op_type: str, inputs: List[str], outputs: List[str],
+         name: str = "", attrs: Dict[str, Any] = None) -> bytes:
+    out = b""
+    for i in inputs:
+        out += _f_str(1, i)
+    for o in outputs:
+        out += _f_str(2, o)
+    out += _f_str(3, name or outputs[0])
+    out += _f_str(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += _f_bytes(5, attribute(k, v))
+    return out
+
+
+def value_info(name: str, shape: Tuple[int, ...],
+               dtype: str = "float32") -> bytes:
+    dims = b"".join(_f_bytes(1, _f_varint(1, d)) for d in shape)
+    tensor_type = _f_varint(1, _NP2DT[dtype]) + _f_bytes(2, dims)
+    type_proto = _f_bytes(1, tensor_type)
+    return _f_str(1, name) + _f_bytes(2, type_proto)
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    out = b""
+    for n in nodes:
+        out += _f_bytes(1, n)
+    out += _f_str(2, name)
+    for t in initializers:
+        out += _f_bytes(5, t)
+    for i in inputs:
+        out += _f_bytes(11, i)
+    for o in outputs:
+        out += _f_bytes(12, o)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 13,
+          producer: str = "mxnet_tpu") -> bytes:
+    opset_id = _f_varint(2, opset)                # OperatorSetId.version
+    out = _f_varint(1, 7)                         # ir_version 7
+    out += _f_str(2, producer)
+    out += _f_bytes(7, graph_bytes)
+    out += _f_bytes(8, opset_id)
+    return out
+
+
+# -- decoding ----------------------------------------------------------------
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _s64(v: int) -> int:
+    """Protobuf int64 varints are two's complement in 64 bits."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _decode_packed_varints(payload: bytes) -> List[int]:
+    out, pos = [], 0
+    while pos < len(payload):
+        v, pos = _read_varint(payload, pos)
+        out.append(v)
+    return out
+
+
+def decode_tensor(buf: bytes):
+    dims, dt, name, raw = [], DT_FLOAT, "", b""
+    floats: List[float] = []
+    int64s: List[int] = []
+    for field, wire, val in _iter_fields(buf):
+        if field == 1:
+            dims.extend(_decode_packed_varints(val) if wire == 2 else [val])
+        elif field == 2:
+            dt = val
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+        elif field == 4:
+            floats.extend(struct.unpack(f"<{len(val) // 4}f", val)
+                          if wire == 2 else [val])
+        elif field == 7:
+            int64s.extend(_decode_packed_varints(val) if wire == 2
+                          else [val])
+    np_dt = onp.dtype(_DT2NP.get(dt, "float32"))
+    if raw:
+        arr = onp.frombuffer(raw, dtype=np_dt).reshape(dims)
+    elif floats:
+        arr = onp.asarray(floats, np_dt).reshape(dims)
+    elif int64s:
+        arr = onp.asarray(int64s, np_dt).reshape(dims)
+    else:
+        arr = onp.zeros(dims, np_dt)
+    return name, arr
+
+
+def decode_attribute(buf: bytes):
+    name, atype = "", None
+    f = i = s = t = None
+    floats, ints, strings = [], [], []
+    for field, wire, val in _iter_fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            f = val
+        elif field == 3:
+            i = _s64(val)
+        elif field == 4:
+            s = val.decode()
+        elif field == 5:
+            t = decode_tensor(val)[1]
+        elif field == 7:
+            floats.append(val)
+        elif field == 8:
+            ints.extend(_s64(v) for v in (
+                _decode_packed_varints(val) if wire == 2 else [val]))
+        elif field == 9:
+            strings.append(val.decode())
+        elif field == 20:
+            atype = val
+    if atype == AT_FLOAT:
+        return name, f
+    if atype == AT_INT:
+        return name, i
+    if atype == AT_STRING:
+        return name, s
+    if atype == AT_TENSOR:
+        return name, t
+    if atype == AT_FLOATS:
+        return name, floats
+    if atype == AT_INTS:
+        return name, ints
+    if atype == AT_STRINGS:
+        return name, strings
+    # untyped: best effort priority
+    for v in (t, s, f, i):
+        if v is not None:
+            return name, v
+    return name, ints or floats or strings
+
+
+def decode_node(buf: bytes):
+    inputs, outputs, attrs = [], [], {}
+    op_type, name = "", ""
+    for field, wire, val in _iter_fields(buf):
+        if field == 1:
+            inputs.append(val.decode())
+        elif field == 2:
+            outputs.append(val.decode())
+        elif field == 3:
+            name = val.decode()
+        elif field == 4:
+            op_type = val.decode()
+        elif field == 5:
+            k, v = decode_attribute(val)
+            attrs[k] = v
+    return {"op_type": op_type, "name": name, "inputs": inputs,
+            "outputs": outputs, "attrs": attrs}
+
+
+def decode_value_info(buf: bytes):
+    name, shape, dtype = "", [], "float32"
+    for field, wire, val in _iter_fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            for f2, _, v2 in _iter_fields(val):
+                if f2 == 1:  # tensor_type
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            dtype = _DT2NP.get(v3, "float32")
+                        elif f3 == 2:  # shape
+                            for f4, _, v4 in _iter_fields(v3):
+                                if f4 == 1:  # dim
+                                    dv = 0
+                                    for f5, _, v5 in _iter_fields(v4):
+                                        if f5 == 1:
+                                            dv = v5
+                                    shape.append(dv)
+    return name, tuple(shape), dtype
+
+
+def decode_graph(buf: bytes):
+    nodes, initializers, inputs, outputs = [], {}, [], []
+    name = ""
+    for field, wire, val in _iter_fields(buf):
+        if field == 1:
+            nodes.append(decode_node(val))
+        elif field == 2:
+            name = val.decode()
+        elif field == 5:
+            k, arr = decode_tensor(val)
+            initializers[k] = arr
+        elif field == 11:
+            inputs.append(decode_value_info(val))
+        elif field == 12:
+            outputs.append(decode_value_info(val))
+    return {"name": name, "nodes": nodes, "initializers": initializers,
+            "inputs": inputs, "outputs": outputs}
+
+
+def decode_model(buf: bytes):
+    g = None
+    ir_version = 0
+    opset = 0
+    for field, wire, val in _iter_fields(buf):
+        if field == 1:
+            ir_version = val
+        elif field == 7:
+            g = decode_graph(val)
+        elif field == 8:
+            for f2, _, v2 in _iter_fields(val):
+                if f2 == 2:
+                    opset = v2
+    if g is None:
+        raise ValueError("not an ONNX model (no graph)")
+    g["ir_version"] = ir_version
+    g["opset"] = opset
+    return g

@@ -1,4 +1,5 @@
 from .io import (  # noqa: F401
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
-    MXDataIter, ImageRecordIter, MNISTIter, CSVIter, LibSVMIter,
+    MXDataIter, ImageRecordIter, ImageDetRecordIter, DetRecordIter,
+    MNISTIter, CSVIter, LibSVMIter,
 )

@@ -19,7 +19,8 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MXDataIter", "ImageRecordIter", "MNISTIter",
+           "PrefetchingIter", "MXDataIter", "ImageRecordIter",
+           "ImageDetRecordIter", "DetRecordIter", "MNISTIter",
            "CSVIter", "LibSVMIter"]
 
 
@@ -376,11 +377,198 @@ def LibSVMIter(data_libsvm, data_shape, batch_size=128, **kwargs):
                        batch_size=batch_size)
 
 
+def _first_record_is_jpeg(path_imgrec) -> bool:
+    """The native pipeline decodes JPEG only; PNG/other records (e.g.
+    pack_img(img_fmt='.png')) must take the cv2/PIL fallback rather than
+    silently decode to zeros."""
+    try:
+        from .. import recordio as rio
+        from ..native import NativeRecordIO
+        reader = NativeRecordIO(path_imgrec)
+        if len(reader) == 0:
+            reader.close()
+            return False
+        _, payload = rio.unpack(reader.read_idx(0))
+        reader.close()
+        return payload[:2] == b"\xff\xd8"  # JPEG SOI
+    except Exception:
+        return False
+
+
+class _NativeImageRecordIter(DataIter):
+    """DataIter over the native C++ decode+augment pipeline
+    (native/image_pipeline.cc — the iter_image_recordio_2.cc analog)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width,
+                 shuffle, mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1,
+                 std_b=1, rand_crop=False, rand_mirror=False, resize=0,
+                 seed=0, preprocess_threads=0, **_ignored):
+        super().__init__(batch_size)
+        from ..native import NativeImagePipeline
+        self._pipe = NativeImagePipeline(
+            path_imgrec, batch_size, data_shape=data_shape,
+            label_width=label_width, shuffle=shuffle, resize=resize,
+            rand_crop=rand_crop, rand_mirror=rand_mirror,
+            mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b),
+            seed=seed, num_workers=preprocess_threads)
+        self._iter = iter(self._pipe)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self._pipe.reset()
+        self._iter = iter(self._pipe)
+
+    def next(self):
+        from ..ndarray.ndarray import array as nd_array
+        try:
+            data, labels = next(self._iter)
+        except StopIteration:
+            raise StopIteration
+        if self.label_width == 1:
+            labels = labels.reshape(-1)
+        # the native pipeline wrap-pads the final partial batch and
+        # reports the count per batch (delivery order is not index order
+        # with multiple decode workers) — ref: ImageRecordIter
+        # last_batch_handle='pad' semantics
+        return DataBatch(data=[nd_array(data)], label=[nd_array(labels)],
+                         pad=self._pipe.last_pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def iter_next(self):
+        raise NotImplementedError  # next() is overridden directly
+
+
+class ImageDetRecordIter(DataIter):
+    """Detection RecordIO iterator (ref: src/io/iter_image_det_recordio.cc
+    ImageDetRecordIter + image_det_aug_default.cc, the SSD input tier).
+
+    Records are pack()'d with an array label
+    ``[header_width, object_width, <extra header...>,
+    (cls, xmin, ymin, xmax, ymax) * N]`` in normalized coordinates
+    (tools/im2rec-for-detection convention). Decode runs on the native
+    C++ pipeline; detection-aware augmentation (horizontal flip moves
+    the boxes with the pixels) is applied on the decoded batch.
+    """
+
+    def __init__(self, path_imgrec=None, data_shape=(3, 300, 300),
+                 batch_size=1, shuffle=False, label_pad_width=0,
+                 label_pad_value=-1.0, rand_mirror=False, resize=0,
+                 mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                 seed=0, preprocess_threads=0, **_ignored):
+        super().__init__(batch_size)
+        from .. import recordio as rio
+        from ..base import MXNetError
+        from ..native import NativeImagePipeline, NativeRecordIO
+        if not _first_record_is_jpeg(path_imgrec):
+            raise MXNetError(
+                "ImageDetRecordIter requires JPEG-encoded records "
+                "(the native decode path has no PNG support)")
+        if label_pad_width <= 0:
+            # scan headers for the max label width (the reference's
+            # first-pass estimate, iter_image_det_recordio.cc:332)
+            reader = NativeRecordIO(path_imgrec)
+            for i in range(len(reader)):
+                hdr, _ = rio.unpack(reader.read_idx(i))
+                width = 1 if isinstance(hdr.label, float) \
+                    else len(hdr.label)
+                label_pad_width = max(label_pad_width, width)
+            reader.close()
+        self.label_pad_width = label_pad_width
+        self.label_pad_value = float(label_pad_value)
+        self._rand_mirror = rand_mirror
+        self._rng = onp.random.RandomState(seed)
+        self.data_shape = tuple(data_shape)
+        # native decode with force_resize: images are WARPED to
+        # data_shape (no crop), so normalized box coordinates stay valid
+        # (the det augmenter default, image_det_aug_default.cc);
+        # geometric label-changing augs are handled here
+        self._pipe = NativeImagePipeline(
+            path_imgrec, batch_size, data_shape=data_shape,
+            label_width=label_pad_width, shuffle=shuffle,
+            rand_crop=False, rand_mirror=False, force_resize=True,
+            mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b),
+            seed=seed, num_workers=preprocess_threads,
+            label_pad_value=self.label_pad_value)
+        self._iter = iter(self._pipe)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        n_obj = (self.label_pad_width - 2) // 5
+        return [DataDesc("label", (self.batch_size, n_obj, 5))]
+
+    def reset(self):
+        self._pipe.reset()
+        self._iter = iter(self._pipe)
+
+    def next(self):
+        from ..ndarray.ndarray import array as nd_array
+        data, labels = next(self._iter)
+        B = data.shape[0]
+        n_obj = (self.label_pad_width - 2) // 5
+        boxes = onp.full((B, n_obj, 5), self.label_pad_value, "float32")
+        for b in range(B):
+            row = labels[b]
+            hw = int(row[0]) if row[0] > 0 else 2
+            ow = int(row[1]) if row[1] > 0 else 5
+            body = row[hw:]
+            k = 0
+            for o in range(min(n_obj, len(body) // ow)):
+                rec = body[o * ow:(o + 1) * ow]
+                if rec[0] < 0:  # padding
+                    continue
+                boxes[b, k, :5] = rec[:5]
+                k += 1
+        if self._rand_mirror:
+            flip = self._rng.rand(B) < 0.5
+            for b in onp.where(flip)[0]:
+                data[b] = data[b][:, :, ::-1]
+                valid = boxes[b, :, 0] >= 0
+                x1 = boxes[b, valid, 1].copy()
+                x2 = boxes[b, valid, 3].copy()
+                boxes[b, valid, 1] = 1.0 - x2
+                boxes[b, valid, 3] = 1.0 - x1
+        return DataBatch(data=[nd_array(onp.ascontiguousarray(data))],
+                         label=[nd_array(boxes)], pad=self._pipe.last_pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def iter_next(self):
+        raise NotImplementedError  # next() is overridden directly
+
+
+DetRecordIter = ImageDetRecordIter
+
+
 def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
                     label_width=1, shuffle=False, **kwargs):
     """RecordIO image pipeline (ref: src/io/iter_image_recordio_2.cc
-    ImageRecordIter2). Decode+augment via the image module; the native C++
-    reader (mxnet_tpu/native) supplies the fast path when built."""
+    ImageRecordIter2). The native C++ decode+augment pipeline
+    (native/image_pipeline.cc) is the default path; Python cv2/PIL
+    decode is the fallback when the toolchain/libjpeg is unavailable."""
+    from .. import native
+    if native.available() and _first_record_is_jpeg(path_imgrec):
+        try:
+            return _NativeImageRecordIter(
+                path_imgrec, data_shape, batch_size, label_width, shuffle,
+                **kwargs)
+        except Exception:
+            pass  # fall back to the python pipeline
     from ..image import ImageRecordIterPy
     return ImageRecordIterPy(path_imgrec=path_imgrec, data_shape=data_shape,
                              batch_size=batch_size, label_width=label_width,

@@ -166,6 +166,16 @@ class KVServer:
                     raise MXNetError("optimizer is not set")
                 self._updater.set_states(payload)
             return None
+        if cmd == "profiler_state":
+            # worker-commanded server profiling (ref: kvstore_dist.h:99
+            # kSetProfilerParams; tests/nightly/test_server_profiling.py)
+            from . import profiler
+            profiler.set_state(payload or "stop")
+            return None
+        if cmd == "profiler_dump":
+            from . import profiler
+            profiler.dump()
+            return None
         if cmd == "barrier":
             with self._barrier_cv:
                 gen = self._barrier_generation

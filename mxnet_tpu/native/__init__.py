@@ -17,7 +17,9 @@ import threading
 import numpy as onp
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "recordio.cc")
+_SRCS = [os.path.join(_HERE, "recordio.cc"),
+         os.path.join(_HERE, "image_pipeline.cc")]
+_SRC = _SRCS[0]  # kept for external references
 _SO = os.path.join(_HERE, "libmxtpu_native.so")
 
 _lock = threading.Lock()
@@ -28,10 +30,11 @@ _build_error = None
 def build(force: bool = False) -> str:
     """Compile the native library (cached)."""
     if not force and os.path.exists(_SO) and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            all(os.path.getmtime(_SO) >= os.path.getmtime(s)
+                for s in _SRCS):
         return _SO
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _SO]
+           *_SRCS, "-o", _SO, "-ljpeg"]
     subprocess.run(cmd, check=True, capture_output=True)
     return _SO
 
@@ -81,6 +84,29 @@ def lib():
             L.rio_batch_free.argtypes = [ctypes.c_void_p]
             L.rio_batch_server_reset.argtypes = [ctypes.c_void_p]
             L.rio_batch_server_destroy.argtypes = [ctypes.c_void_p]
+            L.imgpipe_create.restype = ctypes.c_void_p
+            L.imgpipe_create.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+                ctypes.c_int, ctypes.c_float, ctypes.c_int]
+            L.imgpipe_next.restype = ctypes.c_void_p
+            L.imgpipe_next.argtypes = [ctypes.c_void_p]
+            L.imgpipe_batch_data.restype = ctypes.POINTER(ctypes.c_float)
+            L.imgpipe_batch_data.argtypes = [ctypes.c_void_p]
+            L.imgpipe_batch_labels.restype = ctypes.POINTER(ctypes.c_float)
+            L.imgpipe_batch_labels.argtypes = [ctypes.c_void_p]
+            L.imgpipe_batch_n.restype = ctypes.c_int64
+            L.imgpipe_batch_n.argtypes = [ctypes.c_void_p]
+            L.imgpipe_batch_pad.restype = ctypes.c_int64
+            L.imgpipe_batch_pad.argtypes = [ctypes.c_void_p]
+            L.imgpipe_batch_free.argtypes = [ctypes.c_void_p]
+            L.imgpipe_reset.argtypes = [ctypes.c_void_p]
+            L.imgpipe_decode_failures.restype = ctypes.c_int64
+            L.imgpipe_decode_failures.argtypes = [ctypes.c_void_p]
+            L.imgpipe_destroy.argtypes = [ctypes.c_void_p]
             _lib = L
         except Exception as e:  # toolchain missing → python fallback
             _build_error = e
@@ -236,3 +262,79 @@ def build_capi(force: bool = False) -> str:
            f"-Wl,-rpath,{libdir}", "-o", _CAPI_SO]
     subprocess.run(cmd, check=True, capture_output=True)
     return _CAPI_SO
+
+
+class NativeImagePipeline:
+    """Threaded JPEG decode + augment + batch pipeline (image_pipeline.cc;
+    ref: src/io/iter_image_recordio_2.cc parser threads +
+    image_aug_default.cc). Yields (data, label) float32 numpy batches,
+    NCHW by default."""
+
+    def __init__(self, path: str, batch_size: int, data_shape=(3, 224, 224),
+                 label_width: int = 1, shuffle: bool = False, resize: int = 0,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 mean=None, std=None, seed: int = 0, num_workers: int = 0,
+                 layout: str = "NCHW", label_pad_value: float = 0.0,
+                 force_resize: bool = False):
+        L = lib()
+        if L is None:
+            raise RuntimeError(f"native lib unavailable: {_build_error}")
+        if num_workers <= 0:
+            # MXNET_CPU_WORKER_NTHREADS sizes the native IO thread pool
+            from ..base import get_env
+            num_workers = max(2, int(get_env("MXNET_CPU_WORKER_NTHREADS",
+                                             1)))
+        self._L = L
+        self._reader = NativeRecordIO(path)
+        c, h, w = data_shape
+        m = (ctypes.c_float * 3)(*(mean if mean is not None else (0, 0, 0)))
+        s = (ctypes.c_float * 3)(*(std if std is not None else (1, 1, 1)))
+        self._nhwc = layout == "NHWC"
+        self._h = L.imgpipe_create(
+            self._reader._h, batch_size, c, h, w, int(resize),
+            int(label_width), int(rand_crop), int(rand_mirror),
+            int(shuffle), int(self._nhwc), m, s, seed, num_workers,
+            float(label_pad_value), int(force_resize))
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+
+    def __iter__(self):
+        c, h, w = self.data_shape
+        shape = (self.batch_size, h, w, c) if self._nhwc \
+            else (self.batch_size, c, h, w)
+        n_img = self.batch_size * c * h * w
+        n_lbl = self.batch_size * self.label_width
+        while True:
+            b = self._L.imgpipe_next(self._h)
+            if not b:
+                return
+            data = onp.ctypeslib.as_array(
+                self._L.imgpipe_batch_data(b), shape=(n_img,)).copy()
+            labels = onp.ctypeslib.as_array(
+                self._L.imgpipe_batch_labels(b), shape=(n_lbl,)).copy()
+            self.last_pad = int(self._L.imgpipe_batch_pad(b))
+            self._L.imgpipe_batch_free(b)
+            yield (data.reshape(shape),
+                   labels.reshape(self.batch_size, self.label_width))
+
+    def reset(self):
+        self._L.imgpipe_reset(self._h)
+
+    @property
+    def decode_failures(self) -> int:
+        return int(self._L.imgpipe_decode_failures(self._h))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._L.imgpipe_destroy(self._h)
+            self._h = None
+        if getattr(self, "_reader", None) is not None:
+            self._reader.close()
+            self._reader = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -101,6 +101,7 @@ struct BatchServer {
   size_t max_ready = 4;
   std::vector<std::thread> workers;
   std::atomic<bool> stop{false};
+  std::atomic<int> active{0};
   std::mutex cursor_mu;
 
   ~BatchServer() { shutdown(); }
@@ -152,19 +153,25 @@ struct BatchServer {
       });
       if (stop.load()) {
         delete b;
+        active.fetch_sub(1);
         return;
       }
       ready.push_back(b);
       cv_ready.notify_one();
     }
-    std::unique_lock<std::mutex> lk(mu);
-    ready.push_back(nullptr);  // end-of-epoch marker
-    cv_ready.notify_all();
+    // only the LAST exiting worker marks end-of-epoch — an earlier
+    // marker would make the consumer drop batches still in flight
+    if (active.fetch_sub(1) == 1) {
+      std::unique_lock<std::mutex> lk(mu);
+      ready.push_back(nullptr);
+      cv_ready.notify_all();
+    }
   }
 
   void start(int num_workers) {
     stop.store(false);
     reset_order();
+    active.store(num_workers);
     for (int i = 0; i < num_workers; ++i)
       workers.emplace_back([this] { worker_loop(); });
   }

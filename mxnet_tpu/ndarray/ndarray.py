@@ -95,9 +95,17 @@ def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
         # sparse inputs execute through the dense implementation
         from .sparse import log_storage_fallback
         log_storage_fallback(getattr(fn, "__name__", str(fn)))
+    from .. import profiler as _prof
     was_recording = autograd.set_recording(False)  # no nested recording:
     try:   # ops whose impls re-enter the nd layer (control flow bodies)
-        out = call(*in_arrays)  # must not write tracer nodes to the tape
+        if _prof.is_running() and _prof._config.get("profile_imperative",
+                                                    True):
+            # per-op event (ref: profiler operator events hooked into
+            # the engine, include/mxnet/engine.h:189)
+            with _prof.Scope(getattr(fn, "__name__", "op")):
+                out = call(*in_arrays)
+        else:
+            out = call(*in_arrays)  # must not write tape tracer nodes
     finally:
         autograd.set_recording(was_recording)
     outs = list(out) if isinstance(out, (tuple, list)) else [out]

@@ -136,22 +136,26 @@ def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
     return _np_wrap(_place(out, ctx))
 
 
-def _unary(jfn):
-    def f(x, out=None, **kwargs):
+def _unary(jfn, differentiable=True):
+    def f(x, *args, out=None, **kwargs):
         if not isinstance(x, NDArray):
             x = array(x)
-        res = _invoke(lambda a: jfn(a, **kwargs), [x])
+        # positional extras (axis/k/shift/decimals...) pass straight
+        # through — swallowing them into `out` silently changes results
+        res = _invoke(lambda a: jfn(a, *args, **kwargs), [x],
+                      differentiable=differentiable)
         return _np_wrap(res._data)
     return f
 
 
-def _binary(jfn):
-    def f(x1, x2, out=None, **kwargs):
+def _binary(jfn, differentiable=True):
+    def f(x1, x2, *args, out=None, **kwargs):
         if not isinstance(x1, NDArray):
             x1 = array(x1)
         if not isinstance(x2, NDArray):
             x2 = array(x2, dtype=str(x1.dtype))
-        res = _invoke(lambda a, b: jfn(a, b, **kwargs), [x1, x2])
+        res = _invoke(lambda a, b: jfn(a, b, *args, **kwargs), [x1, x2],
+                      differentiable=differentiable)
         return _np_wrap(res._data)
     return f
 
@@ -361,3 +365,257 @@ class _NPRandom:
 
 
 random = _NPRandom()
+
+
+# ---------------------------------------------------------------------------
+# breadth tier (ref: src/operator/numpy/ — the ~4k-LoC native _npi_ corpus;
+# VERDICT r1 item 7): generated wrappers over jax.numpy keeping the mx.np
+# array type and autograd recording.
+# ---------------------------------------------------------------------------
+
+euler_gamma = onp.euler_gamma
+float_ = onp.float64
+int_ = onp.int64
+int16 = onp.int16
+uint32 = onp.uint32
+uint64 = onp.uint64
+
+
+def _np_multi(jfn, differentiable=True):
+    """Wrapper for fns taking a sequence of arrays (vstack family)."""
+    def f(arrays, *args, **kwargs):
+        arrs = [a if isinstance(a, NDArray) else array(a) for a in arrays]
+        res = _invoke(lambda *xs: jfn(xs, *args, **kwargs), arrs,
+                      differentiable=differentiable)
+        return _np_wrap(res._data)
+    return f
+
+
+_EXTRA_UNARY = [
+    "sort", "flip", "flipud", "fliplr", "ravel", "cumprod", "nancumsum",
+    "nan_to_num", "trace", "tril", "triu", "diagonal", "diff",
+    "ptp", "round", "conj", "real", "imag", "angle", "positive", "i0",
+    "sinc", "exp2", "signbit", "spacing", "rot90", "roll", "unwrap",
+    "nanprod", "trim_zeros", "rad2deg", "deg2rad",
+]
+_EXTRA_UNARY_NONDIFF = ["argsort", "count_nonzero", "all", "any",
+                        "flatnonzero", "iscomplex", "isreal", "isneginf",
+                        "isposinf"]
+_EXTRA_BINARY = ["logaddexp", "logaddexp2", "outer", "inner", "kron",
+                 "vdot", "cross", "heaviside", "fmod", "float_power",
+                 "nextafter", "fmax", "fmin", "polyval"]
+
+for _name in _EXTRA_UNARY:
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _unary(getattr(jnp, _name)))
+for _name in _EXTRA_UNARY_NONDIFF:
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name,
+                _unary(getattr(jnp, _name), differentiable=False))
+for _name in _EXTRA_BINARY:
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _binary(getattr(jnp, _name)))
+
+def fix(x, out=None):
+    """jnp.fix is deprecated; trunc is the same op."""
+    return _unary(jnp.trunc)(x, out=out)
+
+
+vstack = _np_multi(jnp.vstack)
+hstack = _np_multi(jnp.hstack)
+dstack = _np_multi(jnp.dstack)
+column_stack = _np_multi(jnp.column_stack)
+row_stack = vstack
+
+
+def append(arr, values, axis=None):
+    if not isinstance(values, NDArray):
+        values = array(values)
+    return _np_wrap(_invoke(lambda a, v: jnp.append(a, v, axis=axis),
+                            [arr, values])._data)
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    outs = _invoke(lambda x: tuple(jnp.array_split(
+        x, indices_or_sections, axis=axis)), [ary])
+    return [_np_wrap(o._data) for o in outs]
+
+
+def take(a, indices, axis=None, mode="clip"):
+    if not isinstance(indices, NDArray):
+        indices = array(indices)
+    return _np_wrap(_invoke(
+        lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis,
+                              mode=mode), [a, indices])._data)
+
+
+def take_along_axis(arr, indices, axis):
+    return _np_wrap(_invoke(
+        lambda x, i: jnp.take_along_axis(x, i.astype(jnp.int32), axis=axis),
+        [arr, indices])._data)
+
+
+def searchsorted(a, v, side="left"):
+    if not isinstance(v, NDArray):
+        v = array(v)
+    return _np_wrap(_invoke(
+        lambda x, q: jnp.searchsorted(x, q, side=side), [a, v],
+        differentiable=False)._data)
+
+
+def bincount(x, weights=None, minlength=0):
+    args = [x] + ([weights] if weights is not None else [])
+    if weights is None:
+        return _np_wrap(_invoke(
+            lambda a: jnp.bincount(a.astype(jnp.int32),
+                                   minlength=minlength), args,
+            differentiable=False)._data)
+    return _np_wrap(_invoke(
+        lambda a, w: jnp.bincount(a.astype(jnp.int32), weights=w,
+                                  minlength=minlength), args)._data)
+
+
+def interp(x, xp, fp, left=None, right=None):
+    arrs = [a if isinstance(a, NDArray) else array(a) for a in (x, xp, fp)]
+    return _np_wrap(_invoke(
+        lambda a, b, c: jnp.interp(a, b, c, left=left, right=right),
+        arrs)._data)
+
+
+def meshgrid(*xi, indexing="xy"):
+    arrs = [a if isinstance(a, NDArray) else array(a) for a in xi]
+    outs = _invoke(lambda *xs: tuple(jnp.meshgrid(*xs, indexing=indexing)),
+                   arrs)
+    return [_np_wrap(o._data) for o in outs]
+
+
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    h, edges = onp.histogram(a.asnumpy() if isinstance(a, NDArray) else a,
+                             bins=bins, range=range,
+                             weights=None if weights is None
+                             else onp.asarray(weights), density=density)
+    return array(h), array(edges)
+
+
+def atleast_1d(*arys):
+    outs = [reshape(a if isinstance(a, NDArray) else array(a),
+                    (-1,)) if (a.ndim if isinstance(a, NDArray)
+                               else onp.ndim(a)) == 0 else
+            (a if isinstance(a, NDArray) else array(a)) for a in arys]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def moveaxis(a, source, destination):
+    return _np_wrap(_invoke(lambda x: jnp.moveaxis(x, source, destination),
+                            [a])._data)
+
+
+def rollaxis(a, axis, start=0):
+    return _np_wrap(_invoke(lambda x: jnp.rollaxis(x, axis, start),
+                            [a])._data)
+
+
+def nonzero(a):
+    res = onp.nonzero(a.asnumpy())
+    return tuple(array(r) for r in res)
+
+
+def pad(array_, pad_width, mode="constant", **kwargs):
+    a = array_ if isinstance(array_, NDArray) else array(array_)
+    return _np_wrap(_invoke(
+        lambda x: jnp.pad(x, pad_width, mode=mode, **kwargs), [a])._data)
+
+
+def identity(n, dtype=None):
+    return _np_wrap(jnp.identity(n, _canon_dtype(dtype)))
+
+
+def tri(N, M=None, k=0, dtype=None):
+    return _np_wrap(jnp.tri(N, M, k, _canon_dtype(dtype) or jnp.float32))
+
+
+def empty_like(prototype, dtype=None):
+    return zeros_like(prototype, dtype)
+
+
+def full_like(a, fill_value, dtype=None):
+    return _np_wrap(jnp.full_like(a._data, fill_value,
+                                  _canon_dtype(dtype)))
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, ndarray) and dtype is None:
+        return a
+    return array(a, dtype=dtype)
+
+
+ascontiguousarray = asarray
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None):
+    return _np_wrap(jnp.logspace(start, stop, num, endpoint, base,
+                                 _canon_dtype(dtype)))
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None):
+    return _np_wrap(jnp.geomspace(start, stop, num, endpoint,
+                                  _canon_dtype(dtype)))
+
+
+def indices(dimensions, dtype=None):
+    return _np_wrap(jnp.indices(dimensions,
+                                _canon_dtype(dtype) or jnp.int32))
+
+
+def _nanreduce(jfn):
+    def f(a, axis=None, keepdims=False, **kw):
+        return _np_wrap(_invoke(lambda x: jfn(x, axis=axis,
+                                              keepdims=keepdims),
+                                [a])._data)
+    return f
+
+
+nansum = _nanreduce(jnp.nansum)
+nanmax = _nanreduce(jnp.nanmax)
+nanmin = _nanreduce(jnp.nanmin)
+nanmean = _nanreduce(jnp.nanmean)
+nanstd = _nanreduce(jnp.nanstd)
+nanvar = _nanreduce(jnp.nanvar)
+nanargmax = _nanreduce(jnp.nanargmax)
+nanargmin = _nanreduce(jnp.nanargmin)
+
+
+def median(a, axis=None, keepdims=False, **kw):
+    return _np_wrap(_invoke(lambda x: jnp.median(x, axis=axis,
+                                                 keepdims=keepdims),
+                            [a])._data)
+
+
+def percentile(a, q, axis=None, keepdims=False, **kw):
+    return _np_wrap(_invoke(
+        lambda x: jnp.percentile(x, q, axis=axis, keepdims=keepdims),
+        [a])._data)
+
+
+def quantile(a, q, axis=None, keepdims=False, **kw):
+    return _np_wrap(_invoke(
+        lambda x: jnp.quantile(x, q, axis=axis, keepdims=keepdims),
+        [a])._data)
+
+
+def average(a, axis=None, weights=None, returned=False):
+    if weights is None:
+        out = mean(a, axis=axis)
+        return (out, full_like(out, float(a.size if axis is None
+                                          else a.shape[axis]))) \
+            if returned else out
+    w = weights if isinstance(weights, NDArray) else array(weights)
+    res = _invoke(lambda x, ww: jnp.average(x, axis=axis, weights=ww),
+                  [a, w])
+    if returned:
+        return _np_wrap(res._data), sum(w, axis=axis)
+    return _np_wrap(res._data)
+
+
+# linalg sub-namespace (ref: _linalg_* op family + numpy.linalg surface)
+from . import linalg  # noqa: E402,F401

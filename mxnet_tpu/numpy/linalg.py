@@ -1,0 +1,138 @@
+"""mx.np.linalg — NumPy-compatible linear algebra.
+
+ref: the reference's `_linalg_*` native op family (src/operator/tensor/
+la_op.cc gemm/potrf/trsm/syrk/syevd/det/inverse, LAPACK via
+c_lapack_api.cc) exposed through python/mxnet/numpy/linalg.py. On TPU
+these are jax.numpy.linalg calls — XLA lowers them to MXU-friendly
+kernels — wrapped to keep the mx.np array type and autograd recording.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, invoke as _invoke
+
+__all__ = ["norm", "inv", "pinv", "det", "slogdet", "cholesky", "qr",
+           "svd", "svdvals", "eig", "eigh", "eigvals", "eigvalsh",
+           "solve", "lstsq", "matrix_rank", "matrix_power", "multi_dot",
+           "tensorinv", "tensorsolve", "cond", "trace"]
+
+
+def _wrap1(x):
+    from . import _np_wrap
+    return _np_wrap(x._data if isinstance(x, NDArray) else x)
+
+
+def _as_nd(a):
+    from . import array
+    return a if isinstance(a, NDArray) else array(a)
+
+
+def _call(jfn, arrays, differentiable=True, n_out=1):
+    arrays = [_as_nd(a) for a in arrays]
+    res = _invoke(jfn, arrays, differentiable=differentiable, n_out=n_out)
+    if isinstance(res, (list, tuple)):
+        return tuple(_wrap1(r) for r in res)
+    return _wrap1(res)
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _call(lambda a: jnp.linalg.norm(a, ord=ord, axis=axis,
+                                           keepdims=keepdims), [x])
+
+
+def inv(a):
+    return _call(jnp.linalg.inv, [a])
+
+
+def pinv(a, rcond=None):
+    return _call(lambda x: jnp.linalg.pinv(x, rcond=rcond), [a])
+
+
+def det(a):
+    return _call(jnp.linalg.det, [a])
+
+
+def slogdet(a):
+    return _call(lambda x: tuple(jnp.linalg.slogdet(x)), [a], n_out=2)
+
+
+def cholesky(a):
+    return _call(jnp.linalg.cholesky, [a])
+
+
+def qr(a, mode="reduced"):
+    return _call(lambda x: tuple(jnp.linalg.qr(x, mode=mode)), [a],
+                 n_out=2)
+
+
+def svd(a, full_matrices=True, compute_uv=True):
+    if not compute_uv:
+        return _call(lambda x: jnp.linalg.svd(x, full_matrices=False,
+                                              compute_uv=False), [a])
+    return _call(lambda x: tuple(jnp.linalg.svd(
+        x, full_matrices=full_matrices)), [a], n_out=3)
+
+
+def svdvals(a):
+    return svd(a, compute_uv=False)
+
+
+def eig(a):
+    return _call(lambda x: tuple(jnp.linalg.eig(x)), [a],
+                 differentiable=False, n_out=2)
+
+
+def eigh(a, UPLO="L"):
+    return _call(lambda x: tuple(jnp.linalg.eigh(x, UPLO=UPLO)), [a],
+                 n_out=2)
+
+
+def eigvals(a):
+    return _call(jnp.linalg.eigvals, [a], differentiable=False)
+
+
+def eigvalsh(a, UPLO="L"):
+    return _call(lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO), [a])
+
+
+def solve(a, b):
+    return _call(jnp.linalg.solve, [a, b])
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond in ("warn", None) else rcond
+    return _call(lambda x, y: tuple(jnp.linalg.lstsq(x, y, rcond=rc)),
+                 [a, b], n_out=4)
+
+
+def matrix_rank(a, tol=None):
+    return _call(lambda x: jnp.linalg.matrix_rank(x, tol=tol), [a],
+                 differentiable=False)
+
+
+def matrix_power(a, n):
+    return _call(lambda x: jnp.linalg.matrix_power(x, n), [a])
+
+
+def multi_dot(arrays):
+    return _call(lambda *xs: jnp.linalg.multi_dot(list(xs)), list(arrays))
+
+
+def tensorinv(a, ind=2):
+    return _call(lambda x: jnp.linalg.tensorinv(x, ind=ind), [a])
+
+
+def tensorsolve(a, b, axes=None):
+    return _call(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
+                 [a, b])
+
+
+def cond(x, p=None):
+    return _call(lambda a: jnp.linalg.cond(a, p=p), [x],
+                 differentiable=False)
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _call(lambda x: jnp.trace(x, offset=offset, axis1=axis1,
+                                     axis2=axis2), [a])

@@ -363,18 +363,20 @@ def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
     K = lda.shape[1]
     B, T = lags.shape
 
-    def per_sample(lda_i, state_i, lags_i, marks_i, vl_i, maxt_i):
+    def per_sample(lda_i, alpha_i, beta_i, state_i, lags_i, marks_i, vl_i,
+                   maxt_i):
         def step(carry, inp):
             ll, rem, t = carry
             lag, mark, idx = inp
             valid = idx < vl_i
             t_new = t + lag
-            decay = jnp.exp(-beta * lag)
+            decay = jnp.exp(-beta_i * lag)          # (K,)
             rem = rem * decay
             intensity = lda_i[mark] + rem[mark]
             ll_new = ll + jnp.where(valid, jnp.log(
                 jnp.clip(intensity, 1e-20, None)), 0.0)
-            rem = jnp.where(valid, rem.at[mark].add(alpha[mark] * beta[mark]),
+            rem = jnp.where(valid,
+                            rem.at[mark].add(alpha_i[mark] * beta_i[mark]),
                             rem)
             return (ll_new, rem, jnp.where(valid, t_new, t)), None
 
@@ -384,12 +386,13 @@ def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
             (lags_i, marks_i.astype(jnp.int32), jnp.arange(T)))
         # compensator
         comp = jnp.sum(lda_i * maxt_i) + jnp.sum(
-            (rem / jnp.clip(beta, 1e-12, None))
-            * (1 - jnp.exp(-beta * (maxt_i - t_last))))
+            (rem / jnp.clip(beta_i, 1e-12, None))
+            * (1 - jnp.exp(-beta_i * (maxt_i - t_last))))
         return ll - comp, rem
 
     lls, states = jax.vmap(per_sample)(
-        jnp.broadcast_to(lda, (B, K)), state, lags, marks,
+        jnp.broadcast_to(lda, (B, K)), jnp.broadcast_to(alpha, (B, K)),
+        jnp.broadcast_to(beta, (B, K)), state, lags, marks,
         valid_length.reshape(-1), max_time.reshape(-1))
     return lls, states
 

@@ -36,6 +36,12 @@ def set_config(**kwargs):
 
 def set_state(state="stop", profile_process="worker"):
     global _running, _jax_dir
+    if profile_process == "server":
+        # remote/server profiling: command the parameter server (ref:
+        # kvstore_dist.h:99 kSetProfilerParams;
+        # tests/nightly/test_server_profiling.py)
+        _send_server_command("profiler_state", state)
+        return
     if state == "run" and not _running:
         _running = True
         _jax_dir = os.path.splitext(_config["filename"])[0] + "_xprof"
@@ -65,15 +71,81 @@ def is_running() -> bool:
 
 
 def dumps(reset=False) -> str:
-    out = json.dumps({"traceEvents": list(_events)}, indent=1)
+    """Chrome-trace JSON, or the aggregate statistics table when
+    aggregate_stats is configured (ref: src/profiler/aggregate_stats.cc
+    DumpTable via MXAggregateProfileStatsPrint)."""
+    if _config.get("aggregate_stats"):
+        out = _aggregate_table()
+    else:
+        out = json.dumps({"traceEvents": list(_events)}, indent=1)
     if reset:
         _events.clear()
+        _agg.clear()
     return out
 
 
 def dump(finished=True, profile_process="worker"):
+    if profile_process == "server":
+        _send_server_command("profiler_dump", "")
+        return
     with open(_config["filename"], "w") as f:
-        f.write(dumps())
+        f.write(json.dumps({"traceEvents": list(_events)}, indent=1))
+
+
+# -- aggregate stats (ref: profiler.h:327-331 + aggregate_stats.cc) ---------
+
+_agg: dict = {}
+_agg_lock = threading.Lock()
+
+
+def _agg_update(name: str, dur_us: float):
+    with _agg_lock:
+        ent = _agg.get(name)
+        if ent is None:
+            _agg[name] = [1, dur_us, dur_us, dur_us]
+        else:
+            ent[0] += 1
+            ent[1] += dur_us
+            ent[2] = min(ent[2], dur_us)
+            ent[3] = max(ent[3], dur_us)
+
+
+def _aggregate_table() -> str:
+    lines = ["Profile Statistics:",
+             f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
+             f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}",
+             "-" * 102]
+    with _agg_lock:
+        rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+    for name, (count, total, mn, mx) in rows:
+        lines.append(f"{name[:39]:<40}{count:>12}{total / 1e3:>14.4f}"
+                     f"{mn / 1e3:>12.4f}{mx / 1e3:>12.4f}"
+                     f"{total / count / 1e3:>12.4f}")
+    return "\n".join(lines)
+
+
+def get_summary(reset=False) -> str:
+    """ref: MXAggregateProfileStatsPrint — always the aggregate table."""
+    out = _aggregate_table()
+    if reset:
+        with _agg_lock:
+            _agg.clear()
+    return out
+
+
+def _send_server_command(head: str, body: str):
+    """Route a profiler command to the parameter-server role (ref:
+    kvstore_dist.h:99 SendCommandToServers)."""
+    from . import kvstore_server as srv
+    addr = srv.server_address()
+    if addr is None:
+        return  # no server in this job
+    try:
+        client = srv.KVClient(addr, retries=5)
+        client.request(head, None, body)
+        client._sock.close()
+    except Exception:
+        pass
 
 
 class Scope:
@@ -94,11 +166,13 @@ class Scope:
         self._jctx.__exit__(*exc)
         t1 = time.perf_counter_ns()
         if _running:
+            dur_us = (t1 - self._t0) / 1000.0
             _events.append({
                 "name": self.name, "ph": "X", "pid": os.getpid(),
                 "tid": threading.get_ident(),
-                "ts": self._t0 / 1000.0, "dur": (t1 - self._t0) / 1000.0,
+                "ts": self._t0 / 1000.0, "dur": dur_us,
             })
+            _agg_update(self.name, dur_us)
 
 
 scope = Scope

@@ -1,0 +1,292 @@
+"""Native image pipeline (image_pipeline.cc) + multiprocess DataLoader
+(ref: src/io/iter_image_recordio_2.cc, image_aug_default.cc,
+python/mxnet/gluon/data/dataloader.py:27-71)."""
+import io as pyio
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.native import available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native toolchain unavailable")
+
+
+@pytest.fixture(scope="module")
+def imgrec(tmp_path_factory):
+    from PIL import Image
+    path = str(tmp_path_factory.mktemp("rec") / "data.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rs = onp.random.RandomState(0)
+    raw = []
+    for i in range(24):
+        arr = (rs.randint(0, 255, (40, 48, 3), dtype=onp.uint8)
+               .astype(onp.float32) * 0.3 + 90).astype(onp.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+        # re-decode so the fixture reference matches JPEG loss
+        raw.append(onp.asarray(Image.open(pyio.BytesIO(buf.getvalue()))))
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 7), i, 0),
+                              buf.getvalue()))
+    w.close()
+    return path, raw
+
+
+def test_decode_matches_pil(imgrec):
+    from mxnet_tpu.native import NativeImagePipeline
+    path, raw = imgrec
+    pipe = NativeImagePipeline(path, batch_size=2, data_shape=(3, 40, 48))
+    data, labels = next(iter(pipe))
+    assert pipe.decode_failures == 0
+    got = data[0].transpose(1, 2, 0)
+    assert onp.abs(got - raw[0].astype(onp.float32)).max() <= 1.0
+    assert labels.ravel()[0] == 0.0 and labels.ravel()[1] == 1.0
+    pipe.close()
+
+
+def test_resize_crop_mirror_normalize(imgrec):
+    from mxnet_tpu.native import NativeImagePipeline
+    path, raw = imgrec
+    mean = (100.0, 90.0, 80.0)
+    std = (50.0, 40.0, 30.0)
+    pipe = NativeImagePipeline(path, batch_size=3, data_shape=(3, 32, 32),
+                               resize=36, rand_crop=True, rand_mirror=True,
+                               shuffle=True, mean=mean, std=std, seed=7)
+    n = 0
+    for data, labels in pipe:
+        assert data.shape == (3, 3, 32, 32)
+        assert onp.isfinite(data).all()
+        n += 1
+    assert n == 8  # 24 imgs / batch 3
+    assert pipe.decode_failures == 0
+    # normalization applied: values roughly standardized, not 0..255
+    assert data.max() < 10.0 and data.min() > -10.0
+    pipe.close()
+
+
+def test_center_crop_matches_reference_math(imgrec):
+    """No resize, center crop: output equals the cropped source."""
+    from mxnet_tpu.native import NativeImagePipeline
+    path, raw = imgrec
+    pipe = NativeImagePipeline(path, batch_size=1, data_shape=(3, 32, 32))
+    data, _ = next(iter(pipe))
+    src = raw[0].astype(onp.float32)
+    y0, x0 = (40 - 32) // 2, (48 - 32) // 2
+    want = src[y0:y0 + 32, x0:x0 + 32].transpose(2, 0, 1)
+    assert onp.abs(data[0] - want).max() <= 1.0
+    pipe.close()
+
+
+def test_epoch_reset_and_full_coverage(imgrec):
+    from mxnet_tpu.native import NativeImagePipeline
+    path, _ = imgrec
+    pipe = NativeImagePipeline(path, batch_size=4, data_shape=(3, 32, 32),
+                               shuffle=True, num_workers=3, seed=1)
+    labels1 = sorted(float(x) for _, l in pipe for x in l.ravel())
+    pipe.reset()
+    labels2 = sorted(float(x) for _, l in pipe for x in l.ravel())
+    # every record served exactly once per epoch, both epochs
+    assert len(labels1) == 24 and labels1 == labels2
+    pipe.close()
+
+
+def test_image_record_iter_uses_native(imgrec):
+    path, _ = imgrec
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                               batch_size=6, shuffle=False)
+    assert type(it).__name__ == "_NativeImageRecordIter"
+    b = next(iter(it))
+    assert b.data[0].shape == (6, 3, 32, 32)
+    assert b.label[0].shape == (6,)
+    assert b.label[0].asnumpy()[0] == 0.0
+
+
+def test_label_array_records(tmp_path):
+    """flag>0 records carry a label array (pack with array label)."""
+    from PIL import Image
+    from mxnet_tpu.native import NativeImagePipeline
+    path = str(tmp_path / "multi.rec")
+    w = recordio.MXRecordIO(path, "w")
+    buf = pyio.BytesIO()
+    Image.fromarray(onp.full((32, 32, 3), 128, onp.uint8)).save(
+        buf, format="JPEG")
+    w.write(recordio.pack(
+        recordio.IRHeader(0, onp.asarray([1.5, 2.5, 3.5], "float32"), 0, 0),
+        buf.getvalue()))
+    w.close()
+    pipe = NativeImagePipeline(path, batch_size=1, data_shape=(3, 32, 32),
+                               label_width=3)
+    _, labels = next(iter(pipe))
+    assert onp.allclose(labels.ravel(), [1.5, 2.5, 3.5])
+    assert pipe.decode_failures == 0
+    pipe.close()
+
+
+def test_corrupt_record_counted_not_fatal(tmp_path):
+    from mxnet_tpu.native import NativeImagePipeline
+    path = str(tmp_path / "bad.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(recordio.pack(recordio.IRHeader(0, 1.0, 0, 0),
+                          b"not a jpeg at all"))
+    w.close()
+    pipe = NativeImagePipeline(path, batch_size=1, data_shape=(3, 16, 16))
+    data, labels = next(iter(pipe))
+    assert onp.allclose(data, 0)  # zero-filled, not a crash
+    assert pipe.decode_failures == 1
+    pipe.close()
+
+
+@pytest.fixture(scope="module")
+def detrec(tmp_path_factory):
+    """Detection records: label = [2, 5, (cls,x1,y1,x2,y2)*N]."""
+    from PIL import Image
+    path = str(tmp_path_factory.mktemp("det") / "det.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rs = onp.random.RandomState(1)
+    truth = []
+    for i in range(6):
+        arr = onp.full((48, 48, 3), 120 + i, onp.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+        n_obj = 1 + i % 2
+        objs = []
+        for k in range(n_obj):
+            x1, y1 = rs.uniform(0, 0.4, 2)
+            objs.append([float(k % 3), x1, y1, x1 + 0.3, y1 + 0.4])
+        truth.append(objs)
+        label = onp.asarray([2, 5] + [v for o in objs for v in o],
+                            "float32")
+        w.write(recordio.pack(recordio.IRHeader(0, label, i, 0),
+                              buf.getvalue()))
+    w.close()
+    return path, truth
+
+
+def test_det_record_iter(detrec):
+    path, truth = detrec
+    it = mx.io.ImageDetRecordIter(path_imgrec=path,
+                                  data_shape=(3, 32, 32), batch_size=3)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.data[0].shape == (3, 3, 32, 32)
+    lbl = b0.label[0].asnumpy()
+    assert lbl.shape[0] == 3 and lbl.shape[2] == 5
+    # record 0 has one object, matching the packed truth
+    assert onp.allclose(lbl[0, 0], truth[0][0], atol=1e-5)
+    assert (lbl[0, 1:] == -1).all()  # padding rows
+    # record 1 has two objects
+    assert onp.allclose(lbl[1, 1], truth[1][1], atol=1e-5)
+
+
+def test_det_record_iter_mirror_moves_boxes(detrec):
+    path, truth = detrec
+    it = mx.io.ImageDetRecordIter(path_imgrec=path,
+                                  data_shape=(3, 32, 32), batch_size=6,
+                                  rand_mirror=True, seed=3)
+    lbl = next(iter(it)).label[0].asnumpy()
+    for b in range(6):
+        got = lbl[b, 0]
+        want = onp.asarray(truth[b][0], "float32")
+        flipped = want.copy()
+        flipped[1], flipped[3] = 1.0 - want[3], 1.0 - want[1]
+        assert (onp.allclose(got, want, atol=1e-5)
+                or onp.allclose(got, flipped, atol=1e-5)), (got, want)
+
+
+def test_det_record_iter_feeds_multibox(detrec):
+    """The SSD target path consumes real detection batches (ref:
+    example/ssd/train/train_net.py MultiBoxTarget over DetRecordIter)."""
+    path, _ = detrec
+    it = mx.io.ImageDetRecordIter(path_imgrec=path,
+                                  data_shape=(3, 32, 32), batch_size=2)
+    batch = next(iter(it))
+    anchors = nd.contrib.MultiBoxPrior(batch.data[0], sizes=(0.5, 0.25),
+                                       ratios=(1.0, 2.0))
+    cls_preds = nd.zeros((2, 4, anchors.shape[1]))
+    target = nd.contrib.MultiBoxTarget(anchors, batch.label[0], cls_preds)
+    assert len(target) == 3
+    assert onp.isfinite(target[0].asnumpy()).all()
+
+
+# ---------------------------------------------------------------------------
+# multiprocess DataLoader
+# ---------------------------------------------------------------------------
+
+class _SquareDataset:
+    def __len__(self):
+        return 31
+
+    def __getitem__(self, i):
+        return (onp.full((4, 5), float(i), "float32"),
+                onp.asarray(i * i, "float32"))
+
+
+def test_dataloader_processes_shared_memory():
+    from mxnet_tpu.gluon.data import DataLoader
+    loader = DataLoader(_SquareDataset(), batch_size=8, shuffle=False,
+                        num_workers=3)
+    assert len(loader._workers) == 3
+    assert all(w.is_alive() for w in loader._workers)
+    seen = []
+    for batch in loader:
+        data, label = batch
+        assert isinstance(data, nd.NDArray)
+        seen.extend(label.asnumpy().ravel().tolist())
+    assert seen == [float(i * i) for i in range(31)]  # ordered, complete
+    # second epoch works with the same persistent workers
+    n = sum(1 for _ in loader)
+    assert n == 4
+    loader._shutdown()
+
+
+def test_dataloader_abandoned_epoch_restarts_clean():
+    """Breaking out of an epoch must not leak that epoch's results into
+    the next one (stale-seq corruption) nor leak shm segments."""
+    from mxnet_tpu.gluon.data import DataLoader
+    loader = DataLoader(_SquareDataset(), batch_size=4, num_workers=2)
+    it = iter(loader)
+    next(it)  # consume one batch, abandon the rest mid-flight
+    it.close()
+    labels = [float(x) for _, l in loader for x in l.asnumpy().ravel()]
+    assert labels == [float(i * i) for i in range(31)], labels
+    loader._shutdown()
+
+
+def test_native_iter_reports_pad(imgrec):
+    path, _ = imgrec  # 24 records
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                               batch_size=9, shuffle=False)
+    pads = [b.pad for b in it]
+    # 24 records / batch 9 -> 9+9+6: last batch padded by 3 duplicates
+    assert pads == [0, 0, 3]
+
+
+def test_dataloader_worker_error_surfaces():
+    from mxnet_tpu.gluon.data import DataLoader
+
+    class Boom:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                raise ValueError("bad sample")
+            return onp.zeros(3, "float32")
+
+    loader = DataLoader(Boom(), batch_size=2, num_workers=1)
+    with pytest.raises(RuntimeError, match="bad sample"):
+        list(loader)
+    loader._shutdown()
+
+
+def test_dataloader_thread_pool_still_available():
+    from mxnet_tpu.gluon.data import DataLoader
+    loader = DataLoader(_SquareDataset(), batch_size=10, num_workers=2,
+                        thread_pool=True)
+    assert not loader._workers and loader._pool is not None
+    out = [b for b in loader]
+    assert len(out) == 4

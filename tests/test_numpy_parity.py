@@ -1,0 +1,210 @@
+"""mx.np parity vs NumPy (ref: src/operator/numpy/ _npi_ corpus,
+python/mxnet/numpy/; SURVEY Appendix A NumPy-namespace list)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+np = mx.np
+
+rs = onp.random.RandomState(0)
+A = rs.randn(4, 5).astype("float32")
+B = rs.randn(5, 3).astype("float32")
+V = rs.randn(6).astype("float32")
+
+
+def _chk(got, want, rtol=1e-5, atol=1e-5):
+    got = onp.asarray(got.asnumpy() if hasattr(got, "asnumpy") else got)
+    assert got.shape == onp.asarray(want).shape, \
+        f"shape {got.shape} vs {onp.asarray(want).shape}"
+    assert onp.allclose(got, want, rtol=rtol, atol=atol)
+
+
+# one (mx_expr, np_expr) row per op — executed identically on both
+CASES = [
+    ("tensordot", lambda m: m.tensordot(m.array(A), m.array(A), axes=2)),
+    ("tensordot_axes1", lambda m: m.tensordot(m.array(A), m.array(B),
+                                              axes=1)),
+    ("einsum", lambda m: m.einsum("ij,jk->ik", m.array(A), m.array(B))),
+    ("cumsum", lambda m: m.cumsum(m.array(A), axis=1)),
+    ("cumprod", lambda m: m.cumprod(m.array(onp.abs(A) + 0.5), axis=0)),
+    ("std", lambda m: m.std(m.array(A), axis=0, ddof=1)),
+    ("var", lambda m: m.var(m.array(A), axis=1)),
+    ("median", lambda m: m.median(m.array(A), axis=0)),
+    ("percentile", lambda m: m.percentile(m.array(A), 30.0, axis=1)),
+    ("average", lambda m: m.average(m.array(V), weights=m.array(
+        onp.abs(V) + 1))),
+    ("nansum", lambda m: m.nansum(m.array(A), axis=0)),
+    ("sort", lambda m: m.sort(m.array(A), axis=1)),
+    ("argsort", lambda m: m.argsort(m.array(V))),
+    ("flip", lambda m: m.flip(m.array(A), axis=0)),
+    ("roll", lambda m: m.roll(m.array(V), shift=2)),
+    ("trace", lambda m: m.trace(m.array(A[:4, :4]))),
+    ("tril", lambda m: m.tril(m.array(A))),
+    ("triu", lambda m: m.triu(m.array(A), k=1)),
+    ("diff", lambda m: m.diff(m.array(V))),
+    ("outer", lambda m: m.outer(m.array(V), m.array(V))),
+    ("inner", lambda m: m.inner(m.array(V), m.array(V))),
+    ("kron", lambda m: m.kron(m.array(A[:2, :2]), m.array(A[:2, :2]))),
+    ("vdot", lambda m: m.vdot(m.array(V), m.array(V))),
+    ("cross", lambda m: m.cross(m.array(V[:3]), m.array(V[3:6]))),
+    ("logaddexp", lambda m: m.logaddexp(m.array(A), m.array(A * 0.5))),
+    ("vstack", lambda m: m.vstack([m.array(A), m.array(A)])),
+    ("hstack", lambda m: m.hstack([m.array(A), m.array(A)])),
+    ("column_stack", lambda m: m.column_stack([m.array(V), m.array(V)])),
+    ("take", lambda m: m.take(m.array(V), m.array(
+        onp.asarray([0, 2, 4])), axis=0)),
+    ("searchsorted", lambda m: m.searchsorted(
+        m.array(onp.sort(V)), m.array(V[:3]))),
+    ("bincount", lambda m: m.bincount(m.array(
+        onp.asarray([0, 1, 1, 3])), minlength=5)),
+    ("interp", lambda m: m.interp(m.array(onp.asarray([0.5, 1.5])),
+                                  m.array(onp.asarray([0.0, 1.0, 2.0])),
+                                  m.array(onp.asarray([0.0, 10.0, 20.0])))),
+    ("pad", lambda m: m.pad(m.array(A), ((1, 1), (0, 2)))),
+    ("ptp", lambda m: m.ptp(m.array(A), axis=0)),
+    ("nan_to_num", lambda m: m.nan_to_num(m.array(
+        onp.asarray([1.0, onp.nan, onp.inf], "float32")))),
+    ("moveaxis", lambda m: m.moveaxis(m.array(
+        A.reshape(2, 2, 5)), 0, 2)),
+    ("repeat", lambda m: m.repeat(m.array(V), 3)),
+    ("logspace", lambda m: m.logspace(0.0, 2.0, 5)),
+    ("geomspace", lambda m: m.geomspace(1.0, 8.0, 4)),
+    ("identity", lambda m: m.identity(4)),
+    ("full_like", lambda m: m.full_like(m.array(A), 7.0)),
+]
+
+
+@pytest.mark.parametrize("name,expr", CASES, ids=[c[0] for c in CASES])
+def test_np_matches_numpy(name, expr):
+    class _NP:
+        def __getattr__(self, n):
+            return getattr(onp, n)
+
+        @staticmethod
+        def array(a):
+            return onp.asarray(a)
+
+    got = expr(np)
+    want = expr(_NP())
+    _chk(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_meshgrid_and_nonzero():
+    gx, gy = np.meshgrid(np.arange(3), np.arange(4))
+    wx, wy = onp.meshgrid(onp.arange(3), onp.arange(4))
+    _chk(gx, wx)
+    _chk(gy, wy)
+    nz = np.nonzero(np.array(onp.asarray([[0, 1], [2, 0]])))
+    wz = onp.nonzero(onp.asarray([[0, 1], [2, 0]]))
+    for g, w in zip(nz, wz):
+        _chk(g, w)
+
+
+def test_histogram():
+    h, edges = np.histogram(np.array(V), bins=4)
+    wh, wedges = onp.histogram(V, bins=4)
+    _chk(h, wh)
+    _chk(edges, wedges.astype("float32"), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+SPD = (lambda a: a @ a.T + 5 * onp.eye(4, dtype="float32"))(
+    rs.randn(4, 4).astype("float32"))
+
+
+LINALG_CASES = [
+    ("norm", lambda l, x: l.norm(x), lambda x: onp.linalg.norm(x)),
+    ("inv", lambda l, x: l.inv(x), lambda x: onp.linalg.inv(x)),
+    ("det", lambda l, x: l.det(x), lambda x: onp.linalg.det(x)),
+    ("cholesky", lambda l, x: l.cholesky(x),
+     lambda x: onp.linalg.cholesky(x)),
+    ("pinv", lambda l, x: l.pinv(x), lambda x: onp.linalg.pinv(x)),
+    ("matrix_rank", lambda l, x: l.matrix_rank(x),
+     lambda x: onp.linalg.matrix_rank(x)),
+    ("matrix_power", lambda l, x: l.matrix_power(x, 3),
+     lambda x: onp.linalg.matrix_power(x, 3)),
+    ("eigvalsh", lambda l, x: l.eigvalsh(x),
+     lambda x: onp.linalg.eigvalsh(x)),
+]
+
+
+@pytest.mark.parametrize("name,mx_fn,np_fn", LINALG_CASES,
+                         ids=[c[0] for c in LINALG_CASES])
+def test_linalg_matches_numpy(name, mx_fn, np_fn):
+    got = mx_fn(np.linalg, np.array(SPD))
+    want = np_fn(SPD.astype("float64")).astype("float32")
+    _chk(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_slogdet_solve_qr_svd_eigh():
+    sign, logdet = np.linalg.slogdet(np.array(SPD))
+    wsign, wlogdet = onp.linalg.slogdet(SPD)
+    assert float(sign.asscalar()) == pytest.approx(wsign)
+    assert float(logdet.asscalar()) == pytest.approx(wlogdet, rel=1e-4)
+
+    b = rs.randn(4, 2).astype("float32")
+    x = np.linalg.solve(np.array(SPD), np.array(b))
+    _chk(x, onp.linalg.solve(SPD, b), rtol=1e-3, atol=1e-3)
+
+    q, r = np.linalg.qr(np.array(A))
+    _chk(np.dot(q, r), A, rtol=1e-4, atol=1e-4)
+
+    u, s, vt = np.linalg.svd(np.array(A), full_matrices=False)
+    recon = u.asnumpy() @ onp.diag(s.asnumpy()) @ vt.asnumpy()
+    assert onp.allclose(recon, A, atol=1e-4)
+
+    w, v = np.linalg.eigh(np.array(SPD))
+    recon = v.asnumpy() @ onp.diag(w.asnumpy()) @ v.asnumpy().T
+    assert onp.allclose(recon, SPD, atol=1e-3)
+
+    ws = onp.linalg.eigvalsh(SPD)
+    _chk(w, ws.astype("float32"), rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_grad_flows():
+    from mxnet_tpu import autograd
+    x = np.array(SPD)
+    x.attach_grad()
+    with autograd.record():
+        y = np.linalg.slogdet(x)[1]
+    y.backward()
+    # d logdet / dX = X^-T
+    want = onp.linalg.inv(SPD).T
+    assert onp.allclose(x.grad.asnumpy(), want, atol=1e-3)
+
+
+def test_np_random_namespace():
+    a = np.random.uniform(0, 1, size=(3, 4))
+    assert a.shape == (3, 4)
+    b = np.random.normal(size=(2, 2))
+    assert b.shape == (2, 2)
+    assert type(a).__name__ == "ndarray"
+
+
+def test_positional_args_pass_through():
+    """Regression: positional axis/decimals/shift must not be swallowed
+    by the out= slot (silently wrong results)."""
+    a = onp.asarray([[1.0, 2.0], [3.0, 4.0]], "float32")
+    _chk(np.flip(np.array(a), 1), onp.flip(a, 1))
+    _chk(np.round(np.array(onp.asarray([1.234], "float32")), 2),
+         onp.round(onp.asarray([1.234], "float32"), 2))
+    _chk(np.roll(np.array(a), 1), onp.roll(a, 1))
+    _chk(np.tril(np.array(a), -1), onp.tril(a, -1))
+    _chk(np.cumprod(np.array(a), 1), onp.cumprod(a, 1))
+
+
+def test_average_returned_tuple():
+    w = onp.asarray([1.0, 3.0], "float32")
+    a = onp.asarray([2.0, 4.0], "float32")
+    avg, wsum = np.average(np.array(a), weights=np.array(w), returned=True)
+    assert float(avg.asscalar()) == pytest.approx(3.5)
+    assert float(wsum.asscalar()) == pytest.approx(4.0)
+
+
+def test_np_scalars_zero_dim():
+    s = np.sum(np.array(A))
+    assert s.shape == ()
+    assert isinstance(float(s.asscalar()), float)

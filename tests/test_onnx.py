@@ -1,0 +1,159 @@
+"""ONNX export/import round trip (ref: python/mxnet/contrib/onnx/;
+self-contained wire-format codec in contrib/onnx_proto.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib.onnx import (export_model, get_model_metadata,
+                                    import_model)
+
+rs = onp.random.RandomState(0)
+
+
+def _mlp():
+    x = sym.var("data")
+    h = sym.FullyConnected(x, num_hidden=8, name="fc1")
+    a = sym.Activation(h, act_type="relu", name="act1")
+    o = sym.FullyConnected(a, num_hidden=3, name="fc2")
+    return sym.softmax(o, name="prob")
+
+
+def _mlp_params():
+    return {"fc1_weight": nd.array(rs.randn(8, 6).astype("float32")),
+            "fc1_bias": nd.zeros((8,)),
+            "fc2_weight": nd.array(rs.randn(3, 8).astype("float32")),
+            "fc2_bias": nd.zeros((3,))}
+
+
+def test_export_import_mlp_round_trip(tmp_path):
+    net = _mlp()
+    params = _mlp_params()
+    path = str(tmp_path / "mlp.onnx")
+    export_model(net, params, [(2, 6)], onnx_file_path=path)
+
+    meta = get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 6))]
+    assert meta["output_tensor_data"][0][1] == (2, 3)
+
+    sym2, arg2, aux2 = import_model(path)
+    x = rs.randn(2, 6).astype("float32")
+    ref = net.bind(mx.cpu(), {"data": nd.array(x), **params}) \
+        .forward()[0].asnumpy()
+    args2 = {"data": nd.array(x)}
+    args2.update({k: v for k, v in arg2.items()})
+    got = sym2.bind(mx.cpu(), args2).forward()[0].asnumpy()
+    assert onp.allclose(got, ref, atol=1e-5)
+
+
+def test_export_import_convnet_round_trip(tmp_path):
+    x = sym.var("data")
+    c = sym.Convolution(x, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                        name="conv0")
+    a = sym.Activation(c, act_type="relu")
+    p = sym.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool0")
+    f = sym.Flatten(p, name="flat")
+    net = sym.FullyConnected(f, num_hidden=2, name="fc")
+    params = {
+        "conv0_weight": nd.array(rs.randn(4, 3, 3, 3).astype("float32")
+                                 * 0.1),
+        "conv0_bias": nd.zeros((4,)),
+        "fc_weight": nd.array(rs.randn(2, 4 * 4 * 4).astype("float32")
+                              * 0.1),
+        "fc_bias": nd.zeros((2,)),
+    }
+    path = str(tmp_path / "conv.onnx")
+    export_model(net, params, [(1, 3, 8, 8)], onnx_file_path=path)
+    sym2, arg2, _ = import_model(path)
+    xval = rs.randn(1, 3, 8, 8).astype("float32")
+    ref = net.bind(mx.cpu(), {"data": nd.array(xval), **params}) \
+        .forward()[0].asnumpy()
+    got = sym2.bind(mx.cpu(), {"data": nd.array(xval), **arg2}) \
+        .forward()[0].asnumpy()
+    assert onp.allclose(got, ref, atol=1e-4)
+
+
+def test_export_covers_batchnorm_and_elementwise(tmp_path):
+    x = sym.var("data")
+    b = sym.BatchNorm(x, name="bn", fix_gamma=False)
+    y = sym.broadcast_add(sym.tanh(b), sym.var("c"))
+    params = {"bn_gamma": nd.ones((3,)), "bn_beta": nd.zeros((3,)),
+              "bn_moving_mean": nd.zeros((3,)),
+              "bn_moving_var": nd.ones((3,)),
+              "c": nd.array(onp.asarray([1.0], "float32"))}
+    path = str(tmp_path / "bn.onnx")
+    export_model(y, params, [(2, 3, 4, 4)], onnx_file_path=path)
+    sym2, arg2, _ = import_model(path)
+    xv = rs.randn(2, 3, 4, 4).astype("float32")
+    ref = y.bind(mx.cpu(), {"data": nd.array(xv),
+                            **{k: v for k, v in params.items()}}) \
+        .forward()[0].asnumpy()
+    got = sym2.bind(mx.cpu(), {"data": nd.array(xv), **arg2}) \
+        .forward()[0].asnumpy()
+    assert onp.allclose(got, ref, atol=1e-4)
+
+
+def test_reduce_sum_axes_round_trip(tmp_path):
+    """opset>=13: ReduceSum ships axes as a tensor input."""
+    x = sym.var("data")
+    net = sym.sum(sym.relu(x), axis=1, keepdims=True)
+    path = str(tmp_path / "r.onnx")
+    export_model(net, {}, [(3, 4)], onnx_file_path=path)
+    from mxnet_tpu.contrib import onnx_proto
+    with open(path, "rb") as f:
+        g = onnx_proto.decode_model(f.read())
+    rsum = [n for n in g["nodes"] if n["op_type"] == "ReduceSum"][0]
+    assert len(rsum["inputs"]) == 2 and "axes" not in rsum["attrs"]
+    sym2, arg2, _ = import_model(path)
+    xv = rs.randn(3, 4).astype("float32")
+    ref = net.bind(mx.cpu(), {"data": nd.array(xv)}).forward()[0].asnumpy()
+    got = sym2.bind(mx.cpu(), {"data": nd.array(xv), **arg2}) \
+        .forward()[0].asnumpy()
+    assert got.shape == ref.shape and onp.allclose(got, ref, atol=1e-5)
+
+
+def test_import_gemm_transb0(tmp_path):
+    """External Gemm with transB=0 (weights (in, out)) imports with the
+    weight re-laid-out, producing correct numbers."""
+    from mxnet_tpu.contrib import onnx_proto as proto
+    w = rs.randn(5, 3).astype("float32")     # (in, out), transB=0
+    b = rs.randn(3).astype("float32")
+    nodes = [proto.node("Gemm", ["data", "w", "b"], ["out"], "g",
+                        {"transB": 0})]
+    g = proto.graph(nodes, "ext", [proto.tensor("w", w),
+                                   proto.tensor("b", b)],
+                    [proto.value_info("data", (2, 5))],
+                    [proto.value_info("out", (2, 3))])
+    path = str(tmp_path / "ext.onnx")
+    with open(path, "wb") as f:
+        f.write(proto.model(g))
+    sym2, arg2, _ = import_model(path)
+    xv = rs.randn(2, 5).astype("float32")
+    got = sym2.bind(mx.cpu(), {"data": nd.array(xv), **arg2}) \
+        .forward()[0].asnumpy()
+    assert onp.allclose(got, xv @ w + b, atol=1e-5)
+
+
+def test_unsupported_op_raises(tmp_path):
+    x = sym.var("data")
+    net = sym.CTCLoss(x, sym.var("l"))
+    with pytest.raises(mx.MXNetError, match="unsupported op"):
+        export_model(net, {}, [(4, 2, 5), (2, 3)],
+                     onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_wire_format_self_describing(tmp_path):
+    """The emitted file parses as standard protobuf TLV and starts with
+    the ir_version field (field 1, varint, value 7)."""
+    net = _mlp()
+    path = str(tmp_path / "m.onnx")
+    export_model(net, _mlp_params(), [(1, 6)], onnx_file_path=path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    assert blob[0] == 0x08 and blob[1] == 0x07  # ir_version=7
+    from mxnet_tpu.contrib import onnx_proto
+    g = onnx_proto.decode_model(blob)
+    assert g["opset"] == 17
+    assert {n["op_type"] for n in g["nodes"]} == {"Gemm", "Relu",
+                                                  "Softmax"}
