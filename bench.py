@@ -13,7 +13,11 @@ error is reported inside the JSON line instead of crashing.
 Env knobs:
   MXTPU_BENCH_BATCH   per-step batch size (default 256 accel / 8 cpu)
   MXTPU_BENCH_STEPS   timed steps (default 30 accel / 3 cpu)
-  MXTPU_BENCH_AMP     1 (default) = bf16 matmul/conv precision on MXU
+  MXTPU_BENCH_AMP     0 = fp32; 1 (default) = bf16 matmul/conv
+                      precision; 2 = full bf16 cast (params +
+                      activations; BN statistics stay fp32). The step is
+                      HBM-bandwidth-bound at fp32 so 2 is the big lever,
+                      but its conv compile takes >10 min on v5e — opt-in.
   MXTPU_BENCH_TIMEOUT watchdog seconds (default 1500)
 """
 import contextlib
@@ -131,7 +135,9 @@ def main():
                                "256" if on_accel else "8"))
     n_steps = int(os.environ.get("MXTPU_BENCH_STEPS",
                                  "30" if on_accel else "3"))
-    amp = os.environ.get("MXTPU_BENCH_AMP", "1") == "1"
+    # default 1: full-bf16 (2) hits a >10-minute XLA conv compile on the
+    # v5e chip — opt in explicitly when the watchdog budget allows
+    amp = int(os.environ.get("MXTPU_BENCH_AMP", "1"))
 
     from mxnet_tpu import gluon, nd
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
@@ -153,6 +159,20 @@ def main():
                          .astype("float32"))
         net(nd.array(xv[:1]))  # resolve deferred shapes on host
         trainer._extract_params()
+        if amp >= 2:
+            # full bf16: params + activations in bf16, BN stats fp32
+            # (the contrib/amp policy); optimizer state recreated to
+            # match the cast weight dtypes
+            bn = ("gamma", "beta", "running_mean", "running_var",
+                  "moving_mean", "moving_var")
+            trainer.params = {
+                k: (v if k.rsplit(".", 1)[-1] in bn
+                    else v.astype(jnp.bfloat16))
+                for k, v in trainer.params.items()}
+            trainer.opt_state = trainer._init_fn(
+                {n: v for n, v in trainer.params.items()
+                 if n in trainer.trainable}, **trainer.opt_params)
+            xv = xv.astype(jnp.bfloat16)
 
     if on_accel:
         dev = accel[0]
@@ -162,8 +182,9 @@ def main():
         yv = jax.device_put(yv, dev)
     x, y = nd.array(xv), nd.array(yv)
 
-    # bf16 matmul/conv precision: fp32 params/activations, MXU-rate compute
-    prec = jax.default_matmul_precision("bfloat16") if amp \
+    # amp=1: fp32 params/activations with MXU-rate bf16 matmul passes;
+    # amp=2 casts the tensors themselves (precision context is harmless)
+    prec = jax.default_matmul_precision("bfloat16") if amp >= 1 \
         else contextlib.nullcontext()
     with prec:
         for _ in range(2):  # warmup (compile)

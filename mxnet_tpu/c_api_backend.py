@@ -213,3 +213,185 @@ def version() -> int:
     from . import __version__
     major, minor, patch = (__version__.split(".") + ["0", "0"])[:3]
     return int(major) * 10000 + int(minor) * 100 + int(patch)
+
+
+# ---------------------------------------------------------------------------
+# general MX* ABI backend: NDArray / Symbol / Executor / imperative invoke
+# (ref: include/mxnet/c_api.h — the 234-function surface; this backend
+# powers the native shim's MXNDArray*/MXSymbol*/MXExecutor*/
+# MXImperativeInvoke subset, the embeddable training/inference ABI
+# beyond MXPred)
+# ---------------------------------------------------------------------------
+
+_nd_handles: Dict[int, object] = {}
+_sym_handles: Dict[int, object] = {}
+_exec_handles: Dict[int, object] = {}
+_handle_seq = [1]
+
+
+def _new_handle(table, obj) -> int:
+    with _lock:
+        h = _handle_seq[0]
+        _handle_seq[0] += 1
+        table[h] = obj
+    return h
+
+
+def _nd(h):
+    a = _nd_handles.get(h)
+    if a is None:
+        raise MXNetError(f"invalid NDArray handle {h}")
+    return a
+
+
+def ndarray_create(shape, dtype: str = "float32") -> int:
+    from .ndarray.ndarray import zeros
+    return _new_handle(_nd_handles, zeros(tuple(shape), dtype=dtype))
+
+
+def ndarray_from_bytes(data: bytes, shape, dtype: str = "float32") -> int:
+    from .ndarray.ndarray import array
+    arr = onp.frombuffer(data, dtype=dtype).reshape(tuple(shape))
+    return _new_handle(_nd_handles, array(arr))
+
+
+def ndarray_free(h: int):
+    with _lock:
+        _nd_handles.pop(h, None)
+
+
+def ndarray_get_shape(h: int):
+    return tuple(int(s) for s in _nd(h).shape)
+
+
+def ndarray_get_dtype(h: int) -> str:
+    return str(_nd(h).dtype)
+
+
+def ndarray_sync_copy_to_cpu(h: int) -> bytes:
+    return onp.ascontiguousarray(_nd(h).asnumpy()).tobytes()
+
+
+def ndarray_sync_copy_from_cpu(h: int, data: bytes):
+    a = _nd(h)
+    arr = onp.frombuffer(data, dtype=str(a.dtype)).reshape(a.shape)
+    from .ndarray.ndarray import array
+    a._rebind(array(arr)._data)
+
+
+def ndarray_save(fname: str, handles, names):
+    from .ndarray import ndarray as nd_mod
+    arrays = [_nd(h) for h in handles]
+    if names:
+        nd_mod.save(fname, dict(zip(names, arrays)))
+    else:
+        nd_mod.save(fname, arrays)
+
+
+def ndarray_load(fname: str):
+    """Returns (handles, names)."""
+    from .ndarray import ndarray as nd_mod
+    out = nd_mod.load(fname)
+    if isinstance(out, dict):
+        names = list(out.keys())
+        handles = [_new_handle(_nd_handles, out[n]) for n in names]
+        return handles, names
+    return [_new_handle(_nd_handles, a) for a in out], []
+
+
+def imperative_invoke(op_name: str, in_handles, param_keys, param_vals):
+    """ref: MXImperativeInvokeEx (src/c_api/c_api_ndarray.cc:132)."""
+    from .ndarray import ndarray as nd_mod
+    import mxnet_tpu.ndarray as nd_ns
+    fn = getattr(nd_ns, op_name, None)
+    if fn is None:
+        raise MXNetError(f"operator '{op_name}' is not registered")
+    import ast
+    params = {}
+    for k, v in zip(param_keys, param_vals):
+        try:  # literals only — an eval here would let ABI callers run
+            params[k] = ast.literal_eval(v)  # arbitrary expressions
+        except (ValueError, SyntaxError):
+            params[k] = v
+    out = fn(*[_nd(h) for h in in_handles], **params)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    return [_new_handle(_nd_handles, o) for o in outs]
+
+
+# -- symbol -----------------------------------------------------------------
+
+def _sym(h):
+    s = _sym_handles.get(h)
+    if s is None:
+        raise MXNetError(f"invalid Symbol handle {h}")
+    return s
+
+
+def symbol_create_from_json(json_str: str) -> int:
+    from .symbol.symbol import load_json
+    return _new_handle(_sym_handles, load_json(json_str))
+
+
+def symbol_save_to_json(h: int) -> str:
+    return _sym(h).tojson()
+
+
+def symbol_list_arguments(h: int):
+    return list(_sym(h).list_arguments())
+
+
+def symbol_list_outputs(h: int):
+    return list(_sym(h).list_outputs())
+
+
+def symbol_list_auxiliary_states(h: int):
+    return list(_sym(h).list_auxiliary_states())
+
+
+def symbol_free(h: int):
+    with _lock:
+        _sym_handles.pop(h, None)
+
+
+# -- executor ---------------------------------------------------------------
+
+def executor_bind(sym_h: int, dev_type: int, dev_id: int, arg_handles,
+                  grad_req: str = "null") -> int:
+    from . import context as ctx_mod
+    from .ndarray.ndarray import zeros as nd_zeros
+    sym = _sym(sym_h)
+    ctx = ctx_mod.cpu(dev_id) if dev_type == 1 else ctx_mod.tpu(dev_id)
+    args = [_nd(h) for h in arg_handles]
+    args_grad = None
+    if grad_req != "null":
+        args_grad = {n: nd_zeros(a.shape, dtype=str(a.dtype))
+                     for n, a in zip(sym.list_arguments(), args)}
+    exe = sym.bind(ctx, args, args_grad=args_grad, grad_req=grad_req)
+    return _new_handle(_exec_handles, exe)
+
+
+def _exec(h):
+    e = _exec_handles.get(h)
+    if e is None:
+        raise MXNetError(f"invalid Executor handle {h}")
+    return e
+
+
+def executor_forward(h: int, is_train: bool = False):
+    outs = _exec(h).forward(is_train=is_train)
+    return [_new_handle(_nd_handles, o) for o in outs]
+
+
+def executor_backward(h: int):
+    """ref: MXExecutorBackward — returns grad handles in declared
+    argument order (None-grads skipped)."""
+    exe = _exec(h)
+    exe.backward()
+    return [_new_handle(_nd_handles, exe.grad_dict[n])
+            for n in exe._symbol.list_arguments()
+            if exe.grad_dict.get(n) is not None]
+
+
+def executor_free(h: int):
+    with _lock:
+        _exec_handles.pop(h, None)

@@ -344,3 +344,375 @@ int MXPredFree(PredictorHandle handle) {
 }
 
 }  // extern "C"
+
+/* ------------------------------------------------------------------------
+ * General MX* ABI subset beyond MXPred: NDArray / Symbol / Executor /
+ * imperative invoke (ref: include/mxnet/c_api.h — MXNDArrayCreateEx,
+ * MXNDArraySyncCopy*, MXNDArraySave/Load, MXImperativeInvokeEx,
+ * MXSymbolCreateFromJSON, MXExecutorBind/Forward). Handles are opaque
+ * integer ids owned by the Python backend.
+ * --------------------------------------------------------------------- */
+
+namespace {
+
+thread_local std::vector<uint32_t> g_shape_buf;
+thread_local std::string g_str_buf;
+thread_local std::vector<void *> g_handle_buf;
+thread_local std::vector<std::string> g_name_buf;
+thread_local std::vector<const char *> g_name_ptr_buf;
+
+long as_id(void *h) { return reinterpret_cast<intptr_t>(h); }
+
+// PyTuple_Pack does NOT steal references; this does (so inline-created
+// argument objects are owned by the tuple and freed with it)
+template <typename... Os>
+PyObject *pack_steal(Os... objs) {
+  constexpr Py_ssize_t n = sizeof...(objs);
+  PyObject *arr[] = {objs...};
+  PyObject *t = PyTuple_New(n);
+  for (Py_ssize_t i = 0; i < n; ++i) PyTuple_SetItem(t, i, arr[i]);
+  return t;
+}
+void *as_handle(long id) {
+  return reinterpret_cast<void *>(static_cast<intptr_t>(id));
+}
+
+// run fn under lock+GIL; fn returns new ref or nullptr
+template <typename F>
+int with_backend(F &&fn) {
+  std::lock_guard<std::mutex> lk(g_mutex);
+  if (!ensure_backend()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = fn() ? 0 : -1;
+  PyGILState_Release(gil);
+  return rc;
+}
+
+PyObject *shape_list(const uint32_t *shape, uint32_t ndim) {
+  PyObject *s = PyList_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyList_SetItem(s, i, PyLong_FromUnsignedLong(shape[i]));
+  return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXNDArrayCreate(const uint32_t *shape, uint32_t ndim, const char *dtype,
+                    void **out) {
+  return with_backend([&]() -> bool {
+    PyObject *args = pack_steal(shape_list(shape, ndim),
+                                  PyUnicode_FromString(dtype));
+    PyObject *ret = call_backend("ndarray_create", args);
+    if (!ret) return false;
+    *out = as_handle(PyLong_AsLong(ret));
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXNDArrayCreateFromBytes(const void *data, uint64_t nbytes,
+                             const uint32_t *shape, uint32_t ndim,
+                             const char *dtype, void **out) {
+  return with_backend([&]() -> bool {
+    PyObject *args = PyTuple_Pack(
+        3,
+        PyBytes_FromStringAndSize(static_cast<const char *>(data),
+                                  static_cast<Py_ssize_t>(nbytes)),
+        shape_list(shape, ndim), PyUnicode_FromString(dtype));
+    PyObject *ret = call_backend("ndarray_from_bytes", args);
+    if (!ret) return false;
+    *out = as_handle(PyLong_AsLong(ret));
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXNDArrayFree(void *handle) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_free", pack_steal(PyLong_FromLong(as_id(handle))));
+    Py_XDECREF(ret);
+    return ret != nullptr;
+  });
+}
+
+int MXNDArrayGetShape(void *handle, uint32_t *out_dim,
+                      const uint32_t **out_pdata) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_get_shape",
+        pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    Py_ssize_t n = PyTuple_Size(ret);
+    g_shape_buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_shape_buf[i] = static_cast<uint32_t>(
+          PyLong_AsLong(PyTuple_GetItem(ret, i)));
+    Py_DECREF(ret);
+    *out_dim = static_cast<uint32_t>(n);
+    *out_pdata = g_shape_buf.data();
+    return true;
+  });
+}
+
+int MXNDArrayGetDType(void *handle, const char **out) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_get_dtype",
+        pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    const char *s = PyUnicode_AsUTF8(ret);
+    g_str_buf = s ? s : "";
+    Py_DECREF(ret);
+    *out = g_str_buf.c_str();
+    return true;
+  });
+}
+
+int MXNDArraySyncCopyToCPU(void *handle, void *data, uint64_t size) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_sync_copy_to_cpu",
+        pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    char *buf = nullptr;
+    Py_ssize_t n = 0;
+    PyBytes_AsStringAndSize(ret, &buf, &n);
+    if (static_cast<uint64_t>(n) > size) {
+      set_error("MXNDArraySyncCopyToCPU: buffer too small");
+      Py_DECREF(ret);
+      return false;
+    }
+    std::memcpy(data, buf, static_cast<size_t>(n));
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXNDArraySyncCopyFromCPU(void *handle, const void *data, uint64_t size) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_sync_copy_from_cpu",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                     PyBytes_FromStringAndSize(
+                         static_cast<const char *>(data),
+                         static_cast<Py_ssize_t>(size))));
+    Py_XDECREF(ret);
+    return ret != nullptr;
+  });
+}
+
+int MXNDArraySave(const char *fname, uint32_t num, void **handles,
+                  const char **keys) {
+  return with_backend([&]() -> bool {
+    PyObject *hs = PyList_New(num);
+    PyObject *ks = PyList_New(keys ? num : 0);
+    for (uint32_t i = 0; i < num; ++i) {
+      PyList_SetItem(hs, i, PyLong_FromLong(as_id(handles[i])));
+      if (keys) PyList_SetItem(ks, i, PyUnicode_FromString(keys[i]));
+    }
+    PyObject *ret = call_backend(
+        "ndarray_save",
+        pack_steal(PyUnicode_FromString(fname), hs, ks));
+    Py_XDECREF(ret);
+    return ret != nullptr;
+  });
+}
+
+int MXNDArrayLoad(const char *fname, uint32_t *out_size, void ***out_arr,
+                  uint32_t *out_name_size, const char ***out_names) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_load", pack_steal(PyUnicode_FromString(fname)));
+    if (!ret) return false;
+    PyObject *hs = PyTuple_GetItem(ret, 0);
+    PyObject *ns = PyTuple_GetItem(ret, 1);
+    Py_ssize_t n = PyList_Size(hs), nn = PyList_Size(ns);
+    g_handle_buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_handle_buf[i] = as_handle(PyLong_AsLong(PyList_GetItem(hs, i)));
+    g_name_buf.clear();
+    g_name_ptr_buf.clear();
+    for (Py_ssize_t i = 0; i < nn; ++i) {
+      const char *s = PyUnicode_AsUTF8(PyList_GetItem(ns, i));
+      g_name_buf.emplace_back(s ? s : "");
+    }
+    for (const auto &s : g_name_buf) g_name_ptr_buf.push_back(s.c_str());
+    Py_DECREF(ret);
+    *out_size = static_cast<uint32_t>(n);
+    *out_arr = g_handle_buf.data();
+    *out_name_size = static_cast<uint32_t>(nn);
+    *out_names = g_name_ptr_buf.data();
+    return true;
+  });
+}
+
+int MXImperativeInvoke(const char *op_name, int num_inputs, void **inputs,
+                       int *num_outputs, void ***outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  return with_backend([&]() -> bool {
+    PyObject *ins = PyList_New(num_inputs);
+    for (int i = 0; i < num_inputs; ++i)
+      PyList_SetItem(ins, i, PyLong_FromLong(as_id(inputs[i])));
+    PyObject *ks = PyList_New(num_params);
+    PyObject *vs = PyList_New(num_params);
+    for (int i = 0; i < num_params; ++i) {
+      PyList_SetItem(ks, i, PyUnicode_FromString(param_keys[i]));
+      PyList_SetItem(vs, i, PyUnicode_FromString(param_vals[i]));
+    }
+    PyObject *ret = call_backend(
+        "imperative_invoke",
+        pack_steal(PyUnicode_FromString(op_name), ins, ks, vs));
+    if (!ret) return false;
+    Py_ssize_t n = PyList_Size(ret);
+    g_handle_buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_handle_buf[i] = as_handle(PyLong_AsLong(PyList_GetItem(ret, i)));
+    Py_DECREF(ret);
+    *num_outputs = static_cast<int>(n);
+    *outputs = g_handle_buf.data();
+    return true;
+  });
+}
+
+int MXSymbolCreateFromJSON(const char *json, void **out) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "symbol_create_from_json",
+        pack_steal(PyUnicode_FromString(json)));
+    if (!ret) return false;
+    *out = as_handle(PyLong_AsLong(ret));
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXSymbolSaveToJSON(void *handle, const char **out_json) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "symbol_save_to_json",
+        pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    const char *s = PyUnicode_AsUTF8(ret);
+    g_str_buf = s ? s : "";
+    Py_DECREF(ret);
+    *out_json = g_str_buf.c_str();
+    return true;
+  });
+}
+
+static int list_strings(const char *fn, void *handle, uint32_t *out_size,
+                        const char ***out_arr) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        fn, pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    Py_ssize_t n = PyList_Size(ret);
+    g_name_buf.clear();
+    g_name_ptr_buf.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char *s = PyUnicode_AsUTF8(PyList_GetItem(ret, i));
+      g_name_buf.emplace_back(s ? s : "");
+    }
+    for (const auto &s : g_name_buf) g_name_ptr_buf.push_back(s.c_str());
+    Py_DECREF(ret);
+    *out_size = static_cast<uint32_t>(n);
+    *out_arr = g_name_ptr_buf.data();
+    return true;
+  });
+}
+
+int MXSymbolListArguments(void *handle, uint32_t *out_size,
+                          const char ***out_arr) {
+  return list_strings("symbol_list_arguments", handle, out_size, out_arr);
+}
+
+int MXSymbolListOutputs(void *handle, uint32_t *out_size,
+                        const char ***out_arr) {
+  return list_strings("symbol_list_outputs", handle, out_size, out_arr);
+}
+
+int MXSymbolListAuxiliaryStates(void *handle, uint32_t *out_size,
+                                const char ***out_arr) {
+  return list_strings("symbol_list_auxiliary_states", handle, out_size,
+                      out_arr);
+}
+
+int MXSymbolFree(void *handle) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "symbol_free", pack_steal(PyLong_FromLong(as_id(handle))));
+    Py_XDECREF(ret);
+    return ret != nullptr;
+  });
+}
+
+int MXExecutorBind(void *sym_handle, int dev_type, int dev_id,
+                   uint32_t num_args, void **arg_handles,
+                   const char *grad_req, void **out) {
+  return with_backend([&]() -> bool {
+    PyObject *args_list = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i)
+      PyList_SetItem(args_list, i,
+                     PyLong_FromLong(as_id(arg_handles[i])));
+    PyObject *ret = call_backend(
+        "executor_bind",
+        pack_steal(PyLong_FromLong(as_id(sym_handle)),
+                   PyLong_FromLong(dev_type), PyLong_FromLong(dev_id),
+                   args_list,
+                   PyUnicode_FromString(grad_req ? grad_req : "null")));
+    if (!ret) return false;
+    *out = as_handle(PyLong_AsLong(ret));
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXExecutorBackward(void *handle, uint32_t *out_size, void ***grads) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "executor_backward",
+        pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    Py_ssize_t n = PyList_Size(ret);
+    g_handle_buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_handle_buf[i] = as_handle(PyLong_AsLong(PyList_GetItem(ret, i)));
+    Py_DECREF(ret);
+    *out_size = static_cast<uint32_t>(n);
+    *grads = g_handle_buf.data();
+    return true;
+  });
+}
+
+int MXExecutorForward(void *handle, int is_train, uint32_t *out_size,
+                      void ***outputs) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "executor_forward",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                     PyBool_FromLong(is_train)));
+    if (!ret) return false;
+    Py_ssize_t n = PyList_Size(ret);
+    g_handle_buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_handle_buf[i] = as_handle(PyLong_AsLong(PyList_GetItem(ret, i)));
+    Py_DECREF(ret);
+    *out_size = static_cast<uint32_t>(n);
+    *outputs = g_handle_buf.data();
+    return true;
+  });
+}
+
+int MXExecutorFree(void *handle) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "executor_free", pack_steal(PyLong_FromLong(as_id(handle))));
+    Py_XDECREF(ret);
+    return ret != nullptr;
+  });
+}
+
+}  // extern "C"

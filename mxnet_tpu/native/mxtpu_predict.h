@@ -63,6 +63,61 @@ int MXPredGetOutput(PredictorHandle handle, uint32_t index, float *data,
                     uint32_t size);
 int MXPredFree(PredictorHandle handle);
 
+
+/* ------------------------------------------------------------------------
+ * General MX* ABI subset (ref: include/mxnet/c_api.h): NDArray / Symbol /
+ * Executor handles + imperative invoke. Handles are opaque ids owned by
+ * the embedded runtime; every function returns 0 on success, -1 on error
+ * (message via MXGetLastError).
+ * --------------------------------------------------------------------- */
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+
+int MXNDArrayCreate(const uint32_t *shape, uint32_t ndim,
+                              const char *dtype, NDArrayHandle *out);
+int MXNDArrayCreateFromBytes(const void *data, uint64_t nbytes,
+                                       const uint32_t *shape, uint32_t ndim,
+                                       const char *dtype, NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, uint32_t *out_dim,
+                                const uint32_t **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, const char **out);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     uint64_t size);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
+                                       const void *data, uint64_t size);
+int MXNDArraySave(const char *fname, uint32_t num,
+                            NDArrayHandle *handles, const char **keys);
+int MXNDArrayLoad(const char *fname, uint32_t *out_size,
+                            NDArrayHandle **out_arr,
+                            uint32_t *out_name_size,
+                            const char ***out_names);
+int MXImperativeInvoke(const char *op_name, int num_inputs,
+                                 NDArrayHandle *inputs, int *num_outputs,
+                                 NDArrayHandle **outputs, int num_params,
+                                 const char **param_keys,
+                                 const char **param_vals);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+int MXSymbolListArguments(SymbolHandle handle, uint32_t *out_size,
+                                    const char ***out_arr);
+int MXSymbolListOutputs(SymbolHandle handle, uint32_t *out_size,
+                                  const char ***out_arr);
+int MXSymbolListAuxiliaryStates(SymbolHandle handle,
+                                          uint32_t *out_size,
+                                          const char ***out_arr);
+int MXSymbolFree(SymbolHandle handle);
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                   uint32_t num_args, NDArrayHandle *args,
+                   const char *grad_req, ExecutorHandle *out);
+int MXExecutorBackward(ExecutorHandle handle, uint32_t *out_size,
+                       NDArrayHandle **grads);
+int MXExecutorForward(ExecutorHandle handle, int is_train,
+                                uint32_t *out_size, NDArrayHandle **outputs);
+int MXExecutorFree(ExecutorHandle handle);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

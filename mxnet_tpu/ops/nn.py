@@ -457,17 +457,25 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     red = tuple(i for i in range(data.ndim) if i != axis)
     shape = [1] * data.ndim
     shape[axis] = -1
+    # mixed precision: statistics/affine at >= fp32 (never downcast
+    # f64), output back in the activation dtype (the contrib/amp BN
+    # convention — fp32 stats with low-precision activations must not
+    # silently upcast the network)
+    in_dtype = data.dtype
+    stat_dtype = jnp.promote_types(in_dtype, jnp.float32)
+    xf = data.astype(stat_dtype) if in_dtype != stat_dtype else data
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
         new_mean = moving_mean * momentum + mean * (1 - momentum)
         new_var = moving_var * momentum + var * (1 - momentum)
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
-    out = (data - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
     out = out * g.reshape(shape) + beta.reshape(shape)
-    return out, jax.lax.stop_gradient(new_mean), jax.lax.stop_gradient(new_var)
+    return (out.astype(in_dtype), jax.lax.stop_gradient(new_mean),
+            jax.lax.stop_gradient(new_var))
 
 
 @register_op("LayerNorm", input_names=("data", "gamma", "beta"))

@@ -1,0 +1,189 @@
+/* End-to-end test of the general MX* C ABI subset (NDArray / Symbol /
+ * Executor / imperative invoke) — ref: include/mxnet/c_api.h consumers.
+ * Usage: test_c_api <symbol.json path> <params path>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu_predict.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+#define CHECK(cond, msg)                                  \
+  if (!(cond)) {                                          \
+    fprintf(stderr, "FAIL %s: %s\n", msg, MXGetLastError()); \
+    return 1;                                             \
+  }
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s symbol.json file.params\n", argv[0]);
+    return 2;
+  }
+
+  /* --- NDArray create / copy / shape ------------------------------- */
+  uint32_t shape[2] = {2, 3};
+  float vals[6] = {1, 2, 3, 4, 5, 6};
+  NDArrayHandle a = NULL, b = NULL;
+  CHECK(MXNDArrayCreateFromBytes(vals, sizeof(vals), shape, 2, "float32",
+                                 &a) == 0, "CreateFromBytes");
+  CHECK(MXNDArrayCreate(shape, 2, "float32", &b) == 0, "Create");
+  CHECK(MXNDArraySyncCopyFromCPU(b, vals, sizeof(vals)) == 0,
+        "SyncCopyFromCPU");
+
+  uint32_t ndim = 0;
+  const uint32_t *pshape = NULL;
+  CHECK(MXNDArrayGetShape(a, &ndim, &pshape) == 0, "GetShape");
+  CHECK(ndim == 2 && pshape[0] == 2 && pshape[1] == 3, "shape values");
+  const char *dt = NULL;
+  CHECK(MXNDArrayGetDType(a, &dt) == 0 && strcmp(dt, "float32") == 0,
+        "GetDType");
+
+  /* --- imperative invoke: a + b ------------------------------------ */
+  NDArrayHandle inputs[2] = {a, b};
+  NDArrayHandle *outputs = NULL;
+  int n_out = 0;
+  CHECK(MXImperativeInvoke("elemwise_add", 2, inputs, &n_out, &outputs, 0,
+                           NULL, NULL) == 0, "ImperativeInvoke");
+  CHECK(n_out == 1, "one output");
+  float got[6];
+  CHECK(MXNDArraySyncCopyToCPU(outputs[0], got, sizeof(got)) == 0,
+        "SyncCopyToCPU");
+  for (int i = 0; i < 6; ++i)
+    CHECK(got[i] == 2 * vals[i], "elemwise_add values");
+  printf("invoke_ok=1\n");
+
+  /* --- invoke with params: sum(axis=1) ----------------------------- */
+  const char *keys[1] = {"axis"};
+  const char *pvals[1] = {"1"};
+  NDArrayHandle *sout = NULL;
+  int n_sout = 0;
+  CHECK(MXImperativeInvoke("sum", 1, &a, &n_sout, &sout, 1, keys,
+                           pvals) == 0, "Invoke sum");
+  float svals[2];
+  CHECK(MXNDArraySyncCopyToCPU(sout[0], svals, sizeof(svals)) == 0,
+        "sum copy");
+  CHECK(svals[0] == 6.0f && svals[1] == 15.0f, "sum values");
+
+  /* --- save / load reference-format .params ------------------------ */
+  const char *names[1] = {"arr_a"};
+  CHECK(MXNDArraySave("test_c_api_tmp.params", 1, &a, names) == 0, "Save");
+  uint32_t ln = 0, lnn = 0;
+  NDArrayHandle *loaded = NULL;
+  const char **lnames = NULL;
+  CHECK(MXNDArrayLoad("test_c_api_tmp.params", &ln, &loaded, &lnn,
+                      &lnames) == 0, "Load");
+  CHECK(ln == 1 && lnn == 1 && strcmp(lnames[0], "arr_a") == 0,
+        "load names");
+  remove("test_c_api_tmp.params");
+  printf("saveload_ok=1\n");
+
+  /* --- symbol + executor ------------------------------------------- */
+  long jsize = 0;
+  char *json = read_file(argv[1], &jsize);
+  CHECK(json != NULL, "read symbol json");
+  SymbolHandle sym = NULL;
+  CHECK(MXSymbolCreateFromJSON(json, &sym) == 0, "SymbolCreateFromJSON");
+  free(json);
+  uint32_t n_args = 0;
+  const char **arg_names = NULL;
+  CHECK(MXSymbolListArguments(sym, &n_args, &arg_names) == 0,
+        "ListArguments");
+  printf("n_args=%u\n", n_args);
+  /* the list/load string buffers are thread-local and reused by the
+   * next call — copy the argument names BEFORE anything else runs */
+  char **arg_copy = (char **)malloc(sizeof(char *) * n_args);
+  for (uint32_t i = 0; i < n_args; ++i) arg_copy[i] = strdup(arg_names[i]);
+  const char *sjson = NULL;
+  CHECK(MXSymbolSaveToJSON(sym, &sjson) == 0 && strlen(sjson) > 10,
+        "SaveToJSON");
+
+  /* load the checkpoint params and bind in declared-argument order */
+  uint32_t pn = 0, pnn = 0;
+  NDArrayHandle *params = NULL;
+  const char **pnames = NULL;
+  CHECK(MXNDArrayLoad(argv[2], &pn, &params, &pnn, &pnames) == 0,
+        "load params");
+  NDArrayHandle *bind_args =
+      (NDArrayHandle *)malloc(sizeof(NDArrayHandle) * n_args);
+  char **pname_copy = (char **)malloc(sizeof(char *) * pnn);
+  NDArrayHandle *param_copy =
+      (NDArrayHandle *)malloc(sizeof(NDArrayHandle) * pn);
+  for (uint32_t i = 0; i < pn; ++i) param_copy[i] = params[i];
+  for (uint32_t i = 0; i < pnn; ++i) pname_copy[i] = strdup(pnames[i]);
+
+  uint32_t data_shape[2] = {1, 6};
+  for (uint32_t i = 0; i < n_args; ++i) {
+    bind_args[i] = NULL;
+    for (uint32_t j = 0; j < pnn; ++j) {
+      const char *nm = pname_copy[j];
+      if (strncmp(nm, "arg:", 4) == 0) nm += 4;
+      if (strcmp(nm, arg_copy[i]) == 0) bind_args[i] = param_copy[j];
+    }
+    if (!bind_args[i]) { /* the data input */
+      CHECK(MXNDArrayCreate(data_shape, 2, "float32", &bind_args[i]) == 0,
+            "create data arg");
+      float x[6];
+      for (int k = 0; k < 6; ++k) x[k] = (float)k / 6.0f;
+      CHECK(MXNDArraySyncCopyFromCPU(bind_args[i], x, sizeof(x)) == 0,
+            "fill data");
+    }
+  }
+  ExecutorHandle exec = NULL;
+  CHECK(MXExecutorBind(sym, 1, 0, n_args, bind_args, "write", &exec) == 0,
+        "ExecutorBind");
+  uint32_t n_outs = 0;
+  NDArrayHandle *exec_outs = NULL;
+  CHECK(MXExecutorForward(exec, 0, &n_outs, &exec_outs) == 0,
+        "ExecutorForward");
+  CHECK(n_outs >= 1, "executor outputs");
+  const uint32_t *oshape = NULL;
+  uint32_t odim = 0;
+  CHECK(MXNDArrayGetShape(exec_outs[0], &odim, &oshape) == 0, "out shape");
+  uint32_t total = 1;
+  for (uint32_t i = 0; i < odim; ++i) total *= oshape[i];
+  float *out_vals = (float *)malloc(sizeof(float) * total);
+  CHECK(MXNDArraySyncCopyToCPU(exec_outs[0], out_vals,
+                               sizeof(float) * total) == 0, "out copy");
+  float s = 0;
+  printf("exec_out=");
+  for (uint32_t i = 0; i < total; ++i) {
+    s += out_vals[i];
+    if (i < 8) printf("%.6f ", out_vals[i]);
+  }
+  printf("\n");
+  printf("exec_out_sum=%.6f\n", s);
+  CHECK(s > 0.99f && s < 1.01f, "softmax sums to 1");
+
+  uint32_t n_grads = 0;
+  NDArrayHandle *grads = NULL;
+  CHECK(MXExecutorBackward(exec, &n_grads, &grads) == 0,
+        "ExecutorBackward");
+  printf("n_grads=%u\n", n_grads);
+  CHECK(n_grads == n_args, "gradient per argument");
+  const uint32_t *gshape = NULL;
+  uint32_t gdim = 0;
+  CHECK(MXNDArrayGetShape(grads[0], &gdim, &gshape) == 0, "grad shape");
+
+  CHECK(MXExecutorFree(exec) == 0, "ExecutorFree");
+  CHECK(MXSymbolFree(sym) == 0, "SymbolFree");
+  CHECK(MXNDArrayFree(a) == 0 && MXNDArrayFree(b) == 0, "NDArrayFree");
+  printf("C_API_OK\n");
+  return 0;
+}

@@ -55,6 +55,52 @@ def test_backend_output_shape_before_forward(tmp_path):
         cab.free(h)
 
 
+def test_c_general_abi_end_to_end(tmp_path):
+    """NDArray/Symbol/Executor/imperative-invoke through the C ABI
+    (ref: include/mxnet/c_api.h MX* surface beyond MXPred)."""
+    from mxnet_tpu.native import build_capi
+    build_capi()
+
+    net = _mlp()
+    rs = onp.random.RandomState(0)
+    args = {"fc1_weight": nd.array(rs.randn(8, 6).astype("float32")),
+            "fc1_bias": nd.zeros((8,)),
+            "fc2_weight": nd.array(rs.randn(3, 8).astype("float32")),
+            "fc2_bias": nd.zeros((3,))}
+    sym_path = str(tmp_path / "net-symbol.json")
+    net.save(sym_path)
+    param_path = str(tmp_path / "net-0000.params")
+    nd.save(param_path, {f"arg:{k}": v for k, v in args.items()})
+
+    c_src = os.path.join(ROOT, "tests", "cpredict", "test_c_api.c")
+    c_bin = str(tmp_path / "test_c_api")
+    subprocess.run(["gcc", "-O2", c_src, f"-I{NATIVE}", f"-L{NATIVE}",
+                    "-lmxtpu_capi", f"-Wl,-rpath,{NATIVE}", "-o", c_bin],
+                   check=True, capture_output=True)
+    import site
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + site.getsitepackages()[0]
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([c_bin, sym_path, param_path], env=env,
+                          cwd=str(tmp_path), capture_output=True,
+                          text=True, timeout=380)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"C ABI test failed:\n{out[-3000:]}"
+    assert "C_API_OK" in out
+    assert "invoke_ok=1" in out and "saveload_ok=1" in out
+    assert "n_args=5" in out  # data + 4 params
+    # the executor output must match the python-side executor on the
+    # SAME weights — catches silently-wrong bindings (softmax summing
+    # to 1 alone would not)
+    x = (onp.arange(6, dtype="float32") / 6.0).reshape(1, 6)
+    exe = net.bind(mx.cpu(), {"data": nd.array(x), **args})
+    ref = exe.forward()[0].asnumpy().ravel()
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("exec_out=")][0]
+    c_vals = [float(v) for v in line[9:].split()]
+    assert onp.allclose(c_vals, ref[:len(c_vals)], atol=1e-5)
+
+
 def test_c_predict_end_to_end(tmp_path):
     from mxnet_tpu.native import build_capi
     so = build_capi()

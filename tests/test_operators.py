@@ -70,6 +70,21 @@ def test_pooling():
     assert full.shape == (1, 1, 3, 3)
 
 
+def test_batchnorm_preserves_activation_dtype():
+    """Mixed precision: BN computes stats in fp32 but must return the
+    activation dtype (bf16 nets would silently upcast otherwise)."""
+    x = nd.array(onp.random.randn(2, 3, 4, 4).astype("float32")) \
+        .astype("bfloat16")
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mm, mv = nd.zeros((3,)), nd.ones((3,))
+    out, _, _ = nd.BatchNorm(x, gamma, beta, mm, mv, _training=True,
+                             fix_gamma=False)
+    assert str(out.dtype) == "bfloat16"
+    out2, _, _ = nd.BatchNorm(x.astype("float32"), gamma, beta, mm, mv,
+                              _training=True, fix_gamma=False)
+    assert str(out2.dtype) == "float32"
+
+
 def test_batchnorm_modes():
     x = nd.array(onp.random.randn(8, 3, 4, 4).astype("float32") * 2 + 3)
     gamma, beta = nd.ones(3), nd.zeros(3)
