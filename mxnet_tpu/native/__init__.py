@@ -295,6 +295,10 @@ class NativeImagePipeline:
             int(label_width), int(rand_crop), int(rand_mirror),
             int(shuffle), int(self._nhwc), m, s, seed, num_workers,
             float(label_pad_value), int(force_resize))
+        if not self._h:
+            self._reader.close()
+            raise ValueError(
+                f"imgpipe_create rejected batch_size={batch_size}")
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.label_width = label_width

@@ -23,7 +23,7 @@
 #include <csetjmp>
 #include <cstdint>
 #include <cstring>
-#include <deque>
+#include <map>
 #include <mutex>
 #include <random>
 #include <string>
@@ -120,7 +120,8 @@ struct ImgBatch {
   std::vector<float> data;
   std::vector<float> labels;
   int64_t n = 0;
-  int64_t pad = 0;  // wrap-padded duplicates in this batch
+  int64_t pad = 0;      // wrap-padded duplicates in this batch
+  uint64_t seq = 0;     // batch index within the epoch (delivery order)
 };
 
 struct ImagePipeline {
@@ -137,7 +138,13 @@ struct ImagePipeline {
   size_t cursor = 0;
   std::mutex cursor_mu;
 
-  std::deque<ImgBatch*> ready;
+  // batches are produced by whichever worker finishes first but MUST be
+  // consumed in epoch order (reference ImageRecordIter2 is deterministic
+  // per seed): workers insert keyed by seq, the consumer pops next_out.
+  // Backpressure is a sliding window over seq (not queue size) so the
+  // worker holding next_out can never be blocked out by later batches.
+  std::map<uint64_t, ImgBatch*> ready;
+  uint64_t next_out = 0;
   std::mutex mu;
   std::condition_variable cv_ready, cv_space;
   size_t max_ready = 4;
@@ -163,7 +170,7 @@ struct ImagePipeline {
                     int64_t* pad) {
     std::lock_guard<std::mutex> lk(cursor_mu);
     if (cursor >= order.size()) return false;
-    *batch_id = cursor;
+    *batch_id = cursor / static_cast<size_t>(batch);
     size_t end = std::min(cursor + static_cast<size_t>(batch),
                           order.size());
     idx->assign(order.begin() + cursor, order.begin() + end);
@@ -297,6 +304,7 @@ struct ImagePipeline {
       std::mt19937_64 rng(seed * 1000003u + epoch * 10007u + batch_id);
       ImgBatch* b = new ImgBatch();
       b->pad = pad;
+      b->seq = batch_id;
       size_t img_elems = static_cast<size_t>(C) * H * W;
       b->data.resize(static_cast<size_t>(batch) * img_elems);
       b->labels.resize(static_cast<size_t>(batch) * label_width);
@@ -315,42 +323,48 @@ struct ImagePipeline {
         }
       }
       std::unique_lock<std::mutex> lk(mu);
-      cv_space.wait(lk, [this] {
-        return ready.size() < max_ready || stop.load();
+      cv_space.wait(lk, [this, b] {
+        return b->seq < next_out + max_ready || stop.load();
       });
       if (stop.load()) {
         delete b;
         active.fetch_sub(1);
+        cv_ready.notify_all();
         return;
       }
-      ready.push_back(b);
-      cv_ready.notify_one();
-    }
-    // only the LAST exiting worker marks end-of-epoch — an earlier
-    // marker would make the consumer drop batches still in flight
-    if (active.fetch_sub(1) == 1) {
-      std::unique_lock<std::mutex> lk(mu);
-      ready.push_back(nullptr);
+      ready.emplace(b->seq, b);
       cv_ready.notify_all();
     }
+    // end-of-epoch is detected by the consumer: active==0 and the
+    // reorder map fully drained; just wake it up
+    active.fetch_sub(1);
+    std::unique_lock<std::mutex> lk(mu);
+    cv_ready.notify_all();
   }
 
   void start(int num_workers) {
     stop.store(false);
     reset_order();
+    next_out = 0;
     active.store(num_workers);
     for (int i = 0; i < num_workers; ++i)
       workers.emplace_back([this] { worker_loop(); });
   }
 
   void shutdown() {
-    stop.store(true);
+    {
+      // set stop under mu: a worker that just evaluated its cv_space
+      // predicate (stop still false) but not yet blocked would miss an
+      // unsynchronized notify and sleep forever, hanging the join
+      std::lock_guard<std::mutex> lk(mu);
+      stop.store(true);
+    }
     cv_space.notify_all();
     cv_ready.notify_all();
     for (auto& t : workers)
       if (t.joinable()) t.join();
     workers.clear();
-    for (ImgBatch* b : ready) delete b;
+    for (auto& kv : ready) delete kv.second;
     ready.clear();
   }
 };
@@ -365,6 +379,7 @@ void* imgpipe_create(void* reader, int batch, int channels, int height,
                      const float* mean3, const float* std3, uint64_t seed,
                      int num_workers, float label_pad_value,
                      int force_resize) {
+  if (batch <= 0 || !reader) return nullptr;
   ImagePipeline* p = new ImagePipeline();
   p->reader = reader;
   p->batch = batch;
@@ -388,15 +403,21 @@ void* imgpipe_create(void* reader, int batch, int channels, int height,
   return p;
 }
 
-// Returns an ImgBatch* or nullptr at end of epoch.
+// Returns an ImgBatch* or nullptr at end of epoch. Batches come out in
+// epoch order (seq 0, 1, 2, ...) regardless of worker completion order.
 void* imgpipe_next(void* pipe) {
   ImagePipeline* p = static_cast<ImagePipeline*>(pipe);
   std::unique_lock<std::mutex> lk(p->mu);
-  p->cv_ready.wait(lk, [p] { return !p->ready.empty() || p->stop.load(); });
-  if (p->ready.empty()) return nullptr;
-  ImgBatch* b = p->ready.front();
-  p->ready.pop_front();
-  p->cv_space.notify_one();
+  p->cv_ready.wait(lk, [p] {
+    return p->ready.count(p->next_out) || p->stop.load() ||
+           (p->active.load() == 0 && p->ready.empty());
+  });
+  auto it = p->ready.find(p->next_out);
+  if (it == p->ready.end()) return nullptr;  // epoch done or stopping
+  ImgBatch* b = it->second;
+  p->ready.erase(it);
+  p->next_out += 1;
+  p->cv_space.notify_all();  // window slid: several workers may now fit
   return b;
 }
 

@@ -47,6 +47,22 @@ def test_decode_matches_pil(imgrec):
     pipe.close()
 
 
+def test_batches_delivered_in_order(imgrec):
+    """Batch delivery order must be epoch order even with many decode
+    workers racing (the reorder window in image_pipeline.cc)."""
+    from mxnet_tpu.native import NativeImagePipeline
+    path, _ = imgrec
+    pipe = NativeImagePipeline(path, batch_size=2, data_shape=(3, 32, 32),
+                               num_workers=8)
+    for _ in range(3):  # racy property: several epochs via reset()
+        labels = onp.concatenate([l.ravel() for _, l in pipe])
+        assert labels.tolist() == [float(i % 7) for i in range(24)]
+        pipe.reset()
+    pipe.close()
+    with pytest.raises(ValueError):
+        NativeImagePipeline(path, batch_size=0, data_shape=(3, 32, 32))
+
+
 def test_resize_crop_mirror_normalize(imgrec):
     from mxnet_tpu.native import NativeImagePipeline
     path, raw = imgrec
