@@ -171,6 +171,18 @@ def get_logger(name: str = "mxnet_tpu", level=logging.INFO) -> logging.Logger:
     return logger
 
 
+def worker_rank(default=0):
+    """This process's worker rank: MX_WORKER_ID (tools/launch.py
+    local/ssh), else the MPI runtime env (--launcher mpi), else
+    `default`."""
+    import os
+    for var in ("MX_WORKER_ID", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                "PMIX_RANK"):
+        if var in os.environ:
+            return int(os.environ[var])
+    return default
+
+
 def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None, **kwargs):
     """Wire this process into a multi-worker jax.distributed job.
@@ -193,8 +205,10 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
         return
     if num_processes is None and "MX_NUM_WORKERS" in os.environ:
         num_processes = int(os.environ["MX_NUM_WORKERS"])
-    if process_id is None and "MX_WORKER_ID" in os.environ:
-        process_id = int(os.environ["MX_WORKER_ID"])
+    if process_id is None:
+        # MX_WORKER_ID (local/ssh launcher) or the MPI runtime env
+        # (--launcher mpi, where rank is not a per-process export)
+        process_id = worker_rank(default=None)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id, **kwargs)

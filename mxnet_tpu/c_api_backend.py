@@ -383,13 +383,14 @@ def executor_forward(h: int, is_train: bool = False):
 
 
 def executor_backward(h: int):
-    """ref: MXExecutorBackward — returns grad handles in declared
-    argument order (None-grads skipped)."""
+    """ref: MXExecutorBackward — one grad handle per declared argument,
+    in argument order; arguments with no gradient yield handle 0 so
+    positions stay aligned with list_arguments()."""
     exe = _exec(h)
     exe.backward()
-    return [_new_handle(_nd_handles, exe.grad_dict[n])
-            for n in exe._symbol.list_arguments()
-            if exe.grad_dict.get(n) is not None]
+    return [(_new_handle(_nd_handles, g) if g is not None else 0)
+            for g in (exe.grad_dict.get(n)
+                      for n in exe._symbol.list_arguments())]
 
 
 def executor_free(h: int):

@@ -267,7 +267,8 @@ class KVStoreDistAsync(KVStoreBase):
         self._type = type_name
         import os
         from . import kvstore_server as srv
-        self._rank = int(os.environ.get("MX_WORKER_ID", "0"))
+        from .base import worker_rank
+        self._rank = worker_rank()
         self._num_workers = int(os.environ.get("MX_NUM_WORKERS", "1"))
         if self._num_workers == 1 and jax.distributed.is_initialized():
             # launched by something other than tools/launch.py — take the

@@ -252,7 +252,8 @@ def ensure_server(num_workers: int, rank: Optional[int] = None) -> str:
     global _local_server
     addr = server_address()
     if rank is None:
-        rank = int(os.environ.get("MX_WORKER_ID", "0"))
+        from .base import worker_rank
+        rank = worker_rank()
     if addr is None:
         if num_workers > 1:
             # without a shared endpoint every rank would silently start

@@ -1,0 +1,406 @@
+/*
+ * C++ bindings for mxnet_tpu — header-only RAII layer over the C ABI.
+ *
+ * TPU-native analog of the reference's cpp-package
+ * (ref: cpp-package/include/mxnet-cpp/ndarray.h, operator.h, symbol.h,
+ * executor.h): NDArray / Operator / Symbol / Executor / Predictor
+ * classes with automatic handle lifetime, exceptions instead of return
+ * codes, and chainable imperative op invocation:
+ *
+ *   mxtpu::NDArray x({2, 6});
+ *   auto out = mxtpu::Operator("FullyConnected")
+ *                  .SetParam("num_hidden", 8)
+ *                  .PushInput(x).PushInput(w).PushInput(b)
+ *                  .Invoke();
+ *
+ * Link against libmxtpu_capi.so (built by mxnet_tpu.native.build_capi).
+ * Every failure throws mxtpu::Error carrying MXGetLastError().
+ */
+#ifndef MXTPU_CPP_HPP_
+#define MXTPU_CPP_HPP_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <numeric>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxtpu_predict.h"
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc) {
+  if (rc != 0) {
+    const char* msg = MXGetLastError();
+    throw Error(msg ? msg : "unknown mxtpu error");
+  }
+}
+
+inline int Version() {
+  int v = 0;
+  Check(MXGetVersion(&v));
+  return v;
+}
+
+inline std::vector<std::string> ListAllOpNames() {
+  uint32_t n = 0;
+  const char** names = nullptr;
+  Check(MXListAllOpNames(&n, &names));
+  return std::vector<std::string>(names, names + n);
+}
+
+/* Device placement (ref: cpp-package/include/mxnet-cpp/base.h DeviceType;
+ * dev_type 1 = cpu, 2 = accelerator/tpu). */
+struct Context {
+  int dev_type;
+  int dev_id;
+  static Context cpu(int id = 0) { return {1, id}; }
+  static Context tpu(int id = 0) { return {2, id}; }
+  static Context gpu(int id = 0) { return {2, id}; }  // alias
+};
+
+/* ------------------------------------------------------------------ */
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  explicit NDArray(const std::vector<uint32_t>& shape,
+                   const std::string& dtype = "float32") {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreate(shape.data(),
+                          static_cast<uint32_t>(shape.size()),
+                          dtype.c_str(), &h));
+    reset(h);
+  }
+
+  NDArray(const float* data, const std::vector<uint32_t>& shape) {
+    uint64_t n = 1;
+    for (uint32_t d : shape) n *= d;
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreateFromBytes(data, n * sizeof(float), shape.data(),
+                                   static_cast<uint32_t>(shape.size()),
+                                   "float32", &h));
+    reset(h);
+  }
+
+  NDArray(const std::vector<float>& data,
+          const std::vector<uint32_t>& shape)
+      : NDArray(data.data(), shape) {}
+
+  /* Adopt a handle returned by the C ABI (takes ownership). */
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+
+  NDArrayHandle handle() const { return h_.get(); }
+  bool defined() const { return static_cast<bool>(h_); }
+
+  std::vector<uint32_t> Shape() const {
+    uint32_t ndim = 0;
+    const uint32_t* dims = nullptr;
+    Check(MXNDArrayGetShape(h_.get(), &ndim, &dims));
+    return std::vector<uint32_t>(dims, dims + ndim);
+  }
+
+  std::string DType() const {
+    const char* s = nullptr;
+    Check(MXNDArrayGetDType(h_.get(), &s));
+    return s ? s : "";
+  }
+
+  uint64_t Size() const {
+    auto shape = Shape();
+    return std::accumulate(shape.begin(), shape.end(), uint64_t{1},
+                           std::multiplies<uint64_t>());
+  }
+
+  /* Blocking device->host copy (ref: ndarray.h SyncCopyToCPU). */
+  std::vector<float> CopyToHost() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(h_.get(), out.data(),
+                                 out.size() * sizeof(float)));
+    return out;
+  }
+
+  void CopyFromHost(const float* data, uint64_t count) {
+    Check(MXNDArraySyncCopyFromCPU(h_.get(), data,
+                                   count * sizeof(float)));
+  }
+
+  static void Save(const std::string& fname,
+                   const std::map<std::string, NDArray>& arrays) {
+    std::vector<NDArrayHandle> handles;
+    std::vector<const char*> keys;
+    for (const auto& kv : arrays) {
+      keys.push_back(kv.first.c_str());
+      handles.push_back(kv.second.handle());
+    }
+    Check(MXNDArraySave(fname.c_str(),
+                        static_cast<uint32_t>(handles.size()),
+                        handles.data(), keys.data()));
+  }
+
+  static std::map<std::string, NDArray> Load(const std::string& fname) {
+    uint32_t n = 0, n_names = 0;
+    NDArrayHandle* arrs = nullptr;
+    const char** names = nullptr;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &arrs, &n_names, &names));
+    std::map<std::string, NDArray> out;
+    for (uint32_t i = 0; i < n; ++i) {
+      std::string key = (i < n_names && names[i]) ? names[i]
+                                                  : std::to_string(i);
+      out.emplace(key, FromHandle(arrs[i]));
+    }
+    return out;
+  }
+
+ private:
+  void reset(NDArrayHandle h) {
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXNDArrayFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* ------------------------------------------------------------------ */
+
+/* Chainable imperative op invocation
+ * (ref: cpp-package/include/mxnet-cpp/operator.h Operator::SetParam/
+ * PushInput/Invoke over MXImperativeInvokeEx). */
+class Operator {
+ public:
+  explicit Operator(const std::string& op_name) : name_(op_name) {}
+
+  template <typename T>
+  Operator& SetParam(const std::string& key, const T& value) {
+    std::ostringstream os;
+    os << value;
+    keys_.push_back(key);
+    vals_.push_back(os.str());
+    return *this;
+  }
+
+  Operator& PushInput(const NDArray& nd) {
+    inputs_.push_back(nd);
+    return *this;
+  }
+
+  Operator& operator()(const NDArray& nd) { return PushInput(nd); }
+
+  std::vector<NDArray> Invoke() {
+    std::vector<NDArrayHandle> in;
+    for (const auto& a : inputs_) in.push_back(a.handle());
+    std::vector<const char*> ks, vs;
+    for (const auto& s : keys_) ks.push_back(s.c_str());
+    for (const auto& s : vals_) vs.push_back(s.c_str());
+    int n_out = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXImperativeInvoke(name_.c_str(),
+                             static_cast<int>(in.size()), in.data(),
+                             &n_out, &outs,
+                             static_cast<int>(ks.size()), ks.data(),
+                             vs.data()));
+    std::vector<NDArray> result;
+    result.reserve(static_cast<size_t>(n_out));
+    for (int i = 0; i < n_out; ++i)
+      result.push_back(NDArray::FromHandle(outs[i]));
+    return result;
+  }
+
+ private:
+  std::string name_;
+  std::vector<NDArray> inputs_;
+  std::vector<std::string> keys_, vals_;
+};
+
+inline NDArray InvokeOne(Operator& op) { return op.Invoke().at(0); }
+
+inline NDArray operator+(const NDArray& a, const NDArray& b) {
+  return Operator("broadcast_add").PushInput(a).PushInput(b).Invoke().at(0);
+}
+inline NDArray operator-(const NDArray& a, const NDArray& b) {
+  return Operator("broadcast_sub").PushInput(a).PushInput(b).Invoke().at(0);
+}
+inline NDArray operator*(const NDArray& a, const NDArray& b) {
+  return Operator("broadcast_mul").PushInput(a).PushInput(b).Invoke().at(0);
+}
+inline NDArray operator/(const NDArray& a, const NDArray& b) {
+  return Operator("broadcast_div").PushInput(a).PushInput(b).Invoke().at(0);
+}
+
+/* ------------------------------------------------------------------ */
+
+class Symbol {
+ public:
+  Symbol() = default;
+
+  static Symbol FromJSON(const std::string& json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    Symbol s;
+    s.reset(h);
+    return s;
+  }
+
+  SymbolHandle handle() const { return h_.get(); }
+  bool defined() const { return static_cast<bool>(h_); }
+
+  std::string ToJSON() const {
+    const char* s = nullptr;
+    Check(MXSymbolSaveToJSON(h_.get(), &s));
+    return s ? s : "";
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return list(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return list(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return list(&MXSymbolListAuxiliaryStates);
+  }
+
+ private:
+  using ListFn = int (*)(SymbolHandle, uint32_t*, const char***);
+  std::vector<std::string> list(ListFn fn) const {
+    uint32_t n = 0;
+    const char** arr = nullptr;
+    Check(fn(h_.get(), &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+  void reset(SymbolHandle h) {
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXSymbolFree(p);
+    });
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* ------------------------------------------------------------------ */
+
+/* Bound computation graph (ref: cpp-package/include/mxnet-cpp/executor.h;
+ * args are NDArrays in ListArguments() order; grad_req "write" enables
+ * Backward()). The arg NDArrays stay owned by the caller. */
+class Executor {
+ public:
+  Executor(const Symbol& sym, const Context& ctx,
+           const std::vector<NDArray>& args,
+           const std::string& grad_req = "null")
+      : args_(args) {
+    std::vector<NDArrayHandle> hs;
+    for (const auto& a : args_) hs.push_back(a.handle());
+    ExecutorHandle h = nullptr;
+    Check(MXExecutorBind(sym.handle(), ctx.dev_type, ctx.dev_id,
+                         static_cast<uint32_t>(hs.size()), hs.data(),
+                         grad_req.c_str(), &h));
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXExecutorFree(p);
+    });
+  }
+
+  std::vector<NDArray> Forward(bool is_train = false) {
+    uint32_t n = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXExecutorForward(h_.get(), is_train ? 1 : 0, &n, &outs));
+    std::vector<NDArray> result;
+    for (uint32_t i = 0; i < n; ++i)
+      result.push_back(NDArray::FromHandle(outs[i]));
+    return result;
+  }
+
+  /* One gradient per argument, in ListArguments() order; arguments
+   * without a gradient come back !defined() so positions never shift. */
+  std::vector<NDArray> Backward() {
+    uint32_t n = 0;
+    NDArrayHandle* grads = nullptr;
+    Check(MXExecutorBackward(h_.get(), &n, &grads));
+    std::vector<NDArray> result;
+    for (uint32_t i = 0; i < n; ++i)
+      result.push_back(NDArray::FromHandle(grads[i]));
+    return result;
+  }
+
+ private:
+  std::vector<NDArray> args_;  // keep arg handles alive over the bind
+  std::shared_ptr<void> h_;
+};
+
+/* ------------------------------------------------------------------ */
+
+/* Deployment predictor (ref: c_predict_api.h consumer pattern:
+ * Create -> GetOutputShape -> SetInput -> Forward -> GetOutput). */
+class Predictor {
+ public:
+  Predictor(const std::string& symbol_json, const std::string& param_bytes,
+            const Context& ctx,
+            const std::map<std::string, std::vector<uint32_t>>& input_shapes) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<uint32_t> shape_data;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      shape_data.insert(shape_data.end(), kv.second.begin(),
+                        kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(shape_data.size()));
+    }
+    PredictorHandle h = nullptr;
+    Check(MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                       static_cast<int>(param_bytes.size()), ctx.dev_type,
+                       ctx.dev_id, static_cast<uint32_t>(keys.size()),
+                       keys.data(), indptr.data(), shape_data.data(), &h));
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXPredFree(p);
+    });
+  }
+
+  uint32_t OutputCount() const {
+    uint32_t n = 0;
+    Check(MXPredGetOutputCount(h_.get(), &n));
+    return n;
+  }
+
+  std::vector<uint32_t> OutputShape(uint32_t index) const {
+    uint32_t* dims = nullptr;
+    uint32_t ndim = 0;
+    Check(MXPredGetOutputShape(h_.get(), index, &dims, &ndim));
+    return std::vector<uint32_t>(dims, dims + ndim);
+  }
+
+  void SetInput(const std::string& key, const std::vector<float>& data) {
+    Check(MXPredSetInput(h_.get(), key.c_str(), data.data(),
+                         static_cast<uint32_t>(data.size())));
+  }
+
+  void Forward() { Check(MXPredForward(h_.get())); }
+
+  std::vector<float> GetOutput(uint32_t index) const {
+    auto shape = OutputShape(index);
+    uint64_t n = std::accumulate(shape.begin(), shape.end(), uint64_t{1},
+                                 std::multiplies<uint64_t>());
+    std::vector<float> out(n);
+    Check(MXPredGetOutput(h_.get(), index, out.data(),
+                          static_cast<uint32_t>(n)));
+    return out;
+  }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_HPP_

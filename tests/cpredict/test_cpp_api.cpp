@@ -1,0 +1,124 @@
+// End-to-end consumer of the C++ bindings (mxtpu_cpp.hpp) — the
+// cpp-package analog: NDArray math via imperative ops, Symbol
+// introspection, Executor forward/backward, save/load round trip, and
+// the Predictor deployment path, all through libmxtpu_capi.so.
+//
+// Usage: test_cpp_api <symbol.json path> <params path>
+#include <cmath>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+
+#include "mxtpu_cpp.hpp"
+
+static std::string slurp(const char* path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s symbol.json params\n", argv[0]);
+    return 2;
+  }
+  try {
+    std::printf("version=%d\n", mxtpu::Version());
+    std::printf("n_ops=%zu\n", mxtpu::ListAllOpNames().size());
+
+    // --- NDArray + imperative ops + operator overloads ---------------
+    std::vector<float> av = {1, 2, 3, 4, 5, 6};
+    mxtpu::NDArray a(av, {2, 3});
+    mxtpu::NDArray b(std::vector<float>(6, 2.0f), {2, 3});
+    auto sum = (a + b).CopyToHost();
+    auto prod = (a * b).CopyToHost();
+    bool math_ok = true;
+    for (int i = 0; i < 6; ++i) {
+      math_ok = math_ok && std::fabs(sum[i] - (av[i] + 2)) < 1e-6f &&
+                std::fabs(prod[i] - av[i] * 2) < 1e-6f;
+    }
+    auto relu = mxtpu::Operator("Activation")
+                    .SetParam("act_type", "relu")
+                    .PushInput(a - b)
+                    .Invoke()
+                    .at(0)
+                    .CopyToHost();
+    for (int i = 0; i < 6; ++i)
+      math_ok = math_ok &&
+                std::fabs(relu[i] - std::max(0.0f, av[i] - 2)) < 1e-6f;
+    std::printf("math_ok=%d\n", math_ok ? 1 : 0);
+
+    // --- save / load round trip --------------------------------------
+    mxtpu::NDArray::Save("cpp_roundtrip.params", {{"a", a}, {"b", b}});
+    auto loaded = mxtpu::NDArray::Load("cpp_roundtrip.params");
+    auto a2 = loaded.at("a").CopyToHost();
+    bool saveload_ok = loaded.size() == 2 && a2 == av &&
+                       loaded.at("a").Shape() ==
+                           std::vector<uint32_t>({2, 3});
+    std::printf("saveload_ok=%d\n", saveload_ok ? 1 : 0);
+
+    // --- Symbol + Executor forward/backward --------------------------
+    auto sym = mxtpu::Symbol::FromJSON(slurp(argv[1]));
+    auto arg_names = sym.ListArguments();
+    std::printf("n_args=%zu\n", arg_names.size());
+    std::printf("n_outputs=%zu\n", sym.ListOutputs().size());
+
+    auto params = mxtpu::NDArray::Load(argv[2]);
+    std::vector<mxtpu::NDArray> args;
+    std::vector<float> x(6);
+    for (int i = 0; i < 6; ++i) x[i] = i / 6.0f;
+    for (const auto& name : arg_names) {
+      if (name == "data") {
+        args.emplace_back(x, std::vector<uint32_t>{1, 6});
+      } else {
+        args.push_back(params.at("arg:" + name));
+      }
+    }
+    mxtpu::Executor exe(sym, mxtpu::Context::cpu(), args, "write");
+    auto outs = exe.Forward(true);
+    std::printf("exec_out=");
+    auto ov = outs.at(0).CopyToHost();
+    for (float v : ov) std::printf("%.6f ", v);
+    std::printf("\n");
+    auto grads = exe.Backward();
+    bool grad_ok = grads.size() == arg_names.size();
+    for (const auto& g : grads) {
+      if (!g.defined()) continue;
+      for (float v : g.CopyToHost())
+        grad_ok = grad_ok && std::isfinite(v);
+    }
+    std::printf("grad_ok=%d\n", grad_ok ? 1 : 0);
+
+    // --- Predictor deployment path -----------------------------------
+    mxtpu::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                          mxtpu::Context::cpu(), {{"data", {1, 6}}});
+    auto oshape = pred.OutputShape(0);
+    std::printf("pred_oshape=%u,%u\n", oshape[0], oshape[1]);
+    pred.SetInput("data", x);
+    pred.Forward();
+    auto pv = pred.GetOutput(0);
+    bool pred_ok = pv.size() == ov.size();
+    for (size_t i = 0; i < pv.size() && pred_ok; ++i)
+      pred_ok = std::fabs(pv[i] - ov[i]) < 1e-5f;
+    std::printf("pred_ok=%d\n", pred_ok ? 1 : 0);
+
+    // --- error surfacing: bad op must throw, not crash ---------------
+    bool throw_ok = false;
+    try {
+      mxtpu::Operator("definitely_not_an_op").PushInput(a).Invoke();
+    } catch (const mxtpu::Error&) {
+      throw_ok = true;
+    }
+    std::printf("throw_ok=%d\n", throw_ok ? 1 : 0);
+
+    if (math_ok && saveload_ok && grad_ok && pred_ok && throw_ok) {
+      std::printf("CPP_API_OK\n");
+      return 0;
+    }
+    return 1;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "exception: %s\n", e.what());
+    return 1;
+  }
+}

@@ -101,6 +101,52 @@ def test_c_general_abi_end_to_end(tmp_path):
     assert onp.allclose(c_vals, ref[:len(c_vals)], atol=1e-5)
 
 
+def test_cpp_bindings_end_to_end(tmp_path):
+    """C++ RAII bindings (mxtpu_cpp.hpp, the cpp-package analog —
+    ref: cpp-package/include/mxnet-cpp/): NDArray math + operator
+    overloads, Symbol introspection, Executor fwd/bwd, save/load,
+    Predictor, and exception surfacing, from a pure C++ consumer."""
+    from mxnet_tpu.native import build_capi
+    build_capi()
+
+    net = _mlp()
+    rs = onp.random.RandomState(0)
+    args = {"fc1_weight": nd.array(rs.randn(8, 6).astype("float32")),
+            "fc1_bias": nd.zeros((8,)),
+            "fc2_weight": nd.array(rs.randn(3, 8).astype("float32")),
+            "fc2_bias": nd.zeros((3,))}
+    sym_path = str(tmp_path / "net-symbol.json")
+    net.save(sym_path)
+    param_path = str(tmp_path / "net-0000.params")
+    nd.save(param_path, {f"arg:{k}": v for k, v in args.items()})
+
+    cpp_src = os.path.join(ROOT, "tests", "cpredict", "test_cpp_api.cpp")
+    cpp_bin = str(tmp_path / "test_cpp_api")
+    subprocess.run(["g++", "-O2", "-std=c++17", cpp_src, f"-I{NATIVE}",
+                    f"-L{NATIVE}", "-lmxtpu_capi", f"-Wl,-rpath,{NATIVE}",
+                    "-o", cpp_bin], check=True, capture_output=True)
+    import site
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + site.getsitepackages()[0]
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([cpp_bin, sym_path, param_path], env=env,
+                          cwd=str(tmp_path), capture_output=True,
+                          text=True, timeout=380)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"C++ bindings test failed:\n{out[-3000:]}"
+    for flag in ("math_ok=1", "saveload_ok=1", "grad_ok=1", "pred_ok=1",
+                 "throw_ok=1", "CPP_API_OK"):
+        assert flag in out, f"missing {flag}:\n{out[-3000:]}"
+    # executor output must match the python-side executor on same weights
+    x = (onp.arange(6, dtype="float32") / 6.0).reshape(1, 6)
+    exe = net.bind(mx.cpu(), {"data": nd.array(x), **args})
+    ref = exe.forward()[0].asnumpy().ravel()
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("exec_out=")][0]
+    c_vals = [float(v) for v in line[9:].split()]
+    assert onp.allclose(c_vals, ref[:len(c_vals)], atol=1e-5)
+
+
 def test_c_predict_end_to_end(tmp_path):
     from mxnet_tpu.native import build_capi
     so = build_capi()

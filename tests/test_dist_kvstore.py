@@ -28,6 +28,83 @@ def test_dist_async_kvstore_four_workers():
     assert out.count("DIST_ASYNC_OK") == 4, out[-3000:]
 
 
+def test_ssh_launcher_command_construction(tmp_path):
+    """--launcher ssh spawns one ssh per hostfile slot with the rank env
+    on the remote command line (ref: tools/launch.py ssh tracker). A fake
+    `ssh` on PATH records its argv instead of dialing out."""
+    log = tmp_path / "calls.log"
+    fake = tmp_path / "ssh"
+    fake.write_text("#!/bin/sh\necho \"$@\" >> %s\n" % log)
+    fake.chmod(0o755)
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("# cluster\nnode-a slots=2\nnode-b\n")
+    env = dict(os.environ)
+    env["PATH"] = f"{tmp_path}:{env['PATH']}"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "3", "--launcher", "ssh", "-H", str(hostfile),
+         "--env", "FOO=bar", "echo", "worker"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    calls = log.read_text().strip().splitlines()
+    assert len(calls) == 3
+    # ssh processes run concurrently, so the log is completion-ordered:
+    # sort by rank before checking host assignment (slots expand:
+    # node-a twice, then node-b)
+    calls.sort(key=lambda c: c.split("MX_WORKER_ID=")[1].split()[0])
+    assert "node-a" in calls[0] and "MX_WORKER_ID=0" in calls[0]
+    assert "node-a" in calls[1] and "MX_WORKER_ID=1" in calls[1]
+    assert "node-b" in calls[2] and "MX_WORKER_ID=2" in calls[2]
+    for c in calls:
+        assert "MX_NUM_WORKERS=3" in c and "FOO=bar" in c
+        # coordinator rewritten to rank 0's host, not localhost
+        assert "MX_COORDINATOR=node-a:" in c
+        assert "echo worker" in c
+
+
+def test_mpi_launcher_command_construction(tmp_path):
+    """--launcher mpi delegates placement to mpirun, forwarding the
+    shared env with -x and omitting the per-rank MX_WORKER_ID (ranks
+    derive it from OMPI_COMM_WORLD_RANK/PMI_RANK)."""
+    log = tmp_path / "calls.log"
+    fake = tmp_path / "mpirun"
+    fake.write_text("#!/bin/sh\nprintf '%s ' \"$@\" >> {0}\n"
+                    "printf '\\n' >> {0}\nenv >> {0}\n".format(log))
+    fake.chmod(0o755)
+    env = dict(os.environ)
+    env["PATH"] = f"{tmp_path}:{env['PATH']}"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "4", "--launcher", "mpi", "echo", "worker"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    text = log.read_text()
+    argv = text.splitlines()[0]
+    assert "-n 4" in argv
+    assert "-x MX_COORDINATOR" in argv and "-x MX_NUM_WORKERS" in argv
+    assert "echo worker" in argv
+    assert "MX_WORKER_ID" not in text  # per-rank, comes from the MPI env
+    assert "MX_NUM_WORKERS=4" in text  # env visible to mpirun
+
+
+def test_worker_rank_mpi_fallback():
+    from mxnet_tpu.base import worker_rank
+    env_backup = {k: os.environ.pop(k, None)
+                  for k in ("MX_WORKER_ID", "OMPI_COMM_WORLD_RANK",
+                            "PMI_RANK", "PMIX_RANK")}
+    try:
+        assert worker_rank() == 0
+        os.environ["OMPI_COMM_WORLD_RANK"] = "3"
+        assert worker_rank() == 3
+        os.environ["MX_WORKER_ID"] = "1"  # explicit launcher env wins
+        assert worker_rank() == 1
+    finally:
+        for k, v in env_backup.items():
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+
+
 def test_dist_sync_kvstore_two_workers():
     env = dict(os.environ)
     # the worker forces the CPU backend in-process; drop any virtual-device

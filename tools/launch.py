@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Local multi-process job launcher.
+"""Multi-process / multi-host job launcher.
 
 TPU-native analog of the reference's distributed launcher
 (ref: tools/launch.py:29 — dmlc-core tracker spawning scheduler/server/
@@ -9,12 +9,22 @@ jax.distributed job (Gloo on CPU hosts, ICI/DCN on TPU slices) via the
 MX_COORDINATOR / MX_NUM_WORKERS / MX_WORKER_ID env the framework's
 `initialize_distributed` reads.
 
+Launchers (ref launch.py --launcher {local,ssh,mpi,sge,yarn}):
+  local  spawn N processes on this host (default)
+  ssh    one process per host from --hostfile, rank 0's host is the
+         coordinator (ref: dmlc-core/tracker ssh.py)
+  mpi    delegate process placement to mpirun/mpiexec; ranks read
+         OMPI_COMM_WORLD_RANK / PMI_RANK (ref: dmlc-core/tracker mpi.py)
+
 Usage (mirrors `tools/launch.py -n 2 --launcher local python train.py`):
 
     python tools/launch.py -n 2 python dist_sync_kvstore.py
+    python tools/launch.py -n 4 --launcher ssh -H hosts.txt python train.py
+    python tools/launch.py -n 4 --launcher mpi python train.py
 """
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -26,14 +36,129 @@ def _free_port():
         return s.getsockname()[1]
 
 
+def _wait_all(procs):
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def _read_hostfile(path):
+    """One host per line; '#' comments; optional 'host slots=N'."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p[6:])
+            hosts.extend([host] * slots)
+    return hosts
+
+
+def _worker_env(args, rank, coordinator, kv_server):
+    env = {"MX_COORDINATOR": coordinator,
+           "MX_KV_SERVER": kv_server,
+           "MX_NUM_WORKERS": str(args.num_workers),
+           "MX_WORKER_ID": str(rank)}
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    return env
+
+
+def launch_local(args, coordinator, kv_server):
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(_worker_env(args, rank, coordinator, kv_server))
+        procs.append(subprocess.Popen(args.command, env=env))
+    return _wait_all(procs)
+
+
+def launch_ssh(args, coordinator, kv_server):
+    """One rank per hostfile slot; env is passed on the remote command
+    line (ssh does not forward arbitrary env), cwd mirrored when the
+    remote shares the filesystem (the reference tracker's assumption).
+    The coordinator/kv ports are probed free on THIS host only — pin
+    --port/--kv-port if rank 0's host may have them taken."""
+    if not args.hostfile:
+        raise SystemExit("--launcher ssh requires --hostfile")
+    hosts = _read_hostfile(args.hostfile)
+    if len(hosts) < args.num_workers:
+        raise SystemExit(f"hostfile has {len(hosts)} slots, "
+                         f"need {args.num_workers}")
+    # rank 0's host serves the coordinator port: rewrite localhost
+    coord_host = hosts[0]
+    coordinator = f"{coord_host}:{coordinator.rsplit(':', 1)[1]}"
+    kv_server = f"{coord_host}:{kv_server.rsplit(':', 1)[1]}"
+    procs = []
+    for rank in range(args.num_workers):
+        env = _worker_env(args, rank, coordinator, kv_server)
+        exports = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in sorted(env.items()))
+        remote = (f"cd {shlex.quote(os.getcwd())} && env {exports} " +
+                  " ".join(shlex.quote(c) for c in args.command))
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank], remote]))
+    return _wait_all(procs)
+
+
+def launch_mpi(args, coordinator, kv_server):
+    """mpirun owns placement; every rank gets the same env and derives
+    MX_WORKER_ID from the MPI rank env (initialize_distributed reads
+    OMPI_COMM_WORLD_RANK/PMI_RANK when MX_WORKER_ID is unset).
+
+    The coordinator endpoint must be reachable from every rank AND
+    bindable by rank 0, so loopback is rewritten to this host's name —
+    valid under the standard mpirun convention that the launching host
+    is the first slot (rank 0 lands here). If rank 0 is placed
+    elsewhere, pass --coordinator-host with that machine's name."""
+    mpirun = args.mpirun or "mpirun"
+    host = args.coordinator_host or socket.gethostname()
+    coordinator = f"{host}:{coordinator.rsplit(':', 1)[1]}"
+    kv_server = f"{host}:{kv_server.rsplit(':', 1)[1]}"
+    env = dict(os.environ)
+    env.update(_worker_env(args, 0, coordinator, kv_server))
+    del env["MX_WORKER_ID"]  # per-rank, from the MPI env
+    cmd = [mpirun, "-n", str(args.num_workers)]
+    if args.hostfile:
+        cmd += ["--hostfile", args.hostfile]
+    for k in ("MX_COORDINATOR", "MX_KV_SERVER", "MX_NUM_WORKERS"):
+        cmd += ["-x", k]
+    for kv in args.env:
+        cmd += ["-x", kv.partition("=")[0]]
+    cmd += args.command
+    return subprocess.call(cmd, env=env)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
-        description="launch a local multi-process mxnet_tpu job")
+        description="launch a multi-process mxnet_tpu job")
     parser.add_argument("-n", "--num-workers", type=int, required=True,
                         help="number of worker processes")
-    parser.add_argument("--launcher", default="local", choices=["local"],
-                        help="only 'local' (single host) is supported; "
-                        "multi-host slices are wired by the TPU runtime")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh", "mpi"],
+                        help="process launcher (default: local)")
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="hostfile for --launcher ssh "
+                        "(one host per line, optional slots=N)")
+    parser.add_argument("--mpirun", default=None,
+                        help="mpirun binary for --launcher mpi")
+    parser.add_argument("--coordinator-host", default=None,
+                        help="host serving the coordinator port "
+                        "(mpi launcher; default: this host)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="pin the coordinator port (default: probe "
+                        "a free one on this host)")
+    parser.add_argument("--kv-port", type=int, default=None,
+                        help="pin the parameter-server port")
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VALUE env for every worker")
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -42,27 +167,13 @@ def main(argv=None):
     if not args.command:
         parser.error("no command given")
 
-    coordinator = f"localhost:{_free_port()}"
+    coordinator = f"localhost:{args.port or _free_port()}"
     # parameter-server endpoint for async kvstore types (rank 0 binds it,
     # ref role: DMLC_PS_ROOT_URI of the ps-lite tracker)
-    kv_server = f"127.0.0.1:{_free_port()}"
-    procs = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env["MX_COORDINATOR"] = coordinator
-        env["MX_KV_SERVER"] = kv_server
-        env["MX_NUM_WORKERS"] = str(args.num_workers)
-        env["MX_WORKER_ID"] = str(rank)
-        for kv in args.env:
-            k, _, v = kv.partition("=")
-            env[k] = v
-        procs.append(subprocess.Popen(args.command, env=env))
-
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    return rc
+    kv_server = f"127.0.0.1:{args.kv_port or _free_port()}"
+    launchers = {"local": launch_local, "ssh": launch_ssh,
+                 "mpi": launch_mpi}
+    return launchers[args.launcher](args, coordinator, kv_server)
 
 
 if __name__ == "__main__":
