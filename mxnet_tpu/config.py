@@ -240,6 +240,11 @@ register_flag(
     "MXNET_PROFILER_MODE", int, 0,
     "Default profiler mode bitmask (ref: env_var.md).")
 register_flag(
+    "MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
+    "Seconds a worker waits at a dist barrier before declaring the "
+    "job failed (failure detection, SURVEY.md §5.3; the reference's "
+    "ps-lite van timeouts play this role).")
+register_flag(
     "MXNET_TEST_SEED", int, -1,
     "Fixed seed for the test harness; -1 = random per test "
     "(ref: tests/python/unittest/common.py).")

@@ -85,6 +85,10 @@ class KVServer:
         self._barrier_count = 0
         self._barrier_generation = 0
         self._barrier_cv = threading.Condition()
+        # failure detection (SURVEY §5.3): a connection that drops
+        # without a clean 'stop' marks the job failed so peers blocked
+        # at a barrier surface the error instead of hanging
+        self._lost_connections = 0
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host or "127.0.0.1", int(port)))
@@ -108,12 +112,17 @@ class KVServer:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket):
+        clean = False
+        participated = False  # issued >=1 command, i.e. a real worker —
+        # a port probe / failed handshake must not look like a death
         try:
             while True:
                 cmd, key, payload = _recv_msg(conn)
                 if cmd == "stop":
                     _send_msg(conn, ("ok", None))
+                    clean = True
                     break
+                participated = True
                 try:
                     reply = self._handle(cmd, key, payload)
                     _send_msg(conn, ("ok", reply))
@@ -123,6 +132,11 @@ class KVServer:
             pass
         finally:
             conn.close()
+            if participated and not clean and not self._stopping:
+                # abnormal disconnect: wake barrier waiters with failure
+                with self._barrier_cv:
+                    self._lost_connections += 1
+                    self._barrier_cv.notify_all()
 
     def _handle(self, cmd: str, key, payload):
         if cmd == "init":
@@ -177,6 +191,13 @@ class KVServer:
             profiler.dump()
             return None
         if cmd == "barrier":
+            # failure detection (SURVEY §5.3): rather than hang forever
+            # on a dead peer, surface a diagnosis — either on the
+            # configured deadline (MXNET_KVSTORE_BARRIER_TIMEOUT) or as
+            # soon as a peer's connection drops abnormally
+            from .base import get_env
+            deadline = time.monotonic() + float(
+                get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0))
             with self._barrier_cv:
                 gen = self._barrier_generation
                 self._barrier_count += 1
@@ -186,7 +207,27 @@ class KVServer:
                     self._barrier_cv.notify_all()
                 else:
                     while self._barrier_generation == gen:
-                        self._barrier_cv.wait(timeout=60.0)
+                        arrived = self._barrier_count
+                        # ANY worker death so far is fatal to a barrier:
+                        # workers hold one persistent connection each and
+                        # never reconnect, so a past drop means this
+                        # barrier can never complete — fail fast, not at
+                        # the deadline
+                        if self._lost_connections > 0:
+                            self._barrier_count -= 1
+                            raise MXNetError(
+                                "barrier failed: a worker connection "
+                                f"dropped while {arrived}/"
+                                f"{self._num_workers} workers were "
+                                "waiting (peer process died?)")
+                        remain = deadline - time.monotonic()
+                        if remain <= 0:
+                            self._barrier_count -= 1
+                            raise MXNetError(
+                                f"barrier timeout: only {arrived}/"
+                                f"{self._num_workers} workers arrived "
+                                "within MXNET_KVSTORE_BARRIER_TIMEOUT")
+                        self._barrier_cv.wait(timeout=min(remain, 5.0))
             return None
         raise MXNetError(f"unknown kvstore server command {cmd}")
 
@@ -223,11 +264,25 @@ class KVClient:
         else:
             raise MXNetError(f"cannot reach kvstore server {address}: {last}")
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # connect probing used a 60s timeout; requests may legitimately
+        # block for a full barrier (bounded SERVER-side by
+        # MXNET_KVSTORE_BARRIER_TIMEOUT), but must not hang forever if
+        # the server HOST dies without FIN/RST — cap recv at the barrier
+        # deadline plus margin
+        from .base import get_env
+        self._sock.settimeout(
+            float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0)) + 60.0)
 
     def request(self, cmd: str, key=None, payload=None):
-        with self._lock:
-            _send_msg(self._sock, (cmd, key, payload))
-            status, reply = _recv_msg(self._sock)
+        try:
+            with self._lock:
+                _send_msg(self._sock, (cmd, key, payload))
+                status, reply = _recv_msg(self._sock)
+        except socket.timeout:
+            raise MXNetError(
+                f"kvstore server unresponsive during '{cmd}' (host "
+                "dead or partitioned? recv exceeded the barrier "
+                "deadline + margin)") from None
         if status != "ok":
             raise MXNetError(f"kvstore server: {reply}")
         return reply

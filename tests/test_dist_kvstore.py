@@ -87,6 +87,28 @@ def test_mpi_launcher_command_construction(tmp_path):
     assert "MX_NUM_WORKERS=4" in text  # env visible to mpirun
 
 
+def test_mpi_launcher_mpich_style(tmp_path):
+    """mpiexec (Hydra/MPICH, no -x flag) gets -genv KEY VALUE pairs."""
+    log = tmp_path / "calls.log"
+    fake = tmp_path / "mpiexec"
+    fake.write_text("#!/bin/sh\nprintf '%s ' \"$@\" >> {0}\n"
+                    "printf '\\n' >> {0}\n".format(log))
+    fake.chmod(0o755)
+    env = dict(os.environ)
+    env["PATH"] = f"{tmp_path}:{env['PATH']}"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "mpi", "--mpirun", "mpiexec",
+         "--env", "FOO=bar", "echo", "worker"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    argv = log.read_text().splitlines()[0]
+    assert "-x" not in argv.split()
+    assert "-genv MX_NUM_WORKERS 2" in argv
+    assert "-genv FOO bar" in argv
+    assert "-genv MX_COORDINATOR" in argv
+
+
 def test_worker_rank_mpi_fallback():
     from mxnet_tpu.base import worker_rank
     env_backup = {k: os.environ.pop(k, None)

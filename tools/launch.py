@@ -125,15 +125,24 @@ def launch_mpi(args, coordinator, kv_server):
     coordinator = f"{host}:{coordinator.rsplit(':', 1)[1]}"
     kv_server = f"{host}:{kv_server.rsplit(':', 1)[1]}"
     env = dict(os.environ)
-    env.update(_worker_env(args, 0, coordinator, kv_server))
-    del env["MX_WORKER_ID"]  # per-rank, from the MPI env
+    worker_env = _worker_env(args, 0, coordinator, kv_server)
+    del worker_env["MX_WORKER_ID"]  # per-rank, from the MPI env
+    env.update(worker_env)
     cmd = [mpirun, "-n", str(args.num_workers)]
     if args.hostfile:
         cmd += ["--hostfile", args.hostfile]
-    for k in ("MX_COORDINATOR", "MX_KV_SERVER", "MX_NUM_WORKERS"):
-        cmd += ["-x", k]
-    for kv in args.env:
-        cmd += ["-x", kv.partition("=")[0]]
+    # env forwarding syntax differs by MPI flavor: OpenMPI re-exports
+    # with `-x KEY`, MPICH/Hydra (mpiexec, Intel MPI) uses
+    # `-genv KEY VALUE` and has no -x
+    style = args.mpi_env_style
+    if style == "auto":
+        style = "mpich" if "mpiexec" in os.path.basename(mpirun) \
+            else "openmpi"
+    for k in sorted(worker_env):
+        if style == "mpich":
+            cmd += ["-genv", k, worker_env[k]]
+        else:
+            cmd += ["-x", k]
     cmd += args.command
     return subprocess.call(cmd, env=env)
 
@@ -151,6 +160,11 @@ def main(argv=None):
                         "(one host per line, optional slots=N)")
     parser.add_argument("--mpirun", default=None,
                         help="mpirun binary for --launcher mpi")
+    parser.add_argument("--mpi-env-style", default="auto",
+                        choices=["auto", "openmpi", "mpich"],
+                        help="env forwarding syntax: '-x K' (openmpi) "
+                        "vs '-genv K V' (mpich/Hydra); auto picks "
+                        "mpich when the binary is mpiexec")
     parser.add_argument("--coordinator-host", default=None,
                         help="host serving the coordinator port "
                         "(mpi launcher; default: this host)")
