@@ -240,6 +240,14 @@ register_flag(
     "MXNET_PROFILER_MODE", int, 0,
     "Default profiler mode bitmask (ref: env_var.md).")
 register_flag(
+    "MXNET_USE_OPERATOR_TUNING", str, "1",
+    "Measure-and-cache selection between equivalent op implementations "
+    "(Pallas flash vs dense attention, ...; operator_tune.autotune — "
+    "the TPU reinterpretation of the reference's OMP tuning, "
+    "operator_tune.h:165). 0/false/off = always take the default "
+    "candidate; any other value (1, float32, ... — the reference's "
+    "multi-valued forms) enables tuning.")
+register_flag(
     "MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
     "Seconds a worker waits at a dist barrier before declaring the "
     "job failed (failure detection, SURVEY.md §5.3; the reference's "
@@ -285,12 +293,9 @@ for _name, _type, _default, _doc, _note in [
     ("MXNET_CUDA_ALLOW_TENSOR_CORE", bool, True,
      "Allow TensorCore math.",
      "use jax.default_matmul_precision / bf16 policies"),
-    ("MXNET_USE_OPERATOR_TUNING", int, 1,
-     "OpenMP operator tuning (ref: operator_tune.h).",
-     "XLA fusion decides parallelization"),
     ("MXNET_ENABLE_OPERATOR_TUNING", int, 1,
      "Enable/disable operator tuning.",
-     "XLA fusion decides parallelization"),
+     "superseded by MXNET_USE_OPERATOR_TUNING (active)"),
     ("MXNET_KVSTORE_USETREE", bool, False,
      "Topology-aware tree reduction (ref: comm_tree.h).",
      "ICI collectives are already topology-optimal"),

@@ -81,8 +81,20 @@ class MultiHeadAttention(HybridBlock):
                          seq_axis=self._cp_axis, causal=causal,
                          strategy=self._cp_strategy)
         elif _on_tpu() and T % 128 == 0 and self._head_dim in (64, 128, 256):
+            # two valid backends on TPU: the Pallas flash kernel (O(T)
+            # memory) and XLA dense attention. Which is faster depends
+            # on T/D/dtype — measured once on the eager warm-up forward
+            # (operator_tune cache), flash as the default under a trace
+            from .. import operator_tune as _otune
             from ..ops.pallas_kernels import flash_attention
-            fn = partial(flash_attention, causal=causal)
+            _, fn = _otune.choose(
+                "attention",
+                [("flash", partial(flash_attention, causal=causal)),
+                 ("dense", partial(local_attention, causal=causal))],
+                q, k, v,
+                key=(f"attention|T={T}|D={self._head_dim}"
+                     f"|H={self._num_heads}|causal={causal}"
+                     f"|{getattr(q, 'dtype', '?')}"))
         else:
             fn = partial(local_attention, causal=causal)
         out = invoke(fn, [q, k, v])  # (B, H, T, D)

@@ -211,6 +211,12 @@ class ParallelTrainer:
                 loss_of, has_aux=True)(tparams)
             new_t, new_opt = apply_fn(tparams, grads, opt_state, lr=lr,
                                       **opt_params)
+            # update math may promote (e.g. bf16 param - f32 lr*mom →
+            # f32); keep each param's storage dtype stable across steps
+            # or step 2 retraces with upcast weights and mixed-precision
+            # training silently degrades to fp32
+            new_t = {n: v.astype(tparams[n].dtype)
+                     for n, v in new_t.items()}
             new_params = dict(params)
             new_params.update(new_t)
             new_params.update(aux)  # running stats

@@ -166,6 +166,55 @@ def test_operator_tune():
     operator_tune.set_tuning_mode("auto")
 
 
+def test_autotune_picks_faster_candidate(tmp_path, monkeypatch):
+    """autotune must select the measurably faster implementation, cache
+    the winner per signature (in-process + on disk), and honor the
+    'never' mode by taking the default candidate."""
+    import time as _time
+
+    import numpy as onp
+
+    from mxnet_tpu import operator_tune
+
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))
+    operator_tune.clear_cache()
+    operator_tune.set_tuning_mode("auto")
+
+    calls = {"fast": 0, "slow": 0}
+
+    def fast(x):
+        calls["fast"] += 1
+        return x + 1
+
+    def slow(x):
+        calls["slow"] += 1
+        _time.sleep(0.02)
+        return x + 1
+
+    x = onp.ones((4,), "float32")
+    out = operator_tune.autotune("toy_op", [("slow", slow), ("fast", fast)],
+                                 x, iters=3)
+    assert (out == 2).all()
+    # winner cached: subsequent calls go straight to `fast`
+    f0 = calls["fast"]
+    s0 = calls["slow"]
+    operator_tune.autotune("toy_op", [("slow", slow), ("fast", fast)], x)
+    assert calls["fast"] == f0 + 1 and calls["slow"] == s0
+    # disk cache written and reloadable
+    assert os.path.exists(operator_tune.cache_path())
+    operator_tune._choices.clear()
+    operator_tune._disk_loaded = False
+    operator_tune.autotune("toy_op", [("slow", slow), ("fast", fast)], x)
+    assert calls["slow"] == s0  # winner came from disk, no re-measure
+    # 'never' takes the first (default) candidate without timing
+    operator_tune.set_tuning_mode("never")
+    s1 = calls["slow"]
+    operator_tune.autotune("toy_op", [("slow", slow), ("fast", fast)], x)
+    assert calls["slow"] == s1 + 1
+    operator_tune.set_tuning_mode("auto")
+    operator_tune.clear_cache()
+
+
 # ---------------------------------------------------------------------------
 # FeedForward + executor_manager
 # ---------------------------------------------------------------------------
