@@ -1,0 +1,6 @@
+"""Experimental gluon components
+(ref: python/mxnet/gluon/contrib/__init__.py — nn, rnn, cnn, data,
+estimator)."""
+from . import cnn, data, estimator, nn, rnn
+
+__all__ = ["nn", "rnn", "cnn", "data", "estimator"]

@@ -1,0 +1,4 @@
+"""Experimental conv layers (ref: python/mxnet/gluon/contrib/cnn/)."""
+from .conv_layers import DeformableConvolution
+
+__all__ = ["DeformableConvolution"]

@@ -1,0 +1,80 @@
+"""Deformable convolution layer
+(ref: python/mxnet/gluon/contrib/cnn/conv_layers.py:22
+DeformableConvolution — an offset-predicting conv feeding
+_contrib_DeformableConvolution, src/operator/contrib/
+deformable_convolution.cc)."""
+from ...block import HybridBlock
+from ...nn import Conv2D
+
+__all__ = ["DeformableConvolution"]
+
+
+class DeformableConvolution(HybridBlock):
+    """2-D deformable convolution (Dai et al. 2017): a regular conv
+    predicts per-tap sampling offsets, then the deformable kernel
+    bilinear-samples the input at those offsets before the MXU matmul
+    (ops/extra_ops.py deformable_convolution)."""
+
+    def __init__(self, channels, kernel_size=(3, 3), strides=(1, 1),
+                 padding=(1, 1), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        if isinstance(strides, int):
+            strides = (strides, strides)
+        if isinstance(padding, int):
+            padding = (padding, padding)
+        if isinstance(dilation, int):
+            dilation = (dilation, dilation)
+        self._channels = channels
+        self._kernel = tuple(kernel_size)
+        self._stride = tuple(strides)
+        self._pad = tuple(padding)
+        self._dilate = tuple(dilation)
+        self._groups = groups
+        self._ndg = num_deformable_group
+        self._use_bias = use_bias
+        self._activation = activation
+        kh, kw = self._kernel
+        with self.name_scope():
+            # offset conv: 2 offsets (dy, dx) per deformable group per tap
+            # (zero-init so the layer starts as a plain conv — the
+            # reference's recommended init)
+            self.offset = Conv2D(
+                2 * num_deformable_group * kh * kw, kernel_size,
+                strides=strides, padding=padding, dilation=dilation,
+                use_bias=offset_use_bias,
+                weight_initializer=offset_weight_initializer,
+                bias_initializer=offset_bias_initializer,
+                prefix="offset_")
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels // groups, kh, kw),
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+
+    def _infer_param_shapes(self, x, *args):
+        kh, kw = self._kernel
+        self.weight.shape = (self._channels, x.shape[1] // self._groups,
+                             kh, kw)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        offset = self.offset(x)
+        args = [x, offset, weight] + ([bias] if bias is not None else [])
+        out = F.contrib.DeformableConvolution(
+            *args, kernel=self._kernel, stride=self._stride,
+            pad=self._pad, dilate=self._dilate,
+            num_filter=self._channels, num_group=self._groups,
+            num_deformable_group=self._ndg,
+            no_bias=bias is None)
+        if self._activation:
+            out = F.Activation(out, act_type=self._activation)
+        return out

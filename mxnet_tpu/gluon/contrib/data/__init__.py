@@ -1,0 +1,5 @@
+"""Experimental gluon datasets/samplers
+(ref: python/mxnet/gluon/contrib/data/)."""
+from .sampler import IntervalSampler
+
+__all__ = ["IntervalSampler"]

@@ -1,0 +1,28 @@
+"""ref: python/mxnet/gluon/contrib/data/sampler.py:21 IntervalSampler."""
+from ...data.sampler import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Samples i, i+interval, i+2*interval, ... for each start i —
+    the strided-epoch ordering used by truncated-BPTT language-model
+    training (ref: contrib/data/sampler.py)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length, \
+            f"interval {interval} must not be larger than length {length}"
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            for j in range(i, self._length, self._interval):
+                yield j
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        # without rollover only the first stride's indices are yielded
+        return (self._length + self._interval - 1) // self._interval

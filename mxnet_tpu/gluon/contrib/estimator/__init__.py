@@ -1,0 +1,13 @@
+"""Gluon Estimator: a batteries-included fit loop
+(ref: python/mxnet/gluon/contrib/estimator/)."""
+from .estimator import Estimator
+from .event_handler import (CheckpointHandler, EarlyStoppingHandler,
+                            EpochBegin, EpochEnd, LoggingHandler,
+                            MetricHandler, StoppingHandler, TrainBegin,
+                            TrainEnd, BatchBegin, BatchEnd,
+                            ValidationHandler)
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]

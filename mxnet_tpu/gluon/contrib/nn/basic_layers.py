@@ -1,0 +1,160 @@
+"""Experimental gluon layers
+(ref: python/mxnet/gluon/contrib/nn/basic_layers.py:22-30 — Concurrent,
+HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+PixelShuffle1D/2D/3D)."""
+from ...block import Block, HybridBlock
+from ...nn import BatchNorm, Embedding, HybridSequential, Sequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Runs children on the same input, concatenates outputs on `axis`
+    (ref: basic_layers.py Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import nd as F
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (ref: basic_layers.py HybridConcurrent).
+
+    Overrides forward (not hybrid_forward): this codebase's
+    HybridSequential dispatches children through its own forward, which
+    would otherwise CHAIN the branches instead of fanning out."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import nd as F
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through (ref: basic_layers.py Identity) — useful inside
+    Concurrent to keep the input as one of the concatenated branches."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with row_sparse gradients (ref: basic_layers.py
+    SparseEmbedding). On TPU the lookup itself is the dense MXU-friendly
+    gather; sparse_grad marks the weight for row-sparse update math in
+    the sparse optimizer path (optimizer.py sparse updates)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._embedding = Embedding(input_dim, output_dim, dtype=dtype,
+                                    weight_initializer=weight_initializer,
+                                    sparse_grad=True)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim}
+
+    @property
+    def weight(self):
+        return self._embedding.weight
+
+    def forward(self, x):
+        return self._embedding(x)
+
+    def __repr__(self):
+        return "SparseEmbedding({input_dim} -> {output_dim})".format(
+            **self._kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (ref: basic_layers.py SyncBatchNorm over
+    src/operator/contrib/sync_batch_norm.cc). Under pjit the batch axis
+    is GLOBAL — statistics reduce over all devices by construction — so
+    plain BatchNorm already has sync semantics on TPU; this subclass
+    keeps the explicit name/num_devices API."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(in_channels=in_channels, momentum=momentum,
+                         epsilon=epsilon, **kwargs)
+        self._num_devices = num_devices
+
+
+def _pixel_shuffle(F, x, factors, ndim):
+    """Rearrange (N, C*prod(f), *S) -> (N, C, *S*f) — the reference's
+    depth-to-space (basic_layers.py PixelShuffle*D reshape/transpose
+    chains), expressed as one reshape + transpose + reshape."""
+    fshape = tuple(factors)
+    N, C = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    C_out = C
+    for f in fshape:
+        C_out //= f
+    # (N, C_out, f1..fn, s1..sn)
+    x = x.reshape((N, C_out) + fshape + tuple(spatial))
+    # interleave: (N, C_out, s1, f1, s2, f2, ...)
+    perm = [0, 1]
+    for i in range(ndim):
+        perm += [2 + ndim + i, 2 + i]
+    x = x.transpose(tuple(perm))
+    out_spatial = tuple(s * f for s, f in zip(spatial, fshape))
+    return x.reshape((N, C_out) + out_spatial)
+
+
+class PixelShuffle1D(HybridBlock):
+    """ref: basic_layers.py PixelShuffle1D."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = (int(factor),)
+
+    def hybrid_forward(self, F, x):
+        return _pixel_shuffle(F, x, self._factor, 1)
+
+    def __repr__(self):
+        return f"PixelShuffle1D({self._factor[0]})"
+
+
+class PixelShuffle2D(HybridBlock):
+    """ref: basic_layers.py PixelShuffle2D."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor, factor)
+        self._factor = tuple(int(f) for f in factor)
+
+    def hybrid_forward(self, F, x):
+        return _pixel_shuffle(F, x, self._factor, 2)
+
+    def __repr__(self):
+        return f"PixelShuffle2D({self._factor})"
+
+
+class PixelShuffle3D(HybridBlock):
+    """ref: basic_layers.py PixelShuffle3D."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor, factor, factor)
+        self._factor = tuple(int(f) for f in factor)
+
+    def hybrid_forward(self, F, x):
+        return _pixel_shuffle(F, x, self._factor, 3)
+
+    def __repr__(self):
+        return f"PixelShuffle3D({self._factor})"

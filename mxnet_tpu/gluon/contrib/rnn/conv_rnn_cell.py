@@ -1,0 +1,182 @@
+"""Convolutional recurrent cells
+(ref: python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py:21 —
+Conv{1,2,3}D{RNN,LSTM,GRU}Cell; Shi et al. 2015 ConvLSTM). The dense
+i2h/h2h projections of the plain cells become convolutions over the
+spatial dims; states carry (C, *spatial) feature maps."""
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Shared conv-cell machinery (ref: conv_rnn_cell.py
+    _BaseConvRNNCell): input_shape is (C, *spatial) channels-first."""
+
+    _n_gates = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 conv_layout="NCHW", activation="tanh", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        dims = len(conv_layout) - 2
+        self._dims = dims
+        self._input_shape = tuple(input_shape)
+        self._hidden_channels = hidden_channels
+        self._activation = activation
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, \
+                "h2h kernel must be odd to preserve the state shape " \
+                f"(got {self._h2h_kernel})"
+        self._i2h_pad = _tup(i2h_pad, dims)
+        self._i2h_dilate = _tup(i2h_dilate, dims)
+        self._h2h_dilate = _tup(h2h_dilate, dims)
+        # SAME-padding for h2h so state spatial dims are stable
+        self._h2h_pad = tuple(d * (k - 1) // 2 for k, d in
+                              zip(self._h2h_kernel, self._h2h_dilate))
+        in_c = input_shape[0]
+        ng = self._n_gates
+        self._state_shape = self._compute_state_shape()
+        self.i2h_weight = self.params.get(
+            "i2h_weight",
+            shape=(ng * hidden_channels, in_c) + self._i2h_kernel,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight",
+            shape=(ng * hidden_channels, hidden_channels) +
+                  self._h2h_kernel,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hidden_channels,), init="zeros",
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hidden_channels,), init="zeros",
+            allow_deferred_init=True)
+
+    def _compute_state_shape(self):
+        spatial = self._input_shape[1:]
+        out = []
+        for s, k, p, d in zip(spatial, self._i2h_kernel, self._i2h_pad,
+                              self._i2h_dilate):
+            out.append((s + 2 * p - d * (k - 1) - 1) + 1)
+        return (self._hidden_channels,) + tuple(out)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": "NC" + "DHW"[-self._dims:]}]
+
+    def _conv(self, F, x, weight, bias, kernel, pad, dilate):
+        ng = self._n_gates
+        return F.Convolution(
+            x, weight, bias, kernel=kernel, pad=pad, dilate=dilate,
+            stride=(1,) * self._dims,
+            num_filter=ng * self._hidden_channels)
+
+    def _gates(self, F, inputs, states, p):
+        # p: the param values injected into hybrid_forward (kwargs named
+        # by parameter) — NOT .data(), which would bypass the traced
+        # values under functional_call/jit
+        i2h = self._conv(F, inputs, p["i2h_weight"], p["i2h_bias"],
+                         self._i2h_kernel, self._i2h_pad,
+                         self._i2h_dilate)
+        h2h = self._conv(F, states[0], p["h2h_weight"], p["h2h_bias"],
+                         self._h2h_kernel, self._h2h_pad,
+                         self._h2h_dilate)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _n_gates = 1
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, **params):
+        i2h, h2h = self._gates(F, inputs, states, params)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _n_gates = 4
+
+    def state_info(self, batch_size=0):
+        info = super().state_info(batch_size)
+        return info + [dict(info[0])]
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, **params):
+        i2h, h2h = self._gates(F, inputs, states, params)
+        gates = i2h + h2h
+        sliced = F.SliceChannel(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(sliced[0])
+        f = F.sigmoid(sliced[1])
+        g = self._act(F, sliced[2])
+        o = F.sigmoid(sliced[3])
+        next_c = f * states[1] + i * g
+        next_h = o * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _n_gates = 3
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, **params):
+        i2h, h2h = self._gates(F, inputs, states, params)
+        i2h_s = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_s = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_s[0] + h2h_s[0])
+        update = F.sigmoid(i2h_s[1] + h2h_s[1])
+        cand = self._act(F, i2h_s[2] + reset * h2h_s[2])
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]
+
+
+def _make(cell_base, dims, name):
+    layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[dims]
+
+    class _Cell(cell_base):
+        __doc__ = (f"ref: contrib/rnn/conv_rnn_cell.py {name} "
+                   f"(layout {layout}).")
+
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     activation="tanh", prefix=None, params=None,
+                     conv_layout=layout):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad=i2h_pad,
+                             i2h_dilate=i2h_dilate, h2h_dilate=h2h_dilate,
+                             conv_layout=conv_layout,
+                             activation=activation, prefix=prefix,
+                             params=params)
+
+    _Cell.__name__ = name
+    _Cell.__qualname__ = name
+    return _Cell
+
+
+Conv1DRNNCell = _make(_ConvRNNCell, 1, "Conv1DRNNCell")
+Conv2DRNNCell = _make(_ConvRNNCell, 2, "Conv2DRNNCell")
+Conv3DRNNCell = _make(_ConvRNNCell, 3, "Conv3DRNNCell")
+Conv1DLSTMCell = _make(_ConvLSTMCell, 1, "Conv1DLSTMCell")
+Conv2DLSTMCell = _make(_ConvLSTMCell, 2, "Conv2DLSTMCell")
+Conv3DLSTMCell = _make(_ConvLSTMCell, 3, "Conv3DLSTMCell")
+Conv1DGRUCell = _make(_ConvGRUCell, 1, "Conv1DGRUCell")
+Conv2DGRUCell = _make(_ConvGRUCell, 2, "Conv2DGRUCell")
+Conv3DGRUCell = _make(_ConvGRUCell, 3, "Conv3DGRUCell")

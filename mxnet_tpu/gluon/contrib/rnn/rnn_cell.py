@@ -1,0 +1,124 @@
+"""Experimental recurrent cells
+(ref: python/mxnet/gluon/contrib/rnn/rnn_cell.py:20 — LSTMPCell,
+VariationalDropoutCell)."""
+from ...rnn.rnn_cell import HybridRecurrentCell, ModifierCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (a.k.a. locked) dropout: ONE dropout mask per
+    sequence, shared across all time steps, applied to inputs / states /
+    outputs (ref: contrib/rnn/rnn_cell.py VariationalDropoutCell,
+    Gal & Ghahramani 2016 semantics)."""
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0.):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def _mask(self, F, cached_name, p, like):
+        """Sample a keep/drop mask once (first step) and reuse it."""
+        mask = getattr(self, cached_name)
+        if mask is None:
+            mask = F.Dropout(F.ones_like(like), p=p)
+            setattr(self, cached_name, mask)
+        return mask
+
+    def hybrid_forward(self, F, inputs, states):
+        from .... import autograd
+        training = autograd.is_training()
+        if training and self.drop_inputs:
+            inputs = inputs * self._mask(F, "_input_mask",
+                                         self.drop_inputs, inputs)
+        if training and self.drop_states:
+            mask = self._mask(F, "_state_mask", self.drop_states, states[0])
+            states = [states[0] * mask] + list(states[1:])
+        output, next_states = self.base_cell(inputs, states)
+        if training and self.drop_outputs:
+            output = output * self._mask(F, "_output_mask",
+                                         self.drop_outputs, output)
+        return output, next_states
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(in={self.drop_inputs}, "
+                f"state={self.drop_states}, out={self.drop_outputs})")
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with a hidden-state projection (ref: contrib/rnn/rnn_cell.py
+    LSTMPCell; Sak et al. 2014). The recurrent state is the PROJECTED
+    vector r (size projection_size); the cell state keeps hidden_size:
+
+        gates from [x, r];  c' = f*c + i*g;  h = o*tanh(c');  r' = W_r h
+    """
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def _infer_param_shapes(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        r, c = states
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(r, h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        sliced = F.SliceChannel(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(sliced[0])
+        forget_gate = F.sigmoid(sliced[1])
+        in_transform = F.tanh(sliced[2])
+        out_gate = F.sigmoid(sliced[3])
+        next_c = forget_gate * c + in_gate * in_transform
+        hidden = out_gate * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]

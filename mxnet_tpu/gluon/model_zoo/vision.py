@@ -739,8 +739,160 @@ def mobilenet0_25(**kw):
 
 
 def mobilenet_v2_1_0(**kw):
-    kw.pop("pretrained", None)
+    if kw.pop("pretrained", False):
+        raise MXNetError("pretrained weights unavailable (no egress)")
     return MobileNetV2(1.0, **kw)
+
+
+def mobilenet_v2_0_75(**kw):
+    if kw.pop("pretrained", False):
+        raise MXNetError("pretrained weights unavailable (no egress)")
+    return MobileNetV2(0.75, **kw)
+
+
+def mobilenet_v2_0_5(**kw):
+    if kw.pop("pretrained", False):
+        raise MXNetError("pretrained weights unavailable (no egress)")
+    return MobileNetV2(0.5, **kw)
+
+
+def mobilenet_v2_0_25(**kw):
+    if kw.pop("pretrained", False):
+        raise MXNetError("pretrained weights unavailable (no egress)")
+    return MobileNetV2(0.25, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Inception V3 (ref: gluon/model_zoo/vision/inception.py — Inception3 /
+# inception_v3; Szegedy et al. 2015, 299x299 input)
+# ---------------------------------------------------------------------------
+
+def _inc_conv(channels, kernel, stride=1, pad=0):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(channels, kernel, strides=stride, padding=pad,
+                      use_bias=False),
+            nn.BatchNorm(epsilon=0.001),
+            nn.Activation("relu"))
+    return out
+
+
+def _inc_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential(prefix="")
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    for channels, kernel, stride, pad in conv_settings:
+        out.add(_inc_conv(channels, kernel, stride, pad))
+    return out
+
+
+def _inc_A(pool_features):
+    from ..contrib.nn import HybridConcurrent
+    out = HybridConcurrent(axis=1, prefix="")
+    out.add(_inc_branch(None, (64, 1, 1, 0)),
+            _inc_branch(None, (48, 1, 1, 0), (64, 5, 1, 2)),
+            _inc_branch(None, (64, 1, 1, 0), (96, 3, 1, 1),
+                        (96, 3, 1, 1)),
+            _inc_branch("avg", (pool_features, 1, 1, 0)))
+    return out
+
+
+def _inc_B():
+    from ..contrib.nn import HybridConcurrent
+    out = HybridConcurrent(axis=1, prefix="")
+    out.add(_inc_branch(None, (384, 3, 2, 0)),
+            _inc_branch(None, (64, 1, 1, 0), (96, 3, 1, 1),
+                        (96, 3, 2, 0)),
+            _inc_branch("max"))
+    return out
+
+
+def _inc_C(channels_7x7):
+    from ..contrib.nn import HybridConcurrent
+    c = channels_7x7
+    out = HybridConcurrent(axis=1, prefix="")
+    out.add(_inc_branch(None, (192, 1, 1, 0)),
+            _inc_branch(None, (c, 1, 1, 0), (c, (1, 7), 1, (0, 3)),
+                        (192, (7, 1), 1, (3, 0))),
+            _inc_branch(None, (c, 1, 1, 0), (c, (7, 1), 1, (3, 0)),
+                        (c, (1, 7), 1, (0, 3)), (c, (7, 1), 1, (3, 0)),
+                        (192, (1, 7), 1, (0, 3))),
+            _inc_branch("avg", (192, 1, 1, 0)))
+    return out
+
+
+def _inc_D():
+    from ..contrib.nn import HybridConcurrent
+    out = HybridConcurrent(axis=1, prefix="")
+    out.add(_inc_branch(None, (192, 1, 1, 0), (320, 3, 2, 0)),
+            _inc_branch(None, (192, 1, 1, 0), (192, (1, 7), 1, (0, 3)),
+                        (192, (7, 1), 1, (3, 0)), (192, 3, 2, 0)),
+            _inc_branch("max"))
+    return out
+
+
+class _IncESplit(HybridBlock):
+    """The E-block's forked 1x3/3x1 pair, concatenated."""
+
+    def __init__(self, pre_settings, **kwargs):
+        super().__init__(**kwargs)
+        self.pre = nn.HybridSequential(prefix="")
+        for channels, kernel, stride, pad in pre_settings:
+            self.pre.add(_inc_conv(channels, kernel, stride, pad))
+        self.a = _inc_conv(384, (1, 3), 1, (0, 1))
+        self.b = _inc_conv(384, (3, 1), 1, (1, 0))
+
+    def hybrid_forward(self, F, x):
+        h = self.pre(x)
+        return F.Concat(self.a(h), self.b(h), dim=1)
+
+
+def _inc_E():
+    from ..contrib.nn import HybridConcurrent
+    out = HybridConcurrent(axis=1, prefix="")
+    out.add(_inc_branch(None, (320, 1, 1, 0)),
+            _IncESplit([(384, 1, 1, 0)]),
+            _IncESplit([(448, 1, 1, 0), (384, 3, 1, 1)]),
+            _inc_branch("avg", (192, 1, 1, 0)))
+    return out
+
+
+class Inception3(HybridBlock):
+    """ref: inception.py Inception3 (input 3x299x299)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(_inc_conv(32, 3, 2),
+                              _inc_conv(32, 3),
+                              _inc_conv(64, 3, pad=1),
+                              nn.MaxPool2D(pool_size=3, strides=2),
+                              _inc_conv(80, 1),
+                              _inc_conv(192, 3),
+                              nn.MaxPool2D(pool_size=3, strides=2),
+                              _inc_A(32), _inc_A(64), _inc_A(64),
+                              _inc_B(),
+                              _inc_C(128), _inc_C(160), _inc_C(160),
+                              _inc_C(192),
+                              _inc_D(),
+                              _inc_E(), _inc_E(),
+                              nn.AvgPool2D(pool_size=8),
+                              nn.Dropout(0.5))
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        x = self.output(x)
+        return x
+
+
+def inception_v3(pretrained=False, classes=1000, **kwargs):
+    """ref: inception.py inception_v3."""
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no egress)")
+    return Inception3(classes=classes, **kwargs)
 
 
 _models = {
@@ -760,6 +912,10 @@ _models = {
     "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "mobilenetv2_1.0": mobilenet_v2_1_0,
+    "mobilenetv2_0.75": mobilenet_v2_0_75,
+    "mobilenetv2_0.5": mobilenet_v2_0_5,
+    "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "inceptionv3": inception_v3,
 }
 
 
