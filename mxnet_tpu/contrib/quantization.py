@@ -1,8 +1,19 @@
 """Quantization driver: calibrate + convert models to INT8.
 
-ref: python/mxnet/contrib/quantization.py — quantize_model with
-calib_mode none/naive/entropy (the C++ graph pass quantize_graph_pass.cc
-becomes a symbol rewrite here; int8 kernels live in ops/quantization.py).
+ref: python/mxnet/contrib/quantization.py quantize_model (the C++ graph
+pass src/operator/quantization/quantize_graph_pass.cc). Here the pass is
+a real Symbol-DAG rewrite: every quantizable Convolution /
+FullyConnected is replaced by
+
+    quantize_v2(input) -> _contrib_quantized_{conv,fully_connected}
+    (int8 x int8 -> int32 on the MXU's native int8 path)
+    -> requantize -> dequantize [-> +bias in fp32]
+
+with calibration ranges (naive min/max or entropy-histogram, collected
+over ALL internal outputs like the reference's LayerOutputCollector)
+baked into the quantize/requantize params, and weights offline-quantized
+to int8 vars. The rewritten Symbol executes through the normal
+executor — no special dispatch path.
 """
 from __future__ import annotations
 
@@ -15,13 +26,11 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["quantize_model", "quantize_graph", "CalibrationCollector"]
 
-_QUANTIZABLE = {"Convolution": "_contrib_quantized_conv",
-                "FullyConnected": "_contrib_quantized_fully_connected",
-                "Pooling": "_contrib_quantized_pooling"}
+_QUANTIZABLE = ("Convolution", "FullyConnected")
 
 
 class CalibrationCollector:
-    """Collects per-layer output min/max (naive mode) or histograms
+    """Collects per-entry output min/max (naive mode) or histograms
     (entropy mode) during calibration forward passes (ref:
     quantization.py _LayerOutputCollector/_LayerOutputMinMaxCollector)."""
 
@@ -31,17 +40,33 @@ class CalibrationCollector:
         self.min_max: Dict[str, tuple] = {}
         self.hists: Dict[str, onp.ndarray] = {}
 
+    def _sym_range(self, name):
+        lo, hi = self.min_max[name]
+        return (min(lo, -abs(hi)), max(hi, abs(lo)))
+
     def collect(self, name: str, arr: NDArray):
         a = arr.asnumpy()
         lo, hi = float(a.min()), float(a.max())
+        old_range = self._sym_range(name) if name in self.min_max else None
         if name in self.min_max:
             plo, phi = self.min_max[name]
             lo, hi = min(lo, plo), max(hi, phi)
         self.min_max[name] = (lo, hi)
         if self.mode == "entropy":
-            h, _ = onp.histogram(a, bins=self.num_bins,
-                                 range=(min(lo, -abs(hi)),
-                                        max(hi, abs(lo))))
+            rng = self._sym_range(name)
+            if name in self.hists and old_range != rng:
+                # the symmetric range grew: RE-BIN the accumulated
+                # histogram onto the new edges before adding this batch —
+                # summing histograms taken over different edges would
+                # smear earlier batches' mass across the wrong bins
+                old = self.hists[name]
+                centers = onp.linspace(old_range[0], old_range[1],
+                                       self.num_bins + 1)
+                centers = (centers[:-1] + centers[1:]) / 2
+                rebinned, _ = onp.histogram(centers, bins=self.num_bins,
+                                            range=rng, weights=old)
+                self.hists[name] = rebinned
+            h, _ = onp.histogram(a, bins=self.num_bins, range=rng)
             if name in self.hists:
                 self.hists[name] += h
             else:
@@ -50,29 +75,139 @@ class CalibrationCollector:
     def thresholds(self) -> Dict[str, tuple]:
         if self.mode != "entropy":
             return dict(self.min_max)
+        # single calibration policy: the _contrib_calibrate_entropy op
+        # (ops/quantization.py calibrate_entropy) is the one
+        # implementation of the threshold search
+        from ..ops.quantization import calibrate_entropy
         out = {}
         for name, h in self.hists.items():
-            lo, hi = self.min_max[name]
-            cdf = onp.cumsum(h) / max(h.sum(), 1e-12)
-            lo_i = int(onp.argmax(cdf > 5e-5))
-            hi_i = len(h) - int(onp.argmax(cdf[::-1] < 1 - 5e-5)) - 1
-            edges = onp.linspace(min(lo, -abs(hi)), max(hi, abs(lo)),
-                                 len(h) + 1)
-            out[name] = (float(edges[lo_i]), float(edges[hi_i + 1]))
+            rng = self._sym_range(name)
+            edges = onp.linspace(rng[0], rng[1], len(h) + 1)
+            lo, hi = calibrate_entropy(onp.asarray(h, "float32"),
+                                       onp.asarray(edges, "float32"))
+            out[name] = (float(lo[0]), float(hi[0]))
         return out
 
 
-def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8"):
-    """Rewrite a Symbol: wrap quantizable ops with quantize/dequantize
-    (ref: src/operator/quantization/quantize_graph_pass.cc). Minimal
-    rewrite: mark nodes; the executor dispatches int8 kernels when the
-    node params carry `quantized=True` calibration ranges."""
+def _entry_name(node, idx):
+    return f"{node.name}_output" if idx == 0 else \
+        f"{node.name}_output{idx}"
+
+
+def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
+                   calib_ranges: Optional[Dict[str, tuple]] = None):
+    """Rewrite the Symbol DAG, lowering quantizable nodes onto the int8
+    ops (ref: quantize_graph_pass.cc QuantizeGraph). Returns the new
+    Symbol; weight/bias quantization happens in quantize_model.
+
+    calib_ranges maps internal-output entry names ("<node>_output") to
+    (min, max); nodes without a range quantize dynamically per batch.
+    """
     from ..symbol.symbol import Symbol, _Node
-    # annotate a copy of the graph
+    if quantized_dtype != "int8":
+        raise MXNetError(f"unsupported quantized_dtype {quantized_dtype}")
+    calib_ranges = calib_ranges or {}
+    excluded = set(excluded_sym_names or ())
+
+    mapping: Dict[tuple, tuple] = {}  # (id(old_node), idx) -> new entry
+
+    def resolve(entry):
+        old, idx = entry
+        return mapping.get((id(old), idx), (old, idx))
+
     for node in sym._topo_nodes():
-        if node.op in _QUANTIZABLE and node.name not in excluded_sym_names:
-            node.attrs["__quantized__"] = quantized_dtype
-    return sym
+        if node.is_variable:
+            continue
+        new_inputs = [resolve(e) for e in node.inputs]
+        quantizable = (node.op in _QUANTIZABLE
+                       and node.name not in excluded
+                       # only weight-as-variable is rewritable: a
+                       # computed weight has no offline int8 copy and
+                       # its range vars would be unbindable
+                       and len(node.inputs) > 1
+                       and node.inputs[1][0].is_variable)
+        if not quantizable:
+            if new_inputs != node.inputs:
+                repl = _Node(node.op, node.name, new_inputs,
+                             dict(node.params), dict(node.attrs))
+                for i in range(node._n_out):
+                    mapping[(id(node), i)] = (repl, i)
+            continue
+
+        # --- quantize the data input ---------------------------------
+        src = new_inputs[0]
+        src_name = _entry_name(node.inputs[0][0], node.inputs[0][1])
+        in_calibrated = src_name in calib_ranges
+        qparams = {"out_type": "int8"}
+        if in_calibrated:
+            lo, hi = calib_ranges[src_name]
+            qparams["min_calib_range"] = float(lo)
+            qparams["max_calib_range"] = float(hi)
+        q_in = _Node("_contrib_quantize_v2", f"{node.name}_quantize",
+                     [src], qparams)
+
+        # --- int8 weight + range vars (values from quantize_model) ---
+        w_old = node.inputs[1][0]
+        w_min = _Node(None, f"{w_old.name}_min", [], {})
+        w_max = _Node(None, f"{w_old.name}_max", [], {})
+        dummy = (q_in, 1)  # placeholder for the unused bias slots
+
+        params = dict(node.params)
+        has_bias = (len(node.inputs) > 2
+                    and not params.get("no_bias", False)
+                    and node.inputs[2][0].is_variable)
+        # bias placement decides requantize correctness: a CALIBRATED
+        # requantize range is the post-bias output range, so the bias
+        # must already be inside the int32 accumulator (as int32, scaled
+        # by s_data*s_weight — quantize_model provides
+        # '<node>_bias_quant'); without input calibration the int8
+        # scales are dynamic, the bias cannot be pre-scaled offline, and
+        # it is instead re-added in fp32 after dequantize (requantize is
+        # then dynamic too, so no mis-clipping)
+        fold_bias = has_bias and in_calibrated
+        bias_entry = dummy
+        if fold_bias:
+            b_q = _Node(None, f"{node.name}_bias_quant", [], {})
+            bias_entry = (b_q, 0)
+        params["no_bias"] = not fold_bias
+        qop = ("_contrib_quantized_conv" if node.op == "Convolution"
+               else "_contrib_quantized_fully_connected")
+        qnode = _Node(qop, f"{node.name}_int8",
+                      [(q_in, 0), (w_old, 0), bias_entry,
+                       (q_in, 1), (q_in, 2),
+                       (w_min, 0), (w_max, 0), dummy, dummy],
+                      params)
+
+        # --- requantize int32 accum to int8, then back to fp32 --------
+        rparams = {}
+        out_name = _entry_name(node, 0)
+        if out_name in calib_ranges and (fold_bias or not has_bias):
+            lo, hi = calib_ranges[out_name]
+            rparams["min_calib_range"] = float(lo)
+            rparams["max_calib_range"] = float(hi)
+        req = _Node("_contrib_requantize", f"{node.name}_requantize",
+                    [(qnode, 0), (qnode, 1), (qnode, 2)], rparams)
+        deq = _Node("_contrib_dequantize", f"{node.name}_dequantize",
+                    [(req, 0), (req, 1), (req, 2)], {})
+
+        out_entry = (deq, 0)
+        if has_bias and not fold_bias:
+            b_old = node.inputs[2][0]
+            if node.op == "Convolution":
+                ndim = len(params.get("kernel", (1, 1)))
+                shape = (1, -1) + (1,) * ndim
+                b_shaped = _Node("reshape", f"{node.name}_bias_reshape",
+                                 [(b_old, 0)], {"shape": shape})
+                b_entry = (b_shaped, 0)
+            else:
+                b_entry = (b_old, 0)
+            add = _Node("broadcast_add", f"{node.name}_bias_add",
+                        [out_entry, b_entry], {})
+            out_entry = (add, 0)
+        for i in range(node._n_out):
+            mapping[(id(node), i)] = out_entry
+
+    return Symbol([resolve(e) for e in sym._outputs])
 
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
@@ -81,15 +216,21 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    calib_data=None, num_calib_examples=None,
                    quantized_dtype="int8", logger=None):
     """ref: quantization.py quantize_model — returns
-    (qsym, qarg_params, aux_params)."""
+    (qsym, qarg_params, aux_params). qsym executes the int8 kernels;
+    qarg_params carries int8 weights plus their range vars."""
     excluded = set(excluded_sym_names or [])
-    qsym = quantize_graph(sym, excluded, quantized_dtype)
+    if calib_mode != "none" and calib_data is None:
+        raise MXNetError(
+            f"calib_mode='{calib_mode}' requires calib_data "
+            "(pass calib_mode='none' for dynamic-range quantization)")
 
-    calib_ranges = {}
+    # --- calibration over ALL internal outputs ------------------------
+    calib_ranges: Dict[str, tuple] = {}
     if calib_mode != "none" and calib_data is not None:
         collector = CalibrationCollector(
             "naive" if calib_mode == "naive" else "entropy")
-        ex = sym.simple_bind(
+        internals = sym.get_internals()
+        ex = internals.simple_bind(
             ctx, **{d.name: d.shape for d in calib_data.provide_data})
         ex.copy_params_from(arg_params, aux_params, allow_extra_params=True)
         n = 0
@@ -98,27 +239,58 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                 if name in ex.arg_dict:
                     ex.arg_dict[name][:] = arr
             outs = ex.forward(is_train=False)
-            for name, out in zip(sym.list_outputs(), outs):
+            for name, out in zip(internals.list_outputs(), outs):
                 collector.collect(name, out)
             n += batch.data[0].shape[0]
             if num_calib_examples is not None and n >= num_calib_examples:
                 break
         calib_ranges = collector.thresholds()
+        if hasattr(calib_data, "reset"):
+            calib_data.reset()
 
-    # quantize weights offline
+    qsym = quantize_graph(sym, excluded, quantized_dtype, calib_ranges)
+
+    # --- offline weight + bias quantization ---------------------------
+    from ..ndarray.ndarray import array as nd_array
+    quantized_weights = {}
+    folded_biases = {}  # original bias name -> (node, weight name)
+    for node in sym._topo_nodes():
+        if node.op in _QUANTIZABLE and node.name not in excluded \
+                and len(node.inputs) > 1 and node.inputs[1][0].is_variable:
+            w_name = node.inputs[1][0].name
+            quantized_weights[w_name] = node
+            src_name = _entry_name(node.inputs[0][0], node.inputs[0][1])
+            has_bias = (len(node.inputs) > 2
+                        and not node.params.get("no_bias", False)
+                        and node.inputs[2][0].is_variable)
+            if has_bias and src_name in calib_ranges:
+                folded_biases[node.inputs[2][0].name] = (node, w_name,
+                                                         src_name)
     qarg_params = {}
+    w_amax = {}
     for name, arr in arg_params.items():
-        if name.endswith("weight") and quantized_dtype == "int8":
+        if name in quantized_weights:
             a = arr.asnumpy()
-            amax = max(abs(a.min()), abs(a.max()), 1e-12)
+            amax = max(abs(float(a.min())), abs(float(a.max())), 1e-12)
+            w_amax[name] = amax
             scale = 127.0 / amax
-            from ..ndarray.ndarray import array as nd_array
             qarg_params[name] = nd_array(
                 onp.clip(onp.round(a * scale), -127, 127).astype("int8"))
-            qarg_params[name + "_min"] = nd_array([-amax])
-            qarg_params[name + "_max"] = nd_array([amax])
-        else:
+            qarg_params[name + "_min"] = nd_array(
+                onp.array([-amax], "float32"))
+            qarg_params[name + "_max"] = nd_array(
+                onp.array([amax], "float32"))
+        elif name not in folded_biases:
             qarg_params[name] = arr
-    for node_name, rng in calib_ranges.items():
-        pass  # ranges attached via attrs in quantize_graph consumers
+    # folded biases live in the int32 accumulator: scale by
+    # s_data * s_weight (the product the accumulator is measured in)
+    for b_name, (node, w_name, src_name) in folded_biases.items():
+        if b_name not in arg_params or w_name not in w_amax:
+            continue
+        lo, hi = calib_ranges[src_name]
+        d_amax = max(abs(lo), abs(hi), 1e-12)
+        s = (127.0 / d_amax) * (127.0 / w_amax[w_name])
+        b = arg_params[b_name].asnumpy()
+        qarg_params[f"{node.name}_bias_quant"] = nd_array(
+            onp.round(b * s).astype("int32"))
     return qsym, qarg_params, dict(aux_params)

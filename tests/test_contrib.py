@@ -200,6 +200,92 @@ def test_quantized_fc():
     assert_almost_equal(out.asnumpy(), expect)
 
 
+def test_quantize_model_end_to_end():
+    """quantize_model must emit a REWRITTEN graph that executes the int8
+    conv/FC kernels and stays close to the fp32 model (ref:
+    quantize_graph_pass.cc + quantization.py quantize_model)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import io, sym
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    rs = onp.random.RandomState(0)
+    x = sym.var("data")
+    c = sym.Convolution(x, name="conv0", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    r = sym.Activation(c, act_type="relu")
+    f = sym.flatten(r)
+    o = sym.FullyConnected(f, name="fc0", num_hidden=6)
+    net = o
+
+    args = {"conv0_weight": nd.array(rs.randn(8, 3, 3, 3)
+                                     .astype("float32") * 0.3),
+            "conv0_bias": nd.array(rs.randn(8).astype("float32") * 0.1),
+            "fc0_weight": nd.array(rs.randn(6, 8 * 6 * 6)
+                                   .astype("float32") * 0.1),
+            "fc0_bias": nd.array(rs.randn(6).astype("float32") * 0.1)}
+    data = rs.uniform(-1, 1, (8, 3, 6, 6)).astype("float32")
+    calib = io.NDArrayIter(data={"data": nd.array(data)}, batch_size=4)
+
+    qsym, qargs, qaux = quantize_model(
+        net, args, {}, calib_mode="naive", calib_data=calib,
+        ctx=mx.cpu())
+    # the rewrite actually lowered onto the int8 ops
+    ops = {n.op for n in qsym._topo_nodes() if n.op}
+    assert "_contrib_quantized_conv" in ops
+    assert "_contrib_quantized_fully_connected" in ops
+    assert str(qargs["conv0_weight"].dtype) == "int8"
+    assert str(qargs["fc0_weight"].dtype) == "int8"
+
+    xs = nd.array(data[:4])
+    ref = net.bind(mx.cpu(), {"data": xs, **args}).forward()[0].asnumpy()
+    got = qsym.bind(mx.cpu(), {"data": xs, **qargs}).forward()[0].asnumpy()
+    # int8 quantization error bound: close in absolute + rank order
+    spread = max(ref.max() - ref.min(), 1e-6)
+    assert onp.abs(got - ref).max() / spread < 0.15
+    agree = (got.argmax(axis=1) == ref.argmax(axis=1)).mean()
+    assert agree >= 0.75
+
+
+def test_quantize_model_bias_shifts_output_range():
+    """Bias that recenters the output must not break calibration: the
+    bias is folded into the int32 accumulator (scaled s_data*s_weight)
+    so the calibrated post-bias requantize range applies to what is
+    actually requantized. Regression: all-negative conv outputs ~-20
+    recentered near 0 by bias +5 used to clip at >100% error."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import io, sym
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    rs = onp.random.RandomState(1)
+    x = sym.var("data")
+    net = sym.Convolution(x, name="conv0", kernel=(1, 1), num_filter=4)
+
+    w = -onp.abs(rs.randn(4, 3, 1, 1).astype("float32"))  # all-negative
+    args = {"conv0_weight": nd.array(w),
+            "conv0_bias": nd.array(onp.full(4, 5.0, "float32"))}
+    data = rs.uniform(2.0, 3.0, (8, 3, 4, 4)).astype("float32")
+    calib = io.NDArrayIter(data={"data": nd.array(data)}, batch_size=4)
+    qsym, qargs, _ = quantize_model(net, args, {}, calib_mode="naive",
+                                    calib_data=calib, ctx=mx.cpu())
+    xs = nd.array(data[:4])
+    ref = net.bind(mx.cpu(), {"data": xs, **args}).forward()[0].asnumpy()
+    got = qsym.bind(mx.cpu(), {"data": xs, **qargs}).forward()[0].asnumpy()
+    spread = max(ref.max() - ref.min(), 1e-6)
+    assert onp.abs(got - ref).max() / spread < 0.1
+    # the folded int32 bias replaced the fp32 bias variable
+    assert "conv0_bias_quant" in qargs and "conv0_bias" not in qargs
+    assert str(qargs["conv0_bias_quant"].dtype) == "int32"
+
+
+def test_quantize_model_requires_calib_data():
+    from mxnet_tpu import sym
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib.quantization import quantize_model
+    net = sym.FullyConnected(sym.var("data"), name="fc", num_hidden=2)
+    with pytest.raises(MXNetError, match="calib_data"):
+        quantize_model(net, {}, {}, calib_mode="entropy")
+
+
 def test_misc_contrib():
     x = nd.array([1.0, 2.0])
     q = nd.contrib.quadratic(x, a=1, b=2, c=3)
