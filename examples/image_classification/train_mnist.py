@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Train an MLP / LeNet on MNIST via the symbolic Module API.
+
+Mirrors the reference's example/image-classification/train_mnist.py:
+symbol -> Module.fit with metrics, lr schedule, and checkpointing. Uses
+the real MNIST ubyte files when --data-dir has them (io.MNISTIter),
+otherwise a deterministic synthetic stand-in with learnable structure
+(class = quadrant of the brightest blob) so the script is runnable
+offline.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+# Examples default to the CPU backend: small eager loops pay per-op
+# dispatch latency on a remote TPU; pass --tpu to run on the chip
+# (worthwhile for the jit-compiled / large-batch configs).
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, nd, sym
+
+
+def mlp_symbol(num_classes=10):
+    """ref: train_mnist.py get_mlp."""
+    data = sym.var("data")
+    h = sym.flatten(data)
+    h = sym.Activation(sym.FullyConnected(h, num_hidden=128, name="fc1"),
+                       act_type="relu")
+    h = sym.Activation(sym.FullyConnected(h, num_hidden=64, name="fc2"),
+                       act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=num_classes, name="fc3")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def lenet_symbol(num_classes=10):
+    """ref: train_mnist.py get_lenet (LeCun et al. 98)."""
+    data = sym.var("data")
+    c1 = sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    a1 = sym.Activation(c1, act_type="tanh")
+    p1 = sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = sym.Convolution(p1, kernel=(5, 5), num_filter=50)
+    a2 = sym.Activation(c2, act_type="tanh")
+    p2 = sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f = sym.flatten(p2)
+    h = sym.Activation(sym.FullyConnected(f, num_hidden=500),
+                       act_type="tanh")
+    h = sym.FullyConnected(h, num_hidden=num_classes)
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def synthetic_mnist(n, seed=0):
+    """Learnable synthetic digits: a bright 8x8 blob whose quadrant+
+    intensity band encodes the class."""
+    rs = onp.random.RandomState(seed)
+    x = rs.rand(n, 1, 28, 28).astype("float32") * 0.2
+    y = rs.randint(0, 10, n)
+    for i, cls in enumerate(y):
+        qy, qx = divmod(cls % 4, 2)
+        r, c = 4 + qy * 12, 4 + qx * 12
+        x[i, 0, r:r + 8, c:c + 8] += 0.4 + 0.15 * (cls // 4)
+    return x, y.astype("float32")
+
+
+def get_iters(args):
+    imgs = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(imgs):
+        train = io.MNISTIter(image=imgs,
+                             label=os.path.join(
+                                 args.data_dir, "train-labels-idx1-ubyte"),
+                             batch_size=args.batch_size, shuffle=True)
+        val = io.MNISTIter(image=os.path.join(
+            args.data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size)
+        return train, val
+    xs, ys = synthetic_mnist(args.num_examples)
+    vx, vy = synthetic_mnist(max(args.num_examples // 5, args.batch_size),
+                             seed=99)
+    train = io.NDArrayIter(data=nd.array(xs), label=nd.array(ys),
+                           batch_size=args.batch_size, shuffle=True)
+    val = io.NDArrayIter(data=nd.array(vx), label=nd.array(vy),
+                         batch_size=args.batch_size)
+    return train, val
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--num-examples", type=int, default=2000,
+                   help="synthetic-data size when no MNIST files")
+    p.add_argument("--data-dir", default="data")
+    p.add_argument("--model-prefix", default=None,
+                   help="save checkpoints as <prefix>-NNNN.params")
+    p.add_argument("--tpu", action="store_true",
+                   help="run on the TPU backend")
+    args = p.parse_args(argv)
+
+    net = mlp_symbol() if args.network == "mlp" else lenet_symbol()
+    train, val = get_iters(args)
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    cb = mx.callback.do_checkpoint(args.model_prefix) \
+        if args.model_prefix else None
+    mod.fit(train, eval_data=val, num_epoch=args.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            eval_metric="acc", epoch_end_callback=cb,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       50))
+    score = mod.score(val, mx.metric.Accuracy())
+    print("final validation:", score)
+    return score
+
+
+if __name__ == "__main__":
+    main()

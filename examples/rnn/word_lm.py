@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Word-level LSTM language model with tied embeddings.
+
+Mirrors the reference's example/rnn/word_lm/train.py (the 44.26-ppl
+Sherlock Holmes config, scaled down): embedding -> stacked LSTM ->
+tied-weight softmax, truncated-BPTT batching, perplexity reporting.
+Trains on a text file (--data) or, offline, on a built-in corpus.
+"""
+import argparse
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+# Examples default to the CPU backend: small eager loops pay per-op
+# dispatch latency on a remote TPU; pass --tpu to run on the chip
+# (worthwhile for the jit-compiled / large-batch configs).
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+FALLBACK = ("the quick brown fox jumps over the lazy dog . "
+            "a stitch in time saves nine . all that glitters is not gold . "
+            "actions speak louder than words . practice makes perfect . "
+            "better late than never . the early bird catches the worm . ")
+
+
+class Corpus:
+    def __init__(self, text):
+        words = text.split()
+        self.vocab = {w: i for i, w in
+                      enumerate(sorted(set(words)))}
+        self.data = onp.array([self.vocab[w] for w in words], "int32")
+
+    def batchify(self, batch_size):
+        n = len(self.data) // batch_size
+        return self.data[:n * batch_size].reshape(
+            batch_size, n).T  # (T, B)
+
+
+class RNNModel(gluon.Block):
+    """ref: word_lm/model.py RNNModel — tied embedding/decoder."""
+
+    def __init__(self, vocab_size, embed_size=64, hidden=64, layers=1,
+                 dropout=0.2, tied=True, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, embed_size)
+            self.rnn = rnn.LSTM(hidden, num_layers=layers,
+                                input_size=embed_size)
+            if tied and hidden == embed_size:
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        in_units=embed_size,
+                                        params=self.encoder.params)
+            else:
+                self.decoder = nn.Dense(vocab_size, flatten=False)
+        self._hidden = hidden
+        self._layers = layers
+
+    def begin_state(self, batch_size):
+        return self.rnn.begin_state(batch_size=batch_size)
+
+    def forward(self, x, state):
+        # x: (T, B)
+        emb = self.drop(self.encoder(x))
+        out, state = self.rnn(emb, state)
+        out = self.drop(out)
+        return self.decoder(out), state
+
+
+def detach(state):
+    return [s.detach() for s in state]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None, help="path to a text file")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--bptt", type=int, default=8)
+    p.add_argument("--embed-size", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--layers", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--tied", type=int, default=1)
+    p.add_argument("--tpu", action="store_true",
+                   help="run on the TPU backend")
+    args = p.parse_args(argv)
+
+    text = open(args.data).read() if args.data else FALLBACK * 30
+    corpus = Corpus(text)
+    data = corpus.batchify(args.batch_size)
+    V = len(corpus.vocab)
+    print(f"corpus: {len(corpus.data)} tokens, vocab {V}")
+
+    model = RNNModel(V, args.embed_size, args.hidden, args.layers,
+                     tied=bool(args.tied))
+    model.initialize()
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    final_ppl = None
+    for epoch in range(args.epochs):
+        state = model.begin_state(args.batch_size)
+        total, count = 0.0, 0
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = nd.array(data[i:i + args.bptt])
+            y = nd.array(data[i + 1:i + 1 + args.bptt].astype("float32"))
+            state = detach(state)
+            with autograd.record():
+                out, state = model(x, state)
+                loss = loss_fn(out.reshape((-1, V)), y.reshape((-1,)))
+            loss.backward()
+            trainer.step(args.batch_size * args.bptt)
+            total += float(loss.sum().asscalar())
+            count += loss.size
+        final_ppl = math.exp(total / max(count, 1))
+        print(f"epoch {epoch}: train ppl {final_ppl:.2f}")
+    return final_ppl
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Train a small SSD detector and run detection decode.
+
+Mirrors the reference's example/ssd/train.py slice: backbone features ->
+MultiBoxPrior anchors -> MultiBoxTarget matching -> joint cls+loc loss,
+then MultiBoxDetection NMS decode at inference. Uses synthetic
+images/boxes by default (one colored square per image whose location is
+the ground-truth box) so the pipeline is runnable offline; point
+--rec at a DetRecordIter .rec (tools/im2rec for detection) for real
+data.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+class TinySSD(nn.HybridBlock):
+    """ref: example/ssd/symbol/symbol_builder.py, reduced."""
+
+    def __init__(self, num_classes=1, num_anchors=4, **kw):
+        super().__init__(**kw)
+        self.na = num_anchors
+        self.nc = num_classes
+        with self.name_scope():
+            self.backbone = nn.HybridSequential()
+            for ch in (16, 32, 32):
+                self.backbone.add(nn.Conv2D(ch, 3, 2, 1,
+                                            activation="relu"))
+            self.cls_head = nn.Conv2D(num_anchors * (num_classes + 1), 3,
+                                      padding=1)
+            self.loc_head = nn.Conv2D(num_anchors * 4, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        feat = self.backbone(x)
+        anchors = F.contrib.MultiBoxPrior(
+            feat, sizes=(0.2, 0.35, 0.5), ratios=(1, 2))
+        cls = self.cls_head(feat)
+        B, _, h, w = cls.shape
+        cls = cls.transpose((0, 2, 3, 1)).reshape(
+            (B, h * w * self.na, self.nc + 1)).transpose((0, 2, 1))
+        loc = self.loc_head(feat).transpose((0, 2, 3, 1)).reshape((B, -1))
+        return anchors, cls, loc
+
+
+def synthetic_batch(rs, batch_size, size=64):
+    """One bright square per image; its bounds are the gt box."""
+    x = rs.rand(batch_size, 3, size, size).astype("float32") * 0.2
+    boxes = onp.zeros((batch_size, 1, 5), "float32")
+    for i in range(batch_size):
+        s = rs.randint(size // 5, size // 3)
+        r, c = rs.randint(0, size - s, 2)
+        x[i, :, r:r + s, c:c + s] = rs.rand(3, 1, 1) * 0.6 + 0.4
+        boxes[i, 0] = [0, c / size, r / size, (c + s) / size,
+                       (r + s) / size]
+    return nd.array(x), nd.array(boxes)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--rec", default=None,
+                   help="detection .rec file (DetRecordIter)")
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    rs = onp.random.RandomState(0)
+    net = TinySSD()
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    det_iter = None
+    if args.rec:
+        det_iter = mx.io.DetRecordIter(
+            path_imgrec=args.rec, batch_size=args.batch_size,
+            data_shape=(3, args.image_size, args.image_size))
+
+    first = last = None
+    for step in range(args.steps):
+        if det_iter is not None:
+            try:
+                batch = next(det_iter)
+            except StopIteration:
+                det_iter.reset()
+                batch = next(det_iter)
+            images, labels = batch.data[0], batch.label[0]
+        else:
+            images, labels = synthetic_batch(rs, args.batch_size,
+                                             args.image_size)
+        with autograd.record():
+            anchors, cls_preds, loc_preds = net(images)
+            box_t, box_m, cls_t = nd.contrib.MultiBoxTarget(
+                anchors, labels, cls_preds)
+            cls_loss = ce(cls_preds.transpose((0, 2, 1)), cls_t).mean()
+            loc_loss = nd.smooth_l1((loc_preds - box_t) * box_m,
+                                    scalar=1.0).mean()
+            loss = cls_loss + loc_loss
+        loss.backward()
+        trainer.step(args.batch_size)
+        lv = float(loss.asscalar())
+        first = first if first is not None else lv
+        last = lv
+        if step % 10 == 0:
+            print(f"step {step}: loss {lv:.4f}")
+    print(f"loss {first:.4f} -> {last:.4f}")
+
+    # detection decode (ref: example/ssd/demo.py)
+    images, labels = synthetic_batch(rs, 2, args.image_size)
+    anchors, cls_preds, loc_preds = net(images)
+    probs = nd.softmax(cls_preds.transpose((0, 2, 1)),
+                       axis=-1).transpose((0, 2, 1))
+    det = nd.contrib.MultiBoxDetection(probs, loc_preds, anchors,
+                                       nms_threshold=0.45)
+    top = det.asnumpy()[0][det.asnumpy()[0][:, 1].argsort()[::-1]][:3]
+    print("top detections (cls, score, xmin, ymin, xmax, ymax):")
+    for row in top:
+        print("  ", [round(float(v), 3) for v in row])
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

@@ -58,19 +58,22 @@ class Context:
         present (lets reference scripts using mx.gpu() run on TPU); 'cpu'
         resolves to a host device.
         """
+        # LOCAL devices only: under jax.distributed, jax.devices() is the
+        # global list and another rank's device is non-addressable here
         if self.device_type.startswith("cpu"):
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
             except RuntimeError:
-                devs = [d for d in jax.devices() if d.platform == "cpu"]
+                devs = [d for d in jax.local_devices()
+                        if d.platform == "cpu"]
                 if not devs:
                     return None
             return devs[min(self.device_id, len(devs) - 1)]
         # accelerator
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
         if not devs:
             # fall back to default platform (tests run pure-CPU)
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[min(self.device_id, len(devs) - 1)]
 
     def __enter__(self):

@@ -50,9 +50,18 @@ def broadcast(x, axis_name: str, root: int = 0):
 # cross-process helpers used by KVStoreDist (DCN path)
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=None)
 def _global_mesh():
-    devs = onp.asarray(jax.devices())
-    return Mesh(devs, ("all",))
+    """One device PER PROCESS: the kvstore collective sums process
+    contributions, and a mesh over every device would count a process
+    once per local device (8x with a virtual 8-CPU mesh). Cached — this
+    sits on the per-chunk gradient-push hot path."""
+    devs, seen = [], set()
+    for d in jax.devices():
+        if d.process_index not in seen:
+            seen.add(d.process_index)
+            devs.append(d)
+    return Mesh(onp.asarray(devs), ("all",))
 
 
 def allreduce_across_processes(x):
@@ -63,7 +72,22 @@ def allreduce_across_processes(x):
     tiny jitted psum program over the global device mesh."""
     if jax.process_count() <= 1:
         return x
-    return _allreduce_jit()(x)
+    # lift the (possibly device-committed) local array onto the global
+    # replicated sharding: jit would otherwise reject a local-device
+    # argument against the multi-host shard_map. NOT device_put — that
+    # asserts value equality across processes, and the whole point is
+    # that each process contributes a DIFFERENT value to the sum.
+    mesh = _global_mesh()
+    x = jnp.asarray(x)
+    shards = [jax.device_put(x, d) for d in mesh.local_devices]
+    x = jax.make_array_from_single_device_arrays(
+        x.shape, NamedSharding(mesh, P()), shards)
+    out = _allreduce_jit()(x)
+    # the psum result is committed to the GLOBAL mesh; downstream eager
+    # math mixes it with process-local arrays (e.g. Trainer updating
+    # local params with pulled grads), which jax rejects as incompatible
+    # devices — hand back this process's local replica instead
+    return out.addressable_data(0)
 
 
 @functools.lru_cache(maxsize=None)

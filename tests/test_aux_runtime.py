@@ -344,6 +344,41 @@ def test_im2rec_roundtrip(tmp_path):
     assert labels == {0.0, 1.0}
 
 
+def test_im2rec_multiprocess_matches_serial(tmp_path):
+    """--num-thread N must produce byte-identical records to the serial
+    path (ref: im2rec.py read_worker/write_worker queue pipeline)."""
+    from PIL import Image
+    root = tmp_path / "imgs"
+    for cls in ("a", "b"):
+        (root / cls).mkdir(parents=True)
+        for i in range(4):
+            Image.new("RGB", (24, 24),
+                      color=(i * 30, 50, 200)).save(root / cls / f"{i}.jpg")
+    # tools/ must STAY on sys.path until the spawn-Pool children have
+    # finished: they unpickle _encode_one by importing module 'im2rec'
+    # from the inherited sys.path; remove the exact entry afterwards
+    # (the module itself prepends the repo root, so pop(0) would remove
+    # the wrong one)
+    tools_path = os.path.join(ROOT, "tools")
+    sys.path.insert(0, tools_path)
+    try:
+        import im2rec
+        p1 = str(tmp_path / "serial")
+        p2 = str(tmp_path / "parallel")
+        im2rec.main([p1, str(root), "--list"])
+        import shutil
+        shutil.copy(p1 + ".lst", p2 + ".lst")
+        im2rec.main([p1, str(root)])
+        im2rec.main([p2, str(root), "--num-thread", "3"])
+    finally:
+        try:
+            sys.path.remove(tools_path)
+        except ValueError:
+            pass
+    with open(p1 + ".rec", "rb") as f1, open(p2 + ".rec", "rb") as f2:
+        assert f1.read() == f2.read()
+
+
 def test_signal_handler_enabled():
     import faulthandler
     assert faulthandler.is_enabled()

@@ -1,0 +1,68 @@
+"""Smoke tier for examples/ — every script must run end to end with
+tiny settings (ref: the reference CI's example runs)."""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(ROOT, "examples")
+
+
+def _load(relpath):
+    path = os.path.join(EX, relpath)
+    name = os.path.basename(relpath)[:-3]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_train_mnist_example():
+    mod = _load("image_classification/train_mnist.py")
+    score = mod.main(["--epochs", "2", "--num-examples", "320",
+                      "--batch-size", "32"])
+    assert score[0][0] == "accuracy" and 0.0 <= score[0][1] <= 1.0
+
+
+def test_train_gluon_example():
+    mod = _load("image_classification/train_gluon.py")
+    acc = mod.main(["--model", "mobilenetv2_0.25", "--steps", "4",
+                    "--batch-size", "8", "--image-size", "32"])
+    assert 0.0 <= acc <= 1.0
+
+
+def test_word_lm_example_learns():
+    mod = _load("rnn/word_lm.py")
+    ppl = mod.main(["--epochs", "2"])
+    assert ppl < 15.0  # vocab 36; untrained ppl ~36
+
+
+def test_ssd_example_loss_decreases():
+    mod = _load("ssd/train_ssd.py")
+    first, last = mod.main(["--steps", "12", "--batch-size", "4",
+                            "--image-size", "32"])
+    assert last < first
+
+
+def test_quantization_example():
+    mod = _load("quantization/quantize_model.py")
+    err, agree = mod.main(["--calib-mode", "naive",
+                           "--num-calib-batches", "2"])
+    assert err < 0.15 and agree >= 0.75
+
+
+def test_distributed_example_two_processes():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(EX, "distributed", "train_dist.py"),
+         "--steps", "50"],
+        env=env, capture_output=True, text=True, timeout=280)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert out.count("DIST_TRAIN_OK") == 2, out[-2000:]

@@ -65,42 +65,68 @@ def read_list(path):
             yield idx, label[0] if len(label) == 1 else label, parts[-1]
 
 
-def make_record(args):
-    """ref: im2rec.py image_encode/write loop — resize/re-encode each image
-    and append to an indexed .rec."""
-    from PIL import Image
+def _encode_one(job):
+    """Worker: decode + resize/crop + JPEG-encode one image. Returns
+    (idx, label, jpeg_bytes) or None for unreadable files."""
+    idx, label, path, resize, center_crop, quality = job
+    import io as _io
 
+    from PIL import Image
+    try:
+        img = Image.open(path).convert("RGB")
+    except Exception as e:  # noqa: BLE001 — skip unreadable, like ref
+        print(f"skipping {path}: {e}", file=sys.stderr)
+        return None
+    if resize:
+        w, h = img.size
+        scale = resize / min(w, h)
+        img = img.resize((max(1, round(w * scale)),
+                          max(1, round(h * scale))))
+    if center_crop:
+        w, h = img.size
+        s = min(w, h)
+        left, top = (w - s) // 2, (h - s) // 2
+        img = img.crop((left, top, left + s, top + s))
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG", quality=quality)
+    return idx, label, buf.getvalue()
+
+
+def make_record(args):
+    """ref: im2rec.py image_encode/read_worker/write_worker — the decode
+    + encode work fans out over --num-thread processes (the reference's
+    multiprocessing queues); the single writer consumes results in list
+    order so the .rec layout is deterministic."""
     from mxnet_tpu import recordio
 
     lst = args.prefix + ".lst"
+    jobs = [(idx, label, os.path.join(args.root, rel), args.resize,
+             args.center_crop, args.quality)
+            for idx, label, rel in read_list(lst)]
     rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
                                      args.prefix + ".rec", "w")
     n = 0
-    for idx, label, rel in read_list(lst):
-        path = os.path.join(args.root, rel)
-        try:
-            img = Image.open(path).convert("RGB")
-        except Exception as e:  # noqa: BLE001 — skip unreadable, like ref
-            print(f"skipping {path}: {e}", file=sys.stderr)
-            continue
-        if args.resize:
-            w, h = img.size
-            scale = args.resize / min(w, h)
-            img = img.resize((max(1, round(w * scale)),
-                              max(1, round(h * scale))))
-        if args.center_crop:
-            w, h = img.size
-            s = min(w, h)
-            left, top = (w - s) // 2, (h - s) // 2
-            img = img.crop((left, top, left + s, top + s))
-        import io as _io
-        buf = _io.BytesIO()
-        img.save(buf, format="JPEG", quality=args.quality)
+
+    def write(result):
+        nonlocal n
+        if result is None:
+            return
+        idx, label, payload = result
         header = recordio.IRHeader(0, label, idx, 0)
-        rec.write_idx(idx, recordio.pack(header, buf.getvalue()))
+        rec.write_idx(idx, recordio.pack(header, payload))
         n += 1
         if n % 1000 == 0:
             print(f"packed {n} images")
+
+    if args.num_thread > 1:
+        import multiprocessing as mp
+        with mp.get_context("spawn").Pool(args.num_thread) as pool:
+            # imap preserves submission order -> deterministic .rec
+            for result in pool.imap(_encode_one, jobs, chunksize=16):
+                write(result)
+    else:
+        for job in jobs:
+            write(_encode_one(job))
     rec.close()
     print(f"wrote {n} records to {args.prefix}.rec")
 
@@ -119,6 +145,9 @@ def main(argv=None):
                    help="resize shorter edge to this many pixels")
     p.add_argument("--center-crop", action="store_true")
     p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--num-thread", type=int, default=1,
+                   help="worker processes for decode+encode "
+                   "(ref: im2rec.py --num-thread)")
     args = p.parse_args(argv)
     if args.list:
         make_list(args)
