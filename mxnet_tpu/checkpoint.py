@@ -1,0 +1,230 @@
+"""Async checkpoint / resume manager.
+
+The reference recovers from failures by checkpoint-restart at epoch
+granularity (ref: python/mxnet/callback.py:55 do_checkpoint +
+model.py:394 save_checkpoint). The TPU plan (SURVEY.md §5.3) upgrades
+that honestly: periodic ASYNC checkpoints — the device keeps training
+while a background thread serializes the previous step's state — with
+atomic directory commits, bounded retention, and restart-from-latest
+that skips torn/corrupt checkpoints.
+
+    mgr = CheckpointManager("ckpts", max_to_keep=3)
+    for step, batch in enumerate(data):
+        trainer.step(*batch)
+        if step % 100 == 0:
+            mgr.save(step, trainer=trainer)          # returns immediately
+    ...
+    step = mgr.restore_latest(trainer=trainer)       # after a crash
+
+State is written in the reference-compatible formats: parameters via
+nd.save (.params binary layout) and optimizer state via the pickled
+updater-state blob Module/Trainer already use.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+from typing import Dict, Optional
+
+from .base import MXNetError, get_logger
+
+__all__ = ["CheckpointManager"]
+
+_log = get_logger("mxnet_tpu.checkpoint")
+
+_MANIFEST = "manifest.json"
+
+
+class CheckpointManager:
+    """Periodic async checkpoints with atomic commit and retention.
+
+    Layout: ``<directory>/step_<N>/`` holding ``params`` (nd.save
+    format), optional ``opt_state`` (pickle), optional ``extra``
+    (pickled user dict), and a ``manifest.json`` whose presence marks
+    the checkpoint COMPLETE (written last, after fsync of the payload —
+    a crash mid-save leaves no manifest and restore skips the entry).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        os.makedirs(directory, exist_ok=True)
+
+    # -- saving -----------------------------------------------------------
+    def save(self, step: int, trainer=None, params: Optional[Dict] = None,
+             opt_state: Optional[bytes] = None, extra: Optional[Dict] = None):
+        """Snapshot NOW (host copies are taken synchronously so training
+        can mutate on), serialize in the background."""
+        self.check_error()
+        if trainer is not None:
+            # gluon.Trainer or parallel.ParallelTrainer
+            if hasattr(trainer, "params") and isinstance(
+                    getattr(trainer, "params"), dict):
+                from .ndarray.ndarray import array as nd_array
+                params = {k: nd_array(v) for k, v in trainer.params.items()}
+                opt_state = pickle.dumps(
+                    _to_host(trainer.opt_state),
+                    protocol=pickle.HIGHEST_PROTOCOL)
+            else:
+                params = {p.name: p.data() for p in trainer._params}
+                try:
+                    opt_state = trainer._updaters[0].get_states()
+                except (AttributeError, IndexError):
+                    opt_state = None
+        if params is None:
+            raise MXNetError("save() needs a trainer= or params=")
+        # force host materialization up front: the async thread must not
+        # race the next training step's donated buffers
+        host_params = {k: v.asnumpy() if hasattr(v, "asnumpy") else v
+                       for k, v in params.items()}
+
+        self.wait()  # one in-flight save at a time (ordering + memory)
+        if self.async_save:
+            self._thread = threading.Thread(
+                target=self._write, args=(step, host_params, opt_state,
+                                          extra), daemon=True)
+            self._thread.start()
+        else:
+            self._write(step, host_params, opt_state, extra)
+
+    def _write(self, step, host_params, opt_state, extra):
+        try:
+            final = os.path.join(self.directory, f"step_{step}")
+            tmp = tempfile.mkdtemp(prefix=f".step_{step}_",
+                                   dir=self.directory)
+            from .ndarray import ndarray as nd_mod
+            from .ndarray.ndarray import array as nd_array
+            nd_mod.save(os.path.join(tmp, "params"),
+                        {k: nd_array(v) for k, v in host_params.items()})
+            if opt_state is not None:
+                with open(os.path.join(tmp, "opt_state"), "wb") as f:
+                    f.write(opt_state)
+            if extra is not None:
+                with open(os.path.join(tmp, "extra"), "wb") as f:
+                    pickle.dump(extra, f)
+            # manifest LAST: its presence marks completeness
+            with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                json.dump({"step": step,
+                           "params": sorted(host_params),
+                           "has_opt_state": opt_state is not None,
+                           "has_extra": extra is not None}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+            self._retain()
+        except BaseException as e:  # surfaced on next save()/wait()
+            self._error = e
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _retain(self):
+        steps = sorted(self.all_steps())
+        for s in steps[:-self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.directory, f"step_{s}"),
+                          ignore_errors=True)
+
+    def wait(self):
+        """Block until the in-flight async save (if any) committed."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self.check_error()
+
+    def check_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise MXNetError(f"async checkpoint failed: {err!r}")
+
+    # -- restoring --------------------------------------------------------
+    def all_steps(self):
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_") and os.path.exists(
+                    os.path.join(self.directory, name, _MANIFEST)):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int, trainer=None):
+        """Load checkpoint `step`; returns (params, opt_state, extra) and,
+        if trainer= given, installs the state into it."""
+        path = os.path.join(self.directory, f"step_{step}")
+        if not os.path.exists(os.path.join(path, _MANIFEST)):
+            raise MXNetError(f"no complete checkpoint at step {step}")
+        from .ndarray import ndarray as nd_mod
+        params = nd_mod.load(os.path.join(path, "params"))
+        opt_state = None
+        if os.path.exists(os.path.join(path, "opt_state")):
+            with open(os.path.join(path, "opt_state"), "rb") as f:
+                opt_state = f.read()
+        extra = None
+        if os.path.exists(os.path.join(path, "extra")):
+            with open(os.path.join(path, "extra"), "rb") as f:
+                extra = pickle.load(f)
+        if trainer is not None:
+            self._install(trainer, params, opt_state)
+        return params, opt_state, extra
+
+    def restore_latest(self, trainer=None):
+        """Restart-from-latest, skipping torn checkpoints. Returns the
+        restored step, or None when nothing usable exists."""
+        for step in reversed(self.all_steps()):
+            try:
+                self.restore(step, trainer=trainer)
+                return step
+            except Exception as e:  # corrupt payload: fall back further
+                _log.warning("checkpoint step_%d unusable (%s); "
+                             "falling back", step, e)
+        return None
+
+    @staticmethod
+    def _install(trainer, params, opt_state):
+        if hasattr(trainer, "params") and isinstance(
+                getattr(trainer, "params"), dict):
+            # ParallelTrainer: rebind the device pytrees
+            import jax.numpy as jnp
+            trainer.params = {k: jnp.asarray(v.asnumpy())
+                              for k, v in params.items()}
+            if opt_state is not None:
+                trainer.opt_state = _from_host(pickle.loads(opt_state))
+            trainer._compiled = None  # device placement changed
+        else:
+            by_name = {p.name: p for p in trainer._params}
+            for name, arr in params.items():
+                if name in by_name:
+                    by_name[name].data()._rebind(arr._data)
+            if opt_state is not None:
+                try:
+                    for updater in trainer._updaters:
+                        updater.set_states(opt_state)
+                except (AttributeError, TypeError):
+                    pass
+
+
+def _to_host(tree):
+    import jax
+    import numpy as onp
+    return jax.tree.map(lambda v: onp.asarray(v)
+                        if hasattr(v, "shape") else v, tree)
+
+
+def _from_host(tree):
+    import jax
+    import jax.numpy as jnp
+    return jax.tree.map(lambda v: jnp.asarray(v)
+                        if hasattr(v, "shape") else v, tree)
