@@ -138,18 +138,33 @@ def flags() -> Dict[str, Flag]:
     return dict(_FLAGS)
 
 
+def flag_rows():
+    """One (name, type_name, default_repr, status, doc) tuple per flag —
+    the single rendering source for describe() and docs generation
+    (tools/gen_env_docs.py). Machine-dependent defaults (home-relative
+    paths) are normalized so generated docs are portable."""
+    home = os.path.expanduser("~")
+    rows = []
+    for name in sorted(_FLAGS):
+        f = _FLAGS[name]
+        status = "active" if f.active else "accepted (no-op on TPU)"
+        default = repr(f.default)
+        if isinstance(f.default, str) and f.default.startswith(home):
+            default = repr("~" + f.default[len(home):])
+        doc = " ".join(f.doc.split())
+        if f.tpu_note:
+            doc += f" TPU: {' '.join(f.tpu_note.split())}"
+        rows.append((name, f.type.__name__, default, status, doc))
+    return rows
+
+
 def describe() -> str:
     """Human-readable flag table (the env_var.md analog)."""
     lines = []
-    for name in sorted(_FLAGS):
-        f = _FLAGS[name]
-        cur = get(name)
-        status = "active" if f.active else "accepted (no-op on TPU)"
-        lines.append(f"{name} = {cur!r}  [{f.type.__name__}, "
-                     f"default {f.default!r}, {status}]")
-        lines.append(f"    {f.doc}")
-        if f.tpu_note:
-            lines.append(f"    TPU: {f.tpu_note}")
+    for name, tname, default, status, doc in flag_rows():
+        lines.append(f"{name} = {get(name)!r}  [{tname}, "
+                     f"default {default}, {status}]")
+        lines.append(f"    {doc}")
     return "\n".join(lines)
 
 

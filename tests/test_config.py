@@ -176,3 +176,16 @@ def test_multi_tensor_sgd_matches_single(momentum):
 
     for a, b in zip(run(True), run(False)):
         assert onp.allclose(a, b, atol=1e-6)
+
+
+def test_env_docs_fresh():
+    """docs/env_vars.md is generated from the flag registry and must
+    not drift (tools/gen_env_docs.py --check)."""
+    import importlib.util
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "gen_env_docs", os.path.join(root, "tools", "gen_env_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--check"]) == 0

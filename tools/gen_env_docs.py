@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Generate docs/env_vars.md from the typed flag registry.
+
+The reference documents its env vars by hand (ref: docs/faq/env_var.md,
+83 vars); here the registry in mxnet_tpu/config.py is the single source
+of truth and this script renders it, so the doc cannot drift from the
+code.
+
+    python tools/gen_env_docs.py          # rewrites docs/env_vars.md
+    python tools/gen_env_docs.py --check  # exit 1 if the doc is stale
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+HEADER = """# Environment variables
+
+All runtime flags, generated from the typed registry
+(`mxnet_tpu/config.py`) by `tools/gen_env_docs.py` — regenerate after
+registering a flag. Flags resolve as: `config.set_flag()` override >
+environment > default. "accepted (no-op on TPU)" marks reference vars
+kept for compatibility whose job XLA/PJRT already performs; setting
+them warns once and has no effect.
+
+| Variable | Type | Default | Status | Description |
+|---|---|---|---|---|
+"""
+
+
+def render() -> str:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu import config
+    rows = []
+    for name, tname, default, status, doc in config.flag_rows():
+        rows.append(f"| `{name}` | {tname} | `{default}` "
+                    f"| {status} | {doc.replace('|', chr(92) + '|')} |")
+    return HEADER + "\n".join(rows) + "\n"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--check", action="store_true")
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "docs",
+        "env_vars.md"))
+    args = p.parse_args(argv)
+    text = render()
+    if args.check:
+        try:
+            with open(args.out) as f:
+                current = f.read()
+        except OSError:
+            current = None
+        if current != text:
+            print("docs/env_vars.md is stale or missing — run "
+                  "tools/gen_env_docs.py", file=sys.stderr)
+            return 1
+        return 0
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(text)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
