@@ -104,26 +104,39 @@ class MultiHeadAttention(HybridBlock):
 
 class TransformerEncoderLayer(HybridBlock):
     def __init__(self, units, num_heads, hidden_size, dropout=0.0,
-                 pre_norm=True, **kwargs):
+                 pre_norm=True, num_experts=0, num_experts_per_tok=2,
+                 **kwargs):
         super().__init__(**kwargs)
         self._pre_norm = pre_norm
+        self._moe = num_experts > 0
         with self.name_scope():
             self.attn = MultiHeadAttention(units, num_heads, dropout)
             self.ln1 = nn.LayerNorm(in_channels=units)
             self.ln2 = nn.LayerNorm(in_channels=units)
-            self.ffn1 = nn.Dense(hidden_size, flatten=False, prefix="ffn1_")
-            self.ffn2 = nn.Dense(units, flatten=False, prefix="ffn2_")
+            if self._moe:
+                # expert-parallel FFN (SURVEY §2.4 ep axis)
+                from ..parallel.moe import MoEFFN
+                self.moe = MoEFFN(units, hidden_size,
+                                  num_experts=num_experts,
+                                  num_experts_per_tok=num_experts_per_tok)
+            else:
+                self.ffn1 = nn.Dense(hidden_size, flatten=False,
+                                     prefix="ffn1_")
+                self.ffn2 = nn.Dense(units, flatten=False, prefix="ffn2_")
             self.drop = nn.Dropout(dropout)
+
+    def _ffn(self, F, h):
+        if self._moe:
+            return self.moe(h)
+        return self.ffn2(F.LeakyReLU(self.ffn1(h), act_type="gelu"))
 
     def hybrid_forward(self, F, x):
         if self._pre_norm:
             x = x + self.attn(self.ln1(x))
             h = self.ln2(x)
-            h = self.ffn2(F.LeakyReLU(self.ffn1(h), act_type="gelu"))
-            return x + self.drop(h)
+            return x + self.drop(self._ffn(F, h))
         x = self.ln1(x + self.attn(x))
-        h = self.ffn2(F.LeakyReLU(self.ffn1(x), act_type="gelu"))
-        return self.ln2(x + self.drop(h))
+        return self.ln2(x + self.drop(self._ffn(F, x)))
 
 
 class TransformerLM(HybridBlock):
@@ -134,7 +147,7 @@ class TransformerLM(HybridBlock):
 
     def __init__(self, vocab_size, units=256, num_layers=4, num_heads=8,
                  hidden_size=1024, max_len=512, dropout=0.0, causal=False,
-                 **kwargs):
+                 num_experts=0, num_experts_per_tok=2, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._max_len = max_len
@@ -146,7 +159,9 @@ class TransformerLM(HybridBlock):
             with self.layers.name_scope():
                 for _ in range(num_layers):
                     self.layers.add(TransformerEncoderLayer(
-                        units, num_heads, hidden_size, dropout))
+                        units, num_heads, hidden_size, dropout,
+                        num_experts=num_experts,
+                        num_experts_per_tok=num_experts_per_tok))
             self.ln_f = nn.LayerNorm(in_channels=units)
             self.head = nn.Dense(vocab_size, flatten=False, prefix="head_")
         for layer in self.layers:
@@ -199,6 +214,10 @@ def tensor_parallel_shardings(block, model_axis: str = "model"):
                 "embedding" in name and name.endswith("weight"):
             spec = P(model_axis, None) if len(p.shape) == 2 else P()
         else:
-            spec = P()
+            # leave unmatched params OUT of the dict (ParallelTrainer
+            # defaults them to replicated): an explicit P() here would
+            # clobber other sharding helpers' specs — e.g.
+            # expert_parallel_shardings — depending on merge order
+            continue
         specs[name] = spec
     return specs

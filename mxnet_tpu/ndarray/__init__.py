@@ -19,6 +19,19 @@ for _name in _list_ops():
     if not hasattr(_mod, _name):
         setattr(_mod, _name, _make(_name))
 
+
+def __getattr__(name):
+    """Late-registered ops (register_op AFTER this module imported —
+    e.g. parallel/moe.py, user extensions) materialize on first
+    access (PEP 562)."""
+    from ..ops.registry import has_op
+    if has_op(name):
+        fn = _make(name)
+        setattr(_mod, name, fn)
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no "
+                         f"attribute {name!r}")
+
 # sparse + random sub-namespaces
 from . import sparse  # noqa: E402,F401
 from .. import random as _random_mod

@@ -23,3 +23,4 @@ from .pipeline import pipeline_apply  # noqa: F401
 # Multi-host init (ref role: ps-lite scheduler wiring via DMLC_* env,
 # python/mxnet/kvstore_server.py:76; here jax.distributed over DCN).
 from ..base import initialize_distributed  # noqa: F401
+from .moe import MoEFFN, expert_parallel_shardings  # noqa: F401

@@ -1,0 +1,130 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Beyond the reference (SURVEY.md §2.4 lists expert parallelism as
+ABSENT — "note for future"); on TPU it is a first-class scaling axis,
+so the framework ships it: a top-k routed MoE FFN whose expert
+dimension shards over a mesh axis. The computation is expressed
+densely — every token's hidden state flows through an einsum over the
+stacked expert weights, masked by the routing weights — so shapes are
+static, XLA tiles it onto the MXU, and under pjit the (E, ...) expert
+parameters shard on the expert axis with GSPMD inserting the token
+all-to-alls (the Switch-Transformer dispatch/combine, Fedus et al.
+2021, realized by the compiler rather than hand-written NCCL as in
+GShard-style implementations).
+
+    layer = MoEFFN(units=256, hidden_size=1024, num_experts=8,
+                   num_experts_per_tok=2)
+    specs = expert_parallel_shardings(net, expert_axis="model")
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..gluon.block import HybridBlock
+from ..ops.registry import register_op
+
+__all__ = ["MoEFFN", "expert_parallel_shardings"]
+
+
+@register_op("_moe_ffn", input_names=("x", "gate_w", "w1", "b1", "w2",
+                                      "b2"))
+def _moe_ffn(x, gate_w, w1, b1, w2, b2, num_experts_per_tok=2):
+    """Dense MoE FFN: route, run every expert, combine by routing weight.
+
+    x: (N, C); gate_w: (E, C); w1: (E, H, C); b1: (E, H);
+    w2: (E, C, H); b2: (E, C). Dense-dispatch keeps shapes static (the
+    TPU-friendly formulation); with E sharded, XLA turns the masked
+    einsums into expert-parallel compute + collectives.
+    """
+    import jax
+    E = gate_w.shape[0]
+    k = min(int(num_experts_per_tok), E)
+    probs = jax.nn.softmax(x @ gate_w.T, axis=-1)   # (N, E)
+    # top-k mask, renormalized over the selected experts
+    if k < E:
+        kth = jnp.sort(probs, axis=-1)[:, E - k][:, None]
+        mask = (probs >= kth).astype(probs.dtype)
+        gates = probs * mask
+        gates = gates / jnp.clip(jnp.sum(gates, axis=-1, keepdims=True),
+                                 1e-9, None)
+    else:
+        gates = probs
+    # every expert computes on every token; the gate zeroes non-routed
+    # contributions. (N,C)x(E,H,C)->(E,N,H). Exact gelu — the same
+    # activation as the dense ffn1/gelu/ffn2 path this layer replaces
+    # (ops/nn.py leaky_relu act_type='gelu')
+    h = jnp.einsum("nc,ehc->enh", x, w1) + b1[:, None, :]
+    h = jax.nn.gelu(h, approximate=False)
+    out = jnp.einsum("enh,ech->enc", h, w2) + b2[:, None, :]
+    return jnp.einsum("enc,ne->nc", out, gates)
+
+
+@register_op("_moe_load_balance_loss", input_names=("x", "gate_w"))
+def _moe_load_balance_loss(x, gate_w):
+    """Switch-Transformer auxiliary loss: E * sum_e(f_e * P_e) where
+    f_e is the fraction of tokens whose argmax is expert e and P_e the
+    mean routing probability (Fedus et al. 2021, eq. 4)."""
+    import jax
+    E = gate_w.shape[0]
+    probs = jax.nn.softmax(x @ gate_w.T, axis=-1)
+    top = jnp.argmax(probs, axis=-1)
+    frac = jnp.mean((jnp.arange(E)[None, :] == top[:, None])
+                    .astype(probs.dtype), axis=0)
+    return E * jnp.sum(frac * jnp.mean(probs, axis=0))
+
+
+class MoEFFN(HybridBlock):
+    """Drop-in replacement for the transformer FFN pair
+    (ffn1/gelu/ffn2) with E experts and top-k routing."""
+
+    def __init__(self, units, hidden_size, num_experts=4,
+                 num_experts_per_tok=2, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._hidden = hidden_size
+        self._E = num_experts
+        self._k = num_experts_per_tok
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(num_experts, units),
+                init=None)
+            self.w1 = self.params.get(
+                "w1", shape=(num_experts, hidden_size, units),
+                init=None)
+            self.b1 = self.params.get(
+                "b1", shape=(num_experts, hidden_size), init="zeros")
+            self.w2 = self.params.get(
+                "w2", shape=(num_experts, units, hidden_size),
+                init=None)
+            self.b2 = self.params.get(
+                "b2", shape=(num_experts, units), init="zeros")
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            # structural marker consumed by expert_parallel_shardings —
+            # leading dim is the expert axis
+            p._expert_sharded = True
+
+    def hybrid_forward(self, F, x, gate_weight, w1, b1, w2, b2):
+        shape = x.shape
+        flat = x.reshape((-1, shape[-1]))
+        out = F._moe_ffn(flat, gate_weight, w1, b1, w2, b2,
+                         num_experts_per_tok=self._k)
+        return out.reshape(shape)
+
+    def load_balance_loss(self, x):
+        flat = x.reshape((-1, x.shape[-1]))
+        from .. import ndarray as nd_ns
+        return nd_ns._moe_load_balance_loss(flat, self.gate_weight.data())
+
+
+def expert_parallel_shardings(block, expert_axis: str = "model"):
+    """PartitionSpecs sharding every MoE expert-stacked parameter on
+    its leading (E) dim over `expert_axis` (the ep analog of
+    models.tensor_parallel_shardings). Returns {param_name: P(...)}."""
+    from jax.sharding import PartitionSpec as P
+    specs = {}
+    for name, param in block._collect_params_with_prefix().items():
+        if getattr(param, "_expert_sharded", False):
+            specs[name] = P(expert_axis)
+        elif name.rsplit(".", 1)[-1] == "gate_weight":
+            specs[name] = P()  # router replicated
+    return specs

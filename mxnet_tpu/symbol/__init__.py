@@ -17,6 +17,19 @@ for _name in _list_ops():
         setattr(_mod, _name, _make(_name))
 
 
+def __getattr__(name):
+    """Late-registered ops materialize on first access (PEP 562),
+    mirroring mxnet_tpu.ndarray's fallback so the two generated
+    surfaces never diverge."""
+    from ..ops.registry import has_op
+    if has_op(name):
+        fn = _make(name)
+        setattr(_mod, name, fn)
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.symbol' has no "
+                         f"attribute {name!r}")
+
+
 class _Contrib:
     def __getattr__(self, name):
         if name in ("foreach", "while_loop", "cond"):
