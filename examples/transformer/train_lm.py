@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Train a (optionally Mixture-of-Experts) transformer language model
+on a device mesh.
+
+The flagship-model example: TransformerLM with switchable attention
+backends (Pallas flash on TPU), optional MoE FFNs expert-sharded over
+the mesh, Megatron tensor parallelism, and ring-attention sequence
+parallelism — the dp x tp x sp x ep matrix from one script.
+
+    # single device
+    python examples/transformer/train_lm.py
+    # 8 virtual CPU devices: dp2 x tp2 x sp2, 2-expert MoE
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/transformer/train_lm.py --dp 2 --tp 2 --sp 2 \
+        --num-experts 2
+"""
+import argparse
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.models import TransformerLM, tensor_parallel_shardings
+from mxnet_tpu.parallel import (ParallelTrainer,
+                                expert_parallel_shardings, make_mesh)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--units", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--steps", type=int, default=80)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--num-experts", type=int, default=0,
+                   help=">0 turns every FFN into a routed MoE")
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel mesh axis (requires "
+                   "--num-experts divisible by it)")
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+    if args.tpu and jax.config.jax_platforms == "cpu":
+        raise SystemExit(
+            "--tpu only works from the command line (the backend is "
+            "chosen at import); for main(argv) calls set the platform "
+            "before importing this module")
+    n_mesh = args.dp * args.tp * args.sp * args.ep
+    mesh = None
+    if args.ep > 1:
+        assert args.num_experts and args.num_experts % args.ep == 0, \
+            f"--num-experts {args.num_experts} not divisible by --ep"
+    if n_mesh > 1:
+        assert len(jax.devices()) >= n_mesh, \
+            f"need {n_mesh} devices (set xla_force_host_platform_" \
+            f"device_count), have {len(jax.devices())}"
+        # fail with the flag name, not a GSPMD divisibility error
+        assert args.batch_size % args.dp == 0, \
+            f"--batch-size {args.batch_size} not divisible by --dp"
+        assert args.seq_len % args.sp == 0, \
+            f"--seq-len {args.seq_len} not divisible by --sp"
+        axes = {"data": args.dp, "model": args.tp, "seq": args.sp}
+        if args.ep > 1:
+            axes["expert"] = args.ep
+        mesh = make_mesh(axes, jax.devices()[:n_mesh])
+
+    V, T = args.vocab, args.seq_len
+    net = TransformerLM(vocab_size=V, units=args.units,
+                        num_layers=args.layers, num_heads=args.heads,
+                        hidden_size=args.hidden, max_len=T, causal=True,
+                        num_experts=args.num_experts)
+    net.initialize()
+    net(nd.zeros((1, T), dtype="int32"))
+    if mesh is not None and args.sp > 1:
+        net.set_context_parallel(mesh, seq_axis="seq", strategy="ring")
+
+    class LMLoss(gluon.HybridBlock):
+        def hybrid_forward(self, F, logits, labels):
+            return gluon.loss.SoftmaxCrossEntropyLoss()(
+                logits.reshape((-1, V)), labels.reshape((-1,)))
+
+    specs = {}
+    if mesh is not None and args.tp > 1:
+        specs.update(tensor_parallel_shardings(net, model_axis="model"))
+    if mesh is not None and args.num_experts:
+        # dedicated 'expert' axis when --ep is set; otherwise ride the
+        # model axis (a no-op extent-1 shard on pure-dp meshes)
+        axis = "expert" if args.ep > 1 else "model"
+        specs.update(expert_parallel_shardings(net, expert_axis=axis))
+    specs = specs or None
+    trainer = ParallelTrainer(net, LMLoss(), optimizer="adam",
+                              optimizer_params={"learning_rate": args.lr},
+                              mesh=mesh, param_shardings=specs)
+
+    # task: predict the sequence shifted by one over a fixed corpus
+    rs = onp.random.RandomState(0)
+    corpus = rs.randint(0, V, (args.batch_size, T + 1))
+    tokens = nd.array(corpus[:, :T], dtype="int32")
+    labels = nd.array(corpus[:, 1:].astype("float32"))
+    last = None
+    for step in range(args.steps):
+        loss = trainer.step(tokens, labels)
+        last = float(loss.asscalar())
+        if step % 20 == 0:
+            print(f"step {step}: loss {last:.4f} "
+                  f"(ppl {math.exp(min(last, 20)):.1f})")
+    print(f"final loss {last:.4f}")
+    return last
+
+
+if __name__ == "__main__":
+    main()

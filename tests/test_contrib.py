@@ -277,6 +277,36 @@ def test_quantize_model_bias_shifts_output_range():
     assert str(qargs["conv0_bias_quant"].dtype) == "int32"
 
 
+def test_quantized_graph_json_roundtrip():
+    """A rewritten int8 graph must survive tojson/load_json (the
+    deployment path: qsym.save -> SymbolBlock/Module load)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import io, sym
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu.symbol.symbol import load_json
+
+    rs = onp.random.RandomState(0)
+    x = sym.var("data")
+    net = sym.FullyConnected(
+        sym.Activation(sym.Convolution(x, name="c", kernel=(3, 3),
+                                       num_filter=4, pad=(1, 1)),
+                       act_type="relu"), name="f", num_hidden=3)
+    args = {"c_weight": nd.array(rs.randn(4, 3, 3, 3)
+                                 .astype("float32") * 0.3),
+            "c_bias": nd.zeros((4,)),
+            "f_weight": nd.array(rs.randn(3, 64).astype("float32") * 0.1),
+            "f_bias": nd.zeros((3,))}
+    data = rs.uniform(-1, 1, (8, 3, 4, 4)).astype("float32")
+    calib = io.NDArrayIter(data={"data": nd.array(data)}, batch_size=4)
+    qsym, qargs, _ = quantize_model(net, args, {}, calib_mode="naive",
+                                    calib_data=calib, ctx=mx.cpu())
+    q2 = load_json(qsym.tojson())
+    xs = nd.array(data[:4])
+    o1 = qsym.bind(mx.cpu(), {"data": xs, **qargs}).forward()[0].asnumpy()
+    o2 = q2.bind(mx.cpu(), {"data": xs, **qargs}).forward()[0].asnumpy()
+    assert onp.allclose(o1, o2)
+
+
 def test_quantize_model_requires_calib_data():
     from mxnet_tpu import sym
     from mxnet_tpu.base import MXNetError

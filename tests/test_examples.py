@@ -54,6 +54,15 @@ def test_quantization_example():
     assert err < 0.15 and agree >= 0.75
 
 
+def test_transformer_lm_example_moe_mesh():
+    """The flagship example composes dp x tp x sp with MoE experts on
+    the virtual mesh (conftest provides 8 CPU devices)."""
+    mod = _load("transformer/train_lm.py")
+    last = mod.main(["--dp", "2", "--tp", "2", "--sp", "2",
+                     "--num-experts", "2", "--steps", "50"])
+    assert last < 1.0
+
+
 def test_distributed_example_two_processes():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)

@@ -156,6 +156,10 @@ CASES = {
     "_contrib_quantized_batch_norm": lambda: (
         [_q8(2, 3, 4, 4), T(3), T(3), T(3), T(3),
          nd.array([-1.0]), nd.array([1.0])], {}),
+    "_moe_ffn": lambda: (
+        [T(5, 4), T(3, 4), T(3, 6, 4), T(3, 6), T(3, 4, 6), T(3, 4)],
+        {"num_experts_per_tok": 2}),
+    "_moe_load_balance_loss": lambda: ([T(5, 4), T(3, 4)], {}),
     "_contrib_calibrate_entropy": lambda: (
         [nd.array(rs.uniform(0, 10, (255,)).astype("float32")),
          nd.array(onp.linspace(-4, 4, 256).astype("float32"))], {}),
