@@ -11,6 +11,19 @@ mx.gluon, mx.sym, mx.mod, mx.optimizer, mx.metric, mx.io, mx.kv, ...).
 """
 __version__ = "0.1.0"
 
+import os as _os
+
+# Large-tensor support (ref: the INT64_TENSOR_SIZE build flag +
+# MXNET_USE_INT64_TENSOR_SIZE, docs/faq/env_var.md; tests/nightly/
+# test_large_array.py): int64 element indexing needs jax x64 mode,
+# which must be set before the first jax import. Opt-in, like the
+# reference's off-by-default build flag — x64 also widens python-float
+# weak types, so it is not the default.
+if _os.environ.get("MXNET_USE_INT64_TENSOR_SIZE", "0").lower() in (
+        "1", "true", "yes", "on"):
+    import jax as _jax
+    _jax.config.update("jax_enable_x64", True)
+
 
 # Wire this process into a multi-worker job before anything touches the
 # XLA backend, when launched by tools/launch.py (ref role: the DMLC_ROLE

@@ -255,6 +255,12 @@ register_flag(
     "MXNET_PROFILER_MODE", int, 0,
     "Default profiler mode bitmask (ref: env_var.md).")
 register_flag(
+    "MXNET_USE_INT64_TENSOR_SIZE", bool, False,
+    "Enable tensors with more than 2^31 elements / int64 indexing "
+    "(ref: the INT64_TENSOR_SIZE build flag, env_var.md). Read at "
+    "import: turns on jax x64 mode, which also widens python-float "
+    "weak types — opt-in, like the reference's off-by-default build.")
+register_flag(
     "MXNET_USE_OPERATOR_TUNING", str, "1",
     "Measure-and-cache selection between equivalent op implementations "
     "(Pallas flash vs dense attention, ...; operator_tune.autotune — "

@@ -480,8 +480,9 @@ class NDArray:
 
     def take(self, indices, axis=0, mode="clip"):
         ind = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
-        return invoke(lambda x: jnp.take(x, ind.astype(jnp.int32), axis=axis,
-                                         mode=mode), [self])
+        from ..ops.tensor import _index_int
+        return invoke(lambda x: jnp.take(x, ind.astype(_index_int()),
+                                         axis=axis, mode=mode), [self])
 
     def pick(self, index, axis=-1, keepdims=False):
         from ..ops import tensor as _t
@@ -489,9 +490,11 @@ class NDArray:
 
     def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
         dt = _canon_dtype(dtype)
-        return invoke(lambda x: jax.nn.one_hot(x.astype(jnp.int32), depth,
-                                               dtype=dt) * (on_value - off_value)
-                      + off_value, [self], differentiable=False)
+        from ..ops.tensor import _index_int
+        return invoke(lambda x: jax.nn.one_hot(
+            x.astype(_index_int()), depth, dtype=dt)
+            * (on_value - off_value) + off_value, [self],
+            differentiable=False)
 
     # reductions
     def _reduce(self, fn, axis=None, keepdims=False, **kw):
@@ -525,19 +528,22 @@ class NDArray:
             keepdims=keepdims), [self])
 
     def argmax(self, axis=None, keepdims=False):
+        from ..ops.tensor import _index_float
         return invoke(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims)
-                      .astype(jnp.float32), [self], differentiable=False)
+                      .astype(_index_float()), [self], differentiable=False)
 
     def argmin(self, axis=None, keepdims=False):
+        from ..ops.tensor import _index_float
         return invoke(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims)
-                      .astype(jnp.float32), [self], differentiable=False)
+                      .astype(_index_float()), [self], differentiable=False)
 
     def argsort(self, axis=-1, is_ascend=True):
         def f(x):
+            from ..ops.tensor import _index_float
             r = jnp.argsort(x, axis=axis)
             if not is_ascend:
                 r = jnp.flip(r, axis=axis)
-            return r.astype(jnp.float32)
+            return r.astype(_index_float())
         return invoke(f, [self], differentiable=False)
 
     def sort(self, axis=-1, is_ascend=True):

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register_op
+from .tensor import _index_float, _index_int
 
 __all__ = []
 
@@ -51,7 +52,7 @@ register_op("_npi_var")(
 register_op("_npi_argmax", differentiable=False)(
     lambda data, axis=None, keepdims=False:
     jnp.argmax(data, axis=None if axis is None else int(axis),
-               keepdims=keepdims).astype(jnp.float32))
+               keepdims=keepdims).astype(_index_float()))
 
 
 # ---------------------------------------------------------------------------
@@ -150,7 +151,7 @@ register_op("_npi_slice")(
         for b, e, s in zip(begin, end,
                            step or (None,) * len(begin)))])
 register_op("_npi_gather_nd", differentiable=False)(
-    lambda data, indices: data[tuple(indices.astype(jnp.int32))])
+    lambda data, indices: data[tuple(indices.astype(_index_int()))])
 register_op("_npi_rnn_param_concat", aliases=["_rnn_param_concat"])(
     lambda *args, dim=0: jnp.concatenate([a.reshape(-1) for a in args],
                                          axis=0))

@@ -201,25 +201,43 @@ def moments(data, axes=None, keepdims=False):
     return mean, var
 
 
+def _index_int():
+    """Integer index dtype: int64 under MXNET_USE_INT64_TENSOR_SIZE
+    (jax x64), else int32."""
+    import jax as _jax
+    return jnp.int64 if _jax.config.jax_enable_x64 else jnp.int32
+
+
+def _index_float():
+    """Index-carrying float dtype: MXNet's arg* ops return floats; under
+    MXNET_USE_INT64_TENSOR_SIZE (jax x64) float32 cannot represent
+    indices past 2^24/2^31, so widen to f64 (the reference's large-
+    tensor build widens these outputs the same way)."""
+    import jax as _jax
+    return jnp.float64 if _jax.config.jax_enable_x64 else jnp.float32
+
+
 @register_op("argmax", differentiable=False)
 def argmax(data, axis=None, keepdims=False):
-    return jnp.argmax(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+    return jnp.argmax(data, axis=axis,
+                      keepdims=keepdims).astype(_index_float())
 
 
 @register_op("argmin", differentiable=False)
 def argmin(data, axis=None, keepdims=False):
-    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+    return jnp.argmin(data, axis=axis,
+                      keepdims=keepdims).astype(_index_float())
 
 
 @register_op("argmax_channel", differentiable=False)
 def argmax_channel(data):
-    return jnp.argmax(data, axis=1).astype(jnp.float32)
+    return jnp.argmax(data, axis=1).astype(_index_float())
 
 
 @register_op("pick")
 def pick(data, index, axis=-1, keepdims=False, mode="clip"):
     """ref: src/operator/tensor/broadcast_reduce_op_index.cc pick"""
-    idx = index.astype(jnp.int32)
+    idx = index.astype(_index_int())
     if idx.ndim == data.ndim:
         idx = jnp.squeeze(idx, axis=axis)
     picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
@@ -233,7 +251,11 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
 # ---------------------------------------------------------------------------
 
 @register_op("topk", differentiable=False)
-def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype=None):
+    # default index dtype follows the large-tensor mode (f64 exact past
+    # 2^24 under x64; the reference default "float32" otherwise)
+    dtype = dtype or _index_float()
     mv = jnp.moveaxis(data, axis, -1)
     vals, idx = jax.lax.top_k(-mv if is_ascend else mv, k)
     if is_ascend:
@@ -257,7 +279,8 @@ def sort(data, axis=-1, is_ascend=True):
 
 
 @register_op("argsort", differentiable=False)
-def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+def argsort(data, axis=-1, is_ascend=True, dtype=None):
+    dtype = dtype or _index_float()
     r = jnp.argsort(data, axis=axis)
     if not is_ascend:
         r = jnp.flip(r, axis=axis)
@@ -495,13 +518,13 @@ def identity_with_attr_like_rhs(lhs, rhs):
 @register_op("take")
 def take(a, indices, axis=0, mode="clip"):
     m = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
-    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=m)
+    return jnp.take(a, indices.astype(_index_int()), axis=axis, mode=m)
 
 
 @register_op("batch_take")
 def batch_take(a, indices):
     return jnp.take_along_axis(
-        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1).squeeze(1)
+        a, indices.astype(_index_int()).reshape(-1, 1), axis=1).squeeze(1)
 
 
 @register_op("one_hot", differentiable=False)
@@ -512,13 +535,13 @@ def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
 
 @register_op("gather_nd")
 def gather_nd(data, indices):
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(indices.astype(_index_int()))
     return data[idx]
 
 
 @register_op("scatter_nd")
 def scatter_nd(data, indices, shape=None):
-    idx = tuple(indices.astype(jnp.int32))
+    idx = tuple(indices.astype(_index_int()))
     out = jnp.zeros(tuple(shape), data.dtype)
     return out.at[idx].add(data)
 
@@ -533,7 +556,7 @@ def ravel_multi_index(data, shape=None):
 
 @register_op("_unravel_index", differentiable=False)
 def unravel_index(data, shape=None):
-    idx = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    idx = jnp.unravel_index(data.astype(_index_int()), tuple(shape))
     return jnp.stack(idx).astype(data.dtype)
 
 

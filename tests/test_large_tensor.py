@@ -1,0 +1,73 @@
+"""Large-tensor tier: int64 indexing past the 2^31 element boundary
+(ref: tests/nightly/test_large_array.py / test_large_vector.py behind
+the INT64_TENSOR_SIZE build flag).
+
+MXNET_USE_INT64_TENSOR_SIZE must be set BEFORE the framework imports
+(it flips jax x64 mode), so the checks run in a subprocess. Gated by
+MXTPU_TEST_LARGE=1 (allocates a few GB):
+
+    MXTPU_TEST_LARGE=1 python -m pytest tests/test_large_tensor.py -q
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MXTPU_TEST_LARGE", "0") != "1",
+    reason="large-tensor tier is opt-in (MXTPU_TEST_LARGE=1; needs ~6GB)")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHECKS = r'''
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as onp
+from mxnet_tpu import nd
+
+LARGE = 2 ** 31 + 17
+
+# vector past the int32 element-count boundary
+a = nd.zeros((LARGE,), dtype="int8")
+assert a.size == LARGE
+a[2 ** 31 + 11] = 7
+a[-1] = 3
+assert int(a[2 ** 31 + 11].asscalar()) == 7
+assert int(a[LARGE - 1].asscalar()) == 3
+assert int(a.sum().asscalar()) == 10
+print("vector ok")
+
+# argmax index beyond int32
+b = nd.zeros((LARGE,), dtype="int8")
+idx = 2 ** 31 + 5
+b[idx] = 1
+got = int(b.argmax(axis=0).asscalar())
+assert got == idx, f"argmax {got} != {idx}"
+print("argmax ok")
+
+# take with int64 indices
+picked = nd.take(b, nd.array(onp.array([idx, 0], dtype="int64")))
+assert picked.asnumpy().tolist() == [1, 0], picked.asnumpy()
+print("take ok")
+
+# 2D: rows * cols > 2^31, slice + reduce
+rows = 2 ** 27 + 3
+c = nd.ones((rows, 17), dtype="int8")
+assert c.size > 2 ** 31
+assert c[rows - 2:].shape == (2, 17)
+assert int(c.sum(axis=0)[0].asscalar()) == rows
+print("2d ok")
+print("LARGE_TENSOR_OK")
+'''
+
+
+def test_int64_tensor_size_subprocess():
+    env = dict(os.environ)
+    env["MXNET_USE_INT64_TENSOR_SIZE"] = "1"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", CHECKS], env=env,
+                          capture_output=True, text=True, timeout=1200)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert "LARGE_TENSOR_OK" in out
