@@ -97,6 +97,7 @@ from . import operator_tune  # noqa: F401
 from .model import FeedForward  # noqa: F401
 from . import runtime  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import tensor_inspector  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import util  # noqa: F401
 from . import visualization  # noqa: F401

@@ -10,7 +10,8 @@ from ...base import MXNetError
 from ..block import Block, HybridBlock
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
-           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "ModifierCell", "DropoutCell", "ZoneoutCell",
            "ResidualCell", "BidirectionalCell"]
 
 
@@ -290,6 +291,13 @@ class SequentialRNNCell(RecurrentCell):
 
     def __getitem__(self, i):
         return list(self._children.values())[i]
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """ref: rnn_cell.py HybridSequentialRNNCell — the hybridizable
+    stacked-cell container. Stacking logic is identical; under this
+    framework both variants trace cleanly through jit (hybridize is a
+    whole-graph property), so this subclass exists for API parity."""
 
 
 class DropoutCell(HybridRecurrentCell):

@@ -18,9 +18,13 @@ from .io.io import DataBatch, DataDesc, DataIter
 from .ndarray.ndarray import NDArray, array
 
 __all__ = ["imdecode", "imread", "imresize", "resize_short", "center_crop",
-           "random_crop", "color_normalize", "CreateAugmenter", "Augmenter",
+           "random_crop", "fixed_crop", "color_normalize",
+           "CreateAugmenter", "Augmenter",
            "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
-           "HorizontalFlipAug", "ColorNormalizeAug", "CastAug", "ImageIter",
+           "HorizontalFlipAug", "ColorNormalizeAug", "CastAug",
+           "SequentialAug", "RandomOrderAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
+           "ColorJitterAug", "LightingAug", "RandomGrayAug", "ImageIter",
            "ImageRecordIterPy"]
 
 
@@ -190,6 +194,182 @@ class CastAug(Augmenter):
         return src.astype(self.typ)
 
 
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """ref: image.py fixed_crop — crop the (x0, y0, w, h) window, then
+    optionally resize to `size` (w, h)."""
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def _as_host(src):
+    """Augmenter-internal host view: jitter math runs in numpy; NDArray
+    inputs round-trip, numpy inputs stay numpy (no device hops when
+    augs are chained)."""
+    if hasattr(src, "asnumpy"):
+        return onp.asarray(src.asnumpy(), onp.float32), True
+    return onp.asarray(src, onp.float32), False
+
+
+def _from_host(a, was_nd):
+    return array(a) if was_nd else a
+
+
+class SequentialAug(Augmenter):
+    """ref: image.py SequentialAug — apply children in order."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """ref: image.py RandomOrderAug — children in random order."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    """ref: image.py BrightnessJitterAug — scale by 1±U(0, brightness)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    """ref: image.py ContrastJitterAug — blend with the mean gray."""
+
+    _GRAY = onp.array([0.299, 0.587, 0.114], onp.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        a, was_nd = _as_host(src)
+        gray = (a * self._GRAY).sum(axis=-1).mean()
+        return _from_host(a * alpha + gray * (1.0 - alpha), was_nd)
+
+
+class SaturationJitterAug(Augmenter):
+    """ref: image.py SaturationJitterAug — blend with per-pixel gray."""
+
+    _GRAY = ContrastJitterAug._GRAY
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        a, was_nd = _as_host(src)
+        gray = (a * self._GRAY).sum(axis=-1, keepdims=True)
+        return _from_host(a * alpha + gray * (1.0 - alpha), was_nd)
+
+
+class HueJitterAug(Augmenter):
+    """ref: image.py HueJitterAug — rotate color about the gray axis
+    (the yiq-matrix formulation)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = onp.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], onp.float32)
+        self.ityiq = onp.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], onp.float32)
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       onp.float32)
+        t = self.ityiq @ bt @ self.tyiq
+        a, was_nd = _as_host(src)
+        return _from_host(a @ t.T, was_nd)
+
+
+class LightingAug(Augmenter):
+    """ref: image.py LightingAug — AlexNet-style PCA color noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd,
+                         eigval=onp.asarray(eigval).tolist(),
+                         eigvec=onp.asarray(eigvec).tolist())
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, onp.float32)
+        self.eigvec = onp.asarray(eigvec, onp.float32)
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return src + array(rgb.astype(onp.float32))
+
+
+class RandomGrayAug(Augmenter):
+    """ref: image.py RandomGrayAug — with prob p convert to gray
+    (luminance weights, matching the reference's gray matrix)."""
+
+    _MAT = onp.tile(onp.array([[0.21], [0.72], [0.07]], onp.float32),
+                    (1, 3))
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            a, was_nd = _as_host(src)
+            return _from_host(a @ self._MAT, was_nd)
+        return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    """ref: image.py ColorJitterAug — brightness/contrast/saturation in
+    random order."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -206,6 +386,20 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        # ImageNet eigval/eigvec (ref: image.py CreateAugmenter)
+        auglist.append(LightingAug(
+            pca_noise,
+            [55.46, 4.794, 1.148],
+            [[-0.5675, 0.7192, 0.4009],
+             [-0.5808, -0.0045, -0.8140],
+             [-0.5836, -0.6948, 0.4203]]))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = onp.asarray([123.68, 116.28, 103.53])
     if std is True:
@@ -225,8 +419,16 @@ class ImageIter(DataIter):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
+        # forward augmentation kwargs to CreateAugmenter like the
+        # reference ImageIter; unknown kwargs must not silently disable
+        # the requested augmentation
+        aug_kwargs = {k: kwargs.pop(k) for k in list(kwargs)
+                      if k in ("resize", "rand_crop", "rand_resize",
+                               "rand_mirror", "mean", "std", "brightness",
+                               "contrast", "saturation", "hue",
+                               "pca_noise", "rand_gray", "inter_method")}
         self.auglist = aug_list if aug_list is not None else \
-            CreateAugmenter(data_shape)
+            CreateAugmenter(data_shape, **aug_kwargs)
         self.data_name = data_name
         self.label_name = label_name
         self.shuffle = shuffle
@@ -349,8 +551,12 @@ def ImageRecordIterPy(path_imgrec=None, data_shape=(3, 224, 224),
     std = None
     if (std_r, std_g, std_b) != (1, 1, 1):
         std = onp.asarray([std_r, std_g, std_b])
+    jitter = {k: kwargs.pop(k) for k in list(kwargs)
+              if k in ("brightness", "contrast", "saturation", "hue",
+                       "pca_noise", "rand_gray", "inter_method")}
     augs = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
-                           rand_mirror=rand_mirror, mean=mean, std=std)
+                           rand_mirror=rand_mirror, mean=mean, std=std,
+                           **jitter)
     return ImageIter(batch_size, data_shape, label_width,
                      path_imgrec=path_imgrec, shuffle=shuffle,
                      aug_list=augs, **kwargs)

@@ -1,0 +1,113 @@
+"""Tensor debugging inspector.
+
+ref: src/common/tensor_inspector.h — TensorInspector wraps a tensor and
+offers value printing, binary dumps, and value checking (NaN/Inf/
+negative/... checkers returning violation coordinates) for debugging
+numerical issues. The TPU-native version operates on host copies at
+sync points (the only place device values are observable) and plugs
+into Monitor-style workflows:
+
+    from mxnet_tpu.tensor_inspector import TensorInspector, CheckerType
+    ti = TensorInspector(arr)
+    print(ti.to_string())
+    bad = ti.check_value(CheckerType.NaNChecker)   # list of coords
+    ti.dump_to_file("dumps", "conv1_out")
+"""
+from __future__ import annotations
+
+import enum
+import os
+from typing import Callable, List, Tuple, Union
+
+import numpy as onp
+
+__all__ = ["TensorInspector", "CheckerType"]
+
+
+class CheckerType(enum.Enum):
+    """ref: tensor_inspector.h CheckerType."""
+    NegativeChecker = "negative"
+    PositiveChecker = "positive"
+    ZeroChecker = "zero"
+    NaNChecker = "nan"
+    InfChecker = "inf"
+    PositiveInfChecker = "pinf"
+    NegativeInfChecker = "ninf"
+    FiniteChecker = "finite"
+    AbnormalChecker = "abnormal"  # nan or inf
+
+
+_CHECKS = {
+    CheckerType.NegativeChecker: lambda a: a < 0,
+    CheckerType.PositiveChecker: lambda a: a > 0,
+    CheckerType.ZeroChecker: lambda a: a == 0,
+    CheckerType.NaNChecker: lambda a: onp.isnan(a),
+    CheckerType.InfChecker: lambda a: onp.isinf(a),
+    CheckerType.PositiveInfChecker: lambda a: onp.isposinf(a),
+    CheckerType.NegativeInfChecker: lambda a: onp.isneginf(a),
+    CheckerType.FiniteChecker: lambda a: onp.isfinite(a),
+    CheckerType.AbnormalChecker: lambda a: ~onp.isfinite(a),
+}
+
+
+class TensorInspector:
+    """Inspect one tensor's values on the host (ref:
+    tensor_inspector.h TensorInspector; construction forces a sync —
+    the WaitToRead the reference performs before reading)."""
+
+    def __init__(self, tensor, name: str = "tensor"):
+        if hasattr(tensor, "asnumpy"):
+            self._a = tensor.asnumpy()
+        else:
+            self._a = onp.asarray(tensor)
+        self.name = name
+
+    # -- info / printing --------------------------------------------------
+    def tensor_info(self) -> str:
+        """ref: tensor_info_to_string — '<dtype Tensor shape>'."""
+        shape = "x".join(str(s) for s in self._a.shape) or "scalar"
+        return f"<{self._a.dtype} Tensor {shape}>"
+
+    def to_string(self, max_elems: int = 1000) -> str:
+        body = onp.array2string(self._a, threshold=max_elems)
+        return f"{self.tensor_info()}\n{body}"
+
+    def print_string(self, max_elems: int = 1000):
+        print(self.to_string(max_elems=max_elems))
+
+    # -- value checking ---------------------------------------------------
+    def check_value(self,
+                    checker: Union[CheckerType, Callable],
+                    interactive: bool = False,
+                    print_result: bool = False
+                    ) -> List[Tuple[int, ...]]:
+        """Coordinates where `checker` holds (ref: check_value_helper).
+
+        checker: a CheckerType or an elementwise predicate over the
+        numpy array. `print_result` prints each coordinate like the
+        reference's interactive mode (which is not meaningful under an
+        async runtime, so prompting is not reproduced)."""
+        fn = _CHECKS[checker] if isinstance(checker, CheckerType) \
+            else checker
+        mask = onp.asarray(fn(self._a))
+        coords = [tuple(int(i) for i in c) for c in
+                  onp.argwhere(mask)]
+        if print_result or interactive:
+            for c in coords:
+                print(f"{self.name}{list(c)} = {self._a[c]}")
+        return coords
+
+    # -- dumping ----------------------------------------------------------
+    def dump_to_file(self, directory: str, tag: str,
+                     visit_id: int = 0) -> str:
+        """Binary .npy dump named '<tag>_<visit>.npy'
+        (ref: dump_to_file writes {tag}_{visit}.npy in numpy format so
+        dumps are loadable with numpy.load — same contract here)."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"{tag}_{visit_id}.npy")
+        onp.save(path, self._a)
+        return path
+
+    @staticmethod
+    def load_from_file(path: str) -> onp.ndarray:
+        return onp.load(path)

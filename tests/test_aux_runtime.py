@@ -382,3 +382,23 @@ def test_im2rec_multiprocess_matches_serial(tmp_path):
 def test_signal_handler_enabled():
     import faulthandler
     assert faulthandler.is_enabled()
+
+
+# ---------------------------------------------------------------------------
+# tensor inspector (ref: src/common/tensor_inspector.h)
+# ---------------------------------------------------------------------------
+
+def test_tensor_inspector(tmp_path):
+    from mxnet_tpu.tensor_inspector import CheckerType, TensorInspector
+    a = nd.array(onp.array([[1.0, -2.0], [onp.nan, onp.inf]], "float32"))
+    ti = TensorInspector(a, name="act")
+    assert ti.tensor_info() == "<float32 Tensor 2x2>"
+    assert "float32" in ti.to_string()
+    assert ti.check_value(CheckerType.NaNChecker) == [(1, 0)]
+    assert ti.check_value(CheckerType.AbnormalChecker) == [(1, 0), (1, 1)]
+    assert ti.check_value(CheckerType.NegativeChecker) == [(0, 1)]
+    assert ti.check_value(lambda x: x == 1.0) == [(0, 0)]
+    path = ti.dump_to_file(str(tmp_path), "act", visit_id=3)
+    assert path.endswith("act_3.npy")
+    back = TensorInspector.load_from_file(path)
+    assert back.shape == (2, 2) and back[0, 0] == 1.0

@@ -1,0 +1,75 @@
+"""Python image augmenter tier (ref: python/mxnet/image/image.py
+augmenters + tests/python/unittest/test_image.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu.image as image
+from mxnet_tpu import nd
+
+
+@pytest.fixture
+def src():
+    rs = onp.random.RandomState(0)
+    return nd.array(rs.randint(0, 255, (24, 32, 3)).astype("float32"))
+
+
+def test_fixed_crop(src):
+    out = image.fixed_crop(src, 4, 2, 16, 20)
+    assert out.shape == (20, 16, 3)
+    assert onp.allclose(out.asnumpy(), src.asnumpy()[2:22, 4:20])
+    resized = image.fixed_crop(src, 4, 2, 16, 20, size=(8, 10))
+    assert resized.shape == (10, 8, 3)
+
+
+def test_brightness_jitter_scales(src):
+    aug = image.BrightnessJitterAug(0.5)
+    out = aug(src).asnumpy()
+    a = src.asnumpy()
+    sel = a > 10  # avoid divide noise at near-zero pixels
+    ratio = out[sel] / a[sel]
+    # one global scale factor in [0.5, 1.5]
+    assert ratio.std() < 1e-2
+    assert 0.45 <= ratio.mean() <= 1.55
+
+
+def test_contrast_and_saturation_preserve_shape(src):
+    for aug in (image.ContrastJitterAug(0.3),
+                image.SaturationJitterAug(0.3),
+                image.HueJitterAug(0.2),
+                image.RandomGrayAug(1.0),
+                image.LightingAug(0.1, [55.46, 4.794, 1.148],
+                                  onp.eye(3))):
+        out = aug(src)
+        assert out.shape == src.shape
+        assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_random_gray_p1_is_gray(src):
+    out = image.RandomGrayAug(1.0)(src).asnumpy()
+    assert onp.allclose(out[..., 0], out[..., 1], atol=1e-3)
+    assert onp.allclose(out[..., 1], out[..., 2], atol=1e-3)
+
+
+def test_sequential_and_random_order_aug(src):
+    seq = image.SequentialAug([image.CastAug("float32"),
+                               image.HorizontalFlipAug(1.0)])
+    out = seq(src).asnumpy()
+    assert onp.allclose(out, src.asnumpy()[:, ::-1])
+    ro = image.RandomOrderAug([image.CastAug("float32")])
+    assert ro(src).shape == src.shape
+
+
+def test_create_augmenter_full_chain(src):
+    augs = image.CreateAugmenter((3, 16, 16), rand_mirror=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1, pca_noise=0.05,
+                                 rand_gray=0.2, mean=True, std=True)
+    names = [type(a).__name__ for a in augs]
+    assert "ColorJitterAug" in names and "HueJitterAug" in names
+    assert "LightingAug" in names and "RandomGrayAug" in names
+    out = src
+    for a in augs:
+        out = a(out)
+    assert out.shape == (16, 16, 3)
+    # normalized: roughly standardized range
+    assert abs(float(out.asnumpy().mean())) < 3.0
