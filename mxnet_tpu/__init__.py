@@ -98,6 +98,12 @@ from .model import FeedForward  # noqa: F401
 from . import runtime  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import tensor_inspector  # noqa: F401
+from . import name  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
+from . import libinfo  # noqa: F401
+from . import log  # noqa: F401
+from . import library  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import util  # noqa: F401
 from . import visualization  # noqa: F401

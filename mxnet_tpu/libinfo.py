@@ -1,0 +1,33 @@
+"""Library metadata (ref: python/mxnet/libinfo.py — __version__ and
+find_lib_path locating the native library)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+from . import __version__  # noqa: F401  (single source in the package)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def find_lib_path():
+    """Paths of the native shared libraries (ref: libinfo.py
+    find_lib_path — here the lazily-built RecordIO/pipeline and C-ABI
+    libraries; builds them on first call like the reference expects the
+    lib to exist)."""
+    from . import native
+    paths = []
+    if native.available():
+        paths.append(native.build())
+    try:
+        paths.append(native.build_capi())
+    except Exception:
+        pass
+    return [p for p in paths if p and os.path.exists(p)]
+
+
+def find_include_path():
+    """C/C++ headers consumers compile against (mxtpu_predict.h /
+    mxtpu_cpp.hpp; ref: find_include_path)."""
+    return os.path.join(_HERE, "native")

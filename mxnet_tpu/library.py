@@ -1,0 +1,67 @@
+"""External operator libraries (ref: python/mxnet/library.py load() +
+src/initialize.cc MXLoadLib over include/mxnet/lib_api.h).
+
+The reference dlopens a C++ library exporting the lib_api registration
+hooks. The TPU-native extension unit is a PYTHON module registering jax
+ops through the same registry every built-in op uses (register_op) —
+the compiler, not an ABI, is the integration point. load() therefore
+accepts a .py path (executed as a module, its register_op calls take
+effect immediately thanks to the nd/sym late-op fallback) and rejects
+binary libraries with an explanatory error.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+from .base import MXNetError
+from .log import get_logger
+
+__all__ = ["load", "loaded_libraries"]
+
+_log = get_logger("mxnet_tpu.library", level=20)  # INFO
+_LOADED = {}
+
+
+def load(path: str, verbose: bool = True):
+    """Load an operator-extension module (ref: library.py load).
+
+    `path` is a python file; top-level code registers ops:
+
+        # myops.py
+        from mxnet_tpu.ops.registry import register_op
+        @register_op("my_gemm")
+        def my_gemm(a, b): ...
+
+        mx.library.load("myops.py")
+        mx.nd.my_gemm(x, y)
+    """
+    if not os.path.exists(path):
+        raise MXNetError(f"library not found: {path}")
+    if path.endswith((".so", ".dll", ".dylib")):
+        raise MXNetError(
+            "binary op libraries target the reference's lib_api ABI; "
+            "TPU-native extensions are python modules calling "
+            "mxnet_tpu.ops.registry.register_op (pure-jax kernels get "
+            "compiled by XLA — there is no dlopen kernel path)")
+    if not path.endswith(".py"):
+        raise MXNetError(
+            f"operator extensions must be .py modules, got {path!r}")
+    if path in _LOADED:
+        return _LOADED[path]
+    from .ops.registry import list_ops
+    before = set(list_ops())
+    name = f"mxtpu_lib_{os.path.basename(path)[:-3]}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    added = sorted(set(list_ops()) - before)
+    if verbose:
+        _log.info("loaded %s: %d new operator(s) %s", path, len(added),
+                  added[:8])
+    _LOADED[path] = mod
+    return mod
+
+
+def loaded_libraries():
+    return dict(_LOADED)

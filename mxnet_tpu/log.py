@@ -1,0 +1,39 @@
+"""Logging helpers (ref: python/mxnet/log.py — get_logger with the
+reference's PY_VAR formatting and level handling)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "CRITICAL", "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+CRITICAL = logging.CRITICAL
+NOTSET = logging.NOTSET
+
+_FMT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configure and return a logger (ref: log.py getLogger): optional
+    file output, idempotent handler attachment."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxtpu_configured", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FMT, _DATEFMT))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_configured = True
+    return logger
+
+
+getLogger = get_logger

@@ -74,11 +74,13 @@ def foreach(body: Callable, data, init_states, name=None):
                 + [v.name for v in state_vars]
                 + [n.name for n, _ in free])
     n_total = len(out_list) + len(ns_list)
+    from ..attribute import AttrScope
     node = _Node("_foreach", name or _auto_name("_foreach"),
                  _entries(data_list) + _entries(state_list) + free,
                  {"__subgraph__": sub, "in_names": tuple(in_names),
                   "n_data": len(data_list), "n_states": len(state_list),
-                  "num_outputs": n_total})
+                  "num_outputs": n_total},
+                 AttrScope.current().get(None))
     entries = [(node, i) for i in range(n_total)]
     out_syms = [Symbol([e]) for e in entries[:len(out_list)]]
     st_syms = [Symbol([e]) for e in entries[len(out_list):]]
@@ -107,12 +109,14 @@ def while_loop(cond: Callable, func: Callable, loop_vars,
     free = _free_var_entries([func_sub, cond_sub], bound)
     in_names = [v.name for v in lvars] + [n.name for n, _ in free]
     n_total = len(out_list) + len(nv_list)
+    from ..attribute import AttrScope
     node = _Node("_while_loop", name or _auto_name("_while_loop"),
                  _entries(var_list) + free,
                  {"__cond__": cond_sub, "__func__": func_sub,
                   "in_names": tuple(in_names), "n_vars": len(var_list),
                   "max_iterations": int(max_iterations),
-                  "num_outputs": n_total})
+                  "num_outputs": n_total},
+                 AttrScope.current().get(None))
     entries = [(node, i) for i in range(n_total)]
     out_syms = [Symbol([e]) for e in entries[:len(out_list)]]
     var_syms = [Symbol([e]) for e in entries[len(out_list):]]
@@ -141,10 +145,12 @@ def cond(pred: Callable, then_func: Callable, else_func: Callable,
     bound = {v.name for v in ivars}
     free = _free_var_entries([pred_sub, then_sub, else_sub], bound)
     in_names = [v.name for v in ivars] + [n.name for n, _ in free]
+    from ..attribute import AttrScope
     node = _Node("_cond", name or _auto_name("_cond"),
                  _entries(in_list) + free,
                  {"__pred__": pred_sub, "__then__": then_sub,
                   "__else__": else_sub, "in_names": tuple(in_names),
-                  "num_outputs": len(t_list)})
+                  "num_outputs": len(t_list)},
+                 AttrScope.current().get(None))
     out_syms = [Symbol([(node, i)]) for i in range(len(t_list))]
     return out_syms[0] if single_out else out_syms

@@ -12,7 +12,6 @@ planning/fusion/bulking are XLA's job (SURVEY.md §3.3 "TPU mapping").
 from __future__ import annotations
 
 import json
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -26,16 +25,14 @@ from ..ops.registry import get_op, has_op, list_ops, OpInfo
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "zeros", "ones"]
 
-_name_lock = threading.local()
 
 
 def _auto_name(op_name: str) -> str:
-    counts = getattr(_name_lock, "counts", None)
-    if counts is None:
-        counts = _name_lock.counts = {}
+    """Auto names come from the active NameManager (ref: name.py
+    NameManager/Prefix; symbol.py _set_name)."""
+    from ..name import NameManager
     base = op_name.lower().lstrip("_")
-    counts[base] = counts.get(base, -1) + 1
-    return f"{base}{counts[base]}"
+    return NameManager.current().get(None, base)
 
 
 class _Node:
@@ -482,6 +479,8 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if init is not None:
         attrs["__init__"] = init if isinstance(init, str) else init.dumps()
     attrs.update(kwargs)
+    from ..attribute import AttrScope
+    attrs = AttrScope.current().get(attrs)
     return Symbol([(_Node(None, name, [], {}, attrs), 0)])
 
 
@@ -510,6 +509,10 @@ def _make_node(op_name: str, inputs: List[Tuple[_Node, int]], params: dict,
                ) -> Symbol:
     info = get_op(op_name)
     name = name or _auto_name(op_name)
+    # merge scope attrs (ref: attribute.py AttrScope applied by the
+    # symbol creators; explicit attrs win)
+    from ..attribute import AttrScope
+    attrs = AttrScope.current().get(attrs)
     # auto-create variables for missing declared inputs (ref: the reference
     # auto-creates fullyconnected0_weight etc. at compose time)
     if info.input_names:
