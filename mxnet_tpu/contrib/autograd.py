@@ -1,0 +1,91 @@
+"""Legacy contrib autograd API (ref: python/mxnet/contrib/autograd.py —
+the pre-1.0 surface kept for old scripts; thin adapters over the main
+mxnet_tpu.autograd implementation)."""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+from .. import autograd as _ag
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """ref: contrib/autograd.py set_is_training — returns the previous
+    state."""
+    prev = _ag.is_recording()
+    _ag.set_recording(bool(is_train))
+    _ag.set_training(bool(is_train))
+    return prev
+
+
+@contextlib.contextmanager
+def train_section():
+    """ref: contrib/autograd.py train_section — records computation."""
+    with _ag.record():
+        yield
+
+
+@contextlib.contextmanager
+def test_section():
+    """ref: contrib/autograd.py test_section — pauses recording."""
+    with _ag.pause():
+        yield
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """ref: contrib/autograd.py mark_variables — delegates to the main
+    implementation (autograd.mark_variables) after scalar-to-list
+    normalization so the two paths cannot diverge."""
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """ref: contrib/autograd.py backward."""
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+    _ag.backward(outputs, head_grads=out_grads,
+                 retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """ref: contrib/autograd.py compute_gradient (deprecated alias of
+    backward; gradients land on the marked variables)."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """ref: contrib/autograd.py grad_and_loss — wraps `func` to return
+    (gradients, loss)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            nums = argnum if isinstance(argnum, (list, tuple)) else [argnum]
+            variables = [args[i] for i in nums]
+        for v in variables:
+            if v.grad is None:
+                v.attach_grad()
+        with _ag.record():
+            out = func(*args)
+        backward(out)
+        return [v.grad for v in variables], out
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """ref: contrib/autograd.py grad."""
+    fn = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return fn(*args)[0]
+    return wrapped

@@ -1,0 +1,77 @@
+"""contrib IO adapters (ref: python/mxnet/contrib/io.py —
+DataLoaderIter bridges a gluon DataLoader into the Module/DataIter
+world)."""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..io.io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a gluon DataLoader as a DataIter so Module.fit can consume
+    gluon datasets (ref: contrib/io.py DataLoaderIter). A short final
+    batch (DataLoader last_batch='keep') is wrap-padded to the full
+    batch size with DataBatch.pad set, matching DataIter semantics."""
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label", dtype="float32"):
+        super().__init__(batch_size=0)
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        self._dtype = dtype
+        try:
+            self._first = next(self._iter)
+        except StopIteration:
+            raise MXNetError("DataLoaderIter: the DataLoader is empty") \
+                from None
+        self._consumed_first = False
+        self.batch_size = self._first[0].shape[0]
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name, self._first[0].shape,
+                         self._dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self._label_name, self._first[1].shape,
+                         self._dtype)]
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._consumed_first = True  # stale; re-iterate from scratch
+
+    def _pad_full(self, arr):
+        """Wrap-pad a short final batch to batch_size rows."""
+        from ..ndarray import concat
+        n = arr.shape[0]
+        reps = []
+        while n + sum(r.shape[0] for r in reps) < self.batch_size:
+            take = min(arr.shape[0],
+                       self.batch_size - n - sum(r.shape[0]
+                                                 for r in reps))
+            reps.append(arr[:take])
+        return concat(arr, *reps, dim=0) if reps else arr
+
+    def next(self):
+        if not self._consumed_first:
+            self._consumed_first = True
+            data, label = self._first
+        else:
+            data, label = next(self._iter)
+        pad = self.batch_size - data.shape[0]
+        if pad > 0:
+            data = self._pad_full(data)
+            label = self._pad_full(label)
+        return DataBatch(data=[data.astype(self._dtype)],
+                         label=[label.astype(self._dtype)],
+                         pad=max(pad, 0),
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def iter_next(self):
+        raise NotImplementedError  # next() is overridden directly

@@ -1,0 +1,7 @@
+"""contrib ndarray namespace (ref: python/mxnet/contrib/ndarray.py —
+the generated `_contrib_*` op surface; identical to nd.contrib)."""
+from ..ndarray import contrib as _contrib
+
+
+def __getattr__(name):
+    return getattr(_contrib, name)

@@ -1,0 +1,7 @@
+"""contrib symbol namespace (ref: python/mxnet/contrib/symbol.py —
+the generated `_contrib_*` symbol surface; identical to sym.contrib)."""
+from ..symbol import contrib as _contrib
+
+
+def __getattr__(name):
+    return getattr(_contrib, name)

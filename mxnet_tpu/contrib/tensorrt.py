@@ -1,0 +1,32 @@
+"""TensorRT integration surface (ref: python/mxnet/contrib/tensorrt.py).
+
+TensorRT is an NVIDIA inference runtime; on TPU its role — taking a
+trained graph and producing an optimized inference engine — is XLA
+compilation itself (every bound executor IS the optimized engine), with
+INT8 via contrib.quantization. The reference API is kept so ported
+scripts fail with guidance rather than AttributeError."""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["set_use_fp16", "get_use_fp16", "init_tensorrt_params"]
+
+_use_fp16 = False
+
+
+def set_use_fp16(status):
+    """ref: tensorrt.py set_use_fp16 — advisory on TPU (prefer the bf16
+    AMP policies, contrib.amp)."""
+    global _use_fp16
+    _use_fp16 = bool(status)
+
+
+def get_use_fp16():
+    return _use_fp16
+
+
+def init_tensorrt_params(sym, arg_params, aux_params):
+    raise MXNetError(
+        "TensorRT is CUDA-only. On TPU the bound executor already runs "
+        "the XLA-optimized engine; for low precision use contrib.amp "
+        "(bf16) or contrib.quantization.quantize_model (int8).")

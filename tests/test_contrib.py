@@ -337,3 +337,80 @@ def test_misc_contrib():
     assert fz.shape == (2, 16)
     back = nd._contrib_ifft(fz) / 8
     assert_almost_equal(back.asnumpy(), sig.asnumpy(), atol=1e-4)
+
+
+def test_contrib_legacy_autograd():
+    """ref: contrib/autograd.py — the pre-1.0 grad/grad_and_loss API."""
+    from mxnet_tpu.contrib import autograd as cag
+
+    def f(x):
+        return (x * x).sum()
+
+    x = nd.array(onp.array([1.0, 2.0, 3.0], "float32"))
+    grads, loss = cag.grad_and_loss(f)(x)
+    assert onp.allclose(grads[0].asnumpy(), [2.0, 4.0, 6.0])
+    assert float(loss.asscalar()) == pytest.approx(14.0)
+    g = cag.grad(f)(x)
+    assert onp.allclose(g[0].asnumpy(), [2.0, 4.0, 6.0])
+    with cag.train_section():
+        from mxnet_tpu import autograd as ag
+        assert ag.is_recording()
+        with cag.test_section():
+            assert not ag.is_recording()
+
+
+def test_contrib_dataloader_iter():
+    """ref: contrib/io.py DataLoaderIter — gluon DataLoader feeding a
+    Module."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, sym
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.rand(32, 6).astype("float32"))
+    y = nd.array((rs.rand(32) > 0.5).astype("float32"))
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(x, y),
+                                   batch_size=8)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (8, 6)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.var("data"), num_hidden=2), name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    it.reset()
+    mod.fit(it, num_epoch=1, optimizer="sgd")
+
+
+def test_contrib_namespaces_and_tensorrt():
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import ndarray as cnd, symbol as csym, tensorrt
+    # alias namespaces resolve the same ops as nd/sym contrib
+    assert cnd.quadratic is not None
+    assert csym.MultiBoxPrior is not None
+    tensorrt.set_use_fp16(True)
+    assert tensorrt.get_use_fp16()
+    with pytest.raises(mx.base.MXNetError, match="XLA"):
+        tensorrt.init_tensorrt_params(None, {}, {})
+
+
+def test_contrib_dataloader_iter_pads_short_final_batch():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.rand(30, 6).astype("float32"))  # 30 % 8 != 0
+    y = nd.array(rs.rand(30).astype("float32"))
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(x, y),
+                                   batch_size=8)
+    it = DataLoaderIter(loader)
+    batches = list(it)
+    assert [b.pad for b in batches] == [0, 0, 0, 2]
+    assert all(b.data[0].shape == (8, 6) for b in batches)
+    empty = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(nd.zeros((0, 6)), nd.zeros((0,))),
+        batch_size=4)
+    with pytest.raises(MXNetError, match="empty"):
+        DataLoaderIter(empty)
