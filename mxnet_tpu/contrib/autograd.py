@@ -50,8 +50,8 @@ def backward(outputs, out_grads=None, retain_graph=False):
     """ref: contrib/autograd.py backward."""
     if isinstance(outputs, NDArray):
         outputs = [outputs]
-        if isinstance(out_grads, NDArray):
-            out_grads = [out_grads]
+    if isinstance(out_grads, NDArray):
+        out_grads = [out_grads]
     _ag.backward(outputs, head_grads=out_grads,
                  retain_graph=retain_graph)
 
@@ -72,8 +72,10 @@ def grad_and_loss(func, argnum=None):
             nums = argnum if isinstance(argnum, (list, tuple)) else [argnum]
             variables = [args[i] for i in nums]
         for v in variables:
-            if v.grad is None:
-                v.attach_grad()
+            # FRESH zero gradients every invocation (the reference marks
+            # new zeros each call) — reusing a stale buffer accumulates
+            # across calls under grad_req='add'
+            v.attach_grad()
         with _ag.record():
             out = func(*args)
         backward(out)

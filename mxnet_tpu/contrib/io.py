@@ -49,13 +49,9 @@ class DataLoaderIter(DataIter):
         """Wrap-pad a short final batch to batch_size rows."""
         from ..ndarray import concat
         n = arr.shape[0]
-        reps = []
-        while n + sum(r.shape[0] for r in reps) < self.batch_size:
-            take = min(arr.shape[0],
-                       self.batch_size - n - sum(r.shape[0]
-                                                 for r in reps))
-            reps.append(arr[:take])
-        return concat(arr, *reps, dim=0) if reps else arr
+        reps = (self.batch_size - 1) // n  # ceil(batch/n) - 1 extra copies
+        out = concat(arr, *([arr] * reps), dim=0)
+        return out[:self.batch_size]
 
     def next(self):
         if not self._consumed_first:
@@ -72,6 +68,3 @@ class DataLoaderIter(DataIter):
                          pad=max(pad, 0),
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
-
-    def iter_next(self):
-        raise NotImplementedError  # next() is overridden directly

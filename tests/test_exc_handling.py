@@ -169,3 +169,26 @@ def test_barrier_completes_when_all_arrive():
         b.close()
     finally:
         server.stop()
+
+
+def test_server_profiling_commands(tmp_path, monkeypatch):
+    """Worker-commanded server profiling (ref: kvstore_dist.h:99
+    kSetProfilerParams; tests/nightly/test_server_profiling.py): a
+    profiler.set_state(profile_process='server') call must reach the
+    parameter server and flip ITS profiler."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.kvstore_server import KVServer
+    addr = f"127.0.0.1:{_free_port()}"
+    server = KVServer(addr, num_workers=1)
+    monkeypatch.setenv("MX_KV_SERVER", addr)
+    try:
+        assert not profiler.is_running()
+        profiler.set_state("run", profile_process="server")
+        # the server process (here: in-process server role) saw the
+        # command and started its profiler
+        assert profiler.is_running()
+        profiler.set_state("stop", profile_process="server")
+        assert not profiler.is_running()
+    finally:
+        profiler.set_state("stop")
+        server.stop()
