@@ -241,6 +241,43 @@ class Mixed:
         raise ValueError(f"parameter {name} did not match any pattern")
 
 
+class Load:
+    """Initialize variables from a saved .params file or dict, falling
+    back to `default_init` for unmatched names (ref: initializer.py
+    Load — drops the 'arg:'/'aux:' checkpoint prefixes)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import ndarray as nd_mod
+            param = nd_mod.load(param)
+        assert isinstance(param, dict)
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith(("arg:", "aux:")):
+                name = name[4:]
+            self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        key = str(name)
+        if key in self.param:
+            src = self.param[key]
+            assert tuple(arr.shape) == tuple(src.shape), \
+                f"Parameter {key}: shape mismatch " \
+                f"({tuple(arr.shape)} vs {tuple(src.shape)})"
+            arr[:] = src
+            if self.verbose:
+                from .base import get_logger
+                get_logger("mxnet_tpu.initializer").info(
+                    "Initialized %s by loading", key)
+        else:
+            assert self.default_init is not None, \
+                f"Cannot Initialize {key}: not found in loaded params " \
+                "and no default_init"
+            self.default_init(name, arr)
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name

@@ -365,6 +365,77 @@ class Loss(EvalMetric):
             self._inc(loss, int(onp.prod(_as_numpy(pred).shape)))
 
 
+@register("torch")
+class Torch(Loss):
+    """Dummy metric for torch criterions (ref: metric.py Torch)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register("caffe")
+class Caffe(Loss):
+    """Dummy metric for caffe criterions (ref: metric.py Caffe)."""
+
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register("pcc")
+class PCC(EvalMetric):
+    """Multiclass MCC: the discrete Pearson correlation over a KxK
+    confusion matrix (ref: metric.py PCC — eq. in its docstring; grows
+    the matrix lazily as new classes appear)."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self._cm = onp.zeros((0, 0), dtype=onp.float64)
+
+    def reset(self):
+        super().reset()
+        self._cm = onp.zeros((0, 0), dtype=onp.float64)
+
+    def _grow(self, k):
+        if k > self._cm.shape[0]:
+            cm = onp.zeros((k, k), dtype=onp.float64)
+            n = self._cm.shape[0]
+            cm[:n, :n] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            lab = _as_numpy(label).ravel().astype(onp.int64)
+            p = _as_numpy(pred)
+            cls = p.argmax(axis=-1).ravel().astype(onp.int64) \
+                if p.ndim > 1 else onp.round(p.ravel()).astype(onp.int64)
+            # drop ignore-labels / invalid negatives: python negative
+            # indexing would silently corrupt the confusion matrix
+            keep = (lab >= 0) & (cls >= 0)
+            lab, cls = lab[keep], cls[keep]
+            if lab.size == 0:
+                continue
+            k = int(max(lab.max(), cls.max())) + 1
+            self._grow(k)
+            onp.add.at(self._cm, (lab, cls), 1)
+        # PCC from the accumulated confusion matrix
+        c = self._cm
+        n = c.sum()
+        x = c.sum(axis=1)  # true-class counts
+        y = c.sum(axis=0)  # predicted-class counts
+        cov_xy = n * onp.trace(c) - x @ y
+        cov_xx = n * n - x @ x
+        cov_yy = n * n - y @ y
+        denom = onp.sqrt(cov_xx * cov_yy)
+        # nan on the degenerate matrix, like the reference: a perfect
+        # single-class sweep is UNDEFINED, not zero correlation
+        val = float(cov_xy / denom) if denom > 0 else float("nan")
+        self.sum_metric = val
+        self.global_sum_metric = val
+        self.num_inst = 1
+        self.global_num_inst = 1
+
+
 class CompositeEvalMetric(EvalMetric):
     """ref: metric.py:278."""
 

@@ -315,3 +315,61 @@ def test_leaky_relu_variants():
     g = nd.array([0.3])
     prelu = nd.LeakyReLU(x, g, act_type="prelu")
     assert prelu.asnumpy()[0, 0] == pytest.approx(-0.6, rel=1e-5)
+
+
+def test_metric_pcc_torch_caffe():
+    """ref: metric.py PCC (multiclass MCC over the confusion matrix),
+    Torch/Caffe loss metrics."""
+    import mxnet_tpu as mx
+    pcc = mx.metric.PCC()
+    # perfect multi-class prediction -> PCC == 1
+    labels = nd.array(onp.array([0, 1, 2, 1, 0], "float32"))
+    preds = nd.array(onp.eye(3, dtype="float32")[[0, 1, 2, 1, 0]])
+    pcc.update([labels], [preds])
+    assert pcc.get()[1] == pytest.approx(1.0)
+    # anti-prediction drives it negative
+    pcc.reset()
+    preds_bad = nd.array(onp.eye(3, dtype="float32")[[1, 2, 0, 2, 1]])
+    pcc.update([labels], [preds_bad])
+    assert pcc.get()[1] < 0
+    # registry + the Loss-family dummies
+    assert isinstance(mx.metric.create("pcc"), mx.metric.PCC)
+    t = mx.metric.Torch()
+    t.update(None, [nd.array([2.0, 4.0])])
+    assert t.get()[1] == pytest.approx(3.0)
+    assert mx.metric.create("caffe").name == "caffe"
+
+
+def test_initializer_load():
+    """ref: initializer.py Load — init from checkpoint dict with
+    default fallback and arg:/aux: prefix stripping."""
+    import mxnet_tpu as mx
+    saved = {"arg:fc_weight": nd.array(onp.full((2, 3), 7.0, "float32"))}
+    init = mx.initializer.Load(saved,
+                               default_init=mx.initializer.Zero())
+    w = nd.ones((2, 3))
+    init("fc_weight", w)
+    assert (w.asnumpy() == 7.0).all()
+    b = nd.ones((4,))
+    init("fc_bias", b)  # not in dict -> default Zero
+    assert (b.asnumpy() == 0.0).all()
+    with pytest.raises(AssertionError):
+        init("fc_weight", nd.ones((3, 3)))  # shape mismatch
+
+
+def test_metric_pcc_edge_cases():
+    import mxnet_tpu as mx
+    pcc = mx.metric.PCC()
+    # ignore-label -1 must not corrupt the confusion matrix
+    labels = nd.array(onp.array([0, 1, -1, 1], "float32"))
+    preds = nd.array(onp.eye(2, dtype="float32")[[0, 1, 0, 1]])
+    pcc.update([labels], [preds])
+    assert pcc.get()[1] == pytest.approx(1.0)
+    assert pcc.get_global()[1] == pytest.approx(1.0)
+    # degenerate (single-class) sweep is undefined -> nan, not 0
+    pcc.reset()
+    pcc.update([nd.zeros((4,))], [nd.array(onp.eye(2, dtype="float32")[[0, 0, 0, 0]])])
+    assert onp.isnan(pcc.get()[1])
+    # list-length mismatch raises
+    with pytest.raises(ValueError):
+        pcc.update([labels, labels], [preds])
