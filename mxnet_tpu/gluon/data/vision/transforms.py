@@ -10,7 +10,7 @@ from ...nn import Sequential, HybridSequential
 
 import jax.numpy as jnp
 
-__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+__all__ = ["CropResize", "Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
            "RandomHue", "RandomColorJitter", "RandomLighting"]
@@ -81,6 +81,44 @@ class CenterCrop(Block):
         H, W = x.shape[-3], x.shape[-2]
         y0, x0 = max((H - h) // 2, 0), max((W - w) // 2, 0)
         return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class CropResize(Block):
+    """Crop the fixed (x, y, width, height) window, then optionally
+    resize (ref: gluon/data/vision/transforms.py CropResize). Accepts
+    (H, W, C) images or (N, H, W, C) batches; out-of-bounds windows
+    raise (matching the reference's image.crop validation — silent
+    truncation would corrupt pipelines)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._x, self._y = x, y
+        self._w, self._h = width, height
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interp = interpolation
+
+    def forward(self, img):
+        H, W = img.shape[-3], img.shape[-2]
+        if self._x < 0 or self._y < 0 or self._x + self._w > W \
+                or self._y + self._h > H:
+            raise ValueError(
+                f"crop window (x={self._x}, y={self._y}, w={self._w}, "
+                f"h={self._h}) exceeds image bounds {W}x{H}")
+        out = img[..., self._y:self._y + self._h,
+                  self._x:self._x + self._w, :]
+        if self._size is not None:
+            import jax
+            import jax.numpy as jnp
+            from ....ndarray.ndarray import _wrap
+            data = out._data if hasattr(out, "_data") else jnp.asarray(out)
+            target = data.shape[:-3] + (self._size[1], self._size[0],
+                                        data.shape[-1])
+            res = jax.image.resize(data.astype(jnp.float32), target,
+                                   method="linear")
+            out = _wrap(res.astype(data.dtype)
+                        if jnp.issubdtype(data.dtype, jnp.integer)
+                        else res)
+        return out
 
 
 class RandomResizedCrop(Block):

@@ -15,7 +15,7 @@ from ..base import MXNetError
 from ..context import Context, cpu
 from ..ndarray.ndarray import NDArray, array
 
-__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+__all__ = ["shape_is_known", "split_data", "split_and_load", "clip_global_norm", "check_sha1",
            "download"]
 
 
@@ -97,3 +97,21 @@ def download(url, path=None, overwrite=False, sha1_hash=None,
         return fname
     raise MXNetError("network download is unavailable in this environment; "
                      "place the file locally and pass a file:// url")
+
+
+def shape_is_known(shape):
+    """True when every dim of `shape` is concrete (ref: gluon/utils.py
+    shape_is_known; unknown is -1 under np semantics, 0 otherwise)."""
+    if shape is None:
+        return False
+    from ..util import is_np_shape
+    unknown = -1 if is_np_shape() else 0
+    if len(shape) == 0:
+        # rank-0: known only under np semantics (ref: utils.py:433)
+        return unknown == -1
+    for dim in shape:
+        if dim == unknown:
+            return False
+        assert dim > unknown, (
+            f"shape dimension must be >= {unknown}, got {dim}")
+    return True

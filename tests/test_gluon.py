@@ -227,3 +227,19 @@ def test_trainer_adam():
     w_before = net.weight.data().asnumpy().copy()
     trainer.step(4)
     assert not onp.allclose(w_before, net.weight.data().asnumpy())
+
+
+def test_transforms_crop_resize_and_shape_is_known():
+    """ref: gluon/data/vision/transforms.py CropResize +
+    gluon/utils.py shape_is_known."""
+    from mxnet_tpu.gluon.data.vision import transforms
+    from mxnet_tpu.gluon.utils import shape_is_known
+    img = nd.array(onp.arange(20 * 24 * 3).reshape(20, 24, 3)
+                   .astype("float32"))
+    out = transforms.CropResize(2, 3, 10, 8)(img)
+    assert out.shape == (8, 10, 3)
+    assert onp.allclose(out.asnumpy(), img.asnumpy()[3:11, 2:12])
+    resized = transforms.CropResize(2, 3, 10, 8, size=(5, 4))(img)
+    assert resized.shape == (4, 5, 3)
+    assert shape_is_known((2, 3)) and not shape_is_known(None)
+    assert not shape_is_known((2, 0))
