@@ -97,10 +97,12 @@ class CropResize(Block):
         self._size = (size, size) if isinstance(size, int) else size
         self._interp = interpolation
 
+    _METHODS = {0: "nearest", 1: "linear", 2: "linear", 3: "cubic"}
+
     def forward(self, img):
         H, W = img.shape[-3], img.shape[-2]
-        if self._x < 0 or self._y < 0 or self._x + self._w > W \
-                or self._y + self._h > H:
+        if self._w <= 0 or self._h <= 0 or self._x < 0 or self._y < 0 \
+                or self._x + self._w > W or self._y + self._h > H:
             raise ValueError(
                 f"crop window (x={self._x}, y={self._y}, w={self._w}, "
                 f"h={self._h}) exceeds image bounds {W}x{H}")
@@ -109,15 +111,19 @@ class CropResize(Block):
         if self._size is not None:
             import jax
             import jax.numpy as jnp
-            from ....ndarray.ndarray import _wrap
-            data = out._data if hasattr(out, "_data") else jnp.asarray(out)
-            target = data.shape[:-3] + (self._size[1], self._size[0],
-                                        data.shape[-1])
-            res = jax.image.resize(data.astype(jnp.float32), target,
-                                   method="linear")
-            out = _wrap(res.astype(data.dtype)
-                        if jnp.issubdtype(data.dtype, jnp.integer)
-                        else res)
+            method = self._METHODS.get(self._interp, "linear")
+            tw, th = self._size
+
+            def _resize(a):
+                target = a.shape[:-3] + (th, tw, a.shape[-1])
+                res = jax.image.resize(
+                    a.astype(jnp.float32), target, method=method)
+                # crop-only path preserves dtype; the resize path must
+                # too (int images round-trip, low-precision floats are
+                # not silently promoted)
+                return res.astype(a.dtype)
+
+            out = invoke(_resize, [out])
         return out
 
 

@@ -22,7 +22,7 @@ def test_dist_async_kvstore_four_workers():
         [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
          "-n", "4", sys.executable,
          os.path.join(ROOT, "tests", "nightly", "dist_async_kvstore.py")],
-        env=env, capture_output=True, text=True, timeout=280)
+        env=env, capture_output=True, text=True, timeout=420)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"async dist test failed:\n{out[-3000:]}"
     assert out.count("DIST_ASYNC_OK") == 4, out[-3000:]
