@@ -61,6 +61,7 @@ from .ndarray.ndarray import NDArray  # noqa: F401
 
 from . import autograd  # noqa: F401
 from . import random  # noqa: F401
+from . import rnn  # noqa: F401
 from . import engine  # noqa: F401
 from . import operator  # noqa: F401
 from . import amp  # noqa: F401

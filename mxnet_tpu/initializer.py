@@ -281,6 +281,17 @@ class Load:
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name
+    if name.startswith("["):
+        # an Initializer.dumps() payload: '["name", {kwargs}]' — the
+        # form Variable(init=...) serializes into the __init__ attr
+        # (ref: initializer.py InitDesc/__init__ attr round trip)
+        import json
+        try:
+            loaded = json.loads(name)
+            return create(loaded[0],
+                          **(loaded[1] if len(loaded) > 1 else {}))
+        except (ValueError, IndexError, TypeError):
+            pass
     if name.lower() in _REG.keys():
         return _REG.get(name.lower())(**kwargs)
     raise ValueError(f"unknown initializer {name}")

@@ -1,0 +1,6 @@
+"""Legacy symbolic RNN API (ref: python/mxnet/rnn/ — cells for
+Module/BucketingModule workflows, bucketed sequence IO, cell-aware
+checkpointing)."""
+from .rnn_cell import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403

@@ -1,0 +1,54 @@
+"""RNN checkpoint helpers (ref: python/mxnet/rnn/rnn.py — cell-aware
+save/load that pack/unpack fused weights around model.checkpoint)."""
+from __future__ import annotations
+
+from .. import model as model_mod
+from ..base import get_logger
+
+__all__ = ["rnn_unroll", "save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]
+
+_log = get_logger("mxnet_tpu.rnn")
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC"):
+    """Deprecated alias of cell.unroll (ref: rnn.py rnn_unroll)."""
+    return cell.unroll(length, inputs=inputs, begin_state=begin_state,
+                       input_prefix=input_prefix, layout=layout)
+
+
+def _cells_pack(cells, args):
+    for cell in (cells if isinstance(cells, (list, tuple)) else [cells]):
+        args = cell.pack_weights(args)
+    return args
+
+
+def _cells_unpack(cells, args):
+    for cell in (cells if isinstance(cells, (list, tuple)) else [cells]):
+        args = cell.unpack_weights(args)
+    return args
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """ref: rnn.py save_rnn_checkpoint — pack cell weights, then the
+    standard checkpoint."""
+    model_mod.save_checkpoint(prefix, epoch, symbol,
+                              _cells_pack(cells, arg_params), aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """ref: rnn.py load_rnn_checkpoint."""
+    sym, arg, aux = model_mod.load_checkpoint(prefix, epoch)
+    return sym, _cells_unpack(cells, arg), aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """ref: rnn.py do_rnn_checkpoint — epoch-end callback."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback
