@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Adversarial examples via FGSM (ref: example/adversary/adversary_generation.ipynb).
+
+Trains a small classifier, then perturbs inputs along the sign of the
+input gradient (autograd.grad with respect to data, not parameters) and
+shows accuracy collapsing on the perturbed batch.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+def make_batch(rs, n, classes=4, dim=32):
+    """Learnable synthetic task: class k raises coordinates [8k:8k+8)."""
+    y = rs.randint(0, classes, n)
+    x = rs.rand(n, dim).astype("float32") * 0.3
+    for i, c in enumerate(y):
+        x[i, 8 * c:8 * c + 8] += 0.5
+    return x, y.astype("float32")
+
+
+def accuracy(net, x, y):
+    pred = net(nd.array(x)).asnumpy().argmax(axis=1)
+    return float((pred == y).mean())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epsilon", type=float, default=0.4)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rs = onp.random.RandomState(0)
+    for step in range(args.steps):
+        xb, yb = make_batch(rs, args.batch_size)
+        x, y = nd.array(xb), nd.array(yb)
+        with autograd.record():
+            loss = ce(net(x), y).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+
+    xt, yt = make_batch(rs, 256)
+    clean_acc = accuracy(net, xt, yt)
+
+    # FGSM: x_adv = x + eps * sign(dL/dx)
+    x = nd.array(xt)
+    x.attach_grad()
+    with autograd.record():
+        loss = ce(net(x), nd.array(yt)).mean()
+    loss.backward()
+    x_adv = (x + args.epsilon * nd.sign(x.grad)).asnumpy()
+    adv_acc = accuracy(net, x_adv, yt)
+
+    print(f"clean accuracy {clean_acc:.3f}, FGSM(eps={args.epsilon}) "
+          f"accuracy {adv_acc:.3f}")
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""CTC training (ref: example/ctc/ — LSTM-OCR with warp-CTC): an LSTM
+reads a longer input sequence and CTC aligns it to a shorter label
+sequence without frame-level alignment supervision.
+
+Task: the input is a sequence of one-hot symbols with repeats/blanks
+inserted; the label is the de-duplicated symbol string — exactly the
+collapse CTC models.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+def make_batch(rs, batch, T, L, vocab):
+    """Labels in 1..vocab-1 (0 is the CTC blank); inputs stretch each
+    label over a random number of frames."""
+    labels = rs.randint(1, vocab, (batch, L))
+    x = onp.zeros((batch, T, vocab), "float32")
+    for b in range(batch):
+        pos = sorted(rs.choice(onp.arange(1, T), L - 1,
+                               replace=False).tolist()) + [T]
+        start = 0
+        for li, end in enumerate(pos):
+            x[b, start:end, labels[b, li]] = 1.0
+            start = end
+    x += rs.rand(batch, T, vocab).astype("float32") * 0.1
+    return x, labels.astype("float32")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=250)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=20)
+    p.add_argument("--label-len", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=6)
+    p.add_argument("--hidden", type=int, default=48)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    net = gluon.nn.HybridSequential()
+    lstm = gluon.rnn.LSTM(args.hidden, layout="NTC")
+    head = gluon.nn.Dense(args.vocab, flatten=False)
+    net.add(lstm, head)
+    net.initialize()
+    net.hybridize()  # one XLA program per shape instead of eager dispatch
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 4e-3})
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+
+    rs = onp.random.RandomState(0)
+    first = last = None
+    for step in range(args.steps):
+        xb, yb = make_batch(rs, args.batch_size, args.seq_len,
+                            args.label_len, args.vocab)
+        x, y = nd.array(xb), nd.array(yb)
+        with autograd.record():
+            loss = ctc(net(x), y).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        v = float(loss.asscalar())
+        if first is None:
+            first = v
+        last = v
+        if step % 50 == 0:
+            print(f"step {step}: ctc loss {v:.3f}")
+    print(f"ctc loss {first:.3f} -> {last:.3f}")
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

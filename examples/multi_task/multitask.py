@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Multi-task training: one trunk, two heads, joint loss
+(ref: example/multi-task/example_multi_task.py — digit class + odd/even).
+
+Shows weighted multi-objective autograd through a shared representation
+and per-task metrics.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, metric, nd
+
+
+class MultiTaskNet(gluon.HybridBlock):
+    def __init__(self, classes=8, **kw):
+        super().__init__(**kw)
+        self.trunk = gluon.nn.HybridSequential()
+        self.trunk.add(gluon.nn.Dense(64, activation="relu"))
+        self.head_cls = gluon.nn.Dense(classes)
+        self.head_parity = gluon.nn.Dense(2)
+
+    def hybrid_forward(self, F, x):
+        h = self.trunk(x)
+        return self.head_cls(h), self.head_parity(h)
+
+
+def make_batch(rs, n, classes=8, dim=32):
+    y = rs.randint(0, classes, n)
+    x = rs.rand(n, dim).astype("float32") * 0.3
+    for i, c in enumerate(y):
+        x[i, 4 * c:4 * c + 4] += 0.5
+    return x, y.astype("float32"), (y % 2).astype("float32")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--task-weight", type=float, default=0.5)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    net = MultiTaskNet()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    m_cls, m_par = metric.Accuracy(), metric.Accuracy()
+
+    rs = onp.random.RandomState(0)
+    for step in range(args.steps):
+        xb, yc, yp = make_batch(rs, args.batch_size)
+        x = nd.array(xb)
+        with autograd.record():
+            out_c, out_p = net(x)
+            loss = (ce(out_c, nd.array(yc)).mean()
+                    + args.task_weight * ce(out_p, nd.array(yp)).mean())
+        loss.backward()
+        trainer.step(args.batch_size)
+        m_cls.update(nd.array(yc), out_c)
+        m_par.update(nd.array(yp), out_p)
+        if step % 100 == 0:
+            print(f"step {step}: loss {float(loss.asscalar()):.3f} "
+                  f"cls {m_cls.get()[1]:.3f} parity {m_par.get()[1]:.3f}")
+    acc_c, acc_p = m_cls.get()[1], m_par.get()[1]
+    print(f"final: class acc {acc_c:.3f}, parity acc {acc_p:.3f}")
+    return acc_c, acc_p
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Python-defined operator in a training graph (ref:
+example/numpy-ops/custom_softmax.py): CustomOp/CustomOpProp implement a
+numpy softmax loss-layer — forward AND backward written by the user in
+Python — registered and used from a symbolic Module like any built-in.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io.io import NDArrayIter
+from mxnet_tpu.operator import CustomOp, CustomOpProp, register
+
+
+class NumpySoftmax(CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = onp.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], nd.array(e / e.sum(axis=1,
+                                                            keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        prob = out_data[0].asnumpy()
+        label = in_data[1].asnumpy().astype("int64")
+        grad = prob.copy()
+        grad[onp.arange(len(label)), label] -= 1.0
+        self.assign(in_grad[0], req[0], nd.array(grad))
+
+
+@register("numpy_softmax")
+class NumpySoftmaxProp(CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--num-examples", type=int, default=600)
+    p.add_argument("--batch-size", type=int, default=50)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    rs = onp.random.RandomState(0)
+    y = rs.randint(0, 10, args.num_examples)
+    x = rs.rand(args.num_examples, 100).astype("float32") * 0.2
+    for i, c in enumerate(y):
+        x[i, 10 * c:10 * c + 10] += 0.6
+
+    train_iter = NDArrayIter(x, y.astype("float32"),
+                             batch_size=args.batch_size, shuffle=True,
+                             label_name="softmax_label")
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=10)
+    out = sym.Custom(fc, label, name="softmax", op_type="numpy_softmax")
+
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.fit(train_iter, num_epoch=args.epochs,
+            optimizer_params={"learning_rate": 0.3},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(train_iter, "acc")
+    print(f"custom-op softmax train accuracy: {score[0][1]:.3f}")
+    return score
+
+
+if __name__ == "__main__":
+    main()

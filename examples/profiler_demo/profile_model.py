@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Profiling a training loop (ref: example/profiler/profiler_ndarray.py /
+profiler_executor.py): set_config -> run scoped work -> dump a
+chrome://tracing JSON plus the aggregate-stats table.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd, profiler
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--out", default=None, help="trace file path")
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    trace = args.out or os.path.join(tempfile.mkdtemp(), "profile.json")
+    profiler.set_config(filename=trace, profile_all=True,
+                        aggregate_stats=True)
+    profiler.set_state("run")
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(128, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rs = onp.random.RandomState(0)
+    for step in range(args.steps):
+        x = nd.array(rs.rand(32, 64).astype("float32"))
+        y = nd.array(rs.randint(0, 10, 32).astype("float32"))
+        with profiler.scope(f"step_{step}"):
+            with autograd.record():
+                loss = ce(net(x), y).mean()
+            loss.backward()
+            trainer.step(32)
+            loss.wait_to_read()
+
+    profiler.set_state("stop")
+    profiler.dump()
+    stats = profiler.dumps(reset=False)
+    events = json.load(open(trace))
+    n_events = len(events["traceEvents"]) if isinstance(events, dict) \
+        else len(events)
+    print(f"trace: {trace} ({n_events} events)")
+    print(stats[:400])
+    return trace, n_events, stats
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Matrix factorization recommender (ref: example/recommenders/demo1-MF.ipynb,
+example/recommenders/matrix_fact.py): user/item embeddings whose dot
+product predicts ratings, trained with MSE.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+class MFNet(gluon.HybridBlock):
+    def __init__(self, n_users, n_items, k, **kw):
+        super().__init__(**kw)
+        self.user = gluon.nn.Embedding(n_users, k)
+        self.item = gluon.nn.Embedding(n_items, k)
+
+    def hybrid_forward(self, F, uid, iid):
+        return (self.user(uid) * self.item(iid)).sum(axis=1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--users", type=int, default=100)
+    p.add_argument("--items", type=int, default=80)
+    p.add_argument("--factors", type=int, default=6)
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    rs = onp.random.RandomState(0)
+    true_u = rs.randn(args.users, args.factors).astype("float32") * 0.7
+    true_i = rs.randn(args.items, args.factors).astype("float32") * 0.7
+
+    net = MFNet(args.users, args.items, args.factors)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    l2 = gluon.loss.L2Loss()
+
+    first = last = None
+    for step in range(args.steps):
+        uid = rs.randint(0, args.users, args.batch_size)
+        iid = rs.randint(0, args.items, args.batch_size)
+        rating = (true_u[uid] * true_i[iid]).sum(axis=1)
+        u, i, r = (nd.array(uid.astype("float32")),
+                   nd.array(iid.astype("float32")),
+                   nd.array(rating))
+        with autograd.record():
+            loss = l2(net(u, i), r).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        v = float(loss.asscalar())
+        if first is None:
+            first = v
+        last = v
+        if step % 100 == 0:
+            print(f"step {step}: mse {v:.4f}")
+    rmse = (2 * last) ** 0.5  # L2Loss is half-mse
+    print(f"loss {first:.4f} -> {last:.4f} (rmse {rmse:.4f})")
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

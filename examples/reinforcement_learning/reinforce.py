@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Policy-gradient RL (ref: example/reinforcement-learning/ — A3C/DQN
+family): REINFORCE on a self-contained multi-armed contextual bandit,
+no external gym dependency. The policy net maps context -> action
+logits; gradient is log-prob weighted by (reward - baseline).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--episodes", type=int, default=300)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--contexts", type=int, default=4)
+    p.add_argument("--actions", type=int, default=4)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    # bandit: in context c the best action is (c+1) % actions
+    def env_reward(ctx, act):
+        best = (ctx + 1) % args.actions
+        return (act == best).astype("float32") \
+            + 0.1 * rs.randn(len(act)).astype("float32")
+
+    policy = gluon.nn.Sequential()
+    policy.add(gluon.nn.Dense(32, activation="relu"),
+               gluon.nn.Dense(args.actions))
+    policy.initialize()
+    trainer = gluon.Trainer(policy.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+
+    rs = onp.random.RandomState(0)
+    eye = onp.eye(args.contexts, dtype="float32")
+    baseline = 0.0
+    avg_rewards = []
+    for ep in range(args.episodes):
+        ctx = rs.randint(0, args.contexts, args.batch_size)
+        obs = nd.array(eye[ctx])
+        with autograd.record():
+            logits = policy(obs)
+            logp = nd.log_softmax(logits, axis=-1)
+            # sample actions from the current policy (host-side)
+            probs = nd.softmax(logits, axis=-1).asnumpy()
+            acts = onp.array([rs.choice(args.actions, p=pr / pr.sum())
+                              for pr in probs])
+            r = env_reward(ctx, acts)
+            adv = nd.array(r - baseline)
+            act_logp = nd.pick(logp, nd.array(acts.astype("float32")),
+                               axis=1)
+            loss = -(act_logp * adv).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        baseline = 0.9 * baseline + 0.1 * float(r.mean())
+        avg_rewards.append(float(r.mean()))
+        if ep % 100 == 0:
+            print(f"episode {ep}: avg reward {avg_rewards[-1]:.3f}")
+    first = onp.mean(avg_rewards[:20])
+    final = onp.mean(avg_rewards[-20:])
+    print(f"avg reward {first:.3f} -> {final:.3f}")
+    return first, final
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""SVM output layer on digit features (ref: example/svm_mnist/svm_mnist.py):
+a Module-API net whose final layer is SVMOutput — identity forward,
+one-vs-rest hinge gradient backward (L2-SVM by default; --l1 for
+linear hinge).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.io.io import NDArrayIter
+
+
+def synthetic_digits(n, rs, classes=10, dim=784):
+    y = rs.randint(0, classes, n)
+    x = rs.rand(n, dim).astype("float32") * 0.2
+    for i, c in enumerate(y):
+        x[i, 64 * c:64 * c + 64] += 0.6
+    return x, y.astype("float32")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--num-examples", type=int, default=1000)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--l1", action="store_true", help="linear (L1) SVM")
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    rs = onp.random.RandomState(0)
+    x, y = synthetic_digits(args.num_examples, rs)
+    train_iter = NDArrayIter(x, y, batch_size=args.batch_size,
+                             shuffle=True, label_name="svm_label")
+
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=10)
+    svm = sym.SVMOutput(fc2, name="svm", margin=1.0,
+                        regularization_coefficient=1.0,
+                        use_linear=args.l1)
+
+    mod = mx.mod.Module(svm, context=mx.cpu(),
+                        label_names=("svm_label",))
+    mod.fit(train_iter, num_epoch=args.epochs,
+            optimizer_params={"learning_rate": 0.02, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(train_iter, "acc")
+    print(f"SVM ({'L1' if args.l1 else 'L2'}) train accuracy: "
+          f"{score[0][1]:.3f}")
+    return score
+
+
+if __name__ == "__main__":
+    main()

@@ -617,5 +617,29 @@ def average(a, axis=None, weights=None, returned=False):
     return _np_wrap(res._data)
 
 
+def empty(shape, dtype=None, order="C", ctx=None):
+    """XLA buffers are always defined; empty == zeros (ref
+    numpy/multiarray.py `empty` — contents unspecified there too)."""
+    return zeros(shape, dtype=dtype, order=order, ctx=ctx)
+
+
+def broadcast_arrays(*args):
+    arrs = [a if isinstance(a, NDArray) else array(a) for a in args]
+    outs = _invoke(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), arrs)
+    return [_np_wrap(o._data) for o in outs]
+
+
+def genfromtxt(fname, dtype=onp.float64, delimiter=None, skip_header=0,
+               **kwargs):
+    """Host-side text loader (ref numpy/io.py genfromtxt wraps onp)."""
+    return array(onp.genfromtxt(fname, dtype=dtype, delimiter=delimiter,
+                                skip_header=skip_header, **kwargs))
+
+
+def set_printoptions(precision=None, threshold=None, **kwargs):
+    """Printing is delegated to host numpy (ref numpy/arrayprint.py)."""
+    onp.set_printoptions(precision=precision, threshold=threshold, **kwargs)
+
+
 # linalg sub-namespace (ref: _linalg_* op family + numpy.linalg surface)
 from . import linalg  # noqa: E402,F401

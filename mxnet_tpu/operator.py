@@ -81,10 +81,14 @@ class CustomOpProp:
 
 
 def register(reg_name: str):
-    """ref: operator.py register — decorator on a CustomOpProp subclass."""
+    """ref: operator.py register — decorator on a CustomOpProp subclass.
+    Re-registering a name drops any cached jit callables for it so the
+    new class's forward/backward take effect everywhere."""
 
     def deco(prop_cls):
         _REG.register(reg_name)(prop_cls)
+        for k in [k for k in _CALLABLE_CACHE if k[0] == reg_name]:
+            del _CALLABLE_CACHE[k]
         return prop_cls
 
     return deco
@@ -100,8 +104,7 @@ def invoke_custom(op_type: str, *inputs: NDArray, **kwargs):
     dedicated callback thread: the host *is* the callback thread here)."""
     from . import autograd
 
-    prop = _REG.get(op_type)(**kwargs) if _accepts_kwargs(_REG.get(op_type)) \
-        else _REG.get(op_type)()
+    prop = _make_prop(op_type, kwargs)
     in_shapes = [list(i.shape) for i in inputs]
     in_shapes_out = prop.infer_shape(in_shapes)
     _, out_shapes, aux_shapes = in_shapes_out
@@ -111,8 +114,9 @@ def invoke_custom(op_type: str, *inputs: NDArray, **kwargs):
     op = prop.create_operator(None, in_shapes,
                               [i.dtype for i in inputs])
 
+    is_train = autograd.is_training()  # before pause() resets train mode
     with autograd.pause():
-        op.forward(is_train=autograd.is_training(),
+        op.forward(is_train=is_train,
                    req=["write"] * len(out_data), in_data=list(inputs),
                    out_data=out_data, aux=aux)
 
@@ -139,3 +143,147 @@ def _accepts_kwargs(cls):
     import inspect
     sig = inspect.signature(cls.__init__)
     return len(sig.parameters) > 1
+
+
+def _make_prop(op_type: str, kwargs):
+    cls = _REG.get(op_type)
+    return cls(**kwargs) if _accepts_kwargs(cls) else cls()
+
+
+_CALLABLE_CACHE: Dict[tuple, object] = {}
+
+
+def make_custom_callable(op_type: str, kwargs, is_train: bool = True):
+    """Build a jit-compatible callable for a registered CustomOp.
+
+    The role of the reference's dedicated callback thread
+    (src/operator/custom/custom-inl.h:76 CustomOperator::Push): the
+    user's Python forward/backward run on the host, outside the compiled
+    program, via jax.pure_callback; jax.custom_vjp routes gradients
+    through the user's backward instead of differentiating the callback.
+    One prop + operator instance is created per (shape, dtype) signature
+    (create_operator receives the matching shapes, as the reference's
+    per-executor-node construction does). Graph nodes sharing
+    (op_type, params, is_train, shapes) share an instance — an op that
+    stashes forward state on `self` must tolerate that, as callbacks
+    inside one compiled program carry no per-node identity.
+    Callables are cached per (op_type, params, is_train) so eager tape
+    replays don't rebuild prop/infer_shape/infer_type each call; the
+    cache is invalidated when the op_type is re-registered.
+    """
+    key = (op_type, tuple(sorted((k, str(v)) for k, v in kwargs.items())),
+           bool(is_train))
+    cached = _CALLABLE_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    import jax.numpy as jnp
+
+    prop = _make_prop(op_type, kwargs)
+
+    def _np(a):
+        return onp.asarray(a)
+
+    def build(example_avals):
+        in_shapes = [list(a.shape) for a in example_avals]
+        in_dtypes = [onp.dtype(a.dtype) for a in example_avals]
+        _, out_shapes, _aux_shapes = prop.infer_shape(
+            [list(s) for s in in_shapes])
+        _, out_types, aux_types = prop.infer_type(in_dtypes)
+        out_structs = [jax.ShapeDtypeStruct(tuple(s), onp.dtype(t))
+                       for s, t in zip(out_shapes, out_types)]
+        aux_shapes = [tuple(s) for s in _aux_shapes]
+        # one operator per shape signature; forward and backward of the
+        # same signature share it (state stashed on self survives fwd->bwd)
+        op_holder = {}
+
+        def _get_op():
+            if "op" not in op_holder:
+                op_holder["op"] = prop.create_operator(None, in_shapes,
+                                                       in_dtypes)
+            return op_holder["op"]
+
+        def host_forward(*xs):
+            from .ndarray.ndarray import array as _arr
+            in_data = [_arr(_np(x)) for x in xs]
+            out_data = [_arr(onp.zeros(s.shape, s.dtype))
+                        for s in out_structs]
+            aux = [_arr(onp.zeros(s, onp.dtype(t)))
+                   for s, t in zip(aux_shapes, aux_types)]
+            opi = _get_op()
+            opi.forward(is_train=is_train, req=["write"] * len(out_data),
+                        in_data=in_data, out_data=out_data, aux=aux)
+            return tuple(_np(o._data).astype(s.dtype) for o, s in
+                         zip(out_data, out_structs))
+
+        # integer inputs take float0 cotangents (jax custom_vjp contract);
+        # only inexact inputs go through the host backward
+        grad_idx = [i for i, d in enumerate(in_dtypes)
+                    if jnp.issubdtype(d, jnp.inexact)]
+
+        def host_backward(*args):
+            from .ndarray.ndarray import array as _arr
+            nx, no = len(in_shapes), len(out_structs)
+            xs, outs, gs = args[:nx], args[nx:nx + no], args[nx + no:]
+            in_data = [_arr(_np(x)) for x in xs]
+            out_data = [_arr(_np(o)) for o in outs]
+            out_grad = [_arr(_np(g)) for g in gs]
+            in_grad = [_arr(onp.zeros(tuple(s), d))
+                       for s, d in zip(in_shapes, in_dtypes)]
+            aux = [_arr(onp.zeros(s, onp.dtype(t)))
+                   for s, t in zip(aux_shapes, aux_types)]
+            opi = _get_op()
+            opi.backward(req=["write"] * len(in_grad), out_grad=out_grad,
+                         in_data=in_data, out_data=out_data,
+                         in_grad=in_grad, aux=aux)
+            return tuple(_np(in_grad[i]._data).astype(in_dtypes[i])
+                         for i in grad_idx)
+
+        @jax.custom_vjp
+        def f(*xs):
+            return jax.pure_callback(host_forward, tuple(out_structs),
+                                     *xs, vmap_method="sequential")
+
+        def f_fwd(*xs):
+            outs = jax.pure_callback(host_forward, tuple(out_structs),
+                                     *xs, vmap_method="sequential")
+            return outs, (xs, outs)
+
+        def f_bwd(res, gs):
+            xs, outs = res
+            if not grad_idx:  # no differentiable inputs at all
+                return tuple(onp.zeros(tuple(s), jax.dtypes.float0)
+                             for s in in_shapes)
+            grad_structs = tuple(
+                jax.ShapeDtypeStruct(tuple(in_shapes[i]), in_dtypes[i])
+                for i in grad_idx)
+            grads = jax.pure_callback(host_backward, grad_structs,
+                                      *xs, *outs, *gs,
+                                      vmap_method="sequential")
+            out = []
+            gi = iter(grads)
+            for i, d in enumerate(in_dtypes):
+                if i in grad_idx:
+                    out.append(next(gi))
+                else:  # float0 cotangent for integer/bool inputs
+                    out.append(onp.zeros(tuple(in_shapes[i]),
+                                         jax.dtypes.float0))
+            return tuple(out)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f
+
+    built = {}  # (shapes, dtypes) -> custom_vjp fn
+
+    def call(*arrays):
+        arrays = [a if hasattr(a, "dtype") else jnp.asarray(a)
+                  for a in arrays]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        f = built.get(sig)
+        if f is None:
+            f = built[sig] = build(arrays)
+        outs = f(*arrays)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    _CALLABLE_CACHE[key] = call
+    return call

@@ -830,27 +830,21 @@ def dgl_graph_compact(indptr, indices, data, *vids_arrays, num_args=2,
 # legacy/back-compat registrations
 # ---------------------------------------------------------------------------
 
-@register_op("Custom", n_out=-1)
-def custom(*inputs, op_type=None, **kwargs):
+@register_op("Custom", n_out=-1, needs_train=True)
+def custom(*inputs, op_type=None, _training=False, **kwargs):
     """ref: src/operator/custom/custom-inl.h — dispatch to a Python
     CustomOp registered via mxnet_tpu.operator.register.
 
-    Gradient-correct custom backward only flows through nd.Custom (which
-    records the user's backward on the tape); this raw registry path would
-    silently substitute jax.vjp of the forward, so it refuses to record."""
-    from .. import autograd
-    from ..base import MXNetError
-    if autograd.is_recording():
-        raise MXNetError(
-            "the registry-level Custom op cannot record gradients (it "
-            "would ignore the user-defined backward); call nd.Custom "
-            "inside autograd.record() instead")
-    from ..operator import invoke_custom
-    from ..ndarray.ndarray import _wrap
-    outs = invoke_custom(op_type, *[_wrap(i) for i in inputs], **kwargs)
-    if isinstance(outs, (list, tuple)):
-        return tuple(o._data for o in outs)
-    return outs._data
+    jit-compatible: the user's forward/backward run as host callbacks
+    (jax.pure_callback) and jax.custom_vjp routes the cotangents through
+    the user-defined backward — so Custom works inside symbolic
+    executors / hybridized graphs AND under the eager tape (jax.vjp of
+    this op resolves to the custom backward, never a traced-through
+    approximation). `_training` (injected by the wrapper/executor via
+    needs_train) reaches the user forward as its is_train argument."""
+    from ..operator import make_custom_callable
+    return make_custom_callable(op_type, kwargs,
+                                is_train=bool(_training))(*inputs)
 
 
 @register_op("_contrib_quantized_batch_norm", n_out=3, differentiable=False,

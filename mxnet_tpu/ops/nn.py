@@ -418,7 +418,9 @@ def _make_regression(link, grad_fn, name):
 
     op.defvjp(fwd, bwd)
 
-    @register_op(name)
+    # input_names lets the symbol layer auto-create the `<name>_label`
+    # variable (ref: regression_output.cc lists data+label inputs)
+    @register_op(name, input_names=("data", "label"))
     def reg(data, label, grad_scale=1.0):
         return op(data, label.reshape(data.shape), grad_scale)
     return reg
@@ -429,11 +431,42 @@ _make_regression(jax.nn.sigmoid, lambda o, l: o - l, "LogisticRegressionOutput")
 _make_regression(lambda x: x, lambda o, l: jnp.sign(o - l), "MAERegressionOutput")
 
 
-@register_op("SVMOutput")
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output(data, label, margin, reg, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg, use_linear, res, g):
+    # ref: src/operator/svm_output-inl.h L1_SVM/L2_SVM kernels —
+    # one-vs-rest hinge over the score matrix; true-class column k gets
+    # the pull-up gradient, every other column the push-down one.
+    out, label = res
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1],
+                            dtype=out.dtype)
+    if use_linear:  # L1-SVM
+        g_true = -(margin > out).astype(out.dtype) * reg
+        g_other = (margin > -out).astype(out.dtype) * reg
+    else:           # L2-SVM (default)
+        g_true = -2.0 * reg * jnp.maximum(0.0, margin - out)
+        g_other = 2.0 * reg * jnp.maximum(0.0, margin + out)
+    grad = onehot * g_true + (1.0 - onehot) * g_other
+    return grad, jnp.zeros_like(label)
+
+
+_svm_output.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register_op("SVMOutput", input_names=("data", "label"))
 def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                use_linear=False):
-    """ref: src/operator/svm_output.cc — forward is identity"""
-    return data
+    """ref: src/operator/svm_output.cc — forward is identity, backward is
+    the one-vs-rest hinge gradient (L2-SVM default, L1 via use_linear)."""
+    return _svm_output(data, label, float(margin),
+                       float(regularization_coefficient), bool(use_linear))
 
 
 # ---------------------------------------------------------------------------

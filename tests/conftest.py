@@ -21,6 +21,11 @@ import numpy as onp  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running example/convergence cases")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     """Seeded determinism (ref: tests/python/unittest/common.py:117

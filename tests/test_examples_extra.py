@@ -1,0 +1,115 @@
+"""Smoke tier for the round-2 example families (ref: the reference's
+example/ breadth — gan, autoencoder, adversary, sparse, recommenders,
+bi-lstm-sort, bayesian-methods, model-parallel, svm_mnist, ctc,
+numpy-ops, profiler, svrg_module, reinforcement-learning). Each runs
+end to end with tiny settings and asserts its learning signal."""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(ROOT, "examples")
+
+
+def _load(relpath):
+    path = os.path.join(EX, relpath)
+    name = "ex_" + os.path.basename(relpath)[:-3]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gan_example_moves_toward_manifold():
+    d0, d1 = _load("gan/dcgan.py").main(["--steps", "150"])
+    assert d1 < d0 * 0.8, f"generator did not improve: {d0} -> {d1}"
+
+
+def test_autoencoder_example():
+    first, last = _load("autoencoder/train_ae.py").main(["--steps", "120"])
+    assert last < first * 0.7
+
+
+def test_adversary_fgsm_example():
+    clean, adv = _load("adversary/fgsm.py").main(["--steps", "120"])
+    assert clean > 0.9 and adv < clean - 0.3
+
+
+def test_multi_task_example():
+    acc_c, acc_p = _load("multi_task/multitask.py").main(["--steps", "150"])
+    assert acc_c > 0.7 and acc_p > 0.7
+
+
+def test_recommender_matrix_fact_example():
+    first, last = _load("recommenders/matrix_fact.py").main(
+        ["--steps", "200"])
+    assert last < first * 0.8
+
+
+def test_sparse_linear_classification_example():
+    first, last, untouched = _load(
+        "sparse/linear_classification.py").main(["--epochs", "6"])
+    assert last < first * 0.5 and untouched
+
+
+def test_sgld_posterior_example():
+    est, post_mean, err = _load("bayesian_methods/sgld.py").main(
+        ["--steps", "800", "--burn-in", "200"])
+    assert err < 0.2
+
+
+def test_model_parallel_pjit_example():
+    first, last = _load("model_parallel/pjit_mlp.py").main(
+        ["--steps", "40", "--mp", "4"])
+    assert last < first * 0.1
+
+
+def test_svm_output_example_trains():
+    score = _load("svm_mnist/svm_mnist.py").main(["--epochs", "4"])
+    assert score[0][1] > 0.9
+
+
+def test_svm_l1_variant_trains():
+    score = _load("svm_mnist/svm_mnist.py").main(["--epochs", "4", "--l1"])
+    assert score[0][1] > 0.9
+
+
+def test_custom_op_example_trains():
+    score = _load("numpy_ops/custom_softmax.py").main(["--epochs", "4"])
+    assert score[0][1] > 0.9
+
+
+def test_profiler_example_emits_trace():
+    trace, n_events, stats = _load("profiler_demo/profile_model.py").main(
+        ["--steps", "3"])
+    assert os.path.exists(trace) and n_events > 0
+    assert "Time" in stats or "time" in stats
+
+
+def test_svrg_example():
+    mse = _load("svrg/svrg_train.py").main(["--epochs", "6"])
+    assert mse < 0.05
+
+
+def test_reinforce_example_improves():
+    first, final = _load("reinforcement_learning/reinforce.py").main(
+        ["--episodes", "200"])
+    assert final > first + 0.2
+
+
+@pytest.mark.slow
+def test_bi_lstm_sort_example():
+    acc = _load("bi_lstm_sort/sort_lstm.py").main(
+        ["--steps", "180", "--seq-len", "5", "--vocab", "6",
+         "--hidden", "24", "--batch-size", "24"])
+    assert acc > 0.5
+
+
+@pytest.mark.slow
+def test_ctc_example_loss_decreases():
+    first, last = _load("ctc/ctc_train.py").main(
+        ["--steps", "70", "--seq-len", "14", "--label-len", "3",
+         "--vocab", "5", "--hidden", "32", "--batch-size", "8"])
+    assert last < first * 0.85

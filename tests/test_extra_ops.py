@@ -347,3 +347,239 @@ def test_identity_attach_kl_sparse_reg():
         z = y.sum()
     z.backward()
     assert onp.isfinite(_np(x.grad)).all()
+
+
+def test_custom_op_inside_jit_uses_user_backward():
+    """Registry-level Custom lowers via pure_callback + custom_vjp, so it
+    works under jax.jit/grad AND routes cotangents through the
+    user-defined backward (ref: custom-inl.h CustomOperator::Push)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import operator
+
+    @operator.register("weird_grad_jit")
+    class WeirdProp(operator.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 3)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    # deliberately NOT d(3x)=3: proves the user backward
+                    # is used, not autodiff of the forward callback
+                    self.assign(in_grad[0], req[0], out_grad[0] * 7)
+            return Op()
+
+    from mxnet_tpu.operator import make_custom_callable
+    f = make_custom_callable("weird_grad_jit", {})
+
+    x = jnp.asarray([1.0, 2.0], jnp.float32)
+    out = jax.jit(lambda v: f(v))(x)
+    assert onp.allclose(onp.asarray(out), [3.0, 6.0])
+    g = jax.grad(lambda v: jnp.sum(f(v)))(x)
+    assert onp.allclose(onp.asarray(g), [7.0, 7.0])
+
+
+def test_custom_op_in_symbolic_module_trains():
+    """sym.Custom inside a jitted symbolic executor: forward matches the
+    host computation and the backward updates weights."""
+    from mxnet_tpu import sym
+    import mxnet_tpu as mx
+    from mxnet_tpu.io.io import NDArrayIter
+    from mxnet_tpu import operator
+
+    @operator.register("np_softmax_symbolic")
+    class Prop(operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0].asnumpy()
+                    e = onp.exp(x - x.max(axis=1, keepdims=True))
+                    self.assign(out_data[0], req[0],
+                                nd.array(e / e.sum(axis=1, keepdims=True)))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    prob = out_data[0].asnumpy()
+                    lab = in_data[1].asnumpy().astype("int64")
+                    grad = prob.copy()
+                    grad[onp.arange(len(lab)), lab] -= 1.0
+                    self.assign(in_grad[0], req[0], nd.array(grad))
+            return Op()
+
+    rs = onp.random.RandomState(0)
+    y = rs.randint(0, 4, 120)
+    x = rs.rand(120, 16).astype("float32") * 0.2
+    for i, c in enumerate(y):
+        x[i, 4 * c:4 * c + 4] += 0.7
+    it = NDArrayIter(x, y.astype("float32"), batch_size=30, shuffle=True,
+                     label_name="softmax_label")
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    out = sym.Custom(fc, label, name="softmax",
+                     op_type="np_softmax_symbolic")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.fit(it, num_epoch=4, optimizer_params={"learning_rate": 0.3},
+            initializer=mx.initializer.Xavier())
+    assert mod.score(it, "acc")[0][1] > 0.9
+
+
+def test_svm_output_gradients():
+    """SVMOutput: identity forward; backward is the one-vs-rest hinge
+    gradient (ref: svm_output-inl.h L1_SVM/L2_SVM kernels)."""
+    from mxnet_tpu import autograd
+
+    scores = onp.array([[0.5, -0.2, 2.0],
+                        [-1.5, 0.1, 0.3]], "float32")
+    labels = onp.array([0, 1], "float32")
+
+    # L2-SVM (default): true col -2*max(0, m - s), other +2*max(0, m + s)
+    x = nd.array(scores)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.SVMOutput(x, nd.array(labels))
+        y.backward(nd.ones(y.shape))
+    assert onp.allclose(_np(y), scores)  # identity forward
+    g = _np(x.grad)
+    m = 1.0
+    exp = onp.zeros_like(scores)
+    for r, k in enumerate(labels.astype(int)):
+        for c in range(3):
+            s = scores[r, c]
+            if c == k:
+                exp[r, c] = -2 * max(0.0, m - s)
+            else:
+                exp[r, c] = 2 * max(0.0, m + s)
+    assert onp.allclose(g, exp, atol=1e-5), (g, exp)
+
+    # L1-SVM: true col -1[m > s], other +1[m > -s]
+    x2 = nd.array(scores)
+    x2.attach_grad()
+    with autograd.record():
+        y2 = nd.SVMOutput(x2, nd.array(labels), use_linear=True)
+        y2.backward(nd.ones(y2.shape))
+    g1 = _np(x2.grad)
+    exp1 = onp.zeros_like(scores)
+    for r, k in enumerate(labels.astype(int)):
+        for c in range(3):
+            s = scores[r, c]
+            exp1[r, c] = (-float(m > s)) if c == k else float(m > -s)
+    assert onp.allclose(g1, exp1, atol=1e-5), (g1, exp1)
+
+
+def test_custom_op_receives_is_train_flag():
+    """The executor's train/eval mode reaches CustomOp.forward's
+    is_train argument through the needs_train injection."""
+    from mxnet_tpu import operator, autograd
+
+    seen = []
+
+    @operator.register("train_flag_probe")
+    class Prop(operator.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    seen.append(bool(is_train))
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+            return Op()
+
+    x = nd.ones((2,))
+    nd.Custom(x, op_type="train_flag_probe").asnumpy()
+    assert seen[-1] is False  # inference mode by default
+    with autograd.record():
+        nd.Custom(x, op_type="train_flag_probe").asnumpy()
+    assert seen[-1] is True  # record() implies train mode
+
+
+def test_custom_op_jit_integer_input_and_shape_reuse():
+    """float0 cotangents for integer inputs; one operator instance per
+    shape signature (different shapes don't reuse a stale instance)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import operator
+
+    created = []
+
+    @operator.register("int_label_jit")
+    class Prop(operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def infer_shape(self, in_shape):
+            created.append(tuple(in_shape[0]))
+            return [in_shape[0], in_shape[1]], [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 2)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 2)
+                    # in_grad[1] (int label) intentionally untouched
+            return Op()
+
+    from mxnet_tpu.operator import make_custom_callable
+    f = make_custom_callable("int_label_jit", {})
+
+    x = jnp.asarray([[1.0, 2.0]], jnp.float32)
+    lab = jnp.asarray([3], jnp.int32)
+    # grad through jit with an integer input must not raise
+    g = jax.grad(lambda v: jnp.sum(f(v, lab)))(x)
+    assert onp.allclose(onp.asarray(g), 2.0)
+    # a second shape builds a fresh operator (per-signature instance)
+    x2 = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], jnp.float32)
+    lab2 = jnp.asarray([0, 1, 2], jnp.int32)
+    out2 = f(x2, lab2)
+    assert out2.shape == (3, 2)
+
+
+def test_custom_op_reregister_invalidates_jit_cache():
+    import jax.numpy as jnp
+
+    from mxnet_tpu import operator
+    from mxnet_tpu.operator import make_custom_callable
+
+    def make(scale):
+        @operator.register("reregister_probe")
+        class Prop(operator.CustomOpProp):
+            def create_operator(self, ctx, shapes, dtypes):
+                class Op(operator.CustomOp):
+                    def forward(self, is_train, req, in_data, out_data,
+                                aux):
+                        self.assign(out_data[0], req[0],
+                                    in_data[0] * scale)
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        self.assign(in_grad[0], req[0], out_grad[0])
+                return Op()
+
+    make(2.0)
+    f1 = make_custom_callable("reregister_probe", {})
+    x = jnp.asarray([1.0], jnp.float32)
+    assert float(onp.asarray(f1(x))[0]) == 2.0
+    make(5.0)  # redefinition must invalidate the cached callable
+    f2 = make_custom_callable("reregister_probe", {})
+    assert float(onp.asarray(f2(x))[0]) == 5.0
