@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""CNN text classification (ref: example/cnn_text_classification/ —
+Kim-style CNN): token embeddings -> parallel Conv1D banks with several
+kernel widths -> max-over-time pooling -> dense classifier.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+class TextCNN(gluon.HybridBlock):
+    def __init__(self, vocab, embed, widths=(2, 3, 4), channels=16,
+                 classes=2, **kw):
+        super().__init__(**kw)
+        self.embed = gluon.nn.Embedding(vocab, embed)
+        self.convs = []
+        for i, w in enumerate(widths):
+            conv = gluon.nn.Conv1D(channels, w, activation="relu")
+            setattr(self, f"conv{i}", conv)
+            self.convs.append(conv)
+        self.pool = gluon.nn.GlobalMaxPool1D()
+        self.out = gluon.nn.Dense(classes)
+
+    def hybrid_forward(self, F, tokens):
+        e = self.embed(tokens).transpose((0, 2, 1))  # NCW for Conv1D
+        feats = [self.pool(c(e)).flatten() for c in self.convs]
+        return self.out(F.concat(*feats, dim=1))
+
+
+def make_batch(rs, n, T, vocab, classes):
+    """Class k is marked by the presence of keyword token k+1 somewhere
+    in the sequence (the bag-of-ngrams signal a TextCNN pools out)."""
+    y = rs.randint(0, classes, n)
+    x = rs.randint(classes + 1, vocab, (n, T))
+    pos = rs.randint(0, T, n)
+    for i in range(n):
+        x[i, pos[i]] = y[i] + 1
+    return x.astype("float32"), y.astype("float32")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=24)
+    p.add_argument("--vocab", type=int, default=100)
+    p.add_argument("--classes", type=int, default=4)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    net = TextCNN(args.vocab, 32, classes=args.classes)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rs = onp.random.RandomState(0)
+    acc = 0.0
+    for step in range(args.steps):
+        xb, yb = make_batch(rs, args.batch_size, args.seq_len,
+                            args.vocab, args.classes)
+        x, y = nd.array(xb), nd.array(yb)
+        with autograd.record():
+            out = net(x)
+            loss = ce(out, y).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 50 == 0 or step == args.steps - 1:
+            acc = float((out.asnumpy().argmax(1) == yb).mean())
+            print(f"step {step}: loss {float(loss.asscalar()):.3f} "
+                  f"acc {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Neural style transfer (ref: example/neural-style/): optimize the
+*pixels* of an image so its CNN features match a content image and its
+gram matrices match a style image. The distinctive capability is
+gradient descent on the input tensor itself (attach_grad on data, an
+optimizer stepping pixels, the network frozen).
+
+Uses a fixed random conv feature extractor (no pretrained weights in
+this environment); random projections still define meaningful content/
+style distances for the demonstration.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.optimizer import create, get_updater
+
+
+class FeatureNet(gluon.Block):
+    """Small conv stack returning features at two depths."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.c1 = gluon.nn.Conv2D(16, 3, padding=1, activation="relu")
+        self.c2 = gluon.nn.Conv2D(32, 3, strides=2, padding=1,
+                                  activation="relu")
+        self.c3 = gluon.nn.Conv2D(32, 3, padding=1, activation="relu")
+
+    def forward(self, x):
+        f1 = self.c1(x)
+        f2 = self.c3(self.c2(f1))
+        return f1, f2
+
+
+def gram(f):
+    b, c, h, w = f.shape
+    m = f.reshape((b, c, h * w))
+    return nd.batch_dot(m, m.transpose((0, 2, 1))) / (c * h * w)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--style-weight", type=float, default=50.0)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    rs = onp.random.RandomState(0)
+    S = args.size
+    content = onp.zeros((1, 3, S, S), "float32")
+    content[:, :, S // 4:3 * S // 4, S // 4:3 * S // 4] = 0.8  # a square
+    style = onp.tile(onp.sin(onp.arange(S) * 0.8)[None, None, None, :],
+                     (1, 3, S, 1)).astype("float32") * 0.5 + 0.5  # stripes
+
+    net = FeatureNet()
+    net.initialize()
+    c_feats = net(nd.array(content))
+    s_grams = [gram(f) for f in net(nd.array(style))]
+
+    img = nd.array(rs.rand(1, 3, S, S).astype("float32"))
+    img.attach_grad()
+    opt = create("adam", learning_rate=args.lr)
+    upd = get_updater(opt)
+
+    first = last = None
+    for step in range(args.steps):
+        with autograd.record():
+            feats = net(img)
+            content_loss = nd.mean(nd.square(feats[1] - c_feats[1]))
+            style_loss = sum(nd.mean(nd.square(gram(f) - g))
+                             for f, g in zip(feats, s_grams))
+            loss = content_loss + args.style_weight * style_loss
+        loss.backward()
+        upd(0, img.grad, img)  # optimizer steps the PIXELS
+        v = float(loss.asscalar())
+        if first is None:
+            first = v
+        last = v
+        if step % 40 == 0:
+            print(f"step {step}: total {v:.4f} "
+                  f"(content {float(content_loss.asscalar()):.4f})")
+    print(f"style-transfer objective {first:.4f} -> {last:.4f}")
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Stochastic depth (ref: example/stochastic-depth/sd_cifar10.py):
+residual blocks are randomly skipped during training (identity passes
+through) and scaled by their survival probability at inference —
+train-time regularization that needs mode-dependent block behavior.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+class StochasticResBlock(gluon.Block):
+    """Residual block skipped with prob (1 - survival) in train mode."""
+
+    def __init__(self, channels, survival, **kw):
+        super().__init__(**kw)
+        self.survival = survival
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(
+            gluon.nn.Conv2D(channels, 3, padding=1, activation="relu"),
+            gluon.nn.Conv2D(channels, 3, padding=1))
+        self.skipped = 0
+        self.total = 0
+
+    def forward(self, x):
+        if autograd.is_training():
+            self.total += 1
+            if onp.random.rand() > self.survival:
+                self.skipped += 1
+                return x  # block dropped: pure identity
+            return nd.relu(x + self.body(x))
+        # inference: expected value — residual scaled by survival prob
+        return nd.relu(x + self.survival * self.body(x))
+
+
+class SDNet(gluon.Block):
+    def __init__(self, blocks=4, channels=8, classes=4, p_last=0.5, **kw):
+        super().__init__(**kw)
+        self.stem = gluon.nn.Conv2D(channels, 3, padding=1,
+                                    activation="relu")
+        self.blocks = []
+        for i in range(blocks):
+            # linearly decaying survival (deeper blocks die more often)
+            surv = 1.0 - (i + 1) / blocks * (1.0 - p_last)
+            blk = StochasticResBlock(channels, surv)
+            setattr(self, f"block{i}", blk)
+            self.blocks.append(blk)
+        self.head = gluon.nn.Sequential()
+        self.head.add(gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+                      gluon.nn.Dense(classes))
+
+    def forward(self, x):
+        h = self.stem(x)
+        for b in self.blocks:
+            h = b(h)
+        return self.head(h)
+
+
+def make_batch(rs, n, classes=4, S=16):
+    y = rs.randint(0, classes, n)
+    x = rs.rand(n, 3, S, S).astype("float32") * 0.3
+    for i, c in enumerate(y):
+        x[i, :, (c * S // classes):(c * S // classes) + 3, :] += 0.5
+    return x, y.astype("float32")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    net = SDNet()
+    net.initialize(init="xavier")
+    # one inference-mode pass runs every block (no stochastic skipping)
+    # so deferred shapes resolve before blocks start dropping out
+    net(nd.zeros((1, 3, 16, 16)))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rs = onp.random.RandomState(0)
+    onp.random.seed(0)
+    for step in range(args.steps):
+        xb, yb = make_batch(rs, args.batch_size)
+        x, y = nd.array(xb), nd.array(yb)
+        with autograd.record():
+            loss = ce(net(x), y).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 50 == 0:
+            print(f"step {step}: loss {float(loss.asscalar()):.3f}")
+
+    skipped = sum(b.skipped for b in net.blocks)
+    total = sum(b.total for b in net.blocks)
+    xt, yt = make_batch(rs, 256)
+    acc = float((net(nd.array(xt)).asnumpy().argmax(1) == yt).mean())
+    print(f"eval acc {acc:.3f}; blocks skipped {skipped}/{total} "
+          f"during training")
+    return acc, skipped, total
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,15 @@ def invoke_custom(op_type: str, *inputs: NDArray, **kwargs):
                    req=["write"] * len(out_data), in_data=list(inputs),
                    out_data=out_data, aux=aux)
 
+    # an op may assign an input straight through to an output; the tape
+    # keys gradients by buffer id, so alias the same guard invoke() uses
+    # (a copy) or the head cotangent double-counts onto the input
+    import jax.numpy as _jnp
+    in_ids = {id(i._data) for i in inputs}
+    for o in out_data:
+        if id(o._data) in in_ids:
+            o._rebind(_jnp.copy(o._data))
+
     if autograd.is_recording():
         tape = autograd.current_tape()
 
@@ -192,25 +201,33 @@ def make_custom_callable(op_type: str, kwargs, is_train: bool = True):
         _, out_types, aux_types = prop.infer_type(in_dtypes)
         out_structs = [jax.ShapeDtypeStruct(tuple(s), onp.dtype(t))
                        for s, t in zip(out_shapes, out_types)]
+        # aux count comes from infer_shape (the eager path's source of
+        # truth); infer_type's aux list may be shorter when the prop
+        # keeps the default list_auxiliary_states — pad with float32
         aux_shapes = [tuple(s) for s in _aux_shapes]
+        aux_types = list(aux_types) + [onp.float32] * (len(aux_shapes)
+                                                       - len(aux_types))
         # one operator per shape signature; forward and backward of the
-        # same signature share it (state stashed on self survives fwd->bwd)
+        # same signature share it AND its aux arrays (state written by
+        # forward must be visible to backward, like the eager path)
         op_holder = {}
 
         def _get_op():
             if "op" not in op_holder:
+                from .ndarray.ndarray import array as _arr
                 op_holder["op"] = prop.create_operator(None, in_shapes,
                                                        in_dtypes)
-            return op_holder["op"]
+                op_holder["aux"] = [
+                    _arr(onp.zeros(s, onp.dtype(t)))
+                    for s, t in zip(aux_shapes, aux_types)]
+            return op_holder["op"], op_holder["aux"]
 
         def host_forward(*xs):
             from .ndarray.ndarray import array as _arr
             in_data = [_arr(_np(x)) for x in xs]
             out_data = [_arr(onp.zeros(s.shape, s.dtype))
                         for s in out_structs]
-            aux = [_arr(onp.zeros(s, onp.dtype(t)))
-                   for s, t in zip(aux_shapes, aux_types)]
-            opi = _get_op()
+            opi, aux = _get_op()
             opi.forward(is_train=is_train, req=["write"] * len(out_data),
                         in_data=in_data, out_data=out_data, aux=aux)
             return tuple(_np(o._data).astype(s.dtype) for o, s in
@@ -230,9 +247,7 @@ def make_custom_callable(op_type: str, kwargs, is_train: bool = True):
             out_grad = [_arr(_np(g)) for g in gs]
             in_grad = [_arr(onp.zeros(tuple(s), d))
                        for s, d in zip(in_shapes, in_dtypes)]
-            aux = [_arr(onp.zeros(s, onp.dtype(t)))
-                   for s, t in zip(aux_shapes, aux_types)]
-            opi = _get_op()
+            opi, aux = _get_op()  # same aux arrays forward wrote into
             opi.backward(req=["write"] * len(in_grad), out_grad=out_grad,
                          in_data=in_data, out_data=out_data,
                          in_grad=in_grad, aux=aux)

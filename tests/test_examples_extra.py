@@ -113,3 +113,33 @@ def test_ctc_example_loss_decreases():
         ["--steps", "70", "--seq-len", "14", "--label-len", "3",
          "--vocab", "5", "--hidden", "32", "--batch-size", "8"])
     assert last < first * 0.85
+
+
+def test_text_cnn_example():
+    acc = _load("cnn_text_classification/text_cnn.py").main(
+        ["--steps", "100"])
+    assert acc > 0.8
+
+
+def test_nce_loss_example():
+    acc = _load("nce_loss/nce_lm.py").main(["--steps", "300"])
+    assert acc > 0.5  # untrained top-1 is 1/200
+
+
+def test_stochastic_depth_example():
+    acc, skipped, total = _load("stochastic_depth/sd_resnet.py").main(
+        ["--steps", "150"])
+    assert skipped > 0, "no blocks were ever dropped in train mode"
+    assert acc > 0.45  # 4-way chance is 0.25
+
+
+def test_neural_style_example_optimizes_pixels():
+    first, last = _load("neural_style/neural_style.py").main(
+        ["--steps", "60"])
+    assert last < first * 0.3
+
+
+def test_dsd_example_mask_holds():
+    acc_d, acc_s, acc_r = _load("dsd/dsd_train.py").main(
+        ["--phase-steps", "80"])
+    assert acc_s > 0.8 and acc_r > 0.8  # survives 70% pruning

@@ -583,3 +583,95 @@ def test_custom_op_reregister_invalidates_jit_cache():
     make(5.0)  # redefinition must invalidate the cached callable
     f2 = make_custom_callable("reregister_probe", {})
     assert float(onp.asarray(f2(x))[0]) == 5.0
+
+
+def test_custom_op_aux_state_forward_to_backward_jit():
+    """Aux values written by forward must be visible to backward in the
+    jit path, matching eager semantics."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import operator
+
+    @operator.register("aux_carry_probe")
+    class Prop(operator.CustomOpProp):
+        def list_auxiliary_states(self):
+            return ["stash"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], [[1]]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0])
+                    self.assign(aux[0], "write", nd.array(
+                        onp.array([42.0], "float32")))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                out_grad[0] * aux[0].asnumpy()[0])
+            return Op()
+
+    from mxnet_tpu.operator import make_custom_callable
+    f = make_custom_callable("aux_carry_probe", {})
+    x = jnp.asarray([1.0, 2.0], jnp.float32)
+    g = jax.grad(lambda v: jnp.sum(f(v)))(x)
+    assert onp.allclose(onp.asarray(g), 42.0), onp.asarray(g)
+
+
+def test_custom_op_aux_shapes_without_list_aux_states_jit():
+    """aux sizing follows infer_shape even when list_auxiliary_states
+    keeps its default empty list (eager path behavior)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import operator
+
+    @operator.register("aux_default_list_probe")
+    class Prop(operator.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], [[2]]  # aux declared here only
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    assert len(aux) == 1 and aux[0].shape == (2,)
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+            return Op()
+
+    from mxnet_tpu.operator import make_custom_callable
+    f = make_custom_callable("aux_default_list_probe", {})
+    out = f(jnp.asarray([1.0], jnp.float32))
+    assert float(onp.asarray(out)[0]) == 1.0
+
+
+def test_custom_op_eager_identity_passthrough_grad():
+    """A forward that assigns an input through to the output must not
+    double-count the head cotangent onto the input (tape id-aliasing)."""
+    from mxnet_tpu import autograd, operator
+
+    @operator.register("identity_fwd_weird_bwd")
+    class Prop(operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 42)
+            return Op()
+
+    x = nd.array(onp.array([1.0, 2.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="identity_fwd_weird_bwd")
+        y = y[0] if isinstance(y, (list, tuple)) else y
+    y.backward(nd.ones(y.shape))
+    g = _np(x.grad)
+    assert onp.allclose(g, 42.0), f"expected 42 (user backward only), got {g}"
