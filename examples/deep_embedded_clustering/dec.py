@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Deep Embedded Clustering (ref: example/deep-embedded-clustering/dec.py):
+pretrain an autoencoder, initialize cluster centroids (k-means-style)
+in the latent space, then refine encoder + centroids by minimizing KL
+between the soft assignment q and its sharpened target p.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+def soft_assign(z, mu, alpha=1.0):
+    """Student-t similarity q_ij (DEC eq. 1)."""
+    d2 = nd.sum(nd.square(z.expand_dims(1) - mu.expand_dims(0)), axis=2)
+    q = (1.0 + d2 / alpha) ** (-(alpha + 1.0) / 2.0)
+    return q / nd.sum(q, axis=1, keepdims=True)
+
+
+def target_dist(q):
+    """Sharpened target p (DEC eq. 3) — computed without gradients."""
+    w = q ** 2 / q.sum(axis=0, keepdims=True)
+    return w / w.sum(axis=1, keepdims=True)
+
+
+def cluster_acc(assign, labels, k):
+    """Best-map accuracy via greedy majority vote per cluster."""
+    total = 0
+    for c in range(k):
+        members = labels[assign == c]
+        if len(members):
+            total += int(onp.bincount(members).max())
+    return total / len(labels)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=480)
+    p.add_argument("--clusters", type=int, default=3)
+    p.add_argument("--latent", type=int, default=4)
+    p.add_argument("--pretrain-steps", type=int, default=200)
+    p.add_argument("--dec-steps", type=int, default=100)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    rs = onp.random.RandomState(0)
+    K = args.clusters
+    # well-separated Gaussian blobs embedded in 32-D
+    centers = rs.randn(K, 32).astype("float32") * 3.0
+    labels = rs.randint(0, K, args.n)
+    data = (centers[labels]
+            + rs.randn(args.n, 32).astype("float32") * 0.4)
+
+    enc = gluon.nn.HybridSequential()
+    enc.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(args.latent))
+    dec_net = gluon.nn.HybridSequential()
+    dec_net.add(gluon.nn.Dense(16, activation="relu"),
+                gluon.nn.Dense(32))
+    ae = gluon.nn.HybridSequential()
+    ae.add(enc, dec_net)
+    ae.initialize()
+    l2 = gluon.loss.L2Loss()
+    tr_ae = gluon.Trainer(ae.collect_params(), "adam",
+                          {"learning_rate": 2e-3})
+
+    # phase 1: autoencoder pretraining
+    X = nd.array(data)
+    for step in range(args.pretrain_steps):
+        with autograd.record():
+            loss = l2(ae(X), X).mean()
+        loss.backward()
+        tr_ae.step(args.n)
+
+    # centroid init: pick K latent points far apart (k-means++-style)
+    Z = enc(X).asnumpy()
+    idx = [int(rs.randint(args.n))]
+    for _ in range(K - 1):
+        d = onp.min([onp.linalg.norm(Z - Z[i], axis=1) for i in idx],
+                    axis=0)
+        idx.append(int(d.argmax()))
+    mu = nd.array(Z[idx].copy())
+    mu.attach_grad()
+
+    # phase 2: KL(q||p) refinement of encoder + centroids
+    from mxnet_tpu.optimizer import create, get_updater
+    upd = get_updater(create("adam", learning_rate=2e-3))
+    tr_enc = gluon.Trainer(enc.collect_params(), "adam",
+                           {"learning_rate": 2e-3})
+    for step in range(args.dec_steps):
+        with autograd.pause():
+            pt = target_dist(soft_assign(enc(X), mu))
+        with autograd.record():
+            q = soft_assign(enc(X), mu)
+            kl = nd.sum(pt * (nd.log(pt + 1e-10) - nd.log(q + 1e-10))) \
+                / args.n
+        kl.backward()
+        tr_enc.step(args.n)
+        upd(0, mu.grad, mu)
+        if step % 50 == 0:
+            print(f"dec step {step}: KL {float(kl.asscalar()):.4f}")
+
+    assign = soft_assign(enc(X), mu).asnumpy().argmax(axis=1)
+    acc = cluster_acc(assign, labels, K)
+    print(f"cluster accuracy (best-map): {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

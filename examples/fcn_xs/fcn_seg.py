@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Fully-convolutional segmentation (ref: example/fcn-xs/ — FCN-32s/16s/8s):
+conv encoder -> Conv2DTranspose upsampling decoder with a skip
+connection, trained with per-pixel softmax cross-entropy. Exercises
+Deconvolution and pixelwise losses end to end.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+class FCN(gluon.HybridBlock):
+    def __init__(self, classes, **kw):
+        super().__init__(**kw)
+        self.c1 = gluon.nn.Conv2D(16, 3, padding=1, activation="relu")
+        self.p1 = gluon.nn.MaxPool2D(2, 2)
+        self.c2 = gluon.nn.Conv2D(32, 3, padding=1, activation="relu")
+        self.p2 = gluon.nn.MaxPool2D(2, 2)
+        self.score = gluon.nn.Conv2D(classes, 1)
+        self.up2 = gluon.nn.Conv2DTranspose(classes, 4, strides=2,
+                                            padding=1)
+        self.skip_score = gluon.nn.Conv2D(classes, 1)
+        self.up_final = gluon.nn.Conv2DTranspose(classes, 4, strides=2,
+                                                 padding=1)
+
+    def hybrid_forward(self, F, x):
+        f1 = self.p1(self.c1(x))            # /2
+        f2 = self.p2(self.c2(f1))           # /4
+        s = self.up2(self.score(f2))        # back to /2
+        s = s + self.skip_score(f1)         # FCN-16s-style skip fusion
+        return self.up_final(s)             # full res (N, C, H, W)
+
+
+def make_batch(rs, n, classes=3, S=24):
+    """Each image: background plus one class-colored square; the mask
+    labels its pixels with the class id."""
+    x = rs.rand(n, 3, S, S).astype("float32") * 0.2
+    m = onp.zeros((n, S, S), "int64")
+    for i in range(n):
+        c = rs.randint(1, classes)
+        r0, c0 = rs.randint(2, S - 10, 2)
+        x[i, c - 1, r0:r0 + 8, c0:c0 + 8] += 0.7
+        m[i, r0:r0 + 8, c0:c0 + 8] = c
+    return x, m.astype("float32")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    net = FCN(args.classes)
+    net.initialize(init="xavier")
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    # per-pixel CE: axis=1 is the class channel of (N, C, H, W)
+    ce = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+
+    rs = onp.random.RandomState(0)
+    miou = 0.0
+    for step in range(args.steps):
+        xb, mb = make_batch(rs, args.batch_size, args.classes)
+        x, m = nd.array(xb), nd.array(mb)
+        with autograd.record():
+            out = net(x)
+            loss = ce(out, m).mean()
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 50 == 0 or step == args.steps - 1:
+            pred = out.asnumpy().argmax(axis=1)
+            inter = ((pred == mb) & (mb > 0)).sum()
+            union = ((pred > 0) | (mb > 0)).sum()
+            miou = float(inter / max(union, 1))
+            pix = float((pred == mb).mean())
+            print(f"step {step}: loss {float(loss.asscalar()):.3f} "
+                  f"pixel-acc {pix:.3f} fg-IoU {miou:.3f}")
+    return miou
+
+
+if __name__ == "__main__":
+    main()

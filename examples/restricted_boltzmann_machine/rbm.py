@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Restricted Boltzmann Machine (ref:
+example/restricted-boltzmann-machine/): binary RBM trained with CD-1
+(contrastive divergence) — Gibbs sampling with manually computed
+positive/negative phase statistics, no autograd (the update IS the
+learning rule).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import nd
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--visible", type=int, default=36)
+    p.add_argument("--hidden", type=int, default=24)
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    rs = onp.random.RandomState(0)
+    V, H, B = args.visible, args.hidden, args.batch_size
+
+    # data: two prototype binary patterns + bit noise
+    protos = (rs.rand(4, V) < 0.5).astype("float32")
+
+    def batch():
+        k = rs.randint(0, len(protos), B)
+        x = protos[k].copy()
+        flip = rs.rand(B, V) < 0.05
+        x[flip] = 1 - x[flip]
+        return nd.array(x)
+
+    W = nd.array(rs.randn(V, H).astype("float32") * 0.05)
+    bv = nd.zeros((V,))
+    bh = nd.zeros((H,))
+
+    def sigmoid(x):
+        return 1.0 / (1.0 + nd.exp(-x))
+
+    def sample(pr):
+        return nd.array((rs.rand(*pr.shape) <
+                         pr.asnumpy()).astype("float32"))
+
+    first = last = None
+    for step in range(args.steps):
+        v0 = batch()
+        # positive phase
+        ph0 = sigmoid(nd.dot(v0, W) + bh)
+        h0 = sample(ph0)
+        # negative phase (one Gibbs step: CD-1)
+        pv1 = sigmoid(nd.dot(h0, W.T) + bv)
+        v1 = sample(pv1)
+        ph1 = sigmoid(nd.dot(v1, W) + bh)
+        # CD-1 update rule
+        W += args.lr / B * (nd.dot(v0.T, ph0) - nd.dot(v1.T, ph1))
+        bv += args.lr * nd.mean(v0 - v1, axis=0)
+        bh += args.lr * nd.mean(ph0 - ph1, axis=0)
+
+        recon_err = float(nd.mean(nd.square(v0 - pv1)).asscalar())
+        if first is None:
+            first = recon_err
+        last = recon_err
+        if step % 100 == 0:
+            print(f"step {step}: reconstruction error {recon_err:.4f}")
+
+    print(f"reconstruction error {first:.4f} -> {last:.4f}")
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

@@ -372,6 +372,19 @@ class Function:
         outs = [outputs] if single else list(outputs)
 
         if is_recording():
+            # passthrough forwards may return an input NDArray (or alias
+            # one buffer across outputs); tape grads are keyed by buffer
+            # id, so aliased outputs are re-wrapped around a copied
+            # buffer (NOT rebound in place — the output may BE the input
+            # object) or the head cotangent double-counts (same guard
+            # as invoke())
+            import jax.numpy as _jnp
+            seen = {id(i._data) for i in inputs}
+            for k, o in enumerate(outs):
+                if isinstance(o, NDArray):
+                    if id(o._data) in seen:
+                        o = outs[k] = _wrap(_jnp.copy(o._data))
+                    seen.add(id(o._data))
             tape = current_tape()
 
             def custom_backward(cotangents, _self=self, _inputs=inputs):

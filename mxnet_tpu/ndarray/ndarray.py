@@ -110,10 +110,17 @@ def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
         autograd.set_recording(was_recording)
     outs = list(out) if isinstance(out, (tuple, list)) else [out]
     if autograd.is_recording():
-        # identity-like ops may return the input buffer itself; give such
-        # outputs a fresh identity so tape grad-keying (by id) stays sound
-        in_ids = {id(a) for a in in_arrays}
-        outs = [jnp.copy(o) if id(o) in in_ids else o for o in outs]
+        # identity-like ops may return the input buffer itself (or one
+        # buffer for several outputs); give such outputs a fresh identity
+        # so tape grad-keying (by id) stays sound
+        seen = {id(a) for a in in_arrays}
+        deal = []
+        for o in outs:
+            if id(o) in seen:
+                o = jnp.copy(o)
+            seen.add(id(o))
+            deal.append(o)
+        outs = deal
         tape = autograd.current_tape()
         tape.record(call, in_arrays, outs, list(inputs),
                     differentiable=differentiable)

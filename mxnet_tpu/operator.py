@@ -120,16 +120,17 @@ def invoke_custom(op_type: str, *inputs: NDArray, **kwargs):
                    req=["write"] * len(out_data), in_data=list(inputs),
                    out_data=out_data, aux=aux)
 
-    # an op may assign an input straight through to an output; the tape
-    # keys gradients by buffer id, so alias the same guard invoke() uses
-    # (a copy) or the head cotangent double-counts onto the input
-    import jax.numpy as _jnp
-    in_ids = {id(i._data) for i in inputs}
-    for o in out_data:
-        if id(o._data) in in_ids:
-            o._rebind(_jnp.copy(o._data))
-
     if autograd.is_recording():
+        # an op may assign an input straight through to an output (or one
+        # output to another); the tape keys gradients by buffer id, so
+        # aliased outputs get a fresh identity (same guard as invoke())
+        # — only needed when recording, so inference pays no copy
+        import jax.numpy as _jnp
+        seen = {id(i._data) for i in inputs}
+        for o in out_data:
+            if id(o._data) in seen:
+                o._rebind(_jnp.copy(o._data))
+            seen.add(id(o._data))
         tape = autograd.current_tape()
 
         def custom_backward(cotangents, _op=op, _inputs=inputs,
@@ -208,26 +209,30 @@ def make_custom_callable(op_type: str, kwargs, is_train: bool = True):
         aux_types = list(aux_types) + [onp.float32] * (len(aux_shapes)
                                                        - len(aux_types))
         # one operator per shape signature; forward and backward of the
-        # same signature share it AND its aux arrays (state written by
-        # forward must be visible to backward, like the eager path)
+        # same signature share it AND the aux arrays of the most recent
+        # forward (state written by forward must be visible to backward).
+        # Each forward starts from FRESH zero aux, matching the eager
+        # path's per-invocation allocation.
         op_holder = {}
+
+        def _fresh_aux():
+            from .ndarray.ndarray import array as _arr
+            op_holder["aux"] = [_arr(onp.zeros(s, onp.dtype(t)))
+                                for s, t in zip(aux_shapes, aux_types)]
+            return op_holder["aux"]
 
         def _get_op():
             if "op" not in op_holder:
-                from .ndarray.ndarray import array as _arr
                 op_holder["op"] = prop.create_operator(None, in_shapes,
                                                        in_dtypes)
-                op_holder["aux"] = [
-                    _arr(onp.zeros(s, onp.dtype(t)))
-                    for s, t in zip(aux_shapes, aux_types)]
-            return op_holder["op"], op_holder["aux"]
+            return op_holder["op"]
 
         def host_forward(*xs):
             from .ndarray.ndarray import array as _arr
             in_data = [_arr(_np(x)) for x in xs]
             out_data = [_arr(onp.zeros(s.shape, s.dtype))
                         for s in out_structs]
-            opi, aux = _get_op()
+            opi, aux = _get_op(), _fresh_aux()
             opi.forward(is_train=is_train, req=["write"] * len(out_data),
                         in_data=in_data, out_data=out_data, aux=aux)
             return tuple(_np(o._data).astype(s.dtype) for o, s in
@@ -247,7 +252,9 @@ def make_custom_callable(op_type: str, kwargs, is_train: bool = True):
             out_grad = [_arr(_np(g)) for g in gs]
             in_grad = [_arr(onp.zeros(tuple(s), d))
                        for s, d in zip(in_shapes, in_dtypes)]
-            opi, aux = _get_op()  # same aux arrays forward wrote into
+            opi = _get_op()
+            # the aux arrays the most recent forward wrote into
+            aux = op_holder.get("aux") or _fresh_aux()
             opi.backward(req=["write"] * len(in_grad), out_grad=out_grad,
                          in_data=in_data, out_data=out_data,
                          in_grad=in_grad, aux=aux)

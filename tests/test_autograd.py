@@ -145,3 +145,22 @@ def test_mark_variables():
         y = x * 5
     y.backward()
     assert x.grad.asscalar() == pytest.approx(5.0)
+
+
+def test_function_identity_passthrough_grad():
+    """A Function whose forward returns its input unchanged must not
+    double-count the head cotangent (tape id-aliasing guard)."""
+    x = nd.array(onp.array([1.0, 2.0], dtype="float32"))
+    x.attach_grad()
+
+    class Passthrough(autograd.Function):
+        def forward(self, a):
+            return a
+
+        def backward(self, dy):
+            return dy * 42
+
+    with autograd.record():
+        y = Passthrough()(x)
+    y.backward(nd.ones(y.shape))
+    assert onp.allclose(x.grad.asnumpy(), 42.0), x.grad.asnumpy()

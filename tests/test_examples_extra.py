@@ -143,3 +143,25 @@ def test_dsd_example_mask_holds():
     acc_d, acc_s, acc_r = _load("dsd/dsd_train.py").main(
         ["--phase-steps", "80"])
     assert acc_s > 0.8 and acc_r > 0.8  # survives 70% pruning
+
+
+def test_fcn_segmentation_example():
+    miou = _load("fcn_xs/fcn_seg.py").main(["--steps", "120"])
+    assert miou > 0.3  # untrained fg-IoU ~0
+
+
+def test_dec_clustering_example():
+    acc = _load("deep_embedded_clustering/dec.py").main([])
+    assert acc > 0.9  # well-separated blobs
+
+
+def test_rbm_cd1_example():
+    first, last = _load("restricted_boltzmann_machine/rbm.py").main(
+        ["--steps", "200"])
+    assert last < first * 0.5
+
+
+def test_lstnet_forecast_example():
+    first, last = _load("multivariate_time_series/lstnet.py").main(
+        ["--steps", "120"])
+    assert last < first * 0.3
