@@ -85,6 +85,41 @@ class ndarray(NDArray):
     def item(self, *args):
         return self.asnumpy().item(*args)
 
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __index__(self):
+        # numpy: only integer-dtype scalars are valid indices
+        if not onp.issubdtype(onp.dtype(str(self.dtype)), onp.integer):
+            raise TypeError("only integer scalar arrays can be converted "
+                            "to a scalar index")
+        return int(self.item())
+
+    def as_np_ndarray(self):
+        return self
+
+    # working numpy-semantics methods, delegating to the module-level
+    # wrappers below (the reference raises NotImplementedError for these
+    # on mx.np arrays — multiarray.py:562,1183 — but jnp gives them to
+    # us for free, so they work here)
+    def all(self, axis=None, keepdims=False, **kw):
+        return _mod.all(self, axis=axis, keepdims=keepdims)
+
+    def any(self, axis=None, keepdims=False, **kw):
+        return _mod.any(self, axis=axis, keepdims=keepdims)
+
+    def cumsum(self, axis=None, dtype=None, **kw):
+        return _mod.cumsum(self, axis=axis, dtype=dtype)
+
+    def flip(self, axis=None):
+        return _mod.flip(self, axis)
+
+    def diag(self, k=0):
+        return _mod.diag(self, k)
+
 
 def _np_wrap(data) -> ndarray:
     out = ndarray.__new__(ndarray)

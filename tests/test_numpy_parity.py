@@ -208,3 +208,14 @@ def test_np_scalars_zero_dim():
     s = np.sum(np.array(A))
     assert s.shape == ()
     assert isinstance(float(s.asscalar()), float)
+
+
+def test_np_ndarray_scalar_dunders_and_methods():
+    a = mx.np.array([1.0, 2.0, 3.0])
+    assert int(mx.np.array([5])) == 5
+    assert float(mx.np.array([2.5])) == 2.5
+    assert onp.arange(10)[int(mx.np.array([3]))] == 3  # __index__ path
+    assert bool(a.all()) and bool(a.any())
+    assert onp.allclose(a.cumsum().asnumpy(), [1, 3, 6])
+    assert a.as_np_ndarray() is a
+    assert onp.allclose(a.flip().asnumpy(), [3, 2, 1])

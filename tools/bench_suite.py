@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Secondary benchmark suite (beyond bench.py's driver headline).
+
+Prints one JSON line per benchmark:
+  transformer_train  tokens/sec (+MFU) for a GPT-style TransformerLM
+                     train step (attention backend autotuned at warm-up)
+  flash_attention    fwd+bwd wall time at T=4096 (the long-context
+                     kernel; ref SURVEY.md §5.7 mandate)
+  image_pipeline     native decode+augment throughput (images/sec;
+                     ref src/io/iter_image_recordio_2.cc role)
+
+Platform-defensive like bench.py: accelerator probed in a killable
+subprocess, CPU fallback with tiny shapes so a number always appears.
+
+Usage: python tools/bench_suite.py [transformer|flash|pipeline|all]
+"""
+import io as pyio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PEAK_FLOPS = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
+    ("v4", 275e12), ("v3", 61.5e12), ("v2", 22.5e12),
+]
+
+
+def _probe_tpu(timeout_s=120):
+    import subprocess
+    code = ("import jax, sys; "
+            "sys.exit(0 if any(d.platform != 'cpu' "
+            "for d in jax.devices()) else 2)")
+    try:
+        rc = subprocess.run([sys.executable, "-c", code],
+                            timeout=timeout_s,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL).returncode
+    except Exception:
+        return "failed"
+    return {0: "accel", 2: "cpu"}.get(rc, "failed")
+
+
+def _init_jax():
+    probe = _probe_tpu()
+    import jax
+    if probe != "accel":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("MXTPU_COMPILE_CACHE",
+                                         "/tmp/mxtpu_xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    devs = jax.devices()
+    return jax, devs, any(d.platform != "cpu" for d in devs)
+
+
+def _emit(metric, value, unit, **extra):
+    line = {"metric": metric, "value": value, "unit": unit}
+    line.update(extra)
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+def _peak(dev):
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    for key, p in _PEAK_FLOPS:
+        if key in kind:
+            return p
+    return None
+
+
+def bench_transformer():
+    jax, devs, on_accel = _init_jax()
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.models import TransformerLM
+    from mxnet_tpu.parallel import ParallelTrainer
+
+    if on_accel:
+        B, T, L, U, H, V = 8, 2048, 12, 768, 3072, 32000
+        steps = 20
+    else:
+        B, T, L, U, H, V = 2, 128, 2, 64, 128, 512
+        steps = 3
+
+    # attention backend (Pallas flash vs XLA dense) is chosen by
+    # operator_tune at warm-up; bench_flash times the kernel directly
+    net = TransformerLM(vocab_size=V, units=U, num_layers=L,
+                        num_heads=U // 64, hidden_size=H, max_len=T,
+                        causal=True)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    class LMLoss(gluon.HybridBlock):
+        def hybrid_forward(self, F, logits, labels):
+            return loss_fn(logits.reshape((-1, V)),
+                           labels.reshape((-1,)))
+
+    trainer = ParallelTrainer(net, LMLoss(), optimizer="adam",
+                              optimizer_params={"learning_rate": 1e-4})
+    rng = onp.random.RandomState(0)
+    tokens = nd.array(rng.randint(0, V, (B, T)), dtype="int32")
+    labels = nd.array(rng.randint(0, V, (B, T)).astype("float32"))
+    net(nd.array(tokens._data[:1]))
+    trainer._extract_params()
+    if on_accel:
+        trainer.params = {k: (v.astype(jnp.bfloat16)
+                              if v.dtype == jnp.float32 else v)
+                          for k, v in trainer.params.items()}
+        trainer.opt_state = trainer._init_fn(
+            {n: v for n, v in trainer.params.items()
+             if n in trainer.trainable}, **trainer.opt_params)
+
+    with jax.default_matmul_precision("bfloat16"):
+        trainer.step(tokens, labels).wait_to_read()  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.step(tokens, labels)
+        loss.wait_to_read()
+        dt = time.perf_counter() - t0
+
+    tok_s = steps * B * T / dt
+    # 6*N FLOPs/token (fwd+bwd) for non-embedding params N
+    n_params = sum(int(onp.prod(v.shape))
+                   for k, v in trainer.params.items()
+                   if "embed" not in k)
+    flops_tok = 6 * n_params
+    peak = _peak(devs[0]) if on_accel else None
+    mfu = round(tok_s * flops_tok / peak, 4) if peak else None
+    _emit("transformer_train_tokens_per_sec", round(tok_s, 1),
+          "tokens/sec", batch=B, seq_len=T,
+          layers=L, mfu=mfu, ms_per_step=round(dt / steps * 1e3, 2),
+          platform="tpu" if on_accel else "cpu")
+
+
+def bench_flash():
+    jax, devs, on_accel = _init_jax()
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+
+    B, H, T, D = (2, 8, 4096, 64) if on_accel else (1, 2, 256, 64)
+    rs = onp.random.RandomState(0)
+    dt_ = jnp.bfloat16 if on_accel else jnp.float32
+    q = jnp.asarray(rs.randn(B, H, T, D), dt_)
+    k = jnp.asarray(rs.randn(B, H, T, D), dt_)
+    v = jnp.asarray(rs.randn(B, H, T, D), dt_)
+
+    interpret = not on_accel
+
+    def step(q, k, v):
+        out, vjp = jax.vjp(
+            lambda a, b, c: flash_attention(a, b, c, causal=True,
+                                            interpret=interpret),
+            q, k, v)
+        dq, dk, dv = vjp(out)
+        return out, dq
+
+    fn = jax.jit(step)
+    jax.block_until_ready(fn(q, k, v))  # compile
+    n = 10 if on_accel else 2
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn(q, k, v)
+    jax.block_until_ready(r)
+    ms = (time.perf_counter() - t0) / n * 1e3
+    _emit("flash_attention_fwd_bwd", round(ms, 2), "ms",
+          batch=B, heads=H, seq_len=T, head_dim=D, causal=True,
+          platform="tpu" if on_accel else "cpu")
+
+
+def bench_pipeline():
+    _init_jax()  # decode path is host-side, but importing mxnet_tpu
+    # must not touch a wedged accelerator backend
+    import numpy as onp
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.native import NativeImagePipeline, available
+    if not available():
+        _emit("image_pipeline_throughput", None, "images/sec",
+              error="native lib unavailable")
+        return
+    from PIL import Image
+
+    S, n_img = 224, 256
+    path = os.path.join(tempfile.mkdtemp(), "bench.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rs = onp.random.RandomState(0)
+    for i in range(n_img):
+        arr = rs.randint(0, 255, (S, S, 3), dtype=onp.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 10), i, 0),
+                              buf.getvalue()))
+    w.close()
+
+    batch = 64
+    t0 = time.perf_counter()
+    epochs = 4
+    total = 0
+    for _ in range(epochs):
+        pipe = NativeImagePipeline(path, batch_size=batch,
+                                   data_shape=(3, S, S), rand_crop=True,
+                                   rand_mirror=True, shuffle=True)
+        for data, labels in pipe:
+            total += batch
+    dt = time.perf_counter() - t0
+    _emit("image_pipeline_throughput", round(total / dt, 1),
+          "images/sec", image_size=S, batch=batch,
+          workers=os.environ.get("MXNET_CPU_WORKER_NTHREADS", "auto"))
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("transformer", "all"):
+        try:
+            bench_transformer()
+        except Exception as e:
+            _emit("transformer_train_tokens_per_sec", None, "tokens/sec",
+                  error=f"{type(e).__name__}: {e}"[:300])
+    if which in ("flash", "all"):
+        try:
+            bench_flash()
+        except Exception as e:
+            _emit("flash_attention_fwd_bwd", None, "ms",
+                  error=f"{type(e).__name__}: {e}"[:300])
+    if which in ("pipeline", "all"):
+        try:
+            bench_pipeline()
+        except Exception as e:
+            _emit("image_pipeline_throughput", None, "images/sec",
+                  error=f"{type(e).__name__}: {e}"[:300])
+
+
+if __name__ == "__main__":
+    main()
