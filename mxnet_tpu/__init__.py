@@ -106,6 +106,14 @@ from . import libinfo  # noqa: F401
 from . import log  # noqa: F401
 from . import library  # noqa: F401
 from . import test_utils  # noqa: F401
+from . import image  # noqa: F401
+from . import image as img  # noqa: F401
+from . import registry  # noqa: F401
+from . import symbol_doc  # noqa: F401
+from . import ndarray_doc  # noqa: F401
+from . import notebook  # noqa: F401
+from . import torch  # noqa: F401  (gated Torch7-bridge surface)
+from . import misc  # noqa: F401  (legacy scheduler shims)
 from . import util  # noqa: F401
 from . import visualization  # noqa: F401
 from . import visualization as viz  # noqa: F401

@@ -7,6 +7,7 @@ numpy for pre-decoded arrays.
 """
 from __future__ import annotations
 
+import json
 import os
 import random as pyrandom
 from typing import List, Optional
@@ -560,3 +561,368 @@ def ImageRecordIterPy(path_imgrec=None, data_shape=(3, 224, 224),
     return ImageIter(batch_size, data_shape, label_width,
                      path_imgrec=path_imgrec, shuffle=shuffle,
                      aug_list=augs, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Detection tier (ref: python/mxnet/image/detection.py — DetAugmenter set,
+# CreateDetAugmenter, ImageDetIter; backs the SSD input pipeline together
+# with io.ImageDetRecordIter / src/io/image_det_aug_default.cc)
+#
+# Label convention (reference lst/rec detection format): a flat float row
+# [A, B, <A-2 extra header>, obj0(B values), obj1(B values), ...] where
+# A = header width (>=2), B = per-object width (>=5) and each object is
+# [class_id, xmin, ymin, xmax, ymax, ...] with coordinates normalized to
+# [0, 1]. Parsed object matrices have shape (num_objs, B).
+# ---------------------------------------------------------------------------
+
+
+class DetAugmenter:
+    """ref: detection.py DetAugmenter — image+label joint augmenter."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+    def dumps(self):
+        """Name + json-serializable config (ref: detection.py dumps)."""
+        def enc(v):
+            if isinstance(v, (int, float, str, bool, type(None))):
+                return v
+            if isinstance(v, (tuple, list)):
+                return [enc(x) for x in v]
+            if isinstance(v, (Augmenter, DetAugmenter)):
+                return v.dumps()
+            return str(v)
+        kw = {k: enc(v) for k, v in self.__dict__.items()
+              if not k.startswith("_")}
+        return json.dumps([self.__class__.__name__.lower(), kw])
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter; label passes through
+    (ref: detection.py DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one augmenter from a list, or skip entirely
+    (ref: detection.py DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and box x-coordinates with probability p
+    (ref: detection.py DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            arr = _as_host(src)[0]
+            src = arr[:, ::-1, :].copy()
+            label = label.copy()
+            xmin = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - xmin
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping enough object coverage; boxes are re-projected
+    into crop coordinates and objects whose center falls outside are
+    dropped (ref: detection.py DetRandomCropAug)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), max_attempts=50):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def _try_crop(self, h, w):
+        import math
+        ratio = pyrandom.uniform(*self.aspect_ratio_range)
+        area = pyrandom.uniform(*self.area_range) * h * w
+        ch = int(round(math.sqrt(area / ratio)))
+        cw = int(round(math.sqrt(area * ratio)))
+        if ch > h or cw > w or ch < 1 or cw < 1:
+            return None
+        y0 = pyrandom.randint(0, h - ch)
+        x0 = pyrandom.randint(0, w - cw)
+        return x0, y0, cw, ch
+
+    def _project(self, label, x0, y0, cw, ch, w, h):
+        out = []
+        for obj in label:
+            cx = (obj[1] + obj[3]) / 2 * w
+            cy = (obj[2] + obj[4]) / 2 * h
+            if not (x0 <= cx < x0 + cw and y0 <= cy < y0 + ch):
+                continue
+            o = obj.copy()
+            o[1] = onp.clip((obj[1] * w - x0) / cw, 0, 1)
+            o[2] = onp.clip((obj[2] * h - y0) / ch, 0, 1)
+            o[3] = onp.clip((obj[3] * w - x0) / cw, 0, 1)
+            o[4] = onp.clip((obj[4] * h - y0) / ch, 0, 1)
+            # coverage check: remaining box area vs original
+            orig = max(obj[3] - obj[1], 1e-12) * max(obj[4] - obj[2], 1e-12)
+            new = (o[3] - o[1]) * cw * (o[4] - o[2]) * ch / (w * h)
+            if new / orig >= self.min_object_covered:
+                out.append(o)
+        return onp.asarray(out, onp.float32) if out else None
+
+    def __call__(self, src, label):
+        arr = _as_host(src)[0]
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            crop = self._try_crop(h, w)
+            if crop is None:
+                continue
+            x0, y0, cw, ch = crop
+            new_label = self._project(label, x0, y0, cw, ch, w, h)
+            if new_label is not None:
+                return arr[y0:y0 + ch, x0:x0 + cw, :].copy(), new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Pad the image into a larger canvas, shrinking boxes accordingly
+    (ref: detection.py DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        import math
+        arr = _as_host(src)[0]
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            area = pyrandom.uniform(*self.area_range) * h * w
+            nh = int(round(math.sqrt(area / ratio)))
+            nw = int(round(math.sqrt(area * ratio)))
+            if nh < h or nw < w:
+                continue
+            y0 = pyrandom.randint(0, nh - h)
+            x0 = pyrandom.randint(0, nw - w)
+            canvas = onp.empty((nh, nw, arr.shape[2]), arr.dtype)
+            canvas[:] = onp.asarray(self.pad_val, arr.dtype)
+            canvas[y0:y0 + h, x0:x0 + w, :] = arr
+            new_label = label.copy()
+            new_label[:, 1] = (label[:, 1] * w + x0) / nw
+            new_label[:, 2] = (label[:, 2] * h + y0) / nh
+            new_label[:, 3] = (label[:, 3] * w + x0) / nw
+            new_label[:, 4] = (label[:, 4] * h + y0) / nh
+            return canvas, new_label
+        return src, label
+
+
+class DetForceResizeAug(DetAugmenter):
+    """Resize to exact (w, h); normalized boxes are size-invariant."""
+
+    def __init__(self, size, interp=2):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src, label):
+        arr, was_nd = _as_host(src)
+        out = imresize(array(arr), self.size[0], self.size[1],
+                       self.interp)
+        return (out if was_nd else out.asnumpy()), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """ref: detection.py CreateDetAugmenter — standard SSD train-time
+    augmentation list."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetForceResizeAug((data_shape[2], data_shape[1]),
+                                     inter_method))
+    color_augs = []
+    if brightness or contrast or saturation:
+        color_augs.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        color_augs.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        color_augs.append(LightingAug(
+            pca_noise,
+            onp.asarray([55.46, 4.794, 1.148]),
+            onp.asarray([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]])))
+    if rand_gray > 0:
+        color_augs.append(RandomGrayAug(rand_gray))
+    for a in color_augs:
+        auglist.append(DetBorrowAug(a))
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = onp.asarray([123.68, 116.28, 103.53])
+        if std is True:
+            std = onp.asarray([58.395, 57.12, 57.375])
+        auglist.append(DetBorrowAug(CastAug()))
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator over .rec/.lst/in-memory lists
+    (ref: detection.py ImageDetIter). Emits data (B, C, H, W) and label
+    (B, max_objs, obj_width) padded with -1 rows."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="label", last_batch_handle="pad",
+                 label_shape=None, **kwargs):
+        if last_batch_handle not in ("pad", "discard"):
+            raise ValueError(
+                f"last_batch_handle={last_batch_handle!r} not supported; "
+                "use 'pad' or 'discard'")
+        self._last_batch_handle = last_batch_handle
+        aug_kwargs = {k: kwargs.pop(k) for k in list(kwargs)
+                      if k in ("resize", "rand_crop", "rand_pad",
+                               "rand_gray", "rand_mirror", "mean", "std",
+                               "brightness", "contrast", "saturation",
+                               "pca_noise", "hue", "inter_method",
+                               "min_object_covered", "aspect_ratio_range",
+                               "area_range", "max_attempts", "pad_val")}
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle,
+                         aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name,
+                         **kwargs)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape, **aug_kwargs)
+        # size the padded label tensor: explicit label_shape wins, else a
+        # full pass over the labels (imglist AND .rec headers — sizing
+        # from only the first record would silently drop boxes)
+        if label_shape is not None:
+            self._max_objs, self._obj_width = label_shape
+        else:
+            self._obj_width, self._max_objs = self._scan_label_shape()
+
+    @staticmethod
+    def _parse_label(raw):
+        """Flat [A, B, ...header..., objs...] -> (num_objs, B) matrix."""
+        raw = onp.asarray(raw, onp.float32).reshape(-1)
+        if raw.size >= 2 and raw[0] >= 2 and raw[1] >= 5 and \
+                (raw.size - int(raw[0])) % int(raw[1]) == 0 and \
+                raw.size > int(raw[0]):
+            a, b = int(raw[0]), int(raw[1])
+            return raw[a:].reshape(-1, b)
+        if raw.size % 5 == 0 and raw.size >= 5:  # plain (N, 5) rows
+            return raw.reshape(-1, 5)
+        raise ValueError(f"invalid detection label of size {raw.size}")
+
+    def _scan_label_shape(self):
+        width, n = 5, 1
+        if self.imglist:
+            for label, _ in self.imglist.values():
+                objs = self._parse_label(label)
+                width = max(width, objs.shape[1])
+                n = max(n, objs.shape[0])
+        elif self.imgrec is not None:
+            from .recordio import unpack
+
+            def _labels():  # full header pass, then rewind
+                if self.seq is not None:
+                    for idx in self.seq:
+                        yield unpack(self.imgrec.read_idx(idx))[0].label
+                else:
+                    while True:
+                        s = self.imgrec.read()
+                        if s is None:
+                            return
+                        yield unpack(s)[0].label
+
+            for label in _labels():
+                objs = self._parse_label(label)
+                width = max(width, objs.shape[1])
+                n = max(n, objs.shape[0])
+            self.imgrec.reset()
+        return width, n
+
+    @property
+    def provide_label(self):
+        from .io.io import DataDesc
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self._max_objs,
+                          self._obj_width))]
+
+    def label_shape(self):
+        return (self._max_objs, self._obj_width)
+
+    def sync_label_shape(self, it, verbose=False):
+        """Synchronize padded label shapes with another ImageDetIter
+        (ref: detection.py sync_label_shape — train/val iters must agree)."""
+        width = max(self._obj_width, it._obj_width)
+        n = max(self._max_objs, it._max_objs)
+        self._obj_width = it._obj_width = width
+        self._max_objs = it._max_objs = n
+        return it
+
+    def next(self):
+        from .io.io import DataBatch
+        bd = onp.zeros((self.batch_size,) + self.data_shape, onp.float32)
+        bl = onp.full((self.batch_size, self._max_objs, self._obj_width),
+                      -1.0, onp.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s) if isinstance(s, bytes) else array(s)
+                objs = self._parse_label(label)
+                arr = img.asnumpy() if isinstance(img, NDArray) else \
+                    onp.asarray(img)
+                for aug in self.auglist:
+                    arr, objs = aug(arr, objs)
+                arr = arr.asnumpy() if isinstance(arr, NDArray) else arr
+                if arr.ndim == 3 and arr.shape[2] == self.data_shape[0]:
+                    arr = arr.transpose(2, 0, 1)
+                bd[i] = arr
+                k = min(objs.shape[0], self._max_objs)
+                bl[i, :k, :objs.shape[1]] = objs[:k]
+                i += 1
+        except StopIteration:
+            if i == 0 or self._last_batch_handle == "discard":
+                raise
+            pad = self.batch_size - i
+        return DataBatch(data=[array(bd)], label=[array(bl)], pad=pad)

@@ -1,0 +1,52 @@
+"""Per-op documentation augmentation for the ndarray namespace
+(ref: python/mxnet/ndarray_doc.py — NDArrayDoc subclasses whose
+docstrings are appended to generated op functions)."""
+from __future__ import annotations
+
+from .ops.registry import get_op
+
+__all__ = ["NDArrayDoc", "ReshapeDoc", "ConcatDoc"]
+
+
+class NDArrayDoc:
+    """Subclass with the op's name and a docstring to extend the
+    generated `nd.<op>` documentation (ref: ndarray_doc.py:29)."""
+
+
+class ReshapeDoc(NDArrayDoc):
+    """Examples
+    --------
+    Reshapes the input array into a new shape; -1 infers one axis.
+    >>> x = mx.nd.array([1, 2, 3, 4])
+    >>> y = mx.nd.reshape(x, shape=(2, 2))
+    """
+
+
+class ConcatDoc(NDArrayDoc):
+    """Examples
+    --------
+    >>> x = mx.nd.array([[1, 1], [2, 2]])
+    >>> mx.nd.concat(x, x, dim=0).shape
+    (4, 2)
+    """
+
+
+def _build_doc(func_name, desc="", arg_names=(), arg_types=(),
+               arg_desc=(), key_var_num_args=None, ret_type=None):
+    """Assemble a numpydoc-style docstring for a generated op function
+    (ref: ndarray_doc.py _build_doc, used by register.py codegen)."""
+    lines = [desc or f"{func_name} operator.", "", "Parameters",
+             "----------"]
+    for n, t, d in zip(arg_names, arg_types, arg_desc):
+        lines.append(f"{n} : {t}")
+        if d:
+            lines.append(f"    {d}")
+    try:
+        info = get_op(func_name)
+        if info.fn.__doc__:
+            lines += ["", info.fn.__doc__]
+    except Exception:
+        pass
+    lines += ["", "Returns", "-------", f"out : "
+              f"{ret_type or 'NDArray or list of NDArrays'}"]
+    return "\n".join(lines)

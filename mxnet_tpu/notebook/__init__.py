@@ -1,0 +1,3 @@
+"""Notebook utilities (ref: python/mxnet/notebook/ — live training-curve
+plotting callbacks for Jupyter)."""
+from . import callback  # noqa: F401

@@ -1,0 +1,162 @@
+"""Training-progress callbacks for notebooks (ref:
+python/mxnet/notebook/callback.py — PandasLogger, LiveBokehChart/
+LiveLearningCurve, args_wrapper).
+
+Dependency-light: metric history is accumulated in plain dicts of
+lists (pandas optional for PandasLogger.to_dataframe), and live charts
+degrade to text summaries when no plotting backend is present.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["PandasLogger", "LiveLearningCurve", "LiveTimeSeries",
+           "args_wrapper"]
+
+
+class _MetricHistory:
+    def __init__(self):
+        self.rows = []  # list of dicts
+
+    def append(self, metrics):
+        self.rows.append(dict(metrics))
+
+    def series(self, key):
+        return [r[key] for r in self.rows if key in r]
+
+
+class PandasLogger:
+    """Accumulate train/eval metrics per batch/epoch
+    (ref: notebook/callback.py PandasLogger). History is kept as plain
+    dict rows; .train_df/.eval_df return pandas frames when pandas is
+    importable, else the raw row lists."""
+
+    def __init__(self, batch_size, frequent=50):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._train = _MetricHistory()
+        self._eval = _MetricHistory()
+        self._epoch = _MetricHistory()
+        self.last_time = time.time()
+
+    def _to_frame(self, hist):
+        try:
+            import pandas as pd
+            return pd.DataFrame(hist.rows)
+        except ImportError:
+            return hist.rows
+
+    @property
+    def train_df(self):
+        return self._to_frame(self._train)
+
+    @property
+    def eval_df(self):
+        return self._to_frame(self._eval)
+
+    @property
+    def epoch_df(self):
+        return self._to_frame(self._epoch)
+
+    def train_cb(self, param):
+        if param.nbatch % self.frequent == 0:
+            self._process(param, self._train)
+
+    def eval_cb(self, param):
+        self._process(param, self._eval)
+
+    def epoch_cb(self, epoch, *_args):
+        now = time.time()
+        self._epoch.append({"epoch": epoch,
+                            "elapsed": now - self.last_time})
+        self.last_time = now
+
+    def _process(self, param, hist):
+        row = {"epoch": getattr(param, "epoch", 0),
+               "nbatch": getattr(param, "nbatch", 0)}
+        if param.eval_metric is not None:
+            names, vals = param.eval_metric.get()
+            if not isinstance(names, list):
+                names, vals = [names], [vals]
+            row.update(dict(zip(names, vals)))
+        row["elapsed"] = time.time() - self.last_time
+        hist.append(row)
+
+    def append_metrics(self, metrics, which="train"):
+        {"train": self._train, "eval": self._eval,
+         "epoch": self._epoch}[which].append(metrics)
+
+
+class LiveLearningCurve:
+    """Live train/eval metric curve (ref: notebook/callback.py
+    LiveLearningCurve). Renders with matplotlib when importable,
+    otherwise prints a compact text summary on each update."""
+
+    def __init__(self, metric_name="accuracy", frequent=50):
+        self.metric_name = metric_name
+        self.frequent = frequent
+        self._train_x, self._train_y = [], []
+        self._eval_x, self._eval_y = [], []
+        self._n = 0
+
+    def train_cb(self, param):
+        self._n += 1
+        if self._n % self.frequent == 0 and param.eval_metric is not None:
+            _, vals = param.eval_metric.get()
+            val = vals[0] if isinstance(vals, (list, tuple)) else vals
+            self._train_x.append(self._n)
+            self._train_y.append(float(val))
+            self._update()
+
+    def eval_cb(self, param):
+        if param.eval_metric is not None:
+            name, val = param.eval_metric.get()
+            if isinstance(val, (list, tuple)):
+                val = val[0]
+            self._eval_x.append(self._n)
+            self._eval_y.append(float(val))
+            self._update()
+
+    def _update(self):
+        try:
+            import matplotlib.pyplot as plt
+            plt.clf()
+            plt.plot(self._train_x, self._train_y, label="train")
+            if self._eval_x:
+                plt.plot(self._eval_x, self._eval_y, label="eval")
+            plt.xlabel("batch")
+            plt.ylabel(self.metric_name)
+            plt.legend()
+            plt.pause(0.001)
+        except Exception:
+            tail = self._train_y[-1] if self._train_y else None
+            etail = self._eval_y[-1] if self._eval_y else None
+            print(f"[LiveLearningCurve] batch {self._n}: "
+                  f"train {self.metric_name}={tail} "
+                  f"eval {self.metric_name}={etail}")
+
+
+class LiveTimeSeries(LiveLearningCurve):
+    """Single time-series variant (ref: notebook/callback.py
+    LiveTimeSeries)."""
+
+    def append(self, value):
+        self._n += 1
+        self._train_x.append(self._n)
+        self._train_y.append(float(value))
+        self._update()
+
+
+def args_wrapper(*args):
+    """Generate callbacks for Module.fit from logger/chart objects
+    (ref: notebook/callback.py:392). Returns a dict of fit kwargs."""
+    out = {"batch_end_callback": [], "eval_end_callback": [],
+           "epoch_end_callback": []}
+    for a in args:
+        if hasattr(a, "train_cb"):
+            out["batch_end_callback"].append(a.train_cb)
+        if hasattr(a, "eval_cb"):
+            out["eval_end_callback"].append(a.eval_cb)
+        if hasattr(a, "epoch_cb"):
+            out["epoch_end_callback"].append(a.epoch_cb)
+    return out

@@ -428,7 +428,7 @@ def _np_multi(jfn, differentiable=True):
 
 _EXTRA_UNARY = [
     "sort", "flip", "flipud", "fliplr", "ravel", "cumprod", "nancumsum",
-    "nan_to_num", "trace", "tril", "triu", "diagonal", "diff",
+    "nan_to_num", "trace", "tril", "triu", "diag", "diagonal", "diff",
     "ptp", "round", "conj", "real", "imag", "angle", "positive", "i0",
     "sinc", "exp2", "signbit", "spacing", "rot90", "roll", "unwrap",
     "nanprod", "trim_zeros", "rad2deg", "deg2rad",

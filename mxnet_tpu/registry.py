@@ -1,0 +1,87 @@
+"""Generic class-registry helpers (ref: python/mxnet/registry.py —
+get_register_func/get_alias_func/get_create_func used by the optimizer,
+initializer and lr-scheduler registries).
+
+The create() protocol accepts a name string, a "name(json-kwargs)"
+spec, a prebuilt instance, or a class, mirroring the reference.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, Type
+
+_REGISTRIES: Dict[type, Dict[str, type]] = {}
+
+
+def get_registry(base_class):
+    """A copy of the name -> class mapping for base_class."""
+    return dict(_REGISTRIES.get(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """ref: registry.py:49 — build a register() decorator for a base."""
+    reg = _REGISTRIES.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            f"Can only register subclass of {base_class.__name__}"
+        if name is None:
+            name = klass.__name__
+        name = name.lower()
+        if name in reg and reg[name] is not klass:
+            logging.warning("\033[91mNew %s %s.%s registered with name %s"
+                            " is overriding existing %s %s.%s\033[0m",
+                            nickname, klass.__module__, klass.__name__,
+                            name, nickname, reg[name].__module__,
+                            reg[name].__name__)
+        reg[name] = klass
+        return klass
+
+    register.__doc__ = f"Register {nickname} to the {nickname} factory"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """ref: registry.py:88 — decorator registering extra names."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """ref: registry.py:115 — build a create() factory for a base."""
+
+    def create(*args, **kwargs):
+        if len(args) and isinstance(args[0], base_class):
+            assert len(kwargs) == 0 and len(args) == 1
+            return args[0]
+        if len(args) and isinstance(args[0], type) and \
+                issubclass(args[0], base_class):
+            return args[0](*args[1:], **kwargs)
+        if len(args):
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        assert isinstance(name, str), \
+            f"{nickname} must be of string type"
+        reg = _REGISTRIES.get(base_class, {})
+        if name.endswith(")"):  # "name(json-kwargs)" spec string
+            name, _, spec = name[:-1].partition("(")
+            if spec:
+                kwargs.update(json.loads(spec))
+        name = name.lower()
+        if name not in reg:
+            raise ValueError(f"Cannot find {nickname} {name}. Valid "
+                             f"options: {sorted(reg)}")
+        return reg[name](*args, **kwargs)
+
+    create.__doc__ = f"Create a {nickname} instance from config"
+    return create

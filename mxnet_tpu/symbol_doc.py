@@ -1,0 +1,21 @@
+"""Per-op documentation augmentation for the symbol namespace
+(ref: python/mxnet/symbol_doc.py — SymbolDoc subclasses + the
+get_output_shape debug helper)."""
+from __future__ import annotations
+
+__all__ = ["SymbolDoc"]
+
+
+class SymbolDoc:
+    """The base class for attaching doc to symbol operators
+    (ref: symbol_doc.py:63)."""
+
+    @staticmethod
+    def get_output_shape(sym, **input_shapes):
+        """Infer and return output shapes keyed by output name
+        (ref: symbol_doc.py:66-71)."""
+        _, s_outputs, _ = sym.infer_shape(**input_shapes)
+        return dict(zip(sym.list_outputs(), s_outputs))
+
+
+from .ndarray_doc import _build_doc  # noqa: E402,F401  (shared codegen)

@@ -1,0 +1,30 @@
+"""Torch-bridge API surface (ref: python/mxnet/torch.py — a ctypes
+bridge to the Lua Torch7 runtime via MXListFunctions/MXFuncInvoke).
+
+The TPU build has no Torch7 runtime (the bridge was deprecated upstream
+and its native half requires `USE_TORCH` builds that the reference
+itself stopped shipping). The module keeps the import surface so
+`import mxnet.torch` ports don't crash at import time; calling any
+bridged function raises with a pointer to the native alternative.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = []
+
+
+def _unavailable(name):
+    def fn(*args, **kwargs):
+        raise MXNetError(
+            f"mxnet.torch.{name} requires the Lua Torch7 bridge "
+            "(USE_TORCH=1 native build), which has no TPU equivalent; "
+            "use the native mx.nd / mx.np operators instead")
+    fn.__name__ = name
+    return fn
+
+
+def __getattr__(name):  # PEP 562: any th-namespace lookup explains itself
+    if name.startswith("__"):
+        raise AttributeError(name)
+    return _unavailable(name)

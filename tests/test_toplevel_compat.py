@@ -1,0 +1,279 @@
+"""Top-level compat modules (ref: python/mxnet/{registry,misc,torch,
+ndarray_doc,symbol_doc}.py, notebook/) and the image detection tier
+(ref: python/mxnet/image/detection.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# --- registry.py -----------------------------------------------------------
+
+def test_registry_register_alias_create():
+    from mxnet_tpu import registry
+
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    register = registry.get_register_func(Base, "thing")
+    alias = registry.get_alias_func(Base, "thing")
+    create = registry.get_create_func(Base, "thing")
+
+    @register
+    class Foo(Base):
+        pass
+
+    @alias("bar", "baz")
+    class Bar(Base):
+        pass
+
+    assert isinstance(create("foo"), Foo)
+    assert isinstance(create("baz"), Bar)
+    assert create('foo(\n{"x": 5})' .replace("\n", "")).x == 5
+    inst = Foo()
+    assert create(inst) is inst
+    assert isinstance(create(Bar, x=2), Bar)
+    with pytest.raises(ValueError):
+        create("missing")
+
+
+def test_misc_factor_scheduler():
+    from mxnet_tpu.misc import FactorScheduler
+    s = FactorScheduler(step=10, factor=0.5)
+    assert abs(s(0) - s.base_lr) < 1e-9
+    assert abs(s(10) - s.base_lr * 0.5) < 1e-9
+    assert abs(s(25) - s.base_lr * 0.25) < 1e-9
+    with pytest.raises(ValueError):
+        FactorScheduler(step=0)
+
+
+def test_torch_bridge_raises_helpfully():
+    from mxnet_tpu import torch as th
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="Torch7"):
+        th.add(1, 2)
+
+
+def test_doc_modules():
+    from mxnet_tpu.ndarray_doc import NDArrayDoc, _build_doc
+    from mxnet_tpu.symbol_doc import SymbolDoc
+    doc = _build_doc("FullyConnected", "desc", ["data"], ["NDArray"],
+                     ["input"])
+    assert "Parameters" in doc and "data" in doc
+    assert NDArrayDoc is not None
+
+    from mxnet_tpu import sym
+    x = sym.Variable("data")
+    fc = sym.FullyConnected(x, name="fc", num_hidden=8)
+    shapes = SymbolDoc.get_output_shape(fc, data=(2, 4))
+    assert list(shapes.values())[0] == (2, 8)
+
+
+def test_notebook_callbacks():
+    from mxnet_tpu.notebook.callback import (LiveLearningCurve,
+                                             PandasLogger, args_wrapper)
+
+    class Param:
+        def __init__(self, metric, epoch=0, nbatch=0):
+            self.eval_metric = metric
+            self.epoch = epoch
+            self.nbatch = nbatch
+
+    m = mx.metric.Accuracy()
+    m.update(nd.array([1.0, 0.0]), nd.array([[0.1, 0.9], [0.2, 0.8]]))
+    logger = PandasLogger(batch_size=2, frequent=1)
+    logger.train_cb(Param(m, nbatch=1))
+    logger.eval_cb(Param(m))
+    logger.epoch_cb(0)
+    assert logger._train.rows and logger._eval.rows
+    assert logger._train.rows[0]["accuracy"] == 0.5
+
+    curve = LiveLearningCurve(frequent=1)
+    curve.train_cb(Param(m))
+    assert curve._train_y == [0.5]
+
+    cbs = args_wrapper(logger, curve)
+    assert len(cbs["batch_end_callback"]) == 2
+    assert len(cbs["epoch_end_callback"]) == 1
+
+
+# --- image detection tier --------------------------------------------------
+
+def _det_label(objs):
+    """[A=2, B=5] header + rows."""
+    return onp.concatenate([[2, 5], onp.asarray(objs, "float32")
+                            .reshape(-1)]).astype("float32")
+
+
+def test_det_label_parse_and_iter(tmp_path):
+    from PIL import Image
+    from mxnet_tpu.image import ImageDetIter
+
+    rs = onp.random.RandomState(0)
+    files = []
+    for i in range(6):
+        arr = rs.randint(0, 255, (32, 40, 3), dtype=onp.uint8)
+        f = tmp_path / f"img{i}.png"
+        Image.fromarray(arr).save(f)
+        files.append(str(f.name))
+    imglist = [
+        [_det_label([[i % 3, 0.1, 0.2, 0.6, 0.8],
+                     [(i + 1) % 3, 0.3, 0.3, 0.9, 0.9]]), files[i]]
+        for i in range(6)]
+
+    it = ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                      imglist=imglist, path_root=str(tmp_path))
+    assert it.label_shape() == (2, 5)
+    batch = it.next()
+    data = batch.data[0].asnumpy()
+    label = batch.label[0].asnumpy()
+    assert data.shape == (2, 3, 24, 24)
+    assert label.shape == (2, 2, 5)
+    assert (label[:, :, 0] >= 0).all()  # both objects present
+    assert (label[:, :, 1:] >= 0).all() and (label[:, :, 1:] <= 1).all()
+
+
+def test_det_flip_adjusts_boxes():
+    from mxnet_tpu.image import DetHorizontalFlipAug
+    aug = DetHorizontalFlipAug(p=1.0)
+    img = onp.zeros((10, 20, 3), "uint8")
+    img[:, :5, 0] = 255  # red stripe on the left
+    label = onp.asarray([[0, 0.0, 0.0, 0.25, 1.0]], "float32")
+    out, lab = aug(img, label)
+    assert out[:, -5:, 0].min() == 255  # stripe moved right
+    assert abs(lab[0, 1] - 0.75) < 1e-6 and abs(lab[0, 3] - 1.0) < 1e-6
+
+
+def test_det_random_crop_keeps_box_geometry():
+    from mxnet_tpu.image import DetRandomCropAug
+    import random as pyrandom
+    pyrandom.seed(3)
+    aug = DetRandomCropAug(min_object_covered=0.1,
+                           area_range=(0.5, 1.0))
+    img = onp.zeros((40, 40, 3), "uint8")
+    label = onp.asarray([[1, 0.4, 0.4, 0.6, 0.6]], "float32")
+    out, lab = aug(img, label)
+    if lab.shape[0]:  # object survived: coords stay valid and ordered
+        assert (lab[:, 1] <= lab[:, 3]).all()
+        assert (lab[:, 2] <= lab[:, 4]).all()
+        assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+
+
+def test_det_pad_shrinks_boxes():
+    from mxnet_tpu.image import DetRandomPadAug
+    import random as pyrandom
+    pyrandom.seed(0)
+    aug = DetRandomPadAug(area_range=(1.5, 2.0))
+    img = onp.full((20, 20, 3), 200, "uint8")
+    label = onp.asarray([[0, 0.0, 0.0, 1.0, 1.0]], "float32")
+    out, lab = aug(img, label)
+    assert out.shape[0] >= 20 and out.shape[1] >= 20
+    w = lab[0, 3] - lab[0, 1]
+    h = lab[0, 4] - lab[0, 2]
+    assert w < 1.0 and h < 1.0  # box shrank within the padded canvas
+
+
+def test_create_det_augmenter_pipeline_runs():
+    from mxnet_tpu.image import CreateDetAugmenter
+    augs = CreateDetAugmenter((3, 16, 16), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, brightness=0.1,
+                              mean=True, std=True)
+    img = onp.random.RandomState(0).randint(
+        0, 255, (24, 30, 3)).astype("uint8")
+    label = onp.asarray([[0, 0.2, 0.2, 0.8, 0.8]], "float32")
+    for aug in augs:
+        img, label = aug(img, label)
+    arr = img.asnumpy() if hasattr(img, "asnumpy") else img
+    assert arr.shape[:2] == (16, 16)
+
+
+def test_det_iter_sync_label_shape(tmp_path):
+    from PIL import Image
+    from mxnet_tpu.image import ImageDetIter
+
+    arr = onp.zeros((16, 16, 3), "uint8")
+    Image.fromarray(arr).save(tmp_path / "a.png")
+    one = [[_det_label([[0, 0.1, 0.1, 0.5, 0.5]]), "a.png"]]
+    two = [[_det_label([[0, 0.1, 0.1, 0.5, 0.5],
+                        [1, 0.2, 0.2, 0.6, 0.6]]), "a.png"]]
+    it1 = ImageDetIter(2, (3, 16, 16), imglist=one,
+                       path_root=str(tmp_path))
+    it2 = ImageDetIter(2, (3, 16, 16), imglist=two,
+                       path_root=str(tmp_path))
+    it1.sync_label_shape(it2)
+    assert it1.label_shape() == it2.label_shape() == (2, 5)
+
+
+def test_det_iter_rec_path_scans_all_objects(tmp_path):
+    """Label sizing must scan the whole .rec, not default to one object
+    (multi-box ground truth was silently truncated otherwise)."""
+    import io as pyio
+
+    from PIL import Image
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import ImageDetIter
+
+    rs = onp.random.RandomState(0)
+    path = str(tmp_path / "det.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(4):
+        arr = rs.randint(0, 255, (24, 24, 3), dtype=onp.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        n_obj = 3 if i == 2 else 1  # one record has three boxes
+        label = _det_label([[j, 0.1 * (j + 1), 0.1, 0.2 * (j + 1), 0.5]
+                            for j in range(n_obj)])
+        w.write(recordio.pack(
+            recordio.IRHeader(0, label, i, 0), buf.getvalue()))
+    w.close()
+
+    it = ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                      path_imgrec=path)
+    assert it.label_shape() == (3, 5)
+    batch = it.next()
+    assert batch.label[0].shape == (2, 3, 5)
+
+    # explicit label_shape override skips the scan
+    it2 = ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                       path_imgrec=path, label_shape=(7, 5))
+    assert it2.label_shape() == (7, 5)
+
+
+def test_det_iter_last_batch_discard(tmp_path):
+    from PIL import Image
+    from mxnet_tpu.image import ImageDetIter
+
+    arr = onp.zeros((16, 16, 3), "uint8")
+    Image.fromarray(arr).save(tmp_path / "a.png")
+    imglist = [[_det_label([[0, 0.1, 0.1, 0.5, 0.5]]), "a.png"]
+               for _ in range(3)]
+    it = ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                      imglist=imglist, path_root=str(tmp_path),
+                      last_batch_handle="discard")
+    it.next()  # full batch of 2
+    with pytest.raises(StopIteration):
+        it.next()  # remaining 1 sample is discarded, not padded
+    with pytest.raises(ValueError):
+        ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                     imglist=imglist, path_root=str(tmp_path),
+                     last_batch_handle="roll_over")
+
+
+def test_det_augmenter_dumps_config():
+    import json as _json
+
+    from mxnet_tpu.image import DetRandomCropAug
+    name, kw = _json.loads(
+        DetRandomCropAug(min_object_covered=0.5).dumps())
+    assert name == "detrandomcropaug"
+    assert kw["min_object_covered"] == 0.5
+    assert kw["max_attempts"] == 50
+
+
+def test_np_diag_method_and_function():
+    a = mx.np.array([1.0, 2.0, 3.0])
+    d = a.diag()
+    assert d.shape == (3, 3) and float(d.asnumpy()[1, 1]) == 2.0
+    assert mx.np.diag(d).shape == (3,)

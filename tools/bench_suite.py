@@ -44,8 +44,13 @@ def _probe_tpu(timeout_s=120):
     return {0: "accel", 2: "cpu"}.get(rc, "failed")
 
 
+_PROBE_CACHE = {}
+
+
 def _init_jax():
-    probe = _probe_tpu()
+    if "probe" not in _PROBE_CACHE:  # one subprocess probe per process,
+        _PROBE_CACHE["probe"] = _probe_tpu()  # not one per benchmark
+    probe = _PROBE_CACHE["probe"]
     import jax
     if probe != "accel":
         os.environ["JAX_PLATFORMS"] = "cpu"
