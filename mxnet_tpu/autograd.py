@@ -175,12 +175,18 @@ def _zero_cotangent(arr):
     return onp.zeros(arr.shape, dtype=jax.dtypes.float0)
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             _create_graph=False):
     """Run reverse accumulation from `heads`.
 
     ref: MXAutogradBackwardEx → Imperative::Backward (imperative.cc:280-523).
     Walks the eager tape in reverse creation order (already topological),
     vjp-ing each op; gradients land on marked NDArrays respecting grad_req.
+
+    With `_create_graph` (set by autograd.grad(create_graph=True)), every
+    vjp evaluation — and every gradient accumulation — is itself recorded
+    as a tape node, so the produced gradients can be differentiated again
+    (ref: imperative.cc:512-523 create_graph re-enabling recording).
     """
     from .ndarray.ndarray import NDArray  # cycle-free at call time
 
@@ -189,6 +195,12 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     tape = _STATE.tape
     if tape is None or not tape.nodes:
         raise MXNetError("no computation recorded; call inside autograd.record()")
+    if _create_graph and not _STATE.recording:
+        raise MXNetError(
+            "create_graph=True requires an active autograd.record() "
+            "scope: the backward pass records its own nodes, which is "
+            "impossible once recording has stopped")
+    record_bwd = _create_graph
 
     if head_grads is None:
         head_grads = [jnp.ones(h.shape, h.dtype) for h in heads]
@@ -203,13 +215,20 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     for h, hg in zip(heads, head_grads):
         grads[id(h._data)] = hg
 
-    for node in reversed(tape.nodes):
+    for node in reversed(list(tape.nodes)):
         out_grads = [grads.get(id(o)) for o in node.outputs]
         if all(g is None for g in out_grads):
             continue
         if not node.differentiable:
             continue
         from .ndarray.sparse_ops import SparseCotangent
+        if record_bwd and any(isinstance(g, SparseCotangent)
+                              for g in out_grads):
+            # densify() buffers and sparse accumulation are not recorded;
+            # silently wrong second derivatives are worse than an error
+            raise MXNetError(
+                "create_graph=True through sparse gradients "
+                "(row_sparse/SparseCotangent paths) is not supported")
         cotangents = [
             (g.densify() if isinstance(g, SparseCotangent) else g)
             if g is not None else _zero_cotangent(o)
@@ -224,12 +243,47 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
             _, vjp_fn = jax.vjp(_fn_tuple, *node.inputs)
             in_grads = vjp_fn(tuple(cotangents))
+            if record_bwd:
+                # create_graph: the vjp evaluation becomes a tape node
+                # over (original inputs, cotangents), so these gradients
+                # are themselves differentiable on the next backward
+                keep = [i for i, g in enumerate(in_grads)
+                        if g is not None and getattr(g, "dtype", None)
+                        != jax.dtypes.float0]
+                if keep:
+                    def _bwd_fn(*args, _f=node.fn,
+                                _n=len(node.inputs), _keep=tuple(keep)):
+                        ins, cots = args[:_n], args[_n:]
+
+                        def _tup(*xs):
+                            o = _f(*xs)
+                            return o if isinstance(o, (tuple, list)) \
+                                else (o,)
+
+                        _, vjp = jax.vjp(_tup, *ins)
+                        igs = vjp(tuple(cots))
+                        return tuple(igs[i] for i in _keep)
+
+                    tape.record(_bwd_fn,
+                                list(node.inputs) + list(cotangents),
+                                [in_grads[i] for i in keep],
+                                list(node.input_owners)
+                                + [None] * len(cotangents))
         for inp, owner, ig in zip(node.inputs, node.input_owners, in_grads):
             if ig is None or (hasattr(ig, "dtype") and ig.dtype == jax.dtypes.float0):
                 continue
             key = id(inp)
             if key in grads:
-                grads[key] = grads[key] + ig  # SparseCotangent sums too
+                prev = grads[key]
+                total = prev + ig  # SparseCotangent sums too
+                if record_bwd and not isinstance(prev, SparseCotangent) \
+                        and not isinstance(ig, SparseCotangent):
+                    # accumulation must live on the tape too, or the
+                    # summed gradient is an orphan the next backward
+                    # cannot reach
+                    tape.record(lambda a, b: (a + b,), [prev, ig],
+                                [total], [None, None])
+                grads[key] = total
             else:
                 grads[key] = ig
             if owner is not None and getattr(owner, "_grad", None) is not None:
@@ -303,9 +357,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """ref: python/mxnet/autograd.py:273 `grad` — returns grads instead of
-    storing into .grad buffers. create_graph (higher-order) is supported by
-    re-recording the vjp computation through the op layer is NOT yet done;
-    use jax.grad via hybridize for higher-order needs."""
+    storing into .grad buffers. With create_graph=True (inside record()),
+    the backward pass records its own vjp + accumulation nodes so the
+    returned gradients are differentiable again (higher-order grads;
+    ref: imperative.cc:512-523, tests/python/unittest/
+    test_higher_order_grad.py)."""
     from .ndarray.ndarray import NDArray, array as _nd_array
 
     if isinstance(heads, NDArray):
@@ -320,7 +376,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         v._grad_req = "write"
     try:
         backward(heads, head_grads, retain_graph=bool(retain_graph) or create_graph,
-                 train_mode=train_mode)
+                 train_mode=train_mode, _create_graph=create_graph)
         out = [v.grad for v in variables]
     finally:
         for v, g, req in saved:

@@ -103,7 +103,8 @@ def random_crop(src, size, interp=2):
 
 
 def color_normalize(src, mean, std=None):
-    src = src - mean
+    if mean is not None:  # std-only normalization is valid
+        src = src - mean
     if std is not None:
         src = src / std
     return src
@@ -881,7 +882,6 @@ class ImageDetIter(ImageIter):
 
     @property
     def provide_label(self):
-        from .io.io import DataDesc
         return [DataDesc(self.label_name,
                          (self.batch_size, self._max_objs,
                           self._obj_width))]
@@ -899,7 +899,6 @@ class ImageDetIter(ImageIter):
         return it
 
     def next(self):
-        from .io.io import DataBatch
         bd = onp.zeros((self.batch_size,) + self.data_shape, onp.float32)
         bl = onp.full((self.batch_size, self._max_objs, self._obj_width),
                       -1.0, onp.float32)

@@ -10,6 +10,7 @@ from .ndarray import (  # noqa: F401
     NDArray, array, zeros, ones, full, empty, arange, eye, linspace,
     concat, concatenate, stack, split, dot, save, load, load_frombuffer,
     waitall, from_numpy, moveaxis, invoke, _wrap,
+    to_dlpack_for_read, to_dlpack_for_write, from_dlpack,
 )
 from .. import ops as _ops
 from ..ops.registry import list_ops as _list_ops, make_nd_function as _make

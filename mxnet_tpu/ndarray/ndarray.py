@@ -226,6 +226,9 @@ class NDArray:
     def __dlpack__(self, **kwargs):
         return self._data.__dlpack__(**kwargs)
 
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
     def astype(self, dtype, copy=True) -> "NDArray":
         dt = _canon_dtype(dtype)
         if not copy and onp.dtype(dt) == self.dtype:
@@ -793,3 +796,96 @@ def load_frombuffer(buf: bytes):
     if names:
         return dict(zip(names, arrays))
     return arrays
+
+
+# ---------------------------------------------------------------------------
+# DLPack interop (ref: python/mxnet/ndarray/ndarray.py to_dlpack_for_read/
+# to_dlpack_for_write/from_dlpack; 3rdparty/dlpack role in SURVEY App. B —
+# zero-copy tensor exchange with torch/numpy/cupy)
+# ---------------------------------------------------------------------------
+
+def to_dlpack_for_read(data: "NDArray"):
+    """DLPack capsule sharing this array's buffer for reading
+    (ref: ndarray.py to_dlpack_for_read). The producer waits for
+    pending writes the way WaitToRead does."""
+    data.wait_to_read()
+    return data._data.__dlpack__()
+
+
+def to_dlpack_for_write(data: "NDArray"):
+    """ref: ndarray.py to_dlpack_for_write — a capsule whose consumer
+    mutations become visible to this array. XLA buffers are immutable,
+    so honoring write semantics is impossible; handing out the raw
+    buffer would let consumers silently corrupt state every compiled
+    computation assumes frozen. Raises with the supported recipe
+    (mutate on the consumer side, round-trip via from_dlpack)."""
+    raise MXNetError(
+        "to_dlpack_for_write is unsupported on the TPU backend: XLA "
+        "buffers are immutable. Export with to_dlpack_for_read, mutate "
+        "the consumer's own tensor, and import the result with "
+        "nd.from_dlpack instead")
+
+
+def from_dlpack(dlpack) -> "NDArray":
+    """Build an NDArray from any object speaking the DLPack protocol
+    (an object with __dlpack__/__dlpack_device__, or a legacy PyCapsule
+    e.g. from torch.utils.dlpack.to_dlpack), zero-copy where the
+    consumer allows (ref: ndarray.py from_dlpack)."""
+    if hasattr(dlpack, "__dlpack__") and hasattr(dlpack,
+                                                 "__dlpack_device__"):
+        return _wrap(jnp.from_dlpack(dlpack))
+
+    device = _capsule_device(dlpack)
+    if device[0] not in (1, 3):  # kDLCPU / kDLCPUPinned
+        raise MXNetError(
+            f"from_dlpack: legacy capsule holds device-type {device[0]} "
+            "memory; only host (CPU) capsules are supported — use the "
+            "modern __dlpack__ protocol object for device tensors")
+
+    class _CapsuleShim:
+        """Adapt a legacy capsule to the modern protocol, reporting the
+        device read from the capsule's DLManagedTensor header."""
+
+        def __init__(self, cap, dev):
+            self._cap = cap
+            self._dev = dev
+
+        def __dlpack__(self, **kwargs):
+            return self._cap
+
+        def __dlpack_device__(self):
+            return self._dev
+
+    return _wrap(jnp.from_dlpack(_CapsuleShim(dlpack, device)))
+
+
+def _capsule_device(capsule):
+    """Read (device_type, device_id) out of a legacy 'dltensor' capsule.
+
+    DLManagedTensor starts with DLTensor { void* data;
+    DLDevice { int32 device_type; int32 device_id; } ... } — the device
+    pair sits one pointer past the struct start."""
+    import ctypes
+    is_valid = ctypes.pythonapi.PyCapsule_IsValid
+    is_valid.restype = ctypes.c_int
+    is_valid.argtypes = [ctypes.py_object, ctypes.c_char_p]
+    get_ptr = ctypes.pythonapi.PyCapsule_GetPointer
+    get_ptr.restype = ctypes.c_void_p
+    get_ptr.argtypes = [ctypes.py_object, ctypes.c_char_p]
+    for name in (b"dltensor", b"dltensor_versioned"):
+        if is_valid(capsule, name):
+            ptr = get_ptr(capsule, name)
+            break
+    else:
+        return (1, 0)  # unrecognized capsule name: assume host
+    if not ptr:
+        return (1, 0)
+    base = ptr + ctypes.sizeof(ctypes.c_void_p)
+    if name == b"dltensor_versioned":
+        # DLManagedTensorVersioned prepends {version; void* manager_ctx;
+        # void* deleter; uint64 flags} before the DLTensor
+        base = ptr + 2 * ctypes.sizeof(ctypes.c_uint32) \
+            + 2 * ctypes.sizeof(ctypes.c_void_p) + 8 \
+            + ctypes.sizeof(ctypes.c_void_p)
+    dev = (ctypes.c_int32 * 2).from_address(base)
+    return (int(dev[0]), int(dev[1]))

@@ -164,3 +164,51 @@ def test_function_identity_passthrough_grad():
         y = Passthrough()(x)
     y.backward(nd.ones(y.shape))
     assert onp.allclose(x.grad.asnumpy(), 42.0), x.grad.asnumpy()
+
+
+def test_higher_order_grad_scalar():
+    """d2/dx2 x^3 = 6x via grad-of-grad (ref: tests/python/unittest/
+    test_higher_order_grad.py)."""
+    x = nd.array(onp.array([2.0, -1.0], "float32"))
+    x.attach_grad()
+
+    with autograd.record():
+        y = x * x * x
+        gx = autograd.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        z = gx.sum()
+    z.backward()
+    # d/dx (3x^2) = 6x
+    assert onp.allclose(x.grad.asnumpy(), 6.0 * x.asnumpy(), atol=1e-4), \
+        x.grad.asnumpy()
+
+
+def test_higher_order_grad_trig_and_exp():
+    """sin'' = -sin, exp'' = exp (ref: test_higher_order_grad.py)."""
+    for fn, d2 in [(nd.sin, lambda v: -onp.sin(v)),
+                   (nd.exp, lambda v: onp.exp(v))]:
+        x = nd.array(onp.array([0.3, -0.7, 1.2], "float32"))
+        x.attach_grad()
+        with autograd.record():
+            y = fn(x)
+            gx = autograd.grad(y, [x], create_graph=True,
+                               retain_graph=True)[0]
+            z = gx.sum()
+        z.backward()
+        assert onp.allclose(x.grad.asnumpy(), d2(x.asnumpy()),
+                            atol=1e-5), (fn, x.grad.asnumpy())
+
+
+def test_third_order_grad():
+    """d3/dx3 x^4 = 24x: grad-of-grad-of-grad chains."""
+    x = nd.array(onp.array([1.5], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x
+        g1 = autograd.grad(y, [x], create_graph=True,
+                           retain_graph=True)[0]
+        g2 = autograd.grad(g1, [x], create_graph=True,
+                           retain_graph=True)[0]
+        z = g2.sum()
+    z.backward()
+    assert onp.allclose(x.grad.asnumpy(), 24.0 * 1.5, atol=1e-3), \
+        x.grad.asnumpy()

@@ -204,3 +204,27 @@ def test_sparse_basics():
     assert csr.stype == "csr"
     assert csr.indptr.asnumpy().tolist() == [0, 1, 2, 2]
     assert_almost_equal(csr.tostype("default").asnumpy(), dense.asnumpy())
+
+
+def test_dlpack_torch_round_trip():
+    """Zero-copy tensor exchange via DLPack (ref: tests/python/unittest/
+    test_dlpack.py; 3rdparty/dlpack role): NDArray -> torch and back."""
+    torch = pytest.importorskip("torch")
+    x = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    t = torch.utils.dlpack.from_dlpack(nd.to_dlpack_for_read(x))
+    with pytest.raises(Exception, match="immutable"):
+        nd.to_dlpack_for_write(x)
+    assert t.shape == (3, 4)
+    assert onp.allclose(t.numpy(), x.asnumpy())
+    t2 = t * 2
+    y = nd.from_dlpack(torch.utils.dlpack.to_dlpack(t2))
+    assert isinstance(y, nd.NDArray)
+    assert onp.allclose(y.asnumpy(), x.asnumpy() * 2)
+
+
+def test_dlpack_protocol_object():
+    """from_dlpack also accepts any __dlpack__-speaking object
+    (the NDArray itself implements the protocol)."""
+    x = nd.array(onp.ones((2, 2), "float32"))
+    y = nd.from_dlpack(x)
+    assert onp.allclose(y.asnumpy(), 1.0)
