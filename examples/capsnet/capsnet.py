@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Capsule network with dynamic routing (ref: example/capsnet/):
+primary capsules -> digit capsules via routing-by-agreement (the
+iterative softmax-coupling loop), squash nonlinearity, margin loss on
+capsule lengths. Kept small enough to train on CPU in a minute.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+def squash(s, axis=-1, eps=1e-7):
+    """v = |s|^2/(1+|s|^2) * s/|s| (CapsNet eq. 1)."""
+    sq = nd.sum(nd.square(s), axis=axis, keepdims=True)
+    norm = nd.sqrt(sq + eps)
+    return (sq / (1.0 + sq)) * (s / norm)
+
+
+def _conv_out(s, k, stride):
+    return (s - k) // stride + 1
+
+
+class CapsNet(gluon.Block):
+    def __init__(self, n_class=4, n_prim=8, prim_dim=4, digit_dim=8,
+                 routings=3, input_size=20, **kw):
+        super().__init__(**kw)
+        self.n_class = n_class
+        self.n_prim = n_prim
+        self.prim_dim = prim_dim
+        self.digit_dim = digit_dim
+        if routings < 1:
+            raise ValueError("routing-by-agreement needs >= 1 iteration")
+        self.routings = routings
+        self.conv = gluon.nn.Conv2D(16, 5, strides=2, activation="relu")
+        self.prim = gluon.nn.Conv2D(n_prim * prim_dim, 3, strides=2)
+        grid = _conv_out(_conv_out(input_size, 5, 2), 3, 2)
+        self.n_in = n_prim * grid * grid
+        # transformation matrices W_ij: (1, N_in, n_class, digit, prim)
+        self.caps_w = self.params.get(
+            "caps_w", shape=(1, self.n_in, n_class, digit_dim, prim_dim))
+
+    def forward(self, x):
+        B = x.shape[0]
+        h = self.prim(self.conv(x))                 # (B, P*D, H, W)
+        _, PD, H, W = h.shape
+        u = h.reshape((B, self.n_prim, self.prim_dim, H, W)) \
+             .transpose((0, 1, 3, 4, 2)) \
+             .reshape((B, self.n_in, self.prim_dim))
+        u = squash(u)
+        Wm = self.caps_w.data()                     # (1,N,C,Dd,Dp)
+        # u_hat_{ij} = W_ij u_i : (B, N, C, Dd)
+        u_exp = u.expand_dims(2).expand_dims(3)     # (B,N,1,1,Dp)
+        u_hat = nd.sum(Wm * u_exp, axis=4)
+
+        # routing by agreement (the dynamic part)
+        b = nd.zeros((B, self.n_in, self.n_class))
+        u_hat_ng = u_hat.detach()  # routing iterations don't backprop
+        for r in range(self.routings):
+            c = nd.softmax(b, axis=2).expand_dims(3)   # coupling
+            src = u_hat if r == self.routings - 1 else u_hat_ng
+            s = nd.sum(c * src, axis=1)                # (B, C, Dd)
+            v = squash(s, axis=2)
+            if r < self.routings - 1:
+                b = b + nd.sum(u_hat_ng * v.expand_dims(1), axis=3)
+        return nd.sqrt(nd.sum(nd.square(v), axis=2) + 1e-9)  # lengths
+
+
+def margin_loss(lengths, y_onehot, m_pos=0.9, m_neg=0.1, lam=0.5):
+    loss = y_onehot * nd.square(nd.relu(m_pos - lengths)) \
+        + lam * (1 - y_onehot) * nd.square(nd.relu(lengths - m_neg))
+    return loss.sum(axis=1).mean()
+
+
+def make_batch(rs, n, classes=4, S=20):
+    y = rs.randint(0, classes, n)
+    x = rs.rand(n, 1, S, S).astype("float32") * 0.2
+    for i, c in enumerate(y):
+        x[i, 0, (c * S // classes):(c * S // classes) + 4, 2:-2] += 0.7
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--batch-size", type=int, default=24)
+    p.add_argument("--routings", type=int, default=3)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    net = CapsNet(routings=args.routings)
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+
+    rs = onp.random.RandomState(0)
+    eye = onp.eye(4, dtype="float32")
+    acc = 0.0
+    for step in range(args.steps):
+        xb, yb = make_batch(rs, args.batch_size)
+        x = nd.array(xb)
+        y1h = nd.array(eye[yb])
+        with autograd.record():
+            lengths = net(x)
+            loss = margin_loss(lengths, y1h)
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 40 == 0 or step == args.steps - 1:
+            acc = float((lengths.asnumpy().argmax(1) == yb).mean())
+            print(f"step {step}: margin loss "
+                  f"{float(loss.asscalar()):.4f} acc {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -235,6 +235,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             for g, o in zip(out_grads, node.outputs)
         ]
         if node.custom_backward is not None:
+            if record_bwd:
+                # a host-side custom backward (autograd.Function,
+                # CustomOp, sparse scatter) is opaque to the tape: its
+                # outputs would be unreachable orphans on the next
+                # backward — raise rather than return silent zeros
+                raise MXNetError(
+                    "create_graph=True through an op with a custom "
+                    "backward (autograd.Function / CustomOp) is not "
+                    "supported")
             in_grads = node.custom_backward(cotangents)
         else:
             def _fn_tuple(*args, _f=node.fn):

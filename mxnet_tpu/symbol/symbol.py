@@ -291,15 +291,16 @@ class Symbol:
     # jax.eval_shape over the compiled graph function)
     # ------------------------------------------------------------------
     def infer_shape(self, *args, **kwargs):
-        try:
-            return self._infer_shape_impl(False, *args, **kwargs)
-        except Exception:
-            return (None, None, None)
+        """Full inference: contradictory input shapes RAISE (ref:
+        infer_graph_attr_pass.cc fixed-point errors); underdetermined
+        entries come back as None."""
+        return self._infer_shape_impl(False, *args, _strict=True,
+                                      **kwargs)
 
     def infer_shape_partial(self, *args, **kwargs):
         return self._infer_shape_impl(True, *args, **kwargs)
 
-    def _infer_shape_impl(self, partial, *args, **kwargs):
+    def _infer_shape_impl(self, partial, *args, _strict=False, **kwargs):
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
         known: Dict[str, tuple] = {}
@@ -308,7 +309,7 @@ class Symbol:
                 if shape is not None:
                     known[name] = tuple(shape)
         known.update({k: tuple(v) for k, v in kwargs.items()})
-        shapes = _infer_all_shapes(self, known)
+        shapes = _infer_all_shapes(self, known, strict=_strict)
         arg_shapes = [shapes.get(n) for n in arg_names]
         aux_shapes = [shapes.get(n) for n in aux_names]
         out_shapes = [shapes.get(("__out__", i))
@@ -316,10 +317,32 @@ class Symbol:
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, *args, **kwargs):
+        """Dtype propagation (ref: infer_graph_attr_pass.cc:679
+        InferType): unknown parameter variables adopt their node's
+        carrier dtype (result_type of known inputs — e.g. fc_weight
+        becomes float64 when data is), `dtype`-parameterized ops
+        (cast/amp_cast/creation) set their own output type."""
         arg_names = self.list_arguments()
-        dt = onp.float32
-        return ([dt] * len(arg_names), [dt] * len(self._outputs),
-                [dt] * len(self.list_auxiliary_states()))
+        known: Dict[str, object] = {}
+        if args:
+            for name, t in zip(arg_names, args):
+                if t is not None:
+                    known[name] = onp.dtype(t)
+        known.update({k: onp.dtype(v) for k, v in kwargs.items()})
+        types = _infer_all_types(self, known)
+        arg_types = [types.get(n, onp.dtype(onp.float32))
+                     for n in arg_names]
+        aux_types = [types.get(n, onp.dtype(onp.float32))
+                     for n in self.list_auxiliary_states()]
+        out_types = []
+        for node, oi in self._outputs:
+            if node.is_variable:
+                out_types.append(types.get(node.name,
+                                           onp.dtype(onp.float32)))
+            else:
+                out_types.append(types.get((id(node), oi),
+                                           onp.dtype(onp.float32)))
+        return arg_types, out_types, aux_types
 
     # ------------------------------------------------------------------
     # binding (ref: symbol.py:1499 simple_bind → graph_executor.cc:1913)
@@ -622,8 +645,38 @@ def eval_graph(symbol: Symbol, value_map: Dict[str, "jax.Array"],
     return outputs, aux_updates
 
 
-def _infer_all_shapes(symbol: Symbol, known: Dict[str, tuple]
-                      ) -> Dict[object, tuple]:
+def _infer_all_types(symbol: Symbol, known: Dict[str, object]
+                     ) -> Dict[object, object]:
+    """Rule-based dtype propagation over the traced graph (the InferType
+    pass role). Per node: output dtype = its `dtype` param when present
+    (cast/creation family), else result_type of the known input dtypes;
+    unknown *variable* inputs (auto-created weights/biases) are
+    backfilled with that carrier dtype, mirroring the reference's
+    bidirectional fixed-point for the common layer case."""
+    types: Dict[object, object] = dict(known)
+    for node in symbol._topo_nodes():
+        if node.is_variable:
+            continue
+        in_types = []
+        for inode, oi in node.inputs:
+            t = types.get(inode.name) if inode.is_variable \
+                else types.get((id(inode), oi))
+            in_types.append(t)
+        ks = [t for t in in_types if t is not None]
+        carrier = onp.result_type(*ks) if ks else onp.dtype(onp.float32)
+        for (inode, _), t in zip(node.inputs, in_types):
+            if t is None and inode.is_variable:
+                types[inode.name] = carrier
+        dt = node.params.get("dtype")
+        out_t = onp.dtype(dt) if dt is not None else carrier
+        for i in range(node._n_out if node._n_out and node._n_out > 0
+                       else 1):
+            types[(id(node), i)] = out_t
+    return types
+
+
+def _infer_all_shapes(symbol: Symbol, known: Dict[str, tuple],
+                      strict: bool = False) -> Dict[object, tuple]:
     """Shape inference via jax.eval_shape (abstract evaluation — zero FLOPs).
 
     Forward-only: variables without known shapes must be inferable from
@@ -666,7 +719,15 @@ def _infer_all_shapes(symbol: Symbol, known: Dict[str, tuple]
             outs = list(out) if isinstance(out, (tuple, list)) else [out]
             for i, o in enumerate(outs):
                 shapes[(id(node), i)] = tuple(o.shape)
-        except Exception:
+        except Exception as e:
+            if strict:
+                # all inputs known yet abstract eval failed: the given
+                # shapes are CONTRADICTORY — surface it (ref: InferShape
+                # fixed-point errors), don't return an all-None triple
+                raise MXNetError(
+                    f"shape inference failed at op '{node.op}' "
+                    f"(node '{node.name}') with input shapes "
+                    f"{in_shapes}: {e}") from e
             continue
     for i, e in enumerate(symbol._outputs):
         shapes[("__out__", i)] = entry_shape(e)

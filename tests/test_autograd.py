@@ -212,3 +212,22 @@ def test_third_order_grad():
     z.backward()
     assert onp.allclose(x.grad.asnumpy(), 24.0 * 1.5, atol=1e-3), \
         x.grad.asnumpy()
+
+
+def test_create_graph_through_custom_backward_raises():
+    """Higher-order through a Function's opaque host backward would be
+    silently zero; it must raise instead."""
+    x = nd.array(onp.array([2.0], "float32"))
+    x.attach_grad()
+
+    class Square(autograd.Function):
+        def forward(self, a):
+            return a * a
+
+        def backward(self, dy):
+            return dy * 4  # arbitrary custom backward
+
+    with autograd.record():
+        y = Square()(x)
+        with pytest.raises(mx.base.MXNetError, match="custom backward"):
+            autograd.grad(y, [x], create_graph=True, retain_graph=True)

@@ -165,3 +165,8 @@ def test_lstnet_forecast_example():
     first, last = _load("multivariate_time_series/lstnet.py").main(
         ["--steps", "120"])
     assert last < first * 0.3
+
+
+def test_capsnet_example_routing_trains():
+    acc = _load("capsnet/capsnet.py").main(["--steps", "80"])
+    assert acc > 0.8

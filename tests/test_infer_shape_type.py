@@ -1,0 +1,86 @@
+"""Symbol shape/type inference (ref: tests/python/unittest/
+test_infer_shape.py, test_infer_type.py — the InferShape/InferType
+fixed-point pass, src/executor/infer_graph_attr_pass.cc:649,679)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def test_mlp_infer_shape():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=1000)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=10)
+    out = sym.SoftmaxOutput(fc2, name="sm")
+
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(100, 100))
+    args = dict(zip(out.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (1000, 100)
+    assert args["fc1_bias"] == (1000,)
+    assert args["fc2_weight"] == (10, 1000)
+    assert out_shapes[0] == (100, 10)
+
+
+def test_conv_pool_infer_shape():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, name="conv", num_filter=8,
+                           kernel=(3, 3), pad=(1, 1))
+    pool = sym.Pooling(conv, name="pool", kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    _, out_shapes, _ = pool.infer_shape(data=(2, 3, 32, 32))
+    assert out_shapes[0] == (2, 8, 16, 16)
+
+
+def test_infer_shape_partial():
+    """Partial inference leaves unknowable shapes unset instead of
+    raising (ref: test_infer_shape.py partial cases)."""
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes[0] is None or 0 in tuple(out_shapes[0] or (0,)) \
+        or out_shapes[0] == ()
+
+
+def test_backward_shape_consistency():
+    """Mismatched input shapes raise rather than mis-infer."""
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    with pytest.raises(Exception):
+        c.infer_shape(a=(2, 3), b=(4, 5))
+
+
+def test_infer_type_float_propagation():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=3)
+    arg_types, out_types, _ = fc.infer_type(data="float64")
+    types = dict(zip(fc.list_arguments(), arg_types))
+    assert onp.dtype(types["fc_weight"]) == onp.float64
+    assert onp.dtype(out_types[0]) == onp.float64
+
+    arg_types32, out_types32, _ = fc.infer_type(data="float32")
+    assert onp.dtype(out_types32[0]) == onp.float32
+
+
+def test_infer_type_through_cast():
+    data = sym.Variable("data")
+    c = sym.cast(data, dtype="float16")
+    _, out_types, _ = c.infer_type(data="float32")
+    assert onp.dtype(out_types[0]) == onp.float16
+
+
+def test_elementwise_broadcast_shapes():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = sym.broadcast_add(a, b)
+    _, out_shapes, _ = c.infer_shape(a=(2, 1, 4), b=(1, 3, 4))
+    assert out_shapes[0] == (2, 3, 4)
+
+
+def test_reshape_and_transpose_inference():
+    d = sym.Variable("d")
+    r = sym.transpose(sym.reshape(d, shape=(0, -1)), axes=(1, 0))
+    _, out_shapes, _ = r.infer_shape(d=(4, 3, 2))
+    assert out_shapes[0] == (6, 4)
