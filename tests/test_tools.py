@@ -1,0 +1,82 @@
+"""CLI tools tier (ref: tools/{parse_log,rec2idx,diagnose,
+flakiness_checker}.py and benchmark/opperf/)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import recordio
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=240):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # tools don't need the 8-device mesh
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=timeout, env=env, cwd=ROOT)
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Batch [20] Speed: 5000.10 samples/sec\n"
+        "INFO:root:Epoch[0] Train-accuracy=0.850000\n"
+        "INFO:root:Epoch[0] Time cost=12.300\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.800000\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.910000\n")
+    r = _run([os.path.join(ROOT, "tools", "parse_log.py"), str(log)])
+    assert r.returncode == 0, r.stderr
+    assert "0.85000" in r.stdout and "0.80000" in r.stdout
+    r2 = _run([os.path.join(ROOT, "tools", "parse_log.py"), str(log),
+               "--format", "csv"])
+    assert "epoch,train-accuracy" in r2.stdout
+
+
+def test_rec2idx_round_trip(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(6):
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              b"payload%d" % i))
+    w.close()
+    r = _run([os.path.join(ROOT, "tools", "rec2idx.py"), rec])
+    assert r.returncode == 0, r.stderr
+    idx_path = str(tmp_path / "data.idx")
+    assert len(open(idx_path).read().splitlines()) == 6
+    ir = recordio.MXIndexedRecordIO(idx_path, rec, "r")
+    _, payload = recordio.unpack(ir.read_idx(4))
+    assert payload == b"payload4"
+
+
+def test_diagnose_runs():
+    r = _run([os.path.join(ROOT, "tools", "diagnose.py")], timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "Python Info" in r.stdout
+    assert "MXNet-TPU Info" in r.stdout
+    assert "Version" in r.stdout
+
+
+def test_opperf_subset_json():
+    r = _run([os.path.join(ROOT, "tools", "opperf.py"), "--runs", "2",
+              "--ops", "exp,sum,FullyConnected", "--json"], timeout=420)
+    assert r.returncode == 0, r.stderr
+    import json
+    line = [ln for ln in r.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    data = json.loads(line)
+    ops = {x["op"]: x for x in data["results"]}
+    assert set(ops) == {"exp", "sum", "FullyConnected"}
+    assert all(v["fwd_ms"] > 0 for v in ops.values())
+    assert ops["FullyConnected"]["fwd_bwd_ms"] is not None
+
+
+def test_flakiness_checker_detects_pass(tmp_path):
+    t = tmp_path / "test_trivial_check.py"
+    t.write_text("def test_always_passes():\n    assert True\n")
+    r = _run([os.path.join(ROOT, "tools", "flakiness_checker.py"),
+              str(t), "-n", "2"], timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "2/2 passed" in r.stdout

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Environment diagnosis (ref: tools/diagnose.py — dump platform,
+package versions, hardware and environment variables for bug reports).
+"""
+import os
+import platform
+import subprocess
+import sys
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+
+
+def check_pip():
+    print("------------Pip Info-----------")
+    try:
+        import pip
+        print("Version      :", pip.__version__)
+    except ImportError:
+        print("No corresponding pip install for current python.")
+
+
+def check_mxnet():
+    print("----------MXNet-TPU Info-----------")
+    try:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        import jax
+        if "--tpu" not in sys.argv:  # don't hang on a wedged tunnel
+            jax.config.update("jax_platforms", "cpu")
+        import mxnet_tpu as mx
+        print("Version      :", mx.__version__)
+        print("Directory    :", os.path.dirname(mx.__file__))
+        from mxnet_tpu.runtime import Features
+        feats = Features()
+        enabled = [f for f in feats if feats.is_enabled(f)]
+        print("Num features :", len(list(feats)))
+        print("Enabled      :", ", ".join(sorted(enabled)[:12]), "...")
+    except Exception as e:
+        print("Import error :", e)
+
+
+def check_hardware():
+    print("----------Hardware Info----------")
+    print("Machine      :", platform.machine())
+    print("Processor    :", platform.processor() or "n/a")
+    if sys.platform.startswith("linux"):
+        try:
+            out = subprocess.run(["lscpu"], capture_output=True,
+                                 text=True, timeout=10).stdout
+            for line in out.splitlines():
+                if any(k in line for k in ("Model name", "CPU(s):",
+                                           "Thread", "Socket")):
+                    print(line.strip())
+        except Exception:
+            pass
+    # probe devices in a killable subprocess: jax.devices() HANGS (not
+    # raises) when the accelerator tunnel is down
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            capture_output=True, text=True, timeout=60)
+        print("JAX devices  :",
+              (out.stdout.strip().splitlines() or ["unknown"])[-1]
+              if out.returncode == 0 else f"probe rc={out.returncode}")
+    except subprocess.TimeoutExpired:
+        print("JAX devices  : PROBE TIMED OUT (accelerator tunnel down?)")
+    except Exception as e:
+        print("JAX devices  : unavailable (%s)" % e)
+
+
+def check_os():
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def check_environment():
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXNET_", "MXTPU_", "JAX_", "XLA_", "OMP_",
+                         "KMP_", "DMLC_")):
+            print(f"{k}=\"{v}\"")
+
+
+def main():
+    check_python()
+    check_pip()
+    check_os()
+    check_hardware()
+    check_environment()
+    check_mxnet()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Run one test many times to detect flakiness (ref:
+tools/flakiness_checker.py — repeated seeded runs of a single test).
+
+Usage:
+  python tools/flakiness_checker.py tests/test_operators.py::test_foo \
+      [-n 20] [--seed 7]
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def run(test, n, seed=None):
+    env = dict(os.environ)
+    failures = 0
+    for i in range(n):
+        if seed is not None:
+            env["MXNET_TEST_SEED"] = str(seed + i)
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", test, "-q", "-x"],
+            env=env, capture_output=True, text=True)
+        ok = proc.returncode == 0
+        failures += 0 if ok else 1
+        print(f"run {i + 1}/{n}: {'PASS' if ok else 'FAIL'}"
+              + ("" if ok else f"  (seed {env.get('MXNET_TEST_SEED')})"))
+        if not ok and failures == 1:
+            print(proc.stdout[-1500:])
+    print(f"\n{n - failures}/{n} passed"
+          + (f" — FLAKY ({failures} failures)" if failures else ""))
+    return failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("test", help="pytest node id")
+    p.add_argument("-n", "--num-trials", type=int, default=10)
+    p.add_argument("--seed", type=int, default=None,
+                   help="base seed; trial i uses seed+i")
+    args = p.parse_args(argv)
+    return run(args.test, args.num_trials, args.seed)
+
+
+if __name__ == "__main__":
+    sys.exit(1 if main() else 0)

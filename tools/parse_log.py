@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Parse training logs into per-epoch tables (ref: tools/parse_log.py —
+extracts train/val accuracy and speed from fit() logging output).
+
+Usage: python tools/parse_log.py logfile [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+
+# the log lines emitted by callback.Speedometer / BaseModule.fit
+RE_SPEED = re.compile(
+    r"Epoch\[(\d+)\].*?Speed[:=]\s*([\d.]+)\s*samples")
+RE_TRAIN_METRIC = re.compile(
+    r"Epoch\[(\d+)\].*?Train-?([\w-]+)[:=]([\d.nan]+)")
+RE_VAL_METRIC = re.compile(
+    r"Epoch\[(\d+)\].*?Validation-?([\w-]+)[:=]([\d.nan]+)")
+RE_TIME = re.compile(r"Epoch\[(\d+)\].*?Time cost[:=]\s*([\d.]+)")
+
+
+def parse(lines):
+    epochs = {}
+
+    def ep(i):
+        return epochs.setdefault(int(i), {"speed": [], "train": {},
+                                          "val": {}, "time": None})
+
+    for ln in lines:
+        m = RE_SPEED.search(ln)
+        if m:
+            ep(m.group(1))["speed"].append(float(m.group(2)))
+        m = RE_TRAIN_METRIC.search(ln)
+        if m:
+            ep(m.group(1))["train"][m.group(2)] = float(m.group(3))
+        m = RE_VAL_METRIC.search(ln)
+        if m:
+            ep(m.group(1))["val"][m.group(2)] = float(m.group(3))
+        m = RE_TIME.search(ln)
+        if m:
+            ep(m.group(1))["time"] = float(m.group(2))
+    return epochs
+
+
+def render(epochs, fmt="markdown"):
+    metrics = sorted({k for e in epochs.values()
+                      for k in list(e["train"]) + list(e["val"])})
+    header = ["epoch"] + [f"train-{m}" for m in metrics] \
+        + [f"val-{m}" for m in metrics] + ["speed", "time"]
+    rows = []
+    for i in sorted(epochs):
+        e = epochs[i]
+        speed = sum(e["speed"]) / len(e["speed"]) if e["speed"] else None
+
+        def f(v):
+            return f"{v:.5f}" if isinstance(v, float) else ""
+        rows.append([str(i)]
+                    + [f(e["train"].get(m)) for m in metrics]
+                    + [f(e["val"].get(m)) for m in metrics]
+                    + [f(speed), f(e["time"])])
+    if fmt == "csv":
+        return "\n".join(",".join(r) for r in [header] + rows)
+    w = [max(len(r[i]) for r in [header] + rows)
+         for i in range(len(header))]
+    out = [" | ".join(h.ljust(x) for h, x in zip(header, w)),
+           "-|-".join("-" * x for x in w)]
+    out += [" | ".join(c.ljust(x) for c, x in zip(r, w)) for r in rows]
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile")
+    p.add_argument("--format", default="markdown",
+                   choices=["markdown", "csv"])
+    args = p.parse_args(argv)
+    with open(args.logfile) as fin:
+        table = render(parse(fin), args.format)
+    print(table)
+    return table
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Build the .idx companion for a .rec file (ref: tools/rec2idx.py) so
+MXIndexedRecordIO / shuffling iterators can seek by record id.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_index(rec_path, idx_path):
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # host-side tool
+    from mxnet_tpu import recordio
+
+    reader = recordio.MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as idx:
+        while True:
+            pos = reader.tell()
+            item = reader.read()
+            if item is None:
+                break
+            idx.write(f"{n}\t{pos}\n")
+            n += 1
+    reader.close()
+    return n
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("record", help="path of the .rec file")
+    p.add_argument("index", nargs="?", help="output .idx path")
+    args = p.parse_args(argv)
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = build_index(args.record, idx)
+    print(f"wrote {n} entries to {idx}")
+    return n
+
+
+if __name__ == "__main__":
+    main()
