@@ -231,3 +231,32 @@ def test_create_graph_through_custom_backward_raises():
         y = Square()(x)
         with pytest.raises(mx.base.MXNetError, match="custom backward"):
             autograd.grad(y, [x], create_graph=True, retain_graph=True)
+
+
+def test_thread_local_recording_isolation():
+    """Two threads recording concurrently keep independent tapes
+    (ref: tests/nightly/test_tlocal_racecondition.py — the thread-local
+    is_recording_/tape state, imperative.cc:26-32)."""
+    import threading
+
+    results = {}
+
+    def worker(tid, scale):
+        x = nd.array(onp.full((4,), float(tid + 1), "float32"))
+        x.attach_grad()
+        for _ in range(10):
+            with autograd.record():
+                y = (x * scale).sum()
+            y.backward()
+        results[tid] = (float(x.grad.asnumpy()[0]), scale)
+
+    threads = [threading.Thread(target=worker, args=(i, float(i + 2)))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4
+    for tid, (g, scale) in results.items():
+        assert g == scale, f"thread {tid}: grad {g} != scale {scale}"
+    assert not autograd.is_recording()  # main thread untouched

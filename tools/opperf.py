@@ -111,18 +111,21 @@ def time_op(name, builder, kwargs, fn, runs, warmup=3):
         for a in grad_args:
             a.attach_grad()
         try:
+            head = None  # allocated once; shape is fixed across runs
             for _ in range(warmup):
                 with autograd.record():
                     out = fn(*args, **kwargs)
                     out = out[0] if isinstance(out, (list, tuple)) else out
-                out.backward(nd.ones(out.shape))
+                if head is None:
+                    head = nd.ones(out.shape)
+                out.backward(head)
             _sync(grad_args[0].grad)
             t0 = time.perf_counter()
             for _ in range(runs):
                 with autograd.record():
                     out = fn(*args, **kwargs)
                     out = out[0] if isinstance(out, (list, tuple)) else out
-                out.backward(nd.ones(out.shape))
+                out.backward(head)
             _sync(grad_args[0].grad)
             bwd_ms = (time.perf_counter() - t0) / runs * 1e3
         except Exception:
@@ -148,7 +151,8 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     table = _ops_table(_PROFILES[args.profile])
-    selected = args.ops.split(",") if args.ops else sorted(table)
+    selected = [s.strip() for s in args.ops.split(",")] if args.ops \
+        else sorted(table)
     results = []
     for name in selected:
         if name not in table:
@@ -161,7 +165,7 @@ def main(argv=None):
                         "fwd_bwd_ms": round(bwd, 4) if bwd else None})
     if not results:
         print("no valid ops selected", file=sys.stderr)
-        return results
+        sys.exit(2)
     if args.json:
         print(json.dumps({"profile": args.profile, "runs": args.runs,
                           "results": results}))

@@ -8,13 +8,15 @@ import argparse
 import re
 import sys
 
-# the log lines emitted by callback.Speedometer / BaseModule.fit
+# the log lines emitted by callback.Speedometer / BaseModule.fit;
+# values may be negative (log-likelihood losses) or scientific notation
+_NUM = r"(nan|[-+]?[\d.]+(?:[eE][-+]?\d+)?)"
 RE_SPEED = re.compile(
     r"Epoch\[(\d+)\].*?Speed[:=]\s*([\d.]+)\s*samples")
 RE_TRAIN_METRIC = re.compile(
-    r"Epoch\[(\d+)\].*?Train-?([\w-]+)[:=]([\d.nan]+)")
+    r"Epoch\[(\d+)\].*?Train-?([\w-]+)[:=]" + _NUM)
 RE_VAL_METRIC = re.compile(
-    r"Epoch\[(\d+)\].*?Validation-?([\w-]+)[:=]([\d.nan]+)")
+    r"Epoch\[(\d+)\].*?Validation-?([\w-]+)[:=]" + _NUM)
 RE_TIME = re.compile(r"Epoch\[(\d+)\].*?Time cost[:=]\s*([\d.]+)")
 
 
