@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Named-entity recognition (ref: example/named_entity_recognition/):
+bi-LSTM token tagger over padded sentences with a masked loss — padding
+positions contribute nothing to the objective or the metric.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+class Tagger(gluon.HybridBlock):
+    def __init__(self, vocab, tags, hidden, **kw):
+        super().__init__(**kw)
+        self.embed = gluon.nn.Embedding(vocab, hidden)
+        self.lstm = gluon.rnn.LSTM(hidden, layout="NTC",
+                                   bidirectional=True)
+        self.out = gluon.nn.Dense(tags, flatten=False)
+
+    def hybrid_forward(self, F, tokens):
+        return self.out(self.lstm(self.embed(tokens)))
+
+
+def make_batch(rs, n, T, vocab, n_tags):
+    """Tag rule: entity tokens are ids < n_tags-1 and are tagged with
+    their own id + 1; everything else is tag 0 ('O'). Variable-length
+    sentences padded with token 0/tag -1."""
+    toks = rs.randint(n_tags, vocab, (n, T))
+    tags = onp.zeros((n, T), "int64")
+    lengths = rs.randint(T // 2, T + 1, n)
+    for i in range(n):
+        n_ent = rs.randint(1, 4)
+        pos = rs.choice(lengths[i], min(n_ent, lengths[i]),
+                        replace=False)
+        ids = rs.randint(0, n_tags - 1, len(pos))
+        toks[i, pos] = ids
+        tags[i, pos] = ids + 1
+        toks[i, lengths[i]:] = 0
+        tags[i, lengths[i]:] = -1  # padding: ignored
+    return toks.astype("float32"), tags.astype("float32"), lengths
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=12)
+    p.add_argument("--vocab", type=int, default=60)
+    p.add_argument("--tags", type=int, default=4)
+    p.add_argument("--hidden", type=int, default=32)
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    net = Tagger(args.vocab, args.tags, args.hidden)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rs = onp.random.RandomState(0)
+    acc = 0.0
+    for step in range(args.steps):
+        xb, yb, _ = make_batch(rs, args.batch_size, args.seq_len,
+                               args.vocab, args.tags)
+        x, y = nd.array(xb), nd.array(yb)
+        mask = nd.array((yb >= 0).astype("float32"))
+        with autograd.record():
+            logits = net(x)                       # (B, T, tags)
+            per_tok = ce(logits.reshape((-1, args.tags)),
+                         nd.relu(y).reshape((-1,)))  # pad tags -> 0
+            loss = (per_tok * mask.reshape((-1,))).sum() / mask.sum()
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step % 50 == 0 or step == args.steps - 1:
+            pred = logits.asnumpy().argmax(2)
+            m = yb >= 0
+            acc = float((pred[m] == yb[m]).mean())
+            print(f"step {step}: masked loss "
+                  f"{float(loss.asscalar()):.3f} token acc {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -170,3 +170,9 @@ def test_lstnet_forecast_example():
 def test_capsnet_example_routing_trains():
     acc = _load("capsnet/capsnet.py").main(["--steps", "80"])
     assert acc > 0.8
+
+
+def test_ner_example_masked_tagging():
+    acc = _load("named_entity_recognition/ner.py").main(
+        ["--steps", "120"])
+    assert acc > 0.85
