@@ -52,7 +52,13 @@ def imdecode(buf, flag=1, to_rgb=True, **kwargs):
     try:
         from PIL import Image
         import io as _io
-        img = onp.asarray(Image.open(_io.BytesIO(buf)))
+        pil = Image.open(_io.BytesIO(buf))
+        # honor flag/to_rgb like the cv2 path: flag=0 -> grayscale;
+        # to_rgb=False means BGR channel order (OpenCV native)
+        pil = pil.convert("L" if not flag else "RGB")
+        img = onp.asarray(pil)
+        if flag and not to_rgb:
+            img = img[:, :, ::-1]
         return array(img)
     except ImportError:
         raise MXNetError("no image decoder available (cv2/PIL missing)")
@@ -64,15 +70,15 @@ def imread(filename, flag=1, to_rgb=True):
 
 
 def imresize(src, w, h, interp=1):
-    import jax
-    data = src._data if isinstance(src, NDArray) else onp.asarray(src)
-    from .ndarray.ndarray import _wrap
+    """One resize implementation for the whole framework: delegates to
+    the registered `_cvimresize` op (image_io.cc role) so mx.image and
+    nd._cvimresize cannot drift."""
     import jax.numpy as jnp
-    out = jax.image.resize(jnp.asarray(data, jnp.float32),
-                           (h, w, data.shape[2]), method="linear")
-    return _wrap(out.astype(jnp.asarray(data).dtype)
-                 if onp.issubdtype(onp.asarray(data).dtype, onp.integer)
-                 else out)
+    from .ndarray.ndarray import _wrap
+    from .ops.extra_ops import cvimresize
+    data = src._data if isinstance(src, NDArray) else \
+        jnp.asarray(onp.asarray(src))
+    return _wrap(cvimresize(data, w=w, h=h, interp=interp))
 
 
 def resize_short(src, size, interp=2):

@@ -107,3 +107,23 @@ def _contrib_boolean_mask(data, index, axis=0):
                     out_arrays=[out], in_owners=[data, index],
                     custom_backward=custom_backward)
     return out_nd
+
+
+def _cvimdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """ref: image_io.cc _cvimdecode — host JPEG/PNG decode to NDArray.
+    The input is raw bytes (or a uint8 NDArray of bytes), a host-side
+    operation like the reference's OpenCV call."""
+    from ..image import imdecode as _imdec
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    return _imdec(buf, flag=flag, to_rgb=to_rgb)
+
+
+def _cvimread(filename, flag=1, to_rgb=True, **kwargs):
+    """ref: image_io.cc _cvimread."""
+    from ..image import imread as _imrd
+    return _imrd(filename, flag=flag, to_rgb=to_rgb)
+
+
+_npi_cvimdecode = _cvimdecode
+_npi_cvimread = _cvimread

@@ -885,3 +885,53 @@ register_op("_NDArray", differentiable=False)(_unsupported(
 register_op("_Native", differentiable=False)(_unsupported(
     "_Native", "legacy v0.x Python callback op; use Custom "
     "(mxnet_tpu.operator.register)"))
+
+
+# ---------------------------------------------------------------------------
+# OpenCV-role image IO ops (ref: src/io/image_io.cc — _cvimdecode/_cvimread/
+# _cvimresize/_cvcopyMakeBorder, exposed as mx.img.* in the reference)
+# ---------------------------------------------------------------------------
+
+register_op("_copyto", aliases=["_npi_copyto"])(
+    lambda data: jnp.copy(data))
+
+
+@register_op("_cvimresize", aliases=["_npi_cvimresize"])
+def cvimresize(data, w=0, h=0, interp=1):
+    """ref: image_io.cc imresize — (H, W, C) resize; w/h are required
+    (the reference's params have no defaults). Integer dtypes saturate
+    to their own range like OpenCV, not to uint8's."""
+    import jax
+    if int(w) <= 0 or int(h) <= 0:
+        raise ValueError(f"imresize requires positive w/h, got "
+                         f"w={w}, h={h}")
+    out = jax.image.resize(data.astype(jnp.float32),
+                           (int(h), int(w), data.shape[2]),
+                           method="nearest" if int(interp) == 0
+                           else "linear")
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        info = jnp.iinfo(data.dtype)
+        return jnp.clip(jnp.round(out), info.min,
+                        info.max).astype(data.dtype)
+    return out.astype(data.dtype)
+
+
+@register_op("_cvcopyMakeBorder", aliases=["_npi_copyMakeBorder"])
+def cvcopy_make_border(data, top=0, bot=0, left=0, right=0, type=0,
+                       value=0.0, values=()):
+    """ref: image_io.cc copyMakeBorder — pad an (H, W, C) image.
+    cv2 border types: 0 CONSTANT, 1 REPLICATE (edge), 2 REFLECT
+    (edge-repeated = numpy 'symmetric'), 3 WRAP, 4 REFLECT_101
+    (numpy 'reflect')."""
+    mode = {0: "constant", 1: "edge", 2: "symmetric", 3: "wrap",
+            4: "reflect"}.get(int(type), "edge")
+    pad = ((int(top), int(bot)), (int(left), int(right)), (0, 0))
+    if mode == "constant":
+        if values:
+            chans = [jnp.pad(data[:, :, c:c + 1], pad, mode="constant",
+                             constant_values=float(values[min(c, len(values) - 1)]))
+                     for c in range(data.shape[2])]
+            return jnp.concatenate(chans, axis=2)
+        return jnp.pad(data, pad, mode="constant",
+                       constant_values=float(value))
+    return jnp.pad(data, pad, mode=mode)

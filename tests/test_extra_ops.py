@@ -751,3 +751,50 @@ def test_custom_op_jit_aux_fresh_per_forward():
     assert float(onp.asarray(f(x))[0]) == 3.0
     g = jax.grad(lambda v: jnp.sum(f(v)))(x)
     assert float(onp.asarray(g)[0]) == 1.0  # backward saw aux==1
+
+
+def test_cv_image_io_ops():
+    """ref: src/io/image_io.cc — _cvimresize/_cvcopyMakeBorder registry
+    ops and the host-side _cvimdecode/_cvimread wrappers."""
+    import io as pyio
+
+    from PIL import Image
+
+    img = nd.array(onp.arange(48, dtype="float32").reshape(4, 4, 3))
+    r = nd._cvimresize(img, w=8, h=6)
+    assert r.shape == (6, 8, 3)
+    b = nd._cvcopyMakeBorder(img, top=1, bot=2, left=3, right=4,
+                             value=7.0)
+    assert b.shape == (7, 11, 3)
+    assert float(b.asnumpy()[0, 0, 0]) == 7.0
+    assert onp.allclose(b.asnumpy()[1:5, 3:7], img.asnumpy())
+    # per-channel border values
+    bc = nd._cvcopyMakeBorder(img, top=1, bot=0, left=0, right=0,
+                              values=(1.0, 2.0, 3.0))
+    assert onp.allclose(bc.asnumpy()[0, 0], [1.0, 2.0, 3.0])
+
+    buf = pyio.BytesIO()
+    Image.fromarray(onp.zeros((5, 6, 3), "uint8")).save(buf,
+                                                        format="PNG")
+    d = nd._cvimdecode(buf.getvalue())
+    assert d.shape == (5, 6, 3)
+    assert nd._copyto(img).shape == img.shape
+
+
+def test_cv_border_types_and_int_ranges():
+    """Border modes map to cv2 semantics; integer resize saturates to
+    the dtype's own range, not uint8's."""
+    img = nd.array(onp.array([[[1.], [2.]], [[3.], [4.]]], "float32"))
+    # REPLICATE (type 1): top row repeats the edge row [1, 2]
+    rep = nd._cvcopyMakeBorder(img, top=1, type=1).asnumpy()
+    assert rep[0, 0, 0] == 1.0 and rep[0, 1, 0] == 2.0
+    # WRAP (type 3): top row wraps from the bottom row [3, 4]
+    wrap = nd._cvcopyMakeBorder(img, top=1, type=3).asnumpy()
+    assert wrap[0, 0, 0] == 3.0 and wrap[0, 1, 0] == 4.0
+
+    labels = nd.array(onp.full((4, 4, 1), 1000, "int32"))
+    r = nd._cvimresize(labels, w=2, h=2)
+    assert int(r.asnumpy().max()) == 1000  # not clipped to 255
+
+    with pytest.raises(Exception):
+        nd._cvimresize(labels)  # w/h required
