@@ -42,6 +42,7 @@ def _sym_identity():
 # curated inputs: name -> lambda returning (args, params)
 CASES = {
     "pick": lambda: ([T(4, 5), I(4, hi=5)], {}),
+    "_cvimresize": lambda: ([T(4, 5, 3)], {"w": 8, "h": 6}),
     "dot": lambda: ([T(3, 4), T(4, 5)], {}),
     "batch_dot": lambda: ([T(2, 3, 4), T(2, 4, 5)], {}),
     "reshape": lambda: ([T(2, 6)], {"shape": (3, 4)}),
