@@ -52,18 +52,25 @@ def _emit(value, unit="images/sec", vs=None, **extra):
 
 
 def _probe_tpu(timeout_s=150):
-    """Check in a SUBPROCESS whether an accelerator backend comes up.
+    """Check in a SUBPROCESS whether an accelerator backend actually
+    EXECUTES, not just enumerates.
 
     jax.devices() can HANG (not raise) when the TPU plugin's transport
     is down — a hang in-process would eat the driver's whole timeout
-    (that is what produced rc=124 in round 1). A subprocess probe is
+    (that is what produced rc=124 in round 1). Worse, a half-up tunnel
+    can enumerate the chip fine and then hang on the first compile or
+    execute (observed in round 2: devices() returned in seconds, the
+    warmup step never finished). So the probe runs a real matmul on
+    the accelerator and blocks on the result. A subprocess probe is
     killable. Tri-state result: "accel", "cpu" (backend healthy but
     CPU-only — definitive, don't retry), "failed" (crash/hang).
     """
     import subprocess
-    code = ("import jax, sys; "
-            "sys.exit(0 if any(d.platform != 'cpu' "
-            "for d in jax.devices()) else 2)")
+    code = ("import jax, sys; import jax.numpy as jnp; "
+            "accel=[d for d in jax.devices() if d.platform != 'cpu']; "
+            "sys.exit(2) if not accel else None; "
+            "x = jax.device_put(jnp.ones((128, 128)), accel[0]); "
+            "(x @ x).block_until_ready(); sys.exit(0)")
     try:
         rc = subprocess.run([sys.executable, "-c", code],
                             timeout=timeout_s,
