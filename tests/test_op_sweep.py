@@ -362,7 +362,23 @@ GRAD_OPS = [
     ("log1p", 1), ("rsqrt", 1), ("elemwise_add", 2), ("elemwise_mul", 2),
     ("elemwise_sub", 2), ("elemwise_div", 2), ("broadcast_maximum", 2),
     ("broadcast_power", 2), ("broadcast_hypot", 2), ("smooth_l1", 1),
+    # round-2 widening: trig/hyperbolic/special + matrix/reduce/shape ops
+    ("sin", 1), ("cos", 1), ("arcsinh", 1), ("arctanh", 1),
+    ("gamma", 1), ("gammaln", 1), ("reciprocal", 1), ("log2", 1),
+    ("log10", 1), ("degrees", 1), ("radians", 1), ("hard_sigmoid", 1),
+    ("softmax", 1), ("log_softmax", 1), ("sum", 1), ("mean", 1),
+    ("prod", 1), ("nansum", 1), ("L2Normalization", 1), ("dot", 2),
+    ("batch_dot", 2), ("broadcast_add", 2), ("broadcast_sub", 2),
+    ("broadcast_mul", 2), ("broadcast_div", 2), ("broadcast_minimum", 2),
+    ("transpose", 1), ("Flatten", 1), ("negative", 1),
 ]
+
+
+# ops whose inputs cannot all share one (3, 4) shape
+_GRAD_SHAPES = {
+    "dot": [(3, 4), (4, 3)],
+    "batch_dot": [(2, 3, 4), (2, 4, 3)],
+}
 
 
 @pytest.mark.parametrize("name,n_in", GRAD_OPS)
@@ -370,8 +386,9 @@ def test_numeric_gradient(name, n_in):
     """Tape backward vs central finite differences (ref:
     check_numeric_gradient, python/mxnet/test_utils.py)."""
     eps = 1e-3
-    xs = [nd.array(rs.uniform(0.2, 0.8, (3, 4)).astype("float32"))
-          for _ in range(n_in)]
+    shapes = _GRAD_SHAPES.get(name, [(3, 4)] * n_in)
+    xs = [nd.array(rs.uniform(0.2, 0.8, s).astype("float32"))
+          for s in shapes]
     for x in xs:
         x.attach_grad()
     fn = getattr(nd, name)

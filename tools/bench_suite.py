@@ -35,14 +35,8 @@ def _probe_tpu(timeout_s=120):
     then hangs on the first compile/execute). __graft_entry__ keeps its
     own self-contained copy by design — it must run with nothing but
     the repo checkout."""
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "_bench_probe", os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "bench.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod._probe_tpu(timeout_s)
+    import bench  # repo root is on sys.path (line above)
+    return bench._probe_tpu(timeout_s)
 
 
 _PROBE_CACHE = {}
