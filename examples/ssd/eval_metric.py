@@ -1,0 +1,149 @@
+"""Detection mAP metrics (ref: example/ssd/evaluate/eval_metric.py —
+MApMetric and VOC07MApMetric).
+
+Labels per image: (M, 5+) rows [cls, xmin, ymin, xmax, ymax,
+(difficult)], -1-padded. Predictions per image: (N, 6) rows
+[cls, score, xmin, ymin, xmax, ymax] with cls = -1 for padding slots
+(the MultiBoxDetection output layout).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from mxnet_tpu.metric import EvalMetric
+
+
+def _iou(box, boxes):
+    """IoU of one box against (K, 4) boxes (corner format)."""
+    ix1 = onp.maximum(box[0], boxes[:, 0])
+    iy1 = onp.maximum(box[1], boxes[:, 1])
+    ix2 = onp.minimum(box[2], boxes[:, 2])
+    iy2 = onp.minimum(box[3], boxes[:, 3])
+    iw = onp.maximum(0.0, ix2 - ix1)
+    ih = onp.maximum(0.0, iy2 - iy1)
+    inter = iw * ih
+    a1 = (box[2] - box[0]) * (box[3] - box[1])
+    a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = a1 + a2 - inter
+    return onp.where(union > 0, inter / onp.maximum(union, 1e-12), 0.0)
+
+
+class MApMetric(EvalMetric):
+    """Mean average precision over detection classes
+    (ref: eval_metric.py MApMetric)."""
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False,
+                 class_names=None, pred_idx=0, name="mAP"):
+        self.ovp_thresh = ovp_thresh
+        self.use_difficult = use_difficult
+        self.class_names = class_names
+        self.pred_idx = int(pred_idx)
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        super().reset()  # num_inst/sum_metric + global counters
+        # per class: list of (score, tp) records + total gt count
+        self._records = {}
+        self._gt_counts = {}
+
+    def update(self, labels, preds):
+        """labels/preds: lists of NDArrays (batch-wise)."""
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        pred = preds[self.pred_idx]
+        label = labels[0]
+        lab = label.asnumpy() if hasattr(label, "asnumpy") else \
+            onp.asarray(label)
+        det = pred.asnumpy() if hasattr(pred, "asnumpy") else \
+            onp.asarray(pred)
+        for b in range(lab.shape[0]):
+            self._update_one(lab[b], det[b])
+
+    def _update_one(self, gts, dets):
+        gts = gts[gts[:, 0] >= 0]
+        dets = dets[dets[:, 0] >= 0]
+        difficult = gts[:, 5].astype(bool) if gts.shape[1] > 5 else \
+            onp.zeros(len(gts), bool)
+        for c in onp.unique(onp.concatenate(
+                [gts[:, 0], dets[:, 0]])).astype(int):
+            c_gt = gts[gts[:, 0] == c]
+            c_diff = difficult[gts[:, 0] == c]
+            n_valid = int((~c_diff).sum()) if not self.use_difficult \
+                else len(c_gt)
+            self._gt_counts[c] = self._gt_counts.get(c, 0) + n_valid
+            c_det = dets[dets[:, 0] == c]
+            order = onp.argsort(-c_det[:, 1])
+            matched = onp.zeros(len(c_gt), bool)
+            recs = self._records.setdefault(c, [])
+            for i in order:
+                score, box = c_det[i, 1], c_det[i, 2:6]
+                if len(c_gt) == 0:
+                    recs.append((score, 0))
+                    continue
+                ious = _iou(box, c_gt[:, 1:5])
+                j = int(ious.argmax())
+                if ious[j] >= self.ovp_thresh:
+                    if not self.use_difficult and c_diff[j]:
+                        # difficult gt: the detection is IGNORED —
+                        # never consumes the gt, never counts as fp
+                        # (VOC protocol; ref eval_metric.py checks
+                        # difficult before marking found)
+                        continue
+                    if not matched[j]:
+                        matched[j] = True
+                        recs.append((score, 1))
+                    else:
+                        recs.append((score, 0))  # duplicate detection
+                else:
+                    recs.append((score, 0))
+
+    def _class_ap(self, c):
+        recs = sorted(self._records.get(c, []), key=lambda r: -r[0])
+        n_gt = self._gt_counts.get(c, 0)
+        if n_gt == 0:
+            return None
+        tp = onp.cumsum([r[1] for r in recs]) if recs else onp.array([])
+        fp = onp.cumsum([1 - r[1] for r in recs]) if recs else \
+            onp.array([])
+        if len(tp) == 0:
+            return 0.0
+        recall = tp / n_gt
+        precision = tp / onp.maximum(tp + fp, 1e-12)
+        return self._average_precision(recall, precision)
+
+    @staticmethod
+    def _average_precision(recall, precision):
+        """Area under the monotone precision envelope
+        (ref: eval_metric.py _average_precision)."""
+        mrec = onp.concatenate([[0.0], recall, [1.0]])
+        mpre = onp.concatenate([[0.0], precision, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = onp.where(mrec[1:] != mrec[:-1])[0]
+        return float(onp.sum((mrec[idx + 1] - mrec[idx])
+                             * mpre[idx + 1]))
+
+    def get(self):
+        aps = [self._class_ap(c) for c in sorted(self._gt_counts)]
+        aps = [a for a in aps if a is not None]
+        value = float(onp.mean(aps)) if aps else float("nan")
+        if self.class_names:
+            names = [f"{n}_ap" for n in self.class_names] + [self.name]
+            per = [self._class_ap(c) for c in range(len(self.class_names))]
+            return names, [(-1.0 if a is None else a)
+                           for a in per] + [value]
+        return self.name, value
+
+
+class VOC07MApMetric(MApMetric):
+    """11-point interpolated AP (ref: eval_metric.py VOC07MApMetric)."""
+
+    @staticmethod
+    def _average_precision(recall, precision):
+        ap = 0.0
+        for t in onp.arange(0.0, 1.01, 0.1):
+            mask = recall >= t
+            p = float(onp.max(precision[mask])) if mask.any() else 0.0
+            ap += p / 11.0
+        return ap

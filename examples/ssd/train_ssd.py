@@ -132,7 +132,19 @@ def main(argv=None):
     print("top detections (cls, score, xmin, ymin, xmax, ymax):")
     for row in top:
         print("  ", [round(float(v), 3) for v in row])
-    return first, last
+
+    # mAP evaluation (ref: example/ssd/evaluate/eval_metric.py)
+    import importlib.util as _ilu
+    spec = _ilu.spec_from_file_location(
+        "ssd_eval", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "eval_metric.py"))
+    _em = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(_em)
+    metric = _em.VOC07MApMetric(ovp_thresh=0.5)
+    metric.update([labels], [det])
+    name, value = metric.get()
+    print(f"{name}: {value:.3f}")
+    return first, last, value
 
 
 if __name__ == "__main__":

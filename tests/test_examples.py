@@ -42,9 +42,10 @@ def test_word_lm_example_learns():
 
 def test_ssd_example_loss_decreases():
     mod = _load("ssd/train_ssd.py")
-    first, last = mod.main(["--steps", "12", "--batch-size", "4",
-                            "--image-size", "32"])
+    first, last, mean_ap = mod.main(["--steps", "12", "--batch-size",
+                                     "4", "--image-size", "32"])
     assert last < first
+    assert 0.0 <= mean_ap <= 1.0  # VOC07 mAP computed on the decode
 
 
 def test_quantization_example():
