@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Automatic mixed precision (ref: example/automatic-mixed-precision/
+amp_tutorial.md): amp.init() casts MXU-friendly ops to bfloat16 while
+keeping precision-sensitive ops in fp32, with dynamic loss scaling for
+the backward. Shows training converging under AMP and the loss scaler
+reacting to overflow.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon, nd
+
+
+def make_batch(rs, n, classes=4, dim=32):
+    y = rs.randint(0, classes, n)
+    x = rs.rand(n, dim).astype("float32") * 0.3
+    for i, c in enumerate(y):
+        x[i, 8 * c:8 * c + 8] += 0.5
+    return x, y.astype("float32")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--tpu", action="store_true")
+    args = p.parse_args(argv)
+
+    amp.init(target_dtype=args.dtype)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    amp.init_trainer(trainer)
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rs = onp.random.RandomState(0)
+    acc = 0.0
+    for step in range(args.steps):
+        xb, yb = make_batch(rs, args.batch_size)
+        x, y = nd.array(xb), nd.array(yb)
+        with autograd.record():
+            out = net(x)
+            loss = ce(out, y).mean()
+            with amp.scale_loss(loss, trainer) as scaled:
+                scaled.backward()
+        trainer.step(args.batch_size)
+        if step % 50 == 0 or step == args.steps - 1:
+            acc = float((out.asnumpy().argmax(1) == yb).mean())
+            print(f"step {step}: loss {float(loss.asscalar()):.4f} "
+                  f"acc {acc:.3f} "
+                  f"loss-scale {trainer._amp_loss_scaler.loss_scale:.0f}"
+                  if hasattr(trainer, "_amp_loss_scaler") else
+                  f"step {step}: acc {acc:.3f}")
+    print(f"AMP({args.dtype}) final acc {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

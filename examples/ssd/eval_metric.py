@@ -38,8 +38,7 @@ class MApMetric(EvalMetric):
         self.use_difficult = use_difficult
         self.class_names = class_names
         self.pred_idx = int(pred_idx)
-        super().__init__(name)
-        self.reset()
+        super().__init__(name)  # base __init__ calls our reset()
 
     def reset(self):
         super().reset()  # num_inst/sum_metric + global counters
@@ -125,12 +124,14 @@ class MApMetric(EvalMetric):
                              * mpre[idx + 1]))
 
     def get(self):
-        aps = [self._class_ap(c) for c in sorted(self._gt_counts)]
-        aps = [a for a in aps if a is not None]
+        ap_by_class = {c: self._class_ap(c)
+                       for c in sorted(self._gt_counts)}
+        aps = [a for a in ap_by_class.values() if a is not None]
         value = float(onp.mean(aps)) if aps else float("nan")
         if self.class_names:
             names = [f"{n}_ap" for n in self.class_names] + [self.name]
-            per = [self._class_ap(c) for c in range(len(self.class_names))]
+            per = [ap_by_class.get(c) for c in
+                   range(len(self.class_names))]
             return names, [(-1.0 if a is None else a)
                            for a in per] + [value]
         return self.name, value

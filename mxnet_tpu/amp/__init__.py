@@ -72,17 +72,27 @@ def current_policy():
 
 def cast_for_op(op_name: str, arrays):
     """Called by the nd dispatch layer: cast inputs per policy."""
+    plan = cast_plan(op_name)
+    return arrays if plan is None else plan(arrays)
+
+
+def cast_plan(op_name: str):
+    """SNAPSHOT of the current policy for one op: a pure arrays->arrays
+    function (or None for no-cast). The dispatch layer closes the
+    recorded fn over this plan, so tape replay at backward() time uses
+    the dtypes of record time even if amp.init() state changed since."""
     if not _STATE.active:
-        return arrays
+        return None
     if op_name in TARGET_DTYPE_OPS:
-        return [a.astype(_STATE.target_dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a
-                for a in arrays]
+        dt = _STATE.target_dtype
+        return lambda arrays: [a.astype(dt)
+                               if jnp.issubdtype(a.dtype, jnp.floating)
+                               else a for a in arrays]
     if op_name in FP32_OPS:
-        return [a.astype(jnp.float32)
-                if a.dtype in (jnp.bfloat16, jnp.float16) else a
-                for a in arrays]
-    return arrays
+        return lambda arrays: [a.astype(jnp.float32)
+                               if a.dtype in (jnp.bfloat16, jnp.float16)
+                               else a for a in arrays]
+    return None
 
 
 def init_trainer(trainer):

@@ -205,8 +205,12 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     if head_grads is None:
         head_grads = [jnp.ones(h.shape, h.dtype) for h in heads]
     else:
+        # cast user-provided head gradients to each head's dtype —
+        # e.g. fp32 ones against a bf16 AMP output must not poison the
+        # vjp cotangent chain with a dtype mismatch
         head_grads = [
-            jnp.ones(h.shape, h.dtype) if g is None else g._data
+            jnp.ones(h.shape, h.dtype) if g is None
+            else g._data.astype(h.dtype)
             for h, g in zip(heads, head_grads)
         ]
 

@@ -144,11 +144,19 @@ def make_nd_function(name: str) -> Callable:
                 return out_kw
             return sparse_res
         from .. import amp as _amp
-        if _amp.is_active():
-            from ..ndarray.ndarray import _wrap as _aw
-            cast = _amp.cast_for_op(name, [i._data for i in inputs])
-            inputs = [i if c is i._data else _aw(c)
-                      for i, c in zip(inputs, cast)]
+        use_fn = info.fn
+        _plan = _amp.cast_plan(name) if _amp.is_active() else None
+        if _plan is not None:
+            # cast INSIDE the recorded fn: swapping the input NDArrays
+            # for cast copies would sever the parameter-owner chain and
+            # silently drop gradients onto throwaway wrappers; in-fn
+            # casting keeps owners intact and vjp routes the cotangent
+            # back through astype to the fp32 master weights. The plan
+            # is a policy SNAPSHOT so tape replay is dtype-stable even
+            # if amp state changes before backward().
+            def use_fn(*arrays, __f=info.fn, __p=_plan, **kw):
+                return __f(*__p(list(arrays)), **kw)
+            use_fn.__name__ = name  # profiler/fallback logs keep the op name
         n_out = rest_params.get("num_outputs", info.n_out) \
             if info.n_out == -1 else info.n_out
         if info.needs_train and "_training" not in rest_params:
@@ -160,7 +168,7 @@ def make_nd_function(name: str) -> Callable:
             from ..ndarray.ndarray import _wrap as _w
             # raw uint32 key data: vjp-safe (int cotangents are float0)
             inputs.append(_w(_jax.random.key_data(next_key())))
-        out = invoke(info.fn, inputs, n_out=n_out,
+        out = invoke(use_fn, inputs, n_out=n_out,
                      differentiable=info.differentiable, **rest_params)
         # Hide non-visible outputs in eager mode too (ref:
         # FNumVisibleOutputs applies to imperative invoke). Ops with

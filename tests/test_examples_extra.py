@@ -230,3 +230,8 @@ def test_ssd_map_difficult_gts_ignored():
     _, value = met.get()
     assert abs(value - 1.0) < 1e-6, value
     met.get_global()  # base-class contract intact after reset override
+
+
+def test_amp_example_trains():
+    acc = _load("amp/amp_train.py").main(["--steps", "150"])
+    assert acc > 0.8

@@ -210,3 +210,40 @@ def test_optimizer_convergence_matrix():
                 first = loss.asscalar()
             last = loss.asscalar()
         assert last < first, f"{opt_name}: {first} -> {last}"
+
+
+def test_amp_eager_training_gradients_reach_parameters():
+    """amp.init() casting must not sever the parameter-owner chain —
+    gradients flow to the fp32 master weights through the in-fn cast
+    (regression: eager AMP silently trained at chance accuracy)."""
+    from mxnet_tpu import amp
+
+    amp.init()
+    try:
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize(mx.initializer.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 2e-3})
+        amp.init_trainer(tr)
+        ce = gluon.loss.SoftmaxCrossEntropyLoss()
+        rs = onp.random.RandomState(0)
+        for step in range(60):
+            yb = rs.randint(0, 4, 64)
+            xb = rs.rand(64, 32).astype("float32") * 0.3
+            for i, c in enumerate(yb):
+                xb[i, 8 * c:8 * c + 8] += 0.5
+            x, y = nd.array(xb), nd.array(yb.astype("float32"))
+            with autograd.record():
+                out = net(x)
+                loss = ce(out, y).mean()
+                with amp.scale_loss(loss, tr) as scaled:
+                    scaled.backward()
+            tr.step(64)
+        acc = float((out.asnumpy().argmax(1) == yb).mean())
+        assert acc > 0.8, f"AMP training stuck at {acc}"
+        # params stayed fp32 masters
+        for _, p in net.collect_params().items():
+            assert p.data().dtype == onp.float32
+    finally:
+        amp._STATE.active = False  # don't leak AMP into other tests
