@@ -40,3 +40,7 @@ def _seed():
     import mxnet_tpu as mx
     mx.random.seed(seed)
     yield
+    # tests/examples that call amp.init() must not leak the global cast
+    # policy into later tests (bf16 casts silently loosen grad checks)
+    from mxnet_tpu import amp as _amp
+    _amp._STATE.active = False
