@@ -39,8 +39,16 @@ def _seed():
     onp.random.seed(seed)
     import mxnet_tpu as mx
     mx.random.seed(seed)
-    yield
     # tests/examples that call amp.init() must not leak the global cast
-    # policy into later tests (bf16 casts silently loosen grad checks)
+    # policy into later tests (bf16 casts silently loosen grad checks);
+    # init() also mutates the op lists, so snapshot and restore them too
     from mxnet_tpu import amp as _amp
+    _saved_target = set(_amp.TARGET_DTYPE_OPS)
+    _saved_fp32 = set(_amp.FP32_OPS)
+    yield
     _amp._STATE.active = False
+    _amp._STATE.target_dtype = None
+    _amp.TARGET_DTYPE_OPS.clear()
+    _amp.TARGET_DTYPE_OPS.update(_saved_target)
+    _amp.FP32_OPS.clear()
+    _amp.FP32_OPS.update(_saved_fp32)

@@ -381,6 +381,27 @@ _GRAD_SHAPES = {
 }
 
 
+def _numeric_grad(fn, xs, k, eps, project=None):
+    """Central finite differences of sum(fn(xs)^2) w.r.t. input k.
+    `project` post-processes each perturbed input (e.g. re-symmetrize
+    for ops defined on symmetric matrices)."""
+    base = xs[k].asnumpy().astype("float64")
+    num = onp.zeros_like(base)
+    for i in onp.ndindex(*base.shape):
+        for sgn in (+1, -1):
+            pert = base.copy()
+            pert[i] += sgn * eps
+            if project is not None:
+                pert = project(pert)
+            args = [nd.array(p.asnumpy()) if j != k
+                    else nd.array(pert.astype("float32"))
+                    for j, p in enumerate(xs)]
+            out = fn(*args)
+            val = float((out * out).sum().asscalar())
+            num[i] += sgn * val / (2 * eps)
+    return num
+
+
 @pytest.mark.parametrize("name,n_in", GRAD_OPS)
 def test_numeric_gradient(name, n_in):
     """Tape backward vs central finite differences (ref:
@@ -397,18 +418,7 @@ def test_numeric_gradient(name, n_in):
         loss = nd.sum(y * y)
     loss.backward()
     for k, x in enumerate(xs):
-        base = x.asnumpy().astype("float64")
-        num = onp.zeros_like(base)
-        for i in onp.ndindex(*base.shape):
-            for sgn in (+1, -1):
-                pert = base.copy()
-                pert[i] += sgn * eps
-                args = [nd.array(p.asnumpy()) if j != k
-                        else nd.array(pert.astype("float32"))
-                        for j, p in enumerate(xs)]
-                out = getattr(nd, name)(*args)
-                val = float((out * out).sum().asscalar())
-                num[i] += sgn * val / (2 * eps)
+        num = _numeric_grad(fn, xs, k, eps)
         got = xs[k].grad.asnumpy()
         assert onp.allclose(got, num, rtol=5e-2, atol=5e-2), \
             f"{name} input {k}: analytic vs numeric mismatch"
@@ -465,3 +475,44 @@ def test_exception_surfaces_through_executor():
         e = net.bind(mx.cpu(), {"x": nd.ones((2, 3)),
                                 "w": nd.ones((4, 9))})
         e.forward()[0].asnumpy()
+
+
+@pytest.mark.parametrize("name,make", [
+    ("linalg_det", lambda: _well_conditioned_np(3)),
+    ("linalg_inverse", lambda: _well_conditioned_np(3)),
+    ("linalg_potrf", lambda: _spd_np(3)),
+    ("linalg_sumlogdiag", lambda: _spd_np(3)),
+])
+def test_linalg_numeric_gradient(name, make):
+    """Finite differences through the linalg family on curated
+    well-conditioned inputs (ref: test_operator.py check_numeric_gradient
+    over the _linalg_* corpus, src/operator/tensor/la_op.cc)."""
+    eps = 1e-4
+    x = nd.array(make())
+    x.attach_grad()
+    fn = getattr(nd, name)
+    with autograd.record():
+        y = fn(x)
+        loss = nd.sum(y * y)
+    loss.backward()
+    project = ((lambda m: (m + m.T) / 2)  # keep symmetric
+               if name in ("linalg_potrf", "linalg_sumlogdiag") else None)
+    num = _numeric_grad(fn, [x], 0, eps, project=project)
+    got = x.grad.asnumpy()
+    if name in ("linalg_potrf", "linalg_sumlogdiag"):
+        # symmetric perturbation doubles off-diagonal sensitivity;
+        # compare the symmetrized analytic gradient instead
+        got = got + got.T - onp.diag(onp.diag(got))
+    assert onp.allclose(got, num, rtol=6e-2, atol=6e-2), \
+        f"{name}:\n{got}\nvs\n{num}"
+
+
+def _well_conditioned_np(n):
+    a = rs.uniform(0.2, 0.8, (n, n)).astype("float32")
+    return a + n * onp.eye(n, dtype="float32")
+
+
+def _spd_np(n):
+    a = rs.uniform(0.2, 0.8, (n, n)).astype("float32")
+    m = a @ a.T + n * onp.eye(n, dtype="float32")
+    return m.astype("float32")
