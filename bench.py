@@ -205,19 +205,34 @@ def main():
         yv = jax.device_put(yv, dev)
     x, y = nd.array(xv), nd.array(yv)
 
+    # Timing fence: block_until_ready has been observed to RETURN EARLY
+    # under the axon TPU tunnel (a 30-step ResNet run "finished" in
+    # 59 ms — 8x the chip's peak FLOPs, physically impossible). A
+    # device-to-host transfer cannot lie: the bytes must exist. So the
+    # fence is a D2H fetch of one loss scalar. The tunnel adds a flat
+    # ~100 ms round-trip latency per fetch, measured separately on an
+    # already-ready buffer and subtracted from the chained-step total.
+    from mxnet_tpu.util import d2h_fence as _fence
+
     # amp=1: fp32 params/activations with MXU-rate bf16 matmul passes;
     # amp=2 casts the tensors themselves (precision context is harmless)
     prec = jax.default_matmul_precision("bfloat16") if amp >= 1 \
         else contextlib.nullcontext()
     with prec:
         for _ in range(2):  # warmup (compile)
-            trainer.step(x, y).wait_to_read()
+            _fence(trainer.step(x, y))
+
+        # flat D2H latency on a ready buffer (median of 3)
+        from mxnet_tpu.util import d2h_fence_latency
+        d2h_lat = d2h_fence_latency(trainer.step(x, y))
 
         t0 = time.perf_counter()
         for _ in range(n_steps):
             loss = trainer.step(x, y)
-        loss.wait_to_read()
-        dt = time.perf_counter() - t0
+        _fence(loss)
+        raw = time.perf_counter() - t0
+        from mxnet_tpu.util import lat_dominated, net_time
+        dt = net_time(raw, d2h_lat)
 
     img_per_sec = n_steps * batch / dt
 
@@ -243,6 +258,8 @@ def main():
     _emit(round(img_per_sec, 2),
           mfu=mfu, batch=batch, steps=n_steps, amp=amp,
           flops_per_step=flops_per_step, xla_flops=xla_flops,
+          raw_s=round(raw, 4), fence_lat_s=round(d2h_lat, 4),
+          lat_dominated=lat_dominated(raw, d2h_lat),
           platform=(accel[0].platform if on_accel else "cpu"),
           device_kind=getattr((accel[0] if on_accel else devices[0]),
                               "device_kind", "unknown"))

@@ -81,3 +81,72 @@ def use_np(func):
 def get_gpu_count():
     from .context import num_gpus
     return num_gpus()
+
+
+def d2h_fence(out):
+    """Force a real device->host synchronization on `out` and return it.
+
+    The honest timing fence for benchmarks: `block_until_ready()` has
+    been observed to return early under tunneled TPU transports (axon),
+    reporting step times beyond the chip's peak FLOPs. A device-to-host
+    transfer cannot lie — the scalar's bytes must exist on the host.
+    Accepts NDArrays, jax arrays, or pytrees/sequences thereof; fetches
+    one scalar from the first array leaf.
+    """
+    import jax
+    import numpy as _onp
+    fenced = None
+    # NDArrays are unregistered pytree types (hence leaves themselves,
+    # wherever they sit in the structure); unwrap each to its jax array.
+    for leaf in jax.tree.leaves(out):
+        leaf = getattr(leaf, "_data", leaf)
+        if not isinstance(leaf, jax.Array):
+            continue  # host scalars/onp arrays need no device sync
+        if fenced is None and leaf.size:
+            # .ravel()[0] builds a FRESH sliced array each call, so the
+            # transfer can never be served from a cached host copy
+            _onp.asarray(leaf.ravel()[0])
+            fenced = leaf
+        elif fenced is None:
+            fenced = leaf  # remember an empty leaf as last resort
+    if fenced is not None and not fenced.size:
+        _onp.asarray(fenced)  # 0-byte fetch still joins definition
+    return out
+
+
+def d2h_fence_latency(out, reps: int = 3) -> float:
+    """Median flat cost of d2h_fence on an ALREADY-COMPUTED buffer.
+
+    Over a tunneled transport the fence pays a fixed round-trip
+    (~100 ms observed on axon); benchmark harnesses feed this to
+    `net_time` so short regions aren't swamped by it.
+    """
+    import time as _time
+    d2h_fence(out)  # ensure computed
+    lats = []
+    for _ in range(reps):
+        t0 = _time.perf_counter()
+        d2h_fence(out)
+        lats.append(_time.perf_counter() - t0)
+    return sorted(lats)[len(lats) // 2]
+
+
+def net_time(elapsed, lat):
+    """Compute time of a fenced region, given the flat fence latency.
+
+    The fetch request is dispatched while device compute is still
+    running, so a long region's elapsed time includes only the RETURN
+    half of the round trip; subtract lat/2, floored at 5% of elapsed so
+    a jittery latency sample can never zero (or negate) the region.
+    Callers should size the region so elapsed >> lat — check
+    `lat_dominated(elapsed, lat)` and grow the iteration count or flag
+    the result when it trips.
+    """
+    return max(elapsed - 0.5 * lat, 0.05 * elapsed)
+
+
+def lat_dominated(elapsed, lat):
+    """True when the fence round-trip is a material share (>30%) of the
+    measured region — the corrected number is then noise-dominated and
+    should be flagged or re-run with more iterations."""
+    return elapsed <= 0 or (lat / elapsed) > 0.3

@@ -120,13 +120,15 @@ def bench_transformer():
             {n: v for n, v in trainer.params.items()
              if n in trainer.trainable}, **trainer.opt_params)
 
+    from mxnet_tpu.util import d2h_fence, d2h_fence_latency, net_time
     with jax.default_matmul_precision("bfloat16"):
-        trainer.step(tokens, labels).wait_to_read()  # compile
+        d2h_fence(trainer.step(tokens, labels))  # compile
+        lat = d2h_fence_latency(trainer.step(tokens, labels))
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = trainer.step(tokens, labels)
-        loss.wait_to_read()
-        dt = time.perf_counter() - t0
+        d2h_fence(loss)
+        dt = net_time(time.perf_counter() - t0, lat)
 
     tok_s = steps * B * T / dt
     # 6*N FLOPs/token (fwd+bwd) for non-embedding params N
@@ -166,14 +168,16 @@ def bench_flash():
         dq, dk, dv = vjp(out)
         return out, dq
 
+    from mxnet_tpu.util import d2h_fence, d2h_fence_latency, net_time
     fn = jax.jit(step)
-    jax.block_until_ready(fn(q, k, v))  # compile
+    d2h_fence(fn(q, k, v))  # compile
+    lat = d2h_fence_latency(fn(q, k, v))
     n = 10 if on_accel else 2
     t0 = time.perf_counter()
     for _ in range(n):
         r = fn(q, k, v)
-    jax.block_until_ready(r)
-    ms = (time.perf_counter() - t0) / n * 1e3
+    d2h_fence(r)
+    ms = net_time(time.perf_counter() - t0, lat) / n * 1e3
     _emit("flash_attention_fwd_bwd", round(ms, 2), "ms",
           batch=B, heads=H, seq_len=T, head_dim=D, causal=True,
           platform="tpu" if on_accel else "cpu")

@@ -98,11 +98,12 @@ def time_op(name, builder, kwargs, fn, runs, warmup=3):
     for _ in range(warmup):
         out = fn(*args, **kwargs)
     _sync(out)
+    lat = _sync_latency(out)
     t0 = time.perf_counter()
     for _ in range(runs):
         out = fn(*args, **kwargs)
     _sync(out)
-    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+    fwd_ms = _net(time.perf_counter() - t0, lat) / runs * 1e3
 
     bwd_ms = None
     grad_args = [a for a in args if a.dtype.kind == "f"]
@@ -127,16 +128,27 @@ def time_op(name, builder, kwargs, fn, runs, warmup=3):
                     out = out[0] if isinstance(out, (list, tuple)) else out
                 out.backward(head)
             _sync(grad_args[0].grad)
-            bwd_ms = (time.perf_counter() - t0) / runs * 1e3
+            bwd_ms = _net(time.perf_counter() - t0, lat) / runs * 1e3
         except Exception:
             bwd_ms = None
     return fwd_ms, bwd_ms
 
 
 def _sync(out):
-    if isinstance(out, (list, tuple)):
-        out = out[0]
-    out.wait_to_read()
+    from mxnet_tpu.util import d2h_fence
+    d2h_fence(out)
+
+
+def _sync_latency(out):
+    """Flat cost of the fence itself (a tunneled D2H pays ~100 ms
+    round-trip); fed to util.net_time per timed region."""
+    from mxnet_tpu.util import d2h_fence_latency
+    return d2h_fence_latency(out)
+
+
+def _net(elapsed, lat):
+    from mxnet_tpu.util import net_time
+    return net_time(elapsed, lat)
 
 
 def main(argv=None):
