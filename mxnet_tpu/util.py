@@ -95,22 +95,22 @@ def d2h_fence(out):
     """
     import jax
     import numpy as _onp
-    fenced = None
+    empty = None
     # NDArrays are unregistered pytree types (hence leaves themselves,
     # wherever they sit in the structure); unwrap each to its jax array.
     for leaf in jax.tree.leaves(out):
         leaf = getattr(leaf, "_data", leaf)
         if not isinstance(leaf, jax.Array):
             continue  # host scalars/onp arrays need no device sync
-        if fenced is None and leaf.size:
+        if leaf.size:
             # .ravel()[0] builds a FRESH sliced array each call, so the
             # transfer can never be served from a cached host copy
             _onp.asarray(leaf.ravel()[0])
-            fenced = leaf
-        elif fenced is None:
-            fenced = leaf  # remember an empty leaf as last resort
-    if fenced is not None and not fenced.size:
-        _onp.asarray(fenced)  # 0-byte fetch still joins definition
+            return out
+        if empty is None:
+            empty = leaf  # last resort if ALL array leaves are empty
+    if empty is not None:
+        _onp.asarray(empty)  # 0-byte fetch still joins definition
     return out
 
 

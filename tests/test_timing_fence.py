@@ -37,11 +37,20 @@ def test_fence_handles_host_scalars_mixed_with_arrays():
     assert d2h_fence(out) is out
 
 
-def test_fence_handles_empty_leaves_and_no_arrays():
+def test_fence_handles_empty_leaves_and_no_arrays(monkeypatch):
     d2h_fence(jnp.zeros((0, 3)))        # size-0 array: no IndexError
     d2h_fence([])                        # nothing to fence
     d2h_fence((1.0, "x", onp.ones(2)))   # host-only values
-    d2h_fence([jnp.zeros((0,)), jnp.ones((2,))])  # empty then real
+
+    # an empty FIRST leaf must not stop the real leaf being fetched
+    fetched = []
+    real_asarray = onp.asarray
+    monkeypatch.setattr(
+        onp, "asarray",
+        lambda a, *k, **kw: (fetched.append(getattr(a, "size", None)),
+                             real_asarray(a, *k, **kw))[1])
+    d2h_fence([jnp.zeros((0,)), jnp.ones((2,))])
+    assert fetched and fetched[-1] == 1  # one real scalar was pulled
 
 
 def test_fence_latency_is_small_and_positive():

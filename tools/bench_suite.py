@@ -94,33 +94,48 @@ def bench_transformer():
 
     # attention backend (Pallas flash vs XLA dense) is chosen by
     # operator_tune at warm-up; bench_flash times the kernel directly
-    net = TransformerLM(vocab_size=V, units=U, num_layers=L,
-                        num_heads=U // 64, hidden_size=H, max_len=T,
-                        causal=True)
-    net.initialize()
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    # ALL eager work (init, deferred-shape forward) on the host: each
+    # eager op over a tunneled accelerator pays the transport round
+    # trip (~90 ms on axon) and an eager transformer forward is
+    # hundreds of ops — init on the device looked like a hang.
+    cpu_dev = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu_dev):
+        net = TransformerLM(vocab_size=V, units=U, num_layers=L,
+                            num_heads=U // 64, hidden_size=H, max_len=T,
+                            causal=True)
+        net.initialize()
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
-    class LMLoss(gluon.HybridBlock):
-        def hybrid_forward(self, F, logits, labels):
-            return loss_fn(logits.reshape((-1, V)),
-                           labels.reshape((-1,)))
+        class LMLoss(gluon.HybridBlock):
+            def hybrid_forward(self, F, logits, labels):
+                return loss_fn(logits.reshape((-1, V)),
+                               labels.reshape((-1,)))
 
-    trainer = ParallelTrainer(net, LMLoss(), optimizer="adam",
-                              optimizer_params={"learning_rate": 1e-4})
-    rng = onp.random.RandomState(0)
-    tokens = nd.array(rng.randint(0, V, (B, T)), dtype="int32")
-    labels = nd.array(rng.randint(0, V, (B, T)).astype("float32"))
-    net(nd.array(tokens._data[:1]))
-    trainer._extract_params()
+        trainer = ParallelTrainer(net, LMLoss(), optimizer="adam",
+                                  optimizer_params={"learning_rate": 1e-4})
+        rng = onp.random.RandomState(0)
+        tokens_v = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
+        labels_v = jnp.asarray(rng.randint(0, V, (B, T))
+                               .astype("float32"))
+        net(nd.array(tokens_v[:1]))
+        trainer._extract_params()
+        if on_accel:
+            trainer.params = {k: (v.astype(jnp.bfloat16)
+                                  if v.dtype == jnp.float32 else v)
+                              for k, v in trainer.params.items()}
+            trainer.opt_state = trainer._init_fn(
+                {n: v for n, v in trainer.params.items()
+                 if n in trainer.trainable}, **trainer.opt_params)
     if on_accel:
-        trainer.params = {k: (v.astype(jnp.bfloat16)
-                              if v.dtype == jnp.float32 else v)
-                          for k, v in trainer.params.items()}
-        trainer.opt_state = trainer._init_fn(
-            {n: v for n, v in trainer.params.items()
-             if n in trainer.trainable}, **trainer.opt_params)
+        dev = [d for d in devs if d.platform != "cpu"][0]
+        trainer.params = jax.device_put(trainer.params, dev)
+        trainer.opt_state = jax.device_put(trainer.opt_state, dev)
+        tokens_v = jax.device_put(tokens_v, dev)
+        labels_v = jax.device_put(labels_v, dev)
+    tokens, labels = nd.array(tokens_v), nd.array(labels_v)
 
-    from mxnet_tpu.util import d2h_fence, d2h_fence_latency, net_time
+    from mxnet_tpu.util import (d2h_fence, d2h_fence_latency,
+                                lat_dominated, net_time)
     with jax.default_matmul_precision("bfloat16"):
         d2h_fence(trainer.step(tokens, labels))  # compile
         lat = d2h_fence_latency(trainer.step(tokens, labels))
@@ -128,7 +143,8 @@ def bench_transformer():
         for _ in range(steps):
             loss = trainer.step(tokens, labels)
         d2h_fence(loss)
-        dt = net_time(time.perf_counter() - t0, lat)
+        raw = time.perf_counter() - t0
+        dt = net_time(raw, lat)
 
     tok_s = steps * B * T / dt
     # 6*N FLOPs/token (fwd+bwd) for non-embedding params N
@@ -141,6 +157,7 @@ def bench_transformer():
     _emit("transformer_train_tokens_per_sec", round(tok_s, 1),
           "tokens/sec", batch=B, seq_len=T,
           layers=L, mfu=mfu, ms_per_step=round(dt / steps * 1e3, 2),
+          lat_dominated=lat_dominated(raw, lat),
           platform="tpu" if on_accel else "cpu")
 
 
@@ -168,7 +185,8 @@ def bench_flash():
         dq, dk, dv = vjp(out)
         return out, dq
 
-    from mxnet_tpu.util import d2h_fence, d2h_fence_latency, net_time
+    from mxnet_tpu.util import (d2h_fence, d2h_fence_latency,
+                                lat_dominated, net_time)
     fn = jax.jit(step)
     d2h_fence(fn(q, k, v))  # compile
     lat = d2h_fence_latency(fn(q, k, v))
@@ -177,9 +195,11 @@ def bench_flash():
     for _ in range(n):
         r = fn(q, k, v)
     d2h_fence(r)
-    ms = net_time(time.perf_counter() - t0, lat) / n * 1e3
+    raw = time.perf_counter() - t0
+    ms = net_time(raw, lat) / n * 1e3
     _emit("flash_attention_fwd_bwd", round(ms, 2), "ms",
           batch=B, heads=H, seq_len=T, head_dim=D, causal=True,
+          lat_dominated=lat_dominated(raw, lat),
           platform="tpu" if on_accel else "cpu")
 
 

@@ -103,7 +103,9 @@ def time_op(name, builder, kwargs, fn, runs, warmup=3):
     for _ in range(runs):
         out = fn(*args, **kwargs)
     _sync(out)
-    fwd_ms = _net(time.perf_counter() - t0, lat) / runs * 1e3
+    raw = time.perf_counter() - t0
+    fwd_ms = _net(raw, lat) / runs * 1e3
+    dominated = _dominated(raw, lat)
 
     bwd_ms = None
     grad_args = [a for a in args if a.dtype.kind == "f"]
@@ -128,10 +130,12 @@ def time_op(name, builder, kwargs, fn, runs, warmup=3):
                     out = out[0] if isinstance(out, (list, tuple)) else out
                 out.backward(head)
             _sync(grad_args[0].grad)
-            bwd_ms = _net(time.perf_counter() - t0, lat) / runs * 1e3
+            raw = time.perf_counter() - t0
+            bwd_ms = _net(raw, lat) / runs * 1e3
+            dominated = dominated or _dominated(raw, lat)
         except Exception:
             bwd_ms = None
-    return fwd_ms, bwd_ms
+    return fwd_ms, bwd_ms, dominated
 
 
 def _sync(out):
@@ -149,6 +153,11 @@ def _sync_latency(out):
 def _net(elapsed, lat):
     from mxnet_tpu.util import net_time
     return net_time(elapsed, lat)
+
+
+def _dominated(elapsed, lat):
+    from mxnet_tpu.util import lat_dominated
+    return lat_dominated(elapsed, lat)
 
 
 def main(argv=None):
@@ -172,9 +181,10 @@ def main(argv=None):
                   file=sys.stderr)
             continue
         builder, kwargs, fn = table[name]
-        fwd, bwd = time_op(name, builder, kwargs, fn, args.runs)
+        fwd, bwd, dom = time_op(name, builder, kwargs, fn, args.runs)
         results.append({"op": name, "fwd_ms": round(fwd, 4),
-                        "fwd_bwd_ms": round(bwd, 4) if bwd else None})
+                        "fwd_bwd_ms": round(bwd, 4) if bwd else None,
+                        "lat_dominated": dom})
     if not results:
         print("no valid ops selected", file=sys.stderr)
         sys.exit(2)
@@ -186,7 +196,11 @@ def main(argv=None):
         print(f"{'operator'.ljust(w)}{'fwd (ms)':>12}{'fwd+bwd (ms)':>15}")
         for r in results:
             b = f"{r['fwd_bwd_ms']:.4f}" if r["fwd_bwd_ms"] else "-"
-            print(f"{r['op'].ljust(w)}{r['fwd_ms']:>12.4f}{b:>15}")
+            star = " *" if r["lat_dominated"] else ""
+            print(f"{r['op'].ljust(w)}{r['fwd_ms']:>12.4f}{b:>15}{star}")
+        if any(r["lat_dominated"] for r in results):
+            print("* sync round-trip >30% of the timed region — raise "
+                  "--runs for a trustworthy number")
     return results
 
 
