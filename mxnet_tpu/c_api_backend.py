@@ -396,3 +396,360 @@ def executor_backward(h: int):
 def executor_free(h: int):
     with _lock:
         _exec_handles.pop(h, None)
+
+
+# ---------------------------------------------------------------------------
+# NDArray extras (ref: c_api.h MXNDArraySlice/At/Reshape/GetContext/
+# WaitToRead/WaitAll/GetGrad)
+# ---------------------------------------------------------------------------
+
+def ndarray_slice(h: int, begin: int, end: int) -> int:
+    return _new_handle(_nd_handles, _nd(h)[int(begin):int(end)])
+
+
+def ndarray_at(h: int, idx: int) -> int:
+    return _new_handle(_nd_handles, _nd(h)[int(idx)])
+
+
+def ndarray_reshape(h: int, shape) -> int:
+    return _new_handle(_nd_handles,
+                       _nd(h).reshape(tuple(int(s) for s in shape)))
+
+
+def ndarray_get_context(h: int):
+    """Returns (dev_type, dev_id) — 1=cpu, 2=accelerator (the
+    reference's kCPU/kGPU codes, include/mxnet/base.h:102-115)."""
+    ctx = _nd(h).context
+    return (1 if ctx.device_type in ("cpu", "cpu_pinned") else 2,
+            int(ctx.device_id))
+
+
+def ndarray_wait_to_read(h: int):
+    _nd(h).wait_to_read()
+
+
+def ndarray_wait_all():
+    from .ndarray.ndarray import waitall
+    waitall()
+
+
+def ndarray_get_grad(h: int) -> int:
+    g = _nd(h).grad
+    return _new_handle(_nd_handles, g) if g is not None else 0
+
+
+# ---------------------------------------------------------------------------
+# autograd (ref: c_api.h MXAutogradSetIsRecording/SetIsTraining/
+# IsRecording/IsTraining/MarkVariables/BackwardEx)
+# ---------------------------------------------------------------------------
+
+def autograd_set_is_recording(flag: int) -> int:
+    from . import autograd
+    return int(autograd.set_recording(bool(flag)))
+
+
+def autograd_set_is_training(flag: int) -> int:
+    from . import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+def autograd_is_recording() -> int:
+    from . import autograd
+    return int(autograd.is_recording())
+
+
+def autograd_is_training() -> int:
+    from . import autograd
+    return int(autograd.is_training())
+
+
+def autograd_mark_variables(handles, grad_handles, grad_reqs):
+    from . import autograd
+    reqs = [r if isinstance(r, str) else
+            {0: "null", 1: "write", 2: "add"}[int(r)] for r in grad_reqs]
+    # a NULL grad handle (id 0) is legal with req "null" — the variable
+    # gets no gradient buffer, exactly as mark_variables treats it
+    grads = [(_nd(g) if g else None) for g in grad_handles]
+    for g, req in zip(grads, reqs):
+        if g is None and req != "null":
+            raise MXNetError("grad handle is NULL but grad_req is "
+                             f"'{req}' (only 'null' allows no buffer)")
+    autograd.mark_variables([_nd(h) for h in handles], grads, reqs)
+
+
+def autograd_backward(out_handles, ograd_handles, retain_graph: int,
+                      train_mode: int):
+    from . import autograd
+    heads = [_nd(h) for h in out_handles]
+    ograds = None
+    if ograd_handles:
+        ograds = [(_nd(h) if h else None) for h in ograd_handles]
+    autograd.backward(heads, ograds, retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+# ---------------------------------------------------------------------------
+# symbol composition & inference (ref: c_api.h MXSymbolCreateVariable/
+# CreateAtomicSymbol/Compose/Copy/GetInternals/InferShape/InferType)
+# ---------------------------------------------------------------------------
+
+_atomic_handles: Dict[int, Tuple[str, dict]] = {}
+
+
+def _literal(v: str):
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def symbol_create_variable(name: str) -> int:
+    from .symbol.symbol import var
+    return _new_handle(_sym_handles, var(name))
+
+
+def symbol_create_atomic(op_name: str, param_keys, param_vals) -> int:
+    """An un-composed op node: params now, inputs at compose time (the
+    reference's two-step CreateAtomicSymbol -> Compose protocol)."""
+    from .ops.registry import get_op
+    get_op(op_name)  # raises for unknown ops at create time, like the ref
+    params = {k: _literal(v) for k, v in zip(param_keys, param_vals)}
+    h = _new_handle(_sym_handles, None)  # reserve the id in the sym table
+    _atomic_handles[h] = (op_name, params)
+    return h
+
+
+def symbol_compose(h: int, name: str, arg_keys, arg_handles):
+    """Binds inputs to an atomic symbol IN PLACE (the handle becomes a
+    real composed symbol, as MXSymbolCompose mutates its handle).
+    arg_keys empty -> positional in declared op-input order; otherwise
+    named binding against the op's declared input names. The pending
+    atomic state is only consumed on success, so a failed compose (bad
+    arg handle, unknown key) leaves the handle retryable."""
+    pending = _atomic_handles.get(h)
+    if pending is None:
+        raise MXNetError(f"handle {h} is not an un-composed atomic symbol")
+    op_name, params = pending
+    from .ops.registry import get_op
+    from .symbol.symbol import _make_node
+    entries = [_sym(a)._entry() for a in arg_handles]
+    if arg_keys:
+        declared = list(get_op(op_name).input_names or ())
+        if not declared:
+            raise MXNetError(f"operator {op_name} declares no input names; "
+                             "use positional composition")
+        slots = {}
+        for k, e in zip(arg_keys, entries):
+            if k not in declared:
+                raise MXNetError(f"unknown input '{k}' for {op_name}; "
+                                 f"declared inputs: {declared}")
+            slots[declared.index(k)] = e
+        if sorted(slots) != list(range(len(slots))):
+            raise MXNetError(f"named inputs {sorted(arg_keys)} must fill "
+                             f"a prefix of {declared} (later inputs are "
+                             "auto-created variables)")
+        entries = [slots[i] for i in range(len(slots))]
+    composed = _make_node(op_name, entries, params, name=name or None)
+    with _lock:
+        _atomic_handles.pop(h, None)
+        _sym_handles[h] = composed
+
+
+def symbol_copy(h: int) -> int:
+    import copy as _copy
+    return _new_handle(_sym_handles, _copy.deepcopy(_sym(h)))
+
+
+def symbol_get_internals(h: int) -> int:
+    return _new_handle(_sym_handles, _sym(h).get_internals())
+
+
+def symbol_get_name(h: int) -> str:
+    return _sym(h).name or ""
+
+
+def symbol_infer_shape(h: int, arg_names, arg_shapes):
+    """Returns (in_shapes, out_shapes, aux_shapes) as lists of tuples."""
+    sym = _sym(h)
+    kwargs = {n: tuple(int(d) for d in s)
+              for n, s in zip(arg_names, arg_shapes)}
+    in_s, out_s, aux_s = sym.infer_shape(**kwargs)
+    clean = lambda ss: [tuple(s) if s is not None else () for s in ss or []]
+    return clean(in_s), clean(out_s), clean(aux_s)
+
+
+def symbol_infer_type(h: int, arg_names, arg_dtypes):
+    sym = _sym(h)
+    kwargs = {n: t for n, t in zip(arg_names, arg_dtypes)}
+    in_t, out_t, aux_t = sym.infer_type(**kwargs)
+    clean = lambda ts: [str(t) if t is not None else "" for t in ts or []]
+    return clean(in_t), clean(out_t), clean(aux_t)
+
+
+# ---------------------------------------------------------------------------
+# kvstore (ref: c_api.h MXKVStoreCreate/Free/Init/Push/Pull/GetRank/
+# GetGroupSize/GetType/Barrier; src/kvstore/kvstore.cc:40-77 factory)
+# ---------------------------------------------------------------------------
+
+_kv_handles: Dict[int, object] = {}
+
+
+def _kv(h):
+    kv = _kv_handles.get(h)
+    if kv is None:
+        raise MXNetError(f"invalid KVStore handle {h}")
+    return kv
+
+
+def kvstore_create(type_name: str) -> int:
+    from .kvstore import create as kv_create
+    return _new_handle(_kv_handles, kv_create(type_name or "local"))
+
+
+def kvstore_free(h: int):
+    with _lock:
+        _kv_handles.pop(h, None)
+
+
+def kvstore_init(h: int, keys, nd_handles):
+    kv = _kv(h)
+    for k, a in zip(keys, nd_handles):
+        kv.init(k, _nd(a))
+
+
+def kvstore_push(h: int, keys, nd_handles, priority: int = 0):
+    kv = _kv(h)
+    for k, a in zip(keys, nd_handles):
+        kv.push(k, _nd(a), priority=priority)
+
+
+def kvstore_pull(h: int, keys, nd_handles, priority: int = 0):
+    kv = _kv(h)
+    for k, a in zip(keys, nd_handles):
+        kv.pull(k, out=_nd(a), priority=priority)
+
+
+def kvstore_get_rank(h: int) -> int:
+    return int(_kv(h).rank)
+
+
+def kvstore_get_group_size(h: int) -> int:
+    return int(_kv(h).num_workers)
+
+
+def kvstore_get_type(h: int) -> str:
+    return str(_kv(h).type)
+
+
+def kvstore_barrier(h: int):
+    _kv(h).barrier()
+
+
+# ---------------------------------------------------------------------------
+# data iterators (ref: c_api.h MXListDataIters/MXDataIterCreateIter/
+# Next/BeforeFirst/GetData/GetLabel/Free; src/io registry)
+# ---------------------------------------------------------------------------
+
+_iter_handles: Dict[int, object] = {}
+_iter_batches: Dict[int, object] = {}
+
+# file-based iterators only, as in the reference's MXListDataIters
+# (pure-Python NDArrayIter is not reachable through string kwargs)
+_ITER_CREATORS = ("MNISTIter", "CSVIter", "LibSVMIter",
+                  "ImageRecordIter", "ImageDetRecordIter")
+
+
+def list_data_iters():
+    return list(_ITER_CREATORS)
+
+
+def data_iter_create(name: str, param_keys, param_vals) -> int:
+    if name not in _ITER_CREATORS:
+        raise MXNetError(f"unknown data iterator {name}; "
+                         f"choices: {_ITER_CREATORS}")
+    from . import io as io_mod
+    kwargs = {k: _literal(v) for k, v in zip(param_keys, param_vals)}
+    it = getattr(io_mod, name)(**kwargs)
+    return _new_handle(_iter_handles, it)
+
+
+def _iter(h):
+    it = _iter_handles.get(h)
+    if it is None:
+        raise MXNetError(f"invalid DataIter handle {h}")
+    return it
+
+
+def data_iter_next(h: int) -> int:
+    it = _iter(h)
+    try:
+        _iter_batches[h] = next(it)
+        return 1
+    except StopIteration:
+        _iter_batches.pop(h, None)
+        return 0
+
+
+def data_iter_before_first(h: int):
+    _iter(h).reset()
+    _iter_batches.pop(h, None)
+
+
+def _iter_batch(h):
+    b = _iter_batches.get(h)
+    if b is None:
+        raise MXNetError("call MXDataIterNext before reading the batch")
+    return b
+
+
+def data_iter_get_data(h: int) -> int:
+    return _new_handle(_nd_handles, _iter_batch(h).data[0])
+
+
+def data_iter_get_label(h: int) -> int:
+    batch = _iter_batch(h)
+    if batch.label:
+        return _new_handle(_nd_handles, batch.label[0])
+    # label-less iterator: dummy 0-labels sized to the batch, as the
+    # reference's CSVIter emits when no label_csv is configured
+    from .ndarray.ndarray import zeros
+    n = int(batch.data[0].shape[0])
+    return _new_handle(_nd_handles, zeros((n,)))
+
+
+def data_iter_free(h: int):
+    with _lock:
+        _iter_handles.pop(h, None)
+        _iter_batches.pop(h, None)
+
+
+# ---------------------------------------------------------------------------
+# misc (ref: c_api.h MXRandomSeed/MXGetGPUCount/MXSetProfilerState/
+# MXDumpProfile/MXNotifyShutdown)
+# ---------------------------------------------------------------------------
+
+def random_seed(seed: int):
+    from . import random as rnd
+    rnd.seed(int(seed))
+
+
+def get_gpu_count() -> int:
+    from .context import num_gpus
+    return int(num_gpus())
+
+
+def profiler_set_state(state: str):
+    from . import profiler
+    profiler.set_state(state)
+
+
+def profiler_dump():
+    from . import profiler
+    profiler.dump()
+
+
+def notify_shutdown():
+    """ref: MXNotifyShutdown — drain pending async work before exit."""
+    from .ndarray.ndarray import waitall
+    waitall()

@@ -45,6 +45,21 @@ struct Predictor {
 
 void set_error(const std::string &msg) { g_last_error = msg; }
 
+// marshal a Python list of str into C-string storage; non-UTF-8 entries
+// are kept as "" so list positions stay aligned with handle arrays
+void load_string_list(PyObject *list, std::vector<std::string> &names,
+                      std::vector<const char *> &ptrs) {
+  names.clear();
+  ptrs.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    if (!s) PyErr_Clear();
+    names.emplace_back(s ? s : "");
+  }
+  for (const auto &v : names) ptrs.push_back(v.c_str());
+}
+
 std::string fetch_py_error() {
   PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
   PyErr_Fetch(&type, &value, &trace);
@@ -133,18 +148,7 @@ int MXListAllOpNames(uint32_t *out_size, const char ***out_array) {
   PyObject *ret = call_backend("list_op_names", PyTuple_New(0));
   int rc = -1;
   if (ret) {
-    g_op_names.clear();
-    g_op_name_ptrs.clear();
-    Py_ssize_t n = PyList_Size(ret);
-    for (Py_ssize_t i = 0; i < n; ++i) {
-      const char *utf8 = PyUnicode_AsUTF8(PyList_GetItem(ret, i));
-      if (!utf8) {  // skip non-UTF-8-representable names
-        PyErr_Clear();
-        continue;
-      }
-      g_op_names.emplace_back(utf8);
-    }
-    for (const auto &s : g_op_names) g_op_name_ptrs.push_back(s.c_str());
+    load_string_list(ret, g_op_names, g_op_name_ptrs);
     *out_size = static_cast<uint32_t>(g_op_names.size());
     *out_array = g_op_name_ptrs.data();
     Py_DECREF(ret);
@@ -416,8 +420,7 @@ int MXNDArrayCreateFromBytes(const void *data, uint64_t nbytes,
                              const uint32_t *shape, uint32_t ndim,
                              const char *dtype, void **out) {
   return with_backend([&]() -> bool {
-    PyObject *args = PyTuple_Pack(
-        3,
+    PyObject *args = pack_steal(
         PyBytes_FromStringAndSize(static_cast<const char *>(data),
                                   static_cast<Py_ssize_t>(nbytes)),
         shape_list(shape, ndim), PyUnicode_FromString(dtype));
@@ -529,21 +532,15 @@ int MXNDArrayLoad(const char *fname, uint32_t *out_size, void ***out_arr,
     if (!ret) return false;
     PyObject *hs = PyTuple_GetItem(ret, 0);
     PyObject *ns = PyTuple_GetItem(ret, 1);
-    Py_ssize_t n = PyList_Size(hs), nn = PyList_Size(ns);
+    Py_ssize_t n = PyList_Size(hs);
     g_handle_buf.resize(static_cast<size_t>(n));
     for (Py_ssize_t i = 0; i < n; ++i)
       g_handle_buf[i] = as_handle(PyLong_AsLong(PyList_GetItem(hs, i)));
-    g_name_buf.clear();
-    g_name_ptr_buf.clear();
-    for (Py_ssize_t i = 0; i < nn; ++i) {
-      const char *s = PyUnicode_AsUTF8(PyList_GetItem(ns, i));
-      g_name_buf.emplace_back(s ? s : "");
-    }
-    for (const auto &s : g_name_buf) g_name_ptr_buf.push_back(s.c_str());
+    load_string_list(ns, g_name_buf, g_name_ptr_buf);
     Py_DECREF(ret);
     *out_size = static_cast<uint32_t>(n);
     *out_arr = g_handle_buf.data();
-    *out_name_size = static_cast<uint32_t>(nn);
+    *out_name_size = static_cast<uint32_t>(g_name_buf.size());
     *out_names = g_name_ptr_buf.data();
     return true;
   });
@@ -712,6 +709,546 @@ int MXExecutorFree(void *handle) {
         "executor_free", pack_steal(PyLong_FromLong(as_id(handle))));
     Py_XDECREF(ret);
     return ret != nullptr;
+  });
+}
+
+}  // extern "C"
+
+/* ------------------------------------------------------------------------
+ * Expanded MX* families: NDArray extras, autograd, symbol composition &
+ * inference, KVStore, DataIter, misc (ref: include/mxnet/c_api.h).
+ * --------------------------------------------------------------------- */
+
+namespace {
+
+// shared small helpers for the expanded families
+bool ret_handle(PyObject *ret, void **out) {
+  if (!ret) return false;
+  *out = as_handle(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return true;
+}
+
+bool ret_void(PyObject *ret) {
+  Py_XDECREF(ret);
+  return ret != nullptr;
+}
+
+bool ret_int(PyObject *ret, int *out) {
+  if (!ret) return false;
+  *out = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return true;
+}
+
+bool ret_string(PyObject *ret, const char **out) {
+  if (!ret) return false;
+  const char *s = PyUnicode_AsUTF8(ret);
+  if (!s) PyErr_Clear();
+  g_str_buf = s ? s : "";
+  Py_DECREF(ret);
+  *out = g_str_buf.c_str();
+  return true;
+}
+
+PyObject *handle_list(uint32_t num, void **handles) {
+  PyObject *l = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i)
+    PyList_SetItem(l, i, PyLong_FromLong(as_id(handles[i])));
+  return l;
+}
+
+PyObject *string_list(uint32_t num, const char **strs) {
+  PyObject *l = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i)
+    PyList_SetItem(l, i, PyUnicode_FromString(strs[i]));
+  return l;
+}
+
+// per-group storage for CSR-style shape outputs (InferShape): each group
+// owns its rows so the pointers stay valid until the next call
+struct ShapeGroup {
+  std::vector<uint32_t> ndim;
+  std::vector<std::vector<uint32_t>> rows;
+  std::vector<const uint32_t *> ptrs;
+
+  void load(PyObject *tuples) {  // list of tuples of ints
+    Py_ssize_t n = PyList_Size(tuples);
+    ndim.resize(static_cast<size_t>(n));
+    rows.assign(static_cast<size_t>(n), {});
+    ptrs.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *t = PyList_GetItem(tuples, i);
+      Py_ssize_t d = PyTuple_Check(t) ? PyTuple_Size(t) : 0;
+      ndim[i] = static_cast<uint32_t>(d);
+      rows[i].resize(static_cast<size_t>(d));
+      for (Py_ssize_t j = 0; j < d; ++j)
+        rows[i][j] = static_cast<uint32_t>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(t, j)));
+      ptrs[i] = rows[i].data();
+    }
+  }
+};
+
+thread_local ShapeGroup g_in_shapes, g_out_shapes, g_aux_shapes;
+
+// string-list groups for InferType outputs
+struct StrGroup {
+  std::vector<std::string> vals;
+  std::vector<const char *> ptrs;
+
+  void load(PyObject *list) {
+    Py_ssize_t n = PyList_Size(list);
+    vals.clear();
+    ptrs.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+      if (!s) PyErr_Clear();
+      vals.emplace_back(s ? s : "");
+    }
+    for (const auto &v : vals) ptrs.push_back(v.c_str());
+  }
+};
+
+thread_local StrGroup g_in_types, g_out_types, g_aux_types;
+
+}  // namespace
+
+extern "C" {
+
+/* --- NDArray extras --------------------------------------------------- */
+
+int MXNDArraySlice(void *handle, uint32_t begin, uint32_t end, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_slice",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromUnsignedLong(begin),
+                   PyLong_FromUnsignedLong(end))), out);
+  });
+}
+
+int MXNDArrayAt(void *handle, uint32_t idx, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_at", pack_steal(PyLong_FromLong(as_id(handle)),
+                                 PyLong_FromUnsignedLong(idx))), out);
+  });
+}
+
+int MXNDArrayReshape(void *handle, int ndim, const int *dims, void **out) {
+  return with_backend([&]() -> bool {
+    PyObject *s = PyList_New(ndim);
+    for (int i = 0; i < ndim; ++i)
+      PyList_SetItem(s, i, PyLong_FromLong(dims[i]));
+    return ret_handle(call_backend(
+        "ndarray_reshape",
+        pack_steal(PyLong_FromLong(as_id(handle)), s)), out);
+  });
+}
+
+int MXNDArrayGetContext(void *handle, int *out_dev_type, int *out_dev_id) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_get_context", pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(ret, 0)));
+    *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(ret, 1)));
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXNDArrayWaitToRead(void *handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "ndarray_wait_to_read", pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+int MXNDArrayWaitAll(void) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend("ndarray_wait_all", PyTuple_New(0)));
+  });
+}
+
+int MXNDArrayGetGrad(void *handle, void **out) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_get_grad", pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    long id = PyLong_AsLong(ret);
+    Py_DECREF(ret);
+    *out = id ? as_handle(id) : nullptr;  /* NULL: no grad attached */
+    return true;
+  });
+}
+
+/* --- autograd --------------------------------------------------------- */
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("autograd_set_is_recording",
+                                pack_steal(PyLong_FromLong(is_recording))),
+                   prev);
+  });
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("autograd_set_is_training",
+                                pack_steal(PyLong_FromLong(is_training))),
+                   prev);
+  });
+}
+
+int MXAutogradIsRecording(int *out) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("autograd_is_recording", PyTuple_New(0)),
+                   out);
+  });
+}
+
+int MXAutogradIsTraining(int *out) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("autograd_is_training", PyTuple_New(0)),
+                   out);
+  });
+}
+
+int MXAutogradMarkVariables(uint32_t num, void **var_handles,
+                            uint32_t *grad_reqs, void **grad_handles) {
+  return with_backend([&]() -> bool {
+    PyObject *reqs = PyList_New(num);
+    for (uint32_t i = 0; i < num; ++i)
+      PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(grad_reqs[i]));
+    return ret_void(call_backend(
+        "autograd_mark_variables",
+        pack_steal(handle_list(num, var_handles),
+                   handle_list(num, grad_handles), reqs)));
+  });
+}
+
+int MXAutogradBackward(uint32_t num_output, void **output_handles,
+                       void **ograd_handles, int retain_graph,
+                       int train_mode) {
+  return with_backend([&]() -> bool {
+    PyObject *ograds;
+    if (ograd_handles) {
+      ograds = PyList_New(num_output);
+      for (uint32_t i = 0; i < num_output; ++i)
+        PyList_SetItem(ograds, i,
+                       PyLong_FromLong(ograd_handles[i]
+                                           ? as_id(ograd_handles[i]) : 0));
+    } else {
+      ograds = PyList_New(0);
+    }
+    return ret_void(call_backend(
+        "autograd_backward",
+        pack_steal(handle_list(num_output, output_handles), ograds,
+                   PyLong_FromLong(retain_graph),
+                   PyLong_FromLong(train_mode))));
+  });
+}
+
+/* --- symbol composition & inference ----------------------------------- */
+
+int MXSymbolCreateVariable(const char *name, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "symbol_create_variable",
+        pack_steal(PyUnicode_FromString(name))), out);
+  });
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, uint32_t num_param,
+                               const char **keys, const char **vals,
+                               void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "symbol_create_atomic",
+        pack_steal(PyUnicode_FromString(op_name),
+                   string_list(num_param, keys),
+                   string_list(num_param, vals))), out);
+  });
+}
+
+int MXSymbolCompose(void *handle, const char *name, uint32_t num_args,
+                    const char **keys, void **args) {
+  return with_backend([&]() -> bool {
+    /* keys == NULL: positional, in declared op-input order; otherwise
+     * named binding resolved by the backend */
+    PyObject *key_list = keys ? string_list(num_args, keys)
+                              : PyList_New(0);
+    return ret_void(call_backend(
+        "symbol_compose",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyUnicode_FromString(name ? name : ""), key_list,
+                   handle_list(num_args, args))));
+  });
+}
+
+int MXSymbolCopy(void *handle, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "symbol_copy", pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+int MXSymbolGetInternals(void *handle, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "symbol_get_internals",
+        pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+int MXSymbolGetName(void *handle, const char **out) {
+  return with_backend([&]() -> bool {
+    return ret_string(call_backend(
+        "symbol_get_name", pack_steal(PyLong_FromLong(as_id(handle)))),
+                      out);
+  });
+}
+
+int MXSymbolInferShape(void *handle, uint32_t num_args, const char **keys,
+                       const uint32_t *arg_ind_ptr,
+                       const uint32_t *arg_shape_data,
+                       uint32_t *in_shape_size,
+                       const uint32_t **in_shape_ndim,
+                       const uint32_t ***in_shape_data,
+                       uint32_t *out_shape_size,
+                       const uint32_t **out_shape_ndim,
+                       const uint32_t ***out_shape_data,
+                       uint32_t *aux_shape_size,
+                       const uint32_t **aux_shape_ndim,
+                       const uint32_t ***aux_shape_data) {
+  return with_backend([&]() -> bool {
+    PyObject *names = string_list(num_args, keys);
+    PyObject *shapes = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i) {
+      uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+      PyList_SetItem(shapes, i, shape_list(arg_shape_data + lo, hi - lo));
+    }
+    PyObject *ret = call_backend(
+        "symbol_infer_shape",
+        pack_steal(PyLong_FromLong(as_id(handle)), names, shapes));
+    if (!ret) return false;
+    g_in_shapes.load(PyTuple_GetItem(ret, 0));
+    g_out_shapes.load(PyTuple_GetItem(ret, 1));
+    g_aux_shapes.load(PyTuple_GetItem(ret, 2));
+    Py_DECREF(ret);
+    *in_shape_size = static_cast<uint32_t>(g_in_shapes.ndim.size());
+    *in_shape_ndim = g_in_shapes.ndim.data();
+    *in_shape_data = g_in_shapes.ptrs.data();
+    *out_shape_size = static_cast<uint32_t>(g_out_shapes.ndim.size());
+    *out_shape_ndim = g_out_shapes.ndim.data();
+    *out_shape_data = g_out_shapes.ptrs.data();
+    *aux_shape_size = static_cast<uint32_t>(g_aux_shapes.ndim.size());
+    *aux_shape_ndim = g_aux_shapes.ndim.data();
+    *aux_shape_data = g_aux_shapes.ptrs.data();
+    return true;
+  });
+}
+
+int MXSymbolInferType(void *handle, uint32_t num_args, const char **keys,
+                      const char **arg_dtypes, uint32_t *in_type_size,
+                      const char ***in_types, uint32_t *out_type_size,
+                      const char ***out_types, uint32_t *aux_type_size,
+                      const char ***aux_types) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "symbol_infer_type",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   string_list(num_args, keys),
+                   string_list(num_args, arg_dtypes)));
+    if (!ret) return false;
+    g_in_types.load(PyTuple_GetItem(ret, 0));
+    g_out_types.load(PyTuple_GetItem(ret, 1));
+    g_aux_types.load(PyTuple_GetItem(ret, 2));
+    Py_DECREF(ret);
+    *in_type_size = static_cast<uint32_t>(g_in_types.ptrs.size());
+    *in_types = g_in_types.ptrs.data();
+    *out_type_size = static_cast<uint32_t>(g_out_types.ptrs.size());
+    *out_types = g_out_types.ptrs.data();
+    *aux_type_size = static_cast<uint32_t>(g_aux_types.ptrs.size());
+    *aux_types = g_aux_types.ptrs.data();
+    return true;
+  });
+}
+
+/* --- kvstore ----------------------------------------------------------- */
+
+int MXKVStoreCreate(const char *type, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "kvstore_create",
+        pack_steal(PyUnicode_FromString(type ? type : "local"))), out);
+  });
+}
+
+int MXKVStoreFree(void *handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "kvstore_free", pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+static int kv_apply(const char *fn, void *handle, uint32_t num,
+                    const char **keys, void **vals, int priority,
+                    bool with_priority) {
+  return with_backend([&]() -> bool {
+    PyObject *args =
+        with_priority
+            ? pack_steal(PyLong_FromLong(as_id(handle)),
+                         string_list(num, keys), handle_list(num, vals),
+                         PyLong_FromLong(priority))
+            : pack_steal(PyLong_FromLong(as_id(handle)),
+                         string_list(num, keys), handle_list(num, vals));
+    return ret_void(call_backend(fn, args));
+  });
+}
+
+int MXKVStoreInit(void *handle, uint32_t num, const char **keys,
+                  void **vals) {
+  return kv_apply("kvstore_init", handle, num, keys, vals, 0, false);
+}
+
+int MXKVStorePush(void *handle, uint32_t num, const char **keys,
+                  void **vals, int priority) {
+  return kv_apply("kvstore_push", handle, num, keys, vals, priority, true);
+}
+
+int MXKVStorePull(void *handle, uint32_t num, const char **keys,
+                  void **vals, int priority) {
+  return kv_apply("kvstore_pull", handle, num, keys, vals, priority, true);
+}
+
+int MXKVStoreGetRank(void *handle, int *rank) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend(
+        "kvstore_get_rank", pack_steal(PyLong_FromLong(as_id(handle)))),
+                   rank);
+  });
+}
+
+int MXKVStoreGetGroupSize(void *handle, int *size) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend(
+        "kvstore_get_group_size",
+        pack_steal(PyLong_FromLong(as_id(handle)))), size);
+  });
+}
+
+int MXKVStoreGetType(void *handle, const char **type) {
+  return with_backend([&]() -> bool {
+    return ret_string(call_backend(
+        "kvstore_get_type", pack_steal(PyLong_FromLong(as_id(handle)))),
+                      type);
+  });
+}
+
+int MXKVStoreBarrier(void *handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "kvstore_barrier", pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+/* --- data iterators ---------------------------------------------------- */
+
+int MXListDataIters(uint32_t *out_size, const char ***out_array) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend("list_data_iters", PyTuple_New(0));
+    if (!ret) return false;
+    load_string_list(ret, g_name_buf, g_name_ptr_buf);
+    Py_DECREF(ret);
+    *out_size = static_cast<uint32_t>(g_name_buf.size());
+    *out_array = g_name_ptr_buf.data();
+    return true;
+  });
+}
+
+int MXDataIterCreateIter(const char *name, uint32_t num_param,
+                         const char **keys, const char **vals, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "data_iter_create",
+        pack_steal(PyUnicode_FromString(name), string_list(num_param, keys),
+                   string_list(num_param, vals))), out);
+  });
+}
+
+int MXDataIterNext(void *handle, int *out) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend(
+        "data_iter_next", pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+int MXDataIterBeforeFirst(void *handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "data_iter_before_first",
+        pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+int MXDataIterGetData(void *handle, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "data_iter_get_data",
+        pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+int MXDataIterGetLabel(void *handle, void **out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "data_iter_get_label",
+        pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+int MXDataIterFree(void *handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "data_iter_free", pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+/* --- misc --------------------------------------------------------------- */
+
+int MXRandomSeed(int seed) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend("random_seed",
+                                 pack_steal(PyLong_FromLong(seed))));
+  });
+}
+
+int MXGetGPUCount(int *out) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("get_gpu_count", PyTuple_New(0)), out);
+  });
+}
+
+int MXSetProfilerState(int state) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profiler_set_state",
+        pack_steal(PyUnicode_FromString(state ? "run" : "stop"))));
+  });
+}
+
+int MXDumpProfile(void) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend("profiler_dump", PyTuple_New(0)));
+  });
+}
+
+int MXNotifyShutdown(void) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend("notify_shutdown", PyTuple_New(0)));
   });
 }
 

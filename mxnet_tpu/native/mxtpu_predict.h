@@ -118,6 +118,118 @@ int MXExecutorForward(ExecutorHandle handle, int is_train,
                                 uint32_t *out_size, NDArrayHandle **outputs);
 int MXExecutorFree(ExecutorHandle handle);
 
+/* ------------------------------------------------------------------------
+ * Expanded MX* families (ref: include/mxnet/c_api.h): NDArray extras,
+ * autograd, symbol composition & inference, KVStore, DataIter, misc.
+ * Same conventions: 0 on success, -1 on error (MXGetLastError).
+ * --------------------------------------------------------------------- */
+
+typedef void *KVStoreHandle;
+typedef void *DataIterHandle;
+
+/* NDArray extras (ref: MXNDArraySlice/At/Reshape/GetContext/WaitToRead/
+ * WaitAll/GetGrad). Slice/At operate on the first axis; GetGrad sets
+ * *out to NULL when no gradient buffer is attached. dev_type: 1=cpu,
+ * 2=accelerator. */
+int MXNDArraySlice(NDArrayHandle handle, uint32_t begin, uint32_t end,
+                   NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll(void);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+/* Autograd (ref: MXAutogradSetIsRecording/SetIsTraining/IsRecording/
+ * IsTraining/MarkVariables/Backward). grad_reqs codes: 0=null, 1=write,
+ * 2=add. ograd_handles may be NULL (ones-like heads). */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradIsRecording(int *out);
+int MXAutogradIsTraining(int *out);
+int MXAutogradMarkVariables(uint32_t num, NDArrayHandle *var_handles,
+                            uint32_t *grad_reqs,
+                            NDArrayHandle *grad_handles);
+int MXAutogradBackward(uint32_t num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph,
+                       int train_mode);
+
+/* Symbol composition & inference (ref: MXSymbolCreateVariable/
+ * CreateAtomicSymbol/Compose/Copy/GetInternals/GetName/InferShape/
+ * InferType). CreateAtomicSymbol + Compose is the reference's two-step
+ * graph-building protocol: params at create, inputs (positional, in
+ * declared op order) at compose; Compose mutates its handle in place.
+ * InferShape takes CSR-packed known arg shapes and returns borrowed
+ * per-group (arg/out/aux) shape arrays, valid until the next call on
+ * this thread. InferType uses dtype strings ("float32", ...). */
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateAtomicSymbol(const char *op_name, uint32_t num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle handle, const char *name,
+                    uint32_t num_args, const char **keys,
+                    SymbolHandle *args);
+int MXSymbolCopy(SymbolHandle handle, SymbolHandle *out);
+int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle *out);
+int MXSymbolGetName(SymbolHandle handle, const char **out);
+int MXSymbolInferShape(SymbolHandle handle, uint32_t num_args,
+                       const char **keys, const uint32_t *arg_ind_ptr,
+                       const uint32_t *arg_shape_data,
+                       uint32_t *in_shape_size,
+                       const uint32_t **in_shape_ndim,
+                       const uint32_t ***in_shape_data,
+                       uint32_t *out_shape_size,
+                       const uint32_t **out_shape_ndim,
+                       const uint32_t ***out_shape_data,
+                       uint32_t *aux_shape_size,
+                       const uint32_t **aux_shape_ndim,
+                       const uint32_t ***aux_shape_data);
+int MXSymbolInferType(SymbolHandle handle, uint32_t num_args,
+                      const char **keys, const char **arg_dtypes,
+                      uint32_t *in_type_size, const char ***in_types,
+                      uint32_t *out_type_size, const char ***out_types,
+                      uint32_t *aux_type_size, const char ***aux_types);
+
+/* KVStore (ref: MXKVStoreCreate/Free/Init/Push/Pull/GetRank/
+ * GetGroupSize/GetType/Barrier; types: "local", "device", "dist_sync",
+ * "dist_async"). */
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, uint32_t num, const char **keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, uint32_t num, const char **keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, uint32_t num, const char **keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreBarrier(KVStoreHandle handle);
+
+/* Data iterators (ref: MXListDataIters/MXDataIterCreateIter/Next/
+ * BeforeFirst/GetData/GetLabel/Free). Creator params are string
+ * key/value pairs, Python-literal encoded where structured (e.g.
+ * "(3,224,224)"). Next sets *out to 1 while a batch is available. */
+int MXListDataIters(uint32_t *out_size, const char ***out_array);
+int MXDataIterCreateIter(const char *name, uint32_t num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+
+/* Misc (ref: MXRandomSeed/MXGetGPUCount/MXSetProfilerState/
+ * MXDumpProfile/MXNotifyShutdown). */
+int MXRandomSeed(int seed);
+int MXGetGPUCount(int *out);
+int MXSetProfilerState(int state);
+int MXDumpProfile(void);
+int MXNotifyShutdown(void);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

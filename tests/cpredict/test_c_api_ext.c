@@ -1,0 +1,251 @@
+/* Consumer test of the expanded MX* C ABI families: NDArray extras,
+ * autograd, symbol composition/inference, KVStore, DataIter, misc
+ * (ref: include/mxnet/c_api.h consumers; the embeddable training ABI
+ * every reference language binding sits on).
+ * Usage: test_c_api_ext <tmpdir>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu_predict.h"
+
+#define CHECK(cond, msg)                                        \
+  if (!(cond)) {                                                \
+    fprintf(stderr, "FAIL %s: %s\n", msg, MXGetLastError());    \
+    return 1;                                                   \
+  }
+
+int main(int argc, char **argv) {
+  const char *tmpdir = argc > 1 ? argv[1] : ".";
+
+  /* --- NDArray extras: slice / at / reshape / context / wait ------- */
+  uint32_t shape[2] = {4, 3};
+  float vals[12];
+  for (int i = 0; i < 12; ++i) vals[i] = (float)i;
+  NDArrayHandle a = NULL;
+  CHECK(MXNDArrayCreateFromBytes(vals, sizeof(vals), shape, 2, "float32",
+                                 &a) == 0, "CreateFromBytes");
+
+  NDArrayHandle sl = NULL, at = NULL, rs = NULL;
+  CHECK(MXNDArraySlice(a, 1, 3, &sl) == 0, "Slice");
+  uint32_t ndim = 0;
+  const uint32_t *pshape = NULL;
+  CHECK(MXNDArrayGetShape(sl, &ndim, &pshape) == 0 && ndim == 2 &&
+        pshape[0] == 2 && pshape[1] == 3, "slice shape");
+  float slv[6];
+  CHECK(MXNDArraySyncCopyToCPU(sl, slv, sizeof(slv)) == 0, "slice copy");
+  CHECK(slv[0] == 3.0f && slv[5] == 8.0f, "slice values");
+
+  CHECK(MXNDArrayAt(a, 2, &at) == 0, "At");
+  CHECK(MXNDArrayGetShape(at, &ndim, &pshape) == 0 && ndim == 1 &&
+        pshape[0] == 3, "at shape");
+
+  int dims[2] = {3, 4};
+  CHECK(MXNDArrayReshape(a, 2, dims, &rs) == 0, "Reshape");
+  CHECK(MXNDArrayGetShape(rs, &ndim, &pshape) == 0 && ndim == 2 &&
+        pshape[0] == 3 && pshape[1] == 4, "reshape shape");
+
+  int dev_type = 0, dev_id = -1;
+  CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id) == 0, "GetContext");
+  CHECK(dev_type == 1 || dev_type == 2, "context type");
+  CHECK(MXNDArrayWaitToRead(a) == 0, "WaitToRead");
+  CHECK(MXNDArrayWaitAll() == 0, "WaitAll");
+  printf("ndarray_ext_ok=1\n");
+
+  /* --- autograd: record y = x*x, backward, read grad ---------------- */
+  uint32_t xshape[1] = {3};
+  float xv[3] = {1, 2, 3};
+  NDArrayHandle x = NULL, xg = NULL;
+  CHECK(MXNDArrayCreateFromBytes(xv, sizeof(xv), xshape, 1, "float32",
+                                 &x) == 0, "x create");
+  CHECK(MXNDArrayCreate(xshape, 1, "float32", &xg) == 0, "grad buf");
+  uint32_t reqs[1] = {1}; /* write */
+  CHECK(MXAutogradMarkVariables(1, &x, reqs, &xg) == 0, "MarkVariables");
+
+  int prev = -1;
+  CHECK(MXAutogradSetIsRecording(1, &prev) == 0 && prev == 0,
+        "SetIsRecording");
+  int rec = 0;
+  CHECK(MXAutogradIsRecording(&rec) == 0 && rec == 1, "IsRecording");
+
+  NDArrayHandle ins[2];
+  ins[0] = x;
+  ins[1] = x;
+  NDArrayHandle *outs = NULL;
+  int n_out = 0;
+  CHECK(MXImperativeInvoke("elemwise_mul", 2, ins, &n_out, &outs, 0, NULL,
+                           NULL) == 0 && n_out == 1, "record mul");
+  NDArrayHandle y = outs[0];
+  CHECK(MXAutogradSetIsRecording(0, &prev) == 0 && prev == 1,
+        "stop recording");
+
+  CHECK(MXAutogradBackward(1, &y, NULL, 0, 1) == 0, "Backward");
+  NDArrayHandle g = NULL;
+  CHECK(MXNDArrayGetGrad(x, &g) == 0 && g != NULL, "GetGrad");
+  float gv[3];
+  CHECK(MXNDArraySyncCopyToCPU(g, gv, sizeof(gv)) == 0, "grad copy");
+  CHECK(gv[0] == 2.0f && gv[1] == 4.0f && gv[2] == 6.0f,
+        "d(x*x)/dx == 2x");
+  printf("autograd_ok=1\n");
+
+  /* --- symbol: variable + atomic + compose + infer ------------------ */
+  SymbolHandle data = NULL, fc = NULL;
+  CHECK(MXSymbolCreateVariable("data", &data) == 0, "CreateVariable");
+  const char *pk[1] = {"num_hidden"};
+  const char *pv[1] = {"4"};
+  CHECK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, pk, pv, &fc) == 0,
+        "CreateAtomicSymbol");
+  SymbolHandle compose_args[1];
+  compose_args[0] = data;
+  CHECK(MXSymbolCompose(fc, "fc1", 1, NULL, compose_args) == 0, "Compose");
+
+  const char *sname = NULL;
+  CHECK(MXSymbolGetName(fc, &sname) == 0 && strcmp(sname, "fc1") == 0,
+        "GetName");
+
+  uint32_t n_args = 0;
+  const char **arg_names = NULL;
+  CHECK(MXSymbolListArguments(fc, &n_args, &arg_names) == 0 && n_args == 3,
+        "auto-created weight/bias args");
+
+  /* infer shapes from data shape (2,5) */
+  const char *known[1] = {"data"};
+  uint32_t indptr[2] = {0, 2};
+  uint32_t sdata[2] = {2, 5};
+  uint32_t in_n = 0, out_n = 0, aux_n = 0;
+  const uint32_t *in_ndim = NULL, *out_ndim = NULL, *aux_ndim = NULL;
+  const uint32_t **in_sh = NULL, **out_sh = NULL, **aux_sh = NULL;
+  CHECK(MXSymbolInferShape(fc, 1, known, indptr, sdata, &in_n, &in_ndim,
+                           &in_sh, &out_n, &out_ndim, &out_sh, &aux_n,
+                           &aux_ndim, &aux_sh) == 0, "InferShape");
+  CHECK(in_n == 3 && out_n == 1, "inferred counts");
+  CHECK(out_ndim[0] == 2 && out_sh[0][0] == 2 && out_sh[0][1] == 4,
+        "output shape (2,4)");
+  /* weight is argument 1: (num_hidden, in_dim) = (4,5) */
+  CHECK(in_ndim[1] == 2 && in_sh[1][0] == 4 && in_sh[1][1] == 5,
+        "weight shape (4,5)");
+
+  const char *tkeys[1] = {"data"};
+  const char *tvals[1] = {"float32"};
+  uint32_t tin_n = 0, tout_n = 0, taux_n = 0;
+  const char **tin = NULL, **tout = NULL, **taux = NULL;
+  CHECK(MXSymbolInferType(fc, 1, tkeys, tvals, &tin_n, &tin, &tout_n,
+                          &tout, &taux_n, &taux) == 0, "InferType");
+  CHECK(tout_n == 1 && strcmp(tout[0], "float32") == 0, "output type");
+
+  SymbolHandle fc_copy = NULL, internals = NULL;
+  CHECK(MXSymbolCopy(fc, &fc_copy) == 0, "Copy");
+  CHECK(MXSymbolGetInternals(fc, &internals) == 0, "GetInternals");
+  uint32_t n_int = 0;
+  const char **int_names = NULL;
+  CHECK(MXSymbolListOutputs(internals, &n_int, &int_names) == 0 &&
+        n_int >= 1, "internals outputs");
+
+  /* named composition + failed-compose retry */
+  SymbolHandle fc2 = NULL;
+  CHECK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, pk, pv, &fc2) == 0,
+        "second atomic");
+  SymbolHandle bad_args[1];
+  bad_args[0] = (SymbolHandle)(intptr_t)999999; /* invalid handle */
+  CHECK(MXSymbolCompose(fc2, "fc2", 1, NULL, bad_args) != 0,
+        "compose with bad arg must fail");
+  const char *named_keys[1] = {"data"};
+  SymbolHandle named_args[1];
+  named_args[0] = data;
+  CHECK(MXSymbolCompose(fc2, "fc2", 1, named_keys, named_args) == 0,
+        "retry with named binding succeeds");
+  CHECK(MXSymbolGetName(fc2, &sname) == 0 && strcmp(sname, "fc2") == 0,
+        "named compose name");
+  printf("symbol_ok=1\n");
+
+  /* --- kvstore: init / push / pull ---------------------------------- */
+  KVStoreHandle kv = NULL;
+  CHECK(MXKVStoreCreate("local", &kv) == 0, "KVStoreCreate");
+  const char *ktype = NULL;
+  CHECK(MXKVStoreGetType(kv, &ktype) == 0 && strcmp(ktype, "local") == 0,
+        "GetType");
+  int rank = -1, size = 0;
+  CHECK(MXKVStoreGetRank(kv, &rank) == 0 && rank == 0, "GetRank");
+  CHECK(MXKVStoreGetGroupSize(kv, &size) == 0 && size == 1,
+        "GetGroupSize");
+
+  uint32_t wshape[1] = {4};
+  float wv[4] = {1, 1, 1, 1};
+  float gv4[4] = {0.5f, 0.5f, 0.5f, 0.5f};
+  NDArrayHandle w = NULL, wg = NULL, wout = NULL;
+  CHECK(MXNDArrayCreateFromBytes(wv, sizeof(wv), wshape, 1, "float32",
+                                 &w) == 0, "w");
+  CHECK(MXNDArrayCreateFromBytes(gv4, sizeof(gv4), wshape, 1, "float32",
+                                 &wg) == 0, "wg");
+  CHECK(MXNDArrayCreate(wshape, 1, "float32", &wout) == 0, "wout");
+  const char *wkeys[1] = {"w0"};
+  CHECK(MXKVStoreInit(kv, 1, wkeys, &w) == 0, "Init");
+  CHECK(MXKVStorePush(kv, 1, wkeys, &wg, 0) == 0, "Push");
+  CHECK(MXKVStorePull(kv, 1, wkeys, &wout, 0) == 0, "Pull");
+  float pulled[4];
+  CHECK(MXNDArraySyncCopyToCPU(wout, pulled, sizeof(pulled)) == 0,
+        "pull copy");
+  /* local kvstore: pull returns init value + pushed grad sum */
+  CHECK(pulled[0] == 1.5f && pulled[3] == 1.5f, "pull values");
+  CHECK(MXKVStoreBarrier(kv) == 0, "Barrier");
+  CHECK(MXKVStoreFree(kv) == 0, "KVStoreFree");
+  printf("kvstore_ok=1\n");
+
+  /* --- data iter: CSVIter over a generated file --------------------- */
+  char csv_path[1024];
+  snprintf(csv_path, sizeof(csv_path), "%s/c_api_ext.csv", tmpdir);
+  FILE *f = fopen(csv_path, "w");
+  CHECK(f != NULL, "csv open");
+  for (int i = 0; i < 6; ++i) fprintf(f, "%d,%d\n", 2 * i, 2 * i + 1);
+  fclose(f);
+
+  uint32_t n_iters = 0;
+  const char **iter_names = NULL;
+  CHECK(MXListDataIters(&n_iters, &iter_names) == 0 && n_iters >= 3,
+        "ListDataIters");
+  int has_csv = 0;
+  for (uint32_t i = 0; i < n_iters; ++i)
+    if (strcmp(iter_names[i], "CSVIter") == 0) has_csv = 1;
+  CHECK(has_csv, "CSVIter listed");
+
+  const char *ikeys[3] = {"data_csv", "data_shape", "batch_size"};
+  const char *ivals[3] = {csv_path, "(2,)", "3"};
+  DataIterHandle it = NULL;
+  CHECK(MXDataIterCreateIter("CSVIter", 3, ikeys, ivals, &it) == 0,
+        "DataIterCreateIter");
+  int has_next = 0, batches = 0;
+  float first_val = -1.0f;
+  while (MXDataIterNext(it, &has_next) == 0 && has_next) {
+    NDArrayHandle batch = NULL;
+    CHECK(MXDataIterGetData(it, &batch) == 0, "GetData");
+    uint32_t bnd = 0;
+    const uint32_t *bsh = NULL;
+    CHECK(MXNDArrayGetShape(batch, &bnd, &bsh) == 0 && bnd == 2 &&
+          bsh[0] == 3 && bsh[1] == 2, "batch shape");
+    if (batches == 0) {
+      float bv[6];
+      CHECK(MXNDArraySyncCopyToCPU(batch, bv, sizeof(bv)) == 0,
+            "batch copy");
+      first_val = bv[0];
+    }
+    ++batches;
+  }
+  CHECK(batches == 2, "two batches of 3");
+  CHECK(first_val == 0.0f, "first csv value");
+  CHECK(MXDataIterBeforeFirst(it) == 0, "BeforeFirst");
+  CHECK(MXDataIterNext(it, &has_next) == 0 && has_next, "next after reset");
+  CHECK(MXDataIterFree(it) == 0, "DataIterFree");
+  remove(csv_path);
+  printf("dataiter_ok=1\n");
+
+  /* --- misc ---------------------------------------------------------- */
+  CHECK(MXRandomSeed(42) == 0, "RandomSeed");
+  int ngpu = -1;
+  CHECK(MXGetGPUCount(&ngpu) == 0 && ngpu >= 0, "GetGPUCount");
+  CHECK(MXNotifyShutdown() == 0, "NotifyShutdown");
+  printf("misc_ok=1\n");
+
+  printf("ALL_OK\n");
+  return 0;
+}
