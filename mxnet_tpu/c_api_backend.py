@@ -351,6 +351,10 @@ def symbol_list_auxiliary_states(h: int):
 def symbol_free(h: int):
     with _lock:
         _sym_handles.pop(h, None)
+        # an un-composed atomic symbol keeps its pending state in a side
+        # table; drop it too or a later Compose could resurrect the
+        # freed handle
+        _atomic_handles.pop(h, None)
 
 
 # -- executor ---------------------------------------------------------------
@@ -545,6 +549,8 @@ def symbol_compose(h: int, name: str, arg_keys, arg_handles):
                 raise MXNetError(f"unknown input '{k}' for {op_name}; "
                                  f"declared inputs: {declared}")
             slots[declared.index(k)] = e
+        if len(slots) != len(entries):
+            raise MXNetError(f"duplicate input names in {sorted(arg_keys)}")
         if sorted(slots) != list(range(len(slots))):
             raise MXNetError(f"named inputs {sorted(arg_keys)} must fill "
                              f"a prefix of {declared} (later inputs are "

@@ -797,17 +797,7 @@ struct StrGroup {
   std::vector<std::string> vals;
   std::vector<const char *> ptrs;
 
-  void load(PyObject *list) {
-    Py_ssize_t n = PyList_Size(list);
-    vals.clear();
-    ptrs.clear();
-    for (Py_ssize_t i = 0; i < n; ++i) {
-      const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
-      if (!s) PyErr_Clear();
-      vals.emplace_back(s ? s : "");
-    }
-    for (const auto &v : vals) ptrs.push_back(v.c_str());
-  }
+  void load(PyObject *list) { load_string_list(list, vals, ptrs); }
 };
 
 thread_local StrGroup g_in_types, g_out_types, g_aux_types;
@@ -1161,10 +1151,14 @@ int MXListDataIters(uint32_t *out_size, const char ***out_array) {
   return with_backend([&]() -> bool {
     PyObject *ret = call_backend("list_data_iters", PyTuple_New(0));
     if (!ret) return false;
-    load_string_list(ret, g_name_buf, g_name_ptr_buf);
+    // dedicated buffers: g_name_buf backs MXNDArrayLoad's returned name
+    // array, which must stay valid across unrelated ABI calls
+    thread_local std::vector<std::string> iter_names;
+    thread_local std::vector<const char *> iter_ptrs;
+    load_string_list(ret, iter_names, iter_ptrs);
     Py_DECREF(ret);
-    *out_size = static_cast<uint32_t>(g_name_buf.size());
-    *out_array = g_name_ptr_buf.data();
+    *out_size = static_cast<uint32_t>(iter_names.size());
+    *out_array = iter_ptrs.data();
     return true;
   });
 }
