@@ -105,6 +105,7 @@ int main(void) {
   uint32_t shapes[16][8];
   uint32_t ndims[16];
   for (uint32_t i = 0; i < n_args; ++i) {
+    CHECK(in_ndim[i] <= 8, "rank budget");
     ndims[i] = in_ndim[i];
     for (uint32_t d = 0; d < in_ndim[i]; ++d) shapes[i][d] = in_sh[i][d];
   }
@@ -168,6 +169,7 @@ int main(void) {
     float probs[64 * 2];
     CHECK(MXNDArraySyncCopyToCPU(outs[0], probs, sizeof(probs)) == 0,
           "probs copy");
+    CHECK(MXNDArrayFree(outs[0]) == 0, "free fwd out");
     float loss = 0.0f;
     for (int i = 0; i < B; ++i) {
       float p = probs[i * OUT + (int)y[i]];
@@ -203,12 +205,22 @@ int main(void) {
                                      numel * sizeof(float)) == 0,
             "w write");
       free(tmp);
+      CHECK(MXNDArrayFree(upd_out[0]) == 0, "free upd out");
     }
+    /* release this step's grad handles — per-step handles are minted
+     * fresh by the ABI; a long-running consumer must free them */
+    for (uint32_t i = 0; i < n_grads; ++i)
+      if (grads[i]) CHECK(MXNDArrayFree(grads[i]) == 0, "free grad");
   }
 
   printf("first_loss=%.4f last_loss=%.4f\n", first_loss, last_loss);
   CHECK(last_loss < first_loss * 0.7f, "loss must fall by >30%");
   CHECK(MXExecutorFree(exe) == 0, "exec free");
+  for (uint32_t i = 0; i < n_args; ++i)
+    CHECK(MXNDArrayFree(args[i]) == 0, "arg free");
+  SymbolHandle syms[6] = {data, label, fc1, act, fc2, out_sym};
+  for (int i = 0; i < 6; ++i)
+    CHECK(MXSymbolFree(syms[i]) == 0, "symbol free");
   CHECK(MXNotifyShutdown() == 0, "shutdown");
   printf("C_TRAIN_OK\n");
   return 0;

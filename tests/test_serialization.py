@@ -5,6 +5,7 @@ layout (NOT via the code under test), so these tests pin the on-disk
 format: a reference-produced file must load, and save() must emit
 byte-identical output for the same content.
 """
+import os
 import struct
 
 import numpy as onp
@@ -164,3 +165,22 @@ def test_module_checkpoint_uses_reference_format(tmp_path):
     _, loaded_arg, _ = mx.model.load_checkpoint(prefix, 1)
     assert onp.array_equal(loaded_arg["fc_weight"].asnumpy(),
                            arg["fc_weight"].asnumpy())
+
+
+REFERENCE_V0 = "/root/reference/tests/python/unittest/legacy_ndarray.v0"
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_V0),
+                    reason="reference checkout not present")
+def test_reference_v0_fixture_loads_bit_for_bit():
+    """The reference repo ships a v0-era NDArray file as its own
+    backward-compat gate (ref: tests/python/unittest/test_ndarray.py
+    test_legacy_ndarray_load, fixture legacy_ndarray.v0 = six
+    arange(128) arrays). Loading the actual reference-produced bytes is
+    the strongest cross-implementation interop proof available here."""
+    arrs = nd.load(REFERENCE_V0)
+    assert isinstance(arrs, list) and len(arrs) == 6
+    expect = onp.arange(128, dtype="float32")
+    for a in arrs:
+        assert a.shape == (128,) and str(a.dtype) == "float32"
+        assert onp.array_equal(a.asnumpy(), expect)
