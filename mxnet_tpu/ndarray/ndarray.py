@@ -143,6 +143,13 @@ def _coerce_operand(other, ref: "NDArray"):
     return _wrap(arr)
 
 
+def _op_div(lhs, rhs):
+    # shared with elemwise_div/broadcast_div: int/int stays integer with
+    # C-style trunc division (lazy import: ops imports this module)
+    from ..ops.tensor import _div
+    return _div(lhs, rhs)
+
+
 class NDArray:
     """Multi-dimensional array (ref: python/mxnet/ndarray/ndarray.py NDArray)."""
 
@@ -348,8 +355,10 @@ class NDArray:
     def __rsub__(self, o): return self._rbinary(o, jnp.subtract)
     def __mul__(self, o): return self._binary(o, jnp.multiply)
     def __rmul__(self, o): return self._rbinary(o, jnp.multiply)
-    def __truediv__(self, o): return self._binary(o, jnp.divide)
-    def __rtruediv__(self, o): return self._rbinary(o, jnp.divide)
+    # int/int keeps dtype with C-style trunc division, as the
+    # reference's elemwise_div does (see ops.tensor._div)
+    def __truediv__(self, o): return self._binary(o, _op_div)
+    def __rtruediv__(self, o): return self._rbinary(o, _op_div)
     def __floordiv__(self, o): return self._binary(o, jnp.floor_divide)
     def __rfloordiv__(self, o): return self._rbinary(o, jnp.floor_divide)
     def __mod__(self, o): return self._binary(o, jnp.mod)
@@ -380,7 +389,7 @@ class NDArray:
 
     def __itruediv__(self, o):
         o = _coerce_operand(o, self)
-        out = invoke(jnp.divide, [self, o])
+        out = invoke(_op_div, [self, o])
         self._rebind(out._data)
         return self
 

@@ -22,9 +22,24 @@ from .registry import register_op
 #       elemwise_binary_broadcast_op_*.cc)
 # ---------------------------------------------------------------------------
 
+def _div(lhs, rhs):
+    """Division keeps integer dtypes as C-style (round-toward-zero)
+    integer division, as the reference's elemwise/broadcast div does
+    (mshadow op::div on integral types); jnp.divide would promote the
+    result to float. lax.div neither broadcasts nor promotes, so do
+    both first."""
+    lhs, rhs = jnp.asarray(lhs), jnp.asarray(rhs)
+    if jnp.issubdtype(lhs.dtype, jnp.integer) and \
+            jnp.issubdtype(rhs.dtype, jnp.integer):
+        dt = jnp.promote_types(lhs.dtype, rhs.dtype)
+        lhs, rhs = jnp.broadcast_arrays(lhs.astype(dt), rhs.astype(dt))
+        return jax.lax.div(lhs, rhs)  # trunc division, dtype-preserving
+    return jnp.divide(lhs, rhs)
+
+
 _BINARY = {
     "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
-    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "div": _div, "mod": jnp.mod, "power": jnp.power,
     "maximum": jnp.maximum, "minimum": jnp.minimum,
     "hypot": jnp.hypot,
 }
@@ -61,7 +76,8 @@ for _name, _fn in _SCALAR.items():
         (lambda f: lambda data, scalar=1.0: f(data, jnp.asarray(scalar, data.dtype)).astype(data.dtype))(_fn))
 
 register_op("_rminus_scalar")(lambda data, scalar=1.0: scalar - data)
-register_op("_rdiv_scalar")(lambda data, scalar=1.0: scalar / data)
+register_op("_rdiv_scalar")(
+    lambda data, scalar=1.0: _div(jnp.asarray(scalar, data.dtype), data))
 register_op("_rpower_scalar")(lambda data, scalar=1.0: jnp.power(scalar, data))
 register_op("_rmod_scalar")(lambda data, scalar=1.0: jnp.mod(scalar, data))
 
@@ -464,7 +480,13 @@ def diag(data, k=0, axis1=0, axis2=1):
 
 @register_op("where")
 def where(condition, x, y):
-    return jnp.where(condition.astype(bool), x, y)
+    """ref: src/operator/tensor/control_flow_op.h Where — condition is
+    either the same shape as x/y, or a 1-D vector of length x.shape[0]
+    selecting whole rows (the reference's csr/vector mode)."""
+    cond = condition.astype(bool)
+    if cond.ndim == 1 and x.ndim > 1 and cond.shape[0] == x.shape[0]:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond, x, y)
 
 
 @register_op("broadcast_to")

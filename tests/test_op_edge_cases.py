@@ -1,0 +1,345 @@
+"""Parametrized edge-case tier for the core operator families.
+
+The reference exercises each op across many shapes/axes/dtypes
+(tests/python/unittest/test_operator.py runs thousands of cases); the
+registry sweep (test_op_sweep.py) runs each op once. This tier fills
+the gap for the families where edge cases actually bite: reductions
+(negative axes, keepdims, empty/1-sized axes), broadcasting (mixed
+ranks, zeros), indexing (negative indices, clip/wrap modes), slicing
+(negative bounds, strides), dtype promotion, and shape-special ops.
+Every expectation comes from numpy on the same inputs.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+rs = onp.random.RandomState(7)
+
+
+def A(*shape, dtype="float32"):
+    return rs.uniform(-2, 2, shape).astype(dtype)
+
+
+def assert_np(out, expect, rtol=1e-5, atol=1e-6):
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=rtol,
+                                atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# reductions: axes (incl. negative, tuple), keepdims, degenerate dims
+# ---------------------------------------------------------------------------
+
+REDUCTIONS = [("sum", onp.sum), ("mean", onp.mean), ("prod", onp.prod),
+              ("max", onp.max), ("min", onp.min)]
+
+
+@pytest.mark.parametrize("name,ref", REDUCTIONS)
+@pytest.mark.parametrize("axis,keepdims", [
+    (None, False), (0, False), (1, True), (-1, False), (-2, True),
+    ((0, 2), False), ((0, 2), True), ((-1, -2), False),
+])
+def test_reduction_axes(name, ref, axis, keepdims):
+    x = A(2, 3, 4)
+    out = getattr(nd, name)(nd.array(x), axis=axis, keepdims=keepdims)
+    expect = ref(x, axis=axis, keepdims=keepdims)
+    assert_np(out, onp.asarray(expect, dtype="float32"), rtol=1e-4)
+
+
+@pytest.mark.parametrize("name,ref", REDUCTIONS)
+def test_reduction_size_one_axis(name, ref):
+    x = A(3, 1, 2)
+    out = getattr(nd, name)(nd.array(x), axis=1)
+    assert_np(out, onp.asarray(ref(x, axis=1), "float32"), rtol=1e-4)
+
+
+def test_sum_empty_axis_result():
+    # reducing a 0-sized axis: sum -> 0, consistent with numpy
+    x = onp.zeros((2, 0, 3), "float32")
+    out = nd.sum(nd.array(x), axis=1)
+    assert_np(out, onp.sum(x, axis=1))
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_argmax_argmin_axes(axis):
+    x = A(4, 5)
+    assert_np(nd.argmax(nd.array(x), axis=axis),
+              onp.argmax(x, axis=axis).astype("float32"))
+    assert_np(nd.argmin(nd.array(x), axis=axis),
+              onp.argmin(x, axis=axis).astype("float32"))
+
+
+def test_norm_ord_axis():
+    x = A(3, 4)
+    assert_np(nd.norm(nd.array(x), ord=2, axis=1),
+              onp.linalg.norm(x, ord=2, axis=1), rtol=1e-4)
+    assert_np(nd.norm(nd.array(x), ord=1, axis=0),
+              onp.abs(x).sum(axis=0), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# broadcasting: mixed ranks, ones, zero-sized dims
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sa,sb", [
+    ((2, 3), (3,)), ((2, 3), (1, 3)), ((2, 1, 4), (3, 1)),
+    ((1,), (2, 3)), ((2, 3), (2, 1)), ((5, 1, 3), (1, 4, 3)),
+])
+@pytest.mark.parametrize("op,ref", [
+    ("broadcast_add", onp.add), ("broadcast_mul", onp.multiply),
+    ("broadcast_maximum", onp.maximum),
+])
+def test_broadcast_shapes(sa, sb, op, ref):
+    a, b = A(*sa), A(*sb)
+    assert_np(getattr(nd, op)(nd.array(a), nd.array(b)), ref(a, b))
+
+
+def test_broadcast_with_zero_dim():
+    a, b = A(2, 0, 3), A(1, 1, 3)
+    out = nd.broadcast_add(nd.array(a), nd.array(b))
+    assert out.shape == (2, 0, 3)
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("broadcast_greater", onp.greater),
+    ("broadcast_lesser_equal", onp.less_equal),
+    ("broadcast_not_equal", onp.not_equal),
+])
+def test_broadcast_comparisons(op, ref):
+    a, b = A(3, 4), A(1, 4)
+    assert_np(getattr(nd, op)(nd.array(a), nd.array(b)),
+              ref(a, b).astype("float32"))
+
+
+# ---------------------------------------------------------------------------
+# indexing: take modes, negative indices, gather/scatter shapes
+# ---------------------------------------------------------------------------
+
+def test_take_clip_mode():
+    x = A(5, 3)
+    idx = onp.array([0, 4, 7, -1], "float32")  # out of range both ways
+    out = nd.take(nd.array(x), nd.array(idx), mode="clip")
+    expect = x[onp.clip(idx.astype("int64"), 0, 4)]
+    assert_np(out, expect)
+
+
+def test_take_wrap_mode():
+    x = A(5, 3)
+    idx = onp.array([-1, 5, 6], "float32")
+    out = nd.take(nd.array(x), nd.array(idx), mode="wrap")
+    expect = x[onp.mod(idx.astype("int64"), 5)]
+    assert_np(out, expect)
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_take_axis(axis):
+    x = A(4, 5)
+    idx = onp.array([1, 3], "float32")
+    out = nd.take(nd.array(x), nd.array(idx), axis=axis)
+    assert_np(out, onp.take(x, idx.astype("int64"), axis=axis))
+
+
+def test_pick_negative_axis_and_modes():
+    x = A(4, 5)
+    idx = onp.array([0, 4, 2, 1], "float32")
+    out = nd.pick(nd.array(x), nd.array(idx), axis=-1)
+    assert_np(out, x[onp.arange(4), idx.astype("int64")])
+
+
+def test_gather_nd_rank3():
+    x = A(3, 4, 5)
+    ind = onp.array([[0, 2], [1, 3], [2, 0]], "float32")  # (3 dims? no:
+    # indices shape (M, N) indexes first M axes at N points)
+    out = nd.gather_nd(nd.array(x), nd.array(ind))
+    expect = x[ind[0].astype("int64"), ind[1].astype("int64"),
+               ind[2].astype("int64")]
+    assert_np(out, expect)
+
+
+def test_one_hot_depth_and_values():
+    idx = onp.array([0, 2, 1], "float32")
+    out = nd.one_hot(nd.array(idx), depth=4, on_value=5.0, off_value=-1.0)
+    expect = onp.full((3, 4), -1.0, "float32")
+    expect[onp.arange(3), idx.astype("int64")] = 5.0
+    assert_np(out, expect)
+
+
+# ---------------------------------------------------------------------------
+# slicing & shape ops: negative bounds, steps, degenerate results
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("begin,end,step", [
+    ((0, 0), (2, 3), None), ((1, -3), (3, -1), None),
+    ((0, 4), (4, 0), (1, -1)), ((3, 0), (0, 3), (-1, 1)),
+])
+def test_slice_negative_and_step(begin, end, step):
+    x = A(4, 5)
+    kwargs = {"begin": begin, "end": end}
+    if step:
+        kwargs["step"] = step
+    out = nd.slice(nd.array(x), **kwargs)
+    sl = tuple(slice(b, e, s) for b, e, s in
+               zip(begin, end, step or (None,) * len(begin)))
+    assert_np(out, x[sl])
+
+
+def test_slice_axis_negative():
+    x = A(3, 6)
+    out = nd.slice_axis(nd.array(x), axis=-1, begin=-4, end=-1)
+    assert_np(out, x[:, -4:-1])
+
+
+def test_reshape_special_codes():
+    x = A(2, 3, 4)
+    # 0 copies the input dim; -1 infers
+    out = nd.reshape(nd.array(x), shape=(0, -1))
+    assert out.shape == (2, 12)
+    # -2 copies the remaining dims
+    out2 = nd.reshape(nd.array(x), shape=(0, -2))
+    assert out2.shape == (2, 3, 4)
+    # -3 merges two dims
+    out3 = nd.reshape(nd.array(x), shape=(-3, 0))
+    assert out3.shape == (6, 4)
+
+
+def test_flip_multiple_axes():
+    x = A(2, 3, 4)
+    assert_np(nd.reverse(nd.array(x), axis=(0, 2)),
+              x[::-1, :, ::-1])
+
+
+def test_tile_broadcast_rank_mismatch():
+    x = A(2, 3)
+    out = nd.tile(nd.array(x), reps=(2, 1, 2))
+    assert_np(out, onp.tile(x, (2, 1, 2)))
+
+
+def test_expand_squeeze_negative_axis():
+    x = A(2, 3)
+    e = nd.expand_dims(nd.array(x), axis=-1)
+    assert e.shape == (2, 3, 1)
+    s = nd.squeeze(e, axis=-1)
+    assert s.shape == (2, 3)
+
+
+@pytest.mark.parametrize("axis", [0, 1, 2, -1])
+def test_stack_concat_axes(axis):
+    a, b = A(2, 3, 4), A(2, 3, 4)
+    out = nd.stack(nd.array(a), nd.array(b), axis=axis)
+    assert_np(out, onp.stack([a, b], axis=axis))
+    cat_axis = axis if axis != 2 else 1
+    out2 = nd.concat(nd.array(a), nd.array(b), dim=cat_axis)
+    assert_np(out2, onp.concatenate([a, b], axis=cat_axis))
+
+
+def test_where_broadcast_condition():
+    cond = onp.array([1, 0, 1], "float32")
+    a, b = A(3, 2), A(3, 2)
+    out = nd.where(nd.array(cond), nd.array(a), nd.array(b))
+    expect = onp.where(cond[:, None].astype(bool), a, b)
+    assert_np(out, expect)
+
+
+# ---------------------------------------------------------------------------
+# dtype behavior: promotion, cast edge values, integer arithmetic
+# ---------------------------------------------------------------------------
+
+def test_cast_out_of_range_saturates():
+    # Out-of-range float->int casts are UB in C (the reference wraps on
+    # most platforms); XLA converts SATURATE, which is the well-defined
+    # contract we pin: negatives clamp to 0, overflow clamps to max.
+    x = onp.array([-1.9, -0.5, 0.5, 300.7], "float32")
+    out = nd.cast(nd.array(x), dtype="uint8")
+    assert_np(out, onp.array([0, 0, 0, 255], "uint8"))
+
+
+def test_integer_division_truncates_and_keeps_dtype():
+    a = onp.array([7, -7, 8], "int32")
+    b = onp.array([2, 2, -3], "int32")
+    out = nd.array(a, dtype="int32") / nd.array(b, dtype="int32")
+    # the reference's int div is C-style round-toward-zero and stays
+    # integer (mshadow op::div); jnp.divide would promote to float
+    assert str(out.dtype) == "int32"
+    assert_np(out, onp.array([3, -3, -2], "int32"))
+
+
+def test_integer_division_broadcasts_and_promotes():
+    a = rs.randint(-20, 20, (2, 3)).astype("int32")
+    b = onp.array([2, 3, -4], "int8")  # rank- and dtype-mismatched
+    out = nd.broadcast_div(nd.array(a, dtype="int32"),
+                           nd.array(b, dtype="int8"))
+    assert str(out.dtype) == "int32"
+    expect = (onp.sign(a) * (onp.abs(a) // onp.abs(b))
+              * onp.sign(b)).astype("int32")  # trunc toward zero
+    assert_np(out, expect)
+
+
+def test_rdiv_scalar_keeps_int_dtype():
+    d = nd.array(onp.array([2, 3, -4], "int32"), dtype="int32")
+    out = nd._rdiv_scalar(d, scalar=12)
+    assert str(out.dtype) == "int32"
+    assert_np(out, onp.array([6, 4, -3], "int32"))
+    fl = nd._rdiv_scalar(nd.array([2.0, 4.0]), scalar=1.0)
+    assert_np(fl, onp.array([0.5, 0.25], "float32"))
+
+
+def test_float16_arithmetic_stays_f16():
+    a = nd.array(A(2, 2), dtype="float16")
+    out = a + a
+    assert str(out.dtype) == "float16"
+
+
+def test_clip_boundaries():
+    x = onp.array([-5.0, -1.0, 0.0, 1.0, 5.0], "float32")
+    assert_np(nd.clip(nd.array(x), -1.0, 1.0), onp.clip(x, -1, 1))
+
+
+# ---------------------------------------------------------------------------
+# sorting / topk edge cases
+# ---------------------------------------------------------------------------
+
+def test_topk_smallest_and_values():
+    x = A(3, 6)
+    out = nd.topk(nd.array(x), k=2, axis=1, ret_typ="value",
+                  is_ascend=True)
+    expect = onp.sort(x, axis=1)[:, :2]
+    assert_np(out, expect)
+
+
+def test_sort_descending_negative_axis():
+    x = A(4, 3)
+    out = nd.sort(nd.array(x), axis=-1, is_ascend=False)
+    assert_np(out, -onp.sort(-x, axis=-1))
+
+
+def test_argsort_stability_shape():
+    x = A(2, 5)
+    out = nd.argsort(nd.array(x), axis=1)
+    assert_np(out, onp.argsort(x, axis=1, kind="stable")
+              .astype("float32"))
+
+
+# ---------------------------------------------------------------------------
+# matmul family shapes
+# ---------------------------------------------------------------------------
+
+def test_dot_transpose_flags():
+    a, b = A(3, 4), A(3, 5)
+    out = nd.dot(nd.array(a), nd.array(b), transpose_a=True)
+    assert_np(out, a.T @ b, rtol=1e-4)
+    c = A(5, 4)
+    out2 = nd.dot(nd.array(a), nd.array(c), transpose_b=True)
+    assert_np(out2, a @ c.T, rtol=1e-4)
+
+
+def test_batch_dot_transpose():
+    a, b = A(2, 3, 4), A(2, 5, 4)
+    out = nd.batch_dot(nd.array(a), nd.array(b), transpose_b=True)
+    assert_np(out, onp.einsum("bij,bkj->bik", a, b), rtol=1e-4)
+
+
+def test_dot_1d_cases():
+    a, b = A(4), A(4)
+    assert_np(nd.dot(nd.array(a), nd.array(b)), onp.dot(a, b),
+              rtol=1e-4)
