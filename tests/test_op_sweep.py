@@ -371,6 +371,14 @@ GRAD_OPS = [
     ("batch_dot", 2), ("broadcast_add", 2), ("broadcast_sub", 2),
     ("broadcast_mul", 2), ("broadcast_div", 2), ("broadcast_minimum", 2),
     ("transpose", 1), ("Flatten", 1), ("negative", 1),
+    # continuation widening: domain-restricted unaries, parameterized
+    # layers (weights get gradients too), and shape/concat ops
+    ("tan", 1), ("arcsin", 1), ("arccos", 1), ("arccosh", 1),
+    ("erfinv", 1), ("FullyConnected", 3), ("Convolution", 3),
+    ("LayerNorm", 3), ("InstanceNorm", 3), ("Pooling", 1),
+    ("Activation", 1), ("LeakyReLU", 1), ("concat", 2),
+    ("reshape", 1), ("slice", 1), ("clip", 1), ("SwapAxis", 1),
+    ("Pad", 1), ("UpSampling", 1), ("SoftmaxActivation", 1),
 ]
 
 
@@ -378,6 +386,41 @@ GRAD_OPS = [
 _GRAD_SHAPES = {
     "dot": [(3, 4), (4, 3)],
     "batch_dot": [(2, 3, 4), (2, 4, 3)],
+    "FullyConnected": [(2, 5), (4, 5), (4,)],
+    "Convolution": [(1, 2, 5, 5), (3, 2, 3, 3), (3,)],
+    "LayerNorm": [(3, 4), (3,), (3,)],  # gamma/beta sized to axis=0
+    "InstanceNorm": [(2, 3, 4), (3,), (3,)],
+    "Pooling": [(1, 2, 6, 6)],
+    "UpSampling": [(1, 2, 3, 3)],
+    "Pad": [(1, 2, 4, 4)],
+    "SwapAxis": [(2, 3, 4)],
+}
+
+# extra op params threaded through both the tape pass and the
+# finite-difference re-evaluations (functools.partial over nd.<op>)
+_GRAD_KWARGS = {
+    "FullyConnected": {"num_hidden": 4},
+    "Convolution": {"kernel": (3, 3), "num_filter": 3},
+    "LayerNorm": {"axis": 0},  # non-default axis
+    "Pooling": {"kernel": (2, 2), "pool_type": "avg", "stride": (2, 2)},
+    "Activation": {"act_type": "softrelu"},
+    "LeakyReLU": {"act_type": "leaky", "slope": 0.1},
+    "concat": {"dim": 1},
+    "reshape": {"shape": (4, 3)},
+    "slice": {"begin": (0, 1), "end": (3, 4)},
+    # a_max INSIDE the input range so the zero-grad masking branch is
+    # actually exercised (saturated elements: analytic 0 vs numeric ~0)
+    "clip": {"a_min": 0.05, "a_max": 0.6},
+    "SwapAxis": {"dim1": 0, "dim2": 2},
+    "UpSampling": {"scale": 2, "sample_type": "nearest"},
+    "Pad": {"mode": "edge", "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)},
+}
+
+# uniform(0.2, 0.8) unless the op's domain needs shifting
+_GRAD_RANGES = {
+    "arccosh": (1.2, 1.8),
+    # must straddle 0 or the slope branch is never executed
+    "LeakyReLU": (-0.8, 0.8),
 }
 
 
@@ -406,13 +449,17 @@ def _numeric_grad(fn, xs, k, eps, project=None):
 def test_numeric_gradient(name, n_in):
     """Tape backward vs central finite differences (ref:
     check_numeric_gradient, python/mxnet/test_utils.py)."""
+    import functools
     eps = 1e-3
     shapes = _GRAD_SHAPES.get(name, [(3, 4)] * n_in)
-    xs = [nd.array(rs.uniform(0.2, 0.8, s).astype("float32"))
+    lo, hi = _GRAD_RANGES.get(name, (0.2, 0.8))
+    xs = [nd.array(rs.uniform(lo, hi, s).astype("float32"))
           for s in shapes]
     for x in xs:
         x.attach_grad()
     fn = getattr(nd, name)
+    if name in _GRAD_KWARGS:
+        fn = functools.partial(fn, **_GRAD_KWARGS[name])
     with autograd.record():
         y = fn(*xs)
         loss = nd.sum(y * y)
