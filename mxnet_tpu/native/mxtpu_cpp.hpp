@@ -166,6 +166,47 @@ class NDArray {
     return out;
   }
 
+  /* Views & metadata over the expanded ABI (ref:
+   * cpp-package/include/mxnet-cpp/ndarray.h Slice/At/Reshape/
+   * GetContext/WaitToRead). */
+  NDArray Slice(uint32_t begin, uint32_t end) const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArraySlice(h_.get(), begin, end, &h));
+    return FromHandle(h);
+  }
+
+  NDArray At(uint32_t idx) const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayAt(h_.get(), idx, &h));
+    return FromHandle(h);
+  }
+
+  NDArray Reshape(const std::vector<int>& dims) const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayReshape(h_.get(), static_cast<int>(dims.size()),
+                           dims.data(), &h));
+    return FromHandle(h);
+  }
+
+  Context GetContext() const {
+    int dev_type = 0, dev_id = 0;
+    Check(MXNDArrayGetContext(h_.get(), &dev_type, &dev_id));
+    return Context{dev_type, dev_id};
+  }
+
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(h_.get())); }
+
+  static void WaitAll() { Check(MXNDArrayWaitAll()); }
+
+  /* Gradient buffer after autograd::Backward; !defined() if none. */
+  NDArray Grad() const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayGetGrad(h_.get(), &h));
+    NDArray g;
+    if (h) g.reset(h);
+    return g;
+  }
+
  private:
   void reset(NDArrayHandle h) {
     h_ = std::shared_ptr<void>(h, [](void* p) {
@@ -177,6 +218,32 @@ class NDArray {
 
 /* ------------------------------------------------------------------ */
 
+/* Stringified key/value params + c_str marshalling, shared by every
+ * SetParam-style builder (Operator, DataIter). */
+class ParamPack {
+ public:
+  template <typename T>
+  void Set(const std::string& key, const T& value) {
+    std::ostringstream os;
+    os << value;
+    keys_.push_back(key);
+    vals_.push_back(os.str());
+  }
+
+  std::vector<const char*> KeyPtrs() const { return ptrs(keys_); }
+  std::vector<const char*> ValPtrs() const { return ptrs(vals_); }
+  uint32_t Size() const { return static_cast<uint32_t>(keys_.size()); }
+
+ private:
+  static std::vector<const char*> ptrs(const std::vector<std::string>& v) {
+    std::vector<const char*> out;
+    out.reserve(v.size());
+    for (const auto& s : v) out.push_back(s.c_str());
+    return out;
+  }
+  std::vector<std::string> keys_, vals_;
+};
+
 /* Chainable imperative op invocation
  * (ref: cpp-package/include/mxnet-cpp/operator.h Operator::SetParam/
  * PushInput/Invoke over MXImperativeInvokeEx). */
@@ -186,10 +253,7 @@ class Operator {
 
   template <typename T>
   Operator& SetParam(const std::string& key, const T& value) {
-    std::ostringstream os;
-    os << value;
-    keys_.push_back(key);
-    vals_.push_back(os.str());
+    params_.Set(key, value);
     return *this;
   }
 
@@ -203,9 +267,8 @@ class Operator {
   std::vector<NDArray> Invoke() {
     std::vector<NDArrayHandle> in;
     for (const auto& a : inputs_) in.push_back(a.handle());
-    std::vector<const char*> ks, vs;
-    for (const auto& s : keys_) ks.push_back(s.c_str());
-    for (const auto& s : vals_) vs.push_back(s.c_str());
+    auto ks = params_.KeyPtrs();
+    auto vs = params_.ValPtrs();
     int n_out = 0;
     NDArrayHandle* outs = nullptr;
     Check(MXImperativeInvoke(name_.c_str(),
@@ -223,7 +286,7 @@ class Operator {
  private:
   std::string name_;
   std::vector<NDArray> inputs_;
-  std::vector<std::string> keys_, vals_;
+  ParamPack params_;
 };
 
 inline NDArray InvokeOne(Operator& op) { return op.Invoke().at(0); }
@@ -398,6 +461,177 @@ class Predictor {
   }
 
  private:
+  std::shared_ptr<void> h_;
+};
+
+/* ------------------------------------------------------------------ */
+
+/* Autograd over the expanded ABI (ref: cpp-package has no autograd;
+ * this mirrors python/mxnet/autograd.py record()/mark_variables()/
+ * backward() so C++ consumers can train imperatively). */
+namespace autograd {
+
+/* RAII recording scope: `{ autograd::RecordScope rec; ... }` */
+class RecordScope {
+ public:
+  explicit RecordScope(bool train_mode = true) {
+    Check(MXAutogradSetIsRecording(1, &prev_rec_));
+    try {
+      Check(MXAutogradSetIsTraining(train_mode ? 1 : 0, &prev_train_));
+    } catch (...) {
+      // half-constructed scope: the destructor won't run, so restore
+      // the recording flag here or it stays enabled process-wide
+      int ignore = 0;
+      MXAutogradSetIsRecording(prev_rec_, &ignore);
+      throw;
+    }
+  }
+  ~RecordScope() {
+    int ignore = 0;
+    MXAutogradSetIsRecording(prev_rec_, &ignore);
+    MXAutogradSetIsTraining(prev_train_, &ignore);
+  }
+  RecordScope(const RecordScope&) = delete;
+  RecordScope& operator=(const RecordScope&) = delete;
+
+ private:
+  int prev_rec_ = 0;
+  int prev_train_ = 0;
+};
+
+/* grad_req: 1 = write, 2 = add (0 = null needs no grad buffer). */
+inline void MarkVariable(const NDArray& var, const NDArray& grad,
+                         uint32_t grad_req = 1) {
+  NDArrayHandle vh = var.handle(), gh = grad.handle();
+  Check(MXAutogradMarkVariables(1, &vh, &grad_req, &gh));
+}
+
+inline void Backward(const std::vector<NDArray>& outputs,
+                     bool retain_graph = false, bool train_mode = true) {
+  std::vector<NDArrayHandle> hs;
+  for (const auto& o : outputs) hs.push_back(o.handle());
+  Check(MXAutogradBackward(static_cast<uint32_t>(hs.size()), hs.data(),
+                           nullptr, retain_graph ? 1 : 0,
+                           train_mode ? 1 : 0));
+}
+
+}  // namespace autograd
+
+/* ------------------------------------------------------------------ */
+
+/* Distributed key-value store (ref: cpp-package/include/mxnet-cpp/
+ * kvstore.h over MXKVStore*; types "local"/"device"/"dist_sync"/
+ * "dist_async"). */
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    KVStoreHandle h = nullptr;
+    Check(MXKVStoreCreate(type.c_str(), &h));
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXKVStoreFree(p);
+    });
+  }
+
+  void Init(const std::string& key, const NDArray& val) {
+    const char* k = key.c_str();
+    NDArrayHandle v = val.handle();
+    Check(MXKVStoreInit(h_.get(), 1, &k, &v));
+  }
+
+  void Push(const std::string& key, const NDArray& val, int priority = 0) {
+    const char* k = key.c_str();
+    NDArrayHandle v = val.handle();
+    Check(MXKVStorePush(h_.get(), 1, &k, &v, priority));
+  }
+
+  void Pull(const std::string& key, NDArray* out, int priority = 0) {
+    const char* k = key.c_str();
+    NDArrayHandle v = out->handle();
+    Check(MXKVStorePull(h_.get(), 1, &k, &v, priority));
+  }
+
+  int GetRank() const {
+    int rank = 0;
+    Check(MXKVStoreGetRank(h_.get(), &rank));
+    return rank;
+  }
+
+  int GetNumWorkers() const {
+    int n = 0;
+    Check(MXKVStoreGetGroupSize(h_.get(), &n));
+    return n;
+  }
+
+  std::string GetType() const {
+    const char* t = nullptr;
+    Check(MXKVStoreGetType(h_.get(), &t));
+    return t ? t : "";
+  }
+
+  void Barrier() { Check(MXKVStoreBarrier(h_.get())); }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+/* ------------------------------------------------------------------ */
+
+/* File-based data iterator (ref: cpp-package/include/mxnet-cpp/io.h
+ * MXDataIter::SetParam/CreateDataIter over MXDataIter*). */
+class DataIter {
+ public:
+  explicit DataIter(const std::string& name) : name_(name) {}
+
+  template <typename T>
+  DataIter& SetParam(const std::string& key, const T& value) {
+    params_.Set(key, value);
+    return *this;
+  }
+
+  /* Materialize the iterator; params are fixed from here on. */
+  void Create() {
+    auto ks = params_.KeyPtrs();
+    auto vs = params_.ValPtrs();
+    DataIterHandle h = nullptr;
+    Check(MXDataIterCreateIter(name_.c_str(), params_.Size(),
+                               ks.data(), vs.data(), &h));
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXDataIterFree(p);
+    });
+  }
+
+  bool Next() {
+    int more = 0;
+    Check(MXDataIterNext(h_.get(), &more));
+    return more != 0;
+  }
+
+  void Reset() { Check(MXDataIterBeforeFirst(h_.get())); }
+
+  NDArray GetData() {
+    NDArrayHandle h = nullptr;
+    Check(MXDataIterGetData(h_.get(), &h));
+    return NDArray::FromHandle(h);
+  }
+
+  NDArray GetLabel() {
+    NDArrayHandle h = nullptr;
+    Check(MXDataIterGetLabel(h_.get(), &h));
+    return NDArray::FromHandle(h);
+  }
+
+  static std::vector<std::string> List() {
+    uint32_t n = 0;
+    const char** names = nullptr;
+    Check(MXListDataIters(&n, &names));
+    std::vector<std::string> out;
+    for (uint32_t i = 0; i < n; ++i) out.emplace_back(names[i]);
+    return out;
+  }
+
+ private:
+  std::string name_;
+  ParamPack params_;
   std::shared_ptr<void> h_;
 };
 

@@ -112,7 +112,69 @@ int main(int argc, char** argv) {
     }
     std::printf("throw_ok=%d\n", throw_ok ? 1 : 0);
 
-    if (math_ok && saveload_ok && grad_ok && pred_ok && throw_ok) {
+    // --- NDArray views over the expanded ABI -------------------------
+    mxtpu::NDArray big(std::vector<float>{0, 1, 2, 3, 4, 5}, {3, 2});
+    bool view_ok = big.Slice(1, 3).Shape() == std::vector<uint32_t>{2, 2}
+        && big.At(2).CopyToHost().at(1) == 5.0f
+        && big.Reshape({2, 3}).Shape() == std::vector<uint32_t>{2, 3}
+        && big.GetContext().dev_type >= 1;
+    big.WaitToRead();
+    mxtpu::NDArray::WaitAll();
+    std::printf("view_ok=%d\n", view_ok ? 1 : 0);
+
+    // --- imperative autograd: d(sum(x*x))/dx == 2x -------------------
+    mxtpu::NDArray xg(std::vector<float>{1, 2, 3}, {3});
+    mxtpu::NDArray gbuf(std::vector<uint32_t>{3});
+    mxtpu::autograd::MarkVariable(xg, gbuf);
+    mxtpu::NDArray y2;
+    {
+      mxtpu::autograd::RecordScope rec;
+      y2 = mxtpu::Operator("elemwise_mul")
+               .PushInput(xg).PushInput(xg).Invoke().at(0);
+    }
+    mxtpu::autograd::Backward({y2});
+    auto gv = xg.Grad().CopyToHost();
+    bool ag_ok = gv.size() == 3 && gv[0] == 2.0f && gv[1] == 4.0f &&
+                 gv[2] == 6.0f;
+    std::printf("ag_ok=%d\n", ag_ok ? 1 : 0);
+
+    // --- kvstore push/pull accumulate --------------------------------
+    mxtpu::KVStore kv("local");
+    mxtpu::NDArray w(std::vector<float>{1, 1}, {2});
+    mxtpu::NDArray g2(std::vector<float>{0.25f, 0.25f}, {2});
+    mxtpu::NDArray out2(std::vector<uint32_t>{2});
+    kv.Init("w", w);
+    kv.Push("w", g2);
+    kv.Pull("w", &out2);
+    auto wv = out2.CopyToHost();
+    bool kv_ok = kv.GetRank() == 0 && kv.GetNumWorkers() == 1 &&
+                 kv.GetType() == "local" && wv[0] == 1.25f;
+    kv.Barrier();
+    std::printf("kv_ok=%d\n", kv_ok ? 1 : 0);
+
+    // --- data iterator over a generated CSV --------------------------
+    {
+      std::ofstream csv("cpp_api_iter.csv");
+      for (int i = 0; i < 4; ++i) csv << i << "," << i + 10 << "\n";
+    }
+    mxtpu::DataIter it("CSVIter");
+    it.SetParam("data_csv", "cpp_api_iter.csv")
+        .SetParam("data_shape", "(2,)")
+        .SetParam("batch_size", 2);
+    it.Create();
+    int batches = 0;
+    while (it.Next()) {
+      if (it.GetData().Shape() != std::vector<uint32_t>{2, 2}) break;
+      ++batches;
+    }
+    it.Reset();
+    bool iter_ok = batches == 2 && it.Next() &&
+                   !mxtpu::DataIter::List().empty();
+    std::remove("cpp_api_iter.csv");
+    std::printf("iter_ok=%d\n", iter_ok ? 1 : 0);
+
+    if (math_ok && saveload_ok && grad_ok && pred_ok && throw_ok &&
+        view_ok && ag_ok && kv_ok && iter_ok) {
       std::printf("CPP_API_OK\n");
       return 0;
     }

@@ -135,7 +135,8 @@ def test_cpp_bindings_end_to_end(tmp_path):
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"C++ bindings test failed:\n{out[-3000:]}"
     for flag in ("math_ok=1", "saveload_ok=1", "grad_ok=1", "pred_ok=1",
-                 "throw_ok=1", "CPP_API_OK"):
+                 "throw_ok=1", "view_ok=1", "ag_ok=1", "kv_ok=1",
+                 "iter_ok=1", "CPP_API_OK"):
         assert flag in out, f"missing {flag}:\n{out[-3000:]}"
     # executor output must match the python-side executor on same weights
     x = (onp.arange(6, dtype="float32") / 6.0).reshape(1, 6)

@@ -423,6 +423,24 @@ _GRAD_RANGES = {
     "LeakyReLU": (-0.8, 0.8),
 }
 
+# non-differentiable kink locations: sampled elements within 10*eps of
+# a kink are nudged away, or the central difference straddles the kink
+# and the numeric gradient is ~half the analytic one (flaky under any
+# reordering of the shared RandomState)
+_GRAD_KINKS = {
+    "clip": (0.05, 0.6),
+    "LeakyReLU": (0.0,),
+    "abs": (0.0,),
+}
+
+
+def _nudge_off_kinks(arr, kinks, margin):
+    for k in kinks:
+        close = onp.abs(arr - k) < margin
+        arr = onp.where(close, k + margin * onp.where(arr >= k, 1, -1),
+                        arr)
+    return arr
+
 
 def _numeric_grad(fn, xs, k, eps, project=None):
     """Central finite differences of sum(fn(xs)^2) w.r.t. input k.
@@ -453,7 +471,9 @@ def test_numeric_gradient(name, n_in):
     eps = 1e-3
     shapes = _GRAD_SHAPES.get(name, [(3, 4)] * n_in)
     lo, hi = _GRAD_RANGES.get(name, (0.2, 0.8))
-    xs = [nd.array(rs.uniform(lo, hi, s).astype("float32"))
+    kinks = _GRAD_KINKS.get(name, ())
+    xs = [nd.array(_nudge_off_kinks(rs.uniform(lo, hi, s), kinks,
+                                    20 * eps).astype("float32"))
           for s in shapes]
     for x in xs:
         x.attach_grad()
