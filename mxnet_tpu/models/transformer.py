@@ -51,6 +51,7 @@ class MultiHeadAttention(HybridBlock):
         self._cp_mesh = None
         self._cp_axis = "seq"
         self._cp_strategy = "ring"
+        self._cp_block_size = None
         self._causal = False
         with self.name_scope():
             self.qkv = nn.Dense(3 * units, flatten=False, use_bias=use_bias,
@@ -59,10 +60,12 @@ class MultiHeadAttention(HybridBlock):
                                  prefix="proj_")
             self.drop = nn.Dropout(dropout)
 
-    def set_context_parallel(self, mesh, seq_axis="seq", strategy="ring"):
+    def set_context_parallel(self, mesh, seq_axis="seq", strategy="ring",
+                             block_size=None):
         self._cp_mesh = mesh
         self._cp_axis = seq_axis
         self._cp_strategy = strategy
+        self._cp_block_size = block_size
         self._cached = {}
 
     def hybrid_forward(self, F, x):
@@ -79,7 +82,8 @@ class MultiHeadAttention(HybridBlock):
             from ..parallel.ring_attention import context_parallel_attention
             fn = partial(context_parallel_attention, mesh=mesh,
                          seq_axis=self._cp_axis, causal=causal,
-                         strategy=self._cp_strategy)
+                         strategy=self._cp_strategy,
+                         block_size=getattr(self, "_cp_block_size", None))
         elif _on_tpu() and T % 128 == 0 and self._head_dim in (64, 128, 256):
             # two valid backends on TPU: the Pallas flash kernel (O(T)
             # memory) and XLA dense attention. Which is faster depends
@@ -167,9 +171,11 @@ class TransformerLM(HybridBlock):
         for layer in self.layers:
             layer.attn._causal = causal
 
-    def set_context_parallel(self, mesh, seq_axis="seq", strategy="ring"):
+    def set_context_parallel(self, mesh, seq_axis="seq", strategy="ring",
+                             block_size=None):
         for layer in self.layers:
-            layer.attn.set_context_parallel(mesh, seq_axis, strategy)
+            layer.attn.set_context_parallel(mesh, seq_axis, strategy,
+                                            block_size)
 
     def hybrid_forward(self, F, tokens):
         # tokens: (B, T) int

@@ -44,13 +44,47 @@ def local_attention(q, k, v, scale=None, causal=False, q_offset=0,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def _online_update(o, l, m, q, k_c, v_c, scale_v, qpos, kpos):
+    """One online-softmax accumulator update against a K/V chunk.
+    Positions may be None (no causal mask). Shared by the ring step and
+    the inner chunk loop so both levels use identical math."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_c) * scale_v
+    logits = logits.astype(jnp.float32)
+    if qpos is not None:
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    blk_max = jnp.max(logits, axis=-1)
+    new_m = jnp.maximum(m, blk_max)
+    # guard fully-masked blocks (max = -inf)
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    p = jnp.exp(logits - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+    new_l = l * corr + jnp.sum(p, axis=-1)
+    new_o = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32))
+    return new_o, new_l, new_m
+
+
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
-                          scale: Optional[float]):
-    """Executed per-device under shard_map. q/k/v: (B,H,T_loc,D)."""
+                          scale: Optional[float],
+                          block_size: Optional[int] = None):
+    """Executed per-device under shard_map. q/k/v: (B,H,T_loc,D).
+
+    block_size chunks each ring step's K/V along the sequence axis so
+    the logits buffer is (T_loc, block_size) instead of (T_loc, T_loc)
+    — blockwise attention inside ring attention, the long-context
+    memory shape the reference has no analog for (SURVEY §5.7 mandate).
+    None = one chunk (logits T_loc x T_loc)."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, H, T, D = q.shape
     scale_v = scale if scale is not None else 1.0 / jnp.sqrt(D)
+    C = block_size if block_size and block_size < T else T
+    if C <= 0 or T % C:
+        raise ValueError(f"block_size {C} must be positive and divide "
+                         f"the local sequence length {T}")
 
     # online-softmax accumulators
     o = jnp.zeros((B, H, T, D), jnp.float32)
@@ -60,29 +94,22 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     def body(i, carry):
         o, l, m, k_blk, v_blk = carry
         src_idx = (my_idx - i) % axis_size  # whose K/V block we hold now
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale_v
-        logits = logits.astype(jnp.float32)
-        if causal:
-            qpos = my_idx * T + jnp.arange(T)
-            kpos = src_idx * T + jnp.arange(T)
-            mask = qpos[:, None] >= kpos[None, :]
-            logits = jnp.where(mask[None, None], logits, -jnp.inf)
-        blk_max = jnp.max(logits, axis=-1)
-        new_m = jnp.maximum(m, blk_max)
-        # guard fully-masked blocks (max = -inf)
-        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
-        p = jnp.exp(logits - safe_m[..., None])
-        p = jnp.where(jnp.isfinite(logits), p, 0.0)
-        corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
-        corr = jnp.where(jnp.isfinite(m), corr, 0.0)
-        new_l = l * corr + jnp.sum(p, axis=-1)
-        new_o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        qpos = my_idx * T + jnp.arange(T) if causal else None
+
+        def chunk(j, inner):
+            o, l, m = inner
+            k_c = jax.lax.dynamic_slice_in_dim(k_blk, j * C, C, axis=2)
+            v_c = jax.lax.dynamic_slice_in_dim(v_blk, j * C, C, axis=2)
+            kpos = src_idx * T + j * C + jnp.arange(C) if causal else None
+            return _online_update(o, l, m, q, k_c, v_c, scale_v,
+                                  qpos, kpos)
+
+        o, l, m = jax.lax.fori_loop(0, T // C, chunk, (o, l, m))
         # rotate K/V to the next device (nearest-neighbour ICI hop)
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (new_o, new_l, new_m, k_next, v_next)
+        return (o, l, m, k_next, v_next)
 
     o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, l, m, k, v))
     out = o / jnp.maximum(l, 1e-20)[..., None]
@@ -90,11 +117,15 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
 
 
 def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   block_size: Optional[int] = None):
     """q/k/v: (B, H, T_global, D) logically; sharded over `seq_axis` on the
-    T dimension. Returns attention output with the same sharding."""
+    T dimension. Returns attention output with the same sharding.
+    block_size chunks K/V within each ring step (blockwise-in-ring) so
+    per-device logits memory is O(T_loc * block_size)."""
     fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
-                           causal=causal, scale=scale)
+                           causal=causal, scale=scale,
+                           block_size=block_size)
     spec = P(None, None, seq_axis, None)
     mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, check_vma=False)
@@ -133,11 +164,14 @@ def ulysses_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
 def context_parallel_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
                                causal: bool = False,
                                scale: Optional[float] = None,
-                               strategy: str = "ring"):
+                               strategy: str = "ring",
+                               block_size: Optional[int] = None):
     """One entry point behind a `context_parallel` mesh axis
-    (SURVEY.md §5.7 plan)."""
+    (SURVEY.md §5.7 plan). block_size applies to the ring strategy:
+    blockwise attention inside each ring step."""
     if strategy == "ring":
-        return ring_attention(q, k, v, mesh, seq_axis, causal, scale)
+        return ring_attention(q, k, v, mesh, seq_axis, causal, scale,
+                              block_size)
     if strategy in ("ulysses", "all_to_all"):
         return ulysses_attention(q, k, v, mesh, seq_axis, causal, scale)
     raise ValueError(f"unknown context-parallel strategy {strategy}")

@@ -423,7 +423,7 @@ _GRAD_RANGES = {
     "LeakyReLU": (-0.8, 0.8),
 }
 
-# non-differentiable kink locations: sampled elements within 10*eps of
+# non-differentiable kink locations: sampled elements within 20*eps of
 # a kink are nudged away, or the central difference straddles the kink
 # and the numeric gradient is ~half the analytic one (flaky under any
 # reordering of the shared RandomState)

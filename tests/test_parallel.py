@@ -96,6 +96,59 @@ def test_ring_attention_matches_local(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block_size", [1, 2, 4])
+def test_ring_attention_blockwise_matches_dense(causal, block_size):
+    """blockwise-in-ring (logits chunked to T_loc x block_size inside
+    each ring step) must be numerically identical to the one-chunk
+    path and to dense attention."""
+    mesh = make_mesh({"seq": 8})
+    B, H, T, D = 1, 2, 32, 8  # T_loc = 4 per device
+    rng = onp.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    ref = local_attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, seq_axis="seq", causal=causal,
+                         block_size=block_size)
+    assert_almost_equal(onp.asarray(out), onp.asarray(ref), rtol=2e-4,
+                        atol=2e-5)
+
+
+def test_ring_attention_blockwise_grads_match():
+    """The chunked path must be differentiable and agree with dense
+    gradients (it feeds the context-parallel training step)."""
+    mesh = make_mesh({"seq": 8})
+    B, H, T, D = 1, 2, 16, 8
+    rng = onp.random.RandomState(4)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+
+    def loss_ring(q, k, v):
+        out = ring_attention(q, k, v, mesh, seq_axis="seq", causal=True,
+                             block_size=2)
+        return (out * out).sum()
+
+    def loss_dense(q, k, v):
+        out = local_attention(q, k, v, causal=True)
+        return (out * out).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        assert_almost_equal(onp.asarray(gr), onp.asarray(gd), rtol=5e-4,
+                            atol=5e-5)
+
+
+def test_ring_attention_block_size_must_divide():
+    mesh = make_mesh({"seq": 8})
+    x = jnp.ones((1, 2, 32, 8), jnp.float32)  # T_loc = 4
+    with pytest.raises(Exception):
+        onp.asarray(ring_attention(x, x, x, mesh, seq_axis="seq",
+                                   block_size=3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_attention_matches_local(causal):
     mesh = make_mesh({"seq": 8})
     B, H, T, D = 2, 8, 32, 16  # H divisible by mesh size
@@ -163,3 +216,43 @@ def test_zero_sharding():
     l1 = trainer.step(x, y).asscalar()
     l2 = trainer.step(x, y).asscalar()
     assert l2 < l1
+
+
+def test_transformer_trains_with_blockwise_ring():
+    """End to end: a TransformerLM with blockwise-in-ring context
+    parallelism takes a finite training step on the 8-device mesh."""
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.models import TransformerLM
+    from mxnet_tpu.parallel import ParallelTrainer
+
+    mesh = make_mesh({"data": 1, "seq": 8})
+    B, T, V = 2, 32, 64
+    net = TransformerLM(vocab_size=V, units=16, num_layers=1, num_heads=2,
+                        hidden_size=32, max_len=T, causal=True)
+    net.initialize()
+    net.set_context_parallel(mesh, seq_axis="seq", strategy="ring",
+                             block_size=2)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    class LMLoss(gluon.HybridBlock):
+        def hybrid_forward(self, F, logits, labels):
+            return loss_fn(logits.reshape((-1, V)),
+                           labels.reshape((-1,)))
+
+    trainer = ParallelTrainer(net, LMLoss(), optimizer="sgd",
+                              optimizer_params={"learning_rate": 0.1},
+                              mesh=mesh)
+    rng = onp.random.RandomState(0)
+    tokens = nd.array(rng.randint(0, V, (B, T)), dtype="int32")
+    labels = nd.array(rng.randint(0, V, (B, T)).astype("float32"))
+    l1 = float(trainer.step(tokens, labels).asscalar())
+    l2 = float(trainer.step(tokens, labels).asscalar())
+    assert onp.isfinite(l1) and onp.isfinite(l2)
+
+
+def test_ring_attention_negative_block_size_rejected():
+    mesh = make_mesh({"seq": 8})
+    x = jnp.ones((1, 2, 32, 8), jnp.float32)  # T_loc = 4
+    with pytest.raises(Exception):
+        onp.asarray(ring_attention(x, x, x, mesh, seq_axis="seq",
+                                   block_size=-2))
