@@ -740,30 +740,45 @@ def spatial_transformer(data, loc, target_shape=(0, 0),
 
 @register_op("ROIPooling")
 def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """ref: src/operator/roi_pooling.cc ROIPoolForward — roi corners are
+    rounded but NOT clipped; each pooling bin is sized from the full
+    roi extent, then clipped to the feature map, and an empty bin (or
+    an invalid batch index) outputs 0."""
     ph, pw = _pair(pooled_size)
     n, c, h, w = data.shape
 
     def one_roi(roi):
         b = roi[0].astype(jnp.int32)
+        valid_b = (b >= 0) & (b < n)
         x0 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
         y0 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
         x1 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
         y1 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
-        rh = jnp.maximum(y1 - y0 + 1, 1)
-        rw = jnp.maximum(x1 - x0 + 1, 1)
-        img = data[b]
+        # force malformed ROIs to be 1x1, as the reference does
+        rh = jnp.maximum(y1 - y0 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x1 - x0 + 1, 1).astype(jnp.float32)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[jnp.clip(b, 0, n - 1)]
         ys = jnp.arange(h)
         xs = jnp.arange(w)
 
         def cell(iy, ix):
-            cy0 = y0 + (iy * rh) // ph
-            cy1 = y0 + jnp.maximum(((iy + 1) * rh + ph - 1) // ph, 1) + 0
-            cx0 = x0 + (ix * rw) // pw
-            cx1 = x0 + jnp.maximum(((ix + 1) * rw + pw - 1) // pw, 1)
-            my = (ys >= cy0) & (ys < jnp.maximum(cy1, cy0 + 1))
-            mx = (xs >= cx0) & (xs < jnp.maximum(cx1, cx0 + 1))
+            hstart = jnp.clip(jnp.floor(iy * bin_h).astype(jnp.int32)
+                              + y0, 0, h)
+            hend = jnp.clip(jnp.ceil((iy + 1) * bin_h).astype(jnp.int32)
+                            + y0, 0, h)
+            wstart = jnp.clip(jnp.floor(ix * bin_w).astype(jnp.int32)
+                              + x0, 0, w)
+            wend = jnp.clip(jnp.ceil((ix + 1) * bin_w).astype(jnp.int32)
+                            + x0, 0, w)
+            empty = (hend <= hstart) | (wend <= wstart) | ~valid_b
+            my = (ys >= hstart) & (ys < hend)
+            mx = (xs >= wstart) & (xs < wend)
             mask = my[:, None] & mx[None, :]
-            return jnp.max(jnp.where(mask[None], img, -jnp.inf), axis=(1, 2))
+            val = jnp.max(jnp.where(mask[None], img, -jnp.inf),
+                          axis=(1, 2))
+            return jnp.where(empty, 0.0, val).astype(data.dtype)
 
         cells = [[cell(iy, ix) for ix in range(pw)] for iy in range(ph)]
         return jnp.stack([jnp.stack(r, axis=-1) for r in cells], axis=-2)

@@ -7,6 +7,8 @@ import importlib.util
 import os
 import sys
 
+import numpy as onp
+
 import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -235,3 +237,21 @@ def test_ssd_map_difficult_gts_ignored():
 def test_amp_example_trains():
     acc = _load("amp/amp_train.py").main(["--steps", "150"])
     assert acc > 0.8
+
+
+def test_rcnn_rpn_demo_trains():
+    """Two-stage detection: RPN objectness + Proposal + ROIPooling +
+    region classifier (ref: example/rcnn). Also regression-guards the
+    ROIPooling clip fix (out-of-bounds rois used to pool -inf)."""
+    first, last = _load("rcnn/rpn_demo.py").main(["--steps", "80"])
+    assert onp.isfinite(last) and last < first * 0.8
+
+
+def test_vae_gan_example_trains():
+    first, last = _load("vae_gan/vae_gan.py").main(["--steps", "150"])
+    assert last < first * 0.85
+
+
+def test_captcha_cnn_ctc_trains():
+    first, last = _load("captcha/cnn_ctc.py").main(["--steps", "80"])
+    assert last < first * 0.7

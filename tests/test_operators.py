@@ -304,6 +304,32 @@ def test_roi_and_spatial():
     assert ra.shape == (2, 3, 2, 2)
 
 
+def test_roi_pooling_out_of_bounds_bins_are_zero():
+    """Reference semantics (src/operator/roi_pooling.cc): roi corners
+    stay unclipped; each BIN is clipped to the map and empty bins (or
+    an invalid batch index) emit 0 — an out-of-bounds cell used to pool
+    an empty mask into -inf (caught by the rcnn example, where Proposal
+    emits image-scale boxes)."""
+    d = onp.random.randn(1, 2, 8, 8).astype("float32")
+    data = nd.array(d)
+    rois = nd.array([[0, 5, 5, 12, 12],      # beyond both edges
+                     [0, -3, -3, 2, 2],      # negative corner
+                     [0, 20, 20, 30, 30],    # fully outside
+                     [7, 0, 0, 4, 4]])       # invalid batch index
+    out = nd.ROIPooling(data, rois, pooled_size=(3, 3),
+                        spatial_scale=1.0)
+    vals = out.asnumpy()
+    assert out.shape == (4, 2, 3, 3)
+    assert onp.isfinite(vals).all()
+    # fully-outside roi and invalid batch index: all-zero output
+    assert (vals[2] == 0).all() and (vals[3] == 0).all()
+    # negative-corner roi: the roi spans [-3, 2]^2, 6 wide, bins of 2;
+    # the first bin covers [-3, -1) -> fully outside -> 0, the last
+    # covers [1, 3) -> max over data[:, 1:3, 1:3]
+    assert (vals[1][:, 0, :] == 0).all() and (vals[1][:, :, 0] == 0).all()
+    assert onp.allclose(vals[1][:, 2, 2], d[0, :, 1:3, 1:3].max((1, 2)))
+
+
 def test_leaky_relu_variants():
     x = nd.array([[-2.0, 2.0]])
     leaky = nd.LeakyReLU(x, act_type="leaky", slope=0.1)
