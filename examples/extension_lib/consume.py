@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Load an operator-extension library at runtime and use its ops from
+nd/sym/autograd like built-ins (ref: example/lib_api/test.py —
+mx.library.load('libmyop.so') then mx.nd.my_gemm)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if "--tpu" not in sys.argv:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--tpu", action="store_true")
+    p.parse_args(argv)
+    here = os.path.dirname(os.path.abspath(__file__))
+    mx.library.load(os.path.join(here, "my_ops.py"))
+
+    a = nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    b = nd.array(onp.ones((3, 2), "float32"))
+    out = nd.my_gemm(a, b, alpha=2.0)
+    expect = 2.0 * (a.asnumpy() @ b.asnumpy())
+    assert onp.allclose(out.asnumpy(), expect)
+
+    # extension ops run under autograd like built-ins
+    a.attach_grad()
+    with autograd.record():
+        y = nd.my_gemm(a, b)
+    y.backward(nd.ones((2, 2)))
+    assert onp.allclose(a.grad.asnumpy(), onp.ones((2, 2)) @
+                        b.asnumpy().T)
+
+    sq = nd.array(onp.eye(2, dtype="float32") * 2)
+    rep = nd.my_state_gemm(sq, sq, count=3)
+    assert onp.allclose(rep.asnumpy(), onp.eye(2) * 16)
+
+    print("extension_ops_ok=1")
+    return True
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Operator-extension library: the reference's lib_api example
+(example/lib_api/mylib.cc gemm op loaded via mx.library.load) in the
+TPU-native extension unit — a python module whose register_op calls
+compile through XLA like any built-in op.
+"""
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.registry import register_op
+
+
+@register_op("my_gemm", input_names=("a", "b"))
+def my_gemm(a, b, alpha=1.0):
+    """alpha * (a @ b) — the lib_api tutorial op."""
+    return alpha * jnp.matmul(a, b)
+
+
+@register_op("my_state_gemm", input_names=("a", "b"))
+def my_state_gemm(a, b, count=1):
+    """The tutorial's 'stateful' variant: repeats the multiply `count`
+    times (a stand-in for stateful custom ops; state itself is carried
+    functionally on TPU)."""
+    out = a
+    for _ in range(int(count)):
+        out = jnp.matmul(out, b)
+    return out

@@ -750,10 +750,17 @@ def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
     def one_roi(roi):
         b = roi[0].astype(jnp.int32)
         valid_b = (b >= 0) & (b < n)
-        x0 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
-        y0 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
-        x1 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
-        y1 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        # C round() semantics (half away from zero), as std::round in
+        # the reference kernel — jnp.round is half-to-even and would
+        # shift bins by a cell at exact .5 products
+        def _cround(x):
+            return jnp.where(x >= 0, jnp.floor(x + 0.5),
+                             jnp.ceil(x - 0.5)).astype(jnp.int32)
+
+        x0 = _cround(roi[1] * spatial_scale)
+        y0 = _cround(roi[2] * spatial_scale)
+        x1 = _cround(roi[3] * spatial_scale)
+        y1 = _cround(roi[4] * spatial_scale)
         # force malformed ROIs to be 1x1, as the reference does
         rh = jnp.maximum(y1 - y0 + 1, 1).astype(jnp.float32)
         rw = jnp.maximum(x1 - x0 + 1, 1).astype(jnp.float32)

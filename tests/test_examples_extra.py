@@ -255,3 +255,15 @@ def test_vae_gan_example_trains():
 def test_captcha_cnn_ctc_trains():
     first, last = _load("captcha/cnn_ctc.py").main(["--steps", "80"])
     assert last < first * 0.7
+
+
+def test_extension_lib_example():
+    """Runtime operator-extension loading (ref: example/lib_api):
+    loaded ops behave like built-ins under nd and autograd."""
+    assert _load("extension_lib/consume.py").main([]) is True
+
+
+def test_speech_recognition_ctc_trains():
+    first, last = _load("speech_recognition/lstm_ctc.py").main(
+        ["--steps", "100"])
+    assert last < first * 0.3
