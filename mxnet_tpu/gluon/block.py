@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import re
 import threading
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -396,21 +397,44 @@ class HybridBlock(Block):
     def _call_cached(self, *args):
         """CachedOp analog (ref: cached_op.cc Forward :904)."""
         inputs = [a for a in args if isinstance(a, NDArray)]
+        training = autograd.is_training()
+        key = (tuple(tuple(i.shape) + (str(i.dtype),) for i in inputs),
+               training)
+        if self._cached.get(key, False) is None:
+            # known dynamic-shape signature: skip the parameter gather
+            # entirely and run eagerly
+            return super(HybridBlock, self).__call__(*args)
         try:
             plist = self._flat_params()
             pvals = {n: p.data()._data for n, p in plist}
         except DeferredInitializationError:
             # first call resolves deferred shapes eagerly (ref:
             # block.py:786 _build_cache's deferred-infer)
-            out = super(HybridBlock, self).__call__(*args)
-            plist = self._flat_params()
-            pvals = {n: p.data()._data for n, p in plist}
-            return out
-        training = autograd.is_training()
-        key = (tuple(tuple(i.shape) + (str(i.dtype),) for i in inputs),
-               training)
+            return super(HybridBlock, self).__call__(*args)
         if key not in self._cached:
-            self._cached[key] = self._build_jit(args, training)
+            try:
+                self._cached[key] = self._build_jit(args, training)
+            except (jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.TracerBoolConversionError,
+                    jax.errors.TracerIntegerConversionError) as e:
+                # dynamic-shape op in the graph (boolean_mask & co):
+                # XLA needs static shapes, so this graph runs eagerly —
+                # the analog of the reference's dynamic-shape executor
+                # path that re-infers shapes every call
+                # (graph_executor.cc:1421; test_dynamic_shape.py runs
+                # boolean_mask under hybridize the same way). The jax
+                # message is kept: data-dependent python control flow
+                # raises the same error and the user must see which
+                # line concretized a tracer.
+                self._cached[key] = None
+                warnings.warn(
+                    f"{type(self).__name__}: tracing failed; hybridize "
+                    "falls back to eager execution for this input "
+                    "signature. Cause: a dynamic-output-shape op "
+                    "(expected, e.g. boolean_mask) or data-dependent "
+                    f"python control flow (a bug). Trace error:\n{e}")
+                return super(HybridBlock, self).__call__(*args)
         fn = self._cached[key]
         rng = jax.random.key_data(_random.next_key())
         in_vals = [i._data for i in inputs]

@@ -1,0 +1,79 @@
+"""Dynamic-output-shape ops under graph capture (ref:
+tests/python/unittest/test_dynamic_shape.py — boolean_mask inside a
+hybridized block, forward AND backward).
+
+XLA requires static shapes, so a hybridized graph containing a
+dynamic-shape op falls back to eager execution for that input
+signature (the analog of the reference's dynamic-shape executor path,
+graph_executor.cc:1421), with a warning. Static graphs on the same
+block still jit."""
+import warnings
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import autograd, gluon, nd
+
+
+class _MaskBlock(gluon.HybridBlock):
+    def hybrid_forward(self, F, data, index):
+        return F.contrib.boolean_mask(data, index)
+
+
+def test_dynamic_shape_hybridized_forward_backward():
+    block = _MaskBlock()
+    block.hybridize()
+    data = nd.array([[1, 2, 3], [4, 5, 6], [7, 8, 9]])
+    index = nd.array([0, 1, 1])
+    data.attach_grad()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with autograd.record():
+            result = block(data, index)
+        result.backward()
+    assert onp.allclose(result.asnumpy(), [[4, 5, 6], [7, 8, 9]])
+    assert onp.allclose(data.grad.asnumpy(),
+                        [[0, 0, 0], [1, 1, 1], [1, 1, 1]])
+    assert any("dynamic" in str(w.message) for w in caught)
+
+
+def test_dynamic_shape_fallback_is_per_signature():
+    """The eager fallback is recorded per input signature; a different
+    mask population (hence different output shape) still works."""
+    block = _MaskBlock()
+    block.hybridize()
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out1 = block(data, nd.array([1, 0, 0, 1]))
+        out2 = block(data, nd.array([0, 1, 1, 1]))
+    assert out1.shape == (2, 3) and out2.shape == (3, 3)
+
+
+def test_static_block_still_jits_after_dynamic_one():
+    """The eager fallback is per-block/per-signature state: after a
+    dynamic block has fallen back, a static block still jits."""
+    dyn = _MaskBlock()
+    dyn.hybridize()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dyn(nd.array(onp.eye(3, dtype="float32")), nd.array([1, 0, 1]))
+    assert list(dyn._cached.values()) == [None]  # fell back
+
+    class Dense2(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = gluon.nn.Dense(2)
+
+        def hybrid_forward(self, F, x):
+            return self.fc(x)
+
+    net = Dense2()
+    net.initialize()
+    net.hybridize()
+    x = nd.array(onp.ones((3, 4), "float32"))
+    net(x)           # first call resolves deferred shapes eagerly
+    out = net(x)     # second call builds and uses the jitted cache
+    assert out.shape == (3, 2)
+    assert any(v is not None for v in net._cached.values())
