@@ -259,8 +259,27 @@ def test_captcha_cnn_ctc_trains():
 
 def test_extension_lib_example():
     """Runtime operator-extension loading (ref: example/lib_api):
-    loaded ops behave like built-ins under nd and autograd."""
-    assert _load("extension_lib/consume.py").main([]) is True
+    loaded ops behave like built-ins under nd and autograd. The
+    registry is restored afterwards — a leaked extension op would be
+    picked up by the registry-wide sweep with generic inputs."""
+    import mxnet_tpu.ndarray as nd_mod
+    import mxnet_tpu.symbol as sym_mod
+    from mxnet_tpu import library
+    from mxnet_tpu.ops.registry import _OPS
+    before = set(_OPS)
+    loaded_before = dict(library._LOADED)
+    try:
+        assert _load("extension_lib/consume.py").main([]) is True
+    finally:
+        for name in set(_OPS) - before:
+            _OPS.pop(name, None)
+            # the nd/sym namespaces memoize generated wrappers on first
+            # attribute access; drop those too or the op stays callable
+            for mod in (nd_mod, sym_mod):
+                if hasattr(mod, name):
+                    delattr(mod, name)
+        library._LOADED.clear()
+        library._LOADED.update(loaded_before)
 
 
 def test_speech_recognition_ctc_trains():
