@@ -343,3 +343,82 @@ def test_dot_1d_cases():
     a, b = A(4), A(4)
     assert_np(nd.dot(nd.array(a), nd.array(b)), onp.dot(a, b),
               rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (mask/last/reverse with per-batch lengths)
+# ---------------------------------------------------------------------------
+
+def test_sequence_mask_lengths():
+    # (T, B, C) layout, lengths per batch element
+    x = A(4, 2, 3)
+    out = nd.SequenceMask(nd.array(x),
+                          nd.array(onp.array([2, 3], "float32")),
+                          use_sequence_length=True, value=-1.0)
+    expect = x.copy()
+    expect[2:, 0] = -1.0
+    expect[3:, 1] = -1.0
+    assert_np(out, expect)
+
+
+def test_sequence_last_lengths():
+    x = A(4, 2, 3)
+    out = nd.SequenceLast(nd.array(x),
+                          nd.array(onp.array([2, 4], "float32")),
+                          use_sequence_length=True)
+    expect = onp.stack([x[1, 0], x[3, 1]])
+    assert_np(out, expect)
+
+
+def test_sequence_reverse_lengths():
+    x = A(4, 2, 3)
+    out = nd.SequenceReverse(nd.array(x),
+                             nd.array(onp.array([3, 4], "float32")),
+                             use_sequence_length=True)
+    expect = x.copy()
+    expect[:3, 0] = x[:3, 0][::-1]
+    expect[:, 1] = x[:, 1][::-1]
+    assert_np(out, expect)
+
+
+# ---------------------------------------------------------------------------
+# ordering edge cases
+# ---------------------------------------------------------------------------
+
+def test_topk_k_equals_axis_size():
+    x = A(3, 4)
+    out = nd.topk(nd.array(x), k=4, axis=1, ret_typ="value")
+    assert_np(out, -onp.sort(-x, axis=1))
+
+
+def test_topk_ret_both():
+    x = A(2, 5)
+    vals, idx = nd.topk(nd.array(x), k=2, axis=1, ret_typ="both")
+    order = onp.argsort(-x, axis=1)[:, :2]
+    assert_np(vals, onp.take_along_axis(x, order, axis=1))
+    assert_np(idx, order.astype("float32"))
+
+
+def test_argmax_channel():
+    x = A(3, 5)
+    assert_np(nd.argmax_channel(nd.array(x)),
+              onp.argmax(x, axis=1).astype("float32"))
+
+
+# ---------------------------------------------------------------------------
+# broadcast_like / slice_like shape coupling
+# ---------------------------------------------------------------------------
+
+def test_broadcast_like_axes():
+    a = A(1, 3)
+    b = A(5, 3)
+    out = nd.broadcast_like(nd.array(a), nd.array(b))
+    assert out.shape == (5, 3)
+
+
+def test_slice_like_partial_axes():
+    a = A(5, 6)
+    b = A(3, 4)
+    out = nd.slice_like(nd.array(a), nd.array(b), axes=(0,))
+    assert out.shape == (3, 6)
+    assert_np(out, a[:3])
