@@ -1,5 +1,6 @@
 """CLI tools tier (ref: tools/{parse_log,rec2idx,diagnose,
 flakiness_checker}.py and benchmark/opperf/)."""
+import json
 import os
 import subprocess
 import sys
@@ -80,3 +81,17 @@ def test_flakiness_checker_detects_pass(tmp_path):
               str(t), "-n", "2"], timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "2/2 passed" in r.stdout
+
+
+@pytest.mark.slow
+def test_check_tpu_consistency_self_test():
+    """The cpu-vs-accelerator oracle's harness validated cpu-vs-cpu
+    (the gpu/test_operator_gpu.py check_consistency analog; the real
+    cross-backend run needs a live chip and runs standalone)."""
+    proc = _run([os.path.join(ROOT, "tools", "check_tpu_consistency.py"),
+                 "--self-test"], timeout=600)
+    assert proc.returncode == 0, proc.stdout[-500:] + proc.stderr[-500:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, proc.stdout[-500:]
+    data = json.loads(lines[-1])
+    assert data["value"] == data["total"] and not data["failed"], data

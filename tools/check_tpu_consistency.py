@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""CPU-jax vs TPU-jax operator consistency sweep.
+
+The reference's main cross-backend oracle is check_consistency run by
+tests/python/gpu/test_operator_gpu.py (same op on cpu+gpu, outputs
+compared). This is the TPU analog as a standalone tool — it must run
+OUTSIDE the test suite because tests/conftest.py forces the CPU
+platform. Probes the accelerator with a killable subprocess first
+(the tunnel can hang rather than fail) and emits one JSON line.
+
+Usage: python tools/check_tpu_consistency.py [--ops a,b,c]
+"""
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as onp  # noqa: E402
+
+
+def _cases(rs):
+    """name -> (fn_name, inputs, kwargs). Inputs sized to hit the MXU
+    tiles (multiples of 8/128 where it matters)."""
+    B = {
+        "relu": (["T(64, 128)"], {}),
+        "sigmoid": (["T(64, 128)"], {}),
+        "tanh": (["T(64, 128)"], {}),
+        "exp": (["T(64, 128)"], {}),
+        "softmax": (["T(32, 128)"], {"axis": -1}),
+        "log_softmax": (["T(32, 128)"], {"axis": -1}),
+        "sum": (["T(16, 64, 32)"], {"axis": (1,)}),
+        "mean": (["T(16, 64, 32)"], {"axis": (0, 2)}),
+        "max": (["T(16, 64)"], {"axis": 1}),
+        "argmax": (["T(16, 64)"], {"axis": 1}),
+        "dot": (["T(64, 128)", "T(128, 96)"], {}),
+        "batch_dot": (["T(8, 32, 64)", "T(8, 64, 48)"], {}),
+        "elemwise_add": (["T(64, 128)", "T(64, 128)"], {}),
+        "broadcast_mul": (["T(64, 128)", "T(1, 128)"], {}),
+        "transpose": (["T(32, 64, 16)"], {"axes": (2, 0, 1)}),
+        "take": (["T(128, 32)", "I(64, hi=128)"], {}),
+        "one_hot": (["I(64, hi=32)"], {"depth": 32}),
+        "topk": (["T(16, 128)"], {"k": 8, "ret_typ": "value"}),
+        "sort": (["T(16, 128)"], {"axis": -1}),
+        "LayerNorm": (["T(32, 128)", "T(128)", "T(128)"], {}),
+        "FullyConnected": (["T(32, 64)", "T(48, 64)", "T(48)"],
+                           {"num_hidden": 48}),
+        "Convolution": (["T(4, 8, 28, 28)", "T(16, 8, 3, 3)", "T(16)"],
+                        {"kernel": (3, 3), "num_filter": 16}),
+        "Pooling": (["T(4, 8, 28, 28)"],
+                    {"kernel": (2, 2), "pool_type": "max",
+                     "stride": (2, 2)}),
+        "BatchNorm": (["T(8, 16, 14, 14)", "T(16)", "T(16)", "T(16)",
+                       "T(16, lo=0.5, hi=1.5)"], {"fix_gamma": False}),
+    }
+
+    def T(*shape, lo=-1.0, hi=1.0):
+        return rs.uniform(lo, hi, shape).astype("float32")
+
+    def I(*shape, hi=8):
+        return rs.randint(0, hi, shape).astype("float32")
+
+    env = {"T": T, "I": I}
+    out = {}
+    for name, (specs, kwargs) in B.items():
+        out[name] = ([eval(s, env) for s in specs], kwargs)  # noqa: S307
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--ops", default=None)
+    p.add_argument("--rtol", type=float, default=2e-2)  # bf16-tolerant
+    p.add_argument("--atol", type=float, default=2e-2)
+    p.add_argument("--self-test", action="store_true",
+                   help="compare cpu against cpu (validates the harness "
+                        "without an accelerator)")
+    args = p.parse_args(argv)
+
+    if args.self_test:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import bench  # repo root: reuse the killable accelerator probe
+        if bench._probe_tpu() != "accel":
+            print(json.dumps({"metric": "tpu_consistency", "value": None,
+                              "total": 0, "failed": [],
+                              "error": "accelerator unavailable"}))
+            return 1
+        import jax
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.ndarray.ndarray import array
+
+    cpu_dev = jax.local_devices(backend="cpu")[0]
+    accel = cpu_dev if args.self_test else \
+        [d for d in jax.devices() if d.platform != "cpu"][0]
+
+    rs = onp.random.RandomState(0)
+    cases = _cases(rs)
+    selected = args.ops.split(",") if args.ops else sorted(cases)
+    unknown = [s for s in selected if s not in cases]
+    if unknown:
+        print(json.dumps({"metric": "tpu_consistency", "value": None,
+                          "total": 0, "failed": [],
+                          "error": f"unknown ops {unknown}; "
+                                   f"choices: {sorted(cases)}"}))
+        return 1
+    passed, failed = [], []
+    for name in selected:
+        inputs, kwargs = cases[name]
+        fn = getattr(nd, name)
+        try:
+            outs = {}
+            for label, dev in (("cpu", cpu_dev), ("tpu", accel)):
+                with jax.default_device(dev):
+                    vals = fn(*[array(a) for a in inputs], **kwargs)
+                    vals = vals if isinstance(vals, (list, tuple)) \
+                        else [vals]
+                    outs[label] = [onp.asarray(v.asnumpy()) for v in vals]
+            for c, t in zip(outs["cpu"], outs["tpu"]):
+                onp.testing.assert_allclose(c, t, rtol=args.rtol,
+                                            atol=args.atol)
+            passed.append(name)
+        except Exception as e:  # noqa: BLE001 — report, don't abort
+            failed.append(f"{name}: {type(e).__name__}: {str(e)[:120]}")
+    print(json.dumps({"metric": "tpu_consistency",
+                      "value": len(passed), "total": len(selected),
+                      "failed": failed}))
+    return 0 if not failed else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
