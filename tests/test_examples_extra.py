@@ -286,3 +286,10 @@ def test_speech_recognition_ctc_trains():
     first, last = _load("speech_recognition/lstm_ctc.py").main(
         ["--steps", "100"])
     assert last < first * 0.3
+
+
+def test_bucketing_lm_example():
+    """Variable-length bucketed LM (ref: example/rnn/bucketing) —
+    the bucketed-jit answer to dynamic sequence lengths."""
+    ppl = _load("rnn/bucketing_lm.py").main(["--epochs", "10"])
+    assert ppl < 6.0  # random would be ~15
