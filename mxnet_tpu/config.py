@@ -269,6 +269,13 @@ register_flag(
     "candidate; any other value (1, float32, ... — the reference's "
     "multi-valued forms) enables tuning.")
 register_flag(
+    "MXNET_OPTUNE_CHOICE_<NAME>", str, "",
+    "Wildcard override: pin a tuned choice by candidate label, "
+    "trumping measurement and cache — e.g. "
+    "MXNET_OPTUNE_CHOICE_ATTENTION=dense forces XLA dense attention "
+    "over the Pallas flash kernel (operator_tune.choose). An unknown "
+    "label raises, listing the candidates.")
+register_flag(
     "MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
     "Seconds a worker waits at a dist barrier before declaring the "
     "job failed (failure detection, SURVEY.md §5.3; the reference's "

@@ -170,6 +170,19 @@ def choose(name: str, candidates: Sequence[Tuple[str, Callable]],
     tracers); the cached winner is served, else the default. The eager
     warm-up pass frameworks run to resolve deferred shapes is what
     populates the cache."""
+    # deterministic override: MXNET_OPTUNE_CHOICE_<NAME>=<label> pins a
+    # candidate by its label (e.g. MXNET_OPTUNE_CHOICE_ATTENTION=dense),
+    # trumping both the measurement and the cache; resolved through
+    # get_env so config.set_flag() overrides work like any other flag
+    from .base import get_env
+    forced = get_env(f"MXNET_OPTUNE_CHOICE_{name.upper()}", "")
+    if forced:
+        for cand in candidates:
+            if cand[0] == forced:
+                return cand
+        raise ValueError(
+            f"MXNET_OPTUNE_CHOICE_{name.upper()}={forced!r} does not "
+            f"match any candidate {[c[0] for c in candidates]}")
     mode = _resolve_mode()
     if mode == "never" or len(candidates) == 1:
         return candidates[0]

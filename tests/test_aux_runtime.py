@@ -402,3 +402,21 @@ def test_tensor_inspector(tmp_path):
     assert path.endswith("act_3.npy")
     back = TensorInspector.load_from_file(path)
     assert back.shape == (2, 2) and back[0, 0] == 1.0
+
+
+def test_operator_tune_choice_override(monkeypatch):
+    """MXNET_OPTUNE_CHOICE_<NAME> pins a tuned candidate by label,
+    trumping the measurement and cache; unknown labels raise with the
+    candidate list (docs/env_vars.md wildcard entry)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import operator_tune as ot
+
+    cands = [("a", lambda x: x + 1), ("b", lambda x: x + 2)]
+    monkeypatch.setenv("MXNET_OPTUNE_CHOICE_DEMO_CHOICE", "b")
+    label, fn = ot.choose("demo_choice", cands, jnp.ones(3))
+    assert label == "b"
+
+    monkeypatch.setenv("MXNET_OPTUNE_CHOICE_DEMO_CHOICE", "nope")
+    with pytest.raises(ValueError, match="does not match"):
+        ot.choose("demo_choice", cands, jnp.ones(3))
