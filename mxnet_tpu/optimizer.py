@@ -714,8 +714,18 @@ class Updater:
                                               self.states[index])
 
     def set_states(self, states):
-        self.states = pickle.loads(states) if isinstance(states, bytes) \
+        """ref: optimizer.py Updater.set_states — the payload may be
+        either the bare state dict or the (states, optimizer) pair that
+        get_states(dump_optimizer=True) produces."""
+        loaded = pickle.loads(states) if isinstance(states, bytes) \
             else states
+        if isinstance(loaded, tuple) and len(loaded) == 2 and \
+                isinstance(loaded[1], Optimizer):
+            loaded, self.optimizer = loaded
+            # keep the fused-update flag tracking the loaded optimizer
+            self.aggregate_updates = \
+                getattr(self.optimizer, "aggregate_num", 0) > 0
+        self.states = loaded
         self.states_synced = {k: False for k in self.states}
 
     def get_states(self, dump_optimizer=False):

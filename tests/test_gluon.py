@@ -1,4 +1,5 @@
 """Gluon tests (ref: tests/python/unittest/test_gluon.py)."""
+import jax.numpy as jnp
 import numpy as onp
 import pytest
 
@@ -243,3 +244,64 @@ def test_transforms_crop_resize_and_shape_is_known():
     assert resized.shape == (4, 5, 3)
     assert shape_is_known((2, 3)) and not shape_is_known(None)
     assert not shape_is_known((2, 0))
+
+
+def test_trainer_save_load_states(tmp_path):
+    """Optimizer state round trip through Trainer.save_states/
+    load_states (ref: tests/python/unittest/test_gluon_trainer.py
+    test_trainer_save_load): momentum buffers survive, and training
+    continues identically after a reload."""
+    def make():
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        net.weight.data()._rebind(jnp.ones((2, 3), jnp.float32))
+        net.bias.data()._rebind(jnp.zeros(2, jnp.float32))
+        return net
+
+    x = nd.array(onp.random.RandomState(0).randn(4, 3).astype("float32"))
+
+    def step(net, tr):
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(4)
+
+    net_a = make()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    step(net_a, tr_a)
+    path = str(tmp_path / "trainer.states")
+    tr_a.save_states(path)
+    step(net_a, tr_a)
+    wa = net_a.weight.data().asnumpy()
+
+    net_b = make()
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    step(net_b, tr_b)  # same first step -> same params as checkpoint
+    tr_b.load_states(path)
+    step(net_b, tr_b)  # must replay identically (momentum restored)
+    assert onp.allclose(net_b.weight.data().asnumpy(), wa, atol=1e-6)
+
+
+def test_trainer_set_learning_rate():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    net.weight.data()._rebind(jnp.ones((1, 2), jnp.float32))
+    net.bias.data()._rebind(jnp.zeros(1, jnp.float32))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.0})
+    x = nd.array(onp.ones((2, 2), "float32"))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
+    assert onp.allclose(net.weight.data().asnumpy(), 1.0)  # lr 0: frozen
+    assert tr.learning_rate == 0.0
+    tr.set_learning_rate(0.5)
+    assert tr.learning_rate == 0.5
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
+    assert not onp.allclose(net.weight.data().asnumpy(), 1.0)
