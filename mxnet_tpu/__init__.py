@@ -68,6 +68,7 @@ from . import amp  # noqa: F401
 from . import contrib  # noqa: F401
 
 from . import initializer  # noqa: F401
+from . import initializer as init  # noqa: F401  (ref: __init__.py:55)
 from . import optimizer  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from . import lr_scheduler  # noqa: F401

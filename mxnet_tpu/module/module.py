@@ -126,6 +126,11 @@ class Module(BaseModule):
              grad_req="write"):
         """ref: module.py:364."""
         if force_rebind:
+            if self._exec_group is not None and self._params_dirty:
+                # latest weights live only in the executors; pull them
+                # back before discarding or the re-bound executors get
+                # stale host params and training silently regresses
+                self._sync_params_from_devices()
             self._exec_group = None
             self.binded = False
         if self.binded:
@@ -155,6 +160,14 @@ class Module(BaseModule):
                                 for n in self._param_names}
             self._aux_params = {n: nd_zeros(ex.aux_dict[n].shape)
                                 for n in self._aux_names}
+        elif self.params_initialized:
+            # params were loaded before bind (Module.load -> bind): the
+            # fresh executors must receive them, as the reference's bind
+            # does (module.py:430 exec_group.set_params when
+            # params_initialized) — otherwise a loaded checkpoint
+            # silently trains from uninitialized buffers
+            self._exec_group.set_params(self._arg_params, self._aux_params,
+                                        allow_extra=True)
 
     # ------------------------------------------------------------------
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
@@ -327,12 +340,10 @@ class Module(BaseModule):
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
-        self.binded = False
-        self._exec_group = None
+        # bind(force_rebind) syncs dirty params out of the old executors
+        # and installs them into the fresh ones when params_initialized
         self.bind(data_shapes, label_shapes, self.for_training,
                   self.inputs_need_grad, force_rebind=True)
-        self._exec_group.set_params(self._arg_params, self._aux_params,
-                                    allow_extra=True)
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized

@@ -250,3 +250,86 @@ def test_libinfo_paths():
     incl = mx.libinfo.find_include_path()
     assert os.path.exists(os.path.join(incl, "mxtpu_predict.h"))
     assert os.path.exists(os.path.join(incl, "mxtpu_cpp.hpp"))
+
+
+def test_module_checkpoint_with_optimizer_states(tmp_path):
+    """Module.save_checkpoint(save_optimizer_states=True) ->
+    Module.load(load_optimizer_states=True) restores momentum and
+    training replays identically (ref: module.py save_checkpoint/load;
+    the dump_optimizer pickle path that Updater.set_states consumes)."""
+    rs = onp.random.RandomState(3)
+    x = rs.randn(8, 4).astype("float32")
+    y = onp.argmax(x[:, :2], axis=1).astype("float32")
+
+    def make():
+        data = sym.var("data")
+        net = sym.FullyConnected(data, num_hidden=2, name="fc")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net)
+        it = mx.io.NDArrayIter(x, y, batch_size=8)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Constant(0.1))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        return mod, it
+
+    def one_step(mod, it):
+        it.reset()
+        batch = next(it)
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+
+    mod_a, it_a = make()
+    one_step(mod_a, it_a)
+    prefix = str(tmp_path / "ckpt")
+    mod_a.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    one_step(mod_a, it_a)
+    wa = mod_a.get_params()[0]["fc_weight"].asnumpy()
+
+    mod_b = mx.mod.Module.load(prefix, 1, load_optimizer_states=True)
+    it_b = mx.io.NDArrayIter(x, y, batch_size=8)
+    mod_b.bind(data_shapes=it_b.provide_data,
+               label_shapes=it_b.provide_label)
+    mod_b.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9})
+    one_step(mod_b, it_b)
+    wb = mod_b.get_params()[0]["fc_weight"].asnumpy()
+    assert onp.allclose(wa, wb, atol=1e-6), "momentum not restored"
+
+
+def test_reshape_preserves_trained_params():
+    """reshape/force_rebind must carry the LATEST device params into
+    the fresh executors — after update() the newest weights live only
+    device-side (_params_dirty) and a naive rebind reverts training."""
+    rs = onp.random.RandomState(5)
+    x = rs.randn(8, 4).astype("float32")
+    y = onp.argmax(x[:, :2], axis=1).astype("float32")
+    data = sym.var("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=2, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(x, y, batch_size=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Constant(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    batch = next(it)
+    mod.forward(batch)
+    mod.backward()
+    mod.update()  # device params now differ from the host copy
+    trained = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert not onp.allclose(trained, 0.1)
+
+    mod.reshape(data_shapes=[("data", (4, 4))],
+                label_shapes=[("softmax_label", (4,))])
+    after = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert onp.allclose(after, trained), "reshape reverted training"
+    # and the new executors actually run at the new batch size
+    it4 = mx.io.NDArrayIter(x[:4], y[:4], batch_size=4)
+    mod.forward(next(it4), is_train=False)
+    assert mod.get_outputs()[0].shape == (4, 2)
