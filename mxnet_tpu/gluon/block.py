@@ -377,6 +377,11 @@ class HybridBlock(Block):
         self._cached = {}
 
     def __call__(self, *args):
+        from ..symbol.symbol import Symbol
+        if any(isinstance(a, Symbol) for a in args):
+            # symbolic tracing (export): no jit cache, just compose the
+            # graph (ref: block.py forward dispatches on input type)
+            return self.forward(*args)
         if not self._active:
             return super().__call__(*args)
         return self._call_cached(*args)
@@ -507,7 +512,15 @@ class HybridBlock(Block):
         return jitted
 
     def forward(self, x, *args):
-        """ref: block.py:941 — dispatches hybrid_forward with F=nd."""
+        """ref: block.py:941 — dispatches hybrid_forward with F=nd for
+        NDArray inputs, F=sym for Symbol inputs (the export trace)."""
+        from ..symbol.symbol import Symbol
+        if isinstance(x, Symbol):
+            from .. import symbol as sym_ns
+            from ..symbol.symbol import var as sym_var
+            params = {name: sym_var(p.name)
+                      for name, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_ns, x, *args, **params)
         from .. import ndarray as nd_ns
         params = {}
         for name, p in self._reg_params.items():
@@ -535,19 +548,33 @@ class HybridBlock(Block):
 
     def export(self, path, epoch=0, remove_amp_cast=True):
         """ref: block.py:907 export — emits symbol JSON + params usable by
-        SymbolBlock.imports / Module.load."""
+        SymbolBlock.imports / Module.load. Aux states (BN running
+        stats) are saved under the aux: prefix, as the traced symbol
+        classifies them — Module.load splits arg/aux by that prefix."""
         sym = self._trace_symbol()
         sym.save(f"{path}-symbol.json")
+        aux_names = set(sym.list_auxiliary_states())
         params = self._collect_params_with_prefix()
         from ..ndarray import ndarray as nd_mod
         arg_dict = {}
         for name, p in params.items():
-            arg_dict[f"arg:{p.name}"] = p.data()
+            kind = "aux" if p.name in aux_names else "arg"
+            try:
+                arg_dict[f"{kind}:{p.name}"] = p.data()
+            except DeferredInitializationError as e:
+                raise MXNetError(
+                    "export requires resolved parameter shapes; run one "
+                    "forward pass before export") from e
         nd_mod.save("%s-%04d.params" % (path, epoch), arg_dict)
 
     def _trace_symbol(self):
-        raise MXNetError("export requires a symbol trace; build the net "
-                         "with mx.sym for Module-style deployment")
+        """Trace hybrid_forward with Symbol proxies (ref: block.py
+        _build_cache's symbol trace backing export). Single-"data"-input
+        convention, like the reference's deployment flow; parameters
+        must be initialized (run one forward first for deferred
+        shapes)."""
+        from ..symbol.symbol import var as sym_var
+        return self.forward(sym_var("data"))
 
 
 class SymbolBlock(HybridBlock):
@@ -562,6 +589,10 @@ class SymbolBlock(HybridBlock):
             inputs = [inputs]
         self._symbol = outputs
         self._input_names = [i.name for i in inputs]
+        # graph variables carry their original fully-qualified names;
+        # the block prefix must NOT be prepended or imports() misses
+        # every parameter when matching loaded arrays by name
+        self.params._prefix = ""
         arg_names = outputs.list_arguments()
         aux_names = set(outputs.list_auxiliary_states())
         for name in arg_names:
@@ -593,7 +624,25 @@ class SymbolBlock(HybridBlock):
                     ret.params[name].set_data(p)
         return ret
 
+    def _collect_params_with_prefix(self, prefix=""):
+        # SymbolBlock params are registered on the ParameterDict by
+        # their graph names, not as _reg_params attributes; expose them
+        # so save_parameters/load_parameters (and export) see them
+        return {name: p for name, p in self.params.items()}
+
+    def _trace_symbol(self):
+        # the stored graph IS the symbol — re-export without re-tracing
+        # (tracing through forward would need symbolic substitution)
+        return self._symbol
+
     def forward(self, *args):
+        from ..symbol.symbol import Symbol
+        if any(isinstance(a, Symbol) for a in args):
+            raise MXNetError(
+                "composing an imported SymbolBlock into another "
+                "symbolic trace is not supported; export from the "
+                "original network (the SymbolBlock itself can "
+                "export() — it re-emits its stored graph)")
         values = {}
         for name, a in zip(self._input_names, args):
             values[name] = a._data if isinstance(a, NDArray) else a

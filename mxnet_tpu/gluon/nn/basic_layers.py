@@ -211,8 +211,13 @@ class BatchNorm(HybridBlock):
         super().cast(dtype)
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
-        out, new_mean, new_var = F.BatchNorm(
+        res = F.BatchNorm(
             x, gamma, beta, running_mean, running_var, **self._kwargs)
+        if not isinstance(res, (tuple, list)):
+            # symbolic trace: the graph op exposes one output; the
+            # running-stat updates are executor aux-state semantics
+            return res
+        out, new_mean, new_var = res
         record_aux_update(self.running_mean, new_mean)
         record_aux_update(self.running_var, new_var)
         return out

@@ -305,3 +305,84 @@ def test_trainer_set_learning_rate():
     loss.backward()
     tr.step(2)
     assert not onp.allclose(net.weight.data().asnumpy(), 1.0)
+
+
+def test_export_symbolblock_imports_roundtrip(tmp_path):
+    """The reference deployment flow (ref: block.py:907 export ->
+    block.py:1025 SymbolBlock.imports): a hybridized Gluon net exports
+    symbol JSON + params, reloads as a SymbolBlock, and reproduces its
+    outputs exactly."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.array(onp.random.RandomState(0).randn(2, 5).astype("float32"))
+    net.hybridize()
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "net")
+    net.export(prefix, epoch=7)
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0007.params")
+    assert onp.allclose(sb(x).asnumpy(), ref, atol=1e-5)
+
+
+def test_export_with_batchnorm_loads_in_module(tmp_path):
+    """Aux states (BN running stats) export under the aux: prefix so
+    the pair loads in BOTH SymbolBlock and Module (the executor splits
+    arg/aux by prefix, ref: model.py load_checkpoint)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.GlobalAvgPool2D(), nn.Dense(2))
+    net.initialize()
+    x = nd.array(onp.random.RandomState(1).randn(2, 3, 8, 8)
+                 .astype("float32"))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "cnet")
+    net.export(prefix)
+
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    assert onp.allclose(sb(x).asnumpy(), ref, atol=1e-4)
+
+    mod = mx.mod.Module.load(prefix, 0)
+    it = mx.io.NDArrayIter(x.asnumpy(), None, batch_size=2)
+    mod.bind(data_shapes=it.provide_data, for_training=False)
+    mod.forward(next(it), is_train=False)
+    assert onp.allclose(mod.get_outputs()[0].asnumpy(), ref, atol=1e-4)
+
+
+def test_symbolblock_save_load_and_reexport(tmp_path):
+    """SymbolBlock supports the full Block persistence surface:
+    save_parameters/load_parameters by graph names, and export()
+    re-emits its stored graph (ref: block.py SymbolBlock)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize()
+    x = nd.array(onp.random.RandomState(0).randn(2, 3).astype("float32"))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "sb")
+    net.export(prefix)
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    pfile = str(tmp_path / "sb.params")
+    sb.save_parameters(pfile)
+    sb2 = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"])
+    sb2.load_parameters(pfile)
+    assert onp.allclose(sb2(x).asnumpy(), ref, atol=1e-5)
+
+    re_prefix = str(tmp_path / "sb_re")
+    sb.export(re_prefix)
+    sb3 = gluon.SymbolBlock.imports(re_prefix + "-symbol.json", ["data"],
+                                    re_prefix + "-0000.params")
+    assert onp.allclose(sb3(x).asnumpy(), ref, atol=1e-5)
+
+
+def test_export_before_forward_raises_friendly(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(3))  # deferred in_units
+    net.initialize()
+    with pytest.raises(mx.MXNetError, match="forward pass before export"):
+        net.export(str(tmp_path / "defer"))
