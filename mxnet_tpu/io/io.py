@@ -164,8 +164,12 @@ class NDArrayIter(DataIter):
         if end <= self.num_data:
             sel = self.idx[self.cursor:end]
         else:
+            # pad by wrapping from the start, cycling if the batch is
+            # larger than the dataset (idx[:pad] alone under-fills then,
+            # emitting a short batch whose pad exceeds its length)
             pad = end - self.num_data
-            sel = onp.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+            sel = onp.concatenate([self.idx[self.cursor:],
+                                   onp.resize(self.idx, pad)])
         return [array(self._cached[k][sel]) for k, _ in arrs]
 
     def getdata(self):

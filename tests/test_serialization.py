@@ -184,3 +184,43 @@ def test_reference_v0_fixture_loads_bit_for_bit():
     for a in arrs:
         assert a.shape == (128,) and str(a.dtype) == "float32"
         assert onp.array_equal(a.asnumpy(), expect)
+
+
+def test_feedforward_save_load_predict(tmp_path):
+    """FeedForward.save -> load -> predict reproduces outputs (ref:
+    model.py FeedForward save/load). Caught: NDArrayIter emitted a
+    short under-filled batch when batch_size > num_data (pad wrap
+    used idx[:pad] which caps at num_data), so a loaded model with
+    the default numpy_batch_size predicted an EMPTY array."""
+    rs = onp.random.RandomState(0)
+    x = rs.randn(16, 4).astype("float32")
+    y = onp.argmax(x[:, :2], 1).astype("float32")
+    from mxnet_tpu import sym
+    data = sym.var("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=2, name="fc"),
+        name="softmax")
+    ff = mx.FeedForward(net, num_epoch=2, numpy_batch_size=8,
+                        learning_rate=0.2)
+    ff.fit(x, y)
+    ref = ff.predict(x)
+    prefix = str(tmp_path / "ffm")
+    ff.save(prefix, epoch=2)
+    ff2 = mx.FeedForward.load(prefix, epoch=2)  # default batch 128 > 16
+    out = ff2.predict(x)
+    assert out.shape == (16, 2)
+    assert onp.allclose(out, ref, atol=1e-5)
+
+
+def test_ndarray_iter_batch_larger_than_data():
+    """batch_size > num_data: one full-size padded batch cycling the
+    data, with pad = batch_size - num_data (reference pad semantics)."""
+    from mxnet_tpu.io import NDArrayIter
+    it = NDArrayIter(onp.arange(6, dtype="float32").reshape(3, 2),
+                     None, batch_size=8)
+    batches = list(it)
+    assert len(batches) == 1
+    b = batches[0]
+    assert b.data[0].shape == (8, 2) and b.pad == 5
+    vals = b.data[0].asnumpy()[:, 0]
+    assert vals.tolist() == [0, 2, 4, 0, 2, 4, 0, 2]  # cycled fill
