@@ -33,6 +33,15 @@ class BucketingModule(BaseModule):
         self._curr_bucket_key = None
         self._monitor = None
         self._grad_req = None
+        self._preload_params = None  # set by BucketingModule.load
+        # monotonically increasing parameter version: each bucket module
+        # records the version it last received, so switch_bucket knows
+        # exactly when a module's device params are stale. The
+        # _params_dirty flag alone cannot carry this — get_params()
+        # clears it after syncing only the CURRENT bucket, leaving other
+        # buckets stale with no record (params-shared executors make
+        # this moot in the reference; here params are copied on switch)
+        self._param_version = 0
 
     def _gen_symbol(self, key):
         sym, data_names, label_names = self._sym_gen(key)
@@ -85,6 +94,11 @@ class BucketingModule(BaseModule):
                                       allow_extra=allow_extra)
         self._params_dirty = False
         self.params_initialized = True
+        # a fresh param install is a new version — other bucket modules
+        # must refresh on their next switch (set_params routes here with
+        # force_init, so this also covers external param injection)
+        self._param_version += 1
+        self._curr_module._bucket_param_version = self._param_version
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -115,6 +129,19 @@ class BucketingModule(BaseModule):
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self._params_dirty = False
+        if self._preload_params is not None:
+            # checkpoint loaded before bind (BucketingModule.load):
+            # install into the fresh executors, like Module's own
+            # preloaded-params path
+            arg_params, aux_params = self._preload_params
+            module._arg_params = arg_params
+            module._aux_params = aux_params
+            module.params_initialized = True
+            module._exec_group.set_params(arg_params, aux_params,
+                                          allow_extra=True)
+            module._bucket_param_version = self._param_version
+            self.params_initialized = True
+            self._preload_params = None
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """ref: bucketing_module.py switch_bucket."""
@@ -131,11 +158,6 @@ class BucketingModule(BaseModule):
                         self._curr_module.for_training,
                         self._curr_module.inputs_need_grad,
                         force_rebind=False, grad_req=self._grad_req)
-            if self.params_initialized:
-                arg_params, aux_params = self.get_params()
-                module.init_params(arg_params=arg_params,
-                                   aux_params=aux_params, allow_missing=True,
-                                   force_init=True, allow_extra=True)
             if self._monitor is not None:
                 module.install_monitor(self._monitor)
             if self._curr_module.optimizer_initialized:
@@ -143,14 +165,19 @@ class BucketingModule(BaseModule):
             self._buckets[bucket_key] = module
         else:
             module = self._buckets[bucket_key]
-            if self.params_initialized and self._params_dirty:
-                arg_params, aux_params = self.get_params()
-                module.init_params(arg_params=arg_params,
-                                   aux_params=aux_params, allow_missing=True,
-                                   force_init=True, allow_extra=True)
             if not module.optimizer_initialized and \
                     self._curr_module.optimizer_initialized:
                 module.borrow_optimizer(self._curr_module)
+        if self.params_initialized and \
+                getattr(module, "_bucket_param_version", -1) != \
+                self._param_version:
+            # this module last saw an older parameter version: refresh
+            # from the current (freshest) module BEFORE switching
+            arg_params, aux_params = self.get_params()
+            module.init_params(arg_params=arg_params,
+                               aux_params=aux_params, allow_missing=True,
+                               force_init=True, allow_extra=True)
+            module._bucket_param_version = self._param_version
         self._curr_module = module
         self._curr_bucket_key = bucket_key
 
@@ -189,7 +216,9 @@ class BucketingModule(BaseModule):
 
     def update(self):
         self._params_dirty = True
+        self._param_version += 1
         self._curr_module.update()
+        self._curr_module._bucket_param_version = self._param_version
 
     def get_outputs(self, merge_multi_context=True):
         return self._curr_module.get_outputs(merge_multi_context)
@@ -204,6 +233,65 @@ class BucketingModule(BaseModule):
     def symbol(self):
         assert self.binded
         return self._curr_module.symbol
+
+    @staticmethod
+    def _bucket_tag(key):
+        """Filename-safe rendering of a bucket key (int, str, or tuple
+        like seq2seq's (enc_len, dec_len))."""
+        import re
+        if isinstance(key, (tuple, list)):
+            raw = "_".join(str(k) for k in key)
+        else:
+            raw = str(key)
+        return re.sub(r"[^A-Za-z0-9_.-]", "_", raw)
+
+    def save_checkpoint(self, prefix, epoch, remove_amp_cast=False):
+        """ref: bucketing_module.py:563 — shared params, one symbol
+        JSON per trained bucket, and an epoch-scoped JSON manifest of
+        the bucket keys (tuple keys preserved as lists)."""
+        assert self._buckets, "empty BucketingModule cannot be saved"
+        import json
+
+        self.save_params("%s-%04d.params" % (prefix, epoch))
+        tags = {}
+        for key in self._buckets:
+            s, _, _ = self._gen_symbol(key)
+            tag = self._bucket_tag(key)
+            s.save("%s-%s-symbol.json" % (prefix, tag))
+            tags[tag] = list(key) if isinstance(key, (tuple, list)) \
+                else key
+        with open("%s-%04d.buckets.json" % (prefix, epoch), "w") as f:
+            json.dump(tags, f)
+
+    @staticmethod
+    def load(prefix, epoch, sym_gen=None, default_bucket_key=None,
+             **kwargs):
+        """ref: bucketing_module.py:584 — sym_gen cannot be serialized,
+        so the caller supplies it; params install into the executors at
+        the next bind. The manifest, when present, validates that the
+        requested default bucket was part of the checkpoint."""
+        import json
+        import os
+
+        assert sym_gen is not None, \
+            "sym_gen is required for loading BucketingModule"
+        assert default_bucket_key is not None, \
+            "default_bucket_key is required for loading BucketingModule"
+        manifest = "%s-%04d.buckets.json" % (prefix, epoch)
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                tags = json.load(f)
+            want = BucketingModule._bucket_tag(default_bucket_key)
+            if want not in tags:
+                raise ValueError(
+                    f"default_bucket_key {default_bucket_key!r} was not "
+                    f"in the checkpoint (buckets: {sorted(tags.values())})")
+        from ..model import load_params as _load_params
+        mod = BucketingModule(sym_gen,
+                              default_bucket_key=default_bucket_key,
+                              **kwargs)
+        mod._preload_params = _load_params(prefix, epoch)
+        return mod
 
     def install_monitor(self, mon):
         assert self.binded
