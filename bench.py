@@ -41,6 +41,31 @@ _PEAK_FLOPS = [
     ("v4", 275e12), ("v3", 61.5e12), ("v2", 22.5e12),
 ]
 
+# Peak HBM bandwidth per device (bytes/s), same keying. Used for the
+# roofline line: which roof (MXU flops vs HBM bytes) binds the step.
+_PEAK_HBM = [
+    ("v6", 1640e9), ("v5p", 2765e9), ("v5", 819e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+]
+
+# Append-only on-chip evidence log, committed to the repo. Every
+# SUCCESSFUL accelerator measurement — driver-run or manual — appends a
+# timestamped record here, so one tunnel outage at driver time can no
+# longer erase the round's hardware story (round-2 failure mode).
+TPU_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_TPU_LOG.jsonl")
+
+
+def append_tpu_log(record):
+    try:
+        record = dict(record)
+        record.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()))
+        with open(TPU_LOG, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except Exception:
+        pass  # evidence log must never break the bench contract
+
 
 def _emit(value, unit="images/sec", vs=None, **extra):
     line = {"metric": "resnet50_train_throughput",
@@ -83,6 +108,31 @@ def _probe_tpu(timeout_s=150):
     return {0: "accel", 2: "cpu"}.get(rc, "failed")
 
 
+def _probe_with_retry(per_try_s=150):
+    """Probe the accelerator repeatedly with backoff, spending MOST of
+    the watchdog budget before giving up (VERDICT r2: a 2x150 s window
+    lost the round's hardware evidence to a transient tunnel outage).
+    Keeps a reserve for compile+run — with the persistent XLA cache a
+    post-probe bench needs ~2-4 min. Returns (status, attempts):
+    status "accel" | "cpu" (definitive: backend healthy, no accel) |
+    "failed" (budget exhausted, tunnel unreachable)."""
+    watchdog = int(os.environ.get("MXTPU_BENCH_TIMEOUT", "1500"))
+    reserve = int(os.environ.get("MXTPU_BENCH_PROBE_RESERVE", "600"))
+    budget = max(per_try_s + 10, watchdog - reserve)
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while True:
+        left = deadline - time.monotonic()
+        probe = _probe_tpu(max(30, min(per_try_s, left)))
+        attempt += 1
+        if probe in ("accel", "cpu"):
+            return probe, attempt
+        backoff = min(20.0 * attempt, 90.0)
+        if time.monotonic() + backoff + 60 > deadline:
+            return "failed", attempt
+        time.sleep(backoff)
+
+
 def _force_cpu(jax):
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
@@ -94,28 +144,29 @@ def _force_cpu(jax):
 
 
 def _init_jax():
-    """Initialize the jax backend robustly. Returns (jax, devices).
+    """Initialize the jax backend robustly. Returns
+    (jax, devices, probe_status).
 
-    Probe the accelerator in a killable subprocess first; retry once on
-    transient failure (UNAVAILABLE / chip left poisoned by a previous
-    run), then fall back to the CPU backend so a number is always
-    produced. MXTPU_BENCH_FORCE_CPU=1 skips the probe entirely
-    (hermetic CI / contract tests).
+    Probe the accelerator in a killable subprocess with long retry
+    (most of the watchdog budget — see _probe_with_retry), then fall
+    back to the CPU backend so a number is always produced; the caller
+    marks that line "degraded" so a CPU fallback can never masquerade
+    as a real measurement. MXTPU_BENCH_FORCE_CPU=1 skips the probe
+    entirely (hermetic CI / contract tests).
     """
     if os.environ.get("MXTPU_BENCH_FORCE_CPU") == "1":
-        probe = "cpu"
+        probe = "forced_cpu"
     else:
-        probe = _probe_tpu()
+        probe, attempts = _probe_with_retry()
         if probe == "failed":
-            time.sleep(5.0)
-            probe = _probe_tpu()
+            probe = f"failed:{attempts}"
     import jax
-    if probe != "accel":
+    if not probe.startswith("accel"):
         _force_cpu(jax)
-        return jax, jax.devices()
+        return jax, jax.devices(), probe
     for attempt in range(3):
         try:
-            return jax, jax.devices()
+            return jax, jax.devices(), probe
         except Exception:  # backend init failure
             try:
                 from jax._src import xla_bridge as _xb
@@ -124,20 +175,28 @@ def _init_jax():
                 pass
             time.sleep(2.0 * (attempt + 1))
     _force_cpu(jax)
-    return jax, jax.devices()
+    return jax, jax.devices(), "failed:init"
 
 
-def _peak_flops(dev):
-    kind = getattr(dev, "device_kind", "") or ""
-    kind_l = kind.lower()
-    for key, peak in _PEAK_FLOPS:
+def _peak_lookup(dev, table):
+    kind_l = (getattr(dev, "device_kind", "") or "").lower()
+    for key, peak in table:
         if key in kind_l:
             return peak
     return None
 
 
+def _peak_flops(dev):
+    return _peak_lookup(dev, _PEAK_FLOPS)
+
+
+def _peak_hbm(dev):
+    return _peak_lookup(dev, _PEAK_HBM)
+
+
 def main():
-    jax, devices = _init_jax()
+    t_start = time.monotonic()
+    jax, devices, probe_status = _init_jax()
     # persistent compile cache: a re-run after a watchdog kill (or any
     # second invocation) skips the multi-minute first compile
     cache_dir = os.environ.get("MXTPU_COMPILE_CACHE",
@@ -235,34 +294,85 @@ def main():
         dt = net_time(raw, d2h_lat)
 
     img_per_sec = n_steps * batch / dt
+    step_s = dt / n_steps
 
-    # MFU from the analytic model-flops count (standard convention);
-    # XLA's own per-step count optionally alongside (it goes through
-    # the AOT compile path — a second full compile — so opt-in only).
     flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
-    xla_flops = None
-    if os.environ.get("MXTPU_BENCH_XLA_FLOPS", "0") == "1":
+    dev0 = accel[0] if on_accel else devices[0]
+    peak = _peak_flops(dev0) if on_accel else None
+    peak_hbm = _peak_hbm(dev0) if on_accel else None
+    mfu = round(img_per_sec / batch * flops_per_step / peak, 4) \
+        if peak else None
+
+    degraded = None
+    if not on_accel and probe_status.startswith("failed"):
+        degraded = "tpu_unreachable"
+
+    record = dict(
+        mfu=mfu, batch=batch, steps=n_steps, amp=amp,
+        flops_per_step=flops_per_step, step_s=round(step_s, 5),
+        raw_s=round(raw, 4), fence_lat_s=round(d2h_lat, 4),
+        lat_dominated=lat_dominated(raw, d2h_lat),
+        platform=(accel[0].platform if on_accel else "cpu"),
+        device_kind=getattr(dev0, "device_kind", "unknown"))
+    if degraded:
+        record["degraded"] = degraded
+
+    # SECURE THE EVIDENCE FIRST: the throughput number is measured; log
+    # and emit it before the (potentially slow) cost-analysis pass so a
+    # watchdog kill during enrichment can't erase the round's hardware
+    # story (the parent scans partial stdout on timeout and takes the
+    # last JSON line — an enriched line below supersedes this one).
+    if on_accel:
+        append_tpu_log(dict(metric="resnet50_train_throughput",
+                            value=round(img_per_sec, 2),
+                            unit="images/sec", partial=True, **record))
+    _emit(round(img_per_sec, 2), **record)
+
+    # Enrichment: XLA's own flops/bytes for the roofline line (which
+    # roof — MXU flops vs HBM bytes — binds the step). Re-lowers +
+    # compiles, normally a persistent-cache hit (the warmup jit wrote
+    # it seconds ago); guarded by the watchdog budget anyway.
+    xla_flops = xla_bytes = None
+    want_cost = os.environ.get("MXTPU_BENCH_XLA_FLOPS",
+                               "1" if on_accel else "0") == "1"
+    watchdog = int(os.environ.get("MXTPU_BENCH_TIMEOUT", "1500"))
+    if want_cost and time.monotonic() - t_start > watchdog - 240:
+        want_cost = False
+    if want_cost:
         try:
             cost = trainer._compiled.lower(
                 trainer.params, trainer.opt_state, xv, yv,
                 jax.random.key_data(jax.random.key(0)),
                 jnp.asarray(0.05, jnp.float32)).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
             if cost and cost.get("flops", 0) > 0:
                 xla_flops = float(cost["flops"])
+            xla_bytes = float(cost.get("bytes accessed", 0)) or None
         except Exception:
             pass
-    peak = _peak_flops(accel[0]) if on_accel else None
-    mfu = round(img_per_sec / batch * flops_per_step / peak, 4) \
-        if peak else None
 
-    _emit(round(img_per_sec, 2),
-          mfu=mfu, batch=batch, steps=n_steps, amp=amp,
-          flops_per_step=flops_per_step, xla_flops=xla_flops,
-          raw_s=round(raw, 4), fence_lat_s=round(d2h_lat, 4),
-          lat_dominated=lat_dominated(raw, d2h_lat),
-          platform=(accel[0].platform if on_accel else "cpu"),
-          device_kind=getattr((accel[0] if on_accel else devices[0]),
-                              "device_kind", "unknown"))
+    roofline = {}
+    if peak:
+        ach_flops = (xla_flops or flops_per_step) / step_s
+        roofline["achieved_flops"] = round(ach_flops, 3)
+        roofline["flops_util"] = round(ach_flops / peak, 4)
+    if peak_hbm and xla_bytes:
+        ach_bytes = xla_bytes / step_s
+        roofline["achieved_bytes_per_s"] = round(ach_bytes, 3)
+        roofline["hbm_util"] = round(ach_bytes / peak_hbm, 4)
+    if "flops_util" in roofline and "hbm_util" in roofline:
+        roofline["bound"] = ("hbm" if roofline["hbm_util"]
+                             > roofline["flops_util"] else "mxu")
+
+    if roofline or xla_flops or xla_bytes:
+        record.update(xla_flops=xla_flops, xla_bytes=xla_bytes,
+                      **roofline)
+        if on_accel:
+            append_tpu_log(dict(metric="resnet50_train_throughput",
+                                value=round(img_per_sec, 2),
+                                unit="images/sec", **record))
+        _emit(round(img_per_sec, 2), **record)
 
 
 def _parent():
@@ -281,10 +391,25 @@ def _parent():
                 print(ln)
                 sys.stdout.flush()
                 return
-        _emit(None, vs=None,
+        _emit(None, vs=None, degraded="bench_failed",
               error=f"child rc={res.returncode}, no JSON line")
-    except subprocess.TimeoutExpired:
-        _emit(None, vs=None, error=f"bench timed out after {timeout}s")
+    except subprocess.TimeoutExpired as te:
+        # the child emits the measured throughput BEFORE enrichment;
+        # salvage it from the partial stdout rather than losing the run
+        out = te.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        for ln in reversed(out.strip().splitlines()):
+            if ln.startswith("{"):
+                try:
+                    json.loads(ln)  # kill mid-write leaves torn lines
+                except ValueError:
+                    continue
+                print(ln)
+                sys.stdout.flush()
+                return
+        _emit(None, vs=None, degraded="bench_timeout",
+              error=f"bench timed out after {timeout}s")
     except Exception as e:
         _emit(None, vs=None, error=f"{type(e).__name__}: {e}"[:500])
 
