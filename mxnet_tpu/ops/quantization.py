@@ -85,12 +85,14 @@ def quantized_fully_connected(data, weight, bias, min_data, max_data,
                               num_hidden=0, no_bias=False, flatten=True):
     """ref: quantization/quantized_fully_connected.cc — int8×int8→int32 on
     the MXU."""
-    x = data.astype(jnp.int32)
+    # operands stay int8 INTO the dot — int8 x int8 -> int32 accumulate
+    # is what lowers to the MXU's int8 mode; upcasting first would make
+    # XLA run an int32 matmul (correct but full-width, no speedup)
+    x = data if data.dtype == jnp.int8 else data.astype(jnp.int8)
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
-    acc = jax.lax.dot(x.astype(jnp.int8).astype(jnp.int32),
-                      weight.T.astype(jnp.int32),
-                      preferred_element_type=jnp.int32)
+    w = weight if weight.dtype == jnp.int8 else weight.astype(jnp.int8)
+    acc = jax.lax.dot(x, w.T, preferred_element_type=jnp.int32)
     if not no_bias:
         acc = acc + bias.astype(jnp.int32)
     s_d, _ = _range_to_scale(min_data, max_data)
