@@ -43,10 +43,35 @@ class ndarray(NDArray):
 
     __slots__ = ()
 
+    def _np_operand(self, other):
+        """numpy-semantics operand handling: python scalars stay WEAK
+        (int array + 1.5 -> float), never cast to self.dtype like the
+        legacy nd coercion — that truncation silently corrupts
+        arithmetic AND comparisons (int arr > -2.5 at -2)."""
+        if isinstance(other, (int, float, bool, onp.number)):
+            return other
+        if isinstance(other, NDArray):
+            return other
+        return _np_wrap(jnp.asarray(other))
+
+    def _binary(self, other, fn):
+        o = self._np_operand(other)
+        if not isinstance(o, NDArray):
+            return _invoke(lambda a: fn(a, o), [self])
+        return _invoke(fn, [self, o])
+
+    def _rbinary(self, other, fn):
+        o = self._np_operand(other)
+        if not isinstance(o, NDArray):
+            return _invoke(lambda a: fn(o, a), [self])
+        return _invoke(fn, [o, self])
+
     def _cmp(self, other, fn):
-        from ..ndarray.ndarray import _coerce_operand
-        other = _coerce_operand(other, self)
-        return _invoke(lambda a, b: fn(a, b), [self, other],
+        o = self._np_operand(other)
+        if not isinstance(o, NDArray):
+            return _invoke(lambda a: fn(a, o), [self],
+                           differentiable=False)
+        return _invoke(lambda a, b: fn(a, b), [self, o],
                        differentiable=False)
 
     def __eq__(self, o):
@@ -69,6 +94,52 @@ class ndarray(NDArray):
 
     def __hash__(self):
         return id(self)
+
+    # numpy semantics: / is TRUE division for every dtype (int/int ->
+    # float), unlike mx.nd's legacy C-truncating int division
+    # (ref: np_true_divide.cc — mx.np routes `/` to _npi_true_divide)
+    def __truediv__(self, o):
+        return self._binary(o, jnp.true_divide)
+
+    def __rtruediv__(self, o):
+        return self._rbinary(o, jnp.true_divide)
+
+    # in-place ops follow numpy's same_kind casting rule: the result is
+    # cast back to self.dtype (views/aliases observe the update through
+    # _rebind) or a TypeError is raised — int_arr /= 2.5 must not
+    # silently become float in place
+    def _ibinary(self, o, fn, ufunc_name):
+        out = self._binary(o, fn)
+        if not onp.can_cast(onp.dtype(str(out.dtype)),
+                            onp.dtype(str(self.dtype)),
+                            casting="same_kind"):
+            raise TypeError(
+                f"Cannot cast ufunc '{ufunc_name}' output from "
+                f"{out.dtype} to {self.dtype} with casting rule "
+                f"'same_kind'")
+        self._rebind(out._data.astype(self._data.dtype))
+        return self
+
+    def __iadd__(self, o):
+        return self._ibinary(o, jnp.add, "add")
+
+    def __isub__(self, o):
+        return self._ibinary(o, jnp.subtract, "subtract")
+
+    def __imul__(self, o):
+        return self._ibinary(o, jnp.multiply, "multiply")
+
+    def __itruediv__(self, o):
+        return self._ibinary(o, jnp.true_divide, "true_divide")
+
+    def __ifloordiv__(self, o):
+        return self._ibinary(o, jnp.floor_divide, "floor_divide")
+
+    def __imod__(self, o):
+        return self._ibinary(o, jnp.mod, "remainder")
+
+    def __ipow__(self, o):
+        return self._ibinary(o, jnp.power, "power")
 
     def as_nd_ndarray(self):
         out = NDArray.__new__(NDArray)

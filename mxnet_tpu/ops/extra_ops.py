@@ -896,6 +896,28 @@ register_op("_copyto", aliases=["_npi_copyto"])(
     lambda data: jnp.copy(data))
 
 
+@register_op("_cvimdecode", aliases=["_npi_cvimdecode"],
+             differentiable=False)
+def cvimdecode(data, flag=1, to_rgb=True):
+    """ref: image_io.cc _cvimdecode (NNVM-registered as an op there, not
+    just a Python helper) — decode an encoded JPEG/PNG byte buffer
+    (uint8 1-D tensor) to (H, W, C). Host-side and eager-only: the
+    output shape is data-dependent, exactly like the reference's
+    OpenCV call."""
+    import numpy as onp
+    from ..image import imdecode as _imdec
+    buf = onp.asarray(data).tobytes()
+    return _imdec(buf, flag=int(flag), to_rgb=bool(to_rgb))._data
+
+
+@register_op("_cvimread", aliases=["_npi_cvimread"], differentiable=False)
+def cvimread(filename="", flag=1, to_rgb=True):
+    """ref: image_io.cc _cvimread — read + decode an image file.
+    Zero tensor inputs (a creation-style op); host-side, eager-only."""
+    from ..image import imread as _imrd
+    return _imrd(filename, flag=int(flag), to_rgb=bool(to_rgb))._data
+
+
 @register_op("_cvimresize", aliases=["_npi_cvimresize"])
 def cvimresize(data, w=0, h=0, interp=1):
     """ref: image_io.cc imresize — (H, W, C) resize; w/h are required

@@ -117,7 +117,34 @@ _ALIASES = {
     "_npx_smooth_l1": "smooth_l1",
     "_npx_softmax": "softmax",
     "_npx_topk": "topk",
+    "_npx_relu": "relu",
+    "_npx_sigmoid": "sigmoid",
+    # numpy binary/scalar arithmetic (ref: np_elemwise_broadcast_op.cc) —
+    # jnp already applies numpy broadcasting + promotion in the canonical
+    # broadcast_* kernels, so these are pure renames
+    "_npi_add": "broadcast_add",
+    "_npi_subtract": "broadcast_sub",
+    "_npi_multiply": "broadcast_mul",
+    "_npi_mod": "broadcast_mod",
+    "_npi_power": "broadcast_power",
+    "_npi_absolute": "abs",
+    "_npi_negative": "negative",
 }
+# NOTE: the _npi_*_scalar family is NOT aliased onto the legacy scalar
+# kernels — those cast scalar and result to the data dtype (reference
+# legacy semantics), while numpy semantics promote (int array + 1.5 ->
+# float). Real registrations live in numpy_ops.py.
+
+# numpy unary math (ref: np_elemwise_unary_op_basic.cc NNVM registrations):
+# the same jnp kernels as the canonical mxnet-name ops
+for _u in ("arccos", "arccosh", "arcsin", "arcsinh", "arctan", "arctanh",
+           "cbrt", "ceil", "cos", "cosh", "degrees", "exp", "expm1", "fix",
+           "floor", "log10", "log1p", "log2", "radians",
+           "reciprocal", "rint", "sign", "sin", "sinh", "sqrt", "square",
+           "tan", "tanh", "trunc"):
+    _ALIASES[f"_npi_{_u}"] = _u
+# logical_not is excluded above: the legacy kernel returns the input
+# dtype, numpy semantics return bool — numpy_ops.py registers the real one
 
 # _npx__image_* -> _image_* (ref: src/operator/image/ registered under both)
 for _img in ("adjust_lighting", "crop", "flip_left_right", "flip_top_bottom",

@@ -60,6 +60,23 @@ register_op("_npi_argmax", differentiable=False)(
 # ---------------------------------------------------------------------------
 
 register_op("_npi_true_divide")(lambda lhs, rhs: jnp.true_divide(lhs, rhs))
+
+# scalar arithmetic with NUMPY promotion: the scalar stays weak-typed, so
+# int array + 1.5 promotes to float (the legacy _plus_scalar kernels cast
+# scalar AND result to the data dtype — reference legacy semantics, wrong
+# here; ref: np_elemwise_broadcast_op.cc scalar registrations)
+for _sname, _sfn in [("add", jnp.add), ("subtract", jnp.subtract),
+                     ("multiply", jnp.multiply), ("mod", jnp.mod),
+                     ("power", jnp.power)]:
+    register_op(f"_npi_{_sname}_scalar")(
+        (lambda f: lambda data, scalar=1.0: f(data, scalar))(_sfn))
+for _sname, _sfn in [("rsubtract", jnp.subtract), ("rmod", jnp.mod),
+                     ("rpower", jnp.power)]:
+    register_op(f"_npi_{_sname}_scalar")(
+        (lambda f: lambda data, scalar=1.0: f(scalar, data))(_sfn))
+
+register_op("_npi_logical_not", differentiable=False)(
+    lambda data: jnp.logical_not(data))  # bool result (legacy keeps dtype)
 register_op("_npi_true_divide_scalar")(
     lambda data, scalar=1.0: jnp.true_divide(data, scalar))
 register_op("_npi_rtrue_divide_scalar")(

@@ -219,3 +219,218 @@ def test_np_ndarray_scalar_dunders_and_methods():
     assert onp.allclose(a.cumsum().asnumpy(), [1, 3, 6])
     assert a.as_np_ndarray() is a
     assert onp.allclose(a.flip().asnumpy(), [3, 2, 1])
+
+
+# ---------------------------------------------------------------------------
+# Semantics tier (VERDICT r2 item 4): zero-dim, np-shape switch, boolean
+# indexing, dtype promotion — each case executed against real NumPy.
+# ref: python/mxnet/util.py:53-132 (np_shape/np_array switches),
+# python/mxnet/numpy/multiarray.py (__getitem__ advanced modes,
+# promotion via the _npi_ kernels).
+# ---------------------------------------------------------------------------
+
+class TestNumpySemantics:
+    def test_zero_dim_arithmetic_and_rank(self):
+        a0 = np.array(3.5)
+        assert a0.shape == () and a0.ndim == 0 and a0.size == 1
+        out = a0 * np.array(2.0) + 1.0
+        assert out.shape == ()
+        assert float(out.asscalar()) == pytest.approx(8.0)
+        # reduction of a 0-d is a 0-d
+        assert np.sum(a0).shape == ()
+        # 0-d broadcasts against any rank like numpy
+        v = np.array([1.0, 2.0])
+        _chk(a0 + v, onp.float32(3.5) + onp.asarray([1.0, 2.0], "float32"))
+
+    def test_zero_size_dims_under_np_shape(self):
+        with mx.util.np_shape(True):
+            z = np.zeros((0, 4))
+            assert z.shape == (0, 4) and z.size == 0
+            s = np.sum(z, axis=0)
+            assert s.shape == (4,)
+            _chk(s, onp.zeros((4,), "float32"))
+            c = np.concatenate([z, np.ones((2, 4))], axis=0)
+            assert c.shape == (2, 4)
+
+    def test_boolean_mask_getitem(self):
+        x = rs.randn(4, 5).astype("float32")
+        m = x > 0
+        _chk(np.array(x)[np.array(m)], x[m])
+        # 1-d mask over axis 0
+        row_m = onp.array([True, False, True, False])
+        _chk(np.array(x)[np.array(row_m)], x[row_m])
+
+    def test_boolean_setitem(self):
+        x = rs.randn(6).astype("float32")
+        want = x.copy()
+        want[want < 0] = 0.0
+        got = np.array(x)
+        got[got < 0] = 0.0
+        _chk(got, want)
+
+    def test_advanced_integer_indexing(self):
+        x = rs.randn(4, 5).astype("float32")
+        idx = onp.array([2, 0, 3])
+        _chk(np.array(x)[np.array(idx)], x[idx])
+        _chk(np.array(x)[np.array(idx), np.array(idx)], x[idx, idx])
+        _chk(np.array(x)[1:, ::2], x[1:, ::2])
+        _chk(np.array(x)[..., -1], x[..., -1])
+        _chk(np.array(x)[None, 1], x[None, 1])
+
+    @pytest.mark.parametrize("da,db", [
+        ("int32", "float32"), ("int8", "int32"), ("uint8", "int8"),
+        ("float16", "float32"), ("int8", "float16"), ("bool", "int32"),
+    ])
+    def test_dtype_promotion_matches_numpy(self, da, db):
+        a = onp.ones((2, 2), da)
+        b = onp.ones((2, 2), db)
+        got = np.array(a) + np.array(b)
+        want = a + b
+        # numpy promotion modulo 32-bit canonicalization (x64 disabled:
+        # f64->f32, i64->i32 — the documented mx.np default, same as jax)
+        want_dt = {onp.dtype("float64"): onp.dtype("float32"),
+                   onp.dtype("int64"): onp.dtype("int32"),
+                   onp.dtype("uint64"): onp.dtype("uint32")}.get(
+                       want.dtype, want.dtype)
+        assert got.dtype == want_dt, (got.dtype, want_dt)
+        _chk(got, want.astype(want_dt))
+
+    def test_wide_int_plus_f16_keeps_float_width(self):
+        # documented divergence: numpy widens int32+f16 -> f64; the XLA
+        # lattice (value-independent, TPU-friendly) keeps the float's
+        # width. Pin it so a silent change is caught.
+        a = np.array(onp.ones((2,), "int32"))
+        b = np.array(onp.ones((2,), "float16"))
+        assert (a + b).dtype == onp.float16
+
+    def test_python_scalar_promotion_is_weak(self):
+        # numpy 2 / jax weak typing: int8 + python int stays int8,
+        # float32 + python float stays float32
+        a = np.array(onp.ones((2,), "int8"))
+        assert (a + 1).dtype == onp.int8
+        f = np.array(onp.ones((2,), "float32"))
+        assert (f + 1.5).dtype == onp.float32
+
+    def test_true_divide_ints_gives_float(self):
+        a = onp.asarray([7, 2], "int32")
+        b = onp.asarray([2, 2], "int32")
+        got = np.array(a) / np.array(b)
+        assert got.dtype == onp.float32  # x64 disabled: f32 not f64
+        assert onp.allclose(got.asnumpy(), [3.5, 1.0])
+
+    def test_mod_follows_python_sign(self):
+        a = onp.asarray([-7.0, 7.0], "float32")
+        b = onp.asarray([3.0, -3.0], "float32")
+        _chk(np.mod(np.array(a), np.array(b)), onp.mod(a, b))
+
+    def test_npi_alias_names_reachable_from_nd(self):
+        # symbol-JSON / C-ABI clients address the internal _npi_* names
+        from mxnet_tpu import nd
+        a = nd.array(onp.asarray([[1.0, -2.0]], "float32"))
+        assert onp.allclose(nd._npi_absolute(a).asnumpy(), [[1.0, 2.0]])
+        assert onp.allclose(
+            nd._npi_subtract(a, a).asnumpy(), [[0.0, 0.0]])
+        assert onp.allclose(
+            nd._npi_rsubtract_scalar(a, 1.0).asnumpy(), [[0.0, 3.0]])
+        got = nd._npi_logical_not(a).asnumpy()
+        assert onp.allclose(got, [[0.0, 0.0]])
+
+
+def test_image_io_registry_ops(tmp_path):
+    """_cvimdecode/_cvimread as REGISTRY ops (ref: src/io/image_io.cc
+    registers them via NNVM, not just as Python helpers) — addressable
+    by symbol-JSON / C-ABI clients through the op table."""
+    import io as pyio
+    from PIL import Image
+    from mxnet_tpu.ops.registry import get_op, has_op
+
+    for name in ("_cvimdecode", "_npi_cvimdecode",
+                 "_cvimread", "_npi_cvimread"):
+        assert has_op(name)
+
+    img = rs.randint(0, 255, (8, 6, 3)).astype(onp.uint8)
+    buf = pyio.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    raw = onp.frombuffer(buf.getvalue(), dtype=onp.uint8)
+    out = get_op("_cvimdecode").fn(onp.asarray(raw))
+    assert out.shape == (8, 6, 3)
+    assert onp.array_equal(onp.asarray(out), img)  # PNG lossless
+
+    fn = tmp_path / "t.png"
+    Image.fromarray(img).save(fn)
+    out2 = get_op("_cvimread").fn(filename=str(fn))
+    assert onp.array_equal(onp.asarray(out2), img)
+
+
+def test_npi_scalar_ops_promote_like_numpy():
+    """_npi_*_scalar must keep the scalar weak-typed (int array + 1.5 ->
+    float), unlike the legacy _plus_scalar kernels which cast scalar and
+    result to the data dtype."""
+    from mxnet_tpu import nd
+    a = nd.array(onp.asarray([5, 2], "int32"))
+    got = nd._npi_add_scalar(a, 1.5)
+    assert got.dtype == onp.float32, got.dtype
+    assert onp.allclose(got.asnumpy(), [6.5, 3.5])
+    # legacy kernel keeps the reference's cast-to-data-dtype behavior
+    legacy = nd._plus_scalar(a, 1.5)
+    assert legacy.dtype == onp.int32
+    got = nd._npi_rpower_scalar(a, 2.5)
+    assert got.dtype == onp.float32
+    assert onp.allclose(got.asnumpy(), 2.5 ** onp.asarray([5.0, 2.0]))
+    nb = nd._npi_logical_not(a)
+    assert nb.dtype == onp.bool_
+    assert onp.array_equal(nb.asnumpy(), [False, False])
+
+
+def test_np_truediv_scalar_and_inplace_views():
+    a = np.array(onp.asarray([5, 2], "int32"))
+    got = a / 2.5
+    assert got.dtype == onp.float32
+    assert onp.allclose(got.asnumpy(), [2.0, 0.8])
+    got = 2.5 / np.array(onp.asarray([5], "int32"))
+    assert onp.allclose(got.asnumpy(), [0.5])
+    # /= rebinds in place so views/aliases observe it
+    x = np.array(onp.ones((4,), "float32"))
+    alias = x
+    x /= 2.0
+    assert onp.allclose(alias.asnumpy(), 0.5)
+
+
+def test_np_all_dunders_promote_weak_scalars():
+    """Every arithmetic dunder (not just /) keeps python scalars weak:
+    int array * 2.5 -> float, matching numpy — the legacy nd coercion
+    (cast scalar to array dtype) must not leak into mx.np."""
+    a = np.array(onp.asarray([5, 2], "int32"))
+    for op, want in [
+        (lambda v: v * 2.5, [12.5, 5.0]),
+        (lambda v: v + 1.5, [6.5, 3.5]),
+        (lambda v: v - 0.5, [4.5, 1.5]),
+        (lambda v: v ** 0.5, [5 ** 0.5, 2 ** 0.5]),
+        (lambda v: 2.5 * v, [12.5, 5.0]),
+        (lambda v: 10.5 - v, [5.5, 8.5]),
+    ]:
+        got = op(a)
+        assert got.dtype == onp.float32, got.dtype
+        assert onp.allclose(got.asnumpy(), want)
+    # comparisons: int arr > -2.5 must not truncate the scalar to -2
+    b = np.array(onp.asarray([-2, 0], "int32"))
+    assert onp.array_equal((b > -2.5).asnumpy(), [True, True])
+
+
+def test_np_inplace_same_kind_casting():
+    # float in place: result cast back to self dtype, aliases observe
+    x = np.array(onp.ones((3,), "float32") * 4)
+    alias = x
+    x /= 2.0
+    assert x.dtype == onp.float32
+    assert onp.allclose(alias.asnumpy(), 2.0)
+    x *= 1.5
+    assert onp.allclose(alias.asnumpy(), 3.0)
+    # int in place with a float result: numpy raises (same_kind rule)
+    y = np.array(onp.asarray([4, 2], "int32"))
+    with pytest.raises(TypeError):
+        y /= 2.0
+    with pytest.raises(TypeError):
+        y += 1.5
+    y += 1  # int result stays fine
+    assert onp.array_equal(y.asnumpy(), [5, 3])

@@ -270,6 +270,9 @@ SKIP = {
     "_Native": "legacy python-callback op — needs a callback handle",
     "_TensorRT": "explicit unsupported-backend stub (raises by design)",
     "_subgraph_xla": "internal contraction op — tests/test_aux_runtime.py",
+    "_cvimdecode": "host image decode needs real encoded bytes — "
+                   "covered in test_numpy_parity/test_image_io",
+    "_cvimread": "host file read needs a real image path — same coverage",
 }
 
 
