@@ -13,6 +13,24 @@ __version__ = "0.1.0"
 
 import os as _os
 
+# Escape hatch for EXTERNAL helper processes that must never open the
+# accelerator (embedding hosts, cluster sidecars): with
+# MXTPU_FORCE_CPU_BACKEND=1 in the environment, the jax platform list
+# is pinned to cpu BEFORE any import below could initialize a backend —
+# over a tunneled TPU a wedged transport would otherwise hang the
+# process at import time. In-repo helpers don't need it (package import
+# is backend-free since the RNG key went lazy; spawn DataLoader workers
+# pin the platform in _worker_entry), but the hatch is kept and tested
+# (tests/test_aux_runtime.py) for embedders.
+if _os.environ.get("MXTPU_FORCE_CPU_BACKEND") == "1":
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax as _jax_cpu
+
+    try:
+        _jax_cpu.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
 # Large-tensor support (ref: the INT64_TENSOR_SIZE build flag +
 # MXNET_USE_INT64_TENSOR_SIZE, docs/faq/env_var.md; tests/nightly/
 # test_large_array.py): int64 element indexing needs jax x64 mode,

@@ -2,18 +2,20 @@
 
 ref: python/mxnet/gluon/data/dataloader.py — multi-worker loading. The
 reference forks worker processes that share NDArrays through
-cpu_shared_storage + ForkingPickler (dataloader.py:27-71). Here worker
-processes are forked the same way and finished batches travel back
-through POSIX shared memory (multiprocessing.shared_memory — the
-cpu_shared storage role): the worker batchifies into numpy, copies into
-a shm segment, and the parent re-wraps without a queue-pickle of the
-bulk data. The device transfer (jax.device_put) happens exactly once,
-in the parent.
+cpu_shared_storage + ForkingPickler (dataloader.py:27-71). Here workers
+are SPAWNED (forking a JAX-initialized parent is unsafe — the runtime
+is multithreaded) with the dataset shipped pre-pickled, and finished
+batches travel back through POSIX shared memory
+(multiprocessing.shared_memory — the cpu_shared storage role): the
+worker batchifies into numpy, copies into a shm segment, and the parent
+re-wraps without a queue-pickle of the bulk data. The device transfer
+(jax.device_put) happens exactly once, in the parent.
 
 Workers run numpy-only code (datasets/transforms should return numpy) —
-the forked child never touches the XLA runtime, whose threadpools do
-not survive fork. `thread_pool=True` selects the in-process thread pool
-instead (useful when __getitem__ already releases the GIL).
+each child forces the CPU jax backend before the dataset unpickles, so
+a worker can never open (or hang on) the accelerator. `thread_pool=True`
+selects the in-process thread pool instead (useful when __getitem__
+already releases the GIL).
 """
 from __future__ import annotations
 
@@ -84,8 +86,33 @@ def _shm_decode(obj, opened):
     return obj
 
 
+def _worker_entry(dataset_bytes, batchify_bytes, task_q, res_q):
+    """Spawn-context child entry. The payloads arrive PICKLED so nothing
+    jax-backed materializes before this body forces the CPU backend —
+    a worker must never open the accelerator (slow init; over a tunneled
+    TPU a wedged transport would hang every worker). Spawn replaces the
+    previous fork context: forking a JAX-initialized parent is
+    documented-unsafe (os.fork + multithreaded runtime). Like torch's
+    spawn-mode DataLoader, user SCRIPTS must guard DataLoader
+    construction with `if __name__ == "__main__":` (the child re-imports
+    the main module at bootstrap)."""
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import cloudpickle
+    dataset = cloudpickle.loads(dataset_bytes)
+    batchify_fn = cloudpickle.loads(batchify_bytes)
+    # startup handshake: tells the parent this worker is fully
+    # operational, so the (long) spawn+import boot window is not
+    # charged against the per-batch timeout
+    res_q.put(("__ready__", None, None, None))
+    _worker_loop(dataset, batchify_fn, task_q, res_q)
+
+
 def _worker_loop(dataset, batchify_fn, task_q, res_q):
-    """Runs in the forked child: pull (seq, indices), batchify, ship via
+    """Runs in the worker child: pull (seq, indices), batchify, ship via
     shared memory (ref: dataloader.py worker_loop)."""
     # MXNET_MP_WORKER_NTHREADS caps per-worker decode threads
     # (ref: env_var.md:60 / MXNET_MP_OPENCV_NUM_THREADS)
@@ -104,9 +131,9 @@ def _worker_loop(dataset, batchify_fn, task_q, res_q):
                 import warnings
                 warnings.warn(
                     "DataLoader worker received NDArray items from the "
-                    "dataset; creating/reading XLA arrays in a forked "
-                    "worker can deadlock — return numpy from __getitem__ "
-                    "or use thread_pool=True")
+                    "dataset; worker-side XLA arrays live on the "
+                    "worker's CPU backend — return numpy from "
+                    "__getitem__ for zero-copy shm handoff")
             return x.asnumpy()
         return x
 
@@ -178,19 +205,38 @@ class DataLoader:
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=self._num_workers)
         elif self._num_workers > 0:
-            # real worker processes (ref: dataloader.py:27-71) — forked,
-            # results via shared memory
+            # real worker processes (ref: dataloader.py:27-71) — SPAWNED
+            # (forking a JAX-initialized parent is unsafe: the runtime
+            # is multithreaded), results via shared memory. Dataset and
+            # batchify_fn ship pre-pickled so the child can force its
+            # CPU backend before anything jax-backed unpickles.
+            # cloudpickle, not pickle: datasets/batchify fns defined in
+            # local scope (or as lambdas) must keep working under the
+            # spawn context the way they did under fork
+            import cloudpickle
             self._batchify_fn = batchify_fn or default_mp_batchify_fn
-            ctx = mp.get_context("fork")
+            # spawn, not fork: fork would clone the JAX-initialized
+            # (multithreaded) parent — documented-unsafe. Spawn requires
+            # the torch-style `if __name__ == "__main__"` guard in user
+            # scripts; a missing guard is detected and reported below.
+            ctx = mp.get_context("spawn")
             self._task_q = ctx.Queue()
             self._res_q = ctx.Queue()
+            dataset_bytes = cloudpickle.dumps(dataset)
+            batchify_bytes = cloudpickle.dumps(self._batchify_fn)
+            # _worker_entry forces the CPU backend before anything
+            # jax-backed unpickles; importing mxnet_tpu itself is
+            # backend-free (lazy RNG key), so no env mutation is needed
+            # — a global os.environ dance here would race concurrent
+            # spawns in other threads
             for _ in range(self._num_workers):
-                w = ctx.Process(target=_worker_loop,
-                                args=(dataset, self._batchify_fn,
+                w = ctx.Process(target=_worker_entry,
+                                args=(dataset_bytes, batchify_bytes,
                                       self._task_q, self._res_q),
                                 daemon=True)
                 w.start()
                 self._workers.append(w)
+            self._pending_ready = self._num_workers
         else:
             self._batchify_fn = batchify_fn or default_batchify_fn
 
@@ -258,16 +304,51 @@ class DataLoader:
             while received < sent:
                 while received not in buffered:
                     import queue as _queue
-                    try:
-                        e, seq, payload, err = self._res_q.get(
-                            timeout=self._timeout)
-                    except _queue.Empty:
-                        dead = [w.pid for w in self._workers
-                                if not w.is_alive()]
-                        raise RuntimeError(
-                            f"DataLoader timed out after {self._timeout}s"
-                            + (f"; worker process(es) {dead} died "
-                               "(killed/crashed?)" if dead else ""))
+                    import time as _time
+                    # poll in short slices so dead workers surface
+                    # immediately instead of after the full timeout;
+                    # worker BOOT (spawn + fresh interpreter + imports)
+                    # gets its own generous window, charged only while
+                    # workers are alive-but-not-ready
+                    booting = self._pending_ready > 0
+                    deadline = _time.monotonic() + (
+                        max(self._timeout, 600) if booting
+                        else self._timeout)
+                    while True:
+                        try:
+                            e, seq, payload, err = self._res_q.get(
+                                timeout=min(
+                                    5.0, max(0.1, deadline
+                                             - _time.monotonic())))
+                            break
+                        except _queue.Empty:
+                            dead = [w.pid for w in self._workers
+                                    if not w.is_alive()]
+                            if dead and self._pending_ready > 0:
+                                raise RuntimeError(
+                                    "DataLoader worker process(es) "
+                                    f"{dead} died during startup — if "
+                                    "this is a script, DataLoader with "
+                                    "num_workers>0 must be created "
+                                    "under the `if __name__ == "
+                                    "'__main__':` guard (spawn start "
+                                    "method re-imports the main module)")
+                            if dead:
+                                # mid-epoch death: the task it held can
+                                # never complete — fail NOW, not after
+                                # the full timeout
+                                raise RuntimeError(
+                                    f"DataLoader worker process(es) "
+                                    f"{dead} died mid-epoch (killed/"
+                                    "OOM?); in-flight batches are lost")
+                            if _time.monotonic() >= deadline:
+                                raise RuntimeError(
+                                    "DataLoader timed out after "
+                                    f"{self._timeout}s")
+                            continue
+                    if e == "__ready__":
+                        self._pending_ready -= 1
+                        continue
                     if e != epoch:  # stale result, abandoned epoch
                         if payload is not None:
                             self._discard_payload(payload)

@@ -218,6 +218,21 @@ class NDArray:
     def asnumpy(self) -> onp.ndarray:
         return onp.asarray(self._data)
 
+    # pickling (ref: ndarray.py __getstate__/__setstate__ — NDArrays are
+    # picklable by value). Device placement is NOT serialized: the array
+    # re-materializes on the current default device, so spawn-context
+    # DataLoader workers (which force the CPU backend before unpickling)
+    # never touch an accelerator.
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "grad_req": self._grad_req}
+
+    def __setstate__(self, state):
+        self._data = jnp.asarray(state["data"])
+        self._grad = None
+        self._grad_req = state.get("grad_req", "null")
+        self._pending_grad = None
+        self._writeback = None
+
     def asscalar(self):
         if self.size != 1:
             raise ValueError("The current array is not a scalar")

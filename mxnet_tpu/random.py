@@ -19,10 +19,25 @@ import numpy as onp
 
 
 class _RNGState(threading.local):
+    """LAZY root key: creating a jax key materializes a device array,
+    which initializes the backend — far too early at import time (it
+    wedges helper processes that must pick their platform first, e.g.
+    spawn DataLoader workers over a hung accelerator tunnel)."""
+
     def __init__(self):
-        self.key = jax.random.key(0)
+        self._key = None
         self.trace_key = None
         self.trace_counter = 0
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(0)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
 
 
 _STATE = _RNGState()

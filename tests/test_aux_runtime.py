@@ -420,3 +420,22 @@ def test_operator_tune_choice_override(monkeypatch):
     monkeypatch.setenv("MXNET_OPTUNE_CHOICE_DEMO_CHOICE", "nope")
     with pytest.raises(ValueError, match="does not match"):
         ot.choose("demo_choice", cands, jnp.ones(3))
+
+
+def test_force_cpu_backend_env_pins_platform():
+    """MXTPU_FORCE_CPU_BACKEND=1 pins the jax platform list to cpu
+    BEFORE any mxnet_tpu import can initialize a backend — the escape
+    hatch for external helper processes embedding the framework
+    (mxnet_tpu/__init__.py head)."""
+    import subprocess
+    import sys
+    code = ("import mxnet_tpu, jax; "
+            "assert all(d.platform == 'cpu' for d in jax.devices()), "
+            "jax.devices(); print('CPU_PINNED')")
+    env = dict(os.environ)
+    env["MXTPU_FORCE_CPU_BACKEND"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0 and "CPU_PINNED" in r.stdout, \
+        (r.stdout + r.stderr)[-1500:]
