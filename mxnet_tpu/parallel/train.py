@@ -162,7 +162,12 @@ class ParallelTrainer:
         self.param_names = [n for n, _ in plist]
         self._param_objs = dict(plist)
         self.trainable = {n for n, p in plist if p.grad_req != "null"}
-        params = {n: p.data()._data for n, p in plist}
+        # COPY, never alias: step() donates params to XLA (buffer reuse),
+        # which deletes the donated arrays — aliasing the block's own
+        # buffers here would leave every gluon Parameter pointing at a
+        # deleted array after the first step (eager net(...) calls and
+        # any second trainer over the same block would crash)
+        params = {n: jnp.copy(p.data()._data) for n, p in plist}
         self.params = params
         self.opt_state = self._init_fn(
             {n: v for n, v in params.items() if n in self.trainable},

@@ -256,3 +256,51 @@ def test_ring_attention_negative_block_size_rejected():
     with pytest.raises(Exception):
         onp.asarray(ring_attention(x, x, x, mesh, seq_axis="seq",
                                    block_size=-2))
+
+
+def test_dp_tp_sp_ep_matches_single_device():
+    """The full 8-device dp2 x tp2 x sp2 combination (with 2-expert MoE
+    FFNs = ep over the model axis) must reproduce the single-device loss
+    trajectory numerically — the same assertion dryrun_multichip makes
+    for the driver (ref: tests/nightly/dist_sync_kvstore.py asserts
+    numerical equality, not finiteness)."""
+    from mxnet_tpu.models import TransformerLM, tensor_parallel_shardings
+    from mxnet_tpu.parallel import expert_parallel_shardings
+    from mxnet_tpu import random as mxrand
+
+    dp, tp, sp = 2, 2, 2
+    B, T, V = 2 * dp, 8 * sp, 64
+    net = TransformerLM(vocab_size=V, units=32, num_layers=2, num_heads=8,
+                        hidden_size=64, max_len=T, causal=True,
+                        num_experts=2)
+    net.initialize()
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    class LMLoss(gluon.HybridBlock):
+        def hybrid_forward(self, F, logits, labels):
+            return loss_fn(logits.reshape((-1, V)), labels.reshape((-1,)))
+
+    rs = onp.random.RandomState(3)
+    tokens = nd.array(rs.randint(0, V, size=(B, T)), dtype="int32")
+    labels = nd.array(rs.randint(0, V, size=(B, T)), dtype="float32")
+
+    mxrand.seed(11)
+    ref = ParallelTrainer(net, LMLoss(), optimizer="adam",
+                          optimizer_params={"learning_rate": 1e-3})
+    ref_losses = [float(ref.step(tokens, labels).asscalar())
+                  for _ in range(3)]
+
+    mesh = make_mesh({"data": dp, "model": tp, "seq": sp})
+    net.set_context_parallel(mesh, seq_axis="seq", strategy="ring",
+                             block_size=4)
+    specs = {}
+    specs.update(tensor_parallel_shardings(net, model_axis="model"))
+    specs.update(expert_parallel_shardings(net, expert_axis="model"))
+    mxrand.seed(11)
+    tr = ParallelTrainer(net, LMLoss(), optimizer="adam",
+                         optimizer_params={"learning_rate": 1e-3},
+                         mesh=mesh, param_shardings=specs)
+    losses = [float(tr.step(tokens, labels).asscalar()) for _ in range(3)]
+    assert onp.allclose(losses, ref_losses, rtol=5e-3, atol=5e-4), \
+        (losses, ref_losses)
