@@ -16,9 +16,15 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#ifdef __linux__
+#include <dlfcn.h>
+#endif
+
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -28,7 +34,10 @@ extern "C" {
 
 namespace {
 
-std::mutex g_mutex;
+// RECURSIVE: C callbacks invoked from inside an ABI call (the kvstore
+// updater) legitimately call back into MX* on the same thread; a plain
+// mutex would self-deadlock there. PyGILState_Ensure nests fine too.
+std::recursive_mutex g_mutex;
 // per-thread last error, like the reference's thread-local error ring
 // (src/c_api/c_api_error.cc) — readable without locks
 thread_local std::string g_last_error;
@@ -87,6 +96,24 @@ std::string fetch_py_error() {
 bool ensure_backend() {
   if (g_backend) return true;
   if (!Py_IsInitialized()) {
+    // Hosts that dlopen this library WITHOUT RTLD_GLOBAL (perl XSLoader,
+    // Java JNI, lua...) leave libpython's symbols local — python C
+    // extension modules (numpy's _multiarray_umath, ...) then fail to
+    // resolve them and numpy dies with a misleading "source directory"
+    // error. Re-open libpython with RTLD_GLOBAL|RTLD_NOLOAD to promote
+    // the already-mapped library's symbols.
+#ifdef __linux__
+    {
+      char pylib[64];
+      snprintf(pylib, sizeof(pylib), "libpython%d.%d.so.1.0",
+               PY_MAJOR_VERSION, PY_MINOR_VERSION);
+      if (!dlopen(pylib, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD)) {
+        snprintf(pylib, sizeof(pylib), "libpython%d.%d.so",
+                 PY_MAJOR_VERSION, PY_MINOR_VERSION);
+        dlopen(pylib, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+      }
+    }
+#endif
     Py_InitializeEx(0);  // no signal handlers: stay a polite guest library
     // Py_InitializeEx leaves this thread holding the GIL; hand it back so
     // every entry point can use the PyGILState API uniformly
@@ -127,7 +154,7 @@ extern "C" {
 const char *MXGetLastError(void) { return g_last_error.c_str(); }
 
 int MXGetVersion(int *out) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   if (!ensure_backend()) return -1;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *ret = call_backend("version", PyTuple_New(0));
@@ -142,7 +169,7 @@ int MXGetVersion(int *out) {
 }
 
 int MXListAllOpNames(uint32_t *out_size, const char ***out_array) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   if (!ensure_backend()) return -1;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *ret = call_backend("list_op_names", PyTuple_New(0));
@@ -167,7 +194,7 @@ static int pred_create_impl(const char *symbol_json_str,
                             const uint32_t *input_shape_data,
                             uint32_t num_output_nodes,
                             const char **output_keys, PredictorHandle *out) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   if (!ensure_backend()) return -1;
   PyGILState_STATE gil = PyGILState_Ensure();
 
@@ -232,7 +259,7 @@ int MXPredCreatePartialOut(const char *symbol_json_str,
 }
 
 int MXPredGetOutputCount(PredictorHandle handle, uint32_t *out) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   auto *p = static_cast<Predictor *>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *ret = call_backend("num_outputs", Py_BuildValue("(l)", p->handle));
@@ -248,7 +275,7 @@ int MXPredGetOutputCount(PredictorHandle handle, uint32_t *out) {
 
 int MXPredSetInput(PredictorHandle handle, const char *key,
                    const float *data, uint32_t size) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   auto *p = static_cast<Predictor *>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   // shape [] → backend reshapes to the declared input shape; we pass the
@@ -267,7 +294,7 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
 }
 
 int MXPredForward(PredictorHandle handle) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   auto *p = static_cast<Predictor *>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *ret = call_backend("forward", Py_BuildValue("(l)", p->handle));
@@ -283,7 +310,7 @@ int MXPredForward(PredictorHandle handle) {
 
 int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
                          uint32_t **shape_data, uint32_t *shape_ndim) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   auto *p = static_cast<Predictor *>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *ret = call_backend("get_output_shape",
@@ -308,7 +335,7 @@ int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
 
 int MXPredGetOutput(PredictorHandle handle, uint32_t index, float *data,
                     uint32_t size) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   auto *p = static_cast<Predictor *>(handle);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *ret = call_backend("get_output",
@@ -336,7 +363,7 @@ int MXPredGetOutput(PredictorHandle handle, uint32_t index, float *data,
 }
 
 int MXPredFree(PredictorHandle handle) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   auto *p = static_cast<Predictor *>(handle);
   if (!p) return 0;
   PyGILState_STATE gil = PyGILState_Ensure();
@@ -381,10 +408,25 @@ void *as_handle(long id) {
   return reinterpret_cast<void *>(static_cast<intptr_t>(id));
 }
 
+// executor monitor callbacks (MXExecutorSetMonitorCallback): keyed by
+// executor handle, fired per output after each MXExecutorForward
+typedef void (*ExecutorMonitorCallback_)(const char *, void *, void *);
+std::map<void *, std::pair<ExecutorMonitorCallback_, void *>> g_monitors;
+
+void fire_monitors(void *exec_handle, uint32_t n, void **outputs) {
+  auto it = g_monitors.find(exec_handle);
+  if (it == g_monitors.end()) return;
+  char name[32];
+  for (uint32_t i = 0; i < n; ++i) {
+    snprintf(name, sizeof(name), "output%u", i);
+    it->second.first(name, outputs[i], it->second.second);
+  }
+}
+
 // run fn under lock+GIL; fn returns new ref or nullptr
 template <typename F>
 int with_backend(F &&fn) {
-  std::lock_guard<std::mutex> lk(g_mutex);
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
   if (!ensure_backend()) return -1;
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = fn() ? 0 : -1;
@@ -699,6 +741,7 @@ int MXExecutorForward(void *handle, int is_train, uint32_t *out_size,
     Py_DECREF(ret);
     *out_size = static_cast<uint32_t>(n);
     *outputs = g_handle_buf.data();
+    fire_monitors(handle, static_cast<uint32_t>(n), g_handle_buf.data());
     return true;
   });
 }
@@ -1247,3 +1290,2300 @@ int MXNotifyShutdown(void) {
 }
 
 }  // extern "C"
+
+/* ------------------------------------------------------------------------
+ * Round-3 ABI completion (ref: include/mxnet/c_api.h): CachedOp, symbol
+ * attrs/structure, executor simple_bind/reshape/outputs, autograd extras,
+ * kvstore updater + node roles, profiler objects, RecordIO, legacy
+ * Function API, ndarray extras + 64-bit variants, quantization passes,
+ * misc. CUDA-only families (MXRtc*, TVM) export honest unsupported
+ * errors, mirroring the reference's disabled-build-flag behavior.
+ * --------------------------------------------------------------------- */
+
+namespace {
+
+// marshal a vector of python ints into the thread-local handle buffer
+bool ret_handle_vec(PyObject *ret, int *num, void ***out) {
+  if (!ret) return false;
+  Py_ssize_t n = PyList_Check(ret) ? PyList_Size(ret) : 0;
+  g_handle_buf.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_handle_buf[i] = as_handle(PyLong_AsLong(PyList_GetItem(ret, i)));
+  Py_DECREF(ret);
+  if (num) *num = static_cast<int>(n);
+  if (out) *out = g_handle_buf.data();
+  return true;
+}
+
+// (exec, args, grads, aux) quad returned by simple_bind / reshape
+thread_local std::vector<void *> g_bind_args, g_bind_grads, g_bind_aux;
+
+bool ret_bind_quad(PyObject *ret, void **exec_out, uint32_t *num_args,
+                   void ***args_out, void ***grads_out, uint32_t *num_aux,
+                   void ***aux_out) {
+  if (!ret) return false;
+  PyObject *eh = PyTuple_GetItem(ret, 0);
+  PyObject *args = PyTuple_GetItem(ret, 1);
+  PyObject *grads = PyTuple_GetItem(ret, 2);
+  PyObject *aux = PyTuple_GetItem(ret, 3);
+  auto fill = [](PyObject *l, std::vector<void *> &buf) {
+    Py_ssize_t n = PyList_Size(l);
+    buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      buf[i] = as_handle(PyLong_AsLong(PyList_GetItem(l, i)));
+    return static_cast<uint32_t>(n);
+  };
+  uint32_t na = fill(args, g_bind_args);
+  fill(grads, g_bind_grads);
+  uint32_t nx = fill(aux, g_bind_aux);
+  *exec_out = as_handle(PyLong_AsLong(eh));
+  Py_DECREF(ret);
+  if (num_args) *num_args = na;
+  if (args_out) *args_out = g_bind_args.data();
+  if (grads_out) *grads_out = g_bind_grads.data();
+  if (num_aux) *num_aux = nx;
+  if (aux_out) *aux_out = g_bind_aux.data();
+  return true;
+}
+
+PyObject *shape_list64(const int64_t *shape, uint32_t ndim) {
+  PyObject *s = PyList_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyList_SetItem(s, i, PyLong_FromLongLong(shape[i]));
+  return s;
+}
+
+thread_local std::vector<int64_t> g_shape64_buf;
+thread_local std::vector<std::string> g_attr_buf;
+thread_local std::vector<const char *> g_attr_ptr_buf;
+thread_local std::string g_bytes_buf;
+
+int unsupported(const char *what, const char *hint) {
+  set_error(std::string(what) +
+            " is not supported on the TPU backend: " + hint);
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+/* -- CachedOp (ref: c_api_ndarray.cc MXCreateCachedOpEx/MXInvokeCachedOp) */
+
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(
+        call_backend("cachedop_create",
+                     pack_steal(PyLong_FromLong(as_id(sym)),
+                                PyList_New(0), PyList_New(0))),
+        out);
+  });
+}
+
+int MXCreateCachedOpEx(SymbolHandle sym, int num_flags, const char **keys,
+                       const char **vals, CachedOpHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(
+        call_backend("cachedop_create",
+                     pack_steal(PyLong_FromLong(as_id(sym)),
+                                string_list(num_flags, keys),
+                                string_list(num_flags, vals))),
+        out);
+  });
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs, void **inputs,
+                     int *num_outputs, void ***outputs) {
+  return with_backend([&]() -> bool {
+    return ret_handle_vec(
+        call_backend("cachedop_invoke",
+                     pack_steal(PyLong_FromLong(as_id(handle)),
+                                handle_list(num_inputs, inputs))),
+        num_outputs, outputs);
+  });
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs, void **inputs,
+                       int *num_outputs, void ***outputs,
+                       const int **out_stypes) {
+  static thread_local std::vector<int> stypes;
+  int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                            outputs);
+  if (rc == 0) {
+    stypes.assign(static_cast<size_t>(*num_outputs), 0);  // dense
+    *out_stypes = stypes.data();
+  }
+  return rc;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "cachedop_free", pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+/* -- symbol attrs / structure ----------------------------------------- */
+
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *success) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "symbol_get_attr",
+        pack_steal(PyLong_FromLong(as_id(sym)), PyUnicode_FromString(key)));
+    if (!ret) return false;
+    const char *s = PyUnicode_AsUTF8(PyTuple_GetItem(ret, 0));
+    g_str_buf = s ? s : "";
+    *success = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(ret, 1)));
+    *out = *success ? g_str_buf.c_str() : nullptr;
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "symbol_set_attr",
+        pack_steal(PyLong_FromLong(as_id(sym)), PyUnicode_FromString(key),
+                   PyUnicode_FromString(value))));
+  });
+}
+
+static int list_attr_impl(const char *fn, SymbolHandle sym, uint32_t *out_size,
+                          const char ***out) {
+  return with_backend([&]() -> bool {
+    PyObject *ret =
+        call_backend(fn, pack_steal(PyLong_FromLong(as_id(sym))));
+    if (!ret) return false;
+    load_string_list(ret, g_attr_buf, g_attr_ptr_buf);
+    *out_size = static_cast<uint32_t>(g_attr_buf.size() / 2);
+    *out = g_attr_ptr_buf.data();
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXSymbolListAttr(SymbolHandle sym, uint32_t *out_size,
+                     const char ***out) {
+  return list_attr_impl("symbol_list_attr", sym, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle sym, uint32_t *out_size,
+                            const char ***out) {
+  return list_attr_impl("symbol_list_attr_shallow", sym, out_size, out);
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle sym, uint32_t *out) {
+  return with_backend([&]() -> bool {
+    int v = 0;
+    if (!ret_int(call_backend("symbol_get_num_outputs",
+                              pack_steal(PyLong_FromLong(as_id(sym)))),
+                 &v))
+      return false;
+    *out = static_cast<uint32_t>(v);
+    return true;
+  });
+}
+
+int MXSymbolGetOutput(SymbolHandle sym, uint32_t index, SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(
+        call_backend("symbol_get_output",
+                     pack_steal(PyLong_FromLong(as_id(sym)),
+                                PyLong_FromUnsignedLong(index))),
+        out);
+  });
+}
+
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "symbol_get_children", pack_steal(PyLong_FromLong(as_id(sym)))),
+        out);
+  });
+}
+
+int MXSymbolPrint(SymbolHandle sym, const char **out_str) {
+  return with_backend([&]() -> bool {
+    return ret_string(call_backend(
+        "symbol_print", pack_steal(PyLong_FromLong(as_id(sym)))), out_str);
+  });
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend("symbol_create_from_file",
+                                   pack_steal(PyUnicode_FromString(fname))),
+                      out);
+  });
+}
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "symbol_save_to_file",
+        pack_steal(PyLong_FromLong(as_id(sym)),
+                   PyUnicode_FromString(fname))));
+  });
+}
+
+int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend("symbol_create_group",
+                                   pack_steal(handle_list(num_symbols,
+                                                          symbols))),
+                      out);
+  });
+}
+
+int MXGenAtomicSymbolFromSymbol(SymbolHandle sym, SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "gen_atomic_symbol_from_symbol",
+        pack_steal(PyLong_FromLong(as_id(sym)))), out);
+  });
+}
+
+int MXSymbolRemoveAmpCast(SymbolHandle sym, SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "symbol_remove_amp_cast",
+        pack_steal(PyLong_FromLong(as_id(sym)))), out);
+  });
+}
+
+int MXShallowCopySymbol(SymbolHandle sym, SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "shallow_copy_symbol",
+        pack_steal(PyLong_FromLong(as_id(sym)))), out);
+  });
+}
+
+int MXShallowCopyNDArray(NDArrayHandle nd, NDArrayHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "shallow_copy_ndarray",
+        pack_steal(PyLong_FromLong(as_id(nd)))), out);
+  });
+}
+
+int MXSymbolGrad(SymbolHandle sym, uint32_t num_wrt, const char **wrt,
+                 SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "symbol_grad", pack_steal(PyLong_FromLong(as_id(sym)),
+                                  string_list(num_wrt, wrt))), out);
+  });
+}
+
+/* -- infer shape/type partial + 64-bit ------------------------------- */
+
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const uint32_t *arg_ind_ptr, const uint32_t *arg_shape_data,
+    uint32_t *in_shape_size, const uint32_t **in_shape_ndim,
+    const uint32_t ***in_shape_data, uint32_t *out_shape_size,
+    const uint32_t **out_shape_ndim, const uint32_t ***out_shape_data,
+    uint32_t *aux_shape_size, const uint32_t **aux_shape_ndim,
+    const uint32_t ***aux_shape_data, int *complete) {
+  return with_backend([&]() -> bool {
+    PyObject *names = PyList_New(num_args);
+    PyObject *shapes = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyList_SetItem(names, i, PyUnicode_FromString(keys[i]));
+      uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+      PyObject *shp = PyList_New(hi - lo);
+      for (uint32_t j = lo; j < hi; ++j)
+        PyList_SetItem(shp, j - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+      PyList_SetItem(shapes, i, shp);
+    }
+    PyObject *ret = call_backend(
+        "symbol_infer_shape_partial",
+        pack_steal(PyLong_FromLong(as_id(sym)), names, shapes));
+    if (!ret) return false;
+    g_in_shapes.load(PyTuple_GetItem(ret, 0));
+    g_out_shapes.load(PyTuple_GetItem(ret, 1));
+    g_aux_shapes.load(PyTuple_GetItem(ret, 2));
+    Py_DECREF(ret);
+    *in_shape_size = static_cast<uint32_t>(g_in_shapes.ndim.size());
+    *in_shape_ndim = g_in_shapes.ndim.data();
+    *in_shape_data = g_in_shapes.ptrs.data();
+    *out_shape_size = static_cast<uint32_t>(g_out_shapes.ndim.size());
+    *out_shape_ndim = g_out_shapes.ndim.data();
+    *out_shape_data = g_out_shapes.ptrs.data();
+    *aux_shape_size = static_cast<uint32_t>(g_aux_shapes.ndim.size());
+    *aux_shape_ndim = g_aux_shapes.ndim.data();
+    *aux_shape_data = g_aux_shapes.ptrs.data();
+    // complete only when EVERY shape (args, outputs, aux) is known —
+    // partial callers allocate buffers from these rows
+    bool all_known = true;
+    for (auto *g : {&g_in_shapes, &g_out_shapes, &g_aux_shapes})
+      for (auto &r : g->rows) all_known &= !r.empty();
+    *complete = all_known ? 1 : 0;
+    return true;
+  });
+}
+
+int MXSymbolInferTypePartial(SymbolHandle sym, uint32_t num_args,
+                             const char **keys, const char **arg_dtypes,
+                             uint32_t *in_type_size,
+                             const char ***in_type_data,
+                             uint32_t *out_type_size,
+                             const char ***out_type_data,
+                             uint32_t *aux_type_size,
+                             const char ***aux_type_data) {
+  /* delegate to the strict variant (this ABI names dtypes, it does not
+   * use the reference int codes); on failure report incomplete */
+  int rc = MXSymbolInferType(sym, num_args, keys, arg_dtypes, in_type_size,
+                             in_type_data, out_type_size, out_type_data,
+                             aux_type_size, aux_type_data);
+  if (rc != 0) {
+    *in_type_size = *out_type_size = *aux_type_size = 0;
+    return 0;
+  }
+  return rc;
+}
+
+/* -- executor simple_bind / reshape / outputs -------------------------- */
+
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         uint32_t num_args, const char **arg_names,
+                         const uint32_t *arg_ind_ptr,
+                         const uint32_t *arg_shape_data, const char *grad_req,
+                         ExecutorHandle *out, uint32_t *num_arg_arrays,
+                         NDArrayHandle **arg_arrays,
+                         NDArrayHandle **grad_arrays, uint32_t *num_aux,
+                         NDArrayHandle **aux_arrays) {
+  return with_backend([&]() -> bool {
+    PyObject *names = PyList_New(num_args);
+    PyObject *shapes = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyList_SetItem(names, i, PyUnicode_FromString(arg_names[i]));
+      uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+      PyObject *shp = PyList_New(hi - lo);
+      for (uint32_t j = lo; j < hi; ++j)
+        PyList_SetItem(shp, j - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+      PyList_SetItem(shapes, i, shp);
+    }
+    return ret_bind_quad(
+        call_backend("executor_simple_bind",
+                     pack_steal(PyLong_FromLong(as_id(sym)),
+                                PyLong_FromLong(dev_type),
+                                PyLong_FromLong(dev_id), names, shapes,
+                                PyUnicode_FromString(grad_req))),
+        out, num_arg_arrays, arg_arrays, grad_arrays, num_aux, aux_arrays);
+  });
+}
+
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing, int dev_type,
+                      int dev_id, uint32_t num_args, const char **arg_names,
+                      const uint32_t *arg_ind_ptr,
+                      const uint32_t *arg_shape_data,
+                      ExecutorHandle shared_exec, ExecutorHandle *out,
+                      uint32_t *num_arg_arrays, NDArrayHandle **arg_arrays,
+                      NDArrayHandle **grad_arrays, uint32_t *num_aux,
+                      NDArrayHandle **aux_arrays) {
+  (void)dev_type;
+  (void)dev_id;
+  return with_backend([&]() -> bool {
+    PyObject *names = PyList_New(num_args);
+    PyObject *shapes = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyList_SetItem(names, i, PyUnicode_FromString(arg_names[i]));
+      uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+      PyObject *shp = PyList_New(hi - lo);
+      for (uint32_t j = lo; j < hi; ++j)
+        PyList_SetItem(shp, j - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+      PyList_SetItem(shapes, i, shp);
+    }
+    return ret_bind_quad(
+        call_backend("executor_reshape",
+                     pack_steal(PyLong_FromLong(as_id(shared_exec)), names,
+                                shapes, PyLong_FromLong(partial_shaping),
+                                PyLong_FromLong(allow_up_sizing))),
+        out, num_arg_arrays, arg_arrays, grad_arrays, num_aux, aux_arrays);
+  });
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, uint32_t *out_size,
+                      NDArrayHandle **out) {
+  return with_backend([&]() -> bool {
+    int n = 0;
+    if (!ret_handle_vec(
+            call_backend("executor_outputs",
+                         pack_steal(PyLong_FromLong(as_id(handle)))),
+            &n, out))
+      return false;
+    *out_size = static_cast<uint32_t>(n);
+    return true;
+  });
+}
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  return with_backend([&]() -> bool {
+    return ret_string(call_backend(
+        "executor_print", pack_steal(PyLong_FromLong(as_id(handle)))),
+        out_str);
+  });
+}
+
+int MXExecutorGetOptimizedSymbol(ExecutorHandle handle, SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "executor_get_optimized_symbol",
+        pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+/* monitor callback: invoked per executor output after each forward
+ * (simplified relative to the reference's per-op hook — the XLA graph
+ * has no per-op boundary to observe); storage + firing live beside the
+ * helpers (fire_monitors), called from MXExecutorForward. */
+typedef void (*ExecutorMonitorCallback)(const char *, NDArrayHandle, void *);
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  std::lock_guard<std::recursive_mutex> lk(g_mutex);
+  if (callback)
+    g_monitors[handle] = {
+        reinterpret_cast<ExecutorMonitorCallback_>(callback),
+        callback_handle};
+  else
+    g_monitors.erase(handle);
+  return 0;
+}
+
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle handle,
+                                   ExecutorMonitorCallback callback,
+                                   void *callback_handle, bool monitor_all) {
+  (void)monitor_all;
+  return MXExecutorSetMonitorCallback(handle, callback, callback_handle);
+}
+
+/* -- autograd extras --------------------------------------------------- */
+
+int MXAutogradBackwardEx(uint32_t num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, uint32_t num_variables,
+                         NDArrayHandle *var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes) {
+  return with_backend([&]() -> bool {
+    PyObject *ograds;
+    if (ograd_handles) {
+      ograds = handle_list(num_output, ograd_handles);
+    } else {
+      ograds = PyList_New(0);
+    }
+    int n = 0;
+    if (!ret_handle_vec(
+            call_backend(
+                "autograd_backward_ex",
+                pack_steal(handle_list(num_output, output_handles), ograds,
+                           handle_list(num_variables, var_handles),
+                           PyLong_FromLong(retain_graph),
+                           PyLong_FromLong(create_graph),
+                           PyLong_FromLong(is_train))),
+            &n, grad_handles))
+      return false;
+    if (grad_stypes) {
+      static thread_local std::vector<int> stypes;
+      stypes.assign(static_cast<size_t>(n), 0);
+      *grad_stypes = stypes.data();
+    }
+    return true;
+  });
+}
+
+int MXAutogradComputeGradient(uint32_t num_output,
+                              NDArrayHandle *output_handles) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "autograd_compute_gradient",
+        pack_steal(handle_list(num_output, output_handles))));
+  });
+}
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "autograd_get_symbol",
+        pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+/* -- kvstore updater / roles / commands -------------------------------- */
+
+typedef void (*MXKVStoreUpdater)(int, NDArrayHandle, NDArrayHandle, void *);
+typedef void (*MXKVStoreStrUpdater)(const char *, NDArrayHandle,
+                                    NDArrayHandle, void *);
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "kvstore_set_updater",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromVoidPtr(reinterpret_cast<void *>(updater)),
+                   PyLong_FromVoidPtr(updater_handle))));
+  });
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle) {
+  if (str_updater) {
+    return with_backend([&]() -> bool {
+      return ret_void(call_backend(
+          "kvstore_set_str_updater",
+          pack_steal(PyLong_FromLong(as_id(handle)),
+                     PyLong_FromVoidPtr(
+                         reinterpret_cast<void *>(str_updater)),
+                     PyLong_FromVoidPtr(updater_handle))));
+    });
+  }
+  return MXKVStoreSetUpdater(handle, updater, updater_handle);
+}
+
+int MXKVStoreIsWorkerNode(int *ret) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("kvstore_is_worker_node", PyTuple_New(0)),
+                   ret);
+  });
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("kvstore_is_server_node", PyTuple_New(0)),
+                   ret);
+  });
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("kvstore_is_scheduler_node",
+                                PyTuple_New(0)), ret);
+  });
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       void (*controller)(int, const char *, void *),
+                       void *controller_handle) {
+  (void)controller;
+  (void)controller_handle;
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "kvstore_run_server", pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "kvstore_send_command_to_servers",
+        pack_steal(PyLong_FromLong(as_id(handle)), PyLong_FromLong(cmd_id),
+                   PyUnicode_FromString(cmd_body))));
+  });
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "kvstore_set_barrier_before_exit",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromLong(barrier_before_exit))));
+  });
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend(
+        "kvstore_get_num_dead_node",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromLong(node_id))), number);
+  });
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, uint32_t num_params,
+                                    const char **keys, const char **vals) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "kvstore_set_gradient_compression",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   string_list(num_params, keys),
+                   string_list(num_params, vals))));
+  });
+}
+
+int MXInitPSEnv(uint32_t num_vars, const char **keys, const char **vals) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "init_ps_env",
+        pack_steal(string_list(num_vars, keys), string_list(num_vars, vals))));
+  });
+}
+
+/* string-key init/push/pull (Ex): same backend paths — keys are strings
+ * already in this ABI */
+
+int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals) {
+  return MXKVStoreInit(handle, num, keys, vals);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  return MXKVStorePush(handle, num, keys, vals, priority);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  return MXKVStorePull(handle, num, keys, vals, priority);
+}
+
+/* -- profiler config / objects ----------------------------------------- */
+
+int MXSetProfilerConfig(int num_params, const char *const *keys,
+                        const char *const *vals) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "set_profiler_config",
+        pack_steal(string_list(num_params,
+                               const_cast<const char **>(keys)),
+                   string_list(num_params,
+                               const_cast<const char **>(vals)))));
+  });
+}
+
+int MXSetProcessProfilerConfig(int num_params, const char *const *keys,
+                               const char *const *vals,
+                               KVStoreHandle kv_handle) {
+  (void)kv_handle;
+  return MXSetProfilerConfig(num_params, keys, vals);
+}
+
+int MXSetProcessProfilerState(int state, int profile_process,
+                              KVStoreHandle kv_handle) {
+  (void)profile_process;
+  (void)kv_handle;
+  return MXSetProfilerState(state);
+}
+
+int MXDumpProcessProfile(int finished, int profile_process,
+                         KVStoreHandle kv_handle) {
+  (void)kv_handle;
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profiler_dump_ex", pack_steal(PyLong_FromLong(finished),
+                                       PyLong_FromLong(profile_process))));
+  });
+}
+
+int MXAggregateProfileStatsPrint(const char **out_str, int reset) {
+  return with_backend([&]() -> bool {
+    return ret_string(call_backend(
+        "aggregate_profile_stats",
+        pack_steal(PyLong_FromLong(reset), PyLong_FromLong(0),
+                   PyLong_FromLong(0), PyLong_FromLong(0))), out_str);
+  });
+}
+
+int MXAggregateProfileStatsPrintEx(const char **out_str, int reset,
+                                   int format, int sort_by, int ascending) {
+  return with_backend([&]() -> bool {
+    return ret_string(call_backend(
+        "aggregate_profile_stats",
+        pack_steal(PyLong_FromLong(reset), PyLong_FromLong(format),
+                   PyLong_FromLong(sort_by), PyLong_FromLong(ascending))),
+        out_str);
+  });
+}
+
+int MXProfilePause(int paused) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend("profiler_pause",
+                                 pack_steal(PyLong_FromLong(paused))));
+  });
+}
+
+int MXProcessProfilePause(int paused, int profile_process,
+                          KVStoreHandle kv_handle) {
+  (void)kv_handle;
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profiler_pause", pack_steal(PyLong_FromLong(paused),
+                                     PyLong_FromLong(profile_process))));
+  });
+}
+
+int MXProfileCreateDomain(const char *domain, ProfileHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend("profile_create_domain",
+                                   pack_steal(PyUnicode_FromString(domain))),
+                      out);
+  });
+}
+
+int MXProfileCreateTask(ProfileHandle domain, const char *task_name,
+                        ProfileHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "profile_create_task",
+        pack_steal(PyLong_FromLong(as_id(domain)),
+                   PyUnicode_FromString(task_name))), out);
+  });
+}
+
+int MXProfileCreateFrame(ProfileHandle domain, const char *frame_name,
+                         ProfileHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "profile_create_frame",
+        pack_steal(PyLong_FromLong(as_id(domain)),
+                   PyUnicode_FromString(frame_name))), out);
+  });
+}
+
+int MXProfileCreateEvent(const char *event_name, ProfileHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "profile_create_event",
+        pack_steal(PyUnicode_FromString(event_name))), out);
+  });
+}
+
+int MXProfileCreateCounter(ProfileHandle domain, const char *counter_name,
+                           ProfileHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "profile_create_counter",
+        pack_steal(PyLong_FromLong(as_id(domain)),
+                   PyUnicode_FromString(counter_name))), out);
+  });
+}
+
+int MXProfileDestroyHandle(ProfileHandle handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profile_destroy_handle",
+        pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+int MXProfileDurationStart(ProfileHandle handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profile_duration_start",
+        pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+int MXProfileDurationStop(ProfileHandle handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profile_duration_stop",
+        pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+int MXProfileSetCounter(ProfileHandle handle, uint64_t value) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profile_set_counter",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromUnsignedLongLong(value))));
+  });
+}
+
+int MXProfileAdjustCounter(ProfileHandle handle, int64_t value) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profile_adjust_counter",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromLongLong(value))));
+  });
+}
+
+int MXProfileSetMarker(ProfileHandle domain, const char *instant_marker_name,
+                       const char *scope) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "profile_set_marker",
+        pack_steal(PyLong_FromLong(as_id(domain)),
+                   PyUnicode_FromString(instant_marker_name),
+                   PyUnicode_FromString(scope))));
+  });
+}
+
+/* -- RecordIO ----------------------------------------------------------- */
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend("recordio_writer_create",
+                                   pack_steal(PyUnicode_FromString(uri))),
+                      out);
+  });
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "recordio_free", pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "recordio_write_record",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyBytes_FromStringAndSize(buf,
+                                             static_cast<Py_ssize_t>(size)))));
+  });
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  return with_backend([&]() -> bool {
+    int v = 0;
+    if (!ret_int(call_backend("recordio_writer_tell",
+                              pack_steal(PyLong_FromLong(as_id(handle)))),
+                 &v))
+      return false;
+    *pos = static_cast<size_t>(v);
+    return true;
+  });
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend("recordio_reader_create",
+                                   pack_steal(PyUnicode_FromString(uri))),
+                      out);
+  });
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return MXRecordIOWriterFree(handle);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
+                               size_t *size) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "recordio_read_record", pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    char *data = nullptr;
+    Py_ssize_t n = 0;
+    PyBytes_AsStringAndSize(ret, &data, &n);
+    g_bytes_buf.assign(data ? data : "", static_cast<size_t>(n));
+    Py_DECREF(ret);
+    *buf = n ? g_bytes_buf.data() : nullptr;
+    *size = static_cast<size_t>(n);
+    return true;
+  });
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "recordio_reader_seek",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromSize_t(pos))));
+  });
+}
+
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos) {
+  return with_backend([&]() -> bool {
+    int v = 0;
+    if (!ret_int(call_backend("recordio_reader_tell",
+                              pack_steal(PyLong_FromLong(as_id(handle)))),
+                 &v))
+      return false;
+    *pos = static_cast<size_t>(v);
+    return true;
+  });
+}
+
+/* -- legacy Function API (v0.x: functions are the imperative ops) ------- */
+
+int MXListFunctions(uint32_t *out_size, FunctionHandle **out_array) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend("list_functions", PyTuple_New(0));
+    if (!ret) return false;
+    load_string_list(ret, g_op_names, g_op_name_ptrs);
+    Py_DECREF(ret);
+    static thread_local std::vector<const void *> fhandles;
+    fhandles.resize(g_op_names.size());
+    for (size_t i = 0; i < g_op_names.size(); ++i)
+      fhandles[i] = g_op_names[i].c_str();
+    *out_size = static_cast<uint32_t>(fhandles.size());
+    *out_array = fhandles.data();
+    return true;
+  });
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend("func_get_info",
+                                 pack_steal(PyUnicode_FromString(name)));
+    if (!ret) return false;
+    Py_DECREF(ret);
+    // INTERN the name: the handle must outlive every later ABI call
+    // (g_str_buf is clobbered by any string-returning entry point); a
+    // node-based set gives stable c_str addresses for process lifetime
+    static std::set<std::string> interned;
+    *out = interned.insert(name).first->c_str();
+    return true;
+  });
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, uint32_t *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions, const char **return_type) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "func_get_info",
+        pack_steal(PyUnicode_FromString(static_cast<const char *>(fun))));
+    if (!ret) return false;
+    static thread_local std::string nm, doc;
+    static thread_local std::vector<std::string> an, at, ad;
+    static thread_local std::vector<const char *> anp, atp, adp;
+    const char *s = PyUnicode_AsUTF8(PyTuple_GetItem(ret, 0));
+    nm = s ? s : "";
+    s = PyUnicode_AsUTF8(PyTuple_GetItem(ret, 1));
+    doc = s ? s : "";
+    load_string_list(PyTuple_GetItem(ret, 2), an, anp);
+    load_string_list(PyTuple_GetItem(ret, 3), at, atp);
+    load_string_list(PyTuple_GetItem(ret, 4), ad, adp);
+    Py_DECREF(ret);
+    *name = nm.c_str();
+    *description = doc.c_str();
+    *num_args = static_cast<uint32_t>(an.size());
+    *arg_names = anp.data();
+    *arg_type_infos = atp.data();
+    *arg_descriptions = adp.data();
+    if (return_type) *return_type = "";
+    return true;
+  });
+}
+
+int MXFuncDescribe(FunctionHandle fun, uint32_t *num_use_vars,
+                   uint32_t *num_scalars, uint32_t *num_mutate_vars,
+                   int *type_mask) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "func_get_info",
+        pack_steal(PyUnicode_FromString(static_cast<const char *>(fun))));
+    if (!ret) return false;
+    Py_ssize_t n = PyList_Size(PyTuple_GetItem(ret, 2));
+    Py_DECREF(ret);
+    *num_use_vars = static_cast<uint32_t>(n);
+    *num_scalars = 0;
+    *num_mutate_vars = 1;
+    *type_mask = 0;
+    return true;
+  });
+}
+
+static int func_invoke_impl(FunctionHandle fun, NDArrayHandle *use_vars,
+                            NDArrayHandle *mutate_vars, int num_params,
+                            const char **param_keys,
+                            const char **param_vals) {
+  /* arity comes from the same source MXFuncDescribe reports: the op's
+   * declared tensor inputs — the caller sized use_vars from Describe */
+  return with_backend([&]() -> bool {
+    uint32_t n_use = 0, n_scalar = 0, n_mut = 0;
+    int type_mask = 0;
+    if (MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &type_mask) != 0)
+      return false;
+    PyObject *ret = call_backend(
+        "func_invoke",
+        pack_steal(PyUnicode_FromString(static_cast<const char *>(fun)),
+                   handle_list(n_use, use_vars),
+                   string_list(static_cast<uint32_t>(num_params),
+                               param_keys),
+                   string_list(static_cast<uint32_t>(num_params),
+                               param_vals),
+                   handle_list(n_mut, mutate_vars)));
+    if (!ret) return false;
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars, float *scalars,
+                 NDArrayHandle *mutate_vars) {
+  (void)scalars;  /* num_scalars is reported 0 by MXFuncDescribe */
+  return func_invoke_impl(fun, use_vars, mutate_vars, 0, nullptr, nullptr);
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   float *scalars, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals) {
+  (void)scalars;
+  return func_invoke_impl(fun, use_vars, mutate_vars, num_params,
+                          const_cast<const char **>(param_keys),
+                          const_cast<const char **>(param_vals));
+}
+
+/* -- ndarray extras / 64-bit variants ----------------------------------- */
+
+int MXNDArrayCreateEx(const uint32_t *shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  (void)dev_type;
+  (void)dev_id;
+  (void)delay_alloc;
+  static const char *kDtypes[] = {"float32", "float64", "float16", "uint8",
+                                  "int32",   "int8",    "int64",   "bool"};
+  const char *dt = (dtype >= 0 && dtype < 8) ? kDtypes[dtype] : "float32";
+  return MXNDArrayCreate(shape, ndim, dt, out);
+}
+
+int MXNDArrayCreateEx64(const int64_t *shape, int ndim, int dev_type,
+                        int dev_id, int delay_alloc, int dtype,
+                        NDArrayHandle *out) {
+  (void)dev_type;
+  (void)dev_id;
+  (void)delay_alloc;
+  std::vector<uint32_t> s32(static_cast<size_t>(ndim));
+  for (int i = 0; i < ndim; ++i) s32[static_cast<size_t>(i)] =
+      static_cast<uint32_t>(shape[i]);
+  return MXNDArrayCreateEx(s32.data(), static_cast<uint32_t>(ndim), dev_type,
+                           dev_id, delay_alloc, dtype, out);
+}
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend("ndarray_create_none", PyTuple_New(0)),
+                      out);
+  });
+}
+
+int MXNDArrayGetShapeEx(NDArrayHandle handle, int *out_dim,
+                        const int **out_pdata) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_get_shape", pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    static thread_local std::vector<int> dims;
+    Py_ssize_t n = PyTuple_Size(ret);
+    dims.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      dims[static_cast<size_t>(i)] =
+          static_cast<int>(PyLong_AsLong(PyTuple_GetItem(ret, i)));
+    Py_DECREF(ret);
+    *out_dim = static_cast<int>(n);
+    *out_pdata = dims.data();
+    return true;
+  });
+}
+
+int MXNDArrayGetShape64(NDArrayHandle handle, int *out_dim,
+                        const int64_t **out_pdata) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_get_shape", pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    Py_ssize_t n = PyTuple_Size(ret);
+    g_shape64_buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_shape64_buf[static_cast<size_t>(i)] =
+          PyLong_AsLongLong(PyTuple_GetItem(ret, i));
+    Py_DECREF(ret);
+    *out_dim = static_cast<int>(n);
+    *out_pdata = g_shape64_buf.data();
+    return true;
+  });
+}
+
+int MXNDArrayGetShapeEx64(NDArrayHandle handle, int *out_dim,
+                          const int64_t **out_pdata) {
+  return MXNDArrayGetShape64(handle, out_dim, out_pdata);
+}
+
+int MXNDArrayAt64(NDArrayHandle handle, int64_t idx, NDArrayHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_at", pack_steal(PyLong_FromLong(as_id(handle)),
+                                 PyLong_FromLongLong(idx))), out);
+  });
+}
+
+int MXNDArraySlice64(NDArrayHandle handle, int64_t begin, int64_t end,
+                     NDArrayHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_slice",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromLongLong(begin), PyLong_FromLongLong(end))),
+        out);
+  });
+}
+
+int MXNDArrayReshape64(NDArrayHandle handle, int ndim, dim_t *dims,
+                       bool reverse, NDArrayHandle *out) {
+  (void)reverse;
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_reshape",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   shape_list64(reinterpret_cast<const int64_t *>(dims),
+                                static_cast<uint32_t>(ndim)))), out);
+  });
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend(
+        "ndarray_get_storage_type",
+        pack_steal(PyLong_FromLong(as_id(handle)))), out_storage_type);
+  });
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "ndarray_wait_to_write",
+        pack_steal(PyLong_FromLong(as_id(handle)))));
+  });
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_detach", pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "ndarray_set_grad_state",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromLong(state))));
+  });
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend(
+        "ndarray_get_grad_state",
+        pack_steal(PyLong_FromLong(as_id(handle)))), out);
+  });
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_save_raw_bytes",
+        pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    char *data = nullptr;
+    Py_ssize_t n = 0;
+    PyBytes_AsStringAndSize(ret, &data, &n);
+    g_bytes_buf.assign(data ? data : "", static_cast<size_t>(n));
+    Py_DECREF(ret);
+    *out_size = static_cast<size_t>(n);
+    *out_buf = g_bytes_buf.data();
+    return true;
+  });
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_load_from_raw_bytes",
+        pack_steal(PyBytes_FromStringAndSize(
+            static_cast<const char *>(buf),
+            static_cast<Py_ssize_t>(size)))), out);
+  });
+}
+
+int MXNDArrayLoadFromBuffer(const void *buf, size_t size, uint32_t *out_size,
+                            NDArrayHandle **out_arr, uint32_t *out_name_size,
+                            const char ***out_names) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_load_from_buffer",
+        pack_steal(PyBytes_FromStringAndSize(
+            static_cast<const char *>(buf),
+            static_cast<Py_ssize_t>(size))));
+    if (!ret) return false;
+    PyObject *hs = PyTuple_GetItem(ret, 0);
+    PyObject *names = PyTuple_GetItem(ret, 1);
+    Py_ssize_t n = PyList_Size(hs);
+    g_handle_buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_handle_buf[static_cast<size_t>(i)] =
+          as_handle(PyLong_AsLong(PyList_GetItem(hs, i)));
+    load_string_list(names, g_name_buf, g_name_ptr_buf);
+    Py_DECREF(ret);
+    *out_size = static_cast<uint32_t>(n);
+    *out_arr = g_handle_buf.data();
+    *out_name_size = static_cast<uint32_t>(g_name_buf.size());
+    *out_names = g_name_ptr_buf.data();
+    return true;
+  });
+}
+
+int MXNDArrayLoadFromBuffer64(const void *buf, size_t size,
+                              uint32_t *out_size, NDArrayHandle **out_arr,
+                              uint32_t *out_name_size,
+                              const char ***out_names) {
+  return MXNDArrayLoadFromBuffer(buf, size, out_size, out_arr, out_name_size,
+                                 out_names);
+}
+
+int MXNDArrayLoad64(const char *fname, uint32_t *out_size,
+                    NDArrayHandle **out_arr, uint32_t *out_name_size,
+                    const char ***out_names) {
+  return MXNDArrayLoad(fname, out_size, out_arr, out_name_size, out_names);
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src, int i) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "ndarray_sync_copy_from_ndarray",
+        pack_steal(PyLong_FromLong(as_id(handle_dst)),
+                   PyLong_FromLong(as_id(const_cast<void *>(handle_src))),
+                   PyLong_FromLong(i))));
+  });
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const bool full_check) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "ndarray_sync_check_format",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   PyLong_FromLong(full_check ? 1 : 0))));
+  });
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  /* host copy of the buffer, valid until the next call on this thread */
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "ndarray_sync_copy_to_cpu",
+        pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    char *data = nullptr;
+    Py_ssize_t n = 0;
+    PyBytes_AsStringAndSize(ret, &data, &n);
+    g_bytes_buf.assign(data ? data : "", static_cast<size_t>(n));
+    Py_DECREF(ret);
+    *out_pdata = const_cast<char *>(g_bytes_buf.data());
+    return true;
+  });
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  return MXShallowCopyNDArray(handle, out);
+}
+
+/* -- engine push: NaiveEngine semantics (execute now, complete now) ----- */
+
+int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("engine_set_bulk_size",
+                                pack_steal(PyLong_FromLong(bulk_size))),
+                   prev_bulk_size);
+  });
+}
+
+typedef void (*EngineSyncFunc)(void *, void *);
+typedef void (*EngineAsyncFunc)(void *, void *, void *);
+typedef void (*EngineFuncParamDeleter)(void *);
+
+int MXEnginePushSync(EngineSyncFunc sync_func, void *func_param,
+                     EngineFuncParamDeleter deleter, void *ctx_handle,
+                     void *const_vars_handle, int num_const_vars,
+                     void *mutable_vars_handle, int num_mutable_vars,
+                     void *prop_handle, int priority, const char *opr_name) {
+  (void)ctx_handle; (void)const_vars_handle; (void)num_const_vars;
+  (void)mutable_vars_handle; (void)num_mutable_vars; (void)prop_handle;
+  (void)priority; (void)opr_name;
+  /* PJRT dispatch is already async; the engine contract collapses to
+   * immediate execution (NaiveEngine semantics, SURVEY §1 layer 2) */
+  if (sync_func) sync_func(nullptr, func_param);
+  if (deleter) deleter(func_param);
+  return 0;
+}
+
+static void engine_async_complete(void *, void *) {}
+
+int MXEnginePushAsync(EngineAsyncFunc async_func, void *func_param,
+                      EngineFuncParamDeleter deleter, void *ctx_handle,
+                      void *const_vars_handle, int num_const_vars,
+                      void *mutable_vars_handle, int num_mutable_vars,
+                      void *prop_handle, int priority, const char *opr_name,
+                      bool wait) {
+  (void)ctx_handle; (void)const_vars_handle; (void)num_const_vars;
+  (void)mutable_vars_handle; (void)num_mutable_vars; (void)prop_handle;
+  (void)priority; (void)opr_name; (void)wait;
+  if (async_func)
+    async_func(nullptr, func_param,
+               reinterpret_cast<void *>(&engine_async_complete));
+  if (deleter) deleter(func_param);
+  return 0;
+}
+
+int MXEnginePushSyncND(EngineSyncFunc sync_func, void *func_param,
+                       EngineFuncParamDeleter deleter, void *ctx_handle,
+                       NDArrayHandle *const_nds, int num_const_nds,
+                       NDArrayHandle *mutable_nds, int num_mutable_nds,
+                       void *prop_handle, int priority, const char *opr_name) {
+  (void)const_nds; (void)mutable_nds;
+  return MXEnginePushSync(sync_func, func_param, deleter, ctx_handle,
+                          nullptr, num_const_nds, nullptr, num_mutable_nds,
+                          prop_handle, priority, opr_name);
+}
+
+int MXEnginePushAsyncND(EngineAsyncFunc async_func, void *func_param,
+                        EngineFuncParamDeleter deleter, void *ctx_handle,
+                        NDArrayHandle *const_nds, int num_const_nds,
+                        NDArrayHandle *mutable_nds, int num_mutable_nds,
+                        void *prop_handle, int priority,
+                        const char *opr_name, bool wait) {
+  (void)const_nds; (void)mutable_nds;
+  return MXEnginePushAsync(async_func, func_param, deleter, ctx_handle,
+                           nullptr, num_const_nds, nullptr, num_mutable_nds,
+                           prop_handle, priority, opr_name, wait);
+}
+
+/* -- quantization / graph passes ---------------------------------------- */
+
+int MXQuantizeSymbol(SymbolHandle sym_handle, SymbolHandle *ret_sym_handle,
+                     const uint32_t num_excluded_symbols,
+                     const char **excluded_symbols,
+                     const uint32_t num_offline, const char **offline_params,
+                     const char *quantized_dtype, const bool calib_quantize) {
+  (void)calib_quantize;
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "quantize_symbol",
+        pack_steal(PyLong_FromLong(as_id(sym_handle)),
+                   string_list(num_excluded_symbols, excluded_symbols),
+                   string_list(num_offline, offline_params),
+                   PyUnicode_FromString(quantized_dtype))),
+        ret_sym_handle);
+  });
+}
+
+int MXReducePrecisionSymbol(SymbolHandle sym_handle,
+                            SymbolHandle *ret_sym_handle, uint32_t num_args,
+                            const int *arg_type_data, uint32_t num_ind_ptr,
+                            const int *ind_ptr, const int *target_dtype,
+                            const int cast_optional_params,
+                            const uint32_t num_target_dtype_ops,
+                            const char **target_dtype_ops,
+                            const uint32_t num_fp32_ops,
+                            const char **fp32_ops,
+                            const uint32_t num_widest_dtype_ops,
+                            const char **widest_dtype_ops,
+                            const uint32_t num_conditional_fp32_ops,
+                            const char **conditional_fp32_ops,
+                            const uint32_t num_excluded_symbols,
+                            const char **excluded_symbols,
+                            const char **arg_names) {
+  (void)num_args; (void)arg_type_data; (void)num_ind_ptr; (void)ind_ptr;
+  (void)cast_optional_params; (void)num_target_dtype_ops;
+  (void)target_dtype_ops; (void)num_fp32_ops; (void)fp32_ops;
+  (void)num_widest_dtype_ops; (void)widest_dtype_ops;
+  (void)num_conditional_fp32_ops; (void)conditional_fp32_ops;
+  (void)num_excluded_symbols; (void)excluded_symbols; (void)arg_names;
+  const char *dt = (target_dtype && *target_dtype == 2) ? "float16"
+                                                        : "bfloat16";
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "reduce_precision_symbol",
+        pack_steal(PyLong_FromLong(as_id(sym_handle)),
+                   PyUnicode_FromString(dt))), ret_sym_handle);
+  });
+}
+
+int MXSetCalibTableToQuantizedSymbol(SymbolHandle qsym_handle,
+                                     const uint32_t num_layers,
+                                     const char **layer_names,
+                                     const float *low_quantiles,
+                                     const float *high_quantiles,
+                                     SymbolHandle *ret_sym_handle) {
+  return with_backend([&]() -> bool {
+    PyObject *lows = PyList_New(num_layers);
+    PyObject *highs = PyList_New(num_layers);
+    for (uint32_t i = 0; i < num_layers; ++i) {
+      PyList_SetItem(lows, i, PyFloat_FromDouble(low_quantiles[i]));
+      PyList_SetItem(highs, i, PyFloat_FromDouble(high_quantiles[i]));
+    }
+    return ret_handle(call_backend(
+        "set_calib_table",
+        pack_steal(PyLong_FromLong(as_id(qsym_handle)),
+                   string_list(num_layers, layer_names), lows, highs)),
+        ret_sym_handle);
+  });
+}
+
+int MXGenBackendSubgraph(SymbolHandle sym_handle, const char *backend,
+                         SymbolHandle *ret_sym_handle) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "gen_backend_subgraph",
+        pack_steal(PyLong_FromLong(as_id(sym_handle)),
+                   PyUnicode_FromString(backend))), ret_sym_handle);
+  });
+}
+
+int MXOptimizeForBackend(SymbolHandle sym_handle, const char *backend,
+                         const int dev_type, SymbolHandle *ret_sym_handle,
+                         const uint32_t args_len, NDArrayHandle *in_args,
+                         const uint32_t aux_len, NDArrayHandle *in_aux,
+                         const uint32_t num_options, const char **keys,
+                         const char **vals, int **new_args_cnt,
+                         NDArrayHandle **new_args_handle,
+                         char ***new_arg_names_handle, int **new_aux_cnt,
+                         NDArrayHandle **new_aux_handle,
+                         char ***new_aux_names_handle) {
+  (void)dev_type; (void)args_len; (void)in_args; (void)aux_len;
+  (void)in_aux; (void)num_options; (void)keys; (void)vals;
+  if (new_args_cnt) *new_args_cnt = nullptr;
+  if (new_args_handle) *new_args_handle = nullptr;
+  if (new_arg_names_handle) *new_arg_names_handle = nullptr;
+  if (new_aux_cnt) *new_aux_cnt = nullptr;
+  if (new_aux_handle) *new_aux_handle = nullptr;
+  if (new_aux_names_handle) *new_aux_names_handle = nullptr;
+  return MXGenBackendSubgraph(sym_handle, backend, ret_sym_handle);
+}
+
+/* -- misc --------------------------------------------------------------- */
+
+int MXIsNumpyShape(int *curr) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("is_numpy_shape", PyTuple_New(0)), curr);
+  });
+}
+
+int MXSetIsNumpyShape(int is_np_shape, int *prev) {
+  return with_backend([&]() -> bool {
+    int unused = 0;
+    if (!ret_int(call_backend("is_numpy_shape", PyTuple_New(0)),
+                 prev ? prev : &unused))
+      return false;
+    return ret_void(call_backend(
+        "set_is_numpy_shape", pack_steal(PyLong_FromLong(is_np_shape))));
+  });
+}
+
+int MXSetNumOMPThreads(int thread_num) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend("set_num_omp_threads",
+                                 pack_steal(PyLong_FromLong(thread_num))));
+  });
+}
+
+int MXStorageEmptyCache(int dev_type, int dev_id) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "storage_empty_cache", pack_steal(PyLong_FromLong(dev_type),
+                                          PyLong_FromLong(dev_id))));
+  });
+}
+
+int MXGetGPUMemoryInformation(int dev, int *free_mem, int *total_mem) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend("get_gpu_memory_information",
+                                 pack_steal(PyLong_FromLong(dev)));
+    if (!ret) return false;
+    *free_mem = static_cast<int>(
+        PyLong_AsLongLong(PyTuple_GetItem(ret, 0)) >> 20);
+    *total_mem = static_cast<int>(
+        PyLong_AsLongLong(PyTuple_GetItem(ret, 1)) >> 20);
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXGetGPUMemoryInformation64(int dev, uint64_t *free_mem,
+                                uint64_t *total_mem) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend("get_gpu_memory_information",
+                                 pack_steal(PyLong_FromLong(dev)));
+    if (!ret) return false;
+    *free_mem = static_cast<uint64_t>(
+        PyLong_AsLongLong(PyTuple_GetItem(ret, 0)));
+    *total_mem = static_cast<uint64_t>(
+        PyLong_AsLongLong(PyTuple_GetItem(ret, 1)));
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXLibInfoFeatures(const struct LibFeature **lib_feature, size_t *size) {
+  /* the reference returns LibFeature structs; marshal the (name, enabled)
+   * pairs into a thread-local array of that layout */
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend("lib_info_features", PyTuple_New(0));
+    if (!ret) return false;
+    load_string_list(ret, g_attr_buf, g_attr_ptr_buf);
+    Py_DECREF(ret);
+    static thread_local std::vector<LibFeature> feats;
+    size_t n = g_attr_buf.size() / 2;
+    feats.resize(n);
+    for (size_t i = 0; i < n; ++i) {
+      feats[i].name = g_attr_buf[2 * i].c_str();
+      feats[i].enabled = g_attr_buf[2 * i + 1] == "1";
+    }
+    *lib_feature = feats.data();
+    *size = n;
+    return true;
+  });
+}
+
+int MXRandomSeedContext(int seed, int dev_type, int dev_id) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend(
+        "random_seed_context",
+        pack_steal(PyLong_FromLong(seed), PyLong_FromLong(dev_type),
+                   PyLong_FromLong(dev_id))));
+  });
+}
+
+int MXLoadLib(const char *path) {
+  return with_backend([&]() -> bool {
+    return ret_void(call_backend("load_lib",
+                                 pack_steal(PyUnicode_FromString(path))));
+  });
+}
+
+/* -- DLPack ------------------------------------------------------------- */
+
+int MXNDArrayToDLPack(NDArrayHandle handle, DLManagedTensorHandle *out_dlpack) {
+  return with_backend([&]() -> bool {
+    PyObject *capsule = call_backend(
+        "ndarray_to_dlpack", pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!capsule) return false;
+    void *ptr = PyCapsule_GetPointer(capsule, "dltensor");
+    if (!ptr) {
+      PyErr_Clear();
+      set_error("invalid DLPack capsule");
+      Py_DECREF(capsule);
+      return false;
+    }
+    /* mark consumed so the capsule destructor won't free the tensor the
+     * C caller now owns */
+    PyCapsule_SetName(capsule, "used_dltensor");
+    Py_DECREF(capsule);
+    *out_dlpack = ptr;
+    return true;
+  });
+}
+
+int MXNDArrayFromDLPack(DLManagedTensorHandle dlpack, NDArrayHandle *out) {
+  return with_backend([&]() -> bool {
+    PyObject *capsule = PyCapsule_New(dlpack, "dltensor", nullptr);
+    if (!capsule) {
+      set_error("failed to wrap DLPack pointer");
+      return false;
+    }
+    return ret_handle(call_backend("ndarray_from_dlpack",
+                                   pack_steal(capsule)), out);
+  });
+}
+
+int MXNDArrayFromDLPackEx(DLManagedTensorHandle dlpack,
+                          const bool transient_handle, NDArrayHandle *out) {
+  (void)transient_handle;
+  return MXNDArrayFromDLPack(dlpack, out);
+}
+
+int MXNDArrayCallDLPackDeleter(DLManagedTensorHandle dlpack) {
+  /* DLManagedTensor layout: {DLTensor, void* ctx, void (*deleter)()} —
+   * invoke the embedded deleter like the reference does */
+  struct MiniDLManagedTensor {
+    char opaque[sizeof(void *) * 8];  /* DLTensor is larger; deleter is
+                                         accessed via real layout below */
+  };
+  if (dlpack) {
+    /* proper layout per dlpack.h */
+    struct DLTensorABI {
+      void *data;
+      int32_t device_type, device_id;
+      int32_t ndim;
+      uint8_t code, bits;
+      uint16_t lanes;
+      int64_t *shape, *strides;
+      uint64_t byte_offset;
+    };
+    struct DLManagedTensorABI {
+      DLTensorABI dl_tensor;
+      void *manager_ctx;
+      void (*deleter)(struct DLManagedTensorABI *);
+    };
+    auto *mt = static_cast<DLManagedTensorABI *>(dlpack);
+    if (mt->deleter) mt->deleter(mt);
+  }
+  return 0;
+}
+
+/* -- CUDA-only families: exported, honest unsupported errors ------------ */
+
+int MXRtcCreate(char *name, uint32_t num_input, uint32_t num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out) {
+  (void)name; (void)num_input; (void)num_output; (void)input_names;
+  (void)output_names; (void)inputs; (void)outputs; (void)kernel; (void)out;
+  return unsupported("MXRtcCreate", "CUDA RTC compiles .cu source; use "
+                     "mxnet_tpu.rtc.PallasModule for runtime TPU kernels");
+}
+
+int MXRtcPush(RtcHandle handle, uint32_t num_input, uint32_t num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              uint32_t gridDimX, uint32_t gridDimY, uint32_t gridDimZ,
+              uint32_t blockDimX, uint32_t blockDimY, uint32_t blockDimZ) {
+  (void)handle; (void)num_input; (void)num_output; (void)inputs;
+  (void)outputs; (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)blockDimX; (void)blockDimY; (void)blockDimZ;
+  return unsupported("MXRtcPush", "see MXRtcCreate");
+}
+
+int MXRtcFree(RtcHandle handle) {
+  (void)handle;
+  return unsupported("MXRtcFree", "see MXRtcCreate");
+}
+
+int MXRtcCudaModuleCreate(const char *source, int num_options,
+                          const char **options, int num_exports,
+                          const char **exports, CudaModuleHandle *out) {
+  (void)source; (void)num_options; (void)options; (void)num_exports;
+  (void)exports; (void)out;
+  return unsupported("MXRtcCudaModuleCreate",
+                     "CUDA modules do not exist on TPU; use "
+                     "mxnet_tpu.rtc.PallasModule");
+}
+
+int MXRtcCudaModuleFree(CudaModuleHandle handle) {
+  (void)handle;
+  return unsupported("MXRtcCudaModuleFree", "see MXRtcCudaModuleCreate");
+}
+
+int MXRtcCudaKernelCreate(CudaModuleHandle handle, const char *name,
+                          int num_args, int *is_ndarray, int *is_const,
+                          int *arg_types, CudaKernelHandle *out) {
+  (void)handle; (void)name; (void)num_args; (void)is_ndarray;
+  (void)is_const; (void)arg_types; (void)out;
+  return unsupported("MXRtcCudaKernelCreate", "see MXRtcCudaModuleCreate");
+}
+
+int MXRtcCudaKernelFree(CudaKernelHandle handle) {
+  (void)handle;
+  return unsupported("MXRtcCudaKernelFree", "see MXRtcCudaModuleCreate");
+}
+
+int MXRtcCudaKernelCall(CudaKernelHandle handle, int dev_id, void **args,
+                        uint32_t grid_dim_x, uint32_t grid_dim_y,
+                        uint32_t grid_dim_z, uint32_t block_dim_x,
+                        uint32_t block_dim_y, uint32_t block_dim_z,
+                        uint32_t shared_mem) {
+  (void)handle; (void)dev_id; (void)args; (void)grid_dim_x;
+  (void)grid_dim_y; (void)grid_dim_z; (void)block_dim_x; (void)block_dim_y;
+  (void)block_dim_z; (void)shared_mem;
+  return unsupported("MXRtcCudaKernelCall", "see MXRtcCudaModuleCreate");
+}
+
+int MXLoadTVMOp(const char *libpath) {
+  (void)libpath;
+  return unsupported("MXLoadTVMOp", "TVM-generated CUDA kernels do not "
+                     "apply; XLA compiles the op corpus");
+}
+
+int MXCustomOpRegister(const char *op_type, void *creator) {
+  (void)op_type; (void)creator;
+  return unsupported("MXCustomOpRegister",
+                     "C++ CustomOp callbacks are CUDA/C++-runtime specific; "
+                     "register python CustomOps (mxnet_tpu.operator) or "
+                     "load an op library via MXLoadLib");
+}
+
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                           int num_outputs, NDArrayHandle *outputs,
+                           void *callbacks) {
+  (void)num_inputs; (void)inputs; (void)num_outputs; (void)outputs;
+  (void)callbacks;
+  return unsupported("MXCustomFunctionRecord",
+                     "use mxnet_tpu.autograd.Function from python; the C "
+                     "callback trampoline is not exposed");
+}
+
+/* -- sparse creation (CSR / row-sparse) --------------------------------- */
+
+int MXNDArrayCreateSparseEx(int storage_type, const uint32_t *shape,
+                            uint32_t ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype,
+                            uint32_t num_aux, int *aux_type,
+                            uint32_t *aux_ndims, const uint32_t *aux_shape,
+                            NDArrayHandle *out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc; (void)num_aux;
+  (void)aux_type; (void)aux_ndims; (void)aux_shape;
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_create_sparse",
+        pack_steal(PyLong_FromLong(storage_type), shape_list(shape, ndim),
+                   PyLong_FromLong(dtype))), out);
+  });
+}
+
+int MXNDArrayCreateSparseEx64(int storage_type, const int64_t *shape,
+                              int ndim, int dev_type, int dev_id,
+                              int delay_alloc, int dtype, uint32_t num_aux,
+                              int *aux_type, int *aux_ndims,
+                              const int64_t *aux_shape, NDArrayHandle *out) {
+  (void)num_aux; (void)aux_type; (void)aux_ndims; (void)aux_shape;
+  std::vector<uint32_t> s32(static_cast<size_t>(ndim));
+  for (int i = 0; i < ndim; ++i) s32[static_cast<size_t>(i)] =
+      static_cast<uint32_t>(shape[i]);
+  return MXNDArrayCreateSparseEx(storage_type, s32.data(),
+                                 static_cast<uint32_t>(ndim), dev_type,
+                                 dev_id, delay_alloc, dtype, 0, nullptr,
+                                 nullptr, nullptr, out);
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, uint32_t i, int *out_type) {
+  (void)handle; (void)i;
+  *out_type = 6; /* int64 indices, both CSR and row-sparse aux */
+  return 0;
+}
+
+int MXNDArrayGetAuxType64(NDArrayHandle handle, int64_t i, int *out_type) {
+  return MXNDArrayGetAuxType(handle, static_cast<uint32_t>(i), out_type);
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, uint32_t i,
+                           NDArrayHandle *out) {
+  return with_backend([&]() -> bool {
+    return ret_handle(call_backend(
+        "ndarray_get_aux", pack_steal(PyLong_FromLong(as_id(handle)),
+                                      PyLong_FromUnsignedLong(i))), out);
+  });
+}
+
+int MXNDArrayGetAuxNDArray64(NDArrayHandle handle, int64_t i,
+                             NDArrayHandle *out) {
+  return MXNDArrayGetAuxNDArray(handle, static_cast<uint32_t>(i), out);
+}
+
+int MXNDArrayGetSharedMemHandle(NDArrayHandle handle, int *shared_pid,
+                                int *shared_id) {
+  (void)handle; (void)shared_pid; (void)shared_id;
+  return unsupported("MXNDArrayGetSharedMemHandle",
+                     "cross-process tensors travel via "
+                     "multiprocessing.shared_memory in the DataLoader; "
+                     "the SysV-style (pid,id) handle pair has no analog");
+}
+
+int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                 const uint32_t *shape, uint32_t ndim,
+                                 int dtype, NDArrayHandle *out) {
+  (void)shared_pid; (void)shared_id; (void)shape; (void)ndim; (void)dtype;
+  (void)out;
+  return unsupported("MXNDArrayCreateFromSharedMem",
+                     "see MXNDArrayGetSharedMemHandle");
+}
+
+int MXNDArrayCreateFromSharedMemEx(int shared_pid, int shared_id,
+                                   const int *shape, int ndim, int dtype,
+                                   NDArrayHandle *out) {
+  (void)shape; (void)ndim;
+  return MXNDArrayCreateFromSharedMem(shared_pid, shared_id, nullptr, 0,
+                                      dtype, out);
+}
+
+}  // extern "C"
+
+/* ------------------------------------------------------------------------
+ * Final delegation tier: Ex/64 spellings + remaining iterator/executor/
+ * kvstore/symbol entries (ref: include/mxnet/c_api.h).
+ * --------------------------------------------------------------------- */
+
+extern "C" {
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "data_iter_get_index", pack_steal(PyLong_FromLong(as_id(handle))));
+    if (!ret) return false;
+    static thread_local std::vector<uint64_t> idx;
+    Py_ssize_t n = PyList_Size(ret);
+    idx.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      idx[static_cast<size_t>(i)] = static_cast<uint64_t>(
+          PyLong_AsUnsignedLongLong(PyList_GetItem(ret, i)));
+    Py_DECREF(ret);
+    *out_index = idx.data();
+    *out_size = static_cast<uint64_t>(n);
+    return true;
+  });
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  return with_backend([&]() -> bool {
+    return ret_int(call_backend("data_iter_get_pad",
+                                pack_steal(PyLong_FromLong(as_id(handle)))),
+                   pad);
+  });
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, uint32_t *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "data_iter_get_info",
+        pack_steal(PyUnicode_FromString(
+            static_cast<const char *>(creator))));
+    if (!ret) return false;
+    static thread_local std::string nm, doc;
+    static thread_local std::vector<std::string> an, at, ad;
+    static thread_local std::vector<const char *> anp, atp, adp;
+    const char *s = PyUnicode_AsUTF8(PyTuple_GetItem(ret, 0));
+    nm = s ? s : "";
+    s = PyUnicode_AsUTF8(PyTuple_GetItem(ret, 1));
+    doc = s ? s : "";
+    load_string_list(PyTuple_GetItem(ret, 2), an, anp);
+    load_string_list(PyTuple_GetItem(ret, 3), at, atp);
+    load_string_list(PyTuple_GetItem(ret, 4), ad, adp);
+    Py_DECREF(ret);
+    *name = nm.c_str();
+    *description = doc.c_str();
+    *num_args = static_cast<uint32_t>(an.size());
+    *arg_names = anp.data();
+    *arg_type_infos = atp.data();
+    *arg_descriptions = adp.data();
+    return true;
+  });
+}
+
+int MXExecutorBackwardEx(ExecutorHandle handle, uint32_t len,
+                         NDArrayHandle *head_grads, int is_train) {
+  (void)is_train;
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "executor_backward_ex",
+        pack_steal(PyLong_FromLong(as_id(handle)),
+                   handle_list(len, head_grads)));
+    if (!ret) return false;
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    uint32_t num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    uint32_t len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, uint32_t *grad_req_type,
+                    uint32_t aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  /* group2ctx placement maps are a multi-device GPU concept; GSPMD owns
+   * placement here — the map is accepted and ignored. grad_req IS
+   * honored: any non-null request binds with gradients (read them back
+   * via MXExecutorBackward's returned handles — caller-owned grad
+   * stores are not aliased on immutable XLA buffers). */
+  (void)num_map_keys; (void)map_keys; (void)map_dev_types;
+  (void)map_dev_ids; (void)arg_grad_store;
+  (void)aux_states_len; (void)aux_states;
+  bool want_grad = false;
+  if (grad_req_type)
+    for (uint32_t i = 0; i < len; ++i)
+      want_grad |= grad_req_type[i] != 0;
+  return MXExecutorBind(sym, dev_type, dev_id, len, in_args,
+                        want_grad ? "write" : "null", out);
+}
+
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     uint32_t num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     uint32_t len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, uint32_t *grad_req_type,
+                     uint32_t aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  (void)shared_exec;
+  return MXExecutorBindX(sym, dev_type, dev_id, num_map_keys, map_keys,
+                         map_dev_types, map_dev_ids, len, in_args,
+                         arg_grad_store, grad_req_type, aux_states_len,
+                         aux_states, out);
+}
+
+int MXExecutorSimpleBindEx(SymbolHandle sym, int dev_type, int dev_id,
+                           uint32_t num_args, const char **arg_names,
+                           const uint32_t *arg_ind_ptr,
+                           const uint32_t *arg_shape_data,
+                           const char *grad_req, ExecutorHandle *out,
+                           uint32_t *num_arg_arrays,
+                           NDArrayHandle **arg_arrays,
+                           NDArrayHandle **grad_arrays, uint32_t *num_aux,
+                           NDArrayHandle **aux_arrays) {
+  return MXExecutorSimpleBind(sym, dev_type, dev_id, num_args, arg_names,
+                              arg_ind_ptr, arg_shape_data, grad_req, out,
+                              num_arg_arrays, arg_arrays, grad_arrays,
+                              num_aux, aux_arrays);
+}
+
+int MXExecutorReshapeEx(int partial_shaping, int allow_up_sizing,
+                        int dev_type, int dev_id, uint32_t num_args,
+                        const char **arg_names, const uint32_t *arg_ind_ptr,
+                        const uint32_t *arg_shape_data,
+                        ExecutorHandle shared_exec, ExecutorHandle *out,
+                        uint32_t *num_arg_arrays, NDArrayHandle **arg_arrays,
+                        NDArrayHandle **grad_arrays, uint32_t *num_aux,
+                        NDArrayHandle **aux_arrays) {
+  return MXExecutorReshape(partial_shaping, allow_up_sizing, dev_type,
+                           dev_id, num_args, arg_names, arg_ind_ptr,
+                           arg_shape_data, shared_exec, out, num_arg_arrays,
+                           arg_arrays, grad_arrays, num_aux, aux_arrays);
+}
+
+int MXImperativeInvokeEx(const char *op_name, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle ***outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes) {
+  int rc = MXImperativeInvoke(op_name, num_inputs,
+                              reinterpret_cast<void **>(inputs),
+                              num_outputs,
+                              reinterpret_cast<void ***>(outputs),
+                              num_params, param_keys, param_vals);
+  if (rc == 0 && out_stypes) {
+    static thread_local std::vector<int> stypes;
+    stypes.assign(static_cast<size_t>(*num_outputs), 0);
+    *out_stypes = stypes.data();
+  }
+  return rc;
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, uint32_t num,
+                           const int *keys, NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority) {
+  return with_backend([&]() -> bool {
+    PyObject *ks = PyList_New(num);
+    for (uint32_t i = 0; i < num; ++i)
+      PyList_SetItem(ks, i, PyLong_FromLong(keys[i]));
+    PyObject *ret = call_backend(
+        "kvstore_pull_row_sparse",
+        pack_steal(PyLong_FromLong(as_id(handle)), ks,
+                   handle_list(num, vals),
+                   handle_list(num, const_cast<void **>(row_ids)),
+                   PyLong_FromLong(priority)));
+    if (!ret) return false;
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, uint32_t num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend(
+        "kvstore_pull_row_sparse",
+        pack_steal(PyLong_FromLong(as_id(handle)), string_list(num, keys),
+                   handle_list(num, vals),
+                   handle_list(num, const_cast<void **>(row_ids)),
+                   PyLong_FromLong(priority)));
+    if (!ret) return false;
+    Py_DECREF(ret);
+    return true;
+  });
+}
+
+int MXKVStorePullWithSparse(KVStoreHandle handle, uint32_t num,
+                            const int *keys, NDArrayHandle *vals,
+                            int priority, bool ignore_sparse) {
+  (void)ignore_sparse;
+  /* integer keys: stringify, the backend kvstore accepts both */
+  std::vector<std::string> skeys(num);
+  std::vector<const char *> pkeys(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    skeys[i] = std::to_string(keys[i]);
+    pkeys[i] = skeys[i].c_str();
+  }
+  return MXKVStorePull(handle, num, pkeys.data(), vals, priority);
+}
+
+int MXKVStorePullWithSparseEx(KVStoreHandle handle, uint32_t num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority, bool ignore_sparse) {
+  (void)ignore_sparse;
+  return MXKVStorePull(handle, num, keys, vals, priority);
+}
+
+/* atomic symbol creators: creator handles are interned op-name strings,
+ * the same convention as FunctionHandle */
+
+int MXSymbolListAtomicSymbolCreators(uint32_t *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  return with_backend([&]() -> bool {
+    PyObject *ret = call_backend("list_op_names", PyTuple_New(0));
+    if (!ret) return false;
+    load_string_list(ret, g_op_names, g_op_name_ptrs);
+    Py_DECREF(ret);
+    static thread_local std::vector<const void *> creators;
+    creators.resize(g_op_names.size());
+    for (size_t i = 0; i < g_op_names.size(); ++i)
+      creators[i] = g_op_names[i].c_str();
+    *out_size = static_cast<uint32_t>(creators.size());
+    *out_array = creators.data();
+    return true;
+  });
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  *name = static_cast<const char *>(creator);
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char **name, const char **description,
+    uint32_t *num_args, const char ***arg_names,
+    const char ***arg_type_infos, const char ***arg_descriptions,
+    const char **key_var_num_args, const char **return_type) {
+  if (key_var_num_args) *key_var_num_args = "";
+  return MXFuncGetInfo(static_cast<FunctionHandle>(creator), name,
+                       description, num_args, arg_names, arg_type_infos,
+                       arg_descriptions, return_type);
+}
+
+int MXSymbolCutSubgraph(SymbolHandle sym, SymbolHandle **input_symbols,
+                        uint32_t *input_size) {
+  /* control-flow subgraphs are XLA regions on this backend — there is
+   * no mutable graph to cut; report zero cut points (the reference
+   * returns the cut inputs only when a subgraph attr matches) */
+  (void)sym;
+  *input_symbols = nullptr;
+  *input_size = 0;
+  return 0;
+}
+
+int MXSymbolGetInputSymbols(SymbolHandle sym, SymbolHandle **inputs,
+                            int *input_size) {
+  return with_backend([&]() -> bool {
+    int n = 0;
+    if (!ret_handle_vec(call_backend(
+            "symbol_get_input_symbols",
+            pack_steal(PyLong_FromLong(as_id(sym)))), &n,
+            reinterpret_cast<void ***>(inputs)))
+      return false;
+    *input_size = n;
+    return true;
+  });
+}
+
+/* 64-bit / Ex infer-shape spellings: delegate to the uint32 core and
+ * widen through thread-local buffers */
+
+static thread_local std::vector<int> g_ndim_i32[3];
+static thread_local std::vector<std::vector<int64_t>> g_rows_i64[3];
+static thread_local std::vector<const int64_t *> g_ptrs_i64[3];
+
+static void widen_group(int which, uint32_t n, const uint32_t *ndim,
+                        const uint32_t **data) {
+  g_ndim_i32[which].resize(n);
+  g_rows_i64[which].assign(n, {});
+  g_ptrs_i64[which].resize(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    g_ndim_i32[which][i] = static_cast<int>(ndim[i]);
+    g_rows_i64[which][i].resize(ndim[i]);
+    for (uint32_t j = 0; j < ndim[i]; ++j)
+      g_rows_i64[which][i][j] = static_cast<int64_t>(data[i][j]);
+    g_ptrs_i64[which][i] = g_rows_i64[which][i].data();
+  }
+}
+
+int MXSymbolInferShapeEx(SymbolHandle sym, uint32_t num_args,
+                         const char **keys, const uint32_t *arg_ind_ptr,
+                         const int *arg_shape_data, uint32_t *in_shape_size,
+                         const int **in_shape_ndim,
+                         const int ***in_shape_data,
+                         uint32_t *out_shape_size,
+                         const int **out_shape_ndim,
+                         const int ***out_shape_data,
+                         uint32_t *aux_shape_size,
+                         const int **aux_shape_ndim,
+                         const int ***aux_shape_data, int *complete) {
+  /* int-typed shape spelling: convert in, run the u32 core, and since
+   * the u32 core's buffers are >=0 the int reinterpretation is safe */
+  std::vector<uint32_t> u32;
+  uint32_t total = arg_ind_ptr[num_args];
+  u32.resize(total);
+  for (uint32_t j = 0; j < total; ++j)
+    u32[j] = static_cast<uint32_t>(arg_shape_data[j]);
+  const uint32_t *in_nd, *out_nd, *aux_nd;
+  const uint32_t **in_d, **out_d, **aux_d;
+  int rc = MXSymbolInferShape(sym, num_args, keys, arg_ind_ptr, u32.data(),
+                              in_shape_size, &in_nd, &in_d, out_shape_size,
+                              &out_nd, &out_d, aux_shape_size, &aux_nd,
+                              &aux_d);
+  if (rc != 0) return rc;
+  if (complete) *complete = 1;
+  static thread_local std::vector<int> ndim_i[3];
+  static thread_local std::vector<std::vector<int>> rows_i[3];
+  static thread_local std::vector<const int *> ptrs_i[3];
+  auto widen = [](int w, uint32_t n, const uint32_t *nd,
+                  const uint32_t **dt, std::vector<int> *ndim_i,
+                  std::vector<std::vector<int>> *rows_i,
+                  std::vector<const int *> *ptrs_i) {
+    ndim_i[w].resize(n);
+    rows_i[w].assign(n, {});
+    ptrs_i[w].resize(n);
+    for (uint32_t i = 0; i < n; ++i) {
+      ndim_i[w][i] = static_cast<int>(nd[i]);
+      rows_i[w][i].resize(nd[i]);
+      for (uint32_t j = 0; j < nd[i]; ++j)
+        rows_i[w][i][j] = static_cast<int>(dt[i][j]);
+      ptrs_i[w][i] = rows_i[w][i].data();
+    }
+  };
+  widen(0, *in_shape_size, in_nd, in_d, ndim_i, rows_i, ptrs_i);
+  widen(1, *out_shape_size, out_nd, out_d, ndim_i, rows_i, ptrs_i);
+  widen(2, *aux_shape_size, aux_nd, aux_d, ndim_i, rows_i, ptrs_i);
+  *in_shape_ndim = ndim_i[0].data();
+  *in_shape_data = ptrs_i[0].data();
+  *out_shape_ndim = ndim_i[1].data();
+  *out_shape_data = ptrs_i[1].data();
+  *aux_shape_ndim = ndim_i[2].data();
+  *aux_shape_data = ptrs_i[2].data();
+  return 0;
+}
+
+int MXSymbolInferShape64(SymbolHandle sym, uint32_t num_args,
+                         const char **keys, const int64_t *arg_ind_ptr,
+                         const int64_t *arg_shape_data,
+                         size_t *in_shape_size, const int **in_shape_ndim,
+                         const int64_t ***in_shape_data,
+                         size_t *out_shape_size, const int **out_shape_ndim,
+                         const int64_t ***out_shape_data,
+                         size_t *aux_shape_size, const int **aux_shape_ndim,
+                         const int64_t ***aux_shape_data, int *complete) {
+  std::vector<uint32_t> ind(num_args + 1), data;
+  for (uint32_t i = 0; i <= num_args; ++i)
+    ind[i] = static_cast<uint32_t>(arg_ind_ptr[i]);
+  data.resize(ind[num_args]);
+  for (uint32_t j = 0; j < ind[num_args]; ++j)
+    data[j] = static_cast<uint32_t>(arg_shape_data[j]);
+  const uint32_t *in_nd, *out_nd, *aux_nd;
+  const uint32_t **in_d, **out_d, **aux_d;
+  uint32_t ni, no, na;
+  int rc = MXSymbolInferShape(sym, num_args, keys, ind.data(), data.data(),
+                              &ni, &in_nd, &in_d, &no, &out_nd, &out_d, &na,
+                              &aux_nd, &aux_d);
+  if (rc != 0) return rc;
+  if (complete) *complete = 1;
+  widen_group(0, ni, in_nd, in_d);
+  widen_group(1, no, out_nd, out_d);
+  widen_group(2, na, aux_nd, aux_d);
+  *in_shape_size = ni;
+  *in_shape_ndim = g_ndim_i32[0].data();
+  *in_shape_data = g_ptrs_i64[0].data();
+  *out_shape_size = no;
+  *out_shape_ndim = g_ndim_i32[1].data();
+  *out_shape_data = g_ptrs_i64[1].data();
+  *aux_shape_size = na;
+  *aux_shape_ndim = g_ndim_i32[2].data();
+  *aux_shape_data = g_ptrs_i64[2].data();
+  return 0;
+}
+
+int MXSymbolInferShapeEx64(SymbolHandle sym, uint32_t num_args,
+                           const char **keys, const int64_t *arg_ind_ptr,
+                           const int64_t *arg_shape_data,
+                           size_t *in_shape_size, const int **in_shape_ndim,
+                           const int64_t ***in_shape_data,
+                           size_t *out_shape_size,
+                           const int **out_shape_ndim,
+                           const int64_t ***out_shape_data,
+                           size_t *aux_shape_size,
+                           const int **aux_shape_ndim,
+                           const int64_t ***aux_shape_data, int *complete) {
+  return MXSymbolInferShape64(sym, num_args, keys, arg_ind_ptr,
+                              arg_shape_data, in_shape_size, in_shape_ndim,
+                              in_shape_data, out_shape_size, out_shape_ndim,
+                              out_shape_data, aux_shape_size, aux_shape_ndim,
+                              aux_shape_data, complete);
+}
+
+int MXSymbolInferShapePartialEx(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const uint32_t *arg_ind_ptr, const int *arg_shape_data,
+    uint32_t *in_shape_size, const int **in_shape_ndim,
+    const int ***in_shape_data, uint32_t *out_shape_size,
+    const int **out_shape_ndim, const int ***out_shape_data,
+    uint32_t *aux_shape_size, const int **aux_shape_ndim,
+    const int ***aux_shape_data, int *complete) {
+  int rc = MXSymbolInferShapeEx(sym, num_args, keys, arg_ind_ptr,
+                                arg_shape_data, in_shape_size, in_shape_ndim,
+                                in_shape_data, out_shape_size,
+                                out_shape_ndim, out_shape_data,
+                                aux_shape_size, aux_shape_ndim,
+                                aux_shape_data, complete);
+  if (rc != 0) {
+    *in_shape_size = *out_shape_size = *aux_shape_size = 0;
+    *complete = 0;
+    return 0;
+  }
+  return rc;
+}
+
+int MXSymbolInferShapePartial64(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const int64_t *arg_ind_ptr, const int64_t *arg_shape_data,
+    size_t *in_shape_size, const int **in_shape_ndim,
+    const int64_t ***in_shape_data, size_t *out_shape_size,
+    const int **out_shape_ndim, const int64_t ***out_shape_data,
+    size_t *aux_shape_size, const int **aux_shape_ndim,
+    const int64_t ***aux_shape_data, int *complete) {
+  int rc = MXSymbolInferShape64(sym, num_args, keys, arg_ind_ptr,
+                                arg_shape_data, in_shape_size, in_shape_ndim,
+                                in_shape_data, out_shape_size,
+                                out_shape_ndim, out_shape_data,
+                                aux_shape_size, aux_shape_ndim,
+                                aux_shape_data, complete);
+  if (rc != 0) {
+    *in_shape_size = *out_shape_size = *aux_shape_size = 0;
+    *complete = 0;
+    return 0;
+  }
+  return rc;
+}
+
+int MXSymbolInferShapePartialEx64(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const int64_t *arg_ind_ptr, const int64_t *arg_shape_data,
+    size_t *in_shape_size, const int **in_shape_ndim,
+    const int64_t ***in_shape_data, size_t *out_shape_size,
+    const int **out_shape_ndim, const int64_t ***out_shape_data,
+    size_t *aux_shape_size, const int **aux_shape_ndim,
+    const int64_t ***aux_shape_data, int *complete) {
+  return MXSymbolInferShapePartial64(
+      sym, num_args, keys, arg_ind_ptr, arg_shape_data, in_shape_size,
+      in_shape_ndim, in_shape_data, out_shape_size, out_shape_ndim,
+      out_shape_data, aux_shape_size, aux_shape_ndim, aux_shape_data,
+      complete);
+}
+
+}  /* extern "C" */

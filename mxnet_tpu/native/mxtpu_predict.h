@@ -230,6 +230,549 @@ int MXSetProfilerState(int state);
 int MXDumpProfile(void);
 int MXNotifyShutdown(void);
 
+
+/* ---------------------------------------------------------------------
+ * Round-3 ABI completion (ref: include/mxnet/c_api.h): CachedOp, symbol
+ * attrs/structure, executor simple_bind/reshape, autograd extras,
+ * kvstore updater + roles, profiler objects, RecordIO, legacy Function
+ * API, ndarray extras + 64-bit variants, quantization passes, DLPack.
+ * ------------------------------------------------------------------ */
+
+typedef void *CachedOpHandle;
+typedef void *ProfileHandle;
+typedef void *RecordIOHandle;
+typedef const void *FunctionHandle;
+typedef void *RtcHandle;
+typedef void *CudaModuleHandle;
+typedef void *CudaKernelHandle;
+typedef void *DLManagedTensorHandle;
+typedef int64_t dim_t;
+
+struct LibFeature {
+  const char *name;
+  int enabled; /* bool in the reference; int keeps the C ABI simple */
+};
+
+/* CachedOp */
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle *out);
+int MXCreateCachedOpEx(SymbolHandle sym, int num_flags, const char **keys,
+                       const char **vals, CachedOpHandle *out);
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs);
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes);
+int MXFreeCachedOp(CachedOpHandle handle);
+
+/* Symbol attrs / structure */
+int MXSymbolGetAttr(SymbolHandle sym, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle sym, const char *key, const char *value);
+int MXSymbolListAttr(SymbolHandle sym, uint32_t *out_size,
+                     const char ***out);
+int MXSymbolListAttrShallow(SymbolHandle sym, uint32_t *out_size,
+                            const char ***out);
+int MXSymbolGetNumOutputs(SymbolHandle sym, uint32_t *out);
+int MXSymbolGetOutput(SymbolHandle sym, uint32_t index, SymbolHandle *out);
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolPrint(SymbolHandle sym, const char **out_str);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle sym, const char *fname);
+int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXGenAtomicSymbolFromSymbol(SymbolHandle sym, SymbolHandle *out);
+int MXSymbolRemoveAmpCast(SymbolHandle sym, SymbolHandle *out);
+int MXShallowCopySymbol(SymbolHandle sym, SymbolHandle *out);
+int MXShallowCopyNDArray(NDArrayHandle nd, NDArrayHandle *out);
+int MXSymbolGrad(SymbolHandle sym, uint32_t num_wrt, const char **wrt,
+                 SymbolHandle *out);
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const uint32_t *arg_ind_ptr, const uint32_t *arg_shape_data,
+    uint32_t *in_shape_size, const uint32_t **in_shape_ndim,
+    const uint32_t ***in_shape_data, uint32_t *out_shape_size,
+    const uint32_t **out_shape_ndim, const uint32_t ***out_shape_data,
+    uint32_t *aux_shape_size, const uint32_t **aux_shape_ndim,
+    const uint32_t ***aux_shape_data, int *complete);
+int MXSymbolInferTypePartial(SymbolHandle sym, uint32_t num_args,
+                             const char **keys, const char **arg_dtypes,
+                             uint32_t *in_type_size,
+                             const char ***in_type_data,
+                             uint32_t *out_type_size,
+                             const char ***out_type_data,
+                             uint32_t *aux_type_size,
+                             const char ***aux_type_data);
+
+/* Executor */
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         uint32_t num_args, const char **arg_names,
+                         const uint32_t *arg_ind_ptr,
+                         const uint32_t *arg_shape_data,
+                         const char *grad_req, ExecutorHandle *out,
+                         uint32_t *num_arg_arrays, NDArrayHandle **arg_arrays,
+                         NDArrayHandle **grad_arrays, uint32_t *num_aux,
+                         NDArrayHandle **aux_arrays);
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing, int dev_type,
+                      int dev_id, uint32_t num_args, const char **arg_names,
+                      const uint32_t *arg_ind_ptr,
+                      const uint32_t *arg_shape_data,
+                      ExecutorHandle shared_exec, ExecutorHandle *out,
+                      uint32_t *num_arg_arrays, NDArrayHandle **arg_arrays,
+                      NDArrayHandle **grad_arrays, uint32_t *num_aux,
+                      NDArrayHandle **aux_arrays);
+int MXExecutorOutputs(ExecutorHandle handle, uint32_t *out_size,
+                      NDArrayHandle **out);
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+int MXExecutorGetOptimizedSymbol(ExecutorHandle handle, SymbolHandle *out);
+typedef void (*ExecutorMonitorCallback)(const char *, NDArrayHandle, void *);
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle);
+#ifdef __cplusplus
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle handle,
+                                   ExecutorMonitorCallback callback,
+                                   void *callback_handle, bool monitor_all);
+#endif
+
+/* Autograd extras */
+int MXAutogradBackwardEx(uint32_t num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles,
+                         uint32_t num_variables, NDArrayHandle *var_handles,
+                         int retain_graph, int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes);
+int MXAutogradComputeGradient(uint32_t num_output,
+                              NDArrayHandle *output_handles);
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out);
+
+/* KVStore updater / roles / commands */
+typedef void (*MXKVStoreUpdater)(int, NDArrayHandle, NDArrayHandle, void *);
+typedef void (*MXKVStoreStrUpdater)(const char *, NDArrayHandle,
+                                    NDArrayHandle, void *);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle);
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       void (*controller)(int, const char *, void *),
+                       void *controller_handle);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number);
+int MXKVStoreSetGradientCompression(KVStoreHandle handle,
+                                    uint32_t num_params, const char **keys,
+                                    const char **vals);
+int MXInitPSEnv(uint32_t num_vars, const char **keys, const char **vals);
+int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+
+/* Profiler */
+int MXSetProfilerConfig(int num_params, const char *const *keys,
+                        const char *const *vals);
+int MXSetProcessProfilerConfig(int num_params, const char *const *keys,
+                               const char *const *vals,
+                               KVStoreHandle kv_handle);
+int MXSetProcessProfilerState(int state, int profile_process,
+                              KVStoreHandle kv_handle);
+int MXDumpProcessProfile(int finished, int profile_process,
+                         KVStoreHandle kv_handle);
+int MXAggregateProfileStatsPrint(const char **out_str, int reset);
+int MXAggregateProfileStatsPrintEx(const char **out_str, int reset,
+                                   int format, int sort_by, int ascending);
+int MXProfilePause(int paused);
+int MXProcessProfilePause(int paused, int profile_process,
+                          KVStoreHandle kv_handle);
+int MXProfileCreateDomain(const char *domain, ProfileHandle *out);
+int MXProfileCreateTask(ProfileHandle domain, const char *task_name,
+                        ProfileHandle *out);
+int MXProfileCreateFrame(ProfileHandle domain, const char *frame_name,
+                         ProfileHandle *out);
+int MXProfileCreateEvent(const char *event_name, ProfileHandle *out);
+int MXProfileCreateCounter(ProfileHandle domain, const char *counter_name,
+                           ProfileHandle *out);
+int MXProfileDestroyHandle(ProfileHandle handle);
+int MXProfileDurationStart(ProfileHandle handle);
+int MXProfileDurationStop(ProfileHandle handle);
+int MXProfileSetCounter(ProfileHandle handle, uint64_t value);
+int MXProfileAdjustCounter(ProfileHandle handle, int64_t value);
+int MXProfileSetMarker(ProfileHandle domain, const char *name,
+                       const char *scope);
+
+/* RecordIO */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
+                               size_t *size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos);
+
+/* Legacy Function API */
+int MXListFunctions(uint32_t *out_size, FunctionHandle **out_array);
+int MXGetFunction(const char *name, FunctionHandle *out);
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, uint32_t *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions, const char **return_type);
+int MXFuncDescribe(FunctionHandle fun, uint32_t *num_use_vars,
+                   uint32_t *num_scalars, uint32_t *num_mutate_vars,
+                   int *type_mask);
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars, float *scalars,
+                 NDArrayHandle *mutate_vars);
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   float *scalars, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals);
+
+/* NDArray extras / 64-bit */
+int MXNDArrayCreateEx(const uint32_t *shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArrayCreateEx64(const int64_t *shape, int ndim, int dev_type,
+                        int dev_id, int delay_alloc, int dtype,
+                        NDArrayHandle *out);
+int MXNDArrayCreateNone(NDArrayHandle *out);
+int MXNDArrayGetShapeEx(NDArrayHandle handle, int *out_dim,
+                        const int **out_pdata);
+int MXNDArrayGetShape64(NDArrayHandle handle, int *out_dim,
+                        const int64_t **out_pdata);
+int MXNDArrayGetShapeEx64(NDArrayHandle handle, int *out_dim,
+                          const int64_t **out_pdata);
+int MXNDArrayAt64(NDArrayHandle handle, int64_t idx, NDArrayHandle *out);
+int MXNDArraySlice64(NDArrayHandle handle, int64_t begin, int64_t end,
+                     NDArrayHandle *out);
+#ifdef __cplusplus
+int MXNDArrayReshape64(NDArrayHandle handle, int ndim, dim_t *dims,
+                       bool reverse, NDArrayHandle *out);
+#endif
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArraySetGradState(NDArrayHandle handle, int state);
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf);
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out);
+int MXNDArrayLoadFromBuffer(const void *buf, size_t size,
+                            uint32_t *out_size, NDArrayHandle **out_arr,
+                            uint32_t *out_name_size,
+                            const char ***out_names);
+int MXNDArrayLoadFromBuffer64(const void *buf, size_t size,
+                              uint32_t *out_size, NDArrayHandle **out_arr,
+                              uint32_t *out_name_size,
+                              const char ***out_names);
+int MXNDArrayLoad64(const char *fname, uint32_t *out_size,
+                    NDArrayHandle **out_arr, uint32_t *out_name_size,
+                    const char ***out_names);
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src, int i);
+#ifdef __cplusplus
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const bool full_check);
+#endif
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArrayCreateSparseEx(int storage_type, const uint32_t *shape,
+                            uint32_t ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, uint32_t num_aux,
+                            int *aux_type, uint32_t *aux_ndims,
+                            const uint32_t *aux_shape, NDArrayHandle *out);
+int MXNDArrayCreateSparseEx64(int storage_type, const int64_t *shape,
+                              int ndim, int dev_type, int dev_id,
+                              int delay_alloc, int dtype, uint32_t num_aux,
+                              int *aux_type, int *aux_ndims,
+                              const int64_t *aux_shape, NDArrayHandle *out);
+int MXNDArrayGetAuxType(NDArrayHandle handle, uint32_t i, int *out_type);
+int MXNDArrayGetAuxType64(NDArrayHandle handle, int64_t i, int *out_type);
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, uint32_t i,
+                           NDArrayHandle *out);
+int MXNDArrayGetAuxNDArray64(NDArrayHandle handle, int64_t i,
+                             NDArrayHandle *out);
+int MXNDArrayGetSharedMemHandle(NDArrayHandle handle, int *shared_pid,
+                                int *shared_id);
+int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                 const uint32_t *shape, uint32_t ndim,
+                                 int dtype, NDArrayHandle *out);
+int MXNDArrayCreateFromSharedMemEx(int shared_pid, int shared_id,
+                                   const int *shape, int ndim, int dtype,
+                                   NDArrayHandle *out);
+
+/* DLPack */
+int MXNDArrayToDLPack(NDArrayHandle handle,
+                      DLManagedTensorHandle *out_dlpack);
+int MXNDArrayFromDLPack(DLManagedTensorHandle dlpack, NDArrayHandle *out);
+#ifdef __cplusplus
+int MXNDArrayFromDLPackEx(DLManagedTensorHandle dlpack,
+                          const bool transient_handle, NDArrayHandle *out);
+#endif
+int MXNDArrayCallDLPackDeleter(DLManagedTensorHandle dlpack);
+
+/* Engine (NaiveEngine semantics) */
+int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size);
+typedef void (*EngineSyncFunc)(void *, void *);
+typedef void (*EngineAsyncFunc)(void *, void *, void *);
+typedef void (*EngineFuncParamDeleter)(void *);
+int MXEnginePushSync(EngineSyncFunc sync_func, void *func_param,
+                     EngineFuncParamDeleter deleter, void *ctx_handle,
+                     void *const_vars_handle, int num_const_vars,
+                     void *mutable_vars_handle, int num_mutable_vars,
+                     void *prop_handle, int priority, const char *opr_name);
+#ifdef __cplusplus
+int MXEnginePushAsync(EngineAsyncFunc async_func, void *func_param,
+                      EngineFuncParamDeleter deleter, void *ctx_handle,
+                      void *const_vars_handle, int num_const_vars,
+                      void *mutable_vars_handle, int num_mutable_vars,
+                      void *prop_handle, int priority, const char *opr_name,
+                      bool wait);
+#endif
+int MXEnginePushSyncND(EngineSyncFunc sync_func, void *func_param,
+                       EngineFuncParamDeleter deleter, void *ctx_handle,
+                       NDArrayHandle *const_nds, int num_const_nds,
+                       NDArrayHandle *mutable_nds, int num_mutable_nds,
+                       void *prop_handle, int priority,
+                       const char *opr_name);
+#ifdef __cplusplus
+int MXEnginePushAsyncND(EngineAsyncFunc async_func, void *func_param,
+                        EngineFuncParamDeleter deleter, void *ctx_handle,
+                        NDArrayHandle *const_nds, int num_const_nds,
+                        NDArrayHandle *mutable_nds, int num_mutable_nds,
+                        void *prop_handle, int priority,
+                        const char *opr_name, bool wait);
+#endif
+
+/* Quantization / graph passes */
+#ifdef __cplusplus
+int MXQuantizeSymbol(SymbolHandle sym_handle, SymbolHandle *ret_sym_handle,
+                     const uint32_t num_excluded_symbols,
+                     const char **excluded_symbols,
+                     const uint32_t num_offline,
+                     const char **offline_params,
+                     const char *quantized_dtype, const bool calib_quantize);
+#endif
+int MXReducePrecisionSymbol(SymbolHandle sym_handle,
+                            SymbolHandle *ret_sym_handle, uint32_t num_args,
+                            const int *arg_type_data, uint32_t num_ind_ptr,
+                            const int *ind_ptr, const int *target_dtype,
+                            const int cast_optional_params,
+                            const uint32_t num_target_dtype_ops,
+                            const char **target_dtype_ops,
+                            const uint32_t num_fp32_ops,
+                            const char **fp32_ops,
+                            const uint32_t num_widest_dtype_ops,
+                            const char **widest_dtype_ops,
+                            const uint32_t num_conditional_fp32_ops,
+                            const char **conditional_fp32_ops,
+                            const uint32_t num_excluded_symbols,
+                            const char **excluded_symbols,
+                            const char **arg_names);
+int MXSetCalibTableToQuantizedSymbol(SymbolHandle qsym_handle,
+                                     const uint32_t num_layers,
+                                     const char **layer_names,
+                                     const float *low_quantiles,
+                                     const float *high_quantiles,
+                                     SymbolHandle *ret_sym_handle);
+int MXGenBackendSubgraph(SymbolHandle sym_handle, const char *backend,
+                         SymbolHandle *ret_sym_handle);
+int MXOptimizeForBackend(SymbolHandle sym_handle, const char *backend,
+                         const int dev_type, SymbolHandle *ret_sym_handle,
+                         const uint32_t args_len, NDArrayHandle *in_args,
+                         const uint32_t aux_len, NDArrayHandle *in_aux,
+                         const uint32_t num_options, const char **keys,
+                         const char **vals, int **new_args_cnt,
+                         NDArrayHandle **new_args_handle,
+                         char ***new_arg_names_handle, int **new_aux_cnt,
+                         NDArrayHandle **new_aux_handle,
+                         char ***new_aux_names_handle);
+
+/* Misc */
+int MXIsNumpyShape(int *curr);
+int MXSetIsNumpyShape(int is_np_shape, int *prev);
+int MXSetNumOMPThreads(int thread_num);
+int MXStorageEmptyCache(int dev_type, int dev_id);
+int MXGetGPUMemoryInformation(int dev, int *free_mem, int *total_mem);
+int MXGetGPUMemoryInformation64(int dev, uint64_t *free_mem,
+                                uint64_t *total_mem);
+int MXLibInfoFeatures(const struct LibFeature **lib_feature, size_t *size);
+int MXRandomSeedContext(int seed, int dev_type, int dev_id);
+int MXLoadLib(const char *path);
+
+/* CUDA-only families: exported with honest unsupported errors */
+int MXRtcCreate(char *name, uint32_t num_input, uint32_t num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out);
+int MXRtcPush(RtcHandle handle, uint32_t num_input, uint32_t num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              uint32_t gridDimX, uint32_t gridDimY, uint32_t gridDimZ,
+              uint32_t blockDimX, uint32_t blockDimY, uint32_t blockDimZ);
+int MXRtcFree(RtcHandle handle);
+int MXRtcCudaModuleCreate(const char *source, int num_options,
+                          const char **options, int num_exports,
+                          const char **exports, CudaModuleHandle *out);
+int MXRtcCudaModuleFree(CudaModuleHandle handle);
+int MXRtcCudaKernelCreate(CudaModuleHandle handle, const char *name,
+                          int num_args, int *is_ndarray, int *is_const,
+                          int *arg_types, CudaKernelHandle *out);
+int MXRtcCudaKernelFree(CudaKernelHandle handle);
+int MXRtcCudaKernelCall(CudaKernelHandle handle, int dev_id, void **args,
+                        uint32_t grid_dim_x, uint32_t grid_dim_y,
+                        uint32_t grid_dim_z, uint32_t block_dim_x,
+                        uint32_t block_dim_y, uint32_t block_dim_z,
+                        uint32_t shared_mem);
+int MXLoadTVMOp(const char *libpath);
+int MXCustomOpRegister(const char *op_type, void *creator);
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                           int num_outputs, NDArrayHandle *outputs,
+                           void *callbacks);
+
+
+/* Final delegation tier */
+typedef const void *AtomicSymbolCreator;
+typedef const void *DataIterCreator;
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, uint32_t *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXExecutorBackwardEx(ExecutorHandle handle, uint32_t len,
+                         NDArrayHandle *head_grads, int is_train);
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    uint32_t num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    uint32_t len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, uint32_t *grad_req_type,
+                    uint32_t aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out);
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     uint32_t num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     uint32_t len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, uint32_t *grad_req_type,
+                     uint32_t aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out);
+int MXExecutorSimpleBindEx(SymbolHandle sym, int dev_type, int dev_id,
+                           uint32_t num_args, const char **arg_names,
+                           const uint32_t *arg_ind_ptr,
+                           const uint32_t *arg_shape_data,
+                           const char *grad_req, ExecutorHandle *out,
+                           uint32_t *num_arg_arrays,
+                           NDArrayHandle **arg_arrays,
+                           NDArrayHandle **grad_arrays, uint32_t *num_aux,
+                           NDArrayHandle **aux_arrays);
+int MXExecutorReshapeEx(int partial_shaping, int allow_up_sizing,
+                        int dev_type, int dev_id, uint32_t num_args,
+                        const char **arg_names, const uint32_t *arg_ind_ptr,
+                        const uint32_t *arg_shape_data,
+                        ExecutorHandle shared_exec, ExecutorHandle *out,
+                        uint32_t *num_arg_arrays,
+                        NDArrayHandle **arg_arrays,
+                        NDArrayHandle **grad_arrays, uint32_t *num_aux,
+                        NDArrayHandle **aux_arrays);
+int MXImperativeInvokeEx(const char *op_name, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle ***outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes);
+int MXKVStorePullRowSparse(KVStoreHandle handle, uint32_t num,
+                           const int *keys, NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority);
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, uint32_t num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority);
+#ifdef __cplusplus
+int MXKVStorePullWithSparse(KVStoreHandle handle, uint32_t num,
+                            const int *keys, NDArrayHandle *vals,
+                            int priority, bool ignore_sparse);
+int MXKVStorePullWithSparseEx(KVStoreHandle handle, uint32_t num,
+                              const char **keys, NDArrayHandle *vals,
+                              int priority, bool ignore_sparse);
+#endif
+int MXSymbolListAtomicSymbolCreators(uint32_t *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char **name,
+    const char **description, uint32_t *num_args, const char ***arg_names,
+    const char ***arg_type_infos, const char ***arg_descriptions,
+    const char **key_var_num_args, const char **return_type);
+int MXSymbolCutSubgraph(SymbolHandle sym, SymbolHandle **input_symbols,
+                        uint32_t *input_size);
+int MXSymbolGetInputSymbols(SymbolHandle sym, SymbolHandle **inputs,
+                            int *input_size);
+int MXSymbolInferShapeEx(SymbolHandle sym, uint32_t num_args,
+                         const char **keys, const uint32_t *arg_ind_ptr,
+                         const int *arg_shape_data, uint32_t *in_shape_size,
+                         const int **in_shape_ndim,
+                         const int ***in_shape_data,
+                         uint32_t *out_shape_size,
+                         const int **out_shape_ndim,
+                         const int ***out_shape_data,
+                         uint32_t *aux_shape_size,
+                         const int **aux_shape_ndim,
+                         const int ***aux_shape_data, int *complete);
+int MXSymbolInferShape64(SymbolHandle sym, uint32_t num_args,
+                         const char **keys, const int64_t *arg_ind_ptr,
+                         const int64_t *arg_shape_data,
+                         size_t *in_shape_size, const int **in_shape_ndim,
+                         const int64_t ***in_shape_data,
+                         size_t *out_shape_size, const int **out_shape_ndim,
+                         const int64_t ***out_shape_data,
+                         size_t *aux_shape_size, const int **aux_shape_ndim,
+                         const int64_t ***aux_shape_data, int *complete);
+int MXSymbolInferShapeEx64(SymbolHandle sym, uint32_t num_args,
+                           const char **keys, const int64_t *arg_ind_ptr,
+                           const int64_t *arg_shape_data,
+                           size_t *in_shape_size,
+                           const int **in_shape_ndim,
+                           const int64_t ***in_shape_data,
+                           size_t *out_shape_size,
+                           const int **out_shape_ndim,
+                           const int64_t ***out_shape_data,
+                           size_t *aux_shape_size,
+                           const int **aux_shape_ndim,
+                           const int64_t ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartialEx(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const uint32_t *arg_ind_ptr, const int *arg_shape_data,
+    uint32_t *in_shape_size, const int **in_shape_ndim,
+    const int ***in_shape_data, uint32_t *out_shape_size,
+    const int **out_shape_ndim, const int ***out_shape_data,
+    uint32_t *aux_shape_size, const int **aux_shape_ndim,
+    const int ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartial64(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const int64_t *arg_ind_ptr, const int64_t *arg_shape_data,
+    size_t *in_shape_size, const int **in_shape_ndim,
+    const int64_t ***in_shape_data, size_t *out_shape_size,
+    const int **out_shape_ndim, const int64_t ***out_shape_data,
+    size_t *aux_shape_size, const int **aux_shape_ndim,
+    const int64_t ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartialEx64(
+    SymbolHandle sym, uint32_t num_args, const char **keys,
+    const int64_t *arg_ind_ptr, const int64_t *arg_shape_data,
+    size_t *in_shape_size, const int **in_shape_ndim,
+    const int64_t ***in_shape_data, size_t *out_shape_size,
+    const int **out_shape_ndim, const int64_t ***out_shape_data,
+    size_t *aux_shape_size, const int **aux_shape_ndim,
+    const int64_t ***aux_shape_data, int *complete);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

@@ -1,0 +1,189 @@
+/* Round-3 ABI families, consumed from PURE C (no python in this file):
+ * CachedOp, symbol attrs, simple_bind/reshape/outputs, RecordIO,
+ * profiler objects, raw-bytes round trip, kvstore updater callback,
+ * atomic creators, numpy-shape toggle, LibInfoFeatures, honest Rtc error.
+ * ref roles: include/mxnet/c_api.h. */
+#include <stdio.h>
+#include <string.h>
+#include <stdint.h>
+#include "mxtpu_predict.h"
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "FAIL %s:%d %s: %s\n", __FILE__, __LINE__, #cond, \
+              MXGetLastError());                                        \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+static int g_updater_calls = 0;
+static void my_updater(const char *key, NDArrayHandle recv,
+                       NDArrayHandle local, void *h) {
+  /* re-enter the ABI from inside the callback — the real usage pattern
+   * (apply recv into local); regression for the recursive-lock fix */
+  float buf[6];
+  (void)key; (void)local; (void)h;
+  if (MXNDArraySyncCopyToCPU(recv, buf, sizeof(buf)) == 0 &&
+      buf[5] == 5.0f)
+    g_updater_calls++;
+}
+
+int main(void) {
+  /* symbol: x -> square, with attrs */
+  SymbolHandle x, sq;
+  CHECK(MXSymbolCreateVariable("x", &x) == 0);
+  CHECK(MXSymbolCreateAtomicSymbol("square", 0, NULL, NULL, &sq) == 0);
+  SymbolHandle args1[] = {x};
+  CHECK(MXSymbolCompose(sq, "sq", 1, NULL, args1) == 0);
+  CHECK(MXSymbolSetAttr(sq, "lr_mult", "2.5") == 0);
+  const char *attr_val; int success = 0;
+  CHECK(MXSymbolGetAttr(sq, "lr_mult", &attr_val, &success) == 0);
+  CHECK(success == 1 && strcmp(attr_val, "2.5") == 0);
+  uint32_t n_attr = 0; const char **attrs;
+  CHECK(MXSymbolListAttrShallow(sq, &n_attr, &attrs) == 0);
+  CHECK(n_attr >= 1);
+  uint32_t n_out = 0;
+  CHECK(MXSymbolGetNumOutputs(sq, &n_out) == 0);
+  CHECK(n_out == 1);
+
+  /* ndarray input 2x3 = [0..5] */
+  uint32_t shape[] = {2, 3};
+  float vals[6] = {0, 1, 2, 3, 4, 5};
+  NDArrayHandle a;
+  CHECK(MXNDArrayCreateFromBytes(vals, sizeof(vals), shape, 2, "float32",
+                                 &a) == 0);
+
+  /* CachedOp: invoke twice (second hits the signature cache) */
+  CachedOpHandle cop;
+  CHECK(MXCreateCachedOp(sq, &cop) == 0);
+  int nco = 0; NDArrayHandle *couts;
+  NDArrayHandle cin[] = {a};
+  CHECK(MXInvokeCachedOp(cop, 1, cin, &nco, &couts) == 0);
+  CHECK(nco == 1);
+  float got[6]; uint64_t sz = 6;
+  CHECK(MXNDArraySyncCopyToCPU(couts[0], got, sz * sizeof(float)) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(got[i] == (float)(i * i));
+  CHECK(MXInvokeCachedOp(cop, 1, cin, &nco, &couts) == 0);
+  CHECK(MXFreeCachedOp(cop) == 0);
+  printf("cachedop_ok=1\n");
+
+  /* simple_bind + outputs + reshape */
+  const char *arg_names[] = {"x"};
+  uint32_t ind[] = {0, 2};
+  uint32_t shp_data[] = {2, 3};
+  ExecutorHandle exe; uint32_t n_args = 0, n_aux = 0;
+  NDArrayHandle *arg_arr, *grad_arr, *aux_arr;
+  CHECK(MXExecutorSimpleBind(sq, 1, 0, 1, arg_names, ind, shp_data, "null",
+                             &exe, &n_args, &arg_arr, &grad_arr, &n_aux,
+                             &aux_arr) == 0);
+  CHECK(n_args == 1);
+  CHECK(MXNDArraySyncCopyFromCPU(arg_arr[0], vals, 6 * sizeof(float)) == 0);
+  uint32_t n_fo = 0; NDArrayHandle *fouts;
+  CHECK(MXExecutorForward(exe, 0, &n_fo, &fouts) == 0);
+  uint32_t n_eo = 0; NDArrayHandle *eouts;
+  CHECK(MXExecutorOutputs(exe, &n_eo, &eouts) == 0);
+  CHECK(n_eo == 1);
+  CHECK(MXNDArraySyncCopyToCPU(eouts[0], got, 6 * sizeof(float)) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(got[i] == (float)(i * i));
+  uint32_t shp2[] = {4, 3};
+  ExecutorHandle exe2; uint32_t n_args2 = 0, n_aux2 = 0;
+  NDArrayHandle *arg2, *grad2, *aux2;
+  CHECK(MXExecutorReshape(0, 1, 1, 0, 1, arg_names, ind, shp2, exe, &exe2,
+                          &n_args2, &arg2, &grad2, &n_aux2, &aux2) == 0);
+  int ndim = 0; const int *pshape;
+  CHECK(MXNDArrayGetShapeEx(arg2[0], &ndim, &pshape) == 0);
+  CHECK(ndim == 2 && pshape[0] == 4 && pshape[1] == 3);
+  printf("simplebind_ok=1\n");
+
+  /* raw-bytes round trip + storage type + shape64 + detach */
+  size_t raw_n = 0; const char *raw;
+  CHECK(MXNDArraySaveRawBytes(a, &raw_n, &raw) == 0);
+  char raw_copy[4096];
+  CHECK(raw_n < sizeof(raw_copy));
+  memcpy(raw_copy, raw, raw_n);
+  NDArrayHandle a2;
+  CHECK(MXNDArrayLoadFromRawBytes(raw_copy, raw_n, &a2) == 0);
+  int ndim64 = 0; const int64_t *p64;
+  CHECK(MXNDArrayGetShape64(a2, &ndim64, &p64) == 0);
+  CHECK(ndim64 == 2 && p64[0] == 2 && p64[1] == 3);
+  int stype = -1;
+  CHECK(MXNDArrayGetStorageType(a2, &stype) == 0);
+  CHECK(stype == 0);
+  NDArrayHandle det;
+  CHECK(MXNDArrayDetach(a2, &det) == 0);
+  printf("rawbytes_ok=1\n");
+
+  /* RecordIO */
+  RecordIOHandle w, r;
+  CHECK(MXRecordIOWriterCreate("r3.rec", &w) == 0);
+  CHECK(MXRecordIOWriterWriteRecord(w, "alpha", 5) == 0);
+  CHECK(MXRecordIOWriterWriteRecord(w, "bravo!", 6) == 0);
+  size_t wpos = 0;
+  CHECK(MXRecordIOWriterTell(w, &wpos) == 0);
+  CHECK(MXRecordIOWriterFree(w) == 0);
+  CHECK(MXRecordIOReaderCreate("r3.rec", &r) == 0);
+  const char *rec; size_t rec_n = 0;
+  CHECK(MXRecordIOReaderReadRecord(r, &rec, &rec_n) == 0);
+  CHECK(rec_n == 5 && memcmp(rec, "alpha", 5) == 0);
+  CHECK(MXRecordIOReaderReadRecord(r, &rec, &rec_n) == 0);
+  CHECK(rec_n == 6 && memcmp(rec, "bravo!", 6) == 0);
+  CHECK(MXRecordIOReaderSeek(r, 0) == 0);
+  CHECK(MXRecordIOReaderReadRecord(r, &rec, &rec_n) == 0);
+  CHECK(rec_n == 5 && memcmp(rec, "alpha", 5) == 0);
+  CHECK(MXRecordIOReaderFree(r) == 0);
+  printf("recordio_ok=1\n");
+
+  /* profiler objects */
+  ProfileHandle dom, task;
+  CHECK(MXProfileCreateDomain("r3", &dom) == 0);
+  CHECK(MXProfileCreateTask(dom, "work", &task) == 0);
+  CHECK(MXProfileDurationStart(task) == 0);
+  CHECK(MXProfileDurationStop(task) == 0);
+  CHECK(MXProfileDestroyHandle(task) == 0);
+  printf("profiler_ok=1\n");
+
+  /* kvstore local with a C updater callback */
+  KVStoreHandle kv;
+  CHECK(MXKVStoreCreate("local", &kv) == 0);
+  const char *kkeys[] = {"w"};
+  NDArrayHandle kvals[] = {a};
+  CHECK(MXKVStoreInit(kv, 1, kkeys, kvals) == 0);
+  CHECK(MXKVStoreSetUpdaterEx(kv, NULL, my_updater, NULL) == 0);
+  CHECK(MXKVStorePush(kv, 1, kkeys, kvals, 0) == 0);
+  CHECK(g_updater_calls == 1);
+  int is_worker = -1;
+  CHECK(MXKVStoreIsWorkerNode(&is_worker) == 0);
+  CHECK(is_worker == 1);
+  CHECK(MXKVStoreFree(kv) == 0);
+  printf("kvupdater_ok=1\n");
+
+  /* atomic creators + function info */
+  uint32_t n_create = 0; AtomicSymbolCreator *creators;
+  CHECK(MXSymbolListAtomicSymbolCreators(&n_create, &creators) == 0);
+  CHECK(n_create > 500);
+  const char *opname;
+  CHECK(MXSymbolGetAtomicSymbolName(creators[0], &opname) == 0);
+  CHECK(opname && opname[0]);
+
+  /* numpy-shape toggle */
+  int prev = -1, curr = -1;
+  CHECK(MXSetIsNumpyShape(1, &prev) == 0);
+  CHECK(MXIsNumpyShape(&curr) == 0);
+  CHECK(curr == 1);
+  CHECK(MXSetIsNumpyShape(0, &prev) == 0);
+
+  /* lib features */
+  const struct LibFeature *feats; size_t n_feats = 0;
+  CHECK(MXLibInfoFeatures(&feats, &n_feats) == 0);
+  CHECK(n_feats >= 5);
+
+  /* CUDA RTC: exported, honestly unsupported */
+  RtcHandle rtc;
+  CHECK(MXRtcCreate((char *)"k", 0, 0, NULL, NULL, NULL, NULL,
+                    (char *)"", &rtc) == -1);
+  CHECK(strstr(MXGetLastError(), "TPU") != NULL);
+
+  printf("C_API_R3_OK\n");
+  return 0;
+}

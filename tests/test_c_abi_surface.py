@@ -1,0 +1,46 @@
+"""C ABI surface count test (VERDICT r2 item 8).
+
+The reference exports 234 `MX*` entry points (extracted from
+include/mxnet/c_api.h into the checked-in tests/data/c_api_symbols_ref.txt).
+Every one must resolve in libmxtpu_capi.so — families that cannot exist on
+TPU (MXRtc*/TVM) are still exported and return an honest error, mirroring
+the reference's disabled-build-flag behavior.
+"""
+import ctypes
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "mxnet_tpu", "native")
+REF_LIST = os.path.join(ROOT, "tests", "data", "c_api_symbols_ref.txt")
+
+
+def _build_capi(tmp_path):
+    out = os.path.join(str(tmp_path), "libmxtpu_capi.so")
+    includes = subprocess.run(
+        [sys.executable + "-config" if False else "python3-config",
+         "--includes"], capture_output=True, text=True).stdout.split()
+    prefix = subprocess.run(["python3-config", "--prefix"],
+                            capture_output=True, text=True).stdout.strip()
+    cmd = ["g++", "-O1", "-std=c++17", "-shared", "-fPIC",
+           os.path.join(NATIVE, "c_predict_api.cc"), *includes,
+           f"-L{prefix}/lib", "-lpython3.12", "-o", out]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-3000:]
+    return out
+
+
+def test_every_reference_symbol_exports(tmp_path):
+    with open(REF_LIST) as f:
+        ref_names = [ln.strip() for ln in f if ln.strip()]
+    assert len(ref_names) == 234
+    lib_path = _build_capi(tmp_path)
+    lib = ctypes.CDLL(lib_path)
+    missing = [n for n in ref_names if not hasattr(lib, n)]
+    assert not missing, f"{len(missing)} reference ABI symbols absent: " \
+                        f"{missing[:20]}"
+    # the error channel itself
+    assert hasattr(lib, "MXGetLastError")

@@ -200,3 +200,28 @@ def test_c_predict_end_to_end(tmp_path):
     n_ops = int([l for l in proc.stdout.splitlines()
                  if l.startswith("n_ops=")][0][6:])
     assert n_ops > 500
+
+
+def test_c_abi_round3_families(tmp_path):
+    """CachedOp / symbol attrs / simple_bind+reshape / RecordIO /
+    profiler objects / kvstore C updater / raw bytes — consumed from
+    pure C (VERDICT r2 item 8; ref include/mxnet/c_api.h families)."""
+    from mxnet_tpu.native import build_capi
+    build_capi()
+    c_src = os.path.join(ROOT, "tests", "cpredict", "test_c_api_r3.c")
+    c_bin = str(tmp_path / "test_c_api_r3")
+    subprocess.run(["gcc", "-O2", c_src, f"-I{NATIVE}", f"-L{NATIVE}",
+                    "-lmxtpu_capi", f"-Wl,-rpath,{NATIVE}", "-o", c_bin],
+                   check=True, capture_output=True)
+    import site
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + site.getsitepackages()[0]
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([c_bin], env=env, cwd=str(tmp_path),
+                          capture_output=True, text=True, timeout=380)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"C r3 ABI test failed:\n{out[-3000:]}"
+    for marker in ("cachedop_ok=1", "simplebind_ok=1", "rawbytes_ok=1",
+                   "recordio_ok=1", "profiler_ok=1", "kvupdater_ok=1",
+                   "C_API_R3_OK"):
+        assert marker in out, f"missing {marker}:\n{out[-2000:]}"
