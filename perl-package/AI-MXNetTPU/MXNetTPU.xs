@@ -1,0 +1,216 @@
+/* AI::MXNetTPU — thin Perl binding over the mxtpu C ABI.
+ *
+ * Role model: the reference's perl-package/AI-MXNet (38k LoC of
+ * generated OO wrappers). This binding is deliberately MINIMAL — it
+ * exists to prove the inverted C ABI (embedded CPython behind
+ * libmxtpu_capi.so) serves any XS-capable language, not to re-grow the
+ * full surface: NDArray round trips, imperative op invocation, symbol
+ * loading and a predictor. Everything routes through the same MX*
+ * entry points the C/C++ consumers use (mxtpu_predict.h).
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "mxtpu_predict.h"
+
+static void croak_mx(pTHX_ const char *what) {
+  croak("%s failed: %s", what, MXGetLastError());
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+const char *
+mx_last_error()
+  CODE:
+    RETVAL = MXGetLastError();
+  OUTPUT:
+    RETVAL
+
+int
+mx_version()
+  CODE:
+    int v = 0;
+    if (MXGetVersion(&v) != 0) croak_mx(aTHX_ "MXGetVersion");
+    RETVAL = v;
+  OUTPUT:
+    RETVAL
+
+void *
+nd_from_floats(AV *vals, AV *shape)
+  CODE:
+    size_t n = av_count(vals);
+    float *buf = (float *)malloc(n * sizeof(float));
+    size_t i;
+    for (i = 0; i < n; ++i)
+      buf[i] = (float)SvNV(*av_fetch(vals, i, 0));
+    size_t nd = av_count(shape);
+    uint32_t shp[8];
+    for (i = 0; i < nd && i < 8; ++i)
+      shp[i] = (uint32_t)SvUV(*av_fetch(shape, i, 0));
+    NDArrayHandle h;
+    int rc = MXNDArrayCreateFromBytes(buf, n * sizeof(float), shp,
+                                      (uint32_t)nd, "float32", &h);
+    free(buf);
+    if (rc != 0) croak_mx(aTHX_ "MXNDArrayCreateFromBytes");
+    RETVAL = h;
+  OUTPUT:
+    RETVAL
+
+AV *
+nd_to_floats(void *h)
+  CODE:
+    int ndim = 0;
+    const int *pshape;
+    if (MXNDArrayGetShapeEx(h, &ndim, &pshape) != 0)
+      croak_mx(aTHX_ "MXNDArrayGetShapeEx");
+    size_t n = 1;
+    int i;
+    for (i = 0; i < ndim; ++i) n *= (size_t)pshape[i];
+    float *buf = (float *)malloc(n * sizeof(float));
+    if (MXNDArraySyncCopyToCPU(h, buf, n * sizeof(float)) != 0) {
+      free(buf);
+      croak_mx(aTHX_ "MXNDArraySyncCopyToCPU");
+    }
+    AV *out = newAV();
+    size_t j;
+    for (j = 0; j < n; ++j) av_push(out, newSVnv(buf[j]));
+    free(buf);
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+AV *
+nd_shape(void *h)
+  CODE:
+    int ndim = 0;
+    const int *pshape;
+    if (MXNDArrayGetShapeEx(h, &ndim, &pshape) != 0)
+      croak_mx(aTHX_ "MXNDArrayGetShapeEx");
+    AV *out = newAV();
+    int i;
+    for (i = 0; i < ndim; ++i) av_push(out, newSViv(pshape[i]));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void
+nd_free(void *h)
+  CODE:
+    MXNDArrayFree(h);
+
+void *
+op_invoke1(const char *op_name, AV *in_handles, AV *pkeys, AV *pvals)
+  CODE:
+    int n_in = (int)av_count(in_handles);
+    void *ins[16];
+    int i;
+    for (i = 0; i < n_in && i < 16; ++i)
+      ins[i] = INT2PTR(void *, SvIV(*av_fetch(in_handles, i, 0)));
+    int n_par = (int)av_count(pkeys);
+    const char *ks[16], *vs[16];
+    for (i = 0; i < n_par && i < 16; ++i) {
+      ks[i] = SvPV_nolen(*av_fetch(pkeys, i, 0));
+      vs[i] = SvPV_nolen(*av_fetch(pvals, i, 0));
+    }
+    int n_out = 0;
+    void **outs = NULL;
+    if (MXImperativeInvoke(op_name, n_in, ins, &n_out, &outs, n_par,
+                           ks, vs) != 0)
+      croak_mx(aTHX_ "MXImperativeInvoke");
+    if (n_out < 1) croak("op produced no outputs");
+    RETVAL = outs[0];
+  OUTPUT:
+    RETVAL
+
+void *
+sym_load(const char *path)
+  CODE:
+    SymbolHandle h;
+    if (MXSymbolCreateFromFile(path, &h) != 0)
+      croak_mx(aTHX_ "MXSymbolCreateFromFile");
+    RETVAL = h;
+  OUTPUT:
+    RETVAL
+
+AV *
+sym_arguments(void *h)
+  CODE:
+    uint32_t n = 0;
+    const char **names;
+    if (MXSymbolListArguments(h, &n, &names) != 0)
+      croak_mx(aTHX_ "MXSymbolListArguments");
+    AV *out = newAV();
+    uint32_t i;
+    for (i = 0; i < n; ++i) av_push(out, newSVpv(names[i], 0));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void *
+pred_create(const char *symbol_json, SV *param_bytes, AV *input_keys, \
+            AV *indptr, AV *shapes_flat)
+  CODE:
+    STRLEN plen;
+    const char *pbuf = SvPV(param_bytes, plen);
+    uint32_t n_in = (uint32_t)av_count(input_keys);
+    const char *keys[16];
+    uint32_t ind[17], flat[64];
+    uint32_t i;
+    for (i = 0; i < n_in && i < 16; ++i)
+      keys[i] = SvPV_nolen(*av_fetch(input_keys, i, 0));
+    for (i = 0; i <= n_in && i < 17; ++i)
+      ind[i] = (uint32_t)SvUV(*av_fetch(indptr, i, 0));
+    uint32_t n_flat = (uint32_t)av_count(shapes_flat);
+    for (i = 0; i < n_flat && i < 64; ++i)
+      flat[i] = (uint32_t)SvUV(*av_fetch(shapes_flat, i, 0));
+    PredictorHandle h;
+    if (MXPredCreate(symbol_json, pbuf, (int)plen, 1, 0, n_in, keys, ind,
+                     flat, &h) != 0)
+      croak_mx(aTHX_ "MXPredCreate");
+    RETVAL = h;
+  OUTPUT:
+    RETVAL
+
+void
+pred_set_input(void *h, const char *key, AV *vals)
+  CODE:
+    size_t n = av_count(vals);
+    float *buf = (float *)malloc(n * sizeof(float));
+    size_t i;
+    for (i = 0; i < n; ++i)
+      buf[i] = (float)SvNV(*av_fetch(vals, i, 0));
+    int rc = MXPredSetInput(h, key, buf, (uint32_t)n);
+    free(buf);
+    if (rc != 0) croak_mx(aTHX_ "MXPredSetInput");
+
+void
+pred_forward(void *h)
+  CODE:
+    if (MXPredForward(h) != 0) croak_mx(aTHX_ "MXPredForward");
+
+AV *
+pred_get_output(void *h, int index)
+  CODE:
+    uint32_t ndim = 0;
+    const uint32_t *pshape;
+    if (MXPredGetOutputShape(h, (uint32_t)index, &pshape, &ndim) != 0)
+      croak_mx(aTHX_ "MXPredGetOutputShape");
+    size_t n = 1;
+    uint32_t i;
+    for (i = 0; i < ndim; ++i) n *= pshape[i];
+    float *buf = (float *)malloc(n * sizeof(float));
+    if (MXPredGetOutput(h, (uint32_t)index, buf, (uint32_t)n) != 0) {
+      free(buf);
+      croak_mx(aTHX_ "MXPredGetOutput");
+    }
+    AV *out = newAV();
+    size_t j;
+    for (j = 0; j < n; ++j) av_push(out, newSVnv(buf[j]));
+    free(buf);
+    RETVAL = out;
+  OUTPUT:
+    RETVAL

@@ -1,0 +1,56 @@
+package AI::MXNetTPU;
+# Thin Perl binding over the mxtpu C ABI (role model: the reference's
+# perl-package/AI-MXNet). See MXNetTPU.xs for scope notes.
+use strict;
+use warnings;
+require XSLoader;
+our $VERSION = '0.01';
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+package AI::MXNetTPU::NDArray;
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $vals, $shape) = @_;
+    my $h = AI::MXNetTPU::nd_from_floats($vals, $shape);
+    return bless {h => $h}, $class;
+}
+
+sub aslist { my $s = shift; AI::MXNetTPU::nd_to_floats($s->{h}) }
+sub shape  { my $s = shift; AI::MXNetTPU::nd_shape($s->{h}) }
+
+sub invoke {
+    my ($class, $op, $inputs, %params) = @_;
+    my @hs = map { 0 + $_->{h} } @$inputs;
+    my @ks = sort keys %params;
+    my @vs = map { "$params{$_}" } @ks;
+    my $out = AI::MXNetTPU::op_invoke1($op, [map { "$_" } @hs],
+                                       \@ks, \@vs);
+    return bless {h => $out}, 'AI::MXNetTPU::NDArray';
+}
+
+sub DESTROY { my $s = shift; AI::MXNetTPU::nd_free($s->{h}) if $s->{h} }
+
+package AI::MXNetTPU::Predictor;
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $json, $params, $input_keys, $shapes) = @_;
+    my @indptr = (0);
+    my @flat;
+    for my $s (@$shapes) {
+        push @flat, @$s;
+        push @indptr, scalar(@flat);
+    }
+    my $h = AI::MXNetTPU::pred_create($json, $params, $input_keys,
+                                      \@indptr, \@flat);
+    return bless {h => $h}, $class;
+}
+
+sub set_input { my ($s, $k, $v) = @_; AI::MXNetTPU::pred_set_input($s->{h}, $k, $v) }
+sub forward   { my $s = shift; AI::MXNetTPU::pred_forward($s->{h}) }
+sub output    { my ($s, $i) = @_; AI::MXNetTPU::pred_get_output($s->{h}, $i // 0) }
+
+1;

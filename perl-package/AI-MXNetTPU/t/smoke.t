@@ -1,0 +1,40 @@
+# AI::MXNetTPU smoke: NDArray round trip, imperative ops, predictor
+# over an exported symbol+params (run via tests/test_perl_binding.py,
+# which provides MXTPU_FIXTURE_* env).
+use strict; use warnings;
+use Test::More;
+use AI::MXNetTPU;
+
+ok(AI::MXNetTPU::mx_version() >= 100, "version");
+
+my $a = AI::MXNetTPU::NDArray->new([1,2,3,4,5,6], [2,3]);
+is_deeply($a->shape, [2,3], "shape");
+my $sq = AI::MXNetTPU::NDArray->invoke("square", [$a]);
+my $got = $sq->aslist;
+my @want = (1,4,9,16,25,36);
+for my $i (0..5) {
+    ok(abs($got->[$i] - $want[$i]) < 1e-5, "square[$i]");
+}
+my $sum = AI::MXNetTPU::NDArray->invoke("sum", [$a], axis => 1);
+my $s = $sum->aslist;
+ok(abs($s->[0] - 6) < 1e-5 && abs($s->[1] - 15) < 1e-5, "sum axis=1");
+
+SKIP: {
+    skip "no fixture env", 2 unless $ENV{MXTPU_FIXTURE_SYMBOL};
+    open my $fh, '<', $ENV{MXTPU_FIXTURE_SYMBOL} or die $!;
+    local $/; my $json = <$fh>; close $fh;
+    open my $pf, '<:raw', $ENV{MXTPU_FIXTURE_PARAMS} or die $!;
+    my $params = <$pf>; close $pf;
+    my $pred = AI::MXNetTPU::Predictor->new(
+        $json, $params, ["data"], [[3, 8]]);
+    my @x = map { 0.1 * $_ } (0 .. 23);
+    $pred->set_input("data", \@x);
+    $pred->forward;
+    my $out = $pred->output(0);
+    is(scalar(@$out), 12, "predictor output size 3x4");
+    my $env_want = $ENV{MXTPU_FIXTURE_WANT0};
+    ok(abs($out->[0] - $env_want) < 1e-4,
+       "predictor output[0] matches python ($out->[0] vs $env_want)");
+}
+
+done_testing();
