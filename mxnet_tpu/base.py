@@ -173,13 +173,21 @@ def get_logger(name: str = "mxnet_tpu", level=logging.INFO) -> logging.Logger:
 
 def worker_rank(default=0):
     """This process's worker rank: MX_WORKER_ID (tools/launch.py
-    local/ssh), else the MPI runtime env (--launcher mpi), else
-    `default`."""
+    local/ssh/sge), else the MPI runtime env (--launcher mpi), else the
+    YARN container id (--launcher yarn: CONTAINER_ID ends in a
+    sequential suffix; the ApplicationMaster is 000001, workers start
+    at 000002), else `default`."""
     import os
     for var in ("MX_WORKER_ID", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
                 "PMIX_RANK"):
         if var in os.environ:
             return int(os.environ[var])
+    if os.environ.get("MX_WORKER_ID_FROM") == "YARN_CONTAINER_ID"             and "CONTAINER_ID" in os.environ:
+        try:
+            return max(0, int(os.environ["CONTAINER_ID"]
+                              .rsplit("_", 1)[-1]) - 2)
+        except ValueError:
+            pass
     return default
 
 

@@ -140,3 +140,51 @@ def test_dist_sync_kvstore_two_workers():
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"dist test failed:\n{out[-3000:]}"
     assert out.count("DIST_KVSTORE_OK") == 2, out[-3000:]
+
+
+def test_sge_launcher_command_construction(tmp_path):
+    """--launcher sge submits one qsub array job whose script exports the
+    shared env and derives MX_WORKER_ID from SGE_TASK_ID (ref:
+    dmlc_tracker/sge.py). A fake `qsub` on PATH records argv."""
+    log = tmp_path / "calls.log"
+    fake = tmp_path / "qsub"
+    fake.write_text("#!/bin/sh\necho \"$@\" >> %s\n" % log)
+    fake.chmod(0o755)
+    env = dict(os.environ)
+    env["PATH"] = f"{tmp_path}:{env['PATH']}"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "3", "--launcher", "sge", "--sge-queue", "gpu.q",
+         "--env", "FOO=bar", "echo", "worker"],
+        env=env, capture_output=True, text=True, timeout=60,
+        cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    call = log.read_text().strip()
+    assert "-t 1-3" in call and "-sync y" in call
+    script = (tmp_path / ".mxtpu_sge_job.sh").read_text()
+    assert "export MX_NUM_WORKERS=3" in script
+    assert "export MX_WORKER_ID=$((SGE_TASK_ID - 1))" in script
+    assert "export FOO=bar" in script
+    assert "#$ -q gpu.q" in script
+    assert "echo worker" in script
+
+
+def test_yarn_launcher_command_construction(tmp_path):
+    """--launcher yarn runs the distributed-shell with one container per
+    rank and the shared env in -shell_env (ref: dmlc_tracker/yarn.py)."""
+    log = tmp_path / "calls.log"
+    fake = tmp_path / "yarn"
+    fake.write_text("#!/bin/sh\necho \"$@\" >> %s\n" % log)
+    fake.chmod(0o755)
+    env = dict(os.environ)
+    env["PATH"] = f"{tmp_path}:{env['PATH']}"
+    env.pop("HADOOP_HOME", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "yarn", "echo", "worker"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    call = log.read_text().strip()
+    assert "-num_containers 2" in call
+    assert "MX_NUM_WORKERS=2" in call
+    assert "-shell_command echo worker" in call

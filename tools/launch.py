@@ -15,6 +15,8 @@ Launchers (ref launch.py --launcher {local,ssh,mpi,sge,yarn}):
          coordinator (ref: dmlc-core/tracker ssh.py)
   mpi    delegate process placement to mpirun/mpiexec; ranks read
          OMPI_COMM_WORLD_RANK / PMI_RANK (ref: dmlc-core/tracker mpi.py)
+  sge    qsub array job, rank = SGE_TASK_ID - 1 (ref: tracker sge.py)
+  yarn   YARN distributed-shell, one container per rank (ref: yarn.py)
 
 Usage (mirrors `tools/launch.py -n 2 --launcher local python train.py`):
 
@@ -147,14 +149,96 @@ def launch_mpi(args, coordinator, kv_server):
     return subprocess.call(cmd, env=env)
 
 
+def launch_sge(args, coordinator, kv_server):
+    """Sun Grid Engine array job (ref: dmlc_tracker/sge.py role): submit
+    one qsub array task per rank; each task derives its rank from
+    SGE_TASK_ID (1-based). Rank 0 lands on an arbitrary EXEC node, so
+    the coordinator endpoint cannot be precomputed: rank 0 publishes
+    its hostname through the shared working directory (SGE's -cwd
+    shared-filesystem convention) and the other tasks poll for it.
+    --coordinator-host overrides the rendezvous entirely."""
+    coord_port = coordinator.rsplit(":", 1)[1]
+    kv_port = kv_server.rsplit(":", 1)[1]
+    env = _worker_env(args, 0, "", "")
+    del env["MX_WORKER_ID"]  # per-task: SGE_TASK_ID - 1
+    del env["MX_COORDINATOR"]  # resolved in-script (see below)
+    del env["MX_KV_SERVER"]
+    coord_file = os.path.join(os.getcwd(), ".mxtpu_sge_coord")
+    if os.path.exists(coord_file):
+        os.unlink(coord_file)
+    script = os.path.join(os.getcwd(), ".mxtpu_sge_job.sh")
+    with open(script, "w") as f:
+        f.write("#!/bin/sh\n#$ -S /bin/sh\n#$ -cwd\n")
+        if args.sge_queue:
+            f.write(f"#$ -q {args.sge_queue}\n")
+        for k, v in sorted(env.items()):
+            f.write(f"export {k}={shlex.quote(v)}\n")
+        f.write("export MX_WORKER_ID=$((SGE_TASK_ID - 1))\n")
+        if args.coordinator_host:
+            f.write(f"COORD_HOST={shlex.quote(args.coordinator_host)}\n")
+        else:
+            f.write(f'if [ "$SGE_TASK_ID" = "1" ]; then\n'
+                    f"  hostname > {shlex.quote(coord_file)}.tmp\n"
+                    f"  mv {shlex.quote(coord_file)}.tmp "
+                    f"{shlex.quote(coord_file)}\n"
+                    f"fi\n"
+                    f"while [ ! -s {shlex.quote(coord_file)} ]; do "
+                    f"sleep 1; done\n"
+                    f"COORD_HOST=$(cat {shlex.quote(coord_file)})\n")
+        f.write(f"export MX_COORDINATOR=$COORD_HOST:{coord_port}\n")
+        f.write(f"export MX_KV_SERVER=$COORD_HOST:{kv_port}\n")
+        f.write(" ".join(shlex.quote(c) for c in args.command) + "\n")
+    os.chmod(script, 0o755)
+    cmd = ["qsub", "-sync", "y", "-t", f"1-{args.num_workers}",
+           "-N", "mxtpu-job", script]
+    return subprocess.call(cmd)
+
+
+def launch_yarn(args, coordinator, kv_server):
+    """YARN distributed-shell submission (ref: dmlc_tracker/yarn.py
+    role, minus the bundled Java ApplicationMaster): each container
+    runs one rank, deriving it in-container from YARN's CONTAINER_ID
+    sequential suffix (base.worker_rank consumes MX_WORKER_ID_FROM=
+    YARN_CONTAINER_ID; the AM holds suffix 000001, workers 000002+).
+
+    BEST-EFFORT: the suffix heuristic assumes contiguous container
+    allocation with no relaunches (the reference's yarn tracker ships a
+    custom Java ApplicationMaster to assign ranks properly — out of
+    scope here). For production elasticity prefer --launcher ssh/mpi,
+    or front a rank service. --coordinator-host is REQUIRED unless the
+    client host is reachable from the containers."""
+    host = args.coordinator_host or socket.gethostname()
+    coordinator = f"{host}:{coordinator.rsplit(':', 1)[1]}"
+    kv_server = f"{host}:{kv_server.rsplit(':', 1)[1]}"
+    hadoop = os.environ.get("HADOOP_HOME")
+    yarn_bin = os.path.join(hadoop, "bin", "yarn") if hadoop else "yarn"
+    env = _worker_env(args, 0, coordinator, kv_server)
+    del env["MX_WORKER_ID"]  # derived in-container (see base.worker_rank)
+    env["MX_WORKER_ID_FROM"] = "YARN_CONTAINER_ID"
+    shell_env = ",".join(f"{k}={v}" for k, v in sorted(env.items()))
+    cmd = [yarn_bin, "jar",
+           os.environ.get("YARN_DSHELL_JAR",
+                          "hadoop-yarn-applications-distributedshell.jar"),
+           "-jar", os.environ.get(
+               "YARN_DSHELL_JAR",
+               "hadoop-yarn-applications-distributedshell.jar"),
+           "-num_containers", str(args.num_workers),
+           "-shell_env", shell_env,
+           "-shell_command",
+           " ".join(shlex.quote(c) for c in args.command)]
+    return subprocess.call(cmd)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="launch a multi-process mxnet_tpu job")
     parser.add_argument("-n", "--num-workers", type=int, required=True,
                         help="number of worker processes")
     parser.add_argument("--launcher", default="local",
-                        choices=["local", "ssh", "mpi"],
+                        choices=["local", "ssh", "mpi", "sge", "yarn"],
                         help="process launcher (default: local)")
+    parser.add_argument("--sge-queue", default="",
+                        help="SGE queue name (-q) for --launcher sge")
     parser.add_argument("-H", "--hostfile", default=None,
                         help="hostfile for --launcher ssh "
                         "(one host per line, optional slots=N)")
@@ -186,6 +270,7 @@ def main(argv=None):
     # ref role: DMLC_PS_ROOT_URI of the ps-lite tracker)
     kv_server = f"127.0.0.1:{args.kv_port or _free_port()}"
     launchers = {"local": launch_local, "ssh": launch_ssh,
+                 "sge": launch_sge, "yarn": launch_yarn,
                  "mpi": launch_mpi}
     return launchers[args.launcher](args, coordinator, kv_server)
 
