@@ -1,0 +1,65 @@
+"""Model backwards-compatibility tier (ref:
+tests/nightly/model_backwards_compatibility_check/ — artifacts trained
+on an OLDER version must keep loading and producing identical outputs).
+
+The fixtures under tests/data/backcompat/ are frozen bytes saved by the
+version noted in MANIFEST.json; every future version must load them
+bit-compatibly. The reference's v0-era `legacy_ndarray.v0` interop
+fixture is covered in test_native_io.py; this tier covers the
+framework's OWN artifacts across versions.
+"""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+D = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data",
+                 "backcompat")
+
+
+def _pinned():
+    x = onp.load(os.path.join(D, "input.npy"))
+    want = onp.load(os.path.join(D, "output.npy"))
+    return x, want
+
+
+def test_manifest_present():
+    with open(os.path.join(D, "MANIFEST.json")) as f:
+        m = json.load(f)
+    assert "framework_version" in m
+
+
+def test_ndarray_payload_loads():
+    loaded = nd.load(os.path.join(D, "arrays.nd"))
+    assert set(loaded) == {"a", "b"}
+    assert loaded["a"].shape == (2, 3)
+    assert loaded["b"].dtype == onp.int32
+    assert onp.array_equal(loaded["b"].asnumpy(), onp.arange(5))
+
+
+def test_gluon_export_reloads_with_pinned_output():
+    x, want = _pinned()
+    net = gluon.nn.SymbolBlock.imports(
+        os.path.join(D, "mlp-symbol.json"), ["data"],
+        os.path.join(D, "mlp-0000.params"))
+    got = net(nd.array(x)).asnumpy()
+    assert onp.allclose(got, want, atol=1e-5), \
+        "frozen gluon export no longer reproduces its pinned output"
+
+
+def test_module_checkpoint_reloads_with_pinned_output():
+    x, want = _pinned()
+    sym, arg, aux = mx.model.load_checkpoint(
+        os.path.join(D, "mlp_module"), 0)
+    mod = mx.mod.Module(symbol=sym, data_names=["data"], label_names=None)
+    mod.bind(data_shapes=[("data", x.shape)], for_training=False)
+    mod.set_params(arg, aux)
+    from mxnet_tpu.io import NDArrayIter
+    it = NDArrayIter(data=x, batch_size=x.shape[0])
+    got = mod.predict(it).asnumpy()
+    assert onp.allclose(got, want, atol=1e-5), \
+        "frozen module checkpoint no longer reproduces its pinned output"
