@@ -34,11 +34,14 @@ FALLBACK = ("the quick brown fox jumps over the lazy dog . "
 
 
 class Corpus:
-    def __init__(self, text):
+    def __init__(self, text, vocab=None):
         words = text.split()
-        self.vocab = {w: i for i, w in
-                      enumerate(sorted(set(words)))}
-        self.data = onp.array([self.vocab[w] for w in words], "int32")
+        if vocab is None:
+            vocab = {w: i for i, w in enumerate(sorted(set(words)))}
+            vocab.setdefault("<unk>", len(vocab))
+        self.vocab = vocab
+        unk = vocab["<unk>"] if "<unk>" in vocab else 0
+        self.data = onp.array([vocab.get(w, unk) for w in words], "int32")
 
     def batchify(self, batch_size):
         n = len(self.data) // batch_size
@@ -81,9 +84,27 @@ def detach(state):
     return [s.detach() for s in state]
 
 
+def evaluate(model, data, bptt, batch_size, V, loss_fn):
+    """Held-out perplexity (no grad, fresh state) — the reference's
+    eval loop role (word_lm/train.py evaluation at each epoch)."""
+    state = model.begin_state(batch_size)
+    total, count = 0.0, 0
+    for i in range(0, data.shape[0] - 1 - bptt, bptt):
+        x = nd.array(data[i:i + bptt])
+        y = nd.array(data[i + 1:i + 1 + bptt].astype("float32"))
+        out, state = model(x, state)
+        loss = loss_fn(out.reshape((-1, V)), y.reshape((-1,)))
+        total += float(loss.sum().asscalar())
+        count += loss.size
+    return math.exp(total / max(count, 1))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--data", default=None, help="path to a text file")
+    p.add_argument("--test-data", default=None,
+                   help="held-out text file; when given, returns "
+                        "(train_ppl, test_ppl)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--bptt", type=int, default=8)
     p.add_argument("--embed-size", type=int, default=64)
@@ -100,6 +121,11 @@ def main(argv=None):
     corpus = Corpus(text)
     data = corpus.batchify(args.batch_size)
     V = len(corpus.vocab)
+    test_data = None
+    if args.test_data:
+        test_corpus = Corpus(open(args.test_data).read(),
+                             vocab=corpus.vocab)
+        test_data = test_corpus.batchify(args.batch_size)
     print(f"corpus: {len(corpus.data)} tokens, vocab {V}")
 
     model = RNNModel(V, args.embed_size, args.hidden, args.layers,
@@ -126,6 +152,11 @@ def main(argv=None):
             count += loss.size
         final_ppl = math.exp(total / max(count, 1))
         print(f"epoch {epoch}: train ppl {final_ppl:.2f}")
+    if test_data is not None:
+        test_ppl = evaluate(model, test_data, args.bptt,
+                            args.batch_size, V, loss_fn)
+        print(f"test ppl {test_ppl:.2f}")
+        return final_ppl, test_ppl
     return final_ppl
 
 

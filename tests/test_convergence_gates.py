@@ -1,0 +1,57 @@
+"""Convergence gates from BASELINE.md, scaled but real (VERDICT r2
+item 7).
+
+- Word-LM: the reference trains example/rnn/word_lm to 44.26 test ppl on
+  Sherlock Holmes (README.md:36). Scaled recipe (tied weights, 2-layer
+  LSTM, truncated BPTT) over the bundled REAL corpus slice
+  (tests/data/lm_corpus, ~31k tokens of genuine English prose) must hit
+  the precomputed test perplexity — not "ppl ~2 on toy data".
+- SSD: the reference reports 77.8 VOC mAP (example/ssd/README.md:63).
+  Scaled gate: VOC07 mAP on a FIXED 48-image synthetic-VOC eval set
+  after a short seeded training run, vs the pinned value.
+
+Both runs are deterministic (fixed seeds, single-threaded math): the
+pins carry a tolerance only for platform (CPU/TPU) numerics drift.
+"""
+import importlib.util
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# pinned on CPU by the round-3 builder (see examples/* invocations in
+# the docstrings); re-pin deliberately if the recipe changes
+WORD_LM_TEST_PPL = 295.66
+SSD_MAP_48 = 0.401
+
+
+def _load(rel):
+    path = os.path.join(ROOT, "examples", rel)
+    spec = importlib.util.spec_from_file_location(
+        rel.replace("/", "_")[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_word_lm_real_corpus_perplexity_gate():
+    mod = _load("rnn/word_lm_corpus.py")
+    train_ppl, test_ppl = mod.main(["--epochs", "6", "--lr", "0.005"])
+    # vocab 1894 -> untrained ppl ~1894; the recipe must land at the
+    # pinned value (±8% platform drift), proving capability not plumbing
+    assert test_ppl == pytest.approx(WORD_LM_TEST_PPL, rel=0.08), \
+        f"test ppl {test_ppl:.2f} vs pinned {WORD_LM_TEST_PPL}"
+    assert train_ppl < 450.0
+
+
+@pytest.mark.slow
+def test_ssd_synthetic_voc_map_gate():
+    mod = _load("ssd/train_ssd.py")
+    first, last, mean_ap = mod.main(
+        ["--steps", "250", "--batch-size", "8", "--image-size", "64",
+         "--eval-images", "48"])
+    assert last < first
+    assert mean_ap == pytest.approx(SSD_MAP_48, abs=0.08), \
+        f"mAP {mean_ap:.3f} vs pinned {SSD_MAP_48}"
