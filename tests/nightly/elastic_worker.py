@@ -1,0 +1,83 @@
+"""Elastic-training drill worker (ref role: SURVEY §5.3 failure
+detection + §5.4 checkpoint/resume — the reference's dist workers are
+restarted by the cluster manager and resume from the last checkpoint).
+
+Run under tests/test_elastic.py: dist_async kvstore (no barrier in the
+steady state — a killed peer must not wedge survivors), periodic async
+checkpoints, restart-from-latest on boot.
+"""
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.checkpoint import CheckpointManager  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["MX_WORKER_ID"])
+    target = int(os.environ["ELASTIC_TARGET_STEPS"])
+    ckpt_every = int(os.environ.get("ELASTIC_CKPT_EVERY", "5"))
+    step_sleep = float(os.environ.get("ELASTIC_STEP_SLEEP", "0.1"))
+
+    kv = mx.kv.create("dist_async")
+    if rank == 0:
+        kv.init("w", nd.zeros((2, 2)))
+    # init visibility without a barrier (a later restart must be able to
+    # join with no generation counting): poll until the key exists
+    out = nd.zeros((2, 2))
+    for _ in range(200):
+        try:
+            kv.pull("w", out=out)
+            break
+        except Exception:
+            time.sleep(0.05)
+
+    mgr = CheckpointManager(os.path.join(os.environ["ELASTIC_CKPT_DIR"],
+                                         f"rank{rank}"), async_save=True)
+    params = {"step": nd.array(onp.zeros((1,), "float32"))}
+    restored = mgr.restore_latest()
+    start = 0
+    if restored is not None:
+        loaded, _opt, extra = mgr.restore(restored)
+        start = int(extra["next_step"])
+    print(f"RESUMED rank={rank} from={start}", flush=True)
+
+    for step in range(start, target):
+        kv.push("w", nd.array(onp.ones((2, 2), "float32")))
+        kv.pull("w", out=out)
+        if (step + 1) % ckpt_every == 0:
+            params["step"]._rebind(
+                nd.array(onp.asarray([step + 1.0], "float32"))._data)
+            mgr.save(step + 1, params=params,
+                     extra={"next_step": step + 1})
+            mgr.wait()
+        time.sleep(step_sleep)
+
+    print(f"DONE rank={rank} ran={target - start}", flush=True)
+    # the server-owning rank outlives its peers (a real PS is torn down
+    # by the cluster manager only after the job completes): wait for
+    # every rank's done-flag so late-restarted workers can still push
+    flag_dir = os.environ["ELASTIC_CKPT_DIR"]
+    open(os.path.join(flag_dir, f"done.{rank}"), "w").close()
+    if rank == 0:
+        nw = int(os.environ["MX_NUM_WORKERS"])
+        deadline = time.time() + float(
+            os.environ.get("ELASTIC_JOIN_TIMEOUT", "240"))
+        while time.time() < deadline:
+            if all(os.path.exists(os.path.join(flag_dir, f"done.{r}"))
+                   for r in range(nw)):
+                break
+            time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    main()
