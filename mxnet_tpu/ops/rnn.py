@@ -104,26 +104,19 @@ def _cell_step(mode, H):
     return step
 
 
-def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
-    """x: (T, B, I). Returns (outputs (T,B,H), h_T, c_T)."""
-    H = wh.shape[1]
-    gin_x = jnp.einsum("tbi,gi->tbg", x, wi) + bi + (
-        0.0 if mode == "gru" else bh)
-
+def _layer_step(mode, wh, bh, H):
+    """One timestep: (carry, pre-mixed input gates) -> (carry, y)."""
     if mode == "lstm":
         cell = _cell_step(mode, H)
 
-        def scan_fn(carry, gx):
+        def step(carry, gx):
             h, c = carry
             gin = gx + jnp.matmul(h, wh.T)
             h2, c2 = cell((h, c), gin)
             return (h2, c2), h2
-
-        (hT, cT), ys = jax.lax.scan(scan_fn, (h0, c0), gin_x,
-                                    reverse=reverse)
-        return ys, hT, cT
+        return step
     if mode == "gru":
-        def scan_fn(carry, gx):
+        def step(carry, gx):
             (h,) = carry
             gh = jnp.matmul(h, wh.T) + bh
             rx, zx, nx = jnp.split(gx, 3, axis=-1)
@@ -133,18 +126,62 @@ def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
             n = jnp.tanh(nx + r * nh)
             h2 = (1 - z) * n + z * h
             return (h2,), h2
-
-        (hT,), ys = jax.lax.scan(scan_fn, (h0,), gin_x, reverse=reverse)
-        return ys, hT, None
+        return step
     act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
 
-    def scan_fn(carry, gx):
+    def step(carry, gx):
         (h,) = carry
         h2 = act(gx + jnp.matmul(h, wh.T))
         return (h2,), h2
+    return step
 
-    (hT,), ys = jax.lax.scan(scan_fn, (h0,), gin_x, reverse=reverse)
-    return ys, hT, None
+
+# unrolling is only offered below this sequence length: past it the
+# unrolled program's compile time dwarfs any steady-state win
+_RNN_UNROLL_MAX_T = 32
+
+
+def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, reverse=False):
+    """x: (T, B, I). Returns (outputs (T,B,H), h_T, c_T).
+
+    The time loop has two equivalent lowerings — `lax.scan` (one
+    compiled body, XLA while-loop; compiles fast, steady overhead per
+    step) and full unrolling (T inlined bodies; slower compile, lets
+    XLA fuse/pipeline across steps — often faster for short T). The
+    winner is measured-and-cached per (mode, T, B, H) signature by
+    operator_tune, the same machinery that picks the attention backend
+    (ref role: operator_tune.h's measured-cost corpus tuning)."""
+    H = wh.shape[1]
+    gin_x = jnp.einsum("tbi,gi->tbg", x, wi) + bi + (
+        0.0 if mode == "gru" else bh)
+    init = (h0, c0) if mode == "lstm" else (h0,)
+    step = _layer_step(mode, wh, bh, H)
+
+    def run_scan(gin):
+        carry, ys = jax.lax.scan(step, init, gin, reverse=reverse)
+        return ys, carry
+
+    def run_unroll(gin):
+        T = gin.shape[0]
+        order = range(T - 1, -1, -1) if reverse else range(T)
+        carry = init
+        ys = [None] * T
+        for t in order:
+            carry, ys[t] = step(carry, gin[t])
+        return jnp.stack(ys), carry
+
+    T = gin_x.shape[0]
+    candidates = [("scan", run_scan)]
+    if T <= _RNN_UNROLL_MAX_T:
+        candidates.append(("unroll", run_unroll))
+    from .. import operator_tune as _otune
+    _, fn = _otune.choose(
+        f"rnn_{mode}", candidates, gin_x,
+        key=f"rnn_{mode}|T{T}|B{gin_x.shape[1]}|H{H}")
+    ys, carry = fn(gin_x)
+    if mode == "lstm":
+        return ys, carry[0], carry[1]
+    return ys, carry[0], None
 
 
 def _rnn_visible(params):

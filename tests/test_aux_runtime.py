@@ -439,3 +439,41 @@ def test_force_cpu_backend_env_pins_platform():
                        capture_output=True, text=True, timeout=180)
     assert r.returncode == 0 and "CPU_PINNED" in r.stdout, \
         (r.stdout + r.stderr)[-1500:]
+
+
+def test_rnn_scan_unroll_autotune_equivalence():
+    """The RNN time loop offers two lowerings (lax.scan vs full unroll,
+    ops/rnn.py _run_layer) behind the operator_tune measure-and-cache
+    machinery — both must agree numerically, the override env must pin
+    either, and a measured winner must land in the cache."""
+    import json
+
+    from mxnet_tpu import operator_tune
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    rs = onp.random.RandomState(3)
+    p = rnn_param_size("lstm", 1, 3, 4, False)
+    x = nd.array(rs.randn(6, 2, 3).astype("float32"))
+    w = nd.array((rs.rand(p).astype("float32") - 0.5) * 0.2)
+    h = nd.zeros((1, 2, 4))
+    c = nd.zeros((1, 2, 4))
+
+    outs = {}
+    for choice in ("scan", "unroll"):
+        os.environ["MXNET_OPTUNE_CHOICE_RNN_LSTM"] = choice
+        try:
+            out = nd.RNN(x, w, h, c, state_size=4, num_layers=1,
+                         mode="lstm")
+            first = out[0] if isinstance(out, (list, tuple)) else out
+            outs[choice] = first.asnumpy()
+        finally:
+            del os.environ["MXNET_OPTUNE_CHOICE_RNN_LSTM"]
+    assert onp.allclose(outs["scan"], outs["unroll"], atol=1e-5)
+
+    operator_tune.clear_cache()
+    out = nd.RNN(x, w, h, c, state_size=4, num_layers=1, mode="lstm")
+    (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
+    with open(operator_tune.cache_path()) as f:
+        cache = json.load(f)
+    keys = cache.get("choices", cache)
+    assert any("rnn_lstm|T6" in str(k) for k in keys), keys
