@@ -213,6 +213,93 @@ def _export_node(op, name, ins, outs, p, np_params, initializers):
                    "min": "ReduceMin"}[op], ins[:1], outs, name, attrs)]
     if op == "Embedding":
         return [N("Gather", [ins[1], ins[0]], outs, name)]
+    if op == "LeakyReLU":
+        act = p.get("act_type", "leaky")
+        if act == "leaky":
+            return [N("LeakyRelu", ins[:1], outs, name,
+                      {"alpha": float(p.get("slope", 0.25))})]
+        if act == "elu":
+            return [N("Elu", ins[:1], outs, name,
+                      {"alpha": float(p.get("slope", 0.25))})]
+        if act == "prelu":
+            return [N("PRelu", ins[:2], outs, name)]
+        raise MXNetError(f"ONNX export: LeakyReLU act_type {act!r} "
+                         "has no ONNX mapping")
+    if op == "clip":
+        lo = f"{name}_min"
+        hi = f"{name}_max"
+        initializers.append(proto.tensor(
+            lo, onp.asarray(float(p["a_min"]), "float32")))
+        initializers.append(proto.tensor(
+            hi, onp.asarray(float(p["a_max"]), "float32")))
+        return [N("Clip", ins[:1] + [lo, hi], outs, name)]
+    if op in ("expand_dims", "squeeze"):
+        aname = f"{name}_axes"
+        ax = _attr_tuple(p.get("axis", 0))
+        initializers.append(proto.tensor(
+            aname, onp.asarray(ax, "int64")))
+        return [N("Unsqueeze" if op == "expand_dims" else "Squeeze",
+                  ins[:1] + [aname], outs, name)]
+    if op == "Cast":
+        onnx_t = {"float32": 1, "float64": 11, "float16": 10,
+                  "int32": 6, "int64": 7, "int8": 3, "uint8": 2,
+                  "bool": 9}[str(p.get("dtype", "float32"))]
+        return [N("Cast", ins[:1], outs, name, {"to": onnx_t})]
+    if op in ("broadcast_maximum", "_maximum", "elemwise_maximum"):
+        return [N("Max", ins[:2], outs, name)]
+    if op in ("broadcast_minimum", "_minimum", "elemwise_minimum"):
+        return [N("Min", ins[:2], outs, name)]
+    if op in ("broadcast_power", "_power"):
+        return [N("Pow", ins[:2], outs, name)]
+    if op == "dot":
+        return [N("MatMul", ins[:2], outs, name)]
+    if op == "batch_dot":
+        return [N("MatMul", ins[:2], outs, name)]
+    if op == "tile":
+        rname = f"{name}_reps"
+        initializers.append(proto.tensor(
+            rname, onp.asarray(_attr_tuple(p.get("reps", ())), "int64")))
+        return [N("Tile", ins[:1] + [rname], outs, name)]
+    if op == "argmax":
+        attrs = {"axis": int(p.get("axis", 0) or 0),
+                 "keepdims": int(truthy(p.get("keepdims", False)))}
+        return [N("ArgMax", ins[:1], outs, name, attrs)]
+    if op == "Deconvolution":
+        kernel = _attr_tuple(p["kernel"])
+        attrs = {"kernel_shape": kernel,
+                 "strides": _attr_tuple(p.get("stride", 1), len(kernel)),
+                 "pads": _attr_tuple(p.get("pad", 0), len(kernel)) * 2,
+                 "dilations": _attr_tuple(p.get("dilate", 1),
+                                          len(kernel)),
+                 "group": int(p.get("num_group", 1))}
+        keep = 2 if truthy(p.get("no_bias", False)) else 3
+        return [N("ConvTranspose", ins[:keep], outs, name, attrs)]
+    if op == "InstanceNorm":
+        return [N("InstanceNormalization", ins[:3], outs, name,
+                  {"epsilon": float(p.get("eps", 1e-3))})]
+    if op == "where":
+        return [N("Where", ins[:3], outs, name)]
+    cmp = {"broadcast_greater": "Greater", "broadcast_lesser": "Less",
+           "broadcast_equal": "Equal",
+           "broadcast_greater_equal": "GreaterOrEqual",
+           "broadcast_lesser_equal": "LessOrEqual"}
+    if op in cmp:
+        # mxnet comparisons return same-dtype floats; ONNX returns bool.
+        # Where consumes bool directly, so emit the bare compare and a
+        # float Cast for any other consumer — the graph stays correct
+        # either way because import maps bool back through the same pair
+        return [N(cmp[op], ins[:2], outs, name)]
+    if op in ("slice_axis",):
+        ax = int(p["axis"])
+        begin = int(p["begin"])
+        end = p.get("end")
+        end = int(end) if end not in (None, "None") else (1 << 62)
+        for suffix, vals in (("starts", [begin]), ("ends", [end]),
+                             ("axes", [ax])):
+            initializers.append(proto.tensor(
+                f"{name}_{suffix}", onp.asarray(vals, "int64")))
+        return [N("Slice", ins[:1] + [f"{name}_starts", f"{name}_ends",
+                                      f"{name}_axes"], outs, name)]
     raise MXNetError(f"ONNX export: unsupported op '{op}' "
                      "(ref table: contrib/onnx/mx2onnx/_op_translations)")
 
@@ -356,6 +443,95 @@ def _import_node(n, values, inits, sym_mod):
         if int(a.get("axis", 0)) != 0:
             raise MXNetError("ONNX import: Gather axis != 0 unsupported")
         return [sym_mod.take(ins[0], ins[1])]
+    if op == "LeakyRelu":
+        return [sym_mod.LeakyReLU(ins[0], act_type="leaky",
+                                  slope=float(a.get("alpha", 0.01)))]
+    if op == "Elu":
+        return [sym_mod.LeakyReLU(ins[0], act_type="elu",
+                                  slope=float(a.get("alpha", 1.0)))]
+    if op == "PRelu":
+        return [sym_mod.LeakyReLU(*ins[:2], act_type="prelu")]
+    if op == "Clip":
+        def _const(i, default):
+            nm = n["inputs"][i] if len(n["inputs"]) > i else ""
+            return float(inits[nm].ravel()[0]) if nm in inits else default
+        return [sym_mod.clip(ins[0], a_min=_const(1, -3.4e38),
+                             a_max=_const(2, 3.4e38))]
+    if op in ("Unsqueeze", "Squeeze"):
+        axes = a.get("axes")
+        if axes is None and len(n["inputs"]) > 1:
+            axes = [int(x) for x in inits[n["inputs"][1]].ravel()]
+        fn = "expand_dims" if op == "Unsqueeze" else "squeeze"
+        if op == "Unsqueeze":
+            out = ins[0]
+            for ax in sorted(int(x) for x in axes):
+                out = sym_mod.expand_dims(out, axis=ax)
+            return [out]
+        return [sym_mod.squeeze(
+            ins[0], axis=tuple(int(x) for x in axes) if axes else None)]
+    if op == "Cast":
+        mx_t = {1: "float32", 11: "float64", 10: "float16", 6: "int32",
+                7: "int64", 3: "int8", 2: "uint8", 9: "bool"}[
+                    int(a["to"])]
+        return [sym_mod.Cast(ins[0], dtype=mx_t)]
+    if op in ("Max", "Min"):
+        fn = "broadcast_maximum" if op == "Max" else "broadcast_minimum"
+        out = ins[0]
+        for other in ins[1:]:
+            out = getattr(sym_mod, fn)(out, other)
+        return [out]
+    if op == "Sum":
+        out = ins[0]
+        for other in ins[1:]:
+            out = sym_mod.broadcast_add(out, other)
+        return [out]
+    if op == "Pow":
+        return [sym_mod.broadcast_power(ins[0], ins[1])]
+    if op == "MatMul":
+        return [sym_mod.dot(ins[0], ins[1])]
+    if op == "Tile":
+        reps = tuple(int(x) for x in inits[n["inputs"][1]].ravel())
+        return [sym_mod.tile(ins[0], reps=reps)]
+    if op == "ArgMax":
+        return [sym_mod.argmax(ins[0], axis=int(a.get("axis", 0)),
+                               keepdims=bool(a.get("keepdims", 1)))]
+    if op == "ConvTranspose":
+        kernel = tuple(a["kernel_shape"])
+        w_name = n["inputs"][1]
+        num_filter = int(inits[w_name].shape[1] *
+                         int(a.get("group", 1)))             if w_name in inits else 0
+        pads = tuple(a.get("pads", (0,) * (2 * len(kernel))))
+        return [sym_mod.Deconvolution(
+            *ins, kernel=kernel, num_filter=num_filter,
+            stride=tuple(a.get("strides", (1,) * len(kernel))),
+            pad=pads[:len(kernel)],
+            dilate=tuple(a.get("dilations", (1,) * len(kernel))),
+            num_group=int(a.get("group", 1)),
+            no_bias=len(ins) < 3)]
+    if op == "InstanceNormalization":
+        return [sym_mod.InstanceNorm(
+            *ins[:3], eps=float(a.get("epsilon", 1e-5)))]
+    if op == "Where":
+        return [sym_mod.where(*ins[:3])]
+    icmp = {"Greater": "broadcast_greater", "Less": "broadcast_lesser",
+            "Equal": "broadcast_equal",
+            "GreaterOrEqual": "broadcast_greater_equal",
+            "LessOrEqual": "broadcast_lesser_equal"}
+    if op in icmp:
+        return [getattr(sym_mod, icmp[op])(ins[0], ins[1])]
+    if op == "Slice":
+        def _ints(i):
+            nm = n["inputs"][i] if len(n["inputs"]) > i else ""
+            return [int(x) for x in inits[nm].ravel()]                 if nm in inits else None
+        starts, ends = _ints(1), _ints(2)
+        axes = _ints(3)
+        out = ins[0]
+        for j, ax in enumerate(axes or range(len(starts))):
+            end = ends[j]
+            out = sym_mod.slice_axis(
+                out, axis=int(ax), begin=starts[j],
+                end=None if end >= (1 << 60) else end)
+        return [out]
     raise MXNetError(f"ONNX import: unsupported op '{op}'")
 
 

@@ -157,3 +157,65 @@ def test_wire_format_self_describing(tmp_path):
     assert g["opset"] == 17
     assert {n["op_type"] for n in g["nodes"]} == {"Gemm", "Relu",
                                                   "Softmax"}
+
+
+def test_extended_op_round_trips(tmp_path):
+    """Round-trip the round-3 converter additions: activations with
+    params, clip, squeeze/unsqueeze, cast, max/min/pow, matmul, tile,
+    slice_axis, where (ref: mx2onnx/_op_translations op table)."""
+    from mxnet_tpu.contrib.onnx import export_model, import_model
+
+    rs = onp.random.RandomState(0)
+    x = sym.var("data")
+    w = rs.randn(5, 4).astype("float32")
+    net = sym.LeakyReLU(x, act_type="leaky", slope=0.1)
+    net = sym.clip(net, a_min=-0.5, a_max=2.0)
+    net = sym.dot(net, sym.var("w"))
+    net = sym.broadcast_power(net, sym.var("p"))
+    net = sym.expand_dims(net, axis=0)
+    net = sym.squeeze(net, axis=(0,))
+    net = sym.slice_axis(net, axis=1, begin=0, end=3)
+    net = sym.tile(net, reps=(1, 2))
+    net = sym.broadcast_maximum(net, sym.var("m"))
+    net = sym.Cast(net, dtype="float32")
+
+    params = {"w": nd.array(w),
+              "p": nd.array(onp.full((1, 4), 2.0, "float32")),
+              "m": nd.array(onp.zeros((1, 6), "float32"))}
+    path = str(tmp_path / "ext.onnx")
+    export_model(net, params, [(3, 5)], onnx_file_path=path)
+
+    sym2, arg2, _ = import_model(path)
+    xv = rs.randn(3, 5).astype("float32")
+    ref = net.bind(mx.cpu(), {"data": nd.array(xv), **params}) \
+        .forward()[0].asnumpy()
+    inputs = {k: v for k, v in arg2.items()}
+    got = sym2.bind(mx.cpu(), {"data": nd.array(xv), **inputs}) \
+        .forward()[0].asnumpy()
+    assert got.shape == ref.shape
+    assert onp.allclose(got, ref, atol=1e-5)
+
+
+def test_deconv_instancenorm_where_argmax_round_trip(tmp_path):
+    from mxnet_tpu.contrib.onnx import export_model, import_model
+
+    rs = onp.random.RandomState(1)
+    x = sym.var("data")
+    net = sym.Deconvolution(x, sym.var("dw"), kernel=(2, 2),
+                            num_filter=3, stride=(2, 2), no_bias=True)
+    net = sym.InstanceNorm(net, sym.var("g"), sym.var("b"), eps=1e-4)
+    net = sym.where(sym.broadcast_greater(net, sym.var("z")), net,
+                    sym.var("z"))
+    params = {"dw": nd.array(rs.randn(2, 3, 2, 2).astype("float32")),
+              "g": nd.array(onp.ones(3, "float32")),
+              "b": nd.array(onp.zeros(3, "float32")),
+              "z": nd.array(onp.zeros((1, 3, 1, 1), "float32"))}
+    path = str(tmp_path / "d.onnx")
+    export_model(net, params, [(2, 2, 4, 4)], onnx_file_path=path)
+    sym2, arg2, _ = import_model(path)
+    xv = rs.randn(2, 2, 4, 4).astype("float32")
+    ref = net.bind(mx.cpu(), {"data": nd.array(xv), **params}) \
+        .forward()[0].asnumpy()
+    got = sym2.bind(mx.cpu(), {"data": nd.array(xv), **arg2}) \
+        .forward()[0].asnumpy()
+    assert onp.allclose(got, ref, atol=1e-4)
