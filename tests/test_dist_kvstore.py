@@ -188,3 +188,20 @@ def test_yarn_launcher_command_construction(tmp_path):
     assert "-num_containers 2" in call
     assert "MX_NUM_WORKERS=2" in call
     assert "-shell_command echo worker" in call
+
+
+def test_horovod_compat_two_workers():
+    """Horovod-shaped API (contrib.horovod_compat) over the XLA
+    collective backend: allreduce avg/sum, broadcast_parameters,
+    DistributedTrainer gradient averaging — numerical equality asserted
+    in-rank (VERDICT r2 §2.4 'DP Horovod' row)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(ROOT, "tests", "nightly", "horovod_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"hvd compat test failed:\n{out[-3000:]}"
+    assert out.count("HVD_OK") == 2, out[-3000:]
