@@ -220,3 +220,10 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id, **kwargs)
+    # Complete the COLLECTIVE backend bring-up now, while every rank is
+    # at the same point (import/bootstrap): under jax.distributed the
+    # first backend touch exchanges local topologies across ALL ranks,
+    # and deferring it invites a distributed deadlock — e.g. rank 0
+    # stuck in lazy backend init waiting for peers' topology while the
+    # peers block on rank 0's kvstore server before ever touching jax.
+    jax.devices()
