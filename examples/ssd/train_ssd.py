@@ -94,7 +94,7 @@ def main(argv=None):
             path_imgrec=args.rec, batch_size=args.batch_size,
             data_shape=(3, args.image_size, args.image_size))
 
-    first = last = None
+    losses = []
     for step in range(args.steps):
         if det_iter is not None:
             try:
@@ -124,11 +124,15 @@ def main(argv=None):
         loss.backward()
         trainer.step(args.batch_size)
         lv = float(loss.asscalar())
-        first = first if first is not None else lv
-        last = lv
+        # the mined loss is noisy per step (positive/negative counts
+        # vary); callers assert a trend over first/last window MEANS
+        losses.append(lv)
         if step % 10 == 0:
             print(f"step {step}: loss {lv:.4f}")
-    print(f"loss {first:.4f} -> {last:.4f}")
+    w = min(5, max(1, len(losses) // 2))
+    first = sum(losses[:w]) / w
+    last = sum(losses[-w:]) / w
+    print(f"loss {first:.4f} -> {last:.4f} (first/last {w}-step means)")
 
     # detection decode (ref: example/ssd/demo.py)
     images, labels = synthetic_batch(rs, 2, args.image_size)
