@@ -82,8 +82,16 @@ def bench_transformer():
     from mxnet_tpu.parallel import ParallelTrainer
 
     if on_accel:
-        B, T, L, U, H, V = 8, 2048, 12, 768, 3072, 32000
-        steps = 20
+        # env-sweepable for on-chip MFU tuning (no code edits in a
+        # short healthy-tunnel window): MXTPU_TFMR_B/T/L/U/H/V/STEPS
+        e = os.environ.get
+        B = int(e("MXTPU_TFMR_B", 8))
+        T = int(e("MXTPU_TFMR_T", 2048))
+        L = int(e("MXTPU_TFMR_L", 12))
+        U = int(e("MXTPU_TFMR_U", 768))
+        H = int(e("MXTPU_TFMR_H", 3072))
+        V = int(e("MXTPU_TFMR_V", 32000))
+        steps = int(e("MXTPU_TFMR_STEPS", 20))
     else:
         B, T, L, U, H, V = 2, 128, 2, 64, 128, 512
         steps = 3
