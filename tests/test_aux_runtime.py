@@ -477,3 +477,35 @@ def test_rnn_scan_unroll_autotune_equivalence():
         cache = json.load(f)
     keys = cache.get("choices", cache)
     assert any("rnn_lstm|T6" in str(k) for k in keys), keys
+
+
+def test_ndarray_pickle_round_trips():
+    """NDArrays pickle by value across dense/sparse/np-subclass (the
+    spawn DataLoader contract; device placement intentionally not
+    serialized)."""
+    import pickle
+
+    a = nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    b = pickle.loads(pickle.dumps(a))
+    assert type(b) is type(a)
+    assert onp.array_equal(b.asnumpy(), a.asnumpy())
+
+    from mxnet_tpu.ndarray import sparse
+    rs = sparse.row_sparse_array(
+        (onp.ones((2, 3), "float32"), onp.array([1, 3])), shape=(5, 3))
+    rs2 = pickle.loads(pickle.dumps(rs))
+    assert rs2.stype == "row_sparse"
+    assert onp.array_equal(rs2.asnumpy(), rs.asnumpy())
+    assert onp.array_equal(rs2.indices.asnumpy(), [1, 3])
+
+    csr = sparse.csr_matrix(
+        (onp.asarray([1.0, 2.0], "float32"), onp.asarray([0, 2]),
+         onp.asarray([0, 1, 2])), shape=(2, 3))
+    csr2 = pickle.loads(pickle.dumps(csr))
+    assert csr2.stype == "csr"
+    assert onp.array_equal(csr2.asnumpy(), csr.asnumpy())
+
+    c = mx.np.array(onp.asarray([1.5, 2.5], "float32"))
+    c2 = pickle.loads(pickle.dumps(c))
+    assert type(c2).__name__ == "ndarray"  # mx.np subclass preserved
+    assert onp.allclose((c2 * 2).asnumpy(), [3.0, 5.0])
