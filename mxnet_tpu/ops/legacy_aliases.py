@@ -81,6 +81,26 @@ _ALIASES = {
     "_CrossDeviceCopy": "_copy",
     # sampling convenience names (ref: sample_op.cc add_alias)
     "uniform": "_random_uniform", "normal": "_random_normal",
+    "exponential": "_random_exponential", "poisson": "_random_poisson",
+    "negative_binomial": "_random_negative_binomial",
+    "generalized_negative_binomial":
+        "_random_generalized_negative_binomial",
+    # elemwise comparison/logical spellings (ref: elemwise_binary_op
+    # add_alias rows) — same-shape is the degenerate broadcast case
+    "_equal": "broadcast_equal", "_not_equal": "broadcast_not_equal",
+    "_greater": "broadcast_greater",
+    "_greater_equal": "broadcast_greater_equal",
+    "_lesser": "broadcast_lesser",
+    "_lesser_equal": "broadcast_lesser_equal",
+    "_logical_and": "broadcast_logical_and",
+    "_logical_or": "broadcast_logical_or",
+    "_logical_xor": "broadcast_logical_xor",
+    # scatter_* storage-preserving variants (ref: elemwise_binary_op
+    # _scatter_elemwise_div etc. — same math; sparse storage routing is
+    # the FComputeEx dispatcher's job here)
+    "_scatter_elemwise_div": "elemwise_div",
+    "_scatter_plus_scalar": "_plus_scalar",
+    "_scatter_minus_scalar": "_minus_scalar",
     "ravel_multi_index": "_ravel_multi_index",
     "unravel_index": "_unravel_index",
     # MKLDNN fused subgraph ops — on TPU the fusion is XLA's job, the
