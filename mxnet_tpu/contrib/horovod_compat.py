@@ -102,13 +102,27 @@ def broadcast(tensor, root_rank: int = 0, name=None, priority=0):
 
 def broadcast_parameters(params, root_rank: int = 0):
     """Sync initial parameters from root (ref: hvd.broadcast_parameters
-    — called once after initialize())."""
+    — called once after initialize()).
+
+    Deferred-shape parameters cannot be broadcast yet, so a one-shot
+    post-init hook is registered on each: the broadcast fires the
+    moment the first forward resolves the shape (Horovod registers a
+    deferred-init callback for exactly this — ranks seeded differently
+    would otherwise silently train divergent copies)."""
+    from ..gluon.parameter import DeferredInitializationError
     items = params.items() if hasattr(params, "items") else params
     for _name, p in items:
         try:
             data = p.data()
-        except Exception:
-            continue  # deferred-shape param: synced on first use
+        except DeferredInitializationError:
+            # only a DEFERRED param reaches _finish_deferred_init where
+            # the hooks fire; a never-initialized fixed-shape param
+            # raises plain MXNetError and must propagate — a hook
+            # registered for it would never run
+            p._post_init_hooks.append(
+                lambda param: param.data()._rebind(
+                    broadcast(param.data(), root_rank=root_rank)._data))
+            continue
         data._rebind(broadcast(data, root_rank=root_rank)._data)
 
 
@@ -117,10 +131,22 @@ class DistributedOptimizer:
     (ref: hvd.DistributedOptimizer)."""
 
     def __init__(self, optimizer):
-        self._opt = optimizer
+        # object.__setattr__: our own __setattr__ forwards to _opt
+        object.__setattr__(self, "_opt", optimizer)
 
     def __getattr__(self, name):
         return getattr(self._opt, name)
+
+    def __setattr__(self, name, value):
+        # Forward writes too: Trainer does `optimizer.rescale_grad = x`
+        # after wrapping — landing that on the wrapper only would leave
+        # the wrapped optimizer's stale value silently mis-scaling
+        # gradients (mirrors hvd.DistributedOptimizer, which subclasses
+        # the real Optimizer and therefore shares its attribute table).
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._opt, name, value)
 
     def update(self, index, weight, grad, state):
         g = allreduce(grad, average=True)

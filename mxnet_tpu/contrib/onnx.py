@@ -278,17 +278,25 @@ def _export_node(op, name, ins, outs, p, np_params, initializers):
         return [N("InstanceNormalization", ins[:3], outs, name,
                   {"epsilon": float(p.get("eps", 1e-3))})]
     if op == "where":
-        return [N("Where", ins[:3], outs, name)]
+        # ONNX Where requires a tensor(bool) condition; mxnet's is a
+        # same-dtype float mask (and, post-export, compare outputs are
+        # Cast to float for arithmetic consumers) — so re-Cast to bool
+        # here to keep the graph type-valid for strict consumers.
+        return [N("Cast", ins[:1], [f"{name}_cond"], f"{name}_cast",
+                  {"to": 9}),
+                N("Where", [f"{name}_cond"] + list(ins[1:3]), outs,
+                  name)]
     cmp = {"broadcast_greater": "Greater", "broadcast_lesser": "Less",
            "broadcast_equal": "Equal",
            "broadcast_greater_equal": "GreaterOrEqual",
            "broadcast_lesser_equal": "LessOrEqual"}
     if op in cmp:
-        # mxnet comparisons return same-dtype floats; ONNX returns bool.
-        # Where consumes bool directly, so emit the bare compare and a
-        # float Cast for any other consumer — the graph stays correct
-        # either way because import maps bool back through the same pair
-        return [N(cmp[op], ins[:2], outs, name)]
+        # mxnet comparisons return same-dtype floats; ONNX returns
+        # bool. Emit compare -> Cast(FLOAT) so arithmetic consumers
+        # (Mul/Add) stay type-valid ONNX; on import the Cast collapses
+        # to a no-op because broadcast_* already yields float.
+        return [N(cmp[op], ins[:2], [f"{name}_bool"], f"{name}_cmp"),
+                N("Cast", [f"{name}_bool"], outs, name, {"to": 1})]
     if op in ("slice_axis",):
         ax = int(p["axis"])
         begin = int(p["begin"])
@@ -525,6 +533,19 @@ def _import_node(n, values, inits, sym_mod):
             return [int(x) for x in inits[nm].ravel()]                 if nm in inits else None
         starts, ends = _ints(1), _ints(2)
         axes = _ints(3)
+        steps_name = n["inputs"][4] if len(n["inputs"]) > 4 else ""
+        steps = _ints(4)
+        if steps_name and steps is None:
+            # steps fed by a graph input / un-folded Constant: value is
+            # unknowable here, so refuse rather than silently assume 1
+            raise MXNetError(
+                f"ONNX import: Slice steps input {steps_name!r} is not "
+                f"an initializer; cannot verify steps == 1")
+        if steps is not None and any(s != 1 for s in steps):
+            raise MXNetError(
+                f"ONNX import: Slice with steps={steps} is not "
+                f"supported (only step 1); refusing to import a model "
+                f"that would produce silently wrong results")
         out = ins[0]
         for j, ax in enumerate(axes or range(len(starts))):
             end = ends[j]

@@ -43,6 +43,10 @@ class Parameter:
         self._stype = stype
         self._grad_stype = grad_stype
         self._trainer = None
+        # one-shot callbacks fired right after a deferred init resolves
+        # (e.g. horovod_compat.broadcast_parameters syncing a param whose
+        # shape was unknown at broadcast time)
+        self._post_init_hooks = []
 
     def __repr__(self):
         return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
@@ -112,6 +116,9 @@ class Parameter:
             raise DeferredInitializationError(
                 f"Parameter {self.name} has unknown shape")
         self._finish_init(init, ctx, default_init)
+        hooks, self._post_init_hooks = self._post_init_hooks, []
+        for hook in hooks:
+            hook(self)
 
     def _check_initialized(self):
         if self._data is not None:

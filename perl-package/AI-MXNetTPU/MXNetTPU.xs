@@ -49,6 +49,10 @@ nd_from_floats(AV *vals, AV *shape)
       buf[i] = (float)SvNV(*av_fetch(vals, i, 0));
     size_t nd = av_count(shape);
     uint32_t shp[8];
+    if (nd > 8) {
+      free(buf);
+      croak("nd_from_floats: ndim %zu exceeds the 8-dim shim limit", nd);
+    }
     for (i = 0; i < nd && i < 8; ++i)
       shp[i] = (uint32_t)SvUV(*av_fetch(shape, i, 0));
     NDArrayHandle h;

@@ -205,3 +205,53 @@ def test_horovod_compat_two_workers():
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"hvd compat test failed:\n{out[-3000:]}"
     assert out.count("HVD_OK") == 2, out[-3000:]
+
+
+def test_horovod_distributed_optimizer_forwards_writes():
+    """ADVICE r3: Trainer sets optimizer.rescale_grad AFTER wrapping;
+    the wrapper must forward attribute writes to the wrapped optimizer
+    or gradients are silently mis-scaled."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.horovod_compat import DistributedOptimizer
+
+    opt = mx.optimizer.SGD(learning_rate=0.1)
+    wrapped = DistributedOptimizer(opt)
+    wrapped.rescale_grad = 0.25
+    assert opt.rescale_grad == 0.25          # write reached the inner opt
+    assert wrapped.rescale_grad == 0.25      # and reads agree
+    wrapped._private = "wrapper-only"        # privates stay on the wrapper
+    assert not hasattr(opt, "_private")
+
+
+def test_horovod_broadcast_parameters_deferred_hook():
+    """ADVICE r3: broadcast_parameters on a deferred-init parameter must
+    register a post-init hook that fires when the shape resolves, not
+    silently skip the parameter."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import horovod_compat as hvd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(3)                        # in_units unknown: deferred
+    net.initialize()
+    params = net.collect_params()
+    hvd.broadcast_parameters(params, root_rank=0)
+    weight = next(p for name, p in params.items() if "weight" in name)
+    assert weight._post_init_hooks, "hook not registered on deferred param"
+    net(nd.ones((2, 5)))                     # first forward resolves shape
+    assert not weight._post_init_hooks, "hook did not fire after init"
+    assert weight.data().shape == (3, 5)
+
+
+def test_horovod_broadcast_uninitialized_raises():
+    """A never-initialized fixed-shape parameter must raise from
+    broadcast_parameters (its init path never fires post-init hooks, so
+    registering one would silently drop the broadcast)."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib import horovod_compat as hvd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(3, in_units=5)            # fixed shape, NOT initialized
+    with pytest.raises(MXNetError, match="initialize"):
+        hvd.broadcast_parameters(net.collect_params())

@@ -219,3 +219,70 @@ def test_deconv_instancenorm_where_argmax_round_trip(tmp_path):
     got = sym2.bind(mx.cpu(), {"data": nd.array(xv), **arg2}) \
         .forward()[0].asnumpy()
     assert onp.allclose(got, ref, atol=1e-4)
+
+
+def test_comparison_into_arithmetic_round_trip(tmp_path):
+    """ADVICE r3: a comparison feeding Mul/Add must export as
+    compare -> Cast(FLOAT), or the graph is type-invalid ONNX (bool
+    into arithmetic). Round-trips and checks the Cast node exists."""
+    from mxnet_tpu.contrib.onnx import export_model, import_model
+    from mxnet_tpu.contrib import onnx_proto as proto
+
+    rs = onp.random.RandomState(2)
+    x = sym.var("data")
+    mask = sym.broadcast_greater(x, sym.var("t"))
+    net = sym.broadcast_mul(mask, x)          # bool-into-Mul if uncast
+    params = {"t": nd.array(onp.zeros((1, 4), "float32"))}
+    path = str(tmp_path / "cmp.onnx")
+    export_model(net, params, [(3, 4)], onnx_file_path=path)
+
+    with open(path, "rb") as f:
+        g = proto.decode_model(f.read())
+    ops = [n["op_type"] for n in g["nodes"]]
+    gi = ops.index("Greater")
+    assert "Cast" in ops[gi:], "no float Cast after the comparison"
+
+    sym2, arg2, _ = import_model(path)
+    xv = rs.randn(3, 4).astype("float32")
+    ref = net.bind(mx.cpu(), {"data": nd.array(xv), **params}) \
+        .forward()[0].asnumpy()
+    got = sym2.bind(mx.cpu(), {"data": nd.array(xv), **arg2}) \
+        .forward()[0].asnumpy()
+    assert onp.allclose(got, ref, atol=1e-5)
+
+
+def test_slice_with_steps_refuses_import(tmp_path):
+    """ADVICE r3: ONNX Slice with steps != 1 must raise, not silently
+    ignore the steps input."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib.onnx import import_model
+    from mxnet_tpu.contrib import onnx_proto as proto
+
+    inits = [proto.tensor("starts", onp.asarray([0], "int64")),
+             proto.tensor("ends", onp.asarray([4], "int64")),
+             proto.tensor("axes", onp.asarray([1], "int64")),
+             proto.tensor("steps", onp.asarray([2], "int64"))]
+    nodes = [proto.node("Slice",
+                        ["data", "starts", "ends", "axes", "steps"],
+                        ["out"], "sl")]
+    g = proto.graph(nodes, "g", inits,
+                    [proto.value_info("data", (2, 8))],
+                    [proto.value_info("out", (2, 2))])
+    path = str(tmp_path / "steps.onnx")
+    with open(path, "wb") as f:
+        f.write(proto.model(g))
+    with pytest.raises(MXNetError, match="steps"):
+        import_model(path)
+
+    # step == 1 in the steps input stays importable
+    inits[3] = proto.tensor("steps", onp.asarray([1], "int64"))
+    g = proto.graph(nodes, "g", inits,
+                    [proto.value_info("data", (2, 8))],
+                    [proto.value_info("out", (2, 4))])
+    with open(path, "wb") as f:
+        f.write(proto.model(g))
+    sym2, _, _ = import_model(path)
+    xv = onp.arange(16, dtype="float32").reshape(2, 8)
+    got = sym2.bind(mx.cpu(), {"data": nd.array(xv)}) \
+        .forward()[0].asnumpy()
+    assert onp.allclose(got, xv[:, 0:4])

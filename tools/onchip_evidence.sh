@@ -18,6 +18,6 @@ python tools/bench_suite.py all
 # 3. CPU-vs-TPU operator consistency oracle (24 MXU-sized cases)
 python tools/check_tpu_consistency.py || true
 
-# 4. commit the evidence log immediately
-git add BENCH_TPU_LOG.jsonl
-git commit -m "On-chip benchmark evidence capture" || true
+# 4. commit the evidence log immediately (pathspec: don't sweep the
+#    shared index)
+git commit -m "On-chip benchmark evidence capture" -- BENCH_TPU_LOG.jsonl || true

@@ -1,0 +1,45 @@
+#!/bin/sh
+# Background TPU-tunnel watcher (VERDICT r3 item 1: "keep a background
+# watcher so a one-hour healthy window is not missed").
+#
+# Probes the accelerator in a killable subprocess every PROBE_INTERVAL
+# seconds; each attempt is appended to BENCH_TPU_LOG.jsonl so the
+# outage itself stays durable evidence. The moment a probe EXECUTES a
+# matmul on the chip (not merely enumerates it — see bench._probe_tpu),
+# it runs tools/onchip_evidence.sh, commits the log, and exits 0.
+# Exits 3 if MAX_SECONDS elapses without a healthy window.
+set -u
+cd "$(dirname "$0")/.."
+PROBE_INTERVAL="${PROBE_INTERVAL:-600}"
+MAX_SECONDS="${MAX_SECONDS:-39600}"   # ~11h: the round's wall clock
+START=$(date +%s)
+while :; do
+    NOW=$(date +%s)
+    ELAPSED=$((NOW - START))
+    if [ "$ELAPSED" -gt "$MAX_SECONDS" ]; then
+        printf '{"event":"watcher_giveup","elapsed_s":%d,"ts":"%s"}\n' \
+            "$ELAPSED" "$(date -u +%FT%TZ)" >> BENCH_TPU_LOG.jsonl
+        exit 3
+    fi
+    STATUS=$(python - <<'EOF'
+import sys; sys.path.insert(0, ".")
+from bench import _probe_tpu
+print(_probe_tpu(150))
+EOF
+)
+    printf '{"event":"watcher_probe","status":"%s","elapsed_s":%d,"ts":"%s"}\n' \
+        "$STATUS" "$ELAPSED" "$(date -u +%FT%TZ)" >> BENCH_TPU_LOG.jsonl
+    if [ "$STATUS" = "accel" ]; then
+        printf '{"event":"tunnel_healthy","ts":"%s"}\n' "$(date -u +%FT%TZ)" >> BENCH_TPU_LOG.jsonl
+        sh tools/onchip_evidence.sh > /tmp/onchip_evidence.out 2>&1
+        RC=$?
+        printf '{"event":"evidence_capture_done","rc":%d,"ts":"%s"}\n' \
+            "$RC" "$(date -u +%FT%TZ)" >> BENCH_TPU_LOG.jsonl
+        # pathspec commit: do NOT sweep whatever else is staged in the
+        # shared index into the watcher's commit
+        git commit -m "TPU watcher: on-chip evidence captured" \
+            -- BENCH_TPU_LOG.jsonl BENCH_r04.json || true
+        exit 0
+    fi
+    sleep "$PROBE_INTERVAL"
+done
