@@ -41,6 +41,15 @@ def export_model(sym, params, input_shape, input_type=None,
         sym = sym_mod2.load(sym)
     if isinstance(input_shape, tuple):
         input_shape = [input_shape]
+    if input_type is not None and onp.dtype(input_type) not in (
+            onp.dtype("float32"), onp.dtype("int32"), onp.dtype("int64")):
+        # the exporter coerces every float param to float32 below and
+        # declares float32 value_infos; emitting anything else would
+        # produce a silently mixed-dtype graph (e.g. the comparison
+        # Cast-to-FLOAT nodes assume float32 activations)
+        raise MXNetError(
+            f"ONNX export supports float32/int32/int64 inputs, got "
+            f"{input_type}; cast the model first")
     params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
     np_params = {k: (v.asnumpy() if isinstance(v, NDArray)
                      else onp.asarray(v)) for k, v in params.items()}
@@ -294,7 +303,10 @@ def _export_node(op, name, ins, outs, p, np_params, initializers):
         # mxnet comparisons return same-dtype floats; ONNX returns
         # bool. Emit compare -> Cast(FLOAT) so arithmetic consumers
         # (Mul/Add) stay type-valid ONNX; on import the Cast collapses
-        # to a no-op because broadcast_* already yields float.
+        # to a no-op because broadcast_* already yields float. FLOAT
+        # (not the operand dtype) is correct for THIS exporter: all
+        # float activations are float32 by contract (export_model
+        # coerces params and rejects other input_types).
         return [N(cmp[op], ins[:2], [f"{name}_bool"], f"{name}_cmp"),
                 N("Cast", [f"{name}_bool"], outs, name, {"to": 1})]
     if op in ("slice_axis",):
@@ -531,16 +543,24 @@ def _import_node(n, values, inits, sym_mod):
         def _ints(i):
             nm = n["inputs"][i] if len(n["inputs"]) > i else ""
             return [int(x) for x in inits[nm].ravel()]                 if nm in inits else None
-        starts, ends = _ints(1), _ints(2)
-        axes = _ints(3)
-        steps_name = n["inputs"][4] if len(n["inputs"]) > 4 else ""
-        steps = _ints(4)
-        if steps_name and steps is None:
-            # steps fed by a graph input / un-folded Constant: value is
-            # unknowable here, so refuse rather than silently assume 1
-            raise MXNetError(
-                f"ONNX import: Slice steps input {steps_name!r} is not "
-                f"an initializer; cannot verify steps == 1")
+        # every Slice operand must be a constant we can read: a
+        # graph-input- or un-folded-Constant-backed operand is
+        # unknowable here, and guessing (axes 0..k-1, step 1) produces
+        # silently wrong results
+        def _required(i, what):
+            nm = n["inputs"][i] if len(n["inputs"]) > i else ""
+            vals = _ints(i)
+            if nm and vals is None:
+                raise MXNetError(
+                    f"ONNX import: Slice {what} input {nm!r} is not an "
+                    f"initializer; cannot resolve it statically")
+            return vals
+
+        starts, ends = _required(1, "starts"), _required(2, "ends")
+        axes = _required(3, "axes")
+        steps = _required(4, "steps")
+        if starts is None or ends is None:
+            raise MXNetError("ONNX import: Slice requires starts/ends")
         if steps is not None and any(s != 1 for s in steps):
             raise MXNetError(
                 f"ONNX import: Slice with steps={steps} is not "

@@ -1,0 +1,155 @@
+"""Structural verification of compiled collectives.
+
+VERDICT r3 item 6: "assert the compiled HLO contains the expected
+collectives (all-reduce count/axes) so communication structure is
+verified even without hardware". XLA erases mesh axis NAMES during SPMD
+partitioning — the compiled HLO only has device-id replica_groups — so
+this module re-derives which mesh axes each collective spans by
+matching its groups against the group pattern every axis subset of the
+mesh would produce.
+
+Works on the post-SPMD HLO text (jit(f).lower(...).compile().as_text()).
+Handles both replica_groups syntaxes XLA prints:
+  - explicit:  replica_groups={{0,2},{1,3}}
+  - iota form: replica_groups=[2,4]<=[8] or [2,4]<=[4,2]T(1,0)
+and collective-permute's source_target_pairs={{0,1},{1,0}}.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Dict, FrozenSet, List, Optional
+
+import numpy as onp
+from jax.sharding import Mesh
+
+__all__ = ["collective_report", "axis_groups", "CollectiveInfo"]
+
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)(?:-start|-done)?\b[^\n]*")
+_EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}")
+
+
+class CollectiveInfo:
+    def __init__(self, op: str, groups, axes: Optional[FrozenSet[str]],
+                 line: str):
+        self.op = op
+        self.groups = groups          # frozenset of frozensets of ids
+        self.axes = axes              # inferred mesh axes, or None
+        self.line = line
+
+    def __repr__(self):
+        ax = "+".join(sorted(self.axes)) if self.axes else "?"
+        return f"<{self.op} over [{ax}]>"
+
+
+def _mesh_ids(mesh: Mesh) -> onp.ndarray:
+    return onp.vectorize(lambda d: d.id)(mesh.devices)
+
+
+def axis_groups(mesh: Mesh, axes) -> FrozenSet[FrozenSet[int]]:
+    """Device-id groups an XLA collective spanning exactly `axes` of
+    `mesh` would use: vary the given axes, fix the rest."""
+    names = list(mesh.axis_names)
+    ids = _mesh_ids(mesh)
+    move = [i for i, n in enumerate(names) if n in axes]
+    keep = [i for i, n in enumerate(names) if n not in axes]
+    group_size = int(onp.prod([ids.shape[i] for i in move], initial=1))
+    mat = ids.transpose(keep + move).reshape(-1, group_size)
+    return frozenset(frozenset(int(x) for x in row) for row in mat)
+
+
+def _parse_explicit(body: str) -> FrozenSet[FrozenSet[int]]:
+    return frozenset(
+        frozenset(int(x) for x in grp.split(",") if x.strip())
+        for grp in re.findall(r"\{([^{}]*)\}", body))
+
+
+def _parse_iota(n_groups, group_size, dims, perm) -> FrozenSet[FrozenSet[int]]:
+    dims = [int(d) for d in dims.split(",")]
+    flat = onp.arange(int(onp.prod(dims))).reshape(dims)
+    if perm:
+        flat = flat.transpose([int(p) for p in perm.split(",")])
+    mat = flat.reshape(int(n_groups), int(group_size))
+    return frozenset(frozenset(int(x) for x in row) for row in mat)
+
+
+def _groups_from_pairs(body: str) -> FrozenSet[FrozenSet[int]]:
+    """Treat each {src,dst} permute pair as a 2-element group; merging
+    the pairs of a ring over one axis reproduces that axis's groups."""
+    pairs = [tuple(int(x) for x in grp.split(","))
+             for grp in re.findall(r"\{([^{}]*)\}", body)]
+    # union-find merge of connected pairs -> the communicating sets
+    parent = {}
+
+    def find(a):
+        parent.setdefault(a, a)
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for a, b in pairs:
+        parent[find(a)] = find(b)
+    comp: Dict[int, set] = {}
+    for a, _ in pairs:
+        comp.setdefault(find(a), set()).add(a)
+    for _, b in pairs:
+        comp.setdefault(find(b), set()).add(b)
+    return frozenset(frozenset(s) for s in comp.values())
+
+
+def _infer_axes(groups, mesh: Mesh) -> Optional[FrozenSet[str]]:
+    names = list(mesh.axis_names)
+    for r in range(1, len(names) + 1):
+        for subset in itertools.combinations(names, r):
+            if axis_groups(mesh, subset) == groups:
+                return frozenset(subset)
+    return None
+
+
+def collective_report(hlo_text: str, mesh: Mesh) -> List[CollectiveInfo]:
+    """Every collective in the compiled HLO with its inferred mesh axes.
+
+    `-start`/`-done` async pairs are deduplicated (the -done op carries
+    no groups). Collectives whose groups match no axis subset get
+    axes=None — e.g. groups rewritten by XLA's collective combiner; the
+    caller decides whether that is acceptable."""
+    out: List[CollectiveInfo] = []
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        line = m.group(0)
+        if "-done" in line.split()[0]:
+            continue
+        op = m.group(1)
+        groups = None
+        em = _EXPLICIT_GROUPS_RE.search(line)
+        im = _IOTA_GROUPS_RE.search(line)
+        pm = _PAIRS_RE.search(line)
+        if em:
+            groups = _parse_explicit(em.group(1))
+        elif im:
+            groups = _parse_iota(*im.groups())
+        elif pm:
+            groups = _groups_from_pairs(pm.group(1))
+        if groups is None:
+            continue
+        # singleton groups = no communication (SPMD artifact); skip
+        if all(len(g) <= 1 for g in groups):
+            continue
+        out.append(CollectiveInfo(op, groups, _infer_axes(groups, mesh),
+                                  line))
+    return out
+
+
+def summarize(report: List[CollectiveInfo]) -> Dict[str, int]:
+    """{'all-reduce[data]': 3, ...} count map for logging/artifacts."""
+    counts: Dict[str, int] = {}
+    for info in report:
+        ax = "+".join(sorted(info.axes)) if info.axes else "?"
+        key = f"{info.op}[{ax}]"
+        counts[key] = counts.get(key, 0) + 1
+    return counts

@@ -1,0 +1,35 @@
+"""Combined-mesh worker: dp x tp x sp x ep x pipe in ONE mesh.
+
+Run as a subprocess with its own virtual device count (the main suite
+pins 8 in-process devices; 16/32-device cases need a fresh backend):
+
+    python combined_mesh_worker.py <n_devices> <dp> <tp> <sp> <pp>
+
+Delegates to parallel.pipeline_lm.combined_mesh_drill — the SAME oracle
+the driver's dryrun runs (VERDICT r3 item 6): n-step Adam trajectory vs
+the dense single-device reference, plus per-axis verification of the
+compiled HLO's collectives. Prints COMBINED_MESH_OK on success.
+"""
+import json
+import os
+import sys
+
+n_dev, dp, tp, sp, pp = (int(a) for a in sys.argv[1:6])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={n_dev}")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from mxnet_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mxnet_tpu.parallel.pipeline_lm import combined_mesh_drill  # noqa: E402
+
+assert dp * tp * sp * pp == n_dev, "factorization must cover the mesh"
+mesh = make_mesh({"data": dp, "model": tp, "seq": sp, "pipe": pp},
+                 jax.devices()[:n_dev])
+counts, dense_traj, pipe_traj = combined_mesh_drill(mesh)
+print("collectives:", json.dumps(counts))
+print("COMBINED_MESH_OK", n_dev, dp, tp, sp, pp,
+      json.dumps({"dense": dense_traj, "pipe": pipe_traj}))

@@ -36,9 +36,10 @@ EOF
         printf '{"event":"evidence_capture_done","rc":%d,"ts":"%s"}\n' \
             "$RC" "$(date -u +%FT%TZ)" >> BENCH_TPU_LOG.jsonl
         # pathspec commit: do NOT sweep whatever else is staged in the
-        # shared index into the watcher's commit
+        # shared index into the watcher's commit (only the tracked
+        # evidence log — an unknown pathspec would abort the commit)
         git commit -m "TPU watcher: on-chip evidence captured" \
-            -- BENCH_TPU_LOG.jsonl BENCH_r04.json || true
+            -- BENCH_TPU_LOG.jsonl || true
         exit 0
     fi
     sleep "$PROBE_INTERVAL"
