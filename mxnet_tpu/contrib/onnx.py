@@ -41,15 +41,24 @@ def export_model(sym, params, input_shape, input_type=None,
         sym = sym_mod2.load(sym)
     if isinstance(input_shape, tuple):
         input_shape = [input_shape]
-    if input_type is not None and onp.dtype(input_type) not in (
-            onp.dtype("float32"), onp.dtype("int32"), onp.dtype("int64")):
-        # the exporter coerces every float param to float32 below and
-        # declares float32 value_infos; emitting anything else would
-        # produce a silently mixed-dtype graph (e.g. the comparison
-        # Cast-to-FLOAT nodes assume float32 activations)
-        raise MXNetError(
-            f"ONNX export supports float32/int32/int64 inputs, got "
-            f"{input_type}; cast the model first")
+    if input_type is not None:
+        # the exporter declares every data input's value_info as
+        # float32 and coerces float params to float32 below; any other
+        # input_type would silently produce a mixed-dtype graph (e.g.
+        # the comparison Cast-to-FLOAT nodes assume float32
+        # activations). input_type may be one dtype or one per input
+        # (reference export_model signature).
+        types = input_type if isinstance(input_type, (list, tuple)) \
+            else [input_type]
+        for t in types:
+            try:
+                ok = onp.dtype(t) == onp.dtype("float32")
+            except TypeError:
+                ok = False
+            if not ok:
+                raise MXNetError(
+                    f"ONNX export supports float32 data inputs only, "
+                    f"got {t!r}; cast the model first")
     params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
     np_params = {k: (v.asnumpy() if isinstance(v, NDArray)
                      else onp.asarray(v)) for k, v in params.items()}

@@ -107,6 +107,12 @@ class Parameter:
             # the data NDArray itself carries the grad buffer
             self._data._grad = self._grad
             self._data._grad_req = self.grad_req
+        # fire here (not in _finish_deferred_init) so hooks run however
+        # the init resolves — first forward OR a later initialize()
+        # with the shape filled in / force_reinit
+        hooks, self._post_init_hooks = self._post_init_hooks, []
+        for hook in hooks:
+            hook(self)
 
     def _finish_deferred_init(self):
         if not self._deferred_init:
@@ -116,9 +122,6 @@ class Parameter:
             raise DeferredInitializationError(
                 f"Parameter {self.name} has unknown shape")
         self._finish_init(init, ctx, default_init)
-        hooks, self._post_init_hooks = self._post_init_hooks, []
-        for hook in hooks:
-            hook(self)
 
     def _check_initialized(self):
         if self._data is not None:

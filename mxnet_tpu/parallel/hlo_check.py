@@ -25,9 +25,12 @@ from jax.sharding import Mesh
 
 __all__ = ["collective_report", "axis_groups", "CollectiveInfo"]
 
+# anchored to the HLO instruction position (`%name = <type> op(...)`;
+# the type may be a spaced tuple for -start ops) so op_name metadata
+# strings can't produce phantom entries
 _COLLECTIVE_RE = re.compile(
-    r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|"
-    r"collective-permute)(?:-start|-done)?\b[^\n]*")
+    r"=\s*(?:\([^)]*\)|\S+)\s+(all-reduce|all-gather|reduce-scatter|"
+    r"all-to-all|collective-permute)((?:-start|-done)?)\([^\n]*")
 _EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
 _IOTA_GROUPS_RE = re.compile(
     r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
@@ -116,26 +119,34 @@ def collective_report(hlo_text: str, mesh: Mesh) -> List[CollectiveInfo]:
     """Every collective in the compiled HLO with its inferred mesh axes.
 
     `-start`/`-done` async pairs are deduplicated (the -done op carries
-    no groups). Collectives whose groups match no axis subset get
-    axes=None — e.g. groups rewritten by XLA's collective combiner; the
-    caller decides whether that is acceptable."""
+    no groups). Collectives whose groups match no axis subset — or
+    whose groups could not be parsed at all — get axes=None (and
+    groups=None for the unparseable case) rather than being dropped, so
+    a caller asserting "no unexplained communication" really covers
+    every collective. An empty `replica_groups={}` is legal HLO meaning
+    ONE group spanning all devices."""
+    all_ids = frozenset(int(x) for x in _mesh_ids(mesh).ravel())
     out: List[CollectiveInfo] = []
     for m in _COLLECTIVE_RE.finditer(hlo_text):
         line = m.group(0)
-        if "-done" in line.split()[0]:
+        if m.group(2) == "-done":
             continue
         op = m.group(1)
         groups = None
         em = _EXPLICIT_GROUPS_RE.search(line)
         im = _IOTA_GROUPS_RE.search(line)
         pm = _PAIRS_RE.search(line)
-        if em:
+        if "replica_groups={}" in line:
+            groups = frozenset({all_ids})
+        elif em:
             groups = _parse_explicit(em.group(1))
         elif im:
             groups = _parse_iota(*im.groups())
         elif pm:
             groups = _groups_from_pairs(pm.group(1))
         if groups is None:
+            # groups syntax we don't recognize: surface, don't hide
+            out.append(CollectiveInfo(op, None, None, line))
             continue
         # singleton groups = no communication (SPMD artifact); skip
         if all(len(g) <= 1 for g in groups):

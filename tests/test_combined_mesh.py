@@ -77,6 +77,11 @@ def test_hlo_check_parsers():
   c = f32[4] all-gather(d), replica_groups=[4,2]<=[4,2]T(1,0)
   e = f32[4] collective-permute(f), source_target_pairs={{0,1},{1,0},{2,3},{3,2},{4,5},{5,4},{6,7},{7,6}}
   g = f32[4] all-reduce(h), replica_groups={{0},{1},{2},{3},{4},{5},{6},{7}}
+  i = f32[4] all-reduce(j), replica_groups={}
+  k = f32[4] all-to-all(l), replica_groups=<weird new syntax>
+  m = (f32[4], f32[4]) all-reduce-start(n), replica_groups={{0,4},{1,5},{2,6},{3,7}}
+  o = f32[4] all-reduce-done(m)
+  p = f32[4] add(q), metadata={op_name="jit(f)/all-reduce"}
 """
     rep = collective_report(hlo, mesh)
     kinds = {(i.op, i.axes) for i in rep}
@@ -85,5 +90,13 @@ def test_hlo_check_parsers():
     # regroup by 2 = {0,2},{4,6},{1,3},{5,7}, i.e. the 'model' axis
     assert ("all-gather", frozenset({"model"})) in kinds
     assert ("collective-permute", frozenset({"seq"})) in kinds
-    # the singleton-groups all-reduce communicates nothing: filtered out
-    assert len(rep) == 3
+    # empty replica_groups = ONE group over all devices = every axis
+    assert ("all-reduce", frozenset({"data", "model", "seq"})) in kinds
+    # unrecognized groups syntax surfaces as axes=None, not a drop
+    assert any(i.op == "all-to-all" and i.axes is None and i.groups is None
+               for i in rep)
+    # singleton-groups all-reduce communicates nothing (filtered);
+    # -done halves and op_name metadata strings don't create entries
+    ops = [i.op for i in rep]
+    assert ops.count("all-reduce") == 3  # data, all-axes, -start(data)
+    assert len(rep) == 6
