@@ -115,10 +115,11 @@ def broadcast_parameters(params, root_rank: int = 0):
         try:
             data = p.data()
         except DeferredInitializationError:
-            # only a DEFERRED param reaches _finish_deferred_init where
-            # the hooks fire; a never-initialized fixed-shape param
-            # raises plain MXNetError and must propagate — a hook
-            # registered for it would never run
+            # hooks fire in _finish_init, so the broadcast runs however
+            # the deferred shape resolves (first forward or a direct
+            # initialize()). A never-initialized fixed-shape param
+            # raises plain MXNetError and must propagate: the user
+            # forgot initialize(), and parking a hook would hide that.
             p._post_init_hooks.append(
                 lambda param: param.data()._rebind(
                     broadcast(param.data(), root_rank=root_rank)._data))

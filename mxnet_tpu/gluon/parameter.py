@@ -94,7 +94,6 @@ class Parameter:
                         dtype=onp.dtype(self.dtype).name
                         if not isinstance(self.dtype, str) else self.dtype)
         initializer = init or self.init or default_init
-        init_mod.create(initializer) if isinstance(initializer, str) else None
         if isinstance(initializer, str):
             initializer = init_mod.create(initializer)
         initializer(init_mod.InitDesc(self.name), data)

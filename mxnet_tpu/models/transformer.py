@@ -25,6 +25,7 @@ import numpy as onp
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 from ..ndarray.ndarray import NDArray, invoke
+from ..ops.pallas_kernels import flash_attention_available as _fa_available
 from ..parallel.ring_attention import local_attention
 from ..parallel.mesh import P
 
@@ -84,7 +85,7 @@ class MultiHeadAttention(HybridBlock):
                          seq_axis=self._cp_axis, causal=causal,
                          strategy=self._cp_strategy,
                          block_size=getattr(self, "_cp_block_size", None))
-        elif _on_tpu() and T % 128 == 0 and self._head_dim in (64, 128, 256):
+        elif _on_tpu() and _fa_available(T, T, self._head_dim):
             # two valid backends on TPU: the Pallas flash kernel (O(T)
             # memory) and XLA dense attention. Which is faster depends
             # on T/D/dtype — measured once on the eager warm-up forward

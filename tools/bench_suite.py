@@ -208,6 +208,28 @@ def bench_flash():
           platform="tpu" if on_accel else "cpu",
           device_kind=getattr(devs[0], "device_kind", "unknown"))
 
+    # Padded path (T=400 pads the tail K block -> kv_len mask active;
+    # D=96 -> 128 contraction pad): proves the round-4 pad/mask tiling
+    # compiles under Mosaic on real hardware, not just interpret mode
+    Bp, Hp, Tp, Dp = (8, 12, 400, 96) if on_accel else (1, 2, 100, 96)
+    qp = jnp.asarray(rs.randn(Bp, Hp, Tp, Dp), dt_)
+    kp = jnp.asarray(rs.randn(Bp, Hp, Tp, Dp), dt_)
+    vp = jnp.asarray(rs.randn(Bp, Hp, Tp, Dp), dt_)
+    fnp = jax.jit(step)
+    d2h_fence(fnp(qp, kp, vp))  # compile
+    lat = d2h_fence_latency(fnp(qp, kp, vp))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fnp(qp, kp, vp)
+    d2h_fence(r)
+    raw = time.perf_counter() - t0
+    _emit("flash_attention_padded_fwd_bwd",
+          round(net_time(raw, lat) / n * 1e3, 2), "ms",
+          batch=Bp, heads=Hp, seq_len=Tp, head_dim=Dp, causal=True,
+          lat_dominated=lat_dominated(raw, lat),
+          platform="tpu" if on_accel else "cpu",
+          device_kind=getattr(devs[0], "device_kind", "unknown"))
+
 
 def bench_pipeline():
     _init_jax()  # decode path is host-side, but importing mxnet_tpu
