@@ -174,6 +174,8 @@ def test_flash_attention_available_predicate():
     assert flash_attention_available(128, 128, 64)
     assert flash_attention_available(128, 100, 64)
     assert flash_attention_available(384, 384, 96)
-    # ...but tiny sequences and oversized heads still fall back
+    # 128-multiple big heads tile exactly; other big heads fall back
+    assert flash_attention_available(128, 128, 512)
+    assert not flash_attention_available(128, 128, 300)
+    # tiny sequences still fall back
     assert not flash_attention_available(16, 16, 64)
-    assert not flash_attention_available(128, 128, 512)
