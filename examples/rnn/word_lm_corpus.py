@@ -52,19 +52,27 @@ def batches(ids, batch, bptt):
             for i in range(0, x.shape[1], bptt)]
 
 
-def build_symbol(V, E, H, layers, T):
-    """Unrolled tied-weight LSTM LM: one fixed-shape compiled graph."""
+def build_symbol(V, E, H, layers, T, dropout=0.0):
+    """Unrolled tied-weight LSTM LM: one fixed-shape compiled graph.
+    dropout matches the reference model.py placement: on the embedding,
+    between stacked LSTM layers, and on the final hidden states."""
     data = sym.var("data")
     label = sym.var("softmax_label")
     embed_w = sym.var("embed_weight")
     emb = sym.Embedding(data, weight=embed_w, input_dim=V, output_dim=E,
                         name="embed")
+    if dropout > 0:
+        emb = sym.Dropout(emb, p=dropout, name="embed_drop")
     stack = mx.rnn.SequentialRNNCell()
     for i in range(layers):
         stack.add(mx.rnn.LSTMCell(H, prefix=f"lstm{i}_"))
+        if dropout > 0 and i < layers - 1:
+            stack.add(mx.rnn.DropoutCell(dropout, prefix=f"drop{i}_"))
     outputs, _ = stack.unroll(T, inputs=emb, merge_outputs=True,
                               layout="NTC")
     hid = sym.Reshape(outputs, shape=(-1, H))
+    if dropout > 0:
+        hid = sym.Dropout(hid, p=dropout, name="out_drop")
     # TIED decoder: the softmax weight IS the embedding matrix
     logits = sym.FullyConnected(hid, weight=embed_w, num_hidden=V,
                                 no_bias=True, name="decoder")
@@ -104,32 +112,66 @@ def main(argv=None):
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--lr", type=float, default=0.003)
+    p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--tpu", action="store_true")
+    p.add_argument("--reference-recipe", action="store_true",
+                   help="the reference 44.26-ppl config "
+                        "(example/rnn/word_lm/train.py defaults: "
+                        "emsize=nhid=650, 2 layers, tied, dropout 0.5, "
+                        "SGD lr=1.0 clip=0.2, batch 32, bptt 35, lr/4 "
+                        "annealing on validation plateau)")
     args = p.parse_args(argv)
+    if args.reference_recipe:
+        args.embed, args.layers, args.bptt = 650, 2, 35
+        args.batch, args.dropout, args.lr = 32, 0.5, 1.0
 
     mx.random.seed(args.seed)
     onp.random.seed(args.seed)
 
     train_ids, vocab = load_corpus("train")
+    valid_ids, _ = load_corpus("valid", vocab)
     test_ids, _ = load_corpus("test", vocab)
     V, E = len(vocab), args.embed
     print(f"train {len(train_ids)} tokens / test {len(test_ids)} / "
           f"vocab {V}")
 
-    lm = build_symbol(V, E, E, args.layers, args.bptt)
+    lm = build_symbol(V, E, E, args.layers, args.bptt,
+                      dropout=args.dropout)
     mod = mx.mod.Module(lm, data_names=["data"],
                         label_names=["softmax_label"],
                         context=mx.cpu() if not args.tpu else mx.tpu())
     train_b = batches(train_ids, args.batch, args.bptt)
+    valid_b = batches(valid_ids, args.batch, args.bptt)
     test_b = batches(test_ids, args.batch, args.bptt)
     mod.bind(data_shapes=[("data", (args.batch, args.bptt))],
              label_shapes=[("softmax_label", (args.batch, args.bptt))])
     mod.init_params(mx.init.Xavier(magnitude=2.0))
-    mod.init_optimizer(optimizer="adam",
-                       optimizer_params={"learning_rate": args.lr})
     metric = mx.metric.Perplexity(ignore_label=None)
-    train_ppl = run_epochs(mod, train_b, args.epochs, metric)
+
+    if args.reference_recipe:
+        # reference train.py loop: SGD + grad clip, anneal lr by 4 when
+        # the validation perplexity stops improving
+        lr = args.lr
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": lr,
+                                             "clip_gradient": 0.2})
+        best_val = float("inf")
+        train_ppl = None
+        for ep in range(args.epochs):
+            train_ppl = run_epochs(mod, train_b, 1, metric)
+            val_ppl = score(mod, valid_b, metric)
+            if val_ppl < best_val:
+                best_val = val_ppl
+            else:
+                lr /= 4.0
+                mod._optimizer.set_learning_rate(lr)
+            print(f"epoch {ep}: train_ppl={train_ppl:.2f} "
+                  f"val_ppl={val_ppl:.2f} lr={lr}")
+    else:
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": args.lr})
+        train_ppl = run_epochs(mod, train_b, args.epochs, metric)
     test_ppl = score(mod, test_b, metric)
     print(f"train_perplexity={train_ppl:.3f}")
     print(f"test_perplexity={test_ppl:.3f}")

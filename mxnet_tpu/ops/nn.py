@@ -69,17 +69,43 @@ def convolution(data, weight, *bias, kernel=None, stride=None, dilate=None,
     stride = tuple(stride) if stride else (1,) * k
     dilate = tuple(dilate) if dilate else (1,) * k
     pad = tuple(pad) if pad else (0,) * k
-    out = jax.lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=_conv_dims(nd),
-        feature_group_count=num_group,
-    )
-    if not no_bias and bias:
-        out = out + bias[0].reshape((1, -1) + (1,) * k)
-    return out
+    pads = [(p, p) for p in pad]
+
+    def _nchw(data, weight, *bias):
+        out = jax.lax.conv_general_dilated(
+            data, weight, window_strides=stride, padding=pads,
+            rhs_dilation=dilate, dimension_numbers=_conv_dims(nd),
+            feature_group_count=num_group)
+        if not no_bias and bias:
+            out = out + bias[0].reshape((1, -1) + (1,) * k)
+        return out
+
+    def _nhwc(data, weight, *bias):
+        # transpose-to-NHWC candidate: the TPU's native conv layout.
+        # Inside one jit XLA's layout assignment makes this moot, but at
+        # an EAGER boundary each op is its own program and the transpose
+        # cost vs kernel speedup is a real, shape-dependent trade
+        # (ref role: operator_tune.h kAuto over MKLDNN layout choices).
+        x = jnp.transpose(data, (0, 2, 3, 1))
+        w = jnp.transpose(weight, (2, 3, 1, 0))           # OIHW->HWIO
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pads,
+            rhs_dilation=dilate,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=num_group)
+        if not no_bias and bias:
+            out = out + bias[0].reshape((1, 1, 1, -1))
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    if nd == 4:
+        from .. import operator_tune as _otune
+        _, fn = _otune.choose(
+            "conv_layout", [("nchw", _nchw), ("nhwc", _nhwc)],
+            data, weight, *bias,
+            key=(f"conv_layout|{tuple(data.shape)}|{tuple(weight.shape)}"
+                 f"|{data.dtype}|s{stride}|p{pad}|d{dilate}|g{num_group}"))
+        return fn(data, weight, *bias)
+    return _nchw(data, weight, *bias)
 
 
 @register_op("Deconvolution", input_names=("data", "weight", "bias"))

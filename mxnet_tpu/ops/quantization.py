@@ -9,6 +9,8 @@ min/max tensor pair, matching the reference's 3-tensor calling convention.
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -92,7 +94,28 @@ def quantized_fully_connected(data, weight, bias, min_data, max_data,
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
     w = weight if weight.dtype == jnp.int8 else weight.astype(jnp.int8)
-    acc = jax.lax.dot(x, w.T, preferred_element_type=jnp.int32)
+
+    def _dot_i8(x, w):
+        return jax.lax.dot(x, w.T, preferred_element_type=jnp.int32)
+
+    def _dot_f32(x, w):
+        # int8 products (<= 127^2) and their sums up to 2^24 are exact
+        # in f32, so this candidate is bit-identical while using the
+        # float pipeline — faster than an s32 matmul on backends with
+        # no native int8 mode (the guard below keeps it exact)
+        return jax.lax.dot(x.astype(jnp.float32), w.T.astype(jnp.float32)
+                           ).astype(jnp.int32)
+
+    cands = [("int8", _dot_i8)]
+    # bound with 128^2: -128 is representable in caller-supplied int8
+    # tensors even though our own quantize ops clip to +/-127
+    if x.shape[-1] * 128 * 128 < 2 ** 24:
+        cands.append(("f32", _dot_f32))
+    from .. import operator_tune as _otune
+    _, dot = _otune.choose(
+        "quantized_dot", cands, x, w,
+        key=f"qdot|{tuple(x.shape)}|{tuple(w.shape)}")
+    acc = dot(x, w)
     if not no_bias:
         acc = acc + bias.astype(jnp.int32)
     s_d, _ = _range_to_scale(min_data, max_data)
@@ -111,12 +134,37 @@ def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
     stride = tuple(stride) if stride else (1,) * k
     dilate = tuple(dilate) if dilate else (1,) * k
     pad = tuple(pad) if pad else (0,) * k
-    acc = jax.lax.conv_general_dilated(
-        data.astype(jnp.int8), weight.astype(jnp.int8),
-        window_strides=stride, padding=[(p, p) for p in pad],
-        rhs_dilation=dilate, dimension_numbers=_conv_dims(data.ndim),
-        feature_group_count=num_group,
-        preferred_element_type=jnp.int32)
+
+    def _conv_i8(d8, w8):
+        return jax.lax.conv_general_dilated(
+            d8, w8, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=_conv_dims(d8.ndim),
+            feature_group_count=num_group,
+            preferred_element_type=jnp.int32)
+
+    def _conv_f32(d8, w8):
+        # exact while the per-output accumulation fits f32's integer
+        # range (see _dot_f32); same int32-accumulator contract
+        return jax.lax.conv_general_dilated(
+            d8.astype(jnp.float32), w8.astype(jnp.float32),
+            window_strides=stride, padding=[(p, p) for p in pad],
+            rhs_dilation=dilate, dimension_numbers=_conv_dims(d8.ndim),
+            feature_group_count=num_group).astype(jnp.int32)
+
+    d8 = data.astype(jnp.int8)
+    w8 = weight.astype(jnp.int8)
+    # accumulation taps per output element: C_in/group x kernel volume
+    taps = weight.shape[1] * int(math.prod(kernel))
+    cands = [("int8", _conv_i8)]
+    if taps * 128 * 128 < 2 ** 24:  # 128^2: -128 reachable (see above)
+        cands.append(("f32", _conv_f32))
+    from .. import operator_tune as _otune
+    _, conv = _otune.choose(
+        "quantized_conv", cands, d8, w8,
+        key=(f"qconv|{tuple(d8.shape)}|{tuple(w8.shape)}"
+             f"|s{stride}|p{pad}|d{dilate}|g{num_group}"))
+    acc = conv(d8, w8)
     if not no_bias:
         acc = acc + bias.astype(jnp.int32).reshape((1, -1) + (1,) * k)
     s_d, _ = _range_to_scale(min_data, max_data)
