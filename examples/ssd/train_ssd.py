@@ -28,15 +28,20 @@ from mxnet_tpu.gluon import nn
 
 
 class TinySSD(nn.HybridBlock):
-    """ref: example/ssd/symbol/symbol_builder.py, reduced."""
+    """ref: example/ssd/symbol/symbol_builder.py, reduced.
 
-    def __init__(self, num_classes=1, num_anchors=4, **kw):
+    num_stages scales the backbone depth to the input resolution: the
+    receptive field must cover the object (the reference's SSD-300
+    rides VGG16 to stride 32); 3 stride-2 stages suffice at 64x64 but
+    see only ~15px at 300x300, collapsing mAP."""
+
+    def __init__(self, num_classes=1, num_anchors=4, num_stages=3, **kw):
         super().__init__(**kw)
         self.na = num_anchors
         self.nc = num_classes
         with self.name_scope():
             self.backbone = nn.HybridSequential()
-            for ch in (16, 32, 32):
+            for ch in (16, 32, 32, 64, 64)[:num_stages]:
                 self.backbone.add(nn.Conv2D(ch, 3, 2, 1,
                                             activation="relu"))
             self.cls_head = nn.Conv2D(num_anchors * (num_classes + 1), 3,
@@ -82,7 +87,8 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     rs = onp.random.RandomState(0)
-    net = TinySSD()
+    # stride 8 for thumbnails, stride 32 at VOC-like resolutions
+    net = TinySSD(num_stages=3 if args.image_size <= 96 else 5)
     net.initialize(mx.initializer.Xavier())
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": args.lr, "momentum": 0.9})

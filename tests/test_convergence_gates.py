@@ -55,3 +55,50 @@ def test_ssd_synthetic_voc_map_gate():
     assert last < first
     assert mean_ap == pytest.approx(SSD_MAP_48, abs=0.08), \
         f"mAP {mean_ap:.3f} vs pinned {SSD_MAP_48}"
+
+
+# ---------------------------------------------------------------------------
+# round-4 full-recipe gates (VERDICT r3 item 4). These reproduce the
+# REFERENCE recipe shapes, not thumbnails: run them with
+# MXTPU_FULL_GATES=1 (word-LM ~50 min, SSD ~25 min on CPU — too long
+# for the default suite, which keeps the scaled pins above). The
+# measured values and the honest gap to the reference numbers live in
+# ROUND4_NOTES.md.
+# ---------------------------------------------------------------------------
+
+WORD_LM_REFERENCE_RECIPE_PPL = 168.59   # 20 epochs, pinned 2026-08-01
+SSD_300_MAP_300 = 0.558                 # 250 steps / 300 eval images
+
+
+def _full_gates_enabled():
+    return os.environ.get("MXTPU_FULL_GATES") == "1"
+
+
+@pytest.mark.slow
+def test_word_lm_reference_recipe_gate():
+    """Full reference recipe shape (650-unit tied 2-layer LSTM, dropout
+    0.5, SGD+clip, lr/4 annealing — example/rnn/word_lm/train.py
+    defaults) on the bundled 31k-token corpus. Reference: 44.26 ppl on
+    the ~580k-token Sherlock corpus; the gap is corpus size."""
+    if not _full_gates_enabled():
+        pytest.skip("set MXTPU_FULL_GATES=1 (runs ~50 min on CPU)")
+    mod = _load("rnn/word_lm_corpus.py")
+    _, test_ppl = mod.main(["--reference-recipe", "--epochs", "20"])
+    assert test_ppl == pytest.approx(WORD_LM_REFERENCE_RECIPE_PPL,
+                                     rel=0.08), test_ppl
+
+
+@pytest.mark.slow
+def test_ssd_300x300_map_gate():
+    """SSD at the reference's 300x300 resolution over a 300-image
+    synthetic-VOC eval set (stride-32 backbone — the receptive field
+    must cover the object, the reason the reference rides VGG16).
+    Reference: 77.8 VOC07 mAP with full VOC data and long training."""
+    if not _full_gates_enabled():
+        pytest.skip("set MXTPU_FULL_GATES=1 (runs ~25 min on CPU)")
+    mod = _load("ssd/train_ssd.py")
+    first, last, mean_ap = mod.main(
+        ["--steps", "250", "--batch-size", "8", "--image-size", "300",
+         "--eval-images", "300"])
+    assert last < first
+    assert mean_ap == pytest.approx(SSD_300_MAP_300, abs=0.08), mean_ap

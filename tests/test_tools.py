@@ -95,3 +95,26 @@ def test_check_tpu_consistency_self_test():
     assert lines, proc.stdout[-500:]
     data = json.loads(lines[-1])
     assert data["value"] == data["total"] and not data["failed"], data
+
+
+@pytest.mark.slow
+def test_check_tpu_consistency_registry_sweep_self_test(tmp_path):
+    """The FULL-REGISTRY cross-backend sweep (VERDICT r3 item 5)
+    validated cpu-vs-cpu: every unique registered op executes on both
+    'devices', fresh-RNG ops compare structurally, and the per-op
+    report artifact is written with zero fails."""
+    report = str(tmp_path / "sweep.json")
+    proc = _run([os.path.join(ROOT, "tools", "check_tpu_consistency.py"),
+                 "--self-test", "--registry", "--report", report],
+                timeout=900)
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-800:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    data = json.loads(lines[-1])
+    assert data["n_failed"] == 0, data
+    assert data["total"] >= 400, data  # the whole unique-op registry
+    rep = json.load(open(report))
+    assert rep["passed"] + rep["skipped"] == rep["total"]
+    assert rep["passed"] > 0, rep  # a sweep of pure skips is no sweep
+    # per-op entries carry the artifact fields the verdict asked for
+    sample = [r for r in rep["report"] if r["status"] == "pass"][0]
+    assert {"op", "rtol", "atol", "max_abs_err"} <= set(sample)
