@@ -17,12 +17,14 @@ inspectable XLA program:
 - 'model' : Megatron-style tensor parallel (attention heads + MoE
             experts sharded) — expert parallel rides the same axis, as
             in the rest of this framework (parallel/moe.py).
-- 'seq'   : activations sequence-sharded (Megatron-SP style: XLA
-            gathers K/V for the causal attention). The ring-attention
-            path (parallel/ring_attention.py) remains the long-context
-            kernel; here the point is the five-axis composition in one
-            program, where the all-gather formulation lets GSPMD place
-            the collectives.
+- 'seq'   : two selectable formulations (attention= kwarg):
+            "gspmd" (default) — activations sequence-sharded,
+            Megatron-SP style, XLA all-gathers K/V for the causal
+            product; "ring" — TRUE ring attention
+            (parallel/ring_attention.py) as a NESTED partial-manual
+            shard_map over 'seq' inside the 'pipe'-manual stage: K/V
+            (and their global positions) rotate around the ICI ring
+            with online softmax, O(T_local^2) memory.
 
 The reference has no pipeline parallelism at all (SURVEY.md §2.4;
 closest is staged PartialForward, graph_executor.cc:82) — this is part
@@ -127,26 +129,47 @@ def _rmsnorm(h, scale):
         jnp.mean(jnp.square(h), axis=-1, keepdims=True) + 1e-6)
 
 
-def _layer(lp, h, shard):
+def _layer(lp, h, shard, attention="gspmd"):
     """One pre-LN block: causal MHA + top-1-gated MoE FFN.
 
     `shard(x, axes)` annotates GSPMD shardings (identity in the dense
     reference): activations (data, seq)-sharded, heads/experts on
-    'model'. K/V are annotated seq-REPLICATED so XLA inserts the
-    all-gather over 'seq' that makes the causal product q_local @ k_full
-    legal — the Megatron-SP formulation of sequence parallelism."""
+    'model'.
+
+    attention="gspmd": K/V are annotated seq-REPLICATED so XLA inserts
+    the all-gather over 'seq' that makes the causal product
+    q_local @ k_full legal — the Megatron-SP formulation.
+    attention="ring": TRUE ring attention (parallel/ring_attention.py)
+    as a nested partial-manual shard_map over 'seq' inside the
+    'pipe'-manual stage — K/V rotate around the ICI ring with online
+    softmax, O(T_local^2) memory, the long-context kernel composed into
+    the five-axis mesh."""
+    if attention not in ("gspmd", "ring"):
+        raise ValueError(f"attention must be 'gspmd' or 'ring', "
+                         f"got {attention!r}")
     B, T, D = h.shape
     H, K = lp["wo"].shape[0], lp["wo"].shape[1]
 
     hn = _rmsnorm(h, lp["ln1"])
     qkv = jnp.einsum("btd,cdhk->cbthk", hn, lp["wqkv"])
-    q = shard(qkv[0], ("data", "seq", "model", None))
-    k = shard(qkv[1], ("data", None, "model", None))
-    v = shard(qkv[2], ("data", None, "model", None))
-    logits = jnp.einsum("bthk,bshk->bhts", q, k) / onp.sqrt(K)
-    causal = jnp.tril(jnp.ones((T, T), bool))
-    att = jax.nn.softmax(jnp.where(causal, logits, -1e30), axis=-1)
-    ctx = jnp.einsum("bhts,bshk->bthk", att, v)
+    if attention == "ring":
+        from .ring_attention import ring_attention
+        q = shard(qkv[0], ("data", "seq", "model", None))
+        k = shard(qkv[1], ("data", "seq", "model", None))
+        v = shard(qkv[2], ("data", "seq", "model", None))
+        ctx = ring_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), mesh=None, seq_axis="seq",
+            causal=True, scale=1.0 / onp.sqrt(K), nested=True,
+        ).transpose(0, 2, 1, 3)
+    else:
+        q = shard(qkv[0], ("data", "seq", "model", None))
+        k = shard(qkv[1], ("data", None, "model", None))
+        v = shard(qkv[2], ("data", None, "model", None))
+        logits = jnp.einsum("bthk,bshk->bhts", q, k) / onp.sqrt(K)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        att = jax.nn.softmax(jnp.where(causal, logits, -1e30), axis=-1)
+        ctx = jnp.einsum("bhts,bshk->bthk", att, v)
     h = h + shard(jnp.einsum("bthk,hkd->btd", ctx, lp["wo"]),
                   ("data", "seq", None))
 
@@ -175,7 +198,7 @@ def _no_shard(x, axes):
 
 
 def _pipelined_stack(layers_staged, h, mesh, n_stage: int,
-                     num_microbatches: int, shard):
+                     num_microbatches: int, shard, attention="gspmd"):
     """GPipe over the 'pipe' axis of `mesh`, differentiable.
 
     layers_staged leaves: (n_stage, per_stage, ...), stage dim sharded
@@ -193,7 +216,7 @@ def _pipelined_stack(layers_staged, h, mesh, n_stage: int,
         perm = [(j, (j + 1) % n_stage) for j in range(n_stage)]
 
         def stage_body(hc, lp):
-            return _layer(lp, hc, shard), None
+            return _layer(lp, hc, shard, attention=attention), None
 
         def tick(carry, t):
             buf, outs = carry
@@ -232,13 +255,13 @@ def _lm_head_loss(params, h, labels, shard):
 
 
 def pipeline_lm_loss(params_staged, tokens, labels, mesh, n_stage: int,
-                     num_microbatches: int):
+                     num_microbatches: int, attention: str = "gspmd"):
     """Mean NLL of the pipelined model. params_staged: stage layout."""
     shard = _mesh_shard(mesh)
     h = params_staged["embed"][tokens]
     h = shard(h, ("data", "seq", None))
     h = _pipelined_stack(params_staged["layers"], h, mesh, n_stage,
-                         num_microbatches, shard)
+                         num_microbatches, shard, attention=attention)
     return _lm_head_loss(params_staged, h, labels, shard)
 
 
@@ -261,7 +284,8 @@ def dense_lm_loss(params, tokens, labels):
 # ---------------------------------------------------------------------------
 
 def build_pipeline_lm_step(mesh: Mesh, n_stage: int,
-                           num_microbatches: int, lr: float = 1e-3):
+                           num_microbatches: int, lr: float = 1e-3,
+                           attention: str = "gspmd"):
     """Returns (step, in_shardings) where step(params_staged, opt_state,
     tokens, labels) -> (params_staged, opt_state, loss) is one jitted
     XLA program: pipelined forward, backward through the GPipe schedule,
@@ -272,7 +296,8 @@ def build_pipeline_lm_step(mesh: Mesh, n_stage: int,
 
     def step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(pipeline_lm_loss)(
-            params, tokens, labels, mesh, n_stage, num_microbatches)
+            params, tokens, labels, mesh, n_stage, num_microbatches,
+            attention)
         new_params, new_opt = adam_apply(params, grads, opt_state, lr=lr)
         return new_params, new_opt, loss
 
@@ -291,7 +316,7 @@ def build_pipeline_lm_step(mesh: Mesh, n_stage: int,
 def combined_mesh_drill(mesh: Mesh, *, num_microbatches: int = 2,
                         lr: float = 1e-3, n_steps: int = 2,
                         seed: int = 0, data_seed: int = 11,
-                        rtol: float = 2e-4):
+                        rtol: float = 2e-4, attention: str = "gspmd"):
     """End-to-end verification of the five-axis composition on `mesh`
     (axes 'data'/'model'/'seq'/'pipe'; ep rides 'model'):
 
@@ -332,7 +357,7 @@ def combined_mesh_drill(mesh: Mesh, *, num_microbatches: int = 2,
 
     staged = stage_params(params, pp)
     step, (pspec, ospec, dspec) = build_pipeline_lm_step(
-        mesh, pp, num_microbatches, lr=lr)
+        mesh, pp, num_microbatches, lr=lr, attention=attention)
     ppar = jax.device_put(staged, pspec)
     popt = jax.tree.map(lambda v, s: jax.device_put(v, s),
                         adam_init(staged), ospec)

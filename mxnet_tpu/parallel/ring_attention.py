@@ -67,18 +67,24 @@ def _online_update(o, l, m, q, k_c, v_c, scale_v, qpos, kpos):
     return new_o, new_l, new_m
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+def _ring_attention_local(q, k, v, pos, axis_name: str, causal: bool,
                           scale: Optional[float],
                           block_size: Optional[int] = None):
-    """Executed per-device under shard_map. q/k/v: (B,H,T_loc,D).
+    """Executed per-device under shard_map. q/k/v: (B,H,T_loc,D);
+    pos: (T_loc,) int32 — this shard's GLOBAL sequence positions.
 
     block_size chunks each ring step's K/V along the sequence axis so
     the logits buffer is (T_loc, block_size) instead of (T_loc, T_loc)
     — blockwise attention inside ring attention, the long-context
     memory shape the reference has no analog for (SURVEY §5.7 mandate).
-    None = one chunk (logits T_loc x T_loc)."""
-    axis_size = jax.lax.psum(1, axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    None = one chunk (logits T_loc x T_loc).
+
+    The K positions ROTATE around the ring alongside K/V rather than
+    being derived from jax.lax.axis_index — axis_index (and a constant
+    psum) lowers to an op that re-binds parent-manual axes under
+    shardy, which breaks the nested partial-manual composition
+    (ring-inside-GPipe, parallel/pipeline_lm.py)."""
+    axis_size = jax.lax.axis_size(axis_name)
     B, H, T, D = q.shape
     scale_v = scale if scale is not None else 1.0 / jnp.sqrt(D)
     C = block_size if block_size and block_size < T else T
@@ -90,46 +96,67 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     o = jnp.zeros((B, H, T, D), jnp.float32)
     l = jnp.zeros((B, H, T), jnp.float32)          # sum of exp
     m = jnp.full((B, H, T), -jnp.inf, jnp.float32)  # running max
+    qpos = pos if causal else None
+    # positions only ride the ring when the mask needs them — the
+    # non-causal path must not pay an extra collective per step
+    kpos0 = pos if causal else jnp.zeros((0,), jnp.int32)
 
     def body(i, carry):
-        o, l, m, k_blk, v_blk = carry
-        src_idx = (my_idx - i) % axis_size  # whose K/V block we hold now
-        qpos = my_idx * T + jnp.arange(T) if causal else None
+        o, l, m, k_blk, v_blk, kpos_blk = carry
 
         def chunk(j, inner):
             o, l, m = inner
             k_c = jax.lax.dynamic_slice_in_dim(k_blk, j * C, C, axis=2)
             v_c = jax.lax.dynamic_slice_in_dim(v_blk, j * C, C, axis=2)
-            kpos = src_idx * T + j * C + jnp.arange(C) if causal else None
+            kpos = jax.lax.dynamic_slice_in_dim(kpos_blk, j * C, C, 0) \
+                if causal else None
             return _online_update(o, l, m, q, k_c, v_c, scale_v,
                                   qpos, kpos)
 
         o, l, m = jax.lax.fori_loop(0, T // C, chunk, (o, l, m))
-        # rotate K/V to the next device (nearest-neighbour ICI hop)
+        # rotate K/V (and, when causal, their positions) to the next
+        # device — a nearest-neighbour ICI hop
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (o, l, m, k_next, v_next)
+        kpos_next = jax.lax.ppermute(kpos_blk, axis_name, perm) \
+            if causal else kpos_blk
+        return (o, l, m, k_next, v_next, kpos_next)
 
-    o, l, m, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, l, m, k, v))
+    o, l, m, _, _, _ = jax.lax.fori_loop(0, axis_size, body,
+                                         (o, l, m, k, v, kpos0))
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+def ring_attention(q, k, v, mesh: Optional[Mesh], seq_axis: str = "seq",
                    causal: bool = False, scale: Optional[float] = None,
-                   block_size: Optional[int] = None):
+                   block_size: Optional[int] = None,
+                   nested: bool = False):
     """q/k/v: (B, H, T_global, D) logically; sharded over `seq_axis` on the
     T dimension. Returns attention output with the same sharding.
     block_size chunks K/V within each ring step (blockwise-in-ring) so
-    per-device logits memory is O(T_loc * block_size)."""
+    per-device logits memory is O(T_loc * block_size).
+
+    nested=True: run as a PARTIAL-manual shard_map over only `seq_axis`,
+    inheriting the caller's context mesh — the mode that composes inside
+    another shard_map region (e.g. the 'pipe'-manual GPipe stage of
+    parallel/pipeline_lm.py) with the remaining axes still GSPMD.
+    Requires a jit context (eager partial-manual is unsupported in jax)."""
     fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
                            causal=causal, scale=scale,
                            block_size=block_size)
     spec = P(None, None, seq_axis, None)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec, check_vma=False)
-    return mapped(q, k, v)
+    pos = jnp.arange(q.shape[2], dtype=jnp.int32)
+    kwargs = dict(in_specs=(spec, spec, spec, P(seq_axis)),
+                  out_specs=spec, check_vma=False)
+    if nested:
+        # the caller's (manual) context supplies the mesh; passing the
+        # concrete Mesh here would conflict with its abstract form
+        kwargs["axis_names"] = {seq_axis}
+    else:
+        kwargs["mesh"] = mesh
+    return jax.shard_map(fn, **kwargs)(q, k, v, pos)
 
 
 def _ulysses_local(q, k, v, axis_name: str, causal: bool,

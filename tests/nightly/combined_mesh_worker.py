@@ -3,7 +3,7 @@
 Run as a subprocess with its own virtual device count (the main suite
 pins 8 in-process devices; 16/32-device cases need a fresh backend):
 
-    python combined_mesh_worker.py <n_devices> <dp> <tp> <sp> <pp>
+    python combined_mesh_worker.py <n_devices> <dp> <tp> <sp> <pp> [attention]
 
 Delegates to parallel.pipeline_lm.combined_mesh_drill — the SAME oracle
 the driver's dryrun runs (VERDICT r3 item 6): n-step Adam trajectory vs
@@ -15,6 +15,7 @@ import os
 import sys
 
 n_dev, dp, tp, sp, pp = (int(a) for a in sys.argv[1:6])
+attention = sys.argv[6] if len(sys.argv) > 6 else "gspmd"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
@@ -29,7 +30,8 @@ from mxnet_tpu.parallel.pipeline_lm import combined_mesh_drill  # noqa: E402
 assert dp * tp * sp * pp == n_dev, "factorization must cover the mesh"
 mesh = make_mesh({"data": dp, "model": tp, "seq": sp, "pipe": pp},
                  jax.devices()[:n_dev])
-counts, dense_traj, pipe_traj = combined_mesh_drill(mesh)
+counts, dense_traj, pipe_traj = combined_mesh_drill(mesh,
+                                                     attention=attention)
 print("collectives:", json.dumps(counts))
-print("COMBINED_MESH_OK", n_dev, dp, tp, sp, pp,
+print("COMBINED_MESH_OK", n_dev, dp, tp, sp, pp, attention,
       json.dumps({"dense": dense_traj, "pipe": pipe_traj}))

@@ -16,11 +16,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "nightly", "combined_mesh_worker.py")
 
 
-def _run_worker(n_dev, dp, tp, sp, pp, timeout=900):
+def _run_worker(n_dev, dp, tp, sp, pp, timeout=900, attention="gspmd"):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
     proc = subprocess.run(
-        [sys.executable, WORKER] + [str(x) for x in (n_dev, dp, tp, sp, pp)],
+        [sys.executable, WORKER]
+        + [str(x) for x in (n_dev, dp, tp, sp, pp)] + [attention],
         env=env, capture_output=True, text=True, timeout=timeout)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0 and "COMBINED_MESH_OK" in out, out[-3000:]
@@ -30,6 +31,15 @@ def _run_worker(n_dev, dp, tp, sp, pp, timeout=900):
 def test_combined_mesh_16_devices():
     """dp2 x tp2 x sp2 x pipe2 (ep rides 'model'): every axis > 1."""
     _run_worker(16, 2, 2, 2, 2)
+
+
+def test_combined_mesh_16_ring_attention():
+    """TRUE ring attention (K/V rotating via ppermute, online softmax)
+    as a NESTED partial-manual shard_map over 'seq' inside the
+    'pipe'-manual GPipe stage — the long-context kernel composed into
+    the five-axis mesh, still matching the dense trajectory."""
+    out = _run_worker(16, 2, 2, 2, 2, attention="ring")
+    assert "collective-permute[seq]" in out  # the ring is really there
 
 
 @pytest.mark.slow
