@@ -20,7 +20,8 @@ __all__ = ["get_model", "resnet18_v1", "resnet34_v1", "resnet50_v1",
            "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
            "mobilenet_v2_1_0", "get_resnet", "get_vgg", "get_mobilenet",
            "ResNetV1", "ResNetV2", "VGG", "AlexNet", "SqueezeNet",
-           "DenseNet", "MobileNet", "MobileNetV2"]
+           "DenseNet", "MobileNet", "MobileNetV2", "Inception3",
+           "inception_v3"]
 
 
 # ---------------------------------------------------------------------------

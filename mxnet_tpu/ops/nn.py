@@ -52,6 +52,19 @@ def fully_connected(data, weight, *bias, num_hidden=0, no_bias=False, flatten=Tr
 # Convolution (ref: src/operator/nn/convolution.cc; MXU path)
 # ---------------------------------------------------------------------------
 
+_ACCEL_PRESENT = None
+
+
+def _accel_present() -> bool:
+    """True when a non-CPU device exists (cached: jax.devices() is
+    stable for the life of the backend)."""
+    global _ACCEL_PRESENT
+    if _ACCEL_PRESENT is None:
+        import jax
+        _ACCEL_PRESENT = any(d.platform != "cpu" for d in jax.devices())
+    return _ACCEL_PRESENT
+
+
 def _conv_dims(ndim):
     if ndim == 3:
         return ("NCW", "OIW", "NCW")
@@ -97,7 +110,11 @@ def convolution(data, weight, *bias, kernel=None, stride=None, dilate=None,
             out = out + bias[0].reshape((1, 1, 1, -1))
         return jnp.transpose(out, (0, 3, 1, 2))
 
-    if nd == 4:
+    if nd == 4 and _accel_present():
+        # accelerator-only: the layout trade is an MXU/TPU question,
+        # and measuring it costs two extra compiles per first-seen
+        # shape — a tax eager CPU workloads (and the CPU test suite)
+        # must not pay for a choice that cannot pay off there
         from .. import operator_tune as _otune
         _, fn = _otune.choose(
             "conv_layout", [("nchw", _nchw), ("nhwc", _nhwc)],

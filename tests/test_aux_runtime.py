@@ -514,11 +514,15 @@ def test_ndarray_pickle_round_trips():
 def test_conv_layout_tune_site(tmp_path, monkeypatch):
     """VERDICT r3 item 8: the eager conv boundary tunes NCHW-direct vs
     transpose-to-NHWC; both candidates agree numerically and a winner
-    lands in the cache."""
+    lands in the cache. The site is accelerator-gated (measuring costs
+    two compiles per shape — a tax CPU eager work must not pay), so the
+    test forces the gate open."""
     import numpy as onp
 
     from mxnet_tpu import operator_tune
+    from mxnet_tpu.ops import nn as nn_ops
 
+    monkeypatch.setattr(nn_ops, "_ACCEL_PRESENT", True)
     monkeypatch.setenv("MXNET_HOME", str(tmp_path))
     operator_tune.clear_cache()
     prev_mode = operator_tune.tuning_mode()
