@@ -293,3 +293,11 @@ def test_bucketing_lm_example():
     the bucketed-jit answer to dynamic sequence lengths."""
     ppl = _load("rnn/bucketing_lm.py").main(["--epochs", "10"])
     assert ppl < 6.0  # random would be ~15
+
+
+def test_combined_mesh_lm_example():
+    """Five-axis combined mesh example (dp x tp x sp x ep x pipe; the
+    model-parallel story told mesh-first) trains under loss descent."""
+    loss = _load("model_parallel/combined_mesh_lm.py").main(
+        ["--steps", "8"])
+    assert loss < 5.8  # V=256 -> untrained ~ ln(256)=5.54+moe noise
