@@ -22,8 +22,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
 N_DEV = int(os.environ.get("MXTPU_EXAMPLE_DEVICES", "8"))
-os.environ.setdefault("XLA_FLAGS",
-                      f"--xla_force_host_platform_device_count={N_DEV}")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        f"{_flags} --xla_force_host_platform_device_count={N_DEV}"
+    ).strip()
 if "--tpu" not in sys.argv:
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -76,11 +79,12 @@ def main(argv=None):
     compiled = step.lower(pars, opt, tokens, labels).compile()
     print("collectives per axis:",
           summarize(collective_report(compiled.as_text(), mesh)))
+    loss = None
     for i in range(args.steps):
         pars, opt, loss = compiled(pars, opt, tokens, labels)
         if i % 2 == 0 or i == args.steps - 1:
             print(f"step {i}: loss {float(loss):.4f}")
-    return float(loss)
+    return float(loss) if loss is not None else None
 
 
 if __name__ == "__main__":

@@ -96,6 +96,9 @@ def _save_disk_cache():
             except (OSError, ValueError):
                 pass
             merged.update(_choices)
+            # drop pre-platform-scoping keys (no "|@plat" suffix): they
+            # can never be looked up again and would accrete forever
+            merged = {k: v for k, v in merged.items() if "|@" in k}
             tmp = cache_path() + f".tmp{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(merged, f, indent=0, sort_keys=True)
@@ -138,6 +141,26 @@ def _time_candidate(fn: Callable, args, kwargs, iters: int) -> float:
         times.append(time.perf_counter() - t0)
     times.sort()
     return times[len(times) // 2]
+
+
+def _exec_platform(raw) -> str:
+    """Platform the candidates would EXECUTE on: taken from the first
+    concrete array argument (device-resident truth), else the active
+    jax.default_device(...) context (host/numpy args execute THERE —
+    exactly the tunnel-safe warm-up pattern), else the process default
+    backend (the jit-trace case)."""
+    import jax
+    for x in jax.tree.leaves(raw):
+        devs = getattr(x, "devices", None)
+        if callable(devs):
+            try:
+                return next(iter(devs())).platform
+            except Exception:
+                continue
+    dd = getattr(jax.config, "jax_default_device", None)
+    if dd is not None and hasattr(dd, "platform"):
+        return dd.platform
+    return jax.default_backend()
 
 
 def _sig(name: str, args, kwargs) -> str:
@@ -188,6 +211,13 @@ def choose(name: str, candidates: Sequence[Tuple[str, Callable]],
         return candidates[0]
     raw = [getattr(a, "_data", a) for a in args]
     key = key or _sig(name, raw, kwargs)
+    # scope the cache by EXECUTION platform: an eager warm-up pinned to
+    # the host (jax.default_device(cpu) — the tunnel-safe init pattern)
+    # must not cache a CPU-measured winner that a TPU trace then serves
+    # (observed: the flash-vs-dense choice measured on CPU picking dense
+    # for the chip). Concrete arrays name their platform; tracers fall
+    # back to the process default backend.
+    key = f"{key}|@{_exec_platform(raw)}"
     with _lock:
         _load_disk_cache()
         idx = _choices.get(key)

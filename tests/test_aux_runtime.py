@@ -575,3 +575,33 @@ def test_quantized_dot_tune_site(tmp_path, monkeypatch):
     finally:
         operator_tune.set_tuning_mode(prev_mode)
         operator_tune.clear_cache()
+
+
+def test_tune_cache_keys_scoped_by_platform(tmp_path, monkeypatch):
+    """A warm-up measured under jax.default_device(cpu) must not cache
+    a winner that a TPU trace would later serve: every cache key is
+    suffixed with the EXECUTION platform of the measured arrays."""
+    import numpy as onp
+
+    from mxnet_tpu import operator_tune
+
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))
+    operator_tune.clear_cache()
+    prev_mode = operator_tune.tuning_mode()
+    operator_tune.set_tuning_mode("auto")
+    try:
+        import jax
+        plat = jax.default_backend()
+        x = onp.ones((4,), "float32")
+        operator_tune.choose("platkey",
+                             [("a", lambda v: v), ("b", lambda v: v + 0)],
+                             x, key="platkey|fixed")
+        keys = list(operator_tune._choices)
+        assert any(k == f"platkey|fixed|@{plat}" for k in keys), keys
+        # a lookup scoped to another platform misses (returns default,
+        # does not serve this platform's winner)
+        other = "tpu" if plat == "cpu" else "cpu"
+        assert f"platkey|fixed|@{other}" not in operator_tune._choices
+    finally:
+        operator_tune.set_tuning_mode(prev_mode)
+        operator_tune.clear_cache()
