@@ -18,6 +18,12 @@ from .ring_attention import (  # noqa: F401
     local_attention,
 )
 from .pipeline import pipeline_apply  # noqa: F401
+from .pipeline_lm import (  # noqa: F401
+    init_pipeline_lm, stage_params, pipeline_lm_shardings,
+    build_pipeline_lm_step, pipeline_lm_loss, dense_lm_loss,
+    combined_mesh_drill,
+)
+from .hlo_check import collective_report, axis_groups  # noqa: F401
 
 
 # Multi-host init (ref role: ps-lite scheduler wiring via DMLC_* env,
