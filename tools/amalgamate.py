@@ -261,6 +261,8 @@ def _forward(graph, params, data):
                                -1).reshape(x[0].shape)
         elif op == "Dropout":
             out = x[0]                  # inference: identity
+        elif op == "clip":
+            out = np.clip(x[0], float(a["a_min"]), float(a["a_max"]))
         elif op in ("elemwise_add", "_plus", "_Plus", "broadcast_add"):
             out = x[0] + x[1]
         elif op in ("elemwise_mul", "broadcast_mul"):
