@@ -34,6 +34,11 @@ git add CONSISTENCY_SWEEP.json 2>/dev/null || true
 git commit -m "On-chip full-registry consistency sweep report" \
     -- CONSISTENCY_SWEEP.json 2>/dev/null || true
 
-# 5. final evidence-log commit picks up anything the sweeps appended
-git commit -m "On-chip evidence: consistency sweep log lines" \
+# 5. MFU sweep (bonus: after the core evidence is safely committed) —
+#    larger batch / larger transformer to find the best MFU point
+MXTPU_BENCH_BATCH=512 MXTPU_BENCH_TIMEOUT=1200 timeout 1500 python bench.py || true
+MXTPU_TFMR_B=16 timeout 1800 python tools/bench_suite.py transformer || true
+
+# 6. final evidence-log commit picks up anything the sweeps appended
+git commit -m "On-chip evidence: sweeps and consistency log lines" \
     -- BENCH_TPU_LOG.jsonl || true
