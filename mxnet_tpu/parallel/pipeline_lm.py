@@ -198,7 +198,8 @@ def _no_shard(x, axes):
 
 
 def _pipelined_stack(layers_staged, h, mesh, n_stage: int,
-                     num_microbatches: int, shard, attention="gspmd"):
+                     num_microbatches: int, shard, attention="gspmd",
+                     remat=False):
     """GPipe over the 'pipe' axis of `mesh`, differentiable.
 
     layers_staged leaves: (n_stage, per_stage, ...), stage dim sharded
@@ -215,8 +216,15 @@ def _pipelined_stack(layers_staged, h, mesh, n_stage: int,
         outs = jnp.zeros_like(micro)
         perm = [(j, (j + 1) % n_stage) for j in range(n_stage)]
 
+        # prevent_cse=False: under lax.scan the problematic CSE cannot
+        # occur and the default optimization barriers would only block
+        # XLA fusion (the jax-recommended scan+checkpoint setting)
+        layer_fn = (jax.checkpoint(_layer, prevent_cse=False,
+                                   static_argnums=(2, 3))
+                    if remat else _layer)
+
         def stage_body(hc, lp):
-            return _layer(lp, hc, shard, attention=attention), None
+            return layer_fn(lp, hc, shard, attention), None
 
         def tick(carry, t):
             buf, outs = carry
@@ -255,13 +263,19 @@ def _lm_head_loss(params, h, labels, shard):
 
 
 def pipeline_lm_loss(params_staged, tokens, labels, mesh, n_stage: int,
-                     num_microbatches: int, attention: str = "gspmd"):
-    """Mean NLL of the pipelined model. params_staged: stage layout."""
+                     num_microbatches: int, attention: str = "gspmd",
+                     remat: bool = False):
+    """Mean NLL of the pipelined model. params_staged: stage layout.
+    remat=True checkpoints each LAYER inside the stage scan (the
+    classic scan-over-layers rematerialization): activation memory per
+    stage drops from O(layers) to O(1) at the cost of one extra
+    forward in the backward."""
     shard = _mesh_shard(mesh)
     h = params_staged["embed"][tokens]
     h = shard(h, ("data", "seq", None))
     h = _pipelined_stack(params_staged["layers"], h, mesh, n_stage,
-                         num_microbatches, shard, attention=attention)
+                         num_microbatches, shard, attention=attention,
+                         remat=remat)
     return _lm_head_loss(params_staged, h, labels, shard)
 
 
@@ -285,7 +299,8 @@ def dense_lm_loss(params, tokens, labels):
 
 def build_pipeline_lm_step(mesh: Mesh, n_stage: int,
                            num_microbatches: int, lr: float = 1e-3,
-                           attention: str = "gspmd"):
+                           attention: str = "gspmd",
+                           remat: bool = False):
     """Returns (step, in_shardings) where step(params_staged, opt_state,
     tokens, labels) -> (params_staged, opt_state, loss) is one jitted
     XLA program: pipelined forward, backward through the GPipe schedule,
@@ -297,7 +312,7 @@ def build_pipeline_lm_step(mesh: Mesh, n_stage: int,
     def step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(pipeline_lm_loss)(
             params, tokens, labels, mesh, n_stage, num_microbatches,
-            attention)
+            attention, remat)
         new_params, new_opt = adam_apply(params, grads, opt_state, lr=lr)
         return new_params, new_opt, loss
 

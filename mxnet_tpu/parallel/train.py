@@ -135,6 +135,14 @@ class ParallelTrainer:
                  mesh: Optional[Mesh] = None, batch_axis: str = "data",
                  zero: bool = False, donate: bool = True,
                  param_shardings: Optional[Dict[str, P]] = None):
+        # NOTE on rematerialization: a monolithic jax.checkpoint around
+        # the whole loss would NOT reduce peak activation memory (the
+        # recomputed forward's intermediates are all live again during
+        # the backward) — remat only pays when applied per segment,
+        # which needs model structure. The pipelined trainer
+        # (pipeline_lm.build_pipeline_lm_step(remat=True)) checkpoints
+        # per LAYER inside its stage scan; prefer it for memory-bound
+        # models.
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh
