@@ -13,6 +13,21 @@
 set -x
 cd "$(dirname "$0")/.."
 
+# 0. graded evidence ladder FIRST (2026-08-02 lesson: the tunnel can
+#    execute a probe matmul and then wedge on the big ResNet transfer/
+#    compile — a monolithic bench converts a half-healthy window into
+#    zero evidence; the ladder records whatever rung the tunnel can
+#    sustain, each rung in its own killable subprocess, eager commits).
+#    Exit 3 = the SMALLEST rung hung: the tunnel is wedged for fresh
+#    processes, so skip every remaining on-chip step rather than
+#    burning ~4 h of timeouts against the same hang.
+#    Outer budget 9600 > the 7800 s sum of default per-rung timeouts,
+#    so the last rung's diagnostic cannot be truncated by the wrapper.
+timeout -k 30 9600 python tools/onchip_incremental.py
+LADDER_RC=$?
+# the ladder committed its abort line itself before exiting 3
+[ "$LADDER_RC" = 3 ] && exit 3
+
 # 1. headline ResNet-50 throughput + roofline (also the driver metric)
 MXTPU_BENCH_TIMEOUT=2000 timeout 2400 python bench.py
 

@@ -1,0 +1,329 @@
+"""Incremental on-chip evidence capture for a fragile tunnel.
+
+The axon tunnel's observed failure mode (2026-08-02 session) is: a small
+probe matmul EXECUTES fine, then the full ResNet-50 bench wedges during
+the large param transfer / train-step compile and never returns. A
+monolithic bench therefore converts a half-healthy window into zero
+evidence. This driver runs a LADDER of workloads — each in its own
+killable subprocess with its own timeout, each appending a line to
+BENCH_TPU_LOG.jsonl and committing eagerly — so whatever rung the
+tunnel can sustain becomes durable evidence, and the first rung that
+hangs tells us precisely where the tunnel breaks.
+
+Rungs (small -> large):
+  1. matmul_1k     1024^3 bf16 matmul           (~2 MB transfers)
+  2. matmul_4k     4096^3 bf16 — MXU peak probe (~100 MB arithmetic)
+  3. int8_gate     int8 vs bf16 4096^3 dot chain (the >=1.5x gate)
+  4. flash_1k      pallas flash attention T=1024 fwd+bwd (Mosaic!)
+  5. flash_4k      pallas flash attention T=4096 fwd+bwd
+  6. flash_padded  T=400 D=96 pad/mask path under Mosaic
+  7. resnet_b32    ResNet-50 train step batch 32 (via bench.py)
+  8. resnet_b128   batch 128 (via bench.py)
+  9. resnet_b256   batch 256 — NOT in the default set (explicit only:
+                   onchip_evidence.sh step 1 runs exactly this)
+ 10. transformer   bench_suite LM shape — NOT in the default set
+                   (step 2 runs it)
+
+Usage: python tools/onchip_incremental.py [rung ...]
+(no args = all rungs in order). If the FIRST rung — the smallest
+possible workload — times out, the tunnel is wedged for fresh
+processes too and the ladder exits immediately rather than burning
+every remaining rung's timeout on an identical hang. Any later rung's
+individual failure does NOT stop the ladder: a rung may fail for
+size-specific reasons (e.g. a transfer-size wedge) that don't apply
+to its successors.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RUNG_TIMEOUT = int(os.environ.get("MXTPU_RUNG_TIMEOUT", "600"))
+
+_COMMON = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+import numpy as onp
+jax.config.update("jax_compilation_cache_dir", "/tmp/mxtpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+accel = [d for d in jax.devices() if d.platform != "cpu"]
+assert accel, "no accelerator"
+dev = accel[0]
+from mxnet_tpu.util import d2h_fence, d2h_fence_latency, net_time, \
+    lat_dominated
+from bench import append_tpu_log
+
+
+def emit(metric, value, unit, **extra):
+    rec = dict(metric=metric, value=value, unit=unit,
+               platform=dev.platform, device_kind=dev.device_kind,
+               rung=True, **extra)
+    append_tpu_log(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def timed(fn, args, reps):
+    out = fn(*args)
+    d2h_fence(out)                      # compile + first execute
+    lat = d2h_fence_latency(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    d2h_fence(out)
+    raw = time.perf_counter() - t0
+    return net_time(raw, lat) / reps, lat, raw
+"""
+
+
+def _rung_src(body):
+    return _COMMON.format(repo=REPO) + body
+
+
+MATMUL = r"""
+N = {n}
+rs = onp.random.RandomState(0)
+x = jax.device_put(jnp.asarray(rs.randn(N, N), jnp.bfloat16), dev)
+f = jax.jit(lambda a: a @ a)
+dt, lat, raw = timed(f, (x,), {reps})
+tflops = 2 * N**3 / dt / 1e12
+emit("matmul_{n}_bf16", round(tflops, 2), "TFLOP/s",
+     ms=round(dt * 1e3, 3), fence_lat_s=round(lat, 4),
+     lat_dominated=lat_dominated(raw, lat))
+"""
+
+INT8 = r"""
+N, CH = 4096, 8
+rs = onp.random.RandomState(0)
+xi = jax.device_put(jnp.asarray(
+    rs.randint(-127, 127, (N, N)), jnp.int8), dev)
+xb = jax.device_put(jnp.asarray(rs.randn(N, N), jnp.bfloat16), dev)
+
+
+def chain_i8(a):
+    def body(c, _):
+        c = jax.lax.dot(c, a, preferred_element_type=jnp.int32)
+        return (c >> 7).astype(jnp.int8), None
+    return jax.lax.scan(body, a, None, length=CH)[0]
+
+
+def chain_bf(a):
+    def body(c, _):
+        return jax.lax.dot(c, a).astype(jnp.bfloat16) * 0.01, None
+    return jax.lax.scan(body, a, None, length=CH)[0]
+
+
+fi = jax.jit(chain_i8)
+fb = jax.jit(chain_bf)
+dt_i, lat_i, raw_i = timed(fi, (xi,), 5)
+dt_b, lat_b, raw_b = timed(fb, (xb,), 5)
+speedup = dt_b / dt_i
+emit("int8_vs_bf16_dot_speedup", round(speedup, 3), "x",
+     int8_ms=round(dt_i / CH * 1e3, 3), bf16_ms=round(dt_b / CH * 1e3, 3),
+     n=N, chain=CH, gate="[accept >=1.5]",
+     gate_pass=bool(speedup >= 1.5),
+     lat_dominated=lat_dominated(raw_i, lat_i))
+"""
+
+FLASH = r"""
+from mxnet_tpu.ops.pallas_kernels import flash_attention
+B, H, T, D = {shape}
+rs = onp.random.RandomState(0)
+q = jax.device_put(jnp.asarray(rs.randn(B, H, T, D), jnp.bfloat16), dev)
+k = jax.device_put(jnp.asarray(rs.randn(B, H, T, D), jnp.bfloat16), dev)
+v = jax.device_put(jnp.asarray(rs.randn(B, H, T, D), jnp.bfloat16), dev)
+
+
+def step(q, k, v):
+    out, vjp = jax.vjp(
+        lambda a, b, c: flash_attention(a, b, c, causal=True), q, k, v)
+    dq, dk, dv = vjp(out)
+    return out, dq
+
+
+f = jax.jit(step)
+dt, lat, raw = timed(f, (q, k, v), {reps})
+emit("{name}", round(dt * 1e3, 2), "ms", batch=B, heads=H, seq_len=T,
+     head_dim=D, causal=True, mosaic=True,
+     fence_lat_s=round(lat, 4), lat_dominated=lat_dominated(raw, lat))
+"""
+
+# ResNet rungs reuse bench.py verbatim via its env knobs (one
+# implementation of the amp-2 cast / fence / MFU protocol — bench.py
+# appends its own line to the evidence log). Deliberately NOT prefixed
+# with _COMMON: the wrapper must not initialize the (exclusive-access)
+# device itself while bench.py's probe and --child subprocesses need
+# it; the rung is pure process plumbing.
+RESNET = r"""
+import os, subprocess, sys
+env = dict(os.environ, MXTPU_BENCH_BATCH="{batch}",
+           MXTPU_BENCH_STEPS="{steps}",
+           MXTPU_BENCH_TIMEOUT="{wd}",
+           MXTPU_BENCH_PROBE_RESERVE="{wd_reserve}")
+res = subprocess.run([sys.executable, os.path.join({repo!r}, "bench.py")],
+                     env=env, cwd={repo!r}, stdout=subprocess.PIPE,
+                     stderr=subprocess.STDOUT, text=True)
+lines = (res.stdout or "").strip().splitlines()
+print(lines[-1] if lines else "", flush=True)
+sys.exit(res.returncode)
+"""
+
+TRANSFORMER = r"""
+import tools.bench_suite as bs
+# the _COMMON preamble above already executed a real device op; skip
+# bench_suite's own 120 s subprocess probe
+bs._PROBE_CACHE["probe"] = "accel"
+bs.bench_transformer()
+"""
+
+def _resnet(batch, steps):
+    # wd=1500 with reserve=1200 gives bench.py a short (~300 s) probe
+    # phase — the ladder's earlier rungs already established tunnel
+    # health — and the rest for the cold-cache compile + run. NOTE:
+    # RESNET is plain process plumbing, no _COMMON preamble (the
+    # wrapper must not hold the exclusive-access device while bench.py
+    # subprocesses need it).
+    return RESNET.format(batch=batch, steps=steps, repo=REPO,
+                         wd=1500, wd_reserve=1200)
+
+
+# (name, source, per-rung timeout seconds, in_default). The heavy rungs
+# get the same order of budget the monolithic bench grants them
+# (MXTPU_BENCH_TIMEOUT=2000 in onchip_evidence.sh) — a cold-cache
+# ResNet-50 compile can exceed 600 s without the tunnel being wedged.
+# resnet_b256 and transformer are NOT in the default ladder: they are
+# exactly what onchip_evidence.sh steps 1-2 (bench.py, bench_suite all)
+# run next, and duplicating the two heaviest workloads would double
+# the time spent inside a fragile tunnel window. They stay defined for
+# explicit standalone invocation.
+RUNGS = [
+    ("matmul_1k", _rung_src(MATMUL.format(n=1024, reps=20)), 600, True),
+    ("matmul_4k", _rung_src(MATMUL.format(n=4096, reps=10)), 600, True),
+    ("int8_gate", _rung_src(INT8), 600, True),
+    ("flash_1k", _rung_src(FLASH.format(
+        shape=(2, 8, 1024, 64), reps=10,
+        name="flash_attention_1k")), 600, True),
+    ("flash_4k", _rung_src(FLASH.format(
+        shape=(2, 8, 4096, 64), reps=10,
+        name="flash_attention_4k")), 900, True),
+    ("flash_padded", _rung_src(FLASH.format(
+        shape=(8, 12, 400, 96), reps=10,
+        name="flash_attention_padded")), 900, True),
+    ("resnet_b32", _resnet(32, 20), 1800, True),
+    ("resnet_b128", _resnet(128, 20), 1800, True),
+    ("resnet_b256", _resnet(256, 30), 1800, False),
+    ("transformer", _rung_src(TRANSFORMER), 1200, False),
+]
+
+
+def log_event(event, **extra):
+    rec = dict(event=event, ts=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime()), **extra)
+    from bench import append_tpu_log  # one writer implementation
+    append_tpu_log(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def commit_log(msg):
+    subprocess.run(["git", "commit", "-m", msg, "--",
+                    "BENCH_TPU_LOG.jsonl"], cwd=REPO,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+import signal
+
+_CURRENT = {}
+
+
+def _kill_group(proc):
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except Exception:
+        try:
+            proc.kill()
+        except Exception:
+            pass
+
+
+def _on_term(signum, frame):
+    # the outer `timeout` in onchip_evidence.sh TERMs only this
+    # driver; without this handler a wedged rung child (and bench.py
+    # grandchildren) would survive and keep holding the accelerator
+    # while the script's later steps contend for it
+    proc = _CURRENT.get("proc")
+    if proc is not None:
+        _kill_group(proc)
+    try:
+        log_event("ladder_terminated", rung=_CURRENT.get("rung", ""))
+        commit_log("On-chip evidence ladder: terminated by outer timeout")
+    except Exception:
+        pass
+    sys.exit(143)
+
+
+def _run_rung(name, src, timeout):
+    """Run one rung in its own PROCESS GROUP; on timeout kill the whole
+    group (a rung may spawn bench.py grandchildren) and salvage the
+    partial stdout — where the child got to before wedging is exactly
+    the diagnostic the ladder exists to capture."""
+    proc = subprocess.Popen([sys.executable, "-c", src], cwd=REPO,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            start_new_session=True)
+    _CURRENT["proc"] = proc
+    _CURRENT["rung"] = name
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return ("ok" if proc.returncode == 0
+                else f"rc={proc.returncode}"), out or ""
+    except subprocess.TimeoutExpired as te:
+        _kill_group(proc)
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except Exception:
+            out = None
+        partial = out if out else (te.output or "")
+        if isinstance(partial, bytes):
+            partial = partial.decode("utf-8", "replace")
+        return "timeout", partial or ""
+    finally:
+        _CURRENT["proc"] = None
+
+
+def main():
+    signal.signal(signal.SIGTERM, _on_term)
+    want = sys.argv[1:] or [n for n, _, _, dflt in RUNGS if dflt]
+    for name, src, timeout, _dflt in RUNGS:
+        if name not in want:
+            continue
+        # MXTPU_RUNG_TIMEOUT, when set, overrides every per-rung budget
+        # (test hook / operator override for cold-cache compiles)
+        if os.environ.get("MXTPU_RUNG_TIMEOUT"):
+            timeout = RUNG_TIMEOUT
+        t0 = time.time()
+        status, out = _run_rung(name, src, timeout)
+        dt = round(time.time() - t0, 1)
+        tail = out.strip().splitlines()[-3:]
+        if status != "ok":
+            log_event("rung_failed", rung=name, status=status,
+                      elapsed_s=dt, tail=tail[-1][:300] if tail else "")
+        else:
+            print(f"[rung {name}] ok in {dt}s", flush=True)
+        commit_log(f"On-chip evidence rung: {name} ({status})")
+        if name == RUNGS[0][0] and status == "timeout":
+            # the smallest possible workload hung: the tunnel is wedged
+            # for fresh processes — every later rung would burn its
+            # timeout on the same hang. (Guarded on matmul_1k itself,
+            # not "first selected": an explicitly requested heavy rung
+            # timing out is a size-specific signal, not a dead tunnel.)
+            log_event("ladder_abort", reason="first_rung_timeout")
+            commit_log("On-chip evidence ladder: abort, tunnel wedged")
+            sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,16 @@ EOF
         # evidence log — an unknown pathspec would abort the commit)
         git commit -m "TPU watcher: on-chip evidence captured" \
             -- BENCH_TPU_LOG.jsonl || true
+        # rc=3: the tunnel wedged again between the probe and the
+        # ladder's first rung — keep watching for the next window
+        # instead of standing down on zero captured measurements. The
+        # probe has just proven itself a non-discriminator for this
+        # wedge state, so back off a full interval first rather than
+        # re-probing (and re-burning a ladder timeout) immediately.
+        if [ "$RC" = 3 ]; then
+            sleep "$PROBE_INTERVAL"
+            continue
+        fi
         exit 0
     fi
     sleep "$PROBE_INTERVAL"
