@@ -285,6 +285,30 @@ def main():
         from mxnet_tpu.util import d2h_fence_latency
         d2h_lat = d2h_fence_latency(trainer.step(x, y))
 
+        # provisional single-step measurement BEFORE the long timed
+        # run: the tunnel's failure mode is a wedge mid-operation, and
+        # a wedge during the n_steps loop below would otherwise erase
+        # the whole run. The parent's salvage path (and the evidence
+        # log) keep this line if the final number never materializes;
+        # a final emit supersedes it.
+        from mxnet_tpu.util import net_time as _net_time
+        t0 = time.perf_counter()
+        _fence(trainer.step(x, y))
+        one_step = max(_net_time(time.perf_counter() - t0, d2h_lat), 1e-9)
+        prov = dict(metric="resnet50_train_throughput",
+                    value=round(batch / one_step, 2), unit="images/sec",
+                    provisional=True, batch=batch, steps=1, amp=amp,
+                    step_s=round(one_step, 5),
+                    fence_lat_s=round(d2h_lat, 4),
+                    platform=(accel[0].platform if on_accel else "cpu"),
+                    device_kind=getattr(dev0_early, "device_kind",
+                                        "unknown"))
+        if on_accel:
+            append_tpu_log(prov)
+            _emit(prov["value"], **{k: v for k, v in prov.items()
+                                    if k not in ("metric", "value",
+                                                 "unit")})
+
         t0 = time.perf_counter()
         for _ in range(n_steps):
             loss = trainer.step(x, y)
