@@ -191,6 +191,19 @@ def worker_rank(default=0):
     return default
 
 
+def _distributed_is_initialized(jax_mod) -> bool:
+    """`jax.distributed.is_initialized` only exists on newer jax; older
+    releases expose the same fact via the global distributed state."""
+    probe = getattr(jax_mod.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None, **kwargs):
     """Wire this process into a multi-worker jax.distributed job.
@@ -205,7 +218,7 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     Idempotent; no-op when no coordinator is known."""
     import os
     import jax
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized(jax):
         return
     if coordinator_address is None:
         coordinator_address = os.environ.get("MX_COORDINATOR")

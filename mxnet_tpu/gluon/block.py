@@ -38,6 +38,15 @@ __all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_trace_ctx"]
 _naming = threading.local()
 
 
+def _leak_check_mode() -> str:
+    """MXNET_TRACER_CHECK: 'warn' (default) reports hybridize()-time
+    tracer leaks as warnings, 'raise' makes them MXNetError, 'off'
+    disables the scan."""
+    from ..base import get_env
+    mode = str(get_env("MXNET_TRACER_CHECK", "warn")).lower()
+    return mode if mode in ("off", "warn", "raise") else "warn"
+
+
 class _BlockScope:
     """ref: block.py _BlockScope — name management."""
 
@@ -433,12 +442,20 @@ class HybridBlock(Block):
                 # raises the same error and the user must see which
                 # line concretized a tracer.
                 self._cached[key] = None
+                # point at the user's line when their own Python consumed
+                # the tracer (tracercheck pass); an all-internal traceback
+                # means a dynamic-shape op, which is the expected case
+                from ..passes.tracercheck import explain_concretization
+                user_loc = explain_concretization(e)
+                cause = (f"data-dependent python control flow at "
+                         f"{user_loc} (a bug — hoist it out of forward)"
+                         if user_loc else
+                         "a dynamic-output-shape op (expected, e.g. "
+                         "boolean_mask)")
                 warnings.warn(
                     f"{type(self).__name__}: tracing failed; hybridize "
                     "falls back to eager execution for this input "
-                    "signature. Cause: a dynamic-output-shape op "
-                    "(expected, e.g. boolean_mask) or data-dependent "
-                    f"python control flow (a bug). Trace error:\n{e}")
+                    f"signature. Cause: {cause}. Trace error:\n{e}")
                 return super(HybridBlock, self).__call__(*args)
         fn = self._cached[key]
         rng = jax.random.key_data(_random.next_key())
@@ -509,6 +526,19 @@ class HybridBlock(Block):
         pvals = {n: p.data()._data for n, p in plist}
         jitted(pvals, [i._data for i in sample_inputs], rng)
         self._cached_aux_params = list(aux_params_found)
+        # hybridize()-time tracer-leak check: a forward that stored an
+        # intermediate on self just left a dead tracer behind; report it
+        # NOW, naming the attribute, instead of the UnexpectedTracerError
+        # jax raises wherever the attribute is next touched
+        mode = _leak_check_mode()
+        if mode != "off":
+            from ..passes.tracercheck import scan_block_for_tracers
+            leaks = scan_block_for_tracers(self)
+            if leaks:
+                msg = "; ".join(f.message for f in leaks[:3])
+                if mode == "raise":
+                    raise MXNetError(msg)
+                warnings.warn(msg)
         return jitted
 
     def forward(self, x, *args):

@@ -405,6 +405,8 @@ def edge_id(data, u, v):
 
 @register_op("_contrib_getnnz", differentiable=False)
 def getnnz(data, axis=None):
+    """Count nonzero elements, total or per `axis` (ref:
+    contrib/nnz.cc getnnz)."""
     nz = (data != 0)
     if axis is None:
         return jnp.sum(nz).astype(jnp.int64).reshape(1)
@@ -433,6 +435,8 @@ def fft(data, compute_size=128):
 
 @register_op("_contrib_ifft")
 def ifft(data, compute_size=128):
+    """Inverse FFT of interleaved (real, imag) columns back to real
+    (ref: contrib/ifft.cc)."""
     n = data.shape[-1] // 2
     z = data.reshape(data.shape[:-1] + (n, 2))
     comp = z[..., 0] + 1j * z[..., 1]

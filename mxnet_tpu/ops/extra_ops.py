@@ -42,32 +42,42 @@ def _shape_t(shape):
 
 @register_op("_zeros", differentiable=False)
 def _zeros(shape=(), ctx=None, dtype="float32"):
+    """Input-free zeros(shape, dtype) (ref: init_op.cc _zeros)."""
     return jnp.zeros(_shape_t(shape), dtype=dtype)
 
 
 @register_op("_zeros_without_dtype", differentiable=False)
 def _zeros_without_dtype(shape=(), ctx=None, dtype=None):
+    """Zeros whose dtype defaults at execution time (ref: init_op.cc
+    _zeros_without_dtype)."""
     return jnp.zeros(_shape_t(shape), dtype=dtype or "float32")
 
 
 @register_op("_ones", differentiable=False)
 def _ones(shape=(), ctx=None, dtype="float32"):
+    """Input-free ones(shape, dtype) (ref: init_op.cc _ones)."""
     return jnp.ones(_shape_t(shape), dtype=dtype)
 
 
 @register_op("_full", differentiable=False)
 def _full(shape=(), value=0.0, ctx=None, dtype="float32"):
+    """Input-free constant fill of `shape` with `value` (ref:
+    init_op.cc _full)."""
     return jnp.full(_shape_t(shape), value, dtype=dtype)
 
 
 @register_op("_eye", differentiable=False)
 def _eye(N=0, M=0, k=0, ctx=None, dtype="float32"):
+    """Identity-like matrix with ones on the k-th diagonal (ref:
+    init_op.cc _eye)."""
     return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=dtype)
 
 
 @register_op("_arange", differentiable=False)
 def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
             ctx=None, dtype="float32"):
+    """Evenly spaced values in [start, stop), each repeated `repeat`
+    times (ref: init_op.cc _arange)."""
     out = jnp.arange(start, stop, step, dtype=dtype)
     if repeat != 1:
         out = jnp.repeat(out, repeat)
@@ -77,6 +87,8 @@ def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
 @register_op("_linspace", differentiable=False)
 def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, ctx=None,
               dtype="float32"):
+    """`num` evenly spaced values from start to stop (ref: init_op.cc
+    _linspace)."""
     return jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=dtype)
 
 
@@ -106,6 +118,8 @@ def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
 @register_op("_slice_assign_scalar",
              aliases=["_crop_assign_scalar", "_npi_slice_assign_scalar"])
 def _slice_assign_scalar(data, begin=(), end=(), step=(), scalar=0.0):
+    """Write a scalar into the [begin, end) region of `data` (ref:
+    matrix_op.cc _slice_assign_scalar)."""
     return data.at[_region_index(data.shape, begin, end, step)].set(
         jnp.asarray(scalar, data.dtype))
 
@@ -682,7 +696,7 @@ def rroi_align(data, rois, pooled_size=(7, 7), spatial_scale=0.0625,
 # eager ops over CSR adjacency (the reference is CPU-only here too).
 # ---------------------------------------------------------------------------
 
-@register_op("_contrib_dgl_adjacency", differentiable=False)
+@register_op("_contrib_dgl_adjacency", n_out=3, differentiable=False)
 def dgl_adjacency(indptr, indices, data):
     """ref: dgl_graph.cc DGLAdjacency — same sparsity pattern, data all 1."""
     return indptr, indices, jnp.ones_like(data)
@@ -892,7 +906,9 @@ register_op("_Native", differentiable=False)(_unsupported(
 # _cvimresize/_cvcopyMakeBorder, exposed as mx.img.* in the reference)
 # ---------------------------------------------------------------------------
 
-register_op("_copyto", aliases=["_npi_copyto"])(
+register_op("_copyto", aliases=["_npi_copyto"],
+            doc="Device-to-device copy as an op (ref: ndarray_function.cc "
+                "_copyto; identity under a single jax device mesh).")(
     lambda data: jnp.copy(data))
 
 

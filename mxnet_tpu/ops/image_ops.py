@@ -28,6 +28,8 @@ def to_tensor(data):
 
 @register_op("_image_normalize", aliases=["image_normalize"])
 def normalize(data, mean=0.0, std=1.0):
+    """Channel-wise (x - mean) / std on CHW float input (ref:
+    image_random.cc Normalize)."""
     mean = jnp.asarray(mean, jnp.float32)
     std = jnp.asarray(std, jnp.float32)
     shape = (-1, 1, 1)
@@ -38,6 +40,8 @@ def normalize(data, mean=0.0, std=1.0):
 
 @register_op("_image_resize", aliases=["image_resize"])
 def resize(data, size=(0, 0), keep_ratio=False, interp=1):
+    """Bilinear resize of HWC/NHWC images to (w, h) (ref:
+    image_resize.cc)."""
     if isinstance(size, int):
         size = (size, size)
     w, h = size
@@ -52,6 +56,7 @@ def resize(data, size=(0, 0), keep_ratio=False, interp=1):
 
 @register_op("_image_crop", aliases=["image_crop"])
 def crop(data, x=0, y=0, width=1, height=1):
+    """Fixed-window crop of HWC/NHWC images (ref: image_crop.cc)."""
     if data.ndim == 3:
         return data[y:y + height, x:x + width]
     return data[:, y:y + height, x:x + width]
@@ -59,12 +64,14 @@ def crop(data, x=0, y=0, width=1, height=1):
 
 @register_op("_image_flip_left_right", differentiable=False)
 def flip_left_right(data):
+    """Horizontal flip of HWC/NHWC images (ref: image_random.cc)."""
     axis = 1 if data.ndim == 3 else 2
     return jnp.flip(data, axis=axis)
 
 
 @register_op("_image_flip_top_bottom", differentiable=False)
 def flip_top_bottom(data):
+    """Vertical flip of HWC/NHWC images (ref: image_random.cc)."""
     axis = 0 if data.ndim == 3 else 1
     return jnp.flip(data, axis=axis)
 
@@ -72,6 +79,7 @@ def flip_top_bottom(data):
 @register_op("_image_random_flip_left_right", needs_rng=True,
              differentiable=False)
 def random_flip_left_right(data, raw_key):
+    """Horizontal flip with probability 1/2 (ref: image_random.cc)."""
     flip = jax.random.bernoulli(_key(raw_key))
     axis = 1 if data.ndim == 3 else 2
     return jnp.where(flip, jnp.flip(data, axis=axis), data)
@@ -80,6 +88,7 @@ def random_flip_left_right(data, raw_key):
 @register_op("_image_random_flip_top_bottom", needs_rng=True,
              differentiable=False)
 def random_flip_top_bottom(data, raw_key):
+    """Vertical flip with probability 1/2 (ref: image_random.cc)."""
     flip = jax.random.bernoulli(_key(raw_key))
     axis = 0 if data.ndim == 3 else 1
     return jnp.where(flip, jnp.flip(data, axis=axis), data)
@@ -87,6 +96,8 @@ def random_flip_top_bottom(data, raw_key):
 
 @register_op("_image_random_brightness", needs_rng=True)
 def random_brightness(data, raw_key, min_factor=0.0, max_factor=1.0):
+    """Scale brightness by a uniform random factor (ref:
+    image_random.cc RandomBrightness)."""
     f = jax.random.uniform(_key(raw_key), (), minval=min_factor,
                            maxval=max_factor)
     return data.astype(jnp.float32) * f
@@ -94,6 +105,8 @@ def random_brightness(data, raw_key, min_factor=0.0, max_factor=1.0):
 
 @register_op("_image_random_contrast", needs_rng=True)
 def random_contrast(data, raw_key, min_factor=0.0, max_factor=1.0):
+    """Blend toward the gray mean by a uniform random factor (ref:
+    image_random.cc RandomContrast)."""
     f = jax.random.uniform(_key(raw_key), (), minval=min_factor,
                            maxval=max_factor)
     x = data.astype(jnp.float32)
@@ -103,6 +116,8 @@ def random_contrast(data, raw_key, min_factor=0.0, max_factor=1.0):
 
 @register_op("_image_random_saturation", needs_rng=True)
 def random_saturation(data, raw_key, min_factor=0.0, max_factor=1.0):
+    """Blend toward per-pixel luma by a uniform random factor (ref:
+    image_random.cc RandomSaturation)."""
     f = jax.random.uniform(_key(raw_key), (), minval=min_factor,
                            maxval=max_factor)
     x = data.astype(jnp.float32)
@@ -114,6 +129,8 @@ def random_saturation(data, raw_key, min_factor=0.0, max_factor=1.0):
 
 @register_op("_image_random_hue", needs_rng=True)
 def random_hue(data, raw_key, min_factor=0.0, max_factor=1.0):
+    """Blend toward the channel mean by a uniform random factor (ref:
+    image_random.cc RandomHue, simplified)."""
     f = jax.random.uniform(_key(raw_key), (), minval=min_factor,
                            maxval=max_factor)
     x = data.astype(jnp.float32)
@@ -124,6 +141,8 @@ def random_hue(data, raw_key, min_factor=0.0, max_factor=1.0):
 @register_op("_image_random_color_jitter", needs_rng=True)
 def random_color_jitter(data, raw_key, brightness=0.0, contrast=0.0,
                         saturation=0.0, hue=0.0):
+    """Compose random brightness/contrast/saturation/hue jitter (ref:
+    image_random.cc RandomColorJitter)."""
     k = _key(raw_key)
     x = data.astype(jnp.float32)
     if brightness:
@@ -149,6 +168,8 @@ def random_color_jitter(data, raw_key, brightness=0.0, contrast=0.0,
 
 @register_op("_image_random_lighting", needs_rng=True)
 def random_lighting(data, raw_key, alpha_std=0.05):
+    """AlexNet-style PCA lighting noise on RGB channels (ref:
+    image_random.cc RandomLighting)."""
     eigval = jnp.asarray([55.46, 4.794, 1.148])
     eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
                           [-0.5808, -0.0045, -0.8140],

@@ -18,11 +18,15 @@ from .registry import _OPS, register_op
 __all__ = []
 
 # the handful of canonical names the corpus genuinely lacked
-register_op("_hypot_scalar")(
+register_op("_hypot_scalar", doc="Elementwise hypot(data, scalar) in "
+            "the data dtype (ref: elemwise_binary_scalar_op_extended.cc).")(
     lambda data, scalar=0.0: jnp.hypot(data, jnp.asarray(scalar, data.dtype)))
 for _lname, _lfn in [("and", jnp.logical_and), ("or", jnp.logical_or),
                      ("xor", jnp.logical_xor)]:
-    register_op(f"_logical_{_lname}_scalar", differentiable=False)(
+    register_op(f"_logical_{_lname}_scalar", differentiable=False,
+                doc=f"Elementwise logical {_lname} against a scalar; "
+                    f"returns 0/1 in the data dtype (ref: "
+                    f"elemwise_binary_scalar_op_logic.cc).")(
         (lambda f: lambda data, scalar=0.0:
          f(data, scalar).astype(data.dtype))(_lfn))
 

@@ -22,22 +22,28 @@ def _t(x, transpose):
 @register_op("_linalg_gemm", aliases=["linalg_gemm"])
 def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
          axis=-2):
+    """alpha * op(A) @ op(B) + beta * C (ref: la_op.cc gemm)."""
     return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
 
 
 @register_op("_linalg_gemm2", aliases=["linalg_gemm2"])
 def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    """alpha * op(A) @ op(B) (ref: la_op.cc gemm2)."""
     return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
 
 
 @register_op("_linalg_potrf", aliases=["linalg_potrf"])
 def potrf(A, lower=True):
+    """Cholesky factorization of a symmetric positive-definite matrix
+    (ref: la_op.cc potrf)."""
     L = jnp.linalg.cholesky(A)
     return L if lower else jnp.swapaxes(L, -1, -2)
 
 
 @register_op("_linalg_potri", aliases=["linalg_potri"])
 def potri(A, lower=True):
+    """Inverse of the original matrix from its Cholesky factor (ref:
+    la_op.cc potri)."""
     # A is the cholesky factor; potri returns inverse of the original matrix
     eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
     Linv = jsl.solve_triangular(A, eye, lower=lower)
@@ -47,16 +53,19 @@ def potri(A, lower=True):
 
 @register_op("_linalg_trmm", aliases=["linalg_trmm"])
 def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix multiply alpha * op(A) @ B (or B @ op(A);
+    ref: la_op.cc trmm)."""
     At = _t(A, transpose)
     return alpha * (jnp.matmul(B, At) if rightside else jnp.matmul(At, B))
 
 
 @register_op("_linalg_trsm", aliases=["linalg_trsm"])
 def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Solve the triangular system op(A) X = alpha B (or X op(A) =
+    alpha B; ref: la_op.cc trsm)."""
     if rightside:
         # solve X A^T' = alpha B  →  A' X^T = alpha B^T
-        Xt = jsl.solve_triangular(_t(A, not transpose) if False else A,
-                                  jnp.swapaxes(B, -1, -2),
+        Xt = jsl.solve_triangular(A, jnp.swapaxes(B, -1, -2),
                                   trans=0 if transpose else 1,
                                   lower=lower)
         return alpha * jnp.swapaxes(Xt, -1, -2)
@@ -66,18 +75,24 @@ def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
 
 @register_op("_linalg_syrk", aliases=["linalg_syrk"])
 def syrk(A, transpose=False, alpha=1.0):
+    """Symmetric rank-k update alpha * A @ A.T (or A.T @ A; ref:
+    la_op.cc syrk)."""
     At = jnp.swapaxes(A, -1, -2)
     return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
 
 
 @register_op("_linalg_syevd", aliases=["linalg_syevd"], n_out=2)
 def syevd(A):
+    """Symmetric eigendecomposition; returns (eigvec rows U, eigvals L)
+    (ref: la_op.cc syevd)."""
     w, v = jnp.linalg.eigh(A)
     return jnp.swapaxes(v, -1, -2), w  # MXNet returns (U rows=eigvecs, L)
 
 
 @register_op("_linalg_gelqf", aliases=["linalg_gelqf"], n_out=2)
 def gelqf(A):
+    """LQ factorization A = L Q with orthonormal Q rows (ref:
+    la_op.cc gelqf)."""
     # LQ of A: A = L Q  (Q rows orthonormal).  qr of A^T: A^T = Qt R
     Qt, R = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
     return jnp.swapaxes(R, -1, -2), jnp.swapaxes(Qt, -1, -2)
@@ -85,27 +100,34 @@ def gelqf(A):
 
 @register_op("_linalg_det", aliases=["linalg_det"])
 def det(A):
+    """Matrix determinant (ref: la_op.cc det)."""
     return jnp.linalg.det(A)
 
 
 @register_op("_linalg_slogdet", aliases=["linalg_slogdet"], n_out=2)
 def slogdet(A):
+    """(sign, log|det|) of a matrix (ref: la_op.cc slogdet)."""
     sign, ld = jnp.linalg.slogdet(A)
     return sign, ld
 
 
 @register_op("_linalg_inverse", aliases=["linalg_inverse"])
 def inverse(A):
+    """Matrix inverse (ref: la_op.cc inverse)."""
     return jnp.linalg.inv(A)
 
 
 @register_op("_linalg_extractdiag", aliases=["linalg_extractdiag"])
 def extractdiag(A, offset=0):
+    """Extract the offset-th diagonal as a vector (ref: la_op.cc
+    extractdiag)."""
     return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
 
 
 @register_op("_linalg_makediag", aliases=["linalg_makediag"])
 def makediag(A, offset=0):
+    """Embed a vector as the offset-th diagonal of a square matrix
+    (ref: la_op.cc makediag)."""
     n = A.shape[-1] + abs(offset)
     out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
     idx = jnp.arange(A.shape[-1])
@@ -116,6 +138,8 @@ def makediag(A, offset=0):
 
 @register_op("_linalg_extracttrian", aliases=["linalg_extracttrian"])
 def extracttrian(A, offset=0, lower=True):
+    """Extract the lower/upper triangle as a packed vector (ref:
+    la_op.cc extracttrian)."""
     n = A.shape[-1]
     rows, cols = jnp.tril_indices(n, k=offset) if lower else \
         jnp.triu_indices(n, k=offset)
@@ -124,6 +148,8 @@ def extracttrian(A, offset=0, lower=True):
 
 @register_op("_linalg_maketrian", aliases=["linalg_maketrian"])
 def maketrian(A, offset=0, lower=True):
+    """Unpack a vector into a lower/upper triangular matrix (ref:
+    la_op.cc maketrian)."""
     m = A.shape[-1]
     # solve n(n+1)/2 - like count for n given m and offset≈0
     import math
@@ -136,4 +162,6 @@ def maketrian(A, offset=0, lower=True):
 
 @register_op("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
 def sumlogdiag(A):
+    """Sum of log of the diagonal entries (ref: la_op.cc
+    sumlogdiag)."""
     return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)

@@ -40,6 +40,8 @@ def _pair(v, n=2):
 
 @register_op("FullyConnected", input_names=("data", "weight", "bias"))
 def fully_connected(data, weight, *bias, num_hidden=0, no_bias=False, flatten=True):
+    """Linear layer: data @ weight.T (+ bias), flattening trailing dims
+    by default (ref: fully_connected.cc:245-333)."""
     if flatten and data.ndim > 2:
         data = jnp.reshape(data, (data.shape[0], -1))
     out = jnp.matmul(data, weight.T)
@@ -77,6 +79,10 @@ def _conv_dims(ndim):
 def convolution(data, weight, *bias, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=0, num_group=1, workspace=1024,
                 no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
+    """N-D convolution (NCHW family layouts) via
+    lax.conv_general_dilated, with grouped and dilated forms; on an
+    accelerator the NCHW/NHWC layout choice is auto-tuned per shape
+    (ref: convolution.cc)."""
     nd = data.ndim
     k = len(kernel) if kernel else nd - 2
     stride = tuple(stride) if stride else (1,) * k
@@ -173,6 +179,8 @@ def _group_swap(w, g):
 def pooling(data, kernel=(2, 2), pool_type="max", global_pool=False,
             cudnn_off=False, pooling_convention="valid", stride=None,
             pad=None, p_value=2, count_include_pad=True, layout=None):
+    """max/avg/sum/lp pooling with valid/full conventions and
+    global_pool, via lax.reduce_window (ref: pooling.cc)."""
     nd = data.ndim
     k = nd - 2
     if global_pool:
@@ -251,6 +259,8 @@ def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
 @register_op("_contrib_BilinearResize2D")
 def bilinear_resize2d(data, height=1, width=1, scale_height=None,
                       scale_width=None, mode="size"):
+    """Bilinear resize to (height, width) or by scale factors (ref:
+    src/operator/contrib/bilinear_resize.cc)."""
     n, c, h, w = data.shape
     if scale_height is not None:
         height = int(round(h * scale_height))
@@ -264,6 +274,8 @@ def bilinear_resize2d(data, height=1, width=1, scale_height=None,
 
 @register_op("Activation")
 def activation(data, act_type="relu"):
+    """Elementwise activation selected by act_type
+    (relu/sigmoid/tanh/softrelu/softsign; ref: activation.cc)."""
     return {
         "relu": jax.nn.relu,
         "sigmoid": jax.nn.sigmoid,
@@ -305,6 +317,8 @@ def leaky_relu(data, *extra, act_type="leaky", slope=0.25, lower_bound=0.125,
 
 @register_op("hard_sigmoid")
 def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """Piecewise-linear sigmoid: clip(alpha*x + beta, 0, 1) (ref:
+    elemwise_unary_op_basic.cc hard_sigmoid)."""
     return jnp.clip(alpha * data + beta, 0.0, 1.0)
 
 
@@ -315,6 +329,8 @@ def hard_sigmoid(data, alpha=0.2, beta=0.5):
 @register_op("softmax")
 def softmax(data, *length, axis=-1, temperature=None, dtype=None,
             use_length=False):
+    """Softmax along `axis`, with temperature and optional per-row
+    valid-length masking (ref: softmax.cc)."""
     from .tensor import _safe_acc
     data, restore = _safe_acc(data)  # MXNET_SAFE_ACCUMULATION: fp32 math
     x = data / temperature if temperature else data
@@ -333,6 +349,8 @@ def softmax(data, *length, axis=-1, temperature=None, dtype=None,
 
 @register_op("log_softmax")
 def log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
+    """Numerically stable log(softmax) along `axis` (ref:
+    log_softmax.cc)."""
     from .tensor import _safe_acc
     data, restore = _safe_acc(data)  # MXNET_SAFE_ACCUMULATION: fp32 math
     x = data / temperature if temperature else data
@@ -342,12 +360,15 @@ def log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
 
 @register_op("softmin")
 def softmin(data, axis=-1, temperature=None, dtype=None):
+    """Softmax of the negated input (ref: softmin.cc)."""
     x = -data / (temperature or 1.0)
     return jax.nn.softmax(x, axis=axis)
 
 
 @register_op("SoftmaxActivation")
 def softmax_activation(data, mode="instance"):
+    """Deprecated softmax layer: per-instance (flattened) or per-channel
+    (ref: softmax_activation.cc)."""
     if mode == "channel":
         return jax.nn.softmax(data, axis=1)
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
@@ -436,6 +457,8 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
 @register_op("softmax_cross_entropy")
 def softmax_cross_entropy(data, label):
+    """Summed cross-entropy of logits against integer class labels
+    (ref: loss_binary_op.cc softmax_cross_entropy)."""
     logp = jax.nn.log_softmax(data, axis=-1)
     lab = label.astype(jnp.int32)
     return -jnp.sum(jnp.take_along_axis(logp, lab[:, None], axis=-1))
@@ -463,7 +486,11 @@ def _make_regression(link, grad_fn, name):
 
     # input_names lets the symbol layer auto-create the `<name>_label`
     # variable (ref: regression_output.cc lists data+label inputs)
-    @register_op(name, input_names=("data", "label"))
+    @register_op(name, input_names=("data", "label"),
+                 doc=f"{name}: loss layer whose forward applies the link "
+                     f"function and whose backward is the regression "
+                     f"gradient scaled by grad_scale (ref: "
+                     f"regression_output.cc).")
     def reg(data, label, grad_scale=1.0):
         return op(data, label.reshape(data.shape), grad_scale)
     return reg
@@ -526,7 +553,9 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                output_mean_var=False, axis=1, cudnn_off=False,
                min_calib_range=None, max_calib_range=None, ndev=1, key=None,
                _training=False):
-    """Returns (out, new_moving_mean, new_moving_var); caller writes the aux
+    """Batch normalization (ref: batch_norm.cc).
+
+    Returns (out, new_moving_mean, new_moving_var); caller writes the aux
     stats back (ref: batch_norm.cc aux states). SyncBatchNorm alias: under
     pjit the batch axis is global, so plain BN *is* sync-BN on TPU."""
     g = jnp.ones_like(gamma) if fix_gamma else gamma
@@ -556,6 +585,8 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 
 @register_op("LayerNorm", input_names=("data", "gamma", "beta"))
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Layer normalization over `axis` with affine gamma/beta (ref:
+    layer_norm.cc)."""
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.var(data, axis=axis, keepdims=True)
     out = (data - mean) * jax.lax.rsqrt(var + eps)
@@ -566,6 +597,7 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
 
 @register_op("GroupNorm", input_names=("data", "gamma", "beta"))
 def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, output_mean_var=False):
+    """Group normalization over channel groups (ref: group_norm.cc)."""
     n, c = data.shape[:2]
     x = data.reshape((n, num_groups, c // num_groups) + data.shape[2:])
     red = tuple(range(2, x.ndim))
@@ -579,6 +611,8 @@ def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, output_mean_var=False)
 
 @register_op("InstanceNorm", input_names=("data", "gamma", "beta"))
 def instance_norm(data, gamma, beta, eps=1e-3):
+    """Instance normalization over spatial dims per (n, c) (ref:
+    instance_norm.cc)."""
     red = tuple(range(2, data.ndim))
     mean = jnp.mean(data, axis=red, keepdims=True)
     var = jnp.var(data, axis=red, keepdims=True)
@@ -589,6 +623,8 @@ def instance_norm(data, gamma, beta, eps=1e-3):
 
 @register_op("L2Normalization")
 def l2_normalization(data, eps=1e-10, mode="instance"):
+    """Scale to unit L2 norm per instance/channel/spatial position
+    (ref: l2_normalization.cc)."""
     if mode == "instance":
         red = tuple(range(1, data.ndim))
         n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
@@ -602,6 +638,8 @@ def l2_normalization(data, eps=1e-10, mode="instance"):
 
 @register_op("LRN")
 def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization across `nsize` adjacent channels
+    (ref: lrn.cc)."""
     sq = jnp.square(data)
     half = nsize // 2
     padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
@@ -617,6 +655,8 @@ def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
 @register_op("Dropout", needs_rng=True, needs_train=True)
 def dropout(data, raw_key, p=0.5, mode="training", axes=None,
             cudnn_off=False, _training=False):
+    """Inverted dropout with keep-prob scaling; identity outside
+    training unless mode='always' (ref: dropout-inl.h)."""
     if (not _training and mode != "always") or p <= 0:
         return data
     shape = data.shape
@@ -635,6 +675,8 @@ def dropout(data, raw_key, p=0.5, mode="training", axes=None,
              input_names=("data", "weight"))
 def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
               sparse_grad=False):
+    """Embedding-table row lookup by integer indices (ref:
+    indexing_op.cc Embedding)."""
     idx = data.astype(jnp.int32)
     return jnp.take(weight, idx, axis=0)
 
@@ -645,6 +687,8 @@ def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
 
 @register_op("SequenceMask")
 def sequence_mask(data, *length, use_sequence_length=False, value=0.0, axis=0):
+    """Mask time steps past each sequence's length with `value` (ref:
+    sequence_mask.cc)."""
     if not use_sequence_length or not length:
         return data
     ln = length[0].astype(jnp.int32)
@@ -660,6 +704,8 @@ def sequence_mask(data, *length, use_sequence_length=False, value=0.0, axis=0):
 
 @register_op("SequenceLast")
 def sequence_last(data, *length, use_sequence_length=False, axis=0):
+    """Select each sequence's last valid time step (ref:
+    sequence_last.cc)."""
     if not use_sequence_length or not length:
         idx = [slice(None)] * data.ndim
         idx[axis] = -1
@@ -672,6 +718,8 @@ def sequence_last(data, *length, use_sequence_length=False, axis=0):
 
 @register_op("SequenceReverse")
 def sequence_reverse(data, *length, use_sequence_length=False, axis=0):
+    """Reverse each sequence's first `length` time steps, leaving the
+    padding in place (ref: sequence_reverse.cc)."""
     if not use_sequence_length or not length:
         return jnp.flip(data, axis=axis)
     ln = length[0].astype(jnp.int32)
@@ -696,6 +744,8 @@ def pad_op(data, mode="constant", pad_width=None, constant_value=0):
 
 @register_op("Crop")
 def crop(*args, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=1):
+    """Spatial crop to h_w (or a reference input's size), at `offset`
+    or centered (ref: crop.cc)."""
     data = args[0]
     if len(args) > 1:
         th, tw = args[1].shape[2], args[1].shape[3]
@@ -743,11 +793,15 @@ def _bilinear_sample(data, grid):
 
 @register_op("BilinearSampler")
 def bilinear_sampler(data, grid, cudnn_off=False):
+    """Sample data at grid's [-1, 1] xy coordinates with bilinear
+    interpolation and zero padding (ref: bilinear_sampler.cc)."""
     return _bilinear_sample(data, grid)
 
 
 @register_op("GridGenerator")
 def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Generate a sampling grid from affine parameters or a flow field
+    (ref: grid_generator.cc)."""
     h, w = target_shape
     if transform_type == "affine":
         n = data.shape[0]
@@ -773,6 +827,8 @@ def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
 def spatial_transformer(data, loc, target_shape=(0, 0),
                         transform_type="affine", sampler_type="bilinear",
                         cudnn_off=False):
+    """Affine spatial transformer: grid generation + bilinear sampling
+    (ref: spatial_transformer.cc)."""
     grid = grid_generator(loc, "affine", target_shape)
     return _bilinear_sample(data, grid)
 
@@ -875,6 +931,7 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
 
 @register_op("im2col")
 def im2col(data, kernel=None, stride=None, dilate=None, pad=None):
+    """Unfold sliding kernel patches into columns (ref: im2col.cc)."""
     k = len(kernel)
     stride = tuple(stride) if stride else (1,) * k
     dilate = tuple(dilate) if dilate else (1,) * k
@@ -893,6 +950,8 @@ def im2col(data, kernel=None, stride=None, dilate=None, pad=None):
 @register_op("Correlation")
 def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
                 stride2=1, pad_size=0, is_multiply=True):
+    """Patch cross-correlation between two feature maps over a
+    displacement window (ref: correlation.cc, simplified dense form)."""
     d = max_displacement
     n, c, h, w = data1.shape
     p2 = jnp.pad(data2, ((0, 0), (0, 0), (d, d), (d, d)))

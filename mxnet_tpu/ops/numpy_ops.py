@@ -37,19 +37,25 @@ def _ax(axis):
 
 for _name, _fn in [("sum", jnp.sum), ("max", jnp.max), ("min", jnp.min),
                    ("prod", jnp.prod), ("mean", jnp.mean)]:
-    register_op(f"_np_{_name}", aliases=[f"_npi_{_name}"])(
+    register_op(f"_np_{_name}", aliases=[f"_npi_{_name}"],
+                doc=f"numpy-semantics {_name} reduction over `axis` "
+                    f"(ref: np_broadcast_reduce_op_value.cc).")(
         (lambda f: lambda a, axis=None, dtype=None, keepdims=False,
          initial=None: f(a, axis=_ax(axis), keepdims=keepdims)
          .astype(dtype) if dtype else
          f(a, axis=_ax(axis), keepdims=keepdims))(_fn))
 
-register_op("_npi_std")(
+register_op("_npi_std", doc="numpy-semantics standard deviation with "
+            "ddof (ref: np_broadcast_reduce_op_value.cc).")(
     lambda a, axis=None, dtype=None, ddof=0, keepdims=False:
     jnp.std(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims))
-register_op("_npi_var")(
+register_op("_npi_var", doc="numpy-semantics variance with ddof (ref: "
+            "np_broadcast_reduce_op_value.cc).")(
     lambda a, axis=None, dtype=None, ddof=0, keepdims=False:
     jnp.var(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims))
-register_op("_npi_argmax", differentiable=False)(
+register_op("_npi_argmax", differentiable=False,
+            doc="numpy-semantics argmax as the index-carrying float "
+                "dtype (ref: np_broadcast_reduce_op_index.cc).")(
     lambda data, axis=None, keepdims=False:
     jnp.argmax(data, axis=None if axis is None else int(axis),
                keepdims=keepdims).astype(_index_float()))
@@ -59,7 +65,9 @@ register_op("_npi_argmax", differentiable=False)(
 # elementwise / comparison (ref: np_elemwise_broadcast_op.cc)
 # ---------------------------------------------------------------------------
 
-register_op("_npi_true_divide")(lambda lhs, rhs: jnp.true_divide(lhs, rhs))
+register_op("_npi_true_divide", doc="True (always-float) division with "
+            "numpy promotion (ref: np_true_divide.cc).")(
+    lambda lhs, rhs: jnp.true_divide(lhs, rhs))
 
 # scalar arithmetic with NUMPY promotion: the scalar stays weak-typed, so
 # int array + 1.5 promotes to float (the legacy _plus_scalar kernels cast
@@ -68,37 +76,64 @@ register_op("_npi_true_divide")(lambda lhs, rhs: jnp.true_divide(lhs, rhs))
 for _sname, _sfn in [("add", jnp.add), ("subtract", jnp.subtract),
                      ("multiply", jnp.multiply), ("mod", jnp.mod),
                      ("power", jnp.power)]:
-    register_op(f"_npi_{_sname}_scalar")(
+    register_op(f"_npi_{_sname}_scalar",
+                doc=f"numpy-semantics scalar {_sname}; the scalar stays "
+                    f"weak-typed so promotion follows numpy (ref: "
+                    f"np_elemwise_broadcast_op.cc).")(
         (lambda f: lambda data, scalar=1.0: f(data, scalar))(_sfn))
 for _sname, _sfn in [("rsubtract", jnp.subtract), ("rmod", jnp.mod),
                      ("rpower", jnp.power)]:
-    register_op(f"_npi_{_sname}_scalar")(
+    register_op(f"_npi_{_sname}_scalar",
+                doc=f"numpy-semantics reversed-operand scalar "
+                    f"{_sname[1:]} (scalar op data; ref: "
+                    f"np_elemwise_broadcast_op.cc).")(
         (lambda f: lambda data, scalar=1.0: f(scalar, data))(_sfn))
 
-register_op("_npi_logical_not", differentiable=False)(
-    lambda data: jnp.logical_not(data))  # bool result (legacy keeps dtype)
-register_op("_npi_true_divide_scalar")(
+register_op("_npi_logical_not", differentiable=False,
+            doc="numpy-semantics logical not; returns bool (the legacy "
+                "op keeps the input dtype; ref: np_elemwise_unary_op_"
+                "basic.cc).")(
+    lambda data: jnp.logical_not(data))
+register_op("_npi_true_divide_scalar", doc="True (always-float) division "
+            "by a scalar (ref: np_true_divide.cc).")(
     lambda data, scalar=1.0: jnp.true_divide(data, scalar))
-register_op("_npi_rtrue_divide_scalar")(
+register_op("_npi_rtrue_divide_scalar", doc="True division of a scalar "
+            "by the data (reversed operands; ref: np_true_divide.cc).")(
     lambda data, scalar=1.0: jnp.true_divide(scalar, data))
 
 for _name, _fn in [("maximum", jnp.maximum), ("minimum", jnp.minimum)]:
-    register_op(f"_npi_{_name}")((lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
-    register_op(f"_npi_{_name}_scalar")(
+    register_op(f"_npi_{_name}",
+                doc=f"numpy-semantics broadcasting {_name} (ref: "
+                    f"np_elemwise_broadcast_op.cc).")(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
+    register_op(f"_npi_{_name}_scalar",
+                doc=f"numpy-semantics {_name} against a scalar (ref: "
+                    f"np_elemwise_broadcast_op.cc).")(
         (lambda f: lambda data, scalar=0.0: f(data, scalar))(_fn))
 
 for _name, _fn in [("equal", jnp.equal), ("not_equal", jnp.not_equal),
                    ("greater", jnp.greater), ("less", jnp.less),
                    ("greater_equal", jnp.greater_equal),
                    ("less_equal", jnp.less_equal)]:
-    register_op(f"_npi_{_name}", differentiable=False)(
+    register_op(f"_npi_{_name}", differentiable=False,
+                doc=f"numpy-semantics broadcasting {_name} comparison; "
+                    f"returns bool (ref: np_elemwise_broadcast_logic_"
+                    f"op.cc).")(
         (lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
-    register_op(f"_npi_{_name}_scalar", differentiable=False)(
+    register_op(f"_npi_{_name}_scalar", differentiable=False,
+                doc=f"numpy-semantics {_name} comparison against a "
+                    f"scalar; returns bool (ref: np_elemwise_broadcast_"
+                    f"logic_op.cc).")(
         (lambda f: lambda data, scalar=0.0: f(data, scalar))(_fn))
 
-register_op("_npi_abs")(lambda data: jnp.abs(data))
-register_op("_npi_log")(lambda data: jnp.log(data))
-register_op("_npi_clip")(
+register_op("_npi_abs", doc="numpy-semantics elementwise absolute value "
+            "(ref: np_elemwise_unary_op_basic.cc).")(
+    lambda data: jnp.abs(data))
+register_op("_npi_log", doc="numpy-semantics elementwise natural log "
+            "(ref: np_elemwise_unary_op_basic.cc).")(
+    lambda data: jnp.log(data))
+register_op("_npi_clip", doc="numpy-semantics clip into [a_min, a_max]; "
+            "either bound may be None (ref: np_matrix_op.cc clip).")(
     lambda data, a_min=None, a_max=None: jnp.clip(data, a_min, a_max))
 
 
@@ -110,25 +145,37 @@ def _shape_t(shape):
     return (shape,) if isinstance(shape, int) else tuple(shape or ())
 
 
-register_op("_npi_zeros", differentiable=False)(
+register_op("_npi_zeros", differentiable=False,
+            doc="Input-free zeros(shape, dtype) (ref: np_init_op.cc).")(
     lambda shape=(), ctx=None, dtype="float32":
     jnp.zeros(_shape_t(shape), dtype))
-register_op("_npi_ones", differentiable=False)(
+register_op("_npi_ones", differentiable=False,
+            doc="Input-free ones(shape, dtype) (ref: np_init_op.cc).")(
     lambda shape=(), ctx=None, dtype="float32":
     jnp.ones(_shape_t(shape), dtype))
-register_op("_npi_full", differentiable=False)(
+register_op("_npi_full", differentiable=False,
+            doc="Input-free constant fill of `shape` with `fill_value` "
+                "(ref: np_init_op.cc full).")(
     lambda shape=(), fill_value=0.0, ctx=None, dtype="float32":
     jnp.full(_shape_t(shape), fill_value, dtype))
-register_op("_npi_arange", differentiable=False)(
+register_op("_npi_arange", differentiable=False,
+            doc="Evenly spaced values in [start, stop) with `step` "
+                "(ref: np_init_op.cc arange).")(
     lambda start=0.0, stop=None, step=1.0, ctx=None, dtype="float32":
     jnp.arange(start, stop, step, dtype=dtype))
-register_op("_npi_linspace", differentiable=False)(
+register_op("_npi_linspace", differentiable=False,
+            doc="`num` evenly spaced values from start to stop (ref: "
+                "np_init_op.cc linspace).")(
     lambda start=0.0, stop=1.0, num=50, endpoint=True, ctx=None,
     dtype="float32": jnp.linspace(start, stop, int(num), endpoint=endpoint,
                                   dtype=dtype))
-register_op("_np_zeros_like", differentiable=False)(
+register_op("_np_zeros_like", differentiable=False,
+            doc="Zeros with the input's shape and dtype (ref: "
+                "np_init_op.cc zeros_like).")(
     lambda a: jnp.zeros_like(a))
-register_op("_np_ones_like", differentiable=False)(
+register_op("_np_ones_like", differentiable=False,
+            doc="Ones with the input's shape and dtype (ref: "
+                "np_init_op.cc ones_like).")(
     lambda a: jnp.ones_like(a))
 
 
@@ -136,40 +183,61 @@ register_op("_np_ones_like", differentiable=False)(
 # matrix / shape manipulation (ref: np_matrix_op.cc)
 # ---------------------------------------------------------------------------
 
-register_op("_np_reshape", aliases=["_npi_reshape"])(
+register_op("_np_reshape", aliases=["_npi_reshape"],
+            doc="numpy-semantics reshape (ref: np_matrix_op.cc).")(
     lambda a, newshape=(), order="C": jnp.reshape(a, newshape))
-register_op("_np_transpose")(
+register_op("_np_transpose",
+            doc="numpy-semantics axis permutation (ref: np_matrix_op.cc).")(
     lambda a, axes=None: jnp.transpose(a, axes))
-register_op("_np_squeeze")(
+register_op("_np_squeeze",
+            doc="Remove size-1 axes (ref: np_matrix_op.cc squeeze).")(
     lambda a, axis=None: jnp.squeeze(a, _ax(axis)))
-register_op("_np_broadcast_to")(
+register_op("_np_broadcast_to",
+            doc="Broadcast to `shape` (ref: np_matrix_op.cc).")(
     lambda array, shape=(): jnp.broadcast_to(array, _shape_t(shape)))
-register_op("_np_copy")(lambda a: jnp.copy(a))
-register_op("_np_repeat")(
+register_op("_np_copy", doc="Identity copy (ref: np_elemwise_unary_op_"
+            "basic.cc copy).")(
+    lambda a: jnp.copy(a))
+register_op("_np_repeat", doc="Repeat each element along `axis` (ref: "
+            "np_matrix_op.cc repeat).")(
     lambda a, repeats=1, axis=None: jnp.repeat(a, repeats, axis=axis))
-register_op("_npi_expand_dims")(
+register_op("_npi_expand_dims", doc="Insert a size-1 axis (ref: "
+            "np_matrix_op.cc expand_dims).")(
     lambda a, axis=0: jnp.expand_dims(a, int(axis)))
-register_op("_npi_concatenate", aliases=["_npi_concat"])(
+register_op("_npi_concatenate", aliases=["_npi_concat"],
+            doc="Concatenate along an existing axis (ref: "
+                "np_matrix_op.cc concatenate).")(
     lambda *args, dim=0, axis=None: jnp.concatenate(
         args, axis=int(axis if axis is not None else dim)))
-register_op("_npi_stack")(
+register_op("_npi_stack", doc="Stack along a new axis (ref: "
+            "np_matrix_op.cc stack).")(
     lambda *args, axis=0: jnp.stack(args, axis=int(axis)))
-register_op("_npi_swapaxes")(
+register_op("_npi_swapaxes", doc="Interchange two axes (ref: "
+            "np_matrix_op.cc swapaxes).")(
     lambda data, dim1=0, dim2=0: jnp.swapaxes(data, int(dim1), int(dim2)))
-register_op("_npi_tile")(
+register_op("_npi_tile", doc="Tile the tensor `reps` times per axis "
+            "(ref: np_matrix_op.cc tile).")(
     lambda A, reps=(): jnp.tile(A, tuple(reps) if not isinstance(reps, int)
                                 else reps))
-register_op("_npi_split", n_out=-1)(
+register_op("_npi_split", n_out=-1,
+            doc="Split along `axis` into equal sections or at indices "
+                "(ref: np_matrix_op.cc split).")(
     lambda ary, indices_or_sections=1, axis=0:
     tuple(jnp.split(ary, indices_or_sections, axis=int(axis))))
-register_op("_npi_slice")(
+register_op("_npi_slice", doc="Strided multi-axis slice by "
+            "begin/end/step vectors (ref: np_matrix_op.cc slice).")(
     lambda data, begin=(), end=(), step=(): data[tuple(
         slice(b, e, s if s not in (0, None) else None)
         for b, e, s in zip(begin, end,
                            step or (None,) * len(begin)))])
-register_op("_npi_gather_nd", differentiable=False)(
+register_op("_npi_gather_nd", differentiable=False,
+            doc="N-dimensional gather; indices' leading axis indexes "
+                "data's leading axes (ref: np_indexing_op.cc).")(
     lambda data, indices: data[tuple(indices.astype(_index_int()))])
-register_op("_npi_rnn_param_concat", aliases=["_rnn_param_concat"])(
+register_op("_npi_rnn_param_concat", aliases=["_rnn_param_concat"],
+            doc="Flatten-and-concatenate RNN parameter tensors into the "
+                "packed parameter vector (ref: rnn.cc "
+                "_rnn_param_concat).")(
     lambda *args, dim=0: jnp.concatenate([a.reshape(-1) for a in args],
                                          axis=0))
 
@@ -184,17 +252,22 @@ register_op("_npi_rnn_param_concat", aliases=["_rnn_param_concat"])(
 
 @register_op("_np_dot")
 def _np_dot(a, b):
+    """numpy-semantics dot product (ref: np_dot.cc)."""
     return jnp.dot(a, b)
 
 
 @register_op("_npi_tensordot")
 def _npi_tensordot(a, b, a_axes_summed=(), b_axes_summed=()):
+    """Tensordot contracting the listed axis pairs (ref:
+    np_tensordot_op.cc)."""
     return jnp.tensordot(a, b, axes=(tuple(a_axes_summed),
                                      tuple(b_axes_summed)))
 
 
 @register_op("_npi_tensordot_int_axes")
 def _npi_tensordot_int_axes(a, b, axes=2):
+    """Tensordot contracting the last/first `axes` axes (ref:
+    np_tensordot_op.cc int-axes form)."""
     return jnp.tensordot(a, b, axes=int(axes))
 
 
@@ -210,6 +283,8 @@ def _key(raw):
              differentiable=False, needs_rng=True)
 def _npi_uniform(raw_key, low=0.0, high=1.0, size=None, ctx=None,
                  dtype="float32"):
+    """Uniform samples in [low, high) from the threefry stream (ref:
+    numpy/random/np_uniform_op.cc)."""
     return jax.random.uniform(_key(raw_key), _shape_t(size),
                               jnp.dtype(dtype or "float32"), low, high)
 
@@ -218,6 +293,8 @@ def _npi_uniform(raw_key, low=0.0, high=1.0, size=None, ctx=None,
              differentiable=False, needs_rng=True)
 def _npi_normal(raw_key, loc=0.0, scale=1.0, size=None, ctx=None,
                 dtype="float32"):
+    """Normal(loc, scale) samples from the threefry stream (ref:
+    numpy/random/np_normal_op.cc)."""
     return loc + scale * jax.random.normal(_key(raw_key), _shape_t(size),
                                            jnp.dtype(dtype or "float32"))
 
@@ -226,6 +303,8 @@ def _npi_normal(raw_key, loc=0.0, scale=1.0, size=None, ctx=None,
              differentiable=False, needs_rng=True)
 def _npi_randint(raw_key, low=0, high=None, size=None, ctx=None,
                  dtype="int32"):
+    """Integer samples in [low, high) from the threefry stream (ref:
+    numpy/random/np_randint_op.cc)."""
     if high is None:
         low, high = 0, low
     return jax.random.randint(_key(raw_key), _shape_t(size), int(low),
@@ -234,15 +313,29 @@ def _npi_randint(raw_key, low=0, high=None, size=None, ctx=None,
 
 @register_op("_npi_multinomial", differentiable=False, needs_rng=True)
 def _npi_multinomial(*arrays, n=1, pvals=None, size=None):
+    """ref: src/operator/numpy/random/np_multinomial_op.cc — counts of n
+    categorical draws per pvals row. Implemented as one_hot-summed
+    categorical samples (jax.random grew a native multinomial only after
+    the pinned version)."""
     # arrays is (pvals, key) when pvals arrives as a tensor, else (key,)
     raw_key = arrays[-1]
     p = arrays[0] if len(arrays) > 1 else jnp.asarray(pvals)
-    counts = jax.random.multinomial(
-        _key(raw_key), float(n), p,
-        shape=(_shape_t(size) + p.shape) if size is not None else None)
-    return counts.astype(jnp.int64)
+    k = p.shape[-1]
+    batch = _shape_t(size) if size is not None else p.shape[:-1]
+    logits = jnp.broadcast_to(jnp.log(jnp.clip(p, 1e-20, None)),
+                              batch + (k,))
+    rows = 1
+    for d in batch:
+        rows *= d
+    draws = jax.random.categorical(_key(raw_key),
+                                   logits.reshape(rows, 1, k),
+                                   axis=-1, shape=(rows, int(n)))
+    counts = jnp.sum(jax.nn.one_hot(draws, k, dtype=jnp.int32), axis=-2)
+    return counts.reshape(batch + (k,))
 
 
 @register_op("_np__random_shuffle", differentiable=False, needs_rng=True)
 def _np_random_shuffle(data, raw_key):
+    """Random permutation along axis 0 (ref: shuffle_op.cc, numpy
+    calling convention)."""
     return jax.random.permutation(_key(raw_key), data, axis=0)

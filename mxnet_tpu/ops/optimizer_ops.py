@@ -26,6 +26,8 @@ def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
 @register_op("sgd_update", n_out=1)
 def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=True):
+    """Plain SGD step: w -= lr * (rescaled, clipped grad + wd*w) (ref:
+    optimizer_op.cc sgd_update)."""
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
     return weight - lr * g
 
@@ -33,6 +35,8 @@ def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
 @register_op("sgd_mom_update", n_out=2)
 def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """SGD with momentum; returns (new_weight, new_mom) (ref:
+    optimizer_op.cc sgd_mom_update)."""
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
     new_mom = momentum * mom - lr * g
     return weight + new_mom, new_mom
@@ -53,6 +57,9 @@ def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       lazy_update=True):
+    """Mixed-precision SGD with momentum over fp32 master weights;
+    returns (new_weight, new_mom, new_weight32) (ref: optimizer_op.cc
+    mp_sgd_mom_update)."""
     g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad,
                   clip_gradient)
     new_mom = momentum * mom - lr * g
@@ -63,6 +70,8 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
 @register_op("nag_mom_update", n_out=2)
 def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov accelerated gradient step; returns (new_weight,
+    new_mom) (ref: optimizer_op.cc nag_mom_update)."""
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
     new_mom = momentum * mom + g
     return weight - lr * (g + momentum * new_mom), new_mom
@@ -72,6 +81,8 @@ def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 def adam_update(weight, grad, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
+    """Adam step; returns (new_weight, new_mean, new_var) (ref:
+    optimizer_op.cc adam_update)."""
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
     new_mean = beta1 * mean + (1 - beta1) * g
     new_var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -98,6 +109,8 @@ def adamw_update(weight, grad, mean, var, rescale_grad_t=None, lr=0.01,
 @register_op("ftml_update", n_out=4)
 def ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1):
+    """Follow-the-moving-leader step; returns (new_weight, d, v, z)
+    (ref: optimizer_op.cc ftml_update)."""
     g = grad * rescale_grad
     if clip_grad is not None and clip_grad >= 0:
         g = jnp.clip(g, -clip_grad, clip_grad)
@@ -113,6 +126,8 @@ def ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
 @register_op("ftrl_update", n_out=3)
 def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0):
+    """FTRL-proximal step with L1 shrinkage; returns (new_weight, z, n)
+    (ref: optimizer_op.cc ftrl_update)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -132,6 +147,8 @@ def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
 def rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    clip_weights=-1.0):
+    """RMSProp step (Tieleman & Hinton form); returns (new_weight, n)
+    (ref: optimizer_op.cc rmsprop_update)."""
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
     new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
     new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
@@ -144,6 +161,9 @@ def rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
 def rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.01, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
+    """RMSProp (Graves form with centered second moment and momentum);
+    returns (new_weight, n, g_avg, delta) (ref: optimizer_op.cc
+    rmspropalex_update)."""
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
     new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
     new_gavg = gamma1 * g_avg + (1 - gamma1) * g
@@ -158,6 +178,8 @@ def rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.01, gamma1=0.95,
 @register_op("signsgd_update", n_out=1)
 def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0):
+    """signSGD step: w -= lr * sign(grad) (ref: optimizer_op.cc
+    signsgd_update)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -167,6 +189,8 @@ def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
 @register_op("signum_update", n_out=2)
 def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """Signum step (sign of the momentum); returns (new_weight,
+    new_mom) (ref: optimizer_op.cc signum_update)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -178,6 +202,8 @@ def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 @register_op("_sparse_adagrad_update", aliases=["adagrad_update"], n_out=2)
 def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad step; returns (new_weight, new_history) (ref:
+    optimizer_op.cc _sparse_adagrad_update, dense on TPU)."""
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
     new_hist = history + jnp.square(g)
     return weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist
@@ -186,6 +212,8 @@ def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
 @register_op("adadelta_update", n_out=3)
 def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaDelta step; returns (new_weight, acc_g, acc_delta) (ref:
+    optimizer_op.cc adadelta_update)."""
     g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
     new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
     delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
@@ -201,6 +229,8 @@ def all_finite(data, init_output=True):
 
 @register_op("multi_all_finite", differentiable=False)
 def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    """AMP overflow check across several tensors: 1.0 iff every element
+    of every input is finite (ref: all_finite.cc multi_all_finite)."""
     ok = jnp.asarray(True)
     for a in arrays:
         ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))

@@ -50,6 +50,8 @@ def quantize_v2(data, out_type="int8", min_calib_range=None,
 
 @register_op("_contrib_dequantize", differentiable=False)
 def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 -> float using the min/max range pair (ref:
+    quantization/dequantize.cc)."""
     scale, _ = _range_to_scale(min_range, max_range)
     return data.astype(jnp.float32) / scale
 
@@ -130,6 +132,8 @@ def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
                    dilate=None, pad=None, num_filter=0, num_group=1,
                    workspace=1024, no_bias=False, layout=None,
                    cudnn_tune=None, cudnn_off=False):
+    """int8 convolution with int32 accumulation on the MXU (ref:
+    quantization/quantized_conv.cc)."""
     k = len(kernel)
     stride = tuple(stride) if stride else (1,) * k
     dilate = tuple(dilate) if dilate else (1,) * k
@@ -178,6 +182,8 @@ def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
                       pool_type="max", global_pool=False, stride=None,
                       pad=None, pooling_convention="valid", layout=None,
                       count_include_pad=True, p_value=2, cudnn_off=False):
+    """Pooling on quantized data; the range pair passes through (ref:
+    quantization/quantized_pooling.cc)."""
     from .nn import pooling as _pool
     out = _pool(data.astype(jnp.float32), kernel=kernel,
                 pool_type=pool_type, global_pool=global_pool, stride=stride,
@@ -189,6 +195,8 @@ def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
 @register_op("_contrib_quantized_elemwise_add", n_out=3,
              differentiable=False)
 def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 add in real space with requantization to the joint range
+    (ref: quantization/quantized_elemwise_add.cc)."""
     s_l, _ = _range_to_scale(lhs_min, lhs_max)
     s_r, _ = _range_to_scale(rhs_min, rhs_max)
     real = lhs.astype(jnp.float32) / s_l + rhs.astype(jnp.float32) / s_r
@@ -200,11 +208,15 @@ def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
 
 @register_op("_contrib_quantized_flatten", n_out=3, differentiable=False)
 def quantized_flatten(data, min_data, max_data):
+    """Flatten quantized data; the range pair passes through (ref:
+    quantization/quantized_flatten.cc)."""
     return data.reshape(data.shape[0], -1), min_data, max_data
 
 
 @register_op("_contrib_quantized_act", n_out=3, differentiable=False)
 def quantized_act(data, min_data, max_data, act_type="relu"):
+    """Quantized relu: max(x, 0) with the min range clipped at 0 (ref:
+    quantization/quantized_activation.cc)."""
     if act_type != "relu":
         raise ValueError("only relu is supported quantized")
     return jnp.maximum(data, 0), jnp.maximum(min_data, 0), max_data
@@ -212,6 +224,8 @@ def quantized_act(data, min_data, max_data, act_type="relu"):
 
 @register_op("_contrib_quantized_concat", n_out=3, differentiable=False)
 def quantized_concat(*args, dim=1, num_args=0):
+    """Concatenate quantized inputs after rescaling each to the joint
+    range (ref: quantization/quantized_concat.cc)."""
     n = len(args) // 3
     datas, mins, maxs = args[:n], args[n:2 * n], args[2 * n:]
     lo, hi = _q_ranges(list(mins), list(maxs))

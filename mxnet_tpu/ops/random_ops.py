@@ -49,6 +49,8 @@ def _dt(dtype, default="float32"):
              aliases=["random_uniform"])
 def _random_uniform(raw_key, low=0.0, high=1.0, shape=(1,), dtype="float32",
                     ctx=None):
+    """Uniform samples in [low, high) (ref: sample_op.cc
+    _random_uniform)."""
     return jax.random.uniform(_key(raw_key), _shape(shape),
                               _dt(dtype), low, high)
 
@@ -57,6 +59,7 @@ def _random_uniform(raw_key, low=0.0, high=1.0, shape=(1,), dtype="float32",
              aliases=["random_normal"])
 def _random_normal(raw_key, loc=0.0, scale=1.0, shape=(1,), dtype="float32",
                    ctx=None):
+    """Normal(loc, scale) samples (ref: sample_op.cc _random_normal)."""
     return loc + scale * jax.random.normal(_key(raw_key), _shape(shape),
                                            _dt(dtype))
 
@@ -65,6 +68,8 @@ def _random_normal(raw_key, loc=0.0, scale=1.0, shape=(1,), dtype="float32",
              aliases=["random_gamma"])
 def _random_gamma(raw_key, alpha=1.0, beta=1.0, shape=(1,), dtype="float32",
                   ctx=None):
+    """Gamma(alpha) * beta samples — shape/scale parameterization (ref:
+    sample_op.cc _random_gamma)."""
     return beta * jax.random.gamma(_key(raw_key), alpha, _shape(shape),
                                    _dt(dtype))
 
@@ -73,6 +78,8 @@ def _random_gamma(raw_key, alpha=1.0, beta=1.0, shape=(1,), dtype="float32",
              aliases=["random_exponential"])
 def _random_exponential(raw_key, lam=1.0, shape=(1,), dtype="float32",
                         ctx=None):
+    """Exponential(rate=lam) samples (ref: sample_op.cc
+    _random_exponential)."""
     return jax.random.exponential(_key(raw_key), _shape(shape),
                                   _dt(dtype)) / lam
 
@@ -80,6 +87,7 @@ def _random_exponential(raw_key, lam=1.0, shape=(1,), dtype="float32",
 @register_op("_random_poisson", differentiable=False, needs_rng=True,
              aliases=["random_poisson"])
 def _random_poisson(raw_key, lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    """Poisson(lam) samples (ref: sample_op.cc _random_poisson)."""
     return jax.random.poisson(_key(raw_key), lam,
                               _shape(shape)).astype(_dt(dtype))
 
@@ -88,6 +96,8 @@ def _random_poisson(raw_key, lam=1.0, shape=(1,), dtype="float32", ctx=None):
              needs_rng=True, aliases=["random_negative_binomial"])
 def _random_negative_binomial(raw_key, k=1, p=0.5, shape=(1,),
                               dtype="float32", ctx=None):
+    """NegativeBinomial(k, p) samples via the gamma-Poisson mixture
+    (ref: sample_op.cc _random_negative_binomial)."""
     key = _key(raw_key)
     g = jax.random.gamma(key, k, _shape(shape)) * (1.0 - p) / p
     return jax.random.poisson(jax.random.fold_in(key, 1), g,
@@ -99,6 +109,8 @@ def _random_negative_binomial(raw_key, k=1, p=0.5, shape=(1,),
 def _random_generalized_negative_binomial(raw_key, mu=1.0, alpha=1.0,
                                           shape=(1,), dtype="float32",
                                           ctx=None):
+    """Generalized negative binomial (mu, alpha) samples via the
+    gamma-Poisson mixture (ref: sample_op.cc)."""
     key = _key(raw_key)
     r = 1.0 / alpha
     p = r / (r + mu)
@@ -111,6 +123,8 @@ def _random_generalized_negative_binomial(raw_key, mu=1.0, alpha=1.0,
              aliases=["random_randint"])
 def _random_randint(raw_key, low=0, high=1, shape=(1,), dtype="int32",
                     ctx=None):
+    """Integer samples in [low, high) (ref: sample_op.cc
+    _random_randint)."""
     return jax.random.randint(_key(raw_key), _shape(shape), low, high,
                               _dt(dtype, "int32"))
 
@@ -119,30 +133,36 @@ def _random_randint(raw_key, low=0, high=1, shape=(1,), dtype="int32",
 
 @register_op("_random_uniform_like", differentiable=False, needs_rng=True)
 def _random_uniform_like(data, raw_key, low=0.0, high=1.0):
+    """Uniform samples shaped/typed like `data` (ref: sample_op.cc
+    _like variants)."""
     return jax.random.uniform(_key(raw_key), data.shape, data.dtype,
                               low, high)
 
 
 @register_op("_random_normal_like", differentiable=False, needs_rng=True)
 def _random_normal_like(data, raw_key, loc=0.0, scale=1.0):
+    """Normal(loc, scale) samples shaped/typed like `data`."""
     return loc + scale * jax.random.normal(_key(raw_key), data.shape,
                                            data.dtype)
 
 
 @register_op("_random_gamma_like", differentiable=False, needs_rng=True)
 def _random_gamma_like(data, raw_key, alpha=1.0, beta=1.0):
+    """Gamma(alpha) * beta samples shaped/typed like `data`."""
     return beta * jax.random.gamma(_key(raw_key), alpha, data.shape,
                                    data.dtype)
 
 
 @register_op("_random_exponential_like", differentiable=False, needs_rng=True)
 def _random_exponential_like(data, raw_key, lam=1.0):
+    """Exponential(rate=lam) samples shaped/typed like `data`."""
     return jax.random.exponential(_key(raw_key), data.shape,
                                   data.dtype) / lam
 
 
 @register_op("_random_poisson_like", differentiable=False, needs_rng=True)
 def _random_poisson_like(data, raw_key, lam=1.0):
+    """Poisson(lam) samples shaped/typed like `data`."""
     return jax.random.poisson(_key(raw_key), lam,
                               data.shape).astype(data.dtype)
 
@@ -150,6 +170,7 @@ def _random_poisson_like(data, raw_key, lam=1.0):
 @register_op("_random_negative_binomial_like", differentiable=False,
              needs_rng=True)
 def _random_negative_binomial_like(data, raw_key, k=1, p=0.5):
+    """NegativeBinomial(k, p) samples shaped/typed like `data`."""
     key = _key(raw_key)
     g = jax.random.gamma(key, k, data.shape) * (1.0 - p) / p
     return jax.random.poisson(jax.random.fold_in(key, 1), g,
@@ -160,6 +181,8 @@ def _random_negative_binomial_like(data, raw_key, k=1, p=0.5):
              differentiable=False, needs_rng=True)
 def _random_generalized_negative_binomial_like(data, raw_key, mu=1.0,
                                                alpha=1.0):
+    """Generalized negative binomial (mu, alpha) samples shaped/typed
+    like `data`."""
     key = _key(raw_key)
     r = 1.0 / alpha
     p = r / (r + mu)
@@ -186,6 +209,8 @@ def _bcast(param, shape):
 @register_op("_sample_uniform", differentiable=False, needs_rng=True,
              aliases=["sample_uniform"])
 def _sample_uniform(low, high, raw_key, shape=(), dtype="float32"):
+    """Per-row Uniform[low_i, high_i) draws; output shape is
+    params.shape + shape (ref: multisample_op.cc)."""
     u = jax.random.uniform(_key(raw_key), _row_shape(low, shape), _dt(dtype))
     return _bcast(low, shape) + u * (_bcast(high, shape) - _bcast(low, shape))
 
@@ -193,6 +218,7 @@ def _sample_uniform(low, high, raw_key, shape=(), dtype="float32"):
 @register_op("_sample_normal", differentiable=False, needs_rng=True,
              aliases=["sample_normal"])
 def _sample_normal(mu, sigma, raw_key, shape=(), dtype="float32"):
+    """Per-row Normal(mu_i, sigma_i) draws (ref: multisample_op.cc)."""
     z = jax.random.normal(_key(raw_key), _row_shape(mu, shape), _dt(dtype))
     return _bcast(mu, shape) + z * _bcast(sigma, shape)
 
@@ -200,6 +226,7 @@ def _sample_normal(mu, sigma, raw_key, shape=(), dtype="float32"):
 @register_op("_sample_gamma", differentiable=False, needs_rng=True,
              aliases=["sample_gamma"])
 def _sample_gamma(alpha, beta, raw_key, shape=(), dtype="float32"):
+    """Per-row Gamma(alpha_i) * beta_i draws (ref: multisample_op.cc)."""
     g = jax.random.gamma(_key(raw_key), _bcast(alpha, shape),
                          _row_shape(alpha, shape), _dt(dtype))
     return g * _bcast(beta, shape)
@@ -208,6 +235,7 @@ def _sample_gamma(alpha, beta, raw_key, shape=(), dtype="float32"):
 @register_op("_sample_exponential", differentiable=False, needs_rng=True,
              aliases=["sample_exponential"])
 def _sample_exponential(lam, raw_key, shape=(), dtype="float32"):
+    """Per-row Exponential(rate=lam_i) draws (ref: multisample_op.cc)."""
     e = jax.random.exponential(_key(raw_key), _row_shape(lam, shape),
                                _dt(dtype))
     return e / _bcast(lam, shape)
@@ -216,6 +244,7 @@ def _sample_exponential(lam, raw_key, shape=(), dtype="float32"):
 @register_op("_sample_poisson", differentiable=False, needs_rng=True,
              aliases=["sample_poisson"])
 def _sample_poisson(lam, raw_key, shape=(), dtype="float32"):
+    """Per-row Poisson(lam_i) draws (ref: multisample_op.cc)."""
     p = jax.random.poisson(_key(raw_key), _bcast(lam, shape),
                            _row_shape(lam, shape))
     return p.astype(_dt(dtype))
@@ -224,6 +253,8 @@ def _sample_poisson(lam, raw_key, shape=(), dtype="float32"):
 @register_op("_sample_negative_binomial", differentiable=False,
              needs_rng=True, aliases=["sample_negative_binomial"])
 def _sample_negative_binomial(k, p, raw_key, shape=(), dtype="float32"):
+    """Per-row NegativeBinomial(k_i, p_i) draws via the gamma-Poisson
+    mixture (ref: multisample_op.cc)."""
     key = _key(raw_key)
     kk, pp = _bcast(k, shape), _bcast(p, shape)
     g = jax.random.gamma(key, kk, _row_shape(k, shape)) * (1.0 - pp) / pp
@@ -235,6 +266,8 @@ def _sample_negative_binomial(k, p, raw_key, shape=(), dtype="float32"):
              needs_rng=True, aliases=["sample_generalized_negative_binomial"])
 def _sample_generalized_negative_binomial(mu, alpha, raw_key, shape=(),
                                           dtype="float32"):
+    """Per-row generalized negative binomial (mu_i, alpha_i) draws via
+    the gamma-Poisson mixture (ref: multisample_op.cc)."""
     key = _key(raw_key)
     r = 1.0 / _bcast(alpha, shape)
     p = r / (r + _bcast(mu, shape))
@@ -310,6 +343,8 @@ def _maybe_exp(logpdf, is_log):
 
 @register_op("_random_pdf_uniform")
 def _random_pdf_uniform(sample, low, high, is_log=False):
+    """Uniform[low, high) density (or log-density) at `sample` (ref:
+    pdf_op.cc)."""
     low, high = _pdf_out(sample, low), _pdf_out(sample, high)
     inside = (sample >= low) & (sample <= high)
     logpdf = jnp.where(inside, -jnp.log(high - low), -jnp.inf)
@@ -318,6 +353,8 @@ def _random_pdf_uniform(sample, low, high, is_log=False):
 
 @register_op("_random_pdf_normal")
 def _random_pdf_normal(sample, mu, sigma, is_log=False):
+    """Normal(mu, sigma) density (or log-density) at `sample` (ref:
+    pdf_op.cc)."""
     mu, sigma = _pdf_out(sample, mu), _pdf_out(sample, sigma)
     z = (sample - mu) / sigma
     logpdf = -0.5 * z * z - jnp.log(sigma) - 0.5 * jnp.log(2 * jnp.pi)
@@ -326,6 +363,8 @@ def _random_pdf_normal(sample, mu, sigma, is_log=False):
 
 @register_op("_random_pdf_gamma")
 def _random_pdf_gamma(sample, alpha, beta, is_log=False):
+    """Gamma(alpha, scale=beta) density (or log-density) at `sample`
+    (ref: pdf_op.cc)."""
     alpha, beta = _pdf_out(sample, alpha), _pdf_out(sample, beta)
     # reference parameterization: scale beta (sample ~ beta * Gamma(alpha))
     logpdf = (alpha * -jnp.log(beta) + (alpha - 1) * jnp.log(sample)
@@ -335,6 +374,8 @@ def _random_pdf_gamma(sample, alpha, beta, is_log=False):
 
 @register_op("_random_pdf_exponential")
 def _random_pdf_exponential(sample, lam, is_log=False):
+    """Exponential(rate=lam) density (or log-density) at `sample` (ref:
+    pdf_op.cc)."""
     lam = _pdf_out(sample, lam)
     logpdf = jnp.log(lam) - lam * sample
     return _maybe_exp(logpdf, is_log)
@@ -342,6 +383,7 @@ def _random_pdf_exponential(sample, lam, is_log=False):
 
 @register_op("_random_pdf_poisson")
 def _random_pdf_poisson(sample, lam, is_log=False):
+    """Poisson(lam) mass (or log-mass) at `sample` (ref: pdf_op.cc)."""
     lam = _pdf_out(sample, lam)
     logpdf = (sample * jnp.log(lam) - lam
               - jax.scipy.special.gammaln(sample + 1.0))
@@ -350,6 +392,8 @@ def _random_pdf_poisson(sample, lam, is_log=False):
 
 @register_op("_random_pdf_negative_binomial")
 def _random_pdf_negative_binomial(sample, k, p, is_log=False):
+    """NegativeBinomial(k, p) mass (or log-mass) at `sample` (ref:
+    pdf_op.cc)."""
     k, p = _pdf_out(sample, k), _pdf_out(sample, p)
     logpdf = (jax.scipy.special.gammaln(sample + k)
               - jax.scipy.special.gammaln(sample + 1.0)
@@ -361,6 +405,8 @@ def _random_pdf_negative_binomial(sample, k, p, is_log=False):
 @register_op("_random_pdf_generalized_negative_binomial")
 def _random_pdf_generalized_negative_binomial(sample, mu, alpha,
                                               is_log=False):
+    """Generalized negative binomial (mu, alpha) mass (or log-mass) at
+    `sample` (ref: pdf_op.cc)."""
     mu, alpha = _pdf_out(sample, mu), _pdf_out(sample, alpha)
     r = 1.0 / alpha
     p = r / (r + mu)
@@ -373,6 +419,8 @@ def _random_pdf_generalized_negative_binomial(sample, mu, alpha,
 
 @register_op("_random_pdf_dirichlet")
 def _random_pdf_dirichlet(sample, alpha, is_log=False):
+    """Dirichlet(alpha) density (or log-density) at simplex rows of
+    `sample` (ref: pdf_op.cc)."""
     # sample: (..., k) rows on the simplex; alpha: (..., k)
     a = alpha
     while a.ndim < sample.ndim:

@@ -22,9 +22,20 @@ __all__ = ["register_op", "get_op", "list_ops", "OpInfo",
 
 def parse_bool_param(v) -> bool:
     """Coerce an op param that may arrive as a string (symbol json /
-    C-API attrs) to bool — the dmlc::Parameter bool-parsing role."""
+    C-API attrs) to bool — the dmlc::Parameter bool-parsing role.
+
+    Unknown strings raise MXNetError, as dmlc::Parameter does: the old
+    fall-through to ``bool(str)`` silently read "off"/"no" (and any
+    typo) as True."""
     if isinstance(v, str):
-        return v.lower() in ("1", "true", "yes", "on")
+        s = v.strip().lower()
+        if s in ("1", "true", "yes", "on"):
+            return True
+        if s in ("0", "false", "no", "off", ""):
+            return False
+        raise MXNetError(
+            f"invalid boolean parameter value {v!r}: expected one of "
+            f"1/true/yes/on or 0/false/no/off")
     return bool(v)
 
 
@@ -65,7 +76,7 @@ _OPS: Dict[str, OpInfo] = {}
 def register_op(name: str, n_out: int = 1, differentiable: bool = True,
                 aliases: Optional[List[str]] = None, needs_rng: bool = False,
                 needs_train: bool = False, input_names=None, aux_updates=None,
-                visible_outputs=None):
+                visible_outputs=None, doc: Optional[str] = None):
     """Register a pure-jax op function under an MXNet-style name.
 
     The function's leading parameters without defaults are tensor inputs
@@ -73,9 +84,13 @@ def register_op(name: str, n_out: int = 1, differentiable: bool = True,
     dmlc::Parameter analog). `needs_rng`: a threefry key is appended as a
     trailing tensor input by the nd wrapper. `needs_train`: the wrapper
     injects `_training=autograd.is_training()` (ref: the thread-local
-    is_train_ flag, src/imperative/imperative.cc:26)."""
+    is_train_ flag, src/imperative/imperative.cc:26). `doc`: op docstring
+    for lambda/loop-registered ops that cannot carry their own (the
+    NNVM ``.describe(...)`` role); ignored when the fn already has one."""
 
     def deco(fn):
+        if doc and not (fn.__doc__ or "").strip():
+            fn.__doc__ = doc
         info = OpInfo(name, fn, n_out, differentiable, needs_rng, needs_train,
                       input_names, aux_updates, visible_outputs)
         _OPS[name] = info

@@ -45,10 +45,15 @@ _BINARY = {
 }
 
 for _name, _fn in _BINARY.items():
-    register_op(f"elemwise_{_name}", aliases=[f"_{_name}", f"_Plus" if _name == "add" else f"_x{_name}"])(
+    register_op(f"elemwise_{_name}", aliases=[f"_{_name}", f"_Plus" if _name == "add" else f"_x{_name}"],
+                doc=f"Elementwise {_name} of two same-shape tensors "
+                    f"(ref: elemwise_binary_op_basic.cc).")(
         (lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
     register_op(f"broadcast_{_name}",
-                aliases=[f"_broadcast_{_name}"])((lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
+                aliases=[f"_broadcast_{_name}"],
+                doc=f"Elementwise {_name} with numpy-style broadcasting "
+                    f"(ref: elemwise_binary_broadcast_op_basic.cc).")(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
 
 _CMP = {
     "equal": jnp.equal, "not_equal": jnp.not_equal, "greater": jnp.greater,
@@ -58,7 +63,9 @@ _CMP = {
     "logical_xor": jnp.logical_xor,
 }
 for _name, _fn in _CMP.items():
-    register_op(f"broadcast_{_name}", differentiable=False)(
+    register_op(f"broadcast_{_name}", differentiable=False,
+                doc=f"Broadcasting {_name} comparison; returns 0/1 in the "
+                    f"lhs dtype (ref: elemwise_binary_broadcast_op_logic.cc).")(
         (lambda f: lambda lhs, rhs: f(lhs, rhs).astype(lhs.dtype))(_fn))
 
 _SCALAR = {
@@ -72,14 +79,24 @@ _SCALAR = {
 for _name, _fn in _SCALAR.items():
     diff = _name in ("plus", "minus", "mul", "div", "mod", "power",
                      "maximum", "minimum")
-    register_op(f"_{_name}_scalar", differentiable=diff)(
+    register_op(f"_{_name}_scalar", differentiable=diff,
+                doc=f"Elementwise {_name} against a scalar operand; the "
+                    f"scalar and result are cast to the data dtype "
+                    f"(ref: elemwise_binary_scalar_op_basic.cc).")(
         (lambda f: lambda data, scalar=1.0: f(data, jnp.asarray(scalar, data.dtype)).astype(data.dtype))(_fn))
 
-register_op("_rminus_scalar")(lambda data, scalar=1.0: scalar - data)
-register_op("_rdiv_scalar")(
+register_op("_rminus_scalar", doc="scalar - data, elementwise (reversed-"
+            "operand scalar subtraction).")(
+    lambda data, scalar=1.0: scalar - data)
+register_op("_rdiv_scalar", doc="scalar / data, elementwise (reversed-"
+            "operand scalar division; C-style on integer dtypes).")(
     lambda data, scalar=1.0: _div(jnp.asarray(scalar, data.dtype), data))
-register_op("_rpower_scalar")(lambda data, scalar=1.0: jnp.power(scalar, data))
-register_op("_rmod_scalar")(lambda data, scalar=1.0: jnp.mod(scalar, data))
+register_op("_rpower_scalar", doc="scalar ** data, elementwise (reversed-"
+            "operand scalar power).")(
+    lambda data, scalar=1.0: jnp.power(scalar, data))
+register_op("_rmod_scalar", doc="scalar % data, elementwise (reversed-"
+            "operand scalar modulo).")(
+    lambda data, scalar=1.0: jnp.mod(scalar, data))
 
 
 @register_op("add_n", aliases=["ElementWiseSum", "_sum"])
@@ -91,7 +108,10 @@ def add_n(*args):
     return out
 
 
-register_op("_grad_add")(lambda lhs, rhs: lhs + rhs)
+register_op("_grad_add", doc="Gradient accumulation add (ref: "
+            "elemwise_binary_op_basic.cc _grad_add — plain addition kept "
+            "as a distinct op so grad graphs stay recognizable).")(
+    lambda lhs, rhs: lhs + rhs)
 
 # ---------------------------------------------------------------------------
 # unary math (ref: elemwise_unary_op_basic.cc / _trig.cc / _logexp.cc / _pow.cc)
@@ -116,9 +136,13 @@ _UNARY = {
     "identity": lambda x: x,
 }
 for _name, _fn in _UNARY.items():
-    register_op(_name)((lambda f: lambda data: f(data))(_fn))
+    register_op(_name, doc=f"Elementwise {_name} (ref: elemwise_unary_op"
+                           f"_basic.cc / _trig.cc / _logexp.cc family).")(
+        (lambda f: lambda data: f(data))(_fn))
 
-register_op("_copy")(lambda data: jnp.copy(data))
+register_op("_copy", doc="Identity copy of the input tensor (ref: "
+            "elemwise_unary_op_basic.cc _copy).")(
+    lambda data: jnp.copy(data))
 
 _UNARY_NONDIFF = {
     "ceil": jnp.ceil, "floor": jnp.floor, "rint": jnp.rint,
@@ -126,11 +150,16 @@ _UNARY_NONDIFF = {
     "sign": jnp.sign, "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
 }
 for _name, _fn in _UNARY_NONDIFF.items():
-    register_op(_name, differentiable=False)((lambda f: lambda data: f(data))(_fn))
+    register_op(_name, differentiable=False,
+                doc=f"Elementwise {_name}; zero-gradient everywhere, so "
+                    f"registered non-differentiable (ref: "
+                    f"elemwise_unary_op_basic.cc).")(
+        (lambda f: lambda data: f(data))(_fn))
 
 
 @register_op("clip")
 def clip(data, a_min=0.0, a_max=1.0):
+    """Clamp values into [a_min, a_max] (ref: matrix_op.cc Clip)."""
     return jnp.clip(data, a_min, a_max)
 
 
@@ -152,6 +181,8 @@ def block_grad(data):
 
 @register_op("make_loss")
 def make_loss(data):
+    """Mark a symbol as a loss head (identity forward; ref:
+    elemwise_unary_op_basic.cc MakeLoss)."""
     return data
 
 
@@ -191,17 +222,29 @@ def _make_reduce(jfn, nan_fn=None):
     return red
 
 
-register_op("sum", aliases=["sum_axis"])(_make_reduce(jnp.sum))
-register_op("nansum")(_make_reduce(jnp.nansum))
-register_op("mean")(_make_reduce(jnp.mean))
-register_op("prod")(_make_reduce(jnp.prod))
-register_op("nanprod")(_make_reduce(jnp.nanprod))
-register_op("max", aliases=["max_axis"])(_make_reduce(jnp.max))
-register_op("min", aliases=["min_axis"])(_make_reduce(jnp.min))
+_REDUCE_DOC = ("Reduce with {0} over `axis` (None = all axes); supports "
+               "keepdims/exclude and MXNET_SAFE_ACCUMULATION fp32 "
+               "accumulation (ref: broadcast_reduce_op.h).")
+register_op("sum", aliases=["sum_axis"],
+            doc=_REDUCE_DOC.format("summation"))(_make_reduce(jnp.sum))
+register_op("nansum", doc=_REDUCE_DOC.format("NaN-ignoring summation"))(
+    _make_reduce(jnp.nansum))
+register_op("mean", doc=_REDUCE_DOC.format("arithmetic mean"))(
+    _make_reduce(jnp.mean))
+register_op("prod", doc=_REDUCE_DOC.format("product"))(
+    _make_reduce(jnp.prod))
+register_op("nanprod", doc=_REDUCE_DOC.format("NaN-ignoring product"))(
+    _make_reduce(jnp.nanprod))
+register_op("max", aliases=["max_axis"],
+            doc=_REDUCE_DOC.format("maximum"))(_make_reduce(jnp.max))
+register_op("min", aliases=["min_axis"],
+            doc=_REDUCE_DOC.format("minimum"))(_make_reduce(jnp.min))
 
 
 @register_op("norm")
 def norm(data, ord=2, axis=None, keepdims=False):
+    """Matrix/vector norm over `axis` (flattened when None; ref:
+    broadcast_reduce_norm_value.cc)."""
     ax = _axis_arg(axis)
     if ax is None:
         data = data.ravel()
@@ -235,18 +278,24 @@ def _index_float():
 
 @register_op("argmax", differentiable=False)
 def argmax(data, axis=None, keepdims=False):
+    """Index of the maximum along `axis`, as the index-carrying float
+    dtype (ref: broadcast_reduce_op_index.cc)."""
     return jnp.argmax(data, axis=axis,
                       keepdims=keepdims).astype(_index_float())
 
 
 @register_op("argmin", differentiable=False)
 def argmin(data, axis=None, keepdims=False):
+    """Index of the minimum along `axis`, as the index-carrying float
+    dtype (ref: broadcast_reduce_op_index.cc)."""
     return jnp.argmin(data, axis=axis,
                       keepdims=keepdims).astype(_index_float())
 
 
 @register_op("argmax_channel", differentiable=False)
 def argmax_channel(data):
+    """Argmax over axis 1 (the channel axis; ref:
+    broadcast_reduce_op_index.cc argmax_channel)."""
     return jnp.argmax(data, axis=1).astype(_index_float())
 
 
@@ -269,6 +318,7 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
 @register_op("topk", differentiable=False)
 def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
          dtype=None):
+    """Top-k values/indices/mask along `axis` (ref: ordering_op.cc TopK)."""
     # default index dtype follows the large-tensor mode (f64 exact past
     # 2^24 under x64; the reference default "float32" otherwise)
     dtype = dtype or _index_float()
@@ -290,12 +340,14 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
 
 @register_op("sort")
 def sort(data, axis=-1, is_ascend=True):
+    """Sort along `axis`, ascending or descending (ref: ordering_op.cc)."""
     r = jnp.sort(data, axis=axis)
     return r if is_ascend else jnp.flip(r, axis=axis)
 
 
 @register_op("argsort", differentiable=False)
 def argsort(data, axis=-1, is_ascend=True, dtype=None):
+    """Sorting permutation along `axis` (ref: ordering_op.cc ArgSort)."""
     dtype = dtype or _index_float()
     r = jnp.argsort(data, axis=axis)
     if not is_ascend:
@@ -309,6 +361,8 @@ def argsort(data, axis=-1, is_ascend=True, dtype=None):
 
 @register_op("dot")
 def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    """Dot product contracting lhs's last axis with rhs's first, with
+    optional operand transposes (ref: dot-inl.h) — hits the MXU."""
     a = lhs.T if transpose_a and lhs.ndim == 2 else (
         jnp.transpose(lhs) if transpose_a else lhs)
     b = rhs.T if transpose_b and rhs.ndim == 2 else (
@@ -321,6 +375,8 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
 
 @register_op("batch_dot")
 def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    """Batched matrix multiply over leading batch dims (ref: dot-inl.h
+    batch_dot)."""
     a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
     b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
     return jnp.matmul(a, b)
@@ -332,42 +388,55 @@ def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None
 
 @register_op("reshape", aliases=["Reshape"])
 def reshape(data, shape=None, reverse=False):
+    """Reshape with MXNet's special codes (0 keep, -1 infer, -2 copy
+    rest, -3 merge, -4 split; ref: matrix_op.cc Reshape)."""
     from ..ndarray.ndarray import _expand_reshape_spec
     return jnp.reshape(data, _expand_reshape_spec(data.shape, tuple(shape)))
 
 
 @register_op("reshape_like")
 def reshape_like(lhs, rhs):
+    """Reshape lhs to rhs's shape (ref: matrix_op.cc reshape_like)."""
     return jnp.reshape(lhs, rhs.shape)
 
 
 @register_op("shape_array", differentiable=False)
 def shape_array(data):
+    """The input's shape as a 1-D int64 tensor (ref: matrix_op.cc
+    shape_array)."""
     return jnp.asarray(data.shape, dtype=jnp.int64)
 
 
 @register_op("size_array", differentiable=False)
 def size_array(data):
+    """The input's element count as a 1-element int64 tensor (ref:
+    matrix_op.cc size_array)."""
     return jnp.asarray([data.size], dtype=jnp.int64)
 
 
 @register_op("cast", aliases=["Cast", "amp_cast"])
 def cast(data, dtype="float32"):
+    """Cast to `dtype` (ref: elemwise_unary_op_basic.cc Cast; amp_cast
+    is the AMP-inserted alias)."""
     return data.astype(jnp.dtype(dtype) if isinstance(dtype, str) else dtype)
 
 
 @register_op("transpose")
 def transpose(data, axes=None):
+    """Permute axes (reversed when `axes` is None; ref: matrix_op.cc)."""
     return jnp.transpose(data, tuple(axes) if axes else None)
 
 
 @register_op("expand_dims")
 def expand_dims(data, axis=0):
+    """Insert a size-1 axis at `axis` (ref: matrix_op.cc expand_dims)."""
     return jnp.expand_dims(data, axis)
 
 
 @register_op("squeeze")
 def squeeze(data, axis=None):
+    """Remove size-1 axes (all of them when `axis` is None; ref:
+    matrix_op.cc squeeze)."""
     return jnp.squeeze(data, axis)
 
 
@@ -379,6 +448,8 @@ def flatten(data):
 
 @register_op("slice")
 def slice_op(data, begin=None, end=None, step=None):
+    """Strided multi-axis slice by begin/end/step vectors (ref:
+    matrix_op.cc slice)."""
     idx = tuple(slice(b, e, s) for b, e, s in
                 zip(begin, end, step or [None] * len(begin)))
     return data[idx]
@@ -386,6 +457,7 @@ def slice_op(data, begin=None, end=None, step=None):
 
 @register_op("slice_axis")
 def slice_axis(data, axis=0, begin=0, end=None):
+    """Slice [begin, end) along one axis (ref: matrix_op.cc slice_axis)."""
     idx = [slice(None)] * data.ndim
     idx[axis] = slice(begin, end)
     return data[tuple(idx)]
@@ -393,6 +465,8 @@ def slice_axis(data, axis=0, begin=0, end=None):
 
 @register_op("slice_like")
 def slice_like(data, shape_like, axes=None):
+    """Slice data down to shape_like's extents on the given axes (ref:
+    matrix_op.cc slice_like)."""
     tgt = shape_like.shape
     idx = [slice(None)] * data.ndim
     axes = axes if axes else range(min(data.ndim, len(tgt)))
@@ -412,6 +486,8 @@ def slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False):
 
 @register_op("_split_v2", n_out=-1)
 def split_v2(data, indices_or_sections=1, axis=0, squeeze_axis=False, sections=0):
+    """Split along `axis` into sections or at given indices (ref:
+    matrix_op.cc _split_v2 — the numpy-style successor of SliceChannel)."""
     n = sections if sections else indices_or_sections
     if isinstance(n, (list, tuple)):
         n = list(n)
@@ -429,32 +505,41 @@ def concat(*args, dim=1, num_args=0):
 
 @register_op("stack")
 def stack(*args, axis=0, num_args=0):
+    """Stack same-shape tensors along a new axis (ref: matrix_op.cc)."""
     return jnp.stack(args, axis=axis)
 
 
 @register_op("tile")
 def tile(data, reps=None):
+    """Repeat the whole tensor `reps` times per axis (ref: matrix_op.cc)."""
     return jnp.tile(data, tuple(reps))
 
 
 @register_op("repeat")
 def repeat(data, repeats=1, axis=None):
+    """Repeat each element `repeats` times along `axis` (flattened when
+    None; ref: matrix_op.cc repeat)."""
     return jnp.repeat(data, repeats, axis=axis)
 
 
 @register_op("reverse", aliases=["flip"])
 def reverse(data, axis=None):
+    """Reverse element order along the given axes (ref: matrix_op.cc
+    reverse)."""
     ax = axis if isinstance(axis, (tuple, list)) else (axis,)
     return jnp.flip(data, axis=ax)
 
 
 @register_op("SwapAxis", aliases=["swapaxes"])
 def swapaxes(data, dim1=0, dim2=0):
+    """Interchange two axes (ref: swapaxis.cc SwapAxis)."""
     return jnp.swapaxes(data, dim1, dim2)
 
 
 @register_op("depth_to_space")
 def depth_to_space(data, block_size=1):
+    """Rearrange channel blocks into spatial blocks, NCHW (ref:
+    matrix_op.cc depth_to_space)."""
     n, c, h, w = data.shape
     b = block_size
     x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
@@ -464,6 +549,8 @@ def depth_to_space(data, block_size=1):
 
 @register_op("space_to_depth")
 def space_to_depth(data, block_size=1):
+    """Rearrange spatial blocks into channel blocks, NCHW (ref:
+    matrix_op.cc space_to_depth)."""
     n, c, h, w = data.shape
     b = block_size
     x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
@@ -473,6 +560,8 @@ def space_to_depth(data, block_size=1):
 
 @register_op("diag")
 def diag(data, k=0, axis1=0, axis2=1):
+    """Build a diagonal matrix from 1-D input, or extract the k-th
+    diagonal from N-D input (ref: diag_op.cc)."""
     if data.ndim == 1:
         return jnp.diag(data, k)
     return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
@@ -491,6 +580,8 @@ def where(condition, x, y):
 
 @register_op("broadcast_to")
 def broadcast_to(data, shape=None):
+    """Broadcast to `shape`; 0 entries keep the current extent (ref:
+    broadcast_reduce_op_value.cc broadcast_to)."""
     shape = tuple(c if s == 0 else s for s, c in zip(shape, data.shape)) \
         if len(shape) == data.ndim else tuple(shape)
     return jnp.broadcast_to(data, shape)
@@ -498,11 +589,15 @@ def broadcast_to(data, shape=None):
 
 @register_op("broadcast_like")
 def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Broadcast lhs to rhs's shape (ref: broadcast_reduce_op_value.cc
+    broadcast_like)."""
     return jnp.broadcast_to(lhs, rhs.shape)
 
 
 @register_op("broadcast_axis", aliases=["broadcast_axes"])
 def broadcast_axis(data, axis=None, size=None):
+    """Broadcast size-1 axes to the given sizes (ref:
+    broadcast_reduce_op_value.cc broadcast_axis)."""
     axes = axis if isinstance(axis, (list, tuple)) else [axis]
     sizes = size if isinstance(size, (list, tuple)) else [size]
     tgt = list(data.shape)
@@ -513,6 +608,8 @@ def broadcast_axis(data, axis=None, size=None):
 
 @register_op("Pad", aliases=["pad"])
 def pad_alias(data, mode="constant", pad_width=None, constant_value=0):
+    """Pad with constant/edge/reflect modes; pad_width follows the
+    reference's (before, after)-per-axis layout (ref: pad.cc Pad)."""
     from .nn import pad_op
     return pad_op(data, mode=mode, pad_width=tuple(pad_width),
                   constant_value=constant_value)
@@ -520,16 +617,23 @@ def pad_alias(data, mode="constant", pad_width=None, constant_value=0):
 
 @register_op("zeros_like", differentiable=False)
 def zeros_like(data):
+    """Zeros with the input's shape and dtype (ref:
+    elemwise_unary_op_basic.cc zeros_like)."""
     return jnp.zeros_like(data)
 
 
 @register_op("ones_like", differentiable=False)
 def ones_like(data):
+    """Ones with the input's shape and dtype (ref:
+    elemwise_unary_op_basic.cc ones_like)."""
     return jnp.ones_like(data)
 
 
 @register_op("_identity_with_attr_like_rhs")
 def identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs that inherits rhs's attributes in the graph (ref:
+    elemwise_unary_op_basic.cc _identity_with_attr_like_rhs, used by
+    sparse grad plumbing)."""
     return lhs
 
 
@@ -539,30 +643,40 @@ def identity_with_attr_like_rhs(lhs, rhs):
 
 @register_op("take")
 def take(a, indices, axis=0, mode="clip"):
+    """Gather slices along `axis` by integer indices, with clip/wrap
+    out-of-bounds modes (ref: indexing_op.cc take)."""
     m = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
     return jnp.take(a, indices.astype(_index_int()), axis=axis, mode=m)
 
 
 @register_op("batch_take")
 def batch_take(a, indices):
+    """Per-row element pick: out[i] = a[i, indices[i]] (ref:
+    indexing_op.cc batch_take)."""
     return jnp.take_along_axis(
         a, indices.astype(_index_int()).reshape(-1, 1), axis=1).squeeze(1)
 
 
 @register_op("one_hot", differentiable=False)
 def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    """One-hot encode indices to `depth` classes with configurable
+    on/off values (ref: indexing_op.cc one_hot)."""
     oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
     return oh * (on_value - off_value) + off_value
 
 
 @register_op("gather_nd")
 def gather_nd(data, indices):
+    """N-dimensional gather: indices' leading axis indexes data's leading
+    axes (ref: indexing_op.cc gather_nd)."""
     idx = tuple(indices.astype(_index_int()))
     return data[idx]
 
 
 @register_op("scatter_nd")
 def scatter_nd(data, indices, shape=None):
+    """N-dimensional scatter-add of data into a zeros(`shape`) tensor
+    (ref: indexing_op.cc scatter_nd)."""
     idx = tuple(indices.astype(_index_int()))
     out = jnp.zeros(tuple(shape), data.dtype)
     return out.at[idx].add(data)
@@ -570,6 +684,8 @@ def scatter_nd(data, indices, shape=None):
 
 @register_op("_ravel_multi_index", differentiable=False)
 def ravel_multi_index(data, shape=None):
+    """Fold a (ndim, N) matrix of coordinates into flat indices for
+    `shape` (ref: ravel.cc _ravel_multi_index)."""
     dims = jnp.asarray(shape)
     mult = jnp.cumprod(jnp.concatenate([jnp.ones(1, dims.dtype),
                                         dims[::-1][:-1]]))[::-1]
@@ -578,12 +694,17 @@ def ravel_multi_index(data, shape=None):
 
 @register_op("_unravel_index", differentiable=False)
 def unravel_index(data, shape=None):
+    """Unfold flat indices into a (ndim, N) coordinate matrix for
+    `shape` (ref: ravel.cc _unravel_index)."""
     idx = jnp.unravel_index(data.astype(_index_int()), tuple(shape))
     return jnp.stack(idx).astype(data.dtype)
 
 
 @register_op("boolean_mask")
 def boolean_mask(data, index, axis=0):
+    """Select rows where `index` is nonzero (ref: boolean_mask.cc).
+    Dynamic output size: the result is padded to the mask length so XLA
+    keeps a static shape; eager callers slice to the true count."""
     # XLA needs static shapes: materialize via nonzero with size bound
     mask = index.astype(bool)
     idx = jnp.nonzero(mask, size=mask.shape[0])[0]
@@ -596,6 +717,8 @@ def boolean_mask(data, index, axis=0):
 
 @register_op("khatri_rao")
 def khatri_rao(*args):
+    """Column-wise Khatri-Rao (Kronecker) product of the input matrices
+    (ref: krprod.cc khatri_rao)."""
     out = args[0]
     for m in args[1:]:
         out = jnp.einsum("ir,jr->ijr", out, m).reshape(-1, out.shape[1])
@@ -604,16 +727,21 @@ def khatri_rao(*args):
 
 @register_op("_square_sum")
 def square_sum(data, axis=None, keepdims=False):
+    """Fused square-then-sum reduction (ref: square_sum.cc _square_sum,
+    the sparse-gradient norm helper)."""
     return jnp.sum(jnp.square(data), axis=_axis_arg(axis), keepdims=keepdims)
 
 
 @register_op("cast_storage")
 def cast_storage(data, stype="default"):
+    """Storage-type cast (ref: cast_storage.cc)."""
     return data  # dense-on-TPU: storage casts are identity (see sparse.py)
 
 
 @register_op("_contrib_arange_like", differentiable=False)
 def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """Arange shaped like the input (or its `axis` extent; ref:
+    src/operator/contrib/arange_like.cc)."""
     if axis is None:
         n = data.size
         shape = data.shape
@@ -631,9 +759,13 @@ def div_sqrt_dim(data):
 
 @register_op("_sym_zeros", differentiable=False)
 def _sym_zeros(shape=(), dtype="float32"):
+    """Input-free zeros initializer for symbol graphs (the _zeros init
+    op's symbol-layer spelling)."""
     return jnp.zeros(tuple(shape), jnp.dtype(dtype))
 
 
 @register_op("_sym_ones", differentiable=False)
 def _sym_ones(shape=(), dtype="float32"):
+    """Input-free ones initializer for symbol graphs (the _ones init
+    op's symbol-layer spelling)."""
     return jnp.ones(tuple(shape), jnp.dtype(dtype))

@@ -1,0 +1,175 @@
+"""mxlint: composable analysis passes over the op registry and Symbol IR.
+
+The reference caught whole classes of user errors before execution via
+NNVM graph passes (ref: src/nnvm/ — InferShape/InferType/PlanMemory run
+at bind time, each walking the graph and attaching attributes). Our
+TPU-native port defers everything to JAX tracing, so a malformed graph
+surfaces as an opaque TracerConversionError or XLA shape error deep
+inside jax.eval_shape. This package restores the pass layer as *static
+analysis first*: a small pass-manager over the existing Symbol DAG
+(symbol/symbol.py) and the op registry (ops/registry.py), with three
+concrete analyses:
+
+- ``oplint``      — audits every registered OpInfo against its function
+                    (the FInferShape/FGradient attribute-consistency role);
+- ``graphlint``   — lints a bound Symbol with MXNet-style rich messages
+                    (the InferShape error-reporting capability);
+- ``tracercheck`` — hybridize()-time tracer-leak / concretization
+                    detection pointing at the user's source line.
+
+The walker/Finding skeleton is deliberately reusable: later optimisation
+passes (fusion grouping, sharding annotation — ROADMAP) plug into the
+same PassManager and emit the same structured findings.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, Iterable, List, Optional
+
+__all__ = ["Finding", "Pass", "PassManager", "SEVERITIES",
+           "findings_report", "severity_counts", "worst_severity",
+           "topo_walk"]
+
+# ordered weakest → strongest; exit codes / sorting key off this order
+SEVERITIES = ("info", "warn", "error")
+
+
+class Finding:
+    """One structured lint result.
+
+    The machine-readable unit shared by every checker in tools/ (mxlint,
+    check_tpu_consistency --json, flakiness_checker --json): a finding
+    names the pass that produced it, the specific check, the object it
+    is about (op name / node name / test id), a severity, and a human
+    message. Keep fields flat — they serialize 1:1 into the report JSON.
+    """
+
+    __slots__ = ("pass_name", "check", "obj", "severity", "message", "loc")
+
+    def __init__(self, pass_name: str, check: str, obj: str, severity: str,
+                 message: str, loc: Optional[str] = None):
+        if severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {severity!r}; "
+                             f"choose from {SEVERITIES}")
+        self.pass_name = pass_name
+        self.check = check
+        self.obj = obj
+        self.severity = severity
+        self.message = message
+        self.loc = loc  # "file:line" when the pass can point at source
+
+    def to_dict(self) -> Dict[str, object]:
+        d = {"pass": self.pass_name, "check": self.check, "obj": self.obj,
+             "severity": self.severity, "message": self.message}
+        if self.loc:
+            d["loc"] = self.loc
+        return d
+
+    def __repr__(self):
+        tag = f"{self.pass_name}/{self.check}"
+        return f"[{self.severity.upper()}] {tag} {self.obj}: {self.message}"
+
+
+class Pass:
+    """Base class for an analysis pass.
+
+    Subclasses set ``name`` and implement ``run(target) -> [Finding]``.
+    A pass must not mutate its target — analyses here are read-only by
+    contract so the manager can run them in any order (the reference's
+    nnvm passes return a NEW graph for the same reason).
+    """
+
+    name = "pass"
+
+    def run(self, target) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, check: str, obj: str, severity: str, message: str,
+                loc: Optional[str] = None) -> Finding:
+        return Finding(self.name, check, obj, severity, message, loc)
+
+
+class PassManager:
+    """Registry + runner for analysis passes (ref: nnvm::ApplyPasses).
+
+    Passes register under a name; ``run(names, target)`` applies each to
+    the target and concatenates findings. Later transform passes can hook
+    the same registry — the manager is analysis-only today but keeps the
+    (name → pass) indirection the optimiser work will need.
+    """
+
+    def __init__(self):
+        self._passes: Dict[str, Pass] = {}
+
+    def register(self, p: Pass) -> Pass:
+        self._passes[p.name] = p
+        return p
+
+    def get(self, name: str) -> Pass:
+        if name not in self._passes:
+            raise KeyError(f"no pass named {name!r}; registered: "
+                           f"{sorted(self._passes)}")
+        return self._passes[name]
+
+    def names(self) -> List[str]:
+        return sorted(self._passes)
+
+    def run(self, names: Iterable[str], target) -> List[Finding]:
+        out: List[Finding] = []
+        for n in names:
+            out.extend(self.get(n).run(target))
+        return out
+
+
+def topo_walk(symbol):
+    """Yield the Symbol's nodes in topological order — the shared walker
+    every graph pass iterates with (ref: nnvm::DFSVisit)."""
+    for node in symbol._topo_nodes():
+        yield node
+
+
+def severity_counts(findings: Iterable[Finding]) -> Dict[str, int]:
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] += 1
+    return counts
+
+
+def worst_severity(findings: Iterable[Finding]) -> Optional[str]:
+    worst = -1
+    for f in findings:
+        worst = max(worst, SEVERITIES.index(f.severity))
+    return SEVERITIES[worst] if worst >= 0 else None
+
+
+def findings_report(tool: str, findings: Iterable[Finding],
+                    extra: Optional[Dict[str, object]] = None,
+                    as_json: bool = False):
+    """The one machine-readable findings format shared across tools/.
+
+    Shape: {"tool", "findings": [finding dicts], "summary": {severity
+    counts + n_findings}, ...extra}. mxlint, check_tpu_consistency
+    --json, and flakiness_checker --json all emit this, so downstream
+    automation parses a single schema.
+    """
+    fl = [f.to_dict() if isinstance(f, Finding) else dict(f)
+          for f in findings]
+    counts = {s: 0 for s in SEVERITIES}
+    for f in fl:
+        counts[f.get("severity", "info")] += 1
+    report = {"tool": tool, "findings": fl,
+              "summary": dict(counts, n_findings=len(fl))}
+    if extra:
+        report.update(extra)
+    return json.dumps(report, indent=1) if as_json else report
+
+
+# the default manager with the built-in analyses registered; import-time
+# cheap (passes hold no state until run)
+def default_manager() -> PassManager:
+    from . import oplint, graphlint, tracercheck
+    pm = PassManager()
+    pm.register(oplint.OpRegistryAudit())
+    pm.register(graphlint.GraphLint())
+    pm.register(tracercheck.TracerLeakCheck())
+    return pm

@@ -1,0 +1,280 @@
+"""graphlint: static lint of a Symbol DAG with MXNet-style rich messages.
+
+The reference's InferShape/InferType passes (src/nnvm/
+infer_graph_attr_pass.cc) walked the graph BEFORE execution and, on a
+contradiction, named the offending node, its op, and its inputs. Our
+jax-backed Symbol defers to jax.eval_shape, whose failures destroy that
+context. This pass restores the pre-execution walk for everything
+detectable without tracing:
+
+- duplicate node names (eval_graph keys bindings by name — two nodes
+  sharing one name silently share one value);
+- output-index out of range (a corrupt entry reads a neighbour's buffer);
+- arguments listed but never consumed (e.g. a bias input composed onto a
+  ``no_bias=True`` layer), and too many inputs for the op's declared list;
+- dtype conflicts detectable from declared ``__dtype__`` attrs (the
+  reference's InferType requires equal dtypes on elemwise inputs);
+- aux state consumed as a differentiable input by a non-aux op (aux is
+  excluded from gradients — such a read silently gets no gradient);
+- unknown ops / dangling input indices / nodes unreachable from the
+  heads, for serialized graph JSON (``lint_json``), where a hand-edited
+  or cross-version file can be malformed in ways the in-memory builder
+  prevents.
+
+Messages name the node, its op, and its input names — the error shape
+jax.eval_shape failures currently lose.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from . import Finding, Pass
+
+__all__ = ["GraphLint", "lint_symbol", "lint_json"]
+
+
+def _describe(node) -> str:
+    ins = ", ".join(f"{i.name}[{oi}]" if oi else i.name
+                    for i, oi in node.inputs)
+    kind = "variable" if node.is_variable else f"op={node.op}"
+    return f"node '{node.name}' ({kind}" + (f", inputs=[{ins}])" if ins
+                                            else ")")
+
+
+# ops whose whole point is changing dtype — exempt from conflict checks
+_CAST_FAMILY = frozenset({"cast", "Cast", "amp_cast", "amp_multicast"})
+
+
+def _bool_attr(node, key: str, findings: List[Finding], p: Pass) -> bool:
+    """Parse a bool attr that may arrive as a string from symbol json
+    ("False"/"0"/...); an unparseable value becomes a finding instead of
+    crashing the lint (the op itself would raise at execution)."""
+    raw = node.params.get(key)
+    if raw is None:
+        return False
+    from ..base import MXNetError
+    from ..ops.registry import parse_bool_param
+    try:
+        return parse_bool_param(raw)
+    except MXNetError as e:
+        findings.append(p.finding(
+            "bad-bool-attr", node.name, "error",
+            f"{_describe(node)} has unparseable boolean attr "
+            f"{key}={raw!r}: {e}"))
+        return False
+
+
+class GraphLint(Pass):
+    """Lint a bound Symbol (or serialized graph JSON string)."""
+
+    name = "graphlint"
+
+    def run(self, target) -> List[Finding]:
+        if isinstance(target, (str, bytes)):
+            return lint_json(target, self)
+        return lint_symbol(target, self)
+
+
+def lint_symbol(symbol, p: Optional[GraphLint] = None) -> List[Finding]:
+    """All in-memory checks over a Symbol; see module docstring."""
+    from ..ops.registry import has_op, get_op
+    p = p or GraphLint()
+    findings: List[Finding] = []
+    nodes = symbol._topo_nodes()
+
+    # duplicate names: eval_graph's value_map is name-keyed
+    by_name: Dict[str, list] = {}
+    for n in nodes:
+        by_name.setdefault(n.name, []).append(n)
+    for name, group in sorted(by_name.items()):
+        if len(group) > 1:
+            descs = "; ".join(_describe(n) for n in group)
+            findings.append(p.finding(
+                "duplicate-name", name, "error",
+                f"{len(group)} distinct nodes share the name {name!r}: "
+                f"{descs}. Graph evaluation binds values by name, so one "
+                f"array would silently feed every one of them — rename "
+                f"the variables/ops"))
+
+    # aux classification (the FListAuxiliaryStates role): variable ->
+    # set of (node, position) reads, and which reads are aux positions
+    aux_vars = set(symbol.list_auxiliary_states())
+    consumers: Dict[int, List] = {}
+    for n in nodes:
+        if n.is_variable:
+            continue
+        info = get_op(n.op) if has_op(n.op) else None
+        for pos, (inp, oi) in enumerate(n.inputs):
+            # out-index bounds (corrupt multi-output wiring)
+            if oi >= inp._n_out:
+                findings.append(p.finding(
+                    "out-index", n.name, "error",
+                    f"{_describe(n)} reads output {oi} of "
+                    f"'{inp.name}', which only has {inp._n_out} "
+                    f"output(s)"))
+            if inp.is_variable:
+                consumers.setdefault(id(inp), []).append((inp, n, pos, info))
+
+    # aux state read by a non-aux consumer
+    for reads in consumers.values():
+        for inp, n, pos, info in reads:
+            if inp.name not in aux_vars:
+                continue
+            aux_positions = set(
+                (info.aux_updates or {}).values()) if info else set()
+            if pos not in aux_positions:
+                findings.append(p.finding(
+                    "aux-misuse", inp.name, "error",
+                    f"auxiliary state '{inp.name}' is consumed as a "
+                    f"regular differentiable input by {_describe(n)} "
+                    f"(position {pos}); aux states are excluded from "
+                    f"gradients, so this read silently gets no gradient "
+                    f"— use BlockGrad on a copy, or a plain variable"))
+
+    # arguments listed but never consumed / too many inputs for the op
+    for n in nodes:
+        if n.is_variable or not has_op(n.op):
+            continue
+        info = get_op(n.op)
+        if not info.input_names:
+            continue
+        expected = list(info.input_names)
+        if _bool_attr(n, "no_bias", findings, p) and "bias" in expected:
+            expected.remove("bias")
+            bias_pos = list(info.input_names).index("bias")
+            if len(n.inputs) > bias_pos:
+                bias_in, _ = n.inputs[bias_pos]
+                findings.append(p.finding(
+                    "unconsumed-input", n.name, "warn",
+                    f"{_describe(n)} sets no_bias=True but an input "
+                    f"('{bias_in.name}') occupies the bias slot; the op "
+                    f"ignores it, so '{bias_in.name}' is listed as an "
+                    f"argument yet never consumed"))
+        if len(n.inputs) > len(info.input_names) \
+                and "*" not in info.arg_names:
+            findings.append(p.finding(
+                "input-arity", n.name, "error",
+                f"{_describe(n)} has {len(n.inputs)} inputs but op "
+                f"'{n.op}' declares only "
+                f"{list(info.input_names)}; extras are dropped at "
+                f"execution"))
+
+    # declared-dtype conflicts (the InferType equality requirement):
+    # propagate __dtype__ hints forward; flag elemwise ops whose known
+    # input dtypes disagree
+    findings.extend(_lint_dtypes(symbol, nodes, p))
+    return findings
+
+
+def _lint_dtypes(symbol, nodes, p: GraphLint) -> List[Finding]:
+    import numpy as onp
+    findings: List[Finding] = []
+    types: Dict[object, object] = {}
+    for n in nodes:
+        if n.is_variable:
+            hint = n.attrs.get("__dtype__")
+            if hint:
+                try:
+                    types[id(n)] = onp.dtype(hint)
+                except TypeError:
+                    findings.append(p.finding(
+                        "dtype-conflict", n.name, "error",
+                        f"variable '{n.name}' declares unparseable dtype "
+                        f"{hint!r}"))
+            continue
+        in_types = []
+        for inp, _ in n.inputs:
+            t = types.get(id(inp))
+            if t is not None:
+                in_types.append((inp.name, t))
+        known = {t for _, t in in_types}
+        if len(known) > 1 and n.op not in _CAST_FAMILY:
+            pairs = ", ".join(f"{nm}:{t}" for nm, t in in_types)
+            findings.append(p.finding(
+                "dtype-conflict", n.name, "error",
+                f"{_describe(n)} mixes declared input dtypes ({pairs}); "
+                f"the reference's InferType requires equal dtypes here — "
+                f"insert a Cast, or align the variables' dtype attrs"))
+        dt = n.params.get("dtype")
+        if dt is not None:
+            try:
+                types[id(n)] = onp.dtype(dt)
+            except TypeError:
+                pass
+        elif len(known) == 1:
+            types[id(n)] = next(iter(known))
+    return findings
+
+
+def lint_json(json_str, p: Optional[GraphLint] = None) -> List[Finding]:
+    """Lint a serialized graph (Symbol.tojson format) WITHOUT building it
+    — a malformed file would crash the builder with a bare KeyError."""
+    from ..ops.registry import has_op
+    p = p or GraphLint()
+    findings: List[Finding] = []
+    try:
+        data = json.loads(json_str)
+        jnodes = data["nodes"]
+        heads = data["heads"]
+    except (ValueError, KeyError, TypeError) as e:
+        return [p.finding(
+            "json-malformed", "<graph>", "error",
+            f"not a symbol JSON ({type(e).__name__}: {e})")]
+
+    for i, jn in enumerate(jnodes):
+        name = jn.get("name", f"#{i}")
+        op = jn.get("op", "null")
+        if op != "null" and not has_op(op):
+            findings.append(p.finding(
+                "unknown-op", name, "error",
+                f"node '{name}' uses op '{op}', which is not registered "
+                f"in this build (serialized from a different version?)"))
+        for ref in jn.get("inputs", []):
+            src = ref[0]
+            if not (0 <= src < i):
+                findings.append(p.finding(
+                    "dangling-input", name, "error",
+                    f"node '{name}' (#{i}) reads node #{src}, which is "
+                    f"{'a forward reference' if src >= i else 'negative'}"
+                    f" — the file is not in topological order or is "
+                    f"corrupt"))
+
+    # reachability from heads (dead nodes survive serialization when the
+    # file was produced or edited elsewhere)
+    live = set()
+    stack = [h[0] for h in heads if 0 <= h[0] < len(jnodes)]
+    for h in heads:
+        if not (0 <= h[0] < len(jnodes)):
+            findings.append(p.finding(
+                "dangling-head", "<graph>", "error",
+                f"head entry references node #{h[0]}, outside the "
+                f"{len(jnodes)}-node graph"))
+    while stack:
+        i = stack.pop()
+        if i in live:
+            continue
+        live.add(i)
+        for ref in jnodes[i].get("inputs", []):
+            if 0 <= ref[0] < len(jnodes):
+                stack.append(ref[0])
+    for i, jn in enumerate(jnodes):
+        if i not in live:
+            findings.append(p.finding(
+                "dead-node", jn.get("name", f"#{i}"), "warn",
+                f"node '{jn.get('name', i)}' (op="
+                f"{jn.get('op', 'null')}) is unreachable from the graph "
+                f"heads — dead code in the serialized graph"))
+
+    if not findings:
+        # structurally sound: build it and run the full in-memory lint
+        from ..symbol.symbol import load_json
+        try:
+            findings.extend(lint_symbol(load_json(
+                json_str if isinstance(json_str, str)
+                else json_str.decode()), p))
+        except Exception as e:  # noqa: BLE001
+            findings.append(p.finding(
+                "json-malformed", "<graph>", "error",
+                f"graph JSON failed to load: {type(e).__name__}: {e}"))
+    return findings

@@ -1,0 +1,315 @@
+"""oplint: static audit of every registered op's metadata against reality.
+
+The reference's NNVM registry carries per-op attributes (FInferShape,
+FListInputNames, FGradient, FNumVisibleOutputs ...) that the graph passes
+trust blindly — a wrong attribute is a silent miscompile. Here the
+registry keeps the same metadata on OpInfo (ops/registry.py) and the
+symbol/eager layers trust it the same way, so this pass verifies each
+claim against the op function itself:
+
+- ``n_out``           matches what the fn returns under jax.eval_shape
+                      (abstract evaluation — zero FLOPs);
+- ``input_names``     ⊆ the fn's signature parameters;
+- ``differentiable``  ops survive a jax gradient on a probe input
+                      (abstractly, via eval_shape of jax.grad);
+- ``aux_updates`` / ``visible_outputs`` indices are in range;
+- legacy aliases (ops/legacy_aliases.py) resolve to their target OpInfo;
+- every op carries a docstring (the generated nd./sym. surfaces forward
+  fn.__doc__ — an empty one ships an undocumented public function).
+
+Probe inputs come from the repo's registry-wide sweep corpus
+(tests/test_op_sweep.py CASES/SKIP) when available, else are synthesized
+generically; ops with no constructible probe are still audited statically
+and reported at info severity so coverage gaps stay visible.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import Finding, Pass
+
+__all__ = ["OpRegistryAudit", "audit_registry", "load_probe_corpus"]
+
+# ops whose *registered contract* is to raise (unsupported-backend stubs):
+# probing them exercises the raise, which is correct behavior, not a finding
+_RAISING_STUBS = frozenset({"_TensorRT", "_NDArray", "_Native"})
+
+_RNG_SPEC = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+
+def load_probe_corpus():
+    """Import the registry-wide sweep corpus (tests/test_op_sweep.py) —
+    the curated per-op probe inputs shared with check_tpu_consistency.
+    Returns the module or None when the tests tree isn't present."""
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    tests = os.path.join(root, "tests")
+    if os.path.isdir(tests) and tests not in sys.path:
+        sys.path.insert(0, tests)
+    try:
+        import test_op_sweep  # noqa: PLC0415
+        return test_op_sweep
+    except Exception:
+        return None
+
+
+def _unique_ops(ops: Dict[str, object]) -> List[Tuple[str, object]]:
+    """One (canonical-name, info) per distinct implementation; the first
+    registered name wins (aliases share the OpInfo object)."""
+    seen = {}
+    for name, info in ops.items():
+        seen.setdefault(id(info), (name, info))
+    return sorted(seen.values(), key=lambda kv: kv[0])
+
+
+def _n_required(info) -> int:
+    n = 0
+    for a in info.arg_names:
+        if a == "*":
+            return max(n, 1)
+        if a in info.defaults:
+            break
+        n += 1
+    return n
+
+
+def _probe_inputs(name, info, corpus):
+    """(jax arrays, params) probe for an op, or (None, reason)."""
+    if corpus is not None:
+        if name in getattr(corpus, "SKIP", {}):
+            return None, corpus.SKIP[name]
+        case = getattr(corpus, "CASES", {}).get(name)
+        if case is not None:
+            args, params = case()
+            return [a._data if hasattr(a, "_data") else jnp.asarray(a)
+                    for a in args], dict(params)
+    n = _n_required(info)
+    if info.needs_rng:
+        n = max(n - 1, 0)  # trailing raw key is appended below
+    return [jnp.zeros((2, 3, 4), jnp.float32) for _ in range(n)], {}
+
+
+def _call_spec(info, arrays, params):
+    """Assemble the (args, kwargs) the raw fn expects: trailing threefry
+    key for needs_rng, _training for needs_train — the same plumbing the
+    nd wrapper and eval_graph apply (registry.py / symbol.py)."""
+    args = list(arrays)
+    if info.needs_rng:
+        args.append(_RNG_SPEC)
+    kwargs = dict(params)
+    if info.needs_train:
+        kwargs.setdefault("_training", False)
+    return args, kwargs
+
+
+def _expected_n_out(info, params) -> Optional[int]:
+    if info.n_out != -1:
+        return info.n_out
+    if "num_outputs" in params:
+        return int(params["num_outputs"])
+    return None  # param-dependent and the probe didn't pin it
+
+
+class OpRegistryAudit(Pass):
+    """Walk every OpInfo and verify its metadata (see module docstring)."""
+
+    name = "oplint"
+
+    def __init__(self, corpus="auto", probe=True):
+        self._corpus = corpus
+        self._probe = probe
+
+    def run(self, target=None) -> List[Finding]:
+        from ..ops.registry import _OPS
+        ops = target if target is not None else _OPS
+        corpus = load_probe_corpus() if self._corpus == "auto" \
+            else self._corpus
+        findings: List[Finding] = []
+        for name, info in _unique_ops(ops):
+            findings.extend(self._audit_static(name, info))
+            if self._probe:
+                findings.extend(self._audit_probe(name, info, corpus))
+        if target is None:
+            # the alias table describes the GLOBAL registry; auditing it
+            # against a caller-supplied subset would flag every alias
+            # whose target the subset happens to omit
+            findings.extend(self._audit_aliases(ops))
+        return findings
+
+    # ---- static checks: no execution, pure metadata ----------------------
+    def _audit_static(self, name, info) -> List[Finding]:
+        out: List[Finding] = []
+        if not (info.fn.__doc__ or "").strip():
+            out.append(self.finding(
+                "docstring", name, "warn",
+                "registered op has no docstring; nd.%s/sym.%s ship "
+                "undocumented (the codegen forwards fn.__doc__)"
+                % (name, name)))
+        if info.input_names:
+            has_varargs = "*" in info.arg_names
+            for iname in info.input_names:
+                if iname not in info.arg_names and not has_varargs:
+                    out.append(self.finding(
+                        "input-names", name, "error",
+                        f"declared input {iname!r} is not a parameter of "
+                        f"the op function (signature: "
+                        f"{[a for a in info.arg_names if a != '*']}); the "
+                        f"symbol layer auto-creates variables from stale "
+                        f"names"))
+        for out_idx, in_idx in (info.aux_updates or {}).items():
+            if info.n_out != -1 and not (0 <= out_idx < info.n_out):
+                out.append(self.finding(
+                    "aux-range", name, "error",
+                    f"aux_updates output index {out_idx} out of range for "
+                    f"n_out={info.n_out}"))
+            if info.input_names and not (0 <= in_idx < len(info.input_names)):
+                out.append(self.finding(
+                    "aux-range", name, "error",
+                    f"aux_updates input index {in_idx} out of range for "
+                    f"{len(info.input_names)} declared inputs"))
+        vis = info.visible_outputs
+        if isinstance(vis, int):
+            if info.n_out != -1 and not (0 < vis <= info.n_out):
+                out.append(self.finding(
+                    "visible-outputs", name, "error",
+                    f"visible_outputs={vis} out of range for "
+                    f"n_out={info.n_out}"))
+        elif vis is not None and not callable(vis):
+            out.append(self.finding(
+                "visible-outputs", name, "error",
+                f"visible_outputs must be an int or callable(params), got "
+                f"{type(vis).__name__}"))
+        return out
+
+    # ---- probe checks: abstract evaluation of the op function ------------
+    def _audit_probe(self, name, info, corpus) -> List[Finding]:
+        if name in _RAISING_STUBS:
+            return []
+        arrays, params = _probe_inputs(name, info, corpus)
+        if arrays is None:
+            return [self.finding(
+                "probe-skip", name, "info",
+                f"no probe inputs: {params}")]
+        args, kwargs = _call_spec(info, arrays, params)
+        abstract = True
+        try:
+            shaped = jax.eval_shape(
+                lambda *a: info.fn(*a, **kwargs), *args)
+        except Exception as abs_err:  # noqa: BLE001 — try concretely
+            # host-side eager ops (dgl sampling, boolean_mask) concretize
+            # their inputs by design and cannot be abstractly evaluated;
+            # run the probe for real (tiny inputs, same cost as the sweep
+            # test) so their n_out contract is still verified
+            abstract = False
+            concrete = [jnp.zeros(a.shape, a.dtype)
+                        if isinstance(a, jax.ShapeDtypeStruct) else a
+                        for a in args]
+            try:
+                shaped = info.fn(*concrete, **kwargs)
+            except Exception:  # noqa: BLE001 — report, don't abort audit
+                return [self.finding(
+                    "probe-error", name, "info",
+                    f"probe evaluation failed, abstractly and concretely "
+                    f"({type(abs_err).__name__}: {str(abs_err)[:160]}); "
+                    f"n_out/vjp unverified for this op")]
+        outs = list(shaped) if isinstance(shaped, (tuple, list)) else [shaped]
+        findings: List[Finding] = []
+        expected = _expected_n_out(info, kwargs)
+        if expected is not None and len(outs) != expected:
+            findings.append(self.finding(
+                "n-out", name, "error",
+                f"registered n_out={expected} but the op function returns "
+                f"{len(outs)} output(s) on the probe input; the executor "
+                f"would mis-split this op's outputs"))
+        if info.n_out == -1 and not isinstance(shaped, (tuple, list)):
+            findings.append(self.finding(
+                "n-out", name, "error",
+                "n_out=-1 (param-dependent) but the op function returned a "
+                "single array, not a tuple"))
+        vis = info.visible_outputs
+        if callable(vis):
+            try:
+                vis = vis(dict(kwargs))
+            except Exception as e:  # noqa: BLE001
+                findings.append(self.finding(
+                    "visible-outputs", name, "error",
+                    f"visible_outputs callable raised on probe params: "
+                    f"{type(e).__name__}: {e}"))
+                vis = None
+        if isinstance(vis, int) and not (0 < vis <= len(outs)):
+            findings.append(self.finding(
+                "visible-outputs", name, "error",
+                f"visible_outputs={vis} out of range for the {len(outs)} "
+                f"output(s) the op actually returns"))
+        if info.differentiable and abstract:
+            findings.extend(self._audit_vjp(name, info, args, kwargs, outs))
+        return findings
+
+    def _audit_vjp(self, name, info, args, kwargs, outs) -> List[Finding]:
+        """differentiable=True must survive a jax gradient: grad of the
+        summed float outputs w.r.t. the float probe inputs, abstractly."""
+        argnums = tuple(
+            i for i, a in enumerate(args)
+            if a is not _RNG_SPEC and hasattr(a, "dtype")
+            and jnp.issubdtype(a.dtype, jnp.floating))
+        if not argnums or not any(
+                jnp.issubdtype(o.dtype, jnp.floating) for o in outs):
+            return []  # nothing float to differentiate — vacuously fine
+
+        def scalar_loss(*a):
+            out = info.fn(*a, **kwargs)
+            outs_ = out if isinstance(out, (tuple, list)) else [out]
+            tot = jnp.zeros((), jnp.float32)
+            for o in outs_:
+                if jnp.issubdtype(o.dtype, jnp.floating):
+                    tot = tot + jnp.sum(o).astype(jnp.float32)
+            return tot
+
+        try:
+            jax.eval_shape(jax.grad(scalar_loss, argnums=argnums), *args)
+        except Exception as e:  # noqa: BLE001
+            return [self.finding(
+                "vjp", name, "error",
+                f"registered differentiable=True but jax.vjp fails on the "
+                f"probe input ({type(e).__name__}: {str(e)[:160]}); the "
+                f"tape would crash at backward time — register with "
+                f"differentiable=False or fix the gradient path")]
+        return []
+
+    # ---- alias table ------------------------------------------------------
+    def _audit_aliases(self, ops) -> List[Finding]:
+        try:
+            from ..ops.legacy_aliases import _ALIASES
+        except Exception as e:  # noqa: BLE001
+            return [self.finding(
+                "alias", "legacy_aliases", "error",
+                f"alias table failed to import: {type(e).__name__}: {e}")]
+        out: List[Finding] = []
+        for new, old in _ALIASES.items():
+            if old not in ops:
+                out.append(self.finding(
+                    "alias", new, "error",
+                    f"alias target {old!r} is not registered"))
+            elif new not in ops:
+                out.append(self.finding(
+                    "alias", new, "error",
+                    f"alias {new!r} -> {old!r} was never installed in the "
+                    f"registry"))
+            elif ops[new] is not ops[old] and ops[new].fn is not ops[old].fn:
+                out.append(self.finding(
+                    "alias", new, "error",
+                    f"alias {new!r} resolves to a different implementation "
+                    f"than its target {old!r} (shadowed by a later "
+                    f"registration)"))
+        return out
+
+
+def audit_registry(corpus="auto") -> List[Finding]:
+    """Audit the live registry; the one-call API tools/mxlint.py uses."""
+    import mxnet_tpu  # noqa: F401 — populate the registry
+    return OpRegistryAudit(corpus=corpus).run()

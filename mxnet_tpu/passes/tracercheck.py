@@ -1,0 +1,209 @@
+"""tracercheck: hybridize()-time tracer-leak / concretization detection.
+
+When a Gluon block is hybridized, its forward runs once under jax.jit
+tracing. Two classes of user bugs surface there as opaque jax internals:
+
+1. **Concretization** — Python-level ``bool()``/``int()``/``float()``/
+   ``.item()``/``.asnumpy()`` on a traced value (data-dependent ``if``,
+   shape arithmetic on values). jax raises a TracerBoolConversionError
+   whose traceback is dominated by jax internals; the frame the user
+   needs — their own line — is buried. ``explain_concretization``
+   extracts it.
+2. **Tracer leaks** — storing an intermediate on ``self`` during forward
+   (``self.attention = attn``). The trace completes, so nothing raises
+   until the stored tracer is touched much later, far from the cause
+   (jax's UnexpectedTracerError names the trace, not the attribute).
+   ``scan_block_for_tracers`` walks the block tree right after the first
+   trace and names the exact attribute path holding a dead tracer.
+
+HybridBlock._build_jit (gluon/block.py) runs both automatically on the
+first trace; ``check_block`` is the standalone API (used by mxlint's
+self-check and tests).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Tuple
+
+from . import Finding, Pass
+
+__all__ = ["TracerLeakCheck", "scan_block_for_tracers",
+           "explain_concretization", "check_block"]
+
+# frames under these roots are machinery, not the user's bug site
+_INTERNAL_ROOTS = (
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),  # mxnet_tpu
+)
+
+
+import sysconfig
+
+_STDLIB = sysconfig.get_paths().get("stdlib", "")
+
+
+def _is_library(filename: str) -> bool:
+    """jax / numpy / stdlib machinery — never the user's bug site."""
+    if filename.startswith("<"):  # synthetic: <frozen importlib>, exec'd
+        return True
+    f = os.path.abspath(filename)
+    return ("site-packages" in f or "dist-packages" in f
+            or bool(_STDLIB) and f.startswith(_STDLIB + os.sep))
+
+
+def _is_ours(filename: str) -> bool:
+    f = os.path.abspath(filename)
+    return any(f.startswith(root + os.sep) for root in _INTERNAL_ROOTS)
+
+
+# NDArray scalar-conversion entry points: these frames are inside
+# mxnet_tpu but the *caller* owns the bug (a user `if x > 0:` lands in
+# NDArray.__bool__ before jax raises) — blame forwards outward through
+# them instead of classifying the error as an internal dynamic-shape op
+_BLAME_FORWARDERS = frozenset({
+    "__bool__", "__int__", "__float__", "__index__", "__len__",
+    "__iter__", "__array__", "asscalar", "asnumpy", "item",
+})
+
+
+def _is_tracer(v: Any) -> bool:
+    try:
+        import jax
+        if isinstance(v, jax.core.Tracer):
+            return True
+        data = getattr(v, "_data", None)  # NDArray wrapping a tracer
+        return isinstance(data, jax.core.Tracer)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _scan_value(path: str, v: Any, out: List[Tuple[str, Any]], depth=0):
+    if _is_tracer(v):
+        out.append((path, v))
+        return
+    if depth >= 2:  # one container level is the common leak shape
+        return
+    if isinstance(v, dict):
+        for k, item in v.items():
+            _scan_value(f"{path}[{k!r}]", item, out, depth + 1)
+    elif isinstance(v, (list, tuple)):
+        for i, item in enumerate(v):
+            _scan_value(f"{path}[{i}]", item, out, depth + 1)
+
+
+def scan_block_for_tracers(block, prefix: str = "") -> List[Finding]:
+    """Walk a Block tree's attributes for leaked jax tracers. Run right
+    after a trace completes: any tracer still reachable from the block is
+    dead and will raise UnexpectedTracerError wherever it is next used."""
+    p = TracerLeakCheck()
+    findings: List[Finding] = []
+    label = prefix or type(block).__name__
+
+    leaks: List[Tuple[str, Any]] = []
+    for attr, v in vars(block).items():
+        if attr in ("_children", "_reg_params", "_params", "_cached"):
+            continue
+        _scan_value(f"{label}.{attr}", v, leaks)
+    for path, _ in leaks:
+        findings.append(p.finding(
+            "tracer-leak", path, "error",
+            f"'{path}' holds a jax tracer captured during hybridize() "
+            f"tracing; it escaped the traced function and is dead — "
+            f"touching it later raises UnexpectedTracerError far from "
+            f"here. Don't store intermediates on self inside forward "
+            f"(compute them outside, or return them as outputs)"))
+
+    for name, child in getattr(block, "_children", {}).items():
+        findings.extend(scan_block_for_tracers(child, f"{label}.{name}"))
+    return findings
+
+
+def explain_concretization(exc: BaseException) -> Optional[str]:
+    """Name the user's source line inside a jax concretization error.
+
+    Walks the traceback from the raise site outward and classifies by
+    the innermost frame that is not jax/stdlib machinery: if that frame
+    is inside mxnet_tpu (an op whose implementation legitimately
+    concretizes, e.g. boolean_mask), returns None — not a user bug. If
+    it is the user's own file, returns 'file:line (in func): source'."""
+    import linecache
+    frames = []
+    tb = exc.__traceback__
+    while tb is not None:
+        code = tb.tb_frame.f_code
+        frames.append((code.co_filename, tb.tb_lineno, code.co_name))
+        tb = tb.tb_next
+    for fname, lineno, func in reversed(frames):
+        if _is_library(fname):
+            continue  # jax / stdlib machinery — keep walking out
+        if _is_ours(fname):
+            if func in _BLAME_FORWARDERS:
+                continue  # scalar-conversion shim — blame the caller
+            return None  # concretization is inside the op corpus
+        src = linecache.getline(fname, lineno).strip()
+        loc = f"{fname}:{lineno} (in {func})"
+        return f"{loc}: {src}" if src else loc
+    return None
+
+
+class TracerLeakCheck(Pass):
+    """Pass wrapper: target is a HybridBlock (plus optional probe args)."""
+
+    name = "tracercheck"
+
+    def run(self, target) -> List[Finding]:
+        if isinstance(target, tuple):
+            block, args = target[0], target[1:]
+            return check_block(block, *args)
+        return scan_block_for_tracers(target)
+
+
+def check_block(block, *args) -> List[Finding]:
+    """Trace ``block.forward(*args)`` abstractly and report tracer bugs.
+
+    Findings:
+    - ``concretization`` (error) when the trace concretizes a traced
+      value in user code, with the user's source line;
+    - ``dynamic-shape`` (info) when the concretizing frame is inside the
+      op corpus (expected for boolean_mask & co — the hybridize path
+      falls back to eager for these);
+    - ``tracer-leak`` (error) for tracers left on block attributes.
+    """
+    import jax
+    from ..gluon.block import functional_call
+
+    p = TracerLeakCheck()
+    findings: List[Finding] = []
+    try:
+        plist = sorted(block._collect_params_with_prefix().items())
+        pvals = {n: par.data()._data for n, par in plist}
+        in_vals = [a._data if hasattr(a, "_data") else a for a in args]
+        jax.eval_shape(
+            lambda pv, iv: functional_call(block, pv, iv)[0],
+            pvals, in_vals)
+    except (jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerBoolConversionError,
+            jax.errors.TracerIntegerConversionError) as e:
+        loc = explain_concretization(e)
+        if loc:
+            findings.append(p.finding(
+                "concretization", type(block).__name__, "error",
+                f"forward() concretizes a traced value at {loc} — "
+                f"data-dependent Python control flow cannot be compiled; "
+                f"hoist the decision out of forward or use where/"
+                f"control-flow ops. (jax: {type(e).__name__})",
+                loc=loc.split(" ")[0]))
+        else:
+            findings.append(p.finding(
+                "dynamic-shape", type(block).__name__, "info",
+                f"forward() uses a dynamic-output-shape op "
+                f"({type(e).__name__} raised inside the op corpus); "
+                f"hybridize() will fall back to eager execution for "
+                f"this block"))
+    except Exception as e:  # noqa: BLE001 — not a tracer problem
+        findings.append(p.finding(
+            "trace-error", type(block).__name__, "warn",
+            f"forward() failed under abstract tracing before any tracer "
+            f"check could run: {type(e).__name__}: {str(e)[:160]}"))
+    findings.extend(scan_block_for_tracers(block))
+    return findings

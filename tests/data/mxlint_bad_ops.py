@@ -1,0 +1,72 @@
+"""Deliberately-broken op registrations: the mxlint known-bad corpus.
+
+Imported by tests/test_mxlint.py (in-process, cleaned up afterwards) and
+by the CLI test via `tools/mxlint.py --ops --load <this file>` (fresh
+subprocess). Every op here must trip exactly the oplint check named in
+its docstring — if the auditor stops firing on one of these, the test
+suite catches the regression.
+"""
+import jax
+
+from mxnet_tpu.ops.registry import register_op
+
+# name -> the oplint check expected to fire on it
+EXPECTED = {
+    "_lintbad_n_out": "n-out",
+    "_lintbad_inputs": "input-names",
+    "_lintbad_aux": "aux-range",
+    "_lintbad_vis": "visible-outputs",
+    "_lintbad_vjp": "vjp",
+    "_lintbad_nodoc": "docstring",
+}
+
+
+@register_op("_lintbad_n_out", n_out=2)
+def _lintbad_n_out(data):
+    """Registered n_out=2 but returns a single array."""
+    return data * 2
+
+
+@register_op("_lintbad_inputs", input_names=("data", "weight"))
+def _lintbad_inputs(data):
+    """Declares input 'weight' that the signature does not have."""
+    return data
+
+
+@register_op("_lintbad_aux", input_names=("data",), aux_updates={5: 0})
+def _lintbad_aux(data):
+    """aux_updates output index 5 out of range for n_out=1."""
+    return data
+
+
+@register_op("_lintbad_vis", visible_outputs=3)
+def _lintbad_vis(data):
+    """visible_outputs=3 exceeds the single real output."""
+    return data
+
+
+@jax.custom_vjp
+def _broken_grad(x):
+    return x
+
+
+def _broken_fwd(x):
+    return x, None
+
+
+def _broken_bwd(res, g):
+    raise ValueError("deliberately broken backward pass")
+
+
+_broken_grad.defvjp(_broken_fwd, _broken_bwd)
+
+
+@register_op("_lintbad_vjp")
+def _lintbad_vjp(data):
+    """Registered differentiable=True but the backward pass raises."""
+    return _broken_grad(data)
+
+
+@register_op("_lintbad_nodoc")
+def _lintbad_nodoc(data):
+    return data

@@ -1,0 +1,345 @@
+"""mxlint pass-framework tests (mxnet_tpu/passes/ + tools/mxlint.py).
+
+Two halves, mirroring the acceptance contract:
+- known-bad fixtures (tests/data/mxlint_bad_ops.py, hand-built bad
+  graphs/blocks) on which every check must FIRE;
+- the live corpus (full op registry, a composed network) which must
+  lint CLEAN — this is the tier-1 wiring of `tools/mxlint.py --all`.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import HybridBlock, nn
+from mxnet_tpu.passes import (Finding, PassManager, default_manager,
+                              findings_report, severity_counts,
+                              worst_severity)
+from mxnet_tpu.passes.graphlint import lint_json, lint_symbol
+from mxnet_tpu.passes.oplint import OpRegistryAudit
+from mxnet_tpu.passes.tracercheck import check_block, scan_block_for_tracers
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BAD_OPS_PY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "data", "mxlint_bad_ops.py")
+MXLINT = os.path.join(ROOT, "tools", "mxlint.py")
+
+
+@pytest.fixture
+def bad_ops():
+    """Import the known-bad fixture ops, clean the registry afterwards."""
+    from mxnet_tpu.ops.registry import _OPS
+    spec = importlib.util.spec_from_file_location("mxlint_bad_ops",
+                                                  BAD_OPS_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        yield mod.EXPECTED
+    finally:
+        for name in mod.EXPECTED:
+            _OPS.pop(name, None)
+
+
+# ---------------------------------------------------------------------------
+# oplint: every fixture op trips its check; the live registry is clean
+# ---------------------------------------------------------------------------
+
+def test_oplint_fires_on_every_bad_fixture(bad_ops):
+    from mxnet_tpu.ops.registry import _OPS
+    target = {name: _OPS[name] for name in bad_ops}
+    findings = OpRegistryAudit().run(target)
+    fired = {(f.obj, f.check) for f in findings}
+    for name, check in bad_ops.items():
+        assert (name, check) in fired, (
+            f"expected oplint/{check} to fire on {name}; got {fired}")
+
+
+def test_oplint_bad_findings_are_structured(bad_ops):
+    from mxnet_tpu.ops.registry import _OPS
+    target = {name: _OPS[name] for name in bad_ops}
+    findings = OpRegistryAudit().run(target)
+    assert worst_severity(findings) == "error"
+    for f in findings:
+        d = f.to_dict()
+        assert {"pass", "check", "obj", "severity", "message"} <= set(d)
+        assert d["pass"] == "oplint"
+
+
+def test_oplint_live_registry_is_clean():
+    """The corpus test: EVERY registered op audits clean (the acceptance
+    criterion behind `mxlint --all` exiting 0)."""
+    findings = OpRegistryAudit().run()
+    counts = severity_counts(findings)
+    bad = [f for f in findings if f.severity in ("warn", "error")]
+    assert not bad, f"registry has lint findings: {bad[:10]} ({counts})"
+
+
+# ---------------------------------------------------------------------------
+# graphlint: known-bad Symbols / graph JSON
+# ---------------------------------------------------------------------------
+
+def _checks(findings):
+    return {f.check for f in findings}
+
+
+def test_graphlint_duplicate_names():
+    out = sym.var("x") + sym.var("x")
+    findings = lint_symbol(out)
+    dup = [f for f in findings if f.check == "duplicate-name"]
+    assert dup and dup[0].obj == "x"
+    assert "'x'" in dup[0].message
+
+
+def test_graphlint_dtype_conflict():
+    a = sym.var("a", dtype="float32")
+    b = sym.var("b", dtype="float16")
+    findings = lint_symbol(a + b)
+    conf = [f for f in findings if f.check == "dtype-conflict"]
+    assert conf, findings
+    assert "a:float32" in conf[0].message and "b:float16" in conf[0].message
+
+
+def test_graphlint_unconsumed_bias():
+    x = sym.var("data")
+    w = sym.var("w")
+    b = sym.var("b")
+    fc = sym.FullyConnected(x, w, b, num_hidden=4, no_bias=True, name="fc")
+    findings = lint_symbol(fc)
+    unc = [f for f in findings if f.check == "unconsumed-input"]
+    assert unc and unc[0].obj == "fc"
+    assert "'b'" in unc[0].message
+
+
+def test_graphlint_aux_misused_as_input():
+    x = sym.var("data")
+    g, b = sym.var("g"), sym.var("b")
+    mm, mv = sym.var("mm"), sym.var("mv")
+    bn = sym.BatchNorm(x, g, b, mm, mv, name="bn")
+    leaked = mm + x  # aux state consumed by a differentiable op
+    findings = lint_symbol(sym.Group([bn, leaked]))
+    mis = [f for f in findings if f.check == "aux-misuse"]
+    assert mis and mis[0].obj == "mm"
+    assert "no gradient" in mis[0].message
+
+
+def test_graphlint_clean_network_is_clean():
+    x = sym.var("data")
+    net = sym.FullyConnected(x, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    assert lint_symbol(net) == []
+    # serialized form round-trips clean too
+    assert lint_json(net.tojson()) == []
+
+
+def test_graphlint_json_malformed():
+    findings = lint_json("this is not a symbol json")
+    assert _checks(findings) == {"json-malformed"}
+
+
+def _jnode(op, name, inputs=()):
+    return {"op": op, "name": name, "attrs": {},
+            "inputs": [[i, 0, 0] for i in inputs]}
+
+
+def test_graphlint_json_forward_reference():
+    graph = json.dumps({
+        "nodes": [_jnode("relu", "r", inputs=[1]),
+                  _jnode("null", "x")],
+        "heads": [[0, 0, 0]],
+    })
+    findings = lint_json(graph)
+    assert "dangling-input" in _checks(findings)
+
+
+def test_graphlint_json_unknown_op():
+    graph = json.dumps({
+        "nodes": [_jnode("null", "x"),
+                  _jnode("not_a_real_op_xyz", "bad", inputs=[0])],
+        "heads": [[1, 0, 0]],
+    })
+    findings = lint_json(graph)
+    unk = [f for f in findings if f.check == "unknown-op"]
+    assert unk and "not_a_real_op_xyz" in unk[0].message
+
+
+def test_graphlint_json_dead_node():
+    graph = json.dumps({
+        "nodes": [_jnode("null", "x"),
+                  _jnode("relu", "live", inputs=[0]),
+                  _jnode("null", "orphan")],
+        "heads": [[1, 0, 0]],
+    })
+    findings = lint_json(graph)
+    dead = [f for f in findings if f.check == "dead-node"]
+    assert dead and dead[0].obj == "orphan"
+    assert dead[0].severity == "warn"
+
+
+def test_graphlint_json_dangling_head():
+    graph = json.dumps({
+        "nodes": [_jnode("null", "x")],
+        "heads": [[7, 0, 0]],
+    })
+    findings = lint_json(graph)
+    assert "dangling-head" in _checks(findings)
+
+
+# ---------------------------------------------------------------------------
+# tracercheck: concretization blame + tracer leaks
+# ---------------------------------------------------------------------------
+
+class _BranchyBlock(HybridBlock):
+    def forward(self, x):
+        if x.sum() > 0:  # data-dependent python control flow: the bug
+            return x * 2
+        return x
+
+
+class _LeakyBlock(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.dense = nn.Dense(4, in_units=3)
+
+    def forward(self, x):
+        h = self.dense(x)
+        self.stash = h  # tracer stored on self: the bug
+        return h
+
+
+def test_tracercheck_concretization_names_user_line():
+    b = _BranchyBlock()
+    b.initialize()
+    findings = check_block(b, nd.ones((2, 3)))
+    conc = [f for f in findings if f.check == "concretization"]
+    assert conc, findings
+    # blame lands on THIS file's `if x.sum() > 0` line, not jax internals
+    assert os.path.basename(__file__) in conc[0].message
+    assert "x.sum() > 0" in conc[0].message
+    assert conc[0].severity == "error"
+
+
+def test_tracercheck_reports_tracer_leak():
+    b = _LeakyBlock()
+    b.initialize()
+    findings = check_block(b, nd.ones((2, 3)))
+    leaks = [f for f in findings if f.check == "tracer-leak"]
+    assert leaks, findings
+    assert "stash" in leaks[0].obj
+    assert "UnexpectedTracerError" in leaks[0].message
+
+
+def test_tracercheck_clean_block_is_clean():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=6))
+        net.add(nn.Dense(2, in_units=4))
+    net.initialize()
+    findings = [f for f in check_block(net, nd.zeros((2, 6)))
+                if f.check != "dynamic-shape"]
+    assert findings == []
+
+
+def test_hybridize_warns_on_tracer_leak():
+    """The gluon integration: _build_jit scans for leaks after the first
+    trace (MXNET_TRACER_CHECK=warn default)."""
+    b = _LeakyBlock()
+    b.initialize()
+    b.hybridize()
+    with pytest.warns(UserWarning, match="tracer"):
+        b(nd.ones((2, 3)))
+
+
+# ---------------------------------------------------------------------------
+# pass-manager skeleton + shared findings format
+# ---------------------------------------------------------------------------
+
+def test_pass_manager_registry():
+    pm = default_manager()
+    assert pm.names() == ["graphlint", "oplint", "tracercheck"]
+    with pytest.raises(KeyError):
+        pm.get("no_such_pass")
+    out = sym.var("x") + sym.var("x")
+    findings = pm.run(["graphlint"], out)
+    assert any(f.check == "duplicate-name" for f in findings)
+
+
+def test_finding_rejects_unknown_severity():
+    with pytest.raises(ValueError):
+        Finding("p", "c", "o", "fatal", "m")
+
+
+def test_findings_report_schema():
+    fs = [Finding("oplint", "n-out", "op_a", "error", "boom"),
+          Finding("graphlint", "dead-node", "n1", "warn", "meh")]
+    rep = findings_report("mxlint", fs)
+    assert rep["tool"] == "mxlint"
+    assert rep["summary"]["n_findings"] == 2
+    assert rep["summary"]["error"] == 1 and rep["summary"]["warn"] == 1
+    assert rep["findings"][0]["check"] == "n-out"
+    # json mode emits the same shape, parseable
+    assert json.loads(findings_report("mxlint", fs, as_json=True)) == rep
+
+
+def test_parse_bool_param_rejects_unknown_strings():
+    from mxnet_tpu.ops.registry import parse_bool_param
+    assert parse_bool_param("on") and parse_bool_param("True")
+    assert not parse_bool_param("off")
+    assert not parse_bool_param("no")
+    assert not parse_bool_param("0")
+    assert not parse_bool_param("")
+    with pytest.raises(MXNetError):
+        parse_bool_param("offf")
+
+
+# ---------------------------------------------------------------------------
+# CLI: the tier-1 gate — clean corpus exits 0, bad fixtures exit 2
+# ---------------------------------------------------------------------------
+
+def _run_mxlint(*args):
+    return subprocess.run([sys.executable, MXLINT, *args], cwd=ROOT,
+                          capture_output=True, text=True, timeout=300)
+
+
+def test_cli_all_exits_zero_on_clean_corpus():
+    """`python tools/mxlint.py --all` — the full gate, wired into tier-1
+    here: ops audit over every registered op + graph/block self-checks."""
+    proc = _run_mxlint("--all", "--json")
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(proc.stdout)
+    assert report["summary"]["error"] == 0
+    assert report["summary"]["warn"] == 0
+    # the auditor covered the whole registry, not a sample
+    oplint_sections = [s for s in report["sections"]
+                       if s["pass"] == "oplint"]
+    assert oplint_sections
+
+
+def test_cli_exits_nonzero_on_bad_fixtures():
+    proc = _run_mxlint("--ops", "--no-probe", "--json",
+                       "--load", BAD_OPS_PY)
+    assert proc.returncode == 2, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(proc.stdout)
+    flagged = {f["obj"] for f in report["findings"]
+               if f["obj"].startswith("_lintbad_")}
+    # static checks fire even without probes
+    assert {"_lintbad_inputs", "_lintbad_aux", "_lintbad_vis",
+            "_lintbad_nodoc"} <= flagged
+
+
+def test_cli_lints_graph_json_files(tmp_path):
+    bad = tmp_path / "bad_graph.json"
+    bad.write_text(json.dumps({
+        "nodes": [_jnode("null", "x"),
+                  _jnode("not_a_real_op_xyz", "bad", inputs=[0])],
+        "heads": [[1, 0, 0]],
+    }))
+    proc = _run_mxlint(str(bad))
+    assert proc.returncode == 2
+    assert "not_a_real_op_xyz" in proc.stdout

@@ -78,6 +78,10 @@ CASES = {
     "_contrib_BilinearResize2D": lambda: ([T(1, 2, 4, 4)],
                                           {"height": 8, "width": 8}),
     "softmax_cross_entropy": lambda: ([T(4, 5), I(4, hi=5)], {}),
+    # loss layers take class-id labels, not data-shaped tensors — with a
+    # generic same-shape probe their custom-vjp backward broadcasts wrong
+    "SoftmaxOutput": lambda: ([T(4, 5), I(4, hi=5)], {}),
+    "SVMOutput": lambda: ([T(4, 5), I(4, hi=5)], {}),
     "BatchNorm": lambda: ([T(2, 3, 4, 4), T(3), T(3), T(3), T(3)], {}),
     "LayerNorm": lambda: ([T(2, 5), T(5), T(5)], {}),
     "GroupNorm": lambda: ([T(2, 4, 3, 3), T(4), T(4)], {"num_groups": 2}),
@@ -236,6 +240,9 @@ CASES["_npi_random_normal"] = lambda: ([], {"size": (3, 4)})
 CASES["_npi_random_randint"] = lambda: ([], {"low": 0, "high": 9,
                                              "size": (3, 4)})
 CASES["_np__random_shuffle"] = lambda: ([T(5, 2)], {})
+CASES["_npi_multinomial"] = lambda: ([nd.softmax(T(2, 5))], {"n": 3})
+CASES["_contrib_boolean_mask"] = lambda: (
+    [T(5, 3), nd.array([0, 1, 0, 1, 1])], {})
 CASES["_contrib_Proposal"] = lambda: (
     [nd.softmax(T(1, 6, 4, 4), axis=1), T(1, 12, 4, 4, lo=-0.1, hi=0.1),
      nd.array([[64, 64, 1.0]])],

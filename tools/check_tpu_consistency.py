@@ -8,7 +8,11 @@ OUTSIDE the test suite because tests/conftest.py forces the CPU
 platform. Probes the accelerator with a killable subprocess first
 (the tunnel can hang rather than fail) and emits one JSON line.
 
-Usage: python tools/check_tpu_consistency.py [--ops a,b,c]
+Usage: python tools/check_tpu_consistency.py [--ops a,b,c] [--json]
+
+--json swaps the one-line metric for the machine-readable findings
+report shared with mxlint and flakiness_checker --json (one finding per
+mismatching op).
 """
 import argparse
 import json
@@ -161,11 +165,31 @@ def _registry_sweep(args, jax, cpu_dev, accel):
                    "passed": n_pass, "failed": n_fail, "skipped": n_skip,
                    "total": len(report), "self_test": args.self_test,
                    "report": report}, f, indent=1)
-    print(json.dumps({"metric": "tpu_registry_consistency",
-                      "value": n_pass, "total": len(report),
-                      "failed": n_fail[:20], "n_failed": len(n_fail),
-                      "report_path": args.report}))
+    if args.as_json:
+        print(_findings_json(
+            [(r["op"], r.get("error", r["status"])) for r in report
+             if r["status"] in ("fail", "input_error")],
+            extra={"metric": "tpu_registry_consistency", "passed": n_pass,
+                   "total": len(report), "skipped": n_skip,
+                   "report_path": args.report}))
+    else:
+        print(json.dumps({"metric": "tpu_registry_consistency",
+                          "value": n_pass, "total": len(report),
+                          "failed": n_fail[:20], "n_failed": len(n_fail),
+                          "report_path": args.report}))
     return 0 if not n_fail else 2
+
+
+def _findings_json(failed_pairs, extra):
+    """The shared machine-readable findings schema (mxnet_tpu.passes
+    findings_report): one error finding per mismatching op."""
+    from mxnet_tpu.passes import Finding, findings_report
+    findings = [
+        Finding("consistency", "cpu-accel-mismatch", op, "error",
+                f"op '{op}' disagrees between cpu and accelerator: {msg}")
+        for op, msg in failed_pairs]
+    return findings_report("check_tpu_consistency", findings, extra=extra,
+                           as_json=True)
 
 
 def main(argv=None):
@@ -183,6 +207,8 @@ def main(argv=None):
     p.add_argument("--report", default=os.path.join(
         ROOT, "CONSISTENCY_SWEEP.json"),
         help="where --registry writes the per-op report artifact")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the shared machine-readable findings report")
     args = p.parse_args(argv)
 
     if args.self_test:
@@ -236,9 +262,15 @@ def main(argv=None):
             passed.append(name)
         except Exception as e:  # noqa: BLE001 — report, don't abort
             failed.append(f"{name}: {type(e).__name__}: {str(e)[:120]}")
-    print(json.dumps({"metric": "tpu_consistency",
-                      "value": len(passed), "total": len(selected),
-                      "failed": failed}))
+    if args.as_json:
+        print(_findings_json(
+            [(f.split(":")[0], f.split(":", 1)[1].strip()) for f in failed],
+            extra={"metric": "tpu_consistency", "passed": len(passed),
+                   "total": len(selected)}))
+    else:
+        print(json.dumps({"metric": "tpu_consistency",
+                          "value": len(passed), "total": len(selected),
+                          "failed": failed}))
     return 0 if not failed else 2
 
 

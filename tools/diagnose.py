@@ -91,6 +91,36 @@ def check_environment():
             print(f"{k}=\"{v}\"")
 
 
+def check_mxlint():
+    """Static-analysis health: run the fast (no-probe) registry audit and
+    report finding counts (tools/mxlint.py; see docs/passes.md)."""
+    print("----------mxlint Status----------")
+    import json
+    mxlint = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "mxlint.py")
+    try:
+        out = subprocess.run(
+            [sys.executable, mxlint, "--ops", "--no-probe", "--json"],
+            capture_output=True, text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("mxlint       : TIMED OUT")
+        return
+    if out.returncode not in (0, 2):
+        print(f"mxlint       : failed (rc={out.returncode}): "
+              f"{out.stderr.strip()[-200:]}")
+        return
+    try:
+        summary = json.loads(out.stdout)["summary"]
+    except (ValueError, KeyError) as e:
+        print(f"mxlint       : unparseable output ({e})")
+        return
+    status = "clean" if out.returncode == 0 else "FINDINGS"
+    print(f"mxlint       : {status} — {summary['error']} error(s), "
+          f"{summary['warn']} warning(s), {summary['info']} note(s) "
+          f"[static checks only; run `python tools/mxlint.py --all` "
+          f"for the full audit]")
+
+
 def main():
     check_python()
     check_pip()
@@ -98,6 +128,7 @@ def main():
     check_hardware()
     check_environment()
     check_mxnet()
+    check_mxlint()
 
 
 if __name__ == "__main__":

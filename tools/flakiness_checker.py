@@ -4,23 +4,43 @@ tools/flakiness_checker.py — repeated seeded runs of a single test).
 
 Usage:
   python tools/flakiness_checker.py tests/test_operators.py::test_foo \
-      [-n 20] [--seed 7]
+      [-n 20] [--seed 7] [--json]
+
+--json emits the machine-readable findings report shared with mxlint
+and check_tpu_consistency --json (one finding per failing trial).
 """
 import argparse
+import importlib.util
 import os
 import subprocess
 import sys
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-def run(test, n, seed=None):
+
+def _passes_mod():
+    """Load mxnet_tpu/passes standalone: the shared Finding/report
+    helpers have no package-level deps, so the checker stays light (no
+    jax import just to format a report)."""
+    path = os.path.join(ROOT, "mxnet_tpu", "passes", "__init__.py")
+    spec = importlib.util.spec_from_file_location("_mx_passes", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run(test, n, seed=None, as_json=False):
     import random as _random
     if seed is None:
         # vary the seed per trial by default — identical-environment
         # reruns can never surface seed-dependent flakiness
         seed = _random.randint(0, 2 ** 20)
-        print(f"base seed: {seed} (pass --seed {seed} to reproduce)")
+        if not as_json:
+            print(f"base seed: {seed} (pass --seed {seed} to reproduce)")
     env = dict(os.environ)
     failures = 0
+    findings = []
+    first_fail_tail = None
     for i in range(n):
         env["MXNET_TEST_SEED"] = str(seed + i)
         proc = subprocess.run(
@@ -28,12 +48,31 @@ def run(test, n, seed=None):
             env=env, capture_output=True, text=True)
         ok = proc.returncode == 0
         failures += 0 if ok else 1
-        print(f"run {i + 1}/{n}: {'PASS' if ok else 'FAIL'}"
-              + ("" if ok else f"  (seed {env.get('MXNET_TEST_SEED')})"))
-        if not ok and failures == 1:
-            print(proc.stdout[-1500:])
-    print(f"\n{n - failures}/{n} passed"
-          + (f" — FLAKY ({failures} failures)" if failures else ""))
+        if not ok:
+            findings.append({
+                "pass": "flakiness", "check": "failing-trial", "obj": test,
+                "severity": "error",
+                "message": (f"trial {i + 1}/{n} failed under "
+                            f"MXNET_TEST_SEED={seed + i}"),
+            })
+            if first_fail_tail is None:
+                first_fail_tail = proc.stdout[-1500:]
+        if not as_json:
+            print(f"run {i + 1}/{n}: {'PASS' if ok else 'FAIL'}"
+                  + ("" if ok else f"  (seed {env.get('MXNET_TEST_SEED')})"))
+            if not ok and failures == 1:
+                print(first_fail_tail)
+    if as_json:
+        passes = _passes_mod()
+        print(passes.findings_report(
+            "flakiness_checker", findings,
+            extra={"test": test, "trials": n, "base_seed": seed,
+                   "passed": n - failures,
+                   "first_fail_tail": first_fail_tail},
+            as_json=True))
+    else:
+        print(f"\n{n - failures}/{n} passed"
+              + (f" — FLAKY ({failures} failures)" if failures else ""))
     return failures
 
 
@@ -43,8 +82,10 @@ def main(argv=None):
     p.add_argument("-n", "--num-trials", type=int, default=10)
     p.add_argument("--seed", type=int, default=None,
                    help="base seed; trial i uses seed+i")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the shared machine-readable findings report")
     args = p.parse_args(argv)
-    return run(args.test, args.num_trials, args.seed)
+    return run(args.test, args.num_trials, args.seed, args.as_json)
 
 
 if __name__ == "__main__":

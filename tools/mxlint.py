@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""mxlint: run the static-analysis passes (mxnet_tpu/passes/) from the CLI.
+
+The pre-execution correctness gate the reference got from its NNVM graph
+passes, as a tool:
+
+  python tools/mxlint.py --ops                 # audit every registered op
+  python tools/mxlint.py model-symbol.json     # lint serialized graphs
+  python tools/mxlint.py --all                 # ops audit + framework
+                                               # self-check graphs/blocks
+  python tools/mxlint.py --all --json          # machine-readable findings
+                                               # (same schema as
+                                               # check_tpu_consistency
+                                               # --json / flakiness_checker
+                                               # --json)
+  python tools/mxlint.py --ops --load m.py     # import a module first
+                                               # (test fixtures register
+                                               # deliberately-bad ops)
+
+Exit codes: 0 clean, 2 findings at error severity (or warn under
+--strict), 1 usage/internal error.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_module(path):
+    spec = importlib.util.spec_from_file_location(
+        "mxlint_loaded_" + os.path.splitext(os.path.basename(path))[0], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _selfcheck_graph_findings():
+    """graphlint over a small composed network — exercises the Symbol
+    walker end-to-end; a clean corpus must lint clean."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.passes.graphlint import lint_symbol
+    x = sym.var("data")
+    net = sym.FullyConnected(x, num_hidden=8, name="fc1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    return lint_symbol(net)
+
+
+def _selfcheck_block_findings():
+    """tracercheck over a small hybridized block — a clean forward must
+    produce no tracer findings."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.passes.tracercheck import check_block
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=6))
+        net.add(nn.Dense(2, in_units=4))
+    net.initialize()
+    return [f for f in check_block(net, nd.zeros((2, 6)))
+            if f.check != "dynamic-shape"]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("graphs", nargs="*",
+                   help="symbol JSON files to lint (Symbol.tojson format)")
+    p.add_argument("--ops", action="store_true",
+                   help="audit every registered op's metadata (oplint)")
+    p.add_argument("--all", action="store_true",
+                   help="ops audit + graph/block framework self-checks")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the shared machine-readable findings report")
+    p.add_argument("--strict", action="store_true",
+                   help="exit non-zero on warnings too (default: errors)")
+    p.add_argument("--no-probe", action="store_true",
+                   help="static metadata checks only — skip the "
+                        "eval_shape/vjp probes (fast path)")
+    p.add_argument("--load", action="append", default=[], metavar="PY",
+                   help="import a python file before auditing (fixtures "
+                        "register known-bad ops)")
+    args = p.parse_args(argv)
+
+    if not (args.ops or args.all or args.graphs):
+        p.error("nothing to do: pass --ops, --all, or graph JSON files")
+
+    import mxnet_tpu  # noqa: F401 — populate the registry
+    from mxnet_tpu.passes import findings_report, severity_counts
+    from mxnet_tpu.passes.graphlint import lint_json
+    from mxnet_tpu.passes.oplint import OpRegistryAudit
+
+    for path in args.load:
+        _load_module(path)
+
+    findings = []
+    sections = []
+    if args.ops or args.all:
+        ops_findings = OpRegistryAudit(probe=not args.no_probe).run()
+        findings.extend(ops_findings)
+        from mxnet_tpu.ops.registry import _OPS
+        uniq = len({id(i) for i in _OPS.values()})
+        sections.append(("oplint", f"{uniq} unique ops "
+                                   f"({len(_OPS)} registered names)",
+                         ops_findings))
+    for path in args.graphs:
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError as e:
+            print(f"mxlint: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+        gf = lint_json(src)
+        findings.extend(gf)
+        sections.append(("graphlint", path, gf))
+    if args.all:
+        gf = _selfcheck_graph_findings()
+        findings.extend(gf)
+        sections.append(("graphlint", "<self-check net>", gf))
+        bf = _selfcheck_block_findings()
+        findings.extend(bf)
+        sections.append(("tracercheck", "<self-check block>", bf))
+
+    counts = severity_counts(findings)
+    if args.as_json:
+        print(findings_report(
+            "mxlint", findings,
+            extra={"sections": [{"pass": s, "target": t,
+                                 "n_findings": len(fl)}
+                                for s, t, fl in sections]},
+            as_json=True))
+    else:
+        for sect, target, fl in sections:
+            status = "clean" if not fl else f"{len(fl)} finding(s)"
+            print(f"== {sect}: {target} — {status}")
+            for f in fl:
+                print(f"  {f!r}")
+        print(f"mxlint: {counts['error']} error(s), {counts['warn']} "
+              f"warning(s), {counts['info']} note(s)")
+
+    bad = counts["error"] + (counts["warn"] if args.strict else 0)
+    return 2 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
