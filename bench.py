@@ -256,6 +256,7 @@ def main():
                  if n in trainer.trainable}, **trainer.opt_params)
             xv = xv.astype(jnp.bfloat16)
 
+    dev0_early = accel[0] if on_accel else devices[0]
     if on_accel:
         dev = accel[0]
         trainer.params = jax.device_put(trainer.params, dev)
@@ -319,6 +320,30 @@ def main():
 
     img_per_sec = n_steps * batch / dt
     step_s = dt / n_steps
+
+    # telemetry (docs/observability.md): the bench feeds the same
+    # process-wide metrics registry as Trainer.step, and appends one
+    # snapshot line to the MXNET_METRICS_EXPORT sink when configured —
+    # the stdout JSON-line contract below is unchanged
+    try:
+        from mxnet_tpu import telemetry as _telemetry
+        from mxnet_tpu.base import get_env as _get_env
+        _telemetry.metrics.counter(
+            "bench_step_total", "timed bench steps").inc(n_steps)
+        _telemetry.metrics.counter(
+            "bench_samples_total", "images through timed steps"
+            ).inc(n_steps * batch)
+        _telemetry.metrics.histogram(
+            "bench_step_seconds", "mean timed step latency"
+            ).observe(step_s)
+        _telemetry.metrics.gauge(
+            "bench_throughput_samples_per_sec",
+            "bench images/sec").set(img_per_sec)
+        _sink = _get_env("MXNET_METRICS_EXPORT", "")
+        if _sink:
+            _telemetry.export_jsonl(_sink, extra={"source": "bench"})
+    except Exception:
+        pass  # telemetry must never break the bench contract
 
     flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG * batch
     dev0 = accel[0] if on_accel else devices[0]

@@ -255,6 +255,22 @@ register_flag(
     "MXNET_PROFILER_MODE", int, 0,
     "Default profiler mode bitmask (ref: env_var.md).")
 register_flag(
+    "MXNET_PROFILER_TOPK", int, 0,
+    "Row cap for the profiler's aggregate statistics table and the "
+    "tools/mxprof.py default top-K; 0 = unlimited (profiler."
+    "get_summary / mxprof summarize).")
+register_flag(
+    "MXNET_METRICS_EXPORT", str, "",
+    "Path of the JSON-lines metrics sink; when set, gluon Trainer.step "
+    "and bench.py append one metrics snapshot line per step "
+    "(telemetry.record_step). Empty = export off.")
+register_flag(
+    "MXNET_TELEMETRY_MEMORY_INTERVAL", float, 0.0,
+    "Minimum seconds between automatic memory samples at step "
+    "boundaries (telemetry.memory.maybe_sample — the jax.live_arrays "
+    "census walks every buffer). 0 = sample every step while the "
+    "profiler's memory domain is on or a metrics sink is configured.")
+register_flag(
     "MXNET_USE_INT64_TENSOR_SIZE", bool, False,
     "Enable tensors with more than 2^31 elements / int64 indexing "
     "(ref: the INT64_TENSOR_SIZE build flag, env_var.md). Read at "

@@ -42,6 +42,7 @@ class Executor:
         self._last_is_train = False
         self._compiled = {}
         self._compiled_grad = {}
+        self._seen_sigs = set()  # recompile-auditor dedup (telemetry)
 
     # ------------------------------------------------------------------
     @property
@@ -88,6 +89,27 @@ class Executor:
                            else "MXNET_EXEC_BULK_EXEC_INFERENCE", True)
             self._compiled[key] = jax.jit(fn) if bulk else fn
         return self._compiled[key]
+
+    def _record_compile(self, which: str, is_train: bool):
+        """Recompile accounting (telemetry): called per execution, NOT
+        per dict miss — the jitted fn silently retraces whenever an
+        argument shape/dtype changes under it (reshape/_rebind), so the
+        auditor must key on the full argument signature to see the
+        retrace loops it exists to catch. Dedup via _seen_sigs keeps
+        the steady state at one set lookup per call."""
+        sig_key = (which, is_train,
+                   tuple((tuple(self.arg_dict[n].shape),
+                          str(self.arg_dict[n].dtype))
+                         for n in self._arg_names))
+        if sig_key in self._seen_sigs:
+            return
+        self._seen_sigs.add(sig_key)
+        from .telemetry import recompile as _recompile
+        sig = _recompile.signature_of(
+            [self.arg_dict[n] for n in self._arg_names], is_train)
+        head = (self._symbol.list_outputs() or ["?"])[0]
+        _recompile.record_recompile(
+            f"Executor:{head}:{which}", sig, kind="executor")
 
     def _get_compiled_grad(self, need_outputs=True):
         """Fused forward+backward (one XLA program ≙ the train-mode cached
@@ -141,6 +163,7 @@ class Executor:
                     v._data if isinstance(v, NDArray) else jnp.asarray(v))
         self._last_is_train = is_train
         fn = self._get_compiled(is_train)
+        self._record_compile("forward", is_train)
         rng = jax.random.key_data(_random.next_key())
         outs, aux_updates = fn(self._arg_values(), self._aux_values(), rng)
         for name, val in aux_updates.items():
@@ -160,6 +183,7 @@ class Executor:
             ograds = [g._data if isinstance(g, NDArray) else g
                       for g in out_grads]
         fb = self._get_compiled_grad()
+        self._record_compile("forward_backward", True)
         rng = jax.random.key_data(_random.next_key())
         outs, aux_updates, grads = fb(self._arg_values(), self._aux_values(),
                                       rng, tuple(ograds))

@@ -426,6 +426,15 @@ class HybridBlock(Block):
             # block.py:786 _build_cache's deferred-infer)
             return super(HybridBlock, self).__call__(*args)
         if key not in self._cached:
+            # recompile accounting (telemetry pillar 2): every cache
+            # miss of the CachedOp analog is counted and classified
+            # ("why did we recompile" — first compile vs shape/dtype/
+            # train-flag change) with the triggering signature
+            from ..telemetry import recompile as _recompile
+            _recompile.record_recompile(
+                f"{type(self).__name__}:{self.name}",
+                _recompile.signature_of(inputs, training),
+                kind="cached_op")
             try:
                 self._cached[key] = self._build_jit(args, training)
             except (jax.errors.ConcretizationTypeError,

@@ -104,12 +104,21 @@ class Trainer:
             self._optimizer.lr = lr
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """ref: trainer.py:305 — allreduce + update."""
+        """ref: trainer.py:305 — allreduce + update.
+
+        The step boundary is the telemetry heartbeat: step count/latency/
+        throughput counters update here, a throttled memory sample is
+        taken, and one metrics line goes to the MXNET_METRICS_EXPORT
+        sink when configured (telemetry.record_step)."""
+        import time as _time
+        from .. import telemetry as _telemetry
+        t0 = _time.perf_counter()
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        _telemetry.record_step(batch_size, _time.perf_counter() - t0)
 
     def allreduce_grads(self):
         """ref: trainer.py:334."""

@@ -40,6 +40,14 @@ def _key_str(key):
     return str(key)
 
 
+def _kv_timer(name: str):
+    """Histogram the data-plane call (telemetry pillar 3): push/pull
+    latency is where a slow DCN or an overloaded async server shows
+    up first."""
+    from .telemetry import timed_block
+    return timed_block(name, "kvstore data-plane latency (seconds)")
+
+
 class KVStoreBase:
     def __init__(self):
         self._updater = None
@@ -93,26 +101,28 @@ class KVStoreBase:
         return _wrap(total)
 
     def push(self, key, value, priority=0):
-        for k, vals in self._group(key, value).items():
-            agg = self._reduce(vals)
-            agg = self._global_reduce(k, agg)
-            if self._updater is not None:
-                if k not in self._store:
-                    raise MXNetError(f"key {k} was not init'd")
-                self._updater(_updater_key(k), agg, self._store[k])
-            else:
-                if k in self._store:
-                    self._store[k] += agg
+        with _kv_timer("kvstore_push_seconds"):
+            for k, vals in self._group(key, value).items():
+                agg = self._reduce(vals)
+                agg = self._global_reduce(k, agg)
+                if self._updater is not None:
+                    if k not in self._store:
+                        raise MXNetError(f"key {k} was not init'd")
+                    self._updater(_updater_key(k), agg, self._store[k])
                 else:
-                    self._store[k] = agg
+                    if k in self._store:
+                        self._store[k] += agg
+                    else:
+                        self._store[k] = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        for k, tgts in self._group(key, out).items():
-            if k not in self._store:
-                raise MXNetError(f"key {k} was not init'd")
-            src = self._store[k]
-            for t in tgts:
-                t._rebind(src._data.astype(t._data.dtype))
+        with _kv_timer("kvstore_pull_seconds"):
+            for k, tgts in self._group(key, out).items():
+                if k not in self._store:
+                    raise MXNetError(f"key {k} was not init'd")
+                src = self._store[k]
+                for t in tgts:
+                    t._rebind(src._data.astype(t._data.dtype))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only requested rows (ref: kvstore.py:248 row_sparse_pull —
@@ -292,15 +302,17 @@ class KVStoreDistAsync(KVStoreBase):
             self._client.request("init", k, v.asnumpy())
 
     def push(self, key, value, priority=0):
-        for k, vals in self._group(key, value).items():
-            agg = self._reduce(vals)  # local device shards only
-            self._client.request("push", k, agg.asnumpy())
+        with _kv_timer("kvstore_push_seconds"):
+            for k, vals in self._group(key, value).items():
+                agg = self._reduce(vals)  # local device shards only
+                self._client.request("push", k, agg.asnumpy())
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        for k, tgts in self._group(key, out).items():
-            cur = self._client.request("pull", k)
-            for t in tgts:
-                t._rebind(jnp.asarray(cur).astype(t._data.dtype))
+        with _kv_timer("kvstore_pull_seconds"):
+            for k, tgts in self._group(key, out).items():
+                cur = self._client.request("pull", k)
+                for t in tgts:
+                    t._rebind(jnp.asarray(cur).astype(t._data.dtype))
 
     def set_optimizer(self, optimizer):
         """Pickle the optimizer to the server — rank 0 only, exactly as

@@ -190,6 +190,13 @@ class KVServer:
             from . import profiler
             profiler.dump()
             return None
+        if cmd == "profiler_pause":
+            from . import profiler
+            if payload in ("1", b"1", 1, True):
+                profiler.pause()
+            else:
+                profiler.resume()
+            return None
         if cmd == "barrier":
             # failure detection (SURVEY §5.3): rather than hang forever
             # on a dead peer, surface a diagnosis — either on the

@@ -15,10 +15,10 @@ from .ndarray import (  # noqa: F401
 from .. import ops as _ops
 from ..ops.registry import list_ops as _list_ops, make_nd_function as _make
 
-_mod = _sys.modules[__name__]
+_this_module = _sys.modules[__name__]
 for _name in _list_ops():
-    if not hasattr(_mod, _name):
-        setattr(_mod, _name, _make(_name))
+    if not hasattr(_this_module, _name):
+        setattr(_this_module, _name, _make(_name))
 
 
 def __getattr__(name):
@@ -28,7 +28,7 @@ def __getattr__(name):
     from ..ops.registry import has_op
     if has_op(name):
         fn = _make(name)
-        setattr(_mod, name, fn)
+        setattr(_this_module, name, fn)
         return fn
     raise AttributeError(f"module 'mxnet_tpu.ndarray' has no "
                          f"attribute {name!r}")
@@ -58,8 +58,8 @@ class _Contrib:
             from ..ops import control_flow as _cf
             return getattr(_cf, name)
         for cand in (f"_contrib_{name}", name):
-            if hasattr(_mod, cand):
-                return getattr(_mod, cand)
+            if hasattr(_this_module, cand):
+                return getattr(_this_module, cand)
         raise AttributeError(name)
 
 

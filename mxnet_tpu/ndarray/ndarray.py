@@ -98,11 +98,14 @@ def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
     from .. import profiler as _prof
     was_recording = autograd.set_recording(False)  # no nested recording:
     try:   # ops whose impls re-enter the nd layer (control flow bodies)
-        if _prof.is_running() and _prof._config.get("profile_imperative",
-                                                    True):
+        if _prof._active() and _prof._domain_enabled("imperative") \
+                and not getattr(fn, "_mx_traced", False):
             # per-op event (ref: profiler operator events hooked into
-            # the engine, include/mxnet/engine.h:189)
-            with _prof.Scope(getattr(fn, "__name__", "op")):
+            # the engine, include/mxnet/engine.h:189) — registry-
+            # dispatched ops arrive already instrumented (_mx_traced,
+            # telemetry.tracing) and must not be double-counted
+            with _prof.Scope(getattr(fn, "__name__", "op"),
+                             domain="imperative"):
                 out = call(*in_arrays)
         else:
             out = call(*in_arrays)  # must not write tape tracer nodes

@@ -183,6 +183,14 @@ def make_nd_function(name: str) -> Callable:
             from ..ndarray.ndarray import _wrap as _w
             # raw uint32 key data: vjp-safe (int cotangents are float0)
             inputs.append(_w(_jax.random.key_data(next_key())))
+        # op-level tracing (telemetry pillar 1): when the profiler is
+        # running, the op body executes under jax.named_scope +
+        # TraceAnnotation so the MXNet op name lands in XProf, the HLO
+        # metadata of any enclosing jit trace, and the chrome-trace
+        # dump; maybe_instrument is the identity when the profiler is
+        # off (one branch on the hot path)
+        from ..telemetry.tracing import maybe_instrument as _instr
+        use_fn = _instr(name, use_fn)
         out = invoke(use_fn, inputs, n_out=n_out,
                      differentiable=info.differentiable, **rest_params)
         # Hide non-visible outputs in eager mode too (ref:
@@ -204,4 +212,8 @@ def make_nd_function(name: str) -> Callable:
     nd_fn.__name__ = name
     nd_fn.__qualname__ = name
     nd_fn.__doc__ = info.fn.__doc__
+    # marker for the dispatchlint pass: this is the instrumented registry
+    # path (op tracing + sparse dispatch + autograd); a module-level
+    # function shadowing a registered name lacks it and gets flagged
+    nd_fn._mx_registry_dispatch = True
     return nd_fn

@@ -7,15 +7,18 @@ TPU-native port defers everything to JAX tracing, so a malformed graph
 surfaces as an opaque TracerConversionError or XLA shape error deep
 inside jax.eval_shape. This package restores the pass layer as *static
 analysis first*: a small pass-manager over the existing Symbol DAG
-(symbol/symbol.py) and the op registry (ops/registry.py), with three
+(symbol/symbol.py) and the op registry (ops/registry.py), with four
 concrete analyses:
 
-- ``oplint``      — audits every registered OpInfo against its function
-                    (the FInferShape/FGradient attribute-consistency role);
-- ``graphlint``   — lints a bound Symbol with MXNet-style rich messages
-                    (the InferShape error-reporting capability);
-- ``tracercheck`` — hybridize()-time tracer-leak / concretization
-                    detection pointing at the user's source line.
+- ``oplint``       — audits every registered OpInfo against its function
+                     (the FInferShape/FGradient attribute-consistency role);
+- ``graphlint``    — lints a bound Symbol with MXNet-style rich messages
+                     (the InferShape error-reporting capability);
+- ``tracercheck``  — hybridize()-time tracer-leak / concretization
+                     detection pointing at the user's source line;
+- ``dispatchlint`` — flags registered ops whose nd dispatch bypasses the
+                     instrumented registry path (telemetry/op-tracing
+                     coverage, docs/observability.md).
 
 The walker/Finding skeleton is deliberately reusable: later optimisation
 passes (fusion grouping, sharding annotation — ROADMAP) plug into the
@@ -167,9 +170,10 @@ def findings_report(tool: str, findings: Iterable[Finding],
 # the default manager with the built-in analyses registered; import-time
 # cheap (passes hold no state until run)
 def default_manager() -> PassManager:
-    from . import oplint, graphlint, tracercheck
+    from . import oplint, graphlint, tracercheck, dispatchlint
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
     pm.register(tracercheck.TracerLeakCheck())
+    pm.register(dispatchlint.DispatchAudit())
     return pm

@@ -1,0 +1,86 @@
+"""dispatchlint: flag ops whose nd dispatch bypasses the instrumented
+registry path.
+
+The telemetry layer (mxnet_tpu/telemetry/tracing.py) instruments op
+execution inside ``make_nd_function`` — the generated ``nd.<op>``
+wrappers carry op-level tracing, sparse dispatch, amp casting and
+autograd recording. A module-level function in ``mxnet_tpu.ndarray``
+that shadows a registered op name silently opts that op out of ALL of
+it: no op-name events in the profile, no sparse fallback logging, and
+an op table that under-reports. (This pass caught a real one at birth:
+the module's ``_mod`` alias variable shadowed the registered ``_mod``
+modulo op, so ``nd._mod`` was a module object.)
+
+Some shadows are deliberate — host-side eager ops that cannot run under
+a jit trace (dynamic output shapes, OpenCV decode) document themselves
+in ``_KNOWN_EAGER_OVERRIDES`` and report at info severity so the
+exemption list stays visible in every audit; anything else is a warn.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding, Pass
+
+__all__ = ["DispatchAudit", "KNOWN_EAGER_OVERRIDES"]
+
+# registered-op names whose nd-level shadow is BY DESIGN, with the reason
+# the instrumented path cannot serve them; kept here (not at the shadow
+# site) so the audit prints the whole exemption surface in one place
+KNOWN_EAGER_OVERRIDES = {
+    "Custom": "dispatches user CustomOp python code (operator.py), not "
+              "a registry fn",
+    "_contrib_boolean_mask": "dynamic output shape; host-side gather "
+                             "with a tape custom_backward",
+    "_cvimdecode": "host-side image decode (bytes in, not a jax op)",
+    "_cvimread": "host-side file read",
+    "_npi_cvimdecode": "host-side image decode",
+    "_npi_cvimread": "host-side file read",
+    "concat": "hand-written NDArray-list API (variadic list calling "
+              "convention predates the registry wrapper)",
+    "dot": "hand-written to support sparse lhs dispatch directly",
+    "split": "returns a python list with num_outputs semantics",
+    "stack": "hand-written NDArray-list API",
+    "zeros_like": "thin eager invoke shim kept for keyword parity",
+    "ones_like": "thin eager invoke shim kept for keyword parity",
+}
+
+
+class DispatchAudit(Pass):
+    """For every registered op, verify ``nd.<name>`` is the instrumented
+    registry wrapper (``_mx_registry_dispatch``)."""
+
+    name = "dispatchlint"
+
+    def run(self, target=None) -> List[Finding]:
+        from ..ops.registry import _OPS
+        from .. import ndarray as nd_mod
+        ops = target if target is not None else _OPS
+        findings: List[Finding] = []
+        for name in sorted(ops):
+            try:
+                fn = getattr(nd_mod, name)
+            except AttributeError:
+                findings.append(self.finding(
+                    "missing-nd", name, "error",
+                    f"registered op has no nd.{name} attribute — the "
+                    f"codegen loop or __getattr__ fallback lost it"))
+                continue
+            if getattr(fn, "_mx_registry_dispatch", False):
+                continue
+            if name in KNOWN_EAGER_OVERRIDES:
+                findings.append(self.finding(
+                    "known-eager-override", name, "info",
+                    f"nd.{name} intentionally bypasses the instrumented "
+                    f"registry dispatch: {KNOWN_EAGER_OVERRIDES[name]}"))
+                continue
+            findings.append(self.finding(
+                "bypasses-dispatch", name, "warn",
+                f"nd.{name} is shadowed by "
+                f"{getattr(fn, '__module__', '?')}.{getattr(fn, '__name__', '?')} "
+                f"and bypasses the instrumented registry dispatch — op "
+                f"tracing, sparse fallback logging and amp casting all "
+                f"miss it; route it through make_nd_function or add a "
+                f"documented entry to "
+                f"dispatchlint.KNOWN_EAGER_OVERRIDES"))
+        return findings

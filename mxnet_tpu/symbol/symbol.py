@@ -608,9 +608,15 @@ def eval_graph(symbol: Symbol, value_map: Dict[str, "jax.Array"],
     bulking, engine push — graph_executor.cc:1016,1288,1384) becomes XLA's
     problem. Returns (outputs, aux_update_dict)."""
     from .. import random as _random
+    from ..telemetry import tracing as _tracing
 
     values: Dict[Tuple[int, int], object] = {}
     aux_updates: Dict[str, object] = {}
+    # symbolic-domain op tracing (telemetry pillar 1): under jit this
+    # trace runs ONCE, so the named_scope stamps each node's op name
+    # into the compiled HLO permanently; trace_ops is False when the
+    # profiler is off and the loop below pays nothing
+    trace_ops = _tracing.active("symbolic")
 
     def run():
         for node in symbol._topo_nodes():
@@ -627,7 +633,12 @@ def eval_graph(symbol: Symbol, value_map: Dict[str, "jax.Array"],
                 params["_training"] = training
             if info.needs_rng:
                 ins.append(jax.random.key_data(_random.next_key()))
-            out = info.fn(*ins, **params)
+            if trace_ops:
+                with _tracing.op_span(info.name, "symbolic",
+                                      node=node.name):
+                    out = info.fn(*ins, **params)
+            else:
+                out = info.fn(*ins, **params)
             outs = list(out) if isinstance(out, (tuple, list)) else [out]
             for i, o in enumerate(outs):
                 values[(id(node), i)] = o

@@ -1,0 +1,124 @@
+"""Memory accounting: live-array census + device memory-stats snapshots.
+
+The reference profiler's memory domain tracked the pooled allocator
+(ref: src/profiler/storage_profiler.h); under PJRT the allocator is
+opaque, but two cheap probes reconstruct the same story:
+
+- :func:`jax.live_arrays` — every live on-device buffer this process
+  holds, summed into ``memory_live_bytes`` (and a peak gauge);
+- ``device.memory_stats()`` — the PJRT allocator's own view
+  (``bytes_in_use`` / ``peak_bytes_in_use``) where the backend provides
+  it (TPU does; CPU returns None).
+
+:func:`sample` feeds the gauges and, when the profiler's memory domain
+is on, appends chrome-trace counter events (``ph: "C"``) so the dump
+renders a memory timeline. Sampling walks every live array, so it is
+throttled: :func:`maybe_sample` enforces the
+``MXNET_TELEMETRY_MEMORY_INTERVAL`` minimum spacing and is what the
+Trainer step boundary calls.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+
+from . import metrics as _metrics
+
+__all__ = ["live_bytes", "device_memory_stats", "sample", "maybe_sample",
+           "peak_bytes", "reset_peak"]
+
+_last_sample = [0.0]
+
+
+def live_bytes() -> Dict[str, int]:
+    """Census of live on-device buffers: {'bytes', 'arrays'}."""
+    total = 0
+    count = 0
+    try:
+        for a in jax.live_arrays():
+            total += getattr(a, "nbytes", 0)
+            count += 1
+    except Exception:  # backend torn down mid-walk
+        pass
+    return {"bytes": total, "arrays": count}
+
+
+def device_memory_stats() -> Optional[Dict[str, int]]:
+    """PJRT allocator stats of device 0, or None (CPU backends)."""
+    try:
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: v for k, v in stats.items()
+            if isinstance(v, (int, float))}
+
+
+def sample(emit_event: bool = True) -> Dict[str, object]:
+    """Take one memory sample: update gauges, optionally emit chrome
+    counter events (profiler running + memory domain enabled)."""
+    census = live_bytes()
+    _metrics.gauge("memory_live_bytes",
+                   "bytes held by live jax arrays").set(census["bytes"])
+    _metrics.gauge("memory_live_arrays",
+                   "count of live jax arrays").set(census["arrays"])
+    _metrics.gauge("memory_peak_bytes",
+                   "peak of memory_live_bytes since reset"
+                   ).max(census["bytes"])
+    stats = device_memory_stats()
+    if stats:
+        if "bytes_in_use" in stats:
+            _metrics.gauge("device_bytes_in_use",
+                           "PJRT allocator bytes in use"
+                           ).set(stats["bytes_in_use"])
+        if "peak_bytes_in_use" in stats:
+            _metrics.gauge("device_peak_bytes_in_use",
+                           "PJRT allocator peak bytes"
+                           ).set(stats["peak_bytes_in_use"])
+    _last_sample[0] = time.monotonic()
+    out = {"live": census, "device": stats}
+    if not emit_event:
+        return out
+    from .. import profiler as _prof
+    if _prof._active() and _prof._domain_enabled("memory"):
+        ts = time.perf_counter_ns() / 1000.0
+        ev = {"name": "memory", "ph": "C", "cat": "memory",
+              "pid": os.getpid(), "tid": threading.get_ident(), "ts": ts,
+              "args": {"live_bytes": census["bytes"],
+                       "live_arrays": census["arrays"]}}
+        if stats and "bytes_in_use" in stats:
+            ev["args"]["device_bytes_in_use"] = stats["bytes_in_use"]
+        _prof._append_event(ev)
+    return out
+
+
+def maybe_sample() -> Optional[Dict[str, object]]:
+    """Throttled :func:`sample` — the Trainer-step hook. Samples when at
+    least MXNET_TELEMETRY_MEMORY_INTERVAL seconds (default 0: every
+    call) passed since the last one; only runs at all when the profiler
+    is active with the memory domain on, or when a metrics export sink
+    is configured (the census is the cost, so idle processes skip it)."""
+    from ..base import get_env
+    from .. import profiler as _prof
+    profiling = _prof._active() and _prof._domain_enabled("memory")
+    exporting = bool(get_env("MXNET_METRICS_EXPORT", ""))
+    if not (profiling or exporting):
+        return None
+    interval = float(get_env("MXNET_TELEMETRY_MEMORY_INTERVAL", 0.0))
+    if interval > 0 and time.monotonic() - _last_sample[0] < interval:
+        return None
+    return sample()
+
+
+def peak_bytes() -> int:
+    return int(_metrics.gauge("memory_peak_bytes").value())
+
+
+def reset_peak():
+    _metrics.gauge("memory_peak_bytes").set(0)

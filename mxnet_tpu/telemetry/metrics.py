@@ -1,0 +1,229 @@
+"""Process-wide metrics registry: counters, gauges, histograms + exporters.
+
+The reference had no first-class metrics surface — throughput numbers
+lived in example scripts and the profiler's aggregate table. On TPU the
+numbers that decide whether a run is healthy (step time, recompiles,
+bytes in flight, kvstore latency) are cheap to count and expensive to
+reconstruct after the fact, so this module keeps one process-wide
+registry that the framework layers (gluon Trainer, kvstore, the
+recompile auditor, bench.py) feed at their natural boundaries.
+
+Two exporters:
+
+- :func:`to_json_lines` / :func:`export_jsonl` — one JSON object per
+  snapshot, append-friendly (the ``MXNET_METRICS_EXPORT`` path gets one
+  line per Trainer step);
+- :func:`to_prometheus` — Prometheus text exposition format
+  (``# TYPE``-annotated), for scraping out of a long-lived worker.
+
+All operations are O(1) under one lock; a counter increment is cheap
+enough to live on the kvstore push path.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
+           "all_metrics", "snapshot", "to_json_lines", "to_prometheus",
+           "export_jsonl", "reset_metrics"]
+
+_LOCK = threading.Lock()
+_METRICS: Dict[str, "Metric"] = {}
+
+
+class Metric:
+    """Base: a named, documented instrument."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, doc: str = ""):
+        self.name = name
+        self.doc = doc
+
+    def value(self):
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotone counter (steps taken, recompiles, samples seen)."""
+
+    kind = "counter"
+
+    def __init__(self, name, doc=""):
+        super().__init__(name, doc)
+        self._v = 0
+
+    def inc(self, n=1):
+        with _LOCK:
+            self._v += n
+
+    def value(self):
+        return self._v  # single-field read: atomic in CPython
+
+    def reset(self):
+        with _LOCK:
+            self._v = 0
+
+
+class Gauge(Metric):
+    """Point-in-time value (live bytes, throughput, learning rate)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, doc=""):
+        super().__init__(name, doc)
+        self._v = 0.0
+
+    def set(self, v):
+        with _LOCK:
+            self._v = v
+
+    def max(self, v):
+        """Set to max(current, v) — peak tracking."""
+        with _LOCK:
+            if v > self._v:
+                self._v = v
+
+    def value(self):
+        return self._v  # single-field read: atomic in CPython
+
+    def reset(self):
+        with _LOCK:
+            self._v = 0.0
+
+
+class Histogram(Metric):
+    """Streaming distribution: count / sum / min / max."""
+
+    kind = "histogram"
+
+    def __init__(self, name, doc=""):
+        super().__init__(name, doc)
+        self._reset_fields()
+
+    def _reset_fields(self):
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+
+    def observe(self, v):
+        v = float(v)
+        with _LOCK:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def value(self):
+        # multi-field read: lock so count/sum/avg are mutually
+        # consistent even against a concurrent observe()
+        with _LOCK:
+            if not self._count:
+                return {"count": 0, "sum": 0.0}
+            return {"count": self._count, "sum": self._sum,
+                    "min": self._min, "max": self._max,
+                    "avg": self._sum / self._count}
+
+    def reset(self):
+        with _LOCK:
+            self._reset_fields()
+
+
+def _get_or_create(cls, name: str, doc: str) -> Metric:
+    with _LOCK:
+        m = _METRICS.get(name)
+        if m is None:
+            m = cls(name, doc)
+            _METRICS[name] = m
+        elif not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}, requested {cls.__name__}")
+        return m
+
+
+def counter(name: str, doc: str = "") -> Counter:
+    return _get_or_create(Counter, name, doc)
+
+
+def gauge(name: str, doc: str = "") -> Gauge:
+    return _get_or_create(Gauge, name, doc)
+
+
+def histogram(name: str, doc: str = "") -> Histogram:
+    return _get_or_create(Histogram, name, doc)
+
+
+def all_metrics() -> Dict[str, Metric]:
+    with _LOCK:
+        return dict(_METRICS)
+
+
+def reset_metrics(clear: bool = False):
+    """Zero every instrument (tests); ``clear=True`` drops them."""
+    with _LOCK:
+        if clear:
+            _METRICS.clear()
+            return
+    for m in all_metrics().values():
+        m.reset()
+
+
+def snapshot() -> Dict[str, object]:
+    """{name: value} for every instrument; histogram values are dicts."""
+    return {name: m.value() for name, m in sorted(all_metrics().items())}
+
+
+def to_json_lines(extra: Optional[Dict[str, object]] = None) -> str:
+    """One JSON object: {"ts", "metrics": {...}, **extra} — a single
+    snapshot line of the JSON-lines export stream."""
+    line = {"ts": time.time(), "metrics": snapshot()}
+    if extra:
+        line.update(extra)
+    return json.dumps(line)
+
+
+def export_jsonl(path: str, extra: Optional[Dict[str, object]] = None):
+    """Append one snapshot line to ``path`` (the MXNET_METRICS_EXPORT
+    sink). Never raises — telemetry must not take down training."""
+    try:
+        with open(path, "a") as f:
+            f.write(to_json_lines(extra) + "\n")
+    except OSError:
+        pass
+
+
+def to_prometheus() -> str:
+    """Prometheus text exposition format of the current snapshot."""
+    lines: List[str] = []
+    for name, m in sorted(all_metrics().items()):
+        if m.doc:
+            lines.append(f"# HELP {name} {m.doc}")
+        if isinstance(m, Histogram):
+            lines.append(f"# TYPE {name} summary")
+            v = m.value()
+            lines.append(f"{name}_count {v['count']}")
+            lines.append(f"{name}_sum {v['sum']}")
+            if v["count"]:
+                lines.append(f"{name}_min {v['min']}")
+                lines.append(f"{name}_max {v['max']}")
+        else:
+            lines.append(f"# TYPE {name} {m.kind}")
+            lines.append(f"{name} {m.value()}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,121 @@
+"""Retrace auditor: count XLA recompiles and record WHY each happened.
+
+On TPU the silent performance killer is retracing: a hybridized block or
+executor that recompiles every step (loose shapes, a dtype flapping
+between fp32/bf16, a training-flag flip) spends its time in XLA, not on
+the MXU — and nothing in the reference's profiler surfaces it. Every
+jit-cache miss in the framework (``HybridBlock._call_cached``,
+``Executor._get_compiled*``) reports here with the signature that
+missed; the auditor diffs it against the entry's previous signatures and
+classifies the cause:
+
+- ``first-compile``    — the entry's first trace (expected, once);
+- ``shape-change``     — same dtypes/arity, different dims (the classic
+                         loose-batch retrace loop);
+- ``dtype-change``     — same shapes, different dtype (amp flapping);
+- ``train-flag``       — only the training mode differs (fwd vs fwd+bwd
+                         specialization — expected, twice);
+- ``cache-evicted``    — an already-seen signature compiled again (a
+                         hybridize()/cast() call dropped the cache);
+- ``signature-change`` — arity or input structure changed.
+
+Each record feeds (1) the ``recompile_total`` counter (always on),
+(2) a chrome-trace instant event ``recompile:<entry>`` with the
+triggering shapes when the profiler is running, and (3) an in-memory
+ring that ``recompile_report()`` / ``tools/mxprof.py`` render as the
+"why did we recompile" table.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["record_recompile", "recompile_count", "recompile_report",
+           "reset_recompiles", "signature_of"]
+
+_LOCK = threading.Lock()
+_HISTORY: Dict[str, List[dict]] = {}   # entry -> [signature, ...]
+_RECORDS: List[dict] = []              # ring of recompile records
+_MAX_RECORDS = 512
+
+
+def signature_of(inputs, training: Optional[bool] = None) -> dict:
+    """Normalize a jit-cache key: [{'shape', 'dtype'}...] + flags."""
+    sig = {"inputs": [{"shape": list(getattr(a, "shape", ())),
+                       "dtype": str(getattr(a, "dtype", "?"))}
+                      for a in inputs]}
+    if training is not None:
+        sig["training"] = bool(training)
+    return sig
+
+
+def _classify(entry: str, sig: dict) -> str:
+    prior = _HISTORY.get(entry)
+    if not prior:
+        return "first-compile"
+    s_in = sig["inputs"]
+    same_inputs = [p for p in prior if p["inputs"] == s_in]
+    if any(p.get("training") == sig.get("training") for p in same_inputs):
+        return "cache-evicted"  # seen before: hybridize()/cast() reset
+    if same_inputs:
+        return "train-flag"
+    for p in prior:
+        p_in = p["inputs"]
+        if len(p_in) != len(s_in):
+            continue
+        shapes_differ = any(a["shape"] != b["shape"]
+                            for a, b in zip(p_in, s_in))
+        dtypes_differ = any(a["dtype"] != b["dtype"]
+                            for a, b in zip(p_in, s_in))
+        if shapes_differ and not dtypes_differ:
+            return "shape-change"
+        if dtypes_differ and not shapes_differ:
+            return "dtype-change"
+    return "signature-change"
+
+
+def record_recompile(entry: str, signature: dict,
+                     kind: str = "cached_op") -> dict:
+    """Report one jit-cache miss. Returns the classified record."""
+    with _LOCK:
+        reason = _classify(entry, signature)
+        _HISTORY.setdefault(entry, []).append(signature)
+        record = {"entry": entry, "kind": kind, "reason": reason,
+                  "signature": signature, "ts": time.time(),
+                  "n_for_entry": len(_HISTORY[entry])}
+        _RECORDS.append(record)
+        del _RECORDS[:-_MAX_RECORDS]
+    _metrics.counter(
+        "recompile_total",
+        "jit-cache misses across CachedOp/Executor entry points").inc()
+    from .. import profiler as _prof
+    if _prof._active():
+        _prof._append_event({
+            "name": f"recompile:{entry}", "ph": "i", "s": "p",
+            "cat": "recompile", "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "ts": time.perf_counter_ns() / 1000.0,
+            "args": {"reason": reason, "kind": kind, **signature},
+        })
+    return record
+
+
+def recompile_count() -> int:
+    return _metrics.counter("recompile_total").value()
+
+
+def recompile_report() -> List[dict]:
+    """The recorded recompiles, oldest first (bounded ring)."""
+    with _LOCK:
+        return list(_RECORDS)
+
+
+def reset_recompiles():
+    with _LOCK:
+        _HISTORY.clear()
+        _RECORDS.clear()
+    _metrics.counter("recompile_total").reset()

@@ -121,6 +121,32 @@ def check_mxlint():
           f"for the full audit]")
 
 
+def check_telemetry():
+    """Runtime observability health: profiler state, metrics snapshot,
+    recompile count (mxnet_tpu/telemetry/; docs/observability.md)."""
+    print("----------Telemetry----------")
+    try:
+        from mxnet_tpu import profiler, telemetry
+    except Exception as e:
+        print("telemetry    : unavailable (%s)" % e)
+        return
+    state = "running" if profiler.is_running() else "stopped"
+    if profiler.is_paused():
+        state += " (paused)"
+    print("profiler     :", state)
+    enabled = [d for d in ("symbolic", "imperative", "memory", "api")
+               if profiler._domain_enabled(d)]
+    print("domains      :", ", ".join(enabled) or "none")
+    print("recompiles   :", telemetry.recompile_count())
+    snap = telemetry.snapshot()
+    print("metrics      :", len(snap), "instrument(s)")
+    for k, v in sorted(snap.items())[:10]:
+        print(f"  {k} = {v}")
+    from mxnet_tpu.base import get_env
+    sink = get_env("MXNET_METRICS_EXPORT", "")
+    print("export sink  :", sink or "(off)")
+
+
 def main():
     check_python()
     check_pip()
@@ -128,6 +154,7 @@ def main():
     check_hardware()
     check_environment()
     check_mxnet()
+    check_telemetry()
     check_mxlint()
 
 

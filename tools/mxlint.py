@@ -95,6 +95,7 @@ def main(argv=None):
 
     import mxnet_tpu  # noqa: F401 — populate the registry
     from mxnet_tpu.passes import findings_report, severity_counts
+    from mxnet_tpu.passes.dispatchlint import DispatchAudit
     from mxnet_tpu.passes.graphlint import lint_json
     from mxnet_tpu.passes.oplint import OpRegistryAudit
 
@@ -111,6 +112,13 @@ def main(argv=None):
         sections.append(("oplint", f"{uniq} unique ops "
                                    f"({len(_OPS)} registered names)",
                          ops_findings))
+        # telemetry-coverage audit: every registered op's nd dispatch
+        # must route through the instrumented registry path (or carry a
+        # documented eager-override exemption)
+        disp_findings = DispatchAudit().run()
+        findings.extend(disp_findings)
+        sections.append(("dispatchlint", "nd dispatch coverage",
+                         disp_findings))
     for path in args.graphs:
         try:
             with open(path) as f:

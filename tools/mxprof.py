@@ -1,0 +1,331 @@
+#!/usr/bin/env python
+"""mxprof: summarize a telemetry dump (chrome-trace JSON or metrics
+JSON-lines) from the command line.
+
+The reading half of mxnet_tpu/telemetry/: the profiler writes a
+chrome-trace dump whose events carry MXNet op names (tracing pillar),
+recompile instants with triggering shapes (recompile auditor), and
+memory counter samples; this tool renders the three reports the dump
+encodes:
+
+  python tools/mxprof.py summarize profile.json            # all three
+  python tools/mxprof.py summarize profile.json --top 10   # top-K cap
+  python tools/mxprof.py summarize profile.json --json     # machine-
+                                                           # readable
+  python tools/mxprof.py summarize metrics.jsonl           # metrics
+                                                           # sink lines
+
+--json emits the shared findings schema (mxnet_tpu.passes
+findings_report — same shape as mxlint/check_tpu_consistency/
+flakiness_checker --json): pathological patterns (recompile loops,
+monotone memory growth) surface as findings; the tables ride in the
+report's extra sections.
+
+Exit codes: 0 clean, 2 findings at error severity, 1 usage error.
+"""
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# a loose-shape entry that recompiles this often is a retrace loop
+RECOMPILE_LOOP_THRESHOLD = 4
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace analysis
+# ---------------------------------------------------------------------------
+
+def self_times(events):
+    """Per-name {count, total_us, self_us} from ph=X duration events.
+
+    Self time = duration minus the duration of events nested inside it
+    (same pid/tid, contained interval) — the chrome-trace flame-graph
+    convention, so an op that re-enters the nd layer doesn't double-
+    count its children.
+    """
+    stats = defaultdict(lambda: {"count": 0, "total_us": 0.0,
+                                 "self_us": 0.0})
+    by_track = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "X" and "dur" in e:
+            by_track[(e.get("pid"), e.get("tid"))].append(e)
+    for track in by_track.values():
+        track.sort(key=lambda e: (e["ts"], -e["dur"]))
+        open_evs = []  # stack of (end_ts, event) currently containing us
+        for e in track:
+            ts, dur = e["ts"], e["dur"]
+            while open_evs and open_evs[-1][0] <= ts:
+                open_evs.pop()
+            if open_evs:  # direct parent absorbs this child's duration
+                parent = open_evs[-1][1]
+                parent["child_us"] = parent.get("child_us", 0.0) + dur
+            open_evs.append((ts + dur, e))
+            s = stats[e["name"]]
+            s["count"] += 1
+            s["total_us"] += dur
+        for e in track:
+            stats[e["name"]]["self_us"] += \
+                e["dur"] - e.pop("child_us", 0.0)
+    return dict(stats)
+
+
+def top_ops_table(stats, top):
+    rows = sorted(stats.items(), key=lambda kv: -kv[1]["self_us"])
+    if top and top > 0:
+        rows = rows[:top]
+    lines = [f"{'Op':<40}{'Count':>8}{'Self (ms)':>12}{'Total (ms)':>12}"
+             f"{'Avg (ms)':>12}",
+             "-" * 84]
+    for name, s in rows:
+        lines.append(
+            f"{name[:39]:<40}{s['count']:>8}{s['self_us'] / 1e3:>12.4f}"
+            f"{s['total_us'] / 1e3:>12.4f}"
+            f"{s['total_us'] / s['count'] / 1e3:>12.4f}")
+    return "\n".join(lines)
+
+
+def recompile_records(events):
+    out = []
+    for e in events:
+        if e.get("cat") == "recompile" or \
+                str(e.get("name", "")).startswith("recompile:"):
+            args = e.get("args", {})
+            out.append({
+                "entry": str(e.get("name", ""))[len("recompile:"):],
+                "reason": args.get("reason", "?"),
+                "kind": args.get("kind", "?"),
+                "inputs": args.get("inputs", []),
+                "training": args.get("training"),
+                "ts": e.get("ts"),
+            })
+    return out
+
+
+def recompile_table(records):
+    lines = [f"{'Entry':<44}{'Reason':<18}{'Triggering shapes'}",
+             "-" * 96]
+    for r in records:
+        shapes = ",".join("x".join(map(str, i.get("shape", [])))
+                          or "scalar" for i in r["inputs"]) or "-"
+        lines.append(f"{r['entry'][:43]:<44}{r['reason']:<18}{shapes}")
+    by_entry = defaultdict(int)
+    for r in records:
+        by_entry[r["entry"]] += 1
+    lines.append("")
+    lines.append(f"total recompiles: {len(records)} across "
+                 f"{len(by_entry)} entr(ies)")
+    return "\n".join(lines)
+
+
+def memory_timeline(events):
+    samples = [(e["ts"], e.get("args", {}))
+               for e in events if e.get("ph") == "C"
+               and e.get("cat") == "memory"]
+    samples.sort()
+    return samples
+
+
+def memory_table(samples):
+    if not samples:
+        return "no memory counter samples in this dump"
+    vals = [a.get("live_bytes", 0) for _, a in samples]
+    lines = [f"samples: {len(samples)}  "
+             f"first: {vals[0]}  peak: {max(vals)}  last: {vals[-1]} "
+             f"(live bytes)"]
+    span = samples[-1][0] - samples[0][0]
+    width = 50
+    peak = max(vals) or 1
+    for ts, a in samples[:200]:
+        bar = "#" * max(1, int(width * a.get("live_bytes", 0) / peak))
+        rel = (ts - samples[0][0]) / 1e3
+        lines.append(f"  +{rel:>10.1f} ms  {a.get('live_bytes', 0):>14}  "
+                     f"{bar}")
+    if len(samples) > 200:
+        lines.append(f"  ... {len(samples) - 200} more samples")
+    if span <= 0 and len(samples) > 1:
+        lines.append("  (all samples share one timestamp)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# metrics JSON-lines analysis
+# ---------------------------------------------------------------------------
+
+def summarize_metrics_lines(lines):
+    """Fold a MXNET_METRICS_EXPORT stream: last snapshot + line count."""
+    last = None
+    n = 0
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metrics" in rec:
+            last = rec
+            n += 1
+    return {"n_snapshots": n, "last": last}
+
+
+# ---------------------------------------------------------------------------
+# findings (shared schema with mxlint)
+# ---------------------------------------------------------------------------
+
+def analyze(stats, recompiles, mem_samples):
+    """Pathology scan → passes.Finding list (the shared schema)."""
+    from mxnet_tpu.passes import Finding
+    findings = []
+    by_entry = defaultdict(list)
+    for r in recompiles:
+        by_entry[r["entry"]].append(r)
+    for entry, recs in by_entry.items():
+        shape_changes = [r for r in recs if r["reason"] == "shape-change"]
+        if len(shape_changes) >= RECOMPILE_LOOP_THRESHOLD:
+            shapes = [",".join("x".join(map(str, i.get("shape", [])))
+                               for i in r["inputs"])
+                      for r in shape_changes[:4]]
+            findings.append(Finding(
+                "mxprof", "recompile-loop", entry, "error",
+                f"{len(shape_changes)} shape-triggered recompiles "
+                f"(shapes: {shapes}); pad or bucket the loose dimension "
+                f"or this entry compiles every step"))
+        dtype_changes = [r for r in recs if r["reason"] == "dtype-change"]
+        if len(dtype_changes) >= 2:
+            findings.append(Finding(
+                "mxprof", "dtype-flapping", entry, "warn",
+                f"{len(dtype_changes)} dtype-triggered recompiles — an "
+                f"amp boundary is casting inconsistently"))
+    if len(mem_samples) >= 4:
+        vals = [a.get("live_bytes", 0) for _, a in mem_samples]
+        if all(b > a for a, b in zip(vals, vals[1:])):
+            findings.append(Finding(
+                "mxprof", "memory-growth", "live_bytes", "warn",
+                f"live bytes grew monotonically across all "
+                f"{len(vals)} samples ({vals[0]} -> {vals[-1]}); "
+                f"check for arrays retained across steps"))
+    return findings
+
+
+def summarize(path, top, as_json):
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head != "{":
+            report = {"file": path, "kind": "metrics",
+                      **summarize_metrics_lines(f)}
+            _emit_metrics(report, as_json)
+            return 0
+        first_line = f.readline()
+        try:
+            doc = json.loads(first_line)
+            # a single-line file may be a metrics snapshot line
+            if isinstance(doc, dict) and "metrics" in doc \
+                    and "traceEvents" not in doc:
+                f.seek(0)
+                report = {"file": path, "kind": "metrics",
+                          **summarize_metrics_lines(f)}
+                _emit_metrics(report, as_json)
+                return 0
+        except ValueError:
+            pass
+        f.seek(0)
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    stats = self_times(events)
+    recompiles = recompile_records(events)
+    mem = memory_timeline(events)
+    findings = analyze(stats, recompiles, mem)
+
+    if as_json:
+        from mxnet_tpu.passes import findings_report, severity_counts
+        rows = sorted(stats.items(), key=lambda kv: -kv[1]["self_us"])
+        if top and top > 0:
+            rows = rows[:top]
+        print(findings_report(
+            "mxprof", findings,
+            extra={"file": path,
+                   "top_ops": [{"name": n, **s} for n, s in rows],
+                   "recompiles": recompiles,
+                   "memory_samples": [
+                       {"ts": ts, **args} for ts, args in mem]},
+            as_json=True))
+    else:
+        print(f"== mxprof summarize: {path} ({len(events)} events)")
+        print()
+        print(f"-- top ops by self time (top {top or 'all'})")
+        print(top_ops_table(stats, top))
+        print()
+        print("-- recompile report")
+        print(recompile_table(recompiles))
+        print()
+        print("-- memory timeline")
+        print(memory_table(mem))
+        if findings:
+            print()
+            print("-- findings")
+            for fi in findings:
+                print(f"  {fi!r}")
+    from mxnet_tpu.passes import severity_counts
+    return 2 if severity_counts(findings)["error"] else 0
+
+
+def _emit_metrics(report, as_json):
+    if as_json:
+        from mxnet_tpu.passes import findings_report
+        print(findings_report("mxprof", [], extra=report, as_json=True))
+        return
+    print(f"== mxprof summarize: {report['file']} "
+          f"(metrics stream, {report['n_snapshots']} snapshot(s))")
+    last = report.get("last")
+    if last:
+        print("-- last snapshot")
+        for k, v in sorted(last.get("metrics", {}).items()):
+            print(f"  {k} = {v}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="mxprof", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd")
+    ps = sub.add_parser("summarize",
+                        help="render top-K ops / recompiles / memory "
+                             "from a dump")
+    ps.add_argument("dump", help="chrome-trace JSON (profiler.dump) or "
+                                 "metrics JSON-lines "
+                                 "(MXNET_METRICS_EXPORT)")
+    ps.add_argument("--top", type=int, default=None,
+                    help="rows in the op table (default: "
+                         "MXNET_PROFILER_TOPK, 0 = all)")
+    ps.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the shared machine-readable findings "
+                         "report")
+    args = p.parse_args(argv)
+    if args.cmd != "summarize":
+        p.error("nothing to do: use the summarize subcommand")
+    top = args.top
+    if top is None:
+        from mxnet_tpu.base import get_env
+        top = int(get_env("MXNET_PROFILER_TOPK", 0))
+    try:
+        return summarize(args.dump, top, args.as_json)
+    except OSError as e:
+        print(f"mxprof: cannot read {args.dump}: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"mxprof: {args.dump} is not valid JSON: {e}",
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
