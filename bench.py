@@ -67,12 +67,14 @@ def append_tpu_log(record):
         pass  # evidence log must never break the bench contract
 
 
-def _emit(value, unit="images/sec", vs=None, **extra):
-    line = {"metric": "resnet50_train_throughput",
+def _emit(value, unit="images/sec", vs=None,
+          metric="resnet50_train_throughput", **extra):
+    line = {"metric": metric,
             "value": value, "unit": unit,
             "vs_baseline": vs if vs is not None else (
                 round(value / BASELINE_IMG_PER_SEC, 3)
-                if isinstance(value, (int, float)) else None)}
+                if isinstance(value, (int, float))
+                and metric == "resnet50_train_throughput" else None)}
     line.update(extra)
     print(json.dumps(line))
     sys.stdout.flush()
@@ -424,6 +426,78 @@ def main():
         _emit(round(img_per_sec, 2), **record)
 
 
+def serving_main():
+    """Serving throughput/latency benchmark (MXTPU_BENCH_SERVING=1 or
+    --serving): closed-loop loadgen against an in-process warmed
+    ServingEngine — the mxserve pipeline end to end (bucket padding,
+    dynamic batching, compiled-program reuse). Emits ONE BENCH-schema
+    JSON line: metric mxserve_throughput in requests/sec, with p50/p99
+    latency, mean batch occupancy, and the after-warmup recompile count
+    (0 = the bucket ladder closed the jit cache; anything else is a
+    serving bug). Knobs: MXTPU_BENCH_SERVE_REQUESTS / _CONCURRENCY /
+    _FEATURE / _BUCKETS."""
+    jax, devices, probe_status = _init_jax()
+    accel = [d for d in devices if d.platform != "cpu"]
+    on_accel = bool(accel)
+
+    requests = int(os.environ.get("MXTPU_BENCH_SERVE_REQUESTS",
+                                  "400" if on_accel else "120"))
+    concurrency = int(os.environ.get("MXTPU_BENCH_SERVE_CONCURRENCY", "8"))
+    feature = int(os.environ.get("MXTPU_BENCH_SERVE_FEATURE", "64"))
+    buckets = os.environ.get("MXTPU_BENCH_SERVE_BUCKETS", "1,2,4,8")
+
+    import numpy as onp
+
+    from mxnet_tpu import gluon, nd, serve, telemetry
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(256, activation="relu", flatten=False))
+        net.add(gluon.nn.Dense(64, flatten=False))
+    net.initialize()
+    net(nd.zeros((1, feature)))  # resolve deferred shapes
+    engine = serve.ServingEngine(
+        net, input_specs=[(feature,)],
+        ladder=serve.parse_bucket_spec(buckets),
+        name="bench", max_linger_ms=1.0)
+
+    t0 = time.perf_counter()
+    report = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    recompiles_at_warmup = telemetry.recompile_count()
+
+    from mxnet_tpu.serve.loadgen import run_loadgen
+    rng = onp.random.RandomState(0)
+    payloads = [rng.uniform(-1, 1, size=(1 + (i % 4), feature))
+                .astype("float32") for i in range(requests)]
+    res = run_loadgen(
+        lambda p: engine.predict(p, timeout_ms=30000.0),
+        payloads, concurrency=concurrency)
+    wall = res["wall_s"]
+
+    stats = engine.stats()
+    record = dict(
+        metric="mxserve_throughput", requests=requests,
+        completed=res["completed"], errors=len(res["errors"]),
+        concurrency=concurrency, feature=feature, buckets=buckets,
+        p50_ms=round(res["p50_ms"], 3),
+        p99_ms=round(res["p99_ms"], 3),
+        warmup_s=round(warmup_s, 3), programs=len(report),
+        avg_occupancy=round(stats["batcher"]["avg_occupancy"], 3),
+        recompiles_after_warmup=stats["recompiles_after_warmup"],
+        recompiles_during_load=telemetry.recompile_count()
+        - recompiles_at_warmup,
+        platform=(accel[0].platform if on_accel else "cpu"),
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    if not on_accel and probe_status.startswith("failed"):
+        record["degraded"] = "tpu_unreachable"
+    value = round(res["completed"] / wall, 2) if res["completed"] else None
+    if on_accel:
+        append_tpu_log(dict(value=value, unit="requests/sec", **record))
+    engine.close()
+    _emit(value, unit="requests/sec", **record)
+
+
 def _parent():
     """Run the bench in a KILLABLE subprocess and own the one-JSON-line
     contract. A SIGALRM watchdog cannot interrupt a hang inside C code
@@ -431,6 +505,12 @@ def _parent():
     that is exactly the round-1 rc=124 failure mode."""
     import subprocess
     timeout = int(os.environ.get("MXTPU_BENCH_TIMEOUT", "1500"))
+    # failure lines must carry the metric of the bench that was RUN —
+    # a serving-bench timeout labeled resnet50_train_throughput would
+    # corrupt the BENCH schema's attribution
+    metric = ("mxserve_throughput"
+              if os.environ.get("MXTPU_BENCH_SERVING") == "1"
+              else "resnet50_train_throughput")
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
                               "--child"], timeout=timeout,
@@ -440,7 +520,7 @@ def _parent():
                 print(ln)
                 sys.stdout.flush()
                 return
-        _emit(None, vs=None, degraded="bench_failed",
+        _emit(None, vs=None, metric=metric, degraded="bench_failed",
               error=f"child rc={res.returncode}, no JSON line")
     except subprocess.TimeoutExpired as te:
         # the child emits the measured throughput BEFORE enrichment;
@@ -457,18 +537,27 @@ def _parent():
                 print(ln)
                 sys.stdout.flush()
                 return
-        _emit(None, vs=None, degraded="bench_timeout",
+        _emit(None, vs=None, metric=metric, degraded="bench_timeout",
               error=f"bench timed out after {timeout}s")
     except Exception as e:
-        _emit(None, vs=None, error=f"{type(e).__name__}: {e}"[:500])
+        _emit(None, vs=None, metric=metric,
+              error=f"{type(e).__name__}: {e}"[:500])
 
 
 if __name__ == "__main__":
+    # --serving / MXTPU_BENCH_SERVING=1 selects the mxserve loadgen
+    # bench (serving_main); the env form propagates into the child
+    if "--serving" in sys.argv:
+        os.environ["MXTPU_BENCH_SERVING"] = "1"
+    _serving = os.environ.get("MXTPU_BENCH_SERVING") == "1"
     if "--child" in sys.argv:
         try:
-            main()
+            serving_main() if _serving else main()
         except Exception as e:
-            _emit(None, vs=None, error=f"{type(e).__name__}: {e}"[:500])
+            _emit(None, vs=None,
+                  metric=("mxserve_throughput" if _serving
+                          else "resnet50_train_throughput"),
+                  error=f"{type(e).__name__}: {e}"[:500])
             sys.exit(0)
     else:
         _parent()

@@ -111,6 +111,7 @@ from . import monitor  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from . import profiler  # noqa: F401
 from . import telemetry  # noqa: F401  (op tracing, recompile/memory accounting, metrics)
+from . import serve  # noqa: F401  (dynamic-batching inference serving)
 from . import rtc  # noqa: F401
 from . import subgraph  # noqa: F401
 from . import executor_manager  # noqa: F401

@@ -292,6 +292,29 @@ register_flag(
     "over the Pallas flash kernel (operator_tune.choose). An unknown "
     "label raises, listing the candidates.")
 register_flag(
+    "MXSERVE_BUCKETS", str, "1,2,4,8,16,32",
+    "Shape-bucket ladder for the serving subsystem (serve.buckets."
+    "default_ladder): batch rungs as a comma list, or named axes as "
+    "'batch:1,2,4,8;seq:16,32,64' where axis<k> addresses BATCHED-"
+    "array axis k, i.e. item axis k-1 (seq = axis1). Requests are "
+    "padded up to the next rung so the serving jit cache CLOSES after "
+    "warmup (docs/serving.md).")
+register_flag(
+    "MXSERVE_MAX_LINGER_MS", float, 2.0,
+    "Max milliseconds the serving batcher waits for co-batchable "
+    "requests before dispatching a partial batch (serve.batcher) — "
+    "the cap on batching-added latency; keep ~ one device step time.")
+register_flag(
+    "MXSERVE_QUEUE_DEPTH", int, 256,
+    "Bounded serving-queue capacity (serve.batcher). A submit against "
+    "a full queue is rejected immediately with QueueFullError "
+    "(HTTP 429 at the endpoint) — load-shed backpressure, never "
+    "unbounded blocking.")
+register_flag(
+    "MXSERVE_MAX_BATCH", int, 0,
+    "Row cap per serving dispatch (serve.batcher). 0 (default) = the "
+    "ladder's top batch rung.")
+register_flag(
     "MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
     "Seconds a worker waits at a dist barrier before declaring the "
     "job failed (failure detection, SURVEY.md §5.3; the reference's "

@@ -147,6 +147,28 @@ class Executor:
             self._compiled_grad["fb"] = jax.jit(fb)
         return self._compiled_grad["fb"]
 
+    def compile_signature(self, is_train: bool = False):
+        """Compile-by-signature warmup hook (mxserve): compile the
+        forward program for the executor's CURRENT argument shapes and
+        dtypes by running it ONCE with the current buffer contents,
+        discarding outputs and aux updates (warmup must not mutate
+        state). One real execution is the only way to warm jax's jit
+        dispatch cache — an AOT ``lower().compile()`` populates a
+        separate cache and the first real forward would pay the full
+        compile again. The compile is recorded with the recompile
+        auditor like a first forward, and the signature is
+        deduplicated, so subsequent real traffic on this signature
+        counts zero recompiles. Returns self."""
+        fn = self._get_compiled(is_train)
+        self._record_compile("forward", is_train)
+        # throwaway key, NOT _random.next_key(): consuming the global
+        # stream would make warmed and unwarmed runs draw different
+        # randomness downstream
+        rng = jax.random.key_data(jax.random.key(0))
+        outs, _aux_updates = fn(self._arg_values(), self._aux_values(), rng)
+        jax.block_until_ready(outs)
+        return self
+
     # ------------------------------------------------------------------
     # execution (ref: GraphExecutor::Forward :78 / Backward :91)
     # ------------------------------------------------------------------

@@ -550,6 +550,44 @@ class HybridBlock(Block):
                 warnings.warn(msg)
         return jitted
 
+    def compile_signature(self, input_shapes, dtypes="float32",
+                          training=False):
+        """AOT compile-by-signature hook (mxserve warmup): populate the
+        hybridize jit cache for ONE input signature using zero-filled
+        inputs, without real data. ``input_shapes`` is one shape tuple
+        or a list of them (full shapes, batch axis included); ``dtypes``
+        a matching dtype or list. The compile is recorded by the
+        recompile auditor as usual (classified ``first-compile`` during
+        warmup) and later real traffic on the signature is a cache hit.
+
+        Requires an active ``hybridize()`` — without it there is no jit
+        cache to warm — and resolved parameter shapes (run one forward,
+        or let deferred init resolve from the zeros here)."""
+        if not self._active:
+            raise MXNetError(
+                f"{type(self).__name__}.compile_signature: call "
+                "hybridize() first — eager blocks have no jit cache to "
+                "warm")
+        from ..ndarray.ndarray import zeros as nd_zeros
+        shapes = [input_shapes] if input_shapes and \
+            isinstance(input_shapes[0], int) else list(input_shapes)
+        if isinstance(dtypes, str):
+            dtypes = [dtypes] * len(shapes)
+        args = [nd_zeros(tuple(s), dtype=d)
+                for s, d in zip(shapes, dtypes)]
+        with autograd._Scope(False, training):
+            self(*args)
+        return self
+
+    def as_serving_engine(self, input_specs=None, **kwargs):
+        """Export-to-engine path: wrap this block in a
+        :class:`~mxnet_tpu.serve.engine.ServingEngine` (bucketed,
+        batched, warmed inference — docs/serving.md). ``input_specs``
+        are per-item shapes (no batch axis); remaining kwargs go to the
+        engine (ladder, max_linger_ms, ...)."""
+        from ..serve import ServingEngine
+        return ServingEngine(self, input_specs=input_specs, **kwargs)
+
     def forward(self, x, *args):
         """ref: block.py:941 — dispatches hybrid_forward with F=nd for
         NDArray inputs, F=sym for Symbol inputs (the export trace)."""

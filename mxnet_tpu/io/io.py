@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import namedtuple
 from typing import Dict, List, Optional
 
@@ -233,6 +234,15 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
+class _PrefetchError:
+    """Queue sentinel carrying a worker-thread exception to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class PrefetchingIter(DataIter):
     """ref: io.py:617 PrefetchingIter — background-thread double buffering
     (the role of src/io/iter_prefetcher.h)."""
@@ -245,20 +255,34 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        self.prefetch_depth = prefetch_depth
         self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = None
         self._start()
 
     def _start(self):
+        # the worker binds ITS epoch's queue/stop-event, not self._…:
+        # if reset() times out joining a worker that is stuck in a slow
+        # it.next(), the straggler's final put() lands in the orphaned
+        # old queue instead of poisoning the new epoch with a stale batch
+        q, stop = self._queue, self._stop
+
         def worker():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batches = [it.next() for it in self.iters]
-                    self._queue.put(batches)
                 except StopIteration:
-                    self._queue.put(None)
+                    q.put(None)
                     return
+                except BaseException as e:  # noqa: BLE001
+                    # a dying worker must not strand the consumer: ship
+                    # the exception through the queue (next() re-raises
+                    # it) instead of exiting silently and leaving
+                    # queue.get() blocked forever
+                    q.put(_PrefetchError(e))
+                    return
+                q.put(batches)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -282,24 +306,44 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
+        # drain-and-join until the worker is dead (bounded): each drain
+        # unblocks a worker stuck in queue.put, letting it see the stop
+        # event. A worker stuck >5 s inside it.next() is abandoned as a
+        # straggler — it holds the OLD queue/stop bindings (see _start)
+        # so it cannot poison the new epoch's queue, but it may still
+        # race it.reset() on the shared underlying iterators; nothing
+        # short of an unbounded wait can close that, so we bound.
         self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=1.0)
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            if self._thread is None or not self._thread.is_alive() \
+                    or time.monotonic() > deadline:
+                break
+            self._thread.join(timeout=0.1)
         for it in self.iters:
             it.reset()
         self._stop = threading.Event()
-        self._queue = queue.Queue(maxsize=2)
+        self._queue = queue.Queue(maxsize=self.prefetch_depth)
         self._start()
 
     def next(self):
         batches = self._queue.get()
         if batches is None:
+            # re-enqueue the one-shot end marker: the worker is dead, so
+            # a second next() after exhaustion must raise StopIteration
+            # again instead of blocking forever on an empty queue
+            self._queue.put(None)
             raise StopIteration
+        if isinstance(batches, _PrefetchError):
+            # keep the sentinel available so every subsequent next()
+            # fails the same way instead of blocking on an empty queue
+            self._queue.put(batches)
+            raise batches.exc
         if len(batches) == 1:
             return batches[0]
         return DataBatch(

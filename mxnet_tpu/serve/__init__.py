@@ -1,0 +1,35 @@
+"""mxnet_tpu.serve: dynamic-batching inference serving (ISSUE 3).
+
+The request-level vertical slice the ROADMAP's "heavy traffic from
+millions of users" north star needs — everything between an HTTP request
+and a warmed XLA program:
+
+- :mod:`~mxnet_tpu.serve.buckets` — shape bucketing: pad requests onto a
+  closed ladder of batch/sequence shapes so the jit cache CLOSES after
+  warmup (zero steady-state recompiles, asserted via the PR 2 auditor);
+- :mod:`~mxnet_tpu.serve.batcher` — thread-safe dynamic micro-batching:
+  max batch, max linger, per-request deadlines, bounded queue with
+  load-shed backpressure;
+- :mod:`~mxnet_tpu.serve.engine` — :class:`ServingEngine`: AOT warmup
+  over every ladder rung, donated input buffers, double-buffered
+  dispatch, over a Gluon block / bound Executor / plain callable;
+- :mod:`~mxnet_tpu.serve.endpoint` — multi-model registry + stdlib
+  ``http.server`` JSON endpoint with health/readiness, Prometheus
+  metrics, and graceful drain.
+
+``tools/mxserve.py`` is the CLI (serve / warmup / loadgen); see
+docs/serving.md for architecture and the bucket-ladder tuning guide.
+"""
+from .batcher import (BatcherStoppedError, DeadlineExceededError,  # noqa: F401
+                      DynamicBatcher, QueueFullError, Request)
+from .buckets import (BucketLadder, BucketOverflowError,  # noqa: F401
+                      default_ladder, parse_bucket_spec)
+from .endpoint import ModelRegistry, ServingEndpoint  # noqa: F401
+from .engine import InputSpec, ServingEngine  # noqa: F401
+
+__all__ = [
+    "BucketLadder", "BucketOverflowError", "parse_bucket_spec",
+    "default_ladder", "DynamicBatcher", "Request", "QueueFullError",
+    "DeadlineExceededError", "BatcherStoppedError", "ServingEngine",
+    "InputSpec", "ModelRegistry", "ServingEndpoint",
+]

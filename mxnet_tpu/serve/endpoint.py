@@ -1,0 +1,275 @@
+"""Multi-model registry + stdlib HTTP JSON endpoint.
+
+A thin, dependency-free front door for :class:`ServingEngine`:
+``http.server.ThreadingHTTPServer`` (one thread per connection — the
+dynamic batcher is what turns that concurrency into batched device
+dispatches) with the conventional serving surface:
+
+- ``GET  /healthz``                     — liveness (always 200 while up)
+- ``GET  /readyz``                      — readiness: 200 only when every
+  registered engine is warmed and the endpoint is not draining
+- ``GET  /metrics``                     — Prometheus exposition of the
+  PR 2 metrics registry (queue depth, occupancy, p50/p99, recompiles)
+- ``GET  /v1/models``                   — model list + stats
+- ``GET  /v1/models/<name>``            — one model's stats
+- ``POST /v1/models/<name>:predict``    — ``{"inputs": ...}`` →
+  ``{"outputs": ...}``
+- ``POST /v1/models/<name>:warmup``     — run AOT warmup, return report
+- ``POST /admin/drain``                 — graceful drain: readiness goes
+  503, queues flush, in-flight requests finish, then the server stops.
+
+JSON body for predict: ``inputs`` is a (nested) list for single-input
+models, or a list of such per input for multi-input models (dtype comes
+from the engine's input specs). Row results come back as nested lists.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..telemetry import metrics as _metrics
+from .batcher import (BatcherStoppedError, DeadlineExceededError,
+                      QueueFullError)
+from .engine import ServingEngine
+
+__all__ = ["ModelRegistry", "ServingEndpoint"]
+
+
+class ModelRegistry:
+    """Thread-safe name → :class:`ServingEngine` map."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, ServingEngine] = {}
+
+    def register(self, name: str, engine: ServingEngine,
+                 warmup: bool = False) -> ServingEngine:
+        if warmup and not engine.warmed:
+            engine.warmup()
+        with self._lock:
+            if name in self._models:
+                raise MXNetError(f"model {name!r} already registered")
+            self._models[name] = engine
+            count = len(self._models)
+        _metrics.gauge("mxserve_models_registered",
+                       "engines in the serving registry").set(count)
+        return engine
+
+    def unregister(self, name: str, close: bool = True) -> None:
+        with self._lock:
+            engine = self._models.pop(name, None)
+            count = len(self._models)
+        if engine is None:
+            raise MXNetError(f"model {name!r} not registered")
+        if close:
+            engine.close()
+        _metrics.gauge("mxserve_models_registered", "").set(count)
+
+    def get(self, name: str) -> ServingEngine:
+        with self._lock:
+            engine = self._models.get(name)
+            have = sorted(self._models)
+        if engine is None:
+            raise MXNetError(f"model {name!r} not registered "
+                             f"(have: {have})")
+        return engine
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def engines(self) -> List[ServingEngine]:
+        with self._lock:
+            return list(self._models.values())
+
+    def items(self) -> List:
+        """Consistent (name, engine) snapshot in one lock acquisition —
+        handlers iterate this, never names()+get() (a concurrent
+        unregister between the two would raise mid-response)."""
+        with self._lock:
+            return sorted(self._models.items())
+
+    def all_ready(self) -> bool:
+        with self._lock:
+            engines = list(self._models.values())
+        return all(e.warmed for e in engines)
+
+
+def _json_bytes(obj) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the endpoint instance is attached to the server object
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if self.server.endpoint.verbose:  # type: ignore[attr-defined]
+            super().log_message(fmt, *args)
+
+    # -- helpers -------------------------------------------------------
+    def _send(self, code: int, obj):
+        body = _json_bytes(obj)
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _endpoint(self) -> "ServingEndpoint":
+        return self.server.endpoint  # type: ignore[attr-defined]
+
+    # -- routes --------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — http.server API
+        ep = self._endpoint()
+        path = self.path.split("?")[0]
+        if path == "/healthz":
+            return self._send(200, {"status": "alive"})
+        if path == "/readyz":
+            if ep.draining:
+                return self._send(503, {"status": "draining"})
+            if not ep.registry.all_ready():
+                return self._send(
+                    503, {"status": "warming",
+                          "models": {n: e.warmed
+                                     for n, e in ep.registry.items()}})
+            return self._send(200, {"status": "ready"})
+        if path == "/metrics":
+            text = _metrics.to_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            self.wfile.write(text)
+            return
+        if path == "/v1/models":
+            return self._send(200, {
+                "models": [e.stats()
+                           for _, e in ep.registry.items()]})
+        if path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):]
+            try:
+                return self._send(200, ep.registry.get(name).stats())
+            except MXNetError as e:
+                return self._send(404, {"error": str(e)})
+        return self._send(404, {"error": f"no route {path!r}"})
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        ep = self._endpoint()
+        path = self.path.split("?")[0]
+        if path == "/admin/drain":
+            threading.Thread(target=ep.drain, daemon=True).start()
+            return self._send(202, {"status": "draining"})
+        if path.startswith("/v1/models/") and ":" in path:
+            name, _, verb = path[len("/v1/models/"):].rpartition(":")
+            try:
+                engine = ep.registry.get(name)
+            except MXNetError as e:
+                return self._send(404, {"error": str(e)})
+            if verb == "warmup":
+                try:
+                    return self._send(200, {"report": engine.warmup()})
+                except MXNetError as e:
+                    return self._send(400, {"error": str(e)})
+            if verb == "predict":
+                return self._predict(ep, engine)
+            return self._send(404, {"error": f"unknown verb {verb!r}"})
+        return self._send(404, {"error": f"no route {path!r}"})
+
+    def _predict(self, ep: "ServingEndpoint", engine: ServingEngine):
+        if ep.draining:
+            return self._send(503, {"error": "endpoint is draining"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise TypeError(
+                    f"body must be a JSON object, got "
+                    f"{type(payload).__name__}")
+            inputs = payload["inputs"]
+        except (ValueError, KeyError, TypeError) as e:
+            return self._send(400, {"error": f"bad JSON body: {e}"})
+        specs = engine.input_specs
+        try:
+            if specs and len(specs) > 1:
+                data = [onp.asarray(x, dtype=s.dtype)
+                        for x, s in zip(inputs, specs)]
+            else:
+                dtype = specs[0].dtype if specs else "float32"
+                data = onp.asarray(inputs, dtype=dtype)
+        except (ValueError, TypeError) as e:
+            return self._send(400, {"error": f"bad inputs: {e}"})
+        t0 = time.perf_counter()
+        try:
+            out = engine.predict(
+                data, timeout_ms=payload.get("timeout_ms"))
+        except QueueFullError as e:
+            return self._send(429, {"error": str(e)})
+        except DeadlineExceededError as e:
+            return self._send(504, {"error": str(e)})
+        except BatcherStoppedError as e:
+            return self._send(503, {"error": str(e)})
+        except MXNetError as e:
+            return self._send(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — model/jax errors: the
+            # client must get a JSON 500, not a dropped connection
+            return self._send(500, {"error": f"{type(e).__name__}: {e}"})
+        outs = [o.tolist() for o in out] if isinstance(out, list) \
+            else out.tolist()
+        return self._send(200, {
+            "outputs": outs, "model": engine.name,
+            "latency_ms": round((time.perf_counter() - t0) * 1000.0, 3)})
+
+
+class ServingEndpoint:
+    """The HTTP front door. ``start()`` serves on a background thread;
+    ``drain()`` performs the graceful-shutdown dance."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 8080,
+                 verbose: bool = False):
+        self.registry = registry or ModelRegistry()
+        self.verbose = verbose
+        self.draining = False
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._server.endpoint = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self, background: bool = True):
+        if background:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="mxserve-endpoint", daemon=True)
+            self._thread.start()
+        else:
+            self._server.serve_forever()
+        return self
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        """Graceful drain: readiness flips to 503 (load balancers stop
+        routing), every engine's batcher flushes, then the listener
+        stops. Returns True when every queue drained in time."""
+        self.draining = True
+        ok = all(e.drain(timeout) for e in self.registry.engines())
+        self.stop()
+        return ok
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

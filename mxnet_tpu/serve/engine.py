@@ -1,0 +1,525 @@
+"""ServingEngine: a warmed, bucketed, batched inference unit.
+
+One engine owns one model and everything between a request and the MXU:
+
+- the **bucket ladder** (:mod:`~mxnet_tpu.serve.buckets`) that pads every
+  dispatch onto a closed set of shapes;
+- the **dynamic batcher** (:mod:`~mxnet_tpu.serve.batcher`) that
+  coalesces concurrent requests into one dispatch;
+- the **compiled-program cache**, AOT-populated by :meth:`warmup` over
+  every ladder rung so steady-state traffic never compiles
+  (``recompile_after_warmup`` is the alarm metric — it should stay 0);
+- **reusable staging buffers**: a pair of host staging buffers per
+  signature alternates across dispatches — no per-dispatch allocation,
+  and one dispatch of headroom so an asynchronously-launched program
+  that zero-copy-aliased its host buffer is never overwritten by the
+  immediately following dispatch (true assemble/execute pipelining
+  across dispatcher threads is future work);
+- **donated input buffers**: on accelerator backends the padded input
+  buffer is donated to XLA (``donate_argnums``), letting the compiler
+  reuse its HBM for outputs instead of holding both live.
+
+Three model kinds are accepted:
+
+- a Gluon :class:`~mxnet_tpu.gluon.block.Block`/``HybridBlock`` — run
+  functionally (:func:`~mxnet_tpu.gluon.block.functional_call`) under
+  one engine-owned ``jax.jit``; parameter updates between dispatches are
+  picked up automatically (pvals are jit *arguments*);
+- a bound :class:`~mxnet_tpu.executor.Executor` — one executor per
+  padded shape via ``reshape``; its first forward compiles and records
+  the signature (``Executor.compile_signature`` is the standalone
+  warmup hook for external callers);
+- any plain callable over jax arrays — wrapped in ``jax.jit`` directly.
+
+Determinism contract (verified by the sustained-load smoke test): the
+engine passes a FIXED rng key per dispatch and pads with a constant, so
+for batch-independent models a request's result is bitwise identical no
+matter which requests it shared a dispatch with — results depend only on
+the bucket the request landed in.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as onp
+
+from ..base import MXNetError
+from ..telemetry import metrics as _metrics
+from ..telemetry import recompile as _recompile
+from .batcher import DynamicBatcher, Request
+from .buckets import BucketLadder, default_ladder
+
+__all__ = ["ServingEngine", "InputSpec"]
+
+
+class InputSpec:
+    """Shape/dtype of ONE request item (no batch axis)."""
+
+    __slots__ = ("shape", "dtype", "name")
+
+    def __init__(self, shape: Sequence[int], dtype: str = "float32",
+                 name: str = "data"):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = str(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec({self.name}: {self.shape}, {self.dtype})"
+
+
+def _as_specs(input_specs) -> List[InputSpec]:
+    specs = []
+    for i, s in enumerate(input_specs):
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, dict):
+            specs.append(InputSpec(**s))
+        else:  # bare shape tuple
+            specs.append(InputSpec(s, name="data" if i == 0 else f"data{i}"))
+    return specs
+
+
+def _unpad_output(rows: onp.ndarray,
+                  orig_items: Sequence[Tuple[int, ...]],
+                  padded_items: Sequence[Tuple[int, ...]]) -> onp.ndarray:
+    """Slice non-batch padding back out of an output block.
+
+    ``orig_items``/``padded_items`` are the request's per-INPUT item
+    shapes (no batch axis), aligned. Heuristic: an output axis is
+    sliced to an input's original extent when its size equals that
+    input's PADDED extent on the same axis and the original was
+    smaller — i.e. the model preserved that axis (sequence models);
+    the first input that matches decides. Axes the model reshaped are
+    left alone. Engines with exotic output geometry pass ``unpad=``
+    to override (same signature).
+    """
+    idx = [slice(None)] * rows.ndim
+    changed = False
+    for ax in range(1, rows.ndim):
+        k = ax - 1
+        for orig, padded in zip(orig_items, padded_items):
+            if k < len(padded) and rows.shape[ax] == padded[k] \
+                    and orig[k] < padded[k]:
+                idx[ax] = slice(0, orig[k])
+                changed = True
+                break
+    return rows[tuple(idx)] if changed else rows
+
+
+class ServingEngine:
+    """Request-level inference over one model. See the module docstring.
+
+    Parameters
+    ----------
+    model : HybridBlock | Executor | callable
+    input_specs : list of InputSpec/shape-tuples, per-item (no batch axis).
+        Required for :meth:`warmup`; inferred from the first request
+        otherwise.
+    ladder : BucketLadder, default from ``MXSERVE_BUCKETS``.
+    batching : bool — route ``predict`` through the dynamic batcher
+        (default True). False = direct dispatch (still bucketed).
+    unpad : optional ``f(rows, orig_items, padded_items)`` overriding
+        the output-unpadding heuristic; ``orig_items``/``padded_items``
+        are aligned lists of per-INPUT item-shape tuples (no batch
+        axis) — see :func:`_unpad_output`.
+    """
+
+    def __init__(self, model, input_specs=None,
+                 ladder: Optional[BucketLadder] = None,
+                 name: Optional[str] = None,
+                 max_batch_size: Optional[int] = None,
+                 max_linger_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 batching: bool = True,
+                 pad_value: float = 0.0,
+                 donate: str = "auto",
+                 rng_seed: int = 0,
+                 unpad: Optional[Callable] = None,
+                 input_names: Optional[Sequence[str]] = None):
+        from ..executor import Executor
+        from ..gluon.block import Block
+        self.model = model
+        self.ladder = ladder if ladder is not None else default_ladder()
+        self.name = name or getattr(model, "name", None) \
+            or type(model).__name__
+        self.input_specs: Optional[List[InputSpec]] = \
+            _as_specs(input_specs) if input_specs is not None else None
+        self.pad_value = float(pad_value)
+        self._unpad = unpad or _unpad_output
+        self._rng_raw = jax.random.key_data(jax.random.key(rng_seed))
+        self._lock = threading.Lock()       # program/staging caches
+        self._warmed = False
+        self._seen_programs: set = set()    # full padded signatures
+        self._staging: Dict[Tuple, List[Optional[onp.ndarray]]] = {}
+        self._staging_flip: Dict[Tuple, int] = {}
+        self._warmup_report: List[dict] = []
+        self._after_warmup_count = 0  # per-engine; the registry counter
+        # below is the process-global aggregate across all engines
+        self._m_after = _metrics.counter(
+            "mxserve_recompile_after_warmup_total",
+            "serving programs compiled after warmup declared the cache "
+            "closed — should stay 0")
+        self._m_pad = _metrics.histogram(
+            "mxserve_padding_ratio",
+            "padded rows / real rows per dispatch (bucket efficiency)")
+        self._pad_sum = 0.0  # per-engine; the histogram is process-global
+        self._pad_n = 0
+        if donate not in ("auto", "on", "off"):
+            raise MXNetError("donate must be auto/on/off")
+        self._donate = (donate == "on") or (
+            donate == "auto" and jax.default_backend() != "cpu")
+        # -- bind the model kind ---------------------------------------
+        self._plist = None  # cached (name, Parameter) list, block kind
+        if isinstance(model, Executor):
+            self._kind = "executor"
+            self._input_names = list(input_names or ["data"])
+            self._execs: Dict[Tuple, Executor] = {}
+        elif isinstance(model, Block):
+            self._kind = "block"
+            self._jitted = self._build_block_program()
+        elif callable(model):
+            self._kind = "callable"
+            self._jitted = jax.jit(
+                lambda in_vals, rng: tuple(
+                    o for o in self._call_plain(in_vals)),
+                donate_argnums=(0,) if self._donate else ())
+        else:
+            raise MXNetError(
+                f"ServingEngine cannot serve a {type(model).__name__}; "
+                "pass a Gluon Block, a bound Executor, or a callable")
+        # row cap per dispatch: explicit arg > MXSERVE_MAX_BATCH flag >
+        # the ladder's top batch rung; never above the top rung (a
+        # dispatch larger than the biggest compiled program can't run)
+        from .. import config
+        if max_batch_size is None:
+            max_batch_size = int(config.get("MXSERVE_MAX_BATCH")) \
+                or self.ladder.max_batch
+        max_rows = min(int(max_batch_size), self.ladder.max_batch)
+        self.batcher: Optional[DynamicBatcher] = DynamicBatcher(
+            self._dispatch_group, max_batch_size=max_rows,
+            max_linger_ms=max_linger_ms, queue_depth=queue_depth,
+            name=self.name) if batching else None
+
+    # ------------------------------------------------------------------
+    # model-kind programs
+    # ------------------------------------------------------------------
+    def _call_plain(self, in_vals):
+        out = self.model(*in_vals)
+        return out if isinstance(out, (tuple, list)) else (out,)
+
+    def _build_block_program(self):
+        from ..gluon.block import functional_call
+        block = self.model
+
+        def pure_fn(pvals, in_vals, rng_raw):
+            outs, _aux = functional_call(block, pvals, list(in_vals),
+                                         training=False, rng_raw=rng_raw)
+            return outs
+
+        return jax.jit(pure_fn,
+                       donate_argnums=(1,) if self._donate else ())
+
+    def _block_pvals(self):
+        # the (name, Parameter) list is immutable once shapes are
+        # resolved; cache it so the serving hot path doesn't walk and
+        # sort the block tree per dispatch (only the per-param buffer
+        # fetch runs each time — updates still flow, pvals are jit args)
+        plist = self._plist
+        if plist is None:
+            plist = self._plist = sorted(
+                self.model._collect_params_with_prefix().items())
+        return {n: p.data()._data for n, p in plist}
+
+    def _resolve_deferred(self, sample_arrays: List[onp.ndarray]):
+        """First contact with a not-yet-initialized Gluon block: one
+        eager forward resolves deferred parameter shapes (the reference's
+        deferred-init story). Runs before warmup snapshots the recompile
+        counter, so it never pollutes the after-warmup accounting."""
+        if self._kind != "block":
+            return
+        from ..gluon.parameter import DeferredInitializationError
+        from ..ndarray.ndarray import _wrap
+        try:
+            self._block_pvals()
+        except (DeferredInitializationError, AssertionError, MXNetError):
+            import jax.numpy as jnp
+            args = [_wrap(jnp.asarray(a)) for a in sample_arrays]
+            from .. import autograd
+            with autograd._Scope(False, False):
+                self.model.forward(*args)
+            self._plist = None  # deferred init may have added params
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+    def _group_key(self, arrays: List[onp.ndarray]) -> Tuple:
+        return self.ladder.signature(arrays)
+
+    def _staging_for(self, full_sig: Tuple,
+                     shapes: List[Tuple[int, ...]],
+                     dtypes: List[str]) -> List[onp.ndarray]:
+        """Two host staging sets per signature, alternated per dispatch:
+        reuse avoids per-dispatch allocation, and the flip gives one
+        dispatch of headroom so an async launch that zero-copy-aliased
+        its host buffer is not overwritten by the next dispatch."""
+        pair = self._staging.get(full_sig)
+        if pair is None:
+            pair = [
+                [onp.empty(s, d) for s, d in zip(shapes, dtypes)],
+                [onp.empty(s, d) for s, d in zip(shapes, dtypes)],
+            ]
+            self._staging[full_sig] = pair
+            self._staging_flip[full_sig] = 0
+        flip = self._staging_flip[full_sig] = \
+            1 - self._staging_flip[full_sig]
+        return pair[flip]
+
+    def _record_program(self, full_shapes: List[Tuple[int, ...]],
+                        dtypes: List[str]):
+        """Feed the PR 2 recompile auditor on every NEW padded program
+        signature; after warmup this also trips the alarm counter."""
+        full_sig = tuple(zip(map(tuple, full_shapes), dtypes))
+        if full_sig in self._seen_programs:
+            return
+        self._seen_programs.add(full_sig)
+        sig = {"inputs": [{"shape": list(s), "dtype": d}
+                          for s, d in zip(full_shapes, dtypes)],
+               "training": False}
+        _recompile.record_recompile(
+            f"ServingEngine:{self.name}", sig, kind="serving")
+        if self._warmed:
+            self._m_after.inc()
+            self._after_warmup_count += 1
+
+    def _execute(self, padded: List[onp.ndarray]) -> List:
+        """Launch ONE padded, bucketed batch; returns DEVICE-side
+        outputs (jax arrays, possibly still in flight — jax dispatch is
+        async). Callers materialize outside the staging lock so the
+        next dispatch can assemble while the device works."""
+        import jax.numpy as jnp
+        shapes = [tuple(a.shape) for a in padded]
+        dtypes = [str(a.dtype) for a in padded]
+        self._record_program(shapes, dtypes)
+        if self._kind == "executor":
+            exe = self._executor_for(shapes)
+            feed = {n: a for n, a in zip(self._input_names, padded)}
+            outs = exe.forward(is_train=False, **{
+                k: _nd_array(v) for k, v in feed.items()})
+            return [o._data for o in outs]
+        in_vals = [jnp.asarray(a) for a in padded]
+        if self._kind == "block":
+            outs = self._jitted(self._block_pvals(), in_vals,
+                                self._rng_raw)
+        else:
+            outs = self._jitted(in_vals, self._rng_raw)
+        return list(outs)
+
+    def _executor_for(self, shapes: List[Tuple[int, ...]]):
+        key = tuple(shapes)
+        exe = self._execs.get(key)
+        if exe is None:
+            base = self.model
+            if tuple(tuple(base.arg_dict[n].shape)
+                     for n in self._input_names) == key:
+                exe = base
+            else:
+                exe = base.reshape(**dict(zip(self._input_names, shapes)))
+            # no compile_signature here: the forward in _execute
+            # compiles AND records this signature — a warmup call first
+            # would execute the full program twice per shape
+            self._execs[key] = exe
+        return exe
+
+    def _dispatch_group(self, group_key: Tuple,
+                        requests: List[Request]) -> List[Any]:
+        """Batcher callback: concat + pad claimed requests, one device
+        dispatch, scatter slices back (one result list per request)."""
+        rows = sum(r.n_items for r in requests)
+        bucket = self.ladder.batch_bucket(rows)
+        n_inputs = len(requests[0].arrays)
+        padded_items = [ps for ps, _ in group_key]
+        dtypes = [dt for _, dt in group_key]
+        full_shapes = [(bucket,) + tuple(ps) for ps in padded_items]
+        with self._lock:
+            staging = self._staging_for(tuple(group_key) + (bucket,),
+                                        full_shapes, dtypes)
+            for buf in staging:
+                buf.fill(self.pad_value)
+            offset = 0
+            for r in requests:
+                for i in range(n_inputs):
+                    a = r.arrays[i]
+                    idx = (slice(offset, offset + r.n_items),) + tuple(
+                        slice(0, s) for s in a.shape[1:])
+                    staging[i][idx] = a
+                offset += r.n_items
+            self._m_pad.observe(bucket / max(rows, 1))
+            self._pad_sum += bucket / max(rows, 1)
+            self._pad_n += 1
+            outs_dev = self._execute(staging)
+        # materialize OUTSIDE the lock: a concurrent direct-dispatch
+        # caller (batching=False) can assemble and launch into the
+        # flipped staging set while this thread waits on the device
+        outs = [onp.asarray(o) for o in outs_dev]
+        padded_tuples = [tuple(ps) for ps in padded_items]
+        results = []
+        offset = 0
+        for r in requests:
+            sl = []
+            orig_items = [tuple(a.shape[1:]) for a in r.arrays]
+            for o in outs:
+                block = o[offset:offset + r.n_items]
+                sl.append(self._unpad(block, orig_items, padded_tuples))
+            results.append(sl)
+            offset += r.n_items
+        return results
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def warmup(self, input_specs=None) -> List[dict]:
+        """AOT-compile every ladder rung so the jit cache is CLOSED.
+
+        Enumerates ``ladder.warmup_shapes`` per input spec, runs one
+        padded dummy dispatch per combination, and records per-program
+        wall time. After this returns, any further compile increments
+        ``mxserve_recompile_after_warmup_total`` — the alarm the
+        sustained-load smoke test asserts stays at 0.
+        """
+        if input_specs is not None:
+            self.input_specs = _as_specs(input_specs)
+        if not self.input_specs:
+            raise MXNetError(
+                "warmup needs input_specs (per-item shapes, no batch "
+                "axis) — pass them to the engine or to warmup()")
+        specs = self.input_specs
+        combo_lists = [self.ladder.item_shape_combos(s.shape)
+                       for s in specs]
+        self._resolve_deferred([
+            onp.full((1,) + specs[i].shape, self.pad_value,
+                     specs[i].dtype) for i in range(len(specs))])
+        report = []
+        # CROSS-product across inputs: live requests pad each input
+        # independently (input0 seq may land on rung 16 while input1
+        # lands on 32), so the closed cache must hold every combination,
+        # not just the lockstep diagonal
+        import itertools
+        for combo in itertools.product(*combo_lists):
+            for b in self.ladder.batch_buckets:
+                padded = [
+                    onp.full((b,) + tuple(combo[i]),
+                             self.pad_value, specs[i].dtype)
+                    for i in range(len(specs))]
+                t0 = time.perf_counter()
+                with self._lock:
+                    outs = self._execute(padded)
+                jax.block_until_ready(outs)  # honest compile+run timing
+                report.append({
+                    "shapes": [list(p.shape) for p in padded],
+                    "compile_ms": round(
+                        (time.perf_counter() - t0) * 1000.0, 3)})
+        self._warmed = True
+        self._warmup_report = report
+        _metrics.gauge(
+            "mxserve_programs_compiled",
+            "distinct serving programs in the jit cache"
+        ).set(len(self._seen_programs))
+        return report
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    def predict(self, data, timeout_ms: Optional[float] = None):
+        """Serve one request.
+
+        ``data``: one array or a list (multi-input models), each with a
+        leading batch axis (``n`` rows, any ``n`` up to the batch cap).
+        Returns numpy output(s) with padding sliced back off — a single
+        array when the model has one output.
+        """
+        arrays = self._coerce_request(data)
+        n = int(arrays[0].shape[0])
+        key = self._group_key(arrays)
+        if self.batcher is not None:
+            outs = self.batcher.submit(arrays, n, key,
+                                       timeout_ms=timeout_ms)
+        else:
+            if timeout_ms is not None:
+                raise MXNetError(
+                    "timeout_ms requires batching=True — direct "
+                    "dispatch is synchronous and cannot enforce a "
+                    "deadline")
+            outs = self._dispatch_group(
+                key, [Request(arrays, n, key, None)])[0]
+        return outs[0] if len(outs) == 1 else outs
+
+    def predict_async(self, data, timeout_ms: Optional[float] = None):
+        """Non-blocking submit; returns the batcher Request (``wait()``,
+        then ``.result``/``.error``)."""
+        if self.batcher is None:
+            raise MXNetError("predict_async requires batching=True")
+        arrays = self._coerce_request(data)
+        return self.batcher.submit_async(
+            arrays, int(arrays[0].shape[0]), self._group_key(arrays),
+            timeout_ms=timeout_ms)
+
+    def _coerce_request(self, data) -> List[onp.ndarray]:
+        from ..ndarray.ndarray import NDArray
+        items = data if isinstance(data, (list, tuple)) else [data]
+        arrays = []
+        for i, a in enumerate(items):
+            if isinstance(a, NDArray):
+                a = a.asnumpy()
+            a = onp.asarray(a)
+            if self.input_specs and i < len(self.input_specs):
+                spec = self.input_specs[i]
+                if a.ndim == len(spec.shape):  # single item, no batch axis
+                    a = a[None]
+                a = a.astype(spec.dtype, copy=False)
+            arrays.append(a)
+        if self.input_specs is None:
+            self.input_specs = [InputSpec(a.shape[1:], str(a.dtype),
+                                          name=f"data{i}" if i else "data")
+                                for i, a in enumerate(arrays)]
+        return arrays
+
+    def stats(self) -> dict:
+        out = {
+            "name": self.name,
+            "kind": self._kind,
+            "warmed": self._warmed,
+            "buckets": self.ladder.spec(),
+            "programs_compiled": len(self._seen_programs),
+            "recompiles_after_warmup": self._after_warmup_count,
+            "donate": self._donate,
+        }
+        if self._pad_n:
+            out["avg_padding_ratio"] = round(
+                self._pad_sum / self._pad_n, 4)
+        if self.batcher is not None:
+            out["batcher"] = self.batcher.stats()
+        return out
+
+    def warmup_report(self) -> List[dict]:
+        return list(self._warmup_report)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: refuse new requests, flush the queue."""
+        return self.batcher.drain(timeout) if self.batcher else True
+
+    def close(self):
+        if self.batcher is not None:
+            self.batcher.stop()
+
+    def __repr__(self):
+        return (f"ServingEngine({self.name!r}, kind={self._kind}, "
+                f"ladder={self.ladder!r}, warmed={self._warmed})")
+
+
+def _nd_array(a):
+    from ..ndarray.ndarray import array
+    return array(a)

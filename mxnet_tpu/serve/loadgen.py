@@ -1,0 +1,69 @@
+"""Closed-loop load generator over a serving target.
+
+The one implementation behind ``tools/mxserve.py loadgen`` and
+``bench.py --serving``: N worker threads pull payloads from a shared
+cursor and fire them at a ``fire(payload)`` callable (an in-process
+:class:`~mxnet_tpu.serve.engine.ServingEngine` predict, or an HTTP
+POST), recording per-request wall latency. Closed-loop means each
+worker waits for its response before sending the next request — offered
+load tracks capacity, which is what a batching-efficiency benchmark
+wants (open-loop arrival processes belong to an external harness).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Sequence
+
+from .. telemetry.metrics import percentile_of
+
+__all__ = ["run_loadgen"]
+
+
+def run_loadgen(fire: Callable, payloads: Sequence,
+                concurrency: int = 8) -> dict:
+    """Fire every payload through ``fire`` from ``concurrency`` workers.
+
+    Returns ``{completed, errors (messages), wall_s, throughput_rps,
+    p50_ms, p99_ms, latencies_s}``.
+    """
+    latencies: List[float] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+    cursor = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(payloads):
+                    return
+                cursor[0] += 1
+            t0 = time.perf_counter()
+            try:
+                fire(payloads[i])
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+            except Exception as e:  # noqa: BLE001 — record, keep loading
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(time.perf_counter() - t_start, 1e-9)
+    lat = sorted(latencies)
+    return {
+        "completed": len(latencies),
+        "errors": errors,
+        "wall_s": wall,
+        "throughput_rps": len(latencies) / wall,
+        "p50_ms": (percentile_of(lat, 50) or 0.0) * 1000.0,
+        "p99_ms": (percentile_of(lat, 99) or 0.0) * 1000.0,
+        "latencies_s": lat,
+    }

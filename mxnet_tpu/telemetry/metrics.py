@@ -24,11 +24,23 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "all_metrics", "snapshot", "to_json_lines", "to_prometheus",
-           "export_jsonl", "reset_metrics"]
+           "export_jsonl", "reset_metrics", "percentile_of"]
+
+
+def percentile_of(sorted_vals, q: float):
+    """Nearest-rank percentile (0..100) over an ascending-sorted
+    sequence; None when empty. The ONE quantile implementation shared by
+    Histogram, the serving loadgen, and the CLIs."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
 
 _LOCK = threading.Lock()
 _METRICS: Dict[str, "Metric"] = {}
@@ -99,9 +111,16 @@ class Gauge(Metric):
 
 
 class Histogram(Metric):
-    """Streaming distribution: count / sum / min / max."""
+    """Streaming distribution: count / sum / min / max, plus quantiles
+    over a bounded reservoir of the most recent observations.
+
+    The streaming fields are exact over the full history; ``p50``/``p99``
+    are computed from the last ``RESERVOIR`` samples (a deque — serving
+    latency quantiles care about *recent* behavior, and a sliding window
+    is the Prometheus-summary convention without the decay math)."""
 
     kind = "histogram"
+    RESERVOIR = 512
 
     def __init__(self, name, doc=""):
         super().__init__(name, doc)
@@ -112,6 +131,7 @@ class Histogram(Metric):
         self._sum = 0.0
         self._min = float("inf")
         self._max = float("-inf")
+        self._recent = deque(maxlen=self.RESERVOIR)
 
     def observe(self, v):
         v = float(v)
@@ -122,6 +142,14 @@ class Histogram(Metric):
                 self._min = v
             if v > self._max:
                 self._max = v
+            self._recent.append(v)
+
+    def percentile(self, q: float):
+        """q-th percentile (0..100) over the recent-sample reservoir;
+        None when nothing has been observed."""
+        with _LOCK:
+            samples = sorted(self._recent)
+        return percentile_of(samples, q)
 
     @property
     def count(self):
@@ -137,9 +165,12 @@ class Histogram(Metric):
         with _LOCK:
             if not self._count:
                 return {"count": 0, "sum": 0.0}
+            samples = sorted(self._recent)
             return {"count": self._count, "sum": self._sum,
                     "min": self._min, "max": self._max,
-                    "avg": self._sum / self._count}
+                    "avg": self._sum / self._count,
+                    "p50": percentile_of(samples, 50),
+                    "p99": percentile_of(samples, 99)}
 
     def reset(self):
         with _LOCK:
@@ -223,6 +254,8 @@ def to_prometheus() -> str:
             if v["count"]:
                 lines.append(f"{name}_min {v['min']}")
                 lines.append(f"{name}_max {v['max']}")
+                lines.append(f'{name}{{quantile="0.5"}} {v["p50"]}')
+                lines.append(f'{name}{{quantile="0.99"}} {v["p99"]}')
         else:
             lines.append(f"# TYPE {name} {m.kind}")
             lines.append(f"{name} {m.value()}")

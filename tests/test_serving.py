@@ -1,0 +1,620 @@
+"""Serving subsystem (ISSUE 3): bucket ladder, dynamic batcher under
+concurrency, ServingEngine sustained-load smoke test (zero recompiles
+after warmup via the PR 2 auditor, batch occupancy > 1, per-request
+results bitwise-equal to unbatched single calls), executor/callable
+paths, compile-by-signature hooks, HTTP endpoint surface.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, serve, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import (BatcherStoppedError, BucketLadder,
+                             BucketOverflowError, DeadlineExceededError,
+                             DynamicBatcher, QueueFullError, ServingEngine)
+
+
+def _run_bounded(fn, timeout=30.0):
+    """Run fn on a thread; fail the test instead of hanging the suite."""
+    out = {}
+
+    def runner():
+        try:
+            out["result"] = fn()
+        except BaseException as e:  # noqa: BLE001
+            out["error"] = e
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), f"call did not finish within {timeout}s"
+    if "error" in out:
+        raise out["error"]
+    return out.get("result")
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+def test_parse_bucket_spec():
+    lad = serve.parse_bucket_spec("1,2,4,8")
+    assert lad.batch_buckets == (1, 2, 4, 8)
+    assert lad.dim_buckets == {}
+    lad = serve.parse_bucket_spec("batch:1,2,8;seq:16,32,64")
+    assert lad.batch_buckets == (1, 2, 8)
+    assert lad.dim_buckets == {1: (16, 32, 64)}
+    lad = serve.parse_bucket_spec("batch:4;axis2:10,20")
+    assert lad.dim_buckets == {2: (10, 20)}
+    assert serve.parse_bucket_spec(lad.spec()).spec() == lad.spec()
+    for bad in ("", "0,2", "a,b", "seq:16,32", "what:1,2"):
+        with pytest.raises(MXNetError):
+            serve.parse_bucket_spec(bad)
+
+
+def test_ladder_padding_and_overflow():
+    lad = BucketLadder([1, 2, 4, 8], {1: [16, 32]})
+    assert lad.batch_bucket(1) == 1
+    assert lad.batch_bucket(3) == 4
+    assert lad.batch_bucket(8) == 8
+    with pytest.raises(BucketOverflowError):
+        lad.batch_bucket(9)
+    assert lad.padded_shape((3, 10, 7)) == (4, 16, 7)
+    assert lad.padded_shape((8, 32, 7)) == (8, 32, 7)
+    with pytest.raises(BucketOverflowError):
+        lad.padded_shape((1, 33))
+    # warmup enumeration: |batch| x |seq| programs
+    shapes = lad.warmup_shapes((16, 7))
+    assert len(shapes) == 8
+    assert (1, 16, 7) in shapes and (8, 32, 7) in shapes
+    assert lad.program_count((16, 7)) == 8
+    # coalescing signature ignores the batch rung, pads item dims
+    a = onp.zeros((3, 10, 7), "float32")
+    b = onp.zeros((1, 14, 7), "float32")
+    assert lad.signature([a]) == lad.signature([b])
+
+
+def test_default_ladder_from_flag():
+    from mxnet_tpu import config
+    config.set_flag("MXSERVE_BUCKETS", "batch:2,4;seq:8")
+    try:
+        lad = serve.default_ladder()
+        assert lad.batch_buckets == (2, 4)
+        assert lad.dim_buckets == {1: (8,)}
+    finally:
+        config.unset_flag("MXSERVE_BUCKETS")
+
+
+# ---------------------------------------------------------------------------
+# dynamic batcher (satellite: concurrency semantics)
+# ---------------------------------------------------------------------------
+
+def _echo_dispatch(key, requests):
+    """Row-preserving dispatch: each request's result is its own input
+    doubled — any cross-request mixup corrupts the payload check."""
+    return [[r.arrays[0] * 2.0] for r in requests]
+
+
+def test_batcher_concurrent_mixed_shapes():
+    batcher = DynamicBatcher(_echo_dispatch, max_batch_size=8,
+                             max_linger_ms=2.0, queue_depth=64)
+    n_threads, per_thread = 6, 15
+    results = {}
+    errors = []
+    lock = threading.Lock()
+
+    def worker(tid):
+        rng = onp.random.RandomState(tid)
+        for i in range(per_thread):
+            rows = 1 + (i % 3)
+            feat = 4 if (tid + i) % 2 == 0 else 6  # two coalescing keys
+            x = rng.uniform(-1, 1, size=(rows, feat)).astype("float32")
+            try:
+                out = batcher.submit([x], rows, ("f", feat),
+                                     timeout_ms=10000.0)
+                with lock:
+                    results[(tid, i)] = (x, out)
+            except BaseException as e:  # noqa: BLE001
+                with lock:
+                    errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive(), "batcher worker hung"
+    assert not errors, errors[:3]
+    assert len(results) == n_threads * per_thread
+    for (tid, i), (x, out) in results.items():
+        # every request got its OWN (unpadded, un-mixed) result back
+        assert out[0].shape == x.shape
+        assert onp.array_equal(out[0], x * 2.0)
+    stats = batcher.stats()
+    assert stats["requests"] == n_threads * per_thread
+    assert stats["dispatches"] >= 1
+    batcher.stop()
+
+
+def test_batcher_deadline_fail_fast():
+    release = threading.Event()
+
+    def slow_dispatch(key, requests):
+        release.wait(5.0)
+        return [[r.arrays[0]] for r in requests]
+
+    batcher = DynamicBatcher(slow_dispatch, max_batch_size=4,
+                             max_linger_ms=1.0, queue_depth=16)
+    try:
+        x = onp.ones((1, 4), "float32")
+        # first request occupies the dispatcher (blocked in dispatch)
+        first = batcher.submit_async([x], 1, "k")
+        time.sleep(0.05)
+        # second request expires while QUEUED: fails fast, well before
+        # the 5 s dispatch would finish
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceededError):
+            _run_bounded(lambda: batcher.submit([x], 1, "k",
+                                                timeout_ms=40.0))
+        assert time.perf_counter() - t0 < 2.0, "timeout was not fast"
+        assert batcher.stats()["deadline_expired"] >= 1
+    finally:
+        release.set()
+        first.wait(5.0)
+        batcher.stop()
+
+
+def test_batcher_backpressure_load_shed():
+    release = threading.Event()
+
+    def slow_dispatch(key, requests):
+        release.wait(5.0)
+        return [[r.arrays[0]] for r in requests]
+
+    depth = 3
+    batcher = DynamicBatcher(slow_dispatch, max_batch_size=1,
+                             max_linger_ms=0.5, queue_depth=depth)
+    try:
+        x = onp.ones((1, 4), "float32")
+        pending = [batcher.submit_async([x], 1, "k")]  # claimed
+        time.sleep(0.05)
+        for _ in range(depth):  # fill the bounded queue
+            pending.append(batcher.submit_async([x], 1, "k"))
+        t0 = time.perf_counter()
+        with pytest.raises(QueueFullError):
+            _run_bounded(lambda: batcher.submit([x], 1, "k"))
+        # the rejection is immediate backpressure, not a blocking wait
+        assert time.perf_counter() - t0 < 1.0
+        assert batcher.stats()["shed"] >= 1
+    finally:
+        release.set()
+        for r in pending:
+            r.wait(10.0)
+        batcher.stop()
+
+
+def test_batcher_drain_stops_intake():
+    batcher = DynamicBatcher(_echo_dispatch, max_batch_size=4,
+                             max_linger_ms=0.5, queue_depth=8)
+    x = onp.ones((1, 4), "float32")
+    assert onp.array_equal(
+        _run_bounded(lambda: batcher.submit([x], 1, "k"))[0], x * 2)
+    assert batcher.drain(timeout=5.0)
+    with pytest.raises(BatcherStoppedError):
+        batcher.submit([x], 1, "k")
+    batcher.stop()
+
+
+def test_batcher_dispatch_error_fails_group():
+    def bad_dispatch(key, requests):
+        raise RuntimeError("kaboom")
+
+    batcher = DynamicBatcher(bad_dispatch, max_batch_size=4,
+                             max_linger_ms=0.5, queue_depth=8)
+    x = onp.ones((1, 4), "float32")
+    with pytest.raises(RuntimeError, match="kaboom"):
+        _run_bounded(lambda: batcher.submit([x], 1, "k"))
+    batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# ServingEngine
+# ---------------------------------------------------------------------------
+
+def _seq_mlp(feature=5):
+    """Sequence-preserving MLP: (n, L, feature) -> (n, L, 12).
+    Batch- and position-independent, so serving results must be
+    bitwise-independent of co-batched requests."""
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(24, activation="relu", flatten=False))
+        net.add(gluon.nn.Dense(12, flatten=False))
+    net.initialize()
+    net(nd.zeros((1, 2, feature)))  # resolve deferred shapes
+    return net
+
+
+def test_engine_sustained_load_smoke():
+    """Acceptance: 200 mixed-shape requests through a warmed engine —
+    ZERO recompiles after warmup (recompile auditor), occupancy > 1
+    under concurrent load, per-request results bitwise-equal to
+    unbatched single calls (single batch rung => same program)."""
+    feature = 5
+    net = _seq_mlp(feature)
+    ladder = BucketLadder([8], {1: [4, 8]})
+    engine = ServingEngine(net, input_specs=[(4, feature)], ladder=ladder,
+                           name="smoke", max_linger_ms=5.0,
+                           queue_depth=256)
+    try:
+        report = engine.warmup()
+        assert len(report) == 2  # 1 batch rung x 2 seq rungs
+        assert engine.warmed
+        rc_after_warmup = telemetry.recompile_count()
+
+        rng = onp.random.RandomState(7)
+        n_requests = 200
+        payloads = [
+            rng.uniform(-1, 1, size=(1 + (i % 3), 2 + (i % 7), feature))
+            .astype("float32") for i in range(n_requests)]
+
+        # unbatched single calls: one request per dispatch (reference)
+        reference = [
+            _run_bounded(lambda p=p: engine.predict(p), timeout=60)
+            for p in payloads]
+        for p, r in zip(payloads, reference):
+            assert r.shape == p.shape[:2] + (12,)
+        dispatches_before = telemetry.metrics.counter(
+            "mxserve_dispatch_total").value()
+
+        # sustained concurrent load
+        results = [None] * n_requests
+        errors = []
+        cursor = [0]
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = cursor[0]
+                    if i >= n_requests:
+                        return
+                    cursor[0] += 1
+                try:
+                    out = engine.predict(payloads[i], timeout_ms=30000.0)
+                    with lock:
+                        results[i] = out
+                except BaseException as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(e)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+            assert not t.is_alive(), "serving worker hung"
+        assert not errors, errors[:3]
+
+        # 1) zero recompiles after warmup, asserted via the auditor
+        assert telemetry.recompile_count() == rc_after_warmup, \
+            [r for r in telemetry.recompile_report()
+             if r["ts"] >= 0][-3:]
+        assert engine.stats()["recompiles_after_warmup"] == 0
+
+        # 2) batch occupancy > 1 under concurrent load
+        dispatches = telemetry.metrics.counter(
+            "mxserve_dispatch_total").value() - dispatches_before
+        assert dispatches < n_requests, \
+            f"no coalescing: {dispatches} dispatches for {n_requests}"
+        assert n_requests / dispatches > 1.0
+
+        # 3) per-request results bitwise-equal to the unbatched calls
+        for i in range(n_requests):
+            assert onp.array_equal(results[i], reference[i]), \
+                f"request {i} differs between batched and single call"
+
+        # sanity: the serving path computes the same function as the
+        # model called directly (numerics, not bitwise — different
+        # padded program)
+        direct = net(nd.array(payloads[0])).asnumpy()
+        onp.testing.assert_allclose(reference[0], direct,
+                                    rtol=1e-5, atol=1e-5)
+    finally:
+        engine.close()
+
+
+def test_engine_executor_path():
+    """Bound-Symbol serving: per-bucket executors via reshape +
+    compile_signature; elementwise graph => bitwise-checkable."""
+    data = mx.sym.Variable("data")
+    out = data * 2.0 + 1.0
+    exe = out.simple_bind(mx.cpu(), data=(4, 6))
+    engine = ServingEngine(exe, input_specs=[(6,)],
+                           ladder=BucketLadder([2, 4]),
+                           name="exec", max_linger_ms=1.0,
+                           input_names=["data"])
+    try:
+        engine.warmup()
+        rc = telemetry.recompile_count()
+        x = onp.random.RandomState(0).uniform(
+            -1, 1, size=(3, 6)).astype("float32")
+        got = _run_bounded(lambda: engine.predict(x))
+        assert got.shape == (3, 6)
+        assert onp.array_equal(got, x * 2.0 + 1.0)
+        assert telemetry.recompile_count() == rc
+    finally:
+        engine.close()
+
+
+def test_engine_callable_path():
+    import jax.numpy as jnp
+
+    engine = ServingEngine(lambda x: jnp.tanh(x),
+                           input_specs=[(4,)],
+                           ladder=BucketLadder([1, 2, 4]),
+                           name="fn", max_linger_ms=1.0)
+    try:
+        engine.warmup()
+        x = onp.linspace(-1, 1, 8, dtype="float32").reshape(2, 4)
+        got = _run_bounded(lambda: engine.predict(x))
+        assert got.shape == (2, 4)
+        onp.testing.assert_allclose(got, onp.tanh(x), rtol=1e-6)
+    finally:
+        engine.close()
+
+
+def test_engine_multi_input_unpad():
+    """Two-input model with a laddered sequence axis: outputs must come
+    back sliced to the ORIGINAL extents (per-input shapes drive the
+    unpad), bitwise equal to the unpadded computation."""
+    import jax.numpy as jnp
+
+    engine = ServingEngine(lambda a, b: a + 2.0 * b,
+                           input_specs=[(4, 3), (4, 3)],
+                           ladder=BucketLadder([2], {1: [4]}),
+                           name="multi", max_linger_ms=1.0)
+    try:
+        engine.warmup()
+        rng = onp.random.RandomState(1)
+        a = rng.uniform(-1, 1, size=(1, 2, 3)).astype("float32")
+        b = rng.uniform(-1, 1, size=(1, 2, 3)).astype("float32")
+        out = _run_bounded(lambda: engine.predict([a, b]))
+        assert out.shape == (1, 2, 3)
+        assert onp.array_equal(out, a + 2.0 * b)
+    finally:
+        engine.close()
+
+
+def test_engine_multi_input_warmup_cross_product():
+    """Inputs pad their laddered axes independently, so warmup must
+    cover the cross-product of rung combinations — a mixed (4, 8)
+    signature after a diagonal-only warmup would recompile."""
+    import jax.numpy as jnp
+
+    engine = ServingEngine(
+        lambda a, b: a[:, :1, :] + b[:, :1, :],
+        input_specs=[(4, 2), (4, 2)],
+        ladder=BucketLadder([2], {1: [4, 8]}),
+        name="cross", max_linger_ms=1.0)
+    try:
+        report = engine.warmup()
+        assert len(report) == 4  # 1 batch rung x (2 x 2) input combos
+        rc = telemetry.recompile_count()
+        a = onp.ones((1, 3, 2), "float32")   # axis1 pads to 4
+        b = onp.ones((1, 6, 2), "float32")   # axis1 pads to 8
+        out = _run_bounded(lambda: engine.predict([a, b]))
+        assert out.shape == (1, 1, 2)
+        assert telemetry.recompile_count() == rc
+        assert engine.stats()["recompiles_after_warmup"] == 0
+    finally:
+        engine.close()
+
+
+def test_engine_honors_max_batch_flag():
+    from mxnet_tpu import config
+    config.set_flag("MXSERVE_MAX_BATCH", 2)
+    try:
+        engine = ServingEngine(lambda x: x, input_specs=[(3,)],
+                               ladder=BucketLadder([1, 2, 4]),
+                               name="capped", max_linger_ms=1.0)
+        assert engine.batcher.max_batch_size == 2
+        engine.close()
+    finally:
+        config.unset_flag("MXSERVE_MAX_BATCH")
+    # 0 (the default) resolves to the ladder's top rung, and an explicit
+    # cap larger than the top rung is clamped to it
+    engine = ServingEngine(lambda x: x, input_specs=[(3,)],
+                           ladder=BucketLadder([1, 2, 4]),
+                           name="uncapped", max_linger_ms=1.0,
+                           max_batch_size=99)
+    assert engine.batcher.max_batch_size == 4
+    engine.close()
+
+
+def test_engine_rejects_oversized_request():
+    engine = ServingEngine(_seq_mlp(), input_specs=[(4, 5)],
+                           ladder=BucketLadder([2], {1: [4]}),
+                           name="tiny", max_linger_ms=1.0)
+    try:
+        with pytest.raises(MXNetError):
+            _run_bounded(lambda: engine.predict(
+                onp.zeros((5, 4, 5), "float32")))
+    finally:
+        engine.close()
+
+
+def test_as_serving_engine_export_path():
+    net = _seq_mlp()
+    engine = net.as_serving_engine(input_specs=[(4, 5)],
+                                   ladder=BucketLadder([2], {1: [4]}),
+                                   max_linger_ms=1.0)
+    try:
+        engine.warmup()
+        x = onp.ones((1, 3, 5), "float32")
+        out = _run_bounded(lambda: engine.predict(x))
+        assert out.shape == (1, 3, 12)
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# compile-by-signature hooks
+# ---------------------------------------------------------------------------
+
+def test_hybridblock_compile_signature_closes_cache():
+    net = _seq_mlp()
+    net.hybridize()
+    rc0 = telemetry.recompile_count()
+    net.compile_signature((4, 4, 5))
+    # the warmup compile records (once per hybridized block in the tree)
+    rc1 = telemetry.recompile_count()
+    assert rc1 > rc0
+    net(nd.ones((4, 4, 5)))  # same signature: cache hit, no new record
+    assert telemetry.recompile_count() == rc1
+    with pytest.raises(MXNetError):
+        _seq_mlp().compile_signature((1, 2, 5))  # not hybridized
+
+
+def test_executor_compile_signature_dedups_forward():
+    data = mx.sym.Variable("data")
+    exe = (data + 1.0).simple_bind(mx.cpu(), data=(2, 3))
+    rc0 = telemetry.recompile_count()
+    exe.compile_signature(is_train=False)
+    assert telemetry.recompile_count() == rc0 + 1
+    exe.forward(is_train=False, data=nd.ones((2, 3)))
+    assert telemetry.recompile_count() == rc0 + 1  # deduped signature
+    assert onp.allclose(exe.outputs[0].asnumpy(), 2.0)
+
+
+# ---------------------------------------------------------------------------
+# endpoint
+# ---------------------------------------------------------------------------
+
+def _http(url, data=None, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(data).encode() if data is not None else None,
+        headers={"Content-Type": "application/json"} if data is not None
+        else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_endpoint_http_surface():
+    net = _seq_mlp()
+    engine = ServingEngine(net, input_specs=[(4, 5)],
+                           ladder=BucketLadder([1, 2, 4], {1: [4]}),
+                           name="m", max_linger_ms=1.0)
+    registry = serve.ModelRegistry()
+    registry.register("m", engine)
+    endpoint = serve.ServingEndpoint(registry, port=0).start()
+    base = endpoint.address
+    try:
+        assert _http(f"{base}/healthz")[0] == 200
+        # not warmed yet: readiness gate holds traffic
+        code, body = _http(f"{base}/readyz")
+        assert code == 503 and body["status"] == "warming"
+        code, body = _http(f"{base}/v1/models/m:warmup", data={})
+        assert code == 200 and len(body["report"]) == 3
+        assert _http(f"{base}/readyz")[0] == 200
+        code, body = _http(f"{base}/v1/models")
+        assert code == 200 and body["models"][0]["name"] == "m"
+        x = onp.ones((2, 3, 5), "float32")
+        code, body = _http(f"{base}/v1/models/m:predict",
+                           data={"inputs": x.tolist()})
+        assert code == 200
+        got = onp.asarray(body["outputs"], "float32")
+        expect = _run_bounded(lambda: engine.predict(x))
+        onp.testing.assert_allclose(got, expect, rtol=1e-5)
+        assert _http(f"{base}/v1/models/nope")[0] == 404
+        # malformed bodies get a 400, not a dropped connection
+        code, body = _http(f"{base}/v1/models/m:predict",
+                           data=[1, 2, 3])
+        assert code == 400 and "error" in body
+        code, body = _http(f"{base}/v1/models/m:predict",
+                           data={"nope": 1})
+        assert code == 400
+        # prometheus exposition includes the serving metrics
+        req = urllib.request.Request(f"{base}/metrics")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+        assert "mxserve_request_seconds" in text
+        assert 'quantile="0.99"' in text
+        # graceful drain: accepted, then the listener goes away
+        assert _http(f"{base}/admin/drain", data={})[0] == 202
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                _http(f"{base}/healthz", timeout=1)
+                time.sleep(0.05)
+            except (ConnectionError, OSError):
+                break
+        else:
+            pytest.fail("endpoint did not stop after drain")
+        assert endpoint.draining
+    finally:
+        try:
+            endpoint.stop()
+        except Exception:
+            pass
+        engine.close()
+
+
+def test_registry_semantics():
+    reg = serve.ModelRegistry()
+    engine = ServingEngine(lambda x: x, input_specs=[(2,)],
+                           ladder=BucketLadder([1]), batching=False,
+                           name="r")
+    reg.register("r", engine)
+    with pytest.raises(MXNetError):
+        reg.register("r", engine)
+    assert reg.names() == ["r"]
+    assert reg.get("r") is engine
+    reg.unregister("r")
+    with pytest.raises(MXNetError):
+        reg.get("r")
+
+
+# ---------------------------------------------------------------------------
+# metrics plumbing
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentiles():
+    h = telemetry.metrics.histogram("t_pct")
+    assert h.percentile(50) is None
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.percentile(50) == pytest.approx(50.0, abs=1.0)
+    assert h.percentile(99) == pytest.approx(99.0, abs=1.0)
+    val = h.value()
+    assert val["p50"] == pytest.approx(50.0, abs=1.0)
+    assert val["p99"] == pytest.approx(99.0, abs=1.0)
+
+
+def test_serving_stats_surface():
+    engine = ServingEngine(lambda x: x * 1.0, input_specs=[(3,)],
+                           ladder=BucketLadder([1, 2]), name="stats",
+                           max_linger_ms=1.0)
+    try:
+        engine.warmup()
+        _run_bounded(lambda: engine.predict(
+            onp.ones((1, 3), "float32")))
+        stats = engine.stats()
+        assert stats["warmed"] is True
+        assert stats["programs_compiled"] == 2
+        assert stats["recompiles_after_warmup"] == 0
+        assert stats["batcher"]["requests"] >= 1
+        assert "latency_p99_ms" in stats["batcher"]
+    finally:
+        engine.close()

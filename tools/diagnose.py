@@ -147,6 +147,39 @@ def check_telemetry():
     print("export sink  :", sink or "(off)")
 
 
+def check_serving():
+    """Serving-subsystem health: flag values, bucket-ladder program
+    count, and the mxserve_* metrics (mxnet_tpu/serve/; docs/serving.md)."""
+    print("----------Serving (mxserve)----------")
+    try:
+        from mxnet_tpu import config, serve, telemetry
+    except Exception as e:
+        print("serving      : unavailable (%s)" % e)
+        return
+    try:
+        ladder = serve.default_ladder()
+        print("buckets      :", ladder.spec())
+    except Exception as e:
+        print("buckets      : INVALID MXSERVE_BUCKETS (%s)" % e)
+        ladder = None
+    print("max linger   :", config.get("MXSERVE_MAX_LINGER_MS"), "ms")
+    print("queue depth  :", config.get("MXSERVE_QUEUE_DEPTH"))
+    max_batch = config.get("MXSERVE_MAX_BATCH")
+    print("max batch    :", max_batch if max_batch
+          else f"(top batch rung: {ladder.max_batch})" if ladder else "?")
+    snap = telemetry.snapshot()
+    served = {k: v for k, v in snap.items() if k.startswith("mxserve_")}
+    if not served:
+        print("metrics      : none (no engine has run in this process)")
+        return
+    for k, v in sorted(served.items()):
+        print(f"  {k} = {v}")
+    after = snap.get("mxserve_recompile_after_warmup_total", 0)
+    if after:
+        print(f"  WARNING: {after} recompile(s) after warmup — the "
+              "bucket ladder does not close the jit cache")
+
+
 def main():
     check_python()
     check_pip()
@@ -155,6 +188,7 @@ def main():
     check_environment()
     check_mxnet()
     check_telemetry()
+    check_serving()
     check_mxlint()
 
 

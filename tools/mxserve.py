@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""mxserve CLI: serve / warmup / loadgen for the serving subsystem.
+
+Subcommands (see docs/serving.md):
+
+  serve    start the HTTP endpoint with one or more models
+           python tools/mxserve.py serve --port 8080 --warmup
+           python tools/mxserve.py serve --symbol model-symbol.json \\
+               --params model-0000.params --input-shape 3,224,224
+  warmup   AOT-compile every bucket rung and print the per-program
+           compile-time report (ladder tuning aid)
+           python tools/mxserve.py warmup --buckets 1,2,4,8 --json
+  loadgen  closed-loop load generator: N concurrent workers firing
+           mixed-shape requests at an in-process engine (default) or a
+           running endpoint (--url), reporting p50/p99 latency,
+           throughput, batch occupancy and after-warmup recompiles
+           python tools/mxserve.py loadgen --requests 200 --concurrency 8
+
+Without --symbol a built-in 2-layer MLP is served, so every subcommand
+runs out of the box (smoke tests, ladder tuning, CI).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _init_backend(args):
+    import jax
+    if getattr(args, "cpu", False):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def _build_model(args):
+    """The model to serve: an exported symbol (SymbolBlock.imports) or
+    the built-in MLP."""
+    from mxnet_tpu import gluon, nd
+    if args.symbol:
+        from mxnet_tpu.gluon.block import SymbolBlock
+        net = SymbolBlock.imports(args.symbol, ["data"], args.params)
+        item_shape = tuple(int(s) for s in args.input_shape.split(","))
+        return net, item_shape
+    feature = args.feature
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu", flatten=False))
+        net.add(gluon.nn.Dense(32, flatten=False))
+    net.initialize()
+    net(nd.zeros((1, feature)))  # resolve deferred shapes
+    return net, (feature,)
+
+
+def _build_engine(args):
+    from mxnet_tpu import serve
+    model, item_shape = _build_model(args)
+    ladder = serve.parse_bucket_spec(args.buckets) if args.buckets else None
+    engine = serve.ServingEngine(
+        model, input_specs=[item_shape], ladder=ladder,
+        name=args.name, max_linger_ms=args.linger_ms)
+    return engine, item_shape
+
+
+def cmd_serve(args):
+    _init_backend(args)
+    from mxnet_tpu import serve
+    engine, _ = _build_engine(args)
+    registry = serve.ModelRegistry()
+    registry.register(args.name, engine, warmup=args.warmup)
+    endpoint = serve.ServingEndpoint(registry, host=args.host,
+                                     port=args.port, verbose=args.verbose)
+    print(f"mxserve: {args.name} on {endpoint.address} "
+          f"(ladder {engine.ladder.spec()}, "
+          f"{'warmed' if engine.warmed else 'cold — POST :warmup'})")
+    try:
+        endpoint.start(background=False)
+    except KeyboardInterrupt:
+        print("mxserve: draining...")
+        endpoint.drain()
+    return 0
+
+
+def cmd_warmup(args):
+    _init_backend(args)
+    engine, item_shape = _build_engine(args)
+    t0 = time.perf_counter()
+    report = engine.warmup()
+    total = time.perf_counter() - t0
+    out = {"model": args.name, "ladder": engine.ladder.spec(),
+           "item_shape": list(item_shape), "programs": len(report),
+           "total_s": round(total, 3), "report": report}
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"warmed {len(report)} program(s) in {total:.2f}s "
+              f"(ladder {engine.ladder.spec()}):")
+        for row in report:
+            print(f"  {row['shapes']}: {row['compile_ms']:.1f} ms")
+    engine.close()
+    return 0
+
+
+def cmd_loadgen(args):
+    _init_backend(args)
+    import numpy as onp
+
+    if args.url:
+        import urllib.request
+
+        # forward the deadline so the server-side batcher enforces it,
+        # and give the client socket a little headroom on top
+        client_timeout = args.timeout_ms / 1000.0 + 5.0
+
+        def fire(payload):
+            body = json.dumps({"inputs": payload.tolist(),
+                               "timeout_ms": args.timeout_ms}).encode()
+            req = urllib.request.Request(
+                f"{args.url}/v1/models/{args.name}:predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req,
+                                        timeout=client_timeout) as resp:
+                json.loads(resp.read())
+        engine = None
+        item_shape = tuple(
+            int(s) for s in args.input_shape.split(",")) \
+            if args.input_shape else (args.feature,)
+    else:
+        engine, item_shape = _build_engine(args)
+        engine.warmup()
+
+        def fire(payload):
+            engine.predict(payload, timeout_ms=args.timeout_ms)
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve.loadgen import run_loadgen
+    recompiles_before = telemetry.recompile_count()
+    rng = onp.random.RandomState(0)
+    payloads = [rng.uniform(-1, 1, size=(1 + (i % args.max_rows),)
+                            + item_shape).astype("float32")
+                for i in range(args.requests)]
+    res = run_loadgen(fire, payloads, concurrency=args.concurrency)
+    errors = res["errors"]
+    out = {
+        "metric": "mxserve_throughput",
+        "value": round(res["throughput_rps"], 2),
+        "unit": "requests/sec",
+        "requests": args.requests,
+        "completed": res["completed"],
+        "errors": len(errors),
+        "concurrency": args.concurrency,
+        "p50_ms": round(res["p50_ms"], 3),
+        "p99_ms": round(res["p99_ms"], 3),
+        "wall_s": round(res["wall_s"], 3),
+        "recompiles_during_load":
+            telemetry.recompile_count() - recompiles_before,
+    }
+    if engine is not None:
+        stats = engine.stats()
+        out["recompiles_after_warmup"] = stats["recompiles_after_warmup"]
+        out["avg_occupancy"] = stats["batcher"]["avg_occupancy"]
+        out["shed"] = stats["batcher"]["shed"]
+        engine.close()
+    if errors and not args.json:
+        print(f"errors ({len(errors)}):", errors[:3], file=sys.stderr)
+    print(json.dumps(out))
+    return 0 if not errors else 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="mxserve", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--name", default="default", help="model name")
+        sp.add_argument("--buckets", default="",
+                        help="bucket spec (default: MXSERVE_BUCKETS)")
+        sp.add_argument("--linger-ms", type=float, default=None,
+                        help="max linger (default: MXSERVE_MAX_LINGER_MS)")
+        sp.add_argument("--symbol", default="",
+                        help="exported -symbol.json to serve")
+        sp.add_argument("--params", default=None,
+                        help="exported -NNNN.params file")
+        sp.add_argument("--input-shape", default="",
+                        help="per-item shape for --symbol, e.g. 3,224,224")
+        sp.add_argument("--feature", type=int, default=16,
+                        help="built-in MLP feature width")
+        sp.add_argument("--cpu", action="store_true",
+                        help="pin the jax backend to CPU")
+
+    sp = sub.add_parser("serve", help="start the HTTP endpoint")
+    common(sp)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument("--warmup", action="store_true",
+                    help="AOT warmup before accepting traffic")
+    sp.add_argument("--verbose", action="store_true")
+    sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser("warmup", help="AOT-compile the ladder, report")
+    common(sp)
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_warmup)
+
+    sp = sub.add_parser("loadgen", help="closed-loop load generator")
+    common(sp)
+    sp.add_argument("--url", default="",
+                    help="target a running endpoint instead of in-process")
+    sp.add_argument("--requests", type=int, default=200)
+    sp.add_argument("--concurrency", type=int, default=8)
+    sp.add_argument("--max-rows", type=int, default=4,
+                    help="request row counts cycle 1..max-rows")
+    sp.add_argument("--timeout-ms", type=float, default=30000.0)
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_loadgen)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
