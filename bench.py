@@ -498,6 +498,115 @@ def serving_main():
     _emit(value, unit="requests/sec", **record)
 
 
+def chaos_main():
+    """Chaos-recovery benchmark (--chaos / MXTPU_BENCH_CHAOS=1): measure
+    training throughput through three phases — fault-free baseline,
+    injected kvstore faults (MXRESIL_FAULT_PLAN probabilistic raise,
+    absorbed by the resil retry policies), and post-fault recovery —
+    and emit ONE BENCH-schema JSON line (metric mxresil_chaos_recovery,
+    value = recovered/baseline throughput ratio). The contract the
+    resilience subsystem makes: recovery >= 0.9x baseline, and ZERO
+    retries recorded when no fault plan is set. Knobs:
+    MXTPU_BENCH_CHAOS_STEPS / _FAULT_PROB."""
+    os.environ.setdefault("MXTPU_BENCH_FORCE_CPU", "1")  # host-side path
+    jax, devices, probe_status = _init_jax()
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, gluon, nd, telemetry
+
+    # 5% per-attempt fault rate: hot enough to exercise retries on most
+    # runs, cool enough that the per-call retry cap (3) and the shared
+    # retry budget absorb it — a sustained 30%+ failure rate is breaker
+    # territory, not retry territory
+    n_steps = int(os.environ.get("MXTPU_BENCH_CHAOS_STEPS", "60"))
+    prob = float(os.environ.get("MXTPU_BENCH_CHAOS_FAULT_PROB", "0.05"))
+
+    # the chaos bench OWNS the fault plan: an ambient operator plan
+    # would corrupt the fault-free baseline (and a kill/preempt plan
+    # would take down the bench child outright)
+    os.environ.pop("MXRESIL_FAULT_PLAN", None)
+    config.unset_flag("MXRESIL_FAULT_PLAN")
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu", flatten=False))
+        net.add(gluon.nn.Dense(8, flatten=False))
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    # an EXPLICIT local kvstore instance: single-device string configs
+    # short-circuit to kv=None (model._create_kvstore), and the chaos
+    # faults are injected at the kvstore.push/pull sites
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01},
+                            kvstore=mx.kv.create("local"))
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, size=(16, 32)).astype("float32"))
+    y = nd.array(rng.uniform(-1, 1, size=(16, 8)).astype("float32"))
+
+    from mxnet_tpu import autograd
+
+    def one_step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(16)
+
+    def timed_phase(steps):
+        """steps/sec from the MEDIAN per-step time — robust to
+        unrelated load spikes on a shared CI host (the ratio contract
+        compares phases run minutes apart)."""
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            one_step()
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return 1.0 / max(times[len(times) // 2], 1e-9)
+
+    retries = telemetry.metrics.counter("mxresil_retries_total")
+    injected = telemetry.metrics.counter("mxresil_injected_faults_total")
+
+    for _ in range(5):  # warmup: compile before any phase is timed
+        one_step()
+
+    # phase A: fault-free baseline — the zero-retry contract
+    r0 = retries.value()
+    rate_baseline = timed_phase(n_steps)
+    retries_baseline = retries.value() - r0
+
+    # phase B: probabilistic kvstore faults, retries absorb them
+    # fixed-point format: bare f-string floats render tiny probabilities
+    # in scientific notation, which the plan grammar rejects
+    config.set_flag("MXRESIL_FAULT_PLAN",
+                    f"kvstore.push%{prob:.6f}=raise")
+    i0, r0 = injected.value(), retries.value()
+    rate_faulted = timed_phase(n_steps)
+    faults_injected = injected.value() - i0
+    retries_during_fault = retries.value() - r0
+    config.unset_flag("MXRESIL_FAULT_PLAN")
+
+    # phase C: plan cleared — throughput must re-converge
+    rate_recovered = timed_phase(n_steps)
+
+    ratio = round(rate_recovered / rate_baseline, 4) if rate_baseline \
+        else None
+    record = dict(
+        metric="mxresil_chaos_recovery",
+        steps_per_phase=n_steps, fault_prob=prob,
+        baseline_steps_per_sec=round(rate_baseline, 2),
+        faulted_steps_per_sec=round(rate_faulted, 2),
+        recovered_steps_per_sec=round(rate_recovered, 2),
+        faults_injected=faults_injected,
+        retries_during_fault=retries_during_fault,
+        retries_baseline=retries_baseline,
+        recovered=ratio is not None and ratio >= 0.9
+        and retries_baseline == 0,
+        platform=devices[0].platform,
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(ratio, unit="recovered/baseline throughput ratio", **record)
+
+
 def _parent():
     """Run the bench in a KILLABLE subprocess and own the one-JSON-line
     contract. A SIGALRM watchdog cannot interrupt a hang inside C code
@@ -510,6 +619,8 @@ def _parent():
     # corrupt the BENCH schema's attribution
     metric = ("mxserve_throughput"
               if os.environ.get("MXTPU_BENCH_SERVING") == "1"
+              else "mxresil_chaos_recovery"
+              if os.environ.get("MXTPU_BENCH_CHAOS") == "1"
               else "resnet50_train_throughput")
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
@@ -546,16 +657,26 @@ def _parent():
 
 if __name__ == "__main__":
     # --serving / MXTPU_BENCH_SERVING=1 selects the mxserve loadgen
-    # bench (serving_main); the env form propagates into the child
+    # bench (serving_main); --chaos / MXTPU_BENCH_CHAOS=1 the resil
+    # chaos-recovery bench; the env forms propagate into the child
     if "--serving" in sys.argv:
         os.environ["MXTPU_BENCH_SERVING"] = "1"
+    if "--chaos" in sys.argv:
+        os.environ["MXTPU_BENCH_CHAOS"] = "1"
     _serving = os.environ.get("MXTPU_BENCH_SERVING") == "1"
+    _chaos = os.environ.get("MXTPU_BENCH_CHAOS") == "1"
     if "--child" in sys.argv:
         try:
-            serving_main() if _serving else main()
+            if _serving:
+                serving_main()
+            elif _chaos:
+                chaos_main()
+            else:
+                main()
         except Exception as e:
             _emit(None, vs=None,
                   metric=("mxserve_throughput" if _serving
+                          else "mxresil_chaos_recovery" if _chaos
                           else "resnet50_train_throughput"),
                   error=f"{type(e).__name__}: {e}"[:500])
             sys.exit(0)

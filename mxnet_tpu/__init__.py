@@ -46,8 +46,10 @@ if _os.environ.get("MXNET_USE_INT64_TENSOR_SIZE", "0").lower() in (
 # Wire this process into a multi-worker job before anything touches the
 # XLA backend, when launched by tools/launch.py (ref role: the DMLC_ROLE
 # bootstrap that runs on `import mxnet`, python/mxnet/kvstore_server.py:76).
+from .base import ensure_jax_compat as _ensure_jax_compat
 from .base import initialize_distributed as _init_dist
 
+_ensure_jax_compat()
 _init_dist()
 
 
@@ -112,6 +114,7 @@ from .monitor import Monitor  # noqa: F401
 from . import profiler  # noqa: F401
 from . import telemetry  # noqa: F401  (op tracing, recompile/memory accounting, metrics)
 from . import serve  # noqa: F401  (dynamic-batching inference serving)
+from . import resil  # noqa: F401  (fault injection, retry policies, preemption guard, watchdogs)
 from . import rtc  # noqa: F401
 from . import subgraph  # noqa: F401
 from . import executor_manager  # noqa: F401

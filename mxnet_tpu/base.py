@@ -191,6 +191,42 @@ def worker_rank(default=0):
     return default
 
 
+def ensure_jax_compat():
+    """Forward-compat shims for older jax releases (same role as the
+    jax.distributed.is_initialized probe below): this codebase writes
+    the modern ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+    check_vma=..., axis_names=...)`` spelling, which older jax only
+    offers as ``jax.experimental.shard_map.shard_map(f, mesh, in_specs,
+    out_specs, check_rep=..., auto=...)``. Install an adapter so the
+    collectives/pipeline/ring-attention layers run on either."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _esm
+    except Exception:
+        return
+
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, axis_names=None):
+        kwargs = {}
+        rep = check_rep if check_rep is not None else check_vma
+        if rep is not None:
+            kwargs["check_rep"] = rep
+        if axis_names is not None:
+            if mesh is None:
+                raise NotImplementedError(
+                    "axis_names without an explicit mesh (nested "
+                    "partial-manual shard_map) needs jax.shard_map; "
+                    "this jax release only has the experimental API")
+            # modern axis_names = MANUAL axes; legacy auto = the rest
+            kwargs["auto"] = frozenset(mesh.axis_names) - \
+                frozenset(axis_names)
+        return _esm(f, mesh, in_specs, out_specs, **kwargs)
+
+    jax.shard_map = shard_map
+
+
 def _distributed_is_initialized(jax_mod) -> bool:
     """`jax.distributed.is_initialized` only exists on newer jax; older
     releases expose the same fact via the global distributed state."""

@@ -28,6 +28,7 @@ import pickle
 import shutil
 import tempfile
 import threading
+import zlib
 from typing import Dict, Optional
 
 from .base import MXNetError, get_logger
@@ -37,6 +38,26 @@ __all__ = ["CheckpointManager"]
 _log = get_logger("mxnet_tpu.checkpoint")
 
 _MANIFEST = "manifest.json"
+
+
+def _array_crc(arr) -> int:
+    """Content digest of one (host) array: crc32 over the contiguous
+    bytes. Cheap enough to run per save, strong enough to catch the
+    torn-write / truncated-file corruption restore must detect."""
+    import numpy as onp
+    a = onp.ascontiguousarray(arr.asnumpy() if hasattr(arr, "asnumpy")
+                              else arr)
+    return zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+
+
+def _fsync_path(path: str):
+    """fsync a file (or directory) by path — the payload must be
+    durable BEFORE the manifest that declares it complete."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 class CheckpointManager:
@@ -97,34 +118,73 @@ class CheckpointManager:
 
     def _write(self, step, host_params, opt_state, extra):
         try:
-            final = os.path.join(self.directory, f"step_{step}")
-            tmp = tempfile.mkdtemp(prefix=f".step_{step}_",
-                                   dir=self.directory)
+            # resil hook: retried on injected/transient faults — a
+            # failed attempt cleans up its own temp dir and never
+            # leaves a half-valid checkpoint, so blanket retry is sound
+            from .resil.hooks import guarded as _guarded
+            _guarded("checkpoint.write", self._write_attempt,
+                     step, host_params, opt_state, extra)
+            self._retain()
+        except BaseException as e:  # surfaced on next save()/wait()
+            self._error = e
+
+    def _write_attempt(self, step, host_params, opt_state, extra):
+        """One crash-safe commit: payload into a temp dir, fsync every
+        file, digest-carrying manifest last (also fsynced), atomic
+        rename, directory fsync. A crash at ANY point leaves either the
+        previous checkpoint or a manifest-less temp dir that restore
+        ignores."""
+        final = os.path.join(self.directory, f"step_{step}")
+        tmp = tempfile.mkdtemp(prefix=f".step_{step}_",
+                               dir=self.directory)
+        try:
             from .ndarray import ndarray as nd_mod
             from .ndarray.ndarray import array as nd_array
-            nd_mod.save(os.path.join(tmp, "params"),
-                        {k: nd_array(v) for k, v in host_params.items()})
+            # digest the SAME canonicalized arrays that hit the disk:
+            # nd_array canonicalizes dtypes (int64->int32, float64->
+            # float32 with jax x64 off), so a digest of the raw host
+            # input would never match what restore loads back
+            nd_params = {k: nd_array(v) for k, v in host_params.items()}
+            nd_mod.save(os.path.join(tmp, "params"), nd_params)
+            _fsync_path(os.path.join(tmp, "params"))
             if opt_state is not None:
                 with open(os.path.join(tmp, "opt_state"), "wb") as f:
                     f.write(opt_state)
+                    f.flush()
+                    os.fsync(f.fileno())
             if extra is not None:
                 with open(os.path.join(tmp, "extra"), "wb") as f:
                     pickle.dump(extra, f)
-            # manifest LAST: its presence marks completeness
+                    f.flush()
+                    os.fsync(f.fileno())
+            # manifest LAST: its presence marks completeness, and its
+            # digests/sizes let restore tell "intact" from "truncated"
+            arrays = {
+                k: {"crc32": _array_crc(v),
+                    "shape": list(v.shape),
+                    "dtype": str(v.dtype)}
+                for k, v in nd_params.items()}
+            files = {name: os.path.getsize(os.path.join(tmp, name))
+                     for name in ("params", "opt_state", "extra")
+                     if os.path.exists(os.path.join(tmp, name))}
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
                 json.dump({"step": step,
                            "params": sorted(host_params),
+                           "arrays": arrays,
+                           "files": files,
                            "has_opt_state": opt_state is not None,
                            "has_extra": extra is not None}, f)
                 f.flush()
                 os.fsync(f.fileno())
+            _fsync_path(tmp)
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.replace(tmp, final)
-            self._retain()
-        except BaseException as e:  # surfaced on next save()/wait()
-            self._error = e
+            _fsync_path(self.directory)  # the rename itself is durable
+        except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        return final
 
     def _retain(self):
         steps = sorted(self.all_steps())
@@ -162,12 +222,60 @@ class CheckpointManager:
 
     def restore(self, step: int, trainer=None):
         """Load checkpoint `step`; returns (params, opt_state, extra) and,
-        if trainer= given, installs the state into it."""
+        if trainer= given, installs the state into it.
+
+        Integrity-checked: file sizes and per-array crc32 digests from
+        the manifest must match what is on disk — a truncated or
+        bit-flipped checkpoint raises here, and ``restore_latest``
+        falls back to the newest INTACT step instead of handing the
+        trainer corrupt weights. (Pre-digest manifests from older
+        checkpoints load without verification.)
+
+        Runs under the 'checkpoint.restore' site policy: transient
+        faults are retried; only genuine corruption (MXNetError, not
+        retryable) falls through to the restore_latest fallback."""
+        from .resil.hooks import guarded as _guarded
+        params, opt_state, extra = _guarded(
+            "checkpoint.restore", self._restore_attempt, step)
+        if trainer is not None:
+            self._install(trainer, params, opt_state)
+        return params, opt_state, extra
+
+    def _restore_attempt(self, step: int):
         path = os.path.join(self.directory, f"step_{step}")
         if not os.path.exists(os.path.join(path, _MANIFEST)):
             raise MXNetError(f"no complete checkpoint at step {step}")
+        with open(os.path.join(path, _MANIFEST)) as f:
+            try:
+                manifest = json.load(f)
+            except ValueError as e:
+                raise MXNetError(
+                    f"checkpoint step_{step}: corrupt manifest ({e})")
+        for name, size in (manifest.get("files") or {}).items():
+            fpath = os.path.join(path, name)
+            if not os.path.exists(fpath):
+                raise MXNetError(
+                    f"checkpoint step_{step}: missing payload {name!r}")
+            actual = os.path.getsize(fpath)
+            if actual != size:
+                raise MXNetError(
+                    f"checkpoint step_{step}: truncated/corrupt "
+                    f"{name!r} ({actual} bytes, manifest says {size})")
         from .ndarray import ndarray as nd_mod
         params = nd_mod.load(os.path.join(path, "params"))
+        digests = manifest.get("arrays") or {}
+        if digests:
+            if sorted(params) != sorted(digests):
+                raise MXNetError(
+                    f"checkpoint step_{step}: params keys do not match "
+                    "the manifest")
+            for name, meta in digests.items():
+                crc = _array_crc(params[name])
+                if crc != meta["crc32"]:
+                    raise MXNetError(
+                        f"checkpoint step_{step}: array {name!r} fails "
+                        f"its digest (crc32 {crc:#x} != manifest "
+                        f"{meta['crc32']:#x}) — corrupt payload")
         opt_state = None
         if os.path.exists(os.path.join(path, "opt_state")):
             with open(os.path.join(path, "opt_state"), "rb") as f:
@@ -176,8 +284,6 @@ class CheckpointManager:
         if os.path.exists(os.path.join(path, "extra")):
             with open(os.path.join(path, "extra"), "rb") as f:
                 extra = pickle.load(f)
-        if trainer is not None:
-            self._install(trainer, params, opt_state)
         return params, opt_state, extra
 
     def restore_latest(self, trainer=None):

@@ -315,6 +315,51 @@ register_flag(
     "Row cap per serving dispatch (serve.batcher). 0 (default) = the "
     "ladder's top batch rung.")
 register_flag(
+    "MXRESIL_FAULT_PLAN", str, "",
+    "Deterministic fault-injection plan (resil.faultplan), e.g. "
+    "'step:40=preempt;kvstore.push@3=raise;io=stall:200ms' — "
+    "semicolon-separated site[@K|%P|:STEP]=action[:arg] clauses "
+    "evaluated at the wired injection sites (kvstore.push/pull, io, "
+    "serve.submit, checkpoint.write/restore, step). Empty = injection "
+    "off (the hooks are no-ops). See docs/resilience.md.")
+register_flag(
+    "MXRESIL_SEED", int, 0,
+    "Seed for probabilistic fault-plan clauses (site%P): a fixed seed "
+    "reproduces the same fault sequence bit-for-bit "
+    "(resil.faultplan.Clause).")
+register_flag(
+    "MXRESIL_RETRY_MAX", int, 3,
+    "Max retries per call for the site retry policies "
+    "(resil.policy.RetryPolicy) wrapping kvstore push/pull and "
+    "checkpoint I/O; only typed RetryableErrors are retried.")
+register_flag(
+    "MXRESIL_RETRY_BASE_MS", float, 10.0,
+    "First-retry backoff in milliseconds; subsequent retries double "
+    "it with jitter (resil.policy.BackoffSchedule).")
+register_flag(
+    "MXRESIL_RETRY_MAX_MS", float, 2000.0,
+    "Backoff ceiling in milliseconds (resil.policy.BackoffSchedule).")
+register_flag(
+    "MXRESIL_BREAKER_FAILURES", int, 5,
+    "Consecutive failures that trip a site circuit breaker to OPEN "
+    "(fail-fast degraded mode; resil.policy.CircuitBreaker).")
+register_flag(
+    "MXRESIL_BREAKER_COOLDOWN_S", float, 30.0,
+    "Seconds an open circuit breaker waits before admitting one "
+    "half-open probe (resil.policy.CircuitBreaker).")
+register_flag(
+    "MXRESIL_WATCHDOG_STALL_S", float, 0.0,
+    "Heartbeat age that counts as a stall (resil.watchdog.Watchdog). "
+    "0 = auto: 10x the step-time EWMA (min 1 s; 30 s before any step "
+    "has been observed).")
+register_flag(
+    "MXNET_KVSTORE_TIMEOUT_MS", float, 0.0,
+    "Per-request timeout for kvstore data-plane push/pull over the "
+    "dist_async transport: exceeding it raises the typed "
+    "KVStoreTimeoutError (retryable by resil policies) instead of "
+    "hanging. 0 (default) = fall back to the barrier-timeout-based "
+    "socket deadline. An active resil deadline_scope caps it further.")
+register_flag(
     "MXNET_KVSTORE_BARRIER_TIMEOUT", float, 300.0,
     "Seconds a worker waits at a dist barrier before declaring the "
     "job failed (failure detection, SURVEY.md §5.3; the reference's "

@@ -269,8 +269,15 @@ class PrefetchingIter(DataIter):
         q, stop = self._queue, self._stop
 
         def worker():
+            from ..resil import faultplan as _faultplan
+
             while not stop.is_set():
                 try:
+                    # resil 'io' site: MXRESIL_FAULT_PLAN stalls/faults
+                    # the prefetch worker here — an injected raise rides
+                    # the existing sentinel path below, so drills prove
+                    # the consumer is never stranded
+                    _faultplan.inject("io")
                     batches = [it.next() for it in self.iters]
                 except StopIteration:
                     q.put(None)

@@ -31,9 +31,17 @@ import jax.numpy as jnp
 
 from .base import MXNetError, get_env
 from .ndarray.ndarray import NDArray, _wrap, zeros as nd_zeros
+from .resil.policy import RetryableError
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDist", "KVStoreDistAsync",
-           "create"]
+           "KVStoreTimeoutError", "create"]
+
+
+class KVStoreTimeoutError(RetryableError):
+    """A kvstore data-plane request exceeded MXNET_KVSTORE_TIMEOUT_MS
+    (or the barrier-based socket deadline). Typed and retryable: resil
+    policies retry it with backoff instead of the job hanging on a dead
+    or partitioned server."""
 
 
 def _key_str(key):
@@ -101,28 +109,39 @@ class KVStoreBase:
         return _wrap(total)
 
     def push(self, key, value, priority=0):
+        # resil hook: fault injection runs BEFORE any store mutation, so
+        # a retried attempt never double-applies an update; only typed
+        # RetryableErrors (injected faults, timeouts) are retried
+        from .resil.hooks import guarded as _guarded
         with _kv_timer("kvstore_push_seconds"):
-            for k, vals in self._group(key, value).items():
-                agg = self._reduce(vals)
-                agg = self._global_reduce(k, agg)
-                if self._updater is not None:
-                    if k not in self._store:
-                        raise MXNetError(f"key {k} was not init'd")
-                    self._updater(_updater_key(k), agg, self._store[k])
-                else:
-                    if k in self._store:
-                        self._store[k] += agg
-                    else:
-                        self._store[k] = agg
+            _guarded("kvstore.push", self._push_impl, key, value, priority)
 
-    def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        with _kv_timer("kvstore_pull_seconds"):
-            for k, tgts in self._group(key, out).items():
+    def _push_impl(self, key, value, priority=0):
+        for k, vals in self._group(key, value).items():
+            agg = self._reduce(vals)
+            agg = self._global_reduce(k, agg)
+            if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"key {k} was not init'd")
-                src = self._store[k]
-                for t in tgts:
-                    t._rebind(src._data.astype(t._data.dtype))
+                self._updater(_updater_key(k), agg, self._store[k])
+            else:
+                if k in self._store:
+                    self._store[k] += agg
+                else:
+                    self._store[k] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .resil.hooks import guarded as _guarded
+        with _kv_timer("kvstore_pull_seconds"):
+            _guarded("kvstore.pull", self._pull_impl, key, out, priority)
+
+    def _pull_impl(self, key, out=None, priority=0):
+        for k, tgts in self._group(key, out).items():
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not init'd")
+            src = self._store[k]
+            for t in tgts:
+                t._rebind(src._data.astype(t._data.dtype))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only requested rows (ref: kvstore.py:248 row_sparse_pull —
@@ -302,15 +321,23 @@ class KVStoreDistAsync(KVStoreBase):
             self._client.request("init", k, v.asnumpy())
 
     def push(self, key, value, priority=0):
+        # retried on KVStoreTimeoutError / injected faults. The async
+        # server applies pushes per-arrival, so a retry after a timeout
+        # whose request DID land is at-least-once — the same contract as
+        # the reference's ps-lite resend path (docs/resilience.md).
+        from .resil.hooks import guarded as _guarded
         with _kv_timer("kvstore_push_seconds"):
             for k, vals in self._group(key, value).items():
                 agg = self._reduce(vals)  # local device shards only
-                self._client.request("push", k, agg.asnumpy())
+                _guarded("kvstore.push", self._client.request,
+                         "push", k, agg.asnumpy())
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .resil.hooks import guarded as _guarded
         with _kv_timer("kvstore_pull_seconds"):
             for k, tgts in self._group(key, out).items():
-                cur = self._client.request("pull", k)
+                cur = _guarded("kvstore.pull", self._client.request,
+                               "pull", k)
                 for t in tgts:
                     t._rebind(jnp.asarray(cur).astype(t._data.dtype))
 

@@ -258,12 +258,13 @@ class KVClient:
 
     def __init__(self, address: str, retries: int = 50):
         host, _, port = address.partition(":")
+        self._addr = (host or "127.0.0.1", int(port))
         self._lock = threading.Lock()
         last = None
         for _ in range(retries):
             try:
-                self._sock = socket.create_connection(
-                    (host or "127.0.0.1", int(port)), timeout=60)
+                self._sock = socket.create_connection(self._addr,
+                                                      timeout=60)
                 break
             except OSError as e:  # server may not be up yet
                 last = e
@@ -271,25 +272,91 @@ class KVClient:
         else:
             raise MXNetError(f"cannot reach kvstore server {address}: {last}")
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # connect probing used a 60s timeout; requests may legitimately
-        # block for a full barrier (bounded SERVER-side by
-        # MXNET_KVSTORE_BARRIER_TIMEOUT), but must not hang forever if
-        # the server HOST dies without FIN/RST — cap recv at the barrier
-        # deadline plus margin
+
+    def _request_timeout_s(self, cmd: str) -> float:
+        """Per-request recv deadline.
+
+        Data-plane requests honor MXNET_KVSTORE_TIMEOUT_MS (so a dead
+        or partitioned server surfaces as a typed, retryable timeout
+        instead of a hang); barriers may legitimately block for the
+        full barrier window (bounded SERVER-side by
+        MXNET_KVSTORE_BARRIER_TIMEOUT), so they keep the barrier
+        deadline + margin. An active resil deadline_scope caps either.
+        """
         from .base import get_env
-        self._sock.settimeout(
-            float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0)) + 60.0)
+        barrier_based = float(
+            get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0)) + 60.0
+        if cmd in ("push", "pull"):
+            # only the RETRIED data plane gets the short deadline —
+            # one-shot control commands (init, barrier, optimizer
+            # state) have no retry wrapper, so a short timeout there
+            # would turn a startup blip into a job crash
+            t_ms = float(get_env("MXNET_KVSTORE_TIMEOUT_MS", 0.0))
+            timeout = t_ms / 1000.0 if t_ms > 0 else barrier_based
+        else:
+            timeout = barrier_based
+        from .resil.policy import remaining_deadline
+        left = remaining_deadline()
+        if left is not None:
+            timeout = max(0.001, min(timeout, left))
+        return timeout
+
+    def _reconnect(self):
+        """After a timeout the stream may still carry the late reply to
+        the abandoned request — a fresh connection is the only way a
+        retry can't read a stale frame. On failure the socket is left
+        as None and the next request() retries the connect (typed
+        timeout again, so retry policies keep driving recovery)."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        try:
+            sock = socket.create_connection(self._addr, timeout=5)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+        except OSError:
+            pass  # still down: stays None, retried on the next request
 
     def request(self, cmd: str, key=None, payload=None):
-        try:
-            with self._lock:
+        with self._lock:
+            # resolve the timeout AFTER acquiring the lock: a thread
+            # that waited behind a slow barrier must apply whatever is
+            # LEFT of its deadline scope, not a stale pre-wait value
+            timeout = self._request_timeout_s(cmd)
+            try:
+                if self._sock is None:
+                    self._reconnect()  # a previous reconnect failed
+                    if self._sock is None:
+                        from .kvstore import KVStoreTimeoutError
+                        raise KVStoreTimeoutError(
+                            f"kvstore server {self._addr[0]}:"
+                            f"{self._addr[1]} unreachable during "
+                            f"'{cmd}' (reconnect failed) — typed, "
+                            "safe to retry")
+                self._sock.settimeout(timeout)
                 _send_msg(self._sock, (cmd, key, payload))
                 status, reply = _recv_msg(self._sock)
-        except socket.timeout:
-            raise MXNetError(
-                f"kvstore server unresponsive during '{cmd}' (host "
-                "dead or partitioned? recv exceeded the barrier "
-                "deadline + margin)") from None
+            except OSError as e:
+                # ALL transport failures — recv timeout (silent
+                # partition), ConnectionError/BrokenPipeError (server
+                # crashed with FIN/RST) — surface as the typed
+                # retryable error so resil policies drive recovery.
+                # Reconnect INSIDE this critical section: releasing the
+                # lock first would let another thread send on the stale
+                # socket and read this request's late reply as its own.
+                self._reconnect()
+                from .kvstore import KVStoreTimeoutError
+                detail = (f"no reply within {timeout * 1000:.0f} ms "
+                          "(host dead or partitioned?)"
+                          if isinstance(e, socket.timeout)
+                          else f"transport failure ({e})")
+                raise KVStoreTimeoutError(
+                    f"kvstore server unresponsive during '{cmd}': "
+                    f"{detail} — typed timeout, safe to retry"
+                ) from None
         if status != "ok":
             raise MXNetError(f"kvstore server: {reply}")
         return reply
@@ -299,7 +366,8 @@ class KVClient:
             self.request("stop")
         except Exception:
             pass
-        self._sock.close()
+        if self._sock is not None:
+            self._sock.close()
 
 
 _local_server: Optional[KVServer] = None

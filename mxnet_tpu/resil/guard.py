@@ -1,0 +1,292 @@
+"""TrainGuard: the preemption-aware training scope.
+
+Cloud TPU workers are preempted with a SIGTERM and a short grace
+window; the reference's answer was epoch-granularity checkpoint-restart
+(ref: callback.py do_checkpoint). TrainGuard upgrades that to
+step-granularity with bounded loss:
+
+    mgr = CheckpointManager(dir)
+    with TrainGuard(mgr, trainer=trainer,
+                    checkpoint_every=100) as guard:
+        start = guard.resume()              # restore_latest on restart
+        for step in range(start, target):
+            loss = train_step(batch[step])
+            if not guard.completed(step, loss=loss):
+                continue                    # non-finite: rolled back
+
+- SIGTERM/SIGINT set a flag; at the NEXT step boundary ``completed()``
+  writes an **emergency checkpoint** (the in-flight async save is
+  drained first, then the save is awaited — commit is guaranteed before
+  exit) and raises :class:`Preempted`. The handler itself does nothing
+  unsafe: no I/O from signal context.
+- Non-finite losses (inf/nan — the divergence signature) are counted
+  and **rolled back**: parameters reload from the newest intact
+  checkpoint instead of poisoning every later step. More than
+  ``nonfinite_limit`` consecutive rollbacks raises — the run has
+  diverged and restarting won't fix it.
+- Every boundary runs the ``step`` fault-injection site (so plans like
+  ``step:40=preempt`` and ``step:7=nan`` drive drills) and beats the
+  watchdog when one is attached.
+
+The guard restores prior signal dispositions on exit and composes with
+the driver loop of ``tools/mxresil.py drill``, which measures MTTR and
+steps-lost across a preempt/restart cycle.
+"""
+from __future__ import annotations
+
+import math
+import signal
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..base import MXNetError, get_logger
+from . import faultplan
+from .watchdog import Watchdog
+
+__all__ = ["Preempted", "TrainGuard", "last_emergency"]
+
+_log = get_logger("mxnet_tpu.resil.guard")
+
+# (step, unix ts, directory) of the newest emergency checkpoint this
+# process committed — surfaced by tools/diagnose.py
+_LAST_EMERGENCY: Optional[Dict[str, object]] = None
+
+
+def last_emergency() -> Optional[Dict[str, object]]:
+    return _LAST_EMERGENCY
+
+
+class Preempted(MXNetError):
+    """Raised at the step boundary after the emergency checkpoint
+    committed. ``step`` is the last COMPLETED step."""
+
+    def __init__(self, step: int, signum: int):
+        super().__init__(
+            f"preempted (signal {signum}) after step {step}; emergency "
+            "checkpoint committed — exit and restart to resume")
+        self.step = step
+        self.signum = signum
+
+
+class TrainGuard:
+    """Context manager guarding a training loop (see module docstring).
+
+    State sources, exactly one required for checkpointing:
+    ``trainer=`` (anything :class:`CheckpointManager` understands) or
+    ``params_fn=`` (zero-arg callable returning the params dict to
+    snapshot). In ``params_fn`` mode the guard cannot install restored
+    state by itself — pass ``restore_fn(params, opt_state, extra)`` to
+    receive it on :meth:`resume` and on non-finite rollback; without
+    one, non-finite steps are SKIPPED (counted, not rolled back).
+    ``extra_fn`` may add a user dict to every checkpoint.
+    """
+
+    def __init__(self, manager, trainer=None,
+                 params_fn: Optional[Callable[[], Dict]] = None,
+                 restore_fn: Optional[Callable] = None,
+                 extra_fn: Optional[Callable[[], Dict]] = None,
+                 checkpoint_every: int = 0, nonfinite_limit: int = 3,
+                 watchdog: Optional[Watchdog] = None,
+                 install_signals: bool = True,
+                 signals=(signal.SIGTERM, signal.SIGINT)):
+        if trainer is None and params_fn is None:
+            raise MXNetError("TrainGuard needs trainer= or params_fn=")
+        self.manager = manager
+        self.trainer = trainer
+        self.params_fn = params_fn
+        self.restore_fn = restore_fn
+        self.extra_fn = extra_fn
+        self.checkpoint_every = int(checkpoint_every)
+        self.nonfinite_limit = int(nonfinite_limit)
+        self.watchdog = watchdog
+        self.install_signals = install_signals
+        self.signals = tuple(signals)
+        self._prev_handlers = {}
+        self._preempt_signum: Optional[int] = None
+        self._preempt_noted = False
+        self._nonfinite_streak = 0
+        self._last_step_t: Optional[float] = None
+        self._entered = False
+        from ..telemetry import metrics as _metrics
+        self._m_preempt = _metrics.counter(
+            "mxresil_preemptions_total", "preemption signals observed")
+        self._m_emergency = _metrics.counter(
+            "mxresil_emergency_ckpt_total",
+            "emergency checkpoints committed")
+        self._m_nonfinite = _metrics.counter(
+            "mxresil_nonfinite_steps_total",
+            "steps skipped/rolled back on non-finite loss")
+        self._m_rollbacks = _metrics.counter(
+            "mxresil_rollbacks_total",
+            "parameter rollbacks to the last intact checkpoint")
+        self._g_emergency_step = _metrics.gauge(
+            "mxresil_last_emergency_ckpt_step",
+            "step of the newest emergency checkpoint (-1 = none)")
+
+    # -- lifecycle --------------------------------------------------------
+    def __enter__(self) -> "TrainGuard":
+        self._entered = True
+        if self.install_signals and \
+                threading.current_thread() is threading.main_thread():
+            for sig in self.signals:
+                try:
+                    self._prev_handlers[sig] = signal.signal(
+                        sig, self._on_signal)
+                except (ValueError, OSError):  # embedded interpreter
+                    pass
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers.clear()
+        self._entered = False
+        return False
+
+    def _on_signal(self, signum, frame):
+        # signal context: set the flag and NOTHING else — the metrics
+        # registry and the logging module both take non-reentrant locks
+        # the interrupted main thread may already hold (Trainer.step
+        # updates counters constantly); counting/logging happen at the
+        # next step boundary via _note_preempt
+        self._preempt_signum = signum
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempt_signum is not None
+
+    def request_preempt(self, signum: int = signal.SIGTERM):
+        """Programmatic preemption (tests / embedders without signals)."""
+        self._preempt_signum = signum
+
+    def _note_preempt(self):
+        if self._preempt_signum is not None and not self._preempt_noted:
+            self._preempt_noted = True
+            self._m_preempt.inc()
+            _log.warning("received signal %d: emergency checkpoint at "
+                         "this step boundary", self._preempt_signum)
+
+    # -- resume -----------------------------------------------------------
+    def resume(self) -> int:
+        """Restore the newest intact checkpoint; returns the step to
+        START from (0 on a fresh boot).
+
+        Single-load restore_latest shape (corrupt steps fall back), but
+        keeping the restore() tuple so ``next_step`` comes from the one
+        load instead of deserializing and digest-checking twice."""
+        restored = self._restore_newest_intact()
+        if restored is None:
+            return 0
+        step, (_, _, extra) = restored
+        if isinstance(extra, dict) and "next_step" in extra:
+            return int(extra["next_step"])
+        return int(step)
+
+    def _restore_newest_intact(self):
+        """Single-load restore-latest: returns (step, restore() tuple)
+        of the newest INTACT checkpoint, installed into the trainer or
+        handed to ``restore_fn``; None when nothing usable exists."""
+        for step in reversed(self.manager.all_steps()):
+            try:
+                loaded = self.manager.restore(step, trainer=self.trainer)
+            except Exception as e:  # corrupt payload: fall back further
+                _log.warning("checkpoint step_%d unusable (%s); "
+                             "falling back", step, e)
+                continue
+            if self.trainer is None and self.restore_fn is not None:
+                self.restore_fn(*loaded)
+            return step, loaded
+        return None
+
+    # -- the step boundary ------------------------------------------------
+    def completed(self, step: int, loss=None) -> bool:
+        """Mark training step ``step`` complete.
+
+        Returns False when the step was REJECTED (non-finite loss; the
+        parameters were rolled back) — the caller should not count it.
+        Raises :class:`Preempted` after committing an emergency
+        checkpoint when a preemption signal arrived."""
+        self._note_preempt()  # safe context now: count + log the signal
+        now = time.perf_counter()
+        if self.watchdog is not None:
+            self.watchdog.beat(
+                step_seconds=(now - self._last_step_t
+                              if self._last_step_t is not None else None))
+        self._last_step_t = now
+
+        # fault-plan boundary: step:N clauses (preempt/kill/raise/nan)
+        token = faultplan.inject("step", step=step)
+        if token == "nan":
+            loss = float("nan")
+
+        if loss is not None and not self._finite(loss):
+            self._m_nonfinite.inc()
+            self._nonfinite_streak += 1
+            rolled = self._rollback(step)
+            if self._nonfinite_streak > self.nonfinite_limit:
+                raise MXNetError(
+                    f"{self._nonfinite_streak} consecutive non-finite "
+                    f"losses at step {step} — the run has diverged "
+                    "beyond what checkpoint rollback can fix")
+            _log.warning("non-finite loss at step %d: %s", step,
+                         "rolled back to last checkpoint" if rolled
+                         else "skipped (no restore channel or no intact "
+                              "checkpoint)")
+            self._maybe_emergency(step)
+            return False
+        self._nonfinite_streak = 0
+
+        if self.checkpoint_every and (step + 1) % self.checkpoint_every == 0:
+            self._save(step)
+        self._maybe_emergency(step)
+        return True
+
+    # -- internals --------------------------------------------------------
+    @staticmethod
+    def _finite(loss) -> bool:
+        if hasattr(loss, "asnumpy"):
+            loss = loss.asnumpy()
+        try:
+            import numpy as onp
+            return bool(onp.isfinite(onp.asarray(loss)).all())
+        except (TypeError, ValueError):
+            return math.isfinite(float(loss))
+
+    def _save(self, step: int, extra_extra: Optional[dict] = None):
+        extra = {"next_step": step + 1}
+        if self.extra_fn is not None:
+            extra.update(self.extra_fn())
+        if extra_extra:
+            extra.update(extra_extra)
+        if self.trainer is not None:
+            self.manager.save(step + 1, trainer=self.trainer, extra=extra)
+        else:
+            self.manager.save(step + 1, params=self.params_fn(),
+                              extra=extra)
+
+    def _rollback(self, step: int) -> bool:
+        if self.trainer is None and self.restore_fn is None:
+            return False  # params_fn-only: nowhere to install state
+        if self._restore_newest_intact() is None:
+            return False
+        self._m_rollbacks.inc()
+        return True
+
+    def _maybe_emergency(self, step: int):
+        if self._preempt_signum is None:
+            return
+        global _LAST_EMERGENCY
+        signum = self._preempt_signum
+        self.manager.wait()  # drain any in-flight periodic save first
+        self._save(step, extra_extra={"emergency": True,
+                                      "signal": signum})
+        self.manager.wait()  # the commit must land before we exit
+        self._m_emergency.inc()
+        self._g_emergency_step.set(step + 1)
+        _LAST_EMERGENCY = {"step": step + 1, "ts": time.time(),
+                           "directory": self.manager.directory}
+        raise Preempted(step, signum)

@@ -1,0 +1,122 @@
+"""Call-site wiring: one guarded entry point per framework hot path.
+
+The framework layers do not build policies by hand — they call
+:func:`guarded` (retry + injection) or :func:`breaker_scope` (admission
++ outcome recording) with a site name, and this module owns the
+per-site singletons:
+
+====================  ======================================================
+site                  wrapped call
+====================  ======================================================
+``kvstore.push``      :meth:`KVStoreBase.push` / dist-async client push
+``kvstore.pull``      :meth:`KVStoreBase.pull` / dist-async client pull
+``io``                PrefetchingIter worker's upstream ``next()``
+``serve.submit``      :meth:`ServingEngine.predict` / ``predict_async``
+``checkpoint.write``  :meth:`CheckpointManager._write` payload commit
+``checkpoint.restore``:meth:`CheckpointManager.restore` payload load
+``step``              TrainGuard's per-step boundary (faultplan only)
+====================  ======================================================
+
+Retry spends one try/except on the happy path and records zero
+``mxresil_retries_total`` when nothing fails; injection is a no-op
+without ``MXRESIL_FAULT_PLAN``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from . import faultplan
+from .policy import CircuitBreaker, RetryBudget, RetryPolicy
+
+__all__ = ["guarded", "site_policy", "site_breaker", "breaker_scope",
+           "breaker_states", "reset"]
+
+_LOCK = threading.Lock()
+_POLICIES: Dict[str, RetryPolicy] = {}
+_BREAKERS: Dict[str, CircuitBreaker] = {}
+_BUDGETS: Dict[str, RetryBudget] = {}
+
+
+def site_policy(site: str) -> RetryPolicy:
+    """The per-site retry policy (flag-configured defaults, shared
+    budget per site).
+
+    Built ONCE per process per site — the hot paths must not re-read
+    flags per call. Unlike MXRESIL_FAULT_PLAN (re-read dynamically),
+    changing MXRESIL_RETRY_* at runtime requires :func:`reset` for the
+    new values to take effect."""
+    pol = _POLICIES.get(site)
+    if pol is None:
+        with _LOCK:
+            pol = _POLICIES.get(site)
+            if pol is None:
+                budget = _BUDGETS.setdefault(site, RetryBudget())
+                pol = RetryPolicy(name=site, budget=budget)
+                _POLICIES[site] = pol
+    return pol
+
+
+def site_breaker(site: str) -> CircuitBreaker:
+    """The per-site circuit breaker, created on first use."""
+    brk = _BREAKERS.get(site)
+    if brk is None:
+        with _LOCK:
+            brk = _BREAKERS.get(site)
+            if brk is None:
+                brk = CircuitBreaker(name=site)
+                _BREAKERS[site] = brk
+    return brk
+
+
+def guarded(site: str, fn: Callable, *args, **kwargs):
+    """Run ``fn`` under the site's retry policy with fault injection
+    evaluated on EVERY attempt (so an ``@K`` clause hit on attempt K
+    clears on the retry — the recovery path actually executes)."""
+
+    def attempt():
+        faultplan.inject(site)
+        return fn(*args, **kwargs)
+
+    return site_policy(site).call(attempt)
+
+
+class breaker_scope:
+    """``with breaker_scope("serve.submit"): ...`` — admission check on
+    entry (raises :class:`CircuitOpenError` while open), outcome
+    recording on exit. Exception types in ``ignore`` (client-caused:
+    deadline expiry, load-shed backpressure) count as neither success
+    nor failure."""
+
+    def __init__(self, site: str, ignore: tuple = ()):
+        self.site = site
+        self.ignore = ignore
+        self._breaker: Optional[CircuitBreaker] = None
+
+    def __enter__(self):
+        self._breaker = site_breaker(self.site)
+        self._breaker.check()
+        return self._breaker
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._breaker.record_success()
+        elif not issubclass(exc_type, self.ignore):
+            self._breaker.record_failure()
+        return False
+
+
+def breaker_states() -> Dict[str, dict]:
+    """{site: breaker.describe()} for every breaker created so far
+    (the diagnose.py resilience section)."""
+    with _LOCK:
+        return {site: brk.describe() for site, brk in _BREAKERS.items()}
+
+
+def reset() -> None:
+    """Drop all per-site state (tests)."""
+    with _LOCK:
+        _POLICIES.clear()
+        _BREAKERS.clear()
+        _BUDGETS.clear()
+    faultplan.reset()

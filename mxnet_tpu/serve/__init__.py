@@ -21,7 +21,8 @@ and a warmed XLA program:
 docs/serving.md for architecture and the bucket-ladder tuning guide.
 """
 from .batcher import (BatcherStoppedError, DeadlineExceededError,  # noqa: F401
-                      DynamicBatcher, QueueFullError, Request)
+                      DynamicBatcher, QueueFullError,
+                      RequestTooLargeError, Request)
 from .buckets import (BucketLadder, BucketOverflowError,  # noqa: F401
                       default_ladder, parse_bucket_spec)
 from .endpoint import ModelRegistry, ServingEndpoint  # noqa: F401
@@ -30,6 +31,7 @@ from .engine import InputSpec, ServingEngine  # noqa: F401
 __all__ = [
     "BucketLadder", "BucketOverflowError", "parse_bucket_spec",
     "default_ladder", "DynamicBatcher", "Request", "QueueFullError",
-    "DeadlineExceededError", "BatcherStoppedError", "ServingEngine",
+    "DeadlineExceededError", "BatcherStoppedError",
+    "RequestTooLargeError", "ServingEngine",
     "InputSpec", "ModelRegistry", "ServingEndpoint",
 ]

@@ -40,11 +40,16 @@ from ..base import MXNetError
 from ..telemetry import metrics as _metrics
 
 __all__ = ["DynamicBatcher", "QueueFullError", "DeadlineExceededError",
-           "BatcherStoppedError", "Request"]
+           "BatcherStoppedError", "RequestTooLargeError", "Request"]
 
 
 class QueueFullError(MXNetError):
     """Load-shed: the bounded request queue is at MXSERVE_QUEUE_DEPTH."""
+
+
+class RequestTooLargeError(MXNetError):
+    """A single request exceeds max_batch_size rows — a CLIENT error
+    (typed so serving breakers can exclude it from health accounting)."""
 
 
 class DeadlineExceededError(MXNetError):
@@ -64,7 +69,7 @@ class Request:
     """One in-flight request. ``wait()`` blocks for the result."""
 
     __slots__ = ("arrays", "n_items", "group_key", "deadline", "enq_t",
-                 "event", "result", "error", "state")
+                 "event", "result", "error", "state", "callbacks")
 
     def __init__(self, arrays: Sequence[Any], n_items: int, group_key: Any,
                  deadline: Optional[float]):
@@ -77,6 +82,9 @@ class Request:
         self.result: Any = None
         self.error: Optional[BaseException] = None
         self.state = _QUEUED
+        # completion hooks run (once) after result/error is final —
+        # async callers use these to record circuit-breaker outcomes
+        self.callbacks: List[Callable[["Request"], None]] = []
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -84,6 +92,16 @@ class Request:
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.event.wait(timeout)
+
+    def finish(self):
+        """Terminal transition: wake waiters, then run callbacks (which
+        must never take down the dispatcher)."""
+        self.event.set()
+        for cb in self.callbacks:
+            try:
+                cb(self)
+            except Exception:
+                pass
 
 
 class DynamicBatcher:
@@ -127,7 +145,9 @@ class DynamicBatcher:
         self._queue: "deque[Request]" = deque()
         self._stopping = False
         self._draining = False
+        self._crashed: Optional[BaseException] = None
         self._in_flight = 0  # claimed but not yet completed
+        self._current_group: List[Request] = []  # dispatcher-owned
         self._m_depth = _metrics.gauge(
             "mxserve_queue_depth", "requests waiting in the batcher queue")
         self._m_occ = _metrics.histogram(
@@ -164,18 +184,29 @@ class DynamicBatcher:
     # ------------------------------------------------------------------
     def submit_async(self, arrays: Sequence[Any], n_items: int,
                      group_key: Any,
-                     timeout_ms: Optional[float] = None) -> Request:
+                     timeout_ms: Optional[float] = None,
+                     on_done: Optional[Callable[[Request], None]] = None
+                     ) -> Request:
         """Enqueue without blocking for the result. Raises
         :class:`QueueFullError` / :class:`BatcherStoppedError` on
-        intake; the returned :class:`Request` resolves via ``wait()``."""
+        intake; the returned :class:`Request` resolves via ``wait()``.
+        ``on_done`` is registered BEFORE the request is enqueued —
+        appending to ``req.callbacks`` after submit races a dispatcher
+        that may already have finished it."""
         if n_items > self.max_batch_size:
-            raise MXNetError(
+            raise RequestTooLargeError(
                 f"request of {n_items} rows exceeds max_batch_size="
                 f"{self.max_batch_size}; shard it client-side")
         deadline = (time.monotonic() + timeout_ms / 1000.0
                     if timeout_ms is not None else None)
         req = Request(arrays, n_items, group_key, deadline)
+        if on_done is not None:
+            req.callbacks.append(on_done)
         with self._cv:
+            if self._crashed is not None:
+                raise BatcherStoppedError(
+                    f"batcher {self.name!r} dispatcher crashed: "
+                    f"{self._crashed!r}") from self._crashed
             if self._stopping or self._draining:
                 raise BatcherStoppedError(
                     f"batcher {self.name!r} is "
@@ -245,7 +276,7 @@ class DynamicBatcher:
                     "request deadline passed while queued")
                 self._m_expired.inc()
                 self._n_expired += 1
-                head.event.set()
+                head.finish()
                 continue
             head.state = _CLAIMED
             self._in_flight += 1
@@ -265,7 +296,7 @@ class DynamicBatcher:
                         "request deadline passed while queued")
                     self._m_expired.inc()
                     self._n_expired += 1
-                    req.event.set()
+                    req.finish()
                     continue
                 if rows + req.n_items > self.max_batch_size:
                     continue
@@ -292,9 +323,41 @@ class DynamicBatcher:
         return head.group_key, group
 
     def _loop(self):
+        # the dispatcher is the batcher's single worker: if IT dies (a
+        # bug outside the per-group dispatch_fn guard below), every
+        # queued/claimed request would otherwise sit out its full
+        # deadline — or forever — on a thread that no longer exists.
+        # Mirror of the PrefetchingIter sentinel fix: crash ⇒ every
+        # in-flight future fails fast with the worker's exception.
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 — fail fast, loudly
+            self._crash(e)
+
+    def _crash(self, exc: BaseException):
+        with self._cv:
+            self._crashed = exc
+            self._stopping = True
+            pending = list(self._queue) + [
+                r for r in self._current_group if not r.event.is_set()]
+            self._queue.clear()
+            self._current_group = []
+            self._in_flight = 0
+            self._m_depth.set(0)
+            self._cv.notify_all()
+        err = BatcherStoppedError(
+            f"batcher {self.name!r} dispatcher crashed: {exc!r}")
+        err.__cause__ = exc
+        for r in pending:
+            r.state = _DONE
+            r.error = err
+            r.finish()
+
+    def _loop_inner(self):
         while True:
             with self._cv:
                 key, group = self._claim_group()
+                self._current_group = group
                 if not group:
                     return
             now = time.monotonic()
@@ -336,7 +399,8 @@ class DynamicBatcher:
             for r in group:
                 r.state = _DONE
                 self._m_lat.observe(done_t - r.enq_t)
-                r.event.set()
+                r.finish()
+            self._current_group = []
 
     # ------------------------------------------------------------------
     # lifecycle

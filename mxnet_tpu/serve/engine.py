@@ -49,7 +49,17 @@ import numpy as onp
 from ..base import MXNetError
 from ..telemetry import metrics as _metrics
 from ..telemetry import recompile as _recompile
-from .batcher import DynamicBatcher, Request
+from .batcher import (BatcherStoppedError, DeadlineExceededError,
+                      DynamicBatcher, QueueFullError, Request,
+                      RequestTooLargeError)
+
+# outcomes that count as neither breaker success nor failure: load
+# backpressure, client deadline/oversize errors, graceful drain. ONE
+# list shared by the sync breaker scope and the async completion
+# callback — the two paths must never classify the same error
+# differently
+_BREAKER_IGNORE = (QueueFullError, DeadlineExceededError,
+                   BatcherStoppedError, RequestTooLargeError)
 from .buckets import BucketLadder, default_ladder
 
 __all__ = ["ServingEngine", "InputSpec"]
@@ -441,31 +451,68 @@ class ServingEngine:
         Returns numpy output(s) with padding sliced back off — a single
         array when the model has one output.
         """
+        from ..resil import faultplan as _faultplan
+        from ..resil.hooks import breaker_scope as _breaker_scope
+        # client-error paths stay OUTSIDE the breaker scope: malformed
+        # requests and misused arguments must not trip the circuit
+        # against a healthy model
         arrays = self._coerce_request(data)
         n = int(arrays[0].shape[0])
         key = self._group_key(arrays)
-        if self.batcher is not None:
-            outs = self.batcher.submit(arrays, n, key,
-                                       timeout_ms=timeout_ms)
-        else:
-            if timeout_ms is not None:
-                raise MXNetError(
-                    "timeout_ms requires batching=True — direct "
-                    "dispatch is synchronous and cannot enforce a "
-                    "deadline")
-            outs = self._dispatch_group(
-                key, [Request(arrays, n, key, None)])[0]
-        return outs[0] if len(outs) == 1 else outs
+        if self.batcher is None and timeout_ms is not None:
+            raise MXNetError(
+                "timeout_ms requires batching=True — direct "
+                "dispatch is synchronous and cannot enforce a "
+                "deadline")
+        # resil admission: while the 'serve.submit' breaker is open
+        # (repeated dispatch failures) requests fail fast in degraded
+        # mode instead of queueing behind a broken model/device.
+        with _breaker_scope("serve.submit", ignore=_BREAKER_IGNORE):
+            _faultplan.inject("serve.submit")
+            if self.batcher is not None:
+                outs = self.batcher.submit(arrays, n, key,
+                                           timeout_ms=timeout_ms)
+            else:
+                outs = self._dispatch_group(
+                    key, [Request(arrays, n, key, None)])[0]
+            return outs[0] if len(outs) == 1 else outs
 
     def predict_async(self, data, timeout_ms: Optional[float] = None):
         """Non-blocking submit; returns the batcher Request (``wait()``,
-        then ``.result``/``.error``)."""
+        then ``.result``/``.error``). Runs the 'serve.submit' injection
+        site and breaker admission check; the breaker outcome is
+        recorded by a completion callback when the future resolves (so
+        an admitted half-open probe always reports back — backpressure
+        outcomes count as neither success nor failure)."""
         if self.batcher is None:
             raise MXNetError("predict_async requires batching=True")
+        from ..resil import faultplan as _faultplan
+        from ..resil.hooks import site_breaker as _site_breaker
         arrays = self._coerce_request(data)
-        return self.batcher.submit_async(
-            arrays, int(arrays[0].shape[0]), self._group_key(arrays),
-            timeout_ms=timeout_ms)
+        breaker = _site_breaker("serve.submit")
+        breaker.check()
+
+        def _record(r):
+            if r.error is None:
+                breaker.record_success()
+            elif not isinstance(r.error, _BREAKER_IGNORE):
+                breaker.record_failure()
+
+        try:
+            _faultplan.inject("serve.submit")
+            # on_done registers BEFORE enqueue — appending after
+            # submit_async returns would race a dispatcher that already
+            # finished the request, dropping the breaker outcome
+            return self.batcher.submit_async(
+                arrays, int(arrays[0].shape[0]), self._group_key(arrays),
+                timeout_ms=timeout_ms, on_done=_record)
+        except _BREAKER_IGNORE:
+            # same ignore set as the sync path: backpressure / client
+            # error / drain is neither breaker success nor failure
+            raise
+        except BaseException:
+            breaker.record_failure()
+            raise
 
     def _coerce_request(self, data) -> List[onp.ndarray]:
         from ..ndarray.ndarray import NDArray

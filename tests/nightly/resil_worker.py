@@ -1,0 +1,89 @@
+"""Resilience drill worker (tools/mxresil.py drill + the SIGTERM case
+of tests/test_elastic.py).
+
+A deterministic single-process trainer: params are a pure function of
+the completed step history (grad(k) is exact in float32), updates flow
+through the LOCAL kvstore so the ``kvstore.push``/``kvstore.pull``
+injection sites tick, and every step boundary runs under
+:class:`~mxnet_tpu.resil.TrainGuard` — so ``MXRESIL_FAULT_PLAN``
+clauses like ``step:40=preempt`` produce an emergency checkpoint and a
+clean exit(42), and a restarted worker resumes bitwise-identically.
+
+Env: RESIL_CKPT_DIR (required), RESIL_TARGET_STEPS (default 80),
+RESIL_CKPT_EVERY (default 1), RESIL_STEP_SLEEP (default 0.01 s).
+Prints RESUMED from=N / PREEMPTED step=N / DONE ran=N /
+FINAL sha256=... for the drill harness to parse.
+"""
+import hashlib
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.checkpoint import CheckpointManager  # noqa: E402
+from mxnet_tpu.resil import Preempted, TrainGuard, Watchdog  # noqa: E402
+
+
+def grad(step: int) -> onp.ndarray:
+    # multiples of 1/8: float32-exact, so resumed == uninterrupted
+    # bit-for-bit
+    return onp.full((4, 4), ((step % 7) + 1) * 0.125, "float32")
+
+
+def main():
+    target = int(os.environ.get("RESIL_TARGET_STEPS", "80"))
+    every = int(os.environ.get("RESIL_CKPT_EVERY", "1"))
+    sleep = float(os.environ.get("RESIL_STEP_SLEEP", "0.01"))
+    mgr = CheckpointManager(os.environ["RESIL_CKPT_DIR"],
+                            async_save=True)
+    kv = mx.kv.create("local")
+    state = {"w": onp.zeros((4, 4), "float32")}
+    out = nd.array(state["w"])
+
+    def params_fn():
+        return {"w": nd.array(state["w"])}
+
+    def restore_fn(params, _opt, _extra):
+        # TrainGuard hands restored state here on resume() AND on
+        # non-finite rollback; the kvstore mirror must follow the params
+        state["w"] = params["w"].asnumpy()
+        kv.init("w", nd.array(state["w"]))
+
+    watchdog = Watchdog()
+    try:
+        with TrainGuard(mgr, params_fn=params_fn, restore_fn=restore_fn,
+                        checkpoint_every=every,
+                        watchdog=watchdog) as guard:
+            start = guard.resume()
+            if start == 0:
+                kv.init("w", nd.array(state["w"]))  # fresh boot
+            print(f"RESUMED from={start}", flush=True)
+            for step in range(start, target):
+                kv.push("w", nd.array(grad(step)))
+                kv.pull("w", out=out)
+                state["w"] = out.asnumpy()
+                if not guard.completed(step,
+                                       loss=float(state["w"].sum())):
+                    continue  # non-finite: restore_fn already re-synced
+                if sleep:
+                    time.sleep(sleep)
+    except Preempted as e:
+        print(f"PREEMPTED step={e.step}", flush=True)
+        sys.exit(42)
+    mgr.wait()
+    digest = hashlib.sha256(
+        onp.ascontiguousarray(state["w"]).tobytes()).hexdigest()
+    print(f"DONE ran={target - start}", flush=True)
+    print(f"FINAL sha256={digest}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -180,6 +180,56 @@ def check_serving():
               "bucket ladder does not close the jit cache")
 
 
+def check_resilience():
+    """Fault-tolerance health: active fault plan, retry/breaker/watchdog
+    flags, breaker states, mxresil_* metrics, last emergency checkpoint
+    (mxnet_tpu/resil/; docs/resilience.md)."""
+    print("----------Resilience (mxresil)----------")
+    try:
+        from mxnet_tpu import config, telemetry
+        from mxnet_tpu.resil import active_plan, guard, hooks
+    except Exception as e:
+        print("resilience   : unavailable (%s)" % e)
+        return
+    try:
+        plan = active_plan()
+        if plan is None:
+            print("fault plan   : (off)")
+        else:
+            print(f"fault plan   : {plan.spec!r} "
+                  f"({len(plan.clauses)} clause(s), seed {plan.seed})")
+    except Exception as e:
+        print("fault plan   : INVALID MXRESIL_FAULT_PLAN (%s)" % e)
+    print("retry policy :", config.get("MXRESIL_RETRY_MAX"), "retries,",
+          config.get("MXRESIL_RETRY_BASE_MS"), "->",
+          config.get("MXRESIL_RETRY_MAX_MS"), "ms backoff")
+    print("breaker      :", config.get("MXRESIL_BREAKER_FAILURES"),
+          "failures trip;", config.get("MXRESIL_BREAKER_COOLDOWN_S"),
+          "s cooldown")
+    stall = config.get("MXRESIL_WATCHDOG_STALL_S")
+    print("watchdog     :", f"{stall} s stall threshold" if stall
+          else "auto stall threshold (10x step EWMA)")
+    kv_ms = config.get("MXNET_KVSTORE_TIMEOUT_MS")
+    print("kv timeout   :", f"{kv_ms} ms" if kv_ms
+          else "(barrier-based default)")
+    states = hooks.breaker_states()
+    if states:
+        for site, st in sorted(states.items()):
+            print(f"  breaker {site}: {st['state']} "
+                  f"({st['consecutive_failures']} consecutive failures)")
+    else:
+        print("breakers     : none created (no guarded site has run)")
+    emergency = guard.last_emergency()
+    print("emergency ckpt:", emergency or "(none this process)")
+    snap = telemetry.snapshot()
+    resil_metrics = {k: v for k, v in snap.items()
+                     if k.startswith("mxresil_")}
+    for k, v in sorted(resil_metrics.items()):
+        print(f"  {k} = {v}")
+    if not resil_metrics:
+        print("metrics      : none (no resil hook has fired)")
+
+
 def main():
     check_python()
     check_pip()
@@ -189,6 +239,7 @@ def main():
     check_mxnet()
     check_telemetry()
     check_serving()
+    check_resilience()
     check_mxlint()
 
 

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""mxresil CLI: fault drills and resilience reporting.
+
+Subcommands (see docs/resilience.md):
+
+  drill    run the deterministic drill trainer under a fault plan,
+           restart it on preemption (the cluster-manager role), and
+           report MTTR, steps lost, and bitwise-equality of the final
+           params against an uninterrupted baseline run
+           python tools/mxresil.py drill --plan "step:40=preempt"
+  plan     parse/validate a fault plan and print its clauses
+           python tools/mxresil.py plan --plan "kvstore.push@3=raise"
+  watch    run the watchdog over a live metrics process once and emit
+           findings in the shared mxlint --json schema
+  report   summarize one or more drill JSON records (MTTR / steps-lost
+           aggregates)
+           python tools/mxresil.py drill ... | tee drills.jsonl
+           python tools/mxresil.py report --file drills.jsonl
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+WORKER = os.path.join(ROOT, "tests", "nightly", "resil_worker.py")
+
+
+def _parse_worker_output(out: str) -> dict:
+    info = {"resumed_from": None, "preempted_step": None, "final": None,
+            "ran": None}
+    for ln in out.splitlines():
+        if ln.startswith("RESUMED from="):
+            info["resumed_from"] = int(ln.split("=")[1])
+        elif ln.startswith("PREEMPTED step="):
+            info["preempted_step"] = int(ln.split("=")[1])
+        elif ln.startswith("FINAL sha256="):
+            info["final"] = ln.split("=")[1].strip()
+        elif ln.startswith("DONE ran="):
+            info["ran"] = int(ln.split("=")[1])
+    return info
+
+
+def _run_worker(env: dict, timeout: float = 300.0):
+    """Run one worker; returns (rc, stdout, t_resumed) where t_resumed
+    is the monotonic instant the RESUMED line appeared (the moment the
+    restarted trainer is back in business — the MTTR endpoint).
+
+    Output is drained on a reader thread so the --timeout deadline
+    holds even when the worker wedges WITHOUT printing (a hung
+    collective is exactly the failure mode a resilience drill hits)."""
+    import threading
+    proc = subprocess.Popen([sys.executable, WORKER], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    lines = []
+    t_resumed = [None]
+
+    def _drain():
+        for ln in proc.stdout:
+            lines.append(ln)
+            if t_resumed[0] is None and ln.startswith("RESUMED"):
+                t_resumed[0] = time.monotonic()
+
+    reader = threading.Thread(target=_drain, daemon=True)
+    reader.start()
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = proc.wait()
+    reader.join(timeout=5.0)
+    return rc, "".join(lines), t_resumed[0]
+
+
+def cmd_drill(args):
+    import tempfile
+    base_env = dict(os.environ)
+    base_env.pop("MXRESIL_FAULT_PLAN", None)
+    base_env.update({
+        "RESIL_TARGET_STEPS": str(args.steps),
+        "RESIL_CKPT_EVERY": str(args.ckpt_every),
+        "RESIL_STEP_SLEEP": str(args.step_sleep),
+        "MXTPU_FORCE_CPU_BACKEND": "1",
+    })
+
+    # 1) uninterrupted baseline (no plan): the bitwise reference
+    with tempfile.TemporaryDirectory() as base_dir:
+        base_env["RESIL_CKPT_DIR"] = base_dir
+        rc, out, _ = _run_worker(base_env, timeout=args.timeout)
+        if rc != 0:
+            print(out[-2000:], file=sys.stderr)
+            print(json.dumps({"error": f"baseline run failed rc={rc}"}))
+            return 1
+        baseline = _parse_worker_output(out)
+
+    # 2) faulted run(s): preempt → restart until completion (the
+    #    cluster-manager role a real deployment delegates to k8s)
+    fault_env = dict(base_env)
+    fault_env["MXRESIL_FAULT_PLAN"] = args.plan
+    drill_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="mxresil_")
+    fault_env["RESIL_CKPT_DIR"] = drill_dir
+    restarts = 0
+    mttr_s = []
+    steps_lost = []
+    final = None
+    t_death = None
+    executed_before_death = None
+    while True:
+        rc, out, t_resumed = _run_worker(fault_env, timeout=args.timeout)
+        info = _parse_worker_output(out)
+        if t_death is not None and t_resumed is not None:
+            mttr_s.append(t_resumed - t_death)
+        if executed_before_death is not None and \
+                info["resumed_from"] is not None:
+            steps_lost.append(executed_before_death
+                              - info["resumed_from"])
+            executed_before_death = None
+        if rc == 42:  # preempted: emergency checkpoint committed
+            t_death = time.monotonic()
+            if info["preempted_step"] is not None:
+                executed_before_death = info["preempted_step"] + 1
+            restarts += 1
+            if restarts > args.max_restarts:
+                print(json.dumps(
+                    {"error": "exceeded --max-restarts", "plan": args.plan}))
+                return 1
+            continue
+        if rc != 0:
+            print(out[-2000:], file=sys.stderr)
+            print(json.dumps({"error": f"drill run failed rc={rc}",
+                              "plan": args.plan}))
+            return 1
+        final = info["final"]
+        break
+
+    record = {
+        "metric": "mxresil_drill",
+        "plan": args.plan,
+        "steps": args.steps,
+        "restarts": restarts,
+        "mttr_s": round(max(mttr_s), 3) if mttr_s else None,
+        "steps_lost": max(steps_lost) if steps_lost else 0,
+        "bitwise_equal": (final == baseline["final"]
+                          and final is not None),
+        "final_sha256": final,
+        "baseline_sha256": baseline["final"],
+        "ckpt_dir": drill_dir,
+    }
+    print(json.dumps(record))
+    ok = record["bitwise_equal"] and \
+        (record["steps_lost"] or 0) <= args.max_steps_lost
+    return 0 if ok else 1
+
+
+def cmd_plan(args):
+    from mxnet_tpu.resil import faultplan
+    try:
+        plan = faultplan.FaultPlan(args.plan,
+                                   seed=args.seed)
+    except Exception as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    print(json.dumps(plan.report(), indent=None if args.json else 2))
+    return 0
+
+
+def cmd_watch(args):
+    """One watchdog evaluation over this process's registry — mostly a
+    schema/integration smoke; long-lived jobs embed Watchdog.start()."""
+    from mxnet_tpu.passes import findings_report
+    from mxnet_tpu.resil import Watchdog
+    wd = Watchdog(stall_after_s=args.stall_s or None)
+    wd.poll()
+    findings = wd.check()
+    report = findings_report("mxresil.watch", findings,
+                             extra={"threshold_s": wd.stall_threshold_s()})
+    print(json.dumps(report) if args.json
+          else json.dumps(report, indent=2))
+    return 2 if findings else 0
+
+
+def cmd_report(args):
+    records = []
+    with open(args.file) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+                if rec.get("metric") == "mxresil_drill":
+                    records.append(rec)
+    if not records:
+        print("no drill records found", file=sys.stderr)
+        return 1
+    mttrs = [r["mttr_s"] for r in records if r.get("mttr_s") is not None]
+    lost = [r.get("steps_lost") or 0 for r in records]
+    summary = {
+        "drills": len(records),
+        "restarts": sum(r.get("restarts", 0) for r in records),
+        "mttr_max_s": max(mttrs) if mttrs else None,
+        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 3) if mttrs else None,
+        "steps_lost_max": max(lost),
+        "bitwise_equal_all": all(r.get("bitwise_equal") for r in records),
+    }
+    print(json.dumps(summary, indent=None if args.json else 2))
+    return 0 if summary["bitwise_equal_all"] else 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="mxresil", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("drill", help="preempt/restart fault drill")
+    d.add_argument("--plan", required=True,
+                   help="MXRESIL_FAULT_PLAN for the faulted run")
+    d.add_argument("--steps", type=int, default=80)
+    d.add_argument("--ckpt-every", type=int, default=1)
+    d.add_argument("--step-sleep", type=float, default=0.01)
+    d.add_argument("--ckpt-dir", default=None,
+                   help="reuse a checkpoint dir across invocations")
+    d.add_argument("--max-restarts", type=int, default=5)
+    d.add_argument("--max-steps-lost", type=int, default=1)
+    d.add_argument("--timeout", type=float, default=300.0)
+    d.set_defaults(fn=cmd_drill)
+
+    pl = sub.add_parser("plan", help="validate/expand a fault plan")
+    pl.add_argument("--plan", required=True)
+    pl.add_argument("--seed", type=int, default=0)
+    pl.add_argument("--json", action="store_true")
+    pl.set_defaults(fn=cmd_plan)
+
+    w = sub.add_parser("watch", help="one watchdog check (mxlint schema)")
+    w.add_argument("--stall-s", type=float, default=0.0)
+    w.add_argument("--json", action="store_true")
+    w.set_defaults(fn=cmd_watch)
+
+    r = sub.add_parser("report", help="summarize drill JSON records")
+    r.add_argument("--file", required=True)
+    r.add_argument("--json", action="store_true")
+    r.set_defaults(fn=cmd_report)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
