@@ -11,14 +11,28 @@ retried with backoff, then fall back to the CPU backend; any remaining
 error is reported inside the JSON line instead of crashing.
 
 Env knobs:
-  MXTPU_BENCH_BATCH   per-step batch size (default 256 accel / 8 cpu)
+  MXTPU_BENCH_BATCH   per-step batch size (default 256 accel / 4 cpu —
+                      the CPU default keeps the whole-step working set
+                      cache-resident; at batch 8 the XLA:CPU step
+                      becomes memory-pressure-bound and fused ~= eager)
   MXTPU_BENCH_STEPS   timed steps (default 30 accel / 3 cpu)
+  MXTPU_BENCH_FUSED   1 (default) = drive training through the fused
+                      whole-step compiler (mxnet_tpu.step.StepFunction
+                      over a gluon Trainer: one donated XLA program per
+                      step); 0 (or --no-fused-step) = the eager
+                      reference path (per-op forward/backward tape +
+                      per-param Trainer update loop)
+  MXTPU_BENCH_EAGER_STEPS  eager-path steps timed for the
+                      fused_step_speedup comparison (default 2; 0
+                      skips the comparison)
   MXTPU_BENCH_AMP     0 = fp32; 1 = bf16 matmul/conv precision with
-                      fp32 storage; 2 (default) = full bf16 cast
-                      (params + activations; BN statistics stay fp32).
-                      Measured on v5e batch 256: fp32 ~222 ms/step,
-                      amp=1 ~207 ms, amp=2 ~112 ms (HBM-bandwidth
-                      bound; halving the bytes halves the step).
+                      fp32 storage; 2 = full bf16 cast (params +
+                      activations; BN statistics stay fp32). Default 2
+                      on accelerators, 0 on CPU: the bf16 win is an
+                      HBM-bandwidth win (measured on v5e batch 256:
+                      fp32 ~222 ms/step, amp=1 ~207 ms, amp=2 ~112 ms)
+                      while XLA:CPU emulates bf16 with converts and
+                      gets ~3x SLOWER.
   MXTPU_BENCH_TIMEOUT watchdog seconds (default 1500)
   MXTPU_BENCH_FORCE_CPU=1  skip the accelerator probe and run on the
                       CPU backend (hermetic CI / contract tests)
@@ -119,7 +133,7 @@ def _probe_with_retry(per_try_s=150):
     status "accel" | "cpu" (definitive: backend healthy, no accel) |
     "failed" (budget exhausted, tunnel unreachable)."""
     watchdog = int(os.environ.get("MXTPU_BENCH_TIMEOUT", "1500"))
-    reserve = int(os.environ.get("MXTPU_BENCH_PROBE_RESERVE", "600"))
+    reserve = int(os.environ.get("MXTPU_BENCH_PROBE_RESERVE", "900"))
     budget = max(per_try_s + 10, watchdog - reserve)
     deadline = time.monotonic() + budget
     attempt = 0
@@ -218,14 +232,17 @@ def main():
     cpu_dev = jax.local_devices(backend="cpu")[0] if on_accel else devices[0]
 
     batch = int(os.environ.get("MXTPU_BENCH_BATCH",
-                               "256" if on_accel else "8"))
+                               "256" if on_accel else "4"))
     n_steps = int(os.environ.get("MXTPU_BENCH_STEPS",
                                  "30" if on_accel else "3"))
-    amp = int(os.environ.get("MXTPU_BENCH_AMP", "2"))
+    amp = int(os.environ.get("MXTPU_BENCH_AMP",
+                             "2" if on_accel else "0"))
 
-    from mxnet_tpu import gluon, nd
+    fused_on = os.environ.get("MXTPU_BENCH_FUSED", "1") == "1"
+    eager_steps = int(os.environ.get("MXTPU_BENCH_EAGER_STEPS", "2"))
+
+    from mxnet_tpu import autograd, gluon, nd, telemetry
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
-    from mxnet_tpu.parallel import ParallelTrainer
 
     # All eager work (init, deferred-shape resolution) on host — avoid
     # per-op roundtrips to the accelerator; transfer params once.
@@ -233,39 +250,50 @@ def main():
         net = resnet50_v1(classes=1000)
         net.initialize()
         loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-        trainer = ParallelTrainer(net, loss_fn, optimizer="sgd",
-                                  optimizer_params={"learning_rate": 0.05,
-                                                    "momentum": 0.9})
         rng = onp.random.RandomState(0)
         xv = jnp.asarray(rng.uniform(-1, 1, size=(batch, 3, 224, 224))
                          .astype("float32"))
         yv = jnp.asarray(rng.randint(0, 1000, size=(batch,))
                          .astype("float32"))
         net(nd.array(xv[:1]))  # resolve deferred shapes on host
-        trainer._extract_params()
         if amp >= 2:
             # full bf16: params + activations in bf16, BN stats fp32
-            # (the contrib/amp policy); optimizer state recreated to
-            # match the cast weight dtypes
+            # (the contrib/amp policy); Parameter.cast also casts the
+            # grad buffers, and optimizer state is created lazily from
+            # the cast weight dtypes
             bn = ("gamma", "beta", "running_mean", "running_var",
                   "moving_mean", "moving_var")
-            trainer.params = {
-                k: (v if k.rsplit(".", 1)[-1] in bn
-                    else v.astype(jnp.bfloat16))
-                for k, v in trainer.params.items()}
-            trainer.opt_state = trainer._init_fn(
-                {n: v for n, v in trainer.params.items()
-                 if n in trainer.trainable}, **trainer.opt_params)
+            for k, p in net._collect_params_with_prefix().items():
+                if k.rsplit(".", 1)[-1] not in bn:
+                    p.cast("bfloat16")
             xv = xv.astype(jnp.bfloat16)
 
     dev0_early = accel[0] if on_accel else devices[0]
     if on_accel:
         dev = accel[0]
-        trainer.params = jax.device_put(trainer.params, dev)
-        trainer.opt_state = jax.device_put(trainer.opt_state, dev)
+        for p in net.collect_params().values():
+            p.data()._rebind(jax.device_put(p.data()._data, dev))
         xv = jax.device_put(xv, dev)
         yv = jax.device_put(yv, dev)
     x, y = nd.array(xv), nd.array(yv)
+
+    # the training drivers: fused = ONE donated XLA computation per
+    # step (mxnet_tpu.step.StepFunction over the gluon Trainer);
+    # eager = the reference-shaped path (per-op forward/backward tape
+    # + per-param Trainer update loop)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    fused = trainer.fuse_step(net, loss_fn) if fused_on else None
+
+    def eager_step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    def do_step():
+        return fused.step(x, y) if fused_on else eager_step()
 
     # Timing fence: block_until_ready has been observed to RETURN EARLY
     # under the axon TPU tunnel (a 30-step ResNet run "finished" in
@@ -282,11 +310,14 @@ def main():
         else contextlib.nullcontext()
     with prec:
         for _ in range(2):  # warmup (compile)
-            _fence(trainer.step(x, y))
+            _fence(do_step())
+        # the fused-path steady-state contract: ZERO recompiles after
+        # step 2 (the signature cache is closed once warm)
+        rc_after_warmup = telemetry.recompile_count()
 
         # flat D2H latency on a ready buffer (median of 3)
         from mxnet_tpu.util import d2h_fence_latency
-        d2h_lat = d2h_fence_latency(trainer.step(x, y))
+        d2h_lat = d2h_fence_latency(do_step())
 
         # provisional single-step measurement BEFORE the long timed
         # run: the tunnel's failure mode is a wedge mid-operation, and
@@ -296,11 +327,12 @@ def main():
         # a final emit supersedes it.
         from mxnet_tpu.util import net_time as _net_time
         t0 = time.perf_counter()
-        _fence(trainer.step(x, y))
+        _fence(do_step())
         one_step = max(_net_time(time.perf_counter() - t0, d2h_lat), 1e-9)
         prov = dict(metric="resnet50_train_throughput",
                     value=round(batch / one_step, 2), unit="images/sec",
                     provisional=True, batch=batch, steps=1, amp=amp,
+                    fused_step=fused_on,
                     step_s=round(one_step, 5),
                     fence_lat_s=round(d2h_lat, 4),
                     platform=(accel[0].platform if on_accel else "cpu"),
@@ -314,11 +346,39 @@ def main():
 
         t0 = time.perf_counter()
         for _ in range(n_steps):
-            loss = trainer.step(x, y)
+            loss = do_step()
         _fence(loss)
+        if not fused_on:
+            # eager dispatch is async (MXNET_EAGER_SYNC off): the last
+            # step's per-param updates are separate dispatches still in
+            # flight after the loss fence — wait for them so the timed
+            # window covers the same work the fused path's fence does
+            jax.block_until_ready(
+                [p.data()._data for p in trainer._params])
         raw = time.perf_counter() - t0
         from mxnet_tpu.util import lat_dominated, net_time
         dt = net_time(raw, d2h_lat)
+        recompiles_after_step2 = telemetry.recompile_count() \
+            - rc_after_warmup
+
+        # eager comparator (fused_step_speedup): a few steps of the
+        # reference-shaped path through the SAME net/trainer; min()
+        # over steps drops the first step's per-op compile overhead
+        eager_rate = eager_err = None
+        if fused_on and eager_steps > 0:
+            try:
+                times = []
+                for _ in range(eager_steps):
+                    te = time.perf_counter()
+                    le = eager_step()
+                    _fence(le)
+                    jax.block_until_ready(  # updates are separate
+                        [p.data()._data for p in trainer._params])
+                    times.append(max(net_time(
+                        time.perf_counter() - te, d2h_lat), 1e-9))
+                eager_rate = batch / min(times)
+            except Exception as e:  # comparator must not kill the run
+                eager_err = f"{type(e).__name__}: {e}"[:300]
 
     img_per_sec = n_steps * batch / dt
     step_s = dt / n_steps
@@ -360,11 +420,19 @@ def main():
 
     record = dict(
         mfu=mfu, batch=batch, steps=n_steps, amp=amp,
+        fused_step=fused_on,
+        fused_step_speedup=(round(img_per_sec / eager_rate, 3)
+                            if eager_rate else None),
+        recompiles_after_step2=recompiles_after_step2,
+        eager_img_per_sec=(round(eager_rate, 2) if eager_rate
+                           else None),
         flops_per_step=flops_per_step, step_s=round(step_s, 5),
         raw_s=round(raw, 4), fence_lat_s=round(d2h_lat, 4),
         lat_dominated=lat_dominated(raw, d2h_lat),
         platform=(accel[0].platform if on_accel else "cpu"),
         device_kind=getattr(dev0, "device_kind", "unknown"))
+    if eager_err:
+        record["eager_error"] = eager_err
     if degraded:
         record["degraded"] = degraded
 
@@ -389,15 +457,10 @@ def main():
     watchdog = int(os.environ.get("MXTPU_BENCH_TIMEOUT", "1500"))
     if want_cost and time.monotonic() - t_start > watchdog - 240:
         want_cost = False
-    if want_cost:
+    if want_cost and fused_on:
         try:
-            cost = trainer._compiled.lower(
-                trainer.params, trainer.opt_state, xv, yv,
-                jax.random.key_data(jax.random.key(0)),
-                jnp.asarray(0.05, jnp.float32)).compile().cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else {}
-            if cost and cost.get("flops", 0) > 0:
+            cost = fused.cost_analysis(x, y)
+            if cost.get("flops", 0) > 0:
                 xla_flops = float(cost["flops"])
             xla_bytes = float(cost.get("bytes accessed", 0)) or None
         except Exception:
@@ -663,6 +726,13 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_SERVING"] = "1"
     if "--chaos" in sys.argv:
         os.environ["MXTPU_BENCH_CHAOS"] = "1"
+    # fused whole-train-step compiler: default ON; --no-fused-step
+    # measures the eager reference path instead (env form propagates
+    # into the --child subprocess)
+    if "--fused-step" in sys.argv:
+        os.environ["MXTPU_BENCH_FUSED"] = "1"
+    if "--no-fused-step" in sys.argv:
+        os.environ["MXTPU_BENCH_FUSED"] = "0"
     _serving = os.environ.get("MXTPU_BENCH_SERVING") == "1"
     _chaos = os.environ.get("MXTPU_BENCH_CHAOS") == "1"
     if "--child" in sys.argv:

@@ -113,6 +113,12 @@ from . import monitor  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from . import profiler  # noqa: F401
 from . import telemetry  # noqa: F401  (op tracing, recompile/memory accounting, metrics)
+from . import step  # noqa: F401  (fused whole-train-step compiler)
+
+# persistent XLA compilation cache (MXNET_COMPILE_CACHE_DIR): point
+# jax at the on-disk cache before any jit runs so the fused train
+# step's warmup survives process restarts (docs/performance.md)
+step.maybe_enable_compile_cache()
 from . import serve  # noqa: F401  (dynamic-batching inference serving)
 from . import resil  # noqa: F401  (fault injection, retry policies, preemption guard, watchdogs)
 from . import rtc  # noqa: F401

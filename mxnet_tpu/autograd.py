@@ -238,6 +238,16 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             if g is not None else _zero_cotangent(o)
             for g, o in zip(out_grads, node.outputs)
         ]
+        # align cotangent dtypes with the primal outputs: a mixed-
+        # precision chain (bf16 conv → f32 BatchNorm) hands this node
+        # an f32 cotangent for a bf16 output, and the per-op transpose
+        # rules require an exact dtype match (whole-graph jax.vjp
+        # inserts the same convert at its promotion sites)
+        cotangents = [
+            c if getattr(c, "dtype", None) == o.dtype
+            else jnp.asarray(c, dtype=o.dtype)
+            for c, o in zip(cotangents, node.outputs)
+        ]
         if node.custom_backward is not None:
             if record_bwd:
                 # a host-side custom backward (autograd.Function,

@@ -239,6 +239,30 @@ register_flag(
     "Max tensors fused per multi-tensor optimizer update "
     "(ref: env_var.md MXNET_OPTIMIZER_AGGREGATION_SIZE).")
 register_flag(
+    "MXNET_GRAD_BUCKET_BYTES", int, 4 << 20,
+    "Byte cap per flat gradient-exchange bucket (step.buckets."
+    "GradientBuckets, used by gluon Trainer._allreduce_grads): "
+    "gradients of like dtype are coalesced into buckets up to this "
+    "size so the kvstore data plane does O(buckets) transfers instead "
+    "of O(params). Larger buckets amortize transport latency; smaller "
+    "ones overlap exchange with the backward earlier "
+    "(docs/performance.md).")
+register_flag(
+    "MXNET_COMPILE_CACHE_DIR", str, "",
+    "Directory for JAX's persistent XLA compilation cache "
+    "(step.cache.enable_compile_cache, applied at import): compiled "
+    "programs — including the fused train step — are written to disk "
+    "so warmup survives process restarts. Hits/misses are logged to "
+    "the telemetry registry (jax_compile_cache_{hits,misses}_total). "
+    "Empty = cache off.")
+register_flag(
+    "MXNET_EAGER_SYNC", bool, False,
+    "Block on device completion after EVERY eager op dispatch "
+    "(ndarray.invoke). Default off: PJRT pipelines eager chains "
+    "asynchronously. Forced on while the profiler's imperative domain "
+    "is recording (accurate per-op timings) and under NaiveEngine / "
+    "MXNET_ENFORCE_DETERMINISM.")
+register_flag(
     "MXNET_MP_WORKER_NTHREADS", int, 4,
     "Per-worker decode thread cap in multiprocess DataLoader workers "
     "(ref: env_var.md:60).")

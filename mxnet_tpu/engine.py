@@ -18,7 +18,8 @@ import jax
 
 from .base import get_env
 
-__all__ = ["set_bulk_size", "bulk", "is_sync", "wait_for_all", "set_engine_type"]
+__all__ = ["set_bulk_size", "bulk", "is_sync", "eager_sync",
+           "wait_for_all", "set_engine_type"]
 
 _state = threading.local()
 
@@ -65,6 +66,35 @@ def maybe_sync(arr):
     if is_sync():
         jax.block_until_ready(arr)
     return arr
+
+
+_EAGER_SYNC_CACHE = [-1, False]  # [config generation, value]
+
+
+def eager_sync() -> bool:
+    """Should the eager dispatch path block after every op?
+
+    Default NO — PJRT pipelines eager chains asynchronously and XLA
+    overlaps them (the per-op block was costing the eager mutation
+    path its pipelining; ISSUE 5 satellite). Blocking is opt-in:
+
+    - ``MXNET_EAGER_SYNC=1`` — explicit debugging knob;
+    - profiler recording the ``imperative`` domain — per-op wall times
+      are meaningless when the op only enqueued work;
+    - NaiveEngine / MXNET_ENFORCE_DETERMINISM (``is_sync``) — the
+      reference's synchronous dispatch contract.
+    """
+    if is_sync():
+        return True
+    from . import config as _config
+    gen = _config.generation()
+    if _EAGER_SYNC_CACHE[0] != gen:
+        _EAGER_SYNC_CACHE[1] = get_env("MXNET_EAGER_SYNC", False)
+        _EAGER_SYNC_CACHE[0] = gen
+    if _EAGER_SYNC_CACHE[1]:
+        return True
+    from . import profiler as _prof
+    return _prof._active() and _prof._domain_enabled("imperative")
 
 
 _BULK_SIZE = get_env("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15)

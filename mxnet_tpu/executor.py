@@ -21,7 +21,49 @@ from .ndarray.ndarray import NDArray, _wrap
 from . import random as _random
 from .symbol.symbol import Symbol, eval_graph
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "graph_forward_backward"]
+
+
+def graph_forward_backward(symbol: Symbol, grad_names: List[str],
+                           mirror: Optional[bool] = None):
+    """Build the pure fused forward+backward evaluator of a Symbol:
+
+        fb(arg_vals, aux_vals, rng_raw, ograds)
+            -> (outputs, aux_updates, grads)
+
+    — one XLA program covering the train-mode graph plus its backward
+    segment (≙ cached_op.cc StaticBackward), gradients taken w.r.t.
+    ``grad_names``. Shared by :meth:`Executor._get_compiled_grad` and
+    the fused train-step compiler's symbol mode
+    (``mxnet_tpu.step.StepFunction``). ``mirror=None`` reads
+    MXNET_BACKWARD_DO_MIRROR (rematerialize via jax.checkpoint)."""
+    if mirror is None:
+        # MXNET_BACKWARD_DO_MIRROR (ref: env_var.md:187, the mirror/
+        # recompute option of src/nnvm/gradient.cc): on TPU this is
+        # rematerialization — wrap the forward in jax.checkpoint so
+        # the backward recomputes activations instead of storing them
+        from .base import get_env
+        mirror = get_env("MXNET_BACKWARD_DO_MIRROR", False)
+
+    def fb(arg_vals, aux_vals, rng_raw, ograds):
+        def fwd(gvals):
+            vm = dict(arg_vals)
+            vm.update(gvals)
+            vm.update(aux_vals)
+            outs, aux_updates = eval_graph(symbol, vm, True, rng_raw)
+            return tuple(outs), aux_updates
+
+        gvals = {n: arg_vals[n] for n in grad_names}
+        fwd_fn = jax.checkpoint(fwd) if mirror else fwd
+        outs, vjp_fn, aux_updates = jax.vjp(
+            lambda gv: fwd_fn(gv), gvals, has_aux=True)
+        cots = tuple(
+            og if og is not None else jnp.ones_like(o)
+            for o, og in zip(outs, ograds))
+        grads = vjp_fn(cots)[0]
+        return outs, aux_updates, grads
+
+    return fb
 
 
 class Executor:
@@ -115,36 +157,10 @@ class Executor:
         """Fused forward+backward (one XLA program ≙ the train-mode cached
         graph with backward segment, cached_op.cc StaticBackward)."""
         if not self._compiled_grad:
-            sym = self._symbol
             grad_names = [n for n in self._arg_names
                           if self.grad_req.get(n, "null") != "null"]
-
-            # MXNET_BACKWARD_DO_MIRROR (ref: env_var.md:187, the mirror/
-            # recompute option of src/nnvm/gradient.cc): on TPU this is
-            # rematerialization — wrap the forward in jax.checkpoint so
-            # the backward recomputes activations instead of storing them
-            from .base import get_env
-            mirror = get_env("MXNET_BACKWARD_DO_MIRROR", False)
-
-            def fb(arg_vals, aux_vals, rng_raw, ograds):
-                def fwd(gvals):
-                    vm = dict(arg_vals)
-                    vm.update(gvals)
-                    vm.update(aux_vals)
-                    outs, aux_updates = eval_graph(sym, vm, True, rng_raw)
-                    return tuple(outs), aux_updates
-
-                gvals = {n: arg_vals[n] for n in grad_names}
-                fwd_fn = jax.checkpoint(fwd) if mirror else fwd
-                outs, vjp_fn, aux_updates = jax.vjp(
-                    lambda gv: fwd_fn(gv), gvals, has_aux=True)
-                cots = tuple(
-                    og if og is not None else jnp.ones_like(o)
-                    for o, og in zip(outs, ograds))
-                grads = vjp_fn(cots)[0]
-                return outs, aux_updates, grads
-
-            self._compiled_grad["fb"] = jax.jit(fb)
+            self._compiled_grad["fb"] = jax.jit(
+                graph_forward_backward(self._symbol, grad_names))
         return self._compiled_grad["fb"]
 
     def compile_signature(self, is_train: bool = False):

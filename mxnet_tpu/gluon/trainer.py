@@ -52,6 +52,7 @@ class Trainer:
         self._params_to_init = []
         self._contains_sparse_weight = False
         self._contains_sparse_grad = False
+        self._grad_buckets = None  # lazy; see _allreduce_grads
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -129,11 +130,67 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        if self._update_on_kvstore or \
+                not getattr(self._kvstore, "supports_flat_allreduce",
+                            False):
+            # server-side optimizer (or async PS): the server applies
+            # per key — per-param push/pull semantics are the contract
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.push(i, param.list_grad(), priority=-i)
+                    if not self._update_on_kvstore:
+                        self._kvstore.pull(i, param.list_grad(),
+                                           priority=-i)
+            return
+        self._allreduce_grads_bucketed()
+
+    def _bucketable(self, param):
+        """Dense single-buffer gradients coalesce; row_sparse grads and
+        multi-device shard lists keep the per-param path."""
+        from ..ndarray.sparse import RowSparseNDArray
+        grads = param.list_grad()
+        return len(grads) == 1 and \
+            not isinstance(grads[0], RowSparseNDArray)
+
+    def _allreduce_grads_bucketed(self):
+        """DDP-style coalesced exchange (ISSUE 5): O(buckets) kvstore
+        round trips instead of O(params) — gradients of like dtype are
+        flattened into buckets capped at MXNET_GRAD_BUCKET_BYTES
+        (step.buckets), allreduced flat, and scattered back into the
+        parameters' grad buffers."""
+        from ..ndarray.ndarray import _wrap
+        from ..step.buckets import GradientBuckets
+        items, leftover = [], []
         for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.list_grad(), priority=-i)
-                if not self._update_on_kvstore:
-                    self._kvstore.pull(i, param.list_grad(), priority=-i)
+            if param.grad_req == "null":
+                continue
+            if not self._bucketable(param):
+                leftover.append(i)
+                continue
+            g = param.grad()
+            items.append((i, tuple(g.shape), str(g.dtype),
+                          g.size * g.dtype.itemsize))
+        sig = (tuple(items), tuple(leftover))
+        # (re)build when the layout changes — a Parameter.cast (amp
+        # fine-tuning) or grad_req flip would otherwise hit a stale
+        # assignment and concat mixed dtypes into one bucket
+        if self._grad_buckets is None or self._grad_buckets[2] != sig:
+            self._grad_buckets = (GradientBuckets(items), leftover, sig)
+        buckets, leftover, _ = self._grad_buckets
+        grads = {i: self._params[i].grad()._data
+                 for b in buckets.buckets for i, _, _ in b.entries}
+        for bid, bucket in enumerate(buckets.buckets):
+            flat = buckets.flatten(bucket, grads)
+            reduced = self._kvstore.allreduce_flat(
+                f"__grad_bucket_{bid}", _wrap(flat))
+            for i, seg in buckets.unflatten(bucket,
+                                            reduced._data).items():
+                self._params[i].grad()._rebind(seg)
+        for i in leftover:  # sparse / multi-device: per-param exchange
+            self._kvstore.push(i, self._params[i].list_grad(),
+                               priority=-i)
+            self._kvstore.pull(i, self._params[i].list_grad(),
+                               priority=-i)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """ref: trainer.py:366."""
@@ -144,13 +201,35 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            if self._kvstore and self._update_on_kvstore:
-                self._kvstore.pull(i, param.list_data(), priority=-i)
-                continue
+        if self._kvstore and self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.pull(i, param.list_data(), priority=-i)
+            return
+        live = [(i, param) for i, param in enumerate(self._params)
+                if param.grad_req != "null"]
+        if len(live) > 1 and updater.aggregate_updates:
+            # aggregated multi-tensor update: the list-form Updater
+            # chunks by MXNET_OPTIMIZER_AGGREGATION_SIZE and runs one
+            # fused kernel call per chunk (optimizer.update_multi);
+            # sparse/multi-precision fall back per-param inside it
+            updater([i for i, _ in live],
+                    [p.grad() for _, p in live],
+                    [p.data() for _, p in live])
+            return
+        for i, param in live:
             updater(i, param.grad(), param.data())
+
+    def fuse_step(self, net, loss_fn=None, **kwargs):
+        """Compile this trainer's whole step into one donated XLA
+        computation (mxnet_tpu.step.StepFunction): ``fused.step(x, y)``
+        replaces the record/backward/step(batch) triple with a single
+        dispatch, bitwise-equal to the eager loop for optimizers with a
+        functional fused_apply. The trainer keeps owning optimizer
+        state (save_states/load_states and mxresil checkpoints see the
+        post-update values)."""
+        from ..step import StepFunction
+        return StepFunction(net, loss_fn, trainer=self, **kwargs)
 
     def save_states(self, fname):
         """ref: trainer.py save_states."""

@@ -57,6 +57,8 @@ def _kv_timer(name: str):
 
 
 class KVStoreBase:
+    supports_flat_allreduce = True  # see allreduce_flat / step/buckets.py
+
     def __init__(self):
         self._updater = None
         self._optimizer = None
@@ -161,6 +163,19 @@ class KVStoreBase:
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
+
+    def allreduce_flat(self, key, value: NDArray) -> NDArray:
+        """Stateless allreduce of one flat gradient bucket (the DDP-
+        style coalesced exchange, step/buckets.py): reduce local device
+        shards, then the cross-process reduce — ONE data-plane round
+        trip per bucket instead of one per parameter, and no server
+        state left behind (unlike push, which accumulates into the
+        store). ``key`` only labels the transfer (compression residuals,
+        fault-plan selectors)."""
+        from .resil.hooks import guarded as _guarded
+        with _kv_timer("kvstore_bucket_seconds"):
+            return _guarded("kvstore.push", self._global_reduce, key,
+                            self._reduce([value]))
 
     broadcast = pull
 
@@ -282,6 +297,11 @@ class KVStoreDist(KVStoreBase):
 class KVStoreDistAsync(KVStoreBase):
     """Asynchronous multi-process store over the parameter-server role.
 
+    No bucketed allreduce: the async contract is per-key server-side
+    application on arrival — a coalesced flat bucket has no server key
+    to land on (``supports_flat_allreduce = False`` keeps the gluon
+    Trainer on the per-param path).
+
     Each push is shipped to the server and applied the moment it arrives
     (server-side optimizer if set, else accumulate) — no coordination
     with other workers; pulls read whatever state the server holds right
@@ -290,6 +310,8 @@ class KVStoreDistAsync(KVStoreBase):
     barrier() IS still a real barrier (ps::Postoffice::Barrier exists in
     async mode too) — training steps just never call it.
     """
+
+    supports_flat_allreduce = False
 
     def __init__(self, type_name="dist_async"):
         super().__init__()

@@ -103,10 +103,14 @@ def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
             # per-op event (ref: profiler operator events hooked into
             # the engine, include/mxnet/engine.h:189) — registry-
             # dispatched ops arrive already instrumented (_mx_traced,
-            # telemetry.tracing) and must not be double-counted
+            # telemetry.tracing) and must not be double-counted. The
+            # block INSIDE the scope makes the event span device time,
+            # not just dispatch time (engine.eager_sync is on while
+            # the imperative domain records).
             with _prof.Scope(getattr(fn, "__name__", "op"),
                              domain="imperative"):
                 out = call(*in_arrays)
+                jax.block_until_ready(out)
         else:
             out = call(*in_arrays)  # must not write tape tracer nodes
     finally:
@@ -129,10 +133,12 @@ def invoke(fn: Callable, inputs: Sequence["NDArray"], n_out: int = 1,
                     differentiable=differentiable)
     wrapped = [_wrap(o) for o in outs]
     from .. import engine as _engine
-    if _engine.is_sync():
-        # NaiveEngine / MXNET_ENFORCE_DETERMINISM: block after every op
-        # so exceptions surface at the op that raised them (ref:
-        # threaded_engine.h:64-65 exception chains; env_var.md:110-114)
+    if _engine.eager_sync():
+        # Opt-in per-op blocking (MXNET_EAGER_SYNC=1 / profiler-on /
+        # NaiveEngine / MXNET_ENFORCE_DETERMINISM): exceptions surface
+        # at the op that raised them (ref: threaded_engine.h:64-65
+        # exception chains; env_var.md:110-114). Default is ASYNC so
+        # XLA pipelines eager chains (ISSUE 5).
         jax.block_until_ready(outs)
     if isinstance(out, (tuple, list)):
         return wrapped

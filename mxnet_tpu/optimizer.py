@@ -61,7 +61,13 @@ class Optimizer:
         self.multi_precision = multi_precision
         self.idx2name = dict(param_idx2name or {})
         self.param_dict = param_dict or {}
-        self.aggregate_num = 0
+        # width of the fused multi-tensor update (ref: the reference
+        # optimizers read MXNET_OPTIMIZER_AGGREGATION_SIZE for the
+        # multi_*_update kernels) — honored by the base update_multi
+        # aggregation path for every optimizer with a fused_apply
+        from .base import get_env
+        self.aggregate_num = max(
+            1, min(45, int(get_env("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4))))
 
     # -- state ------------------------------------------------------------
     def create_state(self, index, weight):
@@ -84,6 +90,100 @@ class Optimizer:
             weight._rebind(w32._data.astype(weight._data.dtype))
         else:
             self.update(index, weight, grad, state)
+
+    # -- functional multi-tensor path (mxstep) ----------------------------
+    @property
+    def has_fused_apply(self) -> bool:
+        """True when this optimizer provides a pure functional
+        :meth:`fused_apply` — the fused train-step compiler
+        (mxnet_tpu/step/) and the aggregated eager update both require
+        it; optimizers without one downgrade to the per-param eager
+        loop (the steplint pass flags them)."""
+        return type(self).fused_apply is not Optimizer.fused_apply
+
+    def fused_hyper(self, index):
+        """Advance the update count for ``index`` and return the
+        per-step scalar hyperparameters ``(lr, wd)`` with any per-step
+        correction (Adam's bias correction) folded into ``lr`` — the
+        exact host-side float64 arithmetic of the eager ``update``, so
+        the fused path is bitwise-identical to it."""
+        lr, wd, _ = self._common(index)
+        return lr, wd
+
+    def fused_signature(self):
+        """The scalar hyperparameters :meth:`fused_apply` bakes into a
+        trace as closure constants. Every jit cache built over
+        fused_apply (the aggregated eager chunks, StepFunction's
+        signature cache) keys on this tuple, so mutating one of these
+        mid-training retraces instead of being silently ignored —
+        lr/wd are NOT here (they travel as traced scalars).
+        Subclasses extend with their own structural scalars."""
+        return (float(self.rescale_grad),
+                None if self.clip_gradient is None
+                else float(self.clip_gradient))
+
+    def fused_apply(self, indices, weights, grads, states, lrs, wds):
+        """Pure multi-tensor update over raw jax arrays: returns
+        ``(new_weights, new_states)`` lists without touching NDArrays —
+        safe to call under a jit trace (the whole-train-step compiler)
+        or eagerly (the aggregated update path). ``states`` entries are
+        raw arrays / tuples of raw arrays / None, matching
+        ``create_state``'s structure. ``lrs``/``wds`` may be python
+        floats (eager) or weakly-typed f32 scalars (traced) — both
+        promote exactly like the eager per-param kernels."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no functional fused_apply; the "
+            "fused step and aggregated update paths fall back to the "
+            "eager per-param loop")
+
+    def update_multi(self, indices, weights, grads, states):
+        """Aggregated eager update: one fused multi-tensor kernel call
+        per chunk of ``aggregate_num`` parameters
+        (MXNET_OPTIMIZER_AGGREGATION_SIZE; ref: optimizer_op.cc
+        multi_sgd_update and the list-form Updater path). Falls back to
+        per-param updates when no ``fused_apply`` is available."""
+        if not self.has_fused_apply:
+            for i, w, g, s in zip(indices, weights, grads, states):
+                self.update_multi_precision(i, w, g, s)
+            return
+        width = max(1, self.aggregate_num)
+        for start in range(0, len(indices), width):
+            idxs = list(indices[start:start + width])
+            ws = list(weights[start:start + width])
+            gs = list(grads[start:start + width])
+            ss = list(states[start:start + width])
+            hyper = [self.fused_hyper(i) for i in idxs]
+            new_w, new_s = self._fused_eager_call(
+                idxs, [w._data for w in ws], [g._data for g in gs],
+                [_state_values(s) for s in ss],
+                tuple(h[0] for h in hyper), tuple(h[1] for h in hyper))
+            for w, nw in zip(ws, new_w):
+                w._rebind(nw)
+            for s, ns in zip(ss, new_s):
+                _state_rebind(s, ns)
+
+    def _fused_eager_call(self, idxs, w_raw, g_raw, s_raw, lrs, wds):
+        """Dispatch one aggregated chunk through a cached jit: the
+        eager aggregated path costs ONE XLA program per chunk, and —
+        since the fused train step inlines the same expression DAG —
+        matches both the per-param loop and the in-step apply bitwise.
+        lrs/wds are traced scalars (schedulers don't retrace); the
+        cache keys on the chunk's indices plus fused_signature() —
+        every scalar the trace bakes in (rescale_grad, clip, momentum,
+        betas, ...), so mid-run hyperparameter mutation retraces."""
+        import jax
+        key = (tuple(idxs),) + self.fused_signature()
+        cache = self.__dict__.setdefault("_fused_jit_cache", {})
+        fn = cache.get(key)
+        if fn is None:
+            frozen = tuple(idxs)
+
+            def apply_chunk(ws, gs, ss, lrs, wds):
+                return self.fused_apply(list(frozen), ws, gs, ss,
+                                        list(lrs), list(wds))
+
+            fn = cache[key] = jax.jit(apply_chunk)
+        return fn(tuple(w_raw), tuple(g_raw), tuple(s_raw), lrs, wds)
 
     # -- hyperparams ------------------------------------------------------
     def set_learning_rate(self, lr):
@@ -131,11 +231,61 @@ class Optimizer:
 
     def __getstate__(self):
         d = self.__dict__.copy()
+        d.pop("_fused_jit_cache", None)  # compiled callables don't pickle
         return d
 
 
 def _assign(weight: NDArray, new: NDArray):
     weight._rebind(new._data)
+
+
+_KERNEL_JITS: Dict = {}
+
+
+def _jk(fn):
+    """Jitted optimizer kernel for the eager per-param path: ONE
+    compiled XLA program per update instead of one dispatch per jnp op.
+    Per-step scalars (lr/wd/rescale_grad) stay traced — weak f32, so a
+    scheduler changing lr never retraces — while structural scalars
+    (momentum/betas/clip, which feed python arithmetic or control flow
+    in the kernels) are static exactly like the fused step's closure
+    captures. Because the fused train step (mxnet_tpu/step/) inlines
+    the same expression DAG, eager and fused updates are
+    bitwise-identical (XLA's FMA contraction applies equally to both)."""
+    j = _KERNEL_JITS.get(fn)
+    if j is None:
+        import inspect
+        import jax
+        sig = inspect.signature(fn).parameters
+        static = [n for n, p in sig.items()
+                  if p.default is not inspect.Parameter.empty
+                  and n not in ("lr", "wd", "rescale_grad")]
+        j = _KERNEL_JITS[fn] = jax.jit(fn, static_argnames=static)
+    return j
+
+
+def _state_values(state):
+    """Raw jax arrays of an optimizer state slot (None / NDArray /
+    nested tuple of NDArrays) — the functional mirror of create_state's
+    structure, consumed by fused_apply."""
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(_state_values(s) for s in state)
+    return state._data
+
+
+def _state_rebind(state, new_values):
+    """Write fused_apply's new raw arrays back into the stateful slot
+    IN PLACE (the NDArray objects keep their identity — kvstore
+    updaters, trainers, and checkpoints all hold references)."""
+    if state is None:
+        return
+    if isinstance(state, (tuple, list)):
+        for s, n in zip(state, new_values):
+            _state_rebind(s, n)
+    else:
+        state._rebind(new_values)
 
 
 def _rowsparse_parts(grad):
@@ -196,11 +346,6 @@ class SGD(Optimizer):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
-        # width of the fused multi-tensor update (ref: the reference SGD
-        # reads MXNET_OPTIMIZER_AGGREGATION_SIZE for multi_sgd_update)
-        from .base import get_env
-        self.aggregate_num = max(
-            1, min(45, int(get_env("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4))))
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -227,46 +372,40 @@ class SGD(Optimizer):
             return
         lr, wd, clip = self._common(index)
         if state is None:
-            new_w = invoke(oops.sgd_update, [weight, grad], lr=lr, wd=wd,
+            new_w = invoke(_jk(oops.sgd_update), [weight, grad], lr=lr, wd=wd,
                            rescale_grad=self.rescale_grad, clip_gradient=clip)
             _assign(weight, new_w)
         else:
-            new_w, new_mom = invoke(oops.sgd_mom_update, [weight, grad, state],
+            new_w, new_mom = invoke(_jk(oops.sgd_mom_update), [weight, grad, state],
                                     n_out=2, lr=lr, momentum=self.momentum,
                                     wd=wd, rescale_grad=self.rescale_grad,
                                     clip_gradient=clip)
             _assign(weight, new_w)
             _assign(state, new_mom)
 
-    def update_multi(self, indices, weights, grads, states):
-        """Fused multi-tensor update — one op call for up to
-        aggregate_num parameters (ref: optimizer_op.cc multi_sgd_update /
-        multi_sgd_mom_update; width set by
-        MXNET_OPTIMIZER_AGGREGATION_SIZE)."""
-        from .ops.extra_ops import multi_sgd_mom_update, multi_sgd_update
-        n = len(indices)
-        lws = [self._common(i) for i in indices]
-        lrs = [t[0] for t in lws]
-        wds = [t[1] for t in lws]
-        clip = lws[0][2] if lws else -1.0
-        if self.momentum == 0.0:
-            arrays = [a for w, g in zip(weights, grads) for a in (w, g)]
-            outs = invoke(multi_sgd_update, arrays, n_out=n,
-                          lrs=lrs, wds=wds, rescale_grad=self.rescale_grad,
-                          clip_gradient=clip, num_weights=n)
-            for w, nw in zip(weights, outs):
-                _assign(w, nw)
-        else:
-            arrays = [a for w, g, m in zip(weights, grads, states)
-                      for a in (w, g, m)]
-            outs = invoke(multi_sgd_mom_update, arrays, n_out=2 * n,
-                          lrs=lrs, wds=wds, momentum=self.momentum,
-                          rescale_grad=self.rescale_grad,
-                          clip_gradient=clip, num_weights=n)
-            for w, nw in zip(weights, outs[:n]):
-                _assign(w, nw)
-            for m, nm in zip(states, outs[n:]):
-                _assign(m, nm)
+    def fused_apply(self, indices, weights, grads, states, lrs, wds):
+        """Functional multi-tensor SGD over raw arrays (ref:
+        optimizer_op.cc multi_sgd_update / multi_sgd_mom_update) —
+        the same sgd_update/sgd_mom_update kernels as the eager
+        per-param path, so results are bitwise-identical to it."""
+        clip = -1.0 if self.clip_gradient is None else self.clip_gradient
+        new_w, new_s = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            if s is None:
+                new_w.append(oops.sgd_update(
+                    w, g, lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                    clip_gradient=clip))
+                new_s.append(None)
+            else:
+                nw, nm = oops.sgd_mom_update(
+                    w, g, s, lr=lr, momentum=self.momentum, wd=wd,
+                    rescale_grad=self.rescale_grad, clip_gradient=clip)
+                new_w.append(nw)
+                new_s.append(nm)
+        return new_w, new_s
+
+    def fused_signature(self):
+        return super().fused_signature() + (float(self.momentum),)
 
 
 @register
@@ -283,16 +422,36 @@ class NAG(Optimizer):
     def update(self, index, weight, grad, state):
         lr, wd, clip = self._common(index)
         if state is None:
-            new_w = invoke(oops.sgd_update, [weight, grad], lr=lr, wd=wd,
+            new_w = invoke(_jk(oops.sgd_update), [weight, grad], lr=lr, wd=wd,
                            rescale_grad=self.rescale_grad, clip_gradient=clip)
             _assign(weight, new_w)
         else:
-            new_w, new_mom = invoke(oops.nag_mom_update, [weight, grad, state],
+            new_w, new_mom = invoke(_jk(oops.nag_mom_update), [weight, grad, state],
                                     n_out=2, lr=lr, momentum=self.momentum,
                                     wd=wd, rescale_grad=self.rescale_grad,
                                     clip_gradient=clip)
             _assign(weight, new_w)
             _assign(state, new_mom)
+
+    def fused_apply(self, indices, weights, grads, states, lrs, wds):
+        clip = -1.0 if self.clip_gradient is None else self.clip_gradient
+        new_w, new_s = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            if s is None:
+                new_w.append(oops.sgd_update(
+                    w, g, lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                    clip_gradient=clip))
+                new_s.append(None)
+            else:
+                nw, nm = oops.nag_mom_update(
+                    w, g, s, lr=lr, momentum=self.momentum, wd=wd,
+                    rescale_grad=self.rescale_grad, clip_gradient=clip)
+                new_w.append(nw)
+                new_s.append(nm)
+        return new_w, new_s
+
+    def fused_signature(self):
+        return super().fused_signature() + (float(self.momentum),)
 
 
 @register
@@ -335,12 +494,38 @@ class Adam(Optimizer):
             _rows_set(var, vb, vslots, v_rows)
             return
         new_w, new_mean, new_var = invoke(
-            oops.adam_update, [weight, grad, mean, var], n_out=3, lr=lr,
+            _jk(oops.adam_update), [weight, grad, mean, var], n_out=3, lr=lr,
             beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
             rescale_grad=self.rescale_grad, clip_gradient=clip)
         _assign(weight, new_w)
         _assign(mean, new_mean)
         _assign(var, new_var)
+
+    def fused_hyper(self, index):
+        # fold the bias correction into lr on the host in float64 —
+        # the exact arithmetic of the eager update above
+        lr, wd, _ = self._common(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        return lr * (math.sqrt(coef2) / coef1), wd
+
+    def fused_apply(self, indices, weights, grads, states, lrs, wds):
+        clip = -1.0 if self.clip_gradient is None else self.clip_gradient
+        new_w, new_s = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            mean, var = s
+            nw, nm, nv = oops.adam_update(
+                w, g, mean, var, lr=lr, beta1=self.beta1, beta2=self.beta2,
+                epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=clip)
+            new_w.append(nw)
+            new_s.append((nm, nv))
+        return new_w, new_s
+
+    def fused_signature(self):
+        return super().fused_signature() + (
+            float(self.beta1), float(self.beta2), float(self.epsilon))
 
 
 @register
@@ -361,12 +546,30 @@ class AdamW(Optimizer):
         lr, wd, clip = self._common(index)
         mean, var = state
         new_w, new_mean, new_var = invoke(
-            oops.adamw_update, [weight, grad, mean, var], n_out=3, lr=lr,
+            _jk(oops.adamw_update), [weight, grad, mean, var], n_out=3, lr=lr,
             beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
             eta=self.eta, rescale_grad=self.rescale_grad, clip_gradient=clip)
         _assign(weight, new_w)
         _assign(mean, new_mean)
         _assign(var, new_var)
+
+    def fused_apply(self, indices, weights, grads, states, lrs, wds):
+        clip = -1.0 if self.clip_gradient is None else self.clip_gradient
+        new_w, new_s = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            mean, var = s
+            nw, nm, nv = oops.adamw_update(
+                w, g, mean, var, lr=lr, beta1=self.beta1, beta2=self.beta2,
+                epsilon=self.epsilon, wd=wd, eta=self.eta,
+                rescale_grad=self.rescale_grad, clip_gradient=clip)
+            new_w.append(nw)
+            new_s.append((nm, nv))
+        return new_w, new_s
+
+    def fused_signature(self):
+        return super().fused_signature() + (
+            float(self.beta1), float(self.beta2), float(self.epsilon),
+            float(self.eta))
 
 
 @register
@@ -427,7 +630,7 @@ class RMSProp(Optimizer):
         if self.centered:
             n, g_avg, delta = state
             new_w, new_n, new_g, new_d = invoke(
-                oops.rmspropalex_update, [weight, grad, n, g_avg, delta],
+                _jk(oops.rmspropalex_update), [weight, grad, n, g_avg, delta],
                 n_out=4, lr=lr, gamma1=self.gamma1, gamma2=self.gamma2,
                 epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
                 clip_gradient=clip, clip_weights=cw)
@@ -435,11 +638,41 @@ class RMSProp(Optimizer):
             _assign(g_avg, new_g); _assign(delta, new_d)
         else:
             new_w, new_n = invoke(
-                oops.rmsprop_update, [weight, grad, state], n_out=2, lr=lr,
+                _jk(oops.rmsprop_update), [weight, grad, state], n_out=2, lr=lr,
                 gamma1=self.gamma1, epsilon=self.epsilon, wd=wd,
                 rescale_grad=self.rescale_grad, clip_gradient=clip,
                 clip_weights=cw)
             _assign(weight, new_w); _assign(state, new_n)
+
+    def fused_apply(self, indices, weights, grads, states, lrs, wds):
+        clip = -1.0 if self.clip_gradient is None else self.clip_gradient
+        cw = -1.0 if self.clip_weights is None else self.clip_weights
+        new_w, new_s = [], []
+        for w, g, s, lr, wd in zip(weights, grads, states, lrs, wds):
+            if self.centered:
+                n, g_avg, delta = s
+                nw, nn, ng, nd = oops.rmspropalex_update(
+                    w, g, n, g_avg, delta, lr=lr, gamma1=self.gamma1,
+                    gamma2=self.gamma2, epsilon=self.epsilon, wd=wd,
+                    rescale_grad=self.rescale_grad, clip_gradient=clip,
+                    clip_weights=cw)
+                new_w.append(nw)
+                new_s.append((nn, ng, nd))
+            else:
+                nw, nn = oops.rmsprop_update(
+                    w, g, s, lr=lr, gamma1=self.gamma1,
+                    epsilon=self.epsilon, wd=wd,
+                    rescale_grad=self.rescale_grad, clip_gradient=clip,
+                    clip_weights=cw)
+                new_w.append(nw)
+                new_s.append(nn)
+        return new_w, new_s
+
+    def fused_signature(self):
+        return super().fused_signature() + (
+            float(self.gamma1), float(self.gamma2), float(self.epsilon),
+            bool(self.centered),
+            None if self.clip_weights is None else float(self.clip_weights))
 
 
 @register
@@ -688,12 +921,12 @@ class Updater:
                     self.states[i] = \
                         self.optimizer.create_state_multi_precision(i, w)
                     self.states_synced[i] = True
-            # the fused path handles plain dense fp32 tensors only;
+            # the fused path handles plain dense tensors only;
             # multi-precision states (w32, base) tuples and row_sparse
             # grads keep their scalar update semantics
             from .ndarray.sparse import RowSparseNDArray
             fusable = (self.aggregate_updates
-                       and hasattr(self.optimizer, "update_multi")
+                       and self.optimizer.has_fused_apply
                        and not self.optimizer.multi_precision
                        and not any(isinstance(g, RowSparseNDArray)
                                    for g in grad))

@@ -170,10 +170,11 @@ def findings_report(tool: str, findings: Iterable[Finding],
 # the default manager with the built-in analyses registered; import-time
 # cheap (passes hold no state until run)
 def default_manager() -> PassManager:
-    from . import oplint, graphlint, tracercheck, dispatchlint
+    from . import oplint, graphlint, tracercheck, dispatchlint, steplint
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
     pm.register(tracercheck.TracerLeakCheck())
     pm.register(dispatchlint.DispatchAudit())
+    pm.register(steplint.OptimizerFusionAudit())
     return pm

@@ -1,0 +1,32 @@
+"""mxstep: the fused whole-train-step compiler.
+
+The survey's target is a TPU-native stack where a symbolic graph lowers
+to ONE XLA computation per training step — yet the reference-shaped
+training path (gluon.Trainer over kvstore) executes like eager MXNet:
+one kvstore push/pull and one ``Optimizer.update`` per parameter, each
+a separate un-jitted dispatch. This package closes that gap, following
+"Operator Fusion in XLA" (fusion across op boundaries is where the
+throughput is) and "Automatic Cross-Replica Sharding of Weight Update
+in Data-Parallel Training" (the weight-update/allreduce phase is a
+first-class fusion target, not an afterthought):
+
+- :class:`~mxnet_tpu.step.stepfn.StepFunction` — captures forward +
+  backward + gradient exchange + optimizer update into ONE ``jax.jit``
+  computation with donated weight/optimizer-state buffers, keyed by a
+  shape signature with hit/miss counters in the telemetry registry;
+- :mod:`~mxnet_tpu.step.buckets` — DDP-style size-capped flat gradient
+  buckets for the kvstore exchange (O(buckets) transfers instead of
+  O(params); used by ``gluon.Trainer._allreduce_grads``);
+- :mod:`~mxnet_tpu.step.cache` — the persistent XLA compilation cache
+  behind ``MXNET_COMPILE_CACHE_DIR`` so warmup survives restarts.
+
+See docs/performance.md for architecture and tuning.
+"""
+from __future__ import annotations
+
+from .buckets import GradientBuckets  # noqa: F401
+from .cache import enable_compile_cache, maybe_enable_compile_cache  # noqa: F401
+from .stepfn import StepFunction  # noqa: F401
+
+__all__ = ["StepFunction", "GradientBuckets", "enable_compile_cache",
+           "maybe_enable_compile_cache"]

@@ -1,0 +1,99 @@
+"""Gradient bucketing: coalesce per-parameter gradients into size-capped
+flat buckets for the kvstore exchange.
+
+The reference pushes/pulls one kvstore key per parameter — O(params)
+round trips per step, each with its own transport latency (ref:
+python/mxnet/gluon/trainer.py:334 allreduce_grads). DDP-style bucketing
+(the PyTorch DistributedDataParallel / Horovod tensor-fusion recipe)
+concatenates gradients of like dtype into flat buffers capped at
+``MXNET_GRAD_BUCKET_BYTES`` so the distributed path does O(buckets)
+transfers; the single-process path reduces each bucket to an identity
+(and the fully-fused path compiles the exchange into the step as a
+``psum`` — see stepfn.py).
+
+Bucket assignment is static per parameter set (shapes don't change
+across steps), so the flatten/unflatten offsets are computed once.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..base import get_env
+
+__all__ = ["GradientBuckets", "DEFAULT_BUCKET_BYTES"]
+
+DEFAULT_BUCKET_BYTES = 4 << 20  # 4 MiB, the DDP-ish sweet spot
+
+
+class _Bucket:
+    __slots__ = ("dtype", "entries", "nbytes")
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.entries: List[Tuple[int, Tuple[int, ...], int]] = []
+        self.nbytes = 0
+
+
+class GradientBuckets:
+    """Static assignment of parameter indices to flat buckets.
+
+    ``items`` is a sequence of ``(index, shape, dtype, nbytes)`` rows —
+    one per dense gradient to exchange. Parameters of different dtypes
+    never share a bucket (a concat would upcast); a single oversized
+    parameter gets a bucket of its own.
+    """
+
+    def __init__(self, items: Sequence[Tuple[int, Tuple[int, ...], object,
+                                             int]],
+                 cap_bytes: int = 0):
+        self.cap_bytes = int(cap_bytes) if cap_bytes else int(
+            get_env("MXNET_GRAD_BUCKET_BYTES", DEFAULT_BUCKET_BYTES))
+        open_by_dtype: Dict[str, _Bucket] = {}
+        self.buckets: List[_Bucket] = []
+        for index, shape, dtype, nbytes in items:
+            key = str(dtype)
+            b = open_by_dtype.get(key)
+            if b is None or b.nbytes + nbytes > self.cap_bytes:
+                b = _Bucket(dtype)
+                self.buckets.append(b)
+                open_by_dtype[key] = b
+            b.entries.append((index, tuple(shape), nbytes))
+            b.nbytes += nbytes
+            if b.nbytes >= self.cap_bytes:
+                open_by_dtype.pop(key, None)  # closed: full
+        self._record_metrics()
+
+    def _record_metrics(self):
+        from ..telemetry import metrics as _metrics
+        _metrics.gauge(
+            "grad_bucket_count",
+            "flat gradient-exchange buckets per step").set(
+            len(self.buckets))
+        h = _metrics.histogram(
+            "grad_bucket_bytes", "bytes per gradient-exchange bucket")
+        for b in self.buckets:
+            h.observe(b.nbytes)
+
+    def __len__(self):
+        return len(self.buckets)
+
+    def flatten(self, bucket: _Bucket, grads: Dict[int, object]):
+        """Concat the bucket's gradients (raw jax arrays by param index)
+        into one flat buffer."""
+        parts = [grads[i].reshape(-1) for i, _, _ in bucket.entries]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def unflatten(self, bucket: _Bucket, flat):
+        """Split a reduced flat buffer back into {index: array} with the
+        original shapes."""
+        out = {}
+        offset = 0
+        for index, shape, _ in bucket.entries:
+            n = 1
+            for s in shape:
+                n *= s
+            out[index] = flat[offset:offset + n].reshape(shape)
+            offset += n
+        return out

@@ -1,0 +1,497 @@
+"""StepFunction: one donated XLA computation per training step.
+
+The reference-shaped training loop runs four phases per step — forward,
+backward, gradient exchange, optimizer update — as separate dispatch
+streams: the gluon ``Trainer`` pushes/pulls one kvstore key per
+parameter and calls one ``Optimizer.update`` per parameter, each a
+separate un-jitted dispatch (ref: python/mxnet/gluon/trainer.py:305).
+``StepFunction`` captures all four into ONE ``jax.jit`` computation —
+one dispatch per step instead of O(params):
+
+- forward + backward via ``jax.vjp`` over the same pure trace the
+  hybridize/Executor machinery uses (``gluon.block.functional_call``
+  for HybridBlocks, ``executor.graph_forward_backward`` for Symbols),
+  seeded with a ones cotangent exactly like ``loss.backward()``;
+- gradient exchange lowered in-jit: identity for the single-process
+  path, ``lax.psum`` over ``psum_axis`` when the step runs inside a
+  mesh context (the cross-replica phase is part of the fused program,
+  per "Automatic Cross-Replica Sharding of Weight Update");
+- the optimizer via the functional multi-tensor
+  :meth:`~mxnet_tpu.optimizer.Optimizer.fused_apply` kernels. Per-step
+  scalars (lr, wd, Adam bias correction) are computed on the host in
+  float64 — the exact arithmetic of the eager per-param loop — and
+  passed as weakly-typed f32 scalars so schedulers never retrace;
+- weight and optimizer-state buffers **donated** to XLA (buffer
+  reuse); the post-step write-back rebinds the gluon Parameters and
+  the Updater states in place, so checkpoints, kvstore updaters and
+  ``mxresil`` preemption guards observe the post-update values.
+
+The fused step is **bitwise-identical** to the eager loop
+(test-enforced for SGD/Adam/AdamW in tests/test_step.py). Two
+mechanisms make that hold: the eager per-param path dispatches each
+optimizer kernel as one jitted program (optimizer._jk — the same
+expression DAG XLA sees inside the fused step, so FMA contraction
+applies equally to both), and an ``optimization_barrier`` pins the
+gradient/update boundary so fusion cannot clone gradient producers
+into the update kernels with different contraction.
+
+Compiled programs are keyed by the input shape signature; hits/misses
+feed the telemetry registry (``fused_step_cache_hits_total`` /
+``..._misses_total``) and every miss is classified by the recompile
+auditor (kind ``fused_step``) — ``tools/mxprof.py step`` renders the
+report. See docs/performance.md.
+"""
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _wrap
+from ..optimizer import _state_rebind, _state_values
+from .. import random as _random
+
+__all__ = ["StepFunction"]
+
+
+def _raw(a):
+    return a._data if isinstance(a, NDArray) else jnp.asarray(a)
+
+
+class StepFunction:
+    """Fused whole-train-step compiler for a HybridBlock (or Symbol).
+
+    Block mode::
+
+        trainer = gluon.Trainer(net.collect_params(), "sgd", {...})
+        fused = StepFunction(net, loss_fn, trainer=trainer)
+        for x, y in batches:
+            loss = fused.step(x, y)          # ONE dispatch
+
+    is the fused equivalent of::
+
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch_size)
+
+    and bitwise-equal to it for every optimizer with a functional
+    ``fused_apply`` (SGD/NAG/Adam/AdamW/RMSProp). Without a trainer,
+    pass ``optimizer=``/``optimizer_params=`` and the StepFunction owns
+    its own Updater (state lives in ``self.updater.states`` — the same
+    structure ``Trainer.save_states`` snapshots).
+
+    Symbol mode::
+
+        fused = StepFunction(loss_sym, arg_dict=args, aux_dict=auxs,
+                             input_names=("data", "label"),
+                             optimizer="sgd")
+
+    traces the symbol through the Executor's ``eval_graph`` machinery
+    (``executor.graph_forward_backward``); the symbol's first output is
+    the per-sample loss.
+    """
+
+    def __init__(self, net, loss_fn=None, trainer=None, optimizer="sgd",
+                 optimizer_params=None, arg_dict=None, aux_dict=None,
+                 input_names=("data", "softmax_label"), grad_names=None,
+                 donate=True, psum_axis=None, name=None):
+        from ..symbol.symbol import Symbol
+        self._net = net
+        self._loss_fn = loss_fn
+        self._trainer = trainer
+        self._psum_axis = psum_axis
+        self._symbol_mode = isinstance(net, Symbol)
+        self._name = name or (net.name if hasattr(net, "name")
+                              else type(net).__name__)
+        # donation is a no-op on the CPU backend (and jax warns about
+        # it per compile); request it only where PJRT honors it
+        self._donate = bool(donate) and jax.default_backend() != "cpu"
+        self._cache = {}
+        self._last = None  # (jitted fn, key) of the newest compile
+
+        if trainer is not None:
+            if optimizer_params or optimizer != "sgd":
+                raise MXNetError("pass either trainer= or optimizer=/"
+                                 "optimizer_params=, not both")
+            self._optimizer = trainer._optimizer
+            self._updater = trainer._updaters[0]
+            self._scale = trainer._scale
+            if (trainer._kvstore_params.get("update_on_kvstore")
+                    or (trainer._kv_initialized
+                        and trainer._update_on_kvstore)):
+                raise MXNetError(
+                    "StepFunction runs the optimizer inside the fused "
+                    "step; update_on_kvstore trainers are unsupported — "
+                    "create the Trainer with update_on_kvstore=False (or "
+                    "no kvstore)")
+            kvs = trainer._kvstore_params.get("kvstore")
+            kv_type = getattr(kvs, "type",
+                              kvs if isinstance(kvs, str) else "")
+            if isinstance(kv_type, str) and "dist" in kv_type:
+                raise MXNetError(
+                    "StepFunction does not drive the kvstore data "
+                    "plane; for multi-process training use "
+                    "parallel.ParallelTrainer (in-jit psum over a "
+                    "mesh) or the eager Trainer loop")
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             **(optimizer_params or {}))
+            self._updater = opt_mod.get_updater(self._optimizer)
+            self._scale = 1.0
+
+        if self._optimizer.multi_precision:
+            raise MXNetError("StepFunction does not support "
+                             "multi_precision optimizers; use the eager "
+                             "per-param path")
+        if not self._optimizer.has_fused_apply:
+            raise MXNetError(
+                f"optimizer {type(self._optimizer).__name__} has no "
+                "functional fused_apply — the fused step would downgrade "
+                "to eager; implement fused_apply (see steplint) or use "
+                "the eager Trainer loop")
+        if trainer is not None:
+            # ALL validation passed — only now alter the trainer: the
+            # fused step replaces the kvstore data plane, so a later
+            # trainer.step() must not double-apply through a
+            # server-side optimizer
+            trainer._kvstore_params["update_on_kvstore"] = False
+
+        if self._symbol_mode:
+            self._init_symbol(net, arg_dict or {}, aux_dict or {},
+                              tuple(input_names), grad_names)
+        else:
+            self._plist = None  # resolved lazily (deferred shapes)
+
+    # ------------------------------------------------------------------
+    # parameter resolution
+    # ------------------------------------------------------------------
+    def _init_symbol(self, sym, arg_dict, aux_dict, input_names,
+                     grad_names):
+        self._input_names = tuple(input_names)
+        missing = [n for n in sym.list_arguments()
+                   if n not in arg_dict and n not in self._input_names]
+        if missing:
+            raise MXNetError(f"symbol-mode StepFunction: arg_dict is "
+                             f"missing {missing}")
+        self._param_objs = dict(arg_dict)
+        self._aux_objs = {n: aux_dict[n]
+                          for n in sym.list_auxiliary_states()}
+        self._trainable = tuple(sorted(grad_names if grad_names is not None
+                                       else self._param_objs))
+        self._indices = list(range(len(self._trainable)))
+        self._ensure_states({i: self._param_objs[n]
+                             for i, n in zip(self._indices,
+                                             self._trainable)})
+
+    def _resolve_block_params(self, sample_x):
+        from ..gluon.parameter import DeferredInitializationError
+        try:
+            plist = sorted(
+                self._net._collect_params_with_prefix().items())
+            for _, p in plist:
+                p.data()
+        except DeferredInitializationError:
+            from .. import autograd as _ag
+            with _ag.pause():
+                self._net(_wrap(_raw(sample_x)[:1]))
+            plist = sorted(
+                self._net._collect_params_with_prefix().items())
+        self._plist = plist
+        self._param_objs = {n: p for n, p in plist}
+        # weight tying: one Parameter under several prefixed names
+        # would split its gradient across the aliases (each alias gets
+        # a partial vjp cotangent), update each alias from the same
+        # pre-step weight, and advance its update count once per alias
+        # — silently diverging from the eager loop. Refuse loudly.
+        by_id = {}
+        for n, p in plist:
+            if id(p) in by_id:
+                raise MXNetError(
+                    f"StepFunction: parameter '{p.name}' is shared "
+                    f"between blocks (as '{by_id[id(p)]}' and '{n}'); "
+                    "weight-tied models are not supported by the fused "
+                    "step — use the eager record/backward/step loop")
+            by_id[id(p)] = n
+        if self._trainer is not None:
+            index_of = self._trainer._param2idx
+            trainable = [(n, p) for n, p in plist
+                         if p.name in index_of and p.grad_req != "null"]
+            self._indices = [index_of[p.name] for _, p in trainable]
+        else:
+            trainable = [(n, p) for n, p in plist if p.grad_req != "null"]
+            self._indices = list(range(len(trainable)))
+            self._optimizer.param_dict = {
+                i: p for i, (_, p) in zip(self._indices, trainable)}
+        self._trainable = tuple(n for n, _ in trainable)
+        for n, p in trainable:
+            if p.grad_req == "add":
+                warnings.warn(
+                    f"StepFunction: parameter {p.name} has grad_req="
+                    "'add'; the fused step computes fresh per-step "
+                    "gradients (accumulation is not folded in)")
+        self._ensure_states({i: p for i, (_, p) in zip(self._indices,
+                                                       trainable)})
+        self._psig = tuple(p.grad_req for _, p in plist)
+
+    def _param_dtypes(self):
+        """Parameter dtype signature for the cache key: a mid-run
+        Parameter.cast retraces jax's jit internally, and without the
+        dtypes in OUR key the retrace would be miscounted as a cache
+        hit and stay invisible to the recompile auditor."""
+        if self._symbol_mode:
+            return tuple(str(v._data.dtype)
+                         for _, v in sorted(self._param_objs.items()))
+        return tuple(str(p.data()._data.dtype) for _, p in self._plist)
+
+    def _ensure_states(self, by_index):
+        upd = self._updater
+        for i, p in by_index.items():
+            if i not in upd.states:
+                w = p.data() if hasattr(p, "data") else p
+                upd.states[i] = \
+                    self._optimizer.create_state_multi_precision(i, w)
+                upd.states_synced[i] = True
+
+    # ------------------------------------------------------------------
+    # tracing
+    # ------------------------------------------------------------------
+    def _exchange(self, grads):
+        """Gradient exchange, lowered into the jit: identity for the
+        single-process path, psum over a named mesh axis otherwise."""
+        if self._psum_axis is None:
+            return grads
+        return jax.tree.map(
+            lambda g: jax.lax.psum(g, self._psum_axis), grads)
+
+    def _apply(self, trainable_vals, grads, svals, lrs, wds):
+        """The in-jit update segment: exchange + fused multi-tensor
+        optimizer. The barrier pins the gradient/update boundary so
+        XLA's producer-consumer fusion cannot clone gradient
+        expressions into the update kernels with different FMA
+        contraction — the bitwise-parity contract with the eager loop
+        (whose per-param kernels jit the same expression DAG)."""
+        grads = jax.lax.optimization_barrier(grads)
+        grads = self._exchange(grads)
+        return self._optimizer.fused_apply(
+            self._indices,
+            [trainable_vals[n] for n in self._trainable],
+            [grads[n] for n in self._trainable], svals, lrs, wds)
+
+    def _build_block(self):
+        block, loss_fn = self._net, self._loss_fn
+        trainable = self._trainable
+        from ..gluon.block import functional_call
+
+        def pure_step(pvals, svals, lrs, wds, inputs, rng):
+            def loss_of(tvals):
+                allp = dict(pvals)
+                allp.update(tvals)
+                (out,), aux = functional_call(
+                    block, allp, [_wrap(inputs[0])], training=True,
+                    rng_raw=rng)
+                if loss_fn is None:
+                    lout = out
+                else:
+                    louts, _ = functional_call(
+                        loss_fn, {},
+                        [_wrap(out)] + [_wrap(v) for v in inputs[1:]],
+                        training=True)
+                    lout = louts[0]
+                return lout, aux
+
+            tvals = {n: pvals[n] for n in trainable}
+            lout, vjp_fn, aux = jax.vjp(loss_of, tvals, has_aux=True)
+            grads = vjp_fn(jnp.ones_like(lout))[0]
+            new_w, new_s = self._apply(tvals, grads, svals, lrs, wds)
+            new_params = dict(pvals)
+            new_params.update(zip(trainable, new_w))
+            new_params.update(aux)  # BN running stats
+            return new_params, new_s, lout
+
+        return pure_step
+
+    def _build_symbol(self):
+        sym = self._net
+        trainable = self._trainable
+        input_names = self._input_names
+        from ..executor import graph_forward_backward
+        fb = graph_forward_backward(sym, list(trainable))
+
+        def pure_step(pvals, svals, lrs, wds, inputs, rng):
+            arg_vals = dict(pvals)
+            arg_vals.update(zip(input_names, inputs))
+            aux_vals = dict(arg_vals.pop("__aux__", {}))
+            outs, aux_updates, grads = fb(
+                arg_vals, aux_vals, rng,
+                tuple([None] * len(sym._outputs)))
+            tvals = {n: pvals[n] for n in trainable}
+            new_w, new_s = self._apply(tvals, grads, svals, lrs, wds)
+            new_params = dict(pvals)
+            new_params.update(zip(trainable, new_w))
+            new_params["__aux__"] = dict(aux_updates)
+            return new_params, new_s, outs[0]
+
+        return pure_step
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _hyper(self):
+        """Per-step scalar hyperparameters, host-computed (float64 —
+        the eager loop's arithmetic), shipped as weakly-typed f32
+        scalars so value changes (schedulers, Adam's t) never
+        retrace."""
+        lrs, wds = [], []
+        for i in self._indices:
+            lr, wd = self._optimizer.fused_hyper(i)
+            lrs.append(jnp.asarray(lr))
+            wds.append(jnp.asarray(wd))
+        return tuple(lrs), tuple(wds)
+
+    def _gather(self):
+        if self._symbol_mode:
+            pvals = {n: v._data for n, v in self._param_objs.items()}
+            pvals["__aux__"] = {n: v._data
+                                for n, v in self._aux_objs.items()}
+        else:
+            pvals = {n: p.data()._data for n, p in self._plist}
+        svals = [_state_values(self._updater.states[i])
+                 for i in self._indices]
+        return pvals, svals
+
+    def _writeback(self, new_params, new_states):
+        if self._symbol_mode:
+            aux = new_params.pop("__aux__", {})
+            for n, v in aux.items():
+                if n in self._aux_objs:
+                    self._aux_objs[n]._rebind(v)
+            for n, v in new_params.items():
+                self._param_objs[n]._rebind(v)
+        else:
+            for n, v in new_params.items():
+                p = self._param_objs.get(n)
+                if p is not None:
+                    p.data()._rebind(v)
+        for i, ns in zip(self._indices, new_states):
+            _state_rebind(self._updater.states[i], ns)
+
+    def step(self, x, *labels, batch_size=None):
+        """Run one fused training step; returns the loss NDArray."""
+        from ..telemetry import metrics as _metrics
+        from .. import telemetry as _telemetry
+        t0 = time.perf_counter()
+        inputs = tuple(_raw(a) for a in (x,) + labels)
+        if not self._symbol_mode:
+            if self._plist is None:
+                self._resolve_block_params(inputs[0])
+            elif self._psig != tuple(p.grad_req
+                                     for _, p in self._plist):
+                # grad_req flipped mid-run (freeze/unfreeze): the
+                # trainable set — and hence the program — changed;
+                # re-derive it (the eager loop picks this up
+                # implicitly, so the fused step must too)
+                self._resolve_block_params(inputs[0])
+                self._cache.clear()
+        if batch_size is None:
+            batch_size = int(inputs[0].shape[0]) if inputs[0].ndim else 1
+        self._optimizer.rescale_grad = self._scale / batch_size
+
+        # key on input signature + parameter dtypes + every scalar the
+        # trace bakes in (rescale_grad, clip, momentum, betas, ... —
+        # fused_signature), so mid-run hyperparameter mutation and
+        # Parameter.cast retrace VISIBLY (counted as misses, recorded
+        # by the recompile auditor) instead of silently
+        key = (tuple((tuple(v.shape), str(v.dtype)) for v in inputs),
+               self._param_dtypes(),
+               self._optimizer.fused_signature())
+        fn = self._cache.get(key)
+        if fn is None:
+            _metrics.counter(
+                "fused_step_cache_misses_total",
+                "fused-step signature-cache misses (compiles)").inc()
+            from ..telemetry import recompile as _recompile
+            _recompile.record_recompile(
+                f"StepFunction:{self._name}",
+                _recompile.signature_of(
+                    [_wrap(v) for v in inputs], True),
+                kind="fused_step")
+            tb0 = time.perf_counter()
+            pure = (self._build_symbol() if self._symbol_mode
+                    else self._build_block())
+            fn = jax.jit(pure,
+                         donate_argnums=(0, 1) if self._donate else ())
+            self._cache[key] = fn
+            self._last = (fn, key)
+            _metrics.histogram(
+                "fused_step_compile_seconds",
+                "fused-step trace+compile latency").observe(
+                time.perf_counter() - tb0)
+        else:
+            _metrics.counter(
+                "fused_step_cache_hits_total",
+                "fused-step signature-cache hits").inc()
+
+        lrs, wds = self._hyper()
+        pvals, svals = self._gather()
+        t1 = time.perf_counter()
+        rng = jax.random.key_data(_random.next_key())
+        new_params, new_states, loss = fn(pvals, svals, lrs, wds,
+                                          inputs, rng)
+        t2 = time.perf_counter()
+        self._writeback(new_params, new_states)
+        t3 = time.perf_counter()
+        _metrics.histogram(
+            "fused_step_host_seconds",
+            "fused-step host prep (hyper scalars + buffer gather)"
+            ).observe(t1 - t0)
+        _metrics.histogram(
+            "fused_step_dispatch_seconds",
+            "fused-step compiled-call dispatch (async; excludes device "
+            "wait)").observe(t2 - t1)
+        _metrics.histogram(
+            "fused_step_writeback_seconds",
+            "fused-step parameter/state rebind").observe(t3 - t2)
+        _telemetry.record_step(batch_size, time.perf_counter() - t0)
+        return _wrap(loss)
+
+    __call__ = step
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def cache_info(self) -> Dict[str, int]:
+        from ..telemetry import metrics as _metrics
+        return {
+            "programs": len(self._cache),
+            "hits": _metrics.counter(
+                "fused_step_cache_hits_total").value(),
+            "misses": _metrics.counter(
+                "fused_step_cache_misses_total").value(),
+        }
+
+    def cost_analysis(self, x, *labels):
+        """XLA cost analysis of the compiled step (bench roofline):
+        returns a dict with ``flops`` and ``bytes accessed``. Lowers
+        with the CURRENT buffers (a persistent-cache hit when the step
+        already ran); does not execute or donate."""
+        if self._last is None:
+            raise MXNetError("no compiled step yet — call step() first")
+        fn, _ = self._last
+        inputs = tuple(_raw(a) for a in (x,) + labels)
+        lrs = tuple(jnp.asarray(0.0) for _ in self._indices)
+        wds = tuple(jnp.asarray(0.0) for _ in self._indices)
+        pvals, svals = self._gather()
+        rng = jax.random.key_data(jax.random.key(0))
+        cost = fn.lower(pvals, svals, lrs, wds, inputs,
+                        rng).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return {"flops": float((cost or {}).get("flops", 0) or 0),
+                "bytes accessed": float(
+                    (cost or {}).get("bytes accessed", 0) or 0)}

@@ -21,6 +21,7 @@ def test_bench_emits_parseable_json_line():
         "MXTPU_BENCH_BATCH": "4",      # hermetic regardless of tunnel
         "MXTPU_BENCH_STEPS": "2",
         "MXTPU_BENCH_AMP": "0",
+        "MXTPU_BENCH_EAGER_STEPS": "1",  # keys present, minimal cost
         "MXTPU_BENCH_TIMEOUT": "900",
     })
     proc = subprocess.run(
@@ -30,8 +31,13 @@ def test_bench_emits_parseable_json_line():
              if ln.startswith("{")]
     assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
     data = json.loads(lines[-1])
-    for key in ("metric", "value", "unit", "vs_baseline"):
+    for key in ("metric", "value", "unit", "vs_baseline", "fused_step",
+                "fused_step_speedup", "recompiles_after_step2"):
         assert key in data, data
     assert data["metric"] == "resnet50_train_throughput"
     assert data["value"] is not None and data["value"] > 0, data
     assert data["platform"] == "cpu"
+    # the fused-step steady-state contract: the signature cache closes
+    # after warmup — zero recompiles across the timed steps
+    assert data["fused_step"] is True
+    assert data["recompiles_after_step2"] == 0, data

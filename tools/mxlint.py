@@ -119,6 +119,14 @@ def main(argv=None):
         findings.extend(disp_findings)
         sections.append(("dispatchlint", "nd dispatch coverage",
                          disp_findings))
+        # fused-step coverage audit: optimizers overriding update()
+        # without a functional fused_apply downgrade the fused train
+        # step to the eager per-param loop
+        from mxnet_tpu.passes.steplint import OptimizerFusionAudit
+        step_findings = OptimizerFusionAudit().run()
+        findings.extend(step_findings)
+        sections.append(("steplint", "optimizer fused_apply coverage",
+                         step_findings))
     for path in args.graphs:
         try:
             with open(path) as f:

@@ -14,6 +14,8 @@ encodes:
                                                            # readable
   python tools/mxprof.py summarize metrics.jsonl           # metrics
                                                            # sink lines
+  python tools/mxprof.py step metrics.jsonl                # fused-step
+                                                           # report
 
 --json emits the shared findings schema (mxnet_tpu.passes
 findings_report — same shape as mxlint/check_tpu_consistency/
@@ -177,6 +179,113 @@ def summarize_metrics_lines(lines):
 
 
 # ---------------------------------------------------------------------------
+# fused-step report (mxnet_tpu/step/ — ISSUE 5)
+# ---------------------------------------------------------------------------
+
+# a fused step that misses its signature cache this often is retracing
+FUSED_RETRACE_THRESHOLD = 4
+
+
+def _hist_row(name, h):
+    if not isinstance(h, dict) or not h.get("count"):
+        return f"  {name:<34} (no samples)"
+    return (f"  {name:<34} n={h['count']:<6} avg={h['avg'] * 1e3:9.3f} ms"
+            f"  p50={(h.get('p50') or 0) * 1e3:9.3f} ms"
+            f"  max={h['max'] * 1e3:9.3f} ms")
+
+
+def step_report(metrics):
+    """Render the fused-step section of one metrics snapshot: cache
+    hits/misses, time-per-phase breakdown, gradient-bucket shape, and
+    the persistent-compile-cache counters."""
+    g = metrics.get
+    hits = g("fused_step_cache_hits_total", 0)
+    misses = g("fused_step_cache_misses_total", 0)
+    lines = ["-- fused step (mxstep)"]
+    if not (hits or misses):
+        lines.append("  no fused-step activity in this snapshot "
+                     "(StepFunction never ran)")
+    else:
+        total = hits + misses
+        lines.append(f"  signature cache: {hits} hit(s), {misses} "
+                     f"miss(es) ({100.0 * hits / total:.1f}% hit rate)")
+        lines.append("  time per phase:")
+        for name in ("fused_step_compile_seconds",
+                     "fused_step_host_seconds",
+                     "fused_step_dispatch_seconds",
+                     "fused_step_writeback_seconds",
+                     "trainer_step_seconds"):
+            lines.append(_hist_row(name, g(name)))
+    buckets = g("grad_bucket_count")
+    if buckets:
+        bb = g("grad_bucket_bytes", {})
+        lines.append(f"  gradient exchange: {int(buckets)} bucket(s)"
+                     + (f", bytes avg={bb.get('avg', 0):.0f} "
+                        f"max={bb.get('max', 0):.0f}"
+                        if isinstance(bb, dict) and bb.get("count")
+                        else ""))
+    cc_h = g("jax_compile_cache_hits_total", 0)
+    cc_m = g("jax_compile_cache_misses_total", 0)
+    if cc_h or cc_m:
+        lines.append(f"  persistent compile cache: {cc_h} hit(s), "
+                     f"{cc_m} miss(es)")
+    return "\n".join(lines)
+
+
+def analyze_step(metrics):
+    """Fused-step pathology scan → Finding list (shared schema)."""
+    from mxnet_tpu.passes import Finding
+    findings = []
+    hits = metrics.get("fused_step_cache_hits_total", 0)
+    misses = metrics.get("fused_step_cache_misses_total", 0)
+    if misses >= FUSED_RETRACE_THRESHOLD and misses > hits:
+        findings.append(Finding(
+            "mxprof", "fused-step-retrace", "StepFunction", "error",
+            f"{misses} fused-step cache misses vs {hits} hits — the "
+            "step signature changes almost every call (loose batch "
+            "shape or flapping dtype); pad or bucket the inputs or "
+            "every step pays a full XLA compile"))
+    disp = metrics.get("fused_step_dispatch_seconds")
+    host = metrics.get("fused_step_host_seconds")
+    if isinstance(disp, dict) and isinstance(host, dict) \
+            and disp.get("count") and host.get("count") \
+            and host.get("avg", 0) > 4 * disp.get("avg", 1e-12):
+        findings.append(Finding(
+            "mxprof", "host-bound-step", "StepFunction", "warn",
+            f"host prep averages {host['avg'] * 1e3:.2f} ms vs "
+            f"{disp['avg'] * 1e3:.2f} ms dispatch — per-step python "
+            "overhead (hyper scalars/gather) dominates; suspect tiny "
+            "model or excessive parameter count"))
+    return findings
+
+
+def step_cmd(path, as_json):
+    with open(path) as f:
+        report = summarize_metrics_lines(f)
+    last = report.get("last") or {}
+    metrics = last.get("metrics", {})
+    findings = analyze_step(metrics)
+    if as_json:
+        from mxnet_tpu.passes import findings_report
+        keys = [k for k in metrics
+                if k.startswith(("fused_step_", "grad_bucket_",
+                                 "jax_compile_cache_", "trainer_step"))]
+        print(findings_report(
+            "mxprof", findings,
+            extra={"file": path, "n_snapshots": report["n_snapshots"],
+                   "step_metrics": {k: metrics[k] for k in keys}},
+            as_json=True))
+    else:
+        print(f"== mxprof step: {path} "
+              f"({report['n_snapshots']} snapshot(s))")
+        print(step_report(metrics))
+        for fi in findings:
+            print(f"  {fi!r}")
+    from mxnet_tpu.passes import severity_counts
+    return 2 if severity_counts(findings)["error"] else 0
+
+
+# ---------------------------------------------------------------------------
 # findings (shared schema with mxlint)
 # ---------------------------------------------------------------------------
 
@@ -309,14 +418,25 @@ def main(argv=None):
     ps.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the shared machine-readable findings "
                          "report")
+    pstep = sub.add_parser(
+        "step",
+        help="fused-step report from a metrics JSON-lines dump: cache "
+             "hits/misses, time-per-phase breakdown, bucket sizes")
+    pstep.add_argument("dump", help="metrics JSON-lines file "
+                                    "(MXNET_METRICS_EXPORT)")
+    pstep.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the shared machine-readable findings "
+                            "report")
     args = p.parse_args(argv)
-    if args.cmd != "summarize":
-        p.error("nothing to do: use the summarize subcommand")
-    top = args.top
-    if top is None:
-        from mxnet_tpu.base import get_env
-        top = int(get_env("MXNET_PROFILER_TOPK", 0))
+    if args.cmd not in ("summarize", "step"):
+        p.error("nothing to do: use the summarize or step subcommand")
     try:
+        if args.cmd == "step":
+            return step_cmd(args.dump, args.as_json)
+        top = args.top
+        if top is None:
+            from mxnet_tpu.base import get_env
+            top = int(get_env("MXNET_PROFILER_TOPK", 0))
         return summarize(args.dump, top, args.as_json)
     except OSError as e:
         print(f"mxprof: cannot read {args.dump}: {e}", file=sys.stderr)
