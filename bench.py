@@ -561,6 +561,97 @@ def serving_main():
     _emit(value, unit="requests/sec", **record)
 
 
+def shard_main():
+    """Sharded-training weak-scaling benchmark (--shard /
+    MXTPU_BENCH_SHARD=1): drive the GSPMD-sharded fused step
+    (mxnet_tpu/shard/) over 1/2/4/8 forced host devices with a FIXED
+    per-replica batch and emit ONE BENCH-schema JSON line (metric
+    mxshard_scaling): per-device-count step time plus per-replica
+    optimizer-state bytes — the two curves the TPU retro-validation
+    needs (flat step time = weak scaling holds; 1/N opt-state bytes =
+    ZeRO holds; ROADMAP measurement note). value = the opt-state
+    per-replica ratio at max devices vs 1 device (ideal 1/N). CPU
+    virtual devices share the same cores, so step TIME here only
+    sanity-checks the compile path; the bytes curve is exact on any
+    backend. Knobs: MXTPU_BENCH_SHARD_BATCH (per replica, default 8),
+    MXTPU_BENCH_SHARD_STEPS (timed, default 4)."""
+    # virtual host devices must be forced BEFORE the first jax import
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, telemetry
+    from mxnet_tpu.shard import ShardPlan
+
+    per_replica = int(os.environ.get("MXTPU_BENCH_SHARD_BATCH", "8"))
+    n_steps = int(os.environ.get("MXTPU_BENCH_SHARD_STEPS", "4"))
+    feature, hidden, out = 64, 256, 32  # all 8-divisible (clean ZeRO)
+
+    devices = jax.devices()
+    counts = [n for n in (1, 2, 4, 8) if n <= len(devices)]
+    rng = onp.random.RandomState(0)
+    series = []
+    for n in counts:
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(hidden, activation="relu",
+                                   flatten=False, in_units=feature))
+            net.add(gluon.nn.Dense(out, flatten=False,
+                                   in_units=hidden))
+        net.initialize(mx.initializer.Xavier())
+        loss_fn = gluon.loss.L2Loss()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 0.01})
+        plan = ShardPlan(devices=devices[:n])
+        fused = trainer.fuse_step(net, loss_fn, shard_plan=plan)
+        gb = n * per_replica  # weak scaling: global batch grows with n
+        x = nd.array(rng.uniform(-1, 1, (gb, feature))
+                     .astype("float32"))
+        y = nd.array(rng.uniform(-1, 1, (gb, out)).astype("float32"))
+        for _ in range(2):  # warmup (compile)
+            fused.step(x, y).asnumpy()
+        rc0 = telemetry.recompile_count()
+        times = []
+        for _ in range(n_steps):
+            t0 = time.perf_counter()
+            fused.step(x, y).asnumpy()  # host fetch = completion fence
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        rep = fused.memory_report()
+        series.append(dict(
+            devices=n, global_batch=gb,
+            step_s=round(times[len(times) // 2], 6),
+            recompiles_after_warmup=telemetry.recompile_count() - rc0,
+            opt_state_per_replica_bytes=rep["opt_state"][
+                "per_replica_bytes"],
+            opt_state_total_bytes=rep["opt_state"]["total_bytes"],
+            params_per_replica_bytes=rep["params"][
+                "per_replica_bytes"]))
+
+    first, last = series[0], series[-1]
+    ratio = (round(last["opt_state_per_replica_bytes"]
+                   / first["opt_state_per_replica_bytes"], 4)
+             if first["opt_state_per_replica_bytes"] else None)
+    record = dict(
+        metric="mxshard_scaling",
+        per_replica_batch=per_replica, steps=n_steps,
+        series=series,
+        weak_scaling_step_ratio=(
+            round(last["step_s"] / first["step_s"], 3)
+            if first["step_s"] else None),
+        ideal_opt_bytes_ratio=round(1.0 / last["devices"], 4),
+        platform="cpu",
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(ratio, unit="opt-state bytes per replica, max-mesh/1-dev",
+          **record)
+
+
 def chaos_main():
     """Chaos-recovery benchmark (--chaos / MXTPU_BENCH_CHAOS=1): measure
     training throughput through three phases — fault-free baseline,
@@ -684,6 +775,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_SERVING") == "1"
               else "mxresil_chaos_recovery"
               if os.environ.get("MXTPU_BENCH_CHAOS") == "1"
+              else "mxshard_scaling"
+              if os.environ.get("MXTPU_BENCH_SHARD") == "1"
               else "resnet50_train_throughput")
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
@@ -726,6 +819,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_SERVING"] = "1"
     if "--chaos" in sys.argv:
         os.environ["MXTPU_BENCH_CHAOS"] = "1"
+    if "--shard" in sys.argv:
+        os.environ["MXTPU_BENCH_SHARD"] = "1"
     # fused whole-train-step compiler: default ON; --no-fused-step
     # measures the eager reference path instead (env form propagates
     # into the --child subprocess)
@@ -735,18 +830,22 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_FUSED"] = "0"
     _serving = os.environ.get("MXTPU_BENCH_SERVING") == "1"
     _chaos = os.environ.get("MXTPU_BENCH_CHAOS") == "1"
+    _shard = os.environ.get("MXTPU_BENCH_SHARD") == "1"
     if "--child" in sys.argv:
         try:
             if _serving:
                 serving_main()
             elif _chaos:
                 chaos_main()
+            elif _shard:
+                shard_main()
             else:
                 main()
         except Exception as e:
             _emit(None, vs=None,
                   metric=("mxserve_throughput" if _serving
                           else "mxresil_chaos_recovery" if _chaos
+                          else "mxshard_scaling" if _shard
                           else "resnet50_train_throughput"),
                   error=f"{type(e).__name__}: {e}"[:500])
             sys.exit(0)

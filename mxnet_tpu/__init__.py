@@ -119,6 +119,7 @@ from . import step  # noqa: F401  (fused whole-train-step compiler)
 # jax at the on-disk cache before any jit runs so the fused train
 # step's warmup survives process restarts (docs/performance.md)
 step.maybe_enable_compile_cache()
+from . import shard  # noqa: F401  (GSPMD sharded training over a named mesh)
 from . import serve  # noqa: F401  (dynamic-batching inference serving)
 from . import resil  # noqa: F401  (fault injection, retry policies, preemption guard, watchdogs)
 from . import rtc  # noqa: F401

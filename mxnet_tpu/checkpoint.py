@@ -83,8 +83,23 @@ class CheckpointManager:
     def save(self, step: int, trainer=None, params: Optional[Dict] = None,
              opt_state: Optional[bytes] = None, extra: Optional[Dict] = None):
         """Snapshot NOW (host copies are taken synchronously so training
-        can mutate on), serialize in the background."""
+        can mutate on), serialize in the background.
+
+        A trainer carrying a shard plan (``Trainer.fuse_step(...,
+        shard_plan=...)``) gets the plan's mesh/spec description
+        recorded in the manifest — arrays are always saved DENSE
+        (``asnumpy`` gathers sharded buffers), so the checkpoint
+        restores onto any device count and the recorded plan lets
+        restore tell (and log) that it is resharding."""
         self.check_error()
+        shard_desc = None
+        if trainer is not None:
+            plan = getattr(trainer, "_shard_plan", None)
+            if plan is not None:
+                try:
+                    shard_desc = plan.describe()
+                except Exception:
+                    shard_desc = None
         if trainer is not None:
             # gluon.Trainer or parallel.ParallelTrainer
             if hasattr(trainer, "params") and isinstance(
@@ -111,24 +126,26 @@ class CheckpointManager:
         if self.async_save:
             self._thread = threading.Thread(
                 target=self._write, args=(step, host_params, opt_state,
-                                          extra), daemon=True)
+                                          extra, shard_desc), daemon=True)
             self._thread.start()
         else:
-            self._write(step, host_params, opt_state, extra)
+            self._write(step, host_params, opt_state, extra, shard_desc)
 
-    def _write(self, step, host_params, opt_state, extra):
+    def _write(self, step, host_params, opt_state, extra,
+               shard_desc=None):
         try:
             # resil hook: retried on injected/transient faults — a
             # failed attempt cleans up its own temp dir and never
             # leaves a half-valid checkpoint, so blanket retry is sound
             from .resil.hooks import guarded as _guarded
             _guarded("checkpoint.write", self._write_attempt,
-                     step, host_params, opt_state, extra)
+                     step, host_params, opt_state, extra, shard_desc)
             self._retain()
         except BaseException as e:  # surfaced on next save()/wait()
             self._error = e
 
-    def _write_attempt(self, step, host_params, opt_state, extra):
+    def _write_attempt(self, step, host_params, opt_state, extra,
+                       shard_desc=None):
         """One crash-safe commit: payload into a temp dir, fsync every
         file, digest-carrying manifest last (also fsynced), atomic
         rename, directory fsync. A crash at ANY point leaves either the
@@ -167,13 +184,16 @@ class CheckpointManager:
             files = {name: os.path.getsize(os.path.join(tmp, name))
                      for name in ("params", "opt_state", "extra")
                      if os.path.exists(os.path.join(tmp, name))}
+            manifest = {"step": step,
+                        "params": sorted(host_params),
+                        "arrays": arrays,
+                        "files": files,
+                        "has_opt_state": opt_state is not None,
+                        "has_extra": extra is not None}
+            if shard_desc is not None:
+                manifest["shard"] = shard_desc
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
-                json.dump({"step": step,
-                           "params": sorted(host_params),
-                           "arrays": arrays,
-                           "files": files,
-                           "has_opt_state": opt_state is not None,
-                           "has_extra": extra is not None}, f)
+                json.dump(manifest, f)
                 f.flush()
                 os.fsync(f.fileno())
             _fsync_path(tmp)
@@ -235,10 +255,11 @@ class CheckpointManager:
         faults are retried; only genuine corruption (MXNetError, not
         retryable) falls through to the restore_latest fallback."""
         from .resil.hooks import guarded as _guarded
-        params, opt_state, extra = _guarded(
+        params, opt_state, extra, manifest = _guarded(
             "checkpoint.restore", self._restore_attempt, step)
         if trainer is not None:
-            self._install(trainer, params, opt_state)
+            self._install(trainer, params, opt_state,
+                          shard=manifest.get("shard"))
         return params, opt_state, extra
 
     def _restore_attempt(self, step: int):
@@ -284,7 +305,7 @@ class CheckpointManager:
         if os.path.exists(os.path.join(path, "extra")):
             with open(os.path.join(path, "extra"), "rb") as f:
                 extra = pickle.load(f)
-        return params, opt_state, extra
+        return params, opt_state, extra, manifest
 
     def restore_latest(self, trainer=None):
         """Restart-from-latest, skipping torn checkpoints. Returns the
@@ -299,7 +320,28 @@ class CheckpointManager:
         return None
 
     @staticmethod
-    def _install(trainer, params, opt_state):
+    def _install(trainer, params, opt_state, shard=None):
+        """Install restored state into the trainer. When the manifest
+        recorded a shard plan and the trainer carries one now, compare
+        device counts and account the reshard: arrays land as host
+        buffers and the sharded step's ``in_shardings`` re-place them
+        onto the CURRENT mesh on the next call — same compiled
+        program, no recompile — so an 8-device checkpoint resumes on
+        4 (or 16) with nothing but this log line to show for it."""
+        plan = getattr(trainer, "_shard_plan", None)
+        if shard is not None and plan is not None:
+            saved_n = int(shard.get("n_devices", 0) or 0)
+            if saved_n and saved_n != plan.n_devices:
+                from .telemetry import metrics as _metrics
+                _metrics.counter(
+                    "shard_reshard_restores_total",
+                    "checkpoint restores onto a different mesh size"
+                    ).inc()
+                _log.info(
+                    "resharding checkpoint: saved on %d device(s) "
+                    "(axes %s), restoring onto %d (axes %s)",
+                    saved_n, dict(shard.get("axes") or []),
+                    plan.n_devices, plan.axes)
         if hasattr(trainer, "params") and isinstance(
                 getattr(trainer, "params"), dict):
             # ParallelTrainer: rebind the device pytrees

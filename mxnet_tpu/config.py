@@ -372,6 +372,28 @@ register_flag(
     "Seconds an open circuit breaker waits before admitting one "
     "half-open probe (resil.policy.CircuitBreaker).")
 register_flag(
+    "MXSHARD_AUTO", bool, False,
+    "Shard every gluon Trainer.fuse_step over the local devices when "
+    "more than one is present (shard.ShardPlan.from_env over "
+    "MXSHARD_AXES/MXSHARD_ZERO): the fused train step compiles with "
+    "NamedSharding annotations over a named mesh instead of running "
+    "single-device. Explicit shard_plan= arguments always win. See "
+    "docs/sharding.md.")
+register_flag(
+    "MXSHARD_AXES", str, "batch:-1",
+    "Mesh axes for MXSHARD_AUTO / ShardPlan.from_env, as "
+    "'name:size[,name:size...]' with at most one -1 (inferred from "
+    "the device count) — e.g. 'batch:-1' (pure data parallel) or "
+    "'batch:4,model:2' (DP x TP composition). The 'batch' axis (or "
+    "the first axis named) is the data-parallel axis.")
+register_flag(
+    "MXSHARD_ZERO", bool, True,
+    "ZeRO-style sharding of optimizer state (and thereby the fused "
+    "weight-update computation) along the batch axis "
+    "(shard.ShardPlan.state_spec): per-replica optimizer memory "
+    "scales ~1/N with data-parallel replicas. Off = optimizer state "
+    "mirrors its weight's (usually replicated) sharding.")
+register_flag(
     "MXRESIL_WATCHDOG_STALL_S", float, 0.0,
     "Heartbeat age that counts as a stall (resil.watchdog.Watchdog). "
     "0 = auto: 10x the step-time EWMA (min 1 s; 30 s before any step "

@@ -53,6 +53,7 @@ class Trainer:
         self._contains_sparse_weight = False
         self._contains_sparse_grad = False
         self._grad_buckets = None  # lazy; see _allreduce_grads
+        self._shard_plan = None  # set by fuse_step(shard_plan=...)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -220,15 +221,36 @@ class Trainer:
         for i, param in live:
             updater(i, param.grad(), param.data())
 
-    def fuse_step(self, net, loss_fn=None, **kwargs):
+    def fuse_step(self, net, loss_fn=None, shard_plan=None, **kwargs):
         """Compile this trainer's whole step into one donated XLA
         computation (mxnet_tpu.step.StepFunction): ``fused.step(x, y)``
         replaces the record/backward/step(batch) triple with a single
         dispatch, bitwise-equal to the eager loop for optimizers with a
         functional fused_apply. The trainer keeps owning optimizer
         state (save_states/load_states and mxresil checkpoints see the
-        post-update values)."""
+        post-update values).
+
+        With ``shard_plan=`` (a :class:`mxnet_tpu.shard.ShardPlan`) —
+        or ``MXSHARD_AUTO=1`` and more than one local device — the
+        step compiles GSPMD-sharded over the plan's named mesh: batch
+        sharded on the ``batch`` axis, optimizer state ZeRO-sharded,
+        parameters tensor-sharded per the plan's ``param_specs``; the
+        same user code, ``P("batch", "model")`` composition included.
+        Checkpoints taken through this trainer record the plan in
+        their manifest and reshard on restore (docs/sharding.md)."""
+        if shard_plan is None:
+            from .. import config
+            import jax as _jax
+            if config.get("MXSHARD_AUTO") and len(_jax.devices()) > 1:
+                from ..shard import ShardPlan
+                shard_plan = ShardPlan.from_env()
+        if shard_plan is not None:
+            from ..shard import ShardedStepFunction
+            self._shard_plan = shard_plan
+            return ShardedStepFunction(net, loss_fn, trainer=self,
+                                       shard_plan=shard_plan, **kwargs)
         from ..step import StepFunction
+        self._shard_plan = None  # an unsharded rebuild clears the plan
         return StepFunction(net, loss_fn, trainer=self, **kwargs)
 
     def save_states(self, fname):

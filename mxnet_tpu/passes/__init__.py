@@ -170,11 +170,13 @@ def findings_report(tool: str, findings: Iterable[Finding],
 # the default manager with the built-in analyses registered; import-time
 # cheap (passes hold no state until run)
 def default_manager() -> PassManager:
-    from . import oplint, graphlint, tracercheck, dispatchlint, steplint
+    from . import (oplint, graphlint, tracercheck, dispatchlint,
+                   steplint, shardlint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
     pm.register(tracercheck.TracerLeakCheck())
     pm.register(dispatchlint.DispatchAudit())
     pm.register(steplint.OptimizerFusionAudit())
+    pm.register(shardlint.ShardLint())
     return pm

@@ -1,0 +1,38 @@
+"""mxshard: GSPMD sharded training over a named device mesh.
+
+The scale-out path (ROADMAP item 1): the fused whole-train-step
+compiler (mxnet_tpu/step/) rebuilt on ``jax.jit`` + ``NamedSharding``
+so parameters, gradients, optimizer state and the weight-update
+computation itself carry sharding specs over a named mesh
+(``parallel/mesh.py``, promoted from island to core):
+
+- :class:`~mxnet_tpu.shard.plan.ShardPlan` — the mesh/spec model:
+  data-parallel batch sharding, fnmatch-pattern tensor parallelism
+  (``P("batch", "model")`` composition), ZeRO-style optimizer-state
+  sharding along the batch axis (per-replica optimizer memory ~1/N,
+  per "Automatic Cross-Replica Sharding of Weight Update in
+  Data-Parallel Training"), and a manifest round-trip so checkpoints
+  reshard on restore onto a different device count;
+- :class:`~mxnet_tpu.shard.stepfn.ShardedStepFunction` — the fused
+  step compiled with in/out sharding annotations; one donated program
+  per signature, zero steady-state recompiles, structural verification
+  via the ``shardlint`` pass (passes/shardlint.py over
+  ``parallel/hlo_check``).
+
+Gluon entry point: ``trainer.fuse_step(net, loss_fn,
+shard_plan=ShardPlan())`` — or ``MXSHARD_AUTO=1`` to shard every fused
+step over all local devices. Testable on any host via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+See docs/sharding.md.
+"""
+from __future__ import annotations
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: F401
+from jax.sharding import PartitionSpec as P  # noqa: F401
+
+from ..parallel.mesh import data_parallel_mesh, make_mesh  # noqa: F401
+from .plan import ShardPlan  # noqa: F401
+from .stepfn import ShardedStepFunction  # noqa: F401
+
+__all__ = ["ShardPlan", "ShardedStepFunction", "Mesh", "NamedSharding",
+           "PartitionSpec", "P", "make_mesh", "data_parallel_mesh"]

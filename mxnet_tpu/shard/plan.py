@@ -1,0 +1,261 @@
+"""ShardPlan: the mesh/spec model behind GSPMD sharded training.
+
+One object answers every placement question the sharded train step
+asks: which named mesh the job runs over, how data batches split
+across it, which parameters are tensor-sharded, and how optimizer
+state is ZeRO-sharded along the batch axis per "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training" (PAPERS.md) — the
+weight-update computation follows the state shardings through XLA's
+SPMD partitioner, so annotating the *buffers* is the whole mechanism.
+
+The plan composes data and tensor parallel from one axes dict::
+
+    plan = ShardPlan(axes={"batch": -1})                  # pure DP+ZeRO
+    plan = ShardPlan(axes={"batch": -1, "model": 2},      # DP x TP
+                     param_specs={"*.dense*.weight": P(None, "model")})
+
+Parameter spec patterns are fnmatch globs over the prefixed parameter
+names (``net._collect_params_with_prefix()`` keys, e.g. ``0.weight``);
+anything unmatched is replicated. ZeRO (default on) then shards dim 0
+of every optimizer-state leaf whose dim 0 is unsharded and divisible
+by the batch-axis size — per-replica optimizer memory scales 1/N with
+data-parallel replicas while weights stay replicated (and therefore
+donation-stable) between steps.
+
+``describe()``/``from_manifest()`` round-trip the plan through the
+checkpoint manifest so a job can resume on a different device count:
+the batch axis is re-inferred from the devices present at restore
+(the 16-chip-job-resumes-on-8 contract, docs/sharding.md).
+
+Testable anywhere via ``XLA_FLAGS=--xla_force_host_platform_
+device_count=8`` (the tier-1 conftest already forces 8).
+"""
+from __future__ import annotations
+
+import fnmatch
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..parallel.mesh import make_mesh
+
+__all__ = ["ShardPlan"]
+
+
+def _spec_tuple(spec: Optional[P]) -> Tuple:
+    """PartitionSpec -> plain tuple (JSON-able, comparable)."""
+    if spec is None:
+        return ()
+    return tuple(None if e is None else
+                 (tuple(e) if isinstance(e, (tuple, list)) else str(e))
+                 for e in spec)
+
+
+class ShardPlan:
+    """Named-mesh sharding policy for parameters, optimizer state and
+    data batches.
+
+    Parameters
+    ----------
+    axes : dict, optional
+        Ordered ``{axis_name: size}`` mesh spec; at most one size may
+        be ``-1`` (inferred from the device count). Default:
+        ``{"batch": -1}`` — pure data parallel over every local device.
+    batch_axis : str
+        The data-parallel axis name (inputs shard their dim 0 over it;
+        ZeRO shards optimizer state along it). Must be in ``axes``.
+    zero : bool
+        ZeRO-style optimizer-state sharding (default True).
+    param_specs : dict, optional
+        ``{fnmatch_pattern: PartitionSpec}`` tensor-parallel placements
+        for parameters, matched against prefixed parameter names in
+        insertion order (first match wins).
+    devices : sequence, optional
+        Devices to build the mesh over (default: all local devices).
+    """
+
+    def __init__(self, axes: Optional[Dict[str, int]] = None,
+                 batch_axis: str = "batch", zero: bool = True,
+                 param_specs: Optional[Dict[str, P]] = None,
+                 devices=None):
+        axes = dict(axes) if axes else {batch_axis: -1}
+        if batch_axis not in axes:
+            raise MXNetError(
+                f"batch_axis {batch_axis!r} not in mesh axes "
+                f"{sorted(axes)}")
+        self.mesh = make_mesh(axes, devices)
+        self.axes = {n: int(s) for n, s in
+                     zip(self.mesh.axis_names, self.mesh.devices.shape)}
+        self.batch_axis = batch_axis
+        self.zero = bool(zero)
+        self.param_specs = dict(param_specs or {})
+        self._match_cache: Dict[str, P] = {}
+
+    # -- construction helpers ---------------------------------------------
+    @classmethod
+    def from_env(cls, devices=None) -> "ShardPlan":
+        """Build from MXSHARD_AXES / MXSHARD_ZERO (the MXSHARD_AUTO
+        path, gluon.Trainer.fuse_step). Axes grammar:
+        ``"batch:-1"`` or ``"batch:4,model:2"``."""
+        from .. import config
+        spec = config.get("MXSHARD_AXES") or "batch:-1"
+        axes: Dict[str, int] = {}
+        for part in spec.split(","):
+            name, _, size = part.strip().partition(":")
+            if not name:
+                continue
+            try:
+                axes[name] = int(size) if size else -1
+            except ValueError:
+                raise MXNetError(
+                    f"MXSHARD_AXES: bad axis size in {part!r} "
+                    f"(grammar: 'batch:-1' or 'batch:4,model:2')")
+        batch_axis = "batch" if "batch" in axes else next(iter(axes))
+        return cls(axes=axes, batch_axis=batch_axis,
+                   zero=config.get("MXSHARD_ZERO"), devices=devices)
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def n_batch(self) -> int:
+        return self.axes[self.batch_axis]
+
+    # -- specs ------------------------------------------------------------
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def data_spec(self, value=None) -> NamedSharding:
+        """Inputs shard their leading (batch) dim; scalars replicate.
+        The global batch must divide by the batch-axis size."""
+        if value is not None and getattr(value, "ndim", 0) == 0:
+            return self.replicated()
+        return NamedSharding(self.mesh, P(self.batch_axis))
+
+    def _param_pspec(self, name: str) -> P:
+        if name in self._match_cache:
+            return self._match_cache[name]
+        out = P()
+        for pattern, spec in self.param_specs.items():
+            if fnmatch.fnmatchcase(name, pattern):
+                out = spec if spec is not None else P()
+                break
+        self._match_cache[name] = out
+        return out
+
+    def param_spec(self, name: str, value) -> NamedSharding:
+        """Tensor-parallel placement of one parameter (replicated
+        unless a param_specs pattern matches). Validates divisibility
+        so a bad pattern fails here, not as an XLA error."""
+        pspec = self._param_pspec(name)
+        shape = tuple(getattr(value, "shape", ()))
+        for dim, entry in enumerate(tuple(pspec)[:len(shape)]):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            span = int(onp.prod([self.axes[a] for a in names]))
+            if shape[dim] % span:
+                raise MXNetError(
+                    f"param_specs: {name!r} dim {dim} of size "
+                    f"{shape[dim]} does not divide by mesh axes "
+                    f"{names} (= {span})")
+        return NamedSharding(self.mesh, pspec)
+
+    def state_spec(self, name: str, value) -> NamedSharding:
+        """ZeRO placement of one optimizer-state leaf (same-shaped as
+        its weight): inherit the weight's tensor sharding, then shard
+        dim 0 along the batch axis when it is unsharded and divisible —
+        the cross-replica weight-update sharding of the paper. With
+        ``zero=False`` the state simply mirrors the weight."""
+        base = tuple(self._param_pspec(name))
+        shape = tuple(getattr(value, "shape", ()))
+        entries: List = list(base[:len(shape)])
+        entries += [None] * (len(shape) - len(entries))
+        if (self.zero and shape and entries and entries[0] is None
+                and self.n_batch > 1 and shape[0] % self.n_batch == 0):
+            entries[0] = self.batch_axis
+        while entries and entries[-1] is None:
+            entries.pop()
+        return NamedSharding(self.mesh, P(*entries))
+
+    def fingerprint(self) -> Tuple:
+        """Cache-key component: everything that changes the compiled
+        program's partitioning."""
+        return (tuple(self.axes.items()), self.batch_axis, self.zero,
+                tuple(sorted((p, _spec_tuple(s))
+                             for p, s in self.param_specs.items())),
+                tuple(int(d.id) for d in self.mesh.devices.flat))
+
+    # -- manifest round-trip (resharding checkpoints) ---------------------
+    def describe(self) -> Dict[str, object]:
+        """JSON-able record for the checkpoint manifest."""
+        return {"axes": [[n, s] for n, s in self.axes.items()],
+                "batch_axis": self.batch_axis,
+                "zero": self.zero,
+                "param_specs": {p: list(_spec_tuple(s))
+                                for p, s in self.param_specs.items()},
+                "n_devices": self.n_devices}
+
+    @classmethod
+    def from_manifest(cls, desc: Dict[str, object],
+                      devices=None) -> "ShardPlan":
+        """Rebuild a plan from a manifest on the CURRENT device count:
+        non-batch axes keep their recorded sizes; the batch axis is
+        re-inferred (-1), so a checkpoint from a 16-device mesh restores
+        onto 8 (or 4) without user arithmetic."""
+        axes = {n: int(s) for n, s in desc["axes"]}
+        batch_axis = desc["batch_axis"]
+        axes[batch_axis] = -1
+        param_specs = {p: P(*[None if e is None else
+                              (tuple(e) if isinstance(e, list) else e)
+                              for e in spec])
+                       for p, spec in (desc.get("param_specs")
+                                       or {}).items()}
+        return cls(axes=axes, batch_axis=batch_axis,
+                   zero=bool(desc.get("zero", True)),
+                   param_specs=param_specs, devices=devices)
+
+    # -- accounting -------------------------------------------------------
+    @staticmethod
+    def per_device_bytes(arrays) -> Dict[int, int]:
+        """{device_id: bytes} actually held for the given jax arrays
+        (addressable shards — the truth, not the spec's promise)."""
+        out: Dict[int, int] = {}
+        for a in arrays:
+            if a is None or not hasattr(a, "addressable_shards"):
+                continue
+            for sh in a.addressable_shards:
+                out[sh.device.id] = out.get(sh.device.id, 0) \
+                    + int(sh.data.nbytes)
+        return out
+
+    def memory_report(self, param_arrays, state_arrays) \
+            -> Dict[str, object]:
+        """Per-replica memory accounting for params vs optimizer state
+        — the quantity the ZeRO sharding exists to shrink. Feeds the
+        ``shard_*`` telemetry gauges and ``tools/mxprof.py shard``."""
+        import jax as _jax
+        report = {"devices": self.n_devices}
+        for kind, arrays in (("params", param_arrays),
+                             ("opt_state", state_arrays)):
+            leaves = [v for v in _jax.tree.leaves(list(arrays))
+                      if hasattr(v, "nbytes")]
+            total = sum(int(v.nbytes) for v in leaves)
+            per_dev = self.per_device_bytes(leaves)
+            per_replica = max(per_dev.values()) if per_dev else 0
+            report[kind] = {
+                "total_bytes": total,
+                "per_replica_bytes": per_replica,
+                "replicated_fraction": (round(
+                    per_replica * self.n_devices / total, 4)
+                    if total else None)}
+        return report
+
+    def __repr__(self):
+        axes = ",".join(f"{n}:{s}" for n, s in self.axes.items())
+        return (f"<ShardPlan mesh[{axes}] zero={self.zero} "
+                f"tp_patterns={len(self.param_specs)}>")

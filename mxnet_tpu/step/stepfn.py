@@ -342,6 +342,19 @@ class StepFunction:
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
+    def _make_jit(self, pure):
+        """Compile hook: the sharded subclass (mxnet_tpu/shard/)
+        overrides this to attach NamedSharding in/out annotations over
+        its device mesh; the base step is single-(logical-)device."""
+        return jax.jit(pure,
+                       donate_argnums=(0, 1) if self._donate else ())
+
+    def _shard_key(self):
+        """Extra cache-key component for subclasses whose compiled
+        program depends on more than shapes/dtypes/optimizer scalars
+        (the sharded step keys on its plan fingerprint)."""
+        return ()
+
     def _hyper(self):
         """Per-step scalar hyperparameters, host-computed (float64 —
         the eager loop's arithmetic), shipped as weakly-typed f32
@@ -409,7 +422,7 @@ class StepFunction:
         # by the recompile auditor) instead of silently
         key = (tuple((tuple(v.shape), str(v.dtype)) for v in inputs),
                self._param_dtypes(),
-               self._optimizer.fused_signature())
+               self._optimizer.fused_signature()) + self._shard_key()
         fn = self._cache.get(key)
         if fn is None:
             _metrics.counter(
@@ -424,8 +437,7 @@ class StepFunction:
             tb0 = time.perf_counter()
             pure = (self._build_symbol() if self._symbol_mode
                     else self._build_block())
-            fn = jax.jit(pure,
-                         donate_argnums=(0, 1) if self._donate else ())
+            fn = self._make_jit(pure)
             self._cache[key] = fn
             self._last = (fn, key)
             _metrics.histogram(

@@ -29,9 +29,31 @@ import jax
 from . import metrics as _metrics
 
 __all__ = ["live_bytes", "device_memory_stats", "sample", "maybe_sample",
-           "peak_bytes", "reset_peak"]
+           "peak_bytes", "reset_peak", "per_device_live_bytes"]
 
 _last_sample = [0.0]
+
+
+def per_device_live_bytes() -> Dict[int, int]:
+    """Per-device census: {device_id: bytes} actually resident on each
+    device, attributing every live array through its addressable
+    shards — a ZeRO-sharded optimizer-state buffer counts 1/N on each
+    device, a replicated parameter counts fully on all of them. The
+    aggregate gauges above cannot tell those apart; this one is what
+    the mxshard per-replica memory contract is measured with
+    (tools/mxprof.py shard)."""
+    out: Dict[int, int] = {}
+    try:
+        for a in jax.live_arrays():
+            try:
+                for sh in a.addressable_shards:
+                    out[sh.device.id] = out.get(sh.device.id, 0) + \
+                        int(sh.data.nbytes)
+            except Exception:  # deleted/donated array mid-walk
+                continue
+    except Exception:  # backend torn down
+        pass
+    return out
 
 
 def live_bytes() -> Dict[str, int]:
@@ -71,6 +93,19 @@ def sample(emit_event: bool = True) -> Dict[str, object]:
     _metrics.gauge("memory_peak_bytes",
                    "peak of memory_live_bytes since reset"
                    ).max(census["bytes"])
+    per_dev = None
+    try:
+        n_devices = len(jax.devices())
+    except Exception:
+        n_devices = 1
+    if n_devices > 1:
+        # per-device gauges only when there is more than one device to
+        # tell apart (the shard-walk doubles the census cost)
+        per_dev = per_device_live_bytes()
+        for dev_id, nbytes in sorted(per_dev.items()):
+            _metrics.gauge(f"memory_live_bytes_dev{dev_id}",
+                           "bytes resident on this device "
+                           "(addressable-shard census)").set(nbytes)
     stats = device_memory_stats()
     if stats:
         if "bytes_in_use" in stats:
@@ -82,7 +117,7 @@ def sample(emit_event: bool = True) -> Dict[str, object]:
                            "PJRT allocator peak bytes"
                            ).set(stats["peak_bytes_in_use"])
     _last_sample[0] = time.monotonic()
-    out = {"live": census, "device": stats}
+    out = {"live": census, "device": stats, "per_device": per_dev}
     if not emit_event:
         return out
     from .. import profiler as _prof

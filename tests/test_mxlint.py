@@ -263,7 +263,7 @@ def test_hybridize_warns_on_tracer_leak():
 def test_pass_manager_registry():
     pm = default_manager()
     assert pm.names() == ["dispatchlint", "graphlint", "oplint",
-                          "steplint", "tracercheck"]
+                          "shardlint", "steplint", "tracercheck"]
     with pytest.raises(KeyError):
         pm.get("no_such_pass")
     out = sym.var("x") + sym.var("x")

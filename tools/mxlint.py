@@ -53,6 +53,36 @@ def _selfcheck_graph_findings():
     return lint_symbol(net)
 
 
+def _selfcheck_shard_findings():
+    """shardlint over a tiny GSPMD-sharded fused step on the local
+    devices (forced to 8 virtual host devices when the caller didn't
+    set a count): compiled sharding annotations must match the plan,
+    collectives must attribute to mesh axes, ZeRO must really shard
+    the optimizer state."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.passes.shardlint import lint_shard_report
+    from mxnet_tpu.shard import ShardPlan
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu", flatten=False,
+                         in_units=16))
+        net.add(nn.Dense(8, flatten=False, in_units=32))
+    net.initialize(mx.initializer.Xavier())
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (8, 16)).astype("float32"))
+    y = nd.array(rng.uniform(-1, 1, (8, 8)).astype("float32"))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    fused = trainer.fuse_step(net, gluon.loss.L2Loss(),
+                              shard_plan=ShardPlan())
+    fused.step(x, y)
+    return lint_shard_report(fused.shard_report(x, y))
+
+
 def _selfcheck_block_findings():
     """tracercheck over a small hybridized block — a clean forward must
     produce no tracer findings."""
@@ -78,6 +108,10 @@ def main(argv=None):
                    help="audit every registered op's metadata (oplint)")
     p.add_argument("--all", action="store_true",
                    help="ops audit + graph/block framework self-checks")
+    p.add_argument("--shard", action="store_true",
+                   help="shardlint self-check: compile a tiny GSPMD-"
+                        "sharded fused step over the local devices and "
+                        "verify its HLO sharding annotations")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the shared machine-readable findings report")
     p.add_argument("--strict", action="store_true",
@@ -90,8 +124,17 @@ def main(argv=None):
                         "register known-bad ops)")
     args = p.parse_args(argv)
 
-    if not (args.ops or args.all or args.graphs):
-        p.error("nothing to do: pass --ops, --all, or graph JSON files")
+    if not (args.ops or args.all or args.graphs or args.shard):
+        p.error("nothing to do: pass --ops, --all, --shard, or graph "
+                "JSON files")
+
+    if args.shard and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # the self-check needs a mesh; force 8 virtual host devices
+        # (must land before the first jax import)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
 
     import mxnet_tpu  # noqa: F401 — populate the registry
     from mxnet_tpu.passes import findings_report, severity_counts
@@ -144,6 +187,10 @@ def main(argv=None):
         bf = _selfcheck_block_findings()
         findings.extend(bf)
         sections.append(("tracercheck", "<self-check block>", bf))
+    if args.shard:
+        sf = _selfcheck_shard_findings()
+        findings.extend(sf)
+        sections.append(("shardlint", "<self-check sharded step>", sf))
 
     counts = severity_counts(findings)
     if args.as_json:

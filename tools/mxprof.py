@@ -286,6 +286,118 @@ def step_cmd(path, as_json):
 
 
 # ---------------------------------------------------------------------------
+# sharded-training report (mxnet_tpu/shard/ — ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"
+
+
+def shard_metrics(metrics):
+    """Pull the mxshard gauge family out of one metrics snapshot."""
+    devices = int(metrics.get("shard_mesh_devices", 0) or 0)
+    out = {"devices": devices, "per_device_live": {
+        int(k[len("memory_live_bytes_dev"):]): v
+        for k, v in metrics.items()
+        if k.startswith("memory_live_bytes_dev")}}
+    for kind in ("params", "opt_state"):
+        total = metrics.get(f"shard_{kind}_bytes_total")
+        per = metrics.get(f"shard_{kind}_bytes_per_replica")
+        out[kind] = {"total": total, "per_replica": per,
+                     "replicated_fraction": (
+                         round(per * devices / total, 4)
+                         if total and per and devices else None)}
+    return out
+
+
+def shard_table(sm):
+    """Render bytes-per-replica for params vs optimizer state — the
+    quantity ZeRO sharding exists to shrink (1.0x replicated fraction
+    = perfectly sharded; Nx = fully replicated on an N-device mesh)."""
+    if not sm["devices"]:
+        return ("  no sharded-step activity in this snapshot "
+                "(ShardedStepFunction never installed)")
+    lines = [f"  mesh devices: {sm['devices']}"]
+    for kind, label in (("params", "parameters"),
+                        ("opt_state", "optimizer state")):
+        k = sm[kind]
+        if not k["total"]:
+            lines.append(f"  {label:<16} (no accounting)")
+            continue
+        frac = k["replicated_fraction"]
+        lines.append(
+            f"  {label:<16} total {_fmt_bytes(k['total']):>10}   "
+            f"per-replica {_fmt_bytes(k['per_replica']):>10}   "
+            f"replicated-fraction {frac}x"
+            + (" (fully sharded)" if frac and frac <= 1.05 else
+               " (fully replicated)" if frac
+               and frac >= 0.95 * sm["devices"] else ""))
+    if sm["per_device_live"]:
+        vals = sm["per_device_live"]
+        lines.append("  per-device live bytes:")
+        for dev_id in sorted(vals):
+            lines.append(f"    dev{dev_id:<3} "
+                         f"{_fmt_bytes(vals[dev_id])}")
+    return "\n".join(lines)
+
+
+def analyze_shard(sm):
+    """Sharding pathology scan → Finding list (shared schema)."""
+    from mxnet_tpu.passes import Finding
+    findings = []
+    devices = sm["devices"]
+    frac = sm["opt_state"]["replicated_fraction"]
+    if devices > 1 and frac is not None and frac >= 0.95 * devices:
+        findings.append(Finding(
+            "mxprof", "shard-no-memory-win", "opt_state", "warn",
+            f"optimizer state is effectively fully replicated "
+            f"(replicated-fraction {frac}x on a {devices}-device "
+            "mesh) — ZeRO sharding is off or every state dim 0 "
+            "fails the divisibility rule; per-replica memory will "
+            "not scale 1/N"))
+    per_dev = sm["per_device_live"]
+    if len(per_dev) > 1:
+        vals = sorted(per_dev.values())
+        if vals[0] and vals[-1] / max(vals[0], 1) > 1.5:
+            findings.append(Finding(
+                "mxprof", "shard-imbalance", "live_bytes", "warn",
+                f"per-device live bytes are imbalanced "
+                f"(min {vals[0]}, max {vals[-1]}): one replica is "
+                "holding >1.5x another's memory — check param_specs "
+                "divisibility or stray unsharded buffers"))
+    return findings
+
+
+def shard_cmd(path, as_json):
+    with open(path) as f:
+        report = summarize_metrics_lines(f)
+    last = report.get("last") or {}
+    metrics = last.get("metrics", {})
+    sm = shard_metrics(metrics)
+    findings = analyze_shard(sm)
+    if as_json:
+        from mxnet_tpu.passes import findings_report
+        print(findings_report(
+            "mxprof", findings,
+            extra={"file": path, "n_snapshots": report["n_snapshots"],
+                   "shard_metrics": sm},
+            as_json=True))
+    else:
+        print(f"== mxprof shard: {path} "
+              f"({report['n_snapshots']} snapshot(s))")
+        print("-- sharded training (mxshard)")
+        print(shard_table(sm))
+        for fi in findings:
+            print(f"  {fi!r}")
+    from mxnet_tpu.passes import severity_counts
+    return 2 if severity_counts(findings)["error"] else 0
+
+
+# ---------------------------------------------------------------------------
 # findings (shared schema with mxlint)
 # ---------------------------------------------------------------------------
 
@@ -427,12 +539,25 @@ def main(argv=None):
     pstep.add_argument("--json", action="store_true", dest="as_json",
                        help="emit the shared machine-readable findings "
                             "report")
+    pshard = sub.add_parser(
+        "shard",
+        help="sharded-training report from a metrics JSON-lines dump: "
+             "bytes-per-replica for params vs optimizer state, "
+             "per-device live bytes, sharding pathologies")
+    pshard.add_argument("dump", help="metrics JSON-lines file "
+                                     "(MXNET_METRICS_EXPORT)")
+    pshard.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the shared machine-readable "
+                             "findings report")
     args = p.parse_args(argv)
-    if args.cmd not in ("summarize", "step"):
-        p.error("nothing to do: use the summarize or step subcommand")
+    if args.cmd not in ("summarize", "step", "shard"):
+        p.error("nothing to do: use the summarize, step or shard "
+                "subcommand")
     try:
         if args.cmd == "step":
             return step_cmd(args.dump, args.as_json)
+        if args.cmd == "shard":
+            return shard_cmd(args.dump, args.as_json)
         top = args.top
         if top is None:
             from mxnet_tpu.base import get_env
