@@ -316,6 +316,31 @@ register_flag(
     "over the Pallas flash kernel (operator_tune.choose). An unknown "
     "label raises, listing the candidates.")
 register_flag(
+    "MXNET_GRAPH_OPT", int, 0,
+    "Graph-optimizer level for Symbol binds (mxnet_tpu/opt/, "
+    "docs/graph_opt.md). 0 = off; 1 = semantics-preserving cleanups "
+    "(constant folding, CSE, identity elision, dead-node sweep — "
+    "bitwise parity class); 2 = level 1 plus fusion-group "
+    "partitioning (conv+bn+relu, matmul+act, elementwise chains, "
+    "attention) and NHWC layout selection for TPU/XLA:CPU "
+    "(tolerance-tagged parity). Applies at Executor bind, symbol-mode "
+    "StepFunction compile, and serve AOT warmup.", choices=(0, 1, 2))
+register_flag(
+    "MXNET_GRAPH_OPT_VERIFY", bool, False,
+    "Bind-time parity gate for the graph optimizer: run the optimized "
+    "graph against the unoptimized one on the executor's live buffers "
+    "under the pipeline's declared tolerance class, and REVERT to the "
+    "unoptimized graph on any mismatch (graph_opt_verify_failures_"
+    "total counts reverts). Costs one extra forward per bind; "
+    "mxlint --opt turns it on for its self-check.")
+register_flag(
+    "MXNET_GRAPH_OPT_PALLAS", bool, True,
+    "Allow Pallas kernel lowerings for fused patterns (_fused_"
+    "attention flash kernel, the fused optimizer+cast mp_sgd step). "
+    "Only takes effect on a TPU backend; everywhere else — and when "
+    "set to 0 — the automatic XLA fallback composition runs "
+    "(bitwise-identical to the unfused graph).")
+register_flag(
     "MXSERVE_BUCKETS", str, "1,2,4,8,16,32",
     "Shape-bucket ladder for the serving subsystem (serve.buckets."
     "default_ladder): batch rungs as a comma list, or named axes as "

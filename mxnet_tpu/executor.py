@@ -78,6 +78,28 @@ class Executor:
         self.aux_dict = aux_states
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
+        # bind-time graph optimization (MXNET_GRAPH_OPT levels — the
+        # optimizing-compiler pillar, mxnet_tpu/opt/): the EXECUTED
+        # graph may be a rewritten clone; self._symbol stays the
+        # user's graph for all metadata/naming surfaces. The rewrite
+        # pipeline guarantees an identical binding surface (same args/
+        # aux/output arity) or reverts, so every dict above is valid
+        # against both. Optionally parity-verified right here against
+        # the live buffers (MXNET_GRAPH_OPT_VERIFY).
+        self._run_symbol = symbol
+        self._opt_report = None
+        from .base import get_env
+        if get_env("MXNET_GRAPH_OPT", 0):
+            from .opt import optimize_symbol
+            vm = None
+            if get_env("MXNET_GRAPH_OPT_VERIFY", False):
+                from .opt.verify import executor_value_map
+                vm = executor_value_map(
+                    {n: a for n, a in args.items()
+                     if n in self._arg_names}, aux_states)
+            head = (symbol.list_outputs() or ["?"])[0]
+            self._run_symbol, self._opt_report = optimize_symbol(
+                symbol, where=f"Executor:{head}", value_map=vm)
         self.outputs: List[NDArray] = []
         self._monitor_callback = None
         self._monitor_all = False
@@ -111,10 +133,16 @@ class Executor:
     # ------------------------------------------------------------------
     # compilation
     # ------------------------------------------------------------------
+    @property
+    def opt_report(self):
+        """The graph-optimizer report for this bind (None when
+        MXNET_GRAPH_OPT=0 or nothing fired) — see opt.OptReport."""
+        return self._opt_report
+
     def _get_compiled(self, is_train: bool):
         key = is_train
         if key not in self._compiled:
-            sym = self._symbol
+            sym = self._run_symbol
 
             def fn(arg_vals, aux_vals, rng_raw):
                 vm = dict(arg_vals)
@@ -160,7 +188,7 @@ class Executor:
             grad_names = [n for n in self._arg_names
                           if self.grad_req.get(n, "null") != "null"]
             self._compiled_grad["fb"] = jax.jit(
-                graph_forward_backward(self._symbol, grad_names))
+                graph_forward_backward(self._run_symbol, grad_names))
         return self._compiled_grad["fb"]
 
     def compile_signature(self, is_train: bool = False):

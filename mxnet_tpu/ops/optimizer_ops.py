@@ -59,7 +59,28 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
                       lazy_update=True):
     """Mixed-precision SGD with momentum over fp32 master weights;
     returns (new_weight, new_mom, new_weight32) (ref: optimizer_op.cc
-    mp_sgd_mom_update)."""
+    mp_sgd_mom_update). On TPU (MXNET_GRAPH_OPT_PALLAS, default on)
+    the update AND the low-precision cast lower as ONE Pallas kernel —
+    the optimizer+cast pattern XLA emits as two kernels with an extra
+    HBM round trip (mxnet_tpu/opt/kernels.py); elsewhere the plain XLA
+    composition below runs."""
+    from ..opt.kernels import (mp_sgd_mom_update_pallas,
+                               pallas_kernels_active)
+    if pallas_kernels_active():
+        return mp_sgd_mom_update_pallas(
+            weight, grad, mom, weight32, lr=lr, momentum=momentum,
+            wd=wd, rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+    return _mp_sgd_mom_update_xla(
+        weight, grad, mom, weight32, lr=lr, momentum=momentum, wd=wd,
+        rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+
+
+def _mp_sgd_mom_update_xla(weight, grad, mom, weight32, lr, momentum,
+                           wd, rescale_grad, clip_gradient):
+    """The plain-XLA composition of mp_sgd_mom_update — shared by the
+    op and by the Pallas wrapper's automatic fallback (opt/kernels.py),
+    so both paths are one formula."""
     g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad,
                   clip_gradient)
     new_mom = momentum * mom - lr * g

@@ -56,7 +56,11 @@ class OpInfo:
         # symbol-layer metadata (ref: nnvm FListInputNames /
         # FListAuxiliaryStates / FNumVisibleOutputs attrs):
         self.input_names = input_names    # declared tensor-input names
-        self.aux_updates = aux_updates or {}  # out_idx -> input_idx (aux var)
+        # out_idx -> input_idx (aux var); may be callable(params) -> dict
+        # for ops whose aux topology is instance-dependent (the graph
+        # optimizer's _fused_group carries its aux map in node params,
+        # mirroring how visible_outputs already supports callables)
+        self.aux_updates = aux_updates or {}
         self.visible_outputs = visible_outputs  # user-visible output count
         sig = inspect.signature(fn)
         self.arg_names = []
@@ -68,6 +72,15 @@ class OpInfo:
             self.arg_names.append(pname)
             if p.default is not p.empty:
                 self.defaults[pname] = p.default
+
+    def aux_updates_for(self, params) -> Dict[int, int]:
+        """Resolve the aux-update map for a concrete node: static dict
+        for ordinary ops, ``aux_updates(params)`` for param-dependent
+        ones (e.g. the optimizer's fused groups)."""
+        au = self.aux_updates
+        if callable(au):
+            au = au(params or {})
+        return au or {}
 
 
 _OPS: Dict[str, OpInfo] = {}

@@ -769,3 +769,14 @@ def _sym_ones(shape=(), dtype="float32"):
     """Input-free ones initializer for symbol graphs (the _ones init
     op's symbol-layer spelling)."""
     return jnp.ones(tuple(shape), jnp.dtype(dtype))
+
+
+@register_op("_graph_const", differentiable=False)
+def _graph_const(data=(), shape=(), dtype="float32"):
+    """Materialized constant produced by the graph optimizer's
+    constant-folding pass (mxnet_tpu/opt/): ``data`` is the folded
+    value as (nested) lists so the node survives a tojson/load_json
+    round trip, ``shape``/``dtype`` pin the exact array. Under jit the
+    value embeds in the program as an XLA constant."""
+    arr = jnp.asarray(onp.asarray(data, onp.dtype(dtype)))
+    return arr.reshape(tuple(shape))

@@ -1,0 +1,366 @@
+"""Level-2 fusion-group partitioning over the Symbol IR.
+
+"Operator Fusion in XLA" (PAPERS.md) quantifies which fusions XLA's
+producer-consumer pass finds by itself (elementwise chains inside one
+jit) and which need explicit partitioning (attention-shaped softmax
+contractions, anything crossing a dispatch boundary). This pass makes
+the profitable groups EXPLICIT graph nodes:
+
+- ``conv_bn_relu``     — Convolution → BatchNorm [→ Activation]
+- ``matmul_bias_act``  — FullyConnected → Activation
+- ``elementwise_chain``— maximal single-consumer chains of elementwise
+  ops, length >= 2
+- ``attention``        — batch_dot(softmax(batch_dot(q,kᵀ)·s), v)
+  collapsed into ``_fused_attention`` (Pallas flash kernel on TPU, the
+  exact unfused composition elsewhere — ops/fused.py)
+
+The first three collapse into ``_fused_group`` nodes whose subgraph
+rides along as symbol JSON and evaluates through one jit region; at an
+eager (non-bulk) boundary that is one dispatch per group instead of one
+per op, and under the bulk jit each group stamps a named_scope so
+profiles attribute time to the pattern. Groups never capture rng/
+train-polymorphic ops other than BatchNorm (whose aux write-back is
+re-exposed through the fused node's ``aux_map``), and an intermediate
+consumed outside the group disqualifies it (the group boundary must not
+duplicate work).
+
+Tolerance class "fusion": within a group the arithmetic is the same
+op-for-op today, but the contract allows kernel lowerings (Pallas
+attention's online softmax) that reorder contractions.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..passes import Finding
+from ..symbol.symbol import Symbol, _Node
+from .rewrite import MutableGraph, RewritePass
+
+__all__ = ["FusionPartition", "ELEMENTWISE_OPS"]
+
+# ops that are elementwise/shape-preserving and safe inside a chain
+ELEMENTWISE_OPS = frozenset({
+    "Activation", "relu", "sigmoid", "tanh", "softsign", "exp", "log",
+    "sqrt", "square", "abs", "negative", "clip", "hard_sigmoid",
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_power_scalar", "smooth_l1",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "add_n",
+})
+
+
+def _single_consumer(node: _Node, use_counts, outputs) -> bool:
+    """True when every output of ``node`` is consumed exactly once and
+    none is a graph head — the group can swallow it without
+    duplicating work or changing the output surface."""
+    if any(n is node for n, _oi in outputs):
+        return False
+    return use_counts.get(id(node), 0) == 1
+
+
+class _Group:
+    """One matched fusion group (nodes in topo order)."""
+
+    def __init__(self, pattern: str, nodes: Sequence[_Node]):
+        self.pattern = pattern
+        self.nodes = list(nodes)
+
+
+class FusionPartition(RewritePass):
+    name = "opt.fuse"
+    order = 50
+    min_level = 2
+    tolerance_class = "fusion"
+
+    def __init__(self):
+        self.last_census: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    def apply(self, graph: MutableGraph) -> Tuple[int, List[Finding]]:
+        self.last_census = {}
+        findings: List[Finding] = []
+        total = 0
+        # attention first: its nodes must not be claimed by chain fusion
+        n, f = self._fuse_attention(graph)
+        total += n
+        findings.extend(f)
+        for matcher in (self._match_conv_bn_relu,
+                        self._match_matmul_act,
+                        self._match_elementwise_chains):
+            groups = matcher(graph)
+            for g in groups:
+                ok, why = self._lower_group(graph, g)
+                if not ok:
+                    findings.append(self.rewrite_finding(
+                        "fuse-skip", g.nodes[0].name,
+                        f"pattern {g.pattern} matched but not lowered: "
+                        f"{why}"))
+                    continue
+                total += 1
+                self.last_census[g.pattern] = \
+                    self.last_census.get(g.pattern, 0) + 1
+                findings.append(self.rewrite_finding(
+                    "fuse", g.nodes[0].name,
+                    f"fused {len(g.nodes)} nodes into one "
+                    f"{g.pattern} group"))
+        return total, findings
+
+    # ------------------------------------------------------------------
+    # pattern matchers
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _groupable(node: _Node) -> bool:
+        if node.is_variable:
+            return False
+        info = node.info
+        if info is None or info.needs_rng or not info.differentiable:
+            return False
+        # train-polymorphic ops other than BN stay out of groups
+        if info.needs_train and node.op not in (
+                "BatchNorm", "BatchNorm_v1", "_contrib_SyncBatchNorm"):
+            return False
+        if node.op in ("_fused_group", "_fused_attention"):
+            return False
+        return True
+
+    def _match_conv_bn_relu(self, graph: MutableGraph) -> List[_Group]:
+        use = graph.use_counts()
+        consumers = graph.consumers()
+        claimed: Set[int] = set()
+        groups = []
+        for node in graph.topo():
+            if node.op not in ("Convolution", "Convolution_v1",
+                               "_nhwc_conv") or id(node) in claimed:
+                continue
+            chain = [node]
+            cur = node
+            for want in ("bn", "act"):
+                nxt = self._sole_consumer(cur, consumers, use,
+                                          graph.outputs)
+                if nxt is None:
+                    break
+                if want == "bn" and nxt.op in (
+                        "BatchNorm", "BatchNorm_v1",
+                        "_contrib_SyncBatchNorm"):
+                    chain.append(nxt)
+                    cur = nxt
+                elif nxt.op == "Activation" or (
+                        want == "act" and nxt.op in ("relu",)):
+                    chain.append(nxt)
+                    cur = nxt
+                    break
+                else:
+                    break
+            if len(chain) >= 2 and all(self._groupable(n)
+                                       for n in chain):
+                claimed.update(id(n) for n in chain)
+                groups.append(_Group("conv_bn_relu", chain))
+        return groups
+
+    def _match_matmul_act(self, graph: MutableGraph) -> List[_Group]:
+        use = graph.use_counts()
+        consumers = graph.consumers()
+        groups = []
+        for node in graph.topo():
+            if node.op != "FullyConnected":
+                continue
+            nxt = self._sole_consumer(node, consumers, use,
+                                      graph.outputs)
+            if nxt is not None and nxt.op == "Activation" \
+                    and self._groupable(node) and self._groupable(nxt):
+                groups.append(_Group("matmul_bias_act", [node, nxt]))
+        return groups
+
+    def _match_elementwise_chains(self, graph: MutableGraph
+                                  ) -> List[_Group]:
+        use = graph.use_counts()
+        consumers = graph.consumers()
+        claimed: Set[int] = set()
+        groups = []
+        for node in graph.topo():
+            if node.op not in ELEMENTWISE_OPS or id(node) in claimed \
+                    or not self._groupable(node):
+                continue
+            # only start a chain at a node whose producer is NOT a
+            # chain member (maximal chains, each node claimed once)
+            prod = node.inputs[0][0] if node.inputs else None
+            if prod is not None and prod.op in ELEMENTWISE_OPS \
+                    and id(prod) not in claimed \
+                    and self._groupable(prod) \
+                    and _single_consumer(prod, use, graph.outputs):
+                continue
+            chain = [node]
+            cur = node
+            while True:
+                nxt = self._sole_consumer(cur, consumers, use,
+                                          graph.outputs)
+                if nxt is None or nxt.op not in ELEMENTWISE_OPS \
+                        or not self._groupable(nxt) \
+                        or id(nxt) in claimed:
+                    break
+                # a multi-input elementwise consumer joins only if its
+                # OTHER inputs come from outside the chain (they become
+                # group inputs)
+                chain.append(nxt)
+                cur = nxt
+            if len(chain) >= 2:
+                claimed.update(id(n) for n in chain)
+                groups.append(_Group("elementwise_chain", chain))
+        return groups
+
+    @staticmethod
+    def _sole_consumer(node: _Node, consumers, use_counts, outputs
+                       ) -> Optional[_Node]:
+        if not _single_consumer(node, use_counts, outputs):
+            return None
+        cons = consumers.get(id(node), [])
+        if len(cons) != 1:
+            return None
+        return cons[0][0]
+
+    # ------------------------------------------------------------------
+    # attention: batch_dot(softmax(batch_dot(q, k, transpose_b)·s), v)
+    # ------------------------------------------------------------------
+    def _fuse_attention(self, graph: MutableGraph
+                        ) -> Tuple[int, List[Finding]]:
+        findings: List[Finding] = []
+        fused = 0
+        for node in graph.topo():
+            # recompute per candidate: an applied fusion invalidates
+            # use counts (graphs are small; matching is not hot)
+            use = graph.use_counts()
+            m = self._match_attention(node, use, graph.outputs)
+            if m is None:
+                continue
+            q, k, v, scale, causal, members = m
+            att = graph.add_node(_Node(
+                "_fused_attention", f"{node.name}_flash", [q, k, v],
+                {"scale": float(scale), "causal": bool(causal)}))
+            graph.replace_many({(id(node), 0): (att, 0)})
+            fused += 1
+            self.last_census["attention"] = \
+                self.last_census.get("attention", 0) + 1
+            findings.append(self.rewrite_finding(
+                "fuse", node.name,
+                f"fused {len(members)}-node softmax-attention into "
+                f"_fused_attention (Pallas when available)"))
+        return fused, findings
+
+    def _match_attention(self, out_bd: _Node, use, outputs):
+        """Match out_bd = batch_dot(softmax(scores, axis=-1), v) where
+        scores = batch_dot(q, k, transpose_b=True) [· scale]."""
+        if out_bd.op != "batch_dot" or out_bd.params.get("transpose_a") \
+                or out_bd.params.get("transpose_b"):
+            return None
+        if len(out_bd.inputs) != 2:
+            return None
+        (sm, sm_oi), v_entry = out_bd.inputs
+        if sm_oi != 0 or sm.op != "softmax" \
+                or int(sm.params.get("axis", -1)) != -1 \
+                or sm.params.get("use_length") \
+                or not _single_consumer(sm, use, outputs):
+            return None
+        scores, sc_oi = sm.inputs[0]
+        if sc_oi != 0:
+            return None
+        scale = 1.0
+        members = [out_bd, sm]
+        if scores.op == "_mul_scalar":
+            if not _single_consumer(scores, use, outputs):
+                return None
+            scale = float(scores.params.get("scalar", 1.0))
+            members.append(scores)
+            scores, sc_oi = scores.inputs[0]
+            if sc_oi != 0:
+                return None
+        if scores.op != "batch_dot" \
+                or not scores.params.get("transpose_b") \
+                or scores.params.get("transpose_a") \
+                or not _single_consumer(scores, use, outputs):
+            return None
+        members.append(scores)
+        q_entry, k_entry = scores.inputs
+        return q_entry, k_entry, v_entry, scale, False, members
+
+    # ------------------------------------------------------------------
+    # group lowering: collapse nodes into one _fused_group node
+    # ------------------------------------------------------------------
+    def _lower_group(self, graph: MutableGraph, group: _Group
+                     ) -> Tuple[bool, str]:
+        gset = {id(n) for n in group.nodes}
+        # external inputs in first-use order; external outputs = every
+        # entry consumed outside the group (+ aux-update outs)
+        ext_inputs: List[Tuple[_Node, int]] = []
+        seen_in: Set[Tuple[int, int]] = set()
+        for n in group.nodes:
+            for e in n.inputs:
+                src, oi = e
+                if id(src) in gset:
+                    continue
+                key = (id(src), oi)
+                if key not in seen_in:
+                    seen_in.add(key)
+                    ext_inputs.append(e)
+        consumers = graph.consumers()
+        head_ids = {(id(n), oi) for n, oi in graph.outputs}
+        ext_outputs: List[Tuple[_Node, int]] = []
+        for n in group.nodes:
+            for oi in range(n._n_out):
+                consumed_outside = any(
+                    id(c) not in gset
+                    for c, pos in consumers.get(id(n), [])
+                    if c.inputs[pos] == (n, oi)) \
+                    or (id(n), oi) in head_ids
+                if consumed_outside:
+                    ext_outputs.append((n, oi))
+        if not ext_outputs:
+            return False, "group has no external outputs"
+        # aux updates (BatchNorm): expose the new-stat outputs and map
+        # them to the aux variable's input position
+        aux_map: Dict[int, int] = {}
+        for n in group.nodes:
+            au = n.info.aux_updates_for(n.params) if n.info else {}
+            for out_idx, in_pos in au.items():
+                src_entry = n.inputs[in_pos]
+                if id(src_entry[0]) in gset or not src_entry[0].is_variable:
+                    return False, ("aux source is not an external "
+                                   "variable")
+                if src_entry not in ext_inputs:
+                    ext_inputs.append(src_entry)
+                if (n, out_idx) not in ext_outputs:
+                    ext_outputs.append((n, out_idx))
+                aux_map[ext_outputs.index((n, out_idx))] = \
+                    ext_inputs.index(src_entry)
+        # build the inner symbol: clone group nodes over fresh
+        # _fg_in{i} variables
+        in_vars = {(id(e[0]), e[1]): _Node(None, f"_fg_in{i}", [], {})
+                   for i, e in enumerate(ext_inputs)}
+        cloned: Dict[int, _Node] = {}
+
+        def clone(n: _Node) -> _Node:
+            got = cloned.get(id(n))
+            if got is not None:
+                return got
+            ins = []
+            for src, oi in n.inputs:
+                if id(src) in gset:
+                    ins.append((clone(src), oi))
+                else:
+                    ins.append((in_vars[(id(src), oi)], 0))
+            new = _Node(n.op, n.name, ins, dict(n.params),
+                        dict(n.attrs))
+            new._n_out = n._n_out
+            cloned[id(n)] = new
+            return new
+
+        inner = Symbol([(clone(n), oi) for n, oi in ext_outputs])
+        fused = graph.add_node(_Node(
+            "_fused_group", f"{group.nodes[-1].name}_{group.pattern}",
+            list(ext_inputs),
+            {"graph": inner.tojson(), "pattern": group.pattern,
+             "num_outputs": len(ext_outputs), "aux_map": aux_map}))
+        graph.replace_many({
+            (id(n), oi): (fused, i)
+            for i, (n, oi) in enumerate(ext_outputs)})
+        return True, ""

@@ -1,0 +1,209 @@
+"""Level-2 TPU layout selection: NHWC convolution regions.
+
+The MXNet op surface is NCHW-native, but channels-last is the layout
+the TPU's convolution hardware (and XLA:CPU's vectorized path — the
+bench host) actually wants; the reference delegated this to MKLDNN's
+format propagation, and our eager conv auto-tunes the choice per
+dispatch (ops/nn.py). Inside one jitted graph the choice belongs to the
+COMPILER — this pass makes it: it finds maximal regions of
+layout-flexible ops anchored on 2-D convolutions, converts the region
+to NHWC (``_nhwc_conv`` / ``_nhwc_pool`` / BatchNorm ``axis=3``), and
+inserts the minimal transpose set at region boundaries — interior edges
+carry NO transposes, and weights/biases keep their bound NCHW-family
+shapes (the optimizer's I/O contract), with the OIHW→HWIO weight shuffle
+folded into the kernel where XLA hoists it.
+
+Growth rule (fixpoint): a node joins a region when its op is
+layout-flexible AND every tensor input that must share the layout is
+already in the region; convolutions seed regions unconditionally
+(their data edge takes the boundary transpose). Ops that MIX element
+order with shape — reshape, Flatten, Concat, slice — are hard
+boundaries: transposing through them changes semantics, so the region
+ends and a single NHWC→NCHW transpose restores the contract.
+
+Tolerance class "layout": the convolution/pooling reduce order changes
+with the layout, so parity is tolerance-tagged, not bitwise.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from ..passes import Finding
+from ..symbol.symbol import _Node
+from .rewrite import MutableGraph, RewritePass
+
+__all__ = ["LayoutSelect"]
+
+_TO_NHWC = (0, 2, 3, 1)
+_TO_NCHW = (0, 3, 1, 2)
+
+# single-tensor-input ops that are layout-transparent
+_UNARY_FLEX = frozenset({
+    "Activation", "relu", "sigmoid", "tanh", "softsign", "exp", "log",
+    "sqrt", "square", "abs", "negative", "clip", "hard_sigmoid",
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_power_scalar",
+})
+# multi-input elementwise ops: every tensor input must share the layout
+_NARY_FLEX = frozenset({
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "add_n",
+})
+_BN_OPS = frozenset({"BatchNorm", "BatchNorm_v1",
+                     "_contrib_SyncBatchNorm"})
+_POOL_TYPES_NHWC = ("max", "avg", "sum")
+
+
+def _is_conv_seed(node: _Node) -> bool:
+    if node.op not in ("Convolution", "Convolution_v1"):
+        return False
+    kern = node.params.get("kernel")
+    if kern is None or len(tuple(kern)) != 2:
+        return False
+    layout = node.params.get("layout")
+    return layout in (None, "NCHW")
+
+
+def _pool_eligible(node: _Node) -> bool:
+    if node.op not in ("Pooling", "Pooling_v1"):
+        return False
+    if node.params.get("pool_type", "max") not in _POOL_TYPES_NHWC:
+        return False
+    if node.params.get("layout") not in (None, "NCHW"):
+        return False
+    kern = tuple(node.params.get("kernel", (2, 2)))
+    return len(kern) == 2 or bool(node.params.get("global_pool"))
+
+
+class LayoutSelect(RewritePass):
+    name = "opt.layout"
+    order = 40
+    min_level = 2
+    tolerance_class = "layout"
+
+    #: regions smaller than this are not converted (two boundary
+    #: transposes around a lone node rarely pay)
+    MIN_REGION = 2
+
+    def apply(self, graph: MutableGraph) -> Tuple[int, List[Finding]]:
+        region = self._grow_region(graph)
+        if len(region) < self.MIN_REGION:
+            return 0, []
+        findings: List[Finding] = []
+        nodes = [n for n in graph.topo() if id(n) in region]
+        n_transposes = self._rewrite(graph, region, nodes)
+        findings.append(self.rewrite_finding(
+            "layout", nodes[0].name,
+            f"converted a {len(nodes)}-node region to NHWC "
+            f"({sum(1 for n in nodes if n.op == '_nhwc_conv')} conv, "
+            f"{n_transposes} boundary transpose(s))"))
+        return len(nodes), findings
+
+    # ------------------------------------------------------------------
+    def _grow_region(self, graph: MutableGraph) -> Set[int]:
+        region: Set[int] = set()
+        for n in graph.topo():
+            if _is_conv_seed(n):
+                region.add(id(n))
+        if not region:
+            return region
+        changed = True
+        while changed:
+            changed = False
+            for n in graph.topo():
+                if id(n) in region or n.is_variable:
+                    continue
+                if not self._joins(n, region):
+                    continue
+                region.add(id(n))
+                changed = True
+        return region
+
+    @staticmethod
+    def _joins(node: _Node, region: Set[int]) -> bool:
+        op = node.op
+        if op in _UNARY_FLEX:
+            return bool(node.inputs) and id(node.inputs[0][0]) in region
+        if op in _NARY_FLEX:
+            return bool(node.inputs) and all(
+                id(src) in region for src, _oi in node.inputs)
+        if op in _BN_OPS:
+            # only the DATA edge must be in-region; gamma/beta/stats
+            # are (C,) vectors, reshaped by the axis param
+            return int(node.params.get("axis", 1)) == 1 \
+                and bool(node.inputs) \
+                and id(node.inputs[0][0]) in region
+        if _pool_eligible(node):
+            return bool(node.inputs) and id(node.inputs[0][0]) in region
+        return False
+
+    # ------------------------------------------------------------------
+    def _rewrite(self, graph: MutableGraph, region: Set[int],
+                 nodes: List[_Node]) -> int:
+        n_t = 0
+        # 1. convert ops in place
+        for n in nodes:
+            if n.op in ("Convolution", "Convolution_v1"):
+                n.op = "_nhwc_conv"
+            elif n.op in ("Pooling", "Pooling_v1"):
+                n.op = "_nhwc_pool"
+            elif n.op in _BN_OPS:
+                n.params["axis"] = 3
+        # 2. boundary transposes on region INPUT data edges. By the
+        # growth rule every non-seed member joined because its data
+        # inputs were already in-region, so only conv seeds can have
+        # an out-of-region data edge.
+        for n in nodes:
+            if n.op != "_nhwc_conv":
+                continue
+            src, oi = n.inputs[0]
+            if id(src) in region:
+                continue
+            t = graph.add_node(_Node(
+                "transpose", f"{n.name}_to_nhwc",
+                [(src, oi)], {"axes": _TO_NHWC}))
+            n.inputs[0] = (t, 0)
+            n_t += 1
+        # 3. boundary transposes on region OUTPUT edges consumed
+        # outside (or heads)
+        consumers = graph.consumers()
+        for n in nodes:
+            ext = [(c, pos) for c, pos in consumers.get(id(n), [])
+                   if id(c) not in region]
+            head_idx = [i for i, (hn, _oi) in enumerate(graph.outputs)
+                        if hn is n]
+            # aux-update outputs of BN stay (C,)-shaped — no transpose
+            aux_outs = set()
+            if n.info is not None:
+                aux_outs = set(
+                    n.info.aux_updates_for(n.params).keys())
+            by_oi: Dict[int, _Node] = {}
+            for c, pos in ext:
+                _src, oi = c.inputs[pos]
+                if oi in aux_outs:
+                    continue
+                t = by_oi.get(oi)
+                if t is None:
+                    t = graph.add_node(_Node(
+                        "transpose", f"{n.name}_to_nchw{oi}",
+                        [(n, oi)], {"axes": _TO_NCHW}))
+                    by_oi[oi] = t
+                    n_t += 1
+                c.inputs[pos] = (t, 0)
+            for i in head_idx:
+                _hn, oi = graph.outputs[i]
+                if oi in aux_outs:
+                    continue
+                t = by_oi.get(oi)
+                if t is None:
+                    t = graph.add_node(_Node(
+                        "transpose", f"{n.name}_to_nchw{oi}",
+                        [(n, oi)], {"axes": _TO_NCHW}))
+                    by_oi[oi] = t
+                    n_t += 1
+                graph.outputs[i] = (t, 0)
+        return n_t
+    # NOTE: interior edges (both endpoints in the region) are never
+    # touched — that is the "minimal transpose set" property: one
+    # transpose per region-crossing data edge, zero inside.

@@ -1,0 +1,293 @@
+"""Level-1 rewrite passes: semantics-preserving graph cleanups.
+
+Four passes, each a true graph→graph rewrite (TVM/Relay's FoldConstant,
+EliminateCommonSubexpr, and DeadCodeElimination are the shapes being
+reproduced over our Symbol IR):
+
+- :class:`ConstantFold`    — input-free deterministic subgraphs are
+  evaluated once at optimize time and replaced with ``_graph_const``
+  nodes (the folded value embeds as an XLA constant; big constants and
+  anything touching rng/train/aux state are left alone);
+- :class:`CommonSubexpr`   — structurally identical pure nodes merge
+  into the first occurrence (variables unify by name);
+- :class:`IdentityElide`   — no-op nodes (``_copy``, ``x+0``, ``x*1``,
+  ``x**1``, ``x/1``, identity transpose, cast-to-same-dtype) are
+  bypassed;
+- :class:`DeadNodeSweep`   — drops every node the earlier passes
+  orphaned (runs LAST; its rewrite count is the census of what the
+  pipeline actually deleted).
+
+Parity class: ``bitwise`` — none of these change the arithmetic of any
+surviving node, and folded subgraphs are evaluated under ``jax.jit`` so
+the constant is produced by the same XLA simplification pipeline the
+unoptimized bulk-mode graph would run through.
+
+Safety rails shared by all passes: rng-consuming and train-dependent
+nodes are untouchable (folding/merging them would change the random
+stream or mode behavior), aux-updating nodes are never merged or
+folded (their hidden outputs write back into executor state), and
+variables are never removed (the optimizer's I/O contract — checked
+again centrally in :func:`mxnet_tpu.opt.optimize_symbol`).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as onp
+
+from ..passes import Finding
+from ..symbol.symbol import _Node
+from .rewrite import MutableGraph, RewritePass, canon_params, entry_key
+
+__all__ = ["ConstantFold", "CommonSubexpr", "IdentityElide",
+           "DeadNodeSweep", "MAX_FOLD_ELEMS"]
+
+# constants bigger than this are not materialized into the graph json
+# (a folded 100M-element tensor as a python list would dwarf the win)
+MAX_FOLD_ELEMS = 1 << 16
+
+_CONST_LEAVES = frozenset({"_sym_zeros", "_sym_ones", "_graph_const"})
+
+
+def _is_pure(node: _Node) -> bool:
+    """True when the node's value depends only on its inputs+params:
+    no rng, no train-mode branch, no aux write-back."""
+    info = node.info
+    if info is None:
+        return False
+    if info.needs_rng or info.needs_train:
+        return False
+    if info.aux_updates_for(node.params):
+        return False
+    return True
+
+
+class ConstantFold(RewritePass):
+    """Evaluate input-free deterministic subgraphs at optimize time."""
+
+    name = "opt.fold"
+    order = 10
+
+    def apply(self, graph: MutableGraph) -> Tuple[int, List[Finding]]:
+        const_vals: Dict[Tuple, object] = {}   # entry_key -> np value
+        # const-leaf entries are registered WITHOUT evaluating (a graph
+        # full of big initializer leaves must not pay a jit compile +
+        # host materialization per leaf per bind when nothing folds);
+        # values are computed lazily, memoized, only when a consumer
+        # actually folds through them — and only for leaves under the
+        # size cap, so an over-cap leaf never even evaluates
+        lazy_leaves: Dict[Tuple, _Node] = {}
+        replaced = 0
+        findings: List[Finding] = []
+        replacements: Dict[Tuple[int, int], Tuple[_Node, int]] = {}
+
+        def leaf_value(key):
+            v = const_vals.get(key)
+            if v is None:
+                v = self._eval(lazy_leaves[key], [])[0]
+                const_vals[key] = v
+            return v
+
+        for node in graph.topo():
+            if node.is_variable or not _is_pure(node):
+                continue
+            if node.op in _CONST_LEAVES:
+                shape = tuple(node.params.get("shape", ()))
+                size = 1
+                for s in shape:
+                    size *= int(s)
+                if size <= MAX_FOLD_ELEMS:
+                    lazy_leaves[(id(node), 0)] = node
+                continue
+            in_keys = [entry_key(e) for e in node.inputs]
+            if not in_keys or not all(
+                    k in const_vals or k in lazy_leaves
+                    for k in in_keys):
+                continue
+            try:
+                vals = self._eval(node,
+                                  [leaf_value(k) for k in in_keys])
+            except Exception as e:  # un-foldable op: leave it in place
+                findings.append(self.rewrite_finding(
+                    "fold-skip", node.name,
+                    f"constant inputs but evaluation failed: "
+                    f"{type(e).__name__}: {str(e)[:80]}"))
+                continue
+            if any(v.size > MAX_FOLD_ELEMS for v in vals):
+                findings.append(self.rewrite_finding(
+                    "fold-skip", node.name,
+                    f"folded value exceeds {MAX_FOLD_ELEMS} elements; "
+                    "left in graph"))
+                continue
+            for i, v in enumerate(vals):
+                cnode = graph.add_node(_Node(
+                    "_graph_const", f"{node.name}_fold{i}", [],
+                    {"data": v.tolist(), "shape": tuple(v.shape),
+                     "dtype": str(v.dtype)}))
+                const_vals[(id(cnode), 0)] = v
+                replacements[(id(node), i)] = (cnode, 0)
+                const_vals[(id(node), i)] = v
+            replaced += 1
+            findings.append(self.rewrite_finding(
+                "fold", node.name,
+                f"folded op '{node.op}' into constant(s) "
+                f"{[tuple(v.shape) for v in vals]}"))
+        if replacements:
+            graph.replace_many(replacements)
+        return replaced, findings
+
+    @staticmethod
+    def _eval(node: _Node, in_vals) -> List[onp.ndarray]:
+        info = node.info
+        params = dict(node.params)
+        params.pop("num_args", None)
+
+        def f(*a):
+            return info.fn(*a, **params)
+
+        # jit the evaluation: the constant is produced by the same XLA
+        # simplification pipeline the unoptimized (bulk-mode, jitted)
+        # graph would apply to this subexpression — the bitwise-parity
+        # contract of the level-1 pipeline
+        out = jax.jit(f)(*[jax.numpy.asarray(v) for v in in_vals])
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        return [onp.asarray(o) for o in outs]
+
+
+class CommonSubexpr(RewritePass):
+    """Merge structurally identical pure nodes (CSE)."""
+
+    name = "opt.cse"
+    order = 20
+
+    def apply(self, graph: MutableGraph) -> Tuple[int, List[Finding]]:
+        seen: Dict[Tuple, _Node] = {}
+        merged = 0
+        findings: List[Finding] = []
+        changed = True
+        while changed:  # merging can expose new congruences upstream
+            changed = False
+            replacements: Dict[Tuple[int, int], Tuple[_Node, int]] = {}
+            for node in graph.topo():
+                if node.is_variable or not _is_pure(node):
+                    continue
+                key = (node.op, canon_params(node.params),
+                       tuple(entry_key(e) for e in node.inputs))
+                rep = seen.get(key)
+                if rep is None or rep is node:
+                    seen[key] = node
+                    continue
+                for i in range(node._n_out):
+                    replacements[(id(node), i)] = (rep, i)
+                merged += 1
+                findings.append(self.rewrite_finding(
+                    "cse", node.name,
+                    f"merged duplicate '{node.op}' into "
+                    f"'{rep.name}'"))
+                changed = True
+            if replacements:
+                graph.replace_many(replacements)
+                seen.clear()  # entry identities changed; rebuild keys
+        return merged, findings
+
+
+# elidable scalar-arithmetic no-ops: op -> (param, neutral value)
+_SCALAR_NOOPS = {
+    "_plus_scalar": ("scalar", 0.0),
+    "_minus_scalar": ("scalar", 0.0),
+    "_mul_scalar": ("scalar", 1.0),
+    "_div_scalar": ("scalar", 1.0),
+    "_power_scalar": ("scalar", 1.0),
+}
+
+
+class IdentityElide(RewritePass):
+    """Bypass no-op nodes, re-pointing consumers at their input."""
+
+    name = "opt.elide"
+    order = 30
+
+    def apply(self, graph: MutableGraph) -> Tuple[int, List[Finding]]:
+        elided = 0
+        findings: List[Finding] = []
+        replacements: Dict[Tuple[int, int], Tuple[_Node, int]] = {}
+        for node in graph.topo():
+            if node.is_variable or not node.inputs:
+                continue
+            if not self._is_noop(node):
+                continue
+            replacements[(id(node), 0)] = node.inputs[0]
+            elided += 1
+            findings.append(self.rewrite_finding(
+                "elide", node.name,
+                f"elided no-op '{node.op}' "
+                f"({self._why(node)})"))
+        if replacements:
+            graph.replace_many(replacements)
+        return elided, findings
+
+    @staticmethod
+    def _provable_dtype(entry) -> str:
+        """The entry's dtype when statically certain, else ''."""
+        node, _oi = entry
+        if node.is_variable:
+            return str(node.attrs.get("__dtype__") or "")
+        if node.op in ("cast", "Cast", "amp_cast") \
+                or node.op in _CONST_LEAVES:
+            d = node.params.get("dtype")
+            return str(onp.dtype(d)) if d is not None else ""
+        return ""
+
+    def _is_noop(self, node: _Node) -> bool:
+        op, p = node.op, node.params
+        if op == "_copy":
+            return True
+        spec = _SCALAR_NOOPS.get(op)
+        if spec is not None:
+            pname, neutral = spec
+            try:
+                return float(p.get(pname, None)) == neutral
+            except (TypeError, ValueError):
+                return False
+        if op == "transpose":
+            axes = p.get("axes")
+            return bool(axes) and tuple(axes) == tuple(range(len(axes)))
+        if op in ("cast", "Cast", "amp_cast"):
+            tgt = p.get("dtype")
+            if tgt is None:
+                return False
+            src = self._provable_dtype(node.inputs[0])
+            return bool(src) and onp.dtype(src) == onp.dtype(tgt)
+        return False
+
+    @staticmethod
+    def _why(node: _Node) -> str:
+        if node.op == "_copy":
+            return "identity copy"
+        if node.op == "transpose":
+            return "identity permutation"
+        if node.op in ("cast", "Cast", "amp_cast"):
+            return "cast to the input's own dtype"
+        return f"neutral scalar {node.params.get('scalar')}"
+
+
+class DeadNodeSweep(RewritePass):
+    """Collect nodes orphaned by earlier passes (mark-and-sweep DCE).
+
+    Runs LAST (order 90): elision/CSE/fusion re-point consumers and
+    deliberately leave the bypassed producers dangling; this pass is
+    the one place they are counted and dropped. It also catches dead
+    nodes present in the INPUT graph (e.g. a deserialized json with
+    unreferenced nodes)."""
+
+    name = "opt.dce"
+    order = 90
+
+    def apply(self, graph: MutableGraph) -> Tuple[int, List[Finding]]:
+        n = graph.sweep()
+        findings = []
+        if n:
+            findings.append(self.rewrite_finding(
+                "dce", "<graph>", f"swept {n} dead node(s)"))
+        return n, findings

@@ -1,0 +1,204 @@
+"""Rewrite-pass infrastructure: a mutable working copy of a Symbol DAG.
+
+The analysis passes in ``mxnet_tpu/passes/`` are read-only by contract;
+a rewrite pipeline needs the opposite — a graph it can freely mutate
+without touching the user's Symbol (whose ``_Node`` objects may be
+shared with other Symbols via composition). :class:`MutableGraph` is
+that working copy: it clones the node DAG once, gives passes the
+consumer map and entry-replacement primitives they need, and converts
+back to a fresh :class:`~mxnet_tpu.symbol.symbol.Symbol` at the end
+(ref: nnvm passes return a NEW Graph for the same isolation reason;
+TVM/Relay's transform.Sequential is the shape of the pipeline).
+
+:class:`RewritePass` extends the :class:`~mxnet_tpu.passes.Pass`
+skeleton so rewrite passes ride the same PassManager registry/ordering
+and emit the same structured Findings as the linters — ``tools/mxlint
+.py --opt`` reports what fired through the identical schema — but their
+``apply(graph)`` entry point is *allowed* to mutate its MutableGraph
+target (the read-only ``run`` contract stays true for analysis passes;
+rewriters override ``apply`` instead).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..passes import Finding, Pass
+from ..symbol.symbol import Symbol, _Node
+
+__all__ = ["MutableGraph", "RewritePass", "canon_params", "entry_key"]
+
+
+Entry = Tuple[_Node, int]
+
+
+def canon_params(params: dict) -> tuple:
+    """Hashable canonical form of a node's param dict (CSE keys,
+    fusion-group signatures). Scalars are tagged with their python
+    type so 0, 0.0 and False never alias — jax's weak-type promotion
+    makes int-vs-float params semantically different (``x ** 2`` stays
+    int where ``x ** 2.0`` promotes), and Python's ``0 == 0.0 ==
+    False`` would otherwise collapse them into one CSE key."""
+
+    def c(v):
+        if isinstance(v, dict):
+            return ("d",) + tuple(sorted((k, c(x)) for k, x in v.items()))
+        if isinstance(v, (list, tuple)):
+            return ("t",) + tuple(c(x) for x in v)
+        if isinstance(v, (int, float, str, bool, type(None))):
+            return (type(v).__name__, v)
+        return ("r", repr(v))  # initializer objects etc.
+
+    return c(params or {})
+
+
+def entry_key(entry: Entry):
+    node, oi = entry
+    if node.is_variable:
+        return ("var", node.name)
+    return (id(node), oi)
+
+
+class MutableGraph:
+    """A privately-cloned, freely-mutable copy of a Symbol's DAG.
+
+    Invariants the pipeline relies on:
+
+    - every node reachable from ``outputs`` was cloned by THIS graph
+      (mutations can never leak into the source Symbol);
+    - ``known_nodes`` remembers every node the graph has ever held, so
+      the DCE sweep can report how many a preceding pass orphaned;
+    - variables are identified by NAME (two variable nodes with one
+      name are one binding — eval_graph keys the value map by name).
+    """
+
+    def __init__(self, symbol: Symbol):
+        self._clone_map: Dict[int, _Node] = {}
+        self.outputs: List[Entry] = [
+            (self._clone(n), oi) for n, oi in symbol._outputs]
+        self.known_nodes: Dict[int, _Node] = {
+            id(n): n for n in self.topo()}
+
+    def _clone(self, node: _Node) -> _Node:
+        got = self._clone_map.get(id(node))
+        if got is not None:
+            return got
+        inputs = [(self._clone(i), oi) for i, oi in node.inputs]
+        new = _Node(node.op, node.name, inputs, dict(node.params),
+                    dict(node.attrs))
+        new._n_out = node._n_out
+        self._clone_map[id(node)] = new
+        return new
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def topo(self) -> List[_Node]:
+        """Reachable nodes in the same DFS postorder eval_graph uses."""
+        return Symbol(self.outputs)._topo_nodes()
+
+    def consumers(self) -> Dict[int, List[Tuple[_Node, int]]]:
+        """{id(producer): [(consumer, input_position)]} over the
+        reachable graph. Recompute after structural edits."""
+        out: Dict[int, List[Tuple[_Node, int]]] = {}
+        for n in self.topo():
+            for pos, (inp, _oi) in enumerate(n.inputs):
+                out.setdefault(id(inp), []).append((n, pos))
+        return out
+
+    def use_counts(self) -> Dict[int, int]:
+        counts: Dict[int, int] = {}
+        for n in self.topo():
+            for inp, _oi in n.inputs:
+                counts[id(inp)] = counts.get(id(inp), 0) + 1
+        for n, _oi in self.outputs:
+            counts[id(n)] = counts.get(id(n), 0) + 1
+        return counts
+
+    # ------------------------------------------------------------------
+    # mutation primitives
+    # ------------------------------------------------------------------
+    def add_node(self, node: _Node) -> _Node:
+        self.known_nodes[id(node)] = node
+        return node
+
+    def replace_entry(self, old: Entry, new: Entry):
+        """Re-point every consumer of ``old`` (and any head) at
+        ``new``. The orphaned producer is left for the DCE sweep."""
+        onode, ooi = old
+        for n in self.topo():
+            n.inputs = [
+                new if (i is onode and oi == ooi) else (i, oi)
+                for i, oi in n.inputs]
+        self.outputs = [
+            new if (n is onode and oi == ooi) else (n, oi)
+            for n, oi in self.outputs]
+
+    def replace_many(self, mapping: Dict[Tuple[int, int], Entry]):
+        """Bulk entry replacement: {(id(node), out_idx): new_entry}.
+        One traversal, applied transitively (a replacement target that
+        is itself replaced resolves to the final entry)."""
+
+        def resolve(entry: Entry) -> Entry:
+            seen = set()
+            while True:
+                k = (id(entry[0]), entry[1])
+                nxt = mapping.get(k)
+                if nxt is None or k in seen:
+                    return entry
+                seen.add(k)
+                entry = nxt
+
+        for n in self.topo():
+            n.inputs = [resolve(e) for e in n.inputs]
+        self.outputs = [resolve(e) for e in self.outputs]
+
+    def sweep(self) -> int:
+        """Drop orphaned nodes from ``known_nodes``; returns how many
+        were swept (the DCE rewrite count)."""
+        reachable = {id(n) for n in self.topo()}
+        dead = [k for k in self.known_nodes if k not in reachable]
+        for k in dead:
+            del self.known_nodes[k]
+        return len(dead)
+
+    def refresh(self):
+        """Re-sync ``known_nodes`` with reachability (after passes that
+        add nodes), keeping newly added reachable nodes known."""
+        for n in self.topo():
+            self.known_nodes.setdefault(id(n), n)
+
+    # ------------------------------------------------------------------
+    def to_symbol(self) -> Symbol:
+        return Symbol(list(self.outputs))
+
+    def node_count(self) -> int:
+        return len(self.topo())
+
+
+class RewritePass(Pass):
+    """A graph→graph transform over a :class:`MutableGraph`.
+
+    Subclasses set ``name``/``order``/``min_level`` and implement
+    ``apply(graph) -> (n_rewrites, [Finding])``. ``run`` adapts the
+    PassManager calling convention (and keeps analysis callers working:
+    a RewritePass run against a plain Symbol wraps it first, which
+    preserves the no-mutation contract for the caller's object)."""
+
+    #: lowest MXNET_GRAPH_OPT level at which the pass participates
+    min_level = 1
+    #: parity guarantee of this pass's rewrites (see opt/verify.py):
+    #: "bitwise" unless the rewrite reorders a contraction
+    tolerance_class = "bitwise"
+
+    def apply(self, graph: MutableGraph) -> Tuple[int, List[Finding]]:
+        raise NotImplementedError
+
+    def run(self, target) -> List[Finding]:
+        g = target if isinstance(target, MutableGraph) \
+            else MutableGraph(target)
+        _n, findings = self.apply(g)
+        return findings
+
+    def rewrite_finding(self, check: str, obj: str, message: str,
+                        severity: str = "info") -> Finding:
+        return self.finding(check, obj, severity, message)

@@ -1,0 +1,152 @@
+"""Parity verification: optimized graph vs. its source, under one rng.
+
+Every rewrite the optimizer ships is either *bitwise* (level-1 cleanups
+— no surviving node's arithmetic changes) or *tolerance-tagged* (level-2
+fusion/layout — contraction order legitimately changes, exactly the
+PR-5 fused-step discipline). This module is the one place both claims
+are checked: evaluate the original and the optimized graph as jitted
+programs over the SAME value map and the SAME fixed rng key, and
+compare outputs and aux updates under the declared tolerance class.
+
+Used three ways: the bind-time ``MXNET_GRAPH_OPT_VERIFY`` gate
+(Executor hands in its live buffers; a failed check reverts to the
+unoptimized graph and records ``graph_opt_verify_failures_total``),
+``tools/mxlint.py --opt`` round-trip self-check, and the property-style
+suite in tests/test_graph_opt.py.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as onp
+
+from ..symbol.symbol import Symbol, eval_graph, _infer_all_shapes
+
+__all__ = ["TOLERANCE_CLASSES", "tolerance_for", "strongest_class",
+           "parity_check", "random_value_map", "executor_value_map"]
+
+# class -> (rtol, atol) for float32; half-precision inputs widen 100x.
+# "bitwise" compares exact. Order below is weakest-guarantee-last; a
+# pipeline's aggregate class is the strongest-indexed class that fired.
+TOLERANCE_CLASSES: Dict[str, Tuple[float, float]] = {
+    "bitwise": (0.0, 0.0),
+    "layout": (2e-5, 1e-6),   # conv/pool reduce order changes
+    "fusion": (2e-5, 1e-6),   # fused contraction / online softmax
+}
+_CLASS_ORDER = ("bitwise", "layout", "fusion")
+
+
+def strongest_class(classes) -> str:
+    worst = 0
+    for c in classes:
+        worst = max(worst, _CLASS_ORDER.index(c))
+    return _CLASS_ORDER[worst]
+
+
+def tolerance_for(cls: str, dtype=None) -> Tuple[float, float]:
+    rtol, atol = TOLERANCE_CLASSES[cls]
+    if dtype is not None and onp.dtype(dtype).itemsize < 4:
+        rtol, atol = rtol * 100, atol * 100
+    return rtol, atol
+
+
+def random_value_map(symbol: Symbol, shapes: Optional[Dict] = None,
+                     seed: int = 0) -> Dict[str, onp.ndarray]:
+    """Deterministic random bindings for every argument/aux of
+    ``symbol``; ``shapes`` seeds inference for underdetermined
+    inputs (same contract as ``simple_bind`` kwargs)."""
+    known = {k: tuple(v) for k, v in (shapes or {}).items()}
+    inferred = _infer_all_shapes(symbol, known)
+    rng = onp.random.RandomState(seed)
+    aux = set(symbol.list_auxiliary_states())
+    vm = {}
+    for name in symbol.list_arguments() + sorted(aux):
+        shape = inferred.get(name)
+        if shape is None:
+            raise ValueError(
+                f"cannot infer a probe shape for '{name}'; pass it in "
+                f"shapes=")
+        # aux states are variances/means: keep them positive so eval
+        # never manufactures NaNs the comparison must then excuse
+        lo, hi = (0.5, 1.5) if name in aux else (-1.0, 1.0)
+        vm[name] = rng.uniform(lo, hi, size=shape).astype("float32")
+    return vm
+
+
+def executor_value_map(arg_dict, aux_dict) -> Dict[str, onp.ndarray]:
+    """Bind-time verify probes from an executor's LIVE buffers.
+
+    A buffer that is entirely zeros (the simple_bind default) would
+    make the parity check vacuous — zero activations produce zero
+    batch stats no matter what a rewrite broke — so all-zero buffers
+    are swapped for seeded random probes (positive for aux: variances
+    must stay valid). Real user-bound data is used as is."""
+    rng = onp.random.RandomState(0xC0FFEE)
+    out: Dict[str, onp.ndarray] = {}
+    for is_aux, d in ((False, arg_dict), (True, aux_dict)):
+        for name, arr in d.items():
+            v = onp.asarray(arr._data if hasattr(arr, "_data") else arr)
+            if v.size and not v.any():
+                lo, hi = (0.5, 1.5) if is_aux else (-1.0, 1.0)
+                v = rng.uniform(lo, hi, v.shape).astype(v.dtype)
+            out[name] = v
+    return out
+
+
+def _run(symbol: Symbol, vm, training: bool):
+    arrays = {k: jax.numpy.asarray(v) for k, v in vm.items()}
+    rng_raw = jax.random.key_data(jax.random.key(0))
+
+    def f(values, rng):
+        return eval_graph(symbol, values, training, rng)
+
+    outs, aux = jax.jit(f, static_argnums=())(arrays, rng_raw)
+    return ([onp.asarray(o) for o in outs],
+            {k: onp.asarray(v) for k, v in aux.items()})
+
+
+def parity_check(original: Symbol, optimized: Symbol,
+                 value_map: Dict[str, onp.ndarray],
+                 training: bool = False,
+                 tol_class: str = "bitwise") -> Tuple[bool, List[str]]:
+    """Compare the two graphs on one value map; returns (ok, problems).
+
+    Problems name the output index / aux key and the observed error so
+    a verify failure is actionable, not just boolean."""
+    outs_a, aux_a = _run(original, value_map, training)
+    outs_b, aux_b = _run(optimized, value_map, training)
+    problems: List[str] = []
+    if len(outs_a) != len(outs_b):
+        return False, [f"output arity {len(outs_a)} != {len(outs_b)}"]
+
+    def compare(tag, a, b):
+        if a.shape != b.shape:
+            problems.append(f"{tag}: shape {a.shape} != {b.shape}")
+            return
+        rtol, atol = tolerance_for(tol_class, a.dtype)
+        if rtol == 0.0 and atol == 0.0:
+            if not onp.array_equal(a, b, equal_nan=True):
+                bad = int(onp.sum(a != b))
+                err = onp.max(onp.abs(a.astype("f8") - b.astype("f8")))
+                problems.append(
+                    f"{tag}: {bad}/{a.size} elements differ bitwise "
+                    f"(max abs err {err:.3e})")
+        elif not onp.allclose(a, b, rtol=rtol, atol=atol,
+                              equal_nan=True):
+            err = onp.max(onp.abs(a.astype("f8") - b.astype("f8")))
+            problems.append(
+                f"{tag}: max abs err {err:.3e} exceeds class "
+                f"'{tol_class}' (rtol={rtol}, atol={atol})")
+
+    for i, (a, b) in enumerate(zip(outs_a, outs_b)):
+        compare(f"output[{i}]", onp.asarray(a), onp.asarray(b))
+    if set(aux_a) != set(aux_b):
+        problems.append(
+            f"aux-update keys differ: {sorted(aux_a)} != "
+            f"{sorted(aux_b)}")
+    else:
+        for k in aux_a:
+            compare(f"aux[{k}]", onp.asarray(aux_a[k]),
+                    onp.asarray(aux_b[k]))
+    return not problems, problems

@@ -383,6 +383,34 @@ class SGD(Optimizer):
             _assign(weight, new_w)
             _assign(state, new_mom)
 
+    def update_multi_precision(self, index, weight, grad, state):
+        """Dense fp16-weight updates take the fused mp_sgd kernels:
+        master update + momentum + low-precision cast in ONE dispatch
+        (and, on TPU, one Pallas kernel — the optimizer+cast fusion
+        XLA won't do; mxnet_tpu/opt/kernels.py) instead of the base
+        class's update-then-cast pair. Sparse grads keep the lazy
+        row-wise path."""
+        _idx, _gv, sparse = _rowsparse_parts(grad)
+        if not (self.multi_precision and weight.dtype == onp.float16) \
+                or sparse:
+            return super().update_multi_precision(index, weight, grad,
+                                                  state)
+        w32, mom = state
+        lr, wd, clip = self._common(index)
+        if mom is None:
+            new_w, new_w32 = invoke(
+                _jk(oops.mp_sgd_update), [weight, grad, w32], n_out=2,
+                lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=clip)
+        else:
+            new_w, new_m, new_w32 = invoke(
+                _jk(oops.mp_sgd_mom_update), [weight, grad, mom, w32],
+                n_out=3, lr=lr, momentum=self.momentum, wd=wd,
+                rescale_grad=self.rescale_grad, clip_gradient=clip)
+            _assign(mom, new_m)
+        _assign(weight, new_w)
+        _assign(w32, new_w32)
+
     def fused_apply(self, indices, weights, grads, states, lrs, wds):
         """Functional multi-tensor SGD over raw arrays (ref:
         optimizer_op.cc multi_sgd_update / multi_sgd_mom_update) —

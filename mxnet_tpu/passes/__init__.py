@@ -83,6 +83,13 @@ class Pass:
     """
 
     name = "pass"
+    #: Explicit pipeline-ordering key (lower runs first). Ties break by
+    #: registration sequence, so a pipeline's execution order is a pure
+    #: function of the (order, registration) pairs — reproducible across
+    #: runs and hosts. Analysis passes keep the default; rewrite
+    #: pipelines (mxnet_tpu/opt/) assign explicit keys because their
+    #: passes compose (elision leaves dangling nodes that DCE sweeps).
+    order = 100
 
     def run(self, target) -> List[Finding]:
         raise NotImplementedError
@@ -96,15 +103,29 @@ class PassManager:
     """Registry + runner for analysis passes (ref: nnvm::ApplyPasses).
 
     Passes register under a name; ``run(names, target)`` applies each to
-    the target and concatenates findings. Later transform passes can hook
-    the same registry — the manager is analysis-only today but keeps the
-    (name → pass) indirection the optimiser work will need.
+    the target and concatenates findings. Execution order is governed by
+    the explicit ``Pass.order`` key (``ordered_names()``/``run_all``):
+    ascending key, ties broken by registration sequence — never by dict
+    or hash iteration order, so a pipeline is reproducible across runs.
+    The graph optimizer (mxnet_tpu/opt/) hooks this same registry with
+    *rewrite* passes whose relative order is load-bearing (fold before
+    CSE before elision before the DCE sweep).
     """
 
     def __init__(self):
         self._passes: Dict[str, Pass] = {}
+        self._seq: Dict[str, int] = {}  # name -> registration index
+        self._next_seq = 0
 
-    def register(self, p: Pass) -> Pass:
+    def register(self, p: Pass, order: Optional[int] = None) -> Pass:
+        """Register ``p``; ``order`` overrides the pass's own ``order``
+        attribute. Re-registering a name replaces the pass but keeps its
+        original registration index (a pipeline rebuild stays stable)."""
+        if order is not None:
+            p.order = order
+        if p.name not in self._seq:
+            self._seq[p.name] = self._next_seq
+            self._next_seq += 1
         self._passes[p.name] = p
         return p
 
@@ -117,11 +138,24 @@ class PassManager:
     def names(self) -> List[str]:
         return sorted(self._passes)
 
+    def ordered_names(self) -> List[str]:
+        """Pipeline execution order: ascending ``order`` key, ties by
+        registration sequence. This — not ``names()``, which is
+        alphabetical for display — is the order ``run_all`` applies
+        passes in, and it is deterministic across runs by construction
+        (no dict/hash iteration order involved)."""
+        return sorted(self._passes,
+                      key=lambda n: (self._passes[n].order, self._seq[n]))
+
     def run(self, names: Iterable[str], target) -> List[Finding]:
         out: List[Finding] = []
         for n in names:
             out.extend(self.get(n).run(target))
         return out
+
+    def run_all(self, target) -> List[Finding]:
+        """Apply every registered pass in ``ordered_names()`` order."""
+        return self.run(self.ordered_names(), target)
 
 
 def topo_walk(symbol):

@@ -122,7 +122,7 @@ def lint_symbol(symbol, p: Optional[GraphLint] = None) -> List[Finding]:
             if inp.name not in aux_vars:
                 continue
             aux_positions = set(
-                (info.aux_updates or {}).values()) if info else set()
+                info.aux_updates_for(n.params).values()) if info else set()
             if pos not in aux_positions:
                 findings.append(p.finding(
                     "aux-misuse", inp.name, "error",

@@ -161,7 +161,11 @@ class OpRegistryAudit(Pass):
                         f"{[a for a in info.arg_names if a != '*']}); the "
                         f"symbol layer auto-creates variables from stale "
                         f"names"))
-        for out_idx, in_idx in (info.aux_updates or {}).items():
+        au = info.aux_updates
+        if callable(au):
+            au = {}  # param-dependent (e.g. _fused_group): range checks
+            # need a concrete node's params — graphlint covers those
+        for out_idx, in_idx in (au or {}).items():
             if info.n_out != -1 and not (0 <= out_idx < info.n_out):
                 out.append(self.finding(
                     "aux-range", name, "error",

@@ -166,6 +166,7 @@ class ServingEngine:
         self._staging: Dict[Tuple, List[Optional[onp.ndarray]]] = {}
         self._staging_flip: Dict[Tuple, int] = {}
         self._warmup_report: List[dict] = []
+        self._opt_summary: Optional[dict] = None  # graph-opt (executor)
         self._after_warmup_count = 0  # per-engine; the registry counter
         # below is the process-global aggregate across all engines
         self._m_after = _metrics.counter(
@@ -437,6 +438,26 @@ class ServingEngine:
             "mxserve_programs_compiled",
             "distinct serving programs in the jit cache"
         ).set(len(self._seen_programs))
+        # graph-optimizer visibility (MXNET_GRAPH_OPT): executor-kind
+        # engines compile the OPTIMIZED graph per rung (Executor binds
+        # run the rewrite pipeline); surface what fired so a serving
+        # deployment can see its AOT programs were optimized — and at
+        # which level — without digging into the executors.
+        if self._kind == "executor":
+            reps = [e.opt_report for e in
+                    list(self._execs.values()) + [self.model]
+                    if getattr(e, "opt_report", None) is not None]
+            if reps:
+                _metrics.gauge(
+                    "mxserve_graph_opt_level",
+                    "MXNET_GRAPH_OPT level of the warmed serving "
+                    "programs").set(reps[0].level)
+                self._opt_summary = {
+                    "level": reps[0].level,
+                    "tolerance_class": reps[0].tolerance_class,
+                    "rewrites": sum(r.total_rewrites for r in reps),
+                    "fused_census": reps[0].fused_census,
+                }
         return report
 
     @property
@@ -547,6 +568,8 @@ class ServingEngine:
         if self._pad_n:
             out["avg_padding_ratio"] = round(
                 self._pad_sum / self._pad_n, 4)
+        if getattr(self, "_opt_summary", None):
+            out["graph_opt"] = dict(self._opt_summary)
         if self.batcher is not None:
             out["batcher"] = self.batcher.stats()
         return out

@@ -114,6 +114,8 @@ class StepFunction:
         self._donate = bool(donate) and jax.default_backend() != "cpu"
         self._cache = {}
         self._last = None  # (jitted fn, key) of the newest compile
+        self._opt_report = None  # graph-optimizer report (symbol mode)
+        self._opt_level = 0
 
         if trainer is not None:
             if optimizer_params or optimizer != "sgd":
@@ -173,6 +175,22 @@ class StepFunction:
     # ------------------------------------------------------------------
     def _init_symbol(self, sym, arg_dict, aux_dict, input_names,
                      grad_names):
+        # bind-time graph optimization (MXNET_GRAPH_OPT): the fused
+        # step traces the OPTIMIZED symbol — and because the rewrite
+        # pipeline preserves the binding surface, the sharded subclass
+        # composes unchanged (same in/out shardings over the optimized
+        # graph; the plan never names interior nodes). The report is
+        # keyed into _shard_key so flipping the level between
+        # constructions can never alias a cached program.
+        from ..base import get_env
+        self._opt_report = None
+        self._opt_level = 0
+        if get_env("MXNET_GRAPH_OPT", 0):
+            from ..opt import optimize_symbol, opt_level
+            self._opt_level = opt_level()
+            sym, self._opt_report = optimize_symbol(
+                sym, where=f"StepFunction:{self._name}")
+            self._net = sym
         self._input_names = tuple(input_names)
         missing = [n for n in sym.list_arguments()
                    if n not in arg_dict and n not in self._input_names]
@@ -421,7 +439,7 @@ class StepFunction:
         # Parameter.cast retrace VISIBLY (counted as misses, recorded
         # by the recompile auditor) instead of silently
         key = (tuple((tuple(v.shape), str(v.dtype)) for v in inputs),
-               self._param_dtypes(),
+               self._param_dtypes(), self._opt_level,
                self._optimizer.fused_signature()) + self._shard_key()
         fn = self._cache.get(key)
         if fn is None:
@@ -477,6 +495,12 @@ class StepFunction:
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
+    @property
+    def opt_report(self):
+        """Graph-optimizer report for symbol mode (None when off or in
+        block mode — the optimizer works on the Symbol IR)."""
+        return self._opt_report
+
     def cache_info(self) -> Dict[str, int]:
         from ..telemetry import metrics as _metrics
         return {

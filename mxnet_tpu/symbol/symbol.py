@@ -115,9 +115,10 @@ class Symbol:
             if n.op is None:
                 continue
             info = n.info
-            if not info.aux_updates:
+            au = info.aux_updates_for(n.params)
+            if not au:
                 continue
-            aux_positions = set(info.aux_updates.values())
+            aux_positions = set(au.values())
             for pos, (inp, _) in enumerate(n.inputs):
                 if pos in aux_positions and inp.is_variable \
                         and inp.name not in aux:
@@ -642,7 +643,7 @@ def eval_graph(symbol: Symbol, value_map: Dict[str, "jax.Array"],
             outs = list(out) if isinstance(out, (tuple, list)) else [out]
             for i, o in enumerate(outs):
                 values[(id(node), i)] = o
-            for out_idx, in_idx in info.aux_updates.items():
+            for out_idx, in_idx in info.aux_updates_for(node.params).items():
                 src, _ = node.inputs[in_idx]
                 if src.is_variable:
                     aux_updates[src.name] = outs[out_idx]

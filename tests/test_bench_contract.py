@@ -41,3 +41,41 @@ def test_bench_emits_parseable_json_line():
     # after warmup — zero recompiles across the timed steps
     assert data["fused_step"] is True
     assert data["recompiles_after_step2"] == 0, data
+
+
+@pytest.mark.slow
+def test_bench_graph_opt_emits_mxopt_speedup():
+    """--graph-opt contract: one mxopt_speedup JSON line with the
+    per-level series (step time, rewrites, census) for both bench
+    models, and ZERO recompiles across the interleaved timed phase at
+    every level."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_GRAPHOPT_STEPS": "3",
+        "MXTPU_BENCH_GRAPHOPT_BATCH": "4",
+        "MXTPU_BENCH_TIMEOUT": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--graph-opt"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxopt_speedup"
+    assert data["value"] is not None and data["value"] > 0, data
+    models = {s["model"]: s for s in data["series"]}
+    assert set(models) == {"resnet", "lm"}
+    for s in models.values():
+        assert s["recompiles_after_warmup"] == 0, s
+        by_level = {r["level"]: r for r in s["levels"]}
+        assert set(by_level) == {0, 1, 2}
+        assert by_level[0]["rewrites"] == 0
+        assert by_level[2]["rewrites"] > 0
+        assert all(r["step_s"] > 0 for r in s["levels"])
+    assert models["resnet"]["levels"][2]["fused_census"].get(
+        "conv_bn_relu", 0) >= 1
+    assert models["lm"]["levels"][2]["fused_census"].get(
+        "attention", 0) >= 1

@@ -39,9 +39,30 @@ def _sym_identity():
     return (x + 0.0)
 
 
+def _fused_group_case():
+    """A tiny relu chain serialized the way the graph optimizer's
+    fusion pass emits groups (opt/fuse.py)."""
+    from mxnet_tpu import sym
+    x = sym.var("_fg_in0")
+    g = sym.Activation(x + 1.0, act_type="relu")
+    return ([T(2, 3)], {"graph": g.tojson(), "pattern": "sweep",
+                        "num_outputs": 1})
+
+
 # curated inputs: name -> lambda returning (args, params)
 CASES = {
     "pick": lambda: ([T(4, 5), I(4, hi=5)], {}),
+    "_graph_const": lambda: ([], {"data": [[1.0, 2.0], [3.0, 4.0]],
+                                  "shape": (2, 2), "dtype": "float32"}),
+    "_fused_group": _fused_group_case,
+    "_fused_attention": lambda: ([T(2, 2, 8, 4), T(2, 2, 8, 4),
+                                  T(2, 2, 8, 4)], {"scale": 0.5}),
+    "_nhwc_conv": lambda: ([T(1, 6, 6, 3), T(4, 3, 3, 3), T(4)],
+                           {"kernel": (3, 3), "num_filter": 4,
+                            "pad": (1, 1)}),
+    "_nhwc_pool": lambda: ([T(1, 6, 6, 3)],
+                           {"kernel": (2, 2), "stride": (2, 2),
+                            "pool_type": "max"}),
     "_cvimresize": lambda: ([T(4, 5, 3)], {"w": 8, "h": 6}),
     "dot": lambda: ([T(3, 4), T(4, 5)], {}),
     "batch_dot": lambda: ([T(2, 3, 4), T(2, 4, 5)], {}),

@@ -83,6 +83,90 @@ def _selfcheck_shard_findings():
     return lint_shard_report(fused.shard_report(x, y))
 
 
+def _selfcheck_opt_findings():
+    """Graph-optimizer self-check: run the level-2 rewrite pipeline on
+    a fixture graph that exercises every pass (const subexpression,
+    duplicate branch, scalar no-ops, conv+bn+relu, attention), verify
+    the optimized graph round-trips (json) and matches the original
+    under the declared tolerance class, and report per-pass rewrite
+    counts — the optimizer's analog of the --shard self-check."""
+    import numpy as onp
+    from mxnet_tpu import sym
+    from mxnet_tpu.opt import (optimize_symbol, parity_check,
+                               random_value_map)
+    from mxnet_tpu.passes import Finding
+
+    x = sym.var("data")
+    c = (sym.ones((1, 8)) * 3.0 + 2.0) / 7.0       # fold
+    n = sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="c1")                  # layout + fuse
+    n = sym.BatchNorm(n, name="bn1")
+    n = sym.Activation(n, act_type="relu", name="r1")
+    n = sym.Pooling(n, global_pool=True, pool_type="avg", name="gap")
+    n = sym.Flatten(n)
+    fc1 = sym.FullyConnected(n, num_hidden=8, name="fc1")
+    a1 = sym.Activation(fc1, act_type="relu", name="a1")
+    a2 = sym.Activation(fc1, act_type="relu", name="a2")  # cse
+    net = sym.broadcast_add((a1 + 0.0) * 1.0, a2)         # elide
+    net = sym.broadcast_add(net, c)
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+
+    optimized, report = optimize_symbol(net, level=2,
+                                        where="<self-check opt>")
+    findings = list(report.findings)
+    fired = {p["pass"]: p["rewrites"] for p in report.passes}
+    for pname in ("opt.fold", "opt.cse", "opt.elide", "opt.layout",
+                  "opt.fuse", "opt.dce"):
+        if not fired.get(pname):
+            findings.append(Finding(
+                pname, "selfcheck-coverage", "<self-check opt>",
+                "error", "pass applied no rewrites on the fixture "
+                         "built to trigger it"))
+    # round-trip: the optimized graph must serialize and reload
+    from mxnet_tpu.symbol.symbol import load_json
+    reloaded = load_json(optimized.tojson())
+    vm = random_value_map(net, {"data": (2, 3, 8, 8)})
+    for tag, graph in (("optimized", optimized),
+                       ("reloaded", reloaded)):
+        for training in (False, True):
+            ok, problems = parity_check(
+                net, graph, vm, training=training,
+                tol_class=report.tolerance_class)
+            if not ok:
+                findings.append(Finding(
+                    "opt.pipeline", "selfcheck-parity",
+                    f"<{tag} train={training}>", "error",
+                    "; ".join(problems)[:300]))
+    # the bind-time gate itself (this is what the MXNET_GRAPH_OPT_VERIFY
+    # flag doc points at): an Executor bind with the gate on must run
+    # the live-buffer parity check in both modes and accept the graph
+    from mxnet_tpu import config
+    config.set_flag("MXNET_GRAPH_OPT", 2)
+    config.set_flag("MXNET_GRAPH_OPT_VERIFY", True)
+    try:
+        ex = net.simple_bind(grad_req="null", data=(2, 3, 8, 8))
+        if ex.opt_report is None or ex.opt_report.verified is not True:
+            findings.append(Finding(
+                "opt.pipeline", "selfcheck-bind-verify",
+                "<self-check opt>", "error",
+                f"bind-time verify gate did not accept the optimized "
+                f"graph (report: "
+                f"{ex.opt_report and ex.opt_report.reverted})"))
+    finally:
+        config.unset_flag("MXNET_GRAPH_OPT")
+        config.unset_flag("MXNET_GRAPH_OPT_VERIFY")
+    summary = ", ".join(f"{k.split('.')[-1]}={v}"
+                        for k, v in sorted(fired.items()))
+    findings.append(Finding(
+        "opt.pipeline", "selfcheck-summary", "<self-check opt>",
+        "info",
+        f"level 2: {report.nodes_before}->{report.nodes_after} nodes, "
+        f"rewrites {summary}, census {report.fused_census}, "
+        f"class {report.tolerance_class} (bind-time verify gate "
+        f"exercised)"))
+    return findings
+
+
 def _selfcheck_block_findings():
     """tracercheck over a small hybridized block — a clean forward must
     produce no tracer findings."""
@@ -112,6 +196,12 @@ def main(argv=None):
                    help="shardlint self-check: compile a tiny GSPMD-"
                         "sharded fused step over the local devices and "
                         "verify its HLO sharding annotations")
+    p.add_argument("--opt", action="store_true", dest="opt_check",
+                   help="graph-optimizer self-check: run the level-2 "
+                        "rewrite pipeline on a fixture graph, report "
+                        "per-pass rewrite counts, and verify the "
+                        "optimized graph round-trips and matches the "
+                        "original under its tolerance class")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the shared machine-readable findings report")
     p.add_argument("--strict", action="store_true",
@@ -124,9 +214,10 @@ def main(argv=None):
                         "register known-bad ops)")
     args = p.parse_args(argv)
 
-    if not (args.ops or args.all or args.graphs or args.shard):
-        p.error("nothing to do: pass --ops, --all, --shard, or graph "
-                "JSON files")
+    if not (args.ops or args.all or args.graphs or args.shard
+            or args.opt_check):
+        p.error("nothing to do: pass --ops, --all, --shard, --opt, or "
+                "graph JSON files")
 
     if args.shard and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -191,6 +282,10 @@ def main(argv=None):
         sf = _selfcheck_shard_findings()
         findings.extend(sf)
         sections.append(("shardlint", "<self-check sharded step>", sf))
+    if args.opt_check:
+        of = _selfcheck_opt_findings()
+        findings.extend(of)
+        sections.append(("mxopt", "<self-check optimizer>", of))
 
     counts = severity_counts(findings)
     if args.as_json:

@@ -286,6 +286,110 @@ def step_cmd(path, as_json):
 
 
 # ---------------------------------------------------------------------------
+# graph-optimizer report (mxnet_tpu/opt/ — ISSUE 7)
+# ---------------------------------------------------------------------------
+
+_OPT_PASSES = ("fold", "cse", "elide", "layout", "fuse", "dce")
+
+
+def opt_metrics(metrics):
+    """Extract the graph-optimizer slice of one metrics snapshot."""
+    out = {
+        "graphs": metrics.get("graph_opt_graphs_total", 0),
+        "rewrites": metrics.get("graph_opt_rewrites_total", 0),
+        "reverts": metrics.get("graph_opt_reverts_total", 0),
+        "verify_failures": metrics.get(
+            "graph_opt_verify_failures_total", 0),
+        "passes": {}, "fused": {},
+    }
+    for p in _OPT_PASSES:
+        n = metrics.get(f"graph_opt_{p}_rewrites_total", 0)
+        t = metrics.get(f"graph_opt_{p}_seconds")
+        out["passes"][p] = {
+            "rewrites": n,
+            "seconds": t if isinstance(t, dict) else None}
+    for k, v in metrics.items():
+        if k.startswith("graph_opt_fused_") and k.endswith("_total"):
+            out["fused"][k[len("graph_opt_fused_"):-len("_total")]] = v
+    return out
+
+
+def opt_report(om):
+    """Render the optimizer section: per-pass rewrite counters, the
+    fused-group census, and time-in-pass."""
+    lines = ["-- graph optimizer (mxopt)"]
+    if not om["graphs"]:
+        lines.append("  no optimizer activity in this snapshot "
+                     "(MXNET_GRAPH_OPT=0 or no symbol binds)")
+        return "\n".join(lines)
+    lines.append(f"  graphs optimized: {om['graphs']}, total rewrites: "
+                 f"{om['rewrites']}, reverts: {om['reverts']}, "
+                 f"verify failures: {om['verify_failures']}")
+    lines.append("  per-pass rewrites / time-in-pass:")
+    for p in _OPT_PASSES:
+        row = om["passes"][p]
+        t = row["seconds"]
+        tavg = (f"avg={t['avg'] * 1e3:8.3f} ms  "
+                f"max={t['max'] * 1e3:8.3f} ms"
+                if isinstance(t, dict) and t.get("count") else
+                "(no timing samples)")
+        lines.append(f"  {p:<8} rewrites={row['rewrites']:<6} {tavg}")
+    if om["fused"]:
+        lines.append("  fused-group census (pattern -> groups):")
+        for pat, n in sorted(om["fused"].items()):
+            lines.append(f"    {pat:<20} {n}")
+    return "\n".join(lines)
+
+
+def analyze_opt(om):
+    """Optimizer pathology scan → Finding list (shared schema)."""
+    from mxnet_tpu.passes import Finding
+    findings = []
+    if om["reverts"]:
+        findings.append(Finding(
+            "mxprof", "opt-reverts", "optimize_symbol", "warn",
+            f"{om['reverts']} graph(s) reverted to unoptimized (io-"
+            "contract or parity failure) — the optimizer paid its "
+            "cost and delivered nothing; check bind logs/findings"))
+    if om["verify_failures"]:
+        findings.append(Finding(
+            "mxprof", "opt-verify-failed", "optimize_symbol", "error",
+            f"{om['verify_failures']} bind-time parity check(s) "
+            "failed — a rewrite pass produced different numbers; "
+            "file it, and run mxlint --opt to reproduce"))
+    if om["graphs"] and not om["rewrites"]:
+        findings.append(Finding(
+            "mxprof", "opt-no-rewrites", "optimize_symbol", "info",
+            f"{om['graphs']} graph(s) went through the pipeline with "
+            "zero rewrites — nothing matched; see the \"why didn't my "
+            "graph fuse\" cookbook in docs/graph_opt.md"))
+    return findings
+
+
+def opt_cmd(path, as_json):
+    with open(path) as f:
+        report = summarize_metrics_lines(f)
+    last = report.get("last") or {}
+    om = opt_metrics(last.get("metrics", {}))
+    findings = analyze_opt(om)
+    if as_json:
+        from mxnet_tpu.passes import findings_report
+        print(findings_report(
+            "mxprof", findings,
+            extra={"file": path, "n_snapshots": report["n_snapshots"],
+                   "opt_metrics": om},
+            as_json=True))
+    else:
+        print(f"== mxprof opt: {path} "
+              f"({report['n_snapshots']} snapshot(s))")
+        print(opt_report(om))
+        for fi in findings:
+            print(f"  {fi!r}")
+    from mxnet_tpu.passes import severity_counts
+    return 2 if severity_counts(findings)["error"] else 0
+
+
+# ---------------------------------------------------------------------------
 # sharded-training report (mxnet_tpu/shard/ — ISSUE 6)
 # ---------------------------------------------------------------------------
 
@@ -549,15 +653,27 @@ def main(argv=None):
     pshard.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the shared machine-readable "
                              "findings report")
+    popt = sub.add_parser(
+        "opt",
+        help="graph-optimizer report from a metrics JSON-lines dump: "
+             "per-pass rewrite counters, fused-group census "
+             "(pattern -> count), time-in-pass")
+    popt.add_argument("dump", help="metrics JSON-lines file "
+                                   "(MXNET_METRICS_EXPORT)")
+    popt.add_argument("--json", action="store_true", dest="as_json",
+                      help="emit the shared machine-readable findings "
+                           "report")
     args = p.parse_args(argv)
-    if args.cmd not in ("summarize", "step", "shard"):
-        p.error("nothing to do: use the summarize, step or shard "
+    if args.cmd not in ("summarize", "step", "shard", "opt"):
+        p.error("nothing to do: use the summarize, step, shard or opt "
                 "subcommand")
     try:
         if args.cmd == "step":
             return step_cmd(args.dump, args.as_json)
         if args.cmd == "shard":
             return shard_cmd(args.dump, args.as_json)
+        if args.cmd == "opt":
+            return opt_cmd(args.dump, args.as_json)
         top = args.top
         if top is None:
             from mxnet_tpu.base import get_env
