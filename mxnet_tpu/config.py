@@ -364,6 +364,57 @@ register_flag(
     "Row cap per serving dispatch (serve.batcher). 0 (default) = the "
     "ladder's top batch rung.")
 register_flag(
+    "MXSERVE2_PAGE_SIZE", int, 16,
+    "KV-cache page width in tokens for the continuous-batching serving "
+    "tier (serve2.kvcache.PagedKVCache): each page is a fixed-size "
+    "block of the pooled K/V memory, so admit/finish/preempt never "
+    "change a compiled decode program's shapes. Smaller pages waste "
+    "less memory on short tails but lengthen the paged-attention scan "
+    "(docs/serving.md v2 tuning guide).")
+register_flag(
+    "MXSERVE2_NUM_PAGES", int, 256,
+    "Total pages in the serve2 KV pool (page 0 is reserved as the null "
+    "page). Together with MXSERVE2_PAGE_SIZE this fixes the pool's "
+    "device footprint at engine construction; running out under load "
+    "triggers recompute preemption of the youngest sequence, counted "
+    "in mxserve2_preemptions_total.")
+register_flag(
+    "MXSERVE2_MAX_INFLIGHT", int, 8,
+    "Max sequences decoded concurrently by one serve2 DecodeEngine. "
+    "The decode bucket ladder is the powers of two up to this cap; "
+    "each rung is ONE compiled decode step program, AOT-warmed so the "
+    "jit cache closes (zero steady-state recompiles, servelint-"
+    "checked).")
+register_flag(
+    "MXSERVE2_REPLICAS", int, 2,
+    "Default replica count per model group in the serve2 Router "
+    "(serve2.router): requests spread over N engine replicas with "
+    "queue-depth + circuit-breaker aware routing; a tripped replica "
+    "is routed around (graceful degradation) until its breaker "
+    "half-opens.")
+register_flag(
+    "MXSERVE2_RELOAD_DRAIN_TIMEOUT_S", float, 30.0,
+    "Per-replica drain budget during a rolling model reload "
+    "(Router.rolling_reload): the NEW engine is warmed before the "
+    "swap, then the old engine gets this many seconds to finish "
+    "in-flight work before it is closed; requests still queued after "
+    "the budget count as dropped in the reload report (test-enforced "
+    "to be zero).")
+register_flag(
+    "MXSERVE2_DECODE_STEPS", int, 4,
+    "Decode iterations folded into ONE compiled serve2 dispatch "
+    "(n-step scheduling). The K steps run entirely in-device, so the "
+    "pool copy-on-update forced where buffer donation is unavailable "
+    "(XLA:CPU) is paid once per K tokens; scheduling granularity "
+    "(admit/preempt/finish) coarsens to K tokens. 1 = strict "
+    "iteration-level scheduling.")
+register_flag(
+    "MXSERVE2_PREFILL_BUCKETS", str, "16,32,64",
+    "Prompt-length rungs for the serve2 prefill program (comma list). "
+    "Prompts are padded up to the next rung so prefill compiles once "
+    "per rung — same closed-jit-cache contract as MXSERVE_BUCKETS; "
+    "prompts longer than the top rung are rejected at submit.")
+register_flag(
     "MXRESIL_FAULT_PLAN", str, "",
     "Deterministic fault-injection plan (resil.faultplan), e.g. "
     "'step:40=preempt;kvstore.push@3=raise;io=stall:200ms' — "

@@ -1,0 +1,130 @@
+"""Paged-KV attention: decode-time attention over a block-table cache.
+
+The serving tier (mxnet_tpu/serve2/) stores each sequence's K/V history
+in fixed-size *pages* of a process-wide pool instead of one contiguous
+per-sequence buffer — the vLLM memory layout, which is what lets a
+continuous-batching scheduler admit/finish/preempt sequences without
+ever changing a compiled program's shapes: the pool, the block tables,
+and the batch axis are all fixed-size, so the decode step stays ONE
+XLA program per batch rung ("Operator Fusion in XLA" economics, same as
+the serve/ bucket ladder).
+
+The attention itself is the :mod:`~mxnet_tpu.parallel.ring_attention`
+online-softmax formulation applied over the PAGE axis instead of the
+ring axis: a ``lax.scan`` walks each sequence's block table one page at
+a time, maintaining the running (max, denominator, accumulator) triple,
+so the logits buffer is ``(B, H, page_size)`` — never ``(B, H, T)`` —
+and a longer context costs scan steps, not memory. Pages past a
+sequence's length are masked with ``-inf`` exactly like ring
+attention's causal mask, and the fully-masked-block guards are the same
+math as ``ring_attention._online_update``.
+
+Numerics: accumulation is float32 and the streaming softmax reassociates
+the reduction, so results match a dense softmax within the "fusion"
+tolerance class of :mod:`mxnet_tpu.opt.verify` (the class that already
+covers online-softmax rewrites), not bitwise.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention", "paged_attention_flat"]
+
+
+def paged_attention(q, kpool, vpool, block_tables, lengths, *,
+                    page_size: int, scale: Optional[float] = None):
+    """Single-token attention over paged K/V for a batch of sequences.
+
+    Parameters
+    ----------
+    q : (B, H, K) — one query vector per sequence (the token being
+        decoded, already written into the pool by the caller).
+    kpool, vpool : (S, H, K) — the FLAT page pool, ``S = num_pages *
+        page_size`` slots. Page ``p`` owns slots ``[p*page_size,
+        (p+1)*page_size)``. Page 0 is the null page (scratch — block
+        tables of dead rows point there).
+    block_tables : (B, N) int32 — page id of each sequence's logical
+        page ``j`` (logical position ``t`` lives in page ``t //
+        page_size`` at offset ``t % page_size``). Unused entries may be
+        any valid page id (they are masked by ``lengths``).
+    lengths : (B,) int32 — valid cached positions per sequence
+        (including the current token). 0 marks an inactive row; its
+        output is zeros.
+    page_size : static page width (compiled into the program).
+    scale : logit scale, default ``1/sqrt(K)``.
+
+    Returns (B, H, K) in ``q``'s dtype.
+    """
+    B, H, K = q.shape
+    scale_v = jnp.float32(scale if scale is not None else 1.0 / (K ** 0.5))
+    offs = jnp.arange(page_size, dtype=jnp.int32)
+    qf = q.astype(jnp.float32)
+
+    def body(carry, xs):
+        o, l, m = carry
+        j, bt_col = xs                                # (), (B,)
+        idx = bt_col[:, None] * page_size + offs[None, :]   # (B, page)
+        k_c = kpool[idx].astype(jnp.float32)          # (B, page, H, K)
+        v_c = vpool[idx].astype(jnp.float32)
+        logits = jnp.einsum("bhk,bphk->bhp", qf, k_c) * scale_v
+        pos = j * page_size + offs                    # logical positions
+        mask = pos[None, :] < lengths[:, None]        # (B, page)
+        logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
+        blk_max = jnp.max(logits, axis=-1)            # (B, H)
+        new_m = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(logits - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+        corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+        new_l = l * corr + jnp.sum(p, axis=-1)
+        new_o = o * corr[..., None] + jnp.einsum("bhp,bphk->bhk", p, v_c)
+        return (new_o, new_l, new_m), None
+
+    n_pages = block_tables.shape[1]
+    init = (jnp.zeros((B, H, K), jnp.float32),
+            jnp.zeros((B, H), jnp.float32),
+            jnp.full((B, H), -jnp.inf, jnp.float32))
+    (o, l, _), _ = jax.lax.scan(
+        body, init, (jnp.arange(n_pages, dtype=jnp.int32),
+                     block_tables.T.astype(jnp.int32)))
+    out = jnp.where(l[..., None] > 0, o / jnp.maximum(l, 1e-30)[..., None],
+                    0.0)
+    return out.astype(q.dtype)
+
+
+def paged_attention_flat(q, kpool, vpool, block_tables, lengths, *,
+                         page_size: int, scale: Optional[float] = None):
+    """Same contract as :func:`paged_attention`, flat formulation: ONE
+    gather materializes each sequence's whole logical window ``(B,
+    N*page_size, H, K)``, then a single masked softmax. More live
+    memory (the window buffer) and one big gather instead of a
+    streaming scan — on CPU the ~10x fewer kernel launches win; on TPU
+    the scan's O(page_size) logits memory is the point. The decode
+    engine picks per backend (``attention="auto"``); both formulations
+    are tolerance-class-equivalent (test-enforced).
+    """
+    B, H, K = q.shape
+    page = int(page_size)
+    scale_v = jnp.float32(scale if scale is not None else 1.0 / (K ** 0.5))
+    offs = jnp.arange(page, dtype=jnp.int32)
+    idx = (block_tables.astype(jnp.int32)[:, :, None] * page
+           + offs[None, None, :]).reshape(B, -1)      # (B, N*page)
+    k_all = kpool[idx].astype(jnp.float32)            # (B, S, H, K)
+    v_all = vpool[idx].astype(jnp.float32)
+    logits = jnp.einsum("bhk,bshk->bhs", q.astype(jnp.float32),
+                        k_all) * scale_v
+    pos = jnp.arange(idx.shape[1], dtype=jnp.int32)
+    mask = pos[None, :] < lengths[:, None]
+    logits = jnp.where(mask[:, None, :], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.where(jnp.isfinite(logits), jnp.exp(logits - safe_m), 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhs,bshk->bhk", p, v_all)
+    out = jnp.where(l[..., None] > 0,
+                    o / jnp.maximum(l, 1e-30)[..., None], 0.0)
+    return out.astype(q.dtype)

@@ -48,8 +48,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .train import adam_init, adam_apply
 
 __all__ = ["init_pipeline_lm", "pipeline_lm_shardings",
-           "build_pipeline_lm_step", "dense_lm_loss", "pipeline_lm_loss",
-           "combined_mesh_drill"]
+           "build_pipeline_lm_step", "dense_lm_loss", "dense_lm_logits",
+           "pipeline_lm_loss", "combined_mesh_drill"]
 
 
 # ---------------------------------------------------------------------------
@@ -277,6 +277,24 @@ def pipeline_lm_loss(params_staged, tokens, labels, mesh, n_stage: int,
                          num_microbatches, shard, attention=attention,
                          remat=remat)
     return _lm_head_loss(params_staged, h, labels, shard)
+
+
+def dense_lm_logits(params, tokens):
+    """Full-forward next-token logits (B, T, V) of the dense reference
+    stack — identical math to :func:`dense_lm_loss` without the loss.
+    This is the serving oracle: mxnet_tpu/serve2's paged-KV continuous-
+    batching decode must reproduce these logits (and their greedy argmax
+    trajectory) within the online-softmax tolerance class, and the PR-3
+    request/response baseline in ``bench.py --serving2`` decodes by
+    re-running this whole forward per generated token."""
+    h = params["embed"][tokens]
+
+    def body(hc, lp):
+        return _layer(lp, hc, _no_shard), None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    h = _rmsnorm(h, params["ln_f"])
+    return jnp.einsum("btd,dv->btv", h, params["head"])
 
 
 def dense_lm_loss(params, tokens, labels):

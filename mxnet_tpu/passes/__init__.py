@@ -205,7 +205,7 @@ def findings_report(tool: str, findings: Iterable[Finding],
 # cheap (passes hold no state until run)
 def default_manager() -> PassManager:
     from . import (oplint, graphlint, tracercheck, dispatchlint,
-                   steplint, shardlint)
+                   steplint, shardlint, servelint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
@@ -213,4 +213,5 @@ def default_manager() -> PassManager:
     pm.register(dispatchlint.DispatchAudit())
     pm.register(steplint.OptimizerFusionAudit())
     pm.register(shardlint.ShardLint())
+    pm.register(servelint.ServeLint())
     return pm

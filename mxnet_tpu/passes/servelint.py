@@ -1,0 +1,120 @@
+"""servelint: the serving tier's closed-jit-cache / donation contract.
+
+The whole serve2 design rests on two invariants the type system cannot
+enforce:
+
+1. **bucket-rung-exact shapes** — every compiled decode-step program's
+   batch size and every prefill program's prompt length must be a
+   declared ladder rung. A program compiled at, say, batch 3 means some
+   code path passed the LIVE in-flight count instead of padding to the
+   rung — the silent per-sequence-length retrace class: it works, it is
+   just one fresh XLA compile per arrival pattern, and the p99 pays it.
+2. **donated page pools** — the decode/prefill programs must take the
+   KV pools as donated buffers on accelerator backends, or XLA holds
+   input AND output pools live (double the KV footprint, ~the largest
+   allocation in the process).
+
+:class:`ServeLint` audits a :class:`~mxnet_tpu.serve2.decode.PagedLM` /
+:class:`~mxnet_tpu.serve2.scheduler.DecodeEngine` (anything with their
+``lint_report()`` shape) against both, plus the warmup-coverage and
+after-warmup-recompile alarms. Registered in the default PassManager;
+``tools/mxlint.py --serve`` runs it over a live self-check engine.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding, Pass
+
+__all__ = ["ServeLint", "lint_serve_report"]
+
+
+class ServeLint(Pass):
+    name = "servelint"
+    order = 100
+
+    def run(self, target) -> List[Finding]:
+        rep = target if isinstance(target, dict) else target.lint_report()
+        return lint_serve_report(rep)
+
+    def finding(self, check, obj, severity, message, loc=None):
+        return Finding(self.name, check, obj, severity, message, loc)
+
+
+def lint_serve_report(rep: dict) -> List[Finding]:
+    """Audit one engine's ``lint_report()`` dict. See module docstring
+    for the checks."""
+    p = ServeLint()
+    obj = str(rep.get("name", "<engine>"))
+    out: List[Finding] = []
+    decode_rungs = set(rep.get("decode_rungs") or ())
+    prefill_rungs = set(rep.get("prefill_rungs") or ())
+    warmed = bool(rep.get("warmed"))
+    compiled = [tuple(c) for c in rep.get("compiled", ())]
+
+    if not warmed:
+        out.append(p.finding(
+            "not-warmed", obj, "warn",
+            "engine was never warmed — the jit cache is open and every "
+            "first-arrival shape will compile in the serving path"))
+
+    rung_sets = {"decode": decode_rungs, "prefill": prefill_rungs}
+    for kind, size in compiled:
+        rungs = rung_sets.get(kind)
+        if rungs is None:
+            out.append(p.finding(
+                "unknown-program", obj, "warn",
+                f"compiled program kind {kind!r} (size {size}) is not "
+                "a decode or prefill rung program"))
+            continue
+        if warmed and size not in rungs:
+            out.append(p.finding(
+                "off-rung-shape", obj, "error",
+                f"{kind} program compiled at size {size}, which is not "
+                f"a declared rung {sorted(rungs)} — the silent "
+                "per-sequence-length retrace class (some caller passed "
+                "a live count instead of padding to the ladder)"))
+
+    if warmed:
+        seen = {k: {s for kk, s in compiled if kk == k}
+                for k in ("decode", "prefill")}
+        for kind, rungs in rung_sets.items():
+            missing = rungs - seen.get(kind, set())
+            if missing:
+                out.append(p.finding(
+                    "warmup-gap", obj, "warn",
+                    f"declared {kind} rungs {sorted(missing)} were "
+                    "never compiled by warmup — the first live request "
+                    "on those rungs will compile in the serving path"))
+
+    after = int(rep.get("recompiles_after_warmup", 0))
+    if after:
+        out.append(p.finding(
+            "recompile-after-warmup", obj, "error",
+            f"{after} program(s) compiled after warmup declared the "
+            "cache closed (see the recompile auditor's serving2 "
+            "entries for the triggering signatures)"))
+
+    backend = rep.get("backend", "cpu")
+    donate_mode = rep.get("donate_mode", "auto")
+    donated = bool(rep.get("donate_pages"))
+    if backend != "cpu" and not donated:
+        out.append(p.finding(
+            "pool-not-donated", obj, "error",
+            f"page pools are NOT donated on backend {backend!r} "
+            f"(donate={donate_mode!r}): XLA must keep input and output "
+            "pools live simultaneously — double the KV-cache HBM "
+            "footprint"))
+    elif backend == "cpu" and donate_mode == "off":
+        out.append(p.finding(
+            "pool-donate-off", obj, "warn",
+            "donation explicitly disabled — fine on CPU, but this "
+            "config doubles KV HBM the moment it runs on an "
+            "accelerator"))
+    elif backend == "cpu" and not donated:
+        out.append(p.finding(
+            "pool-donate-cpu", obj, "info",
+            "pools not donated because XLA:CPU does not support "
+            "donation; the same engine donates automatically on "
+            "TPU/GPU (donate='auto')"))
+    return out

@@ -21,17 +21,19 @@ and a warmed XLA program:
 docs/serving.md for architecture and the bucket-ladder tuning guide.
 """
 from .batcher import (BatcherStoppedError, DeadlineExceededError,  # noqa: F401
-                      DynamicBatcher, QueueFullError,
-                      RequestTooLargeError, Request)
+                      DynamicBatcher, InvalidRequestError,
+                      QueueFullError, RequestTooLargeError, Request)
 from .buckets import (BucketLadder, BucketOverflowError,  # noqa: F401
                       default_ladder, parse_bucket_spec)
 from .endpoint import ModelRegistry, ServingEndpoint  # noqa: F401
 from .engine import InputSpec, ServingEngine  # noqa: F401
+from .loadgen import run_loadgen, run_loadgen_open  # noqa: F401
 
 __all__ = [
     "BucketLadder", "BucketOverflowError", "parse_bucket_spec",
     "default_ladder", "DynamicBatcher", "Request", "QueueFullError",
     "DeadlineExceededError", "BatcherStoppedError",
-    "RequestTooLargeError", "ServingEngine",
+    "RequestTooLargeError", "InvalidRequestError", "ServingEngine",
     "InputSpec", "ModelRegistry", "ServingEndpoint",
+    "run_loadgen", "run_loadgen_open",
 ]

@@ -40,7 +40,8 @@ from ..base import MXNetError
 from ..telemetry import metrics as _metrics
 
 __all__ = ["DynamicBatcher", "QueueFullError", "DeadlineExceededError",
-           "BatcherStoppedError", "RequestTooLargeError", "Request"]
+           "BatcherStoppedError", "RequestTooLargeError",
+           "InvalidRequestError", "Request"]
 
 
 class QueueFullError(MXNetError):
@@ -50,6 +51,13 @@ class QueueFullError(MXNetError):
 class RequestTooLargeError(MXNetError):
     """A single request exceeds max_batch_size rows — a CLIENT error
     (typed so serving breakers can exclude it from health accounting)."""
+
+
+class InvalidRequestError(MXNetError):
+    """The request itself is malformed (empty prompt, bad shape, bad
+    max_new_tokens) — a CLIENT error: deterministic for the request, so
+    routers must neither retry it on another replica nor count it
+    against replica health."""
 
 
 class DeadlineExceededError(MXNetError):

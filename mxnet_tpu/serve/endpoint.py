@@ -40,44 +40,111 @@ from .engine import ServingEngine
 
 __all__ = ["ModelRegistry", "ServingEndpoint"]
 
+# mxserve_models_registered is one PROCESS-WIDE gauge, and serve2 makes
+# multiple live registries per process the norm (a router's registry +
+# the endpoint's front registry) — each registry publishing its own
+# len() would be last-writer-wins garbage, so they share this tally
+_registered_lock = threading.Lock()
+_registered_total = 0
+
+
+def _count_registered(delta: int) -> None:
+    global _registered_total
+    with _registered_lock:
+        _registered_total += delta
+        count = _registered_total
+    _metrics.gauge("mxserve_models_registered",
+                   "engines registered across all serving registries "
+                   "in this process").set(count)
+
 
 class ModelRegistry:
-    """Thread-safe name → :class:`ServingEngine` map."""
+    """Thread-safe name → :class:`ServingEngine` map with version
+    pinning.
+
+    Every registration carries a monotonically-increasing **version**
+    (explicit, or auto-assigned). :meth:`swap` atomically replaces the
+    engine behind a name with a newer version and returns the old one
+    for the caller to drain — the serve2 router's rolling-reload
+    primitive. Clients that must not silently cross a model version
+    pass ``version=`` to :meth:`get`: a mismatch raises instead of
+    serving from the wrong weights.
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._models: Dict[str, ServingEngine] = {}
+        self._versions: Dict[str, int] = {}
 
     def register(self, name: str, engine: ServingEngine,
-                 warmup: bool = False) -> ServingEngine:
+                 warmup: bool = False,
+                 version: Optional[int] = None) -> ServingEngine:
         if warmup and not engine.warmed:
             engine.warmup()
         with self._lock:
             if name in self._models:
-                raise MXNetError(f"model {name!r} already registered")
+                raise MXNetError(f"model {name!r} already registered "
+                                 "(use swap() to replace it)")
             self._models[name] = engine
-            count = len(self._models)
-        _metrics.gauge("mxserve_models_registered",
-                       "engines in the serving registry").set(count)
+            self._versions[name] = int(version) if version is not None \
+                else 1
+        _count_registered(+1)
         return engine
+
+    def swap(self, name: str, engine: ServingEngine,
+             version: Optional[int] = None) -> ServingEngine:
+        """Atomically replace ``name``'s engine; returns the OLD engine
+        (still live — the caller owns draining and closing it, so
+        in-flight requests on the old version finish untouched).
+        ``version`` must be newer than the current one (default:
+        current + 1); a stale version is refused, which is what makes
+        concurrent reloads safe to retry."""
+        with self._lock:
+            if name not in self._models:
+                raise MXNetError(f"model {name!r} not registered")
+            cur = self._versions[name]
+            new = int(version) if version is not None else cur + 1
+            if new <= cur:
+                raise MXNetError(
+                    f"swap of {name!r} with stale version {new} "
+                    f"(current {cur})")
+            old = self._models[name]
+            self._models[name] = engine
+            self._versions[name] = new
+        return old
+
+    def version_of(self, name: str) -> int:
+        with self._lock:
+            if name not in self._versions:
+                raise MXNetError(f"model {name!r} not registered")
+            return self._versions[name]
 
     def unregister(self, name: str, close: bool = True) -> None:
         with self._lock:
             engine = self._models.pop(name, None)
-            count = len(self._models)
+            self._versions.pop(name, None)
         if engine is None:
             raise MXNetError(f"model {name!r} not registered")
         if close:
             engine.close()
-        _metrics.gauge("mxserve_models_registered", "").set(count)
+        _count_registered(-1)
 
-    def get(self, name: str) -> ServingEngine:
+    def get(self, name: str,
+            version: Optional[int] = None) -> ServingEngine:
+        """Look up an engine; ``version=`` pins the call to a specific
+        model version (raises on mismatch instead of silently serving
+        newer/older weights across a rolling reload)."""
         with self._lock:
             engine = self._models.get(name)
             have = sorted(self._models)
+            cur = self._versions.get(name)
         if engine is None:
             raise MXNetError(f"model {name!r} not registered "
                              f"(have: {have})")
+        if version is not None and int(version) != cur:
+            raise MXNetError(
+                f"model {name!r} is at version {cur}, caller pinned "
+                f"version {int(version)}")
         return engine
 
     def names(self) -> List[str]:
@@ -167,6 +234,29 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/admin/drain":
             threading.Thread(target=ep.drain, daemon=True).start()
             return self._send(202, {"status": "draining"})
+        if path == "/admin/reload":
+            if ep.reloader is None:
+                return self._send(
+                    404, {"error": "no reloader configured (start the "
+                                    "endpoint over a serve2 Router)"})
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError(
+                        f"body must be a JSON object, got "
+                        f"{type(payload).__name__}")
+                model = payload.get("model")
+            except ValueError as e:
+                return self._send(400, {"error": f"bad JSON body: {e}"})
+            try:
+                report = ep.reloader(model)
+            except MXNetError as e:
+                return self._send(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — JSON 500, not a drop
+                return self._send(500,
+                                  {"error": f"{type(e).__name__}: {e}"})
+            return self._send(200, report)
         if path.startswith("/v1/models/") and ":" in path:
             name, _, verb = path[len("/v1/models/"):].rpartition(":")
             try:
@@ -234,9 +324,12 @@ class ServingEndpoint:
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  host: str = "127.0.0.1", port: int = 8080,
-                 verbose: bool = False):
+                 verbose: bool = False, reloader=None):
         self.registry = registry or ModelRegistry()
         self.verbose = verbose
+        # optional ``reloader(model_name) -> report dict`` hook backing
+        # POST /admin/reload (the serve2 Router's rolling_reload)
+        self.reloader = reloader
         self.draining = False
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True

@@ -1,0 +1,371 @@
+"""Compiled prefill / decode-step programs over the in-repo LM stack.
+
+The model is :func:`~mxnet_tpu.parallel.pipeline_lm.init_pipeline_lm`'s
+pre-LN decoder stack (causal MHA + top-1 MoE FFN) — the same parameters
+and math as the dense training reference ``dense_lm_logits``, re-derived
+in incremental form over a paged KV-cache:
+
+- :meth:`PagedLM.prefill` — ONE program per prompt-length rung: full
+  causal forward over the padded prompt, per-layer K/V scattered into
+  the page pool through the sequence's block table, next token from the
+  logits at the last real position.
+- :meth:`PagedLM.decode` — ONE program per batch rung: embed the last
+  token of every in-flight sequence, write its K/V at ``length``, run
+  :func:`~mxnet_tpu.parallel.paged_attention.paged_attention` (the
+  ring-attention-style online softmax over the page axis), FFN, head,
+  greedy argmax. All shapes — ``(max_batch,)`` scalars, the
+  ``(max_batch, max_pages)`` block table, the page pools — are FIXED,
+  so continuous batching never retraces.
+
+Both programs take the page pools as donated arguments (off-CPU), so
+XLA reuses the pool HBM in place instead of double-buffering ~the whole
+KV footprint; every call returns the new pools and the caller threads
+them forward. Compiled signatures feed the PR-2 recompile auditor under
+kind ``serving2``; after :meth:`warmup` any new signature trips
+``mxserve2_recompile_after_warmup_total`` — the alarm servelint and the
+soak test keep at 0.
+
+Parity contract (test-enforced): greedy decode through this cache
+matches one-sequence-at-a-time ``dense_lm_logits`` decode token-for-
+token, with logits inside the ``fusion`` tolerance class of
+:mod:`mxnet_tpu.opt.verify` (online softmax reassociates reductions —
+same class, same reason, as the fused-attention rewrite).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..telemetry import metrics as _metrics
+from ..telemetry import recompile as _recompile
+from ..parallel.paged_attention import (paged_attention,
+                                        paged_attention_flat)
+# the oracle's norm, not a copy: token-for-token parity with
+# dense_lm_logits must survive any future change to the eps/form
+from ..parallel.pipeline_lm import _rmsnorm
+
+__all__ = ["PagedLM", "decode_rungs_for"]
+
+
+def decode_rungs_for(max_inflight: int) -> Tuple[int, ...]:
+    """The decode bucket ladder: powers of two up to ``max_inflight``
+    (inclusive, appended when not itself a power of two)."""
+    m = int(max_inflight)
+    if m < 1:
+        raise MXNetError("max_inflight must be >= 1")
+    rungs = []
+    r = 1
+    while r < m:
+        rungs.append(r)
+        r *= 2
+    rungs.append(m)
+    return tuple(rungs)
+
+
+def _moe_ffn(lp, hn):
+    """Top-1-gated MoE FFN on a (..., D) activation — the dense
+    ``_layer`` math with the T axis generalized away."""
+    wts = jax.nn.softmax(jnp.einsum("...d,de->...e", hn, lp["gate"]))
+    top1 = jax.nn.one_hot(jnp.argmax(wts, -1), wts.shape[-1]) * wts
+    top1 = top1 / (jnp.sum(top1, -1, keepdims=True) + 1e-9)
+    y = jnp.einsum("...d,edf->e...f", hn, lp["w1"]) \
+        + lp["b1"][(slice(None),) + (None,) * (hn.ndim - 1)]
+    y = jax.nn.gelu(y)
+    y = jnp.einsum("e...f,efd->e...d", y, lp["w2"]) \
+        + lp["b2"][(slice(None),) + (None,) * (hn.ndim - 1)]
+    return jnp.einsum("...e,e...d->...d", top1, y)
+
+
+class PagedLM:
+    """One LM + one page pool + the two compiled serving programs.
+
+    Parameters
+    ----------
+    params : the :func:`init_pipeline_lm` tree (dense, unstaged layout).
+    page_size, num_pages : pool geometry (page 0 is the null page).
+    max_pages_per_seq : block-table width — caps sequence length at
+        ``max_pages_per_seq * page_size`` cached positions.
+    donate : "auto" (donate pools off-CPU), "on", "off".
+    """
+
+    def __init__(self, params: Dict, *, page_size: int, num_pages: int,
+                 max_pages_per_seq: int, donate: str = "auto",
+                 decode_steps: int = 1, attention: str = "auto",
+                 name: str = "lm"):
+        self.name = name
+        if attention not in ("auto", "scan", "flat"):
+            raise MXNetError("attention must be auto/scan/flat")
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_pages = int(max_pages_per_seq)
+        # tokens decoded per compiled dispatch (n-step scheduling): the
+        # K iterations run entirely in-device, so the pool
+        # copy-on-update that XLA:CPU's missing donation forces is paid
+        # once per K tokens instead of per token; scheduling (admit/
+        # preempt/finish) coarsens to K-token granularity
+        self.decode_steps = int(decode_steps)
+        if self.decode_steps < 1:
+            raise MXNetError("decode_steps must be >= 1")
+        wqkv = params["layers"]["wqkv"]
+        self.n_layers, _, self.d_model, self.n_heads, self.d_head = \
+            wqkv.shape
+        self.vocab = params["head"].shape[1]
+        self.params = jax.tree.map(jnp.asarray, params)
+        if donate not in ("auto", "on", "off"):
+            raise MXNetError("donate must be auto/on/off")
+        self.donate_mode = donate
+        self.backend = jax.default_backend()
+        # scan = ring-attention-style streaming over pages (O(page)
+        # logits memory — the TPU formulation); flat = one window
+        # gather + dense masked softmax (far fewer kernels — wins on
+        # CPU). Both are in the same tolerance class (test-enforced).
+        self.attention = attention if attention != "auto" else (
+            "flat" if self.backend == "cpu" else "scan")
+        self._attend = (paged_attention_flat
+                        if self.attention == "flat" else paged_attention)
+        self.donate_pages = (donate == "on") or (
+            donate == "auto" and self.backend != "cpu")
+        slots = self.num_pages * self.page_size
+        pool_shape = (self.n_layers, slots, self.n_heads, self.d_head)
+        self.kpool = jnp.zeros(pool_shape, jnp.float32)
+        self.vpool = jnp.zeros(pool_shape, jnp.float32)
+        self.pool_bytes = 2 * int(onp.prod(pool_shape)) * 4
+        dn = (1, 2) if self.donate_pages else ()
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=dn)
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=dn)
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._warmed = False
+        self._warmed_rungs: dict = {"decode": (), "prefill": ()}
+        self._after_warmup = 0
+        self._m_after = _metrics.counter(
+            "mxserve2_recompile_after_warmup_total",
+            "serve2 decode/prefill programs compiled after warmup "
+            "declared the cache closed — should stay 0")
+
+    # ------------------------------------------------------------------
+    # jitted bodies
+    # ------------------------------------------------------------------
+    def _decode_fn(self, params, kpool, vpool, bt, lengths, tokens,
+                   remaining):
+        """``decode_steps`` greedy tokens for every slot, entirely
+        in-device. bt (B, N) int32; lengths/tokens/remaining (B,)
+        int32 — row i is active for loop steps ``s < remaining[i]``
+        (0 = dead row). Returns (kpool, vpool, out_tokens (B, K),
+        last_logits (B, V)); callers take ``out[i, :remaining[i]]``.
+
+        CAVEAT (K > 1): last_logits come from the FINAL loop step, so
+        row i's slice is only meaningful when ``remaining[i] == K`` —
+        a row that finished earlier in the window was inactive for the
+        later steps (stale token, attention masked to length 0) and its
+        logits are garbage. Valid token ids are unaffected; a logprob/
+        score surface would need per-row logit capture at
+        ``s == remaining[i] - 1`` first.
+        """
+        page = self.page_size
+        K_steps = self.decode_steps
+        scale = 1.0 / (self.d_head ** 0.5)
+        B = tokens.shape[0]
+
+        def one_token(kpool, vpool, toks, s):
+            act = s < remaining
+            pos = lengths + s
+            # inactive steps write into the null page's scratch slots —
+            # never through (a clipped read of) the block table, which
+            # for pos past capacity could alias a REAL slot
+            page_id = jnp.take_along_axis(
+                bt, jnp.clip(pos // page, 0, bt.shape[1] - 1)[:, None],
+                axis=1)[:, 0]
+            slot = jnp.where(act, page_id * page + pos % page,
+                             pos % page)
+            att_len = jnp.where(act, pos + 1, 0)
+            h = params["embed"][toks]                     # (B, D)
+
+            def body(hc, xs):
+                lp, kp, vp = xs
+                hn = _rmsnorm(hc, lp["ln1"])
+                qkv = jnp.einsum("bd,cdhk->cbhk", hn, lp["wqkv"])
+                kp = kp.at[slot].set(qkv[1])
+                vp = vp.at[slot].set(qkv[2])
+                ctx = self._attend(qkv[0], kp, vp, bt, att_len,
+                                   page_size=page, scale=scale)
+                hc = hc + jnp.einsum("bhk,hkd->bd", ctx, lp["wo"])
+                hn2 = _rmsnorm(hc, lp["ln2"])
+                hc = hc + _moe_ffn(lp, hn2)
+                return hc, (kp, vp)
+
+            h, (kpool, vpool) = jax.lax.scan(
+                body, h, (params["layers"], kpool, vpool))
+            h = _rmsnorm(h, params["ln_f"])
+            logits = jnp.einsum("bd,dv->bv", h, params["head"])
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return kpool, vpool, nxt, logits
+
+        if K_steps == 1:
+            kpool, vpool, nxt, logits = one_token(kpool, vpool,
+                                                  tokens, 0)
+            return kpool, vpool, nxt[:, None], logits
+
+        def step(s, carry):
+            kpool, vpool, toks, out, logits = carry
+            kpool, vpool, nxt, logits = one_token(kpool, vpool, toks, s)
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, nxt[:, None], s, axis=1)
+            return kpool, vpool, nxt, out, logits
+
+        init = (kpool, vpool, tokens,
+                jnp.zeros((B, K_steps), jnp.int32),
+                jnp.zeros((B, self.vocab), jnp.float32))
+        kpool, vpool, _, out, logits = jax.lax.fori_loop(
+            0, K_steps, step, init)
+        return kpool, vpool, out, logits
+
+    def _prefill_fn(self, params, kpool, vpool, bt_row, length, tokens):
+        """Full causal forward over ONE padded prompt. tokens (T,)
+        int32, length scalar int32 (real prompt length), bt_row (N,)
+        int32. Returns (kpool, vpool, next_token, last_logits)."""
+        page = self.page_size
+        T = tokens.shape[0]
+        scale = 1.0 / (self.d_head ** 0.5)
+        pos = jnp.arange(T, dtype=jnp.int32)
+        valid = pos < length
+        slot = jnp.where(valid,
+                         bt_row[pos // page] * page + pos % page,
+                         pos % page)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        h = params["embed"][tokens]                       # (T, D)
+
+        def body(hc, xs):
+            lp, kp, vp = xs
+            hn = _rmsnorm(hc, lp["ln1"])
+            qkv = jnp.einsum("td,cdhk->cthk", hn, lp["wqkv"])
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            kp = kp.at[slot].set(k)
+            vp = vp.at[slot].set(v)
+            logits = jnp.einsum("thk,shk->hts", q, k) * scale
+            att = jax.nn.softmax(
+                jnp.where(causal, logits, -1e30), axis=-1)
+            ctx = jnp.einsum("hts,shk->thk", att, v)
+            hc = hc + jnp.einsum("thk,hkd->td", ctx, lp["wo"])
+            hn2 = _rmsnorm(hc, lp["ln2"])
+            hc = hc + _moe_ffn(lp, hn2)
+            return hc, (kp, vp)
+
+        h, (kpool, vpool) = jax.lax.scan(
+            body, h, (params["layers"], kpool, vpool))
+        h = _rmsnorm(h, params["ln_f"])
+        logits = jnp.einsum("td,dv->tv", h, params["head"])
+        last = jnp.take(logits, length - 1, axis=0)
+        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return kpool, vpool, nxt, last
+
+    # ------------------------------------------------------------------
+    # recompile accounting
+    # ------------------------------------------------------------------
+    def _record(self, kind: str, size: int):
+        key = (kind, int(size))
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        sig = {"inputs": [{"shape": [int(size)], "dtype": "int32"}],
+               "training": False, "program": kind}
+        _recompile.record_recompile(
+            f"PagedLM:{self.name}", sig, kind="serving2")
+        if self._warmed:
+            self._m_after.inc()
+            self._after_warmup += 1
+
+    # ------------------------------------------------------------------
+    # public API (single-threaded by the engine lock of the caller)
+    # ------------------------------------------------------------------
+    def decode(self, bt: onp.ndarray, lengths: onp.ndarray,
+               tokens: onp.ndarray, remaining: onp.ndarray):
+        """Run one decode tick (``decode_steps`` in-device iterations);
+        returns (tokens (B, decode_steps), last_logits) as numpy — row
+        ``i``'s valid prefix is ``remaining[i]`` tokens. ``bt`` must be
+        (B, max_pages); B must be a warmed rung. With decode_steps > 1,
+        last_logits rows are only valid where ``remaining[i] ==
+        decode_steps`` (see the ``_decode_fn`` caveat)."""
+        with self._lock:
+            self._record("decode", bt.shape[0])
+            self.kpool, self.vpool, out, logits = self._decode_jit(
+                self.params, self.kpool, self.vpool,
+                jnp.asarray(bt, jnp.int32),
+                jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(remaining, jnp.int32))
+        return onp.asarray(out), onp.asarray(logits)
+
+    def prefill(self, tokens_padded: onp.ndarray, length: int,
+                bt_row: onp.ndarray):
+        """Prefill one prompt (padded to a rung); returns (next_token,
+        last_logits)."""
+        with self._lock:
+            self._record("prefill", tokens_padded.shape[0])
+            self.kpool, self.vpool, nxt, logits = self._prefill_jit(
+                self.params, self.kpool, self.vpool,
+                jnp.asarray(bt_row, jnp.int32),
+                jnp.int32(length),
+                jnp.asarray(tokens_padded, jnp.int32))
+        return int(nxt), onp.asarray(logits)
+
+    def warmup(self, decode_rungs, prefill_rungs) -> List[dict]:
+        """AOT-compile every rung; afterwards any new signature is a
+        counted recompile (the serve/ warmup contract)."""
+        import time
+        report = []
+        N = self.max_pages
+        for b in sorted(set(int(r) for r in decode_rungs)):
+            t0 = time.perf_counter()
+            self.decode(onp.zeros((b, N), "int32"),
+                        onp.zeros((b,), "int32"),
+                        onp.zeros((b,), "int32"),
+                        onp.zeros((b,), "int32"))
+            jax.block_until_ready(self.kpool)
+            report.append({"program": "decode", "size": b,
+                           "compile_ms": round(
+                               (time.perf_counter() - t0) * 1e3, 3)})
+        for t in sorted(set(int(r) for r in prefill_rungs)):
+            t0 = time.perf_counter()
+            self.prefill(onp.zeros((t,), "int32"), 1,
+                         onp.zeros((N,), "int32"))
+            jax.block_until_ready(self.kpool)
+            report.append({"program": "prefill", "size": t,
+                           "compile_ms": round(
+                               (time.perf_counter() - t0) * 1e3, 3)})
+        self._warmed = True
+        self._warmed_rungs = {
+            "decode": tuple(sorted(set(int(r) for r in decode_rungs))),
+            "prefill": tuple(sorted(set(int(r) for r in prefill_rungs)))}
+        return report
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    def lint_report(self) -> dict:
+        """Everything :mod:`mxnet_tpu.passes.servelint` checks: the
+        compiled signatures vs the declared rungs, and the donation
+        configuration of the page pools."""
+        with self._lock:  # _record() mutates _seen on the scheduler
+            seen = sorted(self._seen)  # thread; snapshot, don't iterate
+            after = self._after_warmup
+        return {
+            "name": self.name,
+            "warmed": self._warmed,
+            "decode_rungs": self._warmed_rungs["decode"],
+            "prefill_rungs": self._warmed_rungs["prefill"],
+            "compiled": seen,
+            "decode_steps": self.decode_steps,
+            "attention": self.attention,
+            "donate_mode": self.donate_mode,
+            "donate_pages": self.donate_pages,
+            "backend": self.backend,
+            "recompiles_after_warmup": after,
+            "pool_bytes": self.pool_bytes,
+        }

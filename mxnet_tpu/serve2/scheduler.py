@@ -1,0 +1,547 @@
+"""Iteration-level (continuous-batching) scheduler over a PagedLM.
+
+PR-3's :class:`~mxnet_tpu.serve.engine.ServingEngine` coalesces whole
+*requests*; an autoregressive LM needs coalescing at the *iteration*
+level — every scheduler tick:
+
+1. **admit** — pop waiting prompts while a batch slot and enough pages
+   for the (re)prefill exist; one prefill program run per admit (padded
+   to the prompt rung ladder), which also emits the first token;
+2. **grow** — give every running sequence the page its next position
+   needs; on pool exhaustion, **preempt** the youngest running
+   sequence (free its pages, requeue it at the FRONT with its progress
+   folded into an effective prompt — recompute-style preemption, so a
+   preempted sequence's greedy trajectory is unchanged);
+3. **step** — pack all running sequences into the smallest decode
+   batch rung and run ONE compiled decode step for everyone; append the
+   sampled tokens, then finish (free pages, resolve handles) sequences
+   that hit ``max_new_tokens`` / EOS / cancellation.
+
+Because admit/finish/preempt only edit host-side block tables, the
+device programs never see a new shape: the jit cache stays closed under
+any arrival pattern — the property the serve/ bucket ladder pioneered,
+carried into autoregressive serving.
+
+The engine runs its scheduler on one background thread; ``submit``
+returns a :class:`GenerationHandle`, and ``predict`` (the router/
+endpoint-facing call, same duck type as ``ServingEngine.predict``)
+submits and waits. Telemetry: ``mxserve2_inflight_seqs_<engine>`` /
+``mxserve2_waiting_seqs_<engine>`` gauges, ``mxserve2_preemptions_total`` /
+``mxserve2_ticks_total`` / ``mxserve2_tokens_total`` counters, page
+occupancy via :mod:`~mxnet_tpu.serve2.kvcache`.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..telemetry import metrics as _metrics
+from ..serve.batcher import (BatcherStoppedError, DeadlineExceededError,
+                             InvalidRequestError)
+from ..serve.buckets import BucketOverflowError
+from .decode import PagedLM, decode_rungs_for
+from .kvcache import (BlockTable, PageAllocator, PagePoolExhausted,
+                      pages_needed)
+
+__all__ = ["DecodeEngine", "EngineCrashedError", "GenerationHandle"]
+
+
+class EngineCrashedError(BatcherStoppedError):
+    """The engine's scheduler thread died. Unlike a draining/stopped
+    engine (plain :class:`BatcherStoppedError`, a transient load
+    signal), a crashed engine is DEAD: the router records a breaker
+    failure so traffic routes around the replica."""
+
+
+class GenerationHandle:
+    """One in-flight generation. ``wait()`` blocks for the result
+    (an int32 numpy array of generated token ids, EOS included)."""
+
+    __slots__ = ("event", "result", "error", "sid", "cancelled")
+
+    def __init__(self, sid: int):
+        self.event = threading.Event()
+        self.result: Optional[onp.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.sid = sid
+        self.cancelled = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.event.wait(timeout)
+
+    def done(self) -> bool:
+        return self.event.is_set()
+
+
+class _Seq:
+    __slots__ = ("sid", "prompt", "generated", "max_new", "bt",
+                 "handle", "admit_idx")
+
+    def __init__(self, sid: int, prompt: List[int], max_new: int):
+        self.sid = sid
+        self.prompt = prompt
+        self.generated: List[int] = []
+        self.max_new = max_new
+        self.bt: Optional[BlockTable] = None
+        self.handle = GenerationHandle(sid)
+        self.admit_idx = -1  # monotone per (re)admission: preemption age
+
+    def effective_prompt(self) -> List[int]:
+        """Prompt for (re)prefill: original prompt plus progress — a
+        preempted sequence recomputes its cache AND its next token from
+        this, so greedy decoding continues exactly where it stopped."""
+        return self.prompt + self.generated
+
+
+class DecodeEngine:
+    """Continuous-batching LM serving engine. See module docstring.
+
+    ``params`` is an :func:`init_pipeline_lm` tree; flags supply the
+    pool geometry and concurrency defaults (``MXSERVE2_*``).
+    """
+
+    def __init__(self, params: Dict, *, page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_inflight: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 max_new_default: int = 16, eos_id: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 decode_steps: Optional[int] = None,
+                 attention: str = "auto",
+                 name: str = "lm", donate: str = "auto"):
+        from .. import config
+        self.name = name
+        self.decode_steps = int(
+            decode_steps if decode_steps is not None
+            else config.get("MXSERVE2_DECODE_STEPS"))
+        self.page_size = int(page_size if page_size is not None
+                             else config.get("MXSERVE2_PAGE_SIZE"))
+        self.num_pages = int(num_pages if num_pages is not None
+                             else config.get("MXSERVE2_NUM_PAGES"))
+        self.max_inflight = int(
+            max_inflight if max_inflight is not None
+            else config.get("MXSERVE2_MAX_INFLIGHT"))
+        if prefill_buckets is None:
+            prefill_buckets = [
+                int(t) for t in
+                str(config.get("MXSERVE2_PREFILL_BUCKETS")).split(",")
+                if t.strip()]
+        self.max_new_default = int(max_new_default)
+        self.eos_id = eos_id
+        top_prefill = max(int(b) for b in prefill_buckets)
+        self._configured_prefill_top = top_prefill
+        if max_seq_len is None:
+            max_seq_len = top_prefill + 4 * self.max_new_default
+        self.max_seq_len = int(max_seq_len)
+        self.max_pages_per_seq = pages_needed(self.max_seq_len,
+                                              self.page_size)
+        # re-prefill after preemption may carry prompt+progress past the
+        # configured rungs; one extra rung at max_seq_len keeps that
+        # path inside the closed cache too
+        self.prefill_rungs: Tuple[int, ...] = tuple(sorted(
+            {int(b) for b in prefill_buckets} | {self.max_seq_len}))
+        self.decode_rungs: Tuple[int, ...] = \
+            decode_rungs_for(self.max_inflight)
+        self.lm = PagedLM(params, page_size=self.page_size,
+                          num_pages=self.num_pages,
+                          max_pages_per_seq=self.max_pages_per_seq,
+                          donate=donate, name=name,
+                          decode_steps=self.decode_steps,
+                          attention=attention)
+        self.alloc = PageAllocator(self.num_pages, self.page_size,
+                                   name=name)
+        from ..serve.engine import InputSpec
+        self.input_specs = [InputSpec((top_prefill,), "int32",
+                                      name="tokens")]
+        self._cv = threading.Condition()
+        self._waiting: "deque[_Seq]" = deque()
+        self._running: List[_Seq] = []
+        self._sid = itertools.count()
+        self._admit_counter = itertools.count()
+        self._stopping = False
+        self._draining = False
+        self._crashed: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        # sequences popped from _waiting whose prefill is in flight
+        # with the lock released — still live work (idle/depth checks
+        # must count them or a mid-admission engine looks idle)
+        self._admitting = 0
+        self._n_preempt = 0
+        self._n_ticks = 0
+        self._n_tokens = 0
+        self._n_finished = 0
+        from .kvcache import _gauge_tag
+        tag = _gauge_tag(name)
+        self._m_inflight = _metrics.gauge(
+            f"mxserve2_inflight_seqs_{tag}",
+            f"sequences currently decoding in engine {name!r}")
+        self._m_waiting = _metrics.gauge(
+            f"mxserve2_waiting_seqs_{tag}",
+            f"sequences queued for admission in engine {name!r}")
+        self._m_preempt = _metrics.counter(
+            "mxserve2_preemptions_total",
+            "sequences preempted on KV page-pool exhaustion")
+        self._m_ticks = _metrics.counter(
+            "mxserve2_ticks_total", "scheduler decode ticks")
+        self._m_tokens = _metrics.counter(
+            "mxserve2_tokens_total", "tokens generated by serve2")
+
+    # ------------------------------------------------------------------
+    # intake
+    # ------------------------------------------------------------------
+    def warmup(self, input_specs=None) -> List[dict]:
+        """AOT-compile every decode batch rung and prefill length rung
+        (the ``ServingEngine.warmup`` contract; ``input_specs`` is
+        accepted for duck-type compatibility and ignored)."""
+        return self.lm.warmup(self.decode_rungs, self.prefill_rungs)
+
+    @property
+    def warmed(self) -> bool:
+        return self.lm.warmed
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None
+               ) -> GenerationHandle:
+        """Enqueue one prompt (1-D int sequence); non-blocking."""
+        from ..resil import faultplan as _faultplan
+        prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise InvalidRequestError("empty prompt")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.max_new_default)
+        if max_new < 1:
+            raise InvalidRequestError("max_new_tokens must be >= 1")
+        # cap on the CONFIGURED buckets, not the internal max_seq_len
+        # rung that only exists for post-preemption re-prefills — the
+        # MXSERVE2_PREFILL_BUCKETS doc promises rejection past its top
+        top = self._configured_prefill_top
+        if len(prompt) > min(top, self.max_seq_len):
+            raise BucketOverflowError(
+                f"prompt of {len(prompt)} tokens exceeds the prefill "
+                f"ladder top {top} / max_seq_len {self.max_seq_len}")
+        if len(prompt) + max_new > self.max_seq_len:
+            raise BucketOverflowError(
+                f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        if pages_needed(len(prompt) + max_new, self.page_size) \
+                > self.num_pages - 1:
+            raise PagePoolExhausted(
+                f"request needs more pages than the whole pool "
+                f"({self.num_pages - 1}) holds")
+        _faultplan.inject("serve2.submit")
+        seq = _Seq(next(self._sid), prompt, max_new)
+        with self._cv:
+            if self._crashed is not None:
+                raise EngineCrashedError(
+                    f"engine {self.name!r} scheduler crashed: "
+                    f"{self._crashed!r}") from self._crashed
+            if self._stopping or self._draining:
+                raise BatcherStoppedError(
+                    f"engine {self.name!r} is "
+                    + ("draining" if self._draining else "stopped"))
+            self._waiting.append(seq)
+            self._m_waiting.set(len(self._waiting))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name=f"{self.name}-decode",
+                    daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+        return seq.handle
+
+    def predict(self, data, timeout_ms: Optional[float] = None):
+        """Router/endpoint-facing call: submit one prompt, wait for the
+        generated ids. ``data`` is a 1-D token sequence (a single-row
+        2-D array is flattened). Same error surface as
+        ``ServingEngine.predict``."""
+        arr = onp.asarray(data)
+        if arr.ndim == 2 and arr.shape[0] == 1:
+            arr = arr[0]
+        if arr.ndim != 1:
+            raise InvalidRequestError(
+                f"DecodeEngine.predict takes one prompt (1-D token "
+                f"ids), got shape {arr.shape}")
+        handle = self.submit(arr)
+        budget = timeout_ms / 1000.0 if timeout_ms is not None else None
+        if not handle.wait(budget):
+            handle.cancelled = True
+            with self._cv:
+                self._cv.notify_all()
+            raise DeadlineExceededError(
+                f"generation exceeded {timeout_ms} ms "
+                f"(engine {self.name!r})")
+        if handle.error is not None:
+            raise handle.error
+        return handle.result
+
+    # ------------------------------------------------------------------
+    # scheduler
+    # ------------------------------------------------------------------
+    def _loop(self):
+        try:
+            while True:
+                with self._cv:
+                    while not (self._waiting or self._running
+                               or self._stopping):
+                        self._cv.wait()
+                    if self._stopping and not (self._waiting
+                                               or self._running):
+                        return
+                self.tick()
+                with self._cv:
+                    # wake run_until_idle/drain waiters — they re-check
+                    # the queues themselves
+                    self._cv.notify_all()
+        except BaseException as e:  # noqa: BLE001 — fail fast, loudly
+            self._crash(e)
+
+    def _crash(self, exc: BaseException):
+        with self._cv:
+            self._crashed = exc
+            self._stopping = True
+            pending = list(self._waiting) + list(self._running)
+            self._waiting.clear()
+            self._running = []
+            self._cv.notify_all()
+        err = EngineCrashedError(
+            f"engine {self.name!r} scheduler crashed: {exc!r}")
+        err.__cause__ = exc
+        for s in pending:
+            if s.bt is not None and s.bt.pages:
+                try:
+                    self.alloc.free(s.bt.pages)
+                except MXNetError:
+                    pass
+            s.handle.error = err
+            s.handle.event.set()
+
+    def tick(self):
+        """One scheduler iteration: admit, grow/preempt, decode-window,
+        finish. Callers must NOT hold ``_cv`` — the tick takes it for
+        host-side bookkeeping only and releases it around the compiled
+        prefill/decode dispatches, so ``submit``/``queue_depth`` (the
+        router's depth-aware pick) stay responsive during a window.
+        Sequence state (``bt``/``generated``) is mutated by the
+        scheduler thread only, so reading it between lock windows is
+        safe."""
+        # -- admit ------------------------------------------------------
+        while True:
+            with self._cv:
+                seq = None
+                while self._waiting and \
+                        len(self._running) < self.max_inflight:
+                    cand = self._waiting[0]
+                    if cand.handle.cancelled:
+                        self._waiting.popleft()
+                        self._resolve(cand)
+                        continue
+                    eff = cand.effective_prompt()
+                    need = pages_needed(len(eff), self.page_size)
+                    if not self.alloc.can_alloc(need):
+                        break
+                    self._waiting.popleft()
+                    self._admitting += 1
+                    seq = cand
+                    break
+            if seq is None:
+                break
+            try:
+                bt = BlockTable(self.page_size)
+                bt.pages = self.alloc.alloc(need)
+                seq.bt = bt
+                rung = min(r for r in self.prefill_rungs
+                           if r >= len(eff))
+                padded = onp.zeros((rung,), "int32")
+                padded[:len(eff)] = eff
+                # device dispatch, lock released
+                nxt, _ = self.lm.prefill(padded, len(eff),
+                                         bt.row(self.max_pages_per_seq))
+            except BaseException:
+                # put the seq back where _crash (via the caller's
+                # except) can see and fail it — never strand a handle
+                with self._cv:
+                    self._admitting -= 1
+                    self._waiting.appendleft(seq)
+                raise
+            bt.length = len(eff)
+            seq.generated.append(int(nxt))
+            with self._cv:
+                self._admitting -= 1
+                self._n_tokens += 1
+                self._m_tokens.inc()
+                seq.admit_idx = next(self._admit_counter)
+                self._running.append(seq)
+                self._finish_if_done(seq)
+        # -- grow / preempt --------------------------------------------
+        # each running sequence needs page capacity for its next
+        # decode WINDOW (min(decode_steps, tokens still wanted))
+        with self._cv:
+            for seq in list(self._running):
+                if seq not in self._running:
+                    continue  # preempted below while growing another
+                want = min(self.decode_steps,
+                           seq.max_new - len(seq.generated))
+                while seq in self._running and seq.bt.needs_page(want):
+                    try:
+                        seq.bt.pages.extend(self.alloc.alloc(1))
+                    except PagePoolExhausted:
+                        victim = max(self._running,
+                                     key=lambda s: s.admit_idx)
+                        self._preempt(victim)
+            seqs = sorted(self._running, key=lambda s: s.admit_idx)
+        # -- decode window ----------------------------------------------
+        if seqs:
+            n = len(seqs)
+            rung = min(r for r in self.decode_rungs if r >= n)
+            N = self.max_pages_per_seq
+            bt = onp.zeros((rung, N), "int32")
+            lengths = onp.zeros((rung,), "int32")
+            tokens = onp.zeros((rung,), "int32")
+            remaining = onp.zeros((rung,), "int32")
+            for i, s in enumerate(seqs):
+                s.bt.row(N, out=bt[i])
+                lengths[i] = s.bt.length
+                tokens[i] = s.generated[-1]
+                remaining[i] = min(self.decode_steps,
+                                   s.max_new - len(s.generated))
+            # device dispatch, lock released
+            out, _ = self.lm.decode(bt, lengths, tokens, remaining)
+            with self._cv:
+                for i, s in enumerate(seqs):
+                    taken = int(remaining[i])
+                    new_toks = [int(t) for t in out[i, :taken]]
+                    if self.eos_id is not None \
+                            and self.eos_id in new_toks:
+                        new_toks = new_toks[
+                            :new_toks.index(self.eos_id) + 1]
+                    s.bt.length += taken
+                    s.generated.extend(new_toks)
+                    self._n_tokens += len(new_toks)
+                    self._m_tokens.inc(len(new_toks))
+                for s in seqs:
+                    self._finish_if_done(s)
+        with self._cv:
+            self._n_ticks += 1
+            self._m_ticks.inc()
+            self._m_inflight.set(len(self._running))
+            self._m_waiting.set(len(self._waiting))
+
+    def _preempt(self, seq: _Seq):
+        """Recompute-preemption: drop the cache, requeue at the front.
+        The generated-so-far tokens fold into the effective prompt, so
+        the continuation is greedy-identical to an uninterrupted run."""
+        self.alloc.free(seq.bt.pages)
+        seq.bt = None
+        self._running.remove(seq)
+        self._waiting.appendleft(seq)
+        self._n_preempt += 1
+        self._m_preempt.inc()
+        self._m_waiting.set(len(self._waiting))
+
+    def _finish_if_done(self, seq: _Seq):
+        done = (len(seq.generated) >= seq.max_new
+                or (self.eos_id is not None
+                    and seq.generated[-1] == self.eos_id)
+                or seq.handle.cancelled)
+        if not done:
+            return
+        if seq.bt is not None:
+            self.alloc.free(seq.bt.pages)
+            seq.bt = None
+        self._running.remove(seq)
+        self._resolve(seq)
+
+    def _resolve(self, seq: _Seq):
+        self._n_finished += 1
+        seq.handle.result = onp.asarray(seq.generated, "int32")
+        seq.handle.event.set()
+
+    # ------------------------------------------------------------------
+    # lifecycle / introspection
+    # ------------------------------------------------------------------
+    def run_until_idle(self, timeout: Optional[float] = 60.0) -> bool:
+        """Block until no work remains (tests / drain)."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        while True:
+            with self._cv:
+                self._cv.notify_all()
+                if not (self._waiting or self._running
+                        or self._admitting):
+                    return True
+                # work implies a live scheduler thread: submit() starts
+                # it under this lock before enqueueing ever returns
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining if remaining is not None
+                              else 0.1)
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return (len(self._waiting) + len(self._running)
+                    + self._admitting)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        return self.run_until_idle(timeout)
+
+    def close(self):
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10.0)
+        # retire the per-engine-name gauges: after a rolling reload the
+        # old version's pool must not linger in /metrics as a live one
+        self.alloc.retire_gauges()
+        _metrics.unregister(self._m_inflight.name)
+        _metrics.unregister(self._m_waiting.name)
+
+    def stats(self) -> dict:
+        with self._cv:
+            waiting, running = len(self._waiting), len(self._running)
+        out = {
+            "name": self.name,
+            "kind": "decode",
+            "warmed": self.warmed,
+            "inflight": running,
+            "waiting": waiting,
+            "max_inflight": self.max_inflight,
+            "decode_rungs": list(self.decode_rungs),
+            "prefill_rungs": list(self.prefill_rungs),
+            "max_seq_len": self.max_seq_len,
+            "pages": self.alloc.stats(),
+            "preemptions": self._n_preempt,
+            "ticks": self._n_ticks,
+            "tokens_generated": self._n_tokens,
+            "finished": self._n_finished,
+            "draining": self._draining,
+        }
+        rep = self.lm.lint_report()
+        out["recompiles_after_warmup"] = rep["recompiles_after_warmup"]
+        out["programs_compiled"] = len(rep["compiled"])
+        return out
+
+    def lint_report(self) -> dict:
+        """servelint's view: the PagedLM compile report plus the
+        scheduler's declared ladders."""
+        rep = self.lm.lint_report()
+        rep["max_inflight"] = self.max_inflight
+        rep["declared_decode_rungs"] = self.decode_rungs
+        rep["declared_prefill_rungs"] = self.prefill_rungs
+        return rep
+
+    def __repr__(self):
+        return (f"DecodeEngine({self.name!r}, rungs="
+                f"{self.decode_rungs}, pages={self.num_pages}x"
+                f"{self.page_size}, warmed={self.warmed})")

@@ -201,6 +201,14 @@ def histogram(name: str, doc: str = "") -> Histogram:
     return _get_or_create(Histogram, name, doc)
 
 
+def unregister(name: str) -> bool:
+    """Drop one instrument by name (per-engine gauges when their engine
+    is closed/retired — a reload must not leave dead pools looking like
+    live fully-free ones in ``/metrics``)."""
+    with _LOCK:
+        return _METRICS.pop(name, None) is not None
+
+
 def all_metrics() -> Dict[str, Metric]:
     with _LOCK:
         return dict(_METRICS)

@@ -79,3 +79,43 @@ def test_bench_graph_opt_emits_mxopt_speedup():
         "conv_bn_relu", 0) >= 1
     assert models["lm"]["levels"][2]["fused_census"].get(
         "attention", 0) >= 1
+
+
+@pytest.mark.slow
+def test_bench_serving2_emits_mxserve2_throughput():
+    """--serving2 contract: one mxserve2_throughput JSON line — serve2
+    requests/sec, the PR-3 single-engine baseline and the speedup, zero
+    after-warmup recompiles across BOTH phases, zero request errors,
+    and a rolling reload performed mid-load with zero dropped requests.
+    Reduced knobs keep this a contract check (shape + invariants);
+    the acceptance-scale speedup number comes from the default knobs."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_SERVE2_LM_REQUESTS": "8",
+        "MXTPU_BENCH_SERVE2_CNN_REQUESTS": "8",
+        "MXTPU_BENCH_SERVE2_CONCURRENCY": "8",
+        "MXTPU_BENCH_SERVE2_MAX_NEW": "48",
+        "MXTPU_BENCH_SERVE2_DMODEL": "64",
+        "MXTPU_BENCH_SERVE2_INFLIGHT": "8",
+        "MXTPU_BENCH_TIMEOUT": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--serving2"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxserve2_throughput"
+    assert data["value"] is not None and data["value"] > 0, data
+    assert data["errors"] == 0 and data["baseline_errors"] == 0, data
+    assert data["recompiles_after_warmup"] == 0, data
+    assert data["speedup_vs_single_engine"] is not None \
+        and data["speedup_vs_single_engine"] > 1.0, data
+    assert data["reload_during_load"] is True, data
+    assert data["reload_dropped"] == 0, data
+    assert data["reload_new_version"] == 2, data
+    assert data["open_errors"] == 0, data
+    assert data["open_p99_ms"] >= data["open_p50_ms"] > 0, data

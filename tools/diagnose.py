@@ -180,6 +180,42 @@ def check_serving():
               "bucket ladder does not close the jit cache")
 
 
+def check_serving2():
+    """Serving-v2 health: pool/scheduler flags and the mxserve2_*
+    metrics (mxnet_tpu/serve2/; docs/serving.md v2 section)."""
+    print("----------Serving v2 (mxserve2)----------")
+    try:
+        from mxnet_tpu import config, telemetry
+    except Exception as e:
+        print("serve2       : unavailable (%s)" % e)
+        return
+    page = config.get("MXSERVE2_PAGE_SIZE")
+    pages = config.get("MXSERVE2_NUM_PAGES")
+    print("kv pool      : %s pages x %s tokens (%s slots)"
+          % (pages, page, pages * page))
+    print("max inflight :", config.get("MXSERVE2_MAX_INFLIGHT"))
+    print("decode steps :", config.get("MXSERVE2_DECODE_STEPS"),
+          "(tokens per compiled dispatch)")
+    print("prefill rungs:", config.get("MXSERVE2_PREFILL_BUCKETS"))
+    print("replicas     :", config.get("MXSERVE2_REPLICAS"))
+    print("reload drain :", config.get("MXSERVE2_RELOAD_DRAIN_TIMEOUT_S"),
+          "s")
+    snap = telemetry.snapshot()
+    served = {k: v for k, v in snap.items()
+              if k.startswith("mxserve2_")}
+    if not served:
+        print("metrics      : none (no serve2 engine has run in this "
+              "process)")
+        return
+    for k, v in sorted(served.items()):
+        print(f"  {k} = {v}")
+    after = snap.get("mxserve2_recompile_after_warmup_total", 0)
+    if after:
+        print(f"  WARNING: {after} decode/prefill compile(s) after "
+              "warmup — some caller bypassed the rung ladder "
+              "(run tools/mxlint.py --serve)")
+
+
 def check_resilience():
     """Fault-tolerance health: active fault plan, retry/breaker/watchdog
     flags, breaker states, mxresil_* metrics, last emergency checkpoint
@@ -239,6 +275,7 @@ def main():
     check_mxnet()
     check_telemetry()
     check_serving()
+    check_serving2()
     check_resilience()
     check_mxlint()
 

@@ -167,6 +167,63 @@ def _selfcheck_opt_findings():
     return findings
 
 
+def _selfcheck_serve_findings():
+    """servelint self-check: warm a tiny continuous-batching decode
+    engine, run a few generations through admit/step/finish, and lint
+    the closed-cache/donation contract. A clean engine must lint clean
+    (CPU donation note aside), and — coverage check on the lint itself —
+    a synthetic report with an off-rung program and an undonated pool
+    on TPU MUST fire the corresponding error findings."""
+    import numpy as onp
+    from mxnet_tpu.parallel.pipeline_lm import init_pipeline_lm
+    from mxnet_tpu.passes import Finding
+    from mxnet_tpu.passes.servelint import lint_serve_report
+    from mxnet_tpu.serve2 import DecodeEngine
+
+    params = init_pipeline_lm(0, vocab=32, d_model=16, n_layers=2,
+                              n_heads=2, d_head=8, d_ff=32, n_experts=2)
+    engine = DecodeEngine(params, page_size=4, num_pages=16,
+                          max_inflight=2, prefill_buckets=[8],
+                          max_new_default=3, max_seq_len=16,
+                          name="<self-check serve>")
+    try:
+        engine.warmup()
+        rs = onp.random.RandomState(0)
+        for _ in range(3):
+            engine.submit(rs.randint(0, 32, size=(5,)), max_new_tokens=3)
+        if not engine.run_until_idle(60.0):
+            return [Finding("servelint", "selfcheck-hang",
+                            "<self-check serve>", "error",
+                            "self-check generations did not finish")]
+        findings = [f for f in lint_serve_report(engine.lint_report())
+                    if f.check != "pool-donate-cpu"]
+    finally:
+        engine.close()
+    # the lint must FIRE on a bad report (off-rung compile + undonated
+    # accelerator pool) — otherwise the pass is vacuous
+    bad = {"name": "<bad fixture>", "warmed": True,
+           "decode_rungs": (1, 2), "prefill_rungs": (8,),
+           "compiled": [("decode", 3), ("prefill", 8)],
+           "donate_mode": "off", "donate_pages": False,
+           "backend": "tpu", "recompiles_after_warmup": 1}
+    fired = {f.check for f in lint_serve_report(bad)}
+    for check in ("off-rung-shape", "pool-not-donated",
+                  "recompile-after-warmup"):
+        if check not in fired:
+            findings.append(Finding(
+                "servelint", "selfcheck-coverage", "<bad fixture>",
+                "error",
+                f"lint did not fire {check!r} on the fixture built to "
+                "trigger it"))
+    findings.append(Finding(
+        "servelint", "selfcheck-summary", "<self-check serve>", "info",
+        f"decode rungs {engine.decode_rungs}, prefill rungs "
+        f"{engine.prefill_rungs}, "
+        f"{engine.stats()['programs_compiled']} programs, "
+        "bad-fixture coverage exercised"))
+    return findings
+
+
 def _selfcheck_block_findings():
     """tracercheck over a small hybridized block — a clean forward must
     produce no tracer findings."""
@@ -196,6 +253,11 @@ def main(argv=None):
                    help="shardlint self-check: compile a tiny GSPMD-"
                         "sharded fused step over the local devices and "
                         "verify its HLO sharding annotations")
+    p.add_argument("--serve", action="store_true", dest="serve_check",
+                   help="servelint self-check: warm a tiny continuous-"
+                        "batching decode engine and lint its compiled "
+                        "shapes (bucket-rung-exact) and KV page-pool "
+                        "donation")
     p.add_argument("--opt", action="store_true", dest="opt_check",
                    help="graph-optimizer self-check: run the level-2 "
                         "rewrite pipeline on a fixture graph, report "
@@ -215,9 +277,9 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     if not (args.ops or args.all or args.graphs or args.shard
-            or args.opt_check):
-        p.error("nothing to do: pass --ops, --all, --shard, --opt, or "
-                "graph JSON files")
+            or args.opt_check or args.serve_check):
+        p.error("nothing to do: pass --ops, --all, --shard, --opt, "
+                "--serve, or graph JSON files")
 
     if args.shard and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -286,6 +348,10 @@ def main(argv=None):
         of = _selfcheck_opt_findings()
         findings.extend(of)
         sections.append(("mxopt", "<self-check optimizer>", of))
+    if args.serve_check:
+        sv = _selfcheck_serve_findings()
+        findings.extend(sv)
+        sections.append(("servelint", "<self-check decode engine>", sv))
 
     counts = severity_counts(findings)
     if args.as_json:

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""mxserve CLI: serve / warmup / loadgen for the serving subsystem.
+"""mxserve CLI: serve / warmup / loadgen / route / reload.
 
 Subcommands (see docs/serving.md):
 
@@ -10,11 +10,23 @@ Subcommands (see docs/serving.md):
   warmup   AOT-compile every bucket rung and print the per-program
            compile-time report (ladder tuning aid)
            python tools/mxserve.py warmup --buckets 1,2,4,8 --json
-  loadgen  closed-loop load generator: N concurrent workers firing
-           mixed-shape requests at an in-process engine (default) or a
-           running endpoint (--url), reporting p50/p99 latency,
-           throughput, batch occupancy and after-warmup recompiles
-           python tools/mxserve.py loadgen --requests 200 --concurrency 8
+  loadgen  load generator against an in-process engine (default) or a
+           running endpoint (--url). Closed-loop by default (capacity);
+           --qps N switches to OPEN-loop Poisson arrivals at the target
+           rate, reporting honest p50/p99 + timeout rate (serve2 SLO
+           mode)
+           python tools/mxserve.py loadgen --requests 200 --qps 50
+  route    start the serve2 router tier: N engine replicas per model
+           group from a replica spec (JSON/YAML file via --spec, or the
+           built-in MLP with --replicas), behind the HTTP endpoint
+           with breaker-aware routing and POST /admin/reload
+           python tools/mxserve.py route --replicas 2 --port 8080
+  reload   trigger a zero-downtime rolling model reload. With --url,
+           POSTs /admin/reload to a running `route` server; without,
+           runs an in-process demo (router under load, reload
+           mid-load) and prints the drained/dropped report
+           python tools/mxserve.py reload --url http://127.0.0.1:8080 \\
+               --model default
 
 Without --symbol a built-in 2-layer MLP is served, so every subcommand
 runs out of the box (smoke tests, ladder tuning, CI).
@@ -110,7 +122,11 @@ def cmd_loadgen(args):
     import numpy as onp
 
     if args.url:
+        import socket
+        import urllib.error
         import urllib.request
+
+        from mxnet_tpu.serve.batcher import DeadlineExceededError
 
         # forward the deadline so the server-side batcher enforces it,
         # and give the client socket a little headroom on top
@@ -122,9 +138,26 @@ def cmd_loadgen(args):
             req = urllib.request.Request(
                 f"{args.url}/v1/models/{args.name}:predict", data=body,
                 headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req,
-                                        timeout=client_timeout) as resp:
-                json.loads(resp.read())
+            # map the HTTP shapes of a deadline miss back onto
+            # DeadlineExceededError so open-loop timeout_rate stays
+            # honest over the wire, not just in-process
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=client_timeout) as resp:
+                    json.loads(resp.read())
+            except socket.timeout as e:
+                raise DeadlineExceededError(
+                    f"client timeout after {client_timeout}s") from e
+            except urllib.error.HTTPError as e:
+                if e.code == 504:  # endpoint's DeadlineExceededError
+                    raise DeadlineExceededError(
+                        f"server deadline: {e.read()[:200]!r}") from e
+                raise
+            except urllib.error.URLError as e:
+                if isinstance(e.reason, (socket.timeout, TimeoutError)):
+                    raise DeadlineExceededError(
+                        f"client timeout after {client_timeout}s") from e
+                raise
         engine = None
         item_shape = tuple(
             int(s) for s in args.input_shape.split(",")) \
@@ -137,18 +170,27 @@ def cmd_loadgen(args):
             engine.predict(payload, timeout_ms=args.timeout_ms)
 
     from mxnet_tpu import telemetry
-    from mxnet_tpu.serve.loadgen import run_loadgen
+    from mxnet_tpu.serve.batcher import DeadlineExceededError
+    from mxnet_tpu.serve.loadgen import run_loadgen, run_loadgen_open
     recompiles_before = telemetry.recompile_count()
     rng = onp.random.RandomState(0)
     payloads = [rng.uniform(-1, 1, size=(1 + (i % args.max_rows),)
                             + item_shape).astype("float32")
                 for i in range(args.requests)]
-    res = run_loadgen(fire, payloads, concurrency=args.concurrency)
+    if args.qps > 0:
+        res = run_loadgen_open(fire, payloads, qps=args.qps,
+                               concurrency=args.concurrency,
+                               timeout_errors=(DeadlineExceededError,))
+        value = round(res["achieved_qps"], 2)
+    else:
+        res = run_loadgen(fire, payloads, concurrency=args.concurrency)
+        value = round(res["throughput_rps"], 2)
     errors = res["errors"]
     out = {
         "metric": "mxserve_throughput",
-        "value": round(res["throughput_rps"], 2),
+        "value": value,
         "unit": "requests/sec",
+        "mode": "open" if args.qps > 0 else "closed",
         "requests": args.requests,
         "completed": res["completed"],
         "errors": len(errors),
@@ -159,6 +201,11 @@ def cmd_loadgen(args):
         "recompiles_during_load":
             telemetry.recompile_count() - recompiles_before,
     }
+    if args.qps > 0:
+        out.update(offered_qps=args.qps,
+                   timeouts=res["timeouts"],
+                   timeout_rate=round(res["timeout_rate"], 4),
+                   late_starts=res["late_starts"])
     if engine is not None:
         stats = engine.stats()
         out["recompiles_after_warmup"] = stats["recompiles_after_warmup"]
@@ -169,6 +216,153 @@ def cmd_loadgen(args):
         print(f"errors ({len(errors)}):", errors[:3], file=sys.stderr)
     print(json.dumps(out))
     return 0 if not errors else 1
+
+
+def _load_spec(path):
+    """Replica spec file: YAML when PyYAML is importable, JSON always.
+    Shape: {"models": [{"name", "kind": "mlp"|"lm", "replicas", ...}]}"""
+    with open(path) as f:
+        text = f.read()
+    try:
+        import yaml  # optional — the container may not ship it
+        return yaml.safe_load(text)
+    except ImportError:
+        return json.loads(text)
+
+
+def _group_factory(cfg, args, name):
+    """Engine factory for one replica-spec entry;
+    ``factory(version, replica)`` builds a FRESH engine (a model reload
+    in this demo stack is a fresh init — real deployments load new
+    weights here). ``replica`` keeps sibling engine names unique so
+    their per-engine gauges never collide."""
+    kind = cfg.get("kind", "mlp")
+    if kind == "lm":
+        from mxnet_tpu.parallel.pipeline_lm import init_pipeline_lm
+        from mxnet_tpu.serve2 import DecodeEngine
+
+        def factory(version, replica):
+            params = init_pipeline_lm(
+                int(cfg.get("seed", 0)) + version,
+                vocab=int(cfg.get("vocab", 64)),
+                d_model=int(cfg.get("d_model", 32)),
+                n_layers=int(cfg.get("n_layers", 2)),
+                n_heads=int(cfg.get("n_heads", 2)),
+                d_head=int(cfg.get("d_head", 16)),
+                d_ff=int(cfg.get("d_ff", 64)),
+                n_experts=int(cfg.get("n_experts", 2)))
+            return DecodeEngine(
+                params, name=f"{name}-r{replica}-v{version}",
+                max_new_default=int(cfg.get("max_new", 16)))
+        return factory
+
+    from mxnet_tpu import serve
+
+    def factory(version, replica):
+        import argparse as _ap
+        margs = _ap.Namespace(**vars(args))
+        margs.symbol = cfg.get("symbol", args.symbol)
+        margs.params = cfg.get("params", args.params)
+        margs.input_shape = cfg.get("input_shape", args.input_shape)
+        margs.feature = int(cfg.get("feature", args.feature))
+        model, item_shape = _build_model(margs)
+        buckets = cfg.get("buckets", args.buckets)
+        ladder = serve.parse_bucket_spec(buckets) if buckets else None
+        return serve.ServingEngine(
+            model, input_specs=[item_shape], ladder=ladder,
+            name=f"{name}-r{replica}-v{version}",
+            max_linger_ms=args.linger_ms)
+    return factory
+
+
+def cmd_route(args):
+    _init_backend(args)
+    from mxnet_tpu import serve
+    from mxnet_tpu.serve2 import Router
+    if args.spec:
+        spec = _load_spec(args.spec)
+    else:
+        spec = {"models": [{"name": args.name, "kind": "mlp",
+                            "replicas": args.replicas}]}
+    router = Router(name="mxserve-router")
+    front = serve.ModelRegistry()
+    for m in spec.get("models", []):
+        name = m["name"]
+        nrep = m.get("replicas", args.replicas)
+        router.add_group(name, _group_factory(m, args, name),
+                         n_replicas=None if nrep is None else int(nrep))
+        front.register(name, router.frontend(name))
+    endpoint = serve.ServingEndpoint(
+        front, host=args.host, port=args.port, verbose=args.verbose,
+        reloader=router.rolling_reload)
+    print(f"mxserve route: {', '.join(router.models())} on "
+          f"{endpoint.address} "
+          f"({sum(len(g.replicas) for g in router._groups.values())} "
+          f"replicas; POST /admin/reload for a rolling reload)")
+    try:
+        endpoint.start(background=False)
+    except KeyboardInterrupt:
+        print("mxserve route: draining...")
+        endpoint.drain()
+        router.close()
+    return 0
+
+
+def cmd_reload(args):
+    if args.url:
+        import urllib.error
+        import urllib.request
+        body = json.dumps({"model": args.model}).encode()
+        req = urllib.request.Request(
+            f"{args.url}/admin/reload", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=args.timeout_s) as r:
+                report = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # surface the endpoint's JSON error report, not a traceback
+            print(e.read().decode("utf-8", "replace") or
+                  json.dumps({"error": str(e)}), file=sys.stderr)
+            return 1
+        print(json.dumps(report))
+        return 0 if report.get("dropped", 1) == 0 else 1
+
+    # in-process demo: reload a 2-replica router while a closed-loop
+    # load runs against it — the drained/dropped numbers are the point
+    _init_backend(args)
+    import threading
+
+    import numpy as onp
+
+    from mxnet_tpu.serve2 import Router
+    from mxnet_tpu.serve.loadgen import run_loadgen
+    router = Router(name="reload-demo")
+    router.add_group(args.model,
+                     _group_factory({"kind": "mlp"}, args, args.model),
+                     n_replicas=args.replicas)
+    rng = onp.random.RandomState(0)
+    payloads = [rng.uniform(-1, 1, size=(1 + (i % 4), args.feature))
+                .astype("float32") for i in range(args.requests)]
+    report_box = {}
+
+    def _reload_mid_load():
+        time.sleep(0.2)
+        report_box["reload"] = router.rolling_reload(args.model)
+
+    t = threading.Thread(target=_reload_mid_load, daemon=True)
+    t.start()
+    res = run_loadgen(
+        lambda p: router.predict(args.model, p, timeout_ms=30000.0),
+        payloads, concurrency=args.concurrency)
+    t.join(timeout=60.0)
+    out = dict(report_box.get("reload", {"error": "reload did not run"}))
+    out.update(load_completed=res["completed"],
+               load_errors=len(res["errors"]),
+               load_p99_ms=round(res["p99_ms"], 3))
+    router.close()
+    print(json.dumps(out))
+    return 0 if out.get("dropped", 1) == 0 and not res["errors"] else 1
 
 
 def main(argv=None):
@@ -207,7 +401,7 @@ def main(argv=None):
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_warmup)
 
-    sp = sub.add_parser("loadgen", help="closed-loop load generator")
+    sp = sub.add_parser("loadgen", help="closed/open-loop load generator")
     common(sp)
     sp.add_argument("--url", default="",
                     help="target a running endpoint instead of in-process")
@@ -216,8 +410,41 @@ def main(argv=None):
     sp.add_argument("--max-rows", type=int, default=4,
                     help="request row counts cycle 1..max-rows")
     sp.add_argument("--timeout-ms", type=float, default=30000.0)
+    sp.add_argument("--qps", type=float, default=0.0,
+                    help="open-loop mode: Poisson arrivals at this "
+                         "target rate (0 = closed loop); reports "
+                         "honest p50/p99 + timeout rate")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_loadgen)
+
+    sp = sub.add_parser("route", help="serve2 router over N replicas")
+    common(sp)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument("--spec", default="",
+                    help="replica spec file (JSON/YAML): {'models': "
+                         "[{'name', 'kind': 'mlp'|'lm', 'replicas', "
+                         "...}]}")
+    sp.add_argument("--replicas", type=int, default=None,
+                    help="replicas per group (default: "
+                         "MXSERVE2_REPLICAS)")
+    sp.add_argument("--verbose", action="store_true")
+    sp.set_defaults(fn=cmd_route)
+
+    sp = sub.add_parser("reload", help="trigger a rolling model reload")
+    common(sp)
+    sp.add_argument("--url", default="",
+                    help="running `route` endpoint; omitted = run the "
+                         "in-process reload-under-load demo")
+    sp.add_argument("--model", default="default",
+                    help="model group to reload")
+    sp.add_argument("--replicas", type=int, default=2,
+                    help="demo mode: replicas in the demo router")
+    sp.add_argument("--requests", type=int, default=120,
+                    help="demo mode: load during the reload")
+    sp.add_argument("--concurrency", type=int, default=8)
+    sp.add_argument("--timeout-s", type=float, default=300.0)
+    sp.set_defaults(fn=cmd_reload)
 
     args = p.parse_args(argv)
     return args.fn(args)
