@@ -1010,6 +1010,70 @@ def chaos_main():
     _emit(ratio, unit="recovered/baseline throughput ratio", **record)
 
 
+def elastic_main():
+    """Elastic-membership recovery benchmark (--elastic /
+    MXTPU_BENCH_ELASTIC=1): the 3-phase drill — full group, kill one
+    in-process worker via the thread-mode fault plan, rejoin a fresh
+    worker from group state-sync — against an uninterrupted baseline,
+    emitting ONE BENCH-schema JSON line (metric mxelastic_recovery,
+    value = post-shrink/pre-kill aggregate-throughput ratio). The
+    contract: ratio >= 0.6 at world N-1 (ideal (N-1)/N minus rebuild
+    cost on a contended host is ~1.0 here — the phases are
+    CPU-bound), recompiles_after_rebuild == 0 beyond the single
+    update-program re-key per generation, final loss within
+    MXELASTIC_LOSS_TOL of the baseline, and the rejoiner synced from
+    the GROUP (start_step > 0, no checkpoint file involved). Knobs:
+    MXTPU_BENCH_ELASTIC_{WORKERS,STEPS,KILL_STEP}."""
+    os.environ.setdefault("MXTPU_BENCH_FORCE_CPU", "1")  # threads on
+    jax, devices, probe_status = _init_jax()              # host CPU
+    from mxnet_tpu import config
+    from mxnet_tpu.elastic.drill import run_elastic_drill
+
+    n = int(os.environ.get("MXTPU_BENCH_ELASTIC_WORKERS", "3"))
+    steps = int(os.environ.get("MXTPU_BENCH_ELASTIC_STEPS", "48"))
+    kill_step = int(os.environ.get("MXTPU_BENCH_ELASTIC_KILL_STEP",
+                                   "12"))
+    common = dict(n_workers=n, steps=steps, batch=8,
+                  hb_interval=0.15, timeout_s=240.0)
+    baseline = run_elastic_drill(**common)
+    drill = run_elastic_drill(kill_step=kill_step, kill_rank=1,
+                              rejoin=True, rejoin_after_steps=10,
+                              **common)
+
+    tol = float(config.get("MXELASTIC_LOSS_TOL"))
+    base_loss, loss = baseline.get("final_loss"), drill.get("final_loss")
+    loss_delta = (abs(loss - base_loss) / max(abs(base_loss), 1e-9)
+                  if loss is not None and base_loss is not None
+                  else None)
+    ratio = drill.get("shrink_throughput_ratio")
+    joiner = drill["per_worker"].get(f"w{n}") or {}
+    record = dict(
+        metric="mxelastic_recovery",
+        workers=n, steps=steps, kill_step=kill_step,
+        recovery_s=drill.get("recovery_s"),
+        rate_full_samples_per_s=drill.get("rate_full_samples_per_s"),
+        rate_shrunk_samples_per_s=drill.get(
+            "rate_shrunk_samples_per_s"),
+        rate_rejoined_samples_per_s=drill.get(
+            "rate_rejoined_samples_per_s"),
+        recompiles_after_rebuild=drill.get("recompiles_after_rebuild"),
+        rekeys=drill.get("rekeys"),
+        final_loss=loss, baseline_loss=base_loss,
+        loss_delta_rel=(round(loss_delta, 6)
+                        if loss_delta is not None else None),
+        loss_tol=tol,
+        rejoin_synced_from_group=bool(
+            (joiner.get("start_step") or 0) > 0),
+        recovered=(ratio is not None and ratio >= 0.6
+                   and drill.get("recompiles_after_rebuild") == 0
+                   and loss_delta is not None and loss_delta <= tol
+                   and bool((joiner.get("start_step") or 0) > 0)),
+        platform=devices[0].platform,
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(ratio, unit="post-shrink/pre-kill aggregate throughput "
+                      "ratio", vs=None, **record)
+
+
 def graphopt_main():
     """Graph-optimizer A/B benchmark (--graph-opt / MXTPU_BENCH_GRAPHOPT
     =1): bind the same symbol-mode models at MXNET_GRAPH_OPT levels
@@ -1157,6 +1221,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_SHARD") == "1"
               else "mxopt_speedup"
               if os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
+              else "mxelastic_recovery"
+              if os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
               else "resnet50_train_throughput")
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
@@ -1205,6 +1271,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_SHARD"] = "1"
     if "--graph-opt" in sys.argv:
         os.environ["MXTPU_BENCH_GRAPHOPT"] = "1"
+    if "--elastic" in sys.argv:
+        os.environ["MXTPU_BENCH_ELASTIC"] = "1"
     # fused whole-train-step compiler: default ON; --no-fused-step
     # measures the eager reference path instead (env form propagates
     # into the --child subprocess)
@@ -1217,6 +1285,7 @@ if __name__ == "__main__":
     _chaos = os.environ.get("MXTPU_BENCH_CHAOS") == "1"
     _shard = os.environ.get("MXTPU_BENCH_SHARD") == "1"
     _graphopt = os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
+    _elastic = os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
     if "--child" in sys.argv:
         try:
             if _serving2:
@@ -1229,6 +1298,8 @@ if __name__ == "__main__":
                 shard_main()
             elif _graphopt:
                 graphopt_main()
+            elif _elastic:
+                elastic_main()
             else:
                 main()
         except Exception as e:
@@ -1238,6 +1309,7 @@ if __name__ == "__main__":
                           else "mxresil_chaos_recovery" if _chaos
                           else "mxshard_scaling" if _shard
                           else "mxopt_speedup" if _graphopt
+                          else "mxelastic_recovery" if _elastic
                           else "resnet50_train_throughput"),
                   error=f"{type(e).__name__}: {e}"[:500])
             sys.exit(0)

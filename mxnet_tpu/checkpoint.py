@@ -93,6 +93,7 @@ class CheckpointManager:
         restore tell (and log) that it is resharding."""
         self.check_error()
         shard_desc = None
+        elastic_desc = None
         if trainer is not None:
             plan = getattr(trainer, "_shard_plan", None)
             if plan is not None:
@@ -100,6 +101,16 @@ class CheckpointManager:
                     shard_desc = plan.describe()
                 except Exception:
                     shard_desc = None
+            ses = getattr(trainer, "_elastic", None)
+            if ses is not None and ses.view is not None:
+                # elastic membership: record which generation/world
+                # this snapshot was taken in, so a restore can tell a
+                # consistent group from a stale one (docs/resilience.md)
+                elastic_desc = {
+                    "generation": ses.generation,
+                    "world_size": ses.world,
+                    "worker_id": ses.worker_id,
+                    "samples": ses.samples_seen}
         if trainer is not None:
             # gluon.Trainer or parallel.ParallelTrainer
             if hasattr(trainer, "params") and isinstance(
@@ -126,26 +137,29 @@ class CheckpointManager:
         if self.async_save:
             self._thread = threading.Thread(
                 target=self._write, args=(step, host_params, opt_state,
-                                          extra, shard_desc), daemon=True)
+                                          extra, shard_desc,
+                                          elastic_desc), daemon=True)
             self._thread.start()
         else:
-            self._write(step, host_params, opt_state, extra, shard_desc)
+            self._write(step, host_params, opt_state, extra, shard_desc,
+                        elastic_desc)
 
     def _write(self, step, host_params, opt_state, extra,
-               shard_desc=None):
+               shard_desc=None, elastic_desc=None):
         try:
             # resil hook: retried on injected/transient faults — a
             # failed attempt cleans up its own temp dir and never
             # leaves a half-valid checkpoint, so blanket retry is sound
             from .resil.hooks import guarded as _guarded
             _guarded("checkpoint.write", self._write_attempt,
-                     step, host_params, opt_state, extra, shard_desc)
+                     step, host_params, opt_state, extra, shard_desc,
+                     elastic_desc)
             self._retain()
         except BaseException as e:  # surfaced on next save()/wait()
             self._error = e
 
     def _write_attempt(self, step, host_params, opt_state, extra,
-                       shard_desc=None):
+                       shard_desc=None, elastic_desc=None):
         """One crash-safe commit: payload into a temp dir, fsync every
         file, digest-carrying manifest last (also fsynced), atomic
         rename, directory fsync. A crash at ANY point leaves either the
@@ -192,6 +206,8 @@ class CheckpointManager:
                         "has_extra": extra is not None}
             if shard_desc is not None:
                 manifest["shard"] = shard_desc
+            if elastic_desc is not None:
+                manifest["elastic"] = elastic_desc
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
                 json.dump(manifest, f)
                 f.flush()
@@ -259,7 +275,8 @@ class CheckpointManager:
             "checkpoint.restore", self._restore_attempt, step)
         if trainer is not None:
             self._install(trainer, params, opt_state,
-                          shard=manifest.get("shard"))
+                          shard=manifest.get("shard"),
+                          elastic=manifest.get("elastic"))
         return params, opt_state, extra
 
     def _restore_attempt(self, step: int):
@@ -320,7 +337,7 @@ class CheckpointManager:
         return None
 
     @staticmethod
-    def _install(trainer, params, opt_state, shard=None):
+    def _install(trainer, params, opt_state, shard=None, elastic=None):
         """Install restored state into the trainer. When the manifest
         recorded a shard plan and the trainer carries one now, compare
         device counts and account the reshard: arrays land as host
@@ -328,6 +345,27 @@ class CheckpointManager:
         onto the CURRENT mesh on the next call — same compiled
         program, no recompile — so an 8-device checkpoint resumes on
         4 (or 16) with nothing but this log line to show for it."""
+        ses = getattr(trainer, "_elastic", None)
+        if elastic is not None and ses is not None and \
+                ses.view is not None:
+            saved_gen = int(elastic.get("generation", 0) or 0)
+            if saved_gen != ses.generation or \
+                    int(elastic.get("world_size", 0) or 0) != ses.world:
+                # the group moved on since this snapshot: restoring is
+                # legal (weights are group-identical at every step
+                # boundary) but the step/schedule accounting belongs
+                # to the recorded generation — surface it
+                from .telemetry import metrics as _metrics
+                _metrics.counter(
+                    "mxelastic_cross_generation_restores_total",
+                    "checkpoint restores into a different membership "
+                    "generation").inc()
+                _log.info(
+                    "elastic checkpoint: saved at generation %d "
+                    "(world %d), restoring into generation %d "
+                    "(world %d)", saved_gen,
+                    elastic.get("world_size"), ses.generation,
+                    ses.world)
         plan = getattr(trainer, "_shard_plan", None)
         if shard is not None and plan is not None:
             saved_n = int(shard.get("n_devices", 0) or 0)

@@ -470,6 +470,43 @@ register_flag(
     "scales ~1/N with data-parallel replicas. Off = optimizer state "
     "mirrors its weight's (usually replicated) sharding.")
 register_flag(
+    "MXELASTIC_HEARTBEAT_S", float, 2.0,
+    "Elastic-membership heartbeat interval in seconds (elastic."
+    "MembershipTracker): workers beat at every step boundary and "
+    "inside every blocked protocol wait; a worker silent for "
+    "MXELASTIC_HEARTBEAT_S x MXELASTIC_MISS_LIMIT seconds is declared "
+    "lost and the membership generation bumps, fencing in-flight "
+    "exchanges with the typed MembershipChanged "
+    "(docs/resilience.md elastic section).")
+register_flag(
+    "MXELASTIC_MISS_LIMIT", int, 3,
+    "Missed-heartbeat budget before a worker-lost verdict (elastic."
+    "MembershipTracker.check): lost_after = MXELASTIC_HEARTBEAT_S x "
+    "this. Lower = faster recovery after a hard kill, higher = more "
+    "tolerance for GC pauses / slow steps.")
+register_flag(
+    "MXELASTIC_MIN_WORLD", int, 1,
+    "Smallest world size elastic training may shrink to before the "
+    "group HARD-FAILS (elastic.MembershipTracker): below this, every "
+    "elastic operation raises GroupFailed so the cluster manager "
+    "restarts the job from checkpoint instead of limping on too few "
+    "workers.")
+register_flag(
+    "MXELASTIC_LR_SCALE", bool, True,
+    "Linear-scaling rule across membership changes (gluon Trainer."
+    "_on_membership_change): after a generation bump the learning "
+    "rate is set to base_lr x world/ref_world so per-sample update "
+    "magnitude tracks the shrunken/grown global batch. Schedulers are "
+    "instead driven through the session's virtual update counter "
+    "(samples-based step accounting). Off = LR untouched.")
+register_flag(
+    "MXELASTIC_LOSS_TOL", float, 0.15,
+    "Declared relative tolerance for the elastic loss-trajectory "
+    "contract: the final loss of a kill/rejoin drill must match the "
+    "uninterrupted run within this fraction (tools/mxresil.py "
+    "elastic, bench.py --elastic). The rescaled-batch/LR accounting "
+    "exists to keep runs inside it.")
+register_flag(
     "MXRESIL_WATCHDOG_STALL_S", float, 0.0,
     "Heartbeat age that counts as a stall (resil.watchdog.Watchdog). "
     "0 = auto: 10x the step-time EWMA (min 1 s; 30 s before any step "

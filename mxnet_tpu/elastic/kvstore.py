@@ -1,0 +1,208 @@
+"""ElasticKVStore: the synchronous data-parallel store that survives
+membership changes.
+
+``dist_sync`` maps the exchange onto collectives that wait forever on a
+dead peer; ``dist_async`` survives deaths but gives up synchronous
+semantics. This store keeps the synchronous contract — one flat-bucket
+allreduce per exchange, every live worker contributes — while fencing
+every round with the membership generation: a worker that dies mid-step
+turns the survivors' blocking wait into a typed
+:class:`~mxnet_tpu.elastic.membership.MembershipChanged` (absorbed by
+the gluon ``Trainer`` / ``ElasticStepFunction`` rebuild path) instead
+of a silent wedge.
+
+Two transports behind one ``group`` duck type:
+
+- in-process: pass the :class:`~mxnet_tpu.elastic.coordinator.
+  ElasticCoordinator` directly (the drill harness, tier-1 tests);
+- multi-process: :class:`RemoteGroup` speaks the ``elastic.*`` command
+  family of the rank-0 kvstore server (`kvstore_server.KVServer`) over
+  the same framed-pickle wire as ``dist_async`` — the server relays
+  typed membership errors so the worker-side rebuild logic is
+  transport-blind.
+
+``supports_flat_allreduce = True`` and ``elastic_abort =
+"generation"`` — the contract ``passes/elasticlint.py`` audits: any
+store claiming the flat-allreduce fast path must say how a blocked
+exchange aborts when a peer dies.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..base import MXNetError, get_logger
+from ..kvstore import KVStoreBase
+from ..ndarray.ndarray import NDArray, _wrap
+from .membership import MembershipChanged
+from .session import ElasticSession
+
+__all__ = ["ElasticKVStore", "RemoteGroup"]
+
+_log = get_logger("mxnet_tpu.elastic")
+
+
+class RemoteGroup:
+    """Worker-side proxy for a coordinator living inside the rank-0
+    kvstore server. Mirrors the ElasticCoordinator worker surface 1:1;
+    each call is one framed request (kvstore_server.KVClient), and the
+    server relays :class:`MembershipChanged` / eviction as typed
+    replies so rebuild logic cannot tell the transports apart."""
+
+    def __init__(self, address: Optional[str] = None,
+                 client=None):
+        from .. import kvstore_server as srv
+        if client is not None:
+            self._client = client
+        else:
+            if address is None:
+                address = srv.server_address()
+            if address is None:
+                raise MXNetError(
+                    "elastic RemoteGroup needs a server address: launch "
+                    "via tools/launch.py (exports MX_KV_SERVER) or set "
+                    "MX_KV_SERVER=host:port")
+            self._client = srv.KVClient(address)
+
+    def _req(self, op, **payload):
+        return self._client.request("elastic", op, payload)
+
+    def register(self, worker_id, devices=()):
+        return self._req("register", worker_id=worker_id,
+                         devices=tuple(devices))
+
+    def heartbeat(self, worker_id, step=None):
+        return self._req("heartbeat", worker_id=worker_id, step=step)
+
+    def leave(self, worker_id):
+        return self._req("leave", worker_id=worker_id)
+
+    def mark_lost(self, worker_id):
+        return self._req("mark_lost", worker_id=worker_id)
+
+    def view(self):
+        return self._req("view")
+
+    def allreduce(self, worker_id, generation, round_id, key, value,
+                  timeout_s=None):
+        return self._req("allreduce", worker_id=worker_id,
+                         generation=int(generation),
+                         round_id=int(round_id), key=str(key),
+                         value=value, timeout_s=timeout_s)
+
+    def rebuild_barrier(self, worker_id, timeout_s=None):
+        return self._req("rebuild_barrier", worker_id=worker_id,
+                         timeout_s=timeout_s)
+
+    def announce_join(self, worker_id, devices=()):
+        return self._req("announce_join", worker_id=worker_id,
+                         devices=tuple(devices))
+
+    def wait_admitted(self, worker_id, timeout_s=None):
+        return self._req("wait_admitted", worker_id=worker_id,
+                         timeout_s=timeout_s)
+
+    def admit_joiners(self, leader_id, state, meta=None):
+        return self._req("admit_joiners", leader_id=leader_id,
+                         state=state, meta=meta)
+
+    def describe(self):
+        return self._req("describe")
+
+    def close(self):
+        self._client.close()
+
+
+class ElasticKVStore(KVStoreBase):
+    """'elastic' kvstore (see module docstring).
+
+    The dense exchange rides :meth:`allreduce_flat` (the gluon Trainer
+    bucketed path); the per-key push/pull fallback reduces through the
+    same generation-checked rounds via ``_global_reduce``. Reductions
+    return the SUM over the current members — callers fold the
+    ``1/world`` normalization into ``rescale_grad``, which is exactly
+    the structural scalar whose change re-keys the fused step once per
+    world-size change (docs/resilience.md).
+    """
+
+    supports_flat_allreduce = True
+    # elasticlint contract: how a blocked exchange aborts when a peer
+    # dies — "generation" means every round is fenced by the membership
+    # generation and raises the typed MembershipChanged
+    elastic_abort = "generation"
+
+    def __init__(self, group=None, worker_id: Optional[str] = None,
+                 devices: Sequence[int] = (), join: bool = False,
+                 trainer=None):
+        super().__init__()
+        self._type = "elastic"
+        if group is None:
+            group = RemoteGroup()
+        if worker_id is None:
+            from ..base import worker_rank
+            worker_id = os.environ.get("MX_WORKER_ID",
+                                       f"w{worker_rank()}")
+        self.group = group
+        if join:
+            self.session = ElasticSession.join(
+                group, worker_id, trainer=trainer, devices=devices)
+        else:
+            self.session = ElasticSession(
+                group, worker_id, trainer=trainer, devices=devices)
+        # transient transport faults retry; a membership fence must NOT
+        # be retried blind — the REBUILD is the retry (session.rebuild)
+        from ..resil.policy import RetryPolicy
+        self._policy = RetryPolicy(name="elastic.allreduce",
+                                   no_retry=(MembershipChanged,))
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self.session.rank
+
+    @property
+    def num_workers(self) -> int:
+        return self.session.world
+
+    # -- data plane -------------------------------------------------------
+    def _reduce_round(self, key: str, data):
+        """One generation-checked round under the retry policy, with
+        the kvstore.push fault-injection site evaluated per attempt
+        (drills exercise the REAL recovery path)."""
+        from ..resil import faultplan
+
+        def attempt():
+            faultplan.inject("kvstore.push")
+            return self.session.allreduce(key, data)
+
+        return self._policy.call(attempt)
+
+    def allreduce_flat(self, key, value: NDArray) -> NDArray:
+        from ..kvstore import _kv_timer
+        with _kv_timer("kvstore_bucket_seconds"):
+            import numpy as onp
+            reduced = self._reduce_round(key, onp.asarray(value._data))
+            return _wrap(jnp.asarray(reduced).astype(value._data.dtype))
+
+    def _global_reduce(self, key, val: NDArray) -> NDArray:
+        # the per-key push/pull fallback (sparse leftovers) crosses
+        # workers through the same fenced rounds
+        import numpy as onp
+        reduced = self._reduce_round(f"__key_{key}",
+                                     onp.asarray(val._data))
+        return _wrap(jnp.asarray(reduced).astype(val._data.dtype))
+
+    def barrier(self):
+        """A plain barrier is a zero-payload reduce round: completes
+        when every current member arrives, fences on membership
+        change like everything else."""
+        import numpy as onp
+        self._reduce_round("__barrier__", onp.zeros((), onp.float32))
+
+    def close(self):
+        self.session.stop_heartbeat_pump()
+        close = getattr(self.group, "close", None)
+        if close is not None:
+            close()

@@ -1,0 +1,312 @@
+"""Membership generations: the model behind elastic training.
+
+A training group is a set of worker ids plus a **monotone generation
+number**; every membership change — a worker joining, leaving
+gracefully, or being declared lost on missed heartbeats — bumps the
+generation exactly once. Data-plane exchanges are tagged with the
+generation they were issued under, so a change *fences* every in-flight
+collective with a typed :class:`MembershipChanged` instead of letting
+survivors wedge on a peer that will never push (the dist_sync failure
+mode ROADMAP 5(a) names; ref: ps-lite has no analog — the reference's
+answer was "restart the job").
+
+:class:`MembershipTracker` is the pure bookkeeping core: no sockets, no
+threads, an injectable clock — tier-1 tests drive whole leave/rejoin
+histories with fake workers and a fake clock (tests/test_elastic.py).
+The blocking coordination built on top (reduce rounds, the rebuild
+barrier, join state-sync) lives in
+:class:`~mxnet_tpu.elastic.coordinator.ElasticCoordinator`; the socket
+transport rides the kvstore server (kvstore_server.KVServer).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, get_logger
+from ..resil.policy import RetryableError
+
+__all__ = ["MembershipChanged", "WorkerEvicted", "GroupFailed",
+           "ElasticTimeout", "MembershipView", "MembershipTracker"]
+
+_log = get_logger("mxnet_tpu.elastic")
+
+
+class MembershipChanged(RetryableError):
+    """The group's membership generation moved while a collective was
+    in flight. Typed and retryable *by contract*: the failed exchange
+    had no partial effect (contributions of a dead generation are
+    discarded whole), so the caller re-enters the rebuild barrier and
+    re-issues the exchange under the new generation. The elastic call
+    sites configure their :class:`~mxnet_tpu.resil.policy.RetryPolicy`
+    with ``no_retry=(MembershipChanged,)`` — blind retry under a stale
+    generation can never succeed; the REBUILD is the retry."""
+
+    def __init__(self, message: str, generation: Optional[int] = None):
+        super().__init__(message)
+        self.generation = generation  # the new generation, when known
+
+
+class WorkerEvicted(MXNetError):
+    """This worker was declared lost (missed heartbeats) and removed
+    from the group — but it is actually alive (a long GC pause, a
+    network partition that healed). NOT retryable under the old
+    identity: the worker must re-enter through the join protocol."""
+
+
+class GroupFailed(MXNetError):
+    """The group shrank below MXELASTIC_MIN_WORLD (or was explicitly
+    failed): elastic adaptation is out of room and the job hard-fails
+    so the cluster manager restarts it from checkpoint."""
+
+
+class ElasticTimeout(RetryableError):
+    """A blocking elastic operation (reduce wait, rebuild barrier,
+    join admission) exceeded its deadline without a membership verdict
+    either way — the control plane itself looks stuck."""
+
+
+class MembershipView:
+    """An immutable snapshot of the group at one generation."""
+
+    __slots__ = ("generation", "workers", "devices")
+
+    def __init__(self, generation: int, workers: Sequence[str],
+                 devices: Optional[Dict[str, Tuple[int, ...]]] = None):
+        self.generation = int(generation)
+        self.workers: Tuple[str, ...] = tuple(sorted(workers))
+        self.devices: Dict[str, Tuple[int, ...]] = {
+            w: tuple(d) for w, d in (devices or {}).items()
+            if w in self.workers}
+
+    @property
+    def world_size(self) -> int:
+        return len(self.workers)
+
+    @property
+    def leader(self) -> Optional[str]:
+        """Deterministic leader: the lexicographically first member
+        (stable across workers with no election round)."""
+        return self.workers[0] if self.workers else None
+
+    def rank_of(self, worker_id: str) -> int:
+        return self.workers.index(worker_id)
+
+    def device_ids(self) -> Tuple[int, ...]:
+        """All device ids owned by current members, sorted — the input
+        to live ShardPlan re-inference."""
+        out = set()
+        for ids in self.devices.values():
+            out.update(ids)
+        return tuple(sorted(out))
+
+    def describe(self) -> Dict[str, object]:
+        return {"generation": self.generation,
+                "workers": list(self.workers),
+                "world_size": self.world_size,
+                "devices": {w: list(d) for w, d in self.devices.items()}}
+
+    def __repr__(self):
+        return (f"<MembershipView gen={self.generation} "
+                f"world={self.world_size} workers={self.workers}>")
+
+
+class _Member:
+    __slots__ = ("worker_id", "devices", "last_beat", "joined_gen",
+                 "last_step")
+
+    def __init__(self, worker_id, devices, now, gen):
+        self.worker_id = worker_id
+        self.devices = tuple(devices or ())
+        self.last_beat = now
+        self.joined_gen = gen
+        self.last_step = None
+
+
+class MembershipTracker:
+    """Heartbeat ledger + generation counter (see module docstring).
+
+    Thread-safe; every mutation that changes the member set bumps the
+    generation exactly once (``admit`` batches several joins into one
+    bump so a multi-worker restart does not trigger a rebuild per
+    worker). ``check()`` applies the missed-heartbeat policy: a member
+    silent for more than ``heartbeat_interval_s * miss_limit`` seconds
+    is declared lost. The clock is injectable — deterministic drills,
+    no flaky sleeps."""
+
+    def __init__(self, heartbeat_interval_s: Optional[float] = None,
+                 miss_limit: Optional[int] = None,
+                 min_world: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from .. import config
+        if heartbeat_interval_s is None:
+            heartbeat_interval_s = float(config.get("MXELASTIC_HEARTBEAT_S"))
+        if miss_limit is None:
+            miss_limit = int(config.get("MXELASTIC_MISS_LIMIT"))
+        if min_world is None:
+            min_world = int(config.get("MXELASTIC_MIN_WORLD"))
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.miss_limit = int(miss_limit)
+        self.min_world = int(min_world)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._members: Dict[str, _Member] = {}
+        self._generation = 0
+        self._failed: Optional[str] = None
+        from ..telemetry import metrics as _metrics
+        self._g_gen = _metrics.gauge(
+            "mxelastic_generation", "current membership generation")
+        self._g_world = _metrics.gauge(
+            "mxelastic_world_size", "current elastic world size")
+        self._m_lost = _metrics.counter(
+            "mxelastic_lost_workers_total",
+            "workers declared lost on missed heartbeats")
+        self._m_leaves = _metrics.counter(
+            "mxelastic_leaves_total", "graceful worker departures")
+        self._m_joins = _metrics.counter(
+            "mxelastic_joins_total", "workers admitted into the group")
+
+    # -- inspection --------------------------------------------------------
+    @property
+    def lost_after_s(self) -> float:
+        """Heartbeat age that converts into a worker-lost verdict."""
+        return self.heartbeat_interval_s * self.miss_limit
+
+    def view(self) -> MembershipView:
+        with self._lock:
+            return MembershipView(
+                self._generation, list(self._members),
+                {w: m.devices for w, m in self._members.items()})
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def heartbeat_ages(self) -> Dict[str, float]:
+        now = self._clock()
+        with self._lock:
+            return {w: now - m.last_beat
+                    for w, m in self._members.items()}
+
+    def check_failed(self):
+        with self._lock:
+            if self._failed is not None:
+                raise GroupFailed(self._failed)
+
+    # -- mutation ----------------------------------------------------------
+    def _bump(self):
+        # under self._lock
+        self._generation += 1
+        self._g_gen.set(self._generation)
+        self._g_world.set(len(self._members))
+
+    def admit(self, worker_ids: Sequence[str],
+              devices: Optional[Dict[str, Sequence[int]]] = None
+              ) -> MembershipView:
+        """Add workers (one generation bump for the whole batch)."""
+        now = self._clock()
+        with self._lock:
+            self.check_failed()
+            changed = False
+            for wid in worker_ids:
+                if wid in self._members:
+                    continue
+                self._members[wid] = _Member(
+                    wid, (devices or {}).get(wid, ()), now,
+                    self._generation + 1)
+                self._m_joins.inc()
+                changed = True
+            if changed:
+                self._bump()
+            return self.view()
+
+    def join(self, worker_id: str,
+             devices: Sequence[int] = ()) -> MembershipView:
+        return self.admit([worker_id], {worker_id: tuple(devices)})
+
+    def _check_min_world(self, lost: bool):
+        """Arm the hard-fail after a shrink. Under self._lock.
+        min-world applies to SHRINKS only — a forming group passes
+        through small sizes legitimately, and a clean drain to zero
+        (every worker leaving deliberately) is shutdown, not
+        failure; a LOST-verdict shrink to zero does fail."""
+        n = len(self._members)
+        below = n < self.min_world if lost else 0 < n < self.min_world
+        if below and self._failed is None:
+            self._failed = (
+                f"elastic group shrank to {n} worker(s) — below "
+                f"MXELASTIC_MIN_WORLD={self.min_world}; hard-failing "
+                "so the job restarts from checkpoint instead of "
+                "limping")
+            _log.error("%s", self._failed)
+
+    def _remove(self, worker_id: str, lost: bool) -> bool:
+        # under self._lock
+        if worker_id not in self._members:
+            return False
+        del self._members[worker_id]
+        self._bump()
+        self._check_min_world(lost)
+        return True
+
+    def leave(self, worker_id: str) -> MembershipView:
+        """Graceful departure (preemption notice): bump immediately."""
+        with self._lock:
+            if self._remove(worker_id, lost=False):
+                self._m_leaves.inc()
+                _log.info("worker %r left the group (generation %d, "
+                          "world %d)", worker_id, self._generation,
+                          len(self._members))
+            return self.view()
+
+    def mark_lost(self, worker_id: str) -> MembershipView:
+        """Apply a worker-lost verdict (watchdog or explicit)."""
+        with self._lock:
+            if self._remove(worker_id, lost=True):
+                self._m_lost.inc()
+                _log.warning(
+                    "worker %r declared LOST (generation %d, world %d)",
+                    worker_id, self._generation, len(self._members))
+            return self.view()
+
+    def heartbeat(self, worker_id: str,
+                  step: Optional[int] = None) -> MembershipView:
+        """Record a beat; raises :class:`WorkerEvicted` for a worker
+        that was already removed (it must rejoin, not resume)."""
+        now = self._clock()
+        with self._lock:
+            self.check_failed()
+            m = self._members.get(worker_id)
+            if m is None:
+                raise WorkerEvicted(
+                    f"worker {worker_id!r} is not a member of "
+                    f"generation {self._generation} — it was declared "
+                    "lost or never joined; re-enter via the join "
+                    "protocol (docs/resilience.md elastic runbook)")
+            m.last_beat = now
+            if step is not None:
+                m.last_step = int(step)
+            return self.view()
+
+    def check(self) -> List[str]:
+        """The missed-heartbeat policy: declare silent members lost.
+        Returns the worker ids removed (one bump covers them all)."""
+        now = self._clock()
+        threshold = self.lost_after_s
+        with self._lock:
+            lost = [w for w, m in self._members.items()
+                    if now - m.last_beat > threshold]
+            for w in lost:
+                age = now - self._members[w].last_beat
+                del self._members[w]
+                self._m_lost.inc()
+                _log.warning(
+                    "worker %r silent for %.2fs (> %d x %.2fs "
+                    "heartbeat budget) — declared lost", w, age,
+                    self.miss_limit, self.heartbeat_interval_s)
+            if lost:
+                self._bump()
+                self._check_min_world(lost=True)
+        return lost

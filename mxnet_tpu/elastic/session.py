@@ -1,0 +1,374 @@
+"""ElasticSession: one worker's view of the membership protocol.
+
+The session owns everything generation-scoped on the worker side: the
+current :class:`MembershipView`, the per-generation round counter the
+reduce rounds are tagged with, the effective-batch / LR-schedule
+accounting that keeps the loss trajectory within the declared tolerance
+of an uninterrupted run, and the state snapshot/install helpers the
+join protocol uses so a rejoiner syncs **from the group**, not from a
+checkpoint file.
+
+Lifecycle::
+
+    session = ElasticSession(group, "w0", trainer=trainer)   # register
+    ...
+    changed = session.heartbeat(step)        # every step boundary
+    if changed:
+        session.rebuild()                    # barrier + trainer re-plan
+
+    # a (re)started worker instead:
+    session = ElasticSession.join(group, "w3", trainer=trainer)
+    # -> announced, admitted at the next boundary, live state installed
+
+``group`` is anything with the :class:`~mxnet_tpu.elastic.coordinator.
+ElasticCoordinator` worker surface — the coordinator itself in-process,
+or the kvstore-server transport (`elastic.kvstore.RemoteGroup`) across
+processes.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError, get_logger
+from .membership import MembershipChanged, MembershipView
+
+__all__ = ["ElasticSession"]
+
+_log = get_logger("mxnet_tpu.elastic")
+
+
+class _ElasticSchedule:
+    """LR-scheduler proxy installed by :meth:`ElasticSession.attach`:
+    schedulers see the session's *virtual* update count — steps scaled
+    by ``world / reference_world`` — so after a shrink the schedule
+    advances at the rate of samples actually consumed and the decay
+    landmarks stay aligned with the uninterrupted run."""
+
+    def __init__(self, inner, session: "ElasticSession"):
+        self.inner = inner
+        self.session = session
+
+    def __call__(self, num_update):
+        return self.inner(self.session.schedule_updates())
+
+    def __getattr__(self, name):  # base_lr etc. pass through
+        return getattr(self.inner, name)
+
+
+class ElasticSession:
+    def __init__(self, group, worker_id: str, trainer=None,
+                 devices: Sequence[int] = (), register: bool = True,
+                 clock=time.monotonic):
+        self.group = group
+        self.worker_id = str(worker_id)
+        self.devices = tuple(devices)
+        self._clock = clock
+        self._round = 0
+        self._samples = 0.0
+        self._virtual_updates = 0.0
+        self._ref_world: Optional[int] = None
+        self._base_lr: Optional[float] = None
+        self._trainer = None
+        self._pump = None
+        self._pump_stop = None
+        self._pending_state = None  # join-before-trainer snapshot
+        self.start_meta: Dict[str, object] = {}
+        self.view: Optional[MembershipView] = None
+        if register:
+            self.view = group.register(self.worker_id, self.devices)
+        if trainer is not None:
+            self.attach(trainer)
+
+    # ------------------------------------------------------------------
+    # identity
+    # ------------------------------------------------------------------
+    @property
+    def generation(self) -> int:
+        return self.view.generation if self.view else 0
+
+    @property
+    def world(self) -> int:
+        return self.view.world_size if self.view else 1
+
+    @property
+    def rank(self) -> int:
+        return self.view.rank_of(self.worker_id) if self.view else 0
+
+    @property
+    def is_leader(self) -> bool:
+        return self.view is not None and \
+            self.view.leader == self.worker_id
+
+    @property
+    def ref_world(self) -> int:
+        """The reference world size schedule accounting is anchored to
+        (the world when training started)."""
+        return self._ref_world or self.world or 1
+
+    # ------------------------------------------------------------------
+    # trainer wiring
+    # ------------------------------------------------------------------
+    def attach(self, trainer) -> "ElasticSession":
+        """Bind a gluon ``Trainer``: the trainer absorbs generation
+        bumps inside ``step()`` with zero user code (docs/resilience.md
+        elastic section)."""
+        self._trainer = trainer
+        trainer._elastic = self
+        opt = trainer._optimizer
+        self._base_lr = float(getattr(opt, "lr", 0.0) or 0.0)
+        if self._ref_world is None:
+            self._ref_world = self.world
+        sched = getattr(opt, "lr_scheduler", None)
+        if sched is not None and not isinstance(sched, _ElasticSchedule):
+            opt.lr_scheduler = _ElasticSchedule(sched, self)
+        pending = getattr(self, "_pending_state", None)
+        if pending is not None:  # a join that ran before the trainer
+            self._pending_state = None
+            self.install_state(*pending)
+        return self
+
+    def refresh(self) -> MembershipView:
+        """Adopt the group's current view without acting on it (no
+        leader duties, no rebuild) — drivers call this after forming
+        the initial group so every session starts at the same
+        generation."""
+        view, _ = self.group.heartbeat(self.worker_id)
+        self.view = view
+        return view
+
+    # ------------------------------------------------------------------
+    # the heartbeat pump
+    # ------------------------------------------------------------------
+    def start_heartbeat_pump(self, interval_s: Optional[float] = None):
+        """Liveness side channel: a daemon thread beating at half the
+        heartbeat interval, so compiles/rebuilds/IO pauses on the
+        training thread never read as death. The pump carries NO
+        protocol duties (no leader publish, no rebuild) — those belong
+        to the step boundary; a worker killed by the drill stops its
+        pump too, which is exactly what lets survivors detect it."""
+        import threading
+        if self._pump is not None:
+            return self
+        if interval_s is None:
+            from .. import config
+            interval_s = float(config.get("MXELASTIC_HEARTBEAT_S")) / 2.0
+        stop = threading.Event()
+
+        def pump():
+            while not stop.wait(interval_s):
+                try:
+                    self.group.heartbeat(self.worker_id)
+                except Exception:
+                    return  # evicted / group gone: the boundary will see
+
+        self._pump_stop = stop
+        self._pump = threading.Thread(
+            target=pump, name=f"mxelastic-hb-{self.worker_id}",
+            daemon=True)
+        self._pump.start()
+        return self
+
+    def stop_heartbeat_pump(self):
+        if self._pump is None:
+            return
+        self._pump_stop.set()
+        self._pump.join(timeout=2.0)
+        self._pump = None
+        self._pump_stop = None
+
+    # ------------------------------------------------------------------
+    # the step boundary
+    # ------------------------------------------------------------------
+    def heartbeat(self, step: Optional[int] = None) -> bool:
+        """Step-boundary beat. Leaders publish live state for pending
+        joiners HERE (the consistent point: every parameter reflects
+        the same completed step). Returns True when the generation
+        moved — the caller must :meth:`rebuild` before the next
+        exchange."""
+        view, flags = self.group.heartbeat(self.worker_id, step=step)
+        if flags.get("pending_join") and view.leader == self.worker_id:
+            state, meta = self.snapshot_state(step=step)
+            view = self.group.admit_joiners(self.worker_id, state, meta)
+        changed = self.view is None or \
+            view.generation != self.view.generation
+        if changed:
+            _log.info("worker %r observed generation %s -> %d at step "
+                      "boundary", self.worker_id,
+                      self.view.generation if self.view else None,
+                      view.generation)
+        return changed
+
+    def next_round(self) -> int:
+        r = self._round
+        self._round += 1
+        return r
+
+    def allreduce(self, key: str, value) -> onp.ndarray:
+        """One generation-tagged contribution (raises
+        :class:`MembershipChanged` when fenced)."""
+        return self.group.allreduce(self.worker_id, self.generation,
+                                    self.next_round(), key, value)
+
+    def rebuild(self) -> MembershipView:
+        """The rebuild barrier: agree on the new view with every
+        member, reset the round numbering, and re-plan the trainer
+        (bucket layout, shard plan, batch/LR accounting). Loops
+        internally if membership changes again mid-barrier."""
+        old = self.view
+        t0 = self._clock()
+        view = self.group.rebuild_barrier(self.worker_id)
+        self.view = view
+        self._round = 0
+        from ..telemetry import metrics as _metrics
+        _metrics.counter(
+            "mxelastic_rebuilds_total",
+            "generation rebuilds completed by this worker").inc()
+        _metrics.histogram(
+            "mxelastic_rebuild_seconds",
+            "rebuild-barrier latency (bump observed -> new view "
+            "agreed)").observe(self._clock() - t0)
+        if self._trainer is not None:
+            self._trainer._on_membership_change(old, view)
+        _log.info("worker %r rebuilt: generation %d, world %d",
+                  self.worker_id, view.generation, view.world_size)
+        return view
+
+    def note_step(self, batch_size: int):
+        """Effective-batch accounting: one step consumed
+        ``batch_size x world`` samples; the virtual update counter
+        advances by ``world / ref_world`` so LR schedules track samples
+        rather than wall steps across world-size changes."""
+        if self._ref_world is None:
+            self._ref_world = self.world
+        self._samples += float(batch_size) * self.world
+        self._virtual_updates += self.world / float(self.ref_world)
+
+    def schedule_updates(self) -> int:
+        return int(round(self._virtual_updates))
+
+    @property
+    def samples_seen(self) -> float:
+        return self._samples
+
+    def leave(self):
+        """Graceful departure (the preempt path): bump immediately so
+        survivors fence at the next exchange instead of burning the
+        heartbeat budget."""
+        self.group.leave(self.worker_id)
+
+    # ------------------------------------------------------------------
+    # join / state sync
+    # ------------------------------------------------------------------
+    def snapshot_state(self, step: Optional[int] = None):
+        """Serialize the live trainer state for a joiner: parameters
+        as host arrays in trainer order (POSITIONAL — gluon name
+        counters differ between worker instances of the same model)
+        plus the pickled updater-state blob (the format
+        ``Trainer.save_states`` writes)."""
+        tr = self._trainer
+        if tr is None:
+            return None, {"step": step}
+        params = [(p.name, p.data().asnumpy()) for p in tr._params]
+        try:
+            opt_state = tr._updaters[0].get_states(dump_optimizer=True)
+        except Exception:
+            opt_state = None
+        meta = {"step": step, "samples": self._samples,
+                "virtual_updates": self._virtual_updates,
+                "ref_world": self.ref_world,
+                "base_lr": self._base_lr}
+        return {"params": params, "opt_state": opt_state}, meta
+
+    def install_state(self, state, meta: Dict[str, object]):
+        """Install a leader-published snapshot into the attached
+        trainer: the joiner starts from the group's LIVE weights and
+        optimizer state — never a checkpoint file. Parameters map by
+        trainer position (same model structure), validated by shape."""
+        tr = self._trainer
+        if tr is None or state is None:
+            return
+        entries = list(state.get("params") or [])
+        if len(entries) != len(tr._params):
+            raise MXNetError(
+                f"elastic join: group state has {len(entries)} "
+                f"parameters, this worker's model has "
+                f"{len(tr._params)} — model mismatch between joiner "
+                "and group")
+        from ..ndarray.ndarray import array as nd_array
+        for p, (name, arr) in zip(tr._params, entries):
+            if p._data is not None and \
+                    tuple(arr.shape) != tuple(p.data().shape):
+                raise MXNetError(
+                    f"elastic join: parameter {p.name!r} expects "
+                    f"shape {tuple(p.data().shape)}, group published "
+                    f"{name!r} with {tuple(arr.shape)} — model "
+                    "mismatch between joiner and group")
+            # set_data finishes a DEFERRED init from the published
+            # shape — a freshly-built joiner model need never run a
+            # forward before entering the group
+            p.set_data(nd_array(arr))
+        blob = state.get("opt_state")
+        if blob is not None:
+            try:
+                for updater in tr._updaters:
+                    updater.set_states(blob)
+                    updater.optimizer = tr._updaters[0].optimizer
+                tr._optimizer = tr._updaters[0].optimizer
+                tr._optimizer.param_dict = {
+                    i: p for i, p in enumerate(tr._params)}
+            except Exception as e:
+                _log.warning("elastic join: optimizer state not "
+                             "installed (%s); joiner starts with fresh "
+                             "state", e)
+        self._samples = float(meta.get("samples") or 0.0)
+        self._virtual_updates = float(meta.get("virtual_updates")
+                                      or 0.0)
+        if meta.get("ref_world"):
+            self._ref_world = int(meta["ref_world"])
+        if meta.get("base_lr") is not None:
+            self._base_lr = float(meta["base_lr"])
+
+    @classmethod
+    def join(cls, group, worker_id: str, trainer=None,
+             devices: Sequence[int] = (), timeout_s: Optional[float]
+             = None) -> "ElasticSession":
+        """The rejoin protocol: announce, wait for a leader to admit
+        us with the group's live state, install it, and meet the group
+        at the rebuild barrier. Returns a session already inside the
+        new generation."""
+        self = cls(group, worker_id, trainer=None, devices=devices,
+                   register=False)
+        if trainer is not None:
+            self.attach(trainer)
+        group.announce_join(self.worker_id, self.devices)
+        view, state, meta = group.wait_admitted(self.worker_id,
+                                                timeout_s=timeout_s)
+        self.view = view
+        self.start_meta = dict(meta or {})
+        if self._trainer is not None:
+            self.install_state(state, meta)
+        else:
+            # trainer built after the join (the kvstore-first order):
+            # attach() installs this pending snapshot
+            self._pending_state = (state, dict(meta or {}))
+        # keep beating while the joiner compiles its step programs —
+        # survivors are already waiting on its first contribution
+        self.start_heartbeat_pump()
+        # meet the survivors before the first exchange; membership may
+        # move again mid-barrier — rebuild() loops until agreed
+        self.rebuild()
+        from ..telemetry import metrics as _metrics
+        _metrics.counter(
+            "mxelastic_rejoins_total",
+            "workers that rejoined via group state sync").inc()
+        return self
+
+    def __repr__(self):
+        return (f"<ElasticSession {self.worker_id!r} gen="
+                f"{self.generation} world={self.world}"
+                f"{' leader' if self.is_leader else ''}>")

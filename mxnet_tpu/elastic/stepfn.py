@@ -1,0 +1,252 @@
+"""ElasticStepFunction: the fused train step that survives membership
+changes.
+
+The one-program :class:`~mxnet_tpu.step.stepfn.StepFunction` compiles
+the gradient exchange *into* the jit (identity or in-mesh psum) — a
+shape that cannot abort mid-collective when a peer dies. The elastic
+variant splits the step at exactly the exchange boundary:
+
+- **grad program** — forward + backward, compiled once per input
+  signature. Its trace is *world-size independent*: membership changes
+  never touch it.
+- **host exchange** — the flat-bucket allreduce through the elastic
+  kvstore, generation-fenced: a :class:`MembershipChanged` aborts the
+  step's exchange, the session rebuilds (barrier + bucket relayout +
+  batch/LR rescale), and the SAME gradients are re-exchanged under the
+  new generation — forward/backward is never recomputed for a bump.
+- **update program** — the fused multi-tensor optimizer over the
+  reduced gradients, donated buffers. The ``1/world`` normalization of
+  the summed exchange rides ``rescale_grad``, a *structural* scalar of
+  ``Optimizer.fused_signature()`` — so a world-size change re-keys
+  **exactly this one program** (the acceptance budget: one re-key per
+  generation bump, zero steady-state recompiles after the rebuild; a
+  rejoin back to a previously-seen world size is a cache HIT and
+  re-keys nothing).
+
+The trainer keeps owning optimizer state (checkpoints, TrainGuard and
+``save_states`` see post-update values), and the step boundary is also
+the membership boundary: heartbeats go out here, generation bumps are
+observed here, and the group leader publishes join state here.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+
+from ..base import MXNetError
+from ..ndarray.ndarray import _wrap
+from ..step.stepfn import StepFunction, _raw
+from .membership import MembershipChanged
+
+__all__ = ["ElasticStepFunction"]
+
+
+class ElasticStepFunction(StepFunction):
+    def __init__(self, net, loss_fn=None, trainer=None, **kwargs):
+        if kwargs.get("psum_axis") is not None:
+            raise MXNetError(
+                "ElasticStepFunction owns the gradient exchange; "
+                "psum_axis= does not compose with it")
+        if trainer is None or getattr(trainer, "_elastic", None) is None:
+            raise MXNetError(
+                "ElasticStepFunction needs a trainer with an elastic "
+                "session (create the Trainer with an ElasticKVStore, "
+                "or call session.attach(trainer) first)")
+        super().__init__(net, loss_fn, trainer=trainer, **kwargs)
+        self._session = trainer._elastic
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        kv = trainer._kvstore
+        if kv is None or not getattr(kv, "supports_flat_allreduce",
+                                     False):
+            raise MXNetError(
+                "ElasticStepFunction needs a flat-allreduce-capable "
+                f"kvstore; got {type(kv).__name__}")
+        self._kv = kv
+        self._grad_cache: Dict = {}
+        self._buckets = None  # (GradientBuckets, layout signature)
+        self._nstep = 0
+
+    # ------------------------------------------------------------------
+    # program caches
+    # ------------------------------------------------------------------
+    def _grad_key(self, inputs):
+        return (tuple((tuple(v.shape), str(v.dtype)) for v in inputs),
+                self._param_dtypes(), self._opt_level) \
+            + self._shard_key()
+
+    def _update_key(self):
+        # rescale_grad (inside fused_signature) carries 1/world — THE
+        # re-key on a world-size change; generation itself is absent,
+        # so returning to a previously-seen world size is a cache hit
+        return (self._param_dtypes(), self._opt_level,
+                self._optimizer.fused_signature()) + self._shard_key()
+
+    def _grad_fn(self, inputs):
+        key = self._grad_key(inputs)
+        fn = self._grad_cache.get(key)
+        if fn is None:
+            self._record_miss(inputs)
+            fn = jax.jit(self._build_grads())  # params NOT donated:
+            # the update program still needs the pre-step weights
+            self._grad_cache[key] = fn
+        return fn
+
+    def _update_fn(self):
+        key = self._update_key()
+        fn = self._cache.get(key)
+        if fn is None:
+            from ..telemetry import metrics as _metrics
+            from ..telemetry import recompile as _recompile
+            _metrics.counter(
+                "fused_step_cache_misses_total",
+                "fused-step signature-cache misses (compiles)").inc()
+            sig = {"inputs": [], "world": int(self._session.world),
+                   "rescale": float(self._optimizer.rescale_grad),
+                   "phase": "update"}
+            _recompile.record_recompile(
+                f"ElasticStepFunction:{self._name}", sig,
+                kind="fused_step")
+            trainable = self._trainable
+            indices = self._indices
+
+            def pure_update(tvals, svals, grads, lrs, wds):
+                # the barrier pins the exchange/update boundary for
+                # the same bitwise-contraction reason as the fused
+                # one-program step
+                grads = jax.lax.optimization_barrier(grads)
+                return self._optimizer.fused_apply(
+                    indices, [tvals[n] for n in trainable],
+                    [grads[n] for n in trainable], svals, lrs, wds)
+
+            fn = jax.jit(pure_update,
+                         donate_argnums=(0, 1) if self._donate else ())
+            self._cache[key] = fn
+            self._last = (fn, key)
+        return fn
+
+    # ------------------------------------------------------------------
+    # the host-side bucketed exchange
+    # ------------------------------------------------------------------
+    def _grad_buckets(self):
+        """Bucket layout for the CURRENT world (rebuilt on a bump:
+        the session's generation is part of the signature through
+        world_size — step/buckets.GradientBuckets)."""
+        from ..step.buckets import GradientBuckets
+        items = []
+        for i, n in zip(self._indices, self._trainable):
+            p = self._param_objs[n]
+            v = p.data() if hasattr(p, "data") else p
+            items.append((i, tuple(v.shape), str(v.dtype),
+                          v.size * v.dtype.itemsize))
+        sig = (tuple(items), self._session.world)
+        if self._buckets is None or self._buckets[1] != sig:
+            self._buckets = (GradientBuckets(
+                items, world_size=self._session.world), sig)
+        return self._buckets[0]
+
+    def _exchange_once(self, grads_by_name):
+        """One attempt: flatten → fenced allreduce per bucket →
+        scatter. Raises MembershipChanged whole (no partial effect:
+        reduced segments only replace the local grads after EVERY
+        bucket of the generation succeeded)."""
+        name_of = dict(zip(self._indices, self._trainable))
+        grads_by_idx = {i: grads_by_name[name_of[i]]
+                        for i in self._indices}
+        buckets = self._grad_buckets()
+        reduced_parts = []
+        for bid, bucket in enumerate(buckets.buckets):
+            flat = buckets.flatten(bucket, grads_by_idx)
+            out = self._kv.allreduce_flat(f"__estep_b{bid}",
+                                          _wrap(flat))
+            reduced_parts.append((bucket, out._data))
+        reduced = {}
+        for bucket, flat in reduced_parts:
+            for i, seg in buckets.unflatten(bucket, flat).items():
+                reduced[name_of[i]] = seg
+        return reduced
+
+    def _exchange(self, grads):
+        """In-jit hook disabled: the elastic exchange is host-side."""
+        return grads
+
+    def _set_rescale(self, batch_size):
+        # summed exchange + 1/(local batch x world) = the global-batch
+        # mean — the update math of an uninterrupted run at this world
+        self._optimizer.rescale_grad = \
+            self._scale / (batch_size * max(1, self._session.world))
+
+    # ------------------------------------------------------------------
+    # the step
+    # ------------------------------------------------------------------
+    def step(self, x, *labels, batch_size=None):
+        from ..telemetry import metrics as _metrics
+        from .. import telemetry as _telemetry
+        t0 = time.perf_counter()
+        session = self._session
+        # the step boundary IS the membership boundary
+        if session.heartbeat(self._nstep):
+            session.rebuild()
+        inputs = tuple(_raw(a) for a in (x,) + labels)
+        self._prepare(inputs)
+        if batch_size is None:
+            batch_size = int(inputs[0].shape[0]) if inputs[0].ndim \
+                else 1
+        self._set_rescale(batch_size)
+
+        grads_fn = self._grad_fn(inputs)
+        lrs, wds = self._hyper()
+        pvals, svals = self._gather()
+        from .. import random as _random
+        rng = jax.random.key_data(_random.next_key())
+        grads, extras, loss = grads_fn(pvals, inputs, rng)
+
+        t1 = time.perf_counter()
+        while True:
+            try:
+                reduced = self._exchange_once(grads)
+                break
+            except MembershipChanged:
+                # fenced mid-exchange: rebuild with the survivors and
+                # re-exchange the SAME gradients under the new
+                # generation — forward/backward is not recomputed
+                session.rebuild()
+                self._set_rescale(batch_size)
+        t2 = time.perf_counter()
+
+        update_fn = self._update_fn()
+        tvals = {n: pvals[n] for n in self._trainable}
+        new_w, new_s = update_fn(tvals, svals, reduced, lrs, wds)
+        new_params = dict(zip(self._trainable, new_w))
+        new_params.update(extras)
+        self._writeback(new_params, new_s)
+        t3 = time.perf_counter()
+
+        self._nstep += 1
+        session.note_step(batch_size)
+        _metrics.histogram(
+            "mxelastic_exchange_seconds",
+            "elastic bucketed gradient-exchange latency (including "
+            "any rebuild absorbed mid-step)").observe(t2 - t1)
+        _metrics.histogram(
+            "fused_step_dispatch_seconds",
+            "fused-step compiled-call dispatch (async; excludes "
+            "device wait)").observe((t1 - t0) + (t3 - t2))
+        _telemetry.record_step(batch_size, time.perf_counter() - t0)
+        return _wrap(loss)
+
+    __call__ = step
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def program_counts(self) -> Dict[str, int]:
+        """Per-instance compiled-program census — the drill's re-key
+        budget check reads this (grad programs never re-key on a
+        membership change; update programs re-key once per NEW world
+        size)."""
+        return {"grad": len(self._grad_cache),
+                "update": len(self._cache),
+                "total": len(self._grad_cache) + len(self._cache)}

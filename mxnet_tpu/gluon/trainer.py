@@ -54,6 +54,7 @@ class Trainer:
         self._contains_sparse_grad = False
         self._grad_buckets = None  # lazy; see _allreduce_grads
         self._shard_plan = None  # set by fuse_step(shard_plan=...)
+        self._elastic = None  # ElasticSession (elastic kvstore attach)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -83,12 +84,20 @@ class Trainer:
                 kvstore.set_gradient_compression(self._compression_params)
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
-            for i, param in enumerate(self._params):
-                if param.grad_req != "null":
-                    kvstore.init(i, param.data())
+            if getattr(kvstore, "session", None) is None:
+                # elastic stores hold no weights (the exchange is a
+                # stateless fenced allreduce; weights live on the
+                # workers), so there is nothing to init server-side —
+                # and deferred-shape parameters stay deferred
+                for i, param in enumerate(self._params):
+                    if param.grad_req != "null":
+                        kvstore.init(i, param.data())
         self._kvstore = kvstore
         self._update_on_kvstore = update_on_kvstore if kvstore else False
         self._kv_initialized = True
+        session = getattr(kvstore, "session", None)
+        if session is not None:  # elastic store: bind the membership
+            session.attach(self)  # session so step() absorbs bumps
 
     @property
     def learning_rate(self):
@@ -117,10 +126,64 @@ class Trainer:
         t0 = _time.perf_counter()
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
+        if self._elastic is not None:
+            self._elastic_step(batch_size)
+        else:
+            self._optimizer.rescale_grad = self._scale / batch_size
+            self._allreduce_grads()
         self._update(ignore_stale_grad)
+        if self._elastic is not None:
+            self._elastic.note_step(batch_size)
         _telemetry.record_step(batch_size, _time.perf_counter() - t0)
+
+    def _elastic_step(self, batch_size):
+        """The zero-user-code elastic boundary: heartbeat, observe
+        generation bumps, absorb a mid-exchange MembershipChanged by
+        rebuilding with the survivors and re-exchanging the SAME
+        gradients under the new generation (docs/resilience.md). The
+        summed exchange is normalized by 1/(batch x world), i.e. the
+        global-batch mean — shrinking the world keeps per-sample
+        update math intact."""
+        from ..elastic.membership import MembershipChanged
+        ses = self._elastic
+        if ses.heartbeat():
+            ses.rebuild()  # clears buckets, rescales LR, replans
+        while True:
+            self._optimizer.rescale_grad = \
+                self._scale / (batch_size * max(1, ses.world))
+            try:
+                self._allreduce_grads()
+                return
+            except MembershipChanged:
+                ses.rebuild()
+
+    def _on_membership_change(self, old_view, new_view):
+        """Session rebuild hook: relayout the gradient buckets for the
+        new world size, rescale the LR (linear-scaling rule, anchored
+        at the reference world — MXELASTIC_LR_SCALE), and re-infer the
+        shard plan's batch axis from the devices still present (the
+        ShardPlan.from_manifest path, live)."""
+        from .. import config
+        self._grad_buckets = None  # relayout for the new world
+        ses = self._elastic
+        if ses is not None and config.get("MXELASTIC_LR_SCALE") and \
+                ses._base_lr and self._optimizer.lr_scheduler is None:
+            self._optimizer.lr = ses._base_lr * \
+                new_view.world_size / float(ses.ref_world)
+        plan = self._shard_plan
+        if plan is not None and new_view is not None and \
+                new_view.devices:
+            try:
+                import jax as _jax
+                ids = set(new_view.device_ids())
+                devs = [d for d in _jax.devices() if d.id in ids]
+                if devs:
+                    self._shard_plan = plan.reinfer(devices=devs)
+            except Exception as e:  # a bad device map must not stop
+                import warnings  # the rebuild — weights stay usable
+                warnings.warn(
+                    f"elastic rebuild: shard-plan re-inference failed "
+                    f"({e}); keeping the previous plan")
 
     def allreduce_grads(self):
         """ref: trainer.py:334."""
@@ -171,21 +234,35 @@ class Trainer:
             g = param.grad()
             items.append((i, tuple(g.shape), str(g.dtype),
                           g.size * g.dtype.itemsize))
-        sig = (tuple(items), tuple(leftover))
+        world = self._elastic.world if self._elastic is not None \
+            else getattr(self._kvstore, "num_workers", 1)
+        sig = (tuple(items), tuple(leftover), world)
         # (re)build when the layout changes — a Parameter.cast (amp
-        # fine-tuning) or grad_req flip would otherwise hit a stale
-        # assignment and concat mixed dtypes into one bucket
+        # fine-tuning), grad_req flip, or elastic world-size change
+        # would otherwise hit a stale assignment (mixed-dtype concat /
+        # a layout whose round numbering belonged to a dead generation)
         if self._grad_buckets is None or self._grad_buckets[2] != sig:
-            self._grad_buckets = (GradientBuckets(items), leftover, sig)
+            self._grad_buckets = (GradientBuckets(items,
+                                                  world_size=world),
+                                  leftover, sig)
         buckets, leftover, _ = self._grad_buckets
         grads = {i: self._params[i].grad()._data
                  for b in buckets.buckets for i, _, _ in b.entries}
+        # exchange EVERY bucket before rebinding any: an elastic
+        # MembershipChanged mid-exchange aborts the whole step's
+        # reduce with no partial effect, so the retry after the
+        # rebuild re-exchanges the ORIGINAL gradients — a per-bucket
+        # rebind would feed already-reduced sums back into the retry
+        # and double-count them (same invariant as
+        # ElasticStepFunction._exchange_once)
+        reduced_parts = []
         for bid, bucket in enumerate(buckets.buckets):
             flat = buckets.flatten(bucket, grads)
             reduced = self._kvstore.allreduce_flat(
                 f"__grad_bucket_{bid}", _wrap(flat))
-            for i, seg in buckets.unflatten(bucket,
-                                            reduced._data).items():
+            reduced_parts.append((bucket, reduced._data))
+        for bucket, flat in reduced_parts:
+            for i, seg in buckets.unflatten(bucket, flat).items():
                 self._params[i].grad()._rebind(seg)
         for i in leftover:  # sparse / multi-device: per-param exchange
             self._kvstore.push(i, self._params[i].list_grad(),
@@ -249,6 +326,18 @@ class Trainer:
             self._shard_plan = shard_plan
             return ShardedStepFunction(net, loss_fn, trainer=self,
                                        shard_plan=shard_plan, **kwargs)
+        kvs = self._kvstore_params.get("kvstore")
+        if not self._kv_initialized and (
+                getattr(kvs, "session", None) is not None
+                or (isinstance(kvs, str) and "elastic" in kvs)):
+            self._init_kvstore()  # an elastic kvstore attaches here
+        if self._elastic is not None:
+            # elastic membership: the split-phase step whose update
+            # program re-keys exactly once per world-size change
+            from ..elastic.stepfn import ElasticStepFunction
+            self._shard_plan = None
+            return ElasticStepFunction(net, loss_fn, trainer=self,
+                                       **kwargs)
         from ..step import StepFunction
         self._shard_plan = None  # an unsharded rebuild clears the plan
         return StepFunction(net, loss_fn, trainer=self, **kwargs)

@@ -58,6 +58,15 @@ def _kv_timer(name: str):
 
 class KVStoreBase:
     supports_flat_allreduce = True  # see allreduce_flat / step/buckets.py
+    # elasticlint contract (passes/elasticlint.py): any class claiming
+    # supports_flat_allreduce must declare how a blocked exchange
+    # aborts when a peer dies — "local" (single-process identity
+    # reduce, no peer to wedge on), "timeout" (collective/barrier
+    # deadlines surface a typed error), or "generation" (fenced by the
+    # elastic membership protocol, mxnet_tpu/elastic/). A subclass
+    # that overrides the exchange WITHOUT re-declaring this is the
+    # silent-wedge class the elastic subsystem exists to kill.
+    elastic_abort = "local"
 
     def __init__(self):
         self._updater = None
@@ -240,6 +249,12 @@ class KVStoreDist(KVStoreBase):
     (ref: src/kvstore/kvstore_dist.h:44 — ZPush/ZPull replaced by psum over
     the global device mesh; sync semantics ≙ kSyncMode)."""
 
+    # a dead peer surfaces through the collective/barrier deadline
+    # (MXNET_KVSTORE_BARRIER_TIMEOUT / jax.distributed timeouts), not
+    # a live membership bump — bounded, but coarse; prefer 'elastic'
+    # for jobs that must adapt instead of fail (docs/resilience.md)
+    elastic_abort = "timeout"
+
     def __init__(self, type_name="dist_sync"):
         from .parallel import initialize_distributed
         initialize_distributed()  # wire ranks from tools/launch.py env
@@ -400,6 +415,13 @@ def create(name="local") -> KVStoreBase:
         return KVStoreLocal(name)
     if name == "dist_async":
         return KVStoreDistAsync(name)
+    if name in ("elastic", "dist_sync_elastic"):
+        # synchronous allreduce with live membership: every round is
+        # fenced by the generation protocol (mxnet_tpu/elastic/), so a
+        # dead peer aborts the exchange with a typed MembershipChanged
+        # instead of wedging the survivors
+        from .elastic.kvstore import ElasticKVStore
+        return ElasticKVStore()
     if name.startswith("dist"):
         return KVStoreDist(name)
     raise MXNetError(f"unknown KVStore type {name}")

@@ -59,6 +59,42 @@ def _recv_msg(sock: socket.socket):
     return pickle.loads(_recv_exact(sock, ln))
 
 
+def _error_reply(e: Exception):
+    """Encode an exception for the wire, keeping the elastic membership
+    types TYPED — the worker-side rebuild logic must be transport-blind
+    (a MembershipChanged over a socket drives the same recovery as one
+    raised in-process)."""
+    from .elastic.membership import (ElasticTimeout, GroupFailed,
+                                     MembershipChanged, WorkerEvicted)
+    if isinstance(e, MembershipChanged):
+        return ("membership", (str(e), e.generation))
+    if isinstance(e, WorkerEvicted):
+        return ("evicted", str(e))
+    if isinstance(e, GroupFailed):
+        return ("group_failed", str(e))
+    if isinstance(e, ElasticTimeout):
+        return ("elastic_timeout", str(e))
+    return ("err", f"{type(e).__name__}: {e}")
+
+
+def raise_typed_reply(status: str, reply):
+    """Client-side inverse of :func:`_error_reply` for non-ok,
+    non-err statuses; returns False when the status is not an elastic
+    type (caller handles ok/err)."""
+    from .elastic.membership import (ElasticTimeout, GroupFailed,
+                                     MembershipChanged, WorkerEvicted)
+    if status == "membership":
+        msg, gen = reply
+        raise MembershipChanged(msg, gen)
+    if status == "evicted":
+        raise WorkerEvicted(reply)
+    if status == "group_failed":
+        raise GroupFailed(reply)
+    if status == "elastic_timeout":
+        raise ElasticTimeout(reply)
+    return False
+
+
 def server_address() -> Optional[str]:
     """host:port of the parameter server for this job.
 
@@ -81,6 +117,11 @@ class KVServer:
         self._updater = None
         self._optimizer = None
         self._lock = threading.Lock()
+        # elastic-membership control plane (mxnet_tpu/elastic/):
+        # created lazily on the first elastic.* command so plain
+        # dist_async jobs pay nothing
+        self._elastic = None
+        self._elastic_lock = threading.Lock()
         self._num_workers = num_workers
         self._barrier_count = 0
         self._barrier_generation = 0
@@ -127,7 +168,7 @@ class KVServer:
                     reply = self._handle(cmd, key, payload)
                     _send_msg(conn, ("ok", reply))
                 except Exception as e:  # surface errors to the worker
-                    _send_msg(conn, ("err", f"{type(e).__name__}: {e}"))
+                    _send_msg(conn, _error_reply(e))
         except (ConnectionError, OSError):
             pass
         finally:
@@ -138,7 +179,61 @@ class KVServer:
                     self._lost_connections += 1
                     self._barrier_cv.notify_all()
 
+    def _ensure_elastic(self):
+        """The membership coordinator, created on first elastic use.
+        Heartbeat/miss/min-world policy comes from the MXELASTIC_*
+        flags of the SERVER process (the rank-0 control plane owns the
+        verdicts)."""
+        with self._elastic_lock:
+            if self._elastic is None:
+                from .elastic.coordinator import ElasticCoordinator
+                self._elastic = ElasticCoordinator()
+                _log.info("elastic membership control plane armed "
+                          "(lost after %.2fs)",
+                          self._elastic.tracker.lost_after_s)
+            return self._elastic
+
+    def _handle_elastic(self, op: str, kw):
+        """The ``elastic.*`` command family: one framed request per
+        coordinator call; blocking calls (allreduce, rebuild_barrier,
+        wait_admitted) block this connection's thread — each worker
+        holds its own connection, so a waiting peer never starves
+        another worker's control traffic."""
+        co = self._ensure_elastic()
+        kw = dict(kw or {})
+        if op == "register":
+            return co.register(kw["worker_id"], kw.get("devices") or ())
+        if op == "heartbeat":
+            return co.heartbeat(kw["worker_id"], kw.get("step"))
+        if op == "leave":
+            return co.leave(kw["worker_id"])
+        if op == "mark_lost":
+            return co.mark_lost(kw["worker_id"])
+        if op == "view":
+            return co.view()
+        if op == "allreduce":
+            return co.allreduce(kw["worker_id"], kw["generation"],
+                                kw["round_id"], kw["key"], kw["value"],
+                                timeout_s=kw.get("timeout_s"))
+        if op == "rebuild_barrier":
+            return co.rebuild_barrier(kw["worker_id"],
+                                      timeout_s=kw.get("timeout_s"))
+        if op == "announce_join":
+            return co.announce_join(kw["worker_id"],
+                                    kw.get("devices") or ())
+        if op == "wait_admitted":
+            return co.wait_admitted(kw["worker_id"],
+                                    timeout_s=kw.get("timeout_s"))
+        if op == "admit_joiners":
+            return co.admit_joiners(kw["leader_id"], kw.get("state"),
+                                    kw.get("meta"))
+        if op == "describe":
+            return co.describe()
+        raise MXNetError(f"unknown elastic op {op!r}")
+
     def _handle(self, cmd: str, key, payload):
+        if cmd == "elastic":
+            return self._handle_elastic(key, payload)
         if cmd == "init":
             with self._lock:
                 self._store.setdefault(key, onp.array(payload, copy=True))
@@ -358,6 +453,7 @@ class KVClient:
                     f"{detail} — typed timeout, safe to retry"
                 ) from None
         if status != "ok":
+            raise_typed_reply(status, reply)  # elastic types re-raise
             raise MXNetError(f"kvstore server: {reply}")
         return reply
 

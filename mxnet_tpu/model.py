@@ -31,7 +31,8 @@ def _create_kvstore(kvstore, num_device, arg_params):
     elif isinstance(kvstore, kvs.KVStoreBase):
         kv = kvstore
     elif isinstance(kvstore, str):
-        if num_device == 1 and "dist" not in kvstore:
+        if num_device == 1 and "dist" not in kvstore \
+                and "elastic" not in kvstore:
             kv = None
         else:
             kv = kvs.create(kvstore)
@@ -50,6 +51,11 @@ def _create_kvstore(kvstore, num_device, arg_params):
         # optimizer locally on pulled weights would corrupt training
         # (ref: model.py _create_kvstore forces this for async too)
         update_on_kvstore = True
+    elif "elastic" in kv.type:
+        # the elastic store has no server-side optimizer role: the
+        # exchange is a generation-fenced allreduce and every worker
+        # updates locally (mxnet_tpu/elastic/, docs/resilience.md)
+        update_on_kvstore = False
     return kv, update_on_kvstore
 
 

@@ -205,7 +205,7 @@ def findings_report(tool: str, findings: Iterable[Finding],
 # cheap (passes hold no state until run)
 def default_manager() -> PassManager:
     from . import (oplint, graphlint, tracercheck, dispatchlint,
-                   steplint, shardlint, servelint)
+                   steplint, shardlint, servelint, elasticlint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
@@ -214,4 +214,5 @@ def default_manager() -> PassManager:
     pm.register(steplint.OptimizerFusionAudit())
     pm.register(shardlint.ShardLint())
     pm.register(servelint.ServeLint())
+    pm.register(elasticlint.ElasticAbortAudit())
     return pm

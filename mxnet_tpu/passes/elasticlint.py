@@ -1,0 +1,150 @@
+"""elasticlint: flag kvstores that can silently wedge on a dead peer.
+
+The failure class the elastic subsystem exists to kill: a
+``KVStoreBase`` subclass that claims the flat-allreduce fast path
+(``supports_flat_allreduce = True``) and overrides the exchange
+(``allreduce_flat`` / ``_global_reduce``) with a *blocking,
+multi-worker* implementation — but never says how a blocked exchange
+aborts when a peer dies. dist_sync-style code like that waits forever
+on a push that will never arrive; nobody notices until the reservation
+burns down.
+
+The contract is the ``elastic_abort`` class attribute
+(kvstore.KVStoreBase):
+
+- ``"local"``       single-process identity reduce — no peer to wedge
+                    on (the base class / local stores);
+- ``"timeout"``     collective/barrier deadlines surface a typed error
+                    (KVStoreDist over jax.distributed —
+                    MXNET_KVSTORE_BARRIER_TIMEOUT);
+- ``"generation"``  fenced by the elastic membership protocol
+                    (mxnet_tpu/elastic/): the implementation must
+                    actually reference :class:`MembershipChanged` —
+                    declared-but-unwired is the same wedge with better
+                    paperwork, so the pass checks the source.
+
+Findings:
+
+- ``silent-wedge`` (error): exchange overridden, no ``elastic_abort``
+  declared in the subclass (it inherits "local" while no longer being
+  local);
+- ``unwired-generation-abort`` (error): declares "generation" but the
+  exchange never touches MembershipChanged;
+- ``unknown-abort-mode`` (warn): declares something outside the
+  vocabulary;
+- ``timeout-abort`` (info): "timeout" is bounded but coarse — kept
+  visible in every audit, like the dispatchlint exemption surface.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import List
+
+from . import Finding, Pass
+
+__all__ = ["ElasticAbortAudit", "ABORT_MODES"]
+
+ABORT_MODES = ("local", "timeout", "generation")
+
+_EXCHANGE_METHODS = ("allreduce_flat", "_global_reduce")
+
+
+def _exchange_sources(klass) -> str:
+    """Concatenated source of the exchange methods THIS class (or a
+    non-base ancestor) defines."""
+    out = []
+    for name in _EXCHANGE_METHODS:
+        fn = klass.__dict__.get(name)
+        if fn is None:
+            continue
+        try:
+            out.append(inspect.getsource(fn))
+        except (OSError, TypeError):
+            pass
+    return "\n".join(out)
+
+
+class ElasticAbortAudit(Pass):
+    """Audit every KVStoreBase subclass in scope (see module
+    docstring). ``run(target)`` accepts an explicit class list for
+    fixture tests; default scope is the classes the kvstore factory
+    can hand out plus any imported subclasses."""
+
+    name = "elasticlint"
+
+    def _default_targets(self):
+        from ..kvstore import KVStoreBase
+
+        def walk(cls):
+            yield cls
+            for sub in cls.__subclasses__():
+                yield from walk(sub)
+
+        # the elastic store registers lazily; make sure the audit sees
+        # the in-repo implementations even on a cold import
+        from ..elastic import kvstore as _ekv  # noqa: F401
+        seen, out = set(), []
+        for cls in walk(KVStoreBase):
+            if cls not in seen:
+                seen.add(cls)
+                out.append(cls)
+        return out
+
+    def run(self, target=None) -> List[Finding]:
+        from ..kvstore import KVStoreBase
+        classes = target if target is not None \
+            else self._default_targets()
+        findings: List[Finding] = []
+        for klass in classes:
+            if not getattr(klass, "supports_flat_allreduce", False):
+                continue  # per-key path only: not this pass's contract
+            overrides = [m for m in _EXCHANGE_METHODS
+                         if m in klass.__dict__]
+            declared = "elastic_abort" in klass.__dict__
+            mode = getattr(klass, "elastic_abort", None)
+            if klass is KVStoreBase:
+                continue  # the contract's definition site
+            if overrides and not declared:
+                findings.append(self.finding(
+                    "silent-wedge", klass.__name__, "error",
+                    f"{klass.__name__} overrides "
+                    f"{'/'.join(overrides)} (a multi-worker exchange) "
+                    "but declares no elastic_abort — inherited "
+                    f"'{mode}' no longer holds; a dead peer wedges "
+                    "every survivor forever. Declare 'timeout' or "
+                    "'generation' (and implement it) — "
+                    "docs/resilience.md elastic section."))
+                continue
+            if mode not in ABORT_MODES:
+                findings.append(self.finding(
+                    "unknown-abort-mode", klass.__name__, "warn",
+                    f"{klass.__name__}.elastic_abort = {mode!r} is "
+                    f"not one of {ABORT_MODES} — the audit cannot "
+                    "tell how a blocked exchange aborts"))
+                continue
+            if mode == "generation":
+                src = _exchange_sources(klass)
+                wired = "MembershipChanged" in src or any(
+                    "MembershipChanged" in _exchange_sources(a)
+                    for a in klass.__mro__[1:]
+                    if a is not KVStoreBase and a is not object)
+                # the fence may also live behind a session/group call
+                wired = wired or "session.allreduce" in src \
+                    or "_reduce_round" in src
+                if not wired:
+                    findings.append(self.finding(
+                        "unwired-generation-abort", klass.__name__,
+                        "error",
+                        f"{klass.__name__} declares elastic_abort="
+                        "'generation' but its exchange never touches "
+                        "MembershipChanged (nor the elastic session "
+                        "reduce) — declared-but-unwired is the same "
+                        "silent wedge with better paperwork"))
+            elif mode == "timeout" and overrides:
+                findings.append(self.finding(
+                    "timeout-abort", klass.__name__, "info",
+                    f"{klass.__name__} aborts blocked exchanges by "
+                    "deadline (MXNET_KVSTORE_BARRIER_TIMEOUT) — "
+                    "bounded but coarse; jobs that should adapt "
+                    "instead of fail want the 'elastic' store"))
+        return findings

@@ -33,7 +33,8 @@ from . import faultplan  # noqa: F401
 from . import hooks  # noqa: F401
 from . import policy  # noqa: F401
 from .faultplan import (FaultInjectedError, FaultPlan,  # noqa: F401
-                        active_plan, inject)
+                        WorkerKilled, WorkerPreempted, active_plan,
+                        inject)
 from .guard import Preempted, TrainGuard  # noqa: F401
 from .policy import (BackoffSchedule, CircuitBreaker,  # noqa: F401
                      CircuitOpenError, RetryBudget, RetryPolicy,

@@ -28,6 +28,10 @@ Actions:
 - ``preempt``   — SIGTERM to this process (the cloud-preemption signal;
   TrainGuard turns it into an emergency checkpoint + clean exit);
 - ``kill``      — SIGKILL to this process (hard crash, nothing runs);
+  in *thread mode* (``inject(..., thread_mode=True)``, the per-worker
+  ``elastic.worker.<id>`` sites of the in-process elastic drills)
+  preempt/kill instead raise the typed :class:`WorkerPreempted` /
+  :class:`WorkerKilled` so exactly ONE worker thread dies;
 - ``nan``       — return the token ``"nan"`` to the caller, which
   poisons that step's loss (TrainGuard's non-finite rollback drill).
 
@@ -49,8 +53,9 @@ from typing import Dict, List, Optional
 from ..base import MXNetError
 from .policy import RetryableError
 
-__all__ = ["FaultInjectedError", "Clause", "FaultPlan", "parse_plan",
-           "active_plan", "inject", "is_active", "reset"]
+__all__ = ["FaultInjectedError", "WorkerKilled", "WorkerPreempted",
+           "Clause", "FaultPlan", "parse_plan", "active_plan", "inject",
+           "is_active", "reset"]
 
 # the injection sites the framework wires up; inject() accepts any name
 # (user code can add its own sites) but the parser warns on typos
@@ -61,6 +66,20 @@ KNOWN_SITES = ("kvstore.push", "kvstore.pull", "io", "serve.submit",
 class FaultInjectedError(RetryableError):
     """An injected transient fault (``raise`` action). Retryable by
     contract: policies treat it exactly like a real transient failure."""
+
+
+class WorkerKilled(MXNetError):
+    """Thread-mode ``kill``: this in-process drill worker dies NOW —
+    abrupt, no cleanup, no goodbye (the SIGKILL analog for worker
+    threads; elastic drills detect the death via missed heartbeats).
+    NOT retryable."""
+
+
+class WorkerPreempted(MXNetError):
+    """Thread-mode ``preempt``: this in-process drill worker received
+    its preemption notice — it should leave the group gracefully
+    (`ElasticSession.leave`) and exit (the SIGTERM analog). NOT
+    retryable."""
 
 
 _CLAUSE_RE = re.compile(
@@ -173,13 +192,22 @@ class FaultPlan:
         self._lock = threading.Lock()
 
     def inject(self, site: str, step: Optional[int] = None,
-               count: bool = True) -> Optional[str]:
+               count: bool = True,
+               thread_mode: bool = False) -> Optional[str]:
         """Evaluate the plan at ``site``; applies the matched action.
 
         Returns ``"nan"`` for the nan action (the caller poisons its
         loss), None otherwise. ``count=False`` re-evaluates without
         advancing the invocation counter (unused today; drills rely on
-        every attempt counting so ``@K`` clauses clear on retry)."""
+        every attempt counting so ``@K`` clauses clear on retry).
+
+        ``thread_mode=True`` scopes process-level actions to the
+        calling worker THREAD: ``kill``/``preempt`` raise the typed
+        :class:`WorkerKilled` / :class:`WorkerPreempted` instead of
+        signaling the whole process — the in-process elastic drills
+        (``tools/mxresil.py elastic``, ``bench.py --elastic``) run N
+        workers in one process and must kill exactly one
+        (``elastic.worker.<id>`` sites, docs/resilience.md)."""
         with self._lock:
             inv = self._invocations.get(site, 0) + (1 if count else 0)
             if count:
@@ -205,9 +233,20 @@ class FaultPlan:
                 + (f", step {step}" if step is not None else "")
                 + f"): {name}")
         if hit.action == "preempt":
+            if thread_mode:
+                raise WorkerPreempted(
+                    f"injected preemption notice at {site} "
+                    f"(invocation {inv}"
+                    + (f", step {step}" if step is not None else "")
+                    + ") — leave the group and exit")
             os.kill(os.getpid(), signal.SIGTERM)
             return None
         if hit.action == "kill":
+            if thread_mode:
+                raise WorkerKilled(
+                    f"injected kill at {site} (invocation {inv}"
+                    + (f", step {step}" if step is not None else "")
+                    + ") — die without cleanup")
             os.kill(os.getpid(), signal.SIGKILL)
             return None  # unreachable
         return "nan"
@@ -254,13 +293,14 @@ def active_plan() -> Optional[FaultPlan]:
     return _ACTIVE
 
 
-def inject(site: str, step: Optional[int] = None) -> Optional[str]:
+def inject(site: str, step: Optional[int] = None,
+           thread_mode: bool = False) -> Optional[str]:
     """The hook every wired call site runs. No-op (and no allocation)
     when no fault plan is set."""
     plan = active_plan()
     if plan is None:
         return None
-    return plan.inject(site, step=step)
+    return plan.inject(site, step=step, thread_mode=thread_mode)
 
 
 def reset() -> None:

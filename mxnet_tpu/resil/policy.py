@@ -275,6 +275,7 @@ class RetryPolicy:
                  backoff: Optional[BackoffSchedule] = None,
                  retry_on: Tuple[Type[BaseException], ...] =
                  (RetryableError,),
+                 no_retry: Tuple[Type[BaseException], ...] = (),
                  budget: Optional[RetryBudget] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  clock: Callable[[], float] = time.monotonic,
@@ -286,6 +287,13 @@ class RetryPolicy:
                                else config.get("MXRESIL_RETRY_MAX"))
         self.backoff = backoff or BackoffSchedule()
         self.retry_on = retry_on
+        # ``no_retry`` fences specific RetryableError subtypes OUT of
+        # blind retry: elastic MembershipChanged is retryable by
+        # CONTRACT (no partial effect) but re-issuing under a stale
+        # generation can never succeed — the caller's rebuild is the
+        # retry, so the policy re-raises it immediately instead of
+        # burning backoff (mxnet_tpu/elastic/, docs/resilience.md)
+        self.no_retry = tuple(no_retry)
         self.budget = budget
         self.breaker = breaker
         self._clock = clock
@@ -305,6 +313,8 @@ class RetryPolicy:
             try:
                 result = fn(*args, **kwargs)
             except self.retry_on as e:
+                if self.no_retry and isinstance(e, self.no_retry):
+                    raise  # typed fence: the caller's rebuild retries
                 reason = None
                 if retry >= self.max_retries:
                     reason = f"retries exhausted ({self.max_retries})"

@@ -25,6 +25,13 @@ Findings use the shared mxlint schema
 automation that consumes ``tools/mxlint.py --json`` consumes watchdog
 output (``tools/mxresil.py watch --json``). The clock is injectable:
 tests drive stall windows with a fake clock and zero sleeping.
+
+Extension points: :meth:`Watchdog.add_probe` registers extra detectors
+(the elastic coordinator's per-worker missed-heartbeat probe emits
+``worker_lost`` findings), and :meth:`Watchdog.on_verdict` registers
+verdict ACTIONS — with none registered (the default) the watchdog
+stays report-only; the elastic subsystem opts in a handler that turns
+a ``worker_lost`` verdict into a membership-generation bump.
 """
 from __future__ import annotations
 
@@ -69,6 +76,12 @@ class Watchdog:
         self._last_dispatch: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # pluggable detectors + the verdict-action registry (elastic
+        # membership wires both — see on_verdict below). Both default
+        # empty: the watchdog stays REPORT-ONLY unless a subsystem
+        # explicitly opts a handler in.
+        self._probes: List[Callable[[], List[Finding]]] = []
+        self._verdict_handlers: List[Callable[[Finding], None]] = []
         self._g_ewma = _metrics.gauge(
             "mxresil_step_ewma_seconds", "EWMA of step wall time")
         self._g_age = _metrics.gauge(
@@ -126,6 +139,29 @@ class Watchdog:
             if disp is not None:
                 self._last_dispatch = disp.value()
 
+    # -- extension points -------------------------------------------------
+    def add_probe(self, probe: Callable[[], List[Finding]]
+                  ) -> Callable[[], List[Finding]]:
+        """Register an extra detector: a zero-arg callable returning
+        mxlint-schema findings, run on every :meth:`check`. The
+        elastic coordinator registers its missed-heartbeat probe here
+        (``worker_lost`` findings, ElasticCoordinator.attach_watchdog)."""
+        self._probes.append(probe)
+        return probe
+
+    def on_verdict(self, handler: Callable[[Finding], None]
+                   ) -> Callable[[Finding], None]:
+        """Register a verdict ACTION: called once per finding each
+        :meth:`check`. With no handlers registered (the default) the
+        watchdog is report-only — exactly the old behavior. The
+        elastic subsystem opts in a handler that turns a
+        ``worker_lost`` finding into a membership-generation bump
+        instead of just a log line (docs/resilience.md). Handler
+        exceptions are swallowed: the watchdog must never kill the
+        job it guards."""
+        self._verdict_handlers.append(handler)
+        return handler
+
     # -- checking ---------------------------------------------------------
     def stall_threshold_s(self) -> float:
         if self.stall_after_s > 0:
@@ -174,6 +210,17 @@ class Watchdog:
                     f"circuit {site!r} is {st['state']} after "
                     f"{st['consecutive_failures']} consecutive "
                     "failures — running degraded"))
+        for probe in list(self._probes):
+            try:
+                findings.extend(probe() or [])
+            except Exception:  # a broken probe must not kill the job
+                pass
+        for f in findings:
+            for handler in list(self._verdict_handlers):
+                try:
+                    handler(f)
+                except Exception:  # actions are best-effort too
+                    pass
         return findings
 
     # -- background mode --------------------------------------------------

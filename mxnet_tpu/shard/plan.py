@@ -200,6 +200,17 @@ class ShardPlan:
                                 for p, s in self.param_specs.items()},
                 "n_devices": self.n_devices}
 
+    def reinfer(self, devices=None) -> "ShardPlan":
+        """LIVE batch-axis re-inference: the same path
+        :meth:`from_manifest` runs at restore time, but against the
+        devices present NOW — no manifest round-trip. The elastic
+        rebuild uses this when a membership change removes (or
+        returns) a worker's devices: non-batch axes keep their sizes,
+        the batch axis re-infers from what is left
+        (gluon.Trainer._on_membership_change, docs/resilience.md)."""
+        return type(self).from_manifest(self.describe(),
+                                        devices=devices)
+
     @classmethod
     def from_manifest(cls, desc: Dict[str, object],
                       devices=None) -> "ShardPlan":

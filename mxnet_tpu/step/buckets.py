@@ -47,9 +47,15 @@ class GradientBuckets:
 
     def __init__(self, items: Sequence[Tuple[int, Tuple[int, ...], object,
                                              int]],
-                 cap_bytes: int = 0):
+                 cap_bytes: int = 0, world_size: int = 1):
         self.cap_bytes = int(cap_bytes) if cap_bytes else int(
             get_env("MXNET_GRAD_BUCKET_BYTES", DEFAULT_BUCKET_BYTES))
+        # the world size this layout was built for: elastic membership
+        # changes re-key the layout through layout_key() even though
+        # the assignment itself only depends on shapes/dtypes — a
+        # rebuilt group must never exchange under a stale layout whose
+        # round numbering belonged to the dead generation
+        self.world_size = int(world_size)
         open_by_dtype: Dict[str, _Bucket] = {}
         self.buckets: List[_Bucket] = []
         for index, shape, dtype, nbytes in items:
@@ -75,6 +81,16 @@ class GradientBuckets:
             "grad_bucket_bytes", "bytes per gradient-exchange bucket")
         for b in self.buckets:
             h.observe(b.nbytes)
+
+    def layout_key(self) -> Tuple:
+        """Everything that invalidates a cached assignment: the item
+        rows, the byte cap, and the world size the exchange runs at
+        (gluon Trainer and the elastic step key their cached layouts
+        on this)."""
+        entries = tuple((b.dtype if isinstance(b.dtype, str)
+                         else str(b.dtype), tuple(b.entries))
+                        for b in self.buckets)
+        return (entries, self.cap_bytes, self.world_size)
 
     def __len__(self):
         return len(self.buckets)

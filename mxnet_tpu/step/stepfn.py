@@ -301,12 +301,37 @@ class StepFunction:
             [trainable_vals[n] for n in self._trainable],
             [grads[n] for n in self._trainable], svals, lrs, wds)
 
-    def _build_block(self):
+    def _build_grads(self):
+        """Pure ``(pvals, inputs, rng) -> (grads, extras, loss)``
+        builder — the forward+backward phase shared by the one-program
+        step and the elastic split-phase step (mxnet_tpu/elastic/
+        stepfn.py, which exchanges gradients host-side between this
+        and the update program). ``extras`` is the non-gradient state
+        the step must write back (BN running stats; the symbol graph's
+        ``__aux__`` dict)."""
+        if self._symbol_mode:
+            sym = self._net
+            trainable = self._trainable
+            input_names = self._input_names
+            from ..executor import graph_forward_backward
+            fb = graph_forward_backward(sym, list(trainable))
+
+            def pure_grads(pvals, inputs, rng):
+                arg_vals = dict(pvals)
+                arg_vals.update(zip(input_names, inputs))
+                aux_vals = dict(arg_vals.pop("__aux__", {}))
+                outs, aux_updates, grads = fb(
+                    arg_vals, aux_vals, rng,
+                    tuple([None] * len(sym._outputs)))
+                return grads, {"__aux__": dict(aux_updates)}, outs[0]
+
+            return pure_grads
+
         block, loss_fn = self._net, self._loss_fn
         trainable = self._trainable
         from ..gluon.block import functional_call
 
-        def pure_step(pvals, svals, lrs, wds, inputs, rng):
+        def pure_grads(pvals, inputs, rng):
             def loss_of(tvals):
                 allp = dict(pvals)
                 allp.update(tvals)
@@ -326,34 +351,25 @@ class StepFunction:
             tvals = {n: pvals[n] for n in trainable}
             lout, vjp_fn, aux = jax.vjp(loss_of, tvals, has_aux=True)
             grads = vjp_fn(jnp.ones_like(lout))[0]
-            new_w, new_s = self._apply(tvals, grads, svals, lrs, wds)
-            new_params = dict(pvals)
-            new_params.update(zip(trainable, new_w))
-            new_params.update(aux)  # BN running stats
-            return new_params, new_s, lout
+            return grads, aux, lout  # aux: BN running stats
 
-        return pure_step
+        return pure_grads
 
-    def _build_symbol(self):
-        sym = self._net
+    def _build_pure(self):
+        """The whole-step program: grads + exchange + fused update in
+        one trace (the expression DAG is unchanged by the _build_grads
+        factoring — bitwise parity with the eager loop holds)."""
+        grads_fn = self._build_grads()
         trainable = self._trainable
-        input_names = self._input_names
-        from ..executor import graph_forward_backward
-        fb = graph_forward_backward(sym, list(trainable))
 
         def pure_step(pvals, svals, lrs, wds, inputs, rng):
-            arg_vals = dict(pvals)
-            arg_vals.update(zip(input_names, inputs))
-            aux_vals = dict(arg_vals.pop("__aux__", {}))
-            outs, aux_updates, grads = fb(
-                arg_vals, aux_vals, rng,
-                tuple([None] * len(sym._outputs)))
+            grads, extras, lout = grads_fn(pvals, inputs, rng)
             tvals = {n: pvals[n] for n in trainable}
             new_w, new_s = self._apply(tvals, grads, svals, lrs, wds)
             new_params = dict(pvals)
             new_params.update(zip(trainable, new_w))
-            new_params["__aux__"] = dict(aux_updates)
-            return new_params, new_s, outs[0]
+            new_params.update(extras)
+            return new_params, new_s, lout
 
         return pure_step
 
@@ -412,12 +428,10 @@ class StepFunction:
         for i, ns in zip(self._indices, new_states):
             _state_rebind(self._updater.states[i], ns)
 
-    def step(self, x, *labels, batch_size=None):
-        """Run one fused training step; returns the loss NDArray."""
-        from ..telemetry import metrics as _metrics
-        from .. import telemetry as _telemetry
-        t0 = time.perf_counter()
-        inputs = tuple(_raw(a) for a in (x,) + labels)
+    def _prepare(self, inputs):
+        """Resolve parameters (and re-derive the trainable set on a
+        grad_req flip) before keying/compiling — shared with the
+        elastic split-phase step."""
         if not self._symbol_mode:
             if self._plist is None:
                 self._resolve_block_params(inputs[0])
@@ -429,6 +443,27 @@ class StepFunction:
                 # implicitly, so the fused step must too)
                 self._resolve_block_params(inputs[0])
                 self._cache.clear()
+
+    def _record_miss(self, inputs):
+        """Count + classify one signature-cache miss (the recompile
+        auditor's fused_step kind)."""
+        from ..telemetry import metrics as _metrics
+        from ..telemetry import recompile as _recompile
+        _metrics.counter(
+            "fused_step_cache_misses_total",
+            "fused-step signature-cache misses (compiles)").inc()
+        _recompile.record_recompile(
+            f"StepFunction:{self._name}",
+            _recompile.signature_of([_wrap(v) for v in inputs], True),
+            kind="fused_step")
+
+    def step(self, x, *labels, batch_size=None):
+        """Run one fused training step; returns the loss NDArray."""
+        from ..telemetry import metrics as _metrics
+        from .. import telemetry as _telemetry
+        t0 = time.perf_counter()
+        inputs = tuple(_raw(a) for a in (x,) + labels)
+        self._prepare(inputs)
         if batch_size is None:
             batch_size = int(inputs[0].shape[0]) if inputs[0].ndim else 1
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -443,19 +478,9 @@ class StepFunction:
                self._optimizer.fused_signature()) + self._shard_key()
         fn = self._cache.get(key)
         if fn is None:
-            _metrics.counter(
-                "fused_step_cache_misses_total",
-                "fused-step signature-cache misses (compiles)").inc()
-            from ..telemetry import recompile as _recompile
-            _recompile.record_recompile(
-                f"StepFunction:{self._name}",
-                _recompile.signature_of(
-                    [_wrap(v) for v in inputs], True),
-                kind="fused_step")
+            self._record_miss(inputs)
             tb0 = time.perf_counter()
-            pure = (self._build_symbol() if self._symbol_mode
-                    else self._build_block())
-            fn = self._make_jit(pure)
+            fn = self._make_jit(self._build_pure())
             self._cache[key] = fn
             self._last = (fn, key)
             _metrics.histogram(

@@ -266,6 +266,46 @@ def check_resilience():
         print("metrics      : none (no resil hook has fired)")
 
 
+def check_elastic():
+    """Elastic-membership health: MXELASTIC_* policy, the current
+    generation/world gauges, rebuild/rejoin counters
+    (mxnet_tpu/elastic/; docs/resilience.md elastic section)."""
+    print("----------Elastic membership (mxelastic)----------")
+    try:
+        from mxnet_tpu import config, telemetry
+    except Exception as e:
+        print("elastic      : unavailable (%s)" % e)
+        return
+    hb = config.get("MXELASTIC_HEARTBEAT_S")
+    miss = config.get("MXELASTIC_MISS_LIMIT")
+    print("heartbeat    : every %ss, lost after %d misses (%.2fs)"
+          % (hb, miss, float(hb) * int(miss)))
+    print("min world    :", config.get("MXELASTIC_MIN_WORLD"),
+          "(below this the group hard-fails)")
+    print("lr scaling   :", "linear (base_lr x world/ref_world)"
+          if config.get("MXELASTIC_LR_SCALE") else "off")
+    print("loss tol     :", config.get("MXELASTIC_LOSS_TOL"),
+          "(declared drill tolerance)")
+    snap = telemetry.snapshot()
+    elastic_metrics = {k: v for k, v in snap.items()
+                       if k.startswith("mxelastic_")}
+    if not elastic_metrics:
+        print("metrics      : none (no elastic group in this process)")
+        return
+    for k, v in sorted(elastic_metrics.items()):
+        print(f"  {k} = {v}")
+    gen = snap.get("mxelastic_generation")
+    world = snap.get("mxelastic_world_size")
+    if gen is not None:
+        print(f"group        : generation {gen}, world {world}")
+    lost = snap.get("mxelastic_lost_workers_total", 0)
+    rejoins = snap.get("mxelastic_rejoins_total", 0)
+    if lost and not rejoins:
+        print(f"  NOTE: {lost} worker(s) lost and none rejoined — "
+              "running shrunk; restart the lost workers to rejoin "
+              "from group state (docs/resilience.md runbook)")
+
+
 def main():
     check_python()
     check_pip()
@@ -277,6 +317,7 @@ def main():
     check_serving()
     check_serving2()
     check_resilience()
+    check_elastic()
     check_mxlint()
 
 

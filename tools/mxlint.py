@@ -323,6 +323,14 @@ def main(argv=None):
         findings.extend(step_findings)
         sections.append(("steplint", "optimizer fused_apply coverage",
                          step_findings))
+        # silent-wedge audit: kvstores claiming the flat-allreduce
+        # fast path must declare (and wire) how a blocked exchange
+        # aborts when a peer dies (the elastic membership contract)
+        from mxnet_tpu.passes.elasticlint import ElasticAbortAudit
+        el_findings = ElasticAbortAudit().run()
+        findings.extend(el_findings)
+        sections.append(("elasticlint", "kvstore exchange-abort "
+                                        "contract", el_findings))
     for path in args.graphs:
         try:
             with open(path) as f:

@@ -8,6 +8,14 @@ Subcommands (see docs/resilience.md):
            report MTTR, steps lost, and bitwise-equality of the final
            params against an uninterrupted baseline run
            python tools/mxresil.py drill --plan "step:40=preempt"
+  elastic  run N IN-PROCESS elastic workers (mxnet_tpu/elastic/),
+           kill one at step K via the thread-mode fault plan, rejoin
+           a fresh worker from group state-sync, and report recovery
+           time, post-shrink throughput ratio, the per-generation
+           re-key budget, and the final-loss delta vs an
+           uninterrupted baseline (gates in the mxlint findings
+           schema)
+           python tools/mxresil.py elastic --workers 3 --kill-step 12
   plan     parse/validate a fault plan and print its clauses
            python tools/mxresil.py plan --plan "kvstore.push@3=raise"
   watch    run the watchdog over a live metrics process once and emit
@@ -157,6 +165,88 @@ def cmd_drill(args):
     return 0 if ok else 1
 
 
+def cmd_elastic(args):
+    """The elastic kill/rejoin drill (in one process — the workers are
+    threads sharing a coordinator, killed via the thread-mode fault
+    plan so exactly one dies). Two runs: uninterrupted baseline, then
+    the faulted run; gates are reported as mxlint-schema findings and
+    drive the exit code."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu import config
+    from mxnet_tpu.elastic.drill import run_elastic_drill
+    from mxnet_tpu.passes import Finding, findings_report
+
+    common = dict(n_workers=args.workers, steps=args.steps,
+                  batch=args.batch, hb_interval=args.hb_interval,
+                  seed=args.seed, timeout_s=args.timeout)
+    baseline = run_elastic_drill(**common)
+    drill = run_elastic_drill(
+        kill_step=args.kill_step, kill_rank=args.kill_rank,
+        action=args.action, rejoin=not args.no_rejoin,
+        rejoin_after_steps=args.rejoin_after, **common)
+
+    tol = float(config.get("MXELASTIC_LOSS_TOL"))
+    base_loss = baseline.get("final_loss")
+    loss = drill.get("final_loss")
+    loss_delta = (abs(loss - base_loss)
+                  / max(abs(base_loss), 1e-9)
+                  if loss is not None and base_loss is not None
+                  else None)
+    ratio = drill.get("shrink_throughput_ratio")
+    findings = []
+    if loss_delta is None or loss_delta > tol:
+        findings.append(Finding(
+            "mxresil.elastic", "loss-tolerance", "drill", "error",
+            f"final-loss delta {loss_delta} vs baseline exceeds the "
+            f"declared MXELASTIC_LOSS_TOL={tol} (drill {loss}, "
+            f"baseline {base_loss})"))
+    if ratio is None or ratio < args.min_ratio:
+        # fail CLOSED: an unmeasured shrunk phase is not a pass
+        findings.append(Finding(
+            "mxresil.elastic", "shrink-throughput", "drill", "error",
+            f"post-shrink aggregate throughput ratio {ratio} below "
+            f"the {args.min_ratio} gate (full "
+            f"{drill.get('rate_full_samples_per_s')} -> shrunk "
+            f"{drill.get('rate_shrunk_samples_per_s')} samples/s)"
+            if ratio is not None else
+            "shrunk phase recorded no steps — the >=0.6x throughput "
+            "contract was never measured"))
+    if drill.get("recompiles_after_rebuild", 0):
+        findings.append(Finding(
+            "mxresil.elastic", "steady-state-recompiles", "drill",
+            "error",
+            f"{drill['recompiles_after_rebuild']} compile(s) beyond "
+            "the one-re-key-per-generation budget after the rebuild"))
+    for wid, rk in (drill.get("rekeys") or {}).items():
+        if rk["grad"] != 1 or rk["update"] != len(rk["worlds"]):
+            findings.append(Finding(
+                "mxresil.elastic", "rekey-budget", wid, "error",
+                f"{wid} compiled {rk['grad']} grad / {rk['update']} "
+                f"update programs across worlds {rk['worlds']} — "
+                "budget is 1 grad total and 1 update per world size"))
+
+    record = findings_report("mxresil.elastic", findings, extra={
+        "metric": "mxelastic_drill",
+        "steps_to_recover": 1,  # the fenced step completes post-rebuild
+        "recovery_s": drill.get("recovery_s"),
+        "shrink_throughput_ratio": ratio,
+        "final_loss": loss, "baseline_loss": base_loss,
+        "loss_delta_rel": (round(loss_delta, 6)
+                           if loss_delta is not None else None),
+        "loss_tol": tol,
+        "rekeys": drill.get("rekeys"),
+        "recompiles_after_rebuild":
+            drill.get("recompiles_after_rebuild"),
+        "rejoined": drill.get("rejoin"),
+        "per_worker": drill.get("per_worker"),
+        "final_view": drill.get("final_view"),
+    })
+    print(json.dumps(record) if args.json
+          else json.dumps(record, indent=2))
+    return 1 if findings else 0
+
+
 def cmd_plan(args):
     from mxnet_tpu.resil import faultplan
     try:
@@ -229,6 +319,29 @@ def main(argv=None):
     d.add_argument("--max-steps-lost", type=int, default=1)
     d.add_argument("--timeout", type=float, default=300.0)
     d.set_defaults(fn=cmd_drill)
+
+    e = sub.add_parser("elastic", help="in-process elastic kill/rejoin "
+                                       "drill")
+    e.add_argument("--workers", type=int, default=3)
+    e.add_argument("--steps", type=int, default=40)
+    e.add_argument("--kill-step", type=int, default=12)
+    e.add_argument("--kill-rank", type=int, default=1)
+    e.add_argument("--action", choices=("kill", "preempt"),
+                   default="kill",
+                   help="kill = hard death, detected by missed "
+                        "heartbeats; preempt = graceful leave")
+    e.add_argument("--no-rejoin", action="store_true")
+    e.add_argument("--rejoin-after", type=int, default=8,
+                   help="shrunk-phase steps before the rejoin")
+    e.add_argument("--batch", type=int, default=8)
+    e.add_argument("--hb-interval", type=float, default=0.15,
+                   help="drill heartbeat interval (seconds)")
+    e.add_argument("--min-ratio", type=float, default=0.6,
+                   help="post-shrink aggregate-throughput gate")
+    e.add_argument("--seed", type=int, default=0)
+    e.add_argument("--timeout", type=float, default=120.0)
+    e.add_argument("--json", action="store_true")
+    e.set_defaults(fn=cmd_elastic)
 
     pl = sub.add_parser("plan", help="validate/expand a fault plan")
     pl.add_argument("--plan", required=True)
