@@ -1074,6 +1074,145 @@ def elastic_main():
                       "ratio", vs=None, **record)
 
 
+def guard_main():
+    """mxguard integrity benchmark (--guard / MXTPU_BENCH_GUARD=1),
+    two phases, ONE BENCH-schema JSON line (metric mxguard_drill,
+    value = taps-on/taps-off median step-time ratio):
+
+    - **overhead**: two identical fused-step stacks trained
+      INTERLEAVED (per PR-7's drifty-clock note), one with MXGUARD
+      taps on and one off; contract: <3% median overhead, zero
+      recompiles after warmup (one program per stack), and taps-on
+      final weights BITWISE equal to taps-off — the taps are free in
+      semantics and near-free in time;
+    - **drill**: the elastic sdc drill — one element of one worker's
+      gradients bit-flipped from the drill step onward; contract:
+      detected within 1 step, attributed to the corrupted worker,
+      quarantined through a membership bump, and the survivors' final
+      loss within MXELASTIC_LOSS_TOL of an uninterrupted baseline.
+
+    Knobs: MXTPU_BENCH_GUARD_{STEPS,WORKERS,DRILL_STEPS,KILL_STEP}."""
+    os.environ.setdefault("MXTPU_BENCH_FORCE_CPU", "1")  # thread drill
+    jax, devices, probe_status = _init_jax()
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, gluon, nd, telemetry
+    from mxnet_tpu.elastic.drill import run_elastic_drill
+
+    n_steps = int(os.environ.get("MXTPU_BENCH_GUARD_STEPS", "40"))
+    workers = int(os.environ.get("MXTPU_BENCH_GUARD_WORKERS", "3"))
+    drill_steps = int(os.environ.get("MXTPU_BENCH_GUARD_DRILL_STEPS",
+                                     "24"))
+    kill_step = int(os.environ.get("MXTPU_BENCH_GUARD_KILL_STEP", "8"))
+
+    os.environ.pop("MXRESIL_FAULT_PLAN", None)
+    config.unset_flag("MXRESIL_FAULT_PLAN")
+
+    # ---- phase 1: tap overhead on the plain fused step --------------
+    # a compute-heavy conv stack: the taps' cost is one extra
+    # elementwise pass over weights+grads per step, so the honest
+    # denominator is a step whose time is dominated by real model
+    # compute (conv FLOPs), not a toy MLP where fixed per-dispatch
+    # overhead IS the step
+    def build(seed=7):
+        mx.random.seed(seed)
+        onp.random.seed(seed)
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            # explicit in_channels/in_units: weights materialize HERE,
+            # under the just-seeded stream — deferred init would draw
+            # the second stack's weights from a shifted stream and
+            # fake a parity failure
+            for cin, nf in ((3, 16), (16, 32), (32, 32)):
+                net.add(gluon.nn.Conv2D(nf, kernel_size=3, padding=1,
+                                        in_channels=cin,
+                                        activation="relu"))
+            net.add(gluon.nn.GlobalAvgPool2D())
+            net.add(gluon.nn.Flatten())
+            net.add(gluon.nn.Dense(10, in_units=32))
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01,
+                                 "momentum": 0.9})
+        return net, trainer, trainer.fuse_step(
+            net, gluon.loss.SoftmaxCrossEntropyLoss())
+
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (8, 3, 32, 32)).astype("float32"))
+    y = nd.array(rng.randint(0, 10, (8,)).astype("float32"))
+    net_off, tr_off, fused_off = build()
+    net_on, tr_on, fused_on = build()
+    stacks = ((False, fused_off), (True, fused_on))
+    for taps, fused in stacks:  # warmup: one program per stack
+        config.set_flag("MXGUARD", taps)
+        for _ in range(3):
+            fused.step(x, y).asnumpy()
+    rc0 = telemetry.recompile_count()
+    times = {False: [], True: []}
+    for _ in range(n_steps):  # interleaved: same drift hits both
+        for taps, fused in stacks:
+            config.set_flag("MXGUARD", taps)
+            t0 = time.perf_counter()
+            fused.step(x, y).asnumpy()  # host fetch = completion fence
+            times[taps].append(time.perf_counter() - t0)
+    config.unset_flag("MXGUARD")
+    recompiles = telemetry.recompile_count() - rc0
+    med = {k: sorted(v)[len(v) // 2] for k, v in times.items()}
+    overhead = round(med[True] / med[False], 4) if med[False] else None
+    weights_equal = all(
+        onp.array_equal(a.data().asnumpy(), b.data().asnumpy())
+        for a, b in zip(tr_off._params, tr_on._params))
+
+    # ---- phase 2: the sdc detection/quarantine drill ----------------
+    common = dict(n_workers=workers, steps=drill_steps, batch=8,
+                  hb_interval=0.15, timeout_s=240.0)
+    baseline = run_elastic_drill(**common)
+    drill = run_elastic_drill(kill_step=kill_step, kill_rank=1,
+                              action="sdc", rejoin=False, **common)
+    guard = drill.get("guard") or {}
+    tol = float(config.get("MXELASTIC_LOSS_TOL"))
+    base_loss, loss = baseline.get("final_loss"), drill.get("final_loss")
+    loss_delta = (abs(loss - base_loss) / max(abs(base_loss), 1e-9)
+                  if loss is not None and base_loss is not None
+                  else None)
+    detected_within = (guard.get("detected_step") - kill_step
+                       if guard.get("detected_step") is not None
+                       else None)
+    attributed = guard.get("suspects") == ["w1"]
+    quarantined = guard.get("quarantined") == ["w1"]
+
+    record = dict(
+        metric="mxguard_drill",
+        steps=n_steps, workers=workers, drill_steps=drill_steps,
+        kill_step=kill_step,
+        taps_off_step_s=round(med[False], 6),
+        taps_on_step_s=round(med[True], 6),
+        overhead_pct=(round((overhead - 1.0) * 100, 2)
+                      if overhead else None),
+        taps_bitwise_equal=bool(weights_equal),
+        recompiles_after_warmup=recompiles,
+        detected_within_steps=detected_within,
+        attributed=attributed,
+        quarantined=quarantined,
+        recovery_s=drill.get("recovery_s"),
+        final_loss=loss, baseline_loss=base_loss,
+        loss_delta_rel=(round(loss_delta, 6)
+                        if loss_delta is not None else None),
+        loss_tol=tol,
+        guard=guard and {k: guard[k] for k in
+                         ("detected_step", "suspects", "quarantined")},
+        guard_ok=(overhead is not None and overhead < 1.03
+                  and bool(weights_equal) and recompiles == 0
+                  and detected_within == 0 and attributed
+                  and quarantined and loss_delta is not None
+                  and loss_delta <= tol),
+        platform=devices[0].platform,
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(overhead, unit="taps-on/taps-off median step-time ratio",
+          vs=None, **record)
+
+
 def graphopt_main():
     """Graph-optimizer A/B benchmark (--graph-opt / MXTPU_BENCH_GRAPHOPT
     =1): bind the same symbol-mode models at MXNET_GRAPH_OPT levels
@@ -1223,6 +1362,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
               else "mxelastic_recovery"
               if os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
+              else "mxguard_drill"
+              if os.environ.get("MXTPU_BENCH_GUARD") == "1"
               else "resnet50_train_throughput")
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
@@ -1273,6 +1414,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_GRAPHOPT"] = "1"
     if "--elastic" in sys.argv:
         os.environ["MXTPU_BENCH_ELASTIC"] = "1"
+    if "--guard" in sys.argv:
+        os.environ["MXTPU_BENCH_GUARD"] = "1"
     # fused whole-train-step compiler: default ON; --no-fused-step
     # measures the eager reference path instead (env form propagates
     # into the --child subprocess)
@@ -1286,6 +1429,7 @@ if __name__ == "__main__":
     _shard = os.environ.get("MXTPU_BENCH_SHARD") == "1"
     _graphopt = os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
     _elastic = os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
+    _guard = os.environ.get("MXTPU_BENCH_GUARD") == "1"
     if "--child" in sys.argv:
         try:
             if _serving2:
@@ -1300,6 +1444,8 @@ if __name__ == "__main__":
                 graphopt_main()
             elif _elastic:
                 elastic_main()
+            elif _guard:
+                guard_main()
             else:
                 main()
         except Exception as e:
@@ -1310,6 +1456,7 @@ if __name__ == "__main__":
                           else "mxshard_scaling" if _shard
                           else "mxopt_speedup" if _graphopt
                           else "mxelastic_recovery" if _elastic
+                          else "mxguard_drill" if _guard
                           else "resnet50_train_throughput"),
                   error=f"{type(e).__name__}: {e}"[:500])
             sys.exit(0)

@@ -324,6 +324,32 @@ class CheckpointManager:
                 extra = pickle.load(f)
         return params, opt_state, extra, manifest
 
+    def manifest(self, step: int) -> Dict:
+        """The committed manifest of checkpoint ``step`` — including
+        its per-array crc32 digests, which double as the known-good
+        weight digests of the mxguard checkpoint ring
+        (mxnet_tpu/guard/replay.py): replay compares recomputed state
+        against these without deserializing the payload."""
+        path = os.path.join(self.directory, f"step_{step}", _MANIFEST)
+        if not os.path.exists(path):
+            raise MXNetError(f"no complete checkpoint at step {step}")
+        with open(path) as f:
+            try:
+                return json.load(f)
+            except ValueError as e:
+                raise MXNetError(
+                    f"checkpoint step_{step}: corrupt manifest ({e})")
+
+    def verify(self, step: int) -> bool:
+        """Full integrity check of checkpoint ``step`` (file sizes +
+        per-array digests) without installing anything; returns True
+        when intact, False when corrupt/truncated/missing."""
+        try:
+            self._restore_attempt(step)
+            return True
+        except Exception:
+            return False
+
     def restore_latest(self, trainer=None):
         """Restart-from-latest, skipping torn checkpoints. Returns the
         restored step, or None when nothing usable exists."""

@@ -507,6 +507,54 @@ register_flag(
     "elastic, bench.py --elastic). The rescaled-batch/LR accounting "
     "exists to keep runs inside it.")
 register_flag(
+    "MXGUARD", bool, False,
+    "Silent-corruption integrity taps (mxnet_tpu/guard/, docs/"
+    "resilience.md integrity section): per-gradient fingerprints "
+    "(checksum, absmax, non-finite count) ride as extra outputs of "
+    "the fused train step, cross-replica voting fences a corrupt "
+    "replica BEFORE its gradients enter the allreduce, and the EWMA "
+    "anomaly probe feeds the watchdog. Part of the fused-step "
+    "signature-cache key: flipping it re-keys once, steady state "
+    "stays at zero recompiles; taps-on training is bitwise-identical "
+    "in weights to taps-off (test-enforced).")
+register_flag(
+    "MXGUARD_VOTE_TOL", float, 1000.0,
+    "Cross-replica vote threshold (guard.fingerprint.vote): a "
+    "gradient fingerprint's absmax beyond this factor over the OTHER "
+    "replicas' median votes the replica suspect. Legitimate "
+    "per-worker batch spread is single-digit; an exponent bit flip "
+    "is ~1e30x — the default leaves orders of magnitude of margin "
+    "both ways.")
+register_flag(
+    "MXGUARD_EWMA_FACTOR", float, 100.0,
+    "Anomaly factor for the report-only EWMA loss/grad-norm probe "
+    "(guard.anomaly.GuardProbe, registered on the resil watchdog): a "
+    "step whose loss or gradient absmax exceeds this factor over its "
+    "EWMA emits an integrity-anomaly finding naming the replay "
+    "window for tools/mxresil.py replay.")
+register_flag(
+    "MXGUARD_RING", int, 256,
+    "Capacity (steps) of the deterministic-replay record ring "
+    "(guard.replay.ReplayRecorder): per step one small record of "
+    "batch crc32 digests, the raw RNG key, hyper scalars, the loss "
+    "digest and the fingerprint matrix — what `tools/mxresil.py "
+    "replay` re-executes bitwise to bisect the first corrupted step.")
+register_flag(
+    "MXGUARD_CKPT_EVERY", int, 25,
+    "Known-good checkpoint-ring cadence (steps) of the replay "
+    "recorder: a ring checkpoint commits only while no guard verdict "
+    "has flagged the run (a snapshot taken after corruption entered "
+    "the weights must never become a recovery point — the ring "
+    "freezes once tainted).")
+register_flag(
+    "MXGUARD_STRICT", bool, False,
+    "Hard-fail the ONE-PROGRAM fused step on non-finite gradient "
+    "fingerprints (GuardCorruption). Off by default: the fused "
+    "program has already applied the update when the taps surface, "
+    "so there is nothing to retry — the split-phase elastic step "
+    "instead classifies by re-execution and retries/quarantines "
+    "regardless of this flag.")
+register_flag(
     "MXRESIL_WATCHDOG_STALL_S", float, 0.0,
     "Heartbeat age that counts as a stall (resil.watchdog.Watchdog). "
     "0 = auto: 10x the step-time EWMA (min 1 s; 30 s before any step "

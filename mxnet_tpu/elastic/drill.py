@@ -117,6 +117,7 @@ class _DrillWorker:
         return sorted({r["world"] for r in self.steps})
 
     def run(self):
+        from ..guard.voting import GuardQuarantined
         from ..resil import faultplan
         from ..resil.faultplan import WorkerKilled, WorkerPreempted
         from mxnet_tpu.ndarray.ndarray import array as nd_array
@@ -141,6 +142,14 @@ class _DrillWorker:
                 except WorkerPreempted:
                     self.death = "preempted"
                     self.session.leave()
+                    self.session.stop_heartbeat_pump()
+                    return
+                except GuardQuarantined:
+                    # the fingerprint vote named this worker and the
+                    # corruption reproduced under re-execution: the
+                    # step already left the group (the membership bump
+                    # survivors fence on) — just stop driving it
+                    self.death = "quarantined"
                     self.session.stop_heartbeat_pump()
                     return
                 self.steps.append({
@@ -197,20 +206,41 @@ def run_elastic_drill(n_workers: int = 3, steps: int = 40,
                       out_dim: int = 4, lr: float = 0.05,
                       seed: int = 0, hb_interval: float = 0.1,
                       miss_limit: int = 3, min_world: int = 1,
-                      timeout_s: float = 120.0) -> Dict[str, object]:
+                      timeout_s: float = 120.0,
+                      fault_plan: Optional[str] = None,
+                      guard: bool = False) -> Dict[str, object]:
     """One scripted drill (see module docstring); returns the report
-    dict. ``kill_step=None`` runs the uninterrupted baseline."""
+    dict. ``kill_step=None`` runs the uninterrupted baseline.
+
+    ``action="sdc"`` (or ``"sdc:scale"``) is the mxguard
+    silent-corruption drill: instead of dying, the selected worker's
+    gradients are corrupted by one element from ``kill_step`` onward
+    (the ``guard.sdc.<worker_id>`` site, persistent ``:K+`` selector), the
+    fingerprint vote catches it pre-averaging, and the worker is
+    QUARANTINED through the same membership-bump machinery a kill
+    exercises — the report gains a ``guard`` section (detection step,
+    attribution, per-worker verdicts). MXGUARD taps are forced on for
+    every worker of an sdc drill (or via ``guard=True`` with any
+    action); ``fault_plan`` overrides the drill-owned plan entirely
+    (custom-selector drills, e.g. a transient ``@K`` sdc clause)."""
     from mxnet_tpu import config
     from ..resil import faultplan
 
+    sdc = action.startswith("sdc")
     saved_plan = config.get("MXRESIL_FAULT_PLAN")
     config.set_flag("MXELASTIC_HEARTBEAT_S", hb_interval)
     config.set_flag("MXELASTIC_MISS_LIMIT", miss_limit)
     config.set_flag("MXELASTIC_MIN_WORLD", min_world)
-    if kill_step is not None:
+    if sdc or guard:
+        mode = action.split(":", 1)[1] if ":" in action else "bitflip"
+        config.set_flag("MXGUARD", True)
+    if fault_plan is not None:
+        config.set_flag("MXRESIL_FAULT_PLAN", fault_plan)
+    elif kill_step is not None:
         config.set_flag(
             "MXRESIL_FAULT_PLAN",
-            f"elastic.worker.{kill_rank}:{kill_step}={action}")
+            f"guard.sdc.w{kill_rank}:{kill_step}+=sdc:{mode}" if sdc
+            else f"elastic.worker.{kill_rank}:{kill_step}={action}")
     else:
         config.set_flag("MXRESIL_FAULT_PLAN", "")
     faultplan.reset()
@@ -220,11 +250,20 @@ def run_elastic_drill(n_workers: int = 3, steps: int = 40,
                     out_dim, lr, seed, hb_interval, miss_limit,
                     min_world, timeout_s)
     finally:
-        config.set_flag("MXRESIL_FAULT_PLAN", saved_plan or "")
+        # restore a caller's programmatic plan override; with none,
+        # drop ours so the env/default value resolves again (the
+        # restore-then-unset form would discard the caller's override
+        # — same bug class fixed in guard/replay.py)
+        if saved_plan:
+            config.set_flag("MXRESIL_FAULT_PLAN", saved_plan)
+        else:
+            config.unset_flag("MXRESIL_FAULT_PLAN")
         faultplan.reset()
         for f in ("MXELASTIC_HEARTBEAT_S", "MXELASTIC_MISS_LIMIT",
-                  "MXELASTIC_MIN_WORLD", "MXRESIL_FAULT_PLAN"):
+                  "MXELASTIC_MIN_WORLD"):
             config.unset_flag(f)
+        if sdc or guard:
+            config.unset_flag("MXGUARD")
 
 
 def _run(n_workers, steps, kill_step, kill_rank, action, rejoin,
@@ -374,4 +413,24 @@ def _run(n_workers, steps, kill_step, kill_rank, action, rejoin,
                 "programs": w.programs(),
                 "start_step": w.start_step}
         for w in all_workers}
+
+    # mxguard verdict summary (sdc drills): who was suspected, when,
+    # and whether the quarantine landed through a membership bump
+    events = {w.wid: list(w.fused.guard_events) for w in all_workers
+              if w.fused.guard_events}
+    if events:
+        suspect_steps = [e["step"] for evs in events.values()
+                         for e in evs if e["kind"] == "suspect"]
+        suspects = [s for evs in events.values() for e in evs
+                    if e["kind"] in ("suspect", "persistent")
+                    for s in (e["suspect"] if isinstance(
+                        e["suspect"], list) else [e["suspect"]])]
+        quarantined = [w.wid for w in all_workers
+                       if w.death == "quarantined"]
+        report["guard"] = {
+            "detected_step": min(suspect_steps) if suspect_steps
+            else None,
+            "suspects": sorted(set(suspects)),
+            "quarantined": quarantined,
+            "events": events}
     return report

@@ -132,6 +132,10 @@ class ElasticKVStore(KVStoreBase):
     # dies — "generation" means every round is fenced by the membership
     # generation and raises the typed MembershipChanged
     elastic_abort = "generation"
+    # guardlint contract: the mxguard fingerprint vote rides a fenced
+    # round BEFORE the bucket allreduce (ElasticStepFunction pairs the
+    # taps with this store's generation-checked rounds)
+    guard_tap = "pre-exchange"
 
     def __init__(self, group=None, worker_id: Optional[str] = None,
                  devices: Sequence[int] = (), join: bool = False,

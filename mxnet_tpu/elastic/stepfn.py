@@ -27,6 +27,14 @@ The trainer keeps owning optimizer state (checkpoints, TrainGuard and
 ``save_states`` see post-update values), and the step boundary is also
 the membership boundary: heartbeats go out here, generation bumps are
 observed here, and the group leader publishes join state here.
+
+With ``MXGUARD=1`` the split point gains the integrity vote
+(mxnet_tpu/guard/): the grad program emits fingerprint taps, workers
+exchange them through a generation-fenced round BEFORE the bucket
+allreduce, and a corrupt replica is classified by deterministic
+re-execution — transient faults retry in place, persistent ones
+quarantine through the same leave/membership-bump machinery
+(docs/resilience.md, integrity section).
 """
 from __future__ import annotations
 
@@ -72,9 +80,9 @@ class ElasticStepFunction(StepFunction):
     # ------------------------------------------------------------------
     # program caches
     # ------------------------------------------------------------------
-    def _grad_key(self, inputs):
+    def _grad_key(self, inputs, guard=False):
         return (tuple((tuple(v.shape), str(v.dtype)) for v in inputs),
-                self._param_dtypes(), self._opt_level) \
+                self._param_dtypes(), self._opt_level, bool(guard)) \
             + self._shard_key()
 
     def _update_key(self):
@@ -84,13 +92,15 @@ class ElasticStepFunction(StepFunction):
         return (self._param_dtypes(), self._opt_level,
                 self._optimizer.fused_signature()) + self._shard_key()
 
-    def _grad_fn(self, inputs):
-        key = self._grad_key(inputs)
+    def _grad_fn(self, inputs, guard=False):
+        key = self._grad_key(inputs, guard)
         fn = self._grad_cache.get(key)
         if fn is None:
             self._record_miss(inputs)
-            fn = jax.jit(self._build_grads())  # params NOT donated:
-            # the update program still needs the pre-step weights
+            # params NOT donated: the update program still needs the
+            # pre-step weights — which is also what makes the mxguard
+            # deterministic re-execution safe (guard/voting.py)
+            fn = jax.jit(self._build_grads(taps=guard))
             self._grad_cache[key] = fn
         return fn
 
@@ -179,9 +189,146 @@ class ElasticStepFunction(StepFunction):
             self._scale / (batch_size * max(1, self._session.world))
 
     # ------------------------------------------------------------------
+    # mxguard: the pre-averaging fingerprint vote (guard/voting.py)
+    # ------------------------------------------------------------------
+    def _guard_grads(self, grads_fn, pvals, inputs, rng):
+        """One gradient computation with the taps: run the grad
+        program, evaluate the sdc drill sites (the injection models
+        the hardware — it fires per attempt, so re-executions see a
+        persistent fault again and a one-shot ``@K`` clause clears),
+        and return (grads, extras, loss, host fingerprint matrix) with
+        any corrupted row recomputed host-side so the reported
+        fingerprint describes the bytes this worker contributes."""
+        import numpy as onp
+        from ..guard.voting import apply_sdc, sdc_token
+        grads, extras, loss, fps = grads_fn(pvals, inputs, rng)
+        fps_host = onp.asarray(fps, dtype=onp.float32)
+        token = sdc_token(self._session.worker_id, self._nstep,
+                          self._session.world)
+        if token is not None:
+            from .. import config
+            grads, name, row = apply_sdc(
+                grads, self._trainable, token, self._nstep,
+                seed=int(config.get("MXRESIL_SEED")))
+            fps_host = fps_host.copy()
+            fps_host[1 + self._trainable.index(name)] = row
+        return grads, extras, loss, fps_host
+
+    def _guard_vote(self, grads_fn, pvals, inputs, rng, grads,
+                    fps_host):
+        """Rounds A/B of the pre-exchange fingerprint vote (module
+        docstring of guard/voting.py). Returns possibly-replaced
+        (grads, fps) on a transient verdict; raises
+        :class:`GuardQuarantined` / :class:`GuardCorruption` on a
+        persistent one; a :class:`MembershipChanged` fence propagates
+        to the caller's rebuild loop like any other fenced round."""
+        import numpy as onp
+        from .. import config
+        from ..guard.fingerprint import vote
+        from ..guard.voting import (GuardCorruption, GuardQuarantined,
+                                    contribution, table_of)
+        from ..telemetry import metrics as _metrics
+        session = self._session
+        me = session.worker_id
+        step = self._nstep
+        n_grads = len(self._trainable)
+
+        if session.world <= 1:
+            # solo: no peers to vote with — self-check on non-finite
+            # GRADIENT fingerprints (a non-finite loss is divergence
+            # territory — TrainGuard's rollback, not quarantine),
+            # classify by re-execution
+            if float(fps_host[1:1 + n_grads, 2].sum()) <= 0:
+                return grads, fps_host
+            _metrics.counter(
+                "mxguard_suspect_verdicts_total",
+                "fingerprint verdicts naming a suspect replica").inc()
+            grads2, _, _, fps2 = self._guard_grads(
+                grads_fn, pvals, inputs, rng)
+            if onp.array_equal(fps_host, fps2, equal_nan=True):
+                self.guard_events.append(
+                    {"step": step, "kind": "persistent",
+                     "suspect": me, "reasons": ["nonfinite"]})
+                _metrics.counter(
+                    "mxguard_hard_fails_total",
+                    "solo runs hard-failed on persistent "
+                    "corruption").inc()
+                raise GuardCorruption(step, ["nonfinite"])
+            self.guard_events.append(
+                {"step": step, "kind": "transient", "suspect": me,
+                 "reasons": ["nonfinite"]})
+            _metrics.counter(
+                "mxguard_transient_total",
+                "transient corruption healed by re-execution").inc()
+            return grads2, fps2
+
+        workers = session.view.workers
+        rank = session.rank
+        world = session.world
+        tol = float(config.get("MXGUARD_VOTE_TOL"))
+        # the exchanged table carries params digest + gradient rows;
+        # the trailing LOCAL loss row stays home (losses legitimately
+        # differ per worker — they would only add vote noise)
+        voted = fps_host[:1 + n_grads]
+        table = table_of(session.allreduce(
+            "__guard_fp", contribution(voted, rank, world)), world)
+        _metrics.counter(
+            "mxguard_votes_total",
+            "cross-replica fingerprint vote rounds").inc()
+        verdict = vote(table, workers, tol=tol)
+        if verdict.clean:
+            return grads, fps_host
+        if verdict.global_anomaly:
+            # every replica agrees the gradients are bad: divergence,
+            # not silent corruption — TrainGuard's jurisdiction
+            self.guard_events.append(
+                {"step": step, "kind": "global-anomaly",
+                 "suspect": None, "reasons": ["all-replicas"]})
+            return grads, fps_host
+        _metrics.counter("mxguard_suspect_verdicts_total",
+                         "fingerprint verdicts naming a suspect "
+                         "replica").inc()
+        suspects = verdict.suspects
+        _log_reasons = sorted(
+            {r for rs in suspects.values() for r in rs})
+        self.guard_events.append(
+            {"step": step, "kind": "suspect",
+             "suspect": sorted(suspects),
+             "reasons": _log_reasons})
+        # round B: suspects re-execute on the same inputs, everyone
+        # re-contributes — the SAME deterministic verdict again tells
+        # every worker how the step ends
+        if me in suspects:
+            grads, _, _, fps_host = self._guard_grads(
+                grads_fn, pvals, inputs, rng)
+        table2 = table_of(session.allreduce(
+            "__guard_fp2",
+            contribution(fps_host[:1 + n_grads], rank, world)), world)
+        verdict2 = vote(table2, workers, tol=tol)
+        if me in verdict2.suspects:
+            # reproduced under re-execution: persistent. Quarantine —
+            # leave (the membership bump survivors fence on) and raise
+            _metrics.counter(
+                "mxguard_quarantines_total",
+                "replicas quarantined for persistent corruption").inc()
+            self.guard_events.append(
+                {"step": step, "kind": "persistent", "suspect": me,
+                 "reasons": verdict2.suspects[me]})
+            session.leave()
+            raise GuardQuarantined(me, step, verdict2.suspects[me])
+        if me in suspects:
+            _metrics.counter(
+                "mxguard_transient_total",
+                "transient corruption healed by re-execution").inc()
+            self.guard_events.append(
+                {"step": step, "kind": "transient", "suspect": me,
+                 "reasons": suspects[me]})
+        return grads, fps_host
+
+    # ------------------------------------------------------------------
     # the step
     # ------------------------------------------------------------------
-    def step(self, x, *labels, batch_size=None):
+    def step(self, x, *labels, batch_size=None, rng_raw=None):
         from ..telemetry import metrics as _metrics
         from .. import telemetry as _telemetry
         t0 = time.perf_counter()
@@ -195,17 +342,30 @@ class ElasticStepFunction(StepFunction):
             batch_size = int(inputs[0].shape[0]) if inputs[0].ndim \
                 else 1
         self._set_rescale(batch_size)
+        guard = self._guard_enabled()
 
-        grads_fn = self._grad_fn(inputs)
+        grads_fn = self._grad_fn(inputs, guard)
         lrs, wds = self._hyper()
         pvals, svals = self._gather()
         from .. import random as _random
-        rng = jax.random.key_data(_random.next_key())
-        grads, extras, loss = grads_fn(pvals, inputs, rng)
+        import jax.numpy as jnp
+        rng = jnp.asarray(rng_raw) if rng_raw is not None \
+            else jax.random.key_data(_random.next_key())
+        fps_host = None
+        if guard:
+            grads, extras, loss, fps_host = self._guard_grads(
+                grads_fn, pvals, inputs, rng)
+        else:
+            grads, extras, loss = grads_fn(pvals, inputs, rng)
 
         t1 = time.perf_counter()
         while True:
             try:
+                if guard:
+                    # the pre-averaging vote: a corrupt replica is
+                    # caught BEFORE its gradients enter the allreduce
+                    grads, fps_host = self._guard_vote(
+                        grads_fn, pvals, inputs, rng, grads, fps_host)
                 reduced = self._exchange_once(grads)
                 break
             except MembershipChanged:
@@ -222,6 +382,11 @@ class ElasticStepFunction(StepFunction):
         new_params = dict(zip(self._trainable, new_w))
         new_params.update(extras)
         self._writeback(new_params, new_s)
+        if guard:
+            flagged = any(e["step"] == self._nstep
+                          for e in self.guard_events)
+            self._guard_note(fps_host, loss, inputs, rng,
+                             good=not flagged, strict=False)
         t3 = time.perf_counter()
 
         self._nstep += 1
@@ -242,6 +407,13 @@ class ElasticStepFunction(StepFunction):
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
+    def guard_state(self) -> Dict[str, object]:
+        state = super().guard_state()
+        state["exchanges_gradients"] = True
+        state["kvstore"] = type(self._kv).__name__
+        state["world"] = int(self._session.world)
+        return state
+
     def program_counts(self) -> Dict[str, int]:
         """Per-instance compiled-program census — the drill's re-key
         budget check reads this (grad programs never re-key on a

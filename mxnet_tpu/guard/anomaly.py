@@ -1,0 +1,194 @@
+"""EWMA loss / gradient-norm anomaly verdicts (the replay trigger).
+
+Silent corruption that slips past the vote (a low-exponent flip, a
+``sdc:scale``-class drift) shows up later as a run that quietly
+diverges. The :class:`GuardProbe` keeps exponential moving averages of
+the step loss and the global gradient absmax — fed by the fingerprint
+taps, so it costs nothing beyond the taps themselves — and turns a
+``MXGUARD_EWMA_FACTOR``x excursion (or a non-finite loss) into an
+mxlint-schema finding that names the **replay window**: the last step
+the probe considered healthy through the anomalous step. That window
+is exactly what ``tools/mxresil.py replay`` re-executes bitwise to
+bisect the first corrupted step.
+
+Report-only by design (false-positive spikes must never kill a healthy
+job): register :func:`check_default` on a
+:class:`~mxnet_tpu.resil.watchdog.Watchdog` via ``add_probe`` and the
+verdicts ride the same findings channel as stall/breaker/worker-lost
+detection. The quarantine/hard-fail actions belong to the voting layer
+(``mxnet_tpu/guard/voting.py``), which has re-execution evidence.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+from ..passes import Finding
+
+__all__ = ["GuardProbe", "default_probe", "check_default",
+           "check_all", "last_anomaly", "reset_default"]
+
+# every live probe, for check_all() (the Watchdog registration that
+# covers N in-process step functions at once) and the newest anomaly
+# across all of them (tools/diagnose.py)
+_PROBES: "weakref.WeakSet[GuardProbe]" = weakref.WeakSet()
+_LAST_ANOMALY: Optional[Dict[str, object]] = None
+
+
+class GuardProbe:
+    """See module docstring. ``observe`` is called once per guarded
+    step; ``check`` drains pending anomaly findings (Watchdog-probe
+    shape: zero-arg → ``[Finding]``). Each step function owns its OWN
+    probe (``StepFunction.guard_probe``) — in-process multi-worker
+    drills must not interleave different workers' loss/step streams
+    into one EWMA, or replay windows come out crossed."""
+
+    def __init__(self, factor: Optional[float] = None,
+                 alpha: float = 0.2, warmup_steps: int = 3,
+                 name: str = ""):
+        if factor is None:
+            from .. import config
+            factor = float(config.get("MXGUARD_EWMA_FACTOR"))
+        self.name = str(name)
+        self.factor = float(factor)
+        self.alpha = float(alpha)
+        self.warmup_steps = int(warmup_steps)
+        self._lock = threading.Lock()
+        self._ewma_loss: Optional[float] = None
+        self._ewma_absmax: Optional[float] = None
+        self._seen = 0
+        self._last_good_step: Optional[int] = None
+        self._pending: List[Finding] = []
+        self.last_anomaly: Optional[Dict[str, object]] = None
+        from ..telemetry import metrics as _metrics
+        import re as _re
+        # per-probe gauges (keyed by the owning step function's name,
+        # like PR 8's per-engine gauges): N in-process workers must
+        # not last-writer-win each other's EWMA telemetry
+        suffix = ("_" + _re.sub(r"[^0-9A-Za-z_]", "_", self.name)
+                  if self.name else "")
+        self._g_loss = _metrics.gauge(
+            f"mxguard_loss_ewma{suffix}",
+            "EWMA of the guarded step loss")
+        self._g_absmax = _metrics.gauge(
+            f"mxguard_grad_absmax_ewma{suffix}",
+            "EWMA of the global gradient absmax (fingerprint taps)")
+        self._m_anomalies = _metrics.counter(
+            "mxguard_anomalies_total",
+            "EWMA loss/grad-norm anomaly verdicts emitted")
+        _PROBES.add(self)
+
+    def _ewma(self, prev, v):
+        return v if prev is None else \
+            self.alpha * v + (1 - self.alpha) * prev
+
+    def observe(self, step: int, loss: Optional[float],
+                grad_absmax: Optional[float]) -> Optional[Dict]:
+        """Feed one step; returns the anomaly record when this step
+        tripped (None = healthy)."""
+        reasons = []
+        with self._lock:
+            seen = self._seen
+            self._seen += 1
+            if loss is not None:
+                if not math.isfinite(loss):
+                    reasons.append(f"non-finite loss {loss}")
+                elif self._ewma_loss is not None and \
+                        seen >= self.warmup_steps and \
+                        abs(loss) > self.factor * max(
+                            abs(self._ewma_loss), 1e-30):
+                    reasons.append(
+                        f"loss {loss:.4g} is {self.factor:g}x over the "
+                        f"EWMA {self._ewma_loss:.4g}")
+                else:
+                    self._ewma_loss = self._ewma(self._ewma_loss, loss)
+                    self._g_loss.set(self._ewma_loss)
+            if grad_absmax is not None:
+                if not math.isfinite(grad_absmax):
+                    reasons.append("non-finite gradient absmax")
+                elif self._ewma_absmax is not None and \
+                        seen >= self.warmup_steps and \
+                        grad_absmax > self.factor * max(
+                            self._ewma_absmax, 1e-30):
+                    reasons.append(
+                        f"grad absmax {grad_absmax:.4g} is "
+                        f"{self.factor:g}x over the EWMA "
+                        f"{self._ewma_absmax:.4g}")
+                else:
+                    self._ewma_absmax = self._ewma(self._ewma_absmax,
+                                                   grad_absmax)
+                    self._g_absmax.set(self._ewma_absmax)
+            if not reasons:
+                self._last_good_step = step
+                return None
+            window = (self._last_good_step, step)
+            record = {"step": step, "reasons": reasons,
+                      "replay_window": window, "probe": self.name}
+            self.last_anomaly = record
+            global _LAST_ANOMALY
+            _LAST_ANOMALY = record
+            self._m_anomalies.inc()
+            obj = (f"{self.name}:step:{step}" if self.name
+                   else f"step:{step}")
+            self._pending.append(Finding(
+                "mxguard", "integrity-anomaly", obj, "error",
+                "; ".join(reasons) + " — replay window "
+                f"[{window[0]}, {window[1]}] "
+                "(tools/mxresil.py replay bisects the first corrupted "
+                "step; docs/resilience.md integrity runbook)"))
+        return record
+
+    def check(self) -> List[Finding]:
+        """Drain pending findings (the Watchdog probe contract)."""
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out
+
+
+_DEFAULT: Optional[GuardProbe] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_probe() -> GuardProbe:
+    """The process-wide probe the fingerprint taps feed."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = GuardProbe()
+    return _DEFAULT
+
+
+def check_default() -> List[Finding]:
+    """Zero-arg probe for ``Watchdog.add_probe`` — drains the default
+    probe's pending anomaly findings."""
+    if _DEFAULT is None:
+        return []
+    return _DEFAULT.check()
+
+
+def check_all() -> List[Finding]:
+    """Drain EVERY live probe (each step function owns one) — the
+    one-line Watchdog registration: ``wd.add_probe(anomaly.check_all)``
+    covers all guarded step functions in the process."""
+    out: List[Finding] = []
+    for probe in list(_PROBES):
+        out.extend(probe.check())
+    return out
+
+
+def last_anomaly() -> Optional[Dict[str, object]]:
+    """The newest anomaly record across every probe in the process
+    (tools/diagnose.py)."""
+    return _LAST_ANOMALY
+
+
+def reset_default() -> None:
+    """Drop the default probe and the cross-probe anomaly record
+    (tests / between drills)."""
+    global _DEFAULT, _LAST_ANOMALY
+    with _DEFAULT_LOCK:
+        _DEFAULT = None
+        _LAST_ANOMALY = None

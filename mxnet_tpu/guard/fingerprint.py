@@ -1,0 +1,274 @@
+"""Gradient/weight fingerprints: the unit of silent-corruption evidence.
+
+One fingerprint row is three float32 scalars over one tensor —
+``(checksum, absmax, nonfinite)``:
+
+- **checksum** — the float32 sum of the elements. Linear, so the
+  checksum of a summed allreduce bucket equals the sum of the
+  contributed checksums, and ANY single-element change (a bit flip, a
+  scale) moves it;
+- **absmax**   — ``max |x|``: the signal cross-replica voting compares
+  (an exponent-bit flip turns a ~1e-2 gradient element into ~1e+36 —
+  orders of magnitude outside the spread legitimate per-worker batches
+  produce);
+- **nonfinite** — the count of NaN/Inf elements (float32-encoded so the
+  whole row ships as one dtype through one allreduce).
+
+:func:`fingerprint_vec` / :func:`fingerprint_rows` are **traceable** —
+they run inside the fused step's jit (the mxguard taps emit them as
+extra program outputs; see ``mxnet_tpu/step/stepfn.py``).
+:func:`host_fingerprint` recomputes a row on the host with numpy —
+used when the sdc drill corrupts a gradient buffer after the in-jit
+tap already ran (the reported fingerprint must describe the bytes the
+worker actually contributes). Host and in-jit checksums may differ in
+summation order, so rows are only ever compared like-with-like
+(host-vs-host on re-execution, jit-vs-jit in replay).
+
+:func:`vote` is the deterministic cross-replica verdict every worker
+computes from the same exchanged fingerprint table — see
+``mxnet_tpu/guard/voting.py`` for the protocol around it.
+
+:func:`replica_digests` / :func:`check_replica_digests` are the
+sharded-path complement: per-device crc32 digests over the addressable
+shards of a (replicated) array — on a GSPMD mesh the weight-update
+computation is replicated or sharded per the plan, and any two devices
+holding the SAME shard index must hold bitwise-identical bytes; a
+deviating device is named directly.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+__all__ = ["FP_FIELDS", "PARAMS_ROW", "fingerprint_vec",
+           "fingerprint_rows", "fold_rows", "host_fingerprint",
+           "GuardVerdict", "vote", "replica_digests",
+           "check_replica_digests"]
+
+FP_FIELDS = ("checksum", "absmax", "nonfinite")
+
+#: index of the replicated params-digest row in a tap matrix — row 0 is
+#: the fold over the pre-step trainable weights (bitwise-identical
+#: across data-parallel replicas by construction), rows 1.. are the
+#: per-gradient fingerprints in sorted trainable order.
+PARAMS_ROW = 0
+
+
+# ---------------------------------------------------------------------------
+# traceable (in-jit) fingerprints
+# ---------------------------------------------------------------------------
+
+def fingerprint_vec(x):
+    """One (3,) float32 fingerprint of ``x`` — traceable."""
+    import jax.numpy as jnp
+    f = jnp.asarray(x).astype(jnp.float32).reshape(-1)
+    return jnp.stack([
+        jnp.sum(f),
+        jnp.max(jnp.abs(f)),
+        jnp.sum(~jnp.isfinite(f)).astype(jnp.float32)])
+
+
+def fingerprint_rows(values) -> "jnp.ndarray":
+    """Stack one fingerprint row per value — traceable; (n, 3)."""
+    import jax.numpy as jnp
+    return jnp.stack([fingerprint_vec(v) for v in values])
+
+
+def fold_rows(rows):
+    """Fold (n, 3) rows into one summary row — traceable. The fold is
+    linear in the checksums (sum), max over absmax, sum over nonfinite
+    counts, so a fold of per-parameter rows is itself a valid
+    fingerprint of the concatenation."""
+    import jax.numpy as jnp
+    return jnp.stack([jnp.sum(rows[:, 0]), jnp.max(rows[:, 1]),
+                      jnp.sum(rows[:, 2])])
+
+
+# ---------------------------------------------------------------------------
+# host-side recompute (the drill-corruption path)
+# ---------------------------------------------------------------------------
+
+def host_fingerprint(arr) -> onp.ndarray:
+    """Numpy recompute of one fingerprint row (float32)."""
+    f = onp.asarray(arr).astype(onp.float32).reshape(-1)
+    return onp.array([
+        onp.float32(f.sum(dtype=onp.float32)),
+        onp.float32(onp.abs(f).max()) if f.size else onp.float32(0),
+        onp.float32(float((~onp.isfinite(f)).sum()))],
+        dtype=onp.float32)
+
+
+# ---------------------------------------------------------------------------
+# the cross-replica vote
+# ---------------------------------------------------------------------------
+
+class GuardVerdict:
+    """The deterministic outcome of one fingerprint vote.
+
+    ``suspects`` maps worker id -> list of reasons; ``global_anomaly``
+    is True when EVERY worker tripped the same class of check — that is
+    training divergence (all replicas agree the gradients are bad), not
+    silent corruption, and is left to TrainGuard's non-finite handling.
+    """
+
+    __slots__ = ("suspects", "global_anomaly", "world")
+
+    def __init__(self, suspects: Dict[str, List[str]],
+                 global_anomaly: bool, world: int):
+        self.suspects = suspects
+        self.global_anomaly = global_anomaly
+        self.world = world
+
+    @property
+    def clean(self) -> bool:
+        return not self.suspects and not self.global_anomaly
+
+    def describe(self) -> Dict[str, object]:
+        return {"suspects": {w: list(r)
+                             for w, r in sorted(self.suspects.items())},
+                "global_anomaly": self.global_anomaly,
+                "world": self.world}
+
+    def __repr__(self):
+        return f"<GuardVerdict {self.describe()}>"
+
+
+def vote(table: onp.ndarray, workers: Sequence[str],
+         tol: Optional[float] = None) -> GuardVerdict:
+    """Judge one exchanged fingerprint table.
+
+    ``table`` is (world, n_rows, 3) — worker w's tap matrix in row
+    ``workers.index(w)``; ``workers`` is the generation's sorted member
+    tuple, identical on every caller, so every worker derives the SAME
+    verdict from the same table (no second agreement round needed).
+
+    Checks, per worker:
+
+    - ``nonfinite``       any non-finite element in its gradients;
+    - ``params-divergence`` its replicated params-digest row differs
+      from the strict-majority value (the weight-update computation is
+      replicated across data-parallel workers — byte-equal by
+      construction, so ANY disagreement attributes exactly);
+    - ``absmax-outlier:<row>`` a gradient row's absmax exceeds ``tol``
+      x the median of the OTHER workers' absmax for that row (batches
+      differ per worker, so legitimate spread is small; an exponent
+      bit flip is ~1e30x).
+
+    A reason shared by EVERY worker is a global anomaly (divergence),
+    not an attribution."""
+    if tol is None:
+        from .. import config
+        tol = float(config.get("MXGUARD_VOTE_TOL"))
+    table = onp.asarray(table, dtype=onp.float32)
+    world = len(workers)
+    if table.shape[0] != world:
+        raise ValueError(f"fingerprint table has {table.shape[0]} rows "
+                         f"for {world} workers")
+    n_rows = table.shape[1]
+    suspects: Dict[str, List[str]] = {}
+
+    def mark(w_idx, reason):
+        suspects.setdefault(workers[w_idx], []).append(reason)
+
+    # non-finite gradients (rows after the params digest)
+    for w in range(world):
+        if table[w, PARAMS_ROW + 1:, 2].sum() > 0:
+            mark(w, "nonfinite")
+
+    # replicated params digest: strict-majority byte vote
+    if world >= 2:
+        keys = [table[w, PARAMS_ROW].tobytes() for w in range(world)]
+        counts: Dict[bytes, int] = {}
+        for k in keys:
+            counts[k] = counts.get(k, 0) + 1
+        majority = max(counts.items(), key=lambda kv: kv[1])
+        if majority[1] * 2 > world:
+            for w in range(world):
+                if keys[w] != majority[0]:
+                    mark(w, "params-divergence")
+
+    # absmax outliers vs the other workers' median, per gradient row.
+    # Only FINITE peers form the reference: a non-finite peer is
+    # already attributed by the nonfinite check, and letting its
+    # absmax poison the median (as 0 or as inf) would mark the
+    # HEALTHY workers too — in a 2-worker group that used to collapse
+    # a genuine NaN on one worker into "global divergence" and wave
+    # the corruption straight into the allreduce
+    if world >= 2:
+        for r in range(PARAMS_ROW + 1, n_rows):
+            col = table[:, r, 1]
+            for w in range(world):
+                mine = float(col[w])
+                if not onp.isfinite(mine):
+                    continue  # the nonfinite check owns this worker
+                others = onp.delete(col, w)
+                finite_others = others[onp.isfinite(others)]
+                if finite_others.size == 0:
+                    continue  # no healthy reference to compare against
+                ref = float(onp.median(finite_others))
+                if mine > tol * max(ref, 1e-30):
+                    reason = f"absmax-outlier:{r}"
+                    if reason not in suspects.get(workers[w], ()):
+                        mark(w, reason)
+
+    # every worker tripping the same class = divergence, not SDC
+    # (meaningless solo: a world-1 "vote" is the self-check's job)
+    global_anomaly = False
+    if len(suspects) == world and world >= 2:
+        classes = [frozenset(r.split(":")[0] for r in reasons)
+                   for reasons in suspects.values()]
+        if frozenset.intersection(*classes):
+            suspects = {}
+            global_anomaly = True
+    return GuardVerdict(suspects, global_anomaly, world)
+
+
+# ---------------------------------------------------------------------------
+# sharded path: per-device shard digests
+# ---------------------------------------------------------------------------
+
+def replica_digests(arr) -> List[Dict[str, object]]:
+    """One crc32 digest per addressable shard of a jax array:
+    ``[{"device": id, "index": str, "crc32": int}, ...]``."""
+    out = []
+    for shard in getattr(arr, "addressable_shards", []):
+        data = onp.ascontiguousarray(onp.asarray(shard.data))
+        out.append({"device": getattr(shard.device, "id", -1),
+                    "index": repr(shard.index),
+                    "crc32": zlib.crc32(data.tobytes()) & 0xFFFFFFFF})
+    return out
+
+
+def check_replica_digests(named_arrays) -> List[Dict[str, object]]:
+    """Cross-device integrity check over (name, array) pairs: devices
+    holding the SAME shard index of the same array must hold
+    bitwise-identical bytes. Returns one mismatch record per deviating
+    device (majority digest wins attribution); empty = consistent.
+
+    Accepts jax arrays or duck-typed shard lists (``replica_digests``
+    output) so the logic is testable without a multi-device mesh."""
+    mismatches = []
+    for name, arr in named_arrays:
+        digests = arr if isinstance(arr, list) else replica_digests(arr)
+        by_index: Dict[str, List[Tuple[int, int]]] = {}
+        for d in digests:
+            by_index.setdefault(d["index"], []).append(
+                (d["device"], d["crc32"]))
+        for index, pairs in by_index.items():
+            if len(pairs) < 2:
+                continue
+            counts: Dict[int, int] = {}
+            for _, crc in pairs:
+                counts[crc] = counts.get(crc, 0) + 1
+            majority_crc = max(counts.items(),
+                               key=lambda kv: (kv[1], -kv[0]))[0]
+            for device, crc in pairs:
+                if crc != majority_crc:
+                    mismatches.append({
+                        "name": name, "index": index,
+                        "device": device, "crc32": crc,
+                        "majority_crc32": majority_crc,
+                        "replicas": len(pairs)})
+    return mismatches

@@ -1,0 +1,427 @@
+"""Deterministic replay: re-execute a recorded training window bitwise
+and bisect the first corrupted step.
+
+Detection (taps, voting, the EWMA anomaly probe) tells you *that* a
+run went bad and roughly *when*; replay tells you exactly **which
+step** first diverged — the difference between "restart and hope" and
+a hardware ticket with a step number on it.
+
+Two rings, both bounded:
+
+- the **record ring** (:class:`ReplayRecorder`): one small record per
+  guarded step — batch crc32 digests, the raw RNG key the step
+  consumed, the host-computed hyper scalars, the loss digest, and the
+  fingerprint tap matrix. Persisted as JSON lines under the ring
+  directory, compacted in place;
+- the **known-good checkpoint ring**: a
+  :class:`~mxnet_tpu.checkpoint.CheckpointManager` under
+  ``<ring>/ring_ckpts`` fed every ``MXGUARD_CKPT_EVERY`` steps — but
+  ONLY while no guard verdict has flagged the run (a snapshot taken
+  after corruption entered the weights must never become a recovery
+  point; once tainted, the ring freezes).
+
+:func:`replay_window` restores the newest ring checkpoint at or below
+the window, re-executes each recorded step with the **recorded RNG**
+against the **recorded batch digests**, and compares loss bits and
+fingerprint rows exactly — same program, same backend, same inputs ⇒
+bitwise equality, so the first mismatching step IS the first corrupted
+step. An un-flagged (``sdc:scale``-silent) corruption is found here
+even though every live check passed.
+
+:func:`run_replay_drill` / :func:`replay_ring` are the seeded
+end-to-end drill behind ``tools/mxresil.py replay`` and the tier-1
+test: train a small regression net (single elastic worker, so
+gradients cross the host where the ``sdc`` action can corrupt them)
+with the ring enabled, then rebuild the identical stack WITHOUT the
+fault plan and prove the replay pinpoints the corrupted step — or,
+with no fault, reproduces the window bitwise.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError, get_logger
+
+__all__ = ["ReplayRecorder", "load_ring", "replay_window",
+           "run_replay_drill", "replay_ring"]
+
+_log = get_logger("mxnet_tpu.guard")
+
+_RING_FILE = "ring.jsonl"
+_RING_CKPTS = "ring_ckpts"
+
+
+def _crc(arr) -> int:
+    a = onp.ascontiguousarray(onp.asarray(arr))
+    return zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+
+
+class ReplayRecorder:
+    """Bounded per-step record ring + known-good checkpoint ring.
+
+    Attach to a fused step via ``StepFunction.attach_recorder`` — the
+    step calls :meth:`record` at every guarded boundary. Thread-safe
+    (one recorder may serve several in-process drill workers, though
+    each worker normally owns its own)."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 capacity: Optional[int] = None,
+                 ckpt_every: Optional[int] = None, ring_keep: int = 4):
+        from .. import config
+        if capacity is None:
+            capacity = int(config.get("MXGUARD_RING"))
+        if ckpt_every is None:
+            ckpt_every = int(config.get("MXGUARD_CKPT_EVERY"))
+        self.capacity = max(1, int(capacity))
+        self.ckpt_every = max(0, int(ckpt_every))
+        self.directory = directory
+        self.records: deque = deque(maxlen=self.capacity)
+        self.tainted_at: Optional[int] = None
+        self._lock = threading.Lock()
+        self._lines = 0
+        self._ckpts = None
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+            self._path = os.path.join(directory, _RING_FILE)
+            from ..checkpoint import CheckpointManager
+            self._ckpts = CheckpointManager(
+                os.path.join(directory, _RING_CKPTS),
+                max_to_keep=ring_keep, async_save=False)
+        else:
+            self._path = None
+        from ..telemetry import metrics as _metrics
+        self._m_records = _metrics.counter(
+            "mxguard_replay_records_total",
+            "steps recorded into the deterministic-replay ring")
+        self._m_ring_ckpts = _metrics.counter(
+            "mxguard_ring_checkpoints_total",
+            "known-good checkpoints committed to the guard ring")
+
+    @property
+    def has_checkpoint_ring(self) -> bool:
+        return self._ckpts is not None and self.ckpt_every > 0
+
+    def record(self, step: int, inputs, rng_raw, loss_raw, fps,
+               scalars: Optional[Dict] = None, trainer=None,
+               good: bool = True) -> Dict[str, object]:
+        """Record one completed step. ``good=False`` (a guard verdict
+        or anomaly fired) taints the ring: record-keeping continues —
+        the corrupted window is exactly what replay wants — but the
+        known-good checkpoint ring FREEZES."""
+        fps_host = onp.asarray(fps, dtype=onp.float32)
+        rec = {
+            "step": int(step),
+            "batch_crc": [_crc(v) for v in inputs],
+            "rng": [int(v) for v in
+                    onp.asarray(rng_raw).reshape(-1).tolist()],
+            "scalars": {k: float(v) for k, v in (scalars or {}).items()},
+            "loss_crc": _crc(loss_raw),
+            "loss_mean": float(onp.asarray(loss_raw,
+                                           dtype=onp.float64).mean()),
+            "fps": fps_host.tolist(),
+            "good": bool(good),
+        }
+        with self._lock:
+            self.records.append(rec)
+            if not good and self.tainted_at is None:
+                self.tainted_at = int(step)
+                _log.warning(
+                    "replay ring tainted at step %d: the known-good "
+                    "checkpoint ring is frozen (records continue)",
+                    step)
+            self._write_line(rec)
+        self._m_records.inc()
+        if self.has_checkpoint_ring and trainer is not None and \
+                self.tainted_at is None and \
+                (step + 1) % self.ckpt_every == 0:
+            self._ckpts.save(step + 1, trainer=trainer,
+                             extra={"mxguard_ring": True,
+                                    "record_step": step + 1})
+            self._m_ring_ckpts.inc()
+        return rec
+
+    def _write_line(self, rec):
+        """Append under self._lock; compact when the file outgrows the
+        ring (rewrite from the in-memory deque)."""
+        if self._path is None:
+            return
+        try:
+            if self._lines >= 2 * self.capacity:
+                tmp = self._path + ".tmp"
+                with open(tmp, "w") as f:
+                    for r in self.records:
+                        f.write(json.dumps(r) + "\n")
+                os.replace(tmp, self._path)
+                self._lines = len(self.records)
+            else:
+                with open(self._path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+                self._lines += 1
+        except OSError as e:  # the ring must never take down training
+            _log.warning("replay ring write failed: %s", e)
+
+    def ring_steps(self) -> List[int]:
+        """Steps with a known-good ring checkpoint."""
+        return self._ckpts.all_steps() if self._ckpts else []
+
+    def describe(self) -> Dict[str, object]:
+        with self._lock:
+            steps = [r["step"] for r in self.records]
+        return {"directory": self.directory,
+                "capacity": self.capacity,
+                "records": len(steps),
+                "window": [min(steps), max(steps)] if steps else None,
+                "ckpt_every": self.ckpt_every,
+                "ring_checkpoints": self.ring_steps(),
+                "tainted_at": self.tainted_at}
+
+
+def load_ring(directory: str) -> Dict[int, Dict]:
+    """Read the ring file back: {step: record} (newest line wins)."""
+    path = os.path.join(directory, _RING_FILE)
+    out: Dict[int, Dict] = {}
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue  # torn tail line
+            out[int(rec["step"])] = rec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+def _fp_equal(a, b) -> bool:
+    a = onp.asarray(a, dtype=onp.float32)
+    b = onp.asarray(b, dtype=onp.float32)
+    if a.shape != b.shape:
+        return False
+    return bool(onp.array_equal(a, b, equal_nan=True))
+
+
+def replay_window(fused, trainer, records: Dict[int, Dict],
+                  data_fn: Callable[[int], tuple],
+                  lo: Optional[int] = None, hi: Optional[int] = None,
+                  manager=None) -> Dict[str, object]:
+    """Re-execute recorded steps ``[lo, hi]`` bitwise and report the
+    first corrupted step.
+
+    ``fused`` must run with the fingerprint taps on (MXGUARD); replay
+    drives it with each record's RNG via ``step(..., rng_raw=)``.
+    ``data_fn(step) -> inputs tuple`` must be the run's deterministic
+    data source — every batch is verified against the recorded crc32
+    before it is trusted (a nondeterministic pipeline invalidates
+    replay and is reported as such, not as corruption). ``manager``
+    (the ring's CheckpointManager) supplies the newest known-good
+    restore point at or below ``lo``; without one the replay starts
+    from the freshly-built step-0 state."""
+    if not records:
+        raise MXNetError("replay: the record ring is empty")
+    steps = sorted(records)
+    lo = steps[0] if lo is None else int(lo)
+    hi = steps[-1] if hi is None else int(hi)
+    start = 0
+    if manager is not None:
+        usable = [s for s in manager.all_steps() if s <= lo]
+        if usable:
+            start = max(usable)
+            manager.restore(start, trainer=trainer)
+    first_bad = None
+    bad_digest = []
+    compared = 0
+    for step in range(start, hi + 1):
+        rec = records.get(step)
+        if rec is None:
+            return {"error": f"record ring has no step {step} "
+                             f"(window [{start}, {hi}]) — raise "
+                             "MXGUARD_RING or replay a newer window",
+                    "bitwise_ok": False,
+                    "first_corrupted_step": None}
+        inputs = data_fn(step)
+        if [_crc(v) for v in inputs] != list(rec["batch_crc"]):
+            bad_digest.append(step)
+        rng = onp.asarray(rec["rng"], dtype=onp.uint32)
+        loss = fused.step(*inputs, rng_raw=rng)
+        loss_crc = _crc(loss.asnumpy())
+        fps = onp.asarray(fused.last_fingerprints, dtype=onp.float32)
+        same = loss_crc == rec["loss_crc"] and \
+            _fp_equal(fps, rec["fps"])
+        if step >= lo:
+            compared += 1
+            if not same:
+                first_bad = step
+                break  # everything after the first divergence differs
+    return {"bitwise_ok": first_bad is None and not bad_digest,
+            "first_corrupted_step": first_bad,
+            "replayed_from": start,
+            "steps_compared": compared,
+            "window": [lo, hi],
+            "data_digest_mismatches": bad_digest}
+
+
+# ---------------------------------------------------------------------------
+# the seeded end-to-end drill (tools/mxresil.py replay, tier-1 test)
+# ---------------------------------------------------------------------------
+
+def _drill_data(seed: int, in_dim: int, out_dim: int, batch: int):
+    """The fixed regression task (same family as the elastic drill):
+    deterministic per-step batches of y = tanh(x W)."""
+    rng = onp.random.RandomState(seed)
+    w = rng.uniform(-1, 1, size=(in_dim, out_dim)).astype("float32")
+
+    def batch_fn(step: int):
+        from ..ndarray.ndarray import array as nd_array
+        r = onp.random.RandomState((seed * 1000003 + step) % (2 ** 31))
+        x = r.uniform(-1, 1, size=(batch, in_dim)).astype("float32")
+        y = onp.tanh(x @ w).astype("float32")
+        return nd_array(x), nd_array(y)
+
+    return batch_fn
+
+
+def _build_stack(seed: int, in_dim: int, hidden: int, out_dim: int,
+                 lr: float):
+    """One single-worker elastic training stack with a FIXED gluon
+    prefix, so a rebuild in the same process yields identical
+    parameter names (ring checkpoints restore by name) and identical
+    seeded initial weights."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from ..elastic.coordinator import ElasticCoordinator
+    from ..elastic.kvstore import ElasticKVStore
+
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = gluon.nn.HybridSequential(prefix="mxguard_drill_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(hidden, activation="relu",
+                               flatten=False, in_units=in_dim))
+        net.add(gluon.nn.Dense(out_dim, flatten=False,
+                               in_units=hidden))
+    net.initialize()
+    co = ElasticCoordinator()
+    kv = ElasticKVStore(group=co, worker_id="w0")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr}, kvstore=kv,
+                            update_on_kvstore=False)
+    fused = trainer.fuse_step(net, gluon.loss.L2Loss())
+    return net, trainer, fused, kv
+
+
+def run_replay_drill(ring_dir: str, steps: int = 24,
+                     corrupt_step: Optional[int] = None,
+                     mode: str = "scale", seed: int = 0,
+                     batch: int = 8, in_dim: int = 16, hidden: int = 16,
+                     out_dim: int = 4, lr: float = 0.05,
+                     ckpt_every: int = 8) -> Dict[str, object]:
+    """Train the drill net with the replay ring enabled; when
+    ``corrupt_step`` is set, the ``sdc:<mode>`` action corrupts one
+    gradient element from that step onward (``scale`` stays below
+    every live check — the silent-divergence scenario replay exists
+    for). Returns the run report; the ring lands in ``ring_dir``."""
+    from .. import config
+    from ..resil import faultplan
+
+    saved_plan = config.get("MXRESIL_FAULT_PLAN")
+    config.set_flag("MXGUARD", True)
+    if corrupt_step is not None:
+        config.set_flag("MXRESIL_FAULT_PLAN",
+                        f"guard.sdc:{corrupt_step}+=sdc:{mode}")
+    else:
+        config.set_flag("MXRESIL_FAULT_PLAN", "")
+    faultplan.reset()
+
+    def _restore_flags():
+        # put the caller's plan back (a programmatically-set override
+        # must survive the drill; an env-only plan re-resolves after
+        # the unset)
+        if saved_plan:
+            config.set_flag("MXRESIL_FAULT_PLAN", saved_plan)
+        else:
+            config.unset_flag("MXRESIL_FAULT_PLAN")
+        config.unset_flag("MXGUARD")
+        faultplan.reset()
+    try:
+        net, trainer, fused, kv = _build_stack(seed, in_dim, hidden,
+                                               out_dim, lr)
+        try:
+            rec = ReplayRecorder(ring_dir, capacity=max(steps, 8),
+                                 ckpt_every=ckpt_every)
+            fused.attach_recorder(rec)
+            data = _drill_data(seed, in_dim, out_dim, batch)
+            losses = []
+            for step in range(steps):
+                x, y = data(step)
+                loss = fused.step(x, y)
+                losses.append(float(loss.asnumpy().mean()))
+        finally:
+            kv.close()  # leave the group even on a mid-drill error
+        return {"steps": steps, "corrupt_step": corrupt_step,
+                "mode": mode if corrupt_step is not None else None,
+                "final_loss": losses[-1], "losses": losses,
+                "ring": rec.describe()}
+    finally:
+        _restore_flags()
+
+
+def replay_ring(ring_dir: str, seed: int = 0, lo: Optional[int] = None,
+                hi: Optional[int] = None, batch: int = 8,
+                in_dim: int = 16, hidden: int = 16, out_dim: int = 4,
+                lr: float = 0.05) -> Dict[str, object]:
+    """Rebuild the drill stack WITHOUT the fault plan, restore the
+    newest known-good ring checkpoint, and replay the recorded window
+    bitwise (see :func:`replay_window`). Model/seed knobs must match
+    the recording run."""
+    from .. import config
+    from ..checkpoint import CheckpointManager
+    from ..resil import faultplan
+
+    saved_plan = config.get("MXRESIL_FAULT_PLAN")
+    config.set_flag("MXGUARD", True)
+    config.set_flag("MXRESIL_FAULT_PLAN", "")
+    faultplan.reset()
+
+    def _restore_flags():
+        if saved_plan:
+            config.set_flag("MXRESIL_FAULT_PLAN", saved_plan)
+        else:
+            config.unset_flag("MXRESIL_FAULT_PLAN")
+        config.unset_flag("MXGUARD")
+        faultplan.reset()
+
+    try:
+        # read the ring FIRST: a missing/empty ring fails fast with a
+        # typed error instead of building (and leaking) a stack
+        if not os.path.exists(os.path.join(ring_dir, _RING_FILE)):
+            raise MXNetError(
+                f"no replay ring at {ring_dir!r} (expected "
+                f"{_RING_FILE}) — record a window first "
+                "(guard.ReplayRecorder / tools/mxresil.py replay)")
+        records = load_ring(ring_dir)
+        net, trainer, fused, kv = _build_stack(seed, in_dim, hidden,
+                                               out_dim, lr)
+        try:
+            ckpt_dir = os.path.join(ring_dir, _RING_CKPTS)
+            manager = CheckpointManager(ckpt_dir, async_save=False) \
+                if os.path.isdir(ckpt_dir) else None
+            data = _drill_data(seed, in_dim, out_dim, batch)
+            report = replay_window(fused, trainer, records, data,
+                                   lo=lo, hi=hi, manager=manager)
+        finally:
+            kv.close()
+        return report
+    finally:
+        _restore_flags()

@@ -1,0 +1,182 @@
+"""Cross-replica fingerprint voting: catch a corrupt replica BEFORE its
+gradients enter the allreduce.
+
+The elastic bucketed exchange sums every worker's local gradients —
+one flipped bit on one flaky core poisons every replica at once, and
+nothing downstream can tell who did it. mxguard inserts one extra
+generation-fenced round *ahead* of the buckets:
+
+1. **round A** — every worker contributes its tap matrix (params
+   digest + per-gradient fingerprints) into one ``(world, n, 3)``
+   table (each worker fills its own rank row; the coordinator's sum is
+   the gather). Every worker computes the SAME
+   :func:`~mxnet_tpu.guard.fingerprint.vote` verdict from the same
+   table — no second agreement round.
+2. **round B** (only when round A named suspects) — each suspect
+   *re-executes* its gradient program on the same inputs/weights/RNG
+   (the grad program is deterministic and NOT donated, so this is
+   safe) and contributes the recomputed fingerprints; everyone else
+   re-contributes theirs.
+
+   - recomputed == original  → the fault reproduces: **persistent**.
+     The suspect quarantines itself — ``session.leave()`` (the
+     membership bump survivors fence on) + :class:`GuardQuarantined`;
+     peers' next bucket round fences with ``MembershipChanged`` and
+     the normal rebuild path takes over.
+   - recomputed != original and the new vote is clean → **transient**
+     (a one-shot flip): the suspect adopts its recomputed gradients
+     and the step proceeds — the corrupt contribution never existed
+     as far as the allreduce is concerned.
+
+Solo runs (world 1, or the plain fused step) have no peers to vote
+with: the self-check fires on non-finite gradient fingerprints,
+re-executes to classify, and **hard-fails** with
+:class:`GuardCorruption` when the fault is persistent.
+
+The ``guard.sdc`` / ``guard.sdc.<worker_id>`` fault-injection sites
+(:func:`apply_sdc`) are the deterministic drill trigger: the ``sdc``
+action corrupts exactly one gradient element on the selected worker,
+and the corrupted row is recomputed host-side so the reported
+fingerprint describes the bytes actually contributed.
+"""
+from __future__ import annotations
+
+import random
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError, get_logger
+from .fingerprint import host_fingerprint
+
+__all__ = ["GuardQuarantined", "GuardCorruption", "apply_sdc",
+           "sdc_token", "contribution", "table_of"]
+
+_log = get_logger("mxnet_tpu.guard")
+
+
+class GuardQuarantined(MXNetError):
+    """This worker's gradients are PERSISTENTLY corrupt (the
+    fingerprint vote named it twice, across a deterministic
+    re-execution). It has already left the membership group — the
+    caller should stop driving this replica and hand the host back to
+    the cluster manager for hardware triage."""
+
+    def __init__(self, worker_id: str, step: int, reasons):
+        super().__init__(
+            f"mxguard quarantined worker {worker_id!r} at step {step}: "
+            f"fingerprint vote verdict {sorted(set(reasons))} "
+            "reproduced under deterministic re-execution (persistent "
+            "fault) — the worker left the group; survivors rebuild "
+            "and continue (docs/resilience.md, integrity section)")
+        self.worker_id = worker_id
+        self.step = step
+        self.reasons = list(reasons)
+
+
+class GuardCorruption(MXNetError):
+    """A solo run (no peers to vote with / quarantine into) computed
+    persistently corrupt gradients. Hard-fail: restarting on the same
+    core will reproduce it; replay the recorded window to pinpoint the
+    first corrupted step (``tools/mxresil.py replay``)."""
+
+    def __init__(self, step: int, reasons):
+        super().__init__(
+            f"mxguard: non-finite/anomalous gradient fingerprints at "
+            f"step {step} ({sorted(set(reasons))}) reproduced under "
+            "deterministic re-execution — persistent corruption on a "
+            "solo run; hard-failing. Bisect with "
+            "`tools/mxresil.py replay` (docs/resilience.md)")
+        self.step = step
+        self.reasons = list(reasons)
+
+
+# ---------------------------------------------------------------------------
+# the sdc drill corruption
+# ---------------------------------------------------------------------------
+
+def sdc_token(worker_id, step: int, world: int) -> Optional[str]:
+    """Evaluate the mxguard injection sites for this worker/step.
+    ``guard.sdc.<worker_id>`` targets one worker of an in-process
+    drill — the STABLE worker identity, never the rank, which shifts
+    when membership changes; the bare ``guard.sdc`` site is the
+    solo-run convenience. A no-op (two dict reads) when no fault plan
+    is active."""
+    from ..resil import faultplan
+    if not faultplan.is_active():
+        return None
+    token = faultplan.inject(f"guard.sdc.{worker_id}", step=step)
+    if token is None and world <= 1:
+        token = faultplan.inject("guard.sdc", step=step)
+    return token
+
+
+def apply_sdc(grads: Dict[str, object], order, token: str, step: int,
+              seed: int = 0) -> Tuple[Dict[str, object], str,
+                                      onp.ndarray]:
+    """Corrupt ONE gradient element deterministically (the ``sdc``
+    fault action). The target gradient is seed-chosen; the element is
+    its absmax element. ``bitflip`` flips the high f32 exponent bit
+    when that GROWS the value (|x| < 2) and corrupts the exponent
+    field upward otherwise — guaranteed loud either way (absmax
+    outlier or, on overflow, a nonfinite count). ``scale`` multiplies
+    by ``1 + 2^-10``: exact in float32, far below any vote threshold
+    — the silent-divergence drill for replay. Returns (new grads,
+    corrupted name, the host-recomputed fingerprint row for that
+    gradient)."""
+    import jax.numpy as jnp
+    mode = token.split(":", 1)[1] if ":" in token else "bitflip"
+    rng = random.Random(seed ^ zlib.crc32(b"mxguard.sdc") ^ step)
+    name = tuple(order)[rng.randrange(len(order))]
+    g = onp.asarray(grads[name])
+    flat = g.reshape(-1).copy()
+    idx = int(onp.argmax(onp.abs(flat))) if flat.size else 0
+    if mode == "bitflip" and flat.dtype == onp.float32 and \
+            abs(float(flat[idx])) < 2.0:
+        bits = flat.view(onp.uint32)
+        bits[idx] ^= onp.uint32(1 << 30)
+    elif mode == "bitflip":
+        # |element| >= 2.0 has f32 exponent bit 30 SET — an XOR would
+        # SHRINK it, and a shrunken absmax element hides behind the
+        # runner-up (the one-sided vote can't see it). Corrupt the
+        # exponent FIELD upward instead so the drill trigger stays
+        # guaranteed-loud: huge → absmax outlier, overflow → inf →
+        # nonfinite count; both verdicts
+        flat[idx] = flat[idx] * flat.dtype.type(2.0) ** 100
+    else:  # scale: silent single-element drift
+        flat[idx] = flat[idx] * flat.dtype.type(1.0 + 2.0 ** -10)
+    corrupted = flat.reshape(g.shape)
+    new = dict(grads)
+    new[name] = jnp.asarray(corrupted)
+    from ..telemetry import metrics as _metrics
+    _metrics.counter(
+        "mxguard_sdc_injected_total",
+        "gradient elements corrupted by the sdc fault action").inc()
+    _log.warning("sdc drill: corrupted %s[%d] (%s) at step %d", name,
+                 idx, mode, step)
+    return new, name, host_fingerprint(corrupted)
+
+
+# ---------------------------------------------------------------------------
+# vote-table plumbing
+# ---------------------------------------------------------------------------
+
+def contribution(fps: onp.ndarray, rank: int, world: int) -> onp.ndarray:
+    """This worker's slice of the vote table: zeros except its own
+    rank row — the coordinator's deterministic SUM is then exactly the
+    all-gather of every worker's fingerprints."""
+    fps = onp.asarray(fps, dtype=onp.float32)
+    out = onp.zeros((world,) + fps.shape, dtype=onp.float32)
+    out[rank] = fps
+    return out
+
+
+def table_of(summed, world: int) -> onp.ndarray:
+    """The gathered (world, n, 3) table from the summed exchange."""
+    t = onp.asarray(summed, dtype=onp.float32)
+    if t.shape[0] != world:
+        raise MXNetError(
+            f"mxguard vote table arrived with {t.shape[0]} rank rows "
+            f"for world {world} — workers out of lockstep")
+    return t

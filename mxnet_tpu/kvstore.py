@@ -67,6 +67,14 @@ class KVStoreBase:
     # that overrides the exchange WITHOUT re-declaring this is the
     # silent-wedge class the elastic subsystem exists to kill.
     elastic_abort = "local"
+    # guardlint contract (passes/guardlint.py): where — if anywhere —
+    # mxguard fingerprint taps observe the gradients this store
+    # exchanges. "pre-exchange" = fingerprints are computed and voted
+    # on BEFORE the store sums them (the elastic path); "local" = the
+    # single-process identity reduce (the fused step's in-jit taps
+    # cover it); None = a multi-worker exchange with NO tap wired — a
+    # silently-corruptible data plane the lint flags.
+    guard_tap = "local"
 
     def __init__(self):
         self._updater = None
@@ -254,6 +262,11 @@ class KVStoreDist(KVStoreBase):
     # a live membership bump — bounded, but coarse; prefer 'elastic'
     # for jobs that must adapt instead of fail (docs/resilience.md)
     elastic_abort = "timeout"
+    # no mxguard fingerprint tap on the dist collective path: the
+    # exchange lowers into jax collectives with no host-visible
+    # pre-averaging point — guardlint keeps this gap visible; prefer
+    # the 'elastic' store when integrity voting matters
+    guard_tap = None
 
     def __init__(self, type_name="dist_sync"):
         from .parallel import initialize_distributed

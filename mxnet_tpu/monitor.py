@@ -4,6 +4,26 @@ ref: python/mxnet/monitor.py + the executor monitor callback
 (src/executor/graph_executor.cc:185,1343-1372). The TPU executor calls
 `tic/toc_print` around forward/backward; stats are computed eagerly on
 outputs the executor exposes.
+
+The fused-step path is covered too: ``install()`` accepts a
+:class:`~mxnet_tpu.step.StepFunction` (it duck-types the executor's
+monitor surface). Training that never touches the eager executor — one
+donated XLA program per step — has no materialized per-op activations
+to observe, so what the monitor collects there are the mxguard
+**fingerprint taps** (one ``(checksum, absmax, nonfinite)`` triple per
+gradient plus the params digest, emitted as extra outputs of the same
+compiled program) and the loss. A ``tic`` forces the taps on for that
+step (the tapped program compiles once and is cached; taps-on steps
+stay bitwise-identical in weights — see docs/resilience.md, integrity
+section)::
+
+    fused = trainer.fuse_step(net, loss_fn)
+    mon = Monitor(interval=100)
+    mon.install(fused)
+    for x, y in batches:
+        mon.tic()
+        fused.step(x, y)
+        mon.toc_print()
 """
 from __future__ import annotations
 

@@ -205,7 +205,8 @@ def findings_report(tool: str, findings: Iterable[Finding],
 # cheap (passes hold no state until run)
 def default_manager() -> PassManager:
     from . import (oplint, graphlint, tracercheck, dispatchlint,
-                   steplint, shardlint, servelint, elasticlint)
+                   steplint, shardlint, servelint, elasticlint,
+                   guardlint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
@@ -215,4 +216,5 @@ def default_manager() -> PassManager:
     pm.register(shardlint.ShardLint())
     pm.register(servelint.ServeLint())
     pm.register(elasticlint.ElasticAbortAudit())
+    pm.register(guardlint.GuardLint())
     return pm

@@ -1,0 +1,136 @@
+"""guardlint: flag gradient exchanges with no integrity tap, and guard
+configs with detection but no recovery.
+
+Two gap classes the mxguard layer (mxnet_tpu/guard/,
+docs/resilience.md integrity section) makes checkable:
+
+1. **untapped exchanges** — a kvstore that ships gradients between
+   workers with no fingerprint tap wired is a silently-corruptible
+   data plane: one flipped bit on one worker rides the sum into every
+   replica. The contract is the ``guard_tap`` class attribute
+   (kvstore.KVStoreBase):
+
+   - ``"local"``        single-process identity reduce — the fused
+                        step's in-jit taps cover it;
+   - ``"pre-exchange"`` fingerprints are computed and cross-replica
+                        voted BEFORE the store sums them (the elastic
+                        store + ElasticStepFunction pairing);
+   - ``None``           a multi-worker exchange with no tap. On a
+                        generation-fenced (elastic) store that is an
+                        **error** — the voting machinery exists there
+                        and not wiring it is a plain gap; on a
+                        timeout-abort store it stays an **info**
+                        audit line (the collective lowering has no
+                        host-visible pre-averaging point).
+
+2. **detection without recovery** — a step function running with taps
+   on but NO replay recorder / known-good checkpoint ring can tell
+   you a run was corrupted and nothing else: no bitwise window to
+   bisect, no clean state to roll to. ``StepFunction.guard_state()``
+   dicts (live targets) are audited for exactly that pairing.
+
+Registered in the default manager; ``tools/mxlint.py --guard`` runs
+the live self-check (a guarded fused step + ring, plus bad fixtures
+that must fire every check).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding, Pass
+
+__all__ = ["GuardLint", "TAP_MODES"]
+
+TAP_MODES = ("local", "pre-exchange")
+
+
+class GuardLint(Pass):
+    """Audit kvstore classes (default scope: every ``KVStoreBase``
+    subclass in scope, like elasticlint) and/or live guard-state dicts
+    from ``StepFunction.guard_state()``. ``run(target)`` accepts a
+    mixed list of classes and dicts for fixture tests."""
+
+    name = "guardlint"
+
+    def _default_targets(self):
+        from ..kvstore import KVStoreBase
+
+        def walk(cls):
+            yield cls
+            for sub in cls.__subclasses__():
+                yield from walk(sub)
+
+        from ..elastic import kvstore as _ekv  # noqa: F401 — lazy reg
+        seen, out = set(), []
+        for cls in walk(KVStoreBase):
+            if cls not in seen:
+                seen.add(cls)
+                out.append(cls)
+        return out
+
+    def run(self, target=None) -> List[Finding]:
+        targets = target if target is not None \
+            else self._default_targets()
+        findings: List[Finding] = []
+        for t in targets:
+            if isinstance(t, dict):
+                findings.extend(self._check_state(t))
+            elif isinstance(t, type):
+                findings.extend(self._check_kvstore(t))
+            else:  # a live step function
+                state_fn = getattr(t, "guard_state", None)
+                if state_fn is not None:
+                    findings.extend(self._check_state(state_fn()))
+        return findings
+
+    def _check_kvstore(self, klass) -> List[Finding]:
+        from ..kvstore import KVStoreBase
+        if klass is KVStoreBase or not getattr(
+                klass, "supports_flat_allreduce", False):
+            return []
+        mode = getattr(klass, "elastic_abort", None)
+        tap = getattr(klass, "guard_tap", None)
+        if mode == "generation" and tap != "pre-exchange":
+            return [self.finding(
+                "no-fingerprint-tap", klass.__name__, "error",
+                f"{klass.__name__} exchanges gradients under the "
+                "elastic generation protocol but wires no "
+                "pre-exchange fingerprint tap (guard_tap="
+                f"{tap!r}) — the voting machinery exists on this "
+                "path; one corrupt replica rides the sum into every "
+                "survivor undetected. Declare guard_tap='pre-exchange'"
+                " and exchange through the fenced fingerprint round "
+                "(docs/resilience.md integrity section).")]
+        if mode == "local" or tap in TAP_MODES:
+            return []
+        return [self.finding(
+            "untapped-exchange", klass.__name__, "info",
+            f"{klass.__name__} ships gradients between workers with "
+            f"no mxguard fingerprint tap (guard_tap={tap!r}) — "
+            "silent corruption on one worker is invisible until the "
+            "loss is ruined; jobs that need integrity voting should "
+            "ride the 'elastic' store")]
+
+    def _check_state(self, state: dict) -> List[Finding]:
+        obj = str(state.get("name") or state.get("kind") or "step")
+        findings: List[Finding] = []
+        taps = bool(state.get("taps"))
+        if taps and not (state.get("recorder")
+                         and state.get("ring_checkpoints")):
+            missing = "replay recorder" if not state.get("recorder") \
+                else "known-good checkpoint ring"
+            findings.append(self.finding(
+                "detection-without-recovery", obj, "error",
+                f"MXGUARD taps are on but no {missing} is attached — "
+                "a corruption verdict leaves no bitwise window to "
+                "bisect and no clean state to roll to. Attach "
+                "guard.ReplayRecorder(<dir>) via "
+                "StepFunction.attach_recorder "
+                "(docs/resilience.md integrity runbook)."))
+        if not taps and state.get("exchanges_gradients"):
+            findings.append(self.finding(
+                "untapped-step", obj, "warn",
+                f"{obj} exchanges gradients across workers with the "
+                "MXGUARD taps off — cross-replica corruption voting "
+                "is not protecting this run"))
+        return findings

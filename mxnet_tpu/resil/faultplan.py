@@ -16,7 +16,12 @@ Selectors:
                       so a given seed reproduces the same fault sequence
                       bit-for-bit (no wall clock, no global random state);
 - ``step:N``          the ``step`` site when the training step counter
-                      equals N (TrainGuard passes ``step=`` through).
+                      equals N (TrainGuard passes ``step=`` through);
+- ``<site>:N+``       every invocation with step counter >= N — a
+                      *persistent* fault that survives mxguard's
+                      deterministic re-execution (a ``:N`` or ``@K``
+                      clause clears on the re-executed attempt and
+                      classifies as transient instead).
 
 Actions:
 
@@ -33,7 +38,16 @@ Actions:
   preempt/kill instead raise the typed :class:`WorkerPreempted` /
   :class:`WorkerKilled` so exactly ONE worker thread dies;
 - ``nan``       — return the token ``"nan"`` to the caller, which
-  poisons that step's loss (TrainGuard's non-finite rollback drill).
+  poisons that step's loss (TrainGuard's non-finite rollback drill);
+- ``sdc`` / ``sdc:bitflip`` / ``sdc:scale`` — return the token
+  ``"sdc:<mode>"`` to the caller: the mxguard fingerprint taps
+  (``guard.sdc`` / ``guard.sdc.<worker_id>`` sites) consume it by corrupting
+  ONE gradient element deterministically — ``bitflip`` flips the high
+  exponent bit of the absmax element (loud: caught by cross-replica
+  voting within the step), ``scale`` multiplies it by ``1 + 2^-10``
+  (silent: below the vote threshold, found later by
+  ``tools/mxresil.py replay``). The drill trigger for every mxguard
+  test and ``bench.py --guard``.
 
 When ``MXRESIL_FAULT_PLAN`` is unset, :func:`inject` is a two-dict-read
 no-op — the hooks cost nothing in production and record zero retries
@@ -84,7 +98,8 @@ class WorkerPreempted(MXNetError):
 
 _CLAUSE_RE = re.compile(
     r"^(?P<site>[a-zA-Z_][\w.]*)"
-    r"(?:@(?P<nth>\d+)|%(?P<prob>0?\.\d+|1(?:\.0*)?)|:(?P<step>\d+))?"
+    r"(?:@(?P<nth>\d+)|%(?P<prob>0?\.\d+|1(?:\.0*)?)"
+    r"|:(?P<step>\d+)(?P<step_from>\+)?)?"
     r"=(?P<action>[a-zA-Z_]+)(?::(?P<arg>[^;]+))?$")
 
 
@@ -101,15 +116,17 @@ def _parse_duration_s(arg: str) -> float:
 class Clause:
     """One ``selector=action`` rule plus its firing state."""
 
-    __slots__ = ("site", "nth", "prob", "step", "action", "arg",
-                 "stall_s", "fired", "_rng")
+    __slots__ = ("site", "nth", "prob", "step", "step_from", "action",
+                 "arg", "stall_s", "fired", "_rng")
 
     def __init__(self, site: str, action: str, arg: Optional[str] = None,
                  nth: Optional[int] = None, prob: Optional[float] = None,
-                 step: Optional[int] = None, seed: int = 0):
-        if action not in ("raise", "stall", "preempt", "kill", "nan"):
+                 step: Optional[int] = None, step_from: bool = False,
+                 seed: int = 0):
+        if action not in ("raise", "stall", "preempt", "kill", "nan",
+                          "sdc"):
             raise MXNetError(f"fault plan: unknown action {action!r} "
-                             "(raise|stall|preempt|kill|nan)")
+                             "(raise|stall|preempt|kill|nan|sdc)")
         if action == "stall":
             if not arg:
                 raise MXNetError("fault plan: stall needs a duration, "
@@ -125,10 +142,22 @@ class Clause:
             raise MXNetError(
                 "fault plan: the nan action only applies to the 'step' "
                 f"site (got {site!r}); use raise/stall there instead")
+        if action == "sdc":
+            if arg not in (None, "bitflip", "scale"):
+                raise MXNetError(
+                    f"fault plan: sdc mode {arg!r} unknown — use "
+                    "sdc:bitflip (loud) or sdc:scale (silent)")
+            if not site.startswith("guard."):
+                # only the mxguard taps consume the sdc token — at any
+                # other site it would count a fault that did nothing
+                raise MXNetError(
+                    "fault plan: the sdc action only applies to the "
+                    f"mxguard tap sites 'guard.*' (got {site!r})")
         self.site = site
         self.nth = nth
         self.prob = prob
         self.step = step
+        self.step_from = bool(step_from)
         self.action = action
         self.arg = arg
         self.fired = 0
@@ -138,7 +167,10 @@ class Clause:
 
     def matches(self, invocation: int, step: Optional[int]) -> bool:
         if self.step is not None:
-            return step is not None and step == self.step
+            if step is None:
+                return False
+            return step >= self.step if self.step_from \
+                else step == self.step
         if self.nth is not None:
             return invocation == self.nth
         if self.prob is not None:
@@ -152,7 +184,7 @@ class Clause:
         elif self.prob is not None:
             sel += f"%{self.prob}"
         elif self.step is not None:
-            sel += f":{self.step}"
+            sel += f":{self.step}" + ("+" if self.step_from else "")
         act = self.action + (f":{self.arg}" if self.arg else "")
         return {"selector": sel, "action": act, "fired": self.fired}
 
@@ -174,6 +206,7 @@ def parse_plan(spec: str, seed: int = 0) -> List[Clause]:
             nth=int(d["nth"]) if d["nth"] else None,
             prob=float(d["prob"]) if d["prob"] else None,
             step=int(d["step"]) if d["step"] else None,
+            step_from=bool(d["step_from"]),
             seed=seed))
     return clauses
 
@@ -249,6 +282,8 @@ class FaultPlan:
                     + ") — die without cleanup")
             os.kill(os.getpid(), signal.SIGKILL)
             return None  # unreachable
+        if hit.action == "sdc":
+            return "sdc:" + (hit.arg or "bitflip")
         return "nan"
 
     def report(self) -> Dict[str, object]:

@@ -38,6 +38,7 @@ import math
 import signal
 import threading
 import time
+import warnings
 from typing import Callable, Dict, Optional
 
 from ..base import MXNetError, get_logger
@@ -78,7 +79,11 @@ class TrainGuard:
     snapshot). In ``params_fn`` mode the guard cannot install restored
     state by itself — pass ``restore_fn(params, opt_state, extra)`` to
     receive it on :meth:`resume` and on non-finite rollback; without
-    one, non-finite steps are SKIPPED (counted, not rolled back).
+    one — or with ``manager=None`` (signal handling/watchdog beats
+    only) — non-finite steps are SKIPPED (counted, not rolled back):
+    the first skip warns once and raises the standing
+    ``mxresil_guard_unprotected`` gauge so the degraded protection is
+    visible in telemetry and ``tools/diagnose.py`` instead of silent.
     ``extra_fn`` may add a user dict to every checkpoint.
     """
 
@@ -123,6 +128,19 @@ class TrainGuard:
         self._g_emergency_step = _metrics.gauge(
             "mxresil_last_emergency_ckpt_step",
             "step of the newest emergency checkpoint (-1 = none)")
+        self._g_unprotected = _metrics.gauge(
+            "mxresil_guard_unprotected",
+            "1 = a TrainGuard event ran without checkpoint backing "
+            "(non-finite step skipped with no rollback, or preempted "
+            "with no emergency checkpoint) — degraded protection; "
+            "see tools/diagnose.py and docs/resilience.md")
+        self._warned_unprotected = False
+        if manager is None and (self.checkpoint_every or
+                                restore_fn is not None):
+            raise MXNetError(
+                "TrainGuard(manager=None) cannot checkpoint or "
+                "restore — drop checkpoint_every/restore_fn or pass a "
+                "CheckpointManager")
 
     # -- lifecycle --------------------------------------------------------
     def __enter__(self) -> "TrainGuard":
@@ -178,6 +196,8 @@ class TrainGuard:
         Single-load restore_latest shape (corrupt steps fall back), but
         keeping the restore() tuple so ``next_step`` comes from the one
         load instead of deserializing and digest-checking twice."""
+        if self.manager is None:
+            return 0  # manager-less guard: nothing to resume from
         restored = self._restore_newest_intact()
         if restored is None:
             return 0
@@ -232,6 +252,8 @@ class TrainGuard:
                     f"{self._nonfinite_streak} consecutive non-finite "
                     f"losses at step {step} — the run has diverged "
                     "beyond what checkpoint rollback can fix")
+            if not rolled:
+                self._note_unprotected(step)
             _log.warning("non-finite loss at step %d: %s", step,
                          "rolled back to last checkpoint" if rolled
                          else "skipped (no restore channel or no intact "
@@ -268,7 +290,36 @@ class TrainGuard:
             self.manager.save(step + 1, params=self.params_fn(),
                               extra=extra)
 
+    def _note_unprotected(self, step: int,
+                          what: str = "non-finite step skipped "
+                                      "without rollback"):
+        """A guard event could not be backed by checkpoint machinery
+        (a non-finite skip with no rollback, or a preemption with no
+        emergency checkpoint): protection is degraded. One-time
+        warning + a standing gauge so the gap is visible in telemetry
+        and tools/diagnose.py instead of only in a log line nobody
+        reads until the run is ruined."""
+        self._g_unprotected.set(1)
+        if self._warned_unprotected:
+            return
+        self._warned_unprotected = True
+        why = ("no CheckpointManager attached" if self.manager is None
+               else "no restore channel (params_fn mode without "
+                    "restore_fn)" if self.trainer is None
+                    and self.restore_fn is None
+               else "no intact checkpoint to roll back to")
+        _log.warning(
+            "TrainGuard is running UNPROTECTED (%s at step %d): %s — "
+            "attach a CheckpointManager (and trainer= or restore_fn=) "
+            "to restore full protection; mxresil_guard_unprotected=1 "
+            "until then (docs/resilience.md).", what, step, why)
+        warnings.warn(
+            f"TrainGuard: {what} at step {step} ({why}) — degraded "
+            "protection, see docs/resilience.md", stacklevel=3)
+
     def _rollback(self, step: int) -> bool:
+        if self.manager is None:
+            return False  # nothing to restore from
         if self.trainer is None and self.restore_fn is None:
             return False  # params_fn-only: nowhere to install state
         if self._restore_newest_intact() is None:
@@ -279,6 +330,13 @@ class TrainGuard:
     def _maybe_emergency(self, step: int):
         if self._preempt_signum is None:
             return
+        if self.manager is None:
+            # manager-less guard: nothing to commit — still surface the
+            # preemption to the caller so the process exits cleanly
+            self._note_unprotected(
+                step, what="preempted with NO emergency checkpoint "
+                           "committed")
+            raise Preempted(step, self._preempt_signum)
         global _LAST_EMERGENCY
         signum = self._preempt_signum
         self.manager.wait()  # drain any in-flight periodic save first

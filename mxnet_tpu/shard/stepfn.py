@@ -159,7 +159,7 @@ class ShardedStepFunction(StepFunction):
     def _shard_key(self):
         return (self._plan.fingerprint(),)
 
-    def _make_jit(self, pure):
+    def _make_jit(self, pure, guard=False):
         if not self._installed:
             self.install()
         plan = self._plan
@@ -174,8 +174,13 @@ class ShardedStepFunction(StepFunction):
         in_shardings = (pspec, sspec, lspec, lspec, plan.data_spec(),
                         rep)
         # loss sharding unconstrained: per-sample losses stay sharded
-        # by batch through propagation, scalar losses replicate
-        out_shardings = (pspec, sspec, None)
+        # by batch through propagation, scalar losses replicate. The
+        # mxguard fingerprint output is REPLICATED: its gradient
+        # reductions cross the batch axis, so the taps compose with
+        # the sharded weight-update forms unchanged (every replica
+        # reads the same digest of the same global gradients).
+        out_shardings = (pspec, sspec, None) + \
+            ((rep,) if guard else ())
         return jax.jit(pure,
                        in_shardings=in_shardings,
                        out_shardings=out_shardings,
@@ -192,6 +197,43 @@ class ShardedStepFunction(StepFunction):
         return super().step(x, *labels, batch_size=batch_size)
 
     __call__ = step
+
+    # ------------------------------------------------------------------
+    # mxguard: per-device shard digests (guard/fingerprint.py)
+    # ------------------------------------------------------------------
+    def guard_digest_report(self) -> Dict[str, object]:
+        """Cross-device integrity sweep over the mesh-placed
+        parameters and optimizer state: every pair of devices holding
+        the SAME shard index of the same buffer must hold
+        bitwise-identical bytes (replicated weights, and the ZeRO
+        state's replicated dimensions). A deviating device is named
+        directly — the sharded path's analog of the cross-replica
+        fingerprint vote, where the redundancy lives across mesh
+        devices instead of kvstore workers."""
+        from ..guard.fingerprint import (check_replica_digests,
+                                         replica_digests)
+        pvals, svals = self._gather()
+        pvals = dict(pvals)
+        pvals.pop("__aux__", None)
+        named = list(pvals.items())
+        for name, sval in zip(self._trainable, svals):
+            for j, leaf in enumerate(jax.tree.leaves(sval)):
+                named.append((f"opt_state:{name}:{j}", leaf))
+        mismatches = check_replica_digests(named)
+        from ..telemetry import metrics as _metrics
+        _metrics.counter(
+            "mxguard_shard_digest_sweeps_total",
+            "per-device shard-digest integrity sweeps").inc()
+        if mismatches:
+            _metrics.counter(
+                "mxguard_shard_digest_mismatches_total",
+                "devices whose shard bytes diverged from the majority"
+                ).inc(len(mismatches))
+        return {"buffers": len(named),
+                "devices": self._plan.n_devices,
+                "mismatches": mismatches,
+                "digests": {name: replica_digests(arr)
+                            for name, arr in named[:4]}}
 
     # ------------------------------------------------------------------
     # introspection (shardlint / docs)

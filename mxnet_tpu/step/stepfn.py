@@ -45,7 +45,7 @@ from __future__ import annotations
 
 import time
 import warnings
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -116,6 +116,21 @@ class StepFunction:
         self._last = None  # (jitted fn, key) of the newest compile
         self._opt_report = None  # graph-optimizer report (symbol mode)
         self._opt_level = 0
+        # mxguard integrity taps (mxnet_tpu/guard/): fingerprints ride
+        # as extra outputs of the SAME compiled program when MXGUARD is
+        # on (or a Monitor tic forces them); the flag is part of the
+        # signature-cache key so flipping it re-keys visibly and the
+        # steady state stays at zero recompiles either way
+        self._nstep = 0
+        self._guard_probe = None  # per-instance EWMA anomaly probe
+        self._recorder = None  # guard.ReplayRecorder (attach_recorder)
+        self._monitor_cb = None  # Monitor duck-type (set_monitor_...)
+        self._monitor_all = False
+        self._last_fps = None  # (2+n_grads, 3) of the last noted step
+        self._pending_guard = None  # deferred (fps, loss, step) note
+        self._fp_names = ()
+        self._last_loss = None
+        self.guard_events = []  # vote/self-check verdicts (elastic)
 
         if trainer is not None:
             if optimizer_params or optimizer != "sgd":
@@ -301,14 +316,52 @@ class StepFunction:
             [trainable_vals[n] for n in self._trainable],
             [grads[n] for n in self._trainable], svals, lrs, wds)
 
-    def _build_grads(self):
+    def _build_grads(self, taps=False):
         """Pure ``(pvals, inputs, rng) -> (grads, extras, loss)``
         builder — the forward+backward phase shared by the one-program
         step and the elastic split-phase step (mxnet_tpu/elastic/
         stepfn.py, which exchanges gradients host-side between this
         and the update program). ``extras`` is the non-gradient state
         the step must write back (BN running stats; the symbol graph's
-        ``__aux__`` dict)."""
+        ``__aux__`` dict).
+
+        ``taps=True`` (mxguard) appends a fourth output: the
+        fingerprint matrix — row 0 the fold over the pre-step
+        trainable weights (bitwise-replicated across data-parallel
+        workers, the exact-majority vote row), rows 1..n one
+        (checksum, absmax, nonfinite) triple per gradient in sorted
+        trainable order, and a final LOCAL loss row
+        ``(mean, absmax, nonfinite)`` so the anomaly probe needs no
+        second device fetch (the loss row never enters the
+        cross-replica vote — losses legitimately differ per worker).
+        The gradients pass through an ``optimization_barrier`` before
+        being fingerprinted AND before the update consumes them, so
+        the gradient producers see the same single consumer with taps
+        on or off — the taps-on step is bitwise-identical in weights
+        to taps-off (test-enforced)."""
+        base = self._build_grads_base()
+        if not taps:
+            return base
+        trainable = self._trainable
+        from ..guard.fingerprint import fingerprint_rows, fold_rows
+
+        def tapped(pvals, inputs, rng):
+            grads, extras, lout = base(pvals, inputs, rng)
+            grads = jax.lax.optimization_barrier(grads)
+            prow = fold_rows(fingerprint_rows(
+                pvals[n] for n in trainable))
+            grows = fingerprint_rows(grads[n] for n in trainable)
+            lflat = jnp.asarray(lout).astype(jnp.float32).reshape(-1)
+            lrow = jnp.stack([
+                jnp.mean(lflat), jnp.max(jnp.abs(lflat)),
+                jnp.sum(~jnp.isfinite(lflat)).astype(jnp.float32)])
+            fps = jnp.concatenate(
+                [prow[None, :], grows, lrow[None, :]], axis=0)
+            return grads, extras, lout, fps
+
+        return tapped
+
+    def _build_grads_base(self):
         if self._symbol_mode:
             sym = self._net
             trainable = self._trainable
@@ -355,20 +408,24 @@ class StepFunction:
 
         return pure_grads
 
-    def _build_pure(self):
+    def _build_pure(self, guard=False):
         """The whole-step program: grads + exchange + fused update in
         one trace (the expression DAG is unchanged by the _build_grads
-        factoring — bitwise parity with the eager loop holds)."""
-        grads_fn = self._build_grads()
+        factoring — bitwise parity with the eager loop holds). With
+        ``guard`` the fingerprint matrix rides as a fourth output."""
+        grads_fn = self._build_grads(taps=guard)
         trainable = self._trainable
 
         def pure_step(pvals, svals, lrs, wds, inputs, rng):
-            grads, extras, lout = grads_fn(pvals, inputs, rng)
+            out = grads_fn(pvals, inputs, rng)
+            grads, extras, lout = out[:3]
             tvals = {n: pvals[n] for n in trainable}
             new_w, new_s = self._apply(tvals, grads, svals, lrs, wds)
             new_params = dict(pvals)
             new_params.update(zip(trainable, new_w))
             new_params.update(extras)
+            if guard:
+                return new_params, new_s, lout, out[3]
             return new_params, new_s, lout
 
         return pure_step
@@ -376,10 +433,12 @@ class StepFunction:
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
-    def _make_jit(self, pure):
+    def _make_jit(self, pure, guard=False):
         """Compile hook: the sharded subclass (mxnet_tpu/shard/)
         overrides this to attach NamedSharding in/out annotations over
-        its device mesh; the base step is single-(logical-)device."""
+        its device mesh (``guard`` tells it the program carries the
+        extra fingerprint output); the base step is
+        single-(logical-)device."""
         return jax.jit(pure,
                        donate_argnums=(0, 1) if self._donate else ())
 
@@ -457,8 +516,10 @@ class StepFunction:
             _recompile.signature_of([_wrap(v) for v in inputs], True),
             kind="fused_step")
 
-    def step(self, x, *labels, batch_size=None):
-        """Run one fused training step; returns the loss NDArray."""
+    def step(self, x, *labels, batch_size=None, rng_raw=None):
+        """Run one fused training step; returns the loss NDArray.
+        ``rng_raw`` overrides the step's RNG key data — the
+        deterministic-replay hook (mxnet_tpu/guard/replay.py)."""
         from ..telemetry import metrics as _metrics
         from .. import telemetry as _telemetry
         t0 = time.perf_counter()
@@ -467,20 +528,23 @@ class StepFunction:
         if batch_size is None:
             batch_size = int(inputs[0].shape[0]) if inputs[0].ndim else 1
         self._optimizer.rescale_grad = self._scale / batch_size
+        guard = self._guard_enabled()
 
         # key on input signature + parameter dtypes + every scalar the
         # trace bakes in (rescale_grad, clip, momentum, betas, ... —
         # fused_signature), so mid-run hyperparameter mutation and
         # Parameter.cast retrace VISIBLY (counted as misses, recorded
-        # by the recompile auditor) instead of silently
+        # by the recompile auditor) instead of silently. The mxguard
+        # tap flag re-keys the same way (taps are extra outputs of the
+        # program — a different program).
         key = (tuple((tuple(v.shape), str(v.dtype)) for v in inputs),
-               self._param_dtypes(), self._opt_level,
+               self._param_dtypes(), self._opt_level, guard,
                self._optimizer.fused_signature()) + self._shard_key()
         fn = self._cache.get(key)
         if fn is None:
             self._record_miss(inputs)
             tb0 = time.perf_counter()
-            fn = self._make_jit(self._build_pure())
+            fn = self._make_jit(self._build_pure(guard), guard)
             self._cache[key] = fn
             self._last = (fn, key)
             _metrics.histogram(
@@ -495,11 +559,27 @@ class StepFunction:
         lrs, wds = self._hyper()
         pvals, svals = self._gather()
         t1 = time.perf_counter()
-        rng = jax.random.key_data(_random.next_key())
-        new_params, new_states, loss = fn(pvals, svals, lrs, wds,
-                                          inputs, rng)
+        rng = jnp.asarray(rng_raw) if rng_raw is not None \
+            else jax.random.key_data(_random.next_key())
+        out = fn(pvals, svals, lrs, wds, inputs, rng)
+        new_params, new_states, loss = out[:3]
         t2 = time.perf_counter()
         self._writeback(new_params, new_states)
+        if guard:
+            if self._recorder is not None or self._monitor_all:
+                # recorder/monitor consumers need THIS step's values
+                # (an earlier deferred note flushes first — the probe
+                # must observe steps in order)
+                self._flush_pending_guard()
+                self._guard_note(out[3], loss, inputs, rng)
+            else:
+                # telemetry-only mode: defer the host read one step —
+                # by the next boundary the program has completed, so
+                # the fetch copies a finished buffer instead of
+                # stalling the async pipeline (the measured tap
+                # overhead is the in-program reductions alone)
+                self._flush_pending_guard()
+                self._pending_guard = (out[3], loss, self._nstep)
         t3 = time.perf_counter()
         _metrics.histogram(
             "fused_step_host_seconds",
@@ -513,9 +593,144 @@ class StepFunction:
             "fused_step_writeback_seconds",
             "fused-step parameter/state rebind").observe(t3 - t2)
         _telemetry.record_step(batch_size, time.perf_counter() - t0)
+        self._nstep += 1
         return _wrap(loss)
 
     __call__ = step
+
+    # ------------------------------------------------------------------
+    # mxguard integrity taps (mxnet_tpu/guard/; docs/resilience.md)
+    # ------------------------------------------------------------------
+    def _guard_enabled(self) -> bool:
+        """Taps on: the MXGUARD flag, or a Monitor tic for this step
+        (``_monitor_all`` — the reference executor's monitor switch,
+        set by ``Monitor.tic``)."""
+        from .. import config
+        return bool(config.get("MXGUARD")) or self._monitor_all
+
+    def attach_recorder(self, recorder):
+        """Attach a :class:`~mxnet_tpu.guard.replay.ReplayRecorder`:
+        every guarded step records its batch digests, RNG key, hyper
+        scalars, loss digest and fingerprints into the bounded ring."""
+        self._recorder = recorder
+        return recorder
+
+    @property
+    def guard_probe(self):
+        """This step function's OWN EWMA anomaly probe (lazy): each
+        in-process worker keeps its own loss/step stream, so replay
+        windows attribute to the right run. Register on a watchdog
+        via ``wd.add_probe(fused.guard_probe.check)`` — or
+        ``guard.anomaly.check_all`` to cover every probe at once."""
+        if self._guard_probe is None:
+            from ..guard.anomaly import GuardProbe
+            self._guard_probe = GuardProbe(name=self._name)
+        return self._guard_probe
+
+    @property
+    def last_fingerprints(self):
+        """The newest tap matrix ``(params, *grads, loss) x (checksum,
+        absmax, nonfinite)`` — materializes a deferred note first, so
+        readers always see the LAST COMPLETED step's values."""
+        self._flush_pending_guard()
+        return self._last_fps
+
+    def flush_guard(self):
+        """Process any deferred tap note NOW (telemetry-only mode
+        reads the previous step's completed buffers; call this after
+        the final step of a run, or before reading guard telemetry
+        that must include the newest step)."""
+        self._flush_pending_guard()
+        return self._last_fps
+
+    def _flush_pending_guard(self):
+        if self._pending_guard is None:
+            return
+        fps, loss, step = self._pending_guard
+        self._pending_guard = None
+        self._guard_note(fps, loss, None, None, step=step)
+
+    def _guard_note(self, fps, loss_raw, inputs, rng,
+                    good: bool = True, strict: bool = True,
+                    step: Optional[int] = None):
+        """Post-step guard bookkeeping shared with the elastic
+        subclass: publish the fingerprints, feed the EWMA anomaly
+        probe, run the solo strict check, and record the replay ring
+        entry."""
+        import numpy as onp
+        from .. import config
+        if step is None:
+            step = self._nstep
+        # ONE device fetch: the matrix carries the loss row too, so
+        # the probe never forces a second transfer (the recorder —
+        # opt-in — is the only consumer that touches the loss buffer)
+        fps_host = onp.asarray(fps, dtype=onp.float32)
+        self._last_fps = fps_host
+        self._fp_names = ("__params__",) + self._trainable \
+            + ("__loss__",)
+        self._last_loss = loss_raw
+        n_grads = len(self._trainable)
+        loss_row = fps_host[-1]
+        loss_mean = float(loss_row[0]) if not loss_row[2] \
+            else float("nan")
+        grad_absmax = float(fps_host[1:1 + n_grads, 1].max()) \
+            if n_grads else None
+        anomaly = self.guard_probe.observe(step, loss_mean,
+                                           grad_absmax)
+        nonfinite = float(fps_host[1:1 + n_grads, 2].sum()) \
+            if n_grads else 0.0
+        if nonfinite and strict and config.get("MXGUARD_STRICT"):
+            # the one-program fused step already applied the update
+            # (grads and weights live in ONE donated program), so a
+            # transparent retry is impossible here — hard-fail and
+            # point at the replay ring. The split-phase elastic step
+            # classifies/retries instead (guard/voting.py).
+            from ..guard.voting import GuardCorruption
+            raise GuardCorruption(step,
+                                  [f"nonfinite:{int(nonfinite)}"])
+        if self._recorder is not None and inputs is not None:
+            scalars = {"rescale": float(self._optimizer.rescale_grad)}
+            self._recorder.record(
+                step, inputs, rng, onp.asarray(loss_raw),
+                fps_host, scalars=scalars, trainer=self._trainer,
+                good=good and anomaly is None and not nonfinite)
+
+    def guard_state(self) -> Dict[str, object]:
+        """The guardlint surface: what protection THIS step function
+        actually has wired (docs/resilience.md integrity section)."""
+        from .. import config
+        rec = self._recorder
+        return {"kind": type(self).__name__,
+                "name": self._name,
+                "taps": bool(config.get("MXGUARD")),
+                "recorder": rec is not None,
+                "ring_checkpoints": bool(
+                    rec is not None and rec.has_checkpoint_ring),
+                "exchanges_gradients": False,
+                "guard_events": len(self.guard_events)}
+
+    # -- Monitor duck-type (the executor monitor surface, so
+    # ``Monitor.install(fused)`` works on the fused-step path — the
+    # eager executor never runs there and per-op activations do not
+    # exist as materialized values inside one XLA program; what the
+    # monitor observes are the fingerprint taps + the loss) ------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_cb = callback
+        self._monitor_all = bool(monitor_all)
+
+    def collect_monitor_stats(self, helper):
+        """Feed the last step's tap values to a Monitor stat helper:
+        one (3,) fingerprint NDArray per gradient (named
+        ``<param>_grad_fp``), the params-digest row, and the loss."""
+        if self.last_fingerprints is None:
+            return
+        for name, row in zip(self._fp_names, self.last_fingerprints):
+            tag = "params_fp" if name == "__params__" \
+                else "loss_fp" if name == "__loss__" \
+                else f"{name}_grad_fp"
+            helper(tag, _wrap(jnp.asarray(row)))
+        if self._last_loss is not None:
+            helper("loss", _wrap(jnp.asarray(self._last_loss)))
 
     # ------------------------------------------------------------------
     # introspection

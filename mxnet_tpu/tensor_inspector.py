@@ -53,7 +53,15 @@ _CHECKS = {
 class TensorInspector:
     """Inspect one tensor's values on the host (ref:
     tensor_inspector.h TensorInspector; construction forces a sync —
-    the WaitToRead the reference performs before reading)."""
+    the WaitToRead the reference performs before reading).
+
+    Low-precision host copies are first-class: a bfloat16 buffer
+    arrives as an ``ml_dtypes`` extension dtype that numpy's ufuncs
+    (``isnan``/``isinf``/comparisons) do not reliably accept, so the
+    checkers run over a float32 **widening view** — the widening is
+    exact for every bf16/f16 value (including ±Inf/NaN payload class),
+    so abnormal-coordinate reporting at low precision is lossless.
+    ``tensor_info``/dumps keep the ORIGINAL dtype."""
 
     def __init__(self, tensor, name: str = "tensor"):
         if hasattr(tensor, "asnumpy"):
@@ -61,6 +69,12 @@ class TensorInspector:
         else:
             self._a = onp.asarray(tensor)
         self.name = name
+        # native numpy kinds pass through; extension float dtypes
+        # (bfloat16, float8_*) widen to f32 for checking/printing
+        if self._a.dtype.kind in "biufc":
+            self._check = self._a
+        else:
+            self._check = self._a.astype(onp.float32)
 
     # -- info / printing --------------------------------------------------
     def tensor_info(self) -> str:
@@ -69,7 +83,7 @@ class TensorInspector:
         return f"<{self._a.dtype} Tensor {shape}>"
 
     def to_string(self, max_elems: int = 1000) -> str:
-        body = onp.array2string(self._a, threshold=max_elems)
+        body = onp.array2string(self._check, threshold=max_elems)
         return f"{self.tensor_info()}\n{body}"
 
     def print_string(self, max_elems: int = 1000):
@@ -89,12 +103,12 @@ class TensorInspector:
         async runtime, so prompting is not reproduced)."""
         fn = _CHECKS[checker] if isinstance(checker, CheckerType) \
             else checker
-        mask = onp.asarray(fn(self._a))
+        mask = onp.asarray(fn(self._check))
         coords = [tuple(int(i) for i in c) for c in
                   onp.argwhere(mask)]
         if print_result or interactive:
             for c in coords:
-                print(f"{self.name}{list(c)} = {self._a[c]}")
+                print(f"{self.name}{list(c)} = {self._check[c]}")
         return coords
 
     # -- dumping ----------------------------------------------------------

@@ -263,8 +263,8 @@ def test_hybridize_warns_on_tracer_leak():
 def test_pass_manager_registry():
     pm = default_manager()
     assert pm.names() == ["dispatchlint", "elasticlint", "graphlint",
-                          "oplint", "servelint", "shardlint",
-                          "steplint", "tracercheck"]
+                          "guardlint", "oplint", "servelint",
+                          "shardlint", "steplint", "tracercheck"]
     with pytest.raises(KeyError):
         pm.get("no_such_pass")
     out = sym.var("x") + sym.var("x")

@@ -266,6 +266,51 @@ def check_resilience():
         print("metrics      : none (no resil hook has fired)")
 
 
+def check_guard():
+    """Integrity-layer health: MXGUARD flags, tap/vote/quarantine
+    metrics, the last EWMA anomaly verdict and its replay window
+    (mxnet_tpu/guard/; docs/resilience.md integrity section)."""
+    print("----------Integrity (mxguard)----------")
+    try:
+        from mxnet_tpu import config, telemetry
+        from mxnet_tpu.guard import anomaly
+    except Exception as e:
+        print("guard        : unavailable (%s)" % e)
+        return
+    on = config.get("MXGUARD")
+    print("taps         :", "ON (fingerprints ride the fused step)"
+          if on else "(off — set MXGUARD=1)")
+    print("vote tol     :", config.get("MXGUARD_VOTE_TOL"),
+          "(absmax factor over peer median)")
+    print("anomaly      : %sx EWMA factor (report-only probe)"
+          % config.get("MXGUARD_EWMA_FACTOR"))
+    print("replay ring  : %s steps, known-good ckpt every %s"
+          % (config.get("MXGUARD_RING"),
+             config.get("MXGUARD_CKPT_EVERY")))
+    snap = telemetry.snapshot()
+    guard_metrics = {k: v for k, v in snap.items()
+                     if k.startswith("mxguard_")}
+    for k, v in sorted(guard_metrics.items()):
+        print(f"  {k} = {v}")
+    if not guard_metrics:
+        print("metrics      : none (no guarded step has run)")
+    last = anomaly.last_anomaly()
+    print("last anomaly :", last or "(none this process)")
+    if last:
+        print("  -> replay window %s: python tools/mxresil.py replay "
+              "--ring-dir <ring>" % (last.get("replay_window"),))
+    if snap.get("mxresil_guard_unprotected"):
+        print("  WARNING: a TrainGuard ran without checkpoint "
+              "backing — a non-finite step was skipped with no "
+              "rollback, or a preemption committed no emergency "
+              "checkpoint (mxresil_guard_unprotected=1); attach a "
+              "CheckpointManager + restore channel")
+    quar = snap.get("mxguard_quarantines_total", 0)
+    if quar:
+        print(f"  NOTE: {quar} replica(s) quarantined for persistent "
+              "corruption — triage the host before readmitting")
+
+
 def check_elastic():
     """Elastic-membership health: MXELASTIC_* policy, the current
     generation/world gauges, rebuild/rejoin counters
@@ -318,6 +363,7 @@ def main():
     check_serving2()
     check_resilience()
     check_elastic()
+    check_guard()
     check_mxlint()
 
 

@@ -224,6 +224,86 @@ def _selfcheck_serve_findings():
     return findings
 
 
+def _selfcheck_guard_findings():
+    """guardlint self-check: train a few guarded steps (MXGUARD taps +
+    replay recorder + known-good checkpoint ring) and lint the live
+    guard state plus the kvstore registry — a properly-paired config
+    must lint clean. Coverage check on the lint itself: fixtures with
+    taps-but-no-ring, an exchanging step with taps off, and an elastic
+    store without the pre-exchange tap MUST fire their findings."""
+    import tempfile
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, gluon, nd
+    from mxnet_tpu.guard import ReplayRecorder
+    from mxnet_tpu.passes import Finding
+    from mxnet_tpu.passes.guardlint import GuardLint
+
+    p = GuardLint()
+    config.set_flag("MXGUARD", True)
+    tmp = tempfile.mkdtemp(prefix="mxguard_lint_")
+    try:
+        mx.random.seed(0)
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05})
+        fused = trainer.fuse_step(net, gluon.loss.L2Loss())
+        fused.attach_recorder(ReplayRecorder(tmp, capacity=8,
+                                             ckpt_every=2))
+        rng = onp.random.RandomState(0)
+        for _ in range(3):
+            fused.step(nd.array(rng.uniform(-1, 1, (4, 8))
+                                .astype("float32")),
+                       nd.array(onp.zeros((4, 4), "float32")))
+        findings = p.run([fused])
+        if fused.last_fingerprints is None:
+            findings.append(Finding(
+                "guardlint", "selfcheck-taps", "<self-check step>",
+                "error", "MXGUARD is on but the fused step emitted no "
+                         "fingerprints"))
+        findings += [f for f in p.run()  # the live kvstore registry
+                     if f.severity == "error"]
+    finally:
+        config.unset_flag("MXGUARD")
+    # the lint must FIRE on the bad fixtures — else it is vacuous.
+    # NOT a KVStoreBase subclass: the subclass registry is permanent,
+    # and a leaked fixture would fail every later default-scope audit
+    # in this process (guardlint duck-types the class attributes)
+    class _UntappedElasticStore:
+        supports_flat_allreduce = True
+        elastic_abort = "generation"
+        guard_tap = None
+
+        def allreduce_flat(self, key, value):  # pragma: no cover
+            return value
+
+    fired = {f.check for f in p.run([
+        _UntappedElasticStore,
+        {"name": "<bad taps-no-ring>", "taps": True, "recorder": False,
+         "ring_checkpoints": False, "exchanges_gradients": True},
+        {"name": "<bad untapped-step>", "taps": False,
+         "recorder": False, "ring_checkpoints": False,
+         "exchanges_gradients": True}])}
+    for check in ("no-fingerprint-tap", "detection-without-recovery",
+                  "untapped-step"):
+        if check not in fired:
+            findings.append(Finding(
+                "guardlint", "selfcheck-coverage", "<bad fixture>",
+                "error",
+                f"lint did not fire {check!r} on the fixture built to "
+                "trigger it"))
+    findings.append(Finding(
+        "guardlint", "selfcheck-summary", "<self-check step>", "info",
+        f"guarded {fused._nstep} steps, "
+        f"{len(fused._recorder.records)} ring records, ring "
+        f"checkpoints at {fused._recorder.ring_steps()}, bad-fixture "
+        "coverage exercised"))
+    return findings
+
+
 def _selfcheck_block_findings():
     """tracercheck over a small hybridized block — a clean forward must
     produce no tracer findings."""
@@ -258,6 +338,11 @@ def main(argv=None):
                         "batching decode engine and lint its compiled "
                         "shapes (bucket-rung-exact) and KV page-pool "
                         "donation")
+    p.add_argument("--guard", action="store_true", dest="guard_check",
+                   help="guardlint self-check: run a few MXGUARD-"
+                        "tapped fused steps with a replay ring and "
+                        "lint tap/recovery pairing across the live "
+                        "guard state and the kvstore registry")
     p.add_argument("--opt", action="store_true", dest="opt_check",
                    help="graph-optimizer self-check: run the level-2 "
                         "rewrite pipeline on a fixture graph, report "
@@ -277,9 +362,9 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     if not (args.ops or args.all or args.graphs or args.shard
-            or args.opt_check or args.serve_check):
+            or args.opt_check or args.serve_check or args.guard_check):
         p.error("nothing to do: pass --ops, --all, --shard, --opt, "
-                "--serve, or graph JSON files")
+                "--serve, --guard, or graph JSON files")
 
     if args.shard and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -360,6 +445,10 @@ def main(argv=None):
         sv = _selfcheck_serve_findings()
         findings.extend(sv)
         sections.append(("servelint", "<self-check decode engine>", sv))
+    if args.guard_check:
+        gd = _selfcheck_guard_findings()
+        findings.extend(gd)
+        sections.append(("guardlint", "<self-check guarded step>", gd))
 
     counts = severity_counts(findings)
     if args.as_json:

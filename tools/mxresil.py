@@ -247,6 +247,75 @@ def cmd_elastic(args):
     return 1 if findings else 0
 
 
+def cmd_replay(args):
+    """The mxguard deterministic-replay drill: train the seeded drill
+    net with the record/checkpoint rings enabled — optionally with a
+    SILENT one-element gradient corruption (``sdc:scale``) from
+    ``--corrupt-step`` onward — then rebuild the identical stack
+    without the fault plan and re-execute the recorded window bitwise.
+    Gates (mxlint-schema findings, driving the exit code): a clean run
+    must reproduce bitwise; a corrupted run must bisect to EXACTLY the
+    injected step. ``--ring-dir`` replays an existing ring instead of
+    running the drill (same model/seed knobs as the recording run)."""
+    import tempfile
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu.guard.replay import replay_ring, run_replay_drill
+    from mxnet_tpu.passes import Finding, findings_report
+
+    corrupt = args.corrupt_step \
+        if (args.corrupt_step is not None and args.corrupt_step >= 0) \
+        else None
+    findings = []
+    if args.ring_dir:
+        ring_dir = args.ring_dir
+        drill = None
+    else:
+        ring_dir = tempfile.mkdtemp(prefix="mxguard_replay_")
+        drill = run_replay_drill(
+            ring_dir, steps=args.steps, corrupt_step=corrupt,
+            mode=args.mode, seed=args.seed,
+            ckpt_every=args.ckpt_every)
+    try:
+        report = replay_ring(ring_dir, seed=args.seed,
+                             lo=args.lo, hi=args.hi)
+    except Exception as e:  # missing/corrupt ring -> typed finding
+        report = {"error": f"{type(e).__name__}: {e}",
+                  "bitwise_ok": False, "first_corrupted_step": None}
+    if report.get("error"):
+        findings.append(Finding(
+            "mxresil.replay", "replay-failed", "ring", "error",
+            report["error"]))
+    expected = corrupt if drill is not None else None
+    found = report.get("first_corrupted_step")
+    if drill is not None:
+        if expected is None and not report.get("bitwise_ok"):
+            findings.append(Finding(
+                "mxresil.replay", "bitwise-reproduction", "ring",
+                "error",
+                f"clean run did not replay bitwise (first mismatch at "
+                f"step {found}, digest mismatches "
+                f"{report.get('data_digest_mismatches')}) — the "
+                "record/replay contract is broken"))
+        if expected is not None and found != expected:
+            findings.append(Finding(
+                "mxresil.replay", "bisect-accuracy", "ring", "error",
+                f"replay bisected the first corrupted step to {found} "
+                f"but the sdc drill corrupted step {expected}"))
+    record = findings_report("mxresil.replay", findings, extra={
+        "metric": "mxguard_replay",
+        "ring_dir": ring_dir,
+        "corrupt_step": expected,
+        "replay": report,
+        "drill": ({k: drill[k] for k in
+                   ("steps", "final_loss", "ring")}
+                  if drill is not None else None),
+    })
+    print(json.dumps(record) if args.json
+          else json.dumps(record, indent=2))
+    return 1 if findings else 0
+
+
 def cmd_plan(args):
     from mxnet_tpu.resil import faultplan
     try:
@@ -342,6 +411,28 @@ def main(argv=None):
     e.add_argument("--timeout", type=float, default=120.0)
     e.add_argument("--json", action="store_true")
     e.set_defaults(fn=cmd_elastic)
+
+    rp = sub.add_parser("replay", help="mxguard deterministic-replay "
+                                       "drill: record, corrupt, "
+                                       "replay bitwise, bisect")
+    rp.add_argument("--steps", type=int, default=20)
+    rp.add_argument("--corrupt-step", type=int, default=11,
+                    help="step the silent sdc corruption starts at; "
+                         "negative = clean bitwise-reproduction run")
+    rp.add_argument("--mode", choices=("scale", "bitflip"),
+                    default="scale",
+                    help="sdc mode: scale = one element x (1+2^-10), "
+                         "silent; bitflip = loud exponent flip")
+    rp.add_argument("--ckpt-every", type=int, default=8,
+                    help="known-good ring-checkpoint cadence")
+    rp.add_argument("--ring-dir", default=None,
+                    help="replay an EXISTING ring instead of running "
+                         "the drill")
+    rp.add_argument("--lo", type=int, default=None)
+    rp.add_argument("--hi", type=int, default=None)
+    rp.add_argument("--seed", type=int, default=0)
+    rp.add_argument("--json", action="store_true")
+    rp.set_defaults(fn=cmd_replay)
 
     pl = sub.add_parser("plan", help="validate/expand a fault plan")
     pl.add_argument("--plan", required=True)
