@@ -415,6 +415,42 @@ register_flag(
     "per rung — same closed-jit-cache contract as MXSERVE_BUCKETS; "
     "prompts longer than the top rung are rejected at submit.")
 register_flag(
+    "MXSERVE3_PREFIX_CACHE", bool, False,
+    "Prefix caching for serve2 DecodeEngines (serve3 leg a): FULL "
+    "pages of each prompt are content-hashed (chain hash over the "
+    "whole prefix) so identical prompt prefixes across requests map "
+    "to the same refcounted physical pages — prefill runs only over "
+    "the uncovered suffix, multiplying effective cache capacity under "
+    "templated traffic. Shared pages are read-only; in-place writes "
+    "copy-on-write (mxserve3_cow_copies_*). Exact: greedy outputs are "
+    "unchanged (the cached K/V is the prefill's own). Off by default "
+    "so a flags-off engine is bit-for-bit the PR-8 engine (finished "
+    "sequences' pages linger refcounted in the cache when on).")
+register_flag(
+    "MXSERVE3_PREFIX_CACHE_PAGES", int, 0,
+    "Cap on pages the serve2 prefix cache may pin (0 = no explicit "
+    "cap; pool pressure still evicts LRU cache pages before the "
+    "scheduler resorts to preemption). Tune below the pool size when "
+    "templated traffic would otherwise crowd out decode growth.")
+register_flag(
+    "MXSERVE3_SPEC_TOKENS", int, 0,
+    "Draft tokens proposed per speculative-decoding tick (serve3 leg "
+    "b) when a DecodeEngine is built with draft_params. Each tick the "
+    "draft proposes K tokens in one small dispatch and the target "
+    "verifies all K+1 candidates in ONE batched forward; greedy "
+    "acceptance is exact (token-for-token the target's own "
+    "trajectory), so throughput scales with the draft's acceptance "
+    "rate (mxserve3_accept_rate_*). 0 = speculative decoding off.")
+register_flag(
+    "MXSERVE3_KV_DTYPE", str, "f32",
+    "Storage dtype of the serve2 KV page pools (serve3 leg c): 'f32' "
+    "(exact), 'bf16' (half the pool bytes, quant_bf16 tolerance "
+    "class), or 'int8' (quarter the pool bytes + per-slot f32 dequant "
+    "scales, quantize-on-append, quant_int8 class) — int8 roughly "
+    "quadruples in-flight sequences per pool byte. Dequantization "
+    "happens inside the paged-attention gather.",
+    choices=("f32", "bf16", "int8"))
+register_flag(
     "MXRESIL_FAULT_PLAN", str, "",
     "Deterministic fault-injection plan (resil.faultplan), e.g. "
     "'step:40=preempt;kvstore.push@3=raise;io=stall:200ms' — "

@@ -29,12 +29,20 @@ __all__ = ["TOLERANCE_CLASSES", "tolerance_for", "strongest_class",
 # class -> (rtol, atol) for float32; half-precision inputs widen 100x.
 # "bitwise" compares exact. Order below is weakest-guarantee-last; a
 # pipeline's aggregate class is the strongest-indexed class that fired.
+# The quant_* classes are the serve3 quantized-KV contract: bf16 pools
+# round each cached K/V element to 8 mantissa bits; int8 pools add a
+# per-slot absmax requantization — logits drift accordingly, and the
+# parity gates (tests/test_serving3.py) hold the paged path to these
+# DECLARED bounds rather than silently loosening the fusion class.
 TOLERANCE_CLASSES: Dict[str, Tuple[float, float]] = {
     "bitwise": (0.0, 0.0),
     "layout": (2e-5, 1e-6),   # conv/pool reduce order changes
     "fusion": (2e-5, 1e-6),   # fused contraction / online softmax
+    "quant_bf16": (5e-2, 5e-2),   # bf16 KV pages (8-bit mantissa)
+    "quant_int8": (2e-1, 3e-1),   # int8 KV pages, per-slot scales
 }
-_CLASS_ORDER = ("bitwise", "layout", "fusion")
+_CLASS_ORDER = ("bitwise", "layout", "fusion", "quant_bf16",
+                "quant_int8")
 
 
 def strongest_class(classes) -> str:

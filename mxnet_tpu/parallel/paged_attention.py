@@ -23,6 +23,16 @@ Numerics: accumulation is float32 and the streaming softmax reassociates
 the reduction, so results match a dense softmax within the "fusion"
 tolerance class of :mod:`mxnet_tpu.opt.verify` (the class that already
 covers online-softmax rewrites), not bitwise.
+
+Quantized pools (serve3): the pools may be stored bf16 (no metadata) or
+int8 with per-page-row scales — ``kscale``/``vscale`` are ``(S,)``
+float32 arrays holding each slot's dequant multiplier (one scale per
+cached position per layer: page-granular metadata at the row level,
+written by the quantize-on-append path in serve2/decode.py). Both
+entry points **dequantize inside the gather** so callers never
+materialize a dequantized pool; int8/bf16 results sit in the
+``quant_int8``/``quant_bf16`` tolerance classes of
+:mod:`mxnet_tpu.opt.verify`.
 """
 from __future__ import annotations
 
@@ -34,8 +44,18 @@ import jax.numpy as jnp
 __all__ = ["paged_attention", "paged_attention_flat"]
 
 
+def _deq(pool_rows, scale_rows):
+    """Widen gathered pool rows to f32, applying per-row dequant scales
+    when present. ``pool_rows`` (..., H, K); ``scale_rows`` (...,)."""
+    rows = pool_rows.astype(jnp.float32)
+    if scale_rows is None:
+        return rows
+    return rows * scale_rows.astype(jnp.float32)[..., None, None]
+
+
 def paged_attention(q, kpool, vpool, block_tables, lengths, *,
-                    page_size: int, scale: Optional[float] = None):
+                    page_size: int, scale: Optional[float] = None,
+                    kscale=None, vscale=None):
     """Single-token attention over paged K/V for a batch of sequences.
 
     Parameters
@@ -55,6 +75,8 @@ def paged_attention(q, kpool, vpool, block_tables, lengths, *,
         output is zeros.
     page_size : static page width (compiled into the program).
     scale : logit scale, default ``1/sqrt(K)``.
+    kscale, vscale : optional (S,) float32 per-slot dequant scales for
+        int8 pools (see module docstring); None for f32/bf16 pools.
 
     Returns (B, H, K) in ``q``'s dtype.
     """
@@ -67,8 +89,10 @@ def paged_attention(q, kpool, vpool, block_tables, lengths, *,
         o, l, m = carry
         j, bt_col = xs                                # (), (B,)
         idx = bt_col[:, None] * page_size + offs[None, :]   # (B, page)
-        k_c = kpool[idx].astype(jnp.float32)          # (B, page, H, K)
-        v_c = vpool[idx].astype(jnp.float32)
+        k_c = _deq(kpool[idx],                        # (B, page, H, K)
+                   None if kscale is None else kscale[idx])
+        v_c = _deq(vpool[idx],
+                   None if vscale is None else vscale[idx])
         logits = jnp.einsum("bhk,bphk->bhp", qf, k_c) * scale_v
         pos = j * page_size + offs                    # logical positions
         mask = pos[None, :] < lengths[:, None]        # (B, page)
@@ -97,7 +121,8 @@ def paged_attention(q, kpool, vpool, block_tables, lengths, *,
 
 
 def paged_attention_flat(q, kpool, vpool, block_tables, lengths, *,
-                         page_size: int, scale: Optional[float] = None):
+                         page_size: int, scale: Optional[float] = None,
+                         kscale=None, vscale=None):
     """Same contract as :func:`paged_attention`, flat formulation: ONE
     gather materializes each sequence's whole logical window ``(B,
     N*page_size, H, K)``, then a single masked softmax. More live
@@ -113,8 +138,10 @@ def paged_attention_flat(q, kpool, vpool, block_tables, lengths, *,
     offs = jnp.arange(page, dtype=jnp.int32)
     idx = (block_tables.astype(jnp.int32)[:, :, None] * page
            + offs[None, None, :]).reshape(B, -1)      # (B, N*page)
-    k_all = kpool[idx].astype(jnp.float32)            # (B, S, H, K)
-    v_all = vpool[idx].astype(jnp.float32)
+    k_all = _deq(kpool[idx],                          # (B, S, H, K)
+                 None if kscale is None else kscale[idx])
+    v_all = _deq(vpool[idx],
+                 None if vscale is None else vscale[idx])
     logits = jnp.einsum("bhk,bshk->bhs", q.astype(jnp.float32),
                         k_all) * scale_v
     pos = jnp.arange(idx.shape[1], dtype=jnp.int32)

@@ -47,7 +47,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .train import adam_init, adam_apply
 
-__all__ = ["init_pipeline_lm", "pipeline_lm_shardings",
+__all__ = ["init_pipeline_lm", "truncate_pipeline_lm",
+           "pipeline_lm_shardings",
            "build_pipeline_lm_step", "dense_lm_loss", "dense_lm_logits",
            "pipeline_lm_loss", "combined_mesh_drill"]
 
@@ -85,6 +86,24 @@ def init_pipeline_lm(seed: int, *, vocab: int, d_model: int,
         "ln_f": jnp.ones((D,), jnp.float32),
         "head": w(D, vocab),
     }
+
+
+def truncate_pipeline_lm(params: Dict, n_layers: int) -> Dict:
+    """Layer-truncated draft model: the first ``n_layers`` of a stack
+    with the embedding/head/final-norm shared — the standard
+    self-drafting baseline for speculative decoding
+    (serve2.DecodeEngine ``draft_params=``). Shares the leaves (no
+    copy): vocab and d_model match the target by construction, which
+    is exactly what the verify step requires."""
+    L = params["layers"]["wqkv"].shape[0]
+    n = int(n_layers)
+    if not 1 <= n <= L:
+        raise ValueError(
+            f"truncate_pipeline_lm: n_layers must be in [1, {L}], "
+            f"got {n}")
+    out = dict(params)
+    out["layers"] = {k: v[:n] for k, v in params["layers"].items()}
+    return out
 
 
 def pipeline_lm_shardings(mesh: Mesh, n_stage: int) -> Dict:

@@ -14,19 +14,33 @@ enforce:
    input AND output pools live (double the KV footprint, ~the largest
    allocation in the process).
 
+serve3's prefix caching adds a third contract: **page accounting**.
+Shared pages are refcounted, and a refcount that disagrees with the
+set of reachable holders (running block tables + the prefix cache) is
+either a leak (pages that never return to the pool) or a
+use-after-free (a "freed" sequence still reaching a shared page).
+:func:`lint_page_audit` cross-checks a
+:meth:`~mxnet_tpu.serve2.scheduler.DecodeEngine.page_audit` snapshot:
+refcount-vs-holders equivalence, no reachable page at refcount 0, no
+null page / duplicate page inside a block table, and the
+CoW-on-shared-write contract (the page a sequence's next token would
+write into must not be shared).
+
 :class:`ServeLint` audits a :class:`~mxnet_tpu.serve2.decode.PagedLM` /
 :class:`~mxnet_tpu.serve2.scheduler.DecodeEngine` (anything with their
-``lint_report()`` shape) against both, plus the warmup-coverage and
-after-warmup-recompile alarms. Registered in the default PassManager;
-``tools/mxlint.py --serve`` runs it over a live self-check engine.
+``lint_report()`` shape) against all of the above, plus the
+warmup-coverage and after-warmup-recompile alarms. Registered in the
+default PassManager; ``tools/mxlint.py --serve`` runs it over a live
+self-check engine.
 """
 from __future__ import annotations
 
+from collections import Counter
 from typing import List
 
 from . import Finding, Pass
 
-__all__ = ["ServeLint", "lint_serve_report"]
+__all__ = ["ServeLint", "lint_serve_report", "lint_page_audit"]
 
 
 class ServeLint(Pass):
@@ -35,7 +49,17 @@ class ServeLint(Pass):
 
     def run(self, target) -> List[Finding]:
         rep = target if isinstance(target, dict) else target.lint_report()
-        return lint_serve_report(rep)
+        out = lint_serve_report(rep)
+        # engines with a refcounted paged pool also get the
+        # page-accounting audit (and their draft model, if any, the
+        # compile-contract checks)
+        audit = getattr(target, "page_audit", None)
+        if callable(audit):
+            out.extend(lint_page_audit(audit()))
+        draft = rep.get("draft") if isinstance(rep, dict) else None
+        if draft:
+            out.extend(lint_serve_report(draft))
+        return out
 
     def finding(self, check, obj, severity, message, loc=None):
         return Finding(self.name, check, obj, severity, message, loc)
@@ -58,7 +82,15 @@ def lint_serve_report(rep: dict) -> List[Finding]:
             "engine was never warmed — the jit cache is open and every "
             "first-arrival shape will compile in the serving path"))
 
-    rung_sets = {"decode": decode_rungs, "prefill": prefill_rungs}
+    prefill_ext_rungs = set(rep.get("prefill_ext_rungs") or ())
+    rung_sets = {"decode": decode_rungs, "prefill": prefill_rungs,
+                 # serve3 programs: the speculative verify compiles per
+                 # decode batch rung, the suffix prefill per prompt
+                 # rung, the CoW page copy once (size 0, warmed with
+                 # the prefix-cache leg)
+                 "verify": set(rep.get("verify_rungs") or ()),
+                 "prefill_ext": prefill_ext_rungs,
+                 "copy_page": {0} if prefill_ext_rungs else set()}
     for kind, size in compiled:
         rungs = rung_sets.get(kind)
         if rungs is None:
@@ -77,7 +109,7 @@ def lint_serve_report(rep: dict) -> List[Finding]:
 
     if warmed:
         seen = {k: {s for kk, s in compiled if kk == k}
-                for k in ("decode", "prefill")}
+                for k in rung_sets}
         for kind, rungs in rung_sets.items():
             missing = rungs - seen.get(kind, set())
             if missing:
@@ -117,4 +149,84 @@ def lint_serve_report(rep: dict) -> List[Finding]:
             "pools not donated because XLA:CPU does not support "
             "donation; the same engine donates automatically on "
             "TPU/GPU (donate='auto')"))
+    return out
+
+
+def lint_page_audit(audit: dict) -> List[Finding]:
+    """Page-accounting audit over a
+    :meth:`~mxnet_tpu.serve2.scheduler.DecodeEngine.page_audit`
+    snapshot (see module docstring). An in-flight admission
+    (``admitting`` > 0) legitimately holds references no block table
+    shows yet, so attribution mismatches downgrade to info in that
+    window; structural violations (reachable-but-freed page, null or
+    duplicate page in a table, shared write target) are errors
+    regardless."""
+    p = ServeLint()
+    obj = str(audit.get("name", "<engine>"))
+    out: List[Finding] = []
+    page_size = int(audit.get("page_size", 1))
+    refs = {int(k): int(v)
+            for k, v in (audit.get("refcounts") or {}).items()}
+    seqs = audit.get("sequences") or {}
+    cache_pages = [int(c) for c in (audit.get("cache_pages") or ())]
+    admitting = int(audit.get("admitting", 0))
+
+    holders = Counter(cache_pages)
+    for sid, s in seqs.items():
+        pages = [int(q) for q in s.get("pages", ())]
+        if 0 in pages:
+            out.append(p.finding(
+                "null-page-in-table", obj, "error",
+                f"sequence {sid} holds the reserved null page 0 — "
+                "masked/dead writes would corrupt every sequence "
+                "sharing that scratch space"))
+        dup = [q for q, n in Counter(pages).items() if n > 1 and q != 0]
+        if dup:
+            out.append(p.finding(
+                "dup-page-in-table", obj, "error",
+                f"sequence {sid} references page(s) {sorted(dup)} more "
+                "than once — one position's write would clobber "
+                "another's history"))
+        for q in pages:
+            if q != 0 and refs.get(q, 0) < 1:
+                out.append(p.finding(
+                    "freed-page-reachable", obj, "error",
+                    f"sequence {sid} reaches page {q} whose refcount "
+                    "is 0 — use-after-free: the allocator may hand "
+                    "that page to another sequence"))
+        holders.update(q for q in pages if q != 0)
+        # CoW contract: the page the NEXT token write lands in must
+        # not be shared (copy-on-write should have privatized it)
+        length = int(s.get("length", 0))
+        widx = length // page_size
+        if 0 <= widx < len(pages):
+            wp = pages[widx]
+            if refs.get(wp, 0) > 1:
+                out.append(p.finding(
+                    "shared-write-target", obj, "error",
+                    f"sequence {sid}'s next write (position {length}) "
+                    f"lands in page {wp} with refcount "
+                    f"{refs.get(wp, 0)} — shared pages are read-only; "
+                    "copy-on-write must run before the write"))
+    for q in cache_pages:
+        if refs.get(q, 0) < 1:
+            out.append(p.finding(
+                "freed-page-reachable", obj, "error",
+                f"prefix cache indexes page {q} whose refcount is 0 — "
+                "a lookup would hand out a page the allocator already "
+                "recycled"))
+    for q, r in sorted(refs.items()):
+        h = holders.get(q, 0)
+        if h == r:
+            continue
+        sev = "info" if admitting > 0 else "error"
+        what = ("leaked reference(s): nothing reachable holds them"
+                if r > h else
+                "more holders than references: a free raced a share")
+        out.append(p.finding(
+            "refcount-mismatch", obj, sev,
+            f"page {q}: refcount {r} vs {h} reachable holder(s) — "
+            f"{what}"
+            + (" (an admission is in flight; re-audit at idle)"
+               if admitting > 0 else "")))
     return out

@@ -220,6 +220,31 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, {
                 "models": [e.stats()
                            for _, e in ep.registry.items()]})
+        if path.startswith("/v1/models/") and path.endswith(":audit"):
+            # serve3 page-accounting audit: refcount/block-table/
+            # prefix-cache cross-check as servelint findings (decode
+            # engines only — others have no paged pool to audit)
+            name = path[len("/v1/models/"):-len(":audit")]
+            try:
+                engine = ep.registry.get(name)
+            except MXNetError as e:
+                return self._send(404, {"error": str(e)})
+            # a routed model audits every replica through its router
+            # (RoutedModel.audit_report); a bare decode engine exposes
+            # its own page_audit snapshot
+            report = getattr(engine, "audit_report", None)
+            if callable(report):
+                return self._send(200, dict(report(), model=name))
+            audit = getattr(engine, "page_audit", None)
+            if not callable(audit):
+                return self._send(400, {
+                    "error": f"model {name!r} has no paged KV pool "
+                             "to audit"})
+            from ..passes.servelint import lint_page_audit
+            snapshot = audit()
+            findings = [f.to_dict() for f in lint_page_audit(snapshot)]
+            return self._send(200, {"model": name, "audit": snapshot,
+                                    "findings": findings})
         if path.startswith("/v1/models/"):
             name = path[len("/v1/models/"):]
             try:

@@ -25,6 +25,16 @@ production tier above and beside it:
   reload with version pinning in the
   :class:`~mxnet_tpu.serve.endpoint.ModelRegistry`.
 
+serve3 (ISSUE 12) adds three independently-gated legs on this
+substrate: **prefix caching** (:mod:`~mxnet_tpu.serve2.prefix` —
+content-hashed refcounted pages shared across requests, copy-on-write
+on shared writes), **speculative decoding** (a small draft model
+proposes K tokens, :meth:`PagedLM.verify` checks them in ONE batched
+target forward with exact greedy acceptance), and **quantized KV
+pages** (``kv_dtype="int8"/"bf16"`` pools with per-slot dequant
+scales). ``MXSERVE3_*`` flags gate each leg; ``bench.py --serving3``
+measures them per leg.
+
 Non-autoregressive (CNN) models keep serving through
 :class:`~mxnet_tpu.serve.engine.ServingEngine`; the router mixes both
 behind one front door. ``tools/mxserve.py route|reload|loadgen --qps``
@@ -34,7 +44,8 @@ docs/serving.md has the v2 architecture and runbook.
 """
 from .kvcache import (BlockTable, PageAllocator,  # noqa: F401
                       PagePoolExhausted, pages_needed)
-from .decode import PagedLM, decode_rungs_for  # noqa: F401
+from .prefix import PrefixCache, page_keys  # noqa: F401
+from .decode import KV_DTYPES, PagedLM, decode_rungs_for  # noqa: F401
 from .scheduler import (DecodeEngine, EngineCrashedError,  # noqa: F401
                         GenerationHandle)
 from .router import (AllReplicasUnavailable, RoutedModel,  # noqa: F401
@@ -42,6 +53,7 @@ from .router import (AllReplicasUnavailable, RoutedModel,  # noqa: F401
 
 __all__ = [
     "BlockTable", "PageAllocator", "PagePoolExhausted", "pages_needed",
+    "PrefixCache", "page_keys", "KV_DTYPES",
     "PagedLM", "decode_rungs_for", "DecodeEngine", "EngineCrashedError",
     "GenerationHandle",
     "Router", "RoutedModel", "AllReplicasUnavailable",

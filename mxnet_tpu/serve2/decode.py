@@ -1,4 +1,4 @@
-"""Compiled prefill / decode-step programs over the in-repo LM stack.
+"""Compiled prefill / decode / verify programs over the in-repo LM stack.
 
 The model is :func:`~mxnet_tpu.parallel.pipeline_lm.init_pipeline_lm`'s
 pre-LN decoder stack (causal MHA + top-1 MoE FFN) — the same parameters
@@ -9,27 +9,49 @@ in incremental form over a paged KV-cache:
   causal forward over the padded prompt, per-layer K/V scattered into
   the page pool through the sequence's block table, next token from the
   logits at the last real position.
+- :meth:`PagedLM.prefill_ext` — the prefix-cache-hit variant (serve3):
+  only the UNCACHED suffix of a prompt is computed; the cached prefix
+  is read back through the (possibly quantized) pool, so a prompt that
+  shares ``start`` positions with an earlier request pays compute for
+  ``len(prompt) - start`` tokens instead of all of them.
 - :meth:`PagedLM.decode` — ONE program per batch rung: embed the last
   token of every in-flight sequence, write its K/V at ``length``, run
-  :func:`~mxnet_tpu.parallel.paged_attention.paged_attention` (the
-  ring-attention-style online softmax over the page axis), FFN, head,
-  greedy argmax. All shapes — ``(max_batch,)`` scalars, the
-  ``(max_batch, max_pages)`` block table, the page pools — are FIXED,
-  so continuous batching never retraces.
+  :func:`~mxnet_tpu.parallel.paged_attention.paged_attention`, FFN,
+  head, greedy argmax — ``decode_steps`` iterations folded in-device.
+- :meth:`PagedLM.verify` — the speculative-decoding target step
+  (serve3): W candidate tokens per row (last accepted token + K draft
+  proposals) verified in ONE batched causal forward; the longest
+  draft prefix agreeing with the target's own greedy argmax is
+  accepted plus one corrected token, computed in-device, and REJECTED
+  candidates' K/V writes are routed to the null page — greedy
+  acceptance is exact, so the emitted trajectory is token-for-token
+  the target's own.
+- :meth:`PagedLM.copy_page` — copy-on-write support: duplicate one
+  page's slots (and dequant scales) into a private page before a write
+  would touch a shared (refcount > 1) page.
 
-Both programs take the page pools as donated arguments (off-CPU), so
-XLA reuses the pool HBM in place instead of double-buffering ~the whole
-KV footprint; every call returns the new pools and the caller threads
-them forward. Compiled signatures feed the PR-2 recompile auditor under
-kind ``serving2``; after :meth:`warmup` any new signature trips
-``mxserve2_recompile_after_warmup_total`` — the alarm servelint and the
-soak test keep at 0.
+All shapes are FIXED per rung, so continuous batching never retraces.
+Pools may be stored ``f32``, ``bf16``, or ``int8`` with per-slot
+dequant scales (``kv_dtype=``, quantize-on-append — serve3's
+capacity lever: int8 fits ~4x the cached positions per pool byte);
+reads dequantize inside the attention gather, and quantized results
+sit in the ``quant_*`` tolerance classes of :mod:`mxnet_tpu.opt.verify`.
 
-Parity contract (test-enforced): greedy decode through this cache
-matches one-sequence-at-a-time ``dense_lm_logits`` decode token-for-
-token, with logits inside the ``fusion`` tolerance class of
-:mod:`mxnet_tpu.opt.verify` (online softmax reassociates reductions —
-same class, same reason, as the fused-attention rewrite).
+Both programs take the page pools as ONE donated pytree argument
+(off-CPU), so XLA reuses the pool HBM in place instead of
+double-buffering ~the whole KV footprint; every call returns the new
+pools and the caller threads them forward. Compiled signatures feed the
+PR-2 recompile auditor under kind ``serving2``; after :meth:`warmup`
+any new signature trips ``mxserve2_recompile_after_warmup_total`` — the
+alarm servelint and the soak test keep at 0.
+
+Parity contract (test-enforced): greedy decode through this cache —
+including the prefix-cached and speculative paths — matches
+one-sequence-at-a-time ``dense_lm_logits`` decode token-for-token, with
+logits inside the ``fusion`` tolerance class of
+:mod:`mxnet_tpu.opt.verify` for f32 pools (online softmax reassociates
+reductions) and the ``quant_bf16``/``quant_int8`` classes for quantized
+pools.
 """
 from __future__ import annotations
 
@@ -43,13 +65,17 @@ import numpy as onp
 from ..base import MXNetError
 from ..telemetry import metrics as _metrics
 from ..telemetry import recompile as _recompile
-from ..parallel.paged_attention import (paged_attention,
+from ..parallel.paged_attention import (_deq, paged_attention,
                                         paged_attention_flat)
 # the oracle's norm, not a copy: token-for-token parity with
 # dense_lm_logits must survive any future change to the eps/form
 from ..parallel.pipeline_lm import _rmsnorm
 
-__all__ = ["PagedLM", "decode_rungs_for"]
+__all__ = ["PagedLM", "decode_rungs_for", "KV_DTYPES"]
+
+KV_DTYPES = ("f32", "bf16", "int8")
+_KV_JNP = {"f32": jnp.float32, "bf16": jnp.bfloat16, "int8": jnp.int8}
+_KV_ITEMSIZE = {"f32": 4, "bf16": 2, "int8": 1}
 
 
 def decode_rungs_for(max_inflight: int) -> Tuple[int, ...]:
@@ -81,8 +107,30 @@ def _moe_ffn(lp, hn):
     return jnp.einsum("...e,e...d->...d", top1, y)
 
 
+def _q_write(kv_dtype: str, pool, scales, slot, rows):
+    """Quantize-on-append: write ``rows`` (..., H, K) at ``slot``
+    (...,). int8 stores a per-slot absmax scale (the page-granular
+    dequant metadata — one f32 per cached position per layer); bf16
+    narrows in place; f32 writes through. Returns (pool, scales)."""
+    if kv_dtype == "int8":
+        amax = jnp.max(jnp.abs(rows), axis=(-2, -1))
+        s = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(rows / s[..., None, None]),
+                     -127, 127).astype(jnp.int8)
+        return pool.at[slot].set(q), scales.at[slot].set(s)
+    return pool.at[slot].set(rows.astype(pool.dtype)), scales
+
+
+def _deq_rows(kv_dtype: str, pool, scales, idx):
+    """Gather + dequantize pool rows at ``idx``: (..., H, K) f32 —
+    the same dequant rule as the paged_attention gather (ONE
+    implementation; a scale-layout change lands everywhere at once)."""
+    return _deq(pool[idx],
+                scales[idx] if kv_dtype == "int8" else None)
+
+
 class PagedLM:
-    """One LM + one page pool + the two compiled serving programs.
+    """One LM + one page pool + the compiled serving programs.
 
     Parameters
     ----------
@@ -91,15 +139,21 @@ class PagedLM:
     max_pages_per_seq : block-table width — caps sequence length at
         ``max_pages_per_seq * page_size`` cached positions.
     donate : "auto" (donate pools off-CPU), "on", "off".
+    kv_dtype : "f32" (default), "bf16", or "int8" page pools (int8
+        carries per-slot dequant scales; quantize-on-append).
     """
 
     def __init__(self, params: Dict, *, page_size: int, num_pages: int,
                  max_pages_per_seq: int, donate: str = "auto",
                  decode_steps: int = 1, attention: str = "auto",
-                 name: str = "lm"):
+                 kv_dtype: str = "f32", name: str = "lm"):
         self.name = name
         if attention not in ("auto", "scan", "flat"):
             raise MXNetError("attention must be auto/scan/flat")
+        if kv_dtype not in KV_DTYPES:
+            raise MXNetError(
+                f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
         self.max_pages = int(max_pages_per_seq)
@@ -132,16 +186,32 @@ class PagedLM:
             donate == "auto" and self.backend != "cpu")
         slots = self.num_pages * self.page_size
         pool_shape = (self.n_layers, slots, self.n_heads, self.d_head)
-        self.kpool = jnp.zeros(pool_shape, jnp.float32)
-        self.vpool = jnp.zeros(pool_shape, jnp.float32)
-        self.pool_bytes = 2 * int(onp.prod(pool_shape)) * 4
-        dn = (1, 2) if self.donate_pages else ()
+        pdt = _KV_JNP[kv_dtype]
+        self.pools = {"k": jnp.zeros(pool_shape, pdt),
+                      "v": jnp.zeros(pool_shape, pdt)}
+        if kv_dtype == "int8":
+            self.pools["ks"] = jnp.zeros((self.n_layers, slots),
+                                         jnp.float32)
+            self.pools["vs"] = jnp.zeros((self.n_layers, slots),
+                                         jnp.float32)
+        self.pool_bytes = self.pool_bytes_for(
+            page_size=self.page_size, num_pages=self.num_pages,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            d_head=self.d_head, kv_dtype=kv_dtype)
+        dn = (1,) if self.donate_pages else ()
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=dn)
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=dn)
+        self._prefill_ext_jit = jax.jit(self._prefill_ext_fn,
+                                        donate_argnums=dn)
+        self._verify_jit = jax.jit(self._verify_fn, donate_argnums=dn)
+        self._copy_page_jit = jax.jit(
+            self._copy_page_fn,
+            donate_argnums=(0,) if self.donate_pages else ())
         self._lock = threading.Lock()
         self._seen: set = set()
         self._warmed = False
-        self._warmed_rungs: dict = {"decode": (), "prefill": ()}
+        self._warmed_rungs: dict = {"decode": (), "prefill": (),
+                                    "prefill_ext": (), "verify": ()}
         self._after_warmup = 0
         self._m_after = _metrics.counter(
             "mxserve2_recompile_after_warmup_total",
@@ -149,30 +219,55 @@ class PagedLM:
             "declared the cache closed — should stay 0")
 
     # ------------------------------------------------------------------
+    # pool geometry helpers (bench / capacity tests)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def pool_bytes_for(*, page_size: int, num_pages: int, n_layers: int,
+                       n_heads: int, d_head: int,
+                       kv_dtype: str = "f32") -> int:
+        """Device bytes of the K+V pools (scale metadata included)."""
+        slots = int(num_pages) * int(page_size)
+        per = _KV_ITEMSIZE[kv_dtype]
+        b = 2 * int(n_layers) * slots * int(n_heads) * int(d_head) * per
+        if kv_dtype == "int8":
+            b += 2 * int(n_layers) * slots * 4  # f32 per-slot scales
+        return b
+
+    @staticmethod
+    def pages_for_bytes(budget_bytes: int, *, page_size: int,
+                        n_layers: int, n_heads: int, d_head: int,
+                        kv_dtype: str = "f32") -> int:
+        """Largest ``num_pages`` whose pool fits ``budget_bytes`` —
+        the equal-pool-bytes capacity comparison across kv dtypes."""
+        per_page = PagedLM.pool_bytes_for(
+            page_size=page_size, num_pages=1, n_layers=n_layers,
+            n_heads=n_heads, d_head=d_head, kv_dtype=kv_dtype)
+        return max(0, int(budget_bytes) // per_page)
+
+    # ------------------------------------------------------------------
     # jitted bodies
     # ------------------------------------------------------------------
-    def _decode_fn(self, params, kpool, vpool, bt, lengths, tokens,
-                   remaining):
+    def _scales(self, pools):
+        if self.kv_dtype == "int8":
+            return pools["ks"], pools["vs"]
+        return None, None
+
+    def _decode_fn(self, params, pools, bt, lengths, tokens, remaining):
         """``decode_steps`` greedy tokens for every slot, entirely
         in-device. bt (B, N) int32; lengths/tokens/remaining (B,)
         int32 — row i is active for loop steps ``s < remaining[i]``
-        (0 = dead row). Returns (kpool, vpool, out_tokens (B, K),
+        (0 = dead row). Returns (pools, out_tokens (B, K),
         last_logits (B, V)); callers take ``out[i, :remaining[i]]``.
-
-        CAVEAT (K > 1): last_logits come from the FINAL loop step, so
-        row i's slice is only meaningful when ``remaining[i] == K`` —
-        a row that finished earlier in the window was inactive for the
-        later steps (stale token, attention masked to length 0) and its
-        logits are garbage. Valid token ids are unaffected; a logprob/
-        score surface would need per-row logit capture at
-        ``s == remaining[i] - 1`` first.
-        """
+        last_logits row i is captured at that row's TRUE final step
+        ``s == remaining[i] - 1`` — valid for every live row, whatever
+        its window (rows with ``remaining[i] == 0`` are garbage)."""
         page = self.page_size
         K_steps = self.decode_steps
         scale = 1.0 / (self.d_head ** 0.5)
         B = tokens.shape[0]
+        int8 = self.kv_dtype == "int8"
 
-        def one_token(kpool, vpool, toks, s):
+        def one_token(pools, toks, s):
             act = s < remaining
             pos = lengths + s
             # inactive steps write into the null page's scratch slots —
@@ -187,51 +282,61 @@ class PagedLM:
             h = params["embed"][toks]                     # (B, D)
 
             def body(hc, xs):
-                lp, kp, vp = xs
+                lp, pl = xs
                 hn = _rmsnorm(hc, lp["ln1"])
                 qkv = jnp.einsum("bd,cdhk->cbhk", hn, lp["wqkv"])
-                kp = kp.at[slot].set(qkv[1])
-                vp = vp.at[slot].set(qkv[2])
+                kp, ks = _q_write(self.kv_dtype, pl["k"],
+                                  pl.get("ks"), slot, qkv[1])
+                vp, vs = _q_write(self.kv_dtype, pl["v"],
+                                  pl.get("vs"), slot, qkv[2])
                 ctx = self._attend(qkv[0], kp, vp, bt, att_len,
-                                   page_size=page, scale=scale)
+                                   page_size=page, scale=scale,
+                                   kscale=ks if int8 else None,
+                                   vscale=vs if int8 else None)
                 hc = hc + jnp.einsum("bhk,hkd->bd", ctx, lp["wo"])
                 hn2 = _rmsnorm(hc, lp["ln2"])
                 hc = hc + _moe_ffn(lp, hn2)
-                return hc, (kp, vp)
+                npl = {"k": kp, "v": vp}
+                if int8:
+                    npl["ks"], npl["vs"] = ks, vs
+                return hc, npl
 
-            h, (kpool, vpool) = jax.lax.scan(
-                body, h, (params["layers"], kpool, vpool))
+            h, pools = jax.lax.scan(body, h, (params["layers"], pools))
             h = _rmsnorm(h, params["ln_f"])
             logits = jnp.einsum("bd,dv->bv", h, params["head"])
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return kpool, vpool, nxt, logits
+            return pools, nxt, logits
 
         if K_steps == 1:
-            kpool, vpool, nxt, logits = one_token(kpool, vpool,
-                                                  tokens, 0)
-            return kpool, vpool, nxt[:, None], logits
+            pools, nxt, logits = one_token(pools, tokens, 0)
+            return pools, nxt[:, None], logits
 
         def step(s, carry):
-            kpool, vpool, toks, out, logits = carry
-            kpool, vpool, nxt, logits = one_token(kpool, vpool, toks, s)
+            pools, toks, out, logits_out = carry
+            pools, nxt, logits = one_token(pools, toks, s)
             out = jax.lax.dynamic_update_slice_in_dim(
                 out, nxt[:, None], s, axis=1)
-            return kpool, vpool, nxt, out, logits
+            # per-row final-step capture: row i's logits freeze at its
+            # own last active step, not the loop's last iteration —
+            # rows finishing mid-window stay valid (the PR-8 gap)
+            logits_out = jnp.where((s == remaining - 1)[:, None],
+                                   logits, logits_out)
+            return pools, nxt, out, logits_out
 
-        init = (kpool, vpool, tokens,
+        init = (pools, tokens,
                 jnp.zeros((B, K_steps), jnp.int32),
                 jnp.zeros((B, self.vocab), jnp.float32))
-        kpool, vpool, _, out, logits = jax.lax.fori_loop(
-            0, K_steps, step, init)
-        return kpool, vpool, out, logits
+        pools, _, out, logits = jax.lax.fori_loop(0, K_steps, step, init)
+        return pools, out, logits
 
-    def _prefill_fn(self, params, kpool, vpool, bt_row, length, tokens):
+    def _prefill_fn(self, params, pools, bt_row, length, tokens):
         """Full causal forward over ONE padded prompt. tokens (T,)
         int32, length scalar int32 (real prompt length), bt_row (N,)
-        int32. Returns (kpool, vpool, next_token, last_logits)."""
+        int32. Returns (pools, next_token, last_logits)."""
         page = self.page_size
         T = tokens.shape[0]
         scale = 1.0 / (self.d_head ** 0.5)
+        int8 = self.kv_dtype == "int8"
         pos = jnp.arange(T, dtype=jnp.int32)
         valid = pos < length
         slot = jnp.where(valid,
@@ -241,12 +346,14 @@ class PagedLM:
         h = params["embed"][tokens]                       # (T, D)
 
         def body(hc, xs):
-            lp, kp, vp = xs
+            lp, pl = xs
             hn = _rmsnorm(hc, lp["ln1"])
             qkv = jnp.einsum("td,cdhk->cthk", hn, lp["wqkv"])
             q, k, v = qkv[0], qkv[1], qkv[2]
-            kp = kp.at[slot].set(k)
-            vp = vp.at[slot].set(v)
+            kp, ks = _q_write(self.kv_dtype, pl["k"], pl.get("ks"),
+                              slot, k)
+            vp, vs = _q_write(self.kv_dtype, pl["v"], pl.get("vs"),
+                              slot, v)
             logits = jnp.einsum("thk,shk->hts", q, k) * scale
             att = jax.nn.softmax(
                 jnp.where(causal, logits, -1e30), axis=-1)
@@ -254,15 +361,195 @@ class PagedLM:
             hc = hc + jnp.einsum("thk,hkd->td", ctx, lp["wo"])
             hn2 = _rmsnorm(hc, lp["ln2"])
             hc = hc + _moe_ffn(lp, hn2)
-            return hc, (kp, vp)
+            npl = {"k": kp, "v": vp}
+            if int8:
+                npl["ks"], npl["vs"] = ks, vs
+            return hc, npl
 
-        h, (kpool, vpool) = jax.lax.scan(
-            body, h, (params["layers"], kpool, vpool))
+        h, pools = jax.lax.scan(body, h, (params["layers"], pools))
         h = _rmsnorm(h, params["ln_f"])
         logits = jnp.einsum("td,dv->tv", h, params["head"])
         last = jnp.take(logits, length - 1, axis=0)
         nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-        return kpool, vpool, nxt, last
+        return pools, nxt, last
+
+    def _prefill_ext_fn(self, params, pools, bt_row, start, length,
+                        tokens):
+        """Suffix prefill over cached history (prefix-cache hit):
+        ``tokens`` (T,) is the UNCACHED suffix padded to a rung,
+        ``start`` the cached position count (whole pages by the
+        prefix-cache construction), ``length`` the valid suffix length.
+        Suffix K/V are appended to the pool; each suffix position
+        attends to the cached prefix THROUGH the (dequantized) pool and
+        to earlier suffix positions in-register. Returns
+        (pools, next_token, last_logits)."""
+        page = self.page_size
+        T = tokens.shape[0]
+        scale = 1.0 / (self.d_head ** 0.5)
+        int8 = self.kv_dtype == "int8"
+        t = jnp.arange(T, dtype=jnp.int32)
+        posq = start + t
+        valid = t < length
+        slot = jnp.where(
+            valid,
+            bt_row[jnp.clip(posq // page, 0, bt_row.shape[0] - 1)]
+            * page + posq % page,
+            posq % page)
+        offs = jnp.arange(page, dtype=jnp.int32)
+        widx = (bt_row[:, None] * page + offs[None, :]).reshape(-1)
+        wpos = jnp.arange(widx.shape[0], dtype=jnp.int32)
+        # history mask: cached positions only ([0, start)); the suffix
+        # itself is attended in-register for exact f32 self-attention
+        m_hist = valid[:, None] & (wpos[None, :] < start)      # (T, Sw)
+        m_suf = (valid[:, None] & valid[None, :]
+                 & (t[None, :] <= t[:, None]))                 # (T, T)
+        mask = jnp.concatenate([m_hist, m_suf], axis=1)
+        h = params["embed"][tokens]                            # (T, D)
+
+        def body(hc, xs):
+            lp, pl = xs
+            hn = _rmsnorm(hc, lp["ln1"])
+            qkv = jnp.einsum("td,cdhk->cthk", hn, lp["wqkv"])
+            q, k, v = qkv[0], qkv[1], qkv[2]
+            kp, ks = _q_write(self.kv_dtype, pl["k"], pl.get("ks"),
+                              slot, k)
+            vp, vs = _q_write(self.kv_dtype, pl["v"], pl.get("vs"),
+                              slot, v)
+            k_hist = _deq_rows(self.kv_dtype, pl["k"], pl.get("ks"),
+                               widx)                       # (Sw, H, K)
+            v_hist = _deq_rows(self.kv_dtype, pl["v"], pl.get("vs"),
+                               widx)
+            lg = jnp.concatenate(
+                [jnp.einsum("thk,shk->hts", q, k_hist),
+                 jnp.einsum("thk,uhk->htu", q, k)], axis=-1) * scale
+            att = jax.nn.softmax(
+                jnp.where(mask[None], lg, -1e30), axis=-1)
+            ctx = jnp.einsum("hts,shk->thk", att,
+                             jnp.concatenate([v_hist, v], axis=0))
+            hc = hc + jnp.einsum("thk,hkd->td", ctx, lp["wo"])
+            hn2 = _rmsnorm(hc, lp["ln2"])
+            hc = hc + _moe_ffn(lp, hn2)
+            npl = {"k": kp, "v": vp}
+            if int8:
+                npl["ks"], npl["vs"] = ks, vs
+            return hc, npl
+
+        h, pools = jax.lax.scan(body, h, (params["layers"], pools))
+        h = _rmsnorm(h, params["ln_f"])
+        logits = jnp.einsum("td,dv->tv", h, params["head"])
+        last = jnp.take(logits, length - 1, axis=0)
+        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return pools, nxt, last
+
+    def _verify_fn(self, params, pools, bt, lengths, cands, remaining):
+        """Speculative verify: cands (B, W) = [last accepted token,
+        draft_1..draft_{W-1}]; ONE causal forward over all W positions,
+        greedy acceptance computed in-device. ``remaining`` caps how
+        many tokens row i may emit this window (0 = dead row).
+
+        Returns (pools, out (B, W), accepted (B,), last_logits (B, V)):
+        row i emits ``out[i, :accepted[i]]`` — the accepted draft
+        prefix plus, when the budget allows, the target's corrected
+        token. K/V of candidates beyond the accepted window are routed
+        to the null page (never cached); accepted positions land at
+        ``lengths[i] + j`` through the block table."""
+        page = self.page_size
+        B, W = cands.shape
+        N = bt.shape[1]
+        scale = 1.0 / (self.d_head ** 0.5)
+        int8 = self.kv_dtype == "int8"
+        act = remaining > 0
+        offs = jnp.arange(page, dtype=jnp.int32)
+        widx = (bt.astype(jnp.int32)[:, :, None] * page
+                + offs[None, None, :]).reshape(B, -1)      # (B, Sw)
+        wpos = jnp.arange(widx.shape[1], dtype=jnp.int32)
+        w = jnp.arange(W, dtype=jnp.int32)
+        m_hist = jnp.broadcast_to(
+            (wpos[None, :] < lengths[:, None])[:, None, :],
+            (B, W, widx.shape[1]))
+        m_suf = jnp.broadcast_to(
+            jnp.tril(jnp.ones((W, W), bool))[None], (B, W, W))
+        mask = jnp.concatenate([m_hist, m_suf], axis=-1) \
+            & act[:, None, None]
+        h = params["embed"][cands]                         # (B, W, D)
+
+        def body(hc, xs):
+            lp, pl = xs
+            hn = _rmsnorm(hc, lp["ln1"])
+            qkv = jnp.einsum("bwd,cdhk->cbwhk", hn, lp["wqkv"])
+            q, k, v = qkv[0], qkv[1], qkv[2]               # (B,W,H,K)
+            k_hist = _deq_rows(self.kv_dtype, pl["k"], pl.get("ks"),
+                               widx)                       # (B,Sw,H,K)
+            v_hist = _deq_rows(self.kv_dtype, pl["v"], pl.get("vs"),
+                               widx)
+            lg = jnp.concatenate(
+                [jnp.einsum("bwhk,bshk->bhws", q, k_hist),
+                 jnp.einsum("bwhk,buhk->bhwu", q, k)], axis=-1) * scale
+            att = jax.nn.softmax(
+                jnp.where(mask[:, None], lg, -1e30), axis=-1)
+            ctx = jnp.einsum("bhws,bshk->bwhk", att,
+                             jnp.concatenate([v_hist, v], axis=1))
+            hc = hc + jnp.einsum("bwhk,hkd->bwd", ctx, lp["wo"])
+            hn2 = _rmsnorm(hc, lp["ln2"])
+            hc = hc + _moe_ffn(lp, hn2)
+            # suffix K/V ride out as ys: acceptance is only known after
+            # the head, and REJECTED rows must land on the null page —
+            # so writes happen in a second pass below, not here
+            return hc, (k, v)
+
+        h, (k_stack, v_stack) = jax.lax.scan(
+            body, h, (params["layers"], pools))
+        h = _rmsnorm(h, params["ln_f"])
+        logits = jnp.einsum("bwd,dv->bwv", h, params["head"])
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, W)
+        # greedy acceptance: draft_j survives iff the target's own
+        # greedy choice after position j-1 equals it, cumulatively
+        match = (cands[:, 1:] == g[:, :-1]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)    # (B,)
+        a = jnp.minimum(m + 1, remaining)                  # tokens out
+        shifted = jnp.concatenate(
+            [cands[:, 1:], jnp.zeros((B, 1), jnp.int32)], axis=1)
+        # emitted j<m: accepted draft_{j+1}; j==m: the corrected token
+        out = jnp.where(w[None, :] == m[:, None], g, shifted)
+        last = jnp.take_along_axis(
+            logits, jnp.clip(a - 1, 0, W - 1)[:, None, None],
+            axis=1)[:, 0]
+        # second pass: append accepted candidates' K/V through the
+        # block table; rejected/inactive ones go to null-page scratch
+        pos = lengths[:, None] + w[None, :]                # (B, W)
+        keep = (w[None, :] < a[:, None]) & act[:, None]
+        page_id = jnp.take_along_axis(
+            bt, jnp.clip(pos // page, 0, N - 1), axis=1)
+        slot = jnp.where(keep, page_id * page + pos % page,
+                         pos % page)
+
+        def wbody(_, xs):
+            pl, kn, vn = xs
+            kp, ks = _q_write(self.kv_dtype, pl["k"], pl.get("ks"),
+                              slot, kn)
+            vp, vs = _q_write(self.kv_dtype, pl["v"], pl.get("vs"),
+                              slot, vn)
+            npl = {"k": kp, "v": vp}
+            if int8:
+                npl["ks"], npl["vs"] = ks, vs
+            return None, npl
+
+        _, pools = jax.lax.scan(wbody, None,
+                                (pools, k_stack, v_stack))
+        return pools, out, a, last
+
+    def _copy_page_fn(self, pools, src, dst):
+        """Copy page ``src``'s slots (and scales) onto page ``dst`` —
+        the copy-on-write primitive. src/dst are traced scalars, so
+        this is ONE compiled program for the whole pool."""
+        page = self.page_size
+        offs = jnp.arange(page, dtype=jnp.int32)
+        s_idx = src * page + offs
+        d_idx = dst * page + offs
+        out = {}
+        for key, pool in pools.items():
+            out[key] = pool.at[:, d_idx].set(pool[:, s_idx])
+        return out
 
     # ------------------------------------------------------------------
     # recompile accounting
@@ -287,14 +574,13 @@ class PagedLM:
                tokens: onp.ndarray, remaining: onp.ndarray):
         """Run one decode tick (``decode_steps`` in-device iterations);
         returns (tokens (B, decode_steps), last_logits) as numpy — row
-        ``i``'s valid prefix is ``remaining[i]`` tokens. ``bt`` must be
-        (B, max_pages); B must be a warmed rung. With decode_steps > 1,
-        last_logits rows are only valid where ``remaining[i] ==
-        decode_steps`` (see the ``_decode_fn`` caveat)."""
+        ``i``'s valid prefix is ``remaining[i]`` tokens and its
+        last_logits row is from its own final active step. ``bt`` must
+        be (B, max_pages); B must be a warmed rung."""
         with self._lock:
             self._record("decode", bt.shape[0])
-            self.kpool, self.vpool, out, logits = self._decode_jit(
-                self.params, self.kpool, self.vpool,
+            self.pools, out, logits = self._decode_jit(
+                self.params, self.pools,
                 jnp.asarray(bt, jnp.int32),
                 jnp.asarray(lengths, jnp.int32),
                 jnp.asarray(tokens, jnp.int32),
@@ -307,16 +593,60 @@ class PagedLM:
         last_logits)."""
         with self._lock:
             self._record("prefill", tokens_padded.shape[0])
-            self.kpool, self.vpool, nxt, logits = self._prefill_jit(
-                self.params, self.kpool, self.vpool,
+            self.pools, nxt, logits = self._prefill_jit(
+                self.params, self.pools,
                 jnp.asarray(bt_row, jnp.int32),
                 jnp.int32(length),
                 jnp.asarray(tokens_padded, jnp.int32))
         return int(nxt), onp.asarray(logits)
 
-    def warmup(self, decode_rungs, prefill_rungs) -> List[dict]:
+    def prefill_ext(self, tokens_padded: onp.ndarray, start: int,
+                    length: int, bt_row: onp.ndarray):
+        """Suffix prefill after a prefix-cache hit: ``tokens_padded``
+        holds the uncached suffix padded to a rung, ``start`` cached
+        positions already sit in the pool through ``bt_row``. Returns
+        (next_token, last_logits)."""
+        with self._lock:
+            self._record("prefill_ext", tokens_padded.shape[0])
+            self.pools, nxt, logits = self._prefill_ext_jit(
+                self.params, self.pools,
+                jnp.asarray(bt_row, jnp.int32),
+                jnp.int32(start), jnp.int32(length),
+                jnp.asarray(tokens_padded, jnp.int32))
+        return int(nxt), onp.asarray(logits)
+
+    def verify(self, bt: onp.ndarray, lengths: onp.ndarray,
+               cands: onp.ndarray, remaining: onp.ndarray):
+        """Speculative verify of (B, W) candidate tokens; see
+        :meth:`_verify_fn`. Returns (out (B, W), accepted (B,),
+        last_logits (B, V)) as numpy."""
+        with self._lock:
+            self._record("verify", bt.shape[0])
+            self.pools, out, a, logits = self._verify_jit(
+                self.params, self.pools,
+                jnp.asarray(bt, jnp.int32),
+                jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(cands, jnp.int32),
+                jnp.asarray(remaining, jnp.int32))
+        return onp.asarray(out), onp.asarray(a), onp.asarray(logits)
+
+    def copy_page(self, src: int, dst: int):
+        """Copy-on-write: duplicate page ``src`` into ``dst`` in every
+        pool (K, V, scales)."""
+        with self._lock:
+            self._record("copy_page", 0)
+            self.pools = self._copy_page_jit(
+                self.pools, jnp.int32(src), jnp.int32(dst))
+
+    def warmup(self, decode_rungs, prefill_rungs, *,
+               verify_width: int = 0, prefill_ext: bool = False,
+               copy_page: bool = False) -> List[dict]:
         """AOT-compile every rung; afterwards any new signature is a
-        counted recompile (the serve/ warmup contract)."""
+        counted recompile (the serve/ warmup contract). serve3 programs
+        warm only when their legs are on: ``verify_width`` W > 0 warms
+        the speculative verify per decode rung, ``prefill_ext`` warms
+        the suffix-prefill per prefill rung, ``copy_page`` warms the
+        CoW copy."""
         import time
         report = []
         N = self.max_pages
@@ -326,22 +656,52 @@ class PagedLM:
                         onp.zeros((b,), "int32"),
                         onp.zeros((b,), "int32"),
                         onp.zeros((b,), "int32"))
-            jax.block_until_ready(self.kpool)
+            jax.block_until_ready(self.pools["k"])
             report.append({"program": "decode", "size": b,
                            "compile_ms": round(
                                (time.perf_counter() - t0) * 1e3, 3)})
+            if verify_width > 0:
+                t0 = time.perf_counter()
+                self.verify(onp.zeros((b, N), "int32"),
+                            onp.zeros((b,), "int32"),
+                            onp.zeros((b, verify_width), "int32"),
+                            onp.zeros((b,), "int32"))
+                jax.block_until_ready(self.pools["k"])
+                report.append({"program": "verify", "size": b,
+                               "compile_ms": round(
+                                   (time.perf_counter() - t0) * 1e3,
+                                   3)})
         for t in sorted(set(int(r) for r in prefill_rungs)):
             t0 = time.perf_counter()
             self.prefill(onp.zeros((t,), "int32"), 1,
                          onp.zeros((N,), "int32"))
-            jax.block_until_ready(self.kpool)
+            jax.block_until_ready(self.pools["k"])
             report.append({"program": "prefill", "size": t,
                            "compile_ms": round(
                                (time.perf_counter() - t0) * 1e3, 3)})
+            if prefill_ext:
+                t0 = time.perf_counter()
+                self.prefill_ext(onp.zeros((t,), "int32"), 0, 1,
+                                 onp.zeros((N,), "int32"))
+                jax.block_until_ready(self.pools["k"])
+                report.append({"program": "prefill_ext", "size": t,
+                               "compile_ms": round(
+                                   (time.perf_counter() - t0) * 1e3,
+                                   3)})
+        if copy_page:
+            t0 = time.perf_counter()
+            self.copy_page(0, 0)
+            jax.block_until_ready(self.pools["k"])
+            report.append({"program": "copy_page", "size": 0,
+                           "compile_ms": round(
+                               (time.perf_counter() - t0) * 1e3, 3)})
         self._warmed = True
+        dr = tuple(sorted(set(int(r) for r in decode_rungs)))
+        pr = tuple(sorted(set(int(r) for r in prefill_rungs)))
         self._warmed_rungs = {
-            "decode": tuple(sorted(set(int(r) for r in decode_rungs))),
-            "prefill": tuple(sorted(set(int(r) for r in prefill_rungs)))}
+            "decode": dr, "prefill": pr,
+            "verify": dr if verify_width > 0 else (),
+            "prefill_ext": pr if prefill_ext else ()}
         return report
 
     @property
@@ -360,9 +720,12 @@ class PagedLM:
             "warmed": self._warmed,
             "decode_rungs": self._warmed_rungs["decode"],
             "prefill_rungs": self._warmed_rungs["prefill"],
+            "verify_rungs": self._warmed_rungs["verify"],
+            "prefill_ext_rungs": self._warmed_rungs["prefill_ext"],
             "compiled": seen,
             "decode_steps": self.decode_steps,
             "attention": self.attention,
+            "kv_dtype": self.kv_dtype,
             "donate_mode": self.donate_mode,
             "donate_pages": self.donate_pages,
             "backend": self.backend,

@@ -22,13 +22,24 @@ on finish and on preemption. The allocator never compacts — pages are
 interchangeable by construction, which is exactly why fragmentation
 cannot exist in this layout.
 
+Pages are **refcounted** (serve3 prefix caching): ``alloc`` hands a
+page out at refcount 1, ``incref`` lets another holder (a second
+sequence sharing the same prompt prefix, or the
+:class:`~mxnet_tpu.serve2.prefix.PrefixCache` itself) pin it, and
+``free`` is a *decrement* — the page only returns to the free list when
+the last holder lets go. Shared pages are read-only by contract: a
+write into a page with refcount > 1 must go through copy-on-write
+(``passes/servelint`` audits this cross-checking refcounts against the
+live block tables).
+
 Occupancy telemetry (``mxserve2_pages_*`` gauges) feeds the PR-2
 metrics registry so the router/SLO layer can see pool pressure.
 """
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+from collections import Counter
+from typing import Dict, List, Optional
 
 import numpy as onp
 
@@ -72,6 +83,9 @@ class PageAllocator:
         # shadow set makes the double-free check O(1) per page
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._free_set = set(self._free)
+        # refcount per LIVE page (absent = free). free() decrements;
+        # the page re-enters the free list only at zero
+        self._ref: Dict[int, int] = {}
         # per-engine gauge names: multiple engines in one process must
         # not last-writer-win each other's pool-pressure signal
         tag = _gauge_tag(name)
@@ -107,34 +121,76 @@ class PageAllocator:
                     f"{len(self._free)} free of {self.num_pages - 1}")
             pages = [self._free.pop() for _ in range(n)]
             self._free_set.difference_update(pages)
+            for p in pages:
+                self._ref[p] = 1
             self._g_free.set(len(self._free))
         return pages
 
+    def incref(self, pages: List[int]) -> None:
+        """Pin already-live pages for an additional holder (prefix-
+        cache sharing). All-or-nothing: every id must be live."""
+        with self._lock:
+            for p in pages:
+                if self._ref.get(p, 0) < 1:
+                    raise MXNetError(
+                        f"incref of page {p} which is not allocated")
+            for p in pages:
+                self._ref[p] += 1
+
+    def refcount(self, page: int) -> int:
+        """Current holders of ``page`` (0 = free / never allocated)."""
+        with self._lock:
+            return self._ref.get(page, 0)
+
+    def refcounts(self) -> Dict[int, int]:
+        """Snapshot of every live page's refcount (servelint audit)."""
+        with self._lock:
+            return dict(self._ref)
+
     def free(self, pages: List[int]) -> None:
+        """Drop one reference per listed page; pages whose refcount
+        reaches zero return to the free list (LIFO). A page may appear
+        K times in one call if the caller really holds K references."""
         with self._lock:
             # validate the WHOLE list before touching the free list:
             # free is all-or-nothing like alloc, so a bad id midway
             # (e.g. from an inconsistent block table during crash
             # cleanup) cannot leave the operation half-applied and
             # leak the remaining pages
-            seen = set()
+            drops = Counter()
             for p in pages:
                 if not 0 < p < self.num_pages:
                     raise MXNetError(f"freeing invalid page id {p}")
-                if p in self._free_set or p in seen:
-                    raise MXNetError(f"double free of page {p}")
-                seen.add(p)
-            self._free.extend(pages)
-            self._free_set.update(pages)
+                drops[p] += 1
+            for p, n in drops.items():
+                if self._ref.get(p, 0) < n:
+                    raise MXNetError(
+                        f"double free of page {p} "
+                        f"(refcount {self._ref.get(p, 0)}, dropping {n})")
+            released = []
+            for p, n in drops.items():
+                self._ref[p] -= n
+                if self._ref[p] == 0:
+                    del self._ref[p]
+                    released.append(p)
+            self._free.extend(released)
+            self._free_set.update(released)
             self._g_free.set(len(self._free))
+
+    def shared_pages(self) -> int:
+        """Live pages with more than one holder (prefix-cache wins)."""
+        with self._lock:
+            return sum(1 for n in self._ref.values() if n > 1)
 
     def stats(self) -> dict:
         with self._lock:
             free = len(self._free)
+            shared = sum(1 for n in self._ref.values() if n > 1)
         return {"page_size": self.page_size,
                 "pages_total": self.num_pages - 1,
                 "pages_free": free,
-                "pages_used": self.num_pages - 1 - free}
+                "pages_used": self.num_pages - 1 - free,
+                "pages_shared": shared}
 
     def retire_gauges(self) -> None:
         """Unregister this pool's per-engine gauges (engine close)."""

@@ -9,9 +9,17 @@ duck type) built by the group's ``factory(version)`` — or
 ``factory(version, replica)`` when the factory accepts a second
 positional argument, which it should use to give every replica a
 UNIQUE engine name: per-engine gauges (page pool, in-flight/waiting
-sequences) are keyed by engine name, so same-named sibling replicas
-would overwrite each other's metrics, and closing one during a rolling
-reload would unregister gauges a live sibling still owns.
+sequences, serve3 prefix/acceptance counters) are keyed by engine
+name, so same-named sibling replicas would overwrite each other's
+metrics, and closing one during a rolling reload would unregister
+gauges a live sibling still owns. serve3 **draft/target groups** are
+ordinary groups whose factory builds
+``DecodeEngine(draft_params=..., spec_tokens=K)`` replicas — the
+draft rides inside the engine (shared block tables, one allocator),
+so routing, breakers, and rolling reload need no special cases, and a
+reload swaps draft and target atomically together (a version's draft
+can never verify against another version's target). :meth:`audit`
+exposes the group-wide page-accounting audit.
 
 Routing is queue-depth + breaker aware: each call picks the admitting
 replica with the shallowest queue (ties round-robin), wrapped in a
@@ -361,6 +369,34 @@ class Router:
     # ------------------------------------------------------------------
     # introspection / lifecycle
     # ------------------------------------------------------------------
+    def audit(self, model: Optional[str] = None) -> dict:
+        """serve3 page-accounting audit across a group's replicas (all
+        groups when ``model`` is None): every decode replica's
+        :meth:`~mxnet_tpu.serve2.scheduler.DecodeEngine.page_audit`
+        snapshot is run through
+        :func:`~mxnet_tpu.passes.servelint.lint_page_audit`. Replicas
+        without a paged pool (CNN engines) are skipped. A draft/target
+        group (factories building ``DecodeEngine(draft_params=...)``)
+        audits like any other — the draft shares the target's
+        allocator, so one audit covers both models' pages."""
+        from ..passes.servelint import lint_page_audit
+        models = [model] if model is not None else self.models()
+        out = {"findings": [], "replicas": {}}
+        for m in models:
+            for rep in self._group(m).replicas:
+                audit_fn = getattr(rep.engine, "page_audit", None)
+                if not callable(audit_fn):
+                    continue
+                snap = audit_fn()
+                findings = lint_page_audit(snap)
+                out["replicas"][rep.rname] = {
+                    "pages_used": len(snap.get("refcounts") or {}),
+                    "cache_pages": len(snap.get("cache_pages") or ()),
+                    "findings": len(findings),
+                }
+                out["findings"].extend(f.to_dict() for f in findings)
+        return out
+
     def frontend(self, model: str) -> "RoutedModel":
         """An engine-duck-typed facade over one group, registrable in a
         front ModelRegistry for the HTTP endpoint."""
@@ -426,6 +462,11 @@ class RoutedModel:
     def predict(self, data, timeout_ms: Optional[float] = None):
         return self._router.predict(self.model, data,
                                     timeout_ms=timeout_ms)
+
+    def audit_report(self) -> dict:
+        """The endpoint's ``GET /v1/models/<m>:audit`` hook: page-
+        accounting audit across every replica of this group."""
+        return self._router.audit(self.model)
 
     def stats(self) -> dict:
         g = self._router._group(self.model)

@@ -6,16 +6,33 @@ level — every scheduler tick:
 
 1. **admit** — pop waiting prompts while a batch slot and enough pages
    for the (re)prefill exist; one prefill program run per admit (padded
-   to the prompt rung ladder), which also emits the first token;
-2. **grow** — give every running sequence the page its next position
-   needs; on pool exhaustion, **preempt** the youngest running
-   sequence (free its pages, requeue it at the FRONT with its progress
-   folded into an effective prompt — recompute-style preemption, so a
-   preempted sequence's greedy trajectory is unchanged);
+   to the prompt rung ladder), which also emits the first token. With
+   **prefix caching** on (serve3), the effective prompt's full pages
+   are content-hashed first: cached pages are SHARED (refcounted,
+   read-only) and only the uncovered suffix runs through
+   ``prefill_ext`` — identical templated prompts across requests pay
+   prefill once. A fully-covered prompt copy-on-writes its final page
+   (``mxserve3_cow_copies``) and recomputes just the last position's
+   logits;
+2. **grow** — give every running sequence the page its next window
+   needs; a write that would land in a still-shared page goes through
+   copy-on-write first (structurally rare — shared pages are full by
+   construction — but the contract servelint audits). On pool
+   exhaustion, **evict** idle prefix-cache pages, then **preempt** the
+   youngest running sequence (free its pages, requeue it at the FRONT
+   with its progress folded into an effective prompt —
+   recompute-style preemption, so a preempted sequence's greedy
+   trajectory is unchanged);
 3. **step** — pack all running sequences into the smallest decode
-   batch rung and run ONE compiled decode step for everyone; append the
-   sampled tokens, then finish (free pages, resolve handles) sequences
-   that hit ``max_new_tokens`` / EOS / cancellation.
+   batch rung and run ONE compiled dispatch for everyone. Plain mode:
+   the n-step decode program. **Speculative mode** (serve3, a draft
+   model was given): the draft proposes K tokens per row in one small
+   dispatch, then the target verifies all candidates in ONE batched
+   forward (``PagedLM.verify``) — greedy acceptance is exact, so the
+   emitted trajectory is token-for-token the target's own; the
+   acceptance rate rides ``mxserve3_accept_rate_<engine>``. Append the
+   accepted tokens, then finish (free pages, resolve handles)
+   sequences that hit ``max_new_tokens`` / EOS / cancellation.
 
 Because admit/finish/preempt only edit host-side block tables, the
 device programs never see a new shape: the jit cache stays closed under
@@ -48,6 +65,7 @@ from ..serve.buckets import BucketOverflowError
 from .decode import PagedLM, decode_rungs_for
 from .kvcache import (BlockTable, PageAllocator, PagePoolExhausted,
                       pages_needed)
+from .prefix import PrefixCache, page_keys
 
 __all__ = ["DecodeEngine", "EngineCrashedError", "GenerationHandle"]
 
@@ -81,7 +99,7 @@ class GenerationHandle:
 
 class _Seq:
     __slots__ = ("sid", "prompt", "generated", "max_new", "bt",
-                 "handle", "admit_idx")
+                 "handle", "admit_idx", "_keys", "_keys_len")
 
     def __init__(self, sid: int, prompt: List[int], max_new: int):
         self.sid = sid
@@ -91,6 +109,12 @@ class _Seq:
         self.bt: Optional[BlockTable] = None
         self.handle = GenerationHandle(sid)
         self.admit_idx = -1  # monotone per (re)admission: preemption age
+        # memoized prefix-cache chain keys for the effective prompt of
+        # this length: a pool-pressure requeue retries admission every
+        # tick, and re-hashing the whole prompt each time would burn
+        # O(prompt) host work during exactly the overloaded periods
+        self._keys: List[bytes] = []
+        self._keys_len = -1
 
     def effective_prompt(self) -> List[int]:
         """Prompt for (re)prefill: original prompt plus progress — a
@@ -114,12 +138,33 @@ class DecodeEngine:
                  max_seq_len: Optional[int] = None,
                  decode_steps: Optional[int] = None,
                  attention: str = "auto",
+                 draft_params: Optional[Dict] = None,
+                 spec_tokens: Optional[int] = None,
+                 kv_dtype: Optional[str] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_pages: Optional[int] = None,
                  name: str = "lm", donate: str = "auto"):
         from .. import config
         self.name = name
         self.decode_steps = int(
             decode_steps if decode_steps is not None
             else config.get("MXSERVE2_DECODE_STEPS"))
+        # serve3 legs, each independently gated (flags or kwargs)
+        self.kv_dtype = str(
+            kv_dtype if kv_dtype is not None
+            else config.get("MXSERVE3_KV_DTYPE"))
+        self.spec_tokens = int(
+            spec_tokens if spec_tokens is not None
+            else config.get("MXSERVE3_SPEC_TOKENS"))
+        if draft_params is not None and self.spec_tokens < 1:
+            raise MXNetError(
+                "a draft model was given but spec_tokens resolves to "
+                f"{self.spec_tokens} — pass spec_tokens>=1 or set "
+                "MXSERVE3_SPEC_TOKENS")
+        self.spec = draft_params is not None and self.spec_tokens >= 1
+        self.prefix_enabled = bool(
+            prefix_cache if prefix_cache is not None
+            else config.get("MXSERVE3_PREFIX_CACHE"))
         self.page_size = int(page_size if page_size is not None
                              else config.get("MXSERVE2_PAGE_SIZE"))
         self.num_pages = int(num_pages if num_pages is not None
@@ -152,10 +197,41 @@ class DecodeEngine:
                           num_pages=self.num_pages,
                           max_pages_per_seq=self.max_pages_per_seq,
                           donate=donate, name=name,
-                          decode_steps=self.decode_steps,
-                          attention=attention)
+                          # speculative mode replaces the n-step decode
+                          # dispatch with propose/verify: the target's
+                          # decode program stays at 1 step (fallback
+                          # only, warmed but unused in steady state)
+                          decode_steps=(1 if self.spec
+                                        else self.decode_steps),
+                          attention=attention, kv_dtype=self.kv_dtype)
+        self.draft: Optional[PagedLM] = None
+        if self.spec:
+            dv = draft_params["head"].shape[1]
+            if int(dv) != int(self.lm.vocab):
+                raise MXNetError(
+                    f"draft vocab {dv} != target vocab {self.lm.vocab}")
+            # the draft shares the TARGET's block tables and page ids —
+            # its own (small) pools are indexed by the same slots, so
+            # one allocator runs both. decode_steps = K+1: the extra
+            # iteration exists to append the K-th draft token's own
+            # draft-KV, which the next tick's proposal run attends to
+            # when all K drafts get accepted. Draft pools stay f32 —
+            # they are ~(draft_layers/target_layers) of an already
+            # small pool, and draft quality is the acceptance rate.
+            self.draft = PagedLM(
+                draft_params, page_size=self.page_size,
+                num_pages=self.num_pages,
+                max_pages_per_seq=self.max_pages_per_seq,
+                donate=donate, name=f"{name}-draft",
+                decode_steps=self.spec_tokens + 1,
+                attention=attention, kv_dtype="f32")
         self.alloc = PageAllocator(self.num_pages, self.page_size,
                                    name=name)
+        self.prefix: Optional[PrefixCache] = None
+        if self.prefix_enabled:
+            cap = int(prefix_cache_pages if prefix_cache_pages is not None
+                      else config.get("MXSERVE3_PREFIX_CACHE_PAGES"))
+            self.prefix = PrefixCache(self.alloc, capacity_pages=cap)
         from ..serve.engine import InputSpec
         self.input_specs = [InputSpec((top_prefill,), "int32",
                                       name="tokens")]
@@ -176,6 +252,11 @@ class DecodeEngine:
         self._n_ticks = 0
         self._n_tokens = 0
         self._n_finished = 0
+        self._n_cow = 0
+        self._n_prefix_hits = 0
+        self._n_tokens_avoided = 0
+        self._n_spec_proposed = 0
+        self._n_spec_accepted = 0
         from .kvcache import _gauge_tag
         tag = _gauge_tag(name)
         self._m_inflight = _metrics.gauge(
@@ -191,6 +272,33 @@ class DecodeEngine:
             "mxserve2_ticks_total", "scheduler decode ticks")
         self._m_tokens = _metrics.counter(
             "mxserve2_tokens_total", "tokens generated by serve2")
+        # serve3 per-engine gauges (PR-8 per-engine-gauge class: keyed
+        # by engine name so sibling replicas never last-writer-win each
+        # other; ALL retired on close())
+        self._m_prefix_hits = _metrics.counter(
+            f"mxserve3_prefix_hits_{tag}",
+            f"admissions that reused >=1 cached prefix page in engine "
+            f"{name!r}")
+        self._m_pages_shared = _metrics.gauge(
+            f"mxserve3_prefix_pages_shared_{tag}",
+            f"live pages with more than one holder in engine {name!r}")
+        self._m_cow = _metrics.counter(
+            f"mxserve3_cow_copies_{tag}",
+            f"copy-on-write page copies in engine {name!r}")
+        self._m_tokens_avoided = _metrics.counter(
+            f"mxserve3_prefill_tokens_avoided_{tag}",
+            f"prompt positions served from the prefix cache instead of "
+            f"prefill compute in engine {name!r}")
+        self._m_spec_proposed = _metrics.counter(
+            f"mxserve3_spec_proposed_{tag}",
+            f"draft tokens proposed in engine {name!r}")
+        self._m_spec_accepted = _metrics.counter(
+            f"mxserve3_spec_accepted_{tag}",
+            f"draft tokens accepted by target verify in engine "
+            f"{name!r}")
+        self._m_accept_rate = _metrics.gauge(
+            f"mxserve3_accept_rate_{tag}",
+            f"cumulative draft-acceptance rate in engine {name!r}")
 
     # ------------------------------------------------------------------
     # intake
@@ -198,12 +306,26 @@ class DecodeEngine:
     def warmup(self, input_specs=None) -> List[dict]:
         """AOT-compile every decode batch rung and prefill length rung
         (the ``ServingEngine.warmup`` contract; ``input_specs`` is
-        accepted for duck-type compatibility and ignored)."""
-        return self.lm.warmup(self.decode_rungs, self.prefill_rungs)
+        accepted for duck-type compatibility and ignored). serve3 legs
+        warm their extra programs only when enabled, keeping the flags-
+        off warmup bill identical to PR 8."""
+        report = self.lm.warmup(
+            self.decode_rungs, self.prefill_rungs,
+            verify_width=(self.spec_tokens + 1 if self.spec else 0),
+            prefill_ext=self.prefix is not None,
+            copy_page=self.prefix is not None)
+        if self.draft is not None:
+            for row in self.draft.warmup(
+                    self.decode_rungs, self.prefill_rungs,
+                    prefill_ext=self.prefix is not None,
+                    copy_page=self.prefix is not None):
+                report.append(dict(row, program=f"draft-{row['program']}"))
+        return report
 
     @property
     def warmed(self) -> bool:
-        return self.lm.warmed
+        return self.lm.warmed and (self.draft is None
+                                   or self.draft.warmed)
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None
                ) -> GenerationHandle:
@@ -340,10 +462,6 @@ class DecodeEngine:
                         self._waiting.popleft()
                         self._resolve(cand)
                         continue
-                    eff = cand.effective_prompt()
-                    need = pages_needed(len(eff), self.page_size)
-                    if not self.alloc.can_alloc(need):
-                        break
                     self._waiting.popleft()
                     self._admitting += 1
                     seq = cand
@@ -351,16 +469,9 @@ class DecodeEngine:
             if seq is None:
                 break
             try:
-                bt = BlockTable(self.page_size)
-                bt.pages = self.alloc.alloc(need)
-                seq.bt = bt
-                rung = min(r for r in self.prefill_rungs
-                           if r >= len(eff))
-                padded = onp.zeros((rung,), "int32")
-                padded[:len(eff)] = eff
-                # device dispatch, lock released
-                nxt, _ = self.lm.prefill(padded, len(eff),
-                                         bt.row(self.max_pages_per_seq))
+                # prefix-cache lookup + page alloc + (suffix) prefill;
+                # device dispatches inside, lock released
+                admitted = self._admit_one(seq)
             except BaseException:
                 # put the seq back where _crash (via the caller's
                 # except) can see and fail it — never strand a handle
@@ -368,8 +479,15 @@ class DecodeEngine:
                     self._admitting -= 1
                     self._waiting.appendleft(seq)
                 raise
-            bt.length = len(eff)
-            seq.generated.append(int(nxt))
+            if not admitted:
+                # the pool cannot host this request right now, even
+                # after evicting idle prefix-cache pages: requeue at
+                # the FRONT (arrival order preserved) and stop
+                # admitting until decode progress frees pages
+                with self._cv:
+                    self._admitting -= 1
+                    self._waiting.appendleft(seq)
+                break
             with self._cv:
                 self._admitting -= 1
                 self._n_tokens += 1
@@ -379,20 +497,25 @@ class DecodeEngine:
                 self._finish_if_done(seq)
         # -- grow / preempt --------------------------------------------
         # each running sequence needs page capacity for its next
-        # decode WINDOW (min(decode_steps, tokens still wanted))
+        # dispatch WINDOW: decode_steps tokens plain, or the K drafts +
+        # 1 corrected token of a speculative propose/verify
+        win = (self.spec_tokens + 1) if self.spec else self.decode_steps
         with self._cv:
             for seq in list(self._running):
                 if seq not in self._running:
                     continue  # preempted below while growing another
-                want = min(self.decode_steps,
-                           seq.max_new - len(seq.generated))
+                want = min(win, seq.max_new - len(seq.generated))
                 while seq in self._running and seq.bt.needs_page(want):
                     try:
-                        seq.bt.pages.extend(self.alloc.alloc(1))
+                        seq.bt.pages.extend(self._grow_page())
                     except PagePoolExhausted:
                         victim = max(self._running,
                                      key=lambda s: s.admit_idx)
                         self._preempt(victim)
+                if self.prefix is not None and seq in self._running:
+                    # shared pages are read-only: CoW anything the
+                    # coming window would write into
+                    self._cow_guard(seq, want)
             seqs = sorted(self._running, key=lambda s: s.admit_idx)
         # -- decode window ----------------------------------------------
         if seqs:
@@ -407,13 +530,29 @@ class DecodeEngine:
                 s.bt.row(N, out=bt[i])
                 lengths[i] = s.bt.length
                 tokens[i] = s.generated[-1]
-                remaining[i] = min(self.decode_steps,
-                                   s.max_new - len(s.generated))
-            # device dispatch, lock released
-            out, _ = self.lm.decode(bt, lengths, tokens, remaining)
+                remaining[i] = min(win, s.max_new - len(s.generated))
+            # device dispatches, lock released
+            if self.spec:
+                # propose: ONE draft dispatch folds K+1 in-device
+                # iterations (the extra one appends the K-th draft
+                # token's own draft-KV for the next tick)
+                W = self.spec_tokens + 1
+                d_out, _ = self.draft.decode(bt, lengths, tokens,
+                                             remaining)
+                cands = onp.zeros((rung, W), "int32")
+                cands[:, 0] = tokens
+                cands[:, 1:] = d_out[:, :W - 1]
+                # verify: ONE batched target forward over all W
+                # candidates of every row — the single-dispatch-per-
+                # tick invariant, generalized from n-step
+                out, acc, _ = self.lm.verify(bt, lengths, cands,
+                                             remaining)
+            else:
+                out, _ = self.lm.decode(bt, lengths, tokens, remaining)
+                acc = remaining
             with self._cv:
                 for i, s in enumerate(seqs):
-                    taken = int(remaining[i])
+                    taken = int(acc[i])
                     new_toks = [int(t) for t in out[i, :taken]]
                     if self.eos_id is not None \
                             and self.eos_id in new_toks:
@@ -423,6 +562,23 @@ class DecodeEngine:
                     s.generated.extend(new_toks)
                     self._n_tokens += len(new_toks)
                     self._m_tokens.inc(len(new_toks))
+                if self.spec:
+                    # acceptance telemetry: drafts offered vs drafts
+                    # that survived verify (the corrected token is not
+                    # a draft, so budget-clamped rows may undercount
+                    # by one — telemetry, not accounting)
+                    proposed = int(onp.sum(onp.minimum(
+                        self.spec_tokens, remaining[:n])))
+                    accepted = int(onp.sum(onp.maximum(
+                        acc[:n].astype("int64") - 1, 0)))
+                    self._n_spec_proposed += proposed
+                    self._n_spec_accepted += accepted
+                    self._m_spec_proposed.inc(proposed)
+                    self._m_spec_accepted.inc(accepted)
+                    if self._n_spec_proposed:
+                        self._m_accept_rate.set(
+                            self._n_spec_accepted
+                            / self._n_spec_proposed)
                 for s in seqs:
                     self._finish_if_done(s)
         with self._cv:
@@ -430,6 +586,176 @@ class DecodeEngine:
             self._m_ticks.inc()
             self._m_inflight.set(len(self._running))
             self._m_waiting.set(len(self._waiting))
+            if self.prefix is not None:
+                self._m_pages_shared.set(self.alloc.shared_pages())
+
+    # ------------------------------------------------------------------
+    # admission / page management (serve3 prefix caching + CoW)
+    # ------------------------------------------------------------------
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages, evicting idle prefix-cache pages under
+        pressure; None when the pool genuinely cannot host them."""
+        try:
+            return self.alloc.alloc(n)
+        except PagePoolExhausted:
+            if self.prefix is None:
+                return None
+            missing = n - self.alloc.free_pages
+            if self.prefix.evict(max(1, missing)) <= 0:
+                return None
+            try:
+                return self.alloc.alloc(n)
+            except PagePoolExhausted:
+                return None
+
+    def _grow_page(self) -> List[int]:
+        """One more page for a running sequence; cache-evicting like
+        :meth:`_alloc_pages` but raising (the grow loop's preemption
+        path handles exhaustion)."""
+        got = self._alloc_pages(1)
+        if got is None:
+            raise PagePoolExhausted(
+                f"pool {self.name!r} exhausted (cache empty)")
+        return got
+
+    def _admit_one(self, seq: _Seq) -> bool:
+        """Allocate pages for ``seq`` — reusing cached prefix pages
+        when the prefix cache covers leading full pages of the
+        effective prompt — then run the (suffix) prefill and emit the
+        first token. Called with ``_cv`` RELEASED (compiled dispatches
+        inside). Returns False when the pool cannot host the request
+        even after evicting idle cache pages (caller requeues)."""
+        page = self.page_size
+        eff = seq.effective_prompt()
+        total = pages_needed(len(eff), page)
+        keys: List[bytes] = []
+        shared: List[int] = []
+        if self.prefix is not None:
+            if seq._keys_len != len(eff):
+                # effective prompt only changes across preemptions —
+                # retried admissions reuse the memoized chain keys
+                seq._keys = page_keys(eff, page)
+                seq._keys_len = len(eff)
+            keys = seq._keys
+            shared = self.prefix.lookup(keys)   # increfed for us
+        cow_src: Optional[int] = None
+        if shared and len(shared) * page == len(eff):
+            # FULL coverage: every position is cached, but the next
+            # token still needs the final position's logits — and its
+            # K/V write would land inside the last shared page. Pop it
+            # for copy-on-write and recompute just that one position
+            # into the private copy.
+            cow_src = shared.pop()
+        start = len(shared) * page
+        new_pages = self._alloc_pages(total - len(shared))
+        if new_pages is None:
+            undo = shared + ([cow_src] if cow_src is not None else [])
+            if undo:
+                self.alloc.free(undo)
+            return False
+        held = shared + new_pages \
+            + ([cow_src] if cow_src is not None else [])
+        try:
+            bt = BlockTable(page)
+            if cow_src is not None:
+                dst = new_pages[0]
+                self.lm.copy_page(cow_src, dst)
+                if self.draft is not None:
+                    self.draft.copy_page(cow_src, dst)
+                self.alloc.free([cow_src])      # drop our lookup ref
+                held.remove(cow_src)
+                bt.pages = shared + [dst] + new_pages[1:]
+                start = len(eff) - 1
+                self._n_cow += 1
+                self._m_cow.inc()
+            else:
+                bt.pages = shared + new_pages
+            # from here cleanup ownership moves to the block table
+            # (the crash path frees seq.bt.pages)
+            seq.bt = bt
+            bt_row = bt.row(self.max_pages_per_seq)
+            if start > 0:
+                suffix = eff[start:]
+                rung = min(r for r in self.prefill_rungs
+                           if r >= len(suffix))
+                padded = onp.zeros((rung,), "int32")
+                padded[:len(suffix)] = suffix
+                nxt, _ = self.lm.prefill_ext(padded, start,
+                                             len(suffix), bt_row)
+                if self.draft is not None:
+                    self.draft.prefill_ext(padded, start, len(suffix),
+                                           bt_row)
+                self._n_prefix_hits += 1
+                self._m_prefix_hits.inc()
+                self._n_tokens_avoided += start
+                self._m_tokens_avoided.inc(start)
+            else:
+                rung = min(r for r in self.prefill_rungs
+                           if r >= len(eff))
+                padded = onp.zeros((rung,), "int32")
+                padded[:len(eff)] = eff
+                nxt, _ = self.lm.prefill(padded, len(eff), bt_row)
+                if self.draft is not None:
+                    self.draft.prefill(padded, len(eff), bt_row)
+        except BaseException:
+            if seq.bt is None and held:
+                self.alloc.free(held)           # never leak references
+            raise
+        bt.length = len(eff)
+        seq.generated.append(int(nxt))
+        if self.prefix is not None:
+            # hit statistics land only when the admission LANDS — a
+            # pool-pressure requeue retries the lookup every tick, and
+            # counting those would report phantom hits forever.
+            # `start` is the EXACT positions saved (a CoW admission
+            # recomputes one), so both tokens_avoided surfaces agree
+            self.prefix.record_admission(
+                len(shared) + (1 if cow_src is not None else 0),
+                tokens_avoided=start)
+            if keys:
+                # index this admission's full pages for future sharing
+                # — their content was produced by prefill just now (or
+                # is the already-indexed shared prefix; register skips
+                # those)
+                self.prefix.register(keys, bt.pages[:len(keys)])
+            self._m_pages_shared.set(self.alloc.shared_pages())
+        return True
+
+    def _cow_guard(self, seq: _Seq, want: int) -> None:
+        """Copy-on-write anything the coming window would write into
+        that another holder shares. Structurally unreachable through
+        this scheduler (shared pages are always-FULL prefix pages and
+        writes land at ``pos >= length``), but the audited contract —
+        and the safety net for beam-style callers sharing mid-table
+        pages. Runs under ``_cv`` (holders cannot change mid-check);
+        the copy dispatch is tiny and fires ~never in steady state."""
+        page = self.page_size
+        want = max(1, int(want))
+        lo = seq.bt.length // page
+        hi = min((seq.bt.length + want - 1) // page,
+                 len(seq.bt.pages) - 1)
+        for idx in range(lo, hi + 1):
+            src = seq.bt.pages[idx]
+            if self.alloc.refcount(src) <= 1:
+                continue
+            got = self._alloc_pages(1)
+            if got is None:
+                victim = max(self._running, key=lambda s: s.admit_idx)
+                self._preempt(victim)
+                if victim is seq:
+                    return
+                got = self._alloc_pages(1)
+                if got is None:
+                    self._preempt(seq)
+                    return
+            dst = got[0]
+            self.lm.copy_page(src, dst)
+            if self.draft is not None:
+                self.draft.copy_page(src, dst)
+            seq.bt.pages[idx] = dst
+            self.alloc.free([src])
+            self._n_cow += 1
+            self._m_cow.inc()
 
     def _preempt(self, seq: _Seq):
         """Recompute-preemption: drop the cache, requeue at the front.
@@ -501,11 +827,24 @@ class DecodeEngine:
             thread = self._thread
         if thread is not None:
             thread.join(timeout=10.0)
+        # drop the prefix cache's page references so the pool accounts
+        # clean (shared pages a crashed cleanup already released would
+        # otherwise look leaked)
+        if self.prefix is not None:
+            try:
+                self.prefix.release_all()
+            except MXNetError:
+                pass
         # retire the per-engine-name gauges: after a rolling reload the
         # old version's pool must not linger in /metrics as a live one
         self.alloc.retire_gauges()
         _metrics.unregister(self._m_inflight.name)
         _metrics.unregister(self._m_waiting.name)
+        for m in (self._m_prefix_hits, self._m_pages_shared,
+                  self._m_cow, self._m_tokens_avoided,
+                  self._m_spec_proposed, self._m_spec_accepted,
+                  self._m_accept_rate):
+            _metrics.unregister(m.name)
 
     def stats(self) -> dict:
         with self._cv:
@@ -526,19 +865,75 @@ class DecodeEngine:
             "tokens_generated": self._n_tokens,
             "finished": self._n_finished,
             "draining": self._draining,
+            "kv_dtype": self.kv_dtype,
+            "pool_bytes": self.lm.pool_bytes,
         }
+        if self.prefix is not None:
+            pc = self.prefix.stats()
+            pc["cow_copies"] = self._n_cow
+            pc["pages_shared"] = self.alloc.shared_pages()
+            out["prefix_cache"] = pc
+            out["prefill_tokens_avoided"] = self._n_tokens_avoided
+        if self.spec:
+            out["spec"] = {
+                "spec_tokens": self.spec_tokens,
+                "proposed": self._n_spec_proposed,
+                "accepted": self._n_spec_accepted,
+                "acceptance_rate": (
+                    self._n_spec_accepted / self._n_spec_proposed
+                    if self._n_spec_proposed else None),
+            }
         rep = self.lm.lint_report()
-        out["recompiles_after_warmup"] = rep["recompiles_after_warmup"]
-        out["programs_compiled"] = len(rep["compiled"])
+        after = rep["recompiles_after_warmup"]
+        n_prog = len(rep["compiled"])
+        if self.draft is not None:
+            drep = self.draft.lint_report()
+            after += drep["recompiles_after_warmup"]
+            n_prog += len(drep["compiled"])
+        out["recompiles_after_warmup"] = after
+        out["programs_compiled"] = n_prog
         return out
+
+    def page_audit(self) -> dict:
+        """Page-accounting snapshot for the servelint audit: live
+        refcounts cross-checked against every reachable holder (the
+        running block tables and the prefix cache). ``admitting`` > 0
+        means an admission holds references not yet threaded into a
+        block table — the audit downgrades attribution mismatches to
+        info in that window."""
+        with self._cv:
+            # refcounts and cache pages are read INSIDE the same _cv
+            # window as the block tables: a tick finishing a sequence
+            # between the two reads would otherwise tear the snapshot
+            # and surface a phantom use-after-free (lock order
+            # _cv -> alloc/cache lock matches the scheduler's own)
+            seqs = {s.sid: {"pages": list(s.bt.pages),
+                            "length": int(s.bt.length)}
+                    for s in self._running if s.bt is not None}
+            admitting = self._admitting
+            refcounts = self.alloc.refcounts()
+            cache_pages = (self.prefix.cached_pages()
+                           if self.prefix is not None else [])
+        return {
+            "name": self.name,
+            "page_size": self.page_size,
+            "num_pages": self.num_pages,
+            "admitting": admitting,
+            "refcounts": refcounts,
+            "sequences": seqs,
+            "cache_pages": cache_pages,
+        }
 
     def lint_report(self) -> dict:
         """servelint's view: the PagedLM compile report plus the
-        scheduler's declared ladders."""
+        scheduler's declared ladders (draft report nested when
+        speculating)."""
         rep = self.lm.lint_report()
         rep["max_inflight"] = self.max_inflight
         rep["declared_decode_rungs"] = self.decode_rungs
         rep["declared_prefill_rungs"] = self.prefill_rungs
+        if self.draft is not None:
+            rep["draft"] = self.draft.lint_report()
         return rep
 
     def __repr__(self):

@@ -82,6 +82,61 @@ def test_bench_graph_opt_emits_mxopt_speedup():
 
 
 @pytest.mark.slow
+def test_bench_serving3_emits_mxserve3_speedup():
+    """--serving3 contract: one mxserve3_speedup JSON line — the
+    per-leg ablation matrix (prefix/spec/quant on/off) on templated +
+    unique mixes, greedy parity on every exact config, zero request
+    errors, zero after-warmup recompiles across every engine, the
+    open-loop p50/p99 rows, and the >=1.8x int8 capacity-at-equal-
+    bytes ratio. Reduced knobs keep this a contract check (shape +
+    invariants); the acceptance-scale >=2x speedup comes from the
+    default knobs."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_SERVE3_REQUESTS": "6",
+        "MXTPU_BENCH_SERVE3_MAX_NEW": "8",
+        "MXTPU_BENCH_SERVE3_DMODEL": "32",
+        "MXTPU_BENCH_SERVE3_LAYERS": "2",
+        "MXTPU_BENCH_SERVE3_INFLIGHT": "4",
+        "MXTPU_BENCH_SERVE3_PROMPT": "48",
+        "MXTPU_BENCH_SERVE3_TEMPLATE": "32",
+        "MXTPU_BENCH_SERVE3_SPEC_K": "2",
+        "MXTPU_BENCH_TIMEOUT": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--serving3"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxserve3_speedup"
+    assert data["errors"] == 0, data
+    assert data["recompiles_after_warmup"] == 0, data
+    assert data["parity_ok"] is True, data
+    assert data["value"] is not None and data["value"] > 0, data
+    assert data["quant_capacity_ratio"] >= 1.8, data
+    cfgs = data["configs"]
+    assert set(cfgs) == {"serve2_base", "prefix", "spec", "quant_int8",
+                         "prefix_spec", "prefix_quant"}, cfgs.keys()
+    for name, entry in cfgs.items():
+        for mix in ("templated", "unique"):
+            row = entry[mix]
+            assert row["rps"] > 0, (name, mix, row)
+            assert row["errors"] == 0, (name, mix, row)
+            assert row["p99_ms"] >= row["p50_ms"] > 0, (name, mix, row)
+        # every f32 config must be greedy-parity exact
+        if entry["legs"]["kv"] == "f32":
+            assert entry["parity"] is True, (name, entry)
+    assert cfgs["prefix"]["templated"]["prefill_tokens_avoided"] > 0
+    assert cfgs["prefix_spec"]["templated"]["acceptance_rate"] is not None
+    for row in data["open_loop"].values():
+        assert row["errors"] == 0 and row["p99_ms"] > 0, row
+
+
+@pytest.mark.slow
 def test_bench_serving2_emits_mxserve2_throughput():
     """--serving2 contract: one mxserve2_throughput JSON line — serve2
     requests/sec, the PR-3 single-engine baseline and the speedup, zero

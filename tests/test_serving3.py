@@ -1,0 +1,727 @@
+"""Serving v3 (ISSUE 12): prefix caching (refcounted pages, chain-hash
+sharing, CoW), speculative decoding (draft propose + one-dispatch
+verify, exact greedy acceptance), quantized KV pages (int8/bf16 within
+their declared tolerance classes, capacity at equal pool bytes), the
+per-row last_logits fix, the servelint page-accounting audit, and the
+randomized admit/finish/preempt interleaving property tests. Tiny
+models and short ladders keep tier-1 wall time flat.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401 — registry bootstrap
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.opt.verify import TOLERANCE_CLASSES, tolerance_for
+from mxnet_tpu.parallel.pipeline_lm import (dense_lm_logits,
+                                            init_pipeline_lm,
+                                            truncate_pipeline_lm)
+from mxnet_tpu.serve2 import (DecodeEngine, PageAllocator, PagedLM,
+                              PrefixCache, page_keys, pages_needed)
+
+VOCAB = 32
+
+
+def _tiny_params(seed=0, n_layers=2):
+    return init_pipeline_lm(seed, vocab=VOCAB, d_model=16,
+                            n_layers=n_layers, n_heads=2, d_head=8,
+                            d_ff=32, n_experts=2)
+
+
+def _dense_greedy(params, prompt, n_new):
+    import jax
+    import jax.numpy as jnp
+    dense = jax.jit(dense_lm_logits)
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n_new):
+        lg = dense(params, jnp.asarray([toks], jnp.int32))
+        nxt = int(jnp.argmax(lg[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def _audit_errors(engine):
+    from mxnet_tpu.passes.servelint import lint_page_audit
+    return [f for f in lint_page_audit(engine.page_audit())
+            if f.severity == "error"]
+
+
+# ---------------------------------------------------------------------------
+# refcounted allocator + prefix cache units
+# ---------------------------------------------------------------------------
+
+def test_page_allocator_refcounts():
+    alloc = PageAllocator(num_pages=6, page_size=4, name="rc")
+    a, b = alloc.alloc(2)
+    assert alloc.refcount(a) == 1
+    alloc.incref([a])
+    assert alloc.refcount(a) == 2
+    assert alloc.shared_pages() == 1
+    alloc.free([a])            # decrement, NOT a release
+    assert alloc.refcount(a) == 1
+    assert alloc.free_pages == 3
+    alloc.free([a, b])
+    assert alloc.free_pages == 5
+    assert alloc.refcount(a) == 0
+    with pytest.raises(MXNetError):
+        alloc.free([a])        # fully released: double free
+    with pytest.raises(MXNetError):
+        alloc.incref([a])      # can't pin a free page
+    # a page held K times may be freed K times IN ONE CALL
+    c = alloc.alloc(1)[0]
+    alloc.incref([c])
+    alloc.free([c, c])
+    assert alloc.refcount(c) == 0
+    # ...but K+1 drops is over-free and must be all-or-nothing
+    d = alloc.alloc(1)[0]
+    with pytest.raises(MXNetError):
+        alloc.free([d, d])
+    assert alloc.refcount(d) == 1
+    assert alloc.stats()["pages_shared"] == 0
+
+
+def test_prefix_cache_chain_keys():
+    k1 = page_keys([1, 2, 3, 4, 5, 6, 7, 8, 9], page_size=4)
+    assert len(k1) == 2  # only FULL pages are keyed
+    # the chain makes a page's key depend on the WHOLE prefix
+    k2 = page_keys([9, 2, 3, 4, 5, 6, 7, 8], page_size=4)
+    assert k1[0] != k2[0]
+    assert k1[1] != k2[1]
+    assert page_keys([1, 2, 3], page_size=4) == []
+    assert page_keys([1, 2, 3, 4, 5, 6, 7, 8], page_size=4) == k1
+
+
+def test_prefix_cache_register_lookup_evict():
+    alloc = PageAllocator(num_pages=8, page_size=4, name="pc")
+    cache = PrefixCache(alloc)
+    keys = page_keys(list(range(8)), page_size=4)
+    pages = alloc.alloc(2)
+    assert cache.register(keys, pages) == 2
+    assert alloc.refcount(pages[0]) == 2  # owner + cache
+    # lookup increfs on behalf of the caller (stats land separately
+    # via record_admission — see the capacity-cap test)
+    hit = cache.lookup(keys)
+    assert hit == pages
+    assert alloc.refcount(pages[0]) == 3
+    cache.record_admission(len(hit))
+    assert cache.stats()["tokens_avoided"] == 8
+    # partial prefix: a diverging second page stops the walk
+    other = page_keys(list(range(4)) + [9, 9, 9, 9], page_size=4)
+    hit2 = cache.lookup(other)
+    assert hit2 == pages[:1]
+    alloc.free(hit + hit2)
+    # owner lets go; pages survive via the cache's reference
+    alloc.free(pages)
+    assert alloc.refcount(pages[0]) == 1
+    assert sorted(cache.cached_pages()) == sorted(pages)
+    # eviction actually returns them to the free list (LRU first)
+    freed = cache.evict(2)
+    assert freed == 2
+    assert alloc.free_pages == 7
+    assert len(cache) == 0
+    # registering an already-known key keeps the existing page
+    p2 = alloc.alloc(2)
+    cache.register(keys, p2)
+    p3 = alloc.alloc(1)
+    assert cache.register(keys[:1], p3) == 0
+    assert cache.find(keys[0]) == p2[0]
+    alloc.free(p2 + p3)
+    cache.release_all()
+    assert alloc.free_pages == 7
+
+
+def test_prefix_cache_capacity_cap_drops_entries_not_everything():
+    """capacity_pages is an ENTRY budget: going one over drops exactly
+    the LRU entry — even when every cached page is still shared by a
+    live holder (where the pool-pressure evict() would free nothing
+    per entry and must NOT be used, or the whole index gets flushed)."""
+    alloc = PageAllocator(num_pages=12, page_size=4, name="cap")
+    cache = PrefixCache(alloc, capacity_pages=3)
+    owners = alloc.alloc(4)   # simulated live sequences keep all pages
+    for i, p in enumerate(owners):
+        cache.register(page_keys([i] * 4, 4), [p])
+    assert len(cache) == 3, "cap must hold"
+    # the three SURVIVORS are the most recent; only the LRU was dropped
+    assert sorted(cache.cached_pages()) == sorted(owners[1:])
+    assert alloc.refcount(owners[0]) == 1   # cache ref dropped
+    assert alloc.refcount(owners[1]) == 2   # still cached
+    # hit statistics only land via record_admission (a pool-pressure
+    # requeue retries lookup every tick and must not count)
+    keys = page_keys([1] * 4, 4)
+    got = cache.lookup(keys)
+    assert got == [owners[1]]
+    assert cache.stats()["hits"] == 0
+    cache.record_admission(len(got))
+    assert cache.stats()["hits"] == 1
+    assert cache.stats()["tokens_avoided"] == 4
+    cache.record_admission(0)
+    assert cache.stats()["misses"] == 1
+    alloc.free(got)
+    alloc.free(owners)
+    cache.release_all()
+    assert alloc.stats()["pages_used"] == 0
+
+
+# ---------------------------------------------------------------------------
+# prefix caching through the engine
+# ---------------------------------------------------------------------------
+
+def test_prefix_hit_parity_and_accounting():
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=6,
+                       max_seq_len=24, prefix_cache=True, name="pfx")
+    try:
+        eng.warmup()
+        rc = telemetry.recompile_count()
+        prompt = [3, 9, 1, 4, 7]   # one full page + a partial tail
+        want = _dense_greedy(params, prompt, 6)
+        out1 = eng.predict(onp.asarray(prompt, "int32"),
+                           timeout_ms=60000.0)
+        out2 = eng.predict(onp.asarray(prompt, "int32"),
+                           timeout_ms=60000.0)
+        assert out1.tolist() == want
+        assert out2.tolist() == want, \
+            "a prefix-cache hit changed the greedy trajectory"
+        st = eng.stats()
+        assert st["prefix_cache"]["hits"] == 1
+        assert st["prefill_tokens_avoided"] == 4
+        assert st["recompiles_after_warmup"] == 0
+        assert telemetry.recompile_count() == rc
+        assert _audit_errors(eng) == []
+        # after drain the ONLY live pages are the cache's
+        assert st["pages"]["pages_used"] == len(
+            eng.prefix.cached_pages())
+    finally:
+        eng.close()
+    assert eng.alloc.stats()["pages_used"] == 0, \
+        "close() must release the cache's page references"
+
+
+def test_prefix_full_coverage_cow():
+    """A prompt of exactly N full pages, submitted twice: the second
+    admission covers the WHOLE prompt from cache, so the final
+    position recomputes into a copy-on-write page — and the greedy
+    output is unchanged."""
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=5,
+                       max_seq_len=24, prefix_cache=True, name="cow")
+    try:
+        eng.warmup()
+        prompt = [3, 9, 1, 4, 7, 2, 8, 5]   # exactly 2 full pages
+        want = _dense_greedy(params, prompt, 5)
+        a = eng.predict(onp.asarray(prompt, "int32"), timeout_ms=60000.0)
+        b = eng.predict(onp.asarray(prompt, "int32"), timeout_ms=60000.0)
+        assert a.tolist() == want and b.tolist() == want
+        st = eng.stats()
+        assert st["prefix_cache"]["cow_copies"] >= 1
+        assert st["prefix_cache"]["hits"] == 1
+        assert st["recompiles_after_warmup"] == 0
+        assert _audit_errors(eng) == []
+    finally:
+        eng.close()
+
+
+def test_shared_pages_bitwise_stable_across_other_traffic():
+    """Pages shared from the cache are READ-ONLY: another request
+    decoding over a shared prefix must leave the shared pages'
+    contents bitwise identical."""
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=6,
+                       max_seq_len=24, prefix_cache=True, name="ro")
+    try:
+        eng.warmup()
+        base = [3, 9, 1, 4]
+        eng.predict(onp.asarray(base + [7], "int32"), timeout_ms=60000.0)
+        shared = eng.prefix.cached_pages()
+        assert shared
+        page = eng.page_size
+        slots = onp.concatenate([onp.arange(p * page, (p + 1) * page)
+                                 for p in shared])
+        before = onp.asarray(eng.lm.pools["k"])[:, slots].copy()
+        # different continuation over the same cached prefix
+        out = eng.predict(onp.asarray(base + [6, 2], "int32"),
+                          timeout_ms=60000.0)
+        assert out.tolist() == _dense_greedy(params, base + [6, 2], 6)
+        after = onp.asarray(eng.lm.pools["k"])[:, slots]
+        assert onp.array_equal(before, after), \
+            "a shared prefix page was mutated by another sequence"
+        assert eng.stats()["prefix_cache"]["hits"] >= 1
+    finally:
+        eng.close()
+
+
+def test_preempted_sequence_reuses_its_own_cached_prefix():
+    """Recompute-preemption + prefix cache: the re-admission's
+    effective prompt hits the pages the first admission registered, so
+    preemption recovery prefills only the un-cached suffix — and the
+    greedy trajectory stays oracle-exact."""
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=7, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=10,
+                       max_seq_len=24, prefix_cache=True, name="pre3")
+    try:
+        eng.warmup()
+        rs = onp.random.RandomState(5)
+        prompts = [rs.randint(0, VOCAB, size=(6,)).tolist()
+                   for _ in range(3)]
+        handles = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        assert eng.run_until_idle(120.0)
+        st = eng.stats()
+        assert st["preemptions"] >= 1, \
+            f"pool was sized to force a preemption: {st}"
+        for p, h in zip(prompts, handles):
+            assert h.result.tolist() == _dense_greedy(params, p, 10)
+        assert st["recompiles_after_warmup"] == 0
+        assert _audit_errors(eng) == []
+    finally:
+        eng.close()
+
+
+def test_randomized_interleavings_no_leaks_no_double_free():
+    """Property test: randomized admit/finish/cancel interleavings over
+    a small pool with prefix caching on — after drain, refcounts
+    cross-check clean (no leaks, no double-free, no freed-reachable
+    pages) and the only live pages are the cache's."""
+    params = _tiny_params()
+    for seed in (0, 1, 2):
+        rs = onp.random.RandomState(seed)
+        template = rs.randint(0, VOCAB, size=(4,)).tolist()
+        eng = DecodeEngine(params, page_size=4, num_pages=9,
+                           max_inflight=3, prefill_buckets=[8],
+                           max_new_default=4, max_seq_len=16,
+                           prefix_cache=True, name=f"prop{seed}")
+        try:
+            eng.warmup()
+            handles = []
+            for i in range(12):
+                if rs.rand() < 0.6:
+                    prompt = template + rs.randint(
+                        0, VOCAB, size=(rs.randint(1, 4),)).tolist()
+                else:
+                    prompt = rs.randint(
+                        0, VOCAB, size=(rs.randint(1, 8),)).tolist()
+                h = eng.submit(prompt,
+                               max_new_tokens=int(rs.randint(1, 5)))
+                if rs.rand() < 0.2:
+                    h.cancelled = True
+                handles.append(h)
+            assert eng.run_until_idle(120.0)
+            errs = _audit_errors(eng)
+            assert errs == [], [repr(f) for f in errs]
+            st = eng.stats()
+            assert st["pages"]["pages_used"] == len(
+                eng.prefix.cached_pages()), \
+                f"seed {seed}: pages leaked beyond the cache: {st}"
+            assert st["recompiles_after_warmup"] == 0
+        finally:
+            eng.close()
+        assert eng.alloc.stats()["pages_used"] == 0, f"seed {seed}"
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding
+# ---------------------------------------------------------------------------
+
+def test_spec_self_draft_full_acceptance_parity():
+    """draft == target: every draft token verifies (acceptance -> 1 up
+    to window-budget clamps), generation takes far fewer ticks, and
+    the output is token-for-token the dense oracle's."""
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=9,
+                       max_seq_len=24, draft_params=params,
+                       spec_tokens=3, name="specself")
+    try:
+        eng.warmup()
+        prompt = [3, 9, 1, 4, 7]
+        out = eng.predict(onp.asarray(prompt, "int32"),
+                          timeout_ms=60000.0)
+        assert out.tolist() == _dense_greedy(params, prompt, 9)
+        st = eng.stats()
+        # 9 tokens = 1 (prefill) + two K+1=4 windows: 3 ticks max
+        assert st["ticks"] <= 3
+        assert st["spec"]["proposed"] > 0
+        # all FULLY-OFFERED drafts accepted; only budget clamps bite
+        assert st["spec"]["acceptance_rate"] > 0.7
+        assert st["recompiles_after_warmup"] == 0
+    finally:
+        eng.close()
+
+
+def test_spec_garbage_draft_zero_acceptance_still_exact():
+    """A draft that agrees with the target on nothing (different
+    random init): acceptance ~0, every tick emits exactly the
+    target's own corrected token — greedy parity is unconditional."""
+    params = _tiny_params()
+    other = _tiny_params(seed=7, n_layers=1)
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=6,
+                       max_seq_len=24, draft_params=other,
+                       spec_tokens=3, name="specbad")
+    try:
+        eng.warmup()
+        for seed in (1, 2):
+            rs = onp.random.RandomState(seed)
+            prompt = rs.randint(0, VOCAB, size=(5,)).tolist()
+            out = eng.predict(onp.asarray(prompt, "int32"),
+                              timeout_ms=60000.0)
+            assert out.tolist() == _dense_greedy(params, prompt, 6), \
+                "speculative decoding must be exact at ANY acceptance"
+        st = eng.stats()
+        assert st["spec"]["acceptance_rate"] < 0.7
+        assert st["recompiles_after_warmup"] == 0
+    finally:
+        eng.close()
+
+
+def test_spec_truncated_draft_and_window_edges():
+    """Layer-truncated draft (the CLI's --draft-layers path) plus the
+    window edge cases: K=1, max_new smaller than the window, and EOS
+    landing mid-window."""
+    params = _tiny_params()
+    draft = truncate_pipeline_lm(params, 1)
+    assert draft["layers"]["wqkv"].shape[0] == 1
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=6,
+                       max_seq_len=24, draft_params=draft,
+                       spec_tokens=1, name="spectr")
+    try:
+        eng.warmup()
+        prompt = [3, 9, 1]
+        assert eng.predict(
+            onp.asarray(prompt, "int32"),
+            timeout_ms=60000.0).tolist() == _dense_greedy(params,
+                                                          prompt, 6)
+        # max_new below the speculative window
+        h = eng.submit(prompt, max_new_tokens=1)
+        assert eng.run_until_idle(60.0)
+        assert h.result.tolist() == _dense_greedy(params, prompt, 1)
+        # EOS mid-window stops the sequence at its FIRST occurrence
+        want = _dense_greedy(params, prompt, 6)
+        eng.eos_id = want[2]
+        out = eng.predict(onp.asarray(prompt, "int32"),
+                          timeout_ms=60000.0)
+        assert out.tolist() == want[:want.index(eng.eos_id) + 1]
+        assert eng.stats()["recompiles_after_warmup"] == 0
+    finally:
+        eng.close()
+
+
+def test_spec_with_prefix_cache_combined():
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=6,
+                       max_seq_len=24, draft_params=params,
+                       spec_tokens=2, prefix_cache=True, name="both")
+    try:
+        eng.warmup()
+        prompt = [3, 9, 1, 4, 7]
+        want = _dense_greedy(params, prompt, 6)
+        for _ in range(2):
+            out = eng.predict(onp.asarray(prompt, "int32"),
+                              timeout_ms=60000.0)
+            assert out.tolist() == want
+        st = eng.stats()
+        assert st["prefix_cache"]["hits"] == 1
+        assert st["spec"]["proposed"] > 0
+        assert st["recompiles_after_warmup"] == 0
+        assert _audit_errors(eng) == []
+    finally:
+        eng.close()
+
+
+def test_spec_requires_coherent_config():
+    params = _tiny_params()
+    with pytest.raises(MXNetError):
+        DecodeEngine(params, page_size=4, num_pages=8, max_inflight=2,
+                     prefill_buckets=[8], draft_params=params,
+                     spec_tokens=0, name="bad-k")
+    other_vocab = init_pipeline_lm(0, vocab=16, d_model=16, n_layers=1,
+                                   n_heads=2, d_head=8, d_ff=32,
+                                   n_experts=2)
+    with pytest.raises(MXNetError):
+        DecodeEngine(params, page_size=4, num_pages=8, max_inflight=2,
+                     prefill_buckets=[8], draft_params=other_vocab,
+                     spec_tokens=2, name="bad-vocab")
+
+
+# ---------------------------------------------------------------------------
+# quantized KV pages
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype,cls", [("bf16", "quant_bf16"),
+                                          ("int8", "quant_int8")])
+def test_quantized_pool_logits_within_declared_class(kv_dtype, cls):
+    import jax
+    import jax.numpy as jnp
+    params = _tiny_params()
+    lm = PagedLM(params, page_size=4, num_pages=16, max_pages_per_seq=4,
+                 kv_dtype=kv_dtype, name=f"q-{kv_dtype}")
+    dense = jax.jit(dense_lm_logits)
+    rtol, atol = tolerance_for(cls, "float32")
+    prompt = [3, 9, 1, 4, 7]
+    bt_row = onp.asarray([1, 2, 3, 4], "int32")
+    padded = onp.zeros((8,), "int32")
+    padded[:5] = prompt
+    nxt, logits = lm.prefill(padded, 5, bt_row)
+    toks = list(prompt)
+    for step in range(6):
+        ref = onp.asarray(dense(params, jnp.asarray([toks], jnp.int32)))
+        onp.testing.assert_allclose(
+            logits, ref[0, len(toks) - 1], rtol=rtol, atol=atol,
+            err_msg=f"{kv_dtype} step {step} left class {cls}")
+        toks.append(int(nxt))
+        bt = onp.zeros((1, 4), "int32")
+        bt[0] = bt_row
+        na, lg = lm.decode(bt, onp.asarray([len(toks) - 1], "int32"),
+                           onp.asarray([toks[-1]], "int32"),
+                           onp.asarray([1], "int32"))
+        nxt, logits = int(na[0, 0]), lg[0]
+
+
+def test_quant_classes_declared_and_ordered():
+    assert "quant_bf16" in TOLERANCE_CLASSES
+    assert "quant_int8" in TOLERANCE_CLASSES
+    from mxnet_tpu.opt.verify import strongest_class
+    assert strongest_class(["fusion", "quant_int8"]) == "quant_int8"
+    assert strongest_class(["quant_bf16", "bitwise"]) == "quant_bf16"
+
+
+def test_quant_capacity_at_equal_pool_bytes():
+    """The acceptance gate: an int8 pool of EQUAL BYTES holds >=1.8x
+    the in-flight sequences of the f32 pool (scale metadata included
+    in the byte count — no hidden overhead)."""
+    geom = dict(page_size=8, n_layers=2, n_heads=2, d_head=8)
+    f32_bytes = PagedLM.pool_bytes_for(num_pages=64, kv_dtype="f32",
+                                       **geom)
+    max_seq = 32
+    per_seq = pages_needed(max_seq, 8)
+    f32_seqs = (64 - 1) // per_seq
+    for dtype, floor in (("bf16", 1.8), ("int8", 1.8)):
+        pages = PagedLM.pages_for_bytes(f32_bytes, kv_dtype=dtype,
+                                        **geom)
+        seqs = (pages - 1) // per_seq
+        assert seqs / f32_seqs >= floor, (dtype, pages, seqs, f32_seqs)
+        assert PagedLM.pool_bytes_for(num_pages=pages, kv_dtype=dtype,
+                                      **geom) <= f32_bytes
+    # int8 is ~4x minus the per-slot scale overhead
+    int8_pages = PagedLM.pages_for_bytes(f32_bytes, kv_dtype="int8",
+                                         **geom)
+    assert int8_pages / 64 >= 3.0
+    # the live pools really are that small
+    params = _tiny_params()
+    lm8 = PagedLM(params, page_size=8, num_pages=16,
+                  max_pages_per_seq=4, kv_dtype="int8", name="cap8")
+    lmf = PagedLM(params, page_size=8, num_pages=16,
+                  max_pages_per_seq=4, kv_dtype="f32", name="capf")
+    assert lm8.pool_bytes < lmf.pool_bytes / 2
+    assert onp.asarray(lm8.pools["k"]).dtype == onp.int8
+    assert lm8.pools["ks"].shape == (2, 128)
+
+
+def test_quant_engine_serves_with_prefix_and_audit_clean():
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=4,
+                       prefill_buckets=[8], max_new_default=5,
+                       max_seq_len=24, kv_dtype="int8",
+                       prefix_cache=True, name="q-eng")
+    try:
+        eng.warmup()
+        rs = onp.random.RandomState(3)
+        handles = [eng.submit(rs.randint(0, VOCAB, size=(5,)))
+                   for _ in range(4)]
+        assert eng.run_until_idle(120.0)
+        for h in handles:
+            assert h.done() and h.error is None
+            assert h.result.shape == (5,)
+        st = eng.stats()
+        assert st["kv_dtype"] == "int8"
+        assert st["recompiles_after_warmup"] == 0
+        assert _audit_errors(eng) == []
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# paged attention dequant + per-row last_logits (the PR-8 gap)
+# ---------------------------------------------------------------------------
+
+def test_paged_attention_scale_kwargs_dequantize():
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.paged_attention import (paged_attention,
+                                                    paged_attention_flat)
+    rs = onp.random.RandomState(0)
+    B, N, page, H, K = 2, 3, 4, 2, 8
+    S = 16 * page
+    k_f32 = rs.randn(S, H, K).astype("float32")
+    v_f32 = rs.randn(S, H, K).astype("float32")
+    ks = rs.uniform(0.01, 0.05, size=(S,)).astype("float32")
+    vs = rs.uniform(0.01, 0.05, size=(S,)).astype("float32")
+    k_q = onp.clip(onp.round(k_f32 / ks[:, None, None]),
+                   -127, 127).astype("int8")
+    v_q = onp.clip(onp.round(v_f32 / vs[:, None, None]),
+                   -127, 127).astype("int8")
+    q = jnp.asarray(rs.randn(B, H, K).astype("float32"))
+    bt = jnp.asarray(rs.randint(1, 16, size=(B, N)), jnp.int32)
+    lengths = jnp.asarray([5, 12], jnp.int32)
+    for fn in (paged_attention, paged_attention_flat):
+        ref = fn(q, jnp.asarray(k_q.astype("float32")
+                                * ks[:, None, None]),
+                 jnp.asarray(v_q.astype("float32") * vs[:, None, None]),
+                 bt, lengths, page_size=page)
+        got = fn(q, jnp.asarray(k_q), jnp.asarray(v_q), bt, lengths,
+                 page_size=page, kscale=jnp.asarray(ks),
+                 vscale=jnp.asarray(vs))
+        onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
+                                    rtol=2e-5, atol=1e-6)
+
+
+def test_last_logits_per_row_final_step():
+    """decode_steps > 1: each row's last_logits freeze at ITS final
+    active step — a row finishing mid-window gets real logits, not the
+    garbage of later inactive iterations (the documented PR-8 gap)."""
+    import jax
+    import jax.numpy as jnp
+    params = _tiny_params()
+    lm = PagedLM(params, page_size=4, num_pages=32, max_pages_per_seq=4,
+                 decode_steps=4, name="ll")
+    dense = jax.jit(dense_lm_logits)
+    rtol, atol = tolerance_for("fusion", "float32")
+    rs = onp.random.RandomState(2)
+    prompts = [rs.randint(0, VOCAB, size=(5,)).tolist()
+               for _ in range(2)]
+    rows = []
+    for i, p in enumerate(prompts):
+        bt_row = onp.arange(1 + 4 * i, 5 + 4 * i, dtype="int32")
+        padded = onp.zeros((8,), "int32")
+        padded[:5] = p
+        nxt, _ = lm.prefill(padded, 5, bt_row)
+        rows.append({"toks": p + [int(nxt)], "bt": bt_row})
+    bt = onp.stack([r["bt"] for r in rows])
+    lengths = onp.asarray([5, 5], "int32")
+    tokens = onp.asarray([r["toks"][-1] for r in rows], "int32")
+    remaining = onp.asarray([4, 2], "int32")   # row 1 ends mid-window
+    out, logits = lm.decode(bt, lengths, tokens, remaining)
+    for i, r in enumerate(rows):
+        taken = int(remaining[i])
+        toks = r["toks"] + [int(t) for t in out[i, :taken]]
+        # last_logits must be the logits that produced the FINAL
+        # emitted token's SUCCESSOR — i.e. the dense logits at the
+        # last position, for this row's own window length
+        ref = onp.asarray(dense(params,
+                                jnp.asarray([toks[:-1]], jnp.int32)))
+        onp.testing.assert_allclose(
+            logits[i], ref[0, -1], rtol=rtol, atol=atol,
+            err_msg=f"row {i} (remaining={taken}) got stale logits")
+        assert int(onp.argmax(logits[i])) == toks[-1]
+
+
+# ---------------------------------------------------------------------------
+# servelint page-accounting audit + serve3 gauges
+# ---------------------------------------------------------------------------
+
+def test_lint_page_audit_good_and_bad_fixtures():
+    from mxnet_tpu.passes.servelint import lint_page_audit
+    good = {"name": "g", "page_size": 4, "admitting": 0,
+            "refcounts": {3: 2, 5: 1, 9: 1},
+            "sequences": {1: {"pages": [3, 5], "length": 5},
+                          2: {"pages": [3, 9], "length": 6}},
+            "cache_pages": []}
+    # page 3 is shared BUT both sequences' write positions (5, 6) land
+    # in their private second page — the CoW contract holds
+    assert lint_page_audit(good) == []
+    bad = {"name": "b", "page_size": 4, "admitting": 0,
+           "refcounts": {3: 2, 7: 1, 9: 3},
+           "sequences": {1: {"pages": [3, 0, 5, 5], "length": 9},
+                         2: {"pages": [3], "length": 2}},
+           "cache_pages": [9]}
+    checks = {f.check for f in lint_page_audit(bad)}
+    assert checks >= {"null-page-in-table", "dup-page-in-table",
+                      "freed-page-reachable", "refcount-mismatch",
+                      "shared-write-target"}
+    # an in-flight admission downgrades ATTRIBUTION mismatches only
+    mid = {"name": "m", "page_size": 4, "admitting": 1,
+           "refcounts": {3: 1, 7: 1}, "sequences": {}, "cache_pages": []}
+    sev = {f.check: f.severity for f in lint_page_audit(mid)}
+    assert sev.get("refcount-mismatch") == "info"
+
+
+def test_servelint_runs_audit_and_draft_report_on_engine():
+    from mxnet_tpu.passes.servelint import ServeLint
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=32, max_inflight=2,
+                       prefill_buckets=[8], max_new_default=3,
+                       max_seq_len=16, prefix_cache=True,
+                       draft_params=params, spec_tokens=2,
+                       name="lint3")
+    try:
+        eng.warmup()
+        eng.predict(onp.asarray([1, 2, 3, 4, 5], "int32"),
+                    timeout_ms=60000.0)
+        eng.predict(onp.asarray([1, 2, 3, 4, 5], "int32"),
+                    timeout_ms=60000.0)
+        findings = [f for f in ServeLint().run(eng)
+                    if f.check != "pool-donate-cpu"]
+        assert findings == [], [repr(f) for f in findings]
+        rep = eng.lint_report()
+        assert rep["verify_rungs"] == rep["decode_rungs"]
+        assert rep["prefill_ext_rungs"] == rep["prefill_rungs"]
+        assert "draft" in rep
+    finally:
+        eng.close()
+
+
+def test_router_group_audit_over_draft_target_replicas():
+    """A draft/target group is an ordinary router group; Router.audit
+    runs the page-accounting audit across its decode replicas (one
+    allocator covers draft AND target pages)."""
+    from mxnet_tpu.serve2 import Router
+    params = _tiny_params()
+
+    def factory(version, replica):
+        return DecodeEngine(params, page_size=4, num_pages=16,
+                            max_inflight=2, prefill_buckets=[8],
+                            max_new_default=3, max_seq_len=16,
+                            prefix_cache=True, draft_params=params,
+                            spec_tokens=2,
+                            name=f"aud-r{replica}-v{version}")
+
+    router = Router(name="aud")
+    try:
+        router.add_group("lm", factory, n_replicas=2)
+        router.predict("lm", onp.asarray([1, 2, 3, 4, 5], "int32"),
+                       timeout_ms=60000.0)
+        rep = router.audit("lm")
+        assert set(rep["replicas"]) == {"lm/r0", "lm/r1"}
+        assert rep["findings"] == [], rep
+        assert router.audit() == rep  # all-groups form
+    finally:
+        router.close()
+
+
+def test_serve3_gauges_registered_per_engine_and_retired_on_close():
+    params = _tiny_params()
+    eng = DecodeEngine(params, page_size=4, num_pages=16, max_inflight=2,
+                       prefill_buckets=[8], max_new_default=3,
+                       max_seq_len=16, prefix_cache=True,
+                       draft_params=params, spec_tokens=2,
+                       name="gauges3")
+    names = [f"mxserve3_prefix_hits_gauges3",
+             f"mxserve3_prefix_pages_shared_gauges3",
+             f"mxserve3_cow_copies_gauges3",
+             f"mxserve3_prefill_tokens_avoided_gauges3",
+             f"mxserve3_spec_proposed_gauges3",
+             f"mxserve3_spec_accepted_gauges3",
+             f"mxserve3_accept_rate_gauges3"]
+    have = telemetry.metrics.all_metrics()
+    for n in names:
+        assert n in have, n
+    eng.close()
+    have = telemetry.metrics.all_metrics()
+    for n in names:
+        assert n not in have, f"{n} must be retired on close()"

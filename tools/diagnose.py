@@ -200,9 +200,17 @@ def check_serving2():
     print("replicas     :", config.get("MXSERVE2_REPLICAS"))
     print("reload drain :", config.get("MXSERVE2_RELOAD_DRAIN_TIMEOUT_S"),
           "s")
+    print("prefix cache :", "on" if config.get("MXSERVE3_PREFIX_CACHE")
+          else "off",
+          "(cap %s pages)" % (config.get("MXSERVE3_PREFIX_CACHE_PAGES")
+                              or "none"))
+    print("spec tokens  :", config.get("MXSERVE3_SPEC_TOKENS"),
+          "(draft proposals per tick; engines need draft_params)")
+    print("kv dtype     :", config.get("MXSERVE3_KV_DTYPE"),
+          "(page-pool storage; int8 ~4x positions per byte)")
     snap = telemetry.snapshot()
     served = {k: v for k, v in snap.items()
-              if k.startswith("mxserve2_")}
+              if k.startswith(("mxserve2_", "mxserve3_"))}
     if not served:
         print("metrics      : none (no serve2 engine has run in this "
               "process)")

@@ -177,7 +177,8 @@ def _selfcheck_serve_findings():
     import numpy as onp
     from mxnet_tpu.parallel.pipeline_lm import init_pipeline_lm
     from mxnet_tpu.passes import Finding
-    from mxnet_tpu.passes.servelint import lint_serve_report
+    from mxnet_tpu.passes.servelint import (lint_page_audit,
+                                            lint_serve_report)
     from mxnet_tpu.serve2 import DecodeEngine
 
     params = init_pipeline_lm(0, vocab=32, d_model=16, n_layers=2,
@@ -185,18 +186,29 @@ def _selfcheck_serve_findings():
     engine = DecodeEngine(params, page_size=4, num_pages=16,
                           max_inflight=2, prefill_buckets=[8],
                           max_new_default=3, max_seq_len=16,
+                          prefix_cache=True,
                           name="<self-check serve>")
     try:
         engine.warmup()
         rs = onp.random.RandomState(0)
+        shared_prompt = rs.randint(0, 32, size=(5,))
         for _ in range(3):
-            engine.submit(rs.randint(0, 32, size=(5,)), max_new_tokens=3)
+            # identical prompts so the prefix cache actually shares a
+            # page and the live audit exercises refcounts > 1
+            engine.submit(shared_prompt, max_new_tokens=3)
         if not engine.run_until_idle(60.0):
             return [Finding("servelint", "selfcheck-hang",
                             "<self-check serve>", "error",
                             "self-check generations did not finish")]
         findings = [f for f in lint_serve_report(engine.lint_report())
                     if f.check != "pool-donate-cpu"]
+        findings.extend(lint_page_audit(engine.page_audit()))
+        if engine.stats().get("prefix_cache", {}).get("hits", 0) < 1:
+            findings.append(Finding(
+                "servelint", "selfcheck-coverage", "<self-check serve>",
+                "error",
+                "identical prompts produced no prefix-cache hit — the "
+                "page-accounting audit ran against an idle cache"))
     finally:
         engine.close()
     # the lint must FIRE on a bad report (off-rung compile + undonated
@@ -215,6 +227,24 @@ def _selfcheck_serve_findings():
                 "error",
                 f"lint did not fire {check!r} on the fixture built to "
                 "trigger it"))
+    # ...and the page-accounting audit must fire on its own fixtures:
+    # a freed-but-reachable shared page, a null page in a table, a
+    # refcount leak, and a shared write target (CoW contract)
+    bad_audit = {"name": "<bad audit fixture>", "page_size": 4,
+                 "admitting": 0,
+                 "refcounts": {3: 2, 7: 1, 9: 3},
+                 "sequences": {1: {"pages": [3, 0, 5], "length": 9},
+                               2: {"pages": [3], "length": 2}},
+                 "cache_pages": [9]}
+    fired = {f.check for f in lint_page_audit(bad_audit)}
+    for check in ("freed-page-reachable", "null-page-in-table",
+                  "refcount-mismatch", "shared-write-target"):
+        if check not in fired:
+            findings.append(Finding(
+                "servelint", "selfcheck-coverage",
+                "<bad audit fixture>", "error",
+                f"page audit did not fire {check!r} on the fixture "
+                "built to trigger it"))
     findings.append(Finding(
         "servelint", "selfcheck-summary", "<self-check serve>", "info",
         f"decode rungs {engine.decode_rungs}, prefill rungs "

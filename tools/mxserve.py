@@ -238,8 +238,22 @@ def _group_factory(cfg, args, name):
     their per-engine gauges never collide."""
     kind = cfg.get("kind", "mlp")
     if kind == "lm":
-        from mxnet_tpu.parallel.pipeline_lm import init_pipeline_lm
+        from mxnet_tpu.parallel.pipeline_lm import (init_pipeline_lm,
+                                                    truncate_pipeline_lm)
         from mxnet_tpu.serve2 import DecodeEngine
+
+        # serve3 knobs: CLI flags are the defaults, per-model spec-file
+        # keys override (so one route spec can mix f32 and int8 groups)
+        draft_layers = int(cfg.get("draft_layers",
+                                   getattr(args, "draft_layers", 0)))
+        spec_tokens = cfg.get("spec_tokens",
+                              getattr(args, "spec_tokens", None))
+        if draft_layers > 0 and spec_tokens is None:
+            from mxnet_tpu import config as _config
+            if int(_config.get("MXSERVE3_SPEC_TOKENS")) < 1:
+                spec_tokens = 4  # a draft without K is useless
+        kv_dtype = cfg.get("kv_dtype",
+                           getattr(args, "kv_dtype", None)) or None
 
         def factory(version, replica):
             params = init_pipeline_lm(
@@ -251,9 +265,15 @@ def _group_factory(cfg, args, name):
                 d_head=int(cfg.get("d_head", 16)),
                 d_ff=int(cfg.get("d_ff", 64)),
                 n_experts=int(cfg.get("n_experts", 2)))
+            draft = (truncate_pipeline_lm(params, draft_layers)
+                     if draft_layers > 0 else None)
             return DecodeEngine(
                 params, name=f"{name}-r{replica}-v{version}",
-                max_new_default=int(cfg.get("max_new", 16)))
+                max_new_default=int(cfg.get("max_new", 16)),
+                draft_params=draft,
+                spec_tokens=(int(spec_tokens)
+                             if spec_tokens is not None else None),
+                kv_dtype=kv_dtype)
         return factory
 
     from mxnet_tpu import serve
@@ -424,10 +444,25 @@ def main(argv=None):
     sp.add_argument("--spec", default="",
                     help="replica spec file (JSON/YAML): {'models': "
                          "[{'name', 'kind': 'mlp'|'lm', 'replicas', "
+                         "'draft_layers', 'spec_tokens', 'kv_dtype', "
                          "...}]}")
     sp.add_argument("--replicas", type=int, default=None,
                     help="replicas per group (default: "
                          "MXSERVE2_REPLICAS)")
+    sp.add_argument("--draft-layers", type=int, default=0,
+                    help="serve3 speculative decoding for 'lm' groups: "
+                         "layer-truncated draft model with this many "
+                         "layers (0 = off)")
+    sp.add_argument("--spec-tokens", type=int, default=None,
+                    help="draft tokens proposed per tick (default: "
+                         "MXSERVE3_SPEC_TOKENS)")
+    sp.add_argument("--kv-dtype", default="",
+                    choices=("", "f32", "bf16", "int8"),
+                    help="KV page-pool storage dtype for 'lm' groups "
+                         "(default: MXSERVE3_KV_DTYPE); per-engine "
+                         "prefix-cache/acceptance gauges ride "
+                         "GET /metrics, the page-accounting audit "
+                         "GET /v1/models/<name>:audit")
     sp.add_argument("--verbose", action="store_true")
     sp.set_defaults(fn=cmd_route)
 
