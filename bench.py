@@ -38,6 +38,7 @@ Env knobs:
                       CPU backend (hermetic CI / contract tests)
 """
 import contextlib
+import itertools
 import json
 import os
 import sys
@@ -1648,6 +1649,185 @@ def graphopt_main():
           **record)
 
 
+def trace_main():
+    """mxtrace overhead benchmark (--trace-overhead /
+    MXTPU_BENCH_TRACE=1), ONE BENCH-schema JSON line (metric
+    ``mxtrace_overhead``, value = worst traced/untraced median ratio
+    across the two phases):
+
+    - **training**: a compute-heavy conv stack driven through the
+      fused step with MXGUARD taps ON (the always-on configuration the
+      <2% contract is stated against), interleaved steps with MXTRACE
+      on vs off. Tracing is NOT part of the jit key, so the SAME
+      compiled program serves both arms — the phase also asserts zero
+      recompiles after warmup with the flag flipping every step;
+    - **serving**: a warmed serve2 DecodeEngine driven in loaded
+      continuous-batching waves with MXTRACE on vs off (each traced
+      request emits the full queue/admit/prefill/decode span set;
+      per-tick dispatch spans are shared by the whole batch).
+
+    Contract (``trace_ok``): the conv-net phase < 2% at default
+    sampling and zero after-warmup recompiles with the flag flipping
+    every block (tracing never re-keys a program). The serving ratio
+    is reported alongside; see the in-line note on why it is not a
+    gate on this host. Knobs:
+    MXTPU_BENCH_TRACE_{STEPS,REQUESTS,MAX_NEW}."""
+    os.environ.setdefault("MXTPU_BENCH_FORCE_CPU", "1")
+    jax, devices, probe_status = _init_jax()
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, gluon, nd, telemetry, trace
+    from mxnet_tpu.parallel.pipeline_lm import init_pipeline_lm
+    from mxnet_tpu.serve2 import DecodeEngine
+
+    n_steps = int(os.environ.get("MXTPU_BENCH_TRACE_STEPS", "40"))
+    n_reqs = int(os.environ.get("MXTPU_BENCH_TRACE_REQUESTS", "48"))
+    max_new = int(os.environ.get("MXTPU_BENCH_TRACE_MAX_NEW", "24"))
+    sample = float(config.get("MXTRACE_SAMPLE"))
+
+    # ---- phase 1: training (fused step + guard taps) ----------------
+    mx.random.seed(7)
+    onp.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        for cin, nf in ((3, 16), (16, 32), (32, 32)):
+            net.add(gluon.nn.Conv2D(nf, kernel_size=3, padding=1,
+                                    in_channels=cin,
+                                    activation="relu"))
+        net.add(gluon.nn.GlobalAvgPool2D())
+        net.add(gluon.nn.Flatten())
+        net.add(gluon.nn.Dense(10, in_units=32))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    fused = trainer.fuse_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss())
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.uniform(-1, 1, (8, 3, 32, 32)).astype("float32"))
+    y = nd.array(rng.randint(0, 10, (8,)).astype("float32"))
+    config.set_flag("MXGUARD", True)
+    for _ in range(3):  # warmup: ONE program (tracing never re-keys)
+        fused.step(x, y).asnumpy()
+    def _paired_overhead(run_one, n_pairs, block):
+        """20%-trimmed mean of per-PAIR traced/untraced ratios over
+        BLOCKS of ``block`` calls per arm. The pair runs back-to-back
+        so this host's burstable-vCPU clock drift (2x across seconds —
+        the PR-7 note) cancels inside each ratio; the block averages
+        per-call jitter (decode-window quantization, wait wakeups);
+        the within-pair order alternates so second-in-pair effects
+        cancel; and the trim drops the pause outliers that would
+        otherwise dominate a mean. Measured repeatability at 40 pairs
+        on this host: ~±1% — the honest error bar on the <2% gate.
+        Returns (ratio, untraced_median_per_call_s, traced_...)."""
+        ratios, offs, ons = [], [], []
+        for i in range(n_pairs):
+            pair = {}
+            for traced in ((False, True) if i % 2 == 0
+                           else (True, False)):
+                config.set_flag("MXTRACE", traced)
+                t0 = time.perf_counter()
+                for _ in range(block):
+                    run_one()
+                pair[traced] = (time.perf_counter() - t0) / block
+            if pair[False] > 0:
+                ratios.append(pair[True] / pair[False])
+            offs.append(pair[False])
+            ons.append(pair[True])
+        config.unset_flag("MXTRACE")
+        ratios.sort()
+        offs.sort()
+        ons.sort()
+        trim = len(ratios) // 5
+        core = ratios[trim:len(ratios) - trim] or ratios
+        return (round(sum(core) / len(core), 4) if core else None,
+                offs[len(offs) // 2], ons[len(ons) // 2])
+
+    rc0 = telemetry.recompile_count()
+    train_overhead, t_off, t_on = _paired_overhead(
+        lambda: fused.step(x, y).asnumpy(),  # host fetch = fence
+        n_steps, block=2)
+    config.unset_flag("MXGUARD")
+    train_recompiles = telemetry.recompile_count() - rc0
+
+    # ---- phase 2: serving (warmed decode engine) --------------------
+    # model sized so a decode tick does real compute (the serving
+    # analog of the conv-stack denominator rule above): span cost is
+    # fixed per request, so a toy model would measure dispatch
+    # overhead, not tracing overhead
+    params = init_pipeline_lm(0, vocab=64, d_model=64, n_layers=3,
+                              n_heads=4, d_head=16, d_ff=128,
+                              n_experts=2)
+    engine = DecodeEngine(params, page_size=8, num_pages=64,
+                          max_inflight=4, prefill_buckets=[16],
+                          max_new_default=max_new,
+                          max_seq_len=16 + 2 * max_new,
+                          prefix_cache=False, name="trace-bench")
+    engine.warmup()
+    prng = onp.random.RandomState(1)
+    prompts = [prng.randint(0, 64, size=(12,)).astype("int32")
+               for _ in range(n_reqs)]
+    for p in prompts[:2]:  # steady the engine (thread started, jit hot)
+        engine.predict(p)
+    rc1 = telemetry.recompile_count()
+    it = itertools.cycle(prompts)
+
+    wave = max(4, n_reqs // 3)
+
+    def serve_round():
+        """One loaded round: submit a wave and drain it — the
+        continuous-batching steady state (per-tick span cost is
+        shared by the whole decode batch, and a sub-second round
+        averages out per-request scheduler jitter that single-predict
+        pairs cannot)."""
+        handles = [engine.submit(next(it)) for _ in range(wave)]
+        if not engine.run_until_idle(300.0):
+            raise RuntimeError("trace bench: serve round wedged")
+        for h in handles:
+            if h.error is not None:
+                raise h.error
+
+    serve_round()  # steady the wave shape before timing
+    serve_overhead, s_off, s_on = _paired_overhead(
+        serve_round, 20, block=1)
+    s_off /= wave  # per-request medians for the report
+    s_on /= wave
+    serve_recompiles = telemetry.recompile_count() - rc1
+    engine.close()
+
+    worst = max(v for v in (train_overhead, serve_overhead)
+                if v is not None)
+    recorder = trace.get_recorder().describe()
+    record = dict(
+        metric="mxtrace_overhead",
+        steps=n_steps, requests=n_reqs, max_new=max_new,
+        sample=sample,
+        train_untraced_step_s=round(t_off, 6),
+        train_traced_step_s=round(t_on, 6),
+        train_overhead_pct=(round((train_overhead - 1.0) * 100, 2)
+                            if train_overhead else None),
+        serve_untraced_req_s=round(s_off, 6),
+        serve_traced_req_s=round(s_on, 6),
+        serve_overhead_pct=(round((serve_overhead - 1.0) * 100, 2)
+                            if serve_overhead else None),
+        recompiles_after_warmup=train_recompiles + serve_recompiles,
+        recorder_subsystems=recorder["subsystems"],
+        # the <2% contract is gated on the conv-net phase (the guard-
+        # taps precedent: a compute-dominated step, measured at ~±1%
+        # repeatability). The serving ratio is REPORTED, not gated:
+        # on this burstable CPU host its round times quantize on
+        # decode-window/admission phase alignment (±3% run-to-run,
+        # bimodal), which swamps the ~0.1% true span cost — a gate
+        # there would measure the weather
+        trace_ok=(train_overhead is not None
+                  and train_overhead < 1.02
+                  and train_recompiles + serve_recompiles == 0),
+        platform=devices[0].platform,
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(worst, unit="traced/untraced median time ratio", vs=None,
+          **record)
+
+
 def _parent():
     """Run the bench in a KILLABLE subprocess and own the one-JSON-line
     contract. A SIGALRM watchdog cannot interrupt a hang inside C code
@@ -1674,6 +1854,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
               else "mxguard_drill"
               if os.environ.get("MXTPU_BENCH_GUARD") == "1"
+              else "mxtrace_overhead"
+              if os.environ.get("MXTPU_BENCH_TRACE") == "1"
               else "resnet50_train_throughput")
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
@@ -1728,6 +1910,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_ELASTIC"] = "1"
     if "--guard" in sys.argv:
         os.environ["MXTPU_BENCH_GUARD"] = "1"
+    if "--trace-overhead" in sys.argv:
+        os.environ["MXTPU_BENCH_TRACE"] = "1"
     # fused whole-train-step compiler: default ON; --no-fused-step
     # measures the eager reference path instead (env form propagates
     # into the --child subprocess)
@@ -1743,6 +1927,7 @@ if __name__ == "__main__":
     _graphopt = os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
     _elastic = os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
     _guard = os.environ.get("MXTPU_BENCH_GUARD") == "1"
+    _tracebench = os.environ.get("MXTPU_BENCH_TRACE") == "1"
     if "--child" in sys.argv:
         try:
             if _serving3:
@@ -1761,6 +1946,8 @@ if __name__ == "__main__":
                 elastic_main()
             elif _guard:
                 guard_main()
+            elif _tracebench:
+                trace_main()
             else:
                 main()
         except Exception as e:
@@ -1773,6 +1960,7 @@ if __name__ == "__main__":
                           else "mxopt_speedup" if _graphopt
                           else "mxelastic_recovery" if _elastic
                           else "mxguard_drill" if _guard
+                          else "mxtrace_overhead" if _tracebench
                           else "resnet50_train_throughput"),
                   error=f"{type(e).__name__}: {e}"[:500])
             sys.exit(0)

@@ -591,6 +591,46 @@ register_flag(
     "instead classifies by re-execution and retries/quarantines "
     "regardless of this flag.")
 register_flag(
+    "MXTRACE", bool, True,
+    "Correlated cross-subsystem tracing (mxnet_tpu/trace/, docs/"
+    "observability.md): spans with trace_id/span_id/parent thread the "
+    "serving path (endpoint -> router -> scheduler -> prefill/decode/"
+    "verify) and the training path (step -> exchange -> guard vote -> "
+    "elastic rebuild), feed the per-phase latency histograms "
+    "(mxtrace_phase_*_seconds) and the crash flight recorder. On by "
+    "default: a span is two monotonic clock reads and a deque append "
+    "(<2% at default sampling, bench.py --trace-overhead enforces); "
+    "tracing never touches jit cache keys, so it can never recompile.")
+register_flag(
+    "MXTRACE_SAMPLE", float, 1.0,
+    "Fraction of ROOT traces recorded (trace.span): the decision is "
+    "made once where a trace starts (endpoint request, train step) "
+    "and inherited by every child span, so a dropped trace pays "
+    "~nothing. 1.0 = record everything (default); lower it on "
+    "high-QPS serving to bound export volume.")
+register_flag(
+    "MXTRACE_EXPORT", str, "",
+    "Path of the span JSON-lines sink (trace.export): every finished "
+    "sampled span appends one line. Read it with `tools/mxprof.py "
+    "trace <file>` or convert with trace.write_chrome. Empty = "
+    "export off (spans still reach the in-memory flight recorder).")
+register_flag(
+    "MXTRACE_BUFFER_SPANS", int, 4096,
+    "Per-thread finished-span buffer capacity (trace.span.drain "
+    "collects + clears them). Oldest spans drop first; the flight "
+    "recorder keeps its own per-subsystem rings.")
+register_flag(
+    "MXTRACE_RECORDER_SPANS", int, 256,
+    "Spans retained per subsystem in the crash flight recorder "
+    "(trace.recorder): the last-N window a dump freezes on breaker "
+    "trip / engine crash / GroupFailed / guard quarantine / watchdog "
+    "stall / SIGTERM.")
+register_flag(
+    "MXTRACE_DUMP_DIR", str, "",
+    "Directory for flight-recorder dump files (mxtrace-flight-"
+    "<reason>-<ts>.json). Empty = <tempdir>/mxtrace. Dumps are "
+    "rate-limited per reason (5 s) so failure storms stay readable.")
+register_flag(
     "MXRESIL_WATCHDOG_STALL_S", float, 0.0,
     "Heartbeat age that counts as a stall (resil.watchdog.Watchdog). "
     "0 = auto: 10x the step-time EWMA (min 1 s; 30 s before any step "

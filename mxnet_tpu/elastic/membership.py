@@ -58,7 +58,17 @@ class WorkerEvicted(MXNetError):
 class GroupFailed(MXNetError):
     """The group shrank below MXELASTIC_MIN_WORLD (or was explicitly
     failed): elastic adaptation is out of room and the job hard-fails
-    so the cluster manager restarts it from checkpoint."""
+    so the cluster manager restarts it from checkpoint.
+
+    Constructing one freezes the crash flight recorder (every raise
+    site is terminal for the job, so the dump is the last readable
+    timeline the operator gets — trace/recorder.py)."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        from ..trace import crash_dump
+        crash_dump("group_failed",
+                   site=str(args[0])[:120] if args else None)
 
 
 class ElasticTimeout(RetryableError):

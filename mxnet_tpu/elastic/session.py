@@ -221,19 +221,26 @@ class ElasticSession:
         internally if membership changes again mid-barrier."""
         old = self.view
         t0 = self._clock()
-        view = self.group.rebuild_barrier(self.worker_id)
-        self.view = view
-        self._round = 0
-        from ..telemetry import metrics as _metrics
-        _metrics.counter(
-            "mxelastic_rebuilds_total",
-            "generation rebuilds completed by this worker").inc()
-        _metrics.histogram(
-            "mxelastic_rebuild_seconds",
-            "rebuild-barrier latency (bump observed -> new view "
-            "agreed)").observe(self._clock() - t0)
-        if self._trainer is not None:
-            self._trainer._on_membership_change(old, view)
+        from .. import trace as _trace
+        with _trace.span("elastic.rebuild", "elastic",
+                         worker=self.worker_id,
+                         from_generation=old.generation if old
+                         else None) as _rb:
+            view = self.group.rebuild_barrier(self.worker_id)
+            self.view = view
+            self._round = 0
+            _rb.set(generation=view.generation,
+                    world=view.world_size)
+            from ..telemetry import metrics as _metrics
+            _metrics.counter(
+                "mxelastic_rebuilds_total",
+                "generation rebuilds completed by this worker").inc()
+            _metrics.histogram(
+                "mxelastic_rebuild_seconds",
+                "rebuild-barrier latency (bump observed -> new view "
+                "agreed)").observe(self._clock() - t0)
+            if self._trainer is not None:
+                self._trainer._on_membership_change(old, view)
         _log.info("worker %r rebuilt: generation %d, world %d",
                   self.worker_id, view.generation, view.world_size)
         return view

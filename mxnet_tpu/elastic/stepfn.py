@@ -46,6 +46,7 @@ import jax
 from ..base import MXNetError
 from ..ndarray.ndarray import _wrap
 from ..step.stepfn import StepFunction, _raw
+from .. import trace as _trace
 from .membership import MembershipChanged
 
 __all__ = ["ElasticStepFunction"]
@@ -299,8 +300,10 @@ class ElasticStepFunction(StepFunction):
         # re-contributes — the SAME deterministic verdict again tells
         # every worker how the step ends
         if me in suspects:
-            grads, _, _, fps_host = self._guard_grads(
-                grads_fn, pvals, inputs, rng)
+            with _trace.span("guard.reexec", "guard", step=step,
+                             suspect=me):
+                grads, _, _, fps_host = self._guard_grads(
+                    grads_fn, pvals, inputs, rng)
         table2 = table_of(session.allreduce(
             "__guard_fp2",
             contribution(fps_host[:1 + n_grads], rank, world)), world)
@@ -333,61 +336,98 @@ class ElasticStepFunction(StepFunction):
         from .. import telemetry as _telemetry
         t0 = time.perf_counter()
         session = self._session
-        # the step boundary IS the membership boundary
-        if session.heartbeat(self._nstep):
-            session.rebuild()
-        inputs = tuple(_raw(a) for a in (x,) + labels)
-        self._prepare(inputs)
-        if batch_size is None:
-            batch_size = int(inputs[0].shape[0]) if inputs[0].ndim \
-                else 1
-        self._set_rescale(batch_size)
-        guard = self._guard_enabled()
-
-        grads_fn = self._grad_fn(inputs, guard)
-        lrs, wds = self._hyper()
-        pvals, svals = self._gather()
-        from .. import random as _random
-        import jax.numpy as jnp
-        rng = jnp.asarray(rng_raw) if rng_raw is not None \
-            else jax.random.key_data(_random.next_key())
-        fps_host = None
-        if guard:
-            grads, extras, loss, fps_host = self._guard_grads(
-                grads_fn, pvals, inputs, rng)
-        else:
-            grads, extras, loss = grads_fn(pvals, inputs, rng)
-
-        t1 = time.perf_counter()
-        while True:
-            try:
-                if guard:
-                    # the pre-averaging vote: a corrupt replica is
-                    # caught BEFORE its gradients enter the allreduce
-                    grads, fps_host = self._guard_vote(
-                        grads_fn, pvals, inputs, rng, grads, fps_host)
-                reduced = self._exchange_once(grads)
-                break
-            except MembershipChanged:
-                # fenced mid-exchange: rebuild with the survivors and
-                # re-exchange the SAME gradients under the new
-                # generation — forward/backward is not recomputed
+        # the per-step trace root, keyed by (generation, step) — the
+        # cross-subsystem correlation key: heartbeat/rebuild, grad
+        # dispatch, guard vote, bucket exchange and update all
+        # decompose as children of this one span
+        with _trace.span("train.step", "train", step=self._nstep,
+                         generation=session.generation,
+                         world=session.world, fn=self._name,
+                         kind=type(self).__name__) as _st:
+            # the step boundary IS the membership boundary
+            with _trace.span("elastic.heartbeat", "elastic",
+                             step=self._nstep) as _hb:
+                changed = session.heartbeat(self._nstep)
+                _hb.set(generation_changed=changed)
+            if changed:
                 session.rebuild()
-                self._set_rescale(batch_size)
-        t2 = time.perf_counter()
+                _st.set(generation=session.generation,
+                        world=session.world)
+            inputs = tuple(_raw(a) for a in (x,) + labels)
+            self._prepare(inputs)
+            if batch_size is None:
+                batch_size = int(inputs[0].shape[0]) \
+                    if inputs[0].ndim else 1
+            self._set_rescale(batch_size)
+            guard = self._guard_enabled()
 
-        update_fn = self._update_fn()
-        tvals = {n: pvals[n] for n in self._trainable}
-        new_w, new_s = update_fn(tvals, svals, reduced, lrs, wds)
-        new_params = dict(zip(self._trainable, new_w))
-        new_params.update(extras)
-        self._writeback(new_params, new_s)
-        if guard:
-            flagged = any(e["step"] == self._nstep
-                          for e in self.guard_events)
-            self._guard_note(fps_host, loss, inputs, rng,
-                             good=not flagged, strict=False)
-        t3 = time.perf_counter()
+            with _trace.span("step.prep", "train"):
+                grads_fn = self._grad_fn(inputs, guard)
+                lrs, wds = self._hyper()
+                pvals, svals = self._gather()
+                from .. import random as _random
+                import jax.numpy as jnp
+                rng = jnp.asarray(rng_raw) if rng_raw is not None \
+                    else jax.random.key_data(_random.next_key())
+            fps_host = None
+            with _trace.span("step.grads", "train", guard=guard,
+                             batch=batch_size):
+                if guard:
+                    grads, extras, loss, fps_host = self._guard_grads(
+                        grads_fn, pvals, inputs, rng)
+                else:
+                    grads, extras, loss = grads_fn(pvals, inputs, rng)
+
+            t1 = time.perf_counter()
+            while True:
+                try:
+                    if guard:
+                        # the pre-averaging vote: a corrupt replica is
+                        # caught BEFORE its gradients enter the
+                        # allreduce
+                        with _trace.span("guard.vote", "guard",
+                                         step=self._nstep,
+                                         world=session.world):
+                            grads, fps_host = self._guard_vote(
+                                grads_fn, pvals, inputs, rng, grads,
+                                fps_host)
+                    with _trace.span(
+                            "step.exchange", "elastic",
+                            generation=session.generation,
+                            world=session.world) as _ex:
+                        reduced = self._exchange_once(grads)
+                        # bucket count from the layout _exchange_once
+                        # just memoized — rebuilding the O(n_params)
+                        # signature for a span attribute would tax
+                        # every step, traced or not
+                        if self._buckets is not None:
+                            _ex.set(buckets=len(
+                                self._buckets[0].buckets))
+                    break
+                except MembershipChanged:
+                    # fenced mid-exchange: rebuild with the survivors
+                    # and re-exchange the SAME gradients under the new
+                    # generation — forward/backward is not recomputed
+                    session.rebuild()
+                    self._set_rescale(batch_size)
+                    _st.set(generation=session.generation,
+                            world=session.world, rebuilt=True)
+            t2 = time.perf_counter()
+
+            with _trace.span("step.update", "train"):
+                update_fn = self._update_fn()
+                tvals = {n: pvals[n] for n in self._trainable}
+                new_w, new_s = update_fn(tvals, svals, reduced, lrs,
+                                         wds)
+                new_params = dict(zip(self._trainable, new_w))
+                new_params.update(extras)
+                self._writeback(new_params, new_s)
+            if guard:
+                flagged = any(e["step"] == self._nstep
+                              for e in self.guard_events)
+                self._guard_note(fps_host, loss, inputs, rng,
+                                 good=not flagged, strict=False)
+            t3 = time.perf_counter()
 
         self._nstep += 1
         session.note_step(batch_size)

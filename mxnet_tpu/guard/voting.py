@@ -73,6 +73,13 @@ class GuardQuarantined(MXNetError):
         self.worker_id = worker_id
         self.step = step
         self.reasons = list(reasons)
+        # quarantine is terminal for this replica: freeze the flight
+        # recorder so the dump's final spans name the vote/re-execute
+        # that convicted it (trace/recorder.py)
+        from ..trace import crash_dump
+        crash_dump("guard_quarantine", site=worker_id,
+                   extra={"step": step,
+                          "reasons": sorted(set(reasons))})
 
 
 class GuardCorruption(MXNetError):

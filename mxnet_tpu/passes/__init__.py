@@ -206,7 +206,7 @@ def findings_report(tool: str, findings: Iterable[Finding],
 def default_manager() -> PassManager:
     from . import (oplint, graphlint, tracercheck, dispatchlint,
                    steplint, shardlint, servelint, elasticlint,
-                   guardlint)
+                   guardlint, metriclint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
@@ -217,4 +217,5 @@ def default_manager() -> PassManager:
     pm.register(servelint.ServeLint())
     pm.register(elasticlint.ElasticAbortAudit())
     pm.register(guardlint.GuardLint())
+    pm.register(metriclint.MetricLint())
     return pm

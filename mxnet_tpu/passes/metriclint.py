@@ -1,0 +1,78 @@
+"""metriclint: registered-but-never-retired per-instance gauge audit.
+
+The leak class (fixed by hand in PRs 8, 10 and 11, now lint-enforced):
+per-instance instruments — per-engine pool gauges, per-replica
+breaker/depth gauges, per-probe EWMA gauges — are registered at
+construction; when their owning object closes without unregistering
+them, a dead engine keeps publishing a "live, fully-free" pool in
+``/metrics`` forever. The telemetry registry now carries **owner
+tokens** (:func:`mxnet_tpu.telemetry.metrics.owner`): an instance
+adopts its instrument names at construction and ``close()``s the token
+when it retires them. This pass flags:
+
+- ``closed-owner-live-gauge`` (error) — an instrument adopted by a
+  CLOSED owner is still registered: the leak itself;
+- ``owner-no-instruments`` (info) — a closed owner that never adopted
+  anything (dead wiring: the token exists but protects nothing).
+
+Targets: ``None`` (or any non-fixture object, as ``run_all`` passes)
+audits the LIVE registry + owner ledger; a fixture dict
+``{"owners": [{"owner", "closed", "names"}], "live": [names]}`` audits
+synthetic state — the bad-fixture coverage path ``mxlint --metrics``
+exercises so the lint can never go vacuous.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from . import Finding, Pass
+
+__all__ = ["MetricLint", "lint_owner_ledger"]
+
+
+def lint_owner_ledger(owner_rows: Iterable[Dict[str, object]],
+                      live: Iterable[str]) -> List[Finding]:
+    """The core audit over (owner descriptions, live instrument
+    names) — shared by the live-registry and fixture paths."""
+    live_set = set(live)
+    findings: List[Finding] = []
+    for row in owner_rows:
+        name = str(row.get("owner", "?"))
+        closed = bool(row.get("closed"))
+        names = [str(n) for n in (row.get("names") or ())]
+        if not closed:
+            continue
+        if not names:
+            findings.append(Finding(
+                "metriclint", "owner-no-instruments", name, "info",
+                "owner token closed without ever adopting an "
+                "instrument — dead wiring, or the instruments were "
+                "registered without adoption and escape this audit"))
+            continue
+        for n in sorted(n for n in names if n in live_set):
+            findings.append(Finding(
+                "metriclint", "closed-owner-live-gauge", n, "error",
+                f"instrument {n!r} is still registered but its owner "
+                f"{name!r} closed — a retired engine/replica/probe "
+                "keeps publishing stale values in /metrics; call "
+                "telemetry.metrics.unregister before closing the "
+                "owner (the per-engine-gauge leak class of PRs "
+                "8/10/11)"))
+    return findings
+
+
+class MetricLint(Pass):
+    """See module docstring."""
+
+    name = "metriclint"
+
+    def run(self, target=None) -> List[Finding]:
+        from ..telemetry import metrics as _metrics
+        if isinstance(target, dict) and "owners" in target:
+            return lint_owner_ledger(
+                target.get("owners") or (),
+                target.get("live") or ())
+        # any other target (run_all hands every pass the same object)
+        # -> audit the live registry
+        rows = [t.describe() for t in _metrics.owners()]
+        return lint_owner_ledger(rows, _metrics.all_metrics().keys())

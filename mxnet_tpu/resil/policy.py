@@ -244,13 +244,22 @@ class CircuitBreaker:
             self._failures += 1
             tripped = self._state == self.HALF_OPEN or \
                 self._failures >= self.failure_threshold
-            if tripped and self._state != self.OPEN:
+            fresh_trip = tripped and self._state != self.OPEN
+            if fresh_trip:
                 self._state = self.OPEN
                 self._opened_at = self._clock()
                 self._probing = False
                 self._m_trips.inc()
             elif tripped:  # re-trip from half-open probe failure
                 self._opened_at = self._clock()
+        if fresh_trip:
+            # freeze the last-N-spans picture at the moment the
+            # breaker opened: the dump's final spans show what the
+            # replica was doing when it started failing
+            # (trace/recorder.py; rate-limited per reason)
+            from ..trace import crash_dump
+            crash_dump("breaker_trip", site=self.name,
+                       extra={"consecutive_failures": self._failures})
 
     def describe(self) -> dict:
         with self._lock:

@@ -187,12 +187,22 @@ class Watchdog:
             self._g_age.set(age)
             if age > threshold:
                 self._m_stalls.inc()
+                # a stall verdict freezes the flight recorder: the
+                # dump's last spans show what the step loop was doing
+                # when it stopped beating (trace/recorder.py)
+                from ..trace import crash_dump
+                dump = crash_dump(
+                    "watchdog_stall",
+                    extra={"age_s": round(age, 3),
+                           "threshold_s": round(threshold, 3)})
                 findings.append(Finding(
                     "watchdog", "stall", "trainer", "error",
                     f"no heartbeat for {age:.1f}s (threshold "
                     f"{threshold:.1f}s"
                     + (f", step EWMA {ewma:.3f}s" if ewma else "")
-                    + ") — the step loop looks wedged"))
+                    + ") — the step loop looks wedged"
+                    + (f"; flight recorder dumped to {dump}"
+                       if dump else "")))
         if queue_since is not None:
             q_age = now - queue_since
             if q_age > threshold:

@@ -34,6 +34,7 @@ import numpy as onp
 
 from ..base import MXNetError
 from ..telemetry import metrics as _metrics
+from .. import trace as _trace
 from .batcher import (BatcherStoppedError, DeadlineExceededError,
                       QueueFullError)
 from .engine import ServingEngine
@@ -181,11 +182,13 @@ class _Handler(BaseHTTPRequestHandler):
             super().log_message(fmt, *args)
 
     # -- helpers -------------------------------------------------------
-    def _send(self, code: int, obj):
+    def _send(self, code: int, obj, headers=None):
         body = _json_bytes(obj)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -298,49 +301,97 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(404, {"error": f"unknown verb {verb!r}"})
         return self._send(404, {"error": f"no route {path!r}"})
 
+    # latency histogram tagging: EVERY request (error paths included)
+    # lands in the base histogram AND an outcome-suffixed one — error
+    # storms must move p99, not flatter it by only sampling successes
+    _OUTCOME_OF_CODE = {200: "ok", 400: "bad_request", 429: "shed",
+                        503: "unavailable", 504: "deadline",
+                        500: "error"}
+
     def _predict(self, ep: "ServingEndpoint", engine: ServingEngine):
-        if ep.draining:
-            return self._send(503, {"error": "endpoint is draining"})
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
-            if not isinstance(payload, dict):
-                raise TypeError(
-                    f"body must be a JSON object, got "
-                    f"{type(payload).__name__}")
-            inputs = payload["inputs"]
-        except (ValueError, KeyError, TypeError) as e:
-            return self._send(400, {"error": f"bad JSON body: {e}"})
-        specs = engine.input_specs
-        try:
-            if specs and len(specs) > 1:
-                data = [onp.asarray(x, dtype=s.dtype)
-                        for x, s in zip(inputs, specs)]
-            else:
-                dtype = specs[0].dtype if specs else "float32"
-                data = onp.asarray(inputs, dtype=dtype)
-        except (ValueError, TypeError) as e:
-            return self._send(400, {"error": f"bad inputs: {e}"})
         t0 = time.perf_counter()
+        code = 500
+        # the request ROOT span: everything below (router pick,
+        # scheduler phases, dispatches) parents under this trace, and
+        # the id is echoed so clients can hand it to mxprof trace
+        with _trace.span("serve.request", "serve",
+                         model=engine.name) as sp:
+            hdrs = {"X-MXTrace-Id": sp.trace_id} if sp.trace_id \
+                else None
+            try:
+                code, obj = self._predict_inner(ep, engine, t0, sp)
+            finally:
+                dt = time.perf_counter() - t0
+                outcome = self._OUTCOME_OF_CODE.get(code, "error")
+                sp.set(status_code=code, outcome=outcome)
+                _metrics.histogram(
+                    "mxserve_request_seconds",
+                    "endpoint predict wall time, ALL outcomes"
+                    ).observe(dt)
+                _metrics.histogram(
+                    f"mxserve_request_seconds_{outcome}",
+                    f"endpoint predict wall time, outcome="
+                    f"{outcome}").observe(dt)
+            with _trace.span("serve.respond", "serve",
+                             status_code=code):
+                return self._send(code, obj, headers=hdrs)
+
+    def _predict_inner(self, ep: "ServingEndpoint",
+                       engine: ServingEngine, t0: float, sp):
+        if ep.draining:
+            return 503, {"error": "endpoint is draining"}
+        with _trace.span("serve.parse", "serve"):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(payload, dict):
+                    raise TypeError(
+                        f"body must be a JSON object, got "
+                        f"{type(payload).__name__}")
+                inputs = payload["inputs"]
+            except (ValueError, KeyError, TypeError) as e:
+                return 400, {"error": f"bad JSON body: {e}"}
+            specs = engine.input_specs
+            try:
+                if specs and len(specs) > 1:
+                    data = [onp.asarray(x, dtype=s.dtype)
+                            for x, s in zip(inputs, specs)]
+                else:
+                    dtype = specs[0].dtype if specs else "float32"
+                    data = onp.asarray(inputs, dtype=dtype)
+            except (ValueError, TypeError) as e:
+                return 400, {"error": f"bad inputs: {e}"}
         try:
             out = engine.predict(
                 data, timeout_ms=payload.get("timeout_ms"))
         except QueueFullError as e:
-            return self._send(429, {"error": str(e)})
+            return 429, {"error": str(e)}
         except DeadlineExceededError as e:
-            return self._send(504, {"error": str(e)})
+            return 504, {"error": str(e)}
         except BatcherStoppedError as e:
-            return self._send(503, {"error": str(e)})
+            return 503, {"error": str(e)}
         except MXNetError as e:
-            return self._send(400, {"error": str(e)})
+            # a routed model with every replica refusing is a SERVER
+            # outage, not a client error: it must land in the
+            # 'unavailable' outcome histogram (and give clients a
+            # retryable 503), or an outage storm files as bad_request
+            # (lazy import: serve2.router imports this module)
+            from ..serve2.router import AllReplicasUnavailable
+            if isinstance(e, AllReplicasUnavailable):
+                return 503, {"error": str(e)}
+            return 400, {"error": str(e)}
         except Exception as e:  # noqa: BLE001 — model/jax errors: the
             # client must get a JSON 500, not a dropped connection
-            return self._send(500, {"error": f"{type(e).__name__}: {e}"})
-        outs = [o.tolist() for o in out] if isinstance(out, list) \
-            else out.tolist()
-        return self._send(200, {
-            "outputs": outs, "model": engine.name,
-            "latency_ms": round((time.perf_counter() - t0) * 1000.0, 3)})
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+        with _trace.span("serve.encode", "serve"):
+            outs = [o.tolist() for o in out] if isinstance(out, list) \
+                else out.tolist()
+            body = {"outputs": outs, "model": engine.name,
+                    "latency_ms": round((time.perf_counter() - t0)
+                                        * 1000.0, 3)}
+            if sp.trace_id:
+                body["trace_id"] = sp.trace_id
+            return 200, body
 
 
 class ServingEndpoint:
@@ -367,6 +418,11 @@ class ServingEndpoint:
         return f"http://{host}:{port}"
 
     def start(self, background: bool = True):
+        # wire the SIGTERM flight-dump trigger while we are still ON
+        # the main thread: in blocking mode serve_forever never
+        # returns, and handler/scheduler threads can't install signal
+        # handlers (trace/recorder.py; no-op when already installed)
+        _trace.install_signal_handler()
         if background:
             self._thread = threading.Thread(
                 target=self._server.serve_forever,

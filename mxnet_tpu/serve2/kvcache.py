@@ -192,6 +192,11 @@ class PageAllocator:
                 "pages_used": self.num_pages - 1 - free,
                 "pages_shared": shared}
 
+    def gauge_names(self) -> List[str]:
+        """This pool's per-engine instrument names — the owning engine
+        adopts them onto its metriclint owner token."""
+        return [self._g_total.name, self._g_free.name]
+
     def retire_gauges(self) -> None:
         """Unregister this pool's per-engine gauges (engine close)."""
         _metrics.unregister(self._g_total.name)

@@ -65,6 +65,7 @@ from ..serve.endpoint import ModelRegistry
 from .kvcache import PagePoolExhausted, _gauge_tag
 from .scheduler import EngineCrashedError
 from ..telemetry import metrics as _metrics
+from .. import trace as _trace
 
 __all__ = ["Router", "RoutedModel", "AllReplicasUnavailable"]
 
@@ -90,7 +91,7 @@ class AllReplicasUnavailable(MXNetError):
 
 class _Replica:
     __slots__ = ("rname", "engine", "breaker", "inflight", "lock",
-                 "version", "depth_gauge", "breaker_gauge")
+                 "version", "depth_gauge", "breaker_gauge", "owner")
 
     def __init__(self, rname: str, engine, version: int):
         self.rname = rname
@@ -105,6 +106,9 @@ class _Replica:
         self.breaker_gauge = _metrics.gauge(
             f"mxserve2_replica_breaker_open_{_gauge_tag(rname)}",
             f"1 while replica {rname}'s circuit breaker is not closed")
+        # metriclint owner: retire_gauges() must run before close
+        self.owner = _metrics.owner(f"Replica:{rname}")
+        self.owner.adopt(self.depth_gauge, self.breaker_gauge)
 
     def depth(self) -> int:
         # the engine's own queue depth already counts a request for the
@@ -133,6 +137,7 @@ class _Replica:
         router's replicas don't linger in /metrics as live ones."""
         _metrics.unregister(self.depth_gauge.name)
         _metrics.unregister(self.breaker_gauge.name)
+        self.owner.close()
 
 
 class _Group:
@@ -248,57 +253,85 @@ class Router:
         error taxonomy."""
         group = self._group(model)
         self._m_routed.inc()
-        # rotate BEFORE the stable sort: a key of next(self._rr) would
-        # always hand equal-depth ties to the lowest-index replica
-        # (sorted evaluates keys in list order) — serialized traffic
-        # would never leave replica 0
-        reps = group.replicas
-        start = next(self._rr) % len(reps)
-        rotated = reps[start:] + reps[:start]
-        order = sorted(rotated, key=lambda r: r.depth())
         last_err: Optional[BaseException] = None
-        for attempt, rep in enumerate(order):
-            try:
-                rep.breaker.check()
-            except CircuitOpenError as e:
-                last_err = e
-                continue
-            engine = rep.engine  # snapshot: a concurrent swap must not
-            # change the engine between the call and the outcome record
-            with rep.lock:
-                rep.inflight += 1
-            try:
-                out = engine.predict(data, timeout_ms=timeout_ms)
-                rep.breaker.record_success()
-                return out
-            except _CLIENT_ERRORS:
-                raise
-            except EngineCrashedError as e:
-                rep.breaker.record_failure()
-                last_err = e
-                self._m_retried.inc()
-                continue
-            except _BACKPRESSURE as e:
-                last_err = e
-                self._m_retried.inc()
-                continue
-            except Exception as e:  # noqa: BLE001 — replica failure
-                # Exception, not BaseException: KeyboardInterrupt/
-                # SystemExit must propagate, not count as a replica
-                # failure and silently retry elsewhere
-                rep.breaker.record_failure()
-                last_err = e
-                self._m_retried.inc()
-                continue
-            finally:
-                with rep.lock:
-                    rep.inflight -= 1
-                rep.export()
-        self._m_dropped.inc()
-        raise AllReplicasUnavailable(
-            f"model {model!r}: all {len(order)} replicas refused "
-            f"(last: {type(last_err).__name__}: {last_err})"
-        ) from last_err
+        # the route span parents the whole pick/failover under the
+        # endpoint's request span (or roots a trace for direct router
+        # callers). The depth-sorted pick happens INSIDE it: depth()
+        # takes each engine's scheduler lock, so contention there is
+        # real queueing the trace must attribute, not lose.
+        with _trace.span("serve.route", "serve2", model=model) as _rt:
+            # rotate BEFORE the stable sort: a key of next(self._rr)
+            # would always hand equal-depth ties to the lowest-index
+            # replica (sorted evaluates keys in list order) —
+            # serialized traffic would never leave replica 0. Depths
+            # are captured ONCE here: the attempt spans reuse them
+            # instead of re-taking each engine's scheduler lock per
+            # attribute (which would tax the path even traced-off)
+            reps = group.replicas
+            start = next(self._rr) % len(reps)
+            rotated = reps[start:] + reps[:start]
+            keyed = sorted(((r.depth(), i, r)
+                            for i, r in enumerate(rotated)),
+                           key=lambda t: (t[0], t[1]))
+            order = [(d, r) for d, _, r in keyed]
+            _rt.set(replicas=len(order))
+            for attempt, (depth, rep) in enumerate(order):
+                with _trace.span("serve.attempt", "serve2",
+                                 replica=rep.rname,
+                                 depth=depth) as _at:
+                    try:
+                        rep.breaker.check()
+                    except CircuitOpenError as e:
+                        last_err = e
+                        _at.set(outcome="breaker_open",
+                                breaker=rep.breaker.state)
+                        continue
+                    engine = rep.engine  # snapshot: a concurrent swap
+                    # must not change the engine between the call and
+                    # the outcome record
+                    with rep.lock:
+                        rep.inflight += 1
+                    try:
+                        out = engine.predict(data,
+                                             timeout_ms=timeout_ms)
+                        rep.breaker.record_success()
+                        _at.set(outcome="ok")
+                        _rt.set(picked=rep.rname,
+                                attempts=attempt + 1)
+                        return out
+                    except _CLIENT_ERRORS:
+                        raise
+                    except EngineCrashedError as e:
+                        rep.breaker.record_failure()
+                        last_err = e
+                        self._m_retried.inc()
+                        _at.set(outcome="crashed")
+                        continue
+                    except _BACKPRESSURE as e:
+                        last_err = e
+                        self._m_retried.inc()
+                        _at.set(outcome="backpressure")
+                        continue
+                    except Exception as e:  # noqa: BLE001 — replica
+                        # failure. Exception, not BaseException:
+                        # KeyboardInterrupt/SystemExit must propagate,
+                        # not count as a replica failure and silently
+                        # retry elsewhere
+                        rep.breaker.record_failure()
+                        last_err = e
+                        self._m_retried.inc()
+                        _at.set(outcome="failed")
+                        continue
+                    finally:
+                        with rep.lock:
+                            rep.inflight -= 1
+                        rep.export()
+            self._m_dropped.inc()
+            _rt.set(dropped=True)
+            raise AllReplicasUnavailable(
+                f"model {model!r}: all {len(order)} replicas refused "
+                f"(last: {type(last_err).__name__}: {last_err})"
+            ) from last_err
 
     # ------------------------------------------------------------------
     # rolling reload

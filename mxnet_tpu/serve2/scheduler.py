@@ -59,6 +59,7 @@ import numpy as onp
 
 from ..base import MXNetError
 from ..telemetry import metrics as _metrics
+from .. import trace as _trace
 from ..serve.batcher import (BatcherStoppedError, DeadlineExceededError,
                              InvalidRequestError)
 from ..serve.buckets import BucketOverflowError
@@ -99,7 +100,8 @@ class GenerationHandle:
 
 class _Seq:
     __slots__ = ("sid", "prompt", "generated", "max_new", "bt",
-                 "handle", "admit_idx", "_keys", "_keys_len")
+                 "handle", "admit_idx", "_keys", "_keys_len",
+                 "tctx", "t_submit_ns", "t_admit_ns")
 
     def __init__(self, sid: int, prompt: List[int], max_new: int):
         self.sid = sid
@@ -109,6 +111,13 @@ class _Seq:
         self.bt: Optional[BlockTable] = None
         self.handle = GenerationHandle(sid)
         self.admit_idx = -1  # monotone per (re)admission: preemption age
+        # mxtrace: the submitter's span context rides the sequence so
+        # the scheduler thread can emit this request's queue/admission/
+        # decode phase spans into the SAME trace (cross-thread
+        # propagation, docs/observability.md)
+        self.tctx = _trace.current_context()
+        self.t_submit_ns = time.perf_counter_ns()
+        self.t_admit_ns: Optional[int] = None
         # memoized prefix-cache chain keys for the effective prompt of
         # this length: a pool-pressure requeue retries admission every
         # tick, and re-hashing the whole prompt each time would burn
@@ -299,6 +308,31 @@ class DecodeEngine:
         self._m_accept_rate = _metrics.gauge(
             f"mxserve3_accept_rate_{tag}",
             f"cumulative draft-acceptance rate in engine {name!r}")
+        # metriclint owner token: every per-engine instrument above is
+        # adopted here and must be unregistered before close() marks
+        # the token closed — the audit that ends the per-engine-gauge
+        # leak class (passes/metriclint.py)
+        self._owner = _metrics.owner(f"DecodeEngine:{name}")
+        self._owner.adopt(
+            self._m_inflight, self._m_waiting, self._m_prefix_hits,
+            self._m_pages_shared, self._m_cow, self._m_tokens_avoided,
+            self._m_spec_proposed, self._m_spec_accepted,
+            self._m_accept_rate, *self.alloc.gauge_names())
+        # mxtrace per-request phase decomposition (global histograms —
+        # p50/p99 ride the registry's reservoir quantiles)
+        self._h_queue = _metrics.histogram(
+            "mxtrace_phase_queue_seconds",
+            "serve2 request phase: submit to scheduler admission pop")
+        self._h_admit = _metrics.histogram(
+            "mxtrace_phase_admission_seconds",
+            "serve2 request phase: page alloc + prefix lookup + "
+            "prefill dispatch")
+        self._h_prefill = _metrics.histogram(
+            "mxtrace_phase_prefill_seconds",
+            "serve2 prefill/prefill_ext dispatch within admission")
+        self._h_decode = _metrics.histogram(
+            "mxtrace_phase_decode_seconds",
+            "serve2 request phase: admission end to sequence finish")
 
     # ------------------------------------------------------------------
     # intake
@@ -390,7 +424,15 @@ class DecodeEngine:
                 f"ids), got shape {arr.shape}")
         handle = self.submit(arr)
         budget = timeout_ms / 1000.0 if timeout_ms is not None else None
-        if not handle.wait(budget):
+        # the wait span covers the whole submit-to-result window on
+        # the caller's thread (queue/admit/decode phases from the
+        # scheduler thread land inside it, plus the wakeup gap none
+        # of them can see)
+        with _trace.span("serve2.wait", "serve2", sid=handle.sid,
+                         engine=self.name) as _w:
+            done = handle.wait(budget)
+            _w.set(done=done)
+        if not done:
             handle.cancelled = True
             with self._cv:
                 self._cv.notify_all()
@@ -433,6 +475,11 @@ class DecodeEngine:
         err = EngineCrashedError(
             f"engine {self.name!r} scheduler crashed: {exc!r}")
         err.__cause__ = exc
+        # the flight recorder freezes the last-N-spans picture NOW —
+        # the dump's final spans name this engine and the exception
+        _trace.crash_dump("engine_crashed", site=self.name,
+                          extra={"error": repr(exc)[:500],
+                                 "pending": len(pending)})
         for s in pending:
             if s.bt is not None and s.bt.pages:
                 try:
@@ -468,10 +515,22 @@ class DecodeEngine:
                     break
             if seq is None:
                 break
+            t_pop = time.perf_counter_ns()
+            _trace.emit("serve2.queue", "serve2", seq.t_submit_ns,
+                        t_pop, parent=seq.tctx,
+                        attrs={"sid": seq.sid, "engine": self.name})
+            self._h_queue.observe((t_pop - seq.t_submit_ns) / 1e9)
             try:
                 # prefix-cache lookup + page alloc + (suffix) prefill;
-                # device dispatches inside, lock released
-                admitted = self._admit_one(seq)
+                # device dispatches inside, lock released. The admit
+                # span parents under the REQUEST's context (seq.tctx)
+                # so lookup/prefill children land in the same trace.
+                with _trace.under(seq.tctx):
+                    with _trace.span("serve2.admit", "serve2",
+                                     sid=seq.sid,
+                                     engine=self.name) as _adm:
+                        admitted = self._admit_one(seq)
+                        _adm.set(admitted=admitted)
             except BaseException:
                 # put the seq back where _crash (via the caller's
                 # except) can see and fail it — never strand a handle
@@ -483,11 +542,17 @@ class DecodeEngine:
                 # the pool cannot host this request right now, even
                 # after evicting idle prefix-cache pages: requeue at
                 # the FRONT (arrival order preserved) and stop
-                # admitting until decode progress frees pages
+                # admitting until decode progress frees pages. The
+                # queue stamp re-arms so the NEXT queue span covers
+                # the requeue wait (phase coverage stays honest under
+                # pool pressure).
+                seq.t_submit_ns = time.perf_counter_ns()
                 with self._cv:
                     self._admitting -= 1
                     self._waiting.appendleft(seq)
                 break
+            seq.t_admit_ns = time.perf_counter_ns()
+            self._h_admit.observe((seq.t_admit_ns - t_pop) / 1e9)
             with self._cv:
                 self._admitting -= 1
                 self._n_tokens += 1
@@ -531,25 +596,36 @@ class DecodeEngine:
                 lengths[i] = s.bt.length
                 tokens[i] = s.generated[-1]
                 remaining[i] = min(win, s.max_new - len(s.generated))
-            # device dispatches, lock released
-            if self.spec:
-                # propose: ONE draft dispatch folds K+1 in-device
-                # iterations (the extra one appends the K-th draft
-                # token's own draft-KV for the next tick)
-                W = self.spec_tokens + 1
-                d_out, _ = self.draft.decode(bt, lengths, tokens,
-                                             remaining)
-                cands = onp.zeros((rung, W), "int32")
-                cands[:, 0] = tokens
-                cands[:, 1:] = d_out[:, :W - 1]
-                # verify: ONE batched target forward over all W
-                # candidates of every row — the single-dispatch-per-
-                # tick invariant, generalized from n-step
-                out, acc, _ = self.lm.verify(bt, lengths, cands,
-                                             remaining)
-            else:
-                out, _ = self.lm.decode(bt, lengths, tokens, remaining)
-                acc = remaining
+            # device dispatches, lock released. The tick's dispatch
+            # span roots its OWN trace (one compiled window serves
+            # many requests — per-request attribution is the decode
+            # phase span each sequence emits at finish; sids ride
+            # those, not this per-tick hot-path span).
+            with _trace.span("serve2.dispatch", "serve2",
+                             engine=self.name, rows=n, rung=rung,
+                             kind="spec" if self.spec else "decode"):
+                if self.spec:
+                    # propose: ONE draft dispatch folds K+1 in-device
+                    # iterations (the extra one appends the K-th draft
+                    # token's own draft-KV for the next tick)
+                    W = self.spec_tokens + 1
+                    with _trace.span("serve2.draft", "serve2", rows=n):
+                        d_out, _ = self.draft.decode(bt, lengths,
+                                                     tokens, remaining)
+                    cands = onp.zeros((rung, W), "int32")
+                    cands[:, 0] = tokens
+                    cands[:, 1:] = d_out[:, :W - 1]
+                    # verify: ONE batched target forward over all W
+                    # candidates of every row — the single-dispatch-
+                    # per-tick invariant, generalized from n-step
+                    with _trace.span("serve2.verify", "serve2",
+                                     rows=n, width=W):
+                        out, acc, _ = self.lm.verify(bt, lengths,
+                                                     cands, remaining)
+                else:
+                    out, _ = self.lm.decode(bt, lengths, tokens,
+                                            remaining)
+                    acc = remaining
             with self._cv:
                 for i, s in enumerate(seqs):
                     taken = int(acc[i])
@@ -637,7 +713,10 @@ class DecodeEngine:
                 seq._keys = page_keys(eff, page)
                 seq._keys_len = len(eff)
             keys = seq._keys
-            shared = self.prefix.lookup(keys)   # increfed for us
+            with _trace.span("serve2.prefix_lookup", "serve2",
+                             sid=seq.sid, keys=len(keys)) as _pl:
+                shared = self.prefix.lookup(keys)   # increfed for us
+                _pl.set(hit_pages=len(shared))
         cow_src: Optional[int] = None
         if shared and len(shared) * page == len(eff):
             # FULL coverage: every position is cached, but the next
@@ -674,17 +753,21 @@ class DecodeEngine:
             # (the crash path frees seq.bt.pages)
             seq.bt = bt
             bt_row = bt.row(self.max_pages_per_seq)
+            t_pf = time.perf_counter_ns()
             if start > 0:
                 suffix = eff[start:]
                 rung = min(r for r in self.prefill_rungs
                            if r >= len(suffix))
                 padded = onp.zeros((rung,), "int32")
                 padded[:len(suffix)] = suffix
-                nxt, _ = self.lm.prefill_ext(padded, start,
-                                             len(suffix), bt_row)
-                if self.draft is not None:
-                    self.draft.prefill_ext(padded, start, len(suffix),
-                                           bt_row)
+                with _trace.span("serve2.prefill_ext", "serve2",
+                                 sid=seq.sid, suffix=len(suffix),
+                                 cached=start, rung=rung):
+                    nxt, _ = self.lm.prefill_ext(padded, start,
+                                                 len(suffix), bt_row)
+                    if self.draft is not None:
+                        self.draft.prefill_ext(padded, start,
+                                               len(suffix), bt_row)
                 self._n_prefix_hits += 1
                 self._m_prefix_hits.inc()
                 self._n_tokens_avoided += start
@@ -694,9 +777,14 @@ class DecodeEngine:
                            if r >= len(eff))
                 padded = onp.zeros((rung,), "int32")
                 padded[:len(eff)] = eff
-                nxt, _ = self.lm.prefill(padded, len(eff), bt_row)
-                if self.draft is not None:
-                    self.draft.prefill(padded, len(eff), bt_row)
+                with _trace.span("serve2.prefill", "serve2",
+                                 sid=seq.sid, tokens=len(eff),
+                                 rung=rung):
+                    nxt, _ = self.lm.prefill(padded, len(eff), bt_row)
+                    if self.draft is not None:
+                        self.draft.prefill(padded, len(eff), bt_row)
+            self._h_prefill.observe(
+                (time.perf_counter_ns() - t_pf) / 1e9)
         except BaseException:
             if seq.bt is None and held:
                 self.alloc.free(held)           # never leak references
@@ -768,6 +856,20 @@ class DecodeEngine:
         self._n_preempt += 1
         self._m_preempt.inc()
         self._m_waiting.set(len(self._waiting))
+        # trace: close the preempted decode phase and re-arm the queue
+        # stamp — the request's next phases start from here. The
+        # segment ALSO lands in the decode histogram: preemption
+        # storms are exactly when decode p99 must not under-report
+        now = time.perf_counter_ns()
+        if seq.t_admit_ns is not None:
+            _trace.emit("serve2.decode", "serve2", seq.t_admit_ns,
+                        now, parent=seq.tctx,
+                        attrs={"sid": seq.sid, "engine": self.name,
+                               "preempted": True,
+                               "tokens": len(seq.generated)})
+            self._h_decode.observe((now - seq.t_admit_ns) / 1e9)
+        seq.t_admit_ns = None
+        seq.t_submit_ns = now
 
     def _finish_if_done(self, seq: _Seq):
         done = (len(seq.generated) >= seq.max_new
@@ -784,6 +886,14 @@ class DecodeEngine:
 
     def _resolve(self, seq: _Seq):
         self._n_finished += 1
+        if seq.t_admit_ns is not None:
+            now = time.perf_counter_ns()
+            _trace.emit("serve2.decode", "serve2", seq.t_admit_ns,
+                        now, parent=seq.tctx,
+                        attrs={"sid": seq.sid, "engine": self.name,
+                               "tokens": len(seq.generated)})
+            self._h_decode.observe((now - seq.t_admit_ns) / 1e9)
+            seq.t_admit_ns = None
         seq.handle.result = onp.asarray(seq.generated, "int32")
         seq.handle.event.set()
 
@@ -845,6 +955,9 @@ class DecodeEngine:
                   self._m_spec_proposed, self._m_spec_accepted,
                   self._m_accept_rate):
             _metrics.unregister(m.name)
+        # all adopted instruments are retired: closing the owner now
+        # is what keeps this engine out of the metriclint audit
+        self._owner.close()
 
     def stats(self) -> dict:
         with self._cv:

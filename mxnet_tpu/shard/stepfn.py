@@ -159,6 +159,12 @@ class ShardedStepFunction(StepFunction):
     def _shard_key(self):
         return (self._plan.fingerprint(),)
 
+    def _miss_signature_extra(self):
+        # the plan fingerprint rides the recompile record so a re-plan
+        # on identical shapes classifies as ``key-change`` (the honest
+        # re-key), not cache eviction — tools/mxprof.py step renders it
+        return {"plan": self._plan.fingerprint()}
+
     def _make_jit(self, pure, guard=False):
         if not self._installed:
             self.install()

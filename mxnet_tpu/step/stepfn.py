@@ -503,6 +503,14 @@ class StepFunction:
                 self._resolve_block_params(inputs[0])
                 self._cache.clear()
 
+    def _miss_signature_extra(self):
+        """Non-shape signature keys for the recompile record —
+        subclasses whose cache key carries more than shapes/dtypes
+        (the sharded step's plan fingerprint) report them here so the
+        auditor classifies their re-keys as ``key-change`` instead of
+        cache eviction."""
+        return {}
+
     def _record_miss(self, inputs):
         """Count + classify one signature-cache miss (the recompile
         auditor's fused_step kind)."""
@@ -511,10 +519,10 @@ class StepFunction:
         _metrics.counter(
             "fused_step_cache_misses_total",
             "fused-step signature-cache misses (compiles)").inc()
+        sig = _recompile.signature_of([_wrap(v) for v in inputs], True)
+        sig.update(self._miss_signature_extra())
         _recompile.record_recompile(
-            f"StepFunction:{self._name}",
-            _recompile.signature_of([_wrap(v) for v in inputs], True),
-            kind="fused_step")
+            f"StepFunction:{self._name}", sig, kind="fused_step")
 
     def step(self, x, *labels, batch_size=None, rng_raw=None):
         """Run one fused training step; returns the loss NDArray.
@@ -522,6 +530,7 @@ class StepFunction:
         deterministic-replay hook (mxnet_tpu/guard/replay.py)."""
         from ..telemetry import metrics as _metrics
         from .. import telemetry as _telemetry
+        from .. import trace as _trace
         t0 = time.perf_counter()
         inputs = tuple(_raw(a) for a in (x,) + labels)
         self._prepare(inputs)
@@ -530,57 +539,73 @@ class StepFunction:
         self._optimizer.rescale_grad = self._scale / batch_size
         guard = self._guard_enabled()
 
-        # key on input signature + parameter dtypes + every scalar the
-        # trace bakes in (rescale_grad, clip, momentum, betas, ... —
-        # fused_signature), so mid-run hyperparameter mutation and
-        # Parameter.cast retrace VISIBLY (counted as misses, recorded
-        # by the recompile auditor) instead of silently. The mxguard
-        # tap flag re-keys the same way (taps are extra outputs of the
-        # program — a different program).
-        key = (tuple((tuple(v.shape), str(v.dtype)) for v in inputs),
-               self._param_dtypes(), self._opt_level, guard,
-               self._optimizer.fused_signature()) + self._shard_key()
-        fn = self._cache.get(key)
-        if fn is None:
-            self._record_miss(inputs)
-            tb0 = time.perf_counter()
-            fn = self._make_jit(self._build_pure(guard), guard)
-            self._cache[key] = fn
-            self._last = (fn, key)
-            _metrics.histogram(
-                "fused_step_compile_seconds",
-                "fused-step trace+compile latency").observe(
-                time.perf_counter() - tb0)
-        else:
-            _metrics.counter(
-                "fused_step_cache_hits_total",
-                "fused-step signature-cache hits").inc()
-
-        lrs, wds = self._hyper()
-        pvals, svals = self._gather()
-        t1 = time.perf_counter()
-        rng = jnp.asarray(rng_raw) if rng_raw is not None \
-            else jax.random.key_data(_random.next_key())
-        out = fn(pvals, svals, lrs, wds, inputs, rng)
-        new_params, new_states, loss = out[:3]
-        t2 = time.perf_counter()
-        self._writeback(new_params, new_states)
-        if guard:
-            if self._recorder is not None or self._monitor_all:
-                # recorder/monitor consumers need THIS step's values
-                # (an earlier deferred note flushes first — the probe
-                # must observe steps in order)
-                self._flush_pending_guard()
-                self._guard_note(out[3], loss, inputs, rng)
+        # the per-step trace root (serving's serve.request analog):
+        # compile/dispatch/writeback decompose as children, keyed by
+        # step number so mxprof trace correlates across subsystems
+        with _trace.span("train.step", "train", step=self._nstep,
+                         fn=self._name, kind=type(self).__name__):
+            # key on input signature + parameter dtypes + every scalar
+            # the trace bakes in (rescale_grad, clip, momentum, betas,
+            # ... — fused_signature), so mid-run hyperparameter
+            # mutation and Parameter.cast retrace VISIBLY (counted as
+            # misses, recorded by the recompile auditor) instead of
+            # silently. The mxguard tap flag re-keys the same way
+            # (taps are extra outputs of the program — a different
+            # program).
+            key = (tuple((tuple(v.shape), str(v.dtype))
+                         for v in inputs),
+                   self._param_dtypes(), self._opt_level, guard,
+                   self._optimizer.fused_signature()) \
+                + self._shard_key()
+            fn = self._cache.get(key)
+            if fn is None:
+                self._record_miss(inputs)
+                tb0 = time.perf_counter()
+                with _trace.span("step.compile", "train"):
+                    fn = self._make_jit(self._build_pure(guard), guard)
+                self._cache[key] = fn
+                self._last = (fn, key)
+                _metrics.histogram(
+                    "fused_step_compile_seconds",
+                    "fused-step trace+compile latency").observe(
+                    time.perf_counter() - tb0)
             else:
-                # telemetry-only mode: defer the host read one step —
-                # by the next boundary the program has completed, so
-                # the fetch copies a finished buffer instead of
-                # stalling the async pipeline (the measured tap
-                # overhead is the in-program reductions alone)
-                self._flush_pending_guard()
-                self._pending_guard = (out[3], loss, self._nstep)
-        t3 = time.perf_counter()
+                _metrics.counter(
+                    "fused_step_cache_hits_total",
+                    "fused-step signature-cache hits").inc()
+
+            with _trace.span("step.prep", "train"):
+                lrs, wds = self._hyper()
+                pvals, svals = self._gather()
+                rng = jnp.asarray(rng_raw) if rng_raw is not None \
+                    else jax.random.key_data(_random.next_key())
+            t1 = time.perf_counter()
+            with _trace.span("step.dispatch", "train",
+                             batch=batch_size):
+                out = fn(pvals, svals, lrs, wds, inputs, rng)
+            new_params, new_states, loss = out[:3]
+            t2 = time.perf_counter()
+            with _trace.span("step.writeback", "train"):
+                self._writeback(new_params, new_states)
+                if guard:
+                    if self._recorder is not None or self._monitor_all:
+                        # recorder/monitor consumers need THIS step's
+                        # values (an earlier deferred note flushes
+                        # first — the probe must observe steps in
+                        # order)
+                        self._flush_pending_guard()
+                        self._guard_note(out[3], loss, inputs, rng)
+                    else:
+                        # telemetry-only mode: defer the host read one
+                        # step — by the next boundary the program has
+                        # completed, so the fetch copies a finished
+                        # buffer instead of stalling the async
+                        # pipeline (the measured tap overhead is the
+                        # in-program reductions alone)
+                        self._flush_pending_guard()
+                        self._pending_guard = (out[3], loss,
+                                               self._nstep)
+            t3 = time.perf_counter()
         _metrics.histogram(
             "fused_step_host_seconds",
             "fused-step host prep (hyper scalars + buffer gather)"

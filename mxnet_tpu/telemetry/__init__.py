@@ -18,6 +18,12 @@ The framework feeds it from its natural boundaries (ops/registry
 dispatch, HybridBlock/Executor compiles, Trainer.step, kvstore
 push/pull, bench.py); ``tools/mxprof.py`` renders the dumps.
 
+The CORRELATED layer on top — per-request/per-step span trees threaded
+across subsystems, plus the crash flight recorder — lives in
+:mod:`mxnet_tpu.trace` (ISSUE 13). Per-instance instruments here carry
+owner tokens (:func:`metrics.owner`) audited by
+``passes/metriclint.py``.
+
 See docs/observability.md for the architecture.
 """
 from __future__ import annotations

@@ -29,7 +29,8 @@ from typing import Dict, List, Optional
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "all_metrics", "snapshot", "to_json_lines", "to_prometheus",
-           "export_jsonl", "reset_metrics", "percentile_of"]
+           "export_jsonl", "reset_metrics", "percentile_of",
+           "OwnerToken", "owner", "owners"]
 
 
 def percentile_of(sorted_vals, q: float):
@@ -209,16 +210,97 @@ def unregister(name: str) -> bool:
         return _METRICS.pop(name, None) is not None
 
 
+# -- owner tokens (the metriclint contract) ---------------------------------
+#
+# The recurring leak class fixed by hand in PRs 8, 10 and 11:
+# per-INSTANCE instruments (per-engine pool gauges, per-replica breaker
+# gauges, per-probe EWMA gauges) registered at construction and
+# forgotten at close, leaving a dead engine looking live in /metrics.
+# An OwnerToken makes the lifecycle auditable: the owning object adopts
+# its instrument names at construction and close()s the token when it
+# retires them; passes/metriclint.py flags any CLOSED owner whose
+# adopted instruments are still registered.
+
+_OWNERS: List["OwnerToken"] = []
+
+
+class OwnerToken:
+    """Lifecycle handle tying per-instance instruments to the object
+    that registered them. Create via :func:`owner`."""
+
+    __slots__ = ("name", "names", "closed")
+
+    def __init__(self, name: str):
+        self.name = str(name)
+        self.names: set = set()
+        self.closed = False
+
+    def adopt(self, *names: str) -> "OwnerToken":
+        """Associate instrument names (instrument objects accepted
+        too) with this owner."""
+        for n in names:
+            self.names.add(n.name if isinstance(n, Metric) else str(n))
+        return self
+
+    def close(self) -> None:
+        """Declare this owner retired — its adopted instruments must
+        already be unregistered, or metriclint flags the leak."""
+        self.closed = True
+
+    def leaked(self) -> List[str]:
+        """Adopted instruments still live after close (empty = clean)."""
+        if not self.closed:
+            return []
+        with _LOCK:
+            return sorted(n for n in self.names if n in _METRICS)
+
+    def describe(self) -> Dict[str, object]:
+        return {"owner": self.name, "closed": self.closed,
+                "names": sorted(self.names)}
+
+    def __repr__(self):
+        return (f"<OwnerToken {self.name!r} {len(self.names)} "
+                f"instrument(s){' closed' if self.closed else ''}>")
+
+
+def owner(name: str) -> OwnerToken:
+    """Register a new instrument owner (one per engine/replica/probe
+    instance)."""
+    tok = OwnerToken(name)
+    with _LOCK:
+        _OWNERS.append(tok)
+        # bound the ledger: fully-retired CLEAN owners sweep out once
+        # the list grows past 1024. Open owners and leaky closed
+        # owners are never evicted — the leaky ones are what the lint
+        # exists to surface, and evicting an open owner would blind
+        # the audit to its eventual close. If everything is open or
+        # leaky, the ledger grows (small objects; the lint is already
+        # screaming at that point).
+        if len(_OWNERS) > 1024:
+            _OWNERS[:] = [
+                t for t in _OWNERS
+                if not t.closed or any(n in _METRICS
+                                       for n in t.names)]
+    return tok
+
+
+def owners() -> List[OwnerToken]:
+    with _LOCK:
+        return list(_OWNERS)
+
+
 def all_metrics() -> Dict[str, Metric]:
     with _LOCK:
         return dict(_METRICS)
 
 
 def reset_metrics(clear: bool = False):
-    """Zero every instrument (tests); ``clear=True`` drops them."""
+    """Zero every instrument (tests); ``clear=True`` drops them (and
+    the owner ledger)."""
     with _LOCK:
         if clear:
             _METRICS.clear()
+            _OWNERS.clear()
             return
     for m in all_metrics().values():
         m.reset()

@@ -17,6 +17,10 @@ classifies the cause:
                          specialization — expected, twice);
 - ``cache-evicted``    — an already-seen signature compiled again (a
                          hybridize()/cast() call dropped the cache);
+- ``key-change``       — same inputs and training flag, but a NON-shape
+                         signature key moved (a shard-plan fingerprint,
+                         an optimizer scalar, the elastic world size —
+                         the fused-step/sharded-step re-key classes);
 - ``signature-change`` — arity or input structure changed.
 
 Each record feeds (1) the ``recompile_total`` counter (always on),
@@ -59,8 +63,15 @@ def _classify(entry: str, sig: dict) -> str:
         return "first-compile"
     s_in = sig["inputs"]
     same_inputs = [p for p in prior if p["inputs"] == s_in]
-    if any(p.get("training") == sig.get("training") for p in same_inputs):
+    same_train = [p for p in same_inputs
+                  if p.get("training") == sig.get("training")]
+    if any(p == sig for p in same_train):
         return "cache-evicted"  # seen before: hybridize()/cast() reset
+    if same_train:
+        # inputs and training match but some OTHER signature key moved
+        # (plan fingerprint, optimizer scalars, world size): the
+        # legitimate re-key classes must not masquerade as eviction
+        return "key-change"
     if same_inputs:
         return "train-flag"
     for p in prior:

@@ -1,0 +1,42 @@
+"""mxtrace: correlated cross-subsystem tracing + crash flight recorder.
+
+The measurement plane ISSUE 13 adds over PR 2's telemetry: one span
+model (:mod:`~mxnet_tpu.trace.span`) threaded through BOTH hot paths —
+
+- **serving**: endpoint request → router pick (+breaker state) →
+  scheduler admit/tick → prefix-cache lookup → prefill / prefill_ext /
+  decode / verify dispatch → reply. Every request decomposes into
+  queue / admission / prefill / decode phases
+  (``mxtrace_phase_*_seconds`` histograms, p50/p99 in the metrics
+  registry) and the HTTP endpoint echoes ``X-MXTrace-Id``;
+- **training**: Trainer step → StepFunction dispatch → bucket
+  exchange → guard vote/re-execute → elastic heartbeat/rebuild, keyed
+  by ``(generation, step)``.
+
+Spans export as JSON-lines (``MXTRACE_EXPORT``) and Chrome-trace JSON
+(:func:`~mxnet_tpu.trace.export.write_chrome`); sampling rides
+``MXTRACE_SAMPLE``; the bounded flight recorder
+(:mod:`~mxnet_tpu.trace.recorder`) dumps the last-N-spans picture on
+breaker trips, engine crashes, GroupFailed, guard quarantine, watchdog
+stall verdicts and SIGTERM. ``tools/mxprof.py trace`` summarizes a
+trace file (critical path, phase self-time, cross-subsystem gaps,
+orphan/coverage findings in the shared mxlint schema).
+
+See docs/observability.md for the span taxonomy and the
+flight-recorder runbook.
+"""
+from __future__ import annotations
+
+from . import export  # noqa: F401
+from . import recorder  # noqa: F401
+from . import spans  # noqa: F401
+from .export import load_spans, write_chrome  # noqa: F401
+from .recorder import (crash_dump, get_recorder,  # noqa: F401
+                       install_signal_handler)
+from .spans import (Span, SpanContext, current_context,  # noqa: F401
+                    drain, emit, enabled, reset, span, under)
+
+__all__ = ["Span", "SpanContext", "span", "emit", "under", "enabled",
+           "current_context", "drain", "reset", "load_spans",
+           "write_chrome", "crash_dump", "get_recorder",
+           "install_signal_handler"]

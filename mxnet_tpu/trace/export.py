@@ -1,0 +1,231 @@
+"""Span exporters: JSON-lines stream (MXTRACE_EXPORT) + Chrome trace.
+
+Two on-disk forms, one logical schema (the ``Span.to_dict`` fields):
+
+- **JSON-lines** — one span object per line, append-only, written as
+  spans finish when ``MXTRACE_EXPORT`` names a path. Writes are
+  OS-buffered and flushed every ``_FLUSH_EVERY`` lines / 0.5 s (spans
+  can finish under scheduler locks — a per-line flush would put disk
+  latency inside the engine's lock hold); every flight-recorder dump
+  and ``reset_sink``/process exit flushes the rest, so the spans
+  preceding a failure reach disk with the dump. Concatenates across
+  runs; what ``tools/mxprof.py trace`` reads natively.
+- **Chrome trace** — :func:`write_chrome` renders spans as a
+  ``traceEvents`` document (``ph:"X"`` duration events, one track per
+  thread, span identity in ``args``) for chrome://tracing / Perfetto.
+  Load one back with :func:`load_spans`, which accepts all three
+  on-disk shapes (JSONL, Chrome, flight-recorder dump).
+
+Export must never take down the traffic it observes: sink errors are
+swallowed, and the sink re-resolves its path when the config
+generation moves (tests flip MXTRACE_EXPORT with set_flag).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .spans import _cfg
+
+__all__ = ["sink_write", "flush_sink", "reset_sink", "write_chrome",
+           "load_spans"]
+
+_SINK_LOCK = threading.Lock()
+_SINK = {"gen": -1, "path": "", "fh": None, "pending": 0, "last": 0.0}
+# flush cadence: spans can be written from under scheduler locks
+# (serve2 _resolve), so a per-line flush would put disk latency inside
+# the engine's lock hold. The OS buffer takes the line immediately;
+# fsync-grade durability is the flight recorder's job, not the sink's.
+_FLUSH_EVERY = 64
+_FLUSH_INTERVAL_S = 0.5
+
+
+def _resolve_sink():
+    """(Re)open the MXTRACE_EXPORT file handle when the flag moved."""
+    from .. import config
+    gen = config.generation()
+    if _SINK["gen"] == gen:
+        return _SINK["fh"]
+    path = str(config.get("MXTRACE_EXPORT") or "")
+    if path != _SINK["path"]:
+        if _SINK["fh"] is not None:
+            try:
+                _SINK["fh"].close()
+            except OSError:
+                pass
+            _SINK["fh"] = None
+        if path:
+            try:
+                _SINK["fh"] = open(path, "a")
+            except OSError:
+                _SINK["fh"] = None
+        _SINK["path"] = path
+    _SINK["gen"] = gen
+    return _SINK["fh"]
+
+
+def sink_write_span(span) -> None:
+    """Hot-path form: pays the dict+json cost only when a sink is
+    actually configured."""
+    try:
+        if _SINK["fh"] is None and \
+                _SINK["gen"] == _cfg().generation():
+            return
+    except Exception:  # noqa: BLE001
+        return
+    sink_write(span.to_dict() if not isinstance(span, dict) else span)
+
+
+def sink_write(span_dict: Dict[str, object]) -> None:
+    """Append one span line to the MXTRACE_EXPORT sink (no-op without
+    one). Never raises — telemetry must not take down serving."""
+    try:
+        # lock-free fast path for the common no-sink case: dict reads
+        # are atomic, and a stale miss only delays the first write one
+        # config-generation check
+        from .. import config
+        if _SINK["fh"] is None and _SINK["gen"] == config.generation():
+            return
+        with _SINK_LOCK:
+            fh = _resolve_sink()
+            if fh is None:
+                return
+            fh.write(json.dumps(span_dict) + "\n")
+            _SINK["pending"] += 1
+            now = time.monotonic()
+            if _SINK["pending"] >= _FLUSH_EVERY \
+                    or now - _SINK["last"] >= _FLUSH_INTERVAL_S:
+                fh.flush()
+                _SINK["pending"] = 0
+                _SINK["last"] = now
+    except (OSError, ValueError, TypeError):
+        pass
+
+
+def flush_sink() -> None:
+    """Force pending buffered lines to disk (flight-recorder dumps
+    call this so the export file is consistent with the dump)."""
+    try:
+        with _SINK_LOCK:
+            if _SINK["fh"] is not None and _SINK["pending"]:
+                _SINK["fh"].flush()
+                _SINK["pending"] = 0
+                _SINK["last"] = time.monotonic()
+    except (OSError, ValueError):
+        pass
+
+
+def reset_sink() -> None:
+    """Flush + close the sink so the next write re-resolves (tests /
+    end of run — pending buffered lines land here)."""
+    with _SINK_LOCK:
+        if _SINK["fh"] is not None:
+            try:
+                _SINK["fh"].close()  # close() flushes pending lines
+            except OSError:
+                pass
+        _SINK.update(gen=-1, path="", fh=None, pending=0, last=0.0)
+
+
+def to_chrome_events(spans: List[dict]) -> List[dict]:
+    """Span dicts -> chrome-trace ``ph:"X"`` duration events (identity
+    rides in args so a chrome dump round-trips through load_spans)."""
+    pid = os.getpid()
+    events = []
+    for s in spans:
+        if s.get("dur_us") is None:
+            continue
+        events.append({
+            "name": s["name"], "ph": "X", "cat": s["subsystem"],
+            "pid": pid, "tid": s.get("thread", 0),
+            "ts": s["ts_us"], "dur": s["dur_us"],
+            "args": {"trace_id": s["trace_id"],
+                     "span_id": s["span_id"],
+                     "parent_id": s.get("parent_id"),
+                     "status": s.get("status", "ok"),
+                     **(s.get("attrs") or {})},
+        })
+    return events
+
+
+def write_chrome(path: str, spans: Optional[List[dict]] = None) -> str:
+    """Write a chrome-trace JSON document of ``spans`` (default: the
+    drained thread buffers + the flight-recorder rings)."""
+    if spans is None:
+        from . import recorder as _recorder
+        from . import spans as _spans
+        spans = _spans.drain() + _recorder.get_recorder().spans()
+        seen = set()
+        uniq = []
+        for s in spans:
+            if s["span_id"] in seen:
+                continue
+            seen.add(s["span_id"])
+            uniq.append(s)
+        spans = sorted(uniq, key=lambda d: d["ts_us"])
+    doc = {"traceEvents": to_chrome_events(spans),
+           "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _span_from_chrome(e: dict) -> Optional[dict]:
+    if e.get("ph") != "X" or "dur" not in e:
+        return None
+    args = dict(e.get("args") or {})
+    trace_id = args.pop("trace_id", None)
+    span_id = args.pop("span_id", None)
+    if not trace_id or not span_id:
+        return None
+    return {"trace_id": trace_id, "span_id": span_id,
+            "parent_id": args.pop("parent_id", None),
+            "name": e.get("name", "?"),
+            "subsystem": e.get("cat", "app"),
+            "ts_us": e.get("ts", 0.0), "dur_us": e.get("dur", 0.0),
+            "thread": e.get("tid", 0),
+            "status": args.pop("status", "ok"), "attrs": args}
+
+
+def load_spans(path: str) -> List[dict]:
+    """Read spans back from any supported file shape: span JSON-lines
+    (MXTRACE_EXPORT), a chrome-trace document (write_chrome), or a
+    flight-recorder dump ({"spans": {subsystem: [...]}})."""
+    with open(path) as f:
+        text = f.read()
+    text = text.strip()
+    if not text:
+        return []
+    spans: List[dict] = []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        if "traceEvents" in doc:
+            for e in doc["traceEvents"]:
+                s = _span_from_chrome(e)
+                if s is not None:
+                    spans.append(s)
+            return sorted(spans, key=lambda d: d["ts_us"])
+        if isinstance(doc.get("spans"), dict):
+            for ring in doc["spans"].values():
+                spans.extend(s for s in ring
+                             if isinstance(s, dict)
+                             and "span_id" in s)
+            return sorted(spans, key=lambda d: d["ts_us"])
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "span_id" in rec \
+                and "trace_id" in rec:
+            spans.append(rec)
+    return sorted(spans, key=lambda d: d["ts_us"])

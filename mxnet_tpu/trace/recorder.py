@@ -1,0 +1,255 @@
+"""Crash flight recorder: the last N spans per subsystem, dumped on
+failure.
+
+The operational problem (ISSUE 13): when a drill fails — a breaker
+trips, a scheduler thread dies, an elastic group hard-fails, mxguard
+quarantines a worker, the watchdog declares a stall, or the cluster
+manager SIGTERMs the process — the logs say WHAT died but not what the
+last five seconds looked like. The recorder keeps one bounded ring of
+finished spans per subsystem (``MXTRACE_RECORDER_SPANS`` each) plus
+explicit event notes (breaker trips, crash sites), and
+:func:`crash_dump` writes the whole picture — rings, events, a metrics
+snapshot, the recent recompile records — to one timestamped JSON file
+in ``MXTRACE_DUMP_DIR`` that ``tools/mxprof.py trace`` and
+``tools/diagnose.py`` read back.
+
+Dump triggers wired across the stack (each calls :func:`crash_dump`):
+
+- :class:`~mxnet_tpu.resil.policy.CircuitBreaker` trip
+- :meth:`~mxnet_tpu.serve2.scheduler.DecodeEngine` scheduler crash
+  (EngineCrashedError)
+- :class:`~mxnet_tpu.elastic.membership.GroupFailed`
+- :class:`~mxnet_tpu.guard.voting.GuardQuarantined`
+- :class:`~mxnet_tpu.resil.watchdog.Watchdog` stall verdict
+- SIGTERM (handler installed lazily from the main thread, chaining any
+  existing handler)
+
+Dumps are rate-limited per reason (default 5 s) so a breaker-trip
+storm produces one readable file, not a thousand; ``force=True``
+bypasses for tests/drills.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .spans import _cfg
+
+__all__ = ["FlightRecorder", "get_recorder", "crash_dump",
+           "install_signal_handler"]
+
+_DUMP_SEQ = itertools.count(1)
+_MIN_DUMP_INTERVAL_S = 5.0
+
+
+def _dump_dir() -> str:
+    from .. import config
+    d = str(config.get("MXTRACE_DUMP_DIR") or "")
+    if not d:
+        d = os.path.join(tempfile.gettempdir(), "mxtrace")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class FlightRecorder:
+    """See module docstring. One process-wide instance
+    (:func:`get_recorder`); every method is safe from any thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rings: Dict[str, deque] = {}
+        self._events: deque = deque(maxlen=128)
+        self._last_dump: Optional[dict] = None
+        self._last_dump_ts: Dict[str, float] = {}
+        self._n_dumps = 0
+        self._cap_cache = (-1, 256)  # (config generation, cap)
+
+    def _cap(self) -> int:
+        config = _cfg()
+        gen = config.generation()
+        cached = self._cap_cache
+        if cached[0] == gen:
+            return cached[1]
+        cap = max(8, int(config.get("MXTRACE_RECORDER_SPANS")))
+        self._cap_cache = (gen, cap)
+        return cap
+
+    def add(self, span) -> None:
+        """Append one finished span (a Span object or its dict form —
+        rings hold either; readers normalize via :meth:`spans`)."""
+        sub = getattr(span, "subsystem", None) \
+            or span.get("subsystem", "app")
+        with self._lock:
+            ring = self._rings.get(sub)
+            if ring is None or ring.maxlen != self._cap():
+                ring = deque(ring or (), maxlen=self._cap())
+                self._rings[sub] = ring
+            ring.append(span)
+
+    def note(self, subsystem: str, name: str, **attrs) -> None:
+        """Record one explicit event (a breaker trip, a crash site) —
+        shows up in the dump's ``events`` timeline next to the spans."""
+        with self._lock:
+            self._events.append({
+                "ts": time.time(), "subsystem": subsystem,
+                "name": name, "attrs": attrs})
+
+    @staticmethod
+    def _as_dict(span) -> dict:
+        return span if isinstance(span, dict) else span.to_dict()
+
+    def spans(self, subsystem: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            if subsystem is not None:
+                out = [self._as_dict(s)
+                       for s in self._rings.get(subsystem, ())]
+                out.sort(key=lambda d: d.get("ts_us", 0))
+                return out
+            out = []
+            for ring in self._rings.values():
+                out.extend(self._as_dict(s) for s in ring)
+        out.sort(key=lambda d: d.get("ts_us", 0))
+        return out
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "subsystems": {s: len(r)
+                               for s, r in sorted(self._rings.items())},
+                "events": len(self._events),
+                "dumps": self._n_dumps,
+                "last_dump": dict(self._last_dump)
+                if self._last_dump else None,
+            }
+
+    @property
+    def last_dump(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._last_dump) if self._last_dump else None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rings.clear()
+            self._events.clear()
+            self._last_dump = None
+            self._last_dump_ts.clear()
+
+    def dump(self, reason: str, site: Optional[str] = None,
+             extra: Optional[dict] = None,
+             force: bool = False) -> Optional[str]:
+        """Write the dump file; returns its path (None when the
+        per-reason rate limit suppressed it). Never raises."""
+        try:
+            now = time.monotonic()
+            with self._lock:
+                last = self._last_dump_ts.get(reason)
+                if not force and last is not None \
+                        and now - last < _MIN_DUMP_INTERVAL_S:
+                    return None
+                self._last_dump_ts[reason] = now
+                rings = {s: [self._as_dict(x) for x in r]
+                         for s, r in sorted(self._rings.items())}
+                events = list(self._events)
+            from ..telemetry import metrics as _metrics
+            from ..telemetry import recompile as _recompile
+            from . import export as _export
+            # land any buffered MXTRACE_EXPORT lines NOW: the spans
+            # leading up to a failure are exactly the ones a batched
+            # sink would otherwise lose if the process dies next
+            _export.flush_sink()
+            doc = {
+                "reason": reason,
+                "site": site,
+                "ts": time.time(),
+                "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+                "pid": os.getpid(),
+                "spans": rings,
+                "events": events,
+                "metrics": _metrics.snapshot(),
+                "recompiles": _recompile.recompile_report()[-32:],
+            }
+            if extra:
+                doc["extra"] = extra
+            tag = "".join(c if c.isalnum() or c in "-_" else "_"
+                          for c in reason)[:48]
+            fname = (f"mxtrace-flight-{tag}-"
+                     f"{time.strftime('%Y%m%d-%H%M%S', time.gmtime())}"
+                     f"-p{os.getpid()}-{next(_DUMP_SEQ)}.json")
+            path = os.path.join(_dump_dir(), fname)
+            with open(path, "w") as f:
+                json.dump(doc, f)
+            with self._lock:
+                self._n_dumps += 1
+                self._last_dump = {"reason": reason, "site": site,
+                                   "path": path, "ts": doc["ts"]}
+            return path
+        except Exception:  # noqa: BLE001 — the recorder must never
+            # take down the job it is documenting
+            return None
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def crash_dump(reason: str, site: Optional[str] = None,
+               extra: Optional[dict] = None,
+               force: bool = False) -> Optional[str]:
+    """The one failure hook: note the event (so the dump's final
+    timeline names the failing site) and write the dump. Gated on
+    MXTRACE; rate-limited per reason; never raises."""
+    try:
+        from . import spans as _spans
+        if not _spans.enabled():
+            return None
+        _RECORDER.note("crash", reason, site=site)
+        return _RECORDER.dump(reason, site=site, extra=extra,
+                              force=force)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+_SIGTERM_INSTALLED = [False]
+
+
+def install_signal_handler() -> bool:
+    """Install the SIGTERM dump hook (main thread only; chains any
+    existing handler, then restores + re-raises the default so the
+    process still terminates). Returns True when installed."""
+    if _SIGTERM_INSTALLED[0]:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            crash_dump("sigterm", force=True)
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+                return
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        _SIGTERM_INSTALLED[0] = True
+        return True
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        return False

@@ -137,6 +137,46 @@ def test_bench_serving3_emits_mxserve3_speedup():
 
 
 @pytest.mark.slow
+def test_bench_trace_overhead_emits_mxtrace_overhead():
+    """--trace-overhead contract: one mxtrace_overhead JSON line with
+    both phase overheads (traced vs untraced fused training with
+    guard taps on + serve2 predicts), and ZERO recompiles with the
+    MXTRACE flag flipping every call — tracing must never re-key a
+    program. Reduced knobs keep this a contract check (shape +
+    invariants); the acceptance-scale <2% overhead gate (trace_ok)
+    comes from the default knobs."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_TRACE_STEPS": "6",
+        "MXTPU_BENCH_TRACE_REQUESTS": "6",
+        "MXTPU_BENCH_TRACE_MAX_NEW": "8",
+        "MXTPU_BENCH_TIMEOUT": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--trace-overhead"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxtrace_overhead"
+    assert data["value"] is not None and data["value"] > 0, data
+    assert data["recompiles_after_warmup"] == 0, data
+    assert data["sample"] == 1.0
+    for key in ("train_overhead_pct", "serve_overhead_pct",
+                "train_untraced_step_s", "serve_untraced_req_s",
+                "trace_ok"):
+        assert key in data, data
+    assert data["train_untraced_step_s"] > 0
+    assert data["serve_untraced_req_s"] > 0
+    assert data["recorder_subsystems"].get("train", 0) > 0
+    assert data["recorder_subsystems"].get("serve2", 0) > 0
+
+
+@pytest.mark.slow
 def test_bench_serving2_emits_mxserve2_throughput():
     """--serving2 contract: one mxserve2_throughput JSON line — serve2
     requests/sec, the PR-3 single-engine baseline and the speedup, zero

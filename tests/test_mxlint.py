@@ -263,8 +263,9 @@ def test_hybridize_warns_on_tracer_leak():
 def test_pass_manager_registry():
     pm = default_manager()
     assert pm.names() == ["dispatchlint", "elasticlint", "graphlint",
-                          "guardlint", "oplint", "servelint",
-                          "shardlint", "steplint", "tracercheck"]
+                          "guardlint", "metriclint", "oplint",
+                          "servelint", "shardlint", "steplint",
+                          "tracercheck"]
     with pytest.raises(KeyError):
         pm.get("no_such_pass")
     out = sym.var("x") + sym.var("x")

@@ -147,6 +147,53 @@ def check_telemetry():
     print("export sink  :", sink or "(off)")
 
 
+def check_trace():
+    """mxtrace health: flag values, the per-phase latency histograms,
+    and the crash flight recorder's rings/dump state read DIRECTLY
+    (mxnet_tpu/trace/; docs/observability.md)."""
+    print("----------Tracing (mxtrace)----------")
+    try:
+        from mxnet_tpu import config, telemetry, trace
+    except Exception as e:
+        print("trace        : unavailable (%s)" % e)
+        return
+    on = config.get("MXTRACE")
+    print("tracing      :", "ON" if on else "(off — set MXTRACE=1)")
+    print("sampling     :", config.get("MXTRACE_SAMPLE"),
+          "(fraction of root traces recorded)")
+    sink = config.get("MXTRACE_EXPORT")
+    print("export sink  :", sink or "(off — in-memory recorder only)")
+    print("recorder     : %s span(s)/subsystem ring cap, dumps to %s"
+          % (config.get("MXTRACE_RECORDER_SPANS"),
+             config.get("MXTRACE_DUMP_DIR") or "<tempdir>/mxtrace"))
+    rec = trace.get_recorder().describe()
+    if rec["subsystems"]:
+        print("rings        :",
+              ", ".join(f"{s}={n}"
+                        for s, n in rec["subsystems"].items()))
+    else:
+        print("rings        : empty (no traced work in this process)")
+    if rec["last_dump"]:
+        ld = rec["last_dump"]
+        print(f"  LAST DUMP  : {ld['reason']}"
+              + (f" (site {ld['site']})" if ld.get("site") else "")
+              + f" -> {ld['path']}")
+        print("    read it with: python tools/mxprof.py trace "
+              f"{ld['path']}")
+    snap = telemetry.snapshot()
+    phases = {k: v for k, v in snap.items()
+              if k.startswith("mxtrace_phase_")}
+    for k, v in sorted(phases.items()):
+        if isinstance(v, dict) and v.get("count"):
+            print(f"  {k}: n={v['count']} p50={v.get('p50')} "
+                  f"p99={v.get('p99')}")
+    req = {k: v for k, v in snap.items()
+           if k.startswith("mxserve_request_seconds")}
+    for k, v in sorted(req.items()):
+        if isinstance(v, dict) and v.get("count"):
+            print(f"  {k}: n={v['count']} p99={v.get('p99')}")
+
+
 def check_serving():
     """Serving-subsystem health: flag values, bucket-ladder program
     count, and the mxserve_* metrics (mxnet_tpu/serve/; docs/serving.md)."""
@@ -367,6 +414,7 @@ def main():
     check_environment()
     check_mxnet()
     check_telemetry()
+    check_trace()
     check_serving()
     check_serving2()
     check_resilience()

@@ -334,6 +334,69 @@ def _selfcheck_guard_findings():
     return findings
 
 
+def _selfcheck_metric_findings():
+    """metriclint self-check: the live registry must audit clean, a
+    properly-retired owner must audit clean, and — coverage check on
+    the lint itself — a closed-owner-with-live-gauge fixture MUST fire
+    the leak finding. A real DecodeEngine open/close round drives the
+    adoption contract end-to-end (its per-engine gauges are owned and
+    retired)."""
+    import numpy as onp
+
+    from mxnet_tpu.parallel.pipeline_lm import init_pipeline_lm
+    from mxnet_tpu.passes import Finding
+    from mxnet_tpu.passes.metriclint import MetricLint
+    from mxnet_tpu.serve2 import DecodeEngine
+    from mxnet_tpu.telemetry import metrics as _m
+
+    p = MetricLint()
+    findings = list(p.run())  # the live registry, pre-exercise
+
+    # live exercise: an engine registers per-engine gauges under an
+    # owner token and retires them on close — must stay clean
+    params = init_pipeline_lm(0, vocab=32, d_model=16, n_layers=2,
+                              n_heads=2, d_head=8, d_ff=32,
+                              n_experts=2)
+    engine = DecodeEngine(params, page_size=4, num_pages=16,
+                          max_inflight=2, prefill_buckets=[8],
+                          max_new_default=2, max_seq_len=16,
+                          name="<self-check metrics>")
+    engine.warmup()
+    engine.submit(onp.asarray([1, 2, 3], "int32"), max_new_tokens=2)
+    engine.run_until_idle(60.0)
+    engine.close()
+    after = p.run()
+    findings += after
+    if any(f.check == "closed-owner-live-gauge" for f in after):
+        findings.append(Finding(
+            "metriclint", "selfcheck-retirement",
+            "<self-check metrics>", "error",
+            "a properly-closed DecodeEngine left live adopted gauges "
+            "— the close() retirement contract regressed"))
+
+    # the lint must FIRE on the bad fixture — else it is vacuous
+    bad = {"owners": [
+        {"owner": "<closed engine>", "closed": True,
+         "names": ["leaked_pool_gauge"]},
+        {"owner": "<empty owner>", "closed": True, "names": []}],
+        "live": ["leaked_pool_gauge"]}
+    fired = {f.check for f in p.run(bad)}
+    for check in ("closed-owner-live-gauge", "owner-no-instruments"):
+        if check not in fired:
+            findings.append(Finding(
+                "metriclint", "selfcheck-coverage", "<bad fixture>",
+                "error",
+                f"lint did not fire {check!r} on the fixture built "
+                "to trigger it"))
+    n_owners = len(_m.owners())
+    findings.append(Finding(
+        "metriclint", "selfcheck-summary", "<self-check metrics>",
+        "info",
+        f"{n_owners} owner token(s) in the ledger, engine open/close "
+        "round audited clean, bad-fixture coverage exercised"))
+    return findings
+
+
 def _selfcheck_block_findings():
     """tracercheck over a small hybridized block — a clean forward must
     produce no tracer findings."""
@@ -373,6 +436,13 @@ def main(argv=None):
                         "tapped fused steps with a replay ring and "
                         "lint tap/recovery pairing across the live "
                         "guard state and the kvstore registry")
+    p.add_argument("--metrics", action="store_true",
+                   dest="metrics_check",
+                   help="metriclint self-check: audit the owner-token "
+                        "ledger for per-instance gauges that outlived "
+                        "their closed owner (the per-engine-gauge "
+                        "leak class), driving a real engine "
+                        "open/close round plus bad-fixture coverage")
     p.add_argument("--opt", action="store_true", dest="opt_check",
                    help="graph-optimizer self-check: run the level-2 "
                         "rewrite pipeline on a fixture graph, report "
@@ -392,9 +462,10 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     if not (args.ops or args.all or args.graphs or args.shard
-            or args.opt_check or args.serve_check or args.guard_check):
+            or args.opt_check or args.serve_check or args.guard_check
+            or args.metrics_check):
         p.error("nothing to do: pass --ops, --all, --shard, --opt, "
-                "--serve, --guard, or graph JSON files")
+                "--serve, --guard, --metrics, or graph JSON files")
 
     if args.shard and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -479,6 +550,11 @@ def main(argv=None):
         gd = _selfcheck_guard_findings()
         findings.extend(gd)
         sections.append(("guardlint", "<self-check guarded step>", gd))
+    if args.metrics_check:
+        mt = _selfcheck_metric_findings()
+        findings.extend(mt)
+        sections.append(("metriclint", "<self-check owner ledger>",
+                         mt))
 
     counts = severity_counts(findings)
     if args.as_json:

@@ -502,6 +502,259 @@ def shard_cmd(path, as_json):
 
 
 # ---------------------------------------------------------------------------
+# mxtrace report (mxnet_tpu/trace/ — ISSUE 13)
+# ---------------------------------------------------------------------------
+
+# a root whose descendants cover less than this fraction of its wall
+# time has an attribution hole — somewhere the trace lost a phase
+TRACE_COVERAGE_THRESHOLD = 0.9
+# ...but only when the hole is big enough to act on: a sub-ms step's
+# inter-span Python (key building, branches) is below tracing
+# granularity and not a lost phase
+TRACE_COVERAGE_MIN_GAP_US = 1000.0
+# cross-subsystem gaps larger than this fraction of the root are
+# called out in the gap table
+TRACE_GAP_FRACTION = 0.05
+
+
+def _trace_trees(spans):
+    """Group spans by trace_id: {tid: {"spans", "by_id", "roots",
+    "orphans"}}."""
+    traces = {}
+    for s in spans:
+        traces.setdefault(s["trace_id"], []).append(s)
+    out = {}
+    for tid, ss in traces.items():
+        by_id = {s["span_id"]: s for s in ss}
+        roots = [s for s in ss if not s.get("parent_id")]
+        orphans = [s for s in ss
+                   if s.get("parent_id")
+                   and s["parent_id"] not in by_id]
+        out[tid] = {"spans": ss, "by_id": by_id, "roots": roots,
+                    "orphans": orphans}
+    return out
+
+
+def _interval_coverage(root, spans):
+    """Fraction of the root's interval covered by the union of the
+    OTHER spans' intervals (clipped to the root)."""
+    r0 = root["ts_us"]
+    r1 = r0 + (root["dur_us"] or 0.0)
+    if r1 <= r0:
+        return None
+    ivals = []
+    for s in spans:
+        if s is root or s.get("dur_us") is None:
+            continue
+        a = max(r0, s["ts_us"])
+        b = min(r1, s["ts_us"] + s["dur_us"])
+        if b > a:
+            ivals.append((a, b))
+    ivals.sort()
+    covered, end = 0.0, r0
+    for a, b in ivals:
+        a = max(a, end)
+        if b > a:
+            covered += b - a
+            end = b
+    return covered / (r1 - r0)
+
+
+def _critical_path(tree, root):
+    """Longest-duration child chain from the root — the trace's
+    critical path, flame-graph style."""
+    children = defaultdict(list)
+    for s in tree["spans"]:
+        pid = s.get("parent_id")
+        if pid:
+            children[pid].append(s)
+    path = [root]
+    cur = root
+    while True:
+        kids = [k for k in children.get(cur["span_id"], ())
+                if k.get("dur_us") is not None]
+        if not kids:
+            return path
+        cur = max(kids, key=lambda s: s["dur_us"])
+        path.append(cur)
+
+
+def _subsystem_gaps(tree, root):
+    """Gaps between consecutive descendant spans where the subsystem
+    changes — the cross-subsystem handoff cost (e.g. endpoint ->
+    scheduler thread wakeup)."""
+    spans = sorted((s for s in tree["spans"]
+                    if s is not root and s.get("dur_us") is not None),
+                   key=lambda s: s["ts_us"])
+    gaps = []
+    for a, b in zip(spans, spans[1:]):
+        gap = b["ts_us"] - (a["ts_us"] + a["dur_us"])
+        if gap > 0 and a["subsystem"] != b["subsystem"]:
+            gaps.append({"from": a["name"], "from_sub": a["subsystem"],
+                         "to": b["name"], "to_sub": b["subsystem"],
+                         "gap_us": round(gap, 3)})
+    return sorted(gaps, key=lambda g: -g["gap_us"])
+
+
+def trace_self_times(spans):
+    """Per-name self-time stats over span dicts (chrome-event shape
+    reuse: ts/dur in us, nesting by parent chain per trace)."""
+    stats = defaultdict(lambda: {"count": 0, "total_us": 0.0,
+                                 "self_us": 0.0})
+    child_of = defaultdict(float)  # span_id -> summed child duration
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid in by_id and s.get("dur_us") is not None:
+            child_of[pid] += s["dur_us"]
+    for s in spans:
+        if s.get("dur_us") is None:
+            continue
+        st = stats[s["name"]]
+        st["count"] += 1
+        st["total_us"] += s["dur_us"]
+        st["self_us"] += max(0.0, s["dur_us"]
+                             - child_of.get(s["span_id"], 0.0))
+    return dict(stats)
+
+
+def analyze_trace(trees, min_coverage=TRACE_COVERAGE_THRESHOLD):
+    """Trace pathology scan → Finding list (shared schema):
+    orphan-span (error — a span's parent is missing from its trace)
+    and trace-coverage-gap (warn — a root's descendants cover less
+    than ``min_coverage`` of its wall time)."""
+    from mxnet_tpu.passes import Finding
+    findings = []
+    for tid, tree in sorted(trees.items()):
+        if tree["orphans"] and not tree["roots"]:
+            # the whole ancestry is absent: a flight-recorder ring
+            # truncated the trace, or the work was still IN FLIGHT
+            # when the dump froze (its root span had not closed yet).
+            # Expected in dumps — note it, don't fail on it.
+            findings.append(Finding(
+                "mxprof", "truncated-trace", tid, "info",
+                f"{len(tree['orphans'])} span(s) reference parents "
+                "outside the file and the trace has no root — "
+                "ring-truncated or dumped mid-flight"))
+            continue
+        for s in tree["orphans"]:
+            findings.append(Finding(
+                "mxprof", "orphan-span",
+                f"{tid}/{s['name']}", "error",
+                f"span {s['span_id']} ({s['name']}) references parent "
+                f"{s['parent_id']} which is not in trace {tid} — the "
+                "trace tree is broken (a span was dropped or a "
+                "context leaked across traces)"))
+        for root in tree["roots"]:
+            # only roots with recorded children are judged: a lone
+            # root (a dispatch tick, a one-span trace) has no
+            # decomposition to be incomplete
+            kids = [s for s in tree["spans"] if s is not root]
+            if not kids:
+                continue
+            cov = _interval_coverage(root, tree["spans"])
+            if cov is None or cov >= min_coverage:
+                continue
+            gap_us = (1.0 - cov) * (root["dur_us"] or 0.0)
+            if gap_us < TRACE_COVERAGE_MIN_GAP_US:
+                continue  # sub-granularity hole (see the constant)
+            findings.append(Finding(
+                "mxprof", "trace-coverage-gap",
+                f"{tid}/{root['name']}", "warn",
+                f"descendant spans cover {cov * 100:.1f}% of the "
+                f"root's {root['dur_us'] / 1e3:.3f} ms "
+                f"({gap_us / 1e3:.3f} ms unattributed; threshold "
+                f"{min_coverage * 100:.0f}%) — a phase of this "
+                "request/step is untraced"))
+    return findings
+
+
+def trace_report(trees, top):
+    """Render: per-trace summary, critical path of the longest trace,
+    top-K span self-time, largest cross-subsystem gaps."""
+    lines = []
+    all_spans = [s for t in trees.values() for s in t["spans"]]
+    lines.append(f"-- traces: {len(trees)}, spans: {len(all_spans)}")
+    rooted = [(t, r) for t in trees.values() for r in t["roots"]
+              if r.get("dur_us") is not None
+              and len(t["spans"]) > 1]
+    rooted.sort(key=lambda tr: -tr[1]["dur_us"])
+    for t, root in rooted[:max(3, top or 3)]:
+        cov = _interval_coverage(root, t["spans"])
+        lines.append(
+            f"  {root['trace_id']}  {root['name']:<18} "
+            f"{root['dur_us'] / 1e3:9.3f} ms  "
+            f"{len(t['spans'])} span(s)  coverage "
+            f"{cov * 100:.1f}%" if cov is not None else
+            f"  {root['trace_id']}  {root['name']}")
+    if rooted:
+        t, root = rooted[0]
+        lines.append("-- critical path (longest trace)")
+        for s in _critical_path(t, root):
+            lines.append(f"  {s['name']:<26} [{s['subsystem']:<8}] "
+                         f"{(s['dur_us'] or 0) / 1e3:9.3f} ms")
+        gaps = _subsystem_gaps(t, root)
+        big = [g for g in gaps
+               if g["gap_us"] >= TRACE_GAP_FRACTION
+               * (root["dur_us"] or 1.0)]
+        if big:
+            lines.append("-- largest cross-subsystem gaps")
+            for g in big[:5]:
+                lines.append(
+                    f"  {g['from']} [{g['from_sub']}] -> {g['to']} "
+                    f"[{g['to_sub']}]: {g['gap_us'] / 1e3:.3f} ms")
+    stats = trace_self_times(all_spans)
+    lines.append(f"-- top span self-time (top {top or 'all'})")
+    lines.append(top_ops_table(stats, top))
+    return "\n".join(lines)
+
+
+def trace_cmd(path, top, as_json, min_coverage):
+    from mxnet_tpu.trace import load_spans
+    spans = load_spans(path)
+    trees = _trace_trees(spans)
+    findings = analyze_trace(trees, min_coverage)
+    if as_json:
+        from mxnet_tpu.passes import findings_report
+        traces_out = []
+        for tid, t in sorted(trees.items()):
+            for root in t["roots"]:
+                cov = _interval_coverage(root, t["spans"]) \
+                    if len(t["spans"]) > 1 else None
+                traces_out.append({
+                    "trace_id": tid, "root": root["name"],
+                    "dur_us": root.get("dur_us"),
+                    "n_spans": len(t["spans"]),
+                    "coverage": round(cov, 4)
+                    if cov is not None else None,
+                    "orphans": len(t["orphans"]),
+                    "critical_path": [
+                        {"name": s["name"], "sub": s["subsystem"],
+                         "dur_us": s.get("dur_us")}
+                        for s in _critical_path(t, root)],
+                    "gaps": _subsystem_gaps(t, root)[:5],
+                })
+        stats = trace_self_times(spans)
+        rows = sorted(stats.items(), key=lambda kv: -kv[1]["self_us"])
+        if top and top > 0:
+            rows = rows[:top]
+        print(findings_report(
+            "mxprof", findings,
+            extra={"file": path, "n_spans": len(spans),
+                   "n_traces": len(trees), "traces": traces_out,
+                   "top_spans": [{"name": n, **s} for n, s in rows]},
+            as_json=True))
+    else:
+        print(f"== mxprof trace: {path} ({len(spans)} span(s), "
+              f"{len(trees)} trace(s))")
+        print(trace_report(trees, top))
+        for fi in findings:
+            print(f"  {fi!r}")
+    from mxnet_tpu.passes import severity_counts
+    return 2 if severity_counts(findings)["error"] else 0
+
+
+# ---------------------------------------------------------------------------
 # findings (shared schema with mxlint)
 # ---------------------------------------------------------------------------
 
@@ -663,10 +916,30 @@ def main(argv=None):
     popt.add_argument("--json", action="store_true", dest="as_json",
                       help="emit the shared machine-readable findings "
                            "report")
+    ptrace = sub.add_parser(
+        "trace",
+        help="mxtrace report from a span file (MXTRACE_EXPORT "
+             "JSON-lines, a write_chrome document, or a flight-"
+             "recorder dump): per-trace critical path, top-K span "
+             "self-time, cross-subsystem gaps, orphan/coverage "
+             "findings")
+    ptrace.add_argument("dump", help="span JSON-lines / chrome trace "
+                                     "/ flight-recorder dump file")
+    ptrace.add_argument("--top", type=int, default=None,
+                        help="rows in the span self-time table "
+                             "(default: MXNET_PROFILER_TOPK, 0 = all)")
+    ptrace.add_argument("--min-coverage", type=float,
+                        default=TRACE_COVERAGE_THRESHOLD,
+                        help="coverage fraction below which a root "
+                             "gets a trace-coverage-gap finding "
+                             "(default 0.9)")
+    ptrace.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the shared machine-readable "
+                             "findings report")
     args = p.parse_args(argv)
-    if args.cmd not in ("summarize", "step", "shard", "opt"):
-        p.error("nothing to do: use the summarize, step, shard or opt "
-                "subcommand")
+    if args.cmd not in ("summarize", "step", "shard", "opt", "trace"):
+        p.error("nothing to do: use the summarize, step, shard, opt "
+                "or trace subcommand")
     try:
         if args.cmd == "step":
             return step_cmd(args.dump, args.as_json)
@@ -674,6 +947,13 @@ def main(argv=None):
             return shard_cmd(args.dump, args.as_json)
         if args.cmd == "opt":
             return opt_cmd(args.dump, args.as_json)
+        if args.cmd == "trace":
+            top = args.top
+            if top is None:
+                from mxnet_tpu.base import get_env
+                top = int(get_env("MXNET_PROFILER_TOPK", 0))
+            return trace_cmd(args.dump, top, args.as_json,
+                             args.min_coverage)
         top = args.top
         if top is None:
             from mxnet_tpu.base import get_env
