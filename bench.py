@@ -1383,6 +1383,70 @@ def elastic_main():
                       "ratio", vs=None, **record)
 
 
+def pod_main():
+    """Multi-host pod recovery benchmark (--pod / MXTPU_BENCH_POD=1):
+    the 3-phase drill at HOST-PROCESS scope — full pod, SIGKILL one
+    host via its own ``pod.host.<rank>:K=kill9`` fault plan, rejoin a
+    warm-standby host from group state-sync over the wire — against an
+    uninterrupted baseline, all with N REAL local processes exchanging
+    through the socket transport (mxnet_tpu/pod/). ONE BENCH-schema
+    JSON line (metric mxpod_recovery, value = post-shrink/pre-kill
+    aggregate-throughput ratio). The contract mirrors --elastic one
+    fault domain up: ratio >= 0.6 at world N-1, recompiles_after_
+    rebuild == 0 beyond the one update-program re-key per world size,
+    final loss within MXELASTIC_LOSS_TOL of the baseline, and the
+    rejoiner synced from the GROUP over the control socket
+    (start_step > 0, no checkpoint file). Knobs:
+    MXTPU_BENCH_POD_{HOSTS,STEPS,KILL_STEP}."""
+    jax, devices, probe_status = _init_jax()  # parent stays CPU-light;
+    from mxnet_tpu import config               # workers are subprocesses
+    from mxnet_tpu.pod.drill import run_pod_drill
+
+    n = int(os.environ.get("MXTPU_BENCH_POD_HOSTS", "3"))
+    steps = int(os.environ.get("MXTPU_BENCH_POD_STEPS", "24"))
+    kill_step = int(os.environ.get("MXTPU_BENCH_POD_KILL_STEP", "8"))
+    common = dict(n_hosts=n, steps=steps, batch=8, hb_interval=0.3,
+                  timeout_s=240.0)
+    baseline = run_pod_drill(**common)
+    drill = run_pod_drill(kill_step=kill_step, kill_rank=1,
+                          action="kill9", rejoin=True,
+                          rejoin_after_steps=4, **common)
+
+    tol = float(config.get("MXELASTIC_LOSS_TOL"))
+    base_loss, loss = baseline.get("final_loss"), drill.get("final_loss")
+    loss_delta = (abs(loss - base_loss) / max(abs(base_loss), 1e-9)
+                  if loss is not None and base_loss is not None
+                  else None)
+    ratio = drill.get("shrink_throughput_ratio")
+    synced = bool(drill.get("rejoin_synced_from_group"))
+    record = dict(
+        metric="mxpod_recovery",
+        hosts=n, steps=steps, kill_step=kill_step,
+        recovery_s=drill.get("recovery_s"),
+        steps_lost=drill.get("steps_lost"),
+        world_after_kill=drill.get("world_after_kill"),
+        rate_full_samples_per_s=drill.get("rate_full_samples_per_s"),
+        rate_shrunk_samples_per_s=drill.get(
+            "rate_shrunk_samples_per_s"),
+        rate_rejoined_samples_per_s=drill.get(
+            "rate_rejoined_samples_per_s"),
+        recompiles_after_rebuild=drill.get("recompiles_after_rebuild"),
+        rekeys=drill.get("rekeys"),
+        final_loss=loss, baseline_loss=base_loss,
+        loss_delta_rel=(round(loss_delta, 6)
+                        if loss_delta is not None else None),
+        loss_tol=tol,
+        rejoin_synced_from_group=synced,
+        recovered=(ratio is not None and ratio >= 0.6
+                   and drill.get("recompiles_after_rebuild") == 0
+                   and loss_delta is not None and loss_delta <= tol
+                   and synced),
+        platform=devices[0].platform,
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(ratio, unit="post-shrink/pre-kill aggregate throughput "
+                      "ratio", vs=None, **record)
+
+
 def guard_main():
     """mxguard integrity benchmark (--guard / MXTPU_BENCH_GUARD=1),
     two phases, ONE BENCH-schema JSON line (metric mxguard_drill,
@@ -1852,6 +1916,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
               else "mxelastic_recovery"
               if os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
+              else "mxpod_recovery"
+              if os.environ.get("MXTPU_BENCH_POD") == "1"
               else "mxguard_drill"
               if os.environ.get("MXTPU_BENCH_GUARD") == "1"
               else "mxtrace_overhead"
@@ -1908,6 +1974,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_GRAPHOPT"] = "1"
     if "--elastic" in sys.argv:
         os.environ["MXTPU_BENCH_ELASTIC"] = "1"
+    if "--pod" in sys.argv:
+        os.environ["MXTPU_BENCH_POD"] = "1"
     if "--guard" in sys.argv:
         os.environ["MXTPU_BENCH_GUARD"] = "1"
     if "--trace-overhead" in sys.argv:
@@ -1926,6 +1994,7 @@ if __name__ == "__main__":
     _shard = os.environ.get("MXTPU_BENCH_SHARD") == "1"
     _graphopt = os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
     _elastic = os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
+    _pod = os.environ.get("MXTPU_BENCH_POD") == "1"
     _guard = os.environ.get("MXTPU_BENCH_GUARD") == "1"
     _tracebench = os.environ.get("MXTPU_BENCH_TRACE") == "1"
     if "--child" in sys.argv:
@@ -1944,6 +2013,8 @@ if __name__ == "__main__":
                 graphopt_main()
             elif _elastic:
                 elastic_main()
+            elif _pod:
+                pod_main()
             elif _guard:
                 guard_main()
             elif _tracebench:
@@ -1959,6 +2030,7 @@ if __name__ == "__main__":
                           else "mxshard_scaling" if _shard
                           else "mxopt_speedup" if _graphopt
                           else "mxelastic_recovery" if _elastic
+                          else "mxpod_recovery" if _pod
                           else "mxguard_drill" if _guard
                           else "mxtrace_overhead" if _tracebench
                           else "resnet50_train_throughput"),

@@ -123,6 +123,7 @@ from . import shard  # noqa: F401  (GSPMD sharded training over a named mesh)
 from . import serve  # noqa: F401  (dynamic-batching inference serving)
 from . import serve2  # noqa: F401  (routed continuous-batching serving, paged KV-cache)
 from . import resil  # noqa: F401  (fault injection, retry policies, preemption guard, watchdogs)
+from . import pod  # noqa: F401  (multi-host process-group runtime: bootstrap, host-loss recovery)
 from . import rtc  # noqa: F401
 from . import subgraph  # noqa: F401
 from . import executor_manager  # noqa: F401

@@ -111,6 +111,19 @@ class CheckpointManager:
                     "world_size": ses.world,
                     "worker_id": ses.worker_id,
                     "samples": ses.samples_seen}
+                # pod topology alongside: which HOST PROCESSES held
+                # this group, so a restore into a different host count
+                # re-infers the ShardPlan batch axis and accounts the
+                # cross-topology move (mxnet_tpu/pod/)
+                from .pod import active_context as _pod_active
+                ctx = _pod_active()
+                if ctx is not None:
+                    elastic_desc["pod"] = ctx.topology()
+                else:
+                    elastic_desc["pod"] = {
+                        "n_hosts": ses.world,
+                        "ranks": list(ses.view.workers),
+                        "coordinator": None}
         if trainer is not None:
             # gluon.Trainer or parallel.ParallelTrainer
             if hasattr(trainer, "params") and isinstance(
@@ -392,6 +405,44 @@ class CheckpointManager:
                     "(world %d)", saved_gen,
                     elastic.get("world_size"), ses.generation,
                     ses.world)
+        # pod topology: a checkpoint from N host processes restoring
+        # into M re-infers the ShardPlan batch axis against the
+        # devices present NOW (save at 4 procs, resume at 2) and
+        # accounts the move — the host-count sibling of the
+        # mesh-size reshard below
+        pod_desc = (elastic or {}).get("pod")
+        if pod_desc is not None:
+            from .pod import active_context as _pod_active
+            ctx = _pod_active()
+            now_hosts = ctx.nprocs if ctx is not None else \
+                (ses.world if ses is not None and ses.view is not None
+                 else None)
+            saved_hosts = int(pod_desc.get("n_hosts", 0) or 0)
+            if now_hosts is not None and saved_hosts and \
+                    saved_hosts != now_hosts:
+                from .telemetry import metrics as _metrics
+                _metrics.counter(
+                    "mxpod_cross_topology_restores_total",
+                    "checkpoint restores into a different pod host "
+                    "count").inc()
+                _log.info(
+                    "pod checkpoint: saved across %d host(s) %s "
+                    "(coordinator %s), restoring into %d — "
+                    "re-inferring the ShardPlan batch axis",
+                    saved_hosts, pod_desc.get("ranks"),
+                    pod_desc.get("coordinator"), now_hosts)
+                plan = getattr(trainer, "_shard_plan", None)
+                if plan is not None:
+                    try:
+                        trainer._shard_plan = plan.reinfer()
+                    except Exception as e:
+                        # a plan that cannot re-infer (axis product vs
+                        # devices present) must not sink the restore —
+                        # the next fuse_step bind surfaces it properly
+                        _log.warning(
+                            "pod checkpoint: ShardPlan re-inference "
+                            "failed (%s); keeping the recorded plan",
+                            e)
         plan = getattr(trainer, "_shard_plan", None)
         if shard is not None and plan is not None:
             saved_n = int(shard.get("n_devices", 0) or 0)

@@ -591,6 +591,48 @@ register_flag(
     "instead classifies by re-execution and retries/quarantines "
     "regardless of this flag.")
 register_flag(
+    "MXPOD_COORDINATOR", str, "",
+    "host:port of the pod control plane (pod.PodContext): rank 0 "
+    "binds a kvstore server carrying the elastic membership "
+    "coordinator there; every rank's ElasticKVStore reaches it over "
+    "the framed-pickle socket transport. Empty = fall back to the "
+    "MX_KV_SERVER env exported by tools/launch.py (single process "
+    "without either: a loopback server on a free port).")
+register_flag(
+    "MXPOD_RANK", int, -1,
+    "This process's pod rank (pod.PodContext). -1 = fall back to the "
+    "launcher env (MX_WORKER_ID / OMPI_COMM_WORLD_RANK / ... via "
+    "base.worker_rank). Rank 0 is the coordinator host: it binds "
+    "MXPOD_COORDINATOR and owns the membership verdicts.")
+register_flag(
+    "MXPOD_NPROCS", int, 0,
+    "Number of host processes in the pod (pod.PodContext). 0 = fall "
+    "back to MX_NUM_WORKERS from the launcher. Group formation waits "
+    "for this many registrations before the first exchange.")
+register_flag(
+    "MXPOD_HEARTBEAT_S", float, 0.0,
+    "Pod host-heartbeat interval in seconds: PodContext maps it onto "
+    "MXELASTIC_HEARTBEAT_S for both the rank-0 verdict policy and "
+    "the worker-side pump, so one flag tunes host-loss detection "
+    "end to end. 0 = keep MXELASTIC_HEARTBEAT_S as configured.")
+register_flag(
+    "MXPOD_JOURNAL_DIR", str, "",
+    "Directory of the coordinator's control-plane journal (elastic."
+    "ElasticCoordinator): the leader appends one JSON line per "
+    "generation bump (generation, workers, devices), and a RESTARTED "
+    "rank-0 replays the newest entry to re-form the group — members "
+    "restored, generation bumped once more so every survivor fences "
+    "with the usual MembershipChanged instead of orphaning "
+    "(docs/resilience.md multi-host section). Empty = no journal "
+    "(a coordinator restart orphans the group).")
+register_flag(
+    "MXPOD_COORDINATOR_GRACE_S", float, 30.0,
+    "How long a worker's PodGroup keeps retrying the control-plane "
+    "socket (bounded jittered backoff, resil.policy.RetryPolicy) "
+    "after transport failures before raising the typed "
+    "CoordinatorLost. Long enough to cover a coordinator restart + "
+    "journal replay; waiters never wedge silently either way.")
+register_flag(
     "MXTRACE", bool, True,
     "Correlated cross-subsystem tracing (mxnet_tpu/trace/, docs/"
     "observability.md): spans with trace_id/span_id/parent thread the "

@@ -39,6 +39,7 @@ store.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -84,7 +85,8 @@ class ElasticCoordinator:
     def __init__(self, tracker: Optional[MembershipTracker] = None,
                  timeout_s: Optional[float] = None,
                  tick_s: float = _TICK_S,
-                 clock: Callable[[], float] = None):
+                 clock: Callable[[], float] = None,
+                 journal_dir: Optional[str] = None):
         clock = clock or time.monotonic
         self.tracker = tracker or MembershipTracker(clock=clock)
         self._clock = self.tracker._clock
@@ -106,6 +108,98 @@ class ElasticCoordinator:
         self._m_rebuilds = _metrics.counter(
             "mxelastic_rebuild_barriers_total",
             "rebuild barriers completed")
+        # -- control-plane journal (coordinator hardening, mxpod) -----
+        # One JSON line per generation bump; a restarted rank-0 replays
+        # the newest entry so the group RE-FORMS (members restored,
+        # generation bumped once more) instead of orphaning every
+        # worker behind a fresh empty tracker.
+        if journal_dir is None:
+            from .. import config
+            journal_dir = str(config.get("MXPOD_JOURNAL_DIR") or "")
+        self._journal_path = (
+            os.path.join(journal_dir, "membership.jsonl")
+            if journal_dir else None)
+        self._journaled_gen: Optional[int] = None
+        self.restored = False
+        if self._journal_path:
+            os.makedirs(journal_dir, exist_ok=True)
+            last = self._read_journal_tail()
+            if last is not None:
+                view = self.tracker.restore(
+                    int(last["generation"]), last.get("workers") or [],
+                    {w: tuple(d) for w, d in
+                     (last.get("devices") or {}).items()})
+                self.tracker.bump("coordinator restarted: journal "
+                                  "replayed")
+                self.restored = True
+                _metrics.counter(
+                    "mxpod_coordinator_restores_total",
+                    "coordinator restarts that re-formed the group "
+                    "from the membership journal").inc()
+                _log.warning(
+                    "coordinator restart: journal %s replayed — "
+                    "generation %d, %d member(s) %s restored and "
+                    "bumped to %d so survivors fence and rebuild",
+                    self._journal_path, view.generation,
+                    view.world_size, list(view.workers),
+                    self.tracker.generation)
+            with self._cv:
+                self._journal_sync(reason="restart" if self.restored
+                                   else "open")
+
+    # ------------------------------------------------------------------
+    # the control-plane journal
+    # ------------------------------------------------------------------
+    def _read_journal_tail(self) -> Optional[Dict[str, object]]:
+        import json
+        if not self._journal_path or \
+                not os.path.exists(self._journal_path):
+            return None
+        last = None
+        try:
+            with open(self._journal_path) as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    try:
+                        last = json.loads(ln)
+                    except ValueError:
+                        # a torn tail line (crash mid-append) is
+                        # expected — the previous entry still stands
+                        continue
+        except OSError as e:
+            _log.warning("membership journal unreadable (%s): %s — "
+                         "starting empty", self._journal_path, e)
+            return None
+        return last
+
+    def _journal_sync(self, reason: Optional[str] = None):
+        """Append the current view if its generation is not journaled
+        yet. Under _cv (every mutation notify path funnels through
+        here); append+flush+fsync so the entry survives a SIGKILL'd
+        coordinator — the exact crash the replay exists for."""
+        if not self._journal_path:
+            return
+        view = self.tracker.view()
+        if view.generation == self._journaled_gen and reason is None:
+            return
+        import json
+        entry = {"generation": view.generation,
+                 "workers": list(view.workers),
+                 "devices": {w: list(d)
+                             for w, d in view.devices.items()},
+                 "ts": time.time()}
+        if reason:
+            entry["reason"] = reason
+        try:
+            with open(self._journal_path, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            self._journaled_gen = view.generation
+        except OSError as e:  # a full disk must not kill the group
+            _log.warning("membership journal append failed: %s", e)
 
     # ------------------------------------------------------------------
     # internals
@@ -116,6 +210,7 @@ class ElasticCoordinator:
         lost = self.tracker.check()
         if lost:
             self._gc(self.tracker.generation)
+            self._journal_sync()
             self._cv.notify_all()
         return lost
 
@@ -187,6 +282,7 @@ class ElasticCoordinator:
         with self._cv:
             view = self.tracker.join(worker_id, devices)
             self._gc(view.generation)
+            self._journal_sync()
             self._cv.notify_all()
             return view
 
@@ -210,6 +306,7 @@ class ElasticCoordinator:
         with self._cv:
             view = self.tracker.leave(worker_id)
             self._gc(view.generation)
+            self._journal_sync()
             self._cv.notify_all()
             return view
 
@@ -218,6 +315,7 @@ class ElasticCoordinator:
         with self._cv:
             view = self.tracker.mark_lost(worker_id)
             self._gc(view.generation)
+            self._journal_sync()
             self._cv.notify_all()
             return view
 
@@ -364,6 +462,7 @@ class ElasticCoordinator:
                 j.state = state
                 j.meta = dict(meta or {})
             self._gc(view.generation)
+            self._journal_sync()
             self._cv.notify_all()
             _log.info("leader %r admitted %s at generation %d",
                       leader_id, sorted(pending), view.generation)
@@ -425,10 +524,19 @@ class ElasticCoordinator:
         if obj.startswith("elastic."):
             self.mark_lost(obj[len("elastic."):])
 
-    def attach_watchdog(self, watchdog, act: bool = False):
+    def attach_watchdog(self, watchdog, act: bool = False,
+                        hosts: bool = True):
         """Register the probe (and, when ``act=True``, the verdict
-        action) on a :class:`~mxnet_tpu.resil.watchdog.Watchdog`."""
+        action) on a :class:`~mxnet_tpu.resil.watchdog.Watchdog`.
+        ``hosts=True`` (default) additionally wires the pod host-scope
+        liveness probe (resil.watchdog.host_liveness_probe): per-rank
+        last-beat age gauges plus a ``host_lost`` finding that names
+        the rank and last generation and freezes the crash flight
+        recorder on the verdict."""
         watchdog.add_probe(self.watchdog_probe)
+        if hosts:
+            from ..resil.watchdog import host_liveness_probe
+            watchdog.add_probe(host_liveness_probe(self))
         if act:
             watchdog.on_verdict(self.watchdog_action)
         return watchdog
@@ -445,4 +553,6 @@ class ElasticCoordinator:
                     "heartbeat_ages": {
                         w: round(a, 3) for w, a in
                         self.tracker.heartbeat_ages().items()},
-                    "lost_after_s": self.tracker.lost_after_s}
+                    "lost_after_s": self.tracker.lost_after_s,
+                    "journal": self._journal_path,
+                    "restored": self.restored}

@@ -35,7 +35,18 @@ from ..base import get_logger
 from .coordinator import ElasticCoordinator
 from .membership import GroupFailed, MembershipTracker, WorkerEvicted
 
-__all__ = ["run_elastic_drill"]
+__all__ = ["run_elastic_drill", "run_pod_drill"]
+
+
+def run_pod_drill(*args, **kwargs):
+    """The subprocess N-HOST harness: same drill contract, but every
+    worker is a real host process over the socket-transport exchange
+    (SIGKILL'able, coordinator-restartable). Implementation lives in
+    :mod:`mxnet_tpu.pod.drill`; re-exported here because the two
+    harnesses are the two rungs of one ladder — threads prove the
+    protocol, processes prove the pod."""
+    from ..pod.drill import run_pod_drill as _impl
+    return _impl(*args, **kwargs)
 
 _log = get_logger("mxnet_tpu.elastic")
 

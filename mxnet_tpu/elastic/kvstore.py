@@ -52,7 +52,7 @@ class RemoteGroup:
     replies so rebuild logic cannot tell the transports apart."""
 
     def __init__(self, address: Optional[str] = None,
-                 client=None):
+                 client=None, retries: int = 50):
         from .. import kvstore_server as srv
         if client is not None:
             self._client = client
@@ -64,7 +64,7 @@ class RemoteGroup:
                     "elastic RemoteGroup needs a server address: launch "
                     "via tools/launch.py (exports MX_KV_SERVER) or set "
                     "MX_KV_SERVER=host:port")
-            self._client = srv.KVClient(address)
+            self._client = srv.KVClient(address, retries=retries)
 
     def _req(self, op, **payload):
         return self._client.request("elastic", op, payload)
@@ -136,6 +136,15 @@ class ElasticKVStore(KVStoreBase):
     # round BEFORE the bucket allreduce (ElasticStepFunction pairs the
     # taps with this store's generation-checked rounds)
     guard_tap = "pre-exchange"
+    # podlint contract (passes/elasticlint.PodScopeAudit): this store's
+    # exchange crosses HOST PROCESSES, so membership must be able to
+    # tell a dead host from a slow one — "control-socket" names the
+    # liveness channel (per-host beats to the rank-0 coordinator, the
+    # heartbeat pump + every blocked protocol wait). A pod-scope store
+    # without a heartbeat channel turns every host loss into a
+    # full-budget hang; without generation fencing, into a wedge.
+    pod_scope = True
+    heartbeat_channel = "control-socket"
 
     def __init__(self, group=None, worker_id: Optional[str] = None,
                  devices: Sequence[int] = (), join: bool = False,

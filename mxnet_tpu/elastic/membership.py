@@ -261,6 +261,43 @@ class MembershipTracker:
         self._check_min_world(lost)
         return True
 
+    def bump(self, why: str = "") -> MembershipView:
+        """Explicit external generation bump (same member set). The
+        coordinator-restart path uses it after :meth:`restore`: the new
+        generation fences every exchange issued under the old process,
+        so survivors re-enter through the ordinary rebuild barrier."""
+        with self._lock:
+            self.check_failed()
+            self._bump()
+            if why:
+                _log.info("generation bumped to %d (%s)",
+                          self._generation, why)
+            return self.view()
+
+    def restore(self, generation: int, workers: Sequence[str],
+                devices: Optional[Dict[str, Sequence[int]]] = None
+                ) -> MembershipView:
+        """Reinstate a journaled group into a FRESH tracker (the
+        coordinator-restart path, elastic/coordinator.py): members get
+        the recorded devices and a fresh heartbeat stamp — the restart
+        window must not count against their budget; a member that
+        really died with the old coordinator simply never beats again
+        and the normal missed-heartbeat policy removes it. Does NOT
+        bump — the caller bumps once after restore so survivors fence
+        with MembershipChanged instead of resuming a generation whose
+        in-flight rounds died with the old process."""
+        now = self._clock()
+        with self._lock:
+            self.check_failed()
+            self._generation = int(generation)
+            self._members = {
+                str(w): _Member(str(w), (devices or {}).get(w, ()),
+                                now, self._generation)
+                for w in workers}
+            self._g_gen.set(self._generation)
+            self._g_world.set(len(self._members))
+            return self.view()
+
     def leave(self, worker_id: str) -> MembershipView:
         """Graceful departure (preemption notice): bump immediately."""
         with self._lock:

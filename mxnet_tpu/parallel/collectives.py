@@ -69,7 +69,17 @@ def allreduce_across_processes(x):
 
     ref role: ps-lite ZPush+server-accumulate+ZPull
     (src/kvstore/kvstore_dist.h:411, kvstore_dist_server.h:346). Here a
-    tiny jitted psum program over the global device mesh."""
+    tiny jitted psum program over the global device mesh — except on
+    the CPU backend, whose jaxlib cannot run cross-process collectives:
+    there the sum rides the pod socket transport (one fenced elastic
+    round per call against the rank-0 kvstore server; same synchronous
+    deterministic-fold semantics, typed abort instead of a wedge —
+    mxnet_tpu/pod/transport.py)."""
+    from ..pod import transport as _pod_transport
+    if _pod_transport.socket_mode():
+        x = jnp.asarray(x)
+        return jnp.asarray(_pod_transport.host_allreduce(
+            onp.asarray(x))).astype(x.dtype)
     if jax.process_count() <= 1:
         return x
     # lift the (possibly device-committed) local array onto the global
@@ -102,6 +112,10 @@ def _allreduce_jit():
 
 def process_barrier():
     """ref: ps::Postoffice::Barrier (kvstore_dist.h:53)."""
+    from ..pod import transport as _pod_transport
+    if _pod_transport.socket_mode():
+        _pod_transport.host_barrier()
+        return
     if jax.process_count() <= 1:
         return
     # a tiny allreduce acts as a barrier

@@ -216,6 +216,7 @@ def default_manager() -> PassManager:
     pm.register(shardlint.ShardLint())
     pm.register(servelint.ServeLint())
     pm.register(elasticlint.ElasticAbortAudit())
+    pm.register(elasticlint.PodScopeAudit())
     pm.register(guardlint.GuardLint())
     pm.register(metriclint.MetricLint())
     return pm

@@ -42,7 +42,7 @@ from typing import List
 
 from . import Finding, Pass
 
-__all__ = ["ElasticAbortAudit", "ABORT_MODES"]
+__all__ = ["ElasticAbortAudit", "PodScopeAudit", "ABORT_MODES"]
 
 ABORT_MODES = ("local", "timeout", "generation")
 
@@ -123,15 +123,7 @@ class ElasticAbortAudit(Pass):
                     "tell how a blocked exchange aborts"))
                 continue
             if mode == "generation":
-                src = _exchange_sources(klass)
-                wired = "MembershipChanged" in src or any(
-                    "MembershipChanged" in _exchange_sources(a)
-                    for a in klass.__mro__[1:]
-                    if a is not KVStoreBase and a is not object)
-                # the fence may also live behind a session/group call
-                wired = wired or "session.allreduce" in src \
-                    or "_reduce_round" in src
-                if not wired:
+                if not _wired_generation(klass):
                     findings.append(self.finding(
                         "unwired-generation-abort", klass.__name__,
                         "error",
@@ -147,4 +139,95 @@ class ElasticAbortAudit(Pass):
                     "deadline (MXNET_KVSTORE_BARRIER_TIMEOUT) — "
                     "bounded but coarse; jobs that should adapt "
                     "instead of fail want the 'elastic' store"))
+        return findings
+
+
+def _wired_generation(klass) -> bool:
+    """Whether the class's exchange actually touches the typed fence
+    (directly, via a non-base ancestor's override, or through the
+    session/round helpers that raise it) — the ElasticAbortAudit
+    wiring check, shared with the pod-scope audit."""
+    from ..kvstore import KVStoreBase
+    src = _exchange_sources(klass)
+    wired = "MembershipChanged" in src or any(
+        "MembershipChanged" in _exchange_sources(a)
+        for a in klass.__mro__[1:]
+        if a is not KVStoreBase and a is not object)
+    return wired or "session.allreduce" in src \
+        or "_reduce_round" in src
+
+
+class PodScopeAudit(Pass):
+    """Pod-scope audit of process-group members (ISSUE 15; the mxpod
+    runtime, ``mxnet_tpu/pod/``).
+
+    A kvstore whose exchange crosses HOST PROCESSES declares
+    ``pod_scope = True``. Every such member must bring BOTH halves of
+    the host-loss story, or a dead host converts into the exact outage
+    class mxpod exists to kill:
+
+    - a **wired generation abort** (``elastic_abort = "generation"``
+      with the exchange actually touching the typed fence): without
+      it, survivors of a host loss wedge on a contribution that will
+      never arrive — ``pod-unfenced-exchange`` (error);
+    - a **declared heartbeat channel** (``heartbeat_channel``, e.g.
+      ``"control-socket"``): the fence only fires when membership can
+      TELL a dead host from a slow one; generation-fencing without a
+      liveness channel waits out the full barrier budget on every
+      loss — ``no-heartbeat-channel`` (error).
+
+    Cross-process stores that do NOT declare pod scope (the raw
+    jax.distributed collective path) stay visible as ``not-pod-scope``
+    info — the same keep-the-gap-visible posture as guardlint's
+    missing-tap note. Registered in the default manager; fixture
+    coverage asserted by ``mxlint --ops`` / tests/test_mxlint.py."""
+
+    name = "podlint"
+
+    def _default_targets(self):
+        return ElasticAbortAudit()._default_targets()
+
+    def run(self, target=None) -> List[Finding]:
+        classes = target if target is not None \
+            else self._default_targets()
+        findings: List[Finding] = []
+        for klass in classes:
+            pod = bool(getattr(klass, "pod_scope", False))
+            overrides = [m for m in _EXCHANGE_METHODS
+                         if m in klass.__dict__]
+            mode = getattr(klass, "elastic_abort", None)
+            if not pod:
+                if overrides and mode == "timeout":
+                    findings.append(self.finding(
+                        "not-pod-scope", klass.__name__, "info",
+                        f"{klass.__name__} exchanges across processes "
+                        "but is not a pod-scope member (no membership "
+                        "plane): a lost host surfaces only through "
+                        "the coarse collective deadline. Prefer the "
+                        "'elastic' store under mxpod "
+                        "(docs/resilience.md multi-host section)."))
+                continue
+            if mode != "generation" or not _wired_generation(klass):
+                findings.append(self.finding(
+                    "pod-unfenced-exchange", klass.__name__, "error",
+                    f"{klass.__name__} declares pod_scope but its "
+                    f"exchange is not generation-fenced (elastic_abort"
+                    f"={mode!r}"
+                    + ("" if mode != "generation"
+                       else ", declared but never touches "
+                            "MembershipChanged")
+                    + ") — a lost host wedges every surviving host "
+                    "process; wire the typed MembershipChanged fence "
+                    "(mxnet_tpu/elastic/)"))
+            channel = getattr(klass, "heartbeat_channel", None)
+            if not channel:
+                findings.append(self.finding(
+                    "no-heartbeat-channel", klass.__name__, "error",
+                    f"{klass.__name__} declares pod_scope but no "
+                    "heartbeat_channel — membership cannot tell a "
+                    "dead host from a slow one, so every host loss "
+                    "burns the full barrier budget before the fence "
+                    "fires; declare the liveness channel (e.g. "
+                    "'control-socket') and wire per-host beats "
+                    "(docs/resilience.md multi-host section)"))
         return findings

@@ -1,0 +1,148 @@
+"""PodGroup: the worker side of the multi-host control plane, hardened
+against coordinator loss.
+
+`RemoteGroup` (elastic/kvstore.py) assumes the rank-0 kvstore server
+stays up: a transport failure surfaces as one typed
+:class:`~mxnet_tpu.kvstore.KVStoreTimeoutError` per request and the
+caller is on its own. At pod scale the coordinator host is just another
+preemptible machine, so :class:`PodGroup` adds the recovery contract:
+
+- every control-plane request retries transport failures with bounded
+  jittered backoff (``resil.policy.BackoffSchedule``), reconnecting the
+  socket between attempts. The coordinator's reduce protocol makes the
+  re-issue safe: a round contribution is idempotent per
+  ``(generation, round, key, worker)``, and a contribution that raced
+  the old coordinator's death is fenced by the restarted coordinator's
+  journal-replay generation bump — the worker sees the ordinary typed
+  ``MembershipChanged`` and recovers through the rebuild loop it
+  already has;
+- QUICK ops (heartbeat, register, view, ...) additionally cap each
+  attempt at the remaining grace via a resil ``deadline_scope``, so a
+  silently-partitioned coordinator cannot absorb the whole budget in
+  one blocked recv. Blocking protocol waits (allreduce, the rebuild
+  barrier, join admission) keep their server-side deadline
+  (``ElasticTimeout``) — a long wait for slow peers is legitimate;
+- when the coordinator stays unreachable past
+  ``MXPOD_COORDINATOR_GRACE_S`` of consecutive failures, the waiter
+  gets the typed :class:`CoordinatorLost` instead of a silent wedge —
+  the signal that THIS worker should exit and let the cluster manager
+  reschedule it (the restarted worker rejoins through the group
+  state-sync, never a checkpoint file).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..base import MXNetError, get_logger
+from ..elastic.kvstore import RemoteGroup
+
+__all__ = ["CoordinatorLost", "PodGroup"]
+
+_log = get_logger("mxnet_tpu.pod")
+
+# ops that complete in one coordinator lock acquisition: cap each
+# attempt's socket wait at the remaining grace. Blocking protocol waits
+# stay on the server-side deadline (ElasticTimeout).
+_QUICK_OPS = frozenset(("register", "heartbeat", "leave", "mark_lost",
+                        "view", "announce_join", "describe"))
+
+
+class CoordinatorLost(MXNetError):
+    """The pod control plane (rank-0 coordinator) stayed unreachable
+    past the MXPOD_COORDINATOR_GRACE_S budget of bounded-backoff
+    reconnects. NOT retryable under this identity: the worker should
+    exit so the cluster manager reschedules it — the journal-replaying
+    restarted coordinator re-forms the group and the worker re-enters
+    through the join state-sync.
+
+    Constructing one freezes the crash flight recorder (the waiter is
+    about to die with the only readable timeline of the outage)."""
+
+    def __init__(self, *args, **extra):
+        super().__init__(*args)
+        from ..trace import crash_dump
+        crash_dump("coordinator_lost",
+                   site=str(args[0])[:120] if args else None,
+                   extra=extra or None)
+
+
+class PodGroup(RemoteGroup):
+    """See module docstring. Drop-in for RemoteGroup everywhere an
+    elastic session/kvstore takes a ``group``."""
+
+    def __init__(self, address: Optional[str] = None, client=None,
+                 grace_s: Optional[float] = None,
+                 backoff=None):
+        # generous dial-in budget: sibling ranks race rank 0's (slow,
+        # jax-importing) server bring-up at pod start
+        super().__init__(address=address, client=client, retries=300)
+        from .. import config
+        from ..resil.policy import BackoffSchedule
+        if grace_s is None:
+            grace_s = float(config.get("MXPOD_COORDINATOR_GRACE_S"))
+        self.grace_s = float(grace_s)
+        self._backoff = backoff or BackoffSchedule(base_ms=100.0,
+                                                   max_ms=2000.0)
+        from ..telemetry import metrics as _metrics
+        self._m_retries = _metrics.counter(
+            "mxpod_coordinator_retries_total",
+            "control-plane requests re-issued after a transport "
+            "failure (coordinator restart / network blip)")
+        self._m_lost = _metrics.counter(
+            "mxpod_coordinator_lost_total",
+            "waiters that gave up on the coordinator after the "
+            "MXPOD_COORDINATOR_GRACE_S budget")
+
+    def reconnect(self):
+        """Drop the socket so the next request dials fresh (used after
+        an external recovery action; requests also reconnect on their
+        own between attempts)."""
+        self._client._reconnect()
+
+    def _req(self, op, **payload):
+        from ..kvstore import KVStoreTimeoutError
+        from ..resil.policy import deadline_scope
+        first_failure = None
+        attempt = 0
+        while True:
+            try:
+                if op in _QUICK_OPS:
+                    # quick ops complete in one coordinator lock
+                    # acquisition: bound EVERY attempt's recv at the
+                    # (remaining) grace — a silently-partitioned
+                    # coordinator holding the TCP connection open must
+                    # not wedge the first attempt for the full ~360s
+                    # barrier-based socket deadline (it would also
+                    # hold the shared client lock against the pump)
+                    left = self.grace_s if first_failure is None \
+                        else max(0.05, self.grace_s
+                                 - (time.monotonic() - first_failure))
+                    with deadline_scope(left):
+                        return super()._req(op, **payload)
+                return super()._req(op, **payload)
+            except KVStoreTimeoutError as e:
+                now = time.monotonic()
+                if first_failure is None:
+                    first_failure = now
+                    _log.warning(
+                        "pod control plane unreachable during %r (%s) "
+                        "— retrying with backoff for up to %.1fs",
+                        op, e, self.grace_s)
+                if now - first_failure >= self.grace_s:
+                    self._m_lost.inc()
+                    raise CoordinatorLost(
+                        f"pod coordinator unreachable for "
+                        f"{now - first_failure:.1f}s (grace "
+                        f"MXPOD_COORDINATOR_GRACE_S={self.grace_s:g}) "
+                        f"during {op!r} — exiting so the cluster "
+                        "manager reschedules this worker; a restarted "
+                        "rank-0 replays its membership journal and "
+                        "the group re-forms (docs/resilience.md "
+                        "multi-host section)", op=op,
+                        waited_s=round(now - first_failure, 2)) from e
+                self._m_retries.inc()
+                time.sleep(min(self._backoff.delay(attempt),
+                               max(0.0, self.grace_s
+                                   - (now - first_failure))))
+                attempt += 1

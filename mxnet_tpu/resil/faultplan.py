@@ -37,6 +37,12 @@ Actions:
   ``elastic.worker.<id>`` sites of the in-process elastic drills)
   preempt/kill instead raise the typed :class:`WorkerPreempted` /
   :class:`WorkerKilled` so exactly ONE worker thread dies;
+- ``kill9``     — SIGKILL to this process ALWAYS, even under
+  ``thread_mode`` — the process-scope action of the mxpod host drills
+  (``pod.host.<rank>:K=kill9`` fires at step K of that host's step
+  loop and takes the whole host process down, heartbeat pump and all;
+  survivors must detect the dead HOST through missed beats on the
+  control socket — mxnet_tpu/pod/drill.py);
 - ``nan``       — return the token ``"nan"`` to the caller, which
   poisons that step's loss (TrainGuard's non-finite rollback drill);
 - ``sdc`` / ``sdc:bitflip`` / ``sdc:scale`` — return the token
@@ -72,7 +78,10 @@ __all__ = ["FaultInjectedError", "WorkerKilled", "WorkerPreempted",
            "is_active", "reset"]
 
 # the injection sites the framework wires up; inject() accepts any name
-# (user code can add its own sites) but the parser warns on typos
+# (user code can add its own sites) but the parser warns on typos.
+# Per-instance site families: elastic.worker.<rank> (thread-mode
+# in-process drills), guard.sdc[.<worker_id>] (mxguard taps),
+# pod.host.<rank> (the mxpod subprocess worker's step boundary)
 KNOWN_SITES = ("kvstore.push", "kvstore.pull", "io", "serve.submit",
                "checkpoint.write", "checkpoint.restore", "step")
 
@@ -100,7 +109,7 @@ _CLAUSE_RE = re.compile(
     r"^(?P<site>[a-zA-Z_][\w.]*)"
     r"(?:@(?P<nth>\d+)|%(?P<prob>0?\.\d+|1(?:\.0*)?)"
     r"|:(?P<step>\d+)(?P<step_from>\+)?)?"
-    r"=(?P<action>[a-zA-Z_]+)(?::(?P<arg>[^;]+))?$")
+    r"=(?P<action>[a-zA-Z_][a-zA-Z_0-9]*)(?::(?P<arg>[^;]+))?$")
 
 
 def _parse_duration_s(arg: str) -> float:
@@ -123,10 +132,10 @@ class Clause:
                  nth: Optional[int] = None, prob: Optional[float] = None,
                  step: Optional[int] = None, step_from: bool = False,
                  seed: int = 0):
-        if action not in ("raise", "stall", "preempt", "kill", "nan",
-                          "sdc"):
+        if action not in ("raise", "stall", "preempt", "kill", "kill9",
+                          "nan", "sdc"):
             raise MXNetError(f"fault plan: unknown action {action!r} "
-                             "(raise|stall|preempt|kill|nan|sdc)")
+                             "(raise|stall|preempt|kill|kill9|nan|sdc)")
         if action == "stall":
             if not arg:
                 raise MXNetError("fault plan: stall needs a duration, "
@@ -280,6 +289,11 @@ class FaultPlan:
                     f"injected kill at {site} (invocation {inv}"
                     + (f", step {step}" if step is not None else "")
                     + ") — die without cleanup")
+            os.kill(os.getpid(), signal.SIGKILL)
+            return None  # unreachable
+        if hit.action == "kill9":
+            # process-scope by definition (the pod host drills): no
+            # thread-mode downgrade — the whole host process dies
             os.kill(os.getpid(), signal.SIGKILL)
             return None  # unreachable
         if hit.action == "sdc":

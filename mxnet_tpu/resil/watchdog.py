@@ -42,12 +42,85 @@ from typing import Callable, List, Optional
 from ..base import get_logger
 from ..passes import Finding
 
-__all__ = ["Watchdog"]
+__all__ = ["Watchdog", "host_liveness_probe"]
 
 _log = get_logger("mxnet_tpu.resil.watchdog")
 
 # counters whose progress counts as a training heartbeat in poll()
 _STEP_COUNTERS = ("trainer_step_total", "bench_step_total")
+
+
+def host_liveness_probe(coordinator, dump: bool = True):
+    """Pod host-scope liveness detector over an elastic coordinator
+    (the rank-0 control plane of a multi-host process group,
+    ``mxnet_tpu/pod/``). Returns a :meth:`Watchdog.add_probe`-shaped
+    callable that, on every check:
+
+    - exports one ``mxpod_host_beat_age_seconds_<worker>`` gauge per
+      registered host process (last control-socket beat age);
+    - emits a ``host_lost`` finding for every host over the heartbeat
+      budget, naming the RANK and the last generation it was a member
+      of — the pod-scope sibling of the coordinator's own
+      ``worker_lost`` probe (which stays the verdict-action trigger);
+    - freezes the crash flight recorder on the verdict (``dump=True``),
+      so mxtrace captures what the group was doing when the host died
+      (rate-limited per reason, trace/recorder.py).
+
+    Wired by ``ElasticCoordinator.attach_watchdog`` (default on)."""
+    import re as _re
+    from ..telemetry import metrics as _metrics
+    gauges: set = set()  # wids with a live beat-age gauge
+
+    def _rank_of(wid: str, view) -> int:
+        # the pod rank is encoded in the worker id (PodContext names
+        # hosts w<rank>); the membership index is NOT the rank — it is
+        # an arrival/sort position that shifts with departures
+        m = _re.search(r"(\d+)$", wid)
+        if m:
+            return int(m.group(1))
+        return view.rank_of(wid) if wid in view.workers else -1
+
+    def probe() -> List[Finding]:
+        findings: List[Finding] = []
+        view = coordinator.view()
+        threshold = coordinator.tracker.lost_after_s
+        ages = coordinator.tracker.heartbeat_ages()
+        # retire gauges of departed hosts: a dead host frozen at its
+        # last pre-failure age would read healthy forever, and rejoin
+        # churn would grow the registry unboundedly (the per-instance
+        # gauge-leak class metriclint exists for)
+        for wid in list(gauges - set(ages)):
+            _metrics.unregister(f"mxpod_host_beat_age_seconds_{wid}")
+            gauges.discard(wid)
+        for wid, age in sorted(ages.items()):
+            _metrics.gauge(
+                f"mxpod_host_beat_age_seconds_{wid}",
+                "seconds since this pod host's last control-socket "
+                "heartbeat").set(age)
+            gauges.add(wid)
+            if age <= threshold:
+                continue
+            rank = _rank_of(wid, view)
+            dump_path = None
+            if dump:
+                from ..trace import crash_dump
+                dump_path = crash_dump(
+                    "host_lost", site=f"pod.host.{wid}",
+                    extra={"rank": rank, "worker": wid,
+                           "generation": view.generation,
+                           "beat_age_s": round(age, 3),
+                           "budget_s": round(threshold, 3)})
+            findings.append(Finding(
+                "watchdog", "host_lost", f"pod.host.{wid}", "error",
+                f"pod host {wid!r} (rank {rank}) silent for "
+                f"{age:.2f}s (budget {threshold:.2f}s) at generation "
+                f"{view.generation} — candidate for a host-loss "
+                "membership bump"
+                + (f"; flight recorder dumped to {dump_path}"
+                   if dump_path else "")))
+        return findings
+
+    return probe
 
 
 class Watchdog:

@@ -1,0 +1,50 @@
+"""The mxpod CPU smoke worker (tier-1, 2 processes via launch.py).
+
+The minimal cut of dist_sync_kvstore.py: one synchronous push/pull
+whose sum proves the cross-process exchange really crossed processes,
+one barrier, one re-reduce — all riding the mxpod socket transport on
+the CPU backend (jaxlib-CPU has no multiprocess collectives;
+parallel/collectives.py routes through pod/transport.py). Kept tiny so
+the smoke stays inside the tier-1 budget.
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == int(os.environ["MX_NUM_WORKERS"]), (nw, os.environ)
+
+    shape = (2, 3)
+    kv.init("w", nd.zeros(shape))
+    kv.push("w", nd.array(onp.full(shape, float(rank + 1), "float32")))
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    expect = sum(float(r + 1) for r in range(nw))
+    assert onp.allclose(out.asnumpy(), expect), \
+        f"rank {rank}: pull got {out.asnumpy()[0, 0]}, want {expect}"
+
+    kv.barrier()
+
+    # second round on the same key: rounds stay in lockstep
+    kv.push("w", nd.array(onp.full(shape, 1.0, "float32")))
+    out2 = nd.zeros(shape)
+    kv.pull("w", out=out2)
+    assert onp.allclose(out2.asnumpy(), expect + nw), out2.asnumpy()
+
+    print(f"rank {rank}/{nw}: POD_SMOKE_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -137,6 +137,50 @@ def test_bench_serving3_emits_mxserve3_speedup():
 
 
 @pytest.mark.slow
+def test_bench_pod_emits_mxpod_recovery():
+    """--pod contract: one mxpod_recovery JSON line from the
+    subprocess 3-phase drill (full pod -> SIGKILL one host -> warm
+    rejoin) vs uninterrupted, with the acceptance gates pinned:
+    recovery ratio >= 0.6, zero recompiles beyond the per-world
+    update re-key, rejoin synced from the GROUP (no checkpoint file),
+    loss delta inside MXELASTIC_LOSS_TOL."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_POD_HOSTS": "3",
+        "MXTPU_BENCH_POD_STEPS": "14",
+        "MXTPU_BENCH_POD_KILL_STEP": "5",
+        "MXTPU_BENCH_TIMEOUT": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--pod"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxpod_recovery"
+    for key in ("value", "unit", "recovery_s", "steps_lost",
+                "world_after_kill", "rate_full_samples_per_s",
+                "rate_shrunk_samples_per_s", "recompiles_after_rebuild",
+                "rekeys", "final_loss", "baseline_loss",
+                "loss_delta_rel", "loss_tol",
+                "rejoin_synced_from_group", "recovered"):
+        assert key in data, (key, data)
+    assert data["value"] is not None and data["value"] >= 0.6, data
+    assert data["recompiles_after_rebuild"] == 0, data
+    assert data["rejoin_synced_from_group"] is True, data
+    assert data["loss_delta_rel"] <= data["loss_tol"], data
+    assert data["recovered"] is True, data
+    # the re-key budget, per finishing host: one grad program ever,
+    # one update program per world size it trained at
+    for wid, rk in data["rekeys"].items():
+        assert rk["grad"] == 1, (wid, data["rekeys"])
+        assert rk["update"] == len(rk["worlds"]), (wid, data["rekeys"])
+
+
+@pytest.mark.slow
 def test_bench_trace_overhead_emits_mxtrace_overhead():
     """--trace-overhead contract: one mxtrace_overhead JSON line with
     both phase overheads (traced vs untraced fused training with

@@ -13,24 +13,27 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _dist_cpu_collectives_available() -> bool:
-    """Whether this jaxlib can run CROSS-PROCESS collectives on the CPU
-    backend. It can't: jax.distributed initializes fine but the first
-    psum dies with "Multiprocess computations aren't implemented on the
-    CPU backend" (XlaRuntimeError), so every launch.py-driven dist_sync
-    worker below fails for a reason that is jaxlib's, not ours. Flip
-    MXTPU_DIST_CPU_TESTS=1 to re-enable once a jaxlib with CPU (Gloo)
-    cross-process collectives lands — the tests themselves are sound
-    and should come back the day the backend does."""
+def _dist_cpu_tests_enabled() -> bool:
+    """The multi-process dist cases below RUN on CPU hosts now:
+    jaxlib-CPU still cannot execute a cross-process psum, but since
+    mxpod (ISSUE 15) the CPU exchange rides the rank-0 socket
+    transport instead (parallel/collectives.py -> pod/transport.py —
+    the same fenced elastic rounds the pod training exchange uses), so
+    dist_sync push/pull, the horovod-compat surface and the sge/yarn
+    end-to-end launchers all pass where they used to die in the
+    collective. They stay behind MXTPU_DIST_CPU_TESTS=1 only for
+    COST: each spawns 2-4 full python+jax worker processes, and
+    tier-1 already carries the fast 2-process smoke below
+    (test_pod_socket_smoke_two_workers)."""
     return os.environ.get("MXTPU_DIST_CPU_TESTS") == "1"
 
 
 requires_dist_cpu = pytest.mark.skipif(
-    not _dist_cpu_collectives_available(),
-    reason="jaxlib CPU backend lacks multiprocess collectives "
-           "(cross-process psum raises XlaRuntimeError: 'Multiprocess "
-           "computations aren't implemented on the CPU backend'); "
-           "set MXTPU_DIST_CPU_TESTS=1 to run anyway")
+    not _dist_cpu_tests_enabled(),
+    reason="multi-process dist tests spawn 2-4 python+jax workers; "
+           "tier-1 runs the 2-process socket-exchange smoke instead — "
+           "set MXTPU_DIST_CPU_TESTS=1 to run the full set (they "
+           "pass: the CPU exchange rides the mxpod socket transport)")
 
 
 def test_dist_async_kvstore_four_workers():
@@ -145,6 +148,25 @@ def test_worker_rank_mpi_fallback():
             os.environ.pop(k, None)
             if v is not None:
                 os.environ[k] = v
+
+
+def test_pod_socket_smoke_two_workers():
+    """The tier-1 mxpod CPU smoke (ROADMAP item 1 earmarked the
+    skipped dist cases as this smoke): two REAL worker processes
+    through tools/launch.py, dist_sync push/pull + barrier over the
+    socket-transport exchange — the path jaxlib-CPU's missing
+    multiprocess collectives kept dead through PRs 5-14. The full
+    dist_sync/hvd/sge/yarn set runs under MXTPU_DIST_CPU_TESTS=1."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each rank owns one CPU device
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(ROOT, "tests", "nightly", "pod_smoke_worker.py")],
+        env=env, capture_output=True, text=True, timeout=180)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"pod smoke failed:\n{out[-3000:]}"
+    assert out.count("POD_SMOKE_OK") == 2, out[-3000:]
 
 
 @requires_dist_cpu

@@ -406,6 +406,67 @@ def check_elastic():
               "from group state (docs/resilience.md runbook)")
 
 
+def check_pod():
+    """Multi-host pod runtime: MXPOD_* wiring, the live PodContext (if
+    any), control-plane journal, host beat-age gauges and coordinator
+    retry/lost counters (mxnet_tpu/pod/; docs/resilience.md multi-host
+    section)."""
+    print("----------Multi-host pod (mxpod)----------")
+    try:
+        from mxnet_tpu import config, telemetry
+        from mxnet_tpu.pod import active_context
+    except Exception as e:
+        print("pod          : unavailable (%s)" % e)
+        return
+    coord = config.get("MXPOD_COORDINATOR") or \
+        os.environ.get("MX_KV_SERVER") or "(none)"
+    rank = int(config.get("MXPOD_RANK"))
+    nprocs = int(config.get("MXPOD_NPROCS")) or \
+        int(os.environ.get("MX_NUM_WORKERS", "1"))
+    print("coordinator  :", coord)
+    print("rank/nprocs  : %s / %d"
+          % (rank if rank >= 0 else "(from launcher env)", nprocs))
+    hb = float(config.get("MXPOD_HEARTBEAT_S"))
+    print("heartbeat    :", ("%ss (overrides MXELASTIC_HEARTBEAT_S)"
+                             % hb) if hb > 0
+          else "MXELASTIC_HEARTBEAT_S=%s"
+          % config.get("MXELASTIC_HEARTBEAT_S"))
+    jdir = config.get("MXPOD_JOURNAL_DIR") or ""
+    print("journal      :", jdir if jdir else
+          "(none — a coordinator restart orphans the group; set "
+          "MXPOD_JOURNAL_DIR)")
+    print("grace        : %ss until CoordinatorLost"
+          % config.get("MXPOD_COORDINATOR_GRACE_S"))
+    ctx = active_context()
+    if ctx is not None:
+        d = ctx.describe()
+        print("context      : rank %(rank)d/%(nprocs)d worker "
+              "%(worker_id)s%(extra)s" % {
+                  **d, "extra": (" [coordinator host]"
+                                 if d["coordinator_host"] else "")
+                  + (" [journal replayed]" if d["restored"] else "")})
+        cp = d.get("control_plane")
+        if cp:
+            v = cp["view"]
+            print("control plane: generation %s, world %s, members %s"
+                  % (v["generation"], v["world_size"], v["workers"]))
+            if cp.get("pending_joins"):
+                print("  pending join(s):", cp["pending_joins"])
+    else:
+        print("context      : none (not a pod process)")
+    snap = telemetry.snapshot()
+    pod_metrics = {k: v for k, v in sorted(snap.items())
+                   if k.startswith("mxpod_")}
+    for k, v in pod_metrics.items():
+        print(f"  {k} = {v}")
+    lost = snap.get("mxpod_coordinator_lost_total", 0)
+    if lost:
+        print(f"  NOTE: {lost} waiter(s) raised CoordinatorLost — "
+              "the control plane stayed down past the grace; check "
+              "rank 0 and its journal (docs/resilience.md multi-host "
+              "runbook)")
+
+
 def main():
     check_python()
     check_pip()
@@ -419,6 +480,7 @@ def main():
     check_serving2()
     check_resilience()
     check_elastic()
+    check_pod()
     check_guard()
     check_mxlint()
 

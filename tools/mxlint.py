@@ -32,6 +32,30 @@ sys.path.insert(0, ROOT)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+# podlint bad fixtures (module level so inspect.getsource sees them):
+# a pod-scope store missing its liveness channel, and one whose
+# exchange is not generation-fenced — the --ops self-check asserts the
+# audit FIRES on both (duck-typed, never KVStoreBase subclasses: a
+# permanent subclass-registry entry would fail every later audit)
+class _PodFixtureNoBeat:
+    supports_flat_allreduce = True
+    pod_scope = True
+    elastic_abort = "generation"
+
+    def allreduce_flat(self, key, value):
+        return self._reduce_round(key, value)
+
+
+class _PodFixtureUnfenced:
+    supports_flat_allreduce = True
+    pod_scope = True
+    elastic_abort = "timeout"
+    heartbeat_channel = "control-socket"
+
+    def allreduce_flat(self, key, value):
+        return value
+
+
 def _load_module(path):
     spec = importlib.util.spec_from_file_location(
         "mxlint_loaded_" + os.path.splitext(os.path.basename(path))[0], path)
@@ -476,7 +500,8 @@ def main(argv=None):
             + " --xla_force_host_platform_device_count=8").strip()
 
     import mxnet_tpu  # noqa: F401 — populate the registry
-    from mxnet_tpu.passes import findings_report, severity_counts
+    from mxnet_tpu.passes import (Finding, findings_report,
+                                  severity_counts)
     from mxnet_tpu.passes.dispatchlint import DispatchAudit
     from mxnet_tpu.passes.graphlint import lint_json
     from mxnet_tpu.passes.oplint import OpRegistryAudit
@@ -512,11 +537,34 @@ def main(argv=None):
         # silent-wedge audit: kvstores claiming the flat-allreduce
         # fast path must declare (and wire) how a blocked exchange
         # aborts when a peer dies (the elastic membership contract)
-        from mxnet_tpu.passes.elasticlint import ElasticAbortAudit
+        from mxnet_tpu.passes.elasticlint import (ElasticAbortAudit,
+                                                  PodScopeAudit)
         el_findings = ElasticAbortAudit().run()
         findings.extend(el_findings)
         sections.append(("elasticlint", "kvstore exchange-abort "
                                         "contract", el_findings))
+        # pod-scope audit: stores whose exchange crosses host
+        # processes must pair a WIRED generation abort with a declared
+        # heartbeat channel; the audit must FIRE on the bad fixtures
+        # below or the pass is vacuous
+        pod_findings = PodScopeAudit().run()
+        fired = {(f.obj, f.check)
+                 for f in PodScopeAudit().run(
+                     [_PodFixtureNoBeat, _PodFixtureUnfenced])}
+        for obj, check in (("_PodFixtureNoBeat",
+                            "no-heartbeat-channel"),
+                           ("_PodFixtureUnfenced",
+                            "pod-unfenced-exchange")):
+            if (obj, check) not in fired:
+                pod_findings.append(Finding(
+                    "podlint", "selfcheck-coverage", obj, "error",
+                    f"pod-scope audit did not fire {check!r} on the "
+                    "fixture built to trigger it"))
+        findings.extend(pod_findings)
+        sections.append(("podlint", "pod-scope process-group "
+                                    "membership contract "
+                                    "(bad-fixture coverage exercised)",
+                         pod_findings))
     for path in args.graphs:
         try:
             with open(path) as f:

@@ -16,6 +16,14 @@ Subcommands (see docs/resilience.md):
            uninterrupted baseline (gates in the mxlint findings
            schema)
            python tools/mxresil.py elastic --workers 3 --kill-step 12
+  pod      the same drills at POD scale: N real host processes
+           (mxnet_tpu/pod/) over the socket-transport exchange —
+           SIGKILL one host, corrupt one host (cross-host fingerprint
+           vote -> quarantine by rank), or kill the COORDINATOR and
+           let the restarted one replay its generation journal;
+           reports MTTR, steps lost, the re-key budget and the loss
+           delta vs the uninterrupted baseline
+           python tools/mxresil.py pod --mode all
   plan     parse/validate a fault plan and print its clauses
            python tools/mxresil.py plan --plan "kvstore.push@3=raise"
   watch    run the watchdog over a live metrics process once and emit
@@ -247,6 +255,152 @@ def cmd_elastic(args):
     return 1 if findings else 0
 
 
+def cmd_pod(args):
+    """The multi-host pod drills (mxnet_tpu/pod/): N REAL host
+    processes over the socket-transport exchange, one scripted
+    host-scope fault, against an uninterrupted baseline. Modes:
+
+      kill     SIGKILL one host (pod.host.<rank>:K=kill9); survivors
+               absorb the bump, a warm standby rejoins from group
+               state-sync
+      sdc      corrupt one host's gradients; the CROSS-HOST
+               fingerprint vote attributes it by rank and quarantines
+               it through a membership bump
+      restart  SIGKILL the COORDINATOR host (rank 0); the restarted
+               coordinator replays its generation journal and the
+               group re-forms — no orphans, no wedge
+      all      baseline + all three
+
+    Gates are mxlint-schema findings and drive the exit code."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu import config
+    from mxnet_tpu.pod.drill import run_pod_drill
+    from mxnet_tpu.passes import Finding, findings_report
+
+    common = dict(n_hosts=args.hosts, steps=args.steps,
+                  batch=args.batch, hb_interval=args.hb_interval,
+                  seed=args.seed, timeout_s=args.timeout)
+    modes = ["kill", "sdc", "restart"] if args.mode == "all" \
+        else [args.mode]
+    baseline = run_pod_drill(**common)
+    base_loss = baseline.get("final_loss")
+    tol = float(config.get("MXELASTIC_LOSS_TOL"))
+    findings = []
+    drills = {}
+
+    def gate(name, obj, msg):
+        findings.append(Finding("mxresil.pod", name, obj, "error",
+                                msg))
+
+    for mode in modes:
+        if mode == "kill":
+            drill = run_pod_drill(
+                kill_step=args.kill_step, kill_rank=args.kill_rank,
+                action="kill9", rejoin=not args.no_rejoin, **common)
+        elif mode == "sdc":
+            drill = run_pod_drill(
+                kill_step=args.kill_step, kill_rank=args.kill_rank,
+                action="sdc", rejoin=False, **common)
+        else:  # restart
+            drill = run_pod_drill(
+                kill_step=args.kill_step, kill_rank=0,
+                action="kill9", restart_coordinator=True, **common)
+        drills[mode] = drill
+        loss = drill.get("final_loss")
+        delta = (abs(loss - base_loss) / max(abs(base_loss), 1e-9)
+                 if loss is not None and base_loss is not None
+                 else None)
+        drill["loss_delta_rel"] = (round(delta, 6)
+                                   if delta is not None else None)
+        if delta is None or delta > tol:
+            gate("loss-tolerance", mode,
+                 f"{mode}: final-loss delta {delta} vs baseline "
+                 f"exceeds MXELASTIC_LOSS_TOL={tol} "
+                 f"(drill {loss}, baseline {base_loss})")
+        if drill.get("recompiles_after_rebuild", 0):
+            gate("steady-state-recompiles", mode,
+                 f"{mode}: {drill['recompiles_after_rebuild']} "
+                 "compile(s) beyond the one-re-key-per-world budget")
+        for wid, rk in (drill.get("rekeys") or {}).items():
+            if rk["grad"] != 1 or rk["update"] != len(rk["worlds"]):
+                gate("rekey-budget", f"{mode}:{wid}",
+                     f"{wid} compiled {rk['grad']} grad / "
+                     f"{rk['update']} update programs across worlds "
+                     f"{rk['worlds']} — budget is 1 grad total and "
+                     "1 update per world size")
+        if mode == "kill":
+            ratio = drill.get("shrink_throughput_ratio")
+            if ratio is None or ratio < args.min_ratio:
+                gate("shrink-throughput", mode,
+                     f"post-shrink aggregate throughput ratio {ratio} "
+                     f"below the {args.min_ratio} gate"
+                     if ratio is not None else
+                     "shrunk phase recorded no steps — the gate was "
+                     "never measured")
+            if not args.no_rejoin and \
+                    not drill.get("rejoin_synced_from_group"):
+                gate("rejoin-state-sync", mode,
+                     "the rejoined host did not sync live state from "
+                     "the group (start_step 0 / no formed event) — "
+                     "checkpoint-free rejoin contract broken")
+        if mode == "sdc":
+            g = drill.get("guard") or {}
+            det = g.get("detected_step")
+            # detection must land AT or within one step AFTER the
+            # injection — an earlier suspect event would be a spurious
+            # verdict, not the injected corruption being caught
+            if det is None or det < args.kill_step or \
+                    det - args.kill_step > 1:
+                gate("sdc-detection", mode,
+                     f"corrupt host not detected within 1 step "
+                     f"(injected {args.kill_step}, detected {det})")
+            want = f"w{args.kill_rank}"
+            if g.get("suspects") != [want]:
+                gate("sdc-attribution", mode,
+                     f"vote attributed {g.get('suspects')}, "
+                     f"expected [{want!r}]")
+            if want not in (g.get("quarantined") or []):
+                gate("sdc-quarantine", mode,
+                     f"{want} was not quarantined through a "
+                     "membership bump")
+        if mode == "restart":
+            cr = drill.get("coordinator_restart") or {}
+            if not cr.get("journal_replayed"):
+                gate("journal-replay", mode,
+                     "restarted coordinator did not replay its "
+                     "generation journal")
+            if not cr.get("rejoined"):
+                gate("coordinator-host-rejoin", mode,
+                     "the restarted coordinator host never rejoined "
+                     "the group")
+            fv = drill.get("final_view") or {}
+            if fv.get("world_size") != args.hosts:
+                gate("group-reform", mode,
+                     f"group did not re-form to world {args.hosts} "
+                     f"(final view {fv})")
+
+    record = findings_report("mxresil.pod", findings, extra={
+        "metric": "mxpod_drill",
+        "hosts": args.hosts, "steps": args.steps,
+        "kill_step": args.kill_step, "modes": modes,
+        "baseline_loss": base_loss, "loss_tol": tol,
+        "baseline_rate_samples_per_s":
+            baseline.get("rate_full_samples_per_s"),
+        "drills": {m: {k: d.get(k) for k in (
+            "recovery_s", "steps_lost", "world_after_kill",
+            "shrink_throughput_ratio", "rate_full_samples_per_s",
+            "rate_shrunk_samples_per_s", "rate_rejoined_samples_per_s",
+            "recompiles_after_rebuild", "rekeys", "final_loss",
+            "loss_delta_rel", "rejoin_synced_from_group", "guard",
+            "coordinator_restart", "per_worker", "wall_s")}
+            for m, d in drills.items()},
+    })
+    print(json.dumps(record) if args.json
+          else json.dumps(record, indent=2))
+    return 1 if findings else 0
+
+
 def cmd_replay(args):
     """The mxguard deterministic-replay drill: train the seeded drill
     net with the record/checkpoint rings enabled — optionally with a
@@ -411,6 +565,30 @@ def main(argv=None):
     e.add_argument("--timeout", type=float, default=120.0)
     e.add_argument("--json", action="store_true")
     e.set_defaults(fn=cmd_elastic)
+
+    pd = sub.add_parser("pod", help="multi-host pod drills: baseline "
+                                    "vs SIGKILL-one-host vs "
+                                    "corrupt-one-host vs "
+                                    "coordinator-restart (subprocess "
+                                    "workers)")
+    pd.add_argument("--hosts", type=int, default=3)
+    pd.add_argument("--steps", type=int, default=16)
+    pd.add_argument("--kill-step", type=int, default=5)
+    pd.add_argument("--kill-rank", type=int, default=1)
+    pd.add_argument("--mode", choices=("kill", "sdc", "restart",
+                                       "all"), default="kill",
+                    help="which drill to run against the baseline "
+                         "(all = the full trio)")
+    pd.add_argument("--no-rejoin", action="store_true")
+    pd.add_argument("--batch", type=int, default=8)
+    pd.add_argument("--hb-interval", type=float, default=0.3,
+                    help="pod host-heartbeat interval (seconds)")
+    pd.add_argument("--min-ratio", type=float, default=0.6,
+                    help="post-shrink aggregate-throughput gate")
+    pd.add_argument("--seed", type=int, default=0)
+    pd.add_argument("--timeout", type=float, default=300.0)
+    pd.add_argument("--json", action="store_true")
+    pd.set_defaults(fn=cmd_pod)
 
     rp = sub.add_parser("replay", help="mxguard deterministic-replay "
                                        "drill: record, corrupt, "
