@@ -678,6 +678,29 @@ register_flag(
     "0 = auto: 10x the step-time EWMA (min 1 s; 30 s before any step "
     "has been observed).")
 register_flag(
+    "MXSAN", bool, False,
+    "Runtime lock-order sanitizer (mxnet_tpu/san/, docs/observability"
+    ".md MXSAN runbook): the hot subsystems' locks (serve2, pod, "
+    "elastic, trace, telemetry) are constructed through san.make_lock/"
+    "make_rlock/make_condition — with MXSAN=1 they come back "
+    "instrumented, recording the per-thread acquisition-order graph "
+    "(cycles = potential deadlocks, reported with BOTH acquisition "
+    "stacks), per-lock hold/wait/contention stats (san.export_to_"
+    "registry publishes mxsan_lock_* instruments), and a flight-"
+    "recorder dump when a waiter blocks past MXSAN_BLOCK_THRESHOLD_MS."
+    " Off (default) = the factories return plain threading primitives:"
+    " zero wrappers, zero overhead, no recompiles (bench.py "
+    "--san-overhead enforces). Read at LOCK CONSTRUCTION time — set "
+    "it before building engines/groups (module-level locks capture it "
+    "at import).")
+register_flag(
+    "MXSAN_BLOCK_THRESHOLD_MS", float, 1000.0,
+    "MXSAN=1 only: a sanitized-lock waiter blocked longer than this "
+    "triggers ONE mxsan-blocked-waiter flight-recorder dump naming "
+    "the lock, the holder's acquisition site and the waiter's stack — "
+    "then keeps waiting (the sanitizer reports wedges, it never "
+    "changes blocking semantics). 0 disables the threshold.")
+register_flag(
     "MXNET_KVSTORE_TIMEOUT_MS", float, 0.0,
     "Per-request timeout for kvstore data-plane push/pull over the "
     "dist_async transport: exceeding it raises the typed "

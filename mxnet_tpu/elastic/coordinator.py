@@ -47,6 +47,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as onp
 
 from ..base import MXNetError, get_logger
+from ..san.runtime import make_condition
 from .membership import (ElasticTimeout, MembershipChanged,
                          MembershipTracker, MembershipView, WorkerEvicted)
 
@@ -96,7 +97,7 @@ class ElasticCoordinator:
                                       300.0))
         self.timeout_s = float(timeout_s)
         self.tick_s = float(tick_s)
-        self._cv = threading.Condition()
+        self._cv = make_condition("elastic.coordinator.cv")
         self._rounds: Dict[Tuple[int, int, str], _Round] = {}
         self._barrier_arrived: Dict[int, set] = {}
         self._barrier_done: set = set()

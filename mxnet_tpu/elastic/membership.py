@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..base import MXNetError, get_logger
 from ..resil.policy import RetryableError
+from ..san.runtime import make_rlock
 
 __all__ = ["MembershipChanged", "WorkerEvicted", "GroupFailed",
            "ElasticTimeout", "MembershipView", "MembershipTracker"]
@@ -160,7 +161,7 @@ class MembershipTracker:
         self.miss_limit = int(miss_limit)
         self.min_world = int(min_world)
         self._clock = clock
-        self._lock = threading.RLock()
+        self._lock = make_rlock("elastic.membership")
         self._members: Dict[str, _Member] = {}
         self._generation = 0
         self._failed: Optional[str] = None

@@ -206,7 +206,7 @@ def findings_report(tool: str, findings: Iterable[Finding],
 def default_manager() -> PassManager:
     from . import (oplint, graphlint, tracercheck, dispatchlint,
                    steplint, shardlint, servelint, elasticlint,
-                   guardlint, metriclint)
+                   guardlint, metriclint, racelint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
@@ -219,4 +219,5 @@ def default_manager() -> PassManager:
     pm.register(elasticlint.PodScopeAudit())
     pm.register(guardlint.GuardLint())
     pm.register(metriclint.MetricLint())
+    pm.register(racelint.RaceLint())
     return pm

@@ -1,0 +1,57 @@
+"""racelint pass wrapper: the san/ concurrency lint as a registered
+analysis pass.
+
+The analysis itself lives in :mod:`mxnet_tpu.san.racelint` (AST walk,
+guard-map inference, the four checks) with its reviewed suppression
+registry in :mod:`mxnet_tpu.san.exemptions`; this module adapts it to
+the PassManager protocol so it runs from ``default_manager().run_all``
+and ``mxlint --race`` alongside the other lints.
+
+Targets (the run_all duck-typing convention every lint pass here
+follows): a fixture dict ``{"sources": {relpath: source_text}}`` lints
+the given module sources (the bad-fixture coverage path); a string or
+list of strings lints those files/directories; ``None`` or any other
+object (``run_all`` hands every pass the same target) lints the live
+mxnet_tpu package tree with the exemption registry applied.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+from . import Finding, Pass
+
+__all__ = ["RaceLint"]
+
+
+class RaceLint(Pass):
+    """See module docstring."""
+
+    name = "racelint"
+
+    def run(self, target=None) -> List[Finding]:
+        from ..san import exemptions, racelint
+        if isinstance(target, dict) and "sources" in target:
+            out: List[Finding] = []
+            for rel in sorted(target["sources"]):
+                out.extend(racelint.lint_source(
+                    target["sources"][rel], rel))
+            return exemptions.apply_exemptions(out)
+        if isinstance(target, str) and os.path.exists(target):
+            if os.path.isdir(target):
+                return racelint.lint_tree(target)
+            return exemptions.apply_exemptions(
+                racelint.lint_file(target))
+        if (isinstance(target, (list, tuple)) and target
+                and all(isinstance(t, str) for t in target)):
+            out = []
+            for t in target:
+                if os.path.isdir(t):
+                    out.extend(racelint.lint_tree(
+                        t, apply_exemptions=False))
+                else:
+                    out.extend(racelint.lint_file(t))
+            return exemptions.apply_exemptions(out)
+        # any other target (run_all hands every pass the same object)
+        # -> lint the live package
+        return racelint.lint_tree()

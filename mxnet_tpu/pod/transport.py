@@ -36,12 +36,13 @@ from typing import Optional
 import numpy as onp
 
 from ..base import MXNetError, get_logger, worker_rank
+from ..san.runtime import make_lock
 
 __all__ = ["socket_mode", "host_allreduce", "host_barrier", "reset"]
 
 _log = get_logger("mxnet_tpu.pod")
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("pod.transport.session")
 _SESSION = None
 
 

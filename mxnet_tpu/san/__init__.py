@@ -1,0 +1,33 @@
+"""mxsan: whole-repo concurrency lint + runtime lock-order sanitizer.
+
+Two halves, one bug budget:
+
+- :mod:`.racelint` — AST-based static lint over mxnet_tpu's own
+  source (unguarded writes, bare ``Condition.wait``, blocking calls
+  under a lock, restore-then-unset env teardowns), registered as the
+  ``racelint`` pass and exposed via ``mxlint --race``. The
+  :mod:`.exemptions` registry keeps the repo shippable-clean with
+  every suppression reviewed and reasoned.
+- :mod:`.runtime` — the ``MXSAN=1`` lock-order sanitizer: sanitized
+  lock factories (:func:`make_lock` / :func:`make_rlock` /
+  :func:`make_condition`) adopted by the hot subsystems, a per-thread
+  acquisition-order graph with cycle detection (both stacks in the
+  finding), per-lock hold/wait/contention stats exported through the
+  telemetry registry on demand, and a flight-recorder dump when a
+  waiter blocks past ``MXSAN_BLOCK_THRESHOLD_MS``. With ``MXSAN=0``
+  (the default) the factories return the plain ``threading``
+  primitives — zero wrappers, zero overhead.
+"""
+from __future__ import annotations
+
+from .runtime import (SanCondition, SanLock, SanRLock, blocked_events,
+                      cycle_findings, enabled, export_to_registry,
+                      held_locks, lock_stats, make_condition, make_lock,
+                      make_rlock, order_graph, report, reset)
+from .racelint import lint_file, lint_source, lint_tree
+
+__all__ = ["SanLock", "SanRLock", "SanCondition",
+           "make_lock", "make_rlock", "make_condition", "enabled",
+           "lock_stats", "order_graph", "cycle_findings", "report",
+           "blocked_events", "export_to_registry", "reset", "held_locks",
+           "lint_source", "lint_file", "lint_tree"]

@@ -1,0 +1,458 @@
+"""racelint: AST-based concurrency lint over mxnet_tpu's own source.
+
+Every recent PR's human review round caught a concurrency bug by hand
+(CHANGES.md: the PR 12 ``drain()`` race, the PR 11 torn ``page_audit``
+snapshot, the PR 15 first-recv wedge, the PR 10 restore-then-unset env
+teardown — twice). Each of those is an instance of a PATTERN that is
+visible in the AST without running anything, the same way metriclint's
+gauge-leak class was. racelint encodes the four patterns:
+
+- ``unguarded-write`` — a class takes ``with self._lock:`` around some
+  writes of an attribute but also writes it outside any guard (in a
+  method other than ``__init__``, which runs before the object is
+  shared). The guard map is INFERRED per class: any attribute assigned
+  ``threading.Lock/RLock/Condition()`` (or the san runtime's
+  ``make_lock/make_rlock/make_condition``) is a lock; any attribute
+  assigned under a ``with <lock>:`` in one method but bare in another
+  is a torn-read/lost-update candidate.
+- ``wait-without-predicate-loop`` — ``cond.wait()`` on an inferred
+  Condition outside any enclosing ``while``/``for``: spurious wakeups
+  and stolen notifications make a bare ``wait()`` return with the
+  predicate false. ``wait_for`` is the loop, so it never flags.
+- ``blocking-under-lock`` — a blocking call (``sleep``, socket
+  ``recv/accept/connect/sendall``, file ``flush``/``fsync``,
+  ``subprocess.*``, thread ``join``) made while an inferred lock is
+  held: every other thread touching that lock now waits on I/O
+  (PR 12's per-span disk flush under the scheduler lock; PR 15's
+  first-recv wedge under the shared client lock).
+- ``restore-then-unset`` — a teardown that assigns ``os.environ[K]``
+  and then unconditionally ``pop``s/``del``s the same key as a later
+  sibling statement: the restore is dead and the key is lost when it
+  WAS set before the test (the PR 10 class). The correct idiom —
+  ``if saved is None: pop else: restore`` — puts the two in different
+  branches and never flags.
+
+All four emit severity ``error`` so ``mxlint --race`` gates on them.
+Two suppression channels keep the repo shippable-clean without
+weakening the gate: an inline ``# mxsan: ok`` comment on the flagged
+line, and the reviewed per-site registry in :mod:`.exemptions`
+(findings there downgrade to ``info`` with the reason attached).
+
+Entry points: :func:`lint_source` (one module, used by fixtures),
+:func:`lint_file`, :func:`lint_tree` (the whole package — what
+``mxlint --race`` runs).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..passes import Finding
+
+__all__ = ["lint_source", "lint_file", "lint_tree", "package_root"]
+
+_LOCK_CTORS = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+    "make_lock": "lock", "make_rlock": "rlock",
+    "make_condition": "condition",
+}
+
+_BLOCKING_ATTRS = {
+    "recv": "socket recv", "recv_into": "socket recv_into",
+    "recvfrom": "socket recvfrom", "accept": "socket accept",
+    "connect": "socket connect", "sendall": "socket sendall",
+    "makefile": "socket makefile", "communicate": "subprocess communicate",
+    "flush": "file flush", "fsync": "fsync",
+}
+_SUBPROCESS_FNS = {"run", "Popen", "check_call", "check_output", "call"}
+# ``.join()`` is only a blocking call when the receiver looks like a
+# thread/process handle — never for ", ".join(...) string joins
+_JOIN_RECEIVER = re.compile(
+    r"(thread|worker|proc|pump|loop|sender|receiver|server|child)", re.I)
+
+# the repo's caller-holds-lock convention: a helper that must only be
+# called with a lock held says so — ``# under self._lock`` or
+# ``Under ``_cv``:`` in its docstring. racelint honors the annotation
+# (the whole method is analyzed as guarded by that lock) instead of
+# flagging every interprocedural helper; the annotation is itself the
+# documentation reviewers asked for at those sites.
+_HELD_NOTE = re.compile(r"[Uu]nder\s+`{0,2}(self\.)?(_\w+)`{0,2}")
+
+
+def _lock_kind(value: ast.AST) -> Optional[str]:
+    """'lock'/'rlock'/'condition' when ``value`` is a lock
+    constructor call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = (f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None)
+    return _LOCK_CTORS.get(name or "")
+
+
+def _receiver_tail(expr: ast.AST) -> Optional[str]:
+    """Last identifier of an attribute chain (``self._pump`` ->
+    ``_pump``); None for constants/calls."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001
+        return ast.dump(node)
+
+
+class _ModuleLint:
+    """One parsed module's lint state."""
+
+    def __init__(self, tree: ast.Module, relpath: str,
+                 src_lines: List[str]):
+        self.tree = tree
+        self.relpath = relpath
+        self.src_lines = src_lines
+        self.findings: List[Finding] = []
+        # module-global locks: NAME -> kind
+        self.module_locks: Dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _lock_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks[t.id] = kind
+
+    # -- helpers ----------------------------------------------------
+
+    def _suppressed(self, lineno: int) -> bool:
+        # the annotation may sit on the flagged line or, when that
+        # line has no room, on its own line immediately above
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(self.src_lines) \
+                    and "mxsan: ok" in self.src_lines[ln - 1]:
+                return True
+        return False
+
+    def emit(self, check: str, obj: str, lineno: int, msg: str) -> None:
+        if self._suppressed(lineno):
+            return
+        self.findings.append(Finding(
+            "racelint", check, obj, "error", msg,
+            loc=f"{self.relpath}:{lineno}"))
+
+    # -- driver -----------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        self._check_restore_then_unset()
+        # module-level statements scanned as a pseudo-function (module
+        # locks can be held at import/teardown time too)
+        self._scan_stmts(self.tree.body, owner="<module>",
+                         self_locks={}, writes=None)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._lint_class(node)
+        return self.findings
+
+    # -- per-class guard-map analysis -------------------------------
+
+    def _lint_class(self, cls: ast.ClassDef) -> None:
+        # 1. infer the class's lock attributes (assigned anywhere
+        #    inside the class, typically __init__)
+        self_locks: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                kind = _lock_kind(node.value)
+                if not kind:
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        self_locks[t.attr] = kind
+        # 2. scan each method recording guarded/unguarded self-attr
+        #    writes + the wait/blocking checks
+        writes: Dict[str, List[Tuple[str, Tuple[str, ...], int]]] = {}
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(item, owner=item.name,
+                                    self_locks=self_locks, writes=writes)
+        # 3. the guard map verdict
+        for attr in sorted(writes):
+            if attr in self_locks:
+                continue  # the lock attribute itself
+            rows = writes[attr]
+            guarded = [r for r in rows if r[1]]
+            unguarded = [r for r in rows
+                         if not r[1] and r[0] != "__init__"]
+            if guarded and unguarded:
+                locks = sorted({g for r in guarded for g in r[1]})
+                sites = ", ".join(f"{m}:{ln}"
+                                  for m, _, ln in unguarded[:4])
+                first = unguarded[0][2]
+                if self._suppressed(first):
+                    continue
+                self.emit(
+                    "unguarded-write", f"{cls.name}.{attr}", first,
+                    f"attribute written under {'/'.join(locks)} in "
+                    f"some methods but bare at {sites} — readers "
+                    "under the lock can observe torn/stale state and "
+                    "concurrent bare writers lose updates; guard the "
+                    "write, or exempt with a reason if the path is "
+                    "provably single-threaded")
+
+    # -- statement walker (guard stack + loop depth) ----------------
+
+    def _held_note(self, func, self_locks) -> Optional[Tuple[str, str]]:
+        """The lock a ``# under self._lock`` / ``Under ``_cv``:``
+        annotation inside ``func``'s source names, when it is a known
+        lock of this class or module."""
+        end = getattr(func, "end_lineno", func.lineno) or func.lineno
+        for line in self.src_lines[func.lineno - 1:end]:
+            m = _HELD_NOTE.search(line)
+            if not m:
+                continue
+            attr = m.group(2)
+            if attr in self_locks:
+                return (f"self.{attr}", self_locks[attr])
+            if attr in self.module_locks:
+                return (attr, self.module_locks[attr])
+        return None
+
+    def _scan_function(self, func, owner: str,
+                       self_locks: Dict[str, str], writes) -> None:
+        base = self._held_note(func, self_locks)
+        self._scan_stmts(func.body, owner=owner, self_locks=self_locks,
+                         writes=writes,
+                         base_guards=(base,) if base else ())
+
+    def _scan_stmts(self, stmts, owner: str, self_locks: Dict[str, str],
+                    writes, base_guards=()) -> None:
+        guards: List[Tuple[str, str]] = list(base_guards)  # (name, kind)
+        cond_names = ({f"self.{a}" for a, k in self_locks.items()
+                       if k == "condition"}
+                      | {n for n, k in self.module_locks.items()
+                         if k == "condition"})
+
+        def lock_of(expr) -> Optional[Tuple[str, str]]:
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                    and expr.attr in self_locks):
+                return (f"self.{expr.attr}", self_locks[expr.attr])
+            if (isinstance(expr, ast.Name)
+                    and expr.id in self.module_locks):
+                return (expr.id, self.module_locks[expr.id])
+            return None
+
+        def record_write(target, lineno: int) -> None:
+            if writes is None:
+                return
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    record_write(elt, lineno)
+                return
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                writes.setdefault(target.attr, []).append(
+                    (owner, tuple(g[0] for g in guards), lineno))
+
+        def check_call(node: ast.Call, loops: int) -> None:
+            f = node.func
+            # wait-without-predicate-loop (regardless of guard stack:
+            # the wait itself proves the condition's lock is held)
+            if isinstance(f, ast.Attribute) and f.attr == "wait":
+                recv = _unparse(f.value)
+                if recv in cond_names and loops == 0:
+                    self.emit(
+                        "wait-without-predicate-loop",
+                        f"{owner}", node.lineno,
+                        f"{recv}.wait() outside any while/for loop: "
+                        "spurious wakeups and stolen notifications "
+                        "return with the predicate false — use "
+                        "`while not pred: cv.wait()` or wait_for()")
+            if not guards:
+                return
+            held = "/".join(g[0] for g in guards)
+            desc = None
+            if isinstance(f, ast.Name) and f.id == "sleep":
+                desc = "sleep"
+            elif isinstance(f, ast.Attribute):
+                base = f.value
+                base_name = base.id if isinstance(base, ast.Name) else None
+                if base_name == "time" and f.attr == "sleep":
+                    desc = "time.sleep"
+                elif base_name == "os" and f.attr == "fsync":
+                    desc = "os.fsync"
+                elif (base_name == "subprocess"
+                        and f.attr in _SUBPROCESS_FNS):
+                    desc = f"subprocess.{f.attr}"
+                elif f.attr in _BLOCKING_ATTRS:
+                    # skip the held condition's own wait-adjacent API
+                    desc = _BLOCKING_ATTRS[f.attr]
+                elif f.attr == "join":
+                    tail = _receiver_tail(base)
+                    if tail and _JOIN_RECEIVER.search(tail):
+                        desc = f"{tail}.join"
+            if desc:
+                self.emit(
+                    "blocking-under-lock", f"{owner}", node.lineno,
+                    f"blocking call ({desc}) while holding {held}: "
+                    "every thread contending that lock now waits on "
+                    "I/O/scheduling — move the call outside the "
+                    "guard, or exempt with a reason if the wait is "
+                    "bounded and intentional")
+
+        def walk(node, loops: int) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in node.items:
+                    ln = lock_of(item.context_expr)
+                    if ln:
+                        guards.append(ln)
+                        pushed += 1
+                    walk(item.context_expr, loops)
+                for st in node.body:
+                    walk(st, loops)
+                if pushed:
+                    del guards[-pushed:]
+                return
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                # the test/iter runs each iteration — inside the loop
+                for child in ast.iter_child_nodes(node):
+                    walk(child, loops + 1)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: fresh guard/loop state (it runs later,
+                # not under the current with)
+                self._scan_function(node, owner=f"{owner}.{node.name}",
+                                    self_locks=self_locks, writes=writes)
+                return
+            if isinstance(node, (ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    record_write(t, node.lineno)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                record_write(node.target, node.lineno)
+            elif isinstance(node, ast.Call):
+                check_call(node, loops)
+            for child in ast.iter_child_nodes(node):
+                walk(child, loops)
+
+        for st in stmts:
+            walk(st, 0)
+
+    # -- restore-then-unset -----------------------------------------
+
+    @staticmethod
+    def _environ_key(expr: ast.AST) -> Optional[ast.AST]:
+        """The key K when ``expr`` is ``os.environ[K]``, else None."""
+        if (isinstance(expr, ast.Subscript)
+                and isinstance(expr.value, ast.Attribute)
+                and expr.value.attr == "environ"
+                and isinstance(expr.value.value, ast.Name)
+                and expr.value.value.id == "os"):
+            return expr.slice
+        return None
+
+    def _check_restore_then_unset(self) -> None:
+        for node in ast.walk(self.tree):
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, field, None)
+                if isinstance(stmts, list) and len(stmts) > 1:
+                    self._scan_restore_block(stmts)
+
+    def _scan_restore_block(self, stmts) -> None:
+        restores: Dict[str, int] = {}  # ast.dump(K) -> restore lineno
+        for st in stmts:
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    key = self._environ_key(t)
+                    if key is not None:
+                        restores[ast.dump(key)] = st.lineno
+                continue
+            # a later SIBLING that unconditionally drops the same key
+            key = None
+            if isinstance(st, ast.Delete):
+                for t in st.targets:
+                    key = key or self._environ_key(t)
+            else:
+                for call in (n for n in ast.walk(st)
+                             if isinstance(n, ast.Call)):
+                    f = call.func
+                    if (isinstance(f, ast.Attribute) and f.attr == "pop"
+                            and call.args
+                            and self._environ_key(
+                                ast.Subscript(value=f.value,
+                                              slice=call.args[0]))
+                            is not None):
+                        key = call.args[0]
+                        break
+            if key is None:
+                continue
+            dump = ast.dump(key)
+            if dump in restores and not self._suppressed(st.lineno):
+                self.emit(
+                    "restore-then-unset", _unparse(key), st.lineno,
+                    f"os.environ[{_unparse(key)}] restored at line "
+                    f"{restores[dump]} then unconditionally removed "
+                    "here — the restore is dead, and a value that WAS "
+                    "set before the test is lost (the PR 10 teardown "
+                    "class); use `if saved is None: pop(...) else: "
+                    "environ[k] = saved`")
+                del restores[dump]
+
+
+def lint_source(src: str, relpath: str = "<string>") -> List[Finding]:
+    """Lint one module's source text. Returns raw findings (no
+    exemption downgrades — callers that lint the live tree apply
+    :func:`exemptions.apply_exemptions`)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("racelint", "parse-error", relpath, "error",
+                        f"could not parse: {e}",
+                        loc=f"{relpath}:{e.lineno or 0}")]
+    return _ModuleLint(tree, relpath, src.splitlines()).run()
+
+
+def lint_file(path: str, relpath: Optional[str] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, relpath or path)
+
+
+def package_root() -> str:
+    """Directory containing the mxnet_tpu package."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_tree(root: Optional[str] = None,
+              apply_exemptions: bool = True) -> List[Finding]:
+    """Lint every ``.py`` file under the mxnet_tpu package (or
+    ``root``), relpaths relative to the package parent so exemption
+    entries read ``mxnet_tpu/serve2/scheduler.py``."""
+    pkg = root or os.path.join(os.path.dirname(package_root()),
+                               "mxnet_tpu")
+    base = os.path.dirname(os.path.abspath(pkg))
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, base).replace(os.sep, "/")
+            findings.extend(lint_file(full, rel))
+    if apply_exemptions:
+        from . import exemptions
+        findings = exemptions.apply_exemptions(findings)
+    return findings

@@ -1,0 +1,710 @@
+"""Runtime lock-order sanitizer (``MXSAN=1``).
+
+The static half of mxsan (:mod:`.racelint`) catches lock-discipline
+bugs that are visible in the source; this half catches the ones that
+only exist at runtime — the ACQUISITION ORDER two threads disagree on.
+Every recent PR's review round found one of these by hand (PR 12's
+``drain()`` racing live recorders, PR 15's first-recv wedge under the
+shared client lock); a sanitizer finds them on the first soak instead.
+
+Design (the lockdep model, scaled down to one process):
+
+- :func:`make_lock` / :func:`make_rlock` / :func:`make_condition` are
+  the construction points the hot subsystems (serve2, pod, elastic,
+  trace, telemetry) call instead of ``threading.Lock()`` etc. With
+  ``MXSAN=0`` (the default) they return the PLAIN ``threading``
+  primitive — zero wrappers, zero overhead, bitwise-identical
+  behavior. The flag is read once at construction (module-level locks
+  capture it at import; engine locks at engine construction).
+- With ``MXSAN=1`` they return :class:`SanLock` / :class:`SanRLock` /
+  :class:`SanCondition` wrappers that keep a per-thread stack of held
+  locks and record a DIRECTED EDGE held→acquired for every nested
+  acquisition into one process-wide order graph. A new edge that
+  closes a cycle (A→B recorded while B→A exists) is a potential
+  deadlock: the finding carries BOTH acquisition stacks — the nested
+  acquire that recorded each direction — so the fix is a code
+  pointer, not a core dump.
+- Per-lock hold-time / wait-time / contention statistics accumulate
+  internally (never touching the telemetry registry on the hot path —
+  the registry's own lock is itself adopted, and observing through it
+  from inside every release would both serialize unrelated subsystems
+  and recurse); :func:`export_to_registry` drains them into
+  ``mxsan_lock_{hold,wait}_ms_<name>`` histograms and
+  ``mxsan_lock_{acquisitions,contentions}_<name>`` counters on demand
+  (diagnose, the MXSAN runbook, tests).
+- A waiter blocked past ``MXSAN_BLOCK_THRESHOLD_MS`` triggers ONE
+  flight-recorder dump (``mxsan-blocked-waiter``, rate-limited by the
+  recorder) naming the lock and the current holder's acquisition
+  site, then keeps waiting — the sanitizer reports wedges, it never
+  changes blocking semantics.
+
+The sanitizer's own bookkeeping lock (``_G``) is a plain
+``threading.Lock`` held only for dict/graph mutation — never across a
+wrapped primitive's ``acquire`` — so instrumenting cannot introduce
+the deadlocks it hunts.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+import warnings
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["SanLock", "SanRLock", "SanCondition",
+           "make_lock", "make_rlock", "make_condition", "enabled",
+           "lock_stats", "order_graph", "cycle_findings", "report",
+           "export_to_registry", "reset", "held_locks"]
+
+
+def _cfg():
+    from .. import config
+    return config
+
+
+def enabled() -> bool:
+    """Current MXSAN flag value (read at every call; the make_*
+    factories consult it at CONSTRUCTION time)."""
+    return bool(_cfg().get("MXSAN"))
+
+
+_THRESH_CACHE = [-1, 1.0]  # [config generation, threshold seconds]
+
+
+def _block_threshold_s() -> float:
+    # generation-cached: this runs on EVERY contended acquire, and a
+    # full config.get (flag table + env fallback) there is measurable
+    # on the serve2 soak
+    config = _cfg()
+    gen = config.generation()
+    cached = _THRESH_CACHE
+    if cached[0] != gen:
+        ms = float(config.get("MXSAN_BLOCK_THRESHOLD_MS"))
+        cached[0] = gen
+        cached[1] = ms / 1000.0 if ms > 0 else 0.0
+    return cached[1]
+
+
+# ---------------------------------------------------------------------------
+# process-wide sanitizer state
+# ---------------------------------------------------------------------------
+
+_G = threading.Lock()            # guards everything below; never held
+                                 # across a wrapped primitive operation
+_STATS: Dict[str, "_LockStats"] = {}
+# (src_name, dst_name) -> edge record with the nested-acquire stack
+_EDGES: Dict[Tuple[str, str], dict] = {}
+_ADJ: Dict[str, set] = {}        # adjacency view of _EDGES for the DFS
+_CYCLES: List[dict] = []         # deduped cycle findings
+_CYCLE_KEYS: set = set()
+_BLOCKED: List[dict] = []        # blocked-past-threshold events
+_TL = threading.local()          # .held: list of _Held
+_SAMPLE_MASK = 15                # hold timing: 1-in-16 acquisitions
+_RESET_GEN = [0]                 # bumped by reset(); invalidates the
+                                 # per-lock cached stats rows
+
+
+class _Held:
+    __slots__ = ("lock", "name", "site", "t_ns", "depth")
+
+    def __init__(self, lock, name, site, t_ns):
+        self.lock = lock
+        self.name = name
+        self.site = site
+        self.t_ns = t_ns
+        self.depth = 1           # >1 for reentrant (RLock/Condition)
+
+
+class _LockStats:
+    """Internal per-lock accumulator. Rows are registered/dropped
+    under ``_G``; the per-acquire field bumps rely on the GIL instead
+    (a racy ``+=`` can undercount — these are diagnostics, not
+    accounting, and keeping the hot path off ``_G`` is what makes the
+    MXSAN=1 soak overhead small)."""
+
+    __slots__ = ("name", "kind", "acquisitions", "contentions",
+                 "blocked", "wait_ns_sum", "wait_ns_max", "hold_ns_sum",
+                 "hold_ns_max", "hold_samples", "pending_wait_ms",
+                 "pending_hold_ms")
+
+    def __init__(self, name: str, kind: str):
+        self.name = name
+        self.kind = kind
+        self.acquisitions = 0
+        self.contentions = 0
+        self.blocked = 0
+        self.wait_ns_sum = 0
+        self.wait_ns_max = 0
+        # hold timing is SAMPLED (1-in-16 acquisitions, plus every
+        # contended one): two perf_counter_ns calls per acquisition
+        # were the single largest sanitizer cost on the serve2 soak.
+        # Counts stay exact; hold_ms_total is the total over TIMED
+        # acquisitions (hold_samples of them), not over all
+        self.hold_ns_sum = 0
+        self.hold_ns_max = 0
+        self.hold_samples = 0
+        # bounded sample buffers export_to_registry() drains into the
+        # telemetry histograms (drain-on-export keeps the hot path off
+        # the registry lock)
+        self.pending_wait_ms: deque = deque(maxlen=512)
+        self.pending_hold_ms: deque = deque(maxlen=512)
+
+    def describe(self) -> dict:
+        acq = self.acquisitions
+        return {
+            "kind": self.kind,
+            "acquisitions": acq,
+            "contentions": self.contentions,
+            "blocked_past_threshold": self.blocked,
+            "wait_ms_total": round(self.wait_ns_sum / 1e6, 3),
+            "wait_ms_max": round(self.wait_ns_max / 1e6, 3),
+            "hold_ms_total": round(self.hold_ns_sum / 1e6, 3),
+            "hold_ms_max": round(self.hold_ns_max / 1e6, 3),
+            "hold_samples": self.hold_samples,
+            "hold_ms_avg": (round(self.hold_ns_sum
+                                  / self.hold_samples / 1e6, 4)
+                            if self.hold_samples else 0.0),
+        }
+
+
+def _stats_row(name: str, kind: str) -> _LockStats:
+    """Get-or-create the stats row. The lock-free read is the hot
+    path; creation (construction, or first acquire after a test
+    reset()) goes through ``_G``."""
+    st = _STATS.get(name)
+    if st is None:
+        with _G:
+            st = _STATS.get(name)
+            if st is None:
+                st = _STATS[name] = _LockStats(name, kind)
+    return st
+
+
+def _held_list() -> List[_Held]:
+    held = getattr(_TL, "held", None)
+    if held is None:
+        held = _TL.held = []
+    return held
+
+
+def held_locks() -> List[str]:
+    """Names of sanitized locks the CURRENT thread holds, outermost
+    first (tests + diagnose)."""
+    return [h.name for h in _held_list()]
+
+
+def _caller_loc(depth: int):
+    """(filename, lineno) of the frame ``depth`` levels above the
+    wrapper — two attribute reads, no traceback machinery and no
+    string formatting (this runs on every sanitized acquire; the
+    f-string lives in :func:`_fmt_site`, paid only on the cold
+    diagnostic paths that actually render a site)."""
+    try:
+        f = sys._getframe(depth)
+        return (f.f_code.co_filename, f.f_lineno)
+    except Exception:  # noqa: BLE001 — sanitizer must never raise
+        return None
+
+
+def _fmt_site(loc) -> str:
+    """Render a ``_caller_loc`` tuple as ``file:line`` (accepts an
+    already-formatted string for robustness)."""
+    if loc is None:
+        return "<unknown>"
+    if isinstance(loc, str):
+        return loc
+    return f"{loc[0]}:{loc[1]}"
+
+
+def _stack(skip: int = 2, limit: int = 16) -> str:
+    """Formatted stack of the caller (captured only on NESTED acquires
+    and threshold events — the rare paths where it pays for itself)."""
+    try:
+        f = sys._getframe(skip)
+        return "".join(traceback.format_stack(f, limit=limit))
+    except Exception:  # noqa: BLE001
+        return "<stack unavailable>"
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS over the edge graph: a path src -> ... -> dst, or None.
+    Caller holds ``_G``."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _ADJ.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _record_edges(held: List[_Held], dst: "SanLock",
+                  dst_stack_fn) -> Optional[dict]:
+    """Record held->dst edges; returns a NEW cycle finding (already
+    appended to _CYCLES) when one closed, else None. Runs the graph
+    mutation under _G; the (expensive) stack capture happens at most
+    once per call via ``dst_stack_fn``."""
+    new_cycle = None
+    dst_stack = None
+    for h in held:
+        if h.name == dst.name:
+            continue  # two instances sharing a name: not an ordering
+        key = (h.name, dst.name)
+        # fast path, no _G: _EDGES is only mutated under _G, and a
+        # CPython dict read is safe against that; the count bump is a
+        # GIL-racy += that can undercount (diagnostic only)
+        edge = _EDGES.get(key)
+        if edge is not None:
+            edge["count"] += 1
+            continue
+        # first sighting of this edge: capture the nested-acquire
+        # stack OUTSIDE _G, then re-check under _G (benign race: the
+        # loser's stack is simply dropped)
+        if dst_stack is None:
+            dst_stack = dst_stack_fn()
+        rec = {"src": h.name, "dst": dst.name,
+               "src_site": _fmt_site(h.site),
+               "dst_site": _fmt_site(_caller_loc(3)),
+               "thread": threading.current_thread().name,
+               "count": 1, "stack": dst_stack}
+        with _G:
+            if key in _EDGES:
+                _EDGES[key]["count"] += 1
+                continue
+            _EDGES[key] = rec
+            _ADJ.setdefault(h.name, set()).add(dst.name)
+            # does dst already reach src? then this edge closed a cycle
+            path = _find_path(dst.name, h.name)
+            if path is not None:
+                cyc_key = frozenset(zip(path, path[1:] + [path[0]]))
+                if cyc_key not in _CYCLE_KEYS:
+                    _CYCLE_KEYS.add(cyc_key)
+                    # the reverse direction's first-sighting stack —
+                    # for a 2-cycle this is exactly "the other
+                    # thread's acquisition stack"
+                    back = _EDGES.get((dst.name, h.name))
+                    new_cycle = {
+                        "locks": path,
+                        "edge": f"{h.name} -> {dst.name}",
+                        "forward_stack": dst_stack,
+                        "forward_thread": rec["thread"],
+                        "reverse_edge": (f"{dst.name} -> {h.name}"
+                                         if back else None),
+                        "reverse_stack": (back["stack"] if back
+                                          else None),
+                        "reverse_thread": (back["thread"] if back
+                                           else None),
+                        "ts": time.time(),
+                    }
+                    _CYCLES.append(new_cycle)
+    return new_cycle
+
+
+def _on_cycle(cycle: dict) -> None:
+    """Out-of-lock reporting for a freshly-closed cycle: warn once,
+    count it, and note it on the flight recorder so the next dump
+    carries it."""
+    msg = (f"mxsan: lock-order cycle {' -> '.join(cycle['locks'])} "
+           f"(potential deadlock); forward edge {cycle['edge']} on "
+           f"thread {cycle['forward_thread']}")
+    warnings.warn(msg, RuntimeWarning, stacklevel=4)
+    try:
+        from ..telemetry import metrics as _m
+        _m.counter("mxsan_lock_cycles_total",
+                   "Lock-order cycles detected by the MXSAN runtime "
+                   "sanitizer").inc()
+        from ..trace.recorder import get_recorder
+        get_recorder().note(
+            "mxsan", "lock-order-cycle", locks=cycle["locks"],
+            edge=cycle["edge"], reverse_edge=cycle["reverse_edge"])
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _on_blocked(name: str, waited_s: float, holder_site) -> None:
+    """A waiter exceeded MXSAN_BLOCK_THRESHOLD_MS: record the event
+    and trigger ONE flight-recorder dump (rate-limited per reason by
+    the recorder)."""
+    holder_site = _fmt_site(holder_site)
+    ev = {"lock": name, "waited_ms": round(waited_s * 1000.0, 1),
+          "holder_site": holder_site,
+          "waiter": threading.current_thread().name,
+          "waiter_stack": _stack(skip=3), "ts": time.time()}
+    with _G:
+        _BLOCKED.append(ev)
+        del _BLOCKED[:-64]
+        st = _STATS.get(name)
+        if st is not None:
+            st.blocked += 1
+    try:
+        from ..telemetry import metrics as _m
+        _m.counter("mxsan_blocked_waiters_total",
+                   "Sanitized-lock waits that exceeded "
+                   "MXSAN_BLOCK_THRESHOLD_MS").inc()
+        from ..trace.recorder import crash_dump
+        crash_dump("mxsan-blocked-waiter", site=name,
+                   extra={"lock": name,
+                          "waited_ms": ev["waited_ms"],
+                          "holder_site": holder_site,
+                          "waiter": ev["waiter"]})
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the wrappers
+# ---------------------------------------------------------------------------
+
+class SanLock:
+    """Instrumented ``threading.Lock``. Context-manager compatible
+    with the plain primitive; adds order-graph edges, hold/wait
+    accounting, and the blocked-waiter dump."""
+
+    _reentrant = False
+    kind = "lock"
+
+    def __init__(self, name: str):
+        self.name = str(name)
+        self._inner = self._make_inner()
+        # the holder's acquisition site (a _caller_loc tuple),
+        # readable without the lock — torn reads only cost a stale
+        # pointer in a diagnostic
+        self._holder_site = None
+        self._st = _stats_row(self.name, self.kind)
+        self._gen = _RESET_GEN[0]
+
+    def _make_inner(self):
+        return threading.Lock()
+
+    # -- bookkeeping ------------------------------------------------
+
+    def _stats(self) -> _LockStats:
+        # per-lock cached row; a test reset() bumps the generation and
+        # the next acquire re-resolves against the fresh table
+        if self._gen != _RESET_GEN[0]:
+            self._st = _stats_row(self.name, self.kind)
+            self._gen = _RESET_GEN[0]
+        return self._st
+
+    def _find_held(self) -> Optional[_Held]:
+        for h in _held_list():
+            if h.lock is self:
+                return h
+        return None
+
+    def _locked_tail(self, st, held, entry, wait_ns: int,
+                     contended: bool) -> bool:
+        """Post-acquire bookkeeping. This runs INSIDE the freshly
+        acquired window, so it is the part of the sanitizer every
+        waiter serializes behind — keep it to counter bumps, the
+        (lock-free) edge check, and a sampled timestamp."""
+        n = st.acquisitions + 1
+        st.acquisitions = n
+        if contended or (n & _SAMPLE_MASK) == 1:
+            entry.t_ns = time.perf_counter_ns()
+        if held:
+            cycle = _record_edges(held, self,
+                                  lambda: _stack(skip=3))
+            if cycle is not None:
+                _on_cycle(cycle)
+        held.append(entry)
+        self._holder_site = entry.site
+        if contended:
+            st.contentions += 1
+            st.wait_ns_sum += wait_ns
+            if wait_ns > st.wait_ns_max:
+                st.wait_ns_max = wait_ns
+            st.pending_wait_ms.append(wait_ns / 1e6)
+        return True
+
+    def _note_hold(self, st, t_ns: int) -> None:
+        """Close one TIMED hold window (sampled; see _LockStats)."""
+        hold_ns = time.perf_counter_ns() - t_ns
+        st.hold_ns_sum += hold_ns
+        st.hold_samples += 1
+        if hold_ns > st.hold_ns_max:
+            st.hold_ns_max = hold_ns
+        st.pending_hold_ms.append(hold_ns / 1e6)
+
+    # -- the lock protocol ------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1,
+                _depth: int = 2):
+        if self._reentrant:
+            h = self._find_held()
+            if h is not None:  # reentrant re-acquire: no edges, no
+                ok = self._inner.acquire(blocking, timeout)  # stats
+                if ok:
+                    h.depth += 1
+                return ok
+        # thread-local prep BEFORE the inner acquire: every
+        # instruction moved out of the held window is one no waiter
+        # serializes behind (the --san-overhead gate is won or lost
+        # on the split between this block and _locked_tail)
+        held = _held_list()
+        entry = _Held(self, self.name, _caller_loc(_depth), 0)
+        st = self._stats()
+        if self._inner.acquire(False):
+            return self._locked_tail(st, held, entry, 0, False)
+        if not blocking:
+            st.contentions += 1
+            return False
+        t0 = time.perf_counter_ns()
+        # contended path: wait in threshold-sized slices so a wedged
+        # holder produces a flight dump while we keep waiting
+        thresh = _block_threshold_s()
+        deadline = (None if timeout is None or timeout < 0
+                    else time.perf_counter() + timeout)
+        dumped = False
+        while True:
+            slice_s = thresh if thresh > 0 else 3600.0
+            if deadline is not None:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    st.contentions += 1
+                    return False
+                slice_s = min(slice_s, remaining)
+            if self._inner.acquire(True, slice_s):
+                return self._locked_tail(
+                    st, held, entry,
+                    time.perf_counter_ns() - t0, True)
+            if thresh > 0 and not dumped:
+                dumped = True
+                _on_blocked(self.name,
+                            (time.perf_counter_ns() - t0) / 1e9,
+                            self._holder_site)
+
+    def release(self):
+        if self._reentrant:
+            h = self._find_held()
+            if h is not None and h.depth > 1:
+                h.depth -= 1
+                self._inner.release()
+                return
+        held = _held_list()
+        entry = None
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is self:
+                entry = held[i]
+                del held[i]
+                break
+        self._inner.release()
+        # timing AFTER the inner release: the held window just
+        # closed, so none of this serializes a waiter (the ~0.2us of
+        # pop overhead it adds to the sampled hold reading is noise
+        # next to any hold worth looking at)
+        if entry is not None and entry.t_ns:
+            self._note_hold(self._stats(), entry.t_ns)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire(_depth=3)
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class SanRLock(SanLock):
+    """Instrumented ``threading.RLock``: reentrant re-acquires by the
+    owning thread record neither edges (no self-cycles) nor stats."""
+
+    _reentrant = True
+    kind = "rlock"
+
+    def _make_inner(self):
+        return threading.RLock()
+
+    def locked(self):  # RLock has no locked() pre-3.12; best effort
+        got = self._inner.acquire(False)
+        if got:
+            self._inner.release()
+        return not got
+
+
+class SanCondition(SanLock):
+    """Instrumented ``threading.Condition``. The underlying primitive
+    is a real Condition (over its own RLock); the wrapper does the
+    sanitizer bookkeeping and forwards the condition protocol.
+    ``wait()`` marks the lock released for hold accounting (waiters
+    do not hold the lock) and restores it on wake."""
+
+    _reentrant = True
+    kind = "condition"
+
+    def _make_inner(self):
+        return threading.Condition()
+
+    def wait(self, timeout: Optional[float] = None):
+        # the wait releases the lock: close the hold window now and
+        # open a fresh one on wake, so hold-time histograms measure
+        # time the lock was actually unavailable to others. This is
+        # the scheduler loop's hottest sanitized call, so it reuses
+        # the existing _Held entry (site/depth survive the wait) and
+        # skips edge recording on wake — any lock held ACROSS the
+        # wait was acquired before this condition, so its edge was
+        # recorded at the original acquire
+        h = self._find_held()
+        if h is None:
+            return self._inner.wait(timeout)
+        st = self._stats()
+        if h.t_ns:
+            self._note_hold(st, h.t_ns)
+        held = _held_list()
+        held.remove(h)           # waiters do not hold the lock
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            n = st.acquisitions + 1
+            st.acquisitions = n
+            h.t_ns = (time.perf_counter_ns()
+                      if (n & _SAMPLE_MASK) == 1 else 0)
+            held.append(h)
+            self._holder_site = h.site
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # predicate-loop spelling, forwarded through OUR wait so the
+        # hold accounting stays right
+        end = (None if timeout is None
+               else time.perf_counter() + timeout)
+        result = predicate()
+        while not result:
+            t = None if end is None else max(0.0,
+                                             end - time.perf_counter())
+            if t == 0.0:
+                break
+            self.wait(t)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# construction points
+# ---------------------------------------------------------------------------
+
+def make_lock(name: str):
+    """``threading.Lock()`` (MXSAN=0 — the default: zero overhead) or
+    a :class:`SanLock` (MXSAN=1). The flag is read HERE, once."""
+    return SanLock(name) if enabled() else threading.Lock()
+
+
+def make_rlock(name: str):
+    return SanRLock(name) if enabled() else threading.RLock()
+
+
+def make_condition(name: str):
+    return SanCondition(name) if enabled() else threading.Condition()
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+def lock_stats() -> Dict[str, dict]:
+    """{lock name: stats dict} for every sanitized lock ever
+    constructed under MXSAN=1."""
+    with _G:
+        return {n: s.describe() for n, s in sorted(_STATS.items())}
+
+
+def order_graph() -> List[dict]:
+    """Every recorded held->acquired edge (first-sighting site/stack +
+    count)."""
+    with _G:
+        return [dict(e) for _, e in sorted(_EDGES.items())]
+
+
+def cycle_findings() -> List[dict]:
+    with _G:
+        return [dict(c) for c in _CYCLES]
+
+
+def blocked_events() -> List[dict]:
+    with _G:
+        return [dict(b) for b in _BLOCKED]
+
+
+def report() -> list:
+    """mxlint-schema Findings for every detected cycle and
+    blocked-past-threshold event (passes.Finding objects)."""
+    from ..passes import Finding
+    out = []
+    for c in cycle_findings():
+        msg = (f"lock-order cycle {' -> '.join(c['locks'])}: potential "
+               f"deadlock. Forward edge {c['edge']} (thread "
+               f"{c['forward_thread']}):\n{c['forward_stack']}")
+        if c.get("reverse_stack"):
+            msg += (f"\nreverse edge {c['reverse_edge']} (thread "
+                    f"{c['reverse_thread']}):\n{c['reverse_stack']}")
+        out.append(Finding("mxsan", "lock-order-cycle",
+                           " -> ".join(c["locks"]), "error", msg))
+    for b in blocked_events():
+        out.append(Finding(
+            "mxsan", "blocked-waiter", b["lock"], "warn",
+            f"waiter {b['waiter']!r} blocked {b['waited_ms']}ms past "
+            f"MXSAN_BLOCK_THRESHOLD_MS (holder acquired at "
+            f"{b['holder_site']}); flight dump triggered"))
+    return out
+
+
+def export_to_registry() -> int:
+    """Drain pending hold/wait samples into telemetry histograms
+    (``mxsan_lock_{hold,wait}_ms_<name>``) and refresh the per-lock
+    counters. Returns the number of locks exported. Called on demand
+    (diagnose, tests, the MXSAN runbook) — never from the hot path."""
+    from ..telemetry import metrics as _m
+    with _G:
+        rows = [(s.name, s.acquisitions, s.contentions,
+                 list(s.pending_hold_ms), list(s.pending_wait_ms))
+                for s in _STATS.values()]
+        for s in _STATS.values():
+            s.pending_hold_ms.clear()
+            s.pending_wait_ms.clear()
+    for name, acq, cont, holds, waits in rows:
+        tag = "".join(c if c.isalnum() else "_" for c in name)
+        _m.gauge(f"mxsan_lock_acquisitions_{tag}",
+                 f"Sanitized acquisitions of {name}").set(acq)
+        _m.gauge(f"mxsan_lock_contentions_{tag}",
+                 f"Contended acquisitions of {name}").set(cont)
+        h = _m.histogram(f"mxsan_lock_hold_ms_{tag}",
+                         f"Hold time of {name} (ms, MXSAN)")
+        for v in holds:
+            h.observe(v)
+        w = _m.histogram(f"mxsan_lock_wait_ms_{tag}",
+                         f"Contended wait time for {name} (ms, MXSAN)")
+        for v in waits:
+            w.observe(v)
+    return len(rows)
+
+
+def reset() -> None:
+    """Drop all sanitizer state (tests). Live SanLocks re-register
+    their stats row on next acquire."""
+    with _G:
+        _STATS.clear()
+        _EDGES.clear()
+        _ADJ.clear()
+        _CYCLES.clear()
+        _CYCLE_KEYS.clear()
+        del _BLOCKED[:]
+    # after the clear, so a concurrent _stats() re-resolve cannot grab
+    # a row that is about to be dropped
+    _RESET_GEN[0] += 1

@@ -403,12 +403,14 @@ class DynamicBatcher:
                     # under _cv: stats() sorts this deque and a
                     # concurrent append would blow up its iteration
                     self._lat_recent.append(done_t - r.enq_t)
+                # dispatch is over: clear while still under _cv so
+                # stats() never sees a finished group as current
+                self._current_group = []
                 self._cv.notify_all()
             for r in group:
                 r.state = _DONE
                 self._m_lat.observe(done_t - r.enq_t)
                 r.finish()
-            self._current_group = []
 
     # ------------------------------------------------------------------
     # lifecycle

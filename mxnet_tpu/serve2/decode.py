@@ -63,6 +63,7 @@ import jax.numpy as jnp
 import numpy as onp
 
 from ..base import MXNetError
+from ..san.runtime import make_lock
 from ..telemetry import metrics as _metrics
 from ..telemetry import recompile as _recompile
 from ..parallel.paged_attention import (_deq, paged_attention,
@@ -207,7 +208,7 @@ class PagedLM:
         self._copy_page_jit = jax.jit(
             self._copy_page_fn,
             donate_argnums=(0,) if self.donate_pages else ())
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve2.decode.pool")
         self._seen: set = set()
         self._warmed = False
         self._warmed_rungs: dict = {"decode": (), "prefill": (),

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 import numpy as onp
 
 from ..base import MXNetError
+from ..san.runtime import make_lock
 from ..telemetry import metrics as _metrics
 
 __all__ = ["PageAllocator", "BlockTable", "PagePoolExhausted",
@@ -78,7 +79,7 @@ class PageAllocator:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve2.kvcache.alloc")
         # LIFO free list keeps recently-freed pages hot in cache; the
         # shadow set makes the double-free check O(1) per page
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))

@@ -44,6 +44,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..base import MXNetError
+from ..san.runtime import make_lock
 from .kvcache import PageAllocator
 
 __all__ = ["PrefixCache", "page_keys"]
@@ -82,7 +83,7 @@ class PrefixCache:
                  capacity_pages: int = 0):
         self.alloc = alloc
         self.capacity_pages = int(capacity_pages)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve2.prefix.cache")
         # insertion/LRU order: move_to_end on hit, popitem(last=False)
         # on eviction
         self._pages: "OrderedDict[bytes, int]" = OrderedDict()

@@ -57,6 +57,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..base import MXNetError
 from ..resil.policy import CircuitBreaker, CircuitOpenError
+from ..san.runtime import make_lock
 from ..serve.batcher import (BatcherStoppedError, DeadlineExceededError,
                              InvalidRequestError, QueueFullError,
                              RequestTooLargeError)
@@ -99,7 +100,7 @@ class _Replica:
         self.version = version
         self.breaker = CircuitBreaker(name=rname)
         self.inflight = 0
-        self.lock = threading.Lock()
+        self.lock = make_lock("serve2.router.replica")
         self.depth_gauge = _metrics.gauge(
             f"mxserve2_replica_depth_{_gauge_tag(rname)}",
             f"queued + in-flight requests on replica {rname}")
@@ -148,7 +149,8 @@ class _Group:
         self.factory = factory
         self.replicas: List[_Replica] = replicas
         self.version = version
-        self.lock = threading.Lock()  # serializes reloads per group
+        # serializes reloads per group
+        self.lock = make_lock("serve2.router.group")
 
 
 class Router:

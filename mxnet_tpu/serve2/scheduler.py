@@ -58,6 +58,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as onp
 
 from ..base import MXNetError
+from ..san.runtime import make_condition
 from ..telemetry import metrics as _metrics
 from .. import trace as _trace
 from ..serve.batcher import (BatcherStoppedError, DeadlineExceededError,
@@ -244,7 +245,7 @@ class DecodeEngine:
         from ..serve.engine import InputSpec
         self.input_specs = [InputSpec((top_prefill,), "int32",
                                       name="tokens")]
-        self._cv = threading.Condition()
+        self._cv = make_condition("serve2.scheduler.cv")
         self._waiting: "deque[_Seq]" = deque()
         self._running: List[_Seq] = []
         self._sid = itertools.count()
@@ -745,6 +746,7 @@ class DecodeEngine:
                 held.remove(cow_src)
                 bt.pages = shared + [dst] + new_pages[1:]
                 start = len(eff) - 1
+                # mxsan: ok — only the loop thread admits (one writer)
                 self._n_cow += 1
                 self._m_cow.inc()
             else:

@@ -27,6 +27,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..san.runtime import make_lock
+
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "all_metrics", "snapshot", "to_json_lines", "to_prometheus",
            "export_jsonl", "reset_metrics", "percentile_of",
@@ -43,7 +45,7 @@ def percentile_of(sorted_vals, q: float):
                      int(round(q / 100.0 * (len(sorted_vals) - 1)))))
     return sorted_vals[idx]
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("telemetry.metrics.registry")
 _METRICS: Dict[str, "Metric"] = {}
 
 
@@ -128,6 +130,7 @@ class Histogram(Metric):
         self._reset_fields()
 
     def _reset_fields(self):
+        # under _LOCK (reset(); __init__ runs before publication)
         self._count = 0
         self._sum = 0.0
         self._min = float("inf")

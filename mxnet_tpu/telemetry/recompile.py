@@ -36,12 +36,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..san.runtime import make_lock
 from . import metrics as _metrics
 
 __all__ = ["record_recompile", "recompile_count", "recompile_report",
            "reset_recompiles", "signature_of"]
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("telemetry.recompile")
 _HISTORY: Dict[str, List[dict]] = {}   # entry -> [signature, ...]
 _RECORDS: List[dict] = []              # ring of recompile records
 _MAX_RECORDS = 512

@@ -28,12 +28,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..san.runtime import make_lock
 from .spans import _cfg
 
 __all__ = ["sink_write", "flush_sink", "reset_sink", "write_chrome",
            "load_spans"]
 
-_SINK_LOCK = threading.Lock()
+_SINK_LOCK = make_lock("trace.export.sink")
 _SINK = {"gen": -1, "path": "", "fh": None, "pending": 0, "last": 0.0}
 # flush cadence: spans can be written from under scheduler locks
 # (serve2 _resolve), so a per-line flush would put disk latency inside

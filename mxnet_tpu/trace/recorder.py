@@ -41,6 +41,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..san.runtime import make_lock
 from .spans import _cfg
 
 __all__ = ["FlightRecorder", "get_recorder", "crash_dump",
@@ -64,7 +65,7 @@ class FlightRecorder:
     (:func:`get_recorder`); every method is safe from any thread."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("trace.recorder")
         self._rings: Dict[str, deque] = {}
         self._events: deque = deque(maxlen=128)
         self._last_dump: Optional[dict] = None

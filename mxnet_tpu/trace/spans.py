@@ -42,6 +42,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..san.runtime import make_lock
+
 __all__ = ["Span", "SpanContext", "enabled", "span", "emit", "under",
            "current_context", "drain", "reset", "wall_of_ns"]
 
@@ -60,7 +62,7 @@ _RNG = random.Random()
 # set_flag/unset_flag bumps the config generation; the hot-path check
 # is two attribute reads and an int compare
 _FLAG_CACHE = (-1, True, 1.0)
-_BUF_LOCK = threading.Lock()
+_BUF_LOCK = make_lock("trace.spans.buf")
 _BUFFERS: Dict[int, deque] = {}   # thread ident -> finished-span deque
 _LOCAL = threading.local()
 

@@ -221,6 +221,50 @@ def test_bench_trace_overhead_emits_mxtrace_overhead():
 
 
 @pytest.mark.slow
+def test_bench_san_overhead_emits_mxsan_overhead():
+    """--san-overhead contract: one mxsan_overhead JSON line with the
+    sanitized/plain soak ratio, the STRUCTURAL zero-cost proof
+    (MXSAN=0 constructs the plain stdlib primitives — there is no
+    wrapper to pay for), and evidence the sanitizer watched the run
+    (lock-order edges recorded, zero cycles in serve2's own lock
+    discipline). Reduced knobs keep this a contract check (shape +
+    invariants); the acceptance-scale <5% gate (san_ok) comes from
+    the default knobs."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXSAN", None)  # construction-time flag: the bench owns it
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_SAN_PAIRS": "4",
+        "MXTPU_BENCH_SAN_REQUESTS": "8",
+        "MXTPU_BENCH_SAN_MAX_NEW": "8",
+        "MXTPU_BENCH_TIMEOUT": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--san-overhead"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxsan_overhead"
+    assert data["value"] is not None and data["value"] > 0, data
+    # the zero-cost half of the contract is structural, so it holds
+    # at ANY knob scale: MXSAN=0 must hand out plain primitives
+    assert data["san_off_plain_locks"] is True, data
+    # the sanitizer really watched the sanitized arm
+    assert data["lock_order_edges"] >= 1, data
+    assert data["lock_order_cycles"] == 0, data
+    assert data["watched_locks"] >= 1, data
+    for key in ("overhead_pct", "plain_round_s", "sanitized_round_s",
+                "san_ok", "wave"):
+        assert key in data, data
+    assert data["plain_round_s"] > 0
+    assert data["sanitized_round_s"] > 0
+
+
+@pytest.mark.slow
 def test_bench_serving2_emits_mxserve2_throughput():
     """--serving2 contract: one mxserve2_throughput JSON line — serve2
     requests/sec, the PR-3 single-engine baseline and the speedup, zero

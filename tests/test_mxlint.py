@@ -264,8 +264,8 @@ def test_pass_manager_registry():
     pm = default_manager()
     assert pm.names() == ["dispatchlint", "elasticlint", "graphlint",
                           "guardlint", "metriclint", "oplint",
-                          "podlint", "servelint", "shardlint",
-                          "steplint", "tracercheck"]
+                          "podlint", "racelint", "servelint",
+                          "shardlint", "steplint", "tracercheck"]
     with pytest.raises(KeyError):
         pm.get("no_such_pass")
     out = sym.var("x") + sym.var("x")

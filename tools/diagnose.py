@@ -467,6 +467,56 @@ def check_pod():
               "runbook)")
 
 
+def check_mxsan():
+    """Concurrency sanitizer health: MXSAN flag state, which locks the
+    runtime sanitizer is watching, the lock-order graph, any detected
+    cycles or blocked-waiter events (mxnet_tpu/san/;
+    docs/observability.md MXSAN runbook)."""
+    print("----------Concurrency sanitizer (mxsan)----------")
+    try:
+        from mxnet_tpu import config
+        from mxnet_tpu.san import runtime as san
+    except Exception as e:
+        print("mxsan        : unavailable (%s)" % e)
+        return
+    on = bool(config.get("MXSAN"))
+    print("sanitizer    :", "ON" if on else
+          "(off — set MXSAN=1 BEFORE import/construction; the flag "
+          "is read when each lock is built)")
+    print("block dump   : %sms until a waiter triggers a flight dump"
+          % config.get("MXSAN_BLOCK_THRESHOLD_MS"))
+    stats = san.lock_stats()
+    if not stats:
+        print("watched locks: none (nothing sanitized was built in "
+              "this process)")
+        return
+    print("watched locks:", len(stats))
+    for name, st in sorted(stats.items()):
+        print(f"  {name} [{st['kind']}]: acq={st['acquisitions']} "
+              f"cont={st['contentions']} "
+              f"hold_max={st['hold_ms_max']}ms "
+              f"wait_max={st['wait_ms_max']}ms")
+    edges = san.order_graph()
+    if edges:
+        print("order graph  :", len(edges), "edge(s)")
+        for e in edges[:12]:
+            print(f"  {e['src']} -> {e['dst']} (x{e['count']}, "
+                  f"{e['thread']})")
+    cycles = san.cycle_findings()
+    if cycles:
+        print(f"  CYCLES      : {len(cycles)} lock-order cycle(s) — "
+              "potential deadlock; both acquisition stacks are in "
+              "san.report() and the flight recorder")
+        for c in cycles[:4]:
+            print("   ", " -> ".join(c["locks"]))
+    blocked = san.blocked_events()
+    if blocked:
+        print(f"  BLOCKED     : {len(blocked)} waiter(s) past "
+              "threshold; latest: %s waited %sms (holder at %s)"
+              % (blocked[-1]["lock"], blocked[-1]["waited_ms"],
+                 blocked[-1]["holder_site"]))
+
+
 def main():
     check_python()
     check_pip()
@@ -482,6 +532,7 @@ def main():
     check_elastic()
     check_pod()
     check_guard()
+    check_mxsan()
     check_mxlint()
 
 

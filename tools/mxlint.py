@@ -421,6 +421,156 @@ def _selfcheck_metric_findings():
     return findings
 
 
+# racelint bad fixtures: each is the minimal module exhibiting one of
+# the four checks — the --race self-check asserts the lint FIRES on
+# every one (and stays quiet on the paired good spellings), so the
+# pass can never go vacuous
+_RACE_BAD_FIXTURES = {
+    "<bad unguarded-write>": ("unguarded-write", """
+import threading
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+    def inc(self):
+        with self._lock:
+            self._n += 1
+    def reset(self):
+        self._n = 0
+"""),
+    "<bad wait-no-loop>": ("wait-without-predicate-loop", """
+import threading
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._item = None
+    def get(self):
+        with self._cv:
+            self._cv.wait()
+            return self._item
+"""),
+    "<bad blocking-under-lock>": ("blocking-under-lock", """
+import threading, time
+_LOCK = threading.Lock()
+def poll(sock):
+    with _LOCK:
+        time.sleep(0.5)
+        return sock.recv(4096)
+"""),
+    "<bad restore-then-unset>": ("restore-then-unset", """
+import os
+def teardown(saved):
+    os.environ["MXFOO"] = saved
+    os.environ.pop("MXFOO", None)
+"""),
+}
+
+_RACE_GOOD_FIXTURES = {
+    "<good wait-loop>": """
+import threading
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._item = None
+    def get(self):
+        with self._cv:
+            while self._item is None:
+                self._cv.wait()
+            return self._item
+""",
+    "<good env-teardown>": """
+import os
+def teardown(saved):
+    if saved is None:
+        os.environ.pop("MXFOO", None)
+    else:
+        os.environ["MXFOO"] = saved
+""",
+}
+
+
+def _selfcheck_race_findings():
+    """racelint + mxsan self-check: the live mxnet_tpu tree must lint
+    clean modulo the reviewed exemption registry (exempt findings
+    surface as info, never error); every bad fixture must FIRE its
+    check and every good spelling must stay quiet; and the runtime
+    sanitizer must detect an injected two-lock cycle with BOTH
+    acquisition stacks in the finding."""
+    import threading
+    import warnings
+
+    from mxnet_tpu import config
+    from mxnet_tpu.passes import Finding
+    from mxnet_tpu.passes.racelint import RaceLint
+
+    p = RaceLint()
+    findings = list(p.run())  # the live package, exemptions applied
+    # bad-fixture coverage: one module per check
+    for name, (check, src) in _RACE_BAD_FIXTURES.items():
+        fired = {f.check for f in p.run({"sources": {name: src}})}
+        if check not in fired:
+            findings.append(Finding(
+                "racelint", "selfcheck-coverage", name, "error",
+                f"lint did not fire {check!r} on the fixture built "
+                "to trigger it"))
+    for name, src in _RACE_GOOD_FIXTURES.items():
+        noise = [f for f in p.run({"sources": {name: src}})
+                 if f.severity == "error"]
+        if noise:
+            findings.append(Finding(
+                "racelint", "selfcheck-coverage", name, "error",
+                f"lint fired {sorted({f.check for f in noise})} on the "
+                "correct spelling — false positive on the documented "
+                "good idiom"))
+    # runtime sanitizer coverage: inject the canonical AB/BA deadlock
+    # shape on two threads and require a cycle finding carrying both
+    # nested-acquisition stacks
+    from mxnet_tpu.san import runtime as _rt
+    config.set_flag("MXSAN", True)
+    try:
+        _rt.reset()
+        a = _rt.make_lock("<selfcheck>.A")
+        b = _rt.make_lock("<selfcheck>.B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            for fn in (ab, ba):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+        cycles = _rt.cycle_findings()
+        ok = bool(cycles and cycles[0].get("forward_stack")
+                  and cycles[0].get("reverse_stack"))
+        if not ok:
+            findings.append(Finding(
+                "mxsan", "selfcheck-coverage", "<injected cycle>",
+                "error",
+                "runtime sanitizer did not report the injected "
+                "two-lock cycle with both acquisition stacks "
+                f"(cycles={len(cycles)})"))
+    finally:
+        _rt.reset()
+        config.unset_flag("MXSAN")
+    n_exempt = len([f for f in findings
+                    if "[exempt:" in f.message])
+    findings.append(Finding(
+        "racelint", "selfcheck-summary", "<self-check race>", "info",
+        f"live tree linted ({n_exempt} reviewed exemption(s) "
+        "downgraded to info), bad/good-fixture coverage exercised, "
+        "injected lock-order cycle detected with both stacks"))
+    return findings
+
+
 def _selfcheck_block_findings():
     """tracercheck over a small hybridized block — a clean forward must
     produce no tracer findings."""
@@ -467,6 +617,15 @@ def main(argv=None):
                         "their closed owner (the per-engine-gauge "
                         "leak class), driving a real engine "
                         "open/close round plus bad-fixture coverage")
+    p.add_argument("--race", action="store_true", dest="race_check",
+                   help="racelint + mxsan self-check: AST concurrency "
+                        "lint over mxnet_tpu's own source (unguarded "
+                        "writes, bare Condition.wait, blocking calls "
+                        "under a lock, restore-then-unset env "
+                        "teardowns; reviewed exemptions surface as "
+                        "info), bad-fixture coverage, and an injected "
+                        "runtime lock-order cycle detected with both "
+                        "stacks")
     p.add_argument("--opt", action="store_true", dest="opt_check",
                    help="graph-optimizer self-check: run the level-2 "
                         "rewrite pipeline on a fixture graph, report "
@@ -487,9 +646,10 @@ def main(argv=None):
 
     if not (args.ops or args.all or args.graphs or args.shard
             or args.opt_check or args.serve_check or args.guard_check
-            or args.metrics_check):
+            or args.metrics_check or args.race_check):
         p.error("nothing to do: pass --ops, --all, --shard, --opt, "
-                "--serve, --guard, --metrics, or graph JSON files")
+                "--serve, --guard, --metrics, --race, or graph JSON "
+                "files")
 
     if args.shard and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -603,6 +763,10 @@ def main(argv=None):
         findings.extend(mt)
         sections.append(("metriclint", "<self-check owner ledger>",
                          mt))
+    if args.race_check:
+        rc = _selfcheck_race_findings()
+        findings.extend(rc)
+        sections.append(("racelint", "<self-check concurrency>", rc))
 
     counts = severity_counts(findings)
     if args.as_json:
