@@ -93,6 +93,33 @@ def _emit(value, unit="images/sec", vs=None,
     line.update(extra)
     print(json.dumps(line))
     sys.stdout.flush()
+    _store_append(line)
+
+
+def _store_append(line):
+    """Every BENCH metric line also lands in the perf-trajectory store
+    (tools/benchstore.jsonl) so `mxprof regress` can gate future runs
+    against it. MXTPU_BENCH_STORE=0 is the escape hatch (driver dry
+    runs, unit tests exercising _emit); append failures never break
+    the bench contract."""
+    if os.environ.get("MXTPU_BENCH_STORE", "1").lower() \
+            in ("0", "off", "false"):
+        return
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import benchstore
+        extra = {k: v for k, v in line.items()
+                 if k not in ("metric", "value", "unit", "vs_baseline",
+                              "mesh")}
+        if not isinstance(line.get("value"), (int, float)):
+            return
+        benchstore.record(line.get("metric", "unknown"), line["value"],
+                          unit=line.get("unit", ""),
+                          vs_baseline=line.get("vs_baseline"),
+                          mesh=line.get("mesh"), extra=extra)
+    except Exception:
+        pass
 
 
 def _probe_tpu(timeout_s=150):
@@ -2049,6 +2076,126 @@ def san_main():
           vs=None, **record)
 
 
+def obs_main():
+    """mxobs overhead benchmark (--obs-overhead / MXTPU_BENCH_OBS=1),
+    ONE BENCH-schema JSON line (metric ``mxobs_overhead``, value =
+    obs-on/obs-off median step-time ratio on an elastic fused step —
+    the only hot path mxobs touches: a derived pod.step context per
+    step, one wire field per control-plane call, and the heartbeat-
+    riding collector push).
+
+    Both arms run with MXTRACE on (obs rides tracing; the tracing cost
+    itself is trace_main's ledger) over an in-process elastic group,
+    alternating paired blocks (the trace_main estimator — see
+    ``_paired_overhead`` there for why pairs + trim on this burstable
+    host). Gates (``obs_ok``):
+
+    - structural zero-cost proof: with MXOBS=0 the heartbeat flags
+      carry no pod uid, ``wire_context()`` is None under a live span,
+      and ``pod_step_context`` is None — nothing rides the wire, so
+      there is nothing on the step to pay for;
+    - obs-on/obs-off ratio < 1.02 (the <2% discipline);
+    - zero recompiles after warmup across BOTH arms — toggling MXOBS
+      never re-keys a jit cache.
+
+    Knobs: MXTPU_BENCH_OBS_{PAIRS,HIDDEN}."""
+    os.environ.setdefault("MXTPU_BENCH_FORCE_CPU", "1")
+    jax, devices, probe_status = _init_jax()
+    import numpy as onp
+
+    from mxnet_tpu import config, gluon, telemetry
+    from mxnet_tpu import trace
+    from mxnet_tpu import random as mxrandom
+    from mxnet_tpu.elastic.coordinator import ElasticCoordinator
+    from mxnet_tpu.elastic.kvstore import ElasticKVStore
+    from mxnet_tpu.ndarray import array as nd_array
+    from mxnet_tpu.obs import propagate as obs_prop
+
+    n_pairs = int(os.environ.get("MXTPU_BENCH_OBS_PAIRS", "30"))
+    hidden = int(os.environ.get("MXTPU_BENCH_OBS_HIDDEN", "256"))
+
+    mxrandom.seed(7)
+    onp.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(hidden, activation="relu",
+                               flatten=False))
+        net.add(gluon.nn.Dense(16, flatten=False))
+    net.initialize()
+    co = ElasticCoordinator()
+    kv = ElasticKVStore(group=co, worker_id="w0")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01}, kvstore=kv,
+                            update_on_kvstore=False)
+    fused = trainer.fuse_step(net, gluon.loss.L2Loss())
+    session = kv.session
+    r = onp.random.RandomState(0)
+    x = nd_array(r.uniform(-1, 1, (16, 64)).astype("float32"))
+    y = nd_array(onp.tanh(r.uniform(-1, 1, (16, 16))
+                          ).astype("float32"))
+
+    config.set_flag("MXTRACE", True)
+    # -- structural zero-cost proof under MXOBS=0 ---------------------
+    config.set_flag("MXOBS", False)
+    _, flags_off = co.heartbeat("w0")
+    with trace.span("obs.bench.probe", "app"):
+        wire_off = obs_prop.wire_context()
+    ctx_off = obs_prop.pod_step_context("deadbeef", 0, 0)
+    structural_off = ("pod_uid" not in flags_off and wire_off is None
+                      and ctx_off is None)
+
+    config.set_flag("MXOBS", True)
+    for _ in range(3):  # warmup both programs; obs never re-keys
+        fused.step(x, y).asnumpy()
+    config.set_flag("MXOBS", False)
+    for _ in range(2):
+        fused.step(x, y).asnumpy()
+    rc0 = telemetry.recompile_count()
+
+    block = 4
+    ratios, offs, ons = [], [], []
+    for i in range(n_pairs):
+        pair = {}
+        for obs_on in ((False, True) if i % 2 == 0
+                       else (True, False)):
+            config.set_flag("MXOBS", obs_on)
+            t0 = time.perf_counter()
+            for _ in range(block):
+                fused.step(x, y).asnumpy()
+            pair[obs_on] = (time.perf_counter() - t0) / block
+        if pair[False] > 0:
+            ratios.append(pair[True] / pair[False])
+        offs.append(pair[False])
+        ons.append(pair[True])
+    config.unset_flag("MXOBS")
+    config.unset_flag("MXTRACE")
+    recompiles = telemetry.recompile_count() - rc0
+    ratios.sort()
+    offs.sort()
+    ons.sort()
+    trim = len(ratios) // 5
+    core = ratios[trim:len(ratios) - trim] or ratios
+    ratio = round(sum(core) / len(core), 4) if core else None
+
+    pod_uid = session.pod_uid  # absorbed while MXOBS was on
+    obs_ok = (structural_off and ratio is not None and ratio < 1.02
+              and recompiles == 0 and pod_uid == co.uid)
+    record = dict(
+        metric="mxobs_overhead", pairs=n_pairs, hidden=hidden,
+        obs_off_step_s=round(offs[len(offs) // 2], 6),
+        obs_on_step_s=round(ons[len(ons) // 2], 6),
+        overhead_pct=(round((ratio - 1.0) * 100, 2)
+                      if ratio is not None else None),
+        obs_off_structural=structural_off,
+        pod_uid_absorbed=bool(pod_uid == co.uid),
+        recompiles_after_warmup=recompiles,
+        obs_ok=obs_ok,
+        platform=devices[0].platform,
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(ratio, unit="obs-on/obs-off median step-time ratio",
+          vs=None, **record)
+
+
 def _parent():
     """Run the bench in a KILLABLE subprocess and own the one-JSON-line
     contract. A SIGALRM watchdog cannot interrupt a hang inside C code
@@ -2081,6 +2228,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_TRACE") == "1"
               else "mxsan_overhead"
               if os.environ.get("MXTPU_BENCH_SAN") == "1"
+              else "mxobs_overhead"
+              if os.environ.get("MXTPU_BENCH_OBS") == "1"
               else "resnet50_train_throughput")
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
@@ -2141,6 +2290,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_TRACE"] = "1"
     if "--san-overhead" in sys.argv:
         os.environ["MXTPU_BENCH_SAN"] = "1"
+    if "--obs-overhead" in sys.argv:
+        os.environ["MXTPU_BENCH_OBS"] = "1"
     # fused whole-train-step compiler: default ON; --no-fused-step
     # measures the eager reference path instead (env form propagates
     # into the --child subprocess)
@@ -2159,6 +2310,7 @@ if __name__ == "__main__":
     _guard = os.environ.get("MXTPU_BENCH_GUARD") == "1"
     _tracebench = os.environ.get("MXTPU_BENCH_TRACE") == "1"
     _sanbench = os.environ.get("MXTPU_BENCH_SAN") == "1"
+    _obsbench = os.environ.get("MXTPU_BENCH_OBS") == "1"
     if "--child" in sys.argv:
         try:
             if _serving3:
@@ -2183,6 +2335,8 @@ if __name__ == "__main__":
                 trace_main()
             elif _sanbench:
                 san_main()
+            elif _obsbench:
+                obs_main()
             else:
                 main()
         except Exception as e:
@@ -2198,6 +2352,7 @@ if __name__ == "__main__":
                           else "mxguard_drill" if _guard
                           else "mxtrace_overhead" if _tracebench
                           else "mxsan_overhead" if _sanbench
+                          else "mxobs_overhead" if _obsbench
                           else "resnet50_train_throughput"),
                   error=f"{type(e).__name__}: {e}"[:500])
             sys.exit(0)

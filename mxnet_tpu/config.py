@@ -673,6 +673,42 @@ register_flag(
     "<reason>-<ts>.json). Empty = <tempdir>/mxtrace. Dumps are "
     "rate-limited per reason (5 s) so failure storms stay readable.")
 register_flag(
+    "MXOBS", bool, True,
+    "Pod-scale observability plane (mxnet_tpu/obs/, docs/"
+    "observability.md multi-host section): control-plane messages "
+    "carry the caller's mxtrace context so one train step / rebuild / "
+    "guard vote is ONE trace id across every rank, each host's "
+    "heartbeat pump pushes a mergeable metrics snapshot to the rank-0 "
+    "collector, and a rank-0 dump trigger broadcasts a coordinated "
+    "flight-recorder capture over the heartbeat channel. Same "
+    "discipline as MXTRACE: structurally zero-cost when off (one "
+    "generation-keyed flag-cache read on the hot path, no wire "
+    "fields, no collector state), <2% when on (bench.py "
+    "--obs-overhead enforces), never touches jit cache keys.")
+register_flag(
+    "MXOBS_PUSH_INTERVAL_S", float, 2.0,
+    "Seconds between a host's metrics-snapshot pushes to the rank-0 "
+    "collector (obs.collector, ridden by the elastic heartbeat pump "
+    "— no extra thread, no extra connection). Counters/histograms "
+    "merge exactly on the collector (count/sum exact, reservoir "
+    "merge weighted); lower it in drills that assert on freshness.")
+register_flag(
+    "MXOBS_EXPORT", str, "",
+    "Path of the rank-0 POD-MERGED snapshot JSON-lines sink: the "
+    "collector appends one line per export tick with the fleet-"
+    "merged metrics plus per-rank sections. Empty = export off "
+    "(merged snapshots still queryable via obs_merged / "
+    "tools/diagnose.py).")
+register_flag(
+    "MXOBS_BENCHSTORE", str, "",
+    "Benchstore path override (tools/benchstore.py): the append-only "
+    "JSONL perf-trajectory DB every bench.py metric line lands in, "
+    "keyed by (metric, host fingerprint, mesh, git rev); `mxprof "
+    "regress` gates the newest run against the stored trajectory "
+    "with median/MAD fences. Empty = tools/benchstore.jsonl; "
+    "'0'/'off' = appends disabled (MXTPU_BENCH_STORE=0 is the "
+    "bench-side escape hatch).")
+register_flag(
     "MXRESIL_WATCHDOG_STALL_S", float, 0.0,
     "Heartbeat age that counts as a stall (resil.watchdog.Watchdog). "
     "0 = auto: 10x the step-time EWMA (min 1 s; 30 s before any step "

@@ -40,6 +40,7 @@ store.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -47,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as onp
 
 from ..base import MXNetError, get_logger
+from ..obs import propagate as _obs
 from ..san.runtime import make_condition
 from .membership import (ElasticTimeout, MembershipChanged,
                          MembershipTracker, MembershipView, WorkerEvicted)
@@ -109,6 +111,15 @@ class ElasticCoordinator:
         self._m_rebuilds = _metrics.counter(
             "mxelastic_rebuild_barriers_total",
             "rebuild barriers completed")
+        # -- mxobs: pod identity + collector + coordinated dumps ------
+        # the group uid seeds every rank's derived pod.step trace id
+        # (obs.propagate) — per-coordinator-instance, distributed over
+        # the heartbeat flags so no extra round trip exists to race
+        self.uid = f"{random.SystemRandom().getrandbits(32):08x}"
+        self._obs_collector = None
+        self._dump_epoch = 0
+        self._dump_reason = ""
+        self._dump_mono = 0.0
         # -- control-plane journal (coordinator hardening, mxpod) -----
         # One JSON line per generation bump; a restarted rank-0 replays
         # the newest entry so the group RE-FORMS (members restored,
@@ -212,8 +223,87 @@ class ElasticCoordinator:
         if lost:
             self._gc(self.tracker.generation)
             self._journal_sync()
+            for w in lost:
+                self._obs_retire(w)
+            self._trigger_dump_locked(
+                "host-lost-" + "-".join(str(w) for w in sorted(lost)))
             self._cv.notify_all()
         return lost
+
+    # ------------------------------------------------------------------
+    # mxobs: coordinated capture + the collector channel
+    # ------------------------------------------------------------------
+    def _trigger_dump_locked(self, reason: str) -> int:
+        """Advance the pod dump epoch (under _cv) so every worker's
+        DumpFollower freezes its recorder at the next beat, and freeze
+        THIS process's recorder off-thread (rank 0 is a live rank too;
+        file IO must not stall the control plane). Deduped: the same
+        reason within the recorder's rate window advances nothing."""
+        if not _obs.enabled():
+            return self._dump_epoch
+        now = time.monotonic()
+        if reason == self._dump_reason and \
+                now - self._dump_mono < 10.0:
+            return self._dump_epoch
+        self._dump_epoch += 1
+        self._dump_reason = str(reason)[:120]
+        self._dump_mono = now
+
+        def _local():
+            from ..trace import crash_dump
+            crash_dump(f"pod-dump-{reason}", site="elastic.coordinator",
+                       extra={"dump_epoch": self._dump_epoch})
+
+        threading.Thread(target=_local, name="mxobs-dump",
+                         daemon=True).start()
+        _log.warning("pod dump epoch %d: %s — broadcasting dump-all "
+                     "over the heartbeat channel", self._dump_epoch,
+                     reason)
+        return self._dump_epoch
+
+    def request_dump(self, reason: str = "requested") -> int:
+        """The rank-0 dump trigger (tentpole 3): watchdog verdicts,
+        GroupFailed/quarantine at the leader boundary, or an operator
+        (``obs_request_dump`` over the control plane) land here; the
+        returned epoch rides every heartbeat until all live ranks have
+        dumped into the shared MXTRACE_DUMP_DIR."""
+        with self._cv:
+            epoch = self._trigger_dump_locked(reason)
+            self._cv.notify_all()
+            return epoch
+
+    def obs_collector(self, create: bool = True):
+        """The pod metrics collector (obs.collector.MetricsCollector),
+        created lazily on first use when MXOBS is on."""
+        with self._cv:
+            if self._obs_collector is None and create \
+                    and _obs._obs_on():
+                from ..obs.collector import MetricsCollector
+                self._obs_collector = MetricsCollector("pod")
+            return self._obs_collector
+
+    def obs_push(self, worker_id: str, rank=None, snap=None) -> None:
+        """Collector channel (tentpole 2): one host's mergeable
+        metrics snapshot, pushed by its heartbeat pump every
+        MXOBS_PUSH_INTERVAL_S."""
+        col = self.obs_collector()
+        if col is not None:
+            if rank is None:
+                view = self.view()
+                rank = view.rank_of(worker_id) \
+                    if worker_id in view.workers else -1
+            col.push(worker_id, rank, snap)
+
+    def obs_merged(self) -> Optional[Dict[str, object]]:
+        """The pod-merged snapshot (None before any push / MXOBS=0)."""
+        col = self.obs_collector(create=False)
+        return col.merged() if col is not None else None
+
+    def _obs_retire(self, worker_id: str) -> None:
+        """Host left the membership plane: drop its snapshot and
+        unregister its per-rank gauges (the metriclint leak class)."""
+        if self._obs_collector is not None:
+            self._obs_collector.retire(worker_id)
 
     def _gc(self, current_gen: int):
         """Drop rounds/barriers of dead generations. Under _cv. A
@@ -296,8 +386,17 @@ class ElasticCoordinator:
             view = self.tracker.heartbeat(worker_id, step=step)
             self._poll()
             view = self.tracker.view()
-            flags = {"pending_join": any(
+            flags: Dict[str, object] = {"pending_join": any(
                 j.admitted_gen is None for j in self._pending.values())}
+            if _obs.enabled():
+                # the obs sidecar rides the beat every worker already
+                # sends: pod_uid seeds the derived pod.step trace id,
+                # dump_epoch broadcasts coordinated capture (flags stay
+                # tiny when nothing is happening)
+                flags["pod_uid"] = self.uid
+                if self._dump_epoch:
+                    flags["dump_epoch"] = self._dump_epoch
+                    flags["dump_reason"] = self._dump_reason
             return view, flags
 
     def leave(self, worker_id: str) -> MembershipView:
@@ -308,6 +407,7 @@ class ElasticCoordinator:
             view = self.tracker.leave(worker_id)
             self._gc(view.generation)
             self._journal_sync()
+            self._obs_retire(worker_id)
             self._cv.notify_all()
             return view
 
@@ -317,6 +417,8 @@ class ElasticCoordinator:
             view = self.tracker.mark_lost(worker_id)
             self._gc(view.generation)
             self._journal_sync()
+            self._obs_retire(worker_id)
+            self._trigger_dump_locked(f"mark-lost-{worker_id}")
             self._cv.notify_all()
             return view
 
@@ -538,9 +640,25 @@ class ElasticCoordinator:
         if hosts:
             from ..resil.watchdog import host_liveness_probe
             watchdog.add_probe(host_liveness_probe(self))
+        col = self.obs_collector()
+        if col is not None:
+            # stall/host-loss verdicts should read FLEET state, not
+            # just local counters: the staleness probe fires before
+            # the heartbeat budget turns a wedged pump into a loss
+            from ..obs.collector import fleet_probe
+            watchdog.add_probe(fleet_probe(col))
+            watchdog.on_verdict(self._obs_verdict_dump)
         if act:
             watchdog.on_verdict(self.watchdog_action)
         return watchdog
+
+    def _obs_verdict_dump(self, finding) -> None:
+        """Error-severity watchdog verdicts trigger a coordinated pod
+        dump — the post-mortem directory then holds every live rank's
+        recorder, not just the rank the verdict named."""
+        if getattr(finding, "severity", "") == "error":
+            self.request_dump(
+                f"watchdog-{getattr(finding, 'check', 'verdict')}")
 
     # ------------------------------------------------------------------
     def describe(self) -> Dict[str, object]:
@@ -556,4 +674,12 @@ class ElasticCoordinator:
                         self.tracker.heartbeat_ages().items()},
                     "lost_after_s": self.tracker.lost_after_s,
                     "journal": self._journal_path,
-                    "restored": self.restored}
+                    "restored": self.restored,
+                    "obs": {
+                        "uid": self.uid,
+                        "dump_epoch": self._dump_epoch,
+                        "dump_reason": self._dump_reason,
+                        "collector": (
+                            self._obs_collector.describe()
+                            if self._obs_collector is not None
+                            else None)}}

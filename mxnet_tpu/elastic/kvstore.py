@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from ..base import MXNetError, get_logger
 from ..kvstore import KVStoreBase
 from ..ndarray.ndarray import NDArray, _wrap
+from ..obs import propagate as _obs_prop
 from .membership import MembershipChanged
 from .session import ElasticSession
 
@@ -67,6 +68,13 @@ class RemoteGroup:
             self._client = srv.KVClient(address, retries=retries)
 
     def _req(self, op, **payload):
+        wire = _obs_prop.wire_context()
+        if wire is not None:
+            # carried trace context (mxobs): the rank-0 server runs
+            # this op under OUR span, so fenced rounds and barriers
+            # stitch into the calling rank's trace. One dict compare
+            # when MXOBS/MXTRACE is off — never a recompile.
+            payload["_trace"] = wire
         return self._client.request("elastic", op, payload)
 
     def register(self, worker_id, devices=()):
@@ -110,6 +118,17 @@ class RemoteGroup:
 
     def describe(self):
         return self._req("describe")
+
+    # -- mxobs sidecar ops --------------------------------------------
+    def obs_push(self, worker_id, rank=None, snap=None):
+        return self._req("obs_push", worker_id=worker_id, rank=rank,
+                         snap=snap)
+
+    def obs_merged(self):
+        return self._req("obs_merged")
+
+    def obs_request_dump(self, reason="requested"):
+        return self._req("obs_request_dump", reason=str(reason))
 
     def close(self):
         self._client.close()

@@ -77,6 +77,10 @@ class ElasticSession:
         self._pump = None
         self._pump_stop = None
         self._pending_state = None  # join-before-trainer snapshot
+        # -- mxobs sidecar state (absorbed from heartbeat flags) ------
+        self._pod_uid: Optional[str] = None
+        self._dump_follower = None
+        self._last_push = 0.0
         self.start_meta: Dict[str, object] = {}
         self.view: Optional[MembershipView] = None
         if register:
@@ -137,8 +141,9 @@ class ElasticSession:
         leader duties, no rebuild) — drivers call this after forming
         the initial group so every session starts at the same
         generation."""
-        view, _ = self.group.heartbeat(self.worker_id)
+        view, flags = self.group.heartbeat(self.worker_id)
         self.view = view
+        self._absorb_flags(flags)
         return view
 
     # ------------------------------------------------------------------
@@ -162,7 +167,12 @@ class ElasticSession:
         def pump():
             while not stop.wait(interval_s):
                 try:
-                    self.group.heartbeat(self.worker_id)
+                    _view, flags = self.group.heartbeat(self.worker_id)
+                    # the obs sidecar rides the liveness beat: absorb
+                    # dump-epoch broadcasts and push the mergeable
+                    # metrics snapshot on cadence — no extra thread,
+                    # no extra connection
+                    self._absorb_flags(flags)
                 except Exception:
                     return  # evicted / group gone: the boundary will see
 
@@ -191,6 +201,7 @@ class ElasticSession:
         moved — the caller must :meth:`rebuild` before the next
         exchange."""
         view, flags = self.group.heartbeat(self.worker_id, step=step)
+        self._absorb_flags(flags)
         if flags.get("pending_join") and view.leader == self.worker_id:
             state, meta = self.snapshot_state(step=step)
             view = self.group.admit_joiners(self.worker_id, state, meta)
@@ -202,6 +213,72 @@ class ElasticSession:
                       self.view.generation if self.view else None,
                       view.generation)
         return changed
+
+    # ------------------------------------------------------------------
+    # the mxobs sidecar (pod identity, coordinated dumps, metrics push)
+    # ------------------------------------------------------------------
+    def _absorb_flags(self, flags) -> None:
+        """Process the obs sidecar riding every heartbeat's control
+        flags: remember the group uid (seeds the derived pod.step trace
+        id), follow dump-epoch broadcasts (coordinated flight capture),
+        and push this host's mergeable metrics snapshot to the rank-0
+        collector every MXOBS_PUSH_INTERVAL_S. Never raises; one cached
+        flag read when MXOBS=0."""
+        if not isinstance(flags, dict):
+            return
+        uid = flags.get("pod_uid")
+        if uid:
+            self._pod_uid = str(uid)
+        from ..obs import propagate as _prop
+        if not _prop._obs_on():
+            return
+        try:
+            if self._dump_follower is None:
+                from ..obs.capture import DumpFollower
+                self._dump_follower = DumpFollower()
+            self._dump_follower.observe(flags)
+            now = time.monotonic()
+            from .. import config
+            if now - self._last_push >= \
+                    float(config.get("MXOBS_PUSH_INTERVAL_S")):
+                push = getattr(self.group, "obs_push", None)
+                if push is not None:
+                    self._last_push = now
+                    from ..telemetry.metrics import mergeable_snapshot
+                    push(self.worker_id, self.rank,
+                         mergeable_snapshot())
+        except Exception:  # noqa: BLE001 — telemetry never kills a beat
+            pass
+
+    @property
+    def pod_uid(self) -> Optional[str]:
+        """The coordinator's group uid (None until the first heartbeat
+        with MXOBS+MXTRACE on, or in non-obs runs)."""
+        return self._pod_uid
+
+    def push_metrics(self) -> bool:
+        """Force one immediate snapshot push (tests / shutdown flush;
+        the pump handles cadence)."""
+        push = getattr(self.group, "obs_push", None)
+        if push is None:
+            return False
+        from ..telemetry.metrics import mergeable_snapshot
+        self._last_push = time.monotonic()
+        push(self.worker_id, self.rank, mergeable_snapshot())
+        return True
+
+    def request_pod_dump(self, reason: str = "requested"):
+        """Ask rank 0 to broadcast dump-all (leaders call this on
+        GroupFailed / quarantine; operators via mxprof). Returns the
+        new dump epoch, or None when the group has no obs surface."""
+        fn = getattr(self.group, "obs_request_dump", None) or \
+            getattr(self.group, "request_dump", None)
+        if fn is None:
+            return None
+        try:
+            return fn(reason)
+        except Exception:  # noqa: BLE001 — best-effort on a dying path
+            return None
 
     def next_round(self) -> int:
         r = self._round

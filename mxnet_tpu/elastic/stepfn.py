@@ -45,6 +45,7 @@ import jax
 
 from ..base import MXNetError
 from ..ndarray.ndarray import _wrap
+from ..obs import propagate as _obs_prop
 from ..step.stepfn import StepFunction, _raw
 from .. import trace as _trace
 from .membership import MembershipChanged
@@ -317,6 +318,10 @@ class ElasticStepFunction(StepFunction):
             self.guard_events.append(
                 {"step": step, "kind": "persistent", "suspect": me,
                  "reasons": verdict2.suspects[me]})
+            # coordinated capture BEFORE leaving: the post-mortem needs
+            # every live rank's recorder, not just the quarantined one
+            if hasattr(session, "request_pod_dump"):
+                session.request_pod_dump(f"guard-quarantine-{me}")
             session.leave()
             raise GuardQuarantined(me, step, verdict2.suspects[me])
         if me in suspects:
@@ -336,11 +341,21 @@ class ElasticStepFunction(StepFunction):
         from .. import telemetry as _telemetry
         t0 = time.perf_counter()
         session = self._session
+        # derived pod identity (mxobs): every rank computes the SAME
+        # pod.step trace id from (group uid, generation, step) captured
+        # at entry — lockstep ranks agree, so the per-rank step trees
+        # stitch into one trace under `mxprof trace --dir`. None when
+        # MXOBS/MXTRACE is off or the session has no pod uid yet.
+        gen0, step0 = session.generation, self._nstep
+        pod_ctx = _obs_prop.pod_step_context(
+            getattr(session, "pod_uid", None), gen0, step0)
+        t_root0 = time.perf_counter_ns()
         # the per-step trace root, keyed by (generation, step) — the
         # cross-subsystem correlation key: heartbeat/rebuild, grad
         # dispatch, guard vote, bucket exchange and update all
         # decompose as children of this one span
-        with _trace.span("train.step", "train", step=self._nstep,
+        with _trace.under(pod_ctx), \
+             _trace.span("train.step", "train", step=self._nstep,
                          generation=session.generation,
                          world=session.world, fn=self._name,
                          kind=type(self).__name__) as _st:
@@ -429,6 +444,13 @@ class ElasticStepFunction(StepFunction):
                                  good=not flagged, strict=False)
             t3 = time.perf_counter()
 
+        if pod_ctx is not None and session.is_leader:
+            # exactly one rank records the shared pod.step root the
+            # other ranks' step trees already parent under (leadership
+            # read AFTER the step: a mid-step rebuild may have moved it)
+            _obs_prop.emit_pod_root(
+                session.pod_uid, gen0, step0, t_root0,
+                time.perf_counter_ns(), world=session.world)
         self._nstep += 1
         session.note_step(batch_size)
         _metrics.histogram(

@@ -198,9 +198,28 @@ class KVServer:
         coordinator call; blocking calls (allreduce, rebuild_barrier,
         wait_admitted) block this connection's thread — each worker
         holds its own connection, so a waiting peer never starves
-        another worker's control traffic."""
+        another worker's control traffic.
+
+        Requests may carry the CALLER's trace context (``_trace``,
+        attached by ``RemoteGroup._req`` when MXOBS is on): the op runs
+        under it, so a fenced round or rebuild barrier shows up as a
+        child span inside the calling rank's trace instead of an
+        unrooted server-side fragment."""
         co = self._ensure_elastic()
         kw = dict(kw or {})
+        wire = kw.pop("_trace", None)
+        if wire is None:
+            return self._dispatch_elastic(co, op, kw)
+        from .obs import propagate as _obs_prop
+        from .trace import span as _span, under as _under
+        ctx = _obs_prop.bind(wire)
+        with _under(ctx):
+            with _span(f"elastic.{op}", "elastic",
+                       worker=kw.get("worker_id", "")):
+                return self._dispatch_elastic(co, op, kw)
+
+    @staticmethod
+    def _dispatch_elastic(co, op: str, kw):
         if op == "register":
             return co.register(kw["worker_id"], kw.get("devices") or ())
         if op == "heartbeat":
@@ -229,6 +248,14 @@ class KVServer:
                                     kw.get("meta"))
         if op == "describe":
             return co.describe()
+        if op == "obs_push":
+            co.obs_push(kw["worker_id"], kw.get("rank"),
+                        kw.get("snap"))
+            return None
+        if op == "obs_merged":
+            return co.obs_merged()
+        if op == "obs_request_dump":
+            return co.request_dump(kw.get("reason") or "requested")
         raise MXNetError(f"unknown elastic op {op!r}")
 
     def _handle(self, cmd: str, key, payload):

@@ -1,0 +1,60 @@
+"""Coordinated flight-recorder capture: one trigger, every rank dumps.
+
+A pod post-mortem used to mean hand-collecting N uncorrelated flight
+dumps — and the ranks that *didn't* crash never dumped at all, losing
+exactly the surviving-side timeline that explains a quarantine or a
+host loss. Now rank 0 owns a **dump epoch** on the coordinator
+(:meth:`ElasticCoordinator.request_dump` — bumped by the watchdog
+verdict handler, the host-loss poll, ``GroupFailed``/quarantine at the
+leader boundary, or an operator via ``obs_request_dump``): the epoch
+rides the heartbeat flags every worker already polls, each worker's
+:class:`DumpFollower` notices the advance and freezes its local
+recorder (``crash_dump`` — rank-tagged filename, shared
+``MXTRACE_DUMP_DIR``), and the post-mortem directory holds every live
+rank's last spans + metrics from ONE trigger. See the coordinated-dump
+runbook in docs/observability.md.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["DumpFollower"]
+
+
+class DumpFollower:
+    """Worker-side epoch tracker. Feed it every heartbeat's flags
+    (:meth:`ElasticSession` does); an epoch advance triggers one local
+    flight-recorder dump. Not thread-safe per instance — each session
+    owns one and calls it from its beat paths (a raced duplicate
+    observe is absorbed by the recorder's per-reason rate limit)."""
+
+    __slots__ = ("epoch", "last_path")
+
+    def __init__(self):
+        self.epoch = 0
+        self.last_path: Optional[str] = None
+
+    def observe(self, flags) -> Optional[str]:
+        """Returns the dump path when this observation triggered one
+        (None: no advance, obs off, or rate-limited). A follower that
+        first hears of a non-zero epoch dumps too — 'dump-all' must
+        include late joiners while the incident is still warm."""
+        if not isinstance(flags, dict):
+            return None
+        ep = flags.get("dump_epoch")
+        if not ep:
+            return None
+        ep = int(ep)
+        if ep <= self.epoch:
+            return None
+        self.epoch = ep
+        from . import propagate as _prop
+        if not _prop.enabled():
+            return None
+        from ..trace import crash_dump
+        reason = str(flags.get("dump_reason") or "requested")
+        path = crash_dump(f"pod-dump-{reason}", site="obs.capture",
+                          extra={"dump_epoch": ep})
+        if path:
+            self.last_path = path
+        return path

@@ -1,0 +1,278 @@
+"""Pod-merged metrics: the rank-0 collector channel.
+
+Each host's elastic heartbeat pump pushes one *mergeable* snapshot
+(:func:`telemetry.metrics.mergeable_snapshot`) every
+``MXOBS_PUSH_INTERVAL_S`` over the control socket (``obs_push`` — no
+extra thread, no extra connection). The coordinator hands the
+snapshots to one :class:`MetricsCollector`, which answers the question
+per-process registries cannot: *what is the pod-wide step p99?*
+
+Merge semantics (docs/observability.md, benchmarked exact):
+
+- counters and gauges sum across ranks (fleet totals — steps taken,
+  live bytes; per-rank values stay available under rank labels for
+  the instruments where a sum is meaningless);
+- histograms merge EXACTLY on count/sum/min/max and by count-weighted
+  reservoir sampling on the quantile window
+  (:meth:`~mxnet_tpu.telemetry.metrics.Histogram.merge`) — the merged
+  ``count`` equals the sum of the per-rank counts, bit for bit.
+
+Lifecycle follows the PR 12 metriclint owner-token contract: the
+collector adopts its pod-scope instruments (host-count gauge, push
+counter, one freshness gauge per rank) at construction, retires a
+rank's gauge the moment the membership plane drops the host, and
+closes the token with :meth:`close` — ``passes/obslint.py`` flags any
+collector that skips the retirement declaration.
+"""
+from __future__ import annotations
+
+import json
+import time
+import weakref
+from typing import Dict, List, Optional
+
+from ..san.runtime import make_lock
+from ..telemetry import metrics as _metrics
+
+__all__ = ["MetricsCollector", "live_collectors", "fleet_probe"]
+
+# live-instance ledger for the obslint live path and tools/diagnose.py
+# (weak: a dropped collector must not be kept alive by its audit)
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_collectors() -> List["MetricsCollector"]:
+    return list(_LIVE)
+
+
+class _HostState:
+    __slots__ = ("rank", "snap", "wall", "mono", "pushes")
+
+    def __init__(self, rank):
+        self.rank = rank
+        self.snap: Dict[str, dict] = {}
+        self.wall = 0.0
+        self.mono = 0.0
+        self.pushes = 0
+
+
+class MetricsCollector:
+    """See module docstring. One per coordinator; thread-safe."""
+
+    def __init__(self, name: str = "pod"):
+        self.name = str(name)
+        self._lock = make_lock("obs.collector")
+        self._hosts: Dict[str, _HostState] = {}
+        self.closed = False
+        self._m_hosts = _metrics.gauge(
+            "mxobs_collector_hosts",
+            "hosts with a live metrics snapshot on the pod collector")
+        self._m_pushes = _metrics.counter(
+            "mxobs_pushes_total",
+            "per-host metrics snapshots received by the collector")
+        self.token = _metrics.owner(f"obs.collector.{self.name}")
+        self.token.adopt(self._m_hosts, self._m_pushes)
+        _LIVE.add(self)
+
+    # -- the push channel ----------------------------------------------
+    @staticmethod
+    def _age_gauge_name(rank) -> str:
+        return f"mxobs_push_age_seconds_r{rank}"
+
+    def push(self, worker_id: str, rank, snap) -> None:
+        """Record one host's mergeable snapshot (coordinator-side of
+        the ``obs_push`` control-plane op). Never raises — telemetry
+        must not take down the control plane."""
+        try:
+            if self.closed or not isinstance(snap, dict):
+                return
+            rank = int(rank) if rank is not None else -1
+            with self._lock:
+                st = self._hosts.get(worker_id)
+                if st is None:
+                    st = self._hosts[worker_id] = _HostState(rank)
+                    # per-rank freshness gauge: registered on first
+                    # push, ADOPTED by the collector token, retired on
+                    # host departure (the recurring gauge-leak class)
+                    self.token.adopt(_metrics.gauge(
+                        self._age_gauge_name(rank),
+                        f"seconds since rank {rank}'s last metrics "
+                        "push reached the pod collector"))
+                st.rank = rank
+                st.snap = snap
+                st.wall = time.time()
+                st.mono = time.monotonic()
+                st.pushes += 1
+                self._m_hosts.set(len(self._hosts))
+            self._m_pushes.inc()
+            _metrics.gauge(self._age_gauge_name(rank)).set(0.0)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def retire(self, worker_id: str) -> None:
+        """Drop a departed host's snapshot and unregister its per-rank
+        gauge (leave / mark_lost call this — a dead host must not keep
+        publishing a fresh-looking age in /metrics)."""
+        with self._lock:
+            st = self._hosts.pop(worker_id, None)
+            self._m_hosts.set(len(self._hosts))
+        if st is not None:
+            _metrics.unregister(self._age_gauge_name(st.rank))
+
+    # -- the merged view -----------------------------------------------
+    def merged(self) -> Dict[str, object]:
+        """The pod-wide snapshot: fleet-merged values plus per-rank
+        sections. Histogram counts are the EXACT sum of the per-rank
+        counts (the 2-process smoke asserts this bit-for-bit)."""
+        now = time.monotonic()
+        with self._lock:
+            hosts = {w: (st.rank, st.snap, st.wall, now - st.mono,
+                         st.pushes)
+                     for w, st in self._hosts.items()}
+        merged: Dict[str, object] = {}
+        kinds: Dict[str, str] = {}
+        hists: Dict[str, _metrics.Histogram] = {}
+        per_rank: Dict[str, Dict[str, object]] = {}
+        for w in sorted(hosts):
+            rank, snap, wall, age, pushes = hosts[w]
+            _metrics.gauge(self._age_gauge_name(rank)).set(age)
+            rank_vals: Dict[str, object] = {}
+            for name, entry in snap.items():
+                kind = entry.get("kind", "untyped")
+                kinds[name] = kind
+                if kind == "histogram":
+                    h = hists.get(name)
+                    if h is None:
+                        # detached instance: merged state must not
+                        # pollute the rank-0 process registry
+                        h = hists[name] = _metrics.Histogram(name)
+                    h.merge(entry)
+                    rank_vals[name] = {
+                        "count": entry.get("count", 0),
+                        "sum": entry.get("sum", 0.0)}
+                else:
+                    v = entry.get("value", 0)
+                    rank_vals[name] = v
+                    merged[name] = (merged.get(name) or 0) + v
+            per_rank[str(rank)] = {
+                "worker": w, "age_s": round(age, 3), "pushes": pushes,
+                "wall": wall, "metrics": rank_vals}
+        for name, h in hists.items():
+            merged[name] = h.value()
+        return {"ts": time.time(), "hosts": len(hosts),
+                "kinds": kinds, "merged": merged, "ranks": per_rank}
+
+    # -- exporters -----------------------------------------------------
+    def export_jsonl(self, path: Optional[str] = None) -> bool:
+        """Append one merged-snapshot line to ``path`` (default: the
+        ``MXOBS_EXPORT`` flag). Never raises; False when off/failed."""
+        if path is None:
+            from .. import config
+            path = str(config.get("MXOBS_EXPORT") or "")
+        if not path:
+            return False
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(self.merged()) + "\n")
+            return True
+        except (OSError, TypeError, ValueError):
+            return False
+
+    def to_prometheus(self) -> str:
+        """Prometheus text form of the merged view, per-rank series
+        labeled ``{rank="k"}`` next to each ``_pod``-suffixed fleet
+        aggregate."""
+        doc = self.merged()
+        lines: List[str] = []
+        for name in sorted(doc["merged"]):
+            kind = doc["kinds"].get(name, "untyped")
+            v = doc["merged"][name]
+            if isinstance(v, dict):  # histogram
+                lines.append(f"# TYPE {name}_pod summary")
+                lines.append(f"{name}_pod_count {v.get('count', 0)}")
+                lines.append(f"{name}_pod_sum {v.get('sum', 0.0)}")
+                if v.get("count"):
+                    lines.append(
+                        f'{name}_pod{{quantile="0.5"}} {v["p50"]}')
+                    lines.append(
+                        f'{name}_pod{{quantile="0.99"}} {v["p99"]}')
+            else:
+                lines.append(f"# TYPE {name}_pod {kind}")
+                lines.append(f"{name}_pod {v}")
+            for rank in sorted(doc["ranks"]):
+                rv = doc["ranks"][rank]["metrics"].get(name)
+                if rv is None:
+                    continue
+                if isinstance(rv, dict):
+                    lines.append(f'{name}_count{{rank="{rank}"}} '
+                                 f'{rv.get("count", 0)}')
+                    lines.append(f'{name}_sum{{rank="{rank}"}} '
+                                 f'{rv.get("sum", 0.0)}')
+                else:
+                    lines.append(f'{name}{{rank="{rank}"}} {rv}')
+        return "\n".join(lines) + "\n"
+
+    # -- lifecycle -----------------------------------------------------
+    def describe(self) -> Dict[str, object]:
+        with self._lock:
+            return {"name": self.name, "closed": self.closed,
+                    "hosts": {w: {"rank": st.rank, "pushes": st.pushes}
+                              for w, st in sorted(self._hosts.items())},
+                    "owner": self.token.describe()}
+
+    def ranks(self) -> List[int]:
+        with self._lock:
+            return sorted(st.rank for st in self._hosts.values())
+
+    def close(self) -> None:
+        """Retire every pod-scope instrument and close the owner token
+        — the declaration obslint audits."""
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            hosts = list(self._hosts.values())
+            self._hosts.clear()
+        for st in hosts:
+            _metrics.unregister(self._age_gauge_name(st.rank))
+        _metrics.unregister(self._m_hosts.name)
+        _metrics.unregister(self._m_pushes.name)
+        self.token.close()
+
+    def __repr__(self):
+        return (f"<MetricsCollector {self.name!r} "
+                f"{len(self._hosts)} host(s)"
+                f"{' closed' if self.closed else ''}>")
+
+
+def fleet_probe(collector: MetricsCollector, stale_factor: float = 3.0):
+    """Watchdog probe reading FLEET state: one ``obs-push-stale``
+    finding per host whose last snapshot is older than
+    ``stale_factor x MXOBS_PUSH_INTERVAL_S`` — the early signal (a
+    wedged pump, a paused host) that fires BEFORE the heartbeat budget
+    turns it into a host-loss verdict. Wire via
+    ``ElasticCoordinator.attach_watchdog``."""
+    from ..passes import Finding
+
+    def probe():
+        from .. import config
+        budget = max(0.1, float(config.get("MXOBS_PUSH_INTERVAL_S"))
+                     * stale_factor)
+        now = time.monotonic()
+        out = []
+        with collector._lock:
+            hosts = {w: (st.rank, now - st.mono)
+                     for w, st in collector._hosts.items()}
+        for w, (rank, age) in sorted(hosts.items()):
+            if age > budget:
+                out.append(Finding(
+                    "watchdog", "obs-push-stale", f"obs.r{rank}",
+                    "warn",
+                    f"rank {rank} ({w!r}) last pushed metrics "
+                    f"{age:.2f}s ago (budget {budget:.2f}s = "
+                    f"{stale_factor:g}x MXOBS_PUSH_INTERVAL_S) — "
+                    "pump wedged or host paused; fleet snapshots are "
+                    "going stale before the heartbeat verdict"))
+        return out
+
+    return probe

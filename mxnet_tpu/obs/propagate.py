@@ -1,0 +1,117 @@
+"""Cross-host mxtrace context propagation (the mxobs wire layer).
+
+Trace ids are process-local by construction (``spans._new_trace_id``
+prefixes a per-process session nonce), so without help every rank of
+one logical train step roots its own trace and a pod post-mortem is N
+uncorrelated trees. Two mechanisms repair that, both behind the
+``MXOBS`` flag with the mxtrace zero-cost-off discipline (one
+generation-keyed flag-cache read on the hot path):
+
+- **carried context** — :func:`wire_context` packs the caller's
+  ambient :class:`~mxnet_tpu.trace.SpanContext` into a tiny dict that
+  rides every control-plane request (``RemoteGroup._req`` attaches it
+  as ``_trace``); the rank-0 server :func:`bind`\\ s it back and runs
+  the coordinator op under it, so fenced rounds, rebuild barriers and
+  guard votes show up as children INSIDE the calling rank's trace;
+- **derived identity** — :func:`pod_step_context` computes the SAME
+  (trace_id, root span_id) on every rank from control-plane state
+  (the coordinator's group uid + generation + step), each rank's
+  ``train.step`` parents under it, and the leader retroactively emits
+  the shared ``pod.step`` root (:func:`emit_pod_root`) — so the
+  per-rank span files stitch into ONE rooted tree under
+  ``mxprof trace --dir`` with zero orphans.
+
+Nothing here touches jit cache keys: propagation can never recompile.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..trace import spans as _spans
+from ..trace.spans import SpanContext
+
+__all__ = ["enabled", "wire_context", "bind", "pod_step_context",
+           "emit_pod_root"]
+
+# (config generation, MXOBS) — same pattern as trace.spans._flags
+_FLAG_CACHE = (-1, True)
+
+
+def _obs_on() -> bool:
+    global _FLAG_CACHE
+    config = _spans._cfg()
+    gen = config.generation()
+    cached = _FLAG_CACHE
+    if cached[0] == gen:
+        return cached[1]
+    on = bool(config.get("MXOBS"))
+    _FLAG_CACHE = (gen, on)
+    return on
+
+
+def enabled() -> bool:
+    """The one hot-path gate: MXOBS and MXTRACE both on. Two cached
+    flag reads — MXOBS=0 (or MXTRACE=0) makes every propagation site
+    structurally free (no wire fields, no binds, no pod roots)."""
+    return _obs_on() and _spans.enabled()
+
+
+def wire_context() -> Optional[Dict[str, str]]:
+    """The caller's ambient span context in wire form (``{"t":
+    trace_id, "s": span_id}``), or None when there is nothing to
+    carry: obs/tracing off, no ambient span, or the trace was dropped
+    by sampling (unsampled contexts stay process-local — the remote
+    side could only produce spans that would be discarded here)."""
+    if not enabled():
+        return None
+    ctx = _spans._CURRENT.get()
+    if ctx is None or not ctx.sampled:
+        return None
+    return {"t": ctx.trace_id, "s": ctx.span_id}
+
+
+def bind(wire) -> Optional[SpanContext]:
+    """Rehydrate a :func:`wire_context` dict on the receiving side;
+    None when obs is off here or the payload is malformed (a newer
+    worker talking to an older server must degrade to local traces,
+    never crash the control plane)."""
+    if not enabled() or not isinstance(wire, dict):
+        return None
+    tid = wire.get("t")
+    sid = wire.get("s")
+    if not tid or not sid:
+        return None
+    return SpanContext(str(tid), str(sid), True)
+
+
+def _pod_ids(uid: str, generation: int, step: int):
+    tid = f"pod{uid}g{int(generation)}s{int(step)}"
+    return tid, f"{tid}.root"
+
+
+def pod_step_context(uid: Optional[str], generation: int,
+                     step: int) -> Optional[SpanContext]:
+    """The DERIVED shared identity of one pod-wide train step: every
+    rank computes the same (trace_id, root span_id) from the group uid
+    the coordinator handed out at registration, so their ``train.step``
+    spans land in one trace without any rendezvous. None when obs is
+    off or the session has no pod identity (single-process runs keep
+    plain per-process traces)."""
+    if not uid or not enabled():
+        return None
+    tid, sid = _pod_ids(uid, generation, step)
+    return SpanContext(tid, sid, True)
+
+
+def emit_pod_root(uid: str, generation: int, step: int,
+                  t0_ns: int, t1_ns: int, **attrs):
+    """Leader-only: retroactively record the shared ``pod.step`` root
+    span (explicit identity via :func:`~mxnet_tpu.trace.emit_root`)
+    the other ranks' step trees already parent under. Exactly ONE rank
+    must emit it per (generation, step) or the stitched tree grows
+    duplicate roots."""
+    if not enabled():
+        return None
+    tid, sid = _pod_ids(uid, generation, step)
+    return _spans.emit_root("pod.step", "pod", t0_ns, t1_ns, tid, sid,
+                            attrs=attrs or None)

@@ -206,7 +206,7 @@ def findings_report(tool: str, findings: Iterable[Finding],
 def default_manager() -> PassManager:
     from . import (oplint, graphlint, tracercheck, dispatchlint,
                    steplint, shardlint, servelint, elasticlint,
-                   guardlint, metriclint, racelint)
+                   guardlint, metriclint, racelint, obslint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
@@ -220,4 +220,5 @@ def default_manager() -> PassManager:
     pm.register(guardlint.GuardLint())
     pm.register(metriclint.MetricLint())
     pm.register(racelint.RaceLint())
+    pm.register(obslint.ObsLint())
     return pm

@@ -1,0 +1,117 @@
+"""obslint: pod-collector lifecycle audit (the mxobs plane).
+
+The pod :class:`~mxnet_tpu.obs.collector.MetricsCollector` owns a
+family of pod-scope instruments — a host-count gauge, a push counter,
+and one ``mxobs_push_age_seconds_r<k>`` freshness gauge PER RANK,
+registered lazily as hosts push and retired as the membership plane
+drops them. That churn is exactly where the PR-8/10/11 gauge-leak
+class resurfaces (a rank that left keeps publishing a fresh-looking
+age forever), so the obs plane gets its own lint on top of the generic
+metriclint owner audit:
+
+- ``collector-no-owner`` (error) — a live collector whose instruments
+  are not protected by an open owner token: nothing will catch its
+  leaks at close;
+- ``closed-collector-open-owner`` (error) — a closed collector whose
+  owner token is still open: ``close()`` skipped the retirement
+  declaration and the ledger rots;
+- ``collector-leaked-instruments`` (error) — a closed collector with
+  adopted instruments still registered: the leak itself;
+- ``stale-rank-gauge`` (warn) — a per-rank age gauge is registered
+  for a rank the collector no longer tracks: a ``retire()`` was
+  missed (host lost outside leave/mark_lost).
+
+Targets: ``None``/anything audits the LIVE collectors
+(:func:`~mxnet_tpu.obs.collector.live_collectors`) against the live
+registry; a fixture dict ``{"collectors": [{"name", "closed",
+"owner_closed", "adopted", "ranks"}], "live": [names]}`` audits
+synthetic state — ``mxlint --obs`` drives the bad-fixture coverage
+path so the lint can never go vacuous.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List
+
+from . import Finding, Pass
+
+__all__ = ["ObsLint", "lint_collectors"]
+
+_AGE_RE = re.compile(r"^mxobs_push_age_seconds_r(-?\d+)$")
+
+
+def lint_collectors(rows: Iterable[Dict[str, object]],
+                    live: Iterable[str]) -> List[Finding]:
+    """The core audit over (collector descriptions, live instrument
+    names) — shared by the live and fixture paths."""
+    live_set = set(live)
+    findings: List[Finding] = []
+    for row in rows:
+        name = str(row.get("name", "?"))
+        obj = f"obs.collector.{name}"
+        closed = bool(row.get("closed"))
+        owner_closed = bool(row.get("owner_closed"))
+        adopted = [str(n) for n in (row.get("adopted") or ())]
+        ranks = {int(r) for r in (row.get("ranks") or ())}
+        if not closed and owner_closed:
+            findings.append(Finding(
+                "obslint", "collector-no-owner", obj, "error",
+                f"collector {name!r} is live but its owner token is "
+                "closed (or never adopted its instruments) — its "
+                "pod-scope gauges have no retirement declaration and "
+                "will leak at close"))
+        if closed and not owner_closed:
+            findings.append(Finding(
+                "obslint", "closed-collector-open-owner", obj,
+                "error",
+                f"collector {name!r} closed without closing its owner "
+                "token — close() must end with token.close() so the "
+                "metriclint ledger can audit the retirement"))
+        if closed:
+            for n in sorted(n for n in adopted if n in live_set):
+                findings.append(Finding(
+                    "obslint", "collector-leaked-instruments", n,
+                    "error",
+                    f"instrument {n!r} is still registered but its "
+                    f"collector {name!r} closed — a torn-down pod "
+                    "keeps publishing fleet metrics; close() must "
+                    "unregister every adopted instrument (the "
+                    "per-rank-gauge leak class)"))
+        else:
+            for n in sorted(live_set):
+                m = _AGE_RE.match(n)
+                if m and n in adopted \
+                        and int(m.group(1)) not in ranks:
+                    findings.append(Finding(
+                        "obslint", "stale-rank-gauge", n, "warn",
+                        f"per-rank age gauge {n!r} is registered but "
+                        f"collector {name!r} no longer tracks rank "
+                        f"{m.group(1)} — a departed host's retire() "
+                        "was missed; its freshness will read as a "
+                        "live, healthy rank in /metrics"))
+    return findings
+
+
+class ObsLint(Pass):
+    """See module docstring."""
+
+    name = "obslint"
+
+    def run(self, target=None) -> List[Finding]:
+        if isinstance(target, dict) and "collectors" in target:
+            return lint_collectors(
+                target.get("collectors") or (),
+                target.get("live") or ())
+        from ..obs.collector import live_collectors
+        from ..telemetry import metrics as _metrics
+        rows = []
+        for col in live_collectors():
+            desc = col.describe()
+            owner = desc.get("owner") or {}
+            rows.append({
+                "name": desc.get("name"),
+                "closed": desc.get("closed"),
+                "owner_closed": bool(owner.get("closed")),
+                "adopted": owner.get("names") or (),
+                "ranks": col.ranks()})
+        return lint_collectors(rows, _metrics.all_metrics().keys())

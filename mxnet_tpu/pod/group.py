@@ -45,7 +45,8 @@ _log = get_logger("mxnet_tpu.pod")
 # attempt's socket wait at the remaining grace. Blocking protocol waits
 # stay on the server-side deadline (ElasticTimeout).
 _QUICK_OPS = frozenset(("register", "heartbeat", "leave", "mark_lost",
-                        "view", "announce_join", "describe"))
+                        "view", "announce_join", "describe",
+                        "obs_push", "obs_merged", "obs_request_dump"))
 
 
 class CoordinatorLost(MXNetError):

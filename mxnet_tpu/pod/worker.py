@@ -102,6 +102,7 @@ def main(argv=None) -> int:
           restored=ctx.restored, worker_id=ctx.worker_id)
 
     fused = None
+    session = None
     try:
         kv = ctx.kvstore()
         ctx.form_group(kv)
@@ -184,6 +185,11 @@ def main(argv=None) -> int:
         _emit("coordinator_lost", error=str(e)[:200])
         return 44
     except (GroupFailed, WorkerEvicted) as e:
+        if session is not None:
+            # coordinated capture: GroupFailed means the whole pod is
+            # coming down — grab every rank's recorder while the
+            # control plane still answers
+            session.request_pod_dump(f"group-failed-{type(e).__name__}")
         _emit("group_failed", kind=type(e).__name__,
               error=str(e)[:200])
         return 45

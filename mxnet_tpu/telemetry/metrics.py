@@ -22,6 +22,7 @@ enough to live on the kvstore push path.
 from __future__ import annotations
 
 import json
+import random as _random_mod
 import threading
 import time
 from collections import deque
@@ -32,6 +33,7 @@ from ..san.runtime import make_lock
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "all_metrics", "snapshot", "to_json_lines", "to_prometheus",
            "export_jsonl", "reset_metrics", "percentile_of",
+           "merge_reservoirs", "mergeable_snapshot",
            "OwnerToken", "owner", "owners"]
 
 
@@ -47,6 +49,33 @@ def percentile_of(sorted_vals, q: float):
 
 _LOCK = make_lock("telemetry.metrics.registry")
 _METRICS: Dict[str, "Metric"] = {}
+
+
+def merge_reservoirs(a, n_a, b, n_b, cap, rng=None):
+    """Merge two recent-sample reservoirs into one of at most ``cap``
+    samples, UNBIASED with respect to the full streams they summarize:
+    each retained sample stands for ``n_side / len(side)`` raw
+    observations, and selection is weighted sampling without
+    replacement (exponential keys, the A-ES scheme), so a reservoir
+    backed by 10x the observations contributes ~10x the mass. The
+    obs collector merges per-rank histogram states through this.
+
+    ``rng`` is injectable for deterministic tests."""
+    a = list(a)
+    b = list(b)
+    if not a:
+        return b[-cap:] if len(b) > cap else b
+    if not b:
+        return a[-cap:] if len(a) > cap else a
+    if len(a) + len(b) <= cap:
+        return a + b
+    rng = rng or _random_mod
+    w_a = max(float(n_a), float(len(a))) / len(a)
+    w_b = max(float(n_b), float(len(b))) / len(b)
+    keyed = [(rng.random() ** (1.0 / w_a), v) for v in a]
+    keyed += [(rng.random() ** (1.0 / w_b), v) for v in b]
+    keyed.sort(key=lambda kv: -kv[0])
+    return [v for _, v in keyed[:cap]]
 
 
 class Metric:
@@ -175,6 +204,41 @@ class Histogram(Metric):
                     "avg": self._sum / self._count,
                     "p50": percentile_of(samples, 50),
                     "p99": percentile_of(samples, 99)}
+
+    def state(self) -> dict:
+        """The MERGEABLE form: exact streaming fields plus the raw
+        reservoir — what a pod host pushes to the rank-0 collector
+        (picklable/JSON-able, no Metric object crosses the wire)."""
+        with _LOCK:
+            return {"count": self._count, "sum": self._sum,
+                    "min": self._min, "max": self._max,
+                    "recent": list(self._recent)}
+
+    def merge(self, other, rng=None) -> "Histogram":
+        """Fold another histogram (a :class:`Histogram` or a
+        :meth:`state` dict) into this one: count/sum/min/max merge
+        EXACTLY; the reservoirs merge by count-weighted sampling
+        (:func:`merge_reservoirs`), so quantiles stay representative
+        of the combined stream. Returns self."""
+        st = other.state() if isinstance(other, Histogram) else other
+        o_count = int(st.get("count") or 0)
+        if not o_count:
+            return self
+        o_recent = list(st.get("recent") or ())
+        with _LOCK:
+            merged = merge_reservoirs(
+                list(self._recent), self._count,
+                o_recent, o_count, self.RESERVOIR, rng=rng)
+            self._count += o_count
+            self._sum += float(st.get("sum") or 0.0)
+            o_min = st.get("min")
+            if o_min is not None and float(o_min) < self._min:
+                self._min = float(o_min)
+            o_max = st.get("max")
+            if o_max is not None and float(o_max) > self._max:
+                self._max = float(o_max)
+            self._recent = deque(merged, maxlen=self.RESERVOIR)
+        return self
 
     def reset(self):
         with _LOCK:
@@ -312,6 +376,21 @@ def reset_metrics(clear: bool = False):
 def snapshot() -> Dict[str, object]:
     """{name: value} for every instrument; histogram values are dicts."""
     return {name: m.value() for name, m in sorted(all_metrics().items())}
+
+
+def mergeable_snapshot() -> Dict[str, Dict[str, object]]:
+    """{name: {"kind", ...}} over every instrument, in the form the
+    pod collector can MERGE across hosts: counters/gauges carry their
+    scalar, histograms their full :meth:`Histogram.state` (exact
+    count/sum/min/max + raw reservoir). This is what one host pushes
+    per MXOBS_PUSH_INTERVAL_S tick."""
+    out: Dict[str, Dict[str, object]] = {}
+    for name, m in sorted(all_metrics().items()):
+        if isinstance(m, Histogram):
+            out[name] = {"kind": "histogram", **m.state()}
+        else:
+            out[name] = {"kind": m.kind, "value": m.value()}
+    return out
 
 
 def to_json_lines(extra: Optional[Dict[str, object]] = None) -> str:

@@ -34,9 +34,10 @@ from .export import load_spans, write_chrome  # noqa: F401
 from .recorder import (crash_dump, get_recorder,  # noqa: F401
                        install_signal_handler)
 from .spans import (Span, SpanContext, current_context,  # noqa: F401
-                    drain, emit, enabled, reset, span, under)
+                    drain, emit, emit_root, enabled, reset, span,
+                    under)
 
-__all__ = ["Span", "SpanContext", "span", "emit", "under", "enabled",
-           "current_context", "drain", "reset", "load_spans",
-           "write_chrome", "crash_dump", "get_recorder",
+__all__ = ["Span", "SpanContext", "span", "emit", "emit_root", "under",
+           "enabled", "current_context", "drain", "reset",
+           "load_spans", "write_chrome", "crash_dump", "get_recorder",
            "install_signal_handler"]

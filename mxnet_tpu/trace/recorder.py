@@ -60,6 +60,23 @@ def _dump_dir() -> str:
     return d
 
 
+def _pod_rank() -> int:
+    """This process's pod rank for the dump filename: hosts sharing
+    one MXTRACE_DUMP_DIR (the coordinated-capture layout) must not
+    collide on same-second dumps, and the post-mortem reader wants
+    files NAMED by rank. MXPOD_RANK wins, launcher env falls back,
+    single process is rank 0."""
+    try:
+        from .. import config
+        r = int(config.get("MXPOD_RANK"))
+        if r >= 0:
+            return r
+        from ..base import worker_rank
+        return int(worker_rank(0))
+    except Exception:  # noqa: BLE001 — naming must never block a dump
+        return 0
+
+
 class FlightRecorder:
     """See module docstring. One process-wide instance
     (:func:`get_recorder`); every method is safe from any thread."""
@@ -170,6 +187,7 @@ class FlightRecorder:
             # leading up to a failure are exactly the ones a batched
             # sink would otherwise lose if the process dies next
             _export.flush_sink()
+            rank = _pod_rank()
             doc = {
                 "reason": reason,
                 "site": site,
@@ -177,6 +195,7 @@ class FlightRecorder:
                 "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                      time.gmtime()),
                 "pid": os.getpid(),
+                "rank": rank,
                 "spans": rings,
                 "events": events,
                 "metrics": _metrics.snapshot(),
@@ -188,7 +207,7 @@ class FlightRecorder:
                           for c in reason)[:48]
             fname = (f"mxtrace-flight-{tag}-"
                      f"{time.strftime('%Y%m%d-%H%M%S', time.gmtime())}"
-                     f"-p{os.getpid()}-{next(_DUMP_SEQ)}.json")
+                     f"-r{rank}-p{os.getpid()}-{next(_DUMP_SEQ)}.json")
             path = os.path.join(_dump_dir(), fname)
             with open(path, "w") as f:
                 json.dump(doc, f)

@@ -44,8 +44,9 @@ from typing import Dict, List, Optional
 
 from ..san.runtime import make_lock
 
-__all__ = ["Span", "SpanContext", "enabled", "span", "emit", "under",
-           "current_context", "drain", "reset", "wall_of_ns"]
+__all__ = ["Span", "SpanContext", "enabled", "span", "emit",
+           "emit_root", "under", "current_context", "drain", "reset",
+           "wall_of_ns"]
 
 # wall-clock anchor: perf_counter_ns <-> epoch seconds, taken once so
 # every exported span converts consistently
@@ -399,6 +400,29 @@ def emit(name: str, subsystem: str, t0_ns: int, t1_ns: int,
         return None
     sp = Span(name, subsystem, parent.trace_id, _new_span_id(),
               parent.span_id, t0_ns=t0_ns, sampled=True)
+    sp.t1_ns = t1_ns
+    sp.status = status
+    if attrs:
+        sp.attrs.update(attrs)
+    _record(sp)
+    return sp
+
+
+def emit_root(name: str, subsystem: str, t0_ns: int, t1_ns: int,
+              trace_id: str, span_id: str,
+              attrs: Optional[dict] = None,
+              status: str = "ok") -> Optional[Span]:
+    """Record a retroactive ROOT span with EXPLICIT identity — the
+    cross-process stitching hook (mxnet_tpu/obs/): every rank derives
+    the same (trace_id, span_id) from control-plane state, exactly one
+    designated rank emits the root, and the others parent their local
+    trees under it, so `mxprof trace --dir` reassembles one tree from
+    per-rank span files. Per-process ids stay counter-based; only
+    deliberately-shared roots take this path."""
+    if not enabled():
+        return None
+    sp = Span(name, subsystem, str(trace_id), str(span_id), None,
+              t0_ns=t0_ns, sampled=True)
     sp.t1_ns = t1_ns
     sp.status = status
     if attrs:

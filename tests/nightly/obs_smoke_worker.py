@@ -1,0 +1,141 @@
+"""The mxobs pod-observability smoke worker (tier-1, 2 processes via
+launch.py — see test_pod_obs_smoke_two_workers).
+
+Each rank runs a REAL elastic fused train step with tracing + mxobs
+on, exporting spans to a per-rank file in a shared directory, then:
+
+1. records a per-rank histogram/counter and pushes a mergeable
+   snapshot to the rank-0 collector (rank 0 prints the merged doc —
+   the test asserts merged histogram count == exact sum of per-rank
+   counts);
+2. rank 1 requests a coordinated pod dump over the control socket;
+   BOTH ranks wait until their own rank-tagged flight file appears in
+   the shared MXTRACE_DUMP_DIR;
+3. the test stitches the per-rank span files with mxprof's --dir
+   loader and asserts one pod.step trace spans both ranks with >=90%
+   coverage and zero orphans.
+
+Filenames embed ``-r<rank>-`` so the stitcher's rank tagging (the
+flight-dump convention) applies to the live export files too.
+"""
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as onp  # noqa: E402
+
+from mxnet_tpu import config, gluon  # noqa: E402
+from mxnet_tpu import random as mxrandom  # noqa: E402
+from mxnet_tpu import kvstore_server as srv  # noqa: E402
+from mxnet_tpu.elastic import RemoteGroup  # noqa: E402
+from mxnet_tpu.elastic.kvstore import ElasticKVStore  # noqa: E402
+from mxnet_tpu.ndarray import array as nd_array  # noqa: E402
+from mxnet_tpu.telemetry import metrics as _metrics  # noqa: E402
+from mxnet_tpu.trace import export as trace_export  # noqa: E402
+
+
+def _wait(pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def main():
+    rank = int(os.environ["MX_WORKER_ID"])
+    nw = int(os.environ["MX_NUM_WORKERS"])
+    out_dir = os.environ["OBS_SMOKE_DIR"]
+    dump_dir = os.path.join(out_dir, "dumps")
+    os.makedirs(dump_dir, exist_ok=True)
+
+    config.set_flag("MXTRACE", True)
+    config.set_flag("MXOBS", True)
+    config.set_flag("MXOBS_PUSH_INTERVAL_S", 0.05)
+    config.set_flag("MXTRACE_DUMP_DIR", dump_dir)
+    config.set_flag("MXTRACE_EXPORT",
+                    os.path.join(out_dir, f"spans-r{rank}-live.jsonl"))
+    os.environ["MXPOD_RANK"] = str(rank)
+
+    addr = srv.ensure_server(nw, rank)
+    kv = ElasticKVStore(group=RemoteGroup(addr), worker_id=f"w{rank}")
+    session = kv.session
+
+    def _absorbed():
+        if session.heartbeat(0):
+            session.rebuild()
+        return session.world == nw and session.pod_uid is not None
+    _wait(_absorbed, 60.0, "both ranks joined + pod uid absorbed")
+
+    mxrandom.seed(7)
+    onp.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", flatten=False))
+        net.add(gluon.nn.Dense(4, flatten=False))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01}, kvstore=kv,
+                            update_on_kvstore=False)
+    fused = trainer.fuse_step(net, gluon.loss.L2Loss())
+    r = onp.random.RandomState(0)
+    x = nd_array(r.uniform(-1, 1, (8, 8)).astype("float32"))
+    y = nd_array(onp.tanh(r.uniform(-1, 1, (8, 4))).astype("float32"))
+
+    for _ in range(3):
+        fused.step(x, y).asnumpy()
+
+    # -- merged fleet metrics: exact per-rank counts ------------------
+    h = _metrics.histogram("obs_smoke_h", "smoke histogram")
+    for i in range(rank + 2):  # rank 0 -> 2 samples, rank 1 -> 3
+        h.observe(float(i + 1))
+    _metrics.counter("obs_smoke_c", "smoke counter").inc(rank + 1)
+    assert session.push_metrics(), "forced metrics push failed"
+
+    if rank == 0:
+        def _both_pushed():
+            doc = kv.group.obs_merged()
+            if not doc or doc.get("hosts") != nw:
+                return False
+            return all("obs_smoke_h" in doc["ranks"][str(k)]["metrics"]
+                       for k in range(nw))
+        _wait(_both_pushed, 30.0, "both ranks' snapshots on collector")
+        import json
+        # The merged doc is bigger than PIPE_BUF: printed on the shared
+        # stdout pipe it can interleave with the peer's lines, so hand it
+        # to the test through a file instead.
+        merged_path = os.path.join(out_dir, "merged.doc")
+        with open(merged_path + ".tmp", "w") as f:
+            json.dump(kv.group.obs_merged(), f)
+        os.replace(merged_path + ".tmp", merged_path)
+        print("OBS_MERGED_WRITTEN", flush=True)
+
+    # -- coordinated dump: rank 1 triggers over the wire --------------
+    if rank == 1:
+        epoch = session.request_pod_dump("obs-smoke-drill")
+        assert epoch, f"dump request returned {epoch!r}"
+
+    def _my_dump():
+        session.heartbeat(0)  # keep absorbing flags (dump epoch)
+        return any(f"-r{rank}-" in fn for fn in os.listdir(dump_dir))
+    _wait(_my_dump, 30.0, f"rank {rank} flight dump")
+
+    trace_export.flush_sink()
+    print(f"rank {rank}/{nw}: OBS_SMOKE_OK", flush=True)
+
+    # the server-owning rank outlives its peers
+    open(os.path.join(out_dir, f"done.{rank}"), "w").close()
+    if rank == 0:
+        _wait(lambda: all(
+            os.path.exists(os.path.join(out_dir, f"done.{k}"))
+            for k in range(nw)), 60.0, "peers done")
+
+
+if __name__ == "__main__":
+    main()

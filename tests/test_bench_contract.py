@@ -265,6 +265,104 @@ def test_bench_san_overhead_emits_mxsan_overhead():
 
 
 @pytest.mark.slow
+def test_bench_obs_overhead_emits_mxobs_overhead(tmp_path):
+    """--obs-overhead contract: one mxobs_overhead JSON line with the
+    obs-on/obs-off fused-step ratio, the STRUCTURAL zero-cost proof
+    (MXOBS=0 puts nothing on the wire: no pod uid on flags, no _trace
+    field, no derived step context), zero recompiles with the flag
+    flipping every block, and the pod uid absorbed from heartbeat
+    flags while obs was on. Also pins satellite (f): the emitted line
+    lands in the benchstore trajectory by default (MXOBS_BENCHSTORE
+    redirects it; MXTPU_BENCH_STORE=0 is the escape hatch). Reduced
+    knobs keep this a contract check; the acceptance-scale <2% gate
+    (obs_ok) comes from the default knobs."""
+    store = str(tmp_path / "store.jsonl")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_OBS_PAIRS": "3",
+        "MXTPU_BENCH_OBS_HIDDEN": "32",
+        "MXTPU_BENCH_TIMEOUT": "900",
+        "MXOBS_BENCHSTORE": store,
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--obs-overhead"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxobs_overhead"
+    assert data["value"] is not None and data["value"] > 0, data
+    # the zero-cost half is structural, so it holds at ANY knob scale
+    assert data["obs_off_structural"] is True, data
+    assert data["pod_uid_absorbed"] is True, data
+    assert data["recompiles_after_warmup"] == 0, data
+    for key in ("obs_off_step_s", "obs_on_step_s", "overhead_pct",
+                "obs_ok", "pairs"):
+        assert key in data, data
+    assert data["obs_off_step_s"] > 0 and data["obs_on_step_s"] > 0
+    # satellite (f): the metric line was appended to the trajectory
+    # store the moment _emit printed it
+    with open(store) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    assert any(r["metric"] == "mxobs_overhead" and
+               r["value"] == data["value"] for r in recs), recs
+
+
+@pytest.mark.slow
+def test_bench_store_escape_hatch_and_regress_roundtrip(tmp_path):
+    """MXTPU_BENCH_STORE=0 keeps a bench run out of the trajectory
+    store, and `mxprof regress` gates a store seeded with a 2x
+    slowdown (exit 2) while staying green on an unchanged re-run —
+    the CLI half of the benchstore acceptance drill."""
+    store = str(tmp_path / "store.jsonl")
+    base = dict(os.environ)
+    base.pop("XLA_FLAGS", None)
+    base["MXOBS_BENCHSTORE"] = store
+
+    # escape hatch: _emit fires, nothing lands in the store
+    env = dict(base, MXTPU_BENCH_STORE="0")
+    code = ("import bench, sys; sys.path.insert(0, '.');"
+            "bench._emit(1.5, unit='s', metric='esc_overhead')")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=ROOT,
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert json.loads(proc.stdout.strip().splitlines()[-1])[
+        "metric"] == "esc_overhead"
+    assert not os.path.exists(store)
+
+    # default-on: three baseline appends + an unchanged newest
+    for _ in range(4):
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=ROOT,
+            capture_output=True, text=True, timeout=120, env=base)
+        assert proc.returncode == 0, proc.stderr[-800:]
+    assert os.path.exists(store)
+    regress = [sys.executable, os.path.join(ROOT, "tools", "mxprof.py"),
+               "regress", "--store", store, "--json"]
+    proc = subprocess.run(regress, capture_output=True, text=True,
+                          timeout=120, env=base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # seed a 2x slowdown on the lower-is-better metric: exit 2
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         code.replace("bench._emit(1.5", "bench._emit(3.0")],
+        cwd=ROOT, capture_output=True, text=True, timeout=120, env=base)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    proc = subprocess.run(regress, capture_output=True, text=True,
+                          timeout=120, env=base)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert any(f["check"] == "perf-regression" and
+               f["severity"] == "error" and "esc_overhead" in f["obj"]
+               for f in rep["findings"]), rep
+
+
+@pytest.mark.slow
 def test_bench_serving2_emits_mxserve2_throughput():
     """--serving2 contract: one mxserve2_throughput JSON line — serve2
     requests/sec, the PR-3 single-engine baseline and the speedup, zero

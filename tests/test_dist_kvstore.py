@@ -4,6 +4,8 @@ Mirrors the reference's distributed test tier (SURVEY.md §4: multiple
 processes on one machine via `tools/launch.py -n <workers> --launcher
 local`), with jax.distributed+Gloo standing in for the ps-lite tracker.
 """
+import importlib.util
+import json
 import os
 import subprocess
 import sys
@@ -11,6 +13,14 @@ import sys
 import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mxprof():
+    spec = importlib.util.spec_from_file_location(
+        "mxprof_dist_test", os.path.join(ROOT, "tools", "mxprof.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _dist_cpu_tests_enabled() -> bool:
@@ -167,6 +177,79 @@ def test_pod_socket_smoke_two_workers():
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"pod smoke failed:\n{out[-3000:]}"
     assert out.count("POD_SMOKE_OK") == 2, out[-3000:]
+
+
+def test_pod_obs_smoke_two_workers(tmp_path):
+    """The tier-1 mxobs acceptance drill (ISSUE 17): two REAL worker
+    processes through tools/launch.py run an elastic fused train step
+    with tracing + mxobs on, and the test pins the three pod-scale
+    invariants end to end:
+
+    - the per-rank span exports stitch (mxprof ``trace --dir`` loader)
+      into a single ``pod.step``-rooted trace spanning BOTH ranks with
+      >=90% wall coverage and zero orphan spans — the derived
+      ``pod<uid>g<gen>s<step>`` identity needs no rendezvous;
+    - the rank-0 collector's merged snapshot is EXACT: the fleet
+      histogram count equals the sum of the per-rank counts, counters
+      sum across ranks;
+    - one dump request from rank 1 (over the control socket) makes
+      EVERY live rank drop a rank-tagged flight file into the shared
+      dump dir."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each rank owns one CPU device
+    env["OBS_SMOKE_DIR"] = str(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(ROOT, "tests", "nightly", "obs_smoke_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"obs smoke failed:\n{out[-4000:]}"
+    assert out.count("OBS_SMOKE_OK") == 2, out[-3000:]
+
+    # merged fleet metrics: count merge is exact, bit for bit.  Rank 0
+    # hands the merged doc over through a file — it is bigger than
+    # PIPE_BUF, so a print on the shared stdout pipe can interleave
+    # with the peer's lines.
+    merged_path = os.path.join(str(tmp_path), "merged.doc")
+    assert os.path.exists(merged_path), out[-3000:]
+    with open(merged_path) as f:
+        doc = json.load(f)
+    assert doc["hosts"] == 2, doc
+    per_rank = [doc["ranks"][str(k)]["metrics"]["obs_smoke_h"]["count"]
+                for k in range(2)]
+    assert doc["merged"]["obs_smoke_h"]["count"] == sum(per_rank) == 5, \
+        (doc["merged"]["obs_smoke_h"], per_rank)
+    assert doc["merged"]["obs_smoke_c"] == 3, doc["merged"]  # 1 + 2
+
+    # coordinated dump: a rank-tagged flight file from every live rank
+    dumps = os.listdir(os.path.join(str(tmp_path), "dumps"))
+    for k in range(2):
+        assert any(f"-r{k}-" in f for f in dumps), (k, dumps)
+
+    # cross-rank stitching: one pod.step trace, both ranks, no orphans
+    mxprof = _mxprof()
+    spans = mxprof.load_spans_dir(str(tmp_path))
+    trees = mxprof._trace_trees(spans)
+    pod = {tid: t for tid, t in trees.items()
+           if tid.startswith("pod") and t["roots"]}
+    assert pod, sorted(trees)
+    stitched = 0
+    for tid, tree in pod.items():
+        assert not tree["orphans"], (tid, tree["orphans"])
+        ranks = {s.get("attrs", {}).get("rank") for s in tree["spans"]}
+        if not {0, 1} <= ranks:
+            continue
+        stitched += 1
+        root = tree["roots"][0]
+        assert root["name"] == "pod.step", root
+        cov = mxprof._interval_coverage(root, tree["spans"])
+        assert cov is not None and cov >= 0.9, (tid, cov)
+        findings = [f for f in mxprof.analyze_trace({tid: tree})
+                    if f.check in ("orphan-span", "trace-coverage-gap")]
+        assert not findings, findings
+    assert stitched >= 1, \
+        {t: len(v["spans"]) for t, v in pod.items()}
 
 
 @requires_dist_cpu

@@ -263,8 +263,8 @@ def test_hybridize_warns_on_tracer_leak():
 def test_pass_manager_registry():
     pm = default_manager()
     assert pm.names() == ["dispatchlint", "elasticlint", "graphlint",
-                          "guardlint", "metriclint", "oplint",
-                          "podlint", "racelint", "servelint",
+                          "guardlint", "metriclint", "obslint",
+                          "oplint", "podlint", "racelint", "servelint",
                           "shardlint", "steplint", "tracercheck"]
     with pytest.raises(KeyError):
         pm.get("no_such_pass")

@@ -451,11 +451,16 @@ def test_elastic_guard_drill_traces_and_quarantine_dump(tmp_path):
     assert report["guard"]["quarantined"] == ["w1"]
 
     # the quarantine froze a dump whose final spans name the vote/
-    # re-execution at the failing worker
-    ld = trace.get_recorder().last_dump
-    assert ld is not None and ld["reason"] == "guard_quarantine"
-    assert ld["site"] == "w1"
-    doc = json.load(open(ld["path"]))
+    # re-execution at the failing worker — and (mxobs) the leader
+    # boundary ALSO broadcast a coordinated pod dump for the incident
+    assert trace.get_recorder().last_dump is not None
+    dumps = sorted(os.listdir(str(tmp_path)))
+    quarantine = [f for f in dumps if "-guard_quarantine-" in f]
+    assert quarantine, dumps
+    assert any("pod-dump-guard-quarantine" in f for f in dumps), dumps
+    doc = json.load(open(os.path.join(str(tmp_path), quarantine[-1])))
+    assert doc["reason"] == "guard_quarantine"
+    assert doc["site"] == "w1"
     guard_spans = [s["name"] for s in doc["spans"].get("guard", [])]
     assert "guard.vote" in guard_spans or "guard.reexec" in guard_spans
     assert any(e["name"] == "guard_quarantine" for e in doc["events"])

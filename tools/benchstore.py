@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""benchstore: the append-only perf-trajectory database (mxobs).
+
+Every ``bench.py`` run appends its ``BENCH {...}`` metric lines here
+(one JSON record per line, keyed by metric name, host fingerprint,
+mesh shape and git revision), so the answer to "did PR N make
+resnet50 slower?" is a query over the stored trajectory instead of an
+eyeballed pair of runs. ``mxprof regress`` (and ``python
+tools/benchstore.py check``) gates the LATEST record of each metric
+against the median/MAD of its history:
+
+    gate = max(4 * 1.4826 * MAD, 0.25 * |median|)
+
+— i.e. a regression must clear four robust standard deviations AND at
+least 25% of the median, so noisy CPU-host runs don't page anyone, a
+genuine 2x slowdown always does, and re-running an unchanged rev is
+always green (deviation 0). Direction comes from the metric name
+(``*_overhead``/``*_seconds`` are lower-better, throughputs
+higher-better; unknown names gate two-sided).
+
+The store lives at ``tools/benchstore.jsonl`` (committed — the
+trajectory IS the artifact); ``MXOBS_BENCHSTORE`` points elsewhere,
+``MXOBS_BENCHSTORE=0`` (or ``MXTPU_BENCH_STORE=0`` on the bench side)
+disables appends. Records are never rewritten: ingest appends, check
+reads.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import platform
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["DEFAULT_PATH", "store_path", "host_fingerprint", "git_rev",
+           "record", "load", "trajectory", "direction", "check",
+           "ingest_bench_file", "main"]
+
+DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchstore.jsonl")
+
+# robust gate parameters (see module docstring)
+MAD_SIGMAS = 4.0
+MAD_TO_SIGMA = 1.4826
+REL_FLOOR = 0.25
+MIN_HISTORY = 3
+
+_LOWER_BETTER = ("_overhead", "_seconds", "_latency", "_ms", "_bytes")
+_HIGHER_BETTER = ("throughput", "images_per", "samples_per",
+                  "_speedup", "_recovery", "_per_sec", "_drill")
+
+
+def store_path(path: Optional[str] = None) -> Optional[str]:
+    """Resolve the store file; None means 'disabled'."""
+    if path:
+        return path
+    env = os.environ.get("MXOBS_BENCHSTORE", "").strip()
+    if env.lower() in ("0", "off", "none", "disabled"):
+        return None
+    return env or DEFAULT_PATH
+
+
+def host_fingerprint() -> str:
+    """Stable per-host key: trajectories only compare like with like
+    (a laptop's images/sec is not a regression against a pod's)."""
+    raw = f"{platform.node()}|{platform.machine()}|{os.cpu_count()}"
+    return hashlib.md5(raw.encode()).hexdigest()[:8]
+
+
+def git_rev() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5)
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else "unknown"
+    except (OSError, subprocess.SubprocessError):
+        return "unknown"
+
+
+def record(metric: str, value, unit: str = "", vs_baseline=None,
+           mesh: Optional[str] = None, extra: Optional[dict] = None,
+           path: Optional[str] = None,
+           rev: Optional[str] = None) -> Optional[dict]:
+    """Append one trajectory point. Returns the record, or None when
+    the store is disabled or unwritable (benchmarks must never fail
+    because their trajectory DB is read-only)."""
+    p = store_path(path)
+    if p is None:
+        return None
+    rec = {"ts": round(time.time(), 3), "metric": str(metric),
+           "value": float(value), "unit": str(unit or ""),
+           "host": host_fingerprint(), "mesh": str(mesh or ""),
+           "rev": rev if rev is not None else git_rev()}
+    if vs_baseline is not None:
+        rec["vs_baseline"] = vs_baseline
+    if extra:
+        rec["extra"] = {k: v for k, v in extra.items()
+                        if isinstance(v, (str, int, float, bool))
+                        or v is None}
+    try:
+        with open(p, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    except OSError:
+        return None
+    return rec
+
+
+def load(path: Optional[str] = None) -> List[dict]:
+    p = store_path(path)
+    if p is None or not os.path.exists(p):
+        return []
+    out = []
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a torn append must not poison the store
+            if isinstance(rec, dict) and "metric" in rec \
+                    and "value" in rec:
+                out.append(rec)
+    out.sort(key=lambda r: r.get("ts", 0.0))
+    return out
+
+
+def trajectory(records: List[dict], metric: str,
+               host: Optional[str] = None,
+               mesh: Optional[str] = None) -> List[dict]:
+    out = [r for r in records if r.get("metric") == metric]
+    if host is not None:
+        out = [r for r in out if r.get("host") == host]
+    if mesh is not None:
+        out = [r for r in out if r.get("mesh", "") == mesh]
+    return out
+
+
+def direction(metric: str) -> str:
+    """'lower' / 'higher' / 'both' — which way is a regression."""
+    m = metric.lower()
+    if any(t in m for t in _HIGHER_BETTER):
+        return "higher"
+    if any(t in m for t in _LOWER_BETTER):
+        return "lower"
+    return "both"
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def check(metric: Optional[str] = None, path: Optional[str] = None,
+          window: int = 20, min_history: int = MIN_HISTORY
+          ) -> List[dict]:
+    """Gate the LATEST record of each metric against its history.
+
+    Returns one verdict dict per judged metric: ``{"metric", "value",
+    "median", "gate", "deviation", "direction", "n_history",
+    "severity", "message"}`` with severity ``"error"`` (regression),
+    ``"info"`` (ok), or ``"skip"`` (not enough history to judge —
+    never an error: a brand-new metric has no trajectory yet)."""
+    records = load(path)
+    metrics = [metric] if metric else \
+        sorted({r["metric"] for r in records})
+    out = []
+    for m in metrics:
+        traj = trajectory(records, m)
+        if not traj:
+            out.append({"metric": m, "severity": "skip",
+                        "n_history": 0,
+                        "message": "no records in the store"})
+            continue
+        latest = traj[-1]
+        # compare like with like; fall back to the all-host trajectory
+        # when this (host, mesh) has no usable history (back-ingested
+        # seed records carry the ingest host's fingerprint)
+        hist = trajectory(traj[:-1], m, host=latest.get("host"),
+                          mesh=latest.get("mesh", ""))
+        if len(hist) < min_history:
+            hist = traj[:-1]
+        hist = hist[-window:]
+        if len(hist) < min_history:
+            out.append({"metric": m, "severity": "skip",
+                        "value": latest["value"],
+                        "n_history": len(hist),
+                        "message": f"only {len(hist)} prior record(s) "
+                                   f"(need {min_history}) — trajectory "
+                                   "too short to judge"})
+            continue
+        vals = [float(r["value"]) for r in hist]
+        med = _median(vals)
+        mad = _median([abs(v - med) for v in vals])
+        gate = max(MAD_SIGMAS * MAD_TO_SIGMA * mad,
+                   REL_FLOOR * abs(med))
+        value = float(latest["value"])
+        dev = value - med
+        direc = direction(m)
+        regressed = (direc == "lower" and dev > gate) or \
+                    (direc == "higher" and -dev > gate) or \
+                    (direc == "both" and abs(dev) > gate)
+        verdict = {"metric": m, "value": value, "median": med,
+                   "gate": gate, "deviation": dev,
+                   "direction": direc, "n_history": len(hist),
+                   "rev": latest.get("rev", "unknown"),
+                   "severity": "error" if regressed else "info"}
+        if regressed:
+            pct = abs(dev) / abs(med) * 100 if med else float("inf")
+            verdict["message"] = (
+                f"{m} = {value:g} vs median {med:g} over "
+                f"{len(hist)} run(s): {pct:.0f}% "
+                f"{'above' if dev > 0 else 'below'} "
+                f"(gate {gate:g}, {direc}-is-worse) — perf regression "
+                f"at rev {latest.get('rev', '?')}")
+        else:
+            verdict["message"] = (
+                f"{m} = {value:g} within gate of median {med:g} "
+                f"({len(hist)} run(s))")
+        out.append(verdict)
+    return out
+
+
+def ingest_bench_file(path: str, store: Optional[str] = None) -> int:
+    """Back-ingest a BENCH_*.json driver artifact (``{"n", "cmd",
+    "rc", "tail", "parsed"}`` — ``parsed`` is the BENCH metric line).
+    Returns the number of records appended."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    n = 0
+    docs = doc if isinstance(doc, list) else [doc]
+    for d in docs:
+        if not isinstance(d, dict):
+            continue
+        parsed = d.get("parsed")
+        if not isinstance(parsed, dict) or "value" not in parsed:
+            continue
+        extra = {k: v for k, v in parsed.items()
+                 if k not in ("metric", "value", "unit",
+                              "vs_baseline", "mesh")}
+        extra["ingested_from"] = os.path.basename(path)
+        if record(parsed.get("metric", "unknown"), parsed["value"],
+                  unit=parsed.get("unit", ""),
+                  vs_baseline=parsed.get("vs_baseline"),
+                  mesh=parsed.get("mesh"), extra=extra,
+                  path=store, rev=str(d.get("n", "seed"))) is not None:
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# CLI (mxprof regress wraps `check` with the shared findings schema)
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="benchstore",
+                                description=__doc__)
+    sub = p.add_subparsers(dest="cmd")
+    pi = sub.add_parser("ingest", help="back-ingest BENCH_*.json "
+                                       "driver artifacts")
+    pi.add_argument("files", nargs="+")
+    pi.add_argument("--store", default=None)
+    pc = sub.add_parser("check", help="median/MAD regression gate "
+                                      "over the stored trajectories")
+    pc.add_argument("--metric", default=None)
+    pc.add_argument("--store", default=None)
+    pc.add_argument("--window", type=int, default=20)
+    pc.add_argument("--json", action="store_true", dest="as_json")
+    ps = sub.add_parser("show", help="list stored trajectories")
+    ps.add_argument("--metric", default=None)
+    ps.add_argument("--store", default=None)
+    args = p.parse_args(argv)
+    if args.cmd == "ingest":
+        total = sum(ingest_bench_file(f, store=args.store)
+                    for f in args.files)
+        print(f"benchstore: ingested {total} record(s) into "
+              f"{store_path(args.store)}")
+        return 0
+    if args.cmd == "check":
+        verdicts = check(args.metric, path=args.store,
+                         window=args.window)
+        if args.as_json:
+            print(json.dumps({"tool": "benchstore",
+                              "verdicts": verdicts}, indent=2))
+        else:
+            for v in verdicts:
+                print(f"[{v['severity']:<5}] {v['message']}")
+        return 2 if any(v["severity"] == "error"
+                        for v in verdicts) else 0
+    if args.cmd == "show":
+        records = load(args.store)
+        if args.metric:
+            records = trajectory(records, args.metric)
+        for r in records:
+            print(json.dumps(r, sort_keys=True))
+        return 0
+    p.error("nothing to do: use ingest, check or show")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

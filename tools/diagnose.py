@@ -180,6 +180,43 @@ def check_trace():
               + f" -> {ld['path']}")
         print("    read it with: python tools/mxprof.py trace "
               f"{ld['path']}")
+    # pod view: dump filenames are rank-tagged (-r<k>-), so the dump
+    # DIRECTORY holds one timeline per rank after a coordinated
+    # capture — show the newest per rank, not just this process's
+    dump_dir = str(config.get("MXTRACE_DUMP_DIR") or "")
+    per_rank = _newest_dumps_per_rank(dump_dir)
+    if per_rank:
+        print(f"  POD DUMPS  : {len(per_rank)} rank(s) in {dump_dir}")
+        for rank in sorted(per_rank):
+            print(f"    r{rank}: {os.path.basename(per_rank[rank])}")
+        print("    stitch them with: python tools/mxprof.py trace "
+              f"--dir {dump_dir}")
+
+
+def _newest_dumps_per_rank(dump_dir):
+    """Newest flight-dump file per rank in ``dump_dir`` ({rank:
+    path}); filenames carry the rank as ``-r<k>-`` (trace.recorder)."""
+    import re
+    out = {}
+    if not dump_dir or not os.path.isdir(dump_dir):
+        return out
+    try:
+        names = os.listdir(dump_dir)
+    except OSError:
+        return out
+    for fn in names:
+        m = re.match(r"mxtrace-flight-.*-r(\d+)-p\d+-\d+\.json$", fn)
+        if not m:
+            continue
+        rank = int(m.group(1))
+        path = os.path.join(dump_dir, fn)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        if rank not in out or mtime > out[rank][0]:
+            out[rank] = (mtime, path)
+    return {r: p for r, (t, p) in out.items()}
     snap = telemetry.snapshot()
     phases = {k: v for k, v in snap.items()
               if k.startswith("mxtrace_phase_")}
@@ -517,6 +554,55 @@ def check_mxsan():
                  blocked[-1]["holder_site"]))
 
 
+def check_obs():
+    """Pod observability plane health: MXOBS flag state, the live pod
+    collectors (hosts, pushes, owner tokens), the benchstore
+    trajectory DB, and the trace-propagation gate (mxnet_tpu/obs/;
+    docs/observability.md multi-host section)."""
+    print("----------Pod observability (mxobs)----------")
+    try:
+        from mxnet_tpu import config
+        from mxnet_tpu.obs import propagate as prop
+        from mxnet_tpu.obs.collector import live_collectors
+    except Exception as e:
+        print("mxobs        : unavailable (%s)" % e)
+        return
+    on = bool(config.get("MXOBS"))
+    print("obs plane    :", "ON" if on else "(off — set MXOBS=1)")
+    print("propagation  :", "armed (spans ride the control plane)"
+          if prop.enabled() else
+          "(inert — needs MXOBS and MXTRACE both on)")
+    print("push cadence :", config.get("MXOBS_PUSH_INTERVAL_S"),
+          "s per host snapshot")
+    sink = config.get("MXOBS_EXPORT")
+    print("export sink  :", sink or "(off — query the collector "
+                                    "via describe/obs_merged)")
+    cols = live_collectors()
+    if not cols:
+        print("collectors   : none (not the rank-0 control-plane "
+              "process, or no pod formed)")
+    for col in cols:
+        d = col.describe()
+        hosts = d.get("hosts") or {}
+        print(f"collector    : {d['name']!r} — {len(hosts)} host(s)"
+              + (" CLOSED" if d.get("closed") else ""))
+        for w, h in sorted(hosts.items()):
+            print(f"  {w}: rank {h['rank']}, {h['pushes']} push(es)")
+    # the perf-trajectory store (tools/benchstore.py)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import benchstore
+        path = benchstore.store_path()
+        records = benchstore.load()
+        metrics = sorted({r["metric"] for r in records})
+        print(f"benchstore   : {path or '(disabled)'} — "
+              f"{len(records)} record(s), {len(metrics)} metric(s)")
+        if metrics:
+            print("  gate it with: python tools/mxprof.py regress")
+    except Exception as e:
+        print("benchstore   : unavailable (%s)" % e)
+
+
 def main():
     check_python()
     check_pip()
@@ -533,6 +619,7 @@ def main():
     check_pod()
     check_guard()
     check_mxsan()
+    check_obs()
     check_mxlint()
 
 

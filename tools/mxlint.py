@@ -421,6 +421,69 @@ def _selfcheck_metric_findings():
     return findings
 
 
+def _selfcheck_obs_findings():
+    """obslint self-check: the live collectors must audit clean, a
+    real collector push/retire/close round must stay clean (per-rank
+    age gauges registered, adopted and retired), and — coverage check
+    on the lint itself — the bad fixtures MUST fire all four checks."""
+    from mxnet_tpu.obs.collector import MetricsCollector
+    from mxnet_tpu.passes import Finding
+    from mxnet_tpu.passes.obslint import ObsLint
+    from mxnet_tpu.telemetry import metrics as _m
+
+    p = ObsLint()
+    findings = list(p.run())  # the live collectors, pre-exercise
+
+    # live exercise: push two ranks, retire one, close — every stage
+    # must audit clean and the close must retire every instrument
+    col = MetricsCollector("<self-check obs>")
+    col.push("w0", 0, {"m": {"kind": "counter", "value": 1}})
+    col.push("w1", 1, {"m": {"kind": "counter", "value": 2}})
+    findings += p.run()
+    col.retire("w1")
+    findings += p.run()
+    adopted = list(col.token.describe().get("names") or ())
+    col.close()
+    after = p.run()
+    findings += after
+    leaked = [n for n in adopted if n in _m.all_metrics()]
+    if leaked:
+        findings.append(Finding(
+            "obslint", "selfcheck-retirement", "<self-check obs>",
+            "error",
+            f"a properly-closed collector left {leaked!r} registered "
+            "— the close() retirement contract regressed"))
+
+    # the lint must FIRE on the bad fixtures — else it is vacuous
+    bad = {"collectors": [
+        {"name": "<live no-owner>", "closed": False,
+         "owner_closed": True, "adopted": [], "ranks": []},
+        {"name": "<closed open-owner>", "closed": True,
+         "owner_closed": False, "adopted": [], "ranks": []},
+        {"name": "<closed leaker>", "closed": True,
+         "owner_closed": True, "adopted": ["mxobs_collector_hosts"],
+         "ranks": []},
+        {"name": "<stale rank>", "closed": False,
+         "owner_closed": False,
+         "adopted": ["mxobs_push_age_seconds_r7"], "ranks": [0]}],
+        "live": ["mxobs_collector_hosts",
+                 "mxobs_push_age_seconds_r7"]}
+    fired = {f.check for f in p.run(bad)}
+    for check in ("collector-no-owner", "closed-collector-open-owner",
+                  "collector-leaked-instruments", "stale-rank-gauge"):
+        if check not in fired:
+            findings.append(Finding(
+                "obslint", "selfcheck-coverage", "<bad fixture>",
+                "error",
+                f"lint did not fire {check!r} on the fixture built "
+                "to trigger it"))
+    findings.append(Finding(
+        "obslint", "selfcheck-summary", "<self-check obs>", "info",
+        "collector push/retire/close round audited clean, "
+        "bad-fixture coverage exercised"))
+    return findings
+
+
 # racelint bad fixtures: each is the minimal module exhibiting one of
 # the four checks — the --race self-check asserts the lint FIRES on
 # every one (and stays quiet on the paired good spellings), so the
@@ -617,6 +680,12 @@ def main(argv=None):
                         "their closed owner (the per-engine-gauge "
                         "leak class), driving a real engine "
                         "open/close round plus bad-fixture coverage")
+    p.add_argument("--obs", action="store_true", dest="obs_check",
+                   help="obslint self-check: audit pod-collector "
+                        "lifecycle (owner tokens, per-rank age-gauge "
+                        "retirement) over the live collectors, drive "
+                        "a real push/retire/close round, and exercise "
+                        "bad-fixture coverage")
     p.add_argument("--race", action="store_true", dest="race_check",
                    help="racelint + mxsan self-check: AST concurrency "
                         "lint over mxnet_tpu's own source (unguarded "
@@ -646,10 +715,11 @@ def main(argv=None):
 
     if not (args.ops or args.all or args.graphs or args.shard
             or args.opt_check or args.serve_check or args.guard_check
-            or args.metrics_check or args.race_check):
+            or args.metrics_check or args.race_check
+            or args.obs_check):
         p.error("nothing to do: pass --ops, --all, --shard, --opt, "
-                "--serve, --guard, --metrics, --race, or graph JSON "
-                "files")
+                "--serve, --guard, --metrics, --obs, --race, or "
+                "graph JSON files")
 
     if args.shard and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -763,6 +833,10 @@ def main(argv=None):
         findings.extend(mt)
         sections.append(("metriclint", "<self-check owner ledger>",
                          mt))
+    if args.obs_check:
+        ob = _selfcheck_obs_findings()
+        findings.extend(ob)
+        sections.append(("obslint", "<self-check pod collector>", ob))
     if args.race_check:
         rc = _selfcheck_race_findings()
         findings.extend(rc)

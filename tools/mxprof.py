@@ -28,6 +28,7 @@ Exit codes: 0 clean, 2 findings at error severity, 1 usage error.
 import argparse
 import json
 import os
+import re
 import sys
 from collections import defaultdict
 
@@ -709,9 +710,53 @@ def trace_report(trees, top):
     return "\n".join(lines)
 
 
+def load_spans_dir(dirpath):
+    """Stitch a DIRECTORY of per-rank span files (a coordinated
+    flight-dump directory, or each rank's MXTRACE_EXPORT) into one
+    span list. Two repairs make cross-host trees analyzable:
+
+    - **clock rebase** — ``ts_us`` is per-process monotonic (origins
+      differ per host); every span carrying a ``wall`` anchor is
+      rebased to ``wall * 1e6`` so spans from different ranks align on
+      the epoch clock while intra-process deltas survive exactly;
+    - **rank tagging + dedup** — the rank parsed from the ``-r<k>-``
+      filename tag lands in ``attrs.rank``, and a span dumped by two
+      files (a leader's export AND its flight dump) is kept once.
+    """
+    spans, seen = [], set()
+    for fn in sorted(os.listdir(dirpath)):
+        if not fn.endswith((".json", ".jsonl")):
+            continue
+        try:
+            from mxnet_tpu.trace import load_spans
+            file_spans = load_spans(os.path.join(dirpath, fn))
+        except (OSError, ValueError):
+            continue
+        m = re.search(r"-r(\d+)-", fn)
+        rank = int(m.group(1)) if m else None
+        for s in file_spans:
+            key = (s.get("trace_id"), s.get("span_id"))
+            if key in seen:
+                continue
+            seen.add(key)
+            s = dict(s)
+            w = s.get("wall")
+            if isinstance(w, (int, float)) and w > 0:
+                s["ts_us"] = float(w) * 1e6
+            if rank is not None:
+                attrs = dict(s.get("attrs") or {})
+                attrs.setdefault("rank", rank)
+                s["attrs"] = attrs
+            spans.append(s)
+    return sorted(spans, key=lambda d: d["ts_us"])
+
+
 def trace_cmd(path, top, as_json, min_coverage):
     from mxnet_tpu.trace import load_spans
-    spans = load_spans(path)
+    if os.path.isdir(path):
+        spans = load_spans_dir(path)
+    else:
+        spans = load_spans(path)
     trees = _trace_trees(spans)
     findings = analyze_trace(trees, min_coverage)
     if as_json:
@@ -751,6 +796,42 @@ def trace_cmd(path, top, as_json, min_coverage):
         for fi in findings:
             print(f"  {fi!r}")
     from mxnet_tpu.passes import severity_counts
+    return 2 if severity_counts(findings)["error"] else 0
+
+
+# ---------------------------------------------------------------------------
+# benchstore regression gate (tools/benchstore.py — ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def regress_cmd(metric, store, window, as_json):
+    """``mxprof regress``: gate the latest benchstore record of each
+    metric against its trajectory (median/MAD — see tools/benchstore
+    module docstring). Exit 2 on any regression verdict."""
+    try:
+        import benchstore
+    except ImportError:  # loaded by file path (tests): add tools/
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import benchstore
+    from mxnet_tpu.passes import Finding, findings_report, \
+        severity_counts
+    verdicts = benchstore.check(metric, path=store, window=window)
+    findings = [Finding("mxprof", "perf-regression", v["metric"],
+                        "error", v["message"])
+                for v in verdicts if v["severity"] == "error"]
+    if as_json:
+        print(findings_report(
+            "mxprof", findings,
+            extra={"store": benchstore.store_path(store),
+                   "verdicts": verdicts}, as_json=True))
+    else:
+        path = benchstore.store_path(store)
+        print(f"== mxprof regress: {path} "
+              f"({len(verdicts)} metric(s) judged)")
+        for v in verdicts:
+            print(f"  [{v['severity']:<5}] {v['message']}")
+        if not verdicts:
+            print("  (empty store — run bench.py to seed the "
+                  "trajectory)")
     return 2 if severity_counts(findings)["error"] else 0
 
 
@@ -936,11 +1017,39 @@ def main(argv=None):
     ptrace.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the shared machine-readable "
                              "findings report")
+    ptrace.add_argument("--dir", action="store_true", dest="as_dir",
+                        help="treat DUMP as a directory of per-rank "
+                             "span files (a coordinated flight-dump "
+                             "dir): rebase each span onto the epoch "
+                             "clock and stitch one cross-host report "
+                             "(auto-detected for directory paths)")
+    pregress = sub.add_parser(
+        "regress",
+        help="perf-trajectory regression gate over the benchstore "
+             "(tools/benchstore.jsonl): the latest record of each "
+             "metric vs the median/MAD of its history")
+    pregress.add_argument("--metric", default=None,
+                          help="gate one metric (default: all stored)")
+    pregress.add_argument("--store", default=None,
+                          help="store path (default: "
+                               "MXOBS_BENCHSTORE or "
+                               "tools/benchstore.jsonl)")
+    pregress.add_argument("--window", type=int, default=20,
+                          help="history records per trajectory "
+                               "(default 20)")
+    pregress.add_argument("--json", action="store_true",
+                          dest="as_json",
+                          help="emit the shared machine-readable "
+                               "findings report")
     args = p.parse_args(argv)
-    if args.cmd not in ("summarize", "step", "shard", "opt", "trace"):
-        p.error("nothing to do: use the summarize, step, shard, opt "
-                "or trace subcommand")
+    if args.cmd not in ("summarize", "step", "shard", "opt", "trace",
+                        "regress"):
+        p.error("nothing to do: use the summarize, step, shard, opt, "
+                "trace or regress subcommand")
     try:
+        if args.cmd == "regress":
+            return regress_cmd(args.metric, args.store, args.window,
+                               args.as_json)
         if args.cmd == "step":
             return step_cmd(args.dump, args.as_json)
         if args.cmd == "shard":
