@@ -2196,6 +2196,176 @@ def obs_main():
           vs=None, **record)
 
 
+def fleet_main():
+    """Disaggregated-fleet SLO benchmark (--fleet / MXTPU_BENCH_FLEET=1):
+    the pod-scale serving control plane (mxnet_tpu/fleet/) under an
+    OPEN-LOOP loadgen — arrivals at a fixed offered rate regardless of
+    completions, the schedule an SLO is actually measured against —
+    in three legs, ONE BENCH-schema JSON line (metric ``mxfleet_slo``,
+    value = fleet/single-host goodput-QPS-within-SLO ratio):
+
+    1. single-host baseline: ONE local engine behind the PR 11 Router
+       (the flags-off serving path), driven at the offered rate;
+    2. fleet: the SAME workload against 2 decode + 1 prefill REAL
+       host processes with prefix-affinity routing and disaggregated
+       prefill (pagewire page streaming) — per-worker prefix-cache
+       hit rates aggregate into the fleet hit rate;
+    3. availability: a decode host SIGKILLed mid-load
+       (run_fleet_drill) — the contract is ZERO dropped accepted
+       requests, absorbed by crash-typed retries + directory
+       convergence.
+
+    Knobs: MXTPU_BENCH_FLEET_{DECODE,PREFILL,REQUESTS,RATE_QPS,
+    SLO_MS,PROMPT,MAX_NEW,KILL_REQUESTS}."""
+    import threading
+    os.environ.setdefault("MXTPU_BENCH_FORCE_CPU", "1")  # subprocess
+    jax, devices, probe_status = _init_jax()             # host fleet
+    from mxnet_tpu.fleet.drill import (FleetHarness, _make_payloads,
+                                       run_fleet_drill)
+    from mxnet_tpu.fleet.worker import build_engine
+    from mxnet_tpu.serve2.router import Router
+
+    n_decode = int(os.environ.get("MXTPU_BENCH_FLEET_DECODE", "2"))
+    n_prefill = int(os.environ.get("MXTPU_BENCH_FLEET_PREFILL", "1"))
+    n_req = int(os.environ.get("MXTPU_BENCH_FLEET_REQUESTS", "32"))
+    rate = float(os.environ.get("MXTPU_BENCH_FLEET_RATE_QPS", "2.0"))
+    slo_ms = float(os.environ.get("MXTPU_BENCH_FLEET_SLO_MS", "6000"))
+    prompt_len = int(os.environ.get("MXTPU_BENCH_FLEET_PROMPT", "24"))
+    max_new = int(os.environ.get("MXTPU_BENCH_FLEET_MAX_NEW", "8"))
+    kill_req = int(os.environ.get("MXTPU_BENCH_FLEET_KILL_REQUESTS",
+                                  "16"))
+    page = 8
+    payloads = _make_payloads(n_req, prompt_len, page)
+
+    def _openloop(predict, tag):
+        """Fixed-rate arrivals; returns (qps, p99_ms, goodput_qps)
+        where goodput counts only completions within the SLO. A short
+        unmeasured warm pass first: neither leg's tail may carry the
+        other's compile-settling jitter."""
+        for tokens in payloads[:4]:
+            try:
+                predict(tokens)
+            except Exception:  # noqa: BLE001 — warm pass only
+                pass
+        lats, fails = [], []
+        lock = threading.Lock()
+        threads = []
+        t0 = time.perf_counter()
+        for i, tokens in enumerate(payloads):
+            target = t0 + i / rate
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+
+            def _run(tk=tokens, idx=i):
+                s = time.perf_counter()
+                try:
+                    predict(tk)
+                    with lock:
+                        lats.append(time.perf_counter() - s)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        fails.append(f"{idx}: {type(e).__name__}")
+            t = threading.Thread(target=_run, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(120.0)
+        wall = max(time.perf_counter() - t0, 1e-9)
+        lats.sort()
+        p99 = (lats[min(len(lats) - 1,
+                        int(0.99 * len(lats)))] * 1e3
+               if lats else None)
+        good = sum(1 for v in lats if v * 1e3 <= slo_ms)
+        print(f"# fleet-bench [{tag}] completed={len(lats)} "
+              f"fails={len(fails)} p99_ms={p99} wall={wall:.1f}s",
+              file=sys.stderr)
+        return (len(lats) / wall, p99, good / wall, fails)
+
+    # -- leg 1: single-host router (the flags-off path) ---------------
+    single_engine = build_engine(
+        seed=0, vocab=64, n_layers=2, d_model=32, n_heads=2,
+        page_size=page, num_pages=128, max_inflight=4, max_seq_len=96,
+        pagewire_chunk=0, name="bench-single")
+    single_engine.warmup()
+    router = Router(name="bench-single")
+    router.add_group("lm", lambda version, replica=0: single_engine,
+                     n_replicas=1, warmup=False)
+    try:
+        single_qps, single_p99, single_good, single_fails = _openloop(
+            lambda tk: router.predict("lm", tk, timeout_ms=60_000.0),
+            "single")
+    finally:
+        router.close()
+
+    # -- leg 2: the fleet (real host subprocesses) ---------------------
+    h = FleetHarness(n_decode=n_decode, n_prefill=n_prefill,
+                     page_size=page, max_new=max_new)
+    try:
+        h.wait_ready(timeout_s=240.0)
+        fleet_qps, fleet_p99, fleet_good, fleet_fails = _openloop(
+            lambda tk: h.controller.predict(tk, timeout_ms=60_000.0),
+            "fleet")
+        hits = misses = 0
+        for w in h.workers:
+            if w.proc.poll() is not None or not w.address():
+                continue
+            try:
+                from mxnet_tpu.fleet.worker import EngineClient
+                cli = EngineClient(w.address())
+                try:
+                    pc = dict(cli.request("stats")).get(
+                        "prefix_cache") or {}
+                finally:
+                    cli.close()
+                hits += int(pc.get("hits", 0))
+                misses += int(pc.get("misses", 0))
+            except Exception:  # noqa: BLE001
+                pass
+        ctl = h.controller.describe()
+    finally:
+        h.close()
+    hit_rate = (hits / (hits + misses)) if (hits + misses) else None
+
+    # -- leg 3: availability under host loss ---------------------------
+    kill = run_fleet_drill("kill_decode", n_decode=n_decode,
+                           n_prefill=n_prefill, n_requests=kill_req,
+                           fault_after=max(2, kill_req // 3),
+                           page_size=page, max_new=max_new,
+                           timeout_s=420.0)
+
+    ratio = (fleet_good / single_good
+             if single_good and fleet_good else None)
+    record = dict(
+        metric="mxfleet_slo",
+        decode_hosts=n_decode, prefill_hosts=n_prefill,
+        requests=n_req, offered_qps=rate, slo_ms=slo_ms,
+        prompt_len=prompt_len, max_new_tokens=max_new,
+        single_qps=round(single_qps, 3),
+        single_p99_ms=(round(single_p99, 1)
+                       if single_p99 is not None else None),
+        single_goodput_qps=round(single_good, 3),
+        single_failures=len(single_fails),
+        fleet_qps=round(fleet_qps, 3),
+        fleet_p99_ms=(round(fleet_p99, 1)
+                      if fleet_p99 is not None else None),
+        fleet_goodput_qps=round(fleet_good, 3),
+        fleet_failures=len(fleet_fails),
+        fleet_prefix_hit_rate=(round(hit_rate, 4)
+                               if hit_rate is not None else None),
+        fleet_decode_live=len(ctl.get("decode", [])),
+        kill_requests=kill["requests"],
+        kill_completed=kill["completed"],
+        kill_dropped=kill["dropped"],
+        kill_fault_fired=kill["fault_fired"],
+        fleet_beats_single=(ratio is not None and ratio > 1.0),
+        zero_drop=(kill["dropped"] == 0),
+        platform=devices[0].platform,
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(ratio, unit="fleet/single goodput-QPS-within-SLO ratio",
+          vs=record["fleet_beats_single"], **record)
+
+
 def _parent():
     """Run the bench in a KILLABLE subprocess and own the one-JSON-line
     contract. A SIGALRM watchdog cannot interrupt a hang inside C code
@@ -2222,6 +2392,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
               else "mxpod_recovery"
               if os.environ.get("MXTPU_BENCH_POD") == "1"
+              else "mxfleet_slo"
+              if os.environ.get("MXTPU_BENCH_FLEET") == "1"
               else "mxguard_drill"
               if os.environ.get("MXTPU_BENCH_GUARD") == "1"
               else "mxtrace_overhead"
@@ -2284,6 +2456,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_ELASTIC"] = "1"
     if "--pod" in sys.argv:
         os.environ["MXTPU_BENCH_POD"] = "1"
+    if "--fleet" in sys.argv:
+        os.environ["MXTPU_BENCH_FLEET"] = "1"
     if "--guard" in sys.argv:
         os.environ["MXTPU_BENCH_GUARD"] = "1"
     if "--trace-overhead" in sys.argv:
@@ -2307,6 +2481,7 @@ if __name__ == "__main__":
     _graphopt = os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
     _elastic = os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
     _pod = os.environ.get("MXTPU_BENCH_POD") == "1"
+    _fleet = os.environ.get("MXTPU_BENCH_FLEET") == "1"
     _guard = os.environ.get("MXTPU_BENCH_GUARD") == "1"
     _tracebench = os.environ.get("MXTPU_BENCH_TRACE") == "1"
     _sanbench = os.environ.get("MXTPU_BENCH_SAN") == "1"
@@ -2329,6 +2504,8 @@ if __name__ == "__main__":
                 elastic_main()
             elif _pod:
                 pod_main()
+            elif _fleet:
+                fleet_main()
             elif _guard:
                 guard_main()
             elif _tracebench:
@@ -2349,6 +2526,7 @@ if __name__ == "__main__":
                           else "mxopt_speedup" if _graphopt
                           else "mxelastic_recovery" if _elastic
                           else "mxpod_recovery" if _pod
+                          else "mxfleet_slo" if _fleet
                           else "mxguard_drill" if _guard
                           else "mxtrace_overhead" if _tracebench
                           else "mxsan_overhead" if _sanbench

@@ -709,6 +709,64 @@ register_flag(
     "'0'/'off' = appends disabled (MXTPU_BENCH_STORE=0 is the "
     "bench-side escape hatch).")
 register_flag(
+    "MXFLEET_HEARTBEAT_S", float, 1.0,
+    "Seconds between a fleet engine worker's directory heartbeats to "
+    "the coordinator (fleet.worker.EngineHost). The FleetController "
+    "treats a worker whose last beat is older than 3x this as dead "
+    "and rebuilds the replica group without it; the Router breaker "
+    "already sheds it in the meantime.")
+register_flag(
+    "MXFLEET_AFFINITY", bool, True,
+    "Prefix-affinity routing (fleet.routing): hash the first "
+    "MXFLEET_AFFINITY_PAGES serve2.prefix.page_keys of each prompt "
+    "and prefer the rendezvous-chosen decode worker, so templated "
+    "prompts land where their KV pages already live. Off = pure "
+    "shallowest-queue across hosts. Only consulted inside fleet/ — "
+    "single-host Router behavior is untouched either way.")
+register_flag(
+    "MXFLEET_AFFINITY_PAGES", int, 4,
+    "How many leading page-chain hashes feed the affinity key. "
+    "Small = template-level affinity (shared system prompts "
+    "colocate); large = whole-prompt affinity (less sharing, better "
+    "isolation).")
+register_flag(
+    "MXFLEET_SPILL_FACTOR", float, 2.0,
+    "Affinity spill threshold: the preferred worker is used only "
+    "while its queue depth <= this factor x the shallowest worker's "
+    "depth (+1). Above it the request spills to shallowest-queue — "
+    "cache locality must never buy a convoy. 0 = never spill "
+    "(strict affinity).")
+register_flag(
+    "MXFLEET_PREFILL_DISAGG", bool, True,
+    "Prefill/decode disaggregation (fleet.controller): prompts go to "
+    "a dedicated prefill worker first, which streams the finished KV "
+    "pages to the chosen decode worker over the pagewire before the "
+    "decode request lands (CPU host-transfer path; device-to-device "
+    "is stubbed pending TPU DMA). Requires at least one registered "
+    "prefill-role worker, else requests fall back to direct decode "
+    "(the decode worker prefills locally, exactly the single-host "
+    "path).")
+register_flag(
+    "MXFLEET_PAGEWIRE_CHUNK_PAGES", int, 8,
+    "Pages per pagewire transfer chunk (fleet.pagewire): the "
+    "fixed-shape export/import jit programs move this many KV pages "
+    "per dispatch (warmed by DecodeEngine warmup alongside the "
+    "decode rungs, so streaming never recompiles). Larger = fewer "
+    "dispatches, more padding on the tail chunk.")
+register_flag(
+    "MXFLEET_AUTOSCALE_WINDOW_S", float, 30.0,
+    "Autoscaler observation window (fleet.autoscale.AutoScaler): "
+    "grow/shrink decisions read the decode-phase p99 from the merged "
+    "obs snapshots over this window, with the same span as cooldown "
+    "between actuations (rolling_reload resizes are not free). "
+    "0 = autoscaler disabled.")
+register_flag(
+    "MXFLEET_SLO_P99_MS", float, 0.0,
+    "Decode p99 SLO target in milliseconds for the autoscaler: "
+    "sustained p99 above it grows the group by one replica, p99 "
+    "under half of it (with idle queues) shrinks by one. 0 = no SLO "
+    "-> autoscaler holds (observability-only).")
+register_flag(
     "MXRESIL_WATCHDOG_STALL_S", float, 0.0,
     "Heartbeat age that counts as a stall (resil.watchdog.Watchdog). "
     "0 = auto: 10x the step-time EWMA (min 1 s; 30 s before any step "

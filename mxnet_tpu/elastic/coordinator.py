@@ -120,6 +120,14 @@ class ElasticCoordinator:
         self._dump_epoch = 0
         self._dump_reason = ""
         self._dump_mono = 0.0
+        # -- mxfleet: serving-worker directory (fleet.controller) -----
+        # worker_id -> {role, address, meta, beat (coordinator-clock
+        # mono)}. Deliberately NOT part of the training membership
+        # tracker: engine workers register here without joining the
+        # allreduce group, and a stale entry only costs the controller
+        # one dead-dial (the Router breaker already sheds it).
+        self._fleet: Dict[str, Dict[str, object]] = {}
+        self._fleet_notes: Dict[str, object] = {}
         # -- control-plane journal (coordinator hardening, mxpod) -----
         # One JSON line per generation bump; a restarted rank-0 replays
         # the newest entry so the group RE-FORMS (members restored,
@@ -298,6 +306,71 @@ class ElasticCoordinator:
         """The pod-merged snapshot (None before any push / MXOBS=0)."""
         col = self.obs_collector(create=False)
         return col.merged() if col is not None else None
+
+    # -- mxfleet: serving-worker directory -------------------------
+    # The fleet control plane's source of truth for "which engine
+    # hosts exist, what role each plays, and where to dial them".
+    # Same discipline as the obs channel: quick ops under _cv, no
+    # blocking waits, survives independently of the training
+    # membership tracker.
+
+    def fleet_register(self, worker_id: str, role: str, address: str,
+                       meta=None) -> Dict[str, object]:
+        """An engine worker announces itself (role: 'decode' |
+        'prefill'). Idempotent — a re-register after a worker restart
+        just refreshes the entry."""
+        with self._cv:
+            self._fleet[str(worker_id)] = {
+                "role": str(role), "address": str(address),
+                "meta": dict(meta or {}),
+                "beat": float(self._clock()),
+            }
+            self._cv.notify_all()
+            return {"uid": self.uid, "workers": len(self._fleet)}
+
+    def fleet_heartbeat(self, worker_id: str,
+                        depth=None) -> bool:
+        """Refresh a directory entry's liveness (and optionally its
+        advertised queue depth). Returns False when the worker is not
+        registered — the signal to re-register after a coordinator
+        restart (the directory is NOT journaled; serving workers are
+        expected to outlive it and re-announce)."""
+        with self._cv:
+            ent = self._fleet.get(str(worker_id))
+            if ent is None:
+                return False
+            ent["beat"] = float(self._clock())
+            if depth is not None:
+                ent["meta"]["depth"] = int(depth)
+            return True
+
+    def fleet_leave(self, worker_id: str) -> None:
+        """Graceful directory exit (SIGTERM drain path)."""
+        with self._cv:
+            self._fleet.pop(str(worker_id), None)
+            self._cv.notify_all()
+
+    def fleet_view(self) -> Dict[str, object]:
+        """Snapshot of the directory: entries plus each one's beat
+        age on the COORDINATOR clock (callers must not compare beats
+        against their own clock across hosts)."""
+        with self._cv:
+            now = float(self._clock())
+            workers = {}
+            for wid, ent in self._fleet.items():
+                d = dict(ent)
+                d["meta"] = dict(ent["meta"])
+                d["age_s"] = max(0.0, now - float(ent["beat"]))
+                workers[wid] = d
+            return {"uid": self.uid, "workers": workers,
+                    "notes": dict(self._fleet_notes)}
+
+    def fleet_note(self, key: str, value) -> None:
+        """Controller-published breadcrumbs (last autoscale decision,
+        controller liveness) for fleet_view consumers —
+        tools/diagnose.py's mxfleet section reads these."""
+        with self._cv:
+            self._fleet_notes[str(key)] = value
 
     def _obs_retire(self, worker_id: str) -> None:
         """Host left the membership plane: drop its snapshot and

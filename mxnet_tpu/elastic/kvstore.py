@@ -130,6 +130,24 @@ class RemoteGroup:
     def obs_request_dump(self, reason="requested"):
         return self._req("obs_request_dump", reason=str(reason))
 
+    # -- mxfleet serving-worker directory ops --------------------------
+    def fleet_register(self, worker_id, role, address, meta=None):
+        return self._req("fleet_register", worker_id=worker_id,
+                         role=role, address=address, meta=meta)
+
+    def fleet_heartbeat(self, worker_id, depth=None):
+        return self._req("fleet_heartbeat", worker_id=worker_id,
+                         depth=depth)
+
+    def fleet_leave(self, worker_id):
+        return self._req("fleet_leave", worker_id=worker_id)
+
+    def fleet_view(self):
+        return self._req("fleet_view")
+
+    def fleet_note(self, key, value=None):
+        return self._req("fleet_note", key=key, value=value)
+
     def close(self):
         self._client.close()
 

@@ -1,0 +1,45 @@
+"""mxfleet: pod-scale disaggregated serving (PR 18).
+
+The serving control plane layered over what already exists — it owns
+no model math and no transport primitives of its own:
+
+- **replica groups over pod hosts** (:mod:`.controller`): a
+  :class:`~mxnet_tpu.fleet.controller.FleetController` rides the
+  journaled coordinator's fleet directory (``fleet_register`` /
+  ``fleet_view`` ops over the PodGroup typed-fence transport) and
+  fronts one serve2 :class:`~mxnet_tpu.serve2.router.Router` group of
+  :class:`~mxnet_tpu.fleet.controller.RemoteEngine` proxies, so the
+  shallowest-queue + breaker + failover semantics extend across host
+  processes unchanged — a SIGKILLed host surfaces as
+  ``EngineCrashedError``, breaker-marks, and the request retries on a
+  live host (zero in-flight-accepted drops, drill-enforced);
+- **prefill/decode disaggregation** (:mod:`.pagewire`,
+  :mod:`.worker`): dedicated prefill workers compute prompts and
+  stream the finished KV pages (serve3's quantized-page pool planes,
+  ``PagedLM.export_pages``/``import_pages``) to the chosen decode
+  worker over the framed-pickle socket wire — CPU host-transfer path;
+  the TPU device-to-device DMA is stubbed;
+- **prefix-affinity routing** (:mod:`.routing`): the
+  ``serve2.prefix.page_keys`` chain hash (deterministic across
+  processes — test-enforced) keys a rendezvous pick, so templated
+  prompts land where their pages already live; the Router's
+  ``prefer=`` mechanism applies it with a spill cap
+  (MXFLEET_SPILL_FACTOR) so locality never buys a convoy;
+- **SLO autoscaling** (:mod:`.autoscale`): grow/shrink decisions from
+  the obs-merged ``mxtrace_phase_decode_seconds`` p99 against
+  MXFLEET_SLO_P99_MS, actuated through
+  ``Router.rolling_reload(n_replicas=...)``.
+
+Flags-off (no ``MXFLEET_*`` set, nothing from this package imported)
+the serving path is bit-for-bit the PR 11 single-host router: the
+only serve2/ changes are default-``None`` keyword arguments and a
+default-0 warmup chunk.  See docs/fleet.md.
+"""
+from .autoscale import AutoScaler
+from .controller import FleetController, RemoteEngine
+from .routing import affinity_key, rendezvous_pick, spill_cap
+from .worker import EngineClient, EngineHost
+
+__all__ = ["AutoScaler", "FleetController", "RemoteEngine",
+           "EngineClient", "EngineHost", "affinity_key",
+           "rendezvous_pick", "spill_cap"]

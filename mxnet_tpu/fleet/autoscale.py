@@ -1,0 +1,164 @@
+"""SLO-driven autoscaling over the obs-merged phase histograms.
+
+The decision loop is deliberately small and fully injectable (clock,
+metric source, actuator) so the fast tier can drive it with a fake
+clock and canned snapshots:
+
+- **signal**: the fleet-merged ``mxtrace_phase_decode_seconds`` p99
+  (PR 17's obs collector merges each host's reservoir; PR 10's trace
+  phase histograms feed it) — the decode-tick latency users feel;
+- **policy**: sustained p99 above MXFLEET_SLO_P99_MS grows the group
+  by one replica; p99 under HALF the SLO with idle queues shrinks by
+  one — the half-SLO hysteresis band plus a full
+  MXFLEET_AUTOSCALE_WINDOW_S cooldown between actuations keeps the
+  loop from flapping (a resize is a rolling_reload, not free);
+- **actuator**: any ``(n_replicas) -> report`` callable — in the
+  fleet that's ``FleetController.resize`` →
+  ``Router.rolling_reload(n_replicas=...)``.
+
+SLO unset (MXFLEET_SLO_P99_MS=0, the default) = observability-only:
+every tick records a ``hold`` decision with the measured p99, which
+tools/diagnose.py surfaces, and nothing actuates.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from ..san.runtime import make_lock
+from ..telemetry import metrics as _metrics
+
+__all__ = ["AutoScaler", "p99_ms_from_merged"]
+
+DECODE_PHASE_METRIC = "mxtrace_phase_decode_seconds"
+
+
+def p99_ms_from_merged(doc: Optional[Dict],
+                       metric: str = DECODE_PHASE_METRIC
+                       ) -> Optional[float]:
+    """Pull a phase p99 (milliseconds) out of an obs ``merged()``
+    doc; None when the metric has no samples yet."""
+    if not doc:
+        return None
+    ent = (doc.get("merged") or {}).get(metric)
+    if not isinstance(ent, dict):
+        return None
+    p99 = ent.get("p99")
+    return float(p99) * 1e3 if p99 is not None else None
+
+
+class AutoScaler:
+    """See module docstring.
+
+    ``source`` returns ``{"p99_ms": float|None, "depth": int,
+    "replicas": int}`` per tick (see :meth:`obs_source` for the
+    standard obs-collector adapter); ``actuator(n)`` resizes."""
+
+    def __init__(self, source: Callable[[], Dict],
+                 actuator: Callable[[int], object], *,
+                 slo_p99_ms: Optional[float] = None,
+                 window_s: Optional[float] = None,
+                 min_replicas: int = 1, max_replicas: int = 64,
+                 clock: Callable[[], float] = time.monotonic,
+                 note: Optional[Callable[[str, Dict], None]] = None):
+        from .. import config
+        self.source = source
+        self.actuator = actuator
+        # optional breadcrumb publisher — wired to the directory's
+        # fleet_note so tools/diagnose.py can show the last decision
+        # from OUTSIDE the controller process
+        self.note = note
+        self.slo_p99_ms = float(
+            slo_p99_ms if slo_p99_ms is not None
+            else config.get("MXFLEET_SLO_P99_MS"))
+        self.window_s = float(
+            window_s if window_s is not None
+            else config.get("MXFLEET_AUTOSCALE_WINDOW_S"))
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self._clock = clock
+        self._lock = make_lock("fleet.autoscale")
+        self._last_action_mono: Optional[float] = None
+        self._last: Dict = {"decision": "hold", "reason": "no ticks",
+                            "p99_ms": None, "ts": None}
+        self._m_grow = _metrics.counter(
+            "mxfleet_autoscale_grow_total",
+            "fleet group grow actuations")
+        self._m_shrink = _metrics.counter(
+            "mxfleet_autoscale_shrink_total",
+            "fleet group shrink actuations")
+
+    @staticmethod
+    def obs_source(group, router_stats: Callable[[], Dict]):
+        """The standard signal adapter: p99 from the coordinator's
+        obs-merged doc, depth/replicas from the Router."""
+        def _src() -> Dict:
+            try:
+                doc = group.obs_merged()
+            except Exception:  # noqa: BLE001 — no signal = hold
+                doc = None
+            st = router_stats()
+            reps = next(iter(st.get("models", {}).values()),
+                        {"replicas": []})["replicas"]
+            return {"p99_ms": p99_ms_from_merged(doc),
+                    "depth": sum(int(r.get("depth", 0))
+                                 for r in reps),
+                    "replicas": len(reps)}
+        return _src
+
+    def tick(self) -> Dict:
+        """One observe-decide-(actuate) cycle. Returns the decision
+        record (also kept for :meth:`last_decision`)."""
+        obs = self.source() or {}
+        p99 = obs.get("p99_ms")
+        depth = int(obs.get("depth") or 0)
+        replicas = int(obs.get("replicas") or 0)
+        now = self._clock()
+        decision, reason, target = "hold", "", replicas
+        if self.slo_p99_ms <= 0 or self.window_s <= 0:
+            reason = "no SLO configured (MXFLEET_SLO_P99_MS=0)"
+        elif p99 is None:
+            reason = "no decode-phase samples yet"
+        elif self._last_action_mono is not None and \
+                now - self._last_action_mono < self.window_s:
+            reason = (f"cooldown "
+                      f"({now - self._last_action_mono:.1f}s of "
+                      f"{self.window_s:g}s)")
+        elif p99 > self.slo_p99_ms and replicas < self.max_replicas:
+            decision, target = "grow", replicas + 1
+            reason = (f"p99 {p99:.1f}ms > SLO "
+                      f"{self.slo_p99_ms:g}ms")
+        elif p99 < 0.5 * self.slo_p99_ms and depth == 0 \
+                and replicas > self.min_replicas:
+            decision, target = "shrink", replicas - 1
+            reason = (f"p99 {p99:.1f}ms < half-SLO with idle queues")
+        else:
+            reason = f"p99 {p99:.1f}ms within band" if p99 is not None \
+                else "steady"
+        record = {"decision": decision, "reason": reason,
+                  "p99_ms": p99, "depth": depth,
+                  "replicas": replicas, "target": target,
+                  "ts": time.time()}
+        if decision != "hold":
+            try:
+                self.actuator(target)
+                self._last_action_mono = now
+                (self._m_grow if decision == "grow"
+                 else self._m_shrink).inc()
+            except Exception as e:  # noqa: BLE001 — a failed resize
+                # must not kill the decision loop
+                record["decision"] = "hold"
+                record["reason"] = (f"{decision} failed: "
+                                    f"{str(e)[:120]}")
+        with self._lock:
+            self._last = record
+        if self.note is not None:
+            try:
+                self.note("autoscale", record)
+            except Exception:  # noqa: BLE001 — breadcrumbs only
+                pass
+        return record
+
+    def last_decision(self) -> Dict:
+        with self._lock:
+            return dict(self._last)

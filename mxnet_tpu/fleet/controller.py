@@ -1,0 +1,380 @@
+"""FleetController: one serve2 Router group whose replicas live in
+OTHER PROCESSES.
+
+:class:`RemoteEngine` is the engine duck type
+(``predict/warmup/warmed/queue_depth/stats/drain/close``) over an
+:class:`~mxnet_tpu.fleet.worker.EngineClient` — the Router can't tell
+it from a local DecodeEngine.  The transport failure contract is the
+whole point: a SIGKILLed host surfaces as ``EngineCrashedError``, the
+Router breaker-marks the replica and retries the FULL prompt on the
+next host (greedy decode is deterministic, so the retry is
+bit-identical) — zero in-flight-accepted drops, the same invariant
+the single-host rolling-reload soak enforces, now across hosts.
+
+The controller itself is policy glue:
+
+- **membership**: :meth:`sync` reads the coordinator's fleet
+  directory (``fleet_view``), drops entries whose heartbeat age
+  exceeds 3x MXFLEET_HEARTBEAT_S, and when the live decode set
+  changed, resizes/rebuilds the Router group through
+  ``rolling_reload(n_replicas=...)`` — replica ``i`` proxies decode
+  worker ``i`` in sorted-id order, so the mapping is deterministic;
+- **affinity** (:mod:`.routing`): per request, the page-chain
+  affinity key rendezvous-picks a decode worker; the Router's
+  ``prefer=`` tries it first, capped by the spill threshold computed
+  from the directory's advertised depths;
+- **disaggregation**: with prefill workers registered and
+  MXFLEET_PREFILL_DISAGG on, the prompt goes to a prefill worker
+  first (rendezvous by the same key, so ITS cache warms per template
+  too), which streams the finished KV pages to the chosen decode
+  worker (:mod:`.pagewire`) before the decode request lands.  Any
+  failure in that leg just skips it — the decode worker prefills
+  locally, which is exactly the single-host path.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..base import get_logger
+from ..san.runtime import make_lock
+from ..serve2.router import AllReplicasUnavailable, Router
+from ..serve2.scheduler import EngineCrashedError
+from ..telemetry import metrics as _metrics
+from . import routing as _routing
+from .worker import EngineClient
+
+__all__ = ["FleetController", "RemoteEngine"]
+
+_log = get_logger("mxnet_tpu.fleet")
+
+
+class RemoteEngine:
+    """Engine duck type over one fleet worker's socket wire.
+
+    A small CONNECTION POOL, not one socket: a remote predict holds
+    its connection for the whole generation, and the worker's
+    scheduler batches concurrent requests — one shared socket would
+    serialize them and throw the engine's continuous batching away.
+    A connection that fails is closed, never pooled again."""
+
+    POOL_MAX = 8
+
+    def __init__(self, address: str, name: str = "remote"):
+        self.address = address
+        self.name = name
+        self._lock = make_lock("fleet.controller.remote")
+        self._pool: List[EngineClient] = []
+
+    def _acquire(self) -> EngineClient:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return EngineClient(self.address)
+
+    def _release(self, cli: EngineClient):
+        with self._lock:
+            if len(self._pool) < self.POOL_MAX:
+                self._pool.append(cli)
+                return
+        cli.close()
+
+    def _request(self, op: str, **kw):
+        cli = self._acquire()
+        try:
+            value = cli.request(op, **kw)
+        except BaseException:
+            cli.close()
+            raise
+        self._release(cli)
+        return value
+
+    def predict(self, data, timeout_ms: Optional[float] = None):
+        tokens = [int(t) for t in _flat(data)]
+        try:
+            return self._request("predict", tokens=tokens,
+                                 timeout_ms=timeout_ms)
+        except (OSError, EOFError, ConnectionError) as e:
+            # host gone mid-request: the Router treats this exactly
+            # like a crashed local scheduler — breaker mark + retry
+            # the full prompt on another replica
+            raise EngineCrashedError(
+                f"fleet worker {self.address} unreachable: {e}") from e
+
+    def queue_depth(self) -> int:
+        try:
+            return int(self._request("depth"))
+        except Exception:  # noqa: BLE001 — a dead host sorts last;
+            # the predict attempt will type the failure properly
+            return 1 << 20
+
+    @property
+    def warmed(self) -> bool:
+        return True  # workers warm themselves before registering
+
+    def warmup(self, input_specs=None):
+        return []
+
+    def stats(self) -> dict:
+        try:
+            return dict(self._request("stats"))
+        except Exception:  # noqa: BLE001
+            return {"name": self.name, "unreachable": True}
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        # PROXY-local, deliberately: the Router drains a replica
+        # before retiring it, but retiring this proxy must NOT stop
+        # the remote batcher — the worker outlives group membership
+        # (it may be re-proxied under a new replica slot one sync
+        # later, and other controllers may be serving through it).
+        # In-flight predicts hold their own acquired sockets and the
+        # old proxy object, so they complete regardless of when the
+        # Router drops its reference.  The wire-level "drain" op
+        # stays for the worker's OWN shutdown path (SIGTERM/harness).
+        return True
+
+    def close(self):
+        # closes the PROXY's sockets only — worker lifecycle belongs
+        # to the drill/bench harness, not the router
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for cli in pool:
+            cli.close()
+
+
+def _flat(data):
+    import numpy as onp
+    arr = onp.asarray(data)
+    if arr.ndim == 2 and arr.shape[0] == 1:
+        arr = arr[0]
+    return arr.reshape(-1)
+
+
+class FleetController:
+    """See module docstring. ``group`` is the coordinator transport
+    (PodGroup/RemoteGroup); ``page_size`` must match the workers'."""
+
+    MODEL = "fleet"
+
+    def __init__(self, group, *, page_size: int,
+                 heartbeat_s: Optional[float] = None,
+                 sync_interval_s: Optional[float] = None):
+        from .. import config
+        self.group = group
+        self.page_size = int(page_size)
+        self.heartbeat_s = float(
+            heartbeat_s if heartbeat_s is not None
+            else config.get("MXFLEET_HEARTBEAT_S"))
+        self.sync_interval_s = float(
+            sync_interval_s if sync_interval_s is not None
+            else self.heartbeat_s)
+        self.router = Router(name="fleet")
+        self._lock = make_lock("fleet.controller.sync")
+        self._decode: List[Dict] = []   # sorted by worker id
+        self._prefill: List[Dict] = []
+        self._depths: Dict[str, int] = {}
+        self._synced_mono = 0.0
+        self._m_requests = _metrics.counter(
+            "mxfleet_requests_total",
+            "requests routed through the fleet controller")
+        self._m_affinity = _metrics.counter(
+            "mxfleet_affinity_routed_total",
+            "requests routed to their prefix-affinity worker")
+        self._m_disagg = _metrics.counter(
+            "mxfleet_prefill_disagg_total",
+            "requests whose prefill ran on a dedicated prefill worker")
+        self._m_disagg_miss = _metrics.counter(
+            "mxfleet_prefill_fallback_total",
+            "requests that fell back to local prefill (no prefill "
+            "worker / push failed)")
+
+    # -- membership ----------------------------------------------------
+    def sync(self, force: bool = False) -> Dict:
+        """Pull the fleet directory and converge the Router group on
+        the live decode workers. Cheap when nothing changed."""
+        with self._lock:
+            now = time.monotonic()
+            if not force and self._decode \
+                    and now - self._synced_mono < self.sync_interval_s:
+                return {"decode": len(self._decode),
+                        "prefill": len(self._prefill)}
+            view = self.group.fleet_view()
+            stale = 3.0 * self.heartbeat_s
+            decode, prefill, depths = [], [], {}
+            for wid in sorted(view.get("workers", {})):
+                ent = view["workers"][wid]
+                if float(ent.get("age_s", 0.0)) > stale:
+                    continue
+                rec = {"wid": wid, "address": ent["address"]}
+                depths[wid] = int(ent.get("meta", {})
+                                  .get("depth", 0) or 0)
+                if ent.get("role") == "prefill":
+                    prefill.append(rec)
+                else:
+                    decode.append(rec)
+            self._synced_mono = now
+            if decode:
+                self._prefill = prefill
+                self._depths = depths
+                if [d["wid"] for d in decode] != \
+                        [d["wid"] for d in self._decode]:
+                    self._decode = decode
+                    self._rebuild_group()
+                else:
+                    self._decode = decode
+            # no live decode entries = a directory outage or the
+            # pre-re-announce window after a coordinator restart:
+            # keep the LAST-KNOWN membership picture whole (group,
+            # depths, prefill) — the proxies still serve, and
+            # describe() must not contradict that
+            return {"decode": len(decode), "prefill": len(prefill)}
+
+    def _rebuild_group(self):
+        """Converge the Router group on self._decode (under _lock).
+        Replica i proxies decode worker i; rolling_reload keeps the
+        swap zero-downtime and doubles as the resize actuator."""
+        def factory(version, replica):
+            ent = self._decode[replica]
+            return RemoteEngine(ent["address"],
+                                name=f"fleet/{ent['wid']}")
+        n = len(self._decode)
+        if self.MODEL not in self.router.models():
+            self.router.add_group(self.MODEL, factory, n_replicas=n,
+                                  warmup=False)
+        else:
+            grp = self.router._group(self.MODEL)
+            grp.factory = factory
+            self.router.rolling_reload(self.MODEL, n_replicas=n)
+        _log.info("fleet group converged on %d decode workers: %s",
+                  n, [d["wid"] for d in self._decode])
+
+    def resize(self, n_replicas: int) -> dict:
+        """The autoscale actuator: resize the Router group. The fleet
+        can only shrink below its registered worker count (proxies are
+        dropped, workers stay up for the next grow) — growing beyond
+        it requires more registered hosts, so the target is capped."""
+        with self._lock:
+            n = max(1, min(int(n_replicas), len(self._decode)))
+            report = self.router.rolling_reload(self.MODEL,
+                                                n_replicas=n)
+        try:
+            self.group.fleet_note("last_resize", {
+                "target": n, "ts": time.time()})
+        except Exception:  # noqa: BLE001 — breadcrumbs only
+            pass
+        return report
+
+    # retry cadence when every replica refused: re-sync the directory
+    # (the refusals may reflect a membership change we haven't
+    # converged on yet) and back off briefly before the next pass
+    RETRY_BACKOFF_S = 0.2
+    DEFAULT_RETRY_BUDGET_S = 15.0
+
+    # -- serving -------------------------------------------------------
+    def predict(self, data, timeout_ms: Optional[float] = None):
+        """Route one request.  ``AllReplicasUnavailable`` is absorbed
+        with bounded retries inside the request's deadline budget: a
+        host loss opens a breaker window / membership-rebuild window
+        during which one Router pass can find every replica refusing,
+        but an ACCEPTED request must ride that out — the zero-drop
+        invariant the fleet drill enforces."""
+        self._m_requests.inc()
+        deadline = time.monotonic() + (
+            float(timeout_ms) / 1e3 if timeout_ms is not None
+            else self.DEFAULT_RETRY_BUDGET_S)
+        while True:
+            try:
+                return self._predict_once(data, timeout_ms=timeout_ms)
+            except AllReplicasUnavailable:
+                if time.monotonic() + self.RETRY_BACKOFF_S >= deadline:
+                    raise
+                time.sleep(self.RETRY_BACKOFF_S)
+                try:
+                    self.sync(force=True)
+                except Exception:  # noqa: BLE001 — directory outage
+                    # must not turn a retryable refusal into a crash;
+                    # the next Router pass uses the last-known group
+                    pass
+
+    def _predict_once(self, data, timeout_ms: Optional[float] = None):
+        from .. import config
+        self.sync()
+        tokens = [int(t) for t in _flat(data)]
+        prefer = None
+        cap = None
+        target = None
+        with self._lock:
+            decode = list(self._decode)
+            prefill = list(self._prefill)
+            depths = dict(self._depths)
+        key = None
+        if bool(config.get("MXFLEET_AFFINITY")) and decode:
+            key = _routing.affinity_key(tokens, self.page_size)
+        if key is not None:
+            wids = [d["wid"] for d in decode]
+            pick = _routing.rendezvous_pick(key, wids)
+            if pick is not None:
+                idx = wids.index(pick)
+                target = decode[idx]
+                prefer = f"{self.MODEL}/r{idx}"
+                shallowest = min(
+                    (depths.get(w, 0) for w in wids), default=0)
+                cap = _routing.spill_cap(shallowest)
+                self._m_affinity.inc()
+        if bool(config.get("MXFLEET_PREFILL_DISAGG")) and prefill \
+                and len(tokens) >= self.page_size:
+            self._push_prefill(tokens, key, prefill,
+                               target or (decode[0] if decode
+                                          else None))
+        return self.router.predict(self.MODEL, tokens,
+                                   timeout_ms=timeout_ms,
+                                   prefer=prefer,
+                                   prefer_max_depth=cap)
+
+    def _push_prefill(self, tokens, key, prefill, target):
+        """Disaggregation leg: prefill on a dedicated worker, pages
+        streamed to the chosen decode worker. Best-effort — every
+        failure path is a silent local-prefill fallback."""
+        if target is None:
+            self._m_disagg_miss.inc()
+            return
+        wids = [p["wid"] for p in prefill]
+        pick = _routing.rendezvous_pick(key or bytes(8), wids)
+        ent = prefill[wids.index(pick)]
+        try:
+            cli = EngineClient(ent["address"])
+            try:
+                cli.request("prefill_push", tokens=tokens,
+                            dst=target["address"])
+            finally:
+                cli.close()
+            self._m_disagg.inc()
+        except Exception:  # noqa: BLE001 — optimization only
+            self._m_disagg_miss.inc()
+
+    # -- introspection -------------------------------------------------
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "decode": [dict(d) for d in self._decode],
+                "prefill": [dict(p) for p in self._prefill],
+                "depths": dict(self._depths),
+                "router": self.router.stats(),
+            }
+
+    def heartbeat_note(self):
+        """Publish controller liveness into the directory notes (the
+        tools/diagnose.py mxfleet section reads it)."""
+        try:
+            self.group.fleet_note("controller", {
+                "ts": time.time(),
+                "decode": len(self._decode),
+                "prefill": len(self._prefill)})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def close(self):
+        try:
+            self.router.close()
+        except Exception:  # noqa: BLE001
+            pass

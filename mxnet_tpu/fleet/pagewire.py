@@ -1,0 +1,148 @@
+"""The pagewire: KV-page streaming between engine hosts.
+
+Send side (a prefill worker): the prompt was just prefilled through
+the PUBLIC engine path (``submit(prompt, max_new_tokens=1)``), so its
+full pages sit in the worker's own prefix cache under their chain
+keys.  :func:`collect_pages` pins them (``PrefixCache.lookup`` —
+caller-owned refs) and :func:`export_chunks` gathers their pool
+planes (K, V, int8 scales) through ``PagedLM.export_pages`` in
+fixed-size chunks — one warmed jit program per chunk size, never a
+recompile mid-stream.
+
+Receive side (the chosen decode worker): :func:`install_chunks`
+allocates pages, scatters the planes in through
+``PagedLM.import_pages``, registers the chain keys in the local
+prefix cache, and drops its own allocation refs — exactly the
+refcount dance of a local admission, so the page-accounting audit
+stays clean.  Installation is an OPTIMIZATION: any failure (pool
+pressure, size mismatch, a dead sender) installs nothing and the
+decode worker simply prefills the prompt locally — correctness never
+depends on the wire.
+
+Chunk padding contract (both sides): a short tail repeats the FINAL
+real page index, never page 0 — the null page's content is scratch,
+and a duplicate index carries a duplicate plane row so whichever
+scatter write wins is the same value.
+
+This is the CPU host-transfer path (numpy planes over the
+framed-pickle socket wire).  On TPU the planes should move
+device-to-device (ICI DMA) without touching the host — stubbed until
+a multi-host device mesh exists in CI: :func:`device_transfer_stub`
+raises with the design note.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..telemetry import metrics as _metrics
+
+__all__ = ["collect_pages", "export_chunks", "install_chunks",
+           "device_transfer_stub"]
+
+_m_pages_sent = _metrics.counter(
+    "mxfleet_pagewire_pages_sent_total",
+    "KV pages exported onto the pagewire by prefill workers")
+_m_pages_installed = _metrics.counter(
+    "mxfleet_pagewire_pages_installed_total",
+    "KV pages installed from the pagewire into a decode worker's "
+    "prefix cache")
+_m_install_skips = _metrics.counter(
+    "mxfleet_pagewire_install_skips_total",
+    "pagewire installs skipped whole (pool pressure / shape "
+    "mismatch) — the decode worker prefills locally instead")
+
+
+def collect_pages(engine, tokens: Sequence[int]
+                  ) -> Tuple[List[bytes], List[int]]:
+    """Pin the cached pages of ``tokens``' full-page prefix in
+    ``engine``'s prefix cache.  Returns ``(keys, pages)`` of equal
+    length (the cached-coverage prefix of the chain); the caller owns
+    one allocator ref per page and MUST ``engine.alloc.free(pages)``
+    after exporting."""
+    from ..serve2.prefix import page_keys
+    if engine.prefix is None:
+        return [], []
+    keys = page_keys(tokens, engine.page_size)
+    pages = engine.prefix.lookup(keys)
+    return keys[:len(pages)], pages
+
+
+def export_chunks(lm, pages: Sequence[int], chunk: int
+                  ) -> List[Tuple[int, Dict[str, onp.ndarray]]]:
+    """Gather ``pages``' pool planes in fixed-``chunk`` dispatches.
+    Returns ``[(real_count, planes), ...]`` ready for the wire."""
+    chunk = int(chunk)
+    if chunk < 1:
+        raise MXNetError("pagewire chunk must be >= 1")
+    out = []
+    for s in range(0, len(pages), chunk):
+        part = list(pages[s:s + chunk])
+        count = len(part)
+        padded = part + [part[-1]] * (chunk - count)
+        out.append((count, lm.export_pages(padded)))
+        _m_pages_sent.inc(count)
+    return out
+
+
+def install_chunks(engine, keys: Sequence[bytes],
+                   chunks: Sequence[Tuple[int, Dict[str, onp.ndarray]]],
+                   chunk: int) -> int:
+    """Install streamed planes under ``keys`` in ``engine``'s prefix
+    cache (the receive side).  All-or-nothing: returns the number of
+    pages installed, 0 when the install was skipped (no cache, pool
+    pressure, or a count mismatch).  Safe against the live scheduler —
+    cache, allocator, and pool dispatch all carry their own locks."""
+    cache = engine.prefix
+    if cache is None or not keys:
+        return 0
+    n = len(keys)
+    if sum(c for c, _ in chunks) != n:
+        _m_install_skips.inc()
+        return 0
+    # the sender probed our coverage before exporting, but a
+    # concurrent local admission may have cached some of these keys
+    # since; register() would keep the existing entries anyway, so an
+    # overlapping install only wastes wire+import work — skip it and
+    # let the (rare) race resolve as a local prefill
+    if any(cache.find(k) is not None for k in keys):
+        _m_install_skips.inc()
+        return 0
+    alloc = engine.alloc
+    if not alloc.can_alloc(n):
+        _m_install_skips.inc()
+        return 0
+    pages = alloc.alloc(n)
+    dst = list(pages)
+    pos = 0
+    try:
+        for count, planes in chunks:
+            part = dst[pos:pos + count]
+            padded = part + [part[-1]] * (int(chunk) - count)
+            engine.lm.import_pages(padded, planes)
+            pos += count
+    except Exception:
+        alloc.free(pages)
+        _m_install_skips.inc()
+        raise
+    cache.register(list(keys), dst)
+    alloc.free(pages)
+    _m_pages_installed.inc(n)
+    return n
+
+
+def device_transfer_stub(*_a, **_k):
+    """TPU device-to-device page transfer — NOT implemented.
+
+    On a multi-host TPU mesh the planes should move over ICI via a
+    device-resident collective permute (source worker's pool slice ->
+    destination worker's pool slice) without a host round-trip; CI has
+    a single CPU host, so the pagewire ships numpy planes over the
+    socket wire instead.  Raises so a misconfigured TPU deployment
+    fails loudly rather than silently staging through host memory."""
+    raise NotImplementedError(
+        "pagewire device-to-device transfer is stubbed: CPU CI ships "
+        "planes over the socket wire; wire up an ICI collective "
+        "permute before enabling this path on a TPU pod")

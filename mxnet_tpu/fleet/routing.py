@@ -1,0 +1,80 @@
+"""Prefix-affinity routing policy (mechanism lives in serve2.Router).
+
+The affinity key of a prompt is one of its ``serve2.prefix.page_keys``
+chain hashes: hash ``i`` commits to every token of pages ``0..i``, so
+the key of page ``MXFLEET_AFFINITY_PAGES - 1`` identifies the whole
+leading template.  Two prompts sharing that template share the key,
+rendezvous-hash to the same decode worker, and the second one finds
+its KV pages already in that worker's prefix cache — PR 11's
+per-engine cache made fleet-wide without any shared state.
+
+Rendezvous (highest-random-weight) hashing rather than a modulo ring:
+adding or removing one worker remaps only the keys that pointed AT the
+departed worker, so a host loss doesn't shuffle the whole fleet's
+cache locality.  Everything here is pure policy over SHA-1 digests —
+deterministic across interpreter processes (page_keys never touches
+the salted builtin ``hash()``; test_fleet enforces cross-process
+stability).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence
+
+from ..serve2.prefix import page_keys
+
+__all__ = ["affinity_key", "rendezvous_pick", "spill_cap"]
+
+
+def affinity_key(tokens: Sequence[int], page_size: int,
+                 n_pages: Optional[int] = None) -> Optional[str]:
+    """The prompt's affinity key: the chain hash of its
+    ``min(n_pages, full_pages)``-th page, or None for prompts shorter
+    than one page (no cacheable prefix — route by queue depth
+    alone)."""
+    if n_pages is None:
+        from .. import config
+        n_pages = int(config.get("MXFLEET_AFFINITY_PAGES"))
+    keys = page_keys(tokens, page_size)
+    if not keys:
+        return None
+    return keys[:max(1, int(n_pages))][-1]
+
+
+def _hexkey(key) -> str:
+    return key.hex() if isinstance(key, (bytes, bytearray)) \
+        else str(key)
+
+
+def rendezvous_pick(key, workers: Sequence[str]) -> Optional[str]:
+    """Highest-random-weight pick of one worker id for ``key``
+    (bytes digest or str). Deterministic in (key, worker set) and
+    independent of the sequence's order."""
+    if not workers:
+        return None
+    k = _hexkey(key)
+    return max(sorted(workers), key=lambda w: hashlib.sha1(
+        f"{k}|{w}".encode()).digest())
+
+
+def rendezvous_rank(key, workers: Sequence[str]) -> List[str]:
+    """All workers, best-first — the failover order that preserves
+    affinity stability when the first choice is saturated."""
+    k = _hexkey(key)
+    return sorted(sorted(workers), key=lambda w: hashlib.sha1(
+        f"{k}|{w}".encode()).digest(), reverse=True)
+
+
+def spill_cap(shallowest_depth: int,
+              factor: Optional[float] = None) -> Optional[int]:
+    """Translate MXFLEET_SPILL_FACTOR into the Router's absolute
+    ``prefer_max_depth``: the preferred worker keeps the request while
+    its depth <= factor * shallowest + 1.  ``factor == 0`` means never
+    spill (strict affinity), returned as None — the Router's
+    unconditional-prefer value."""
+    if factor is None:
+        from .. import config
+        factor = float(config.get("MXFLEET_SPILL_FACTOR"))
+    if factor <= 0:
+        return None
+    return int(factor * max(0, int(shallowest_depth)) + 1)

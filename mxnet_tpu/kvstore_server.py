@@ -136,6 +136,13 @@ class KVServer:
         self._listener.listen(num_workers + 4)
         self._stopping = False
         self._threads = []
+        # live accepted connections: stop() must sever them, both so a
+        # restarted server can rebind the port (an ESTABLISHED socket
+        # on the same addr blocks bind even with SO_REUSEADDR) and so
+        # clients fail over to the NEW server instead of silently
+        # talking to a stopped one's threads
+        self._conns: list = []
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -147,6 +154,11 @@ class KVServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                if self._stopping:
+                    conn.close()
+                    continue
+                self._conns.append(conn)
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
@@ -173,6 +185,11 @@ class KVServer:
             pass
         finally:
             conn.close()
+            with self._conns_lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
             if participated and not clean and not self._stopping:
                 # abnormal disconnect: wake barrier waiters with failure
                 with self._barrier_cv:
@@ -256,6 +273,20 @@ class KVServer:
             return co.obs_merged()
         if op == "obs_request_dump":
             return co.request_dump(kw.get("reason") or "requested")
+        if op == "fleet_register":
+            return co.fleet_register(kw["worker_id"], kw["role"],
+                                     kw["address"], kw.get("meta"))
+        if op == "fleet_heartbeat":
+            return co.fleet_heartbeat(kw["worker_id"],
+                                      kw.get("depth"))
+        if op == "fleet_leave":
+            co.fleet_leave(kw["worker_id"])
+            return None
+        if op == "fleet_view":
+            return co.fleet_view()
+        if op == "fleet_note":
+            co.fleet_note(kw["key"], kw.get("value"))
+            return None
         raise MXNetError(f"unknown elastic op {op!r}")
 
     def _handle(self, cmd: str, key, payload):
@@ -366,6 +397,17 @@ class KVServer:
             self._listener.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), []
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 def _int_key(k):

@@ -90,7 +90,11 @@ def lint_serve_report(rep: dict) -> List[Finding]:
                  # the prefix-cache leg)
                  "verify": set(rep.get("verify_rungs") or ()),
                  "prefill_ext": prefill_ext_rungs,
-                 "copy_page": {0} if prefill_ext_rungs else set()}
+                 "copy_page": {0} if prefill_ext_rungs else set(),
+                 # mxfleet pagewire: export/import compile per
+                 # streaming chunk size, warmed alongside the rungs
+                 "export_pages": set(rep.get("pagewire_rungs") or ()),
+                 "import_pages": set(rep.get("pagewire_rungs") or ())}
     for kind, size in compiled:
         rungs = rung_sets.get(kind)
         if rungs is None:

@@ -46,7 +46,9 @@ _log = get_logger("mxnet_tpu.pod")
 # stay on the server-side deadline (ElasticTimeout).
 _QUICK_OPS = frozenset(("register", "heartbeat", "leave", "mark_lost",
                         "view", "announce_join", "describe",
-                        "obs_push", "obs_merged", "obs_request_dump"))
+                        "obs_push", "obs_merged", "obs_request_dump",
+                        "fleet_register", "fleet_heartbeat",
+                        "fleet_leave", "fleet_view", "fleet_note"))
 
 
 class CoordinatorLost(MXNetError):

@@ -208,11 +208,18 @@ class PagedLM:
         self._copy_page_jit = jax.jit(
             self._copy_page_fn,
             donate_argnums=(0,) if self.donate_pages else ())
+        # pagewire: the export gather must NOT donate (the pool stays
+        # live); the import scatter donates like every pool update
+        self._export_pages_jit = jax.jit(self._export_pages_fn)
+        self._import_pages_jit = jax.jit(
+            self._import_pages_fn,
+            donate_argnums=(0,) if self.donate_pages else ())
         self._lock = make_lock("serve2.decode.pool")
         self._seen: set = set()
         self._warmed = False
         self._warmed_rungs: dict = {"decode": (), "prefill": (),
-                                    "prefill_ext": (), "verify": ()}
+                                    "prefill_ext": (), "verify": (),
+                                    "pagewire": ()}
         self._after_warmup = 0
         self._m_after = _metrics.counter(
             "mxserve2_recompile_after_warmup_total",
@@ -552,6 +559,26 @@ class PagedLM:
             out[key] = pool.at[:, d_idx].set(pool[:, s_idx])
         return out
 
+    def _pagewire_slots(self, idx):
+        page = self.page_size
+        offs = jnp.arange(page, dtype=jnp.int32)
+        return (idx[:, None] * page + offs[None, :]).reshape(-1)
+
+    def _export_pages_fn(self, pools, idx):
+        """Gather the per-pool planes of ``idx`` (C,) pages — the
+        pagewire send side. One compiled program per chunk size C."""
+        slots = self._pagewire_slots(idx)
+        return {key: pool[:, slots] for key, pool in pools.items()}
+
+    def _import_pages_fn(self, pools, idx, planes):
+        """Scatter received planes into ``idx`` (C,) pages — the
+        pagewire receive side. Duplicate indices (tail padding repeats
+        the final page) carry identical plane rows, so whichever write
+        wins is the same value."""
+        slots = self._pagewire_slots(idx)
+        return {key: pool.at[:, slots].set(planes[key])
+                for key, pool in pools.items()}
+
     # ------------------------------------------------------------------
     # recompile accounting
     # ------------------------------------------------------------------
@@ -639,9 +666,33 @@ class PagedLM:
             self.pools = self._copy_page_jit(
                 self.pools, jnp.int32(src), jnp.int32(dst))
 
+    def export_pages(self, pages) -> Dict[str, onp.ndarray]:
+        """Pull ``pages``' K/V (and int8 scale) planes out of the pool
+        as numpy — the pagewire send side. ``len(pages)`` must be a
+        warmed chunk size; callers pad a short tail by REPEATING the
+        final page (never by page 0 — the null page's content is
+        scratch)."""
+        with self._lock:
+            self._record("export_pages", len(pages))
+            planes = self._export_pages_jit(
+                self.pools, jnp.asarray(pages, jnp.int32))
+        return {k: onp.asarray(v) for k, v in planes.items()}
+
+    def import_pages(self, pages, planes) -> None:
+        """Write received planes into ``pages`` — the pagewire receive
+        side. Same chunk-size and tail-padding contract as
+        :meth:`export_pages` (a padded tail writes the same plane row
+        to the same page twice, which is a no-op)."""
+        with self._lock:
+            self._record("import_pages", len(pages))
+            self.pools = self._import_pages_jit(
+                self.pools, jnp.asarray(pages, jnp.int32),
+                {k: jnp.asarray(v) for k, v in planes.items()})
+
     def warmup(self, decode_rungs, prefill_rungs, *,
                verify_width: int = 0, prefill_ext: bool = False,
-               copy_page: bool = False) -> List[dict]:
+               copy_page: bool = False,
+               pagewire_chunk: int = 0) -> List[dict]:
         """AOT-compile every rung; afterwards any new signature is a
         counted recompile (the serve/ warmup contract). serve3 programs
         warm only when their legs are on: ``verify_width`` W > 0 warms
@@ -696,13 +747,32 @@ class PagedLM:
             report.append({"program": "copy_page", "size": 0,
                            "compile_ms": round(
                                (time.perf_counter() - t0) * 1e3, 3)})
+        if pagewire_chunk > 0:
+            # warm both pagewire sides at the streaming chunk before
+            # the cache closes — page 0's content is scratch, so an
+            # export/import round-trip on it is harmless
+            t0 = time.perf_counter()
+            planes = self.export_pages([0] * int(pagewire_chunk))
+            report.append({"program": "export_pages",
+                           "size": int(pagewire_chunk),
+                           "compile_ms": round(
+                               (time.perf_counter() - t0) * 1e3, 3)})
+            t0 = time.perf_counter()
+            self.import_pages([0] * int(pagewire_chunk), planes)
+            jax.block_until_ready(self.pools["k"])
+            report.append({"program": "import_pages",
+                           "size": int(pagewire_chunk),
+                           "compile_ms": round(
+                               (time.perf_counter() - t0) * 1e3, 3)})
         self._warmed = True
         dr = tuple(sorted(set(int(r) for r in decode_rungs)))
         pr = tuple(sorted(set(int(r) for r in prefill_rungs)))
         self._warmed_rungs = {
             "decode": dr, "prefill": pr,
             "verify": dr if verify_width > 0 else (),
-            "prefill_ext": pr if prefill_ext else ()}
+            "prefill_ext": pr if prefill_ext else (),
+            "pagewire": (int(pagewire_chunk),)
+            if pagewire_chunk > 0 else ()}
         return report
 
     @property
@@ -723,6 +793,7 @@ class PagedLM:
             "prefill_rungs": self._warmed_rungs["prefill"],
             "verify_rungs": self._warmed_rungs["verify"],
             "prefill_ext_rungs": self._warmed_rungs["prefill_ext"],
+            "pagewire_rungs": self._warmed_rungs.get("pagewire", ()),
             "compiled": seen,
             "decode_steps": self.decode_steps,
             "attention": self.attention,

@@ -249,10 +249,23 @@ class Router:
     # routing
     # ------------------------------------------------------------------
     def predict(self, model: str, data,
-                timeout_ms: Optional[float] = None):
+                timeout_ms: Optional[float] = None,
+                prefer: Optional[str] = None,
+                prefer_max_depth: Optional[int] = None):
         """Route one request: shallowest admitting replica first, then
         failover across the rest. See the module docstring for the
-        error taxonomy."""
+        error taxonomy.
+
+        ``prefer`` names a replica (``rname``) to try FIRST — the
+        mechanism under mxfleet's prefix-affinity routing, where the
+        policy (which replica holds this prompt's KV pages) lives in
+        ``fleet.routing``, not here. The preference is advisory:
+        ``prefer_max_depth`` caps the queue depth at which it still
+        applies (deeper = spill to shallowest-queue), the breaker and
+        failover ladder treat the preferred replica like any other,
+        and ``prefer=None`` (the default everywhere outside fleet/)
+        leaves the pick order byte-identical to the single-host
+        router."""
         group = self._group(model)
         self._m_routed.inc()
         last_err: Optional[BaseException] = None
@@ -276,6 +289,15 @@ class Router:
                             for i, r in enumerate(rotated)),
                            key=lambda t: (t[0], t[1]))
             order = [(d, r) for d, _, r in keyed]
+            if prefer is not None:
+                for j, (d, r) in enumerate(order):
+                    if r.rname != prefer:
+                        continue
+                    if prefer_max_depth is None \
+                            or d <= prefer_max_depth:
+                        order.insert(0, order.pop(j))
+                        _rt.set(preferred=prefer)
+                    break
             _rt.set(replicas=len(order))
             for attempt, (depth, rep) in enumerate(order):
                 with _trace.span("serve.attempt", "serve2",
@@ -339,20 +361,37 @@ class Router:
     # rolling reload
     # ------------------------------------------------------------------
     def rolling_reload(self, model: str,
-                       drain_timeout_s: Optional[float] = None) -> dict:
+                       drain_timeout_s: Optional[float] = None,
+                       n_replicas: Optional[int] = None) -> dict:
         """Zero-downtime model update: warm new → swap → drain old →
         close, one replica at a time. Returns the report the
-        ``mxserve reload`` subcommand prints."""
+        ``mxserve reload`` subcommand prints.
+
+        ``n_replicas`` resizes the group in the same version bump —
+        the mxfleet autoscale actuator and the controller's
+        membership-resync mechanism. A shrink removes the tail
+        replicas from the routing list ATOMICALLY before draining
+        them (new requests can't land on a retiring replica); a grow
+        warms the extra replicas before they enter the list (capacity
+        never dips, same invariant as the per-replica swap)."""
         group = self._group(model)
         timeout = float(drain_timeout_s if drain_timeout_s is not None
                         else self.drain_timeout_s)
         t0 = time.perf_counter()
         with group.lock:
+            target = int(n_replicas if n_replicas is not None
+                         else len(group.replicas))
+            if target < 1:
+                raise MXNetError("n_replicas must be >= 1")
             new_version = group.version + 1
             drained = 0
             dropped = 0
             old_after = 0
             steps = []
+            retiring: List[_Replica] = []
+            if target < len(group.replicas):
+                retiring = group.replicas[target:]
+                group.replicas = group.replicas[:target]
             for rep_idx, rep in enumerate(group.replicas):
                 new_engine = self._build(group.factory, new_version,
                                          rep_idx)
@@ -392,6 +431,41 @@ class Router:
                 old.close()
                 steps.append({"replica": rep.rname,
                               "pending_at_swap": pending,
+                              "drained_ok": bool(ok)})
+            for rep_idx in range(len(group.replicas), target):
+                engine = self._build(group.factory, new_version,
+                                     rep_idx)
+                if not engine.warmed:
+                    engine.warmup()
+                rname = f"{model}/r{rep_idx}"
+                self.registry.register(rname, engine,
+                                       version=new_version)
+                group.replicas.append(_Replica(rname, engine,
+                                               new_version))
+                steps.append({"replica": rname, "added": True})
+            for rep in retiring:
+                # already invisible to new requests (truncated above);
+                # whatever it still holds gets the drain budget
+                pending = rep.depth()
+                ok = rep.engine.drain(timeout)
+                leftover = 0
+                if not ok:
+                    leftover = (rep.engine.queue_depth()
+                                if callable(getattr(rep.engine,
+                                                    "queue_depth",
+                                                    None)) else 0)
+                    dropped += leftover
+                drained += max(0, pending - leftover)
+                try:
+                    old_after += int(rep.engine.stats()
+                                     .get("recompiles_after_warmup",
+                                          0))
+                except Exception:
+                    pass
+                self.registry.unregister(rep.rname, close=True)
+                rep.retire_gauges()
+                steps.append({"replica": rep.rname, "removed": True,
+                              "pending_at_remove": pending,
                               "drained_ok": bool(ok)})
             group.version = new_version
         self._m_reloads.inc()

@@ -153,9 +153,14 @@ class DecodeEngine:
                  kv_dtype: Optional[str] = None,
                  prefix_cache: Optional[bool] = None,
                  prefix_cache_pages: Optional[int] = None,
-                 name: str = "lm", donate: str = "auto"):
+                 name: str = "lm", donate: str = "auto",
+                 pagewire_chunk: int = 0):
         from .. import config
         self.name = name
+        # mxfleet pagewire: > 0 warms the fixed-chunk page export/
+        # import programs so cross-host KV streaming never recompiles.
+        # 0 (default) = no extra programs, identical single-host bill.
+        self.pagewire_chunk = int(pagewire_chunk)
         self.decode_steps = int(
             decode_steps if decode_steps is not None
             else config.get("MXSERVE2_DECODE_STEPS"))
@@ -348,7 +353,8 @@ class DecodeEngine:
             self.decode_rungs, self.prefill_rungs,
             verify_width=(self.spec_tokens + 1 if self.spec else 0),
             prefill_ext=self.prefix is not None,
-            copy_page=self.prefix is not None)
+            copy_page=self.prefix is not None,
+            pagewire_chunk=self.pagewire_chunk)
         if self.draft is not None:
             for row in self.draft.warmup(
                     self.decode_rungs, self.prefill_rungs,

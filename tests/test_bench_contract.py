@@ -181,6 +181,46 @@ def test_bench_pod_emits_mxpod_recovery():
 
 
 @pytest.mark.slow
+def test_bench_fleet_emits_mxfleet_slo():
+    """--fleet contract: one mxfleet_slo JSON line from the 3-leg
+    disaggregated-serving loadgen (single-host router baseline, the
+    2-decode + 1-prefill subprocess fleet, and the mid-load host-kill
+    availability leg), with the zero-drop gate pinned: the SIGKILLed
+    host must not drop a single accepted request."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_FLEET_REQUESTS": "12",
+        "MXTPU_BENCH_FLEET_RATE_QPS": "2.0",
+        "MXTPU_BENCH_FLEET_KILL_REQUESTS": "10",
+        "MXTPU_BENCH_TIMEOUT": "900",
+        "MXTPU_BENCH_STORE": "0",  # reduced knobs: numbers are not
+        # comparable to the default-scale trajectory
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--fleet"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxfleet_slo"
+    for key in ("value", "unit", "decode_hosts", "prefill_hosts",
+                "offered_qps", "slo_ms", "single_qps", "single_p99_ms",
+                "single_goodput_qps", "fleet_qps", "fleet_p99_ms",
+                "fleet_goodput_qps", "fleet_prefix_hit_rate",
+                "kill_requests", "kill_completed", "kill_dropped",
+                "kill_fault_fired", "fleet_beats_single", "zero_drop"):
+        assert key in data, (key, data)
+    assert data["single_failures"] == 0, data
+    assert data["fleet_failures"] == 0, data
+    assert data["kill_fault_fired"] is True, data
+    assert data["kill_dropped"] == 0, data
+    assert data["zero_drop"] is True, data
+
+
+@pytest.mark.slow
 def test_bench_trace_overhead_emits_mxtrace_overhead():
     """--trace-overhead contract: one mxtrace_overhead JSON line with
     both phase overheads (traced vs untraced fused training with

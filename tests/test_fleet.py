@@ -1,0 +1,513 @@
+"""mxfleet fast tier: routing policy, the coordinator's fleet
+directory, the autoscaler decision ladder, the Router's prefer/resize
+mechanics, the EngineHost wire (with a stub engine — no model build),
+and one real-engine pagewire transfer.
+
+The subprocess drills (SIGKILL a host mid-load, coordinator restart)
+live in test_fleet_drill.py under @pytest.mark.slow.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu.fleet.autoscale import AutoScaler, p99_ms_from_merged
+from mxnet_tpu.fleet.routing import (affinity_key, rendezvous_pick,
+                                     rendezvous_rank, spill_cap)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# routing policy (pure)
+# ----------------------------------------------------------------------
+def test_affinity_key_is_deterministic_and_template_shared():
+    page = 8
+    tpl = list(range(24))  # 3 full pages
+    a = affinity_key(tpl + [91, 92, 93], page, n_pages=2)
+    b = affinity_key(tpl + [55, 56], page, n_pages=2)
+    assert a is not None and a == b  # same template -> same key
+    # the key commits to the template: change one template token
+    c = affinity_key([1] + tpl[1:] + [91], page, n_pages=2)
+    assert c != a
+    # sub-page prompts have no cacheable prefix -> no key
+    assert affinity_key([1, 2, 3], page, n_pages=2) is None
+
+
+def test_rendezvous_pick_stable_and_minimal_remap():
+    workers = [f"d{i}" for i in range(5)]
+    keys = [affinity_key(list(range(s, s + 16)), 8, n_pages=2)
+            for s in range(40)]
+    picks = {k: rendezvous_pick(k, workers) for k in keys}
+    # deterministic and order-independent
+    assert picks == {k: rendezvous_pick(k, list(reversed(workers)))
+                     for k in keys}
+    # removing one worker remaps ONLY the keys that pointed at it
+    survivors = [w for w in workers if w != "d2"]
+    for k, before in picks.items():
+        after = rendezvous_pick(k, survivors)
+        if before != "d2":
+            assert after == before
+        else:
+            assert after in survivors
+    # the rank order is the failover ladder: head == pick
+    for k in keys:
+        rank = rendezvous_rank(k, workers)
+        assert rank[0] == picks[k]
+        assert sorted(rank) == sorted(workers)
+
+
+def test_spill_cap_semantics():
+    assert spill_cap(0, factor=2.0) == 1
+    assert spill_cap(3, factor=2.0) == 7
+    # factor 0 = strict affinity = the Router's unconditional-prefer
+    assert spill_cap(7, factor=0.0) is None
+    assert spill_cap(-1, factor=1.0) == 1  # clamped
+
+
+def test_page_keys_stable_across_processes():
+    """The affinity key must be identical in every worker process —
+    page_keys must never touch the salted builtin hash()."""
+    from mxnet_tpu.serve2.prefix import page_keys
+    tokens = list(range(40))
+    local = [k.hex() for k in page_keys(tokens, 8)]
+    code = ("from mxnet_tpu.serve2.prefix import page_keys;"
+            "print(','.join(k.hex() for k in "
+            "page_keys(list(range(40)), 8)))")
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = "12345"  # different salt than this proc
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert out.stdout.strip().split(",") == local
+
+
+# ----------------------------------------------------------------------
+# coordinator fleet directory
+# ----------------------------------------------------------------------
+def test_coordinator_fleet_directory_ops():
+    from mxnet_tpu.elastic.coordinator import ElasticCoordinator
+    co = ElasticCoordinator()
+    # heartbeat before register: the re-announce signal
+    assert co.fleet_heartbeat("d0") is False
+    r = co.fleet_register("d0", "decode", "127.0.0.1:1000")
+    assert r["uid"] == co.uid and r["workers"] == 1
+    co.fleet_register("p0", "prefill", "127.0.0.1:1001",
+                      meta={"pid": 7})
+    assert co.fleet_heartbeat("d0", depth=3) is True
+    view = co.fleet_view()
+    assert set(view["workers"]) == {"d0", "p0"}
+    ent = view["workers"]["d0"]
+    assert ent["role"] == "decode"
+    assert ent["meta"]["depth"] == 3
+    assert ent["age_s"] >= 0.0
+    assert view["workers"]["p0"]["meta"]["pid"] == 7
+    # re-register is idempotent (same uid, refreshed beat)
+    co.fleet_register("d0", "decode", "127.0.0.1:1000")
+    assert len(co.fleet_view()["workers"]) == 2
+    co.fleet_note("controller", {"decode": 1})
+    assert co.fleet_view()["notes"]["controller"] == {"decode": 1}
+    co.fleet_leave("d0")
+    assert set(co.fleet_view()["workers"]) == {"p0"}
+    assert co.fleet_heartbeat("d0") is False
+
+
+# ----------------------------------------------------------------------
+# autoscaler decision ladder (fake clock, canned signal)
+# ----------------------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_autoscaler_grow_cooldown_shrink():
+    clock = _Clock()
+    sig = {"p99_ms": 500.0, "depth": 4, "replicas": 2}
+    acts = []
+
+    def actuator(n):
+        acts.append(n)
+        sig["replicas"] = n
+    sc = AutoScaler(lambda: dict(sig), actuator, slo_p99_ms=200.0,
+                    window_s=30.0, min_replicas=1, max_replicas=4,
+                    clock=clock)
+    rec = sc.tick()
+    assert rec["decision"] == "grow" and rec["target"] == 3
+    assert acts == [3]
+    # inside the cooldown window: hold even though p99 still over SLO
+    clock.t += 10.0
+    rec = sc.tick()
+    assert rec["decision"] == "hold" and "cooldown" in rec["reason"]
+    assert acts == [3]
+    # past cooldown, healthy and idle: shrink by one
+    clock.t += 30.0
+    sig.update(p99_ms=50.0, depth=0)
+    rec = sc.tick()
+    assert rec["decision"] == "shrink" and rec["target"] == 2
+    assert acts == [3, 2]
+    assert sc.last_decision()["decision"] == "shrink"
+
+
+def test_autoscaler_holds_without_slo_or_samples():
+    sc = AutoScaler(lambda: {"p99_ms": 900.0, "depth": 9,
+                             "replicas": 1},
+                    lambda n: (_ for _ in ()).throw(AssertionError),
+                    slo_p99_ms=0.0, window_s=30.0, clock=_Clock())
+    assert sc.tick()["decision"] == "hold"  # observability-only
+    sc2 = AutoScaler(lambda: {"p99_ms": None, "depth": 0,
+                              "replicas": 1},
+                     lambda n: None, slo_p99_ms=100.0, window_s=30.0,
+                     clock=_Clock())
+    rec = sc2.tick()
+    assert rec["decision"] == "hold" and "samples" in rec["reason"]
+
+
+def test_autoscaler_actuator_failure_reverts_to_hold():
+    def bad(n):
+        raise RuntimeError("resize exploded")
+    sc = AutoScaler(lambda: {"p99_ms": 500.0, "depth": 1,
+                             "replicas": 1},
+                    bad, slo_p99_ms=100.0, window_s=30.0,
+                    clock=_Clock())
+    rec = sc.tick()
+    assert rec["decision"] == "hold"
+    assert "grow failed" in rec["reason"]
+
+
+def test_p99_from_merged_doc():
+    doc = {"merged": {"mxtrace_phase_decode_seconds": {"p99": 0.25}}}
+    assert p99_ms_from_merged(doc) == 250.0
+    assert p99_ms_from_merged(None) is None
+    assert p99_ms_from_merged({"merged": {}}) is None
+
+
+# ----------------------------------------------------------------------
+# Router prefer= mechanics and n_replicas resize (stub engines)
+# ----------------------------------------------------------------------
+class _StubEngine:
+    def __init__(self, name, depth=0):
+        self.name = name
+        self._depth = depth
+        self.calls = []
+        self.warmed = True
+        self.drained = False
+
+    def predict(self, data, timeout_ms=None):
+        self.calls.append(list(data))
+        return [0]
+
+    def queue_depth(self):
+        return self._depth
+
+    def warmup(self, input_specs=None):
+        return []
+
+    def drain(self, timeout=None):
+        self.drained = True
+        return True
+
+    def stats(self):
+        return {"name": self.name}
+
+    def close(self):
+        pass
+
+
+def _stub_router(depths):
+    from mxnet_tpu.serve2.router import Router
+    engines = [_StubEngine(f"e{i}", d) for i, d in enumerate(depths)]
+
+    def factory(version, replica):
+        # second arg REQUIRED: the Router only passes the replica
+        # index to factories that demand it
+        while replica >= len(engines):
+            engines.append(_StubEngine(f"e{len(engines)}"))
+        return engines[replica]
+    r = Router(name="t")
+    r.add_group("m", factory, n_replicas=len(depths), warmup=False)
+    return r, engines
+
+
+def test_router_prefer_overrides_depth_order():
+    r, engines = _stub_router([5, 0, 0])
+    # default: shallowest wins — never the depth-5 replica
+    r.predict("m", [1])
+    assert not engines[0].calls
+    # prefer with no cap: the deep replica takes it anyway
+    r.predict("m", [2], prefer="m/r0")
+    assert engines[0].calls == [[2]]
+    # prefer with a cap below its depth: spills to shallowest
+    r.predict("m", [3], prefer="m/r0", prefer_max_depth=3)
+    assert engines[0].calls == [[2]]
+    # cap at/above its depth keeps the preference
+    r.predict("m", [4], prefer="m/r0", prefer_max_depth=5)
+    assert engines[0].calls == [[2], [4]]
+    r.close()
+
+
+def test_rolling_reload_resizes_group():
+    r, engines = _stub_router([0, 0])
+    rep = r.rolling_reload("m", n_replicas=4)
+    assert [s["replica"] for s in rep["steps"][-2:]] == \
+        ["m/r2", "m/r3"]
+    assert all(s.get("added") for s in rep["steps"][-2:])
+    st = r.stats()["models"]["m"]
+    assert len(st["replicas"]) == 4
+    rep = r.rolling_reload("m", n_replicas=1)
+    st = r.stats()["models"]["m"]
+    assert len(st["replicas"]) == 1
+    removed = [s for s in rep["steps"] if s.get("removed")]
+    assert len(removed) == 3
+    assert rep["dropped"] == 0
+    r.close()
+
+
+# ----------------------------------------------------------------------
+# EngineHost wire (stub engine, real sockets)
+# ----------------------------------------------------------------------
+def test_engine_host_roundtrip_and_typed_errors():
+    from mxnet_tpu.fleet.worker import EngineClient, EngineHost
+    from mxnet_tpu.serve.batcher import QueueFullError
+
+    class _WireStub(_StubEngine):
+        prefix = None
+
+        def predict(self, tokens, timeout_ms=None):
+            if tokens and tokens[0] == 99:
+                raise QueueFullError("stub full")
+            return [t + 1 for t in tokens]
+
+    host = EngineHost(_WireStub("w"), role="decode", name="w0",
+                      pagewire_chunk=4)
+    try:
+        cli = EngineClient(host.address)
+        try:
+            pong = cli.request("ping")
+            assert pong["role"] == "decode" and pong["warmed"]
+            assert cli.request("predict", tokens=[1, 2]) == [2, 3]
+            assert cli.request("depth") == 0
+            assert cli.request("stats")["role"] == "decode"
+            # no prefix cache: probe reports zero coverage
+            assert cli.request("page_probe", keys=[b"k"]) == 0
+            # the serve taxonomy survives the wire, typed
+            with pytest.raises(QueueFullError):
+                cli.request("predict", tokens=[99])
+            # and so does an unknown op, as a generic remote error
+            from mxnet_tpu.fleet.worker import RemoteEngineError
+            with pytest.raises(RemoteEngineError):
+                cli.request("no_such_op")
+        finally:
+            cli.close()
+    finally:
+        host.stop()
+
+
+def test_remote_engine_types_dead_host_as_crash():
+    from mxnet_tpu.fleet.controller import RemoteEngine
+    from mxnet_tpu.fleet.worker import EngineHost
+    from mxnet_tpu.serve2.scheduler import EngineCrashedError
+    host = EngineHost(_StubEngine("w"), role="decode", name="w0")
+    addr = host.address
+    host.stop()
+    time.sleep(0.05)
+    eng = RemoteEngine(addr, name="dead")
+    with pytest.raises(EngineCrashedError):
+        eng.predict([1, 2, 3])
+    # a dead host sorts LAST in the depth order, not first
+    assert eng.queue_depth() >= 1 << 20
+    assert eng.stats().get("unreachable") is True
+    assert eng.drain() is True
+    eng.close()
+
+
+def test_remote_engine_drain_never_stops_the_worker():
+    """Retiring a PROXY (group resize) must not drain the remote
+    engine — the worker outlives group membership."""
+    from mxnet_tpu.fleet.controller import RemoteEngine
+    from mxnet_tpu.fleet.worker import EngineHost
+    stub = _StubEngine("w")
+    host = EngineHost(stub, role="decode", name="w0")
+    try:
+        eng = RemoteEngine(host.address, name="p")
+        assert eng.drain(timeout=1.0) is True
+        assert stub.drained is False
+        # the data plane is still up after the proxy "drained"
+        assert eng.predict([7]) == [0]
+        eng.close()
+    finally:
+        host.stop()
+
+
+# ----------------------------------------------------------------------
+# controller membership sync (fake directory, no sockets)
+# ----------------------------------------------------------------------
+class _FakeGroup:
+    def __init__(self):
+        self.workers = {}
+        self.notes = {}
+
+    def fleet_view(self):
+        return {"uid": "u", "workers": dict(self.workers),
+                "notes": dict(self.notes)}
+
+    def fleet_note(self, key, value):
+        self.notes[key] = value
+
+
+def _dirent(role, addr, age=0.0, depth=0):
+    return {"role": role, "address": addr, "age_s": age,
+            "meta": {"depth": depth}, "beat": 0.0}
+
+
+def test_controller_sync_converges_group_on_directory():
+    from mxnet_tpu.fleet.controller import FleetController
+    g = _FakeGroup()
+    g.workers = {"d0": _dirent("decode", "127.0.0.1:1"),
+                 "d1": _dirent("decode", "127.0.0.1:2", depth=2),
+                 "p0": _dirent("prefill", "127.0.0.1:3")}
+    c = FleetController(g, page_size=8, heartbeat_s=1.0,
+                        sync_interval_s=0.0)
+    try:
+        got = c.sync(force=True)
+        assert got == {"decode": 2, "prefill": 1}
+        desc = c.describe()
+        assert [d["wid"] for d in desc["decode"]] == ["d0", "d1"]
+        assert desc["depths"] == {"d0": 0, "d1": 2, "p0": 0}
+        reps = desc["router"]["models"]["fleet"]["replicas"]
+        assert [r["replica"] for r in reps] == ["fleet/r0", "fleet/r1"]
+        # a host whose heartbeat went stale ages out; the group
+        # shrinks through rolling_reload(n_replicas=1)
+        g.workers["d0"]["age_s"] = 99.0
+        c.sync(force=True)
+        reps = c.describe()["router"]["models"]["fleet"]["replicas"]
+        assert [r["replica"] for r in reps] == ["fleet/r0"]
+        # empty directory (coordinator restart): keep the last group —
+        # the data plane must survive a directory outage
+        g.workers = {}
+        c.sync(force=True)
+        assert len(c.describe()["router"]["models"]["fleet"]
+                   ["replicas"]) == 1
+        c.heartbeat_note()
+        assert g.notes["controller"]["decode"] == 1
+    finally:
+        c.close()
+
+
+# ----------------------------------------------------------------------
+# pagewire: real engines, in-process transfer + parity
+# ----------------------------------------------------------------------
+def test_pagewire_transfer_and_parity():
+    """Prefill on engine A, stream the pages into engine B over the
+    chunked export/import programs, and check B (a) serves the prompt
+    from the installed pages (cache hit, no local prefill of the
+    template) and (b) produces the exact greedy continuation A does."""
+    from mxnet_tpu.fleet.pagewire import (collect_pages, export_chunks,
+                                          install_chunks)
+    from mxnet_tpu.fleet.worker import build_engine
+    chunk = 4
+    mk = lambda name: build_engine(  # noqa: E731
+        seed=0, vocab=32, n_layers=1, d_model=16, n_heads=2,
+        page_size=4, num_pages=48, max_inflight=2, max_seq_len=48,
+        pagewire_chunk=chunk, name=name)
+    a, b = mk("pw-a"), mk("pw-b")
+    try:
+        a.warmup()
+        b.warmup()
+        prompt = list(range(1, 19))  # 4 full pages + tail
+        h = a.submit(prompt, max_new_tokens=1)
+        h.wait()
+        keys, pages = collect_pages(a, prompt)
+        assert len(keys) == len(pages) == 4
+        try:
+            chunks = export_chunks(a.lm, pages, chunk)
+            # 4 pages in chunks of 4 -> one dispatch, no recompile
+            assert [c for c, _ in chunks] == [4]
+            installed = install_chunks(b, keys, chunks, chunk)
+        finally:
+            a.alloc.free(pages)
+        assert installed == 4
+        # B now serves the template from the wire-installed pages
+        out_b = b.predict(prompt, timeout_ms=30_000)
+        st = b.stats()["prefix_cache"]
+        assert st["hits"] == 1 and st["misses"] == 0
+        assert st["tokens_avoided"] >= 16
+        out_a = a.predict(prompt, timeout_ms=30_000)
+        assert onp.asarray(out_b).tolist() == \
+            onp.asarray(out_a).tolist()
+        # an install that races a local admission is skipped whole
+        assert install_chunks(b, keys, chunks, chunk) == 0
+        # the warmed chunk programs never recompiled
+        assert a.stats()["recompiles_after_warmup"] == 0
+        assert b.stats()["recompiles_after_warmup"] == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_device_transfer_stub_raises():
+    from mxnet_tpu.fleet.pagewire import device_transfer_stub
+    with pytest.raises(NotImplementedError):
+        device_transfer_stub()
+
+
+# ----------------------------------------------------------------------
+# diagnose: the mxfleet section against a live directory
+# ----------------------------------------------------------------------
+def test_diagnose_reads_live_fleet_directory():
+    from mxnet_tpu.elastic.coordinator import ElasticCoordinator
+    from mxnet_tpu.fleet.drill import _free_port
+    from mxnet_tpu.kvstore_server import KVServer
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    srv = KVServer(addr, 1)
+    try:
+        co = srv._ensure_elastic()
+        assert isinstance(co, ElasticCoordinator)
+        co.fleet_register("d0", "decode", "127.0.0.1:9001",
+                          meta={"depth": 2})
+        co.fleet_note("controller",
+                      {"ts": time.time(), "decode": 1, "prefill": 0})
+        co.fleet_note("autoscale",
+                      {"decision": "hold", "reason": "p99 within "
+                       "band", "ts": time.time()})
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", MXFLEET_COORDINATOR=addr,
+                   PYTHONPATH=ROOT + os.pathsep
+                   + env.get("PYTHONPATH", ""))
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools",
+                                          "diagnose.py")],
+            env=env, capture_output=True, text=True, timeout=240)
+        assert out.returncode == 0, out.stderr[-800:]
+        sec = out.stdout[out.stdout.index("mxfleet"):]
+        assert "d0: decode @ 127.0.0.1:9001, depth 2" in sec
+        assert "1 decode / 0 prefill" in sec
+        assert "hold (p99 within band)" in sec
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------------
+# flags-off guarantee
+# ----------------------------------------------------------------------
+def test_flags_off_leaves_single_host_predict_order_identical():
+    """With prefer=None (every caller outside fleet/), the Router's
+    pick order is the PR 11 shallowest-queue order — byte-identical
+    routing, no fleet code on the path."""
+    r, engines = _stub_router([3, 1, 2])
+    for i in range(6):
+        r.predict("m", [i])
+    # shallowest (depth 1) replica takes all traffic
+    assert not engines[0].calls
+    assert len(engines[1].calls) == 6
+    assert not engines[2].calls
+    r.close()

@@ -603,6 +603,80 @@ def check_obs():
         print("benchstore   : unavailable (%s)" % e)
 
 
+def check_fleet():
+    """Disaggregated serving fleet health: MXFLEET_* policy knobs,
+    and — when a coordinator address is in scope — the live fleet
+    directory: per-worker role/depth/beat age, controller liveness,
+    the last resize and the last autoscale decision
+    (mxnet_tpu/fleet/; docs/fleet.md)."""
+    print("----------Fleet serving (mxfleet)----------")
+    try:
+        from mxnet_tpu import config
+    except Exception as e:
+        print("mxfleet      : unavailable (%s)" % e)
+        return
+    print("affinity     :", "ON (first %d page keys)"
+          % int(config.get("MXFLEET_AFFINITY_PAGES"))
+          if bool(config.get("MXFLEET_AFFINITY"))
+          else "(off — shallowest-queue only)")
+    print("spill factor :", config.get("MXFLEET_SPILL_FACTOR"),
+          "(x shallowest depth before affinity yields)")
+    print("disagg       :", "ON (prefill pushed over pagewire, "
+          "chunk %d pages)"
+          % int(config.get("MXFLEET_PAGEWIRE_CHUNK_PAGES"))
+          if bool(config.get("MXFLEET_PREFILL_DISAGG"))
+          else "(off — every host prefills locally)")
+    slo = float(config.get("MXFLEET_SLO_P99_MS"))
+    print("autoscale    :", "SLO p99 %gms, cooldown %gs"
+          % (slo, float(config.get("MXFLEET_AUTOSCALE_WINDOW_S")))
+          if slo > 0 else
+          "(observability-only — set MXFLEET_SLO_P99_MS)")
+    coord = os.environ.get("MXFLEET_COORDINATOR") or \
+        config.get("MXPOD_COORDINATOR") or \
+        os.environ.get("MX_KV_SERVER")
+    if not coord:
+        print("directory    : (no coordinator address — set "
+              "MXFLEET_COORDINATOR to inspect a live fleet)")
+        return
+    try:
+        from mxnet_tpu.pod.group import PodGroup
+        g = PodGroup(coord, grace_s=3.0)
+        try:
+            view = g.fleet_view()
+        finally:
+            g.close()
+    except Exception as e:
+        print(f"directory    : unreachable at {coord} ({e})")
+        return
+    workers = view.get("workers") or {}
+    beat = float(config.get("MXFLEET_HEARTBEAT_S"))
+    print(f"directory    : {coord} — {len(workers)} worker(s)")
+    for wid, ent in sorted(workers.items()):
+        age = float(ent.get("age_s", 0.0))
+        stale = " STALE" if age > 3 * beat else ""
+        print("  %s: %s @ %s, depth %s, beat %.1fs ago%s"
+              % (wid, ent.get("role"), ent.get("address"),
+                 ent.get("meta", {}).get("depth", "?"), age, stale))
+    notes = view.get("notes") or {}
+    ctl = notes.get("controller")
+    if ctl:
+        import time as _t
+        print("controller   : %d decode / %d prefill proxied, "
+              "noted %.1fs ago"
+              % (ctl.get("decode", 0), ctl.get("prefill", 0),
+                 max(0.0, _t.time() - float(ctl.get("ts", 0.0)))))
+    else:
+        print("controller   : no liveness note (no controller "
+              "attached, or it never completed a sync)")
+    rs = notes.get("last_resize")
+    if rs:
+        print("last resize  : -> %s replica(s)" % rs.get("target"))
+    sc = notes.get("autoscale")
+    if sc:
+        print("autoscale    : %s (%s)"
+              % (sc.get("decision"), sc.get("reason")))
+
+
 def main():
     check_python()
     check_pip()
@@ -620,6 +694,7 @@ def main():
     check_guard()
     check_mxsan()
     check_obs()
+    check_fleet()
     check_mxlint()
 
 
