@@ -1474,6 +1474,101 @@ def pod_main():
                       "ratio", vs=None, **record)
 
 
+def pipe_main():
+    """mxpipe stage-scaling benchmark (--pipe / MXTPU_BENCH_PIPE=1):
+    the same seeded pipeline LM trained at 1, 2 and 4 stages through
+    :class:`~mxnet_tpu.pipe.stepfn.PipeStepFunction` (local transport
+    — identical programs to the socket path, minus the wire), ONE
+    BENCH-schema JSON line (metric mxpipe_scaling, value = 1-stage /
+    4-stage max-per-stage parameter bytes — the memory the stage axis
+    exists to shrink). Each leg records median step time, the
+    schedule's bubble fraction, per-stage parameter bytes and the
+    closed-cache verdict; the contract asserts recompiles_after_warmup
+    == 0 on every leg and the pipelined loss matching the 1-stage leg
+    within PIPE_TOL_REL (they are bit-identical on CPU). Knobs:
+    MXTPU_BENCH_PIPE_{STAGES,STEPS,BATCH,MICRO,LAYERS,DMODEL,SEQ,
+    SCHEDULE}."""
+    jax, devices, probe_status = _init_jax()
+    import numpy as onp
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.pipeline_lm import init_pipeline_lm
+    from mxnet_tpu.pipe import PipeStepFunction
+    from mxnet_tpu.pipe.stepfn import PIPE_TOL_REL
+
+    stages = [int(s) for s in os.environ.get(
+        "MXTPU_BENCH_PIPE_STAGES", "1,2,4").split(",") if s.strip()]
+    steps = int(os.environ.get("MXTPU_BENCH_PIPE_STEPS", "8"))
+    batch = int(os.environ.get("MXTPU_BENCH_PIPE_BATCH", "8"))
+    n_micro = int(os.environ.get("MXTPU_BENCH_PIPE_MICRO", "4"))
+    n_layers = int(os.environ.get("MXTPU_BENCH_PIPE_LAYERS", "8"))
+    d_model = int(os.environ.get("MXTPU_BENCH_PIPE_DMODEL", "32"))
+    seq = int(os.environ.get("MXTPU_BENCH_PIPE_SEQ", "16"))
+    schedule = os.environ.get("MXTPU_BENCH_PIPE_SCHEDULE", "1f1b")
+    vocab = 64
+
+    params = init_pipeline_lm(0, vocab=vocab, d_model=d_model,
+                              n_layers=n_layers, n_heads=2,
+                              d_head=max(4, d_model // 2), d_ff=64,
+                              n_experts=2)
+    rs = onp.random.RandomState(1)
+    data = [(jnp.asarray(rs.randint(0, vocab, size=(batch, seq)),
+                         dtype="int32"),
+             jnp.asarray(rs.randint(0, vocab, size=(batch, seq)),
+                         dtype="int32"))
+            for _ in range(steps)]
+
+    legs = {}
+    final_losses = {}
+    for S in stages:
+        sf = PipeStepFunction(params, n_stage=S, schedule=schedule,
+                              n_microbatch=n_micro,
+                              name=f"bench-pipe-s{S}")
+        times = []
+        loss = None
+        for tok, lab in data:
+            t0 = time.perf_counter()
+            loss = sf.step(tok, lab)
+            times.append(time.perf_counter() - t0)
+        rep = sf.lint_report()
+        # median of the post-warmup steps (step 0 carries every
+        # compile; the steady state is what the schedule promises)
+        steady = sorted(times[1:]) or times
+        legs[str(S)] = {
+            "n_stage": S,
+            "step_time_s": round(steady[len(steady) // 2], 6),
+            "warmup_step_s": round(times[0], 6),
+            "bubble_fraction": round(rep["bubble_fraction"], 4),
+            "stage_param_bytes": rep["stage_param_bytes"],
+            "max_stage_param_bytes": max(rep["stage_param_bytes"]),
+            "recompiles_after_warmup": rep["recompiles_after_warmup"],
+            "programs": rep["programs"]}
+        final_losses[S] = float(loss)
+
+    ref = final_losses.get(1, next(iter(final_losses.values())))
+    parity = max(abs(v - ref) / max(abs(ref), 1e-9)
+                 for v in final_losses.values())
+    closed = all(leg["recompiles_after_warmup"] == 0
+                 for leg in legs.values())
+    lo, hi = str(min(stages)), str(max(stages))
+    ratio = (legs[lo]["max_stage_param_bytes"]
+             / max(1, legs[hi]["max_stage_param_bytes"]))
+    record = dict(
+        metric="mxpipe_scaling",
+        schedule=schedule, stages=stages, steps=steps, batch=batch,
+        n_micro=n_micro, n_layers=n_layers, d_model=d_model, seq=seq,
+        legs=legs,
+        final_losses={str(k): round(v, 6)
+                      for k, v in final_losses.items()},
+        parity_rel=round(parity, 9), parity_tol=PIPE_TOL_REL,
+        parity_ok=parity <= PIPE_TOL_REL,
+        recompiles_after_warmup_zero=closed,
+        platform=devices[0].platform,
+        device_kind=getattr(devices[0], "device_kind", "unknown"))
+    _emit(round(ratio, 4),
+          unit="1-stage/max-stage per-stage param bytes ratio",
+          vs=None, **record)
+
+
 def guard_main():
     """mxguard integrity benchmark (--guard / MXTPU_BENCH_GUARD=1),
     two phases, ONE BENCH-schema JSON line (metric mxguard_drill,
@@ -2392,6 +2487,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
               else "mxpod_recovery"
               if os.environ.get("MXTPU_BENCH_POD") == "1"
+              else "mxpipe_scaling"
+              if os.environ.get("MXTPU_BENCH_PIPE") == "1"
               else "mxfleet_slo"
               if os.environ.get("MXTPU_BENCH_FLEET") == "1"
               else "mxguard_drill"
@@ -2456,6 +2553,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_ELASTIC"] = "1"
     if "--pod" in sys.argv:
         os.environ["MXTPU_BENCH_POD"] = "1"
+    if "--pipe" in sys.argv:
+        os.environ["MXTPU_BENCH_PIPE"] = "1"
     if "--fleet" in sys.argv:
         os.environ["MXTPU_BENCH_FLEET"] = "1"
     if "--guard" in sys.argv:
@@ -2481,6 +2580,7 @@ if __name__ == "__main__":
     _graphopt = os.environ.get("MXTPU_BENCH_GRAPHOPT") == "1"
     _elastic = os.environ.get("MXTPU_BENCH_ELASTIC") == "1"
     _pod = os.environ.get("MXTPU_BENCH_POD") == "1"
+    _pipe = os.environ.get("MXTPU_BENCH_PIPE") == "1"
     _fleet = os.environ.get("MXTPU_BENCH_FLEET") == "1"
     _guard = os.environ.get("MXTPU_BENCH_GUARD") == "1"
     _tracebench = os.environ.get("MXTPU_BENCH_TRACE") == "1"
@@ -2504,6 +2604,8 @@ if __name__ == "__main__":
                 elastic_main()
             elif _pod:
                 pod_main()
+            elif _pipe:
+                pipe_main()
             elif _fleet:
                 fleet_main()
             elif _guard:
@@ -2526,6 +2628,7 @@ if __name__ == "__main__":
                           else "mxopt_speedup" if _graphopt
                           else "mxelastic_recovery" if _elastic
                           else "mxpod_recovery" if _pod
+                          else "mxpipe_scaling" if _pipe
                           else "mxfleet_slo" if _fleet
                           else "mxguard_drill" if _guard
                           else "mxtrace_overhead" if _tracebench

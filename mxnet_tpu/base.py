@@ -197,9 +197,16 @@ def ensure_jax_compat():
     the modern ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
     check_vma=..., axis_names=...)`` spelling, which older jax only
     offers as ``jax.experimental.shard_map.shard_map(f, mesh, in_specs,
-    out_specs, check_rep=..., auto=...)``. Install an adapter so the
+    out_specs, check_rep=..., auto=...)``, and the modern
+    ``jax.lax.axis_size(name)``, which older jax only exposes through
+    the tracing-internal axis env. Install adapters so the
     collectives/pipeline/ring-attention layers run on either."""
     import jax
+    _ensure_shard_map(jax)
+    _ensure_axis_size(jax)
+
+
+def _ensure_shard_map(jax):
     if hasattr(jax, "shard_map"):
         return
     try:
@@ -225,6 +232,29 @@ def ensure_jax_compat():
         return _esm(f, mesh, in_specs, out_specs, **kwargs)
 
     jax.shard_map = shard_map
+
+
+def _ensure_axis_size(jax):
+    """``jax.lax.axis_size`` adapter. The callers here (ring attention's
+    ppermute ring, the pipe stage collectives, the moe_mesh example)
+    need a CONCRETE Python int — it bounds ``range()`` loops and builds
+    ppermute permutations — so ``psum(jnp.ones(()), name)`` (a traced
+    value) is not a substitute. Old jax keeps the bound size in the
+    trace-time axis env: ``jax._src.core.axis_frame(name)`` returns the
+    size directly (an int on 0.4.x; a frame object carrying ``.size``
+    on some releases). Outside any binding of the name this raises
+    NameError, matching modern jax's behaviour."""
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        from jax._src import core as _core
+        frame = _core.axis_frame(axis_name)
+        if isinstance(frame, int):
+            return frame
+        return int(getattr(frame, "size"))
+
+    jax.lax.axis_size = axis_size
 
 
 def _distributed_is_initialized(jax_mod) -> bool:

@@ -457,6 +457,27 @@ class CheckpointManager:
                     "(axes %s), restoring onto %d (axes %s)",
                     saved_n, dict(shard.get("axes") or []),
                     plan.n_devices, plan.axes)
+        # pipeline topology: params are saved DENSE ((L, ...) layer
+        # layout, pipe/model.merge), so a checkpoint trained at S
+        # stages restores into any S' dividing L — account the
+        # re-stage the same way the mesh reshard above is accounted
+        pipe_desc = (shard or {}).get("pipe")
+        if pipe_desc is not None:
+            saved_stages = int(pipe_desc.get("n_stage", 0) or 0)
+            now_stages = int(getattr(plan, "n_stage", 0) or 0)
+            if saved_stages and now_stages and \
+                    saved_stages != now_stages:
+                from .telemetry import metrics as _metrics
+                _metrics.counter(
+                    "mxpipe_cross_stage_restores_total",
+                    "checkpoint restores into a different pipeline "
+                    "stage count").inc()
+                _log.info(
+                    "pipeline checkpoint: saved at %d stage(s) "
+                    "(schedule %s), restoring into %d — dense layer "
+                    "arrays re-stage on the next bind",
+                    saved_stages, pipe_desc.get("schedule"),
+                    now_stages)
         if hasattr(trainer, "params") and isinstance(
                 getattr(trainer, "params"), dict):
             # ParallelTrainer: rebind the device pytrees

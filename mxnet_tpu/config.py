@@ -543,6 +543,39 @@ register_flag(
     "elastic, bench.py --elastic). The rescaled-batch/LR accounting "
     "exists to keep runs inside it.")
 register_flag(
+    "MXPIPE_SCHEDULE", str, "1f1b",
+    "Microbatch schedule for pipelined training (mxnet_tpu/pipe/"
+    "schedule.py, docs/pipeline.md): '1f1b' (non-interleaved one-"
+    "forward-one-backward — same tick count and bubble as GPipe but "
+    "peak in-flight activations bounded at min(M, S-s) per stage) or "
+    "'gpipe' (all forwards then all backwards; peak in-flight = M "
+    "everywhere). Both are explicit dependency-validated tick "
+    "programs; bubble fraction is (S-1)/(M+S-1) for either.",
+    choices=("1f1b", "gpipe"))
+register_flag(
+    "MXPIPE_MICROBATCH", int, 0,
+    "Microbatch count M for the pipeline schedule "
+    "(pipe.PipeStepFunction). 0 = auto: M = n_stage, the smallest M "
+    "that keeps every stage busy in steady state; raise it to shrink "
+    "the bubble fraction (S-1)/(M+S-1) at the cost of more ticks. "
+    "The global batch must divide by M — pipelint flags violations "
+    "as errors before the runner raises.")
+register_flag(
+    "MXPIPE_STAGES", int, 0,
+    "Pipeline stage count S. 0 = auto: one stage per host in the "
+    "elastic/pod membership view (a lost host is a lost stage), or 1 "
+    "outside a session. The LM's layer count must divide by S; "
+    "checkpoints save the DENSE layout, so the same checkpoint "
+    "restores into any valid S (docs/pipeline.md re-stage section).")
+register_flag(
+    "MXPIPE_BALANCE_TOL", float, 0.25,
+    "Stage-balance threshold for passes/pipelint.py: a stage whose "
+    "param bytes deviate from the per-stage mean by more than this "
+    "fraction draws a warn (the pipeline clocks at the SLOWEST "
+    "stage, so imbalance is pure bubble). First/last stages "
+    "legitimately carry embed/head extras; size the tolerance to "
+    "what your vocab adds.")
+register_flag(
     "MXGUARD", bool, False,
     "Silent-corruption integrity taps (mxnet_tpu/guard/, docs/"
     "resilience.md integrity section): per-gradient fingerprints "

@@ -48,7 +48,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .train import adam_init, adam_apply
 
 __all__ = ["init_pipeline_lm", "truncate_pipeline_lm",
-           "pipeline_lm_shardings",
+           "pipeline_lm_shardings", "stage_params", "unstage_params",
            "build_pipeline_lm_step", "dense_lm_loss", "dense_lm_logits",
            "pipeline_lm_loss", "combined_mesh_drill"]
 
@@ -136,6 +136,18 @@ def stage_params(params: Dict, n_stage: int) -> Dict:
     out["layers"] = jax.tree.map(
         lambda v: v.reshape((n_stage, v.shape[0] // n_stage) + v.shape[1:]),
         params["layers"])
+    return out
+
+
+def unstage_params(params_staged: Dict) -> Dict:
+    """Inverse of :func:`stage_params`: collapse the leading
+    (n_stage, per_stage) dims back to (L, ...) — the dense layout
+    checkpoints store, so saved params stay stage-count-independent
+    (mxnet_tpu/pipe restores them into any stage count dividing L)."""
+    out = dict(params_staged)
+    out["layers"] = jax.tree.map(
+        lambda v: v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:]),
+        params_staged["layers"])
     return out
 
 

@@ -206,7 +206,7 @@ def findings_report(tool: str, findings: Iterable[Finding],
 def default_manager() -> PassManager:
     from . import (oplint, graphlint, tracercheck, dispatchlint,
                    steplint, shardlint, servelint, elasticlint,
-                   guardlint, metriclint, racelint, obslint)
+                   guardlint, metriclint, racelint, obslint, pipelint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
@@ -215,6 +215,7 @@ def default_manager() -> PassManager:
     pm.register(steplint.OptimizerFusionAudit())
     pm.register(shardlint.ShardLint())
     pm.register(servelint.ServeLint())
+    pm.register(pipelint.PipeLint())
     pm.register(elasticlint.ElasticAbortAudit())
     pm.register(elasticlint.PodScopeAudit())
     pm.register(guardlint.GuardLint())

@@ -1,0 +1,163 @@
+"""pipelint: the pipeline tier's balance / divisibility / closed-cache
+contract.
+
+mxpipe's performance story rests on invariants the type system cannot
+enforce, and every one of them fails SILENTLY — the pipeline still
+trains, it is just slow or retracing:
+
+1. **stage balance** — the schedule's steady state clocks at the
+   SLOWEST stage; a stage carrying disproportionate parameter bytes
+   drags every tick. Imbalance beyond ``MXPIPE_BALANCE_TOL`` (relative
+   spread vs the mean) warns with the per-stage byte census.
+2. **microbatch divisibility** — the global batch must split exactly
+   into ``n_micro`` microbatches; a remainder means some microbatch
+   carries a different shape, which is either a crash or a fresh
+   compile per step. stepfn raises at step time; the lint catches the
+   configured-but-not-yet-stepped case and the report of a stepped
+   function records what it actually saw.
+3. **warmed transfer rungs** — every stage-transfer shape
+   ``(kind, shape, dtype)`` must be declared and touched during
+   warmup. A declared-but-never-warmed rung means the first live step
+   pays the transfer's first-use cost in the steady state; an
+   undeclared shape showing up later is the off-rung retrace class
+   servelint polices for serving.
+4. **closed jit cache** — ``recompiles_after_warmup`` must be 0; the
+   split-phase design compiles grad programs once per stage KIND and
+   update programs once per (stage kind, topology), nothing else.
+
+:class:`PipeLint` audits anything with the
+:meth:`~mxnet_tpu.pipe.stepfn.PipeStepFunction.lint_report` shape (or
+the dict itself). Registered in the default PassManager;
+``tools/mxlint.py --pipe`` runs it over live self-check pipelines,
+including deliberately bad fixtures.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding, Pass
+
+__all__ = ["PipeLint", "lint_pipe_report"]
+
+
+class PipeLint(Pass):
+    name = "pipelint"
+    order = 100
+
+    def run(self, target) -> List[Finding]:
+        rep = target if isinstance(target, dict) else target.lint_report()
+        return lint_pipe_report(rep)
+
+    def finding(self, check, obj, severity, message, loc=None):
+        return Finding(self.name, check, obj, severity, message, loc)
+
+
+def lint_pipe_report(rep: dict) -> List[Finding]:
+    """Audit one pipeline's ``lint_report()`` dict. See the module
+    docstring for the checks."""
+    from .. import config
+    p = PipeLint()
+    obj = str(rep.get("name", "<pipe>"))
+    out: List[Finding] = []
+    n_stage = int(rep.get("n_stage", 1) or 1)
+    n_micro = int(rep.get("n_micro", 1) or 1)
+    warmed = bool(rep.get("warmed"))
+
+    # 1. stage balance (relative spread of per-stage parameter bytes)
+    tol = float(config.get("MXPIPE_BALANCE_TOL"))
+    sizes = [int(b) for b in (rep.get("stage_param_bytes") or ())]
+    if len(sizes) > 1 and min(sizes) >= 0 and sum(sizes):
+        mean = sum(sizes) / len(sizes)
+        spread = (max(sizes) - min(sizes)) / mean if mean else 0.0
+        if spread > tol:
+            out.append(p.finding(
+                "stage-imbalance", obj, "warn",
+                f"per-stage parameter bytes {sizes} spread "
+                f"{spread:.2f}x of the mean (tolerance {tol}) — the "
+                "steady state clocks at the heaviest stage, so every "
+                "tick pays the imbalance (rebalance the layer split "
+                "or fold the embedding/head stages)"))
+
+    # 2. microbatch divisibility
+    batch = rep.get("batch")
+    if batch is not None and int(batch) % n_micro:
+        out.append(p.finding(
+            "microbatch-not-divisible", obj, "error",
+            f"global batch {batch} does not divide into n_micro="
+            f"{n_micro} microbatches — unequal microbatch shapes are "
+            "a fresh compile (or a crash) per step; pick n_micro "
+            f"dividing {batch}"))
+    if n_micro < n_stage:
+        out.append(p.finding(
+            "micro-lt-stages", obj, "warn",
+            f"n_micro={n_micro} < n_stage={n_stage}: the pipeline "
+            "never fills — bubble fraction "
+            f"{float(rep.get('bubble_fraction', 0)):.2f} and the "
+            "deeper stages idle most ticks (raise the microbatch "
+            "count toward >= the stage count)"))
+
+    # 3. transfer rung warmth
+    def canon(r):
+        # rungs arrive as (kind, shape, dtype) with the shape itself
+        # a sequence; deep-tuple so JSON round-tripped lists compare
+        # equal to live tuples
+        if isinstance(r, (list, tuple)):
+            return tuple(canon(e) for e in r)
+        return r
+    declared = {canon(r) for r in (rep.get("declared_rungs") or ())}
+    warmed_rungs = {canon(r) for r in (rep.get("warmed_rungs") or ())}
+    if warmed:
+        cold = declared - warmed_rungs
+        if cold:
+            out.append(p.finding(
+                "unwarmed-transfer-rungs", obj, "error",
+                f"{len(cold)} declared transfer rung(s) were never "
+                f"touched by the warmup step: {sorted(cold)[:4]} — "
+                "the first live step pays their first-use cost in "
+                "the steady state"))
+        stray = warmed_rungs - declared
+        if stray:
+            out.append(p.finding(
+                "off-rung-transfer", obj, "error",
+                f"transfer shape(s) {sorted(stray)[:4]} were used but "
+                "never declared — the silent per-shape retrace class: "
+                "some edge passed a live shape instead of a declared "
+                "rung"))
+    else:
+        out.append(p.finding(
+            "not-warmed", obj, "warn",
+            "pipeline never completed a warmup step — the jit cache "
+            "is open and every program compiles in the training "
+            "path"))
+
+    # 4. closed cache after warmup
+    after = int(rep.get("recompiles_after_warmup", 0) or 0)
+    if after:
+        out.append(p.finding(
+            "recompile-after-warmup", obj, "error",
+            f"{after} program(s) compiled after warmup declared the "
+            "cache closed (see the recompile auditor's pipe_step "
+            "entries for the triggering signatures)"))
+
+    # stage-map coverage (elastic remap produced a hole or a stage
+    # still assigned to a departed worker would show as a missing key)
+    smap = rep.get("stage_map") or {}
+    if smap:
+        covered = sorted(int(s) for s in smap)
+        if covered != list(range(n_stage)):
+            out.append(p.finding(
+                "stage-map-hole", obj, "error",
+                f"stage map covers stages {covered}, expected "
+                f"0..{n_stage - 1} — a remap left stages unowned; "
+                "those ticks would deadlock the schedule"))
+
+    # bubble-fraction report (informational: the schedule's cost)
+    bubble = rep.get("bubble_fraction")
+    if bubble is not None:
+        out.append(p.finding(
+            "bubble-fraction", obj, "info",
+            f"schedule {rep.get('schedule')!r} S={n_stage} "
+            f"M={n_micro}: bubble fraction {float(bubble):.3f} "
+            "(idle tick share of the steady state; shrink it by "
+            "raising the microbatch count)"))
+    return out

@@ -1,0 +1,46 @@
+"""mxpipe: pipeline parallelism as a first-class ShardPlan axis.
+
+``parallel/pipeline_lm.py`` models stages *inside* one jit (the
+in-mesh GPipe path over a ``'pipe'`` mesh axis — the TPU shape, where
+stage hops are ICI collectives). This package promotes stages to a
+schedulable, elastic, checkpointable axis of the whole training
+system, so data x tensor x pipeline compose as ``P("batch","model")``
+plus a stage mesh:
+
+- :mod:`~mxnet_tpu.pipe.schedule` — GPipe and 1F1B microbatch
+  schedules as explicit (stage, microbatch, phase) tick programs with
+  dependency-checked construction and closed-form bubble accounting;
+- :mod:`~mxnet_tpu.pipe.stepfn` — :class:`PipeStepFunction`, the
+  split-phase runner built on the elastic/stepfn.py machinery:
+  world-independent per-stage grad programs, one audited update
+  program per topology, fenced-round recovery on membership bumps;
+- :mod:`~mxnet_tpu.pipe.transfer` — stage-to-stage activation /
+  cotangent transfer: in-process handoff on a single host (and in-jit
+  collectives on TPU via the pipeline_lm path), the PR 15 fenced
+  socket transport across CPU-CI host processes — fixed-shape warmed
+  rungs, zero recompiles streaming, typed fences on bumps;
+- :mod:`~mxnet_tpu.pipe.plan` — :class:`PipePlan`, the ShardPlan that
+  grows the stage axis: staged param leaves (per
+  ``pipeline_lm.stage_params``), ZeRO ``state_spec`` composing per
+  stage, ``describe()``/``from_manifest()`` round-trip so checkpoints
+  stay mesh- AND stage-count-independent;
+- :mod:`~mxnet_tpu.pipe.model` — the LM stage adapters (split/merge
+  between the dense ``pipeline_lm`` layout and per-stage subtrees);
+- :mod:`~mxnet_tpu.pipe.worker` / :mod:`~mxnet_tpu.pipe.drill` — the
+  subprocess lost-stage drill: kill a mid-pipeline stage mid-load,
+  survivors re-stage via the bump→rebuild protocol.
+
+See docs/pipeline.md for semantics, bubble math, and the elastic
+re-stage runbook.
+"""
+from __future__ import annotations
+
+from .schedule import PipeSchedule, build_schedule, gpipe, one_f_one_b  # noqa: F401
+from .model import LMStageModel  # noqa: F401
+from .plan import PipePlan  # noqa: F401
+from .stepfn import PipeStepFunction  # noqa: F401
+from .transfer import LocalTransport, SessionTransport  # noqa: F401
+
+__all__ = ["PipeSchedule", "build_schedule", "gpipe", "one_f_one_b",
+           "LMStageModel", "PipePlan", "PipeStepFunction",
+           "LocalTransport", "SessionTransport"]

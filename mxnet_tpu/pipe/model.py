@@ -1,0 +1,108 @@
+"""LM stage adapters: the bridge between ``parallel/pipeline_lm``'s
+dense parameter layout and the per-stage subtrees the pipe runner
+schedules.
+
+The dense layout stacks per-layer params along a leading ``L`` dim
+(``layers.wqkv: (L, 3, D, H, K)`` etc.) with shared ``embed`` /
+``ln_f`` / ``head`` leaves. A stage split for ``S`` stages gives stage
+``s`` the layer slab ``[s*L/S : (s+1)*L/S)`` (exactly
+``pipeline_lm.stage_params``'s reshape, sliced), plus ``embed`` on
+stage 0 and ``ln_f``/``head`` on the last stage. Because the split is
+a pure reshape of homogeneous slabs, any stage count dividing ``L``
+yields the SAME model — which is what makes checkpoints stage-count-
+independent (save dense, re-stage at restore) and lost-stage remaps
+exact (survivors re-slice the replicated dense state).
+
+The forward/loss functions are the ``pipeline_lm`` layer math verbatim
+(``_layer`` + ``_rmsnorm`` + ``_lm_head_loss`` with ``_no_shard``), so
+the pipelined trajectories are compared against
+``pipeline_lm.dense_lm_loss`` — the same oracle the dp/tp/sp/ep dryrun
+uses.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..parallel.pipeline_lm import (_layer, _lm_head_loss, _no_shard,
+                                    stage_params, unstage_params)
+
+__all__ = ["LMStageModel"]
+
+
+def _stack_apply(layers, h):
+    def body(hc, lp):
+        return _layer(lp, hc, _no_shard), None
+
+    h, _ = jax.lax.scan(body, h, layers)
+    return h
+
+
+class LMStageModel:
+    """Stage-function bundle for the pipeline LM. All methods are pure
+    jax functions of (stage_params, arrays) — the runner jits them into
+    its per-stage program cache."""
+
+    # -- forward ---------------------------------------------------------
+    def fwd_first(self, p: Dict, tokens):
+        h = p["embed"][tokens]
+        return _stack_apply(p["layers"], h)
+
+    def fwd_mid(self, p: Dict, h):
+        return _stack_apply(p["layers"], h)
+
+    def loss(self, p: Dict, h, labels):
+        """Last stage: its layer slab, then final norm + head + mean
+        NLL. ``p`` carries ``ln_f``/``head`` for :func:`_lm_head_loss`."""
+        h = _stack_apply(p["layers"], h)
+        return _lm_head_loss(p, h, labels, _no_shard)
+
+    def loss_full(self, p: Dict, tokens, labels):
+        """The S==1 degenerate stage (first == last)."""
+        h = p["embed"][tokens]
+        return self.loss(p, h, labels)
+
+    # -- dense <-> staged layout ----------------------------------------
+    def split(self, params: Dict, n_stage: int) -> List[Dict]:
+        """Dense ``pipeline_lm`` params -> list of per-stage subtrees."""
+        S = int(n_stage)
+        L = params["layers"]["wqkv"].shape[0]
+        if S < 1 or L % S:
+            raise MXNetError(
+                f"LMStageModel.split: {L} layers do not divide into "
+                f"{S} stages")
+        staged = stage_params(params, S)["layers"]
+        out: List[Dict] = []
+        for s in range(S):
+            st: Dict = {"layers": {k: v[s] for k, v in staged.items()}}
+            if s == 0:
+                st["embed"] = params["embed"]
+            if s == S - 1:
+                st["ln_f"] = params["ln_f"]
+                st["head"] = params["head"]
+            out.append(st)
+        return out
+
+    def merge(self, stages: List[Dict]) -> Dict:
+        """Inverse of :meth:`split`: per-stage subtrees -> dense
+        params (leading layer dim restored by concatenation)."""
+        if not stages:
+            raise MXNetError("LMStageModel.merge: no stages")
+        layers = {k: jnp.concatenate([st["layers"][k]
+                                      for st in stages], axis=0)
+                  for k in stages[0]["layers"]}
+        return {"embed": stages[0]["embed"], "layers": layers,
+                "ln_f": stages[-1]["ln_f"], "head": stages[-1]["head"]}
+
+    def restage(self, stages: List[Dict], n_stage: int) -> List[Dict]:
+        """Re-slice a staged param (or adam mean/var) list into a
+        different stage count — the checkpoint-restore and elastic
+        re-stage primitive. Pure reshape: the model is unchanged."""
+        return self.split(self.merge(stages), n_stage)
+
+    # merge/split round-trip sanity used by tests
+    def unstage(self, params_staged: Dict) -> Dict:
+        return unstage_params(params_staged)

@@ -1,0 +1,228 @@
+"""PipePlan: the ShardPlan that grows the stage axis.
+
+Composition contract: data x tensor x pipeline from one object. The
+inner axes stay ShardPlan's (``P("batch", "model")`` over the named
+mesh); the stage axis adds one of two shapes:
+
+- **mesh-stage mode** (TPU): ``stage_axis`` IS a mesh axis
+  (``axes={"batch": -1, "pipe": 4}``). Staged param leaves — the
+  ``(n_stage, per_stage, ...)`` layout of ``pipeline_lm.
+  stage_params`` — place their leading dim on ``'pipe'`` and compose
+  the inner tensor spec after it; ZeRO optimizer-state sharding then
+  composes PER STAGE: dim 0 stays on the stage axis and the first
+  unstaged dim shards along the batch axis when divisible (the
+  cross-replica weight-update sharding, applied within each stage's
+  slab). Stage hops are in-jit collectives
+  (``parallel/pipeline_lm.py``).
+- **host-stage mode** (CPU CI, subprocess pods): ``stage_axis`` is NOT
+  in the mesh — stages map to host processes (one stage per survivor,
+  ``pipe.stepfn``), params replicate per host, and transfers ride the
+  fenced socket transport.
+
+``describe()``/``from_manifest()`` extend the ShardPlan manifest with
+a ``pipe`` section, keeping checkpoints mesh- AND stage-count-
+independent: params are saved DENSE (``unstage_params`` layout), the
+manifest records the stage count they were trained at, and restore
+re-stages the same dense arrays into whatever stage count the new
+topology wants (``n_stage=`` override, ``MXPIPE_STAGES``, or the
+recorded value — in that order). ``ShardPlan.from_manifest``
+dispatches here when it sees the ``pipe`` section, so existing
+checkpoint plumbing resolves pipelined manifests with no changes.
+"""
+from __future__ import annotations
+
+import fnmatch
+from typing import Dict, Optional, Tuple
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..shard.plan import ShardPlan, _spec_tuple
+from .schedule import SCHEDULE_KINDS
+
+__all__ = ["PipePlan"]
+
+_STAGED_DEFAULT = ("layers.*", "layers/*", "*.layers.*")
+
+
+class PipePlan(ShardPlan):
+    """A :class:`~mxnet_tpu.shard.plan.ShardPlan` plus the stage axis.
+
+    Parameters (beyond ShardPlan's)
+    -------------------------------
+    n_stage : int
+        Pipeline stage count.
+    stage_axis : str
+        Stage axis name; if present in ``axes`` the plan is in
+        mesh-stage mode, else host-stage mode.
+    schedule : str
+        Microbatch schedule kind ('1f1b' | 'gpipe') — carried in the
+        manifest so a restore reproduces the training schedule.
+    n_microbatch : int
+        Microbatch count (0 = auto at use site).
+    staged_patterns : tuple of fnmatch globs
+        Param names whose leaves carry the leading stage dim.
+    """
+
+    def __init__(self, *, n_stage: int, stage_axis: str = "pipe",
+                 schedule: str = "1f1b", n_microbatch: int = 0,
+                 staged_patterns: Tuple[str, ...] = _STAGED_DEFAULT,
+                 **kw):
+        super().__init__(**kw)
+        self.n_stage = int(n_stage)
+        if self.n_stage < 1:
+            raise MXNetError(f"PipePlan: n_stage must be >= 1, got "
+                             f"{self.n_stage}")
+        self.stage_axis = str(stage_axis)
+        if schedule not in SCHEDULE_KINDS:
+            raise MXNetError(
+                f"PipePlan: unknown schedule {schedule!r} "
+                f"(choices: {SCHEDULE_KINDS})")
+        self.schedule = schedule
+        self.n_microbatch = int(n_microbatch)
+        self.staged_patterns = tuple(staged_patterns)
+        if self.mesh_stage and self.axes[self.stage_axis] != self.n_stage:
+            raise MXNetError(
+                f"PipePlan: mesh axis {self.stage_axis!r} has size "
+                f"{self.axes[self.stage_axis]} but n_stage="
+                f"{self.n_stage}")
+
+    # ------------------------------------------------------------------
+    @property
+    def mesh_stage(self) -> bool:
+        """True when the stage axis is a real mesh axis (in-jit stage
+        hops); False in host-stage mode (subprocess stages)."""
+        return self.stage_axis in self.axes
+
+    def is_staged(self, name: str) -> bool:
+        return any(fnmatch.fnmatchcase(name, pat)
+                   for pat in self.staged_patterns)
+
+    # -- specs: stage axis composes ahead of the inner tensor spec -------
+    def param_spec(self, name: str, value) -> NamedSharding:
+        if not (self.mesh_stage and self.is_staged(name)):
+            return super().param_spec(name, value)
+        shape = tuple(getattr(value, "shape", ()))
+        if not shape or shape[0] != self.n_stage:
+            raise MXNetError(
+                f"PipePlan: staged param {name!r} has leading dim "
+                f"{shape[:1]} != n_stage {self.n_stage} — stage the "
+                "tree with pipeline_lm.stage_params first")
+        inner = tuple(self._param_pspec(name))
+        return NamedSharding(self.mesh, P(self.stage_axis, *inner))
+
+    def state_spec(self, name: str, value) -> NamedSharding:
+        """ZeRO composing per stage: staged leaves keep dim 0 on the
+        stage axis and shard the first per-stage dim along the batch
+        axis when unsharded and divisible."""
+        if not (self.mesh_stage and self.is_staged(name)):
+            return super().state_spec(name, value)
+        shape = tuple(getattr(value, "shape", ()))
+        inner = list(tuple(self._param_pspec(name))[:max(0,
+                                                         len(shape) - 1)])
+        inner += [None] * (len(shape) - 1 - len(inner))
+        if (self.zero and len(shape) > 1 and inner
+                and inner[0] is None and self.n_batch > 1
+                and shape[1] % self.n_batch == 0):
+            inner[0] = self.batch_axis
+        while inner and inner[-1] is None:
+            inner.pop()
+        return NamedSharding(self.mesh, P(self.stage_axis, *inner))
+
+    def fingerprint(self) -> Tuple:
+        return super().fingerprint() + (
+            self.n_stage, self.stage_axis, self.schedule,
+            self.n_microbatch, self.staged_patterns)
+
+    # -- manifest round-trip --------------------------------------------
+    def describe(self) -> Dict[str, object]:
+        desc = super().describe()
+        desc["pipe"] = {"n_stage": self.n_stage,
+                        "stage_axis": self.stage_axis,
+                        "schedule": self.schedule,
+                        "n_microbatch": self.n_microbatch,
+                        "staged_patterns": list(self.staged_patterns)}
+        return desc
+
+    @classmethod
+    def from_manifest(cls, desc: Dict[str, object], devices=None,
+                      n_stage: Optional[int] = None) -> "PipePlan":
+        """Rebuild on the CURRENT topology. Stage count precedence:
+        explicit ``n_stage=`` > ``MXPIPE_STAGES`` (when > 0) > the
+        recorded value — so a 4-stage checkpoint restores at 2 stages
+        by flag alone, with the dense arrays re-staged downstream."""
+        from .. import config
+        pipe = dict(desc.get("pipe") or {})
+        recorded = int(pipe.get("n_stage", 1))
+        if n_stage is None:
+            env = int(config.get("MXPIPE_STAGES"))
+            n_stage = env if env > 0 else recorded
+        stage_axis = str(pipe.get("stage_axis", "pipe"))
+        axes = {n: int(s) for n, s in desc["axes"]}
+        batch_axis = desc["batch_axis"]
+        axes[batch_axis] = -1
+        if stage_axis in axes:
+            axes[stage_axis] = int(n_stage)
+        param_specs = {p: P(*[None if e is None else
+                              (tuple(e) if isinstance(e, list) else e)
+                              for e in spec])
+                       for p, spec in (desc.get("param_specs")
+                                       or {}).items()}
+        return cls(n_stage=int(n_stage), stage_axis=stage_axis,
+                   schedule=str(pipe.get("schedule", "1f1b")),
+                   n_microbatch=int(pipe.get("n_microbatch", 0)),
+                   staged_patterns=tuple(pipe.get("staged_patterns")
+                                         or _STAGED_DEFAULT),
+                   axes=axes, batch_axis=batch_axis,
+                   zero=bool(desc.get("zero", True)),
+                   param_specs=param_specs, devices=devices)
+
+    # -- re-staging ------------------------------------------------------
+    @staticmethod
+    def restage_leaf(value, n_stage: int):
+        """(S, per, ...) -> (n_stage, L/n_stage, ...) through the dense
+        (L, ...) layout — a pure reshape, so any stage count dividing
+        L yields the same model."""
+        shape = tuple(value.shape)
+        if len(shape) < 2:
+            raise MXNetError(
+                f"PipePlan.restage_leaf: leaf of shape {shape} has no "
+                "(stage, per_stage) leading dims")
+        L = shape[0] * shape[1]
+        if L % n_stage:
+            raise MXNetError(
+                f"PipePlan.restage_leaf: {L} layers do not divide "
+                f"into {n_stage} stages")
+        return value.reshape((n_stage, L // n_stage) + shape[2:])
+
+    def restage(self, tree, n_stage: Optional[int] = None):
+        """Re-stage every STAGED leaf of a ``stage_params``-layout
+        tree into this plan's (or the given) stage count."""
+        import jax
+        n = int(n_stage or self.n_stage)
+        flat = _flatten_named(tree)
+        out = {name: (self.restage_leaf(v, n) if self.is_staged(name)
+                      else v)
+               for name, v in flat.items()}
+        return jax.tree.unflatten(
+            jax.tree.structure(tree),
+            [out[name] for name in flat])
+
+    def __repr__(self):
+        axes = ",".join(f"{n}:{s}" for n, s in self.axes.items())
+        mode = "mesh" if self.mesh_stage else "host"
+        return (f"<PipePlan mesh[{axes}] stages={self.n_stage} "
+                f"({mode}) schedule={self.schedule} zero={self.zero}>")
+
+
+def _flatten_named(tree) -> Dict[str, object]:
+    """{dotted.path: leaf} in treedef order."""
+    import jax
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in paths:
+        name = ".".join(
+            str(getattr(k, "key", getattr(k, "idx", k)))
+            for k in path)
+        out[name] = leaf
+    return out

@@ -1,0 +1,243 @@
+"""Microbatch schedules as explicit tick programs.
+
+A schedule is a table: tick t lists the work items — ``(stage,
+microbatch, phase)`` with phase F (forward) or B (backward) — that
+execute concurrently at that tick, at most one item per stage. Making
+the program *explicit data* (rather than control flow buried in a
+runner) buys three things the elastic design needs:
+
+1. every host walks the SAME globally-known tick list, so the order of
+   fenced transfer rounds on the CPU-CI socket transport is agreed
+   without any out-of-band coordination;
+2. correctness is checkable by construction: :meth:`PipeSchedule.
+   validate` proves every consumed activation/cotangent was produced
+   at a strictly earlier tick, and the fake-clock unit tests pin tick
+   order without running any real computation;
+3. bubble accounting is closed-form: ``2*M*S`` busy slots on an
+   ``n_ticks x S`` grid; both GPipe and non-interleaved 1F1B fill
+   ``2(M + S - 1)`` ticks, so the bubble fraction is
+   ``(S-1)/(M+S-1)`` — the schedules differ in peak in-flight
+   activations (1F1B holds at most ``min(M, S-s)`` live forwards on
+   stage s; GPipe holds all M), not in bubble.
+
+Schedules are built by simulating the per-stage issue policy against
+the data dependencies (F(s,m) needs F(s-1,m); B(s,m) needs B(s+1,m),
+or F(S-1,m) on the last stage):
+
+- **gpipe** — a stage prefers F whenever one is ready: all forwards
+  drain through the pipe, then all backwards.
+- **1f1b** — stage s warms up ``min(M, S-s)`` forwards, then
+  strictly alternates one-backward-one-forward, bounding live
+  activation memory at the warmup depth.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["WorkItem", "PipeSchedule", "build_schedule", "gpipe",
+           "one_f_one_b", "SCHEDULE_KINDS"]
+
+SCHEDULE_KINDS = ("gpipe", "1f1b")
+
+
+class WorkItem(NamedTuple):
+    stage: int
+    micro: int
+    phase: str  # "F" | "B"
+
+
+class PipeSchedule:
+    """An immutable (ticks x stages) program. ``ticks[t]`` is a tuple
+    of :class:`WorkItem` sorted by stage — the in-tick execution (and
+    fenced-round) order."""
+
+    def __init__(self, kind: str, n_stage: int, n_micro: int,
+                 ticks: Tuple[Tuple[WorkItem, ...], ...]):
+        self.kind = kind
+        self.n_stage = int(n_stage)
+        self.n_micro = int(n_micro)
+        self.ticks = ticks
+
+    # ------------------------------------------------------------------
+    @property
+    def n_ticks(self) -> int:
+        return len(self.ticks)
+
+    def bubble_fraction(self) -> float:
+        """Idle fraction of the (ticks x stages) grid: ``1 - 2MS /
+        (n_ticks * S)``."""
+        grid = self.n_ticks * self.n_stage
+        busy = 2 * self.n_micro * self.n_stage
+        return max(0.0, 1.0 - busy / grid) if grid else 0.0
+
+    def max_in_flight(self, stage: int) -> int:
+        """Peak live forwards (activations stashed awaiting their
+        backward) on ``stage`` — the schedule's activation-memory
+        watermark, which the 1F1B policy bounds at ``min(M, S-s)``."""
+        live = peak = 0
+        for tick in self.ticks:
+            for it in tick:
+                if it.stage != stage:
+                    continue
+                live += 1 if it.phase == "F" else -1
+                peak = max(peak, live)
+        return peak
+
+    def items(self):
+        for t, tick in enumerate(self.ticks):
+            for it in tick:
+                yield t, it
+
+    def validate(self) -> None:
+        """Prove the program is executable: each stage does each
+        (micro, phase) exactly once, at most one item per stage per
+        tick, and every dependency was produced at a strictly earlier
+        tick. Raises :class:`MXNetError` on violation."""
+        S, M = self.n_stage, self.n_micro
+        done_f = {}
+        done_b = {}
+        for t, tick in enumerate(self.ticks):
+            stages_this_tick = [it.stage for it in tick]
+            if len(stages_this_tick) != len(set(stages_this_tick)):
+                raise MXNetError(
+                    f"schedule {self.kind}: tick {t} runs a stage "
+                    "twice — a stage executes at most one work item "
+                    "per tick")
+            for it in tick:
+                if not (0 <= it.stage < S and 0 <= it.micro < M):
+                    raise MXNetError(
+                        f"schedule {self.kind}: out-of-range item "
+                        f"{it} at tick {t}")
+                if it.phase == "F":
+                    if it.stage > 0 and \
+                            done_f.get((it.stage - 1, it.micro),
+                                       t) >= t:
+                        raise MXNetError(
+                            f"schedule {self.kind}: F{it.stage},"
+                            f"{it.micro} at tick {t} consumes an "
+                            "activation not yet produced")
+                    if (it.stage, it.micro) in done_f:
+                        raise MXNetError(
+                            f"schedule {self.kind}: duplicate "
+                            f"F{it.stage},{it.micro}")
+                    done_f[(it.stage, it.micro)] = t
+                else:
+                    dep = (done_f.get((it.stage, it.micro), t)
+                           if it.stage == S - 1 else
+                           done_b.get((it.stage + 1, it.micro), t))
+                    if dep >= t:
+                        raise MXNetError(
+                            f"schedule {self.kind}: B{it.stage},"
+                            f"{it.micro} at tick {t} consumes a "
+                            "cotangent not yet produced")
+                    if (it.stage, it.micro) in done_b:
+                        raise MXNetError(
+                            f"schedule {self.kind}: duplicate "
+                            f"B{it.stage},{it.micro}")
+                    done_b[(it.stage, it.micro)] = t
+        if len(done_f) != S * M or len(done_b) != S * M:
+            raise MXNetError(
+                f"schedule {self.kind}: incomplete — "
+                f"{len(done_f)}/{S * M} forwards, "
+                f"{len(done_b)}/{S * M} backwards")
+
+    def describe(self) -> dict:
+        return {"kind": self.kind, "n_stage": self.n_stage,
+                "n_micro": self.n_micro, "n_ticks": self.n_ticks,
+                "bubble_fraction": self.bubble_fraction()}
+
+    def __repr__(self):
+        return (f"PipeSchedule({self.kind!r}, stages={self.n_stage}, "
+                f"micro={self.n_micro}, ticks={self.n_ticks}, "
+                f"bubble={self.bubble_fraction():.3f})")
+
+
+# ---------------------------------------------------------------------------
+# construction: simulate the issue policy against the dependencies
+# ---------------------------------------------------------------------------
+
+def _simulate(kind: str, n_stage: int, n_micro: int) -> PipeSchedule:
+    S, M = int(n_stage), int(n_micro)
+    if S < 1:
+        raise MXNetError(f"schedule: n_stage must be >= 1, got {S}")
+    if M < 1:
+        raise MXNetError(f"schedule: n_micro must be >= 1, got {M}")
+    done_f = [[-1] * M for _ in range(S)]   # completion tick, -1 = not yet
+    done_b = [[-1] * M for _ in range(S)]
+    nf = [0] * S                            # forwards issued per stage
+    nb = [0] * S                            # backwards issued per stage
+    warm = [min(M, S - s) for s in range(S)]
+    ticks: List[Tuple[WorkItem, ...]] = []
+    t = 0
+    # 2(M+S-1) ticks suffice for both policies; 4*(M+S)*S is a
+    # generous stall bound that turns a policy bug into a loud error
+    limit = 4 * (M + S) * S + 8
+    while any(nb[s] < M for s in range(S)):
+        if t > limit:
+            raise MXNetError(
+                f"schedule {kind}: stalled after {t} ticks "
+                f"(S={S}, M={M}) — issue-policy bug")
+        items = []
+        for s in range(S):
+            f_ready = nf[s] < M and (
+                s == 0 or 0 <= done_f[s - 1][nf[s]] < t)
+            b_ready = nb[s] < M and (
+                0 <= done_f[s][nb[s]] < t if s == S - 1
+                else 0 <= done_b[s + 1][nb[s]] < t)
+            if kind == "gpipe":
+                choice = "F" if f_ready else ("B" if b_ready else None)
+            else:  # 1f1b
+                in_flight = nf[s] - nb[s]
+                if nf[s] < warm[s]:
+                    choice = "F" if f_ready else (
+                        "B" if b_ready else None)
+                elif b_ready and (in_flight >= warm[s] or nf[s] >= M):
+                    choice = "B"
+                elif f_ready and in_flight < warm[s]:
+                    choice = "F"
+                elif b_ready:
+                    choice = "B"
+                else:
+                    choice = None
+            if choice == "F":
+                items.append(WorkItem(s, nf[s], "F"))
+            elif choice == "B":
+                items.append(WorkItem(s, nb[s], "B"))
+        # commit completions AFTER the whole tick is chosen: items in
+        # one tick run concurrently and cannot consume each other
+        for it in items:
+            if it.phase == "F":
+                done_f[it.stage][it.micro] = t
+                nf[it.stage] += 1
+            else:
+                done_b[it.stage][it.micro] = t
+                nb[it.stage] += 1
+        ticks.append(tuple(sorted(items, key=lambda i: i.stage)))
+        t += 1
+    sched = PipeSchedule(kind, S, M, tuple(ticks))
+    sched.validate()
+    return sched
+
+
+def gpipe(n_stage: int, n_micro: int) -> PipeSchedule:
+    """All forwards drain, then all backwards (maximum in-flight
+    activations = M on every stage)."""
+    return _simulate("gpipe", n_stage, n_micro)
+
+
+def one_f_one_b(n_stage: int, n_micro: int) -> PipeSchedule:
+    """Non-interleaved 1F1B: warm up ``min(M, S-s)`` forwards on stage
+    s, then alternate backward/forward — same tick count (and bubble)
+    as GPipe, ``min(M, S-s)`` peak in-flight activations."""
+    return _simulate("1f1b", n_stage, n_micro)
+
+
+def build_schedule(kind: str, n_stage: int, n_micro: int) -> PipeSchedule:
+    if kind not in SCHEDULE_KINDS:
+        raise MXNetError(
+            f"unknown pipeline schedule {kind!r} "
+            f"(choices: {SCHEDULE_KINDS})")
+    return gpipe(n_stage, n_micro) if kind == "gpipe" \
+        else one_f_one_b(n_stage, n_micro)

@@ -1,0 +1,527 @@
+"""PipeStepFunction: the split-phase pipelined train step.
+
+The elastic design (elastic/stepfn.py) splits the fused step at the
+exchange boundary so a membership bump can fence without killing a
+compiled program. The pipelined step inherits that split and applies
+it per STAGE:
+
+- **grad programs** — per stage-kind forward / backward / loss-grad
+  programs, compiled once per input signature. Their traces are
+  world-independent AND stage-position-independent for the homogeneous
+  mid stages: every mid stage on every host hits the same cached
+  program, which is what makes an elastic re-stage cheap (a survivor
+  adopting a lost stage only compiles programs for stage *kinds* it
+  never ran — typically zero for mid stages).
+- **host transfers** — activations and cotangents move between stages
+  through :mod:`~mxnet_tpu.pipe.transfer`: in-process for host-local
+  edges, one generation-fenced allreduce round per cross-host edge.
+  Every host walks the same schedule tick program, so round order is
+  globally agreed; a :class:`MembershipChanged` aborts the step with
+  no partial effect.
+- **update programs** — one per (stage-kind signature, world): the
+  microbatch rescale ``1/M`` is structural and the world token is part
+  of the key, so a topology change re-keys EXACTLY the update programs
+  (one per stage kind in the new world; returning to a seen world is a
+  cache hit) — the same audited budget as elastic's.
+
+Elastic model — *a lost host is a lost stage*: stage ownership is a
+pure function of the membership view (stage ``s`` -> sorted-survivor
+``s % world``), and on the CPU-CI socket path the full post-update
+(params, optimizer) state of every stage is replicated to every host
+by the end-of-step fenced sync rounds. A SIGKILLed host therefore
+takes no state with it: survivors fence, rebuild, recompute the stage
+map, and redo the interrupted step from the committed state —
+bit-identical inputs, so the loss trajectory continues as if the host
+had never existed. (On TPU meshes the same params live sharded on the
+``'pipe'`` mesh axis instead — parallel/pipeline_lm.py — and
+re-staging is a resharded restore; docs/pipeline.md.)
+
+Gradient math: per-microbatch grads are summed in fixed schedule
+order and scaled ``1/M`` inside the update program, which equals the
+full-batch mean gradient up to float reassociation — the declared
+``pipe_fp32`` tolerance class (:data:`PIPE_TOL_REL`) against the
+monolithic :func:`~mxnet_tpu.parallel.pipeline_lm.dense_lm_loss`
+oracle. Params are NOT donated into the update: the committed state
+must survive a fence during the sync rounds so the redo is exact.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import config
+from ..base import MXNetError
+from ..elastic.membership import MembershipChanged
+from ..parallel.train import adam_apply, adam_init
+from .model import LMStageModel
+from .schedule import PipeSchedule, build_schedule
+from .transfer import LocalTransport, SessionTransport
+
+__all__ = ["PipeStepFunction", "PIPE_TOL_REL"]
+
+# the declared tolerance class: pipelined-vs-monolithic differ only by
+# float32 summation order (microbatch mean vs full-batch mean), same
+# rtol the combined-mesh dryrun pins
+PIPE_TOL_REL = 2e-4
+
+_LOCAL = "local"
+
+
+def _sig(tree) -> Tuple:
+    return tuple((tuple(v.shape), str(v.dtype))
+                 for v in jax.tree.leaves(tree))
+
+
+def _tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def _tree_bytes(tree) -> int:
+    return int(sum(v.size * v.dtype.itemsize
+                   for v in jax.tree.leaves(tree)))
+
+
+class PipeStepFunction:
+    """Schedule-driven pipelined training over per-stage param
+    subtrees (see module docstring). ``params`` is the DENSE
+    ``pipeline_lm`` layout; the runner splits it into ``n_stage``
+    subtrees via the stage model and keeps (params, adam state)
+    replicated per host."""
+
+    def __init__(self, params, *, n_stage: Optional[int] = None,
+                 schedule: Optional[str] = None,
+                 n_microbatch: Optional[int] = None,
+                 lr: float = 1e-3, model: Optional[LMStageModel] = None,
+                 session=None, name: str = "pipe",
+                 on_restage: Optional[Callable] = None):
+        self._name = name
+        self._model = model or LMStageModel()
+        self._session = session
+        self._on_restage = on_restage
+        if n_stage is None:
+            n_stage = int(config.get("MXPIPE_STAGES"))
+            if n_stage <= 0:
+                n_stage = (session.world if session is not None
+                           else 1) or 1
+        self.n_stage = int(n_stage)
+        kind = schedule or str(config.get("MXPIPE_SCHEDULE"))
+        if n_microbatch is None:
+            n_microbatch = int(config.get("MXPIPE_MICROBATCH"))
+        self.n_micro = int(n_microbatch) if n_microbatch else \
+            max(1, self.n_stage)
+        self.schedule: PipeSchedule = build_schedule(
+            kind, self.n_stage, self.n_micro)
+        self._lr = float(lr)
+        self._stages: List = self._model.split(params, self.n_stage)
+        self._opt: List = [adam_init(st) for st in self._stages]
+        # state flatten layout per stage (sync rounds + re-stage): the
+        # treedef/shapes are world-independent, computed once
+        self._state_td = []
+        self._state_shapes = []
+        self._state_sizes = []
+        for st, op in zip(self._stages, self._opt):
+            leaves, td = jax.tree.flatten((st, op))
+            self._state_td.append(td)
+            self._state_shapes.append(
+                [(tuple(v.shape), str(v.dtype)) for v in leaves])
+            self._state_sizes.append(int(sum(v.size for v in leaves)))
+        self._transport = (SessionTransport(session, name)
+                           if session is not None
+                           else LocalTransport(name))
+        self._programs: Dict = {}
+        self._worlds_seen: set = set()
+        self._nstep = 0
+        self._warmed = False
+        self._recompiles_after_warmup = 0
+        self._last_batch: Optional[int] = None
+        self._last_loss: Optional[float] = None
+        self.stage_map: Dict[int, str] = {}
+        self._world_token: Tuple = ()
+        self._remap(initial=True)
+
+    # ------------------------------------------------------------------
+    # topology
+    # ------------------------------------------------------------------
+    def _me(self) -> str:
+        return self._session.worker_id if self._session is not None \
+            else _LOCAL
+
+    def _remap(self, initial: bool = False):
+        """Stage ownership as a pure function of the membership view:
+        stage s -> sorted-survivor s % world. Deterministic, so every
+        host computes the same map with no extra coordination."""
+        if self._session is not None:
+            workers = list(self._session.view.workers)
+            if not workers:
+                raise MXNetError("pipe: empty membership view")
+        else:
+            workers = [_LOCAL]
+        token = tuple(workers)
+        self.stage_map = {s: workers[s % len(workers)]
+                          for s in range(self.n_stage)}
+        changed = token != self._world_token
+        self._world_token = token
+        self._worlds_seen.add(token)
+        if changed and not initial and self._on_restage is not None:
+            self._on_restage(dict(self.stage_map), token)
+
+    @property
+    def world(self) -> int:
+        return len(self._world_token)
+
+    def worlds_seen(self) -> int:
+        return len(self._worlds_seen)
+
+    # ------------------------------------------------------------------
+    # program cache (the split-phase census)
+    # ------------------------------------------------------------------
+    def _program(self, kind: str, build: Callable, sig, extra=()):
+        key = (kind,) + tuple(extra) + (sig,)
+        fn = self._programs.get(key)
+        if fn is None:
+            from ..telemetry import metrics as _metrics
+            from ..telemetry import recompile as _recompile
+            _metrics.counter(
+                "mxpipe_program_compiles_total",
+                "pipe stage-program signature-cache misses "
+                "(compiles)").inc()
+            _recompile.record_recompile(
+                f"PipeStepFunction:{self._name}",
+                {"inputs": [{"shape": list(s[0]), "dtype": s[1]}
+                            for s in sig],
+                 "phase": kind, "world": len(self._world_token),
+                 "extra": list(map(str, extra))},
+                kind="pipe_step")
+            if self._warmed:
+                self._recompiles_after_warmup += 1
+            fn = jax.jit(build)
+            self._programs[key] = fn
+        return fn
+
+    def program_counts(self) -> Dict[str, int]:
+        grad = sum(1 for k in self._programs if k[0] != "update")
+        upd = sum(1 for k in self._programs if k[0] == "update")
+        return {"grad": grad, "update": upd, "total": grad + upd}
+
+    def program_census(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for k in self._programs:
+            out[k[0]] = out.get(k[0], 0) + 1
+        return out
+
+    # -- builders --------------------------------------------------------
+    def _fwd_fn(self, stage: int, x):
+        m = self._model
+        if stage == 0:
+            return self._program("fwd_first", m.fwd_first,
+                                 _sig((self._stages[0], x)))
+        return self._program("fwd_mid", m.fwd_mid,
+                             _sig((self._stages[stage], x)))
+
+    def _bwd_fn(self, stage: int, x, gy):
+        m = self._model
+        fwd = m.fwd_first if stage == 0 else m.fwd_mid
+        kind = "bwd_first" if stage == 0 else "bwd_mid"
+
+        def bwd(p, xin, g):
+            _, vjp = jax.vjp(fwd, p, xin)
+            gp, gx = vjp(g)
+            return (gp,) if stage == 0 else (gp, gx)
+
+        return self._program(kind, bwd,
+                             _sig((self._stages[stage], x, gy)))
+
+    def _loss_grad_fn(self, stage: int, x, labels):
+        m = self._model
+        if self.n_stage == 1:
+            def lg1(p, tok, lab):
+                loss, gp = jax.value_and_grad(m.loss_full)(p, tok, lab)
+                return loss, gp
+
+            return self._program("loss_grad_first", lg1,
+                                 _sig((self._stages[0], x, labels)))
+
+        def lg(p, h, lab):
+            loss, (gp, gx) = jax.value_and_grad(
+                m.loss, argnums=(0, 1))(p, h, lab)
+            return loss, gp, gx
+
+        return self._program("loss_grad", lg,
+                             _sig((self._stages[stage], x, labels)))
+
+    def _update_fn(self, stage: int):
+        rescale = 1.0 / float(self.n_micro)
+        lr = self._lr
+
+        def upd(p, opt, acc):
+            grads = jax.tree.map(lambda g: g * rescale, acc)
+            return adam_apply(p, grads, opt, lr=lr)
+
+        # world token in the key = THE re-key on a topology change;
+        # rescale/lr are structural like elastic's rescale_grad
+        return self._program(
+            "update", upd,
+            _sig((self._stages[stage], self._opt[stage])),
+            extra=(self._world_token, rescale, lr))
+
+    # ------------------------------------------------------------------
+    # state flatten / unflatten (sync rounds, checkpoint, re-stage)
+    # ------------------------------------------------------------------
+    def _flatten_state(self, stage: int, state) -> onp.ndarray:
+        leaves = jax.tree.flatten(state)[0]
+        return onp.concatenate(
+            [onp.asarray(v, dtype=onp.float32).ravel()
+             for v in leaves])
+
+    def _unflatten_state(self, stage: int, flat):
+        flat = onp.asarray(flat, dtype=onp.float32)
+        out, off = [], 0
+        for shape, dtype in self._state_shapes[stage]:
+            n = int(onp.prod(shape)) if shape else 1
+            seg = flat[off:off + n].reshape(shape)
+            out.append(jnp.asarray(seg).astype(dtype))
+            off += n
+        return jax.tree.unflatten(self._state_td[stage], out)
+
+    # ------------------------------------------------------------------
+    # the step
+    # ------------------------------------------------------------------
+    def step(self, tokens, labels) -> float:
+        """One pipelined train step over the global batch. Survives
+        membership bumps: fenced -> rebuild -> re-stage -> redo the
+        step from the committed replicated state."""
+        self._nstep += 1
+        B = int(tokens.shape[0])
+        self._last_batch = B
+        if B % self.n_micro:
+            raise MXNetError(
+                f"pipe: batch {B} is not divisible by n_microbatch "
+                f"{self.n_micro}")
+        session = self._session
+        if session is not None and session.heartbeat(self._nstep):
+            session.rebuild()
+            self._remap()
+        while True:
+            try:
+                loss = self._run_once(tokens, labels)
+                break
+            except MembershipChanged:
+                # a stage died mid-step: rebuild with the survivors,
+                # recompute stage ownership, redo the WHOLE step from
+                # the committed state (replicated, so nothing was
+                # lost) — bit-identical inputs, unchanged trajectory
+                session.rebuild()
+                self._remap()
+                continue
+        if not self._warmed:
+            self._warmed = True
+        self._last_loss = float(loss)
+        return self._last_loss
+
+    def _run_once(self, tokens, labels):
+        S, M = self.n_stage, self.n_micro
+        B = int(tokens.shape[0])
+        mb = B // M
+        me = self._me()
+        own = self.stage_map
+        local = isinstance(self._transport, LocalTransport)
+        gen = (self._session.generation if self._session is not None
+               else 0)
+        # fixed-shape rungs: activations and cotangents share (mb, T,
+        # D); declared before the walk so lint can see gaps
+        D = int(self._stages[0]["embed"].shape[1])
+        T = int(tokens.shape[1])
+        act_t = ((mb, T, D), "float32")
+        if S > 1:
+            self._transport.rungs.declare("act", act_t[0], act_t[1])
+            self._transport.rungs.declare("cot", act_t[0], act_t[1])
+        if not local:
+            self._transport.rungs.declare("loss", (), "float32")
+            for s in range(S):
+                self._transport.rungs.declare(
+                    "sync", (self._state_sizes[s],), "float32")
+
+        x_in: Dict = {}      # (stage, micro) -> stashed stage input
+        outbox: Dict = {}    # (stage, micro) -> activation for s+1
+        cotbox: Dict = {}    # (stage, micro) -> cotangent for s-1
+        acc: Dict = {s: None for s in range(S) if own[s] == me}
+        losses: List = []
+
+        def slice_mb(arr, m):
+            return arr[m * mb:(m + 1) * mb]
+
+        def edge_xfer(kind: str, src: int, dst: int, m: int, value):
+            """One (maybe cross-host) edge. Returns the payload on the
+            receiving host, None elsewhere."""
+            key = f"{kind}|g{gen}|n{self._nstep}|e{src}-{dst}|m{m}"
+            if own[src] == own[dst]:
+                if own[dst] == me:
+                    return self._transport.send_recv(key, value) \
+                        if local else \
+                        LocalTransport.send_recv(
+                            self._local_side(), key, value)
+                return None
+            out = self._transport.send_recv(key, value,
+                                            template=act_t)
+            return out if own[dst] == me else None
+
+        for _t, it in self.schedule.items():
+            s, m = it.stage, it.micro
+            if it.phase == "F":
+                if s == 0:
+                    x = slice_mb(tokens, m) if own[0] == me else None
+                else:
+                    v = outbox.pop((s - 1, m), None) \
+                        if own[s - 1] == me else None
+                    x = edge_xfer("act", s - 1, s, m, v)
+                if own[s] != me:
+                    continue
+                x_in[(s, m)] = x
+                if s < S - 1:
+                    y = self._fwd_fn(s, x)(self._stages[s], x)
+                    outbox[(s, m)] = y
+                # last stage: forward is folded into the loss-grad
+                # program at its B tick (recompute design)
+            else:  # B
+                if s == S - 1:
+                    if own[s] == me:
+                        x = x_in.pop((s, m))
+                        lab = slice_mb(labels, m)
+                        if S == 1:
+                            loss_m, gp = self._loss_grad_fn(
+                                s, x, lab)(self._stages[s], x, lab)
+                            gx = None
+                        else:
+                            loss_m, gp, gx = self._loss_grad_fn(
+                                s, x, lab)(self._stages[s], x, lab)
+                        losses.append(loss_m)
+                        if gx is not None:
+                            cotbox[(s, m)] = gx
+                        acc[s] = gp if acc[s] is None \
+                            else _tree_add(acc[s], gp)
+                else:
+                    v = cotbox.pop((s + 1, m), None) \
+                        if own[s + 1] == me else None
+                    gy = edge_xfer("cot", s + 1, s, m, v)
+                    if own[s] != me:
+                        continue
+                    x = x_in.pop((s, m))
+                    if s == 0:
+                        (gp,) = self._bwd_fn(s, x, gy)(
+                            self._stages[s], x, gy)
+                    else:
+                        gp, gx = self._bwd_fn(s, x, gy)(
+                            self._stages[s], x, gy)
+                        cotbox[(s, m)] = gx
+                    acc[s] = gp if acc[s] is None \
+                        else _tree_add(acc[s], gp)
+
+        # -- updates (pure: nothing committed yet) ---------------------
+        new_state: Dict[int, Tuple] = {}
+        for s in sorted(acc):
+            p2, o2 = self._update_fn(s)(self._stages[s],
+                                        self._opt[s], acc[s])
+            new_state[s] = (p2, o2)
+
+        # -- loss + state sync rounds, then commit ---------------------
+        if local:
+            loss = float(jnp.mean(jnp.stack(losses)))
+            for s, (p2, o2) in new_state.items():
+                self._stages[s] = p2
+                self._opt[s] = o2
+            return loss
+
+        last_owner = own[S - 1]
+        lval = (onp.asarray(
+            jnp.mean(jnp.stack(losses)), dtype=onp.float32)
+            if last_owner == me else None)
+        loss_out = self._transport.send_recv(
+            f"loss|g{gen}|n{self._nstep}", lval,
+            template=((), "float32"))
+        staged: Dict[int, Tuple] = {}
+        for s in range(S):
+            flat = (self._flatten_state(s, new_state[s])
+                    if own[s] == me else None)
+            out = self._transport.send_recv(
+                f"sync|g{gen}|n{self._nstep}|st{s}", flat,
+                template=((self._state_sizes[s],), "float32"))
+            staged[s] = self._unflatten_state(s, out)
+        # every round of the generation succeeded -> commit (a fence
+        # above left self._stages/_opt untouched for the redo)
+        for s, (p2, o2) in staged.items():
+            self._stages[s] = p2
+            self._opt[s] = o2
+        return float(loss_out)
+
+    def _local_side(self) -> LocalTransport:
+        # host-local edges inside a socket run still record rung
+        # warmth through a LocalTransport facet
+        side = getattr(self, "_local_facet", None)
+        if side is None:
+            side = LocalTransport(self._name + ".local")
+            side.rungs = self._transport.rungs
+            self._local_facet = side
+        return side
+
+    # ------------------------------------------------------------------
+    # state accessors (checkpoint / tests)
+    # ------------------------------------------------------------------
+    @property
+    def stages(self) -> List:
+        return self._stages
+
+    def dense_params(self):
+        """Merged stage-count-independent params (checkpoint layout)."""
+        return self._model.merge(self._stages)
+
+    def dense_opt(self):
+        """Merged adam state in the dense layout (t from stage 0 —
+        every stage updates once per step, so the counters agree)."""
+        mean = self._model.merge([o["mean"] for o in self._opt])
+        var = self._model.merge([o["var"] for o in self._opt])
+        return {"mean": mean, "var": var, "t": self._opt[0]["t"]}
+
+    def load_dense(self, params, opt=None):
+        """Install dense (params, adam state) into the CURRENT stage
+        count — the restore path: a checkpoint saved at 4 stages
+        restores into 2 by re-slicing the same dense arrays."""
+        self._stages = self._model.split(params, self.n_stage)
+        if opt is None:
+            self._opt = [adam_init(st) for st in self._stages]
+        else:
+            means = self._model.split(opt["mean"], self.n_stage)
+            vars_ = self._model.split(opt["var"], self.n_stage)
+            t = opt["t"]
+            self._opt = [{"mean": m, "var": v, "t": t}
+                         for m, v in zip(means, vars_)]
+
+    def stage_param_bytes(self) -> List[int]:
+        return [_tree_bytes(st) for st in self._stages]
+
+    # ------------------------------------------------------------------
+    # lint surface
+    # ------------------------------------------------------------------
+    def lint_report(self) -> dict:
+        rungs = self._transport.rungs
+        return {
+            "name": self._name,
+            "schedule": self.schedule.kind,
+            "n_stage": self.n_stage,
+            "n_micro": self.n_micro,
+            "batch": self._last_batch,
+            "divisible": (self._last_batch % self.n_micro == 0
+                          if self._last_batch else None),
+            "warmed": self._warmed,
+            "bubble_fraction": self.schedule.bubble_fraction(),
+            "stage_param_bytes": self.stage_param_bytes(),
+            "declared_rungs": sorted(rungs.declared),
+            "warmed_rungs": sorted(rungs.warmed),
+            "recompiles_after_warmup": self._recompiles_after_warmup,
+            "stage_map": {int(s): w for s, w in self.stage_map.items()},
+            "world": self.world,
+            "programs": self.program_census(),
+        }

@@ -1,0 +1,132 @@
+"""Stage-to-stage activation / cotangent transfer.
+
+Three transports, one contract. A transfer is keyed by ``(edge, kind,
+microbatch)`` inside a step; its payload shape is a **rung** — a fixed
+``(kind, shape, dtype)`` the runner declares up front and warms on the
+first step, exactly the pagewire discipline: after warmup, streaming
+activations never compiles or allocates a new shape.
+
+- **in-jit (TPU)**: stage hops are ``ppermute``/``psum`` collectives
+  inside one jit over the ``'pipe'`` mesh axis — that path lives in
+  ``parallel/pipeline_lm.py`` and is selected by
+  :class:`~mxnet_tpu.pipe.plan.PipePlan` mesh-stage mode; no transport
+  object is involved.
+- :class:`LocalTransport` — host-local edges (single-process runs, and
+  edges whose two stages landed on the same host after a remap): a
+  lock-protected mailbox; records rung warmth so lint sees one code
+  path.
+- :class:`SessionTransport` — cross-host edges on CPU CI: each
+  transfer is ONE generation-fenced allreduce round through the PR 15
+  elastic session (the sender contributes the payload, every other
+  member contributes zeros, the sum IS the payload). All hosts walk
+  the same schedule tick program, so round order agrees globally; a
+  membership bump raises the same typed
+  :class:`~mxnet_tpu.elastic.membership.MembershipChanged` fence as
+  the gradient exchange, with no partial effect.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Set, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..san.runtime import make_lock
+
+__all__ = ["Rung", "LocalTransport", "SessionTransport"]
+
+Rung = Tuple[str, Tuple[int, ...], str]  # (kind, shape, dtype)
+
+
+class _RungBook:
+    """Declared-vs-warmed rung accounting shared by both transports
+    (the pipelint ``schedule-without-warmed-transfer-rungs`` check
+    reads this through the runner's lint_report)."""
+
+    def __init__(self):
+        self.declared: Set[Rung] = set()
+        self.warmed: Set[Rung] = set()
+
+    def declare(self, kind: str, shape, dtype) -> Rung:
+        rung = (str(kind), tuple(int(d) for d in shape), str(dtype))
+        self.declared.add(rung)
+        return rung
+
+    def touch(self, kind: str, shape, dtype):
+        rung = (str(kind), tuple(int(d) for d in shape), str(dtype))
+        self.warmed.add(rung)
+        return rung
+
+
+class LocalTransport:
+    """In-process mailbox for host-local stage edges."""
+
+    def __init__(self, name: str = "pipe"):
+        self.name = name
+        self.rungs = _RungBook()
+        self._lock = make_lock(f"pipe.transfer.local.{name}")
+        self._box: Dict[str, object] = {}
+
+    def send_recv(self, key: str, value, *, template=None):
+        """Same-host edge: the producer already ran at an earlier tick
+        of this host's walk, so this is a put+pop in one call."""
+        if value is None:
+            raise MXNetError(
+                f"LocalTransport {self.name}: local edge {key!r} has "
+                "no payload — producer did not run on this host")
+        self.rungs.touch(key.split("|", 1)[0], value.shape, value.dtype)
+        return value
+
+    def lint_report(self) -> dict:
+        return {"transport": "local",
+                "declared_rungs": sorted(self.rungs.declared),
+                "warmed_rungs": sorted(self.rungs.warmed)}
+
+
+class SessionTransport:
+    """Cross-host edges over the fenced socket transport. One
+    allreduce round per transfer; zeros from non-senders."""
+
+    def __init__(self, session, name: str = "pipe"):
+        self.session = session
+        self.name = name
+        self.rungs = _RungBook()
+        self._lock = make_lock(f"pipe.transfer.session.{name}")
+        self.rounds = 0
+
+    def send_recv(self, key: str, value, *, template=None):
+        """One fenced round. ``value`` is the payload on the sending
+        host and ``None`` elsewhere; ``template`` gives (shape, dtype)
+        so non-senders contribute matching zeros. Every group member
+        MUST call this for the same ``key`` in the same order — the
+        schedule tick program guarantees that. Raises
+        ``MembershipChanged`` through, with no partial effect."""
+        if value is not None:
+            payload = onp.asarray(value, dtype=onp.float32)
+            shape, dtype = payload.shape, template[1] if template \
+                else str(payload.dtype)
+        elif template is not None:
+            shape, dtype = tuple(template[0]), str(template[1])
+            payload = onp.zeros(shape, onp.float32)
+        else:
+            raise MXNetError(
+                f"SessionTransport {self.name}: non-sender for "
+                f"{key!r} needs a (shape, dtype) template")
+        kind = key.split("|", 1)[0]
+        with self._lock:
+            self.rounds += 1
+        out = self.session.allreduce(f"__pipe_{key}", payload)
+        self.rungs.touch(kind, shape, dtype)
+        import jax.numpy as jnp
+        return jnp.asarray(out).astype(dtype)
+
+    def lint_report(self) -> dict:
+        return {"transport": "session", "rounds": self.rounds,
+                "declared_rungs": sorted(self.rungs.declared),
+                "warmed_rungs": sorted(self.rungs.warmed)}
+
+
+def pick_transport(session: Optional[object], name: str = "pipe"):
+    """Session present -> fenced socket rounds; else in-process."""
+    return SessionTransport(session, name) if session is not None \
+        else LocalTransport(name)

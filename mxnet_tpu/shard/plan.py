@@ -217,7 +217,14 @@ class ShardPlan:
         """Rebuild a plan from a manifest on the CURRENT device count:
         non-batch axes keep their recorded sizes; the batch axis is
         re-inferred (-1), so a checkpoint from a 16-device mesh restores
-        onto 8 (or 4) without user arithmetic."""
+        onto 8 (or 4) without user arithmetic. Manifests carrying a
+        ``pipe`` section (stage-axis plans) resolve to
+        :class:`~mxnet_tpu.pipe.plan.PipePlan`, which additionally
+        re-infers the stage count — existing checkpoint plumbing stays
+        pipeline-agnostic."""
+        if "pipe" in desc and cls is ShardPlan:
+            from ..pipe.plan import PipePlan
+            return PipePlan.from_manifest(desc, devices=devices)
         axes = {n: int(s) for n, s in desc["axes"]}
         batch_axis = desc["batch_axis"]
         axes[batch_axis] = -1

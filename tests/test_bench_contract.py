@@ -440,3 +440,45 @@ def test_bench_serving2_emits_mxserve2_throughput():
     assert data["reload_new_version"] == 2, data
     assert data["open_errors"] == 0, data
     assert data["open_p99_ms"] >= data["open_p50_ms"] > 0, data
+
+
+@pytest.mark.slow
+def test_bench_pipe_emits_mxpipe_scaling():
+    """--pipe contract: one mxpipe_scaling JSON line from the
+    stage-scaling legs (1 and 2 stages with reduced knobs), with the
+    acceptance gates pinned: pipelined final loss matches the 1-stage
+    leg within PIPE_TOL_REL (bit-identical on CPU), zero post-warmup
+    recompiles on every leg, and per-stage parameter bytes shrinking
+    with the stage count (value = 1-stage / max-stage ratio > 1)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_PIPE_STAGES": "1,2",
+        "MXTPU_BENCH_PIPE_STEPS": "4",
+        "MXTPU_BENCH_PIPE_LAYERS": "4",
+        "MXTPU_BENCH_PIPE_DMODEL": "16",
+        "MXTPU_BENCH_PIPE_SEQ": "8",
+        "MXTPU_BENCH_TIMEOUT": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--pipe"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxpipe_scaling"
+    for key in ("value", "unit", "schedule", "legs", "final_losses",
+                "parity_rel", "parity_tol", "parity_ok",
+                "recompiles_after_warmup_zero"):
+        assert key in data, (key, data)
+    assert data["parity_ok"] is True, data
+    assert data["parity_rel"] <= data["parity_tol"], data
+    assert data["recompiles_after_warmup_zero"] is True, data
+    assert data["value"] is not None and data["value"] > 1.0, data
+    assert set(data["legs"]) == {"1", "2"}, data["legs"]
+    for leg in data["legs"].values():
+        assert leg["recompiles_after_warmup"] == 0, leg
+        assert leg["step_time_s"] > 0, leg
+        assert len(leg["stage_param_bytes"]) == leg["n_stage"], leg

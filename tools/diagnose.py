@@ -504,6 +504,49 @@ def check_pod():
               "runbook)")
 
 
+def check_pipe():
+    """Pipeline-parallel config: MXPIPE_* policy (schedule, stage and
+    microbatch counts, balance tolerance), the schedule's bubble math
+    at the configured shape, and any live mxpipe compile counters
+    (mxnet_tpu/pipe/; docs/pipeline.md)."""
+    print("----------Pipeline parallelism (mxpipe)----------")
+    try:
+        from mxnet_tpu import config, telemetry
+        from mxnet_tpu.pipe import build_schedule
+    except Exception as e:
+        print("pipe         : unavailable (%s)" % e)
+        return
+    kind = str(config.get("MXPIPE_SCHEDULE"))
+    n_stage = int(config.get("MXPIPE_STAGES"))
+    n_micro = int(config.get("MXPIPE_MICROBATCH"))
+    print("schedule     :", kind)
+    print("stages       :", n_stage if n_stage > 0 else
+          "(auto — session world, or 1 without a session)")
+    print("microbatches :", n_micro if n_micro > 0 else
+          "(auto — one per stage)")
+    print("balance tol  :", config.get("MXPIPE_BALANCE_TOL"),
+          "(pipelint stage-imbalance threshold)")
+    # bubble math at the configured (or representative) shape: the
+    # schedule cost a user signs up for before any step runs
+    S = n_stage if n_stage > 0 else 4
+    M = n_micro if n_micro > 0 else S
+    try:
+        sched = build_schedule(kind, S, M)
+        print("bubble       : %.3f at S=%d M=%d (%d ticks; raise the "
+              "microbatch count to shrink it)"
+              % (sched.bubble_fraction(), S, M, sched.n_ticks))
+    except Exception as e:
+        print("bubble       : schedule build failed (%s)" % e)
+    snap = telemetry.snapshot()
+    pipe_metrics = {k: v for k, v in sorted(snap.items())
+                    if k.startswith("mxpipe_")}
+    if not pipe_metrics:
+        print("metrics      : none (no pipeline in this process)")
+        return
+    for k, v in pipe_metrics.items():
+        print(f"  {k} = {v}")
+
+
 def check_mxsan():
     """Concurrency sanitizer health: MXSAN flag state, which locks the
     runtime sanitizer is watching, the lock-order graph, any detected
@@ -691,6 +734,7 @@ def main():
     check_resilience()
     check_elastic()
     check_pod()
+    check_pipe()
     check_guard()
     check_mxsan()
     check_obs()

@@ -278,6 +278,73 @@ def _selfcheck_serve_findings():
     return findings
 
 
+def _selfcheck_pipe_findings():
+    """pipelint self-check: train a tiny 2-stage 1F1B pipeline for a
+    few steps (local transport — the same programs the socket path
+    compiles) and lint the balance/divisibility/closed-cache contract.
+    A clean pipeline must lint clean beyond the informational
+    bubble-fraction note, and — coverage check on the lint itself —
+    synthetic reports with an imbalanced split, a non-dividing batch,
+    cold declared rungs, an off-rung transfer, a post-warmup recompile
+    and a stage-map hole MUST each fire their error/warn finding."""
+    import numpy as onp
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.pipeline_lm import init_pipeline_lm
+    from mxnet_tpu.passes import Finding
+    from mxnet_tpu.passes.pipelint import lint_pipe_report
+    from mxnet_tpu.pipe import PipeStepFunction
+
+    params = init_pipeline_lm(0, vocab=32, d_model=16, n_layers=4,
+                              n_heads=2, d_head=8, d_ff=32,
+                              n_experts=2)
+    sf = PipeStepFunction(params, n_stage=2, n_microbatch=4,
+                          name="<self-check pipe>")
+    rs = onp.random.RandomState(0)
+    losses = []
+    for _ in range(3):
+        tok = jnp.asarray(rs.randint(0, 32, size=(8, 6)), dtype="int32")
+        lab = jnp.asarray(rs.randint(0, 32, size=(8, 6)), dtype="int32")
+        losses.append(sf.step(tok, lab))
+    findings = [f for f in lint_pipe_report(sf.lint_report())
+                if f.check != "bubble-fraction"]
+    if not all(onp.isfinite(losses)):
+        findings.append(Finding(
+            "pipelint", "selfcheck-coverage", "<self-check pipe>",
+            "error", f"self-check pipeline produced non-finite losses "
+                     f"{losses}"))
+    # the lint must FIRE on the bad fixtures — otherwise the pass is
+    # vacuous
+    bad = {"name": "<bad fixture>", "schedule": "1f1b", "n_stage": 2,
+           "n_micro": 3, "batch": 8, "warmed": True,
+           "bubble_fraction": 0.25,
+           "stage_param_bytes": [100, 100000],
+           "declared_rungs": [["act", [2, 6, 16], "float32"],
+                              ["cot", [2, 6, 16], "float32"]],
+           "warmed_rungs": [["act", [2, 6, 16], "float32"],
+                            ["act", [5, 6, 16], "float32"]],
+           "recompiles_after_warmup": 2,
+           "stage_map": {0: "w0"}, "world": 1, "programs": {}}
+    fired = {f.check for f in lint_pipe_report(bad)}
+    for check in ("stage-imbalance", "microbatch-not-divisible",
+                  "unwarmed-transfer-rungs", "off-rung-transfer",
+                  "recompile-after-warmup", "stage-map-hole"):
+        if check not in fired:
+            findings.append(Finding(
+                "pipelint", "selfcheck-coverage", "<bad fixture>",
+                "error",
+                f"lint did not fire {check!r} on the fixture built to "
+                "trigger it"))
+    rep = sf.lint_report()
+    findings.append(Finding(
+        "pipelint", "selfcheck-summary", "<self-check pipe>", "info",
+        f"schedule {rep['schedule']} S={rep['n_stage']} "
+        f"M={rep['n_micro']}, bubble "
+        f"{rep['bubble_fraction']:.3f}, programs {rep['programs']}, "
+        f"{rep['recompiles_after_warmup']} post-warmup recompile(s), "
+        "bad-fixture coverage exercised"))
+    return findings
+
+
 def _selfcheck_guard_findings():
     """guardlint self-check: train a few guarded steps (MXGUARD taps +
     replay recorder + known-good checkpoint ring) and lint the live
@@ -668,6 +735,12 @@ def main(argv=None):
                         "batching decode engine and lint its compiled "
                         "shapes (bucket-rung-exact) and KV page-pool "
                         "donation")
+    p.add_argument("--pipe", action="store_true", dest="pipe_check",
+                   help="pipelint self-check: train a tiny 2-stage "
+                        "1F1B pipeline and lint its stage balance, "
+                        "microbatch divisibility, transfer-rung "
+                        "warmth and closed-jit-cache contract (plus "
+                        "bad-fixture coverage)")
     p.add_argument("--guard", action="store_true", dest="guard_check",
                    help="guardlint self-check: run a few MXGUARD-"
                         "tapped fused steps with a replay ring and "
@@ -716,10 +789,10 @@ def main(argv=None):
     if not (args.ops or args.all or args.graphs or args.shard
             or args.opt_check or args.serve_check or args.guard_check
             or args.metrics_check or args.race_check
-            or args.obs_check):
+            or args.obs_check or args.pipe_check):
         p.error("nothing to do: pass --ops, --all, --shard, --opt, "
-                "--serve, --guard, --metrics, --obs, --race, or "
-                "graph JSON files")
+                "--serve, --pipe, --guard, --metrics, --obs, --race, "
+                "or graph JSON files")
 
     if args.shard and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -824,6 +897,10 @@ def main(argv=None):
         sv = _selfcheck_serve_findings()
         findings.extend(sv)
         sections.append(("servelint", "<self-check decode engine>", sv))
+    if args.pipe_check:
+        pf = _selfcheck_pipe_findings()
+        findings.extend(pf)
+        sections.append(("pipelint", "<self-check pipeline>", pf))
     if args.guard_check:
         gd = _selfcheck_guard_findings()
         findings.extend(gd)
