@@ -2461,6 +2461,129 @@ def fleet_main():
           vs=record["fleet_beats_single"], **record)
 
 
+def tune_main():
+    """``--tune``: the mxtune end-to-end bench (docs/tuning.md).
+
+    Runs the measurement-driven knob search against BOTH in-process
+    harnesses — fused train step (step/opt knobs, objective: median
+    step seconds) and serve2 open-loop decode (serve2 knobs,
+    objective: goodput QPS within SLO) — persisting every legal trial
+    into a throwaway tuning DB, then exercises the REAL auto-apply
+    path: MXTUNE_AUTO=1, bind-time consult against the DB, re-measure
+    at the applied config and confirm zero post-warmup recompiles.
+
+    Emits ONE JSON line, metric ``mxtune_search``: value = the better
+    leg's tuned/baseline objective ratio; ``tune_ok`` gates >= the
+    threshold (default 1.05) AND recompiles_after_apply == 0 AND the
+    auto-applied config matching the search's best. Env knobs:
+    MXTPU_BENCH_TUNE_BUDGET (trials/leg, default 8),
+    MXTPU_BENCH_TUNE_STEPS, MXTPU_BENCH_TUNE_REQUESTS,
+    MXTPU_BENCH_TUNE_THRESHOLD, MXTPU_BENCH_TUNE_SERVE=0 to skip the
+    serve2 leg."""
+    import tempfile
+    from mxnet_tpu import config, tune
+
+    budget = int(os.environ.get("MXTPU_BENCH_TUNE_BUDGET", "8"))
+    steps = int(os.environ.get("MXTPU_BENCH_TUNE_STEPS", "6"))
+    requests = int(os.environ.get("MXTPU_BENCH_TUNE_REQUESTS", "12"))
+    threshold = float(os.environ.get("MXTPU_BENCH_TUNE_THRESHOLD",
+                                     "1.05"))
+    serve_leg = os.environ.get("MXTPU_BENCH_TUNE_SERVE", "1") == "1"
+    db = tune.TuneDB(tempfile.mkdtemp(prefix="bench-tune-"))
+    full = tune.default_space()
+
+    legs = {}
+
+    def run_leg(name, objective, subsystems, bench_fn, sig):
+        space = full.subset(subsystems)
+        key = tune.current_key(sig, full)
+        rep = tune.run_search(space, bench_fn, objective,
+                              budget=budget, seed=0, db=db, key=key,
+                              source="bench-tune", log=False)
+        # the REAL auto-apply path: consult the DB the way a bind does
+        tune.reset_applied()
+        config.set_flag("MXTUNE_AUTO", 1)
+        try:
+            applied = tune.consult(name, sig, db=db)
+        finally:
+            config.unset_flag("MXTUNE_AUTO")
+        auto_applied = (applied == rep["best_config"])
+        # re-measure applied AND defaults interleaved (A/B/A/B): the
+        # search's sequential trials drift with the burstable host's
+        # clock, so the emitted speedup comes from fresh back-to-back
+        # pairs — and the applied re-measure proves the persisted
+        # config reproduces and compiles warm
+        applied_vals, base_vals = [], []
+        recompiles = 0
+        for _ in range(2):
+            res = tune.measure_candidate(space, applied, bench_fn,
+                                         objective)
+            if res.ok:
+                applied_vals.append(res.value)
+            else:
+                recompiles += 1
+            base = tune.measure_candidate(space, {}, bench_fn,
+                                          objective)
+            if base.ok:
+                base_vals.append(base.value)
+        applied_value = (sorted(applied_vals)[len(applied_vals) // 2]
+                         if applied_vals else None)
+        base_value = (sorted(base_vals)[len(base_vals) // 2]
+                      if base_vals else rep["baseline_value"])
+        if rep["direction"] == "min":
+            speedup = (base_value / applied_value
+                       if applied_value else None)
+        else:
+            speedup = (applied_value / base_value
+                       if applied_value else None)
+        legs[name] = {
+            "objective": objective,
+            "baseline": base_value,
+            "search_baseline": rep["baseline_value"],
+            "search_best": rep["best_value"],
+            "applied_value": applied_value,
+            "speedup": speedup,
+            "trials_measured": rep["measured"],
+            "trials_rejected": rep["n_rejected"],
+            "model_hit_rate": rep["model_hit_rate"],
+            "auto_applied": auto_applied,
+            "recompiles_after_apply": recompiles,
+        }
+
+    run_leg("fuse_step", "fused_step_time_s", ("step", "opt"),
+            tune.fused_step_bench_fn(batch=8, warmup=2, steps=steps),
+            "probe:fused-step-conv24")
+    if serve_leg:
+        # qps offered well above capacity so goodput measures
+        # capacity, not offered load (at low offered qps every config
+        # saturates the SLO and nothing differentiates)
+        run_leg("serve2", "serve2_open_qps_slo", ("serve2",),
+                tune.serve2_bench_fn(requests=requests, max_new=6,
+                                     qps=400.0, slo_ms=2000.0),
+                "probe:serve2-pipeline-lm")
+
+    speedups = {k: v["speedup"] for k, v in legs.items()
+                if v["speedup"]}
+    best_leg = max(speedups, key=speedups.get) if speedups else None
+    best_speedup = speedups.get(best_leg)
+    recompiles_total = sum(v["recompiles_after_apply"]
+                           for v in legs.values())
+    auto_ok = all(v["auto_applied"] for v in legs.values())
+    tune_ok = bool(best_speedup and best_speedup >= threshold
+                   and recompiles_total == 0 and auto_ok)
+    flat = {f"{leg}_{k}": v for leg, d in legs.items()
+            for k, v in d.items()}
+    _emit(round(best_speedup, 4) if best_speedup else None,
+          unit="x tuned/baseline objective",
+          vs=round(best_speedup, 3) if best_speedup else None,
+          metric="mxtune_search", tune_ok=tune_ok,
+          best_leg=best_leg, threshold=threshold,
+          trials_budget=budget,
+          recompiles_after_apply=recompiles_total,
+          auto_applied=auto_ok, db_records=len(db.records()),
+          **flat)
+
+
 def _parent():
     """Run the bench in a KILLABLE subprocess and own the one-JSON-line
     contract. A SIGALRM watchdog cannot interrupt a hang inside C code
@@ -2499,6 +2622,8 @@ def _parent():
               if os.environ.get("MXTPU_BENCH_SAN") == "1"
               else "mxobs_overhead"
               if os.environ.get("MXTPU_BENCH_OBS") == "1"
+              else "mxtune_search"
+              if os.environ.get("MXTPU_BENCH_TUNE") == "1"
               else "resnet50_train_throughput")
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
@@ -2565,6 +2690,8 @@ if __name__ == "__main__":
         os.environ["MXTPU_BENCH_SAN"] = "1"
     if "--obs-overhead" in sys.argv:
         os.environ["MXTPU_BENCH_OBS"] = "1"
+    if "--tune" in sys.argv:
+        os.environ["MXTPU_BENCH_TUNE"] = "1"
     # fused whole-train-step compiler: default ON; --no-fused-step
     # measures the eager reference path instead (env form propagates
     # into the --child subprocess)
@@ -2586,6 +2713,7 @@ if __name__ == "__main__":
     _tracebench = os.environ.get("MXTPU_BENCH_TRACE") == "1"
     _sanbench = os.environ.get("MXTPU_BENCH_SAN") == "1"
     _obsbench = os.environ.get("MXTPU_BENCH_OBS") == "1"
+    _tunebench = os.environ.get("MXTPU_BENCH_TUNE") == "1"
     if "--child" in sys.argv:
         try:
             if _serving3:
@@ -2616,6 +2744,8 @@ if __name__ == "__main__":
                 san_main()
             elif _obsbench:
                 obs_main()
+            elif _tunebench:
+                tune_main()
             else:
                 main()
         except Exception as e:
@@ -2634,6 +2764,7 @@ if __name__ == "__main__":
                           else "mxtrace_overhead" if _tracebench
                           else "mxsan_overhead" if _sanbench
                           else "mxobs_overhead" if _obsbench
+                          else "mxtune_search" if _tunebench
                           else "resnet50_train_throughput"),
                   error=f"{type(e).__name__}: {e}"[:500])
             sys.exit(0)

@@ -840,6 +840,38 @@ register_flag(
     "job failed (failure detection, SURVEY.md §5.3; the reference's "
     "ps-lite van timeouts play this role).")
 register_flag(
+    "MXTUNE_AUTO", bool, False,
+    "Auto-apply tuned configs on bind (mxnet_tpu/tune/, docs/tuning"
+    ".md): Trainer.fuse_step, ServingEngine and DecodeEngine consult "
+    "the tuning DB at bind time and apply the best measured config "
+    "whose key matches this process exactly (model signature, device "
+    "kind, mesh shape, knob-space fingerprint) — logging what was "
+    "applied with its measured value and provenance. ANY mismatch or "
+    "validation failure falls back to defaults (loudly logged, never "
+    "raised into the bind). Off (default) = binding is bit-identical "
+    "to a build without mxtune (test-enforced).")
+register_flag(
+    "MXTUNE_DB_DIR", str, "",
+    "Tuning-DB directory (tune_db.jsonl lives here). Empty (default) "
+    "= ~/.mxnet_tpu/tune. Point search and serving at the same dir "
+    "to share tuned configs; the DB is append-crash-safe and "
+    "self-compacting (docs/tuning.md, DB format section).")
+register_flag(
+    "MXTUNE_BUDGET", int, 16,
+    "Default measurement budget (trials) for tune.run_search and "
+    "`python tools/mxtune.py search` / `bench.py --tune` when no "
+    "explicit budget is passed. Trial 0 always measures the DEFAULTS "
+    "config, so the best entry is never worse than stock; the "
+    "learned cost model starts pruning once ~len(space)+2 legal "
+    "measurements exist (docs/tuning.md, budget guidance).")
+register_flag(
+    "MXTUNE_OBJECTIVE", str, "auto",
+    "Objective auto-apply optimizes for, from tune.OBJECTIVES "
+    "(fused_step_time_s, serve2_open_qps_slo, serve_open_qps_slo). "
+    "'auto' (default) = per bind kind: fuse_step->fused_step_time_s, "
+    "DecodeEngine->serve2_open_qps_slo, ServingEngine->"
+    "serve_open_qps_slo.")
+register_flag(
     "MXNET_TEST_SEED", int, -1,
     "Fixed seed for the test harness; -1 = random per test "
     "(ref: tests/python/unittest/common.py).")

@@ -315,8 +315,15 @@ class Trainer:
         same user code, ``P("batch", "model")`` composition included.
         Checkpoints taken through this trainer record the plan in
         their manifest and reshard on restore (docs/sharding.md)."""
+        from .. import config
+        if config.get("MXTUNE_AUTO"):
+            # mxtune auto-apply (docs/tuning.md): the best measured
+            # step/opt config for THIS model+device+space, applied via
+            # set_flag before the step traces; any key mismatch or
+            # validation failure leaves defaults untouched
+            from ..tune.apply import consult_train, signature_of
+            consult_train(signature_of(net))
         if shard_plan is None:
-            from .. import config
             import jax as _jax
             if config.get("MXSHARD_AUTO") and len(_jax.devices()) > 1:
                 from ..shard import ShardPlan

@@ -1,0 +1,20 @@
+"""Graph-optimizer tunables (mxtune self-description hook).
+
+The TVM/Relay separation this package exists for: rewrite *legality*
+is the optimizer's job (tolerance classes, bind-time verify),
+rewrite *profitability* is the searcher's. ``MXNET_GRAPH_OPT`` is the
+profitability lever — level 2's fusion/layout choices win on some
+models and hosts and lose on others, which is exactly what a measured
+search settles.
+"""
+from __future__ import annotations
+
+from ..tune.space import declare
+
+declare(
+    "MXNET_GRAPH_OPT", "int", (0, 1, 2),
+    subsystem="opt", safety="rebind",
+    doc="graph-optimizer level for Symbol binds: 0 off, 1 bitwise "
+        "cleanups, 2 fusion groups + layout selection (tolerance-"
+        "tagged parity; the bind-time verify gate stays the legality "
+        "rail)")

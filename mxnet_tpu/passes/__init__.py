@@ -206,7 +206,8 @@ def findings_report(tool: str, findings: Iterable[Finding],
 def default_manager() -> PassManager:
     from . import (oplint, graphlint, tracercheck, dispatchlint,
                    steplint, shardlint, servelint, elasticlint,
-                   guardlint, metriclint, racelint, obslint, pipelint)
+                   guardlint, metriclint, racelint, obslint, pipelint,
+                   tunelint)
     pm = PassManager()
     pm.register(oplint.OpRegistryAudit())
     pm.register(graphlint.GraphLint())
@@ -222,4 +223,5 @@ def default_manager() -> PassManager:
     pm.register(metriclint.MetricLint())
     pm.register(racelint.RaceLint())
     pm.register(obslint.ObsLint())
+    pm.register(tunelint.TuneLint())
     return pm

@@ -1,0 +1,171 @@
+"""tunelint: the autotuner's DB-hygiene and apply-safety contract.
+
+mxtune's whole safety story is "the DB only holds configs that were
+measured legally, and auto-apply only fires on an exact key match".
+tunelint audits the places that story can rot:
+
+1. **stale-db-entry** — a stored config references a knob that is no
+   longer registered, a value that drifted outside today's declared
+   range, or a key whose ``space_fp`` no longer matches the live knob
+   universe. Stale entries are fallback-safe (apply validates and
+   declines), but they are dead weight that masks "why didn't my tuned
+   config fire?" — the runbook's first question.
+2. **applied-config-recompile** — an auto-applied config followed by
+   post-warmup recompiles. The measurement runner rejected recompiling
+   candidates, so this firing means the world changed between measure
+   time and apply time (different shapes, different library) — the
+   tuned number no longer describes reality. Error.
+3. **objective-without-measurement** — a DB record that names an
+   objective but carries no measured value, or an objective the
+   registry doesn't know. The DB contract says only legal *measured*
+   records are stored; a value-less record can never be ranked and a
+   record with an unknown objective can never be compared. Error.
+4. **guarded-without-provenance** — a record or applied config that
+   moves a ``guarded`` knob (one that changes numerics, e.g. KV dtype)
+   without tolerance-class provenance. The config may be fine — the
+   rails gate at measure time — but without provenance nobody can
+   audit WHICH tolerance class blessed it. Warn.
+
+Target: the dict from :func:`mxnet_tpu.tune.apply.lint_report`
+(``{"space", "space_fingerprint", "db", "entries", "applied"}``,
+optionally ``"recompiles_after_apply"`` mapping bind kind to the
+post-apply recompile count the caller observed). Registered in the
+default PassManager; ``tools/mxlint.py --tune`` runs it over a live
+self-check DB plus bad fixtures asserting every check fires.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding, Pass
+
+__all__ = ["TuneLint", "lint_tune_report"]
+
+
+class TuneLint(Pass):
+    name = "tunelint"
+    order = 100
+
+    def run(self, target) -> List[Finding]:
+        rep = target if isinstance(target, dict) else target.lint_report()
+        return lint_tune_report(rep)
+
+    def finding(self, check, obj, severity, message, loc=None):
+        return Finding(self.name, check, obj, severity, message, loc)
+
+
+def _spec_index(rep: dict) -> dict:
+    return {k.get("name"): k
+            for k in (rep.get("space") or {}).get("knobs", ())}
+
+
+def _in_range(spec: dict, value) -> bool:
+    cands = spec.get("candidates") or []
+    if spec.get("kind") == "int":
+        try:
+            return bool(cands) and cands[0] <= int(value) <= cands[-1]
+        except (TypeError, ValueError):
+            return False
+    return value in cands
+
+
+def lint_tune_report(rep: dict) -> List[Finding]:
+    """Audit one :func:`~mxnet_tpu.tune.apply.lint_report` dict. See
+    the module docstring for the check classes."""
+    p = TuneLint()
+    out: List[Finding] = []
+    specs = _spec_index(rep)
+    live_fp = str(rep.get("space_fingerprint") or "")
+    guarded = {n for n, s in specs.items()
+               if s.get("safety") == "guarded"}
+    entries = list(rep.get("entries") or ())
+    stale = 0
+
+    for i, rec in enumerate(entries):
+        obj = f"db[{i}]"
+        cfg = rec.get("config") or {}
+        key = rec.get("key") or {}
+        # -- stale-db-entry ------------------------------------------
+        fp = str(key.get("space_fp") or "")
+        if live_fp and fp and fp != live_fp:
+            stale += 1
+            out.append(p.finding(
+                "stale-db-entry", obj, "warn",
+                f"entry's knob-space fingerprint {fp} does not match "
+                f"the live space {live_fp} — the knob universe drifted "
+                "since this config was measured; auto-apply will "
+                "decline it (re-run `mxtune.py search` to re-measure)"))
+        for name, value in sorted(cfg.items()):
+            spec = specs.get(name)
+            if spec is None:
+                stale += 1
+                out.append(p.finding(
+                    "stale-db-entry", obj, "warn",
+                    f"entry sets knob {name!r} which is no longer "
+                    "registered in the knob space — a tunables hook "
+                    "was removed or renamed; the entry can never "
+                    "validate again"))
+            elif not _in_range(spec, value):
+                stale += 1
+                out.append(p.finding(
+                    "stale-db-entry", obj, "warn",
+                    f"entry's {name}={value!r} is outside today's "
+                    f"declared candidates {spec.get('candidates')} — "
+                    "the range drifted since measurement"))
+        # -- objective-without-measurement ---------------------------
+        from ..tune.space import OBJECTIVES
+        objective = str(rec.get("objective") or "")
+        if objective not in OBJECTIVES:
+            out.append(p.finding(
+                "objective-without-measurement", obj, "error",
+                f"entry names objective {objective!r} which the "
+                f"objective registry does not define "
+                f"({sorted(OBJECTIVES)}) — it can never be ranked "
+                "against other measurements"))
+        if rec.get("value") is None:
+            out.append(p.finding(
+                "objective-without-measurement", obj, "error",
+                f"entry claims objective {objective!r} but carries no "
+                "measured value — the DB contract stores only legal "
+                "MEASURED records; this one cannot be ranked and "
+                "best_config() will skip it"))
+        # -- guarded-without-provenance ------------------------------
+        moved_guarded = sorted(set(cfg) & guarded)
+        prov = rec.get("provenance") or {}
+        if moved_guarded and not prov.get("tolerance_class"):
+            out.append(p.finding(
+                "guarded-without-provenance", obj, "warn",
+                f"entry moves guarded knob(s) {moved_guarded} but its "
+                "provenance records no tolerance class — the parity "
+                "rail presumably gated it at measure time, but nothing "
+                "here proves which class blessed the numerics"))
+
+    # -- applied-config-recompile ------------------------------------
+    applied = rep.get("applied") or {}
+    recompiles = rep.get("recompiles_after_apply") or {}
+    for bind, info in sorted(applied.items()):
+        n = int(recompiles.get(bind, 0) or 0)
+        if n > 0:
+            out.append(p.finding(
+                "applied-config-recompile", f"bind:{bind}", "error",
+                f"{n} post-warmup recompile(s) after auto-applying "
+                f"{info.get('config')} — the measurement runner "
+                "rejects recompiling candidates, so the world changed "
+                "between measure and apply (shapes? library rev?); "
+                "this config's measured value no longer describes "
+                "reality. Unset MXTUNE_AUTO or re-search."))
+        cfg = (info or {}).get("config") or {}
+        moved_guarded = sorted(set(cfg) & guarded)
+        prov = (info or {}).get("provenance") or {}
+        if moved_guarded and not prov.get("tolerance_class"):
+            out.append(p.finding(
+                "guarded-without-provenance", f"bind:{bind}", "warn",
+                f"auto-applied config moves guarded knob(s) "
+                f"{moved_guarded} without tolerance-class provenance"))
+
+    out.append(p.finding(
+        "tune-summary", "tune-db", "info",
+        f"{len(entries)} DB record(s), {len(specs)} registered "
+        f"knob(s), {len(applied)} bind(s) auto-applied, "
+        f"{stale} stale finding(s)"))
+    return out

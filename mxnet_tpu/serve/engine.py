@@ -201,13 +201,23 @@ class ServingEngine:
             raise MXNetError(
                 f"ServingEngine cannot serve a {type(model).__name__}; "
                 "pass a Gluon Block, a bound Executor, or a callable")
-        # row cap per dispatch: explicit arg > MXSERVE_MAX_BATCH flag >
-        # the ladder's top batch rung; never above the top rung (a
-        # dispatch larger than the biggest compiled program can't run)
+        # row cap per dispatch: explicit arg > mxtune DB > MXSERVE_MAX_
+        # BATCH flag > the ladder's top batch rung; never above the top
+        # rung (a dispatch larger than the biggest compiled program
+        # can't run). With MXTUNE_AUTO=0 (default) `tuned` is {} and
+        # resolution is bit-identical to before (docs/tuning.md)
         from .. import config
+        tuned: Dict = {}
+        if config.get("MXTUNE_AUTO"):
+            from ..tune.apply import consult, signature_of
+            tuned = consult("serve", signature_of(model),
+                            subsystems=("serve",))
         if max_batch_size is None:
-            max_batch_size = int(config.get("MXSERVE_MAX_BATCH")) \
+            max_batch_size = int(tuned.get(
+                "MXSERVE_MAX_BATCH", config.get("MXSERVE_MAX_BATCH"))) \
                 or self.ladder.max_batch
+        if queue_depth is None and "MXSERVE_QUEUE_DEPTH" in tuned:
+            queue_depth = int(tuned["MXSERVE_QUEUE_DEPTH"])
         max_rows = min(int(max_batch_size), self.ladder.max_batch)
         self.batcher: Optional[DynamicBatcher] = DynamicBatcher(
             self._dispatch_group, max_batch_size=max_rows,

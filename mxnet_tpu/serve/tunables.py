@@ -1,0 +1,22 @@
+"""ServingEngine (request/response tier) tunables (mxtune hook).
+
+The dynamic batcher's knobs trade batching efficiency against queue
+latency; both are host-side scheduling (``steady`` — every bucket
+rung is pre-compiled, so no value here can re-key a program after
+warmup).
+"""
+from __future__ import annotations
+
+from ..tune.space import declare
+
+declare(
+    "MXSERVE_MAX_BATCH", "int", (0, 4, 8, 16, 32, 64),
+    subsystem="serve", safety="steady",
+    doc="dynamic-batcher group cap (0 = the bucket ladder's max): "
+        "bigger groups amortize dispatch, smaller ones bound the "
+        "straggler wait inside a group")
+declare(
+    "MXSERVE_QUEUE_DEPTH", "int", (64, 128, 256, 512),
+    subsystem="serve", safety="steady",
+    doc="bounded admission queue depth before back-pressure; deeper "
+        "queues absorb bursts at the cost of queue-time tail latency")

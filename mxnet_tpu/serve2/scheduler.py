@@ -157,17 +157,31 @@ class DecodeEngine:
                  pagewire_chunk: int = 0):
         from .. import config
         self.name = name
+        # mxtune auto-apply (docs/tuning.md): knob resolution is
+        # kwarg > tuned > flag — an explicit constructor argument
+        # always beats the DB, and with MXTUNE_AUTO=0 (default)
+        # `tuned` is {} so resolution is bit-identical to before
+        tuned: Dict = {}
+        if config.get("MXTUNE_AUTO"):
+            from ..tune.apply import consult, signature_of
+            tuned = consult("serve2", signature_of(params),
+                            subsystems=("serve2",))
+
+        def _knob(kwarg, flag):
+            if kwarg is not None:
+                return kwarg
+            if flag in tuned:
+                return tuned[flag]
+            return config.get(flag)
+
         # mxfleet pagewire: > 0 warms the fixed-chunk page export/
         # import programs so cross-host KV streaming never recompiles.
         # 0 (default) = no extra programs, identical single-host bill.
         self.pagewire_chunk = int(pagewire_chunk)
         self.decode_steps = int(
-            decode_steps if decode_steps is not None
-            else config.get("MXSERVE2_DECODE_STEPS"))
+            _knob(decode_steps, "MXSERVE2_DECODE_STEPS"))
         # serve3 legs, each independently gated (flags or kwargs)
-        self.kv_dtype = str(
-            kv_dtype if kv_dtype is not None
-            else config.get("MXSERVE3_KV_DTYPE"))
+        self.kv_dtype = str(_knob(kv_dtype, "MXSERVE3_KV_DTYPE"))
         self.spec_tokens = int(
             spec_tokens if spec_tokens is not None
             else config.get("MXSERVE3_SPEC_TOKENS"))
@@ -180,13 +194,10 @@ class DecodeEngine:
         self.prefix_enabled = bool(
             prefix_cache if prefix_cache is not None
             else config.get("MXSERVE3_PREFIX_CACHE"))
-        self.page_size = int(page_size if page_size is not None
-                             else config.get("MXSERVE2_PAGE_SIZE"))
-        self.num_pages = int(num_pages if num_pages is not None
-                             else config.get("MXSERVE2_NUM_PAGES"))
+        self.page_size = int(_knob(page_size, "MXSERVE2_PAGE_SIZE"))
+        self.num_pages = int(_knob(num_pages, "MXSERVE2_NUM_PAGES"))
         self.max_inflight = int(
-            max_inflight if max_inflight is not None
-            else config.get("MXSERVE2_MAX_INFLIGHT"))
+            _knob(max_inflight, "MXSERVE2_MAX_INFLIGHT"))
         if prefill_buckets is None:
             prefill_buckets = [
                 int(t) for t in
@@ -244,8 +255,8 @@ class DecodeEngine:
                                    name=name)
         self.prefix: Optional[PrefixCache] = None
         if self.prefix_enabled:
-            cap = int(prefix_cache_pages if prefix_cache_pages is not None
-                      else config.get("MXSERVE3_PREFIX_CACHE_PAGES"))
+            cap = int(_knob(prefix_cache_pages,
+                            "MXSERVE3_PREFIX_CACHE_PAGES"))
             self.prefix = PrefixCache(self.alloc, capacity_pages=cap)
         from ..serve.engine import InputSpec
         self.input_specs = [InputSpec((top_prefill,), "int32",

@@ -1,0 +1,46 @@
+"""serve2/serve3 tunables (mxtune self-description hook).
+
+Declares the paged-decoding knob surface for the searcher. Pool
+geometry and decode-dispatch width re-key programs (``rebind``);
+the in-flight cap is host-side admission only (``steady``); the KV
+dtype moves numerics under its calibrated quant tolerance class
+(``guarded`` — auto-apply requires measurement provenance and the
+runner's tolerance rail).
+"""
+from __future__ import annotations
+
+from ..tune.space import declare
+
+declare(
+    "MXSERVE2_PAGE_SIZE", "int", (8, 16, 32, 64),
+    subsystem="serve2", safety="rebind",
+    doc="tokens per KV page: small pages cut padding waste, large "
+        "pages cut block-table overhead and page-crossing work")
+declare(
+    "MXSERVE2_NUM_PAGES", "int", (64, 128, 256, 512, 1024),
+    subsystem="serve2", safety="rebind",
+    doc="KV pool capacity in pages; undersizing preempts under load, "
+        "oversizing wastes accelerator memory other replicas need")
+declare(
+    "MXSERVE2_DECODE_STEPS", "int", (1, 2, 4, 8),
+    subsystem="serve2", safety="rebind",
+    doc="decode iterations folded into one compiled dispatch: deeper "
+        "folds amortize host dispatch, shallower folds admit waiting "
+        "prefills sooner (tail latency)")
+declare(
+    "MXSERVE2_MAX_INFLIGHT", "int", (2, 4, 8, 16, 32),
+    subsystem="serve2", safety="steady",
+    doc="continuous-batching concurrency cap (host-side admission; "
+        "compiled decode rungs cover every level)")
+declare(
+    "MXSERVE3_KV_DTYPE", "choice", ("f32", "bf16", "int8"),
+    subsystem="serve2", safety="guarded",
+    doc="KV page element type; narrower pools multiply capacity at "
+        "equal bytes but move numerics under the quant tolerance "
+        "class — the measurement runner's parity rail gates it")
+declare(
+    "MXSERVE3_PREFIX_CACHE_PAGES", "int", (0, 64, 128, 256, 512),
+    subsystem="serve2", safety="steady",
+    doc="prefix-cache page budget (0 = uncapped): larger caches keep "
+        "more shared prompt KV resident, smaller ones return pages "
+        "to the decode pool")

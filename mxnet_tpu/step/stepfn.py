@@ -777,10 +777,12 @@ class StepFunction:
         }
 
     def cost_analysis(self, x, *labels):
-        """XLA cost analysis of the compiled step (bench roofline):
-        returns a dict with ``flops`` and ``bytes accessed``. Lowers
-        with the CURRENT buffers (a persistent-cache hit when the step
-        already ran); does not execute or donate."""
+        """XLA cost analysis of the compiled step (bench roofline,
+        mxtune cost-model features): a stable, JSON-serializable dict —
+        sorted keys, plain floats only, always containing ``flops`` and
+        ``bytes accessed``. Lowers with the CURRENT buffers (a
+        persistent-cache hit when the step already ran); does not
+        execute or donate."""
         if self._last is None:
             raise MXNetError("no compiled step yet — call step() first")
         fn, _ = self._last
@@ -793,6 +795,15 @@ class StepFunction:
                         rng).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
-        return {"flops": float((cost or {}).get("flops", 0) or 0),
-                "bytes accessed": float(
-                    (cost or {}).get("bytes accessed", 0) or 0)}
+        # backend cost dicts leak device objects and odd scalar types;
+        # keep only what float() accepts so the result round-trips
+        # through json (mxtune persists these as model features)
+        out = {}
+        for k, v in (cost or {}).items():
+            try:
+                out[str(k)] = float(v)
+            except (TypeError, ValueError):
+                continue
+        out.setdefault("flops", 0.0)
+        out.setdefault("bytes accessed", 0.0)
+        return dict(sorted(out.items()))

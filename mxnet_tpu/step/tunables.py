@@ -1,0 +1,26 @@
+"""Fused-step tunables (mxtune self-description hook).
+
+Imported by ``mxnet_tpu.tune.space.default_space()``; declares the
+training-side knobs this package consumes so the searcher never
+hardcodes them. Both knobs re-key the compiled step / exchange
+programs (``rebind``) but preserve numerics bitwise — chunking a
+multi-tensor update or re-bucketing an exchange moves schedules, not
+math.
+"""
+from __future__ import annotations
+
+from ..tune.space import declare
+
+declare(
+    "MXNET_OPTIMIZER_AGGREGATION_SIZE", "int",
+    (1, 2, 4, 8, 16, 32), subsystem="step", safety="rebind",
+    doc="tensors fused per multi-tensor optimizer update chunk; "
+        "larger chunks amortize dispatch, smaller ones bound live "
+        "buffer pressure")
+declare(
+    "MXNET_GRAD_BUCKET_BYTES", "int",
+    (1 << 20, 2 << 20, 4 << 20, 8 << 20, 16 << 20, 32 << 20),
+    subsystem="step", safety="rebind",
+    doc="byte cap per flat gradient-exchange bucket; larger buckets "
+        "amortize transport latency, smaller ones overlap the "
+        "exchange with the backward earlier")

@@ -1,0 +1,48 @@
+"""mxtune: telemetry-driven autotuning for mxnet_tpu.
+
+The pieces, in pipeline order:
+
+- :mod:`.space`   — the searchable knob space. Subsystems self-describe
+  their tunables via ``declare(...)`` hook modules
+  (``step/tunables.py``, ``opt/tunables.py``, ``serve2/tunables.py``,
+  ``serve/tunables.py``); ``default_space()`` assembles them.
+- :mod:`.measure` — the measurement runner: drives the fused-step and
+  serve2 bench harnesses in-process at a candidate config, reads
+  objectives from wall clock + the telemetry registry, and enforces
+  the legality rails (post-warmup recompile, tolerance class) as hard
+  gates.
+- :mod:`.model`   — the learned cost model (pure-numpy ridge over
+  knob + HLO-stat features) that prunes candidates to the predicted
+  frontier; trust-region/random fallback while cold.
+- :mod:`.db`      — the persistent tuning DB (crash-safe JSONL, keyed
+  by model signature / device kind / mesh shape / space fingerprint,
+  with provenance).
+- :mod:`.apply`   — auto-apply on the next bind behind ``MXTUNE_AUTO``
+  with loud logging and silent-safe fallback on any mismatch.
+
+Flags: ``MXTUNE_AUTO``, ``MXTUNE_DB_DIR``, ``MXTUNE_BUDGET``,
+``MXTUNE_OBJECTIVE`` (docs/tuning.md is the runbook).
+"""
+from __future__ import annotations
+
+from .space import (KnobSpec, KnobSpace, OBJECTIVES, declare,
+                    declared_specs, default_space,
+                    objective_direction)
+from .db import DB_FILE, SCHEMA_VERSION, TuneDB, default_dir, key_str
+from .model import CostModel
+from .measure import (MeasureResult, fused_step_bench_fn,
+                      measure_candidate, run_search, scoped_config,
+                      serve2_bench_fn)
+from .apply import (consult, consult_train, current_key, last_applied,
+                    lint_report, reset_applied, signature_of)
+
+__all__ = [
+    "KnobSpec", "KnobSpace", "OBJECTIVES", "declare",
+    "declared_specs", "default_space", "objective_direction",
+    "DB_FILE", "SCHEMA_VERSION", "TuneDB", "default_dir", "key_str",
+    "CostModel",
+    "MeasureResult", "fused_step_bench_fn", "measure_candidate",
+    "run_search", "scoped_config", "serve2_bench_fn",
+    "consult", "consult_train", "current_key", "last_applied",
+    "lint_report", "reset_applied", "signature_of",
+]

@@ -1,0 +1,204 @@
+"""Auto-apply on bind: the tuning DB's best config, consulted by the
+binding sites themselves.
+
+Behind ``MXTUNE_AUTO=1``, ``Trainer.fuse_step``, ``ServingEngine`` and
+``DecodeEngine`` call :func:`consult` at bind time with the model's
+parameter signature. A DB hit whose key matches exactly — model
+signature, device kind, mesh shape, AND knob-space fingerprint — and
+whose config still validates against today's knob space is applied and
+logged (what was applied, measured value, provenance). **Any** mismatch
+falls back to defaults silently-safe but loudly-logged: a tuned config
+from a drifted knob universe, another device kind, or another model
+must never be applied on faith.
+
+With ``MXTUNE_AUTO=0`` (the default) this module returns empty dicts
+and touches nothing — binding is bit-identical to a build without it
+(test-enforced).
+
+Train-side knobs are applied via ``config.set_flag`` (the fused-step
+builder reads flags at trace time); serve-side consults return a dict
+the engine merges into its own ``kwarg > tuned > flag`` resolution so
+explicit constructor arguments always win over the DB.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Dict, Optional
+
+from ..base import get_logger
+from .db import TuneDB
+from .space import KnobSpace, default_space
+
+__all__ = ["signature_of", "current_key", "consult", "consult_train",
+           "last_applied", "reset_applied", "lint_report"]
+
+_log = get_logger("mxnet_tpu.tune")
+
+#: bind kind -> the objective its DB lookup targets
+BIND_OBJECTIVES = {
+    "fuse_step": "fused_step_time_s",
+    "serve2": "serve2_open_qps_slo",
+    "serve": "serve_open_qps_slo",
+}
+
+_LAST: Dict[str, Dict] = {}
+_LAST_LOCK = threading.Lock()
+
+
+def signature_of(obj) -> str:
+    """Stable digest of a model's (name, shape, dtype) parameter
+    census — the ``model_sig`` DB key component. Accepts a params dict
+    (name -> array-like), a Gluon block, an Executor, or a Symbol;
+    anything else degrades to its type name (still stable, just
+    coarse)."""
+    items = None
+    if isinstance(obj, dict):
+        items = obj
+    elif hasattr(obj, "collect_params"):       # Gluon block
+        try:
+            items = {k: v.data() for k, v in
+                     obj.collect_params().items()}
+        except Exception:  # params not initialized yet
+            items = {k: None for k in obj.collect_params()}
+    elif hasattr(obj, "arg_dict"):             # Executor
+        items = dict(obj.arg_dict)
+    elif hasattr(obj, "tojson"):               # Symbol
+        h = hashlib.sha1(obj.tojson().encode()).hexdigest()
+        return f"sym:{h[:16]}"
+    if items is None:
+        return f"type:{type(obj).__name__}"
+
+    def leaves(prefix, v, out):
+        if isinstance(v, dict):
+            for k in sorted(v):
+                leaves(f"{prefix}/{k}", v[k], out)
+        elif v is None:
+            out.append((prefix, None, None))
+        else:
+            shape = tuple(getattr(v, "shape", ()) or ())
+            dtype = str(getattr(v, "dtype", ""))
+            out.append((prefix, shape, dtype))
+
+    rows = []
+    leaves("", items, rows)
+    blob = json.dumps(sorted(str(r) for r in rows)).encode()
+    return f"params:{hashlib.sha1(blob).hexdigest()[:16]}"
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+        d = jax.devices()[0]
+        return f"{d.platform}:{getattr(d, 'device_kind', '')}"
+    except Exception:
+        return "unknown"
+
+
+def current_key(model_sig: str, space: Optional[KnobSpace] = None,
+                mesh_shape=None, device_kind: Optional[str] = None
+                ) -> Dict:
+    """The four-part DB key for THIS process's world."""
+    space = space or default_space()
+    return {"model_sig": model_sig,
+            "device_kind": device_kind or _device_kind(),
+            "mesh_shape": [int(x) for x in (mesh_shape or (1,))],
+            "space_fp": space.fingerprint()}
+
+
+def consult(bind: str, model_sig: str, *, mesh_shape=None,
+            subsystems=None, db: Optional[TuneDB] = None,
+            space: Optional[KnobSpace] = None) -> Dict[str, object]:
+    """DB lookup for a binding site. Returns the validated tuned
+    config (possibly filtered to ``subsystems``), or ``{}`` when
+    MXTUNE_AUTO is off, there is no matching entry, or the entry fails
+    validation against today's space. Never raises into a bind."""
+    from .. import config
+    if not config.get("MXTUNE_AUTO"):
+        return {}
+    objective = str(config.get("MXTUNE_OBJECTIVE") or "auto")
+    if objective == "auto":
+        objective = BIND_OBJECTIVES.get(bind)
+    if objective is None:
+        _log.warning("mxtune: no objective mapped for bind kind %r — "
+                     "falling back to defaults", bind)
+        return {}
+    try:
+        space = space or default_space()
+        db = db or TuneDB()
+        key = current_key(model_sig, space, mesh_shape=mesh_shape)
+        rec = db.best_config(key, objective)
+        if rec is None:
+            _log.info(
+                "mxtune: MXTUNE_AUTO=1 but no DB entry for bind=%s "
+                "key=%s objective=%s — using defaults (run "
+                "`python tools/mxtune.py search` to populate)",
+                bind, model_sig, objective)
+            return {}
+        cfg = space.validate(rec["config"])
+        if subsystems is not None:
+            allow = {s.name for s in space.subset(subsystems).specs()}
+            cfg = {k: v for k, v in cfg.items() if k in allow}
+        applied = {
+            "bind": bind, "objective": objective, "config": cfg,
+            "value": rec.get("value"), "key": rec.get("key"),
+            "provenance": rec.get("provenance"),
+            "ts": rec.get("ts"),
+        }
+        with _LAST_LOCK:
+            _LAST[bind] = applied
+        _log.info("mxtune: auto-applied %s=%s to bind=%s (measured "
+                  "%s=%s, provenance=%s)", objective,
+                  rec.get("value"), bind, objective, rec.get("value"),
+                  (rec.get("provenance") or {}).get("source"))
+        _log.info("mxtune: applied config: %s", cfg)
+        return cfg
+    except Exception as e:  # noqa: BLE001 — a bind must never die here
+        _log.warning("mxtune: consult failed for bind=%s (%s: %s) — "
+                     "falling back to defaults", bind,
+                     type(e).__name__, e)
+        return {}
+
+
+def consult_train(model_sig: str, *, mesh_shape=None,
+                  db: Optional[TuneDB] = None) -> Dict[str, object]:
+    """Train-side consult: applies the tuned config via
+    ``config.set_flag`` (the fused-step builder reads flags at trace
+    time) and returns ``{knob: previous_override_or_None}`` so a
+    caller *could* restore. Empty when nothing applied."""
+    from .. import config
+    cfg = consult("fuse_step", model_sig, mesh_shape=mesh_shape,
+                  subsystems=("step", "opt"), db=db)
+    prev: Dict[str, object] = {}
+    for name, value in cfg.items():
+        prev[name] = config.get(name)
+        config.set_flag(name, value)
+    return prev
+
+
+def last_applied(bind: Optional[str] = None):
+    """What auto-apply last did — per bind kind, or the whole map.
+    diagnose/tunelint read this."""
+    with _LAST_LOCK:
+        if bind is not None:
+            return _LAST.get(bind)
+        return {k: dict(v) for k, v in _LAST.items()}
+
+
+def reset_applied() -> None:
+    with _LAST_LOCK:
+        _LAST.clear()
+
+
+def lint_report(db: Optional[TuneDB] = None,
+                space: Optional[KnobSpace] = None) -> Dict:
+    """The dict tunelint (passes/tunelint.py) runs on: today's knob
+    space, the DB's records, and what auto-apply did this process."""
+    space = space or default_space()
+    db = db or TuneDB()
+    return {"space": space.describe(),
+            "space_fingerprint": space.fingerprint(),
+            "db": db.describe(),
+            "entries": db.records(),
+            "applied": last_applied()}

@@ -1,0 +1,119 @@
+"""The learned cost model: ridge regression over hand-built features.
+
+Per "A Learned Performance Model for Tensor Processing Units"
+(PAPERS.md), a model trained on measured configurations prunes the
+candidate pool so real measurements go to the predicted frontier. At
+this repo's scale (tens of knobs, tens of trials per search) a
+closed-form ridge regression over quadratic-expanded knob features is
+the right size: pure numpy, deterministic (no iterative solver, no
+RNG), refit-per-trial cheap, and honest about being cold — below
+``min_samples`` measurements :attr:`ready` is False and the searcher
+falls back to trust-region/random sampling instead of trusting an
+unconditioned fit.
+
+Features come from :meth:`KnobSpace.features` (normalized knob values)
+optionally concatenated with model-level HLO statistics from
+``StepFunction.cost_analysis`` (flops, bytes accessed — constant per
+model, but they let one DB's corpus condition a model across model
+signatures). The quadratic expansion (pairwise products) lets the
+linear solve capture the knob *interactions* that dominate real knob
+spaces (page_size x num_pages is a capacity product, not a sum).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["CostModel"]
+
+
+def _expand(X: onp.ndarray) -> onp.ndarray:
+    """[x] -> [x, upper-triangle pairwise products] (bias added by the
+    solver). Deterministic column order: (i, j) with i <= j."""
+    n, d = X.shape
+    cols = [X]
+    prods = [X[:, i] * X[:, j]
+             for i in range(d) for j in range(i, d)]
+    if prods:
+        cols.append(onp.stack(prods, axis=1))
+    return onp.concatenate(cols, axis=1)
+
+
+class CostModel:
+    """Ridge regression ``y ~ W . phi(x)`` with standardized features.
+
+    ``fit`` is closed-form (normal equations with Tikhonov damping) —
+    same data in, bitwise-same weights out, which the determinism test
+    pins. ``predict`` before readiness raises: a cold model must never
+    silently rank candidates.
+    """
+
+    def __init__(self, l2: float = 1e-2, min_samples: int = 8):
+        self.l2 = float(l2)
+        self.min_samples = int(min_samples)
+        self._w: Optional[onp.ndarray] = None
+        self._mu: Optional[onp.ndarray] = None
+        self._sigma: Optional[onp.ndarray] = None
+        self._n_fit = 0
+
+    @property
+    def ready(self) -> bool:
+        return self._w is not None
+
+    @property
+    def n_samples(self) -> int:
+        return self._n_fit
+
+    def fit(self, X: Sequence[Sequence[float]],
+            y: Sequence[float]) -> bool:
+        """Fit on the measured corpus; returns True when the model is
+        warm (>= min_samples rows), False when it stayed cold."""
+        X = onp.asarray(X, dtype=onp.float64)
+        y = onp.asarray(y, dtype=onp.float64).reshape(-1)
+        if X.ndim != 2 or X.shape[0] != y.shape[0]:
+            raise MXNetError(
+                f"cost model fit: X {X.shape} does not match y "
+                f"{y.shape}")
+        self._n_fit = int(X.shape[0])
+        if self._n_fit < self.min_samples:
+            self._w = None
+            return False
+        P = _expand(X)
+        self._mu = P.mean(axis=0)
+        sig = P.std(axis=0)
+        sig[sig < 1e-12] = 1.0  # constant columns contribute nothing
+        self._sigma = sig
+        Z = (P - self._mu) / self._sigma
+        Z = onp.concatenate(
+            [onp.ones((Z.shape[0], 1)), Z], axis=1)  # bias
+        A = Z.T @ Z + self.l2 * onp.eye(Z.shape[1])
+        A[0, 0] -= self.l2  # never damp the bias
+        self._w = onp.linalg.solve(A, Z.T @ y)
+        return True
+
+    def predict(self, X: Sequence[Sequence[float]]) -> onp.ndarray:
+        if not self.ready:
+            raise MXNetError(
+                f"cost model is cold ({self._n_fit} samples < "
+                f"min_samples={self.min_samples}) — the searcher must "
+                "fall back to random/trust-region sampling")
+        X = onp.asarray(X, dtype=onp.float64)
+        Z = (_expand(X) - self._mu) / self._sigma
+        Z = onp.concatenate([onp.ones((Z.shape[0], 1)), Z], axis=1)
+        return Z @ self._w
+
+    def rank(self, X: Sequence[Sequence[float]]) -> List[int]:
+        """Candidate indices sorted best-predicted-first (ascending
+        predicted objective — callers feed direction-normalized y where
+        smaller is always better)."""
+        pred = self.predict(X)
+        return [int(i) for i in onp.argsort(pred, kind="stable")]
+
+    def describe(self) -> dict:
+        return {"ready": self.ready, "n_samples": self._n_fit,
+                "min_samples": self.min_samples, "l2": self.l2,
+                "n_weights": (0 if self._w is None
+                              else int(self._w.shape[0]))}

@@ -1,0 +1,321 @@
+"""The searchable knob space: typed declarations of every tunable.
+
+The repo's performance knobs are ordinary config flags
+(``mxnet_tpu/config.py``) — typed, documented, env-resolvable — but a
+flag alone does not say *how to search it*: which values are worth
+trying, which subsystem's bind consumes it, and whether changing it can
+move numerics (a quantized KV pool) or only schedules (a batch-size
+rung). :class:`KnobSpec` adds exactly that metadata, and
+:class:`KnobSpace` is the validated collection the searcher, the tuning
+DB and the auto-apply path all share.
+
+Subsystems **self-describe**: each package that owns tunables ships a
+``tunables.py`` module declaring its specs via :func:`declare`
+(``step/tunables.py``, ``opt/tunables.py``, ``serve2/tunables.py``,
+``serve/tunables.py``), and :func:`default_space` imports those hooks
+and assembles the space — there is no hardcoded master list to drift
+out of sync when a subsystem grows a knob.
+
+The space's :meth:`~KnobSpace.fingerprint` (a digest of every spec's
+name/type/range) is part of the tuning-DB key: an entry measured
+against a different knob universe must never silently apply — a
+fingerprint mismatch is the ``tunelint`` stale-DB class.
+
+Safety classes
+--------------
+- ``steady``  — host-side scheduling only; cannot change results or
+  compiled programs (e.g. ``MXSERVE2_MAX_INFLIGHT``).
+- ``rebind``  — changes compiled programs (fresh warmup bill) but is
+  numerics-preserving under its tolerance class (e.g. page geometry,
+  ``MXNET_GRAPH_OPT``).
+- ``guarded`` — can move numerics beyond the bitwise class (e.g.
+  ``MXSERVE3_KV_DTYPE``); candidates survive the measurement runner
+  only if the opt/verify tolerance gate passes, and tunelint flags a
+  guarded knob applied without tolerance provenance.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["KnobSpec", "KnobSpace", "declare", "declared_specs",
+           "default_space", "OBJECTIVES", "objective_direction"]
+
+#: objective name -> optimization direction. The measurement runner
+#: produces these, the DB ranks by them, tunelint cross-checks them.
+OBJECTIVES: Dict[str, str] = {
+    "fused_step_time_s": "min",      # median fused train-step seconds
+    "serve2_open_qps_slo": "max",    # open-loop goodput QPS within SLO
+    "serve_open_qps_slo": "max",     # ServingEngine (CNN tier) goodput
+}
+
+SAFETY_CLASSES = ("steady", "rebind", "guarded")
+KINDS = ("int", "choice", "bool")
+
+
+def objective_direction(objective: str) -> str:
+    try:
+        return OBJECTIVES[objective]
+    except KeyError:
+        raise MXNetError(
+            f"unknown objective {objective!r}; known: "
+            f"{sorted(OBJECTIVES)}")
+
+
+class KnobSpec:
+    """One tunable: a registered config flag plus search metadata.
+
+    ``candidates`` is the explicit searchable value set (the AutoTVM
+    idiom: a small factorized grid beats an unbounded range — every
+    value in it must be *legal*, profitability is what gets searched).
+    ``int`` knobs additionally accept any value inside
+    ``[lo, hi] = [min(candidates), max(candidates)]`` at validation
+    time so a hand-written config within range round-trips.
+    """
+
+    __slots__ = ("name", "kind", "candidates", "subsystem", "safety",
+                 "doc")
+
+    def __init__(self, name: str, kind: str, candidates: Sequence,
+                 subsystem: str, safety: str = "rebind", doc: str = ""):
+        if kind not in KINDS:
+            raise MXNetError(f"knob {name!r}: unknown kind {kind!r}; "
+                             f"choose from {KINDS}")
+        if safety not in SAFETY_CLASSES:
+            raise MXNetError(
+                f"knob {name!r}: unknown safety class {safety!r}; "
+                f"choose from {SAFETY_CLASSES}")
+        if not candidates:
+            raise MXNetError(f"knob {name!r}: empty candidate set")
+        from .. import config as _config
+        if name not in _config.flags():
+            raise MXNetError(
+                f"knob {name!r} is not a registered config flag — "
+                "tunables wrap flags so defaults/env/docs stay single-"
+                "sourced (register_flag first)")
+        self.name = name
+        self.kind = kind
+        if kind == "bool":
+            candidates = tuple(bool(c) for c in candidates)
+        elif kind == "int":
+            candidates = tuple(sorted(int(c) for c in candidates))
+        else:
+            candidates = tuple(candidates)
+            flag = _config.flags()[name]
+            if flag.choices:
+                bad = [c for c in candidates if c not in flag.choices]
+                if bad:
+                    raise MXNetError(
+                        f"knob {name!r}: candidates {bad} are outside "
+                        f"the flag's declared choices {flag.choices}")
+        self.candidates = candidates
+        self.subsystem = subsystem
+        self.safety = safety
+        self.doc = doc
+
+    @property
+    def lo(self):
+        return self.candidates[0] if self.kind == "int" else None
+
+    @property
+    def hi(self):
+        return self.candidates[-1] if self.kind == "int" else None
+
+    def default(self):
+        from .. import config as _config
+        return _config.flags()[self.name].default
+
+    def contains(self, value) -> bool:
+        if self.kind == "int":
+            try:
+                v = int(value)
+            except (TypeError, ValueError):
+                return False
+            return self.lo <= v <= self.hi
+        if self.kind == "bool":
+            return isinstance(value, bool) or value in (0, 1)
+        return value in self.candidates
+
+    def coerce(self, value):
+        if self.kind == "int":
+            return int(value)
+        if self.kind == "bool":
+            return bool(value)
+        return value
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "kind": self.kind,
+                "candidates": list(self.candidates),
+                "subsystem": self.subsystem, "safety": self.safety,
+                "doc": self.doc}
+
+    def __repr__(self):
+        return (f"KnobSpec({self.name}, {self.kind}, "
+                f"{self.subsystem}/{self.safety}, "
+                f"candidates={list(self.candidates)})")
+
+
+class KnobSpace:
+    """A validated, fingerprinted collection of :class:`KnobSpec`."""
+
+    def __init__(self, specs: Iterable[KnobSpec] = ()):
+        self._specs: Dict[str, KnobSpec] = {}
+        for s in specs:
+            self.register(s)
+
+    def register(self, spec: KnobSpec) -> KnobSpec:
+        if not isinstance(spec, KnobSpec):
+            raise MXNetError(f"expected a KnobSpec, got {type(spec)}")
+        self._specs[spec.name] = spec
+        return spec
+
+    def names(self) -> List[str]:
+        return sorted(self._specs)
+
+    def specs(self) -> List[KnobSpec]:
+        return [self._specs[n] for n in self.names()]
+
+    def get(self, name: str) -> KnobSpec:
+        if name not in self._specs:
+            raise MXNetError(
+                f"unknown knob {name!r}; registered: {self.names()}")
+        return self._specs[name]
+
+    def __contains__(self, name) -> bool:
+        return name in self._specs
+
+    def __len__(self):
+        return len(self._specs)
+
+    def subset(self, subsystems) -> "KnobSpace":
+        want = {subsystems} if isinstance(subsystems, str) \
+            else set(subsystems)
+        return KnobSpace(s for s in self.specs()
+                         if s.subsystem in want)
+
+    def subsystems(self) -> List[str]:
+        return sorted({s.subsystem for s in self.specs()})
+
+    def validate(self, cfg: Dict[str, object]) -> Dict[str, object]:
+        """Reject unknown knobs and out-of-range values; returns the
+        coerced config. This is the unknown-knob rejection the tuning
+        DB and auto-apply both route through — a stale entry from an
+        older knob universe fails HERE, not deep inside a bind."""
+        out = {}
+        for name in sorted(cfg):
+            spec = self.get(name)  # raises on unknown knob
+            value = cfg[name]
+            if not spec.contains(value):
+                rng = (f"[{spec.lo}, {spec.hi}]" if spec.kind == "int"
+                       else f"{list(spec.candidates)}")
+                raise MXNetError(
+                    f"knob {name!r}: value {value!r} outside the "
+                    f"declared range {rng}")
+            out[name] = spec.coerce(value)
+        return out
+
+    def defaults(self) -> Dict[str, object]:
+        return {s.name: s.default() for s in self.specs()}
+
+    def fingerprint(self) -> str:
+        """Stable digest of the knob universe (names, kinds, ranges,
+        safety). Part of every tuning-DB key."""
+        payload = json.dumps(
+            [{k: v for k, v in s.to_dict().items() if k != "doc"}
+             for s in self.specs()], sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    def sample(self, rng) -> Dict[str, object]:
+        """One uniform-random candidate (``rng``: numpy RandomState)."""
+        return {s.name: s.candidates[int(rng.randint(
+            len(s.candidates)))] for s in self.specs()}
+
+    def neighbor(self, cfg: Dict[str, object], rng) -> Dict[str, object]:
+        """Trust-region move: perturb ONE knob to an adjacent
+        candidate — the local search used around the incumbent once
+        the model has a frontier to refine."""
+        out = dict(cfg)
+        spec = self.specs()[int(rng.randint(len(self)))]
+        cands = list(spec.candidates)
+        cur = out.get(spec.name, spec.default())
+        try:
+            i = cands.index(spec.coerce(cur))
+        except ValueError:
+            i = int(rng.randint(len(cands)))
+        j = max(0, min(len(cands) - 1,
+                       i + (1 if rng.randint(2) else -1)))
+        out[spec.name] = cands[j]
+        return out
+
+    def features(self, cfg: Dict[str, object]) -> List[float]:
+        """Hand-built numeric features for the cost model: one column
+        per knob (fixed order = sorted names), normalized to [0, 1].
+        Choices encode as candidate index so the model sees ordinal
+        structure where there is one (graph-opt levels, dtype widths)."""
+        feats = []
+        for spec in self.specs():
+            value = cfg.get(spec.name, spec.default())
+            if spec.kind == "int":
+                lo, hi = spec.lo, spec.hi
+                v = (float(int(value)) - lo) / (hi - lo) if hi > lo \
+                    else 0.0
+            elif spec.kind == "bool":
+                v = 1.0 if value else 0.0
+            else:
+                cands = list(spec.candidates)
+                try:
+                    v = cands.index(value) / max(len(cands) - 1, 1)
+                except ValueError:
+                    v = 0.0
+            feats.append(v)
+        return feats
+
+    def feature_names(self) -> List[str]:
+        return [s.name for s in self.specs()]
+
+    def describe(self) -> dict:
+        return {"fingerprint": self.fingerprint(),
+                "n_knobs": len(self),
+                "subsystems": self.subsystems(),
+                "knobs": [s.to_dict() for s in self.specs()]}
+
+
+# ---------------------------------------------------------------------------
+# self-description hooks
+# ---------------------------------------------------------------------------
+
+_DECLARED: Dict[str, KnobSpec] = {}
+
+#: tunables.py modules imported by default_space(); each declares its
+#: own subsystem's knobs at import time via declare().
+_HOOK_MODULES: Tuple[str, ...] = (
+    "mxnet_tpu.step.tunables",
+    "mxnet_tpu.opt.tunables",
+    "mxnet_tpu.serve2.tunables",
+    "mxnet_tpu.serve.tunables",
+)
+
+
+def declare(name: str, kind: str, candidates: Sequence, subsystem: str,
+            safety: str = "rebind", doc: str = "") -> KnobSpec:
+    """Register one tunable in the global declaration table (idempotent
+    by name — re-imports just overwrite with an identical spec)."""
+    spec = KnobSpec(name, kind, candidates, subsystem, safety, doc)
+    _DECLARED[spec.name] = spec
+    return spec
+
+
+def declared_specs() -> List[KnobSpec]:
+    return [_DECLARED[n] for n in sorted(_DECLARED)]
+
+
+def default_space() -> KnobSpace:
+    """The full knob space: import every subsystem's tunables hook and
+    assemble the declared specs."""
+    import importlib
+    for mod in _HOOK_MODULES:
+        importlib.import_module(mod)
+    return KnobSpace(declared_specs())

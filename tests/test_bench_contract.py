@@ -482,3 +482,67 @@ def test_bench_pipe_emits_mxpipe_scaling():
         assert leg["recompiles_after_warmup"] == 0, leg
         assert leg["step_time_s"] > 0, leg
         assert len(leg["stage_param_bytes"]) == leg["n_stage"], leg
+
+
+@pytest.mark.slow
+def test_bench_tune_emits_mxtune_search():
+    """--tune contract: one mxtune_search JSON line; the auto-applied
+    config must match the search best, reproduce with ZERO post-warmup
+    recompiles, and the gate fields must be present. Reduced knobs
+    keep this a contract check (shape + invariants); the
+    acceptance-scale >=1.05x gate comes from the default knobs."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "MXTPU_BENCH_FORCE_CPU": "1",
+        "MXTPU_BENCH_STORE": "0",
+        "MXTPU_BENCH_TUNE_BUDGET": "4",
+        "MXTPU_BENCH_TUNE_STEPS": "3",
+        "MXTPU_BENCH_TUNE_REQUESTS": "10",
+        "MXTPU_BENCH_TIMEOUT": "900",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--tune"],
+        capture_output=True, text=True, timeout=960, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, \
+        f"no JSON line:\n{proc.stdout[-800:]}\n{proc.stderr[-400:]}"
+    data = json.loads(lines[-1])
+    assert data["metric"] == "mxtune_search"
+    assert data["value"] is not None and data["value"] > 0, data
+    # the apply path is the contract: what search found is what bind
+    # got, it compiled warm, and the DB holds the trials
+    assert data["auto_applied"] is True, data
+    assert data["recompiles_after_apply"] == 0, data
+    assert data["db_records"] >= 2, data
+    assert "tune_ok" in data and "threshold" in data
+    for leg in ("fuse_step", "serve2"):
+        assert data[f"{leg}_baseline"] > 0, data
+        assert data[f"{leg}_trials_measured"] >= 1, data
+        assert data[f"{leg}_recompiles_after_apply"] == 0, data
+
+
+@pytest.mark.slow
+def test_benchstore_committed_store_schema_and_dedupe():
+    """Every record in the committed perf-trajectory store must be
+    schema-valid, and loading must be dedupe-idempotent (a
+    double-ingested artifact never double-weights the median)."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import benchstore
+    path = os.path.join(ROOT, "tools", "benchstore.jsonl")
+    recs = benchstore.load(path)
+    assert recs, "committed store is empty"
+    for r in recs:
+        assert benchstore.validate(r) == [], \
+            f"schema problems in committed store: " \
+            f"{benchstore.validate(r)}\n{json.dumps(r)[:300]}"
+    assert benchstore.dedupe(recs) == recs  # load() already deduped
+    # dedupe actually drops an exact duplicate
+    assert len(benchstore.dedupe(recs + [dict(recs[0])])) == len(recs)
+    # validate() actually rejects the degenerate shapes
+    assert benchstore.validate({"metric": "m"})  # missing fields
+    assert benchstore.validate(
+        dict(recs[0], value="fast"))  # wrong type
+    assert benchstore.validate(
+        dict(recs[0], value=float("nan")))  # non-finite

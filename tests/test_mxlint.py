@@ -266,7 +266,7 @@ def test_pass_manager_registry():
                           "guardlint", "metriclint", "obslint",
                           "oplint", "pipelint", "podlint", "racelint",
                           "servelint", "shardlint", "steplint",
-                          "tracercheck"]
+                          "tracercheck", "tunelint"]
     with pytest.raises(KeyError):
         pm.get("no_such_pass")
     out = sym.var("x") + sym.var("x")

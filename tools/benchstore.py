@@ -36,9 +36,10 @@ import sys
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["DEFAULT_PATH", "store_path", "host_fingerprint", "git_rev",
-           "record", "load", "trajectory", "direction", "check",
-           "ingest_bench_file", "main"]
+__all__ = ["DEFAULT_PATH", "SCHEMA", "store_path", "host_fingerprint",
+           "git_rev", "record", "validate", "dedupe", "load",
+           "trajectory", "direction", "check", "ingest_bench_file",
+           "main"]
 
 DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "benchstore.jsonl")
@@ -111,6 +112,61 @@ def record(metric: str, value, unit: str = "", vs_baseline=None,
     return rec
 
 
+#: the store's record schema: field -> required type(s). ``validate``
+#: returns the problems (empty list = well-formed); the bench-contract
+#: tests run it over the committed store so a hand-edited or
+#: schema-drifted line fails CI instead of silently skewing gates.
+SCHEMA = {"ts": (int, float), "metric": str, "value": (int, float),
+          "unit": str, "host": str, "mesh": str, "rev": str}
+
+
+def validate(rec: dict) -> List[str]:
+    """Problems with one store record against :data:`SCHEMA` (required
+    fields, types, finite value; ``extra`` scalar-only)."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    for field, types in SCHEMA.items():
+        if field not in rec:
+            problems.append(f"missing field {field!r}")
+        elif not isinstance(rec[field], types) or \
+                isinstance(rec[field], bool):
+            problems.append(
+                f"field {field!r} is {type(rec[field]).__name__}")
+    v = rec.get("value")
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        if v != v or v in (float("inf"), float("-inf")):
+            problems.append(f"value {v!r} is not finite")
+    extra = rec.get("extra")
+    if extra is not None:
+        if not isinstance(extra, dict):
+            problems.append("extra is not an object")
+        else:
+            for k, ev in extra.items():
+                if ev is not None and not isinstance(
+                        ev, (str, int, float, bool)):
+                    problems.append(
+                        f"extra[{k!r}] is {type(ev).__name__} "
+                        "(scalars only)")
+    return problems
+
+
+def dedupe(records: List[dict]) -> List[dict]:
+    """Drop exact duplicates — same (metric, host, mesh, rev, ts,
+    value) — keeping first occurrence and order. Double-ingesting a
+    BENCH_*.json artifact must not double-weight the median."""
+    seen = set()
+    out = []
+    for r in records:
+        fp = (r.get("metric"), r.get("host"), r.get("mesh", ""),
+              r.get("rev"), r.get("ts"), r.get("value"))
+        if fp in seen:
+            continue
+        seen.add(fp)
+        out.append(r)
+    return out
+
+
 def load(path: Optional[str] = None) -> List[dict]:
     p = store_path(path)
     if p is None or not os.path.exists(p):
@@ -129,7 +185,7 @@ def load(path: Optional[str] = None) -> List[dict]:
                     and "value" in rec:
                 out.append(rec)
     out.sort(key=lambda r: r.get("ts", 0.0))
-    return out
+    return dedupe(out)
 
 
 def trajectory(records: List[dict], metric: str,

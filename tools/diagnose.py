@@ -720,6 +720,58 @@ def check_fleet():
               % (sc.get("decision"), sc.get("reason")))
 
 
+def check_tune():
+    """Autotuner state: MXTUNE_* flag resolution, the tuning DB's
+    summary (records, keys, objectives), and what bind-time auto-apply
+    last did in THIS process with its provenance (mxnet_tpu/tune/;
+    docs/tuning.md runbook)."""
+    print("----------Autotuning (mxtune)----------")
+    try:
+        from mxnet_tpu import config, tune
+    except Exception as e:
+        print("mxtune       : unavailable (%s)" % e)
+        return
+    auto = bool(config.get("MXTUNE_AUTO"))
+    print("auto-apply   :", "ON (binds consult the DB)" if auto
+          else "(off — binding is bit-identical to untuned)")
+    print("objective    :", config.get("MXTUNE_OBJECTIVE"),
+          "(auto = per bind kind)" if
+          str(config.get("MXTUNE_OBJECTIVE")) == "auto" else "")
+    print("budget       :", int(config.get("MXTUNE_BUDGET")),
+          "trial(s) default for search")
+    try:
+        db = tune.TuneDB()
+        d = db.describe()
+        if d["records"]:
+            print("db           : %s — %d record(s), %d key(s), "
+                  "objectives %s"
+                  % (d["path"], d["records"], d["keys"],
+                     d["objectives"]))
+        else:
+            print("db           : %s — empty (run `python tools/"
+                  "mxtune.py search` to populate)" % d["path"])
+    except Exception as e:
+        print("db           : unreadable (%s)" % e)
+    try:
+        space = tune.default_space()
+        print("knob space   : %d knob(s) over %s, fingerprint %s"
+              % (len(space), space.subsystems(),
+                 space.fingerprint()))
+    except Exception as e:
+        print("knob space   : unavailable (%s)" % e)
+    applied = tune.last_applied()
+    if not applied:
+        print("last applied : nothing this process"
+              + ("" if auto else " (MXTUNE_AUTO is off)"))
+    for bind, info in sorted(applied.items()):
+        prov = info.get("provenance") or {}
+        print("last applied : bind=%s %s (measured %s=%s, source %s, "
+              "trial %s)"
+              % (bind, info.get("config"), info.get("objective"),
+                 info.get("value"), prov.get("source"),
+                 prov.get("trial")))
+
+
 def main():
     check_python()
     check_pip()
@@ -739,6 +791,7 @@ def main():
     check_mxsan()
     check_obs()
     check_fleet()
+    check_tune()
     check_mxlint()
 
 

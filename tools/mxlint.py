@@ -701,6 +701,73 @@ def _selfcheck_race_findings():
     return findings
 
 
+def _selfcheck_tune_findings():
+    """tunelint self-check: build the live knob space, write one legal
+    measured record into a throwaway tuning DB and lint it (a fresh DB
+    with one rail-passing record must lint clean beyond the info
+    summary) — then, coverage check on the lint itself, a synthetic
+    report with a stale entry (unknown knob, drifted range, drifted
+    space fingerprint), a value-less record, an unknown objective, a
+    guarded knob without provenance and a post-apply recompile MUST
+    each fire their finding."""
+    import tempfile
+    from mxnet_tpu.passes import Finding
+    from mxnet_tpu.passes.tunelint import lint_tune_report
+    from mxnet_tpu.tune import TuneDB, current_key, default_space
+    from mxnet_tpu.tune.apply import lint_report
+
+    space = default_space()
+    db = TuneDB(tempfile.mkdtemp(prefix="mxlint-tune-"), capacity=8)
+    key = current_key("params:selfcheck", space)
+    db.append({"key": key,
+               "config": {"MXNET_GRAPH_OPT": 2},
+               "objective": "fused_step_time_s", "value": 0.01,
+               "provenance": {"source": "<self-check tune>",
+                              "tolerance_class": "fusion"}})
+    findings = [f for f in lint_tune_report(lint_report(db, space))
+                if f.severity != "info"]
+    # the lint must FIRE on the bad fixtures — otherwise the pass is
+    # vacuous
+    fp = space.fingerprint()
+    badkey = dict(key, space_fp="0" * 16)
+    bad = {
+        "space": space.describe(), "space_fingerprint": fp,
+        "db": {"path": "<bad fixture>"},
+        "entries": [
+            {"key": badkey, "config": {"MXNET_NO_SUCH_KNOB": 1},
+             "objective": "fused_step_time_s", "value": 0.01},
+            {"key": dict(key), "config": {"MXNET_GRAPH_OPT": 99},
+             "objective": "fused_step_time_s", "value": 0.01},
+            {"key": dict(key), "config": {"MXNET_GRAPH_OPT": 1},
+             "objective": "fused_step_time_s", "value": None},
+            {"key": dict(key), "config": {"MXNET_GRAPH_OPT": 1},
+             "objective": "not_an_objective", "value": 0.01},
+            {"key": dict(key),
+             "config": {"MXSERVE3_KV_DTYPE": "bf16"},
+             "objective": "serve2_open_qps_slo", "value": 4.0},
+        ],
+        "applied": {"serve2": {"config": {"MXSERVE2_PAGE_SIZE": 32},
+                               "objective": "serve2_open_qps_slo"}},
+        "recompiles_after_apply": {"serve2": 3},
+    }
+    fired = {f.check for f in lint_tune_report(bad)}
+    for check in ("stale-db-entry", "objective-without-measurement",
+                  "guarded-without-provenance",
+                  "applied-config-recompile"):
+        if check not in fired:
+            findings.append(Finding(
+                "tunelint", "selfcheck-coverage", "<bad fixture>",
+                "error",
+                f"lint did not fire {check!r} on the fixture built to "
+                "trigger it"))
+    findings.append(Finding(
+        "tunelint", "selfcheck-summary", "<self-check tune>", "info",
+        f"{len(space)} knob(s) over {space.subsystems()}, space "
+        f"fingerprint {fp}, 1 legal DB record linted clean, "
+        "bad-fixture coverage exercised"))
+    return findings
+
+
 def _selfcheck_block_findings():
     """tracercheck over a small hybridized block — a clean forward must
     produce no tracer findings."""
@@ -768,6 +835,12 @@ def main(argv=None):
                         "info), bad-fixture coverage, and an injected "
                         "runtime lock-order cycle detected with both "
                         "stacks")
+    p.add_argument("--tune", action="store_true", dest="tune_check",
+                   help="tunelint self-check: lint a live knob space + "
+                        "throwaway tuning DB (stale entries, "
+                        "objective-without-measurement, post-apply "
+                        "recompile alarm, guarded-knob provenance) "
+                        "plus bad-fixture coverage")
     p.add_argument("--opt", action="store_true", dest="opt_check",
                    help="graph-optimizer self-check: run the level-2 "
                         "rewrite pipeline on a fixture graph, report "
@@ -789,10 +862,10 @@ def main(argv=None):
     if not (args.ops or args.all or args.graphs or args.shard
             or args.opt_check or args.serve_check or args.guard_check
             or args.metrics_check or args.race_check
-            or args.obs_check or args.pipe_check):
+            or args.obs_check or args.pipe_check or args.tune_check):
         p.error("nothing to do: pass --ops, --all, --shard, --opt, "
                 "--serve, --pipe, --guard, --metrics, --obs, --race, "
-                "or graph JSON files")
+                "--tune, or graph JSON files")
 
     if args.shard and "xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
@@ -918,6 +991,10 @@ def main(argv=None):
         rc = _selfcheck_race_findings()
         findings.extend(rc)
         sections.append(("racelint", "<self-check concurrency>", rc))
+    if args.tune_check:
+        tf = _selfcheck_tune_findings()
+        findings.extend(tf)
+        sections.append(("tunelint", "<self-check tune>", tf))
 
     counts = severity_counts(findings)
     if args.as_json:

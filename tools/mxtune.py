@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""mxtune: search, inspect and apply the telemetry-driven tuning DB.
+
+Subcommands
+-----------
+- ``search`` — run the measurement-driven knob search in-process
+  against the built-in harnesses (fused train step / serve2 open-loop
+  decode), persisting every legal measurement into the tuning DB.
+  Trial 0 always measures the DEFAULTS, so the DB's best entry can
+  never be worse than stock.
+- ``best``   — print the best stored record for a key + objective.
+- ``apply``  — dry-run of bind-time auto-apply: what WOULD fire for
+  this process (device kind, knob space) with MXTUNE_AUTO=1, and why
+  or why not (the docs/tuning.md "why didn't auto-apply fire"
+  runbook's first stop).
+- ``report`` — DB summary plus the tunelint findings over the live
+  space + DB (mxlint finding schema; ``--json`` for machines).
+
+Examples::
+
+    python tools/mxtune.py search --objective fused_step_time_s \\
+        --budget 12
+    python tools/mxtune.py best --objective fused_step_time_s
+    python tools/mxtune.py apply
+    python tools/mxtune.py report --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: the CLI's built-in probe signatures — search and the bench's apply
+#: leg must agree on these for the key to round-trip
+PROBE_SIGS = {"fused_step_time_s": "probe:fused-step-conv24",
+              "serve2_open_qps_slo": "probe:serve2-pipeline-lm",
+              "serve_open_qps_slo": "probe:serve2-pipeline-lm"}
+
+
+def _bench_for(objective: str, fast: bool):
+    from mxnet_tpu import tune
+    if objective == "fused_step_time_s":
+        return tune.fused_step_bench_fn(
+            batch=4 if fast else 8, warmup=1 if fast else 2,
+            steps=3 if fast else 6)
+    return tune.serve2_bench_fn(
+        requests=6 if fast else 16, max_new=4 if fast else 8,
+        qps=6.0, slo_ms=2000.0)
+
+
+def _subsystems_for(objective: str):
+    return {"fused_step_time_s": ("step", "opt"),
+            "serve2_open_qps_slo": ("serve2",),
+            "serve_open_qps_slo": ("serve",)}[objective]
+
+
+def cmd_search(args) -> int:
+    from mxnet_tpu import tune
+    space = tune.default_space().subset(
+        _subsystems_for(args.objective))
+    db = tune.TuneDB(args.db_dir)
+    sig = args.model_sig or PROBE_SIGS[args.objective]
+    # the key's space_fp is always the FULL space's fingerprint (what
+    # bind-time consult computes); the subset only narrows the search
+    key = tune.current_key(sig, tune.default_space())
+    rep = tune.run_search(
+        space, _bench_for(args.objective, args.fast), args.objective,
+        budget=args.budget, seed=args.seed, db=db, key=key,
+        source="mxtune-cli")
+    rep["key"] = key
+    rep["db"] = db.path
+    if args.as_json:
+        print(json.dumps(rep, indent=1, sort_keys=True))
+    else:
+        print(f"objective {rep['objective']} ({rep['direction']}): "
+              f"baseline {rep['baseline_value']:.6g} -> best "
+              f"{rep['best_value']:.6g} "
+              f"(x{rep['speedup']:.3f}), {rep['measured']} measured / "
+              f"{rep['n_rejected']} rejected of budget "
+              f"{rep['budget']}")
+        print(f"best config: {rep['best_config']}")
+        print(f"model: proposed {rep['model_proposed']}, hit rate "
+              f"{rep['model_hit_rate']}")
+        print(f"persisted to {db.path} under key {sig}")
+    return 0
+
+
+def _resolve_key(args, space):
+    from mxnet_tpu import tune
+    sig = args.model_sig or PROBE_SIGS[args.objective]
+    return tune.current_key(sig, space)
+
+
+def cmd_best(args) -> int:
+    from mxnet_tpu import tune
+    space = tune.default_space()
+    db = tune.TuneDB(args.db_dir)
+    rec = db.best_config(_resolve_key(args, space), args.objective)
+    if rec is None:
+        print("no matching record" if not args.as_json
+              else json.dumps({"best": None}))
+        return 1
+    if args.as_json:
+        print(json.dumps({"best": rec}, indent=1, sort_keys=True))
+    else:
+        print(f"{args.objective} = {rec['value']} at {rec['config']}")
+        print(f"provenance: {rec.get('provenance')}")
+    return 0
+
+
+def cmd_apply(args) -> int:
+    """Dry-run the bind-time consult for every bind kind and say what
+    would fire — WITHOUT flipping MXTUNE_AUTO for the process."""
+    from mxnet_tpu import config, tune
+    from mxnet_tpu.tune.apply import BIND_OBJECTIVES
+    db = tune.TuneDB(args.db_dir)
+    out = {"auto_flag": bool(config.get("MXTUNE_AUTO")), "binds": {}}
+    config.set_flag("MXTUNE_AUTO", 1)
+    try:
+        for bind, objective in sorted(BIND_OBJECTIVES.items()):
+            sig = args.model_sig or PROBE_SIGS[objective]
+            cfg = tune.consult(bind, sig, db=db)
+            rec = tune.last_applied(bind)
+            out["binds"][bind] = {
+                "objective": objective, "model_sig": sig,
+                "would_apply": cfg or None,
+                "measured_value": (rec or {}).get("value")}
+            tune.reset_applied()
+    finally:
+        config.unset_flag("MXTUNE_AUTO")
+    if args.as_json:
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        if not out["auto_flag"]:
+            print("MXTUNE_AUTO is OFF — nothing auto-applies; below "
+                  "is what WOULD fire with MXTUNE_AUTO=1")
+        for bind, info in out["binds"].items():
+            what = (f"{info['would_apply']} (measured "
+                    f"{info['objective']}={info['measured_value']})"
+                    if info["would_apply"] else
+                    "nothing (no matching DB entry — see "
+                    "docs/tuning.md runbook)")
+            print(f"{bind}: {what}")
+    return 0
+
+
+def cmd_report(args) -> int:
+    from mxnet_tpu import tune
+    from mxnet_tpu.passes import findings_report
+    from mxnet_tpu.passes.tunelint import lint_tune_report
+    db = tune.TuneDB(args.db_dir)
+    space = tune.default_space()
+    findings = lint_tune_report(tune.lint_report(db, space))
+    rep = findings_report(
+        "mxtune", findings,
+        extra={"db": db.describe(), "space": space.describe()},
+        as_json=args.as_json)
+    if args.as_json:
+        print(rep)
+    else:
+        d = db.describe()
+        print(f"db {d['path']}: {d['records']} record(s), "
+              f"{d['keys']} key(s), objectives {d['objectives']}")
+        print(f"space: {len(space)} knob(s) over "
+              f"{space.subsystems()}, fingerprint "
+              f"{space.fingerprint()}")
+        for f in findings:
+            print(f"  {f!r}")
+    errors = sum(1 for f in findings if f.severity == "error")
+    return 1 if errors else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mxtune", description=__doc__,
+                                formatter_class=argparse
+                                .RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp, objective=True):
+        sp.add_argument("--db-dir", default=None,
+                        help="tuning-DB directory (default: "
+                             "MXTUNE_DB_DIR or ~/.mxnet_tpu/tune)")
+        sp.add_argument("--model-sig", default=None,
+                        help="override the model-signature key part "
+                             "(default: the built-in probe's)")
+        sp.add_argument("--json", action="store_true", dest="as_json")
+        if objective:
+            sp.add_argument("--objective",
+                            default="fused_step_time_s",
+                            choices=sorted(PROBE_SIGS),
+                            help="objective to search/look up")
+
+    s = sub.add_parser("search", help="measurement-driven knob search")
+    common(s)
+    s.add_argument("--budget", type=int, default=None,
+                   help="measurement trials (default: MXTUNE_BUDGET)")
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--fast", action="store_true",
+                   help="smaller harness (CI/self-check scale)")
+    s.set_defaults(fn=cmd_search)
+
+    b = sub.add_parser("best", help="best stored record for a key")
+    common(b)
+    b.set_defaults(fn=cmd_best)
+
+    a = sub.add_parser("apply", help="dry-run bind-time auto-apply")
+    common(a, objective=False)
+    a.set_defaults(fn=cmd_apply)
+
+    r = sub.add_parser("report", help="DB summary + tunelint findings")
+    common(r, objective=False)
+    r.set_defaults(fn=cmd_report)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
